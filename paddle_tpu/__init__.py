@@ -1,0 +1,43 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the
+capabilities of PaddlePaddle Fluid (reference: /root/reference,
+SunGaofeng/Paddle ~v1.4).
+
+Architecture (vs the reference):
+  - Users build a static ``Program`` of ops via ``layers.*`` — the same
+    declarative workflow as fluid (python/paddle/fluid/framework.py).
+  - The Executor traces the whole program through pure-JAX op lowerings
+    into ONE XLA computation per step (instead of a C++ op-by-op
+    interpreter, framework/executor.cc): params live in HBM and are
+    donated, XLA fuses across op boundaries, collectives are
+    compiler-inserted over ICI via mesh shardings (instead of NCCL op
+    handles, framework/details/).
+  - Autodiff appends generic vjp ops (backward.py) whose pullbacks come
+    from jax.vjp of the forward lowerings (instead of per-op C++
+    GradOpMakers).
+  - Hot fused kernels (attention, layer_norm, optimizer updates) are
+    pallas TPU kernels (ops/pallas/), the analog of operators/fused/ +
+    operators/jit/.
+"""
+
+from . import core  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import backward  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .core import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
+                   TPUPlace, global_scope)
+from .core.scope import Scope  # noqa: F401
+from .executor import Executor, scope_guard  # noqa: F401
+from .framework import (Program, Variable, convert_dtype,  # noqa: F401
+                        default_main_program, default_startup_program,
+                        name_scope, program_guard)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+__version__ = "0.1.0"
+
+# fluid-compat alias so reference user scripts port by renaming only the
+# import: ``import paddle_tpu as fluid``.

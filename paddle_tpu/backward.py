@@ -1,0 +1,174 @@
+"""Static-graph autodiff: append_backward.
+
+Reference: python/paddle/fluid/backward.py (append_backward:394,
+_find_op_path_:579, _append_backward_ops_:252 querying C++ per-op
+GradOpMakers via core.get_grad_op_desc, dedup of repeated grads via
+inserted sum ops _addup_repetitive_outputs_:135, pruning :204).
+
+TPU-native redesign: the walk over ops in reverse and the @GRAD naming
+convention are kept — users see the same program structure — but there
+are no hand-written per-op grad kernels. Each appended ``vjp`` op records
+its forward op's signature; at trace time the executor calls jax.vjp on
+the forward lowering (executor._run_vjp_op), so gradients are exact by
+construction and XLA CSE merges the re-traced forward with the original.
+Gradient accumulation for vars consumed by multiple ops happens by
+add-accumulation into the @GRAD env entry (no explicit sum ops needed).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from . import framework, ops
+from .core.enforce import InvalidArgumentError, enforce
+from .framework import Variable, grad_var_name
+
+
+def _op_path_to(block, target_op_index: int,
+                stop_vars: Set[str]) -> List[int]:
+    """Indices of ops (ascending) whose outputs can influence the target
+    op, not crossing stop-gradient barriers (reference:
+    backward.py:579 _find_op_path_)."""
+    needed: Set[str] = set()
+    target = block.ops[target_op_index]
+    needed.update(target.input_arg_names)
+    path = [target_op_index]
+    for i in range(target_op_index - 1, -1, -1):
+        op = block.ops[i]
+        outs = set(op.output_arg_names)
+        if outs & needed:
+            path.append(i)
+            for n in op.input_arg_names:
+                if n not in stop_vars:
+                    needed.add(n)
+    path.reverse()
+    return path
+
+
+def _collect_stop_vars(block, no_grad_set) -> Set[str]:
+    stop = set(no_grad_set or ())
+    for name, var in block.vars.items():
+        if var.stop_gradient:
+            stop.add(name)
+    return stop
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append gradient ops for ``loss`` to its program; returns
+    [(param, grad_var)] like the reference (backward.py:394)."""
+    enforce(isinstance(loss, Variable), "loss must be a Variable")
+    program = loss.block.program
+    block = program.global_block()
+
+    # producer op of loss
+    target_index = None
+    for i in range(len(block.ops) - 1, -1, -1):
+        if loss.name in block.ops[i].output_arg_names:
+            target_index = i
+            break
+    enforce(target_index is not None,
+            "loss %r has no producer op in the program" % loss.name)
+
+    stop_vars = _collect_stop_vars(block, no_grad_set)
+    path = _op_path_to(block, target_index, stop_vars)
+
+    # d(loss)/d(loss) = 1
+    loss_grad = block.create_var(
+        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype,
+        persistable=False, stop_gradient=True)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": tuple(loss.shape), "dtype": loss.dtype,
+               "value": 1.0, "op_role": "backward"})
+
+    # reverse walk, one vjp op per differentiable forward op
+    for i in reversed(path):
+        fwd = block.ops[i]
+        if not ops.has(fwd.type):
+            continue
+        opdef = ops.get(fwd.type)
+        if not opdef.differentiable:
+            continue
+
+        grad_outputs: Dict[str, List[str]] = {}
+        any_grad = False
+        for slot, _variadic in opdef.input_slots:
+            if slot in opdef.nondiff_slots:
+                continue
+            names = fwd.inputs.get(slot, [])
+            gnames = []
+            for n in names:
+                if n in stop_vars:
+                    continue
+                v = block._find_var_recursive(n)
+                if v is not None and v.dtype in ("float32", "float64",
+                                                 "float16", "bfloat16"):
+                    gn = grad_var_name(n)
+                    if not block.has_var(gn):
+                        block.create_var(name=gn, shape=v.shape,
+                                         dtype=v.dtype,
+                                         stop_gradient=True)
+                    gnames.append(gn)
+                    any_grad = True
+            if gnames:
+                grad_outputs[slot + "@GRAD"] = gnames
+        if not any_grad:
+            continue
+
+        out_grad_inputs = [grad_var_name(n) for n in fwd.output_arg_names]
+        block.append_op(
+            type="vjp",
+            inputs={"FwdIn": fwd.input_arg_names,
+                    "OutGrad": [g for g in out_grad_inputs
+                                if block.has_var(g)]},
+            outputs=grad_outputs,
+            attrs={
+                "fwd_type": fwd.type,
+                "fwd_inputs": {k: list(v) for k, v in fwd.inputs.items()},
+                "fwd_outputs": {k: list(v)
+                                for k, v in fwd.outputs.items()},
+                "fwd_attrs": dict(fwd.attrs),
+                "fwd_op_index": i,
+                "no_grad_vars": tuple(sorted(stop_vars)),
+                "op_role": "backward",
+            })
+
+    # collect (param, grad) pairs
+    params = block.all_parameters()
+    if parameter_list is not None:
+        wanted = {p if isinstance(p, str) else p.name
+                  for p in parameter_list}
+        params = [p for p in params if p.name in wanted]
+    result = []
+    for p in params:
+        if not p.trainable:
+            continue
+        gn = grad_var_name(p.name)
+        if block.has_var(gn):
+            result.append((p, block.var(gn)))
+    return result
+
+
+def calc_gradient(targets, inputs, target_gradients=None,
+                  no_grad_set=None):
+    """Reference: backward.py:619. Gradients of targets w.r.t. inputs."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    enforce(len(targets) == 1,
+            "calc_gradient currently supports a single target")
+    target = targets[0]
+    append_backward(target, no_grad_set=no_grad_set)
+    block = target.block.program.global_block()
+    outs = []
+    for iv in inputs:
+        gn = grad_var_name(iv.name)
+        outs.append(block.var(gn) if block.has_var(gn) else None)
+    return outs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

@@ -1,0 +1,92 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+set_gradient_clip, append_gradient_clip_ops)."""
+
+from __future__ import annotations
+
+from .framework import default_main_program
+from .layer_helper import LayerHelper
+from .layers import nn
+
+
+class BaseGradientClipAttr:
+    def _append_clip_op(self, block, grad):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _append_clip_op(self, block, grad):
+        return nn.clip(grad, self.min, self.max)
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _append_clip_op(self, block, grad):
+        return nn.clip_by_norm(grad, self.clip_norm)
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Rescale all grads so their joint L2 norm <= clip_norm (reference:
+    clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip_all(self, params_grads):
+        helper = LayerHelper("global_norm_clip")
+        sq_sums = []
+        for _p, g in params_grads:
+            if g is None:
+                continue
+            sq = nn.reduce_sum(g * g)
+            sq_sums.append(sq)
+        from .layers import tensor as t
+        total = t.sums(sq_sums) if len(sq_sums) > 1 else sq_sums[0]
+        from .layers.ops import sqrt as _sqrt
+        global_norm = _sqrt(total)
+        clip_var = t.fill_constant((), "float32", self.clip_norm)
+        scale = clip_var / nn.elementwise_max(global_norm, clip_var)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, g * scale))
+        return out
+
+
+_gradient_clip_attr = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _gradient_clip_attr
+    _gradient_clip_attr = clip
+    if param_list:
+        for p in param_list:
+            p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(params_grads, clip=None):
+    clip = clip or _gradient_clip_attr
+    if clip is None:
+        return params_grads
+    if isinstance(clip, GradientClipByGlobalNorm):
+        return clip._clip_all(params_grads)
+    block = default_main_program().global_block()
+    out = []
+    for p, g in params_grads:
+        if g is None:
+            out.append((p, g))
+            continue
+        per_param = p.gradient_clip_attr or clip
+        out.append((p, per_param._append_clip_op(block, g)))
+    return out
+
+
+ErrorClipByValue = GradientClipByValue  # parity alias

@@ -1,0 +1,99 @@
+"""Typed global flag system.
+
+TPU-native replacement for the reference's gflags-based configuration
+(reference: 115 DEFINE_* sites across paddle/fluid; whitelist exported to
+Python via core.init_gflags, python/paddle/fluid/__init__.py:136-196).
+
+One typed registry, overridable from the environment as
+``FLAGS_<name>=value`` (same spelling the reference uses), readable and
+settable from Python at runtime. Flags that gate tracing-time behavior
+take effect on the next program compilation.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in _BOOL_TRUE
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+
+
+class _Flags:
+    def __init__(self):
+        self._specs: Dict[str, _FlagSpec] = {}
+        self._values: Dict[str, Any] = {}
+
+    def define(self, name, default, help=""):
+        if isinstance(default, bool):
+            parser = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+        self._specs[name] = _FlagSpec(name, default, parser, help)
+        env = os.environ.get("FLAGS_" + name)
+        self._values[name] = parser(env) if env is not None else default
+
+    def __getattr__(self, name):
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError("unknown flag %r" % name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            super().__setattr__(name, value)
+            return
+        if name not in self._specs:
+            raise AttributeError("unknown flag %r" % name)
+        self._values[name] = value
+
+    def as_dict(self):
+        return dict(self._values)
+
+
+FLAGS = _Flags()
+
+# Execution / debugging (reference: operator.cc FLAGS_check_nan_inf :950,
+# FLAGS_benchmark :946; executor eager deletion FLAGS_eager_delete_tensor_gb).
+FLAGS.define("check_nan_inf", False,
+             "After each step, scan fetched outputs for NaN/Inf and raise.")
+FLAGS.define("benchmark", False,
+             "Block on device completion after every executor run.")
+FLAGS.define("cpu_deterministic", True, "Deterministic reductions on host.")
+FLAGS.define("deterministic", True,
+             "Ask XLA for deterministic reductions (analog of "
+             "cudnn_deterministic / sync_nccl_allreduce).")
+
+# Memory (analog of FLAGS_fraction_of_gpu_memory_to_use etc.; HBM is
+# XLA-managed so these only gate host staging buffers).
+FLAGS.define("host_pinned_pool_mb", 256,
+             "Host staging pool for infeed, in MB.")
+FLAGS.define("eager_delete_tensor_gb", 0.0,
+             "Kept for API parity; XLA manages HBM lifetimes.")
+
+# Tracing / profiling.
+FLAGS.define("profile_dir", "", "If set, xprof traces are written here.")
+
+# Random.
+FLAGS.define("global_seed", 0, "Framework-wide RNG seed (0 = nondeterministic).")
+
+# Distributed.
+FLAGS.define("sync_collectives", True,
+             "Deterministic collective order (analog of sync_nccl_allreduce).")
+FLAGS.define("rpc_deadline", 180000, "DCN RPC deadline ms (parity).")

@@ -1,0 +1,82 @@
+"""Scope: name -> value map with parent-chain lookup.
+
+Reference: paddle/fluid/framework/scope.h:45 (``Scope::Var/FindVar/NewScope``).
+Here a scope holds *device arrays* (jax.Array) for persistable variables —
+parameters, optimizer accumulators, RNG state. Transient (per-step) values
+never live in a scope: the whole step is one compiled XLA program and its
+intermediates are XLA-managed, which is the TPU-native replacement for the
+reference's per-op variable creation + garbage collection
+(executor.cc:384, garbage_collector.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .enforce import NotFoundError
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self._parent = parent
+        self._kids = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def var(self, name: str):
+        """Create-or-get in this scope (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s._parent
+        return False
+
+    def get(self, name: str):
+        v = self.find_var(name)
+        if v is None and not self.has_var(name):
+            raise NotFoundError("variable %r not found in scope" % name)
+        return v
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def drop_kids(self):
+        self._kids.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _reset_global_scope():
+    """Test helper: fresh global scope."""
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

@@ -1,0 +1,164 @@
+"""Parameter initializers — append init ops to the startup program.
+
+Reference: python/paddle/fluid/initializer.py (ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormalInitializer,
+XavierInitializer, MSRAInitializer, NumpyArrayInitializer).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .core.enforce import enforce
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="fill_constant", outputs={"Out": [var.name]},
+            attrs={"shape": tuple(var.shape), "dtype": var.dtype,
+                   "value": float(self.value)})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="uniform_random", outputs={"Out": [var.name]},
+            attrs={"shape": tuple(var.shape), "dtype": var.dtype,
+                   "min": self.low, "max": self.high, "seed": self.seed})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="gaussian_random", outputs={"Out": [var.name]},
+            attrs={"shape": tuple(var.shape), "dtype": var.dtype,
+                   "mean": self.loc, "std": self.scale,
+                   "seed": self.seed})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="truncated_gaussian_random", outputs={"Out": [var.name]},
+            attrs={"shape": tuple(var.shape), "dtype": var.dtype,
+                   "mean": self.loc, "std": self.scale,
+                   "seed": self.seed})
+
+
+def _fan_in_out(var):
+    shape = var.shape
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # fc weight (in_features, out_features)
+        return shape[0], shape[1]
+    # conv weight (out_c, in_c, k...): fan_in = in_c * prod(k),
+    # fan_out = out_c * prod(k) (reference: initializer.py _compute_fans)
+    receptive = 1
+    for d in shape[2:]:
+        receptive *= d
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierInitializer(Initializer):
+    """Glorot init (reference: initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.seed = uniform, seed
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, var, block):
+        fi, fo = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / (fi + fo))
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """He/Kaiming init (reference: initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / fi)
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        enforce(tuple(self.value.shape) == tuple(var.shape),
+                "NumpyArrayInitializer shape %s != var shape %s",
+                self.value.shape, var.shape)
+        return block.append_op(
+            type="assign_numpy_value", outputs={"Out": [var.name]},
+            attrs={"_value": self.value, "dtype": var.dtype})
+
+
+class BilinearInitializer(Initializer):
+    """For upsample deconv weights (reference: BilinearInitializer)."""
+
+    def __call__(self, var, block):
+        shape = var.shape
+        enforce(len(shape) == 4, "bilinear init needs 4-D weight")
+        c_out, c_in, h, w = shape
+        f = math.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        arr = np.zeros(shape, dtype=np.float32)
+        og = np.ogrid[:h, :w]
+        filt = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        for i in range(c_out):
+            arr[i, i % c_in] = filt
+        return NumpyArrayInitializer(arr)(var, block)
+
+
+# fluid-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)

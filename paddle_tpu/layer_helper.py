@@ -1,0 +1,107 @@
+"""LayerHelper: parameter creation + op appending for layers.
+
+Reference: python/paddle/fluid/layer_helper.py:29 — creates parameters in
+both the startup program (with their init ops) and the main program, and
+appends the layer's compute ops to the main program.
+"""
+
+from __future__ import annotations
+
+from . import framework, unique_name
+from .core.enforce import enforce
+from .framework import (Parameter, Variable, default_main_program,
+                        default_startup_program)
+from .initializer import (ConstantInitializer, XavierInitializer,
+                          _global_bias_initializer,
+                          _global_weight_initializer)
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get("name")
+        if name is None:
+            self.name = unique_name.generate(layer_type)
+        else:
+            self.name = name
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    @property
+    def block(self):
+        return self.main_program.current_block()
+
+    def append_op(self, *args, **kwargs):
+        return self.block.append_op(*args, **kwargs)
+
+    # -- variable creation -------------------------------------------------
+    def create_variable_for_type_inference(self, dtype, stop_gradient=False,
+                                           shape=None):
+        return self.block.create_var(
+            name=unique_name.generate(self.name + ".tmp"),
+            dtype=dtype, shape=shape or (), stop_gradient=stop_gradient)
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_global_variable(self, shape, dtype, persistable=False,
+                               name=None, stop_gradient=True):
+        return self.main_program.global_block().create_var(
+            name=name or unique_name.generate(self.name + ".global"),
+            shape=shape, dtype=dtype, persistable=persistable,
+            stop_gradient=stop_gradient)
+
+    def create_parameter(self, attr, shape, dtype, is_bias=False,
+                         default_initializer=None, stop_gradient=False):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if attr.name is None:
+            attr.name = unique_name.generate(".".join([self.name, "w"])) \
+                if not is_bias else unique_name.generate(
+                    ".".join([self.name, "b"]))
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = _global_bias_initializer() if is_bias \
+                else _global_weight_initializer()
+
+        # main-program parameter (metadata)
+        param = self.block.create_parameter(
+            name=attr.name, shape=shape, dtype=dtype,
+            **{k: v for k, v in attr._to_kwargs().items() if k != "name"})
+        # startup-program twin + its init op
+        startup_block = self.startup_program.global_block()
+        sp = startup_block.create_parameter(
+            name=attr.name, shape=shape, dtype=dtype,
+            **{k: v for k, v in attr._to_kwargs().items() if k != "name"})
+        init(sp, startup_block)
+        return param
+
+    # -- activation sugar --------------------------------------------------
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, str):
+            act = {"name": act}
+        act_type = act.pop("name")
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(type=act_type, inputs={"X": [input_var]},
+                       outputs={"Out": [out]}, attrs=act)
+        return out
+
+    def append_bias_op(self, input_var, bias, axis=1):
+        if bias is None:
+            return input_var
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(type="elementwise_add",
+                       inputs={"X": [input_var], "Y": [bias]},
+                       outputs={"Out": [out]}, attrs={"axis": axis})
+        return out

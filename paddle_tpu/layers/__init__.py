@@ -1,0 +1,13 @@
+"""fluid.layers-equivalent namespace: every public layer in one place
+(reference: python/paddle/fluid/layers/__init__.py)."""
+
+from . import math_op_patch  # noqa: F401  (registers Variable operators)
+from .io import data  # noqa: F401
+from .metric_op import accuracy, auc  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import (assign, cast, concat, create_global_var,  # noqa: F401
+                     create_parameter, create_tensor, diag, eye,
+                     fill_constant, fill_constant_batch_size_like,
+                     linspace, ones, ones_like, sums, zeros, zeros_like)
+from .tensor import range as range_  # noqa: F401

@@ -1,0 +1,21 @@
+"""Feed-variable declaration (reference: python/paddle/fluid/layers/io.py
+``data``). The reader-op machinery (create_py_reader_op etc.) is replaced
+by the host-side pipeline in paddle_tpu.reader (async prefetch + device
+infeed), so ``data`` only declares a feed slot."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def data(name, shape, append_batch_size=True, dtype="float32",
+         lod_level=0, type=None, stop_gradient=True):
+    """Declare a feed variable. ``append_batch_size`` prepends -1 like the
+    reference; -1 dims bind at trace time from the actual feed."""
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.main_program.global_block().create_var(
+        name=name, shape=tuple(shape), dtype=dtype, is_data=True,
+        stop_gradient=stop_gradient, lod_level=lod_level)

@@ -1,0 +1,47 @@
+"""Metric layers (reference: python/paddle/fluid/layers/metric_op.py)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from . import nn
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Reference: metric_op.py accuracy -> accuracy_op.cc."""
+    helper = LayerHelper("accuracy")
+    topk_out, topk_idx = nn.topk(input, k=k)
+    acc = helper.create_variable_for_type_inference("float32",
+                                                    stop_gradient=True)
+    correct = correct or helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [topk_out], "Indices": [topk_idx],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct],
+                              "Total": [total]})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming AUC with persistable bucket state (reference:
+    metric_op.py auc -> auc_op.cc)."""
+    from .tensor import create_global_var
+    helper = LayerHelper("auc")
+    stat_pos = create_global_var((num_thresholds + 1,), 0.0, "float32",
+                                 persistable=True)
+    stat_neg = create_global_var((num_thresholds + 1,), 0.0, "float32",
+                                 persistable=True)
+    auc_out = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    helper.append_op(type="auc",
+                     inputs={"Predict": [input], "Label": [label],
+                             "StatPos": [stat_pos],
+                             "StatNeg": [stat_neg]},
+                     outputs={"AUC": [auc_out],
+                              "StatPosOut": [stat_pos],
+                              "StatNegOut": [stat_neg]},
+                     attrs={"num_thresholds": num_thresholds})
+    return auc_out, auc_out, [stat_pos, stat_neg]

@@ -1,0 +1,799 @@
+"""User-facing layers API — parametric layers and NN ops.
+
+Reference: python/paddle/fluid/layers/nn.py (12k LoC, 171 defs: fc:211,
+embedding, conv2d, pool2d, batch_norm, layer_norm, dropout, ...). Same
+names and signatures (modulo LoD-specific args); each call appends ops to
+the default main program via LayerHelper.
+"""
+
+from __future__ import annotations
+
+from .. import framework
+from ..core.enforce import enforce
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import Constant
+
+
+def _simple(op_type, x, attrs=None, name=None, extra_inputs=None,
+            out_dtype=None, stop_gradient=False):
+    helper = LayerHelper(op_type, name=name)
+    inputs = {"X": [x]}
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    out = helper.create_variable_for_type_inference(
+        out_dtype or x.dtype, stop_gradient=stop_gradient)
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs or {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fc / embedding
+# ---------------------------------------------------------------------------
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected layer (reference: layers/nn.py:211). Multiple
+    inputs are each projected then summed, as in fluid."""
+    helper = LayerHelper("fc", name=name, act=act)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(inputs)
+    mul_results = []
+    for inp, pattr in zip(inputs, param_attrs):
+        in_features = 1
+        for d in inp.shape[num_flatten_dims:]:
+            in_features *= d
+        w = helper.create_parameter(attr=pattr,
+                                    shape=(in_features, size),
+                                    dtype=inp.dtype)
+        out = helper.create_variable_for_type_inference(inp.dtype)
+        helper.append_op(type="mul", inputs={"X": [inp], "Y": [w]},
+                         outputs={"Out": [out]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        mul_results.append(out)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(
+            inputs[0].dtype)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=(size,),
+                                    dtype=pre_bias.dtype, is_bias=True)
+        pre_act = helper.append_bias_op(pre_bias, b,
+                                        axis=num_flatten_dims)
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32",
+              name=None):
+    """Reference: layers/nn.py embedding -> lookup_table_op.cc. On TPU
+    the table is a dense HBM array; ``is_sparse`` is accepted for parity
+    (XLA's gather/scatter-add covers the SelectedRows path)."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(attr=param_attr, shape=tuple(size),
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    pad = -1 if padding_idx is None else \
+        (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(type="lookup_table",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"padding_idx": pad, "is_sparse": is_sparse,
+                            "is_distributed": is_distributed})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conv / pool / norm
+# ---------------------------------------------------------------------------
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    """Reference: layers/nn.py conv2d. use_cudnn accepted for parity and
+    ignored — XLA owns algorithm choice on TPU."""
+    helper = LayerHelper("conv2d", name=name, act=act)
+
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    fsize = _pair(filter_size)
+    channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    enforce(channels % groups == 0, "channels %% groups != 0")
+    w_shape = (num_filters, channels // groups) + fsize
+    from ..initializer import MSRAInitializer
+    w = helper.create_parameter(
+        attr=param_attr, shape=w_shape, dtype=input.dtype,
+        default_initializer=MSRAInitializer(uniform=False))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv2d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": _pair(stride),
+                            "paddings": _pair(padding),
+                            "dilations": _pair(dilation),
+                            "groups": groups,
+                            "data_format": data_format})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=(num_filters,),
+                                    dtype=input.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None,
+                     output_size=None):
+    helper = LayerHelper("conv2d_transpose", name=name, act=act)
+
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    fsize = _pair(filter_size)
+    channels = input.shape[1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=(channels, num_filters // groups) + fsize,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": _pair(stride),
+                            "paddings": _pair(padding),
+                            "dilations": _pair(dilation),
+                            "groups": groups,
+                            "output_size": output_size})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=(num_filters,),
+                                    dtype=input.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", name=name, act=act)
+
+    def _trip(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+    fsize = _trip(filter_size)
+    channels = input.shape[1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=(num_filters, channels // groups) + fsize,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": _trip(stride),
+                            "paddings": _trip(padding),
+                            "dilations": _trip(dilation),
+                            "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=(num_filters,),
+                                    dtype=input.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"ksize": pool_size,
+                            "pooling_type": pool_type,
+                            "strides": pool_stride,
+                            "paddings": pool_padding,
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode,
+                            "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="adaptive_pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pool_size": pool_size,
+                            "pooling_type": pool_type})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None, moving_mean_name=None,
+               moving_variance_name=None, use_global_stats=False):
+    """Reference: layers/nn.py batch_norm -> batch_norm_op.cc. Running
+    mean/var are persistable vars updated in-graph each step (MeanOut
+    aliases Mean), matching the reference's in-place update."""
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    dtype = input.dtype
+    c = input.shape[1] if data_layout == "NCHW" and len(input.shape) == 4 \
+        else input.shape[-1]
+    if len(input.shape) == 2:
+        c = input.shape[1]
+    scale = helper.create_parameter(attr=param_attr, shape=(c,),
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(attr=bias_attr, shape=(c,),
+                                   dtype=dtype, is_bias=True)
+    mean = _bn_stat(helper, moving_mean_name, c, dtype, 0.0)
+    var = _bn_stat(helper, moving_variance_name, c, dtype, 1.0)
+    y = helper.create_variable_for_type_inference(dtype)
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [y], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [saved_mean],
+                 "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test, "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(y)
+
+
+def _bn_stat(helper, name, c, dtype, init_val):
+    """Create a moving-stat persistable var + startup init."""
+    from .. import unique_name
+    vname = name or unique_name.generate(helper.name + ".moving")
+    v = helper.main_program.global_block().create_var(
+        name=vname, shape=(c,), dtype=dtype, persistable=True,
+        stop_gradient=True)
+    sblock = helper.startup_program.global_block()
+    sv = sblock.create_var(name=vname, shape=(c,), dtype=dtype,
+                           persistable=True, stop_gradient=True)
+    Constant(init_val)(sv, sblock)
+    return v
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Reference: layers/nn.py layer_norm -> layer_norm_op.cc (pallas
+    fused variant available, ops/pallas/layer_norm.py)."""
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    dtype = input.dtype
+    nshape = 1
+    for d in input.shape[begin_norm_axis:]:
+        nshape *= d
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(attr=param_attr, shape=(nshape,),
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(attr=bias_attr, shape=(nshape,),
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype,
+                                                     stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="layer_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [mean],
+                              "Variance": [var]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(y)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, name=None):
+    helper = LayerHelper("group_norm", name=name, act=act)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(attr=param_attr, shape=(c,),
+                                    dtype=input.dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=(c,),
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference(input.dtype,
+                                                     stop_gradient=True)
+    var = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="group_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [mean],
+                              "Variance": [var]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(y)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype,
+                                                     stop_gradient=True)
+    helper.append_op(type="dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob,
+                            "is_test": is_test, "seed": seed or 0,
+                            "dropout_implementation":
+                                dropout_implementation})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses / softmax
+# ---------------------------------------------------------------------------
+
+def softmax(input, axis=-1, use_cudnn=False, name=None):
+    return _simple("softmax", input, {"axis": axis}, name)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _simple("log_softmax", input, {"axis": axis}, name)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cross_entropy",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    sm = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Softmax": [sm], "Loss": [loss]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index, "axis": axis})
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="square_error_cost",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x], "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"ignore_index": ignore_index,
+                            "normalize": normalize})
+    return out
+
+
+def smooth_l1(x, y, sigma=1.0):
+    helper = LayerHelper("smooth_l1_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="smooth_l1_loss",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"sigma": sigma})
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out]}, attrs={"delta": delta})
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="kldiv_loss",
+                     inputs={"X": [x], "Target": [target]},
+                     outputs={"Loss": [out]},
+                     attrs={"reduction": reduction})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [out]}, attrs={"epsilon": epsilon})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reductions / simple math
+# ---------------------------------------------------------------------------
+
+def mean(x, name=None):
+    return _simple("mean", x, name=name)
+
+
+def _reduce(op_type, input, dim, keep_dim, name):
+    return _simple(op_type, input,
+                   {"dim": dim, "keep_dim": keep_dim,
+                    "reduce_all": dim is None}, name)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _simple("reduce_all", input,
+                   {"dim": dim, "keep_dim": keep_dim,
+                    "reduce_all": dim is None}, name, out_dtype="bool",
+                   stop_gradient=True)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _simple("reduce_any", input,
+                   {"dim": dim, "keep_dim": keep_dim,
+                    "reduce_all": dim is None}, name, out_dtype="bool",
+                   stop_gradient=True)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="scale", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"scale": scale, "bias": bias,
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def _elementwise(op_type, x, y, axis, act, name):
+    helper = LayerHelper(op_type, name=name, act=act)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_x": transpose_x,
+                            "transpose_y": transpose_y, "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def clip(x, min, max, name=None):
+    return _simple("clip", x, {"min": min, "max": max}, name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _simple("clip_by_norm", x, {"max_norm": max_norm}, name)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _simple("norm", x, {"axis": axis, "epsilon": epsilon}, name)
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False,
+            name=None):
+    helper = LayerHelper("reshape2", name=name, act=act)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="reshape2", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    return _simple("transpose2", x, {"axis": tuple(perm)}, name)
+
+
+def squeeze(input, axes, name=None):
+    return _simple("squeeze2", input, {"axes": tuple(axes)}, name)
+
+
+def unsqueeze(input, axes, name=None):
+    return _simple("unsqueeze2", input, {"axes": tuple(axes)}, name)
+
+
+def flatten(x, axis=1, name=None):
+    return _simple("flatten2", x, {"axis": axis}, name)
+
+
+def expand(x, expand_times, name=None):
+    return _simple("expand", x, {"expand_times": tuple(expand_times)},
+                   name)
+
+
+def slice(input, axes, starts, ends):
+    return _simple("slice", input,
+                   {"axes": tuple(axes), "starts": tuple(starts),
+                    "ends": tuple(ends)})
+
+
+def shape(input):
+    return _simple("shape", input, out_dtype="int32", stop_gradient=True)
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="stack", inputs={"X": xs},
+                     outputs={"Y": [out]}, attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(n)]
+    helper.append_op(type="unstack", inputs={"X": [x]},
+                     outputs={"Y": outs},
+                     attrs={"axis": axis, "num": n})
+    return outs
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="concat", inputs={"X": input},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=0, name=None):
+    helper = LayerHelper("split", name=name)
+    n = num_or_sections if isinstance(num_or_sections, int) \
+        else len(num_or_sections)
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op(type="split", inputs={"X": [input]},
+                     outputs={"Out": outs},
+                     attrs={"num_or_sections": num_or_sections,
+                            "axis": dim})
+    return outs
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={"axis": 0})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather_nd",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]},
+                     attrs={"overwrite": overwrite})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _simple("pad", x, {"paddings": tuple(paddings),
+                              "pad_value": pad_value}, name)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _simple("pad2d", input,
+                   {"paddings": tuple(paddings), "mode": mode,
+                    "pad_value": pad_value, "data_format": data_format},
+                   name)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _simple("one_hot", input, {"depth": depth},
+                   out_dtype="float32", stop_gradient=True)
+
+
+def cast(x, dtype):
+    from ..framework import convert_dtype
+    return _simple("cast", x, {"dtype": convert_dtype(dtype)},
+                   out_dtype=convert_dtype(dtype))
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    vals = helper.create_variable_for_type_inference(input.dtype,
+                                                     stop_gradient=True)
+    idx = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [vals], "Indices": [idx]},
+                     attrs={"k": k})
+    return vals, idx
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    vals = helper.create_variable_for_type_inference(input.dtype,
+                                                     stop_gradient=True)
+    idx = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    helper.append_op(type="argsort", inputs={"X": [input]},
+                     outputs={"Out": [vals], "Indices": [idx]},
+                     attrs={"axis": axis, "descending": descending})
+    return vals, idx
+
+
+def argmax(x, axis=0):
+    return _simple("arg_max", x, {"axis": axis}, out_dtype="int64",
+                   stop_gradient=True)
+
+
+def argmin(x, axis=0):
+    return _simple("arg_min", x, {"axis": axis}, out_dtype="int64",
+                   stop_gradient=True)
+
+
+def where(condition, x, y):
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="where",
+                     inputs={"Condition": [condition], "X": [x],
+                             "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    return _simple("cumsum", x, {"axis": axis, "exclusive": exclusive,
+                                 "reverse": reverse})
+
+
+def sequence_mask(x, maxlen, dtype="float32", name=None):
+    return _simple("sequence_mask", x, {"maxlen": maxlen, "dtype": dtype},
+                   name, out_dtype=dtype, stop_gradient=True)
+
+
+def resize_bilinear(input, out_shape, name=None, align_corners=True):
+    return _simple("interpolate", input,
+                   {"out_shape": tuple(out_shape), "method": "bilinear",
+                    "align_corners": align_corners}, name)
+
+
+def resize_nearest(input, out_shape, name=None, align_corners=True):
+    return _simple("interpolate", input,
+                   {"out_shape": tuple(out_shape), "method": "nearest",
+                    "align_corners": align_corners}, name)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _simple("pixel_shuffle", x,
+                   {"upscale_factor": upscale_factor})
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def maxout(x, groups, name=None, axis=1):
+    return _simple("maxout", x, {"groups": groups, "axis": axis}, name)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": value})
+    return out

@@ -1,0 +1,98 @@
+"""Auto-generated-style thin wrappers for unary/simple ops.
+
+Reference: python/paddle/fluid/layers/ops.py (generated from OpProtos by
+layer_function_generator.py). Here the registry IS the proto source: we
+generate a wrapper per registered unary op.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "relu", "sigmoid", "tanh", "softplus", "softsign", "relu6",
+    "logsigmoid", "exp", "log", "log1p", "sqrt", "rsqrt", "abs", "ceil",
+    "floor", "round", "square", "reciprocal", "sign", "sin", "cos",
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "erf",
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = "Elementwise %s (see ops registry)." % op_type
+    return layer
+
+
+_mod = sys.modules[__name__]
+for _op in _UNARY_OPS:
+    setattr(_mod, _op, _make_unary(_op))
+
+
+def gelu(x, approximate=True, name=None):
+    helper = LayerHelper("gelu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="gelu", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"approximate": approximate})
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="leaky_relu", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"alpha": alpha})
+    return out
+
+
+def elu(x, alpha=1.0, name=None):
+    helper = LayerHelper("elu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="elu", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"alpha": alpha})
+    return out
+
+
+def swish(x, beta=1.0, name=None):
+    helper = LayerHelper("swish", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="swish", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"beta": beta})
+    return out
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    helper = LayerHelper("hard_sigmoid", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="hard_sigmoid", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"slope": slope, "offset": offset})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..initializer import Constant
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = (1,)
+    elif mode == "channel":
+        alpha_shape = (x.shape[1],)
+    else:
+        alpha_shape = tuple(x.shape[1:])
+    alpha = helper.create_parameter(attr=param_attr, shape=alpha_shape,
+                                    dtype=x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="prelu",
+                     inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out

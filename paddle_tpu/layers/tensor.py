@@ -1,0 +1,229 @@
+"""Tensor creation / manipulation layers (reference:
+python/paddle/fluid/layers/tensor.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable, convert_dtype
+from ..layer_helper import LayerHelper
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.main_program.global_block().create_var(
+        name=name or helper.name, dtype=dtype, shape=(),
+        persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Reference: tensor.py create_global_var — persistable var + startup
+    fill."""
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(shape=tuple(shape), dtype=dtype,
+                                        persistable=persistable,
+                                        name=name)
+    sblock = helper.startup_program.global_block()
+    sv = sblock.create_var(name=var.name, shape=tuple(shape), dtype=dtype,
+                           persistable=persistable, stop_gradient=True)
+    sblock.append_op(type="fill_constant", outputs={"Out": [sv]},
+                     attrs={"shape": tuple(shape), "dtype": dtype,
+                            "value": float(value)})
+    return var
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    helper = LayerHelper("create_parameter", name=name)
+    return helper.create_parameter(attr or name, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="cast", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"dtype": dtype})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    from . import nn
+    return nn.concat(input, axis, name)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": input},
+                     outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(input.dtype))
+        helper.append_op(type="assign_numpy_value",
+                         outputs={"Out": [output]},
+                         attrs={"_value": input,
+                                "dtype": str(input.dtype)})
+        return output
+    if output is None:
+        output = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="assign", inputs={"X": [input]},
+                     outputs={"Out": [output]})
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    dtype = convert_dtype(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype, stop_gradient=True)
+    helper.append_op(type="fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape), "dtype": dtype,
+                            "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(
+        convert_dtype(dtype), stop_gradient=True)
+    helper.append_op(type="fill_constant_batch_size_like",
+                     inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape),
+                            "dtype": convert_dtype(dtype),
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def _fill_like(x, out, value, helper_name):
+    helper = LayerHelper(helper_name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fill_any_like", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"value": value})
+    return out
+
+
+def ones_like(x, out=None):
+    return _fill_like(x, out, 1.0, "ones_like")
+
+
+def zeros_like(x, out=None):
+    return _fill_like(x, out, 0.0, "zeros_like")
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="range", outputs={"Out": [out]},
+                     attrs={"start": start, "end": end, "step": step,
+                            "dtype": dtype})
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    helper = LayerHelper("linspace")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="linspace", outputs={"Out": [out]},
+                     attrs={"start": start, "stop": stop, "num": num,
+                            "dtype": dtype})
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag")
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op(type="diag", inputs={"Diagonal": [diagonal]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="eye", outputs={"Out": [out]},
+                     attrs={"num_rows": num_rows,
+                            "num_columns": num_columns, "dtype": dtype})
+    return out
+
+
+_INT_MAX = 2 ** 31 - 1  # "to the end" sentinel, as fluid's slice uses
+
+
+def _getitem(var, item):
+    """Variable.__getitem__ -> slice/strided_slice ops (math_op_patch
+    parity). Negative indices and steps are supported; `x[-1]` uses the
+    INT_MAX end sentinel so it works for dynamic (-1) leading dims."""
+    from . import nn
+    from ..layer_helper import LayerHelper
+    if not isinstance(item, tuple):
+        item = (item,)
+    axes, starts, ends, strides, squeeze_axes = [], [], [], [], []
+    for ax, it in enumerate(item):
+        if isinstance(it, int):
+            axes.append(ax)
+            starts.append(it)
+            ends.append(_INT_MAX if it == -1 else it + 1)
+            strides.append(1)
+            squeeze_axes.append(ax)
+        elif isinstance(it, slice):
+            step = it.step if it.step is not None else 1
+            if it.start is None and it.stop is None and step == 1:
+                continue
+            axes.append(ax)
+            if step > 0:
+                starts.append(it.start if it.start is not None else 0)
+                ends.append(it.stop if it.stop is not None else _INT_MAX)
+            else:
+                starts.append(it.start if it.start is not None
+                              else _INT_MAX)
+                ends.append(it.stop if it.stop is not None
+                            else -_INT_MAX)
+            strides.append(step)
+        else:
+            raise TypeError("unsupported index %r" % (it,))
+    if not axes:
+        out = var
+    elif all(s == 1 for s in strides):
+        out = nn.slice(var, axes, starts, ends)
+    else:
+        helper = LayerHelper("strided_slice")
+        out = helper.create_variable_for_type_inference(var.dtype)
+        helper.append_op(type="strided_slice", inputs={"X": [var]},
+                         outputs={"Out": [out]},
+                         attrs={"axes": tuple(axes),
+                                "starts": tuple(starts),
+                                "ends": tuple(ends),
+                                "strides": tuple(strides)})
+    if squeeze_axes:
+        out = nn.squeeze(out, squeeze_axes)
+    return out

@@ -1,0 +1,6 @@
+"""Model zoo mirroring the reference's benchmark + book models
+(reference: benchmark/fluid/models/{mnist,resnet,vgg,
+stacked_dynamic_lstm,machine_translation}.py and
+python/paddle/fluid/tests/book/)."""
+
+from . import mnist  # noqa: F401

@@ -1,0 +1,34 @@
+"""MNIST models (reference: benchmark/fluid/models/mnist.py — cnn_model;
+python/paddle/fluid/tests/book/test_recognize_digits.py — mlp + conv)."""
+
+from __future__ import annotations
+
+from .. import layers
+
+
+def mlp(img, label, hidden_sizes=(200, 200)):
+    """MLP from the book test (test_recognize_digits.py mlp)."""
+    hidden = img
+    for h in hidden_sizes:
+        hidden = layers.fc(hidden, size=h, act="tanh")
+    prediction = layers.fc(hidden, size=10, act="softmax")
+    loss = layers.cross_entropy(prediction, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_loss, acc
+
+
+def cnn(img, label):
+    """conv-pool x2 + fc, the reference's cnn_model
+    (benchmark/fluid/models/mnist.py)."""
+    x = layers.reshape(img, (-1, 1, 28, 28))
+    conv1 = layers.conv2d(x, num_filters=20, filter_size=5, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=50, filter_size=5,
+                          act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    prediction = layers.fc(pool2, size=10, act="softmax")
+    loss = layers.cross_entropy(prediction, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_loss, acc

@@ -1,0 +1,61 @@
+"""Initializer ops (run inside the startup program).
+
+Reference: paddle/fluid/operators/{fill_constant_op.cc,
+gaussian_random_op.cc, uniform_random_op.cc,
+truncated_gaussian_random_op.cc} and python/paddle/fluid/initializer.py
+which appends these ops to the startup program.
+
+RNG: deterministic counter-based keys (jax.random) derived from the
+per-op ``seed`` attr; seed==0 draws from the executor-provided stream —
+same contract as the reference (seed 0 = fresh randomness each startup
+run).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _key(seed, rng):
+    if seed:
+        return jax.random.key(seed)
+    return rng
+
+
+@register("gaussian_random", [], ["Out"], differentiable=False,
+          needs_rng=True)
+def gaussian_random(*, shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    rng=None):
+    return mean + std * jax.random.normal(_key(seed, rng), shape,
+                                          dtype=dtype)
+
+
+@register("uniform_random", [], ["Out"], differentiable=False,
+          needs_rng=True)
+def uniform_random(*, shape, min=-1.0, max=1.0, seed=0, dtype="float32",
+                   rng=None):
+    return jax.random.uniform(_key(seed, rng), shape, dtype=dtype,
+                              minval=min, maxval=max)
+
+
+@register("truncated_gaussian_random", [], ["Out"], differentiable=False,
+          needs_rng=True)
+def truncated_gaussian_random(*, shape, mean=0.0, std=1.0, seed=0,
+                              dtype="float32", rng=None):
+    # truncated to [-2 std, 2 std] around mean, as the reference kernel does
+    return mean + std * jax.random.truncated_normal(
+        _key(seed, rng), -2.0, 2.0, shape, dtype=dtype)
+
+
+@register("randint", [], ["Out"], differentiable=False, needs_rng=True)
+def randint(*, shape, low, high, seed=0, dtype="int64", rng=None):
+    return jax.random.randint(_key(seed, rng), shape, low, high,
+                              dtype=dtype)
+
+
+@register("randperm", [], ["Out"], differentiable=False, needs_rng=True)
+def randperm(*, n, seed=0, dtype="int64", rng=None):
+    return jax.random.permutation(_key(seed, rng), n).astype(dtype)

@@ -1,0 +1,190 @@
+"""Elementwise + linear-algebra ops.
+
+Reference: paddle/fluid/operators/elementwise/ (~4.4k LoC, broadcasting
+machinery in elementwise_op_function.h), activation_op.cc, matmul_op.cc,
+mul_op.cc, operators/math/blas.h (cuBLAS/MKL dispatch).
+
+TPU-native: every op is a jnp/lax lowering; XLA handles broadcasting,
+fusion into MXU matmuls, and dtype promotion. The reference's ``axis``
+broadcasting convention (align Y's dims starting at ``axis`` of X) is kept
+for API parity but lowered to ordinary reshape+broadcast.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: Y aligns to X's dims starting at axis."""
+    if axis == -1 or y.ndim == x.ndim or y.ndim == 0:
+        return y
+    # insert trailing singleton dims so that y spans x.dims[axis:axis+y.ndim]
+    shape = [1] * x.ndim
+    for i in range(y.ndim):
+        shape[axis + i] = y.shape[i]
+    return y.reshape(shape)
+
+
+def _elementwise(fn):
+    def impl(x, y, *, axis=-1):
+        return fn(x, _bcast_y(x, y, axis))
+    return impl
+
+
+register("elementwise_add", ["X", "Y"], ["Out"])(_elementwise(jnp.add))
+register("elementwise_sub", ["X", "Y"], ["Out"])(_elementwise(jnp.subtract))
+register("elementwise_mul", ["X", "Y"], ["Out"])(_elementwise(jnp.multiply))
+register("elementwise_div", ["X", "Y"], ["Out"])(_elementwise(jnp.divide))
+register("elementwise_min", ["X", "Y"], ["Out"])(_elementwise(jnp.minimum))
+register("elementwise_max", ["X", "Y"], ["Out"])(_elementwise(jnp.maximum))
+register("elementwise_pow", ["X", "Y"], ["Out"])(_elementwise(jnp.power))
+register("elementwise_mod", ["X", "Y"], ["Out"], differentiable=False)(
+    _elementwise(jnp.mod))
+register("elementwise_floordiv", ["X", "Y"], ["Out"], differentiable=False)(
+    _elementwise(jnp.floor_divide))
+
+
+@register("scale", ["X"], ["Out"])
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register("mul", ["X", "Y"], ["Out"])
+def mul(x, y, *, x_num_col_dims=1, y_num_col_dims=1):
+    """Fluid 'mul': flatten x to 2-D at x_num_col_dims, then matmul
+    (reference: mul_op.cc)."""
+    xm = x
+    if x.ndim != 2:
+        lead = 1
+        for d in x.shape[:x_num_col_dims]:
+            lead *= d
+        xm = x.reshape((lead, -1))
+    ym = y
+    if y.ndim != 2:
+        lead = 1
+        for d in y.shape[:y_num_col_dims]:
+            lead *= d
+        ym = y.reshape((lead, -1))
+    out = jnp.matmul(xm, ym)
+    if x.ndim != 2:
+        out = out.reshape(x.shape[:x_num_col_dims] + (ym.shape[1],))
+    return out
+
+
+@register("matmul", ["X", "Y"], ["Out"])
+def matmul(x, y, *, transpose_x=False, transpose_y=False, alpha=1.0):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+# -- unary activations / math (reference: activation_op.cc) -----------------
+
+def _unary(name, fn, differentiable=True):
+    register(name, ["X"], ["Out"], differentiable=differentiable)(
+        lambda x: fn(x))
+
+
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lax.rsqrt)
+_unary("abs", jnp.abs)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log1p", jnp.log1p)
+_unary("square", jnp.square)
+_unary("reciprocal", jnp.reciprocal)
+_unary("sign", jnp.sign)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("erf", jax.scipy.special.erf)
+_unary("logical_not", jnp.logical_not, differentiable=False)
+_unary("isnan", jnp.isnan, differentiable=False)
+_unary("isinf", jnp.isinf, differentiable=False)
+_unary("isfinite", jnp.isfinite, differentiable=False)
+
+
+@register("clip", ["X"], ["Out"])
+def clip(x, *, min, max):
+    return jnp.clip(x, min, max)
+
+
+@register("clip_by_norm", ["X"], ["Out"])
+def clip_by_norm(x, *, max_norm):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@register("pow", ["X"], ["Out"])
+def pow_(x, *, factor=1.0):
+    return jnp.power(x, factor)
+
+
+# -- comparison / logical (reference: controlflow/compare_op.cc) ------------
+
+def _cmp(name, fn):
+    register(name, ["X", "Y"], ["Out"], differentiable=False)(
+        _elementwise(fn))
+
+
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+
+
+@register("cast", ["X"], ["Out"])
+def cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+@register("sum", ["X*"], ["Out"])
+def sum_(xs):
+    """add_n over a variadic slot (reference: sum_op.cc — the op
+    backward.py inserts to add up repeated gradients)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register("dot", ["X", "Y"], ["Out"])
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+@register("norm", ["X"], ["Out"])
+def norm(x, *, axis=-1, epsilon=1e-10):
+    return x / jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+                        + epsilon)
+
+
+@register("p_norm", ["X"], ["Out"])
+def p_norm(x, *, porder=2.0, axis=-1, keepdim=False, epsilon=1e-12):
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis,
+                             keepdims=keepdim) + epsilon, 1.0 / porder)

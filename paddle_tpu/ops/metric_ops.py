@@ -1,0 +1,75 @@
+"""Metric ops — metrics run on-device, in-graph, like the reference
+(paddle/fluid/operators/metrics/: accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("accuracy", ["Out", "Indices", "Label"],
+          ["Accuracy", "Correct", "Total"], differentiable=False)
+def accuracy(out, indices, label):
+    """top-k accuracy given top_k's (values, indices) and int labels
+    (reference: accuracy_op.cc)."""
+    lab = label.squeeze(-1) if label.ndim == 2 else label
+    correct = jnp.any(indices == lab[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(lab.shape[0], dtype=jnp.float32)
+    return num_correct / total, num_correct, total
+
+
+@register("auc", ["Predict", "Label", "StatPos", "StatNeg"],
+          ["AUC", "StatPosOut", "StatNegOut"], differentiable=False)
+def auc(predict, label, stat_pos, stat_neg, *, num_thresholds=4095):
+    """Streaming AUC via threshold buckets (reference: auc_op.cc).
+    stat_pos/stat_neg are persistable bucket counters the program wires
+    back in place."""
+    lab = label.squeeze(-1) if label.ndim == 2 else label
+    pos_prob = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 \
+        else predict.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (lab > 0).astype(stat_pos.dtype)
+    pos_new = stat_pos.at[bucket].add(is_pos)
+    neg_new = stat_neg.at[bucket].add(1.0 - is_pos)
+    # trapezoid integration over buckets, descending threshold
+    pos_rev = jnp.flip(pos_new)
+    neg_rev = jnp.flip(neg_new)
+    tp = jnp.cumsum(pos_rev)
+    fp = jnp.cumsum(neg_rev)
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc_val = jnp.where(tot_pos * tot_neg > 0,
+                        area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    return auc_val, pos_new, neg_new
+
+
+@register("precision_recall",
+          ["MaxProbs", "Indices", "Labels", "StatesInfo"],
+          ["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+          differentiable=False)
+def precision_recall(max_probs, indices, labels, states, *, class_number):
+    lab = labels.squeeze(-1) if labels.ndim == 2 else labels
+    pred = indices.reshape(-1)
+    ids = jnp.arange(class_number)
+    tp = jnp.sum((pred[:, None] == ids) & (lab[:, None] == ids), axis=0)
+    fp = jnp.sum((pred[:, None] == ids) & (lab[:, None] != ids), axis=0)
+    fn = jnp.sum((pred[:, None] != ids) & (lab[:, None] == ids), axis=0)
+    batch = jnp.stack([tp, fp, fn], axis=1).astype(jnp.float32)
+    accum = states + batch
+
+    def _metrics(s):
+        tp_, fp_, fn_ = s[:, 0], s[:, 1], s[:, 2]
+        prec = tp_ / jnp.maximum(tp_ + fp_, 1.0)
+        rec = tp_ / jnp.maximum(tp_ + fn_, 1.0)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+        return jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1),
+                          jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+
+    return _metrics(batch), _metrics(accum), accum

@@ -1,0 +1,514 @@
+"""Neural-net ops: activations, losses, conv/pool, normalization, embedding.
+
+Reference: paddle/fluid/operators/{activation_op.cc, softmax_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, conv_op.cc
+(+ conv_cudnn_op.cu.cc), pool_op.cc, batch_norm_op.cc, layer_norm_op.cc,
+group_norm_op.cc, dropout_op.cc, lookup_table_op.cc, ...}.
+
+TPU-native: convs lower to lax.conv_general_dilated (XLA tiles them onto
+the MXU); normalizations are expressed in plain jnp so XLA fuses the
+elementwise chains into surrounding matmuls; dropout uses counter-based
+RNG threaded by the executor. Data layout follows the reference's NCHW
+for API parity — XLA relayouts internally for the TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# -- activations ------------------------------------------------------------
+
+def _unary(name, fn):
+    register(name, ["X"], ["Out"])(lambda x: fn(x))
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("softplus", jax.nn.softplus)
+_unary("softsign", jax.nn.soft_sign)
+_unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+_unary("logsigmoid", jax.nn.log_sigmoid)
+
+
+@register("gelu", ["X"], ["Out"])
+def gelu(x, *, approximate=True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register("leaky_relu", ["X"], ["Out"])
+def leaky_relu(x, *, alpha=0.02):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register("elu", ["X"], ["Out"])
+def elu(x, *, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register("selu", ["X"], ["Out"])
+def selu(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+@register("swish", ["X"], ["Out"])
+def swish(x, *, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@register("hard_sigmoid", ["X"], ["Out"])
+def hard_sigmoid(x, *, slope=0.2, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register("hard_swish", ["X"], ["Out"])
+def hard_swish(x, *, threshold=6.0, scale=6.0, offset=3.0):
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+@register("prelu", ["X", "Alpha"], ["Out"])
+def prelu(x, alpha, *, mode="all"):
+    if mode == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register("softmax", ["X"], ["Out"])
+def softmax(x, *, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax", ["X"], ["Out"])
+def log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("maxout", ["X"], ["Out"])
+def maxout(x, *, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = (x.shape[:axis] + (c // groups, groups)
+                 + x.shape[axis + 1:])
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+# -- losses -----------------------------------------------------------------
+
+@register("cross_entropy", ["X", "Label"], ["Y"], nondiff=("Label",))
+def cross_entropy(x, label, *, soft_label=False, ignore_index=-100):
+    """x is a probability distribution (post-softmax), fluid semantics
+    (reference: cross_entropy_op.cc)."""
+    eps = 1e-8
+    if soft_label:
+        return -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    lab = label.squeeze(-1) if label.ndim == x.ndim else label
+    picked = jnp.take_along_axis(x, lab[..., None].astype(jnp.int32),
+                                 axis=-1)
+    loss = -jnp.log(picked + eps)
+    if ignore_index >= 0:
+        loss = jnp.where((lab == ignore_index)[..., None], 0.0, loss)
+    return loss
+
+
+@register("softmax_with_cross_entropy", ["Logits", "Label"],
+          ["Softmax", "Loss"], nondiff=("Label",))
+def softmax_with_cross_entropy(logits, label, *, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=True,
+                               numeric_stable_mode=True):
+    sm = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label.squeeze(axis) if label.ndim == logits.ndim else label
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                     axis=axis)
+        loss = -picked
+        if ignore_index >= 0:
+            loss = jnp.where((lab == ignore_index)[..., None], 0.0, loss)
+    return sm, loss
+
+
+@register("sigmoid_cross_entropy_with_logits", ["X", "Label"], ["Out"],
+          nondiff=("Label",))
+def sigmoid_cross_entropy_with_logits(x, label, *, ignore_index=-100,
+                                      normalize=False):
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if ignore_index >= 0:
+        mask = (label != ignore_index).astype(x.dtype)
+        loss = loss * mask
+        if normalize:
+            loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss
+
+
+@register("square_error_cost", ["X", "Y"], ["Out"])
+def square_error_cost(x, y):
+    return jnp.square(x - y)
+
+
+@register("smooth_l1_loss", ["X", "Y"], ["Out"])
+def smooth_l1(x, y, *, sigma=1.0):
+    s2 = sigma * sigma
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    return jnp.sum(loss, axis=-1, keepdims=True)
+
+
+@register("huber_loss", ["X", "Y"], ["Out"])
+def huber_loss(x, y, *, delta=1.0):
+    d = y - x
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d,
+                     delta * (ad - 0.5 * delta))
+
+
+@register("kldiv_loss", ["X", "Target"], ["Loss"], nondiff=("Target",))
+def kldiv_loss(x, target, *, reduction="mean"):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-10)) - x)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return loss
+
+
+@register("log_loss", ["Predicted", "Labels"], ["Loss"],
+          nondiff=("Labels",))
+def log_loss(pred, label, *, epsilon=1e-4):
+    return (-label * jnp.log(pred + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - pred + epsilon))
+
+
+@register("margin_rank_loss", ["X1", "X2", "Label"], ["Out"],
+          nondiff=("Label",))
+def margin_rank_loss(x1, x2, label, *, margin=0.0):
+    return jnp.maximum(0.0, -label * (x1 - x2) + margin)
+
+
+@register("hinge_loss", ["Logits", "Labels"], ["Loss"], nondiff=("Labels",))
+def hinge_loss(logits, labels):
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+@register("mse_loss", ["X", "Y"], ["Out"])
+def mse_loss(x, y):
+    return jnp.mean(jnp.square(x - y))
+
+
+# -- conv / pool ------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register("conv2d", ["Input", "Filter"], ["Output"])
+def conv2d(x, w, *, strides=(1, 1), paddings=(0, 0), dilations=(1, 1),
+           groups=1, data_format="NCHW"):
+    """Reference: conv_op.cc / conv_cudnn_op.cu.cc:68. Lowered to one
+    lax.conv_general_dilated — XLA picks the MXU tiling (the analog of
+    cuDNN algo search at :139-151 is done by the compiler)."""
+    strides, dilations = _pair(strides), _pair(dilations)
+    p = _pair(paddings)
+    if len(p) == 2:
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    else:
+        pad = [(p[0], p[1]), (p[2], p[3])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "HWIO", "NHWC"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
+        else None)
+
+
+@register("depthwise_conv2d", ["Input", "Filter"], ["Output"])
+def depthwise_conv2d(x, w, *, strides=(1, 1), paddings=(0, 0),
+                     dilations=(1, 1), groups=None, data_format="NCHW"):
+    g = groups or x.shape[1]
+    return conv2d(x, w, strides=strides, paddings=paddings,
+                  dilations=dilations, groups=g, data_format=data_format)
+
+
+@register("conv3d", ["Input", "Filter"], ["Output"])
+def conv3d(x, w, *, strides=(1, 1, 1), paddings=(0, 0, 0),
+           dilations=(1, 1, 1), groups=1):
+    strides = _pair(strides, 3)
+    dilations = _pair(dilations, 3)
+    p = _pair(paddings, 3)
+    pad = [(pi, pi) for pi in p]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    return lax.conv_general_dilated(x, w, window_strides=strides,
+                                    padding=pad, rhs_dilation=dilations,
+                                    dimension_numbers=dn,
+                                    feature_group_count=groups)
+
+
+@register("conv2d_transpose", ["Input", "Filter"], ["Output"])
+def conv2d_transpose(x, w, *, strides=(1, 1), paddings=(0, 0),
+                     dilations=(1, 1), groups=1, output_size=None):
+    """Gradient-of-conv semantics: out = (H-1)*stride - 2*pad +
+    dilation*(k-1) + 1 (reference: conv_transpose_op.cc). Lowered to an
+    input-dilated conv with per-side pads of dilation*(k-1) - pad."""
+    strides, dilations = _pair(strides), _pair(dilations)
+    p = _pair(paddings)
+    ks = w.shape[2:]
+    pad = [(dilations[i] * (ks[i] - 1) - p[i],) * 2 for i in range(2)]
+    # fluid filter layout for transpose: (in, out//groups, kh, kw).
+    # Deconv = conv of the input dilated by `strides` with the spatially
+    # flipped kernel; the IOHW dimension spec swaps in/out channels.
+    w_flip = jnp.flip(w, axis=(2, 3))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "IOHW", "NCHW"))
+    return lax.conv_general_dilated(
+        x, w_flip, window_strides=(1, 1), padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+@register("pool2d", ["X"], ["Out"])
+def pool2d(x, *, ksize, pooling_type="max", strides=(1, 1),
+           paddings=(0, 0), global_pooling=False, ceil_mode=False,
+           exclusive=True, adaptive=False, data_format="NCHW"):
+    """Reference: pool_op.cc. Lowered to lax.reduce_window."""
+    if data_format != "NCHW":
+        raise NotImplementedError("pool2d currently supports NCHW")
+    if global_pooling or adaptive and tuple(_pair(ksize)) == (1, 1):
+        axis = (2, 3)
+        if pooling_type == "max":
+            return jnp.max(x, axis=axis, keepdims=True)
+        return jnp.mean(x, axis=axis, keepdims=True)
+    k = _pair(ksize)
+    s = _pair(strides)
+    p = _pair(paddings)
+    window = (1, 1) + k
+    stride = (1, 1) + s
+    pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, stride, pads)
+    # avg pool
+    ones = jnp.ones_like(x)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, stride, pads)
+    if exclusive:
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, stride,
+                                   pads)
+    else:
+        counts = float(k[0] * k[1])
+    return summed / counts
+
+
+@register("adaptive_pool2d", ["X"], ["Out"])
+def adaptive_pool2d(x, *, pool_size, pooling_type="avg"):
+    n, c, h, w = x.shape
+    oh, ow = _pair(pool_size)
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if pooling_type == "max":
+        return jnp.max(x, axis=(3, 5))
+    return jnp.mean(x, axis=(3, 5))
+
+
+# -- normalization ----------------------------------------------------------
+
+@register("batch_norm",
+          ["X", "Scale", "Bias", "Mean", "Variance"],
+          ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+          nondiff=("Mean", "Variance"))
+def batch_norm(x, scale, bias, mean, var, *, epsilon=1e-5, momentum=0.9,
+               is_test=False, data_layout="NCHW", use_global_stats=False):
+    """Reference: batch_norm_op.cc/.cu. Running stats are persistable vars
+    updated functionally (MeanOut/VarianceOut alias Mean/Variance in the
+    program, as the reference does)."""
+    axes = (0, 2, 3) if (x.ndim == 4 and data_layout == "NCHW") else \
+        tuple(i for i in range(x.ndim) if i != x.ndim - 1) \
+        if data_layout == "NHWC" else (0,)
+    if x.ndim == 2:
+        axes = (0,)
+    bshape = [1] * x.ndim
+    caxis = 1 if (data_layout == "NCHW" and x.ndim == 4) else x.ndim - 1
+    if x.ndim == 2:
+        caxis = 1
+    bshape[caxis] = x.shape[caxis]
+
+    def _r(v):
+        return v.reshape(bshape)
+
+    if is_test or use_global_stats:
+        y = (x - _r(mean)) * _r(scale) * lax.rsqrt(_r(var) + epsilon) \
+            + _r(bias)
+        return y, mean, var, mean, var
+    bmean = jnp.mean(x, axis=axes)
+    bvar = jnp.mean(jnp.square(x), axis=axes) - jnp.square(bmean)
+    y = (x - _r(bmean)) * _r(scale) * lax.rsqrt(_r(bvar) + epsilon) \
+        + _r(bias)
+    mean_out = momentum * mean + (1.0 - momentum) * bmean
+    var_out = momentum * var + (1.0 - momentum) * bvar
+    return y, mean_out, var_out, bmean, bvar
+
+
+@register("layer_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"])
+def layer_norm(x, scale, bias, *, epsilon=1e-5, begin_norm_axis=1):
+    """Reference: layer_norm_op.cc. Normalizes over dims
+    [begin_norm_axis:]; pallas variant registered in ops/pallas."""
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    inv = lax.rsqrt(var + epsilon)
+    norm = (x - mean) * inv
+    bshape = [1] * begin_norm_axis + list(x.shape[begin_norm_axis:])
+    if scale is not None:
+        norm = norm * scale.reshape(bshape)
+    if bias is not None:
+        norm = norm + bias.reshape(bshape)
+    return norm, jnp.squeeze(mean), jnp.squeeze(var)
+
+
+@register("group_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"])
+def group_norm(x, scale, bias, *, groups, epsilon=1e-5):
+    n, c, h, w = x.shape
+    g = groups
+    xg = x.reshape(n, g, c // g, h, w)
+    mean = jnp.mean(xg, axis=(2, 3, 4), keepdims=True)
+    var = jnp.var(xg, axis=(2, 3, 4), keepdims=True)
+    xn = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, h, w)
+    if scale is not None:
+        xn = xn * scale.reshape(1, c, 1, 1)
+    if bias is not None:
+        xn = xn + bias.reshape(1, c, 1, 1)
+    return xn, jnp.squeeze(mean), jnp.squeeze(var)
+
+
+@register("instance_norm", ["X", "Scale", "Bias"], ["Y"])
+def instance_norm(x, scale, bias, *, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    c = x.shape[1]
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return y
+
+
+@register("l2_normalize", ["X"], ["Out"])
+def l2_normalize(x, *, axis=-1, epsilon=1e-12):
+    return x * lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+                         + epsilon)
+
+
+# -- dropout / embedding ----------------------------------------------------
+
+@register("dropout", ["X"], ["Out", "Mask"], needs_rng=True)
+def dropout(x, *, dropout_prob=0.5, is_test=False,
+            dropout_implementation="downgrade_in_infer", seed=0, rng=None):
+    """Reference: dropout_op.cc. Counter-based RNG replaces curand."""
+    if is_test:
+        if dropout_implementation == "upscale_in_train":
+            return x, jnp.ones_like(x)
+        return x * (1.0 - dropout_prob), jnp.ones_like(x)
+    key = jax.random.key(seed) if seed else rng
+    keep = jax.random.bernoulli(key, 1.0 - dropout_prob, x.shape)
+    mask = keep.astype(x.dtype)
+    if dropout_implementation == "upscale_in_train":
+        out = x * mask / (1.0 - dropout_prob)
+    else:
+        out = x * mask
+    return out, mask
+
+
+@register("lookup_table", ["W", "Ids"], ["Out"], nondiff=("Ids",))
+def lookup_table(w, ids, *, padding_idx=-1, is_sparse=False,
+                 is_distributed=False):
+    """Embedding lookup (reference: lookup_table_op.cc). On TPU this is a
+    dense HBM gather; XLA emits an efficient dynamic-gather. Sparse-grad
+    handling (SelectedRows) is subsumed by XLA scatter-add in the VJP."""
+    ids2 = ids.squeeze(-1) if ids.ndim > 1 and ids.shape[-1] == 1 else ids
+    out = jnp.take(w, ids2, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids2 == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+@register("embedding_bag", ["W", "Ids"], ["Out"], nondiff=("Ids",))
+def embedding_bag(w, ids, *, mode="sum", padding_idx=-1):
+    """Fused embedding + sequence-pool (reference:
+    fused_embedding_seq_pool_op.cc). ids: [batch, bag]; padding_idx rows
+    contribute zero."""
+    emb = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx).astype(w.dtype)[..., None]
+        emb = emb * mask
+        denom = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    else:
+        denom = float(ids.shape[1])
+    if mode == "sum":
+        return jnp.sum(emb, axis=1)
+    if mode == "mean":
+        return jnp.sum(emb, axis=1) / denom
+    return jnp.max(emb, axis=1)
+
+
+# -- misc -------------------------------------------------------------------
+
+@register("interpolate", ["X"], ["Out"])
+def interpolate(x, *, out_shape, method="nearest", align_corners=False,
+                data_format="NCHW"):
+    n, c, h, w = x.shape
+    oh, ow = out_shape
+    return jax.image.resize(x, (n, c, oh, ow),
+                            method="nearest" if method == "nearest"
+                            else "bilinear")
+
+
+@register("pixel_shuffle", ["X"], ["Out"])
+def pixel_shuffle(x, *, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register("grid_sampler", ["X", "Grid"], ["Output"])
+def grid_sampler(x, grid):
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx, wy = gx - x0, gy - y0
+
+    def _sample(xi, yi):
+        xi = jnp.clip(xi, 0, w - 1)
+        yi = jnp.clip(yi, 0, h - 1)
+        batch_idx = jnp.arange(n)[:, None, None]
+        return x[batch_idx, :, yi, xi]  # [n, oh, ow, c]
+
+    v00 = _sample(x0, y0)
+    v01 = _sample(x1, y0)
+    v10 = _sample(x0, y1)
+    v11 = _sample(x1, y1)
+    wx_, wy_ = wx[..., None], wy[..., None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+           + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return jnp.transpose(out, (0, 3, 1, 2))

@@ -1,0 +1,187 @@
+"""Optimizer update ops.
+
+Reference: paddle/fluid/operators/optimizers/ (~4.4k LoC: sgd_op.cc,
+momentum_op.cc w/ LARS variant, adam_op.cc, adamax_op, adagrad_op,
+adadelta_op, rmsprop_op, decayed_adagrad_op, proximal_*, ftrl_op,
+lamb_op). Optimizer state lives in persistable vars, updates are ops in
+the graph — exactly the reference's design, which is ALSO the idiomatic
+TPU design: the whole (fwd + bwd + update) step is one XLA program, so
+parameter updates fuse and stay in HBM.
+
+Each op returns the updated param + state; the program wires the outputs
+back to the same var names (in-place, as the reference's ParamOut ==
+Param). The executor donates the old buffers to XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("sgd", ["Param", "Grad", "LearningRate"], ["ParamOut"],
+          differentiable=False)
+def sgd(param, grad, lr):
+    return param - lr * grad
+
+
+@register("momentum", ["Param", "Grad", "Velocity", "LearningRate"],
+          ["ParamOut", "VelocityOut"], differentiable=False)
+def momentum(param, grad, velocity, lr, *, mu, use_nesterov=False):
+    v = mu * velocity + grad
+    if use_nesterov:
+        p = param - (grad + mu * v) * lr
+    else:
+        p = param - lr * v
+    return p, v
+
+
+@register("lars_momentum", ["Param", "Grad", "Velocity", "LearningRate"],
+          ["ParamOut", "VelocityOut"], differentiable=False)
+def lars_momentum(param, grad, velocity, lr, *, mu, lars_coeff=0.001,
+                  lars_weight_decay=0.0005, epsilon=1e-9):
+    pn = jnp.sqrt(jnp.sum(jnp.square(param)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(grad)))
+    local_lr = lr * lars_coeff * pn / (gn + lars_weight_decay * pn
+                                       + epsilon)
+    v = mu * velocity + local_lr * (grad + lars_weight_decay * param)
+    return param - v, v
+
+
+@register("adam",
+          ["Param", "Grad", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+           "LearningRate"],
+          ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+           "Beta2PowOut"],
+          differentiable=False)
+def adam(param, grad, m1, m2, b1p, b2p, lr, *, beta1=0.9, beta2=0.999,
+         epsilon=1e-8, lazy_mode=False):
+    """Reference: adam_op.cc (+ fuse_adam_op_pass — here fusion across
+    params happens automatically because all updates sit in one XLA
+    program). Pallas fused variant in ops/pallas/fused_adam.py."""
+    m1n = beta1 * m1 + (1.0 - beta1) * grad
+    m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(grad)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    pn = param - lr_t * m1n / (jnp.sqrt(m2n) + epsilon)
+    return pn, m1n, m2n, b1p * beta1, b2p * beta2
+
+
+@register("adamw",
+          ["Param", "Grad", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+           "LearningRate"],
+          ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+           "Beta2PowOut"],
+          differentiable=False)
+def adamw(param, grad, m1, m2, b1p, b2p, lr, *, beta1=0.9, beta2=0.999,
+          epsilon=1e-8, weight_decay=0.01):
+    m1n = beta1 * m1 + (1.0 - beta1) * grad
+    m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(grad)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    pn = param - lr_t * (m1n / (jnp.sqrt(m2n) + epsilon)) \
+        - lr * weight_decay * param
+    return pn, m1n, m2n, b1p * beta1, b2p * beta2
+
+
+@register("adamax",
+          ["Param", "Grad", "Moment", "InfNorm", "Beta1Pow",
+           "LearningRate"],
+          ["ParamOut", "MomentOut", "InfNormOut", "Beta1PowOut"],
+          differentiable=False)
+def adamax(param, grad, moment, inf_norm, b1p, lr, *, beta1=0.9,
+           beta2=0.999, epsilon=1e-8):
+    mn = beta1 * moment + (1.0 - beta1) * grad
+    inf_n = jnp.maximum(beta2 * inf_norm, jnp.abs(grad))
+    lr_t = lr / (1.0 - b1p)
+    pn = param - lr_t * mn / (inf_n + epsilon)
+    return pn, mn, inf_n, b1p * beta1
+
+
+@register("adagrad", ["Param", "Grad", "Moment", "LearningRate"],
+          ["ParamOut", "MomentOut"], differentiable=False)
+def adagrad(param, grad, moment, lr, *, epsilon=1e-6):
+    mn = moment + jnp.square(grad)
+    return param - lr * grad / (jnp.sqrt(mn) + epsilon), mn
+
+
+@register("decayed_adagrad", ["Param", "Grad", "Moment", "LearningRate"],
+          ["ParamOut", "MomentOut"], differentiable=False)
+def decayed_adagrad(param, grad, moment, lr, *, decay=0.95, epsilon=1e-6):
+    mn = decay * moment + (1.0 - decay) * jnp.square(grad)
+    return param - lr * grad / (jnp.sqrt(mn) + epsilon), mn
+
+
+@register("adadelta", ["Param", "Grad", "AvgSquaredGrad",
+                       "AvgSquaredUpdate"],
+          ["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+          differentiable=False)
+def adadelta(param, grad, avg_sq_grad, avg_sq_upd, *, rho=0.95,
+             epsilon=1e-6):
+    asg = rho * avg_sq_grad + (1.0 - rho) * jnp.square(grad)
+    update = -jnp.sqrt((avg_sq_upd + epsilon) / (asg + epsilon)) * grad
+    asu = rho * avg_sq_upd + (1.0 - rho) * jnp.square(update)
+    return param + update, asg, asu
+
+
+@register("rmsprop", ["Param", "Grad", "Moment", "MeanSquare", "MeanGrad",
+                      "LearningRate"],
+          ["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+          differentiable=False)
+def rmsprop(param, grad, moment, mean_square, mean_grad, lr, *, rho=0.95,
+            epsilon=1e-6, momentum=0.0, centered=False):
+    ms = rho * mean_square + (1.0 - rho) * jnp.square(grad)
+    if centered:
+        mg = rho * mean_grad + (1.0 - rho) * grad
+        denom = ms - jnp.square(mg) + epsilon
+    else:
+        mg = mean_grad
+        denom = ms + epsilon
+    mom = momentum * moment + lr * grad * lax.rsqrt(denom)
+    return param - mom, mom, ms, mg
+
+
+@register("ftrl", ["Param", "Grad", "SquaredAccumulator",
+                   "LinearAccumulator", "LearningRate"],
+          ["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+          differentiable=False)
+def ftrl(param, grad, sq_accum, lin_accum, lr, *, l1=0.0, l2=0.0,
+         lr_power=-0.5):
+    new_sq = sq_accum + jnp.square(grad)
+    sigma = (jnp.power(new_sq, -lr_power)
+             - jnp.power(sq_accum, -lr_power)) / lr
+    new_lin = lin_accum + grad - sigma * param
+    x = l1 * jnp.sign(new_lin) - new_lin
+    y = jnp.power(new_sq, -lr_power) / lr + 2.0 * l2
+    pre = x / y
+    pn = jnp.where(jnp.abs(new_lin) > l1, pre, jnp.zeros_like(param))
+    return pn, new_sq, new_lin
+
+
+@register("lamb",
+          ["Param", "Grad", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+           "LearningRate"],
+          ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+           "Beta2PowOut"],
+          differentiable=False)
+def lamb(param, grad, m1, m2, b1p, b2p, lr, *, beta1=0.9, beta2=0.999,
+         epsilon=1e-6, weight_decay=0.01):
+    """Reference: lamb_op.cc — layer-adaptive large-batch optimizer."""
+    m1n = beta1 * m1 + (1.0 - beta1) * grad
+    m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(grad)
+    m1h = m1n / (1.0 - b1p)
+    m2h = m2n / (1.0 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + epsilon) + weight_decay * param
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(param)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return param - lr * ratio * r, m1n, m2n, b1p * beta1, b2p * beta2
+
+
+@register("proximal_gd", ["Param", "Grad", "LearningRate"], ["ParamOut"],
+          differentiable=False)
+def proximal_gd(param, grad, lr, *, l1=0.0, l2=0.0):
+    prox = param - lr * grad
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return prox / (1.0 + lr * l2)

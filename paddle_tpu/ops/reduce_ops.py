@@ -1,0 +1,47 @@
+"""Reduction ops (reference: paddle/fluid/operators/reduce_ops/, ~3k LoC,
+templated on functors; here each is a one-line jnp lowering)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _reduce(name, fn, differentiable=True):
+    @register(name, ["X"], ["Out"], differentiable=differentiable)
+    def impl(x, *, dim=None, keep_dim=False, reduce_all=False):
+        axis = None if (reduce_all or dim is None) else tuple(
+            d % x.ndim for d in (dim if isinstance(dim, (list, tuple))
+                                 else [dim]))
+        return fn(x, axis=axis, keepdims=keep_dim)
+    return impl
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, differentiable=False)
+_reduce("reduce_any", jnp.any, differentiable=False)
+
+
+@register("mean", ["X"], ["Out"])
+def mean(x):
+    return jnp.mean(x)
+
+
+@register("logsumexp", ["X"], ["Out"])
+def logsumexp(x, *, dim=None, keep_dim=False):
+    from jax.scipy.special import logsumexp as lse
+    axis = None if dim is None else tuple(
+        d % x.ndim for d in (dim if isinstance(dim, (list, tuple))
+                             else [dim]))
+    return lse(x, axis=axis, keepdims=keep_dim)
+
+
+@register("frobenius_norm", ["X"], ["Out"])
+def frobenius_norm(x, *, dim=None, keep_dim=False):
+    axis = None if dim is None else tuple(dim)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keep_dim))

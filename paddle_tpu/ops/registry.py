@@ -1,0 +1,102 @@
+"""Op registry.
+
+Reference: paddle/fluid/framework/op_registry.h:66 (OpRegistry, the
+REGISTER_OPERATOR / REGISTER_OP_*_KERNEL macros) and op_info.h:80
+(OpInfoMap). The reference registers, per op, a C++ creator + CPU/CUDA
+kernels + a grad-op maker + shape inference.
+
+TPU-native redesign: one registration per op — a *pure JAX function* that
+lowers the op to jnp/lax (and hence XLA HLO). This single function is
+simultaneously:
+  - the "kernel" for every backend (XLA compiles it for TPU/CPU),
+  - the shape/dtype inference (tracing infers shapes),
+  - the gradient definition (jax.vjp of the function replaces the
+    reference's per-op GradOpMaker, grad_op_desc_maker.h).
+Ops that want a hand-written TPU kernel register a pallas variant which
+the executor substitutes when enabled (the analog of the reference's
+kernel-type dispatch on library=CUDNN/MKLDNN, op_kernel_type.h).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.enforce import AlreadyExistsError, NotFoundError, enforce
+
+
+@dataclass
+class OpDef:
+    type: str
+    fn: Callable
+    # slot name, variadic flag. A variadic slot (declared "X*") receives a
+    # list of values — reference OpDesc's name->var-list maps.
+    input_slots: List[Tuple[str, bool]]
+    output_slots: List[str]
+    differentiable: bool = True
+    # input slots excluded from differentiation (e.g. integer indices)
+    nondiff_slots: frozenset = frozenset()
+    needs_rng: bool = False
+    # alternate lowerings, e.g. {"pallas": fn} — kernel-type dispatch analog
+    variants: Dict[str, Callable] = field(default_factory=dict)
+
+    def pick(self, library: Optional[str] = None) -> Callable:
+        if library and library in self.variants:
+            return self.variants[library]
+        return self.fn
+
+
+_registry: Dict[str, OpDef] = {}
+
+
+def register(type, inputs, outputs, differentiable=True, nondiff=(),
+             needs_rng=False):
+    """Decorator registering an op implementation.
+
+    ``inputs``: list of slot names; suffix ``*`` marks a variadic slot.
+    The wrapped fn takes one positional arg per input slot (a list for
+    variadic slots), attrs as keyword args, and returns one value per
+    output slot (a single value if there is exactly one output).
+    """
+    input_slots = []
+    for s in inputs:
+        if s.endswith("*"):
+            input_slots.append((s[:-1], True))
+        else:
+            input_slots.append((s, False))
+
+    def deco(fn):
+        if type in _registry:
+            raise AlreadyExistsError("op %r already registered" % type)
+        _registry[type] = OpDef(
+            type=type, fn=fn, input_slots=input_slots,
+            output_slots=list(outputs), differentiable=differentiable,
+            nondiff_slots=frozenset(nondiff), needs_rng=needs_rng)
+        return fn
+
+    return deco
+
+
+def register_variant(type, library):
+    """Attach an alternate lowering (e.g. a pallas kernel) to an op."""
+
+    def deco(fn):
+        get(type).variants[library] = fn
+        return fn
+
+    return deco
+
+
+def get(type) -> OpDef:
+    try:
+        return _registry[type]
+    except KeyError:
+        raise NotFoundError("op %r is not registered" % type)
+
+
+def has(type) -> bool:
+    return type in _registry
+
+
+def all_op_types() -> List[str]:
+    return sorted(_registry)

@@ -1,0 +1,303 @@
+"""Tensor manipulation ops.
+
+Reference: paddle/fluid/operators/{reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, stack_op.cc, squeeze_op.cc, unsqueeze_op.cc,
+expand_op.cc, slice_op.cc, gather_op.cc, scatter_op.cc, assign_op.cc,
+shape_op.cc, fill_constant_op.cc, range_op.cc, one_hot_op.cc ...}.
+
+All lower directly to jnp/lax; shapes are static (XLA requirement), so
+shape-producing ops return trace-time constants where possible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("reshape2", ["X"], ["Out"])
+def reshape(x, *, shape):
+    # fluid semantics: 0 means copy dim from input, -1 infers.
+    out_shape = []
+    for i, d in enumerate(shape):
+        if d == 0:
+            out_shape.append(x.shape[i])
+        else:
+            out_shape.append(d)
+    return x.reshape(out_shape)
+
+
+@register("transpose2", ["X"], ["Out"])
+def transpose(x, *, axis):
+    return jnp.transpose(x, axis)
+
+
+@register("concat", ["X*"], ["Out"])
+def concat(xs, *, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register("split", ["X"], ["Out*"])
+def split(x, *, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list -> cumulative indices
+    idx, cum = [], 0
+    for s in num_or_sections[:-1]:
+        cum += s
+        idx.append(cum)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@register("stack", ["X*"], ["Y"])
+def stack(xs, *, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("unstack", ["X"], ["Y*"])
+def unstack(x, *, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(p, axis=axis)
+                 for p in jnp.split(x, n, axis=axis))
+
+
+@register("squeeze2", ["X"], ["Out"])
+def squeeze(x, *, axes=()):
+    if not axes:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, axis=tuple(axes))
+
+
+@register("unsqueeze2", ["X"], ["Out"])
+def unsqueeze(x, *, axes):
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register("expand", ["X"], ["Out"])
+def expand(x, *, expand_times):
+    return jnp.tile(x, expand_times)
+
+
+@register("expand_as", ["X", "Y"], ["Out"], nondiff=("Y",))
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register("tile", ["X"], ["Out"])
+def tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+@register("slice", ["X"], ["Out"])
+def slice_(x, *, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s2 = s + dim if s < 0 else min(s, dim)
+        e2 = e + dim if e < 0 else min(e, dim)
+        idx[a] = slice(s2, e2)
+    return x[tuple(idx)]
+
+
+@register("strided_slice", ["X"], ["Out"])
+def strided_slice(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@register("gather", ["X", "Index"], ["Out"], nondiff=("Index",))
+def gather(x, index, *, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register("gather_nd", ["X", "Index"], ["Out"], nondiff=("Index",))
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@register("scatter", ["X", "Ids", "Updates"], ["Out"], nondiff=("Ids",))
+def scatter(x, ids, updates, *, overwrite=True):
+    if overwrite:
+        return x.at[ids].set(updates)
+    return x.at[ids].add(updates)
+
+
+@register("scatter_nd_add", ["X", "Index", "Updates"], ["Out"],
+          nondiff=("Index",))
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@register("assign", ["X"], ["Out"])
+def assign(x):
+    return x
+
+
+@register("shape", ["X"], ["Out"], differentiable=False)
+def shape_(x):
+    return jnp.array(x.shape, dtype=jnp.int32)
+
+
+@register("fill_constant", [], ["Out"], differentiable=False)
+def fill_constant(*, shape, dtype, value):
+    return jnp.full(shape, value, dtype=dtype)
+
+
+@register("fill_constant_batch_size_like", ["Input"], ["Out"],
+          differentiable=False)
+def fill_constant_batch_size_like(ref, *, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jnp.full(out_shape, value, dtype=dtype)
+
+
+@register("fill_zeros_like", ["X"], ["Out"], differentiable=False)
+def fill_zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("fill_any_like", ["X"], ["Out"], differentiable=False)
+def fill_any_like(x, *, value):
+    return jnp.full_like(x, value)
+
+
+@register("range", [], ["Out"], differentiable=False)
+def range_(*, start, end, step, dtype):
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+@register("linspace", [], ["Out"], differentiable=False)
+def linspace(*, start, stop, num, dtype):
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+@register("one_hot", ["X"], ["Out"], differentiable=False)
+def one_hot(x, *, depth, dtype="float32"):
+    x = jnp.squeeze(x, -1) if x.ndim > 1 and x.shape[-1] == 1 else x
+    return (x[..., None] == jnp.arange(depth, dtype=x.dtype)).astype(dtype)
+
+
+@register("flatten2", ["X"], ["Out"])
+def flatten(x, *, axis=1):
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return x.reshape((lead, -1))
+
+
+@register("flip", ["X"], ["Out"])
+def flip(x, *, axis):
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@register("roll", ["X"], ["Out"])
+def roll(x, *, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register("tril_triu", ["X"], ["Out"])
+def tril_triu(x, *, diagonal=0, lower=True):
+    return jnp.tril(x, diagonal) if lower else jnp.triu(x, diagonal)
+
+
+@register("eye", [], ["Out"], differentiable=False)
+def eye(*, num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=dtype)
+
+
+@register("diag", ["Diagonal"], ["Out"])
+def diag(d):
+    return jnp.diag(d)
+
+
+@register("where", ["Condition", "X", "Y"], ["Out"], nondiff=("Condition",))
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register("cumsum", ["X"], ["Out"])
+def cumsum(x, *, axis=-1, exclusive=False, reverse=False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@register("pad", ["X"], ["Out"])
+def pad(x, *, paddings, pad_value=0.0):
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, cfg, constant_values=pad_value)
+
+
+@register("pad2d", ["X"], ["Out"])
+def pad2d(x, *, paddings, mode="constant", pad_value=0.0,
+          data_format="NCHW"):
+    if data_format == "NCHW":
+        cfg = [(0, 0), (0, 0), (paddings[0], paddings[1]),
+               (paddings[2], paddings[3])]
+    else:
+        cfg = [(0, 0), (paddings[0], paddings[1]),
+               (paddings[2], paddings[3]), (0, 0)]
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "edge": "edge"}
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=pad_value)
+    return jnp.pad(x, cfg, mode=mode_map[mode])
+
+
+@register("sequence_mask", ["X"], ["Y"], differentiable=False)
+def sequence_mask(lengths, *, maxlen, dtype="float32"):
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register("increment", ["X"], ["Out"])
+def increment(x, *, step=1.0):
+    return x + jnp.asarray(step, dtype=x.dtype)
+
+
+@register("cum_step_counter", ["X"], ["Out"], differentiable=False)
+def cum_step_counter(x):
+    """Global-step counter increment (int64-safe)."""
+    return x + 1
+
+
+@register("argsort", ["X"], ["Out", "Indices"], differentiable=False)
+def argsort(x, *, axis=-1, descending=False):
+    xs = -x if descending else x
+    idx = jnp.argsort(xs, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out, idx.astype(jnp.int32)
+
+
+@register("arg_max", ["X"], ["Out"], differentiable=False)
+def arg_max(x, *, axis=-1, keepdims=False):
+    return jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.int32)
+
+
+@register("arg_min", ["X"], ["Out"], differentiable=False)
+def arg_min(x, *, axis=-1, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.int32)
+
+
+@register("top_k", ["X"], ["Out", "Indices"], differentiable=False)
+def top_k(x, *, k):
+    vals, idx = lax.top_k(x, k)
+    return vals, idx.astype(jnp.int32)
+
+
+@register("assign_numpy_value", [], ["Out"], differentiable=False)
+def assign_numpy_value(*, _value, dtype):
+    """Materialize a host constant (NumpyArrayInitializer's op;
+    reference: assign_value_op.cc)."""
+    return jnp.asarray(_value, dtype=dtype)

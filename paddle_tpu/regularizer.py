@@ -1,0 +1,78 @@
+"""Weight-decay regularizers (reference:
+python/paddle/fluid/regularizer.py — append_regularization_ops,
+L1DecayRegularizer, L2DecayRegularizer)."""
+
+from __future__ import annotations
+
+from .framework import default_main_program
+from .layer_helper import LayerHelper
+
+
+class WeightDecayRegularizer:
+    def append_regularization_op(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, block):
+        helper = LayerHelper("l2_decay")
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="scale", inputs={"X": [param]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff, "bias": 0.0,
+                               "bias_after_scale": True,
+                               "op_role": "backward"})
+        out = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]},
+                        attrs={"op_role": "backward"})
+        return out
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def append_regularization_op(self, param, grad, block):
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="sign", inputs={"X": [param]},
+                        outputs={"Out": [sign]},
+                        attrs={"op_role": "backward"})
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="scale", inputs={"X": [sign]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff, "bias": 0.0,
+                               "bias_after_scale": True,
+                               "op_role": "backward"})
+        out = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]},
+                        attrs={"op_role": "backward"})
+        return out
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    """Reference: regularizer.py append_regularization_ops — per-param
+    regularizer wins over the optimizer-level one."""
+    block = default_main_program().global_block()
+    out = []
+    for param, grad in params_grads:
+        if grad is None:
+            out.append((param, grad))
+            continue
+        reg = param.regularizer or regularization
+        if reg is None:
+            out.append((param, grad))
+            continue
+        new_grad = reg.append_regularization_op(param, grad, block)
+        out.append((param, new_grad))
+    return out
+
+
+# fluid-style aliases
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,136 @@
+"""OpTest harness: numpy-reference outputs + finite-difference gradients.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:134 —
+check_output (:495) runs the op through the real executor and compares
+with numpy-computed expectations; check_grad (:532) compares analytic
+gradients (append_backward) against numeric finite differences
+(get_numeric_gradient :45, delta=0.005).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_op_program(op_type, np_inputs, attrs, n_outputs=1,
+                      variadic_input_slot=None, stop_gradient_slots=()):
+    """Build a one-op program with data vars bound to np_inputs.
+
+    np_inputs: {slot: ndarray} or {slot: [ndarray, ...]} for variadic.
+    Returns (program, feed, out_vars, in_vars_by_name).
+    """
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        feed = {}
+        in_map = {}
+        op_inputs = {}
+        for slot, val in np_inputs.items():
+            if isinstance(val, (list, tuple)):
+                vars_ = []
+                for i, v in enumerate(val):
+                    name = "%s_%d" % (slot.lower(), i)
+                    var = layers.data(name, shape=list(v.shape),
+                                      append_batch_size=False,
+                                      dtype=str(v.dtype))
+                    var.stop_gradient = (slot in stop_gradient_slots or
+                                         not np.issubdtype(v.dtype,
+                                                           np.floating))
+                    feed[name] = v
+                    vars_.append(var)
+                    in_map[name] = var
+                op_inputs[slot] = vars_
+            else:
+                name = slot.lower()
+                var = layers.data(name, shape=list(val.shape),
+                                  append_batch_size=False,
+                                  dtype=str(val.dtype))
+                var.stop_gradient = (slot in stop_gradient_slots or
+                                     not np.issubdtype(val.dtype,
+                                                       np.floating))
+                feed[name] = val
+                op_inputs[slot] = [var]
+                in_map[name] = var
+        block = main.global_block()
+        from paddle_tpu import ops as op_registry
+        opdef = op_registry.get(op_type)
+        out_vars = []
+        op_outputs = {}
+        for slot in opdef.output_slots:
+            variadic = slot.endswith("*")
+            sname = slot[:-1] if variadic else slot
+            n = n_outputs if variadic else 1
+            vs = [block.create_var(name="out_%s_%d" % (sname.lower(), i),
+                                   shape=(), dtype="float32")
+                  for i in range(n)]
+            op_outputs[sname] = vs
+            out_vars.extend(vs)
+        block.append_op(type=op_type, inputs=op_inputs,
+                        outputs=op_outputs, attrs=attrs or {})
+    return main, feed, out_vars, in_map
+
+
+def check_output(op_type, np_inputs, attrs, expected, atol=1e-4,
+                 rtol=1e-3, n_outputs=1):
+    """expected: list of ndarrays, positionally matching output slots
+    (None entries skipped)."""
+    main, feed, out_vars, _ = _build_op_program(op_type, np_inputs, attrs,
+                                                n_outputs)
+    exe = fluid.Executor()
+    fetch = [v for v, e in zip(out_vars, expected) if e is not None]
+    exp = [e for e in expected if e is not None]
+    results = exe.run(main, feed=feed, fetch_list=fetch)
+    for got, want in zip(results, exp):
+        np.testing.assert_allclose(np.asarray(got, np.float64),
+                                   np.asarray(want, np.float64),
+                                   atol=atol, rtol=rtol)
+
+
+def check_grad(op_type, np_inputs, attrs, inputs_to_check,
+               delta=0.005, max_relative_error=0.005,
+               output_index=0, n_outputs=1):
+    """Compare append_backward analytic grads vs finite differences of
+    sum(output[output_index]) — the reference's dual-check."""
+    main, feed, out_vars, in_map = _build_op_program(
+        op_type, np_inputs, attrs, n_outputs)
+    with fluid.program_guard(main):
+        loss = layers.reduce_sum(out_vars[output_index])
+        grads = fluid.gradients(
+            loss, [in_map[n.lower()] for n in inputs_to_check])
+    exe = fluid.Executor()
+    analytic = exe.run(main, feed=feed, fetch_list=grads)
+
+    # numeric: central differences on one compiled forward-only program
+    m2, f2, o2, _ = _build_op_program(op_type, np_inputs, attrs,
+                                      n_outputs)
+    num_exe = fluid.Executor()
+
+    def f(feed_override):
+        feed2 = dict(f2)
+        feed2.update(feed_override)
+        (val,) = num_exe.run(m2, feed=feed2,
+                             fetch_list=[o2[output_index]])
+        return float(np.sum(np.asarray(val, np.float64)))
+
+    for name, got in zip(inputs_to_check, analytic):
+        base = feed[name.lower()].astype(np.float64)
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        for i in range(flat.size):
+            pert = flat.copy()
+            pert[i] += delta
+            up = f({name.lower(): pert.reshape(base.shape)
+                    .astype(feed[name.lower()].dtype)})
+            pert[i] -= 2 * delta
+            down = f({name.lower(): pert.reshape(base.shape)
+                      .astype(feed[name.lower()].dtype)})
+            num.reshape(-1)[i] = (up - down) / (2 * delta)
+        got = np.asarray(got, np.float64)
+        denom = np.maximum(np.maximum(np.abs(num), np.abs(got)), 1e-3)
+        rel = np.abs(num - got) / denom
+        assert rel.max() <= max_relative_error, (
+            "%s grad wrt %s: max rel err %.5f > %.5f\nnumeric=%s\n"
+            "analytic=%s" % (op_type, name, rel.max(),
+                             max_relative_error, num, got))

@@ -1,0 +1,78 @@
+"""append_backward tests (reference analog:
+python/paddle/fluid/tests/unittests/test_backward.py,
+gradient_checker.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_append_backward_creates_grad_vars():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.fc(x, size=3)
+        loss = layers.mean(y)
+        pg = fluid.append_backward(loss)
+    assert len(pg) == 2
+    block = main.global_block()
+    for p, g in pg:
+        assert g.name == p.name + "@GRAD"
+        assert block.has_var(g.name)
+    types = [op.type for op in block.ops]
+    assert "vjp" in types
+    assert "fill_constant" in types  # d(loss)/d(loss)=1
+
+
+def test_gradient_values_linear():
+    """loss = mean(x @ w); dloss/dw = x^T 1/n — check numerically."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        w = layers.create_parameter(shape=(4, 3), dtype="float32",
+                                    name="w")
+        y = layers.matmul(x, w)
+        loss = layers.mean(y)
+        pg = fluid.append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (gw,) = exe.run(main, feed={"x": xv}, fetch_list=[pg[0][1]])
+    expect = np.tile(xv.sum(0)[:, None], (1, 3)) / 6.0
+    np.testing.assert_allclose(gw, expect, rtol=1e-5)
+
+
+def test_grad_accumulation_shared_input():
+    """x used by two ops: grads accumulate (reference:
+    _addup_repetitive_outputs_)."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        x.stop_gradient = False
+        a = layers.scale(x, scale=2.0)
+        b = layers.scale(x, scale=3.0)
+        s = a + b
+        loss = layers.reduce_sum(s)
+        fluid.append_backward(loss)
+    exe = fluid.Executor()
+    (gx,) = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                    fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(gx, np.full(3, 5.0), rtol=1e-6)
+
+
+def test_stop_gradient_blocks_flow():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        h1 = layers.fc(x, size=4, name="fc1")
+        h1.stop_gradient = True
+        h2 = layers.fc(h1, size=2, name="fc2")
+        loss = layers.mean(h2)
+        pg = fluid.append_backward(loss)
+    got = {p.name.split(".")[0] for p, _ in pg}
+    # only fc2's params get grads
+    assert all("fc2" in n or "fc_1" in n for n in got), got

@@ -1,0 +1,87 @@
+"""Program/Block/Variable construction tests (reference analog:
+python/paddle/fluid/tests/unittests/test_program.py, test_variable.py,
+test_operator_desc.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_program_build():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3)
+    assert x.shape == (-1, 4)
+    assert len(main.global_block().ops) >= 1
+    params = main.all_parameters()
+    assert len(params) == 2  # weight + bias
+    # startup program holds init ops for both params
+    assert len(startup.global_block().ops) == 2
+
+
+def test_variable_operators_append_ops():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[4])
+        z = x + y
+        w = z * 2.0
+    types = [op.type for op in main.global_block().ops]
+    assert "elementwise_add" in types
+    assert "elementwise_mul" in types
+
+
+def test_program_clone_for_test_flips_is_test():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4])
+        d = layers.dropout(x, dropout_prob=0.5)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops[0].attrs["is_test"] is True
+    # original untouched
+    orig = [op for op in main.global_block().ops if op.type == "dropout"]
+    assert orig[0].attrs["is_test"] is False
+
+
+def test_unique_names():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4])
+        a = layers.fc(x, size=3)
+        b = layers.fc(x, size=3)
+    names = [p.name for p in main.all_parameters()]
+    assert len(names) == len(set(names)) == 4
+
+
+def test_executor_runs_simple_program():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4])
+        y = layers.scale(x, scale=3.0, bias=1.0)
+    exe = fluid.Executor()
+    xv = np.ones((2, 4), dtype=np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 3.0 + 1.0)
+
+
+def test_startup_then_forward():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        out = layers.fc(x, size=3, act="relu")
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    for p in main.all_parameters():
+        assert scope.find_var(p.name) is not None
+    res, = exe.run(main, feed={"x": np.ones((5, 4), np.float32)},
+                   fetch_list=[out])
+    assert res.shape == (5, 3)
+    assert np.all(res >= 0)

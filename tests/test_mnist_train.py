@@ -1,0 +1,91 @@
+"""End-to-end training test: MNIST MLP + CNN learn a synthetic task
+(reference analog: tests/book/test_recognize_digits.py — train to a loss
+threshold)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.models import mnist
+
+
+def _synthetic_batch(rng, batch=64):
+    """Separable synthetic digits: class k has a bump at pixel block k."""
+    label = rng.randint(0, 10, size=(batch, 1)).astype(np.int64)
+    img = rng.rand(batch, 784).astype(np.float32) * 0.1
+    for i in range(batch):
+        k = int(label[i, 0])
+        img[i, k * 78:(k + 1) * 78] += 1.0
+    return img, label
+
+
+def test_mnist_mlp_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 42
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        _, avg_loss, acc = mnist.mlp(img, label)
+        optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses, accs = [], []
+    for step in range(40):
+        iv, lv = _synthetic_batch(rng)
+        loss_v, acc_v = exe.run(main, feed={"img": iv, "label": lv},
+                                fetch_list=[avg_loss, acc])
+        losses.append(float(loss_v))
+        accs.append(float(acc_v))
+    assert losses[-1] < 0.5 * losses[0], losses[::8]
+    assert accs[-1] > 0.9, accs[::8]
+
+
+def test_mnist_cnn_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        _, avg_loss, acc = mnist.cnn(img, label)
+        optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    first, last = None, None
+    for step in range(15):
+        iv, lv = _synthetic_batch(rng, batch=32)
+        (loss_v,) = exe.run(main, feed={"img": iv, "label": lv},
+                            fetch_list=[avg_loss])
+        if first is None:
+            first = float(loss_v)
+        last = float(loss_v)
+    assert last < first, (first, last)
+
+
+def test_inference_clone_no_update():
+    """clone(for_test=True) must not mutate params or running stats."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[16])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=8, act="relu")
+        h = layers.dropout(h, dropout_prob=0.5)
+        pred = layers.fc(h, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+    test_prog = main.clone(for_test=True)
+    with fluid.program_guard(main, startup):
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    iv = np.random.rand(4, 16).astype(np.float32)
+    lv = np.zeros((4, 1), np.int64)
+    # dropout off in test prog: two runs identical
+    r1, = exe.run(test_prog, feed={"img": iv, "label": lv},
+                  fetch_list=[pred])
+    r2, = exe.run(test_prog, feed={"img": iv, "label": lv},
+                  fetch_list=[pred])
+    np.testing.assert_allclose(r1, r2, rtol=1e-6)

@@ -1,0 +1,267 @@
+"""Per-op tests: math/elementwise/reduce (reference analog:
+test_elementwise_add_op.py, test_mul_op.py, test_matmul_op.py,
+test_reduce_op.py, test_activation_op.py ... 249 test_*op*.py files)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+
+
+class TestElementwise:
+    def test_add(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        check_output("elementwise_add", {"X": x, "Y": y}, {}, [x + y])
+
+    def test_add_broadcast_axis(self, rng):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(3,).astype(np.float32)
+        check_output("elementwise_add", {"X": x, "Y": y}, {"axis": 1},
+                     [x + y[None, :, None]])
+
+    def test_sub_grad(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        check_grad("elementwise_sub", {"X": x, "Y": y}, {}, ["X", "Y"])
+
+    def test_mul_div(self, rng):
+        x = rng.rand(3, 4).astype(np.float32) + 0.5
+        y = rng.rand(3, 4).astype(np.float32) + 0.5
+        check_output("elementwise_mul", {"X": x, "Y": y}, {}, [x * y])
+        check_output("elementwise_div", {"X": x, "Y": y}, {}, [x / y])
+        check_grad("elementwise_div", {"X": x, "Y": y}, {}, ["X", "Y"],
+                   max_relative_error=0.02)
+
+    def test_min_max(self, rng):
+        x = rng.rand(5).astype(np.float32)
+        y = rng.rand(5).astype(np.float32)
+        check_output("elementwise_min", {"X": x, "Y": y}, {},
+                     [np.minimum(x, y)])
+        check_output("elementwise_max", {"X": x, "Y": y}, {},
+                     [np.maximum(x, y)])
+
+
+class TestMatmul:
+    def test_matmul(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        check_output("matmul", {"X": x, "Y": y}, {}, [x @ y])
+
+    def test_matmul_transpose(self, rng):
+        x = rng.rand(4, 3).astype(np.float32)
+        y = rng.rand(5, 4).astype(np.float32)
+        check_output("matmul", {"X": x, "Y": y},
+                     {"transpose_x": True, "transpose_y": True},
+                     [x.T @ y.T])
+
+    def test_matmul_batched(self, rng):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(2, 4, 5).astype(np.float32)
+        check_output("matmul", {"X": x, "Y": y}, {}, [x @ y])
+
+    def test_matmul_grad(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 2).astype(np.float32)
+        check_grad("matmul", {"X": x, "Y": y}, {}, ["X", "Y"],
+                   max_relative_error=0.01)
+
+    def test_mul_flatten(self, rng):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(12, 5).astype(np.float32)
+        check_output("mul", {"X": x, "Y": y}, {"x_num_col_dims": 1},
+                     [x.reshape(2, 12) @ y])
+
+
+class TestActivations:
+    def test_relu(self, rng):
+        x = (rng.rand(4, 5).astype(np.float32) - 0.5)
+        check_output("relu", {"X": x}, {}, [np.maximum(x, 0)])
+
+    def test_sigmoid_grad(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        check_output("sigmoid", {"X": x}, {}, [1 / (1 + np.exp(-x))])
+        check_grad("sigmoid", {"X": x}, {}, ["X"],
+                   max_relative_error=0.01)
+
+    def test_tanh_exp_log(self, rng):
+        x = rng.rand(3, 4).astype(np.float32) + 0.1
+        check_output("tanh", {"X": x}, {}, [np.tanh(x)])
+        check_output("exp", {"X": x}, {}, [np.exp(x)])
+        check_output("log", {"X": x}, {}, [np.log(x)])
+
+    def test_softmax(self, rng):
+        x = rng.rand(3, 7).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        check_output("softmax", {"X": x}, {}, [e / e.sum(-1,
+                                                         keepdims=True)])
+
+    def test_softmax_grad(self, rng):
+        x = rng.rand(2, 5).astype(np.float32)
+        check_grad("softmax", {"X": x}, {}, ["X"],
+                   max_relative_error=0.02)
+
+    def test_gelu_leaky(self, rng):
+        x = (rng.rand(3, 4).astype(np.float32) - 0.5) * 2
+        check_output("leaky_relu", {"X": x}, {"alpha": 0.1},
+                     [np.where(x >= 0, x, 0.1 * x)])
+
+
+class TestReduce:
+    def test_reduce_sum(self, rng):
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        check_output("reduce_sum", {"X": x}, {"dim": [1]},
+                     [x.sum(axis=1)])
+        check_output("reduce_sum", {"X": x},
+                     {"dim": None, "reduce_all": True}, [x.sum()])
+
+    def test_reduce_mean_grad(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        check_output("reduce_mean", {"X": x}, {"dim": [0]},
+                     [x.mean(axis=0)])
+        check_grad("reduce_mean", {"X": x}, {"dim": [0]}, ["X"])
+
+    def test_reduce_max_keepdim(self, rng):
+        x = rng.rand(3, 4).astype(np.float32)
+        check_output("reduce_max", {"X": x},
+                     {"dim": [1], "keep_dim": True},
+                     [x.max(axis=1, keepdims=True)])
+
+    def test_mean(self, rng):
+        x = rng.rand(6, 2).astype(np.float32)
+        check_output("mean", {"X": x}, {}, [np.array(x.mean())])
+
+
+class TestVariadic:
+    def test_sum_op(self, rng):
+        xs = [rng.rand(3, 4).astype(np.float32) for _ in range(3)]
+        check_output("sum", {"X": xs}, {}, [xs[0] + xs[1] + xs[2]])
+
+    def test_concat(self, rng):
+        xs = [rng.rand(2, 3).astype(np.float32) for _ in range(2)]
+        check_output("concat", {"X": xs}, {"axis": 1},
+                     [np.concatenate(xs, axis=1)])
+
+    def test_concat_grad(self, rng):
+        xs = [rng.rand(2, 2).astype(np.float32) for _ in range(2)]
+        check_grad("concat", {"X": xs}, {"axis": 0}, ["x_0", "x_1"])
+
+    def test_stack_split(self, rng):
+        xs = [rng.rand(3,).astype(np.float32) for _ in range(2)]
+        check_output("stack", {"X": xs}, {"axis": 0}, [np.stack(xs)])
+
+
+class TestShapeOps:
+    def test_reshape_transpose(self, rng):
+        x = rng.rand(2, 6).astype(np.float32)
+        check_output("reshape2", {"X": x}, {"shape": (3, 4)},
+                     [x.reshape(3, 4)])
+        check_output("transpose2", {"X": x}, {"axis": (1, 0)}, [x.T])
+
+    def test_slice(self, rng):
+        x = rng.rand(4, 5).astype(np.float32)
+        check_output("slice", {"X": x},
+                     {"axes": (0, 1), "starts": (1, 0), "ends": (3, 2)},
+                     [x[1:3, 0:2]])
+
+    def test_gather(self, rng):
+        x = rng.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], dtype=np.int64)
+        check_output("gather", {"X": x, "Index": idx}, {"axis": 0},
+                     [x[idx]])
+
+    def test_one_hot(self):
+        x = np.array([1, 0, 3], dtype=np.int64)
+        expect = np.eye(4, dtype=np.float32)[x]
+        check_output("one_hot", {"X": x}, {"depth": 4}, [expect])
+
+    def test_topk(self, rng):
+        x = rng.rand(3, 6).astype(np.float32)
+        idx = np.argsort(-x, axis=1)[:, :2]
+        vals = np.take_along_axis(x, idx, axis=1)
+        check_output("top_k", {"X": x}, {"k": 2}, [vals, None])
+
+
+class TestReviewRegressions:
+    """Regressions from code-review findings."""
+
+    def test_conv2d_transpose_shape_and_values(self, rng):
+        # fluid contract: out = (H-1)*s - 2p + d*(k-1) + 1
+        x = rng.rand(1, 2, 8, 8).astype(np.float32)
+        w = rng.rand(2, 3, 5, 5).astype(np.float32)  # (in, out, kh, kw)
+        from paddle_tpu import ops as R
+        out = np.asarray(R.get("conv2d_transpose").fn(x, w))
+        assert out.shape == (1, 3, 12, 12), out.shape
+        # value check vs naive scatter-accumulate deconv
+        ref = np.zeros((1, 3, 12, 12), np.float32)
+        for ic in range(2):
+            for oc in range(3):
+                for i in range(8):
+                    for j in range(8):
+                        ref[0, oc, i:i + 5, j:j + 5] += \
+                            x[0, ic, i, j] * w[ic, oc]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_stride_pad(self, rng):
+        x = rng.rand(1, 1, 4, 4).astype(np.float32)
+        w = rng.rand(1, 1, 3, 3).astype(np.float32)
+        from paddle_tpu import ops as R
+        out = np.asarray(R.get("conv2d_transpose").fn(
+            x, w, strides=(2, 2), paddings=(1, 1)))
+        # (4-1)*2 - 2*1 + 3 = 7
+        assert out.shape == (1, 1, 7, 7), out.shape
+
+    def test_getitem_negative_and_step(self, rng):
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = layers.data("x", shape=[5, 6], append_batch_size=False)
+            last = x[-1]
+            strided = x[::2]
+            rev = x[:, ::-1]
+        exe = fluid.Executor()
+        xv = np.arange(30, dtype=np.float32).reshape(5, 6)
+        a, b, c = exe.run(main, feed={"x": xv},
+                          fetch_list=[last, strided, rev])
+        np.testing.assert_allclose(a, xv[-1])
+        np.testing.assert_allclose(b, xv[::2])
+        np.testing.assert_allclose(c, xv[:, ::-1])
+
+    def test_ones_like_out_param(self):
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = layers.data("x", shape=[3], append_batch_size=False)
+            o = layers.ones_like(x)
+        exe = fluid.Executor()
+        (ov,) = exe.run(main, feed={"x": np.zeros(3, np.float32)},
+                        fetch_list=[o])
+        np.testing.assert_allclose(ov, np.ones(3))
+
+    def test_unregistered_op_clear_error(self):
+        import paddle_tpu as fluid
+        from paddle_tpu.core.enforce import UnimplementedError
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            blk = main.global_block()
+            v = blk.create_var(name="v", shape=(2,), dtype="float32")
+            blk.append_op(type="no_such_op", inputs={},
+                          outputs={"Out": [v]})
+        exe = fluid.Executor()
+        with pytest.raises(UnimplementedError, match="no_such_op"):
+            exe.run(main, feed={}, fetch_list=["v"])
+
+    def test_msra_fan_in(self):
+        from paddle_tpu.initializer import _fan_in_out
+
+        class V:
+            shape = (512, 3, 3, 3)
+        fi, fo = _fan_in_out(V)
+        assert fi == 3 * 9 and fo == 512 * 9
+
+        class V2:
+            shape = (100, 50)
+        fi, fo = _fan_in_out(V2)
+        assert fi == 100 and fo == 50

@@ -1,0 +1,142 @@
+"""Optimizer tests — each optimizer trains a tiny quadratic and the op
+math matches a numpy reference (reference analog: test_optimizer.py,
+test_sgd_op.py, test_adam_op.py ...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _build(opt):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        w = layers.create_parameter(shape=(4,), dtype="float32", name="w")
+        diff = x - w
+        loss = layers.reduce_sum(diff * diff)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.parametrize("opt_fn", [
+    lambda: optimizer.SGD(learning_rate=0.1),
+    lambda: optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+    lambda: optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                               use_nesterov=True),
+    lambda: optimizer.Adagrad(learning_rate=0.5),
+    lambda: optimizer.Adam(learning_rate=0.1),
+    lambda: optimizer.AdamW(learning_rate=0.1, weight_decay=0.001),
+    lambda: optimizer.Adamax(learning_rate=0.1),
+    lambda: optimizer.Adadelta(learning_rate=1.0, rho=0.9),
+    lambda: optimizer.RMSProp(learning_rate=0.05),
+    lambda: optimizer.DecayedAdagrad(learning_rate=0.5),
+    lambda: optimizer.Ftrl(learning_rate=0.5),
+    lambda: optimizer.Lamb(learning_rate=0.1),
+    lambda: optimizer.LarsMomentum(learning_rate=200.0, momentum=0.9),
+])
+def test_optimizer_converges(opt_fn):
+    main, startup, loss = _build(opt_fn())
+    exe = fluid.Executor()
+    exe.run(startup)
+    target = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    losses = []
+    for _ in range(200):
+        (lv,) = exe.run(main, feed={"x": target}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses[::20]
+
+
+def test_sgd_math():
+    """One sgd step equals p - lr*g exactly."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        w = layers.create_parameter(
+            shape=(3,), dtype="float32", name="w",
+            default_initializer=fluid.initializer.Constant(2.0))
+        loss = layers.reduce_sum(x * w)
+        optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    w_new = np.asarray(fluid.global_scope().find_var("w"))
+    np.testing.assert_allclose(w_new, 2.0 - 0.5 * xv, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        w = layers.create_parameter(
+            shape=(3,), dtype="float32", name="w",
+            default_initializer=fluid.initializer.Constant(1.0))
+        loss = layers.reduce_sum(x * w)
+        optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.99,
+                       epsilon=1e-8).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.array([0.5, -1.0, 2.0], np.float32)
+
+    # numpy reference
+    p = np.ones(3); m1 = np.zeros(3); m2 = np.zeros(3)
+    b1p, b2p = 0.9, 0.99
+    for _ in range(3):
+        g = xv
+        m1 = 0.9 * m1 + 0.1 * g
+        m2 = 0.99 * m2 + 0.01 * g * g
+        lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+        p = p - lr_t * m1 / (np.sqrt(m2) + 1e-8)
+        b1p *= 0.9
+        b2p *= 0.99
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    w_new = np.asarray(fluid.global_scope().find_var("w"))
+    np.testing.assert_allclose(w_new, p, rtol=1e-5)
+
+
+def test_regularizer_l2():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        w = layers.create_parameter(
+            shape=(3,), dtype="float32", name="w",
+            default_initializer=fluid.initializer.Constant(2.0))
+        loss = layers.reduce_sum(x * w)
+        opt = optimizer.SGD(
+            learning_rate=0.5,
+            regularization=fluid.regularizer.L2Decay(0.1))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.array([1.0, 1.0, 1.0], np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    w_new = np.asarray(fluid.global_scope().find_var("w"))
+    # grad = x + 0.1*w = 1.2; w_new = 2 - 0.5*1.2
+    np.testing.assert_allclose(w_new, np.full(3, 1.4), rtol=1e-6)
+
+
+def test_grad_clip_by_global_norm():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        w = layers.create_parameter(
+            shape=(4,), dtype="float32", name="w",
+            default_initializer=fluid.initializer.Constant(1.0))
+        loss = layers.reduce_sum(x * w)
+        opt = optimizer.SGD(learning_rate=1.0)
+        opt.minimize(loss,
+                     grad_clip=fluid.clip.GradientClipByGlobalNorm(1.0))
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.array([3.0, 4.0, 0.0, 0.0], np.float32)  # norm 5
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    w_new = np.asarray(fluid.global_scope().find_var("w"))
+    np.testing.assert_allclose(w_new, 1.0 - xv / 5.0, rtol=1e-5)
