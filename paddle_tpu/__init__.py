@@ -31,7 +31,10 @@ from .backward import append_backward, gradients  # noqa: F401
 from .core import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
                    TPUPlace, global_scope)
 from .core.scope import Scope  # noqa: F401
+from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                       ExecutionStrategy)
 from .executor import Executor, scope_guard  # noqa: F401
+from . import parallel  # noqa: F401
 from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         default_main_program, default_startup_program,
                         name_scope, program_guard)

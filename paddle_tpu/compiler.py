@@ -1,0 +1,154 @@
+"""CompiledProgram: attach distribution strategy to a Program.
+
+Reference: python/paddle/fluid/compiler.py:49 (CompiledProgram,
+with_data_parallel:117) which constructs a core.ParallelExecutor
+(parallel_executor.cc:305) — per-device scopes, NCCL ctxs, param
+broadcast, SSA-graph build with inserted AllReduce op handles
+(multi_devices_graph_pass.cc).
+
+TPU-native redesign: ALL of that machinery (≈35k LoC of graph passes +
+op handles + NCCL helpers in the reference) collapses into sharding
+annotations over a named mesh. ``with_data_parallel`` picks a mesh and
+per-variable PartitionSpecs; the executor jits the step with those
+shardings and the XLA GSPMD partitioner inserts all-reduce /
+all-gather / reduce-scatter collectives over ICI.
+
+BuildStrategy parity:
+  - reduce_strategy=AllReduce (build_strategy.h:57): params replicated,
+    gradient psum — classic DP.
+  - reduce_strategy=Reduce: parameters + optimizer state sharded over
+    the dp axis (the reference shards param *updates* across devices
+    then broadcasts — the ZeRO precursor); here XLA emits
+    reduce-scatter(grad) + all-gather(param) automatically.
+  - fusion/memory toggles (:77-101) are accepted no-ops: XLA fuses and
+    plans memory itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .core.enforce import InvalidArgumentError, enforce
+from .framework import Program, Variable
+from .parallel import mesh as mesh_lib
+
+
+class BuildStrategy:
+    """Reference: framework/details/build_strategy.h:36."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        # Accepted for parity; the XLA compiler performs these.
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.fuse_broadcast_ops = False
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.enable_sequential_execution = False
+        self.cache_runtime_context = True
+        self.remove_unnecessary_lock = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """Reference: framework/details/execution_strategy.h. Thread-pool
+    knobs have no meaning for a single fused XLA program; kept for API
+    parity."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.allow_op_delay = False
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    """Reference: compiler.py:49."""
+
+    _is_compiled = True
+
+    def __init__(self, program, build_strategy=None):
+        enforce(isinstance(program, Program),
+                "CompiledProgram wraps a Program")
+        self.program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._mesh = None
+        self._loss_name = None
+        self._share_vars_from = None
+
+    # -- strategies --------------------------------------------------------
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None, mesh=None, axes=None):
+        """Distribute over a device mesh. Default: pure DP over all
+        visible devices. ``axes`` may request a multi-axis mesh, e.g.
+        {"dp": 4, "tp": 2} — vars carrying .sharding PartitionSpecs
+        (see parallel.api) then shard over those axes too."""
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+        self._share_vars_from = share_vars_from
+        if mesh is not None:
+            self._mesh = mesh
+        elif axes:
+            self._mesh = mesh_lib.make_mesh(axes)
+        else:
+            ndev = len(places) if places else jax.device_count()
+            self._mesh = mesh_lib.data_parallel_mesh(ndev)
+        return self
+
+    def with_inference_optimize(self, config=None):
+        # Inference graph rewrites are XLA's job; parity no-op.
+        return self
+
+    # -- sharding assignment -----------------------------------------------
+    def _var_spec(self, var: Variable) -> PartitionSpec:
+        """PartitionSpec for a persistable var under the strategy."""
+        if var.sharding is not None:
+            return var.sharding
+        if self._build_strategy.reduce_strategy == \
+                BuildStrategy.ReduceStrategy.Reduce and var.persistable:
+            # ZeRO-style: shard over dp on the first divisible dim.
+            dp = self._mesh.shape.get("dp", 1)
+            if dp > 1:
+                dim = mesh_lib.first_divisible_dim(var.shape, dp)
+                if dim is not None:
+                    spec = [None] * len(var.shape)
+                    spec[dim] = "dp"
+                    return PartitionSpec(*spec)
+        return PartitionSpec()
+
+    def persist_sharding(self, var: Variable) -> NamedSharding:
+        return NamedSharding(self._mesh, self._var_spec(var))
+
+    def feed_sharding(self, ndim: int) -> NamedSharding:
+        if "dp" in self._mesh.shape and ndim > 0:
+            return NamedSharding(self._mesh,
+                                 mesh_lib.shard_batch_spec(ndim))
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    # -- execution ---------------------------------------------------------
+    def run(self, exe, feed, fetch_list, scope, return_numpy):
+        from .core.scope import global_scope
+        return exe._run_impl(self.program, feed or {}, fetch_list or [],
+                             scope or global_scope(), return_numpy,
+                             dist=self)

@@ -230,13 +230,12 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         program = program or framework.default_main_program()
-        compiled = getattr(program, "_compiled_delegate", None)
-        if compiled is not None:
-            return compiled.run(self, feed, fetch_list, scope,
-                                return_numpy)
+        if getattr(program, "_is_compiled", False):
+            # CompiledProgram (compiler.py) — distributed execution.
+            return program.run(self, feed, fetch_list, scope,
+                               return_numpy)
         return self._run_impl(program, feed or {}, fetch_list or [],
                               scope or global_scope(), return_numpy,
-                              shardings=None,
                               use_program_cache=use_program_cache)
 
     def close(self):
@@ -251,7 +250,7 @@ class Executor:
         return jax.random.key(seed)
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
-                  shardings=None, donate=True, library=None,
+                  dist=None, donate=True, library=None,
                   use_program_cache=True):
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
@@ -264,10 +263,20 @@ class Executor:
                     and scope.find_var(name) is not None:
                 persist_in[name] = scope.find_var(name)
 
+        if dist is not None:
+            # Lay persistable vars out on the mesh per the strategy
+            # (the analog of ParallelExecutor's BCastParamsToDevices,
+            # parallel_executor.cc:522 — but a sharded device_put, once;
+            # re-placement is a no-op if already correctly sharded).
+            for name, val in persist_in.items():
+                want = dist.persist_sharding(block.vars[name])
+                if getattr(val, "sharding", None) != want:
+                    persist_in[name] = jax.device_put(val, want)
+
         feed_names = tuple(sorted(feed))
         cache_key = (id(program), program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
-                     library)
+                     library, id(dist) if dist is not None else None)
         fn = self._cache.get(cache_key) if use_program_cache else None
         if fn is None:
             persistable_names = frozenset(
@@ -291,8 +300,14 @@ class Executor:
             jit_kwargs = {}
             if donate:
                 jit_kwargs["donate_argnums"] = (0,)
-            if shardings is not None:
-                jit_kwargs.update(shardings)
+            if dist is not None:
+                # Pin persistable outputs to their input shardings so
+                # parameters keep a stable layout across steps (donation
+                # then reuses the buffers in place).
+                persist_sharding = {
+                    n: dist.persist_sharding(block.vars[n])
+                    for n in persist_in}
+                jit_kwargs["out_shardings"] = (None, persist_sharding)
             fn = jax.jit(step, **jit_kwargs)
             self._cache[cache_key] = fn
 
@@ -300,8 +315,15 @@ class Executor:
                                       self._run_counter)
         self._run_counter += 1
 
-        feed_vals = {k: jnp.asarray(v) if not isinstance(v, jax.Array)
-                     else v for k, v in feed.items()}
+        if dist is not None:
+            feed_vals = {
+                k: jax.device_put(
+                    v, dist.feed_sharding(np.asarray(v).ndim))
+                for k, v in feed.items()}
+        else:
+            feed_vals = {k: jnp.asarray(v)
+                         if not isinstance(v, jax.Array) else v
+                         for k, v in feed.items()}
         fetches, persist_out = fn(persist_in, feed_vals, step_key)
 
         for name, val in persist_out.items():

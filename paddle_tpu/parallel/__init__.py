@@ -1,0 +1,20 @@
+"""Distributed execution over device meshes.
+
+Replaces the reference's three distributed stacks (SURVEY §2.4):
+  - in-graph collectives / ParallelExecutor  -> sharding annotations +
+    GSPMD-inserted collectives over ICI (compiler.py + mesh.py)
+  - DistributeTranspiler + gRPC parameter server -> ZeRO-style sharded
+    params/optimizer state (BuildStrategy.ReduceStrategy.Reduce); a
+    host-side table service is only needed for >HBM embeddings
+  - fleet/PSLib sparse PS -> sharded embedding tables + all-to-all
+    (parallel.sparse)
+
+Multi-host: jax.distributed.initialize + the same mesh spanning all
+processes (the analog of NCCL2-mode trainer ranks, gen_nccl_id_op.cc).
+"""
+
+from . import mesh  # noqa: F401
+from .mesh import (current_mesh, data_parallel_mesh, make_mesh,  # noqa
+                   mesh_guard, named_sharding, set_mesh,
+                   shard_batch_spec)
+from .api import shard, replicate  # noqa: F401

@@ -1,0 +1,32 @@
+"""User-facing sharding annotations.
+
+The declarative replacement for the reference's multi-device graph
+passes: instead of rewriting the op graph per device
+(multi_devices_graph_pass.cc), users (or model libraries) annotate
+variables with PartitionSpecs and the GSPMD partitioner does the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from jax.sharding import PartitionSpec
+
+from ..framework import Variable
+
+
+def shard(var: Variable, *axes: Union[str, None, Sequence[str]]
+          ) -> Variable:
+    """Annotate a variable with a PartitionSpec, one entry per dim.
+
+    Example (Megatron-style 2-way tensor parallel fc):
+        w1 = shard(w1, None, "tp")   # column-parallel
+        w2 = shard(w2, "tp", None)   # row-parallel
+    """
+    var.sharding = PartitionSpec(*axes)
+    return var
+
+
+def replicate(var: Variable) -> Variable:
+    var.sharding = PartitionSpec()
+    return var

@@ -1,0 +1,95 @@
+"""Device mesh management.
+
+Reference: the reference manages device groups through NCCL communicator
+maps — platform/nccl_helper.h:90 ``NCCLContextMap`` (one comm per
+device) and :179 ``MultiNCCLContextMap`` (flat + hierarchical inter/
+intra-node comm sets), bootstrapped by gen_nccl_id_op.cc.
+
+TPU-native redesign: a named ``jax.sharding.Mesh`` replaces communicator
+maps entirely. Axis names declare *roles* (dp/tp/pp/sp/ep); collectives
+are inserted by the XLA GSPMD partitioner from sharding annotations and
+ride ICI within a slice and DCN across slices. The hierarchical-allreduce
+configuration of the reference corresponds to a 2-D ("dcn", "ici") mesh
+layout where jax places the slower axis over DCN automatically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.enforce import InvalidArgumentError, enforce
+
+# Canonical axis names, in nesting order (outermost first). dp=data,
+# pp=pipeline, tp=tensor/model, sp=sequence/context, ep=expert.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None
+              ) -> Mesh:
+    """Create a Mesh with named axes, e.g. make_mesh({"dp": 4, "tp": 2}).
+
+    Axis sizes must multiply to the device count. ``tp`` (and ``sp``)
+    are placed innermost so they map to the fastest ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = [a for a in AXIS_ORDER if a in axes]
+    extra = [a for a in axes if a not in AXIS_ORDER]
+    names += extra
+    sizes = [axes[a] for a in names]
+    total = int(np.prod(sizes)) if sizes else 1
+    enforce(total == len(devices),
+            "mesh axes %s multiply to %d but %d devices are available",
+            axes, total, len(devices))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
+    n = num_devices or jax.device_count()
+    return make_mesh({"dp": n}, jax.devices()[:n])
+
+
+_current_mesh: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    prev = current_mesh()
+    set_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_mesh(prev)
+
+
+def named_sharding(mesh: Mesh, spec: Optional[PartitionSpec]
+                   ) -> NamedSharding:
+    return NamedSharding(mesh, spec if spec is not None
+                         else PartitionSpec())
+
+
+def shard_batch_spec(ndim: int, axis_name: str = "dp") -> PartitionSpec:
+    """Shard dim 0 (batch) over the data axis, replicate the rest."""
+    return PartitionSpec(axis_name, *([None] * (ndim - 1)))
+
+
+def first_divisible_dim(shape: Tuple[int, ...], parts: int
+                        ) -> Optional[int]:
+    for i, d in enumerate(shape):
+        if d is not None and d > 0 and d % parts == 0:
+            return i
+    return None
