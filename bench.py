@@ -1,0 +1,62 @@
+"""Benchmark harness — the analog of benchmark/fluid/fluid_benchmark.py
+(print_train_time :296-301 reports examples/sec).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs on whatever backend JAX sees (the driver provides the real chip).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_mnist_mlp(batch=512, warmup=5, iters=30):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[784], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        hidden = img
+        for h in (256, 256):
+            hidden = layers.fc(hidden, size=h, act="relu")
+        pred = layers.fc(hidden, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    feed = {
+        "img": rs.rand(batch, 784).astype(np.float32),
+        "label": rs.randint(0, 10, size=(batch, 1)).astype(np.int64),
+    }
+    for _ in range(warmup):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main, feed=feed, fetch_list=[loss])
+    np.asarray(out[0])
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main():
+    examples_per_sec = bench_mnist_mlp()
+    print(json.dumps({
+        "metric": "mnist_mlp_train_throughput",
+        "value": round(float(examples_per_sec), 1),
+        "unit": "examples/sec",
+        # reference publishes no in-tree numbers (BASELINE.json
+        # "published": {}); 1.0 = parity placeholder
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

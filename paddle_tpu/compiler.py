@@ -111,9 +111,17 @@ class CompiledProgram:
             self._mesh = mesh
         elif axes:
             self._mesh = mesh_lib.make_mesh(axes)
+        elif mesh_lib.current_mesh() is not None:
+            self._mesh = mesh_lib.current_mesh()
+        elif places:
+            # Respect WHICH devices the caller picked (a Place carries a
+            # device_id), not just how many.
+            devs = jax.devices()
+            picked = [devs[getattr(p, "device_id", i)]
+                      for i, p in enumerate(places)]
+            self._mesh = mesh_lib.make_mesh({"dp": len(picked)}, picked)
         else:
-            ndev = len(places) if places else jax.device_count()
-            self._mesh = mesh_lib.data_parallel_mesh(ndev)
+            self._mesh = mesh_lib.data_parallel_mesh(jax.device_count())
         return self
 
     def with_inference_optimize(self, config=None):
@@ -140,15 +148,34 @@ class CompiledProgram:
     def persist_sharding(self, var: Variable) -> NamedSharding:
         return NamedSharding(self._mesh, self._var_spec(var))
 
-    def feed_sharding(self, ndim: int) -> NamedSharding:
-        if "dp" in self._mesh.shape and ndim > 0:
+    def feed_sharding(self, shape) -> NamedSharding:
+        """Batch-shard a feed over dp when its leading dim divides
+        evenly; otherwise replicate (partial final batches, scalar
+        feeds like learning rates)."""
+        dp = self._mesh.shape.get("dp", 1)
+        if dp > 1 and len(shape) > 0 and shape[0] % dp == 0:
             return NamedSharding(self._mesh,
-                                 mesh_lib.shard_batch_spec(ndim))
+                                 mesh_lib.shard_batch_spec(len(shape)))
         return NamedSharding(self._mesh, PartitionSpec())
 
+    def _fingerprint(self):
+        """Stable identity for the executor's jit cache (NOT id(): a
+        GC'd CompiledProgram's address can be reused, and strategies
+        mutate in place)."""
+        mesh = self._mesh
+        var_specs = tuple(sorted(
+            (n, str(v.sharding)) for n, v in
+            self.program.global_block().vars.items()
+            if v.sharding is not None))
+        return (tuple(d.id for d in mesh.devices.flat),
+                mesh.axis_names, tuple(mesh.shape.values()),
+                self._build_strategy.reduce_strategy, var_specs)
+
     # -- execution ---------------------------------------------------------
-    def run(self, exe, feed, fetch_list, scope, return_numpy):
+    def run(self, exe, feed, fetch_list, scope, return_numpy,
+            use_program_cache=True):
         from .core.scope import global_scope
         return exe._run_impl(self.program, feed or {}, fetch_list or [],
                              scope or global_scope(), return_numpy,
-                             dist=self)
+                             dist=self,
+                             use_program_cache=use_program_cache)

@@ -233,7 +233,8 @@ class Executor:
         if getattr(program, "_is_compiled", False):
             # CompiledProgram (compiler.py) — distributed execution.
             return program.run(self, feed, fetch_list, scope,
-                               return_numpy)
+                               return_numpy,
+                               use_program_cache=use_program_cache)
         return self._run_impl(program, feed or {}, fetch_list or [],
                               scope or global_scope(), return_numpy,
                               use_program_cache=use_program_cache)
@@ -276,7 +277,8 @@ class Executor:
         feed_names = tuple(sorted(feed))
         cache_key = (id(program), program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
-                     library, id(dist) if dist is not None else None)
+                     library,
+                     dist._fingerprint() if dist is not None else None)
         fn = self._cache.get(cache_key) if use_program_cache else None
         if fn is None:
             persistable_names = frozenset(
@@ -317,8 +319,7 @@ class Executor:
 
         if dist is not None:
             feed_vals = {
-                k: jax.device_put(
-                    v, dist.feed_sharding(np.asarray(v).ndim))
+                k: jax.device_put(v, dist.feed_sharding(np.shape(v)))
                 for k, v in feed.items()}
         else:
             feed_vals = {k: jnp.asarray(v)

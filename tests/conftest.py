@@ -21,6 +21,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
+# The image's sitecustomize registers the tunneled TPU plugin *before*
+# this file runs, so the env var alone is too late — force the platform
+# through the live config as well.
+jax.config.update("jax_platforms", "cpu")
+
 # Exact f32 matmuls for numeric checks (the TPU bench path keeps the
 # default MXU precision).
 jax.config.update("jax_default_matmul_precision", "highest")
