@@ -111,15 +111,16 @@ class CompiledProgram:
             self._mesh = mesh
         elif axes:
             self._mesh = mesh_lib.make_mesh(axes)
-        elif mesh_lib.current_mesh() is not None:
-            self._mesh = mesh_lib.current_mesh()
         elif places:
             # Respect WHICH devices the caller picked (a Place carries a
-            # device_id), not just how many.
+            # device_id), not just how many. Explicit places outrank the
+            # ambient mesh_guard.
             devs = jax.devices()
             picked = [devs[getattr(p, "device_id", i)]
                       for i, p in enumerate(places)]
             self._mesh = mesh_lib.make_mesh({"dp": len(picked)}, picked)
+        elif mesh_lib.current_mesh() is not None:
+            self._mesh = mesh_lib.current_mesh()
         else:
             self._mesh = mesh_lib.data_parallel_mesh(jax.device_count())
         return self
@@ -163,10 +164,12 @@ class CompiledProgram:
         GC'd CompiledProgram's address can be reused, and strategies
         mutate in place)."""
         mesh = self._mesh
+        # Only persistable vars can reach persist_sharding, so the scan
+        # stays O(#params), not O(#vars), on the per-step hot path.
         var_specs = tuple(sorted(
             (n, str(v.sharding)) for n, v in
             self.program.global_block().vars.items()
-            if v.sharding is not None))
+            if v.persistable and v.sharding is not None))
         return (tuple(d.id for d in mesh.devices.flat),
                 mesh.axis_names, tuple(mesh.shape.values()),
                 self._build_strategy.reduce_strategy, var_specs)

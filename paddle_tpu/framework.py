@@ -495,7 +495,13 @@ class Program:
         p = copy.deepcopy(self)
         p._is_test = for_test
         if for_test:
+            # Strip backward + optimizer ops (the reference prunes ops
+            # with OpRole Backward/Optimize, framework.py clone:2770) —
+            # otherwise "evaluation" runs would update parameters.
             for b in p.blocks:
+                b.ops = [op for op in b.ops
+                         if op.attrs.get("op_role") not in
+                         ("backward", "optimize")]
                 for op in b.ops:
                     if "is_test" in _TEST_MODE_OPS.get(op.type, ()):
                         op.attrs["is_test"] = True

@@ -1,0 +1,246 @@
+"""Transformer-base NMT (BASELINE.json config 3).
+
+Reference: the fluid transformer used by its distributed tests
+(python/paddle/fluid/tests/unittests/dist_transformer.py) and the
+machine-translation benchmark (benchmark/fluid/models/machine_translation
+.py) — built here from this framework's layer primitives, TPU-first:
+
+  - static [batch, seq_len] shapes (pad + mask, no LoD) so XLA tiles the
+    QK^T / PV matmuls onto the MXU;
+  - attention mask folded in as an additive bias (one fused add, no
+    boolean select chains);
+  - the whole train step (12 blocks fwd + bwd + Adam) compiles to ONE
+    XLA program via the Executor;
+  - weights annotated for Megatron-style tp sharding on request
+    (shard_tp) — GSPMD inserts the ICI collectives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..initializer import NumpyArrayInitializer
+from ..param_attr import ParamAttr
+
+
+class TransformerConfig:
+    """transformer-base hyperparameters."""
+
+    def __init__(self, src_vocab=30000, tgt_vocab=30000, max_len=256,
+                 d_model=512, d_ffn=2048, n_head=8, n_layer=6,
+                 dropout=0.1, label_smooth_eps=0.1,
+                 weight_sharing=False):
+        self.src_vocab = src_vocab
+        self.tgt_vocab = tgt_vocab
+        self.max_len = max_len
+        self.d_model = d_model
+        self.d_ffn = d_ffn
+        self.n_head = n_head
+        self.n_layer = n_layer
+        self.dropout = dropout
+        self.label_smooth_eps = label_smooth_eps
+        self.weight_sharing = weight_sharing
+
+
+def _pos_encoding_table(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    dim = np.arange(d_model // 2)[None, :].astype(np.float64)
+    angle = pos / np.power(10000.0, 2.0 * dim / d_model)
+    table = np.zeros((max_len, d_model), dtype=np.float32)
+    table[:, 0::2] = np.sin(angle)
+    table[:, 1::2] = np.cos(angle)
+    return table
+
+
+def _multi_head_attention(q_in, kv_in, bias, cfg, is_test, prefix):
+    """Scaled dot-product attention over n_head heads.
+
+    bias: additive attention bias [batch, 1, q_len, k_len] (0 where
+    attending, -1e9 at masked positions).
+    """
+    d = cfg.d_model
+    h = cfg.n_head
+    dh = d // h
+
+    q = layers.fc(q_in, d, num_flatten_dims=2, bias_attr=False,
+                  name=prefix + "_q")
+    k = layers.fc(kv_in, d, num_flatten_dims=2, bias_attr=False,
+                  name=prefix + "_k")
+    v = layers.fc(kv_in, d, num_flatten_dims=2, bias_attr=False,
+                  name=prefix + "_v")
+
+    def split_heads(x, slen):
+        x = layers.reshape(x, (-1, slen, h, dh))
+        return layers.transpose(x, (0, 2, 1, 3))  # [b, h, s, dh]
+
+    q_len = q_in.shape[1]
+    k_len = kv_in.shape[1]
+    q = split_heads(q, q_len)
+    k = split_heads(k, k_len)
+    v = split_heads(v, k_len)
+
+    scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+    scores = layers.elementwise_add(scores, bias)
+    weights = layers.softmax(scores)
+    if cfg.dropout and not is_test:
+        weights = layers.dropout(weights, cfg.dropout,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)  # [b, h, q, dh]
+    ctx = layers.transpose(ctx, (0, 2, 1, 3))
+    ctx = layers.reshape(ctx, (-1, q_len, d))
+    return layers.fc(ctx, d, num_flatten_dims=2, bias_attr=False,
+                     name=prefix + "_out")
+
+
+def _ffn(x, cfg, prefix):
+    hidden = layers.fc(x, cfg.d_ffn, num_flatten_dims=2, act="relu",
+                       name=prefix + "_fc1")
+    return layers.fc(hidden, cfg.d_model, num_flatten_dims=2,
+                     name=prefix + "_fc2")
+
+
+def _post_process(x, residual, cfg, is_test, prefix):
+    """residual + dropout, then layer_norm (fluid's "da n" cmd chain)."""
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, cfg.dropout,
+                           dropout_implementation="upscale_in_train")
+    out = layers.elementwise_add(x, residual)
+    return layers.layer_norm(out, begin_norm_axis=2,
+                             name=prefix + "_ln")
+
+
+def _embed(ids, vocab, cfg, is_test, name):
+    emb = layers.embedding(
+        ids, size=(vocab, cfg.d_model),
+        param_attr=ParamAttr(name=name + "_word_emb"))
+    emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
+    pos_tab = _pos_encoding_table(cfg.max_len, cfg.d_model)
+    seq_len = ids.shape[1]
+    pos = layers.assign(pos_tab[:seq_len])
+    out = layers.elementwise_add(emb, pos)
+    if cfg.dropout and not is_test:
+        out = layers.dropout(out, cfg.dropout,
+                             dropout_implementation="upscale_in_train")
+    return out
+
+
+def _pad_bias(pad_mask):
+    """[b, s] float 1=token 0=pad -> additive bias [b, 1, 1, s]."""
+    bias = layers.scale(pad_mask, scale=1e9, bias=-1.0,
+                        bias_after_scale=False)  # (m - 1) * 1e9
+    return layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])
+
+
+def _causal_bias(pad_bias_, seq_len):
+    """Combine key-pad bias with a lower-triangular causal bias."""
+    causal = np.triu(np.full((seq_len, seq_len), -1e9, np.float32), 1)
+    causal_v = layers.assign(causal.reshape(1, 1, seq_len, seq_len))
+    return layers.elementwise_add(pad_bias_, causal_v)
+
+
+def encoder(src_ids, src_mask, cfg, is_test=False):
+    x = _embed(src_ids, cfg.src_vocab, cfg, is_test, "src")
+    bias = _pad_bias(src_mask)
+    for i in range(cfg.n_layer):
+        p = "enc%d" % i
+        att = _multi_head_attention(x, x, bias, cfg, is_test,
+                                    p + "_att")
+        x = _post_process(att, x, cfg, is_test, p + "_att")
+        ff = _ffn(x, cfg, p + "_ffn")
+        x = _post_process(ff, x, cfg, is_test, p + "_ffn")
+    return x
+
+
+def decoder(tgt_ids, enc_out, src_mask, tgt_mask, cfg, is_test=False):
+    x = _embed(tgt_ids, cfg.tgt_vocab, cfg, is_test,
+               "src" if cfg.weight_sharing else "tgt")
+    self_bias = _causal_bias(_pad_bias(tgt_mask), tgt_ids.shape[1])
+    cross_bias = _pad_bias(src_mask)
+    for i in range(cfg.n_layer):
+        p = "dec%d" % i
+        att = _multi_head_attention(x, x, self_bias, cfg, is_test,
+                                    p + "_self")
+        x = _post_process(att, x, cfg, is_test, p + "_self")
+        catt = _multi_head_attention(x, enc_out, cross_bias, cfg,
+                                     is_test, p + "_cross")
+        x = _post_process(catt, x, cfg, is_test, p + "_cross")
+        ff = _ffn(x, cfg, p + "_ffn")
+        x = _post_process(ff, x, cfg, is_test, p + "_ffn")
+    return x
+
+
+def transformer(cfg: TransformerConfig, is_test=False):
+    """Build the full training graph. Declares feeds:
+      src_ids/tgt_ids/lbl_ids [b, s] int64; src_mask/tgt_mask [b, s]
+      float32 (1=token, 0=pad).
+    Returns (avg_cost, token_num, predict_logits).
+    """
+    s = cfg.max_len
+    src_ids = layers.data("src_ids", shape=[s], dtype="int64")
+    tgt_ids = layers.data("tgt_ids", shape=[s], dtype="int64")
+    lbl_ids = layers.data("lbl_ids", shape=[s], dtype="int64")
+    src_mask = layers.data("src_mask", shape=[s], dtype="float32")
+    tgt_mask = layers.data("tgt_mask", shape=[s], dtype="float32")
+
+    enc_out = encoder(src_ids, src_mask, cfg, is_test)
+    dec_out = decoder(tgt_ids, enc_out, src_mask, tgt_mask, cfg,
+                      is_test)
+
+    logits = layers.fc(dec_out, cfg.tgt_vocab, num_flatten_dims=2,
+                       bias_attr=False, name="proj")
+
+    if cfg.label_smooth_eps:
+        oh = layers.one_hot(layers.unsqueeze(lbl_ids, [2]),
+                            cfg.tgt_vocab)
+        soft = layers.label_smooth(oh, epsilon=cfg.label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(
+            logits, soft, soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lbl_ids, [2]))
+    cost = layers.squeeze(cost, [2])            # [b, s]
+    weighted = layers.elementwise_mul(cost, tgt_mask)
+    sum_cost = layers.reduce_sum(weighted)
+    token_num = layers.reduce_sum(tgt_mask)
+    avg_cost = layers.elementwise_div(sum_cost, token_num)
+    return avg_cost, token_num, logits
+
+
+def shard_tp(program, axis="tp"):
+    """Annotate attention/ffn weights Megatron-style over the tp axis:
+    q/k/v and ffn fc1 column-parallel, output proj and ffn fc2
+    row-parallel; embeddings vocab-sharded. GSPMD then inserts the
+    all-reduces the reference would have hand-placed."""
+    from ..parallel import shard
+    for p in program.all_parameters():
+        if len(p.shape) != 2:
+            continue
+        n = p.name
+        if any(t in n for t in ("_q.", "_k.", "_v.", "_fc1.")):
+            shard(p, None, axis)
+        elif any(t in n for t in ("_out.", "_fc2.")):
+            shard(p, axis, None)
+        elif "word_emb" in n or n.startswith("proj"):
+            shard(p, axis, None)
+    return program
+
+
+def make_fake_batch(cfg, batch, seq_len=None, seed=0):
+    """Synthetic padded batch for tests/benchmarks."""
+    s = seq_len or cfg.max_len
+    rs = np.random.RandomState(seed)
+    lens = rs.randint(max(2, s // 2), s + 1, size=batch)
+    src = np.zeros((batch, s), np.int64)
+    tgt = np.zeros((batch, s), np.int64)
+    lbl = np.zeros((batch, s), np.int64)
+    smask = np.zeros((batch, s), np.float32)
+    tmask = np.zeros((batch, s), np.float32)
+    for i, L in enumerate(lens):
+        src[i, :L] = rs.randint(1, cfg.src_vocab, size=L)
+        tgt[i, :L] = rs.randint(1, cfg.tgt_vocab, size=L)
+        lbl[i, :L] = rs.randint(1, cfg.tgt_vocab, size=L)
+        smask[i, :L] = 1.0
+        tmask[i, :L] = 1.0
+    return {"src_ids": src, "tgt_ids": tgt, "lbl_ids": lbl,
+            "src_mask": smask, "tgt_mask": tmask}

@@ -512,3 +512,13 @@ def grid_sampler(x, grid):
     out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
            + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
     return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register("label_smooth", ["X", "PriorDist"], ["Out"])
+def label_smooth(x, prior_dist=None, *, epsilon=0.1):
+    """Reference: operators/label_smooth_op.cc — uniform (or prior)
+    smoothing of one-hot targets."""
+    k = x.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * x + epsilon * prior_dist
+    return (1.0 - epsilon) * x + epsilon / k
