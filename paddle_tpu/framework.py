@@ -505,6 +505,17 @@ class Program:
                 for op in b.ops:
                     if "is_test" in _TEST_MODE_OPS.get(op.type, ()):
                         op.attrs["is_test"] = True
+                # Prune vars no surviving op references (optimizer
+                # state, grads) — otherwise every eval step would
+                # shuttle dead Adam moments through the jitted program.
+                live = set()
+                for op in b.ops:
+                    for ns in op.inputs.values():
+                        live.update(ns)
+                    for ns in op.outputs.values():
+                        live.update(ns)
+                b.vars = {n: v for n, v in b.vars.items()
+                          if n in live or v.is_data}
         p._bump()
         return p
 

@@ -4,3 +4,6 @@ stacked_dynamic_lstm,machine_translation}.py and
 python/paddle/fluid/tests/book/)."""
 
 from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import transformer  # noqa: F401
+from . import vgg  # noqa: F401

@@ -1,0 +1,99 @@
+"""ResNet for image classification (reference:
+benchmark/fluid/models/resnet.py — conv_bn_layer/shortcut/
+bottleneck_block/basicblock, resnet_imagenet/resnet_cifar10).
+
+TPU notes: NCHW program layout; convs lower to XLA conv_general_dilated
+which the TPU backend lays out for the MXU, so no manual layout pass is
+needed. BN defaults to fused scale+shift (is_test folds stats)."""
+
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["resnet_imagenet", "resnet_cifar10", "resnet50"]
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding,
+                  act="relu", is_test=False):
+    conv = layers.conv2d(input, num_filters=ch_out,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, act=None, bias_attr=False)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             is_test=is_test)
+    return input
+
+
+def basicblock(input, ch_out, stride, is_test=False):
+    short = _shortcut(input, ch_out, stride, is_test=is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None,
+                          is_test=is_test)
+    return layers.elementwise_add(short, conv2, act="relu")
+
+
+def bottleneck_block(input, ch_out, stride, is_test=False):
+    short = _shortcut(input, ch_out * 4, stride, is_test=is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test)
+    return layers.elementwise_add(short, conv3, act="relu")
+
+
+def _layer_warp(block_func, input, ch_out, count, stride, is_test=False):
+    res_out = block_func(input, ch_out, stride, is_test=is_test)
+    for _ in range(1, count):
+        res_out = block_func(res_out, ch_out, 1, is_test=is_test)
+    return res_out
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    """ImageNet-shape ResNet; depth in {18, 34, 50, 101, 152}."""
+    cfg = {18: ([2, 2, 2, 2], basicblock),
+           34: ([3, 4, 6, 3], basicblock),
+           50: ([3, 4, 6, 3], bottleneck_block),
+           101: ([3, 4, 23, 3], bottleneck_block),
+           152: ([3, 8, 36, 3], bottleneck_block)}
+    stages, block_func = cfg[depth]
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                          padding=3, is_test=is_test)
+    pool1 = layers.pool2d(conv1, pool_size=3, pool_stride=2,
+                          pool_padding=1, pool_type="max")
+    res = pool1
+    for i, (n, ch) in enumerate(zip(stages, (64, 128, 256, 512))):
+        res = _layer_warp(block_func, res, ch, n,
+                          1 if i == 0 else 2, is_test=is_test)
+    pool2 = layers.pool2d(res, pool_type="avg", global_pooling=True)
+    out = layers.fc(pool2, size=class_dim, act="softmax")
+    return out
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, ch_out=16, filter_size=3, stride=1,
+                          padding=1, is_test=is_test)
+    res1 = _layer_warp(basicblock, conv1, 16, n, 1, is_test=is_test)
+    res2 = _layer_warp(basicblock, res1, 32, n, 2, is_test=is_test)
+    res3 = _layer_warp(basicblock, res2, 64, n, 2, is_test=is_test)
+    pool = layers.pool2d(res3, pool_type="avg", global_pooling=True)
+    out = layers.fc(pool, size=class_dim, act="softmax")
+    return out
+
+
+def resnet50(input, class_dim=1000, is_test=False):
+    return resnet_imagenet(input, class_dim=class_dim, depth=50,
+                           is_test=is_test)
+
+
+def loss_and_acc(prediction, label):
+    loss = layers.cross_entropy(prediction, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(prediction, label)
+    return avg_loss, acc

@@ -31,6 +31,16 @@ class TransformerConfig:
                  d_model=512, d_ffn=2048, n_head=8, n_layer=6,
                  dropout=0.1, label_smooth_eps=0.1,
                  weight_sharing=False):
+        if d_model % 2:
+            raise ValueError("d_model must be even (sin/cos positional "
+                             "encoding interleave): got %d" % d_model)
+        if d_model % n_head:
+            raise ValueError("d_model %d not divisible by n_head %d"
+                             % (d_model, n_head))
+        if weight_sharing and src_vocab != tgt_vocab:
+            raise ValueError(
+                "weight_sharing requires src_vocab == tgt_vocab "
+                "(got %d vs %d)" % (src_vocab, tgt_vocab))
         self.src_vocab = src_vocab
         self.tgt_vocab = tgt_vocab
         self.max_len = max_len
@@ -221,8 +231,10 @@ def shard_tp(program, axis="tp"):
             shard(p, None, axis)
         elif any(t in n for t in ("_out.", "_fc2.")):
             shard(p, axis, None)
-        elif "word_emb" in n or n.startswith("proj"):
-            shard(p, axis, None)
+        elif "word_emb" in n:
+            shard(p, axis, None)       # (vocab, d_model): vocab is dim 0
+        elif n.startswith("proj"):
+            shard(p, None, axis)       # (d_model, vocab): vocab is dim 1
     return program
 
 

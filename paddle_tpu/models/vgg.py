@@ -1,0 +1,34 @@
+"""VGG-16 (reference: benchmark/fluid/models/vgg.py — vgg16_bn_drop)."""
+
+from __future__ import annotations
+
+from .. import layers, nets
+
+__all__ = ["vgg16_bn_drop", "vgg16"]
+
+
+def vgg16_bn_drop(input, class_dim=1000, is_test=False):
+    def conv_block(ipt, num_filter, groups, dropouts):
+        return nets.img_conv_group(
+            ipt, conv_num_filter=[num_filter] * groups,
+            pool_size=2, pool_stride=2, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts)
+
+    drop = 0.0 if is_test else 0.4
+    conv1 = conv_block(input, 64, 2, [drop, 0.0])
+    conv2 = conv_block(conv1, 128, 2, [drop, 0.0])
+    conv3 = conv_block(conv2, 256, 3, [drop, drop, 0.0])
+    conv4 = conv_block(conv3, 512, 3, [drop, drop, 0.0])
+    conv5 = conv_block(conv4, 512, 3, [drop, drop, 0.0])
+
+    drop = layers.dropout(conv5, dropout_prob=0.5, is_test=is_test)
+    fc1 = layers.fc(drop, size=512, act=None)
+    bn = layers.batch_norm(fc1, act="relu", is_test=is_test)
+    drop2 = layers.dropout(bn, dropout_prob=0.5, is_test=is_test)
+    fc2 = layers.fc(drop2, size=512, act=None)
+    prediction = layers.fc(fc2, size=class_dim, act="softmax")
+    return prediction
+
+
+vgg16 = vgg16_bn_drop

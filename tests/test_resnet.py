@@ -1,0 +1,138 @@
+"""ResNet training/forward tests (reference analog:
+test_parallel_executor_seresnext / book image_classification — assert
+the model builds and the loss decreases on synthetic data)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.models import resnet
+
+
+def _synthetic_images(rng, batch=8, hw=32, classes=10):
+    label = rng.randint(0, classes, size=(batch, 1)).astype(np.int64)
+    img = rng.rand(batch, 3, hw, hw).astype(np.float32) * 0.1
+    for i in range(batch):
+        k = int(label[i, 0])
+        img[i, k % 3, (k * 3) % hw:(k * 3) % hw + 3, :] += 1.0
+    return img, label
+
+
+def test_resnet_cifar_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 32, 32])
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = resnet.resnet_cifar10(img, class_dim=10, depth=8)
+        avg_loss, acc = resnet.loss_and_acc(pred, label)
+        optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+            avg_loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(12):
+        iv, lv = _synthetic_images(rng)
+        loss_v, = exe.run(main, feed={"img": iv, "label": lv},
+                          fetch_list=[avg_loss])
+        losses.append(float(loss_v))
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet18_imagenet_forward():
+    """Bottleneck-free ImageNet graph builds and runs one fwd step."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 64, 64])
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = resnet.resnet_imagenet(img, class_dim=10, depth=18,
+                                      is_test=True)
+        avg_loss, acc = resnet.loss_and_acc(pred, label)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    iv, lv = _synthetic_images(rng, batch=2, hw=64)
+    loss_v, pred_v = exe.run(main, feed={"img": iv, "label": lv},
+                             fetch_list=[avg_loss, pred])
+    assert pred_v.shape == (2, 10)
+    np.testing.assert_allclose(pred_v.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_resnet50_graph_builds():
+    """ResNet-50 (the BASELINE config-2 model) graph constructs with the
+    right parameter count (~25.6M for 1000 classes)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 224, 224])
+        pred = resnet.resnet50(img, class_dim=1000, is_test=True)
+    from paddle_tpu.framework import Parameter
+    total = sum(int(np.prod(v.shape))
+                for v in main.global_block().vars.values()
+                if isinstance(v, Parameter))
+    assert 25_000_000 < total < 26_000_000, total
+
+
+def test_simple_img_conv_pool_net():
+    from paddle_tpu import nets
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        out = nets.simple_img_conv_pool(img, num_filters=4,
+                                        filter_size=5, pool_size=2,
+                                        pool_stride=2, act="relu")
+    exe = fluid.Executor()
+    exe.run(startup)
+    iv = np.random.RandomState(0).rand(2, 1, 28, 28).astype(np.float32)
+    out_v, = exe.run(main, feed={"img": iv}, fetch_list=[out])
+    assert out_v.shape == (2, 4, 12, 12)
+
+
+def test_img_conv_group():
+    from paddle_tpu import nets
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 16, 16])
+        out = nets.img_conv_group(img, conv_num_filter=[8, 8],
+                                  pool_size=2, pool_stride=2,
+                                  conv_act="relu",
+                                  conv_with_batchnorm=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    iv = np.random.RandomState(0).rand(2, 3, 16, 16).astype(np.float32)
+    out_v, = exe.run(main, feed={"img": iv}, fetch_list=[out])
+    assert out_v.shape == (2, 8, 8, 8)
+
+
+def test_glu_and_attention_nets():
+    from paddle_tpu import nets
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        g = nets.glu(x, dim=-1)
+        q = layers.data("q", shape=[4, 16])
+        ctx = nets.scaled_dot_product_attention(q, q, q, num_heads=4)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    g_v, c_v = exe.run(
+        main,
+        feed={"x": rng.rand(2, 8).astype(np.float32),
+              "q": rng.rand(2, 4, 16).astype(np.float32)},
+        fetch_list=[g, ctx])
+    assert g_v.shape == (2, 4)
+    assert c_v.shape == (2, 4, 16)
+
+
+def test_vgg16_cifar_forward():
+    from paddle_tpu.models import vgg
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 32, 32])
+        pred = vgg.vgg16_bn_drop(img, class_dim=10, is_test=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    iv = np.random.RandomState(0).rand(2, 3, 32, 32).astype(np.float32)
+    pred_v, = exe.run(main, feed={"img": iv}, fetch_list=[pred])
+    assert pred_v.shape == (2, 10)
+    np.testing.assert_allclose(pred_v.sum(axis=1), 1.0, rtol=1e-4)
