@@ -2,7 +2,17 @@
 (reference: python/paddle/fluid/layers/__init__.py)."""
 
 from . import math_op_patch  # noqa: F401  (registers Variable operators)
+from .control_flow import (equal, greater_equal, greater_than,  # noqa: F401
+                           is_empty, less_equal, less_than,
+                           logical_and, logical_not, logical_or,
+                           logical_xor, not_equal)
 from .io import data  # noqa: F401
+from .learning_rate_scheduler import (cosine_decay,  # noqa: F401
+                                      exponential_decay,
+                                      inverse_time_decay,
+                                      linear_lr_warmup, natural_exp_decay,
+                                      noam_decay, piecewise_decay,
+                                      polynomial_decay)
 from .metric_op import accuracy, auc  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

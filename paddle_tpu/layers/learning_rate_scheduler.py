@@ -1,0 +1,166 @@
+"""In-graph learning-rate schedules over a global step counter
+(reference: python/paddle/fluid/layers/learning_rate_scheduler.py —
+noam_decay:36, exponential_decay:66, natural_exp_decay:102,
+inverse_time_decay:133, polynomial_decay:165, piecewise_decay:214,
+cosine_decay:254, linear_lr_warmup:282).
+
+Each schedule appends ops computing the current LR from a persistable
+step counter that increments once per executed step — so the schedule
+compiles into the same XLA program as the train step (the reference runs
+these as ops in the main program too)."""
+
+from __future__ import annotations
+
+import math
+
+from .. import unique_name
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+from . import nn, ops, tensor
+from .control_flow import less_than
+
+__all__ = ["noam_decay", "exponential_decay", "natural_exp_decay",
+           "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+           "cosine_decay", "linear_lr_warmup"]
+
+
+def _decay_step_counter(begin=0):
+    """Auto-incrementing global step (reference
+    layers/learning_rate_scheduler.py _decay_step_counter → autoincreased
+    step counter var). Returns a float32 scalar holding the 0-based step
+    index of the current run."""
+    helper = LayerHelper("global_step_counter")
+    counter = helper.main_program.global_block().create_var(
+        name=unique_name.generate("@LR_DECAY_COUNTER@"),
+        shape=(), dtype="int64", persistable=True, stop_gradient=True)
+    sblock = helper.startup_program.global_block()
+    sv = sblock.create_var(name=counter.name, shape=(), dtype="int64",
+                           persistable=True, stop_gradient=True)
+    Constant(float(begin))(sv, sblock)
+    nn.increment(counter, value=1, in_place=True)
+    # 0-based step index of *this* run = counter_after_increment - 1
+    step = nn.cast(counter, "float32")
+    return nn.scale(step, scale=1.0, bias=-1.0)
+
+
+def noam_decay(d_model, warmup_steps):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)
+    (reference :36; the transformer schedule)."""
+    step = _one_based_step()
+    a = ops.rsqrt(step)
+    b = nn.scale(step, scale=float(warmup_steps) ** -1.5)
+    lr = nn.elementwise_min(a, b)
+    return nn.scale(lr, scale=float(d_model) ** -0.5)
+
+
+def _one_based_step():
+    s = _decay_step_counter()
+    return nn.scale(s, scale=1.0, bias=1.0)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps) (reference :66)."""
+    step = _decay_step_counter()
+    div = nn.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = ops.floor(div)
+    factor = nn.elementwise_pow(
+        tensor.fill_constant((), "float32", float(decay_rate)), div)
+    return nn.scale(factor, scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps) (reference :102)."""
+    step = _decay_step_counter()
+    div = nn.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = ops.floor(div)
+    return nn.scale(ops.exp(nn.scale(div, scale=-float(decay_rate))),
+                    scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps) (reference :133)."""
+    step = _decay_step_counter()
+    div = nn.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = ops.floor(div)
+    denom = nn.scale(div, scale=float(decay_rate), bias=1.0)
+    return nn.elementwise_div(
+        tensor.fill_constant((), "float32", float(learning_rate)), denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    """Polynomial ramp from lr to end_lr over decay_steps (reference
+    :165)."""
+    step = _decay_step_counter()
+    if cycle:
+        div = ops.ceil(nn.scale(step, scale=1.0 / float(decay_steps)))
+        # first step: ceil(0) == 0 -> force 1 so lr starts at base
+        one = tensor.fill_constant((), "float32", 1.0)
+        div = nn.elementwise_max(div, one)
+        decay_steps_v = nn.scale(div, scale=float(decay_steps))
+        frac = nn.elementwise_div(step, decay_steps_v)
+    else:
+        cap = tensor.fill_constant((), "float32", float(decay_steps))
+        step = nn.elementwise_min(step, cap)
+        frac = nn.scale(step, scale=1.0 / float(decay_steps))
+    one_minus = nn.scale(frac, scale=-1.0, bias=1.0)
+    poly = nn.elementwise_pow(
+        one_minus, tensor.fill_constant((), "float32", float(power)))
+    return nn.scale(poly,
+                    scale=float(learning_rate) - float(end_learning_rate),
+                    bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    """Step function: values[i] while step < boundaries[i] (reference
+    :214). Computed branch-free as sum of interval indicators — XLA
+    prefers the arithmetic form to a switch chain."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    step = _decay_step_counter()
+    lr = tensor.fill_constant((), "float32", float(values[-1]))
+    # lr = values[-1] + sum_i (values[i] - values[i+1]) * (step < b_i)
+    for i, b in enumerate(boundaries):
+        below = nn.cast(
+            less_than(step,
+                         tensor.fill_constant((), "float32", float(b))),
+            "float32")
+        delta = nn.scale(below,
+                         scale=float(values[i]) - float(values[i + 1]))
+        lr = nn.elementwise_add(lr, delta)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """lr * 0.5 * (cos(pi * epoch / epochs) + 1) (reference :254)."""
+    step = _decay_step_counter()
+    epoch = ops.floor(nn.scale(step, scale=1.0 / float(step_each_epoch)))
+    inner = nn.scale(epoch, scale=math.pi / float(epochs))
+    return nn.scale(ops.cos(inner), scale=0.5 * float(learning_rate),
+                    bias=0.5 * float(learning_rate))
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear ramp start_lr→end_lr for warmup_steps, then the wrapped
+    schedule (reference :282). learning_rate may be a float or a
+    schedule output Variable."""
+    from ..framework import Variable
+    step = _decay_step_counter()
+    if not isinstance(learning_rate, Variable):
+        learning_rate = tensor.fill_constant(
+            (), "float32", float(learning_rate))
+    frac = nn.scale(step, scale=1.0 / float(warmup_steps))
+    warm = nn.scale(frac, scale=float(end_lr) - float(start_lr),
+                    bias=float(start_lr))
+    in_warmup = nn.cast(
+        less_than(step, tensor.fill_constant(
+            (), "float32", float(warmup_steps))), "float32")
+    keep = nn.scale(in_warmup, scale=-1.0, bias=1.0)
+    return nn.elementwise_add(nn.elementwise_mul(warm, in_warmup),
+                              nn.elementwise_mul(learning_rate, keep))

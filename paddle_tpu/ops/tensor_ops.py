@@ -301,3 +301,10 @@ def assign_numpy_value(*, _value, dtype):
     """Materialize a host constant (NumpyArrayInitializer's op;
     reference: assign_value_op.cc)."""
     return jnp.asarray(_value, dtype=dtype)
+
+
+@register("is_empty", ["X"], ["Out"], differentiable=False)
+def is_empty(x):
+    """Static-shape emptiness test (reference:
+    controlflow/is_empty_op.cc) — a compile-time constant under XLA."""
+    return jnp.asarray(x.size == 0)

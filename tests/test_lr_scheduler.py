@@ -1,0 +1,116 @@
+"""LR schedule tests (reference analog:
+unittests/test_learning_rate_scheduler.py — compare in-graph schedule
+values against python-computed expectations step by step)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run_schedule(build_fn, steps=8):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build_fn()
+    exe = fluid.Executor()
+    exe.run(startup)
+    return [float(exe.run(main, fetch_list=[lr])[0])
+            for _ in range(steps)]
+
+
+def test_exponential_decay():
+    got = _run_schedule(
+        lambda: layers.exponential_decay(0.1, decay_steps=4,
+                                         decay_rate=0.5))
+    want = [0.1 * 0.5 ** (s / 4.0) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_exponential_decay_staircase():
+    got = _run_schedule(
+        lambda: layers.exponential_decay(0.1, 4, 0.5, staircase=True))
+    want = [0.1 * 0.5 ** (s // 4) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    got = _run_schedule(
+        lambda: layers.natural_exp_decay(0.1, 4, 0.5))
+    want = [0.1 * math.exp(-0.5 * s / 4.0) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    got = _run_schedule(
+        lambda: layers.inverse_time_decay(0.1, 4, 0.5))
+    want = [0.1 / (1 + 0.5 * s / 4.0) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    got = _run_schedule(
+        lambda: layers.polynomial_decay(0.1, decay_steps=5,
+                                        end_learning_rate=0.01,
+                                        power=2.0))
+    want = [(0.1 - 0.01) * (1 - min(s, 5) / 5.0) ** 2 + 0.01
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_schedule(
+        lambda: layers.piecewise_decay([3, 6], [0.1, 0.05, 0.01]))
+    want = [0.1] * 3 + [0.05] * 3 + [0.01] * 2
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cosine_decay():
+    got = _run_schedule(
+        lambda: layers.cosine_decay(0.1, step_each_epoch=2, epochs=4))
+    want = [0.1 * 0.5 * (math.cos(math.pi * (s // 2) / 4.0) + 1)
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_noam_decay():
+    got = _run_schedule(lambda: layers.noam_decay(64, warmup_steps=4))
+    want = [64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 4 ** -1.5)
+            for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linear_lr_warmup_wraps_schedule():
+    got = _run_schedule(
+        lambda: layers.linear_lr_warmup(
+            layers.piecewise_decay([6], [0.1, 0.01]),
+            warmup_steps=4, start_lr=0.0, end_lr=0.1))
+    want = [0.0, 0.025, 0.05, 0.075, 0.1, 0.1, 0.01, 0.01]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-8)
+
+
+def test_scheduler_drives_optimizer():
+    """Schedule output feeds Optimizer(learning_rate=Variable)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 0
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        lr = layers.exponential_decay(0.1, decay_steps=2,
+                                      decay_rate=0.5)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    prev = None
+    for step in range(4):
+        xv = rng.rand(8, 4).astype(np.float32)
+        yv = (xv.sum(1, keepdims=True)).astype(np.float32)
+        loss_v, lr_v = exe.run(main, feed={"x": xv, "y": yv},
+                               fetch_list=[loss, lr])
+        want_lr = 0.1 * 0.5 ** (step / 2.0)
+        np.testing.assert_allclose(float(lr_v), want_lr, rtol=1e-5)
