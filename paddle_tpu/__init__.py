@@ -38,6 +38,8 @@ from . import parallel  # noqa: F401
 from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         default_main_program, default_startup_program,
                         name_scope, program_guard)
+from . import io  # noqa: F401
+from . import nets  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 __version__ = "0.1.0"

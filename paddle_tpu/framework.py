@@ -519,6 +519,100 @@ class Program:
         p._bump()
         return p
 
+    def _prune(self, targets) -> "Program":
+        """Slice the program to the ops needed to compute ``targets``
+        (reference: Program._prune → C++ framework/prune.cc). Walks the
+        op list backward keeping producers of needed vars."""
+        target_names = set()
+        for t in targets:
+            target_names.add(t.name if isinstance(t, Variable) else t)
+        p = copy.deepcopy(self)
+        for b in p.blocks:
+            needed = set(target_names)
+            kept = []
+            for op in reversed(b.ops):
+                out_names = [n for ns in op.outputs.values() for n in ns]
+                if any(n in needed for n in out_names):
+                    kept.append(op)
+                    for ns in op.inputs.values():
+                        needed.update(ns)
+            kept.reverse()
+            b.ops = kept
+            live = set()
+            for op in b.ops:
+                for ns in op.inputs.values():
+                    live.update(ns)
+                for ns in op.outputs.values():
+                    live.update(ns)
+            b.vars = {n: v for n, v in b.vars.items()
+                      if n in live or n in target_names}
+        p._bump()
+        return p
+
+    # -- serialization (reference: ProgramDesc protobuf round-trip;
+    #    framework.proto:184 / Program.parse_from_string) ------------------
+    def to_dict(self) -> dict:
+        blocks = []
+        for b in self.blocks:
+            vars_ = []
+            for v in b.vars.values():
+                d = {"name": v.name, "shape": list(v.shape),
+                     "dtype": v.dtype, "persistable": v.persistable,
+                     "stop_gradient": v.stop_gradient,
+                     "is_data": v.is_data, "lod_level": v.lod_level}
+                if isinstance(v, Parameter):
+                    d["is_parameter"] = True
+                    d["trainable"] = v.trainable
+                    d["optimize_attr"] = v.optimize_attr
+                vars_.append(d)
+            ops_ = [{"type": op.type,
+                     "inputs": {k: list(vv) for k, vv in
+                                op.inputs.items()},
+                     "outputs": {k: list(vv) for k, vv in
+                                 op.outputs.items()},
+                     "attrs": op.attrs} for op in b.ops]
+            blocks.append({"idx": b.idx, "parent_idx": b.parent_idx,
+                           "vars": vars_, "ops": ops_})
+        return {"version": 1, "seed": self._seed,
+                "is_test": self._is_test, "blocks": blocks}
+
+    @staticmethod
+    def from_dict(desc: dict) -> "Program":
+        enforce(desc.get("version") == 1,
+                "unsupported program version %r" % desc.get("version"))
+        p = Program()
+        p._seed = desc.get("seed", 0)
+        p._is_test = desc.get("is_test", False)
+        for bd in desc["blocks"]:
+            if bd["idx"] == 0:
+                b = p.global_block()
+            else:
+                b = Block(p, bd["idx"], bd["parent_idx"])
+                p.blocks.append(b)
+            for vd in bd["vars"]:
+                kw = dict(shape=vd["shape"], dtype=vd["dtype"],
+                          name=vd["name"],
+                          persistable=vd["persistable"],
+                          stop_gradient=vd["stop_gradient"],
+                          is_data=vd["is_data"],
+                          lod_level=vd["lod_level"])
+                if vd.get("is_parameter"):
+                    v = Parameter(b, trainable=vd.get("trainable", True),
+                                  optimize_attr=vd.get("optimize_attr"),
+                                  **kw)
+                else:
+                    v = Variable(b, **kw)
+                b.vars[vd["name"]] = v
+            for od in bd["ops"]:
+                op = Operator(b, od["type"])
+                op.inputs = {k: list(v) for k, v in od["inputs"].items()}
+                op.outputs = {k: list(v) for k, v in
+                              od["outputs"].items()}
+                op.attrs = dict(od["attrs"])
+                b.ops.append(op)
+        p._bump()
+        return p
+
     def __deepcopy__(self, memo):
         p = Program.__new__(Program)
         memo[id(self)] = p

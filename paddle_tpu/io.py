@@ -1,0 +1,241 @@
+"""Checkpoint save/load + inference-model export (reference:
+python/paddle/fluid/io.py — save_vars:98, save_params, save_persistables
+:462, load_vars, load_persistables:698, save_inference_model:903,
+load_inference_model:1083; tensor wire format mirrors
+framework/lod_tensor.h:214 SerializeToStream's versioned header).
+
+TPU-native difference: the reference appends `save`/`save_combine` ops
+and executes them inside the graph; here params are fetched from the
+scope (device→host once per checkpoint) and written host-side — there is
+no op-level graph to splice into, and checkpointing shouldn't invalidate
+the compiled step program.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+from .core.enforce import InvalidArgumentError, enforce
+from .core.scope import global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "get_program_persistable_vars"]
+
+_TENSOR_MAGIC = b"PTPU"
+_TENSOR_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# tensor wire format
+# ---------------------------------------------------------------------------
+
+def serialize_tensor(arr: np.ndarray) -> bytes:
+    """magic | u32 version | u16 len(dtype) | dtype utf8 | u32 ndim |
+    i64 dims... | payload (C-order)."""
+    arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.name.encode()
+    head = _TENSOR_MAGIC + struct.pack("<IH", _TENSOR_VERSION, len(dt))
+    head += dt + struct.pack("<I", arr.ndim)
+    head += struct.pack("<%dq" % arr.ndim, *arr.shape)
+    return head + arr.tobytes()
+
+
+def deserialize_tensor(buf: bytes, offset: int = 0):
+    """Returns (ndarray, next_offset)."""
+    enforce(buf[offset:offset + 4] == _TENSOR_MAGIC,
+            "bad tensor magic — corrupt or foreign checkpoint")
+    offset += 4
+    version, dlen = struct.unpack_from("<IH", buf, offset)
+    enforce(version == _TENSOR_VERSION,
+            "unsupported tensor version %d" % version)
+    offset += 6
+    dtype = np.dtype(buf[offset:offset + dlen].decode())
+    offset += dlen
+    (ndim,) = struct.unpack_from("<I", buf, offset)
+    offset += 4
+    dims = struct.unpack_from("<%dq" % ndim, buf, offset)
+    offset += 8 * ndim
+    count = int(np.prod(dims)) if ndim else 1
+    nbytes = count * dtype.itemsize
+    arr = np.frombuffer(buf, dtype=dtype, count=count,
+                        offset=offset).reshape(dims)
+    return arr.copy(), offset + nbytes
+
+
+# ---------------------------------------------------------------------------
+# var save/load
+# ---------------------------------------------------------------------------
+
+def _is_persistable(var) -> bool:
+    return bool(var.persistable) and not var.is_data
+
+
+def _is_parameter(var) -> bool:
+    return isinstance(var, Parameter)
+
+
+def get_program_persistable_vars(program) -> List[Variable]:
+    return [v for v in program.list_vars() if _is_persistable(v)]
+
+
+def _collect(program, vars, predicate):
+    program = program or default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate(v)]
+    return program, vars
+
+
+def _fetch_numpy(value):
+    enforce(value is not None, "variable has no value in scope — "
+            "did you run the startup program?")
+    return np.asarray(value)
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Save selected vars under ``dirname`` — one file per var, or a
+    single combined ``filename`` (the save_combine path, reference
+    io.py:98/save_combine_op.cc)."""
+    scope = scope or global_scope()
+    program, vars = _collect(main_program, vars,
+                             predicate or _is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for v in vars:
+            arr = _fetch_numpy(scope.find_var(v.name))
+            with open(os.path.join(dirname, v.name), "wb") as f:
+                f.write(serialize_tensor(arr))
+    else:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            names = [v.name for v in vars]
+            f.write(struct.pack("<I", len(names)))
+            for n in names:
+                nb = n.encode()
+                f.write(struct.pack("<H", len(nb)) + nb)
+            for v in vars:
+                arr = _fetch_numpy(scope.find_var(v.name))
+                f.write(serialize_tensor(arr))
+
+
+def save_params(executor=None, dirname=None, main_program=None,
+                filename=None, scope=None):
+    return save_vars(executor, dirname, main_program, None,
+                     _is_parameter, filename, scope)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, scope=None):
+    return save_vars(executor, dirname, main_program, None,
+                     _is_persistable, filename, scope)
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Mirror of save_vars (reference io.py:498). Shape/dtype are
+    validated against the program's declaration."""
+    scope = scope or global_scope()
+    program, vars = _collect(main_program, vars,
+                             predicate or _is_persistable)
+    if filename is None:
+        for v in vars:
+            path = os.path.join(dirname, v.name)
+            enforce(os.path.exists(path),
+                    "checkpoint file missing for var %r: %s"
+                    % (v.name, path))
+            with open(path, "rb") as f:
+                arr, _ = deserialize_tensor(f.read())
+            _check_and_set(scope, v, arr)
+    else:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            buf = f.read()
+        (n,) = struct.unpack_from("<I", buf, 0)
+        off = 4
+        names = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            names.append(buf[off:off + ln].decode())
+            off += ln
+        tensors = {}
+        for name in names:
+            arr, off = deserialize_tensor(buf, off)
+            tensors[name] = arr
+        for v in vars:
+            enforce(v.name in tensors,
+                    "var %r not in combined checkpoint" % v.name)
+            _check_and_set(scope, v, tensors[v.name])
+
+
+def _check_and_set(scope, v, arr):
+    want = tuple(int(d) for d in v.shape if d != -1)
+    got = tuple(arr.shape)
+    if want and got != want:
+        raise InvalidArgumentError(
+            "shape mismatch loading %r: checkpoint %s vs program %s"
+            % (v.name, got, want))
+    scope.set_var(v.name, arr)
+
+
+def load_params(executor=None, dirname=None, main_program=None,
+                filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, None,
+                     _is_parameter, filename, scope)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, None,
+                     _is_persistable, filename, scope)
+
+
+# ---------------------------------------------------------------------------
+# inference model
+# ---------------------------------------------------------------------------
+
+def save_inference_model(dirname, feeded_var_names, target_vars,
+                         executor=None, main_program=None,
+                         model_filename=None, params_filename=None,
+                         scope=None):
+    """Prune to the inference slice and persist program + params
+    (reference io.py:903). Returns the target var names."""
+    main_program = main_program or default_main_program()
+    enforce(isinstance(feeded_var_names, (list, tuple)),
+            "feeded_var_names must be a list of names")
+    targets = list(target_vars)
+    inf_prog = main_program.clone(for_test=True)._prune(targets)
+    target_names = [t.name if isinstance(t, Variable) else t
+                    for t in targets]
+    desc = {"program": inf_prog.to_dict(),
+            "feed_names": list(feeded_var_names),
+            "fetch_names": target_names}
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "wb") as f:
+        pickle.dump(desc, f, protocol=4)
+    save_persistables(executor, dirname, inf_prog,
+                      filename=params_filename, scope=scope)
+    return target_names
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None, scope=None):
+    """Returns (program, feed_names, fetch_vars) (reference
+    io.py:1083)."""
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    enforce(os.path.exists(model_path),
+            "no inference model at %s" % model_path)
+    with open(model_path, "rb") as f:
+        desc = pickle.load(f)
+    program = Program.from_dict(desc["program"])
+    load_persistables(executor, dirname, program,
+                      filename=params_filename, scope=scope)
+    blk = program.global_block()
+    fetch_vars = [blk.var(n) for n in desc["fetch_names"]]
+    return program, desc["feed_names"], fetch_vars

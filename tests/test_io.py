@@ -1,0 +1,129 @@
+"""Checkpoint + inference-model tests (reference analog:
+unittests/test_io_save_load.py, book tests' save+reload round-trips)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.io import (deserialize_tensor, load_inference_model,
+                           load_persistables, save_inference_model,
+                           save_persistables, serialize_tensor)
+
+
+def _build_and_train(steps=5, seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1])
+        h = layers.fc(x, size=8, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        xv = rng.rand(8, 4).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    return main, startup, exe, pred, loss
+
+
+def test_tensor_roundtrip():
+    for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.array(3.5, dtype=np.float64),
+                np.arange(5, dtype=np.int64),
+                np.random.RandomState(0).rand(2, 3, 4).astype(
+                    np.float32)):
+        got, off = deserialize_tensor(serialize_tensor(arr))
+        np.testing.assert_array_equal(got, arr)
+        assert got.dtype == arr.dtype
+
+
+def test_tensor_corrupt_rejected():
+    buf = serialize_tensor(np.ones(3, np.float32))
+    with pytest.raises(Exception, match="magic"):
+        deserialize_tensor(b"XXXX" + buf[4:])
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup, exe, pred, loss = _build_and_train()
+    scope = fluid.global_scope()
+    before = {v.name: np.asarray(scope.find_var(v.name))
+              for v in main.list_vars()
+              if v.persistable and not v.is_data}
+    save_persistables(exe, str(tmp_path / "ckpt"), main)
+    # load into a FRESH scope and compare every persistable (params,
+    # Adam moments, beta pows, lr)
+    fresh = Scope()
+    load_persistables(exe, str(tmp_path / "ckpt"), main, scope=fresh)
+    for name, want in before.items():
+        got = np.asarray(fresh.find_var(name))
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_save_load_combined_single_file(tmp_path):
+    main, startup, exe, pred, loss = _build_and_train(seed=1)
+    scope = fluid.global_scope()
+    save_persistables(exe, str(tmp_path), main, filename="all.pdckpt")
+    assert (tmp_path / "all.pdckpt").exists()
+    fresh = Scope()
+    load_persistables(exe, str(tmp_path), main, filename="all.pdckpt",
+                      scope=fresh)
+    for v in main.list_vars():
+        if v.persistable and not v.is_data:
+            np.testing.assert_array_equal(
+                np.asarray(fresh.find_var(v.name)),
+                np.asarray(scope.find_var(v.name)), err_msg=v.name)
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    main, startup, exe, pred, loss = _build_and_train(seed=2)
+    save_persistables(exe, str(tmp_path / "c"), main)
+    # program with a different fc size must refuse the checkpoint
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = layers.data("x", shape=[4])
+        h = layers.fc(x, size=16, act="relu")  # 8 -> 16
+    with pytest.raises(Exception, match="mismatch|missing"):
+        load_persistables(exe, str(tmp_path / "c"), main2,
+                          scope=Scope())
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup, exe, pred, loss = _build_and_train(steps=8, seed=3)
+    xv = np.random.RandomState(9).rand(4, 4).astype(np.float32)
+    want, = exe.run(main.clone(for_test=True), feed={
+        "x": xv, "y": np.zeros((4, 1), np.float32)},
+        fetch_list=[pred])
+
+    save_inference_model(str(tmp_path / "m"), ["x"], [pred], exe, main)
+
+    # reload into a fresh scope — as an inference process would
+    fresh = Scope()
+    prog, feed_names, fetch_vars = load_inference_model(
+        str(tmp_path / "m"), exe, scope=fresh)
+    assert feed_names == ["x"]
+    with fluid.scope_guard(fresh):
+        got, = exe.run(prog, feed={"x": xv}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # pruned program must not contain label/loss/optimizer machinery
+    op_types = [op.type for op in prog.global_block().ops]
+    assert "adam" not in op_types
+    assert all("grad" not in t for t in op_types), op_types
+
+
+def test_inference_model_strips_train_only_vars(tmp_path):
+    main, startup, exe, pred, loss = _build_and_train(steps=2, seed=4)
+    save_inference_model(str(tmp_path / "m2"), ["x"], [pred], exe, main)
+    prog, _, _ = load_inference_model(str(tmp_path / "m2"), exe,
+                                      scope=Scope())
+    names = set()
+    for b in prog.blocks:
+        names.update(b.vars)
+    assert not any("moment" in n or "@GRAD" in n for n in names), names
