@@ -40,6 +40,10 @@ from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         name_scope, program_guard)
 from . import io  # noqa: F401
 from . import nets  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .pyreader import DataLoader, PyReader  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 __version__ = "0.1.0"

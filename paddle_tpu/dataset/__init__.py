@@ -1,0 +1,13 @@
+"""Dataset zoo (reference: python/paddle/dataset/ — mnist, cifar,
+uci_housing, imdb, movielens... with auto-download).
+
+This environment has zero egress, so each dataset is a *deterministic
+synthetic generator* with the reference's exact sample shapes/dtypes and
+reader-creator API (``train()``/``test()`` return zero-arg callables
+yielding samples). Real data can be dropped into
+``PADDLE_TPU_DATA_HOME`` using the same file layout to override."""
+
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401

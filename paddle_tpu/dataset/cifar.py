@@ -1,0 +1,41 @@
+"""CIFAR reader creators (reference: python/paddle/dataset/cifar.py —
+train10()/test10() yield (3072-float32 in [0,1], int label))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def _sample(idx, classes):
+    rng = np.random.RandomState(idx)
+    label = idx % classes
+    img = rng.rand(3, 32, 32).astype(np.float32) * 0.2
+    img[label % 3, (label * 3) % 32:(label * 3) % 32 + 4, :] += 0.8
+    return img.reshape(-1), np.int64(label)
+
+
+def _creator(n, base, classes):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i, classes)
+
+    return reader
+
+
+def train10():
+    return _creator(TRAIN_SIZE, 0, 10)
+
+
+def test10():
+    return _creator(TEST_SIZE, 5_000_000, 10)
+
+
+def train100():
+    return _creator(TRAIN_SIZE, 0, 100)
+
+
+def test100():
+    return _creator(TEST_SIZE, 5_000_000, 100)

@@ -1,0 +1,42 @@
+"""IMDB sentiment reader creators (reference:
+python/paddle/dataset/imdb.py — word-id sequences + 0/1 label).
+Synthetic: positive samples draw from one token range, negative from
+another, variable length (exercises the pad/bucket pipeline)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB_SIZE = 5148  # reference's imdb.word_dict() size ballpark
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(VOCAB_SIZE - 2)}
+
+
+def _sample(idx):
+    rng = np.random.RandomState(idx)
+    label = idx % 2
+    n = int(rng.randint(8, 120))
+    lo, hi = (0, VOCAB_SIZE // 2) if label else (VOCAB_SIZE // 2,
+                                                 VOCAB_SIZE - 2)
+    ids = rng.randint(lo, hi, size=n).astype(np.int64)
+    return ids, np.int64(label)
+
+
+def _creator(n, base):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i)
+
+    return reader
+
+
+def train(word_idx=None):
+    return _creator(TRAIN_SIZE, 0)
+
+
+def test(word_idx=None):
+    return _creator(TEST_SIZE, 3_000_000)

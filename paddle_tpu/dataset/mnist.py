@@ -1,0 +1,36 @@
+"""MNIST reader creators (reference: python/paddle/dataset/mnist.py —
+train()/test() yield (784-float32 in [-1,1], int64 label)).
+
+Synthetic fallback: class-conditional separable images so models
+actually learn; deterministic per index."""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+
+
+def _sample(idx):
+    rng = np.random.RandomState(idx)
+    label = idx % 10
+    img = rng.rand(784).astype(np.float32) * 0.2 - 1.0
+    img[label * 78:(label + 1) * 78] += 1.2
+    return img, np.int64(label)
+
+
+def _creator(n, base):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i)
+
+    return reader
+
+
+def train():
+    return _creator(TRAIN_SIZE, 0)
+
+
+def test():
+    return _creator(TEST_SIZE, 10_000_000)

@@ -1,0 +1,34 @@
+"""UCI housing reader creators (reference:
+python/paddle/dataset/uci_housing.py — 13 float features, 1 float
+target). Synthetic linear task with noise."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_W = np.linspace(-1.0, 1.0, 13).astype(np.float32)
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+
+def _sample(idx):
+    rng = np.random.RandomState(idx)
+    x = rng.rand(13).astype(np.float32)
+    y = np.float32(x @ _W + 0.05 * rng.randn())
+    return x, np.array([y], np.float32)
+
+
+def _creator(n, base):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i)
+
+    return reader
+
+
+def train():
+    return _creator(TRAIN_SIZE, 0)
+
+
+def test():
+    return _creator(TEST_SIZE, 1_000_000)

@@ -1,0 +1,151 @@
+"""PyReader / DataLoader: decoupled async host→device feeding
+(reference: python/paddle/fluid/reader.py PyReader:45 — python
+generators pump a C++ LoDTensorBlockingQueue consumed by reader ops;
+buffered_reader double-buffers to device).
+
+TPU-native shape: a background thread runs the user generator and
+*pre-transfers* each batch to device (jax.device_put) while the current
+step computes — the double-buffer-to-device pattern of the reference's
+buffered_reader (operators/reader/buffered_reader.cc) without reader
+ops, since the executor takes feeds directly."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from .core.enforce import enforce
+from .data_feeder import DataFeeder
+
+__all__ = ["PyReader", "DataLoader"]
+
+_SENTINEL = object()
+
+
+class PyReader:
+    """Iterable reader bound to a list of feed Variables.
+
+    Usage (iterable mode, the post-1.6 idiom):
+        reader = PyReader(feed_list=[img, label], capacity=4)
+        reader.decorate_sample_list_generator(batched_creator)
+        for data in reader():          # data is a feed dict
+            exe.run(main, feed=data, fetch_list=[...])
+    """
+
+    def __init__(self, feed_list: Sequence, capacity: int = 2,
+                 return_device_arrays: bool = True):
+        enforce(capacity >= 1, "capacity must be >= 1")
+        self.feed_list = list(feed_list)
+        self.capacity = capacity
+        self.return_device_arrays = return_device_arrays
+        self._feeder = DataFeeder(self.feed_list)
+        self._creator: Optional[Callable] = None
+        self._mode = None
+
+    # -- decorators (reference reader.py:45 API surface) -------------------
+    def decorate_sample_list_generator(self, creator):
+        """creator() yields lists of row-tuples (one list = one batch)."""
+        self._creator = creator
+        self._mode = "sample_list"
+        return self
+
+    def decorate_batch_generator(self, creator):
+        """creator() yields ready feed dicts or tuples of arrays."""
+        self._creator = creator
+        self._mode = "batch"
+        return self
+
+    def decorate_paddle_reader(self, creator):  # fluid-compat alias
+        return self.decorate_sample_list_generator(creator)
+
+    # -- iteration ---------------------------------------------------------
+    def _to_feed_dict(self, item):
+        if self._mode == "sample_list":
+            return self._feeder.feed(item)
+        if isinstance(item, dict):
+            return item
+        enforce(isinstance(item, (list, tuple)) and
+                len(item) == len(self.feed_list),
+                "batch generator must yield dicts or one array per "
+                "feed var")
+        return {v.name: a for v, a in zip(self.feed_list, item)}
+
+    def _device_put(self, feed):
+        if not self.return_device_arrays:
+            return feed
+        import jax
+        try:
+            return {k: jax.device_put(v) for k, v in feed.items()}
+        except Exception:
+            return feed
+
+    def __call__(self):
+        enforce(self._creator is not None,
+                "PyReader not decorated with a generator")
+        q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
+        err: List[BaseException] = []
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """put that aborts when the consumer went away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _pump():
+            try:
+                for item in self._creator():
+                    # transfer happens on this thread → overlaps with
+                    # the consumer's compute
+                    if not _put(self._device_put(
+                            self._to_feed_dict(item))):
+                        return  # consumer abandoned iteration
+            except BaseException as e:  # surface in consumer
+                err.append(e)
+            finally:
+                _put(_SENTINEL)
+
+        t = threading.Thread(target=_pump, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # break-out / GeneratorExit: unblock and retire the pump so
+            # it doesn't pin `capacity` device batches forever
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    # start/reset are no-ops in iterable mode (kept for API parity)
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+class DataLoader:
+    """fluid.io.DataLoader-style factory (reference reader.py ~1.6)."""
+
+    @staticmethod
+    def from_generator(feed_list, capacity=2, iterable=True,
+                       return_list=False):
+        enforce(iterable, "only iterable DataLoader is supported — "
+                "reader-op mode is a CUDA-interpreter concept")
+        enforce(not return_list, "return_list=True is not supported: "
+                "this loader yields feed dicts keyed by var name")
+        return PyReader(feed_list=feed_list, capacity=capacity)

@@ -1,0 +1,7 @@
+"""Reader composition toolkit (reference: python/paddle/reader/ —
+decorator.py combinators over "reader creators": zero-arg callables
+returning sample iterators)."""
+
+from .decorator import (buffered, cache, chain, compose,  # noqa: F401
+                        firstn, map_readers, shuffle, xmap_readers)
+from .decorator import batch  # noqa: F401

@@ -1,0 +1,223 @@
+"""Reader-creator combinators (reference:
+python/paddle/reader/decorator.py — map_readers:35, shuffle:62,
+chain:92, compose:130, buffered:180, firstn:252, xmap_readers:279;
+batch lives in python/paddle/batch.py).
+
+A *reader creator* is a zero-arg callable returning an iterator of
+samples. Combinators wrap creators and return new creators — pure-host
+python; the device never sees any of this (feeding happens via
+DataFeeder/PyReader)."""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from itertools import chain as it_chain
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "cache", "batch"]
+
+
+def map_readers(func, *readers):
+    """Element-wise zip+map over several readers (reference :35)."""
+
+    def reader():
+        for vals in zip(*(r() for r in readers)):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Pool-based shuffle with a buf_size reservoir (reference :62)."""
+
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers back to back (reference :92)."""
+
+    def reader():
+        return it_chain(*(r() for r in readers))
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into tuple samples (reference :130)."""
+
+    def _flatten(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        while True:
+            rows = []
+            done = 0
+            for it in its:
+                try:
+                    rows.append(_flatten(next(it)))
+                except StopIteration:
+                    done += 1
+                    rows.append(None)
+            if done == len(its):
+                return
+            if done > 0:
+                if check_alignment:
+                    raise RuntimeError(
+                        "compose: readers of different lengths")
+                return
+            yield sum(rows, ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch into a bounded queue (reference
+    :180) — keeps the host pipeline ahead of the device step."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def _fill():
+            try:
+                for d in r:
+                    q.put(d)
+            except BaseException as e:  # re-raised on the consumer side
+                err.append(e)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=_fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                if err:
+                    raise err[0]
+                return
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples (reference :252)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map with worker threads (reference :279). order=True
+    preserves input order."""
+
+    def ordered():
+        # single pipeline thread keeps ordering trivially correct
+        for s in buffered(map_readers(mapper, reader), buffer_size)():
+            yield s
+
+    if order:
+        return ordered
+
+    end = object()
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        err = []
+
+        def _feed():
+            try:
+                for s in reader():
+                    in_q.put(s)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def _work():
+            try:
+                while True:
+                    s = in_q.get()
+                    if s is end:
+                        return
+                    out_q.put(mapper(s))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                out_q.put(end)
+
+        threading.Thread(target=_feed, daemon=True).start()
+        workers = [threading.Thread(target=_work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        while finished < process_num:
+            s = out_q.get()
+            if s is end:
+                finished += 1
+            else:
+                yield s
+        if err:
+            raise err[0]
+
+    return data_reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory (reference: cache)."""
+    all_data = []
+    filled = [False]
+
+    def cache_reader():
+        if not filled[0]:
+            data = list(reader())  # atomic: partial fills don't stick
+            all_data.extend(data)
+            filled[0] = True
+        yield from all_data
+
+    return cache_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (reference:
+    python/paddle/batch.py)."""
+
+    def batch_reader():
+        b = []
+        for inst in reader():
+            b.append(inst)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
