@@ -1,0 +1,112 @@
+"""BERT tests (BASELINE config 4): pretrain step runs, fine-tune
+learns, and data-parallel loss trace matches single-device (the
+test_dist_base.py:316 loss-equality methodology)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.models import bert
+
+
+def _tiny_cfg(seq_len=16):
+    return bert.BertConfig(
+        vocab_size=200, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, seq_len=seq_len,
+        max_predictions_per_seq=4, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+
+
+def test_bert_pretrain_step_runs_and_learns():
+    cfg = _tiny_cfg()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        total, mlm_loss, nsp_acc = bert.bert_pretrain(cfg)
+        optimizer.Adam(5e-3).minimize(total)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = bert.make_fake_pretrain_batch(cfg, batch=8, seed=0)
+    losses = []
+    for _ in range(12):
+        tv, mv = exe.run(main, feed=feed, fetch_list=[total, mlm_loss])
+        losses.append(float(tv))
+        assert np.isfinite(tv)
+    # memorizes the fixed batch
+    assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+
+def test_bert_classifier_trains():
+    cfg = _tiny_cfg(seq_len=12)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        loss, acc, probs = bert.bert_classifier(cfg, num_classes=2)
+        optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    b, s = 16, 12
+    # learnable: label = whether token 5 appears in the first 4 slots
+    src = rng.randint(0, 200, size=(b, s)).astype(np.int64)
+    lab = (src[:, :4] == 5).any(axis=1).astype(np.int64).reshape(b, 1)
+    src[:, 0] = np.where(lab[:, 0] == 1, 5, 6)  # make it decisive
+    feed = {"src_ids": src,
+            "sent_ids": np.zeros((b, s), np.int64),
+            "input_mask": np.ones((b, s), np.float32),
+            "label": lab}
+    losses = []
+    for _ in range(25):
+        lv, av = exe.run(main, feed=feed, fetch_list=[loss, acc])
+        losses.append(float(lv))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def _dp_losses(compiled, steps=6):
+    cfg = _tiny_cfg()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        total, mlm_loss, nsp_acc = bert.bert_pretrain(cfg)
+        optimizer.Adam(1e-3).minimize(total)
+    prog = main if not compiled else \
+        fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(steps):
+            feed = bert.make_fake_pretrain_batch(cfg, batch=8,
+                                                 seed=step)
+            (tv,) = exe.run(prog, feed=feed, fetch_list=[total])
+            losses.append(float(tv))
+    return losses
+
+
+def test_bert_dp_matches_single_device():
+    single = _dp_losses(False)
+    dp = _dp_losses(True)
+    np.testing.assert_allclose(dp, single, rtol=3e-4, atol=1e-5)
+    assert dp[-1] < dp[0]
+
+
+def test_bert_tp_sharding_runs():
+    cfg = _tiny_cfg()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        total, _, _ = bert.bert_pretrain(cfg)
+        optimizer.Adam(1e-3).minimize(total)
+    bert.shard_tp(main)
+    prog = fluid.CompiledProgram(main).with_data_parallel(
+        axes={"dp": 2, "tp": 4})
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = bert.make_fake_pretrain_batch(cfg, batch=4, seed=0)
+        (tv,) = exe.run(prog, feed=feed, fetch_list=[total])
+        assert np.isfinite(tv)
