@@ -8,6 +8,7 @@ yielding samples). Real data can be dropped into
 ``PADDLE_TPU_DATA_HOME`` using the same file layout to override."""
 
 from . import cifar  # noqa: F401
+from . import criteo  # noqa: F401
 from . import imdb  # noqa: F401
 from . import mnist  # noqa: F401
 from . import uci_housing  # noqa: F401

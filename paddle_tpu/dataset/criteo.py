@@ -1,0 +1,39 @@
+"""Criteo-style CTR reader creators (reference: the dist_ctr test data
+and models-repo criteo dataset: 13 dense + 26 sparse slots + click).
+Synthetic, learnable, deterministic."""
+
+from __future__ import annotations
+
+import numpy as np
+
+NUM_DENSE = 13
+NUM_SPARSE = 26
+SPARSE_DIM = 100000
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def _sample(idx):
+    rs = np.random.RandomState(idx)
+    dense = rs.rand(NUM_DENSE).astype(np.float32)
+    sparse = rs.randint(0, SPARSE_DIM, size=NUM_SPARSE).astype(np.int64)
+    hot = (sparse < SPARSE_DIM // 20).any()
+    p = 0.15 + 0.5 * hot + 0.3 * (dense[0] > 0.5)
+    label = np.int64(rs.rand() < p)
+    return dense, sparse, label
+
+
+def _creator(n, base):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i)
+
+    return reader
+
+
+def train():
+    return _creator(TRAIN_SIZE, 0)
+
+
+def test():
+    return _creator(TEST_SIZE, 7_000_000)

@@ -3,6 +3,8 @@
 stacked_dynamic_lstm,machine_translation}.py and
 python/paddle/fluid/tests/book/)."""
 
+from . import bert  # noqa: F401
+from . import deepfm  # noqa: F401
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import transformer  # noqa: F401

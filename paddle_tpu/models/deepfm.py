@@ -1,0 +1,111 @@
+"""DeepFM CTR model (BASELINE.json config 5 — the reference's
+large-scale sparse competency, cf. dist_ctr.py / DeepFM on PaddlePaddle
+models repo; sparse tables are the PS-mode workload of
+SURVEY §2.4.7-8).
+
+TPU-native sparse story (SURVEY §7 "DistributeTranspiler + gRPC PS →
+sharded tables"): instead of parameter-server row prefetch
+(parameter_prefetch.cc), the embedding table lives in HBM row-sharded
+over the mesh's model axis; lookups become XLA gathers with
+compiler-inserted collectives over ICI. Beyond-HBM tables would add a
+host DCN service — out of scope at this model size.
+
+Criteo-style input: 13 dense float features + 26 categorical slots,
+each slot an id into one shared hashed vocab.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+__all__ = ["DeepFMConfig", "deepfm", "shard_tables", "make_fake_batch"]
+
+
+class DeepFMConfig:
+    def __init__(self, sparse_feature_dim=int(1e5), embedding_size=10,
+                 num_dense=13, num_sparse=26,
+                 layer_sizes=(400, 400, 400)):
+        self.sparse_feature_dim = sparse_feature_dim
+        self.embedding_size = embedding_size
+        self.num_dense = num_dense
+        self.num_sparse = num_sparse
+        self.layer_sizes = tuple(layer_sizes)
+
+
+def deepfm(cfg: DeepFMConfig, is_test=False):
+    """Feeds: dense_input [b, num_dense] float32;
+    sparse_input [b, num_sparse] int64; label [b, 1] int64.
+    Returns (avg_loss, auc_var, predict)."""
+    dense = layers.data("dense_input", shape=[cfg.num_dense])
+    sparse = layers.data("sparse_input", shape=[cfg.num_sparse],
+                         dtype="int64")
+    label = layers.data("label", shape=[1], dtype="int64")
+
+    # ---- first order: w_i x_i -------------------------------------------
+    # dense part: a linear layer; sparse part: 1-dim embedding per id
+    first_dense = layers.fc(dense, 1, name="fm_first_dense")
+    first_sparse_emb = layers.embedding(
+        sparse, size=(cfg.sparse_feature_dim, 1),
+        param_attr=ParamAttr(name="fm_first_w"))       # [b, 26, 1]
+    first_sparse = layers.reduce_sum(first_sparse_emb, dim=1)  # [b, 1]
+    y_first = layers.elementwise_add(first_dense, first_sparse)
+
+    # ---- second order: 0.5 * ((sum v)^2 - sum v^2) ----------------------
+    emb = layers.embedding(
+        sparse, size=(cfg.sparse_feature_dim, cfg.embedding_size),
+        param_attr=ParamAttr(name="fm_embedding"))     # [b, 26, k]
+    summed = layers.reduce_sum(emb, dim=1)             # [b, k]
+    summed_sq = layers.square(summed)
+    sq = layers.square(emb)
+    sq_summed = layers.reduce_sum(sq, dim=1)
+    y_second = layers.scale(
+        layers.reduce_sum(
+            layers.elementwise_sub(summed_sq, sq_summed),
+            dim=1, keep_dim=True),
+        scale=0.5)                                      # [b, 1]
+
+    # ---- deep tower over flattened embeddings ---------------------------
+    deep = layers.reshape(
+        emb, (-1, cfg.num_sparse * cfg.embedding_size))
+    deep = layers.concat([deep, dense], axis=1)
+    for i, h in enumerate(cfg.layer_sizes):
+        deep = layers.fc(deep, h, act="relu", name="deep_fc%d" % i)
+    y_deep = layers.fc(deep, 1, name="deep_out")
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(y_first, y_second), y_deep)
+    predict = layers.sigmoid(logit)
+
+    cost = layers.sigmoid_cross_entropy_with_logits(
+        logit, layers.cast(label, "float32"))
+    avg_loss = layers.mean(cost)
+    auc_var, _, _ = layers.auc(predict, label)
+    return avg_loss, auc_var, predict
+
+
+def shard_tables(program, axis="mp"):
+    """Row-shard the embedding tables over the model axis — the TPU
+    replacement for pserver-sharded tables (distribute_transpiler.py
+    table optimize blocks)."""
+    from ..parallel import shard
+    for p in program.all_parameters():
+        if p.name in ("fm_embedding", "fm_first_w"):
+            shard(p, axis, None)
+    return program
+
+
+def make_fake_batch(cfg, batch, seed=0):
+    """Learnable synthetic CTR data: click prob depends on one dense
+    feature and whether any sparse id falls in a 'hot' range."""
+    rs = np.random.RandomState(seed)
+    dense = rs.rand(batch, cfg.num_dense).astype(np.float32)
+    sparse = rs.randint(0, cfg.sparse_feature_dim,
+                        size=(batch, cfg.num_sparse)).astype(np.int64)
+    hot = (sparse < cfg.sparse_feature_dim // 100).any(axis=1)
+    p = 0.05 + 0.6 * hot + 0.3 * (dense[:, 0] > 0.5)
+    label = (rs.rand(batch) < p).astype(np.int64).reshape(batch, 1)
+    return {"dense_input": dense, "sparse_input": sparse,
+            "label": label}

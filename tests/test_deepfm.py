@@ -1,0 +1,90 @@
+"""DeepFM CTR tests (BASELINE config 5): model learns synthetic CTR
+signal, AUC accumulates, sharded-table mesh run matches replicated."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.models import deepfm
+
+
+def _tiny_cfg():
+    return deepfm.DeepFMConfig(sparse_feature_dim=200,
+                               embedding_size=8, layer_sizes=(32, 32))
+
+
+def _build(cfg, seed=1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        avg_loss, auc_var, predict = deepfm.deepfm(cfg)
+        optimizer.Adam(5e-3).minimize(avg_loss)
+    return main, startup, avg_loss, auc_var
+
+
+def test_deepfm_trains_and_auc_improves():
+    cfg = _tiny_cfg()
+    main, startup, avg_loss, auc_var = _build(cfg)
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses, aucs = [], []
+    for step in range(120):
+        feed = deepfm.make_fake_batch(cfg, batch=256, seed=step)
+        lv, av = exe.run(main, feed=feed,
+                         fetch_list=[avg_loss, auc_var])
+        losses.append(float(lv))
+        aucs.append(float(av))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert aucs[-1] > 0.68, aucs[-1]  # clearly better than chance
+
+
+def test_deepfm_sharded_tables_match_replicated():
+    """Row-sharded embedding tables over an mp axis produce the same
+    loss trace as the replicated run — the TPU equivalent of the
+    reference's PS-sharded-table correctness."""
+
+    def run(shard):
+        cfg = _tiny_cfg()
+        main, startup, avg_loss, auc_var = _build(cfg, seed=3)
+        if shard:
+            deepfm.shard_tables(main)
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                axes={"dp": 2, "mp": 4})
+        else:
+            prog = main
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for step in range(5):
+                feed = deepfm.make_fake_batch(cfg, batch=64, seed=step)
+                (lv,) = exe.run(prog, feed=feed,
+                                fetch_list=[avg_loss])
+                losses.append(float(lv))
+        return losses
+
+    plain = run(False)
+    sharded = run(True)
+    np.testing.assert_allclose(sharded, plain, rtol=3e-4, atol=1e-5)
+
+
+def test_criteo_dataset_pipeline():
+    from paddle_tpu import dataset, reader as rd
+    cfg = deepfm.DeepFMConfig(sparse_feature_dim=100000,
+                              embedding_size=4, layer_sizes=(8,))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        avg_loss, auc_var, predict = deepfm.deepfm(cfg)
+    feeder = fluid.DataFeeder(
+        feed_list=["dense_input", "sparse_input", "label"],
+        program=main)
+    batch = next(rd.batch(dataset.criteo.train(), 32)())
+    feed = feeder.feed(batch)
+    assert feed["dense_input"].shape == (32, 13)
+    assert feed["sparse_input"].shape == (32, 26)
+    exe = fluid.Executor()
+    exe.run(startup)
+    lv, = exe.run(main, feed=feed, fetch_list=[avg_loss])
+    assert np.isfinite(lv)
