@@ -1,0 +1,224 @@
+"""Host-side metric accumulators (reference:
+python/paddle/fluid/metrics.py — MetricBase:44, CompositeMetric:173,
+Precision:231, Recall:287, Accuracy:337, ChunkEvaluator:398, EditDistance,
+Auc:581, DetectionMAP).
+
+These accumulate *numpy fetch results* across minibatches on the host —
+complementary to the in-graph metric ops (layers.accuracy/auc) which run
+on-device inside the step program."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core.enforce import enforce
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
+           "Accuracy", "ChunkEvaluator", "EditDistance", "Auc"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else \
+            self.__class__.__name__
+
+    @property
+    def name(self):
+        return self._name
+
+    def reset(self):
+        """Zero every accumulator state (reference metrics.py:86 resets
+        attrs whose names start without underscore conventions)."""
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    """Evaluate several metrics over the same fetches (reference
+    :173)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        enforce(isinstance(metric, MetricBase),
+                "add_metric expects a MetricBase")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self._metrics:
+            m.update(*args, **kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision = tp / (tp + fp) (reference :231)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).ravel()
+        labels = np.asarray(labels).astype(np.int64).ravel()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """Binary recall = tp / (tp + fn) (reference :287)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).ravel()
+        labels = np.asarray(labels).astype(np.int64).ravel()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running mean of per-batch accuracies (reference
+    :337)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        enforce(weight >= 0, "weight must be non-negative")
+        self.value += float(value) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        enforce(self.weight > 0, "no updates — call update() first")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Chunking F1 from per-batch (num_infer, num_label, num_correct)
+    counts (reference :398)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Running mean edit distance + instance error rate (reference
+    :506)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances, dtype=np.float64).ravel()
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int((distances > 0).sum())
+
+    def eval(self):
+        enforce(self.seq_num > 0, "no updates — call update() first")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """Histogram-bucketed ROC AUC over accumulated predictions
+    (reference :581 — same 4096-bucket scheme as auc_op.cc)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n, np.int64)
+        self._stat_neg = np.zeros(n, np.int64)
+
+    def update(self, preds, labels):
+        """preds: [N, 2] softmax probs or [N] / [N,1] positive-class
+        probs; labels: [N(,1)] 0/1."""
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            pos_prob = preds[:, 1]
+        else:
+            pos_prob = preds.ravel()
+        labels = np.asarray(labels).astype(np.int64).ravel()
+        idx = np.minimum((pos_prob * self._num_thresholds).astype(int),
+                         self._num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def eval(self):
+        # integrate TPR/FPR over buckets from highest threshold down
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.5
+        pos = self._stat_pos[::-1].cumsum()
+        neg = self._stat_neg[::-1].cumsum()
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapz(tpr, fpr))

@@ -1,0 +1,106 @@
+"""Host metric accumulator tests (reference analog:
+unittests/test_metrics.py + op-level test_accuracy_op/test_auc_op)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics
+
+
+def test_precision_recall():
+    p = metrics.Precision()
+    r = metrics.Recall()
+    preds = np.array([1, 1, 0, 1, 0, 0])
+    labels = np.array([1, 0, 0, 1, 1, 0])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.eval() == pytest.approx(2 / 3)   # tp=2 fp=1
+    assert r.eval() == pytest.approx(2 / 3)   # tp=2 fn=1
+    p.reset()
+    assert p.eval() == 0.0
+
+
+def test_accuracy_weighted_mean():
+    a = metrics.Accuracy()
+    a.update(0.5, 10)
+    a.update(1.0, 30)
+    assert a.eval() == pytest.approx((0.5 * 10 + 1.0 * 30) / 40)
+    with pytest.raises(Exception):
+        a.update(0.5, -1)
+
+
+def test_composite():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    c.update(np.array([1, 0]), np.array([1, 1]))
+    got = c.eval()
+    assert got[0] == pytest.approx(1.0)
+    assert got[1] == pytest.approx(0.5)
+
+
+def test_chunk_evaluator():
+    ce = metrics.ChunkEvaluator()
+    ce.update(10, 8, 6)
+    precision, recall, f1 = ce.eval()
+    assert precision == pytest.approx(0.6)
+    assert recall == pytest.approx(0.75)
+    assert f1 == pytest.approx(2 * 0.6 * 0.75 / 1.35)
+
+
+def test_edit_distance():
+    ed = metrics.EditDistance()
+    ed.update(np.array([0.0, 2.0, 1.0]), 3)
+    avg, err_rate = ed.eval()
+    assert avg == pytest.approx(1.0)
+    assert err_rate == pytest.approx(2 / 3)
+
+
+def test_auc_against_sklearn_style_reference():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, size=2000)
+    # informative scores
+    scores = np.clip(labels * 0.3 + rng.rand(2000) * 0.7, 0, 1)
+    auc = metrics.Auc()
+    auc.update(scores[:1000], labels[:1000])
+    auc.update(scores[1000:], labels[1000:])
+
+    # exact AUC via rank statistic
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    exact = ((pos[:, None] > neg[None, :]).sum() +
+             0.5 * (pos[:, None] == neg[None, :]).sum()) \
+        / (len(pos) * len(neg))
+    assert auc.eval() == pytest.approx(float(exact), abs=5e-3)
+
+
+def test_auc_degenerate():
+    auc = metrics.Auc()
+    assert auc.eval() == 0.5  # no data
+    auc.update(np.array([0.9]), np.array([1]))
+    assert auc.eval() == 0.5  # single class
+
+
+def test_in_graph_auc_vs_host_auc():
+    """The in-graph auc op and the host Auc metric agree on the same
+    stream."""
+    from paddle_tpu import layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = layers.data("pred", shape=[1])
+        label = layers.data("label", shape=[1], dtype="int64")
+        auc_var, _, _ = layers.auc(pred, label)
+    exe = fluid.Executor()
+    exe.run(startup)
+    host = metrics.Auc()
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        lab = rng.randint(0, 2, size=(64, 1))
+        pr = np.clip(lab * 0.4 + rng.rand(64, 1) * 0.6, 0, 1) \
+            .astype(np.float32)
+        (av,) = exe.run(main, feed={"pred": pr,
+                                    "label": lab.astype(np.int64)},
+                        fetch_list=[auc_var])
+        host.update(pr, lab)
+    assert float(av) == pytest.approx(host.eval(), abs=2e-2)
