@@ -218,6 +218,19 @@ def run_block(block, env, step_key, library=None):
     return env
 
 
+# Op types that require concrete values (data-dependent Python control
+# flow or list-valued tensor arrays) — programs containing them run
+# un-jitted in interpreted mode.
+_EAGER_OP_TYPES = frozenset(
+    {"while", "create_array", "array_write", "array_read",
+     "array_length"})
+
+
+def _needs_eager(program) -> bool:
+    return any(op.type in _EAGER_OP_TYPES
+               for b in program.blocks for op in b.ops)
+
+
 class Executor:
     """Drop-in analog of fluid.Executor (executor.py:292)."""
 
@@ -287,7 +300,8 @@ class Executor:
             def step(persist, feed_vals, step_key):
                 env = dict(persist)
                 env.update(feed_vals)
-                run_block(block, env, step_key, library=library)
+                with framework._trace_program_guard(program):
+                    run_block(block, env, step_key, library=library)
                 persist_out = {n: env[n] for n in persistable_names
                                if n in env}
                 try:
@@ -299,18 +313,27 @@ class Executor:
                         % (e.args[0], sorted(feed_vals))) from e
                 return fetches, persist_out
 
-            jit_kwargs = {}
-            if donate:
-                jit_kwargs["donate_argnums"] = (0,)
-            if dist is not None:
-                # Pin persistable outputs to their input shardings so
-                # parameters keep a stable layout across steps (donation
-                # then reuses the buffers in place).
-                persist_sharding = {
-                    n: dist.persist_sharding(block.vars[n])
-                    for n in persist_in}
-                jit_kwargs["out_shardings"] = (None, persist_sharding)
-            fn = jax.jit(step, **jit_kwargs)
+            if _needs_eager(program):
+                # Interpreted mode: programs with While loops / tensor
+                # arrays have data-dependent Python control flow; run
+                # the ops' lowerings eagerly, op by op — the analog of
+                # the reference's single-threaded interpreter
+                # (executor.cc:415). Compiled recurrence goes through
+                # static_rnn/dynamic_rnn/beam-search instead.
+                fn = step
+            else:
+                jit_kwargs = {}
+                if donate:
+                    jit_kwargs["donate_argnums"] = (0,)
+                if dist is not None:
+                    # Pin persistable outputs to their input shardings so
+                    # parameters keep a stable layout across steps
+                    # (donation then reuses the buffers in place).
+                    persist_sharding = {
+                        n: dist.persist_sharding(block.vars[n])
+                        for n in persist_in}
+                    jit_kwargs["out_shardings"] = (None, persist_sharding)
+                fn = jax.jit(step, **jit_kwargs)
             self._cache[cache_key] = fn
 
         step_key = jax.random.fold_in(self._base_key(program),

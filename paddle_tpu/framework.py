@@ -397,7 +397,8 @@ def _infer_shapes(block, op):
         else:
             fn = opdef.fn
         attrs.pop("rng", None)
-        out = jax.eval_shape(lambda *a: fn(*a, **attrs), *arg_structs)
+        with _trace_program_guard(block.program):
+            out = jax.eval_shape(lambda *a: fn(*a, **attrs), *arg_structs)
     except Exception:
         return
 
@@ -710,6 +711,30 @@ def _reset_default_programs():
     _startup_program_ = Program()
     unique_name.switch()
     return _main_program_, _startup_program_
+
+
+# ---------------------------------------------------------------------------
+# Tracing-program context. Structured control-flow ops (ops/
+# control_flow_ops.py) hold only a sub-block *index* in their attrs —
+# attrs must stay deep-copyable metadata — and resolve it through this
+# guard, which the Executor (and _infer_shapes) set around tracing.
+# ---------------------------------------------------------------------------
+
+_tracing_program: Optional["Program"] = None
+
+
+@contextlib.contextmanager
+def _trace_program_guard(program):
+    global _tracing_program
+    prev, _tracing_program = _tracing_program, program
+    try:
+        yield
+    finally:
+        _tracing_program = prev
+
+
+def _current_tracing_program() -> Optional["Program"]:
+    return _tracing_program
 
 
 # ---------------------------------------------------------------------------

@@ -1,14 +1,36 @@
 """Control-flow and comparison layers (reference:
 python/paddle/fluid/layers/control_flow.py — less_than:1297, equal,
-array ops, While:697, IfElse:1553, StaticRNN:406)."""
+array ops :947, While:697, IfElse:1553, Switch:1264, StaticRNN:406,
+DynamicRNN:1815).
+
+TPU-native redesign of the structured constructs:
+  - ``StaticRNN`` / ``DynamicRNN`` record their step sub-block and lower
+    through ONE ``lax.scan`` (ops/control_flow_ops.py) — compiled,
+    differentiable, masked for variable lengths (replaces the
+    reference's while+tensor-array recurrent machinery and LoD
+    reordering).
+  - ``While`` + tensor arrays keep full fluid dynamism and run in the
+    Executor's interpreted (eager) mode.
+  - ``IfElse`` / ``Switch`` compute all branches and merge with
+    ``where`` selects — both sides of a branch are cheap relative to a
+    TPU divergent-control-flow stall, and the program stays one static
+    XLA computation.
+"""
 
 from __future__ import annotations
 
+import contextlib
+
+from .. import framework
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework import Variable
 from ..layer_helper import LayerHelper
 
 __all__ = ["less_than", "less_equal", "greater_than", "greater_equal",
            "equal", "not_equal", "logical_and", "logical_or",
-           "logical_xor", "logical_not", "is_empty"]
+           "logical_xor", "logical_not", "is_empty", "While",
+           "StaticRNN", "DynamicRNN", "IfElse", "Switch", "create_array",
+           "array_write", "array_read", "array_length"]
 
 
 def _cmp(op_type, x, y, cond=None):
@@ -75,3 +97,612 @@ def is_empty(x, cond=None):
     helper.append_op(type="is_empty", inputs={"X": [x]},
                      outputs={"Out": [cond]})
     return cond
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (reference: control_flow.py array_write:947, array_read,
+# array_length; eager mode only — see ops/control_flow_ops.py)
+# ---------------------------------------------------------------------------
+
+def create_array(dtype="float32"):
+    helper = LayerHelper("create_array")
+    out = helper.create_variable_for_type_inference(dtype)
+    out.stop_gradient = True
+    helper.append_op(type="create_array", outputs={"Out": [out]},
+                     attrs={"dtype": dtype})
+    return out
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    inputs = {"X": [x], "I": [i], "Array": [array]}
+    helper.append_op(type="array_write", inputs=inputs,
+                     outputs={"Out": [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type="array_read",
+                     inputs={"Array": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    out.stop_gradient = True
+    helper.append_op(type="array_length", inputs={"Array": [array]},
+                     outputs={"Out": [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# block-analysis helpers shared by While / StaticRNN / DynamicRNN
+# ---------------------------------------------------------------------------
+
+def _read_written(sub_block):
+    """Names read from / written to by a sub-block's ops, split into
+    block-local vs parent-visible (a name created inside the sub-block
+    is local; anything else resolves up the parent chain)."""
+    read, written = [], []
+    seen_r, seen_w = set(), set()
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n not in seen_r:
+                seen_r.add(n)
+                read.append(n)
+        for n in op.output_arg_names:
+            if n not in seen_w:
+                seen_w.add(n)
+                written.append(n)
+    outer_read = [n for n in read if n not in sub_block.vars]
+    outer_written = [n for n in written if n not in sub_block.vars]
+    return outer_read, outer_written
+
+
+class _SubBlockGuard:
+    """Enter a fresh sub-block of the main program; on enter hand the new
+    block to ``on_enter``, on exit the finished block to ``on_exit``."""
+
+    def __init__(self, on_exit, on_enter=None):
+        self._on_exit = on_exit
+        self._on_enter = on_enter
+
+    def __enter__(self):
+        main = framework.default_main_program()
+        self.block = main._create_block()
+        if self._on_enter is not None:
+            self._on_enter(self.block)
+        return self.block
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        main = framework.default_main_program()
+        main._rollback()
+        if exc_type is None:
+            self._on_exit(self.block)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# While (reference: control_flow.py:697)
+# ---------------------------------------------------------------------------
+
+class While:
+    """``while cond:`` over a sub-block. The condition must be a bool
+    Variable of one element that the body re-writes (e.g. via
+    ``layers.less_than(i, n, cond=cond)``).
+
+    Runs in the Executor's interpreted mode (full dynamism: tensor
+    arrays, data-dependent trip counts, growing shapes). For compiled
+    recurrence use StaticRNN/DynamicRNN.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        enforce(isinstance(cond, Variable), "While cond must be a Variable")
+        enforce(cond.dtype == "bool", "While cond must be bool, got %s"
+                % cond.dtype)
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.is_test = is_test
+
+    def block(self):
+        return _SubBlockGuard(self._complete)
+
+    def _complete(self, sub_block):
+        cond_name = self.cond_var.name
+        outer_read, outer_written = _read_written(sub_block)
+        enforce(cond_name in outer_written,
+                "While body never updates the loop condition %r — the "
+                "loop would not terminate" % cond_name)
+        # carried outputs need an initial value, so they are inputs too
+        in_names = list(dict.fromkeys(
+            outer_read + [n for n in outer_written if n != cond_name]))
+        in_names = [n for n in in_names if n != cond_name]
+        out_names = [n for n in outer_written if n != cond_name]
+        parent = sub_block.parent_block
+        in_vars = [parent._find_var_recursive(n) for n in in_names]
+        enforce(all(v is not None for v in in_vars),
+                "While body reads undeclared variables")
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [cond_name], "X": in_names},
+            outputs={"Out": out_names + [cond_name]},
+            attrs={"sub_block": sub_block.idx,
+                   "in_names": tuple(in_names),
+                   "out_names": tuple(out_names + [cond_name]),
+                   "cond_name": cond_name,
+                   "is_test": self.is_test})
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN (reference: control_flow.py:406) — fixed-length, time-major
+# ---------------------------------------------------------------------------
+
+class StaticRNN:
+    """Fixed-length recurrence over time-major inputs ``[T, batch, ...]``,
+    lowered to one ``lax.scan``::
+
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)               # [batch, d]
+            h_prev = rnn.memory(init=h0)          # carried state
+            h = layers.fc(input=[x_t, h_prev], size=d, act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()                               # [T, batch, d]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._in_step = False
+        self._sub_block = None
+        self._step_inputs = []   # (parent var, sub var)
+        self._memories = []      # [init var, pre var, new var]
+        self._step_outputs = []  # sub vars
+        self._outputs = []       # parent vars (stacked)
+        self.seq_len = None
+
+    # -- step context ------------------------------------------------------
+    def step(self):
+        def on_enter(block):
+            self._in_step = True
+            self._sub_block = block
+
+        return _SubBlockGuard(self._complete, on_enter)
+
+    def _require_in_step(self):
+        enforce(self._in_step and self._sub_block is not None,
+                "call inside `with rnn.step():`")
+
+    # -- recording API -----------------------------------------------------
+    def step_input(self, x):
+        self._require_in_step()
+        enforce(len(x.shape) >= 2 or -1 in x.shape,
+                "step_input needs [T, batch, ...] input")
+        if self.seq_len is None:
+            self.seq_len = x.shape[0]
+        ivar = self._sub_block.create_var(
+            name=framework.unique_name.generate(self.helper.name + ".in"),
+            shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._step_inputs.append((x, ivar))
+        return ivar
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1,
+               dtype="float32"):
+        self._require_in_step()
+        if init is None:
+            enforce(shape is not None and batch_ref is not None,
+                    "memory needs either init= or (shape=, batch_ref=)")
+            # build the boot memory in the PARENT block; if batch_ref is
+            # a step-input slice ([batch, ...]) swap in its parent
+            # sequence var, whose batch dim sits one axis later (after
+            # the time axis)
+            for pv, iv in self._step_inputs:
+                if batch_ref is iv or batch_ref.name == iv.name:
+                    batch_ref = pv
+                    ref_batch_dim_idx += 1
+                    break
+            # resolve a -1 batch dim from the reference when it's
+            # static — keeps downstream shape inference concrete
+            shape = list(shape)
+            if (shape[init_batch_dim_idx] == -1
+                    and len(batch_ref.shape) > ref_batch_dim_idx
+                    and batch_ref.shape[ref_batch_dim_idx] != -1):
+                shape[init_batch_dim_idx] = \
+                    batch_ref.shape[ref_batch_dim_idx]
+            parent = self._sub_block.parent_block
+            init = parent.create_var(
+                name=framework.unique_name.generate(
+                    self.helper.name + ".mem_init"),
+                shape=tuple(shape), dtype=dtype, stop_gradient=True)
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [batch_ref]},
+                outputs={"Out": [init]},
+                attrs={"shape": tuple(shape), "dtype": dtype,
+                       "value": float(init_value),
+                       "input_dim_idx": ref_batch_dim_idx,
+                       "output_dim_idx": init_batch_dim_idx})
+        pre = self._sub_block.create_var(
+            name=framework.unique_name.generate(self.helper.name + ".mem"),
+            shape=tuple(init.shape), dtype=init.dtype)
+        self._memories.append([init, pre, None])
+        return pre
+
+    def update_memory(self, mem, var):
+        self._require_in_step()
+        for rec in self._memories:
+            if rec[1] is mem or rec[1].name == mem.name:
+                rec[2] = var
+                return
+        raise InvalidArgumentError("update_memory: %r is not a memory "
+                                   "of this StaticRNN" % mem.name)
+
+    def step_output(self, o):
+        self._require_in_step()
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- completion --------------------------------------------------------
+    def _complete(self, sub_block):
+        self._in_step = False
+        enforce(self._step_inputs, "StaticRNN needs a step_input")
+        enforce(self._step_outputs, "StaticRNN needs a step_output")
+        for rec in self._memories:
+            enforce(rec[2] is not None,
+                    "memory %r never updated (call update_memory)"
+                    % rec[1].name)
+        parent = sub_block.parent_block
+        outer_read, _w = _read_written(sub_block)
+        consumed = ({v.name for v, _ in self._step_inputs} |
+                    {rec[0].name for rec in self._memories})
+        outer_names = [n for n in outer_read if n not in consumed]
+
+        T = self.seq_len
+        outs = []
+        for o in self._step_outputs:
+            out = parent.create_var(
+                name=framework.unique_name.generate(
+                    self.helper.name + ".out"),
+                shape=(T,) + tuple(o.shape), dtype=o.dtype)
+            outs.append(out)
+        last_mems = []
+        for rec in self._memories:
+            lm = parent.create_var(
+                name=framework.unique_name.generate(
+                    self.helper.name + ".last"),
+                shape=tuple(rec[1].shape), dtype=rec[1].dtype)
+            last_mems.append(lm)
+        self._outputs = outs
+        self._last_mems = last_mems
+
+        parent.append_op(
+            type="static_rnn",
+            inputs={"StepIn": [v.name for v, _ in self._step_inputs],
+                    "Init": [rec[0].name for rec in self._memories],
+                    "X": outer_names},
+            outputs={"Out": [o.name for o in outs],
+                     "LastMem": [m.name for m in last_mems]},
+            attrs={"sub_block": sub_block.idx,
+                   "step_in_names": tuple(i.name for _, i in
+                                          self._step_inputs),
+                   "mem_pre_names": tuple(rec[1].name
+                                          for rec in self._memories),
+                   "mem_new_names": tuple(rec[2].name
+                                          for rec in self._memories),
+                   "out_names": tuple(o.name for o in self._step_outputs),
+                   "outer_names": tuple(outer_names)})
+
+    def __call__(self):
+        enforce(self._outputs, "StaticRNN not completed")
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return list(self._outputs)
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN (reference: control_flow.py:1815) — batch-major padded
+# sequences + explicit lengths (the padded+mask replacement for LoD)
+# ---------------------------------------------------------------------------
+
+class DynamicRNN:
+    """Variable-length recurrence over batch-major padded input
+    ``[batch, max_len, ...]`` with a per-example lengths vector::
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths=seq_len)   # [batch, d]
+            h_prev = drnn.memory(shape=[hid], value=0.0)
+            h = layers.fc(input=[x_t, h_prev], size=hid, act="relu")
+            drnn.update_memory(h_prev, h)
+            drnn.output(h)
+        out = drnn()            # [batch, max_len, hid], zeros past length
+
+    Steps beyond an example's length neither update its memories nor
+    emit output (masked in the scan body), matching the reference's
+    LoD-driven early exit without dynamic shapes.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._rnn = StaticRNN(name=name)
+        self._lengths = None
+
+    def block(self):
+        def on_enter(block):
+            self._rnn._in_step = True
+            self._rnn._sub_block = block
+
+        return _SubBlockGuard(self._complete, on_enter)
+
+    def step_input(self, x, level=0, lengths=None):
+        self._rnn._require_in_step()
+        enforce(len(x.shape) >= 2 or -1 in x.shape,
+                "step_input needs [batch, max_len, ...] input")
+        if self._rnn.seq_len is None:
+            self._rnn.seq_len = x.shape[1]
+        if lengths is not None:
+            self._lengths = lengths
+        ivar = self._rnn._sub_block.create_var(
+            name=framework.unique_name.generate(self.helper.name + ".in"),
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype)
+        self._rnn._step_inputs.append((x, ivar))
+        return ivar
+
+    def static_input(self, x):
+        return x  # non-stepped inputs are closed over from the outer block
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        if init is not None:
+            return self._rnn.memory(init=init)
+        enforce(self._rnn._step_inputs,
+                "call step_input before memory(shape=...) so the batch "
+                "size is known")
+        batch_ref = self._rnn._step_inputs[0][0]
+        return self._rnn.memory(shape=[-1] + list(shape),
+                                batch_ref=batch_ref, init_value=value,
+                                init_batch_dim_idx=0, ref_batch_dim_idx=0,
+                                dtype=dtype)
+
+    def update_memory(self, mem, var):
+        self._rnn.update_memory(mem, var)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self._rnn.step_output(o)
+
+    def _complete(self, sub_block):
+        rnn = self._rnn
+        rnn._in_step = False
+        enforce(rnn._step_inputs, "DynamicRNN needs a step_input")
+        enforce(rnn._step_outputs, "DynamicRNN needs an output")
+        for rec in rnn._memories:
+            enforce(rec[2] is not None,
+                    "memory %r never updated" % rec[1].name)
+        parent = sub_block.parent_block
+        outer_read, _w = _read_written(sub_block)
+        consumed = ({v.name for v, _ in rnn._step_inputs} |
+                    {rec[0].name for rec in rnn._memories})
+        if self._lengths is not None:
+            consumed.add(self._lengths.name)
+        outer_names = [n for n in outer_read if n not in consumed]
+
+        T = rnn.seq_len
+        outs = []
+        for o in rnn._step_outputs:
+            B = o.shape[0] if o.shape else -1
+            out = parent.create_var(
+                name=framework.unique_name.generate(
+                    self.helper.name + ".out"),
+                shape=(B, T) + tuple(o.shape[1:]), dtype=o.dtype)
+            outs.append(out)
+        last_mems = []
+        for rec in rnn._memories:
+            lm = parent.create_var(
+                name=framework.unique_name.generate(
+                    self.helper.name + ".last"),
+                shape=tuple(rec[1].shape), dtype=rec[1].dtype)
+            last_mems.append(lm)
+        rnn._outputs = outs
+        rnn._last_mems = last_mems
+
+        inputs = {"StepIn": [v.name for v, _ in rnn._step_inputs],
+                  "Init": [rec[0].name for rec in rnn._memories],
+                  "X": outer_names}
+        if self._lengths is not None:
+            inputs["SeqLen"] = [self._lengths.name]
+        parent.append_op(
+            type="dynamic_rnn",
+            inputs=inputs,
+            outputs={"Out": [o.name for o in outs],
+                     "LastMem": [m.name for m in last_mems]},
+            attrs={"sub_block": sub_block.idx,
+                   "step_in_names": tuple(i.name for _, i in
+                                          rnn._step_inputs),
+                   "mem_pre_names": tuple(rec[1].name
+                                          for rec in rnn._memories),
+                   "mem_new_names": tuple(rec[2].name
+                                          for rec in rnn._memories),
+                   "out_names": tuple(o.name for o in rnn._step_outputs),
+                   "outer_names": tuple(outer_names)})
+
+    def __call__(self):
+        return self._rnn()
+
+
+# ---------------------------------------------------------------------------
+# IfElse (reference: control_flow.py:1553) — per-example branch, merged
+# with where-selects (both branches computed; static XLA graph)
+# ---------------------------------------------------------------------------
+
+class IfElse:
+    IN_IF_ELSE_TRUE_BLOCKS = 0
+    IN_IF_ELSE_FALSE_BLOCKS = 1
+
+    def __init__(self, cond, name=None):
+        enforce(isinstance(cond, Variable), "IfElse cond must be a Variable")
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._true_outs = []
+        self._false_outs = []
+        self._current = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._current = self._true_outs
+        try:
+            yield
+        finally:
+            self._current = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._current = self._false_outs
+        try:
+            yield
+        finally:
+            self._current = None
+
+    def input(self, x):
+        enforce(self._current is not None,
+                "IfElse.input() must be called inside a branch block")
+        return x
+
+    def output(self, *outs):
+        enforce(self._current is not None,
+                "IfElse.output() must be called inside a branch block")
+        self._current.extend(outs)
+
+    def __call__(self):
+        enforce(len(self._true_outs) == len(self._false_outs),
+                "IfElse branches produced %d vs %d outputs"
+                % (len(self._true_outs), len(self._false_outs)))
+        enforce(self._true_outs, "IfElse produced no outputs")
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            out = self.helper.create_variable_for_type_inference(t.dtype)
+            self.helper.append_op(
+                type="where", inputs={"Condition": [self.cond],
+                                      "X": [t], "Y": [f]},
+                outputs={"Out": [out]})
+            merged.append(out)
+        if len(merged) == 1:
+            return merged[0]
+        return merged
+
+
+# ---------------------------------------------------------------------------
+# Switch (reference: control_flow.py:1264) — first-true-case-wins scalar
+# branching, used by LR schedules; lowered to a nested where chain
+# ---------------------------------------------------------------------------
+
+class Switch:
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []           # (cond var or None, [(var, temp)])
+        self._inside = False
+
+    def __enter__(self):
+        self._inside = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self._merge()
+        self._inside = False
+        return False
+
+    @contextlib.contextmanager
+    def _case_guard(self, cond):
+        enforce(self._inside, "Switch.case used outside `with Switch()`")
+        block = self.helper.main_program.current_block()
+        start = len(block.ops)
+        preexisting = set(block.vars)
+        b = block.parent_block
+        while b is not None:
+            preexisting.update(b.vars)
+            b = b.parent_block
+        yield
+        end = len(block.ops)
+        # Redirect writes to *pre-existing* vars into fresh temps so
+        # cases don't clobber each other before the merge; vars created
+        # inside the case are case-local and stay as-is.
+        writes = {}
+        for op in block.ops[start:end]:
+            # inputs first: a read-modify-write op (increment) must read
+            # the value written by the *previous* op of this case, not
+            # the temp this op is about to define
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [writes.get(n, n) for n in names]
+            for slot, names in op.outputs.items():
+                new_names = []
+                for n in names:
+                    if n in preexisting:
+                        if n not in writes:
+                            v = block._find_var_recursive(n)
+                            tmp = block.create_var(
+                                name=framework.unique_name.generate(
+                                    self.helper.name + ".case"),
+                                shape=tuple(v.shape)
+                                if v is not None else (),
+                                dtype=v.dtype
+                                if v is not None else "float32")
+                            writes[n] = tmp.name
+                        new_names.append(writes[n])
+                    else:
+                        new_names.append(n)
+                op.outputs[slot] = new_names
+        self._cases.append((cond, writes))
+
+    def case(self, condition):
+        return self._case_guard(condition)
+
+    def default(self):
+        return self._case_guard(None)
+
+    def _merge(self):
+        block = self.helper.main_program.current_block()
+        targets = []
+        for _c, writes in self._cases:
+            for n in writes:
+                if n not in targets:
+                    targets.append(n)
+        for n in targets:
+            var = block._find_var_recursive(n)
+            enforce(var is not None,
+                    "Switch case writes to unknown variable %r" % n)
+            # fold cases in reverse: start from the default (or the
+            # var's prior value) and wrap each case cond outside it
+            current = None
+            for cond, writes in self._cases:
+                if cond is None and n in writes:
+                    current = writes[n]
+            if current is None:
+                current = n  # keep prior value when no case matches
+            for cond, writes in reversed(self._cases):
+                if cond is None or n not in writes:
+                    continue
+                out = block.create_var(
+                    name=framework.unique_name.generate(
+                        self.helper.name + ".sel"),
+                    shape=tuple(var.shape), dtype=var.dtype)
+                block.append_op(
+                    type="where",
+                    inputs={"Condition": [cond.name], "X": [writes[n]],
+                            "Y": [current]},
+                    outputs={"Out": [out.name]})
+                current = out.name
+            # final assign back into the target var name
+            block.append_op(type="assign", inputs={"X": [current]},
+                            outputs={"Out": [n]})

@@ -7,26 +7,27 @@ from .. import framework
 from ..layer_helper import LayerHelper
 
 
-def _scalar_to_var(block, value, dtype):
+def _scalar_to_var(value, dtype):
     helper = LayerHelper("scalar")
     out = helper.create_variable_for_type_inference(dtype,
                                                     stop_gradient=True)
-    block.append_op(type="fill_constant", outputs={"Out": [out]},
-                    attrs={"shape": (), "dtype": dtype,
-                           "value": float(value)})
+    helper.append_op(type="fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": (), "dtype": dtype,
+                            "value": float(value)})
     return out
 
 
 def binary(lhs, rhs, op_type, reverse=False):
-    block = lhs.block
+    # Always append to the *current* block (which may be a control-flow
+    # sub-block), not the block the lhs Variable was created in.
     if not isinstance(rhs, framework.Variable):
-        rhs = _scalar_to_var(block, rhs, lhs.dtype)
+        rhs = _scalar_to_var(rhs, lhs.dtype)
     x, y = (rhs, lhs) if reverse else (lhs, rhs)
     helper = LayerHelper(op_type)
     cmp_ops = {"less_than", "less_equal", "greater_than", "greater_equal",
                "equal", "not_equal"}
     out_dtype = "bool" if op_type in cmp_ops else x.dtype
     out = helper.create_variable_for_type_inference(out_dtype)
-    block.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
-                    outputs={"Out": [out]}, attrs={"axis": -1})
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
     return out

@@ -26,3 +26,4 @@ from . import init_ops  # noqa: F401,E402
 from . import nn_ops  # noqa: F401,E402
 from . import optimizer_ops  # noqa: F401,E402
 from . import metric_ops  # noqa: F401,E402
+from . import control_flow_ops  # noqa: F401,E402

@@ -1,0 +1,203 @@
+"""Structured control-flow ops: while, scan-based RNNs, tensor arrays.
+
+Reference: paddle/fluid/operators/controlflow/while_op.cc (runs a
+sub-block via a nested Executor), lod_tensor_array ops
+(controlflow/tensor_array_read_write_op.cc), and the recurrent op
+machinery the reference drives through while+arrays.
+
+TPU-native redesign:
+  - ``static_rnn`` / ``dynamic_rnn`` lower the recorded sub-block through
+    ``lax.scan`` — ONE fused XLA loop, reverse-mode differentiable, with
+    masking replacing the reference's LoD sequence reordering
+    (math/sequence2batch.h). This is the training-path recurrence.
+  - ``while`` interprets its sub-block eagerly (a Python loop over the
+    ops' JAX lowerings) with full dynamism — the analog of the
+    reference's op-by-op interpreter; the Executor automatically drops
+    to eager mode for programs containing it. Inference decode loops
+    that need to be compiled use the dedicated beam-search ops instead.
+  - tensor arrays are Python lists of device arrays (eager mode only);
+    ``lax.scan``'s stacked outputs replace them on the compiled path.
+
+The sub-block is looked up through the tracing-program context
+(framework._trace_program_guard) because op attrs hold only the block
+index — attrs must stay deep-copyable metadata.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from .registry import register
+
+
+def _tracing_block(sub_block):
+    from .. import framework
+    program = framework._current_tracing_program()
+    enforce(program is not None,
+            "control-flow op traced outside an executor/infer-shape "
+            "context (no tracing program set)")
+    return program.block(sub_block)
+
+
+def _run_sub_block(block, env, rng):
+    from .. import executor as _ex
+    _ex.run_block(block, env, rng)
+    return env
+
+
+def _concrete_index(i, what):
+    try:
+        return int(np.asarray(i).reshape(-1)[0])
+    except jax.errors.TracerArrayConversionError:
+        raise InvalidArgumentError(
+            "%s requires a concrete index — tensor-array ops only run in "
+            "eager (interpreted) mode; use static_rnn/dynamic_rnn or "
+            "beam search for compiled loops" % what)
+
+
+# ---------------------------------------------------------------------------
+# while — eager interpreted loop (reference: while_op.cc:59 WhileOp::Run)
+# ---------------------------------------------------------------------------
+
+@register("while", ["Condition", "X*"], ["Out*"], differentiable=False,
+          needs_rng=True)
+def while_op(cond, xs, *, sub_block, in_names, out_names, cond_name,
+             rng, is_test=False):
+    blk = _tracing_block(sub_block)
+    env = dict(zip(in_names, xs))
+    env[cond_name] = cond
+
+    def _alive(c):
+        try:
+            return bool(np.asarray(c).reshape(-1)[0])
+        except jax.errors.TracerBoolConversionError:
+            raise InvalidArgumentError(
+                "While loops interpret their condition eagerly and "
+                "cannot run under jit/scan; use static_rnn/dynamic_rnn "
+                "or beam search for compiled recurrence")
+
+    it = 0
+    while _alive(env[cond_name]):
+        _run_sub_block(blk, env, jax.random.fold_in(rng, it))
+        it += 1
+    return [env[n] for n in out_names]
+
+
+# ---------------------------------------------------------------------------
+# static_rnn — lax.scan over a fixed-length time-major sequence
+# (reference: the recurrent op built by layers.StaticRNN,
+#  python/paddle/fluid/layers/control_flow.py:406)
+# ---------------------------------------------------------------------------
+
+@register("static_rnn", ["StepIn*", "Init*", "X*"], ["Out*", "LastMem*"],
+          needs_rng=True)
+def static_rnn(step_ins, inits, outers, *, sub_block, step_in_names,
+               mem_pre_names, mem_new_names, out_names, outer_names, rng):
+    blk = _tracing_block(sub_block)
+    enforce(len(step_ins) > 0, "StaticRNN needs at least one step_input")
+    seq_len = step_ins[0].shape[0]
+    outer_env = dict(zip(outer_names, outers))
+
+    def body(carry, scanned):
+        t, xs = scanned
+        env = dict(outer_env)
+        env.update(zip(mem_pre_names, carry))
+        env.update(zip(step_in_names, xs))
+        _run_sub_block(blk, env, jax.random.fold_in(rng, t))
+        new_carry = [env[n] for n in mem_new_names]
+        outs = [env[n] for n in out_names]
+        return new_carry, outs
+
+    xs = (jnp.arange(seq_len), list(step_ins))
+    last_mems, ys = jax.lax.scan(body, list(inits), xs)
+    return list(ys), list(last_mems)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_rnn — lax.scan over batch-major padded sequences + length mask
+# (replaces the reference's LoD-driven DynamicRNN; variable length is
+#  carried as an explicit lengths vector, the padded+mask redesign of
+#  lod_tensor.h:110)
+# ---------------------------------------------------------------------------
+
+def _mask_like(active, val):
+    # active: [batch] bool -> broadcastable to val [batch, ...]
+    return active.reshape(active.shape + (1,) * (val.ndim - 1))
+
+
+@register("dynamic_rnn", ["StepIn*", "Init*", "SeqLen", "X*"],
+          ["Out*", "LastMem*"], nondiff=("SeqLen",), needs_rng=True)
+def dynamic_rnn(step_ins, inits, seq_len, outers, *, sub_block,
+                step_in_names, mem_pre_names, mem_new_names, out_names,
+                outer_names, rng):
+    blk = _tracing_block(sub_block)
+    enforce(len(step_ins) > 0, "DynamicRNN needs at least one step_input")
+    max_len = step_ins[0].shape[1]
+    outer_env = dict(zip(outer_names, outers))
+    # scan wants time-major
+    xs_tm = [jnp.moveaxis(x, 1, 0) for x in step_ins]
+
+    def body(carry, scanned):
+        t, xs = scanned
+        env = dict(outer_env)
+        env.update(zip(mem_pre_names, carry))
+        env.update(zip(step_in_names, xs))
+        _run_sub_block(blk, env, jax.random.fold_in(rng, t))
+        if seq_len is not None:
+            active = t < seq_len  # [batch] bool
+            new_carry = [jnp.where(_mask_like(active, n), n, p)
+                         for p, n in zip(carry,
+                                         (env[m] for m in mem_new_names))]
+            outs = [jnp.where(_mask_like(active, env[n]), env[n],
+                              jnp.zeros_like(env[n]))
+                    for n in out_names]
+        else:
+            new_carry = [env[n] for n in mem_new_names]
+            outs = [env[n] for n in out_names]
+        return new_carry, outs
+
+    xs = (jnp.arange(max_len), xs_tm)
+    last_mems, ys = jax.lax.scan(body, list(inits), xs)
+    # back to batch-major
+    return ([jnp.moveaxis(y, 0, 1) for y in ys], list(last_mems))
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (reference: controlflow/tensor_array_read_write_op.cc,
+# LoDTensorArray framework/lod_tensor_array.h) — eager mode only
+# ---------------------------------------------------------------------------
+
+@register("create_array", [], ["Out"], differentiable=False)
+def create_array(*, dtype="float32"):
+    return []
+
+
+@register("array_write", ["X", "I", "Array"], ["Out"],
+          differentiable=False, nondiff=("I", "Array"))
+def array_write(x, i, array):
+    arr = list(array) if array is not None else []
+    idx = _concrete_index(i, "array_write")
+    enforce(0 <= idx <= len(arr),
+            "array_write index %d out of range [0, %d]" % (idx, len(arr)))
+    if idx == len(arr):
+        arr.append(x)
+    else:
+        arr[idx] = x
+    return arr
+
+
+@register("array_read", ["Array", "I"], ["Out"], differentiable=False,
+          nondiff=("I",))
+def array_read(array, i):
+    idx = _concrete_index(i, "array_read")
+    enforce(0 <= idx < len(array),
+            "array_read index %d out of range [0, %d)" % (idx, len(array)))
+    return array[idx]
+
+
+@register("array_length", ["Array"], ["Out"], differentiable=False)
+def array_length(array):
+    return jnp.asarray([len(array)], dtype=jnp.int64)

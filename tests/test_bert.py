@@ -22,6 +22,7 @@ def test_bert_pretrain_step_runs_and_learns():
     cfg = _tiny_cfg()
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
+    startup.random_seed = 1
     with fluid.program_guard(main, startup):
         total, mlm_loss, nsp_acc = bert.bert_pretrain(cfg)
         optimizer.Adam(5e-3).minimize(total)
@@ -41,6 +42,7 @@ def test_bert_classifier_trains():
     cfg = _tiny_cfg(seq_len=12)
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 2
+    startup.random_seed = 2
     with fluid.program_guard(main, startup):
         loss, acc, probs = bert.bert_classifier(cfg, num_classes=2)
         optimizer.Adam(5e-3).minimize(loss)
@@ -97,6 +99,7 @@ def test_bert_tp_sharding_runs():
     cfg = _tiny_cfg()
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 3
+    startup.random_seed = 3
     with fluid.program_guard(main, startup):
         total, _, _ = bert.bert_pretrain(cfg)
         optimizer.Adam(1e-3).minimize(total)

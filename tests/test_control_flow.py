@@ -1,0 +1,246 @@
+"""Control-flow tests (reference: test_while_op.py, test_array_read_write
+_op.py, test_switch.py, test_dyn_rnn.py, test_rnn_memory_helper_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_sums_to_n():
+    """Classic while: accumulate i into s until i == 10 (reference:
+    test_while_op.py semantics)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=10)
+        s = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond=cond)
+        with w.block():
+            s2 = s + layers.cast(i, "float32")
+            layers.assign(s2, s)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    (out,) = _run(main, startup, {}, [s])
+    assert float(out[0]) == sum(range(10))
+
+
+def test_while_with_tensor_array():
+    """While writing into a tensor array, then reading back."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=5)
+        arr = layers.create_array("float32")
+        cond = layers.less_than(i, n)
+        w = layers.While(cond=cond)
+        with w.block():
+            val = layers.cast(i, "float32") * 2.0
+            layers.array_write(val, i, array=arr)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        length = layers.array_length(arr)
+        idx = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        third = layers.array_read(arr, idx)
+    ln, third_v = _run(main, startup, {}, [length, third])
+    assert int(ln[0]) == 5
+    np.testing.assert_allclose(third_v, [6.0])
+
+
+def test_array_read_write_static_indices():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        i0 = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        arr = layers.array_write(x, i0)
+        layers.array_write(x * 2.0, i1, array=arr)
+        a = layers.array_read(arr, i0)
+        b = layers.array_read(arr, i1)
+        s = a + b
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    (out,) = _run(main, startup, {"x": xv}, [s])
+    np.testing.assert_allclose(out, xv * 3.0, rtol=1e-6)
+
+
+def test_static_rnn_cumsum():
+    """StaticRNN accumulating step inputs == cumsum along time."""
+    T, B, D = 4, 3, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            acc = rnn.memory(shape=[-1, D], batch_ref=x_t,
+                             init_value=0.0, ref_batch_dim_idx=0,
+                             init_batch_dim_idx=0)
+            new = acc + x_t
+            rnn.update_memory(acc, new)
+            rnn.step_output(new)
+        out = rnn()
+    xv = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+    (ov,) = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(ov, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_fc_trains():
+    """StaticRNN with a parameter inside the step: grads flow through
+    lax.scan to the outer parameter."""
+    T, B, D, H = 5, 4, 3, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[-1, H], batch_ref=x_t,
+                                init_value=0.0, ref_batch_dim_idx=0,
+                                init_batch_dim_idx=0)
+            h = layers.fc(input=[x_t, h_prev], size=H, act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = layers.reduce_mean(out * out)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(T, B, D).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xv},
+                            fetch_list=[loss])[0])
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_dynamic_rnn_masks_past_length():
+    """DynamicRNN: outputs past an example's length are zero; memory
+    freezes at the last valid step."""
+    B, T, D = 3, 5, 2
+    lengths = np.array([5, 2, 3], np.int32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths=ln)
+            acc = drnn.memory(shape=[D], value=0.0)
+            new = acc + x_t
+            drnn.update_memory(acc, new)
+            drnn.output(new)
+        out = drnn()
+    xv = np.ones((B, T, D), np.float32)
+    (ov,) = _run(main, startup, {"x": xv, "len": lengths}, [out])
+    # row 0: cumsum 1..5; row 1: steps 3..5 masked to zero
+    np.testing.assert_allclose(ov[0, :, 0], [1, 2, 3, 4, 5])
+    np.testing.assert_allclose(ov[1, :, 0], [1, 2, 0, 0, 0])
+    np.testing.assert_allclose(ov[2, :, 0], [1, 2, 3, 0, 0])
+
+
+def test_ifelse_per_row_select():
+    """IfElse merges branch outputs row-wise by cond (reference:
+    test_ifelse.py semantics, static-shape redesign)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 1], append_batch_size=False)
+        zero = layers.fill_constant(shape=[4, 1], dtype="float32",
+                                    value=0.0)
+        cond = layers.greater_than(x, zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(d * 2.0)
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(d - 1.0)
+        out = ie()
+    xv = np.array([[1.0], [-1.0], [2.0], [-3.0]], np.float32)
+    (ov,) = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(ov, [[2.0], [-2.0], [4.0], [-4.0]])
+
+
+def test_switch_first_case_wins():
+    """Switch picks the first true case (reference: test_switch.py)."""
+    for xval, expect in [(0.5, 10.0), (1.5, 20.0), (5.0, 30.0)]:
+        fluid.framework._reset_default_programs()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[1], append_batch_size=False)
+            one = layers.fill_constant([1], "float32", 1.0)
+            two = layers.fill_constant([1], "float32", 2.0)
+            out = layers.create_global_var([1], 0.0, "float32",
+                                           persistable=False)
+            with layers.Switch() as switch:
+                with switch.case(layers.less_than(x, one)):
+                    layers.assign(layers.fill_constant([1], "float32",
+                                                       10.0), out)
+                with switch.case(layers.less_than(x, two)):
+                    layers.assign(layers.fill_constant([1], "float32",
+                                                       20.0), out)
+                with switch.default():
+                    layers.assign(layers.fill_constant([1], "float32",
+                                                       30.0), out)
+        exe = fluid.Executor()
+        exe.run(startup)
+        (ov,) = exe.run(main, feed={"x": np.array([xval], np.float32)},
+                        fetch_list=[out])
+        assert float(ov[0]) == expect, (xval, float(ov[0]))
+
+
+def test_nested_while():
+    """While inside While (multiplication table sum)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        s = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond=cond)
+        with w.block():
+            j = layers.fill_constant(shape=[1], dtype="int32", value=0)
+            cond2 = layers.less_than(j, n)
+            w2 = layers.While(cond=cond2)
+            with w2.block():
+                prod = layers.cast(i, "float32") * layers.cast(
+                    j, "float32")
+                layers.assign(s + prod, s)
+                layers.increment(j, value=1, in_place=True)
+                layers.less_than(j, n, cond=cond2)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    (out,) = _run(main, startup, {}, [s])
+    expect = sum(i * j for i in range(3) for j in range(3))
+    assert float(out[0]) == expect
+
+
+def test_switch_read_modify_write_case():
+    """A case op that reads and writes the same pre-existing var
+    (in-place increment) must see the pre-case value."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1], append_batch_size=False)
+        one = layers.fill_constant([1], "float32", 1.0)
+        out = layers.fill_constant([1], "float32", 5.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(x, one)):
+                layers.increment(out, value=2.0, in_place=True)
+            with switch.default():
+                layers.increment(out, value=10.0, in_place=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    (a,) = exe.run(main, feed={"x": np.array([0.0], np.float32)},
+                   fetch_list=[out])
+    (b,) = exe.run(main, feed={"x": np.array([2.0], np.float32)},
+                   fetch_list=[out])
+    assert float(a[0]) == 7.0
+    assert float(b[0]) == 15.0

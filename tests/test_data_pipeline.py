@@ -79,6 +79,7 @@ def test_pyreader_end_to_end_training():
     """PyReader pumps synthetic mnist through a full training loop."""
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 5
+    startup.random_seed = 5
     with fluid.program_guard(main, startup):
         img = layers.data("img", shape=[784])
         label = layers.data("label", shape=[1], dtype="int64")

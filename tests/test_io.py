@@ -17,6 +17,7 @@ from paddle_tpu.io import (deserialize_tensor, load_inference_model,
 def _build_and_train(steps=5, seed=0):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = seed
+    startup.random_seed = seed
     with fluid.program_guard(main, startup):
         x = layers.data("x", shape=[4])
         y = layers.data("y", shape=[1])

@@ -95,6 +95,7 @@ def test_scheduler_drives_optimizer():
     """Schedule output feeds Optimizer(learning_rate=Variable)."""
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 0
+    startup.random_seed = 0
     with fluid.program_guard(main, startup):
         x = layers.data("x", shape=[4])
         y = layers.data("y", shape=[1])

@@ -23,6 +23,7 @@ def test_mnist_mlp_trains():
     main = fluid.Program()
     startup = fluid.Program()
     main.random_seed = 42
+    startup.random_seed = 42
     with fluid.program_guard(main, startup):
         img = layers.data("img", shape=[784])
         label = layers.data("label", shape=[1], dtype="int64")
@@ -46,6 +47,7 @@ def test_mnist_cnn_trains():
     main = fluid.Program()
     startup = fluid.Program()
     main.random_seed = 1
+    startup.random_seed = 1
     with fluid.program_guard(main, startup):
         img = layers.data("img", shape=[784])
         label = layers.data("label", shape=[1], dtype="int64")

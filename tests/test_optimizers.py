@@ -13,6 +13,7 @@ def _build(opt):
     main = fluid.Program()
     startup = fluid.Program()
     main.random_seed = 7
+    startup.random_seed = 7
     with fluid.program_guard(main, startup):
         x = layers.data("x", shape=[4], append_batch_size=False)
         w = layers.create_parameter(shape=(4,), dtype="float32", name="w")

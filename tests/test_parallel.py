@@ -78,6 +78,7 @@ def test_multi_axis_mesh_runs():
     main = fluid.Program()
     startup = fluid.Program()
     main.random_seed = 5
+    startup.random_seed = 5
     with fluid.program_guard(main, startup):
         x = layers.data("x", shape=[16])
         label = layers.data("label", shape=[1], dtype="int64")

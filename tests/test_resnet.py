@@ -21,6 +21,7 @@ def _synthetic_images(rng, batch=8, hw=32, classes=10):
 def test_resnet_cifar_trains():
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 3
+    startup.random_seed = 3
     with fluid.program_guard(main, startup):
         img = layers.data("img", shape=[3, 32, 32])
         label = layers.data("label", shape=[1], dtype="int64")
