@@ -19,6 +19,14 @@ from .learning_rate_scheduler import (cosine_decay,  # noqa: F401
 from .metric_op import accuracy, auc  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .rnn import dynamic_gru, dynamic_lstm, gru_unit, lstm_unit  # noqa: F401
+from .sequence import (beam_search, beam_search_decode,  # noqa: F401
+                       sequence_concat, sequence_enumerate,  # noqa: F401
+                       sequence_expand, sequence_expand_as,
+                       sequence_first_step, sequence_last_step,
+                       sequence_pad, sequence_pool, sequence_reverse,
+                       sequence_slice, sequence_softmax,
+                       sequence_unpad)
 from .tensor import (assign, cast, concat, create_global_var,  # noqa: F401
                      create_parameter, create_tensor, diag, eye,
                      fill_constant, fill_constant_batch_size_like,

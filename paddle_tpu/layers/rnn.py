@@ -1,0 +1,125 @@
+"""Recurrent layers: dynamic_lstm / dynamic_gru / lstm_unit / gru_unit.
+
+Reference: python/paddle/fluid/layers/nn.py (dynamic_lstm:443,
+dynamic_gru, lstm_unit, gru_unit). Input layout is the padded+lengths
+redesign — [batch, max_len, gates*hidden] pre-projected input plus an
+optional per-row ``seq_len`` vector (see ops/rnn_ops.py for equations
+and the lax.scan lowering)."""
+
+from __future__ import annotations
+
+from ..core.enforce import enforce
+from ..layer_helper import LayerHelper
+
+__all__ = ["dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit"]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 seq_len=None):
+    """``input``: [B, T, 4*hidden] (apply fc(input, 4*hidden) first, as
+    in the reference); ``size`` = 4*hidden. Returns (hidden, cell),
+    each [B, T, hidden]."""
+    enforce(size % 4 == 0, "dynamic_lstm size must be 4*hidden_size")
+    helper = LayerHelper("lstm", name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(attr=param_attr,
+                                     shape=(hidden, 4 * hidden),
+                                     dtype=dtype)
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    bias = helper.create_parameter(attr=bias_attr, shape=(1, bias_size),
+                                   dtype=dtype, is_bias=True)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    out_h = helper.create_variable_for_type_inference(dtype)
+    out_c = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="lstm", inputs=inputs,
+        outputs={"Hidden": [out_h], "Cell": [out_c],
+                 "LastH": [last_h], "LastC": [last_c]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return out_h, out_c
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None,
+                dtype="float32", seq_len=None):
+    """``input``: [B, T, 3*size] pre-projected; ``size`` = hidden.
+    Returns hidden [B, T, size]."""
+    helper = LayerHelper("gru", name=name)
+    weight = helper.create_parameter(attr=param_attr,
+                                     shape=(size, 3 * size), dtype=dtype)
+    bias = helper.create_parameter(attr=bias_attr, shape=(1, 3 * size),
+                                   dtype=dtype, is_bias=True)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    out_h = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gru", inputs=inputs,
+        outputs={"Hidden": [out_h], "LastH": [last_h]},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "candidate_activation": candidate_activation})
+    return out_h
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference: nn.py lstm_unit): one fc over
+    concat([x_t, h_prev]) — param_attr/bias_attr govern that single
+    weight, exactly as in the reference — then the cell math.
+    Returns (hidden, cell)."""
+    from . import nn
+    from .tensor import concat
+    helper = LayerHelper("lstm_unit", name=name)
+    hidden = hidden_t_prev.shape[-1]
+    proj = nn.fc(concat([x_t, hidden_t_prev], axis=1),
+                 size=4 * hidden, param_attr=param_attr,
+                 bias_attr=bias_attr)
+    out_h = helper.create_variable_for_type_inference(x_t.dtype)
+    out_c = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [proj], "HPrev": [hidden_t_prev],
+                "CPrev": [cell_t_prev]},
+        outputs={"H": [out_h], "C": [out_c]},
+        attrs={"forget_bias": float(forget_bias)})
+    return out_h, out_c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", name=None):
+    """Single GRU step (reference: nn.py gru_unit). ``input``:
+    [B, 3*size] pre-projected. Returns new hidden [B, size]."""
+    helper = LayerHelper("gru_unit", name=name)
+    weight = helper.create_parameter(attr=param_attr,
+                                     shape=(size, 3 * size),
+                                     dtype=input.dtype)
+    bias = helper.create_parameter(attr=bias_attr, shape=(1, 3 * size),
+                                   dtype=input.dtype, is_bias=True)
+    out_h = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="gru_unit",
+        inputs={"X": [input], "HPrev": [hidden], "Weight": [weight],
+                "Bias": [bias]},
+        outputs={"H": [out_h]},
+        attrs={"gate_activation": gate_activation,
+               "activation": activation})
+    return out_h

@@ -1,0 +1,178 @@
+"""Sequence layers over padded tensors + explicit lengths (reference:
+the sequence_* functions in python/paddle/fluid/layers/nn.py and
+sequence_ops/ — LoD-based there, padded+lengths here; see
+ops/sequence_ops.py for the representation contract)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["sequence_pool", "sequence_softmax", "sequence_reverse",
+           "sequence_expand", "sequence_expand_as", "sequence_pad",
+           "sequence_unpad", "sequence_concat", "sequence_slice",
+           "sequence_enumerate", "sequence_first_step",
+           "sequence_last_step", "beam_search", "beam_search_decode"]
+
+
+def _seq_op(op_type, x, seq_len, attrs=None, name=None,
+            extra_inputs=None, out_dtype=None):
+    helper = LayerHelper(op_type, name=name)
+    inputs = {"X": [x]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    out = helper.create_variable_for_type_inference(out_dtype or x.dtype)
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs or {})
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  seq_len=None):
+    return _seq_op("sequence_pool", input, seq_len,
+                   {"pool_type": pool_type, "pad_value": pad_value})
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_len=None):
+    return _seq_op("sequence_softmax", input, seq_len, name=name)
+
+
+def sequence_reverse(x, name=None, seq_len=None):
+    return _seq_op("sequence_reverse", x, seq_len, name=name)
+
+
+def sequence_first_step(input, seq_len=None):
+    return _seq_op("sequence_first_step", input, seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return _seq_op("sequence_last_step", input, seq_len)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, y_seq_len=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    inputs = {"X": [x], "Y": [y]}
+    if y_seq_len is not None:
+        inputs["SeqLenY"] = [y_seq_len]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_expand_as(x, y, name=None, y_seq_len=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    inputs = {"X": [x], "Y": [y]}
+    if y_seq_len is not None:
+        inputs["SeqLenY"] = [y_seq_len]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand_as", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, name=None, seq_len=None):
+    """Returns (padded, lengths) like the reference (sequence_pad_op)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    inputs = {"X": [x]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int32")
+    length.stop_gradient = True
+    helper.append_op(type="sequence_pad", inputs=inputs,
+                     outputs={"Out": [out], "Length": [length]},
+                     attrs={"pad_value": float(pad_value),
+                            "padded_length": maxlen if maxlen else -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_concat(input, name=None, seq_lens=None):
+    """``input``: list of [B, Ti, ...] vars; ``seq_lens``: matching list
+    of length vars (or None). Returns (concatenated, out_lengths)."""
+    helper = LayerHelper("sequence_concat", name=name)
+    if seq_lens is None:
+        seq_lens = []
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    out_len.stop_gradient = True
+    helper.append_op(type="sequence_concat",
+                     inputs={"X": input, "SeqLen": seq_lens},
+                     outputs={"Out": [out], "OutLen": [out_len]})
+    return out, out_len
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       seq_len=None):
+    return _seq_op("sequence_enumerate", input, seq_len,
+                   {"win_size": win_size, "pad_value": pad_value},
+                   name=name)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=False, name=None,
+                return_parent_idx=True):
+    """One dense beam-search step (reference: layers/nn.py beam_search
+    -> beam_search_op.cc; fixed-width [batch, beam] redesign — see
+    ops/beam_search_ops.py). ``ids`` is accepted for signature parity
+    but unused: candidates are the full vocab axis of ``scores``
+    ([batch, beam, vocab] log-probs)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    sel_scores = helper.create_variable_for_type_inference(
+        pre_scores.dtype)
+    parent_idx = helper.create_variable_for_type_inference("int32")
+    for v in (sel_ids, parent_idx):
+        v.stop_gradient = True
+    helper.append_op(
+        type="beam_search",
+        inputs={"PreIds": [pre_ids], "PreScores": [pre_scores],
+                "Scores": [scores]},
+        outputs={"SelectedIds": [sel_ids],
+                 "SelectedScores": [sel_scores],
+                 "ParentIdx": [parent_idx]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, parents, scores, beam_size=0, end_id=0,
+                       name=None):
+    """Backtrack decode-loop tensor arrays into [batch, beam, T]
+    sequences sorted best-first (reference: layers/nn.py
+    beam_search_decode -> beam_search_decode_op.cc)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference(ids.dtype)
+    sent_scores = helper.create_variable_for_type_inference(
+        scores.dtype)
+    sent_ids.stop_gradient = True
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Parents": [parents], "Scores": [scores]},
+        outputs={"SentenceIds": [sent_ids],
+                 "SentenceScores": [sent_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id})
+    return sent_ids, sent_scores

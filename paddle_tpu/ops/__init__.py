@@ -27,3 +27,6 @@ from . import nn_ops  # noqa: F401,E402
 from . import optimizer_ops  # noqa: F401,E402
 from . import metric_ops  # noqa: F401,E402
 from . import control_flow_ops  # noqa: F401,E402
+from . import sequence_ops  # noqa: F401,E402
+from . import rnn_ops  # noqa: F401,E402
+from . import beam_search_ops  # noqa: F401,E402

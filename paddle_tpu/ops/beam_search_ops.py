@@ -1,0 +1,108 @@
+"""Beam search ops — fixed-width dense redesign.
+
+Reference: paddle/fluid/operators/beam_search_op.{cc,h} +
+math/beam_search.{cc,cu} (one selection step over LoD candidate lists)
+and beam_search_decode_op.cc (backtracks the id/parent arrays into
+final sequences).
+
+TPU-native redesign: the reference prunes beams dynamically through
+LoD offsets — dynamic shapes XLA can't compile. Here the beam is a
+dense, fixed ``[batch, beam_size]`` frontier:
+  - finished beams (last id == end_id) survive as "continue with
+    end_id" candidates carrying their score unchanged;
+  - each step flattens [batch, beam, vocab] -> top-k over beam*vocab
+    (ONE xla top-k, MXU-adjacent, no host sync);
+  - ``beam_search_decode`` backtracks parent pointers. It accepts the
+    eager-mode tensor arrays written inside a While loop (fluid
+    parity) — and the same functions compose under lax.scan for the
+    fully-jitted fast path (models/transformer fast decode).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from .registry import register
+
+
+def beam_search_step(pre_ids, pre_scores, scores, *, beam_size, end_id,
+                     is_accumulated=False):
+    """One dense beam-search step. pre_ids/pre_scores: [B, K];
+    scores: [B, K, V] log-probs — per-step (the op adds pre_scores)
+    unless ``is_accumulated``, in which case they are already full-path
+    totals and are used directly (reference: beam_search_op.cc attr of
+    the same name; the default differs because reference users
+    pre-accumulate with elementwise ops while models here pass raw
+    log-softmax output). Returns (ids [B,K], total_scores [B,K],
+    parent_idx [B,K])."""
+    B, K, V = scores.shape
+    neg_inf = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+    finished = pre_ids == end_id  # [B, K]
+    if is_accumulated:
+        total = scores
+    else:
+        total = pre_scores[..., None] + scores
+    # finished beams: only the end_id continuation is allowed, and it
+    # keeps the already-accumulated score
+    keep = jnp.full((V,), False).at[end_id].set(True)
+    total = jnp.where(
+        finished[..., None],
+        jnp.where(keep, pre_scores[..., None],
+                  jnp.full_like(total, neg_inf)),
+        total)
+    flat = total.reshape(B, K * V)
+    sel_scores, flat_idx = jax.lax.top_k(flat, beam_size)
+    parent = (flat_idx // V).astype(jnp.int32)
+    ids = (flat_idx % V).astype(pre_ids.dtype)
+    return ids, sel_scores, parent
+
+
+@register("beam_search", ["PreIds", "PreScores", "Scores"],
+          ["SelectedIds", "SelectedScores", "ParentIdx"],
+          differentiable=False)
+def beam_search(pre_ids, pre_scores, scores, *, beam_size, end_id,
+                level=0, is_accumulated=False):
+    return beam_search_step(pre_ids, pre_scores, scores,
+                            beam_size=beam_size, end_id=end_id,
+                            is_accumulated=is_accumulated)
+
+
+def beam_search_backtrack(ids_steps, parent_steps, scores, *, end_id):
+    """Backtrack T steps of [B, K] ids + parent pointers into full
+    sequences [B, K, T] ordered best-first by final score."""
+    T = len(ids_steps)
+    ids_steps = [jnp.asarray(s) for s in ids_steps]
+    parent_steps = [jnp.asarray(s) for s in parent_steps]
+    B, K = ids_steps[0].shape
+    bidx = jnp.arange(B)[:, None]
+    seqs = []
+    beam = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+    for t in range(T - 1, -1, -1):
+        seqs.append(ids_steps[t][bidx, beam])
+        beam = parent_steps[t][bidx, beam]
+    seqs.reverse()
+    out = jnp.stack(seqs, axis=-1)  # [B, K, T]
+    order = jnp.argsort(-scores, axis=1)
+    out = jnp.take_along_axis(out, order[..., None], axis=1)
+    sorted_scores = jnp.take_along_axis(scores, order, axis=1)
+    return out, sorted_scores
+
+
+@register("beam_search_decode", ["Ids", "Parents", "Scores"],
+          ["SentenceIds", "SentenceScores"], differentiable=False)
+def beam_search_decode(ids_array, parents_array, scores, *, beam_size=0,
+                       end_id=0):
+    """Ids/Parents are tensor arrays (lists of [B, K] steps) written by
+    a While decode loop; Scores is the final [B, K] accumulated score
+    (reference: beam_search_decode_op.cc)."""
+    enforce(isinstance(ids_array, (list, tuple)) and
+            isinstance(parents_array, (list, tuple)),
+            "beam_search_decode expects tensor arrays (use array_write "
+            "inside the decode While loop)")
+    enforce(len(ids_array) == len(parents_array),
+            "Ids and Parents arrays must have equal length")
+    return beam_search_backtrack(list(ids_array), list(parents_array),
+                                 jnp.asarray(scores), end_id=end_id)

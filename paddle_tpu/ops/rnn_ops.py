@@ -1,0 +1,198 @@
+"""Recurrent ops: LSTM / GRU over padded batch-major sequences.
+
+Reference: paddle/fluid/operators/lstm_op.{cc,h} (dynamic_lstm),
+gru_op.{cc,h} (dynamic_gru), lstm_unit_op.cc, gru_unit_op.cc and the
+kernel library paddle/fluid/operators/math/{lstm_compute,gru_compute,
+sequence2batch}.h.
+
+TPU-native redesign: the reference reorders variable-length LoD
+sequences into time-batched dense chunks (sequence2batch) and runs a
+hand-written fused cell kernel per time step. Here sequences are padded
+``[batch, max_len, ...]`` with an explicit per-example ``lengths``
+vector; the whole recurrence is ONE ``lax.scan`` whose body is the cell
+math — XLA fuses the gate arithmetic into the matmul, and steps past an
+example's length neither update state nor emit output (masked), which
+reproduces the LoD semantics with static shapes.
+
+Gate layout convention (documented, differs from the reference's
+internal [c,i,f,o] buffer layout): the projected input and the
+hidden-hidden weight produce gates ordered ``[i, f, c, o]`` for LSTM and
+``[u, r, c]`` for GRU. Equations follow the reference docs:
+
+  LSTM (peepholes optional, lstm_op.cc doc block):
+    i_t = sig(x_i + h_{t-1} W_i + w_ic * c_{t-1} + b_i)
+    f_t = sig(x_f + h_{t-1} W_f + w_fc * c_{t-1} + b_f)
+    c~  = tanh(x_c + h_{t-1} W_c + b_c)
+    c_t = f_t * c_{t-1} + i_t * c~
+    o_t = sig(x_o + h_{t-1} W_o + w_oc * c_t + b_o)
+    h_t = o_t * tanh(c_t)
+
+  GRU (gru_op.cc doc block):
+    u_t = sig(x_u + h_{t-1} W_u + b_u)
+    r_t = sig(x_r + h_{t-1} W_r + b_r)
+    c~  = tanh(x_c + (r_t * h_{t-1}) W_c + b_c)
+    h_t = (1 - u_t) * h_{t-1} + u_t * c~
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import enforce
+from .registry import register
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _mask(active, val):
+    return active.reshape(active.shape + (1,) * (val.ndim - 1))
+
+
+def _scan_rnn(cell, x, states, seq_len, is_reverse):
+    """Run ``cell(x_t, states) -> (new_states, out)`` over time with
+    length masking. x: [B, T, D] batch-major. Returns (outs [B,T,H],
+    last_states)."""
+    from .sequence_ops import reverse_valid_prefix
+    B, T = x.shape[0], x.shape[1]
+    if is_reverse:
+        x = reverse_valid_prefix(x, seq_len)
+    xs = jnp.moveaxis(x, 1, 0)  # [T, B, D]
+
+    def body(carry, scanned):
+        t, x_t = scanned
+        new_states, out = cell(x_t, carry)
+        if seq_len is not None:
+            active = t < seq_len
+            new_states = tuple(
+                jnp.where(_mask(active, n), n, p)
+                for p, n in zip(carry, new_states))
+            out = jnp.where(_mask(active, out), out,
+                            jnp.zeros_like(out))
+        return new_states, out
+
+    last, ys = jax.lax.scan(body, states, (jnp.arange(T), xs))
+    ys = jnp.moveaxis(ys, 0, 1)  # [B, T, H]
+    if is_reverse:
+        ys = reverse_valid_prefix(ys, seq_len)
+    return ys, last
+
+
+@register("lstm", ["Input", "H0", "C0", "Weight", "Bias", "SeqLen"],
+          ["Hidden", "Cell", "LastH", "LastC"], nondiff=("SeqLen",))
+def lstm(x, h0, c0, weight, bias, seq_len, *, use_peepholes=False,
+         is_reverse=False, gate_activation="sigmoid",
+         cell_activation="tanh", candidate_activation="tanh"):
+    """x: [B, T, 4H] (pre-projected input), weight: [H, 4H] hidden-hidden,
+    bias: [4H] (+[3H] peepholes w_ic,w_fc,w_oc when use_peepholes)."""
+    B, T, H4 = x.shape
+    H = H4 // 4
+    enforce(weight.shape == (H, 4 * H),
+            "lstm weight must be [H, 4H], got %s" % (weight.shape,))
+    gact = _ACT[gate_activation]
+    cact = _ACT[cell_activation]
+    candact = _ACT[candidate_activation]
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), x.dtype)
+    b_gates = bias[..., :4 * H].reshape(4 * H) if bias is not None else 0.0
+    if use_peepholes and bias is not None:
+        peep = bias.reshape(-1)[4 * H:]
+        w_ic, w_fc, w_oc = peep[:H], peep[H:2 * H], peep[2 * H:3 * H]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    def cell(x_t, states):
+        h_prev, c_prev = states
+        gates = x_t + h_prev @ weight + b_gates
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + w_ic * c_prev
+            gf = gf + w_fc * c_prev
+        i = gact(gi)
+        f = gact(gf)
+        c = f * c_prev + i * candact(gc)
+        if w_oc is not None:
+            go = go + w_oc * c
+        o = gact(go)
+        h = o * cact(c)
+        return (h, c), jnp.concatenate([h, c], axis=-1)
+
+    hc, (last_h, last_c) = _scan_rnn(cell, x, (h0, c0), seq_len,
+                                     is_reverse)
+    hidden, cellv = hc[..., :H], hc[..., H:]
+    return hidden, cellv, last_h, last_c
+
+
+@register("gru", ["Input", "H0", "Weight", "Bias", "SeqLen"],
+          ["Hidden", "LastH"], nondiff=("SeqLen",))
+def gru(x, h0, weight, bias, seq_len, *, is_reverse=False,
+        gate_activation="sigmoid", candidate_activation="tanh"):
+    """x: [B, T, 3H] (pre-projected), weight: [H, 3H] hidden-hidden laid
+    out as [W_u | W_r | W_c], bias: [3H]."""
+    B, T, H3 = x.shape
+    H = H3 // 3
+    enforce(weight.shape == (H, 3 * H),
+            "gru weight must be [H, 3H], got %s" % (weight.shape,))
+    gact = _ACT[gate_activation]
+    candact = _ACT[candidate_activation]
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    b = bias.reshape(3 * H) if bias is not None else jnp.zeros(3 * H,
+                                                               x.dtype)
+    w_ur, w_c = weight[:, :2 * H], weight[:, 2 * H:]
+
+    def cell(x_t, states):
+        (h_prev,) = states
+        x_ur, x_c = x_t[..., :2 * H], x_t[..., 2 * H:]
+        ur = gact(x_ur + h_prev @ w_ur + b[:2 * H])
+        u, r = ur[..., :H], ur[..., H:]
+        c = candact(x_c + (r * h_prev) @ w_c + b[2 * H:])
+        h = (1.0 - u) * h_prev + u * c
+        return (h,), h
+
+    hidden, (last_h,) = _scan_rnn(cell, x, (h0,), seq_len, is_reverse)
+    return hidden, last_h
+
+
+@register("lstm_unit", ["X", "HPrev", "CPrev", "Weight", "Bias"],
+          ["H", "C"])
+def lstm_unit(x, h_prev, c_prev, weight, bias, *, forget_bias=0.0):
+    """Single LSTM step (reference: lstm_unit_op.cc). x: [B, 4H] gate
+    pre-activations (the layer projects concat([x, h]) with one fc, as
+    the reference does); Weight, if given, adds a separate hidden-hidden
+    contribution [H, 4H]. Gates ordered [i, f, c, o]."""
+    H = h_prev.shape[-1]
+    gates = x
+    if weight is not None:
+        gates = gates + h_prev @ weight
+    if bias is not None:
+        gates = gates + bias.reshape(4 * H)
+    gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return h, c
+
+
+@register("gru_unit", ["X", "HPrev", "Weight", "Bias"], ["H"])
+def gru_unit(x, h_prev, weight, bias, *, gate_activation="sigmoid",
+             activation="tanh"):
+    """Single GRU step (reference: gru_unit_op.cc). x: [B, 3H]."""
+    H = h_prev.shape[-1]
+    gact = _ACT[gate_activation]
+    candact = _ACT[activation]
+    b = bias.reshape(3 * H) if bias is not None else 0.0
+    x = x + b
+    w_ur, w_c = weight[:, :2 * H], weight[:, 2 * H:]
+    ur = gact(x[..., :2 * H] + h_prev @ w_ur)
+    u, r = ur[..., :H], ur[..., H:]
+    c = candact(x[..., 2 * H:] + (r * h_prev) @ w_c)
+    return (1.0 - u) * h_prev + u * c

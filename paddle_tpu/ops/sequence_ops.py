@@ -1,0 +1,260 @@
+"""Sequence ops over padded batch-major tensors + explicit lengths.
+
+Reference: paddle/fluid/operators/sequence_ops/ (5.3k LoC: sequence_pool
+_op, sequence_softmax_op, sequence_expand_op, sequence_pad_op,
+sequence_unpad_op, sequence_reverse_op, sequence_concat_op,
+sequence_slice_op, sequence_enumerate_op, sequence_expand_as_op) and
+the LoD machinery they consume (framework/lod_tensor.h:110).
+
+TPU-native redesign: the reference's LoD tensors carry ragged offsets
+and every sequence op re-walks them on CPU/GPU. XLA wants static shapes,
+so sequences are ``[batch, max_len, ...]`` padded tensors with an
+explicit ``lengths`` int vector ([batch]); every op here is a masked
+dense computation (MXU/VPU friendly, fusable). ``lengths=None`` means
+"all rows full length". Bucketing in the data pipeline (reader.py)
+keeps padding waste bounded — together these replace LoD end to end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from .registry import register
+
+
+def _time_mask(x, lengths, fill=0.0):
+    """Mask [B, T, ...] x past per-row length with ``fill``."""
+    if lengths is None:
+        return x
+    T = x.shape[1]
+    m = jnp.arange(T)[None, :] < lengths[:, None]  # [B, T]
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return jnp.where(m, x, jnp.full_like(x, fill))
+
+
+@register("sequence_pool", ["X", "SeqLen"], ["Out"], nondiff=("SeqLen",))
+def sequence_pool(x, lengths, *, pool_type="average", pad_value=0.0):
+    """[B, T, ...] -> [B, ...] pooled over the valid prefix (reference:
+    sequence_ops/sequence_pool_op.cc; math/sequence_pooling.cc).
+    Rows with length 0 produce ``pad_value``, as in the reference."""
+    T = x.shape[1]
+    pool_type = pool_type.lower()
+    if lengths is None:
+        n = jnp.full((x.shape[0],), T, x.dtype)
+    else:
+        n = jnp.maximum(lengths, 1).astype(x.dtype)
+    n = n.reshape(n.shape + (1,) * (x.ndim - 2))
+    if pool_type == "sum":
+        out = _time_mask(x, lengths).sum(axis=1)
+    elif pool_type == "average":
+        out = _time_mask(x, lengths).sum(axis=1) / n
+    elif pool_type == "sqrt":
+        out = _time_mask(x, lengths).sum(axis=1) / jnp.sqrt(n)
+    elif pool_type == "max":
+        neg = jnp.finfo(x.dtype).min
+        out = _time_mask(x, lengths, fill=neg).max(axis=1)
+    elif pool_type == "first":
+        out = x[:, 0]
+    elif pool_type == "last":
+        if lengths is None:
+            out = x[:, -1]
+        else:
+            idx = jnp.maximum(lengths - 1, 0)
+            idx = idx.reshape(idx.shape + (1,) * (x.ndim - 1))
+            out = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+    else:
+        raise ValueError("unknown pool_type %r" % pool_type)
+    if lengths is not None:
+        empty = (lengths == 0).reshape(
+            lengths.shape + (1,) * (out.ndim - 1))
+        out = jnp.where(empty, jnp.full_like(out, pad_value), out)
+    return out
+
+
+@register("sequence_softmax", ["X", "SeqLen"], ["Out"],
+          nondiff=("SeqLen",))
+def sequence_softmax(x, lengths):
+    """Softmax over the time axis restricted to the valid prefix
+    (reference: sequence_softmax_op.cc)."""
+    if lengths is not None:
+        T = x.shape[1]
+        m = jnp.arange(T)[None, :] < lengths[:, None]
+        m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+        x = jnp.where(m, x, jnp.full_like(x, jnp.finfo(x.dtype).min))
+    out = jax.nn.softmax(x, axis=1)
+    if lengths is not None:
+        out = _time_mask(out, lengths)
+    return out
+
+
+def reverse_valid_prefix(x, lengths):
+    """Reverse each row's valid prefix along the time axis (axis 1);
+    padding positions stay in place. Shared by sequence_reverse and the
+    is_reverse RNN paths (rnn_ops._scan_rnn)."""
+    if lengths is None:
+        return x[:, ::-1]
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    rev = jnp.where(idx < lengths[:, None], lengths[:, None] - 1 - idx,
+                    idx)
+    rev = rev.reshape(rev.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, rev, axis=1)
+
+
+@register("sequence_reverse", ["X", "SeqLen"], ["Out"],
+          nondiff=("SeqLen",))
+def sequence_reverse(x, lengths):
+    """Reverse each row's valid prefix; padding stays in place
+    (reference: sequence_reverse_op.h)."""
+    return reverse_valid_prefix(x, lengths)
+
+
+def _seq_expand_impl(x, y, y_lengths):
+    """Repeat each row x[b] across y's time axis: x [B, ...] or
+    [B, 1, ...] is broadcast to y's [B, T, ...], masked by y's
+    lengths."""
+    T = y.shape[1]
+    if x.ndim == y.ndim:  # [B, 1, ...] -> squeeze the time axis
+        x = x[:, 0]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    return _time_mask(out, y_lengths)
+
+
+@register("sequence_expand", ["X", "Y", "SeqLenY"], ["Out"],
+          nondiff=("Y", "SeqLenY"))
+def sequence_expand(x, y, y_lengths, *, ref_level=0):
+    """Reference: sequence_expand_op.cc, padded-layout specialization."""
+    return _seq_expand_impl(x, y, y_lengths)
+
+
+@register("sequence_expand_as", ["X", "Y", "SeqLenY"], ["Out"],
+          nondiff=("Y", "SeqLenY"))
+def sequence_expand_as(x, y, y_lengths):
+    """Reference: sequence_expand_as_op.cc."""
+    return _seq_expand_impl(x, y, y_lengths)
+
+
+@register("sequence_pad", ["X", "SeqLen"], ["Out", "Length"],
+          nondiff=("SeqLen",))
+def sequence_pad(x, lengths, *, pad_value=0.0, padded_length=-1):
+    """Normalize padding: positions past each row's length are set to
+    ``pad_value``; optionally re-pad the time axis to ``padded_length``
+    (reference: sequence_pad_op.cc — the ragged->padded boundary op; in
+    the padded-native design it canonicalizes the pad region)."""
+    if padded_length not in (-1, None) and padded_length != x.shape[1]:
+        T = x.shape[1]
+        enforce(padded_length >= T,
+                "padded_length %d < current max_len %d"
+                % (padded_length, T))
+        pad_width = [(0, 0), (0, padded_length - T)] + \
+            [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad_width, constant_values=pad_value)
+    out = _time_mask(x, lengths, fill=pad_value)
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return out, lengths
+
+
+@register("sequence_unpad", ["X", "Length"], ["Out"],
+          nondiff=("Length",))
+def sequence_unpad(x, lengths):
+    """Zero out the pad region (reference: sequence_unpad_op.cc returns
+    ragged data; the static-shape analog keeps [B, T, ...] and
+    guarantees pad positions are exactly zero)."""
+    return _time_mask(x, lengths)
+
+
+@register("sequence_concat", ["X*", "SeqLen*"], ["Out", "OutLen"],
+          nondiff=("SeqLen",))
+def sequence_concat(xs, lengths):
+    """Concatenate sequences along time per row (reference:
+    sequence_concat_op.cc): row b of the output is
+    x0[b,:l0] ++ x1[b,:l1] ++ ... followed by padding. An empty
+    ``lengths`` list means every input row is full length."""
+    enforce(len(xs) >= 1, "sequence_concat needs inputs")
+    if not lengths:
+        lengths = [None] * len(xs)
+    enforce(len(lengths) == len(xs),
+            "sequence_concat needs one lengths vector per input")
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    dense = jnp.concatenate(
+        [_time_mask(x, l) for x, l in zip(xs, lengths)], axis=1)
+    # target position of each (input i, time t) element within the row
+    offs = []
+    total = jnp.zeros((B,), jnp.int32)
+    for x, l in zip(xs, lengths):
+        T = x.shape[1]
+        li = (jnp.full((B,), T, jnp.int32) if l is None
+              else l.astype(jnp.int32))
+        offs.append(total[:, None] + jnp.arange(T)[None, :])
+        total = total + li
+    pos = jnp.concatenate(offs, axis=1)  # [B, T_out]
+    valid = jnp.concatenate(
+        [(jnp.arange(x.shape[1])[None, :] <
+          (jnp.full((B, 1), x.shape[1], jnp.int32) if l is None
+           else l[:, None])) for x, l in zip(xs, lengths)], axis=1)
+    pos = jnp.where(valid, pos, T_out)  # dump invalid into scratch slot
+    out = jnp.zeros((B, T_out + 1) + dense.shape[2:], dense.dtype)
+    bidx = jnp.arange(B)[:, None]
+    out = out.at[bidx, pos].set(dense)
+    return out[:, :T_out], total
+
+
+@register("sequence_slice", ["X", "Offset", "Length"], ["Out"],
+          nondiff=("Offset", "Length"))
+def sequence_slice(x, offset, length):
+    """Per-row slice of the time axis (reference: sequence_slice_op.h):
+    out[b] = x[b, offset[b]:offset[b]+length[b]] left-aligned, zero
+    padded to max(length). Positions whose source index falls past the
+    time axis yield 0 (the reference enforces offset+length in range;
+    an in-graph check can't raise, so out-of-range reads are zeroed
+    rather than silently duplicating the last step)."""
+    offset = offset.reshape(-1).astype(jnp.int32)
+    length = length.reshape(-1).astype(jnp.int32)
+    T = x.shape[1]
+    idx = offset[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    in_range = idx < T
+    idx_c = jnp.clip(idx, 0, T - 1)
+    gathered = jnp.take_along_axis(
+        x, idx_c.reshape(idx_c.shape + (1,) * (x.ndim - 2)), axis=1)
+    m = in_range.reshape(in_range.shape + (1,) * (x.ndim - 2))
+    gathered = jnp.where(m, gathered, jnp.zeros_like(gathered))
+    return _time_mask(gathered, length)
+
+
+@register("sequence_enumerate", ["X", "SeqLen"], ["Out"],
+          differentiable=False, nondiff=("SeqLen",))
+def sequence_enumerate(x, lengths, *, win_size, pad_value=0):
+    """Sliding windows over the time axis (reference:
+    sequence_enumerate_op.cc): out[b, t] = x[b, t:t+win_size], positions
+    past the row length filled with pad_value. x: [B, T] int ids ->
+    out: [B, T, win_size]."""
+    B, T = x.shape[0], x.shape[1]
+    starts = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+    win_idx = jnp.clip(starts, 0, T - 1)  # [T, W]
+    out = x[:, win_idx]  # [B, T, W]
+    if lengths is None:
+        valid = (starts < T)[None]
+    else:
+        valid = starts[None, :, :] < lengths[:, None, None]
+    return jnp.where(valid, out, jnp.full_like(out, pad_value))
+
+
+@register("sequence_first_step", ["X", "SeqLen"], ["Out"],
+          nondiff=("SeqLen",))
+def sequence_first_step(x, lengths):
+    return x[:, 0]
+
+
+@register("sequence_last_step", ["X", "SeqLen"], ["Out"],
+          nondiff=("SeqLen",))
+def sequence_last_step(x, lengths):
+    if lengths is None:
+        return x[:, -1]
+    idx = jnp.maximum(lengths - 1, 0)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 1))
+    return jnp.take_along_axis(x, idx, axis=1)[:, 0]

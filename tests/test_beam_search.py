@@ -1,0 +1,109 @@
+"""Beam search tests (reference: test_beam_search_op.py,
+test_beam_search_decode_op.py — dense fixed-width redesign)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.ops.beam_search_ops import (beam_search_backtrack,
+                                            beam_search_step)
+
+import jax.numpy as jnp
+
+
+def test_beam_search_step_selects_topk():
+    # B=1, K=2, V=4; pre scores [0, -1]
+    pre_ids = jnp.array([[3, 2]])
+    pre_scores = jnp.array([[0.0, -1.0]])
+    scores = jnp.log(jnp.array([[[0.1, 0.6, 0.2, 0.1],
+                                 [0.7, 0.1, 0.1, 0.1]]]))
+    ids, sc, parent = beam_search_step(pre_ids, pre_scores, scores,
+                                       beam_size=2, end_id=0)
+    # candidates: beam0: log0.6=-0.51(id1), log0.2=-1.6(id2);
+    # beam1: -1+log0.7=-1.36 (id0)
+    np.testing.assert_array_equal(np.asarray(ids), [[1, 0]])
+    np.testing.assert_array_equal(np.asarray(parent), [[0, 1]])
+    np.testing.assert_allclose(np.asarray(sc),
+                               [[np.log(0.6), -1 + np.log(0.7)]],
+                               rtol=1e-5)
+
+
+def test_finished_beam_keeps_score_and_emits_end():
+    end = 0
+    pre_ids = jnp.array([[end, 2]])          # beam 0 already finished
+    pre_scores = jnp.array([[-0.1, -0.2]])
+    scores = jnp.log(jnp.full((1, 2, 4), 0.25))
+    ids, sc, parent = beam_search_step(pre_ids, pre_scores, scores,
+                                       beam_size=2, end_id=end)
+    # finished beam continues with end_id at unchanged score -0.1 (best)
+    assert int(ids[0, 0]) == end
+    np.testing.assert_allclose(float(sc[0, 0]), -0.1, rtol=1e-6)
+    assert int(parent[0, 0]) == 0
+
+
+def test_backtrack_reconstructs_path():
+    # T=3, B=1, K=2
+    ids = [jnp.array([[5, 6]]), jnp.array([[7, 8]]),
+           jnp.array([[9, 10]])]
+    parents = [jnp.array([[0, 1]]), jnp.array([[1, 0]]),
+               jnp.array([[0, 1]])]
+    scores = jnp.array([[-1.0, -0.5]])  # beam 1 is better
+    seqs, sc = beam_search_backtrack(ids, parents, scores, end_id=0)
+    # best (beam1, score -0.5): t2 id=10 parent=1 -> t1 id=8 parent=0
+    # -> t0 id=5
+    np.testing.assert_array_equal(np.asarray(seqs[0, 0]), [5, 8, 10])
+    # runner-up (beam0): t2 id=9 parent=0 -> t1 id=7 parent=1 -> t0 id=6
+    np.testing.assert_array_equal(np.asarray(seqs[0, 1]), [6, 7, 9])
+    np.testing.assert_allclose(np.asarray(sc), [[-0.5, -1.0]])
+
+
+def test_while_loop_beam_decode_markov():
+    """Full fluid-style decode: While loop + beam_search op + tensor
+    arrays + beam_search_decode, on a deterministic Markov chain where
+    the best path is analytically known."""
+    V, K, B, T = 4, 2, 1, 3
+    end_id = 0
+    # transition log-probs: from any state, P(next=state+1)=0.9 wraps
+    trans = np.full((V, V), 0.05, np.float32)
+    for s in range(V):
+        trans[s, (s + 1) % V] = 0.9
+    trans = np.log(trans / trans.sum(1, keepdims=True))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        tr = layers.data("trans", shape=[V, V], append_batch_size=False)
+        pre_ids = layers.fill_constant([B, K], "int64", 1)  # start at 1
+        pre_scores = layers.fill_constant([B, K], "float32", 0.0)
+        # kill duplicate start beams so beam 1 explores alternatives
+        mask0 = layers.assign(np.array([[0.0, -1e9]], np.float32))
+        pre_scores = pre_scores + mask0
+        ids_arr = layers.create_array("int64")
+        par_arr = layers.create_array("int32")
+        t = layers.fill_constant([1], "int32", 0)
+        tmax = layers.fill_constant([1], "int32", T)
+        cond = layers.less_than(t, tmax)
+        w = layers.While(cond=cond)
+        with w.block():
+            # scores[b,k,:] = trans[pre_ids[b,k]]
+            step_scores = layers.gather(tr, layers.reshape(
+                pre_ids, shape=[B * K]))
+            step_scores = layers.reshape(step_scores, shape=[B, K, V])
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, None, step_scores,
+                beam_size=K, end_id=end_id)
+            layers.array_write(sel_ids, t, array=ids_arr)
+            layers.array_write(parent, t, array=par_arr)
+            layers.assign(sel_ids, pre_ids)
+            layers.assign(sel_scores, pre_scores)
+            layers.increment(t, value=1, in_place=True)
+            layers.less_than(t, tmax, cond=cond)
+        seqs, sc = layers.beam_search_decode(ids_arr, par_arr,
+                                             pre_scores, beam_size=K,
+                                             end_id=end_id)
+    exe = fluid.Executor()
+    exe.run(startup)
+    seqs_v, sc_v = exe.run(main, feed={"trans": trans},
+                           fetch_list=[seqs, sc])
+    # best path from 1: 2 -> 3 -> 0
+    np.testing.assert_array_equal(seqs_v[0, 0], [2, 3, 0])
+    np.testing.assert_allclose(sc_v[0, 0], 3 * trans[1, 2], rtol=1e-5)

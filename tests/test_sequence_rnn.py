@@ -1,0 +1,303 @@
+"""Sequence + RNN op tests vs numpy references (reference pattern:
+OpTest numeric checks, test_sequence_pool.py, test_lstm_op.py,
+test_gru_op.py — padded+lengths redesign)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feed, n_fetch=1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        fetch = build()
+        if not isinstance(fetch, (list, tuple)):
+            fetch = [fetch]
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+B, T, D = 3, 5, 4
+LENS = np.array([5, 2, 3], np.int32)
+
+
+def _x():
+    return np.arange(B * T * D, dtype=np.float32).reshape(B, T, D) / 10.0
+
+
+def test_sequence_pool_types():
+    xv = _x()
+    for pool_type, ref in [
+        ("sum", lambda r, n: r[:n].sum(0)),
+        ("average", lambda r, n: r[:n].mean(0)),
+        ("sqrt", lambda r, n: r[:n].sum(0) / np.sqrt(n)),
+        ("max", lambda r, n: r[:n].max(0)),
+        ("first", lambda r, n: r[0]),
+        ("last", lambda r, n: r[n - 1]),
+    ]:
+        def build():
+            x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+            ln = layers.data("len", shape=[B], dtype="int32",
+                             append_batch_size=False)
+            return layers.sequence_pool(x, pool_type, seq_len=ln)
+
+        (out,) = _run(build, {"x": xv, "len": LENS})
+        want = np.stack([ref(xv[b], LENS[b]) for b in range(B)])
+        np.testing.assert_allclose(out, want, rtol=1e-5,
+                                   err_msg=pool_type)
+
+
+def test_sequence_softmax_masked():
+    xv = _x()
+
+    def build():
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        return layers.sequence_softmax(x, seq_len=ln)
+
+    (out,) = _run(build, {"x": xv, "len": LENS})
+    for b in range(B):
+        n = LENS[b]
+        e = np.exp(xv[b, :n] - xv[b, :n].max(0))
+        np.testing.assert_allclose(out[b, :n], e / e.sum(0), rtol=1e-5)
+        assert np.all(out[b, n:] == 0)
+
+
+def test_sequence_reverse():
+    xv = _x()
+
+    def build():
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        return layers.sequence_reverse(x, seq_len=ln)
+
+    (out,) = _run(build, {"x": xv, "len": LENS})
+    for b in range(B):
+        n = LENS[b]
+        np.testing.assert_allclose(out[b, :n], xv[b, :n][::-1])
+        np.testing.assert_allclose(out[b, n:], xv[b, n:])
+
+
+def test_sequence_expand_and_pad_unpad():
+    xv = np.random.RandomState(0).randn(B, D).astype(np.float32)
+    yv = np.zeros((B, T, D), np.float32)
+
+    def build():
+        x = layers.data("x", shape=[B, D], append_batch_size=False)
+        y = layers.data("y", shape=[B, T, D], append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        ex = layers.sequence_expand(x, y, y_seq_len=ln)
+        padded, plen = layers.sequence_pad(ex, pad_value=-1.0,
+                                           seq_len=ln)
+        unp = layers.sequence_unpad(padded, plen)
+        return ex, padded, plen, unp
+
+    ex, padded, plen, unp = _run(
+        build, {"x": xv, "y": yv, "len": LENS})
+    for b in range(B):
+        n = LENS[b]
+        np.testing.assert_allclose(ex[b, :n], np.tile(xv[b], (n, 1)))
+        assert np.all(ex[b, n:] == 0)
+        assert np.all(padded[b, n:] == -1.0)
+        assert np.all(unp[b, n:] == 0)
+    np.testing.assert_array_equal(plen, LENS)
+
+
+def test_sequence_concat():
+    r = np.random.RandomState(1)
+    x1 = r.randn(B, 3, D).astype(np.float32)
+    x2 = r.randn(B, 4, D).astype(np.float32)
+    l1 = np.array([3, 1, 2], np.int32)
+    l2 = np.array([2, 4, 1], np.int32)
+
+    def build():
+        a = layers.data("a", shape=[B, 3, D], append_batch_size=False)
+        b = layers.data("b", shape=[B, 4, D], append_batch_size=False)
+        la = layers.data("la", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        lb = layers.data("lb", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        out, olen = layers.sequence_concat([a, b], seq_lens=[la, lb])
+        return out, olen
+
+    out, olen = _run(build, {"a": x1, "b": x2, "la": l1, "lb": l2})
+    np.testing.assert_array_equal(olen, l1 + l2)
+    for b in range(B):
+        want = np.concatenate([x1[b, :l1[b]], x2[b, :l2[b]]])
+        np.testing.assert_allclose(out[b, :l1[b] + l2[b]], want,
+                                   rtol=1e-6)
+        assert np.all(out[b, l1[b] + l2[b]:] == 0)
+
+
+def test_sequence_slice_and_enumerate():
+    xv = _x()
+    off = np.array([1, 0, 2], np.int32)
+    ln = np.array([2, 2, 1], np.int32)
+
+    def build():
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        o = layers.data("o", shape=[B], dtype="int32",
+                        append_batch_size=False)
+        l = layers.data("l", shape=[B], dtype="int32",
+                        append_batch_size=False)
+        return layers.sequence_slice(x, o, l)
+
+    (out,) = _run(build, {"x": xv, "o": off, "l": ln})
+    for b in range(B):
+        np.testing.assert_allclose(out[b, :ln[b]],
+                                   xv[b, off[b]:off[b] + ln[b]])
+        assert np.all(out[b, ln[b]:] == 0)
+
+    ids = np.array([[1, 2, 3, 4, 0], [7, 8, 0, 0, 0]], np.int64)
+    lens = np.array([4, 2], np.int32)
+
+    def build2():
+        x = layers.data("ids", shape=[2, 5], dtype="int64",
+                        append_batch_size=False)
+        l = layers.data("l", shape=[2], dtype="int32",
+                        append_batch_size=False)
+        return layers.sequence_enumerate(x, win_size=2, pad_value=0,
+                                         seq_len=l)
+
+    (en,) = _run(build2, {"ids": ids, "l": lens})
+    np.testing.assert_array_equal(en[0, 0], [1, 2])
+    np.testing.assert_array_equal(en[0, 3], [4, 0])  # window past len
+    np.testing.assert_array_equal(en[1, 1], [8, 0])
+
+
+def _np_lstm(x, w, b, lens, hidden, peephole=False):
+    B_, T_, _ = x.shape
+    h = np.zeros((B_, hidden), np.float32)
+    c = np.zeros((B_, hidden), np.float32)
+    hs, cs = [], []
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    bg = b[:, :4 * hidden].reshape(4 * hidden)
+    for t in range(T_):
+        gates = x[:, t] + h @ w + bg
+        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        i, f = sig(gi), sig(gf)
+        c_new = f * c + i * np.tanh(gc)
+        h_new = sig(go) * np.tanh(c_new)
+        active = (t < lens)[:, None]
+        h = np.where(active, h_new, h)
+        c = np.where(active, c_new, c)
+        hs.append(np.where(active, h_new, 0.0))
+        cs.append(np.where(active, c_new, 0.0))
+    return (np.stack(hs, 1), np.stack(cs, 1), h, c)
+
+
+def test_dynamic_lstm_matches_numpy():
+    hidden = 6
+    r = np.random.RandomState(2)
+    xv = r.randn(B, T, 4 * hidden).astype(np.float32)
+
+    def build():
+        x = layers.data("x", shape=[B, T, 4 * hidden],
+                        append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        h, c = layers.dynamic_lstm(x, size=4 * hidden,
+                                   use_peepholes=False, seq_len=ln)
+        return h, c
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    hv, cv = exe.run(main, feed={"x": xv, "len": LENS},
+                     fetch_list=list(fetch))
+    w = np.asarray(fluid.global_scope().find_var("lstm_0.w_0"))
+    b = np.asarray(fluid.global_scope().find_var("lstm_0.b_0"))
+    want_h, want_c, _, _ = _np_lstm(xv, w, b, LENS, hidden)
+    np.testing.assert_allclose(hv, want_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cv, want_c, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_gru_runs_and_masks():
+    size = 5
+    r = np.random.RandomState(3)
+    xv = r.randn(B, T, 3 * size).astype(np.float32)
+
+    def build():
+        x = layers.data("x", shape=[B, T, 3 * size],
+                        append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int32",
+                         append_batch_size=False)
+        return layers.dynamic_gru(x, size=size, seq_len=ln)
+
+    (out,) = _run(build, {"x": xv, "len": LENS})
+    assert out.shape == (B, T, size)
+    for b in range(B):
+        assert np.all(out[b, LENS[b]:] == 0)
+    assert np.isfinite(out).all()
+
+
+def test_lstm_language_model_trains():
+    """dynamic_lstm in a toy next-token model: loss decreases (the
+    stacked_dynamic_lstm benchmark shape, miniature)."""
+    V, E, H_ = 20, 8, 16
+    Bs, Ts = 4, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[Bs, Ts], dtype="int64",
+                          append_batch_size=False)
+        tgt = layers.data("tgt", shape=[Bs, Ts], dtype="int64",
+                          append_batch_size=False)
+        ln = layers.data("len", shape=[Bs], dtype="int32",
+                         append_batch_size=False)
+        emb = layers.embedding(ids, size=[V, E])
+        proj = layers.fc(emb, size=4 * H_, num_flatten_dims=2,
+                         bias_attr=False)
+        h, _c = layers.dynamic_lstm(proj, size=4 * H_,
+                                    use_peepholes=False, seq_len=ln)
+        logits = layers.fc(h, size=V, num_flatten_dims=2)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(
+                logits, layers.unsqueeze(tgt, axes=[2])))
+        fluid.optimizer.AdamOptimizer(5e-2).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    ids_v = r.randint(0, V, (Bs, Ts)).astype(np.int64)
+    tgt_v = np.roll(ids_v, -1, axis=1)
+    lens = np.full((Bs,), Ts, np.int32)
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"ids": ids_v, "tgt": tgt_v,
+                                    "len": lens}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses[::8]
+
+
+def test_gru_unit_and_lstm_unit_shapes():
+    Bs, D_, H_ = 4, 3, 5
+    r = np.random.RandomState(1)
+
+    def build():
+        x = layers.data("x", shape=[Bs, 3 * H_],
+                        append_batch_size=False)
+        h0 = layers.data("h0", shape=[Bs, H_], append_batch_size=False)
+        nh = layers.gru_unit(x, h0, size=H_)
+        x2 = layers.data("x2", shape=[Bs, D_], append_batch_size=False)
+        c0 = layers.data("c0", shape=[Bs, H_], append_batch_size=False)
+        h2, c2 = layers.lstm_unit(x2, h0, c0)
+        return nh, h2, c2
+
+    nh, h2, c2 = _run(build, {
+        "x": r.randn(Bs, 3 * H_).astype(np.float32),
+        "h0": r.randn(Bs, H_).astype(np.float32),
+        "x2": r.randn(Bs, D_).astype(np.float32),
+        "c0": r.randn(Bs, H_).astype(np.float32)})
+    assert nh.shape == (Bs, H_)
+    assert h2.shape == (Bs, H_) and c2.shape == (Bs, H_)
+    assert np.isfinite(nh).all() and np.isfinite(h2).all()
