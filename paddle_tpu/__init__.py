@@ -35,6 +35,7 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from .executor import Executor, scope_guard  # noqa: F401
 from . import parallel  # noqa: F401
+from . import contrib  # noqa: F401
 from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         default_main_program, default_startup_program,
                         name_scope, program_guard)

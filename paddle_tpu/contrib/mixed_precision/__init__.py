@@ -1,0 +1,6 @@
+"""Automatic mixed precision (reference:
+python/paddle/fluid/contrib/mixed_precision/)."""
+
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
+from .fp16_utils import rewrite_program  # noqa: F401

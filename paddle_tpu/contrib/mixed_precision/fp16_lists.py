@@ -1,0 +1,52 @@
+"""Op lists for automatic mixed precision.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/fp16_lists.py
+(AutoMixedPrecisionLists: white/black/gray op sets). The TPU default
+low-precision dtype is bfloat16 — same exponent range as float32, so
+unlike fp16 the white list can be aggressive (any MXU-bound op)."""
+
+from __future__ import annotations
+
+# Ops whose inputs are cast to the low-precision dtype (MXU-bound:
+# matmul/conv dominate FLOPs; bf16 doubles MXU throughput).
+white_list = {
+    "mul", "matmul", "conv2d", "conv3d", "depthwise_conv2d",
+    "conv2d_transpose",
+}
+
+# Numerically sensitive ops that must stay in float32.
+black_list = {
+    "exp", "log", "square", "softmax", "log_softmax", "mean",
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "layer_norm", "batch_norm",
+    "group_norm", "instance_norm", "reduce_sum", "reduce_mean", "sum",
+    "cumsum", "logsumexp", "l2_normalize", "norm", "p_norm",
+    "frobenius_norm",
+}
+
+# Everything else: runs in whatever dtype its inputs arrive in
+# (jnp promotion keeps bf16*f32 -> f32).
+gray_list = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "relu", "gelu", "tanh", "sigmoid", "pool2d",
+    "adaptive_pool2d", "transpose2", "reshape2", "concat", "split",
+    "slice", "dropout", "scale", "stack", "expand",
+}
+
+
+class AutoMixedPrecisionLists:
+    """Reference: fp16_lists.py AutoMixedPrecisionLists — custom
+    white/black sets override the defaults."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        if custom_white_list:
+            for op in custom_white_list:
+                self.white_list.add(op)
+                self.black_list.discard(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                self.black_list.add(op)
+                self.white_list.discard(op)

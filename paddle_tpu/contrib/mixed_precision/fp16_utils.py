@@ -1,0 +1,63 @@
+"""Program rewrite for mixed precision: insert casts around white-list
+ops.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/fp16_utils.py
+(_insert_cast_op / rewrite_program). The reference retypes every var
+and inserts cast ops both directions; here only *inputs* of white-list
+ops are cast down — the op then computes in bf16 (jnp type promotion),
+and the first consumer that mixes in a float32 operand promotes back.
+Parameters themselves keep float32 storage (master weights by
+construction, the role of the reference's master-weight copies), and
+XLA fuses the casts into the surrounding kernels so the rewrite costs
+nothing at run time."""
+
+from __future__ import annotations
+
+from ... import framework
+from ...framework import convert_dtype
+
+
+def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
+    """Insert cast-to-``dest_dtype`` ops in front of every float32 input
+    of white-list ops (forward ops only — backward regenerates through
+    the vjp of the rewritten forward). Returns the number of casts
+    inserted."""
+    dest_dtype = convert_dtype(dest_dtype)
+    n_casts = 0
+    for block in main_program.blocks:
+        new_ops = []
+        # cache per-block so one var feeding several white ops is cast
+        # once (XLA would CSE it anyway; this keeps the program small)
+        casted = {}
+        for op in block.ops:
+            if op.type in amp_lists.white_list and \
+                    op.attrs.get("op_role") not in ("backward",
+                                                    "optimize"):
+                for slot, names in op.inputs.items():
+                    for j, name in enumerate(names):
+                        var = block._find_var_recursive(name)
+                        if var is None or var.dtype != "float32":
+                            continue
+                        if name not in casted:
+                            cast_var = block.create_var(
+                                name=framework.unique_name.generate(
+                                    name + ".cast_" + dest_dtype),
+                                shape=tuple(var.shape),
+                                dtype=dest_dtype,
+                                stop_gradient=var.stop_gradient)
+                            cast_op = framework.Operator(
+                                block, "cast",
+                                inputs={"X": [name]},
+                                outputs={"Out": [cast_var.name]},
+                                attrs={"dtype": dest_dtype})
+                            new_ops.append(cast_op)
+                            casted[name] = cast_var.name
+                            n_casts += 1
+                        names[j] = casted[name]
+            new_ops.append(op)
+            # a write to a var invalidates its cached cast
+            for n in op.output_arg_names:
+                casted.pop(n, None)
+        block.ops = new_ops
+    main_program._bump()
+    return n_casts

@@ -98,7 +98,7 @@ def run_op(op, env, step_key, op_index, library=None):
     _scatter_outputs(opdef, op, env, result)
 
 
-def _run_vjp_op(op, env, step_key):
+def _run_vjp_op(op, env, step_key, library=None):
     """Execute a generic gradient op appended by backward.append_backward.
 
     Replaces the reference's per-op GradOpMaker C++ classes
@@ -145,12 +145,17 @@ def _run_vjp_op(op, env, step_key):
     if not diff_slots:
         return
 
+    # Library variants (pallas kernels) carry a custom_vjp whose
+    # backward recomputes through the reference lowering, so picking
+    # the variant here keeps the forward fast without tracing it twice.
+    fwd_lowering = opdef.pick(library)
+
     def fwd_fn(*diff_vals):
         merged = dict(all_vals)
         for (slot, _v, _n), val in zip(diff_slots, diff_vals):
             merged[slot] = val
         args = [merged[slot] for slot, _ in opdef.input_slots]
-        return opdef.fn(*args, **fwd_attrs)
+        return fwd_lowering(*args, **fwd_attrs)
 
     primal_args = [all_vals[slot] for slot, _, _ in diff_slots]
     primals_out, pullback = jax.vjp(fwd_fn, *primal_args)
@@ -199,7 +204,7 @@ def run_block(block, env, step_key, library=None):
                 % (op.type, i))
         try:
             if op.type == "vjp":
-                _run_vjp_op(op, env, step_key)
+                _run_vjp_op(op, env, step_key, library=library)
             else:
                 run_op(op, env, step_key, i, library=library)
         except KeyError as e:
@@ -269,6 +274,8 @@ class Executor:
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
         block = program.global_block()
+        if library is None and FLAGS.op_library:
+            library = FLAGS.op_library
 
         # persistable vars the program touches and the scope already holds
         persist_in = {}

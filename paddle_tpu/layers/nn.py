@@ -811,3 +811,20 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
                      outputs={"Out": [out]},
                      attrs={"epsilon": float(epsilon)})
     return out
+
+
+def scaled_dot_product_attention(q, k, v, bias=None, scale=1.0,
+                                 name=None):
+    """Fused attention core: softmax(q @ k^T * scale + bias) @ v over
+    [batch, heads, seq, head_dim] inputs. Lowers to one fused op
+    (pallas flash-style kernel when FLAGS_op_library=pallas; XLA-fused
+    composite otherwise). See ops/pallas/attention.py."""
+    helper = LayerHelper("sdpa", name=name)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(type="scaled_dot_product_attention",
+                     inputs=inputs, outputs={"Out": [out]},
+                     attrs={"scale": float(scale)})
+    return out

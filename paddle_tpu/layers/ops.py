@@ -37,6 +37,24 @@ for _op in _UNARY_OPS:
     setattr(_mod, _op, _make_unary(_op))
 
 
+def _make_unary_bool(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference("bool")
+        out.stop_gradient = True
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = "Elementwise %s predicate." % op_type
+    return layer
+
+
+for _op in ("isnan", "isinf", "isfinite"):
+    setattr(_mod, _op, _make_unary_bool(_op))
+
+
 def gelu(x, approximate=True, name=None):
     helper = LayerHelper("gelu", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)

@@ -30,3 +30,4 @@ from . import control_flow_ops  # noqa: F401,E402
 from . import sequence_ops  # noqa: F401,E402
 from . import rnn_ops  # noqa: F401,E402
 from . import beam_search_ops  # noqa: F401,E402
+from . import pallas  # noqa: F401,E402

@@ -1,0 +1,8 @@
+"""Pallas TPU kernel library (see common.py for the design contract —
+the operators/jit + operators/fused analog)."""
+
+from . import common  # noqa: F401  (defines FLAGS_op_library)
+from . import attention  # noqa: F401
+from . import layer_norm  # noqa: F401
+from . import softmax_xent  # noqa: F401
+from . import fused_adam  # noqa: F401

@@ -1,0 +1,140 @@
+"""Fused scaled-dot-product attention (flash-style) pallas kernel.
+
+The analog of the reference's fused attention ops (operators/fused/
+fused_embedding_fc_lstm_op.cc era had no flash attention — attention in
+the 2019 reference is composed op-by-op, e.g. benchmark transformer
+models multiply/softmax/multiply through separate kernels). On TPU the
+composed form round-trips the [B,H,Sq,Sk] score matrix through HBM
+twice; this kernel keeps each q-block's scores in VMEM, fusing
+QK^T -> +bias -> softmax -> @V into one MXU-resident pass.
+
+Forward: pallas kernel (one grid cell per (batch*head, q-block)).
+Backward: custom_vjp that recomputes through the pure-jnp composite —
+the flash-attention recompute strategy: no score matrix is ever stored
+for backward, trading FLOPs for HBM (SURVEY §7 "HBM bandwidth").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import register, register_variant
+from .common import blk, interpret_mode
+
+
+def _sdpa_reference(q, k, v, bias, *, scale):
+    """Pure-jnp composite (the jit/refer/ analog): q,k,v [B,H,S,Dh],
+    bias [B,1,Sq,Sk] additive (or None)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+@register("scaled_dot_product_attention", ["Q", "K", "V", "Bias"],
+          ["Out"])
+def scaled_dot_product_attention(q, k, v, bias, *, scale=1.0):
+    """Base lowering: XLA fuses the chain; the pallas variant below is
+    substituted when FLAGS_op_library=pallas."""
+    return _sdpa_reference(q, k, v, bias, scale=scale)
+
+
+def _mha_fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
+    q = q_ref[0]                       # [blk_q, dh]
+    kk = k_ref[0]                      # [sk, dh]
+    s = jax.lax.dot_general(
+        q, kk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [blk_q, sk]
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    w = e / jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.dot(w.astype(v_ref.dtype), v_ref[0],
+                preferred_element_type=jnp.float32)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _sdpa_pallas_fwd(q, k, v, bias, scale):
+    B, H, Sq, Dh = q.shape
+    Sk = k.shape[2]
+    BH = B * H
+    if bias is not None and bias.shape != (B, 1, Sq, Sk):
+        # encoder-style [B,1,1,Sk] (or other broadcastable) biases:
+        # materialize the per-batch [Sq,Sk] block the BlockSpec expects
+        bias = jnp.broadcast_to(bias, (B, 1, Sq, Sk))
+    q3 = q.reshape(BH, Sq, Dh)
+    k3 = k.reshape(BH, Sk, Dh)
+    v3 = v.reshape(BH, Sk, Dh)
+    blk_q = blk(Sq)
+    grid = (BH, Sq // blk_q)
+
+    in_specs = [
+        pl.BlockSpec((1, blk_q, Dh), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Sk, Dh), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Sk, Dh), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q3, k3, v3]
+    if bias is not None:
+        # bias [B, 1, Sq, Sk] shared across the H heads of a batch row
+        in_specs.append(pl.BlockSpec(
+            (1, 1, blk_q, Sk), lambda i, j: (i // H, 0, j, 0),
+            memory_space=pltpu.VMEM))
+        args.append(bias)
+        kernel = functools.partial(_mha_fwd_kernel, scale=scale)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, orf, **kw: _mha_fwd_kernel(
+                qr, kr, vr, None, orf, **kw), scale=scale)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, blk_q, Dh), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret_mode(),
+    )(*args)
+    return out.reshape(B, H, Sq, Dh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _sdpa_pallas(q, k, v, bias, scale):
+    return _sdpa_pallas_fwd(q, k, v, bias, scale)
+
+
+def _sdpa_vjp_fwd(q, k, v, bias, scale):
+    return _sdpa_pallas_fwd(q, k, v, bias, scale), (q, k, v, bias)
+
+
+def _sdpa_vjp_bwd(scale, res, g):
+    q, k, v, bias = res
+    if bias is None:
+        _out, pull = jax.vjp(
+            lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, None,
+                                               scale=scale), q, k, v)
+        dq, dk, dv = pull(g)
+        return dq, dk, dv, None
+    _out, pull = jax.vjp(
+        lambda q_, k_, v_, b_: _sdpa_reference(q_, k_, v_, b_,
+                                               scale=scale),
+        q, k, v, bias)
+    return pull(g)
+
+
+_sdpa_pallas.defvjp(_sdpa_vjp_fwd, _sdpa_vjp_bwd)
+
+
+@register_variant("scaled_dot_product_attention", "pallas")
+def sdpa_pallas(q, k, v, bias, *, scale=1.0):
+    return _sdpa_pallas(q, k, v, bias, scale)

@@ -1,0 +1,133 @@
+"""Fused layer_norm pallas kernel (reference: layer_norm_op.cu's fused
+CUDA kernel; jit/gen had the x86 analog). One VMEM pass computes
+mean/var/normalize/affine per row block — XLA's decomposed form emits
+several HBM-bound elementwise stages on big rows."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import get, register_variant
+from .common import blk, interpret_mode
+
+
+def _ln_kernel(x_ref, s_ref, b_ref, y_ref, m_ref, v_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)           # [blk_r, D]
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = xc * inv
+    if s_ref is not None:
+        y = y * s_ref[:].astype(jnp.float32)
+    if b_ref is not None:
+        y = y + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    m_ref[:] = mean.astype(m_ref.dtype)
+    v_ref[:] = var.astype(v_ref.dtype)
+
+
+def _ln_pallas_fwd(x, scale, bias, eps, begin_norm_axis):
+    rows = 1
+    for d in x.shape[:begin_norm_axis]:
+        rows *= d
+    D = 1
+    for d in x.shape[begin_norm_axis:]:
+        D *= d
+    x2 = x.reshape(rows, D)
+    blk_r = blk(rows, 256)
+    grid = (rows // blk_r,)
+
+    specs = [pl.BlockSpec((blk_r, D), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)]
+    args = [x2]
+    affine_spec = pl.BlockSpec((1, D), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)
+    if scale is not None:
+        specs.append(affine_spec)
+        args.append(scale.reshape(1, D))
+    if bias is not None:
+        specs.append(affine_spec)
+        args.append(bias.reshape(1, D))
+
+    def kernel(*refs):
+        x_ref = refs[0]
+        idx = 1
+        s_ref = b_ref = None
+        if scale is not None:
+            s_ref = refs[idx]
+            idx += 1
+        if bias is not None:
+            b_ref = refs[idx]
+            idx += 1
+        y_ref, m_ref, v_ref = refs[idx:idx + 3]
+        _ln_kernel(x_ref, s_ref, b_ref, y_ref, m_ref, v_ref, eps=eps)
+
+    y, mean, var = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, D), x.dtype),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)),
+        grid=grid,
+        in_specs=specs,
+        out_specs=(pl.BlockSpec((blk_r, D), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((blk_r, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((blk_r, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret_mode(),
+    )(*args)
+    mshape = x.shape[:begin_norm_axis]
+    return (y.reshape(x.shape), mean.reshape(mshape),
+            var.reshape(mshape))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_pallas(x, scale, bias, eps, begin_norm_axis):
+    return _ln_pallas_fwd(x, scale, bias, eps, begin_norm_axis)
+
+
+def _ln_vjp_fwd(x, scale, bias, eps, begin_norm_axis):
+    out = _ln_pallas_fwd(x, scale, bias, eps, begin_norm_axis)
+    return out, (x, scale, bias)
+
+
+def _ln_vjp_bwd(eps, begin_norm_axis, res, g):
+    x, scale, bias = res
+    ref_fn = get("layer_norm").fn
+
+    def composite(x_, s_, b_):
+        return ref_fn(x_, s_, b_, epsilon=eps,
+                      begin_norm_axis=begin_norm_axis)
+
+    if scale is None and bias is None:
+        _o, pull = jax.vjp(lambda x_: composite(x_, None, None), x)
+        (dx,) = pull(g)
+        return dx, None, None
+    if scale is None:
+        _o, pull = jax.vjp(lambda x_, b_: composite(x_, None, b_),
+                           x, bias)
+        dx, db = pull(g)
+        return dx, None, db
+    if bias is None:
+        _o, pull = jax.vjp(lambda x_, s_: composite(x_, s_, None),
+                           x, scale)
+        dx, ds = pull(g)
+        return dx, ds, None
+    _o, pull = jax.vjp(composite, x, scale, bias)
+    return pull(g)
+
+
+_ln_pallas.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+@register_variant("layer_norm", "pallas")
+def layer_norm_pallas(x, scale, bias, *, epsilon=1e-5,
+                      begin_norm_axis=1):
+    return _ln_pallas(x, scale, bias, epsilon, begin_norm_axis)

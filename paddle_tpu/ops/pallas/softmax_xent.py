@@ -1,0 +1,113 @@
+"""Fused softmax + cross-entropy pallas kernel.
+
+Reference: operators/softmax_with_cross_entropy_op.cu
+(SoftmaxWithCrossEntropyFusedKernel) — the same fusion argument holds
+on TPU: one VMEM pass produces both the softmax and the picked
+log-likelihood, instead of XLA materializing softmax AND log_softmax
+([N, C] each) in HBM between the decomposed stages."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import get, register_variant
+from .common import blk, interpret_mode
+
+
+def _xent_kernel(lg_ref, lb_ref, sm_ref, loss_ref):
+    lg = lg_ref[:].astype(jnp.float32)          # [blk_n, C]
+    lab = lb_ref[:]                             # [blk_n, 1] int32
+    m = jnp.max(lg, axis=1, keepdims=True)
+    sh = lg - m
+    e = jnp.exp(sh)
+    z = jnp.sum(e, axis=1, keepdims=True)
+    sm_ref[:] = (e / z).astype(sm_ref.dtype)
+    logp = sh - jnp.log(z)                      # [blk_n, C]
+    C = lg.shape[1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, logp.shape, 1)
+    picked = jnp.sum(jnp.where(cols == lab, logp, 0.0), axis=1,
+                     keepdims=True)
+    loss_ref[:] = (-picked).astype(loss_ref.dtype)
+
+
+def _xent_pallas_fwd(logits, label):
+    orig_shape = logits.shape
+    C = orig_shape[-1]
+    N = 1
+    for d in orig_shape[:-1]:
+        N *= d
+    lg2 = logits.reshape(N, C)
+    lb2 = label.reshape(N, 1).astype(jnp.int32)
+    # VMEM-aware row block: ~3 [blk_n, C] f32 live buffers must fit
+    target = max(1, min(256, (4 << 20) // (12 * C)))
+    blk_n = blk(N, target)
+    sm, loss = pl.pallas_call(
+        functools.partial(_xent_kernel),
+        out_shape=(jax.ShapeDtypeStruct((N, C), logits.dtype),
+                   jax.ShapeDtypeStruct((N, 1), logits.dtype)),
+        grid=(N // blk_n,),
+        in_specs=[pl.BlockSpec((blk_n, C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((blk_n, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((blk_n, C), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((blk_n, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret_mode(),
+    )(lg2, lb2)
+    return (sm.reshape(orig_shape),
+            loss.reshape(orig_shape[:-1] + (1,)))
+
+
+@jax.custom_vjp
+def _xent_pallas(logits, label):
+    return _xent_pallas_fwd(logits, label)
+
+
+def _xent_vjp_fwd(logits, label):
+    sm, loss = _xent_pallas_fwd(logits, label)
+    return (sm, loss), (sm, label)
+
+
+def _xent_vjp_bwd(res, g):
+    # d(loss)/d(logits) = softmax - onehot(label); the softmax output
+    # cotangent is folded in exactly as the composite's vjp would
+    sm, label = res
+    g_sm, g_loss = g
+    C = sm.shape[-1]
+    lab = label.astype(jnp.int32)
+    if lab.ndim == sm.ndim:
+        lab = lab.squeeze(-1)
+    onehot = jax.nn.one_hot(lab, C, dtype=sm.dtype)
+    dlogits = (sm - onehot) * g_loss
+    if g_sm is not None:
+        # vjp of softmax at `sm`: sm * (g - sum(g*sm))
+        inner = jnp.sum(g_sm * sm, axis=-1, keepdims=True)
+        dlogits = dlogits + sm * (g_sm - inner)
+    return dlogits, None
+
+
+_xent_pallas.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
+
+
+@register_variant("softmax_with_cross_entropy", "pallas")
+def softmax_with_cross_entropy_pallas(logits, label, *, soft_label=False,
+                                      ignore_index=-100, axis=-1,
+                                      return_softmax=True,
+                                      numeric_stable_mode=True):
+    if soft_label or axis not in (-1, logits.ndim - 1) \
+            or ignore_index >= 0:
+        # uncommon modes (soft labels, inner axis, active
+        # ignore_index) fall back to the reference lowering
+        return get("softmax_with_cross_entropy").fn(
+            logits, label, soft_label=soft_label,
+            ignore_index=ignore_index, axis=axis,
+            return_softmax=return_softmax,
+            numeric_stable_mode=numeric_stable_mode)
+    return _xent_pallas(logits, label)

@@ -217,7 +217,7 @@ def sequence_slice(x, offset, length):
     length = length.reshape(-1).astype(jnp.int32)
     T = x.shape[1]
     idx = offset[:, None] + jnp.arange(T)[None, :]  # [B, T]
-    in_range = idx < T
+    in_range = (idx >= 0) & (idx < T)
     idx_c = jnp.clip(idx, 0, T - 1)
     gathered = jnp.take_along_axis(
         x, idx_c.reshape(idx_c.shape + (1,) * (x.ndim - 2)), axis=1)

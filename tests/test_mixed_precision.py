@@ -1,0 +1,124 @@
+"""AMP tests (reference: test_image_classification_fp16.py,
+contrib/tests/test_fp16_utils semantics — bf16 redesign)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import mixed_precision as mp
+
+
+def _mlp(loss_scaling_kwargs=None, dest="bfloat16", dynamic=True):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], append_batch_size=False)
+        y = layers.data("y", shape=[8, 1], dtype="int64",
+                        append_batch_size=False)
+        h = layers.fc(x, size=32, act="relu")
+        logits = layers.fc(h, size=4)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        opt = mp.decorate(
+            fluid.optimizer.SGD(learning_rate=0.1),
+            init_loss_scaling=8.0, use_dynamic_loss_scaling=dynamic,
+            incr_every_n_steps=4, decr_every_n_nan_or_inf=1,
+            dest_dtype=dest, **(loss_scaling_kwargs or {}))
+        opt.minimize(loss)
+    return main, startup, loss, opt
+
+
+def _data(rng):
+    x = rng.rand(8, 16).astype(np.float32)
+    y = np.argmax(x[:, :4], axis=1).reshape(8, 1).astype(np.int64)
+    return x, y
+
+
+def test_bf16_casts_inserted_and_training_converges():
+    main, startup, loss, opt = _mlp()
+    cast_ops = [op for op in main.global_block().ops
+                if op.type == "cast" and
+                op.attrs.get("dtype") == "bfloat16"]
+    assert len(cast_ops) >= 2, "white-list inputs must be cast to bf16"
+    exe = fluid.Executor()
+    exe.run(startup)
+    x, y = _data(np.random.RandomState(0))
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.3, losses[::10]
+    # params stayed float32 (master weights by construction)
+    w = fluid.global_scope().find_var("fc_0.w_0")
+    assert str(np.asarray(w).dtype) == "float32"
+
+
+def test_loss_scale_grows_on_finite_steps():
+    main, startup, loss, opt = _mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    for _ in range(9):
+        x, y = _data(rng)
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    scale = float(np.asarray(
+        fluid.global_scope().find_var("loss_scaling_0"))[0])
+    # incr_every_n_steps=4, 9 finite steps -> grew twice: 8 -> 32
+    assert scale == 32.0, scale
+
+
+def test_nonfinite_batch_skips_update_and_shrinks_scale():
+    main, startup, loss, opt = _mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    x, y = _data(rng)
+    exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    w_before = np.asarray(
+        fluid.global_scope().find_var("fc_0.w_0")).copy()
+    bad_x = x.copy()
+    bad_x[0, 0] = np.inf
+    exe.run(main, feed={"x": bad_x, "y": y}, fetch_list=[loss])
+    w_after = np.asarray(fluid.global_scope().find_var("fc_0.w_0"))
+    np.testing.assert_array_equal(w_before, w_after)
+    scale = float(np.asarray(
+        fluid.global_scope().find_var("loss_scaling_0"))[0])
+    assert scale == pytest.approx(8.0 * 0.8), scale
+
+
+def test_static_loss_scaling_matches_unscaled_sgd():
+    """With static scaling, scale*grad/scale must equal plain SGD."""
+    def run(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4, 8], append_batch_size=False)
+            y = layers.data("y", shape=[4, 1], append_batch_size=False)
+            pred = layers.fc(x, size=1)
+            loss = layers.reduce_mean(
+                layers.square_error_cost(pred, y))
+            base = fluid.optimizer.SGD(learning_rate=0.1)
+            if amp:
+                opt = mp.decorate(base, init_loss_scaling=64.0,
+                                  use_dynamic_loss_scaling=False,
+                                  amp_lists=mp.AutoMixedPrecisionLists(
+                                      custom_black_list=["mul"]))
+                opt.minimize(loss)
+            else:
+                base.minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            rng = np.random.RandomState(3)
+            out = []
+            for _ in range(5):
+                xv = rng.rand(4, 8).astype(np.float32)
+                yv = rng.rand(4, 1).astype(np.float32)
+                (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])
+                out.append(float(lv))
+        return out
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
