@@ -36,6 +36,8 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
 from .executor import Executor, scope_guard  # noqa: F401
 from . import parallel  # noqa: F401
 from . import contrib  # noqa: F401
+from . import profiler  # noqa: F401
+from . import dygraph  # noqa: F401
 from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         default_main_program, default_startup_program,
                         name_scope, program_guard)

@@ -1,0 +1,12 @@
+"""Dygraph (eager/imperative) mode — reference:
+paddle/fluid/imperative/ + python/paddle/fluid/dygraph/."""
+
+from . import nn  # noqa: F401
+from .base import (VarBase, backward, enabled, guard,  # noqa: F401
+                   in_dygraph_mode, no_grad, run_dygraph_op,
+                   to_variable)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .layers import Layer, Parameter  # noqa: F401
+from .nn import (FC, BatchNorm, Conv2D, Dropout, Embedding,  # noqa: F401
+                 GRUUnit, LayerNorm, Linear, Pool2D)
+from .parallel import DataParallel, ParallelEnv  # noqa: F401

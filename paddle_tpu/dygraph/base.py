@@ -1,0 +1,323 @@
+"""Dygraph (eager) mode core: guard / to_variable / tape autograd.
+
+Reference: paddle/fluid/imperative/ (Tracer::Trace tracer.cc:140,
+VarBase layer.h:116, autograd engine engine.h:25) and
+python/paddle/fluid/dygraph/base.py (guard, to_variable).
+
+TPU-native redesign: eager ops execute the SAME pure-JAX lowerings the
+static Executor traces (one registry, ops/), on concrete device
+arrays. Autograd is a Python tape: each executed op records (opdef,
+attrs, inputs, outputs); ``VarBase.backward()`` walks the tape in
+reverse pulling cotangents through ``jax.vjp`` of each lowering — the
+eager twin of executor._run_vjp_op, replacing the reference's
+per-op-registered grad chains (imperative/layer.cc). jit still applies
+inside whole ops; for full-step fusion users switch to the static
+Program path (same layer vocabulary)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..core.enforce import InvalidArgumentError, enforce
+from ..core.flags import FLAGS
+from ..framework import convert_dtype
+
+_in_dygraph = False
+_tape: List["_TapeEntry"] = []
+_no_grad_depth = 0
+_rng_counter = 0
+
+
+def enabled() -> bool:
+    return _in_dygraph
+
+
+in_dygraph_mode = enabled
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Reference: dygraph/base.py guard()."""
+    global _in_dygraph, _tape, _rng_counter
+    prev = _in_dygraph
+    _in_dygraph = True
+    try:
+        yield
+    finally:
+        _in_dygraph = prev
+        if not prev:
+            _tape = []
+            _rng_counter = 0
+
+
+@contextlib.contextmanager
+def no_grad():
+    global _no_grad_depth
+    _no_grad_depth += 1
+    try:
+        yield
+    finally:
+        _no_grad_depth -= 1
+
+
+class VarBase:
+    """Eager tensor (reference: imperative/layer.h:116 VarBase =
+    value + grad + stop_gradient)."""
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        self.value = value if isinstance(value, jax.Array) \
+            else jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.grad: Optional[jax.Array] = None
+
+    # -- fluid VarBase API --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True, name=self.name)
+
+    def astype(self, dtype):
+        return run_dygraph_op("cast", {"X": [self]},
+                              {"dtype": convert_dtype(dtype)})
+
+    def backward(self, retain_graph=False):
+        backward(self, retain_graph=retain_graph)
+
+    # -- operator sugar (math_op_patch analog) ------------------------------
+    def _binary(self, other, op_type, reverse=False):
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, self.value.dtype))
+        x, y = (other, self) if reverse else (self, other)
+        return run_dygraph_op(op_type, {"X": [x], "Y": [y]},
+                              {"axis": -1})
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __neg__(self):
+        return run_dygraph_op("scale", {"X": [self]}, {"scale": -1.0})
+
+    def __repr__(self):
+        return "VarBase(%s, shape=%s, dtype=%s)" % (
+            self.name or "", self.shape, self.dtype)
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """Reference: dygraph/base.py to_variable."""
+    enforce(_in_dygraph,
+            "to_variable must be called under dygraph.guard()")
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(jnp.asarray(value), stop_gradient=True, name=name)
+
+
+class _TapeEntry:
+    __slots__ = ("opdef", "attrs", "slot_vals", "out_vbs")
+
+    def __init__(self, opdef, attrs, slot_vals, out_vbs):
+        self.opdef = opdef
+        self.attrs = attrs
+        self.slot_vals = slot_vals  # list aligned with input_slots
+        self.out_vbs = out_vbs      # flattened output VarBases
+
+
+def _next_rng():
+    global _rng_counter
+    _rng_counter += 1
+    seed = FLAGS.global_seed or 0
+    return jax.random.fold_in(jax.random.key(seed), _rng_counter)
+
+
+def run_dygraph_op(op_type, inputs: Dict[str, List[VarBase]],
+                   attrs: Dict[str, Any]):
+    """Execute one op eagerly through its registered lowering and
+    record it on the tape (reference: Tracer::Trace,
+    imperative/tracer.cc:140)."""
+    opdef = ops.get(op_type)
+    attrs = {k: v for k, v in attrs.items()
+             if k not in ("op_role", "op_namescope")}
+    if opdef.needs_rng:
+        attrs["rng"] = _next_rng()
+
+    slot_vals = []
+    for slot, variadic in opdef.input_slots:
+        vbs = inputs.get(slot, [])
+        if variadic:
+            slot_vals.append(list(vbs))
+        elif not vbs:
+            slot_vals.append(None)
+        else:
+            slot_vals.append(vbs[0])
+
+    def raw(v):
+        if v is None:
+            return None
+        if isinstance(v, list):
+            return [x.value for x in v]
+        return v.value
+
+    lib = FLAGS.op_library or None
+    fn = opdef.pick(lib)
+    result = fn(*[raw(v) for v in slot_vals], **attrs)
+
+    # record only when some differentiable input is grad-connected —
+    # outputs of unrecorded ops become stop_gradient barriers, pruning
+    # backward work (reference: VarBase stop_gradient propagation)
+    record = _no_grad_depth == 0 and opdef.differentiable
+    if record:
+        record = False
+        for i, (slot, _variadic) in enumerate(opdef.input_slots):
+            if slot in opdef.nondiff_slots:
+                continue
+            v = slot_vals[i]
+            vbs = v if isinstance(v, list) else ([v] if v else [])
+            for vb in vbs:
+                if _is_float(vb.value) and (
+                        not vb.stop_gradient or
+                        getattr(vb, "is_parameter", False)):
+                    record = True
+                    break
+            if record:
+                break
+
+    nslots = len(opdef.output_slots)
+    if nslots == 1:
+        result = (result,)
+    out_vbs = []
+    outs = []
+    for slot, val in zip(opdef.output_slots, result):
+        variadic = slot.endswith("*")
+        if variadic:
+            vb_list = [VarBase(v, stop_gradient=not record)
+                       for v in val]
+            out_vbs.extend(vb_list)
+            outs.append(vb_list)
+        else:
+            vb = VarBase(val, stop_gradient=not record)
+            out_vbs.append(vb)
+            outs.append(vb)
+
+    if record:
+        _tape.append(_TapeEntry(opdef, attrs, slot_vals, out_vbs))
+
+    if len(outs) == 1:
+        return outs[0]
+    return tuple(outs)
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def backward(loss: VarBase, retain_graph=False):
+    """Tape-walk reverse AD (reference: imperative engine.h:25; the
+    eager twin of executor._run_vjp_op)."""
+    enforce(_in_dygraph, "backward() requires dygraph mode")
+    grads: Dict[int, jax.Array] = {
+        id(loss): jnp.ones_like(loss.value)}
+    touched: Dict[int, VarBase] = {}
+
+    for entry in reversed(_tape):
+        if not any(id(vb) in grads for vb in entry.out_vbs):
+            continue
+        opdef, attrs = entry.opdef, entry.attrs
+
+        diff = []  # (pos-in-slot_vals, variadic, VarBase or list)
+        for i, (slot, variadic) in enumerate(opdef.input_slots):
+            v = entry.slot_vals[i]
+            if v is None or slot in opdef.nondiff_slots:
+                continue
+            if variadic:
+                if v and all(_is_float(x.value) for x in v):
+                    diff.append((i, True, v))
+            elif _is_float(v.value):
+                diff.append((i, False, v))
+        if not diff:
+            continue
+
+        def fwd(*dvals):
+            vals = []
+            for i, (slot, variadic) in enumerate(opdef.input_slots):
+                v = entry.slot_vals[i]
+                if v is None:
+                    vals.append(None)
+                elif isinstance(v, list):
+                    vals.append([x.value for x in v])
+                else:
+                    vals.append(v.value)
+            for (i, variadic, _vb), dv in zip(diff, dvals):
+                vals[i] = dv
+            return opdef.fn(*vals, **attrs)
+
+        primals = []
+        for i, variadic, vb in diff:
+            primals.append([x.value for x in vb] if variadic
+                           else vb.value)
+        outs, pull = jax.vjp(fwd, *primals)
+        flat_out, tree = jax.tree_util.tree_flatten(outs)
+        cots = []
+        for val, vb in zip(flat_out, entry.out_vbs):
+            g = grads.get(id(vb))
+            cots.append(g if g is not None else jnp.zeros_like(val))
+        cots += [jnp.zeros_like(v)
+                 for v in flat_out[len(entry.out_vbs):]]
+        in_grads = pull(jax.tree_util.tree_unflatten(tree, cots))
+
+        for (i, variadic, vb), g in zip(diff, in_grads):
+            targets = vb if variadic else [vb]
+            gs = g if variadic else [g]
+            for t, gi in zip(targets, gs):
+                # stop_gradient barriers (non-parameter) end the chain
+                if t.stop_gradient and \
+                        not getattr(t, "is_parameter", False):
+                    continue
+                key = id(t)
+                grads[key] = grads[key] + gi if key in grads else gi
+                touched[key] = t
+
+    # expose accumulated grads (repeated backward() calls accumulate,
+    # as in the reference; clear_gradient()/optimizer clears them)
+    for key, vb in touched.items():
+        vb.grad = grads[key] if vb.grad is None else \
+            (vb.grad + grads[key])
+
+    if not retain_graph:
+        _tape.clear()

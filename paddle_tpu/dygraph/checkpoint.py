@@ -1,0 +1,34 @@
+"""Dygraph checkpointing (reference:
+python/paddle/fluid/dygraph/checkpoint.py save_dygraph/load_dygraph —
+state dicts to disk)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.enforce import NotFoundError, enforce
+
+
+def save_dygraph(state_dict, model_path):
+    """Save a ``Layer.state_dict()`` (or optimizer state) to
+    ``model_path + '.pdparams'`` as an npz archive (replaces the
+    reference's LoDTensor stream serialization)."""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    np.savez(model_path + ".pdparams", **arrays)
+
+
+def load_dygraph(model_path):
+    """Returns (param_state_dict, optimizer_state_dict|None)."""
+    path = model_path + ".pdparams"
+    if not os.path.exists(path):
+        path = model_path + ".pdparams.npz"
+    enforce(os.path.exists(path),
+            "no dygraph checkpoint at %r" % model_path)
+    with np.load(path) as z:
+        state = {k: z[k] for k in z.files}
+    return state, None

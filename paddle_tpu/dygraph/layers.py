@@ -1,0 +1,209 @@
+"""Dygraph Layer module system (reference:
+python/paddle/fluid/dygraph/layers.py Layer)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import unique_name
+from ..core.enforce import InvalidArgumentError, enforce
+from ..core.flags import FLAGS
+from ..framework import convert_dtype
+from ..param_attr import ParamAttr
+from .base import VarBase
+
+
+def _eager_init(init, shape, dtype, key):
+    """Evaluate an initializer eagerly (the startup-program init ops'
+    eager twin; reference initializers: python/paddle/fluid/
+    initializer.py)."""
+    from .. import initializer as I
+    dt = jnp.dtype(convert_dtype(dtype))
+    shape = tuple(shape)
+    if init is None:
+        init = I.Xavier()
+    if isinstance(init, I.ConstantInitializer):
+        return jnp.full(shape, init.value, dt)
+    if isinstance(init, I.UniformInitializer):
+        return jax.random.uniform(key, shape, dt, init.low, init.high)
+    if isinstance(init, I.NormalInitializer):
+        return init.loc + init.scale * jax.random.normal(key, shape, dt)
+    if isinstance(init, I.TruncatedNormalInitializer):
+        return init.loc + init.scale * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dt)
+    if isinstance(init, I.NumpyArrayInitializer):
+        return jnp.asarray(init.value, dt)
+    if isinstance(init, (I.XavierInitializer, I.MSRAInitializer)):
+        import types
+        fi, fo = I._fan_in_out(types.SimpleNamespace(shape=shape))
+        if isinstance(init, I.XavierInitializer):
+            fi = init.fan_in if init.fan_in is not None else fi
+            fo = init.fan_out if init.fan_out is not None else fo
+            if init.uniform:
+                lim = float(np.sqrt(6.0 / (fi + fo)))
+                return jax.random.uniform(key, shape, dt, -lim, lim)
+            std = float(np.sqrt(2.0 / (fi + fo)))
+            return std * jax.random.normal(key, shape, dt)
+        fi = init.fan_in if init.fan_in is not None else fi
+        if init.uniform:
+            lim = float(np.sqrt(6.0 / fi))
+            return jax.random.uniform(key, shape, dt, -lim, lim)
+        std = float(np.sqrt(2.0 / fi))
+        return std * jax.random.normal(key, shape, dt)
+    raise InvalidArgumentError("unsupported initializer %r in dygraph"
+                               % (init,))
+
+
+class Parameter(VarBase):
+    is_parameter = True
+
+    def __init__(self, value, name, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+
+
+class Layer:
+    """Reference: dygraph/layers.py Layer — parameter/sublayer
+    registration via attribute assignment, forward() override."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        cls = self.__class__.__name__.lower()
+        self._full_name = unique_name.generate(
+            name_scope if name_scope else cls)
+        self._dtype = dtype
+        self._parameters: Dict[str, Parameter] = {}
+        self._buffers: Dict[str, VarBase] = {}
+        self._sub_layers: Dict[str, "Layer"] = {}
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- registration --------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, Parameter) and params is not None:
+            params[name] = value
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+        object.__setattr__(self, name, value)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name, varbase):
+        """Non-trainable state saved in state_dict (running BN stats
+        etc. — the reference persists these as persistable non-param
+        vars)."""
+        self._buffers[name] = varbase
+        object.__setattr__(self, name, varbase)
+        return varbase
+
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias=False, default_initializer=None):
+        from .. import initializer as I
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None and is_bias:
+            init = I.Constant(0.0)
+        name = attr.name or unique_name.generate(
+            self._full_name + (".b" if is_bias else ".w"))
+        import zlib
+        seed = FLAGS.global_seed or 0
+        key = jax.random.fold_in(jax.random.key(seed),
+                                 zlib.crc32(name.encode()))
+        value = _eager_init(init, shape, dtype, key)
+        return Parameter(value, name, trainable=attr.trainable)
+
+    # -- traversal -----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                out.extend(sub.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                out.extend(sub.sublayers())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for name, p in self._parameters.items():
+            yield (prefix + name if not prefix
+                   else prefix + "." + name), p
+        for sname, sub in self._sub_layers.items():
+            sp = sname if not prefix else prefix + "." + sname
+            yield from sub.named_parameters(sp)
+
+    def named_buffers(self, prefix=""):
+        for name, b in self._buffers.items():
+            yield (prefix + name if not prefix
+                   else prefix + "." + name), b
+        for sname, sub in self._sub_layers.items():
+            sp = sname if not prefix else prefix + "." + sname
+            yield from sub.named_buffers(sp)
+
+    # -- train/eval ----------------------------------------------------------
+    def train(self):
+        self.training = True
+        for sub in self._sub_layers.values():
+            sub.train()
+
+    def eval(self):
+        self.training = False
+        for sub in self._sub_layers.values():
+            sub.eval()
+
+    # -- state dict (reference: dygraph/checkpoint.py save/load_dict) -------
+    def state_dict(self, include_sublayers=True):
+        out = {name: np.asarray(p.value)
+               for name, p in self.named_parameters()}
+        out.update({name: np.asarray(b.value)
+                    for name, b in self.named_buffers()})
+        return out
+
+    def set_dict(self, state, include_sublayers=True):
+        named = dict(self.named_parameters())
+        named.update(dict(self.named_buffers()))
+        for name, val in state.items():
+            enforce(name in named,
+                    "state dict key %r not found in layer — if the "
+                    "layer builds parameters lazily (FC without "
+                    "input_dim), run one forward pass before "
+                    "set_dict" % name)
+            p = named[name]
+            enforce(tuple(np.shape(val)) == p.shape,
+                    "shape mismatch for %r: %s vs %s"
+                    % (name, np.shape(val), p.shape))
+            p.value = jnp.asarray(val)
+
+    load_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)

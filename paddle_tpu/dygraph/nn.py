@@ -1,0 +1,234 @@
+"""Dygraph layer classes (reference: python/paddle/fluid/dygraph/nn.py
+— Conv2D, Pool2D, FC, BatchNorm, Embedding, LayerNorm, GRUUnit...).
+Each forward executes registered op lowerings eagerly via
+run_dygraph_op, so dygraph and static graphs share one kernel
+vocabulary."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.enforce import enforce
+from .base import VarBase, run_dygraph_op
+from .layers import Layer
+
+__all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm",
+           "Embedding", "LayerNorm", "GRUUnit", "Dropout"]
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_channels=None,
+                 num_filters=None, filter_size=3, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        ks = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        self._attrs = {"strides": stride, "paddings": padding,
+                       "dilations": dilation, "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(num_filters, num_channels // groups) + tuple(ks),
+            attr=param_attr)
+        self.bias = self.create_parameter(shape=(num_filters,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        out = run_dygraph_op(
+            "conv2d", {"Input": [x], "Filter": [self.weight]},
+            dict(self._attrs))
+        if self.bias is not None:
+            out = run_dygraph_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]},
+                {"axis": 1})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=2, pool_type="max",
+                 pool_stride=2, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"pooling_type": pool_type, "ksize": pool_size,
+                       "strides": pool_stride, "paddings": pool_padding,
+                       "global_pooling": global_pooling,
+                       "ceil_mode": ceil_mode}
+
+    def forward(self, x):
+        return run_dygraph_op("pool2d", {"X": [x]}, dict(self._attrs))
+
+
+class FC(Layer):
+    """Reference: dygraph/nn.py FC — projects [B, ...] to [B, size]."""
+
+    def __init__(self, name_scope=None, size=None, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None,
+                 input_dim=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+        if input_dim is not None:
+            self._build(input_dim)
+
+    def _build(self, in_features):
+        self.weight = self.create_parameter(
+            shape=(in_features, self._size), attr=self._param_attr)
+        self.bias = self.create_parameter(
+            shape=(self._size,), attr=self._bias_attr, is_bias=True)
+
+    def forward(self, x):
+        if self.weight is None:  # lazy build from first input
+            in_features = 1
+            for d in x.shape[self._nfd:]:
+                in_features *= d
+            self._build(in_features)
+        out = run_dygraph_op(
+            "mul", {"X": [x], "Y": [self.weight]},
+            {"x_num_col_dims": self._nfd, "y_num_col_dims": 1})
+        if self.bias is not None:
+            out = run_dygraph_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]},
+                {"axis": -1})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class Linear(FC):
+    """2.x-style alias: Linear(in_features, out_features)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(None, size=output_dim, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act,
+                         input_dim=input_dim, dtype=dtype)
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 is_test=False, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW"):
+        super().__init__(name_scope, dtype)
+        from .. import initializer as I
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(num_channels,), attr=param_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            shape=(num_channels,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean",
+                             VarBase(np.zeros(num_channels,
+                                              np.float32)))
+        self.register_buffer("_variance",
+                             VarBase(np.ones(num_channels,
+                                             np.float32)))
+
+    def forward(self, x):
+        out, mean_out, var_out, _sm, _sv = run_dygraph_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            dict(self._attrs, is_test=not self.training))
+        self._mean.value = mean_out.value
+        self._variance.value = var_out.value
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(shape=tuple(size),
+                                            attr=param_attr)
+
+    def forward(self, ids):
+        return run_dygraph_op(
+            "lookup_table", {"W": [self.weight], "Ids": [ids]},
+            {"padding_idx": self._padding_idx})
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None,
+                 scale=True, shift=True, begin_norm_axis=1,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        from .. import initializer as I
+        n = 1
+        shape = normalized_shape if isinstance(
+            normalized_shape, (list, tuple)) else [normalized_shape]
+        for d in shape:
+            n *= d
+        self._attrs = {"epsilon": epsilon,
+                       "begin_norm_axis": begin_norm_axis}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(n,), attr=param_attr,
+            default_initializer=I.Constant(1.0)) if scale else None
+        self.bias = self.create_parameter(
+            shape=(n,), attr=bias_attr, is_bias=True) if shift else None
+
+    def forward(self, x):
+        inputs = {"X": [x]}
+        if self.weight is not None:
+            inputs["Scale"] = [self.weight]
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        out, _m, _v = run_dygraph_op("layer_norm", inputs,
+                                     dict(self._attrs))
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class GRUUnit(Layer):
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, activation="tanh",
+                 gate_activation="sigmoid", dtype="float32"):
+        super().__init__(name_scope, dtype)
+        enforce(size is not None and size % 3 == 0,
+                "GRUUnit size must be 3*hidden")
+        hidden = size // 3
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation}
+        self.weight = self.create_parameter(
+            shape=(hidden, 3 * hidden), attr=param_attr)
+        self.bias = self.create_parameter(
+            shape=(1, 3 * hidden), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, hidden):
+        return run_dygraph_op(
+            "gru_unit",
+            {"X": [input], "HPrev": [hidden], "Weight": [self.weight],
+             "Bias": [self.bias]},
+            {"gate_activation": self._attrs["gate_activation"],
+             "activation": self._attrs["activation"]})
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__(None)
+        self._p = p
+
+    def forward(self, x):
+        if not self.training or self._p == 0:
+            return x
+        out, _mask = run_dygraph_op(
+            "dropout", {"X": [x]},
+            {"dropout_prob": self._p, "is_test": False,
+             "dropout_implementation": "upscale_in_train"})
+        return out

@@ -1,0 +1,136 @@
+"""Eager optimizer application for dygraph mode.
+
+Reference: in dygraph the same fluid optimizers apply grads held on
+VarBases (python/paddle/fluid/optimizer.py minimize under
+imperative mode; imperative/layer.h:116). Here each optimizer's
+update rule is the SAME registered op lowering the static path appends
+(ops/optimizer_ops.py), executed eagerly with state kept on the
+optimizer instance."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..core.enforce import UnimplementedError, enforce
+from .base import VarBase
+
+
+def _state(opt) -> Dict[int, dict]:
+    if not hasattr(opt, "_dygraph_state"):
+        opt._dygraph_state = {}
+    return opt._dygraph_state
+
+
+def _lr(opt):
+    lr = opt._learning_rate
+    if callable(lr):
+        lr = lr()
+    return jnp.float32(float(lr))
+
+
+def _eager_clip(grad_clip, pairs):
+    """Eager equivalents of the clip attrs (reference: clip.py)."""
+    from .. import clip as C
+    if isinstance(grad_clip, C.GradientClipByValue):
+        return [(p, jnp.clip(g, grad_clip.min, grad_clip.max))
+                for p, g in pairs]
+    if isinstance(grad_clip, C.GradientClipByNorm):
+        out = []
+        for p, g in pairs:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            out.append((p, g * jnp.minimum(1.0,
+                                           grad_clip.clip_norm / n)))
+        return out
+    if isinstance(grad_clip, C.GradientClipByGlobalNorm):
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                             for _p, g in pairs))
+        scale = grad_clip.clip_norm / jnp.maximum(
+            total, grad_clip.clip_norm)
+        return [(p, g * scale) for p, g in pairs]
+    raise UnimplementedError("unsupported grad_clip %r in dygraph"
+                             % (grad_clip,))
+
+
+def _eager_regularize(reg, pairs):
+    from .. import regularizer as R
+    if reg is None:
+        return pairs
+    if isinstance(reg, R.L2DecayRegularizer):
+        return [(p, g + reg._coeff * p.value) for p, g in pairs]
+    if isinstance(reg, R.L1DecayRegularizer):
+        return [(p, g + reg._coeff * jnp.sign(p.value))
+                for p, g in pairs]
+    raise UnimplementedError("unsupported regularizer %r in dygraph"
+                             % (reg,))
+
+
+def apply_dygraph(opt, loss: VarBase, parameter_list=None,
+                  grad_clip=None):
+    """minimize() in dygraph mode: backward + eager per-param update
+    (with the same clip -> regularize -> update order as the static
+    path). Returns the [(param, grad)] list like the static minimize."""
+    loss.backward()
+    params = [p for p in (parameter_list or [])] or _collect_params(loss)
+    name = type(opt).__name__.lower()
+    pairs = [(p, p.grad) for p in params
+             if p.grad is not None and getattr(p, "trainable", True)]
+    if grad_clip is not None:
+        pairs = _eager_clip(grad_clip, pairs)
+    pairs = _eager_regularize(opt.regularization, pairs)
+    result = []
+    for p, g in pairs:
+        st = _state(opt).setdefault(id(p), {})
+        lr = _lr(opt)
+        if name.startswith("sgd"):
+            p.value = ops.get("sgd").fn(p.value, g, lr)
+        elif name.startswith("momentum"):
+            v = st.setdefault("velocity", jnp.zeros_like(p.value))
+            p.value, st["velocity"] = ops.get("momentum").fn(
+                p.value, g, v, lr, mu=opt._momentum,
+                use_nesterov=opt._use_nesterov)
+        elif name.startswith("adamax"):
+            mom = st.setdefault("moment", jnp.zeros_like(p.value))
+            inf = st.setdefault("inf_norm", jnp.zeros_like(p.value))
+            b1p = st.setdefault("b1p", jnp.float32(opt._beta1))
+            (p.value, st["moment"], st["inf_norm"],
+             st["b1p"]) = ops.get("adamax").fn(
+                p.value, g, mom, inf, b1p, lr, beta1=opt._beta1,
+                beta2=opt._beta2, epsilon=opt._epsilon)
+        elif name.startswith("adamw") or name.startswith("adam"):
+            m1 = st.setdefault("m1", jnp.zeros_like(p.value))
+            m2 = st.setdefault("m2", jnp.zeros_like(p.value))
+            b1p = st.setdefault("b1p", jnp.float32(opt._beta1))
+            b2p = st.setdefault("b2p", jnp.float32(opt._beta2))
+            kw = dict(beta1=opt._beta1, beta2=opt._beta2,
+                      epsilon=opt._epsilon)
+            if name.startswith("adamw"):
+                kw["weight_decay"] = getattr(opt, "_weight_decay",
+                                             0.01)
+            (p.value, st["m1"], st["m2"], st["b1p"],
+             st["b2p"]) = ops.get(
+                "adamw" if name.startswith("adamw") else "adam").fn(
+                p.value, g, m1, m2, b1p, b2p, lr, **kw)
+        elif name.startswith("adagrad"):
+            mom = st.setdefault("moment", jnp.zeros_like(p.value))
+            p.value, st["moment"] = ops.get("adagrad").fn(
+                p.value, g, mom, lr, epsilon=opt._epsilon)
+        else:
+            raise UnimplementedError(
+                "optimizer %s has no dygraph (eager) path yet; use "
+                "SGD/Momentum/Adam/AdamW/Adagrad or the static-graph "
+                "mode" % type(opt).__name__)
+        result.append((p, g))
+        p.grad = None
+    return result
+
+
+def _collect_params(loss):
+    """Without an explicit parameter_list, dygraph users pass one via
+    optimizer ctor in 2.x; in 1.x minimize finds params from the
+    autograd graph. The tape is cleared by backward(), so require the
+    caller's list instead."""
+    raise UnimplementedError(
+        "dygraph minimize() needs parameter_list=layer.parameters()")

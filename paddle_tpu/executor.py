@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import framework, ops
+from . import profiler as _profiler
 from .core.enforce import (InvalidArgumentError, UnimplementedError,
                            enforce)
 from .core.flags import FLAGS
@@ -300,6 +301,7 @@ class Executor:
                      library,
                      dist._fingerprint() if dist is not None else None)
         fn = self._cache.get(cache_key) if use_program_cache else None
+        compiled_here = fn is None
         if fn is None:
             persistable_names = frozenset(
                 n for n, v in block.vars.items() if v.persistable)
@@ -347,15 +349,21 @@ class Executor:
                                       self._run_counter)
         self._run_counter += 1
 
-        if dist is not None:
-            feed_vals = {
-                k: jax.device_put(v, dist.feed_sharding(np.shape(v)))
-                for k, v in feed.items()}
-        else:
-            feed_vals = {k: jnp.asarray(v)
-                         if not isinstance(v, jax.Array) else v
-                         for k, v in feed.items()}
-        fetches, persist_out = fn(persist_in, feed_vals, step_key)
+        with _profiler.RecordEvent("feed_h2d"):
+            if dist is not None:
+                feed_vals = {
+                    k: jax.device_put(v,
+                                      dist.feed_sharding(np.shape(v)))
+                    for k, v in feed.items()}
+            else:
+                feed_vals = {k: jnp.asarray(v)
+                             if not isinstance(v, jax.Array) else v
+                             for k, v in feed.items()}
+        # first invocation of a jitted step traces + compiles
+        span = "executor_trace_compile" if compiled_here \
+            else "executor_run"
+        with _profiler.RecordEvent(span):
+            fetches, persist_out = fn(persist_in, feed_vals, step_key)
 
         for name, val in persist_out.items():
             scope.set_var(name, val)

@@ -114,6 +114,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
+        from . import dygraph
+        if dygraph.enabled():
+            # eager path: tape backward + in-place param updates via the
+            # same optimizer op lowerings (dygraph/optimizer_eager.py)
+            from .dygraph.optimizer_eager import apply_dygraph
+            params_grads = apply_dygraph(self, loss, parameter_list,
+                                         grad_clip=grad_clip)
+            return [], params_grads
         params_grads = self.backward(loss, startup_program,
                                      parameter_list, no_grad_set)
         if grad_clip is not None:

@@ -1,0 +1,209 @@
+"""Profiler: host-side event spans + aggregate tables + chrome trace.
+
+Reference: paddle/fluid/platform/profiler.{h,cc} (RAII ``RecordEvent``
+profiler.h:81, ``EnableProfiler/DisableProfiler`` :166-171 aggregating
+min/max/avg tables from profiler.proto), platform/device_tracer.cc
+(CUPTI device activity), python/paddle/fluid/profiler.py:39-222
+(profiler/start_profiler/stop_profiler/reset_profiler/cuda_profiler)
+and tools/timeline.py (proto -> chrome://tracing JSON).
+
+TPU-native redesign: there is no per-op runtime to instrument — the
+whole step is ONE fused XLA program — so host events cover the step
+pipeline (trace/compile/run/fetch, recorded by the Executor) and any
+user spans, while *device*-side detail comes from the XLA profiler
+(``jax.profiler``, the CUPTI/DeviceTracer analog): pass
+``profile_path`` and a TensorBoard/xprof trace is captured alongside.
+Chrome-trace export works directly from the host events (the
+timeline.py role)."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["RecordEvent", "record_event", "start_profiler",
+           "stop_profiler", "reset_profiler", "profiler",
+           "export_chrome_tracing", "cuda_profiler", "npu_profiler"]
+
+_state = threading.local()
+_lock = threading.Lock()
+_enabled = False
+_events: List["_Event"] = []
+_device_trace_dir: Optional[str] = None
+
+
+@dataclass
+class _Event:
+    name: str
+    start: float
+    end: float
+    thread: int
+    depth: int
+
+    @property
+    def dur(self):
+        return self.end - self.start
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+class RecordEvent:
+    """RAII span (reference: platform/profiler.h:81). Usable as a
+    context manager or via ``record_event``. No-op unless the profiler
+    is enabled — cheap enough to leave in hot paths."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        if _enabled:
+            self._t0 = time.perf_counter()
+            _stack().append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            end = time.perf_counter()
+            stack = _stack()
+            depth = len(stack) - 1
+            stack.pop()
+            ev = _Event(name=self.name, start=self._t0, end=end,
+                        thread=threading.get_ident(), depth=depth)
+            with _lock:
+                _events.append(ev)
+        return False
+
+
+record_event = RecordEvent
+
+
+def start_profiler(state="All", trace_path=None):
+    """Reference: profiler.py start_profiler (state CPU/GPU/All; GPU
+    maps to the TPU/XLA device trace here). ``trace_path`` starts a
+    jax.profiler trace capturing device activity (xprof)."""
+    global _enabled, _device_trace_dir
+    if _enabled:
+        return
+    _enabled = True
+    if trace_path and state in ("GPU", "TPU", "All"):
+        try:
+            import jax
+            jax.profiler.start_trace(trace_path)
+            _device_trace_dir = trace_path
+        except Exception:
+            _device_trace_dir = None
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """Aggregate + print the event table (reference: DisableProfiler →
+    PrintProfiler, profiler.cc); optionally dump chrome tracing JSON to
+    ``profile_path`` (the timeline.py step, no separate tool needed)."""
+    global _enabled, _device_trace_dir
+    if not _enabled:
+        return
+    _enabled = False
+    if _device_trace_dir is not None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _device_trace_dir = None
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    print(summary_table(sorted_key))
+
+
+def summary_table(sorted_key=None) -> str:
+    with _lock:
+        events = list(_events)
+    agg = {}
+    for ev in events:
+        rec = agg.setdefault(ev.name,
+                             {"calls": 0, "total": 0.0,
+                              "min": float("inf"), "max": 0.0})
+        rec["calls"] += 1
+        rec["total"] += ev.dur
+        rec["min"] = min(rec["min"], ev.dur)
+        rec["max"] = max(rec["max"], ev.dur)
+    wall = sum(r["total"] for r in agg.values()) or 1.0
+    rows = []
+    for name, r in agg.items():
+        rows.append((name, r["calls"], r["total"] * 1e3,
+                     r["min"] * 1e3, r["max"] * 1e3,
+                     r["total"] / r["calls"] * 1e3,
+                     r["total"] / wall))
+    key = {None: lambda x: -x[2], "default": lambda x: -x[2],
+           "total": lambda x: -x[2], "calls": lambda x: -x[1],
+           "name": lambda x: x[0], "max": lambda x: -x[4],
+           "min": lambda x: -x[3], "ave": lambda x: -x[5]}[sorted_key]
+    rows.sort(key=key)
+    lines = ["------------------------->     Profiling Report     "
+             "<-------------------------", "",
+             "%-32s %8s %12s %10s %10s %10s %8s" %
+             ("Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+              "Ave(ms)", "Ratio")]
+    for name, calls, total, mn, mx, ave, ratio in rows:
+        lines.append("%-32s %8d %12.4f %10.4f %10.4f %10.4f %7.2f%%"
+                     % (name[:32], calls, total, mn, mx, ave,
+                        ratio * 100.0))
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(path):
+    """chrome://tracing JSON from the host events (reference:
+    tools/timeline.py converting profiler.proto)."""
+    with _lock:
+        events = list(_events)
+    if not events:
+        base = 0.0
+    else:
+        base = min(ev.start for ev in events)
+    trace = {"traceEvents": [
+        {"name": ev.name, "cat": "host", "ph": "X",
+         "ts": (ev.start - base) * 1e6, "dur": ev.dur * 1e6,
+         "pid": 0, "tid": ev.thread % 10000,
+         "args": {"depth": ev.depth}}
+        for ev in events]}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             trace_path=None):
+    """Reference: profiler.py profiler() context manager."""
+    start_profiler(state, trace_path=trace_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    """Accepted for API parity; device tracing on TPU goes through
+    ``trace_path``/jax.profiler (reference: profiler.py cuda_profiler
+    wrapping cudaProfilerStart/Stop)."""
+    yield
+
+
+npu_profiler = cuda_profiler

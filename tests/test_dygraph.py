@@ -1,0 +1,232 @@
+"""Dygraph tests (reference: test_imperative_basic.py,
+test_imperative_mnist.py — dygraph-vs-static equality,
+test_imperative_checkpoint.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import nn as dnn
+
+
+def test_to_variable_and_arith_backward():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0, 2.0, 3.0], np.float32))
+        w = dygraph.Parameter(np.array([2.0, 2.0, 2.0], np.float32),
+                              "w")
+        y = x * w + 1.0
+        loss = dygraph.run_dygraph_op("reduce_sum", {"X": [y]},
+                                      {"dim": None, "keep_dim": False,
+                                       "reduce_all": True})
+        loss.backward()
+        np.testing.assert_allclose(w.gradient(), [1.0, 2.0, 3.0])
+        assert x.gradient() is None  # stop_gradient input
+
+
+def test_linear_regression_trains():
+    rng = np.random.RandomState(0)
+    x_np = rng.rand(32, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    y_np = x_np @ w_true
+
+    with dygraph.guard():
+        model = dnn.Linear(4, 1)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        losses = []
+        for _ in range(200):
+            x = dygraph.to_variable(x_np)
+            y = dygraph.to_variable(y_np)
+            pred = model(x)
+            diff = pred - y
+            loss = dygraph.run_dygraph_op(
+                "reduce_mean", {"X": [diff * diff]},
+                {"dim": None, "keep_dim": False, "reduce_all": True})
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 5e-3, losses[::20]
+        np.testing.assert_allclose(model.weight.numpy(), w_true,
+                                   atol=0.2)
+
+
+def test_mnist_style_convnet_adam():
+    rng = np.random.RandomState(1)
+    imgs = rng.rand(8, 1, 12, 12).astype(np.float32)
+    labels = rng.randint(0, 4, (8, 1)).astype(np.int64)
+
+    class Net(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = dnn.Conv2D(num_channels=1, num_filters=4,
+                                   filter_size=3, act="relu")
+            self.pool = dnn.Pool2D(pool_size=2, pool_stride=2)
+            self.fc = dnn.FC(size=4)
+
+        def forward(self, x):
+            h = self.pool(self.conv(x))
+            return self.fc(h)
+
+    with dygraph.guard():
+        net = Net()
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=0.01)
+        losses = []
+        for _ in range(40):
+            x = dygraph.to_variable(imgs)
+            lbl = dygraph.to_variable(labels)
+            logits = net(x)
+            sm, loss_vec = dygraph.run_dygraph_op(
+                "softmax_with_cross_entropy",
+                {"Logits": [logits], "Label": [lbl]}, {})
+            loss = dygraph.run_dygraph_op(
+                "reduce_mean", {"X": [loss_vec]},
+                {"dim": None, "keep_dim": False, "reduce_all": True})
+            opt.minimize(loss, parameter_list=net.parameters())
+            net.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.3, losses[::8]
+
+
+def test_dygraph_matches_static_fc():
+    """Same weights, same input -> dygraph forward == static forward
+    (the test_imperative_* equality pattern)."""
+    rng = np.random.RandomState(2)
+    x_np = rng.rand(4, 6).astype(np.float32)
+    w_np = rng.rand(6, 3).astype(np.float32)
+    b_np = rng.rand(3).astype(np.float32)
+
+    # static
+    from paddle_tpu import layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 6], append_batch_size=False)
+        out = layers.fc(
+            x, size=3, act="relu",
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    w_np)),
+            bias_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    b_np)))
+    exe = fluid.Executor()
+    exe.run(startup)
+    (static_out,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+
+    # dygraph
+    with dygraph.guard():
+        fc = dnn.FC(size=3, act="relu")
+        _ = fc(dygraph.to_variable(x_np))  # build lazily
+        fc.weight.value = __import__("jax.numpy",
+                                     fromlist=["asarray"]).asarray(w_np)
+        fc.bias.value = __import__("jax.numpy",
+                                   fromlist=["asarray"]).asarray(b_np)
+        dy_out = fc(dygraph.to_variable(x_np)).numpy()
+    np.testing.assert_allclose(dy_out, static_out, rtol=1e-5)
+
+
+def test_layer_state_dict_save_load(tmp_path):
+    with dygraph.guard():
+        net = dnn.Linear(5, 2)
+        sd = net.state_dict()
+        assert len(sd) == 2
+        path = str(tmp_path / "model")
+        dygraph.save_dygraph(sd, path)
+        net2 = dnn.Linear(5, 2)
+        state, _ = dygraph.load_dygraph(path)
+        net2.set_dict(state)
+        for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                      net2.named_parameters()):
+            np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+def test_train_eval_mode_dropout():
+    with dygraph.guard():
+        drop = dnn.Dropout(0.5)
+        x = dygraph.to_variable(np.ones((100,), np.float32))
+        drop.eval()
+        np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+        drop.train()
+        out = drop(x).numpy()
+        assert (out == 0).any() and (out != 0).any()
+
+
+def test_batchnorm_updates_running_stats():
+    rng = np.random.RandomState(3)
+    with dygraph.guard():
+        bn = dnn.BatchNorm(num_channels=3)
+        x = dygraph.to_variable(
+            (rng.rand(4, 3, 5, 5) * 10).astype(np.float32))
+        before = bn._mean.numpy().copy()
+        bn(x)
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
+        # eval mode: stats frozen
+        bn.eval()
+        frozen = bn._mean.numpy().copy()
+        bn(x)
+        np.testing.assert_array_equal(frozen, bn._mean.numpy())
+
+
+def test_no_grad_blocks_tape():
+    with dygraph.guard():
+        w = dygraph.Parameter(np.ones(3, np.float32), "w")
+        with dygraph.no_grad():
+            y = w * 2.0
+        assert y.stop_gradient
+        z = w * 3.0
+        loss = dygraph.run_dygraph_op(
+            "reduce_sum", {"X": [z + y.detach()]},
+            {"dim": None, "keep_dim": False, "reduce_all": True})
+        loss.backward()
+        np.testing.assert_allclose(w.gradient(), [3.0, 3.0, 3.0])
+
+
+def test_batchnorm_stats_in_state_dict(tmp_path):
+    rng = np.random.RandomState(5)
+    with dygraph.guard():
+        bn = dnn.BatchNorm(num_channels=2)
+        x = dygraph.to_variable(
+            (rng.rand(4, 2, 3, 3) * 7).astype(np.float32))
+        bn(x)
+        sd = bn.state_dict()
+        assert any("_mean" in k for k in sd)
+        path = str(tmp_path / "bn")
+        dygraph.save_dygraph(sd, path)
+        bn2 = dnn.BatchNorm(num_channels=2)
+        state, _ = dygraph.load_dygraph(path)
+        bn2.set_dict(state)
+        np.testing.assert_array_equal(bn2._mean.numpy(),
+                                      bn._mean.numpy())
+
+
+def test_dygraph_grad_clip_global_norm():
+    with dygraph.guard():
+        w = dygraph.Parameter(np.ones(4, np.float32), "w")
+        x = dygraph.to_variable(
+            np.array([3.0, 4.0, 0.0, 0.0], np.float32))
+        loss = dygraph.run_dygraph_op(
+            "reduce_sum", {"X": [x * w]},
+            {"dim": None, "keep_dim": False, "reduce_all": True})
+        opt = fluid.optimizer.SGD(learning_rate=1.0)
+        opt.minimize(loss, parameter_list=[w],
+                     grad_clip=fluid.clip.GradientClipByGlobalNorm(1.0))
+        # grad [3,4,0,0] norm 5 -> clipped to g/5
+        np.testing.assert_allclose(
+            w.numpy(), 1.0 - np.array([0.6, 0.8, 0.0, 0.0]),
+            rtol=1e-5)
+
+
+def test_adamax_dygraph_uses_adamax_rule():
+    with dygraph.guard():
+        w = dygraph.Parameter(np.array([1.0], np.float32), "w")
+        x = dygraph.to_variable(np.array([2.0], np.float32))
+        loss = dygraph.run_dygraph_op(
+            "reduce_sum", {"X": [x * w]},
+            {"dim": None, "keep_dim": False, "reduce_all": True})
+        opt = fluid.optimizer.Adamax(learning_rate=0.1, beta1=0.9,
+                                     beta2=0.999, epsilon=1e-8)
+        opt.minimize(loss, parameter_list=[w])
+        # one adamax step: m=0.1*g=0.2, inf=|g|=2, lr_t=lr/(1-b1p*b1)
+        # after update b1p starts at 0.9: lr_t = 0.1/(1-0.9)=1.0
+        # p = 1 - 1.0 * 0.2 / (2+eps) = 0.9
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-5)

@@ -1,0 +1,62 @@
+"""Profiler tests (reference: test_profiler.py, tools/timeline.py)."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, profiler
+
+
+def _small_train(n=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 8], append_batch_size=False)
+        loss = layers.reduce_sum(layers.fc(x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    for _ in range(n):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+
+
+def test_record_event_and_table(capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+    _small_train()
+    profiler.stop_profiler(sorted_key="total")
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "outer" in out and "inner" in out
+    assert "executor_run" in out
+    assert "executor_trace_compile" in out
+    assert "feed_h2d" in out
+
+
+def test_chrome_trace_export(tmp_path):
+    profiler.reset_profiler()
+    path = str(tmp_path / "trace.json")
+    with profiler.profiler("CPU", sorted_key="total",
+                           profile_path=path):
+        _small_train()
+    data = json.load(open(path))
+    evs = data["traceEvents"]
+    assert len(evs) >= 4
+    names = {e["name"] for e in evs}
+    assert "executor_run" in names
+    for e in evs:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_disabled_profiler_records_nothing():
+    profiler.reset_profiler()
+    with profiler.RecordEvent("should_not_appear"):
+        pass
+    table = profiler.summary_table()
+    assert "should_not_appear" not in table
