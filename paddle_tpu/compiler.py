@@ -149,10 +149,16 @@ class CompiledProgram:
     def persist_sharding(self, var: Variable) -> NamedSharding:
         return NamedSharding(self._mesh, self._var_spec(var))
 
-    def feed_sharding(self, shape) -> NamedSharding:
+    def feed_sharding(self, shape, name=None) -> NamedSharding:
         """Batch-shard a feed over dp when its leading dim divides
         evenly; otherwise replicate (partial final batches, scalar
-        feeds like learning rates)."""
+        feeds like learning rates). A feed var annotated via
+        parallel.shard (e.g. sequence-sharded inputs for sp) uses its
+        own spec."""
+        if name is not None:
+            var = self.program.global_block().vars.get(name)
+            if var is not None and var.sharding is not None:
+                return NamedSharding(self._mesh, var.sharding)
         dp = self._mesh.shape.get("dp", 1)
         if dp > 1 and len(shape) > 0 and shape[0] % dp == 0:
             return NamedSharding(self._mesh,
@@ -178,7 +184,11 @@ class CompiledProgram:
     def run(self, exe, feed, fetch_list, scope, return_numpy,
             use_program_cache=True):
         from .core.scope import global_scope
-        return exe._run_impl(self.program, feed or {}, fetch_list or [],
-                             scope or global_scope(), return_numpy,
-                             dist=self,
-                             use_program_cache=use_program_cache)
+        # ops that are mesh-aware (ring_attention, sp/ep lowerings)
+        # read the ambient mesh during tracing
+        with mesh_lib.mesh_guard(self._mesh):
+            return exe._run_impl(self.program, feed or {},
+                                 fetch_list or [],
+                                 scope or global_scope(), return_numpy,
+                                 dist=self,
+                                 use_program_cache=use_program_cache)

@@ -353,7 +353,8 @@ class Executor:
             if dist is not None:
                 feed_vals = {
                     k: jax.device_put(v,
-                                      dist.feed_sharding(np.shape(v)))
+                                      dist.feed_sharding(np.shape(v),
+                                                         k))
                     for k, v in feed.items()}
             else:
                 feed_vals = {k: jnp.asarray(v)

@@ -18,3 +18,6 @@ from .mesh import (current_mesh, data_parallel_mesh, make_mesh,  # noqa
                    mesh_guard, named_sharding, set_mesh,
                    shard_batch_spec)
 from .api import shard, replicate  # noqa: F401
+from . import ring_attention  # noqa: F401  (registers the op)
+from .ring_attention import ring_attention as ring_attention_fn  # noqa
+from . import multihost  # noqa: F401

@@ -1,0 +1,87 @@
+"""Multi-host (pod) runtime coordination.
+
+Reference: the NCCL2 bootstrap — rank0 creates an ncclUniqueId and
+serves it to peers over gRPC (gen_nccl_id_op.cc:31,162,179), then
+ParallelExecutor runs num_trainers*ndev ranks (parallel_executor.cc:
+319); trainer role env vars come from transpiler/fleet role makers.
+
+TPU-native redesign: the PJRT distributed runtime replaces the
+nccl-id handshake — ``jax.distributed.initialize(coordinator, n,
+rank)`` is the gen_nccl_id analog; afterwards every process sees the
+global device list, one Mesh spans the pod, and GSPMD collectives ride
+ICI within a slice / DCN across slices (the MultiNCCLContextMap
+hierarchy is expressed by mesh axis order: outer axes land on DCN).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+
+from ..core.enforce import enforce
+from .mesh import AXIS_ORDER, make_mesh
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Bootstrap the multi-process runtime (reference: NCCL2 transpile
+    mode + PADDLE_TRAINER_* env vars; here also the PADDLE_* spelling
+    is honored for drop-in launch scripts)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or \
+        os.environ.get("PADDLE_COORDINATOR") or \
+        _first_endpoint(os.environ.get("PADDLE_TRAINER_ENDPOINTS"))
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    _initialized = True
+
+
+def _first_endpoint(endpoints):
+    if not endpoints:
+        return None
+    return endpoints.split(",")[0]
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def world_size() -> int:
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def pod_mesh(axes: Optional[Dict[str, int]] = None):
+    """A mesh spanning every device of every process. Without ``axes``,
+    builds {"dp": n_processes, <inner>: devices_per_process} so the
+    cross-host axis (DCN) carries only data-parallel all-reduces —
+    the hierarchical-allreduce layout of the reference
+    (MultiNCCLContextMap, nccl_helper.h:179)."""
+    if axes is None:
+        n_proc = jax.process_count()
+        per_proc = jax.local_device_count()
+        if n_proc > 1:
+            axes = {"dp": n_proc * per_proc}
+        else:
+            axes = {"dp": per_proc}
+    return make_mesh(axes)

@@ -1,0 +1,111 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh
+axis.
+
+Not present in the 2019 reference (SURVEY §5 "long-context") — this is
+a new TPU-first capability: sequences longer than one chip's HBM are
+sharded over the mesh's ``sp`` axis; each device holds a query block
+and the key/value blocks rotate around the ring with
+``lax.ppermute`` (one ICI hop per step) while a numerically-stable
+online softmax accumulates the attention output. Compute for block i
+overlaps the transfer of block i+1 (XLA schedules the ppermute ahead),
+so the ring cost hides behind the matmuls at transformer scale.
+
+Composable three ways:
+  - pure function ``ring_attention(q, k, v, ...)`` over globally
+    sharded arrays (shard_map under the hood);
+  - registered op ``ring_attention`` for static Programs (falls back
+    to single-device fused attention when no sp axis is in scope);
+  - inside user shard_map code via ``ring_attention_inner``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ..ops.registry import register
+from . import mesh as mesh_lib
+
+_NEG = -1.0e30
+
+
+def ring_attention_inner(q, k, v, *, axis_name, n_blocks, scale=1.0,
+                         causal=False, bias_blk=None):
+    """Per-shard body (call inside shard_map/pmap). q,k,v: local
+    [B, H, S_loc, Dh] blocks of the sequence-sharded arrays."""
+    B, H, Sq, Dh = q.shape
+    Sk = k.shape[2]
+    my = jax.lax.axis_index(axis_name)
+
+    m = jnp.full((B, H, Sq, 1), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    acc = jnp.zeros((B, H, Sq, Dh), jnp.float32)
+    perm = [(j, (j + 1) % n_blocks) for j in range(n_blocks)]
+
+    q32 = q.astype(jnp.float32)
+    for step in range(n_blocks):
+        src = (my - step) % n_blocks  # whose k/v block we hold now
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       k.astype(jnp.float32)) * scale
+        if bias_blk is not None:
+            s = s + bias_blk
+        if causal:
+            q_pos = my * Sq + jax.lax.broadcasted_iota(
+                jnp.int32, (Sq, Sk), 0)
+            k_pos = src * Sk + jax.lax.broadcasted_iota(
+                jnp.int32, (Sq, Sk), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        m = m_new
+        if step != n_blocks - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis="sp", scale=1.0,
+                   causal=False):
+    """Global-view entry: q,k,v [B, H, S, Dh] (sharded or not — the
+    shard_map in_specs place them on the sp axis)."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] == 1:
+        # no sequence axis in scope: plain fused attention
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            Sq, Sk = q.shape[2], k.shape[2]
+            q_pos = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+    n = mesh.shape[axis]
+    spec = PartitionSpec(None, None, axis, None)
+    f = shard_map(
+        functools.partial(ring_attention_inner, axis_name=axis,
+                          n_blocks=n, scale=scale, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return f(q, k, v)
+
+
+@register("ring_attention", ["Q", "K", "V"], ["Out"])
+def ring_attention_op(q, k, v, *, scale=1.0, causal=False,
+                      axis="sp"):
+    """Static-graph op: uses the ambient mesh (set by
+    CompiledProgram.run / mesh_guard)."""
+    return ring_attention(q, k, v, axis=axis, scale=scale,
+                          causal=causal)

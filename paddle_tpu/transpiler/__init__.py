@@ -1,0 +1,138 @@
+"""Distribute/memory transpilers — API-compatible front ends.
+
+Reference: python/paddle/fluid/transpiler/ (distribute_transpiler.py:178
+DistributeTranspiler — slices params into blocks :69,:1286, rewrites
+trainer programs with send/recv :646, generates pserver programs with
+server-side optimize blocks :780; ps_dispatcher.py round-robin/hash
+placement; memory_optimization_transpiler.py).
+
+TPU-native redesign: the parameter-server topology dissolves. Dense
+params + optimizer state shard over the mesh (ZeRO-style
+ReduceStrategy.Reduce — the kReduce strategy was exactly the PS
+update-sharding idea in-graph), and collectives replace send/recv.
+``DistributeTranspiler`` keeps the reference's API so launch scripts
+run unchanged:
+  - mode="nccl2" (collective DP): returns the program untouched and
+    records trainer topology; run it under CompiledProgram/fleet with
+    a pod mesh (multihost.init_parallel_env is the gen_nccl_id
+    analog).
+  - PS mode: get_trainer_program() returns the original program
+    configured for sharded-state execution; get_pserver_program()
+    raises with guidance — there is no separate server process to run
+    on a TPU pod.
+"""
+
+from __future__ import annotations
+
+from ..core.enforce import UnavailableError, enforce
+from ..framework import Program, default_main_program
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "memory_optimize", "release_memory", "HashName",
+           "RoundRobin"]
+
+
+class DistributeTranspilerConfig:
+    """Reference: distribute_transpiler.py:130."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = RoundRobin
+        self.min_block_size = 8192
+        self.enable_dc_asgd = False
+        self.mode = "pserver"
+        self.print_log = False
+        self.wait_port = True
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+
+
+class _PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(_PSDispatcher):
+    """Reference: ps_dispatcher.py RoundRobin."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _v in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(_PSDispatcher):
+    """Reference: ps_dispatcher.py HashName."""
+
+    def dispatch(self, varlist):
+        import zlib
+        return [self._eps[zlib.crc32(v.name.encode()) % len(self._eps)]
+                for v in varlist]
+
+
+class DistributeTranspiler:
+    """Reference: distribute_transpiler.py:178 (see module docstring
+    for the TPU mapping)."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6170",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6170"):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers if isinstance(trainers, int) \
+            else len(trainers.split(","))
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.pserver_endpoints = pservers.split(",")
+        self._transpiled = True
+        if self.config.mode == "nccl2":
+            # collective mode: topology only; the pod mesh + GSPMD
+            # collectives replace inserted allreduce ops
+            return
+        # PS mode: dense parameter serving maps to ZeRO-sharded state;
+        # annotate the program so CompiledProgram defaults to Reduce
+        from ..compiler import BuildStrategy
+        bs = BuildStrategy()
+        bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+        bs.num_trainers = self.trainer_num
+        bs.trainer_id = trainer_id
+        self.origin_program._build_strategy = bs
+
+    def get_trainer_program(self, wait_port=True) -> Program:
+        enforce(self._transpiled, "call transpile() first")
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint):
+        raise UnavailableError(
+            "there are no parameter-server processes on a TPU pod: "
+            "dense parameters shard over the device mesh "
+            "(BuildStrategy.ReduceStrategy.Reduce — already set on the "
+            "trainer program by transpile()); launch every process as "
+            "a trainer with parallel.multihost.init_parallel_env()")
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        return self.get_pserver_program(endpoint)
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Reference: memory_optimization_transpiler.py — var-reuse
+    rewriting. XLA's buffer assignment performs this; parity no-op."""
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program
