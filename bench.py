@@ -2,11 +2,14 @@
 (print_train_time :296-301 reports examples/sec).
 
 Headline metric: Transformer-base NMT training tokens/sec/chip
-(BASELINE.json config 3). Prints ONE JSON line:
-{"metric", "value", "unit", "vs_baseline"}.
+(BASELINE.json config 3), trained under bf16 AMP
+(contrib.mixed_precision.decorate) with the pallas kernel library when
+it wins (the operators/jit/benchmark.cc best-impl-wins pattern).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
+``vs_baseline`` is measured MFU over the 0.40 north-star (>=0.8x A100
+MFU per BASELINE.md); ``--all`` adds the other four BASELINE configs.
 
 Runs on whatever backend JAX sees (the driver provides the real chip).
-``python bench.py --all`` also reports the secondary configs.
 """
 
 from __future__ import annotations
@@ -17,10 +20,99 @@ import time
 
 import numpy as np
 
+# bf16 peak matmul FLOP/s by PJRT device kind. MFU is reported only
+# when the device is recognized (CPU runs get mfu=null).
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,   # v6e (Trillium)
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+def _mfu(flops_per_step, steps_per_sec):
+    peak = _peak_flops()
+    if peak is None:
+        return None
+    return round(flops_per_step * steps_per_sec / peak, 4)
+
+
+def _timed_loop(run_step, warmup, iters):
+    """Warmup-excluded protocol (BASELINE.md): first run compiles.
+
+    Steps dispatch asynchronously and sync ONCE at the end — fetching
+    per step would measure host<->device round-trip latency, not chip
+    throughput (the reference's FLAGS_benchmark per-op sync exists for
+    exactly this reason, operator.cc:946-948: sync only when asked)."""
+    import jax
+    out = run_step()
+    for _ in range(max(warmup - 1, 0)):
+        out = run_step()
+    lv = float(np.asarray(out[0]).reshape(-1)[0])
+    if not np.isfinite(lv):
+        raise FloatingPointError("non-finite loss")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_step()
+    jax.block_until_ready(out)
+    return iters / (time.perf_counter() - t0)
+
+
+def _best_library(run_step, warmup, iters):
+    """Measure base vs pallas kernel libraries, return the better
+    steps/sec (jit benchmark.cc: best implementation wins per shape). A
+    broken base path is a real failure and propagates; a broken pallas
+    path only loses the speedup."""
+    from paddle_tpu.core.flags import FLAGS
+
+    def timed(lib):
+        prev = FLAGS.op_library
+        FLAGS.op_library = lib
+        try:
+            return _timed_loop(run_step, warmup, iters)
+        finally:
+            FLAGS.op_library = prev
+
+    base = timed("")
+    try:
+        pallas = timed("pallas")
+    except Exception as e:
+        print("pallas path failed, using base: %r" % e, file=sys.stderr)
+        pallas = 0.0
+    return max(base, pallas)
+
+
+# ---------------------------------------------------------------------------
+# config 3 (headline): Transformer-base NMT
+# ---------------------------------------------------------------------------
+
+def transformer_flops_per_step(cfg, batch):
+    """Analytic matmul FLOPs for one train step (fwd x3 for fwd+bwd),
+    the 6ND-style accounting over the actual architecture. Attention
+    uses the full padded S^2 (what the chip executes)."""
+    S, d, f, V = cfg.max_len, cfg.d_model, cfg.d_ffn, cfg.tgt_vocab
+    enc_layer = 8 * S * d * d + 4 * S * S * d + 4 * S * d * f
+    dec_layer = 16 * S * d * d + 8 * S * S * d + 4 * S * d * f
+    logits = 2 * S * d * V
+    fwd = cfg.n_layer * (enc_layer + dec_layer) + logits
+    return 3.0 * fwd * batch
+
 
 def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10):
-    """Transformer-base train-step throughput in non-pad tokens/sec."""
     import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as amp
     from paddle_tpu.models import transformer as T
 
     cfg = T.TransformerConfig(src_vocab=30000, tgt_vocab=30000,
@@ -30,46 +122,28 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10):
     main.random_seed = 1
     with fluid.program_guard(main, startup):
         avg_cost, token_num, _ = T.transformer(cfg)
-        fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
+        opt = amp.decorate(fluid.optimizer.AdamOptimizer(1e-3))
+        opt.minimize(avg_cost)
     exe = fluid.Executor()
     exe.run(startup)
     feed = T.make_fake_batch(cfg, batch)
     tokens_per_step = float(feed["tgt_mask"].sum())
 
-    from paddle_tpu.core.flags import FLAGS
+    sps = _best_library(
+        lambda: exe.run(main, feed=feed, fetch_list=[avg_cost],
+                        return_numpy=False),
+        warmup, iters)
+    return {
+        "metric": "transformer_base_train_throughput",
+        "value": round(tokens_per_step * sps, 1),
+        "unit": "tokens/sec/chip",
+        "mfu": _mfu(transformer_flops_per_step(cfg, batch), sps),
+    }
 
-    def timed(lib):
-        prev = FLAGS.op_library
-        FLAGS.op_library = lib
-        try:
-            out = exe.run(main, feed=feed, fetch_list=[avg_cost])
-            for _ in range(max(warmup - 1, 0)):
-                out = exe.run(main, feed=feed, fetch_list=[avg_cost])
-            lv = float(np.asarray(out[0]).reshape(-1)[0])
-            if not np.isfinite(lv):
-                raise FloatingPointError(
-                    "non-finite loss under library %r" % lib)
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = exe.run(main, feed=feed, fetch_list=[avg_cost])
-            np.asarray(out[0])
-            return tokens_per_step * iters / (time.perf_counter() - t0)
-        finally:
-            FLAGS.op_library = prev
 
-    # measure both kernel libraries, report the better (the jit
-    # benchmark.cc pattern: best implementation wins per shape). A
-    # broken base path is a real failure and propagates; a broken
-    # pallas path only loses the speedup.
-    base = timed("")
-    try:
-        pallas = timed("pallas")
-    except Exception as e:
-        print("pallas path failed, using base: %r" % e,
-              file=sys.stderr)
-        pallas = 0.0
-    return max(base, pallas)
-
+# ---------------------------------------------------------------------------
+# config 1: MNIST MLP
+# ---------------------------------------------------------------------------
 
 def bench_mnist_mlp(batch=512, warmup=5, iters=30):
     import paddle_tpu as fluid
@@ -92,31 +166,138 @@ def bench_mnist_mlp(batch=512, warmup=5, iters=30):
         "img": rs.rand(batch, 784).astype(np.float32),
         "label": rs.randint(0, 10, size=(batch, 1)).astype(np.int64),
     }
-    for _ in range(warmup):
-        exe.run(main, feed=feed, fetch_list=[loss])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = exe.run(main, feed=feed, fetch_list=[loss])
-    np.asarray(out[0])
-    dt = time.perf_counter() - t0
-    return batch * iters / dt
+    sps = _timed_loop(
+        lambda: exe.run(main, feed=feed, fetch_list=[loss],
+                        return_numpy=False),
+        warmup, iters)
+    return {"metric": "mnist_mlp_train_throughput",
+            "value": round(batch * sps, 1), "unit": "examples/sec",
+            "mfu": None}
+
+
+# ---------------------------------------------------------------------------
+# config 2: ResNet-50 ImageNet
+# ---------------------------------------------------------------------------
+
+_RESNET50_FWD_FLOPS = 8.2e9  # standard 224x224 fwd GFLOPs (convs+fc)
+
+
+def bench_resnet50(batch=64, warmup=3, iters=10):
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as amp
+    from paddle_tpu.models import resnet as R
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[224, 224, 3],
+                                dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = R.resnet50(img)
+        loss, _acc = R.loss_and_acc(pred, label)
+        opt = amp.decorate(fluid.optimizer.MomentumOptimizer(0.1, 0.9))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    feed = {
+        "img": rs.rand(batch, 224, 224, 3).astype(np.float32),
+        "label": rs.randint(0, 1000, size=(batch, 1)).astype(np.int64),
+    }
+    sps = _best_library(
+        lambda: exe.run(main, feed=feed, fetch_list=[loss],
+                        return_numpy=False),
+        warmup, iters)
+    return {"metric": "resnet50_train_throughput",
+            "value": round(batch * sps, 1), "unit": "images/sec/chip",
+            "mfu": _mfu(3.0 * _RESNET50_FWD_FLOPS * batch, sps)}
+
+
+# ---------------------------------------------------------------------------
+# config 4: BERT-base pretraining
+# ---------------------------------------------------------------------------
+
+def bert_flops_per_step(cfg, batch, seq_len):
+    S, d, f = seq_len, cfg.hidden_size, cfg.intermediate_size
+    layer = 8 * S * d * d + 4 * S * S * d + 4 * S * d * f
+    heads = 2 * S * d * cfg.vocab_size + 2 * S * d * d  # mlm + pooler-ish
+    return 3.0 * (cfg.num_hidden_layers * layer + heads) * batch
+
+
+def bench_bert(batch=32, seq_len=128, warmup=3, iters=10):
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as amp
+    from paddle_tpu.models import bert as B
+
+    cfg = B.base()
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings,
+                                      seq_len)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        loss = B.bert_pretrain(cfg)[0]
+        opt = amp.decorate(fluid.optimizer.AdamOptimizer(1e-4))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = B.make_fake_pretrain_batch(cfg, batch)
+    # make_fake_pretrain_batch fixes its own seq len; recompute S
+    seq_len = feed["src_ids"].shape[1]
+    sps = _best_library(
+        lambda: exe.run(main, feed=feed, fetch_list=[loss],
+                        return_numpy=False),
+        warmup, iters)
+    return {"metric": "bert_base_train_throughput",
+            "value": round(batch * seq_len * sps, 1),
+            "unit": "tokens/sec/chip",
+            "mfu": _mfu(bert_flops_per_step(cfg, batch, seq_len), sps)}
+
+
+# ---------------------------------------------------------------------------
+# config 5: DeepFM CTR
+# ---------------------------------------------------------------------------
+
+def bench_deepfm(batch=4096, warmup=3, iters=20):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import deepfm as D
+
+    cfg = D.DeepFMConfig()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        loss, _auc = D.deepfm(cfg)
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = D.make_fake_batch(cfg, batch)
+    sps = _timed_loop(
+        lambda: exe.run(main, feed=feed, fetch_list=[loss],
+                        return_numpy=False),
+        warmup, iters)
+    return {"metric": "deepfm_train_throughput",
+            "value": round(batch * sps, 1), "unit": "examples/sec",
+            "mfu": None}
 
 
 def main():
-    tokens_per_sec = bench_transformer()
-    print(json.dumps({
-        "metric": "transformer_base_train_throughput",
-        "value": round(float(tokens_per_sec), 1),
-        "unit": "tokens/sec/chip",
-        # reference publishes no in-tree numbers (BASELINE.json
-        # "published": {}); 1.0 = parity placeholder
-        "vs_baseline": 1.0,
-    }))
+    res = bench_transformer()
+    mfu = res["mfu"]
+    # north star: >=0.40 MFU (>=0.8x A100-class); measured ratio, not a
+    # placeholder. Unknown device (CPU smoke runs) -> null.
+    res["vs_baseline"] = (round(mfu / 0.40, 3) if mfu is not None
+                          else None)
+    print(json.dumps(res))
     if "--all" in sys.argv:
-        print(json.dumps({
-            "metric": "mnist_mlp_train_throughput",
-            "value": round(float(bench_mnist_mlp()), 1),
-            "unit": "examples/sec", "vs_baseline": 1.0}))
+        for fn in (bench_mnist_mlp, bench_resnet50, bench_bert,
+                   bench_deepfm):
+            try:
+                r = fn()
+                r["vs_baseline"] = (round(r["mfu"] / 0.40, 3)
+                                    if r.get("mfu") else None)
+                print(json.dumps(r))
+            except Exception as e:
+                print(json.dumps({"metric": fn.__name__,
+                                  "error": repr(e)}))
 
 
 if __name__ == "__main__":

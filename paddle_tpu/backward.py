@@ -53,6 +53,33 @@ def _collect_stop_vars(block, no_grad_set) -> Set[str]:
     return stop
 
 
+def _append_sparse_lookup_grad(block, fwd, stop_vars) -> bool:
+    """Append a lookup_table_grad op producing a SparseRows table
+    gradient (the SelectedRows path of lookup_table_op.cc). Returns
+    False when the table doesn't need a grad (caller falls through to
+    the generic machinery, which will also produce nothing)."""
+    w_name = fwd.inputs["W"][0]
+    if w_name in stop_vars:
+        return False
+    w = block._find_var_recursive(w_name)
+    out_name = fwd.outputs["Out"][0]
+    og = grad_var_name(out_name)
+    if not block.has_var(og):
+        return False
+    gn = grad_var_name(w_name)
+    if not block.has_var(gn):
+        block.create_var(name=gn, shape=w.shape, dtype=w.dtype,
+                         stop_gradient=True)
+    block.append_op(
+        type="lookup_table_grad",
+        inputs={"Ids": list(fwd.inputs["Ids"]), "OutGrad": [og]},
+        outputs={"WGrad": [gn]},
+        attrs={"height": int(w.shape[0]),
+               "padding_idx": fwd.attrs.get("padding_idx", -1),
+               "op_role": "backward"})
+    return True
+
+
 def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """Append gradient ops for ``loss`` to its program; returns
@@ -91,6 +118,13 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
         opdef = ops.get(fwd.type)
         if not opdef.differentiable:
             continue
+
+        if fwd.type == "lookup_table" and fwd.attrs.get("is_sparse"):
+            # sparse embedding: emit the dedicated SparseRows grad op
+            # (reference: lookup_table_op.cc is_sparse grad ->
+            # SelectedRows) instead of the dense generic vjp
+            if _append_sparse_lookup_grad(block, fwd, stop_vars):
+                continue
 
         grad_outputs: Dict[str, List[str]] = {}
         any_grad = False

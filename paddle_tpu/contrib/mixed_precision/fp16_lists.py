@@ -11,7 +11,7 @@ from __future__ import annotations
 # matmul/conv dominate FLOPs; bf16 doubles MXU throughput).
 white_list = {
     "mul", "matmul", "conv2d", "conv3d", "depthwise_conv2d",
-    "conv2d_transpose",
+    "conv2d_transpose", "scaled_dot_product_attention",
 }
 
 # Numerically sensitive ops that must stay in float32.

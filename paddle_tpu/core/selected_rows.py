@@ -1,0 +1,122 @@
+"""SparseRows — the SelectedRows analog (sparse gradients).
+
+Reference: paddle/fluid/framework/selected_rows.h:32 (SelectedRows =
+{rows, value tensor, height}), produced by the sparse path of
+lookup_table's gradient (lookup_table_op.cc, attr ``is_sparse``) and
+consumed natively by the sparse kernels of sgd/momentum/adam/adagrad
+(e.g. adam_op.h SparseAdamFunctor) and by merge-add
+(operators/math/selected_rows_functor.cc).
+
+TPU-native redesign: a JAX pytree of {rows int32[n], values [n, ...]}
+plus a static ``height``. All shapes are static (n = number of looked-up
+ids per step), so the whole sparse-update path jits: gradient production
+is a slice of the incoming cotangent (no scatter), duplicate-row merge
+is sort + segment-sum at fixed width, and optimizer application is one
+scatter over the touched rows — the full table is never densified,
+which is what makes >HBM-grad-scale embedding tables trainable
+(VERDICT round-1 gap #1: a 1e8-row table's dense grad would OOM; its
+SparseRows grad is O(batch)).
+
+Out-of-range sentinel: merged() marks unused segments with row index
+``height``; scatters use mode="drop" so sentinel rows are no-ops, and
+gathers clamp (the garbage value is dropped on write-back).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseRows:
+    """A sparse slab of a [height, ...] tensor: ``values[i]`` belongs at
+    row ``rows[i]``; duplicate rows mean addition."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    # -- tensor-ish surface -------------------------------------------------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def __repr__(self):
+        return ("SparseRows(n=%s, height=%d, dim=%s, dtype=%s)"
+                % (self.rows.shape[0], self.height,
+                   tuple(self.values.shape[1:]), self.dtype))
+
+    # -- algebra ------------------------------------------------------------
+    def __add__(self, other):
+        """Sparse+sparse concatenates (merge deferred to the consumer,
+        reference merge_add); sparse+dense densifies — the grad var is
+        also consumed by a dense op, so a dense result is semantically
+        required."""
+        if isinstance(other, SparseRows):
+            if other.height != self.height:
+                raise ValueError(
+                    "SparseRows height mismatch: %d vs %d"
+                    % (self.height, other.height))
+            return SparseRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.height)
+        return self.add_to(other)
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        """Scale values (loss-scaling unscale, 1/N DP averaging)."""
+        return SparseRows(self.rows, self.values * scalar, self.height)
+
+    __rmul__ = __mul__
+
+    def add_to(self, dense):
+        """dense + self via scatter-add (mode='drop' ignores sentinel
+        rows)."""
+        return dense.at[self.rows].add(
+            self.values.astype(dense.dtype), mode="drop")
+
+    def to_dense(self):
+        base = jnp.zeros(self.shape, self.values.dtype)
+        return self.add_to(base)
+
+    def merged(self):
+        """Sum duplicate rows (reference:
+        math/selected_rows_functor.cc MergeAdd). Fixed-shape algorithm:
+        sort by row id, segment-sum runs of equal ids; segments beyond
+        the unique count keep the sentinel row ``height`` (dropped by
+        scatters). Required before any non-linear per-row optimizer
+        update (adam/adagrad: moments must see the SUMMED gradient of a
+        row, not one update per duplicate)."""
+        n = self.rows.shape[0]
+        order = jnp.argsort(self.rows)
+        r = jnp.take(self.rows, order)
+        v = jnp.take(self.values, order, axis=0)
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(first) - 1
+        vals = jax.ops.segment_sum(v, seg, num_segments=n)
+        # row id of each segment; empty segments get int32.min -> sentinel
+        rows_u = jax.ops.segment_max(r, seg, num_segments=n)
+        rows_u = jnp.where(rows_u < 0, self.height, rows_u)
+        return SparseRows(rows_u, vals, self.height)
+
+
+def is_sparse_rows(x) -> bool:
+    return isinstance(x, SparseRows)

@@ -72,6 +72,18 @@ class LayerHelper:
             init = _global_bias_initializer() if is_bias \
                 else _global_weight_initializer()
 
+        # Shared parameters (same ParamAttr name across layers — weight
+        # tying) are created ONCE: a repeated name returns the existing
+        # param and appends no second init op (fluid semantics,
+        # framework.py create_parameter + unique startup init).
+        existing = self.main_program.global_block()._find_var_recursive(
+            attr.name)
+        if existing is not None:
+            from .core.enforce import enforce
+            enforce(tuple(existing.shape) == tuple(shape),
+                    "shared parameter %r re-created with shape %s != %s"
+                    % (attr.name, tuple(shape), tuple(existing.shape)))
+            return existing
         # main-program parameter (metadata)
         param = self.block.create_parameter(
             name=attr.name, shape=shape, dtype=dtype,

@@ -197,18 +197,26 @@ class While:
     Variable of one element that the body re-writes (e.g. via
     ``layers.less_than(i, n, cond=cond)``).
 
-    Runs in the Executor's interpreted mode (full dynamism: tensor
-    arrays, data-dependent trip counts, growing shapes). For compiled
-    recurrence use StaticRNN/DynamicRNN.
+    Compilation (reference: while_op.cc + while_grad):
+      - plain body        -> ``lax.while_loop`` (XLA While HLO): jitted,
+        data-dependent trip count, forward-only;
+      - ``max_iters`` set -> ``lax.scan`` over the bound with a
+        done-mask: jitted AND reverse-mode differentiable — training
+        through the loop works (``append_backward`` emits a generic
+        vjp op like any other differentiable op);
+      - body using tensor arrays -> eager interpreted mode (full
+        dynamism: growing arrays, concrete indices).
+    For fixed-length recurrence prefer StaticRNN/DynamicRNN.
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
         enforce(isinstance(cond, Variable), "While cond must be a Variable")
         enforce(cond.dtype == "bool", "While cond must be bool, got %s"
                 % cond.dtype)
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
         self.is_test = is_test
+        self.max_iters = int(max_iters) if max_iters else 0
 
     def block(self):
         return _SubBlockGuard(self._complete)
@@ -236,7 +244,8 @@ class While:
                    "in_names": tuple(in_names),
                    "out_names": tuple(out_names + [cond_name]),
                    "cond_name": cond_name,
-                   "is_test": self.is_test})
+                   "is_test": self.is_test,
+                   "max_iters": self.max_iters})
 
 
 # ---------------------------------------------------------------------------
@@ -671,6 +680,59 @@ class Switch:
     def default(self):
         return self._case_guard(None)
 
+    def _new_bool(self, block, like):
+        return block.create_var(
+            name=framework.unique_name.generate(
+                self.helper.name + ".cond"),
+            shape=tuple(like.shape), dtype="bool")
+
+    def _effective_conds(self, block):
+        """First-true-wins across ALL cases (reference Switch executes
+        exactly the first block whose condition holds,
+        control_flow.py:1264): case i fires iff cond_i AND NOT any
+        earlier cond; the default fires iff NO cond fired — regardless
+        of which variables each case writes."""
+        effs = []
+        any_prev = None  # var name: OR of conds seen so far
+        for cond, _writes in self._cases:
+            if cond is None:
+                effs.append(None)  # patched below with NOT any_prev
+                continue
+            if any_prev is None:
+                effs.append(cond.name)
+                any_prev_new = cond.name
+            else:
+                eff = self._new_bool(block, cond)
+                notp = self._new_bool(block, cond)
+                block.append_op(type="logical_not",
+                                inputs={"X": [any_prev]},
+                                outputs={"Out": [notp.name]})
+                block.append_op(type="logical_and",
+                                inputs={"X": [cond.name],
+                                        "Y": [notp.name]},
+                                outputs={"Out": [eff.name]})
+                effs.append(eff.name)
+                any_prev_new = self._new_bool(block, cond).name
+                block.append_op(type="logical_or",
+                                inputs={"X": [any_prev],
+                                        "Y": [cond.name]},
+                                outputs={"Out": [any_prev_new]})
+            any_prev = any_prev_new
+        # default = NOT (any case cond)
+        for i, (cond, _w) in enumerate(self._cases):
+            if cond is not None:
+                continue
+            if any_prev is None:
+                effs[i] = None  # no conds at all: default always fires
+            else:
+                ref = next(c for c, _ in self._cases if c is not None)
+                nd = self._new_bool(block, ref)
+                block.append_op(type="logical_not",
+                                inputs={"X": [any_prev]},
+                                outputs={"Out": [nd.name]})
+                effs[i] = nd.name
+        return effs
+
     def _merge(self):
         block = self.helper.main_program.current_block()
         targets = []
@@ -678,20 +740,43 @@ class Switch:
             for n in writes:
                 if n not in targets:
                     targets.append(n)
+        if not targets:
+            return
+        effs = self._effective_conds(block)
         for n in targets:
             var = block._find_var_recursive(n)
             enforce(var is not None,
                     "Switch case writes to unknown variable %r" % n)
-            # fold cases in reverse: start from the default (or the
-            # var's prior value) and wrap each case cond outside it
-            current = None
-            for cond, writes in self._cases:
+            # fold in reverse with EFFECTIVE conditions: every case
+            # guards every var it writes, and non-writing earlier
+            # matches suppress later writes via the eff conds.
+            # Base of the chain = the var's prior value; when the var
+            # has no readable prior (e.g. created by the startup
+            # program only), the default case's write serves unguarded
+            # as the base — the only well-defined fallback.
+            default_val = None
+            for (cond, writes) in self._cases:
                 if cond is None and n in writes:
+                    default_val = writes[n]
+            if self._has_prior(block, n):
+                current = n
+                guard_default = True
+            else:
+                enforce(default_val is not None,
+                        "Switch writes %r conditionally but the "
+                        "variable has no prior value and no default() "
+                        "write" % n)
+                current = default_val
+                guard_default = False
+            for (cond, writes), eff in zip(reversed(self._cases),
+                                           list(reversed(effs))):
+                if n not in writes:
+                    continue
+                if cond is None and not guard_default:
+                    continue  # already the base
+                if eff is None:
+                    # unconditional default with no case conds at all
                     current = writes[n]
-            if current is None:
-                current = n  # keep prior value when no case matches
-            for cond, writes in reversed(self._cases):
-                if cond is None or n not in writes:
                     continue
                 out = block.create_var(
                     name=framework.unique_name.generate(
@@ -699,10 +784,27 @@ class Switch:
                     shape=tuple(var.shape), dtype=var.dtype)
                 block.append_op(
                     type="where",
-                    inputs={"Condition": [cond.name], "X": [writes[n]],
+                    inputs={"Condition": [eff], "X": [writes[n]],
                             "Y": [current]},
                     outputs={"Out": [out.name]})
                 current = out.name
             # final assign back into the target var name
             block.append_op(type="assign", inputs={"X": [current]},
                             outputs={"Out": [n]})
+
+    def _has_prior(self, block, name):
+        """Does ``name`` have a value readable at the merge point —
+        fed data, a persistable, or produced by an op outside the
+        switch (case writes were redirected to temps)?"""
+        var = block._find_var_recursive(name)
+        if var is None:
+            return False
+        if var.persistable or getattr(var, "is_data", False):
+            return True
+        b = block
+        while b is not None:
+            for op in b.ops:
+                if name in op.output_arg_names:
+                    return True
+            b = b.parent_block
+        return False

@@ -814,11 +814,15 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
 
 
 def scaled_dot_product_attention(q, k, v, bias=None, scale=1.0,
-                                 name=None):
+                                 dropout_rate=0.0, causal=False,
+                                 is_test=False, name=None):
     """Fused attention core: softmax(q @ k^T * scale + bias) @ v over
-    [batch, heads, seq, head_dim] inputs. Lowers to one fused op
-    (pallas flash-style kernel when FLAGS_op_library=pallas; XLA-fused
-    composite otherwise). See ops/pallas/attention.py."""
+    [batch, heads, seq, head_dim] inputs, with optional in-kernel
+    attention dropout and causal masking. Lowers to one fused op (pallas
+    flash kernel — blocked online softmax, recompute backward — when
+    FLAGS_op_library=pallas; XLA-fused composite otherwise). ``bias`` is
+    an additive attention *mask* (non-differentiable); add a trainable
+    bias with elementwise_add instead. See ops/pallas/attention.py."""
     helper = LayerHelper("sdpa", name=name)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
@@ -826,5 +830,8 @@ def scaled_dot_product_attention(q, k, v, bias=None, scale=1.0,
     out = helper.create_variable_for_type_inference(q.dtype)
     helper.append_op(type="scaled_dot_product_attention",
                      inputs=inputs, outputs={"Out": [out]},
-                     attrs={"scale": float(scale)})
+                     attrs={"scale": float(scale),
+                            "dropout_rate": float(dropout_rate),
+                            "causal": bool(causal),
+                            "is_test": bool(is_test)})
     return out

@@ -63,14 +63,11 @@ def _attention(x, bias, cfg, is_test, prefix):
         return layers.transpose(t, (0, 2, 1, 3))
 
     q, k, v = split(q), split(k), split(v)
-    scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-    scores = layers.elementwise_add(scores, bias)
-    probs = layers.softmax(scores)
-    if cfg.attention_probs_dropout_prob and not is_test:
-        probs = layers.dropout(
-            probs, cfg.attention_probs_dropout_prob,
-            dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)
+    # fused attention (pallas flash kernel when enabled); attention
+    # dropout runs in-kernel so scores never materialize in HBM
+    ctx = layers.scaled_dot_product_attention(
+        q, k, v, bias=bias, scale=dh ** -0.5,
+        dropout_rate=cfg.attention_probs_dropout_prob, is_test=is_test)
     ctx = layers.transpose(ctx, (0, 2, 1, 3))
     ctx = layers.reshape(ctx, (-1, s, d))
     return layers.fc(ctx, d, num_flatten_dims=2, name=prefix + "_out")

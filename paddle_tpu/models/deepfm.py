@@ -48,7 +48,7 @@ def deepfm(cfg: DeepFMConfig, is_test=False):
     # dense part: a linear layer; sparse part: 1-dim embedding per id
     first_dense = layers.fc(dense, 1, name="fm_first_dense")
     first_sparse_emb = layers.embedding(
-        sparse, size=(cfg.sparse_feature_dim, 1),
+        sparse, size=(cfg.sparse_feature_dim, 1), is_sparse=True,
         param_attr=ParamAttr(name="fm_first_w"))       # [b, 26, 1]
     first_sparse = layers.reduce_sum(first_sparse_emb, dim=1)  # [b, 1]
     y_first = layers.elementwise_add(first_dense, first_sparse)
@@ -56,6 +56,7 @@ def deepfm(cfg: DeepFMConfig, is_test=False):
     # ---- second order: 0.5 * ((sum v)^2 - sum v^2) ----------------------
     emb = layers.embedding(
         sparse, size=(cfg.sparse_feature_dim, cfg.embedding_size),
+        is_sparse=True,
         param_attr=ParamAttr(name="fm_embedding"))     # [b, 26, k]
     summed = layers.reduce_sum(emb, dim=1)             # [b, k]
     summed_sq = layers.square(summed)
@@ -86,10 +87,11 @@ def deepfm(cfg: DeepFMConfig, is_test=False):
     return avg_loss, auc_var, predict
 
 
-def shard_tables(program, axis="mp"):
-    """Row-shard the embedding tables over the model axis — the TPU
-    replacement for pserver-sharded tables (distribute_transpiler.py
-    table optimize blocks)."""
+def shard_tables(program, axis="tp"):
+    """Row-shard the embedding tables over the tensor/model axis — the
+    TPU replacement for pserver-sharded tables
+    (distribute_transpiler.py table optimize blocks). ``tp`` is a
+    first-class mesh axis (parallel/mesh.py AXIS_ORDER)."""
     from ..parallel import shard
     for p in program.all_parameters():
         if p.name in ("fm_embedding", "fm_first_w"):

@@ -90,19 +90,12 @@ def _multi_head_attention(q_in, kv_in, bias, cfg, is_test, prefix):
     k = split_heads(k, k_len)
     v = split_heads(v, k_len)
 
-    if cfg.dropout and not is_test:
-        # attention dropout needs the weights materialized; composed
-        # path (XLA still fuses the chain)
-        scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-        scores = layers.elementwise_add(scores, bias)
-        weights = layers.softmax(scores)
-        weights = layers.dropout(weights, cfg.dropout,
-                                 dropout_implementation="upscale_in_train")
-        ctx = layers.matmul(weights, v)  # [b, h, q, dh]
-    else:
-        # fused attention core (pallas flash kernel when enabled)
-        ctx = layers.scaled_dot_product_attention(
-            q, k, v, bias=bias, scale=dh ** -0.5)
+    # fused attention core (pallas flash kernel when enabled) —
+    # attention dropout runs in-kernel (TPU PRNG), so the score matrix
+    # never materializes in HBM even when training with dropout
+    ctx = layers.scaled_dot_product_attention(
+        q, k, v, bias=bias, scale=dh ** -0.5,
+        dropout_rate=cfg.dropout, is_test=is_test)
     ctx = layers.transpose(ctx, (0, 2, 1, 3))
     ctx = layers.reshape(ctx, (-1, q_len, d))
     return layers.fc(ctx, d, num_flatten_dims=2, bias_attr=False,

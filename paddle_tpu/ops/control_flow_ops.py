@@ -59,14 +59,103 @@ def _concrete_index(i, what):
 
 
 # ---------------------------------------------------------------------------
-# while — eager interpreted loop (reference: while_op.cc:59 WhileOp::Run)
+# while — compiled loop (reference: while_op.cc:59 WhileOp::Run runs the
+# sub-block via a nested Executor; while_grad_op re-runs it backward)
+#
+# TPU-native lowering ladder:
+#   1. body uses tensor arrays            -> eager interpreted loop
+#      (full dynamism; the Executor drops the program to eager mode)
+#   2. ``max_iters`` attr set             -> lax.scan over max_iters with
+#      a done-mask: ONE fused XLA loop, reverse-mode DIFFERENTIABLE —
+#      the analog of while_grad_op (bounded-unroll checkpointing is
+#      jax.checkpoint on the body if memory demands it)
+#   3. otherwise                          -> lax.while_loop: compiled,
+#      data-dependent trip count, forward-only (XLA While HLO)
 # ---------------------------------------------------------------------------
 
-@register("while", ["Condition", "X*"], ["Out*"], differentiable=False,
+# Tensor-array op types: list-valued, need concrete indices. Single
+# source of truth — the Executor's whole-program eager decision imports
+# this same set (executor._EAGER_OP_TYPES).
+ARRAY_OP_TYPES = frozenset({"create_array", "array_write", "array_read",
+                            "array_length"})
+
+
+def _block_uses_arrays(blk) -> bool:
+    for op in blk.ops:
+        if op.type in ARRAY_OP_TYPES:
+            return True
+    return False
+
+
+@register("while", ["Condition", "X*"], ["Out*"], differentiable=True,
           needs_rng=True)
 def while_op(cond, xs, *, sub_block, in_names, out_names, cond_name,
-             rng, is_test=False):
+             rng, is_test=False, max_iters=0):
     blk = _tracing_block(sub_block)
+
+    if _block_uses_arrays(blk):
+        return _while_eager(blk, cond, xs, in_names, out_names,
+                            cond_name, rng)
+
+    # vars written by the body are loop-carried; read-only vars are
+    # loop invariants and close over (XLA keeps them resident)
+    carried = [n for n in out_names if n != cond_name]
+    invariant_env = {n: x for n, x in zip(in_names, xs)
+                     if n not in carried}
+    init_vals = []
+    by_name = dict(zip(in_names, xs))
+    for n in carried:
+        enforce(n in by_name,
+                "While-carried var %r has no initial value" % n)
+        init_vals.append(by_name[n])
+
+    def run_body(cond_val, vals, it):
+        env = dict(invariant_env)
+        env.update(zip(carried, vals))
+        env[cond_name] = cond_val
+        _run_sub_block(blk, env, jax.random.fold_in(rng, it))
+        return env[cond_name], [env[n] for n in carried]
+
+    def collect(cond_val, vals):
+        env = dict(zip(carried, vals))
+        env[cond_name] = cond_val
+        return [env[n] for n in out_names]
+
+    if max_iters and max_iters > 0:
+        # differentiable bounded loop: scan max_iters steps, freeze the
+        # carry once the condition drops (reference while_grad
+        # correctness; grads flow through the active prefix only)
+        def body(carry, it):
+            cond_val, vals = carry
+            active = jnp.asarray(cond_val).reshape(()).astype(bool)
+            new_cond, new_vals = run_body(cond_val, vals, it)
+            keep_cond = jnp.where(active, new_cond, cond_val)
+            keep_vals = [jnp.where(active, nv, v)
+                         for nv, v in zip(new_vals, vals)]
+            return (keep_cond, keep_vals), None
+
+        (final_cond, final_vals), _ = jax.lax.scan(
+            body, (cond, init_vals), jnp.arange(int(max_iters)))
+        return collect(final_cond, final_vals)
+
+    def cond_fn(carry):
+        cond_val, _vals, _it = carry
+        return jnp.asarray(cond_val).reshape(()).astype(bool)
+
+    def body_fn(carry):
+        cond_val, vals, it = carry
+        new_cond, new_vals = run_body(cond_val, vals, it)
+        return (new_cond, new_vals, it + 1)
+
+    final_cond, final_vals, _ = jax.lax.while_loop(
+        cond_fn, body_fn, (cond, init_vals, jnp.int32(0)))
+    return collect(final_cond, final_vals)
+
+
+def _while_eager(blk, cond, xs, in_names, out_names, cond_name, rng):
+    """Op-by-op interpreted loop — the analog of the reference's nested
+    Executor (while_op.cc). Required for tensor-array bodies (growing
+    Python lists); the Executor runs the whole program eagerly."""
     env = dict(zip(in_names, xs))
     env[cond_name] = cond
 
@@ -75,7 +164,7 @@ def while_op(cond, xs, *, sub_block, in_names, out_names, cond_name,
             return bool(np.asarray(c).reshape(-1)[0])
         except jax.errors.TracerBoolConversionError:
             raise InvalidArgumentError(
-                "While loops interpret their condition eagerly and "
+                "While bodies with tensor arrays interpret eagerly and "
                 "cannot run under jit/scan; use static_rnn/dynamic_rnn "
                 "or beam search for compiled recurrence")
 

@@ -448,6 +448,28 @@ def lookup_table(w, ids, *, padding_idx=-1, is_sparse=False,
     return out
 
 
+@register("lookup_table_grad", ["Ids", "OutGrad"], ["WGrad"],
+          differentiable=False, accumulate_outputs=True)
+def lookup_table_grad(ids, out_grad, *, height, padding_idx=-1):
+    """Sparse gradient of lookup_table (reference: lookup_table_op.cc
+    ``is_sparse`` grad path emitting SelectedRows). Appended by
+    backward.append_backward instead of a generic vjp op when the
+    forward lookup has is_sparse=True: the table gradient is the
+    incoming cotangent re-labelled with its row ids — O(batch), no
+    scatter, and the [height, dim] table is never densified."""
+    from ..core.selected_rows import SparseRows
+
+    ids2 = ids.squeeze(-1) if ids.ndim > 1 and ids.shape[-1] == 1 \
+        else ids
+    rows = ids2.reshape(-1).astype(jnp.int32)
+    dim = out_grad.shape[-1]
+    values = out_grad.reshape(-1, dim)
+    if padding_idx is not None and padding_idx >= 0:
+        # forward zeroed padding rows; their cotangent must not flow
+        values = jnp.where((rows == padding_idx)[:, None], 0.0, values)
+    return SparseRows(rows, values, height)
+
+
 @register("embedding_bag", ["W", "Ids"], ["Out"], nondiff=("Ids",))
 def embedding_bag(w, ids, *, mode="sum", padding_idx=-1):
     """Fused embedding + sequence-pool (reference:

@@ -18,18 +18,43 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.selected_rows import SparseRows
 from .registry import register
+
+# Sparse (SelectedRows) update kernels: the reference implements sparse
+# variants for sgd/momentum/adam/adagrad (sgd_op.h SparseSGDFunctor,
+# adam_op.h SparseAdamFunctor with lazy_mode, adagrad_op.h, momentum's
+# SelectedRows path). Here each dense update fn branches on a SparseRows
+# grad: merge duplicate rows, gather the touched optimizer-state rows,
+# update, scatter back (mode="drop" ignores merge-sentinel rows). The
+# table is never densified, so update cost is O(touched rows).
 
 
 @register("sgd", ["Param", "Grad", "LearningRate"], ["ParamOut"],
           differentiable=False)
 def sgd(param, grad, lr):
+    if isinstance(grad, SparseRows):
+        # linear update: duplicates sum correctly without a merge
+        upd = (lr * grad.values).astype(param.dtype)
+        return param.at[grad.rows].add(-upd, mode="drop")
     return param - lr * grad
 
 
 @register("momentum", ["Param", "Grad", "Velocity", "LearningRate"],
           ["ParamOut", "VelocityOut"], differentiable=False)
 def momentum(param, grad, velocity, lr, *, mu, use_nesterov=False):
+    if isinstance(grad, SparseRows):
+        g = grad.merged()
+        rows, vals = g.rows, g.values
+        vr = mu * velocity[rows] + vals
+        if use_nesterov:
+            upd = (vals + mu * vr) * lr
+        else:
+            upd = lr * vr
+        return (param.at[rows].add(-upd.astype(param.dtype),
+                                   mode="drop"),
+                velocity.at[rows].set(vr.astype(velocity.dtype),
+                                      mode="drop"))
     v = mu * velocity + grad
     if use_nesterov:
         p = param - (grad + mu * v) * lr
@@ -60,7 +85,35 @@ def adam(param, grad, m1, m2, b1p, b2p, lr, *, beta1=0.9, beta2=0.999,
          epsilon=1e-8, lazy_mode=False):
     """Reference: adam_op.cc (+ fuse_adam_op_pass — here fusion across
     params happens automatically because all updates sit in one XLA
-    program). Pallas fused variant in ops/pallas/fused_adam.py."""
+    program). Pallas fused variant in ops/pallas/fused_adam.py. A
+    SparseRows grad takes the reference's lazy sparse path
+    (adam_op.h SparseAdamFunctor): only touched rows' moments and
+    params update; beta-power state still advances globally."""
+    if isinstance(grad, SparseRows):
+        g = grad.merged()
+        rows, vals = g.rows, g.values
+        lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+        if lazy_mode:
+            # rows-only: moments and params of untouched rows frozen
+            # (adam_op.h lazy_mode=true) — the industrial-scale path
+            m1r = beta1 * m1[rows] + (1.0 - beta1) * vals
+            m2r = beta2 * m2[rows] + (1.0 - beta2) * jnp.square(vals)
+            upd = lr_t * m1r / (jnp.sqrt(m2r) + epsilon)
+            return (param.at[rows].add(-upd.astype(param.dtype),
+                                       mode="drop"),
+                    m1.at[rows].set(m1r.astype(m1.dtype), mode="drop"),
+                    m2.at[rows].set(m2r.astype(m2.dtype), mode="drop"),
+                    b1p * beta1, b2p * beta2)
+        # non-lazy (reference default): identical trajectory to the
+        # dense update with a zero-filled grad — moments decay on every
+        # row; only the grad itself stays sparse (no densify)
+        m1n = (beta1 * m1).at[rows].add(
+            ((1.0 - beta1) * vals).astype(m1.dtype), mode="drop")
+        m2n = (beta2 * m2).at[rows].add(
+            ((1.0 - beta2) * jnp.square(vals)).astype(m2.dtype),
+            mode="drop")
+        pn = param - lr_t * m1n / (jnp.sqrt(m2n) + epsilon)
+        return pn, m1n, m2n, b1p * beta1, b2p * beta2
     m1n = beta1 * m1 + (1.0 - beta1) * grad
     m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(grad)
     lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
@@ -101,6 +154,15 @@ def adamax(param, grad, moment, inf_norm, b1p, lr, *, beta1=0.9,
 @register("adagrad", ["Param", "Grad", "Moment", "LearningRate"],
           ["ParamOut", "MomentOut"], differentiable=False)
 def adagrad(param, grad, moment, lr, *, epsilon=1e-6):
+    if isinstance(grad, SparseRows):
+        g = grad.merged()
+        rows, vals = g.rows, g.values
+        mr = moment[rows] + jnp.square(vals)
+        upd = lr * vals / (jnp.sqrt(mr) + epsilon)
+        return (param.at[rows].add(-upd.astype(param.dtype),
+                                   mode="drop"),
+                moment.at[rows].set(mr.astype(moment.dtype),
+                                    mode="drop"))
     mn = moment + jnp.square(grad)
     return param - lr * grad / (jnp.sqrt(mn) + epsilon), mn
 

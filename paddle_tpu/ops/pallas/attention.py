@@ -1,17 +1,37 @@
-"""Fused scaled-dot-product attention (flash-style) pallas kernel.
+"""Flash attention: fused scaled-dot-product attention pallas kernels.
 
-The analog of the reference's fused attention ops (operators/fused/
-fused_embedding_fc_lstm_op.cc era had no flash attention — attention in
-the 2019 reference is composed op-by-op, e.g. benchmark transformer
-models multiply/softmax/multiply through separate kernels). On TPU the
-composed form round-trips the [B,H,Sq,Sk] score matrix through HBM
-twice; this kernel keeps each q-block's scores in VMEM, fusing
-QK^T -> +bias -> softmax -> @V into one MXU-resident pass.
+The analog of the reference's fused attention path — the 2019 reference
+composes attention op-by-op (matmul/softmax/matmul through separate
+kernels, e.g. the benchmark transformer), which round-trips the
+[B,H,Sq,Sk] score matrix through HBM twice in the forward and again in
+the backward. On TPU this kernel family never materializes the score
+matrix in HBM in either direction:
 
-Forward: pallas kernel (one grid cell per (batch*head, q-block)).
-Backward: custom_vjp that recomputes through the pure-jnp composite —
-the flash-attention recompute strategy: no score matrix is ever stored
-for backward, trading FLOPs for HBM (SURVEY §7 "HBM bandwidth").
+- **Forward**: k-blocked online softmax (one grid cell per
+  (batch*head, q-block, k-block), k innermost). Running max ``m``,
+  normalizer ``l`` and the output accumulator live in VMEM scratch; the
+  softmax statistics ``lse = m + log(l)`` are saved for the backward.
+- **Backward**: two pallas kernels with per-block recompute —
+  ``dq`` (grid over q-blocks, scanning k-blocks) and ``dk/dv`` (grid
+  over k-blocks, scanning q-blocks). Each block recomputes
+  ``p = exp(s - lse)`` from q/k and the saved statistics; only
+  O(seq * head_dim) residuals (out, lse) ever hit HBM.
+- **Dropout** runs in-kernel with the TPU PRNG
+  (``pltpu.prng_seed``/``prng_random_bits``), seeded per
+  (batch*head, q-block, k-block) so the backward regenerates the exact
+  forward mask without storing it.
+- **Causal** masking skips fully-masked k-blocks (roughly halves the
+  decoder self-attention work).
+
+``Bias`` is an additive attention mask (0 / -1e9, built from data by the
+models) and is registered non-differentiable: the base lowering and the
+pallas kernel therefore agree that no dbias flows. A *trainable*
+attention bias should be added with a separate elementwise_add before a
+bias-free sdpa call.
+
+Reference precedent for the fused-kernel + refer-impl pairing:
+/root/reference/paddle/fluid/operators/jit/README.en.md (best-impl-wins
+kernel dispatch), operators/fused/.
 """
 
 from __future__ import annotations
@@ -20,121 +40,414 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..registry import register, register_variant
 from .common import blk, interpret_mode
 
+_NEG_INF = -1e30
 
-def _sdpa_reference(q, k, v, bias, *, scale):
+
+def _causal_mask(s, j, kk, blk_q, blk_k):
+    rows = j * blk_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = kk * blk_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _dropout_keep(seed_ref, i, j, kk, n_q, n_k, shape, rate):
+    """Deterministic per-block dropout mask; identical bits are
+    regenerated in the backward kernels. The (bh, q-block, k-block)
+    coordinates are folded into one scalar seed (single-arg prng_seed —
+    the multi-arg form doesn't lower on this Mosaic version) with a
+    Knuth-style odd multiplier so nearby blocks decorrelate."""
+    flat = (i * n_q + j) * n_k + kk
+    pltpu.prng_seed(seed_ref[0] + flat * jnp.int32(-1640531527))
+    bits = pltpu.prng_random_bits(shape)
+    u = lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32(min(int(rate * (1 << 32)), (1 << 32) - 1))
+    return u >= thresh
+
+
+def _sdpa_reference(q, k, v, bias, *, scale, dropout_rate=0.0,
+                    causal=False, rng=None):
     """Pure-jnp composite (the jit/refer/ analog): q,k,v [B,H,S,Dh],
-    bias [B,1,Sq,Sk] additive (or None)."""
+    bias additive, broadcastable to [B,1_or_H,Sq,Sk]."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if bias is not None:
-        s = s + bias
+        s = s + lax.stop_gradient(bias)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v).astype(q.dtype)
 
 
 @register("scaled_dot_product_attention", ["Q", "K", "V", "Bias"],
-          ["Out"])
-def scaled_dot_product_attention(q, k, v, bias, *, scale=1.0):
-    """Base lowering: XLA fuses the chain; the pallas variant below is
+          ["Out"], nondiff=("Bias",), needs_rng=True)
+def scaled_dot_product_attention(q, k, v, bias, *, scale=1.0,
+                                 dropout_rate=0.0, causal=False,
+                                 is_test=False, rng=None):
+    """Base lowering: XLA fuses the chain; the pallas flash variant is
     substituted when FLAGS_op_library=pallas."""
-    return _sdpa_reference(q, k, v, bias, scale=scale)
+    rate = 0.0 if is_test else float(dropout_rate)
+    return _sdpa_reference(q, k, v, bias, scale=scale,
+                           dropout_rate=rate, causal=causal, rng=rng)
 
 
-def _mha_fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *, scale):
-    q = q_ref[0]                       # [blk_q, dh]
-    kk = k_ref[0]                      # [sk, dh]
-    s = jax.lax.dot_general(
-        q, kk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [blk_q, sk]
-    if b_ref is not None:
-        s = s + b_ref[0, 0].astype(jnp.float32)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
-    w = e / jnp.sum(e, axis=-1, keepdims=True)
-    o = jnp.dot(w.astype(v_ref.dtype), v_ref[0],
-                preferred_element_type=jnp.float32)
-    o_ref[0] = o.astype(o_ref.dtype)
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, blk_q, blk_k, n_q,
+                n_k, rate, causal):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = (kk * blk_k <= j * blk_q + blk_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        s = lax.dot_general(q, k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if b_ref is not None:
+            s = s + b_ref[0, 0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, j, kk, blk_q, blk_k)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, -1, keepdims=True)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        if rate > 0.0:
+            keep = _dropout_keep(seed_ref, i, j, kk, n_q, n_k,
+                                 p.shape, rate)
+            p = jnp.where(keep, p / (1.0 - rate), 0.0)
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
+        # lane-replicated [blk_q, 128] (the TPU min-tile layout); the
+        # wrapper slices lane 0 out for the residual
+        lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
-def _sdpa_pallas_fwd(q, k, v, bias, scale):
+def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
     B, H, Sq, Dh = q.shape
     Sk = k.shape[2]
     BH = B * H
     if bias is not None and bias.shape != (B, 1, Sq, Sk):
-        # encoder-style [B,1,1,Sk] (or other broadcastable) biases:
-        # materialize the per-batch [Sq,Sk] block the BlockSpec expects
         bias = jnp.broadcast_to(bias, (B, 1, Sq, Sk))
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
-    blk_q = blk(Sq)
-    grid = (BH, Sq // blk_q)
+    blk_q = blk(Sq, 256)
+    blk_k = blk(Sk, 512)
+    n_k = Sk // blk_k
+    grid = (BH, Sq // blk_q, n_k)
+    seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
 
     in_specs = [
-        pl.BlockSpec((1, blk_q, Dh), lambda i, j: (i, j, 0),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Sk, Dh), lambda i, j: (i, 0, 0),
-                     memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, Sk, Dh), lambda i, j: (i, 0, 0),
-                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, blk_k, Dh), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, blk_k, Dh), lambda i, j, kk: (i, kk, 0)),
     ]
-    args = [q3, k3, v3]
+    args = [seed, q3, k3, v3]
     if bias is not None:
-        # bias [B, 1, Sq, Sk] shared across the H heads of a batch row
         in_specs.append(pl.BlockSpec(
-            (1, 1, blk_q, Sk), lambda i, j: (i // H, 0, j, 0),
-            memory_space=pltpu.VMEM))
+            (1, 1, blk_q, blk_k), lambda i, j, kk: (i // H, 0, j, kk)))
         args.append(bias)
-        kernel = functools.partial(_mha_fwd_kernel, scale=scale)
+        kernel = _fwd_kernel
     else:
-        kernel = functools.partial(
-            lambda qr, kr, vr, orf, **kw: _mha_fwd_kernel(
-                qr, kr, vr, None, orf, **kw), scale=scale)
+        kernel = (lambda sr, qr, kr, vr, orf, lr, ar, mr, llr, **kw:
+                  _fwd_kernel(sr, qr, kr, vr, None, orf, lr, ar, mr,
+                              llr, **kw))
 
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
+    out, lse = pl.pallas_call(
+        functools.partial(kernel, scale=scale, blk_q=blk_q,
+                          blk_k=blk_k, n_q=Sq // blk_q, n_k=n_k,
+                          rate=rate, causal=causal),
+        out_shape=[jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Sq, 128), jnp.float32)],
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, blk_q, Dh), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, 128), lambda i, j, kk: (i, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, Dh), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
     )(*args)
-    return out.reshape(B, H, Sq, Dh)
+    return out.reshape(B, H, Sq, Dh), lse[:, :, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _sdpa_pallas(q, k, v, bias, scale):
-    return _sdpa_pallas_fwd(q, k, v, bias, scale)
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, b_ref, lse_ref, *, scale, j, kk, blk_q,
+                 blk_k, causal):
+    s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+    if causal:
+        s = _causal_mask(s, j, kk, blk_q, blk_k)
+    return jnp.exp(s - lse_ref[0][:, :1])            # [blk_q, blk_k]
 
 
-def _sdpa_vjp_fwd(q, k, v, bias, scale):
-    return _sdpa_pallas_fwd(q, k, v, bias, scale), (q, k, v, bias)
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
+               dl_ref, dq_ref, dq_acc, *, scale, blk_q, blk_k, n_q,
+               n_k, rate, causal):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = (kk * blk_k <= j * blk_q + blk_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        p = _recompute_p(q_ref, k_ref, b_ref, lse_ref, scale=scale,
+                         j=j, kk=kk, blk_q=blk_q, blk_k=blk_k,
+                         causal=causal)
+        do = do_ref[0]
+        dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            keep = _dropout_keep(seed_ref, i, j, kk, n_q, n_k,
+                                 dp.shape, rate)
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        delta = dl_ref[0][:, :1]                     # [blk_q, 1]
+        ds = (p * (dp - delta) * scale).astype(k_ref.dtype)
+        dq_acc[...] += lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _sdpa_vjp_bwd(scale, res, g):
-    q, k, v, bias = res
-    if bias is None:
-        _out, pull = jax.vjp(
-            lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, None,
-                                               scale=scale), q, k, v)
-        dq, dk, dv = pull(g)
-        return dq, dk, dv, None
-    _out, pull = jax.vjp(
-        lambda q_, k_, v_, b_: _sdpa_reference(q_, k_, v_, b_,
-                                               scale=scale),
-        q, k, v, bias)
-    return pull(g)
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
+                dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                blk_q, blk_k, n_q, n_k, rate, causal):
+    i = pl.program_id(0)
+    kk = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (kk * blk_k <= j * blk_q + blk_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        p = _recompute_p(q_ref, k_ref, b_ref, lse_ref, scale=scale,
+                         j=j, kk=kk, blk_q=blk_q, blk_k=blk_k,
+                         causal=causal)
+        do = do_ref[0]
+        if rate > 0.0:
+            keep = _dropout_keep(seed_ref, i, j, kk, n_q, n_k,
+                                 p.shape, rate)
+            pd = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            pd = p
+        # dv += Pd^T @ dO
+        dv_acc[...] += lax.dot_general(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        delta = dl_ref[0][:, :1]
+        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
+        # dk += dS^T @ Q
+        dk_acc[...] += lax.dot_general(
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-_sdpa_pallas.defvjp(_sdpa_vjp_fwd, _sdpa_vjp_bwd)
+def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
+    B, H, Sq, Dh = q.shape
+    Sk = k.shape[2]
+    BH = B * H
+    if bias is not None and bias.shape != (B, 1, Sq, Sk):
+        bias = jnp.broadcast_to(bias, (B, 1, Sq, Sk))
+    q3 = q.reshape(BH, Sq, Dh)
+    k3 = k.reshape(BH, Sk, Dh)
+    v3 = v.reshape(BH, Sk, Dh)
+    do3 = g.reshape(BH, Sq, Dh)
+    blk_q = blk(Sq, 256)
+    blk_k = blk(Sk, 512)
+    n_q, n_k = Sq // blk_q, Sk // blk_k
+    seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
+    # delta_i = rowsum(dO * O): O(S*Dh) elementwise work, XLA fuses it.
+    # lse/delta enter the kernels lane-replicated to the 128-lane
+    # min-tile (the layout the fwd kernel produced them in).
+    delta = jnp.sum(do3.astype(jnp.float32) * o.reshape(BH, Sq, Dh)
+                    .astype(jnp.float32), axis=-1)
+    lse128 = jnp.broadcast_to(lse[:, :, None], (BH, Sq, 128))
+    delta128 = jnp.broadcast_to(delta[:, :, None], (BH, Sq, 128))
+
+    def specs(order):
+        """order: 'dq' grid (BH, n_q, n_k) or 'dkv' grid (BH, n_k, n_q)."""
+        if order == "dq":
+            qi = lambda i, j, kk: (i, j, 0)
+            ki = lambda i, j, kk: (i, kk, 0)
+            bi = lambda i, j, kk: (i // H, 0, j, kk)
+        else:
+            qi = lambda i, kk, j: (i, j, 0)
+            ki = lambda i, kk, j: (i, kk, 0)
+            bi = lambda i, kk, j: (i // H, 0, j, kk)
+        sp = [pl.BlockSpec(memory_space=pltpu.SMEM),
+              pl.BlockSpec((1, blk_q, Dh), qi),
+              pl.BlockSpec((1, blk_k, Dh), ki),
+              pl.BlockSpec((1, blk_k, Dh), ki)]
+        ar = [seed, q3, k3, v3]
+        if bias is not None:
+            sp.append(pl.BlockSpec((1, 1, blk_q, blk_k), bi))
+            ar.append(bias)
+        sp += [pl.BlockSpec((1, blk_q, Dh), qi),
+               pl.BlockSpec((1, blk_q, 128), qi),
+               pl.BlockSpec((1, blk_q, 128), qi)]
+        ar += [do3, lse128, delta128]
+        return sp, ar
+
+    def with_bias(kern):
+        if bias is not None:
+            return kern
+        return functools.partial(
+            lambda f, sr, qr, kr, vr, *rest, **kw:
+            f(sr, qr, kr, vr, None, *rest, **kw), kern)
+
+    sp, ar = specs("dq")
+    dq = pl.pallas_call(
+        functools.partial(with_bias(_dq_kernel), scale=scale,
+                          blk_q=blk_q, blk_k=blk_k, n_q=n_q, n_k=n_k,
+                          rate=rate, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
+        grid=(BH, n_q, n_k),
+        in_specs=sp,
+        out_specs=pl.BlockSpec((1, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((blk_q, Dh), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(*ar)
+
+    sp, ar = specs("dkv")
+    dk, dv = pl.pallas_call(
+        functools.partial(with_bias(_dkv_kernel), scale=scale,
+                          blk_q=blk_q, blk_k=blk_k, n_q=n_q, n_k=n_k,
+                          rate=rate, causal=causal),
+        out_shape=[jax.ShapeDtypeStruct((BH, Sk, Dh), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, Dh), v.dtype)],
+        grid=(BH, n_k, n_q),
+        in_specs=sp,
+        out_specs=[
+            pl.BlockSpec((1, blk_k, Dh), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((1, blk_k, Dh), lambda i, kk, j: (i, kk, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((blk_k, Dh), jnp.float32),
+                        pltpu.VMEM((blk_k, Dh), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(*ar)
+
+    dq = dq.reshape(B, H, Sq, Dh)
+    dk = dk.reshape(B, H, Sk, Dh)
+    dv = dv.reshape(B, H, Sk, Dh)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _sdpa_flash(q, k, v, bias, seed_f, scale, rate, causal):
+    out, _lse = _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal)
+    return out
+
+
+def _sdpa_flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
+    out, lse = _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal)
+    return out, (q, k, v, bias, seed_f, out, lse)
+
+
+def _sdpa_flash_bwd(scale, rate, causal, res, g):
+    q, k, v, bias, seed_f, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, bias, seed_f, out, lse, g,
+                            scale, rate, causal)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias, jnp.zeros_like(seed_f)
+
+
+_sdpa_flash.defvjp(_sdpa_flash_fwd, _sdpa_flash_bwd)
 
 
 @register_variant("scaled_dot_product_attention", "pallas")
-def sdpa_pallas(q, k, v, bias, *, scale=1.0):
-    return _sdpa_pallas(q, k, v, bias, scale)
+def sdpa_pallas(q, k, v, bias, *, scale=1.0, dropout_rate=0.0,
+                causal=False, is_test=False, rng=None):
+    rate = 0.0 if is_test else float(dropout_rate)
+    if bias is not None and bias.ndim == 4 and bias.shape[1] not in (
+            1, None) and bias.shape[1] != 1:
+        # per-head bias [B,H,Sq,Sk]: the kernel's BlockSpec shares one
+        # bias slab across a batch row's heads — take the reference
+        # lowering so both libraries accept the same inputs
+        return _sdpa_reference(q, k, v, bias, scale=scale,
+                               dropout_rate=rate, causal=causal,
+                               rng=rng)
+    if rate > 0.0 and (rng is None or interpret_mode()):
+        # the TPU PRNG has no interpreter emulation; CPU tests take the
+        # reference path (dropout masks differ across libraries anyway)
+        return _sdpa_reference(q, k, v, bias, scale=scale,
+                               dropout_rate=rate, causal=causal, rng=rng)
+    if rate > 0.0:
+        # fold the step key into a scalar TPU PRNG seed; float32 carries
+        # it through custom_vjp without an int-cotangent (float0) dance
+        seed_f = jax.random.randint(rng, (), 0, 1 << 23).astype(
+            jnp.float32)
+    else:
+        seed_f = jnp.float32(0)
+    return _sdpa_flash(q, k, v, bias, seed_f, float(scale), rate,
+                       bool(causal))

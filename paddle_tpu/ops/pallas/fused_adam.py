@@ -35,6 +35,14 @@ def _adam_kernel(scal_ref, p_ref, g_ref, m1_ref, m2_ref,
 @register_variant("adam", "pallas")
 def adam_pallas(param, grad, m1, m2, b1p, b2p, lr, *, beta1=0.9,
                 beta2=0.999, epsilon=1e-8, lazy_mode=False):
+    from ...core.selected_rows import SparseRows
+    if isinstance(grad, SparseRows):
+        # sparse grads take the scatter-apply reference path (the
+        # pallas kernel is a dense-elementwise fusion)
+        from ..registry import get
+        return get("adam").fn(param, grad, m1, m2, b1p, b2p, lr,
+                              beta1=beta1, beta2=beta2,
+                              epsilon=epsilon, lazy_mode=lazy_mode)
     shape, dtype = param.shape, param.dtype
     n = param.size
     # flatten + pad to [rows, 128] lanes, rows a multiple of the row

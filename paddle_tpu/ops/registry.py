@@ -37,6 +37,9 @@ class OpDef:
     # input slots excluded from differentiation (e.g. integer indices)
     nondiff_slots: frozenset = frozenset()
     needs_rng: bool = False
+    # outputs ADD into existing env entries instead of overwriting —
+    # for grad-producing ops (the reference's grad-accumulation sum)
+    accumulate_outputs: bool = False
     # alternate lowerings, e.g. {"pallas": fn} — kernel-type dispatch analog
     variants: Dict[str, Callable] = field(default_factory=dict)
 
@@ -50,7 +53,7 @@ _registry: Dict[str, OpDef] = {}
 
 
 def register(type, inputs, outputs, differentiable=True, nondiff=(),
-             needs_rng=False):
+             needs_rng=False, accumulate_outputs=False):
     """Decorator registering an op implementation.
 
     ``inputs``: list of slot names; suffix ``*`` marks a variadic slot.
@@ -71,7 +74,8 @@ def register(type, inputs, outputs, differentiable=True, nondiff=(),
         _registry[type] = OpDef(
             type=type, fn=fn, input_slots=input_slots,
             output_slots=list(outputs), differentiable=differentiable,
-            nondiff_slots=frozenset(nondiff), needs_rng=needs_rng)
+            nondiff_slots=frozenset(nondiff), needs_rng=needs_rng,
+            accumulate_outputs=accumulate_outputs)
         return fn
 
     return deco

@@ -71,17 +71,27 @@ def global_device_count() -> int:
     return jax.device_count()
 
 
-def pod_mesh(axes: Optional[Dict[str, int]] = None):
-    """A mesh spanning every device of every process. Without ``axes``,
-    builds {"dp": n_processes, <inner>: devices_per_process} so the
-    cross-host axis (DCN) carries only data-parallel all-reduces —
-    the hierarchical-allreduce layout of the reference
-    (MultiNCCLContextMap, nccl_helper.h:179)."""
+def pod_mesh(axes: Optional[Dict[str, int]] = None,
+             inner_axis: str = "tp"):
+    """A mesh spanning every device of every process, laid out
+    hierarchically: devices are ordered process-major, so an outer axis
+    of size ``n_processes`` crosses hosts (DCN) while inner axes stay
+    within a host's chips (ICI) — the hierarchical-allreduce layout of
+    the reference (MultiNCCLContextMap, nccl_helper.h:179).
+
+    Without ``axes``, builds {"dp": n_processes, inner_axis:
+    devices_per_process} so only data-parallel all-reduces cross DCN.
+    With explicit ``axes``, sizes must multiply to the global device
+    count; axes are nested in AXIS_ORDER with the process (DCN)
+    boundary landing on the outermost axes."""
+    n_proc = jax.process_count()
+    per_proc = jax.local_device_count()
+    # process-major ordering puts the host boundary on the outer axes
+    devices = sorted(jax.devices(),
+                     key=lambda d: (d.process_index, d.id))
     if axes is None:
-        n_proc = jax.process_count()
-        per_proc = jax.local_device_count()
         if n_proc > 1:
-            axes = {"dp": n_proc * per_proc}
+            axes = {"dp": n_proc, inner_axis: per_proc}
         else:
             axes = {"dp": per_proc}
-    return make_mesh(axes)
+    return make_mesh(axes, devices)

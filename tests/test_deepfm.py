@@ -50,7 +50,7 @@ def test_deepfm_sharded_tables_match_replicated():
         if shard:
             deepfm.shard_tables(main)
             prog = fluid.CompiledProgram(main).with_data_parallel(
-                axes={"dp": 2, "mp": 4})
+                axes={"dp": 2, "tp": 4})
         else:
             prog = main
         exe = fluid.Executor()

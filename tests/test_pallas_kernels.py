@@ -65,6 +65,49 @@ def test_sdpa_gradients_match():
                                    rtol=2e-5, atol=2e-6)
 
 
+def test_sdpa_causal_matches_reference():
+    r = np.random.RandomState(7)
+    B, H, S, Dh = 1, 2, 64, 16
+    q = jnp.asarray(r.randn(B, H, S, Dh).astype(np.float32))
+    k = jnp.asarray(r.randn(B, H, S, Dh).astype(np.float32))
+    v = jnp.asarray(r.randn(B, H, S, Dh).astype(np.float32))
+    bias = jnp.asarray(
+        np.where(r.rand(B, 1, 1, S) > 0.15, 0.0, -1e9)
+        .astype(np.float32))
+    bias = jnp.broadcast_to(bias, (B, 1, S, S))
+    _cmp("scaled_dot_product_attention", (q, k, v, bias),
+         {"scale": Dh ** -0.5, "causal": True})
+    _cmp("scaled_dot_product_attention", (q, k, v, None),
+         {"scale": Dh ** -0.5, "causal": True})
+
+
+def test_sdpa_flash_blocked_shapes():
+    """Shapes that force multiple k-blocks through the online-softmax
+    path (Sk > blk_k), fwd + grads — the flash recurrence itself."""
+    r = np.random.RandomState(8)
+    B, H, Sq, Sk, Dh = 1, 1, 256, 1024, 32
+    q = jnp.asarray(r.randn(B, H, Sq, Dh).astype(np.float32))
+    k = jnp.asarray(r.randn(B, H, Sk, Dh).astype(np.float32))
+    v = jnp.asarray(r.randn(B, H, Sk, Dh).astype(np.float32))
+    bias = jnp.asarray(
+        np.where(r.rand(B, 1, 1, Sk) > 0.1, 0.0, -1e9)
+        .astype(np.float32))
+    bias = jnp.broadcast_to(bias, (B, 1, Sq, Sk))
+    opdef = ops.get("scaled_dot_product_attention")
+    _cmp("scaled_dot_product_attention", (q, k, v, bias),
+         {"scale": Dh ** -0.5}, rtol=5e-5, atol=1e-5)
+
+    def loss(fn):
+        return lambda q_, k_, v_: jnp.sum(jnp.square(
+            fn(q_, k_, v_, bias, scale=Dh ** -0.5)))
+
+    gr = jax.grad(loss(opdef.fn), (0, 1, 2))(q, k, v)
+    gp = jax.grad(loss(opdef.variants["pallas"]), (0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_layer_norm_matches_reference():
     r = np.random.RandomState(2)
     x = jnp.asarray(r.randn(6, 4, 32).astype(np.float32))

