@@ -38,6 +38,12 @@ class UnimplementedError(EnforceNotMet, NotImplementedError):
     pass
 
 
+class UnavailableError(EnforceNotMet):
+    """Resource/service exists but cannot be used right now (reference:
+    platform/errors.h UNAVAILABLE)."""
+    pass
+
+
 def enforce(cond, msg="", *args, exc=InvalidArgumentError):
     """PADDLE_ENFORCE analog: raise ``exc`` with ``msg % args`` if not cond."""
     if not cond:

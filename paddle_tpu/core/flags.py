@@ -76,6 +76,9 @@ FLAGS.define("check_nan_inf", False,
 FLAGS.define("benchmark", False,
              "Block on device completion after every executor run.")
 FLAGS.define("cpu_deterministic", True, "Deterministic reductions on host.")
+FLAGS.define("infer_shape_debug", False,
+             "Log shape-inference failures at op-append time instead of "
+             "deferring errors to trace time.")
 FLAGS.define("deterministic", True,
              "Ask XLA for deterministic reductions (analog of "
              "cudnn_deterministic / sync_nccl_allreduce).")

@@ -93,6 +93,29 @@ def _op_rng(step_key, op_index):
     return jax.random.fold_in(step_key, op_index)
 
 
+def _gate_result(opdef, op, env, result, gate):
+    """Conditionally-applied op: the ``gate`` attr names a scalar bool
+    var; every output that overwrites an existing env entry (in-place
+    state updates like ParamOut/Param) keeps its previous value unless
+    the gate is true. This is the executor-level analog of the
+    reference's batch-merge pass putting optimizer ops behind a
+    condition (framework/ir/multi_batch_merge_pass.cc) — select instead
+    of branch, which is the XLA-friendly formulation."""
+    nslots = len(opdef.output_slots)
+    seq = result if nslots > 1 else (result,)
+    gated = []
+    for slot, val in zip(opdef.output_slots, seq):
+        variadic = slot.endswith("*")
+        names = op.outputs.get(slot[:-1] if variadic else slot, [])
+        if variadic:
+            val = [jnp.where(gate, v, env[n]) if n in env else v
+                   for n, v in zip(names, val)]
+        elif names and names[0] in env:
+            val = jnp.where(gate, val, env[names[0]])
+        gated.append(val)
+    return tuple(gated) if nslots > 1 else gated[0]
+
+
 def run_op(op, env, step_key, op_index, library=None, snapshot=False):
     """Trace a single forward op into the env. Used by the main trace loop
     and recursively by control-flow op impls.
@@ -107,6 +130,7 @@ def run_op(op, env, step_key, op_index, library=None, snapshot=False):
     attrs = dict(op.attrs)
     attrs.pop("op_role", None)
     attrs.pop("op_namescope", None)
+    gate = attrs.pop("gate", None)
     if opdef.needs_rng:
         attrs["rng"] = _op_rng(step_key, op_index)
     if snapshot:
@@ -115,6 +139,8 @@ def run_op(op, env, step_key, op_index, library=None, snapshot=False):
                 env[("fwd_in", op_index, n)] = env[n]
     fn = opdef.pick(library)
     result = fn(*vals, **attrs)
+    if gate is not None:
+        result = _gate_result(opdef, op, env, result, env[gate])
     _scatter_outputs(opdef, op, env, result)
 
 

@@ -387,7 +387,7 @@ def _infer_shapes(block, op):
             else:
                 arg_structs.append(structs[0])
         attrs = {k: v for k, v in op.attrs.items()
-                 if k not in ("op_role", "op_namescope")}
+                 if k not in ("op_role", "op_namescope", "gate")}
         if opdef.needs_rng:
             def fn(*args, **kw):
                 import jax as _jax
@@ -399,7 +399,17 @@ def _infer_shapes(block, op):
         attrs.pop("rng", None)
         with _trace_program_guard(block.program):
             out = jax.eval_shape(lambda *a: fn(*a, **attrs), *arg_structs)
-    except Exception:
+    except Exception as e:
+        # Best-effort by design (abstract eval can't see runtime-only
+        # constructs), but a typo'd op should not fail silently: under
+        # FLAGS_infer_shape_debug the failure surfaces here, at the
+        # append_op site, instead of as a confusing trace error later.
+        from .core.flags import FLAGS as _FLAGS
+        if _FLAGS.infer_shape_debug:
+            import warnings
+            warnings.warn(
+                "shape inference failed for op %r: %s: %s"
+                % (op.type, type(e).__name__, e), stacklevel=3)
         return
 
     nslots = len(opdef.output_slots)

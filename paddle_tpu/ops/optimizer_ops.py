@@ -247,3 +247,109 @@ def proximal_gd(param, grad, lr, *, l1=0.0, l2=0.0):
     if l1 > 0:
         prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
     return prox / (1.0 + lr * l2)
+
+
+# -- gradient accumulation (batch merge) -----------------------------------
+# Reference: framework/ir/multi_batch_merge_pass.cc replicates the
+# fwd/bwd subgraph N times and runs the optimizer section once per N
+# micro-batches. The TPU-native formulation keeps ONE program: a
+# persistable accumulator per parameter plus a step counter, with the
+# update ops gated (executor._gate_result selects old vs new state) —
+# no graph replication, no dynamic control flow, everything jits.
+
+
+@register("accum_steps_counter", ["Counter"], ["CounterOut", "ShouldApply"],
+          differentiable=False)
+def accum_steps_counter(counter, *, k):
+    """Micro-step counter: rolls over every ``k`` steps; ShouldApply is
+    true on the k-th micro-step."""
+    c = (counter + 1) % k
+    return c, c == 0
+
+
+@register("grad_accumulate", ["Acc", "Grad", "ShouldApply"],
+          ["AccOut", "GradOut"], differentiable=False)
+def grad_accumulate(acc, grad, should_apply, *, k):
+    """AccOut = running sum (reset to zero on the apply step);
+    GradOut = mean gradient over the window, consumed by the gated
+    update op that runs right after."""
+    s = acc + grad
+    return jnp.where(should_apply, jnp.zeros_like(s), s), \
+        s / jnp.asarray(k, s.dtype)
+
+
+# -- parameter averaging ---------------------------------------------------
+
+_K_MAX_NUM_ACCUMULATES = 16384  # average_accumulates_op.h kMaxNumAccumulates
+
+
+@register("average_accumulates",
+          ["Param", "Sum1", "Sum2", "Sum3", "NumAccumulates",
+           "OldNumAccumulates", "NumUpdates"],
+          ["Sum1Out", "Sum2Out", "Sum3Out", "NumAccumulatesOut",
+           "OldNumAccumulatesOut", "NumUpdatesOut"],
+          differentiable=False)
+def average_accumulates(param, s1, s2, s3, num_acc, old_num_acc,
+                        num_updates, *, average_window=0.0,
+                        min_average_window=10000,
+                        max_average_window=10000):
+    """Sliding-window parameter sum for ModelAverage (reference:
+    operators/average_accumulates_op.h). sum_1 accumulates every step;
+    it periodically spills into sum_2 (bounding float error); when the
+    window is full the total snapshots into sum_3 and restarts."""
+    num_updates = num_updates + 1
+    num_acc = num_acc + 1
+    s1 = s1 + param
+    spill = num_updates % _K_MAX_NUM_ACCUMULATES == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(max_average_window, num_updates.dtype),
+        (num_updates.astype(jnp.float32)
+         * average_window).astype(num_updates.dtype))
+    full = (num_acc >= min_average_window) & (num_acc >= window)
+    s3 = jnp.where(full, s1 + s2, s3)
+    s1 = jnp.where(full, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(full, jnp.zeros_like(s2), s2)
+    old_num_acc = jnp.where(full, num_acc, old_num_acc)
+    num_acc = jnp.where(full, jnp.zeros_like(num_acc), num_acc)
+    return s1, s2, s3, num_acc, old_num_acc, num_updates
+
+
+@register("model_average_apply",
+          ["Sum1", "Sum2", "Sum3", "NumAccumulates", "OldNumAccumulates"],
+          ["Out"], differentiable=False)
+def model_average_apply(s1, s2, s3, num_acc, old_num_acc):
+    n = jnp.maximum(num_acc + old_num_acc, 1).astype(s1.dtype)
+    return (s1 + s2 + s3) / n
+
+
+# -- exponential moving average --------------------------------------------
+
+
+@register("ema_update", ["Param", "Ema", "DecayPow", "Step"],
+          ["EmaOut", "DecayPowOut"], differentiable=False)
+def ema_update(param, ema, decay_pow, step=None, *, decay=0.999,
+               use_thres=False):
+    """Shadow-variable update (reference: optimizer.py:2412
+    ExponentialMovingAverage). ``use_thres`` ramps the decay like the
+    reference's thres_steps mode: decay_t = min(decay, (1+t)/(10+t));
+    Step is only wired in that mode. DecayPow tracks the product of
+    applied decays for bias correction."""
+    d = jnp.asarray(decay, param.dtype)
+    if use_thres:
+        t = step.astype(param.dtype)
+        d = jnp.minimum(d, (1.0 + t) / (10.0 + t))
+    return d * ema + (1.0 - d) * param, \
+        decay_pow * d.astype(decay_pow.dtype)
+
+
+@register("ema_apply", ["Ema", "DecayPow"], ["Out"], differentiable=False)
+def ema_apply(ema, decay_pow):
+    """Bias-corrected shadow value: ema / (1 - prod(decay)); before any
+    update (decay_pow == 1) the raw ema (zeros) is returned as-is."""
+    denom = 1.0 - decay_pow
+    out = jnp.where(denom > 0,
+                    ema / jnp.where(denom > 0, denom, 1.0).astype(
+                        ema.dtype), ema)
+    return out.astype(ema.dtype)

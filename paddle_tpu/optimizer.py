@@ -14,6 +14,7 @@ step, so the reference's fuse_all_optimizer_ops pass
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from . import framework, unique_name
@@ -34,6 +35,7 @@ class Optimizer:
         self._name = name
         self._learning_rate_map: Dict[int, Variable] = {}
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._accumulate_steps = 1
         self.type = self.__class__.__name__.lower()
 
     # -- learning rate -----------------------------------------------------
@@ -96,11 +98,64 @@ class Optimizer:
         return append_backward(loss, parameter_list, no_grad_set,
                                callbacks)
 
+    def _append_grad_accumulation(self, block, params_grads, k):
+        """Gradient accumulation over ``k`` micro-steps — the TPU-native
+        analog of the reference's batch-merge pass
+        (framework/ir/multi_batch_merge_pass.cc): instead of replicating
+        the fwd/bwd subgraph k times, ONE program keeps a per-param
+        running-sum accumulator + a step counter, and the update ops are
+        gated (the executor selects old vs updated state) so parameters
+        and optimizer moments change only every k-th run."""
+        counter = tensor_layers.create_global_var(
+            shape=(), value=0.0, dtype="int32", persistable=True,
+            name=unique_name.generate("grad_acc_counter"))
+        helper = LayerHelper("grad_acc")
+        should = helper.create_variable_for_type_inference(
+            "bool", stop_gradient=True)
+        # inserted at the FRONT of the block so the gate value exists
+        # before any op that must be gated — including LR-schedule step
+        # counters appended during forward construction
+        block.append_op(
+            type="accum_steps_counter", inputs={"Counter": [counter]},
+            outputs={"CounterOut": [counter], "ShouldApply": [should]},
+            attrs={"k": int(k), "op_role": "optimize"}, index=0)
+        # LR schedules must advance once per APPLIED update, not once
+        # per micro-step (the reference batch-merge pass gates the whole
+        # optimize section, lr-decay ops included)
+        for op in block.ops:
+            if any("@LR_DECAY_COUNTER@" in n
+                   for n in op.output_arg_names):
+                op.attrs["gate"] = should.name
+        new_pg = []
+        for p, g in params_grads:
+            if g is None:
+                new_pg.append((p, g))
+                continue
+            acc = tensor_layers.create_global_var(
+                shape=tuple(p.shape), value=0.0, dtype=g.dtype,
+                persistable=True,
+                name=unique_name.generate(p.name + "_grad_acc"))
+            g_eff = block.create_var(
+                name=unique_name.generate(g.name + ".window_mean"),
+                shape=tuple(p.shape), dtype=g.dtype, stop_gradient=True)
+            block.append_op(
+                type="grad_accumulate",
+                inputs={"Acc": [acc], "Grad": [g],
+                        "ShouldApply": [should]},
+                outputs={"AccOut": [acc], "GradOut": [g_eff]},
+                attrs={"k": float(k), "op_role": "optimize"})
+            new_pg.append((p, g_eff))
+        return new_pg, should
+
     def apply_gradients(self, params_grads):
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         block = default_main_program().global_block()
+        gate = None
+        if self._accumulate_steps > 1:
+            params_grads, gate = self._append_grad_accumulation(
+                block, params_grads, self._accumulate_steps)
         self._create_global_learning_rate()
         self._create_accumulators(
             block, [p for p, g in params_grads if g is not None])
@@ -108,12 +163,18 @@ class Optimizer:
         for pg in params_grads:
             if pg[1] is None:
                 continue
-            optimize_ops.append(self._append_optimize_op(block, pg))
+            op = self._append_optimize_op(block, pg)
+            if gate is not None and op is not None:
+                op.attrs["gate"] = gate.name
+            optimize_ops.append(op)
         self._finish_update(block, params_grads)
         return optimize_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None, grad_clip=None):
+                 no_grad_set=None, grad_clip=None, accumulate_steps=None):
+        """``accumulate_steps=k`` applies the update once per k runs on
+        the mean of the k gradients (static-graph mode only; gradient
+        clipping then acts on each micro-gradient)."""
         from . import dygraph
         if dygraph.enabled():
             # eager path: tape backward + in-place param updates via the
@@ -122,6 +183,12 @@ class Optimizer:
             params_grads = apply_dygraph(self, loss, parameter_list,
                                          grad_clip=grad_clip)
             return [], params_grads
+        if accumulate_steps is None:
+            self._accumulate_steps = 1
+        else:
+            enforce(int(accumulate_steps) >= 1,
+                    "accumulate_steps must be >= 1")
+            self._accumulate_steps = int(accumulate_steps)
         params_grads = self.backward(loss, startup_program,
                                      parameter_list, no_grad_set)
         if grad_clip is not None:
@@ -482,6 +549,197 @@ class LambOptimizer(Optimizer):
                    "epsilon": self._epsilon,
                    "weight_decay": self._weight_decay,
                    "op_role": "optimize"})
+
+
+def _declare_persistable(block, var):
+    """Declare an existing persistable var (by name) inside a fresh
+    program so the executor binds it to the scope value — the pattern
+    of reference io.py's _clone_var_in_block_."""
+    return block.create_var(name=var.name, shape=tuple(var.shape),
+                            dtype=var.dtype, persistable=True,
+                            stop_gradient=True)
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference: optimizer.py:2222
+    ModelAverage + operators/average_accumulates_op). Construct AFTER
+    optimizer.minimize: appends an average_accumulates op per parameter
+    to the main program; ``apply()`` swaps parameters for their window
+    average (eval), ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None,
+                 name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        main = default_main_program()
+        block = main.global_block()
+        self._params = [
+            p for p in block.all_parameters()
+            if p.trainable
+            and getattr(p, "do_model_average", None) is not False]
+        for p in self._params:
+            self._create_accumulators(block, [p])
+            self._append_average_accumulate_op(block, p)
+        self._build_programs()
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("sum_1", p)
+            self._add_accumulator("sum_2", p)
+            self._add_accumulator("sum_3", p)
+            self._add_accumulator("num_accumulates", p, dtype="int64",
+                                  shape=())
+            self._add_accumulator("old_num_accumulates", p,
+                                  dtype="int64", shape=())
+            self._add_accumulator("num_updates", p, dtype="int64",
+                                  shape=())
+
+    def _acc_vars(self, p):
+        return [self._get_accumulator(n, p)
+                for n in ("sum_1", "sum_2", "sum_3", "num_accumulates",
+                          "old_num_accumulates", "num_updates")]
+
+    def _append_average_accumulate_op(self, block, param):
+        s1, s2, s3, na, ona, nu = self._acc_vars(param)
+        block.append_op(
+            type="average_accumulates",
+            inputs={"Param": [param], "Sum1": [s1], "Sum2": [s2],
+                    "Sum3": [s3], "NumAccumulates": [na],
+                    "OldNumAccumulates": [ona], "NumUpdates": [nu]},
+            outputs={"Sum1Out": [s1], "Sum2Out": [s2], "Sum3Out": [s3],
+                     "NumAccumulatesOut": [na],
+                     "OldNumAccumulatesOut": [ona],
+                     "NumUpdatesOut": [nu]},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window,
+                   "op_role": "optimize"})
+
+    def _build_programs(self):
+        self._apply_program = framework.Program()
+        ab = self._apply_program.global_block()
+        self._restore_program = framework.Program()
+        rb = self._restore_program.global_block()
+        for p in self._params:
+            pv = _declare_persistable(ab, p)
+            accs = [_declare_persistable(ab, v)
+                    for v in self._acc_vars(p)]
+            backup = ab.create_var(
+                name=p.name + ".model_avg_backup", shape=tuple(p.shape),
+                dtype=p.dtype, persistable=True, stop_gradient=True)
+            ab.append_op(type="assign", inputs={"X": [pv]},
+                         outputs={"Out": [backup]})
+            ab.append_op(
+                type="model_average_apply",
+                inputs={"Sum1": [accs[0]], "Sum2": [accs[1]],
+                        "Sum3": [accs[2]], "NumAccumulates": [accs[3]],
+                        "OldNumAccumulates": [accs[4]]},
+                outputs={"Out": [pv]})
+            rpv = _declare_persistable(rb, p)
+            rbk = _declare_persistable(rb, backup)
+            rb.append_op(type="assign", inputs={"X": [rbk]},
+                         outputs={"Out": [rpv]})
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError(
+            "ModelAverage is not a training optimizer; construct it "
+            "after optimizer.minimize")
+
+    @contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap params for their averages within the context."""
+        executor.run(self._apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self._restore_program)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with bias correction (reference:
+    optimizer.py:2412). Call ``update()`` after optimizer.minimize to
+    append shadow updates to the main program; ``apply()`` swaps in the
+    bias-corrected shadow values for evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._name = name or ""
+        main = default_main_program()
+        block = main.global_block()
+        self._params = [p for p in block.all_parameters() if p.trainable]
+        self._ema = {}
+        for p in self._params:
+            self._ema[p.name] = tensor_layers.create_global_var(
+                shape=tuple(p.shape), value=0.0, dtype=p.dtype,
+                persistable=True,
+                name=unique_name.generate(p.name + ".ema"))
+        self._decay_pow = tensor_layers.create_global_var(
+            shape=(), value=1.0, dtype="float32", persistable=True,
+            name=unique_name.generate(self._name + "ema_decay_pow"))
+        self._build_programs()
+
+    def update(self):
+        block = default_main_program().global_block()
+        helper = LayerHelper("ema")
+        use_thres = self._thres_steps is not None
+        for i, p in enumerate(self._params):
+            ema = self._ema[p.name]
+            inputs = {"Param": [p], "Ema": [ema],
+                      "DecayPow": [self._decay_pow]}
+            if use_thres:
+                inputs["Step"] = [self._thres_steps]
+            # decay_pow is shared (the decay schedule is global): only
+            # the first op commits it; the rest discard the output
+            dp_out = self._decay_pow if i == 0 else \
+                helper.create_variable_for_type_inference(
+                    "float32", stop_gradient=True)
+            block.append_op(
+                type="ema_update", inputs=inputs,
+                outputs={"EmaOut": [ema], "DecayPowOut": [dp_out]},
+                attrs={"decay": self._decay, "use_thres": use_thres,
+                       "op_role": "optimize"})
+
+    def _build_programs(self):
+        self._apply_program = framework.Program()
+        ab = self._apply_program.global_block()
+        self._restore_program = framework.Program()
+        rb = self._restore_program.global_block()
+        for p in self._params:
+            pv = _declare_persistable(ab, p)
+            ev = _declare_persistable(ab, self._ema[p.name])
+            dpv = _declare_persistable(ab, self._decay_pow)
+            backup = ab.create_var(
+                name=p.name + ".ema_backup", shape=tuple(p.shape),
+                dtype=p.dtype, persistable=True, stop_gradient=True)
+            ab.append_op(type="assign", inputs={"X": [pv]},
+                         outputs={"Out": [backup]})
+            ab.append_op(type="ema_apply",
+                         inputs={"Ema": [ev], "DecayPow": [dpv]},
+                         outputs={"Out": [pv]})
+            rpv = _declare_persistable(rb, p)
+            rbk = _declare_persistable(rb, backup)
+            rb.append_op(type="assign", inputs={"X": [rbk]},
+                         outputs={"Out": [rpv]})
+
+    @contextmanager
+    def apply(self, executor, need_restore=True):
+        executor.run(self._apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self._restore_program)
 
 
 # fluid-style aliases (reference exports both names)

@@ -1,0 +1,26 @@
+"""API-stability freeze test — the analog of the reference's
+paddle/fluid/API.spec (599 frozen signatures) + tools/diff_api.py CI
+check: any change to the public surface must come with a deliberate
+regeneration of API.spec (python tools/print_signatures.py > API.spec).
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+def test_api_surface_frozen():
+    import print_signatures
+    current = print_signatures.generate()
+    with open(os.path.join(ROOT, "API.spec")) as f:
+        frozen = f.read().splitlines()
+    cur_set, froz_set = set(current), set(frozen)
+    removed = sorted(froz_set - cur_set)
+    added = sorted(cur_set - froz_set)
+    assert not removed and not added, (
+        "public API changed; if intentional regenerate API.spec "
+        "(python tools/print_signatures.py > API.spec)\n"
+        "removed:\n  %s\nadded:\n  %s"
+        % ("\n  ".join(removed[:20]), "\n  ".join(added[:20])))

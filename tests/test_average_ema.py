@@ -1,0 +1,229 @@
+"""Gradient accumulation + ModelAverage + EMA tests.
+
+Reference analogs: test_dist_mnist_batch_merge.py (the batch-merge pass,
+multi_batch_merge_pass.cc), test_model_average (optimizer.py:2222),
+test_ema (optimizer.py:2412).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _linear_model(opt, seed=11, accumulate_steps=None):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        y = layers.data("y", shape=[1], append_batch_size=False)
+        w = layers.create_parameter(shape=(4, 1), dtype="float32",
+                                    name="w")
+        pred = layers.matmul(x, w)
+        loss = layers.reduce_mean(
+            layers.square_error_cost(input=pred, label=y))
+        kwargs = {}
+        if accumulate_steps is not None:
+            kwargs["accumulate_steps"] = accumulate_steps
+        opt.minimize(loss, **kwargs)
+    return main, startup, loss, w
+
+
+def _param(name="w"):
+    return np.asarray(fluid.global_scope().find_var(name))
+
+
+class TestGradAccumulation:
+    def _data(self, rng, n):
+        xs = rng.rand(n, 2, 4).astype(np.float32)
+        ys = rng.rand(n, 2, 1).astype(np.float32)
+        return xs, ys
+
+    def _run(self, opt_fn, accumulate_steps, feeds, scope):
+        with fluid.scope_guard(scope):
+            main, startup, loss, w = _linear_model(
+                opt_fn(), accumulate_steps=accumulate_steps)
+            exe = fluid.Executor()
+            exe.run(startup)
+            w0 = _param().copy()
+            for x, y in feeds:
+                exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+            return w0, _param().copy()
+
+    def test_params_frozen_mid_window(self, rng):
+        """Within the accumulation window params must not move."""
+        xs, ys = self._data(rng, 3)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss, w = _linear_model(
+                optimizer.SGD(learning_rate=0.1), accumulate_steps=4)
+            exe = fluid.Executor()
+            exe.run(startup)
+            w0 = _param().copy()
+            for x, y in zip(xs, ys):
+                exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+            np.testing.assert_array_equal(w0, _param())
+
+    def test_sgd_equals_big_batch(self, rng):
+        """k micro-steps with accumulation == one step on the mean
+        gradient of the k micro-batches (all grads at the same params:
+        exactly one big-batch step)."""
+        xs, ys = self._data(rng, 4)
+        feeds = list(zip(xs, ys))
+        _, w_acc = self._run(lambda: optimizer.SGD(learning_rate=0.1),
+                             4, feeds, fluid.Scope())
+        # big batch: all 8 rows at once, mean loss
+        bigx = xs.reshape(8, 4)
+        bigy = ys.reshape(8, 1)
+        _, w_big = self._run(lambda: optimizer.SGD(learning_rate=0.1),
+                             None, [(bigx, bigy)], fluid.Scope())
+        np.testing.assert_allclose(w_acc, w_big, rtol=1e-5, atol=1e-6)
+
+    def test_adam_moments_step_once(self, rng):
+        """Adam under accumulation: after k micro-steps the result
+        matches exactly ONE Adam step on the big batch — moments and
+        beta powers must advance once, not k times."""
+        xs, ys = self._data(rng, 2)
+        feeds = list(zip(xs, ys))
+        _, w_acc = self._run(lambda: optimizer.Adam(learning_rate=0.05),
+                             2, feeds, fluid.Scope())
+        bigx = xs.reshape(4, 4)
+        bigy = ys.reshape(4, 1)
+        _, w_big = self._run(lambda: optimizer.Adam(learning_rate=0.05),
+                             None, [(bigx, bigy)], fluid.Scope())
+        np.testing.assert_allclose(w_acc, w_big, rtol=1e-5, atol=1e-6)
+
+    def test_lr_schedule_steps_per_window(self, rng):
+        """LR-schedule counters advance once per APPLIED update, not
+        once per micro-step (batch-merge gates lr-decay ops too)."""
+        xs, ys = self._data(rng, 4)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", shape=[2, 4],
+                                append_batch_size=False)
+                y = layers.data("y", shape=[2, 1],
+                                append_batch_size=False)
+                w = layers.create_parameter(shape=(4, 1),
+                                            dtype="float32", name="w")
+                loss = layers.reduce_mean(layers.square_error_cost(
+                    input=layers.matmul(x, w), label=y))
+                lr = layers.exponential_decay(0.1, decay_steps=1,
+                                              decay_rate=0.5)
+                optimizer.SGD(learning_rate=lr).minimize(
+                    loss, accumulate_steps=2)
+            exe = fluid.Executor()
+            exe.run(startup)
+            for xb, yb in zip(xs, ys):
+                exe.run(main, feed={"x": xb, "y": yb},
+                        fetch_list=[loss])
+            counters = [n for n in main.global_block().vars
+                        if "@LR_DECAY_COUNTER@" in n]
+            assert counters, "no LR counter var found"
+            val = int(np.asarray(scope.find_var(counters[0])))
+            # 4 micro-steps / window of 2 = 2 applied updates
+            assert val == 2, val
+
+    def test_multiple_windows(self, rng):
+        """Two full windows apply two updates."""
+        xs, ys = self._data(rng, 4)
+        feeds = list(zip(xs, ys))
+        w0, w_acc = self._run(lambda: optimizer.SGD(learning_rate=0.1),
+                              2, feeds, fluid.Scope())
+        assert not np.allclose(w0, w_acc)
+
+
+class TestEMA:
+    def test_ema_tracks_and_restores(self, rng):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main = fluid.Program()
+            startup = fluid.Program()
+            main.random_seed = startup.random_seed = 5
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", shape=[4], append_batch_size=False)
+                w = layers.create_parameter(shape=(4,), dtype="float32",
+                                            name="w")
+                loss = layers.reduce_sum(layers.square(x - w))
+                optimizer.SGD(learning_rate=0.1).minimize(loss)
+                ema = optimizer.ExponentialMovingAverage(decay=0.9)
+                ema.update()
+            exe = fluid.Executor()
+            exe.run(startup)
+            decay = 0.9
+            shadow = np.zeros(4, np.float32)
+            dpow = 1.0
+            target = rng.rand(4).astype(np.float32)
+            for _ in range(5):
+                exe.run(main, feed={"x": target}, fetch_list=[loss])
+                shadow = decay * shadow + (1 - decay) * _param()
+                dpow *= decay
+            raw = _param().copy()
+            with ema.apply(exe):
+                corrected = shadow / (1 - dpow)
+                np.testing.assert_allclose(_param(), corrected,
+                                           rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(_param(), raw, rtol=1e-6)
+
+    def test_ema_apply_no_restore(self, rng):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", shape=[2], append_batch_size=False)
+                w = layers.create_parameter(shape=(2,), dtype="float32",
+                                            name="w")
+                loss = layers.reduce_sum(layers.square(x - w))
+                optimizer.SGD(learning_rate=0.5).minimize(loss)
+                ema = optimizer.ExponentialMovingAverage(decay=0.5)
+                ema.update()
+            exe = fluid.Executor()
+            exe.run(startup)
+            # two different targets: the corrected EMA is a mix of two
+            # distinct param values (after only one step it would equal
+            # the raw param exactly, by bias correction)
+            exe.run(main, feed={"x": np.ones(2, np.float32)},
+                    fetch_list=[loss])
+            exe.run(main, feed={"x": -np.ones(2, np.float32)},
+                    fetch_list=[loss])
+            raw = _param().copy()
+            with ema.apply(exe, need_restore=False):
+                pass
+            assert not np.allclose(_param(), raw)
+
+
+class TestModelAverage:
+    def test_average_and_restore(self, rng):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main = fluid.Program()
+            startup = fluid.Program()
+            main.random_seed = startup.random_seed = 3
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", shape=[4], append_batch_size=False)
+                w = layers.create_parameter(shape=(4,), dtype="float32",
+                                            name="w")
+                loss = layers.reduce_sum(layers.square(x - w))
+                optimizer.SGD(learning_rate=0.2).minimize(loss)
+                avg = optimizer.ModelAverage(
+                    0.15, min_average_window=10000,
+                    max_average_window=10000)
+            exe = fluid.Executor()
+            exe.run(startup)
+            target = rng.rand(4).astype(np.float32)
+            snapshots = []
+            for _ in range(4):
+                exe.run(main, feed={"x": target}, fetch_list=[loss])
+                snapshots.append(_param().copy())
+            raw = _param().copy()
+            with avg.apply(exe):
+                # window never filled: average of every post-update value
+                np.testing.assert_allclose(
+                    _param(), np.mean(snapshots, axis=0),
+                    rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(_param(), raw, rtol=1e-6)

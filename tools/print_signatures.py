@@ -1,0 +1,92 @@
+"""Dump every public API signature, one line each — the analog of the
+reference's tools/print_signatures.py, whose output is frozen in
+paddle/fluid/API.spec (599 entries) and diffed by CI (tools/diff_api.py)
+so the public surface can't change silently.
+
+Regenerate after an intentional API change:
+
+    python tools/print_signatures.py > API.spec
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+import types
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# Public modules whose surface is frozen. Submodules re-exported from
+# `layers` are covered through the `layers` namespace itself.
+PUBLIC_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.backward",
+    "paddle_tpu.io",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.clip",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.param_attr",
+    "paddle_tpu.profiler",
+    "paddle_tpu.unique_name",
+    "paddle_tpu.reader",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.parallel",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.contrib.mixed_precision",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _entries_for(modname):
+    __import__(modname)
+    mod = sys.modules[modname]
+    entries = []
+    for name in sorted(dir(mod)):
+        if name.startswith("_"):
+            continue
+        obj = getattr(mod, name)
+        qual = "%s.%s" % (modname, name)
+        if isinstance(obj, types.ModuleType):
+            continue
+        if inspect.isclass(obj):
+            if obj.__module__ and not obj.__module__.startswith(
+                    "paddle_tpu"):
+                continue
+            entries.append("%s %s" % (qual, _sig(obj.__init__)))
+            for mname in sorted(dir(obj)):
+                if mname.startswith("_"):
+                    continue
+                m = inspect.getattr_static(obj, mname)
+                if isinstance(m, (staticmethod, classmethod)):
+                    m = m.__func__
+                if inspect.isfunction(m):
+                    entries.append("%s.%s %s" % (qual, mname, _sig(m)))
+        elif callable(obj):
+            if getattr(obj, "__module__", "") and \
+                    not obj.__module__.startswith("paddle_tpu"):
+                continue
+            entries.append("%s %s" % (qual, _sig(obj)))
+    return entries
+
+
+def generate():
+    lines = []
+    for modname in PUBLIC_MODULES:
+        lines.extend(_entries_for(modname))
+    return lines
+
+
+if __name__ == "__main__":
+    for line in generate():
+        print(line)
