@@ -9,6 +9,8 @@ from .control_flow import (DynamicRNN, IfElse, StaticRNN,  # noqa: F401
                            less_equal, less_than, logical_and,
                            logical_not, logical_or, logical_xor,
                            not_equal)
+from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from .learning_rate_scheduler import (cosine_decay,  # noqa: F401
                                       exponential_decay,

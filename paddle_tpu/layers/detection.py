@@ -1,0 +1,499 @@
+"""Detection layers — the fluid.layers.detection API surface.
+
+Reference: python/paddle/fluid/layers/detection.py (__all__: prior_box,
+density_prior_box, multi_box_head, bipartite_match, target_assign,
+detection_output, ssd_loss, rpn_target_assign, anchor_generator,
+generate_proposals, iou_similarity, box_coder, polygon_box_transform,
+yolov3_loss, yolo_box, box_clip, multiclass_nms,
+distribute_fpn_proposals, box_decoder_and_assign,
+collect_fpn_proposals; detection_map is provided host-side as
+metrics.DetectionMAP).
+
+LoD → padded redesign: ground-truth boxes arrive as dense [N, B, 4]
+tensors with all-zero padding rows (and [N, B] labels), ROI lists carry
+an explicit batch-index tensor, and NMS-style ops return padded outputs
+plus valid counts — see ops/detection_ops.py for the rationale.
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box", "density_prior_box", "multi_box_head",
+    "bipartite_match", "target_assign", "detection_output", "ssd_loss",
+    "rpn_target_assign", "anchor_generator", "generate_proposals",
+    "iou_similarity", "box_coder", "polygon_box_transform",
+    "yolov3_loss", "yolo_box", "box_clip", "multiclass_nms",
+    "distribute_fpn_proposals", "box_decoder_and_assign",
+    "collect_fpn_proposals", "roi_align", "roi_pool",
+]
+
+
+def _mk(helper, dtype="float32", stop_gradient=False):
+    return helper.create_variable_for_type_inference(
+        dtype, stop_gradient=stop_gradient)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    """Reference: layers/detection.py prior_box."""
+    helper = LayerHelper("prior_box", name=name)
+    boxes = _mk(helper, stop_gradient=True)
+    var = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": tuple(float(m) for m in min_sizes),
+               "max_sizes": tuple(float(m) for m in (max_sizes or ())),
+               "aspect_ratios": tuple(aspect_ratios),
+               "variances": tuple(variance), "flip": flip,
+               "clip": clip, "step_w": float(steps[0]),
+               "step_h": float(steps[1]), "offset": offset,
+               "min_max_aspect_ratios_order":
+                   min_max_aspect_ratios_order})
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = _mk(helper, stop_gradient=True)
+    var = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": tuple(densities),
+               "fixed_sizes": tuple(fixed_sizes),
+               "fixed_ratios": tuple(fixed_ratios),
+               "variances": tuple(variance), "clip": clip,
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": offset, "flatten_to_2d": flatten_to_2d})
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = _mk(helper, stop_gradient=True)
+    var = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": tuple(anchor_sizes or
+                                     (64.0, 128.0, 256.0, 512.0)),
+               "aspect_ratios": tuple(aspect_ratios or (0.5, 1.0, 2.0)),
+               "variances": tuple(variance),
+               "stride": tuple(stride or (16.0, 16.0)),
+               "offset": offset})
+    return anchors, var
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = _mk(helper, stop_gradient=True)
+    helper.append_op(type="iou_similarity",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = _mk(helper)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = tuple(float(v) for v in prior_box_var)
+    elif prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(type="box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = _mk(helper)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = _mk(helper, stop_gradient=True)
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """dist_matrix [B, N, M] (padded gt rows all-zero) →
+    (match_indices [B, M] int32, match_distance [B, M])."""
+    helper = LayerHelper("bipartite_match", name=name)
+    midx = _mk(helper, "int32", stop_gradient=True)
+    mdist = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [midx],
+                 "ColToRowMatchDist": [mdist]},
+        attrs={"match_type": match_type,
+               "dist_threshold": dist_threshold})
+    return midx, mdist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    """input [B, N, K] entity targets; matched_indices [B, M];
+    negative_indices is a [B, M] 0/1 mask (LoD redesign). The gather is
+    differentiable through ``input`` (rpn_target_assign routes head
+    predictions through it, which must carry gradient)."""
+    helper = LayerHelper("target_assign", name=name)
+    out = _mk(helper)
+    weight = _mk(helper, stop_gradient=True)
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op(type="target_assign", inputs=inputs,
+                     outputs={"Out": [out], "OutWeight": [weight]},
+                     attrs={"mismatch_value": float(mismatch_value)})
+    return out, weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """bboxes [N, M, 4], scores [N, C, M] → (Out [N, keep_top_k, 6]
+    padded with -1 rows, valid counts [N])."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = _mk(helper, stop_gradient=True)
+    num = _mk(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [num]},
+        attrs={"background_label": background_label,
+               "score_threshold": float(score_threshold),
+               "nms_top_k": nms_top_k,
+               "nms_threshold": float(nms_threshold),
+               "nms_eta": float(nms_eta), "keep_top_k": keep_top_k,
+               "normalized": normalized})
+    return out, num
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, nms_eta=1.0):
+    """SSD inference head: softmax + decode + multiclass NMS
+    (reference: layers/detection.py detection_output — which applies
+    the softmax internally too). loc [N, P, 4], scores [N, P, C] raw
+    logits, prior_box [P, 4]."""
+    from . import nn
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores = nn.softmax(scores)
+    scores_t = nn.transpose(scores, (0, 2, 1))  # [N, C, P]
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          normalized=False, nms_eta=nms_eta,
+                          background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """Fused SSD multibox loss (see ops/detection_ops.py ssd_loss).
+    gt_box [N, B, 4] padded (all-zero rows), gt_label [N, B] int.
+    Returns [N, P] per-prior weighted loss."""
+    helper = LayerHelper("ssd_loss")
+    out = _mk(helper)
+    inputs = {"Location": [location], "Confidence": [confidence],
+              "GtBox": [gt_box], "GtLabel": [gt_label],
+              "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss", inputs=inputs, outputs={"Loss": [out]},
+        attrs={"background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio,
+               "neg_overlap": neg_overlap,
+               "loc_loss_weight": loc_loss_weight,
+               "conf_loss_weight": conf_loss_weight,
+               "match_type": match_type, "mining_type": mining_type,
+               "normalize": normalize,
+               "sample_size": int(sample_size or 0)})
+    return out
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """gt_box [N, B, 4] (cx, cy, w, h normalized; zero rows pad)."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = _mk(helper)
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op(
+        type="yolov3_loss", inputs=inputs, outputs={"Loss": [loss]},
+        attrs={"anchors": tuple(anchors),
+               "anchor_mask": tuple(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth})
+    return loss
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = _mk(helper, stop_gradient=True)
+    scores = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": tuple(anchors), "class_num": class_num,
+               "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio,
+               "clip_bbox": clip_bbox})
+    return boxes, scores
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors,
+                       variances, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, name=None):
+    """Returns (rpn_rois [N, post_nms_top_n, 4] padded, roi_probs,
+    rois_num [N]) — the LoD output of the reference becomes
+    padded + count."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = _mk(helper, stop_gradient=True)
+    probs = _mk(helper, stop_gradient=True)
+    num = _mk(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [num]},
+        attrs={"pre_nms_top_n": pre_nms_top_n,
+               "post_nms_top_n": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size,
+               "eta": eta})
+    return rois, probs, num
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """Static redesign: returns fixed-size [N, S] slot tensors
+    (anchor indices padded with -1, labels 1/0/-1, encoded target
+    boxes, inside weights) plus the predictions gathered per slot.
+    Reference returns ragged sampled subsets; see
+    ops/detection_ops.py rpn_target_assign."""
+    helper = LayerHelper("rpn_target_assign")
+    loc_idx = _mk(helper, "int32", stop_gradient=True)
+    score_idx = _mk(helper, "int32", stop_gradient=True)
+    tgt_lbl = _mk(helper, "int32", stop_gradient=True)
+    tgt_bbox = _mk(helper, stop_gradient=True)
+    bbox_w = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_idx], "ScoreIndex": [score_idx],
+                 "TargetLabel": [tgt_lbl], "TargetBBox": [tgt_bbox],
+                 "BBoxInsideWeight": [bbox_w]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    # gather sampled predictions per slot ([N, S, ...]) by reusing the
+    # target_assign gather (differentiable through the predictions;
+    # indices < 0 → 0-filled padding slots)
+    pred_loc, _ = target_assign(bbox_pred, loc_idx)
+    pred_score, _ = target_assign(cls_logits, score_idx)
+    return pred_score, pred_loc, tgt_lbl, tgt_bbox, bbox_w
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip=4.135166556742356,
+                           name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    dec = _mk(helper, stop_gradient=True)
+    assign = _mk(helper, stop_gradient=True)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box],
+              "BoxScore": [box_score]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_decoder_and_assign", inputs=inputs,
+        outputs={"DecodeBox": [dec], "OutputAssignBox": [assign]},
+        attrs={"box_clip": box_clip})
+    return dec, assign
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_levels = max_level - min_level + 1
+    outs = [_mk(helper, stop_gradient=True) for _ in range(n_levels)]
+    restore = _mk(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        type="distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": outs, "RestoreIndex": [restore]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level,
+                          max_level, post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    out = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": list(multi_rois),
+                "MultiLevelScores": list(multi_scores)},
+        outputs={"FpnRois": [out]},
+        attrs={"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def roi_align(input, rois, rois_batch_idx, pooled_height=1,
+              pooled_width=1, spatial_scale=1.0, sampling_ratio=-1,
+              name=None):
+    """rois [R, 4] + rois_batch_idx [R] int32 (the LoD redesign;
+    reference roi_align_op.cc infers the batch from LoD)."""
+    helper = LayerHelper("roi_align", name=name)
+    out = _mk(helper)
+    helper.append_op(
+        type="roi_align",
+        inputs={"X": [input], "ROIs": [rois],
+                "RoisBatchIdx": [rois_batch_idx]},
+        outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale,
+               "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, rois_batch_idx, pooled_height=1,
+             pooled_width=1, spatial_scale=1.0, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = _mk(helper)
+    argmax = _mk(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        type="roi_pool",
+        inputs={"X": [input], "ROIs": [rois],
+                "RoisBatchIdx": [rois_batch_idx]},
+        outputs={"Out": [out], "Argmax": [argmax]},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multibox head (reference: layers/detection.py
+    multi_box_head): per feature map, generate priors and conv
+    loc/conf predictions; concat across maps. Returns
+    (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    from . import nn, tensor
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference's ratio interpolation (detection.py multi_box_head)
+        min_sizes, max_sizes = [], []
+        step = int(
+            (max_ratio - min_ratio) // max(n_maps - 2, 1)) if \
+            min_ratio is not None else 0
+        min_sizes = [base_size * 0.1]
+        max_sizes = [base_size * 0.2]
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = min_sizes[:n_maps]
+        max_sizes = max_sizes[:n_maps]
+
+    locs, confs, prior_list, var_list = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        mxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        if not isinstance(ar, (list, tuple)):
+            ar = [ar]
+        st = steps[i] if steps else [
+            step_w[i] if step_w else 0.0,
+            step_h[i] if step_h else 0.0]
+        if not isinstance(st, (list, tuple)):
+            st = [st, st]
+        box, var = prior_box(
+            feat, image, [ms] if not isinstance(ms, (list, tuple))
+            else ms,
+            [mxs] if mxs and not isinstance(mxs, (list, tuple))
+            else mxs, ar, variance, flip, clip, st, offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        num_priors = 1
+        ars_eff = [1.0]
+        for a in ar:
+            if not any(abs(a - e) < 1e-6 for e in ars_eff):
+                ars_eff.append(a)
+                if flip:
+                    ars_eff.append(1.0 / a)
+        num_priors = len(ars_eff) + (1 if mxs else 0)
+
+        loc = nn.conv2d(feat, num_priors * 4, kernel_size,
+                        padding=pad, stride=stride)
+        conf = nn.conv2d(feat, num_priors * num_classes, kernel_size,
+                         padding=pad, stride=stride)
+        # NCHW → [N, H*W*priors, 4/C]
+        loc = nn.transpose(loc, (0, 2, 3, 1))
+        loc = nn.reshape(loc, (0, -1, 4))
+        conf = nn.transpose(conf, (0, 2, 3, 1))
+        conf = nn.reshape(conf, (0, -1, num_classes))
+        locs.append(loc)
+        confs.append(conf)
+        prior_list.append(nn.reshape(box, (-1, 4)))
+        var_list.append(nn.reshape(var, (-1, 4)))
+
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    boxes = tensor.concat(prior_list, axis=0)
+    variances = tensor.concat(var_list, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

@@ -30,4 +30,5 @@ from . import control_flow_ops  # noqa: F401,E402
 from . import sequence_ops  # noqa: F401,E402
 from . import rnn_ops  # noqa: F401,E402
 from . import beam_search_ops  # noqa: F401,E402
+from . import detection_ops  # noqa: F401,E402
 from . import pallas  # noqa: F401,E402

@@ -1,0 +1,1111 @@
+"""Detection ops — SSD / Faster-RCNN / YOLOv3 op suite.
+
+Reference: paddle/fluid/operators/detection/ (~13.6k LoC: prior_box_op,
+density_prior_box_op, anchor_generator_op, box_coder_op, box_clip_op,
+iou_similarity_op, bipartite_match_op, target_assign_op,
+mine_hard_examples_op, multiclass_nms_op, yolo_box_op, yolov3_loss_op,
+generate_proposals_op, rpn_target_assign_op, box_decoder_and_assign_op,
+polygon_box_transform_op, collect/distribute_fpn_proposals_op) plus
+operators/roi_align_op.cc, roi_pool_op.cc.
+
+TPU-native redesign (NOT a port of the CPU kernels):
+
+- **Padded batches replace LoD.** The reference threads variable-length
+  ground-truth/ROI sets through LoD offsets; XLA wants static shapes, so
+  every op here takes dense ``[N, M, ...]`` tensors where invalid slots
+  are marked (gt boxes of all zeros, match index -1, score -1) and
+  returns padded outputs plus a valid-count vector — the same
+  ragged→padded+mask boundary the rest of the framework uses for
+  sequences.
+- **Fixed-trip-count selection replaces dynamic loops.** Greedy
+  bipartite matching and NMS are data-dependent sequential algorithms;
+  they become `lax.fori_loop`s with a static trip count (min(rows,cols)
+  / nms_top_k) over masked argmax — compilable, differentiable-free
+  selection with O(k) steps of vectorized work.
+- **Everything jits and vmaps.** Per-image kernels are written for one
+  image and lifted with jax.vmap — the analog of the reference's
+  per-LoD-segment CPU loops, but batched on device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_EPS = 1e-10
+
+
+# ---------------------------------------------------------------------------
+# anchors / priors
+
+
+@register("prior_box", ["Input", "Image"], ["Boxes", "Variances"],
+          differentiable=False)
+def prior_box(input, image, *, min_sizes, max_sizes=(), aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes (reference: detection/prior_box_op.cc, .h
+    ExpandAspectRatios). Output [H, W, num_priors, 4] (normalized
+    xmin,ymin,xmax,ymax) + same-shape variances."""
+    feat_h, feat_w = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    sw = float(step_w) if step_w > 0 else img_w / feat_w
+    sh = float(step_h) if step_h > 0 else img_h / feat_h
+
+    # per-cell prior (w, h) list — static python loop, mirrors
+    # prior_box_op.h but emitted once at trace time
+    whs = []
+    for s, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = (ms * float(max_sizes[s])) ** 0.5
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
+        else:
+            for ar in ars:
+                whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
+            if max_sizes:
+                big = (ms * float(max_sizes[s])) ** 0.5
+                whs.append((big, big))
+    wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    half_w = wh[None, None, :, 0] / 2.0
+    half_h = wh[None, None, :, 1] / 2.0
+    boxes = jnp.stack([(cxg - half_w) / img_w, (cyg - half_h) / img_h,
+                       (cxg + half_w) / img_w, (cyg + half_h) / img_h],
+                      axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+@register("density_prior_box", ["Input", "Image"], ["Boxes", "Variances"],
+          differentiable=False)
+def density_prior_box(input, image, *, densities, fixed_sizes,
+                      fixed_ratios,
+                      variances=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      step_w=0.0, step_h=0.0, offset=0.5,
+                      flatten_to_2d=False):
+    """Densified priors (reference: density_prior_box_op.cc): each
+    fixed_size spawns a density x density grid of shifted centers."""
+    feat_h, feat_w = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw = float(step_w) if step_w > 0 else img_w / feat_w
+    sh = float(step_h) if step_h > 0 else img_h / feat_h
+
+    entries = []  # (shift_x, shift_y, w, h) per prior, static
+    for size, dens in zip(fixed_sizes, densities):
+        size, dens = float(size), int(dens)
+        for ar in fixed_ratios:
+            bw = size * float(ar) ** 0.5
+            bh = size / float(ar) ** 0.5
+            shift = size / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    ox = -size / 2.0 + shift / 2.0 + dj * shift
+                    oy = -size / 2.0 + shift / 2.0 + di * shift
+                    entries.append((ox, oy, bw, bh))
+    ent = jnp.asarray(entries, jnp.float32)  # [P, 4]
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ccx = cxg[:, :, None] + ent[None, None, :, 0]
+    ccy = cyg[:, :, None] + ent[None, None, :, 1]
+    hw = ent[None, None, :, 2] / 2.0
+    hh = ent[None, None, :, 3] / 2.0
+    boxes = jnp.stack([(ccx - hw) / img_w, (ccy - hh) / img_h,
+                       (ccx + hw) / img_w, (ccy + hh) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return boxes, var
+
+
+@register("anchor_generator", ["Input"], ["Anchors", "Variances"],
+          differentiable=False)
+def anchor_generator(input, *, anchor_sizes=(64.0, 128.0, 256.0, 512.0),
+                     aspect_ratios=(0.5, 1.0, 2.0),
+                     variances=(0.1, 0.1, 0.2, 0.2),
+                     stride=(16.0, 16.0), offset=0.5):
+    """RPN anchors (reference: detection/anchor_generator_op.cc/.h) —
+    output [H, W, A, 4] in image coordinates (unnormalized)."""
+    feat_h, feat_w = input.shape[2], input.shape[3]
+    sw, sh = float(stride[0]), float(stride[1])
+
+    whs = []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            area_ratios = area / float(ar)
+            base_w = round(area_ratios ** 0.5)
+            base_h = round(base_w * float(ar))
+            scale_w = float(size) / sw
+            scale_h = float(size) / sh
+            whs.append((scale_w * base_w, scale_h * base_h))
+    wh = jnp.asarray(whs, jnp.float32)  # [A, 2]
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg, cyg = cxg[:, :, None], cyg[:, :, None]
+    hw = wh[None, None, :, 0] / 2.0
+    hh = wh[None, None, :, 1] / 2.0
+    anchors = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh],
+                        axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return anchors, var
+
+
+# ---------------------------------------------------------------------------
+# box geometry
+
+
+def _box_wh(box):
+    # +1 conventions differ per op; detection box_coder/iou use the
+    # normalized no-offset convention by default
+    return box[..., 2] - box[..., 0], box[..., 3] - box[..., 1]
+
+
+def _iou_matrix(x, y, box_normalized=True):
+    """Pairwise IoU of x [N,4] vs y [M,4] → [N,M] (reference:
+    iou_similarity_op.h IOUSimilarityFunctor)."""
+    off = 0.0 if box_normalized else 1.0
+    area_x = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    area_y = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, _EPS), 0.0)
+
+
+@register("iou_similarity", ["X", "Y"], ["Out"], differentiable=False)
+def iou_similarity(x, y, *, box_normalized=True):
+    """[N,4] x [M,4] -> [N,M], or batched [B,N,4] x [B,M,4] -> [B,N,M]."""
+    if x.ndim == 3:
+        return jax.vmap(
+            lambda a, b: _iou_matrix(a, b, box_normalized))(x, y)
+    return _iou_matrix(x, y, box_normalized)
+
+
+@register("box_coder", ["PriorBox", "PriorBoxVar", "TargetBox"],
+          ["OutputBox"], nondiff=("PriorBox", "PriorBoxVar"))
+def box_coder(prior_box, prior_box_var, target_box, *,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, variance=()):
+    """Encode/decode box deltas (reference: box_coder_op.cc/.h).
+
+    encode: target [N,4] against priors [M,4] → [N,M,4]
+    decode: deltas [N,M,4] (or [N,4] broadcast) + priors → boxes.
+    Differentiable through TargetBox (deltas) so RPN/RCNN heads train
+    through the decode."""
+    off = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + off
+    ph = prior_box[:, 3] - prior_box[:, 1] + off
+    pcx = prior_box[:, 0] + pw / 2.0
+    pcy = prior_box[:, 1] + ph / 2.0
+
+    if prior_box_var is not None:
+        pvar = prior_box_var
+    elif len(variance):
+        pvar = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                                prior_box.shape)
+    else:
+        pvar = jnp.ones_like(prior_box)
+
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + off
+        th = target_box[:, 3] - target_box[:, 1] + off
+        tcx = target_box[:, 0] + tw / 2.0
+        tcy = target_box[:, 1] + th / 2.0
+        # [N, M]
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], _EPS))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], _EPS))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1) / pvar[None, :, :]
+        return out
+    elif code_type == "decode_center_size":
+        t = target_box
+        if t.ndim == 2:
+            t = t[:, None, :]
+        # axis=0: priors broadcast over rows; axis=1: over columns
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+            pv = pvar[None, :, :]
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+            pv = pvar[:, None, :]
+        d = t * pv
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w / 2.0, cy - h / 2.0,
+                          cx + w / 2.0 - off, cy + h / 2.0 - off],
+                         axis=-1)
+    raise ValueError("unknown code_type %r" % code_type)
+
+
+@register("box_clip", ["Input", "ImInfo"], ["Output"],
+          nondiff=("ImInfo",))
+def box_clip(input, im_info, *_, **__):
+    """Clip boxes to image bounds (reference: box_clip_op.h). Boxes
+    [N, M, 4] with im_info [N, 3] (h, w, scale)."""
+    h = im_info[:, 0] / im_info[:, 2]
+    w = im_info[:, 1] / im_info[:, 2]
+    zero = jnp.zeros_like(h)
+    maxes = jnp.stack([w - 1, h - 1, w - 1, h - 1], -1)[:, None, :]
+    mins = jnp.stack([zero, zero, zero, zero], -1)[:, None, :]
+    return jnp.clip(input, mins, maxes)
+
+
+@register("polygon_box_transform", ["Input"], ["Output"],
+          differentiable=False)
+def polygon_box_transform(input):
+    """Quad offsets → absolute corner coordinates (reference:
+    polygon_box_transform_op.cc — EAST-style geometry maps). Input
+    [N, 8k, H, W]: channel 2i is an x-offset, 2i+1 a y-offset, each
+    relative to the pixel's (4*col, 4*row) position."""
+    n, c, h, w = input.shape
+    col = jnp.arange(w, dtype=input.dtype)[None, None, None, :] * 4.0
+    row = jnp.arange(h, dtype=input.dtype)[None, None, :, None] * 4.0
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return jnp.where(is_x, col - input, row - input)
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment
+
+
+def _bipartite_match_one(dist, match_type, overlap_threshold):
+    """Greedy bipartite match for one image: dist [N, M] (rows =
+    ground-truth, cols = priors). Returns (match_idx [M] int32 row or
+    -1, match_dist [M]). Reference: bipartite_match_op.cc
+    BipartiteMatchFunctor — iteratively takes the global max of the
+    remaining matrix; fixed trip count min(N, M)."""
+    n, m = dist.shape
+    neg = jnp.asarray(-1.0, dist.dtype)
+
+    def body(_, state):
+        d, midx, mdist = state
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        best = d[i, j]
+        take = best > 0
+        midx = jnp.where(take, midx.at[j].set(i.astype(jnp.int32)), midx)
+        mdist = jnp.where(take, mdist.at[j].set(best), mdist)
+        # knock out the matched row and column
+        d = jnp.where(take, d.at[i, :].set(neg).at[:, j].set(neg), d)
+        return d, midx, mdist
+
+    init = (dist, jnp.full((m,), -1, jnp.int32),
+            jnp.zeros((m,), dist.dtype))
+    _, midx, mdist = lax.fori_loop(0, min(n, m), body, init)
+
+    if match_type == "per_prediction":
+        # unmatched columns take their argmax row if above threshold
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = (midx < 0) & (best_val >= overlap_threshold)
+        midx = jnp.where(extra, best_row, midx)
+        mdist = jnp.where(extra, best_val, mdist)
+    return midx, mdist
+
+
+@register("bipartite_match", ["DistMat"],
+          ["ColToRowMatchIndices", "ColToRowMatchDist"],
+          differentiable=False)
+def bipartite_match(dist_mat, *, match_type="bipartite",
+                    dist_threshold=0.5):
+    """Batched greedy bipartite matching. DistMat [B, N, M] (padded
+    ground-truth rows must be all-zero so they never win a match);
+    outputs [B, M]."""
+    if dist_mat.ndim == 2:
+        dist_mat = dist_mat[None]
+    fn = functools.partial(_bipartite_match_one,
+                           match_type=match_type,
+                           overlap_threshold=dist_threshold)
+    return jax.vmap(fn)(dist_mat)
+
+
+@register("target_assign", ["X", "MatchIndices", "NegIndices"],
+          ["Out", "OutWeight"],
+          nondiff=("MatchIndices", "NegIndices"))
+def target_assign(x, match_indices, neg_indices, *, mismatch_value=0.0):
+    """Gather per-prior targets by match index (reference:
+    target_assign_op.h). x [B, N, K] (entity targets), match_indices
+    [B, M] → out [B, M, K]; weight 1 where matched (or listed in
+    neg_indices mask [B, M]), else mismatch_value/0.
+
+    LoD redesign: the reference's NegIndices is a ragged index list;
+    here it is an optional [B, M] 0/1 mask."""
+    b, m = match_indices.shape
+    k = x.shape[2]
+    idx = jnp.maximum(match_indices, 0)
+    out = jnp.take_along_axis(x, idx[:, :, None].repeat(k, axis=2),
+                              axis=1)
+    matched = (match_indices >= 0)[:, :, None]
+    out = jnp.where(matched, out,
+                    jnp.asarray(mismatch_value, x.dtype))
+    weight = matched.astype(jnp.float32)
+    if neg_indices is not None:
+        weight = jnp.maximum(weight,
+                             neg_indices[:, :, None].astype(jnp.float32))
+    return out, weight
+
+
+@register("mine_hard_examples",
+          ["ClsLoss", "LocLoss", "MatchIndices", "MatchDist"],
+          ["NegIndices", "UpdatedMatchIndices"], differentiable=False)
+def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist, *,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       mining_type="max_negative", sample_size=0):
+    """Hard-negative mining (reference: mine_hard_examples_op.cc).
+    Selects the highest-loss negatives per image, at most
+    neg_pos_ratio * num_pos (or sample_size). Returns a [B, M] 0/1
+    negative mask (the LoD NegIndices redesign) and match indices with
+    unselected negatives left at -1 (selected stay -1 too — they are
+    negatives; the op only *selects*, mirroring UpdatedMatchIndices)."""
+    loss = cls_loss + (loc_loss if loc_loss is not None else 0.0)
+    is_neg = (match_indices < 0) & (match_dist < neg_dist_threshold)
+    num_pos = jnp.sum((match_indices >= 0).astype(jnp.int32), axis=1)
+    if mining_type == "max_negative":
+        limit = (num_pos.astype(jnp.float32) * neg_pos_ratio)
+    else:  # hard_example
+        limit = jnp.full_like(num_pos, float(sample_size or 0),
+                              jnp.float32)
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    ranks = jnp.argsort(order, axis=1).astype(jnp.float32)
+    selected = is_neg & (ranks < limit[:, None])
+    upd = jnp.where(selected, -1, match_indices)
+    return selected.astype(jnp.int32), upd
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+
+
+def _nms_mask(boxes, scores, valid, iou_threshold, top_k,
+              normalized=True, eta=1.0):
+    """Fixed-size NMS for one class: boxes [M,4], scores [M]. Sorts by
+    score, keeps at most top_k, suppresses IoU > threshold against any
+    earlier kept box. Returns keep mask aligned with the SORTED order
+    plus the sort indices. O(top_k) sequential steps over vectorized
+    suppression rows — the TPU formulation of the reference's
+    NMSFast (multiclass_nms_op.cc), including the adaptive-threshold
+    ``eta`` shrink (threshold *= eta after each kept box while > 0.5)."""
+    m = boxes.shape[0]
+    k = min(top_k, m) if top_k > 0 else m
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    sb = boxes[order][:k]
+    sv = valid[order][:k] & (scores[order][:k] > -jnp.inf)
+    iou = _iou_matrix(sb, sb, box_normalized=normalized)
+
+    def body(i, state):
+        keep, thresh = state
+        sup = jnp.any(keep & (jnp.arange(k) < i) & (iou[i] > thresh))
+        kept = sv[i] & ~sup
+        if eta < 1.0:
+            thresh = jnp.where(kept & (thresh > 0.5), thresh * eta,
+                               thresh)
+        return keep.at[i].set(kept), thresh
+
+    keep, _ = lax.fori_loop(
+        0, k, body,
+        (jnp.zeros((k,), bool), jnp.asarray(iou_threshold, jnp.float32)))
+    return keep, order[:k]
+
+
+def _multiclass_nms_one(bboxes, scores, *, background_label, score_threshold,
+                        nms_top_k, nms_threshold, nms_eta, keep_top_k,
+                        normalized):
+    """One image: bboxes [M, 4] (shared across classes) or [C, M, 4],
+    scores [C, M]. Returns (out [keep_top_k, 6], count)."""
+    c, m = scores.shape
+    shared = bboxes.ndim == 2
+    if c == 1 and background_label == 0:
+        raise ValueError("multiclass_nms: all classes are background")
+    results = []  # per class: (label, score, box, keep)
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        cls_scores = scores[cls]
+        cls_boxes = bboxes if shared else bboxes[cls]
+        valid = cls_scores > score_threshold
+        keep, order = _nms_mask(cls_boxes, cls_scores, valid,
+                                nms_threshold, nms_top_k,
+                                normalized=normalized, eta=nms_eta)
+        results.append((cls, cls_scores[order], cls_boxes[order], keep))
+
+    labels = jnp.concatenate([
+        jnp.full(r[3].shape, r[0], jnp.float32) for r in results])
+    scs = jnp.concatenate([r[1] for r in results])
+    bxs = jnp.concatenate([r[2] for r in results], axis=0)
+    keeps = jnp.concatenate([r[3] for r in results])
+
+    scs = jnp.where(keeps, scs, -jnp.inf)
+    k = min(keep_top_k if keep_top_k > 0 else scs.shape[0],
+            scs.shape[0])
+    top = jnp.argsort(-scs)[:k]
+    sel_valid = scs[top] > -jnp.inf
+    out = jnp.concatenate([
+        labels[top][:, None], jnp.where(sel_valid, scs[top], 0.0)[:, None],
+        bxs[top]], axis=1)
+    out = jnp.where(sel_valid[:, None], out, -1.0)
+    return out, jnp.sum(sel_valid.astype(jnp.int32))
+
+
+@register("multiclass_nms", ["BBoxes", "Scores"], ["Out", "NmsRoisNum"],
+          differentiable=False)
+def multiclass_nms(bboxes, scores, *, background_label=0,
+                   score_threshold=0.0, nms_top_k=-1, nms_threshold=0.3,
+                   nms_eta=1.0, keep_top_k=-1, normalized=True):
+    """Batched multi-class NMS (reference: multiclass_nms_op.cc).
+    bboxes [N, M, 4], scores [N, C, M] → padded Out [N, K, 6]
+    (label, score, x1, y1, x2, y2; -1 rows are padding) + per-image
+    valid counts [N] (the LoD → padded+count redesign)."""
+    fn = functools.partial(
+        _multiclass_nms_one, background_label=background_label,
+        score_threshold=score_threshold, nms_top_k=nms_top_k,
+        nms_threshold=nms_threshold, nms_eta=nms_eta,
+        keep_top_k=keep_top_k, normalized=normalized)
+    return jax.vmap(fn)(bboxes, scores)
+
+
+@register("generate_proposals",
+          ["Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"],
+          ["RpnRois", "RpnRoiProbs", "RpnRoisNum"], differentiable=False)
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       *, pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0):
+    """RPN proposal generation (reference: generate_proposals_op.cc).
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], anchors
+    [H, W, A, 4] → padded RpnRois [N, post_nms_top_n, 4] + counts.
+
+    Static-shape pipeline: top-pre_nms scores → decode → clip →
+    min-size filter (mask) → fixed-size NMS → top-post_nms."""
+    n, a, h, w = scores.shape
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+    total = a * h * w
+
+    def one(sc, bd, info):
+        # [A,H,W] → [H,W,A] flattened to match anchors layout
+        sc = sc.transpose(1, 2, 0).reshape(-1)
+        bd = bd.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms_top_n, total) if pre_nms_top_n > 0 else total
+        top = jnp.argsort(-sc)[:k]
+        sc_k, bd_k, anc_k, var_k = sc[top], bd[top], anc[top], var[top]
+        # decode (same math as box_coder decode with per-anchor var)
+        aw = anc_k[:, 2] - anc_k[:, 0] + 1.0
+        ah = anc_k[:, 3] - anc_k[:, 1] + 1.0
+        acx = anc_k[:, 0] + aw / 2.0
+        acy = anc_k[:, 1] + ah / 2.0
+        d = bd_k * var_k
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+        props = jnp.stack([cx - bw / 2.0, cy - bh / 2.0,
+                           cx + bw / 2.0 - 1.0, cy + bh / 2.0 - 1.0], -1)
+        # clip to image
+        ih, iw = info[0], info[1]
+        props = jnp.clip(props,
+                         jnp.zeros(4, props.dtype),
+                         jnp.asarray([iw - 1, ih - 1, iw - 1, ih - 1],
+                                     props.dtype))
+        # filter boxes smaller than min_size * scale
+        ms = jnp.maximum(min_size * info[2], 1.0)
+        pw = props[:, 2] - props[:, 0] + 1.0
+        ph = props[:, 3] - props[:, 1] + 1.0
+        keep_sz = (pw >= ms) & (ph >= ms)
+        # proposals use pixel coordinates (+1 width convention)
+        keep, order = _nms_mask(props, sc_k, keep_sz, nms_thresh,
+                                post_nms_top_n, normalized=False,
+                                eta=eta)
+        final_sc = jnp.where(keep, sc_k[order], -jnp.inf)
+        take = jnp.argsort(-final_sc)[:post_nms_top_n]
+        ok = final_sc[take] > -jnp.inf
+        rois = jnp.where(ok[:, None], props[order][take], 0.0)
+        probs = jnp.where(ok, sc_k[order][take], 0.0)
+        return rois, probs, jnp.sum(ok.astype(jnp.int32))
+
+    return jax.vmap(one)(scores, bbox_deltas, im_info)
+
+
+# ---------------------------------------------------------------------------
+# YOLO
+
+
+@register("yolo_box", ["X", "ImgSize"], ["Boxes", "Scores"],
+          differentiable=False)
+def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True):
+    """Decode YOLOv3 head output (reference: yolo_box_op.h). x
+    [N, A*(5+C), H, W], img_size [N, 2] (h, w) → boxes
+    [N, A*H*W, 4], scores [N, A*H*W, C]. Low-confidence boxes are
+    zeroed (the reference sets them to zero rather than pruning —
+    already static-shape-friendly)."""
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    pred_xy_x = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    pred_xy_y = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    pred_w = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+    pred_h = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (pred_xy_x - pred_w / 2.0) * img_w
+    y1 = (pred_xy_y - pred_h / 2.0) * img_h
+    x2 = (pred_xy_x + pred_w / 2.0) * img_w
+    y2 = (pred_xy_y + pred_h / 2.0) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, img_w - 1)
+        y1 = jnp.clip(y1, 0.0, img_h - 1)
+        x2 = jnp.clip(x2, 0.0, img_w - 1)
+        y2 = jnp.clip(y2, 0.0, img_h - 1)
+    keep = conf >= conf_thresh
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    boxes = boxes.reshape(n, -1, 4)
+    scores = (probs * keep[:, :, None]).transpose(
+        0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+def _sigmoid_bce(logit, label):
+    return jnp.maximum(logit, 0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+@register("yolov3_loss", ["X", "GTBox", "GTLabel", "GTScore"],
+          ["Loss"], nondiff=("GTBox", "GTLabel", "GTScore"))
+def yolov3_loss(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                class_num, ignore_thresh=0.7, downsample_ratio=32,
+                use_label_smooth=True):
+    """YOLOv3 training loss (reference: yolov3_loss_op.h). x
+    [N, A*(5+C), H, W]; gt_box [N, B, 4] (cx, cy, w, h normalized,
+    all-zero rows are padding), gt_label [N, B] int; gt_score [N, B]
+    (mixup weight, None → 1). Returns per-image loss [N].
+
+    Differentiable through X: the whole target construction is
+    select/scatter on static shapes, so the generic vjp covers the
+    backward (the reference hand-writes the CPU gradient).
+    """
+    n, _, h, w = x.shape
+    mask = list(anchor_mask)
+    na = len(mask)
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    anc_m = anc[jnp.asarray(mask)]
+    input_size = downsample_ratio * h
+    nb = gt_box.shape[1]
+
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+
+    gt_valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)  # [N, B]
+    if gt_score is None:
+        gt_score = jnp.ones(gt_label.shape, jnp.float32)
+
+    # --- objectness ignore mask: pred boxes with IoU > thresh vs any gt
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(px) + grid_x) / w
+    by = (jax.nn.sigmoid(py) + grid_y) / h
+    bw = jnp.exp(pw) * anc_m[None, :, 0, None, None] / input_size
+    bh = jnp.exp(ph) * anc_m[None, :, 1, None, None] / input_size
+
+    pred = jnp.stack([bx - bw / 2, by - bh / 2, bx + bw / 2,
+                      by + bh / 2], axis=-1)  # [N,A,H,W,4]
+    gx1 = gt_box[..., 0] - gt_box[..., 2] / 2
+    gy1 = gt_box[..., 1] - gt_box[..., 3] / 2
+    gx2 = gt_box[..., 0] + gt_box[..., 2] / 2
+    gy2 = gt_box[..., 1] + gt_box[..., 3] / 2
+    gt_c = jnp.stack([gx1, gy1, gx2, gy2], axis=-1)  # [N,B,4]
+
+    def img_iou(p, g, gv):
+        pm = p.reshape(-1, 4)
+        m = _iou_matrix(pm, g)
+        m = jnp.where(gv[None, :], m, 0.0)
+        return jnp.max(m, axis=1).reshape(p.shape[:-1])
+
+    best_iou = jax.vmap(img_iou)(pred, gt_c, gt_valid)  # [N,A,H,W]
+    ignore = best_iou > ignore_thresh
+
+    # --- per-gt responsible cell + best anchor (shape IoU vs ALL
+    # anchors; only anchors in this head's mask contribute targets)
+    gw = gt_box[..., 2] * input_size
+    gh = gt_box[..., 3] * input_size
+    inter = jnp.minimum(gw[..., None], anc[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], anc[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        anc[None, None, :, 0] * anc[None, None, :, 1] - inter
+    shape_iou = inter / jnp.maximum(union, _EPS)  # [N,B,num_anchors]
+    best_anchor = jnp.argmax(shape_iou, axis=-1)  # [N,B]
+
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # map best_anchor to index within this head's mask (-1 if absent)
+    mask_arr = jnp.asarray(mask)
+    in_mask = best_anchor[..., None] == mask_arr[None, None, :]
+    an_idx = jnp.where(jnp.any(in_mask, -1),
+                       jnp.argmax(in_mask, -1), -1)  # [N,B]
+    resp = gt_valid & (an_idx >= 0)
+
+    # scatter gt targets onto the [N,A,H,W] lattice; non-responsible
+    # rows (padding, or best anchor outside this head's mask) are
+    # routed to an out-of-bounds row index so mode="drop" discards them
+    # — they must NOT land on (0, 0, 0) and clobber a real target there
+    bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, nb))
+    flat = lambda t: t.reshape(-1)
+    gj_s = jnp.where(resp, gj, h)  # h = out of bounds → dropped
+    scat_idx = (flat(bidx), flat(jnp.maximum(an_idx, 0)), flat(gj_s),
+                flat(gi))
+
+    def scatter(vals, init):
+        t = jnp.full((n, na, h, w), init, jnp.float32)
+        return t.at[scat_idx].set(flat(vals), mode="drop")
+
+    tx = scatter(gt_box[..., 0] * w - gi.astype(jnp.float32), 0.0)
+    ty = scatter(gt_box[..., 1] * h - gj.astype(jnp.float32), 0.0)
+    anc_w = anc[jnp.maximum(best_anchor, 0), 0]
+    anc_h = anc[jnp.maximum(best_anchor, 0), 1]
+    tw = scatter(jnp.log(jnp.maximum(gw / anc_w, _EPS)), 0.0)
+    th = scatter(jnp.log(jnp.maximum(gh / anc_h, _EPS)), 0.0)
+    tscore = scatter(gt_score, 0.0)
+    obj_mask = scatter(jnp.ones_like(gt_score), 0.0) > 0
+    tcls_idx = scatter(gt_label.astype(jnp.float32), -1.0)
+
+    # box scale weight: 2 - w*h (bigger gt → smaller weight)
+    bscale = scatter(2.0 - gt_box[..., 2] * gt_box[..., 3], 0.0)
+
+    wgt = bscale * tscore
+    loss_xy = _sigmoid_bce(px, tx) * wgt + _sigmoid_bce(py, ty) * wgt
+    loss_wh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * wgt
+    loss_box = jnp.where(obj_mask, loss_xy + loss_wh, 0.0)
+
+    loss_obj_pos = _sigmoid_bce(pobj, jnp.ones_like(pobj)) * tscore
+    loss_obj_neg = _sigmoid_bce(pobj, jnp.zeros_like(pobj))
+    loss_obj = jnp.where(obj_mask, loss_obj_pos,
+                         jnp.where(ignore, 0.0, loss_obj_neg))
+
+    if use_label_smooth:
+        delta = 1.0 / class_num
+        on, off = 1.0 - delta, delta
+    else:
+        on, off = 1.0, 0.0
+    onehot = (jnp.arange(class_num)[None, None, None, None, :]
+              == tcls_idx[..., None]) * (on - off) + off
+    loss_cls = jnp.sum(
+        _sigmoid_bce(pcls.transpose(0, 1, 3, 4, 2), onehot), -1)
+    loss_cls = jnp.where(obj_mask, loss_cls * tscore, 0.0)
+
+    per_img = (loss_box + loss_obj + loss_cls).reshape(n, -1).sum(1)
+    return per_img
+
+
+# ---------------------------------------------------------------------------
+# ROI feature extraction (reference: operators/roi_align_op.cc,
+# roi_pool_op.cc — LoD rois; here rois carry an explicit batch index)
+
+
+@register("roi_align", ["X", "ROIs", "RoisBatchIdx"], ["Out"],
+          nondiff=("ROIs", "RoisBatchIdx"))
+def roi_align(x, rois, rois_batch_idx, *, pooled_height=1,
+              pooled_width=1, spatial_scale=1.0, sampling_ratio=-1):
+    """ROI Align with bilinear sampling. x [N, C, H, W], rois [R, 4]
+    (x1, y1, x2, y2 in image coords), rois_batch_idx [R] int32.
+    Differentiable through X (gather → XLA derives the scatter-add
+    backward the reference hand-writes in roi_align_op.cu).
+
+    Static-shape deviation: the reference's sampling_ratio=-1 means
+    *adaptive* (ceil(roi_size / pooled_size) samples per bin, a
+    data-dependent count); XLA needs a fixed grid, so -1 selects a
+    fixed 4x4 sampling pattern per bin. Pass an explicit
+    sampling_ratio to control accuracy/cost."""
+    n, c, hh, ww = x.shape
+    sr = sampling_ratio if sampling_ratio > 0 else 4
+    ph, pw = pooled_height, pooled_width
+
+    def one_roi(roi, bidx):
+        img = x[jnp.clip(bidx, 0, n - 1)]  # [C, H, W]
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: [ph, pw, sr, sr]
+        iy = jnp.arange(ph, dtype=jnp.float32)[:, None, None, None]
+        ix = jnp.arange(pw, dtype=jnp.float32)[None, :, None, None]
+        sy = jnp.arange(sr, dtype=jnp.float32)[None, None, :, None]
+        sx = jnp.arange(sr, dtype=jnp.float32)[None, None, None, :]
+        yy = y1 + iy * bin_h + (sy + 0.5) * bin_h / sr
+        xx = x1 + ix * bin_w + (sx + 0.5) * bin_w / sr
+        yy = jnp.clip(yy, 0.0, hh - 1.0)
+        xx = jnp.clip(xx, 0.0, ww - 1.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, hh - 1)
+        x1i = jnp.minimum(x0 + 1, ww - 1)
+        ly = yy - y0.astype(jnp.float32)
+        lx = xx - x0.astype(jnp.float32)
+
+        def gat(yi, xi):
+            return img[:, yi, xi]  # [C, ph, pw, sr, sr]
+
+        val = (gat(y0, x0) * ((1 - ly) * (1 - lx))[None] +
+               gat(y0, x1i) * ((1 - ly) * lx)[None] +
+               gat(y1i, x0) * (ly * (1 - lx))[None] +
+               gat(y1i, x1i) * (ly * lx)[None])
+        return val.mean(axis=(-1, -2))  # [C, ph, pw]
+
+    return jax.vmap(one_roi)(rois, rois_batch_idx)
+
+
+@register("roi_pool", ["X", "ROIs", "RoisBatchIdx"], ["Out", "Argmax"],
+          nondiff=("ROIs", "RoisBatchIdx"))
+def roi_pool(x, rois, rois_batch_idx, *, pooled_height=1,
+             pooled_width=1, spatial_scale=1.0):
+    """ROI max pooling (reference: roi_pool_op.h). Exact semantics via
+    bin-index scatter-max: each (h, w) cell computes its bin and
+    contributes by segment-max — no data-dependent slice sizes, so the
+    whole op jits. Sequential lax.map over ROIs bounds memory."""
+    n, c, hh, ww = x.shape
+    ph, pw = pooled_height, pooled_width
+
+    hs = jnp.arange(hh, dtype=jnp.float32)
+    ws = jnp.arange(ww, dtype=jnp.float32)
+
+    def one_roi(args):
+        roi, bidx = args
+        img = x[jnp.clip(bidx, 0, n - 1)]  # [C, H, W]
+        rx1 = jnp.round(roi[0] * spatial_scale)
+        ry1 = jnp.round(roi[1] * spatial_scale)
+        rx2 = jnp.round(roi[2] * spatial_scale)
+        ry2 = jnp.round(roi[3] * spatial_scale)
+        rh = jnp.maximum(ry2 - ry1 + 1.0, 1.0)
+        rw = jnp.maximum(rx2 - rx1 + 1.0, 1.0)
+        # bin index per pixel (floor div by bin size), valid-range mask
+        bin_h = rh / ph
+        bin_w = rw / pw
+        bi = jnp.floor((hs - ry1) / bin_h).astype(jnp.int32)
+        bj = jnp.floor((ws - rx1) / bin_w).astype(jnp.int32)
+        okh = (hs >= ry1) & (hs <= ry2) & (bi >= 0) & (bi < ph)
+        okw = (ws >= rx1) & (ws <= rx2) & (bj >= 0) & (bj < pw)
+        ok = okh[:, None] & okw[None, :]
+        bin_idx = jnp.where(ok, bi[:, None] * pw + bj[None, :],
+                            ph * pw)  # dump bin
+        flatv = img.reshape(c, -1)
+        flati = bin_idx.reshape(-1)
+        out = jnp.full((c, ph * pw + 1), -jnp.inf)
+        out = out.at[:, flati].max(flatv)
+        out = out[:, :ph * pw].reshape(c, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = lax.map(one_roi, (rois, rois_batch_idx))
+    return out, jnp.zeros(out.shape, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# misc assignment / FPN
+
+
+@register("box_decoder_and_assign",
+          ["PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"],
+          ["DecodeBox", "OutputAssignBox"], differentiable=False)
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, *, box_clip=4.135166556742356):
+    """Decode per-class deltas and pick each ROI's best-class box
+    (reference: box_decoder_and_assign_op.cc). target_box [R, 4*C],
+    box_score [R, C]."""
+    r = prior_box.shape[0]
+    cnum = box_score.shape[1]
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + pw / 2.0
+    pcy = prior_box[:, 1] + ph / 2.0
+    t = target_box.reshape(r, cnum, 4)
+    var = prior_box_var if prior_box_var is not None else \
+        jnp.ones((4,), jnp.float32)
+    if var.ndim == 2:
+        var = var[0]
+    dx = t[..., 0] * var[0]
+    dy = t[..., 1] * var[1]
+    dw = jnp.clip(t[..., 2] * var[2], -box_clip, box_clip)
+    dh = jnp.clip(t[..., 3] * var[3], -box_clip, box_clip)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - w / 2.0, cy - h / 2.0,
+                     cx + w / 2.0 - 1.0, cy + h / 2.0 - 1.0], axis=-1)
+    best = jnp.argmax(box_score, axis=1)
+    assign = jnp.take_along_axis(
+        dec, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+    return dec.reshape(r, cnum * 4), assign
+
+
+@register("distribute_fpn_proposals", ["FpnRois"],
+          ["MultiFpnRois*", "RestoreIndex"], differentiable=False)
+def distribute_fpn_proposals(fpn_rois, *, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224):
+    """Route each ROI to its FPN level (reference:
+    distribute_fpn_proposals_op.h). Static redesign: every per-level
+    output keeps the full [R, 4] shape with non-member rows zeroed and
+    a leading validity column is NOT added — instead RestoreIndex packs
+    (level, original index); callers use the mask implied by nonzero
+    rows. roi_align consumes zero rows harmlessly (zero boxes)."""
+    r = fpn_rois.shape[0]
+    w = fpn_rois[:, 2] - fpn_rois[:, 0]
+    h = fpn_rois[:, 3] - fpn_rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, _EPS))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + _EPS)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    for L in range(min_level, max_level + 1):
+        m = (lvl == L)[:, None]
+        outs.append(jnp.where(m, fpn_rois, 0.0))
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order).astype(jnp.int32)[:, None]
+    return outs, restore
+
+
+@register("collect_fpn_proposals", ["MultiLevelRois*", "MultiLevelScores*"],
+          ["FpnRois"], differentiable=False)
+def collect_fpn_proposals(multi_rois, multi_scores, *, post_nms_topN):
+    """Merge per-level proposals by score (reference:
+    collect_fpn_proposals_op.h). Inputs are padded per-level [R_l, 4] /
+    [R_l]; zero-score rows are padding."""
+    rois = jnp.concatenate(multi_rois, axis=0)
+    scores = jnp.concatenate(multi_scores, axis=0)
+    k = min(post_nms_topN, scores.shape[0])
+    top = jnp.argsort(-scores)[:k]
+    return rois[top]
+
+
+@register("rpn_target_assign",
+          ["Anchor", "GtBoxes", "IsCrowd", "ImInfo"],
+          ["LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+           "BBoxInsideWeight"], differentiable=False, needs_rng=True)
+def rpn_target_assign(anchor, gt_boxes, is_crowd, im_info, *, rng,
+                      rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor sampling (reference: rpn_target_assign_op.cc).
+
+    Static redesign: instead of ragged index lists, returns fixed-size
+    [N, S] (S = rpn_batch_size_per_im) index tensors padded with -1,
+    labels (1 fg / 0 bg / -1 pad), encoded target boxes for the fg
+    slots, and inside weights. gt_boxes is padded [N, B, 4] (all-zero
+    rows invalid); is_crowd [N, B] marks crowd gt to skip."""
+    a4 = anchor.reshape(-1, 4)
+    na = a4.shape[0]
+    n = gt_boxes.shape[0]
+    s = rpn_batch_size_per_im
+    n_fg_max = int(rpn_fg_fraction * s)
+
+    def one(gts, crowd, info, key):
+        valid_gt = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1]) & \
+            (crowd == 0)
+        iou = _iou_matrix(a4, gts)  # [A, B]
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        max_iou = jnp.max(iou, axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        # anchors straddling the image boundary are excluded
+        if rpn_straddle_thresh >= 0:
+            ih, iw = info[0], info[1]
+            inside = (a4[:, 0] >= -rpn_straddle_thresh) & \
+                (a4[:, 1] >= -rpn_straddle_thresh) & \
+                (a4[:, 2] < iw + rpn_straddle_thresh) & \
+                (a4[:, 3] < ih + rpn_straddle_thresh)
+        else:
+            inside = jnp.ones((na,), bool)
+        # fg: best anchor per gt, or IoU above positive threshold
+        best_per_gt = jnp.max(jnp.where(inside[:, None], iou, -1.0),
+                              axis=0)
+        is_best = jnp.any(
+            (iou >= jnp.maximum(best_per_gt[None, :], _EPS))
+            & valid_gt[None, :], axis=1)
+        fg = inside & ((max_iou >= rpn_positive_overlap) | is_best)
+        bg = inside & ~fg & (max_iou < rpn_negative_overlap)
+
+        noise = jax.random.uniform(key, (na,)) if use_random else \
+            jnp.zeros((na,))
+        # rank fg and bg separately, take quotas
+        fg_rank = jnp.argsort(
+            jnp.argsort(-(fg.astype(jnp.float32) + noise * 1e-3)))
+        n_fg = jnp.minimum(jnp.sum(fg.astype(jnp.int32)), n_fg_max)
+        fg_sel = fg & (fg_rank < n_fg)
+        n_bg = s - n_fg
+        bg_rank = jnp.argsort(
+            jnp.argsort(-(bg.astype(jnp.float32) + noise * 1e-3)))
+        bg_sel = bg & (bg_rank < n_bg)
+
+        sel = fg_sel | bg_sel
+        sel_rank = jnp.argsort(jnp.argsort(
+            -(sel.astype(jnp.float32) * 2 + fg_sel.astype(jnp.float32))))
+        # positions [S]: anchor index or -1
+        slot_ok = jnp.arange(s) < jnp.sum(sel.astype(jnp.int32))
+        order = jnp.argsort(sel_rank)[:s]
+        loc_idx = jnp.where(slot_ok, order.astype(jnp.int32), -1)
+        lbl = jnp.where(slot_ok,
+                        fg_sel[order].astype(jnp.int32), -1)
+        # encode fg targets against their matched gt
+        mg = gts[argmax_gt[order]]
+        aw = a4[order, 2] - a4[order, 0] + 1.0
+        ah = a4[order, 3] - a4[order, 1] + 1.0
+        acx = a4[order, 0] + aw / 2.0
+        acy = a4[order, 1] + ah / 2.0
+        gw = mg[:, 2] - mg[:, 0] + 1.0
+        gh = mg[:, 3] - mg[:, 1] + 1.0
+        gcx = mg[:, 0] + gw / 2.0
+        gcy = mg[:, 1] + gh / 2.0
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(jnp.maximum(gw / aw, _EPS)),
+                         jnp.log(jnp.maximum(gh / ah, _EPS))], axis=-1)
+        fg_slot = (lbl == 1)[:, None]
+        tgt = jnp.where(fg_slot, tgt, 0.0)
+        w = fg_slot.astype(jnp.float32) * jnp.ones((1, 4), jnp.float32)
+        return loc_idx, loc_idx, lbl, tgt, w
+
+    keys = jax.random.split(rng, n)
+    return jax.vmap(one)(gt_boxes, is_crowd, im_info, keys)
+
+
+# ---------------------------------------------------------------------------
+# SSD loss (fused)
+
+
+@register("ssd_loss", ["Location", "Confidence", "GtBox", "GtLabel",
+                       "PriorBox", "PriorBoxVar"],
+          ["Loss"], nondiff=("GtBox", "GtLabel", "PriorBox",
+                             "PriorBoxVar"))
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var, *, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=0):
+    """Fused SSD multibox loss (reference: layers/detection.py ssd_loss,
+    which composes iou_similarity → bipartite_match → target_assign →
+    mine_hard_examples → smooth_l1 + softmax CE as ~10 graph ops).
+
+    TPU-native: ONE op — XLA fuses the whole pipeline, and the padded
+    redesign (gt_box [N, B, 4] with all-zero padding rows, gt_label
+    [N, B]) replaces the reference's LoD segments. location [N, P, 4],
+    confidence [N, P, C], prior_box [P, 4]. Returns [N, P] weighted
+    loss, normalized by the number of matched priors."""
+    n, p, cnum = confidence.shape
+
+    if prior_box_var is None:
+        prior_box_var = jnp.full((p, 4), 1.0, jnp.float32)
+
+    pw = prior_box[:, 2] - prior_box[:, 0]
+    ph = prior_box[:, 3] - prior_box[:, 1]
+    pcx = prior_box[:, 0] + pw / 2.0
+    pcy = prior_box[:, 1] + ph / 2.0
+
+    def one(loc, conf, gts, gtl):
+        valid_gt = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1])
+        iou = _iou_matrix(gts, prior_box)
+        iou = jnp.where(valid_gt[:, None], iou, 0.0)
+        midx, mdist = _bipartite_match_one(iou, match_type,
+                                           overlap_threshold)
+        matched = midx >= 0
+
+        # conf target + loss
+        tlabel = jnp.where(matched, gtl[jnp.maximum(midx, 0)],
+                           background_label)
+        logp = jax.nn.log_softmax(conf, axis=-1)
+        conf_loss = -jnp.take_along_axis(logp, tlabel[:, None],
+                                         axis=1)[:, 0]
+
+        # hard negative mining on conf loss
+        is_neg = ~matched & (mdist < neg_overlap)
+        num_pos = jnp.sum(matched.astype(jnp.int32))
+        if mining_type == "max_negative":
+            limit = num_pos.astype(jnp.float32) * neg_pos_ratio
+        else:
+            limit = jnp.asarray(float(sample_size or 0))
+        neg_loss = jnp.where(is_neg, conf_loss, -jnp.inf)
+        ranks = jnp.argsort(jnp.argsort(-neg_loss)).astype(jnp.float32)
+        selected_neg = is_neg & (ranks < limit)
+
+        conf_w = matched.astype(jnp.float32) + \
+            selected_neg.astype(jnp.float32)
+
+        # loc target (encode matched gt against priors) + smooth l1
+        mg = gts[jnp.maximum(midx, 0)]
+        gw = mg[:, 2] - mg[:, 0]
+        gh = mg[:, 3] - mg[:, 1]
+        gcx = mg[:, 0] + gw / 2.0
+        gcy = mg[:, 1] + gh / 2.0
+        tloc = jnp.stack([
+            (gcx - pcx) / jnp.maximum(pw, _EPS),
+            (gcy - pcy) / jnp.maximum(ph, _EPS),
+            jnp.log(jnp.maximum(gw / jnp.maximum(pw, _EPS), _EPS)),
+            jnp.log(jnp.maximum(gh / jnp.maximum(ph, _EPS), _EPS))],
+            axis=-1) / prior_box_var
+        d = loc - tloc
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        loc_loss = sl1 * matched.astype(jnp.float32)
+
+        total = conf_loss_weight * conf_loss * conf_w + \
+            loc_loss_weight * loc_loss
+        if normalize:
+            total = total / jnp.maximum(num_pos.astype(jnp.float32),
+                                        1.0)
+        return total
+
+    return jax.vmap(one)(location, confidence, gt_box, gt_label)

@@ -1,0 +1,522 @@
+"""Detection op/layer tests (reference analogs: test_prior_box_op.py,
+test_anchor_generator_op.py, test_iou_similarity_op.py,
+test_box_coder_op.py, test_bipartite_match_op.py,
+test_multiclass_nms_op.py, test_yolo_box_op.py, test_yolov3_loss_op.py,
+test_roi_align_op.py, test_roi_pool_op.py,
+test_generate_proposals_op.py, test_ssd_loss.py ...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(program, feed, fetch):
+    exe = fluid.Executor()
+    return exe.run(program, feed=feed, fetch_list=fetch)
+
+
+def _np_iou(a, b):
+    ax1, ay1, ax2, ay2 = a
+    bx1, by1, bx2, by2 = b
+    ix = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    iy = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = ix * iy
+    u = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / u if u > 0 else 0.0
+
+
+class TestPriors:
+    def test_prior_box_layer(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            feat = layers.data("feat", shape=[8, 4, 4],
+                               append_batch_size=True)
+            img = layers.data("img", shape=[3, 32, 32],
+                              append_batch_size=True)
+            boxes, var = layers.prior_box(
+                feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                aspect_ratios=[2.0], flip=True, clip=True)
+        b, v = _run(main, {"feat": np.zeros((1, 8, 4, 4), np.float32),
+                           "img": np.zeros((1, 3, 32, 32), np.float32)},
+                    [boxes, var])
+        # priors: ar {1, 2, 0.5} + max-size square = 4
+        assert b.shape == (4, 4, 4, 4)
+        assert (b >= 0).all() and (b <= 1).all()
+        # center prior of cell (0,0): min_size square around (4, 4)
+        np.testing.assert_allclose(
+            b[0, 0, 0], [0.0, 0.0, 8.0 / 32, 8.0 / 32], atol=1e-6)
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_density_prior_box(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            feat = layers.data("feat", shape=[8, 2, 2],
+                               append_batch_size=True)
+            img = layers.data("img", shape=[3, 16, 16],
+                              append_batch_size=True)
+            boxes, var = layers.density_prior_box(
+                feat, img, densities=[2], fixed_sizes=[4.0],
+                fixed_ratios=[1.0], flatten_to_2d=True)
+        b, = _run(main, {"feat": np.zeros((1, 8, 2, 2), np.float32),
+                         "img": np.zeros((1, 3, 16, 16), np.float32)},
+                  [boxes])
+        assert b.shape == (2 * 2 * 4, 4)  # 2x2 cells x density^2
+
+    def test_anchor_generator(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            feat = layers.data("feat", shape=[8, 2, 3],
+                               append_batch_size=True)
+            anchors, var = layers.anchor_generator(
+                feat, anchor_sizes=[32.0], aspect_ratios=[1.0],
+                stride=[16.0, 16.0])
+        a, = _run(main, {"feat": np.zeros((1, 8, 2, 3), np.float32)},
+                  [anchors])
+        assert a.shape == (2, 3, 1, 4)
+        # cell (0,0) center at (8, 8), size 32 → [-8, -8, 24, 24]
+        np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24],
+                                   atol=1e-5)
+
+
+class TestBoxMath:
+    def test_iou_similarity(self, rng):
+        x = rng.rand(5, 4).astype(np.float32)
+        x[:, 2:] = x[:, :2] + rng.rand(5, 2).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        y[:, 2:] = y[:, :2] + rng.rand(3, 2).astype(np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            xv = layers.data("x", shape=[5, 4], append_batch_size=False)
+            yv = layers.data("y", shape=[3, 4], append_batch_size=False)
+            out = layers.iou_similarity(xv, yv)
+        o, = _run(main, {"x": x, "y": y}, [out])
+        expect = np.array([[_np_iou(a, b) for b in y] for a in x])
+        np.testing.assert_allclose(o, expect, atol=1e-5)
+
+    def test_box_coder_roundtrip(self, rng):
+        pb = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        tb = np.array([[1, 2, 8, 9]], np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            pbv = layers.data("pb", shape=[2, 4],
+                              append_batch_size=False)
+            tbv = layers.data("tb", shape=[1, 4],
+                              append_batch_size=False)
+            enc = layers.box_coder(pbv, [0.1, 0.1, 0.2, 0.2], tbv,
+                                   code_type="encode_center_size")
+            dec = layers.box_coder(pbv, [0.1, 0.1, 0.2, 0.2], enc,
+                                   code_type="decode_center_size")
+        d, = _run(main, {"pb": pb, "tb": tb}, [dec])
+        np.testing.assert_allclose(d[0, 0], tb[0], atol=1e-4)
+        np.testing.assert_allclose(d[0, 1], tb[0], atol=1e-4)
+
+    def test_box_clip(self):
+        boxes = np.array([[[-5, -5, 40, 70]]], np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            b = layers.data("b", shape=[1, 1, 4],
+                            append_batch_size=False)
+            info = layers.data("i", shape=[1, 3],
+                               append_batch_size=False)
+            out = layers.box_clip(b, info)
+        o, = _run(main, {"b": boxes,
+                         "i": np.array([[32, 64, 1.0]], np.float32)},
+                  [out])
+        np.testing.assert_allclose(o[0, 0], [0, 0, 40, 31], atol=1e-5)
+
+
+class TestMatching:
+    def test_bipartite_match(self):
+        dist = np.array([[[0.8, 0.2, 0.6],
+                          [0.3, 0.9, 0.5]]], np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            d = layers.data("d", shape=[1, 2, 3],
+                            append_batch_size=False)
+            idx, md = layers.bipartite_match(d)
+        i, m = _run(main, {"d": dist}, [idx, md])
+        # greedy: (1,1)=0.9 then (0,0)=0.8; col 2 unmatched
+        np.testing.assert_array_equal(i[0], [0, 1, -1])
+        np.testing.assert_allclose(m[0], [0.8, 0.9, 0.0], atol=1e-6)
+
+    def test_bipartite_match_per_prediction(self):
+        dist = np.array([[[0.8, 0.2, 0.6],
+                          [0.3, 0.9, 0.5]]], np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            d = layers.data("d", shape=[1, 2, 3],
+                            append_batch_size=False)
+            idx, md = layers.bipartite_match(d, "per_prediction", 0.55)
+        i, m = _run(main, {"d": dist}, [idx, md])
+        # col 2 now matches row 0 (0.6 >= 0.55)
+        np.testing.assert_array_equal(i[0], [0, 1, 0])
+        np.testing.assert_allclose(m[0], [0.8, 0.9, 0.6], atol=1e-6)
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+        mi = np.array([[2, -1, 0]], np.int32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            xv = layers.data("x", shape=[1, 3, 4],
+                             append_batch_size=False)
+            mv = layers.data("m", shape=[1, 3], dtype="int32",
+                             append_batch_size=False)
+            out, w = layers.target_assign(xv, mv, mismatch_value=9.0)
+        o, wo = _run(main, {"x": x, "m": mi}, [out, w])
+        np.testing.assert_allclose(o[0, 0], x[0, 2])
+        np.testing.assert_allclose(o[0, 1], [9.0] * 4)
+        np.testing.assert_allclose(o[0, 2], x[0, 0])
+        np.testing.assert_allclose(wo[0, :, 0], [1, 0, 1])
+
+
+class TestNMS:
+    def test_multiclass_nms_suppresses(self):
+        bboxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                            [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            b = layers.data("b", shape=[1, 3, 4],
+                            append_batch_size=False)
+            s = layers.data("s", shape=[1, 2, 3],
+                            append_batch_size=False)
+            out, num = layers.multiclass_nms(
+                b, s, score_threshold=0.1, nms_top_k=3, keep_top_k=3,
+                nms_threshold=0.5)
+        o, n = _run(main, {"b": bboxes, "s": scores}, [out, num])
+        assert n[0] == 2  # overlapping 0.8 box suppressed
+        assert o[0, 0, 1] == pytest.approx(0.9)
+        assert o[0, 1, 1] == pytest.approx(0.7)
+        assert (o[0, 2] == -1).all()
+
+    def test_detection_output_runs(self, rng):
+        n, p, c = 2, 6, 3
+        loc = rng.randn(n, p, 4).astype(np.float32) * 0.05
+        scores = rng.rand(n, p, c).astype(np.float32)
+        scores /= scores.sum(-1, keepdims=True)
+        pb = np.zeros((p, 4), np.float32)
+        pb[:, :2] = rng.rand(p, 2) * 0.5
+        pb[:, 2:] = pb[:, :2] + 0.3
+        pbv = np.full((p, 4), 0.1, np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            lv = layers.data("loc", shape=[n, p, 4],
+                             append_batch_size=False)
+            sv = layers.data("sc", shape=[n, p, c],
+                             append_batch_size=False)
+            pv = layers.data("pb", shape=[p, 4],
+                             append_batch_size=False)
+            pvv = layers.data("pbv", shape=[p, 4],
+                              append_batch_size=False)
+            out, num = layers.detection_output(lv, sv, pv, pvv,
+                                               keep_top_k=4)
+        o, cnt = _run(main, {"loc": loc, "sc": scores, "pb": pb,
+                             "pbv": pbv}, [out, num])
+        assert o.shape == (n, 4, 6)
+        assert (cnt >= 0).all() and (cnt <= 4).all()
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_range(self, rng):
+        x = rng.randn(2, 3 * 7, 4, 4).astype(np.float32)
+        imgs = np.array([[128, 128], [64, 96]], np.int32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            xv = layers.data("x", shape=[2, 21, 4, 4],
+                             append_batch_size=False)
+            iv = layers.data("i", shape=[2, 2], dtype="int32",
+                             append_batch_size=False)
+            boxes, scores = layers.yolo_box(
+                xv, iv, anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                conf_thresh=0.0, downsample_ratio=32)
+        b, s = _run(main, {"x": x, "i": imgs}, [boxes, scores])
+        assert b.shape == (2, 48, 4) and s.shape == (2, 48, 2)
+        assert (b[0, :, [0, 2]] <= 127.001).all()
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_yolov3_loss_trains(self, rng):
+        """Loss decreases when optimizing the head output."""
+        x0 = rng.randn(1, 3 * 7, 4, 4).astype(np.float32) * 0.1
+        gt = np.array([[[0.4, 0.6, 0.3, 0.25], [0, 0, 0, 0]]],
+                      np.float32)
+        gl = np.array([[1, 0]], np.int32)
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            xp = layers.create_parameter(shape=(1, 21, 4, 4),
+                                         dtype="float32", name="xh")
+            loss = layers.yolov3_loss(
+                xp, layers.assign(gt), layers.assign(gl),
+                anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+                class_num=2, ignore_thresh=0.7, downsample_ratio=32)
+            total = layers.reduce_sum(loss)
+            fluid.optimizer.Adam(0.05).minimize(total)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.global_scope().set_var(
+            "xh", np.asarray(x0))
+        losses = []
+        for _ in range(25):
+            (lv,) = exe.run(main, feed={}, fetch_list=[total])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.6, losses[::6]
+
+
+class TestYoloPadding:
+    def test_padding_rows_do_not_clobber(self, rng):
+        """All-zero padding gt rows must not overwrite the target at
+        lattice cell (0, 0, 0) (regression: padding rows used to
+        scatter init values over a real gt's target)."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops import detection_ops as D
+        x = rng.randn(1, 3 * 7, 4, 4).astype(np.float32) * 0.1
+        kw = dict(anchors=(10, 13, 16, 30, 33, 23),
+                  anchor_mask=(0, 1, 2), class_num=2,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        # gt in the (0, 0) cell
+        gt1 = np.array([[[0.05, 0.05, 0.08, 0.10]]], np.float32)
+        gl1 = np.array([[1]], np.int32)
+        gt2 = np.concatenate(
+            [gt1, np.zeros((1, 5, 4), np.float32)], axis=1)
+        gl2 = np.concatenate([gl1, np.zeros((1, 5), np.int32)], axis=1)
+        l1 = D.yolov3_loss(jnp.asarray(x), jnp.asarray(gt1),
+                           jnp.asarray(gl1), None, **kw)
+        l2 = D.yolov3_loss(jnp.asarray(x), jnp.asarray(gt2),
+                           jnp.asarray(gl2), None, **kw)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-6)
+
+
+class TestRpnGradient:
+    def test_rpn_pred_gather_carries_grad(self, rng):
+        """Predictions returned by rpn_target_assign must be
+        differentiable back to the head (regression: the gather was
+        non-differentiable and RPN heads silently froze)."""
+        h = w = 4
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            feat = layers.data("f", shape=[1, 1, h, w],
+                               append_batch_size=False)
+            anchors, variances = layers.anchor_generator(
+                feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+                stride=[8.0, 8.0])
+            bp = layers.create_parameter(shape=(1, h * w, 4),
+                                         dtype="float32", name="bp")
+            cl = layers.create_parameter(shape=(1, h * w, 1),
+                                         dtype="float32", name="cl")
+            gt = layers.assign(np.array(
+                [[[2, 2, 14, 14], [0, 0, 0, 0]]], np.float32))
+            crowd = layers.assign(np.zeros((1, 2), np.int32))
+            info = layers.assign(np.array([[32, 32, 1.0]], np.float32))
+            ps, pl, lbl, tb, wgt = layers.rpn_target_assign(
+                bp, cl, anchors, variances, gt, crowd, info,
+                rpn_batch_size_per_im=8, use_random=False)
+            loss = layers.reduce_sum(layers.square(pl - tb)) + \
+                layers.reduce_sum(layers.square(ps))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        before = np.asarray(
+            fluid.global_scope().find_var("bp")).copy()
+        for _ in range(3):
+            exe.run(main, feed={"f": np.zeros((1, 1, h, w),
+                                              np.float32)},
+                    fetch_list=[loss])
+        after = np.asarray(fluid.global_scope().find_var("bp"))
+        assert not np.allclose(before, after), \
+            "RPN head params did not move — gradient cut"
+
+
+class TestRoi:
+    def test_roi_align_values(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        bidx = np.array([0], np.int32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            xv = layers.data("x", shape=[1, 1, 4, 4],
+                             append_batch_size=False)
+            rv = layers.data("r", shape=[1, 4],
+                             append_batch_size=False)
+            bv = layers.data("b", shape=[1], dtype="int32",
+                             append_batch_size=False)
+            out = layers.roi_align(xv, rv, bv, pooled_height=1,
+                                   pooled_width=1, sampling_ratio=2)
+        o, = _run(main, {"x": x, "r": rois, "b": bidx}, [out])
+        # average of bilinear samples near center ~ mean of map
+        assert abs(float(o[0, 0, 0, 0]) - 7.5) < 1.5
+
+    def test_roi_pool_max(self):
+        x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+        rois = np.array([[0, 0, 7, 7]], np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            xv = layers.data("x", shape=[1, 1, 8, 8],
+                             append_batch_size=False)
+            rv = layers.data("r", shape=[1, 4],
+                             append_batch_size=False)
+            bv = layers.data("b", shape=[1], dtype="int32",
+                             append_batch_size=False)
+            out = layers.roi_pool(xv, rv, bv, pooled_height=2,
+                                  pooled_width=2)
+        o, = _run(main, {"x": x, "r": rois,
+                         "b": np.zeros(1, np.int32)}, [out])
+        np.testing.assert_allclose(o[0, 0], [[27, 31], [59, 63]])
+
+
+class TestProposals:
+    def test_generate_proposals(self, rng):
+        h = w = 6
+        a = 3
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            feat = layers.data("f", shape=[1, 1, h, w],
+                               append_batch_size=False)
+            anchors, variances = layers.anchor_generator(
+                feat, anchor_sizes=[16.0],
+                aspect_ratios=[0.5, 1.0, 2.0], stride=[8.0, 8.0])
+            sc = layers.data("s", shape=[1, a, h, w],
+                             append_batch_size=False)
+            bd = layers.data("d", shape=[1, 4 * a, h, w],
+                             append_batch_size=False)
+            info = layers.data("i", shape=[1, 3],
+                               append_batch_size=False)
+            rois, probs, num = layers.generate_proposals(
+                sc, bd, info, anchors, variances, pre_nms_top_n=30,
+                post_nms_top_n=8, nms_thresh=0.7, min_size=2.0)
+        r, p, n = _run(
+            main,
+            {"f": np.zeros((1, 1, h, w), np.float32),
+             "s": rng.rand(1, a, h, w).astype(np.float32),
+             "d": rng.randn(1, 4 * a, h, w).astype(np.float32) * 0.1,
+             "i": np.array([[48, 48, 1.0]], np.float32)},
+            [rois, probs, num])
+        assert r.shape == (1, 8, 4)
+        assert 0 < n[0] <= 8
+        valid = r[0, :n[0]]
+        assert (valid[:, 2] >= valid[:, 0]).all()
+        assert (valid >= -1e-3).all() and (valid <= 48).all()
+
+    def test_rpn_target_assign(self, rng):
+        h = w = 4
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            feat = layers.data("f", shape=[1, 1, h, w],
+                               append_batch_size=False)
+            anchors, variances = layers.anchor_generator(
+                feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+                stride=[8.0, 8.0])
+            bp = layers.data("bp", shape=[1, h * w, 4],
+                             append_batch_size=False)
+            cl = layers.data("cl", shape=[1, h * w, 1],
+                             append_batch_size=False)
+            gt = layers.data("gt", shape=[1, 2, 4],
+                             append_batch_size=False)
+            crowd = layers.data("cr", shape=[1, 2], dtype="int32",
+                                append_batch_size=False)
+            info = layers.data("i", shape=[1, 3],
+                               append_batch_size=False)
+            ps, pl, lbl, tb, wgt = layers.rpn_target_assign(
+                bp, cl, anchors, variances, gt, crowd, info,
+                rpn_batch_size_per_im=8, use_random=False)
+        out = _run(
+            main,
+            {"f": np.zeros((1, 1, h, w), np.float32),
+             "bp": rng.randn(1, h * w, 4).astype(np.float32),
+             "cl": rng.randn(1, h * w, 1).astype(np.float32),
+             "gt": np.array([[[2, 2, 14, 14], [0, 0, 0, 0]]],
+                            np.float32),
+             "cr": np.zeros((1, 2), np.int32),
+             "i": np.array([[32, 32, 1.0]], np.float32)},
+            [ps, pl, lbl, tb, wgt])
+        scores, locs, labels, tboxes, weights = out
+        assert labels.shape == (1, 8)
+        assert (labels == 1).sum() >= 1  # the gt got a fg anchor
+        fg = labels[0] == 1
+        assert np.isfinite(tboxes[0][fg]).all()
+
+    def test_fpn_distribute_collect(self):
+        # scales 16 / 500 / 60 → floor(log2(s/224)) + 4 = 2 / 5 / 2|3
+        rois = np.array([[0, 0, 16, 16], [0, 0, 500, 500],
+                         [0, 0, 60, 60]], np.float32)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            rv = layers.data("r", shape=[3, 4],
+                             append_batch_size=False)
+            outs, restore = layers.distribute_fpn_proposals(
+                rv, 2, 5, 4, 224)
+            sv = layers.data("s", shape=[3],
+                             append_batch_size=False)
+            merged = layers.collect_fpn_proposals(
+                [rv, rv], [sv, sv], 2, 3, post_nms_top_n=2)
+        res = _run(main, {"r": rois,
+                          "s": np.array([0.9, 0.1, 0.5], np.float32)},
+                   [outs[0], outs[3], restore, merged])
+        lvl2, lvl5, rest, m = res
+        assert (lvl2[0] == rois[0]).all()  # small roi → level 2
+        assert (lvl5[1] == rois[1]).all()  # big roi → level 5
+        assert m.shape == (2, 4)
+
+
+class TestSSDLoss:
+    def test_ssd_loss_trains(self, rng):
+        p, c = 8, 3
+        pb = np.zeros((p, 4), np.float32)
+        pb[:, 0] = np.linspace(0, 0.7, p)
+        pb[:, 1] = 0.2
+        pb[:, 2] = pb[:, 0] + 0.25
+        pb[:, 3] = 0.55
+        gt = np.array([[[0.05, 0.2, 0.3, 0.55], [0, 0, 0, 0]]],
+                      np.float32)
+        gl = np.array([[1, 0]], np.int64)
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            loc = layers.create_parameter(shape=(1, p, 4),
+                                          dtype="float32", name="loc")
+            conf = layers.create_parameter(shape=(1, p, c),
+                                           dtype="float32", name="conf")
+            pbv = layers.assign(pb)
+            loss = layers.ssd_loss(loc, conf, layers.assign(gt),
+                                   layers.assign(gl), pbv)
+            total = layers.reduce_sum(loss)
+            fluid.optimizer.Adam(0.1).minimize(total)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={}, fetch_list=[total])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+    def test_multi_box_head(self, rng):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = layers.data("img", shape=[3, 32, 32],
+                              append_batch_size=True)
+            f1 = layers.data("f1", shape=[8, 8, 8],
+                             append_batch_size=True)
+            f2 = layers.data("f2", shape=[8, 4, 4],
+                             append_batch_size=True)
+            locs, confs, box, var = layers.multi_box_head(
+                [f1, f2], img, base_size=32, num_classes=3,
+                aspect_ratios=[[2.0], [2.0]],
+                min_sizes=[8.0, 16.0], max_sizes=[16.0, 24.0],
+                flip=True)
+        exe = fluid.Executor()
+        exe.run(startup)
+        lo, co, bo, vo = _run(
+            main,
+            {"img": np.zeros((2, 3, 32, 32), np.float32),
+             "f1": rng.randn(2, 8, 8, 8).astype(np.float32),
+             "f2": rng.randn(2, 8, 4, 4).astype(np.float32)},
+            [locs, confs, box, var])
+        n_priors = (8 * 8 + 4 * 4) * 4  # 4 priors/cell
+        assert lo.shape == (2, n_priors, 4)
+        assert co.shape == (2, n_priors, 3)
+        assert bo.shape == (n_priors, 4)
