@@ -1,0 +1,2 @@
+"""Incubating APIs (reference: python/paddle/fluid/incubate/)."""
+from . import fleet  # noqa: F401

@@ -1,0 +1,2 @@
+"""Unified distributed UX (reference: fluid/incubate/fleet/)."""
+from . import base  # noqa: F401

@@ -1,0 +1,129 @@
+"""Fleet base — the unified distributed-training facade.
+
+Reference: python/paddle/fluid/incubate/fleet/base/fleet_base.py:38
+(Fleet: init/is_worker/init_worker/init_server/run_server/
+distributed_optimizer/save_inference_model/save_persistables, plus the
+DistributedOptimizer wrapper).
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Optional
+
+from ....core.enforce import InvalidArgumentError, enforce
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class Fleet:
+    """Reference: fleet_base.py:38. Subclasses implement the mode
+    (collective here; parameter_server dissolves into ZeRO sharding)."""
+
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._optimizer = None
+        self._is_initialized = False
+
+    # -- role queries --------------------------------------------------
+    def _rm(self) -> RoleMakerBase:
+        enforce(self._role_maker is not None,
+                "fleet.init(role_maker) must be called first",
+                exc=InvalidArgumentError)
+        return self._role_maker
+
+    def is_first_worker(self):
+        return self._rm().is_first_worker()
+
+    def worker_index(self):
+        return self._rm().worker_index()
+
+    def worker_num(self):
+        return self._rm().worker_num()
+
+    def is_worker(self):
+        return self._rm().is_worker()
+
+    def server_num(self):
+        return self._rm().server_num()
+
+    def server_index(self):
+        return self._rm().server_index()
+
+    def is_server(self):
+        return self._rm().is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._rm().get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._rm().get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # -- lifecycle -----------------------------------------------------
+    def init(self, role_maker=None):
+        """Reference: fleet_base.py Fleet.init — accepts a role maker
+        (default PaddleCloudRoleMaker from env)."""
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker()
+        enforce(isinstance(role_maker, RoleMakerBase),
+                "init expects a RoleMakerBase")
+        self._role_maker = role_maker
+        role_maker.generate_role()
+        self._is_initialized = True
+        self._init_impl()
+
+    def _init_impl(self):
+        pass
+
+    @abstractmethod
+    def init_worker(self):
+        ...
+
+    @abstractmethod
+    def init_server(self, model_dir=None):
+        ...
+
+    @abstractmethod
+    def run_server(self):
+        ...
+
+    @abstractmethod
+    def stop_worker(self):
+        ...
+
+    @abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        ...
+
+    @abstractmethod
+    def save_inference_model(self, executor, dirname,
+                             feeded_var_names, target_vars,
+                             main_program=None, export_for_deployment=True):
+        ...
+
+    @abstractmethod
+    def save_persistables(self, executor, dirname, main_program=None):
+        ...
+
+
+class DistributedOptimizer:
+    """Wraps a regular Optimizer for distributed training (reference:
+    fleet_base.py DistributedOptimizer)."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        raise NotImplementedError

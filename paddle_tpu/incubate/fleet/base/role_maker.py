@@ -1,0 +1,136 @@
+"""Role makers — who am I in the distributed job?
+
+Reference: python/paddle/fluid/incubate/fleet/base/role_maker.py
+(RoleMakerBase, PaddleCloudRoleMaker reading PADDLE_* env vars,
+UserDefinedRoleMaker). The TPU build keeps the exact env-var spelling
+so reference launch scripts work unchanged; "server" roles exist for
+API parity but the collective fleet has no parameter servers (dense
+state is ZeRO-sharded on device — see transpiler/__init__.py).
+"""
+
+from __future__ import annotations
+
+import os
+from enum import IntEnum
+from typing import List, Optional
+
+from ....core.enforce import InvalidArgumentError, enforce
+
+
+class Role(IntEnum):
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    """Reference: role_maker.py RoleMakerBase."""
+
+    def __init__(self):
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+        self._role: Optional[Role] = None
+        self._current_id = -1
+        self._generated = False
+
+    def generate_role(self):
+        raise NotImplementedError
+
+    def _ensure(self):
+        if not self._generated:
+            self.generate_role()
+
+    def is_worker(self) -> bool:
+        self._ensure()
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        self._ensure()
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self.worker_index() == 0
+
+    def worker_num(self) -> int:
+        self._ensure()
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self) -> int:
+        self._ensure()
+        return len(self._server_endpoints)
+
+    def worker_index(self) -> int:
+        self._ensure()
+        return self._current_id
+
+    def server_index(self) -> int:
+        self._ensure()
+        return self._current_id
+
+    def get_trainer_endpoints(self) -> List[str]:
+        self._ensure()
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self) -> List[str]:
+        self._ensure()
+        return list(self._server_endpoints)
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Role from PADDLE_* environment variables (reference:
+    role_maker.py PaddleCloudRoleMaker):
+
+      TRAINING_ROLE            TRAINER | PSERVER (default TRAINER)
+      PADDLE_TRAINER_ID        this worker's rank
+      PADDLE_TRAINERS_NUM      number of workers
+      PADDLE_TRAINER_ENDPOINTS comma-separated worker ip:port list
+      PADDLE_PSERVERS_IP_PORT_LIST  server list (parity only)
+    """
+
+    def __init__(self, is_collective=True):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._generated:
+            return
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        if role == "TRAINER":
+            self._role = Role.WORKER
+            self._current_id = int(
+                os.environ.get("PADDLE_TRAINER_ID", "0"))
+        elif role == "PSERVER":
+            self._role = Role.SERVER
+            self._current_id = int(
+                os.environ.get("PADDLE_PSERVER_ID",
+                               os.environ.get("PADDLE_TRAINER_ID",
+                                              "0")))
+        else:
+            raise InvalidArgumentError(
+                "TRAINING_ROLE must be TRAINER or PSERVER, got %r"
+                % role)
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        if not self._worker_endpoints:
+            n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+            self._worker_endpoints = ["127.0.0.1:0"] * n
+        seps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in seps.split(",") if e]
+        self._generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicitly-specified role (reference: role_maker.py
+    UserDefinedRoleMaker)."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, worker_endpoints=None):
+        super().__init__()
+        enforce(current_id >= 0, "current_id must be >= 0")
+        self._current_id = int(current_id)
+        self._role = Role(role)
+        self._worker_endpoints = list(
+            worker_endpoints or ["127.0.0.1:0"] * int(worker_num))
+        self._server_endpoints = list(server_endpoints or [])
+
+    def generate_role(self):
+        self._generated = True

@@ -1,0 +1,64 @@
+"""Parameter-server fleet facade.
+
+Reference: python/paddle/fluid/incubate/fleet/parameter_server/
+(distribute_transpiler/__init__.py DistributedTranspiler fleet, and
+pslib/ for Baidu PSLib). In the reference, dense parameters live on
+pserver processes that apply gradients server-side.
+
+TPU-native dissolution: there is no separate server process. The
+idiomatic equivalent of "parameters sharded across servers, updated
+where they live" is ZeRO-style sharding — optimizer state and
+parameters shard over the dp axis ON DEVICE (ReduceStrategy.Reduce,
+compiler.py), updates run where each shard lives, and XLA's
+reduce-scatter/all-gather replace the send/recv RPC fabric. Sparse
+>HBM embedding tables keep the row-sharded + all-to-all path
+(models/deepfm.py shard_tables). So `fleet.distributed_optimizer`
+here wires the Reduce strategy and the API surface stays; server
+process entry points raise with guidance (the reference's
+get_pserver_program analog — transpiler/__init__.py:79).
+"""
+
+from __future__ import annotations
+
+from .... import compiler as compiler_mod
+from ..base.fleet_base import DistributedOptimizer
+from ..collective import Collective, DistributedStrategy
+
+__all__ = ["fleet", "ParameterServerFleet", "PSDistributedOptimizer"]
+
+
+class ParameterServerFleet(Collective):
+    """PS-mode facade over the collective substrate: dense params use
+    ZeRO sharding (the on-device analog of server-side updates)."""
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        strategy = strategy or DistributedStrategy()
+        strategy.build_strategy.reduce_strategy = \
+            compiler_mod.BuildStrategy.ReduceStrategy.Reduce
+        self._optimizer = PSDistributedOptimizer(self, optimizer,
+                                                 strategy)
+        return self._optimizer
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError(
+            "no pserver processes on TPU: dense state is ZeRO-sharded "
+            "on device (ReduceStrategy.Reduce); load checkpoints with "
+            "io.load_persistables instead")
+
+    run_server = init_server
+
+
+class PSDistributedOptimizer(DistributedOptimizer):
+    def __init__(self, fleet_obj, optimizer, strategy):
+        super().__init__(optimizer, strategy)
+        self._fleet = fleet_obj
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        self._fleet._compile(loss, self._strategy)
+        return opt_ops, params_grads
+
+
+fleet = ParameterServerFleet()

@@ -469,14 +469,11 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
             [mxs] if mxs and not isinstance(mxs, (list, tuple))
             else mxs, ar, variance, flip, clip, st, offset,
             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
-        num_priors = 1
-        ars_eff = [1.0]
-        for a in ar:
-            if not any(abs(a - e) < 1e-6 for e in ars_eff):
-                ars_eff.append(a)
-                if flip:
-                    ars_eff.append(1.0 / a)
-        num_priors = len(ars_eff) + (1 if mxs else 0)
+        # priors per cell comes from the generated boxes themselves
+        # ([H, W, P, 4] — shape inference ran at append_op), so the
+        # conv head channel count can never disagree with the priors
+        # (reference reads it off the prior op output the same way)
+        num_priors = box.shape[2]
 
         loc = nn.conv2d(feat, num_priors * 4, kernel_size,
                         padding=pad, stride=stride)

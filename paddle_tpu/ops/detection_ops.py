@@ -73,7 +73,7 @@ def prior_box(input, image, *, min_sizes, max_sizes=(), aspect_ratios=(1.0,),
         ms = float(ms)
         if min_max_aspect_ratios_order:
             whs.append((ms, ms))
-            if max_sizes:
+            if max_sizes and s < len(max_sizes):
                 big = (ms * float(max_sizes[s])) ** 0.5
                 whs.append((big, big))
             for ar in ars:
@@ -83,7 +83,7 @@ def prior_box(input, image, *, min_sizes, max_sizes=(), aspect_ratios=(1.0,),
         else:
             for ar in ars:
                 whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
-            if max_sizes:
+            if max_sizes and s < len(max_sizes):
                 big = (ms * float(max_sizes[s])) ** 0.5
                 whs.append((big, big))
     wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
@@ -556,10 +556,12 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
         pw = props[:, 2] - props[:, 0] + 1.0
         ph = props[:, 3] - props[:, 1] + 1.0
         keep_sz = (pw >= ms) & (ph >= ms)
-        # proposals use pixel coordinates (+1 width convention)
+        # proposals use pixel coordinates (+1 width convention); ALL
+        # pre-NMS candidates stay eligible (top_k = k), so boxes below
+        # rank post_nms_top_n can replace suppressed ones — matching
+        # the reference's full NMS scan over pre_nms_top_n boxes
         keep, order = _nms_mask(props, sc_k, keep_sz, nms_thresh,
-                                post_nms_top_n, normalized=False,
-                                eta=eta)
+                                k, normalized=False, eta=eta)
         final_sc = jnp.where(keep, sc_k[order], -jnp.inf)
         take = jnp.argsort(-final_sc)[:post_nms_top_n]
         ok = final_sc[take] > -jnp.inf
@@ -899,11 +901,17 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
 def distribute_fpn_proposals(fpn_rois, *, min_level=2, max_level=5,
                              refer_level=4, refer_scale=224):
     """Route each ROI to its FPN level (reference:
-    distribute_fpn_proposals_op.h). Static redesign: every per-level
-    output keeps the full [R, 4] shape with non-member rows zeroed and
-    a leading validity column is NOT added — instead RestoreIndex packs
-    (level, original index); callers use the mask implied by nonzero
-    rows. roi_align consumes zero rows harmlessly (zero boxes)."""
+    distribute_fpn_proposals_op.h).
+
+    Static redesign: the reference compacts ROIs into ragged per-level
+    lists and returns a RestoreIndex mapping concat positions back to
+    the original order. Here every per-level output keeps the FULL
+    [R, 4] shape *in the original ROI order* with non-member rows
+    zeroed — so per-level roi_align results recombine by masked sum
+    (zero boxes pool zeros) and NO reordering ever happens.
+    RestoreIndex is therefore the identity [R, 1] (kept for API
+    parity); each ROI's level is recoverable as the level whose output
+    row is nonzero."""
     r = fpn_rois.shape[0]
     w = fpn_rois[:, 2] - fpn_rois[:, 0]
     h = fpn_rois[:, 3] - fpn_rois[:, 1]
@@ -914,8 +922,7 @@ def distribute_fpn_proposals(fpn_rois, *, min_level=2, max_level=5,
     for L in range(min_level, max_level + 1):
         m = (lvl == L)[:, None]
         outs.append(jnp.where(m, fpn_rois, 0.0))
-    order = jnp.argsort(lvl, stable=True)
-    restore = jnp.argsort(order).astype(jnp.int32)[:, None]
+    restore = jnp.arange(r, dtype=jnp.int32)[:, None]
     return outs, restore
 
 

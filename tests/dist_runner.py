@@ -1,0 +1,93 @@
+"""Standalone distributed-model runner, launched as a subprocess by
+test_fleet.py — the analog of the reference's dist_mnist.py +
+TestDistRunnerBase (test_dist_base.py:38): builds a small model,
+trains N steps through the fleet, prints the loss trace as JSON.
+
+Every process feeds the IDENTICAL global batch; the dp sharding
+splits it across processes' devices (the sync-SGD semantics whose
+loss trace must equal a single-process run — test_dist_base.py:316).
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# one CPU device per process (the parent test env forces 8)
+os.environ["XLA_FLAGS"] = ""
+
+import numpy as np  # noqa: E402
+
+
+def build_model():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 6], append_batch_size=False)
+        y = layers.data("y", shape=[8, 1], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu",
+                      param_attr=fluid.ParamAttr(name="w1"))
+        pred = layers.fc(h, size=1, param_attr=fluid.ParamAttr(
+            name="w2"))
+        loss = layers.reduce_mean(
+            layers.square_error_cost(input=pred, label=y))
+    return main, startup, loss
+
+
+def batches(n_steps):
+    rs = np.random.RandomState(7)
+    for _ in range(n_steps):
+        x = rs.rand(8, 6).astype(np.float32)
+        y = (x.sum(1, keepdims=True) * 0.5).astype(np.float32)
+        yield x, y
+
+
+def run_local(n_steps):
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import paddle_tpu as fluid
+
+    main, startup, loss = build_model()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = []
+    for x, y in batches(n_steps):
+        (lv,) = exe.run(main, feed={"x": x, "y": y},
+                        fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def run_fleet(n_steps):
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import paddle_tpu as fluid
+    from paddle_tpu.incubate.fleet.base import role_maker
+    from paddle_tpu.incubate.fleet.collective import fleet
+
+    fleet.init(role_maker.PaddleCloudRoleMaker(is_collective=True))
+    main, startup, loss = build_model()
+    with fluid.program_guard(main, startup):
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = []
+    for x, y in batches(n_steps):
+        (lv,) = exe.run(fleet.main_program, feed={"x": x, "y": y},
+                        fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    n_steps = int(sys.argv[2])
+    losses = run_local(n_steps) if mode == "local" \
+        else run_fleet(n_steps)
+    print("LOSSES:" + json.dumps(losses))

@@ -38,6 +38,9 @@ PUBLIC_MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.transpiler",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.incubate.fleet.base.role_maker",
+    "paddle_tpu.incubate.fleet.collective",
+    "paddle_tpu.incubate.fleet.parameter_server",
 ]
 
 
@@ -52,7 +55,11 @@ def _entries_for(modname):
     __import__(modname)
     mod = sys.modules[modname]
     entries = []
-    for name in sorted(dir(mod)):
+    # a module that declares __all__ freezes exactly that surface;
+    # otherwise every public paddle_tpu-defined callable is frozen
+    # (accidental convenience imports would otherwise become API)
+    public = getattr(mod, "__all__", None)
+    for name in sorted(public if public is not None else dir(mod)):
         if name.startswith("_"):
             continue
         obj = getattr(mod, name)
