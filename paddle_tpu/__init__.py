@@ -47,6 +47,9 @@ from . import metrics  # noqa: F401
 from . import nets  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import recordio  # noqa: F401
+from .dataset_factory import (DatasetFactory, InMemoryDataset,  # noqa: F401
+                              QueueDataset)
 from .data_feeder import DataFeeder  # noqa: F401
 from .pyreader import DataLoader, PyReader  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

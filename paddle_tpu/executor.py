@@ -342,6 +342,61 @@ class Executor:
     def close(self):
         self._cache.clear()
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Run the program over every batch of an industrial Dataset
+        (reference: executor.py train_from_dataset → C++
+        Executor::RunFromDataset, executor.cc:120, driving trainer/
+        device-worker threads). TPU redesign: the Dataset's reader
+        threads pump host batches while the ONE compiled XLA step
+        consumes them — the device-worker thread pool dissolves into
+        XLA's async dispatch (steps overlap host loading because
+        executor runs don't block on fetch)."""
+        from .dataset_factory import DatasetBase
+        enforce(dataset is not None and
+                isinstance(dataset, DatasetBase),
+                "train_from_dataset needs a Dataset (DatasetFactory"
+                "().create_dataset(...))")
+        program = program or framework.default_main_program()
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [
+            getattr(f, "name", str(f)) for f in fetch_list]
+        step = 0
+        for feed in dataset.batch_iterator():
+            step += 1
+            # fetch (which syncs host<->device) only on print steps —
+            # every other step dispatches asynchronously (the
+            # reference also materializes fetch vars at print_period)
+            printing = debug and fetch_list and \
+                step % print_period == 0
+            vals = self.run(program, feed=feed,
+                            fetch_list=fetch_list if printing else [],
+                            scope=scope)
+            if printing:
+                msg = ", ".join(
+                    "%s=%s" % (n, np.asarray(v).reshape(-1)[:3])
+                    for n, v in zip(fetch_info, vals))
+                print("[train_from_dataset] step %d: %s"
+                      % (step, msg))
+        if step == 0:
+            import warnings
+            warnings.warn(
+                "train_from_dataset ran 0 steps — the dataset holds "
+                "fewer instances than one batch (batch_iterator drops "
+                "the last partial batch)")
+        return step
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset (reference:
+        executor.py infer_from_dataset — same loop, no update ops;
+        pass a clone(for_test=True) program)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     # -- internals ---------------------------------------------------------
     def _base_key(self, program):
         seed = program.random_seed or FLAGS.global_seed

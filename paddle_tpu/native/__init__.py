@@ -1,0 +1,56 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference implements its data-path hot spots in C++ (recordio/,
+data_feed.cc, framework/ trainers); this package holds the TPU build's
+C++ equivalents. No pybind11 in the image, so the ABI is plain C
+consumed with ctypes; each library compiles on demand with g++ into a
+per-source-hash cached .so (the analog of the reference's cmake
+`cc_library` targets, built lazily). Callers fall back to pure-Python
+implementations when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CACHE = os.path.join(tempfile.gettempdir(),
+                      "paddle_tpu_native_%d" % os.getuid())
+
+
+def build_library(source_name: str) -> Optional[str]:
+    """Compile native/<source_name> to a cached shared object; return
+    its path or None if the toolchain is unavailable/fails."""
+    src = os.path.join(_HERE, source_name)
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    os.makedirs(_CACHE, exist_ok=True)
+    so = os.path.join(
+        _CACHE, "%s-%s.so" % (os.path.splitext(source_name)[0], digest))
+    if os.path.exists(so):
+        return so
+    tmp = so + ".tmp%d" % os.getpid()
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
+           "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=120)
+        os.replace(tmp, so)  # atomic vs concurrent builders
+        return so
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load_library(source_name: str) -> Optional[ctypes.CDLL]:
+    so = build_library(source_name)
+    if so is None:
+        return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None
