@@ -90,13 +90,23 @@ def check_output(op_type, np_inputs, attrs, expected, atol=1e-4,
 
 def check_grad(op_type, np_inputs, attrs, inputs_to_check,
                delta=0.005, max_relative_error=0.005,
-               output_index=0, n_outputs=1):
+               output_index=0, n_outputs=1, loss_weight=None):
     """Compare append_backward analytic grads vs finite differences of
-    sum(output[output_index]) — the reference's dual-check."""
+    sum(output[output_index]) — the reference's dual-check.
+
+    ``loss_weight``: optional constant array multiplied into the
+    output before summing. Needed for ops whose plain output sum is an
+    input-independent constant (softmax rows sum to 1, normalization
+    outputs sum to ~0) — there the unweighted loss has zero gradient
+    and finite differences measure only float noise."""
     main, feed, out_vars, in_map = _build_op_program(
         op_type, np_inputs, attrs, n_outputs)
     with fluid.program_guard(main):
-        loss = layers.reduce_sum(out_vars[output_index])
+        out = out_vars[output_index]
+        if loss_weight is not None:
+            out = out * layers.assign(
+                np.asarray(loss_weight, np.float32))
+        loss = layers.reduce_sum(out)
         grads = fluid.gradients(
             loss, [in_map[n.lower()] for n in inputs_to_check])
     exe = fluid.Executor()
@@ -112,7 +122,10 @@ def check_grad(op_type, np_inputs, attrs, inputs_to_check,
         feed2.update(feed_override)
         (val,) = num_exe.run(m2, feed=feed2,
                              fetch_list=[o2[output_index]])
-        return float(np.sum(np.asarray(val, np.float64)))
+        arr = np.asarray(val, np.float64)
+        if loss_weight is not None:
+            arr = arr * loss_weight
+        return float(np.sum(arr))
 
     for name, got in zip(inputs_to_check, analytic):
         base = feed[name.lower()].astype(np.float64)

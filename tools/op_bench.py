@@ -1,0 +1,154 @@
+"""Per-op micro-benchmark — the analog of the reference's
+paddle/fluid/operators/benchmark/op_tester.cc (time one op from a
+config) and operators/jit/benchmark.cc (compare implementations and
+report the best).
+
+Times a single op through the real executor, once per registered
+library variant (base XLA lowering vs pallas kernels), and prints one
+JSON line per variant plus the winner:
+
+    python tools/op_bench.py matmul --inputs X=256x256,Y=256x256
+    python tools/op_bench.py softmax --inputs X=512x512 --grad
+    python tools/op_bench.py --list          # ops with variants
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def parse_inputs(spec):
+    """"X=2x3,Y=3x4" or "X=2x3:int64" → {slot: ndarray}."""
+    out = {}
+    if not spec:
+        return out
+    rs = np.random.RandomState(0)
+    for part in spec.split(","):
+        slot, shape = part.split("=")
+        dtype = "float32"
+        if ":" in shape:
+            shape, dtype = shape.split(":")
+        dims = tuple(int(d) for d in shape.split("x"))
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            out[slot] = rs.randint(0, 8, dims).astype(dtype)
+        else:
+            out[slot] = rs.rand(*dims).astype(dtype)
+    return out
+
+
+def parse_attrs(spec):
+    out = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        k, v = part.split("=")
+        for cast in (int, float):
+            try:
+                out[k] = cast(v)
+                break
+            except ValueError:
+                continue
+        else:
+            out[k] = {"True": True, "False": False}.get(v, v)
+    return out
+
+
+def bench_op(op_type, np_inputs, attrs, iters=200, warmup=20,
+             grad=False):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import ops as registry
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tests"))
+    from op_test import _build_op_program
+
+    opdef = registry.get(op_type)
+    libraries = [None] + sorted(opdef.variants)
+    results = []
+    for lib in libraries:
+        main, feed, out_vars, in_map = _build_op_program(
+            op_type, np_inputs, attrs)
+        if grad:
+            with fluid.program_guard(main):
+                from paddle_tpu import layers
+                loss = layers.reduce_sum(out_vars[0])
+                fluid.gradients(loss, list(in_map.values()))
+        exe = fluid.Executor()
+        fetch = [out_vars[0]]
+
+        def run():
+            return exe.run(main, feed=feed, fetch_list=fetch,
+                           return_numpy=False,
+                           use_program_cache=True)
+
+        # executor caches by (program, library) via FLAGS
+        from paddle_tpu.core.flags import FLAGS
+        old = FLAGS.op_library
+        FLAGS.op_library = lib or ""
+        try:
+            out = run()
+            for _ in range(warmup - 1):
+                out = run()
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = run()
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+        finally:
+            FLAGS.op_library = old
+        results.append({
+            "op": op_type, "library": lib or "base",
+            "us_per_call": round(dt / iters * 1e6, 2),
+            "iters": iters, "grad": grad,
+            "inputs": {k: list(np.shape(v))
+                       for k, v in np_inputs.items()},
+        })
+    best = min(results, key=lambda r: r["us_per_call"])
+    for r in results:
+        r["best"] = r["library"] == best["library"]
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("op", nargs="?", help="op type to benchmark")
+    ap.add_argument("--inputs", default="", help="X=2x3,Y=3x4[:dtype]")
+    ap.add_argument("--attrs", default="", help="k=v,k2=v2")
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--grad", action="store_true",
+                    help="include backward in the timed program")
+    ap.add_argument("--list", action="store_true",
+                    help="list ops that have library variants")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from paddle_tpu import ops as registry
+        for t in registry.all_op_types():
+            v = registry.get(t).variants
+            if v:
+                print(t, "->", ", ".join(sorted(v)))
+        return 0
+
+    if not args.op:
+        ap.error("op required (or --list)")
+    results = bench_op(args.op, parse_inputs(args.inputs),
+                       parse_attrs(args.attrs), iters=args.iters,
+                       warmup=args.warmup, grad=args.grad)
+    for r in results:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
