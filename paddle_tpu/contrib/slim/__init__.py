@@ -1,0 +1,7 @@
+"""slim: model compression (reference: fluid/contrib/slim/ — 15.2k LoC
+of quantization / pruning / distillation / NAS). This build ships the
+quantization-aware-training core (the TPU-relevant piece: int8
+inference); pruning/distillation/NAS express naturally as user-level
+program rewrites on this substrate.
+"""
+from . import quantization  # noqa: F401

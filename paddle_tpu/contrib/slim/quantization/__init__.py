@@ -1,0 +1,4 @@
+from .quantization_pass import (AddQuantDequantPass,  # noqa: F401
+                                ConvertToInt8Pass,
+                                QuantizationFreezePass,
+                                QuantizationTransformPass)

@@ -1,0 +1,260 @@
+"""Quantization-aware training passes.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass inserting
+fake_quantize/dequantize op pairs on the inputs of quantizable ops,
+QuantizationFreezePass folding trained scales for inference,
+ConvertToInt8Pass storing weights as int8).
+
+TPU redesign: the reference rewrites an IrGraph; here the passes are
+direct Program rewrites (the same mechanism as the AMP decorator,
+contrib/mixed_precision/fp16_utils.py rewrite_program) — each pass
+walks block.ops, inserts fake-quant ops and renames inputs. The
+quantize-dequantize ops stay in float during training (QAT); actual
+int8 tensors appear only at freeze/export time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .... import framework, unique_name
+from ....core.enforce import enforce
+from ....core.scope import global_scope
+
+# ops whose inputs are quantized (reference:
+# QuantizationTransformPass._quantizable_ops)
+QUANTIZABLE_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+# weight input slot per op type
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y"}
+# output-channel axis of each op's weight (conv filters are
+# [out_c, in_c, kh, kw]; fc/matmul weights are [in, out] — the
+# reference quantizes fc weights per OUTPUT channel, axis 1)
+_WEIGHT_QUANT_AXIS = {"conv2d": 0, "depthwise_conv2d": 0,
+                      "mul": 1, "matmul": 1}
+
+
+class QuantizationTransformPass:
+    """Insert fake quantize-dequantize pairs on activations and
+    weights of quantizable forward ops (reference:
+    quantization_pass.py:41)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9, quantizable_ops=None):
+        enforce(activation_quantize_type in
+                ("abs_max", "moving_average_abs_max",
+                 "range_abs_max"),
+                "unknown activation_quantize_type %r",
+                activation_quantize_type)
+        enforce(weight_quantize_type in
+                ("abs_max", "channel_wise_abs_max"),
+                "unknown weight_quantize_type %r",
+                weight_quantize_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._moving_rate = moving_rate
+        self._ops = tuple(quantizable_ops or QUANTIZABLE_OPS)
+
+    def apply(self, program, startup_program=None, is_test=False):
+        """Rewrite ``program`` in place; returns the number of
+        fake-quant pairs inserted. Scale state vars for the
+        moving-average mode are created in ``startup_program``."""
+        n = 0
+        for block in program.blocks:
+            new_ops = []
+            quantized = {}  # var name -> qdq output name
+            for op in block.ops:
+                if op.type in self._ops and \
+                        op.attrs.get("op_role") not in ("backward",
+                                                        "optimize"):
+                    wslot = _WEIGHT_SLOTS.get(op.type)
+                    for slot, names in op.inputs.items():
+                        for j, name in enumerate(names):
+                            var = block._find_var_recursive(name)
+                            if var is None or \
+                                    var.dtype not in ("float32",
+                                                      "bfloat16"):
+                                continue
+                            is_w = slot == wslot and var.persistable
+                            key = (name, is_w)
+                            if key not in quantized:
+                                qname, ops_ = self._make_qdq(
+                                    block, name, var, is_w,
+                                    startup_program, is_test,
+                                    _WEIGHT_QUANT_AXIS.get(op.type,
+                                                           0))
+                                new_ops.extend(ops_)
+                                quantized[key] = qname
+                                n += 1
+                            names[j] = quantized[key]
+                new_ops.append(op)
+                for out in op.output_arg_names:
+                    quantized.pop((out, True), None)
+                    quantized.pop((out, False), None)
+            block.ops = new_ops
+        program._bump()
+        return n
+
+    def _make_qdq(self, block, name, var, is_weight, startup, is_test,
+                  quant_axis=0):
+        out = block.create_var(
+            name=unique_name.generate(name + ".quantized"),
+            shape=tuple(var.shape), dtype=var.dtype,
+            stop_gradient=var.stop_gradient)
+        scale = block.create_var(
+            name=unique_name.generate(name + ".quant_scale"),
+            shape=(), dtype="float32", stop_gradient=True)
+        if is_weight:
+            bits = self._wbits
+            if self._weight_type == "channel_wise_abs_max":
+                op = framework.Operator(
+                    block,
+                    "fake_channel_wise_quantize_dequantize_abs_max",
+                    inputs={"X": [name]},
+                    outputs={"Out": [out.name],
+                             "OutScale": [scale.name]},
+                    attrs={"bit_length": bits,
+                           "quant_axis": quant_axis})
+            else:
+                op = framework.Operator(
+                    block, "fake_quantize_dequantize_abs_max",
+                    inputs={"X": [name]},
+                    outputs={"Out": [out.name],
+                             "OutScale": [scale.name]},
+                    attrs={"bit_length": bits})
+            return out.name, [op]
+        # activation
+        if self._act_type == "abs_max":
+            op = framework.Operator(
+                block, "fake_quantize_dequantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [out.name], "OutScale": [scale.name]},
+                attrs={"bit_length": self._abits})
+            return out.name, [op]
+        # moving_average_abs_max (range_abs_max maps onto it): a
+        # persistable running scale, updated in-graph while training.
+        # The name is DETERMINISTIC (no unique counter) so the test
+        # program's pass binds to the scale state the training program
+        # learned — the reference shares the scale var the same way.
+        state = block.create_var(
+            name=name + ".quant_scale@state",
+            shape=(), dtype="float32", persistable=True,
+            stop_gradient=True)
+        if startup is not None:
+            sb = startup.global_block()
+            sv = sb.create_var(name=state.name, shape=(),
+                               dtype="float32", persistable=True,
+                               stop_gradient=True)
+            sb.append_op(type="fill_constant",
+                         outputs={"Out": [sv]},
+                         attrs={"shape": (), "dtype": "float32",
+                                "value": 0.0})
+        op = framework.Operator(
+            block,
+            "fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [name], "InScale": [state.name]},
+            outputs={"Out": [out.name], "OutScale": [state.name]},
+            attrs={"bit_length": self._abits,
+                   "moving_rate": self._moving_rate,
+                   "is_test": bool(is_test)})
+        return out.name, [op]
+
+
+class QuantizationFreezePass:
+    """Freeze a QAT-transformed *test* program for inference
+    (reference: quantization_pass.py QuantizationFreezePass): weight
+    fake-quant ops are replaced by int8 weight storage + a
+    dequantize_weight op; activation fake-quants keep their trained
+    frozen scales (is_test=True)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 weight_quantize_type="abs_max"):
+        self._scope = scope
+        self._wbits = weight_bits
+        self._weight_type = weight_quantize_type
+
+    def apply(self, program):
+        scope = self._scope or global_scope()
+        qmax = float(2 ** (self._wbits - 1) - 1)
+        n = 0
+        for block in program.blocks:
+            new_ops = []
+            for op in block.ops:
+                if op.type in (
+                        "fake_quantize_dequantize_abs_max",
+                        "fake_channel_wise_quantize_dequantize_abs_max"):
+                    src = op.inputs["X"][0]
+                    var = block._find_var_recursive(src)
+                    if var is not None and var.persistable:
+                        # quantize the weight tensor in the scope NOW
+                        w = np.asarray(scope.find_var(src))
+                        per_ch = op.type.startswith("fake_channel")
+                        qaxis = int(op.attrs.get("quant_axis", 0))
+                        if per_ch:
+                            axes = tuple(i for i in range(w.ndim)
+                                         if i != qaxis)
+                            scale = np.max(np.abs(w), axis=axes)
+                            shp = [1] * w.ndim
+                            shp[qaxis] = -1
+                            s = scale.reshape(shp)
+                        else:
+                            scale = np.float32(np.max(np.abs(w)))
+                            s = scale
+                        q = np.clip(np.round(w / np.maximum(s, 1e-8)
+                                             * qmax), -qmax,
+                                    qmax).astype(np.int8)
+                        scope.set_var(src, q)
+                        var.dtype = "int8"
+                        sname = unique_name.generate(
+                            src + ".w_scale")
+                        sv = block.create_var(
+                            name=sname, shape=np.shape(scale),
+                            dtype="float32", persistable=True,
+                            stop_gradient=True)
+                        scope.set_var(sname,
+                                      np.asarray(scale, np.float32))
+                        deq = framework.Operator(
+                            block, "dequantize_weight",
+                            inputs={"X": [src], "Scale": [sname]},
+                            outputs={"Out": op.outputs["Out"]},
+                            attrs={"bit_length": self._wbits,
+                                   "quant_axis": qaxis})
+                        new_ops.append(deq)
+                        n += 1
+                        continue
+                if op.type == ("fake_quantize_dequantize_"
+                               "moving_average_abs_max"):
+                    op.attrs["is_test"] = True
+                new_ops.append(op)
+            block.ops = new_ops
+        program._bump()
+        return n
+
+
+class ConvertToInt8Pass:
+    """Kept for reference-API parity: the int8 weight conversion
+    happens inside QuantizationFreezePass here (one pass instead of
+    two — there is no separate IrGraph stage to split over)."""
+
+    def __init__(self, scope=None, place=None):
+        self._scope = scope
+
+    def apply(self, program):
+        return program
+
+
+class AddQuantDequantPass(QuantizationTransformPass):
+    """Reference parity alias: quantize additional op types (pool,
+    elementwise_add...) — same mechanism, different op list."""
+
+    def __init__(self, scope=None, place=None,
+                 quantizable_ops=("pool2d", "elementwise_add"),
+                 **kwargs):
+        super().__init__(scope, place,
+                         quantizable_ops=quantizable_ops, **kwargs)

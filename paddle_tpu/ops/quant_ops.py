@@ -1,0 +1,93 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Reference: paddle/fluid/operators/fake_quantize_op.cc
+(FakeQuantizeAbsMax, FakeQuantizeRangeAbsMax,
+FakeQuantizeMovingAverageAbsMax, FakeChannelWiseQuantizeAbsMax) used by
+contrib/slim/quantization/quantization_pass.py.
+
+TPU-native notes: quantize-dequantize stays in float (int8 storage
+happens only at freeze/export time — ConvertToInt8Pass), the
+straight-through estimator is expressed as
+``x + stop_gradient(qdq(x) - x)`` so the generic vjp machinery yields
+the STE backward with no hand-written grad, and the moving-average
+scale is a persistable var updated in-graph (the reference's
+accumulator pattern).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _qdq(x, scale, bit_length):
+    """Quantize-dequantize to ``bit_length`` signed levels at
+    ``scale`` (maps [-scale, scale] onto the int grid)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _ste(x, dequant):
+    # straight-through estimator: identity gradient through the
+    # round/clip (reference: fake_quantize_op grad passes through)
+    return x + lax.stop_gradient(dequant - x)
+
+
+@register("fake_quantize_dequantize_abs_max", ["X"],
+          ["Out", "OutScale"])
+def fake_quantize_dequantize_abs_max(x, *, bit_length=8):
+    """Dynamic per-tensor scale = max|x| each step (the 'abs_max'
+    activation/weight mode)."""
+    scale = jnp.max(jnp.abs(x))
+    return _ste(x, _qdq(x, scale, bit_length)), scale
+
+
+@register("fake_channel_wise_quantize_dequantize_abs_max", ["X"],
+          ["Out", "OutScale"])
+def fake_channel_wise_quantize_dequantize_abs_max(x, *, bit_length=8,
+                                                 quant_axis=0):
+    """Per-output-channel scales for weights (the
+    'channel_wise_abs_max' weight mode)."""
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    out = _ste(x, _qdq(x, scale, bit_length))
+    return out, scale.reshape(-1)
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max",
+          ["X", "InScale"], ["Out", "OutScale"],
+          nondiff=("InScale",))
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, *, bit_length=8, moving_rate=0.9, is_test=False):
+    """Activation quantization with a running abs-max scale
+    (reference: FakeQuantizeMovingAverageAbsMax): scale_t =
+    rate * scale_{t-1} + (1-rate) * max|x|; at test time the frozen
+    scale is used as-is."""
+    if is_test:
+        scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x))
+        scale = jnp.where(in_scale > 0,
+                          moving_rate * in_scale +
+                          (1.0 - moving_rate) * cur, cur)
+    out = _ste(x, _qdq(x, lax.stop_gradient(scale), bit_length))
+    return out, scale
+
+
+@register("dequantize_weight", ["X", "Scale"], ["Out"],
+          nondiff=("Scale",))
+def dequantize_weight(x, scale, *, bit_length=8, quant_axis=0):
+    """int8 weight -> float (inference path after the freeze pass).
+    Per-channel when Scale has >1 element, broadcasting along
+    ``quant_axis``."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    xf = x.astype(jnp.float32)
+    if scale.ndim and scale.shape[0] > 1:
+        shape = [1] * xf.ndim
+        shape[quant_axis] = scale.shape[0]
+        return xf * scale.reshape(shape) / qmax
+    return xf * scale / qmax

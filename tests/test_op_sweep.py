@@ -330,9 +330,7 @@ spec("lookup_table", {"W": sgn((5, 3), 114),
 spec("embedding_bag", {"W": sgn((5, 3), 115),
                        "Ids": np.array([[1, 2], [0, 4]], np.int64)},
      {"mode": "sum"},
-     ref=lambda ins: [ins["W"][[1, 2]].sum(0)[None].repeat(1, 0)
-                      if False else
-                      np.stack([ins["W"][[1, 2]].sum(0),
+     ref=lambda ins: [np.stack([ins["W"][[1, 2]].sum(0),
                                 ins["W"][[0, 4]].sum(0)])])
 spec("dropout", {"X": u((2, 3), 116)}, {"is_test": True},
      ref=lambda ins: [ins["X"] * 0.5], grad=[])  # train mode is rng-driven
@@ -514,6 +512,24 @@ spec("randint", {}, {"shape": (64,), "low": 0, "high": 5},
      ref=None, custom="random_int")
 spec("randperm", {}, {"n": 16}, ref=None, custom="random_perm")
 
+
+def _np_qdq(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    import numpy as _np
+    s_ = max(float(scale), 1e-8)
+    return _np.clip(_np.round(x / s_ * qmax), -qmax, qmax) * s_ / qmax
+
+
+_qx = sgn((2, 3), 210)
+spec("fake_quantize_dequantize_abs_max", {"X": _qx},
+     ref=lambda ins: [_np_qdq(ins["X"], np.abs(ins["X"]).max()),
+                      np.abs(ins["X"]).max()],
+     grad=[])  # STE grad is identity by design; numeric sees steps
+spec("dequantize_weight",
+     {"X": np.array([[127, -127], [64, 0]], np.int8),
+      "Scale": f32(0.5)},
+     ref=lambda ins: [ins["X"].astype(np.float32) * 0.5 / 127.0])
+
 # Ops exercised end-to-end in dedicated test files (the table must
 # still account for them — the ratchet below fails on unlisted ops).
 EXEMPT = {
@@ -563,6 +579,10 @@ EXEMPT = {
     "yolo_box": "test_detection.py",
     "yolov3_loss": "test_detection.py (convergence + grad flow)",
     "ssd_loss": "test_detection.py (convergence + grad flow)",
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        "test_quantization.py (QAT channel-wise + freeze parity)",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "test_quantization.py (QAT convergence + freeze)",
 }
 
 

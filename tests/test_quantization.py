@@ -1,0 +1,143 @@
+"""Quantization-aware training tests (reference analog:
+slim/tests/test_quantization_pass.py).
+
+QAT must converge within ~1% of fp32 on the synthetic-mnist task, and
+the freeze pass must produce an int8-weight inference program whose
+predictions match the QAT eval program.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.contrib.slim.quantization import (
+    QuantizationFreezePass, QuantizationTransformPass)
+from paddle_tpu.models import mnist
+
+
+def _synthetic_batch(rng, batch=64):
+    label = rng.randint(0, 10, size=(batch, 1)).astype(np.int64)
+    img = rng.rand(batch, 784).astype(np.float32) * 0.1
+    for i in range(batch):
+        k = int(label[i, 0])
+        img[i, k * 78:(k + 1) * 78] += 1.0
+    return img, label
+
+
+def _build(quantize, seed=42, act_type="moving_average_abs_max",
+           weight_type="abs_max"):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred, avg_loss, acc = mnist.mlp(img, label)
+        test_prog = main.clone(for_test=True)
+        if quantize:
+            pass_ = QuantizationTransformPass(
+                activation_quantize_type=act_type,
+                weight_quantize_type=weight_type)
+            n = pass_.apply(main, startup, is_test=False)
+            assert n >= 3, "expected fc weights+activations quantized"
+            pass_t = QuantizationTransformPass(
+                activation_quantize_type=act_type,
+                weight_quantize_type=weight_type)
+            pass_t.apply(test_prog, None, is_test=True)
+        optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+    return main, startup, test_prog, avg_loss, acc, pred
+
+
+def _train(main, startup, avg_loss, acc, scope, steps=60):
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _ in range(steps):
+            iv, lv = _synthetic_batch(rng)
+            _, acc_v = exe.run(main, feed={"img": iv, "label": lv},
+                               fetch_list=[avg_loss, acc])
+        # eval accuracy on fresh batches
+        accs = []
+        for _ in range(5):
+            iv, lv = _synthetic_batch(rng)
+            (_, acc_v) = exe.run(main, feed={"img": iv, "label": lv},
+                                 fetch_list=[avg_loss, acc])
+            accs.append(float(acc_v))
+    return float(np.mean(accs))
+
+
+class TestQAT:
+    def test_qat_converges_close_to_fp32(self):
+        m, s, _, l, a, _ = _build(False)
+        fp32 = _train(m, s, l, a, fluid.Scope())
+        main, startup, _, avg_loss, acc, _ = _build(True)
+        qat = _train(main, startup, avg_loss, acc, fluid.Scope())
+        assert qat >= fp32 - 0.01, (fp32, qat)
+
+    def test_qat_abs_max_channelwise(self):
+        main, startup, _, avg_loss, acc, _ = _build(
+            True, act_type="abs_max",
+            weight_type="channel_wise_abs_max")
+        qat = _train(main, startup, avg_loss, acc, fluid.Scope(),
+                     steps=40)
+        assert qat > 0.9, qat
+
+    def test_transform_inserts_expected_ops(self):
+        main, startup, test_prog, *_ = _build(True)
+        types = [op.type for op in main.global_block().ops]
+        assert "fake_quantize_dequantize_abs_max" in types
+        assert ("fake_quantize_dequantize_moving_average_abs_max"
+                in types)
+        # test program froze the activation scales
+        for op in test_prog.global_block().ops:
+            if op.type == ("fake_quantize_dequantize_"
+                           "moving_average_abs_max"):
+                assert op.attrs["is_test"] is True
+
+    def test_freeze_int8_and_parity(self, tmp_path):
+        """Freeze → int8 weights in scope; frozen program predictions
+        match the QAT eval program; save/load round-trips."""
+        scope = fluid.Scope()
+        main, startup, test_prog, avg_loss, acc, pred = _build(True)
+        _train(main, startup, avg_loss, acc, scope, steps=50)
+        rng = np.random.RandomState(7)
+        iv, lv = _synthetic_batch(rng)
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            (before,) = exe.run(test_prog,
+                                feed={"img": iv, "label": lv},
+                                fetch_list=[pred])
+            freeze = QuantizationFreezePass(scope=scope)
+            n = freeze.apply(test_prog)
+            assert n >= 2, "fc weights should freeze to int8"
+            # weights became int8 in the scope
+            w_names = [v.name for v in
+                       test_prog.global_block().all_parameters()
+                       if v.dtype == "int8"]
+            assert w_names
+            for name in w_names:
+                assert np.asarray(
+                    scope.find_var(name)).dtype == np.int8
+            (after,) = exe.run(test_prog,
+                               feed={"img": iv, "label": lv},
+                               fetch_list=[pred])
+            # int8-weight program agrees with the fake-quant program
+            np.testing.assert_allclose(before, after, atol=1e-3)
+            assert (np.argmax(before, 1) == np.argmax(after, 1)).all()
+
+            # int8 export via save_inference_model round-trips
+            d = str(tmp_path / "int8")
+            fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                          test_prog)
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor()
+            prog2, feeds, fetches = fluid.io.load_inference_model(
+                d, exe2)
+            (reloaded,) = exe2.run(prog2, feed={"img": iv},
+                                   fetch_list=fetches)
+            np.testing.assert_allclose(after, reloaded, atol=1e-5)

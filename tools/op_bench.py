@@ -95,10 +95,11 @@ def bench_op(op_type, np_inputs, attrs, iters=200, warmup=20,
         old = FLAGS.op_library
         FLAGS.op_library = lib or ""
         try:
-            out = run()
-            for _ in range(warmup - 1):
+            out = None
+            for _ in range(warmup):
                 out = run()
-            jax.block_until_ready(out)
+            if out is not None:
+                jax.block_until_ready(out)
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = run()

@@ -38,6 +38,9 @@ PUBLIC_MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.transpiler",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.slim.quantization",
+    "paddle_tpu.recordio",
+    "paddle_tpu.dataset_factory",
     "paddle_tpu.incubate.fleet.base.role_maker",
     "paddle_tpu.incubate.fleet.collective",
     "paddle_tpu.incubate.fleet.parameter_server",
