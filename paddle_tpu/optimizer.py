@@ -159,6 +159,9 @@ class Optimizer:
         self._create_global_learning_rate()
         self._create_accumulators(
             block, [p for p, g in params_grads if g is not None])
+        # subclasses that append EXTRA stateful ops (DGC's u/v + step
+        # counter) must gate them too — exposed for _append_optimize_op
+        self._accum_gate = gate
         optimize_ops = []
         for pg in params_grads:
             if pg[1] is None:
@@ -167,6 +170,7 @@ class Optimizer:
             if gate is not None and op is not None:
                 op.attrs["gate"] = gate.name
             optimize_ops.append(op)
+        self._accum_gate = None
         self._finish_update(block, params_grads)
         return optimize_ops
 
@@ -270,6 +274,91 @@ class LarsMomentumOptimizer(Optimizer):
                    "lars_coeff": self._lars_coeff,
                    "lars_weight_decay": self._lars_weight_decay,
                    "op_role": "optimize"})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Deep Gradient Compression momentum (reference: optimizer.py:786
+    DGCMomentumOptimizer; details/sparse_all_reduce_op_handle.h;
+    arXiv:1712.01887). Sparsifies each parameter's update to the
+    top-(1 - sparsity) entries of the locally-accumulated
+    momentum-corrected gradient; the residual accumulates until it
+    matters. See the ``dgc`` op for the TPU-native formulation (the
+    GSPMD psum replaces the NCCL sparse allreduce)."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum, use_nesterov,
+                         regularization, name)
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = int(rampup_step)
+        self._sparsity = tuple(float(s) for s in sparsity)
+        self._local_grad_clip_norm = local_grad_clip_norm
+        self._step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        gate = getattr(self, "_accum_gate", None)
+        if self._step_var is None:
+            self._step_var = tensor_layers.create_global_var(
+                shape=(), value=0.0, dtype="int32", persistable=True,
+                name=unique_name.generate("dgc_step"))
+            counter_op = block.append_op(
+                type="cum_step_counter",
+                inputs={"X": [self._step_var]},
+                outputs={"Out": [self._step_var]},
+                attrs={"op_role": "optimize"})
+            if gate is not None:
+                # under gradient accumulation the DGC step advances
+                # once per APPLIED update, not per micro-step
+                counter_op.attrs["gate"] = gate.name
+        if self._local_grad_clip_norm is not None:
+            clipped = block.create_var(
+                name=unique_name.generate(grad.name + ".dgc_clip"),
+                shape=tuple(param.shape), dtype=grad.dtype,
+                stop_gradient=True)
+            block.append_op(
+                type="clip_by_norm", inputs={"X": [grad]},
+                outputs={"Out": [clipped]},
+                attrs={"max_norm": float(self._local_grad_clip_norm),
+                       "op_role": "optimize"})
+            grad = clipped
+        u = self._get_accumulator("dgc_u", param)
+        v = self._get_accumulator("dgc_v", param)
+        encoded = block.create_var(
+            name=unique_name.generate(grad.name + ".dgc_encoded"),
+            shape=tuple(param.shape), dtype=grad.dtype,
+            stop_gradient=True)
+        dgc_op = block.append_op(
+            type="dgc",
+            inputs={"U": [u], "V": [v], "Grad": [grad],
+                    "CurrentStep": [self._step_var]},
+            outputs={"UOut": [u], "VOut": [v],
+                     "EncodedGrad": [encoded]},
+            attrs={"m": self._momentum,
+                   "sparsity": self._sparsity,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "rampup_step": self._rampup_step,
+                   "use_nesterov": self._use_nesterov,
+                   "op_role": "optimize"})
+        if gate is not None:
+            # u/v accumulators must only advance on the apply step
+            dgc_op.attrs["gate"] = gate.name
+        # momentum correction folded into u: the final apply is plain
+        # sgd on the (sparse) encoded update
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [param], "Grad": [encoded],
+                    "LearningRate": [self._create_param_lr(
+                        param_and_grad)]},
+            outputs={"ParamOut": [param]},
+            attrs={"op_role": "optimize"})
 
 
 class AdagradOptimizer(Optimizer):
@@ -745,6 +834,7 @@ class ExponentialMovingAverage:
 # fluid-style aliases (reference exports both names)
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
+DGCMomentum = DGCMomentumOptimizer
 Adagrad = AdagradOptimizer
 Adam = AdamOptimizer
 AdamW = AdamWOptimizer

@@ -227,3 +227,95 @@ class TestModelAverage:
                     _param(), np.mean(snapshots, axis=0),
                     rtol=1e-5, atol=1e-6)
             np.testing.assert_allclose(_param(), raw, rtol=1e-6)
+
+
+class TestDGC:
+    def _run(self, opt_fn, steps, scope, seed=21):
+        with fluid.scope_guard(scope):
+            main, startup, loss, w = _linear_model(opt_fn(), seed=seed)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(1)
+            w_true = rs.rand(4, 1).astype(np.float32)
+            losses = []
+            for _ in range(steps):
+                x = rs.rand(2, 4).astype(np.float32)
+                y = x @ w_true
+                (lv,) = exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss])
+                losses.append(float(lv))
+            return losses, _param().copy()
+
+    def test_pre_rampup_equals_momentum(self):
+        """Before rampup_begin_step DGC must follow vanilla momentum
+        exactly (the reference switches to the plain momentum path)."""
+        dgc_losses, dgc_w = self._run(
+            lambda: optimizer.DGCMomentumOptimizer(
+                0.1, 0.9, rampup_begin_step=1000), 8, fluid.Scope())
+        mom_losses, mom_w = self._run(
+            lambda: optimizer.Momentum(0.1, 0.9), 8, fluid.Scope())
+        np.testing.assert_allclose(dgc_losses, mom_losses, rtol=1e-5)
+        np.testing.assert_allclose(dgc_w, mom_w, rtol=1e-5)
+
+    def test_pre_rampup_equals_momentum_nesterov(self):
+        dgc_losses, dgc_w = self._run(
+            lambda: optimizer.DGCMomentumOptimizer(
+                0.1, 0.9, rampup_begin_step=1000, use_nesterov=True),
+            8, fluid.Scope())
+        mom_losses, mom_w = self._run(
+            lambda: optimizer.Momentum(0.1, 0.9, use_nesterov=True),
+            8, fluid.Scope())
+        np.testing.assert_allclose(dgc_losses, mom_losses, rtol=1e-5)
+        np.testing.assert_allclose(dgc_w, mom_w, rtol=1e-5)
+
+    def test_dgc_with_accumulation_state_gated(self):
+        """Under accumulate_steps the DGC step counter and u/v
+        accumulators advance once per APPLIED update (regression: they
+        used to advance every micro-step)."""
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss, w = _linear_model(
+                optimizer.DGCMomentumOptimizer(
+                    0.1, 0.9, rampup_begin_step=1000),
+                accumulate_steps=2)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(2)
+            for _ in range(4):  # 2 windows
+                exe.run(main, feed={"x": rs.rand(2, 4).astype(
+                    np.float32), "y": rs.rand(2, 1).astype(
+                        np.float32)}, fetch_list=[loss])
+            step_vars = [n for n in main.global_block().vars
+                         if n.startswith("dgc_step")]
+            assert step_vars
+            assert int(np.asarray(
+                scope.find_var(step_vars[0]))) == 2
+
+    def test_post_rampup_converges_sparsified(self):
+        """With compression active from step 0, training still
+        converges (residual accumulation keeps information)."""
+        losses, _ = self._run(
+            lambda: optimizer.DGCMomentumOptimizer(
+                0.1, 0.9, rampup_begin_step=0, rampup_step=1,
+                sparsity=[0.5]), 60, fluid.Scope())
+        assert losses[-1] < losses[0] * 0.3, losses[::10]
+
+    def test_encoded_sparsity_ratio(self):
+        """The dgc op emits ~ (1-s) nonzero entries post-rampup."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.optimizer_ops import dgc
+        rs = np.random.RandomState(0)
+        g = jnp.asarray(rs.randn(32, 32).astype(np.float32))
+        u = jnp.zeros_like(g)
+        v = jnp.zeros_like(g)
+        step = jnp.asarray(10, jnp.int32)
+        u2, v2, enc = dgc(u, v, g, step, m=0.9, sparsity=(0.75,),
+                          rampup_begin_step=0, rampup_step=1)
+        frac = float((np.asarray(enc) != 0).mean())
+        assert 0.2 <= frac <= 0.3, frac  # ~25% kept
+        # residual: masked-out grads stay accumulated in v
+        assert float(np.abs(np.asarray(v2)).sum()) > 0
+        # communicated entries were cleared from the accumulators
+        nz = np.asarray(enc) != 0
+        assert (np.asarray(v2)[nz] == 0).all()
+        assert (np.asarray(u2)[nz] == 0).all()

@@ -561,6 +561,7 @@ EXEMPT = {
     "proximal_gd": "test_optimizers.py",
     "rmsprop": "test_optimizers.py",
     "ema_update": "test_average_ema.py",
+    "dgc": "test_average_ema.py (momentum parity, sparsity ratio, residual)",
     "average_accumulates": "test_average_ema.py",
     "accuracy": "test_metrics.py",
     "auc": "test_metrics.py",
