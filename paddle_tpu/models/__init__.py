@@ -6,6 +6,8 @@ python/paddle/fluid/tests/book/)."""
 from . import bert  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import mnist  # noqa: F401
+from . import recommender  # noqa: F401
 from . import resnet  # noqa: F401
 from . import transformer  # noqa: F401
 from . import vgg  # noqa: F401
+from . import word2vec  # noqa: F401

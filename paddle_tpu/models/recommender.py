@@ -1,0 +1,91 @@
+"""Recommender system (the book model).
+
+Reference: python/paddle/fluid/tests/book/test_recommender_system.py —
+user tower (user id / gender / age / job embeddings → fc) and item
+tower (movie id embedding + title mean-pooled bag of words → fc),
+combined by cosine similarity, trained with square error against the
+rating. Exercises multi-input embedding fusion + the metric head.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+
+USR_VOCAB = 200
+GENDER_VOCAB = 2
+AGE_VOCAB = 7
+JOB_VOCAB = 21
+MOV_VOCAB = 300
+TITLE_VOCAB = 500
+TITLE_LEN = 6
+
+
+def _tower(feats, size=200):
+    fcs = [layers.fc(f, size=size, act="relu") for f in feats]
+    merged = fcs[0]
+    for f in fcs[1:]:
+        merged = layers.elementwise_add(merged, f)
+    return layers.fc(merged, size=size, act="tanh")
+
+
+def recommender(embed_size=16):
+    """Returns (feed var list, rating label, avg cost, inferred
+    score)."""
+    usr = layers.data("user_id", shape=[1], dtype="int64")
+    gender = layers.data("gender_id", shape=[1], dtype="int64")
+    age = layers.data("age_id", shape=[1], dtype="int64")
+    job = layers.data("job_id", shape=[1], dtype="int64")
+    mov = layers.data("movie_id", shape=[1], dtype="int64")
+    title = layers.data("title_ids", shape=[TITLE_LEN], dtype="int64")
+
+    usr_feats = [
+        layers.embedding(usr, (USR_VOCAB, embed_size)),
+        layers.embedding(gender, (GENDER_VOCAB, embed_size)),
+        layers.embedding(age, (AGE_VOCAB, embed_size)),
+        layers.embedding(job, (JOB_VOCAB, embed_size)),
+    ]
+    usr_vec = _tower(usr_feats)
+
+    mov_emb = layers.embedding(mov, (MOV_VOCAB, embed_size))
+    # title: bag of words, mean-pooled (the reference sequence_pools a
+    # LoD title; padded redesign pools the fixed-width id window)
+    title_emb = layers.embedding(title, (TITLE_VOCAB, embed_size))
+    title_vec = layers.reduce_mean(title_emb, dim=1)
+    mov_vec = _tower([mov_emb, title_vec])
+
+    # scaled cosine similarity -> rating scale [0, 5]
+    prod = layers.reduce_sum(
+        layers.elementwise_mul(usr_vec, mov_vec), dim=1,
+        keep_dim=True)
+    un = layers.sqrt(layers.reduce_sum(
+        layers.square(usr_vec), dim=1, keep_dim=True))
+    mn = layers.sqrt(layers.reduce_sum(
+        layers.square(mov_vec), dim=1, keep_dim=True))
+    cos = prod / (un * mn + 1e-6)
+    scale_infer = layers.scale(cos, scale=5.0)
+
+    rating = layers.data("score", shape=[1], dtype="float32")
+    cost = layers.square_error_cost(input=scale_infer, label=rating)
+    avg_cost = layers.reduce_mean(cost)
+    feeds = [usr, gender, age, job, mov, title]
+    return feeds, rating, avg_cost, scale_infer
+
+
+def make_fake_batch(batch, seed=0):
+    rs = np.random.RandomState(seed)
+    user = rs.randint(0, USR_VOCAB, (batch, 1)).astype(np.int64)
+    movie = rs.randint(0, MOV_VOCAB, (batch, 1)).astype(np.int64)
+    # rating depends deterministically on (user, movie) → learnable
+    score = ((user * 31 + movie * 17) % 6).astype(np.float32)
+    return {
+        "user_id": user,
+        "gender_id": (user % GENDER_VOCAB).astype(np.int64),
+        "age_id": (user % AGE_VOCAB).astype(np.int64),
+        "job_id": (user % JOB_VOCAB).astype(np.int64),
+        "movie_id": movie,
+        "title_ids": ((movie * np.arange(1, TITLE_LEN + 1))
+                      % TITLE_VOCAB).astype(np.int64),
+        "score": score,
+    }

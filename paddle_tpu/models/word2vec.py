@@ -1,0 +1,48 @@
+"""Word2vec N-gram language model (the book model).
+
+Reference: python/paddle/fluid/tests/book/test_word2vec.py — four
+context-word embeddings (shared table) concat → fc(hidden) →
+fc(softmax over vocab), trained with cross entropy. The book uses this
+to validate embedding + shared-parameter machinery end to end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ParamAttr, layers
+
+
+def ngram_lm(vocab_size, embed_size=32, hidden_size=256,
+             context_words=None, is_sparse=False):
+    """Build the N-gram LM; returns (context data vars, next-word
+    label var, avg cost, prediction). All context embeddings share ONE
+    table (the reference passes the same param name for each)."""
+    if context_words is None:
+        context_words = ["firstw", "secondw", "thirdw", "fourthw"]
+    embeds = []
+    ctx_vars = []
+    for name in context_words:
+        w = layers.data(name, shape=[1], dtype="int64")
+        ctx_vars.append(w)
+        embeds.append(layers.embedding(
+            w, size=(vocab_size, embed_size), is_sparse=is_sparse,
+            param_attr=ParamAttr(name="shared_w")))
+    next_word = layers.data("nextw", shape=[1], dtype="int64")
+    concat = layers.concat(embeds, axis=1)
+    hidden = layers.fc(concat, size=hidden_size, act="sigmoid")
+    predict = layers.fc(hidden, size=vocab_size, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=next_word)
+    avg_cost = layers.reduce_mean(cost)
+    return ctx_vars, next_word, avg_cost, predict
+
+
+def make_fake_batch(vocab_size, batch, seed=0):
+    """Deterministic synthetic corpus: next word = (sum of context
+    words) % vocab — learnable by the model, unlike pure noise."""
+    rs = np.random.RandomState(seed)
+    ctx = rs.randint(0, vocab_size, size=(batch, 4)).astype(np.int64)
+    nxt = (ctx.sum(axis=1) % vocab_size).astype(np.int64)
+    return {"firstw": ctx[:, 0:1], "secondw": ctx[:, 1:2],
+            "thirdw": ctx[:, 2:3], "fourthw": ctx[:, 3:4],
+            "nextw": nxt[:, None]}

@@ -295,6 +295,7 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         self._rampup_step = int(rampup_step)
         self._sparsity = tuple(float(s) for s in sparsity)
         self._local_grad_clip_norm = local_grad_clip_norm
+        self._num_trainers = num_trainers
         self._step_var = None
 
     def _create_accumulators(self, block, parameters):
@@ -326,7 +327,10 @@ class DGCMomentumOptimizer(MomentumOptimizer):
             block.append_op(
                 type="clip_by_norm", inputs={"X": [grad]},
                 outputs={"Out": [clipped]},
-                attrs={"max_norm": float(self._local_grad_clip_norm),
+                attrs={"max_norm":
+                       float(self._local_grad_clip_norm) *
+                       (float(self._num_trainers) ** -0.5
+                        if self._num_trainers else 1.0),
                        "op_role": "optimize"})
             grad = clipped
         u = self._get_accumulator("dgc_u", param)

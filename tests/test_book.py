@@ -1,0 +1,215 @@
+"""End-to-end "book" tests: every tutorial model family trains to a
+loss threshold, saves an inference model, reloads it in a FRESH scope
+and reproduces its predictions.
+
+Reference: python/paddle/fluid/tests/book/ (test_fit_a_line,
+test_recognize_digits, test_image_classification, test_word2vec,
+test_recommender_system, test_machine_translation,
+test_understand_sentiment) — each trains then save+reload+infer
+(e.g. test_fit_a_line.py infer()).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _train_save_reload(build_fn, feeder, feed_names, steps, tmp_path,
+                       lr=1e-2, loss_ratio=0.5, opt=None, seed=3):
+    """Shared book harness. build_fn() -> (loss var, infer var);
+    feeder(step) -> feed dict. Returns nothing; asserts convergence
+    and reload parity."""
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            loss, infer_var = build_fn()
+            test_prog = main.clone(for_test=True)
+            (opt or optimizer.Adam(lr)).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for step in range(steps):
+            (lv,) = exe.run(main, feed=feeder(step),
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * loss_ratio, losses[::10]
+
+        feed = feeder(0)
+        infer_feed = {k: v for k, v in feed.items()
+                      if k in feed_names}
+        (want,) = exe.run(test_prog, feed=feed,
+                          fetch_list=[infer_var])
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, feed_names, [infer_var],
+                                      exe, test_prog)
+    # fresh scope: nothing from training may leak
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor()
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe2)
+        (got,) = exe2.run(prog, feed=infer_feed, fetch_list=fetches)
+    np.testing.assert_allclose(want, got, rtol=1e-4, atol=1e-5)
+
+
+class TestBook:
+    def test_fit_a_line(self, tmp_path):
+        """test_fit_a_line.py: linear regression on 13 features."""
+        rs = np.random.RandomState(0)
+        w_true = rs.rand(13, 1).astype(np.float32)
+
+        def build():
+            x = layers.data("x", shape=[13])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1)
+            loss = layers.reduce_mean(
+                layers.square_error_cost(input=pred, label=y))
+            return loss, pred
+
+        def feeder(step):
+            x = rs.rand(32, 13).astype(np.float32)
+            return {"x": x, "y": x @ w_true}
+
+        _train_save_reload(build, feeder, ["x"], 60, tmp_path,
+                           loss_ratio=0.1)
+
+    def test_recognize_digits(self, tmp_path):
+        """test_recognize_digits.py (the mnist book chapter)."""
+        from paddle_tpu.models import mnist
+        rs = np.random.RandomState(0)
+
+        def build():
+            img = layers.data("img", shape=[784])
+            label = layers.data("label", shape=[1], dtype="int64")
+            pred, avg_loss, _acc = mnist.mlp(img, label)
+            return avg_loss, pred
+
+        def feeder(step):
+            label = rs.randint(0, 10, (64, 1)).astype(np.int64)
+            img = rs.rand(64, 784).astype(np.float32) * 0.1
+            for i in range(64):
+                k = int(label[i, 0])
+                img[i, k * 78:(k + 1) * 78] += 1.0
+            return {"img": img, "label": label}
+
+        _train_save_reload(build, feeder, ["img"], 40, tmp_path,
+                           lr=1e-3)
+
+    def test_image_classification(self, tmp_path):
+        """test_image_classification.py — conv net on small images
+        (vgg-style tower at toy scale)."""
+        rs = np.random.RandomState(0)
+
+        def build():
+            img = layers.data("img", shape=[3, 16, 16])
+            label = layers.data("label", shape=[1], dtype="int64")
+            h = layers.conv2d(img, 16, 3, padding=1, act="relu")
+            h = layers.pool2d(h, 2, "max", 2)
+            h = layers.conv2d(h, 32, 3, padding=1, act="relu")
+            h = layers.pool2d(h, 2, "max", 2)
+            pred = layers.fc(layers.fc(h, 64, act="relu"), 4,
+                             act="softmax")
+            loss = layers.reduce_mean(
+                layers.cross_entropy(input=pred, label=label))
+            return loss, pred
+
+        def feeder(step):
+            label = rs.randint(0, 4, (32, 1)).astype(np.int64)
+            img = rs.rand(32, 3, 16, 16).astype(np.float32) * 0.1
+            for i in range(32):
+                k = int(label[i, 0])
+                img[i, :, k * 4:(k + 1) * 4, :] += 1.0
+            return {"img": img, "label": label}
+
+        _train_save_reload(build, feeder, ["img"], 50, tmp_path,
+                           lr=2e-3)
+
+    def test_word2vec(self, tmp_path):
+        """test_word2vec.py: shared-table N-gram LM."""
+        from paddle_tpu.models import word2vec as W
+        vocab = 50
+
+        def build():
+            _, _, avg_cost, predict = W.ngram_lm(
+                vocab, embed_size=16, hidden_size=64)
+            return avg_cost, predict
+
+        def feeder(step):
+            return W.make_fake_batch(vocab, 64, seed=step % 4)
+
+        _train_save_reload(
+            build, feeder,
+            ["firstw", "secondw", "thirdw", "fourthw"], 120,
+            tmp_path, lr=5e-3)
+
+    def test_recommender_system(self, tmp_path):
+        """test_recommender_system.py: two-tower embedding fusion."""
+        from paddle_tpu.models import recommender as R
+
+        def build():
+            feeds, rating, avg_cost, score = R.recommender()
+            return avg_cost, score
+
+        def feeder(step):
+            return R.make_fake_batch(64, seed=step % 4)
+
+        _train_save_reload(
+            build, feeder,
+            ["user_id", "gender_id", "age_id", "job_id", "movie_id",
+             "title_ids"], 100, tmp_path, lr=2e-3, loss_ratio=0.6)
+
+    def test_machine_translation(self, tmp_path):
+        """test_machine_translation.py — NMT; the flagship Transformer
+        at toy scale (the RNN seq2seq chapter's modern equivalent;
+        dynamic_lstm itself is covered by test_understand_sentiment
+        and test_sequence_rnn.py)."""
+        from paddle_tpu.models import transformer as T
+        cfg = T.TransformerConfig(
+            src_vocab=60, tgt_vocab=60, max_len=12, d_model=32,
+            d_ffn=64, n_head=2, n_layer=1, dropout=0.0)
+
+        def build():
+            avg_cost, _tok, logits = T.transformer(cfg)
+            return avg_cost, logits
+
+        def feeder(step):
+            return T.make_fake_batch(cfg, 8, seed=step % 3)
+
+        _train_save_reload(
+            build, feeder,
+            ["src_ids", "tgt_ids", "lbl_ids", "src_mask", "tgt_mask"],
+            60, tmp_path, lr=2e-3, loss_ratio=0.8)
+
+    def test_understand_sentiment(self, tmp_path):
+        """notest_understand_sentiment.py: LSTM text classifier."""
+        rs = np.random.RandomState(0)
+        vocab, seqlen = 80, 10
+
+        def build():
+            words = layers.data("words", shape=[seqlen],
+                                dtype="int64")
+            lens = layers.data("lens", shape=[1], dtype="int64")
+            label = layers.data("label", shape=[1], dtype="int64")
+            emb = layers.embedding(words, (vocab, 32))
+            lens1 = layers.reshape(lens, (-1,))
+            fwd, _cell = layers.dynamic_lstm(
+                layers.fc(emb, size=128, num_flatten_dims=2),
+                size=128, seq_len=lens1)
+            last = layers.sequence_last_step(fwd, lens1)
+            pred = layers.fc(last, size=2, act="softmax")
+            loss = layers.reduce_mean(
+                layers.cross_entropy(input=pred, label=label))
+            return loss, pred
+
+        def feeder(step):
+            words = rs.randint(0, vocab, (32, seqlen)).astype(np.int64)
+            lens = rs.randint(3, seqlen + 1, (32, 1)).astype(np.int64)
+            # sentiment = parity of the first word (learnable)
+            label = (words[:, :1] % 2).astype(np.int64)
+            return {"words": words, "lens": lens, "label": label}
+
+        _train_save_reload(build, feeder, ["words", "lens"], 80,
+                           tmp_path, lr=3e-3, loss_ratio=0.6)
