@@ -15,10 +15,26 @@ Runs on whatever backend JAX sees (the driver provides the real chip).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+_T0 = time.time()
+# Soft wall-clock budget: the optional pallas re-timing is skipped once
+# exceeded, so one slow compile (cold tunnel) degrades the measurement
+# instead of timing out the whole bench run.
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
+
+
+def _log(msg):
+    print("[bench +%6.1fs] %s" % (time.time() - _T0, msg),
+          file=sys.stderr, flush=True)
+
+
+def _over_budget():
+    return time.time() - _T0 > _BUDGET_S
 
 # bf16 peak matmul FLOP/s by PJRT device kind. MFU is reported only
 # when the device is recognized (CPU runs get mfu=null).
@@ -85,9 +101,16 @@ def _best_library(run_step, warmup, iters):
         finally:
             FLAGS.op_library = prev
 
+    _log("timing base library")
     base = timed("")
+    _log("base done: %.3f steps/s" % base)
+    if _over_budget():
+        _log("time budget exceeded — skipping pallas comparison")
+        return base
     try:
+        _log("timing pallas library")
         pallas = timed("pallas")
+        _log("pallas done: %.3f steps/s" % pallas)
     except Exception as e:
         print("pallas path failed, using base: %r" % e, file=sys.stderr)
         pallas = 0.0
@@ -115,6 +138,8 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10):
     from paddle_tpu.contrib import mixed_precision as amp
     from paddle_tpu.models import transformer as T
 
+    _log("building transformer-base program")
+
     cfg = T.TransformerConfig(src_vocab=30000, tgt_vocab=30000,
                               max_len=seq_len, d_model=512, d_ffn=2048,
                               n_head=8, n_layer=6, dropout=0.1)
@@ -125,7 +150,9 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10):
         opt = amp.decorate(fluid.optimizer.AdamOptimizer(1e-3))
         opt.minimize(avg_cost)
     exe = fluid.Executor()
+    _log("running startup (first device contact)")
     exe.run(startup)
+    _log("startup done")
     feed = T.make_fake_batch(cfg, batch)
     tokens_per_step = float(feed["tgt_mask"].sum())
 
@@ -280,6 +307,19 @@ def bench_deepfm(batch=4096, warmup=3, iters=20):
 
 
 def main():
+    import jax
+    # persistent compile cache: a prior bench run (same binary, same
+    # device) makes later runs skip the multi-minute cold compiles
+    try:
+        cache_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+    except Exception as e:
+        _log("compile cache unavailable: %r" % e)
+    _log("claiming device...")
+    _log("device: %s" % jax.devices()[0].device_kind)
     res = bench_transformer()
     mfu = res["mfu"]
     # north star: >=0.40 MFU (>=0.8x A100-class); measured ratio, not a
