@@ -17,6 +17,7 @@ Program path (same layer vocabulary)."""
 from __future__ import annotations
 
 import contextlib
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -150,13 +151,39 @@ def to_variable(value, name=None, zero_copy=None):
 
 
 class _TapeEntry:
-    __slots__ = ("opdef", "attrs", "slot_vals", "out_vbs")
+    """One recorded op. Outputs are held by WEAKREF: an inference
+    output the user drops dies, and the sweep in run_dygraph_op then
+    reclaims the entry (and the device arrays its inputs pin) — the
+    eager analog of the reference freeing per-tensor autograd graphs
+    when tensors die (ADVICE r1: long no-backward loops used to grow
+    the tape unboundedly). Live chains are safe: any output consumed
+    by a later op is strongly referenced by that op's slot_vals."""
+
+    __slots__ = ("opdef", "attrs", "slot_vals", "out_refs")
 
     def __init__(self, opdef, attrs, slot_vals, out_vbs):
         self.opdef = opdef
         self.attrs = attrs
         self.slot_vals = slot_vals  # list aligned with input_slots
-        self.out_vbs = out_vbs      # flattened output VarBases
+        self.out_refs = [weakref.ref(vb) for vb in out_vbs]
+
+    def outs(self):
+        return [r() for r in self.out_refs]
+
+    def dead(self):
+        return all(r() is None for r in self.out_refs)
+
+
+def _sweep_tape():
+    """Drop entries whose every output died — nothing can request
+    gradients through them. Runs to fixpoint: releasing a dead leaf
+    entry drops its strong input refs, which can kill the upstream
+    entry's outputs in turn (chains reclaim back to front)."""
+    while True:
+        pruned = [e for e in _tape if not e.dead()]
+        if len(pruned) == len(_tape):
+            return
+        _tape[:] = pruned
 
 
 def _next_rng():
@@ -237,6 +264,8 @@ def run_dygraph_op(op_type, inputs: Dict[str, List[VarBase]],
 
     if record:
         _tape.append(_TapeEntry(opdef, attrs, slot_vals, out_vbs))
+        if len(_tape) % 256 == 0:
+            _sweep_tape()
 
     if len(outs) == 1:
         return outs[0]
@@ -256,7 +285,9 @@ def backward(loss: VarBase, retain_graph=False):
     touched: Dict[int, VarBase] = {}
 
     for entry in reversed(_tape):
-        if not any(id(vb) in grads for vb in entry.out_vbs):
+        entry_outs = entry.outs()
+        if not any(vb is not None and id(vb) in grads
+                   for vb in entry_outs):
             continue
         opdef, attrs = entry.opdef, entry.attrs
 
@@ -294,11 +325,11 @@ def backward(loss: VarBase, retain_graph=False):
         outs, pull = jax.vjp(fwd, *primals)
         flat_out, tree = jax.tree_util.tree_flatten(outs)
         cots = []
-        for val, vb in zip(flat_out, entry.out_vbs):
-            g = grads.get(id(vb))
+        for val, vb in zip(flat_out, entry_outs):
+            g = grads.get(id(vb)) if vb is not None else None
             cots.append(g if g is not None else jnp.zeros_like(val))
         cots += [jnp.zeros_like(v)
-                 for v in flat_out[len(entry.out_vbs):]]
+                 for v in flat_out[len(entry.out_refs):]]
         in_grads = pull(jax.tree_util.tree_unflatten(tree, cots))
 
         for (i, variadic, vb), g in zip(diff, in_grads):

@@ -206,4 +206,10 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        # NOTE: eval() deliberately does NOT disable tape recording —
+        # gradients must flow THROUGH frozen eval-mode sublayers
+        # (perceptual-loss pattern). Unconsumed inference outputs are
+        # reclaimed by the tape's weakref pruning (base._TapeEntry);
+        # wrap explicit inference loops in no_grad() to skip recording
+        # entirely.
         return self.forward(*inputs, **kwargs)

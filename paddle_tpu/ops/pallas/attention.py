@@ -155,12 +155,27 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
+def _prep_bias(bias, B, H, Sq, Sk):
+    """Normalize an additive mask for the kernels. Returns
+    (bias array, per_head): per-BATCH biases stay [B, 1, Sq, Sk] and
+    grid cell i indexes row i // H; a per-HEAD bias [B, H, Sq, Sk]
+    reshapes to [B*H, 1, Sq, Sk] and indexes row i directly — both
+    paths read the same (1, 1, blk_q, blk_k) block shape, so the
+    kernels are agnostic (the base jnp lowering accepts either; the
+    two library paths must not diverge)."""
+    if bias is None:
+        return None, False
+    if bias.ndim == 4 and bias.shape[1] == H and H > 1:
+        return (jnp.broadcast_to(bias, (B, H, Sq, Sk))
+                .reshape(B * H, 1, Sq, Sk)), True
+    return jnp.broadcast_to(bias, (B, 1, Sq, Sk)), False
+
+
 def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
     B, H, Sq, Dh = q.shape
     Sk = k.shape[2]
     BH = B * H
-    if bias is not None and bias.shape != (B, 1, Sq, Sk):
-        bias = jnp.broadcast_to(bias, (B, 1, Sq, Sk))
+    bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
@@ -178,8 +193,11 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
     ]
     args = [seed, q3, k3, v3]
     if bias is not None:
-        in_specs.append(pl.BlockSpec(
-            (1, 1, blk_q, blk_k), lambda i, j, kk: (i // H, 0, j, kk)))
+        if per_head:
+            bidx = lambda i, j, kk: (i, 0, j, kk)
+        else:
+            bidx = lambda i, j, kk: (i // H, 0, j, kk)
+        in_specs.append(pl.BlockSpec((1, 1, blk_q, blk_k), bidx))
         args.append(bias)
         kernel = _fwd_kernel
     else:
@@ -313,8 +331,7 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
     B, H, Sq, Dh = q.shape
     Sk = k.shape[2]
     BH = B * H
-    if bias is not None and bias.shape != (B, 1, Sq, Sk):
-        bias = jnp.broadcast_to(bias, (B, 1, Sq, Sk))
+    bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
@@ -333,14 +350,15 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
 
     def specs(order):
         """order: 'dq' grid (BH, n_q, n_k) or 'dkv' grid (BH, n_k, n_q)."""
+        brow = (lambda i: i) if per_head else (lambda i: i // H)
         if order == "dq":
             qi = lambda i, j, kk: (i, j, 0)
             ki = lambda i, j, kk: (i, kk, 0)
-            bi = lambda i, j, kk: (i // H, 0, j, kk)
+            bi = lambda i, j, kk: (brow(i), 0, j, kk)
         else:
             qi = lambda i, kk, j: (i, j, 0)
             ki = lambda i, kk, j: (i, kk, 0)
-            bi = lambda i, kk, j: (i // H, 0, j, kk)
+            bi = lambda i, kk, j: (brow(i), 0, j, kk)
         sp = [pl.BlockSpec(memory_space=pltpu.SMEM),
               pl.BlockSpec((1, blk_q, Dh), qi),
               pl.BlockSpec((1, blk_k, Dh), ki),
@@ -429,14 +447,8 @@ _sdpa_flash.defvjp(_sdpa_flash_fwd, _sdpa_flash_bwd)
 def sdpa_pallas(q, k, v, bias, *, scale=1.0, dropout_rate=0.0,
                 causal=False, is_test=False, rng=None):
     rate = 0.0 if is_test else float(dropout_rate)
-    if bias is not None and bias.ndim == 4 and bias.shape[1] not in (
-            1, None) and bias.shape[1] != 1:
-        # per-head bias [B,H,Sq,Sk]: the kernel's BlockSpec shares one
-        # bias slab across a batch row's heads — take the reference
-        # lowering so both libraries accept the same inputs
-        return _sdpa_reference(q, k, v, bias, scale=scale,
-                               dropout_rate=rate, causal=causal,
-                               rng=rng)
+    # per-head bias [B, H, Sq, Sk] is handled natively: _prep_bias
+    # flattens it to one slab per (batch, head) grid row
     if rate > 0.0 and (rng is None or interpret_mode()):
         # the TPU PRNG has no interpreter emulation; CPU tests take the
         # reference path (dropout masks differ across libraries anyway)
