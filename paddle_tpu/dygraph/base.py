@@ -176,14 +176,20 @@ class _TapeEntry:
 
 def _sweep_tape():
     """Drop entries whose every output died — nothing can request
-    gradients through them. Runs to fixpoint: releasing a dead leaf
-    entry drops its strong input refs, which can kill the upstream
-    entry's outputs in turn (chains reclaim back to front)."""
-    while True:
-        pruned = [e for e in _tape if not e.dead()]
-        if len(pruned) == len(_tape):
-            return
-        _tape[:] = pruned
+    gradients through them. One REVERSE pass reclaims whole dead
+    chains in O(n): slots are nulled (releasing the entry object and
+    hence its strong input refs) the moment an entry is found dead,
+    so by the time the scan reaches the predecessor its outputs have
+    already died too."""
+    changed = False
+    for i in range(len(_tape) - 1, -1, -1):
+        e = _tape[i]
+        if e is not None and e.dead():
+            _tape[i] = None
+            changed = True
+        e = None  # drop the local ref so the entry frees NOW
+    if changed:
+        _tape[:] = [e for e in _tape if e is not None]
 
 
 def _next_rng():

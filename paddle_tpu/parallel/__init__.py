@@ -19,5 +19,7 @@ from .mesh import (current_mesh, data_parallel_mesh, make_mesh,  # noqa
                    shard_batch_spec)
 from .api import shard, replicate  # noqa: F401
 from . import ring_attention  # noqa: F401  (registers the op)
+from . import ulysses  # noqa: F401  (registers the op)
 from .ring_attention import ring_attention as ring_attention_fn  # noqa
+from .ulysses import ulysses_attention as ulysses_attention_fn  # noqa
 from . import multihost  # noqa: F401

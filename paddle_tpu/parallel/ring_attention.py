@@ -79,18 +79,14 @@ def ring_attention(q, k, v, mesh=None, axis="sp", scale=1.0,
     shard_map in_specs place them on the sp axis)."""
     from jax.experimental.shard_map import shard_map
 
+    from .ulysses import _full_attention
+
     mesh = mesh or mesh_lib.current_mesh()
     if mesh is None or axis not in mesh.axis_names \
             or mesh.shape[axis] == 1:
-        # no sequence axis in scope: plain fused attention
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        if causal:
-            Sq, Sk = q.shape[2], k.shape[2]
-            q_pos = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
-            k_pos = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG)
-        w = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        # no sequence axis in scope: plain fused attention (shared
+        # with the ulysses fallback so the numerics can't diverge)
+        return _full_attention(q, k, v, scale, causal)
 
     n = mesh.shape[axis]
     spec = PartitionSpec(None, None, axis, None)

@@ -1,0 +1,113 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence
+re-sharding around full attention.
+
+Like ring attention (ring_attention.py), this is a new TPU-first
+capability with no 2019-reference counterpart (SURVEY §5
+"long-context"). Where the ring rotates K/V blocks with ppermute (N-1
+ICI hops, compute overlapped), Ulysses re-shards ONCE each way:
+
+    [B, H, S/n, Dh]  --all_to_all-->  [B, H/n, S, Dh]
+       (sequence-sharded)                (head-sharded)
+
+each device then runs ordinary full attention for its heads (any
+kernel — including the pallas flash path — since the sequence is whole
+again), and a second all-to-all restores sequence sharding. Two
+collectives total, so it wins over the ring when heads divide evenly
+and S^2/n attention fits per device; the ring wins for extreme S.
+Both compose with dp/tp via the mesh axes.
+
+Requires num_heads % sp == 0 (the classic Ulysses constraint).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from ..core.enforce import enforce
+from ..ops.registry import register
+from . import mesh as mesh_lib
+
+_NEG = -1.0e30
+
+
+def _full_attention(q, k, v, scale, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Sq, Sk = q.shape[2], k.shape[2]
+        q_pos = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _attend(q, k, v, scale, causal):
+    """Per-device attention after the re-shard — dispatched through
+    the op registry so FLAGS_op_library=pallas gets the FLASH kernel
+    (O(S*Dh) residuals, no S^2 score matrix in HBM) exactly as the
+    module docstring promises; the base library takes the jnp path."""
+    from ..core.flags import FLAGS
+    from ..ops.registry import get as get_op
+    opdef = get_op("scaled_dot_product_attention")
+    fn = opdef.pick(FLAGS.op_library or None)
+    return fn(q, k, v, None, scale=scale, causal=causal, is_test=True)
+
+
+def ulysses_attention_inner(q, k, v, *, axis_name, scale=1.0,
+                            causal=False):
+    """Per-shard body (inside shard_map): q,k,v local
+    [B, H, S/n, Dh] → all-to-all → full attention on H/n heads →
+    all-to-all back."""
+    # seq-sharded → head-sharded: split heads across the axis, gather
+    # the full sequence
+    q = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                       tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                       tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                       tiled=True)
+    out = _attend(q, k, v, scale, causal)
+    # head-sharded → seq-sharded
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis="sp", scale=1.0,
+                      causal=False):
+    """Global-view entry: q,k,v [B, H, S, Dh]; the shard_map in_specs
+    shard the sequence over ``axis``. Falls back to plain fused
+    attention when no sp axis is in scope (same contract as
+    ring_attention)."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] == 1:
+        return _full_attention(q, k, v, scale, causal)
+    n = mesh.shape[axis]
+    enforce(q.shape[1] % n == 0,
+            "ulysses needs num_heads (%d) divisible by the sp degree "
+            "(%d); use ring_attention otherwise", q.shape[1], n)
+    spec = PartitionSpec(None, None, axis, None)
+    f = shard_map(
+        functools.partial(ulysses_attention_inner, axis_name=axis,
+                          scale=scale, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return f(q, k, v)
+
+
+@register("ulysses_attention", ["Q", "K", "V"], ["Out"])
+def ulysses_attention_op(q, k, v, *, scale=1.0, causal=False,
+                         axis="sp"):
+    """Static-graph op twin (uses the ambient mesh, like the
+    ring_attention op)."""
+    return ulysses_attention(q, k, v, axis=axis, scale=scale,
+                             causal=causal)

@@ -543,7 +543,8 @@ EXEMPT = {
     "assign_numpy_value": "test_framework.py (layers.assign)",
     "beam_search": "test_beam_search.py",
     "beam_search_decode": "test_beam_search.py",
-    "ring_attention": "test_parallel.py (needs a mesh)",
+    "ring_attention": "test_seq_parallel.py",
+    "ulysses_attention": "test_seq_parallel.py",
     "lstm": "test_sequence_rnn.py (scan kernel, grads)",
     "gru": "test_sequence_rnn.py",
     "sequence_expand": "test_sequence_rnn.py",

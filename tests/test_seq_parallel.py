@@ -1,0 +1,121 @@
+"""Sequence/context parallelism tests: ring attention (ppermute ring +
+online softmax) and Ulysses (all-to-all head re-sharding) must both
+reproduce full attention exactly on the virtual mesh, gradients
+included. (SURVEY §5 long-context — new TPU-first capability.)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel.ring_attention import ring_attention
+from paddle_tpu.parallel.ulysses import (_full_attention,
+                                         ulysses_attention)
+
+B, H, S, Dh = 2, 8, 64, 16
+
+
+@pytest.fixture
+def qkv(rng):
+    q = jnp.asarray(rng.randn(B, H, S, Dh).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, Dh).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, Dh).astype(np.float32)) * 0.3
+    return q, k, v
+
+
+def _sp_mesh(n):
+    return mesh_lib.make_mesh({"sp": n}, jax.devices()[:n])
+
+
+@pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(qkv, impl, causal):
+    q, k, v = qkv
+    want = _full_attention(q, k, v, 0.5, causal)
+    mesh = _sp_mesh(4)
+    got = impl(q, k, v, mesh=mesh, scale=0.5, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
+def test_gradients_match(qkv, impl):
+    q, k, v = qkv
+    mesh = _sp_mesh(4)
+
+    def loss_ref(a, b, c):
+        return jnp.sum(_full_attention(a, b, c, 0.5, True) ** 2)
+
+    def loss_sp(a, b, c):
+        return jnp.sum(impl(a, b, c, mesh=mesh, scale=0.5,
+                            causal=True) ** 2)
+
+    gw = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gg, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_ulysses_full_sp_degree(qkv):
+    """sp == num devices == heads/1: the tightest legal split."""
+    q, k, v = qkv
+    mesh = _sp_mesh(8)
+    want = _full_attention(q, k, v, 1.0, False)
+    got = ulysses_attention(q, k, v, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(rng):
+    q = jnp.asarray(rng.randn(1, 3, 16, 8).astype(np.float32))
+    with pytest.raises(Exception, match="divisible"):
+        ulysses_attention(q, q, q, mesh=_sp_mesh(2))
+
+
+@pytest.mark.parametrize("op_type", ["ring_attention",
+                                     "ulysses_attention"])
+def test_op_inside_program_under_mesh(qkv, op_type):
+    """The registered op twins pick up the ambient mesh set by
+    mesh_guard (the CompiledProgram path)."""
+    q, k, v = qkv
+    want = _full_attention(q, k, v, 1.0, False)
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        from paddle_tpu.layer_helper import LayerHelper
+        qv = fluid.layers.data("q", shape=[B, H, S, Dh],
+                               append_batch_size=False)
+        kv = fluid.layers.data("k", shape=[B, H, S, Dh],
+                               append_batch_size=False)
+        vv = fluid.layers.data("v", shape=[B, H, S, Dh],
+                               append_batch_size=False)
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type=op_type,
+                         inputs={"Q": [qv], "K": [kv], "V": [vv]},
+                         outputs={"Out": [out]},
+                         attrs={"scale": 1.0, "causal": False})
+    exe = fluid.Executor()
+    with mesh_lib.mesh_guard(_sp_mesh(4)):
+        (got,) = exe.run(main, feed={"q": np.asarray(q),
+                                     "k": np.asarray(k),
+                                     "v": np.asarray(v)},
+                         fetch_list=[out])
+    np.testing.assert_allclose(got, np.asarray(want), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_fallback_without_mesh(qkv):
+    """No sp axis in scope → plain attention, same answer."""
+    q, k, v = qkv
+    want = _full_attention(q, k, v, 1.0, False)
+    got = ulysses_attention(q, k, v, mesh=None)
+    got2 = ring_attention(q, k, v, mesh=mesh_lib.make_mesh(
+        {"dp": 4}, jax.devices()[:4]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want),
+                               atol=1e-6)
