@@ -53,6 +53,14 @@ from .dataset_factory import (DatasetFactory, InMemoryDataset,  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .pyreader import DataLoader, PyReader  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import ir  # noqa: F401
+from . import inference  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory)
+from .async_executor import AsyncExecutor  # noqa: F401
+from .core import device_info  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,68 @@
+"""AsyncExecutor: the legacy file-driven CTR training front end.
+
+Reference: python/paddle/fluid/async_executor.py (fluid.AsyncExecutor)
+over paddle/fluid/framework/async_executor.cc:68 RunFromFile —
+thread-per-core workers each consuming a shard of a file list through a
+DataFeed and running the program lock-free (the predecessor of the
+trainer/device-worker path, which the reference itself migrated to).
+
+TPU-native: the thread pool dissolves — the Dataset's reader threads
+shard/parse files on the host while ONE compiled XLA step consumes the
+batches (Executor.train_from_dataset). This facade keeps the legacy
+surface so AsyncExecutor scripts run unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .core.enforce import InvalidArgumentError, enforce
+from .dataset_factory import DatasetFactory
+from .executor import Executor
+from .framework import Program
+
+
+class AsyncExecutor:
+    """Reference: async_executor.py AsyncExecutor.__init__(place,
+    run_mode)."""
+
+    def __init__(self, place=None, run_mode=""):
+        self.place = place
+        self.run_mode = run_mode
+        self.executor = Executor(place)
+
+    def run(self, program: Program, data_feed, filelist: List[str],
+            thread_num: int = 1, fetch: Optional[list] = None,
+            mode="", debug=False):
+        """RunFromFile analog (async_executor.cc:68): build an
+        in-memory Dataset over ``filelist`` described by ``data_feed``
+        (a DataFeedDesc-like object or a dict with slot vars + batch
+        size) and drive train_from_dataset. ``thread_num`` maps to the
+        Dataset's reader-thread count."""
+        enforce(filelist, "AsyncExecutor.run needs a non-empty filelist")
+        if hasattr(data_feed, "to_dataset"):
+            dataset = data_feed.to_dataset()
+        elif isinstance(data_feed, dict):
+            dataset = DatasetFactory().create_dataset("InMemoryDataset")
+            dataset.set_batch_size(data_feed.get("batch_size", 64))
+            dataset.set_use_var(data_feed["use_var"])
+            if "pipe_command" in data_feed:
+                dataset.set_pipe_command(data_feed["pipe_command"])
+        else:
+            raise InvalidArgumentError(
+                "data_feed must be a dict(batch_size=, use_var=[vars]) "
+                "or expose .to_dataset()")
+        dataset.set_thread(max(int(thread_num), 1))
+        dataset.set_filelist(list(filelist))
+        dataset.load_into_memory()
+        return self.executor.train_from_dataset(
+            program=program, dataset=dataset, debug=debug,
+            fetch_list=fetch or [])
+
+    # legacy fleet hooks kept for surface parity; the real distributed
+    # path lives in incubate.fleet + distributed (PS runtime)
+    def config_distributed_nodes(self):
+        raise InvalidArgumentError(
+            "AsyncExecutor distributed mode was superseded by "
+            "fleet (incubate.fleet) in the reference too; use "
+            "fleet.init + distributed.PServerRuntime")

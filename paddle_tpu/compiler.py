@@ -52,7 +52,9 @@ class BuildStrategy:
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
-        # Accepted for parity; the XLA compiler performs these.
+        # fuse_elewise_add_act_ops runs the real ir pass (ir/passes.py);
+        # the remaining toggles are accepted for parity — the XLA
+        # compiler performs those fusions itself.
         self.fuse_elewise_add_act_ops = False
         self.fuse_all_reduce_ops = False
         self.fuse_all_optimizer_ops = False
@@ -184,6 +186,11 @@ class CompiledProgram:
     def run(self, exe, feed, fetch_list, scope, return_numpy,
             use_program_cache=True):
         from .core.scope import global_scope
+        if self._build_strategy.fuse_elewise_add_act_ops and \
+                not getattr(self, "_fuse_done", False):
+            from . import ir
+            ir.apply_passes(self.program, ["fuse_elewise_add_act_pass"])
+            self._fuse_done = True
         # ops that are mesh-aware (ring_attention, sp/ep lowerings)
         # read the ambient mesh during tracing
         with mesh_lib.mesh_guard(self._mesh):

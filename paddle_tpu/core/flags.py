@@ -100,3 +100,12 @@ FLAGS.define("global_seed", 0, "Framework-wide RNG seed (0 = nondeterministic)."
 FLAGS.define("sync_collectives", True,
              "Deterministic collective order (analog of sync_nccl_allreduce).")
 FLAGS.define("rpc_deadline", 180000, "DCN RPC deadline ms (parity).")
+
+# Async communicator (reference: python/paddle/fluid/__init__.py:169-176
+# communicator_* gflags tuning Communicator::SendThread batching).
+FLAGS.define("communicator_max_merge_var_num", 20,
+             "Max queued grads merged into one PS send.")
+FLAGS.define("communicator_send_queue_size", 20,
+             "Trainer-side send queue depth.")
+FLAGS.define("communicator_independent_recv_thread", True,
+             "Kept for API parity (recv is pull-on-demand here).")

@@ -1,0 +1,22 @@
+"""Host-side distributed runtime: RPC tensor transport + parameter
+server.
+
+Reference: paddle/fluid/operators/distributed/ (~8.8k LoC: rpc_client.h,
+rpc_server.h, grpc/), distributed_ops/ (send_op, recv_op,
+listen_and_serv_op) and the python DistributeTranspiler PS mode.
+
+TPU-native split:
+- *dense* synchronous data-parallel training stays ON DEVICE — GSPMD
+  collectives over ICI (compiler.py); none of this package is involved.
+- this package is the **DCN story**: host-side parameter-server
+  training (CPU clusters, asynchronous SGD, >HBM embedding tables),
+  where tensors genuinely move between processes over sockets. The
+  transport is native C++ (native/tensor_rpc.cpp) and the server
+  optimize step runs through the normal Executor.
+"""
+
+from .rpc import RPCClient, RPCServer, VERBS  # noqa: F401
+from .ps import (Communicator, ListenAndServ,  # noqa: F401
+                 ParameterServerRuntime, PServerRuntime)
+from .lookup_service import LargeScaleKV, LookupServiceClient  # noqa: F401
+from .sparse import SparseEmbeddingRuntime  # noqa: F401

@@ -1,0 +1,149 @@
+"""Distributed sparse embedding service: host-resident sharded tables.
+
+Reference: the large-scale sparse competency (L7c) —
+- trainer-side prefetch of remote embedding rows:
+  operators/distributed/parameter_prefetch.cc (splits ids by section,
+  RPC-prefetches each pserver's rows, scatters results back),
+  distribute_transpiler.py:1372
+  `_replace_lookup_table_op_with_prefetch`.
+- server-side table shard with on-arrival sparse optimize:
+  distribute_transpiler.py:1527 (table optimize block),
+  async_sparse_param_update_recorder.h.
+
+TPU-native design: tables that FIT in HBM shard over the mesh with
+all-to-all lookup (models/deepfm.py). This module is the beyond-HBM
+tier: rows live in host RAM across pserver processes (hash-sharded by
+row id), trainers PREFETCH the rows a batch needs into a small device
+tensor, and push sparse (ids, values) grads back — over DCN, exactly
+the reference's Downpour flow. Works with any optimizer that has a
+sparse row update (sgd/adagrad/momentum; optimizer_ops.py SparseRows
+path).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from .rpc import RPCClient
+
+
+class LargeScaleKV:
+    """One pserver's shard of a huge embedding table (the PSLib
+    "DownpourSparseTable" analog, fleet_wrapper.h pull_sparse/
+    push_sparse). Rows materialize lazily on first touch (new ids
+    init from a seeded hash so every shard is deterministic), so the
+    logical table can be arbitrarily larger than allocated memory."""
+
+    def __init__(self, dim, init_std=0.01, optimizer="sgd", lr=0.01,
+                 seed=0, dtype=np.float32):
+        self.dim = int(dim)
+        self.init_std = float(init_std)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.seed = int(seed)
+        self.dtype = dtype
+        self._rows: Dict[int, np.ndarray] = {}
+        self._accum: Dict[int, np.ndarray] = {}  # adagrad state
+        self._mu = threading.Lock()
+
+    def _row(self, rid: int) -> np.ndarray:
+        row = self._rows.get(rid)
+        if row is None:
+            rs = np.random.RandomState(
+                (self.seed * 0x9E3779B1 + rid) & 0x7FFFFFFF)
+            row = (rs.randn(self.dim) * self.init_std).astype(self.dtype)
+            self._rows[rid] = row
+        return row
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._mu:
+            return np.stack([self._row(int(i)) for i in ids]) \
+                if ids.size else np.zeros((0, self.dim), self.dtype)
+
+    def push(self, ids, values):
+        """Apply sparse grads row-wise (server-side optimize — the
+        reference's table optimize block, transpiler :1527). Duplicate
+        ids accumulate before the update (one update per unique row per
+        push, matching SelectedRows merge-add)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        values = np.asarray(values, self.dtype).reshape(len(ids),
+                                                        self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), self.dtype)
+        np.add.at(merged, inv, values)
+        with self._mu:
+            for j, rid in enumerate(uniq):
+                rid = int(rid)
+                g = merged[j]
+                row = self._row(rid)
+                if self.optimizer == "sgd":
+                    row -= self.lr * g
+                elif self.optimizer == "adagrad":
+                    acc = self._accum.setdefault(
+                        rid, np.zeros(self.dim, self.dtype))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-6)
+                else:
+                    raise InvalidArgumentError(
+                        "sparse optimizer %r (have sgd, adagrad)"
+                        % self.optimizer)
+
+    def size(self):
+        with self._mu:
+            return len(self._rows)
+
+
+class LookupServiceClient:
+    """Trainer-side prefetch/push over the pserver shards
+    (parameter_prefetch.cc analog). Rows hash-shard by
+    ``id % n_shards`` (the reference's RoundRobin section split)."""
+
+    def __init__(self, table_name: str, endpoints: List[str], dim: int):
+        self.table = table_name
+        self.dim = dim
+        self.clients = [RPCClient(ep) for ep in endpoints]
+
+    def _shard(self, ids):
+        return np.asarray(ids, np.int64) % len(self.clients)
+
+    def pull(self, ids) -> np.ndarray:
+        """Fetch rows for (possibly duplicated) ids; returns
+        [len(ids), dim] in input order."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.zeros((len(ids), self.dim), np.float32)
+        shard = self._shard(ids)
+        for s, client in enumerate(self.clients):
+            mask = shard == s
+            if not mask.any():
+                continue
+            rows = client.prefetch(self.table, ids[mask])
+            out[mask] = rows
+        return out
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids),
+                                                      self.dim)
+        shard = self._shard(ids)
+        for s, client in enumerate(self.clients):
+            mask = shard == s
+            if mask.any():
+                client.push_sparse(self.table, ids[mask], grads[mask])
+
+    def embed_batch(self, id_batch) -> np.ndarray:
+        """Lookup for a [batch, slots] id matrix -> [batch, slots, dim]
+        device-feedable array: the host-side replacement for a
+        lookup_table op on a >HBM table (the transpiler swaps the op
+        for this prefetch, reference :1372)."""
+        id_batch = np.asarray(id_batch, np.int64)
+        flat = self.pull(id_batch.reshape(-1))
+        return flat.reshape(id_batch.shape + (self.dim,))
+
+    def close(self):
+        for c in self.clients:
+            c.close()

@@ -1,0 +1,375 @@
+"""Parameter-server runtime: ListenAndServ loop + trainer Communicator.
+
+Reference:
+- listen_and_serv op (operators/distributed_ops/listen_and_serv_op.cc):
+  RunSyncLoop :109 barriers N trainers, merges grads, runs the
+  per-param optimize blocks, serves gets; RunAsyncLoop :225 applies
+  each grad on arrival.
+- Communicator (operators/distributed/communicator.h:160): background
+  SendThread batching/merging up to ``communicator_max_merge_var_num``
+  grads per param before one send; RecvThread pulling fresh params.
+- grad merge on the server: _append_pserver_grad_merge_ops
+  (distribute_transpiler.py:1807).
+
+TPU-native shape: the transport is the native tensor_rpc library; the
+server's optimize step runs each param's update op through the normal
+(CPU-jitted) Executor on the pserver process. Dense sync DP should use
+GSPMD instead (compiler.py) — this path exists for CPU PS clusters,
+async SGD, and the sparse/>HBM path (lookup_service.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from ..core.flags import FLAGS
+from ..io import deserialize_tensor, serialize_tensor
+from .rpc import RPCClient, RPCServer
+
+
+class ListenAndServ:
+    """The pserver main loop (listen_and_serv_op.cc analog).
+
+    ``optimize_fn(param_name, grad_ndarray)`` applies one merged grad
+    to the server-resident param and returns nothing; ``params`` maps
+    name -> initial ndarray. In sync mode the loop waits for
+    ``n_trainers`` SENDs per grad name, sums them, optimizes once, and
+    releases the barrier (RunSyncLoop :109). In async mode every
+    arriving grad optimizes immediately (RunAsyncLoop :225).
+    """
+
+    def __init__(self, endpoint, params: Dict[str, np.ndarray],
+                 optimize_fn, n_trainers=1, sync_mode=True,
+                 lookup_tables=None):
+        self.server = RPCServer(endpoint)
+        self.endpoint = self.server.endpoint
+        # any Mapping works — PServerRuntime passes a live scope view
+        self.params = params
+        self.optimize_fn = optimize_fn
+        self.n_trainers = n_trainers
+        self.sync_mode = sync_mode
+        self._mu = threading.Lock()
+        self._pending: Dict[str, List[np.ndarray]] = {}
+        self._barrier_waiters: List = []
+        self._completed = 0
+        self.lookup_tables = lookup_tables or {}
+
+        s = self.server
+        s.register("SEND", self._on_send)
+        s.register("GET", self._on_get)
+        # barrier must not block the single drain thread: it parks the
+        # responder and releases every parked trainer when the last one
+        # arrives (the reference's RequestBarrier/WaitBarrier,
+        # rpc_server.cc)
+        s.register_deferred("BARRIER", self._on_barrier)
+        s.register("COMPLETE", self._on_complete)
+        s.register("PREFETCH", self._on_prefetch)
+        s.register("PUSH_SPARSE", self._on_push_sparse)
+
+    # -- handlers (each runs on the server drain thread) -------------------
+    def _on_send(self, name, payload):
+        grad, _ = deserialize_tensor(payload)
+        with self._mu:
+            if not self.sync_mode:
+                self._apply(name, grad)
+                return b""
+            self._pending.setdefault(name, []).append(grad)
+            if len(self._pending[name]) >= self.n_trainers:
+                merged = np.sum(self._pending.pop(name), axis=0)
+                self._apply(name, merged)
+        return b""
+
+    def _apply(self, name, grad):
+        enforce(name in self.params,
+                "pserver %s has no param %r" % (self.endpoint, name))
+        self.optimize_fn(name, grad)
+
+    def _on_get(self, name, payload):
+        with self._mu:
+            enforce(name in self.params, "no param %r" % name)
+            return serialize_tensor(np.asarray(self.params[name]))
+
+    def _on_barrier(self, name, payload, responder):
+        """Sync-mode step barrier: all trainers must arrive before any
+        proceeds (send_barrier/fetch_barrier ops). Non-blocking: the
+        reply is parked until the n-th trainer arrives."""
+        release = None
+        with self._mu:
+            self._barrier_waiters.append(responder)
+            if len(self._barrier_waiters) >= self.n_trainers:
+                release, self._barrier_waiters = \
+                    self._barrier_waiters, []
+        if release is not None:
+            for r in release:
+                r(0, b"")
+
+    def _on_complete(self, name, payload):
+        with self._mu:
+            self._completed += 1
+        return b""
+
+    def _on_prefetch(self, name, payload):
+        ids, _ = deserialize_tensor(payload)
+        table = self._table(name)
+        return serialize_tensor(table.pull(ids))
+
+    def _on_push_sparse(self, name, payload):
+        ids, off = deserialize_tensor(payload)
+        values, _ = deserialize_tensor(payload, off)
+        self._table(name).push(ids, values)
+        return b""
+
+    def _table(self, name):
+        enforce(name in self.lookup_tables,
+                "pserver %s hosts no lookup table %r (tables: %s)"
+                % (self.endpoint, name, list(self.lookup_tables)))
+        return self.lookup_tables[name]
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self.server.start()
+        return self
+
+    def run_until_complete(self, poll_s=0.2):
+        """Serve until every trainer has sent COMPLETE."""
+        self.server.start()
+        while True:
+            with self._mu:
+                if self._completed >= self.n_trainers:
+                    break
+            time.sleep(poll_s)
+        self.shutdown()
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+class Communicator:
+    """Trainer-side async grad pipeline (communicator.h:160).
+
+    ``send(name, grad)`` enqueues; the SendThread merges up to
+    ``max_merge_var_num`` queued grads per name (summing them — the
+    reference's merge_add) and issues one RPC. ``recv(name)`` pulls the
+    fresh param. In sync mode trainers call flush() + barrier() each
+    step instead."""
+
+    def __init__(self, placement: Dict[str, str],
+                 max_merge_var_num=None, send_queue_size=None):
+        self.placement = placement
+        self.max_merge = max_merge_var_num or \
+            int(FLAGS.communicator_max_merge_var_num or 20)
+        self.queue_size = send_queue_size or \
+            int(FLAGS.communicator_send_queue_size or 20)
+        self._clients: Dict[str, RPCClient] = {}
+        self._q: "queue.Queue" = queue.Queue(self.queue_size)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight = threading.Semaphore(0)
+        self._err: Optional[Exception] = None
+
+    def client(self, endpoint) -> RPCClient:
+        if endpoint not in self._clients:
+            self._clients[endpoint] = RPCClient(endpoint)
+        return self._clients[endpoint]
+
+    # -- async path ---------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._send_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _check_err(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def send(self, name, grad):
+        self._check_err()  # surface async send failures at the caller
+        self._q.put((name, np.asarray(grad)))
+
+    def _send_loop(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                name, grad = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            merged, n = grad, 1
+            # merge-K batching: reference Communicator::SendThread
+            while n < self.max_merge:
+                try:
+                    nxt_name, nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt_name != name:
+                    self._q.put((nxt_name, nxt))
+                    break
+                merged = merged + nxt
+                n += 1
+            try:
+                self.client(self.placement[name]).send_var(name, merged)
+            except Exception as e:
+                self._err = e
+            for _ in range(n):
+                self._inflight.release()
+
+    def wait_sends(self, n):
+        for _ in range(n):
+            self._inflight.acquire()
+        self._check_err()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for c in self._clients.values():
+            c.close()
+        self._check_err()
+
+    # -- sync helpers -------------------------------------------------------
+    def send_sync(self, name, grad):
+        self.client(self.placement[name]).send_var(name, grad)
+
+    def recv(self, name) -> np.ndarray:
+        return self.client(self.placement[name]).get_var(name)
+
+    def barrier_all(self, name="step"):
+        for ep in sorted(set(self.placement.values())):
+            self.client(ep).barrier(name)
+
+    def complete_all(self):
+        for ep in sorted(set(self.placement.values())):
+            self.client(ep).complete()
+
+
+class _ScopeView:
+    """Read-only mapping over a set of scope vars (GET handler)."""
+
+    def __init__(self, scope, names):
+        self.scope = scope
+        self.names = set(names)
+
+    def __contains__(self, name):
+        return name in self.names
+
+    def __getitem__(self, name):
+        return self.scope.find_var(name)
+
+
+class PServerRuntime:
+    """One pserver process: startup + per-param optimize programs +
+    the ListenAndServ loop (the full Executor.run(pserver_program)
+    experience of the reference, listen_and_serv_op.cc:464)."""
+
+    def __init__(self, transpiler, endpoint, lookup_tables=None):
+        from ..core.scope import Scope
+        from ..executor import Executor
+        self.scope = Scope()
+        self.exe = Executor()
+        self.t = transpiler
+        self.endpoint = endpoint
+        own = transpiler.params_on(endpoint)
+        self._minis = {p: transpiler.get_param_program(p) for p in own}
+        self._grad_name = transpiler.param_grad_table()
+        startup = transpiler.get_startup_program(endpoint)
+        self.exe.run(startup, scope=self.scope)
+        self.serv = ListenAndServ(
+            endpoint, _ScopeView(self.scope, own), self._optimize,
+            n_trainers=transpiler.trainer_num,
+            sync_mode=transpiler.sync_mode,
+            lookup_tables=lookup_tables)
+
+    def _optimize(self, pname, grad):
+        self.exe.run(self._minis[pname],
+                     feed={self._grad_name[pname]: grad},
+                     scope=self.scope, fetch_list=[])
+
+    def run(self):
+        """Blocks until every trainer COMPLETEs."""
+        self.serv.run_until_complete()
+
+
+class ParameterServerRuntime:
+    """Drives one PS training process end to end — the glue the
+    transpiler's products plug into (reference: the trainer loop that
+    fluid users write around exe.run(trainer_program) after transpile,
+    plus Executor.run(pserver_program) on servers).
+
+    Trainer side: wraps a (fwd+bwd-only) trainer program; each
+    ``run()`` executes the local step, sends every param grad to its
+    pserver, barriers (sync mode), then pulls fresh params into the
+    local scope."""
+
+    def __init__(self, transpiler, program, scope, sync_mode=True):
+        self.t = transpiler
+        self.program = program
+        self.scope = scope
+        self.sync_mode = sync_mode
+        self.comm = Communicator(transpiler.param_placement())
+
+    def init_params(self):
+        """Adopt the server-side initial parameter values (the
+        reference's post-init param sync: trainers recv before step 0,
+        so every trainer starts from the pserver's init)."""
+        for pname in self.t.param_placement():
+            self.scope.set_var(pname, self.comm.recv(pname))
+
+    def _per_endpoint(self, fn):
+        """Run fn(endpoint, [param,...]) concurrently, one worker per
+        pserver — sends/recvs to different servers are independent, so
+        the step pays one round-trip per SERVER, not per PARAM (the
+        role of the reference's per-endpoint async channels,
+        grpc_client.h connection-per-ep)."""
+        from concurrent.futures import ThreadPoolExecutor
+        by_ep: Dict[str, list] = {}
+        for pname, ep in self.t.param_placement().items():
+            by_ep.setdefault(ep, []).append(pname)
+        if len(by_ep) == 1:
+            ep, ps = next(iter(by_ep.items()))
+            fn(ep, sorted(ps))
+            return
+        with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
+            futs = [pool.submit(fn, ep, sorted(ps))
+                    for ep, ps in by_ep.items()]
+            for f in futs:
+                f.result()  # propagate RPC errors
+
+    def run_step(self, exe, feed, fetch_list=None, return_numpy=True):
+        fetch_list = list(fetch_list or [])
+        grads = self.t.grad_to_param()  # grad var name -> param name
+        out = exe.run(self.program, feed=feed,
+                      fetch_list=fetch_list + sorted(grads),
+                      scope=self.scope, return_numpy=False)
+        user_out = out[:len(fetch_list)]
+        gvals = {grads[gname]: np.asarray(gval) for gname, gval in
+                 zip(sorted(grads), out[len(fetch_list):])}
+
+        def send(ep, pnames):
+            client = self.comm.client(ep)
+            for p in pnames:
+                client.send_var(p, gvals[p])
+
+        def recv(ep, pnames):
+            client = self.comm.client(ep)
+            for p in pnames:
+                self.scope.set_var(p, client.get_var(p))
+
+        self._per_endpoint(send)
+        if self.sync_mode:
+            self.comm.barrier_all("send")
+        self._per_endpoint(recv)
+        if self.sync_mode:
+            self.comm.barrier_all("fetch")
+        if return_numpy:
+            user_out = [np.asarray(v) for v in user_out]
+        return user_out
+
+    def complete(self):
+        self.comm.complete_all()
+        self.comm.stop()

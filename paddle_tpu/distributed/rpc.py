@@ -1,0 +1,247 @@
+"""ctypes bindings over the native TCP tensor transport.
+
+Reference: operators/distributed/rpc_client.h (AsyncSendVar :181 /
+AsyncGetVar / AsyncPrefetchVar verbs), rpc_server.cc (request queue +
+handler dispatch), grpc_serde.cc (tensor <-> wire). The C++ side
+(native/tensor_rpc.cpp) owns all socket IO on its own threads; tensors
+cross the wire in the io.py serialization format.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..core.enforce import UnavailableError, enforce
+from ..io import deserialize_tensor, serialize_tensor
+from ..native import load_library
+
+# verb ids, shared with the server loop (the reference's request type
+# strings RequestSend/RequestGet/RequestPrefetch/RequestBarrier,
+# request_handler.h)
+VERBS = {
+    "SEND": 1,        # push a tensor (param name -> serialized grad)
+    "GET": 2,         # pull a tensor by name
+    "PREFETCH": 3,    # sparse rows lookup: payload = int64 ids
+    "BARRIER": 4,     # sync-mode batch barrier
+    "COMPLETE": 5,    # trainer is done (graceful shutdown)
+    "PUSH_SPARSE": 6,  # sparse grad push: payload = ids + values
+}
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = load_library("tensor_rpc.cpp")
+            if lib is None:
+                raise UnavailableError(
+                    "native tensor_rpc library unavailable (no g++?)")
+            lib.trpc_server_create.restype = ctypes.c_int64
+            lib.trpc_server_create.argtypes = [ctypes.c_int]
+            lib.trpc_server_port.restype = ctypes.c_int
+            lib.trpc_server_port.argtypes = [ctypes.c_int64]
+            lib.trpc_server_next.restype = ctypes.c_int
+            lib.trpc_server_next.argtypes = [
+                ctypes.c_int64, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.trpc_server_respond.restype = ctypes.c_int
+            lib.trpc_server_respond.argtypes = [
+                ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_uint64]
+            lib.trpc_server_shutdown.argtypes = [ctypes.c_int64]
+            lib.trpc_connect.restype = ctypes.c_int64
+            lib.trpc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_int]
+            lib.trpc_call.restype = ctypes.c_int
+            lib.trpc_call.argtypes = [
+                ctypes.c_int64, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.trpc_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+            lib.trpc_close.argtypes = [ctypes.c_int64]
+            _lib = lib
+    return _lib
+
+
+def _parse_endpoint(endpoint):
+    host, port = endpoint.rsplit(":", 1)
+    if host in ("localhost", ""):
+        host = "127.0.0.1"
+    return host, int(port)
+
+
+class RPCServer:
+    """Owns a native server handle; dispatches requests to registered
+    handlers on a Python drain thread (the reference's
+    RequestHandler::Handle path, request_handler_impl.cc)."""
+
+    def __init__(self, endpoint: str = "127.0.0.1:0"):
+        lib = _load()
+        _, port = _parse_endpoint(endpoint)
+        self._h = lib.trpc_server_create(port)
+        enforce(self._h > 0, "cannot bind RPC server on %r" % endpoint)
+        self.port = lib.trpc_server_port(self._h)
+        self.endpoint = "127.0.0.1:%d" % self.port
+        self._handlers: Dict[int, Callable] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def register(self, verb: str, fn: Callable[[str, bytes], bytes]):
+        """fn(name, payload_bytes) -> response bytes (b"" for ack)."""
+        self._handlers[VERBS[verb]] = (fn, False)
+        return self
+
+    def register_deferred(self, verb: str, fn):
+        """fn(name, payload, responder) — the handler OWNS the reply:
+        it must eventually call responder(status:int, payload:bytes),
+        possibly from another request's handler. This keeps the single
+        drain thread non-blocking (a barrier handler that waited
+        in-line would starve every other trainer's requests)."""
+        self._handlers[VERBS[verb]] = (fn, True)
+        return self
+
+    # -- drain loop ---------------------------------------------------------
+    def serve_forever(self, poll_ms=100):
+        lib = _load()
+        req_id = ctypes.c_uint64()
+        verb = ctypes.c_int()
+        name_buf = ctypes.create_string_buffer(512)
+        payload = ctypes.POINTER(ctypes.c_char)()
+        plen = ctypes.c_uint64()
+        while not self._stop.is_set():
+            r = lib.trpc_server_next(
+                self._h, poll_ms, ctypes.byref(req_id),
+                ctypes.byref(verb), name_buf, 512,
+                ctypes.byref(payload), ctypes.byref(plen))
+            if r == 0:
+                continue
+            if r < 0:
+                break
+            name = name_buf.value.decode()
+            body = ctypes.string_at(payload, plen.value) \
+                if plen.value else b""
+            entry = self._handlers.get(verb.value)
+            if entry is None:
+                lib.trpc_server_respond(self._h, req_id, 404, b"", 0)
+                continue
+            handler, deferred = entry
+            if deferred:
+                rid = req_id.value
+
+                def responder(status, resp=b"", _rid=rid):
+                    _load().trpc_server_respond(self._h, _rid, status,
+                                                resp, len(resp))
+
+                try:
+                    handler(name, body, responder)
+                except Exception as e:
+                    responder(500, repr(e).encode())
+                continue
+            try:
+                resp = handler(name, body)
+                status = 0
+            except Exception as e:  # error -> status 500 + message
+                resp = repr(e).encode()
+                status = 500
+            lib.trpc_server_respond(self._h, req_id, status,
+                                    resp, len(resp))
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        _load().trpc_server_shutdown(self._h)
+
+
+class RPCClient:
+    """Synchronous client per endpoint (reference: GRPCClient,
+    grpc_client.h:176 — async verbs + Wait; here Python threads provide
+    the asynchrony, see ps.Communicator)."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 30.0,
+                 retry_interval_s: float = 0.1):
+        self.endpoint = endpoint
+        host, port = _parse_endpoint(endpoint)
+        lib = _load()
+        deadline = time.time() + timeout_s
+        self._h = -1
+        while time.time() < deadline:
+            self._h = lib.trpc_connect(host.encode(), port, 1000)
+            if self._h > 0:
+                break
+            time.sleep(retry_interval_s)  # server may not be up yet
+        enforce(self._h > 0,
+                "cannot connect to pserver %r within %.0fs"
+                % (endpoint, timeout_s))
+
+    def call(self, verb: str, name: str = "",
+             payload: bytes = b"") -> bytes:
+        lib = _load()
+        resp = ctypes.POINTER(ctypes.c_char)()
+        rlen = ctypes.c_uint64()
+        status = ctypes.c_int()
+        rc = lib.trpc_call(self._h, VERBS[verb], name.encode(),
+                           payload, len(payload), ctypes.byref(resp),
+                           ctypes.byref(rlen), ctypes.byref(status))
+        enforce(rc == 0, "rpc %s(%s) to %s failed (rc=%d)"
+                % (verb, name, self.endpoint, rc))
+        body = ctypes.string_at(resp, rlen.value) if rlen.value else b""
+        lib.trpc_free(resp)
+        if status.value == 500:
+            raise UnavailableError(
+                "pserver %s handler error on %s(%s): %s"
+                % (self.endpoint, verb, name, body.decode()))
+        enforce(status.value == 0, "rpc %s(%s): server status %d"
+                % (verb, name, status.value))
+        return body
+
+    # -- tensor verbs (grpc_serde analog) ----------------------------------
+    def send_var(self, name: str, value: np.ndarray):
+        self.call("SEND", name, serialize_tensor(np.asarray(value)))
+
+    def get_var(self, name: str) -> np.ndarray:
+        arr, _ = deserialize_tensor(self.call("GET", name))
+        return arr
+
+    def prefetch(self, table: str, ids: np.ndarray) -> np.ndarray:
+        payload = serialize_tensor(np.asarray(ids, np.int64))
+        arr, _ = deserialize_tensor(self.call("PREFETCH", table,
+                                              payload))
+        return arr
+
+    def push_sparse(self, table: str, ids: np.ndarray,
+                    values: np.ndarray):
+        payload = (serialize_tensor(np.asarray(ids, np.int64)) +
+                   serialize_tensor(np.asarray(values)))
+        self.call("PUSH_SPARSE", table, payload)
+
+    def barrier(self, name: str = "step"):
+        self.call("BARRIER", name)
+
+    def complete(self):
+        self.call("COMPLETE")
+
+    def close(self):
+        if self._h > 0:
+            _load().trpc_close(self._h)
+            self._h = -1

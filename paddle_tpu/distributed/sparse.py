@@ -1,0 +1,71 @@
+"""SparseEmbeddingRuntime: >HBM embedding tables in the training loop.
+
+Reference: the trainer side of the distributed sparse path —
+parameter_prefetch.cc (split ids by shard, RPC pull, scatter back),
+_replace_lookup_table_op_with_prefetch (distribute_transpiler.py:1372),
+and the Downpour per-batch pull_sparse/push_sparse flow
+(device_worker.h:156, fleet_wrapper.h:55).
+
+The program-side contract is established by
+``layers.embedding(..., is_distributed=True)``: the lookup result is a
+data var and ``program._distributed_lookups`` records
+{table, ids, out, rows, dim}. This runtime closes the loop per step:
+
+    feed = srt.wrap_feed(feed)        # pull rows for the batch's ids
+    ... run the step, fetching srt.grad_fetch_names() ...
+    srt.push_grads(feed, grad_values) # sparse push (server-side opt)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework import grad_var_name
+from .lookup_service import LookupServiceClient
+
+
+class SparseEmbeddingRuntime:
+    def __init__(self, program, endpoints: List[str]):
+        self.lookups = list(getattr(program, "_distributed_lookups",
+                                    []))
+        enforce(self.lookups,
+                "program has no distributed lookups (build the net "
+                "with layers.embedding(..., is_distributed=True))")
+        self.clients: Dict[str, LookupServiceClient] = {}
+        for lk in self.lookups:
+            if lk["table"] not in self.clients:
+                self.clients[lk["table"]] = LookupServiceClient(
+                    lk["table"], endpoints, lk["dim"])
+
+    def wrap_feed(self, feed: Dict[str, np.ndarray]):
+        """Prefetch: resolve every distributed lookup against the
+        host-side table shards and add the result to the feed."""
+        feed = dict(feed)
+        for lk in self.lookups:
+            if lk["ids"] not in feed:
+                raise InvalidArgumentError(
+                    "feed is missing %r (the ids of distributed table "
+                    "%r)" % (lk["ids"], lk["table"]))
+            ids = np.asarray(feed[lk["ids"]], np.int64)
+            feed[lk["out"]] = self.clients[lk["table"]].embed_batch(
+                ids).astype(np.float32)
+        return feed
+
+    def grad_fetch_names(self) -> List[str]:
+        return [grad_var_name(lk["out"]) for lk in self.lookups]
+
+    def push_grads(self, feed, grad_values):
+        """Sparse push: ids from the feed + the fetched out-grads form
+        (rows, values) updates applied by the owning pserver (its table
+        optimizer — the server-side optimize block)."""
+        for lk, g in zip(self.lookups, grad_values):
+            ids = np.asarray(feed[lk["ids"]], np.int64).reshape(-1)
+            g = np.asarray(g, np.float32).reshape(len(ids), lk["dim"])
+            self.clients[lk["table"]].push(ids, g)
+
+    def close(self):
+        for c in self.clients.values():
+            c.close()

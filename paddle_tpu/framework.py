@@ -635,6 +635,10 @@ class Program:
         p._op_role_var = list(self._op_role_var)
         p._exec_strategy = self._exec_strategy
         p._build_strategy = self._build_strategy
+        if hasattr(self, "_distributed_lookups"):
+            # >HBM table metadata (layers.embedding is_distributed=True)
+            p._distributed_lookups = [dict(d) for d in
+                                      self._distributed_lookups]
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
             p.blocks.append(nb)

@@ -1,0 +1,161 @@
+"""Inference deployment API: AnalysisConfig + AnalysisPredictor.
+
+Reference: paddle/fluid/inference/ (~28k LoC) —
+- `AnalysisConfig` (api/paddle_analysis_config.h): model path, device,
+  optimization switches.
+- `AnalysisPredictor` (api/analysis_predictor.h:46): loads the model,
+  runs `OptimizeInferenceProgram` (:436 — the analysis ir-pass manager
+  over the graph), then serves `Run` (:196) on a private scope.
+- `CreatePaddlePredictor` factory (paddle_api.h).
+
+TPU-native redesign: the reference's 40+ subgraph-engine passes
+(TensorRT/anakin/ngraph op converters) ARE the XLA compile here — the
+whole pruned program compiles to one device executable, cached per
+batch shape. What remains of the analysis phase is real program-level
+optimization through the ir pass framework (conv+BN fold into trained
+weights, fc fusion) plus the quant freeze from contrib.slim, all
+sharing the Pass/PatternDetector infrastructure (ir/).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import io as _io
+from ..core.enforce import InvalidArgumentError, enforce
+from ..core.scope import Scope
+from ..executor import Executor
+
+__all__ = ["AnalysisConfig", "AnalysisPredictor",
+           "create_paddle_predictor", "PaddleTensor"]
+
+
+class AnalysisConfig:
+    """Reference: api/paddle_analysis_config.h."""
+
+    def __init__(self, model_dir: str = None,
+                 prog_file: str = None, params_file: str = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._ir_optim = True
+        self._memory_optim = True   # XLA-owned; parity switch
+        self._use_tpu = True
+        self._passes = ["conv_bn_fuse_pass", "fc_fuse_pass",
+                        "fuse_elewise_add_act_pass"]
+        self._profile = False
+
+    # -- switches (reference naming) ---------------------------------------
+    def switch_ir_optim(self, on=True):
+        self._ir_optim = bool(on)
+        return self
+
+    def enable_memory_optim(self, on=True):
+        self._memory_optim = bool(on)
+        return self
+
+    def disable_gpu(self):
+        self._use_tpu = False
+        return self
+
+    def enable_profile(self):
+        self._profile = True
+        return self
+
+    def pass_builder(self) -> List[str]:
+        """Mutable pass list (reference: paddle_pass_builder.h)."""
+        return self._passes
+
+    def delete_pass(self, name):
+        self._passes = [p for p in self._passes if p != name]
+        return self
+
+
+class PaddleTensor:
+    """Input/output container (reference: paddle_api.h PaddleTensor —
+    name + shape + data). Accepts/yields numpy."""
+
+    def __init__(self, data, name=""):
+        self.data = np.asarray(data)
+        self.name = name
+
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+
+class AnalysisPredictor:
+    """Reference: api/analysis_predictor.h:46. Thread-compatible for
+    reads; clone per thread for concurrent use (the reference's
+    Clone())."""
+
+    def __init__(self, config: AnalysisConfig):
+        enforce(config.model_dir,
+                "AnalysisConfig needs a model_dir (save_inference_model "
+                "output)")
+        self.config = config
+        self.scope = Scope()
+        self.exe = Executor()
+        self.program, self.feed_names, self.fetch_vars = \
+            _io.load_inference_model(
+                config.model_dir, self.exe,
+                model_filename=config.prog_file,
+                params_filename=config.params_file, scope=self.scope)
+        if config._ir_optim:
+            self._optimize_program()
+
+    def _optimize_program(self):
+        """OptimizeInferenceProgram (analysis_predictor.cc:436): run
+        the analysis passes over the loaded program — with the scope,
+        because conv_bn folding rewrites trained weights."""
+        from .. import ir
+        for name in self.config._passes:
+            p = ir.get_pass(name, scope=self.scope)
+            graph = ir.Graph(self.program)
+            p.apply(graph)
+            graph.to_program()
+
+    # -- serving ------------------------------------------------------------
+    def run(self, inputs: Sequence) -> List[PaddleTensor]:
+        """Positional inputs in feed_names order (reference
+        AnalysisPredictor::Run, analysis_predictor.cc:196)."""
+        enforce(len(inputs) == len(self.feed_names),
+                "model expects %d inputs (%s), got %d"
+                % (len(self.feed_names), self.feed_names, len(inputs)))
+        feed = {}
+        for name, t in zip(self.feed_names, inputs):
+            feed[name] = t.data if isinstance(t, PaddleTensor) \
+                else np.asarray(t)
+        outs = self.exe.run(self.program, feed=feed,
+                            fetch_list=[v.name for v in
+                                        self.fetch_vars],
+                            scope=self.scope)
+        return [PaddleTensor(o, v.name)
+                for o, v in zip(outs, self.fetch_vars)]
+
+    def predict(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Dict-feed convenience (not in the reference C API)."""
+        outs = self.exe.run(self.program, feed=feed,
+                            fetch_list=[v.name for v in
+                                        self.fetch_vars],
+                            scope=self.scope)
+        return list(outs)
+
+    def clone(self) -> "AnalysisPredictor":
+        """Cheap per-thread clone sharing nothing mutable (the
+        reference shares the program, re-creates scope; program
+        re-optimization is skipped by reloading)."""
+        return AnalysisPredictor(self.config)
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self.fetch_vars]
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    """Reference: CreatePaddlePredictor<AnalysisConfig> (paddle_api.h)."""
+    return AnalysisPredictor(config)

@@ -1,0 +1,33 @@
+"""Graph IR + pass framework.
+
+Reference: paddle/fluid/framework/ir/ (~24.1k LoC) — ProgramDesc is
+converted to an ``ir::Graph`` of op/var ``Node``s (ir/graph.h:72,
+node.h), transformed by registered ``Pass``es (ir/pass.h:34,
+PassRegistry :145) driven by pattern matching
+(graph_pattern_detector.h), and converted back
+(graph_to_program_pass.cc).
+
+TPU-native scope: XLA already performs the reference's ~30 *kernel*
+fusion passes (fc_fuse only saves a kernel launch there; here one jitted
+program has no launches to save). What remains genuinely useful on this
+substrate — and is built here — is *program-level* rewriting:
+
+  - a stable Graph/Pass/PatternDetector toolkit that transpilers,
+    inference optimization, and quantization rewrites share (the AMP
+    decorator and QAT passes are ad-hoc program walkers today;
+    new rewrites should use this),
+  - semantic folds XLA cannot do because they change the *parameters*,
+    not the computation graph of one step (conv+BN folding rewrites
+    trained weights),
+  - operator-count reduction for serialized inference programs
+    (fc_fuse, fuse_elewise_add_act), which shrinks program artifacts
+    and trace time,
+  - debugging dumps (graph_viz_pass → graphviz dot, the analog of
+    ir/graph_viz_pass.cc).
+"""
+
+from .graph import Graph, Node  # noqa: F401
+from .pass_base import (Pass, PassManager, apply_passes,  # noqa: F401
+                        get_pass, register_pass)
+from .pattern_detector import GraphPatternDetector, PDNode  # noqa: F401
+from . import passes  # noqa: F401  (registers the standard passes)

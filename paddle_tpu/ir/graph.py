@@ -1,0 +1,246 @@
+"""ir.Graph: SSA graph of op/var nodes over one Program block.
+
+Reference: paddle/fluid/framework/ir/graph.h:72 (Graph),
+ir/node.h (Node — an op node wraps an OpDesc, a var node wraps a
+VarDesc; vars are versioned so each write creates a fresh node),
+ir/graph_helper.h (TopologySortOperations),
+ir/graph_to_program_pass.cc (rebuild the program from the graph).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.enforce import InvalidArgumentError, enforce
+from ..framework import Operator, Program, Variable
+
+
+class Node:
+    """Either an op node or a var node (reference: ir/node.h).
+
+    Op nodes: ``node.op`` is a dict-like record {type, inputs, outputs,
+    attrs} mirroring the Operator it came from; inputs/outputs are
+    lists of var Nodes in slot order.
+
+    Var nodes: ``node.name`` + ``node.var`` (the block's Variable desc,
+    or None for a version created mid-graph); ``inputs`` holds the
+    single writer op node (empty for graph inputs), ``outputs`` the
+    reader op nodes.
+    """
+
+    OP = "op"
+    VAR = "var"
+
+    def __init__(self, kind, name=None, op=None, var=None, version=0):
+        self.kind = kind
+        self.name = name
+        self.op = op            # framework.Operator for op nodes
+        self.var = var          # framework.Variable desc for var nodes
+        self.version = version  # SSA version for var nodes
+        self.inputs: List[Node] = []
+        self.outputs: List[Node] = []
+
+    def is_op(self, type=None):
+        return self.kind == Node.OP and (type is None or
+                                         self.op.type == type)
+
+    def is_var(self):
+        return self.kind == Node.VAR
+
+    @property
+    def persistable(self):
+        return self.var is not None and self.var.persistable
+
+    def single_reader(self) -> Optional["Node"]:
+        """The unique consumer op of a var node, or None."""
+        if self.kind != Node.VAR or len(self.outputs) != 1:
+            return None
+        return self.outputs[0]
+
+    def writer(self) -> Optional["Node"]:
+        return self.inputs[0] if self.inputs else None
+
+    def __repr__(self):
+        if self.kind == Node.OP:
+            return "OpNode(%s)" % self.op.type
+        return "VarNode(%s@%d)" % (self.name, self.version)
+
+
+class Graph:
+    """Build the SSA node graph of ``program.block(idx)``.
+
+    Each read links to the latest version of the name; each write
+    creates a new version node — the reference's var-node versioning
+    that makes write-after-read ordering explicit in graph edges.
+    """
+
+    def __init__(self, program: Program, block_idx: int = 0):
+        enforce(isinstance(program, Program), "Graph wraps a Program")
+        self.program = program
+        self.block_idx = block_idx
+        block = program.block(block_idx)
+        self.nodes: List[Node] = []
+        self._latest: Dict[str, Node] = {}
+        self._versions: Dict[str, int] = {}
+        # original positions: vjp ops reference their forward op BY
+        # INDEX (attrs fwd_op_index keys the RNG fold and in-place
+        # snapshots, executor.py _op_rng/run_op); to_program remaps
+        # them after a rewrite shifts positions
+        self._orig_index = {id(op): i for i, op in enumerate(block.ops)}
+
+        for op in block.ops:
+            self._add_op(op, block)
+
+    # -- construction -------------------------------------------------------
+    def _var_node(self, name, block, write=False) -> Node:
+        if write or name not in self._latest:
+            ver = self._versions.get(name, -1) + 1 \
+                if (write and name in self._latest) else \
+                self._versions.get(name, 0)
+            self._versions[name] = ver
+            node = Node(Node.VAR, name=name,
+                        var=block._find_var_recursive(name), version=ver)
+            self.nodes.append(node)
+            self._latest[name] = node
+            return node
+        return self._latest[name]
+
+    def _add_op(self, op: Operator, block) -> Node:
+        op_node = Node(Node.OP, name=op.type, op=op)
+        self.nodes.append(op_node)
+        for names in op.inputs.values():
+            for n in names:
+                vn = self._var_node(n, block)
+                op_node.inputs.append(vn)
+                vn.outputs.append(op_node)
+        for names in op.outputs.values():
+            for n in names:
+                vn = self._var_node(n, block, write=True)
+                op_node.outputs.append(vn)
+                vn.inputs.append(op_node)
+        return op_node
+
+    # -- queries ------------------------------------------------------------
+    def op_nodes(self, type=None) -> List[Node]:
+        return [n for n in self.nodes
+                if n.kind == Node.OP and (type is None or
+                                          n.op.type == type)]
+
+    def var_nodes(self, name=None) -> List[Node]:
+        return [n for n in self.nodes
+                if n.kind == Node.VAR and (name is None or
+                                           n.name == name)]
+
+    # -- mutation (the pass API) -------------------------------------------
+    def create_op_node(self, type, inputs, outputs, attrs=None) -> Node:
+        """Insert a new op node wired to EXISTING var nodes.
+
+        inputs/outputs: dict slot -> list of var Nodes (slot structure
+        is recorded on the underlying Operator so graph_to_program
+        round-trips)."""
+        block = self.program.block(self.block_idx)
+        op = Operator(block, type,
+                      {s: [v.name for v in vs]
+                       for s, vs in inputs.items()},
+                      {s: [v.name for v in vs]
+                       for s, vs in outputs.items()},
+                      dict(attrs or {}))
+        node = Node(Node.OP, name=type, op=op)
+        self.nodes.append(node)
+        for vs in inputs.values():
+            for vn in vs:
+                node.inputs.append(vn)
+                vn.outputs.append(node)
+        for vs in outputs.values():
+            for vn in vs:
+                node.outputs.append(vn)
+                vn.inputs.insert(0, node)
+        return node
+
+    def remove_nodes(self, nodes) -> None:
+        """Detach and drop a set of nodes (reference:
+        GraphSafeRemoveNodes, graph_pattern_detector.cc)."""
+        doomed = set(id(n) for n in nodes)
+        for n in self.nodes:
+            if id(n) in doomed:
+                continue
+            n.inputs = [m for m in n.inputs if id(m) not in doomed]
+            n.outputs = [m for m in n.outputs if id(m) not in doomed]
+        self.nodes = [n for n in self.nodes if id(n) not in doomed]
+
+    # -- back to program ----------------------------------------------------
+    def topological_order(self) -> List[Node]:
+        """Kahn's algorithm over op nodes (reference:
+        TopologySortOperations, ir/graph_helper.cc). Ties broken by
+        original insertion order so unrelated ops keep program order
+        (deterministic rebuilds)."""
+        indeg: Dict[int, int] = {}
+        pos = {id(n): i for i, n in enumerate(self.nodes)}
+        ops = [n for n in self.nodes if n.kind == Node.OP]
+        for n in ops:
+            deps = set()
+            for vn in n.inputs:
+                for w in vn.inputs:  # writer ops of each input var
+                    deps.add(id(w))
+            indeg[id(n)] = len(deps)
+        ready = sorted([n for n in ops if indeg[id(n)] == 0],
+                       key=lambda n: pos[id(n)])
+        order: List[Node] = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            succs = set()
+            for vn in n.outputs:
+                for r in vn.outputs:
+                    succs.add(id(r))
+            changed = False
+            for m in ops:
+                if id(m) in succs:
+                    indeg[id(m)] -= 1
+                    if indeg[id(m)] == 0:
+                        ready.append(m)
+                        changed = True
+            if changed:
+                ready.sort(key=lambda n: pos[id(n)])
+        if len(order) != len(ops):
+            raise InvalidArgumentError(
+                "graph has a cycle: %d of %d ops sorted"
+                % (len(order), len(ops)))
+        return order
+
+    def to_program(self) -> Program:
+        """Write the (possibly rewritten) op list back into the block
+        in topological order (reference: graph_to_program_pass.cc).
+        Mutates the wrapped Program in place and returns it.
+
+        Gradient safety: generated ``vjp`` ops address their forward op
+        by block index (``fwd_op_index`` — it keys the dropout-RNG fold
+        and the in-place input snapshots in executor.run_block), so any
+        rewrite that shifts positions would silently desynchronize
+        forward and backward RNG streams. The indices are remapped
+        here; a vjp whose forward op a pass deleted is an error."""
+        block = self.program.block(self.block_idx)
+        new_ops = [n.op for n in self.topological_order()]
+        old_to_new = {}
+        for new_i, op in enumerate(new_ops):
+            old_i = self._orig_index.get(id(op))
+            if old_i is not None:
+                old_to_new[old_i] = new_i
+        for op in new_ops:
+            if op.type != "vjp":
+                continue
+            old_fwd = op.attrs.get("fwd_op_index")
+            if old_fwd is None:
+                continue
+            if old_fwd not in old_to_new:
+                raise InvalidArgumentError(
+                    "a pass removed forward op #%d (%s) that a vjp op "
+                    "still differentiates — fusion across recorded "
+                    "gradients is not legal" %
+                    (old_fwd, op.attrs.get("fwd_type")))
+            op.attrs["fwd_op_index"] = old_to_new[old_fwd]
+        # remapped indices become the new baseline for a second pass
+        self._orig_index = {id(op): i for i, op in enumerate(new_ops)}
+        block.ops = new_ops
+        self.program._bump()
+        return self.program

@@ -1,0 +1,95 @@
+"""Pass base + registry (reference: framework/ir/pass.h:34 `Pass`,
+`PassRegistry`:145, REGISTER_PASS macro; build_strategy.cc drives pass
+sequences)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from ..core.enforce import AlreadyExistsError, NotFoundError, enforce
+from .graph import Graph
+
+
+class Pass:
+    """A graph→graph transform. Subclasses set ``name`` and implement
+    ``apply_impl``; attributes the pass needs (scope, place, …) are
+    injected with ``set`` (the reference's Set/Get pass-attribute
+    protocol, pass.h:51)."""
+
+    name = None
+
+    def __init__(self):
+        self._attrs: Dict[str, object] = {}
+
+    def set(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def require(self, key):
+        enforce(key in self._attrs,
+                "pass %r requires attribute %r" % (self.name, key))
+        return self._attrs[key]
+
+    def apply(self, graph: Graph) -> Graph:
+        enforce(isinstance(graph, Graph), "Pass.apply takes an ir.Graph")
+        out = self.apply_impl(graph)
+        return out if out is not None else graph
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        raise NotImplementedError
+
+
+_registry: Dict[str, Type[Pass]] = {}
+
+
+def register_pass(cls: Type[Pass]) -> Type[Pass]:
+    """Class decorator — the REGISTER_PASS macro analog."""
+    enforce(cls.name, "pass class %s needs a `name`" % cls.__name__)
+    if cls.name in _registry:
+        raise AlreadyExistsError("pass %r already registered" % cls.name)
+    _registry[cls.name] = cls
+    return cls
+
+
+def get_pass(name: str, **attrs) -> Pass:
+    if name not in _registry:
+        raise NotFoundError("no pass named %r (have: %s)" %
+                            (name, ", ".join(sorted(_registry))))
+    p = _registry[name]()
+    for k, v in attrs.items():
+        p.set(k, v)
+    return p
+
+
+def all_pass_names() -> List[str]:
+    return sorted(_registry)
+
+
+class PassManager:
+    """Ordered pass sequence (reference: inference/analysis
+    ir_pass_manager.cc / build_strategy.cc pass assembly)."""
+
+    def __init__(self, passes=None):
+        self.passes: List[Pass] = []
+        for p in passes or []:
+            self.add(p)
+
+    def add(self, p):
+        self.passes.append(get_pass(p) if isinstance(p, str) else p)
+        return self
+
+    def apply(self, graph: Graph) -> Graph:
+        for p in self.passes:
+            graph = p.apply(graph)
+        return graph
+
+
+def apply_passes(program, names, block_idx=0, **attrs):
+    """Convenience: Program → Graph → passes → Program (in place)."""
+    graph = Graph(program, block_idx)
+    for name in names:
+        graph = get_pass(name, **attrs).apply(graph)
+    return graph.to_program()

@@ -1,0 +1,237 @@
+"""Standard passes (reference: framework/ir/*_pass.cc).
+
+- fuse_elewise_add_act_pass  <- ir/fuse_elewise_add_act_pass.cc
+- fc_fuse_pass               <- ir/fc_fuse_pass.cc
+- conv_bn_fuse_pass          <- ir/conv_bn_fuse_pass.cc (folds trained
+                                BN statistics into conv weights; needs
+                                the scope — a semantic rewrite XLA
+                                cannot perform)
+- graph_viz_pass             <- ir/graph_viz_pass.cc (graphviz dot)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .graph import Graph, Node
+from .pass_base import Pass, register_pass
+from .pattern_detector import GraphPatternDetector, PDNode
+
+_ACTS = ("relu", "sigmoid", "tanh", "gelu")
+
+
+def _slot_of(op, var_name, which="inputs"):
+    for slot, names in getattr(op, which).items():
+        if var_name in names:
+            return slot
+    return None
+
+
+@register_pass
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add → act  ⇒  fused_elemwise_activation."""
+
+    name = "fuse_elewise_add_act_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        det = GraphPatternDetector()
+        det.node(PDNode.op("add", "elementwise_add"))
+        det.node(PDNode.var("mid", intermediate=True))
+        det.node(PDNode.op("act", _ACTS))
+        det.node(PDNode.var("out"))
+        det.link("add", "mid").link("mid", "act").link("act", "out")
+
+        def rewrite(m, g):
+            add_op, act_op = m["add"].op, m["act"].op
+            x_name = add_op.input("X")[0]
+            y_name = add_op.input("Y")[0]
+            xs = [n for n in m["add"].inputs if n.name == x_name]
+            ys = [n for n in m["add"].inputs if n.name == y_name]
+            g.create_op_node(
+                "fused_elemwise_activation",
+                {"X": [xs[0]], "Y": [ys[0]]},
+                {"Out": [m["out"]]},
+                {"functor_list": ["elementwise_add", act_op.type],
+                 "axis": add_op.attrs.get("axis", -1)})
+            g.remove_nodes([m["add"], m["mid"], m["act"]])
+
+        count = det.apply(graph, rewrite)
+        self.set("fused_count", count)
+        return graph
+
+
+@register_pass
+class FCFusePass(Pass):
+    """mul → elementwise_add(bias) [→ act]  ⇒  fc op.
+
+    The bias must be a persistable parameter (the fc layer's bias), the
+    mul must be the standard x_num_col_dims projection."""
+
+    name = "fc_fuse_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        total = 0
+        for with_act in (True, False):
+            det = GraphPatternDetector()
+            det.node(PDNode.op("mul", "mul"))
+            det.node(PDNode.var("mul_out", intermediate=True))
+            det.node(PDNode.op("add", "elementwise_add"))
+            det.link("mul", "mul_out").link("mul_out", "add")
+            if with_act:
+                det.node(PDNode.var("add_out", intermediate=True))
+                det.node(PDNode.op("act", _ACTS))
+                det.node(PDNode.var("out"))
+                det.link("add", "add_out").link("add_out", "act")
+                det.link("act", "out")
+            else:
+                det.node(PDNode.var("out"))
+                det.link("add", "out")
+
+            def rewrite(m, g, with_act=with_act):
+                mul_op, add_op = m["mul"].op, m["add"].op
+                # the fc op flattens only its Input; a mul with
+                # y_num_col_dims != 1 (W folded from >2-D) has no fc
+                # equivalent — leave it unfused
+                if mul_op.attrs.get("y_num_col_dims", 1) != 1:
+                    return
+                wv = m["mul"].op.input("Y")[0]
+                wvar = g.program.block(g.block_idx) \
+                    ._find_var_recursive(wv)
+                if wvar is not None and wvar.shape and \
+                        len(wvar.shape) != 2:
+                    return
+                # bias: the add input that ISN'T the mul result
+                mul_out_name = mul_op.output("Out")[0]
+                bias_name = next(n for n in add_op.input_arg_names
+                                 if n != mul_out_name)
+                bias_nodes = [n for n in m["add"].inputs
+                              if n.name == bias_name]
+                if not bias_nodes or not bias_nodes[0].persistable:
+                    return
+                x_name = mul_op.input("X")[0]
+                w_name = mul_op.input("Y")[0]
+                xn = next(n for n in m["mul"].inputs
+                          if n.name == x_name)
+                wn = next(n for n in m["mul"].inputs
+                          if n.name == w_name)
+                act = m["act"].op.type if with_act else ""
+                g.create_op_node(
+                    "fc",
+                    {"Input": [xn], "W": [wn], "Bias": [bias_nodes[0]]},
+                    {"Out": [m["out"]]},
+                    {"in_num_col_dims":
+                     mul_op.attrs.get("x_num_col_dims", 1),
+                     "activation_type": act})
+                dead = [m["mul"], m["mul_out"], m["add"]]
+                if with_act:
+                    dead += [m["add_out"], m["act"]]
+                g.remove_nodes(dead)
+
+            total += det.apply(graph, rewrite)
+        self.set("fused_count", total)
+        return graph
+
+
+@register_pass
+class ConvBNFusePass(Pass):
+    """conv2d → batch_norm(is_test)  ⇒  conv2d(W′) → elementwise_add(b′)
+
+    W′[o] = W[o] · γ[o]/√(σ²[o]+ε),  b′[o] = β[o] − μ[o]·γ[o]/√(σ²[o]+ε)
+
+    Rewrites the *trained parameter values* in the scope (pass attr
+    "scope") — the reference's conv_bn_fuse_pass.cc:169 recompute. Only
+    valid for inference programs (running stats frozen)."""
+
+    name = "conv_bn_fuse_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        scope = self.require("scope")
+        det = GraphPatternDetector()
+        det.node(PDNode.op("conv", ("conv2d", "depthwise_conv2d")))
+        det.node(PDNode.var("conv_out", intermediate=True))
+        det.node(PDNode.op("bn", "batch_norm"))
+        det.node(PDNode.var("y"))
+        det.link("conv", "conv_out").link("conv_out", "bn")
+        det.link("bn", "y")
+        count = 0
+
+        def rewrite(m, g):
+            nonlocal count
+            bn_op = m["bn"].op
+            if not bn_op.attrs.get("is_test", False):
+                return
+            # bn's Y must be the matched output (not a stats output)
+            if m["y"].name != bn_op.output("Y")[0]:
+                return
+            conv_op = m["conv"].op
+            w_name = conv_op.input("Filter")[0]
+            names = {s: bn_op.input(s)[0]
+                     for s in ("Scale", "Bias", "Mean", "Variance")}
+            vals = {k: np.asarray(scope.find_var(n))
+                    for k, n in names.items()}
+            w = np.asarray(scope.find_var(w_name))
+            eps = bn_op.attrs.get("epsilon", 1e-5)
+            istd = 1.0 / np.sqrt(vals["Variance"] + eps)
+            gamma = vals["Scale"] * istd                 # [C_out]
+            w_new = w * gamma.reshape(-1, 1, 1, 1)
+            b_new = vals["Bias"] - vals["Mean"] * gamma
+            scope.set_var(w_name, w_new.astype(w.dtype))
+
+            # new bias param var reuses the BN beta var's storage slot
+            bias_name = names["Bias"]
+            scope.set_var(bias_name, b_new.astype(w.dtype))
+            bias_node = next(n for n in m["bn"].inputs
+                             if n.name == bias_name)
+            g.create_op_node(
+                "elementwise_add",
+                {"X": [m["conv_out"]], "Y": [bias_node]},
+                {"Out": [m["y"]]},
+                {"axis": 1 if conv_op.attrs.get(
+                    "data_format", "NCHW") == "NCHW" else -1})
+            # keep conv + its output var; drop only the bn op (its
+            # stats outputs become dead writes)
+            dead_outs = [n for n in m["bn"].outputs if n is not m["y"]
+                         and not n.outputs]
+            g.remove_nodes([m["bn"]] + dead_outs)
+            # conv_out is consumed by the new add now — it was matched
+            # as intermediate but stays alive
+            count += 1
+
+        det.apply(graph, rewrite)
+        self.set("fused_count", count)
+        return graph
+
+
+@register_pass
+class GraphVizPass(Pass):
+    """Dump the graph as graphviz dot (reference: ir/graph_viz_pass.cc;
+    FLAGS_print_sub_graph_dir). Pass attr "path" = output file."""
+
+    name = "graph_viz_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        path = self.require("path")
+        lines = ["digraph G {", "  rankdir=TB;"]
+        ids = {}
+        for i, n in enumerate(graph.nodes):
+            ids[id(n)] = "n%d" % i
+            if n.is_op():
+                lines.append(
+                    '  n%d [label="%s" shape=box style=filled '
+                    'fillcolor="#90EE90"];' % (i, n.op.type))
+            else:
+                shape = "ellipse" if not n.persistable else "octagon"
+                lines.append('  n%d [label="%s" shape=%s];'
+                             % (i, n.name, shape))
+        for n in graph.nodes:
+            if n.is_op():
+                for v in n.inputs:
+                    lines.append("  %s -> %s;" % (ids[id(v)],
+                                                  ids[id(n)]))
+                for v in n.outputs:
+                    lines.append("  %s -> %s;" % (ids[id(n)],
+                                                  ids[id(v)]))
+        lines.append("}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        return graph

@@ -1,0 +1,154 @@
+"""Subgraph pattern matching over ir.Graph.
+
+Reference: framework/ir/graph_pattern_detector.h — `PDNode` (a node
+predicate + role flags), `PDPattern` (PDNodes + links), and
+`GraphPatternDetector::operator()` which finds all subgraph matches and
+invokes a handler per match. ~30 fusion passes are written against it.
+
+The matcher here is a straightforward backtracking subgraph
+isomorphism: pattern nodes are bound in declaration order, each
+candidate must satisfy the PDNode predicate and every already-bound
+link. Patterns are tiny (2–6 nodes), so this is never hot.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.enforce import enforce
+from .graph import Graph, Node
+
+
+class PDNode:
+    """One slot in the pattern. ``predicate(node) -> bool``; role flags
+    mirror the reference's AsInput/AsOutput/AsIntermediate — an
+    intermediate var must have no consumers outside the match (safe to
+    delete when the subgraph is replaced)."""
+
+    def __init__(self, name, predicate, intermediate=False):
+        self.name = name
+        self.predicate = predicate
+        self.intermediate = intermediate
+
+    # -- common predicates --------------------------------------------------
+    @staticmethod
+    def op(name, type) -> "PDNode":
+        if isinstance(type, (list, tuple, set, frozenset)):
+            types = frozenset(type)
+            return PDNode(name, lambda n: n.is_op() and
+                          n.op.type in types)
+        return PDNode(name, lambda n: n.is_op(type))
+
+    @staticmethod
+    def var(name, persistable=None, intermediate=False) -> "PDNode":
+        def pred(n):
+            if not n.is_var():
+                return False
+            if persistable is None:
+                return True
+            return n.persistable == persistable
+        return PDNode(name, pred, intermediate=intermediate)
+
+
+class GraphPatternDetector:
+    """Build a pattern with ``node``/``link``, run with ``detect`` or
+    ``apply`` (handler per match)."""
+
+    def __init__(self):
+        self.pattern: List[PDNode] = []
+        self.links: List[Tuple[str, str]] = []
+        self._by_name: Dict[str, PDNode] = {}
+
+    def node(self, pdnode: PDNode) -> PDNode:
+        enforce(pdnode.name not in self._by_name,
+                "duplicate pattern node %r" % pdnode.name)
+        self.pattern.append(pdnode)
+        self._by_name[pdnode.name] = pdnode
+        return pdnode
+
+    def link(self, src: str, dst: str):
+        """Declare that match[src] must appear in match[dst].inputs
+        (i.e. an edge src → dst)."""
+        enforce(src in self._by_name and dst in self._by_name,
+                "link references unknown pattern node")
+        self.links.append((src, dst))
+        return self
+
+    # -- matching -----------------------------------------------------------
+    def detect(self, graph: Graph) -> List[Dict[str, Node]]:
+        matches: List[Dict[str, Node]] = []
+        nodes = list(graph.nodes)
+
+        def consistent(binding, pd, cand):
+            for src, dst in self.links:
+                if src == pd.name and dst in binding:
+                    if cand not in binding[dst].inputs:
+                        return False
+                if dst == pd.name and src in binding:
+                    if binding[src] not in cand.inputs:
+                        return False
+            return True
+
+        def backtrack(i, binding):
+            if i == len(self.pattern):
+                matches.append(dict(binding))
+                return
+            pd = self.pattern[i]
+            for cand in nodes:
+                if cand in binding.values():
+                    continue
+                if not pd.predicate(cand):
+                    continue
+                if not consistent(binding, pd, cand):
+                    continue
+                if pd.intermediate and cand.is_var():
+                    # all consumers must be inside the pattern once the
+                    # match completes; cheap precheck: writer exists
+                    if not cand.inputs:
+                        continue
+                binding[pd.name] = cand
+                backtrack(i + 1, binding)
+                del binding[pd.name]
+
+        backtrack(0, {})
+        return self._filter_intermediates(matches)
+
+    def _filter_intermediates(self, matches):
+        """Drop matches whose intermediate vars leak outside the match
+        (they can't be deleted) and overlapping matches (first wins,
+        the reference's behavior when a node is consumed by an earlier
+        rewrite)."""
+        out, used = [], set()
+        for m in matches:
+            bound = set(id(n) for n in m.values())
+            ok = True
+            for pd in self.pattern:
+                n = m[pd.name]
+                if id(n) in used:
+                    ok = False
+                    break
+                if pd.intermediate and n.is_var():
+                    if any(id(r) not in bound for r in n.outputs):
+                        ok = False
+                        break
+            if ok:
+                out.append(m)
+                used.update(id(n) for n in m.values()
+                            if n.is_op() or
+                            self._by_name_of(m, n).intermediate)
+        return out
+
+    def _by_name_of(self, match, node):
+        for name, n in match.items():
+            if n is node:
+                return self._by_name[name]
+        raise AssertionError
+
+    def apply(self, graph: Graph,
+              handler: Callable[[Dict[str, Node], Graph], None]) -> int:
+        """Run handler per match; returns the match count (the
+        reference detector's operator())."""
+        matches = self.detect(graph)
+        for m in matches:
+            handler(m, graph)
+        return len(matches)

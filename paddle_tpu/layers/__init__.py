@@ -2,13 +2,13 @@
 (reference: python/paddle/fluid/layers/__init__.py)."""
 
 from . import math_op_patch  # noqa: F401  (registers Variable operators)
-from .control_flow import (DynamicRNN, IfElse, StaticRNN,  # noqa: F401
-                           Switch, While, array_length, array_read,
-                           array_write, create_array, equal,
-                           greater_equal, greater_than, is_empty,
-                           less_equal, less_than, logical_and,
-                           logical_not, logical_or, logical_xor,
-                           not_equal)
+from .control_flow import (DynamicRNN, IfElse, Print,  # noqa: F401
+                           StaticRNN, Switch, While, array_length,
+                           array_read, array_write, create_array,
+                           equal, greater_equal, greater_than,
+                           is_empty, less_equal, less_than,
+                           logical_and, logical_not, logical_or,
+                           logical_xor, not_equal)
 from . import detection  # noqa: F401
 from .detection import *  # noqa: F401,F403
 from .io import data  # noqa: F401

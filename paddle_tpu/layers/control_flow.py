@@ -30,7 +30,7 @@ __all__ = ["less_than", "less_equal", "greater_than", "greater_equal",
            "equal", "not_equal", "logical_and", "logical_or",
            "logical_xor", "logical_not", "is_empty", "While",
            "StaticRNN", "DynamicRNN", "IfElse", "Switch", "create_array",
-           "array_write", "array_read", "array_length"]
+           "array_write", "array_read", "array_length", "Print"]
 
 
 def _cmp(op_type, x, y, cond=None):
@@ -808,3 +808,22 @@ class Switch:
                     return True
             b = b.parent_block
         return False
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Print a variable's runtime value each step (reference:
+    layers/control_flow.py Print -> operators/print_op.cc; the host
+    printer is platform/lodtensor_printer.cc). Pass-through: returns a
+    var carrying the same value so the print stays in the op graph."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or input.name,
+                            "first_n": first_n, "summarize": summarize,
+                            "print_phase": print_phase})
+    return out

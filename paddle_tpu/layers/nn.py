@@ -76,8 +76,31 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
               name=None):
     """Reference: layers/nn.py embedding -> lookup_table_op.cc. On TPU
     the table is a dense HBM array; ``is_sparse`` is accepted for parity
-    (XLA's gather/scatter-add covers the SelectedRows path)."""
+    (XLA's gather/scatter-add covers the SelectedRows path).
+
+    ``is_distributed=True`` requests a table too large for device HBM:
+    no parameter is created — the rows live host-side across pserver
+    processes (distributed.LargeScaleKV) and the lookup result enters
+    the program as a feed-like data var. The runtime
+    (distributed.SparseEmbeddingRuntime) prefetches the batch's rows
+    before each step and pushes the sparse grads after — the analog of
+    _replace_lookup_table_op_with_prefetch
+    (distribute_transpiler.py:1372) + parameter_prefetch.cc."""
     helper = LayerHelper("embedding", name=name)
+    if is_distributed:
+        from .. import unique_name
+        table = name or unique_name.generate("dist_table")
+        out_shape = tuple(input.shape) + (size[1],)
+        out = helper.main_program.global_block().create_var(
+            name=unique_name.generate(table + "_prefetch"),
+            shape=out_shape, dtype=dtype, is_data=True)
+        meta = getattr(helper.main_program, "_distributed_lookups", None)
+        if meta is None:
+            meta = helper.main_program._distributed_lookups = []
+        meta.append({"table": table, "ids": input.name,
+                     "out": out.name, "rows": size[0],
+                     "dim": size[1]})
+        return out
     w = helper.create_parameter(attr=param_attr, shape=tuple(size),
                                 dtype=dtype)
     out = helper.create_variable_for_type_inference(dtype)

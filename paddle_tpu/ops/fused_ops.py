@@ -1,0 +1,60 @@
+"""Fused composite ops produced by ir fusion passes.
+
+Reference: paddle/fluid/operators/fused/fused_elemwise_activation_op.cc
+and operators/fc_op (the fc op the fc_fuse_pass emits,
+framework/ir/fc_fuse_pass.cc). On TPU these exist for *program-level*
+compactness — fewer ops in serialized inference programs and shorter
+traces — not for kernel-launch savings (XLA fuses either way).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .math_ops import _bcast_y
+from .registry import register
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+    "identity": lambda x: x,
+    "": lambda x: x,
+}
+
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+@register("fused_elemwise_activation", ["X", "Y"], ["Out"])
+def fused_elemwise_activation(x, y, *, functor_list, axis=-1):
+    """functor_list = [binary, unary] (binary first, e.g.
+    ["elementwise_add", "relu"]) or [unary, binary] for
+    act-then-add. Reference: fused_elemwise_activation_op.h functor
+    composition. Broadcast follows the fluid elementwise convention
+    (math_ops._bcast_y — the same helper the unfused ops use)."""
+    f0, f1 = functor_list
+    if f0 in _BINARY:
+        out = _BINARY[f0](x, _bcast_y(x, y, axis))
+        return _UNARY[f1](out)
+    return _BINARY[f1](_UNARY[f0](x), _bcast_y(x, y, axis))
+
+
+@register("fc", ["Input", "W", "Bias"], ["Out"])
+def fc(x, w, bias, *, in_num_col_dims=1, activation_type=""):
+    """The fc_fuse_pass target op (reference: operators/fc_op.cc;
+    ir/fc_fuse_pass.cc rewrites mul+elementwise_add(+act) into it)."""
+    lead = x.shape[:in_num_col_dims]
+    k = 1
+    for d in x.shape[in_num_col_dims:]:
+        k *= d
+    x2 = x.reshape(lead + (k,))
+    out = jnp.matmul(x2, w)
+    if bias is not None:
+        out = out + bias
+    return _UNARY[activation_type](out)

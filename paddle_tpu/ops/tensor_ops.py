@@ -11,6 +11,7 @@ shape-producing ops return trace-time constants where possible.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -308,3 +309,30 @@ def is_empty(x):
     """Static-shape emptiness test (reference:
     controlflow/is_empty_op.cc) — a compile-time constant under XLA."""
     return jnp.asarray(x.size == 0)
+
+
+@register("print", ["X"], ["Out"])
+def print_op(x, *, message="", first_n=-1, summarize=20,
+             print_phase="both"):
+    """Host-side value printing from inside the compiled step
+    (reference: operators/print_op.cc + the fetch-var printing of
+    platform/lodtensor_printer.cc). Lowered to a debug callback: the
+    device ships the value to the host printer without breaking the
+    XLA program. ``first_n`` limits prints with a host-side counter
+    (callback runs once per executed step, so the counter sees real
+    executions, not traces)."""
+    state = {"n": 0}
+
+    def _emit(val):
+        if first_n >= 0 and state["n"] >= first_n:
+            return
+        state["n"] += 1
+        import numpy as _np
+        flat = _np.asarray(val).reshape(-1)
+        shown = flat[:summarize] if summarize >= 0 else flat
+        print("%s shape=%s %s%s" % (
+            message or "print_op", _np.asarray(val).shape,
+            shown, "..." if shown.size < flat.size else ""))
+
+    jax.debug.callback(_emit, x)
+    return x

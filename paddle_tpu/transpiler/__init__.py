@@ -1,4 +1,4 @@
-"""Distribute/memory transpilers — API-compatible front ends.
+"""Distribute/memory transpilers.
 
 Reference: python/paddle/fluid/transpiler/ (distribute_transpiler.py:178
 DistributeTranspiler — slices params into blocks :69,:1286, rewrites
@@ -6,26 +6,34 @@ trainer programs with send/recv :646, generates pserver programs with
 server-side optimize blocks :780; ps_dispatcher.py round-robin/hash
 placement; memory_optimization_transpiler.py).
 
-TPU-native redesign: the parameter-server topology dissolves. Dense
-params + optimizer state shard over the mesh (ZeRO-style
-ReduceStrategy.Reduce — the kReduce strategy was exactly the PS
-update-sharding idea in-graph), and collectives replace send/recv.
-``DistributeTranspiler`` keeps the reference's API so launch scripts
-run unchanged:
-  - mode="nccl2" (collective DP): returns the program untouched and
-    records trainer topology; run it under CompiledProgram/fleet with
-    a pod mesh (multihost.init_parallel_env is the gen_nccl_id
-    analog).
-  - PS mode: get_trainer_program() returns the original program
-    configured for sharded-state execution; get_pserver_program()
-    raises with guidance — there is no separate server process to run
-    on a TPU pod.
+TPU-native split by mode:
+  - mode="nccl2" (collective DP): the program is returned untouched and
+    topology recorded; the pod mesh + GSPMD collectives replace
+    inserted allreduce ops (multihost.init_parallel_env is the
+    gen_nccl_id analog). **This is the mode for TPU pods.**
+  - PS mode is REAL: get_trainer_program() strips the optimize ops
+    (they move server-side — run it through
+    distributed.ParameterServerRuntime, which sends grads / recvs
+    params around each step), get_pserver_program(endpoint) builds a
+    program holding that server's params + their update ops, and the
+    distributed package (native tensor_rpc transport + ListenAndServ
+    loop) moves grads/params over DCN — the reference's
+    send/recv/listen_and_serv path (listen_and_serv_op.cc:109) for CPU
+    PS clusters and asynchronous SGD. The ORIGINAL program (optimize
+    ops intact) additionally gets the ZeRO-style sharded-state
+    BuildStrategy, so pod launches without pservers keep running it
+    directly. The optimize-op split is validated lazily, on the first
+    call that needs it — transpile() itself accepts any program.
 """
 
 from __future__ import annotations
 
+import warnings
+from typing import Dict, List
+
 from ..core.enforce import UnavailableError, enforce
-from ..framework import Program, default_main_program
+from ..framework import (Parameter, Program, default_main_program,
+                         default_startup_program, grad_var_name)
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
            "memory_optimize", "release_memory", "HashName",
@@ -55,6 +63,9 @@ class _PSDispatcher:
     def dispatch(self, varlist):
         raise NotImplementedError
 
+    def reset(self):
+        self._step = 0
+
 
 class RoundRobin(_PSDispatcher):
     """Reference: ps_dispatcher.py RoundRobin."""
@@ -76,6 +87,23 @@ class HashName(_PSDispatcher):
                 for v in varlist]
 
 
+def _copy_op(dst_block, op):
+    return dst_block.append_op(type=op.type, inputs=dict(op.inputs),
+                               outputs=dict(op.outputs),
+                               attrs=dict(op.attrs))
+
+
+def _copy_var(dst_block, var, **over):
+    if var.name in dst_block.vars:
+        return dst_block.vars[var.name]
+    kw = dict(name=var.name, shape=var.shape, dtype=var.dtype,
+              persistable=var.persistable)
+    kw.update(over)
+    if isinstance(var, Parameter) and not over:
+        return dst_block.create_parameter(**kw)
+    return dst_block.create_var(**kw)
+
+
 class DistributeTranspiler:
     """Reference: distribute_transpiler.py:178 (see module docstring
     for the TPU mapping)."""
@@ -84,22 +112,30 @@ class DistributeTranspiler:
         self.config = config or DistributeTranspilerConfig()
         self._transpiled = False
 
-    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6170",
-                  trainers=1, sync_mode=True, startup_program=None,
+    def transpile(self, trainer_id, program=None,
+                  pservers="127.0.0.1:6170", trainers=1, sync_mode=True,
+                  startup_program=None,
                   current_endpoint="127.0.0.1:6170"):
         self.trainer_id = trainer_id
         self.trainer_num = trainers if isinstance(trainers, int) \
             else len(trainers.split(","))
         self.sync_mode = sync_mode
         self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or \
+            default_startup_program()
         self.pserver_endpoints = pservers.split(",")
+        self.current_endpoint = current_endpoint
+        self._split_done = False
         self._transpiled = True
         if self.config.mode == "nccl2":
             # collective mode: topology only; the pod mesh + GSPMD
             # collectives replace inserted allreduce ops
             return
-        # PS mode: dense parameter serving maps to ZeRO-sharded state;
-        # annotate the program so CompiledProgram defaults to Reduce
+        # Annotate for pod execution: dense parameter serving maps to
+        # ZeRO-sharded state when the ORIGINAL program runs WITHOUT
+        # pservers. The PS split itself is computed lazily so models
+        # this PS split can't express (LR schedules, global clip)
+        # still transpile for pod use.
         from ..compiler import BuildStrategy
         bs = BuildStrategy()
         bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
@@ -107,24 +143,215 @@ class DistributeTranspiler:
         bs.trainer_id = trainer_id
         self.origin_program._build_strategy = bs
 
+    # -- analysis -----------------------------------------------------------
+    def _ensure_split(self):
+        enforce(self._transpiled, "call transpile() first")
+        enforce(self.config.mode != "nccl2",
+                "PS products are undefined in nccl2 (collective) mode")
+        if not self._split_done:
+            self._split_optimize_ops()
+            self._split_done = True
+
+    def _split_optimize_ops(self):
+        """Group op_role=optimize ops by the parameter they update (the
+        analog of the reference's per-param pserver optimize blocks,
+        get_pserver_program:780). Ops reachable into more than one
+        param's update (shared counters, global-norm clip, lr
+        schedules) have no per-param home — the reference runs them in
+        a dedicated server block; unsupported here."""
+        block = self.origin_program.global_block()
+        opt_ops = [op for op in block.ops
+                   if op.attrs.get("op_role") == "optimize"]
+        self._opt_ops = opt_ops
+        pos = {id(op): i for i, op in enumerate(block.ops)}
+        produced: Dict[str, List] = {}
+        for op in opt_ops:
+            for n in op.output_arg_names:
+                produced.setdefault(n, []).append(op)
+
+        def closure(op, acc):
+            if id(op) in acc:
+                return
+            acc[id(op)] = op
+            for n in op.input_arg_names:
+                for prod in produced.get(n, []):
+                    closure(prod, acc)
+
+        self._param_ops: Dict[str, List] = {}
+        owner: Dict[int, str] = {}
+        shared = set()
+        for op in opt_ops:
+            pnames = op.input("Param")
+            if not pnames:
+                continue
+            pname = pnames[0]
+            acc: Dict[int, object] = {}
+            closure(op, acc)
+            self._param_ops[pname] = sorted(
+                acc.values(), key=lambda o: pos[id(o)])
+            for oid in acc:
+                if oid in owner and owner[oid] != pname:
+                    shared.add(oid)
+                owner[oid] = pname
+        if shared:
+            types = sorted({o.type for ops in self._param_ops.values()
+                            for o in ops if id(o) in shared})
+            raise UnavailableError(
+                "PS mode cannot split optimize ops shared across "
+                "parameters (%s) — global-norm clip / LR schedules / "
+                "shared counters run per server block in the "
+                "reference; use a constant learning rate and per-param "
+                "clip, or collective (nccl2) mode" % ", ".join(types))
+        covered = {id(o) for ops in self._param_ops.values()
+                   for o in ops}
+        # Server-side ops may only consume: the param's grad, persistable
+        # state, or values produced inside their own group. A value
+        # computed by regular trainer ops each step (decayed LR, global
+        # grad norm) has no transport here — the reference gives those a
+        # dedicated server block (:1527); unsupported.
+        opt_ids = {id(o) for o in opt_ops}
+        produced_by_trainer = set()
+        for op in block.ops:
+            if id(op) not in opt_ids:
+                produced_by_trainer.update(op.output_arg_names)
+        for pname, ops in self._param_ops.items():
+            internal = {n for o in ops for n in o.output_arg_names}
+            for o in ops:
+                for n in o.input_arg_names:
+                    if n in internal or n == grad_var_name(pname):
+                        continue
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable:
+                        continue
+                    if n in produced_by_trainer:
+                        raise UnavailableError(
+                            "PS mode: update of %r consumes %r which "
+                            "is recomputed by trainer ops every step "
+                            "(LR schedule / global clip?). Use a "
+                            "constant learning rate and per-param "
+                            "clip, or collective (nccl2) mode"
+                            % (pname, n))
+        dangling = [op.type for op in opt_ops
+                    if id(op) not in covered]
+        if dangling:
+            warnings.warn("optimize ops with no Param slot stay on the "
+                          "trainer: %s" % sorted(set(dangling)))
+        # placement
+        dispatcher = self.config.split_method(self.pserver_endpoints)
+        params = [block.vars[p] for p in sorted(self._param_ops)]
+        eps = dispatcher.dispatch(params)
+        self._placement = {p.name: ep for p, ep in zip(params, eps)}
+
+    # -- products -----------------------------------------------------------
     def get_trainer_program(self, wait_port=True) -> Program:
         enforce(self._transpiled, "call transpile() first")
-        return self.origin_program
+        if self.config.mode == "nccl2":
+            return self.origin_program
+        self._ensure_split()
+        split = {id(o) for ops in self._param_ops.values() for o in ops}
+        trainer = self.origin_program.clone()
+        blk = trainer.global_block()
+        orig_ops = self.origin_program.global_block().ops
+        keep = [i for i, op in enumerate(orig_ops)
+                if id(op) not in split]
+        blk.ops = [blk.ops[i] for i in keep]
+        trainer._bump()
+        return trainer
 
-    def get_pserver_program(self, endpoint):
-        raise UnavailableError(
-            "there are no parameter-server processes on a TPU pod: "
-            "dense parameters shard over the device mesh "
-            "(BuildStrategy.ReduceStrategy.Reduce — already set on the "
-            "trainer program by transpile()); launch every process as "
-            "a trainer with parallel.multihost.init_parallel_env()")
+    def _append_param_ops(self, prog, pname):
+        src = self.origin_program.global_block()
+        blk = prog.global_block()
+        for op in self._param_ops[pname]:
+            for n in op.input_arg_names:
+                v = src._find_var_recursive(n)
+                if v is None:
+                    continue
+                if n == grad_var_name(pname):
+                    _copy_var(blk, v, persistable=False, is_data=True,
+                              shape=src.vars[pname].shape)
+                else:
+                    _copy_var(blk, v)
+            for n in op.output_arg_names:
+                v = src._find_var_recursive(n)
+                if v is not None:
+                    _copy_var(blk, v)
+            _copy_op(blk, op)
+        return prog
+
+    def get_param_program(self, pname) -> Program:
+        """One param's server-side update as a standalone program (the
+        per-param optimize block, reference :780); its Grad var is the
+        feed."""
+        self._ensure_split()
+        return self._append_param_ops(Program(), pname)
+
+    def get_pserver_program(self, endpoint) -> Program:
+        """Program holding this endpoint's params, their optimizer
+        state, and update ops; each Grad input becomes a feed var.
+        (Reference: get_pserver_program:780.)"""
+        self._ensure_split()
+        enforce(endpoint in self.pserver_endpoints,
+                "endpoint %r not in %s" % (endpoint,
+                                           self.pserver_endpoints))
+        prog = Program()
+        for pname in sorted(self._param_ops):
+            if self._placement[pname] == endpoint:
+                self._append_param_ops(prog, pname)
+        return prog
+
+    def params_on(self, endpoint) -> List[str]:
+        self._ensure_split()
+        return sorted(p for p, ep in self._placement.items()
+                      if ep == endpoint)
 
     def get_pserver_programs(self, endpoint):
-        return self.get_pserver_program(endpoint)
+        return (self.get_pserver_program(endpoint),
+                self.get_startup_program(endpoint))
 
     def get_startup_program(self, endpoint=None, pserver_program=None,
-                            startup_program=None):
-        return self.get_pserver_program(endpoint)
+                            startup_program=None) -> Program:
+        """Init ops (from the trainer startup program) for the vars the
+        pserver program owns. ``endpoint`` defaults to the
+        current_endpoint recorded by transpile()."""
+        enforce(self._transpiled, "call transpile() first")
+        self._ensure_split()
+        if endpoint is None:
+            endpoint = self.current_endpoint
+        pserver_program = pserver_program or \
+            self.get_pserver_program(endpoint)
+        want = {n for n, v in
+                pserver_program.global_block().vars.items()
+                if v.persistable}
+        src = self.startup_program.global_block()
+        prog = Program()
+        prog.random_seed = self.startup_program.random_seed
+        blk = prog.global_block()
+        for op in src.ops:
+            outs = set(op.output_arg_names)
+            if not outs & want:
+                continue
+            for n in list(op.input_arg_names) + list(outs):
+                v = src._find_var_recursive(n)
+                if v is not None:
+                    _copy_var(blk, v)
+            _copy_op(blk, op)
+        return prog
+
+    # -- runtime hooks (consumed by distributed.ps) -------------------------
+    def param_placement(self) -> Dict[str, str]:
+        self._ensure_split()
+        return dict(self._placement)
+
+    def grad_to_param(self) -> Dict[str, str]:
+        """grad var name -> param name, for the trainer's send loop."""
+        self._ensure_split()
+        return {grad_var_name(p): p for p in self._param_ops}
+
+    def param_grad_table(self) -> Dict[str, str]:
+        """param -> the Grad var its update op consumes (feed name on
+        the pserver)."""
+        self._ensure_split()
+        return {p: grad_var_name(p) for p in self._param_ops}
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,

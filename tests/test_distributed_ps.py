@@ -1,0 +1,436 @@
+"""Distributed PS runtime tests: native RPC transport, transpiler PS
+split, server loop, sparse lookup service.
+
+Methodology: the reference's distributed pass criterion is loss-trace
+equality between the distributed and local runs
+(test_dist_base.py:316). Pservers here run as in-process threads over
+real TCP sockets (the C++ tensor_rpc transport) — the same wire path
+as separate processes, minus the fork cost; the 2-process fleet test
+(test_fleet.py) covers true process isolation."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.distributed import (Communicator, LargeScaleKV,
+                                    ListenAndServ, LookupServiceClient,
+                                    ParameterServerRuntime,
+                                    PServerRuntime, RPCClient,
+                                    RPCServer)
+from paddle_tpu.transpiler import (DistributeTranspiler,
+                                   DistributeTranspilerConfig)
+
+
+class TestRPCTransport:
+    def test_roundtrip_and_errors(self, rng):
+        store = {}
+        srv = RPCServer("127.0.0.1:0")
+        from paddle_tpu.io import deserialize_tensor, serialize_tensor
+
+        def on_send(name, payload):
+            store[name], _ = deserialize_tensor(payload)
+            return b""
+
+        def on_get(name, payload):
+            if name not in store:
+                raise KeyError(name)
+            return serialize_tensor(store[name])
+
+        srv.register("SEND", on_send).register("GET", on_get).start()
+        try:
+            c = RPCClient(srv.endpoint)
+            w = rng.rand(37, 5).astype(np.float32)
+            c.send_var("w", w)
+            np.testing.assert_array_equal(c.get_var("w"), w)
+            # large payload crosses several socket buffers
+            big = rng.rand(512, 1024).astype(np.float32)
+            c.send_var("big", big)
+            np.testing.assert_array_equal(c.get_var("big"), big)
+            # handler exception -> client-side error, connection survives
+            with pytest.raises(Exception):
+                c.get_var("missing")
+            np.testing.assert_array_equal(c.get_var("w"), w)
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_concurrent_clients(self, rng):
+        vals = {}
+        lock = threading.Lock()
+        srv = RPCServer("127.0.0.1:0")
+        from paddle_tpu.io import deserialize_tensor
+
+        def on_send(name, payload):
+            arr, _ = deserialize_tensor(payload)
+            with lock:
+                vals[name] = vals.get(name, 0.0) + float(arr.sum())
+            return b""
+
+        srv.register("SEND", on_send).start()
+        try:
+            def worker(i):
+                c = RPCClient(srv.endpoint)
+                for k in range(5):
+                    c.send_var("x", np.full((4,), 1.0, np.float32))
+                c.close()
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert vals["x"] == pytest.approx(4 * 5 * 4.0)
+        finally:
+            srv.shutdown()
+
+
+def _build_mlp(seed=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(rng, n):
+    return [{"x": rng.rand(16, 8).astype(np.float32),
+             "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+            for _ in range(n)]
+
+
+class TestTranspilerPS:
+    def test_split(self):
+        main, startup, loss = _build_mlp()
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0,127.0.0.1:1", trainers=1)
+        trainer = t.get_trainer_program()
+        assert not any(op.attrs.get("op_role") == "optimize"
+                       for op in trainer.global_block().ops)
+        # 4 params (2 w + 2 b) round-robin over 2 endpoints
+        placement = t.param_placement()
+        assert len(placement) == 4
+        assert len(set(placement.values())) == 2
+        for ep in t.pserver_endpoints:
+            prog = t.get_pserver_program(ep)
+            sgd_ops = [op for op in prog.global_block().ops
+                       if op.type == "sgd"]
+            assert len(sgd_ops) == len(t.params_on(ep))
+            sp = t.get_startup_program(ep)
+            inited = {n for op in sp.global_block().ops
+                      for n in op.output_arg_names}
+            for p in t.params_on(ep):
+                assert p in inited
+
+    def test_shared_optimize_ops_rejected(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            pred = layers.fc(x, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            lr = layers.exponential_decay(0.1, 10, 0.9)
+            fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+        t = DistributeTranspiler()
+        # transpile() itself accepts anything (the pod-fallback path);
+        # the PS split validates lazily on first product access
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0", trainers=1)
+        with pytest.raises(Exception, match="constant learning rate"):
+            t.get_trainer_program()
+        # a second trainer-program call reports the same clear error
+        # (not a half-initialized AttributeError)
+        with pytest.raises(Exception, match="constant learning rate"):
+            t.get_pserver_program("127.0.0.1:0")
+
+
+class TestPSTraining:
+    def _local_losses(self, feeds):
+        main, startup, loss = _build_mlp()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = []
+            for f in feeds:
+                (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+        return out, {
+            n: np.asarray(scope.find_var(n))
+            for n in main.global_block().vars
+            if main.global_block().vars[n].persistable
+            and scope.find_var(n) is not None}
+
+    def test_sync_ps_matches_local(self, rng):
+        feeds = _feeds(rng, 4)
+        local, local_params = self._local_losses(feeds)
+
+        main, startup, loss = _build_mlp()
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0,127.0.0.1:0", trainers=1)
+        # bind both pservers on ephemeral ports, fix up placement
+        servers = [PServerRuntime(t, ep)
+                   for ep in list(t.pserver_endpoints)]
+        real_eps = {old: s.serv.endpoint
+                    for old, s in zip(t.pserver_endpoints, servers)}
+        # NOTE: both old endpoints are "127.0.0.1:0" -> indistinguishable;
+        # rebuild placement by server ownership instead
+        placement = {}
+        for s in servers:
+            for p in s._minis:
+                placement[p] = s.serv.endpoint
+        t._placement = placement
+        for s in servers:
+            s.serv.server.start()
+
+        trainer = t.get_trainer_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rt = ParameterServerRuntime(t, trainer, scope)
+            rt.init_params()
+            dist = []
+            for f in feeds:
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                dist.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+        for s in servers:
+            s.serv.shutdown()
+
+        # the dist initial params come from the PSERVER init (different
+        # op-index RNG folds), so compare against a local run seeded
+        # from the same server values
+        main2, startup2, loss2 = _build_mlp()
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor()
+            exe2.run(startup2)
+            for s in servers:
+                for p in s._minis:
+                    scope2.set_var(p, np.asarray(s.scope.find_var(p)))
+            ref = []
+            for f in feeds:
+                (lv,) = exe2.run(main2, feed=f, fetch_list=[loss2])
+                ref.append(float(np.asarray(lv).reshape(-1)[0]))
+        np.testing.assert_allclose(dist, ref, rtol=1e-5,
+                                   err_msg="PS loss trace != local")
+        # sanity: training moved the loss
+        assert dist[-1] < dist[0]
+
+    def test_two_trainer_sync_barrier(self, rng):
+        """Two trainers through one pserver: the deferred barrier must
+        release both (a blocking barrier would deadlock the drain
+        thread), and each sync step applies the SUM of both trainers'
+        grads."""
+        feeds_a = _feeds(rng, 3)
+        feeds_b = _feeds(rng, 3)
+
+        main, startup, loss = _build_mlp()
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0", trainers=2)
+        s = PServerRuntime(t, t.pserver_endpoints[0])
+        t._placement = {p: s.serv.endpoint for p in s._minis}
+        s.serv.server.start()
+        trainer = t.get_trainer_program()
+
+        results = {}
+
+        def run_trainer(tid, feeds):
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(startup, scope=scope)
+            rt = ParameterServerRuntime(t, trainer, scope)
+            rt.init_params()
+            out = []
+            for f in feeds:
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+            results[tid] = out
+
+        ts = [threading.Thread(target=run_trainer, args=(i, fs))
+              for i, fs in enumerate([feeds_a, feeds_b])]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=120)
+            assert not th.is_alive(), "trainer thread hung (barrier?)"
+        s.serv.shutdown()
+        assert np.isfinite(results[0]).all()
+        assert np.isfinite(results[1]).all()
+
+    def test_async_mode_trains(self, rng):
+        feeds = _feeds(rng, 4)
+        main, startup, loss = _build_mlp()
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0", trainers=1, sync_mode=False)
+        s = PServerRuntime(t, t.pserver_endpoints[0])
+        t._placement = {p: s.serv.endpoint for p in s._minis}
+        s.serv.server.start()
+        trainer = t.get_trainer_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        sync_mode=False)
+            rt.init_params()
+            vals = []
+            for f in feeds:
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                vals.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+        s.serv.shutdown()
+        assert np.isfinite(vals).all()
+        assert vals[-1] < vals[0]
+
+
+class TestCommunicator:
+    def test_merge_batching(self, rng):
+        applied = []
+        srv = RPCServer("127.0.0.1:0")
+        from paddle_tpu.io import deserialize_tensor
+
+        def on_send(name, payload):
+            arr, _ = deserialize_tensor(payload)
+            applied.append(arr.copy())
+            return b""
+
+        srv.register("SEND", on_send).start()
+        try:
+            comm = Communicator({"w": srv.endpoint},
+                                max_merge_var_num=4).start()
+            for _ in range(8):
+                comm.send("w", np.ones((2,), np.float32))
+            comm.wait_sends(8)
+            comm.stop()
+            total = sum(a.sum() for a in applied)
+            assert total == pytest.approx(16.0)
+            # merging must have reduced the RPC count
+            assert len(applied) < 8
+        finally:
+            srv.shutdown()
+
+
+class TestSparseEmbeddingRuntime:
+    def test_ctr_model_with_criteo_scale_table(self, rng):
+        """A CTR net over a 1e8-row distributed table (lazily
+        materialized host-side — a dense grad of this table would be
+        ~3 TB): prefetch feeds the lookup, sparse push trains it, and
+        the loss goes down."""
+        from paddle_tpu.distributed import SparseEmbeddingRuntime
+
+        ROWS, DIM, SLOTS = 100_000_000, 8, 6
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            ids = layers.data(name="ids", shape=[SLOTS], dtype="int64")
+            label = layers.data(name="label", shape=[1],
+                                dtype="float32")
+            emb = layers.embedding(ids, size=[ROWS, DIM],
+                                   is_distributed=True)
+            flat = layers.reshape(emb, shape=[-1, SLOTS * DIM])
+            h = layers.fc(flat, size=16, act="relu")
+            logit = layers.fc(h, size=1)
+            loss = layers.mean(
+                layers.sigmoid_cross_entropy_with_logits(logit, label))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+        tables = [{"emb_tbl": LargeScaleKV(dim=DIM, optimizer="sgd",
+                                           lr=0.1, seed=2)}
+                  for _ in range(2)]
+        servers = [ListenAndServ("127.0.0.1:0", {}, lambda n, g: None,
+                                 lookup_tables=tb).start()
+                   for tb in tables]
+        # the auto-generated table name must match the hosted one
+        main._distributed_lookups[0]["table"] = "emb_tbl"
+        try:
+            srt = SparseEmbeddingRuntime(
+                main, [s.endpoint for s in servers])
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                # fixed batch so the embedding rows actually train
+                id_batch = rng.randint(0, ROWS, (32, SLOTS))
+                w_true = rng.randn(SLOTS) > 0
+                lbl = (id_batch[:, w_true].sum(1) % 2) \
+                    .reshape(-1, 1).astype(np.float32)
+                feed0 = {"ids": id_batch.astype(np.int64),
+                         "label": lbl}
+                losses = []
+                for _ in range(8):
+                    feed = srt.wrap_feed(feed0)
+                    out = exe.run(
+                        main, feed=feed,
+                        fetch_list=[loss] + srt.grad_fetch_names())
+                    losses.append(float(out[0].reshape(-1)[0]))
+                    srt.push_grads(feed, out[1:])
+            srt.close()
+            assert np.isfinite(losses).all()
+            assert losses[-1] < losses[0], losses
+            # rows materialized only for touched ids
+            touched = sum(tb["emb_tbl"].size() for tb in tables)
+            assert touched <= 32 * SLOTS
+        finally:
+            for s in servers:
+                s.shutdown()
+
+
+class TestLookupService:
+    def test_kv_lazy_init_and_update(self):
+        kv = LargeScaleKV(dim=4, optimizer="sgd", lr=1.0, seed=7)
+        rows = kv.pull([5, 5, 9])
+        np.testing.assert_array_equal(rows[0], rows[1])
+        # push grad 1.0 on id 5 twice (duplicates merge, ONE update)
+        before = rows[0].copy()
+        kv.push([5, 5], np.ones((2, 4), np.float32))
+        after = kv.pull([5])[0]
+        np.testing.assert_allclose(after, before - 2.0, rtol=1e-6)
+        assert kv.size() == 2
+
+    def test_adagrad_rows(self):
+        kv = LargeScaleKV(dim=2, optimizer="adagrad", lr=1.0, seed=1)
+        r0 = kv.pull([3])[0].copy()
+        kv.push([3], np.full((1, 2), 2.0, np.float32))
+        r1 = kv.pull([3])[0]
+        # adagrad: step = lr * g / (sqrt(g^2) + eps) ~= 1.0
+        np.testing.assert_allclose(r1, r0 - 1.0, atol=1e-4)
+
+    def test_sharded_service(self, rng):
+        tables = [{"emb": LargeScaleKV(dim=8, seed=11)} for _ in range(2)]
+        servers = [ListenAndServ("127.0.0.1:0", {}, lambda n, g: None,
+                                 lookup_tables=tb).start()
+                   for tb in tables]
+        try:
+            client = LookupServiceClient(
+                "emb", [s.endpoint for s in servers], dim=8)
+            ids = rng.randint(0, 10_000_000, size=(6, 3))
+            out = client.embed_batch(ids)
+            assert out.shape == (6, 3, 8)
+            # deterministic: same ids -> same rows
+            out2 = client.embed_batch(ids)
+            np.testing.assert_array_equal(out, out2)
+            # push a grad and observe the rows move
+            flat = ids.reshape(-1)
+            client.push(flat, np.ones((flat.size, 8), np.float32))
+            out3 = client.embed_batch(ids)
+            assert not np.allclose(out, out3)
+            client.close()
+        finally:
+            for s in servers:
+                s.shutdown()

@@ -1,0 +1,95 @@
+"""AnalysisPredictor tests: load, ir-optimize, serve.
+
+Reference methodology: inference api tests load a saved model and
+compare predictor output against the executor's
+(inference/tests/api/analyzer_*_tester.cc pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import (AnalysisConfig, AnalysisPredictor,
+                                  PaddleTensor,
+                                  create_paddle_predictor)
+
+
+def _save_conv_model(tmp_path, rng):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        c = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                          bias_attr=False)
+        bn = layers.batch_norm(c)
+        flat = layers.reshape(bn, shape=[-1, 8 * 8 * 8])
+        pred = layers.fc(flat, size=4, act="softmax")
+        loss = layers.mean(
+            layers.cross_entropy(
+                pred, layers.data(name="y", shape=[1], dtype="int64")))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(3):  # train a little so BN stats are non-trivial
+            exe.run(main, feed={
+                "img": rng.rand(8, 3, 8, 8).astype(np.float32),
+                "y": rng.randint(0, 4, (8, 1)).astype(np.int64)},
+                fetch_list=[loss])
+        feed = {"img": rng.rand(4, 3, 8, 8).astype(np.float32)}
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                      main_program=main, scope=scope)
+        (expect,) = exe.run(main.clone(for_test=True), feed={
+            "img": feed["img"],
+            "y": np.zeros((4, 1), np.int64)}, fetch_list=[pred],
+            scope=scope)
+    return d, feed, np.asarray(expect)
+
+
+class TestAnalysisPredictor:
+    def test_optimized_predict_matches_executor(self, tmp_path, rng):
+        d, feed, expect = _save_conv_model(tmp_path, rng)
+        pred = create_paddle_predictor(AnalysisConfig(d))
+        # conv_bn got folded, fc got fused
+        types = [op.type for op in
+                 pred.program.global_block().ops]
+        assert "batch_norm" not in types
+        assert "fc" in types
+        (out,) = pred.run([PaddleTensor(feed["img"])])
+        np.testing.assert_allclose(out.data, expect, atol=1e-4)
+
+    def test_ir_optim_off(self, tmp_path, rng):
+        d, feed, expect = _save_conv_model(tmp_path, rng)
+        cfg = AnalysisConfig(d).switch_ir_optim(False)
+        pred = AnalysisPredictor(cfg)
+        types = [op.type for op in
+                 pred.program.global_block().ops]
+        assert "batch_norm" in types
+        (out,) = pred.run([feed["img"]])
+        np.testing.assert_allclose(out.data, expect, atol=1e-5)
+
+    def test_pass_builder_delete(self, tmp_path, rng):
+        d, feed, expect = _save_conv_model(tmp_path, rng)
+        cfg = AnalysisConfig(d).delete_pass("conv_bn_fuse_pass")
+        pred = AnalysisPredictor(cfg)
+        types = [op.type for op in
+                 pred.program.global_block().ops]
+        assert "batch_norm" in types      # kept
+        assert "fc" in types              # fc fuse still ran
+
+    def test_input_validation(self, tmp_path, rng):
+        d, feed, _ = _save_conv_model(tmp_path, rng)
+        pred = AnalysisPredictor(AnalysisConfig(d))
+        assert pred.get_input_names() == ["img"]
+        assert len(pred.get_output_names()) == 1
+        with pytest.raises(Exception, match="expects 1 input"):
+            pred.run([feed["img"], feed["img"]])
+
+    def test_predict_dict_and_clone(self, tmp_path, rng):
+        d, feed, expect = _save_conv_model(tmp_path, rng)
+        pred = AnalysisPredictor(AnalysisConfig(d))
+        (a,) = pred.predict(feed)
+        (b,) = pred.clone().predict(feed)
+        np.testing.assert_allclose(a, b, atol=1e-6)

@@ -1,0 +1,73 @@
+"""Small parity surfaces: Print op, AsyncExecutor facade, device_info."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core import device_info
+
+
+class TestPrintOp:
+    def test_print_passthrough_and_first_n(self, capfd, rng):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            y = layers.Print(x, message="dbg_x", first_n=2,
+                             summarize=3)
+            out = layers.scale(y, scale=2.0)
+        exe = fluid.Executor()
+        feed = {"x": rng.rand(2, 4).astype(np.float32)}
+        for _ in range(4):
+            (res,) = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(res, feed["x"] * 2.0, rtol=1e-6)
+        captured = capfd.readouterr()
+        # first_n=2: printed on the first two executions only
+        assert captured.out.count("dbg_x") == 2
+
+
+class TestAsyncExecutor:
+    def test_run_from_files(self, tmp_path, rng):
+        # two MultiSlot shards ("<n> v1 ... vn" per slot,
+        # data_feed.h:353): label slot then 8-wide feature slot
+        files = []
+        for i in range(2):
+            p = tmp_path / ("part-%d.txt" % i)
+            rows = ["1 %d 8 %s" % (rng.randint(0, 2),
+                                   " ".join("%.4f" % v
+                                            for v in rng.rand(8)))
+                    for _ in range(64)]
+            p.write_text("\n".join(rows) + "\n")
+            files.append(str(p))
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            feat = layers.data(name="feat", shape=[8],
+                               dtype="float32")
+            pred = layers.fc(feat, size=2, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        ae = fluid.AsyncExecutor()
+        steps = ae.run(main,
+                       data_feed={"batch_size": 16,
+                                  "use_var": [label, feat]},
+                       filelist=files, thread_num=2)
+        assert steps == 8  # 128 rows / 16
+
+
+class TestDeviceInfo:
+    def test_host_info(self):
+        assert device_info.cpu_core_count() >= 1
+        mem = device_info.cpu_memory_bytes()
+        assert mem is None or mem > 1 << 20
+
+    def test_device_props(self):
+        assert device_info.device_count() == 8  # virtual CPU mesh
+        props = device_info.device_properties(0)
+        assert props["platform"] == "cpu"
+        all_props = device_info.all_device_properties()
+        assert len(all_props) == 8

@@ -161,6 +161,23 @@ spec("matmul", {"X": sgn((3, 2), 29), "Y": sgn((4, 3), 30)},
      ref=lambda ins: [ins["X"].T @ ins["Y"].T])
 spec("mul", {"X": sgn((2, 3), 31), "Y": sgn((3, 2), 32)},
      ref=lambda ins: [ins["X"] @ ins["Y"]])
+spec("fc", {"Input": sgn((2, 6), 131), "W": sgn((6, 4), 132),
+            "Bias": sgn((4,), 133)},
+     {"in_num_col_dims": 1, "activation_type": "relu"},
+     ref=lambda ins: [np.maximum(
+         ins["Input"] @ ins["W"] + ins["Bias"], 0)])
+spec("fc", {"Input": sgn((2, 6), 134), "W": sgn((6, 4), 135),
+            "Bias": sgn((4,), 136)},
+     {"in_num_col_dims": 1, "activation_type": ""},
+     ref=lambda ins: [ins["Input"] @ ins["W"] + ins["Bias"]])
+spec("fused_elemwise_activation",
+     {"X": u((2, 3), 137), "Y": u((2, 3), 138)},
+     {"functor_list": ["elementwise_add", "relu"], "axis": -1},
+     ref=lambda ins: [np.maximum(ins["X"] + ins["Y"], 0)])
+spec("fused_elemwise_activation",
+     {"X": u((2, 3), 139), "Y": u((3,), 140)},
+     {"functor_list": ["elementwise_add", "tanh"], "axis": 1},
+     ref=lambda ins: [np.tanh(ins["X"] + ins["Y"])])
 
 # --- reductions -------------------------------------------------------
 spec("reduce_sum", {"X": sgn((2, 3), 33)},
@@ -533,6 +550,7 @@ spec("dequantize_weight",
 # Ops exercised end-to-end in dedicated test files (the table must
 # still account for them — the ratchet below fails on unlisted ops).
 EXEMPT = {
+    "print": "test_misc_parity.py (host callback, pass-through)",
     "while": "test_control_flow.py (lax.while/scan lowering + grad)",
     "static_rnn": "test_sequence_rnn.py",
     "dynamic_rnn": "test_sequence_rnn.py",
