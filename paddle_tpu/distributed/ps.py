@@ -74,6 +74,12 @@ class ListenAndServ:
 
     # -- handlers (each runs on the server drain thread) -------------------
     def _on_send(self, name, payload):
+        # "var@@tid" carries the sender's trainer id (DC-ASGD needs
+        # per-trainer weight backups; reference enable_dc_asgd,
+        # _append_dc_asgd_ops :1849). Single drain thread -> the
+        # current_trainer_id attribute is race-free.
+        name, _, tid = name.partition("@@")
+        self.current_trainer_id = int(tid) if tid else 0
         grad, _ = deserialize_tensor(payload)
         with self._mu:
             if not self.sync_mode:
@@ -270,13 +276,19 @@ class PServerRuntime:
     def __init__(self, transpiler, endpoint, lookup_tables=None):
         from ..core.scope import Scope
         from ..executor import Executor
+        from ..framework import grad_var_name
         self.scope = Scope()
         self.exe = Executor()
         self.t = transpiler
         self.endpoint = endpoint
-        own = transpiler.params_on(endpoint)
-        self._minis = {p: transpiler.get_param_program(p) for p in own}
-        self._grad_name = transpiler.param_grad_table()
+        own = transpiler.params_on(endpoint)  # block names
+        self._minis = {b: transpiler.get_block_program(b) for b in own}
+        self._grad_name = {b: grad_var_name(b) for b in own}
+        self.dc_asgd = getattr(transpiler.config, "enable_dc_asgd",
+                               False) and not transpiler.sync_mode
+        self.dc_lambda = getattr(transpiler.config, "dc_asgd_lambda",
+                                 0.05)
+        self._dc_backup = {}
         startup = transpiler.get_startup_program(endpoint)
         self.exe.run(startup, scope=self.scope)
         self.serv = ListenAndServ(
@@ -285,10 +297,23 @@ class PServerRuntime:
             sync_mode=transpiler.sync_mode,
             lookup_tables=lookup_tables)
 
-    def _optimize(self, pname, grad):
-        self.exe.run(self._minis[pname],
-                     feed={self._grad_name[pname]: grad},
+    def _optimize(self, bname, grad):
+        if self.dc_asgd:
+            # delay compensation (reference _append_dc_asgd_ops:1849 /
+            # the DC-ASGD update): g' = g + lambda * g .* g .* (w_now -
+            # w_backup[trainer]); backup refreshed on this trainer's
+            # every apply.
+            tid = getattr(self.serv, "current_trainer_id", 0)
+            w = np.asarray(self.scope.find_var(bname))
+            bak = self._dc_backup.get((bname, tid), w)
+            grad = np.asarray(grad)
+            grad = grad + self.dc_lambda * grad * grad * (w - bak)
+        self.exe.run(self._minis[bname],
+                     feed={self._grad_name[bname]: grad},
                      scope=self.scope, fetch_list=[])
+        if self.dc_asgd:
+            self._dc_backup[(bname, tid)] = np.asarray(
+                self.scope.find_var(bname))
 
     def run(self):
         """Blocks until every trainer COMPLETEs."""
@@ -311,59 +336,93 @@ class ParameterServerRuntime:
         self.program = program
         self.scope = scope
         self.sync_mode = sync_mode
-        self.comm = Communicator(transpiler.param_placement())
+        self.blocks = transpiler.block_table()
+        # endpoint map for the communicator: block name -> endpoint
+        self.comm = Communicator({b["name"]: b["endpoint"]
+                                  for bs in self.blocks.values()
+                                  for b in bs})
+        self.dc_asgd = getattr(transpiler.config, "enable_dc_asgd",
+                               False) and not sync_mode
+        self._tid_suffix = "@@%d" % transpiler.trainer_id \
+            if self.dc_asgd else ""
+
+    def _assemble(self, pname, parts):
+        if len(parts) == 1:
+            return parts[0]
+        return np.concatenate(parts, axis=0)
 
     def init_params(self):
         """Adopt the server-side initial parameter values (the
         reference's post-init param sync: trainers recv before step 0,
         so every trainer starts from the pserver's init)."""
-        for pname in self.t.param_placement():
-            self.scope.set_var(pname, self.comm.recv(pname))
+
+        def recv(ep, blocks):
+            client = self.comm.client(ep)
+            for b in blocks:
+                b["_value"] = client.get_var(b["name"])
+
+        self._per_endpoint(recv)
+        for pname, bs in self.blocks.items():
+            self.scope.set_var(
+                pname, self._assemble(pname,
+                                      [b.pop("_value") for b in bs]))
 
     def _per_endpoint(self, fn):
-        """Run fn(endpoint, [param,...]) concurrently, one worker per
-        pserver — sends/recvs to different servers are independent, so
-        the step pays one round-trip per SERVER, not per PARAM (the
+        """Run fn(endpoint, [block,...]) concurrently, one worker per
+        pserver — transfers to different servers are independent, so
+        the step pays one round-trip per SERVER, not per BLOCK (the
         role of the reference's per-endpoint async channels,
         grpc_client.h connection-per-ep)."""
         from concurrent.futures import ThreadPoolExecutor
         by_ep: Dict[str, list] = {}
-        for pname, ep in self.t.param_placement().items():
-            by_ep.setdefault(ep, []).append(pname)
+        for bs in self.blocks.values():
+            for b in bs:
+                by_ep.setdefault(b["endpoint"], []).append(b)
+        for ep in by_ep:
+            by_ep[ep].sort(key=lambda b: b["name"])
         if len(by_ep) == 1:
-            ep, ps = next(iter(by_ep.items()))
-            fn(ep, sorted(ps))
+            ep, bs = next(iter(by_ep.items()))
+            fn(ep, bs)
             return
         with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
-            futs = [pool.submit(fn, ep, sorted(ps))
-                    for ep, ps in by_ep.items()]
+            futs = [pool.submit(fn, ep, bs)
+                    for ep, bs in by_ep.items()]
             for f in futs:
                 f.result()  # propagate RPC errors
 
     def run_step(self, exe, feed, fetch_list=None, return_numpy=True):
+        from ..framework import grad_var_name
         fetch_list = list(fetch_list or [])
-        grads = self.t.grad_to_param()  # grad var name -> param name
+        pnames = sorted(self.blocks)
+        gnames = [grad_var_name(p) for p in pnames]
         out = exe.run(self.program, feed=feed,
-                      fetch_list=fetch_list + sorted(grads),
+                      fetch_list=fetch_list + gnames,
                       scope=self.scope, return_numpy=False)
         user_out = out[:len(fetch_list)]
-        gvals = {grads[gname]: np.asarray(gval) for gname, gval in
-                 zip(sorted(grads), out[len(fetch_list):])}
+        gvals = {p: np.asarray(g) for p, g in
+                 zip(pnames, out[len(fetch_list):])}
 
-        def send(ep, pnames):
+        def send(ep, blocks):
             client = self.comm.client(ep)
-            for p in pnames:
-                client.send_var(p, gvals[p])
+            for b in blocks:
+                g = gvals[b["param"]]
+                if b["name"] != b["param"]:
+                    g = g[b["start"]:b["end"]]
+                client.send_var(b["name"] + self._tid_suffix, g)
 
-        def recv(ep, pnames):
+        def recv(ep, blocks):
             client = self.comm.client(ep)
-            for p in pnames:
-                self.scope.set_var(p, client.get_var(p))
+            for b in blocks:
+                b["_value"] = client.get_var(b["name"])
 
         self._per_endpoint(send)
         if self.sync_mode:
             self.comm.barrier_all("send")
         self._per_endpoint(recv)
+        for pname, bs in self.blocks.items():
+            self.scope.set_var(
+                pname, self._assemble(pname,
+                                      [b.pop("_value") for b in bs]))
         if self.sync_mode:
             self.comm.barrier_all("fetch")
         if return_numpy:

@@ -161,6 +161,11 @@ class RPCServer:
                                     resp, len(resp))
 
     def start(self):
+        if self._thread is not None:
+            # idempotent: a second start would spawn a second drain
+            # thread and break the single-drain-thread invariant the
+            # handlers rely on (DC-ASGD trainer attribution)
+            return self
         self._thread = threading.Thread(target=self.serve_forever,
                                         daemon=True)
         self._thread.start()

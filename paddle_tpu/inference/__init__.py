@@ -111,11 +111,8 @@ class AnalysisPredictor:
         the analysis passes over the loaded program — with the scope,
         because conv_bn folding rewrites trained weights."""
         from .. import ir
-        for name in self.config._passes:
-            p = ir.get_pass(name, scope=self.scope)
-            graph = ir.Graph(self.program)
-            p.apply(graph)
-            graph.to_program()
+        ir.apply_passes(self.program, self.config._passes,
+                        scope=self.scope)
 
     # -- serving ------------------------------------------------------------
     def run(self, inputs: Sequence) -> List[PaddleTensor]:

@@ -183,25 +183,26 @@ class Graph:
                 for w in vn.inputs:  # writer ops of each input var
                     deps.add(id(w))
             indeg[id(n)] = len(deps)
-        ready = sorted([n for n in ops if indeg[id(n)] == 0],
-                       key=lambda n: pos[id(n)])
+        import heapq
+        by_id = {id(n): n for n in ops}
+        ready = [(pos[id(n)], id(n)) for n in ops
+                 if indeg[id(n)] == 0]
+        heapq.heapify(ready)
         order: List[Node] = []
         while ready:
-            n = ready.pop(0)
+            _, nid = heapq.heappop(ready)
+            n = by_id[nid]
             order.append(n)
-            succs = set()
+            seen = set()
             for vn in n.outputs:
                 for r in vn.outputs:
-                    succs.add(id(r))
-            changed = False
-            for m in ops:
-                if id(m) in succs:
-                    indeg[id(m)] -= 1
-                    if indeg[id(m)] == 0:
-                        ready.append(m)
-                        changed = True
-            if changed:
-                ready.sort(key=lambda n: pos[id(n)])
+                    rid = id(r)
+                    if rid in seen or rid not in indeg:
+                        continue
+                    seen.add(rid)
+                    indeg[rid] -= 1
+                    if indeg[rid] == 0:
+                        heapq.heappush(ready, (pos[rid], rid))
         if len(order) != len(ops):
             raise InvalidArgumentError(
                 "graph has a cycle: %d of %d ops sorted"

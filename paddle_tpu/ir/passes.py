@@ -18,6 +18,12 @@ from .pass_base import Pass, register_pass
 from .pattern_detector import GraphPatternDetector, PDNode
 
 _ACTS = ("relu", "sigmoid", "tanh", "gelu")
+_HOUSEKEEPING_ATTRS = ("op_role", "op_namescope")
+
+
+def _act_attrs(op):
+    return {k: v for k, v in op.attrs.items()
+            if k not in _HOUSEKEEPING_ATTRS}
 
 
 def _slot_of(op, var_name, which="inputs"):
@@ -52,7 +58,10 @@ class FuseElewiseAddActPass(Pass):
                 {"X": [xs[0]], "Y": [ys[0]]},
                 {"Out": [m["out"]]},
                 {"functor_list": ["elementwise_add", act_op.type],
-                 "axis": add_op.attrs.get("axis", -1)})
+                 "axis": add_op.attrs.get("axis", -1),
+                 # the activation's own attrs ride along so fusion
+                 # never changes numerics (gelu approximate=False)
+                 "act_attrs": _act_attrs(act_op)})
             g.remove_nodes([m["add"], m["mid"], m["act"]])
 
         count = det.apply(graph, rewrite)
@@ -107,6 +116,11 @@ class FCFusePass(Pass):
                 bias_nodes = [n for n in m["add"].inputs
                               if n.name == bias_name]
                 if not bias_nodes or not bias_nodes[0].persistable:
+                    return
+                if with_act and _act_attrs(m["act"].op):
+                    # the fc op has no attr channel for the activation
+                    # (activation_type is a bare name); refuse rather
+                    # than silently change numerics
                     return
                 x_name = mul_op.input("X")[0]
                 w_name = mul_op.input("Y")[0]

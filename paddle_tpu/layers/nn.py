@@ -858,3 +858,421 @@ def scaled_dot_product_attention(q, k, v, bias=None, scale=1.0,
                             "causal": bool(causal),
                             "is_test": bool(is_test)})
     return out
+
+
+# ---------------------------------------------------------------------------
+# sequence-labeling / sampled losses (reference: layers/nn.py warpctc,
+# edit_distance, linear_chain_crf, crf_decoding, nce, hsigmoid,
+# sampled_softmax_with_cross_entropy, rank_loss, bpr_loss, cos_sim)
+# ---------------------------------------------------------------------------
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss (reference: layers/nn.py warpctc -> warpctc_op.cc).
+    Padded redesign: input [B, T, C] with input_length, label [B, L]
+    with label_length (the LoD form has no padded equivalent)."""
+    enforce(input_length is not None and label_length is not None,
+            "padded CTC needs input_length and label_length")
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label],
+                "LogitsLength": [input_length],
+                "LabelLength": [label_length]},
+        outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None):
+    """Greedy CTC decode: argmax per frame, collapse repeats, strip
+    blanks (reference: layers/nn.py ctc_greedy_decoder)."""
+    helper = LayerHelper("ctc_align")
+    ids = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int32")
+    out_len = helper.create_variable_for_type_inference("int32")
+    if input_length is None:
+        from . import tensor as _t
+        input_length = _t.fill_constant_batch_size_like(
+            input, shape=[-1, 1], dtype="int64",
+            value=input.shape[1] if len(input.shape) > 1 else 1)
+    helper.append_op(
+        type="ctc_align",
+        inputs={"Input": [ids], "InputLength": [input_length]},
+        outputs={"Output": [out], "OutputLength": [out_len]},
+        attrs={"blank": blank, "merge_repeated": True})
+    return out, out_len
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance (reference: layers/nn.py edit_distance)."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label],
+                "HypsLength": [input_length],
+                "RefsLength": [label_length]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF log-likelihood; creates the [D+2, D] transition parameter
+    (rows: start, stop, transitions — reference layout,
+    linear_chain_crf_op.h)."""
+    helper = LayerHelper("linear_chain_crf")
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=param_attr, shape=(size + 2, size), dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label], "Length": [length]},
+        outputs={"LogLikelihood": [ll]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode using a trained transition param (reference:
+    layers/nn.py crf_decoding). ``param_attr`` may be the transition
+    Variable itself or its ParamAttr/name.
+
+    Reference semantics for ``label``: when given, the output is a 0/1
+    CORRECTNESS mask (1 where the decoded tag differs from the label —
+    crf_decoding_op.h sets output to the mismatch indicator) rather
+    than the path itself."""
+    helper = LayerHelper("crf_decoding")
+    from ..framework import Variable as _Var
+    if isinstance(param_attr, _Var):
+        transition = param_attr
+    else:
+        name = getattr(param_attr, "name", param_attr)
+        transition = helper.main_program.global_block().var(name)
+    path = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="crf_decoding",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Length": [length]},
+        outputs={"ViterbiPath": [path]})
+    if label is not None:
+        from .control_flow import not_equal
+        from .tensor import cast
+        return cast(not_equal(path, label), "int64")
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False):
+    """Noise-contrastive estimation loss; creates the class weight and
+    bias (reference: layers/nn.py nce -> nce_op.cc)."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=(num_total_classes, dim),
+                                dtype=input.dtype)
+    b = None
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=(num_total_classes,),
+                                    dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="nce",
+        inputs={"Input": [input], "Weight": [w],
+                "Bias": [b] if b is not None else [],
+                "Label": [label]},
+        outputs={"Cost": [cost]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10,
+               "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None,
+             bias_attr=None, name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: layers/nn.py hsigmoid)."""
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=(num_classes - 1, dim),
+                                dtype=input.dtype)
+    b = None
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=(num_classes - 1,),
+                                    dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs={"X": [input], "W": [w],
+                "Bias": [b] if b is not None else [],
+                "Label": [label]},
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": num_classes})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, seed=0):
+    """Sampled softmax (reference: layers/nn.py
+    sampled_softmax_with_cross_entropy -> sample_logits_op.cc +
+    softmax_with_cross_entropy)."""
+    helper = LayerHelper("sample_logits")
+    sampled = helper.create_variable_for_type_inference(logits.dtype)
+    new_label = helper.create_variable_for_type_inference("int64")
+    samples = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="sample_logits",
+        inputs={"Logits": [logits], "Labels": [label]},
+        outputs={"SampledLogits": [sampled],
+                 "SampledLabels": [new_label], "Samples": [samples]},
+        attrs={"num_samples": num_samples, "seed": seed})
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    softmax = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [sampled], "Label": [new_label]},
+        outputs={"Loss": [loss], "Softmax": [softmax]},
+        attrs={"soft_label": False})
+    return loss
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="bpr_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn],
+                              "YNorm": [yn]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# vision layers (reference: layers/nn.py lrn, affine_channel, pool3d,
+# spectral_norm, row_conv, bilinear_tensor_product, temporal_shift,
+# shuffle_channel, space_to_depth, crop, pad_constant_like, multiplex,
+# image resize aliases)
+# ---------------------------------------------------------------------------
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha,
+                            "beta": beta})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="affine_channel",
+                     inputs={"X": [x], "Scale": [scale],
+                             "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None, exclusive=True):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def _3(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * 3
+
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"ksize": _3(pool_size),
+                            "pooling_type": pool_type,
+                            "strides": _3(pool_stride),
+                            "paddings": _3(pool_padding),
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode,
+                            "exclusive": exclusive})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Creates the persistable u/v power-iteration vectors (reference:
+    layers/nn.py spectral_norm)."""
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w_rest = 1
+    for i, d in enumerate(weight.shape):
+        if i != dim:
+            w_rest *= d
+    from ..initializer import Normal
+    u = helper.create_parameter(attr=None, shape=(h,),
+                                dtype=weight.dtype,
+                                default_initializer=Normal(0, 1))
+    v = helper.create_parameter(attr=None, shape=(w_rest,),
+                                dtype=weight.dtype,
+                                default_initializer=Normal(0, 1))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(type="spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None,
+             act=None):
+    helper = LayerHelper("row_conv", act=act)
+    filt = helper.create_parameter(
+        attr=param_attr, shape=(future_context_size + 1,
+                                input.shape[-1]),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [filt]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name, act=act)
+    w = helper.create_parameter(
+        attr=param_attr, shape=(size, x.shape[-1], y.shape[-1]),
+        dtype=x.dtype)
+    b = None
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=(1, size),
+                                    dtype=x.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product",
+                     inputs={"X": [x], "Y": [y], "Weight": [w],
+                             "Bias": [b] if b is not None else []},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="temporal_shift", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"seg_num": seg_num,
+                            "shift_ratio": shift_ratio})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": group})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"blocksize": blocksize})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape),
+                            "offsets_attr": tuple(offsets or
+                                                  [0] * len(shape))})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"pad_value": pad_value})
+    return out
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"Ids": [index], "X": list(inputs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": [input],
+                             "Labels": [label]},
+                     outputs={"OutMeanIou": [miou],
+                              "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """Image patches -> sequence (reference: layers/nn.py im2sequence
+    -> im2sequence_op.cc)."""
+    helper = LayerHelper("im2sequence", name=name)
+
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    pad = padding if isinstance(padding, (list, tuple)) and \
+        len(padding) == 4 else _pair(padding) * 2
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": _pair(filter_size),
+                            "strides": _pair(stride),
+                            "paddings": tuple(pad)})
+    return out

@@ -32,5 +32,7 @@ from . import rnn_ops  # noqa: F401,E402
 from . import beam_search_ops  # noqa: F401,E402
 from . import detection_ops  # noqa: F401,E402
 from . import quant_ops  # noqa: F401,E402
+from . import loss_ops  # noqa: F401,E402
+from . import vision_ops  # noqa: F401,E402
 from . import fused_ops  # noqa: F401,E402
 from . import pallas  # noqa: F401,E402

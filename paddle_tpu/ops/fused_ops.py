@@ -16,12 +16,12 @@ from .math_ops import _bcast_y
 from .registry import register
 
 _UNARY = {
-    "relu": jax.nn.relu,
-    "sigmoid": jax.nn.sigmoid,
-    "tanh": jnp.tanh,
-    "gelu": jax.nn.gelu,
-    "identity": lambda x: x,
-    "": lambda x: x,
+    "relu": lambda x, **kw: jax.nn.relu(x),
+    "sigmoid": lambda x, **kw: jax.nn.sigmoid(x),
+    "tanh": lambda x, **kw: jnp.tanh(x),
+    "gelu": lambda x, **kw: jax.nn.gelu(x, **kw),
+    "identity": lambda x, **kw: x,
+    "": lambda x, **kw: x,
 }
 
 _BINARY = {
@@ -32,17 +32,21 @@ _BINARY = {
 
 
 @register("fused_elemwise_activation", ["X", "Y"], ["Out"])
-def fused_elemwise_activation(x, y, *, functor_list, axis=-1):
+def fused_elemwise_activation(x, y, *, functor_list, axis=-1,
+                              act_attrs=None):
     """functor_list = [binary, unary] (binary first, e.g.
     ["elementwise_add", "relu"]) or [unary, binary] for
-    act-then-add. Reference: fused_elemwise_activation_op.h functor
-    composition. Broadcast follows the fluid elementwise convention
+    act-then-add; ``act_attrs`` carries the original activation op's
+    attrs (gelu approximate=...) so fusion preserves numerics.
+    Reference: fused_elemwise_activation_op.h functor composition.
+    Broadcast follows the fluid elementwise convention
     (math_ops._bcast_y — the same helper the unfused ops use)."""
     f0, f1 = functor_list
+    kw = dict(act_attrs or {})
     if f0 in _BINARY:
         out = _BINARY[f0](x, _bcast_y(x, y, axis))
-        return _UNARY[f1](out)
-    return _BINARY[f1](_UNARY[f0](x), _bcast_y(x, y, axis))
+        return _UNARY[f1](out, **kw)
+    return _BINARY[f1](_UNARY[f0](x, **kw), _bcast_y(x, y, axis))
 
 
 @register("fc", ["Input", "W", "Bias"], ["Out"])

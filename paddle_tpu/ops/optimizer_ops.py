@@ -424,3 +424,30 @@ def dgc(u, v, grad, step, *, m=0.9, sparsity=(0.999,),
     v_out = jnp.where(is_pre, v, v_post)
     enc = jnp.where(is_pre, pre_encoded, encoded)
     return u_out, v_out, enc
+
+
+# -- SelectedRows utility ops (reference: merge_selected_rows_op.cc,
+# get_tensor_from_selected_rows_op.cc — the conversion ops programs use
+# around sparse grads) --------------------------------------------------
+
+@register("merge_selected_rows", ["X"], ["Out"])
+def merge_selected_rows(x):
+    """Merge duplicate rows by addition (reference:
+    operators/merge_selected_rows_op.cc over
+    math/selected_rows_functor.cc MergeAdd)."""
+    from ..core.selected_rows import SparseRows
+    if isinstance(x, SparseRows):
+        return x.merged()
+    return x
+
+
+@register("get_tensor_from_selected_rows", ["X"], ["Out"])
+def get_tensor_from_selected_rows(x):
+    """Densify a SparseRows into its full [height, ...] tensor
+    (reference: get_tensor_from_selected_rows_op.cc)."""
+    from ..core.selected_rows import SparseRows
+    if not isinstance(x, SparseRows):
+        return x
+    dense = jnp.zeros((x.height,) + tuple(x.values.shape[1:]),
+                      x.values.dtype)
+    return dense.at[x.rows].add(x.values, mode="drop")

@@ -84,7 +84,8 @@ def _sdpa_reference(q, k, v, bias, *, scale, dropout_rate=0.0,
         s = jnp.where(rows >= cols, s, _NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     if dropout_rate > 0.0:
-        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, w.shape)
+        from ..nn_ops import _keep_mask
+        keep = _keep_mask(rng, dropout_rate, w.shape)
         w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", w, v).astype(q.dtype)
 

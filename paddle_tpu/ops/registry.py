@@ -44,6 +44,17 @@ class OpDef:
     variants: Dict[str, Callable] = field(default_factory=dict)
 
     def pick(self, library: Optional[str] = None) -> Callable:
+        """Choose the lowering. ``library`` may be a plain library name
+        ("pallas": every op that has that variant uses it) or a
+        per-op mix "op_a:lib,op_b:lib" — the best-impl-WINS dispatch
+        of the reference's jit kernel pool (operators/jit/README.en.md:
+        per-kernel, not per-build, selection)."""
+        if library and ":" in library:
+            for item in library.split(","):
+                op, _, lib = item.partition(":")
+                if op == self.type and lib in self.variants:
+                    return self.variants[lib]
+            return self.fn
         if library and library in self.variants:
             return self.variants[library]
         return self.fn

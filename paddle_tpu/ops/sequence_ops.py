@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ..core.enforce import enforce
@@ -258,3 +259,72 @@ def sequence_last_step(x, lengths):
     idx = jnp.maximum(lengths - 1, 0)
     idx = idx.reshape(idx.shape + (1,) * (x.ndim - 1))
     return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+
+
+@register("sequence_conv", ["X", "Filter", "Lengths"], ["Out"],
+          nondiff=("Lengths",))
+def sequence_conv(x, filt, lengths, *, context_length,
+                  context_start=None, context_stride=1):
+    """Context-window convolution over padded sequences (reference:
+    sequence_ops/sequence_conv_op.cc; math/context_project.h builds
+    the im2col-style context matrix). x [B, T, D], filter
+    [context_length*D, M]; frames outside the row's length (or the
+    sequence bounds) contribute zeros."""
+    B, T, D = x.shape
+    start = -((context_length - 1) // 2) if context_start is None \
+        else context_start
+    if lengths is not None:
+        x = _time_mask(x, lengths)
+    frames = []
+    for j in range(context_length):
+        off = start + j
+        if off < 0:
+            shifted = jnp.pad(x[:, :T + off], ((0, 0), (-off, 0),
+                                               (0, 0)))
+        elif off > 0:
+            shifted = jnp.pad(x[:, off:], ((0, 0), (0, off), (0, 0)))
+        else:
+            shifted = x
+        frames.append(shifted)
+    ctx = jnp.concatenate(frames, axis=2)        # [B, T, ctx*D]
+    out = jnp.einsum("btc,cm->btm", ctx, filt)
+    if lengths is not None:
+        out = _time_mask(out, lengths)
+    return out
+
+
+@register("sequence_reshape", ["X", "Lengths"], ["Out", "OutLengths"],
+          nondiff=("Lengths",))
+def sequence_reshape(x, lengths, *, new_dim):
+    """Trade time steps for feature width (reference:
+    sequence_ops/sequence_reshape_op.cc): each row's l*D values regroup
+    into (l*D/new_dim) steps of new_dim. Padded form: the dense
+    [B, T*D] buffer reshapes to [B, T*D/new_dim, new_dim] and lengths
+    scale by D/new_dim (every row's l*D must divide new_dim, as the
+    reference enforces per sequence)."""
+    B, T, D = x.shape
+    total = T * D
+    out = x.reshape(B, total // new_dim, new_dim)
+    if lengths is None:
+        new_len = None
+    else:
+        new_len = (lengths.astype(jnp.int32) * D) // new_dim
+    return out, new_len
+
+
+@register("sequence_scatter", ["X", "Ids", "Updates", "Lengths"],
+          ["Out"], nondiff=("Ids", "Lengths"))
+def sequence_scatter(x, ids, updates, lengths):
+    """Per-row scatter-add of sequence updates (reference:
+    sequence_ops/sequence_scatter_op.cc): out[b, ids[b,i]] +=
+    updates[b,i] for i < lengths[b]. x [B, N]; ids/updates [B, L]."""
+    B, L = ids.shape
+    ids = ids.astype(jnp.int32)
+    if lengths is not None:
+        live = lax.broadcasted_iota(jnp.int32, (B, L), 1) < \
+            lengths.reshape(-1, 1).astype(jnp.int32)
+        safe = jnp.where(live, ids, x.shape[1])  # drop masked writes
+    else:
+        safe = ids
+    bidx = lax.broadcasted_iota(jnp.int32, (B, L), 0)
+    return x.at[bidx, safe].add(updates, mode="drop")

@@ -336,3 +336,71 @@ def print_op(x, *, message="", first_n=-1, summarize=20,
 
     jax.debug.callback(_emit, x)
     return x
+
+
+# ---------------------------------------------------------------------------
+# v1 op-name aliases: the reference registers both the original ops and
+# their "2" successors (reshape/reshape2 etc. — reshape_op.cc registers
+# BOTH). Same lowerings, second name, so serialized v1 programs run.
+# ---------------------------------------------------------------------------
+
+register("reshape", ["X"], ["Out"])(reshape)
+register("transpose", ["X"], ["Out"])(transpose)
+register("squeeze", ["X"], ["Out"])(squeeze)
+register("unsqueeze", ["X"], ["Out"])(unsqueeze)
+register("flatten", ["X"], ["Out"])(flatten)
+register("fill_zeros_like2", ["X"], ["Out"],
+         differentiable=False)(fill_zeros_like)
+
+
+@register("fill", [], ["Out"], differentiable=False)
+def fill(*, shape, dtype="float32", value=0.0):
+    """Reference: fill_op.cc (value as attr list or scalar)."""
+    arr = jnp.asarray(value, dtype=dtype)
+    if arr.ndim == 0:
+        return jnp.full(shape, arr, dtype=dtype)
+    return arr.reshape(shape)
+
+
+@register("minus", ["X", "Y"], ["Out"])
+def minus(x, y):
+    """Reference: minus_op.cc — plain x - y (no axis broadcast)."""
+    return x - y
+
+
+@register("gaussian_random_batch_size_like", ["Input"], ["Out"],
+          differentiable=False, needs_rng=True)
+def gaussian_random_batch_size_like(ref, *, shape, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32",
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    rng=None):
+    """Reference: gaussian_random_batch_size_like_op.cc."""
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = ref.shape[input_dim_idx]
+    key = jax.random.key(seed) if seed else rng
+    return mean + std * jax.random.normal(key, tuple(out_shape),
+                                          dtype=dtype)
+
+
+@register("uniform_random_batch_size_like", ["Input"], ["Out"],
+          differentiable=False, needs_rng=True)
+def uniform_random_batch_size_like(ref, *, shape, min=-1.0, max=1.0,
+                                   seed=0, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   rng=None):
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = ref.shape[input_dim_idx]
+    key = jax.random.key(seed) if seed else rng
+    return jax.random.uniform(key, tuple(out_shape), dtype=dtype,
+                              minval=min, maxval=max)
+
+
+@register("cross_entropy2", ["X", "Label"], ["Y", "MatchX"],
+          nondiff=("Label",))
+def cross_entropy2(x, label):
+    """Hard-label-only cross entropy (reference: cross_entropy2_op.cc
+    — the soft_label-free fast path; also outputs the matched
+    probability)."""
+    lab = label.reshape(label.shape[0], -1).astype(jnp.int32)
+    match = jnp.take_along_axis(x, lab, axis=-1)
+    return -jnp.log(jnp.maximum(match, 1e-20)), match

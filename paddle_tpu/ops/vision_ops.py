@@ -1,0 +1,514 @@
+"""Vision / spatial rearrangement ops.
+
+Reference coverage (paddle/fluid/operators/): lrn_op.cc,
+affine_channel_op.cc, affine_grid_op.cc, pool_op.cc (pool3d),
+max_pool_with_index_op (max_pool2d/3d_with_index), unpool_op.cc,
+spp_op.cc, temporal_shift_op.cc, shuffle_channel_op.cc,
+space_to_depth_op.cc, crop_op.cc, pad_constant_like_op.cc,
+random_crop_op.cc, multiplex_op.cc, reverse_op.cc, interpolate_op.cc
+(nearest_interp / bilinear_interp), conv_transpose_op.cc
+(conv3d_transpose), sync_batch_norm_op.cu, mean_iou_op.cc,
+spectral_norm_op.cc, fsp_op.cc, conv_shift_op.cc, row_conv_op.cc,
+im2sequence_op.cc, add_position_encoding_op.cc, data_norm_op.cc,
+cvm_op.cc, lstmp_op.cc is in rnn territory (kept there).
+
+All lower to jnp/lax; XLA owns layout + fusion on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# normalization-ish
+# ---------------------------------------------------------------------------
+
+@register("lrn", ["X"], ["Out", "MidOut"])
+def lrn(x, *, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    """Local response normalization across channels (reference:
+    lrn_op.cc, NCHW). mid = k + alpha * local_sum(x^2);
+    out = x * mid^-beta."""
+    sq = jnp.square(x)
+    half = n // 2
+    # sum over a channel window via reduce_window on axis 1
+    local = lax.reduce_window(
+        sq, 0.0, lax.add, (1, n, 1, 1), (1, 1, 1, 1),
+        [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)])
+    mid = k + alpha * local
+    return x * jnp.power(mid, -beta), mid
+
+
+@register("affine_channel", ["X", "Scale", "Bias"], ["Out"])
+def affine_channel(x, scale, bias, *, data_layout="NCHW"):
+    """Per-channel x*scale+bias (reference: affine_channel_op.cc —
+    the BN-fold target op)."""
+    shape = [1] * x.ndim
+    c = 1 if data_layout == "NCHW" else x.ndim - 1
+    shape[c] = x.shape[c]
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register("data_norm", ["X", "BatchSize", "BatchSum", "BatchSquareSum"],
+          ["Y", "Means", "Scales"],
+          nondiff=("BatchSize", "BatchSum", "BatchSquareSum"))
+def data_norm(x, batch_size, batch_sum, batch_sq, *, epsilon=1e-4):
+    """Stats-carried normalization for CTR features (reference:
+    data_norm_op.cc): mean = sum/size, scale = rsqrt(var);
+    accumulators update outside the op (summary_decay path)."""
+    mean = batch_sum / batch_size
+    var = batch_sq / batch_size - jnp.square(mean)
+    scale = lax.rsqrt(var + epsilon)
+    return (x - mean) * scale, mean, scale
+
+
+@register("spectral_norm", ["Weight", "U", "V"], ["Out"],
+          nondiff=("U", "V"))
+def spectral_norm(w, u, v, *, dim=0, power_iters=1, eps=1e-12):
+    """Spectral weight normalization (reference: spectral_norm_op.cc):
+    power-iterate u,v; out = W / sigma. The iteration count is a
+    static attr so the loop unrolls under jit."""
+    shape = w.shape
+    if dim != 0:
+        perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+        w_mat = jnp.transpose(w, perm)
+    else:
+        w_mat = w
+    h = w_mat.shape[0]
+    mat = w_mat.reshape(h, -1)
+
+    def l2n(a):
+        return a / jnp.maximum(jnp.linalg.norm(a), eps)
+
+    u = u.reshape(-1)
+    v = v.reshape(-1)
+    for _ in range(max(power_iters, 0)):
+        v = l2n(mat.T @ u)
+        u = l2n(mat @ v)
+    sigma = u @ mat @ v
+    out = w_mat.reshape(w_mat.shape) / sigma
+    if dim != 0:
+        inv = [0] * w.ndim
+        for i, p in enumerate(perm):
+            inv[p] = i
+        out = jnp.transpose(out.reshape(w_mat.shape), inv)
+    else:
+        out = out.reshape(shape)
+    return out
+
+
+@register("sync_batch_norm",
+          ["X", "Scale", "Bias", "Mean", "Variance"],
+          ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+          nondiff=("Mean", "Variance"))
+def sync_batch_norm(x, scale, bias, mean, var, *, epsilon=1e-5,
+                    momentum=0.9, is_test=False, data_layout="NCHW",
+                    use_global_stats=False):
+    """Cross-replica batch norm (reference: sync_batch_norm_op.cu —
+    ncclAllReduce of the per-device moments). TPU-native: the batch
+    axis of a global array already spans the dp mesh, so plain
+    batch_norm's moments ARE the global-batch moments; GSPMD inserts
+    the cross-chip reduction where the batch is sharded. Registered
+    separately so programs using the reference op name run unchanged."""
+    from .nn_ops import batch_norm
+    return batch_norm(x, scale, bias, mean, var, epsilon=epsilon,
+                      momentum=momentum, is_test=is_test,
+                      data_layout=data_layout,
+                      use_global_stats=use_global_stats)
+
+
+# ---------------------------------------------------------------------------
+# pooling family
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * 3
+
+
+@register("pool3d", ["X"], ["Out"])
+def pool3d(x, *, ksize, pooling_type="max", strides=(1, 1, 1),
+           paddings=(0, 0, 0), global_pooling=False, ceil_mode=False,
+           exclusive=True, adaptive=False):
+    """NCDHW 3-D pooling (reference: pool_op.cc pool3d)."""
+    ks, st, pd = _triple(ksize), _triple(strides), _triple(paddings)
+    if global_pooling:
+        ks = x.shape[2:]
+        pd = (0, 0, 0)
+    window = (1, 1) + tuple(ks)
+    stride = (1, 1) + tuple(st)
+    pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+    if pooling_type == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, stride,
+                                 pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, stride, pads)
+    if exclusive and any(pd):
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride,
+                                pads)
+        return s / cnt
+    return s / float(ks[0] * ks[1] * ks[2])
+
+
+def _pool_with_index(x, ksize, strides, paddings):
+    """Shared max-pool-with-argmax: value path is a plain (autodiff-
+    friendly) max reduce_window; the winner's FLAT spatial index comes
+    from a variadic reduce_window on stop_gradient values (no JVP rule
+    exists for general variadic reducers, and indices carry no
+    tangents anyway). Reference: max_pool_with_index_op."""
+    ks, st, pd = tuple(ksize), tuple(strides), tuple(paddings)
+    window = (1, 1) + ks
+    stride = (1, 1) + st
+    pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+    out = lax.reduce_window(x, -jnp.inf, lax.max, window, stride, pads)
+
+    sizes = x.shape[2:]
+    total = 1
+    for s in sizes:
+        total *= s
+    flat = jnp.arange(total, dtype=jnp.float32).reshape(sizes)
+    flat = jnp.broadcast_to(flat, x.shape)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    _, idx = lax.reduce_window(
+        (lax.stop_gradient(x), flat), (-jnp.inf, jnp.float32(-1)),
+        sel, window, stride, pads)
+    return out, idx.astype(jnp.int32)
+
+
+@register("max_pool2d_with_index", ["X"], ["Out", "Mask"],
+          nondiff=())
+def max_pool2d_with_index(x, *, ksize, strides=(1, 1),
+                          paddings=(0, 0), global_pooling=False,
+                          adaptive=False):
+    ks = tuple(ksize) if isinstance(ksize, (list, tuple)) \
+        else (ksize,) * 2
+    if global_pooling:
+        ks = x.shape[2:]
+    st = tuple(strides) if isinstance(strides, (list, tuple)) \
+        else (strides,) * 2
+    pd = tuple(paddings) if isinstance(paddings, (list, tuple)) \
+        else (paddings,) * 2
+    return _pool_with_index(x, ks, st, pd)
+
+
+@register("max_pool3d_with_index", ["X"], ["Out", "Mask"],
+          nondiff=())
+def max_pool3d_with_index(x, *, ksize, strides=(1, 1, 1),
+                          paddings=(0, 0, 0), global_pooling=False,
+                          adaptive=False):
+    ks = _triple(ksize)
+    if global_pooling:
+        ks = x.shape[2:]
+    return _pool_with_index(x, ks, _triple(strides),
+                            _triple(paddings))
+
+
+@register("unpool", ["X", "Indices"], ["Out"], nondiff=("Indices",))
+def unpool(x, indices, *, unpooling_type="max", ksize=(2, 2),
+           strides=(2, 2), paddings=(0, 0), output_size=None):
+    """Max-unpool: scatter pooled values back to their argmax positions
+    (reference: unpool_op.cc). Indices are flat H*W positions from
+    max_pool2d_with_index."""
+    B, C, Hp, Wp = x.shape
+    if output_size is not None:
+        H, W = output_size[-2:]
+    else:
+        H = (Hp - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+        W = (Wp - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat = jnp.zeros((B, C, H * W), x.dtype)
+    idx = indices.reshape(B, C, -1).astype(jnp.int32)
+    vals = x.reshape(B, C, -1)
+    bidx = lax.broadcasted_iota(jnp.int32, idx.shape, 0)
+    cidx = lax.broadcasted_iota(jnp.int32, idx.shape, 1)
+    flat = flat.at[bidx, cidx, idx].add(vals, mode="drop")
+    return flat.reshape(B, C, H, W)
+
+
+@register("spp", ["X"], ["Out"])
+def spp(x, *, pyramid_height=3, pooling_type="max"):
+    """Spatial pyramid pooling (reference: spp_op.cc): concat the
+    flattened adaptive pools at 1x1, 2x2, ... 2^(h-1) bins."""
+    from .nn_ops import adaptive_pool2d
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        p = adaptive_pool2d(x, pool_size=(bins, bins),
+                            pooling_type=pooling_type)
+        outs.append(p.reshape(x.shape[0], -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# rearrangement
+# ---------------------------------------------------------------------------
+
+@register("temporal_shift", ["X"], ["Out"])
+def temporal_shift(x, *, seg_num, shift_ratio=0.25):
+    """TSM channel shift across the time dimension (reference:
+    temporal_shift_op.cc): x [N*T, C, H, W]; first ratio*C channels
+    shift t-1, next ratio*C shift t+1, rest stay."""
+    NT, C, H, W = x.shape
+    T = seg_num
+    N = NT // T
+    x5 = x.reshape(N, T, C, H, W)
+    c1 = int(C * shift_ratio)
+    c2 = int(C * 2 * shift_ratio)
+    back = jnp.pad(x5[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                    (0, 0)))
+    fwd = jnp.pad(x5[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                      (0, 0)))
+    out = jnp.concatenate([back, fwd, x5[:, :, c2:]], axis=2)
+    return out.reshape(NT, C, H, W)
+
+
+@register("shuffle_channel", ["X"], ["Out"])
+def shuffle_channel(x, *, group):
+    """ShuffleNet channel shuffle (reference: shuffle_channel_op.cc)."""
+    B, C, H, W = x.shape
+    return x.reshape(B, group, C // group, H, W) \
+        .transpose(0, 2, 1, 3, 4).reshape(B, C, H, W)
+
+
+@register("space_to_depth", ["X"], ["Out"])
+def space_to_depth(x, *, blocksize):
+    """Rearrange spatial blocks into channels (reference:
+    space_to_depth_op.cc, NCHW)."""
+    B, C, H, W = x.shape
+    bs = blocksize
+    x = x.reshape(B, C, H // bs, bs, W // bs, bs)
+    return x.transpose(0, 3, 5, 1, 2, 4).reshape(
+        B, C * bs * bs, H // bs, W // bs)
+
+
+@register("crop", ["X", "Offsets"], ["Out"], nondiff=("Offsets",))
+def crop(x, offsets=None, *, shape, offsets_attr=None):
+    """Crop to ``shape`` at static or tensor offsets (reference:
+    crop_op.cc)."""
+    if offsets is None:
+        offsets = jnp.asarray(offsets_attr or [0] * x.ndim)
+    offsets = offsets.reshape(-1).astype(jnp.int32)
+    starts = [offsets[i] for i in range(x.ndim)]
+    return lax.dynamic_slice(x, starts, shape)
+
+
+@register("pad_constant_like", ["X", "Y"], ["Out"], nondiff=("X",))
+def pad_constant_like(x, y, *, pad_value=0.0):
+    """Pad Y at the tail of every dim up to X's shape (reference:
+    pad_constant_like_op.cc)."""
+    pads = [(0, x.shape[i] - y.shape[i]) for i in range(y.ndim)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+@register("random_crop", ["X", "Seed"], ["Out", "SeedOut"],
+          nondiff=("Seed",), needs_rng=True)
+def random_crop(x, seed, *, shape, startup_seed=0, rng=None):
+    """Random spatial crop of the trailing dims (reference:
+    random_crop_op.cc; it threads an integer seed var — kept as a
+    pass-through output, the actual bits come from the step RNG)."""
+    ndim_crop = len(shape)
+    lead = x.ndim - ndim_crop
+    keys = jax.random.split(rng, ndim_crop)
+    starts = [jnp.int32(0)] * lead
+    for i in range(ndim_crop):
+        limit = x.shape[lead + i] - shape[i]
+        starts.append(jax.random.randint(keys[i], (), 0, limit + 1))
+    out = lax.dynamic_slice(x, starts,
+                            x.shape[:lead] + tuple(shape))
+    return out, seed
+
+
+@register("multiplex", ["Ids", "X*"], ["Out"], nondiff=("Ids",))
+def multiplex(ids, xs):
+    """Row-wise select among candidate tensors (reference:
+    multiplex_op.cc): out[r] = X[ids[r]][r]."""
+    stack = jnp.stack(xs, axis=0)                   # [n, B, ...]
+    idx = ids.reshape(-1).astype(jnp.int32)
+    return stack[idx, jnp.arange(stack.shape[1])]
+
+
+@register("reverse", ["X"], ["Out"])
+def reverse(x, *, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(a % x.ndim for a in axes))
+
+
+# interp aliases over the shared lowering (reference registers
+# nearest_interp / bilinear_interp as separate op types)
+@register("nearest_interp", ["X", "OutSize"], ["Out"],
+          nondiff=("OutSize",))
+def nearest_interp(x, out_size=None, *, out_h=-1, out_w=-1,
+                   align_corners=True, align_mode=1,
+                   data_layout="NCHW"):
+    from .nn_ops import interpolate
+    shape = (int(out_size[0]), int(out_size[1])) \
+        if out_size is not None else (out_h, out_w)
+    return interpolate(x, out_shape=shape, method="nearest",
+                       align_corners=align_corners)
+
+
+@register("bilinear_interp", ["X", "OutSize"], ["Out"],
+          nondiff=("OutSize",))
+def bilinear_interp(x, out_size=None, *, out_h=-1, out_w=-1,
+                    align_corners=True, align_mode=1,
+                    data_layout="NCHW"):
+    from .nn_ops import interpolate
+    shape = (int(out_size[0]), int(out_size[1])) \
+        if out_size is not None else (out_h, out_w)
+    return interpolate(x, out_shape=shape, method="bilinear",
+                       align_corners=align_corners)
+
+
+@register("conv3d_transpose", ["Input", "Filter"], ["Output"])
+def conv3d_transpose(x, w, *, strides=(1, 1, 1), paddings=(0, 0, 0),
+                     dilations=(1, 1, 1), groups=1):
+    """NCDHW deconvolution (reference: conv_transpose_op.cc). Same
+    input-dilated formulation as conv2d_transpose."""
+    st, dl = _triple(strides), _triple(dilations)
+    pd = _triple(paddings)
+    ks = w.shape[2:]
+    pad = [(dl[i] * (ks[i] - 1) - pd[i],) * 2 for i in range(3)]
+    w_flip = jnp.flip(w, axis=(2, 3, 4))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "IODHW", "NCDHW"))
+    return lax.conv_general_dilated(
+        x, w_flip, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=st, rhs_dilation=dl, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+# ---------------------------------------------------------------------------
+# grids / misc
+# ---------------------------------------------------------------------------
+
+@register("affine_grid", ["Theta", "OutputShape"], ["Output"],
+          nondiff=("OutputShape",))
+def affine_grid(theta, output_shape=None, *, output_shape_attr=None,
+                align_corners=True):
+    """Affine sampling-grid generation (reference: affine_grid_op.cc):
+    theta [B,2,3] -> grid [B,H,W,2] of (x,y) source coords in
+    [-1,1]."""
+    shape = [int(v) for v in (
+        output_shape if output_shape is not None
+        else output_shape_attr)]
+    H, W = int(shape[-2]), int(shape[-1])
+    B = theta.shape[0]
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys = axis_coords(H)
+    xs = axis_coords(W)
+    gx, gy = jnp.meshgrid(xs, ys)                  # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)      # [H, W, 3]
+    grid = jnp.einsum("hwk,bck->bhwc", base,
+                      theta.astype(jnp.float32))   # [B, H, W, 2]
+    return grid
+
+
+@register("mean_iou", ["Predictions", "Labels"],
+          ["OutMeanIou", "OutWrong", "OutCorrect"],
+          differentiable=False)
+def mean_iou(pred, label, *, num_classes):
+    """Mean intersection-over-union (reference: mean_iou_op.cc)."""
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    correct_mask = pred == label
+    out_correct = jnp.zeros((num_classes,), jnp.int32).at[
+        jnp.where(correct_mask, label, num_classes)].add(
+        1, mode="drop")
+    pred_cnt = jnp.zeros((num_classes,), jnp.int32).at[pred].add(
+        1, mode="drop")
+    lab_cnt = jnp.zeros((num_classes,), jnp.int32).at[label].add(
+        1, mode="drop")
+    union = pred_cnt + lab_cnt - out_correct
+    valid = union > 0
+    iou = jnp.where(valid, out_correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    out_wrong = (lab_cnt - out_correct).astype(jnp.int32)
+    return miou.astype(jnp.float32), out_wrong, out_correct
+
+
+@register("fsp", ["X", "Y"], ["Out"])
+def fsp(x, y):
+    """Flow-of-solution-procedure matrix for distillation (reference:
+    fsp_op.cc): out[b,i,j] = mean_hw x[b,i,h,w] * y[b,j,h,w]."""
+    B, C1, H, W = x.shape
+    return jnp.einsum("bihw,bjhw->bij", x, y) / float(H * W)
+
+
+@register("conv_shift", ["X", "Y"], ["Out"])
+def conv_shift(x, y):
+    """Circular correlation (reference: conv_shift_op.cc): out[b,i] =
+    sum_j x[b, (i+j-M/2) mod N] * y[b,j]. M is small; the loop
+    unrolls statically."""
+    B, N = x.shape
+    M = y.shape[1]
+    half = M // 2
+    out = jnp.zeros_like(x)
+    for j in range(M):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return out
+
+
+@register("row_conv", ["X", "Filter"], ["Out"])
+def row_conv(x, filt):
+    """Lookahead row convolution (reference: row_conv_op.cc):
+    out[b,t] = sum_{j<ctx} x[b,t+j] * filt[j] (zero past the end).
+    x [B, T, D], filt [ctx, D]."""
+    ctx = filt.shape[0]
+    out = jnp.zeros_like(x)
+    for j in range(ctx):
+        shifted = jnp.pad(x[:, j:], ((0, 0), (0, j), (0, 0)))
+        out = out + shifted * filt[j]
+    return out
+
+
+@register("im2sequence", ["X"], ["Out"])
+def im2sequence(x, *, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+    """Image -> patch sequence (reference: im2sequence_op.cc):
+    [B,C,H,W] -> [B, oh*ow, C*kh*kw]."""
+    kh, kw = kernels
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(strides),
+        [(paddings[0], paddings[2]), (paddings[1], paddings[3])])
+    B, CKK, OH, OW = patches.shape
+    return patches.reshape(B, CKK, OH * OW).transpose(0, 2, 1)
+
+
+@register("add_position_encoding", ["X"], ["Out"])
+def add_position_encoding(x, *, alpha=1.0, beta=1.0):
+    """Sinusoidal position encoding add (reference:
+    add_position_encoding_op.cc): out = alpha*x + beta*PE."""
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    half = D // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) /
+                    max(half, 1))
+    ang = pos / div[None, :]
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+    if pe.shape[1] < D:
+        pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[1])))
+    return alpha * x + beta * pe[None, :, :].astype(x.dtype)
+
+
+@register("cvm", ["X", "CVM"], ["Y"], nondiff=("CVM",))
+def cvm(x, cvm_feats, *, use_cvm=True):
+    """Continuous-value-model feature handling (reference: cvm_op.cc):
+    the first two columns are show/click counters; use_cvm keeps them
+    (log-transformed by the feed pipeline), otherwise they are cut."""
+    if use_cvm:
+        return x
+    return x[:, 2:]

@@ -48,6 +48,9 @@ class DistributeTranspilerConfig:
         self.split_method = RoundRobin
         self.min_block_size = 8192
         self.enable_dc_asgd = False
+        # DC-ASGD compensation strength (the lambda of g+l*g*g*(w-bak);
+        # the reference hardcodes it inside _append_dc_asgd_ops)
+        self.dc_asgd_lambda = 0.05
         self.mode = "pserver"
         self.print_log = False
         self.wait_port = True
@@ -236,11 +239,95 @@ class DistributeTranspiler:
         if dangling:
             warnings.warn("optimize ops with no Param slot stay on the "
                           "trainer: %s" % sorted(set(dangling)))
-        # placement
+        self._make_blocks()
+        # placement over BLOCKS (the reference places VarBlocks
+        # round-robin/hash, :1286 _init_splited_vars)
         dispatcher = self.config.split_method(self.pserver_endpoints)
-        params = [block.vars[p] for p in sorted(self._param_ops)]
-        eps = dispatcher.dispatch(params)
-        self._placement = {p.name: ep for p, ep in zip(params, eps)}
+        blocks = [b for p in sorted(self._blocks)
+                  for b in self._blocks[p]]
+        eps = dispatcher.dispatch(
+            [type("V", (), {"name": b["name"]}) for b in blocks])
+        for b, ep in zip(blocks, eps):
+            b["endpoint"] = ep
+        # param-level placement view (unsliced params: their single
+        # block's endpoint; sliced: endpoint of block 0 for display)
+        self._placement = {p: self._blocks[p][0]["endpoint"]
+                           for p in self._blocks}
+
+    def _sliceable(self, pname):
+        """A param can block-slice when its single update op's
+        tensor-state inputs/outputs are all param-shaped (row slicing
+        stays consistent) or scalars (replicated per block)."""
+        if not self.config.slice_var_up:
+            return False
+        if len(self.pserver_endpoints) < 2:
+            return False
+        src = self.origin_program.global_block()
+        p = src.vars[pname]
+        numel = 1
+        for d in p.shape:
+            numel *= d
+        if not p.shape or p.shape[0] < len(self.pserver_endpoints) \
+                or numel < self.config.min_block_size:
+            return False
+        for op in self._param_ops[pname]:
+            for n in set(op.input_arg_names) | \
+                    set(op.output_arg_names):
+                v = src._find_var_recursive(n)
+                if v is None:
+                    continue
+                if v.shape not in ((), p.shape) and \
+                        n != grad_var_name(pname):
+                    return False
+        return True
+
+    def _make_blocks(self):
+        """Slice large params into row blocks, one per pserver
+        (reference: VarBlock :69 + slice_var_up; blocks here are
+        per-endpoint contiguous row ranges rather than fixed-size
+        chunks — same balancing effect, simpler reassembly)."""
+        src = self.origin_program.global_block()
+        n_eps = len(self.pserver_endpoints)
+        self._blocks: Dict[str, List[dict]] = {}
+        for pname in sorted(self._param_ops):
+            p = src.vars[pname]
+            if self._sliceable(pname):
+                rows = p.shape[0]
+                base, extra = divmod(rows, n_eps)
+                blocks, start = [], 0
+                for k in range(n_eps):
+                    size = base + (1 if k < extra else 0)
+                    blocks.append({
+                        "param": pname,
+                        "name": "%s.block%d" % (pname, k),
+                        "start": start, "end": start + size,
+                        "shape": (size,) + tuple(p.shape[1:])})
+                    start += size
+                self._blocks[pname] = blocks
+            else:
+                self._blocks[pname] = [{
+                    "param": pname, "name": pname, "start": 0,
+                    "end": p.shape[0] if p.shape else 1,
+                    "shape": tuple(p.shape)}]
+
+    def block_table(self) -> Dict[str, List[dict]]:
+        """param -> [{name, endpoint, start, end, shape}] — the
+        trainer runtime's send/recv plan."""
+        self._ensure_split()
+        return {p: [dict(b) for b in bs]
+                for p, bs in self._blocks.items()}
+
+    def set_block_endpoints(self, block_names, endpoint):
+        """Re-point blocks at a live endpoint (launchers bind
+        ephemeral ports after transpile; the reference's wait_port
+        dance)."""
+        self._ensure_split()
+        names = set(block_names)
+        for pname, bs in self._blocks.items():
+            for b in bs:
+                if b["name"] in names:
+                    b["endpoint"] = endpoint
+            self._placement[pname] = bs[0]["endpoint"]
 
     # -- products -----------------------------------------------------------
     def get_trainer_program(self, wait_port=True) -> Program:
@@ -258,9 +345,41 @@ class DistributeTranspiler:
         trainer._bump()
         return trainer
 
-    def _append_param_ops(self, prog, pname):
+    def _block_rename(self, pname, binfo):
+        """Name map for one block of a sliced param: param-shaped vars
+        (param, grad, same-shape accumulators) and written scalars get
+        a .block{k} suffix; input-only scalars (the LR) stay shared."""
+        if binfo["name"] == pname:
+            return {grad_var_name(pname): grad_var_name(pname)}
+        suffix = binfo["name"][len(pname):]        # ".block{k}"
+        src = self.origin_program.global_block()
+        p_shape = tuple(src.vars[pname].shape)
+        written = {n for op in self._param_ops[pname]
+                   for n in op.output_arg_names}
+        rename = {}
+        for op in self._param_ops[pname]:
+            for n in set(op.input_arg_names) | \
+                    set(op.output_arg_names):
+                v = src._find_var_recursive(n)
+                if v is None:
+                    continue
+                if tuple(v.shape) == p_shape or \
+                        (v.shape == () and n in written):
+                    rename[n] = n + suffix
+        rename[grad_var_name(pname)] = grad_var_name(binfo["name"])
+        return rename
+
+    def _append_param_ops(self, prog, pname, binfo=None):
         src = self.origin_program.global_block()
         blk = prog.global_block()
+        binfo = binfo or self._blocks[pname][0]
+        rename = self._block_rename(pname, binfo)
+        bshape = tuple(binfo["shape"])
+        p_shape = tuple(src.vars[pname].shape)
+
+        def new_shape(v):
+            return bshape if tuple(v.shape) == p_shape else v.shape
+
         for op in self._param_ops[pname]:
             for n in op.input_arg_names:
                 v = src._find_var_recursive(n)
@@ -268,41 +387,63 @@ class DistributeTranspiler:
                     continue
                 if n == grad_var_name(pname):
                     _copy_var(blk, v, persistable=False, is_data=True,
-                              shape=src.vars[pname].shape)
+                              name=rename.get(n, n), shape=bshape)
                 else:
-                    _copy_var(blk, v)
+                    _copy_var(blk, v, name=rename.get(n, n),
+                              shape=new_shape(v),
+                              persistable=v.persistable)
             for n in op.output_arg_names:
                 v = src._find_var_recursive(n)
                 if v is not None:
-                    _copy_var(blk, v)
-            _copy_op(blk, op)
+                    _copy_var(blk, v, name=rename.get(n, n),
+                              shape=new_shape(v),
+                              persistable=v.persistable)
+            blk.append_op(
+                type=op.type,
+                inputs={sl: [rename.get(n, n) for n in ns]
+                        for sl, ns in op.inputs.items()},
+                outputs={sl: [rename.get(n, n) for n in ns]
+                         for sl, ns in op.outputs.items()},
+                attrs=dict(op.attrs))
         return prog
 
     def get_param_program(self, pname) -> Program:
         """One param's server-side update as a standalone program (the
         per-param optimize block, reference :780); its Grad var is the
-        feed."""
+        feed. Sliced params: use get_block_program per block."""
         self._ensure_split()
         return self._append_param_ops(Program(), pname)
 
+    def get_block_program(self, block_name) -> Program:
+        """Standalone update program for one VarBlock (reference:
+        VarBlock :69 + per-block optimize blocks)."""
+        self._ensure_split()
+        for pname, bs in self._blocks.items():
+            for b in bs:
+                if b["name"] == block_name:
+                    return self._append_param_ops(Program(), pname, b)
+        raise UnavailableError("unknown block %r" % block_name)
+
     def get_pserver_program(self, endpoint) -> Program:
-        """Program holding this endpoint's params, their optimizer
-        state, and update ops; each Grad input becomes a feed var.
-        (Reference: get_pserver_program:780.)"""
+        """Program holding this endpoint's param BLOCKS, their
+        optimizer state, and update ops; each Grad input becomes a
+        feed var. (Reference: get_pserver_program:780.)"""
         self._ensure_split()
         enforce(endpoint in self.pserver_endpoints,
                 "endpoint %r not in %s" % (endpoint,
                                            self.pserver_endpoints))
         prog = Program()
-        for pname in sorted(self._param_ops):
-            if self._placement[pname] == endpoint:
-                self._append_param_ops(prog, pname)
+        for pname in sorted(self._blocks):
+            for b in self._blocks[pname]:
+                if b["endpoint"] == endpoint:
+                    self._append_param_ops(prog, pname, b)
         return prog
 
     def params_on(self, endpoint) -> List[str]:
+        """Block names served by this endpoint."""
         self._ensure_split()
-        return sorted(p for p, ep in self._placement.items()
-                      if ep == endpoint)
+        return sorted(b["name"] for bs in self._blocks.values()
+                      for b in bs if b["endpoint"] == endpoint)
 
     def get_pserver_programs(self, endpoint):
         return (self.get_pserver_program(endpoint),
@@ -319,22 +460,54 @@ class DistributeTranspiler:
             endpoint = self.current_endpoint
         pserver_program = pserver_program or \
             self.get_pserver_program(endpoint)
-        want = {n for n, v in
-                pserver_program.global_block().vars.items()
-                if v.persistable}
+        pvars = pserver_program.global_block().vars
+        want = {n for n, v in pvars.items() if v.persistable}
         src = self.startup_program.global_block()
         prog = Program()
         prog.random_seed = self.startup_program.random_seed
         blk = prog.global_block()
+
+        import re
+        block_re = re.compile(r"^(.*)\.block(\d+)$")
+        init_of = {}
         for op in src.ops:
-            outs = set(op.output_arg_names)
-            if not outs & want:
+            for n in op.output_arg_names:
+                init_of[n] = op
+
+        copied = set()
+        for name in sorted(want):
+            v = pvars[name]
+            m = block_re.match(name)
+            base = m.group(1) if m else name
+            op = init_of.get(base)
+            _copy_var(blk, v, name=name, shape=v.shape,
+                      persistable=True)
+            if op is None:
                 continue
-            for n in list(op.input_arg_names) + list(outs):
-                v = src._find_var_recursive(n)
-                if v is not None:
-                    _copy_var(blk, v)
-            _copy_op(blk, op)
+            if not m:
+                if id(op) not in copied:
+                    copied.add(id(op))
+                    _copy_op(blk, op)
+                continue
+            # sliced var: re-emit the init with the block's shape
+            # (random inits redraw per block — trainers adopt server
+            # values via init_params, so only the distribution must
+            # match; deterministic inits slice exactly)
+            attrs = dict(op.attrs)
+            if "shape" in attrs:
+                attrs["shape"] = tuple(v.shape)
+            if op.type == "assign_numpy_value":
+                import numpy as _np
+                start = next(b["start"]
+                             for b in self._blocks[base]
+                             if b["name"] == name)
+                end = next(b["end"] for b in self._blocks[base]
+                           if b["name"] == name)
+                attrs["_value"] = _np.asarray(
+                    attrs["_value"])[start:end]
+            blk.append_op(type=op.type, inputs=dict(op.inputs),
+                          outputs={next(iter(op.outputs)): [name]},
+                          attrs=attrs)
         return prog
 
     # -- runtime hooks (consumed by distributed.ps) -------------------------

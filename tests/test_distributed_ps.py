@@ -177,21 +177,15 @@ class TestPSTraining:
 
         main, startup, loss = _build_mlp()
         t = DistributeTranspiler()
+        # two DISTINCT placeholder endpoints (both bind ephemeral
+        # ports; localhost normalizes to 127.0.0.1 at connect time)
         t.transpile(0, program=main, startup_program=startup,
-                    pservers="127.0.0.1:0,127.0.0.1:0", trainers=1)
-        # bind both pservers on ephemeral ports, fix up placement
+                    pservers="127.0.0.1:0,localhost:0", trainers=1)
+        # bind both pservers on ephemeral ports, fix up endpoints
         servers = [PServerRuntime(t, ep)
                    for ep in list(t.pserver_endpoints)]
-        real_eps = {old: s.serv.endpoint
-                    for old, s in zip(t.pserver_endpoints, servers)}
-        # NOTE: both old endpoints are "127.0.0.1:0" -> indistinguishable;
-        # rebuild placement by server ownership instead
-        placement = {}
         for s in servers:
-            for p in s._minis:
-                placement[p] = s.serv.endpoint
-        t._placement = placement
-        for s in servers:
+            t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
             s.serv.server.start()
 
         trainer = t.get_trainer_program()
@@ -201,6 +195,11 @@ class TestPSTraining:
             exe.run(startup)
             rt = ParameterServerRuntime(t, trainer, scope)
             rt.init_params()
+            # snapshot the ADOPTED initial params: the local reference
+            # run must start from the same point (pserver init uses
+            # different op-index RNG folds than the trainer startup)
+            init_vals = {p: np.asarray(scope.find_var(p))
+                         for p in t.block_table()}
             dist = []
             for f in feeds:
                 (lv,) = rt.run_step(exe, f, fetch_list=[loss])
@@ -209,17 +208,16 @@ class TestPSTraining:
         for s in servers:
             s.serv.shutdown()
 
-        # the dist initial params come from the PSERVER init (different
-        # op-index RNG folds), so compare against a local run seeded
-        # from the same server values
-        main2, startup2, loss2 = _build_mlp()
+        # clone the SAME programs (identical var names) for the
+        # snapshot-seeded reference run
+        main2, startup2 = main.clone(), startup.clone()
+        loss2 = loss.name
         scope2 = fluid.Scope()
         with fluid.scope_guard(scope2):
             exe2 = fluid.Executor()
             exe2.run(startup2)
-            for s in servers:
-                for p in s._minis:
-                    scope2.set_var(p, np.asarray(s.scope.find_var(p)))
+            for p, v in init_vals.items():
+                scope2.set_var(p, v)
             ref = []
             for f in feeds:
                 (lv,) = exe2.run(main2, feed=f, fetch_list=[loss2])
@@ -242,7 +240,7 @@ class TestPSTraining:
         t.transpile(0, program=main, startup_program=startup,
                     pservers="127.0.0.1:0", trainers=2)
         s = PServerRuntime(t, t.pserver_endpoints[0])
-        t._placement = {p: s.serv.endpoint for p in s._minis}
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
         s.serv.server.start()
         trainer = t.get_trainer_program()
 
@@ -279,7 +277,7 @@ class TestPSTraining:
         t.transpile(0, program=main, startup_program=startup,
                     pservers="127.0.0.1:0", trainers=1, sync_mode=False)
         s = PServerRuntime(t, t.pserver_endpoints[0])
-        t._placement = {p: s.serv.endpoint for p in s._minis}
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
         s.serv.server.start()
         trainer = t.get_trainer_program()
         scope = fluid.Scope()
@@ -434,3 +432,114 @@ class TestLookupService:
         finally:
             for s in servers:
                 s.shutdown()
+
+
+class TestSlicedParams:
+    def test_sliced_sync_matches_local(self, rng):
+        """slice_var_up: the big fc weight splits into row blocks
+        across two pservers; training must still match the local
+        trace (the reference's VarBlock path, :69,:1286)."""
+        def build():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 21
+            with fluid.program_guard(main, startup):
+                x = layers.data(name="x", shape=[32], dtype="float32")
+                label = layers.data(name="label", shape=[1],
+                                    dtype="int64")
+                h = layers.fc(x, size=64, act="relu")
+                pred = layers.fc(h, size=4, act="softmax")
+                loss = layers.mean(layers.cross_entropy(pred, label))
+                fluid.optimizer.MomentumOptimizer(0.2, 0.9) \
+                    .minimize(loss)
+            return main, startup, loss
+
+        feeds = [{"x": rng.rand(8, 32).astype(np.float32),
+                  "label": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+                 for _ in range(4)]
+
+        cfg = DistributeTranspilerConfig()
+        cfg.slice_var_up = True
+        cfg.min_block_size = 64   # force the 32x64 weight to slice
+        main, startup, loss = build()
+        t = DistributeTranspiler(cfg)
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0,localhost:0", trainers=1)
+        table = t.block_table()
+        w_blocks = [bs for bs in table.values() if len(bs) > 1]
+        assert w_blocks, "no param was sliced"
+        for bs in w_blocks:
+            assert [b["start"] for b in bs] == \
+                [0] + [bs[i]["end"] for i in range(len(bs) - 1)]
+
+        servers = [PServerRuntime(t, ep)
+                   for ep in list(t.pserver_endpoints)]
+        for s in servers:
+            t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+            s.serv.server.start()
+        trainer = t.get_trainer_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rt = ParameterServerRuntime(t, trainer, scope)
+            rt.init_params()
+            init_vals = {p: np.asarray(scope.find_var(p))
+                         for p in table}
+            dist = []
+            for f in feeds:
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                dist.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+        for s in servers:
+            s.serv.shutdown()
+
+        # local reference: the SAME programs (clone keeps var names)
+        # seeded from the adopted init — row-sliced momentum updates
+        # must reproduce the whole-param trace exactly
+        main2, startup2 = main.clone(), startup.clone()
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor()
+            exe2.run(startup2)
+            for p, v in init_vals.items():
+                scope2.set_var(p, v)
+            ref = []
+            for f in feeds:
+                (lv,) = exe2.run(main2, feed=f,
+                                 fetch_list=[loss.name])
+                ref.append(float(np.asarray(lv).reshape(-1)[0]))
+        np.testing.assert_allclose(
+            dist, ref, rtol=1e-5,
+            err_msg="sliced PS loss trace != local")
+        assert dist[-1] < dist[0]
+
+    def test_dc_asgd_trains(self, rng):
+        cfg = DistributeTranspilerConfig()
+        cfg.enable_dc_asgd = True
+        main, startup, loss = _build_mlp(seed=31)
+        t = DistributeTranspiler(cfg)
+        t.transpile(0, program=main, startup_program=startup,
+                    pservers="127.0.0.1:0", trainers=1,
+                    sync_mode=False)
+        s = PServerRuntime(t, t.pserver_endpoints[0])
+        assert s.dc_asgd
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+        s.serv.server.start()
+        trainer = t.get_trainer_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        sync_mode=False)
+            rt.init_params()
+            vals = []
+            for f in _feeds(rng, 6):
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                vals.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+        s.serv.shutdown()
+        # per-trainer weight backups were recorded
+        assert s._dc_backup
+        assert np.isfinite(vals).all()
+        assert vals[-1] < vals[0]

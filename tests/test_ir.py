@@ -120,6 +120,27 @@ class TestFusePasses:
         after = _run(main, startup, out, feed)
         np.testing.assert_allclose(before, after, rtol=1e-6)
 
+    def test_fuse_preserves_act_attrs(self, rng):
+        """gelu(approximate=False) must survive fusion numerically;
+        fc_fuse must refuse acts with attrs (no channel for them)."""
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 1
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[16], dtype="float32")
+            h = layers.fc(x, size=8, act=None)
+            out = layers.gelu(h, approximate=False)
+        feed = {"x": rng.rand(4, 16).astype(np.float32)}
+        before = _run(main, startup, out, feed)
+        ir.apply_passes(main, ["fuse_elewise_add_act_pass"])
+        types = [op.type for op in main.global_block().ops]
+        assert "fused_elemwise_activation" in types
+        after = _run(main, startup, out, feed)
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+        # fc_fuse keeps the fused act-op out of the fc (attrs present)
+        ir.apply_passes(main, ["fc_fuse_pass"])
+        types = [op.type for op in main.global_block().ops]
+        assert "fused_elemwise_activation" in types
+
     def test_fc_fuse(self, rng):
         main, startup, out = _mlp_program(act="relu")
         main.random_seed = 1

@@ -549,8 +549,463 @@ spec("dequantize_weight",
 
 # Ops exercised end-to-end in dedicated test files (the table must
 # still account for them — the ratchet below fails on unlisted ops).
+# --- loss / sequence-labeling ops (loss_ops.py) ----------------------
+
+def _ctc_brute(logp, labels, T_len, L_len, blank=0):
+    """Brute-force CTC NLL: enumerate every alignment path."""
+    import itertools
+    B, T, C = logp.shape
+    out = []
+    for b in range(B):
+        lab = list(labels[b][:L_len[b]])
+        total = -np.inf
+        for path in itertools.product(range(C), repeat=int(T_len[b])):
+            # collapse: remove repeats then blanks
+            col, prev = [], -1
+            for s in path:
+                if s != prev and s != blank:
+                    col.append(s)
+                prev = s
+            if col == lab:
+                lp = sum(logp[b, t, s] for t, s in enumerate(path))
+                total = np.logaddexp(total, lp)
+        out.append(-total)
+    return np.asarray(out, np.float32).reshape(-1, 1)
+
+
+def _ctc_ref(ins):
+    logits = ins["Logits"]
+    logp = logits - np.log(np.sum(np.exp(logits), -1, keepdims=True))
+    return [_ctc_brute(logp, ins["Label"].astype(int),
+                       ins["LogitsLength"].reshape(-1).astype(int),
+                       ins["LabelLength"].reshape(-1).astype(int))]
+
+
+spec("warpctc",
+     {"Logits": sgn((2, 4, 3), 201), "Label": np.array(
+         [[1, 2], [2, 0]], np.int64),
+      "LogitsLength": np.array([4, 3], np.int64),
+      "LabelLength": np.array([2, 1], np.int64)},
+     ref=_ctc_ref, grad=["Logits"], max_rel=0.01)
+
+
+def _crf_brute(ins):
+    import itertools
+    em, tr = ins["Emission"], ins["Transition"]
+    lab = ins["Label"].astype(int)
+    lens = ins["Length"].reshape(-1).astype(int)
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    B, T, D = em.shape
+    out = []
+    for b in range(B):
+        L = lens[b]
+
+        def score(seq):
+            s = start[seq[0]] + em[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + em[b, t, seq[t]]
+            return s + stop[seq[L - 1]]
+        gold = score(lab[b][:L])
+        z = -np.inf
+        for seq in itertools.product(range(D), repeat=int(L)):
+            z = np.logaddexp(z, score(seq))
+        out.append(gold - z)
+    return [np.asarray(out, np.float32).reshape(-1, 1)]
+
+
+def _crf_decode_brute(ins):
+    import itertools
+    em, tr = ins["Emission"], ins["Transition"]
+    lens = ins["Length"].reshape(-1).astype(int)
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    B, T, D = em.shape
+    paths = np.zeros((B, T), np.int32)
+    for b in range(B):
+        L = lens[b]
+        best, best_s = None, -np.inf
+        for seq in itertools.product(range(D), repeat=int(L)):
+            s = start[seq[0]] + em[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + em[b, t, seq[t]]
+            s += stop[seq[L - 1]]
+            if s > best_s:
+                best, best_s = seq, s
+        paths[b, :L] = best
+    return [paths]
+
+
+_crf_ins = {"Emission": sgn((2, 4, 3), 203),
+            "Transition": sgn((5, 3), 204),
+            "Label": np.array([[0, 2, 1, 0], [1, 0, 0, 0]], np.int64),
+            "Length": np.array([4, 2], np.int64)}
+spec("linear_chain_crf", dict(_crf_ins), ref=_crf_brute,
+     grad=["Emission", "Transition"], max_rel=0.01)
+spec("crf_decoding",
+     {k: v for k, v in _crf_ins.items() if k != "Label"},
+     ref=_crf_decode_brute)
+
+
+def _edit_ref(ins):
+    h, r = ins["Hyps"].astype(int), ins["Refs"].astype(int)
+    hl = ins["HypsLength"].reshape(-1).astype(int)
+    rl = ins["RefsLength"].reshape(-1).astype(int)
+    out = []
+    for b in range(len(h)):
+        a, c = list(h[b][:hl[b]]), list(r[b][:rl[b]])
+        d = np.zeros((len(a) + 1, len(c) + 1))
+        d[:, 0] = np.arange(len(a) + 1)
+        d[0, :] = np.arange(len(c) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(c) + 1):
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                              d[i - 1, j - 1] + (a[i - 1] != c[j - 1]))
+        out.append(d[-1, -1])
+    return [np.asarray(out, np.float32).reshape(-1, 1), None]
+
+
+spec("edit_distance",
+     {"Hyps": np.array([[1, 2, 3, 4], [5, 5, 0, 0]], np.int64),
+      "Refs": np.array([[1, 3, 3], [5, 6, 7]], np.int64),
+      "HypsLength": np.array([4, 2], np.int64),
+      "RefsLength": np.array([3, 3], np.int64)},
+     ref=_edit_ref, n_outputs=2)
+
+
+def _ctc_align_ref(ins):
+    ids = ins["Input"].astype(int)
+    lens = ins["InputLength"].reshape(-1).astype(int)
+    B, T = ids.shape
+    out = np.zeros((B, T), np.int32)
+    olen = np.zeros((B, 1), np.int32)
+    for b in range(B):
+        prev, row = -1, []
+        for t in range(lens[b]):
+            if ids[b, t] != 0 and ids[b, t] != prev:
+                row.append(ids[b, t])
+            prev = ids[b, t]
+        out[b, :len(row)] = row
+        olen[b, 0] = len(row)
+    return [out, olen]
+
+
+spec("ctc_align",
+     {"Input": np.array([[1, 1, 0, 2, 2, 3], [0, 0, 1, 0, 1, 1]],
+                        np.int64),
+      "InputLength": np.array([6, 5], np.int64)},
+     ref=_ctc_align_ref, n_outputs=2)
+
+spec("rank_loss", {"Label": f32(_rs(205).randint(0, 2, (4, 1))),
+                   "Left": sgn((4, 1), 206), "Right": sgn((4, 1), 207)},
+     ref=lambda ins: [np.log1p(np.exp(ins["Left"] - ins["Right"])) -
+                      ins["Label"] * (ins["Left"] - ins["Right"])])
+spec("bpr_loss", {"X": sgn((3, 4), 208),
+                  "Label": np.array([[0], [2], [3]], np.int64)})
+spec("modified_huber_loss",
+     {"X": sgn((3, 1), 209), "Y": f32(_rs(210).randint(0, 2, (3, 1)))},
+     ref=lambda ins: [np.where(
+         ins["X"] * (2 * ins["Y"] - 1) >= -1,
+         np.square(np.maximum(1 - ins["X"] * (2 * ins["Y"] - 1), 0)),
+         -4 * ins["X"] * (2 * ins["Y"] - 1))])
+spec("teacher_student_sigmoid_loss",
+     {"X": sgn((4, 1), 211), "Label": u((4, 1), 212, lo=0.2, hi=0.8)})
+spec("cos_sim", {"X": sgn((3, 4), 213), "Y": sgn((3, 4), 214)},
+     ref=lambda ins: [
+         (ins["X"] * ins["Y"]).sum(-1, keepdims=True) /
+         (np.linalg.norm(ins["X"], axis=-1, keepdims=True) *
+          np.linalg.norm(ins["Y"], axis=-1, keepdims=True)),
+         None, None],
+     n_outputs=3)
+spec("squared_l2_distance",
+     {"X": sgn((3, 4), 215), "Y": sgn((3, 4), 216)},
+     ref=lambda ins: [np.square(ins["X"] - ins["Y"]).sum(
+         -1, keepdims=True), None], n_outputs=2)
+spec("squared_l2_norm", {"X": sgn((3, 4), 217)},
+     ref=lambda ins: [np.square(ins["X"]).sum().reshape(1)])
+spec("l1_norm", {"X": sgn((3, 4), 218)},
+     ref=lambda ins: [np.abs(ins["X"]).sum().reshape(1)])
+spec("bilinear_tensor_product",
+     {"X": sgn((3, 4), 219), "Y": sgn((3, 5), 220),
+      "Weight": sgn((2, 4, 5), 221), "Bias": sgn((1, 2), 222)},
+     ref=lambda ins: [np.einsum("bm,smn,bn->bs", ins["X"],
+                                ins["Weight"], ins["Y"]) +
+                      ins["Bias"]])
+spec("hierarchical_sigmoid",
+     {"X": sgn((3, 4), 223), "W": sgn((5, 4), 224),
+      "Bias": sgn((5,), 225),
+      "Label": np.array([[0], [3], [5]], np.int64)},
+     {"num_classes": 6}, grad=["X", "W", "Bias"], n_outputs=2,
+     max_rel=0.01)
+
+# --- vision ops (vision_ops.py) ---------------------------------------
+
+
+def well_sep(shape, seed=0, span=3.0):
+    """Values with pairwise gaps > 2*FD-delta — max-pooling numeric
+    grads need the winner to stay the winner under perturbation."""
+    n = int(np.prod(shape))
+    vals = np.linspace(-span, span, n, dtype=np.float32)
+    return _rs(seed).permutation(vals).reshape(shape)
+
+
+def _lrn_ref(ins, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    x = ins["X"]
+    B, C, H, W = x.shape
+    sq = np.square(x)
+    mid = np.full_like(x, k)
+    half = n // 2
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + n - half)
+        mid[:, c] += alpha * sq[:, lo:hi].sum(1)
+    return [x * np.power(mid, -beta), None]
+
+
+spec("lrn", {"X": u((2, 6, 4, 4), 230)}, ref=_lrn_ref, n_outputs=2)
+spec("affine_channel",
+     {"X": sgn((2, 3, 4, 4), 231), "Scale": u((3,), 232),
+      "Bias": sgn((3,), 233)},
+     ref=lambda ins: [ins["X"] * ins["Scale"].reshape(1, 3, 1, 1) +
+                      ins["Bias"].reshape(1, 3, 1, 1)])
+spec("data_norm",
+     {"X": sgn((4, 3), 234), "BatchSize": f32([10, 10, 10]),
+      "BatchSum": f32([5, -3, 1]), "BatchSquareSum": f32([12, 8, 9])},
+     ref=lambda ins: [
+         (ins["X"] - ins["BatchSum"] / 10) /
+         np.sqrt(ins["BatchSquareSum"] / 10 -
+                 np.square(ins["BatchSum"] / 10) + 1e-4),
+         None, None],
+     n_outputs=3, grad=["X"])
+spec("spectral_norm",
+     {"Weight": sgn((4, 3), 235), "U": u((4,), 236), "V": u((3,), 237)},
+     {"power_iters": 2})
+spec("sync_batch_norm",
+     {"X": sgn((4, 3, 2, 2), 238), "Scale": u((3,), 239),
+      "Bias": sgn((3,), 240), "Mean": f32([0.1, -0.1, 0.0]),
+      "Variance": f32([1.0, 0.5, 2.0])},
+     {"is_test": True, "epsilon": 1e-5},
+     ref=lambda ins: [
+         (ins["X"] - ins["Mean"].reshape(1, 3, 1, 1)) *
+         ins["Scale"].reshape(1, 3, 1, 1) /
+         np.sqrt(ins["Variance"].reshape(1, 3, 1, 1) + 1e-5) +
+         ins["Bias"].reshape(1, 3, 1, 1),
+         None, None, None, None],
+     n_outputs=5, grad=["X"])
+
+
+def _pool3d_ref(ins, ks=2):
+    x = ins["X"]
+    B, C, D, H, W = x.shape
+    out = x.reshape(B, C, D // ks, ks, H // ks, ks, W // ks, ks) \
+        .max((3, 5, 7))
+    return [out]
+
+
+spec("pool3d", {"X": well_sep((1, 2, 4, 4, 4), 241)},
+     {"ksize": (2, 2, 2), "strides": (2, 2, 2)}, ref=_pool3d_ref)
+
+
+def _maxpool_idx_ref(ins, ks=2):
+    x = ins["X"]
+    B, C, H, W = x.shape
+    out = np.zeros((B, C, H // ks, W // ks), x.dtype)
+    idx = np.zeros((B, C, H // ks, W // ks), np.int32)
+    for b in range(B):
+        for c in range(C):
+            for i in range(H // ks):
+                for j in range(W // ks):
+                    patch = x[b, c, i * ks:(i + 1) * ks,
+                              j * ks:(j + 1) * ks]
+                    out[b, c, i, j] = patch.max()
+                    a = patch.argmax()
+                    idx[b, c, i, j] = (i * ks + a // ks) * W + \
+                        (j * ks + a % ks)
+    return [out, idx]
+
+
+spec("max_pool2d_with_index", {"X": well_sep((1, 2, 4, 4), 242)},
+     {"ksize": (2, 2), "strides": (2, 2)}, ref=_maxpool_idx_ref,
+     n_outputs=2)
+spec("max_pool3d_with_index", {"X": well_sep((1, 1, 2, 2, 2), 243)},
+     {"ksize": (2, 2, 2), "strides": (2, 2, 2)}, n_outputs=2)
+
+
+def _unpool_ref(ins):
+    x, idx = ins["X"], ins["Indices"].astype(int)
+    B, C, Hp, Wp = x.shape
+    out = np.zeros((B, C, 4, 4), x.dtype)
+    for b in range(B):
+        for c in range(C):
+            for p in range(Hp * Wp):
+                f = idx[b, c].reshape(-1)[p]
+                out[b, c, f // 4, f % 4] += x[b, c].reshape(-1)[p]
+    return [out]
+
+
+_unpool_x = sgn((1, 2, 2, 2), 244)
+_unpool_idx = np.array([[[[0, 3], [9, 14]], [[5, 6], [8, 15]]]],
+                       np.int32)
+spec("unpool", {"X": _unpool_x, "Indices": _unpool_idx},
+     {"ksize": (2, 2), "strides": (2, 2)}, ref=_unpool_ref)
+
+spec("spp", {"X": well_sep((2, 3, 8, 8), 245, span=4.0)},
+     {"pyramid_height": 2})
+spec("temporal_shift", {"X": sgn((4, 4, 2, 2), 246)},
+     {"seg_num": 2, "shift_ratio": 0.25})
+spec("shuffle_channel", {"X": sgn((2, 6, 2, 2), 247)}, {"group": 3},
+     ref=lambda ins: [ins["X"].reshape(2, 3, 2, 2, 2)
+                      .transpose(0, 2, 1, 3, 4).reshape(2, 6, 2, 2)])
+spec("space_to_depth", {"X": sgn((1, 2, 4, 4), 248)}, {"blocksize": 2})
+spec("crop", {"X": sgn((4, 5), 249)},
+     {"shape": (2, 3), "offsets_attr": (1, 1)},
+     ref=lambda ins: [ins["X"][1:3, 1:4]])
+spec("pad_constant_like",
+     {"X": sgn((4, 5), 250), "Y": sgn((2, 3), 251)},
+     {"pad_value": 0.5}, grad=["Y"],
+     ref=lambda ins: [np.pad(ins["Y"], ((0, 2), (0, 2)),
+                             constant_values=0.5)])
+spec("multiplex",
+     {"Ids": np.array([[1], [0], [1]], np.int64),
+      "X": [sgn((3, 4), 252), sgn((3, 4), 253)]},
+     ref=lambda ins: [np.stack([ins["X"][i][b] for b, i in
+                                enumerate([1, 0, 1])])])
+spec("reverse", {"X": sgn((3, 4), 254)}, {"axis": [1]},
+     ref=lambda ins: [ins["X"][:, ::-1]])
+spec("nearest_interp", {"X": sgn((1, 2, 2, 2), 255)},
+     {"out_h": 4, "out_w": 4},
+     ref=lambda ins: [np.repeat(np.repeat(ins["X"], 2, 2), 2, 3)])
+spec("bilinear_interp", {"X": sgn((1, 2, 3, 3), 256)},
+     {"out_h": 6, "out_w": 6})
+spec("conv3d_transpose",
+     {"Input": sgn((1, 2, 3, 3, 3), 257), "Filter": sgn((2, 3, 1, 1, 1),
+                                                        258)},
+     ref=lambda ins: [np.einsum("bidhw,iodhw->bodhw",
+                                ins["Input"], ins["Filter"])])
+spec("affine_grid", {"Theta": sgn((2, 2, 3), 259)},
+     {"output_shape_attr": (2, 1, 3, 3)}, grad=["Theta"],
+     max_rel=0.05)  # exact-linear op; fp32 FD noise dominates
+spec("mean_iou",
+     {"Predictions": np.array([[0, 1, 2, 1]], np.int64),
+      "Labels": np.array([[0, 1, 1, 1]], np.int64)},
+     {"num_classes": 3},
+     ref=lambda ins: [np.float32((1.0 + 2.0 / 3.0 + 0.0) / 3),
+                      None, None],
+     n_outputs=3)
+spec("fsp", {"X": sgn((2, 3, 2, 2), 260), "Y": sgn((2, 4, 2, 2), 261)},
+     ref=lambda ins: [np.einsum("bihw,bjhw->bij", ins["X"],
+                                ins["Y"]) / 4.0])
+
+
+def _conv_shift_ref(ins):
+    x, y = ins["X"], ins["Y"]
+    B, N = x.shape
+    M = y.shape[1]
+    half = M // 2
+    out = np.zeros_like(x)
+    for j in range(M):
+        out += np.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return [out]
+
+
+spec("conv_shift", {"X": sgn((2, 6), 262), "Y": sgn((2, 3), 263)},
+     ref=_conv_shift_ref)
+
+
+def _row_conv_ref(ins):
+    x, f = ins["X"], ins["Filter"]
+    out = np.zeros_like(x)
+    for j in range(f.shape[0]):
+        shifted = np.zeros_like(x)
+        shifted[:, :x.shape[1] - j] = x[:, j:]
+        out += shifted * f[j]
+    return [out]
+
+
+spec("row_conv", {"X": sgn((2, 5, 3), 264), "Filter": sgn((2, 3), 265)},
+     ref=_row_conv_ref)
+spec("im2sequence", {"X": sgn((1, 2, 4, 4), 266)},
+     {"kernels": (2, 2), "strides": (2, 2)})
+spec("add_position_encoding", {"X": sgn((2, 4, 6), 267)},
+     {"alpha": 1.0, "beta": 0.5})
+spec("cvm", {"X": sgn((3, 5), 268), "CVM": sgn((3, 2), 269)},
+     {"use_cvm": True}, ref=lambda ins: [ins["X"]])
+
+
+# --- v1 aliases -------------------------------------------------------
+spec("reshape", {"X": sgn((2, 6), 270)}, {"shape": (3, 4)},
+     ref=lambda ins: [ins["X"].reshape(3, 4)])
+spec("transpose", {"X": sgn((2, 3), 271)}, {"axis": (1, 0)},
+     ref=lambda ins: [ins["X"].T])
+spec("squeeze", {"X": sgn((2, 1, 3), 272)}, {"axes": (1,)},
+     ref=lambda ins: [ins["X"].reshape(2, 3)])
+spec("unsqueeze", {"X": sgn((2, 3), 273)}, {"axes": (0,)},
+     ref=lambda ins: [ins["X"][None]])
+spec("flatten", {"X": sgn((2, 3, 4), 274)}, {"axis": 1},
+     ref=lambda ins: [ins["X"].reshape(2, 12)])
+spec("fill_zeros_like2", {"X": sgn((2, 3), 275)},
+     ref=lambda ins: [np.zeros((2, 3), np.float32)])
+spec("fill", {}, {"shape": (2, 2), "value": 1.5},
+     ref=lambda ins: [np.full((2, 2), 1.5, np.float32)])
+spec("minus", {"X": sgn((2, 3), 276), "Y": sgn((2, 3), 277)},
+     ref=lambda ins: [ins["X"] - ins["Y"]])
+spec("cross_entropy2",
+     {"X": u((3, 4), 278, lo=0.1, hi=0.3),
+      "Label": np.array([[0], [2], [3]], np.int64)},
+     ref=lambda ins: [-np.log(np.take_along_axis(
+         ins["X"], np.array([[0], [2], [3]]), axis=1)), None],
+     n_outputs=2)
+spec("gaussian_random_batch_size_like",
+     {"Input": sgn((4, 2), 279)}, {"shape": (1, 3)},
+     custom="batch_size_like_normal")
+spec("uniform_random_batch_size_like",
+     {"Input": sgn((5, 2), 280)},
+     {"shape": (1, 3), "min": -1.0, "max": 1.0},
+     custom="batch_size_like_uniform")
+
+
+def _seq_conv_ref(ins, ctx=3):
+    x, f = ins["X"], ins["Filter"]
+    B, T, D = x.shape
+    start = -((ctx - 1) // 2)
+    out = np.zeros((B, T, f.shape[1]), np.float32)
+    for b in range(B):
+        for t in range(T):
+            row = []
+            for j in range(ctx):
+                tt = t + start + j
+                row.append(x[b, tt] if 0 <= tt < T
+                           else np.zeros(D, np.float32))
+            out[b, t] = np.concatenate(row) @ f
+    return [out]
+
+
+spec("sequence_conv",
+     {"X": sgn((2, 4, 3), 281), "Filter": sgn((9, 5), 282)},
+     {"context_length": 3}, ref=_seq_conv_ref)
+spec("sequence_reshape", {"X": sgn((2, 4, 6), 283)}, {"new_dim": 8},
+     ref=lambda ins: [ins["X"].reshape(2, 3, 8), None], n_outputs=2)
+spec("sequence_scatter",
+     {"X": sgn((2, 6), 284), "Ids": np.array([[0, 2], [5, 5]], np.int64),
+      "Updates": sgn((2, 2), 285),
+      "Lengths": np.array([2, 1], np.int64)},
+     ref=lambda ins: [_seq_scatter_ref(ins)], grad=["X", "Updates"])
+
+
+def _seq_scatter_ref(ins):
+    out = ins["X"].copy()
+    out[0, 0] += ins["Updates"][0, 0]
+    out[0, 2] += ins["Updates"][0, 1]
+    out[1, 5] += ins["Updates"][1, 0]
+    return out
+
+
 EXEMPT = {
     "print": "test_misc_parity.py (host callback, pass-through)",
+    "nce": "test_new_ops.py (rng-sampled negatives)",
+    "sampling_id": "test_new_ops.py (rng draw, distribution check)",
+    "sample_logits": "test_new_ops.py (rng-sampled classes)",
+    "random_crop": "test_new_ops.py (rng offsets)",
+    "merge_selected_rows": "test_new_ops.py (SparseRows roundtrip)",
+    "get_tensor_from_selected_rows":
+        "test_new_ops.py (SparseRows roundtrip)",
     "while": "test_control_flow.py (lax.while/scan lowering + grad)",
     "static_rnn": "test_sequence_rnn.py",
     "dynamic_rnn": "test_sequence_rnn.py",
@@ -646,12 +1101,40 @@ def _check_random(op_type, attrs, kind):
         assert sorted(val.tolist()) == list(range(attrs["n"]))
 
 
+def _check_random_with_input(op_type, inputs, attrs, kind):
+    """batch_size_like generators: output batch dim copies the ref
+    input's; values follow the requested distribution."""
+    import paddle_tpu as fluid
+    from paddle_tpu.layer_helper import LayerHelper
+    main = fluid.Program()
+    main.random_seed = 99
+    with fluid.program_guard(main):
+        ref_np = inputs["Input"]
+        x = fluid.layers.data(name="inp", shape=list(ref_np.shape[1:]),
+                              dtype="float32")
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(
+            "float32", stop_gradient=True)
+        helper.append_op(type=op_type, inputs={"Input": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+    exe = fluid.Executor()
+    (val,) = exe.run(main, feed={"inp": ref_np}, fetch_list=[out])
+    expect = (ref_np.shape[0],) + tuple(attrs["shape"][1:])
+    assert val.shape == expect, (val.shape, expect)
+    if kind == "batch_size_like_uniform":
+        assert (val >= attrs["min"]).all() and \
+            (val <= attrs["max"]).all()
+
+
 @pytest.mark.parametrize("op_type,inputs,attrs,opt", _flat_cases())
 def test_op(op_type, inputs, attrs, opt):
     opdef = op_registry.get(op_type)
     custom = opt.get("custom")
     if custom:
-        _check_random(op_type, attrs, custom)
+        if custom.startswith("batch_size_like"):
+            _check_random_with_input(op_type, inputs, attrs, custom)
+        else:
+            _check_random(op_type, attrs, custom)
         return
     ref = opt.get("ref")
     if ref is not None:
