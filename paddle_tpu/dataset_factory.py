@@ -35,6 +35,50 @@ import numpy as np
 from .core.enforce import InvalidArgumentError, enforce
 from .recordio import Scanner
 
+_ms_lib = None
+_ms_lock = threading.Lock()
+
+
+def _multislot_lib():
+    """The native MultiSlot parser (native/multislot.cpp — the
+    data_feed.cc tokenizer), or None when no toolchain exists."""
+    global _ms_lib
+    with _ms_lock:
+        if _ms_lib is None:
+            import ctypes
+
+            from .native import load_library
+            lib = load_library("multislot.cpp")
+            if lib is None:
+                _ms_lib = False
+            else:
+                lib.ms_parse_file.restype = ctypes.c_int64
+                lib.ms_parse_file.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+                lib.ms_error.restype = ctypes.c_char_p
+                lib.ms_error.argtypes = [ctypes.c_int64]
+                lib.ms_num_instances.restype = ctypes.c_int64
+                lib.ms_num_instances.argtypes = [ctypes.c_int64]
+                lib.ms_slot_lens.restype = \
+                    ctypes.POINTER(ctypes.c_int32)
+                lib.ms_slot_lens.argtypes = [ctypes.c_int64,
+                                             ctypes.c_int]
+                lib.ms_slot_size.restype = ctypes.c_int64
+                lib.ms_slot_size.argtypes = [ctypes.c_int64,
+                                             ctypes.c_int]
+                lib.ms_slot_floats.restype = \
+                    ctypes.POINTER(ctypes.c_float)
+                lib.ms_slot_floats.argtypes = [ctypes.c_int64,
+                                               ctypes.c_int]
+                lib.ms_slot_ints.restype = \
+                    ctypes.POINTER(ctypes.c_int64)
+                lib.ms_slot_ints.argtypes = [ctypes.c_int64,
+                                             ctypes.c_int]
+                lib.ms_free.argtypes = [ctypes.c_int64]
+                _ms_lib = lib
+    return _ms_lib or None
+
 __all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
            "QueueDataset"]
 
@@ -133,6 +177,11 @@ class DatasetBase:
                 out.append(np.asarray([int(v) for v in vals], dtype))
             else:
                 out.append(np.asarray([float(v) for v in vals], dtype))
+        # strict like the native parser (and the reference's CheckFile,
+        # data_feed.cc): trailing tokens mean a slot-count mismatch
+        enforce(i == len(toks),
+                "MultiSlot instance has %d trailing tokens (more "
+                "slots in the file than use_vars?)" % (len(toks) - i))
         return out
 
     def _read_file(self, path):
@@ -144,6 +193,57 @@ class DatasetBase:
                     line = line.strip()
                     if line:
                         yield line
+
+    def _parse_file_native(self, path):
+        """Parse one MultiSlot text file with the C++ parser
+        (native/multislot.cpp, the data_feed.cc analog). Returns the
+        instance list, or None when the native library is unavailable
+        (Python fallback). The ctypes call releases the GIL, so the
+        reader THREAD POOL gets real parallelism here."""
+        lib = _multislot_lib()
+        if lib is None or not self._use_vars:
+            return None
+        import ctypes
+        dtypes = [np.dtype(getattr(v, "dtype", "float32") or "float32")
+                  for v in self._use_vars]
+        n = len(dtypes)
+        is_int = (ctypes.c_uint8 * n)(
+            *(1 if np.issubdtype(d, np.integer) else 0
+              for d in dtypes))
+        h = lib.ms_parse_file(path.encode(), is_int, n)
+        try:
+            err = lib.ms_error(h)
+            if err:
+                raise InvalidArgumentError(
+                    "%s: %s" % (path, err.decode()))
+            count = lib.ms_num_instances(h)
+            if count == 0:
+                return []
+            slots = []
+            for s in range(n):
+                lens = np.ctypeslib.as_array(
+                    lib.ms_slot_lens(h, s), shape=(count,)).copy()
+                size = lib.ms_slot_size(h, s)
+                if size == 0:
+                    # all-empty slot (sparse CTR): the arena is empty
+                    # and its data() is NULL — don't dereference
+                    vals = np.empty(0, dtypes[s])
+                elif is_int[s]:
+                    vals = np.ctypeslib.as_array(
+                        lib.ms_slot_ints(h, s),
+                        shape=(size,)).astype(dtypes[s], copy=True)
+                else:
+                    vals = np.ctypeslib.as_array(
+                        lib.ms_slot_floats(h, s),
+                        shape=(size,)).astype(dtypes[s], copy=True)
+                offs = np.zeros(count + 1, np.int64)
+                np.cumsum(lens, out=offs[1:])
+                slots.append([vals[offs[i]:offs[i + 1]]
+                              for i in range(count)])
+            return [[slots[s][i] for s in range(n)]
+                    for i in range(count)]
+        finally:
+            lib.ms_free(h)
 
     def _load_files_threaded(self, paths, emit):
         """Read ``paths`` with a thread pool (reference: the
@@ -161,8 +261,16 @@ class DatasetBase:
                 except queue_mod.Empty:
                     return
                 try:
-                    for rec in self._read_file(p):
-                        emit(self._parse_instance(rec))
+                    native = None
+                    if self._parse_fn is None and \
+                            not p.endswith((".rio", ".recordio")):
+                        native = self._parse_file_native(p)
+                    if native is not None:
+                        for inst in native:
+                            emit(inst)
+                    else:
+                        for rec in self._read_file(p):
+                            emit(self._parse_instance(rec))
                 except Exception as e:  # surface in the caller
                     errors.append((p, e))
 

@@ -390,14 +390,16 @@ class ParameterServerRuntime:
             for f in futs:
                 f.result()  # propagate RPC errors
 
-    def run_step(self, exe, feed, fetch_list=None, return_numpy=True):
+    def run_step(self, exe, feed, fetch_list=None, return_numpy=True,
+                 scope=None):
         from ..framework import grad_var_name
+        scope = scope or self.scope
         fetch_list = list(fetch_list or [])
         pnames = sorted(self.blocks)
         gnames = [grad_var_name(p) for p in pnames]
         out = exe.run(self.program, feed=feed,
                       fetch_list=fetch_list + gnames,
-                      scope=self.scope, return_numpy=False)
+                      scope=scope, return_numpy=False)
         user_out = out[:len(fetch_list)]
         gvals = {p: np.asarray(g) for p, g in
                  zip(pnames, out[len(fetch_list):])}
@@ -420,7 +422,7 @@ class ParameterServerRuntime:
             self.comm.barrier_all("send")
         self._per_endpoint(recv)
         for pname, bs in self.blocks.items():
-            self.scope.set_var(
+            scope.set_var(
                 pname, self._assemble(pname,
                                       [b.pop("_value") for b in bs]))
         if self.sync_mode:

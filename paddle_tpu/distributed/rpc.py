@@ -32,6 +32,11 @@ VERBS = {
     "PUSH_SPARSE": 6,  # sparse grad push: payload = ids + values
 }
 
+# response status byte (the wire field is u8 — keep codes < 256)
+STATUS_OK = 0
+STATUS_NOT_FOUND = 4
+STATUS_ERROR = 5
+
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -136,7 +141,8 @@ class RPCServer:
                 if plen.value else b""
             entry = self._handlers.get(verb.value)
             if entry is None:
-                lib.trpc_server_respond(self._h, req_id, 404, b"", 0)
+                lib.trpc_server_respond(self._h, req_id,
+                                        STATUS_NOT_FOUND, b"", 0)
                 continue
             handler, deferred = entry
             if deferred:
@@ -149,14 +155,14 @@ class RPCServer:
                 try:
                     handler(name, body, responder)
                 except Exception as e:
-                    responder(500, repr(e).encode())
+                    responder(STATUS_ERROR, repr(e).encode())
                 continue
             try:
                 resp = handler(name, body)
-                status = 0
-            except Exception as e:  # error -> status 500 + message
+                status = STATUS_OK
+            except Exception as e:  # error -> error status + message
                 resp = repr(e).encode()
-                status = 500
+                status = STATUS_ERROR
             lib.trpc_server_respond(self._h, req_id, status,
                                     resp, len(resp))
 
@@ -212,11 +218,12 @@ class RPCClient:
                 % (verb, name, self.endpoint, rc))
         body = ctypes.string_at(resp, rlen.value) if rlen.value else b""
         lib.trpc_free(resp)
-        if status.value == 500:
+        if status.value == STATUS_ERROR:
             raise UnavailableError(
                 "pserver %s handler error on %s(%s): %s"
                 % (self.endpoint, verb, name, body.decode()))
-        enforce(status.value == 0, "rpc %s(%s): server status %d"
+        enforce(status.value == STATUS_OK,
+                "rpc %s(%s): server status %d"
                 % (verb, name, status.value))
         return body
 

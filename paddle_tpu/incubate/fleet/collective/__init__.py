@@ -46,6 +46,9 @@ class DistributedStrategy:
         self.recompute_checkpoints = []
         self.use_local_sgd = False
         self.mode = "collective"
+        # PS fleet: async-SGD servers (applies grads on arrival;
+        # enables DC-ASGD when the transpiler config asks for it)
+        self.async_mode = False
         self.collective_mode = "grad_allreduce"
 
 

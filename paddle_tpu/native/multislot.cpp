@@ -1,0 +1,167 @@
+// multislot: native parser for the MultiSlot text format.
+//
+// The C++ analog of the reference's data-feed hot path
+// (/root/reference/paddle/fluid/framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance — data_feed.h:353): each line is
+// "<n> v1 ... vn" repeated per slot. Industrial CTR loading is
+// tokenizer-bound in Python; this parser runs one file per call with
+// no Python objects in the loop, and ctypes releases the GIL for the
+// duration, so the Dataset's file-sharded reader threads (the
+// reference's thread-per-DataFeed pool) parse truly in parallel.
+//
+// Results live in per-slot arenas (float32 or int64 values +
+// per-instance int32 lengths); Python wraps them as numpy views and
+// slices instances out without copying the arena.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  bool is_int;
+  std::vector<float> f;
+  std::vector<int64_t> i;
+  std::vector<int32_t> lens;  // one per instance
+};
+
+struct Parsed {
+  std::vector<Slot> slots;
+  int64_t n_instances = 0;
+  std::string error;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Parsed*> g_parsed;
+int64_t g_next = 1;
+
+// strtod/strtoll-based tokenizer over one line
+bool parse_line(const char* p, Parsed* out) {
+  char* end = nullptr;
+  for (auto& slot : out->slots) {
+    long n = std::strtol(p, &end, 10);
+    if (end == p || n < 0) return false;
+    p = end;
+    slot.lens.push_back(static_cast<int32_t>(n));
+    for (long k = 0; k < n; ++k) {
+      if (slot.is_int) {
+        long long v = std::strtoll(p, &end, 10);
+        if (end == p) return false;
+        slot.i.push_back(static_cast<int64_t>(v));
+      } else {
+        float v = std::strtof(p, &end);
+        if (end == p) return false;
+        slot.f.push_back(v);
+      }
+      p = end;
+    }
+  }
+  // trailing junk after the declared slots is a malformed instance
+  while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+  return *p == '\0' || *p == '\n';
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a whole file. is_int: one flag per slot. Returns a handle
+// (>0) or 0 on open failure / parse error (check ms_error).
+int64_t ms_parse_file(const char* path, const uint8_t* is_int,
+                      int n_slots) {
+  auto* out = new Parsed();
+  out->slots.resize(n_slots);
+  for (int s = 0; s < n_slots; ++s) out->slots[s].is_int = is_int[s];
+
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    out->error = "cannot open file";
+  } else {
+    std::string line;
+    char buf[1 << 16];
+    std::string acc;
+    while (std::fgets(buf, sizeof(buf), f)) {
+      acc += buf;
+      if (!acc.empty() && acc.back() != '\n' && !std::feof(f))
+        continue;  // long line spanned the buffer
+      // strip whitespace-only lines
+      const char* p = acc.c_str();
+      while (*p == ' ' || *p == '\t') ++p;
+      if (*p != '\0' && *p != '\n' && *p != '\r') {
+        if (!parse_line(p, out)) {
+          char msg[128];
+          std::snprintf(msg, sizeof(msg),
+                        "malformed MultiSlot instance #%lld",
+                        static_cast<long long>(out->n_instances));
+          out->error = msg;
+          break;
+        }
+        out->n_instances++;
+      }
+      acc.clear();
+    }
+    std::fclose(f);
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next++;
+  g_parsed[h] = out;
+  return h;
+}
+
+static Parsed* find(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_parsed.find(h);
+  return it == g_parsed.end() ? nullptr : it->second;
+}
+
+const char* ms_error(int64_t h) {
+  Parsed* p = find(h);
+  if (!p) return "bad handle";
+  return p->error.empty() ? nullptr : p->error.c_str();
+}
+
+int64_t ms_num_instances(int64_t h) {
+  Parsed* p = find(h);
+  return p ? p->n_instances : -1;
+}
+
+// Per-slot accessors: pointers stay valid until ms_free(handle).
+const int32_t* ms_slot_lens(int64_t h, int slot) {
+  Parsed* p = find(h);
+  return p ? p->slots[slot].lens.data() : nullptr;
+}
+
+int64_t ms_slot_size(int64_t h, int slot) {
+  Parsed* p = find(h);
+  if (!p) return -1;
+  const Slot& s = p->slots[slot];
+  return s.is_int ? static_cast<int64_t>(s.i.size())
+                  : static_cast<int64_t>(s.f.size());
+}
+
+const float* ms_slot_floats(int64_t h, int slot) {
+  Parsed* p = find(h);
+  return p ? p->slots[slot].f.data() : nullptr;
+}
+
+const int64_t* ms_slot_ints(int64_t h, int slot) {
+  Parsed* p = find(h);
+  return p ? p->slots[slot].i.data() : nullptr;
+}
+
+void ms_free(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_parsed.find(h);
+  if (it != g_parsed.end()) {
+    delete it->second;
+    g_parsed.erase(it);
+  }
+}
+
+}  // extern "C"

@@ -196,3 +196,67 @@ def gru_unit(x, h_prev, weight, bias, *, gate_activation="sigmoid",
     u, r = ur[..., :H], ur[..., H:]
     c = candact(x[..., 2 * H:] + (r * h_prev) @ w_c)
     return (1.0 - u) * h_prev + u * c
+
+
+@register("lstmp",
+          ["Input", "H0", "C0", "Weight", "ProjWeight", "Bias",
+           "SeqLen"],
+          ["Projection", "Cell", "LastH", "LastC"],
+          nondiff=("SeqLen",))
+def lstmp(x, h0, c0, weight, proj_weight, bias, seq_len, *,
+          use_peepholes=False, is_reverse=False,
+          gate_activation="sigmoid", cell_activation="tanh",
+          candidate_activation="tanh", proj_activation="tanh",
+          proj_clip=0.0, cell_clip=0.0):
+    """LSTM with a recurrent projection layer (reference:
+    lstmp_op.cc — LSTMP, Sak et al.): the recurrent state is the
+    PROJECTED hidden r = act_p(h @ P) with P [H, R]; weight is
+    [R, 4H] (recurrence runs on the projection). x: [B, T, 4H]."""
+    B, T, H4 = x.shape
+    H = H4 // 4
+    R = proj_weight.shape[1]
+    enforce(weight.shape == (R, 4 * H),
+            "lstmp weight must be [R, 4H], got %s" % (weight.shape,))
+    gact = _ACT[gate_activation]
+    cact = _ACT[cell_activation]
+    candact = _ACT[candidate_activation]
+    pact = _ACT[proj_activation]
+    if h0 is None:
+        r0 = jnp.zeros((B, R), x.dtype)
+    else:
+        r0 = h0 if h0.shape[-1] == R else pact(h0 @ proj_weight)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), x.dtype)
+    b_gates = bias[..., :4 * H].reshape(4 * H) if bias is not None \
+        else 0.0
+    if use_peepholes and bias is not None:
+        peep = bias.reshape(-1)[4 * H:]
+        w_ic, w_fc, w_oc = peep[:H], peep[H:2 * H], peep[2 * H:3 * H]
+    else:
+        w_ic = w_fc = w_oc = None
+
+    def cell(x_t, states):
+        r_prev, c_prev = states
+        gates = x_t + r_prev @ weight + b_gates
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + w_ic * c_prev
+            gf = gf + w_fc * c_prev
+        i = gact(gi)
+        f = gact(gf)
+        c = f * c_prev + i * candact(gc)
+        if cell_clip > 0.0:
+            c = jnp.clip(c, -cell_clip, cell_clip)
+        if w_oc is not None:
+            go = go + w_oc * c
+        o = gact(go)
+        h = o * cact(c)
+        r = pact(h @ proj_weight)
+        if proj_clip > 0.0:
+            r = jnp.clip(r, -proj_clip, proj_clip)
+        return (r, c), jnp.concatenate([r, c], axis=-1)
+
+    rc, (last_r, last_c) = _scan_rnn(cell, x, (r0, c0), seq_len,
+                                     is_reverse)
+    proj, cellv = rc[..., :R], rc[..., R:]
+    return proj, cellv, last_r, last_c

@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
+from paddle_tpu import layers
 from paddle_tpu.incubate.fleet.base import role_maker
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -167,3 +168,98 @@ class TestFleetTwoProcess:
             np.testing.assert_allclose(
                 dist_losses, local_losses, rtol=2e-4,
                 err_msg="worker %d loss trace diverged" % r)
+
+
+class TestFleetRealPS:
+    def test_full_ps_ux(self, rng):
+        """The reference fleet PS workflow end to end: server via
+        init_server/run_server (thread), worker via init_worker +
+        exe.run(fleet.main_program) + stop_worker — over the native
+        RPC transport with a real port."""
+        import socket
+        import threading
+
+        import numpy as np
+        from paddle_tpu.incubate.fleet.base.role_maker import (
+            Role, UserDefinedRoleMaker)
+        from paddle_tpu.incubate.fleet.parameter_server import (
+            ParameterServerFleet)
+
+        # reserve a port for the pserver
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        ep = "127.0.0.1:%d" % port
+
+        def build():
+            # separate processes each start a fresh name counter; the
+            # in-process test must emulate that or the worker's param
+            # names drift from the server's
+            from paddle_tpu import unique_name
+            with unique_name.guard():
+                main, startup = fluid.Program(), fluid.Program()
+                main.random_seed = startup.random_seed = 5
+                with fluid.program_guard(main, startup):
+                    x = layers.data(name="x", shape=[8],
+                                    dtype="float32")
+                    y = layers.data(name="y", shape=[1],
+                                    dtype="int64")
+                    pred = layers.fc(x, size=4, act="softmax")
+                    loss = layers.mean(
+                        layers.cross_entropy(pred, y))
+            return main, startup, loss
+
+        server_ready = threading.Event()
+        server_err = []
+
+        def run_server():
+            try:
+                f = ParameterServerFleet()
+                f.init(UserDefinedRoleMaker(
+                    current_id=0, role=Role.SERVER, worker_num=1,
+                    server_endpoints=[ep]))
+                main, startup, loss = build()
+                with fluid.program_guard(main, startup):
+                    opt = f.distributed_optimizer(
+                        fluid.optimizer.SGDOptimizer(0.3))
+                    opt.minimize(loss)
+                f.init_server()
+                server_ready.set()
+                f.run_server()
+            except Exception as e:  # surfaces in the main thread
+                server_err.append(e)
+                server_ready.set()
+
+        th = threading.Thread(target=run_server, daemon=True)
+        th.start()
+        assert server_ready.wait(timeout=60)
+        assert not server_err, server_err
+
+        wf = ParameterServerFleet()
+        wf.init(UserDefinedRoleMaker(
+            current_id=0, role=Role.WORKER, worker_num=1,
+            server_endpoints=[ep]))
+        main, startup, loss = build()
+        with fluid.program_guard(main, startup):
+            opt = wf.distributed_optimizer(
+                fluid.optimizer.SGDOptimizer(0.3))
+            opt.minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            wf.init_worker()
+            vals = []
+            for _ in range(5):
+                feed = {"x": rng.rand(16, 8).astype(np.float32),
+                        "y": rng.randint(0, 4, (16, 1))
+                        .astype(np.int64)}
+                (lv,) = exe.run(wf.main_program, feed=feed,
+                                fetch_list=[loss])
+                vals.append(float(np.asarray(lv).reshape(-1)[0]))
+            wf.stop_worker()
+        th.join(timeout=60)
+        assert not th.is_alive(), "server did not stop on COMPLETE"
+        assert np.isfinite(vals).all()
+        assert vals[-1] < vals[0]

@@ -977,6 +977,11 @@ def _seq_conv_ref(ins, ctx=3):
     return [out]
 
 
+spec("lstmp",
+     {"Input": sgn((2, 3, 16), 290), "Weight": sgn((3, 16), 291),
+      "ProjWeight": sgn((4, 3), 292), "Bias": sgn((16,), 293)},
+     grad=["Input", "Weight", "ProjWeight", "Bias"], n_outputs=4,
+     max_rel=0.03)  # deep tanh chains: fp32 FD noise compounds
 spec("sequence_conv",
      {"X": sgn((2, 4, 3), 281), "Filter": sgn((9, 5), 282)},
      {"context_length": 3}, ref=_seq_conv_ref)

@@ -302,3 +302,58 @@ class TestDataset:
             # the Executor entry point drives the same loop
             n = exe.train_from_dataset(main, ds, fetch_list=[loss])
             assert n == ds.get_memory_data_size() // 8
+
+
+class TestNativeMultiSlotParser:
+    def test_native_matches_python(self, tmp_path, rng):
+        """The C++ tokenizer must produce byte-identical instances to
+        the Python reference parser."""
+        from paddle_tpu.dataset_factory import (DatasetFactory,
+                                                _multislot_lib)
+        assert _multislot_lib() is not None, "native parser not built"
+        rows = ["0 2 1.5 2.5", "0 1 3.5"]  # empty int slot (sparse)
+        for _ in range(48):
+            n1 = rng.randint(1, 5)
+            n2 = rng.randint(1, 4)
+            rows.append("%d %s %d %s" % (
+                n1, " ".join(str(rng.randint(0, 99)) for _ in range(n1)),
+                n2, " ".join("%.4f" % v for v in rng.rand(n2))))
+        path = tmp_path / "part.txt"
+        path.write_text("\n".join(rows) + "\n\n")  # trailing blank line
+
+        class _V:
+            def __init__(self, name, dtype, shape):
+                self.name, self.dtype, self.shape = name, dtype, shape
+
+        def load(native):
+            ds = DatasetFactory().create_dataset("InMemoryDataset")
+            ds.set_batch_size(10)
+            ds.set_use_var([_V("ids", "int64", (-1, 8)),
+                            _V("vals", "float32", (-1, 8))])
+            if not native:
+                # force the python tokenizer path
+                ds._parse_file_native = lambda p: None
+            ds.set_filelist([str(path)])
+            ds.load_into_memory()
+            return ds._instances
+
+        a = load(native=True)
+        b = load(native=False)
+        assert len(a) == len(b) == 50
+        for ia, ib in zip(a, b):
+            for sa, sb in zip(ia, ib):
+                assert sa.dtype == sb.dtype
+                np.testing.assert_array_equal(sa, sb)
+
+    def test_native_rejects_malformed(self, tmp_path):
+        from paddle_tpu.dataset_factory import _multislot_lib
+        import ctypes
+        lib = _multislot_lib()
+        p = tmp_path / "bad.txt"
+        p.write_text("2 1.0\n")  # declares 2 values, has 1
+        is_int = (ctypes.c_uint8 * 1)(0)
+        h = lib.ms_parse_file(str(p).encode(), is_int, 1)
+        try:
+            assert lib.ms_error(h) is not None
+        finally:
+            lib.ms_free(h)
