@@ -134,6 +134,8 @@ class ParameterServerFleet(Collective):
     def init_worker(self):
         if not self._server_mode():
             return  # collective path needs no worker bootstrap
+        enforce(self._transpiler is not None,
+                "call distributed_optimizer(...).minimize(loss) first")
         from ....core.scope import global_scope
         from ....distributed import ParameterServerRuntime
         t = self._transpiler

@@ -27,7 +27,7 @@ __all__ = [
     "yolov3_loss", "yolo_box", "box_clip", "multiclass_nms",
     "distribute_fpn_proposals", "box_decoder_and_assign",
     "collect_fpn_proposals", "roi_align", "roi_pool",
-]
+    "psroi_pool", "deformable_conv"]
 
 
 def _mk(helper, dtype="float32", stop_gradient=False):
@@ -494,3 +494,64 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = tensor.concat(prior_list, axis=0)
     variances = tensor.concat(var_list, axis=0)
     return mbox_locs, mbox_confs, boxes, variances
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale,
+               pooled_height, pooled_width, rois_batch_idx=None,
+               name=None):
+    """Position-sensitive ROI pooling (reference: layers/detection.py?
+    -> psroi_pool_op.cc; R-FCN heads)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if rois_batch_idx is None:
+        from . import tensor as _t
+        rois_batch_idx = _t.fill_constant_batch_size_like(
+            rois, shape=[-1], dtype="int32", value=0)
+    helper.append_op(
+        type="psroi_pool",
+        inputs={"X": [input], "ROIs": [rois],
+                "RoisBatchIdx": [rois_batch_idx]},
+        outputs={"Out": [out]},
+        attrs={"output_channels": output_channels,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=64,
+                    param_attr=None, bias_attr=None, name=None):
+    """Deformable convolution layer (reference: layers/nn.py
+    deformable_conv -> deformable_conv_op.cc)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("deformable_conv", name=name)
+
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    fsize = _pair(filter_size)
+    channels = input.shape[1]
+    w = helper.create_parameter(
+        attr=param_attr,
+        shape=(num_filters, channels // groups) + fsize,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="deformable_conv",
+        inputs={"Input": [input], "Offset": [offset],
+                "Mask": [mask] if mask is not None else [],
+                "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _pair(stride), "paddings": _pair(padding),
+               "dilations": _pair(dilation), "groups": groups,
+               "deformable_groups": deformable_groups,
+               "im2col_step": im2col_step})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=(num_filters,),
+                                    dtype=input.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return out

@@ -222,3 +222,99 @@ class Auc(MetricBase):
         tpr = pos / tot_pos
         fpr = neg / tot_neg
         return float(np.trapz(tpr, fpr))
+
+
+class DetectionMAP(MetricBase):
+    """VOC-style mean average precision over accumulated detections
+    (reference: python metrics.py DetectionMAP over
+    operators/detection/detection_map_op.cc). Host-side: detections
+    arrive per image as [M, 6] rows (label, score, x1, y1, x2, y2) with
+    ground truth [G, 4] boxes + [G] labels; matching is greedy by score
+    at ``overlap_threshold`` IoU, AP integrates the PR curve
+    (``ap_version``: "integral" or "11point")."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__(name)
+        self.overlap_threshold = float(overlap_threshold)
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._scored = {}   # class -> list of (score, is_tp)
+        self._n_gt = {}     # class -> ground-truth count
+
+    @staticmethod
+    def _iou(box, boxes):
+        x1 = np.maximum(box[0], boxes[:, 0])
+        y1 = np.maximum(box[1], boxes[:, 1])
+        x2 = np.minimum(box[2], boxes[:, 2])
+        y2 = np.minimum(box[3], boxes[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / np.maximum(a + b - inter, 1e-10)
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        """One image's detections + ground truth."""
+        detections = np.asarray(detections, np.float32).reshape(-1, 6)
+        gt_boxes = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels).reshape(-1).astype(int)
+        if difficult is None:
+            difficult = np.zeros(len(gt_labels), bool)
+        else:
+            difficult = np.asarray(difficult).reshape(-1).astype(bool)
+        for c in np.unique(gt_labels):
+            count = int(np.sum((gt_labels == c) &
+                               (self.evaluate_difficult |
+                                ~difficult)))
+            self._n_gt[int(c)] = self._n_gt.get(int(c), 0) + count
+        for c in np.unique(detections[:, 0].astype(int)):
+            dets = detections[detections[:, 0].astype(int) == c]
+            dets = dets[np.argsort(-dets[:, 1])]
+            gmask = gt_labels == c
+            gboxes = gt_boxes[gmask]
+            gdiff = difficult[gmask]
+            taken = np.zeros(len(gboxes), bool)
+            rec = self._scored.setdefault(int(c), [])
+            for d in dets:
+                if len(gboxes) == 0:
+                    rec.append((float(d[1]), False))
+                    continue
+                ious = self._iou(d[2:6], gboxes)
+                j = int(np.argmax(ious))
+                if ious[j] >= self.overlap_threshold and not taken[j]:
+                    taken[j] = True
+                    if self.evaluate_difficult or not gdiff[j]:
+                        rec.append((float(d[1]), True))
+                else:
+                    # below threshold OR duplicate on a taken gt: FP
+                    rec.append((float(d[1]), False))
+
+    def _ap(self, scored, n_gt):
+        if n_gt == 0:
+            return None  # nothing to find: class doesn't count
+        if not scored:
+            return 0.0   # GT present, nothing detected: AP is zero
+        scored = sorted(scored, key=lambda t: -t[0])
+        tp = np.cumsum([1.0 if hit else 0.0 for _, hit in scored])
+        fp = np.cumsum([0.0 if hit else 1.0 for _, hit in scored])
+        recall = tp / n_gt
+        precision = tp / np.maximum(tp + fp, 1e-10)
+        if self.ap_version == "11point":
+            return float(np.mean([
+                np.max(precision[recall >= r], initial=0.0)
+                for r in np.linspace(0, 1, 11)]))
+        # integral AP: sum precision deltas at each new recall point
+        ap, prev_r = 0.0, 0.0
+        for p, r in zip(precision, recall):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return float(ap)
+
+    def eval(self):
+        aps = [self._ap(self._scored.get(c, []), n)
+               for c, n in self._n_gt.items()]
+        aps = [a for a in aps if a is not None]
+        return float(np.mean(aps)) if aps else 0.0

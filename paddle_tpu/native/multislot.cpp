@@ -83,14 +83,9 @@ int64_t ms_parse_file(const char* path, const uint8_t* is_int,
   if (!f) {
     out->error = "cannot open file";
   } else {
-    std::string line;
     char buf[1 << 16];
     std::string acc;
-    while (std::fgets(buf, sizeof(buf), f)) {
-      acc += buf;
-      if (!acc.empty() && acc.back() != '\n' && !std::feof(f))
-        continue;  // long line spanned the buffer
-      // strip whitespace-only lines
+    auto flush_acc = [&]() -> bool {
       const char* p = acc.c_str();
       while (*p == ' ' || *p == '\t') ++p;
       if (*p != '\0' && *p != '\n' && *p != '\r') {
@@ -100,12 +95,22 @@ int64_t ms_parse_file(const char* path, const uint8_t* is_int,
                         "malformed MultiSlot instance #%lld",
                         static_cast<long long>(out->n_instances));
           out->error = msg;
-          break;
+          return false;
         }
         out->n_instances++;
       }
       acc.clear();
+      return true;
+    };
+    while (std::fgets(buf, sizeof(buf), f)) {
+      acc += buf;
+      if (!acc.empty() && acc.back() != '\n' && !std::feof(f))
+        continue;  // long line spanned the buffer
+      if (!flush_acc()) break;
     }
+    // an unterminated final line whose length is an exact multiple of
+    // the buffer leaves acc non-empty after fgets returns NULL
+    if (out->error.empty() && !acc.empty()) flush_acc();
     std::fclose(f);
   }
   std::lock_guard<std::mutex> lk(g_mu);

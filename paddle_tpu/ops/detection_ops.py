@@ -1116,3 +1116,58 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         return total
 
     return jax.vmap(one)(location, confidence, gt_box, gt_label)
+
+
+@register("psroi_pool", ["X", "ROIs", "RoisBatchIdx"], ["Out"],
+          nondiff=("ROIs", "RoisBatchIdx"))
+def psroi_pool(x, rois, rois_batch_idx, *, output_channels,
+               pooled_height=1, pooled_width=1, spatial_scale=1.0):
+    """Position-sensitive ROI pooling (reference: psroi_pool_op.cc,
+    R-FCN): x [N, output_channels*ph*pw, H, W]; bin (i, j) of output
+    channel c AVERAGE-pools the input channel c*ph*pw + i*pw + j over
+    that bin's region. Same static-shape strategy as roi_pool: bin
+    membership masks + segment reduction, lax.map over ROIs."""
+    n, cin, hh, ww = x.shape
+    ph, pw = pooled_height, pooled_width
+    co = output_channels
+    hs = jnp.arange(hh, dtype=jnp.float32)
+    ws = jnp.arange(ww, dtype=jnp.float32)
+
+    def one_roi(args):
+        roi, bidx = args
+        img = x[jnp.clip(bidx, 0, n - 1)]          # [Cin, H, W]
+        # reference rounds the roi to the feature grid
+        rx1 = jnp.round(roi[0] * spatial_scale)
+        ry1 = jnp.round(roi[1] * spatial_scale)
+        rx2 = jnp.round(roi[2] * spatial_scale)
+        ry2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(rx2 - rx1, 0.1)
+        rh = jnp.maximum(ry2 - ry1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # bin index of every cell (or -1 outside the roi)
+        bh = jnp.floor((hs - ry1) / bin_h)
+        bw = jnp.floor((ws - rx1) / bin_w)
+        in_h = (hs >= ry1) & (hs < ry2)
+        in_w = (ws >= rx1) & (ws < rx2)
+        bh = jnp.clip(bh, 0, ph - 1).astype(jnp.int32)
+        bw = jnp.clip(bw, 0, pw - 1).astype(jnp.int32)
+        # one-hot bin masks: [ph, H] and [pw, W]
+        mh = (jnp.arange(ph)[:, None] == bh[None, :]) & in_h[None, :]
+        mw = (jnp.arange(pw)[:, None] == bw[None, :]) & in_w[None, :]
+        mh = mh.astype(x.dtype)
+        mw = mw.astype(x.dtype)
+        # sums per (channel, bin): [Cin, ph, pw]
+        sums = jnp.einsum("chw,ih,jw->cij", img, mh, mw)
+        cnts = jnp.maximum(jnp.einsum("ih,jw->ij", mh, mw), 1.0)
+        avg = sums / cnts[None]
+        # position-sensitive channel selection:
+        # out[c, i, j] = avg[c*ph*pw + i*pw + j, i, j]
+        avg = avg.reshape(co, ph, pw, ph, pw)
+        ii = jnp.arange(ph)
+        jj = jnp.arange(pw)
+        return avg[:, ii[:, None], jj[None, :],
+                   ii[:, None], jj[None, :]]
+
+    return lax.map(one_roi, (rois.astype(jnp.float32),
+                             rois_batch_idx.astype(jnp.int32)))

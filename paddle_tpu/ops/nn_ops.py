@@ -228,12 +228,15 @@ def conv2d(x, w, *, strides=(1, 1), paddings=(0, 0), dilations=(1, 1),
         x.shape, w.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
         else ("NHWC", "HWIO", "NHWC"))
+    # NOTE: no preferred_element_type here — requesting an f32 output
+    # from a bf16 conv breaks JAX's transpose rule under AMP (the
+    # backward conv then mixes bf16/f32 operands). The MXU accumulates
+    # in f32 internally either way; the output rounds to the input
+    # dtype like every other white-list matmul op.
     return lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None)
+        feature_group_count=groups)
 
 
 @register("depthwise_conv2d", ["Input", "Filter"], ["Output"])
@@ -350,13 +353,19 @@ def batch_norm(x, scale, bias, mean, var, *, epsilon=1e-5, momentum=0.9,
         return v.reshape(bshape)
 
     if is_test or use_global_stats:
-        y = (x - _r(mean)) * _r(scale) * lax.rsqrt(_r(var) + epsilon) \
-            + _r(bias)
+        y = ((x.astype(jnp.float32) - _r(mean)) * _r(scale) *
+             lax.rsqrt(_r(var) + epsilon) +
+             _r(bias)).astype(x.dtype)
         return y, mean, var, mean, var
-    bmean = jnp.mean(x, axis=axes)
-    bvar = jnp.mean(jnp.square(x), axis=axes) - jnp.square(bmean)
-    y = (x - _r(bmean)) * _r(scale) * lax.rsqrt(_r(bvar) + epsilon) \
-        + _r(bias)
+    # Statistics ALWAYS in f32 (the reference's fp16 BN keeps float
+    # accumulators, batch_norm_op.cu): the one-pass E[x^2]-E[x]^2 form
+    # in bf16 cancels catastrophically (negative variance -> rsqrt
+    # NaN under AMP). Two-pass + f32 is cheap and stable.
+    xf = x.astype(jnp.float32)
+    bmean = jnp.mean(xf, axis=axes)
+    bvar = jnp.mean(jnp.square(xf - _r(bmean)), axis=axes)
+    y = ((xf - _r(bmean)) * _r(scale) *
+         lax.rsqrt(_r(bvar) + epsilon) + _r(bias)).astype(x.dtype)
     mean_out = momentum * mean + (1.0 - momentum) * bmean
     var_out = momentum * var + (1.0 - momentum) * bvar
     return y, mean_out, var_out, bmean, bvar

@@ -512,3 +512,104 @@ def cvm(x, cvm_feats, *, use_cvm=True):
     if use_cvm:
         return x
     return x[:, 2:]
+
+
+def _bilinear_gather(img, ys, xs):
+    """img [C, H, W]; ys/xs float [...]: bilinear sample with zero
+    padding outside. Returns [C, ...]."""
+    C, H, W = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    dy = ys - y0
+    dx = xs - x0
+
+    def tap(yi, xi):
+        inside = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                    # [C, ...]
+        return jnp.where(inside, v, 0.0)
+
+    return (tap(y0, x0) * (1 - dy) * (1 - dx) +
+            tap(y0, x0 + 1) * (1 - dy) * dx +
+            tap(y0 + 1, x0) * dy * (1 - dx) +
+            tap(y0 + 1, x0 + 1) * dy * dx)
+
+
+@register("deformable_conv", ["Input", "Offset", "Mask", "Filter"],
+          ["Output"])
+def deformable_conv(x, offset, mask, w, *, strides=(1, 1),
+                    paddings=(0, 0), dilations=(1, 1),
+                    deformable_groups=1, groups=1, im2col_step=64):
+    """Deformable convolution v1/v2 (reference:
+    deformable_conv_op.cc): each kernel tap samples the input at its
+    regular position PLUS a learned offset (bilinear), optionally
+    modulated by a mask (v2). Offset [N, 2*dg*kh*kw, Ho, Wo] ordered
+    (y, x) per tap; Mask [N, dg*kh*kw, Ho, Wo] or None.
+
+    TPU formulation: build the sampled patch tensor
+    [N, C, kh*kw, Ho, Wo] with vectorized bilinear gathers (XLA lowers
+    them to dynamic-gathers; the backward scatter-adds are derived by
+    autodiff, replacing the hand-written deformable_col2im kernels),
+    then contract with the filter in ONE einsum on the MXU."""
+    N, C, H, W = x.shape
+    Co, Cg, kh, kw = w.shape
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    K = kh * kw
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = deformable_groups
+
+    # base sampling positions per tap: [K, Ho, Wo]
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing="ij")
+    base_y = ky.reshape(K, 1, 1) + oy.reshape(1, Ho, 1)
+    base_x = kx.reshape(K, 1, 1) + ox.reshape(1, 1, Wo)
+    base_y = jnp.broadcast_to(base_y, (K, Ho, Wo)).astype(jnp.float32)
+    base_x = jnp.broadcast_to(base_x, (K, Ho, Wo)).astype(jnp.float32)
+
+    off = offset.reshape(N, dg, K, 2, Ho, Wo).astype(jnp.float32)
+    ys = base_y[None, None] + off[:, :, :, 0]       # [N, dg, K, Ho, Wo]
+    xs = base_x[None, None] + off[:, :, :, 1]
+    if mask is not None:
+        m = mask.reshape(N, dg, K, Ho, Wo).astype(jnp.float32)
+    else:
+        m = None
+
+    cpg = C // dg  # channels per deformable group
+
+    def one_image(args):
+        img, ysn, xsn, mn = args
+
+        def one_dg(d):
+            sub = lax.dynamic_slice_in_dim(img, d * cpg, cpg, axis=0)
+            s = _bilinear_gather(sub, ysn[d], xsn[d])  # [cpg, K, Ho, Wo]
+            if mn is not None:
+                s = s * mn[d][None]
+            return s
+
+        # dg is small and static: unrolled loop keeps indexing static
+        parts = [one_dg(d) for d in range(dg)]
+        return jnp.concatenate(parts, axis=0)       # [C, K, Ho, Wo]
+
+    if m is None:
+        cols = lax.map(lambda a: one_image((a[0], a[1], a[2], None)),
+                       (x, ys, xs))
+    else:
+        cols = lax.map(one_image, (x, ys, xs, m))
+    # cols [N, C, K, Ho, Wo] x w [Co, Cg, kh, kw] -> [N, Co, Ho, Wo]
+    wk = w.reshape(Co, Cg, K)
+    if groups == 1:
+        out = jnp.einsum("nckhw,ock->nohw", cols, wk)
+    else:
+        cpg2 = C // groups
+        opg = Co // groups
+        cols_g = cols.reshape(N, groups, cpg2, K, Ho, Wo)
+        wk_g = wk.reshape(groups, opg, Cg, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", cols_g, wk_g) \
+            .reshape(N, Co, Ho, Wo)
+    return out.astype(x.dtype)

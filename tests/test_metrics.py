@@ -104,3 +104,38 @@ def test_in_graph_auc_vs_host_auc():
                         fetch_list=[auc_var])
         host.update(pr, lab)
     assert float(av) == pytest.approx(host.eval(), abs=2e-2)
+
+
+class TestDetectionMAP:
+    def test_voc_map(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP()
+        gt = [[0, 0, 10, 10], [20, 20, 30, 30]]
+        dets = [[1, 0.9, 0, 0, 10, 10],
+                [1, 0.8, 50, 50, 60, 60],
+                [1, 0.7, 20, 20, 30, 30]]
+        m.update(dets, gt, [1, 1])
+        assert abs(m.eval() - (0.5 + (2 / 3) * 0.5)) < 1e-6
+        # duplicate detection on a taken gt counts as FP
+        m.update([[1, 0.95, 0, 0, 10, 10],
+                  [1, 0.85, 0, 0, 10, 10]], [[0, 0, 10, 10]], [1])
+        assert 0.0 < m.eval() < 1.0
+        m.reset()
+        assert m.eval() == 0.0
+
+    def test_multiclass_and_difficult(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP(evaluate_difficult=False)
+        gt = [[0, 0, 10, 10], [20, 20, 30, 30]]
+        # class 2's gt is 'difficult' -> excluded from its denominator
+        m.update([[1, 0.9, 0, 0, 10, 10]], gt, [1, 2],
+                 difficult=[False, True])
+        assert abs(m.eval() - 1.0) < 1e-6  # class 1 perfect; class 2 n_gt=0
+
+    def test_missed_class_counts_as_zero_ap(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP()
+        # class 1 perfect, class 2 has GT but no detections at all
+        m.update([[1, 0.9, 0, 0, 10, 10]],
+                 [[0, 0, 10, 10], [20, 20, 30, 30]], [1, 2])
+        assert abs(m.eval() - 0.5) < 1e-6

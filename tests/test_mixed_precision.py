@@ -122,3 +122,38 @@ def test_static_loss_scaling_matches_unscaled_sgd():
         return out
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+class TestAMPConvBN:
+    def test_conv_bn_amp_trains(self, rng):
+        """conv2d + batch_norm under bf16 AMP: the conv transpose rule
+        must accept the cast dtypes (no preferred_element_type
+        mismatch) and BN statistics stay f32 (bf16 one-pass variance
+        NaNs) — regression for the resnet AMP bench failure."""
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+        from paddle_tpu.contrib import mixed_precision as amp
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 16, 16],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1],
+                                dtype="int64")
+            c = layers.conv2d(img, num_filters=8, filter_size=3,
+                              padding=1, bias_attr=False)
+            b = layers.batch_norm(c, act="relu")
+            flat = layers.reshape(b, shape=[-1, 8 * 16 * 16])
+            pred = layers.fc(flat, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            amp.decorate(fluid.optimizer.MomentumOptimizer(
+                0.05, 0.9)).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"img": rng.rand(8, 3, 16, 16).astype(np.float32),
+                "label": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+        vals = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0])
+                      .reshape(-1)[0]) for _ in range(10)]
+        assert np.isfinite(vals).all(), vals
+        assert vals[-1] < vals[0]
