@@ -1,0 +1,19 @@
+"""Shared shape-normalization helpers (the reference spreads private
+_pair/_triple copies across layers; one canonical spot here)."""
+
+from __future__ import annotations
+
+
+def to_ntuple(v, n):
+    """Normalize a scalar-or-sequence to an n-tuple."""
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def pair(v):
+    return to_ntuple(v, 2)
+
+
+def triple(v):
+    return to_ntuple(v, 3)

@@ -13,7 +13,10 @@ from .base import VarBase, run_dygraph_op
 from .layers import Layer
 
 __all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm",
-           "Embedding", "LayerNorm", "GRUUnit", "Dropout"]
+           "Embedding", "LayerNorm", "GRUUnit", "Dropout",
+           "Conv2DTranspose", "Conv3D", "Conv3DTranspose", "PRelu",
+           "NCE", "BilinearTensorProduct", "GroupNorm",
+           "SpectralNorm", "RowConv", "SequenceConv"]
 
 
 class Conv2D(Layer):
@@ -231,4 +234,260 @@ class Dropout(Layer):
             "dropout", {"X": [x]},
             {"dropout_prob": self._p, "is_test": False,
              "dropout_implementation": "upscale_in_train"})
+        return out
+
+
+from ..core.shape_utils import pair as _pair  # noqa: E402
+from ..core.shape_utils import triple as _triple  # noqa: E402
+
+
+class Conv2DTranspose(Layer):
+    """Reference: dygraph/nn.py Conv2DTranspose."""
+
+    def __init__(self, name_scope=None, num_channels=None,
+                 num_filters=None, filter_size=3, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"strides": _pair(stride),
+                       "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(num_channels, num_filters // groups) +
+            _pair(filter_size), attr=param_attr)
+        self.bias = self.create_parameter(shape=(num_filters,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        out = run_dygraph_op(
+            "conv2d_transpose", {"Input": [x], "Filter": [self.weight]},
+            dict(self._attrs))
+        if self.bias is not None:
+            out = run_dygraph_op("elementwise_add",
+                                 {"X": [out], "Y": [self.bias]},
+                                 {"axis": 1})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class Conv3D(Layer):
+    """Reference: dygraph/nn.py Conv3D (conv3d_op)."""
+
+    def __init__(self, name_scope=None, num_channels=None,
+                 num_filters=None, filter_size=3, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(num_filters, num_channels // groups) +
+            _triple(filter_size), attr=param_attr)
+        self.bias = self.create_parameter(shape=(num_filters,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        out = run_dygraph_op(
+            "conv3d", {"Input": [x], "Filter": [self.weight]},
+            dict(self._attrs))
+        if self.bias is not None:
+            out = run_dygraph_op("elementwise_add",
+                                 {"X": [out], "Y": [self.bias]},
+                                 {"axis": 1})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, name_scope=None, num_channels=None,
+                 num_filters=None, filter_size=3, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(num_channels, num_filters // groups) +
+            _triple(filter_size), attr=param_attr)
+        self.bias = self.create_parameter(shape=(num_filters,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        out = run_dygraph_op(
+            "conv3d_transpose",
+            {"Input": [x], "Filter": [self.weight]},
+            dict(self._attrs))
+        if self.bias is not None:
+            out = run_dygraph_op("elementwise_add",
+                                 {"X": [out], "Y": [self.bias]},
+                                 {"axis": 1})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class PRelu(Layer):
+    """Reference: dygraph/nn.py PRelu (mode all/channel/element)."""
+
+    def __init__(self, name_scope=None, mode="all", channel=None,
+                 input_shape=None, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = (1,)
+        elif mode == "channel":
+            shape = (channel,)
+        else:
+            shape = tuple(input_shape)
+        self.weight = self.create_parameter(shape=shape,
+                                            attr=param_attr)
+
+    def forward(self, x):
+        return run_dygraph_op("prelu",
+                              {"X": [x], "Alpha": [self.weight]},
+                              {"mode": self._mode})
+
+
+class NCE(Layer):
+    """Reference: dygraph/nn.py NCE."""
+
+    def __init__(self, name_scope=None, num_total_classes=None, dim=None,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=10, seed=0, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if sample_weight is not None:
+            from ..core.enforce import UnimplementedError
+            raise UnimplementedError(
+                "NCE sample_weight is not supported (the nce op "
+                "weights every example equally); drop the argument "
+                "or weight the returned per-example cost yourself")
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples,
+                       "seed": seed}
+        self.weight = self.create_parameter(
+            shape=(num_total_classes, dim), attr=param_attr)
+        self.bias = self.create_parameter(shape=(num_total_classes,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        return run_dygraph_op(
+            "nce", {"Input": [input], "Weight": [self.weight],
+                    "Bias": [self.bias] if self.bias is not None
+                    else [], "Label": [label]},
+            dict(self._attrs))
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, name_scope=None, size=None, x_dim=None,
+                 y_dim=None, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self.weight = self.create_parameter(shape=(size, x_dim, y_dim),
+                                            attr=param_attr)
+        self.bias = self.create_parameter(shape=(1, size),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x, y):
+        out = run_dygraph_op(
+            "bilinear_tensor_product",
+            {"X": [x], "Y": [y], "Weight": [self.weight],
+             "Bias": [self.bias] if self.bias is not None else []},
+            {})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope=None, channels=None, groups=1,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            shape=(channels,), attr=param_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(shape=(channels,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        y, _mean, _var = run_dygraph_op(
+            "group_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            dict(self._attrs))
+        return y
+
+
+class SpectralNorm(Layer):
+    def __init__(self, name_scope=None, weight_shape=None, dim=0,
+                 power_iters=1, eps=1e-12, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"dim": dim, "power_iters": power_iters,
+                       "eps": eps}
+        h = weight_shape[dim]
+        w_rest = 1
+        for i, d in enumerate(weight_shape):
+            if i != dim:
+                w_rest *= d
+        from .. import initializer as I
+        self.weight_u = self.create_parameter(
+            shape=(h,), default_initializer=I.Normal(0, 1))
+        self.weight_v = self.create_parameter(
+            shape=(w_rest,), default_initializer=I.Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        return run_dygraph_op(
+            "spectral_norm",
+            {"Weight": [weight], "U": [self.weight_u],
+             "V": [self.weight_v]}, dict(self._attrs))
+
+
+class RowConv(Layer):
+    def __init__(self, name_scope=None, input_dim=None,
+                 future_context_size=2, param_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(future_context_size + 1, input_dim),
+            attr=param_attr)
+
+    def forward(self, x):
+        out = run_dygraph_op("row_conv",
+                             {"X": [x], "Filter": [self.weight]}, {})
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class SequenceConv(Layer):
+    def __init__(self, name_scope=None, input_dim=None, num_filters=None,
+                 filter_size=3, param_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self._attrs = {"context_length": filter_size}
+        self.weight = self.create_parameter(
+            shape=(filter_size * input_dim, num_filters),
+            attr=param_attr)
+
+    def forward(self, x, lengths=None):
+        out = run_dygraph_op(
+            "sequence_conv",
+            {"X": [x], "Filter": [self.weight],
+             "Lengths": [lengths] if lengths is not None else []},
+            dict(self._attrs))
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
         return out

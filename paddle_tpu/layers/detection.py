@@ -526,11 +526,9 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
                     param_attr=None, bias_attr=None, name=None):
     """Deformable convolution layer (reference: layers/nn.py
     deformable_conv -> deformable_conv_op.cc)."""
+    from ..core.shape_utils import pair as _pair
     from ..layer_helper import LayerHelper
     helper = LayerHelper("deformable_conv", name=name)
-
-    def _pair(v):
-        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
 
     fsize = _pair(filter_size)
     channels = input.shape[1]

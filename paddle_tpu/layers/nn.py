@@ -973,6 +973,12 @@ def nce(input, label, num_total_classes, sample_weight=None,
         is_sparse=False):
     """Noise-contrastive estimation loss; creates the class weight and
     bias (reference: layers/nn.py nce -> nce_op.cc)."""
+    if sample_weight is not None:
+        from ..core.enforce import UnimplementedError
+        raise UnimplementedError(
+            "NCE sample_weight is not supported (the nce op weights "
+            "every example equally); weight the returned per-example "
+            "cost instead")
     helper = LayerHelper("nce", name=name)
     dim = input.shape[-1]
     w = helper.create_parameter(attr=param_attr,

@@ -284,12 +284,18 @@ class DetectionMAP(MetricBase):
                     continue
                 ious = self._iou(d[2:6], gboxes)
                 j = int(np.argmax(ious))
-                if ious[j] >= self.overlap_threshold and not taken[j]:
-                    taken[j] = True
-                    if self.evaluate_difficult or not gdiff[j]:
+                if ious[j] >= self.overlap_threshold:
+                    if not self.evaluate_difficult and gdiff[j]:
+                        # matches a difficult gt: IGNORED entirely
+                        # (VOC semantics — neither TP nor FP, and the
+                        # difficult gt is never consumed)
+                        continue
+                    if not taken[j]:
+                        taken[j] = True
                         rec.append((float(d[1]), True))
+                    else:  # duplicate on a taken gt: FP
+                        rec.append((float(d[1]), False))
                 else:
-                    # below threshold OR duplicate on a taken gt: FP
                     rec.append((float(d[1]), False))
 
     def _ap(self, scored, n_gt):

@@ -151,7 +151,11 @@ struct Server {
 };
 
 std::mutex g_servers_mu;
-std::unordered_map<int64_t, std::unique_ptr<Server>> g_servers;
+// never-destroyed (static-destruction order): a server leaked past
+// exit would otherwise run ~Server -> ~thread on a joinable thread ->
+// std::terminate during shutdown of the host process
+auto& g_servers =
+    *new std::unordered_map<int64_t, std::unique_ptr<Server>>();
 std::atomic<int64_t> g_next_handle{1};
 
 struct Client {
@@ -160,7 +164,8 @@ struct Client {
 };
 
 std::mutex g_clients_mu;
-std::unordered_map<int64_t, std::unique_ptr<Client>> g_clients;
+auto& g_clients =
+    *new std::unordered_map<int64_t, std::unique_ptr<Client>>();
 
 Server* find_server(int64_t h) {
   std::lock_guard<std::mutex> lk(g_servers_mu);

@@ -1171,3 +1171,64 @@ def psroi_pool(x, rois, rois_batch_idx, *, output_channels,
 
     return lax.map(one_roi, (rois.astype(jnp.float32),
                              rois_batch_idx.astype(jnp.int32)))
+
+
+@register("roi_perspective_transform", ["X", "ROIs", "RoisBatchIdx"],
+          ["Out"], nondiff=("ROIs", "RoisBatchIdx"))
+def roi_perspective_transform(x, rois, rois_batch_idx, *,
+                              transformed_height, transformed_width,
+                              spatial_scale=1.0):
+    """Perspective-warp quadrilateral ROIs to a fixed rectangle
+    (reference: detection/roi_perspective_transform_op.cc, used by
+    OCR-style detectors): rois [R, 8] are quad corners
+    (x1,y1,...,x4,y4) in tl/tr/br/bl order; each quad maps onto a
+    [th, tw] grid through the closed-form unit-square->quad homography
+    (the reference's get_transform_matrix) and the input samples
+    bilinearly. Differentiable through X via the gather autodiff."""
+    from .vision_ops import _bilinear_gather
+    N, C, H, W = x.shape
+    th, tw = transformed_height, transformed_width
+    q = rois.astype(jnp.float32).reshape(-1, 4, 2) * spatial_scale
+    x0, y0 = q[:, 0, 0], q[:, 0, 1]
+    x1, y1 = q[:, 1, 0], q[:, 1, 1]
+    x2, y2 = q[:, 2, 0], q[:, 2, 1]
+    x3, y3 = q[:, 3, 0], q[:, 3, 1]
+    # square->quad projective coefficients (Heckbert's formulation)
+    dx1 = x1 - x2
+    dx2 = x3 - x2
+    dx3 = x0 - x1 + x2 - x3
+    dy1 = y1 - y2
+    dy2 = y3 - y2
+    dy3 = y0 - y1 + y2 - y3
+    den = dx1 * dy2 - dx2 * dy1
+    den = jnp.where(jnp.abs(den) < 1e-9, 1e-9, den)
+    g = (dx3 * dy2 - dx2 * dy3) / den
+    h2 = (dx1 * dy3 - dx3 * dy1) / den
+    a = x1 - x0 + g * x1
+    b = x3 - x0 + h2 * x3
+    c = x0
+    d = y1 - y0 + g * y1
+    e = y3 - y0 + h2 * y3
+    f = y0
+
+    # unit-square grid over the output rectangle
+    u = (jnp.arange(tw, dtype=jnp.float32) + 0.5) / tw
+    v = (jnp.arange(th, dtype=jnp.float32) + 0.5) / th
+    uu, vv = jnp.meshgrid(u, v)                      # [th, tw]
+
+    def one_roi(args):
+        (ai, bi, ci, di, ei, fi, gi, hi, bidx) = args
+        den2 = gi * uu + hi * vv + 1.0
+        # degenerate/misordered quads can cross zero inside the square;
+        # clamp so the sample coords stay finite (they land outside the
+        # image and zero-pad, instead of NaN-poisoning the tile)
+        den2 = jnp.where(jnp.abs(den2) < 1e-6,
+                         jnp.where(den2 < 0, -1e-6, 1e-6), den2)
+        xs = (ai * uu + bi * vv + ci) / den2
+        ys = (di * uu + ei * vv + fi) / den2
+        img = x[jnp.clip(bidx, 0, N - 1)]
+        return _bilinear_gather(img, ys, xs)         # [C, th, tw]
+
+    return lax.map(one_roi,
+                   (a, b, c, d, e, f, g, h2,
+                    rois_batch_idx.astype(jnp.int32)))

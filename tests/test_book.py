@@ -213,3 +213,73 @@ class TestBook:
 
         _train_save_reload(build, feeder, ["words", "lens"], 80,
                            tmp_path, lr=3e-3, loss_ratio=0.6)
+
+    def test_label_semantic_roles(self, tmp_path, rng):
+        """CRF sequence labeling (reference: book/
+        test_label_semantic_roles.py — word features -> emission fc ->
+        linear_chain_crf; inference = crf_decoding). Trains the NLL
+        down, saves the decode program, reloads and reproduces the
+        Viterbi paths."""
+        B, T, D, V = 8, 6, 5, 30
+        true = rng.randint(0, D, (B, T)).astype(np.int64)
+        words = np.where(rng.rand(B, T) < 0.85, true * 6 + 1,
+                         rng.randint(0, V, (B, T))).astype(np.int64)
+        lens = np.full((B, 1), T, np.int64)
+
+        def build():
+            w = layers.data(name="word", shape=[T], dtype="int64")
+            y = layers.data(name="label", shape=[T], dtype="int64")
+            ln = layers.data(name="len", shape=[1], dtype="int64")
+            emb = layers.embedding(w, size=[V, 16])
+            emission = layers.fc(emb, size=D, num_flatten_dims=2)
+            ll = layers.linear_chain_crf(emission, y, length=ln)
+            loss = layers.mean(0.0 - ll)
+            transition = [v for v in
+                          fluid.default_main_program().global_block()
+                          .vars.values()
+                          if "linear_chain_crf" in v.name
+                          and v.persistable][0]
+            path = layers.crf_decoding(emission, transition, length=ln)
+            return loss, path
+
+        def feeder(step):
+            return {"word": words, "label": true, "len": lens}
+
+        _train_save_reload(build, feeder, ["word", "len"], 60,
+                           tmp_path, lr=0.05, loss_ratio=0.6)
+
+    def test_ocr_ctc(self, tmp_path, rng):
+        """CTC recognition pipeline (the reference exercises warpctc in
+        unittests; the book-style contract here: conv features ->
+        per-frame logits -> warpctc trains, greedy decode ships in the
+        inference model)."""
+        B, T, C = 4, 8, 5
+        labs = np.stack([rng.permutation(np.arange(1, C))[:3]
+                         for _ in range(B)]).astype(np.int64)
+        imgs = rng.rand(B, 1, 8, T * 4).astype(np.float32)
+        ilen = np.full((B, 1), T, np.int64)
+        llen = np.full((B, 1), 3, np.int64)
+
+        def build():
+            img = layers.data(name="img", shape=[1, 8, T * 4],
+                              dtype="float32")
+            il = layers.data(name="ilen", shape=[1], dtype="int64")
+            lab = layers.data(name="lab", shape=[3], dtype="int64")
+            ll = layers.data(name="llen", shape=[1], dtype="int64")
+            conv = layers.conv2d(img, num_filters=8, filter_size=3,
+                                 padding=1, act="relu")
+            seq = layers.im2sequence(conv, filter_size=(8, 4),
+                                     stride=(8, 4))
+            logits = layers.fc(seq, size=C, num_flatten_dims=2)
+            loss = layers.mean(layers.warpctc(
+                logits, lab, input_length=il, label_length=ll))
+            decoded, _dlen = layers.ctc_greedy_decoder(
+                logits, blank=0, input_length=il)
+            return loss, decoded
+
+        def feeder(step):
+            return {"img": imgs, "ilen": ilen, "lab": labs,
+                    "llen": llen}
+
+        _train_save_reload(build, feeder, ["img", "ilen"], 150,
+                           tmp_path, lr=0.02, loss_ratio=0.5)

@@ -262,3 +262,64 @@ def test_inference_tape_entries_reclaimed():
         out.backward()  # seeds ones_like(out)
         for p in layer.parameters():
             assert p.grad is not None, "grad cut through eval layer"
+
+
+class TestNewDygraphLayers:
+    def test_layer_classes_forward_and_train(self, rng):
+        """The round's dygraph layer-class batch (reference
+        dygraph/nn.py parity): each builds, forwards, and NCE trains."""
+        import paddle_tpu.dygraph as dg
+        from paddle_tpu.dygraph import nn as dnn
+        with dg.guard():
+            x4 = dg.to_variable(rng.rand(2, 3, 8, 8).astype(np.float32))
+            for layer, args in [
+                (dnn.Conv2DTranspose("ct", num_channels=3,
+                                     num_filters=4, filter_size=3),
+                 (x4,)),
+                (dnn.PRelu("pr", mode="channel", channel=3), (x4,)),
+                (dnn.GroupNorm("gn", channels=3, groups=3), (x4,)),
+            ]:
+                out = layer(*args)
+                assert np.isfinite(np.asarray(out.numpy())).all()
+            x5 = dg.to_variable(
+                rng.rand(1, 2, 4, 4, 4).astype(np.float32))
+            c3 = dnn.Conv3D("c3", num_channels=2, num_filters=3,
+                            filter_size=3)
+            assert c3(x5).numpy().shape == (1, 3, 2, 2, 2)
+            bt = dnn.BilinearTensorProduct("bt", size=5, x_dim=3,
+                                           y_dim=4)
+            xb = dg.to_variable(rng.rand(2, 3).astype(np.float32))
+            yb = dg.to_variable(rng.rand(2, 4).astype(np.float32))
+            assert bt(xb, yb).numpy().shape == (2, 5)
+            sn = dnn.SpectralNorm("sn", weight_shape=(4, 6))
+            w = dg.to_variable(rng.rand(4, 6).astype(np.float32))
+            wn = sn(w).numpy()
+            # spectral norm of the result ~ 1
+            assert abs(np.linalg.norm(wn, 2) - 1.0) < 0.2
+            rc = dnn.RowConv("rc", input_dim=5, future_context_size=2)
+            xr = dg.to_variable(rng.rand(2, 6, 5).astype(np.float32))
+            assert rc(xr).numpy().shape == (2, 6, 5)
+            sc = dnn.SequenceConv("sc", input_dim=5, num_filters=7)
+            assert sc(xr).numpy().shape == (2, 6, 7)
+
+    def test_nce_layer_trains(self, rng):
+        import paddle_tpu as fluid
+        import paddle_tpu.dygraph as dg
+        from paddle_tpu.dygraph import nn as dnn
+        with dg.guard():
+            nce = dnn.NCE("nce", num_total_classes=20, dim=8,
+                          num_neg_samples=5)
+            opt = fluid.optimizer.AdamOptimizer(0.05)
+            x = rng.rand(16, 8).astype(np.float32)
+            y = rng.randint(0, 20, (16, 1)).astype(np.int64)
+            vals = []
+            for _ in range(30):
+                cost = nce(dg.to_variable(x), dg.to_variable(y))
+                from paddle_tpu.dygraph.base import run_dygraph_op
+                loss = run_dygraph_op("mean", {"X": [cost]}, {})
+                loss.backward()
+                opt.minimize(loss,
+                             parameter_list=nce.parameters())
+                nce.clear_gradients()
+                vals.append(float(loss.numpy().reshape(-1)[0]))
+            assert vals[-1] < vals[0] * 0.8, (vals[0], vals[-1])

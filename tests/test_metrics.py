@@ -139,3 +139,15 @@ class TestDetectionMAP:
         m.update([[1, 0.9, 0, 0, 10, 10]],
                  [[0, 0, 10, 10], [20, 20, 30, 30]], [1, 2])
         assert abs(m.eval() - 0.5) < 1e-6
+
+    def test_difficult_gt_duplicates_ignored(self):
+        """evaluate_difficult=False: EVERY detection matching a
+        difficult gt is ignored (VOC), including duplicates."""
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP(evaluate_difficult=False)
+        m.update([[1, 0.9, 0, 0, 10, 10],
+                  [1, 0.8, 0, 0, 10, 10],      # duplicate on difficult
+                  [1, 0.7, 20, 20, 30, 30]],   # TP on the normal gt
+                 [[0, 0, 10, 10], [20, 20, 30, 30]], [1, 1],
+                 difficult=[True, False])
+        assert abs(m.eval() - 1.0) < 1e-6

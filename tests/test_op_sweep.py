@@ -1062,6 +1062,16 @@ spec("deformable_conv",
      grad=["Offset", "Mask"], max_rel=0.02)
 
 
+spec("roi_perspective_transform",
+     {"X": sgn((1, 2, 8, 8), 302),
+      "ROIs": np.array([[1, 1, 5, 1, 5, 5, 1, 5],
+                        [0, 0, 7, 1, 6, 6, 1, 7]], np.float32),
+      "RoisBatchIdx": np.array([0, 0], np.int32)},
+     {"transformed_height": 4, "transformed_width": 4,
+      "spatial_scale": 1.0},
+     grad=["X"], max_rel=0.02)
+
+
 EXEMPT = {
     "print": "test_misc_parity.py (host callback, pass-through)",
     "nce": "test_new_ops.py (rng-sampled negatives)",
