@@ -1282,3 +1282,33 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
                             "strides": _pair(stride),
                             "paddings": tuple(pad)})
     return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a user Python callable as an op (reference: layers/nn.py
+    py_func -> py_func_op.cc). ``out`` vars must be pre-created with
+    shapes/dtypes (create_variable); ``backward_func(*inputs,
+    *outputs, *output_grads)`` returns input grads (None entries for
+    non-differentiable inputs). Under jit the call lowers to a host
+    callback (jax.pure_callback)."""
+    if skip_vars_in_backward_input is not None:
+        from ..core.enforce import UnimplementedError
+        raise UnimplementedError(
+            "py_func skip_vars_in_backward_input is not supported: "
+            "backward_func always receives (*inputs, *outputs, "
+            "*output_grads) positionally — drop unused parameters in "
+            "the callable instead")
+    from ..ops.py_func_op import register_py_func
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fid = register_py_func(func, backward_func)
+    helper.append_op(
+        type="py_func", inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"func_id": fid,
+               "out_shapes": tuple(tuple(int(d) for d in o.shape)
+                                   for o in outs),
+               "out_dtypes": tuple(o.dtype for o in outs)})
+    return out

@@ -1,0 +1,130 @@
+"""py_func: user-defined Python callables as first-class ops.
+
+Reference: operators/py_func_op.cc + layers/nn.py py_func — arbitrary
+Python runs inside the graph, with an optional Python backward.
+
+TPU-native: the op lowers to ``jax.pure_callback`` — the compiled XLA
+program ships the operands to the host, runs the callable, and
+continues on device (the callback is the TPU analog of the reference's
+"call back into the interpreter from the executor loop"). When a
+``backward_func`` is registered the op wraps in ``jax.custom_vjp``
+whose backward is a second callback:
+
+    backward_func(*inputs, *outputs, *output_grads) -> input grads
+    (positional; return one array per DIFFERENTIABLE input, or None
+    for no gradient)
+
+Callables are process-local (kept in a registry keyed by the op's
+``func_id`` attr), so a serialized program carries the id but needs
+re-registration on load — same restriction as the reference, whose
+PyFuncRegistry also lives in the process.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+_PY_FUNCS: List[dict] = []
+
+
+def register_py_func(func: Callable,
+                     backward_func: Optional[Callable] = None) -> int:
+    """Park the callables; returns the func_id the op attr carries
+    (reference: PyFuncRegistry::Register). Entries live as long as the
+    process (exactly the reference's PyFuncRegistry) — rebuilding
+    programs in a loop accretes entries, so long-lived drivers should
+    build once or call clear_py_funcs() between generations."""
+    _PY_FUNCS.append({"fwd": func, "bwd": backward_func})
+    return len(_PY_FUNCS) - 1
+
+
+def clear_py_funcs():
+    """Drop every registered callable (test isolation; invalidates
+    func_ids of existing programs)."""
+    _PY_FUNCS.clear()
+
+
+def _specs(shapes, dtypes):
+    return [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+            for s, d in zip(shapes, dtypes)]
+
+
+@register("py_func", ["X*"], ["Out*"])
+def py_func(xs, *, func_id, out_shapes, out_dtypes):
+    entry = _PY_FUNCS[func_id]
+    fwd = entry["fwd"]
+    bwd = entry["bwd"]
+    # A LEADING -1 (batch) dim in a declared out shape binds to the
+    # first input's leading dim at trace time (callbacks need static
+    # shapes); -1 anywhere else has no trace-time value to bind
+    lead = xs[0].shape[0] if xs else 1
+    resolved = []
+    for shape in out_shapes:
+        if any(d == -1 for d in shape[1:]):
+            raise ValueError(
+                "py_func out var declares -1 in a non-leading dim %s "
+                "— callbacks need static shapes; declare the real "
+                "size" % (tuple(shape),))
+        resolved.append(tuple(lead if d == -1 else d for d in shape))
+    out_shapes = resolved
+    out_specs = _specs(out_shapes, out_dtypes)
+
+    def host_fwd(*vals):
+        outs = fwd(*vals)
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        if len(outs) != len(out_dtypes):
+            raise ValueError(
+                "py_func callable returned %d outputs but %d out "
+                "vars were declared" % (len(outs), len(out_dtypes)))
+        return tuple(np.asarray(o, np.dtype(d))
+                     for o, d in zip(outs, out_dtypes))
+
+    def call_fwd(*args):
+        res = jax.pure_callback(host_fwd, tuple(out_specs), *args)
+        return tuple(res)
+
+    if bwd is None:
+        # no backward registered: gradients do not flow (the reference
+        # marks such py_funcs non-differentiable too)
+        def call_nograd(*args):
+            return call_fwd(*jax.tree_util.tree_map(
+                jax.lax.stop_gradient, args))
+        return list(call_nograd(*xs))
+
+    @jax.custom_vjp
+    def f(*args):
+        return call_fwd(*args)
+
+    def f_fwd(*args):
+        outs = call_fwd(*args)
+        return outs, (args, outs)
+
+    def f_bwd(res, gouts):
+        args, outs = res
+        in_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in args]
+
+        def host_bwd(*vals):
+            n = len(args)
+            m = len(outs)
+            grads = bwd(*vals[:n], *vals[n:n + m], *vals[n + m:])
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            return tuple(
+                np.zeros(s.shape, s.dtype) if g is None
+                else np.asarray(g, s.dtype)
+                for g, s in zip(grads, in_specs))
+
+        gin = jax.pure_callback(host_bwd, tuple(in_specs),
+                                *args, *outs, *gouts)
+        return tuple(gin)
+
+    f.defvjp(f_fwd, f_bwd)
+    return list(f(*xs))

@@ -85,9 +85,61 @@ def run_fleet(n_steps):
     return out
 
 
+def _ps_fleet():
+    import paddle_tpu as fluid  # noqa: F401
+    from paddle_tpu.incubate.fleet.base import role_maker
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        ParameterServerFleet)
+    f = ParameterServerFleet()
+    f.init(role_maker.PaddleCloudRoleMaker(is_collective=False))
+    return f
+
+
+def run_pserver():
+    """PS server process: build the same model, split the optimize
+    ops, serve until the trainer COMPLETEs (the reference's
+    exe.run(pserver_program) process)."""
+    import paddle_tpu as fluid
+    f = _ps_fleet()
+    main, startup, loss = build_model()
+    with fluid.program_guard(main, startup):
+        opt = f.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+    f.init_server()
+    print("SERVER_READY", flush=True)
+    f.run_server()
+    print("SERVER_DONE", flush=True)
+
+
+def run_ps_trainer(n_steps):
+    import paddle_tpu as fluid
+    f = _ps_fleet()
+    main, startup, loss = build_model()
+    with fluid.program_guard(main, startup):
+        opt = f.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    f.init_worker()
+    out = []
+    for x, y in batches(n_steps):
+        (lv,) = exe.run(f.main_program, feed={"x": x, "y": y},
+                        fetch_list=[loss])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    f.stop_worker()
+    return out
+
+
 if __name__ == "__main__":
     mode = sys.argv[1]
+    if mode == "pserver":
+        run_pserver()
+        sys.exit(0)
     n_steps = int(sys.argv[2])
-    losses = run_local(n_steps) if mode == "local" \
-        else run_fleet(n_steps)
+    if mode == "local":
+        losses = run_local(n_steps)
+    elif mode == "ps_trainer":
+        losses = run_ps_trainer(n_steps)
+    else:
+        losses = run_fleet(n_steps)
     print("LOSSES:" + json.dumps(losses))

@@ -247,3 +247,63 @@ class TestSelectedRowsUtilOps:
         x = np.ones((2, 2), np.float32)
         np.testing.assert_allclose(
             np.asarray(get_tensor_from_selected_rows(x)), x)
+
+
+class TestPyFunc:
+    def test_forward_and_custom_backward(self, rng):
+        """py_func with a numpy forward + a Python backward trains
+        through the callback (reference: test_py_func_op.py)."""
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+
+        def np_tanh(a):
+            return np.tanh(a)
+
+        def np_tanh_grad(a, out, dout):
+            return dout * (1.0 - out * out)
+
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            h = layers.fc(x, size=4)
+            o = main.global_block().create_var(
+                name="pyfunc_out", shape=(-1, 4), dtype="float32")
+            layers.py_func(np_tanh, h, o,
+                           backward_func=np_tanh_grad)
+            loss = layers.mean(layers.square(o))
+            fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": rng.rand(3, 4).astype(np.float32)}
+        vals = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0])
+                      .reshape(-1)[0]) for _ in range(15)]
+        assert np.isfinite(vals).all()
+        assert vals[-1] < vals[0] * 0.5, (vals[0], vals[-1])
+
+    def test_forward_values_exact(self, rng):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            o = main.global_block().create_var(
+                name="pyfunc_exact", shape=(-1, 4), dtype="float32")
+            layers.py_func(lambda a: np.tanh(a), x, o)
+        exe = fluid.Executor()
+        feed = {"x": rng.rand(3, 4).astype(np.float32)}
+        (ov,) = exe.run(main, feed=feed, fetch_list=[o])
+        np.testing.assert_allclose(ov, np.tanh(feed["x"]), rtol=1e-6)
+
+    def test_no_backward_blocks_grad(self, rng):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            h = layers.fc(x, size=4)
+            o = main.global_block().create_var(
+                name="pyfunc_out2", shape=(-1, 4), dtype="float32")
+            layers.py_func(lambda a: a * 2.0, h, o)
+            loss = layers.mean(o)
+        exe = fluid.Executor()
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={
+            "x": rng.rand(2, 4).astype(np.float32)},
+            fetch_list=[loss])
+        assert np.isfinite(lv).all()

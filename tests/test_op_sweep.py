@@ -1078,6 +1078,7 @@ EXEMPT = {
     "sampling_id": "test_new_ops.py (rng draw, distribution check)",
     "sample_logits": "test_new_ops.py (rng-sampled classes)",
     "random_crop": "test_new_ops.py (rng offsets)",
+    "py_func": "test_new_ops.py (host callback + custom backward)",
     "merge_selected_rows": "test_new_ops.py (SparseRows roundtrip)",
     "get_tensor_from_selected_rows":
         "test_new_ops.py (SparseRows roundtrip)",
