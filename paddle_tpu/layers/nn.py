@@ -310,10 +310,11 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                                     dtype=dtype, is_bias=True)
         inputs["Bias"] = [b]
     y = helper.create_variable_for_type_inference(dtype)
-    mean = helper.create_variable_for_type_inference(dtype,
-                                                     stop_gradient=True)
-    var = helper.create_variable_for_type_inference(dtype,
-                                                    stop_gradient=True)
+    # the op emits statistics in f32 regardless of input dtype
+    mean = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    var = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
     helper.append_op(type="layer_norm", inputs=inputs,
                      outputs={"Y": [y], "Mean": [mean],
                               "Variance": [var]},

@@ -374,18 +374,24 @@ def batch_norm(x, scale, bias, mean, var, *, epsilon=1e-5, momentum=0.9,
 @register("layer_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"])
 def layer_norm(x, scale, bias, *, epsilon=1e-5, begin_norm_axis=1):
     """Reference: layer_norm_op.cc. Normalizes over dims
-    [begin_norm_axis:]; pallas variant registered in ops/pallas."""
+    [begin_norm_axis:]; pallas variant registered in ops/pallas.
+
+    Statistics in f32 regardless of input dtype (bf16 moment sums lose
+    precision), output back in the INPUT dtype — under AMP this keeps
+    the bf16 stream flowing instead of shipping f32 activations to the
+    next matmul's cast (the same policy as batch_norm)."""
     axes = tuple(range(begin_norm_axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
     inv = lax.rsqrt(var + epsilon)
-    norm = (x - mean) * inv
+    norm = (xf - mean) * inv
     bshape = [1] * begin_norm_axis + list(x.shape[begin_norm_axis:])
     if scale is not None:
         norm = norm * scale.reshape(bshape)
     if bias is not None:
         norm = norm + bias.reshape(bshape)
-    return norm, jnp.squeeze(mean), jnp.squeeze(var)
+    return norm.astype(x.dtype), jnp.squeeze(mean), jnp.squeeze(var)
 
 
 @register("group_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"])

@@ -18,6 +18,13 @@ Callables are process-local (kept in a registry keyed by the op's
 ``func_id`` attr), so a serialized program carries the id but needs
 re-registration on load — same restriction as the reference, whose
 PyFuncRegistry also lives in the process.
+
+Cost note: in a TRAINING program the forward callable runs twice per
+step — the executor's generic vjp machinery re-enters every forward
+lowering under jax.vjp and XLA cannot CSE host callbacks the way it
+CSEs device ops (the design trade documented in executor.py; the
+reference instead saves outputs op-side). Keep py_func forwards cheap
+in training graphs, or wrap only the inference-side computation.
 """
 
 from __future__ import annotations

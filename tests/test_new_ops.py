@@ -293,6 +293,10 @@ class TestPyFunc:
         np.testing.assert_allclose(ov, np.tanh(feed["x"]), rtol=1e-6)
 
     def test_no_backward_blocks_grad(self, rng):
+        """bwd=None: the op stops gradients (pure_callback has no JVP
+        rule, so an un-stopped input would raise at minimize time) and
+        the fc upstream simply receives zero grad — training still
+        runs."""
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
             x = layers.data(name="x", shape=[4], dtype="float32")
@@ -301,9 +305,14 @@ class TestPyFunc:
                 name="pyfunc_out2", shape=(-1, 4), dtype="float32")
             layers.py_func(lambda a: a * 2.0, h, o)
             loss = layers.mean(o)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
         exe = fluid.Executor()
         exe.run(startup)
-        (lv,) = exe.run(main, feed={
-            "x": rng.rand(2, 4).astype(np.float32)},
-            fetch_list=[loss])
+        w0 = np.asarray(fluid.global_scope().find_var("fc_0.w_0"))
+        feed = {"x": rng.rand(2, 4).astype(np.float32)}
+        for _ in range(3):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
         assert np.isfinite(lv).all()
+        w1 = np.asarray(fluid.global_scope().find_var("fc_0.w_0"))
+        # gradients were BLOCKED: params must be untouched
+        np.testing.assert_array_equal(w0, w1)
