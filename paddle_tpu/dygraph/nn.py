@@ -16,7 +16,7 @@ __all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm",
            "Embedding", "LayerNorm", "GRUUnit", "Dropout",
            "Conv2DTranspose", "Conv3D", "Conv3DTranspose", "PRelu",
            "NCE", "BilinearTensorProduct", "GroupNorm",
-           "SpectralNorm", "RowConv", "SequenceConv"]
+           "SpectralNorm", "RowConv", "SequenceConv", "TreeConv"]
 
 
 class Conv2D(Layer):
@@ -488,6 +488,36 @@ class SequenceConv(Layer):
             {"X": [x], "Filter": [self.weight],
              "Lengths": [lengths] if lengths is not None else []},
             dict(self._attrs))
+        if self._act:
+            out = run_dygraph_op(self._act, {"X": [out]}, {})
+        return out
+
+
+class TreeConv(Layer):
+    """Reference: dygraph/nn.py TreeConv (TBCNN)."""
+
+    def __init__(self, name_scope=None, feature_size=None,
+                 output_size=None, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"max_depth": max_depth}
+        self._act = act
+        self.weight = self.create_parameter(
+            shape=(feature_size, 3, output_size, num_filters),
+            attr=param_attr)
+        self.bias = self.create_parameter(
+            shape=(1, 1, output_size, num_filters), attr=bias_attr,
+            is_bias=True)
+
+    def forward(self, nodes_vector, edge_set):
+        out = run_dygraph_op(
+            "tree_conv",
+            {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+             "Filter": [self.weight]}, dict(self._attrs))
+        if self.bias is not None:
+            out = run_dygraph_op("elementwise_add",
+                                 {"X": [out], "Y": [self.bias]}, {})
         if self._act:
             out = run_dygraph_op(self._act, {"X": [out]}, {})
         return out

@@ -1313,3 +1313,30 @@ def py_func(func, x, out, backward_func=None,
                                    for o in outs),
                "out_dtypes": tuple(o.dtype for o in outs)})
     return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None,
+              bias_attr=None, name=None):
+    """Tree-based convolution (reference: layers/nn.py tree_conv ->
+    tree_conv_op.cc). nodes_vector [B, N, F], edge_set [B, E, 2]."""
+    helper = LayerHelper("tree_conv", name=name)
+    F = nodes_vector.shape[-1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=(F, 3, output_size, num_filters),
+        dtype=nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(
+        nodes_vector.dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]}, attrs={"max_depth": max_depth})
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr, shape=(1, 1, output_size, num_filters),
+            dtype=nodes_vector.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=-1)
+    if act:
+        out = _simple(act, out)
+    return out

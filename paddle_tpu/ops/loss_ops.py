@@ -481,3 +481,83 @@ def bilinear_tensor_product(x, y, weight, bias):
     if bias is not None:
         out = out + bias.reshape(1, -1)
     return out
+
+
+def _tree_eta_np(edges, n_nodes, max_depth):
+    """Host-side tree2col coefficients (reference: math/tree2col.cc
+    construct_tree/construct_patch + the eta formulas of tree2col.h).
+    edges [E, 2] int, 1-based, (0,0)-terminated; returns
+    eta [n_nodes, n_nodes, 3] with coefficient order (l, r, t)."""
+    import numpy as _np
+    adj = [[] for _ in range(n_nodes + 2)]
+    # node_count derives from the edge list (reference construct_tree:
+    # #real edges + 1); PADDING rows beyond it must stay zero — they
+    # are not tree nodes, and giving them self-patches would leak
+    # activations/gradients into padding embeddings
+    node_count = 1
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break
+        node_count += 1
+        if u <= n_nodes and v <= n_nodes:
+            adj[u].append(v)
+    node_count = min(node_count, n_nodes)
+    eta = _np.zeros((n_nodes, n_nodes, 3), _np.float32)
+    md = float(max_depth)
+    for root in range(1, node_count + 1):
+        # iterative DFS matching the reference's stack discipline
+        patch = [(root, 1, 1, 0)]
+        visited = {root}
+        stack = [(root, 0)]
+        while stack:
+            node, depth = stack[-1]
+            sz = len(adj[node])
+            advanced = False
+            for i, v in enumerate(adj[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, depth + 1))
+                    patch.append((v, i + 1, sz, depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        for (v, index, pclen, depth) in patch:
+            eta_t = (md - depth) / md
+            temp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * temp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            eta[root - 1, v - 1, 0] += eta_l
+            eta[root - 1, v - 1, 1] += eta_r
+            eta[root - 1, v - 1, 2] += eta_t
+    return eta
+
+
+@register("tree_conv", ["NodesVector", "EdgeSet", "Filter"], ["Out"],
+          nondiff=("EdgeSet",))
+def tree_conv(nodes, edges, filt, *, max_depth):
+    """Tree-based convolution (TBCNN — reference: tree_conv_op.cc over
+    math/tree2col): nodes [B, N, F], edges [B, E, 2] (1-based,
+    0-terminated), filter [F, 3, O, K] -> out [B, N, O, K].
+
+    TPU split: the data-dependent tree patches become a host-computed
+    coefficient tensor eta[B, N, N, 3] (a pure function of the INT
+    edge data — jax.pure_callback, no gradients needed), and ALL the
+    FLOPs run as two einsums on the MXU; autodiff through the einsums
+    replaces the hand-written col2tree backward."""
+    B, N, F = nodes.shape
+
+    def host(e):
+        import numpy as _np
+        return _np.stack([
+            _tree_eta_np(_np.asarray(e[b]).reshape(-1, 2), N,
+                         max_depth)
+            for b in range(e.shape[0])])
+
+    eta = jax.pure_callback(
+        host, jax.ShapeDtypeStruct((B, N, N, 3), jnp.float32),
+        lax.stop_gradient(edges))
+    patch = jnp.einsum("buvc,bvf->bufc", eta,
+                       nodes.astype(jnp.float32))
+    return jnp.einsum("bufc,fcok->buok", patch,
+                      filt.astype(jnp.float32)).astype(nodes.dtype)

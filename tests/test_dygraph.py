@@ -323,3 +323,42 @@ class TestNewDygraphLayers:
                 nce.clear_gradients()
                 vals.append(float(loss.numpy().reshape(-1)[0]))
             assert vals[-1] < vals[0] * 0.8, (vals[0], vals[-1])
+
+
+class TestTreeConv:
+    def test_layer_and_dygraph(self, rng):
+        import paddle_tpu as fluid
+        import paddle_tpu.dygraph as dg
+        from paddle_tpu import layers
+        from paddle_tpu.dygraph import nn as dnn
+        edges_np = np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]],
+                            np.int32)
+        nodes_np = rng.rand(1, 5, 3).astype(np.float32)
+        # static layer: trains, padding node's grad-free row stays 0
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            nv = layers.data(name="nv", shape=[5, 3],
+                             dtype="float32")
+            es = layers.data(name="es", shape=[4, 2], dtype="int32")
+            out = layers.tree_conv(nv, es, output_size=2,
+                                   num_filters=2, bias_attr=False,
+                                   act=None)
+            loss = layers.mean(layers.square(out))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        (ov, lv) = exe.run(main, feed={"nv": nodes_np,
+                                       "es": edges_np},
+                           fetch_list=[out, loss])
+        assert ov.shape == (1, 5, 2, 2)
+        np.testing.assert_allclose(ov[0, 4], 0.0, atol=1e-7)
+        assert np.isfinite(lv).all()
+        # dygraph class with bias + act
+        with dg.guard():
+            tc = dnn.TreeConv("tc", feature_size=3, output_size=2,
+                              num_filters=2)
+            o = tc(dg.to_variable(nodes_np),
+                   dg.to_variable(edges_np))
+            assert o.numpy().shape == (1, 5, 2, 2)
+            assert np.isfinite(o.numpy()).all()

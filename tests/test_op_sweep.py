@@ -1072,6 +1072,40 @@ spec("roi_perspective_transform",
      grad=["X"], max_rel=0.02)
 
 
+def _tree_conv_ref(ins, max_depth=2):
+    """INDEPENDENT hand-derived eta for the fixture tree
+    1->(2,3), 2->4 with max_depth=2 (reference tree2col.h formulas):
+    each root's patch = root(depth 0) + children(depth 1);
+    eta_t(d)= (2-d)/2; child i of sz sibs: temp=(i-1)/(sz-1) or 0.5.
+    Node 5 (N > node_count) is PADDING: its row must be all zero."""
+    nodes, filt = ins["NodesVector"], ins["Filter"]
+    B, N, F = nodes.shape
+    eta = np.zeros((1, N, N, 3), np.float32)
+    # roots' self-entries: depth 0 -> (l, r, t) = (0, 0, 1)
+    for u in range(4):
+        eta[0, u, u] = (0.0, 0.0, 1.0)
+    # root 1: children 2 (index 1 of 2) and 3 (index 2 of 2), depth 1
+    # eta_t=.5; note eta_r=(1-eta_t)*(1-eta_l) uses the FULL eta_l:
+    # node 2: temp 0 -> l=0,   r=.5*(1-0)=.5
+    # node 3: temp 1 -> l=.5,  r=.5*(1-.5)=.25
+    eta[0, 0, 1] = (0.0, 0.5, 0.5)
+    eta[0, 0, 2] = (0.5, 0.25, 0.5)
+    # root 2: child 4 (index 1 of 1): temp=.5 -> l=(1-.5)*.5=.25,
+    # r=(1-eta_t)*(1-eta_l)=(.5)*(1-.25)=.375
+    eta[0, 1, 3] = (0.25, 0.375, 0.5)
+    patch = np.einsum("buvc,bvf->bufc", eta, nodes)
+    return [np.einsum("bufc,fcok->buok", patch, filt)]
+
+
+spec("tree_conv",
+     {"NodesVector": sgn((1, 5, 3), 303),  # node 5 = padding
+      "EdgeSet": np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]],
+                          np.int32),
+      "Filter": sgn((3, 3, 2, 2), 304)},
+     {"max_depth": 2}, ref=_tree_conv_ref,
+     grad=["NodesVector", "Filter"], max_rel=0.02)
+
+
 EXEMPT = {
     "print": "test_misc_parity.py (host callback, pass-through)",
     "nce": "test_new_ops.py (rng-sampled negatives)",
