@@ -143,7 +143,8 @@ def transformer_flops_per_step(cfg, batch):
     return 3.0 * fwd * batch
 
 
-def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10):
+def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
+                      compare_libs=True):
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as amp
     from paddle_tpu.models import transformer as T
@@ -167,10 +168,10 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10):
     tokens_per_step = float(feed["tgt_mask"].sum())
     feed = _device_feed(feed)
 
-    sps = _best_library(
-        lambda: exe.run(main, feed=feed, fetch_list=[avg_cost],
-                        return_numpy=False),
-        warmup, iters)
+    run = lambda: exe.run(main, feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False)
+    sps = (_best_library(run, warmup, iters) if compare_libs
+           else _timed_loop(run, warmup, iters))
     return {
         "metric": "transformer_base_train_throughput",
         "value": round(tokens_per_step * sps, 1),
@@ -318,44 +319,121 @@ def bench_deepfm(batch=4096, warmup=3, iters=20):
             "mfu": None}
 
 
-def main():
+def _claim_device_with_retry():
+    """Initialize the JAX backend, retrying with backoff.
+
+    Round 2 lost its entire perf record because one transient tunnel
+    failure ("Unable to initialize backend 'axon': UNAVAILABLE") became
+    an uncaught traceback and the driver captured rc=1/parsed=null. A
+    bench harness must degrade, not die: retry inside the soft budget,
+    and let the caller emit the JSON line with an error field if the
+    backend never comes up."""
     import jax
-    # TPU-native PRNG: the rbg generator keeps dropout-mask generation
-    # on the vector unit instead of threefry's scalar-heavy hashing —
-    # measured +33% step throughput on transformer-base (0.247 -> 0.329
-    # MFU on v5e). Semantics are unchanged (different stream, still
-    # deterministic per seed).
-    jax.config.update("jax_default_prng_impl", "rbg")
-    # persistent compile cache: a prior bench run (same binary, same
-    # device) makes later runs skip the multi-minute cold compiles
+    delay, last = 5.0, None
+    while True:
+        try:
+            dev = jax.devices()[0]
+            _log("device: %s" % dev.device_kind)
+            return None
+        except Exception as e:  # RuntimeError: backend init failed
+            last = e
+            _log("backend init failed: %r" % e)
+        # leave at least half the budget for the actual measurement
+        if time.time() - _T0 + delay > _BUDGET_S / 2:
+            return last
+        _log("retrying device claim in %.0fs" % delay)
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
+
+
+def _smoke_overrides():
+    """--backend cpu: shrink the headline config so the harness itself
+    is testable in CI without a chip (and without minute-long CPU
+    compiles). The metric line still parses identically."""
+    return dict(batch=4, seq_len=32, warmup=1, iters=2,
+                compare_libs=False)
+
+
+def main():
+    # value stays null unless a measurement actually completed, so a
+    # degraded run can never be mistaken for a measured 0 tokens/sec
+    headline = {"metric": "transformer_base_train_throughput",
+                "value": None, "unit": "tokens/sec/chip",
+                "vs_baseline": None, "mfu": None}
+    smoke = False
     try:
-        cache_dir = os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          5.0)
-    except Exception as e:
-        _log("compile cache unavailable: %r" % e)
-    _log("claiming device...")
-    _log("device: %s" % jax.devices()[0].device_kind)
-    res = bench_transformer()
-    mfu = res["mfu"]
+        backend = None
+        if "--backend" in sys.argv:
+            i = sys.argv.index("--backend") + 1
+            if i >= len(sys.argv):
+                raise SystemExit("--backend requires a value")
+            backend = sys.argv[i]
+            os.environ["JAX_PLATFORMS"] = backend
+            smoke = backend == "cpu"
+        import jax
+        if backend is not None:
+            # under the axon sitecustomize jax is already imported at
+            # interpreter startup and latched JAX_PLATFORMS; the config
+            # update still takes effect because no backend has been
+            # initialized yet in this process
+            jax.config.update("jax_platforms", backend)
+        # TPU-native PRNG: the rbg generator keeps dropout-mask
+        # generation on the vector unit instead of threefry's
+        # scalar-heavy hashing — measured +33% step throughput on
+        # transformer-base (0.247 -> 0.329 MFU on v5e). Semantics are
+        # unchanged (different stream, still deterministic per seed).
+        jax.config.update("jax_default_prng_impl", "rbg")
+        # persistent compile cache: a prior bench run (same binary,
+        # same device) makes later runs skip multi-minute cold compiles
+        try:
+            cache_dir = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), ".jax_cache")
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 5.0)
+        except Exception as e:
+            _log("compile cache unavailable: %r" % e)
+        _log("claiming device...")
+        err = _claim_device_with_retry()
+        if err is not None:
+            headline["error"] = "backend unavailable: %r" % err
+            print(json.dumps(headline), flush=True)
+            return
+        # One transient mid-run failure (tunnel hiccup, remote compile
+        # 500) gets one fresh attempt before we report a degraded line.
+        kw = _smoke_overrides() if smoke else {}
+        for attempt in (1, 2):
+            try:
+                res = bench_transformer(**kw)
+                headline.update(res)
+                headline.pop("error", None)
+                break
+            except Exception as e:
+                _log("headline attempt %d failed: %r" % (attempt, e))
+                headline["error"] = repr(e)
+                if _over_budget():
+                    break
+                time.sleep(10)
+    except BaseException as e:  # never die without the JSON line
+        headline["error"] = repr(e)
+    mfu = headline.get("mfu")
     # north star: >=0.40 MFU (>=0.8x A100-class); measured ratio, not a
     # placeholder. Unknown device (CPU smoke runs) -> null.
-    res["vs_baseline"] = (round(mfu / 0.40, 3) if mfu is not None
-                          else None)
-    print(json.dumps(res))
+    headline["vs_baseline"] = (round(mfu / 0.40, 3) if mfu is not None
+                               else None)
+    print(json.dumps(headline), flush=True)
     if "--all" in sys.argv:
-        for fn in (bench_mnist_mlp, bench_resnet50, bench_bert,
-                   bench_deepfm):
+        extra = [bench_mnist_mlp, bench_resnet50, bench_bert,
+                 bench_deepfm]
+        for fn in extra:
             try:
                 r = fn()
                 r["vs_baseline"] = (round(r["mfu"] / 0.40, 3)
                                     if r.get("mfu") else None)
-                print(json.dumps(r))
+                print(json.dumps(r), flush=True)
             except Exception as e:
                 print(json.dumps({"metric": fn.__name__,
-                                  "error": repr(e)}))
+                                  "error": repr(e)}), flush=True)
 
 
 if __name__ == "__main__":
