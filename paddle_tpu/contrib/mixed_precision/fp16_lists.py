@@ -12,6 +12,9 @@ from __future__ import annotations
 white_list = {
     "mul", "matmul", "conv2d", "conv3d", "depthwise_conv2d",
     "conv2d_transpose", "scaled_dot_product_attention",
+    # MXU-bound and numerically safe in bf16: all reductions over the
+    # vocab axis run in float32 inside the op
+    "fused_linear_xent",
 }
 
 # Numerically sensitive ops that must stay in float32.

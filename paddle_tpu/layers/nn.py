@@ -71,6 +71,47 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     return helper.append_activation(pre_act)
 
 
+def fused_linear_cross_entropy(input, label, size, epsilon=0.0,
+                               param_attr=None, name=None,
+                               return_logits=False):
+    """Fused vocabulary projection + label-smoothed softmax
+    cross-entropy over the last axis of ``input``:
+    ``loss = softmax_xent(input @ W, smooth(onehot(label), epsilon))``.
+
+    The TPU replacement for the ``fc + label_smooth +
+    softmax_with_cross_entropy`` chain every NMT/LM model ends with
+    (reference: operators/fused/ fusion pattern + math/cross_entropy.cu)
+    — the [N, vocab] logits are the model's largest activation, and the
+    fused op (pallas variant: ops/pallas/fused_xent.py) streams them
+    through VMEM instead of materializing them in HBM.
+
+    ``return_logits=True`` additionally emits the plain logits through
+    a separate mul on the same weight — for inference graphs; when the
+    logits go unfetched at train time XLA dead-code-eliminates the
+    extra matmul, so emitting both costs nothing.
+
+    Returns ``loss`` ([..., 1] float32), or ``(loss, logits)``.
+    """
+    helper = LayerHelper("fused_linear_xent", name=name)
+    in_features = input.shape[-1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=(in_features, size),
+                                dtype=input.dtype)
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="fused_linear_xent",
+                     inputs={"X": [input], "W": [w], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"epsilon": epsilon})
+    if not return_logits:
+        return loss
+    logits = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="mul", inputs={"X": [input], "Y": [w]},
+                     outputs={"Out": [logits]},
+                     attrs={"x_num_col_dims": len(input.shape) - 1,
+                            "y_num_col_dims": 1})
+    return loss, logits
+
+
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32",
               name=None):

@@ -196,18 +196,15 @@ def transformer(cfg: TransformerConfig, is_test=False):
     dec_out = decoder(tgt_ids, enc_out, src_mask, tgt_mask, cfg,
                       is_test)
 
-    logits = layers.fc(dec_out, cfg.tgt_vocab, num_flatten_dims=2,
-                       bias_attr=False, name="proj")
-
-    if cfg.label_smooth_eps:
-        oh = layers.one_hot(layers.unsqueeze(lbl_ids, [2]),
-                            cfg.tgt_vocab)
-        soft = layers.label_smooth(oh, epsilon=cfg.label_smooth_eps)
-        cost = layers.softmax_with_cross_entropy(
-            logits, soft, soft_label=True)
-    else:
-        cost = layers.softmax_with_cross_entropy(
-            logits, layers.unsqueeze(lbl_ids, [2]))
+    # Fused head: the [b, s, 30k] logits are the model's largest
+    # activation — the fused op never materializes them for the loss,
+    # and the uniform label smoothing folds into its closed form. The
+    # plain logits (for decoding/inference graphs) come from a separate
+    # mul on the same weight that XLA dead-code-eliminates whenever
+    # they go unfetched (i.e. every training step).
+    cost, logits = layers.fused_linear_cross_entropy(
+        dec_out, layers.unsqueeze(lbl_ids, [2]), cfg.tgt_vocab,
+        epsilon=cfg.label_smooth_eps, name="proj", return_logits=True)
     cost = layers.squeeze(cost, [2])            # [b, s]
     weighted = layers.elementwise_mul(cost, tgt_mask)
     sum_cost = layers.reduce_sum(weighted)

@@ -62,3 +62,37 @@ def fc(x, w, bias, *, in_num_col_dims=1, activation_type=""):
     if bias is not None:
         out = out + bias
     return _UNARY[activation_type](out)
+
+
+@register("fused_linear_xent", ["X", "W", "Label"], ["Loss"],
+          nondiff=("Label",))
+def fused_linear_xent(x, w, label, *, epsilon=0.0):
+    """Fused vocabulary projection + label-smoothed softmax
+    cross-entropy: ``loss = xent(x @ w, smooth(onehot(label), eps))``.
+
+    Reference: the proj fc + label_smooth_op.cc + softmax_with_cross_
+    entropy_op.cu chain every NMT/LM model ends with (e.g.
+    benchmark/fluid/models/machine_translation.py) — fused here because
+    the [N, V] logits of a 30k vocab dwarf every other activation in
+    the model. Uniform smoothing has the closed form
+    ``loss = lse - (1-eps)*logit[y] - eps/V * sum(logits)`` so neither
+    the smoothed targets nor log-probabilities need materializing.
+    The pallas variant (ops/pallas/fused_xent.py) streams vocabulary
+    blocks through VMEM so the logits never reach HBM at all.
+
+    Label: int [..., 1] (hard indices only; arbitrary soft targets stay
+    on the unfused path). Loss: float32 [..., 1].
+    """
+    V = w.shape[-1]
+    logits = jnp.dot(x, w,
+                     preferred_element_type=jnp.float32)  # [..., V]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    lab = label.astype(jnp.int32)
+    if lab.ndim == logits.ndim - 1:
+        lab = lab[..., None]
+    picked = jnp.take_along_axis(logits, lab, axis=-1)
+    loss = lse - (1.0 - epsilon) * picked
+    if epsilon:
+        loss = loss - (epsilon / V) * jnp.sum(logits, axis=-1,
+                                              keepdims=True)
+    return loss

@@ -5,4 +5,5 @@ from . import common  # noqa: F401  (defines FLAGS_op_library)
 from . import attention  # noqa: F401
 from . import layer_norm  # noqa: F401
 from . import softmax_xent  # noqa: F401
+from . import fused_xent  # noqa: F401
 from . import fused_adam  # noqa: F401

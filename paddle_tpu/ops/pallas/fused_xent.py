@@ -1,0 +1,157 @@
+"""Fused vocabulary-projection + softmax cross-entropy pallas kernel.
+
+The analog of the reference's fused logits-loss chain
+(operators/math/cross_entropy.cu + the operators/fused/ pattern): every
+NMT/LM model ends in ``fc(d_model -> V) + label_smooth + softmax_xent``
+whose [N, V] logits (N = batch*seq, V ~ 30k) are by far the largest
+activation in the model — at transformer-base flagship shape the bf16
+logits alone are ~1 GB/step of HBM writes that XLA then re-reads for
+the log-softmax. This kernel streams vocabulary blocks through VMEM and
+reduces them online (flash-attention-style running logsumexp), so the
+logits never reach HBM at all. Only the per-row logsumexp ([N, 1]) is
+saved for the backward, which recomputes the logits blockwise — XLA
+fuses the softmax-minus-target epilogue into the recompute matmul, so
+the backward materializes exactly one [N, V] bf16 array (the scaled
+gradient) instead of logits + softmax + dlogits.
+
+Grid layout: vocab-major ``(nvj, ni)`` so each W block ([D, bv]) loads
+once total while X row blocks re-stream per vocab block — W is the
+big operand (D*V), X the small one (N*D), so this order minimizes HBM
+traffic. Running statistics live in full-length [N, 1] VMEM scratch
+indexed by row offset.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import get, register_variant
+from .common import blk, interpret_mode
+
+
+def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
+                m_sc, z_sc, s_sc, p_sc, *, V, eps, nvj, bn):
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    rows = pl.ds(i * bn, bn)
+
+    logits = jnp.dot(x_ref[:], w_ref[:],
+                     preferred_element_type=jnp.float32)   # [bn, bv]
+    bv = logits.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + j * bv
+    valid = col < V                      # mask the padded vocab tail
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[rows] = jnp.full((bn, 1), -jnp.inf, jnp.float32)
+        z_sc[rows] = jnp.zeros((bn, 1), jnp.float32)
+        s_sc[rows] = jnp.zeros((bn, 1), jnp.float32)
+        p_sc[rows] = jnp.zeros((bn, 1), jnp.float32)
+
+    m_old = m_sc[rows]
+    blk_max = jnp.max(jnp.where(valid, logits, -jnp.inf), axis=1,
+                      keepdims=True)
+    m_new = jnp.maximum(m_old, blk_max)
+    e = jnp.where(valid, jnp.exp(logits - m_new), 0.0)
+    z_sc[rows] = z_sc[rows] * jnp.exp(m_old - m_new) \
+        + jnp.sum(e, axis=1, keepdims=True)
+    m_sc[rows] = m_new
+    s_sc[rows] = s_sc[rows] + jnp.sum(jnp.where(valid, logits, 0.0),
+                                      axis=1, keepdims=True)
+    lab = lab_ref[:]                                       # [bn, 1]
+    p_sc[rows] = p_sc[rows] + jnp.sum(
+        jnp.where(col == lab, logits, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == nvj - 1)
+    def _finish():
+        lse = m_sc[rows] + jnp.log(z_sc[rows])
+        lse_ref[:] = lse
+        # loss = lse - (1-eps)*logit[y] - eps/V * sum(logits)
+        loss_ref[:] = (lse - (1.0 - eps) * p_sc[rows]
+                       - (eps / V) * s_sc[rows])
+
+
+def _fwd_call(x2, w, lab2, eps):
+    N, D = x2.shape
+    V = w.shape[-1]
+    bn = blk(N, 512)
+    bv = min(2048, -(-V // 128) * 128)
+    nvj = -(-V // bv)
+    Vp = nvj * bv
+    if Vp > V:
+        w = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    kernel = functools.partial(_fwd_kernel, V=V, eps=eps, nvj=nvj,
+                               bn=bn)
+    loss, lse = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((N, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((N, 1), jnp.float32)),
+        grid=(nvj, N // bn),
+        in_specs=[pl.BlockSpec((bn, D), lambda j, i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((D, bv), lambda j, i: (0, j),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((bn, 1), lambda j, i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((bn, 1), lambda j, i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((bn, 1), lambda j, i: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((N, 1), jnp.float32)] * 4,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * N * D * Vp, transcendentals=N * Vp,
+            bytes_accessed=(N * D * nvj + D * Vp) * x2.dtype.itemsize),
+        interpret=interpret_mode(),
+    )(x2, w, lab2)
+    return loss, lse
+
+
+@functools.lru_cache(maxsize=None)
+def _fused(eps):
+    @jax.custom_vjp
+    def f(x2, w, lab2):
+        return _fwd_call(x2, w, lab2, eps)[0]
+
+    def fwd(x2, w, lab2):
+        loss, lse = _fwd_call(x2, w, lab2, eps)
+        return loss, (x2, w, lab2, lse)
+
+    def bwd(res, g):
+        # Recompute the logits blockwise-in-XLA: the exp/subtract
+        # epilogue fuses into the matmul, so only the scaled gradient
+        # G ([N, V], input dtype) is ever materialized.
+        x2, w, lab2, lse = res
+        V = w.shape[-1]
+        logits = jnp.dot(x2, w, preferred_element_type=jnp.float32)
+        y = jax.nn.one_hot(lab2[:, 0], V, dtype=jnp.float32)
+        p = jnp.exp(logits - lse)
+        G = ((p - eps / V - (1.0 - eps) * y)
+             * g.astype(jnp.float32)).astype(x2.dtype)
+        dx = jnp.dot(G, w.T)
+        dw = jnp.dot(x2.T, G)
+        return dx, dw, None
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register_variant("fused_linear_xent", "pallas")
+def fused_linear_xent_pallas(x, w, label, *, epsilon=0.0):
+    N = 1
+    for d in x.shape[:-1]:
+        N *= d
+    # full-length [N, 1] f32 running statistics must fit VMEM scratch
+    if N * 16 > (2 << 20):
+        return get("fused_linear_xent").fn(x, w, label,
+                                           epsilon=epsilon)
+    x2 = x.reshape(N, x.shape[-1])
+    lab2 = label.reshape(N, 1).astype(jnp.int32)
+    loss = _fused(float(epsilon))(x2, w, lab2)
+    return loss.reshape(x.shape[:-1] + (1,))

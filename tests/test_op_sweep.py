@@ -170,6 +170,27 @@ spec("fc", {"Input": sgn((2, 6), 134), "W": sgn((6, 4), 135),
             "Bias": sgn((4,), 136)},
      {"in_num_col_dims": 1, "activation_type": ""},
      ref=lambda ins: [ins["Input"] @ ins["W"] + ins["Bias"]])
+def _ref_fused_xent(ins, eps):
+    logits = (ins["X"] @ ins["W"]).astype(np.float64)
+    m = logits.max(-1, keepdims=True)
+    lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+    picked = np.take_along_axis(logits, ins["Label"], -1)
+    V = ins["W"].shape[-1]
+    return [(lse - (1 - eps) * picked
+             - (eps / V) * logits.sum(-1, keepdims=True))
+            .astype(np.float32)]
+
+
+spec("fused_linear_xent",
+     {"X": sgn((4, 6), 601), "W": sgn((6, 9), 602),
+      "Label": np.array([[0], [3], [8], [5]], np.int64)},
+     {"epsilon": 0.0},
+     ref=lambda ins: _ref_fused_xent(ins, 0.0), max_rel=0.02)
+spec("fused_linear_xent",
+     {"X": sgn((4, 6), 603), "W": sgn((6, 9), 604),
+      "Label": np.array([[2], [1], [7], [4]], np.int64)},
+     {"epsilon": 0.1},
+     ref=lambda ins: _ref_fused_xent(ins, 0.1), max_rel=0.03)
 spec("fused_elemwise_activation",
      {"X": u((2, 3), 137), "Y": u((2, 3), 138)},
      {"functor_list": ["elementwise_add", "relu"], "axis": -1},

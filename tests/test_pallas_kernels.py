@@ -223,3 +223,48 @@ def test_sdpa_per_head_bias_matches_reference(rng):
     for a, b in zip(gg, gw):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-3, rtol=5e-3)
+
+
+def test_fused_linear_xent_matches_reference():
+    """Streaming fused projection+xent kernel vs the composite lowering
+    — forward and both gradients, hard labels and label smoothing,
+    including a non-128-multiple vocab (masked padded tail)."""
+    r = np.random.RandomState(5)
+    N, D, V = 48, 16, 300
+    x = jnp.asarray(r.randn(N, D).astype(np.float32)) * 0.5
+    w = jnp.asarray(r.randn(D, V).astype(np.float32)) * 0.2
+    lab = jnp.asarray(r.randint(0, V, size=(N, 1)).astype(np.int64))
+    g = jnp.asarray(r.rand(N, 1).astype(np.float32))
+    opdef = ops.get("fused_linear_xent")
+    for eps in (0.0, 0.1):
+        _cmp("fused_linear_xent", (x, w, lab), {"epsilon": eps},
+             rtol=2e-5, atol=2e-5)
+        dref = jax.grad(lambda a, b: jnp.sum(
+            opdef.fn(a, b, lab, epsilon=eps) * g), argnums=(0, 1))(x, w)
+        dpal = jax.grad(lambda a, b: jnp.sum(
+            opdef.variants["pallas"](a, b, lab, epsilon=eps) * g),
+            argnums=(0, 1))(x, w)
+        for dr, dp in zip(dref, dpal):
+            np.testing.assert_allclose(np.asarray(dp), np.asarray(dr),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_fused_linear_xent_3d_and_bf16():
+    """Leading dims flatten correctly; bf16 inputs keep f32 statistics
+    (the AMP path: white-listed op, loss must stay finite/accurate)."""
+    r = np.random.RandomState(6)
+    B, S, D, V = 3, 8, 16, 130
+    x = jnp.asarray(r.randn(B, S, D).astype(np.float32))
+    w = jnp.asarray(r.randn(D, V).astype(np.float32)) * 0.3
+    lab = jnp.asarray(r.randint(0, V, size=(B, S, 1)).astype(np.int64))
+    opdef = ops.get("fused_linear_xent")
+    ref = opdef.fn(x, w, lab, epsilon=0.1)
+    pal = opdef.variants["pallas"](x, w, lab, epsilon=0.1)
+    assert pal.shape == (B, S, 1) and pal.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    xb, wb = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    palb = opdef.variants["pallas"](xb, wb, lab, epsilon=0.1)
+    assert palb.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(palb), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
