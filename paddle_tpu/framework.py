@@ -339,9 +339,25 @@ class Block:
 # Shape/dtype inference at op-append time
 # ---------------------------------------------------------------------------
 
-# Placeholder concrete size substituted for -1 (batch) dims during
-# abstract evaluation; output dims equal to it are mapped back to -1.
-_DYN_DIM = 8191
+# Placeholder concrete sizes substituted for -1 (batch) dims during
+# abstract evaluation; every -1 in one op shares one sentinel (the
+# dims represent the same unknown batch — mixing two would break
+# broadcasting under eval_shape), but the sentinel is chosen per op to
+# collide with none of the op's concrete dims or integer attrs, so a
+# real dimension of 8191 (vocab padded to a prime, etc.) can no longer
+# be silently mis-inferred as dynamic. Primes: no product of smaller
+# concrete dims can equal one.
+_DYN_SENTINELS = (8191, 7919, 7883, 7877, 7873, 7867, 7853, 7841)
+
+
+def _pick_dyn_dim(avoid):
+    for p in _DYN_SENTINELS:
+        if p not in avoid:
+            return p
+    p = 15013
+    while p in avoid:
+        p += 2
+    return p
 
 
 def _infer_shapes(block, op):
@@ -349,7 +365,7 @@ def _infer_shapes(block, op):
     lowering (the analog of the reference's per-op InferShape,
     operator.cc:933 — but derived from the single source of truth, the
     lowering itself). Best-effort: failures leave shapes unknown."""
-    if op.type == "vjp":
+    if op.type in ("vjp", "vjp2"):
         return
     try:
         from . import ops as _ops
@@ -364,6 +380,31 @@ def _infer_shapes(block, op):
     had_dyn = False
     arg_structs = []
     try:
+        avoid = set()
+        for slot, _variadic in opdef.input_slots:
+            for n in op.inputs.get(slot, []):
+                v = block._find_var_recursive(n)
+                if v is not None and v.shape:
+                    avoid.update(d for d in v.shape if d > 0)
+
+        def _collect_ints(a):
+            if isinstance(a, bool):
+                return
+            if isinstance(a, int):
+                avoid.add(a)
+            elif isinstance(a, (list, tuple)):
+                for e in a:
+                    _collect_ints(e)
+
+        for a in op.attrs.values():
+            _collect_ints(a)
+        # primes defend against products of concrete dims equaling the
+        # sentinel; pairwise sums defend concat-style derived dims
+        if len(avoid) <= 64:
+            for x in list(avoid):
+                for y in list(avoid):
+                    avoid.add(x + y)
+        dyn_dim = _pick_dyn_dim(avoid)
         for slot, variadic in opdef.input_slots:
             names = op.inputs.get(slot, [])
             structs = []
@@ -375,7 +416,7 @@ def _infer_shapes(block, op):
                 for d in v.shape:
                     if d == -1:
                         had_dyn = True
-                        shape.append(_DYN_DIM)
+                        shape.append(dyn_dim)
                     else:
                         shape.append(d)
                 structs.append(jax.ShapeDtypeStruct(
@@ -424,7 +465,7 @@ def _infer_shapes(block, op):
             v = block._find_var_recursive(n)
             if v is None or getattr(r, "shape", None) is None:
                 continue
-            shape = tuple(-1 if (had_dyn and d == _DYN_DIM) else d
+            shape = tuple(-1 if (had_dyn and d == dyn_dim) else d
                           for d in r.shape)
             if v.shape == () or v.shape is None or v.shape == shape:
                 if not v.persistable:

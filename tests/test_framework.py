@@ -85,3 +85,19 @@ def test_startup_then_forward():
                    fetch_list=[out])
     assert res.shape == (5, 3)
     assert np.all(res >= 0)
+
+
+def test_shape_inference_real_dim_equal_to_sentinel():
+    """A concrete dimension equal to a dynamic-dim sentinel (e.g. a
+    vocab padded to the prime 8191) must not be mis-inferred as -1:
+    the sentinel is chosen per op to avoid every concrete dim."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8191], dtype="float32")
+        h = layers.fc(x, size=8191)
+        assert h.shape == (-1, 8191)
+        w = layers.fc(h, size=16)
+        assert w.shape == (-1, 16)
+        # reshape whose target mentions 8191 as a literal attr
+        r = layers.reshape(w, (-1, 8, 2))
+        assert r.shape == (-1, 8, 2)
