@@ -81,12 +81,22 @@ def _append_sparse_lookup_grad(block, fwd, stop_vars) -> bool:
 
 
 def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
-                    callbacks=None):
+                    callbacks=None, grad_suffix=""):
     """Append gradient ops for ``loss`` to its program; returns
-    [(param, grad_var)] like the reference (backward.py:394)."""
+    [(param, grad_var)] like the reference (backward.py:394).
+
+    ``grad_suffix`` namespaces this pass's gradient vars
+    (``x@GRAD<suffix>``) — the analog of the reference's @RENAME@
+    dedup (backward.py:135): a second differentiation over the same
+    program (calc_gradient for a gradient penalty, then minimize)
+    must not accumulate into the first pass's ``@GRAD`` vars.
+    """
     enforce(isinstance(loss, Variable), "loss must be a Variable")
     program = loss.block.program
     block = program.global_block()
+
+    def gname(n):
+        return grad_var_name(n) + grad_suffix
 
     # producer op of loss
     target_index = None
@@ -102,7 +112,7 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
 
     # d(loss)/d(loss) = 1
     loss_grad = block.create_var(
-        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype,
+        name=gname(loss.name), shape=loss.shape, dtype=loss.dtype,
         persistable=False, stop_gradient=True)
     block.append_op(
         type="fill_constant",
@@ -113,6 +123,15 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
     # reverse walk, one vjp op per differentiable forward op
     for i in reversed(path):
         fwd = block.ops[i]
+        if fwd.type == "vjp":
+            # differentiate THROUGH a previous pass's gradient op:
+            # double backward (reference exercises this via
+            # unittests/gradient_checker.py / gradient-penalty models)
+            _append_vjp2(block, fwd, i, stop_vars, gname, grad_suffix)
+            continue
+        if fwd.type == "vjp2":
+            enforce(False, "third-order differentiation through a "
+                    "vjp2 op is not supported")
         if not ops.has(fwd.type):
             continue
         opdef = ops.get(fwd.type)
@@ -139,11 +158,14 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                 v = block._find_var_recursive(n)
                 if v is not None and v.dtype in ("float32", "float64",
                                                  "float16", "bfloat16"):
-                    gn = grad_var_name(n)
+                    gn = gname(n)
                     if not block.has_var(gn):
+                        # NOT stop_gradient: a later pass must be able
+                        # to differentiate through this pass's grads
+                        # (gradient-penalty double backward)
                         block.create_var(name=gn, shape=v.shape,
                                          dtype=v.dtype,
-                                         stop_gradient=True)
+                                         stop_gradient=False)
                     gnames.append(gn)
                     any_grad = True
             if gnames:
@@ -151,7 +173,7 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
         if not any_grad:
             continue
 
-        out_grad_inputs = [grad_var_name(n) for n in fwd.output_arg_names]
+        out_grad_inputs = [gname(n) for n in fwd.output_arg_names]
         block.append_op(
             type="vjp",
             inputs={"FwdIn": fwd.input_arg_names,
@@ -166,6 +188,7 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                 "fwd_attrs": dict(fwd.attrs),
                 "fwd_op_index": i,
                 "no_grad_vars": tuple(sorted(stop_vars)),
+                "grad_suffix": grad_suffix,
                 "op_role": "backward",
             })
 
@@ -179,27 +202,112 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
     for p in params:
         if not p.trainable:
             continue
-        gn = grad_var_name(p.name)
+        gn = gname(p.name)
         if block.has_var(gn):
             result.append((p, block.var(gn)))
     return result
 
 
+def _append_vjp2(block, vop, i, stop_vars, gname, grad_suffix):
+    """Append the second-order gradient op for a first-pass ``vjp`` op.
+
+    A vjp op is a pure function (FwdIn, OutGrad) -> input-grads (the
+    pullback of its forward op). Differentiating through it is
+    jax.vjp of that pullback application (executor._run_vjp2_op);
+    here we only declare which of its inputs receive this pass's
+    gradients and which of its products carry upstream cotangents.
+    """
+    inner_suffix = vop.attrs.get("grad_suffix", "")
+
+    grad_outputs = {"FwdIn@GRAD": [], "OutGrad@GRAD": []}
+    fwd_in = list(vop.inputs.get("FwdIn", []))
+    out_grad = list(vop.inputs.get("OutGrad", []))
+    any_grad = False
+    for key, names in (("FwdIn@GRAD", fwd_in),
+                       ("OutGrad@GRAD", out_grad)):
+        for n in names:
+            if n in stop_vars:
+                continue
+            v = block._find_var_recursive(n)
+            if v is None or v.dtype not in ("float32", "float64",
+                                            "float16", "bfloat16"):
+                continue
+            gn = gname(n)
+            if not block.has_var(gn):
+                block.create_var(name=gn, shape=v.shape, dtype=v.dtype,
+                                 stop_gradient=False)
+            grad_outputs[key].append(gn)
+            any_grad = True
+    if not any_grad:
+        return
+
+    # upstream cotangents: this pass's grads of the vjp op's products
+    up = [gname(g) for g in
+          (n for outs in vop.outputs.values() for n in outs)]
+    block.append_op(
+        type="vjp2",
+        inputs={"FwdIn": fwd_in, "OutGrad": out_grad,
+                "UpGrad": [g for g in up if block.has_var(g)]},
+        outputs=grad_outputs,
+        attrs=dict(vop.attrs, grad_suffix_inner=inner_suffix,
+                   grad_suffix=grad_suffix,
+                   no_grad_vars_outer=tuple(sorted(stop_vars)),
+                   op_role="backward"))
+
+
 def calc_gradient(targets, inputs, target_gradients=None,
                   no_grad_set=None):
-    """Reference: backward.py:619. Gradients of targets w.r.t. inputs."""
+    """Reference: backward.py:619. Gradients of targets w.r.t. inputs.
+
+    Multiple targets follow the reference semantics: the returned
+    grads are ``d(sum_i <targets[i], target_gradients[i]>)/d(inputs)``
+    (cotangents default to ones). Each call namespaces its gradient
+    vars with a fresh suffix, so calc_gradient composes with a later
+    ``minimize``/``append_backward`` over the same program — the
+    double-backward (gradient-penalty) pattern.
+    """
     if isinstance(targets, Variable):
         targets = [targets]
     if isinstance(inputs, Variable):
         inputs = [inputs]
-    enforce(len(targets) == 1,
-            "calc_gradient currently supports a single target")
-    target = targets[0]
-    append_backward(target, no_grad_set=no_grad_set)
-    block = target.block.program.global_block()
+    enforce(len(targets) >= 1, "calc_gradient needs at least 1 target")
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    if isinstance(target_gradients, Variable):
+        target_gradients = [target_gradients]
+    enforce(len(target_gradients) == len(targets),
+            "target_gradients must match targets (%d vs %d)"
+            % (len(target_gradients), len(targets)))
+
+    program = targets[0].block.program
+    block = program.global_block()
+    count = getattr(program, "_calc_grad_count", 0)
+    program._calc_grad_count = count + 1
+    suffix = "@CG%d" % count
+
+    # combined scalar: sum_i <t_i, tg_i>; its backward yields exactly
+    # the requested vector-Jacobian products
+    from . import layers
+    with framework.program_guard(program):
+        terms = []
+        for t, tg in zip(targets, target_gradients):
+            if tg is None:
+                terms.append(layers.reduce_sum(t))
+            else:
+                terms.append(layers.reduce_sum(
+                    layers.elementwise_mul(t, tg)))
+        combined = terms[0]
+        for t in terms[1:]:
+            combined = layers.elementwise_add(combined, t)
+
+    stop = set(no_grad_set or ())
+    for tg in target_gradients:
+        if tg is not None:
+            stop.add(tg.name)
+    append_backward(combined, no_grad_set=stop, grad_suffix=suffix)
     outs = []
     for iv in inputs:
-        gn = grad_var_name(iv.name)
+        gn = grad_var_name(iv.name) + suffix
         outs.append(block.var(gn) if block.has_var(gn) else None)
     return outs
 

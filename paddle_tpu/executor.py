@@ -144,6 +144,140 @@ def run_op(op, env, step_key, op_index, library=None, snapshot=False):
     _scatter_outputs(opdef, op, env, result)
 
 
+class _VjpParts:
+    """The pullback of one forward op, prepared from a ``vjp`` op's
+    attrs: ``grad_fn(primal_args, cotangents)`` is a PURE jax function
+    (non-differentiated inputs are closed-over constants), so first-
+    order execution applies it directly and second-order (``vjp2``)
+    differentiates through it with jax.vjp."""
+
+    def __init__(self, a, env, step_key, library, diff_no_grad=None):
+        fwd_type = a["fwd_type"]
+        fwd_inputs: Dict[str, List[str]] = a["fwd_inputs"]
+        fwd_attrs = dict(a["fwd_attrs"])
+        fwd_index = a["fwd_op_index"]
+        self.no_grad_set = set(a.get("no_grad_vars", ()))
+        # which inputs participate in differentiation; a second-order
+        # pass may need grads w.r.t. vars the first pass stopped, so
+        # the partition set can be wider than no_grad_set
+        partition_stop = (self.no_grad_set if diff_no_grad is None
+                          else set(diff_no_grad))
+        self.fwd_type = fwd_type
+
+        opdef = ops.get(fwd_type)
+        if opdef.needs_rng:
+            # Same per-op key as the forward pass: dropout masks match.
+            fwd_attrs["rng"] = _op_rng(step_key, fwd_index)
+
+        def read(n):
+            # pre-forward-op value: in-place ops overwrite their input
+            # names; the snapshot taken in run_op restores the view the
+            # forward actually consumed
+            return env.get(("fwd_in", fwd_index, n), env[n])
+
+        # Partition inputs into differentiable / fixed. For variadic
+        # slots the FLOAT SUBSET is differentiated (a while/RNN op's X
+        # slot mixes float params with int counters — ints stay fixed).
+        self.diff_slots = []  # (slot, idxs-or-None, names)
+        all_vals = {}
+        for slot, variadic in opdef.input_slots:
+            names = fwd_inputs.get(slot, [])
+            if variadic:
+                vals = [read(n) for n in names]
+            elif not names:
+                vals = None
+            else:
+                vals = read(names[0])
+            all_vals[slot] = vals
+            if slot in opdef.nondiff_slots or not names:
+                continue
+            if variadic:
+                idxs = [j for j, (v, n) in enumerate(zip(vals, names))
+                        if _is_float(v) and n not in partition_stop]
+                if idxs:
+                    self.diff_slots.append((slot, idxs, names))
+            else:
+                if _is_float(vals) and names[0] not in partition_stop:
+                    self.diff_slots.append((slot, None, names))
+
+        # flat list of per-output cotangent names (env grad keys are
+        # name + the pass's grad_suffix)
+        self.out_names = []
+        for slot in opdef.output_slots:
+            variadic = slot.endswith("*")
+            sname = slot[:-1] if variadic else slot
+            self.out_names.extend(a["fwd_outputs"].get(sname, []))
+
+        self.primal_args = [
+            all_vals[slot] if idxs is None
+            else [all_vals[slot][j] for j in idxs]
+            for slot, idxs, _ in self.diff_slots]
+
+        # Library variants (pallas kernels) carry a custom_vjp whose
+        # backward recomputes through the reference lowering, so
+        # picking the variant here keeps the forward fast without
+        # tracing it twice.
+        fwd_lowering = opdef.pick(library)
+        diff_slots = self.diff_slots
+        input_slots = opdef.input_slots
+
+        def fwd_fn(*diff_vals):
+            merged = dict(all_vals)
+            for (slot, idxs, _n), val in zip(diff_slots, diff_vals):
+                if idxs is None:
+                    merged[slot] = val
+                else:
+                    lst = list(all_vals[slot])
+                    for j, v in zip(idxs, val):
+                        lst[j] = v
+                    merged[slot] = lst
+            args = [merged[slot] for slot, _ in input_slots]
+            return fwd_lowering(*args, **fwd_attrs)
+
+        def grad_fn(primal_args, cotangents):
+            """cotangents: flat list aligned with out_names (None =>
+            zero). Returns the grads tuple aligned with diff_slots."""
+            try:
+                primals_out, pullback = jax.vjp(fwd_fn, *primal_args)
+            except ValueError as e:
+                raise _augment_vjp_error(e, fwd_type) from e
+            flat_out, treedef = jax.tree_util.tree_flatten(primals_out)
+            cots = [c if c is not None else jnp.zeros_like(v)
+                    for v, c in zip(flat_out, cotangents)]
+            if len(flat_out) > len(cots):
+                # outputs with no recorded names get zero cotangents
+                cots += [jnp.zeros_like(v) for v in flat_out[len(cots):]]
+            return pullback(
+                jax.tree_util.tree_unflatten(treedef, cots))
+
+        self.grad_fn = grad_fn
+
+    def read_cotangents(self, env, suffix):
+        return [env.get(framework.grad_var_name(n) + suffix)
+                if n else None for n in self.out_names]
+
+    def diff_names(self):
+        """Flat input names aligned with the grads tuple's leaves."""
+        out = []
+        for slot, idxs, names in self.diff_slots:
+            if idxs is None:
+                out.append(names[0])
+            else:
+                out.extend(names[j] for j in idxs)
+        return out
+
+    def accumulate(self, env, grads, suffix, no_grad=None):
+        no_grad = self.no_grad_set if no_grad is None else no_grad
+        for (slot, idxs, names), g in zip(self.diff_slots, grads):
+            leaves = [(names[0], g)] if idxs is None else \
+                [(names[j], gi) for j, gi in zip(idxs, g)]
+            for n, gi in leaves:
+                if n in no_grad or gi is None:
+                    continue
+                gn = framework.grad_var_name(n) + suffix
+                env[gn] = env[gn] + gi if gn in env else gi
+
+
 def _run_vjp_op(op, env, step_key, library=None):
     """Execute a generic gradient op appended by backward.append_backward.
 
@@ -153,111 +287,70 @@ def _run_vjp_op(op, env, step_key, library=None):
     _addup_repetitive_outputs_:135 in the reference) happens here by
     add-accumulating into existing @GRAD entries.
     """
-    a = op.attrs
-    fwd_type = a["fwd_type"]
-    fwd_inputs: Dict[str, List[str]] = a["fwd_inputs"]
-    fwd_outputs: Dict[str, List[str]] = a["fwd_outputs"]
-    fwd_attrs = dict(a["fwd_attrs"])
-    fwd_index = a["fwd_op_index"]
-    no_grad_set = set(a.get("no_grad_vars", ()))
-
-    opdef = ops.get(fwd_type)
-    if opdef.needs_rng:
-        # Same per-op key as the forward pass: dropout masks etc. match.
-        fwd_attrs["rng"] = _op_rng(step_key, fwd_index)
-
-    def read(n):
-        # pre-forward-op value: in-place ops overwrite their input
-        # names; the snapshot taken in run_op restores the view the
-        # forward actually consumed
-        return env.get(("fwd_in", fwd_index, n), env[n])
-
-    # Partition inputs into differentiable / fixed. For variadic slots
-    # the FLOAT SUBSET is differentiated (a while/RNN op's X slot mixes
-    # float params with int counters — the int members stay fixed).
-    diff_slots = []  # (slot, idxs-or-None, names); idxs => variadic
-    all_vals = {}
-    for slot, variadic in opdef.input_slots:
-        names = fwd_inputs.get(slot, [])
-        if variadic:
-            vals = [read(n) for n in names]
-        elif not names:
-            vals = None
-        else:
-            vals = read(names[0])
-        all_vals[slot] = vals
-        if slot in opdef.nondiff_slots or not names:
-            continue
-        if variadic:
-            idxs = [j for j, (v, n) in enumerate(zip(vals, names))
-                    if _is_float(v) and n not in no_grad_set]
-            if idxs:
-                diff_slots.append((slot, idxs, names))
-        else:
-            if _is_float(vals) and names[0] not in no_grad_set:
-                diff_slots.append((slot, None, names))
-
-    if not diff_slots:
+    parts = _VjpParts(op.attrs, env, step_key, library)
+    if not parts.diff_slots:
         return
+    suffix = op.attrs.get("grad_suffix", "")
+    cots = parts.read_cotangents(env, suffix)
+    grads = parts.grad_fn(parts.primal_args, cots)
+    parts.accumulate(env, grads, suffix)
 
-    # Library variants (pallas kernels) carry a custom_vjp whose
-    # backward recomputes through the reference lowering, so picking
-    # the variant here keeps the forward fast without tracing it twice.
-    fwd_lowering = opdef.pick(library)
 
-    def fwd_fn(*diff_vals):
-        merged = dict(all_vals)
-        for (slot, idxs, _n), val in zip(diff_slots, diff_vals):
-            if idxs is None:
-                merged[slot] = val
-            else:
-                lst = list(all_vals[slot])
-                for j, v in zip(idxs, val):
-                    lst[j] = v
-                merged[slot] = lst
-        args = [merged[slot] for slot, _ in opdef.input_slots]
-        return fwd_lowering(*args, **fwd_attrs)
+def _run_vjp2_op(op, env, step_key, library=None):
+    """Execute a second-order (``vjp2``) gradient op: jax.vjp through a
+    first-pass vjp op's pullback application. Produces this pass's
+    gradients w.r.t. the forward op's inputs AND w.r.t. the upstream
+    cotangents the first pass consumed (reference exercises the same
+    capability via unittests/gradient_checker.py double-grad tests)."""
+    a = op.attrs
+    inner_stop = set(a.get("no_grad_vars", ()))
+    outer_stop = set(a.get("no_grad_vars_outer", ()))
+    # differentiate w.r.t. anything differentiable in EITHER pass: the
+    # inner pass's no_grad_set must not freeze vars (e.g. weights) the
+    # outer pass legitimately differentiates through the pullback
+    parts = _VjpParts(a, env, step_key, library,
+                      diff_no_grad=inner_stop & outer_stop)
+    if not parts.diff_slots:
+        return
+    inner_suffix = a.get("grad_suffix_inner", "")
+    outer_suffix = a.get("grad_suffix", "")
+    cots = parts.read_cotangents(env, inner_suffix)
 
-    primal_args = [all_vals[slot] if idxs is None
-                   else [all_vals[slot][j] for j in idxs]
-                   for slot, idxs, _ in diff_slots]
-    try:
-        primals_out, pullback = jax.vjp(fwd_fn, *primal_args)
-    except ValueError as e:
-        raise _augment_vjp_error(e, fwd_type) from e
+    grads_out, pullback = jax.vjp(parts.grad_fn, parts.primal_args,
+                                  cots)
 
-    # Build cotangents matching primals_out structure from @GRAD env vars;
-    # missing output grads are zero.
-    flat_out, treedef = jax.tree_util.tree_flatten(primals_out)
-    out_names = []
-    for slot in opdef.output_slots:
-        variadic = slot.endswith("*")
-        sname = slot[:-1] if variadic else slot
-        out_names.extend(fwd_outputs.get(sname, []))
-    cotangents = []
-    for val, name in zip(flat_out, out_names):
-        g = env.get(framework.grad_var_name(name)) if name else None
-        cotangents.append(g if g is not None else jnp.zeros_like(val))
-    if len(flat_out) != len(out_names):
-        # outputs with no recorded names get zero cotangents
-        cotangents = cotangents + [jnp.zeros_like(v)
-                                   for v in flat_out[len(out_names):]]
-    grads = pullback(jax.tree_util.tree_unflatten(treedef, cotangents))
+    # upstream cotangents for each produced first-order grad:
+    # env["<n>@GRAD<inner>@GRAD<outer>"], zero when absent
+    flat, treedef = jax.tree_util.tree_flatten(grads_out)
+    flat_names = []
+    for (slot, idxs, slot_names) in parts.diff_slots:
+        ns = [slot_names[0]] if idxs is None else \
+            [slot_names[j] for j in idxs]
+        flat_names.extend(ns)
+    ups = []
+    k = 0
+    for leaf in flat:
+        n = flat_names[k] if k < len(flat_names) else None
+        k += 1
+        g = None
+        if n is not None:
+            key = framework.grad_var_name(
+                framework.grad_var_name(n) + inner_suffix) + outer_suffix
+            g = env.get(key)
+        ups.append(g if g is not None else jnp.zeros_like(leaf))
+    d_primals, d_cots = pullback(
+        jax.tree_util.tree_unflatten(treedef, ups))
 
-    for (slot, idxs, names), g in zip(diff_slots, grads):
-        if idxs is not None:
-            for j, gi in zip(idxs, g):
-                n = names[j]
-                if n in no_grad_set:
-                    continue
-                gn = framework.grad_var_name(n)
-                env[gn] = env[gn] + gi if gn in env else gi
-        else:
-            n = names[0]
-            if n in no_grad_set:
-                continue
-            gn = framework.grad_var_name(n)
-            env[gn] = env[gn] + g if gn in env else g
+    parts.accumulate(env, d_primals, outer_suffix, no_grad=outer_stop)
+    # grads w.r.t. the first pass's consumed cotangents flow into
+    # "<out>@GRAD<inner>@GRAD<outer>" — the chain continues through
+    # whatever produced those cotangents
+    for n, dc in zip(parts.out_names, d_cots):
+        if dc is None:
+            continue
+        key = framework.grad_var_name(
+            framework.grad_var_name(n) + inner_suffix) + outer_suffix
+        env[key] = env[key] + dc if key in env else dc
 
 
 def _augment_vjp_error(e, fwd_type):
@@ -275,15 +368,17 @@ def run_block(block, env, step_key, library=None):
     RunPreparedContext hot loop, executor.cc:415 — but tracing, not
     executing)."""
     vjp_fwd_indices = {op.attrs.get("fwd_op_index")
-                       for op in block.ops if op.type == "vjp"}
+                       for op in block.ops if op.type in ("vjp", "vjp2")}
     for i, op in enumerate(block.ops):
-        if op.type != "vjp" and not ops.has(op.type):
+        if op.type not in ("vjp", "vjp2") and not ops.has(op.type):
             raise UnimplementedError(
                 "op type %r (op #%d) has no registered lowering"
                 % (op.type, i))
         try:
             if op.type == "vjp":
                 _run_vjp_op(op, env, step_key, library=library)
+            elif op.type == "vjp2":
+                _run_vjp2_op(op, env, step_key, library=library)
             else:
                 run_op(op, env, step_key, i, library=library,
                        snapshot=i in vjp_fwd_indices)

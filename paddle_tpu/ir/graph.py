@@ -228,7 +228,7 @@ class Graph:
             if old_i is not None:
                 old_to_new[old_i] = new_i
         for op in new_ops:
-            if op.type != "vjp":
+            if op.type not in ("vjp", "vjp2"):
                 continue
             old_fwd = op.attrs.get("fwd_op_index")
             if old_fwd is None:

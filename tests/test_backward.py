@@ -76,3 +76,109 @@ def test_stop_gradient_blocks_flow():
     got = {p.name.split(".")[0] for p, _ in pg}
     # only fc2's params get grads
     assert all("fc2" in n or "fc_1" in n for n in got), got
+
+
+def test_calc_gradient_multi_target():
+    """calc_gradient over several targets sums the vector-Jacobian
+    products (reference: backward.py:619 multi-target semantics)."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        x.stop_gradient = False
+        a = layers.scale(x, scale=2.0)
+        b = layers.scale(x, scale=5.0)
+        (gx,) = fluid.gradients([a, b], x)
+    exe = fluid.Executor()
+    (g,) = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                   fetch_list=[gx])
+    np.testing.assert_allclose(g, np.full(3, 7.0), rtol=1e-6)
+
+
+def test_calc_gradient_target_gradients():
+    """Explicit initial cotangents weight each target's contribution."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        x.stop_gradient = False
+        tg = layers.data("tg", shape=[3], append_batch_size=False)
+        y = layers.scale(x, scale=3.0)
+        (gx,) = fluid.gradients([y], [x], target_gradients=[tg])
+    exe = fluid.Executor()
+    tgv = np.array([1.0, 2.0, -1.0], np.float32)
+    (g,) = exe.run(main, feed={"x": np.ones(3, np.float32),
+                               "tg": tgv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 3.0 * tgv, rtol=1e-6)
+
+
+def test_double_backward_gradient_penalty():
+    """WGAN-GP pattern: calc_gradient for d(out)/dx, then a penalty on
+    that gradient differentiated w.r.t. the weights (reference:
+    unittests/gradient_checker.py double-grad capability)."""
+    import jax
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        x.stop_gradient = False
+        y = layers.fc(x, size=5, bias_attr=False, name="gpfc")
+        sm = layers.softmax(y)
+        out = layers.reduce_sum(layers.square(sm))
+        (gx,) = fluid.gradients(out, x)
+        gp = layers.reduce_mean(layers.square(gx))
+        pg = fluid.append_backward(gp)
+    w_grads = {p.name: g for p, g in pg}
+    assert "gpfc.w_0" in w_grads
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    dw, wv = exe.run(main, feed={"x": xv},
+                     fetch_list=[w_grads["gpfc.w_0"], "gpfc.w_0"])
+
+    def total(w, xx):
+        def outfn(xi):
+            s = jax.nn.softmax(xi @ w)
+            return jnp.sum(jnp.square(s))
+        gxx = jax.grad(outfn)(xx)
+        return jnp.mean(jnp.square(gxx))
+
+    dw_ref = jax.grad(total)(jnp.asarray(wv), jnp.asarray(xv))
+    np.testing.assert_allclose(dw, np.asarray(dw_ref), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_double_backward_with_inner_no_grad_set():
+    """The inner calc_gradient pass restricting grads to x (weights in
+    no_grad_set) must not freeze the weights for the OUTER pass: the
+    penalty's d/dW still flows through the pullback."""
+    import jax
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        x.stop_gradient = False
+        y = layers.fc(x, size=5, bias_attr=False, name="gpfc2")
+        out = layers.reduce_sum(layers.square(y))
+        (gx,) = fluid.gradients(out, x, no_grad_set={"gpfc2.w_0"})
+        gp = layers.reduce_mean(layers.square(gx))
+        pg = fluid.append_backward(gp)
+    w_grads = {p.name: g for p, g in pg}
+    assert "gpfc2.w_0" in w_grads
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    dw, wv = exe.run(main, feed={"x": xv},
+                     fetch_list=[w_grads["gpfc2.w_0"], "gpfc2.w_0"])
+
+    def total(w, xx):
+        def outfn(xi):
+            return jnp.sum(jnp.square(xi @ w))
+        gxx = jax.grad(outfn)(xx)
+        return jnp.mean(jnp.square(gxx))
+
+    dw_ref = jax.grad(total)(jnp.asarray(wv), jnp.asarray(xv))
+    np.testing.assert_allclose(dw, np.asarray(dw_ref), rtol=1e-4,
+                               atol=1e-6)
