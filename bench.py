@@ -96,11 +96,14 @@ def _timed_loop(run_step, warmup, iters):
     return iters / (time.perf_counter() - t0)
 
 
-def _best_library(run_step, warmup, iters):
-    """Measure base vs pallas kernel libraries, return the better
-    steps/sec (jit benchmark.cc: best implementation wins per shape). A
-    broken base path is a real failure and propagates; a broken pallas
-    path only loses the speedup."""
+def _best_library(run_step, warmup, iters, extra_libs=("pallas",)):
+    """Measure the base lowering against candidate kernel-library
+    configurations and return the best steps/sec (jit benchmark.cc:
+    best implementation wins per shape). Besides the blanket "pallas"
+    library, per-op mixes ("op_a:pallas,op_b:pallas") let a winning
+    kernel ship without dragging in siblings that lose at this shape.
+    A broken base path is a real failure and propagates; a broken
+    variant only loses its speedup."""
     from paddle_tpu.core.flags import FLAGS
 
     def timed(lib):
@@ -112,19 +115,21 @@ def _best_library(run_step, warmup, iters):
             FLAGS.op_library = prev
 
     _log("timing base library")
-    base = timed("")
-    _log("base done: %.3f steps/s" % base)
-    if _over_budget():
-        _log("time budget exceeded — skipping pallas comparison")
-        return base
-    try:
-        _log("timing pallas library")
-        pallas = timed("pallas")
-        _log("pallas done: %.3f steps/s" % pallas)
-    except Exception as e:
-        print("pallas path failed, using base: %r" % e, file=sys.stderr)
-        pallas = 0.0
-    return max(base, pallas)
+    best = timed("")
+    _log("base done: %.3f steps/s" % best)
+    for lib in extra_libs:
+        if _over_budget():
+            _log("time budget exceeded — skipping %r" % lib)
+            break
+        try:
+            _log("timing library %r" % lib)
+            sps = timed(lib)
+            _log("%r done: %.3f steps/s" % (lib, sps))
+            best = max(best, sps)
+        except Exception as e:
+            print("library %r failed, ignoring: %r" % (lib, e),
+                  file=sys.stderr)
+    return best
 
 
 # ---------------------------------------------------------------------------
@@ -170,7 +175,10 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
 
     run = lambda: exe.run(main, feed=feed, fetch_list=[avg_cost],
                           return_numpy=False)
-    sps = (_best_library(run, warmup, iters) if compare_libs
+    # curated mix: kernels measured to win at the flagship shape
+    mix = "fused_linear_xent:pallas"
+    sps = (_best_library(run, warmup, iters,
+                         extra_libs=("pallas", mix)) if compare_libs
            else _timed_loop(run, warmup, iters))
     return {
         "metric": "transformer_base_train_throughput",
@@ -319,6 +327,45 @@ def bench_deepfm(batch=4096, warmup=3, iters=20):
             "mfu": None}
 
 
+_EMITTED = []
+
+
+def _emit(headline):
+    if not _EMITTED:
+        _EMITTED.append(True)
+        print(json.dumps(headline), flush=True)
+
+
+def _arm_watchdog(headline):
+    """The axon tunnel can HANG (not fail) inside the first device
+    claim — observed r2/r3: jax.devices() blocks indefinitely, so no
+    except-clause can save the run. A daemon timer guarantees the
+    one-line JSON contract: if the bench is still alive past its
+    budget plus grace, emit the degraded line and hard-exit 0."""
+    import threading
+
+    def fire():
+        if _EMITTED:
+            # headline already out; record that the --all extras were
+            # cut short instead of silently truncating them
+            print(json.dumps(
+                {"metric": "bench_watchdog",
+                 "error": "watchdog: run exceeded %.0fs budget after "
+                 "the headline line; remaining benches skipped"
+                 % _BUDGET_S}), flush=True)
+            os._exit(0)
+        headline.setdefault(
+            "error", "watchdog: bench exceeded %.0fs budget (backend "
+            "hang?)" % _BUDGET_S)
+        _emit(headline)
+        os._exit(0)
+
+    t = threading.Timer(_BUDGET_S + 120.0, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def _claim_device_with_retry():
     """Initialize the JAX backend, retrying with backoff.
 
@@ -360,6 +407,7 @@ def main():
     headline = {"metric": "transformer_base_train_throughput",
                 "value": None, "unit": "tokens/sec/chip",
                 "vs_baseline": None, "mfu": None}
+    _arm_watchdog(headline)
     smoke = False
     try:
         backend = None
@@ -397,7 +445,7 @@ def main():
         err = _claim_device_with_retry()
         if err is not None:
             headline["error"] = "backend unavailable: %r" % err
-            print(json.dumps(headline), flush=True)
+            _emit(headline)
             return
         # One transient mid-run failure (tunnel hiccup, remote compile
         # 500) gets one fresh attempt before we report a degraded line.
@@ -421,7 +469,7 @@ def main():
     # placeholder. Unknown device (CPU smoke runs) -> null.
     headline["vs_baseline"] = (round(mfu / 0.40, 3) if mfu is not None
                                else None)
-    print(json.dumps(headline), flush=True)
+    _emit(headline)
     if "--all" in sys.argv:
         extra = [bench_mnist_mlp, bench_resnet50, bench_bert,
                  bench_deepfm]
