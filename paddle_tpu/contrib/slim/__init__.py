@@ -1,7 +1,12 @@
 """slim: model compression (reference: fluid/contrib/slim/ — 15.2k LoC
-of quantization / pruning / distillation / NAS). This build ships the
-quantization-aware-training core (the TPU-relevant piece: int8
-inference); pruning/distillation/NAS express naturally as user-level
-program rewrites on this substrate.
+of quantization / pruning / distillation / NAS re-expressed over the
+TPU substrate: masks and shrinks are host-side scope surgery between
+fused XLA steps, quantization is QDQ ops the compiler folds, and the
+teacher+student distillation program still traces to ONE device
+launch).
 """
+from . import core  # noqa: F401
+from . import distillation  # noqa: F401
+from . import nas  # noqa: F401
+from . import prune  # noqa: F401
 from . import quantization  # noqa: F401

@@ -1,0 +1,4 @@
+"""Compression orchestration (reference: contrib/slim/core/)."""
+
+from .compressor import Compressor, Context  # noqa: F401
+from .strategy import Strategy  # noqa: F401

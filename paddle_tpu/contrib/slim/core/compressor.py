@@ -1,0 +1,84 @@
+"""Compressor: the train-loop host that drives compression strategies.
+
+Reference: contrib/slim/core/compressor.py (Context:40, Compressor:192
+— owns the epoch loop, invokes each strategy's lifecycle callbacks,
+periodically evaluates and checkpoints). TPU-native: the step itself is
+the executor's single fused XLA program; strategies do host-side scope
+surgery between steps (masks, shrinks, loss rebuilds), which never
+perturbs the compiled step until a program mutation bumps the version.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....core.enforce import enforce
+
+__all__ = ["Context", "Compressor"]
+
+
+class Context:
+    """What strategies see (reference: compressor.py:40)."""
+
+    def __init__(self, program, scope, exe, loss=None,
+                 fetch_list=None):
+        # strategies may SWAP program/fetch_list for a phase
+        # (DistillationStrategy); the loop reads them every step
+        self.program = program
+        self.fetch_list = list(fetch_list or ([loss] if loss else []))
+        self.scope = scope
+        self.exe = exe
+        self.loss = loss
+        self.epoch = 0
+        self.step = 0
+        self.last_loss = None
+        self.eval_results = {}
+
+
+class Compressor:
+    def __init__(self, scope, exe, train_program, train_reader,
+                 train_fetch_list=None, eval_fn=None, epochs=1,
+                 strategies=(), checkpoint_fn=None):
+        """``train_reader``: callable -> iterable of feed dicts per
+        epoch. ``eval_fn(context)``: optional end-of-epoch metric.
+        ``checkpoint_fn(context)``: optional end-of-epoch hook."""
+        self.scope = scope
+        self.exe = exe
+        self.program = train_program
+        self.reader = train_reader
+        self.fetch_list = train_fetch_list or []
+        self.eval_fn = eval_fn
+        self.epochs = epochs
+        self.strategies = list(strategies)
+        self.checkpoint_fn = checkpoint_fn
+
+    def run(self):
+        from .... import executor as _  # noqa: F401 (import check)
+        ctx = Context(self.program, self.scope, self.exe,
+                      loss=self.fetch_list[0] if self.fetch_list
+                      else None, fetch_list=self.fetch_list)
+        for s in self.strategies:
+            s.on_compression_begin(ctx)
+        for epoch in range(self.epochs):
+            ctx.epoch = epoch
+            for s in self.strategies:
+                s.on_epoch_begin(ctx)
+            for feed in self.reader():
+                outs = self.exe.run(ctx.program, feed=feed,
+                                    fetch_list=ctx.fetch_list)
+                if outs:
+                    ctx.last_loss = float(
+                        np.asarray(outs[0]).reshape(-1)[0])
+                ctx.step += 1
+                for s in self.strategies:
+                    s.on_batch_end(ctx)
+            if self.eval_fn is not None:
+                ctx.eval_results.setdefault("metric", []).append(
+                    float(self.eval_fn(ctx)))
+            for s in self.strategies:
+                s.on_epoch_end(ctx)
+            if self.checkpoint_fn is not None:
+                self.checkpoint_fn(ctx)
+        for s in self.strategies:
+            s.on_compression_end(ctx)
+        return ctx

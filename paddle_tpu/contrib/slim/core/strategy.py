@@ -1,0 +1,27 @@
+"""Strategy base (reference: contrib/slim/core/strategy.py:20 — the
+five lifecycle callbacks every compression strategy implements)."""
+
+from __future__ import annotations
+
+__all__ = ["Strategy"]
+
+
+class Strategy:
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass

@@ -1,0 +1,5 @@
+"""Knowledge distillation (reference: contrib/slim/distillation/)."""
+
+from .distiller import (merge, L2Distiller, FSPDistiller,  # noqa: F401
+                        SoftLabelDistiller)
+from .distillation_strategy import DistillationStrategy  # noqa: F401

@@ -1,0 +1,86 @@
+"""Distillation as a Compressor strategy.
+
+Reference: contrib/slim/distillation/distillation_strategy.py — at
+``start_epoch`` the strategy swaps the training graph for one whose
+loss adds the distillers' losses; at ``end_epoch`` it restores the
+original. TPU-native: the swap is a Program swap on the Compressor
+context (the executor re-traces the distillation program into its own
+fused XLA computation on first use; both programs share the scope, so
+parameters flow between phases for free).
+
+Wiring: build the distillation-phase program up front —
+``build_loss`` appends the distiller losses to the (merged
+teacher+student) program, minimize the combined loss with a fresh
+optimizer — then hand it to the strategy::
+
+    total = strategy.build_loss(merged_program, student_loss)
+    with program_guard(merged_program):
+        optimizer.minimize(total)
+    strategy.setup(merged_program, fetch_list=[total])
+    Compressor(..., strategies=[strategy]).run()
+"""
+
+from __future__ import annotations
+
+from .... import framework, layers
+from ....core.enforce import enforce
+
+__all__ = ["DistillationStrategy"]
+
+
+class DistillationStrategy:
+    def __init__(self, distillers=(), start_epoch=0, end_epoch=10):
+        self.distillers = list(distillers)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self._program = None
+        self._fetch = None
+        self._saved = None
+
+    def build_loss(self, program, student_loss=None):
+        """Append every distiller's loss to ``program`` and return the
+        combined training loss (student loss + sum of distill
+        losses)."""
+        with framework.program_guard(program):
+            total = None
+            for d in self.distillers:
+                l = d.distiller_loss(program)
+                total = l if total is None else \
+                    layers.elementwise_add(total, l)
+            if student_loss is not None:
+                total = layers.elementwise_add(total, student_loss)
+        return total
+
+    def setup(self, program, fetch_list=None):
+        """Register the distillation-phase program (built via
+        ``build_loss`` + an optimizer over the combined loss)."""
+        self._program = program
+        self._fetch = fetch_list
+
+    # -- Compressor strategy protocol ---------------------------------
+    def on_epoch_begin(self, context):
+        if context.epoch == self.start_epoch:
+            enforce(self._program is not None,
+                    "DistillationStrategy.setup(program) must be "
+                    "called before compression")
+            self._saved = (context.program, context.fetch_list)
+            context.program = self._program
+            if self._fetch is not None:
+                context.fetch_list = self._fetch
+
+    def on_epoch_end(self, context):
+        if context.epoch + 1 == self.end_epoch and \
+                self._saved is not None:
+            context.program, context.fetch_list = self._saved
+            self._saved = None
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        if self._saved is not None:
+            context.program, context.fetch_list = self._saved
+            self._saved = None
+
+    def on_batch_end(self, context):
+        pass

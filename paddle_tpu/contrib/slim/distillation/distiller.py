@@ -1,0 +1,140 @@
+"""Knowledge-distillation loss builders.
+
+Reference: contrib/slim/distillation/distiller.py (L2Distiller:25,
+FSPDistiller:101, SoftLabelDistiller:191 — each appends its loss ops to
+the merged teacher+student program) and core/compressor.py's graph
+merge. TPU-native: ``merge`` clones the teacher program's ops/vars into
+the student program under a name prefix with gradients stopped — the
+combined program still traces into ONE XLA computation, so teacher and
+student share a single device launch per step (the reference pays two
+executor runs or an in-graph merge with per-op kernels).
+"""
+
+from __future__ import annotations
+
+from .... import layers
+from ....core.enforce import enforce
+
+__all__ = ["merge", "L2Distiller", "FSPDistiller",
+           "SoftLabelDistiller"]
+
+
+def merge(teacher_program, student_program, data_vars=None,
+          name_prefix="teacher_", scope=None, teacher_scope=None):
+    """Clone the teacher's global-block vars/ops into the student
+    program, renaming every non-data var with ``name_prefix``; feed
+    (data) vars are shared by name so one feed drives both nets.
+    Teacher vars are marked stop_gradient (the reference freezes the
+    teacher the same way). When ``scope`` is given, teacher parameter
+    VALUES are copied under the prefixed names (from ``teacher_scope``
+    when the teacher was trained in a separate scope) so the merged
+    program runs without manual re-initialization. Returns
+    {teacher_var: merged_name}.
+    """
+    tb = teacher_program.global_block()
+    sb = student_program.global_block()
+    data_vars = set(data_vars or
+                    [n for n, v in tb.vars.items() if v.is_data])
+    mapping = {}
+    for name, var in tb.vars.items():
+        if name in data_vars:
+            enforce(sb.has_var(name),
+                    "shared data var %r missing from the student "
+                    "program" % name)
+            mapping[name] = name
+            continue
+        new = name_prefix + name
+        mapping[name] = new
+        if sb.has_var(new):
+            continue
+        nv = sb.create_var(name=new, shape=var.shape, dtype=var.dtype,
+                           persistable=var.persistable,
+                           stop_gradient=True)
+        if hasattr(var, "trainable"):
+            nv.trainable = False
+    for op in tb.ops:
+        sb.append_op(
+            type=op.type,
+            inputs={k: [mapping.get(n, n) for n in v]
+                    for k, v in op.inputs.items()},
+            outputs={k: [mapping.get(n, n) for n in v]
+                     for k, v in op.outputs.items()},
+            attrs=dict(op.attrs))
+    if scope is not None:
+        src = teacher_scope or scope
+        for name, var in tb.vars.items():
+            if name in data_vars or not var.persistable:
+                continue
+            if src.has_var(name):
+                scope.set_var(mapping[name], src.get(name))
+    student_program._bump()
+    return mapping
+
+
+class L2Distiller:
+    """MSE between a student and a teacher feature map (reference:
+    distiller.py:25)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.s = student_feature_map
+        self.t = teacher_feature_map
+        self.w = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        s, t = block.var(self.s), block.var(self.t)
+        loss = layers.reduce_mean(
+            layers.square_error_cost(s, t))
+        return layers.scale(loss, scale=self.w)
+
+
+class FSPDistiller:
+    """Flow-of-solution-procedure loss (reference: distiller.py:101):
+    MSE between teacher and student FSP matrices over layer pairs."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        enforce(len(student_pairs) == len(teacher_pairs),
+                "pair lists must align")
+        self.s_pairs = student_pairs
+        self.t_pairs = teacher_pairs
+        self.w = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        losses = []
+        for (s0, s1), (t0, t1) in zip(self.s_pairs, self.t_pairs):
+            sm = layers.fsp_matrix(block.var(s0), block.var(s1))
+            tm = layers.fsp_matrix(block.var(t0), block.var(t1))
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(sm, tm))))
+        total = losses[0]
+        for l in losses[1:]:
+            total = layers.elementwise_add(total, l)
+        return layers.scale(total, scale=self.w)
+
+
+class SoftLabelDistiller:
+    """Soft-label (temperature-scaled) cross-entropy between teacher
+    and student logits (reference: distiller.py:191)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.s = student_feature_map
+        self.t = teacher_feature_map
+        self.st = student_temperature
+        self.tt = teacher_temperature
+        self.w = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        s = layers.scale(block.var(self.s), scale=1.0 / self.st)
+        t = layers.scale(block.var(self.t), scale=1.0 / self.tt)
+        t_soft = layers.softmax(t)
+        t_soft.stop_gradient = True
+        ce = layers.softmax_with_cross_entropy(s, t_soft,
+                                               soft_label=True)
+        loss = layers.reduce_mean(ce)
+        return layers.scale(loss, scale=self.w)

@@ -1,0 +1,62 @@
+"""Light NAS: SA-driven architecture search loop.
+
+Reference: contrib/slim/nas/light_nas_strategy.py (LightNASStrategy —
+sample tokens from the controller, build + short-train the candidate,
+reward = accuracy (optionally latency-constrained), feed back). The
+reference distributes search over a controller server + agents
+(controller_server.py/search_agent.py); on TPU one host drives the
+loop and each candidate is a freshly traced XLA program, so no server
+is needed — the distributed variant composes with parallel.multihost
+if ever required.
+"""
+
+from __future__ import annotations
+
+from ....core.enforce import enforce
+from .sa_controller import SAController
+
+__all__ = ["LightNASStrategy"]
+
+
+class LightNASStrategy:
+    def __init__(self, search_space, reward_fn, search_steps=20,
+                 controller=None, target_latency=None,
+                 latency_fn=None, latency_weight=0.0):
+        """``reward_fn(tokens) -> float`` trains/evaluates one
+        candidate (use search_space.create_net inside). An optional
+        latency model penalizes candidates over ``target_latency``:
+        reward *= (target/latency) ** latency_weight."""
+        self.space = search_space
+        self.reward_fn = reward_fn
+        self.search_steps = search_steps
+        self.controller = controller or SAController(
+            search_space.range_table())
+        self.target_latency = target_latency
+        self.latency_fn = latency_fn
+        self.latency_weight = latency_weight
+        self.history = []
+
+    def _reward(self, tokens):
+        r = float(self.reward_fn(tokens))
+        if self.target_latency is not None and \
+                self.latency_fn is not None:
+            lat = float(self.latency_fn(tokens))
+            if lat > 0:
+                r *= min(1.0, self.target_latency / lat) \
+                    ** self.latency_weight
+        return r
+
+    def search(self):
+        """Run the SA loop; returns (best_tokens, best_reward)."""
+        tokens = self.space.init_tokens()
+        reward = self._reward(tokens)
+        self.controller.update(tokens, reward)
+        self.history.append((list(tokens), reward))
+        for _ in range(self.search_steps - 1):
+            cand = self.controller.next_tokens()
+            reward = self._reward(cand)
+            self.controller.update(cand, reward)
+            self.history.append((list(cand), reward))
+        enforce(self.controller.best_tokens is not None,
+                "search produced no candidates")
+        return self.controller.best_tokens, self.controller.max_reward

@@ -1,0 +1,65 @@
+"""Simulated-annealing token controller.
+
+Reference: contrib/slim/searcher/controller.py (SAController: mutate a
+random token dimension, accept worse rewards with prob
+exp(delta / (T0 * r^iter))). Deterministic under a seed; pure host
+code — the controller never touches the device.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["SAController"]
+
+
+class SAController:
+    def __init__(self, range_table, reduce_rate=0.85, init_temperature=1024.0,
+                 max_iter_number=300, seed=0):
+        self.range_table = list(range_table)
+        self.reduce_rate = reduce_rate
+        self.init_temperature = init_temperature
+        self.max_iter_number = max_iter_number
+        self._rs = np.random.RandomState(seed)
+        self._iter = 0
+        self._best_tokens = None
+        self._best_reward = -float("inf")
+        self._cur_tokens = None
+        self._cur_reward = -float("inf")
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._best_reward
+
+    def next_tokens(self, tokens=None):
+        """Mutate one dimension of ``tokens`` (default: current)."""
+        base = list(tokens if tokens is not None
+                    else (self._cur_tokens or
+                          [0] * len(self.range_table)))
+        d = int(self._rs.randint(len(base)))
+        base[d] = int(self._rs.randint(self.range_table[d]))
+        return base
+
+    def update(self, tokens, reward):
+        """Accept/reject ``tokens`` with annealed Metropolis rule;
+        returns True when accepted (reference: controller.py SA
+        update)."""
+        self._iter += 1
+        temperature = self.init_temperature * \
+            self.reduce_rate ** self._iter
+        if reward > self._best_reward:
+            self._best_reward = reward
+            self._best_tokens = list(tokens)
+        delta = reward - self._cur_reward
+        if delta > 0 or self._rs.rand() < math.exp(
+                min(delta / max(temperature, 1e-9), 0.0)):
+            self._cur_tokens = list(tokens)
+            self._cur_reward = reward
+            return True
+        return False

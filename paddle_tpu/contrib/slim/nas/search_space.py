@@ -1,0 +1,22 @@
+"""Search-space contract (reference: contrib/slim/nas/search_space.py:
+SearchSpaceBase — init_tokens / range_table / create_net)."""
+
+from __future__ import annotations
+
+__all__ = ["SearchSpace"]
+
+
+class SearchSpace:
+    """Subclass and implement the three methods; tokens are an integer
+    vector, dimension d ranges over [0, range_table()[d])."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        """Build (train_program, startup_program, eval_fn) — or
+        whatever the strategy's reward_fn consumes — for ``tokens``."""
+        raise NotImplementedError

@@ -1,0 +1,6 @@
+"""Model pruning (reference: contrib/slim/prune/)."""
+
+from .pruner import Pruner, MagnitudePruner, StructurePruner  # noqa: F401
+from .prune_strategy import (PruneStrategy,  # noqa: F401
+                             UniformPruneStrategy, prune_structured,
+                             sensitivity)

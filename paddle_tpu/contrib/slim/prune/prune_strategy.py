@@ -1,0 +1,280 @@
+"""Pruning strategies over a Program + Scope.
+
+Reference: contrib/slim/prune/prune_strategy.py (SensitivePruneStrategy
+/ UniformPruneStrategy, 958 LoC of graph surgery + greedy sensitivity
+search) and auto_prune_strategy.py. TPU-native redesign:
+
+- **Unstructured** (``PruneStrategy``): parameter shapes stay static —
+  the strategy keeps {0,1} masks host-side and re-applies them to the
+  scope between steps, so the compiled XLA step program is untouched
+  (re-masking is one elementwise multiply per param, amortized over
+  ``mask_frequency`` steps).
+- **Structured** (``prune_structured``): physically shrinks parameters
+  host-side and rewrites the metadata-only Program's shapes, then bumps
+  the program version so the executor re-traces — recompiling is the
+  normal, cheap path here (no C++ graph surgery needed).
+- **Sensitivity analysis** (``sensitivity``): the greedy per-param
+  loss-vs-ratio scan of SensitivePruneStrategy._compute_sensitivities.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....core.enforce import UnimplementedError
+from .pruner import MagnitudePruner, StructurePruner
+
+__all__ = ["PruneStrategy", "UniformPruneStrategy", "prune_structured",
+           "sensitivity"]
+
+
+class PruneStrategy:
+    """Magnitude (unstructured) pruning via persistent masks.
+
+    ``ratios``: {param_name: ratio} or a float applied to every
+    trainable parameter matching ``params`` (None = all weights with
+    ndim >= 2). Masks are computed once at ``start_step`` and
+    re-applied every ``mask_frequency`` steps so optimizer updates
+    cannot resurrect pruned weights.
+    """
+
+    def __init__(self, ratios, params=None, start_step=0,
+                 mask_frequency=1, pruner=None):
+        self.ratios = ratios
+        self.params = params
+        self.start_step = start_step
+        self.mask_frequency = max(1, int(mask_frequency))
+        self.pruner = pruner or MagnitudePruner()
+        self._masks = {}
+        self._step = 0
+
+    def _target_params(self, program):
+        for p in program.global_block().all_parameters():
+            if not p.trainable or len(p.shape) < 2:
+                continue
+            if self.params is not None and p.name not in self.params:
+                continue
+            if isinstance(self.ratios, dict) and \
+                    p.name not in self.ratios:
+                continue
+            yield p
+
+    def _ratio(self, name):
+        if isinstance(self.ratios, dict):
+            return float(self.ratios[name])
+        return float(self.ratios)
+
+    def compute_masks(self, program, scope):
+        for p in self._target_params(program):
+            value = np.asarray(scope.get(p.name))
+            self._masks[p.name] = self.pruner.mask(
+                value, self._ratio(p.name))
+        return self._masks
+
+    def apply_masks(self, scope):
+        import jax.numpy as jnp
+        for name, mask in self._masks.items():
+            scope.set_var(name, jnp.asarray(
+                np.asarray(scope.get(name)) * mask))
+
+    def sparsity(self, scope):
+        """Measured fraction of zeros over the managed params."""
+        total = zeros = 0
+        for name in self._masks:
+            v = np.asarray(scope.get(name))
+            total += v.size
+            zeros += int((v == 0).sum())
+        return zeros / max(total, 1)
+
+    # -- Compressor strategy protocol (reference: core/strategy.py) ----
+    def on_compression_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        self._step += 1
+        if self._step == self.start_step + 1:
+            self.compute_masks(context.program, context.scope)
+        if self._masks and (self._step - self.start_step) \
+                % self.mask_frequency == 0:
+            self.apply_masks(context.scope)
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        if self._masks:
+            self.apply_masks(context.scope)
+
+    def on_compression_end(self, context):
+        if not self._masks:
+            self.compute_masks(context.program, context.scope)
+        self.apply_masks(context.scope)
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """One global ratio for every eligible parameter (reference:
+    prune_strategy.py UniformPruneStrategy)."""
+
+    def __init__(self, ratio, params=None, **kw):
+        super().__init__(float(ratio), params=params, **kw)
+
+
+# ---------------------------------------------------------------------------
+# structured pruning with shape propagation
+# ---------------------------------------------------------------------------
+
+# ops through which a pruned channel axis flows unchanged
+_PASSTHROUGH = {"relu", "sigmoid", "tanh", "gelu", "dropout", "scale",
+                "pool2d", "adaptive_pool2d", "leaky_relu", "relu6"}
+
+
+def prune_structured(program, startup_program, scope, ratios,
+                     pruner=None):
+    """Physically prune output channels/columns of the given params and
+    propagate the shrink through consumers (reference:
+    prune_strategy.py _prune_parameter_by_ratio + _prune_graph).
+
+    ``ratios``: {param_name: ratio}. Conv filters prune axis 0
+    (output channels), fc/mul weights prune axis 1 (output features).
+    Supported consumer chain: elementwise_add bias, batch_norm,
+    activations/pooling, the next conv2d/mul. Returns
+    {param_name: pruned_idx}.
+    """
+    pruner = pruner or StructurePruner()
+    block = program.global_block()
+    pruned = {}
+
+    def resize(name, new_value, startup_too=True):
+        scope.set_var(name, _dev(new_value))
+        v = block._find_var_recursive(name)
+        if v is not None:
+            v.shape = tuple(new_value.shape)
+        if startup_too and startup_program is not None:
+            sb = startup_program.global_block()
+            if sb.has_var(name):
+                sb.var(name).shape = tuple(new_value.shape)
+
+    def _dev(v):
+        import jax.numpy as jnp
+        return jnp.asarray(v)
+
+    for pname, ratio in ratios.items():
+        value = np.asarray(scope.get(pname))
+        axis = 0 if value.ndim == 4 else 1
+        idx = pruner.cal_pruned_idx(pname, value, float(ratio),
+                                    axis=axis)
+        pruned[pname] = idx
+        resize(pname, pruner.prune_tensor(value, idx, axis))
+
+        # producer op and its output var start the propagation; the
+        # channel axis of the output: conv NCHW -> 1, mul/fc -> last
+        for op in block.ops:
+            if pname not in op.input_arg_names:
+                continue
+            if op.type == "conv2d":
+                out = op.outputs["Output"][0]
+                _propagate(block, scope, resize, pruner, out, 1, idx)
+            elif op.type in ("mul", "matmul"):
+                out = op.outputs["Out"][0]
+                ov = block._find_var_recursive(out)
+                _propagate(block, scope, resize, pruner, out,
+                           len(ov.shape) - 1, idx)
+    program._bump()
+    if startup_program is not None:
+        startup_program._bump()
+    return pruned
+
+
+def _propagate(block, scope, resize, pruner, var_name, axis, idx):
+    """Walk consumers of ``var_name`` whose channel ``axis`` lost the
+    groups at ``idx``; shrink their parameters accordingly."""
+    for op in block.ops:
+        if var_name not in op.input_arg_names:
+            continue
+        if op.type in ("vjp", "vjp2") or \
+                op.attrs.get("op_role") in ("backward", "optimize"):
+            # gradient/update ops re-derive every shape from the
+            # forward lowerings at trace time — nothing to rewrite
+            # (optimizer state in the scope is NOT resized: prune
+            # before minimize, or re-run startup for fresh moments)
+            continue
+        if op.type == "elementwise_add":
+            other = [n for n in op.input_arg_names if n != var_name]
+            bias_like = False
+            if other:
+                if scope.has_var(other[0]):
+                    b = np.asarray(scope.get(other[0]))
+                    bias_like = b.ndim == 1
+                    if bias_like:
+                        resize(other[0],
+                               pruner.prune_tensor(b, idx, 0))
+                else:
+                    ov = block._find_var_recursive(other[0])
+                    bias_like = ov is not None and len(ov.shape) <= 1
+            if other and not bias_like:
+                # residual add: the skip branch still carries the
+                # pruned channels — refuse here instead of failing
+                # later at re-trace with an opaque XLA shape mismatch
+                raise UnimplementedError(
+                    "structured pruning cannot shrink through a "
+                    "residual elementwise_add (%r + %r)"
+                    % (var_name, other[0]))
+            _propagate(block, scope, resize, pruner,
+                       op.outputs["Out"][0], axis, idx)
+        elif op.type == "batch_norm":
+            for slot in ("Scale", "Bias", "Mean", "Variance"):
+                names = op.inputs.get(slot, [])
+                if names and scope.has_var(names[0]):
+                    resize(names[0], pruner.prune_tensor(
+                        np.asarray(scope.get(names[0])), idx, 0))
+            _propagate(block, scope, resize, pruner,
+                       op.outputs["Y"][0], axis, idx)
+        elif op.type == "conv2d":
+            f = op.inputs["Filter"][0]
+            resize(f, pruner.prune_tensor(
+                np.asarray(scope.get(f)), idx, 1))
+        elif op.type in ("mul", "matmul"):
+            w = op.inputs["Y"][0]
+            if scope.has_var(w):
+                resize(w, pruner.prune_tensor(
+                    np.asarray(scope.get(w)), idx, 0))
+        elif op.type in _PASSTHROUGH:
+            outs = [n for ns in op.outputs.values() for n in ns]
+            if outs:
+                _propagate(block, scope, resize, pruner, outs[0],
+                           axis, idx)
+        else:
+            raise UnimplementedError(
+                "structured pruning cannot propagate through op %r "
+                "(consumer of %r)" % (op.type, var_name))
+
+
+def sensitivity(program, scope, exe, eval_fn, ratios=(0.1, 0.3, 0.5),
+                params=None, pruner=None):
+    """Per-parameter loss sensitivity scan (reference:
+    prune_strategy.py SensitivePruneStrategy._compute_sensitivities):
+    for each param and ratio, mask, evaluate, restore. ``eval_fn()``
+    returns a scalar metric (higher = better). Returns
+    {param: {ratio: metric_loss_fraction}}."""
+    pruner = pruner or MagnitudePruner()
+    base = float(eval_fn())
+    out = {}
+    for p in program.global_block().all_parameters():
+        if len(p.shape) < 2 or (params is not None
+                                and p.name not in params):
+            continue
+        saved = np.asarray(scope.get(p.name))
+        out[p.name] = {}
+        for r in ratios:
+            mask = pruner.mask(saved, r)
+            import jax.numpy as jnp
+            scope.set_var(p.name, jnp.asarray(saved * mask))
+            m = float(eval_fn())
+            out[p.name][float(r)] = (base - m) / (abs(base) + 1e-12)
+        scope.set_var(p.name, _to_dev(saved))
+    return out
+
+
+def _to_dev(v):
+    import jax.numpy as jnp
+    return jnp.asarray(v)

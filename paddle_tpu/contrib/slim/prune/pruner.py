@@ -1,0 +1,85 @@
+"""Pruners: compute pruning decisions from parameter values.
+
+Reference: contrib/slim/prune/pruner.py (Pruner, StructurePruner:
+cal_pruned_idx/prune_tensor via l1_norm group sorting). TPU-native
+notes: unstructured (magnitude) pruning keeps parameter shapes static —
+masks are persistable vars the strategy re-applies between steps, so
+the compiled XLA program never changes; structured pruning physically
+shrinks tensors host-side and rebuilds the (metadata-only) program,
+which re-traces into a new XLA program — cheap by design here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Pruner", "MagnitudePruner", "StructurePruner"]
+
+
+class Pruner:
+    """Base class of all pruners (reference: pruner.py:22)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured |w| pruning: zero the smallest-magnitude fraction.
+
+    Returns a {0,1} mask of the parameter's shape. The reference's
+    SensitivePruneStrategy applies ratio-driven masks the same way."""
+
+    def mask(self, value, ratio):
+        v = np.asarray(value)
+        k = int(round(v.size * ratio))
+        if k <= 0:
+            return np.ones_like(v, dtype=v.dtype)
+        thresh = np.partition(np.abs(v).ravel(), k - 1)[k - 1]
+        return (np.abs(v) > thresh).astype(v.dtype)
+
+
+class StructurePruner(Pruner):
+    """Group (channel/row) pruning (reference: pruner.py:33).
+
+    ``pruning_axis``/``criterions``: dicts keyed by parameter name,
+    '*' as the wildcard default. Criterion: 'l1_norm' or 'l2_norm'.
+    """
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _lookup(self, table, name):
+        return table[name] if name in table else table["*"]
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the groups to prune along ``axis`` (reference:
+        pruner.py:55 — sort group norms ascending, take the first
+        ``round(ratio * n)``)."""
+        v = np.asarray(param)
+        if axis is None:
+            axis = self._lookup(self.pruning_axis, name)
+        criterion = self._lookup(self.criterions, name)
+        prune_num = int(round(v.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(v.ndim) if i != axis)
+        if criterion == "l1_norm":
+            norms = np.sum(np.abs(v), axis=reduce_dims)
+        elif criterion == "l2_norm":
+            norms = np.sqrt(np.sum(v * v, axis=reduce_dims))
+        else:
+            raise ValueError("unknown criterion %r" % criterion)
+        return norms.argsort()[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis,
+                     lazy=False):
+        """Physically remove (or, with ``lazy``, zero) the groups at
+        ``pruned_idx`` along ``pruned_axis`` (reference: pruner.py:82).
+        """
+        v = np.asarray(tensor)
+        if lazy:
+            out = v.copy()
+            idx = [slice(None)] * v.ndim
+            idx[pruned_axis] = pruned_idx
+            out[tuple(idx)] = 0.0
+            return out
+        return np.delete(v, pruned_idx, axis=pruned_axis)

@@ -2,3 +2,4 @@ from .quantization_pass import (AddQuantDequantPass,  # noqa: F401
                                 ConvertToInt8Pass,
                                 QuantizationFreezePass,
                                 QuantizationTransformPass)
+from .calibration import Calibrator  # noqa: F401

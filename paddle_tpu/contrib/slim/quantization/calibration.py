@@ -1,0 +1,156 @@
+"""Post-training int8 calibration (no retraining).
+
+Reference: contrib/int8_inference/README.md + the Calibrator that
+collects FP32 activation statistics and picks per-tensor scales by the
+KL-divergence method, then emits an int8 inference program. TPU-native
+flow: statistics are fetched from the ordinary traced program (any var
+is fetchable — no special stat ops needed); the calibrated program
+reuses the QAT passes with frozen scales, so the export path (freeze →
+int8 weights) is shared with quantization-aware training.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ....core.enforce import enforce
+from . import quantization_pass as qp
+
+__all__ = ["Calibrator"]
+
+
+class Calibrator:
+    def __init__(self, program, scope, algo="KL", quantizable_ops=None,
+                 bins=2048, activation_bits=8):
+        enforce(algo in ("KL", "abs_max"), "unknown algo %r" % algo)
+        self.program = program
+        self.scope = scope
+        self.algo = algo
+        self.bins = bins
+        self.abits = activation_bits
+        self._ops = tuple(quantizable_ops or qp.QUANTIZABLE_OPS)
+        self._absmax = {}
+        self._hists = {}
+        self._targets = self._find_activations()
+
+    def _find_activations(self):
+        """Input activations of quantizable forward ops."""
+        names = []
+        block = self.program.global_block()
+        for op in block.ops:
+            if op.type not in self._ops or \
+                    op.attrs.get("op_role") in ("backward", "optimize"):
+                continue
+            for slot, ns in op.inputs.items():
+                for n in ns:
+                    v = block._find_var_recursive(n)
+                    if v is None or v.persistable or \
+                            v.dtype not in ("float32", "bfloat16"):
+                        continue
+                    # non-persistable weight-slot inputs (activation x
+                    # activation matmuls) get activation QDQ ops from
+                    # the transform pass, so they need scales too
+                    if n not in names:
+                        names.append(n)
+        return names
+
+    def sample(self, exe, feed):
+        """Run one calibration batch and fold its activations into the
+        statistics."""
+        vals = exe.run(self.program, feed=feed,
+                       fetch_list=list(self._targets))
+        for name, v in zip(self._targets, vals):
+            a = np.abs(np.asarray(v, np.float32)).ravel()
+            mx = float(a.max()) if a.size else 0.0
+            self._absmax[name] = max(self._absmax.get(name, 0.0), mx)
+            if self.algo == "KL" and mx > 0:
+                hist, _ = np.histogram(
+                    a, bins=self.bins,
+                    range=(0.0, self._absmax[name]))
+                prev = self._hists.get(name)
+                if prev is not None and prev[1] < self._absmax[name]:
+                    # re-bin the old histogram onto the wider range
+                    scalef = prev[1] / self._absmax[name]
+                    idx = (np.arange(self.bins) * scalef).astype(int)
+                    re = np.zeros(self.bins)
+                    np.add.at(re, idx, prev[0])
+                    prev = (re, self._absmax[name])
+                if prev is None:
+                    self._hists[name] = (hist.astype(np.float64),
+                                         self._absmax[name])
+                else:
+                    self._hists[name] = (prev[0] + hist,
+                                         self._absmax[name])
+
+    def scales(self):
+        """Per-activation calibrated scale."""
+        out = {}
+        for n in self._targets:
+            if self.algo == "abs_max" or n not in self._hists:
+                out[n] = self._absmax.get(n, 1.0)
+            else:
+                hist, mx = self._hists[n]
+                out[n] = _kl_threshold(hist, mx,
+                                       2 ** (self.abits - 1) - 1)
+        return out
+
+    def quantize(self, test_program, startup_program=None):
+        """Emit a calibrated quantized inference program: insert
+        fixed-scale QDQ ops (moving-average form at is_test) and write
+        the calibrated scales into the scope; compose with
+        QuantizationFreezePass/ConvertToInt8Pass for int8 export."""
+        import jax.numpy as jnp
+        tp = qp.QuantizationTransformPass(
+            activation_quantize_type="moving_average_abs_max",
+            activation_bits=self.abits, quantizable_ops=self._ops)
+        tp.apply(test_program, startup_program, is_test=True)
+        for name, scale in self.scales().items():
+            self.scope.set_var(name + ".quant_scale@state",
+                               jnp.float32(scale))
+        return test_program
+
+
+def _kl_threshold(hist, abs_max, quant_levels):
+    """NVIDIA-style KL threshold search: pick the clip threshold whose
+    quantized distribution diverges least from the observed one."""
+    nbins = len(hist)
+    # the first bin is dominated by exact zeros (ReLU outputs, padding)
+    # which int8 represents losslessly — keeping the spike would let
+    # KL rationalize clipping the informative tail
+    hist = hist.copy()
+    hist[0] = 0
+    total = hist.sum()
+    if total <= 0:
+        return abs_max
+    best_kl, best_i = np.inf, nbins
+    start = max(quant_levels, nbins // 16)
+    for i in range(start, nbins + 1, max(1, nbins // 256)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()          # clip outliers in
+        if p.sum() <= 0:
+            continue
+        # candidate Q: quantize the UNfolded in-range histogram down to
+        # quant_levels and expand back — Q misses the clipped tail mass
+        # that P folded into its last bin, so KL penalizes clipping
+        # exactly as the NVIDIA calibration does
+        factor = i / quant_levels
+        q = np.zeros(i)
+        for j in range(quant_levels):
+            lo, hi = int(j * factor), min(int((j + 1) * factor), i)
+            hi = max(hi, lo + 1)
+            seg = hist[lo:hi]
+            nz = (seg > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0.0)
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs <= 0:
+            continue
+        qn = q / qs
+        mask = pn > 0
+        kl = float(np.sum(np.where(
+            mask, pn * np.log(np.maximum(pn, 1e-12)
+                              / np.maximum(qn, 1e-12)), 0.0)))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return abs_max * best_i / nbins

@@ -864,6 +864,17 @@ def increment(x, value=1.0, in_place=True):
     return out
 
 
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix between two feature maps of
+    the same spatial size (reference: layers/nn.py fsp_matrix ->
+    operators/fsp_op.cc); used by the FSP distiller."""
+    helper = LayerHelper("fsp_matrix")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fsp_matrix", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
 def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
                  name=None):
     """Reference: layers/nn.py label_smooth -> label_smooth_op.cc."""

@@ -565,6 +565,16 @@ def grid_sampler(x, grid):
     return jnp.transpose(out, (0, 3, 1, 2))
 
 
+@register("fsp_matrix", ["X", "Y"], ["Out"])
+def fsp_matrix(x, y):
+    """Reference: operators/fsp_op.cc — flow-of-solution-procedure
+    matrix between two [b, c1, h, w] / [b, c2, h, w] feature maps:
+    Out[b, i, j] = sum_hw X[b,i,h,w] * Y[b,j,h,w] / (h*w). One MXU
+    einsum on TPU."""
+    h, w = x.shape[2], x.shape[3]
+    return jnp.einsum("bihw,bjhw->bij", x, y) / float(h * w)
+
+
 @register("label_smooth", ["X", "PriorDist"], ["Out"])
 def label_smooth(x, prior_dist=None, *, epsilon=0.1):
     """Reference: operators/label_smooth_op.cc — uniform (or prior)

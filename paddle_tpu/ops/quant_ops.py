@@ -22,13 +22,20 @@ from jax import lax
 from .registry import register
 
 
+def _quant(x, scale, bit_length):
+    """Map onto the signed int grid (kept in a float container — int8
+    storage happens at export; XLA computes in f32/bf16 either way)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+
+
 def _qdq(x, scale, bit_length):
     """Quantize-dequantize to ``bit_length`` signed levels at
     ``scale`` (maps [-scale, scale] onto the int grid)."""
     qmax = float(2 ** (bit_length - 1) - 1)
     s = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
-    return q * s / qmax
+    return _quant(x, scale, bit_length) * s / qmax
 
 
 def _ste(x, dequant):
@@ -91,3 +98,118 @@ def dequantize_weight(x, scale, *, bit_length=8, quant_axis=0):
         shape[quant_axis] = scale.shape[0]
         return xf * scale.reshape(shape) / qmax
     return xf * scale / qmax
+
+
+# ---------------------------------------------------------------------------
+# Separate quantize / dequantize family (reference registers these 8
+# alongside the combined QDQ ops; needed to load reference-style
+# quantized programs): fake_quantize_op.cc:493-528,
+# fake_dequantize_op.cc:186-193.
+# ---------------------------------------------------------------------------
+
+@register("fake_quantize_abs_max", ["X"], ["Out", "OutScale"])
+def fake_quantize_abs_max(x, *, bit_length=8):
+    """Reference: FakeQuantizeAbsMaxOp (fake_quantize_op.cc:493)."""
+    scale = jnp.max(jnp.abs(x))
+    return _ste(x, _quant(x, scale, bit_length)), scale
+
+
+@register("fake_quantize_range_abs_max",
+          ["X", "InScale", "Iter", "ScalesBuffer"],
+          ["Out", "OutScale", "OutScalesBuffer", "IterOut"],
+          nondiff=("InScale", "Iter", "ScalesBuffer"))
+def fake_quantize_range_abs_max(x, in_scale, it, scales_buffer, *,
+                                bit_length=8, window_size=10000,
+                                is_test=False):
+    """Reference: FakeQuantizeRangeAbsMaxOp (fake_quantize_op.cc:499):
+    training scale = max of the last ``window_size`` batch abs-maxes
+    (a rolling scales buffer); test time uses the frozen InScale."""
+    if is_test:
+        scale = in_scale
+        out = _ste(x, _quant(x, scale, bit_length))
+        return out, scale, scales_buffer, it
+    cur = jnp.max(jnp.abs(x))
+    pos = (it % scales_buffer.shape[0]).astype(jnp.int32)
+    buf = scales_buffer.at[pos].set(cur)
+    scale = jnp.max(buf)
+    out = _ste(x, _quant(x, lax.stop_gradient(scale), bit_length))
+    return out, scale, buf, it + 1
+
+
+@register("fake_quantize_moving_average_abs_max",
+          ["X", "InScale", "InAccum", "InState"],
+          ["Out", "OutScale", "OutAccum", "OutState"],
+          nondiff=("InScale", "InAccum", "InState"))
+def fake_quantize_moving_average_abs_max(
+        x, in_scale, in_accum, in_state, *, bit_length=8,
+        moving_rate=0.9, is_test=False):
+    """Reference: FakeQuantizeMovingAverageAbsMaxOp
+    (fake_quantize_op.cc:505): accum/state running sums give the
+    debiased moving-average scale."""
+    if is_test:
+        out = _ste(x, _quant(x, in_scale, bit_length))
+        return out, in_scale, in_accum, in_state
+    cur = jnp.max(jnp.abs(x))
+    accum = moving_rate * in_accum + cur
+    state = moving_rate * in_state + 1.0
+    scale = accum / state
+    out = _ste(x, _quant(x, lax.stop_gradient(scale), bit_length))
+    return out, scale, accum, state
+
+
+@register("fake_channel_wise_quantize_abs_max", ["X"],
+          ["Out", "OutScale"])
+def fake_channel_wise_quantize_abs_max(x, *, bit_length=8,
+                                       quant_axis=0):
+    """Reference: FakeChannelWiseQuantizeAbsMaxOp
+    (fake_quantize_op.cc:521)."""
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    out = _ste(x, _quant(x, scale, bit_length))
+    return out, scale.reshape(-1)
+
+
+@register("moving_average_abs_max_scale",
+          ["X", "InAccum", "InState"],
+          ["Out", "OutScale", "OutAccum", "OutState"],
+          nondiff=("InAccum", "InState"))
+def moving_average_abs_max_scale(x, in_accum, in_state, *,
+                                 moving_rate=0.9, is_test=False):
+    """Observer only (reference: MovingAverageAbsMaxScaleOp,
+    fake_quantize_op.cc:528): passes X through, tracks the scale."""
+    if is_test:
+        return x, in_accum / jnp.maximum(in_state, 1e-6), in_accum, \
+            in_state
+    cur = jnp.max(jnp.abs(x))
+    accum = moving_rate * in_accum + cur
+    state = moving_rate * in_state + 1.0
+    return x, accum / state, accum, state
+
+
+@register("fake_dequantize_max_abs", ["X", "Scale"], ["Out"],
+          nondiff=("Scale",))
+def fake_dequantize_max_abs(x, scale, *, max_range=127.0):
+    """Reference: FakeDequantizeMaxAbsOp (fake_dequantize_op.cc:186):
+    Out = X * Scale / max_range."""
+    return x.astype(jnp.float32) * scale / max_range
+
+
+@register("fake_channel_wise_dequantize_max_abs", ["X", "Scales*"],
+          ["Out"], nondiff=("Scales",))
+def fake_channel_wise_dequantize_max_abs(x, scales, *,
+                                         quant_bits=(8,),
+                                         quant_axis=0):
+    """Reference: FakeChannelWiseDequantizeMaxAbsOp
+    (fake_dequantize_op.cc:193): per-channel weight scales, plus an
+    optional second per-tensor activation scale."""
+    out = x.astype(jnp.float32)
+    wscale = scales[0]
+    qmax0 = float(2 ** (int(quant_bits[0]) - 1) - 1)
+    shape = [1] * out.ndim
+    shape[quant_axis] = -1
+    out = out * wscale.reshape(shape) / qmax0
+    if len(scales) > 1 and scales[1] is not None:
+        qmax1 = float(2 ** (int(quant_bits[min(1, len(quant_bits) - 1)])
+                            - 1) - 1)
+        out = out * scales[1] / qmax1
+    return out

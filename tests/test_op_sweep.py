@@ -570,6 +570,64 @@ spec("dequantize_weight",
       "Scale": f32(0.5)},
      ref=lambda ins: [ins["X"].astype(np.float32) * 0.5 / 127.0])
 
+
+def _np_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    s_ = max(float(scale), 1e-8)
+    return np.clip(np.round(x / s_ * qmax), -qmax, qmax)
+
+
+spec("fake_quantize_abs_max", {"X": _qx},
+     ref=lambda ins: [_np_quant(ins["X"], np.abs(ins["X"]).max()),
+                      np.abs(ins["X"]).max()],
+     grad=[])
+spec("fake_quantize_range_abs_max",
+     {"X": _qx, "InScale": f32(0.0),
+      "Iter": np.int32(0), "ScalesBuffer": np.zeros(4, np.float32)},
+     {"window_size": 4},
+     ref=lambda ins: [
+         _np_quant(ins["X"], np.abs(ins["X"]).max()),
+         np.abs(ins["X"]).max(),
+         np.array([np.abs(ins["X"]).max(), 0, 0, 0], np.float32),
+         np.int32(1)],
+     grad=[], n_outputs=4)
+spec("fake_quantize_moving_average_abs_max",
+     {"X": _qx, "InScale": f32(0.0), "InAccum": f32(0.0),
+      "InState": f32(0.0)},
+     {"moving_rate": 0.9},
+     ref=lambda ins: [
+         _np_quant(ins["X"], np.abs(ins["X"]).max()),
+         np.abs(ins["X"]).max(),
+         np.abs(ins["X"]).max(), f32(1.0)],
+     grad=[], n_outputs=4)
+spec("fake_channel_wise_quantize_abs_max", {"X": sgn((3, 4), 212)},
+     {"quant_axis": 0},
+     ref=lambda ins: [
+         np.stack([_np_quant(r, np.abs(r).max()) for r in ins["X"]]),
+         np.abs(ins["X"]).max(axis=1)],
+     grad=[], n_outputs=2)
+spec("moving_average_abs_max_scale",
+     {"X": _qx, "InAccum": f32(0.0), "InState": f32(0.0)},
+     {"moving_rate": 0.9},
+     ref=lambda ins: [ins["X"], np.abs(ins["X"]).max(),
+                      np.abs(ins["X"]).max(), f32(1.0)],
+     grad=[], n_outputs=4)
+spec("fake_dequantize_max_abs",
+     {"X": np.array([[127.0, -64.0]], np.float32), "Scale": f32(0.5)},
+     {"max_range": 127.0},
+     ref=lambda ins: [ins["X"] * 0.5 / 127.0], grad=[])
+spec("fake_channel_wise_dequantize_max_abs",
+     {"X": np.array([[127.0, -64.0], [32.0, 0.0]], np.float32),
+      "Scales": [np.array([0.5, 0.25], np.float32)]},
+     {"quant_bits": (8,), "quant_axis": 0},
+     ref=lambda ins: [ins["X"] *
+                      np.array([[0.5], [0.25]], np.float32) / 127.0],
+     grad=[])
+spec("fsp_matrix",
+     {"X": sgn((2, 3, 4, 4), 213), "Y": sgn((2, 5, 4, 4), 214)},
+     ref=lambda ins: [np.einsum("bihw,bjhw->bij", ins["X"],
+                                ins["Y"]) / 16.0])
+
 # Ops exercised end-to-end in dedicated test files (the table must
 # still account for them — the ratchet below fails on unlisted ops).
 # --- loss / sequence-labeling ops (loss_ops.py) ----------------------

@@ -1,0 +1,381 @@
+"""slim compression suite: pruning, distillation, NAS, compressor,
+post-training calibration (reference: contrib/slim/tests/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.core import Compressor
+from paddle_tpu.contrib.slim.distillation import (DistillationStrategy,
+                                                  FSPDistiller,
+                                                  L2Distiller,
+                                                  SoftLabelDistiller,
+                                                  merge)
+from paddle_tpu.contrib.slim.nas import (LightNASStrategy,
+                                         SAController, SearchSpace)
+from paddle_tpu.contrib.slim.prune import (MagnitudePruner,
+                                           PruneStrategy,
+                                           StructurePruner,
+                                           prune_structured,
+                                           sensitivity)
+from paddle_tpu.contrib.slim.quantization import Calibrator
+
+
+def _mlp_program(seed=5, hidden=16):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, hidden, act="relu", name="fc0")
+        pred = layers.fc(h, 4, act="softmax", name="fc1")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss, pred
+
+
+def _batch(seed=0, n=32):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    label = (x[:, :2].sum(1) > 0).astype(np.int64).reshape(n, 1) + \
+        (x[:, 2:4].sum(1) > 0).astype(np.int64).reshape(n, 1)
+    return {"x": x, "label": label}
+
+
+class TestPrune:
+    def test_magnitude_masks_and_sparsity(self):
+        main, startup, loss, _ = _mlp_program()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for s in range(10):
+                exe.run(main, feed=_batch(s), fetch_list=[loss])
+            strat = PruneStrategy(ratios=0.5)
+            strat.compute_masks(main, scope)
+            strat.apply_masks(scope)
+            assert strat.sparsity(scope) >= 0.49
+            # keep training; re-applied masks keep weights pruned
+            for s in range(3):
+                exe.run(main, feed=_batch(s), fetch_list=[loss])
+                strat.apply_masks(scope)
+            assert strat.sparsity(scope) >= 0.49
+            (lv,) = exe.run(main, feed=_batch(99),
+                            fetch_list=[loss])
+            assert np.isfinite(float(lv))
+
+    def test_structured_fc_chain(self):
+        main, startup, loss, pred = _mlp_program(hidden=16)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            pruned = prune_structured(
+                main, startup, scope, {"fc0.w_0": 0.5})
+            assert len(pruned["fc0.w_0"]) == 8
+            assert np.asarray(scope.get("fc0.w_0")).shape == (8, 8)
+            assert np.asarray(scope.get("fc0.b_0")).shape == (8,)
+            assert np.asarray(scope.get("fc1.w_0")).shape == (8, 4)
+            (lv,) = exe.run(main, feed=_batch(0), fetch_list=[loss])
+            assert np.isfinite(float(lv))
+
+    def test_structured_conv_bn_chain(self):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            img = layers.data("img", shape=[3, 8, 8])
+            c1 = layers.conv2d(img, 8, 3, padding=1, name="c1",
+                               bias_attr=False)
+            bn = layers.batch_norm(c1, name="bn1")
+            act = layers.relu(bn)
+            c2 = layers.conv2d(act, 4, 3, padding=1, name="c2",
+                               bias_attr=False)
+            out = layers.reduce_mean(c2)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prune_structured(main, startup, scope,
+                             {"c1.w_0": 0.25},
+                             pruner=StructurePruner(
+                                 criterions={"*": "l2_norm"}))
+            assert np.asarray(scope.get("c1.w_0")).shape[0] == 6
+            assert np.asarray(scope.get("c2.w_0")).shape[1] == 6
+            feed = {"img": np.random.RandomState(0)
+                    .randn(2, 3, 8, 8).astype(np.float32)}
+            (ov,) = exe.run(main, feed=feed, fetch_list=[out])
+            assert np.isfinite(float(ov))
+
+    def test_sensitivity_scan(self):
+        main, startup, loss, _ = _mlp_program()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for s in range(30):
+                exe.run(main, feed=_batch(s), fetch_list=[loss])
+            feed = _batch(0)
+
+            def eval_fn():
+                (lv,) = exe.run(main.clone(for_test=True), feed=feed,
+                                fetch_list=[loss])
+                return -float(lv)  # higher is better
+
+            sens = sensitivity(main, scope, exe, eval_fn,
+                               ratios=(0.3, 0.9))
+        assert "fc0.w_0" in sens and 0.9 in sens["fc0.w_0"]
+        # pruning 90% of a trained net must hurt the metric
+        assert sens["fc0.w_0"][0.9] > 0
+        assert sens["fc1.w_0"][0.9] > 0
+
+
+class TestDistillation:
+    def _teacher_student(self):
+        teacher = fluid.Program()
+        t_start = fluid.Program()
+        teacher.random_seed = t_start.random_seed = 11
+        with fluid.program_guard(teacher, t_start):
+            x = layers.data("x", shape=[8])
+            th = layers.fc(x, 16, act="relu", name="t_fc0")
+            tlogit = layers.fc(th, 4, name="t_fc1")
+        student = fluid.Program()
+        s_start = fluid.Program()
+        student.random_seed = s_start.random_seed = 12
+        with fluid.program_guard(student, s_start):
+            x = layers.data("x", shape=[8])
+            label = layers.data("label", shape=[1], dtype="int64")
+            sh = layers.fc(x, 8, act="relu", name="s_fc0")
+            slogit = layers.fc(sh, 4, name="s_fc1")
+            sloss = layers.mean(layers.cross_entropy(
+                layers.softmax(slogit), label))
+        return (teacher, t_start, tlogit, student, s_start, slogit,
+                sloss)
+
+    def test_merge_and_soft_label_distill(self):
+        (teacher, t_start, tlogit, student, s_start, slogit,
+         sloss) = self._teacher_student()
+        exe = fluid.Executor()
+        # teacher pretrained in its own scope; merge copies values
+        t_scope = fluid.Scope()
+        with fluid.scope_guard(t_scope):
+            exe.run(t_start)
+        scope = fluid.Scope()
+        mapping = merge(teacher, student, scope=scope,
+                        teacher_scope=t_scope)
+        tname = mapping[tlogit.name]
+        assert tname.startswith("teacher_")
+        sb = student.global_block()
+        assert sb.var(tname).stop_gradient
+        assert scope.has_var("teacher_t_fc0.w_0")
+
+        with fluid.program_guard(student, s_start):
+            d = SoftLabelDistiller(slogit.name, tname,
+                                   student_temperature=2.0,
+                                   teacher_temperature=2.0)
+            dloss = d.distiller_loss(student)
+            total = layers.elementwise_add(dloss, sloss)
+            fluid.optimizer.SGD(0.05).minimize(total)
+
+        with fluid.scope_guard(scope):
+            exe.run(s_start)
+            t_weights = np.asarray(scope.get("teacher_t_fc0.w_0"))
+            losses = []
+            for s in range(8):
+                (lv,) = exe.run(student, feed=_batch(s),
+                                fetch_list=[total])
+                losses.append(float(lv))
+            assert np.isfinite(losses).all()
+            assert losses[-1] < losses[0]
+            # teacher stayed frozen
+            np.testing.assert_array_equal(
+                np.asarray(scope.get("teacher_t_fc0.w_0")), t_weights)
+
+    def test_l2_and_fsp_distillers_build(self):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            img = layers.data("img", shape=[2, 6, 6])
+            a1 = layers.conv2d(img, 4, 3, padding=1, name="a1")
+            a2 = layers.conv2d(a1, 4, 3, padding=1, name="a2")
+            b1 = layers.conv2d(img, 4, 3, padding=1, name="b1")
+            b2 = layers.conv2d(b1, 4, 3, padding=1, name="b2")
+            l2 = L2Distiller(a2.name, b2.name).distiller_loss(main)
+            fsp = FSPDistiller([(a1.name, a2.name)],
+                               [(b1.name, b2.name)]).distiller_loss(
+                                   main)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            feed = {"img": np.random.RandomState(1)
+                    .randn(2, 2, 6, 6).astype(np.float32)}
+            l2v, fspv = exe.run(main, feed=feed,
+                                fetch_list=[l2, fsp])
+        assert float(l2v) >= 0 and float(fspv) >= 0
+        assert np.isfinite([float(l2v), float(fspv)]).all()
+
+
+    def test_distillation_strategy_swaps_program(self):
+        """The strategy protocol must actually distill: during
+        [start_epoch, end_epoch) the Compressor steps the distillation
+        program; outside it, the plain student program."""
+        (teacher, t_start, tlogit, student, s_start, slogit,
+         sloss) = self._teacher_student()
+        exe = fluid.Executor()
+        t_scope = fluid.Scope()
+        with fluid.scope_guard(t_scope):
+            exe.run(t_start)
+        scope = fluid.Scope()
+        mapping = merge(teacher, student, scope=scope,
+                        teacher_scope=t_scope)
+        # plain phase program: student loss only (no distill branch)
+        plain = student
+        with fluid.program_guard(plain, s_start):
+            fluid.optimizer.SGD(0.05).minimize(sloss)
+        distill = plain.clone()
+        strat = DistillationStrategy(
+            [SoftLabelDistiller(slogit.name, mapping[tlogit.name])],
+            start_epoch=1, end_epoch=2)
+        total = strat.build_loss(distill,
+                                 distill.global_block().var(sloss.name))
+        with fluid.program_guard(distill, s_start):
+            fluid.optimizer.SGD(0.05).minimize(total)
+        strat.setup(distill, fetch_list=[total])
+
+        programs_seen = []
+        real_run = exe.run
+
+        def spy(prog, *a, **kw):
+            programs_seen.append(prog)
+            return real_run(prog, *a, **kw)
+
+        exe.run = spy
+        try:
+            with fluid.scope_guard(scope):
+                real_run(s_start)
+                comp = Compressor(
+                    scope, exe, plain,
+                    train_reader=lambda: (_batch(s)
+                                          for s in range(2)),
+                    train_fetch_list=[sloss], epochs=3,
+                    strategies=[strat])
+                ctx = comp.run()
+        finally:
+            exe.run = real_run
+        assert np.isfinite(ctx.last_loss)
+        # epoch 0: plain, epoch 1: distill, epoch 2: plain again
+        assert programs_seen[0] is plain
+        assert programs_seen[2] is distill
+        assert programs_seen[4] is plain
+
+    def test_residual_add_refused(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = layers.data("img", shape=[4, 6, 6])
+            c1 = layers.conv2d(img, 4, 3, padding=1, name="r1",
+                               bias_attr=False)
+            out = layers.elementwise_add(c1, img)  # residual
+            layers.reduce_mean(out)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(Exception, match="residual"):
+                prune_structured(main, startup, scope,
+                                 {"r1.w_0": 0.5})
+
+
+class TestNAS:
+    def test_sa_controller_finds_optimum(self):
+        ctrl = SAController([8, 8], seed=3, init_temperature=1.0,
+                            reduce_rate=0.7)
+        target = np.array([5, 2])
+
+        def reward(tokens):
+            return -float(np.sum((np.array(tokens) - target) ** 2))
+
+        tokens = [0, 0]
+        ctrl.update(tokens, reward(tokens))
+        for _ in range(200):
+            cand = ctrl.next_tokens()
+            ctrl.update(cand, reward(cand))
+        assert ctrl.best_tokens == [5, 2]
+
+    def test_light_nas_search(self):
+        class TinySpace(SearchSpace):
+            def init_tokens(self):
+                return [0, 0]
+
+            def range_table(self):
+                return [4, 4]
+
+            def create_net(self, tokens=None):
+                return tokens
+
+        def reward_fn(tokens):
+            return float(tokens[0] + tokens[1])
+
+        strat = LightNASStrategy(TinySpace(), reward_fn,
+                                 search_steps=60,
+                                 target_latency=1.0,
+                                 latency_fn=lambda t: 1.0,
+                                 latency_weight=1.0)
+        best, r = strat.search()
+        assert best == [3, 3] and r == 6.0
+        assert len(strat.history) == 60
+
+
+class TestCompressor:
+    def test_compressor_drives_prune_strategy(self):
+        main, startup, loss, _ = _mlp_program()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            strat = PruneStrategy(ratios=0.5, start_step=2)
+            comp = Compressor(
+                scope, exe, main,
+                train_reader=lambda: (_batch(s) for s in range(4)),
+                train_fetch_list=[loss], epochs=2,
+                strategies=[strat])
+            ctx = comp.run()
+            assert ctx.step == 8
+            assert strat.sparsity(scope) >= 0.49
+            assert np.isfinite(ctx.last_loss)
+
+
+class TestCalibration:
+    def test_post_training_int8_round_trip(self):
+        main, startup, loss, pred = _mlp_program(seed=21)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for s in range(30):
+                exe.run(main, feed=_batch(s), fetch_list=[loss])
+            infer = main.clone(for_test=True)
+            feed = _batch(123)
+            (p32,) = exe.run(infer, feed=feed, fetch_list=[pred])
+
+            cal = Calibrator(infer, scope, algo="KL")
+            assert cal._targets  # found quantizable activations
+            for s in range(4):
+                cal.sample(exe, _batch(200 + s))
+            qprog = cal.quantize(infer.clone(for_test=True))
+            (pq,) = exe.run(qprog, feed=feed, fetch_list=[pred])
+            # int8 quantization error on softmax outputs stays small
+            assert np.max(np.abs(np.asarray(pq) -
+                                 np.asarray(p32))) < 0.1
+
+    def test_abs_max_scales(self):
+        main, startup, loss, pred = _mlp_program(seed=22)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            infer = main.clone(for_test=True)
+            cal = Calibrator(infer, scope, algo="abs_max")
+            cal.sample(exe, _batch(1))
+            scales = cal.scales()
+            assert scales and all(s > 0 for s in scales.values())
