@@ -1,0 +1,74 @@
+"""Dataset cache / checksum / download protocol.
+
+Reference: python/paddle/dataset/common.py (DATA_HOME, download() with
+md5 verification and retry, md5file). This environment has zero
+egress, so network fetch is GATED: ``download`` uses a file already
+present in the cache dir (checksum-verified) and otherwise raises a
+clear error telling the user how to provision the file — unless
+``PADDLE_TPU_ALLOW_DOWNLOAD=1`` explicitly enables urllib fetching.
+Every loader degrades to its deterministic synthetic generator when
+the real files are absent, so models and tests run everywhere.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "data_path", "md5file", "download",
+           "have_file", "DownloadUnavailableError"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "dataset"))
+
+
+class DownloadUnavailableError(RuntimeError):
+    pass
+
+
+def data_path(module, filename):
+    return os.path.join(DATA_HOME, module, filename)
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def have_file(module, filename, md5=None):
+    path = data_path(module, filename)
+    if not os.path.exists(path):
+        return False
+    return md5 is None or md5file(path) == md5
+
+
+def download(url, module, md5=None, filename=None):
+    """Return the local path of ``url``'s file under
+    ``DATA_HOME/module/``, verifying md5 when given. Fetches over the
+    network only when PADDLE_TPU_ALLOW_DOWNLOAD=1 (reference:
+    common.py:download retries 3x with md5 check)."""
+    filename = filename or url.split("/")[-1].split("?")[0]
+    path = data_path(module, filename)
+    if os.path.exists(path):
+        if md5 is None or md5file(path) == md5:
+            return path
+        os.remove(path)
+    if os.environ.get("PADDLE_TPU_ALLOW_DOWNLOAD") != "1":
+        raise DownloadUnavailableError(
+            "dataset file %r is not cached and downloads are disabled "
+            "(zero-egress environment). Place the file at %s (md5 %s) "
+            "or set PADDLE_TPU_ALLOW_DOWNLOAD=1."
+            % (filename, path, md5 or "unchecked"))
+    import urllib.request
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    for attempt in range(3):
+        urllib.request.urlretrieve(url, path)
+        if md5 is None or md5file(path) == md5:
+            return path
+    raise DownloadUnavailableError(
+        "md5 mismatch for %s after 3 attempts" % url)

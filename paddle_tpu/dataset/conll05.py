@@ -1,0 +1,73 @@
+"""CoNLL-2005 semantic-role-labeling reader creators.
+
+Reference: python/paddle/dataset/conll05.py — test() yields
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_ids, mark,
+label_ids): per-token word ids, five predicate-context windows
+broadcast over the sentence, predicate ids, a 0/1 predicate-adjacency
+mark, and IOB label ids; get_dict() returns (word_dict, verb_dict,
+label_dict). Synthetic sentences follow the exact field conventions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+_WORDS = 4000
+_VERBS = 200
+_LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
+_TEST_SIZE = 512
+
+
+def get_dict():
+    word_dict = {"w%d" % i: i for i in range(_WORDS)}
+    verb_dict = {"v%d" % i: i for i in range(_VERBS)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic stand-in for the pretrained emb table the
+    reference downloads (conll05.py get_embedding)."""
+    rng = np.random.RandomState(0)
+    return rng.randn(_WORDS, 32).astype(np.float32)
+
+
+def _sample(idx):
+    rng = np.random.RandomState(idx)
+    n = int(rng.randint(5, 25))
+    words = rng.randint(0, _WORDS, size=n)
+    pred_pos = int(rng.randint(n))
+    verb = int(rng.randint(_VERBS))
+
+    def ctx(off):
+        p = min(max(pred_pos + off, 0), n - 1)
+        return [int(words[p])] * n
+
+    mark = [1 if abs(i - pred_pos) <= 1 else 0 for i in range(n)]
+    labels = []
+    i = 0
+    while i < n:
+        if i == pred_pos:
+            labels.append(_LABELS.index("B-V"))
+            i += 1
+        elif rng.rand() < 0.3 and i + 1 < n:
+            role = "A0" if rng.rand() < 0.5 else "A1"
+            labels.append(_LABELS.index("B-" + role))
+            labels.append(_LABELS.index("I-" + role))
+            i += 2
+        else:
+            labels.append(_LABELS.index("O"))
+            i += 1
+    labels = labels[:n]
+    return (words.astype(np.int64).tolist(), ctx(-2), ctx(-1), ctx(0),
+            ctx(1), ctx(2), [verb] * n, mark, labels)
+
+
+def test():
+    def reader():
+        for i in range(_TEST_SIZE):
+            yield _sample(11_000_000 + i)
+
+    return reader

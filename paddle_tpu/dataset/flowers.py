@@ -1,0 +1,59 @@
+"""Oxford-102 flowers reader creators.
+
+Reference: python/paddle/dataset/flowers.py — train()/test()/valid()
+yield (CHW float32 image pushed through simple_transform, int64
+label in [0, 102)). Synthetic fallback: class-conditional color blobs
+run through the SAME image.py transform pipeline so the full
+preprocessing path is exercised.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import image as img_util
+
+__all__ = ["train", "test", "valid"]
+
+N_CLASSES = 102
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+VALID_SIZE = 256
+
+
+def _raw(idx):
+    rng = np.random.RandomState(idx)
+    label = idx % N_CLASSES
+    h, w = int(rng.randint(160, 320)), int(rng.randint(160, 320))
+    img = rng.randint(0, 40, size=(h, w, 3)).astype(np.uint8)
+    # class-coded dominant color patch
+    img[h // 4:3 * h // 4, w // 4:3 * w // 4, label % 3] += np.uint8(
+        120 + (label * 7) % 100)
+    return img, np.int64(label)
+
+
+def _creator(n, base, is_train, mapper=None):
+    def reader():
+        for i in range(n):
+            raw, label = _raw(base + i)
+            rng = np.random.RandomState(base + i + 1)
+            out = img_util.simple_transform(
+                raw, 256, 224, is_train, mean=[104.0, 117.0, 124.0],
+                rng=rng)
+            if mapper is not None:
+                out = mapper(out)
+            yield out, label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _creator(TRAIN_SIZE, 0, True, mapper)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _creator(TEST_SIZE, 13_000_000, False, mapper)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _creator(VALID_SIZE, 14_000_000, False, mapper)

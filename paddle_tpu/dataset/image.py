@@ -1,0 +1,113 @@
+"""Image preprocessing utilities (pure numpy).
+
+Reference: python/paddle/dataset/image.py (load_image, resize_short,
+center_crop, random_crop, left_right_flip, simple_transform,
+load_and_transform — there via cv2). TPU-native note: these run in
+the host data pipeline feeding the device; numpy keeps them
+dependency-free (cv2 is a vendor library the reference dynloads).
+Images are HWC uint8/float arrays; ``to_chw`` transposes for the
+NCHW-consuming conv models.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image", "resize_short", "center_crop", "random_crop",
+           "left_right_flip", "to_chw", "simple_transform",
+           "load_and_transform", "batch_images"]
+
+
+def load_image(path, is_color=True):
+    """Decode an image file to an HWC uint8 array. Uses PIL when
+    available; raises a clear error otherwise (zero-egress images are
+    usually provisioned as .npy — np.load is always supported)."""
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            "decoding %r needs PIL; provision .npy arrays instead"
+            % path) from e
+    img = Image.open(path)
+    img = img.convert("RGB" if is_color else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if not is_color:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _resize(img, h, w):
+    """Nearest-neighbor resize (numpy): adequate for pipeline tests
+    and synthetic data; swap in PIL/cv2 for production quality."""
+    hh = (np.arange(h) * (img.shape[0] / h)).astype(int)
+    ww = (np.arange(w) * (img.shape[1] / w)).astype(int)
+    return img[hh][:, ww]
+
+
+def resize_short(img, size):
+    """Scale so the SHORT side equals ``size`` (reference:
+    image.py resize_short)."""
+    h, w = img.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    return _resize(img, nh, nw)
+
+
+def center_crop(img, size, is_color=True):
+    h, w = img.shape[:2]
+    top = (h - size) // 2
+    left = (w - size) // 2
+    return img[top:top + size, left:left + size]
+
+
+def random_crop(img, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = img.shape[:2]
+    top = int(rng.randint(0, h - size + 1))
+    left = int(rng.randint(0, w - size + 1))
+    return img[top:top + size, left:left + size]
+
+
+def left_right_flip(img, is_color=True):
+    return img[:, ::-1]
+
+
+def to_chw(img, order=(2, 0, 1)):
+    return img.transpose(order)
+
+
+def simple_transform(img, resize_size, crop_size, is_train,
+                     is_color=True, mean=None, rng=None):
+    """resize_short -> crop (random+flip when training, center
+    otherwise) -> CHW float32 -> mean subtraction (reference:
+    image.py simple_transform)."""
+    img = resize_short(img, resize_size)
+    if is_train:
+        img = random_crop(img, crop_size, rng=rng)
+        if (rng or np.random).randint(2):
+            img = left_right_flip(img)
+    else:
+        img = center_crop(img, crop_size)
+    img = to_chw(img).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        img -= mean.reshape(-1, 1, 1) if mean.ndim == 1 else mean
+    return img
+
+
+def load_and_transform(path, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(path, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images(samples):
+    """Stack (img, label) samples into (batch NCHW, labels [n, 1])."""
+    imgs = np.stack([s[0] for s in samples]).astype(np.float32)
+    labels = np.asarray([s[1] for s in samples],
+                        np.int64).reshape(-1, 1)
+    return imgs, labels

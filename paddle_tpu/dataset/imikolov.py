@@ -1,0 +1,73 @@
+"""PTB (imikolov) language-model reader creators.
+
+Reference: python/paddle/dataset/imikolov.py — build_dict(min_word_freq)
+over the corpus; train(word_idx, n)/test(word_idx, n) yield n-gram
+tuples (DataType.NGRAM) or (src_seq, trg_seq) pairs (DataType.SEQ)
+with <s>/<e>/<unk> handling. Synthetic corpus: Zipf-distributed
+deterministic sentences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DataType", "build_dict", "train", "test"]
+
+_VOCAB = 2048
+_TRAIN_SENTENCES = 2048
+_TEST_SENTENCES = 256
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _sentence(idx):
+    rng = np.random.RandomState(idx)
+    n = int(rng.randint(3, 20))
+    # Zipf-ish: low ids frequent
+    ids = (rng.zipf(1.3, size=n) - 1) % (_VOCAB - 3)
+    return ["w%d" % i for i in ids]
+
+
+def build_dict(min_word_freq=50):
+    """word -> id with <s>, <e>, <unk> (reference: imikolov.py:53)."""
+    freq = {}
+    for i in range(_TRAIN_SENTENCES):
+        for w in _sentence(i):
+            freq[w] = freq.get(w, 0) + 1
+    words = sorted((w for w, c in freq.items() if c >= min_word_freq),
+                   key=lambda w: (-freq[w], w))
+    word_idx = {w: i for i, w in enumerate(words)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _creator(n_sent, base, word_idx, n, data_type):
+    def reader():
+        unk = word_idx["<unk>"]
+        start = word_idx.get("<s>", unk)
+        end = word_idx.get("<e>", unk)
+        for i in range(n_sent):
+            words = _sentence(base + i)
+            if data_type == DataType.NGRAM:
+                l = [start] + [word_idx.get(w, unk) for w in words] \
+                    + [end]
+                if len(l) < n:
+                    continue
+                for j in range(n, len(l) + 1):
+                    yield tuple(l[j - n:j])
+            else:
+                ids = [word_idx.get(w, unk) for w in words]
+                yield [start] + ids, ids + [end]
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _creator(_TRAIN_SENTENCES, 0, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _creator(_TEST_SENTENCES, 9_000_000, word_idx, n, data_type)

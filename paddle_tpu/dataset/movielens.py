@@ -1,0 +1,140 @@
+"""MovieLens-1M reader creators.
+
+Reference: python/paddle/dataset/movielens.py — samples are
+user.value() + movie.value() + [[rating]] i.e. (user_id, gender_id,
+age_id, job_id, movie_id, category_ids, title_ids, [score]);
+plus the MovieInfo/UserInfo metadata accessors (max_movie_id:193,
+max_user_id:201, max_job_id:216, movie_categories:225,
+get_movie_title_dict:178). Synthetic catalog is deterministic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "MovieInfo", "UserInfo", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories",
+           "get_movie_title_dict", "movie_info", "user_info",
+           "age_table"]
+
+_N_MOVIES = 400
+_N_USERS = 600
+_N_CATEGORIES = 18
+_TITLE_WORDS = 512
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Reference: movielens.py:53."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [movie_categories().index(c) for c in self.categories],
+                [get_movie_title_dict()[w.lower()]
+                 for w in self.title.split()]]
+
+    def __repr__(self):
+        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo:
+    """Reference: movielens.py:80."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
+            self.index, "M" if self.is_male else "F",
+            age_table[self.age], self.job_id)
+
+
+def movie_categories():
+    return ["cat%02d" % i for i in range(_N_CATEGORIES)]
+
+
+def get_movie_title_dict():
+    return {"w%d" % i: i for i in range(_TITLE_WORDS)}
+
+
+def _movie(i):
+    rng = np.random.RandomState(1000 + i)
+    cats = [movie_categories()[c] for c in
+            rng.choice(_N_CATEGORIES, size=int(rng.randint(1, 4)),
+                       replace=False)]
+    title = " ".join("w%d" % t for t in
+                     rng.randint(0, _TITLE_WORDS,
+                                 size=int(rng.randint(1, 6))))
+    return MovieInfo(i, cats, title)
+
+
+def _user(i):
+    rng = np.random.RandomState(2000 + i)
+    return UserInfo(i, "M" if rng.rand() < 0.5 else "F",
+                    age_table[int(rng.randint(len(age_table)))],
+                    int(rng.randint(21)))
+
+
+def movie_info():
+    return {i: _movie(i) for i in range(1, _N_MOVIES + 1)}
+
+
+def user_info():
+    return {i: _user(i) for i in range(1, _N_USERS + 1)}
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_job_id():
+    return 20
+
+
+def _rating(u, m):
+    rng = np.random.RandomState(u * 100003 + m)
+    # taste model: users like movies whose id shares low bits
+    base = 3.0 + ((u ^ m) % 5 - 2) * 0.7
+    return float(np.clip(round(base + rng.randn() * 0.5), 1, 5))
+
+
+def _reader(is_test, test_ratio=0.1, rand_seed=0):
+    def reader():
+        rng = np.random.RandomState(rand_seed)
+        for u in range(1, _N_USERS + 1):
+            n = int(np.random.RandomState(u).randint(5, 15))
+            movies = np.random.RandomState(u + 7).randint(
+                1, _N_MOVIES + 1, size=n)
+            for m in movies:
+                in_test = rng.rand() < test_ratio
+                if in_test != bool(is_test):
+                    continue
+                yield _user(u).value() + _movie(int(m)).value() + \
+                    [[_rating(u, int(m))]]
+
+    return reader
+
+
+def train():
+    return _reader(is_test=False)
+
+
+def test():
+    return _reader(is_test=True)

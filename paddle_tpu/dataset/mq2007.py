@@ -1,0 +1,53 @@
+"""MQ2007 LETOR learning-to-rank reader creators.
+
+Reference: python/paddle/dataset/mq2007.py — train(format=...)/test:
+``pointwise`` yields (feature_vector[46], relevance); ``pairwise``
+yields (d_high[46], d_low[46]); ``listwise`` yields per-query
+(label_list, feature_matrix). Synthetic queries embed relevance
+linearly in a feature subspace so rankers actually learn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "FEATURE_DIM"]
+
+FEATURE_DIM = 46
+_TRAIN_QUERIES = 256
+_TEST_QUERIES = 64
+
+
+def _query(idx):
+    rng = np.random.RandomState(idx)
+    n_docs = int(rng.randint(5, 20))
+    feats = rng.rand(n_docs, FEATURE_DIM).astype(np.float32)
+    score = feats[:, :5].sum(axis=1) + rng.randn(n_docs) * 0.1
+    rel = np.digitize(score, np.quantile(score, [0.5, 0.8]))
+    return rel.astype(np.int64), feats
+
+
+def _creator(n, base, fmt):
+    def reader():
+        for i in range(n):
+            rel, feats = _query(base + i)
+            if fmt == "listwise":
+                yield rel.tolist(), feats
+            elif fmt == "pointwise":
+                for r, f in zip(rel, feats):
+                    yield f, int(r)
+            else:  # pairwise
+                for a in range(len(rel)):
+                    for b in range(len(rel)):
+                        if rel[a] > rel[b]:
+                            yield feats[a], feats[b]
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _creator(_TRAIN_QUERIES, 0, format)
+
+
+def test(format="pairwise"):
+    return _creator(_TEST_QUERIES, 17_000_000, format)

@@ -1,0 +1,52 @@
+"""Movie-review sentiment reader creators.
+
+Reference: python/paddle/dataset/sentiment.py (NLTK movie_reviews:
+get_word_dict():64 sorted by frequency, train()/test() yield
+(word-id list, 0/1 label) with a 90/10 split). Synthetic: polarity
+carried by disjoint token ranges with shared filler words.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_word_dict", "train", "test"]
+
+_VOCAB = 1000
+_N_DOCS = 1024
+NUM_TRAINING_INSTANCES = int(_N_DOCS * 0.9)
+NUM_TOTAL_INSTANCES = _N_DOCS
+
+
+def get_word_dict():
+    """word -> id, most frequent first (reference: sentiment.py:64)."""
+    return {"w%d" % i: i for i in range(_VOCAB)}
+
+
+def _doc(idx):
+    rng = np.random.RandomState(idx)
+    label = idx % 2
+    n = int(rng.randint(10, 80))
+    filler = rng.randint(0, _VOCAB // 2, size=n)
+    polar_lo = _VOCAB // 2 if label else 3 * _VOCAB // 4
+    polar = rng.randint(polar_lo, polar_lo + _VOCAB // 4,
+                        size=max(2, n // 4))
+    ids = np.concatenate([filler, polar])
+    rng.shuffle(ids)
+    return ids.astype(np.int64).tolist(), np.int64(label)
+
+
+def _creator(lo, hi):
+    def reader():
+        for i in range(lo, hi):
+            yield _doc(i)
+
+    return reader
+
+
+def train():
+    return _creator(0, NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _creator(NUM_TRAINING_INSTANCES, NUM_TOTAL_INSTANCES)

@@ -1,0 +1,56 @@
+"""PASCAL VOC2012 segmentation reader creators.
+
+Reference: python/paddle/dataset/voc2012.py — train()/test()/val()
+yield (CHW float32 image, HW int32 segmentation label map with the
+21 VOC classes + 255 ignore border). Synthetic fallback: rectangles
+of a class painted on background with an ignore ring, exercising
+the same shapes the segmentation models consume.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+N_CLASSES = 21
+IGNORE = 255
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+_H = _W = 128
+
+
+def _sample(idx):
+    rng = np.random.RandomState(idx)
+    img = rng.randint(0, 60, size=(3, _H, _W)).astype(np.float32)
+    seg = np.zeros((_H, _W), np.int32)
+    for _ in range(int(rng.randint(1, 4))):
+        cls = int(rng.randint(1, N_CLASSES))
+        h0, w0 = int(rng.randint(_H - 32)), int(rng.randint(_W - 32))
+        h1 = h0 + int(rng.randint(16, 32))
+        w1 = w0 + int(rng.randint(16, 32))
+        seg[h0:h1, w0:w1] = cls
+        seg[h0:h1, w0] = IGNORE    # thin ignore border, VOC-style
+        seg[h0, w0:w1] = IGNORE
+        img[cls % 3, h0:h1, w0:w1] += 120.0
+    return img, seg
+
+
+def _creator(n, base):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i)
+
+    return reader
+
+
+def train():
+    return _creator(TRAIN_SIZE, 0)
+
+
+def test():
+    return _creator(TEST_SIZE, 15_000_000)
+
+
+def val():
+    return _creator(TEST_SIZE, 16_000_000)

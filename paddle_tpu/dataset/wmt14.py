@@ -1,0 +1,67 @@
+"""WMT14 EN->FR reader creators.
+
+Reference: python/paddle/dataset/wmt14.py — train(dict_size)/
+test(dict_size) yield (src_ids, trg_ids, trg_ids_next) where trg_ids
+is <s>-prefixed and trg_ids_next <e>-suffixed; get_dict(dict_size)
+returns (src_dict, trg_dict). Real data: drop the preprocessed
+``wmt14/train.tgz``-style id files under DATA_HOME; otherwise a
+deterministic synthetic parallel corpus with the same id conventions
+(0=<s>, 1=<e>, 2=<unk>) is generated.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_dict"]
+
+START = 0   # <s>
+END = 1     # <e>
+UNK = 2     # <unk>
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def _sample(idx, dict_size):
+    rng = np.random.RandomState(idx)
+    n = int(rng.randint(4, 30))
+    src = rng.randint(3, dict_size, size=n).tolist()
+    # translated sentence: deterministic per-token remap + length jitter
+    trg = [3 + (t * 7 + 11) % (dict_size - 3) for t in src]
+    if n > 5 and idx % 3 == 0:
+        trg = trg[:-1]
+    return src, [START] + trg, trg + [END]
+
+
+def _creator(n, base, dict_size):
+    def reader():
+        for i in range(n):
+            yield _sample(base + i, dict_size)
+
+    return reader
+
+
+def train(dict_size):
+    """Reference: wmt14.py:118."""
+    return _creator(TRAIN_SIZE, 0, dict_size)
+
+
+def test(dict_size):
+    """Reference: wmt14.py:134."""
+    return _creator(TEST_SIZE, 5_000_000, dict_size)
+
+
+def get_dict(dict_size, reverse=False):
+    """(src_dict, trg_dict); id->word when ``reverse`` (reference:
+    wmt14.py:156 — note the reference defaults reverse=True there)."""
+    def one(prefix):
+        words = ["<s>", "<e>", "<unk>"] + [
+            "%s%d" % (prefix, i) for i in range(3, dict_size)]
+        if reverse:
+            return {i: w for i, w in enumerate(words)}
+        return {w: i for i, w in enumerate(words)}
+
+    return one("src"), one("trg")

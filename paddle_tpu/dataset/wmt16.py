@@ -1,0 +1,52 @@
+"""WMT16 EN<->DE reader creators.
+
+Reference: python/paddle/dataset/wmt16.py — train/test/validation
+(src_dict_size, trg_dict_size, src_lang) yield (src_ids, trg_ids,
+trg_ids_next); get_dict(lang, dict_size) returns the vocab. Same
+synthetic-fallback policy as wmt14.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import wmt14
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+VALID_SIZE = 256
+
+
+def _creator(n, base, src_size, trg_size):
+    def reader():
+        for i in range(n):
+            rng = np.random.RandomState(base + i)
+            ln = int(rng.randint(4, 30))
+            src = rng.randint(3, src_size, size=ln).tolist()
+            trg = [3 + (t * 13 + 7) % (trg_size - 3) for t in src]
+            yield src, [wmt14.START] + trg, trg + [wmt14.END]
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator(TRAIN_SIZE, 0, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator(TEST_SIZE, 7_000_000, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator(VALID_SIZE, 8_000_000, src_dict_size,
+                    trg_dict_size)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    words = ["<s>", "<e>", "<unk>"] + [
+        "%s%d" % (lang, i) for i in range(3, dict_size)]
+    if reverse:
+        return {i: w for i, w in enumerate(words)}
+    return {w: i for i, w in enumerate(words)}

@@ -1,0 +1,227 @@
+"""Dataset zoo: reader-creator contracts, the cache/checksum protocol,
+and model wiring for the NMT + recommender loaders (reference:
+python/paddle/dataset/tests/)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, layers
+
+
+def _take(reader, n):
+    return list(itertools.islice(iter(reader()), n))
+
+
+class TestContracts:
+    def test_wmt14_shapes_and_determinism(self):
+        a = _take(dataset.wmt14.train(1000), 5)
+        b = _take(dataset.wmt14.train(1000), 5)
+        assert a == b  # deterministic
+        src, trg, trg_next = a[0]
+        assert trg[0] == dataset.wmt14.START
+        assert trg_next[-1] == dataset.wmt14.END
+        assert trg[1:] == trg_next[:-1]
+        assert all(3 <= t < 1000 for t in src)
+        sd, td = dataset.wmt14.get_dict(1000)
+        assert len(sd) == 1000 and len(td) == 1000
+
+    def test_wmt16_and_validation(self):
+        for r in (dataset.wmt16.train(300, 400),
+                  dataset.wmt16.test(300, 400),
+                  dataset.wmt16.validation(300, 400)):
+            src, trg, nxt = _take(r, 1)[0]
+            assert all(t < 300 for t in src)
+            assert all(t < 400 for t in trg)
+
+    def test_movielens_fields(self):
+        s = _take(dataset.movielens.train(), 3)[0]
+        uid, gender, age, job, mid, cats, title, score = s
+        assert 1 <= uid <= dataset.movielens.max_user_id()
+        assert gender in (0, 1)
+        assert 0 <= age < len(dataset.movielens.age_table)
+        assert 0 <= job <= dataset.movielens.max_job_id()
+        assert 1 <= mid <= dataset.movielens.max_movie_id()
+        assert cats and title
+        assert 1.0 <= score[0] <= 5.0
+        # train/test split is disjoint and stable
+        tr = {tuple(map(str, x[:1] + x[4:5]))
+              for x in _take(dataset.movielens.train(), 200)}
+        te = {tuple(map(str, x[:1] + x[4:5]))
+              for x in _take(dataset.movielens.test(), 200)}
+        assert not (tr & te)
+
+    def test_imikolov_ngram_and_seq(self):
+        d = dataset.imikolov.build_dict(min_word_freq=5)
+        assert "<unk>" in d
+        grams = _take(dataset.imikolov.train(d, 4), 10)
+        assert all(len(g) == 4 for g in grams)
+        src, trg = _take(dataset.imikolov.train(
+            d, 2, dataset.imikolov.DataType.SEQ), 1)[0]
+        assert src[1:] == trg[:-1]
+
+    def test_sentiment_and_conll05(self):
+        w = dataset.sentiment.get_word_dict()
+        ids, label = _take(dataset.sentiment.train(), 1)[0]
+        assert label in (0, 1) and max(ids) < len(w)
+        fields = _take(dataset.conll05.test(), 1)[0]
+        assert len(fields) == 9
+        n = len(fields[0])
+        assert all(len(f) == n for f in fields)
+        wd, vd, ld = dataset.conll05.get_dict()
+        assert max(fields[8]) < len(ld)
+        assert dataset.conll05.get_embedding().shape[0] == len(wd)
+
+    def test_flowers_voc_mq2007(self):
+        img, label = _take(dataset.flowers.train(), 1)[0]
+        assert img.shape == (3, 224, 224) and img.dtype == np.float32
+        assert 0 <= label < 102
+        img, seg = _take(dataset.voc2012.train(), 1)[0]
+        assert seg.shape == img.shape[1:]
+        assert seg.max() <= 255
+        hi, lo = _take(dataset.mq2007.train("pairwise"), 1)[0]
+        assert hi.shape == (46,) and lo.shape == (46,)
+        labels, feats = _take(dataset.mq2007.train("listwise"), 1)[0]
+        assert feats.shape == (len(labels), 46)
+
+
+class TestImageUtils:
+    def test_transform_pipeline(self):
+        from paddle_tpu.dataset import image as I
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 255, size=(300, 200, 3)).astype(np.uint8)
+        r = I.resize_short(img, 256)
+        assert min(r.shape[:2]) == 256
+        c = I.center_crop(r, 224)
+        assert c.shape[:2] == (224, 224)
+        rc = I.random_crop(r, 224, rng=rng)
+        assert rc.shape[:2] == (224, 224)
+        out = I.simple_transform(img, 256, 224, is_train=True,
+                                 mean=[1.0, 2.0, 3.0], rng=rng)
+        assert out.shape == (3, 224, 224) and out.dtype == np.float32
+        f = I.left_right_flip(c)
+        np.testing.assert_array_equal(f[:, 0], c[:, -1])
+
+    def test_batch_images(self):
+        from paddle_tpu.dataset import image as I
+        samples = [(np.zeros((3, 8, 8), np.float32), 1),
+                   (np.ones((3, 8, 8), np.float32), 2)]
+        imgs, labels = I.batch_images(samples)
+        assert imgs.shape == (2, 3, 8, 8)
+        assert labels.shape == (2, 1) and labels.dtype == np.int64
+
+
+class TestDownloadProtocol:
+    def test_download_gated_without_egress(self, tmp_path,
+                                           monkeypatch):
+        from paddle_tpu.dataset import common
+        monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+        monkeypatch.delenv("PADDLE_TPU_ALLOW_DOWNLOAD",
+                           raising=False)
+        with pytest.raises(common.DownloadUnavailableError,
+                           match="zero-egress"):
+            common.download("http://example.com/f.tgz", "wmt14",
+                            md5="d41d8cd98f00b204e9800998ecf8427e")
+
+    def test_cached_file_with_md5(self, tmp_path, monkeypatch):
+        from paddle_tpu.dataset import common
+        monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+        d = tmp_path / "wmt14"
+        d.mkdir()
+        (d / "f.tgz").write_bytes(b"hello")
+        md5 = common.md5file(str(d / "f.tgz"))
+        p = common.download("http://example.com/f.tgz", "wmt14",
+                            md5=md5)
+        assert p.endswith("f.tgz")
+        assert common.have_file("wmt14", "f.tgz", md5)
+        assert not common.have_file("wmt14", "missing.tgz")
+
+
+class TestModelWiring:
+    def test_machine_translation_on_wmt14(self):
+        """The flagship NMT model trains on wmt14 reader batches
+        (pad + mask built from the raw samples — the book test path
+        on real-loader data instead of make_fake_batch)."""
+        from paddle_tpu.models import transformer as T
+        dict_size = 64
+        cfg = T.TransformerConfig(src_vocab=dict_size,
+                                  tgt_vocab=dict_size, max_len=32,
+                                  d_model=32, d_ffn=64, n_head=4,
+                                  n_layer=1, dropout=0.0)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            avg_cost, _tok, _logits = T.transformer(cfg)
+            fluid.optimizer.AdamOptimizer(2e-3).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        def batch(samples, s):
+            b = len(samples)
+            feed = {k: np.zeros((b, s), np.int64)
+                    for k in ("src_ids", "tgt_ids", "lbl_ids")}
+            feed.update({k: np.zeros((b, s), np.float32)
+                         for k in ("src_mask", "tgt_mask")})
+            for i, (src, trg, nxt) in enumerate(samples):
+                src, trg, nxt = src[:s], trg[:s], nxt[:s]
+                feed["src_ids"][i, :len(src)] = src
+                feed["tgt_ids"][i, :len(trg)] = trg
+                feed["lbl_ids"][i, :len(nxt)] = nxt
+                feed["src_mask"][i, :len(src)] = 1.0
+                feed["tgt_mask"][i, :len(nxt)] = 1.0
+            return feed
+
+        reader = dataset.wmt14.train(dict_size)
+        samples = _take(reader, 64)
+        losses = []
+        for step in range(8):
+            feed = batch(samples[(step % 4) * 16:
+                                 (step % 4) * 16 + 16], cfg.max_len)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            losses.append(float(lv))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_recommender_on_movielens(self):
+        """Dot-product recommender (the book's recommender_system
+        chapter) on movielens reader batches."""
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 6
+        with fluid.program_guard(main, startup):
+            uid = layers.data("uid", shape=[1], dtype="int64")
+            mid = layers.data("mid", shape=[1], dtype="int64")
+            score = layers.data("score", shape=[1])
+            uemb = layers.embedding(
+                uid, (dataset.movielens.max_user_id() + 1, 16))
+            memb = layers.embedding(
+                mid, (dataset.movielens.max_movie_id() + 1, 16))
+            u = layers.fc(layers.reshape(uemb, (-1, 16)), 16,
+                          act="relu")
+            m = layers.fc(layers.reshape(memb, (-1, 16)), 16,
+                          act="relu")
+            pred = layers.reduce_sum(layers.elementwise_mul(u, m),
+                                     dim=1, keep_dim=True)
+            pred = layers.scale(pred, scale=1.0)
+            loss = layers.mean(layers.square_error_cost(pred, score))
+            fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        samples = _take(dataset.movielens.train(), 256)
+
+        def batch(chunk):
+            return {
+                "uid": np.array([[s[0]] for s in chunk], np.int64),
+                "mid": np.array([[s[4]] for s in chunk], np.int64),
+                "score": np.array([s[7] for s in chunk], np.float32),
+            }
+
+        losses = []
+        for epoch in range(6):
+            for i in range(0, 256, 64):
+                (lv,) = exe.run(main, feed=batch(samples[i:i + 64]),
+                                fetch_list=[loss])
+                losses.append(float(lv))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.8
