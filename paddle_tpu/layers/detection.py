@@ -27,7 +27,8 @@ __all__ = [
     "yolov3_loss", "yolo_box", "box_clip", "multiclass_nms",
     "distribute_fpn_proposals", "box_decoder_and_assign",
     "collect_fpn_proposals", "roi_align", "roi_pool",
-    "psroi_pool", "deformable_conv"]
+    "psroi_pool", "deformable_conv", "generate_proposal_labels",
+    "generate_mask_labels"]
 
 
 def _mk(helper, dtype="float32", stop_gradient=False):
@@ -341,6 +342,63 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     pred_loc, _ = target_assign(bbox_pred, loc_idx)
     pred_score, _ = target_assign(cls_logits, score_idx)
     return pred_score, pred_loc, tgt_lbl, tgt_bbox, bbox_w
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd,
+                             gt_boxes, im_info,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """Second-stage RoI sampling for Fast/Mask-RCNN training
+    (reference: layers/detection.py generate_proposal_labels ->
+    generate_proposal_labels_op.cc). Padded [N, S] outputs; pad slots
+    carry label -1 (see ops/detection_ops.py)."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = _mk(helper, stop_gradient=True)
+    labels = _mk(helper, "int32", stop_gradient=True)
+    tgts = _mk(helper, stop_gradient=True)
+    iw = _mk(helper, stop_gradient=True)
+    ow = _mk(helper, stop_gradient=True)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [tgts], "BboxInsideWeights": [iw],
+                 "BboxOutsideWeights": [ow]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi,
+               "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": tuple(bbox_reg_weights),
+               "class_nums": int(class_nums), "use_random": use_random})
+    return rois, labels, tgts, iw, ow
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_masks,
+                         rois, labels_int32, num_classes, resolution):
+    """Mask-head targets (reference: layers/detection.py
+    generate_mask_labels -> generate_mask_labels_op.cc). TPU redesign
+    consumes rasterized GtMasks [N, B, H, W] instead of LoD polygon
+    lists; see ops/detection_ops.py generate_mask_labels."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = _mk(helper, stop_gradient=True)
+    has_mask = _mk(helper, "int32", stop_gradient=True)
+    mask_t = _mk(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"ImInfo": [im_info], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtMasks": [gt_masks],
+                "Rois": [rois], "LabelsInt32": [labels_int32]},
+        outputs={"MaskRois": [mask_rois],
+                 "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask_t]},
+        attrs={"num_classes": int(num_classes),
+               "resolution": int(resolution)})
+    return mask_rois, has_mask, mask_t
 
 
 def box_decoder_and_assign(prior_box, prior_box_var, target_box,

@@ -1232,3 +1232,196 @@ def roi_perspective_transform(x, rois, rois_batch_idx, *,
     return lax.map(one_roi,
                    (a, b, c, d, e, f, g, h2,
                     rois_batch_idx.astype(jnp.int32)))
+
+
+# ---------------------------------------------------------------------------
+# Mask-RCNN training targets
+# ---------------------------------------------------------------------------
+
+@register("generate_proposal_labels",
+          ["RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo"],
+          ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+           "BboxOutsideWeights"], differentiable=False,
+          needs_rng=True)
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, *, rng, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True):
+    """Fast/Mask-RCNN second-stage RoI sampling (reference:
+    generate_proposal_labels_op.cc SampleRoisForOneImage:228 —
+    append gt boxes to proposals, match by IoU, sample a
+    fg_fraction-balanced quota, emit per-class bbox regression
+    targets).
+
+    Static TPU redesign: ragged per-image LoD outputs become padded
+    [N, S] tensors (S = batch_size_per_im); pad slots carry label -1
+    and zero weights, so downstream losses mask on label >= 0. Crowd
+    and all-zero (pad) gt rows are excluded from matching. The
+    reservoir sampling of the reference becomes noise-ranked quota
+    selection (same marginal distribution under a uniform key).
+
+    Shapes: RpnRois [N, R, 4]; GtClasses/IsCrowd [N, B];
+    GtBoxes [N, B, 4]; ImInfo [N, 3]. Rois [N, S, 4];
+    LabelsInt32 [N, S]; targets/weights [N, S, 4*class_nums].
+    """
+    n, r = rpn_rois.shape[0], rpn_rois.shape[1]
+    b = gt_boxes.shape[1]
+    s = int(batch_size_per_im)
+    n_fg_max = int(s * fg_fraction)
+    wx, wy, ww, wh = [float(w) for w in bbox_reg_weights]
+
+    def one(rois, gts, classes, crowd, key):
+        valid_gt = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1]) \
+            & (crowd == 0)
+        # candidate boxes: valid gts first (the reference concats
+        # gt_boxes before rpn_rois), then proposals
+        boxes = jnp.concatenate([gts, rois], axis=0)     # [B+R, 4]
+        valid_box = jnp.concatenate(
+            [valid_gt, (rois[:, 2] > rois[:, 0])
+             & (rois[:, 3] > rois[:, 1])])
+        iou = _iou_matrix(boxes, gts)                    # [B+R, B]
+        iou = jnp.where(valid_gt[None, :] & valid_box[:, None],
+                        iou, 0.0)
+        max_iou = jnp.max(iou, axis=1)
+        gt_ind = jnp.argmax(iou, axis=1)
+        fg = valid_box & (max_iou > fg_thresh)
+        bg = valid_box & ~fg & (max_iou >= bg_thresh_lo) \
+            & (max_iou < bg_thresh_hi)
+
+        noise = jax.random.uniform(key, max_iou.shape) if use_random \
+            else jnp.zeros_like(max_iou)
+        fg_rank = jnp.argsort(jnp.argsort(
+            -(fg.astype(jnp.float32) + noise * 1e-3)))
+        n_fg = jnp.minimum(jnp.sum(fg.astype(jnp.int32)), n_fg_max)
+        fg_sel = fg & (fg_rank < n_fg)
+        bg_rank = jnp.argsort(jnp.argsort(
+            -(bg.astype(jnp.float32) + noise * 1e-3)))
+        n_bg = jnp.minimum(jnp.sum(bg.astype(jnp.int32)), s - n_fg)
+        bg_sel = bg & (bg_rank < n_bg)
+
+        sel = fg_sel | bg_sel
+        # fg slots first, then bg, then padding (stable by rank noise)
+        order_key = -(fg_sel.astype(jnp.float32) * 2.0
+                      + bg_sel.astype(jnp.float32)) + noise * 1e-6
+        order = jnp.argsort(order_key)[:s]
+        slot_ok = jnp.arange(s) < jnp.sum(sel.astype(jnp.int32))
+        out_rois = jnp.where(slot_ok[:, None], boxes[order], 0.0)
+        is_fg_slot = slot_ok & fg_sel[order]
+        labels = jnp.where(
+            is_fg_slot, classes[gt_ind[order]].astype(jnp.int32),
+            jnp.where(slot_ok, 0, -1))
+
+        # encode fg targets vs matched gt (BoxToDelta with weights)
+        mg = gts[gt_ind[order]]
+        bw = out_rois[:, 2] - out_rois[:, 0] + 1.0
+        bh = out_rois[:, 3] - out_rois[:, 1] + 1.0
+        bcx = out_rois[:, 0] + bw / 2.0
+        bcy = out_rois[:, 1] + bh / 2.0
+        gw = mg[:, 2] - mg[:, 0] + 1.0
+        gh = mg[:, 3] - mg[:, 1] + 1.0
+        gcx = mg[:, 0] + gw / 2.0
+        gcy = mg[:, 1] + gh / 2.0
+        delta = jnp.stack(
+            [(gcx - bcx) / bw / wx, (gcy - bcy) / bh / wy,
+             jnp.log(jnp.maximum(gw / jnp.maximum(bw, _EPS), _EPS))
+             / ww,
+             jnp.log(jnp.maximum(gh / jnp.maximum(bh, _EPS), _EPS))
+             / wh], axis=-1)                              # [S, 4]
+        # scatter into the per-class layout [S, 4*class_nums]
+        cls = jnp.where(is_fg_slot, labels, 0)
+        col = jax.lax.broadcasted_iota(jnp.int32,
+                                       (s, 4 * class_nums), 1)
+        in_class = (col >= cls[:, None] * 4) \
+            & (col < (cls[:, None] + 1) * 4)
+        hit = in_class & is_fg_slot[:, None]
+        tiled = jnp.tile(delta, (1, class_nums))
+        targets = jnp.where(hit, tiled, 0.0)
+        weights = hit.astype(jnp.float32)
+        return out_rois, labels, targets, weights, weights
+
+    keys = jax.random.split(rng, n)
+    return jax.vmap(one)(rpn_rois.astype(jnp.float32),
+                         gt_boxes.astype(jnp.float32),
+                         gt_classes.astype(jnp.int32),
+                         is_crowd.astype(jnp.int32), keys)
+
+
+@register("generate_mask_labels",
+          ["ImInfo", "GtClasses", "IsCrowd", "GtMasks", "Rois",
+           "LabelsInt32"],
+          ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+          differentiable=False)
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_masks,
+                         rois, labels_int32, *, num_classes=81,
+                         resolution=14):
+    """Mask-head training targets (reference:
+    generate_mask_labels_op.cc — match fg RoIs to gt masks and
+    rasterize the cropped segmentation at ``resolution^2`` per class;
+    non-target class slots are -1 = don't-count, matching the
+    reference's ExpandMaskTarget).
+
+    TPU redesign: the reference consumes ragged COCO polygon lists
+    (LoD level 3) and rasterizes host-side via poly2mask; here gt
+    segmentations arrive already rasterized as GtMasks [N, B, H, W]
+    binary maps (the dataset pipeline's poly2mask analog), and the
+    crop+resize to [resolution, resolution] is a nearest-neighbor
+    gather the compiler vectorizes. Rois/labels are the padded [N, S]
+    outputs of generate_proposal_labels; mask targets are emitted for
+    every fg slot (label > 0), RoiHasMaskInt32 marking them.
+    """
+    m = int(resolution)
+    h, w = gt_masks.shape[2], gt_masks.shape[3]
+
+    def one(gts_mask, classes, crowd, img_rois, labels):
+        valid_gt = (classes > 0) & (crowd == 0) \
+            & (jnp.sum(gts_mask, axis=(1, 2)) > 0)
+        is_fg = labels > 0
+
+        # match each fg roi to the gt whose class equals its label and
+        # whose mask overlaps the roi most (reference matches through
+        # the sampled gt index; recover it by overlap)
+        x0, y0 = img_rois[:, 0], img_rois[:, 1]
+        x1, y1 = img_rois[:, 2], img_rois[:, 3]
+
+        ys = jnp.clip(
+            (y0[:, None] + (jnp.arange(m)[None, :] + 0.5)
+             * (y1 - y0)[:, None] / m).astype(jnp.int32), 0, h - 1)
+        xs = jnp.clip(
+            (x0[:, None] + (jnp.arange(m)[None, :] + 0.5)
+             * (x1 - x0)[:, None] / m).astype(jnp.int32), 0, w - 1)
+
+        def crop(mask):
+            # [S, m, m] nearest-neighbor crop of ONE gt mask
+            return mask[ys[:, :, None], xs[:, None, :]]
+
+        crops = jax.vmap(crop)(gts_mask)            # [B, S, m, m]
+        # overlap score of each gt's mask inside each roi
+        score = jnp.sum(crops, axis=(2, 3)).astype(jnp.float32)
+        class_ok = (classes[:, None] == labels[None, :]) \
+            & valid_gt[:, None]
+        score = jnp.where(class_ok, score, -1.0)
+        best_gt = jnp.argmax(score, axis=0)         # [S]
+        matched = jnp.max(score, axis=0) >= 0.0
+
+        has_mask = is_fg & matched
+        sel = jnp.take_along_axis(
+            crops, best_gt[None, :, None, None], axis=0)[0]  # [S,m,m]
+        flat = sel.reshape(-1, m * m).astype(jnp.int32)
+
+        cls = jnp.where(has_mask, labels, 0)
+        col = jax.lax.broadcasted_iota(
+            jnp.int32, (labels.shape[0], num_classes * m * m), 1)
+        in_class = (col >= cls[:, None] * m * m) \
+            & (col < (cls[:, None] + 1) * m * m)
+        tiled = jnp.tile(flat, (1, num_classes))
+        mask_t = jnp.where(in_class & has_mask[:, None], tiled, -1)
+        mask_rois = jnp.where(has_mask[:, None], img_rois, 0.0)
+        return mask_rois, has_mask.astype(jnp.int32), mask_t
+
+    return jax.vmap(one)(gt_masks.astype(jnp.float32),
+                         gt_classes.astype(jnp.int32),
+                         is_crowd.astype(jnp.int32),
+                         rois.astype(jnp.float32),
+                         labels_int32.astype(jnp.int32))
