@@ -28,13 +28,19 @@ from typing import List, Optional
 
 __all__ = ["RecordEvent", "record_event", "start_profiler",
            "stop_profiler", "reset_profiler", "profiler",
-           "export_chrome_tracing", "cuda_profiler", "npu_profiler"]
+           "export_chrome_tracing", "device_summary_table",
+           "cuda_profiler", "npu_profiler"]
 
 _state = threading.local()
 _lock = threading.Lock()
 _enabled = False
 _events: List["_Event"] = []
 _device_trace_dir: Optional[str] = None
+# host perf_counter captured immediately before jax start_trace: the
+# xplane timebase starts there, so host and device events share one
+# timeline (skew is the start_trace call latency, sub-ms)
+_trace_anchor: Optional[float] = None
+_device_events: List[dict] = []
 
 
 @dataclass
@@ -96,17 +102,21 @@ def start_profiler(state="All", trace_path=None):
         return
     _enabled = True
     if trace_path and state in ("GPU", "TPU", "All"):
+        global _trace_anchor
         try:
             import jax
+            _trace_anchor = time.perf_counter()
             jax.profiler.start_trace(trace_path)
             _device_trace_dir = trace_path
         except Exception:
             _device_trace_dir = None
+            _trace_anchor = None
 
 
 def reset_profiler():
     with _lock:
         _events.clear()
+        _device_events.clear()
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
@@ -121,12 +131,15 @@ def stop_profiler(sorted_key=None, profile_path=None):
         try:
             import jax
             jax.profiler.stop_trace()
+            _collect_device_events(_device_trace_dir)
         except Exception:
             pass
         _device_trace_dir = None
     if profile_path:
         export_chrome_tracing(profile_path)
     print(summary_table(sorted_key))
+    if _device_events:
+        print(device_summary_table())
 
 
 def summary_table(sorted_key=None) -> str:
@@ -165,21 +178,110 @@ def summary_table(sorted_key=None) -> str:
     return "\n".join(lines)
 
 
+def _collect_device_events(trace_dir):
+    """Parse the captured xplane files into per-op device events —
+    the DeviceTracer/CUPTI-activity analog (reference:
+    platform/device_tracer.cc:41). Device planes ("/device:TPU:*")
+    carry one line per core stream; on CPU backends the XLA runtime
+    threads ("tf_*" lines of the host plane) play that role."""
+    import glob
+    global _device_events
+    from jax.profiler import ProfileData
+    events = []
+    for f in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True):
+        pd = ProfileData.from_file(f)
+        planes = list(pd.planes)
+        dev_planes = [p for p in planes
+                      if p.name.startswith("/device:")]
+        if dev_planes:
+            selected = [(p.name, line) for p in dev_planes
+                        for line in p.lines]
+        else:
+            selected = [(p.name, line) for p in planes
+                        if p.name.endswith(":CPU")
+                        for line in p.lines
+                        if line.name.startswith("tf_")]
+        for pname, line in selected:
+            for e in line.events:
+                if e.duration_ns <= 0 or \
+                        e.name.startswith(("end: ", "begin: ")):
+                    continue
+                events.append({"name": e.name, "plane": pname,
+                               "line": line.name,
+                               "ts_ns": float(e.start_ns),
+                               "dur_ns": float(e.duration_ns)})
+    with _lock:
+        _device_events = events
+
+
+def device_summary_table(sorted_key=None) -> str:
+    """Per-op DEVICE time table from the xplane capture (reference:
+    the 'GPU' rows of PrintProfiler + tools/timeline.py device
+    tracks)."""
+    with _lock:
+        events = list(_device_events)
+    agg = {}
+    for ev in events:
+        rec = agg.setdefault(ev["name"],
+                             {"calls": 0, "total": 0.0,
+                              "min": float("inf"), "max": 0.0})
+        rec["calls"] += 1
+        d = ev["dur_ns"] / 1e6
+        rec["total"] += d
+        rec["min"] = min(rec["min"], d)
+        rec["max"] = max(rec["max"], d)
+    wall = sum(r["total"] for r in agg.values()) or 1.0
+    rows = [(n, r["calls"], r["total"], r["min"], r["max"],
+             r["total"] / r["calls"], r["total"] / wall)
+            for n, r in agg.items()]
+    rows.sort(key=lambda x: -x[2])
+    lines = ["------------------------->   Device (XLA) Report   "
+             "<-------------------------", "",
+             "%-40s %8s %12s %10s %10s %8s" %
+             ("Op", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+              "Ratio")]
+    for name, calls, total, mn, mx, _ave, ratio in rows[:60]:
+        lines.append("%-40s %8d %12.4f %10.4f %10.4f %7.2f%%"
+                     % (name[:40], calls, total, mn, mx,
+                        ratio * 100.0))
+    return "\n".join(lines)
+
+
 def export_chrome_tracing(path):
-    """chrome://tracing JSON from the host events (reference:
-    tools/timeline.py converting profiler.proto)."""
+    """ONE chrome://tracing JSON merging host RecordEvents and the
+    captured device-op events on separate tracks (reference:
+    tools/timeline.py merging profiler.proto host records with
+    device_tracer.cc CUPTI records). Host events are aligned to the
+    device timebase via the anchor captured at start_trace."""
     with _lock:
         events = list(_events)
-    if not events:
-        base = 0.0
-    else:
+        dev = list(_device_events)
+    if _trace_anchor is not None and dev:
+        base = _trace_anchor
+    elif events:
         base = min(ev.start for ev in events)
-    trace = {"traceEvents": [
+    else:
+        base = 0.0
+    trace_events = [
         {"name": ev.name, "cat": "host", "ph": "X",
          "ts": (ev.start - base) * 1e6, "dur": ev.dur * 1e6,
          "pid": 0, "tid": ev.thread % 10000,
          "args": {"depth": ev.depth}}
-        for ev in events]}
+        for ev in events]
+    tids = {}
+    for ev in dev:
+        tid = tids.setdefault((ev["plane"], ev["line"]),
+                              len(tids) + 1)
+        trace_events.append(
+            {"name": ev["name"], "cat": "device", "ph": "X",
+             "ts": ev["ts_ns"] / 1e3, "dur": ev["dur_ns"] / 1e3,
+             "pid": 1, "tid": tid, "args": {"stream": ev["line"]}})
+    meta = [{"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "host"}},
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "device (XLA)"}}]
+    trace = {"traceEvents": meta + trace_events}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -51,6 +51,8 @@ def test_chrome_trace_export(tmp_path):
     names = {e["name"] for e in evs}
     assert "executor_run" in names
     for e in evs:
+        if e["ph"] == "M":     # track-name metadata
+            continue
         assert e["ph"] == "X" and e["dur"] >= 0
 
 
@@ -60,3 +62,37 @@ def test_disabled_profiler_records_nothing():
         pass
     table = profiler.summary_table()
     assert "should_not_appear" not in table
+
+
+def test_device_trace_merged_into_timeline(tmp_path):
+    """Host RecordEvents and XLA device-op events land in ONE chrome
+    trace (separate pid tracks) and the per-op device table reports
+    real op names (reference: device_tracer.cc + tools/timeline.py
+    merged timeline)."""
+    import json
+
+    import jax.numpy as jnp
+
+    trace_dir = str(tmp_path / "xprof")
+    out = str(tmp_path / "merged.json")
+    profiler.reset_profiler()
+    profiler.start_profiler("All", trace_path=trace_dir)
+    with profiler.RecordEvent("host_span"):
+        x = jnp.ones((128, 128))
+        for _ in range(3):
+            x = (x @ x) / 128.0
+        x.block_until_ready()
+    profiler.stop_profiler(profile_path=out)
+
+    data = json.load(open(out))
+    cats = {e.get("cat") for e in data["traceEvents"]}
+    assert "host" in cats and "device" in cats
+    names = [e["name"] for e in data["traceEvents"]
+             if e.get("cat") == "device"]
+    assert any("dot" in n or "fusion" in n or "jit" in n
+               for n in names), names[:20]
+    table = profiler.device_summary_table()
+    assert "Device (XLA) Report" in table
+    assert any(tok in table for tok in ("dot", "fusion", "jit"))
+    profiler.reset_profiler()
+    assert profiler.device_summary_table().count("\n") <= 3
