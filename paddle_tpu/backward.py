@@ -173,6 +173,16 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
         if not any_grad:
             continue
 
+        if fwd.type == "while" and not fwd.attrs.get("max_iters"):
+            # surface the XLA constraint at BUILD time (here) instead
+            # of as a trace-time failure deep in the executor: an
+            # unbounded lax.while_loop is forward-only
+            enforce(False,
+                    "gradients through a While loop need a trip "
+                    "bound: build it as layers.While(cond, "
+                    "max_iters=<bound>) so it lowers to a "
+                    "differentiable lax.scan (op #%d)" % i)
+
         out_grad_inputs = [gname(n) for n in fwd.output_arg_names]
         block.append_op(
             type="vjp",

@@ -1,0 +1,5 @@
+"""Decoder UX helpers (reference: fluid/contrib/decoder/)."""
+
+from .beam_search_decoder import (BeamSearchDecoder,  # noqa: F401
+                                  InitState, StateCell,
+                                  TrainingDecoder)

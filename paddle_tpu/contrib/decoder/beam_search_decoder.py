@@ -1,0 +1,296 @@
+"""Seq2seq decoder UX: StateCell / TrainingDecoder / BeamSearchDecoder.
+
+Reference: python/paddle/fluid/contrib/decoder/beam_search_decoder.py
+(InitState:43, StateCell:159 with the @state_updater protocol,
+TrainingDecoder:384 over DynamicRNN, BeamSearchDecoder:523 over a
+while loop + beam_search ops). TPU-native redesign: the training
+decoder rides the repo's scan-lowered DynamicRNN, and the beam decoder
+builds the bounded While + dense [batch, beam] beam_search step +
+backtrack pipeline (ops/beam_search_ops.py) — no LoD state reordering;
+parent-index gathers reorder the cell states each step.
+
+One StateCell drives BOTH decoders, which is the point of the API:
+define the cell once, train with TrainingDecoder, decode with
+BeamSearchDecoder.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import layers
+from ...core.enforce import InvalidArgumentError, enforce
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """Initial decoder state (reference: beam_search_decoder.py:43):
+    either a concrete boot Variable or (shape, value) zeros-like."""
+
+    def __init__(self, init=None, shape=None, value=0.0,
+                 init_boot=None, need_reorder=False, dtype="float32"):
+        self._init = init if init is not None else init_boot
+        self.shape = shape
+        self.value = value
+        self.dtype = dtype
+        self.need_reorder = need_reorder
+        enforce(self._init is not None or shape is not None,
+                "InitState needs init= or shape=")
+
+    @property
+    def init(self):
+        return self._init
+
+
+class StateCell:
+    """The per-step recurrence definition shared by both decoders
+    (reference: beam_search_decoder.py:159). ``inputs`` maps input
+    names to (possibly None) default vars; ``states`` maps state names
+    to InitState; the @state_updater function reads
+    ``get_input``/``get_state`` and must ``set_state`` every state.
+    """
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs)
+        self._states = dict(states)
+        self._out_state = out_state
+        self._updater = None
+        self._cur_states = {}
+        self._cur_inputs = {}
+
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    # -- used inside the updater --------------------------------------
+    def get_input(self, name):
+        enforce(name in self._cur_inputs,
+                "input %r not provided to compute_state" % name)
+        return self._cur_inputs[name]
+
+    def get_state(self, name):
+        enforce(name in self._cur_states,
+                "unknown state %r (did the decoder initialize the "
+                "cell?)" % name)
+        return self._cur_states[name]
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def compute_state(self, inputs):
+        """Run the updater over current states with ``inputs``
+        (reference: :335)."""
+        enforce(self._updater is not None,
+                "StateCell has no @state_updater")
+        self._cur_inputs = dict(self._inputs)
+        self._cur_inputs.update(inputs)
+        self._updater(self)
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder:
+    """Teacher-forced decoding over DynamicRNN (reference:
+    beam_search_decoder.py:384)::
+
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            emb = decoder.step_input(trg_embedding)
+            cell.compute_state(inputs={'x': emb})
+            out = some_layers(cell.out_state())
+            decoder.state_cell.update_states()  # optional, implied
+            decoder.output(out)
+        outputs = decoder()
+    """
+
+    def __init__(self, state_cell, name=None):
+        self._cell = state_cell
+        self._rnn = layers.DynamicRNN(name=name)
+        self._guard = None
+        self._mems = {}
+
+    @property
+    def state_cell(self):
+        return self._cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._rnn
+
+    def block(self):
+        outer = self._rnn.block()
+
+        class _G:
+            def __enter__(_s):
+                outer.__enter__()
+                return self
+
+            def __exit__(_s, *exc):
+                self._commit()
+                return outer.__exit__(*exc)
+
+        return _G()
+
+    def step_input(self, x, lengths=None):
+        v = self._rnn.step_input(x, lengths=lengths)
+        self._ensure_states()
+        return v
+
+    def static_input(self, x):
+        return self._rnn.static_input(x)
+
+    def _ensure_states(self):
+        if self._mems:
+            return
+        for name, st in self._cell._states.items():
+            if st.init is not None:
+                mem = self._rnn.memory(init=st.init)
+            else:
+                mem = self._rnn.memory(shape=st.shape, value=st.value,
+                                       dtype=st.dtype)
+            self._mems[name] = mem
+            self._cell._cur_states[name] = mem
+
+    def output(self, *outs):
+        self._outs = outs
+        self._rnn.output(*outs)
+
+    def _commit(self):
+        # updated states flow into the next step
+        for name, mem in self._mems.items():
+            new = self._cell._cur_states[name]
+            if new is not mem:
+                self._rnn.update_memory(mem, new)
+
+    def __call__(self):
+        return self._rnn()
+
+
+class BeamSearchDecoder:
+    """Beam decoding with the same StateCell (reference:
+    beam_search_decoder.py:523)::
+
+        decoder = BeamSearchDecoder(cell, init_ids, init_scores,
+                                    beam_size=4, end_id=EOS,
+                                    max_len=20)
+        with decoder.block():
+            prev = decoder.read_input()          # [batch, beam] ids
+            emb = layers.embedding(prev, ...)
+            cell.compute_state(inputs={'x': emb})
+            logp = layers.log(layers.softmax(layers.fc(
+                cell.out_state(), vocab)))
+            decoder.apply(logp)                  # beam step + reorder
+        ids, scores = decoder()                  # [batch, beam, T]
+    """
+
+    def __init__(self, state_cell, init_ids, init_scores, beam_size,
+                 end_id, max_len, name=None):
+        self._cell = state_cell
+        self.beam_size = int(beam_size)
+        self.end_id = int(end_id)
+        self.max_len = int(max_len)
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._applied = False
+        enforce(init_ids.shape[0] > 0,
+                "BeamSearchDecoder needs a STATIC batch size (got %s "
+                "for init_ids) — build the decode program with "
+                "concrete-batch data vars (append_batch_size=False), "
+                "the usual shape-static inference setup on XLA"
+                % (init_ids.shape,))
+
+    def block(self):
+        K = self.beam_size
+        b = self._init_ids.shape[0]
+        self._pre_ids = layers.assign(self._init_ids)
+        self._pre_scores = layers.assign(self._init_scores)
+        # Decoder states live as loop-carried vars seeded from the
+        # cell, FLATTENED to [batch*beam, d]: the cell then sees the
+        # same 2-D world it sees under TrainingDecoder, so one cell
+        # definition drives both (the reference achieves this with
+        # LoD beam expansion).
+        self._state_vars = {}
+        for name, st in self._cell._states.items():
+            enforce(st.init is not None,
+                    "BeamSearchDecoder states need concrete init= "
+                    "(the beam-expanded encoder context), got "
+                    "shape-only %r" % name)
+            init = st.init
+            if len(init.shape) == 3:
+                enforce(init.shape[1] == K,
+                        "state %r init must be [batch, beam, d]"
+                        % name)
+                init = layers.reshape(init,
+                                      shape=[-1, init.shape[-1]])
+            self._state_vars[name] = layers.assign(init)
+            self._cell._cur_states[name] = self._state_vars[name]
+        self._ids_arr = layers.create_array("int64")
+        self._par_arr = layers.create_array("int32")
+        self._t = layers.fill_constant([1], "int32", 0)
+        tmax = layers.fill_constant([1], "int32", self.max_len)
+        self._cond = layers.less_than(self._t, tmax)
+        self._tmax = tmax
+        self._while = layers.While(cond=self._cond, is_test=True)
+        outer = self._while.block()
+        decoder = self
+
+        class _G:
+            def __enter__(_s):
+                outer.__enter__()
+                return decoder
+
+            def __exit__(_s, *exc):
+                if exc[0] is None:
+                    enforce(decoder._applied,
+                            "decoder.apply(log_probs) was never "
+                            "called inside the decode block")
+                return outer.__exit__(*exc)
+
+        return _G()
+
+    @property
+    def state_cell(self):
+        return self._cell
+
+    def read_input(self):
+        """Previous step's selected ids, flattened [batch*beam]."""
+        return layers.reshape(self._pre_ids, shape=[-1])
+
+    def apply(self, log_probs):
+        """One beam step: ``log_probs`` is [batch*beam, vocab] (the
+        cell's flat world) or [batch, beam, vocab]; selects top-k
+        accumulated candidates, records ids/parents for backtracking,
+        gathers every cell state by parent beam, advances the loop."""
+        K = self.beam_size
+        if len(log_probs.shape) == 2:
+            log_probs = layers.reshape(
+                log_probs, shape=[-1, K, log_probs.shape[-1]])
+        sel_ids, sel_scores, parent = layers.beam_search(
+            self._pre_ids, self._pre_scores, None, log_probs,
+            beam_size=K, end_id=self.end_id)
+        layers.array_write(sel_ids, self._t, array=self._ids_arr)
+        layers.array_write(parent, self._t, array=self._par_arr)
+        layers.assign(sel_ids, self._pre_ids)
+        layers.assign(sel_scores, self._pre_scores)
+        # reorder flat states by parent beam: flat index b*K + parent
+        b = self._init_ids.shape[0]
+        offset = layers.assign(
+            (np.arange(b, dtype=np.int32)[:, None] * K))
+        flat_parent = layers.reshape(parent + offset, shape=[-1])
+        for name, var in self._state_vars.items():
+            new = self._cell._cur_states[name]
+            reordered = layers.gather(new, flat_parent)
+            layers.assign(reordered, var)
+            self._cell._cur_states[name] = var
+        layers.increment(self._t, value=1, in_place=True)
+        layers.less_than(self._t, self._tmax, cond=self._cond)
+        self._applied = True
+
+    def __call__(self):
+        """[batch, beam, <=max_len] sequences + scores, best first."""
+        return layers.beam_search_decode(
+            self._ids_arr, self._par_arr, self._pre_scores,
+            beam_size=self.beam_size, end_id=self.end_id)

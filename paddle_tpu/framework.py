@@ -465,8 +465,12 @@ def _infer_shapes(block, op):
             v = block._find_var_recursive(n)
             if v is None or getattr(r, "shape", None) is None:
                 continue
-            shape = tuple(-1 if (had_dyn and d == dyn_dim) else d
-                          for d in r.shape)
+            # multiples of the sentinel are flatten/tile products of
+            # the dynamic dim (the sentinel is a large prime no real
+            # dim combination reaches) — map them back to -1 too
+            shape = tuple(
+                -1 if (had_dyn and d >= dyn_dim and d % dyn_dim == 0)
+                else d for d in r.shape)
             if v.shape == () or v.shape is None or v.shape == shape:
                 if not v.persistable:
                     v.shape = shape

@@ -141,10 +141,20 @@ class AnalysisPredictor:
         return list(outs)
 
     def clone(self) -> "AnalysisPredictor":
-        """Cheap per-thread clone sharing nothing mutable (the
-        reference shares the program, re-creates scope; program
-        re-optimization is skipped by reloading)."""
-        return AnalysisPredictor(self.config)
+        """Per-thread clone SHARING the loaded program and the weight
+        scope (reference: analysis_predictor.cc Clone shares the
+        program; weights are read-only at inference) — no disk reload,
+        no re-run of the ir passes; each clone gets its own Executor
+        (whose compiled-computation cache is keyed by program version
+        + feed signature, so clones also share compilations)."""
+        c = AnalysisPredictor.__new__(AnalysisPredictor)
+        c.config = self.config
+        c.scope = self.scope
+        c.exe = Executor()
+        c.program = self.program
+        c.feed_names = list(self.feed_names)
+        c.fetch_vars = list(self.fetch_vars)
+        return c
 
     def get_input_names(self):
         return list(self.feed_names)

@@ -3,6 +3,7 @@ python/paddle/fluid/tests/unittests/test_backward.py,
 gradient_checker.py)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -182,3 +183,24 @@ def test_double_backward_with_inner_no_grad_set():
     dw_ref = jax.grad(total)(jnp.asarray(wv), jnp.asarray(xv))
     np.testing.assert_allclose(dw, np.asarray(dw_ref), rtol=1e-4,
                                atol=1e-6)
+
+
+def test_while_backward_needs_bound_at_build_time():
+    """The forward-only lax.while_loop constraint surfaces when
+    append_backward is CALLED, not later at trace time."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4])
+        x.stop_gradient = False
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        acc = layers.scale(x, scale=1.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)        # no max_iters
+        with w.block():
+            layers.assign(layers.scale(acc, scale=2.0), acc)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        loss = layers.reduce_sum(acc)
+        with pytest.raises(Exception, match="max_iters"):
+            fluid.append_backward(loss)

@@ -628,6 +628,176 @@ spec("fsp_matrix",
      ref=lambda ins: [np.einsum("bihw,bjhw->bij", ins["X"],
                                 ins["Y"]) / 16.0])
 
+# --- optimizer update ops: independent numpy references --------------
+# (replacing the former test-file exemptions — the sweep now checks
+# each update rule against the textbook equations directly)
+
+def _opt_common(seed):
+    return {"Param": sgn((3, 4), seed), "Grad": sgn((3, 4), seed + 1),
+            "LearningRate": f32(0.1)}
+
+
+def _ref_momentum(ins, mu, nesterov):
+    v = mu * ins["Velocity"] + ins["Grad"]
+    if nesterov:
+        p = ins["Param"] - (ins["Grad"] + mu * v) * 0.1
+    else:
+        p = ins["Param"] - 0.1 * v
+    return [p, v]
+
+
+spec("momentum", dict(_opt_common(700), Velocity=sgn((3, 4), 702)),
+     {"mu": 0.9}, ref=lambda ins: _ref_momentum(ins, 0.9, False),
+     n_outputs=2)
+spec("momentum", dict(_opt_common(703), Velocity=sgn((3, 4), 705)),
+     {"mu": 0.9, "use_nesterov": True},
+     ref=lambda ins: _ref_momentum(ins, 0.9, True), n_outputs=2)
+
+
+def _ref_lars(ins, mu=0.9, coeff=0.001, wd=0.0005, eps=1e-9):
+    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    pn = np.sqrt((p * p).sum())
+    gn = np.sqrt((g * g).sum())
+    local = 0.1 * coeff * pn / (gn + wd * pn + eps)
+    vn = mu * v + local * (g + wd * p)
+    return [p - vn, vn]
+
+
+spec("lars_momentum", dict(_opt_common(706), Velocity=sgn((3, 4), 708)),
+     {"mu": 0.9}, ref=_ref_lars, n_outputs=2)
+
+
+def _ref_adam(ins, b1=0.9, b2=0.999, eps=1e-8, wd=None):
+    m1 = b1 * ins["Moment1"] + (1 - b1) * ins["Grad"]
+    m2 = b2 * ins["Moment2"] + (1 - b2) * ins["Grad"] ** 2
+    lr_t = 0.1 * np.sqrt(1 - ins["Beta2Pow"]) / (1 - ins["Beta1Pow"])
+    p = ins["Param"] - lr_t * m1 / (np.sqrt(m2) + eps)
+    if wd is not None:
+        p = p - 0.1 * wd * ins["Param"]
+    return [p, m1, m2, ins["Beta1Pow"] * b1, ins["Beta2Pow"] * b2]
+
+
+_adam_state = dict(Moment1=sgn((3, 4), 710), Moment2=u((3, 4), 711),
+                   Beta1Pow=f32(0.9 ** 3), Beta2Pow=f32(0.999 ** 3))
+spec("adam", dict(_opt_common(712), **_adam_state), {},
+     ref=lambda ins: _ref_adam(ins), n_outputs=5)
+spec("adamw", dict(_opt_common(714), **_adam_state),
+     {"weight_decay": 0.01},
+     ref=lambda ins: _ref_adam(ins, wd=0.01), n_outputs=5)
+
+
+def _ref_adamax(ins, b1=0.9, b2=0.999, eps=1e-8):
+    m = b1 * ins["Moment"] + (1 - b1) * ins["Grad"]
+    inf = np.maximum(b2 * ins["InfNorm"], np.abs(ins["Grad"]))
+    lr_t = 0.1 / (1 - ins["Beta1Pow"])
+    return [ins["Param"] - lr_t * m / (inf + eps), m, inf,
+            ins["Beta1Pow"] * b1]
+
+
+spec("adamax", dict(_opt_common(716), Moment=sgn((3, 4), 718),
+                    InfNorm=u((3, 4), 719), Beta1Pow=f32(0.9 ** 2)),
+     {}, ref=_ref_adamax, n_outputs=4)
+
+
+def _ref_adagrad(ins, eps=1e-6):
+    m = ins["Moment"] + ins["Grad"] ** 2
+    return [ins["Param"] - 0.1 * ins["Grad"] / (np.sqrt(m) + eps), m]
+
+
+spec("adagrad", dict(_opt_common(720), Moment=u((3, 4), 722)),
+     {}, ref=_ref_adagrad, n_outputs=2)
+
+
+def _ref_dec_adagrad(ins, decay=0.95, eps=1e-6):
+    m = decay * ins["Moment"] + (1 - decay) * ins["Grad"] ** 2
+    return [ins["Param"] - 0.1 * ins["Grad"] / (np.sqrt(m) + eps), m]
+
+
+spec("decayed_adagrad", dict(_opt_common(723), Moment=u((3, 4), 725)),
+     {}, ref=_ref_dec_adagrad, n_outputs=2)
+
+
+def _ref_adadelta(ins, rho=0.95, eps=1e-6):
+    asg = rho * ins["AvgSquaredGrad"] + (1 - rho) * ins["Grad"] ** 2
+    upd = -np.sqrt((ins["AvgSquaredUpdate"] + eps) / (asg + eps)) * \
+        ins["Grad"]
+    asu = rho * ins["AvgSquaredUpdate"] + (1 - rho) * upd ** 2
+    return [ins["Param"] + upd, asg, asu]
+
+
+spec("adadelta", {"Param": sgn((3, 4), 726), "Grad": sgn((3, 4), 727),
+                  "AvgSquaredGrad": u((3, 4), 728),
+                  "AvgSquaredUpdate": u((3, 4), 729)},
+     {}, ref=_ref_adadelta, n_outputs=3)
+
+
+def _ref_rmsprop(ins, rho=0.95, eps=1e-6, mom=0.6, centered=False):
+    ms = rho * ins["MeanSquare"] + (1 - rho) * ins["Grad"] ** 2
+    if centered:
+        mg = rho * ins["MeanGrad"] + (1 - rho) * ins["Grad"]
+        denom = ms - mg ** 2 + eps
+    else:
+        mg = ins["MeanGrad"]
+        denom = ms + eps
+    m = mom * ins["Moment"] + 0.1 * ins["Grad"] / np.sqrt(denom)
+    return [ins["Param"] - m, m, ms, mg]
+
+
+_rms_state = dict(Moment=sgn((3, 4), 731), MeanSquare=u((3, 4), 732),
+                  MeanGrad=sgn((3, 4), 733))
+spec("rmsprop", dict(_opt_common(734), **_rms_state),
+     {"momentum": 0.6},
+     ref=lambda ins: _ref_rmsprop(ins), n_outputs=4)
+spec("rmsprop", dict(_opt_common(736), **_rms_state),
+     {"momentum": 0.6, "centered": True},
+     ref=lambda ins: _ref_rmsprop(ins, centered=True), n_outputs=4)
+
+
+def _ref_ftrl(ins, l1=0.1, l2=0.1, lp=-0.5):
+    sq, lin = ins["SquaredAccumulator"], ins["LinearAccumulator"]
+    nsq = sq + ins["Grad"] ** 2
+    sigma = (nsq ** -lp - sq ** -lp) / 0.1
+    nlin = lin + ins["Grad"] - sigma * ins["Param"]
+    x = l1 * np.sign(nlin) - nlin
+    y = nsq ** -lp / 0.1 + 2 * l2
+    p = np.where(np.abs(nlin) > l1, x / y, 0.0).astype(np.float32)
+    return [p, nsq, nlin]
+
+
+spec("ftrl", dict(_opt_common(738),
+                  SquaredAccumulator=u((3, 4), 740),
+                  LinearAccumulator=sgn((3, 4), 741)),
+     {"l1": 0.1, "l2": 0.1},
+     ref=_ref_ftrl, n_outputs=3)
+
+
+def _ref_lamb(ins, b1=0.9, b2=0.999, eps=1e-6, wd=0.01):
+    m1 = b1 * ins["Moment1"] + (1 - b1) * ins["Grad"]
+    m2 = b2 * ins["Moment2"] + (1 - b2) * ins["Grad"] ** 2
+    m1h = m1 / (1 - ins["Beta1Pow"])
+    m2h = m2 / (1 - ins["Beta2Pow"])
+    r = m1h / (np.sqrt(m2h) + eps) + wd * ins["Param"]
+    wn = np.sqrt((ins["Param"] ** 2).sum())
+    rn = np.sqrt((r ** 2).sum())
+    ratio = wn / rn if wn > 0 and rn > 0 else 1.0
+    return [ins["Param"] - 0.1 * ratio * r, m1, m2,
+            ins["Beta1Pow"] * b1, ins["Beta2Pow"] * b2]
+
+
+spec("lamb", dict(_opt_common(742), **_adam_state), {},
+     ref=_ref_lamb, n_outputs=5)
+
+
+def _ref_proximal(ins, l1=0.05, l2=0.1):
+    prox = ins["Param"] - 0.1 * ins["Grad"]
+    prox = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0.0)
+    return [prox / (1.0 + 0.1 * l2)]
+
+
+spec("proximal_gd", _opt_common(744), {"l1": 0.05, "l2": 0.1},
+     ref=_ref_proximal)
+
+
 # Ops exercised end-to-end in dedicated test files (the table must
 # still account for them — the ratchet below fails on unlisted ops).
 # --- loss / sequence-labeling ops (loss_ops.py) ----------------------
@@ -1211,18 +1381,6 @@ EXEMPT = {
     "gru": "test_sequence_rnn.py",
     "sequence_expand": "test_sequence_rnn.py",
     "sequence_expand_as": "test_sequence_rnn.py",
-    "adadelta": "test_optimizers.py (convergence + math)",
-    "adagrad": "test_optimizers.py",
-    "adam": "test_optimizers.py",
-    "adamax": "test_optimizers.py",
-    "adamw": "test_optimizers.py",
-    "decayed_adagrad": "test_optimizers.py",
-    "ftrl": "test_optimizers.py",
-    "lamb": "test_optimizers.py",
-    "lars_momentum": "test_optimizers.py",
-    "momentum": "test_optimizers.py",
-    "proximal_gd": "test_optimizers.py",
-    "rmsprop": "test_optimizers.py",
     "ema_update": "test_average_ema.py",
     "dgc": "test_average_ema.py (momentum parity, sparsity ratio, residual)",
     "average_accumulates": "test_average_ema.py",
