@@ -260,6 +260,66 @@ def bench_resnet50(batch=64, warmup=3, iters=10):
             "mfu": _mfu(3.0 * _RESNET50_FWD_FLOPS * batch, sps)}
 
 
+def bench_resnet50_hostfed(batch=64, warmup=3, iters=10):
+    """ResNet-50 with images flowing host->device EVERY step through
+    PyReader double-buffering (SURVEY hard part 6; reference:
+    operators/reader/buffered_reader.cc): the background thread
+    pre-transfers batch t+1 while the chip computes batch t, so this
+    measures the real end-to-end input pipeline, not pre-staged
+    device arrays."""
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as amp
+    from paddle_tpu.models import resnet as R
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[224, 224, 3],
+                                dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = R.resnet50(img)
+        loss, _acc = R.loss_and_acc(pred, label)
+        opt = amp.decorate(fluid.optimizer.MomentumOptimizer(0.1, 0.9))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    rs = np.random.RandomState(0)
+    # a small rotating pool of distinct host batches: fresh arrays per
+    # step (no device caching), without paying 10 full randn calls
+    pool = [{"img": rs.rand(batch, 224, 224, 3).astype(np.float32),
+             "label": rs.randint(0, 1000, size=(batch, 1))
+             .astype(np.int64)} for _ in range(4)]
+
+    def gen():
+        i = 0
+        while True:
+            yield pool[i % len(pool)]
+            i += 1
+
+    reader = fluid.PyReader(feed_list=[img, label], capacity=4)
+    reader.decorate_batch_generator(gen)
+    import jax
+    it = reader()
+    out = None
+    for _ in range(warmup):
+        out = exe.run(main, feed=next(it), fetch_list=[loss],
+                      return_numpy=False)
+    lv = float(np.asarray(out[0]).reshape(-1)[0])
+    if not np.isfinite(lv):
+        raise FloatingPointError("non-finite loss")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main, feed=next(it), fetch_list=[loss],
+                      return_numpy=False)
+    jax.block_until_ready(out)
+    sps = iters / (time.perf_counter() - t0)
+    reader.reset()
+    return {"metric": "resnet50_hostfed_train_throughput",
+            "value": round(batch * sps, 1), "unit": "images/sec/chip",
+            "mfu": _mfu(3.0 * _RESNET50_FWD_FLOPS * batch, sps)}
+
+
 # ---------------------------------------------------------------------------
 # config 4: BERT-base pretraining
 # ---------------------------------------------------------------------------
@@ -471,8 +531,8 @@ def main():
                                else None)
     _emit(headline)
     if "--all" in sys.argv:
-        extra = [bench_mnist_mlp, bench_resnet50, bench_bert,
-                 bench_deepfm]
+        extra = [bench_mnist_mlp, bench_resnet50,
+                 bench_resnet50_hostfed, bench_bert, bench_deepfm]
         for fn in extra:
             try:
                 r = fn()
