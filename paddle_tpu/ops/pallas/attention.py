@@ -7,21 +7,26 @@ kernels, e.g. the benchmark transformer), which round-trips the
 the backward. On TPU this kernel family never materializes the score
 matrix in HBM in either direction:
 
-- **Forward**: k-blocked online softmax (one grid cell per
-  (batch*head, q-block, k-block), k innermost). Running max ``m``,
-  normalizer ``l`` and the output accumulator live in VMEM scratch; the
-  softmax statistics ``lse = m + log(l)`` are saved for the backward.
+- **Forward**: k-blocked online softmax. Running max ``m``, normalizer
+  ``l`` and the output accumulator live in VMEM scratch; the softmax
+  statistics ``lse = m + log(l)`` are saved for the backward.
 - **Backward**: two pallas kernels with per-block recompute —
-  ``dq`` (grid over q-blocks, scanning k-blocks) and ``dk/dv`` (grid
-  over k-blocks, scanning q-blocks). Each block recomputes
-  ``p = exp(s - lse)`` from q/k and the saved statistics; only
-  O(seq * head_dim) residuals (out, lse) ever hit HBM.
+  ``dq`` (scanning k-blocks) and ``dk/dv`` (scanning q-blocks). Each
+  block recomputes ``p = exp(s - lse)`` from q/k and the saved
+  statistics; only O(seq * head_dim) residuals (out, lse) ever hit HBM.
 - **Dropout** runs in-kernel with the TPU PRNG
   (``pltpu.prng_seed``/``prng_random_bits``), seeded per
-  (batch*head, q-block, k-block) so the backward regenerates the exact
+  (grid cell, q-block, k-block) so the backward regenerates the exact
   forward mask without storing it.
 - **Causal** masking skips fully-masked k-blocks (roughly halves the
   decoder self-attention work).
+- **Short-sequence batching**: each grid cell processes ``G``
+  (batch, head) rows at once (batched dot_generals over the leading
+  dim). At flagship shape (B=64 H=8 S=256) the naive per-row grid is
+  512 cells of ~0.3us of MXU work each — pure per-cell overhead; G=8
+  cuts the grid to 64 cells with 8x the work and 8x larger DMA
+  transfers. G divides H, so a cell never straddles a batch row and
+  per-BATCH bias blocks stay well-defined.
 
 ``Bias`` is an additive attention mask (0 / -1e9, built from data by the
 models) and is registered non-differentiable: the base lowering and the
@@ -49,16 +54,21 @@ from .common import blk, interpret_mode
 
 _NEG_INF = -1e30
 
+# batched dot_general dimension numbers over leading G dim
+_QK = (((2,), (2,)), ((0,), (0,)))     # [G,q,d] x [G,k,d] -> [G,q,k]
+_PV = (((2,), (1,)), ((0,), (0,)))     # [G,q,k] x [G,k,d] -> [G,q,d]
+_TT = (((1,), (1,)), ((0,), (0,)))     # [G,q,k] x [G,q,d] -> [G,k,d]
+
 
 def _causal_mask(s, j, kk, blk_q, blk_k):
-    rows = j * blk_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    cols = kk * blk_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    rows = j * blk_q + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    cols = kk * blk_k + lax.broadcasted_iota(jnp.int32, s.shape, 2)
     return jnp.where(rows >= cols, s, _NEG_INF)
 
 
 def _dropout_keep(seed_ref, i, j, kk, n_q, n_k, shape, rate):
     """Deterministic per-block dropout mask; identical bits are
-    regenerated in the backward kernels. The (bh, q-block, k-block)
+    regenerated in the backward kernels. The (cell, q-block, k-block)
     coordinates are folded into one scalar seed (single-arg prng_seed —
     the multi-arg form doesn't lower on this Mosaic version) with a
     Knuth-style odd multiplier so nearby blocks decorrelate."""
@@ -123,15 +133,17 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0]
-        s = lax.dot_general(q, k_ref[0], (((1,), (1,)), ((), ())),
+        q = q_ref[...]                                  # [G, bq, Dh]
+        s = lax.dot_general(q, k_ref[...], _QK,
                             preferred_element_type=jnp.float32) * scale
         if b_ref is not None:
-            s = s + b_ref[0, 0].astype(jnp.float32)
+            # per-head: [G,1,bq,bk] -> [G,bq,bk]; per-batch:
+            # [1,1,bq,bk] broadcasts over G
+            s = s + b_ref[:, 0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, j, kk, blk_q, blk_k)
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
+        m_prev = m_ref[..., :1]                         # [G, bq, 1]
+        l_prev = l_ref[..., :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
@@ -142,28 +154,26 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
             keep = _dropout_keep(seed_ref, i, j, kk, n_q, n_k,
                                  p.shape, rate)
             p = jnp.where(keep, p / (1.0 - rate), 0.0)
-        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
-                             (((1,), (0,)), ((), ())),
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[...], _PV,
                              preferred_element_type=jnp.float32)
         acc_ref[...] = acc_ref[...] * alpha + pv
 
     @pl.when(kk == n_k - 1)
     def _finish():
         l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
-        o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
-        # lane-replicated [blk_q, 128] (the TPU min-tile layout); the
-        # wrapper slices lane 0 out for the residual
-        lse_ref[0] = m_ref[...] + jnp.log(l_safe)
+        o_ref[...] = (acc_ref[...] / l_safe[..., :1]).astype(
+            o_ref.dtype)
+        # lane-replicated [G, blk_q, 128] (the TPU min-tile layout);
+        # the wrapper slices lane 0 out for the residual
+        lse_ref[...] = m_ref[...] + jnp.log(l_safe)
 
 
 def _prep_bias(bias, B, H, Sq, Sk):
     """Normalize an additive mask for the kernels. Returns
-    (bias array, per_head): per-BATCH biases stay [B, 1, Sq, Sk] and
-    grid cell i indexes row i // H; a per-HEAD bias [B, H, Sq, Sk]
-    reshapes to [B*H, 1, Sq, Sk] and indexes row i directly — both
-    paths read the same (1, 1, blk_q, blk_k) block shape, so the
-    kernels are agnostic (the base jnp lowering accepts either; the
-    two library paths must not diverge)."""
+    (bias array, per_head): per-BATCH biases stay [B, 1, Sq, Sk] and a
+    grid cell of G rows indexes batch (i*G)//H; a per-HEAD bias
+    [B, H, Sq, Sk] reshapes to [B*H, 1, Sq, Sk] and blocks G rows
+    directly — both paths are G-consistent because G divides H."""
     if bias is None:
         return None, False
     if bias.ndim == 4 and bias.shape[1] == H and H > 1:
@@ -177,28 +187,32 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
     Sk = k.shape[2]
     BH = B * H
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
+    G = blk(H, 8)
+    hb = H // G                    # cells per batch row
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
     blk_q = blk(Sq, 256)
     blk_k = blk(Sk, 512)
     n_k = Sk // blk_k
-    grid = (BH, Sq // blk_q, n_k)
+    grid = (BH // G, Sq // blk_q, n_k)
     seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
 
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
-        pl.BlockSpec((1, blk_k, Dh), lambda i, j, kk: (i, kk, 0)),
-        pl.BlockSpec((1, blk_k, Dh), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((G, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((G, blk_k, Dh), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((G, blk_k, Dh), lambda i, j, kk: (i, kk, 0)),
     ]
     args = [seed, q3, k3, v3]
     if bias is not None:
         if per_head:
-            bidx = lambda i, j, kk: (i, 0, j, kk)
+            bspec = pl.BlockSpec((G, 1, blk_q, blk_k),
+                                 lambda i, j, kk: (i, 0, j, kk))
         else:
-            bidx = lambda i, j, kk: (i // H, 0, j, kk)
-        in_specs.append(pl.BlockSpec((1, 1, blk_q, blk_k), bidx))
+            bspec = pl.BlockSpec((1, 1, blk_q, blk_k),
+                                 lambda i, j, kk: (i // hb, 0, j, kk))
+        in_specs.append(bspec)
         args.append(bias)
         kernel = _fwd_kernel
     else:
@@ -215,13 +229,13 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, blk_q, 128), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((G, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((G, blk_q, 128), lambda i, j, kk: (i, j, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((blk_q, Dh), jnp.float32),
-            pltpu.VMEM((blk_q, 128), jnp.float32),
-            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((G, blk_q, Dh), jnp.float32),
+            pltpu.VMEM((G, blk_q, 128), jnp.float32),
+            pltpu.VMEM((G, blk_q, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -236,13 +250,13 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
 
 def _recompute_p(q_ref, k_ref, b_ref, lse_ref, *, scale, j, kk, blk_q,
                  blk_k, causal):
-    s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+    s = lax.dot_general(q_ref[...], k_ref[...], _QK,
                         preferred_element_type=jnp.float32) * scale
     if b_ref is not None:
-        s = s + b_ref[0, 0].astype(jnp.float32)
+        s = s + b_ref[:, 0].astype(jnp.float32)
     if causal:
         s = _causal_mask(s, j, kk, blk_q, blk_k)
-    return jnp.exp(s - lse_ref[0][:, :1])            # [blk_q, blk_k]
+    return jnp.exp(s - lse_ref[..., :1])          # [G, blk_q, blk_k]
 
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
@@ -263,22 +277,22 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         p = _recompute_p(q_ref, k_ref, b_ref, lse_ref, scale=scale,
                          j=j, kk=kk, blk_q=blk_q, blk_k=blk_k,
                          causal=causal)
-        do = do_ref[0]
-        dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+        do = do_ref[...]                              # [G, bq, Dh]
+        dp = lax.dot_general(do, v_ref[...], _QK,
                              preferred_element_type=jnp.float32)
         if rate > 0.0:
             keep = _dropout_keep(seed_ref, i, j, kk, n_q, n_k,
                                  dp.shape, rate)
             dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-        delta = dl_ref[0][:, :1]                     # [blk_q, 1]
+        delta = dl_ref[..., :1]                       # [G, bq, 1]
         ds = (p * (dp - delta) * scale).astype(k_ref.dtype)
         dq_acc[...] += lax.dot_general(
-            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            ds, k_ref[...], _PV,
             preferred_element_type=jnp.float32)
 
     @pl.when(kk == n_k - 1)
     def _finish():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
@@ -300,32 +314,32 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref,
         p = _recompute_p(q_ref, k_ref, b_ref, lse_ref, scale=scale,
                          j=j, kk=kk, blk_q=blk_q, blk_k=blk_k,
                          causal=causal)
-        do = do_ref[0]
+        do = do_ref[...]
         if rate > 0.0:
             keep = _dropout_keep(seed_ref, i, j, kk, n_q, n_k,
                                  p.shape, rate)
             pd = jnp.where(keep, p / (1.0 - rate), 0.0)
         else:
             pd = p
-        # dv += Pd^T @ dO
+        # dv += Pd^T @ dO (per row)
         dv_acc[...] += lax.dot_general(
-            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, _TT,
             preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+        dp = lax.dot_general(do, v_ref[...], _QK,
                              preferred_element_type=jnp.float32)
         if rate > 0.0:
             dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-        delta = dl_ref[0][:, :1]
+        delta = dl_ref[..., :1]
         ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
-        # dk += dS^T @ Q
+        # dk += dS^T @ Q (per row)
         dk_acc[...] += lax.dot_general(
-            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            ds, q_ref[...], _TT,
             preferred_element_type=jnp.float32)
 
     @pl.when(j == n_q - 1)
     def _finish():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
@@ -333,6 +347,8 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
     Sk = k.shape[2]
     BH = B * H
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
+    G = blk(H, 8)
+    hb = H // G
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
@@ -350,27 +366,33 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
     delta128 = jnp.broadcast_to(delta[:, :, None], (BH, Sq, 128))
 
     def specs(order):
-        """order: 'dq' grid (BH, n_q, n_k) or 'dkv' grid (BH, n_k, n_q)."""
-        brow = (lambda i: i) if per_head else (lambda i: i // H)
+        """order: 'dq' grid (BH/G, n_q, n_k) or 'dkv' (BH/G, n_k, n_q)."""
         if order == "dq":
             qi = lambda i, j, kk: (i, j, 0)
             ki = lambda i, j, kk: (i, kk, 0)
-            bi = lambda i, j, kk: (brow(i), 0, j, kk)
+            if per_head:
+                bi = lambda i, j, kk: (i, 0, j, kk)
+            else:
+                bi = lambda i, j, kk: (i // hb, 0, j, kk)
         else:
             qi = lambda i, kk, j: (i, j, 0)
             ki = lambda i, kk, j: (i, kk, 0)
-            bi = lambda i, kk, j: (brow(i), 0, j, kk)
+            if per_head:
+                bi = lambda i, kk, j: (i, 0, j, kk)
+            else:
+                bi = lambda i, kk, j: (i // hb, 0, j, kk)
         sp = [pl.BlockSpec(memory_space=pltpu.SMEM),
-              pl.BlockSpec((1, blk_q, Dh), qi),
-              pl.BlockSpec((1, blk_k, Dh), ki),
-              pl.BlockSpec((1, blk_k, Dh), ki)]
+              pl.BlockSpec((G, blk_q, Dh), qi),
+              pl.BlockSpec((G, blk_k, Dh), ki),
+              pl.BlockSpec((G, blk_k, Dh), ki)]
         ar = [seed, q3, k3, v3]
         if bias is not None:
-            sp.append(pl.BlockSpec((1, 1, blk_q, blk_k), bi))
+            gb = G if per_head else 1
+            sp.append(pl.BlockSpec((gb, 1, blk_q, blk_k), bi))
             ar.append(bias)
-        sp += [pl.BlockSpec((1, blk_q, Dh), qi),
-               pl.BlockSpec((1, blk_q, 128), qi),
-               pl.BlockSpec((1, blk_q, 128), qi)]
+        sp += [pl.BlockSpec((G, blk_q, Dh), qi),
+               pl.BlockSpec((G, blk_q, 128), qi),
+               pl.BlockSpec((G, blk_q, 128), qi)]
         ar += [do3, lse128, delta128]
         return sp, ar
 
@@ -387,10 +409,11 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
                           blk_q=blk_q, blk_k=blk_k, n_q=n_q, n_k=n_k,
                           rate=rate, causal=causal),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
-        grid=(BH, n_q, n_k),
+        grid=(BH // G, n_q, n_k),
         in_specs=sp,
-        out_specs=pl.BlockSpec((1, blk_q, Dh), lambda i, j, kk: (i, j, 0)),
-        scratch_shapes=[pltpu.VMEM((blk_q, Dh), jnp.float32)],
+        out_specs=pl.BlockSpec((G, blk_q, Dh),
+                               lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((G, blk_q, Dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
@@ -403,14 +426,14 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
                           rate=rate, causal=causal),
         out_shape=[jax.ShapeDtypeStruct((BH, Sk, Dh), k.dtype),
                    jax.ShapeDtypeStruct((BH, Sk, Dh), v.dtype)],
-        grid=(BH, n_k, n_q),
+        grid=(BH // G, n_k, n_q),
         in_specs=sp,
         out_specs=[
-            pl.BlockSpec((1, blk_k, Dh), lambda i, kk, j: (i, kk, 0)),
-            pl.BlockSpec((1, blk_k, Dh), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((G, blk_k, Dh), lambda i, kk, j: (i, kk, 0)),
+            pl.BlockSpec((G, blk_k, Dh), lambda i, kk, j: (i, kk, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((blk_k, Dh), jnp.float32),
-                        pltpu.VMEM((blk_k, Dh), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((G, blk_k, Dh), jnp.float32),
+                        pltpu.VMEM((G, blk_k, Dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
