@@ -18,11 +18,14 @@ from .learning_rate_scheduler import (cosine_decay,  # noqa: F401
                                       linear_lr_warmup, natural_exp_decay,
                                       noam_decay, piecewise_decay,
                                       polynomial_decay)
-from .metric_op import accuracy, auc  # noqa: F401
+from .metric_op import accuracy, auc, chunk_eval  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
-from .rnn import dynamic_gru, dynamic_lstm, gru_unit, lstm_unit  # noqa: F401
+from .rnn import (dynamic_gru, dynamic_lstm,  # noqa: F401
+                  dynamic_lstmp, gru_unit, lstm, lstm_unit)
 from .sequence import (beam_search, beam_search_decode,  # noqa: F401
+                       sequence_conv, sequence_reshape,
+                       sequence_scatter,
                        sequence_concat, sequence_enumerate,  # noqa: F401
                        sequence_expand, sequence_expand_as,
                        sequence_first_step, sequence_last_step,
@@ -30,7 +33,10 @@ from .sequence import (beam_search, beam_search_decode,  # noqa: F401
                        sequence_slice, sequence_softmax,
                        sequence_unpad)
 from .tensor import (assign, cast, concat, create_global_var,  # noqa: F401
+                     autoincreased_step_counter,
                      create_parameter, create_tensor, diag, eye,
                      fill_constant, fill_constant_batch_size_like,
-                     linspace, ones, ones_like, sums, zeros, zeros_like)
+                     linspace, ones, ones_like, pow, reverse, sum,
+                     sums, tensor_array_to_tensor, zeros, zeros_like)
 from .tensor import range as range_  # noqa: F401
+from .tensor import range  # noqa: F401,A001  (reference export name)

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .. import unique_name
 from ..layer_helper import LayerHelper
 from . import nn
 
@@ -45,3 +46,99 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
                               "StatNegOut": [stat_neg]},
                      attrs={"num_thresholds": num_thresholds})
     return auc_out, auc_out, [stat_pos, stat_neg]
+
+
+def _extract_chunks(tags, length, scheme, num_chunk_types,
+                    excluded_chunk_types):
+    """conlleval-style chunk extraction from an id-encoded tag row
+    (reference: operators/chunk_eval_op.h Segment extraction).
+    Encoding follows the reference: IOB tag = type*2 + {0:B, 1:I};
+    IOE type*2 + {0:I, 1:E}; IOBES type*4 + {0:B,1:I,2:E,3:S};
+    ``plain`` = the tag IS the type. The id num_chunk_types*K (one
+    past the last) is the outside/O tag."""
+    chunks = []
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    o_tag = num_chunk_types * n_tag
+    start = None
+    cur_type = None
+
+    def close(end):
+        nonlocal start, cur_type
+        if start is not None and \
+                cur_type not in (excluded_chunk_types or ()):
+            chunks.append((start, end, cur_type))
+        start, cur_type = None, None
+
+    for i in range(int(length)):
+        t = int(tags[i])
+        if t >= o_tag or t < 0:
+            close(i - 1)
+            continue
+        typ, pos = divmod(t, n_tag)
+        if scheme == "plain":
+            is_begin = cur_type != typ or start is None
+            is_end = False
+        elif scheme == "IOB":
+            is_begin = pos == 0 or cur_type != typ
+            is_end = False
+        elif scheme == "IOE":
+            is_begin = cur_type != typ or start is None
+            is_end = pos == 1
+        else:  # IOBES
+            is_begin = pos in (0, 3) or cur_type != typ
+            is_end = pos in (2, 3)
+        if is_begin:
+            close(i - 1)
+            start, cur_type = i, typ
+        if is_end:
+            close(i)
+    close(int(length) - 1)
+    return set(chunks)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """In-graph chunking metrics (reference: layers/nn.py chunk_eval ->
+    operators/chunk_eval_op.cc). TPU-native: the irregular chunk walk
+    runs as a host callback (py_func machinery) — metric ops are not on
+    the step's critical path. Inputs are padded [N, S] tag ids with a
+    lengths vector; returns (precision, recall, f1, num_infer_chunks,
+    num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval")
+    excl = tuple(excluded_chunk_types or ())
+
+    def _compute(inf, lab, lens):
+        import numpy as _np
+        n_inf = n_lab = n_cor = 0
+        for row in range(inf.shape[0]):
+            ln = int(lens[row]) if lens is not None else inf.shape[1]
+            ci = _extract_chunks(inf[row], ln, chunk_scheme,
+                                 num_chunk_types, excl)
+            cl = _extract_chunks(lab[row], ln, chunk_scheme,
+                                 num_chunk_types, excl)
+            n_inf += len(ci)
+            n_lab += len(cl)
+            n_cor += len(ci & cl)
+        p = n_cor / n_inf if n_inf else 0.0
+        r = n_cor / n_lab if n_lab else 0.0
+        f1 = 2 * p * r / (p + r) if n_cor else 0.0
+        return (_np.float32(p), _np.float32(r), _np.float32(f1),
+                _np.int32(n_inf), _np.int32(n_lab), _np.int32(n_cor))
+
+    outs = [helper.main_program.current_block().create_var(
+        name=unique_name.generate("chunk_eval_%d" % i),
+        shape=(), dtype=dt, stop_gradient=True)
+        for i, dt in enumerate(["float32", "float32", "float32",
+                                "int32", "int32", "int32"])]
+    xs = [input, label]
+    if seq_length is not None:
+        xs.append(seq_length)
+
+        def fn(inf, lab, lens):
+            return _compute(inf, lab, lens)
+    else:
+        def fn(inf, lab):
+            return _compute(inf, lab, None)
+
+    nn.py_func(fn, xs, outs)
+    return tuple(outs)

@@ -1392,3 +1392,309 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
     if act:
         out = _simple(act, out)
     return out
+
+
+# -- reference API-parity batch (round 3) -----------------------------------
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _simple("brelu", x, {"t_min": t_min, "t_max": t_max},
+                   name=name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _simple("soft_relu", x, {"threshold": threshold}, name=name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _simple("stanh", x, {"scale_a": scale_a,
+                                "scale_b": scale_b}, name=name)
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    return _simple("selu", x, attrs, name=name)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="avg", name=None):
+    return _simple("adaptive_pool3d", input,
+                   {"pool_size": pool_size, "pooling_type": pool_type},
+                   name=name)
+
+
+def conv3d_transpose(input, num_filters, filter_size, padding=0,
+                     stride=1, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """Reference: layers/nn.py conv3d_transpose ->
+    conv_transpose_op.cc (3-D)."""
+    helper = LayerHelper("conv3d_transpose", name=name, act=act)
+    c_in = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = helper.create_parameter(
+        attr=param_attr, shape=(c_in, num_filters // groups) + tuple(fs),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=(num_filters,),
+                                    dtype=input.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return _simple("dice_loss", input, {"epsilon": epsilon},
+                   extra_inputs={"Label": [label]})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    helper = LayerHelper("npair_loss")
+    out = helper.create_variable_for_type_inference(anchor.dtype)
+    helper.append_op(type="npair_loss",
+                     inputs={"Anchor": [anchor],
+                             "Positive": [positive],
+                             "Labels": [labels]},
+                     outputs={"Out": [out]},
+                     attrs={"l2_reg": l2_reg})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"X1": [left], "X2": [right],
+                             "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"margin": margin})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label,
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": soft_max_up_bound,
+                            "soft_max_lower_bound":
+                                soft_max_lower_bound})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _simple("similarity_focus", input,
+                   {"axis": axis, "indexes": tuple(indexes)},
+                   name=name, stop_gradient=True)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """Reference: layers/nn.py continuous_value_model -> cvm op."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm",
+                     inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]},
+                     attrs={"use_cvm": use_cvm})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"min": min, "max": max, "seed": seed})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """Reference: layers/nn.py data_norm -> data_norm_op.cc (CTR
+    normalization with learned batch statistics)."""
+    helper = LayerHelper("data_norm", name=name, act=act)
+    c = input.shape[-1]
+    size = helper.create_parameter(
+        attr=param_attr, shape=(c,), dtype=input.dtype,
+        default_initializer=Constant(1.0))
+    sum_ = helper.create_parameter(
+        attr=param_attr, shape=(c,), dtype=input.dtype,
+        default_initializer=Constant(0.0))
+    sqsum = helper.create_parameter(
+        attr=param_attr, shape=(c,), dtype=input.dtype,
+        default_initializer=Constant(1e-4))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype)
+    scales = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [size],
+                "BatchSum": [sum_], "BatchSquareSum": [sqsum]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1):
+    """Reference: layers/nn.py image_resize -> interpolate ops."""
+    enforce(resample in ("BILINEAR", "NEAREST"),
+            "resample must be BILINEAR or NEAREST")
+    if out_shape is None:
+        enforce(scale is not None, "need out_shape or scale")
+        h, w = input.shape[2], input.shape[3]
+        out_shape = (int(h * scale), int(w * scale))
+    op = "bilinear_interp" if resample == "BILINEAR" \
+        else "nearest_interp"
+    return _simple(op, input,
+                   {"out_h": int(out_shape[0]),
+                    "out_w": int(out_shape[1]),
+                    "align_corners": align_corners,
+                    "align_mode": align_mode}, name=name)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = input.shape[2], input.shape[3]
+    if h < w:
+        oh, ow = out_short_len, int(w * out_short_len / h)
+    else:
+        oh, ow = int(h * out_short_len / w), out_short_len
+    return image_resize(input, out_shape=(oh, ow), resample=resample)
+
+
+def random_crop(x, shape, seed=None):
+    from . import tensor as _t
+    helper = LayerHelper("random_crop")
+    if seed is None or isinstance(seed, int):
+        seed_var = _t.fill_constant((1,), "int64", seed or 0)
+    else:
+        seed_var = seed
+    out = helper.create_variable_for_type_inference(x.dtype)
+    seed_out = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    helper.append_op(type="random_crop",
+                     inputs={"X": [x], "Seed": [seed_var]},
+                     outputs={"Out": [out], "SeedOut": [seed_out]},
+                     attrs={"shape": tuple(shape)})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape), "mean": mean,
+                            "std": std, "dtype": dtype})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0,
+                                    std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(type="gaussian_random_batch_size_like",
+                     inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape), "mean": mean,
+                            "std": std, "dtype": dtype,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(type="uniform_random_batch_size_like",
+                     inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": tuple(shape), "min": min,
+                            "max": max, "dtype": dtype,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _simple("add_position_encoding", input,
+                   {"alpha": alpha, "beta": beta}, name=name)
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    attrs = {}
+    if isinstance(out_shape, (list, tuple)):
+        attrs["output_shape_attr"] = tuple(out_shape)
+        inputs = {"Theta": [theta]}
+    else:
+        inputs = {"Theta": [theta], "OutputShape": [out_shape]}
+    helper.append_op(type="affine_grid", inputs=inputs,
+                     outputs={"Output": [out]}, attrs=attrs)
+    return out
+
+
+def has_inf(x):
+    return _simple("has_inf", x, out_dtype="bool", stop_gradient=True)
+
+
+def has_nan(x):
+    return _simple("has_nan", x, out_dtype="bool", stop_gradient=True)
+
+
+def isfinite(x):
+    return _simple("isfinite", x, out_dtype="bool",
+                   stop_gradient=True)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _simple("hash", input,
+                   {"num_hash": num_hash, "mod_by": hash_size},
+                   out_dtype="int64", stop_gradient=True, name=name)
+
+
+def rank(input):
+    """Rank (ndim) of a variable as a constant tensor (reference:
+    layers/nn.py rank — build-time constant here, shapes are static)."""
+    from . import tensor as _t
+    import numpy as _np
+    return _t.assign(_np.array([len(input.shape)], _np.int32))
+
+
+def merge_selected_rows(x, name=None):
+    return _simple("merge_selected_rows", x, name=name)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _simple("get_tensor_from_selected_rows", x, name=name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    from . import math_op_patch as mop
+    return mop.binary(x, y, "elementwise_mod")
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    from . import math_op_patch as mop
+    return mop.binary(x, y, "elementwise_floordiv")

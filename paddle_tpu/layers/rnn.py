@@ -11,7 +11,8 @@ from __future__ import annotations
 from ..core.enforce import enforce
 from ..layer_helper import LayerHelper
 
-__all__ = ["dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit"]
+__all__ = ["dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
+           "lstm", "lstm_unit", "gru_unit"]
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
@@ -123,3 +124,80 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         attrs={"gate_activation": gate_activation,
                "activation": activation})
     return out_h
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None,
+                  bias_attr=None, use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None,
+                  h_0=None, c_0=None, seq_len=None):
+    """LSTM with a recurrent projection layer (reference: layers/nn.py
+    dynamic_lstmp -> lstmp_op.cc). ``input``: [B, T, 4*hidden]
+    pre-projected; returns (projection, cell)."""
+    enforce(size % 4 == 0, "dynamic_lstmp size must be 4*hidden_size")
+    helper = LayerHelper("lstmp", name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(attr=param_attr,
+                                     shape=(proj_size, 4 * hidden),
+                                     dtype=dtype)
+    proj_weight = helper.create_parameter(attr=param_attr,
+                                          shape=(hidden, proj_size),
+                                          dtype=dtype)
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    bias = helper.create_parameter(attr=bias_attr,
+                                   shape=(1, bias_size), dtype=dtype,
+                                   is_bias=True)
+    inputs = {"Input": [input], "Weight": [weight],
+              "ProjWeight": [proj_weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="lstmp", inputs=inputs,
+        outputs={"Projection": [proj], "Cell": [cell],
+                 "LastH": [last_h], "LastC": [last_c]},
+        attrs={"use_peepholes": use_peepholes,
+               "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1, seq_len=None):
+    """Multi-layer LSTM (reference: layers/nn.py lstm — the cudnn LSTM
+    wrapper; here each layer is the scan-lowered lstm op, stacked, and
+    the input carries its own projection per layer as the cudnn weight
+    blob did). ``input`` [B, T, D]; returns (out, last_h, last_c)."""
+    from . import nn as _nn
+    enforce(not is_bidirec, "is_bidirec=True: use two stacks with "
+            "is_reverse and concat (cudnn bidirectional blob layout "
+            "has no TPU analog)")
+    helper = LayerHelper("lstm_stack", name=name)
+    x = input
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        proj = _nn.fc(x, 4 * hidden_size, num_flatten_dims=2,
+                      bias_attr=False,
+                      name=(name or "lstm") + "_in%d" % layer)
+        h, c = dynamic_lstm(proj, 4 * hidden_size,
+                            use_peepholes=False,
+                            name=(name or "lstm") + "_l%d" % layer,
+                            seq_len=seq_len)
+        if dropout_prob and not is_test:
+            h = _nn.dropout(h, dropout_prob)
+        x = h
+        last_hs.append(h)
+        last_cs.append(c)
+    return x, last_hs[-1], last_cs[-1]

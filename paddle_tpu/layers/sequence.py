@@ -11,7 +11,8 @@ __all__ = ["sequence_pool", "sequence_softmax", "sequence_reverse",
            "sequence_expand", "sequence_expand_as", "sequence_pad",
            "sequence_unpad", "sequence_concat", "sequence_slice",
            "sequence_enumerate", "sequence_first_step",
-           "sequence_last_step", "beam_search", "beam_search_decode"]
+           "sequence_last_step", "sequence_conv", "sequence_reshape",
+           "sequence_scatter", "beam_search", "beam_search_decode"]
 
 
 def _seq_op(op_type, x, seq_len, attrs=None, name=None,
@@ -176,3 +177,61 @@ def beam_search_decode(ids, parents, scores, beam_size=0, end_id=0,
                  "SentenceScores": [sent_scores]},
         attrs={"beam_size": beam_size, "end_id": end_id})
     return sent_ids, sent_scores
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None,
+                  act=None, name=None, seq_len=None):
+    """Context-window convolution over a padded sequence (reference:
+    layers/nn.py sequence_conv -> sequence_conv_op.cc)."""
+    helper = LayerHelper("sequence_conv", name=name, act=act)
+    d = input.shape[-1]
+    filt = helper.create_parameter(
+        attr=param_attr, shape=(filter_size * d, num_filters),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "Filter": [filt]}
+    if seq_len is not None:
+        inputs["Lengths"] = [seq_len]
+    helper.append_op(type="sequence_conv", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"context_length": filter_size,
+                            "context_stride": filter_stride,
+                            "context_start":
+                                None if padding else 0})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=(num_filters,),
+                                    dtype=input.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=2)
+    return helper.append_activation(out)
+
+
+def sequence_reshape(input, new_dim, seq_len=None):
+    """Reference: layers/nn.py sequence_reshape ->
+    sequence_reshape_op.cc. Returns (out, out_lengths) — the padded
+    redesign surfaces the recomputed lengths explicitly."""
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    inputs = {"X": [input]}
+    if seq_len is not None:
+        inputs["Lengths"] = [seq_len]
+    helper.append_op(type="sequence_reshape", inputs=inputs,
+                     outputs={"Out": [out], "OutLengths": [out_len]},
+                     attrs={"new_dim": new_dim})
+    return out, out_len
+
+
+def sequence_scatter(input, index, updates, name=None, seq_len=None):
+    """Reference: layers/nn.py sequence_scatter ->
+    sequence_scatter_op.cc."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if seq_len is not None:
+        inputs["Lengths"] = [seq_len]
+    helper.append_op(type="sequence_scatter", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out

@@ -227,3 +227,69 @@ def _getitem(var, item):
     if squeeze_axes:
         out = nn.squeeze(out, squeeze_axes)
     return out
+
+
+def sum(x):
+    """Alias of ``sums`` matching the reference export (layers.sum ->
+    sum_op.cc: elementwise sum of a var list)."""
+    return sums(x if isinstance(x, (list, tuple)) else [x])
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="reverse", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": tuple(axis)
+                            if isinstance(axis, (list, tuple))
+                            else (axis,)})
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pow", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"factor": factor})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Reference: layers/tensor.py tensor_array_to_tensor ->
+    tensor_array_to_tensor_op.cc. Returns (tensor, index)."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    helper.append_op(type="tensor_array_to_tensor",
+                     inputs={"Array": [input]},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": axis, "use_stack": use_stack})
+    return out, idx
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 step counter incremented once per run
+    (reference: layers/tensor.py autoincreased_step_counter — used by
+    learning-rate schedules)."""
+    from .. import framework
+    helper = LayerHelper("step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    startup = helper.startup_program
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        return block.var(name)
+    counter = block.create_var(name=name, shape=(1,), dtype="int64",
+                               persistable=True, stop_gradient=True)
+    if startup is not None:
+        sb = startup.global_block()
+        sv = sb.create_var(name=name, shape=(1,), dtype="int64",
+                           persistable=True, stop_gradient=True)
+        sb.append_op(type="fill_constant", outputs={"Out": [sv]},
+                     attrs={"shape": (1,), "dtype": "int64",
+                            "value": float(begin - step)})
+    helper.append_op(type="increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]},
+                     attrs={"step": float(step)})
+    return counter

@@ -290,3 +290,18 @@ def array_read(array, i):
 @register("array_length", ["Array"], ["Out"], differentiable=False)
 def array_length(array):
     return jnp.asarray([len(array)], dtype=jnp.int64)
+
+
+@register("tensor_array_to_tensor", ["Array"], ["Out", "OutIndex"],
+          differentiable=False)
+def tensor_array_to_tensor(array, *, axis=0, use_stack=False):
+    """Reference: operators/tensor_array_to_tensor_op.cc — stack or
+    concat a LoDTensorArray; OutIndex records per-entry extents."""
+    enforce(array, "tensor_array_to_tensor on an empty array")
+    if use_stack:
+        out = jnp.stack(array, axis=axis)
+        index = jnp.full((len(array),), 1, jnp.int32)
+    else:
+        out = jnp.concatenate(array, axis=axis)
+        index = jnp.asarray([t.shape[axis] for t in array], jnp.int32)
+    return out, index

@@ -583,3 +583,88 @@ def label_smooth(x, prior_dist=None, *, epsilon=0.1):
     if prior_dist is not None:
         return (1.0 - epsilon) * x + epsilon * prior_dist
     return (1.0 - epsilon) * x + epsilon / k
+
+
+@register("brelu", ["X"], ["Out"])
+def brelu(x, *, t_min=0.0, t_max=24.0):
+    """Reference: operators/activation_op.cc BRelu."""
+    return jnp.clip(x, t_min, t_max)
+
+
+@register("soft_relu", ["X"], ["Out"])
+def soft_relu(x, *, threshold=40.0):
+    """Reference: activation_op.cc SoftRelu: log(1 + exp(clip(x)))."""
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+@register("stanh", ["X"], ["Out"])
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    """Reference: activation_op.cc STanh."""
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register("adaptive_pool3d", ["X"], ["Out"])
+def adaptive_pool3d(x, *, pool_size, pooling_type="avg"):
+    """Reference: pool_op.cc adaptive 3-D (NCDHW); each output cell
+    averages/maxes its evenly split input region."""
+    n, c, d, h, w = x.shape
+    od, oh, ow = (pool_size if isinstance(pool_size, (list, tuple))
+                  else (pool_size,) * 3)
+    x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    if pooling_type == "max":
+        return jnp.max(x, axis=(3, 5, 7))
+    return jnp.mean(x, axis=(3, 5, 7))
+
+
+@register("dice_loss", ["X", "Label"], ["Out"], nondiff=("Label",))
+def dice_loss(x, label, *, epsilon=1e-5):
+    """Reference: layers/nn.py dice_loss (composite in the reference
+    python layer): 1 - 2*|X∩L| / (|X|+|L|), reduced over all but the
+    batch dim."""
+    label = label.astype(x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label,
+                                                   axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@register("npair_loss", ["Anchor", "Positive", "Labels"], ["Out"],
+          nondiff=("Labels",))
+def npair_loss(anchor, positive, labels, *, l2_reg=0.002):
+    """Reference: layers/loss.py npair_loss composite — softmax
+    cross-entropy over anchor·positiveᵀ similarities with same-label
+    targets, plus l2 regularization on the embeddings."""
+    sim = jnp.dot(anchor, positive.T)                   # [B, B]
+    lab = labels.reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True),
+                             1.0)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+                    + jnp.mean(jnp.sum(jnp.square(positive),
+                                       axis=1))) / 2.0
+    return ce + reg
+
+
+@register("similarity_focus", ["X"], ["Out"], differentiable=False)
+def similarity_focus(x, *, axis, indexes):
+    """Reference: operators/similarity_focus_op.cc — build a 0/1
+    focus mask: for each selected channel index along ``axis``, mark
+    the argmax positions per remaining row/col (NCHW only, axis=1 as
+    the reference supports)."""
+    n, c, h, w = x.shape
+    out = jnp.zeros_like(x)
+    for idx in indexes:
+        sl = x[:, idx]                                  # [N, H, W]
+        row_best = jnp.argmax(sl, axis=2)               # [N, H]
+        col_best = jnp.argmax(sl, axis=1)               # [N, W]
+        mask = jnp.zeros((n, h, w), x.dtype)
+        mask = mask.at[jnp.arange(n)[:, None],
+                       jnp.arange(h)[None, :], row_best].set(1.0)
+        mask = mask.at[jnp.arange(n)[:, None], col_best,
+                       jnp.arange(w)[None, :]].set(1.0)
+        out = out + mask[:, None, :, :] * jnp.ones((1, c, 1, 1),
+                                                   x.dtype)
+    return jnp.minimum(out, 1.0)

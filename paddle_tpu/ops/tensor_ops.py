@@ -404,3 +404,33 @@ def cross_entropy2(x, label):
     lab = label.reshape(label.shape[0], -1).astype(jnp.int32)
     match = jnp.take_along_axis(x, lab, axis=-1)
     return -jnp.log(jnp.maximum(match, 1e-20)), match
+
+
+@register("has_inf", ["X"], ["Out"], differentiable=False)
+def has_inf(x):
+    """Reference: operators/isfinite_op.cc (overflow check family)."""
+    return jnp.any(jnp.isinf(x))
+
+
+@register("has_nan", ["X"], ["Out"], differentiable=False)
+def has_nan(x):
+    return jnp.any(jnp.isnan(x))
+
+
+@register("hash", ["X"], ["Out"], differentiable=False)
+def hash_op(x, *, num_hash=1, mod_by=100000000):
+    """Reference: operators/hash_op.cc (xxhash of int-id rows). TPU
+    redesign: a splitmix-style integer mix per hash seed — same
+    contract (deterministic bucketed ids in [0, mod_by)), vectorizes
+    on the VPU instead of calling a byte-stream hasher."""
+    ids = x.astype(jnp.uint32)
+    outs = []
+    for seed in range(num_hash):
+        h = ids * jnp.uint32(0x9E3779B9) + jnp.uint32(seed * 0x85EBCA6B)
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x45D9F3B)
+        h = h ^ (h >> 16)
+        # fold the row's element hashes into one bucket per row
+        outs.append(jnp.sum(h, axis=-1, dtype=jnp.uint32))
+    out = jnp.stack(outs, axis=-1).astype(jnp.int64)
+    return jnp.abs(out) % mod_by

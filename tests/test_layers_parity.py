@@ -1,0 +1,204 @@
+"""Round-3 API-parity layer batch: every layer name the reference
+exports that gained a wrapper this round builds AND executes
+(reference: the layers __all__ sweep across
+python/paddle/fluid/layers/*.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_activation_and_check_layers_run():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        f = layers.data("f", shape=[4])
+        outs = [layers.brelu(f, -0.5, 0.5), layers.soft_relu(f),
+                layers.stanh(f), layers.selu(f),
+                layers.has_inf(f), layers.has_nan(f),
+                layers.pow(f, 2.0), layers.reverse(f, axis=1),
+                layers.sum([f, f]), layers.rank(f)]
+    exe = fluid.Executor()
+    exe.run(startup)
+    fv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    res = exe.run(main, feed={"f": fv}, fetch_list=outs)
+    np.testing.assert_allclose(res[6], fv ** 2, rtol=1e-6)
+    np.testing.assert_allclose(res[7], fv[:, ::-1], rtol=1e-6)
+    np.testing.assert_allclose(res[8], 2 * fv, rtol=1e-6)
+    assert int(res[9][0]) == 2
+    assert not bool(res[4]) and not bool(res[5])
+
+
+def test_losses_and_misc_layers_run():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        anchor = layers.data("anchor", shape=[8])
+        pos = layers.data("pos", shape=[8])
+        labs = layers.data("labs", shape=[1], dtype="int64")
+        nl = layers.npair_loss(anchor, pos, labs)
+        dl = layers.dice_loss(
+            layers.sigmoid(anchor),
+            layers.cast(layers.data("dlbl", shape=[8]), "float32"))
+        mr = layers.margin_rank_loss(
+            layers.data("rl", shape=[1]), layers.data("l1", shape=[1]),
+            layers.data("r1", shape=[1]), margin=0.1)
+        ts = layers.teacher_student_sigmoid_loss(
+            layers.data("tsx", shape=[1]),
+            layers.data("tsy", shape=[1]))
+        dn = layers.data_norm(layers.data("dnx", shape=[6]))
+        sid = layers.sampling_id(
+            layers.softmax(layers.data("lg", shape=[7])))
+        hs = layers.hash(layers.data("ids", shape=[5], dtype="int64"),
+                         hash_size=997, num_hash=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(1)
+    feed = {"anchor": rs.randn(4, 8).astype(np.float32),
+            "pos": rs.randn(4, 8).astype(np.float32),
+            "labs": rs.randint(0, 2, (4, 1)).astype(np.int64),
+            "dlbl": (rs.rand(4, 8) > 0.5).astype(np.float32),
+            "rl": np.ones((2, 1), np.float32),
+            "l1": rs.rand(2, 1).astype(np.float32),
+            "r1": rs.rand(2, 1).astype(np.float32),
+            "tsx": rs.randn(2, 1).astype(np.float32),
+            "tsy": rs.rand(2, 1).astype(np.float32),
+            "dnx": rs.randn(2, 6).astype(np.float32),
+            "lg": rs.randn(2, 7).astype(np.float32),
+            "ids": rs.randint(0, 50, (2, 5)).astype(np.int64)}
+    res = exe.run(main, feed=feed,
+                  fetch_list=[nl, dl, mr, ts, dn, sid, hs])
+    assert all(np.isfinite(np.asarray(r)).all() for r in res[:5])
+    assert ((np.asarray(res[6]) >= 0) &
+            (np.asarray(res[6]) < 997)).all()
+    # hash is deterministic
+    res2 = exe.run(main, feed=feed, fetch_list=[hs])
+    np.testing.assert_array_equal(np.asarray(res[6]),
+                                  np.asarray(res2[0]))
+
+
+def test_vision_and_random_layers_run():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 8, 8])
+        v3 = layers.data("v3", shape=[4, 6, 6])
+        ap3 = layers.adaptive_pool3d(
+            layers.data("vol", shape=[2, 4, 8, 8]), 2)
+        sf = layers.similarity_focus(v3, axis=1, indexes=[0, 2])
+        rc = layers.random_crop(v3, shape=(4, 4, 4))
+        ir = layers.image_resize(img, out_shape=(16, 16))
+        irs = layers.image_resize_short(img, 12)
+        g = layers.gaussian_random((3, 4))
+        gb = layers.gaussian_random_batch_size_like(img, (-1, 5))
+        ub = layers.uniform_random_batch_size_like(img, (-1, 6))
+        ape = layers.add_position_encoding(
+            layers.data("seq", shape=[6, 8]), 1.0, 1.0)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(2)
+    feed = {"img": rs.rand(2, 3, 8, 8).astype(np.float32),
+            "v3": rs.rand(2, 4, 6, 6).astype(np.float32),
+            "vol": rs.rand(2, 2, 4, 8, 8).astype(np.float32),
+            "seq": rs.rand(2, 6, 8).astype(np.float32)}
+    res = exe.run(main, feed=feed,
+                  fetch_list=[ap3, sf, rc, ir, irs, g, gb, ub, ape])
+    assert np.asarray(res[0]).shape == (2, 2, 2, 2, 2)
+    sfv = np.asarray(res[1])
+    assert set(np.unique(sfv)) <= {0.0, 1.0} and sfv.sum() > 0
+    assert np.asarray(res[2]).shape == (2, 4, 4, 4)
+    assert np.asarray(res[3]).shape == (2, 3, 16, 16)
+    assert np.asarray(res[4]).shape[2] == 12 or \
+        np.asarray(res[4]).shape[3] == 12
+    assert np.asarray(res[6]).shape == (2, 5)
+    assert np.asarray(res[7]).shape == (2, 6)
+
+
+def test_sequence_and_rnn_wrappers_run():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        seq = layers.data("seq", shape=[6, 8])
+        lens = layers.reshape(
+            layers.data("lens", shape=[1], dtype="int64"), (-1,))
+        sconv = layers.sequence_conv(seq, 16, 3, seq_len=lens)
+        sresh, srl = layers.sequence_reshape(seq, 4, seq_len=lens)
+        lstmp_in = layers.fc(seq, 32, num_flatten_dims=2,
+                             bias_attr=False)
+        proj, cell = layers.dynamic_lstmp(lstmp_in, 32, 5)
+        lout, lh, lc = layers.lstm(seq, None, None, 6, 8, 2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(3)
+    feed = {"seq": rs.rand(2, 6, 8).astype(np.float32),
+            "lens": np.array([[6], [4]], np.int64)}
+    res = exe.run(main, feed=feed,
+                  fetch_list=[sconv, sresh, proj, lout])
+    assert np.asarray(res[0]).shape == (2, 6, 16)
+    assert np.asarray(res[1]).shape == (2, 12, 4)
+    assert np.asarray(res[2]).shape == (2, 6, 5)
+    assert np.asarray(res[3]).shape == (2, 6, 8)
+
+
+def test_tensor_array_to_tensor_and_counter():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[3])
+        arr = layers.create_array("float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        layers.array_write(a, i0, array=arr)
+        layers.array_write(a * 2.0, i1, array=arr)
+        stacked, _ = layers.tensor_array_to_tensor(arr, axis=0,
+                                                   use_stack=True)
+        cat, idx = layers.tensor_array_to_tensor(arr, axis=0)
+        counter = layers.autoincreased_step_counter()
+    exe = fluid.Executor()
+    exe.run(startup)
+    av = np.arange(6, dtype=np.float32).reshape(2, 3)
+    s1, c1, ix, ct1 = exe.run(
+        main, feed={"a": av}, fetch_list=[stacked, cat, idx, counter])
+    assert s1.shape == (2, 2, 3)
+    np.testing.assert_allclose(c1, np.concatenate([av, 2 * av]))
+    np.testing.assert_array_equal(ix, [2, 2])
+    (ct2,) = exe.run(main, feed={"a": av}, fetch_list=[counter])
+    assert int(ct2[0]) == int(ct1[0]) + 1
+
+
+def test_chunk_eval_iob():
+    """chunk_eval host-callback op on a hand-checked IOB case."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = layers.data("inf", shape=[6], dtype="int64")
+        lab = layers.data("lab", shape=[6], dtype="int64")
+        p, r, f1, ni, nl, nc = layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    O = 4  # outside tag for 2 types * 2 tags
+    # label: [B0 I0 O B1 I1 O]; infer: [B0 I0 O B1 O O]
+    labv = np.array([[0, 1, O, 2, 3, O]], np.int64)
+    infv = np.array([[0, 1, O, 2, O, O]], np.int64)
+    pv, rv, fv, niv, nlv, ncv = exe.run(
+        main, feed={"inf": infv, "lab": labv},
+        fetch_list=[p, r, f1, ni, nl, nc])
+    assert int(niv) == 2 and int(nlv) == 2 and int(ncv) == 1
+    np.testing.assert_allclose(float(pv), 0.5)
+    np.testing.assert_allclose(float(rv), 0.5)
+
+
+def test_elementwise_mod_floordiv():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="int64")
+        y = layers.data("y", shape=[4], dtype="int64")
+        m = layers.elementwise_mod(x, y)
+        fd = layers.elementwise_floordiv(x, y)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.array([[7, 9, 10, 3]], np.int64)
+    yv = np.array([[2, 4, 3, 5]], np.int64)
+    mv, fv = exe.run(main, feed={"x": xv, "y": yv},
+                     fetch_list=[m, fd])
+    np.testing.assert_array_equal(mv, xv % yv)
+    np.testing.assert_array_equal(fv, xv // yv)

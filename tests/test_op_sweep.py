@@ -628,6 +628,34 @@ spec("fsp_matrix",
      ref=lambda ins: [np.einsum("bihw,bjhw->bij", ins["X"],
                                 ins["Y"]) / 16.0])
 
+spec("brelu", {"X": sgn((2, 4), 750)}, {"t_min": -0.5, "t_max": 0.5},
+     ref=lambda ins: [np.clip(ins["X"], -0.5, 0.5)])
+spec("soft_relu", {"X": sgn((2, 4), 751)}, {"threshold": 40.0},
+     ref=lambda ins: [np.log1p(np.exp(ins["X"]))])
+spec("stanh", {"X": sgn((2, 4), 752)},
+     {"scale_a": 0.67, "scale_b": 1.7159},
+     ref=lambda ins: [1.7159 * np.tanh(0.67 * ins["X"])])
+spec("adaptive_pool3d", {"X": u((1, 2, 4, 4, 4), 753)},
+     {"pool_size": 2, "pooling_type": "avg"},
+     ref=lambda ins: [ins["X"].reshape(1, 2, 2, 2, 2, 2, 2, 2)
+                      .mean(axis=(3, 5, 7))])
+spec("dice_loss", {"X": u((2, 4), 754, lo=0.1, hi=0.9),
+                   "Label": (u((2, 4), 755) > 0.6)
+                   .astype(np.float32)},
+     ref=lambda ins: [np.float32(np.mean(
+         1 - (2 * (ins["X"] * ins["Label"]).sum(1) + 1e-5)
+         / (ins["X"].sum(1) + ins["Label"].sum(1) + 1e-5)))])
+spec("npair_loss", {"Anchor": sgn((3, 4), 756),
+                    "Positive": sgn((3, 4), 757),
+                    "Labels": np.array([[0], [1], [0]], np.int64)},
+     {"l2_reg": 0.0}, max_rel=0.02)
+spec("has_inf", {"X": np.array([1.0, np.inf], np.float32)},
+     ref=lambda ins: [np.bool_(True)])
+spec("has_nan", {"X": np.array([1.0, 2.0], np.float32)},
+     ref=lambda ins: [np.bool_(False)])
+spec("hash", {"X": np.array([[1, 2], [3, 4]], np.int64)},
+     {"num_hash": 2, "mod_by": 1000})
+
 # --- optimizer update ops: independent numpy references --------------
 # (replacing the former test-file exemptions — the sweep now checks
 # each update rule against the textbook equations directly)
@@ -1399,6 +1427,9 @@ EXEMPT = {
     "distribute_fpn_proposals": "test_detection.py",
     "collect_fpn_proposals": "test_detection.py",
     "yolo_box": "test_detection.py",
+    "similarity_focus": "test_layers_parity.py (mask semantics)",
+    "tensor_array_to_tensor":
+        "test_layers_parity.py (stack/concat round trip)",
     "generate_proposal_labels":
         "test_detection.py (TestMaskRCNNTargets quota/targets/determinism)",
     "generate_mask_labels":
