@@ -22,10 +22,21 @@ import time
 import numpy as np
 
 _T0 = time.time()
+
+
+def _env_float(name, default):
+    """A malformed env override must degrade to the default, not crash
+    the harness before its JSON line (the rc=1/parsed=null mode)."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 # Soft wall-clock budget: the optional pallas re-timing is skipped once
 # exceeded, so one slow compile (cold tunnel) degrades the measurement
 # instead of timing out the whole bench run.
-_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
+_BUDGET_S = _env_float("BENCH_BUDGET_S", 900.0)
 
 
 def _log(msg):
@@ -402,7 +413,7 @@ def _emit(headline):
         print(json.dumps(headline), flush=True)
 
 
-def _arm_watchdog(headline):
+def _arm_watchdog(headline, delay=None):
     """The axon tunnel can HANG (not fail) inside the first device
     claim — observed r2/r3: jax.devices() blocks indefinitely, so no
     except-clause can save the run. A daemon timer guarantees the
@@ -426,33 +437,50 @@ def _arm_watchdog(headline):
         _emit(headline)
         os._exit(0)
 
-    t = threading.Timer(_BUDGET_S + 120.0, fire)
+    t = threading.Timer(delay if delay is not None else _BUDGET_S + 120.0,
+                        fire)
     t.daemon = True
     t.start()
     return t
 
 
-def _claim_device_with_retry():
-    """Initialize the JAX backend, retrying with backoff.
+_CLAIM_SENTINEL = "BENCH_CLAIMED "
 
-    Round 2 lost its entire perf record because one transient tunnel
-    failure ("Unable to initialize backend 'axon': UNAVAILABLE") became
-    an uncaught traceback and the driver captured rc=1/parsed=null. A
-    bench harness must degrade, not die: retry inside the soft budget,
-    and let the caller emit the JSON line with an error field if the
-    backend never comes up."""
+
+def _claim_device_with_retry():
+    """Initialize the JAX backend (child process side).
+
+    Two observed failure modes, handled at different layers:
+    - backend init RAISES ("Unable to initialize backend 'axon':
+      UNAVAILABLE", round 2): cheap — retry here with backoff, bounded
+      well under the parent's claim timeout so the child exits and the
+      parent does the long backoff.
+    - backend init BLOCKS (rounds 2/3: jax.devices() hangs the thread
+      indefinitely): no except-clause can fire. The child prints a
+      BENCH_CLAIMED sentinel to stdout (which the parent drains) the
+      moment the claim succeeds; the parent kills any child whose
+      sentinel hasn't appeared within the claim timeout and re-forks
+      with backoff. That converts a long outage into several genuine
+      attempts instead of one doomed one."""
     import jax
+    bound = min(_BUDGET_S / 2,
+                _env_float("BENCH_CLAIM_TIMEOUT_S", 240.0) * 0.8)
     delay, last = 5.0, None
     while True:
+        dev = None
         try:
             dev = jax.devices()[0]
             _log("device: %s" % dev.device_kind)
-            return None
         except Exception as e:  # RuntimeError: backend init failed
             last = e
             _log("backend init failed: %r" % e)
-        # leave at least half the budget for the actual measurement
-        if time.time() - _T0 + delay > _BUDGET_S / 2:
+        if dev is not None:
+            # stdout (not a tmpfile): the parent already drains this
+            # pipe, so the claim signal can't be lost to an unwritable
+            # tempdir; the parent filters the sentinel back out
+            print(_CLAIM_SENTINEL + dev.device_kind, flush=True)
+            return None
+        if time.time() - _T0 + delay > bound:
             return last
         _log("retrying device claim in %.0fs" % delay)
         time.sleep(delay)
@@ -467,12 +495,16 @@ def _smoke_overrides():
                 compare_libs=False)
 
 
-def main():
+def _degraded_headline():
     # value stays null unless a measurement actually completed, so a
     # degraded run can never be mistaken for a measured 0 tokens/sec
-    headline = {"metric": "transformer_base_train_throughput",
-                "value": None, "unit": "tokens/sec/chip",
-                "vs_baseline": None, "mfu": None}
+    return {"metric": "transformer_base_train_throughput",
+            "value": None, "unit": "tokens/sec/chip",
+            "vs_baseline": None, "mfu": None}
+
+
+def child_main():
+    headline = _degraded_headline()
     _arm_watchdog(headline)
     smoke = False
     try:
@@ -548,6 +580,156 @@ def main():
             except Exception as e:
                 print(json.dumps({"metric": fn.__name__,
                                   "error": repr(e)}), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# parent orchestrator: killable-subprocess device claim
+# ---------------------------------------------------------------------------
+
+def _kill_child(proc):
+    """TERM first (lets the axon relay release the grant), KILL after a
+    short grace so a wedged PJRT client can't outlive its attempt."""
+    import signal
+    try:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+            return
+        except Exception:
+            pass
+        proc.kill()
+        proc.wait(timeout=10)
+    except Exception:
+        pass
+
+
+def parent_main():
+    """Run the measurement in a killable child process.
+
+    Rounds 2 and 3 both lost their perf record to the same failure
+    mode: the first axon device claim BLOCKS (never raises), so every
+    in-process retry/backoff path is unreachable and only a watchdog's
+    os._exit saves the JSON contract. The fix is structural: this
+    parent never initializes JAX (the sitecustomize only registers the
+    PJRT plugin; the claim happens at backend init), forks bench.py
+    --child per attempt, kills any child whose claim sentinel hasn't
+    appeared within BENCH_CLAIM_TIMEOUT_S (default 240s), and re-forks
+    with backoff until the budget is spent. A 15-minute outage becomes
+    ~3 genuine claim attempts; a successful claim gets the remaining
+    budget to measure (compiles amortized by .jax_cache)."""
+    deadline = _T0 + _BUDGET_S
+    claim_timeout = _env_float("BENCH_CLAIM_TIMEOUT_S", 240.0)
+    grace = 120.0
+    degraded = _degraded_headline()
+    wd = _arm_watchdog(degraded, delay=_BUDGET_S + grace + 60.0)
+
+    last_error = None
+    try:
+        outcome = _parent_attempt_loop(deadline, claim_timeout, grace)
+        if outcome is True:  # child measured; its lines were forwarded
+            wd.cancel()
+            return
+        last_error = outcome
+    except BaseException as e:  # never die without the JSON line
+        last_error = repr(e)
+    degraded["error"] = last_error or "no attempt completed in budget"
+    _emit(degraded)
+    wd.cancel()
+
+
+def _parent_attempt_loop(deadline, claim_timeout, grace):
+    """Fork/monitor/kill children until one measures or the budget is
+    spent. Returns True after forwarding a successful child's output,
+    else the last error string."""
+    import subprocess
+    import threading
+
+    delay, attempt, last_error = 20.0, 0, None
+    # first attempt unconditionally (small smoke budgets must still
+    # measure); later attempts only while enough budget remains
+    while attempt == 0 or time.time() < deadline - 45:
+        attempt += 1
+        env = os.environ.copy()
+        env["BENCH_BUDGET_S"] = "%.0f" % max(deadline - time.time() - 15,
+                                             60)
+        _log("attempt %d: forking child (claim timeout %.0fs)"
+             % (attempt, claim_timeout))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"]
+            + sys.argv[1:],
+            stdout=subprocess.PIPE, env=env, text=True)
+        lines = []
+
+        def drain(stream=proc.stdout, sink=lines):
+            for ln in stream:
+                sink.append(ln.rstrip("\n"))
+
+        rd = threading.Thread(target=drain, daemon=True)
+        rd.start()
+        t_start, claimed, kill_reason = time.time(), False, None
+        while proc.poll() is None:
+            time.sleep(2.0)
+            if not claimed and any(ln.startswith(_CLAIM_SENTINEL)
+                                   for ln in list(lines)):
+                claimed = True
+                _log("attempt %d: device claimed after %.0fs"
+                     % (attempt, time.time() - t_start))
+            if not claimed and time.time() - t_start > claim_timeout:
+                kill_reason = ("claim timed out after %.0fs (backend "
+                               "hang)" % claim_timeout)
+                break
+            if time.time() > deadline + grace:
+                kill_reason = "budget exceeded"
+                break
+        if kill_reason:
+            _log("attempt %d: killing child: %s" % (attempt, kill_reason))
+            _kill_child(proc)
+        rd.join(timeout=10)
+        lines = [ln for ln in lines
+                 if not ln.startswith(_CLAIM_SENTINEL)]
+        headline = None
+        for ln in lines:
+            try:
+                obj = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                headline = obj
+                break
+        if headline is not None and headline.get("value") is not None:
+            for ln in lines:  # headline plus any --all extras
+                print(ln, flush=True)
+            return True
+        prev_error, last_error = last_error, \
+            (headline.get("error") if headline else None) \
+            or kill_reason or ("child exited rc=%s without a measurement"
+                               % proc.returncode)
+        _log("attempt %d failed: %s" % (attempt, last_error))
+        if kill_reason == "budget exceeded":
+            break
+        # a child that exits ON ITS OWN almost immediately with the
+        # same error twice is deterministic (bad flag, ImportError) —
+        # transient claim failures either hang (killed above) or are
+        # retried in-child for minutes first. Don't burn the chip
+        # window re-forking a doomed child.
+        if (kill_reason is None and time.time() - t_start < 30
+                and last_error == prev_error):
+            _log("identical fast failure twice — not retrying")
+            break
+        remaining = deadline - time.time()
+        if remaining < delay + 45:
+            break
+        _log("retrying in %.0fs (%.0fs budget left)" % (delay, remaining))
+        time.sleep(delay)
+        delay = min(delay * 2, 120.0)
+    return last_error
+
+
+def main():
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        parent_main()
 
 
 if __name__ == "__main__":
