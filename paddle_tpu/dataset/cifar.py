@@ -1,12 +1,26 @@
 """CIFAR reader creators (reference: python/paddle/dataset/cifar.py —
-train10()/test10() yield (3072-float32 in [0,1], int label))."""
+train10()/test10() yield (3072-float32 in [0,1], int label)).
+
+Real data: drop ``cifar-10-python.tar.gz`` / ``cifar-100-python.tar.gz``
+under ``DATA_HOME/cifar/`` and the pickled batches inside are parsed
+(reference: cifar.py:48-74 — members matched by substring, ``data`` +
+``labels``/``fine_labels`` keys, values scaled by 1/255). Synthetic
+fallback otherwise."""
 
 from __future__ import annotations
 
+import pickle
+import tarfile
+
 import numpy as np
+
+from . import common
 
 TRAIN_SIZE = 4096
 TEST_SIZE = 512
+
+_CIFAR10 = "cifar-10-python.tar.gz"
+_CIFAR100 = "cifar-100-python.tar.gz"
 
 
 def _sample(idx, classes):
@@ -25,17 +39,47 @@ def _creator(n, base, classes):
     return reader
 
 
+def _real_creator(archive, sub_name):
+    """Parse the pickled python-version batches (reference
+    cifar.py:48-74: members whose name contains ``sub_name``; labels
+    under ``labels`` (cifar10) or ``fine_labels`` (cifar100))."""
+    def reader():
+        path = common.data_path("cifar", archive)
+        with tarfile.open(path, mode="r") as f:
+            names = sorted(m.name for m in f if sub_name in m.name)
+            for name in names:
+                batch = pickle.load(f.extractfile(name),
+                                    encoding="bytes")
+                data = batch[b"data"]
+                labels = batch.get(b"labels",
+                                   batch.get(b"fine_labels"))
+                if labels is None:
+                    raise ValueError("no labels in cifar batch %r"
+                                     % name)
+                for sample, label in zip(data, labels):
+                    yield ((np.asarray(sample) / 255.0)
+                           .astype(np.float32), int(label))
+
+    return reader
+
+
+def _pick(archive, sub_name, n, base, classes):
+    if common.have_file("cifar", archive):
+        return _real_creator(archive, sub_name)
+    return _creator(n, base, classes)
+
+
 def train10():
-    return _creator(TRAIN_SIZE, 0, 10)
+    return _pick(_CIFAR10, "data_batch", TRAIN_SIZE, 0, 10)
 
 
 def test10():
-    return _creator(TEST_SIZE, 5_000_000, 10)
+    return _pick(_CIFAR10, "test_batch", TEST_SIZE, 5_000_000, 10)
 
 
 def train100():
-    return _creator(TRAIN_SIZE, 0, 100)
+    return _pick(_CIFAR100, "train", TRAIN_SIZE, 0, 100)
 
 
 def test100():
-    return _creator(TEST_SIZE, 5_000_000, 100)
+    return _pick(_CIFAR100, "test", TEST_SIZE, 5_000_000, 100)

@@ -5,12 +5,25 @@ Reference: python/paddle/dataset/conll05.py — test() yields
 label_ids): per-token word ids, five predicate-context windows
 broadcast over the sentence, predicate ids, a 0/1 predicate-adjacency
 mark, and IOB label ids; get_dict() returns (word_dict, verb_dict,
-label_dict). Synthetic sentences follow the exact field conventions.
+label_dict).
+
+Real data under ``DATA_HOME/conll05st/``: ``conll05st-tests.tar.gz``
+(the words/props gz members, parsed with the reference's bracket->IOB
+algorithm, conll05.py:76-147) plus ``wordDict.txt`` / ``verbDict.txt``
+/ ``targetDict.txt`` (one entry per line; the label dict expands each
+tag into B-/I- pairs with O last, conll05.py:49-65 — tags sorted here
+for determinism where the reference iterates a set). Synthetic
+sentences with the exact field conventions otherwise.
 """
 
 from __future__ import annotations
 
+import gzip
+import tarfile
+
 import numpy as np
+
+from . import common
 
 __all__ = ["get_dict", "get_embedding", "test"]
 
@@ -19,8 +32,52 @@ _VERBS = 200
 _LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
 _TEST_SIZE = 512
 
+UNK_IDX = 0
+
+_MODULE = "conll05st"
+_ARCHIVE = "conll05st-tests.tar.gz"
+_WORDDICT = "wordDict.txt"
+_VERBDICT = "verbDict.txt"
+_TRGDICT = "targetDict.txt"
+_WORDS_MEMBER = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+_PROPS_MEMBER = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+
+def _have_real():
+    return all(common.have_file(_MODULE, f)
+               for f in (_ARCHIVE, _WORDDICT, _VERBDICT, _TRGDICT))
+
+
+def _load_dict(filename):
+    d = {}
+    with open(common.data_path(_MODULE, filename)) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def _load_label_dict(filename):
+    """targetDict lines like B-A0/I-A0 -> {B-tag, I-tag} id pairs with
+    O last (reference conll05.py:49-65; tags sorted for
+    determinism)."""
+    tags = set()
+    with open(common.data_path(_MODULE, filename)) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("B-") or line.startswith("I-"):
+                tags.add(line[2:])
+    d = {}
+    for tag in sorted(tags):
+        d["B-" + tag] = len(d)
+        d["I-" + tag] = len(d)
+    d["O"] = len(d)
+    return d
+
 
 def get_dict():
+    if _have_real():
+        return (_load_dict(_WORDDICT), _load_dict(_VERBDICT),
+                _load_label_dict(_TRGDICT))
     word_dict = {"w%d" % i: i for i in range(_WORDS)}
     verb_dict = {"v%d" % i: i for i in range(_VERBS)}
     label_dict = {l: i for i, l in enumerate(_LABELS)}
@@ -29,9 +86,118 @@ def get_dict():
 
 def get_embedding():
     """Deterministic stand-in for the pretrained emb table the
-    reference downloads (conll05.py get_embedding)."""
+    reference downloads (conll05.py get_embedding); the real ``emb``
+    file is returned as a path when present under DATA_HOME."""
+    if common.have_file(_MODULE, "emb"):
+        return common.data_path(_MODULE, "emb")
     rng = np.random.RandomState(0)
     return rng.randn(_WORDS, 32).astype(np.float32)
+
+
+def _bracket_to_iob(lbl):
+    """One predicate column ('(A0*', '*', '*)', '(V*)'...) -> IOB
+    sequence (reference conll05.py:107-133)."""
+    cur_tag, in_bracket = "O", False
+    out = []
+    for l in lbl:
+        if l == "*" and not in_bracket:
+            out.append("O")
+        elif l == "*" and in_bracket:
+            out.append("I-" + cur_tag)
+        elif l == "*)":
+            out.append("I-" + cur_tag)
+            in_bracket = False
+        elif "(" in l and ")" in l:
+            cur_tag = l[1:l.find("*")]
+            out.append("B-" + cur_tag)
+            in_bracket = False
+        elif "(" in l:
+            cur_tag = l[1:l.find("*")]
+            out.append("B-" + cur_tag)
+            in_bracket = True
+        else:
+            raise RuntimeError("Unexpected SRL label: %s" % l)
+    return out
+
+
+def _corpus_reader():
+    """Yield (sentence_words, predicate, iob_labels) per predicate per
+    sentence from the words/props gz pair (reference
+    conll05.py:76-147: words one per line, props one field-row per
+    token with the lemma column first, blank lines separate
+    sentences)."""
+    path = common.data_path(_MODULE, _ARCHIVE)
+    with tarfile.open(path) as tf:
+        wf = tf.extractfile(_WORDS_MEMBER)
+        pf = tf.extractfile(_PROPS_MEMBER)
+        with gzip.GzipFile(fileobj=wf) as words_file, \
+                gzip.GzipFile(fileobj=pf) as props_file:
+            sentence, rows = [], []
+            for word, props in zip(words_file, props_file):
+                word = word.decode("utf-8", "replace").strip()
+                fields = props.decode("utf-8", "replace").strip() \
+                    .split()
+                if fields:
+                    sentence.append(word)
+                    rows.append(fields)
+                    continue
+                # end of sentence: column 0 = lemmas, column i>0 =
+                # bracket labels of predicate i
+                if rows:
+                    cols = [[r[i] for r in rows]
+                            for i in range(len(rows[0]))]
+                    verbs = [x for x in cols[0] if x != "-"]
+                    for i, lbl in enumerate(cols[1:]):
+                        yield sentence, verbs[i], _bracket_to_iob(lbl)
+                sentence, rows = [], []
+
+
+def _fields(sentence, predicate, labels, word_dict, predicate_dict,
+            label_dict):
+    """Assemble the 9-field sample (reference conll05.py:150-204)."""
+    n = len(sentence)
+    verb_index = labels.index("B-V")
+    mark = [0] * n
+
+    def ctx(off, default):
+        p = verb_index + off
+        if 0 <= p < n:
+            mark[p] = 1
+            return sentence[p]
+        return default
+
+    ctx_n2 = ctx(-2, "bos")
+    ctx_n1 = ctx(-1, "bos")
+    ctx_0 = ctx(0, sentence[verb_index])
+    ctx_p1 = ctx(1, "eos")
+    ctx_p2 = ctx(2, "eos")
+
+    def widx(w):
+        return word_dict.get(w, UNK_IDX)
+
+    # fail loudly on dict gaps: the reference's .get() would embed
+    # None ids that crash far from the cause (conll05.py:197-198)
+    if predicate not in predicate_dict:
+        raise KeyError("predicate %r not in verbDict" % predicate)
+    missing = [l for l in labels if l not in label_dict]
+    if missing:
+        raise KeyError("labels %r not in targetDict" % missing[:5])
+
+    return ([widx(w) for w in sentence],
+            [widx(ctx_n2)] * n, [widx(ctx_n1)] * n, [widx(ctx_0)] * n,
+            [widx(ctx_p1)] * n, [widx(ctx_p2)] * n,
+            [predicate_dict[predicate]] * n, mark,
+            [label_dict[l] for l in labels])
+
+
+def _real_creator():
+    def reader():
+        word_dict, verb_dict, label_dict = get_dict()
+        for sentence, predicate, labels in _corpus_reader():
+            yield _fields(sentence, predicate, labels, word_dict,
+                          verb_dict, label_dict)
+
+    return reader
 
 
 def _sample(idx):
@@ -66,6 +232,9 @@ def _sample(idx):
 
 
 def test():
+    if _have_real():
+        return _real_creator()
+
     def reader():
         for i in range(_TEST_SIZE):
             yield _sample(11_000_000 + i)

@@ -1,18 +1,70 @@
 """IMDB sentiment reader creators (reference:
-python/paddle/dataset/imdb.py — word-id sequences + 0/1 label).
-Synthetic: positive samples draw from one token range, negative from
-another, variable length (exercises the pad/bucket pipeline)."""
+python/paddle/dataset/imdb.py — word-id sequences + 0/1 label, where
+POSITIVE docs are label 0 and negative label 1, imdb.py:79-87).
+
+Real data: drop ``aclImdb_v1.tar.gz`` under ``DATA_HOME/imdb/`` and
+the per-document text members are tokenized the reference way
+(imdb.py:39-55: sequential tar scan, punctuation stripped, lowered,
+split; build_dict keeps ``freq > cutoff`` sorted by (-freq, word) with
+<unk> last). Synthetic fallback: positive samples draw from one token
+range, negative from another, variable length (exercises the
+pad/bucket pipeline)."""
 
 from __future__ import annotations
 
+import re
+import string
+import tarfile
+
 import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "word_dict", "train", "test"]
 
 VOCAB_SIZE = 5148  # reference's imdb.word_dict() size ballpark
 TRAIN_SIZE = 2048
 TEST_SIZE = 256
 
+_ARCHIVE = "aclImdb_v1.tar.gz"
+_PUNCT_TABLE = bytes.maketrans(b"", b"")  # identity; deleted chars below
+_PUNCT = string.punctuation.encode()
+
+
+def _have_real():
+    return common.have_file("imdb", _ARCHIVE)
+
+
+def tokenize(pattern):
+    """Yield one token list per tar member matching ``pattern``
+    (reference imdb.py:39-55, sequential next() scan)."""
+    with tarfile.open(common.data_path("imdb", _ARCHIVE)) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                yield tarf.extractfile(tf).read().rstrip(b"\n\r") \
+                    .translate(_PUNCT_TABLE, _PUNCT).lower().split()
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff):
+    """bytes word -> id over matching docs (reference imdb.py:58-74)."""
+    freq = {}
+    for doc in tokenize(pattern):
+        for w in doc:
+            freq[w] = freq.get(w, 0) + 1
+    keep = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                  key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _c) in enumerate(keep)}
+    word_idx[b"<unk>"] = len(word_idx)
+    return word_idx
+
 
 def word_dict():
+    if _have_real():
+        return build_dict(
+            re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+            150)
     return {("w%d" % i).encode(): i for i in range(VOCAB_SIZE - 2)}
 
 
@@ -34,9 +86,41 @@ def _creator(n, base):
     return reader
 
 
+def _real_creator(pos_pattern, neg_pattern, word_idx):
+    """Load all docs then yield; pos docs are label 0 (reference
+    imdb.py:79-96)."""
+    def reader():
+        unk = word_idx[b"<unk>"]
+        for pattern, label in ((pos_pattern, 0), (neg_pattern, 1)):
+            for doc in tokenize(pattern):
+                yield [word_idx.get(w, unk) for w in doc], label
+
+    return reader
+
+
+def _require_word_idx(word_idx):
+    """With the real archive present, a missing word_idx must not
+    silently fall back to synthetic ids from a different id space."""
+    if word_idx is None:
+        raise ValueError(
+            "the real aclImdb archive is present; pass word_idx "
+            "(e.g. imdb.word_dict()) so sample ids match the vocab")
+    return word_idx
+
+
 def train(word_idx=None):
+    if _have_real():
+        return _real_creator(
+            re.compile(r"aclImdb/train/pos/.*\.txt$"),
+            re.compile(r"aclImdb/train/neg/.*\.txt$"),
+            _require_word_idx(word_idx))
     return _creator(TRAIN_SIZE, 0)
 
 
 def test(word_idx=None):
+    if _have_real():
+        return _real_creator(
+            re.compile(r"aclImdb/test/pos/.*\.txt$"),
+            re.compile(r"aclImdb/test/neg/.*\.txt$"),
+            _require_word_idx(word_idx))
     return _creator(TEST_SIZE, 3_000_000)

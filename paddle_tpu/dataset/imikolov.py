@@ -3,19 +3,33 @@
 Reference: python/paddle/dataset/imikolov.py — build_dict(min_word_freq)
 over the corpus; train(word_idx, n)/test(word_idx, n) yield n-gram
 tuples (DataType.NGRAM) or (src_seq, trg_seq) pairs (DataType.SEQ)
-with <s>/<e>/<unk> handling. Synthetic corpus: Zipf-distributed
-deterministic sentences.
+with <s>/<e>/<unk> handling.
+
+Real data: drop ``simple-examples.tgz`` under ``DATA_HOME/imikolov/``
+and the PTB text inside (``./simple-examples/data/ptb.train.txt`` /
+``ptb.valid.txt``) is parsed exactly as the reference does
+(imikolov.py:40-107: word-frequency dict with ``freq > min_word_freq``
+cutoff, <unk> appended last, sliding n-grams / <s>-<e> seq pairs).
+Synthetic fallback: Zipf-distributed deterministic sentences.
 """
 
 from __future__ import annotations
 
+import tarfile
+
 import numpy as np
+
+from . import common
 
 __all__ = ["DataType", "build_dict", "train", "test"]
 
 _VOCAB = 2048
 _TRAIN_SENTENCES = 2048
 _TEST_SENTENCES = 256
+
+_ARCHIVE = "simple-examples.tgz"
+_TRAIN_MEMBER = "./simple-examples/data/ptb.train.txt"
+_TEST_MEMBER = "./simple-examples/data/ptb.valid.txt"
 
 
 class DataType:
@@ -31,43 +45,83 @@ def _sentence(idx):
     return ["w%d" % i for i in ids]
 
 
+def _have_real():
+    return common.have_file("imikolov", _ARCHIVE)
+
+
+def _real_sentences(member):
+    with tarfile.open(common.data_path("imikolov", _ARCHIVE)) as tf:
+        f = tf.extractfile(member)
+        for line in f:
+            yield line.decode("utf-8", "replace").strip().split()
+
+
 def build_dict(min_word_freq=50):
-    """word -> id with <s>, <e>, <unk> (reference: imikolov.py:53)."""
+    """word -> id with <unk> last (reference: imikolov.py:40-64 counts
+    train+test, drops <unk>, keeps ``freq > min_word_freq``, sorts by
+    (-freq, word))."""
     freq = {}
-    for i in range(_TRAIN_SENTENCES):
-        for w in _sentence(i):
-            freq[w] = freq.get(w, 0) + 1
-    words = sorted((w for w, c in freq.items() if c >= min_word_freq),
-                   key=lambda w: (-freq[w], w))
+    if _have_real():
+        for member in (_TRAIN_MEMBER, _TEST_MEMBER):
+            for words in _real_sentences(member):
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        keep = [w for w, c in freq.items() if c > min_word_freq]
+    else:
+        for i in range(_TRAIN_SENTENCES):
+            for w in _sentence(i):
+                freq[w] = freq.get(w, 0) + 1
+        keep = [w for w, c in freq.items() if c >= min_word_freq]
+    words = sorted(keep, key=lambda w: (-freq[w], w))
     word_idx = {w: i for i, w in enumerate(words)}
     word_idx["<unk>"] = len(word_idx)
     return word_idx
 
 
+def _emit(words, word_idx, n, data_type):
+    """One sentence -> samples (reference imikolov.py:84-107)."""
+    unk = word_idx["<unk>"]
+    start = word_idx.get("<s>", unk)
+    end = word_idx.get("<e>", unk)
+    if data_type == DataType.NGRAM:
+        l = [start] + [word_idx.get(w, unk) for w in words] + [end]
+        if len(l) >= n:
+            for j in range(n, len(l) + 1):
+                yield tuple(l[j - n:j])
+    else:
+        ids = [word_idx.get(w, unk) for w in words]
+        src = [start] + ids
+        if n > 0 and len(src) > n:
+            return
+        yield src, ids + [end]
+
+
 def _creator(n_sent, base, word_idx, n, data_type):
     def reader():
-        unk = word_idx["<unk>"]
-        start = word_idx.get("<s>", unk)
-        end = word_idx.get("<e>", unk)
         for i in range(n_sent):
-            words = _sentence(base + i)
-            if data_type == DataType.NGRAM:
-                l = [start] + [word_idx.get(w, unk) for w in words] \
-                    + [end]
-                if len(l) < n:
-                    continue
-                for j in range(n, len(l) + 1):
-                    yield tuple(l[j - n:j])
-            else:
-                ids = [word_idx.get(w, unk) for w in words]
-                yield [start] + ids, ids + [end]
+            for s in _emit(_sentence(base + i), word_idx, n, data_type):
+                yield s
+
+    return reader
+
+
+def _real_creator(member, word_idx, n, data_type):
+    def reader():
+        for words in _real_sentences(member):
+            for s in _emit(words, word_idx, n, data_type):
+                yield s
 
     return reader
 
 
 def train(word_idx, n, data_type=DataType.NGRAM):
+    if _have_real():
+        return _real_creator(_TRAIN_MEMBER, word_idx, n, data_type)
     return _creator(_TRAIN_SENTENCES, 0, word_idx, n, data_type)
 
 
 def test(word_idx, n, data_type=DataType.NGRAM):
+    if _have_real():
+        return _real_creator(_TEST_MEMBER, word_idx, n, data_type)
     return _creator(_TEST_SENTENCES, 9_000_000, word_idx, n, data_type)

@@ -1,15 +1,29 @@
 """MNIST reader creators (reference: python/paddle/dataset/mnist.py —
 train()/test() yield (784-float32 in [-1,1], int64 label)).
 
-Synthetic fallback: class-conditional separable images so models
-actually learn; deterministic per index."""
+Real data: drop the four idx-format gzip files
+(``train-images-idx3-ubyte.gz``/``train-labels-idx1-ubyte.gz`` and the
+``t10k-`` pair) under ``DATA_HOME/mnist/`` and they are parsed
+(reference: mnist.py:39-84 reads the same magic-numbered idx streams).
+Synthetic fallback otherwise: class-conditional separable images so
+models actually learn; deterministic per index."""
 
 from __future__ import annotations
 
+import gzip
+import struct
+
 import numpy as np
+
+from . import common
 
 TRAIN_SIZE = 8192
 TEST_SIZE = 1024
+
+_TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+_TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+_TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+_TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
 
 
 def _sample(idx):
@@ -28,9 +42,49 @@ def _creator(n, base):
     return reader
 
 
+def _parse_idx(images_gz, labels_gz):
+    """Parse the classic idx3/idx1 gzip pair (reference mnist.py:44-75
+    reads the same header: magic, count, rows, cols big-endian)."""
+    with gzip.open(common.data_path("mnist", labels_gz), "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError("bad idx1 magic %d in %s" % (magic, labels_gz))
+        labels = np.frombuffer(f.read(n), dtype=np.uint8)
+    with gzip.open(common.data_path("mnist", images_gz), "rb") as f:
+        magic, n2, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError("bad idx3 magic %d in %s" % (magic, images_gz))
+        images = np.frombuffer(f.read(n2 * rows * cols), dtype=np.uint8)
+        images = images.reshape(n2, rows * cols)
+    if n != n2:
+        raise ValueError("mnist image/label count mismatch: %d vs %d"
+                         % (n2, n))
+    return images, labels
+
+
+def _real_creator(images_gz, labels_gz):
+    def reader():
+        images, labels = _parse_idx(images_gz, labels_gz)
+        # reference normalization: [0,255] -> [-1,1] (mnist.py:66)
+        for img, label in zip(images, labels):
+            yield (img.astype(np.float32) / 255.0 * 2.0 - 1.0,
+                   np.int64(label))
+
+    return reader
+
+
+def _have_real(images_gz, labels_gz):
+    return (common.have_file("mnist", images_gz)
+            and common.have_file("mnist", labels_gz))
+
+
 def train():
+    if _have_real(_TRAIN_IMAGES, _TRAIN_LABELS):
+        return _real_creator(_TRAIN_IMAGES, _TRAIN_LABELS)
     return _creator(TRAIN_SIZE, 0)
 
 
 def test():
+    if _have_real(_TEST_IMAGES, _TEST_LABELS):
+        return _real_creator(_TEST_IMAGES, _TEST_LABELS)
     return _creator(TEST_SIZE, 10_000_000)

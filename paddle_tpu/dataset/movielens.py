@@ -5,12 +5,23 @@ user.value() + movie.value() + [[rating]] i.e. (user_id, gender_id,
 age_id, job_id, movie_id, category_ids, title_ids, [score]);
 plus the MovieInfo/UserInfo metadata accessors (max_movie_id:193,
 max_user_id:201, max_job_id:216, movie_categories:225,
-get_movie_title_dict:178). Synthetic catalog is deterministic.
+get_movie_title_dict:178).
+
+Real data: drop ``ml-1m.zip`` under ``DATA_HOME/movielens/`` and the
+"::"-separated latin-1 ``movies.dat``/``users.dat``/``ratings.dat``
+inside are parsed (reference movielens.py:107-160: title year "(1995)"
+stripped by regex, categories split on "|", the np.random(test_ratio)
+train/test split seeded per reader). Synthetic catalog otherwise.
 """
 
 from __future__ import annotations
 
+import re
+import zipfile
+
 import numpy as np
+
+from . import common
 
 __all__ = ["train", "test", "MovieInfo", "UserInfo", "max_movie_id",
            "max_user_id", "max_job_id", "movie_categories",
@@ -22,6 +33,8 @@ _N_USERS = 600
 _N_CATEGORIES = 18
 _TITLE_WORDS = 512
 age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_ARCHIVE = "ml-1m.zip"
 
 
 class MovieInfo:
@@ -62,11 +75,67 @@ class UserInfo:
             age_table[self.age], self.job_id)
 
 
+# --- real-data catalog (parsed once, cached) -------------------------------
+
+_META = None  # {"movies": {id: MovieInfo}, "users": {id: UserInfo},
+#               "categories": [..], "title_dict": {word: id}}
+
+
+def _load_meta():
+    """Parse movies.dat + users.dat (reference movielens.py:107-148)."""
+    global _META
+    if _META is not None:
+        return _META
+    path = common.data_path("movielens", _ARCHIVE)
+    year_pat = re.compile(r"^(.*)\((\d+)\)$")
+    movies, categories, title_words = {}, [], []
+    cat_seen, word_seen = set(), set()
+    users = {}
+    with zipfile.ZipFile(path) as package:
+        with package.open("ml-1m/movies.dat") as f:
+            for line in f:
+                line = line.decode("latin")
+                movie_id, title, cats = line.strip().split("::")
+                cats = cats.split("|")
+                for c in cats:
+                    if c not in cat_seen:
+                        cat_seen.add(c)
+                        categories.append(c)
+                m = year_pat.match(title)
+                if m:
+                    title = m.group(1)
+                movies[int(movie_id)] = MovieInfo(
+                    index=movie_id, categories=cats, title=title)
+                for w in title.split():
+                    w = w.lower()
+                    if w not in word_seen:
+                        word_seen.add(w)
+                        title_words.append(w)
+        with package.open("ml-1m/users.dat") as f:
+            for line in f:
+                line = line.decode("latin")
+                uid, gender, age, job, _zip = line.strip().split("::")
+                users[int(uid)] = UserInfo(index=uid, gender=gender,
+                                           age=age, job_id=job)
+    _META = {"movies": movies, "users": users,
+             "categories": categories,
+             "title_dict": {w: i for i, w in enumerate(title_words)}}
+    return _META
+
+
+def _have_real():
+    return common.have_file("movielens", _ARCHIVE)
+
+
 def movie_categories():
+    if _have_real():
+        return _load_meta()["categories"]
     return ["cat%02d" % i for i in range(_N_CATEGORIES)]
 
 
 def get_movie_title_dict():
+    if _have_real():
+        return _load_meta()["title_dict"]
     return {"w%d" % i: i for i in range(_TITLE_WORDS)}
 
 
@@ -89,22 +158,32 @@ def _user(i):
 
 
 def movie_info():
+    if _have_real():
+        return _load_meta()["movies"]
     return {i: _movie(i) for i in range(1, _N_MOVIES + 1)}
 
 
 def user_info():
+    if _have_real():
+        return _load_meta()["users"]
     return {i: _user(i) for i in range(1, _N_USERS + 1)}
 
 
 def max_movie_id():
+    if _have_real():
+        return max(_load_meta()["movies"])
     return _N_MOVIES
 
 
 def max_user_id():
+    if _have_real():
+        return max(_load_meta()["users"])
     return _N_USERS
 
 
 def max_job_id():
+    if _have_real():
+        return max(u.job_id for u in _load_meta()["users"].values())
     return 20
 
 
@@ -113,6 +192,33 @@ def _rating(u, m):
     # taste model: users like movies whose id shares low bits
     base = 3.0 + ((u ^ m) % 5 - 2) * 0.7
     return float(np.clip(round(base + rng.randn() * 0.5), 1, 5))
+
+
+def _real_reader(is_test, test_ratio=0.1, rand_seed=0):
+    """Stream ratings.dat with the reference's np.random split
+    (movielens.py:152-166)."""
+    def reader():
+        meta = _load_meta()
+        # resolve .value() once per movie/user (a few thousand calls),
+        # NOT once per rating line (a million) — value() walks the
+        # category/title dicts each time
+        movie_vals = {i: m.value() for i, m in meta["movies"].items()}
+        user_vals = {i: u.value() for i, u in meta["users"].items()}
+        path = common.data_path("movielens", _ARCHIVE)
+        rng = np.random.RandomState(rand_seed)
+        with zipfile.ZipFile(path) as package:
+            with package.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    if (rng.random_sample() < test_ratio) != bool(
+                            is_test):
+                        continue
+                    uid, mov_id, rating, _ts = line.strip().split("::")
+                    yield (user_vals[int(uid)]
+                           + movie_vals[int(mov_id)]
+                           + [[float(rating)]])
+
+    return reader
 
 
 def _reader(is_test, test_ratio=0.1, rand_seed=0):
@@ -133,8 +239,12 @@ def _reader(is_test, test_ratio=0.1, rand_seed=0):
 
 
 def train():
+    if _have_real():
+        return _real_reader(is_test=False)
     return _reader(is_test=False)
 
 
 def test():
+    if _have_real():
+        return _real_reader(is_test=True)
     return _reader(is_test=True)

@@ -3,19 +3,31 @@
 Reference: python/paddle/dataset/mq2007.py — train(format=...)/test:
 ``pointwise`` yields (feature_vector[46], relevance); ``pairwise``
 yields (d_high[46], d_low[46]); ``listwise`` yields per-query
-(label_list, feature_matrix). Synthetic queries embed relevance
-linearly in a feature subspace so rankers actually learn.
+(label_list, feature_matrix).
+
+Real data: the reference ships MQ2007 as a .rar (mq2007.py:34) which
+the stdlib can't open, so drop the EXTRACTED fold files instead —
+``MQ2007/Fold1/train.txt`` / ``test.txt`` under ``DATA_HOME/mq2007/``
+— and the LETOR lines ("rel qid:<q> 1:<v> ... 46:<v> #docid...") are
+parsed grouped by query (mq2007.py:89-120 Query.complete_, :269
+load_from_text). Synthetic fallback: queries embed relevance linearly
+in a feature subspace so rankers actually learn.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from . import common
+
 __all__ = ["train", "test", "FEATURE_DIM"]
 
 FEATURE_DIM = 46
 _TRAIN_QUERIES = 256
 _TEST_QUERIES = 64
+
+_TRAIN_FILE = "MQ2007/Fold1/train.txt"
+_TEST_FILE = "MQ2007/Fold1/test.txt"
 
 
 def _query(idx):
@@ -27,27 +39,77 @@ def _query(idx):
     return rel.astype(np.int64), feats
 
 
+def _parse_letor(path, fill_missing=-1.0):
+    """Group LETOR lines by qid, preserving file order (reference
+    mq2007.py:89-120: rel, qid:<id>, then <fid>:<value> pairs;
+    missing feature ids filled with ``fill_missing``)."""
+    queries = []          # [(qid, [rel], [feat_vec])] in first-seen order
+    by_qid = {}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = int(parts[0])
+            qid = int(parts[1].split(":")[1])
+            feat = np.full(FEATURE_DIM, fill_missing, np.float32)
+            for p in parts[2:]:
+                fid, val = p.split(":")
+                fid = int(fid)
+                if 1 <= fid <= FEATURE_DIM:
+                    feat[fid - 1] = float(val)
+            if qid not in by_qid:
+                by_qid[qid] = ([], [])
+                queries.append(qid)
+            by_qid[qid][0].append(rel)
+            by_qid[qid][1].append(feat)
+    for qid in queries:
+        rels, feats = by_qid[qid]
+        yield (np.asarray(rels, np.int64),
+               np.stack(feats).astype(np.float32))
+
+
+def _emit(rel, feats, fmt):
+    if fmt == "listwise":
+        yield rel.tolist(), feats
+    elif fmt == "pointwise":
+        for r, f in zip(rel, feats):
+            yield f, int(r)
+    else:  # pairwise
+        for a in range(len(rel)):
+            for b in range(len(rel)):
+                if rel[a] > rel[b]:
+                    yield feats[a], feats[b]
+
+
 def _creator(n, base, fmt):
     def reader():
         for i in range(n):
             rel, feats = _query(base + i)
-            if fmt == "listwise":
-                yield rel.tolist(), feats
-            elif fmt == "pointwise":
-                for r, f in zip(rel, feats):
-                    yield f, int(r)
-            else:  # pairwise
-                for a in range(len(rel)):
-                    for b in range(len(rel)):
-                        if rel[a] > rel[b]:
-                            yield feats[a], feats[b]
+            for s in _emit(rel, feats, fmt):
+                yield s
+
+    return reader
+
+
+def _real_creator(filename, fmt):
+    def reader():
+        path = common.data_path("mq2007", filename)
+        for rel, feats in _parse_letor(path):
+            for s in _emit(rel, feats, fmt):
+                yield s
 
     return reader
 
 
 def train(format="pairwise"):
+    if common.have_file("mq2007", _TRAIN_FILE):
+        return _real_creator(_TRAIN_FILE, format)
     return _creator(_TRAIN_QUERIES, 0, format)
 
 
 def test(format="pairwise"):
+    if common.have_file("mq2007", _TEST_FILE):
+        return _real_creator(_TEST_FILE, format)
     return _creator(_TEST_QUERIES, 17_000_000, format)

@@ -1,14 +1,29 @@
 """UCI housing reader creators (reference:
 python/paddle/dataset/uci_housing.py — 13 float features, 1 float
-target). Synthetic linear task with noise."""
+target).
+
+Real data: drop ``housing.data`` (whitespace-separated, 14 columns)
+under ``DATA_HOME/uci_housing/`` and it is parsed with the reference's
+normalization and 80/20 split (uci_housing.py:69-82: per-feature
+(x - avg) / (max - min) over the WHOLE file, first 80% train).
+Synthetic linear task with noise otherwise."""
 
 from __future__ import annotations
 
 import numpy as np
 
+from . import common
+
 _W = np.linspace(-1.0, 1.0, 13).astype(np.float32)
 TRAIN_SIZE = 404
 TEST_SIZE = 102
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD",
+    "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+_FILENAME = "housing.data"
 
 
 def _sample(idx):
@@ -26,9 +41,35 @@ def _creator(n, base):
     return reader
 
 
+def _load_real(ratio=0.8, feature_num=14):
+    data = np.fromfile(common.data_path("uci_housing", _FILENAME),
+                       sep=" ")
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maximums = data.max(axis=0)
+    minimums = data.min(axis=0)
+    avgs = data.sum(axis=0) / data.shape[0]
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset], data[offset:]
+
+
+def _real_creator(is_test):
+    def reader():
+        train_rows, test_rows = _load_real()
+        for d in (test_rows if is_test else train_rows):
+            yield d[:-1].astype(np.float32), d[-1:].astype(np.float32)
+
+    return reader
+
+
 def train():
+    if common.have_file("uci_housing", _FILENAME):
+        return _real_creator(is_test=False)
     return _creator(TRAIN_SIZE, 0)
 
 
 def test():
+    if common.have_file("uci_housing", _FILENAME):
+        return _real_creator(is_test=True)
     return _creator(TEST_SIZE, 1_000_000)

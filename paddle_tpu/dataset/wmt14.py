@@ -3,13 +3,21 @@
 Reference: python/paddle/dataset/wmt14.py — train(dict_size)/
 test(dict_size) yield (src_ids, trg_ids, trg_ids_next) where trg_ids
 is <s>-prefixed and trg_ids_next <e>-suffixed; get_dict(dict_size)
-returns (src_dict, trg_dict). Real data: drop the preprocessed
-``wmt14/train.tgz``-style id files under DATA_HOME; otherwise a
-deterministic synthetic parallel corpus with the same id conventions
-(0=<s>, 1=<e>, 2=<unk>) is generated.
+returns (src_dict, trg_dict).
+
+Real data: drop ``wmt14.tgz`` under ``DATA_HOME/wmt14/`` — a tar with
+``*src.dict`` / ``*trg.dict`` vocab members (one word per line, line
+number = id) and ``train/train`` / ``test/test`` corpus members of
+tab-separated "src sentence\\ttrg sentence" lines. It is parsed the
+reference way (wmt14.py:56-115: first dict_size vocab lines, <s>/<e>
+wrapping on the source words, >80-token pairs dropped). Synthetic
+fallback: a deterministic parallel corpus with the same id
+conventions (0=<s>, 1=<e>, 2=<unk>).
 """
 
 from __future__ import annotations
+
+import tarfile
 
 import numpy as np
 
@@ -21,8 +29,12 @@ START = 0   # <s>
 END = 1     # <e>
 UNK = 2     # <unk>
 
+_S, _E, _U = "<s>", "<e>", "<unk>"
+
 TRAIN_SIZE = 2048
 TEST_SIZE = 256
+
+_ARCHIVE = "wmt14.tgz"
 
 
 def _sample(idx, dict_size):
@@ -44,21 +56,88 @@ def _creator(n, base, dict_size):
     return reader
 
 
+def _have_real():
+    return common.have_file("wmt14", _ARCHIVE)
+
+
+def _read_to_dict(dict_size):
+    """First ``dict_size`` vocab lines -> word:line_no (reference
+    wmt14.py:56-79: exactly one member each ending src.dict /
+    trg.dict)."""
+    def to_dict(f):
+        out = {}
+        for i, line in enumerate(f):
+            if i >= dict_size:
+                break
+            out[line.decode("utf-8", "replace").strip()] = i
+        return out
+
+    path = common.data_path("wmt14", _ARCHIVE)
+    with tarfile.open(path, mode="r") as f:
+        src_names = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_names = [m.name for m in f if m.name.endswith("trg.dict")]
+        if len(src_names) != 1 or len(trg_names) != 1:
+            raise ValueError(
+                "wmt14 archive must contain exactly one src.dict and "
+                "one trg.dict member (got %r, %r)"
+                % (src_names, trg_names))
+        return (to_dict(f.extractfile(src_names[0])),
+                to_dict(f.extractfile(trg_names[0])))
+
+
+def _real_creator(file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = _read_to_dict(dict_size)
+        path = common.data_path("wmt14", _ARCHIVE)
+        with tarfile.open(path, mode="r") as f:
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode("utf-8", "replace").strip() \
+                        .split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [src_dict.get(w, UNK)
+                               for w in [_S] + src_words + [_E]]
+                    trg_words = parts[1].split()
+                    trg_ids = [trg_dict.get(w, UNK) for w in trg_words]
+                    # reference drops >80-token pairs (wmt14.py:107)
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    trg_next = trg_ids + [trg_dict[_E]]
+                    trg_ids = [trg_dict[_S]] + trg_ids
+                    yield src_ids, trg_ids, trg_next
+
+    return reader
+
+
 def train(dict_size):
     """Reference: wmt14.py:118."""
+    if _have_real():
+        return _real_creator("train/train", dict_size)
     return _creator(TRAIN_SIZE, 0, dict_size)
 
 
 def test(dict_size):
     """Reference: wmt14.py:134."""
+    if _have_real():
+        return _real_creator("test/test", dict_size)
     return _creator(TEST_SIZE, 5_000_000, dict_size)
 
 
 def get_dict(dict_size, reverse=False):
     """(src_dict, trg_dict); id->word when ``reverse`` (reference:
     wmt14.py:156 — note the reference defaults reverse=True there)."""
+    if _have_real():
+        src, trg = _read_to_dict(dict_size)
+        if reverse:
+            return ({i: w for w, i in src.items()},
+                    {i: w for w, i in trg.items()})
+        return src, trg
+
     def one(prefix):
-        words = ["<s>", "<e>", "<unk>"] + [
+        words = [_S, _E, _U] + [
             "%s%d" % (prefix, i) for i in range(3, dict_size)]
         if reverse:
             return {i: w for i, w in enumerate(words)}

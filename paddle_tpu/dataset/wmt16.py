@@ -2,14 +2,26 @@
 
 Reference: python/paddle/dataset/wmt16.py — train/test/validation
 (src_dict_size, trg_dict_size, src_lang) yield (src_ids, trg_ids,
-trg_ids_next); get_dict(lang, dict_size) returns the vocab. Same
-synthetic-fallback policy as wmt14.
+trg_ids_next); get_dict(lang, dict_size) returns the vocab.
+
+Real data: drop ``wmt16.tar.gz`` under ``DATA_HOME/wmt16/`` — a tar
+with ``wmt16/train`` / ``wmt16/test`` / ``wmt16/val`` members of
+"en sentence\\tde sentence" lines. Vocabularies are built from the
+train corpus by frequency with <s>/<e>/<unk> as ids 0/1/2 and cached
+to ``DATA_HOME/wmt16/{lang}_{size}.dict`` (reference wmt16.py:62-100),
+then both sides are id-mapped with <s>/<e> wrapping
+(wmt16.py:110-145). Synthetic fallback with the same id conventions
+otherwise.
 """
 
 from __future__ import annotations
 
+import os
+import tarfile
+
 import numpy as np
 
+from . import common
 from . import wmt14
 
 __all__ = ["train", "test", "validation", "get_dict"]
@@ -17,6 +29,9 @@ __all__ = ["train", "test", "validation", "get_dict"]
 TRAIN_SIZE = 2048
 TEST_SIZE = 256
 VALID_SIZE = 256
+
+_S, _E, _U = "<s>", "<e>", "<unk>"
+_ARCHIVE = "wmt16.tar.gz"
 
 
 def _creator(n, base, src_size, trg_size):
@@ -31,21 +46,100 @@ def _creator(n, base, src_size, trg_size):
     return reader
 
 
+def _have_real():
+    return common.have_file("wmt16", _ARCHIVE)
+
+
+def _build_dict(dict_size, save_path, lang):
+    """train-corpus frequency vocab, <s>/<e>/<unk> first (reference
+    wmt16.py:62-83)."""
+    freq = {}
+    with tarfile.open(common.data_path("wmt16", _ARCHIVE),
+                      mode="r") as f:
+        for line in f.extractfile("wmt16/train"):
+            parts = line.decode("utf-8", "replace").strip().split("\t")
+            if len(parts) != 2:
+                continue
+            sen = parts[0] if lang == "en" else parts[1]
+            for w in sen.split():
+                freq[w] = freq.get(w, 0) + 1
+    with open(save_path, "w", encoding="utf-8") as fout:
+        fout.write("%s\n%s\n%s\n" % (_S, _E, _U))
+        for idx, (word, _c) in enumerate(
+                sorted(freq.items(), key=lambda x: x[1], reverse=True)):
+            if idx + 3 == dict_size:
+                break
+            fout.write(word + "\n")
+
+
+def _load_dict(dict_size, lang, reverse=False):
+    dict_path = common.data_path("wmt16",
+                                 "%s_%d.dict" % (lang, dict_size))
+    if (not os.path.exists(dict_path)
+            or len(open(dict_path, "rb").readlines()) != dict_size):
+        _build_dict(dict_size, dict_path, lang)
+    word_dict = {}
+    with open(dict_path, encoding="utf-8") as f:
+        for idx, line in enumerate(f):
+            if reverse:
+                word_dict[idx] = line.strip()
+            else:
+                word_dict[line.strip()] = idx
+    return word_dict
+
+
+def _real_creator(file_name, src_dict_size, trg_dict_size, src_lang):
+    def reader():
+        src_dict = _load_dict(src_dict_size, src_lang)
+        trg_dict = _load_dict(trg_dict_size,
+                              "de" if src_lang == "en" else "en")
+        start_id, end_id, unk_id = (src_dict[_S], src_dict[_E],
+                                    src_dict[_U])
+        src_col = 0 if src_lang == "en" else 1
+        with tarfile.open(common.data_path("wmt16", _ARCHIVE),
+                          mode="r") as f:
+            for line in f.extractfile(file_name):
+                parts = line.decode("utf-8", "replace").strip() \
+                    .split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [start_id] + [
+                    src_dict.get(w, unk_id)
+                    for w in parts[src_col].split()] + [end_id]
+                trg_ids = [trg_dict.get(w, unk_id)
+                           for w in parts[1 - src_col].split()]
+                yield (src_ids, [start_id] + trg_ids,
+                       trg_ids + [end_id])
+
+    return reader
+
+
 def train(src_dict_size, trg_dict_size, src_lang="en"):
+    if _have_real():
+        return _real_creator("wmt16/train", src_dict_size,
+                             trg_dict_size, src_lang)
     return _creator(TRAIN_SIZE, 0, src_dict_size, trg_dict_size)
 
 
 def test(src_dict_size, trg_dict_size, src_lang="en"):
+    if _have_real():
+        return _real_creator("wmt16/test", src_dict_size,
+                             trg_dict_size, src_lang)
     return _creator(TEST_SIZE, 7_000_000, src_dict_size, trg_dict_size)
 
 
 def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    if _have_real():
+        return _real_creator("wmt16/val", src_dict_size,
+                             trg_dict_size, src_lang)
     return _creator(VALID_SIZE, 8_000_000, src_dict_size,
                     trg_dict_size)
 
 
 def get_dict(lang, dict_size, reverse=False):
-    words = ["<s>", "<e>", "<unk>"] + [
+    if _have_real():
+        return _load_dict(dict_size, lang, reverse)
+    words = [_S, _E, _U] + [
         "%s%d" % (lang, i) for i in range(3, dict_size)]
     if reverse:
         return {i: w for i, w in enumerate(words)}

@@ -1,0 +1,156 @@
+"""Python authoring API for MultiSlot training data.
+
+Reference: python/paddle/fluid/incubate/data_generator/__init__.py —
+users subclass DataGenerator, override ``generate_sample(line)`` (and
+optionally ``generate_batch(samples)`` + ``set_batch``), then drive
+``run_from_stdin()`` / ``run_from_memory()``; each emitted sample is a
+sequence of (slot_name, [feasign...]) pairs serialized to the
+MultiSlotDataFeed text format ("<n> v1 ... vn" per slot) that
+``paddle_tpu.dataset_factory`` / ``native/multislot.cpp`` parse.
+
+The slot schema is validated across samples the way the reference's
+``_proto_info`` does (same slot names, same order); the inferred
+per-slot type (uint64, promoted to float once any float value
+appears) is exposed via ``get_proto_info()`` — the analog of the
+reference's generated .proto data-feed description. Serialization
+itself is identical for both types ("<n> v1 ... vn")."""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator"]
+
+
+class DataGenerator:
+    """Base class; subclasses override ``generate_sample`` (reference
+    data_generator/__init__.py:21-235)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def get_proto_info(self):
+        """[(slot_name, "uint64"|"float"), ...] inferred from the
+        samples serialized so far (the reference writes this as a
+        .proto data-feed description beside the output); None before
+        the first sample."""
+        if self._proto_info is None:
+            return None
+        return [tuple(p) for p in self._proto_info]
+
+    def set_batch(self, batch_size):
+        """Batch size for ``generate_batch`` grouping (reference
+        :39)."""
+        if not isinstance(batch_size, int) or batch_size < 1:
+            raise ValueError("batch_size must be a positive int, got %r"
+                             % (batch_size,))
+        self.batch_size_ = batch_size
+
+    # -- user hooks ---------------------------------------------------------
+
+    def generate_sample(self, line):
+        """Override: map one raw input line (or None from memory mode)
+        to a local generator yielding samples of the form
+        [(name, [feasign...]), ...] (reference :156-195)."""
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        """Override for batch-level post-processing; default passes
+        samples through (reference :197-235)."""
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    # -- drivers ------------------------------------------------------------
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator (the serialization depends on "
+            "the data feed format)")
+
+    def _drain(self, batch_samples, out):
+        for sample in self.generate_batch(batch_samples)():
+            out.write(self._gen_str(sample))
+
+    def _run(self, line_source, out):
+        batch_samples = []
+        for line in line_source:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    self._drain(batch_samples, out)
+                    batch_samples = []
+        if batch_samples:
+            self._drain(batch_samples, out)
+
+    def run_from_memory(self, out=None):
+        """Emit samples produced by ``generate_sample(None)`` (debug /
+        benchmarking path, reference :66)."""
+        self._run([None], out or sys.stdout)
+
+    def run_from_stdin(self, out=None):
+        """stdin lines -> parsed samples -> MultiSlot text on stdout
+        (the fleet preprocessing pipeline contract, reference
+        :100)."""
+        self._run(sys.stdin, out or sys.stdout)
+
+    def run_from_file(self, input_path, output_path):
+        """File-to-file convenience the zero-egress test environment
+        uses; same semantics as run_from_stdin."""
+        with open(input_path) as fin, open(output_path, "w") as fout:
+            self._run(fin, fout)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [v...]), ...] -> "n v1 ... vn" per slot, one sample
+        per text line; validates the slot schema against the first
+        sample and promotes a slot to float once any float value
+        appears (reference :237-330 _proto_info handling)."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "sample must be a list/tuple of (name, values) pairs, "
+                "got %r" % (line,))
+        output = []
+        if self._proto_info is None:
+            self._proto_info = []
+            first = True
+        else:
+            first = False
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    "sample has %d slots but the schema has %d"
+                    % (len(line), len(self._proto_info)))
+        for i, item in enumerate(line):
+            name, elements = item
+            if not isinstance(name, str):
+                raise ValueError("slot name must be str, got %r"
+                                 % (name,))
+            if not elements:
+                raise ValueError("slot %r has no values (the MultiSlot "
+                                 "format cannot express empty slots)"
+                                 % name)
+            if first:
+                self._proto_info.append([name, "uint64"])
+            elif self._proto_info[i][0] != name:
+                raise ValueError(
+                    "slot %d is named %r but the schema says %r"
+                    % (i, name, self._proto_info[i][0]))
+            parts = [str(len(elements))]
+            for v in elements:
+                if isinstance(v, float):
+                    self._proto_info[i][1] = "float"
+                elif not isinstance(v, int):
+                    raise ValueError(
+                        "feasign must be int or float, got %r in slot "
+                        "%r" % (v, name))
+                parts.append(str(v))
+            output.append(" ".join(parts))
+        return " ".join(output) + "\n"

