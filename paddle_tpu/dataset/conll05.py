@@ -85,11 +85,14 @@ def get_dict():
 
 
 def get_embedding():
-    """Deterministic stand-in for the pretrained emb table the
-    reference downloads (conll05.py get_embedding); the real ``emb``
-    file is returned as a path when present under DATA_HOME."""
+    """The pretrained emb table as a float32 ndarray — parsed from the
+    real whitespace-float ``emb`` file when present under DATA_HOME
+    (one row per word; the reference returns the file path and leaves
+    loading to the caller, conll05.py:221), else a deterministic
+    stand-in. One return type either way."""
     if common.have_file(_MODULE, "emb"):
-        return common.data_path(_MODULE, "emb")
+        return np.loadtxt(common.data_path(_MODULE, "emb"),
+                          dtype=np.float32)
     rng = np.random.RandomState(0)
     return rng.randn(_WORDS, 32).astype(np.float32)
 

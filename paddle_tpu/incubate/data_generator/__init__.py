@@ -147,7 +147,10 @@ class MultiSlotDataGenerator(DataGenerator):
             for v in elements:
                 if isinstance(v, float):
                     self._proto_info[i][1] = "float"
-                elif not isinstance(v, int):
+                elif not isinstance(v, int) or isinstance(v, bool):
+                    # bool is an int subclass but str(True) is not
+                    # parseable MultiSlot text — reject it here, not
+                    # at dataset-load time
                     raise ValueError(
                         "feasign must be int or float, got %r in slot "
                         "%r" % (v, name))

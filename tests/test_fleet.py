@@ -272,13 +272,9 @@ class TestFleetPSTwoProcess:
         methodology): a pserver subprocess serves over the native RPC
         transport, a trainer subprocess trains through
         fleet.main_program, and both exit cleanly."""
-        import time
+        import dist_runner as dr
 
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        ep = "127.0.0.1:%d" % port
+        ep = "127.0.0.1:%d" % dr.free_port()
 
         def env(role):
             e = dict(os.environ)
@@ -291,48 +287,21 @@ class TestFleetPSTwoProcess:
             e["PADDLE_TRAINERS_NUM"] = "1"
             return e
 
-        import select
-        errfile = open(str(tmp_path / "server.err"), "w+")
-        server = subprocess.Popen(
-            [sys.executable, RUNNER, "pserver"],
-            env=env("PSERVER"), stdout=subprocess.PIPE,
-            stderr=errfile, text=True)
-        try:
-            # wait for the server to bind before starting the trainer;
-            # select keeps the deadline real even if the server hangs
-            # without writing anything
-            deadline = time.time() + 120
-            line = ""
-            while time.time() < deadline:
-                ready, _, _ = select.select([server.stdout], [], [],
-                                            1.0)
-                if ready:
-                    line = server.stdout.readline()
-                    if "SERVER_READY" in line:
-                        break
-                if server.poll() is not None:
-                    break
-            def _err():
-                errfile.seek(0)
-                return errfile.read()
-            assert "SERVER_READY" in line, _err()
+        with open(str(tmp_path / "server.err"), "w+") as errfile:
+            server = dr.spawn_pserver(env("PSERVER"), errfile,
+                                      timeout=120)
+            try:
+                (out,) = dr.run_ps_trainers([env("TRAINER")], 5,
+                                            timeout=240)
+                losses = dr.parse_losses(out, "ps trainer")
+                assert len(losses) == 5
+                assert np.isfinite(losses).all()
+                assert losses[-1] < losses[0]
 
-            trainer = subprocess.run(
-                [sys.executable, RUNNER, "ps_trainer", "5"],
-                env=env("TRAINER"), capture_output=True, text=True,
-                timeout=240)
-            assert trainer.returncode == 0, trainer.stderr[-2000:]
-            losses = json.loads(
-                trainer.stdout.split("LOSSES:")[1].strip())
-            assert len(losses) == 5
-            assert np.isfinite(losses).all()
-            assert losses[-1] < losses[0]
-
-            server.wait(timeout=60)
-            out = server.stdout.read()
-            assert server.returncode == 0, _err()
-            assert "SERVER_DONE" in out
-        finally:
-            if server.poll() is None:
-                server.kill()
-            errfile.close()
+                server.wait(timeout=60)
+                sout = server.stdout.read()
+                assert server.returncode == 0
+                assert "SERVER_DONE" in sout
+            finally:
+                if server.poll() is None:
+                    server.kill()
