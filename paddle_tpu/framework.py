@@ -399,10 +399,13 @@ def _infer_shapes(block, op):
         for a in op.attrs.values():
             _collect_ints(a)
         # primes defend against products of concrete dims equaling the
-        # sentinel; pairwise sums defend concat-style derived dims
+        # sentinel; pairwise sums defend concat-style derived dims.
+        # Iterate a snapshot: mutating avoid mid-loop would pair
+        # against already-added sums (order-dependent triple sums)
         if len(avoid) <= 64:
-            for x in list(avoid):
-                for y in list(avoid):
+            base = list(avoid)
+            for x in base:
+                for y in base:
                     avoid.add(x + y)
         dyn_dim = _pick_dyn_dim(avoid)
         for slot, variadic in opdef.input_slots:

@@ -17,6 +17,7 @@ plus valid counts — see ops/detection_ops.py for the rationale.
 
 from __future__ import annotations
 
+from ..core.enforce import enforce
 from ..layer_helper import LayerHelper
 
 __all__ = [
@@ -355,6 +356,10 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd,
     (reference: layers/detection.py generate_proposal_labels ->
     generate_proposal_labels_op.cc). Padded [N, S] outputs; pad slots
     carry label -1 (see ops/detection_ops.py)."""
+    enforce(class_nums is not None,
+            "generate_proposal_labels needs class_nums (the number of "
+            "detection classes incl. background, e.g. 81 for COCO) to "
+            "size its per-class bbox targets")
     helper = LayerHelper("generate_proposal_labels")
     rois = _mk(helper, stop_gradient=True)
     labels = _mk(helper, "int32", stop_gradient=True)

@@ -42,6 +42,13 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         else [param_attr] * len(inputs)
     mul_results = []
     for inp, pattr in zip(inputs, param_attrs):
+        enforce(
+            inp.shape is not None
+            and len(inp.shape) > num_flatten_dims,
+            "fc input %r needs a known rank > num_flatten_dims=%d to "
+            "size its weight (got shape %r — if this is an op whose "
+            "shape inference failed, set FLAGS_infer_shape_debug=1 to "
+            "see why)" % (inp.name, num_flatten_dims, inp.shape))
         in_features = 1
         for d in inp.shape[num_flatten_dims:]:
             in_features *= d

@@ -23,6 +23,25 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
     """``input``: [B, T, 4*hidden] (apply fc(input, 4*hidden) first, as
     in the reference); ``size`` = 4*hidden. Returns (hidden, cell),
     each [B, T, hidden]."""
+    return _dynamic_lstm_full(
+        input, size, h_0=h_0, c_0=c_0, param_attr=param_attr,
+        bias_attr=bias_attr, use_peepholes=use_peepholes,
+        is_reverse=is_reverse, gate_activation=gate_activation,
+        cell_activation=cell_activation,
+        candidate_activation=candidate_activation, dtype=dtype,
+        name=name, seq_len=seq_len)[:2]
+
+
+def _dynamic_lstm_full(input, size, h_0=None, c_0=None,
+                       param_attr=None, bias_attr=None,
+                       use_peepholes=True, is_reverse=False,
+                       gate_activation="sigmoid",
+                       cell_activation="tanh",
+                       candidate_activation="tanh", dtype="float32",
+                       name=None, seq_len=None):
+    """dynamic_lstm plus the op's last-step states ([B, hidden] each,
+    seq_len-aware) — the lstm op computes them anyway; layers.lstm
+    consumes them for the cudnn state contract."""
     enforce(size % 4 == 0, "dynamic_lstm size must be 4*hidden_size")
     helper = LayerHelper("lstm", name=name)
     hidden = size // 4
@@ -51,7 +70,7 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
                "gate_activation": gate_activation,
                "cell_activation": cell_activation,
                "candidate_activation": candidate_activation})
-    return out_h, out_c
+    return out_h, out_c, last_h, last_c
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,
@@ -179,25 +198,43 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     """Multi-layer LSTM (reference: layers/nn.py lstm — the cudnn LSTM
     wrapper; here each layer is the scan-lowered lstm op, stacked, and
     the input carries its own projection per layer as the cudnn weight
-    blob did). ``input`` [B, T, D]; returns (out, last_h, last_c)."""
+    blob did). ``input`` [B, T, D]; ``init_h``/``init_c``
+    [num_layers, B, hidden] (or None for zeros). Returns (out,
+    last_h, last_c) with ``out`` [B, T, hidden] the FINAL layer's
+    sequence and last_h/last_c [num_layers, B, hidden] the last-step
+    states — the cudnn contract. Dropout is applied between layers
+    only, as cudnn does."""
     from . import nn as _nn
     enforce(not is_bidirec, "is_bidirec=True: use two stacks with "
             "is_reverse and concat (cudnn bidirectional blob layout "
             "has no TPU analog)")
     helper = LayerHelper("lstm_stack", name=name)
+
+    def layer_state(state, layer):
+        if state is None:
+            return None
+        return _nn.squeeze(_nn.slice(state, axes=[0], starts=[layer],
+                                     ends=[layer + 1]), axes=[0])
+
     x = input
     last_hs, last_cs = [], []
     for layer in range(num_layers):
+        if layer > 0 and dropout_prob and not is_test:
+            # cudnn semantics: dropout between layers, never on the
+            # final layer's output
+            x = _nn.dropout(x, dropout_prob)
         proj = _nn.fc(x, 4 * hidden_size, num_flatten_dims=2,
                       bias_attr=False,
                       name=(name or "lstm") + "_in%d" % layer)
-        h, c = dynamic_lstm(proj, 4 * hidden_size,
-                            use_peepholes=False,
-                            name=(name or "lstm") + "_l%d" % layer,
-                            seq_len=seq_len)
-        if dropout_prob and not is_test:
-            h = _nn.dropout(h, dropout_prob)
+        h, _c, lh, lc = _dynamic_lstm_full(
+            proj, 4 * hidden_size,
+            h_0=layer_state(init_h, layer),
+            c_0=layer_state(init_c, layer),
+            use_peepholes=False,
+            name=(name or "lstm") + "_l%d" % layer,
+            seq_len=seq_len)
         x = h
-        last_hs.append(h)
-        last_cs.append(c)
-    return x, last_hs[-1], last_cs[-1]
+        last_hs.append(lh)
+        last_cs.append(lc)
+    return (x, _nn.stack(last_hs, axis=0),
+            _nn.stack(last_cs, axis=0))

@@ -317,14 +317,47 @@ def pool2d(x, *, ksize, pooling_type="max", strides=(1, 1),
     return summed / counts
 
 
+def _adaptive_pool(x, out_sizes, axes, pooling_type):
+    """General adaptive pooling: output cell i over axis of length L
+    covers [floor(i*L/O), ceil((i+1)*L/O)) — the reference's
+    AdaptiveStartIndex/AdaptiveEndIndex (pool_op.h:42-52). Bin
+    boundaries are static, so uneven sizes lower to a static slice
+    per cell (cheap: O cells is small); the even case keeps the fused
+    one-reshape reduction."""
+    if all(x.shape[ax] % o == 0 for ax, o in zip(axes, out_sizes)):
+        shape, red_axes = [], []
+        for d in range(x.ndim):
+            if d in axes:
+                o = out_sizes[axes.index(d)]
+                shape += [o, x.shape[d] // o]
+                red_axes.append(len(shape) - 1)
+            else:
+                shape.append(x.shape[d])
+        xr = x.reshape(shape)
+        reduce = jnp.max if pooling_type == "max" else jnp.mean
+        return reduce(xr, axis=tuple(red_axes))
+
+    def pool_axis(arr, ax, o):
+        L = arr.shape[ax]
+        cells = []
+        for i in range(o):
+            lo, hi = (i * L) // o, -((-(i + 1) * L) // o)  # ceil
+            sl = [slice(None)] * arr.ndim
+            sl[ax] = slice(lo, hi)
+            reduce = jnp.max if pooling_type == "max" else jnp.mean
+            cells.append(reduce(arr[tuple(sl)], axis=ax,
+                                keepdims=True))
+        return jnp.concatenate(cells, axis=ax)
+
+    for ax, o in zip(axes, out_sizes):
+        x = pool_axis(x, ax, o)
+    return x
+
+
 @register("adaptive_pool2d", ["X"], ["Out"])
 def adaptive_pool2d(x, *, pool_size, pooling_type="avg"):
-    n, c, h, w = x.shape
     oh, ow = _pair(pool_size)
-    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
-    if pooling_type == "max":
-        return jnp.max(x, axis=(3, 5))
-    return jnp.mean(x, axis=(3, 5))
+    return _adaptive_pool(x, (oh, ow), (2, 3), pooling_type)
 
 
 # -- normalization ----------------------------------------------------------
@@ -605,15 +638,11 @@ def stanh(x, *, scale_a=0.67, scale_b=1.7159):
 
 @register("adaptive_pool3d", ["X"], ["Out"])
 def adaptive_pool3d(x, *, pool_size, pooling_type="avg"):
-    """Reference: pool_op.cc adaptive 3-D (NCDHW); each output cell
-    averages/maxes its evenly split input region."""
-    n, c, d, h, w = x.shape
+    """Reference: pool_op.cc adaptive 3-D (NCDHW); uneven splits use
+    the reference's floor/ceil bin boundaries (pool_op.h:42-52)."""
     od, oh, ow = (pool_size if isinstance(pool_size, (list, tuple))
                   else (pool_size,) * 3)
-    x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
-    if pooling_type == "max":
-        return jnp.max(x, axis=(3, 5, 7))
-    return jnp.mean(x, axis=(3, 5, 7))
+    return _adaptive_pool(x, (od, oh, ow), (2, 3, 4), pooling_type)
 
 
 @register("dice_loss", ["X", "Label"], ["Out"], nondiff=("Label",))

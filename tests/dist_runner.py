@@ -12,9 +12,15 @@ import json
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# one CPU device per process (the parent test env forces 8)
-os.environ["XLA_FLAGS"] = ""
+if __name__ == "__main__":
+    # subprocess mode: claim a single CPU device before any jax import
+    # (paddle imports are lazy inside the run_* functions, so this is
+    # early enough). Guarded so importing this module for its helpers
+    # (test_fleet.py, __graft_entry__._dryrun_ps) does NOT mutate the
+    # importing process's environment.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # one CPU device per process (the parent test env forces 8)
+    os.environ["XLA_FLAGS"] = ""
 
 import numpy as np  # noqa: E402
 
@@ -248,16 +254,35 @@ def run_ps_trainers(envs, n_steps, timeout=300):
     trainer's stdout."""
     import subprocess
 
+    import threading
+    import time
+
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "ps_trainer",
          str(n_steps)],
         env=e, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for e in envs]
-    outs = []
+    # drain every pipe CONCURRENTLY: a sequentially-read sibling can
+    # fill its pipe with XLA warnings, block, and stall the sync
+    # barrier for everyone
+    bufs = [[] for _ in procs]
+
+    def drain(stream, sink):
+        for ln in stream:
+            sink.append(ln)
+
+    readers = [threading.Thread(target=drain,
+                                args=(p.stdout, bufs[i]), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in readers:
+        t.start()
+    deadline = time.time() + timeout
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outs.append(out)
+            p.wait(timeout=max(deadline - time.time(), 1))
+        for t in readers:
+            t.join(timeout=10)
+        outs = ["".join(b) for b in bufs]
         for r, (p, out) in enumerate(zip(procs, outs)):
             if p.returncode != 0:
                 raise AssertionError("ps trainer %d failed:\n%s"

@@ -140,6 +140,41 @@ def test_sequence_and_rnn_wrappers_run():
     assert np.asarray(res[3]).shape == (2, 6, 8)
 
 
+def test_lstm_states_contract():
+    """layers.lstm returns cudnn-contract states ([num_layers, B, H]
+    last-step h/c) and honors init_h/init_c (ADVICE r3: they were
+    silently ignored)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        seq = layers.data("seq", shape=[6, 8])
+        # batch declared dynamic like seq's, so shape inference sees
+        # one consistent dynamic dim across the lstm op's inputs
+        h0 = layers.data("h0", shape=[2, -1, 8],
+                         append_batch_size=False)
+        c0 = layers.data("c0", shape=[2, -1, 8],
+                         append_batch_size=False)
+        out, lh, lc = layers.lstm(seq, h0, c0, 6, 8, 2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(5)
+    feed = {"seq": rs.rand(3, 6, 8).astype(np.float32),
+            "h0": np.zeros((2, 3, 8), np.float32),
+            "c0": np.zeros((2, 3, 8), np.float32)}
+    o0, h_zero, c_zero = (np.asarray(v) for v in exe.run(
+        main, feed=feed, fetch_list=[out, lh, lc]))
+    assert o0.shape == (3, 6, 8)
+    assert h_zero.shape == (2, 3, 8) and c_zero.shape == (2, 3, 8)
+    # top layer's last-step h equals the output's last timestep
+    np.testing.assert_allclose(h_zero[1], o0[:, -1, :], rtol=1e-5,
+                               atol=1e-6)
+    # a nonzero initial state must change the result
+    feed["h0"] = np.full((2, 3, 8), 0.7, np.float32)
+    feed["c0"] = np.full((2, 3, 8), -0.4, np.float32)
+    o1 = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+    assert np.abs(o1 - o0).max() > 1e-4
+
+
 def test_tensor_array_to_tensor_and_counter():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

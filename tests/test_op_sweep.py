@@ -334,6 +334,13 @@ spec("pool2d",
      {"ksize": (2, 2), "pooling_type": "max", "strides": (2, 2)})
 spec("adaptive_pool2d", {"X": sgn((1, 1, 4, 4), 96)},
      {"pool_size": (2, 2), "pooling_type": "avg"})
+# uneven bins: 5 -> 3 uses floor/ceil boundaries (pool_op.h:42-52)
+spec("adaptive_pool2d", {"X": sgn((1, 2, 5, 7), 961)},
+     {"pool_size": (3, 4), "pooling_type": "avg"})
+spec("adaptive_pool2d",
+     {"X": (np.arange(70, dtype=np.float32).reshape(1, 2, 5, 7)
+            + u((1, 2, 5, 7), 962, lo=0.0, hi=0.3))},
+     {"pool_size": (3, 4), "pooling_type": "max"})
 spec("maxout",
      {"X": (np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)
             + u((1, 4, 2, 2), 97, lo=0.0, hi=0.3))},

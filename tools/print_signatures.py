@@ -41,6 +41,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.contrib.slim.quantization",
     "paddle_tpu.recordio",
     "paddle_tpu.dataset_factory",
+    "paddle_tpu.incubate.data_generator",
     "paddle_tpu.incubate.fleet.base.role_maker",
     "paddle_tpu.incubate.fleet.collective",
     "paddle_tpu.incubate.fleet.parameter_server",
