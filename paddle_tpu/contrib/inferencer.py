@@ -1,0 +1,49 @@
+"""Inferencer high-level facade.
+
+Reference: python/paddle/fluid/contrib/inferencer.py — builds the
+inference program from ``infer_func``, loads params saved by
+``save_params``, and serves ``infer(inputs)`` feeds. The place /
+parallel knobs are dropped (XLA owns the device)."""
+
+from __future__ import annotations
+
+from .. import io as io_mod
+from .. import unique_name
+from ..core.scope import Scope
+from ..executor import Executor, scope_guard
+from ..framework import Program, program_guard
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    """Reference inferencer.py:31."""
+
+    def __init__(self, infer_func, param_path, place=None,
+                 parallel=False):
+        del place, parallel
+        self.param_path = param_path
+        self.scope = Scope()
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+        self.exe = Executor()
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            io_mod.load_params(self.exe, param_path,
+                               main_program=self.inference_program)
+        self.inference_program = \
+            self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        """inputs: {feed_name: ndarray} (reference
+        inferencer.py:80)."""
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

@@ -1,0 +1,60 @@
+"""Estimate a program's activation-memory footprint for a batch size.
+
+Reference: python/paddle/fluid/contrib/memory_usage_calc.py —
+``memory_usage`` sums every op-output tensor's size (resolving the one
+dynamic dim with the batch size), converts to a friendly unit, and
+reports a [5%, 10%]-padded range. On TPU the estimate guides batch
+sizing against HBM exactly as the reference's guided GPU memory."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Program
+
+__all__ = ["memory_usage"]
+
+
+def memory_usage(program, batch_size):
+    """(min_total, max_total, unit_str) (reference
+    memory_usage_calc.py:46)."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its "
+            "Parameter. But you passed in %s" % (type(program),))
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total = 0.0
+    seen = set()
+    blk = program.global_block()
+    for op in blk.ops:
+        for var_name in op.output_arg_names:
+            if var_name in seen:
+                continue
+            seen.add(var_name)
+            var = blk._find_var_recursive(var_name)
+            if var is None or not var.shape:
+                continue
+            count = 1
+            neg_seen = False
+            for d in var.shape:
+                if d < 0:
+                    if neg_seen:
+                        raise ValueError(
+                            "Var %s has more than one negative dim."
+                            % var_name)
+                    neg_seen = True
+                    count *= batch_size * (-d)
+                else:
+                    count *= d
+            total += count * np.dtype(var.dtype).itemsize
+
+    unit = "B"
+    if total > 1024:
+        total /= 1024
+        unit = "KB"
+        if total > 1024:
+            total /= 1024
+            unit = "MB"
+    return total * 1.05, total * 1.1, unit

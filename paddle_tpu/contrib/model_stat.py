@@ -1,0 +1,120 @@
+"""Model PARAMs/FLOPs summary table.
+
+Reference: python/paddle/fluid/contrib/model_stat.py — ``summary``
+walks the main program's ops, computes per-op parameter and FLOP
+counts for the common CNN ops, and prints an aligned table plus
+totals. This version also RETURNS (rows, total_params, total_flops)
+so tooling can consume it, and formats the table without the
+prettytable dependency."""
+
+from __future__ import annotations
+
+__all__ = ["summary"]
+
+
+def _var_shape(block, name):
+    v = block._find_var_recursive(name)
+    return tuple(v.shape) if v is not None and v.shape else None
+
+
+def _op_stat(block, op):
+    """(input_shape, out_shape, params, flops) or None for uncounted
+    ops (reference model_stat.py:75-140 op coverage)."""
+    t = op.type
+    if t in ("conv2d", "depthwise_conv2d"):
+        k = _var_shape(block, op.input("Filter")[0])
+        ins = _var_shape(block, op.input("Input")[0])
+        out = _var_shape(block, op.output("Output")[0])
+        if not (k and ins and out):
+            return None
+        c_out, c_in, k_h, k_w = k
+        h_out, w_out = out[2], out[3]
+        groups = op.attr("groups") or 1
+        kernel_ops = k_h * k_w * (c_in / groups)
+        bias = 1 if op.inputs.get("Bias") else 0
+        params = c_out * (kernel_ops + bias)
+        flops = 2 * h_out * w_out * c_out * (kernel_ops + bias)
+        return ins, out, int(params), int(flops)
+    if t == "pool2d":
+        ins = _var_shape(block, op.input("X")[0])
+        out = _var_shape(block, op.output("Out")[0])
+        if not (ins and out):
+            return None
+        k = op.attr("ksize") or (1, 1)
+        if not isinstance(k, (list, tuple)):
+            k = (k, k)
+        flops = out[1] * out[2] * out[3] * k[0] * k[1]
+        return ins, out, 0, int(flops)
+    if t == "mul":
+        x = _var_shape(block, op.input("X")[0])
+        y = _var_shape(block, op.input("Y")[0])
+        out = _var_shape(block, op.output("Out")[0])
+        if not (x and y and out):
+            return None
+        params = y[0] * y[1]
+        flops = 2 * params
+        return x, out, int(params), int(flops)
+    if t == "batch_norm":
+        ins = _var_shape(block, op.input("X")[0])
+        out = _var_shape(block, op.output("Y")[0])
+        if not (ins and out):
+            return None
+        c = ins[1] if len(ins) > 1 else ins[-1]
+        numel = 1
+        for d in out:
+            numel *= max(d, 1)
+        return ins, out, int(4 * c), int(numel)
+    if t in ("relu", "relu6", "sigmoid", "tanh", "leaky_relu", "swish",
+             "hard_swish", "elementwise_add"):
+        name = op.input("X")[0]
+        ins = _var_shape(block, name)
+        outs = [n for ns in op.outputs.values() for n in ns]
+        out = _var_shape(block, outs[0]) if outs else None
+        if not (ins and out):
+            return None
+        numel = 1
+        for d in out:
+            numel *= max(d, 1)
+        return ins, out, 0, int(numel)
+    return None
+
+
+def summary(main_prog, print_table=True):
+    """Collect and (optionally) print the per-op PARAMs/FLOPs table
+    (reference model_stat.py:37 ``summary``). Returns
+    (rows, total_params, total_flops); each row is a dict with type /
+    input_shape / out_shape / PARAMs / FLOPs."""
+    rows = []
+    for blk in main_prog.blocks:
+        for op in blk.ops:
+            st = _op_stat(blk, op)
+            if st is None:
+                continue
+            ins, out, params, flops = st
+            rows.append({"type": op.type,
+                         "input_shape": tuple(ins[1:]),
+                         "out_shape": tuple(out[1:]),
+                         "PARAMs": params, "FLOPs": flops})
+    total_params = sum(r["PARAMs"] for r in rows)
+    total_flops = sum(r["FLOPs"] for r in rows)
+    if print_table:
+        header = ("No.", "TYPE", "INPUT", "OUTPUT", "PARAMs", "FLOPs")
+        table = [(str(i), r["type"], str(r["input_shape"]),
+                  str(r["out_shape"]), str(r["PARAMs"]),
+                  str(r["FLOPs"])) for i, r in enumerate(rows)]
+        widths = [max(len(h), *(len(t[c]) for t in table)) if table
+                  else len(h) for c, h in enumerate(header)]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(" %*s " % (w, h)
+                             for w, h in zip(widths, header)) + "|")
+        print(sep)
+        for t in table:
+            print("|" + "|".join(" %*s " % (w, c)
+                                 for w, c in zip(widths, t)) + "|")
+        print(sep)
+        print("Total PARAMs: %d(%.4fG)"
+              % (total_params, total_params / 1e9))
+        print("Total FLOPs: %d(%.2fG)" % (total_flops,
+                                          total_flops / 1e9))
+    return rows, total_params, total_flops

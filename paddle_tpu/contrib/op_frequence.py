@@ -1,0 +1,49 @@
+"""Op-frequency statistics over a Program.
+
+Reference: python/paddle/fluid/contrib/op_frequence.py —
+``op_freq_statistic`` returns the single-op frequency and the
+adjacent-op-pair ("producer->consumer") frequency, both sorted
+descending, skipping parameter-only edges."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """(uni_op_freq, adj_2_op_freq): lists of (key, count) sorted by
+    count descending (reference op_frequence.py:23)."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Program. "
+                        "But you passed in %s" % (type(program),))
+
+    uni = OrderedDict()
+    adj = OrderedDict()
+    params = {p.name for p in program.global_block().all_parameters()}
+
+    var_gen_op = {}
+    for op in program.global_block().ops:
+        counted = False
+        for var_name in op.output_arg_names:
+            if var_name in params:
+                continue
+            if not counted:
+                uni[op.type] = uni.get(op.type, 0) + 1
+                counted = True
+        for var_name in op.input_arg_names:
+            if var_name in params:
+                continue
+            gens = var_gen_op.get(var_name)
+            if gens:
+                key = gens[-1] + "->" + op.type
+                adj[key] = adj.get(key, 0) + 1
+        for var_name in op.output_arg_names:
+            var_gen_op.setdefault(var_name, []).append(op.type)
+
+    uni = sorted(uni.items(), key=lambda kv: kv[1], reverse=True)
+    adj = sorted(adj.items(), key=lambda kv: kv[1], reverse=True)
+    return uni, adj

@@ -1,5 +1,6 @@
 """Light neural-architecture search (reference: contrib/slim/nas/)."""
 
 from .search_space import SearchSpace  # noqa: F401
+from .conv_space import SimpleConvSpace  # noqa: F401
 from .sa_controller import SAController  # noqa: F401
 from .light_nas_strategy import LightNASStrategy  # noqa: F401

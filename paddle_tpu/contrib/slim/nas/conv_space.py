@@ -1,0 +1,66 @@
+"""A concrete, runnable NAS search space: a small conv-net family.
+
+Reference: the reference ships LightNASStrategy against user search
+spaces (contrib/slim/nas/search_space.py); its models repo pairs it
+with a MobileNetV2 token space. This in-tree space makes LightNAS
+usable out of the box: tokens pick each stage's width, kernel size
+and depth, and ``create_net`` returns (train_program,
+startup_program, loss, accuracy, feed_names) for a CIFAR-shaped
+classification task."""
+
+from __future__ import annotations
+
+from .search_space import SearchSpace
+
+__all__ = ["SimpleConvSpace"]
+
+_WIDTHS = (8, 12, 16, 24, 32)
+_KERNELS = (1, 3, 5)
+_DEPTHS = (1, 2)
+
+
+class SimpleConvSpace(SearchSpace):
+    """3 stages x (width, kernel, depth) tokens + a final-width token:
+    range_table = [5, 3, 2] * 3 + [5]. TPU-friendly by construction
+    (static shapes, conv+bn+relu blocks that XLA fuses)."""
+
+    def __init__(self, num_classes=10, image_shape=(3, 32, 32)):
+        self.num_classes = num_classes
+        self.image_shape = tuple(image_shape)
+
+    def init_tokens(self):
+        return [2, 1, 0] * 3 + [2]
+
+    def range_table(self):
+        return [len(_WIDTHS), len(_KERNELS), len(_DEPTHS)] * 3 + \
+            [len(_WIDTHS)]
+
+    def create_net(self, tokens=None):
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+
+        tokens = list(self.init_tokens() if tokens is None else tokens)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 17
+        with fluid.program_guard(main, startup):
+            img = layers.data("img", shape=list(self.image_shape))
+            label = layers.data("label", shape=[1], dtype="int64")
+            x = img
+            for stage in range(3):
+                w_i, k_i, d_i = tokens[3 * stage:3 * stage + 3]
+                width = _WIDTHS[w_i]
+                kernel = _KERNELS[k_i]
+                for _ in range(_DEPTHS[d_i]):
+                    x = layers.conv2d(x, num_filters=width,
+                                      filter_size=kernel,
+                                      padding=kernel // 2, act=None)
+                    x = layers.batch_norm(x, act="relu")
+                x = layers.pool2d(x, pool_size=2, pool_stride=2,
+                                  pool_type="max")
+            x = layers.pool2d(x, pool_size=x.shape[2],
+                              pool_type="avg")
+            x = layers.fc(x, size=_WIDTHS[tokens[-1]] * 4, act="relu")
+            pred = layers.fc(x, size=self.num_classes, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            acc = layers.accuracy(input=pred, label=label)
+        return main, startup, loss, acc, ["img", "label"]

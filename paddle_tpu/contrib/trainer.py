@@ -1,0 +1,143 @@
+"""High-level Trainer / event-loop facade.
+
+Reference: python/paddle/fluid/contrib/trainer.py — Trainer wraps
+program construction (train_func returns loss), optimization, the
+epoch/step event loop (Begin/EndEpochEvent, Begin/EndStepEvent with
+fetch_metrics), test(), save_params() and stop(). The TPU redesign
+keeps the API but drops the place/parallel machinery (the Executor
+already owns the one XLA device and data parallelism comes from
+CompiledProgram)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import io as io_mod
+from .. import optimizer as optimizer_mod
+from ..data_feeder import DataFeeder
+from ..executor import Executor
+from ..framework import Program, program_guard
+from .. import unique_name
+
+__all__ = ["Trainer", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent"]
+
+
+class BeginEpochEvent:
+    """Reference trainer.py:51."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    """Reference trainer.py:62."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    """Reference trainer.py:73."""
+
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    """Reference trainer.py:89."""
+
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer:
+    """train_func() -> loss var (or [loss, metric...]);
+    optimizer_func() -> an Optimizer (reference trainer.py:115)."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        del place, parallel, checkpoint_config  # XLA owns devices
+        self.stop_flag = False
+        self.train_program = Program()
+        self.startup_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            with unique_name.guard():
+                outs = train_func()
+                outs = list(outs) if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                self.train_outputs = outs
+                self.loss = outs[0]
+                opt = optimizer_func()
+                if not isinstance(opt, optimizer_mod.Optimizer):
+                    raise TypeError(
+                        "optimizer_func must return an Optimizer, got "
+                        "%r" % (opt,))
+                opt.minimize(self.loss)
+        self.test_program = self.train_program.clone(for_test=True)
+        self.exe = Executor()
+        self.exe.run(self.startup_program)
+        if param_path:
+            io_mod.load_params(self.exe, param_path,
+                               main_program=self.train_program)
+
+    def stop(self):
+        """Ask the train loop to exit after the current step
+        (reference trainer.py:231)."""
+        self.stop_flag = True
+
+    def _feeder(self, feed_order, program):
+        blk = program.global_block()
+        return DataFeeder(feed_list=[blk.var(n) for n in feed_order],
+                          place=None, program=program)
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        """The epoch/step loop with events (reference trainer.py:239).
+        ``reader`` yields batches of tuples ordered like
+        ``feed_order``."""
+        feeder = self._feeder(feed_order, self.train_program)
+        for epoch_id in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self.stop_flag:
+                    event_handler(EndEpochEvent(epoch_id))
+                    return
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = self.train_outputs if begin.fetch_metrics \
+                    else []
+                metrics = self.exe.run(self.train_program,
+                                       feed=feeder.feed(data),
+                                       fetch_list=fetch)
+                event_handler(EndStepEvent(
+                    epoch_id, step_id,
+                    [np.asarray(m) for m in metrics]))
+            event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader, feed_order):
+        """Mean metrics over the test reader on the for_test clone
+        (reference trainer.py:293)."""
+        feeder = self._feeder(feed_order, self.test_program)
+        totals = None
+        count = 0
+        for data in reader():
+            vals = self.exe.run(self.test_program,
+                                feed=feeder.feed(data),
+                                fetch_list=self.train_outputs)
+            vals = [float(np.asarray(v).reshape(-1)[0]) for v in vals]
+            totals = vals if totals is None else \
+                [a + b for a, b in zip(totals, vals)]
+            count += 1
+        if count == 0:
+            return []
+        return [t / count for t in totals]
+
+    def save_params(self, param_path):
+        """Reference trainer.py:310."""
+        io_mod.save_params(self.exe, param_path,
+                           main_program=self.train_program)

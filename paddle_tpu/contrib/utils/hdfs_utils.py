@@ -1,0 +1,194 @@
+"""HDFS client helpers built on the ``hadoop fs`` CLI.
+
+Reference: python/paddle/fluid/contrib/utils/hdfs_utils.py —
+HDFSClient shells out to ``$HADOOP_HOME/bin/hadoop fs`` with the
+configured name-node settings and exposes upload/download/is_exist/
+is_dir/delete/rename/makedirs/ls/lsr, plus multi_download /
+multi_upload which fan file transfers out over local processes.
+
+The command runner is injectable (``runner=``) so the logic is fully
+testable in a zero-egress environment; by default it execs the real
+CLI."""
+
+from __future__ import annotations
+
+import logging
+from multiprocessing.pool import ThreadPool
+import os
+import subprocess
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+_logger = logging.getLogger("hdfs_utils")
+
+
+def _default_runner(cmd):
+    proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    return proc.returncode, proc.stdout.splitlines()
+
+
+class HDFSClient:
+    """Reference hdfs_utils.py:31 — configs carry
+    fs.default.name / hadoop.job.ugi."""
+
+    def __init__(self, hadoop_home, configs, runner=None):
+        self.pre_commands = []
+        hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self.pre_commands.append(hadoop_bin)
+        self.pre_commands.append("fs")
+        for k, v in (configs or {}).items():
+            self.pre_commands.append("-D%s=%s" % (k, v))
+        self._run = runner or _default_runner
+        self._made_dirs = set()
+
+    def __run_hdfs_cmd(self, commands):
+        cmd = self.pre_commands + list(commands)
+        _logger.info("Running system command: %s", " ".join(cmd))
+        ret, output = self._run(cmd)
+        return ret, output
+
+    def is_exist(self, hdfs_path):
+        ret, _ = self.__run_hdfs_cmd(["-test", "-e", hdfs_path])
+        return ret == 0
+
+    def is_dir(self, hdfs_path):
+        ret, _ = self.__run_hdfs_cmd(["-test", "-d", hdfs_path])
+        return ret == 0
+
+    def is_file(self, hdfs_path):
+        return self.is_exist(hdfs_path) and not self.is_dir(hdfs_path)
+
+    def delete(self, hdfs_path):
+        """rm -r (reference: delete() drops dirs recursively)."""
+        if not self.is_exist(hdfs_path):
+            return True
+        ret, _ = self.__run_hdfs_cmd(["-rm", "-r", hdfs_path])
+        return ret == 0
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(hdfs_dst_path):
+            self.delete(hdfs_dst_path)
+        ret, _ = self.__run_hdfs_cmd(["-mv", hdfs_src_path,
+                                      hdfs_dst_path])
+        return ret == 0
+
+    def makedirs(self, hdfs_path):
+        if self.is_exist(hdfs_path):
+            return True
+        ret, _ = self.__run_hdfs_cmd(["-mkdir", "-p", hdfs_path])
+        return ret == 0
+
+    def ls(self, hdfs_path):
+        """List entry paths (last whitespace field per line, as the
+        reference parses ``hadoop fs -ls``)."""
+        ret, lines = self.__run_hdfs_cmd(["-ls", hdfs_path])
+        if ret != 0:
+            return []
+        out = []
+        for line in lines:
+            parts = line.split()
+            if len(parts) >= 8:
+                out.append(parts[-1])
+        return out
+
+    def lsr(self, hdfs_path, only_file=True):
+        ret, lines = self.__run_hdfs_cmd(["-ls", "-R", hdfs_path])
+        if ret != 0:
+            return []
+        out = []
+        for line in lines:
+            parts = line.split()
+            if len(parts) >= 8:
+                if only_file and parts[0].startswith("d"):
+                    continue
+                out.append(parts[-1])
+        return out
+
+    def upload(self, hdfs_path, local_path, overwrite=False,
+               retry_times=5):
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        # each hadoop CLI call is a JVM launch: make each destination
+        # directory once per client, not once per file
+        parent = os.path.dirname(hdfs_path) or "/"
+        if parent not in self._made_dirs:
+            self.makedirs(parent)
+            self._made_dirs.add(parent)
+        for _ in range(max(retry_times, 1)):
+            ret, _ = self.__run_hdfs_cmd(["-put", local_path,
+                                          hdfs_path])
+            if ret == 0:
+                return True
+        return False
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False, retry_times=5):
+        del unzip
+        if overwrite and os.path.exists(local_path):
+            if os.path.isfile(local_path):
+                os.remove(local_path)
+        for _ in range(max(retry_times, 1)):
+            ret, _ = self.__run_hdfs_cmd(["-get", hdfs_path,
+                                          local_path])
+            if ret == 0:
+                return True
+        return False
+
+
+def _chunk(seq, n):
+    n = max(int(n), 1)
+    return [seq[i::n] for i in range(n)]
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id,
+                   trainers, multi_processes=5):
+    """Download this trainer's 1/``trainers`` slice of the files under
+    ``hdfs_path``, fanning out over processes (reference
+    hdfs_utils.py:456). Returns the local file list."""
+    files = client.lsr(hdfs_path)
+    my_files = files[trainer_id::max(trainers, 1)]
+    os.makedirs(local_path, exist_ok=True)
+
+    def work(sub):
+        out = []
+        for f in sub:
+            # preserve the remote layout under local_path: basenames
+            # alone would clobber same-named files from different
+            # remote subdirectories
+            rel = os.path.relpath(f, hdfs_path)
+            dst = os.path.join(local_path, rel)
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            if client.download(f, dst):
+                out.append(dst)
+        return out
+
+    if multi_processes <= 1 or len(my_files) <= 1:
+        return work(my_files)
+    with ThreadPool(multi_processes) as pool:
+        parts = pool.map(work, _chunk(my_files, multi_processes))
+    return [f for p in parts for f in p]
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False):
+    """Upload every file under ``local_path`` (reference
+    hdfs_utils.py:515)."""
+    files = []
+    for root, _dirs, names in os.walk(local_path):
+        for n in names:
+            files.append(os.path.join(root, n))
+
+    def work(sub):
+        ok = 0
+        for f in sub:
+            rel = os.path.relpath(f, local_path)
+            if client.upload(os.path.join(hdfs_path, rel), f,
+                             overwrite=overwrite):
+                ok += 1
+        return ok
+
+    if multi_processes <= 1 or len(files) <= 1:
+        return work(files)
+    with ThreadPool(multi_processes) as pool:
+        return sum(pool.map(work, _chunk(files, multi_processes)))

@@ -1,0 +1,162 @@
+"""Distributed-lookup-table persistence and program conversion.
+
+Reference: python/paddle/fluid/contrib/utils/lookup_table_utils.py —
+after fleet training with a distributed (>HBM) lookup table, users
+need to (a) keep training locally from a checkpoint
+(``load_persistables_for_increment``), (b) serve inference with the
+table materialized (``load_persistables_for_inference``), and (c)
+convert a distributed-lookup program into one that runs against a
+local sparse table (``convert_dist_to_sparse_program``).
+
+TPU-native mapping: the >HBM table is a ``LargeScaleKV``
+(distributed/lookup_service.py) instead of the reference's pserver
+SSD table; its rows checkpoint into ``<dir>/__lookup_table__`` as an
+npz, and "materializing for inference" means building the dense
+[rows, dim] parameter the in-graph embedding op consumes."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ... import io as io_mod
+from ...core.enforce import enforce
+from ...distributed.lookup_service import LargeScaleKV
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference", "save_lookup_table"]
+
+LOOKUP_TABLE_FILE = "__lookup_table__"
+
+
+def _dist_lookups(program):
+    lookups = list(getattr(program, "_distributed_lookups", []))
+    enforce(lookups,
+            "program has no distributed lookup table (build with "
+            "layers.embedding(..., is_distributed=True))")
+    return lookups
+
+
+def save_lookup_table(table: LargeScaleKV, dirname):
+    """Checkpoint the touched rows AND the table's hyperparameters +
+    optimizer state of a LargeScaleKV (the reference's pserver-side
+    table checkpoint, lookup_table_utils.py's ``__lookup_table__``
+    dir) — a resumed run must continue exactly where training
+    stopped, including lazy-init seed and adagrad accumulators."""
+    os.makedirs(dirname, exist_ok=True)
+    with table._mu:
+        ids = np.asarray(sorted(table._rows), np.int64)
+        rows = (np.stack([table._rows[int(i)] for i in ids])
+                if len(ids) else np.zeros((0, table.dim), np.float32))
+        acc_ids = np.asarray(sorted(table._accum), np.int64)
+        accum = (np.stack([table._accum[int(i)] for i in acc_ids])
+                 if len(acc_ids)
+                 else np.zeros((0, table.dim), np.float32))
+    np.savez(os.path.join(dirname, LOOKUP_TABLE_FILE),
+             ids=ids, rows=rows, dim=np.int64(table.dim),
+             acc_ids=acc_ids, accum=accum,
+             seed=np.int64(table.seed),
+             init_std=np.float64(table.init_std),
+             lr=np.float64(table.lr),
+             optimizer=np.bytes_(table.optimizer.encode()))
+
+
+def _load_table_file(dirname):
+    path = os.path.join(dirname, LOOKUP_TABLE_FILE)
+    if not os.path.exists(path):
+        path += ".npz"
+    enforce(os.path.exists(path),
+            "no %s under %r (save with save_lookup_table)"
+            % (LOOKUP_TABLE_FILE, dirname))
+    return np.load(path)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Resume local training: load the dense persistables through the
+    normal io path and rebuild a LargeScaleKV with the checkpointed
+    rows (reference lookup_table_utils.py:91). Returns the table."""
+    io_mod.load_persistables(executor, dirname, main_program=program)
+    data = _load_table_file(dirname)
+    table = LargeScaleKV(
+        dim=int(data["dim"]),
+        init_std=float(data["init_std"]),
+        optimizer=bytes(data["optimizer"]).decode(),
+        lr=float(data["lr"]), seed=int(data["seed"]))
+    for i, r in zip(np.asarray(data["ids"], np.int64), data["rows"]):
+        table._rows[int(i)] = np.asarray(r, np.float32)
+    for i, a in zip(np.asarray(data["acc_ids"], np.int64),
+                    data["accum"]):
+        table._accum[int(i)] = np.asarray(a, np.float32)
+    return table
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """Serve inference: load dense persistables and materialize the
+    sparse table into the dense embedding parameter
+    ``lookup_table_var_name`` (rows not in the checkpoint keep their
+    initializer values) — reference lookup_table_utils.py:167."""
+    from ...executor import global_scope
+
+    # dense persistables EXCLUDING the table param (its rows come from
+    # the sparse checkpoint, not a dense tensor file — reference
+    # lookup_table_utils.py:186 filters the same way)
+    io_mod.load_vars(
+        executor, dirname, main_program=program,
+        predicate=lambda v: v.persistable
+        and v.name != lookup_table_var_name)
+    data = _load_table_file(dirname)
+    ids = np.asarray(data["ids"], np.int64)
+    rows = np.asarray(data["rows"], np.float32)
+    scope = global_scope()
+    enforce(scope.has_var(lookup_table_var_name),
+            "var %r not found in scope (run the startup program "
+            "first)" % lookup_table_var_name)
+    dense = np.array(scope.find_var(lookup_table_var_name),
+                     np.float32)
+    # fail loudly: a checkpointed id outside the dense table would be
+    # silently served from initializer values otherwise
+    enforce(len(ids) == 0 or int(ids.max()) < dense.shape[0],
+            "checkpointed table rows reach id %d but %r declares only "
+            "%d rows — enlarge the inference embedding"
+            % (int(ids.max()) if len(ids) else -1,
+               lookup_table_var_name, dense.shape[0]))
+    dense[ids] = rows
+    scope.set_var(lookup_table_var_name, dense)
+    return dense
+
+
+def convert_dist_to_sparse_program(program):
+    """Clone ``program`` with every distributed lookup rewritten to a
+    LOCAL in-graph embedding lookup: the lookup's feed-side data var
+    is replaced by a real ``lookup_table`` op against the dense table
+    parameter (which load_persistables_for_inference fills). The
+    reference's version rewrites lookup_table ops to
+    lookup_sparse_table (lookup_table_utils.py:59); the TPU analog
+    re-attaches the lookup to the graph so XLA sees one gather."""
+    lookups = _dist_lookups(program)
+    out = program.clone()
+    blk = out.global_block()
+    for lk in lookups:
+        # the distributed path made `out` a feed var; re-derive it
+        # from ids via an in-graph lookup on the dense table param.
+        # prepend: ids is a feed var and the table a parameter, both
+        # live before any consumer of `out` runs
+        if not blk.has_var(lk["table"]):
+            blk.create_parameter(name=lk["table"],
+                                 shape=(lk["rows"], lk["dim"]),
+                                 dtype="float32")
+        blk.prepend_op(
+            type="lookup_table",
+            inputs={"W": [lk["table"]], "Ids": [lk["ids"]]},
+            outputs={"Out": [lk["out"]]},
+            attrs={"is_sparse": False, "is_distributed": False,
+                   "padding_idx": -1})
+        # the op now produces lk["out"]; it is no longer fed
+        v = blk.var(lk["out"])
+        v.is_data = False
+    out._distributed_lookups = []
+    return out

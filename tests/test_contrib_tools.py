@@ -1,0 +1,359 @@
+"""Contrib facades and tools (reference:
+python/paddle/fluid/contrib/{model_stat,op_frequence,
+memory_usage_calc,trainer,inferencer}.py + contrib/utils/ + the NAS
+search space)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _small_cnn_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 8, 8])
+        label = layers.data("label", shape=[1], dtype="int64")
+        x = layers.conv2d(img, num_filters=4, filter_size=3,
+                          padding=1)
+        x = layers.batch_norm(x, act="relu")
+        x = layers.pool2d(x, pool_size=2, pool_stride=2)
+        pred = layers.fc(x, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+    return main, startup, loss
+
+
+class TestModelStat:
+    def test_summary_counts(self, capsys):
+        from paddle_tpu.contrib.model_stat import summary
+
+        main, _s, _l = _small_cnn_program()
+        rows, params, flops = summary(main)
+        out = capsys.readouterr().out
+        assert "Total PARAMs" in out and "conv2d" in out
+        conv = [r for r in rows if r["type"] == "conv2d"][0]
+        # 4 filters x (3*3*3 kernel) [no bias input slot on the op]
+        assert conv["PARAMs"] in (108, 112)
+        assert conv["FLOPs"] == 2 * 8 * 8 * 4 * 27
+        mul = [r for r in rows if r["type"] == "mul"][0]
+        assert mul["PARAMs"] == 4 * 4 * 4 * 10
+        assert params == sum(r["PARAMs"] for r in rows)
+        assert flops > 0
+
+
+class TestOpFrequence:
+    def test_frequency_and_pairs(self):
+        from paddle_tpu.contrib import op_freq_statistic
+
+        main, _s, _l = _small_cnn_program()
+        uni, adj = op_freq_statistic(main)
+        uni_d = dict(uni)
+        assert uni_d["conv2d"] == 1
+        assert uni_d["mul"] >= 1
+        assert uni[0][1] >= uni[-1][1]  # sorted descending
+        assert any("->" in k for k, _v in adj)
+
+    def test_type_error(self):
+        from paddle_tpu.contrib import op_freq_statistic
+        with pytest.raises(TypeError):
+            op_freq_statistic("not a program")
+
+
+class TestMemoryUsage:
+    def test_estimate(self):
+        from paddle_tpu.contrib import memory_usage
+
+        main, _s, _l = _small_cnn_program()
+        lo, hi, unit = memory_usage(main, batch_size=32)
+        assert 0 < lo < hi
+        assert unit in ("B", "KB", "MB")
+        lo2, hi2, unit2 = memory_usage(main, batch_size=64)
+        # bigger batch, not smaller estimate (unit may coarsen)
+        assert (unit2 != unit) or lo2 > lo
+
+    def test_errors(self):
+        from paddle_tpu.contrib import memory_usage
+        with pytest.raises(TypeError):
+            memory_usage("x", 4)
+        main, _s, _l = _small_cnn_program()
+        with pytest.raises(ValueError):
+            memory_usage(main, 0)
+
+
+class TestTrainerInferencer:
+    def test_train_save_infer_roundtrip(self, tmp_path):
+        from paddle_tpu.contrib import Inferencer, Trainer
+
+        w_true = np.linspace(-0.5, 0.5, 6).astype(np.float32)
+
+        def train_func():
+            x = layers.data("x", shape=[6])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1,
+                             param_attr=fluid.ParamAttr(name="w"))
+            return layers.reduce_mean(
+                layers.square_error_cost(input=pred, label=y))
+
+        def optimizer_func():
+            return fluid.optimizer.SGD(0.2)
+
+        def reader():
+            rs = np.random.RandomState(0)
+            for _ in range(40):
+                x = rs.rand(16, 6).astype(np.float32)
+                y = x @ w_true[:, None]
+                yield list(zip(x, y))
+
+        seen = {"steps": 0, "epochs": 0, "losses": []}
+
+        def handler(event):
+            from paddle_tpu.contrib import (BeginEpochEvent,
+                                            EndStepEvent)
+            if isinstance(event, EndStepEvent):
+                seen["steps"] += 1
+                seen["losses"].append(
+                    float(np.asarray(event.metrics[0]).reshape(-1)[0]))
+            elif isinstance(event, BeginEpochEvent):
+                seen["epochs"] += 1
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            tr = Trainer(train_func=train_func,
+                         optimizer_func=optimizer_func)
+            tr.train(num_epochs=2, event_handler=handler,
+                     reader=reader, feed_order=["x", "y"])
+            assert seen["epochs"] == 2 and seen["steps"] == 80
+            assert seen["losses"][-1] < seen["losses"][0] * 0.2
+            test_metrics = tr.test(reader=reader,
+                                   feed_order=["x", "y"])
+            assert test_metrics[0] < seen["losses"][0]
+            tr.save_params(str(tmp_path / "model"))
+
+        def infer_func():
+            x = layers.data("x", shape=[6])
+            return layers.fc(x, size=1,
+                             param_attr=fluid.ParamAttr(name="w"))
+
+        inf = Inferencer(infer_func=infer_func,
+                         param_path=str(tmp_path / "model"))
+        xs = np.eye(6, dtype=np.float32)
+        (got,) = inf.infer({"x": xs})
+        # trained weights approximate w_true on the identity probe
+        assert np.abs(np.asarray(got).reshape(-1)
+                      - w_true).max() < 0.2
+
+        with pytest.raises(ValueError):
+            inf.infer([1, 2, 3])
+
+    def test_stop(self):
+        from paddle_tpu.contrib import EndStepEvent, Trainer
+
+        def train_func():
+            x = layers.data("x", shape=[2])
+            y = layers.data("y", shape=[1])
+            pred = layers.fc(x, size=1)
+            return layers.reduce_mean(
+                layers.square_error_cost(input=pred, label=y))
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            tr = Trainer(train_func=train_func,
+                         optimizer_func=lambda: fluid.optimizer.SGD(
+                             0.1))
+            count = {"n": 0}
+
+            def handler(event):
+                if isinstance(event, EndStepEvent):
+                    count["n"] += 1
+                    tr.stop()
+
+            def reader():
+                for _ in range(100):
+                    yield [(np.zeros(2, np.float32),
+                            np.zeros(1, np.float32))] * 4
+
+            tr.train(2, handler, reader=reader, feed_order=["x", "y"])
+            assert count["n"] == 1
+
+
+class TestHDFSUtils:
+    def _client(self, fs):
+        """HDFSClient against an in-memory fake 'hadoop fs'."""
+        from paddle_tpu.contrib.utils import HDFSClient
+
+        def runner(cmd):
+            i = cmd.index("fs") + 1
+            args = [a for a in cmd[i:] if not a.startswith("-D")]
+            op = args[0]
+            if op == "-test":
+                flag, path = args[1], args[2]
+                if flag == "-e":
+                    return (0 if path in fs or any(
+                        k.startswith(path + "/") for k in fs) else 1,
+                        [])
+                return (0 if any(k.startswith(path + "/")
+                                 for k in fs) else 1, [])
+            if op == "-mkdir":
+                return 0, []
+            if op == "-rm":
+                for k in [k for k in fs if k == args[-1]
+                          or k.startswith(args[-1] + "/")]:
+                    del fs[k]
+                return 0, []
+            if op == "-mv":
+                fs[args[2]] = fs.pop(args[1])
+                return 0, []
+            if op == "-put":
+                with open(args[1]) as f:
+                    fs[args[2]] = f.read()
+                return 0, []
+            if op == "-get":
+                if args[1] not in fs:
+                    return 1, ["get: no such file"]
+                with open(args[2], "w") as f:
+                    f.write(fs[args[1]])
+                return 0, []
+            if op == "-ls":
+                rec = args[1] == "-R"
+                path = args[-1]
+                rows = ["-rw-r--r-- 1 u g 1 2026-01-01 00:00 %s" % k
+                        for k in sorted(fs)
+                        if k.startswith(path + "/") or k == path]
+                del rec
+                return 0, rows
+            return 1, ["unknown op %s" % op]
+
+        return HDFSClient("/opt/hadoop", {"fs.default.name": "x",
+                                          "hadoop.job.ugi": "u,p"},
+                          runner=runner)
+
+    def test_roundtrip(self, tmp_path):
+        fs = {}
+        client = self._client(fs)
+        local = tmp_path / "a.txt"
+        local.write_text("hello")
+        assert client.upload("/data/a.txt", str(local))
+        assert client.is_exist("/data/a.txt")
+        assert client.is_dir("/data")
+        assert client.is_file("/data/a.txt")
+        assert client.ls("/data") == ["/data/a.txt"]
+        dst = tmp_path / "b.txt"
+        assert client.download("/data/a.txt", str(dst))
+        assert dst.read_text() == "hello"
+        assert client.rename("/data/a.txt", "/data/c.txt")
+        assert not client.is_exist("/data/a.txt")
+        assert client.delete("/data/c.txt")
+        assert not client.is_exist("/data/c.txt")
+
+    def test_multi_transfer(self, tmp_path):
+        from paddle_tpu.contrib.utils import (multi_download,
+                                              multi_upload)
+        fs = {}
+        client = self._client(fs)
+        src = tmp_path / "src"
+        src.mkdir()
+        for i in range(5):
+            (src / ("f%d.txt" % i)).write_text("c%d" % i)
+        assert multi_upload(client, "/up", str(src),
+                            multi_processes=2) == 5
+        assert len(fs) == 5
+        out = tmp_path / "out"
+        files = multi_download(client, "/up", str(out), trainer_id=0,
+                               trainers=1, multi_processes=2)
+        assert len(files) == 5
+        # sharded download: two trainers split the files
+        files0 = multi_download(client, "/up",
+                                str(tmp_path / "o0"), 0, 2, 1)
+        files1 = multi_download(client, "/up",
+                                str(tmp_path / "o1"), 1, 2, 1)
+        assert len(files0) + len(files1) == 5
+
+
+class TestLookupTableUtils:
+    def test_save_load_increment_and_inference(self, tmp_path):
+        from paddle_tpu.contrib.utils import (
+            convert_dist_to_sparse_program,
+            load_persistables_for_increment,
+            load_persistables_for_inference, save_lookup_table)
+        from paddle_tpu.distributed.lookup_service import LargeScaleKV
+
+        table = LargeScaleKV(dim=4, seed=3, optimizer="adagrad",
+                             lr=0.05, init_std=0.2)
+        rows = table.pull([2, 7, 11])
+        table.push([2], np.ones((1, 4), np.float32))  # adagrad state
+        rows = table.pull([2, 7, 11])
+        save_lookup_table(table, str(tmp_path))
+
+        # a program with a distributed lookup
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", shape=[3], dtype="int64")
+            emb = layers.embedding(ids, size=(16, 4),
+                                   is_distributed=True,
+                                   name="big_table")
+            out = layers.reduce_sum(emb)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            t2 = load_persistables_for_increment(str(tmp_path), exe,
+                                                 main)
+            np.testing.assert_allclose(t2.pull([2, 7, 11]), rows,
+                                       rtol=1e-6)
+            # resume fidelity: hyperparams, lazy-init seed, and the
+            # adagrad accumulator survive the checkpoint
+            assert (t2.optimizer, t2.seed, t2.lr, t2.init_std) == \
+                ("adagrad", 3, 0.05, 0.2)
+            np.testing.assert_allclose(t2._accum[2],
+                                       table._accum[2], rtol=1e-6)
+            # untouched ids lazily init identically after resume
+            np.testing.assert_allclose(t2.pull([99]), table.pull([99]),
+                                       rtol=1e-6)
+
+            # inference: rewrite to an in-graph lookup + materialize
+            infer = convert_dist_to_sparse_program(main)
+            exe.run(fluid.Program())  # no-op warm
+            # create + init the dense table param in the scope
+            blk = infer.global_block()
+            assert blk.has_var("big_table")
+            scope.set_var("big_table",
+                          np.zeros((16, 4), np.float32))
+            load_persistables_for_inference(str(tmp_path), exe, infer,
+                                            "big_table")
+            dense = np.asarray(scope.find_var("big_table"))
+            np.testing.assert_allclose(dense[[2, 7, 11]], rows,
+                                       rtol=1e-6)
+            feed = {"ids": np.array([[2, 7, 11]], np.int64)}
+            (val,) = exe.run(infer, feed=feed, fetch_list=[out])
+            np.testing.assert_allclose(float(np.asarray(val)),
+                                       rows.sum(), rtol=1e-5)
+
+
+class TestSimpleConvSpace:
+    def test_space_contract_and_net(self):
+        from paddle_tpu.contrib.slim.nas import SimpleConvSpace
+
+        sp = SimpleConvSpace(num_classes=4, image_shape=(3, 16, 16))
+        toks = sp.init_tokens()
+        rng = sp.range_table()
+        assert len(toks) == len(rng) == 10
+        assert all(0 <= t < r for t, r in zip(toks, rng))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, loss, acc, feeds = sp.create_net(toks)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            feed = {"img": rs.rand(4, 3, 16, 16).astype(np.float32),
+                    "label": rs.randint(0, 4, (4, 1)).astype(np.int64)}
+            lv, av = exe.run(main, feed=feed, fetch_list=[loss, acc])
+            assert np.isfinite(float(np.asarray(lv)))
+            assert 0.0 <= float(np.asarray(av)) <= 1.0
+        # a different architecture builds too
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            alt = [t for t in toks]
+            alt[0] = (alt[0] + 1) % rng[0]
+            main2 = sp.create_net(alt)[0]
+            assert main2.global_block().ops

@@ -37,8 +37,11 @@ PUBLIC_MODULES = [
     "paddle_tpu.dygraph",
     "paddle_tpu.parallel",
     "paddle_tpu.transpiler",
+    "paddle_tpu.contrib",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.slim.nas",
     "paddle_tpu.contrib.slim.quantization",
+    "paddle_tpu.contrib.utils",
     "paddle_tpu.recordio",
     "paddle_tpu.dataset_factory",
     "paddle_tpu.incubate.data_generator",
