@@ -43,7 +43,16 @@ class AnalysisConfig:
         self._ir_optim = True
         self._memory_optim = True   # XLA-owned; parity switch
         self._use_tpu = True
-        self._passes = ["conv_bn_fuse_pass", "fc_fuse_pass",
+        # ordered: conv_bn leaves conv+add, which conv_elementwise_add
+        # then folds. fc_fuse runs before fc_lstm but cannot capture
+        # the lstm input projection (it requires a bias add; the lstm
+        # builder emits a bias-free mul, which fc_lstm matches
+        # directly)
+        self._passes = ["conv_bn_fuse_pass",
+                        "conv_elementwise_add_fuse_pass",
+                        "fc_fuse_pass", "fc_lstm_fuse_pass",
+                        "seqpool_concat_fuse_pass",
+                        "transpose_flatten_concat_fuse_pass",
                         "fuse_elewise_add_act_pass"]
         self._profile = False
 

@@ -249,3 +249,272 @@ class GraphVizPass(Pass):
         with open(path, "w") as f:
             f.write("\n".join(lines))
         return graph
+
+
+@register_pass
+class ConvElementwiseAddFusePass(Pass):
+    """conv2d → elementwise_add(persistable bias)  ⇒  conv2d_fusion
+    (reference: ir/conv_elementwise_add_fuse_pass.cc). Composes with
+    conv_bn_fuse_pass, whose output is exactly this pattern."""
+
+    name = "conv_elementwise_add_fuse_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        det = GraphPatternDetector()
+        det.node(PDNode.op("conv", ("conv2d", "depthwise_conv2d")))
+        det.node(PDNode.var("conv_out", intermediate=True))
+        det.node(PDNode.op("add", "elementwise_add"))
+        det.node(PDNode.var("out"))
+        det.link("conv", "conv_out").link("conv_out", "add")
+        det.link("add", "out")
+        count = 0
+
+        def rewrite(m, g):
+            nonlocal count
+            conv_op, add_op = m["conv"].op, m["add"].op
+            conv_out_name = conv_op.output("Output")[0]
+            bias_name = next(n for n in add_op.input_arg_names
+                             if n != conv_out_name)
+            bias_nodes = [n for n in m["add"].inputs
+                          if n.name == bias_name]
+            if not bias_nodes or not bias_nodes[0].persistable:
+                return
+            # conv2d_fusion re-applies the bias PER CHANNEL, so the
+            # add must be exactly a per-channel [C_out] broadcast:
+            # axis 1 for NCHW (the conv builders emit this), and a
+            # 1-D bias of length C_out. An axis=-1 trailing broadcast
+            # over W would silently change numerics.
+            data_format = conv_op.attrs.get("data_format", "NCHW")
+            want_axis = 1 if data_format == "NCHW" else -1
+            if add_op.attrs.get("axis", -1) != want_axis:
+                return
+            blk = g.program.block(g.block_idx)
+            bvar = blk._find_var_recursive(bias_name)
+            wvar = blk._find_var_recursive(conv_op.input("Filter")[0])
+            if bvar is None or wvar is None or not bvar.shape                     or not wvar.shape:
+                return
+            c_out = wvar.shape[0]
+            if tuple(bvar.shape) != (c_out,):
+                return
+            x_name = conv_op.input("Input")[0]
+            w_name = conv_op.input("Filter")[0]
+            xn = next(n for n in m["conv"].inputs if n.name == x_name)
+            wn = next(n for n in m["conv"].inputs if n.name == w_name)
+            attrs = {k: v for k, v in conv_op.attrs.items()
+                     if k not in _HOUSEKEEPING_ATTRS}
+            if conv_op.type == "depthwise_conv2d"                     and not attrs.get("groups"):
+                # depthwise defaults groups to C_in at run time; the
+                # fused op lowers through plain conv2d, so pin it
+                xvar = blk._find_var_recursive(x_name)
+                if xvar is None or not xvar.shape:
+                    return
+                attrs["groups"] = xvar.shape[
+                    1 if data_format == "NCHW" else -1]
+            attrs["activation"] = ""
+            g.create_op_node(
+                "conv2d_fusion",
+                {"Input": [xn], "Filter": [wn],
+                 "Bias": [bias_nodes[0]]},
+                {"Output": [m["out"]]}, attrs)
+            g.remove_nodes([m["conv"], m["conv_out"], m["add"]])
+            count += 1
+
+        det.apply(graph, rewrite)
+        self.set("fused_count", count)
+        return graph
+
+
+def _producer(var_node, op_type):
+    """The single producing op of ``var_node`` if it has the given
+    type and this is its only consumer-visible use."""
+    if len(var_node.inputs) != 1:
+        return None
+    op = var_node.inputs[0]
+    if not op.is_op() or op.op.type != op_type:
+        return None
+    if len(var_node.outputs) != 1:
+        return None
+    return op
+
+
+@register_pass
+class TransposeFlattenConcatFusePass(Pass):
+    """N x (transpose2 → flatten2) → concat  ⇒
+    fusion_transpose_flatten_concat (reference:
+    ir/transpose_flatten_concat_fuse_pass.cc — the SSD detection-head
+    reshaping). All branches must share trans/flatten axes."""
+
+    name = "transpose_flatten_concat_fuse_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        count = 0
+        for node in list(graph.nodes):
+            if not node.is_op() or node.op.type != "concat":
+                continue
+            branches = []
+            for cin in node.inputs:
+                fl = _producer(cin, "flatten2")
+                if fl is None:
+                    branches = None
+                    break
+                fin = fl.inputs[0]
+                tr = _producer(fin, "transpose2")
+                if tr is None:
+                    branches = None
+                    break
+                branches.append((tr, fin, fl, cin))
+            if not branches:
+                continue
+            trans_axis = branches[0][0].op.attrs.get("axis")
+            flatten_axis = branches[0][2].op.attrs.get("axis", 1)
+            if any(b[0].op.attrs.get("axis") != trans_axis
+                   or b[2].op.attrs.get("axis", 1) != flatten_axis
+                   for b in branches):
+                continue
+            xs = [b[0].inputs[0] for b in branches]
+            out = node.outputs[0]
+            graph.create_op_node(
+                "fusion_transpose_flatten_concat",
+                {"X": xs}, {"Out": [out]},
+                {"trans_axis": tuple(trans_axis),
+                 "flatten_axis": flatten_axis,
+                 "concat_axis": node.op.attrs.get("axis", 0)})
+            dead = [node]
+            for tr, fin, fl, cin in branches:
+                dead += [tr, fin, fl, cin]
+            graph.remove_nodes(dead)
+            count += 1
+        self.set("fused_count", count)
+        return graph
+
+
+@register_pass
+class SeqPoolConcatFusePass(Pass):
+    """N x sequence_pool → concat  ⇒  fusion_seqpool_concat
+    (reference: ir/seqpool_concat_fuse_pass.cc — CTR slot pooling).
+    All pools must share pool_type."""
+
+    name = "seqpool_concat_fuse_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        count = 0
+        for node in list(graph.nodes):
+            if not node.is_op() or node.op.type != "concat":
+                continue
+            if node.op.attrs.get("axis", 0) != 1:
+                continue
+            pools = []
+            for cin in node.inputs:
+                sp = _producer(cin, "sequence_pool")
+                if sp is None:
+                    pools = None
+                    break
+                pools.append((sp, cin))
+            if not pools:
+                continue
+            ptype = pools[0][0].op.attrs.get("pool_type", "average")
+            if any(p[0].op.attrs.get("pool_type", "average") != ptype
+                   or p[0].op.attrs.get("pad_value", 0.0) != 0.0
+                   for p in pools):
+                continue
+            xs, lens = [], []
+            ok = True
+            for sp, _cin in pools:
+                x_name = sp.op.input("X")[0]
+                xs.append(next(n for n in sp.inputs
+                               if n.name == x_name))
+                ln_names = sp.op.inputs.get("SeqLen", [])
+                if ln_names:
+                    lens.append(next(n for n in sp.inputs
+                                     if n.name == ln_names[0]))
+                elif lens:
+                    ok = False  # mixed with/without lengths
+                    break
+            if not ok or (lens and len(lens) != len(xs)):
+                continue
+            out = node.outputs[0]
+            inputs = {"X": xs}
+            if lens:
+                inputs["SeqLen"] = lens
+            graph.create_op_node(
+                "fusion_seqpool_concat", inputs, {"Out": [out]},
+                {"pooltype": ptype.upper(), "axis": 1})
+            dead = [node] + [p[0] for p in pools] + \
+                [p[1] for p in pools]
+            graph.remove_nodes(dead)
+            count += 1
+        self.set("fused_count", count)
+        return graph
+
+
+@register_pass
+class FCLSTMFusePass(Pass):
+    """mul(x, Wx) → lstm  ⇒  fusion_lstm (reference:
+    ir/fc_lstm_fuse_pass.cc + operators/fused/fusion_lstm_op.cc: the
+    input projection rides inside the scan op). The layers.lstm /
+    dynamic_lstm builders emit exactly this mul+lstm shape."""
+
+    name = "fc_lstm_fuse_pass"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        count = 0
+        for node in list(graph.nodes):
+            if not node.is_op() or node.op.type != "lstm":
+                continue
+            lstm_op = node.op
+            in_name = lstm_op.input("Input")[0]
+            proj = next((v for v in node.inputs
+                         if v.name == in_name), None)
+            if proj is None:
+                continue
+            mul = _producer(proj, "mul")
+            if mul is None:
+                continue
+            if mul.op.attrs.get("y_num_col_dims", 1) != 1:
+                continue
+            x_name = mul.op.input("X")[0]
+            wx_name = mul.op.input("Y")[0]
+            xn = next(n for n in mul.inputs if n.name == x_name)
+            wxn = next(n for n in mul.inputs if n.name == wx_name)
+
+            def in_node(slot):
+                names = lstm_op.inputs.get(slot, [])
+                if not names:
+                    return None
+                return next(n for n in node.inputs
+                            if n.name == names[0])
+
+            wh = in_node("Weight")
+            bias = in_node("Bias")
+            outs = {s: [next(n for n in node.outputs
+                             if n.name == lstm_op.output(s)[0])]
+                    for s in ("Hidden", "Cell")}
+            # LastH/LastC consumers block the fusion (fusion_lstm has
+            # no last-state outputs, reference fusion_lstm_op.cc)
+            last_used = False
+            for s in ("LastH", "LastC"):
+                names = lstm_op.outputs.get(s, [])
+                for n in node.outputs:
+                    if n.name in names and n.outputs:
+                        last_used = True
+            if last_used:
+                continue
+            inputs = {"X": [xn], "WeightX": [wxn], "WeightH": [wh]}
+            if bias is not None:
+                inputs["Bias"] = [bias]
+            for s in ("H0", "C0", "SeqLen"):
+                v = in_node(s)
+                if v is not None:
+                    inputs[s] = [v]
+            attrs = {k: v for k, v in lstm_op.attrs.items()
+                     if k not in _HOUSEKEEPING_ATTRS}
+            graph.create_op_node("fusion_lstm", inputs, outs, attrs)
+            dead = [mul, proj, node]
+            dead += [n for n in node.outputs
+                     if n.name in (lstm_op.outputs.get("LastH", [])
+                                   + lstm_op.outputs.get("LastC", []))
+                     and not n.outputs]
+            graph.remove_nodes(dead)
+            count += 1
+        self.set("fused_count", count)
+        return graph

@@ -96,3 +96,83 @@ def fused_linear_xent(x, w, label, *, epsilon=0.0):
         loss = loss - (epsilon / V) * jnp.sum(logits, axis=-1,
                                               keepdims=True)
     return loss
+
+
+@register("conv2d_fusion", ["Input", "Filter", "Bias", "ResidualData"],
+          ["Output"])
+def conv2d_fusion(x, w, bias, residual, *, strides=(1, 1),
+                  paddings=(0, 0), dilations=(1, 1), groups=1,
+                  data_format="NCHW", activation=""):
+    """conv + bias (+ residual) (+ activation) in one op — what
+    conv_elementwise_add_fuse_pass emits (reference:
+    operators/fused/conv_fusion_op.cc; ir/
+    conv_elementwise_add_fuse_pass.cc). XLA fuses the epilogue into
+    the convolution either way; the op exists for program
+    compactness."""
+    from .nn_ops import conv2d as _conv2d
+
+    out = _conv2d(x, w, strides=strides, paddings=paddings,
+                  dilations=dilations, groups=groups,
+                  data_format=data_format)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else \
+            [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    if residual is not None:
+        out = out + residual
+    return _UNARY[activation](out)
+
+
+@register("fusion_transpose_flatten_concat", ["X*"], ["Out"])
+def fusion_transpose_flatten_concat(xs, *, trans_axis, flatten_axis,
+                                    concat_axis):
+    """transpose each input by ``trans_axis``, flatten from
+    ``flatten_axis``, concat (reference: operators/fused/
+    fusion_transpose_flatten_concat_op.cc — the SSD-head pattern
+    ir/transpose_flatten_concat_fuse_pass.cc targets)."""
+    from .tensor_ops import flatten as _flatten
+
+    outs = [_flatten(jnp.transpose(x, trans_axis), axis=flatten_axis)
+            for x in xs]
+    return jnp.concatenate(outs, axis=concat_axis)
+
+
+@register("fusion_seqpool_concat", ["X*", "SeqLen*"], ["Out"],
+          nondiff=("SeqLen",))
+def fusion_seqpool_concat(xs, seq_lens, *, pooltype="SUM", axis=1):
+    """sequence_pool each input then concat (reference:
+    operators/fused/fusion_seqpool_concat_op.cc, emitted by
+    ir/seqpool_concat_fuse_pass.cc — the CTR-model slot-pool
+    pattern)."""
+    from .sequence_ops import sequence_pool as _sp
+
+    pool = {"SUM": "sum", "AVERAGE": "average", "SQRT": "sqrt",
+            "MAX": "max", "LAST": "last", "FIRST": "first"}[
+        pooltype.upper()]
+    if not seq_lens:
+        seq_lens = [None] * len(xs)
+    outs = [_sp(x, ln, pool_type=pool)
+            for x, ln in zip(xs, seq_lens)]
+    return jnp.concatenate(outs, axis=axis)
+
+
+@register("fusion_lstm",
+          ["X", "WeightX", "WeightH", "Bias", "H0", "C0", "SeqLen"],
+          ["Hidden", "Cell"], nondiff=("SeqLen",))
+def fusion_lstm(x, wx, wh, bias, h0, c0, seq_len, *,
+                use_peepholes=False, is_reverse=False,
+                gate_activation="sigmoid", cell_activation="tanh",
+                candidate_activation="tanh"):
+    """Input projection + LSTM scan in ONE op (reference:
+    operators/fused/fusion_lstm_op.cc, emitted by
+    ir/fc_lstm_fuse_pass.cc). x [B, T, D], wx [D, 4H], wh [H, 4H];
+    bias carries the gate bias [1, 4H(+3H peepholes)]."""
+    from .rnn_ops import lstm as _lstm
+
+    proj = jnp.einsum("btd,dh->bth", x, wx)
+    return _lstm(proj, h0, c0, wh, bias, seq_len,
+                 use_peepholes=use_peepholes,
+                 is_reverse=is_reverse,
+                 gate_activation=gate_activation,
+                 cell_activation=cell_activation,
+                 candidate_activation=candidate_activation)[:2]

@@ -2007,6 +2007,69 @@ spec("fake_quantize_dequantize_moving_average_abs_max",
      {"bit_length": 8, "moving_rate": 0.9}, ref=_fqma_ref, grad=[])
 
 
+
+
+def _c2df_ref(ins):
+    import torch
+    import torch.nn.functional as F
+    out = F.conv2d(torch.from_numpy(ins["Input"]),
+                   torch.from_numpy(ins["Filter"]),
+                   torch.from_numpy(ins["Bias"]).reshape(-1))
+    return [out.numpy()]
+
+
+spec("conv2d_fusion",
+     {"Input": sgn((1, 2, 5, 5), 950), "Filter": sgn((3, 2, 3, 3), 951),
+      "Bias": sgn((3,), 952)},
+     {"strides": (1, 1), "paddings": (0, 0), "activation": ""},
+     ref=_c2df_ref, max_rel=0.01)
+
+
+def _tfc_ref(ins):
+    outs = []
+    for x in ins["X"]:
+        t = np.transpose(x, (0, 2, 3, 1))
+        outs.append(t.reshape(t.shape[0], -1))
+    return [np.concatenate(outs, axis=1)]
+
+
+spec("fusion_transpose_flatten_concat",
+     {"X": [sgn((2, 3, 2, 2), 953), sgn((2, 3, 4, 4), 954)]},
+     {"trans_axis": (0, 2, 3, 1), "flatten_axis": 1,
+      "concat_axis": 1},
+     ref=_tfc_ref)
+
+
+def _spc_ref(ins):
+    outs = []
+    for x, ln in zip(ins["X"], ins["SeqLen"]):
+        m = np.zeros_like(x)
+        for b_, n_ in enumerate(ln):
+            m[b_, :int(n_)] = x[b_, :int(n_)]
+        outs.append(m.sum(axis=1))
+    return [np.concatenate(outs, axis=1)]
+
+
+spec("fusion_seqpool_concat",
+     {"X": [u((2, 3, 4), 955), u((2, 3, 2), 956)],
+      "SeqLen": [np.array([3, 1], np.int64),
+                 np.array([2, 3], np.int64)]},
+     {"pooltype": "SUM", "axis": 1},
+     ref=_spc_ref)
+
+
+def _fusion_lstm_ref(ins):
+    proj = np.einsum("btd,dh->bth", ins["X"], ins["WeightX"])
+    return _lstm_ref({"Input": proj, "Weight": ins["WeightH"],
+                      "Bias": ins["Bias"]})[:2]
+
+
+spec("fusion_lstm",
+     {"X": sgn((2, 3, 5), 957) * 0.5, "WeightX": sgn((5, 8), 958) * 0.4,
+      "WeightH": sgn((2, 8), 959) * 0.4,
+      "Bias": sgn((1, 8), 960) * 0.2},
+     {"use_peepholes": False}, ref=_fusion_lstm_ref, max_rel=0.01)
+
 EXEMPT = {
     # host callbacks
     "print": "test_misc_parity.py (host callback, pass-through)",
