@@ -2,15 +2,25 @@
 
 Reference: python/paddle/dataset/flowers.py — train()/test()/valid()
 yield (CHW float32 image pushed through simple_transform, int64
-label in [0, 102)). Synthetic fallback: class-conditional color blobs
-run through the SAME image.py transform pipeline so the full
-preprocessing path is exercised.
+label in [0, 102)).
+
+Real data under ``DATA_HOME/flowers/``: ``102flowers.tgz``
+(jpg/image_%05d.jpg), ``imagelabels.mat`` and ``setid.mat`` — parsed
+the reference way (flowers.py:108-120: setid's tstid drives train and
+trnid drives test, the reference's deliberate swap; labels are 1-based
+in the .mat and 0-based here to match the synthetic contract).
+Synthetic fallback: class-conditional color blobs run through the
+SAME image.py transform pipeline so the full preprocessing path is
+exercised.
 """
 
 from __future__ import annotations
 
+import tarfile
+
 import numpy as np
 
+from . import common
 from . import image as img_util
 
 __all__ = ["train", "test", "valid"]
@@ -19,6 +29,14 @@ N_CLASSES = 102
 TRAIN_SIZE = 1024
 TEST_SIZE = 256
 VALID_SIZE = 256
+
+_DATA = "102flowers.tgz"
+_LABELS = "imagelabels.mat"
+_SETID = "setid.mat"
+# the reference swaps train/test on purpose (flowers.py:55-60)
+TRAIN_FLAG = "tstid"
+TEST_FLAG = "trnid"
+VALID_FLAG = "valid"
 
 
 def _raw(idx):
@@ -47,13 +65,61 @@ def _creator(n, base, is_train, mapper=None):
     return reader
 
 
+def _have_real():
+    return all(common.have_file("flowers", f)
+               for f in (_DATA, _LABELS, _SETID))
+
+
+def _real_creator(flag, is_train, mapper=None):
+    def reader():
+        import io as _io
+
+        import scipy.io as scio
+        from PIL import Image
+
+        labels = scio.loadmat(
+            common.data_path("flowers", _LABELS))["labels"][0]
+        indexes = scio.loadmat(
+            common.data_path("flowers", _SETID))[flag][0]
+        wanted = {"jpg/image_%05d.jpg" % i: int(i) for i in indexes}
+        # ONE sequential pass over the gzip tar: random-access
+        # extractfile in setid order would rewind and re-decompress
+        # the ~330MB stream on every backward seek. Samples therefore
+        # come out in archive order (the reference shuffles its batch
+        # files anyway, flowers.py:121).
+        with tarfile.open(common.data_path("flowers", _DATA)) as tf:
+            member = tf.next()
+            while member is not None:
+                i = wanted.get(member.name)
+                if i is not None:
+                    blob = tf.extractfile(member).read()
+                    raw = np.asarray(Image.open(_io.BytesIO(blob))
+                                     .convert("RGB"), np.uint8)
+                    rng = np.random.RandomState(i)
+                    out = img_util.simple_transform(
+                        raw, 256, 224, is_train,
+                        mean=[104.0, 117.0, 124.0], rng=rng)
+                    if mapper is not None:
+                        out = mapper(out)
+                    yield out, np.int64(int(labels[i - 1]) - 1)
+                member = tf.next()
+
+    return reader
+
+
 def train(mapper=None, buffered_size=1024, use_xmap=False):
+    if _have_real():
+        return _real_creator(TRAIN_FLAG, True, mapper)
     return _creator(TRAIN_SIZE, 0, True, mapper)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False):
+    if _have_real():
+        return _real_creator(TEST_FLAG, False, mapper)
     return _creator(TEST_SIZE, 13_000_000, False, mapper)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    if _have_real():
+        return _real_creator(VALID_FLAG, False, mapper)
     return _creator(VALID_SIZE, 14_000_000, False, mapper)

@@ -2,25 +2,95 @@
 
 Reference: python/paddle/dataset/sentiment.py (NLTK movie_reviews:
 get_word_dict():64 sorted by frequency, train()/test() yield
-(word-id list, 0/1 label) with a 90/10 split). Synthetic: polarity
-carried by disjoint token ranges with shared filler words.
+(word-id list, 0/1 label — neg=0) with an 80/20 split over the
+neg/pos-interleaved file order — both the synthetic and real paths
+use the same 80/20 convention).
+
+Real data: drop the NLTK corpus at
+``DATA_HOME/corpora/movie_reviews/{neg,pos}/*.txt`` (the layout
+``nltk.download('movie_reviews')`` produces) and the plain-text
+reviews are tokenized and id-mapped (reference sentiment.py:56-106).
+Synthetic fallback: polarity carried by disjoint token ranges with
+shared filler words.
 """
 
 from __future__ import annotations
 
+import glob
+import os
+import re
+
 import numpy as np
+
+from . import common
 
 __all__ = ["get_word_dict", "train", "test"]
 
 _VOCAB = 1000
 _N_DOCS = 1024
-NUM_TRAINING_INSTANCES = int(_N_DOCS * 0.9)
+NUM_TRAINING_INSTANCES = int(_N_DOCS * 0.8)
 NUM_TOTAL_INSTANCES = _N_DOCS
+
+_CORPUS_DIR = os.path.join("corpora", "movie_reviews")
+_TOKEN_RE = re.compile(r"\w+|[^\w\s]")
+
+
+def _corpus_root():
+    return os.path.join(common.DATA_HOME, _CORPUS_DIR)
+
+
+def _have_real():
+    root = _corpus_root()
+    return (os.path.isdir(os.path.join(root, "neg"))
+            and os.path.isdir(os.path.join(root, "pos")))
+
+
+def _files(category):
+    return sorted(glob.glob(os.path.join(_corpus_root(), category,
+                                         "*.txt")))
+
+
+def _tokens(path):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return [t.lower() for t in _TOKEN_RE.findall(f.read())]
+
+
+def _interleaved_files():
+    """neg/pos alternating, as the reference's sort_files()
+    (sentiment.py:77) cross-reads the classes; unpaired leftovers of
+    the larger class follow at the end (zip-truncation would silently
+    drop documents)."""
+    neg, pos = _files("neg"), _files("pos")
+    out = []
+    for n_, p_ in zip(neg, pos):
+        out.append((n_, 0))
+        out.append((p_, 1))
+    k = min(len(neg), len(pos))
+    out += [(f, 0) for f in neg[k:]] + [(f, 1) for f in pos[k:]]
+    return out
+
+
+_DICT_CACHE = {}  # corpus root -> word dict
 
 
 def get_word_dict():
-    """word -> id, most frequent first (reference: sentiment.py:64)."""
-    return {"w%d" % i: i for i in range(_VOCAB)}
+    """word -> id, most frequent first (reference: sentiment.py:56).
+    Cached per corpus root: rebuilding means re-tokenizing the whole
+    corpus."""
+    if not _have_real():
+        return {"w%d" % i: i for i in range(_VOCAB)}
+    root = _corpus_root()
+    cached = _DICT_CACHE.get(root)
+    if cached is not None:
+        return cached
+    freq = {}
+    for path, _lbl in _interleaved_files():
+        for w in _tokens(path):
+            freq[w] = freq.get(w, 0) + 1
+    words = sorted(freq, key=lambda w: (-freq[w], w))
+    out = {w: i for i, w in enumerate(words)}
+    _DICT_CACHE[root] = out
+    return out
 
 
 def _doc(idx):
@@ -44,9 +114,27 @@ def _creator(lo, hi):
     return reader
 
 
+def _real_creator(take_train):
+    def reader():
+        word_ids = get_word_dict()
+        docs = _interleaved_files()
+        split = int(len(docs) * 0.8)  # reference: 1600 of 2000
+        part = docs[:split] if take_train else docs[split:]
+        for path, label in part:
+            ids = [word_ids[w] for w in _tokens(path)
+                   if w in word_ids]
+            yield ids, np.int64(label)
+
+    return reader
+
+
 def train():
+    if _have_real():
+        return _real_creator(take_train=True)
     return _creator(0, NUM_TRAINING_INSTANCES)
 
 
 def test():
+    if _have_real():
+        return _real_creator(take_train=False)
     return _creator(NUM_TRAINING_INSTANCES, NUM_TOTAL_INSTANCES)

@@ -1,15 +1,27 @@
 """PASCAL VOC2012 segmentation reader creators.
 
 Reference: python/paddle/dataset/voc2012.py — train()/test()/val()
-yield (CHW float32 image, HW int32 segmentation label map with the
-21 VOC classes + 255 ignore border). Synthetic fallback: rectangles
-of a class painted on background with an ignore ring, exercising
-the same shapes the segmentation models consume.
+iterate the Segmentation image sets (train()=trainval, test()=train,
+val()=val — the reference's own mapping); samples are (CHW float32
+image, HW int32 segmentation label map with the 21 VOC classes + 255
+ignore border).
+
+Real data: drop ``VOCtrainval_11-May-2012.tar`` under
+``DATA_HOME/voc2012/`` — JPEGImages/*.jpg decode to the CHW contract
+and SegmentationClass/*.png palette indices become the label map
+(reference voc2012.py:44-66). Synthetic fallback: rectangles of a
+class painted on background with an ignore ring, exercising the same
+shapes the segmentation models consume.
 """
 
 from __future__ import annotations
 
+import io
+import tarfile
+
 import numpy as np
+
+from . import common
 
 __all__ = ["train", "test", "val"]
 
@@ -18,6 +30,11 @@ IGNORE = 255
 TRAIN_SIZE = 512
 TEST_SIZE = 128
 _H = _W = 128
+
+_ARCHIVE = "VOCtrainval_11-May-2012.tar"
+_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
 
 def _sample(idx):
@@ -44,13 +61,49 @@ def _creator(n, base):
     return reader
 
 
+def _real_creator(sub_name):
+    def reader():
+        from PIL import Image
+
+        path = common.data_path("voc2012", _ARCHIVE)
+        with tarfile.open(path) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            sets = tf.extractfile(members[_SET_FILE.format(sub_name)])
+            for line in sets:
+                name = line.decode().strip()
+                if not name:
+                    continue
+                data = tf.extractfile(
+                    members[_DATA_FILE.format(name)]).read()
+                label = tf.extractfile(
+                    members[_LABEL_FILE.format(name)]).read()
+                img = np.asarray(Image.open(io.BytesIO(data))
+                                 .convert("RGB"), np.float32)
+                # palette png: pixel values ARE the class ids (and
+                # 255 ignore) in P mode
+                seg = np.asarray(Image.open(io.BytesIO(label)),
+                                 np.int32)
+                yield img.transpose(2, 0, 1), seg
+
+    return reader
+
+
+def _pick(sub_name, n, base):
+    if common.have_file("voc2012", _ARCHIVE):
+        return _real_creator(sub_name)
+    return _creator(n, base)
+
+
 def train():
-    return _creator(TRAIN_SIZE, 0)
+    """trainval split (reference voc2012.py:70)."""
+    return _pick("trainval", TRAIN_SIZE, 0)
 
 
 def test():
-    return _creator(TEST_SIZE, 15_000_000)
+    """'train' split (reference voc2012.py:77 — its test() reads the
+    train image set)."""
+    return _pick("train", TEST_SIZE, 15_000_000)
 
 
 def val():
-    return _creator(TEST_SIZE, 16_000_000)
+    return _pick("val", TEST_SIZE, 16_000_000)

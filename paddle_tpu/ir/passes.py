@@ -291,7 +291,8 @@ class ConvElementwiseAddFusePass(Pass):
             blk = g.program.block(g.block_idx)
             bvar = blk._find_var_recursive(bias_name)
             wvar = blk._find_var_recursive(conv_op.input("Filter")[0])
-            if bvar is None or wvar is None or not bvar.shape                     or not wvar.shape:
+            if (bvar is None or wvar is None
+                    or not bvar.shape or not wvar.shape):
                 return
             c_out = wvar.shape[0]
             if tuple(bvar.shape) != (c_out,):
@@ -302,7 +303,8 @@ class ConvElementwiseAddFusePass(Pass):
             wn = next(n for n in m["conv"].inputs if n.name == w_name)
             attrs = {k: v for k, v in conv_op.attrs.items()
                      if k not in _HOUSEKEEPING_ATTRS}
-            if conv_op.type == "depthwise_conv2d"                     and not attrs.get("groups"):
+            if (conv_op.type == "depthwise_conv2d"
+                    and not attrs.get("groups")):
                 # depthwise defaults groups to C_in at run time; the
                 # fused op lowers through plain conv2d, so pin it
                 xvar = blk._find_var_recursive(x_name)

@@ -383,3 +383,116 @@ def test_synthetic_fallback_when_absent(data_home):
     assert "<unk>" in w
     assert len(list(wmt14.test(30)())) == wmt14.TEST_SIZE
     assert movielens.max_movie_id() == 400
+
+
+# --- voc2012 ---------------------------------------------------------------
+
+def _write_voc(d):
+    import io as _io
+    from PIL import Image
+    with tarfile.open(d / "VOCtrainval_11-May-2012.tar", "w") as tf:
+        def add(name, blob):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, _io.BytesIO(blob))
+
+        base = "VOCdevkit/VOC2012"
+        add(base + "/ImageSets/Segmentation/trainval.txt",
+            b"img1\nimg2\n")
+        add(base + "/ImageSets/Segmentation/train.txt", b"img1\n")
+        add(base + "/ImageSets/Segmentation/val.txt", b"img2\n")
+        rng = np.random.RandomState(0)
+        for name in ("img1", "img2"):
+            buf = _io.BytesIO()
+            Image.fromarray(rng.randint(
+                0, 255, (6, 5, 3), dtype=np.uint8)).save(buf, "JPEG")
+            add(base + "/JPEGImages/%s.jpg" % name, buf.getvalue())
+            seg = np.zeros((6, 5), np.uint8)
+            seg[2:4, 1:3] = 7
+            seg[0, 0] = 255
+            # grayscale PNG: index values survive save/load exactly
+            # (PIL remaps P-mode palettes on save; real VOC P-mode
+            # files decode to the same index array either way)
+            pal = Image.fromarray(seg, mode="L")
+            buf = _io.BytesIO()
+            pal.save(buf, "PNG")
+            add(base + "/SegmentationClass/%s.png" % name,
+                buf.getvalue())
+
+
+def test_voc2012_real(data_home):
+    from paddle_tpu.dataset import voc2012
+
+    d = _module_dir(data_home, "voc2012")
+    _write_voc(d)
+    samples = list(voc2012.train()())
+    assert len(samples) == 2
+    img, seg = samples[0]
+    assert img.shape == (3, 6, 5) and img.dtype == np.float32
+    assert seg.shape == (6, 5) and seg.dtype == np.int32
+    assert seg[2, 1] == 7 and seg[0, 0] == 255
+    assert len(list(voc2012.test()())) == 1
+    assert len(list(voc2012.val()())) == 1
+
+
+# --- flowers ---------------------------------------------------------------
+
+def test_flowers_real(data_home):
+    import io as _io
+
+    import scipy.io as scio
+    from PIL import Image
+    from paddle_tpu.dataset import flowers
+
+    d = _module_dir(data_home, "flowers")
+    rng = np.random.RandomState(1)
+    with tarfile.open(d / "102flowers.tgz", "w:gz") as tf:
+        for i in (1, 2, 3):
+            buf = _io.BytesIO()
+            Image.fromarray(rng.randint(
+                0, 255, (300, 280, 3), dtype=np.uint8)).save(buf,
+                                                            "JPEG")
+            blob = buf.getvalue()
+            info = tarfile.TarInfo("jpg/image_%05d.jpg" % i)
+            info.size = len(blob)
+            tf.addfile(info, _io.BytesIO(blob))
+    scio.savemat(str(d / "imagelabels.mat"),
+                 {"labels": np.array([[5, 9, 23]], np.uint8)})
+    scio.savemat(str(d / "setid.mat"),
+                 {"tstid": np.array([[1, 3]], np.int32),
+                  "trnid": np.array([[2]], np.int32),
+                  "valid": np.array([[2]], np.int32)})
+    train = list(flowers.train()())
+    assert len(train) == 2
+    img, label = train[0]
+    assert img.shape == (3, 224, 224) and img.dtype == np.float32
+    assert label == 4  # 1-based 5 -> 0-based 4
+    assert [l for _x, l in train] == [4, 22]
+    test = list(flowers.test()())
+    assert len(test) == 1 and test[0][1] == 8
+
+
+# --- sentiment -------------------------------------------------------------
+
+def test_sentiment_real(data_home):
+    from paddle_tpu.dataset import sentiment
+
+    root = data_home / "corpora" / "movie_reviews"
+    for cat, texts in [("neg", ["terrible bad film .",
+                                "bad bad plot"]),
+                       ("pos", ["great fun film !",
+                                "truly great acting"])]:
+        (root / cat).mkdir(parents=True)
+        for i, t in enumerate(texts):
+            (root / cat / ("cv%03d.txt" % i)).write_text(t)
+    wd = sentiment.get_word_dict()
+    # freq: bad=3, then film=2/great=2 tie broken alphabetically
+    assert wd["bad"] == 0
+    assert wd["film"] == 1 and wd["great"] == 2
+    train = list(sentiment.train()())
+    test = list(sentiment.test()())
+    assert len(train) == 3 and len(test) == 1  # 80/20 of 4 docs
+    ids, label = train[0]
+    assert label == 0  # interleave starts with neg
+    assert ids[0] == wd["terrible"]
+    assert all(isinstance(i, int) for i in ids)
