@@ -114,7 +114,10 @@ def _best_library(run_step, warmup, iters, extra_libs=("pallas",)):
     library, per-op mixes ("op_a:pallas,op_b:pallas") let a winning
     kernel ship without dragging in siblings that lose at this shape.
     A broken base path is a real failure and propagates; a broken
-    variant only loses its speedup."""
+    variant only loses its speedup. Every measured (library, steps/s)
+    pair is also returned so callers emit per-mix JSON lines after
+    their headline — the driver-captured analog of the
+    jit/benchmark.cc per-impl table. Returns (best, mixes)."""
     from paddle_tpu.core.flags import FLAGS
 
     def timed(lib):
@@ -127,6 +130,7 @@ def _best_library(run_step, warmup, iters, extra_libs=("pallas",)):
 
     _log("timing base library")
     best = timed("")
+    mixes = [("base", best)]
     _log("base done: %.3f steps/s" % best)
     for lib in extra_libs:
         if _over_budget():
@@ -136,11 +140,12 @@ def _best_library(run_step, warmup, iters, extra_libs=("pallas",)):
             _log("timing library %r" % lib)
             sps = timed(lib)
             _log("%r done: %.3f steps/s" % (lib, sps))
+            mixes.append((lib, sps))
             best = max(best, sps)
         except Exception as e:
             print("library %r failed, ignoring: %r" % (lib, e),
                   file=sys.stderr)
-    return best
+    return best, mixes
 
 
 # ---------------------------------------------------------------------------
@@ -195,13 +200,17 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
              "scaled_dot_product_attention:pallas",
              "fused_linear_xent:pallas",
              "pallas")
-    sps = (_best_library(run, warmup, iters, extra_libs=mixes)
-           if compare_libs else _timed_loop(run, warmup, iters))
+    if compare_libs:
+        sps, measured = _best_library(run, warmup, iters,
+                                      extra_libs=mixes)
+    else:
+        sps, measured = _timed_loop(run, warmup, iters), []
     return {
         "metric": "transformer_base_train_throughput",
         "value": round(tokens_per_step * sps, 1),
         "unit": "tokens/sec/chip",
         "mfu": _mfu(transformer_flops_per_step(cfg, batch), sps),
+        "_mixes": measured,
     }
 
 
@@ -268,13 +277,14 @@ def bench_resnet50(batch=64, warmup=3, iters=10):
         "img": rs.rand(batch, 224, 224, 3).astype(np.float32),
         "label": rs.randint(0, 1000, size=(batch, 1)).astype(np.int64),
     })
-    sps = _best_library(
+    sps, measured = _best_library(
         lambda: exe.run(main, feed=feed, fetch_list=[loss],
                         return_numpy=False),
         warmup, iters)
     return {"metric": "resnet50_train_throughput",
             "value": round(batch * sps, 1), "unit": "images/sec/chip",
-            "mfu": _mfu(3.0 * _RESNET50_FWD_FLOPS * batch, sps)}
+            "mfu": _mfu(3.0 * _RESNET50_FWD_FLOPS * batch, sps),
+            "_mixes": measured}
 
 
 def bench_resnet50_hostfed(batch=64, warmup=3, iters=10):
@@ -368,14 +378,15 @@ def bench_bert(batch=32, seq_len=128, warmup=3, iters=10):
     # make_fake_pretrain_batch fixes its own seq len; recompute S
     seq_len = feed["src_ids"].shape[1]
     feed = _device_feed(feed)
-    sps = _best_library(
+    sps, measured = _best_library(
         lambda: exe.run(main, feed=feed, fetch_list=[loss],
                         return_numpy=False),
         warmup, iters)
     return {"metric": "bert_base_train_throughput",
             "value": round(batch * seq_len * sps, 1),
             "unit": "tokens/sec/chip",
-            "mfu": _mfu(bert_flops_per_step(cfg, batch, seq_len), sps)}
+            "mfu": _mfu(bert_flops_per_step(cfg, batch, seq_len), sps),
+            "_mixes": measured}
 
 
 # ---------------------------------------------------------------------------
@@ -495,6 +506,16 @@ def _smoke_overrides():
                 compare_libs=False)
 
 
+def _emit_mixes(prefix, mixes):
+    """Per-mix evidence lines (jit/benchmark.cc best-impl-wins table):
+    the driver records stdout, so each measured kernel mix lands in
+    the round's BENCH artifact alongside its headline."""
+    for lib, sps in mixes:
+        print(json.dumps({"metric": "%s_mix" % prefix,
+                          "library": lib, "value": round(sps, 4),
+                          "unit": "steps/sec"}), flush=True)
+
+
 def _degraded_headline():
     # value stays null unless a measurement actually completed, so a
     # degraded run can never be mistaken for a measured 0 tokens/sec
@@ -567,7 +588,9 @@ def child_main():
     # placeholder. Unknown device (CPU smoke runs) -> null.
     headline["vs_baseline"] = (round(mfu / 0.40, 3) if mfu is not None
                                else None)
+    mixes = headline.pop("_mixes", [])
     _emit(headline)
+    _emit_mixes("transformer", mixes)
     if "--all" in sys.argv:
         extra = [bench_mnist_mlp, bench_resnet50,
                  bench_resnet50_hostfed, bench_bert, bench_deepfm]
@@ -576,7 +599,9 @@ def child_main():
                 r = fn()
                 r["vs_baseline"] = (round(r["mfu"] / 0.40, 3)
                                     if r.get("mfu") else None)
+                mixes = r.pop("_mixes", [])
                 print(json.dumps(r), flush=True)
+                _emit_mixes(r["metric"], mixes)
             except Exception as e:
                 print(json.dumps({"metric": fn.__name__,
                                   "error": repr(e)}), flush=True)

@@ -71,6 +71,10 @@ def _have_real():
 
 
 def _real_creator(flag, is_train, mapper=None):
+    # augmentation must differ across epochs: seed per (epoch, image),
+    # not per image, or every epoch replays identical crops/flips
+    epoch = {"n": 0}
+
     def reader():
         import io as _io
 
@@ -87,6 +91,7 @@ def _real_creator(flag, is_train, mapper=None):
         # the ~330MB stream on every backward seek. Samples therefore
         # come out in archive order (the reference shuffles its batch
         # files anyway, flowers.py:121).
+        epoch["n"] += 1
         with tarfile.open(common.data_path("flowers", _DATA)) as tf:
             member = tf.next()
             while member is not None:
@@ -95,7 +100,8 @@ def _real_creator(flag, is_train, mapper=None):
                     blob = tf.extractfile(member).read()
                     raw = np.asarray(Image.open(_io.BytesIO(blob))
                                      .convert("RGB"), np.uint8)
-                    rng = np.random.RandomState(i)
+                    rng = np.random.RandomState(
+                        (epoch["n"] * 1_000_003 + i) & 0x7FFFFFFF)
                     out = img_util.simple_transform(
                         raw, 256, 224, is_train,
                         mean=[104.0, 117.0, 124.0], rng=rng)

@@ -1,0 +1,123 @@
+"""Chip-measured refer-vs-pallas win table at flagship shapes.
+
+The analog of the reference's operators/jit/benchmark.cc +
+jit/README.en.md discipline: every kernel in the default library mix
+must WIN at its target shape, proven by an in-tree benchmark table.
+Run on the real chip:
+
+    python tools/kernel_table.py            # all kernels, markdown out
+    python tools/kernel_table.py --json     # machine-readable lines
+
+Each row times the base XLA lowering against the pallas variant
+through the real executor (fwd+bwd where differentiable) at the
+transformer-base flagship shape, and verdicts win/lose. Paste the
+table into BASELINE.md and demote any loser from the default mix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+# flagship shapes: transformer-base NMT (BASELINE.json config 3) at
+# batch 64, S=256, d_model 512, H=8, vocab 30k
+_B, _S, _D, _H, _V = 64, 256, 512, 8, 30000
+
+CASES = [
+    # (op, inputs builder, attrs, grad?, output index to time)
+    ("scaled_dot_product_attention",
+     lambda rs: {"Q": rs.rand(_B, _H, _S, _D // _H).astype("float32"),
+                 "K": rs.rand(_B, _H, _S, _D // _H).astype("float32"),
+                 "V": rs.rand(_B, _H, _S, _D // _H).astype("float32")},
+     {"causal": True}, True),
+    ("layer_norm",
+     lambda rs: {"X": rs.rand(_B * _S, _D).astype("float32"),
+                 "Scale": rs.rand(_D).astype("float32"),
+                 "Bias": rs.rand(_D).astype("float32")},
+     {"begin_norm_axis": 1}, True),
+    # out_index 1 = Loss: timing Softmax (index 0) would let XLA
+    # dead-code the cross-entropy path this kernel targets
+    ("softmax_with_cross_entropy",
+     lambda rs: {"Logits": rs.rand(_B * _S, _V).astype("float32"),
+                 "Label": rs.randint(0, _V, (_B * _S, 1))
+                 .astype("int64")},
+     {}, True, 1),
+    ("fused_linear_xent",
+     lambda rs: {"X": rs.rand(_B * _S, _D).astype("float32"),
+                 "W": (rs.rand(_D, _V).astype("float32") * 0.02),
+                 "Label": rs.randint(0, _V, (_B * _S, 1))
+                 .astype("int64")},
+     {"epsilon": 0.1}, True),
+    ("adam",
+     lambda rs: {"Param": rs.rand(_D, 4 * _D).astype("float32"),
+                 "Grad": rs.rand(_D, 4 * _D).astype("float32"),
+                 "Moment1": rs.rand(_D, 4 * _D).astype("float32"),
+                 "Moment2": rs.rand(_D, 4 * _D).astype("float32"),
+                 "LearningRate": np.asarray([1e-3], np.float32),
+                 "Beta1Pow": np.asarray([0.9], np.float32),
+                 "Beta2Pow": np.asarray([0.999], np.float32)},
+     {}, False),
+]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--only", help="comma-separated op subset")
+    args = ap.parse_args(argv)
+
+    from op_bench import bench_op
+
+    rs = np.random.RandomState(0)
+    rows = []
+    only = set(args.only.split(",")) if args.only else None
+    for case in CASES:
+        op, mk, attrs, grad = case[:4]
+        out_index = case[4] if len(case) > 4 else 0
+        if only and op not in only:
+            continue
+        try:
+            results = bench_op(op, mk(rs), attrs, iters=args.iters,
+                               warmup=10, grad=grad,
+                               out_index=out_index)
+        except Exception as e:  # keep the table going per-op
+            rows.append({"op": op, "error": repr(e)})
+            continue
+        by_lib = {r["library"]: r for r in results}
+        base = by_lib.get("base")
+        pallas = by_lib.get("pallas")
+        if not base or not pallas:
+            rows.append({"op": op, "error": "missing variant: %s"
+                         % sorted(by_lib)})
+            continue
+        b_ms = base["us_per_call"] / 1e3
+        p_ms = pallas["us_per_call"] / 1e3
+        speedup = b_ms / p_ms if p_ms else 0.0
+        rows.append({"op": op, "base_ms": round(b_ms, 3),
+                     "pallas_ms": round(p_ms, 3),
+                     "speedup": round(speedup, 3),
+                     "winner": "pallas" if speedup > 1.0 else "xla"})
+    if args.json:
+        for r in rows:
+            print(json.dumps(r), flush=True)
+        return
+    print("| op | base (XLA) ms | pallas ms | speedup | winner |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        if "error" in r:
+            print("| %s | ERROR %s | | | |" % (r["op"], r["error"]))
+        else:
+            print("| %s | %.3f | %.3f | %.2fx | %s |"
+                  % (r["op"], r["base_ms"], r["pallas_ms"],
+                     r["speedup"], r["winner"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,7 +62,7 @@ def parse_attrs(spec):
 
 
 def bench_op(op_type, np_inputs, attrs, iters=200, warmup=20,
-             grad=False):
+             grad=False, out_index=0):
     import jax
 
     import paddle_tpu as fluid
@@ -80,10 +80,10 @@ def bench_op(op_type, np_inputs, attrs, iters=200, warmup=20,
         if grad:
             with fluid.program_guard(main):
                 from paddle_tpu import layers
-                loss = layers.reduce_sum(out_vars[0])
+                loss = layers.reduce_sum(out_vars[out_index])
                 fluid.gradients(loss, list(in_map.values()))
         exe = fluid.Executor()
-        fetch = [out_vars[0]]
+        fetch = [out_vars[out_index]]
 
         def run():
             return exe.run(main, feed=feed, fetch_list=fetch,
