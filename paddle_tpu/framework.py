@@ -567,16 +567,22 @@ class Program:
                 # Prune vars no surviving op references (optimizer
                 # state, grads) — otherwise every eval step would
                 # shuttle dead Adam moments through the jitted program.
-                live = set()
-                for op in b.ops:
-                    for ns in op.inputs.values():
-                        live.update(ns)
-                    for ns in op.outputs.values():
-                        live.update(ns)
+                live = Program._referenced_names(b)
                 b.vars = {n: v for n, v in b.vars.items()
                           if n in live or v.is_data}
         p._bump()
         return p
+
+    @staticmethod
+    def _referenced_names(block) -> set:
+        """Every var name an op of ``block`` reads or writes."""
+        live = set()
+        for op in block.ops:
+            for ns in op.inputs.values():
+                live.update(ns)
+            for ns in op.outputs.values():
+                live.update(ns)
+        return live
 
     def _prune(self, targets) -> "Program":
         """Slice the program to the ops needed to compute ``targets``
@@ -586,25 +592,44 @@ class Program:
         for t in targets:
             target_names.add(t.name if isinstance(t, Variable) else t)
         p = copy.deepcopy(self)
-        for b in p.blocks:
-            needed = set(target_names)
-            kept = []
-            for op in reversed(b.ops):
-                out_names = [n for ns in op.outputs.values() for n in ns]
-                if any(n in needed for n in out_names):
-                    kept.append(op)
-                    for ns in op.inputs.values():
-                        needed.update(ns)
-            kept.reverse()
-            b.ops = kept
-            live = set()
-            for op in b.ops:
+        # prune the ROOT block only: sub-blocks (while/rnn bodies) are
+        # executed by their parent op and their ops never produce the
+        # root fetch names — slicing them against root targets would
+        # empty them (prune.cc keeps sub-blocks of kept ops whole)
+        b = p.global_block()
+        needed = set(target_names)
+        kept = []
+        for op in reversed(b.ops):
+            out_names = [n for ns in op.outputs.values() for n in ns]
+            if any(n in needed for n in out_names):
+                kept.append(op)
                 for ns in op.inputs.values():
-                    live.update(ns)
-                for ns in op.outputs.values():
-                    live.update(ns)
-            b.vars = {n: v for n, v in b.vars.items()
-                      if n in live or n in target_names}
+                    needed.update(ns)
+        kept.reverse()
+        b.ops = kept
+        live = Program._referenced_names(b)
+        # only sub-blocks reachable from KEPT ops survive (prune.cc
+        # semantics); unreachable bodies are emptied — block indices
+        # must stay stable, so the Block objects themselves remain
+        reachable = set()
+        frontier = list(b.ops)
+        while frontier:
+            op = frontier.pop()
+            idx = op.attrs.get("sub_block")
+            if isinstance(idx, int) and idx not in reachable \
+                    and 0 <= idx < len(p.blocks):
+                reachable.add(idx)
+                frontier.extend(p.blocks[idx].ops)
+        for sub in p.blocks[1:]:
+            if sub.idx in reachable:
+                # vars closed over by surviving sub-block ops resolve
+                # through the parent chain — keep them live in root
+                live |= Program._referenced_names(sub)
+            else:
+                sub.ops = []
+                sub.vars = {}
+        b.vars = {n: v for n, v in b.vars.items()
+                  if n in live or n in target_names}
         p._bump()
         return p
 

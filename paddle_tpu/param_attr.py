@@ -32,6 +32,9 @@ class ParamAttr:
             return ParamAttr(initializer=arg)
         if arg is False:
             return False
+        if arg is True:
+            # fluid convention: bias_attr=True means "default bias"
+            return ParamAttr()
         raise TypeError("cannot convert %r to ParamAttr" % (arg,))
 
     def _to_kwargs(self, with_initializer=False):

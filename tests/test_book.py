@@ -283,3 +283,82 @@ class TestBook:
 
         _train_save_reload(build, feeder, ["img", "ilen"], 150,
                            tmp_path, lr=0.02, loss_ratio=0.5)
+
+    def test_rnn_encoder_decoder(self, tmp_path):
+        """test_rnn_encoder_decoder.py — the pre-attention seq2seq
+        chapter: bi-LSTM encoder (forward-last + backward-first
+        context), fc decoder boot, DynamicRNN decoder stepping a
+        hand-built lstm cell over the target embedding with the
+        encoder context as a static input."""
+        DICT, EMB, HID, DEC, T = 40, 16, 16, 16, 8
+
+        def lstm_step(x_t, h_prev, c_prev, size):
+            def linear(inputs):
+                return layers.fc(inputs, size=size, bias_attr=True)
+
+            f = layers.sigmoid(linear([h_prev, x_t]))
+            i = layers.sigmoid(linear([h_prev, x_t]))
+            o = layers.sigmoid(linear([h_prev, x_t]))
+            c_tilde = layers.tanh(linear([h_prev, x_t]))
+            c = layers.elementwise_add(
+                layers.elementwise_mul(f, c_prev),
+                layers.elementwise_mul(i, c_tilde))
+            h = layers.elementwise_mul(o, layers.tanh(c))
+            return h, c
+
+        def build():
+            src = layers.data("src", shape=[T], dtype="int64")
+            tgt = layers.data("tgt", shape=[T], dtype="int64")
+            lbl = layers.data("lbl", shape=[T], dtype="int64")
+            src_len = layers.reshape(
+                layers.data("src_len", shape=[1], dtype="int64"),
+                (-1,))
+
+            src_emb = layers.embedding(src, size=(DICT, EMB))
+            fwd_proj = layers.fc(src_emb, 4 * HID,
+                                 num_flatten_dims=2, bias_attr=False)
+            fwd, _ = layers.dynamic_lstm(
+                fwd_proj, 4 * HID, use_peepholes=False,
+                seq_len=src_len)
+            bwd_proj = layers.fc(src_emb, 4 * HID,
+                                 num_flatten_dims=2, bias_attr=False)
+            bwd, _ = layers.dynamic_lstm(
+                bwd_proj, 4 * HID, use_peepholes=False,
+                is_reverse=True, seq_len=src_len)
+            fwd_last = layers.sequence_last_step(fwd,
+                                                 seq_len=src_len)
+            bwd_first = layers.sequence_first_step(bwd)
+            context = layers.concat([fwd_last, bwd_first], axis=1)
+            boot = layers.fc(bwd_first, DEC, act="tanh")
+
+            tgt_emb = layers.embedding(tgt, size=(DICT, EMB))
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(tgt_emb)
+                ctx = drnn.static_input(context)
+                h_mem = drnn.memory(init=boot, need_reorder=True)
+                c_mem = drnn.memory(shape=[DEC], value=0.0)
+                dec_in = layers.concat([ctx, word], axis=1)
+                h, c = lstm_step(dec_in, h_mem, c_mem, DEC)
+                drnn.update_memory(h_mem, h)
+                drnn.update_memory(c_mem, c)
+                drnn.output(layers.fc(h, DICT, act="softmax"))
+            pred = drnn()
+            cost = layers.cross_entropy(
+                layers.reshape(pred, (-1, DICT)),
+                layers.reshape(lbl, (-1, 1)))
+            return layers.mean(cost), pred
+
+        def feeder(step):
+            rs = np.random.RandomState(step % 3)
+            src = rs.randint(2, DICT, (8, T)).astype(np.int64)
+            # learnable mapping: tgt word = f(src word)
+            tgt = (src * 3 + 1) % DICT
+            lbl = np.roll(tgt, -1, axis=1)
+            lbl[:, -1] = 1
+            return {"src": src, "tgt": tgt, "lbl": lbl,
+                    "src_len": np.full((8, 1), T, np.int64)}
+
+        _train_save_reload(
+            build, feeder, ["src", "tgt", "src_len"], 150, tmp_path,
+            lr=2e-2, loss_ratio=0.5)

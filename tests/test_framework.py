@@ -101,3 +101,40 @@ def test_shape_inference_real_dim_equal_to_sentinel():
         # reshape whose target mentions 8191 as a literal attr
         r = layers.reshape(w, (-1, 8, 2))
         assert r.shape == (-1, 8, 2)
+
+
+class TestPruneSubBlocks:
+    def test_prune_keeps_reachable_drops_dead_sub_blocks(self):
+        """_prune must keep sub-blocks of KEPT ops whole (reference
+        prune.cc) and empty unreachable bodies — round 4 fixed both
+        directions: sub-blocks used to be sliced against root targets
+        (emptying live RNN bodies in saved inference models)."""
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4, 3])
+            enc = layers.fc(x, 6, num_flatten_dims=2,
+                            bias_attr=False)
+            context = layers.reduce_sum(enc, dim=1)
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                x_t = drnn.step_input(x)
+                h_prev = drnn.memory(shape=[5], value=0.0)
+                h = layers.fc(x_t, size=5, act="tanh",
+                              param_attr=fluid.ParamAttr(
+                                  name="dec_w"))
+                h = layers.elementwise_add(
+                    h, layers.fc(h_prev, size=5, bias_attr=False))
+                drnn.update_memory(h_prev, h)
+                drnn.output(h)
+            dec = drnn()
+
+        enc_only = main._prune([context])
+        assert "dec_w" not in enc_only.global_block().vars
+        assert all(not b.ops for b in enc_only.blocks[1:])
+
+        full = main._prune([dec])
+        assert full.blocks[1].ops, "reachable sub-block emptied"
+        assert "dec_w" in full.global_block().vars
