@@ -164,13 +164,10 @@ def transformer_flops_per_step(cfg, batch):
     return 3.0 * fwd * batch
 
 
-def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
-                      compare_libs=True):
+def _build_transformer_step(batch, seq_len):
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as amp
     from paddle_tpu.models import transformer as T
-
-    _log("building transformer-base program")
 
     cfg = T.TransformerConfig(src_vocab=30000, tgt_vocab=30000,
                               max_len=seq_len, d_model=512, d_ffn=2048,
@@ -178,7 +175,7 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
     with fluid.program_guard(main, startup):
-        avg_cost, token_num, _ = T.transformer(cfg)
+        avg_cost, _token_num, _ = T.transformer(cfg)
         opt = amp.decorate(fluid.optimizer.AdamOptimizer(1e-3))
         opt.minimize(avg_cost)
     exe = fluid.Executor()
@@ -188,9 +185,16 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
     feed = T.make_fake_batch(cfg, batch)
     tokens_per_step = float(feed["tgt_mask"].sum())
     feed = _device_feed(feed)
-
     run = lambda: exe.run(main, feed=feed, fetch_list=[avg_cost],
                           return_numpy=False)
+    return cfg, run, tokens_per_step
+
+
+def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
+                      compare_libs=True):
+    _log("building transformer-base program")
+    cfg, run, tokens_per_step = _build_transformer_step(batch, seq_len)
+
     # curated mixes, most promising first (the soft budget may cut the
     # tail): fused vocab-xent (kills the [N,30k] logits traffic) +
     # flash attention with in-kernel dropout (kills the [B,H,S,S]
@@ -205,11 +209,45 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
                                       extra_libs=mixes)
     else:
         sps, measured = _timed_loop(run, warmup, iters), []
+    value = tokens_per_step * sps
+    mfu = _mfu(transformer_flops_per_step(cfg, batch), sps)
+    used_batch = batch
+
+    # round-3's fused vocab-xent removed the [N,30k] logits temp (the
+    # 3.66GB allocation that OOMed batch>=128 on 16G v5e in round 2) —
+    # with budget left, try the winning fused mix at batch 128: bigger
+    # batches amortize HBM-bound elementwise work over more MXU FLOPs
+    if (compare_libs and len(measured) > 1
+            and _BUDGET_S - (time.time() - _T0) > 180):
+        try:
+            from paddle_tpu.core.flags import FLAGS
+            _log("trying batch 128 with the fused mix")
+            cfg2, run2, tokens2 = _build_transformer_step(
+                batch * 2, seq_len)
+            prev = FLAGS.op_library
+            FLAGS.op_library = ("fused_linear_xent:pallas,"
+                                "scaled_dot_product_attention:pallas")
+            try:
+                sps2 = _timed_loop(run2, warmup, iters)
+            finally:
+                FLAGS.op_library = prev
+            measured.append(("fused@b%d" % (batch * 2), sps2))
+            _log("batch %d done: %.3f steps/s" % (batch * 2, sps2))
+            mfu2 = _mfu(transformer_flops_per_step(cfg2, batch * 2),
+                        sps2)
+            if tokens2 * sps2 > value:
+                value = tokens2 * sps2
+                mfu = mfu2
+                used_batch = batch * 2
+        except Exception as e:  # OOM etc. — keep the batch-64 result
+            _log("batch-%d attempt failed (keeping b%d): %r"
+                 % (batch * 2, batch, e))
     return {
         "metric": "transformer_base_train_throughput",
-        "value": round(tokens_per_step * sps, 1),
+        "value": round(value, 1),
         "unit": "tokens/sec/chip",
-        "mfu": _mfu(transformer_flops_per_step(cfg, batch), sps),
+        "mfu": mfu,
+        "batch": used_batch,
         "_mixes": measured,
     }
 

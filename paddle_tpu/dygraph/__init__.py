@@ -1,7 +1,16 @@
 """Dygraph (eager/imperative) mode — reference:
 paddle/fluid/imperative/ + python/paddle/fluid/dygraph/."""
 
+from . import learning_rate_scheduler  # noqa: F401
 from . import nn  # noqa: F401
+from .backward_strategy import BackwardStrategy  # noqa: F401
+from .learning_rate_scheduler import (CosineDecay,  # noqa: F401
+                                      ExponentialDecay,
+                                      InverseTimeDecay,
+                                      LearningRateDecay,
+                                      NaturalExpDecay, NoamDecay,
+                                      PiecewiseDecay,
+                                      PolynomialDecay)
 from .base import (VarBase, backward, enabled, guard,  # noqa: F401
                    in_dygraph_mode, no_grad, run_dygraph_op,
                    to_variable)

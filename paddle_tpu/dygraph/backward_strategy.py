@@ -1,0 +1,18 @@
+"""BackwardStrategy config (reference:
+python/paddle/fluid/dygraph/backward_strategy.py ->
+imperative/backward_strategy.h: one knob, ``sort_sum_gradient`` —
+deterministic gradient aggregation order).
+
+TPU note: the eager tape already aggregates gradients
+deterministically (a Python list walked in reverse-creation order),
+so the flag is accepted for parity and recorded; both settings
+produce identical sums here."""
+
+from __future__ import annotations
+
+__all__ = ["BackwardStrategy"]
+
+
+class BackwardStrategy:
+    def __init__(self):
+        self.sort_sum_gradient = False

@@ -1,0 +1,142 @@
+"""Imperative learning-rate schedules.
+
+Reference: python/paddle/fluid/dygraph/learning_rate_scheduler.py —
+LearningRateDecay subclasses are CALLABLE learning rates: the
+optimizer calls the object each step, which returns the current lr
+and advances its counter. The TPU redesign returns plain Python
+floats (the eager optimizers fold the lr into the jitted update as a
+scalar operand; no 1-element persistable var is needed)."""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "NoamDecay"]
+
+
+class LearningRateDecay:
+    """Reference learning_rate_scheduler.py:27 — __call__ returns the
+    lr for the CURRENT step then advances ``step_num`` by
+    ``step_size``."""
+
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def __call__(self):
+        lr = float(self.step())
+        self.step_num += self.step_size
+        return lr
+
+    def step(self):
+        raise NotImplementedError()
+
+
+class PiecewiseDecay(LearningRateDecay):
+    """Reference :58 — values[i] while step < boundaries[i]."""
+
+    def __init__(self, boundaries, values, begin, step=1,
+                 dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def step(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    """Reference :75 — lr * exp(-rate * t)."""
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def _div(self):
+        d = self.step_num / self.decay_steps
+        return math.floor(d) if self.staircase else d
+
+    def step(self):
+        return self.learning_rate * math.exp(
+            -self.decay_rate * self._div())
+
+
+class ExponentialDecay(NaturalExpDecay):
+    """Reference :101 — lr * rate^(t/steps)."""
+
+    def step(self):
+        return self.learning_rate * (self.decay_rate ** self._div())
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    """Reference :127 — lr / (1 + rate * t/steps)."""
+
+    def step(self):
+        return self.learning_rate / (1.0 + self.decay_rate
+                                     * self._div())
+
+
+class PolynomialDecay(LearningRateDecay):
+    """Reference :153."""
+
+    def __init__(self, learning_rate, decay_steps,
+                 end_learning_rate=0.0001, power=1.0, cycle=False,
+                 begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def step(self):
+        t, steps = self.step_num, self.decay_steps
+        if self.cycle:
+            div = math.ceil(t / float(steps)) if t > 0 else 1.0
+            steps = steps * div
+        else:
+            t = min(t, steps)
+        return ((self.learning_rate - self.end_learning_rate)
+                * (1 - t / steps) ** self.power
+                + self.end_learning_rate)
+
+
+class CosineDecay(LearningRateDecay):
+    """Reference :191 — half-cosine over epochs."""
+
+    def __init__(self, learning_rate, step_each_epoch, epochs,
+                 begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def step(self):
+        cur_epoch = math.floor(self.step_num / self.step_each_epoch)
+        return self.learning_rate * 0.5 * (
+            math.cos(cur_epoch * math.pi / self.epochs) + 1)
+
+
+class NoamDecay(LearningRateDecay):
+    """Reference :213 — the transformer warmup schedule."""
+
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def step(self):
+        t = max(self.step_num, 1)
+        return (self.d_model ** -0.5) * min(
+            t ** -0.5, (self.warmup_steps ** -1.5) * t)

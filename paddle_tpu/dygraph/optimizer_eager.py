@@ -81,9 +81,12 @@ def apply_dygraph(opt, loss: VarBase, parameter_list=None,
         pairs = _eager_clip(grad_clip, pairs)
     pairs = _eager_regularize(opt.regularization, pairs)
     result = []
+    # ONE schedule tick per minimize, not per parameter: a callable
+    # learning rate (dygraph.LearningRateDecay) advances its step
+    # counter on every call
+    lr = _lr(opt)
     for p, g in pairs:
         st = _state(opt).setdefault(id(p), {})
-        lr = _lr(opt)
         if name.startswith("sgd"):
             p.value = ops.get("sgd").fn(p.value, g, lr)
         elif name.startswith("momentum"):

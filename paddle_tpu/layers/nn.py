@@ -260,7 +260,8 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, exclusive=True, name=None):
+           ceil_mode=False, exclusive=True, name=None,
+           data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(type="pool2d", inputs={"X": [input]},
@@ -271,7 +272,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                             "paddings": pool_padding,
                             "global_pooling": global_pooling,
                             "ceil_mode": ceil_mode,
-                            "exclusive": exclusive})
+                            "exclusive": exclusive,
+                            "data_format": data_format})
     return out
 
 

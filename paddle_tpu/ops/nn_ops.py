@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.enforce import InvalidArgumentError
 from .registry import register
 
 
@@ -288,9 +289,19 @@ def conv2d_transpose(x, w, *, strides=(1, 1), paddings=(0, 0),
 def pool2d(x, *, ksize, pooling_type="max", strides=(1, 1),
            paddings=(0, 0), global_pooling=False, ceil_mode=False,
            exclusive=True, adaptive=False, data_format="NCHW"):
-    """Reference: pool_op.cc. Lowered to lax.reduce_window."""
+    """Reference: pool_op.cc. Lowered to lax.reduce_window; NHWC runs
+    through a transpose pair XLA folds into the window layout."""
+    if data_format == "NHWC":
+        out = pool2d(x.transpose(0, 3, 1, 2), ksize=ksize,
+                     pooling_type=pooling_type, strides=strides,
+                     paddings=paddings, global_pooling=global_pooling,
+                     ceil_mode=ceil_mode, exclusive=exclusive,
+                     adaptive=adaptive, data_format="NCHW")
+        return out.transpose(0, 2, 3, 1)
     if data_format != "NCHW":
-        raise NotImplementedError("pool2d currently supports NCHW")
+        raise InvalidArgumentError(
+            "pool2d data_format must be NCHW or NHWC, got %r"
+            % (data_format,))
     if global_pooling or adaptive and tuple(_pair(ksize)) == (1, 1):
         axis = (2, 3)
         if pooling_type == "max":
@@ -301,7 +312,15 @@ def pool2d(x, *, ksize, pooling_type="max", strides=(1, 1),
     p = _pair(paddings)
     window = (1, 1) + k
     stride = (1, 1) + s
-    pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+    hi = [p[0], p[1]]
+    if ceil_mode:
+        # reference pool_op.cc ceil formula: output covers the input
+        # tail by padding the high side up to a full extra stride
+        for i, (L, kk, ss, pp) in enumerate(
+                zip(x.shape[2:], k, s, p)):
+            out_ceil = -(-(L + 2 * pp - kk) // ss) + 1
+            hi[i] = (out_ceil - 1) * ss + kk - (L + pp)
+    pads = [(0, 0), (0, 0), (p[0], hi[0]), (p[1], hi[1])]
     if pooling_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -310,6 +329,8 @@ def pool2d(x, *, ksize, pooling_type="max", strides=(1, 1),
     ones = jnp.ones_like(x)
     summed = lax.reduce_window(x, 0.0, lax.add, window, stride, pads)
     if exclusive:
+        # padding contributes 0 to counts, so ceil-mode tail windows
+        # divide by their real element count
         counts = lax.reduce_window(ones, 0.0, lax.add, window, stride,
                                    pads)
     else:

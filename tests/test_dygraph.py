@@ -362,3 +362,85 @@ class TestTreeConv:
                    dg.to_variable(edges_np))
             assert o.numpy().shape == (1, 5, 2, 2)
             assert np.isfinite(o.numpy()).all()
+
+
+class TestDygraphLRSchedulers:
+    def test_decay_formulas(self):
+        """Reference dygraph/learning_rate_scheduler.py — each decay's
+        closed form, checked at specific steps."""
+        import math
+
+        from paddle_tpu import dygraph
+
+        pw = dygraph.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1], begin=0)
+        got = [pw() for _ in range(7)]
+        assert got == [1.0, 1.0, 1.0, 0.5, 0.5, 0.5, 0.1]
+
+        ne = dygraph.NaturalExpDecay(0.1, 10, 0.5)
+        v0, v1 = ne(), ne()
+        assert v0 == 0.1
+        np.testing.assert_allclose(v1, 0.1 * math.exp(-0.05),
+                                   rtol=1e-6)
+
+        ex = dygraph.ExponentialDecay(0.1, 10, 0.5, staircase=True)
+        vals = [ex() for _ in range(11)]
+        assert vals[0] == vals[9] == 0.1 and vals[10] == 0.05
+
+        it = dygraph.InverseTimeDecay(0.1, 10, 2.0)
+        it()
+        np.testing.assert_allclose(it(), 0.1 / 1.2, rtol=1e-6)
+
+        pd = dygraph.PolynomialDecay(0.1, 10, end_learning_rate=0.01,
+                                     power=1.0)
+        first = pd()
+        np.testing.assert_allclose(first, 0.1, rtol=1e-6)
+        for _ in range(20):
+            last = pd()
+        np.testing.assert_allclose(last, 0.01, rtol=1e-6)
+
+        cd = dygraph.CosineDecay(0.1, step_each_epoch=2, epochs=4)
+        v = [cd() for _ in range(8)]
+        np.testing.assert_allclose(v[0], 0.1, rtol=1e-6)
+        assert v[-1] < v[0]
+
+        nd = dygraph.NoamDecay(d_model=64, warmup_steps=4)
+        warm = [nd() for _ in range(8)]
+        peak = np.argmax(warm)
+        assert peak == 3  # rises through warmup, then decays
+        assert warm[-1] < warm[peak]
+
+    def test_scheduler_drives_training(self):
+        """A callable lr plugs into the eager optimizer (the
+        reference's optimizer(learning_rate=NoamDecay(...)) idiom)."""
+        from paddle_tpu import dygraph
+
+        with dygraph.guard():
+            layer = dygraph.Linear(4, 1)
+            sched = dygraph.PiecewiseDecay([5], [0.1, 0.01], begin=0)
+            sgd = fluid.optimizer.SGD(learning_rate=sched)
+            rs = np.random.RandomState(0)
+            x = dygraph.to_variable(rs.rand(8, 4).astype(np.float32))
+            y = dygraph.to_variable(
+                x.numpy().sum(1, keepdims=True) * 0.3)
+            losses = []
+            for _ in range(10):
+                pred = layer(x)
+                diff = pred - y
+                loss = dygraph.run_dygraph_op(
+                    "reduce_mean", {"X": [diff * diff]},
+                    {"dim": None, "keep_dim": False,
+                     "reduce_all": True})
+                sgd.minimize(loss,
+                             parameter_list=layer.parameters())
+                layer.clear_gradients()
+                losses.append(float(loss.numpy()))
+            assert losses[-1] < losses[0]
+        assert sched.step_num == 10
+
+    def test_backward_strategy_facade(self):
+        from paddle_tpu import dygraph
+
+        bs = dygraph.BackwardStrategy()
+        assert bs.sort_sum_gradient is False
+        bs.sort_sum_gradient = True
+        assert bs.sort_sum_gradient

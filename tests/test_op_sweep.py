@@ -334,6 +334,20 @@ spec("pool2d",
      {"ksize": (2, 2), "pooling_type": "max", "strides": (2, 2)})
 spec("adaptive_pool2d", {"X": sgn((1, 1, 4, 4), 96)},
      {"pool_size": (2, 2), "pooling_type": "avg"})
+# ceil_mode: 5->3 tail windows, exclusive counts (pool_op.cc ceil)
+spec("pool2d", {"X": sgn((1, 2, 5, 5), 964)},
+     {"ksize": (2, 2), "pooling_type": "avg", "strides": (2, 2),
+      "ceil_mode": True},
+     ref=lambda ins: [__import__("torch").nn.functional.avg_pool2d(
+         __import__("torch").from_numpy(ins["X"]), 2, 2,
+         ceil_mode=True, count_include_pad=False).numpy()])
+# NHWC layout: same values as the NCHW spec, channels-last
+spec("pool2d", {"X": sgn((1, 4, 4, 2), 963)},
+     {"ksize": (2, 2), "pooling_type": "avg", "strides": (2, 2),
+      "data_format": "NHWC"},
+     ref=lambda ins: [np.transpose(
+         ins["X"], (0, 3, 1, 2)).reshape(1, 2, 2, 2, 2, 2)
+         .mean(axis=(3, 5)).transpose(0, 2, 3, 1)])
 # uneven bins: 5 -> 3 uses floor/ceil boundaries (pool_op.h:42-52)
 spec("adaptive_pool2d", {"X": sgn((1, 2, 5, 7), 961)},
      {"pool_size": (3, 4), "pooling_type": "avg"})
