@@ -99,3 +99,20 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
     ctx = layers.matmul(weights, v)
     return _merge_heads(ctx)
+
+
+def sequence_conv_pool(input, num_filters, filter_size,
+                       param_attr=None, act="sigmoid",
+                       pool_type="max", bias_attr=None,
+                       seq_len=None):
+    """sequence_conv -> sequence_pool (reference: nets.py
+    sequence_conv_pool — the text-CNN building block).
+
+    ``seq_len`` carries the padded-design lengths vector through both
+    stages (the reference reads lengths from the LoD)."""
+    conv = layers.sequence_conv(input, num_filters,
+                                filter_size=filter_size,
+                                param_attr=param_attr,
+                                bias_attr=bias_attr, act=act,
+                                seq_len=seq_len)
+    return layers.sequence_pool(conv, pool_type, seq_len=seq_len)

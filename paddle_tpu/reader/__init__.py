@@ -4,4 +4,5 @@ returning sample iterators)."""
 
 from .decorator import (buffered, cache, chain, compose,  # noqa: F401
                         firstn, map_readers, shuffle, xmap_readers)
-from .decorator import batch  # noqa: F401
+from .decorator import (ComposeNotAligned, Fake,  # noqa: F401
+                        PipeReader, batch, multiprocess_reader)

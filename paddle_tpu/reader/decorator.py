@@ -15,8 +15,15 @@ import random
 import threading
 from itertools import chain as it_chain
 
-__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+__all__ = ["ComposeNotAligned", "Fake", "PipeReader",
+           "multiprocess_reader",
+           "map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "xmap_readers", "cache", "batch"]
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose() when readers end at different lengths
+    (reference reader/decorator.py:44)."""
 
 
 def map_readers(func, *readers):
@@ -77,7 +84,7 @@ def compose(*readers, check_alignment=True):
                 return
             if done > 0:
                 if check_alignment:
-                    raise RuntimeError(
+                    raise ComposeNotAligned(
                         "compose: readers of different lengths")
                 return
             yield sum(rows, ())
@@ -221,3 +228,185 @@ def batch(reader, batch_size, drop_last=False):
             yield b
 
     return batch_reader
+
+
+class Fake:
+    """Caches the FIRST sample and replays it forever-ish (reference
+    reader/decorator.py:437 Fake): pipeline benchmarking without real
+    data cost. Call the instance with (reader, length)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, length):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < length:
+                self.yield_num += 1
+                yield self.data
+            self.yield_num = 0
+
+        return fake_reader
+
+
+class _WorkerError:
+    """Crosses the process boundary in place of the sentinel when a
+    worker raises, carrying the original error text."""
+
+    def __init__(self, msg):
+        self.msg = msg
+
+
+def _mp_work(r, put):
+    """Worker body shared by the queue and pipe paths: samples, then
+    ALWAYS a terminator — None on success, _WorkerError on failure.
+    A reader yielding None is an error (the reference's
+    'sample has None' ValueError): None is the exhaustion sentinel."""
+    try:
+        for sample in r():
+            if sample is None:
+                raise ValueError(
+                    "multiprocess_reader: sample has None")
+            put(sample)
+        put(None)
+    except Exception as e:  # noqa: BLE001 — crosses process boundary
+        put(_WorkerError("%s: %s" % (type(e).__name__, e)))
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Fan readers out over worker PROCESSES (reference
+    reader/decorator.py:480): ``use_pipe`` streams each worker over
+    its own os pipe (no /dev/shm requirement), else one shared
+    multiprocessing.Queue. Sample order interleaves arbitrarily."""
+    import multiprocessing
+    import queue as queue_mod
+
+    def _finish(item, live):
+        if isinstance(item, _WorkerError):
+            raise RuntimeError(
+                "multiprocess_reader worker failed: %s" % item.msg)
+        assert item is None
+        return live - 1
+
+    def queue_reader():
+        q = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(
+            target=_mp_work, args=(r, q.put), daemon=True)
+            for r in readers]
+        for pr in procs:
+            pr.start()
+        live = len(readers)
+        try:
+            while live > 0:
+                try:
+                    sample = q.get(timeout=5)
+                except queue_mod.Empty:
+                    # a crashed worker can die between samples without
+                    # its terminator (e.g. SIGKILL); poll liveness
+                    # instead of hanging forever
+                    dead = [pr for pr in procs
+                            if not pr.is_alive()
+                            and pr.exitcode not in (0, None)]
+                    if dead:
+                        raise RuntimeError(
+                            "multiprocess_reader: worker exited "
+                            "rc=%s without finishing"
+                            % dead[0].exitcode)
+                    continue
+                if sample is None or isinstance(sample, _WorkerError):
+                    live = _finish(sample, live)
+                else:
+                    yield sample
+        finally:
+            for pr in procs:
+                if pr.is_alive():
+                    pr.terminate()
+
+    def pipe_reader():
+        conns, procs = [], []
+        for r in readers:
+            rx, tx = multiprocessing.Pipe(duplex=False)
+            pr = multiprocessing.Process(
+                target=_mp_work, args=(r, tx.send), daemon=True)
+            procs.append(pr)
+            conns.append(rx)
+            pr.start()
+            tx.close()
+        try:
+            while conns:
+                ready = multiprocessing.connection.wait(conns,
+                                                        timeout=5)
+                if not ready:
+                    dead = [pr for pr in procs
+                            if not pr.is_alive()
+                            and pr.exitcode not in (0, None)]
+                    if dead:
+                        raise RuntimeError(
+                            "multiprocess_reader: worker exited "
+                            "rc=%s without finishing"
+                            % dead[0].exitcode)
+                    continue
+                for rx in ready:
+                    try:
+                        sample = rx.recv()
+                    except EOFError:
+                        conns.remove(rx)
+                        continue
+                    if sample is None or isinstance(sample,
+                                                    _WorkerError):
+                        _finish(sample, 0)
+                        conns.remove(rx)
+                    else:
+                        yield sample
+        finally:
+            for pr in procs:
+                if pr.is_alive():
+                    pr.terminate()
+
+    return pipe_reader if use_pipe else queue_reader
+
+
+class PipeReader:
+    """Stream samples from a shell command's stdout (reference
+    reader/decorator.py:550 — the HDFS-cat ingestion path).
+    get_line() yields decoded lines split on ``cut_lines``."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        if not isinstance(command, str):
+            raise TypeError("PipeReader command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError("PipeReader file_type %r is not allowed "
+                            "(plain, gzip)" % (file_type,))
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize,
+            stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                import zlib
+                decomp = getattr(self, "_decomp", None)
+                if decomp is None:
+                    decomp = self._decomp = zlib.decompressobj(
+                        32 + zlib.MAX_WBITS)
+                buff = decomp.decompress(buff)
+            buff = buff.decode("utf-8", "replace")
+            if cut_lines:
+                lines = (remained + buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            else:
+                yield buff
+        if remained:
+            yield remained

@@ -2,6 +2,7 @@
 decorator_test.py, unittests/test_py_reader_*.py, test_data_feeder)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers, optimizer
@@ -186,3 +187,76 @@ def test_pyreader_survives_early_break():
     # the pump must have retired (no thread stuck on a full queue)
     for feed in r():  # a fresh iteration still works
         break
+
+
+class TestReaderExtras:
+    def test_fake(self):
+        from paddle_tpu.reader import Fake
+
+        calls = {"n": 0}
+
+        def reader():
+            calls["n"] += 1
+            yield np.arange(3)
+            yield np.arange(3) * 2  # never reached by Fake
+
+        fake = Fake()
+        out = list(fake(reader, 5)())
+        assert len(out) == 5
+        assert all((o == np.arange(3)).all() for o in out)
+        assert calls["n"] == 1  # source consulted once
+        # counter resets for the next pass
+        assert len(list(fake(reader, 2)())) == 2
+
+    def test_compose_not_aligned(self):
+        from paddle_tpu.reader import ComposeNotAligned, compose
+
+        r1 = lambda: iter([1, 2, 3])
+        r2 = lambda: iter([4, 5])
+        with pytest.raises(ComposeNotAligned):
+            list(compose(r1, r2)())
+        # and it is a ValueError subclass like the reference's
+        assert issubclass(ComposeNotAligned, ValueError)
+
+    @pytest.mark.parametrize("use_pipe", [True, False])
+    def test_multiprocess_reader(self, use_pipe):
+        from paddle_tpu.reader import multiprocess_reader
+
+        def mk(base):
+            def r():
+                for i in range(4):
+                    yield base + i
+
+            return r
+
+        out = sorted(multiprocess_reader([mk(0), mk(100)],
+                                         use_pipe=use_pipe)())
+        assert out == [0, 1, 2, 3, 100, 101, 102, 103]
+
+    def test_multiprocess_reader_worker_error(self):
+        from paddle_tpu.reader import multiprocess_reader
+
+        def bad():
+            yield 1
+            raise ValueError("corrupt shard")
+
+        with pytest.raises(RuntimeError, match="corrupt shard"):
+            list(multiprocess_reader([bad], use_pipe=True)())
+        # a None sample is an error, not an end marker
+        def yields_none():
+            yield None
+
+        with pytest.raises(RuntimeError, match="sample has None"):
+            list(multiprocess_reader([yields_none],
+                                     use_pipe=False)())
+
+    def test_pipe_reader(self):
+        from paddle_tpu.reader import PipeReader
+
+        pr = PipeReader("printf a\\nb\\nc")
+        lines = list(pr.get_line())
+        assert lines == ["a", "b", "c"]
+        with pytest.raises(TypeError):
+            PipeReader(["not", "a", "string"])
+        with pytest.raises(TypeError, match="not allowed"):
+            PipeReader("cat x", file_type="bzip2")
