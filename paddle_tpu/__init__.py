@@ -36,6 +36,7 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
 from .executor import Executor, scope_guard  # noqa: F401
 from . import parallel  # noqa: F401
 from . import contrib  # noqa: F401
+from . import install_check  # noqa: F401
 from . import profiler  # noqa: F401
 from . import dygraph  # noqa: F401
 from .framework import (Program, Variable, convert_dtype,  # noqa: F401

@@ -1,0 +1,42 @@
+"""Installation smoke check.
+
+Reference: python/paddle/fluid/install_check.py — ``run_check()``
+builds a tiny fc model, runs one forward+backward, and prints a
+success message so users can verify the install end to end (program
+build, startup, trace, compile, execute, autodiff)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import executor, framework, layers, optimizer, unique_name
+from .core.scope import Scope
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Verify the framework end to end on whatever backend JAX sees
+    (reference install_check.py:42)."""
+    print("Running verify paddle_tpu program ... ")
+    prog = framework.Program()
+    startup = framework.Program()
+    scope = Scope()
+    with executor.scope_guard(scope):
+        with framework.program_guard(prog, startup):
+            with unique_name.guard():
+                inp = layers.data(name="inp", shape=[2, 2],
+                                  append_batch_size=False)
+                out = layers.fc(inp, size=2)
+                loss = layers.reduce_mean(out)
+                optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = executor.Executor()
+        exe.run(startup)
+        np_inp = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        (lv,) = exe.run(prog, feed={"inp": np_inp},
+                        fetch_list=[loss])
+        if not np.isfinite(np.asarray(lv)).all():
+            raise RuntimeError(
+                "install check produced a non-finite loss: %r" % lv)
+    print("Your paddle_tpu is installed successfully! Training and "
+          "autodiff work on this backend.")

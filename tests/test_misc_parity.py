@@ -71,3 +71,13 @@ class TestDeviceInfo:
         assert props["platform"] == "cpu"
         all_props = device_info.all_device_properties()
         assert len(all_props) == 8
+
+
+def test_install_check(capsys):
+    """fluid.install_check.run_check() (reference
+    install_check.py:42) verifies build -> startup -> train step."""
+    import paddle_tpu as fluid
+
+    fluid.install_check.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
