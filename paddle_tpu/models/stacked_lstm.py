@@ -1,0 +1,97 @@
+"""Stacked dynamic-LSTM sentiment model — the fifth fluid_benchmark
+model family (reference: benchmark/fluid/models/stacked_dynamic_lstm.py
+get_model:90 — IMDB classification through a hand-built DynamicRNN
+lstm cell; lstm_size=512, emb_dim=512 at benchmark scale).
+
+TPU notes: the hand-built cell runs inside the DynamicRNN scan
+(lax.scan under the hood) exactly like the reference's sub-block; the
+hot path is the fc matmuls, which XLA batches onto the MXU. Stacking
+depth and sizes are configurable so tests run at toy scale."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+
+__all__ = ["StackedLSTMConfig", "stacked_lstm_net", "make_fake_batch"]
+
+
+class StackedLSTMConfig:
+    def __init__(self, vocab_size=5000, emb_dim=64, lstm_size=64,
+                 num_layers=2, num_classes=2, max_len=32):
+        self.vocab_size = vocab_size
+        self.emb_dim = emb_dim
+        self.lstm_size = lstm_size
+        self.num_layers = num_layers
+        self.num_classes = num_classes
+        self.max_len = max_len
+
+
+def _lstm_layer(sentence, lstm_size, seq_len):
+    """One DynamicRNN lstm layer over [B, T, D] (reference
+    stacked_dynamic_lstm.py:45 lstm_net — gates as paired fc sums)."""
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        word = rnn.step_input(sentence, lengths=seq_len)
+        prev_hidden = rnn.memory(value=0.0, shape=[lstm_size])
+        prev_cell = rnn.memory(value=0.0, shape=[lstm_size])
+
+        def gate_common(ipt, hidden, size):
+            gate0 = layers.fc(ipt, size=size, bias_attr=True)
+            gate1 = layers.fc(hidden, size=size, bias_attr=False)
+            return layers.elementwise_add(gate0, gate1)
+
+        forget_gate = layers.sigmoid(
+            gate_common(word, prev_hidden, lstm_size))
+        input_gate = layers.sigmoid(
+            gate_common(word, prev_hidden, lstm_size))
+        output_gate = layers.sigmoid(
+            gate_common(word, prev_hidden, lstm_size))
+        cell_gate = layers.tanh(
+            gate_common(word, prev_hidden, lstm_size))
+
+        cell = layers.elementwise_add(
+            layers.elementwise_mul(forget_gate, prev_cell),
+            layers.elementwise_mul(input_gate, cell_gate))
+        hidden = layers.elementwise_mul(output_gate,
+                                        layers.tanh(cell))
+        rnn.update_memory(prev_cell, cell)
+        rnn.update_memory(prev_hidden, hidden)
+        rnn.output(hidden)
+    return rnn()
+
+
+def stacked_lstm_net(cfg: StackedLSTMConfig):
+    """Build the classifier; returns (loss, accuracy, prediction).
+    Feeds: words [B, T] int64, label [B, 1] int64, seq_len [B, 1]."""
+    words = layers.data("words", shape=[cfg.max_len], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="int64")
+    seq_len = layers.reshape(
+        layers.data("seq_len", shape=[1], dtype="int64"), (-1,))
+
+    emb = layers.embedding(words, size=(cfg.vocab_size, cfg.emb_dim))
+    x = layers.fc(emb, cfg.lstm_size, num_flatten_dims=2, act="tanh")
+    for _ in range(cfg.num_layers):
+        x = _lstm_layer(x, cfg.lstm_size, seq_len)
+    last = layers.sequence_last_step(x, seq_len=seq_len)
+    logit = layers.fc(last, size=cfg.num_classes, act="softmax")
+    loss = layers.mean(layers.cross_entropy(logit, label))
+    acc = layers.accuracy(input=logit, label=label)
+    return loss, acc, logit
+
+
+def make_fake_batch(cfg: StackedLSTMConfig, batch, seed=0):
+    """Learnable synthetic sentiment: the label is carried by which
+    token range dominates the sentence."""
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, cfg.num_classes, size=(batch, 1))
+    lo = 3 + labels * (cfg.vocab_size // cfg.num_classes // 2)
+    words = (lo + rs.randint(
+        0, cfg.vocab_size // cfg.num_classes // 2,
+        size=(batch, cfg.max_len)))
+    lens = rs.randint(cfg.max_len // 2, cfg.max_len + 1,
+                      size=(batch, 1))
+    return {"words": words.astype(np.int64),
+            "label": labels.astype(np.int64),
+            "seq_len": lens.astype(np.int64)}

@@ -301,3 +301,33 @@ def test_gru_unit_and_lstm_unit_shapes():
     assert nh.shape == (Bs, H_)
     assert h2.shape == (Bs, H_) and c2.shape == (Bs, H_)
     assert np.isfinite(nh).all() and np.isfinite(h2).all()
+
+
+class TestStackedLSTMModel:
+    def test_trains(self):
+        """The fifth fluid_benchmark model family (reference:
+        benchmark/fluid/models/stacked_dynamic_lstm.py) learns the
+        synthetic sentiment task."""
+        import paddle_tpu as fluid
+        from paddle_tpu.models import stacked_lstm as S
+
+        cfg = S.StackedLSTMConfig(vocab_size=64, emb_dim=16,
+                                  lstm_size=16, num_layers=2,
+                                  num_classes=2, max_len=12)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 13
+            with fluid.program_guard(main, startup):
+                loss, acc, _logit = S.stacked_lstm_net(cfg)
+                fluid.optimizer.Adam(5e-3).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for step in range(60):
+                feed = S.make_fake_batch(cfg, 16, seed=step % 4)
+                lv, av = exe.run(main, feed=feed,
+                                 fetch_list=[loss, acc])
+                losses.append(float(lv))
+            assert losses[-1] < losses[0] * 0.5, losses[::10]
+            assert float(av) >= 0.8
