@@ -496,3 +496,43 @@ def test_sentiment_real(data_home):
     assert label == 0  # interleave starts with neg
     assert ids[0] == wd["terrible"]
     assert all(isinstance(i, int) for i in ids)
+
+
+# --- criteo ----------------------------------------------------------------
+
+def test_criteo_real(data_home):
+    from paddle_tpu.dataset import criteo
+
+    d = _module_dir(data_home, "criteo")
+
+    def row(label, ints, cats):
+        fields = ([] if label is None else [str(label)]) \
+            + list(ints) + list(cats)
+        return "\t".join(fields)
+
+    ints1 = ["3", ""] + ["12"] + [""] * 10        # 13 integer fields
+    cats1 = ["abc123"] + ["deadbeef"] * 25        # 26 categoricals
+    ints2 = ["", "7"] + [""] * 11
+    cats2 = ["ffff"] + ["cafe"] * 25
+    (d / "train.txt").write_text(
+        row(1, ints1, cats1) + "\n" + row(0, ints2, cats2) + "\n")
+    # unlabeled test split: 39 fields, no leading label
+    (d / "test.txt").write_text(
+        row(None, ["5", "", "2"] + [""] * 10,
+            ["abc123"] + ["bead"] * 25) + "\n")
+    train = list(criteo.train()())
+    assert len(train) == 2
+    dense, sparse, label = train[0]
+    assert label == 1 and dense.dtype == np.float32
+    np.testing.assert_allclose(dense[0], np.log1p(3.0), rtol=1e-6)
+    assert dense[1] == 0.0  # missing integer -> 0
+    assert sparse.shape == (26,) and sparse.dtype == np.int64
+    assert (sparse >= 0).all() and (sparse < criteo.SPARSE_DIM).all()
+    # same category string hashes identically across rows
+    t2 = train[1]
+    assert t2[2] == 0
+    test_rows = list(criteo.test()())
+    assert len(test_rows) == 1
+    # unlabeled: first field is an integer feature, label defaults 0
+    td, _ts, tl = test_rows[0]
+    assert tl == 0 and abs(td[0] - np.log1p(5.0)) < 1e-6
