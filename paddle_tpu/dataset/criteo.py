@@ -89,19 +89,23 @@ def train():
 def test():
     if common.have_file("criteo", _TEST_FILE):
         # the public test.txt ships unlabeled (39 fields); a
-        # provisioned labeled split (40 fields) works too. Sniff the
-        # first NON-BLANK line and require a clean 0/1 first field so
-        # trailing-trimmed rows can't flip the whole file to
-        # "unlabeled" (which would silently fold labels into dense[0])
+        # provisioned labeled split (40 fields) works too. Sniff over
+        # the first 100 non-blank lines (max field count + 0/1 first
+        # fields) so a single trailing-trimmed or stray-tab row can't
+        # flip the verdict and silently fold labels into dense[0]
         path = common.data_path("criteo", _TEST_FILE)
-        has_label = False
+        max_fields, all_01 = 0, True
         with open(path) as f:
+            seen = 0
             for line in f:
                 if not line.strip():
                     continue
                 parts = line.rstrip("\n").split("\t")
-                has_label = (parts[0].strip() in ("0", "1")
-                             and len(parts) > NUM_DENSE + NUM_SPARSE)
-                break
+                max_fields = max(max_fields, len(parts))
+                all_01 = all_01 and parts[0].strip() in ("0", "1")
+                seen += 1
+                if seen >= 100:
+                    break
+        has_label = all_01 and max_fields > NUM_DENSE + NUM_SPARSE
         return _real_creator(_TEST_FILE, has_label=has_label)
     return _creator(TEST_SIZE, 7_000_000)

@@ -444,3 +444,83 @@ class TestDygraphLRSchedulers:
         assert bs.sort_sum_gradient is False
         bs.sort_sum_gradient = True
         assert bs.sort_sum_gradient
+
+
+def test_dygraph_training_matches_static():
+    """The reference's test_imperative_mnist.py discipline: the SAME
+    model trained N steps in dygraph and in static graph (identical
+    init, identical data, same SGD) must produce the same loss trace
+    and the same final parameters."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import layers
+
+    rng = np.random.RandomState(4)
+    w1 = rng.rand(8, 16).astype(np.float32) * 0.1
+    b1 = np.zeros(16, np.float32)
+    w2 = rng.rand(16, 1).astype(np.float32) * 0.1
+    b2 = np.zeros(1, np.float32)
+    xs = [rng.rand(8, 8).astype(np.float32) for _ in range(5)]
+    ys = [x.sum(1, keepdims=True) * 0.3 for x in xs]
+
+    # static
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 8], append_batch_size=False)
+        y = layers.data("y", shape=[8, 1], append_batch_size=False)
+        init = fluid.initializer.NumpyArrayInitializer
+        h = layers.fc(x, 16, act="relu",
+                      param_attr=fluid.ParamAttr(
+                          name="sw1", initializer=init(w1)),
+                      bias_attr=fluid.ParamAttr(
+                          name="sb1", initializer=init(b1)))
+        pred = layers.fc(h, 1,
+                         param_attr=fluid.ParamAttr(
+                             name="sw2", initializer=init(w2)),
+                         bias_attr=fluid.ParamAttr(
+                             name="sb2", initializer=init(b2)))
+        loss = layers.reduce_mean(
+            layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        static_losses = []
+        for xb, yb in zip(xs, ys):
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            static_losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        static_w1 = np.asarray(scope.find_var("sw1"))
+        static_w2 = np.asarray(scope.find_var("sw2"))
+
+    # dygraph
+    with dygraph.guard():
+        l1 = dnn.Linear(8, 16, act="relu")
+        l2 = dnn.Linear(16, 1)
+        l1.weight.value = jnp.asarray(w1)
+        l1.bias.value = jnp.asarray(b1)
+        l2.weight.value = jnp.asarray(w2)
+        l2.bias.value = jnp.asarray(b2)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        dy_losses = []
+        params = l1.parameters() + l2.parameters()
+        for xb, yb in zip(xs, ys):
+            pred = l2(l1(dygraph.to_variable(xb)))
+            diff = pred - dygraph.to_variable(yb)
+            lv = dygraph.run_dygraph_op(
+                "reduce_mean", {"X": [diff * diff]},
+                {"dim": None, "keep_dim": False, "reduce_all": True})
+            opt.minimize(lv, parameter_list=params)
+            for layer in (l1, l2):
+                layer.clear_gradients()
+            dy_losses.append(float(lv.numpy()))
+        dy_w1 = np.asarray(l1.weight.value)
+        dy_w2 = np.asarray(l2.weight.value)
+
+    np.testing.assert_allclose(dy_losses, static_losses, rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(dy_w1, static_w1, rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(dy_w2, static_w2, rtol=1e-5,
+                               atol=1e-7)
