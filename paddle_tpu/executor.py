@@ -413,6 +413,53 @@ def _needs_eager(program) -> bool:
                for b in program.blocks for op in b.ops)
 
 
+def _check_feed_shape_type(block, feed):
+    """Validate each feed against its declared var (the reference's
+    check_feed_shape_type, executor.py:186): trailing dims must match
+    the declaration (-1 dims are free) and the dtype must safe-cast —
+    otherwise the error surfaces later as a confusing compiler shape
+    mismatch deep inside some op's lowering."""
+    def _dims_match(want, got):
+        return len(got) == len(want) and all(
+            w == -1 or w == g for w, g in zip(want, got))
+
+    for name, val in feed.items():
+        var = block.vars.get(name)
+        if var is None or not var.shape:
+            continue
+        dt = getattr(val, "dtype", None)
+        if dt is None or not hasattr(val, "shape"):
+            # list feeds: ONE coercion serves both shape and dtype
+            # (ndarray/jax.Array feeds never take this branch, so no
+            # device->host copies happen here)
+            val = np.asarray(val)
+            dt = val.dtype
+        got = tuple(val.shape)
+        want = tuple(var.shape)
+        # an EXTRA leading batch dim is the established convention for
+        # BATCH-LESS declarations (data(shape=[4],
+        # append_batch_size=False) fed with [B, 4]); declarations that
+        # already carry a free batch dim must match rank exactly or an
+        # over-ranked feed would slip through the -1
+        ok = _dims_match(want, got) or (
+            want and want[0] != -1
+            and len(got) == len(want) + 1
+            and _dims_match(want, got[1:]))
+        if not ok:
+            raise InvalidArgumentError(
+                "feed %r has shape %s but the program declares %s "
+                "(-1 dims are free; one extra leading batch dim is "
+                "allowed for batch-less declarations)" % (name, got,
+                                                          want))
+        got_dt = np.dtype(str(dt))
+        want_dt = np.dtype(var.dtype)
+        if got_dt != want_dt and not np.can_cast(got_dt, want_dt,
+                                                 casting="same_kind"):
+            raise InvalidArgumentError(
+                "feed %r has dtype %s but the program declares %s"
+                % (name, got_dt, want_dt))
+
+
 class Executor:
     """Drop-in analog of fluid.Executor (executor.py:292)."""
 
@@ -526,6 +573,7 @@ class Executor:
                 if getattr(val, "sharding", None) != want:
                     persist_in[name] = jax.device_put(val, want)
 
+        _check_feed_shape_type(block, feed)
         feed_names = tuple(sorted(feed))
         cache_key = (id(program), program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),

@@ -138,3 +138,86 @@ class TestPruneSubBlocks:
         full = main._prune([dec])
         assert full.blocks[1].ops, "reachable sub-block emptied"
         assert "dec_w" in full.global_block().vars
+
+
+class TestExecutorErrorUX:
+    """The verify-skill probes as regression tests: every user mistake
+    gets a clear, var-named error (reference: executor.py
+    check_feed_shape_type + the enforce idiom)."""
+
+    def _net(self):
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            loss = layers.mean(layers.fc(x, 8))
+        return main, startup, loss
+
+    def test_run_before_startup(self):
+        import numpy as np
+        import paddle_tpu as fluid
+
+        main, _startup, loss = self._net()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            with pytest.raises(Exception,
+                               match="persistable var is not i"):
+                exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                        fetch_list=[loss])
+
+    def test_missing_feed_and_unknown_fetch(self):
+        import numpy as np
+        import paddle_tpu as fluid
+
+        main, startup, loss = self._net()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            with pytest.raises(Exception,
+                               match="missing from feed"):
+                exe.run(main, feed={}, fetch_list=[loss])
+            with pytest.raises(Exception, match="not produced"):
+                exe.run(main,
+                        feed={"x": np.ones((2, 4), np.float32)},
+                        fetch_list=["nope"])
+
+    def test_wrong_feed_shape_names_the_var(self):
+        import numpy as np
+        import paddle_tpu as fluid
+
+        main, startup, loss = self._net()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            with pytest.raises(Exception,
+                               match=r"feed 'x' has shape \(2, 5\)"):
+                exe.run(main, feed={"x": np.ones((2, 5), np.float32)},
+                        fetch_list=[loss])
+            # -1 dims stay free: any batch size passes
+            exe.run(main, feed={"x": np.ones((7, 4), np.float32)},
+                    fetch_list=[loss])
+
+    def test_incompatible_feed_dtype(self):
+        import numpy as np
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", shape=[3], dtype="int64")
+            emb = layers.embedding(ids, size=(10, 4))
+            loss = layers.mean(emb)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            # float feed into an int64 ids var is NOT same-kind
+            with pytest.raises(Exception, match="dtype"):
+                exe.run(main,
+                        feed={"ids": np.ones((2, 3), np.float32)},
+                        fetch_list=[loss])
