@@ -39,6 +39,12 @@ from . import contrib  # noqa: F401
 from . import install_check  # noqa: F401
 from . import profiler  # noqa: F401
 from . import dygraph  # noqa: F401
+from . import average  # noqa: F401
+from .average import WeightedAverage  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .framework import (cpu_places, cuda_pinned_places,  # noqa: F401
+                        cuda_places)
+from .initializer import force_init_on_cpu, init_on_cpu  # noqa: F401
 from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         default_main_program, default_startup_program,
                         name_scope, program_guard)

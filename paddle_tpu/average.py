@@ -1,0 +1,44 @@
+"""Host-side weighted running average.
+
+Reference: python/paddle/fluid/average.py — WeightedAverage is a pure
+Python accumulator (deprecated upstream in favor of fluid.metrics, but
+part of the public surface)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    """Reference average.py:40 — add(value, weight), eval()."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        value = np.asarray(value, np.float64)
+        if value.size != 1:
+            raise ValueError(
+                "WeightedAverage.add expects a scalar value, got "
+                "shape %s" % (value.shape,))
+        v = float(value.reshape(()))
+        w = float(weight)
+        if self.numerator is None:
+            self.numerator = v * w
+            self.denominator = w
+        else:
+            self.numerator += v * w
+            self.denominator += w
+
+    def eval(self):
+        if self.numerator is None or self.denominator == 0.0:
+            raise ValueError(
+                "WeightedAverage has no accumulated values (add "
+                "something before eval)")
+        return self.numerator / self.denominator

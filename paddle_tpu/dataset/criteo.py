@@ -94,28 +94,33 @@ def test():
         # first integer feature is often 0/1 too, and preprocessors
         # may trim trailing empty fields), so: explicit override via
         # PADDLE_TPU_CRITEO_TEST_LABELED=0/1 wins; otherwise the
-        # verdict needs BOTH signals over the first 100 non-blank
-        # lines — some full-width (40-field) row exists AND a majority
-        # of first fields are a clean 0/1
+        # verdict needs BOTH majorities over the first 100 non-blank
+        # lines — most rows full-width (40 fields) AND most first
+        # fields a clean 0/1
         import os
         forced = os.environ.get("PADDLE_TPU_CRITEO_TEST_LABELED")
         if forced is not None:
             return _real_creator(_TEST_FILE,
                                  has_label=forced == "1")
         path = common.data_path("criteo", _TEST_FILE)
-        votes_01, seen, max_fields = 0, 0, 0
+        votes_01, votes_full, seen = 0, 0, 0
         with open(path) as f:
             for line in f:
                 if not line.strip():
                     continue
                 parts = line.rstrip("\n").split("\t")
-                max_fields = max(max_fields, len(parts))
+                if len(parts) > NUM_DENSE + NUM_SPARSE:
+                    votes_full += 1
                 if parts[0].strip() in ("0", "1"):
                     votes_01 += 1
                 seen += 1
                 if seen >= 100:
                     break
+        # BOTH majorities required: a single stray-tab or trimmed row
+        # can't flip the verdict in either direction (trailing-trimmed
+        # labeled files vote unlabeled — that's what the env override
+        # above is for)
         has_label = (seen > 0 and votes_01 * 2 >= seen
-                     and max_fields > NUM_DENSE + NUM_SPARSE)
+                     and votes_full * 2 >= seen)
         return _real_creator(_TEST_FILE, has_label=has_label)
     return _creator(TEST_SIZE, 7_000_000)

@@ -838,3 +838,38 @@ def name_scope(prefix):
         yield
     finally:
         _name_scope_stack.pop()
+
+
+def cpu_places(device_count=None):
+    """List of CPUPlace (reference framework.py:153: CPU_NUM env, else
+    one per core — here one entry per requested slot; the Executor
+    targets whatever backend JAX sees either way)."""
+    import multiprocessing
+    import os
+
+    from .core import CPUPlace
+    if device_count is None:
+        device_count = int(os.environ.get(
+            "CPU_NUM", multiprocessing.cpu_count()))
+    return [CPUPlace()] * device_count
+
+
+def cuda_places(device_ids=None):
+    """One Place per visible ACCELERATOR device (reference
+    framework.py:112 — FLAGS_selected_gpus / all visible devices; the
+    TPU analog enumerates jax.devices())."""
+    import jax
+
+    from .core import CUDAPlace
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [CUDAPlace(int(i)) for i in device_ids]
+
+
+def cuda_pinned_places(device_count=None):
+    """Host staging places (reference framework.py:182); host memory
+    is uniform here, so these mirror cpu_places."""
+    from .core import CUDAPinnedPlace
+    if device_count is None:
+        return [CUDAPinnedPlace()]
+    return [CUDAPinnedPlace()] * device_count

@@ -7,6 +7,7 @@ XavierInitializer, MSRAInitializer, NumpyArrayInitializer).
 
 from __future__ import annotations
 
+import contextlib
 import math
 
 import numpy as np
@@ -162,3 +163,27 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    """Reference initializer.py:34. Initializers here always run
+    host-side numpy before the first device transfer, so this flag is
+    informational — it reports the requested mode."""
+    return _force_init_on_cpu_
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """Reference initializer.py:53 — a scope requesting CPU-side
+    parameter init (the permanent behavior of this framework's
+    numpy-based initializers)."""
+    global _force_init_on_cpu_
+    prev = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = prev

@@ -1,0 +1,53 @@
+"""ParallelExecutor compatibility facade.
+
+Reference: python/paddle/fluid/parallel_executor.py:45 — the 1.x
+multi-device driver users constructed directly. The TPU-native
+machinery is CompiledProgram.with_data_parallel (GSPMD shardings over
+the mesh); this class keeps the old construct-and-run UX on top of
+it."""
+
+from __future__ import annotations
+
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .executor import Executor
+from .framework import default_main_program
+
+__all__ = ["ParallelExecutor"]
+
+
+class ParallelExecutor:
+    """Reference parallel_executor.py:45 (use_cuda maps to "use the
+    accelerator mesh" — ignored; XLA owns placement)."""
+
+    def __init__(self, use_cuda=True, loss_name=None,
+                 main_program=None, share_vars_from=None,
+                 exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        del use_cuda, num_trainers, trainer_id
+        # the reference's share_vars_from shares per-device local
+        # scopes; here parameters live in ONE scope, so sharing means
+        # running against the other executor's scope
+        if scope is None and share_vars_from is not None:
+            scope = getattr(share_vars_from, "_scope", None)
+        self._scope = scope
+        main_program = main_program or default_main_program()
+        self._compiled = CompiledProgram(main_program)
+        self._compiled.with_data_parallel(
+            loss_name=loss_name,
+            build_strategy=build_strategy or BuildStrategy(),
+            exec_strategy=exec_strategy or ExecutionStrategy())
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        """Reference parallel_executor.py run():181 (feed_dict is the
+        deprecated alias)."""
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=fetch_list,
+                             scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """Scope lifetime is XLA-managed; kept for API parity
+        (reference parallel_executor.py:227)."""

@@ -81,3 +81,72 @@ def test_install_check(capsys):
     fluid.install_check.run_check()
     out = capsys.readouterr().out
     assert "installed successfully" in out
+
+
+class TestTopLevelSurface:
+    def test_places(self):
+        import paddle_tpu as fluid
+
+        cpus = fluid.cpu_places(3)
+        assert len(cpus) == 3
+        devs = fluid.cuda_places()
+        assert len(devs) >= 1
+        assert fluid.cuda_pinned_places(2)
+
+    def test_weighted_average(self):
+        import pytest
+
+        import paddle_tpu as fluid
+
+        avg = fluid.WeightedAverage()
+        avg.add(value=2.0, weight=1)
+        avg.add(value=4.0, weight=2)
+        assert abs(avg.eval() - 10.0 / 3.0) < 1e-9
+        avg.reset()
+        with pytest.raises(ValueError):
+            avg.eval()
+
+    def test_init_on_cpu_context(self):
+        import paddle_tpu as fluid
+
+        assert not fluid.force_init_on_cpu()
+        with fluid.init_on_cpu():
+            assert fluid.force_init_on_cpu()
+        assert not fluid.force_init_on_cpu()
+
+    def test_parallel_executor_facade(self):
+        import numpy as np
+
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 6
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", shape=[8, 4],
+                                append_batch_size=False)
+                y = layers.data("y", shape=[8, 1],
+                                append_batch_size=False)
+                loss = layers.reduce_mean(
+                    layers.square_error_cost(
+                        input=layers.fc(x, 1), label=y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            fluid.Executor().run(startup)
+            pe = fluid.ParallelExecutor(use_cuda=False,
+                                        loss_name=loss.name,
+                                        main_program=main,
+                                        scope=scope)
+            rs = np.random.RandomState(0)
+            xb = rs.rand(8, 4).astype(np.float32)
+            yb = xb.sum(1, keepdims=True).astype(np.float32) * 0.5
+            first = last = None
+            for _ in range(12):
+                (lv,) = pe.run([loss.name],
+                               feed={"x": xb, "y": yb})
+                v = float(np.asarray(lv).reshape(-1)[0])
+                first = first if first is not None else v
+                last = v
+            assert last < first * 0.5, (first, last)
+            pe.drop_local_exe_scopes()

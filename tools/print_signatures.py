@@ -23,6 +23,7 @@ PUBLIC_MODULES = [
     "paddle_tpu",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
+    "paddle_tpu.average",
     "paddle_tpu.backward",
     "paddle_tpu.io",
     "paddle_tpu.metrics",
