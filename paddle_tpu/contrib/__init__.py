@@ -1,11 +1,16 @@
 """Contrib namespace (reference: python/paddle/fluid/contrib/)."""
 
+from . import extend_optimizer  # noqa: F401
+from . import layers  # noqa: F401
 from . import memory_usage_calc  # noqa: F401
 from . import mixed_precision  # noqa: F401
 from . import model_stat  # noqa: F401
 from . import op_frequence  # noqa: F401
+from . import reader  # noqa: F401
 from . import slim  # noqa: F401
 from . import utils  # noqa: F401
+from .extend_optimizer import (  # noqa: F401
+    extend_with_decoupled_weight_decay)
 from .inferencer import Inferencer  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401

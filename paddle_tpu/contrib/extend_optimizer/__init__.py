@@ -1,0 +1,101 @@
+"""Decoupled weight decay as an optimizer-class factory.
+
+Reference: contrib/extend_optimizer/extend_optimizer_with_weight_decay
+.py — ``extend_with_decoupled_weight_decay(OptimizerClass)`` returns a
+subclass whose minimize() additionally applies
+``param -= coeff * param_old`` AFTER the optimizer update, using the
+PRE-UPDATE parameter values (AdamW-style decoupling for any base
+optimizer)."""
+
+from __future__ import annotations
+
+from ... import optimizer as _optimizer
+from ...framework import Variable
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Reference extend_optimizer_with_weight_decay.py:107."""
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, _optimizer.Optimizer)):
+        raise TypeError(
+            "extend_with_decoupled_weight_decay needs an Optimizer "
+            "subclass, got %r" % (base_optimizer,))
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        def __init__(self, *args, coeff=0.0,
+                     apply_decay_param_fun=None, **kwargs):
+            if not isinstance(coeff, (float, Variable)):
+                raise TypeError("coeff should be float or Variable.")
+            self._coeff = coeff
+            self._apply_decay_param_fun = apply_decay_param_fun
+            super().__init__(*args, **kwargs)
+
+        def _wants_decay(self, name):
+            if isinstance(self._coeff, float) and self._coeff == 0.0:
+                return False
+            return (self._apply_decay_param_fun is None
+                    or self._apply_decay_param_fun(name))
+
+        def _scaled(self, param):
+            from ... import layers
+
+            if isinstance(self._coeff, float):
+                return layers.scale(param, scale=self._coeff)
+            # Variable coeff (e.g. a schedule output): attrs must be
+            # trace-time constants, so multiply in-graph instead
+            return layers.elementwise_mul(param, self._coeff)
+
+        def minimize(self, loss, startup_program=None,
+                     parameter_list=None, no_grad_set=None,
+                     grad_clip=None, accumulate_steps=None):
+            from ... import dygraph, layers
+
+            if dygraph.enabled():
+                # eager: snapshot pre-update values, let the base
+                # optimizer update, then apply the decoupled decay
+                import jax.numpy as jnp
+                params = parameter_list or []
+                snaps = [(p, p.value) for p in params
+                         if self._wants_decay(p.name)]
+                out = super().minimize(
+                    loss, startup_program=startup_program,
+                    parameter_list=parameter_list,
+                    no_grad_set=no_grad_set, grad_clip=grad_clip,
+                    accumulate_steps=accumulate_steps)
+                coeff = (self._coeff if isinstance(self._coeff, float)
+                         else float(jnp.asarray(
+                             self._coeff.value)))
+                for p, pre in snaps:
+                    p.value = p.value - coeff * pre
+                return out
+
+            # snapshot pre-update params so the decay decouples from
+            # the optimizer update (reference takes param * coeff
+            # BEFORE apply_optimize, :60-64)
+            params_grads = self.backward(
+                loss, startup_program=startup_program,
+                parameter_list=parameter_list,
+                no_grad_set=no_grad_set)
+            scaled = []
+            for param, grad in params_grads:
+                if grad is None or not self._wants_decay(param.name):
+                    continue
+                scaled.append((param, self._scaled(param)))
+            if grad_clip is not None:
+                from ...clip import append_gradient_clip_ops
+                params_grads = append_gradient_clip_ops(params_grads,
+                                                        grad_clip)
+            if accumulate_steps is not None:
+                self._accumulate_steps = int(accumulate_steps)
+            out = self.apply_gradients(params_grads)
+            for param, scaled_param in scaled:
+                layers.assign(
+                    layers.elementwise_sub(param, scaled_param),
+                    output=param)
+            return out, params_grads
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        base_optimizer.__name__ + "WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

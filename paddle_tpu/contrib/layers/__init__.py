@@ -1,0 +1,5 @@
+"""Contrib layers (reference: contrib/layers/nn.py)."""
+
+from .nn import fused_elemwise_activation  # noqa: F401
+
+__all__ = ["fused_elemwise_activation"]

@@ -1,0 +1,40 @@
+"""Contrib nn layers.
+
+Reference: contrib/layers/nn.py — ``fused_elemwise_activation``
+exposes the fused binary+unary op the fusion pass emits, for users
+composing it by hand."""
+
+from __future__ import annotations
+
+from ...layer_helper import LayerHelper
+
+__all__ = ["fused_elemwise_activation"]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """Reference contrib/layers/nn.py:29. ``scale`` parameterizes the
+    "scale" functor only (the reference's contract);
+    save_intermediate_out is accepted for parity — the
+    one-XLA-program executor keeps no intermediate buffers either
+    way."""
+    del save_intermediate_out
+    if not isinstance(functor_list, (list, tuple)) \
+            or len(functor_list) != 2:
+        raise ValueError(
+            "functor_list must be [binary_fn, unary_fn], e.g. "
+            "['elementwise_add', 'relu']")
+    if scale and "scale" not in functor_list:
+        raise ValueError(
+            "scale=%r only applies when functor_list contains the "
+            "'scale' functor (e.g. ['elementwise_add', 'scale'])"
+            % (scale,))
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {"functor_list": list(functor_list), "axis": axis}
+    if scale and "scale" in functor_list:
+        attrs["act_attrs"] = {"scale": scale}
+    helper.append_op(type="fused_elemwise_activation",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out

@@ -1,0 +1,9 @@
+"""Contrib readers (reference: contrib/reader/).
+
+``ctr_reader`` (a reader op pulling batches from a remote CTR data
+service) is vendor infrastructure the Dataset/`dataset_factory` path
+replaces; ``distributed_batch_reader`` carries over."""
+
+from .distributed_reader import distributed_batch_reader  # noqa: F401
+
+__all__ = ["distributed_batch_reader"]

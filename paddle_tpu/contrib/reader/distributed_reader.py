@@ -1,0 +1,30 @@
+"""Shard a batch reader across trainers.
+
+Reference: contrib/reader/distributed_reader.py —
+``distributed_batch_reader(reader)`` keeps every
+``num_trainers``-th batch for this trainer (ids from the PADDLE_*
+env), so N trainers consume disjoint batch streams from identical
+readers."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Reference distributed_reader.py:20."""
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if trainer_id >= trainers:
+        raise ValueError(
+            "PADDLE_TRAINER_ID (%d) must be < PADDLE_TRAINERS_NUM "
+            "(%d)" % (trainer_id, trainers))
+
+    def reader():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return reader

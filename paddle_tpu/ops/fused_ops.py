@@ -21,6 +21,7 @@ _UNARY = {
     "tanh": lambda x, **kw: jnp.tanh(x),
     "gelu": lambda x, **kw: jax.nn.gelu(x, **kw),
     "identity": lambda x, **kw: x,
+    "scale": lambda x, scale=1.0, **kw: x * scale,
     "": lambda x, **kw: x,
 }
 

@@ -357,3 +357,130 @@ class TestSimpleConvSpace:
             alt[0] = (alt[0] + 1) % rng[0]
             main2 = sp.create_net(alt)[0]
             assert main2.global_block().ops
+
+
+class TestContribExtras:
+    def test_extend_with_decoupled_weight_decay(self):
+        """AdamW-style decoupling generated for ANY optimizer
+        (reference extend_optimizer_with_weight_decay.py): the decay
+        uses PRE-update params; coeff=0 is the base optimizer."""
+        from paddle_tpu.contrib import (
+            extend_with_decoupled_weight_decay)
+
+        SGDW = extend_with_decoupled_weight_decay(
+            fluid.optimizer.SGD)
+        assert "WithDecoupledWeightDecay" in SGDW.__name__
+        with pytest.raises(TypeError):
+            extend_with_decoupled_weight_decay("not a class")
+
+        w0 = np.full((4, 1), 2.0, np.float32)
+
+        def run(coeff):
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    x = layers.data("x", shape=[4, 4],
+                                    append_batch_size=False)
+                    init = fluid.initializer.NumpyArrayInitializer
+                    pred = layers.fc(
+                        x, 1, bias_attr=False,
+                        param_attr=fluid.ParamAttr(
+                            name="w", initializer=init(w0)))
+                    loss = layers.reduce_mean(pred)
+                    SGDW(learning_rate=0.1, coeff=coeff).minimize(
+                        loss)
+                exe = fluid.Executor()
+                exe.run(startup)
+                exe.run(main,
+                        feed={"x": np.ones((4, 4), np.float32)},
+                        fetch_list=[loss])
+                return np.asarray(scope.find_var("w")).copy()
+
+        base = run(0.0)
+        decayed = run(0.1)
+        # decoupled: w_decayed = w_base - coeff * w_pre_update
+        np.testing.assert_allclose(decayed, base - 0.1 * w0,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fused_elemwise_activation_layer(self):
+        from paddle_tpu.contrib import layers as clayers
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = layers.data("a", shape=[3])
+            b = layers.data("b", shape=[3])
+            out = clayers.fused_elemwise_activation(
+                a, b, ["elementwise_add", "relu"])
+            scaled = clayers.fused_elemwise_activation(
+                a, b, ["elementwise_add", "scale"], scale=0.5)
+            with pytest.raises(ValueError):
+                clayers.fused_elemwise_activation(a, b, ["relu"])
+            # scale only parameterizes the 'scale' functor
+            with pytest.raises(ValueError, match="scale"):
+                clayers.fused_elemwise_activation(
+                    a, b, ["elementwise_add", "relu"], scale=0.5)
+        exe = fluid.Executor()
+        exe.run(startup)
+        av = np.array([[1.0, -5.0, 2.0]], np.float32)
+        bv = np.array([[1.0, 2.0, -4.0]], np.float32)
+        got, got_scaled = exe.run(main, feed={"a": av, "b": bv},
+                                  fetch_list=[out, scaled])
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.maximum(av + bv, 0.0))
+        np.testing.assert_allclose(np.asarray(got_scaled),
+                                   (av + bv) * 0.5)
+
+    def test_decoupled_decay_dygraph_and_clip(self):
+        """The factory composes with dygraph mode and grad_clip (the
+        base-optimizer surface it must not narrow)."""
+        from paddle_tpu import dygraph
+        from paddle_tpu.contrib import (
+            extend_with_decoupled_weight_decay)
+
+        SGDW = extend_with_decoupled_weight_decay(
+            fluid.optimizer.SGD)
+        # dygraph: decay applies on pre-update values eagerly
+        with dygraph.guard():
+            import jax.numpy as jnp
+            lin = dygraph.Linear(3, 1)
+            lin.weight.value = jnp.ones((3, 1), jnp.float32)
+            lin.bias.value = jnp.zeros((1,), jnp.float32)
+            opt = SGDW(learning_rate=0.0, coeff=0.1)
+            x = dygraph.to_variable(np.ones((2, 3), np.float32))
+            d = lin(x)
+            loss = dygraph.run_dygraph_op(
+                "reduce_mean", {"X": [d * d]},
+                {"dim": None, "keep_dim": False, "reduce_all": True})
+            opt.minimize(loss, parameter_list=lin.parameters())
+            # lr=0 -> pure decay: w <- w - 0.1 * w_pre
+            np.testing.assert_allclose(np.asarray(lin.weight.value),
+                                       np.full((3, 1), 0.9),
+                                       rtol=1e-6)
+        # static: grad_clip passes through
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                xv = layers.data("x", shape=[3])
+                loss = layers.reduce_mean(layers.fc(xv, 1))
+                SGDW(learning_rate=0.1, coeff=1e-3).minimize(
+                    loss,
+                    grad_clip=fluid.clip.GradientClipByGlobalNorm(
+                        1.0))
+            exe = fluid.Executor()
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                    fetch_list=[loss])
+
+    def test_distributed_batch_reader(self, monkeypatch):
+        from paddle_tpu.contrib.reader import (
+            distributed_batch_reader)
+
+        src = lambda: iter(range(10))
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        assert list(distributed_batch_reader(src)()) == [1, 4, 7]
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "5")
+        with pytest.raises(ValueError):
+            distributed_batch_reader(src)
