@@ -2262,3 +2262,48 @@ def test_op_bench_harness():
     assert libs == {"base", "pallas"}
     assert sum(r["best"] for r in res) == 1
     assert all(r["us_per_call"] > 0 for r in res)
+
+
+# --- backend-variant rerun (SURVEY §4 item 9: the unittests/mkldnn +
+# unittests/ngraph pattern — re-run the SAME numeric specs with the
+# alternate kernel library selected) ----------------------------------------
+
+def _variant_cases():
+    from paddle_tpu import ops as _ops
+
+    cases = []
+    for op_type in sorted(_ops.all_op_types()):
+        for lib in sorted(_ops.get(op_type).variants):
+            for i, (inputs, attrs, opt) in enumerate(
+                    SPECS.get(op_type, [])):
+                cases.append(pytest.param(
+                    op_type, lib, inputs, attrs, opt,
+                    id="%s-%s-%d" % (op_type, lib, i)))
+    return cases
+
+
+@pytest.mark.parametrize("op_type,lib,inputs,attrs,opt",
+                         _variant_cases())
+def test_op_variant(op_type, lib, inputs, attrs, opt):
+    """Every registered kernel VARIANT must pass the op's own numeric
+    spec — same refs, same finite-difference grads, alternate
+    lowering."""
+    from paddle_tpu.core.flags import FLAGS
+
+    prev = FLAGS.op_library
+    FLAGS.op_library = "%s:%s" % (op_type, lib)
+    try:
+        test_op(op_type, inputs, attrs, opt)
+    finally:
+        FLAGS.op_library = prev
+
+
+def test_every_variant_op_is_spec_covered():
+    """A new pallas variant without a sweep spec would silently skip
+    the variant rerun — ratchet it."""
+    from paddle_tpu import ops as _ops
+
+    missing = [t for t in _ops.all_op_types()
+               if _ops.get(t).variants and t not in SPECS]
+    assert not missing, (
+        "ops with kernel variants but no sweep spec: %s" % missing)
