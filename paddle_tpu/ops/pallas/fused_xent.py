@@ -17,8 +17,15 @@ gradient) instead of logits + softmax + dlogits.
 Grid layout: vocab-major ``(nvj, ni)`` so each W block ([D, bv]) loads
 once total while X row blocks re-stream per vocab block — W is the
 big operand (D*V), X the small one (N*D), so this order minimizes HBM
-traffic. Running statistics live in full-length [N, 1] VMEM scratch
-indexed by row offset.
+traffic.
+
+Rows ride the LANE axis everywhere outside the matmul: TPU VMEM tiles
+are (8, 128), so a ``[N, 1]`` f32 buffer is lane-padded 128x (8 MB at
+N=16k — the scoped-VMEM OOM observed on chip in round 4). Running
+statistics therefore live in ``(ni, bn)`` scratch indexed ``(1, bn)``
+per row block, the logits block is computed TRANSPOSED ``[bv, bn]``
+(``dot_general`` contracting D on both operands), and all row
+reductions are axis-0 — lane-major stats with no relayouts.
 """
 
 from __future__ import annotations
@@ -35,82 +42,87 @@ from .common import blk, interpret_mode
 
 
 def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
-                m_sc, z_sc, s_sc, p_sc, *, V, eps, nvj, bn):
+                m_sc, z_sc, s_sc, p_sc, *, V, eps, nvj):
     j = pl.program_id(0)
     i = pl.program_id(1)
-    rows = pl.ds(i * bn, bn)
+    row = (pl.ds(i, 1), slice(None))     # (1, bn) stats slice
 
-    logits = jnp.dot(x_ref[:], w_ref[:],
-                     preferred_element_type=jnp.float32)   # [bn, bv]
-    bv = logits.shape[1]
-    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + j * bv
+    # transposed block [bv, bn]: contract D of w [D, bv] with D of
+    # x [bn, D] so rows land on lanes and every reduction is axis-0
+    logits = jax.lax.dot_general(
+        w_ref[:], x_ref[:], (((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [bv, bn]
+    bv = logits.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0) + j * bv
     valid = col < V                      # mask the padded vocab tail
 
     @pl.when(j == 0)
     def _init():
-        m_sc[rows] = jnp.full((bn, 1), -jnp.inf, jnp.float32)
-        z_sc[rows] = jnp.zeros((bn, 1), jnp.float32)
-        s_sc[rows] = jnp.zeros((bn, 1), jnp.float32)
-        p_sc[rows] = jnp.zeros((bn, 1), jnp.float32)
+        bn = logits.shape[1]
+        m_sc[row] = jnp.full((1, bn), -jnp.inf, jnp.float32)
+        z_sc[row] = jnp.zeros((1, bn), jnp.float32)
+        s_sc[row] = jnp.zeros((1, bn), jnp.float32)
+        p_sc[row] = jnp.zeros((1, bn), jnp.float32)
 
-    m_old = m_sc[rows]
-    blk_max = jnp.max(jnp.where(valid, logits, -jnp.inf), axis=1,
+    m_old = m_sc[row]
+    blk_max = jnp.max(jnp.where(valid, logits, -jnp.inf), axis=0,
                       keepdims=True)
     m_new = jnp.maximum(m_old, blk_max)
     e = jnp.where(valid, jnp.exp(logits - m_new), 0.0)
-    z_sc[rows] = z_sc[rows] * jnp.exp(m_old - m_new) \
-        + jnp.sum(e, axis=1, keepdims=True)
-    m_sc[rows] = m_new
-    s_sc[rows] = s_sc[rows] + jnp.sum(jnp.where(valid, logits, 0.0),
-                                      axis=1, keepdims=True)
-    lab = lab_ref[:]                                       # [bn, 1]
-    p_sc[rows] = p_sc[rows] + jnp.sum(
-        jnp.where(col == lab, logits, 0.0), axis=1, keepdims=True)
+    z_sc[row] = z_sc[row] * jnp.exp(m_old - m_new) \
+        + jnp.sum(e, axis=0, keepdims=True)
+    m_sc[row] = m_new
+    s_sc[row] = s_sc[row] + jnp.sum(jnp.where(valid, logits, 0.0),
+                                    axis=0, keepdims=True)
+    lab = lab_ref[:]                                       # [1, bn]
+    p_sc[row] = p_sc[row] + jnp.sum(
+        jnp.where(col == lab, logits, 0.0), axis=0, keepdims=True)
 
     @pl.when(j == nvj - 1)
     def _finish():
-        lse = m_sc[rows] + jnp.log(z_sc[rows])
+        lse = m_sc[row] + jnp.log(z_sc[row])
         lse_ref[:] = lse
         # loss = lse - (1-eps)*logit[y] - eps/V * sum(logits)
-        loss_ref[:] = (lse - (1.0 - eps) * p_sc[rows]
-                       - (eps / V) * s_sc[rows])
+        loss_ref[:] = (lse - (1.0 - eps) * p_sc[row]
+                       - (eps / V) * s_sc[row])
 
 
 def _fwd_call(x2, w, lab2, eps):
     N, D = x2.shape
     V = w.shape[-1]
     bn = blk(N, 512)
+    ni = N // bn
     bv = min(2048, -(-V // 128) * 128)
     nvj = -(-V // bv)
     Vp = nvj * bv
     if Vp > V:
         w = jnp.pad(w, ((0, 0), (0, Vp - V)))
-    kernel = functools.partial(_fwd_kernel, V=V, eps=eps, nvj=nvj,
-                               bn=bn)
+    lab_row = lab2.reshape(1, N)
+    kernel = functools.partial(_fwd_kernel, V=V, eps=eps, nvj=nvj)
     loss, lse = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((N, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((N, 1), jnp.float32)),
-        grid=(nvj, N // bn),
+        out_shape=(jax.ShapeDtypeStruct((ni, bn), jnp.float32),
+                   jax.ShapeDtypeStruct((ni, bn), jnp.float32)),
+        grid=(nvj, ni),
         in_specs=[pl.BlockSpec((bn, D), lambda j, i: (i, 0),
                                memory_space=pltpu.VMEM),
                   pl.BlockSpec((D, bv), lambda j, i: (0, j),
                                memory_space=pltpu.VMEM),
-                  pl.BlockSpec((bn, 1), lambda j, i: (i, 0),
+                  pl.BlockSpec((1, bn), lambda j, i: (0, i),
                                memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec((bn, 1), lambda j, i: (i, 0),
+        out_specs=(pl.BlockSpec((1, bn), lambda j, i: (i, 0),
                                 memory_space=pltpu.VMEM),
-                   pl.BlockSpec((bn, 1), lambda j, i: (i, 0),
+                   pl.BlockSpec((1, bn), lambda j, i: (i, 0),
                                 memory_space=pltpu.VMEM)),
-        scratch_shapes=[pltpu.VMEM((N, 1), jnp.float32)] * 4,
+        scratch_shapes=[pltpu.VMEM((ni, bn), jnp.float32)] * 4,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=2 * N * D * Vp, transcendentals=N * Vp,
             bytes_accessed=(N * D * nvj + D * Vp) * x2.dtype.itemsize),
         interpret=interpret_mode(),
-    )(x2, w, lab2)
-    return loss, lse
+    )(x2, w, lab_row)
+    return loss.reshape(N, 1), lse.reshape(N, 1)
 
 
 @functools.lru_cache(maxsize=None)
@@ -147,7 +159,8 @@ def fused_linear_xent_pallas(x, w, label, *, epsilon=0.0):
     N = 1
     for d in x.shape[:-1]:
         N *= d
-    # full-length [N, 1] f32 running statistics must fit VMEM scratch
+    # four (ni, bn) f32 running-stat buffers (N packed along lanes,
+    # 4 bytes/row each) must fit VMEM scratch
     if N * 16 > (2 << 20):
         return get("fused_linear_xent").fn(x, w, label,
                                            epsilon=epsilon)
