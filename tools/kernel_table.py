@@ -20,6 +20,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -75,48 +76,69 @@ def main(argv=None):
 
     from op_bench import bench_op
 
+    def emit(r):
+        # stream each row the moment it's measured: a wedged compile
+        # (observed on-chip round 4: one bad variant hung the remote
+        # compile helper 800s) then costs only the tail of the table,
+        # never the rows already on stdout
+        if args.json:
+            print(json.dumps(r), flush=True)
+        elif "error" in r:
+            print("| %s | ERROR %s | | | |" % (r["op"], r["error"]),
+                  flush=True)
+        else:
+            print("| %s | %.3f | %.3f | %.2fx | %s |"
+                  % (r["op"], r["base_ms"], r["pallas_ms"],
+                     r["speedup"], r["winner"]), flush=True)
+
+    if not args.json:
+        print("| op | base (XLA) ms | pallas ms | speedup | winner |")
+        print("|---|---|---|---|---|")
+
+    try:
+        stall_s = float(os.environ.get("KERNEL_TABLE_STALL_S", 360))
+    except (TypeError, ValueError):
+        stall_s = 360.0
+
     rs = np.random.RandomState(0)
-    rows = []
     only = set(args.only.split(",")) if args.only else None
     for case in CASES:
         op, mk, attrs, grad = case[:4]
         out_index = case[4] if len(case) > 4 else 0
         if only and op not in only:
             continue
+
+        def stalled(op=op):
+            emit({"op": op, "error": "stalled >%.0fs (wedged compile?)"
+                  % stall_s})
+            os._exit(2)
+
+        guard = threading.Timer(stall_s, stalled)
+        guard.daemon = True
+        guard.start()
         try:
             results = bench_op(op, mk(rs), attrs, iters=args.iters,
                                warmup=10, grad=grad,
                                out_index=out_index)
         except Exception as e:  # keep the table going per-op
-            rows.append({"op": op, "error": repr(e)})
+            emit({"op": op, "error": repr(e)})
             continue
+        finally:
+            guard.cancel()
         by_lib = {r["library"]: r for r in results}
         base = by_lib.get("base")
         pallas = by_lib.get("pallas")
         if not base or not pallas:
-            rows.append({"op": op, "error": "missing variant: %s"
-                         % sorted(by_lib)})
+            emit({"op": op, "error": "missing variant: %s"
+                  % sorted(by_lib)})
             continue
         b_ms = base["us_per_call"] / 1e3
         p_ms = pallas["us_per_call"] / 1e3
         speedup = b_ms / p_ms if p_ms else 0.0
-        rows.append({"op": op, "base_ms": round(b_ms, 3),
-                     "pallas_ms": round(p_ms, 3),
-                     "speedup": round(speedup, 3),
-                     "winner": "pallas" if speedup > 1.0 else "xla"})
-    if args.json:
-        for r in rows:
-            print(json.dumps(r), flush=True)
-        return
-    print("| op | base (XLA) ms | pallas ms | speedup | winner |")
-    print("|---|---|---|---|---|")
-    for r in rows:
-        if "error" in r:
-            print("| %s | ERROR %s | | | |" % (r["op"], r["error"]))
-        else:
-            print("| %s | %.3f | %.3f | %.2fx | %s |"
-                  % (r["op"], r["base_ms"], r["pallas_ms"],
-                     r["speedup"], r["winner"]))
+        emit({"op": op, "base_ms": round(b_ms, 3),
+              "pallas_ms": round(p_ms, 3),
+              "speedup": round(speedup, 3),
+              "winner": "pallas" if speedup > 1.0 else "xla"})
 
 
 if __name__ == "__main__":
