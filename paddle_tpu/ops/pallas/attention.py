@@ -347,7 +347,11 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
     Sk = k.shape[2]
     BH = B * H
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
-    G = blk(H, 8)
+    # the bwd streams 6 (G, blk, Dh) operands + 2 outputs + 2 scratch;
+    # with Dh<=64 lane-padded to 128, G=8 at f32 models ~18 MB and
+    # trips the v5e 16 MB scoped-VMEM limit (tests/test_pallas_vmem.py)
+    # — halve the (batch,head) rows per grid cell for 4-byte dtypes
+    G = blk(H, 8 if q.dtype.itemsize <= 2 else 4)
     hb = H // G
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
