@@ -144,25 +144,82 @@ def _device_feed(feed):
     return {k: jnp.asarray(v) for k, v in feed.items()}
 
 
-def _timed_loop(run_step, warmup, iters):
-    """Warmup-excluded protocol (BASELINE.md): first run compiles.
+def _timed_loop(run_steps, warmup, iters):
+    """In-graph repetition protocol: ``run_steps(k)`` executes k
+    consecutive train steps inside ONE compiled dispatch
+    (Executor.run_repeated lax.scan) and returns the last step's
+    fetches as numpy — that conversion is the single honest
+    device->host sync.
 
-    Steps dispatch asynchronously and sync ONCE at the end — fetching
-    per step would measure host<->device round-trip latency, not chip
-    throughput (the reference's FLAGS_benchmark per-op sync exists for
-    exactly this reason, operator.cc:946-948: sync only when asked)."""
-    import jax
-    out = run_step()
-    for _ in range(max(warmup - 1, 0)):
-        out = run_step()
+    Round 4 on-chip forensics killed the old host-loop protocol: the
+    axon tunnel's block_until_ready returns EARLY (a no-op sync), and
+    chained per-step dispatches serialize on 50-1500 ms of handle
+    RTT — the round-2/4 numbers measured the tunnel, not the chip
+    (in-graph: 3.6 ms for a 515-GFLOP matmul = 143 TFLOP/s; host-loop
+    "timings" for the same op ranged 6-1536 ms). One scan'd dispatch
+    sidesteps both, and matches how a non-tunneled TPU runtime is
+    driven anyway. First call compiles (the warmup — the ``warmup``
+    parameter is accepted for signature compatibility and ignored);
+    two timed dispatches, best wins. The constant dispatch+readback overhead is
+    measured once via a trivial null scan (_dispatch_overhead_s,
+    ~0.1-0.2 s through the tunnel) and subtracted — unless it exceeds
+    90% of the measurement, where extrapolation would be meaningless
+    and the uncorrected (conservative) figure is reported instead."""
+    out = run_steps(iters)
     lv = float(np.asarray(out[0]).reshape(-1)[0])
     if not np.isfinite(lv):
         raise FloatingPointError("non-finite loss")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run_step()
-    jax.block_until_ready(out)
-    return iters / (time.perf_counter() - t0)
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        run_steps(iters)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    null = _dispatch_overhead_s()
+    if null > best * 0.9:
+        # the config is too cheap for this iters count — refuse to
+        # extrapolate through a >90% correction; report uncorrected
+        _log("overhead %.0fms >90%% of measured %.0fms — reporting "
+             "uncorrected (conservative)" % (null * 1e3, best * 1e3))
+        return iters / best
+    return iters / (best - null)
+
+
+_NULL_S = [None]
+
+
+def _dispatch_overhead_s():
+    """One dispatch + one readback of a trivial 100-step scan — the
+    constant (per-dispatch transport + RTT) cost shared by every
+    _timed_loop measurement; measured once and subtracted so modest
+    iters counts don't under-report cheap configs. ~100-200 ms through
+    the dev tunnel, ~1 ms on a local runtime."""
+    if _NULL_S[0] is None:
+        import paddle_tpu as fluid
+        from paddle_tpu import layers
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            block = main.global_block()
+            acc = block.create_var(name="bench_null_acc", shape=[1],
+                                   dtype="float32", persistable=True)
+            upd = layers.scale(acc, scale=1.0, bias=1.0)
+            block.append_op(type="assign", inputs={"X": [upd]},
+                            outputs={"Out": [acc]})
+        fluid.global_scope().set_var("bench_null_acc",
+                                     np.zeros((1,), np.float32))
+        exe = fluid.Executor()
+        run = lambda: exe.run_repeated(main, feed={},  # noqa: E731
+                                       fetch_list=[acc], iters=100)
+        run()
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        _NULL_S[0] = best
+        _log("dispatch+readback overhead: %.0f ms" % (best * 1e3))
+    return _NULL_S[0]
 
 
 def _best_library(run_step, warmup, iters, extra_libs=("pallas",),
@@ -252,27 +309,28 @@ def _build_transformer_step(batch, seq_len):
     feed = T.make_fake_batch(cfg, batch)
     tokens_per_step = float(feed["tgt_mask"].sum())
     feed = _device_feed(feed)
-    run = lambda: exe.run(main, feed=feed, fetch_list=[avg_cost],
-                          return_numpy=False)
+    run = lambda k: exe.run_repeated(main, feed=feed,
+                                     fetch_list=[avg_cost], iters=k)
     return cfg, run, tokens_per_step
 
 
-def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
+def bench_transformer(batch=64, seq_len=256, warmup=3, iters=25,
                       compare_libs=True):
     _log("building transformer-base program")
     cfg, run, tokens_per_step = _build_transformer_step(batch, seq_len)
 
     # curated mixes, most promising first (the soft budget may cut the
-    # tail): fused vocab-xent (kills the [N,30k] logits traffic) +
-    # flash attention with in-kernel dropout (kills the [B,H,S,S]
-    # probs+mask traffic), keeping XLA for layer_norm/adam which
-    # measured faster at this shape; the single-kernel mixes isolate
-    # each win so one broken variant can't mask the other's speedup
-    mixes = ("fused_linear_xent:pallas,"
-             "scaled_dot_product_attention:pallas",
-             "scaled_dot_product_attention:pallas",
-             "fused_linear_xent:pallas",
-             "pallas")
+    # tail), per the round-4 chip-measured kernel table (BASELINE.md,
+    # tools/kernel_table.py, honest in-graph protocol): layer_norm
+    # (1.72x) and adam (1.36x) pallas WIN at flagship shape;
+    # attention (0.63x), softmax_xent (0.58x) and fused_linear_xent
+    # (0.64x) LOSE to XLA and are off the default mix — only-winners
+    # discipline (jit/README.en.md). The fused-xent mix is still
+    # measured last as evidence the demotion holds in-model.
+    mixes = ("layer_norm:pallas,adam:pallas",
+             "layer_norm:pallas",
+             "adam:pallas",
+             "fused_linear_xent:pallas")
 
     def on_result(best_sps, mixes_so_far):
         # keep the best-so-far headline current so a later mix stall
@@ -297,10 +355,10 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
     mfu = _mfu(transformer_flops_per_step(cfg, batch), sps)
     used_batch = batch
 
-    # round-3's fused vocab-xent removed the [N,30k] logits temp (the
-    # 3.66GB allocation that OOMed batch>=128 on 16G v5e in round 2) —
-    # with budget left, try the winning fused mix at batch 128: bigger
+    # with budget left, try the winning mix at batch 128: bigger
     # batches amortize HBM-bound elementwise work over more MXU FLOPs
+    # (round-2's b>=128 OOM was the f32 [N,30k] logits temp; under
+    # bf16 AMP it is 2GB and fits)
     if (compare_libs and len(measured) > 1
             and _BUDGET_S - (time.time() - _T0) > 180):
         try:
@@ -309,8 +367,7 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
             cfg2, run2, tokens2 = _build_transformer_step(
                 batch * 2, seq_len)
             prev = FLAGS.op_library
-            FLAGS.op_library = ("fused_linear_xent:pallas,"
-                                "scaled_dot_product_attention:pallas")
+            FLAGS.op_library = "layer_norm:pallas,adam:pallas"
             guard = _mix_guard("batch-%d attempt" % (batch * 2))
             try:
                 sps2 = _timed_loop(run2, warmup, iters)
@@ -342,7 +399,7 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=10,
 # config 1: MNIST MLP
 # ---------------------------------------------------------------------------
 
-def bench_mnist_mlp(batch=512, warmup=5, iters=30):
+def bench_mnist_mlp(batch=512, warmup=5, iters=300):
     import paddle_tpu as fluid
     from paddle_tpu import layers
 
@@ -364,8 +421,8 @@ def bench_mnist_mlp(batch=512, warmup=5, iters=30):
         "label": rs.randint(0, 10, size=(batch, 1)).astype(np.int64),
     })
     sps = _timed_loop(
-        lambda: exe.run(main, feed=feed, fetch_list=[loss],
-                        return_numpy=False),
+        lambda k: exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                   iters=k),
         warmup, iters)
     return {"metric": "mnist_mlp_train_throughput",
             "value": round(batch * sps, 1), "unit": "examples/sec",
@@ -379,7 +436,7 @@ def bench_mnist_mlp(batch=512, warmup=5, iters=30):
 _RESNET50_FWD_FLOPS = 8.2e9  # standard 224x224 fwd GFLOPs (convs+fc)
 
 
-def bench_resnet50(batch=64, warmup=3, iters=10):
+def bench_resnet50(batch=64, warmup=3, iters=60):
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as amp
     from paddle_tpu.models import resnet as R
@@ -402,8 +459,8 @@ def bench_resnet50(batch=64, warmup=3, iters=10):
         "label": rs.randint(0, 1000, size=(batch, 1)).astype(np.int64),
     })
     sps, measured = _best_library(
-        lambda: exe.run(main, feed=feed, fetch_list=[loss],
-                        return_numpy=False),
+        lambda k: exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                   iters=k),
         warmup, iters)
     return {"metric": "resnet50_train_throughput",
             "value": round(batch * sps, 1), "unit": "images/sec/chip",
@@ -482,7 +539,7 @@ def bert_flops_per_step(cfg, batch, seq_len):
     return 3.0 * (cfg.num_hidden_layers * layer + heads) * batch
 
 
-def bench_bert(batch=32, seq_len=128, warmup=3, iters=10):
+def bench_bert(batch=32, seq_len=128, warmup=3, iters=25):
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as amp
     from paddle_tpu.models import bert as B
@@ -503,8 +560,8 @@ def bench_bert(batch=32, seq_len=128, warmup=3, iters=10):
     seq_len = feed["src_ids"].shape[1]
     feed = _device_feed(feed)
     sps, measured = _best_library(
-        lambda: exe.run(main, feed=feed, fetch_list=[loss],
-                        return_numpy=False),
+        lambda k: exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                   iters=k),
         warmup, iters)
     return {"metric": "bert_base_train_throughput",
             "value": round(batch * seq_len * sps, 1),
@@ -517,7 +574,7 @@ def bench_bert(batch=32, seq_len=128, warmup=3, iters=10):
 # config 5: DeepFM CTR
 # ---------------------------------------------------------------------------
 
-def bench_deepfm(batch=4096, warmup=3, iters=20):
+def bench_deepfm(batch=4096, warmup=3, iters=100):
     import paddle_tpu as fluid
     from paddle_tpu.models import deepfm as D
 
@@ -531,8 +588,8 @@ def bench_deepfm(batch=4096, warmup=3, iters=20):
     exe.run(startup)
     feed = _device_feed(D.make_fake_batch(cfg, batch))
     sps = _timed_loop(
-        lambda: exe.run(main, feed=feed, fetch_list=[loss],
-                        return_numpy=False),
+        lambda k: exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                                   iters=k),
         warmup, iters)
     return {"metric": "deepfm_train_throughput",
             "value": round(batch * sps, 1), "unit": "examples/sec",

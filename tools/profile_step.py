@@ -1,0 +1,53 @@
+"""Profile the flagship transformer-base train step on the current
+backend: capture the XLA device trace over a few scan'd steps and
+print the per-op device-time table (profiler.device_summary_table).
+Usage: python tools/profile_step.py [--iters 20] [--batch 64]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--trace-dir", default="/tmp/flagship_trace")
+    args = ap.parse_args()
+
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+    from paddle_tpu.contrib import mixed_precision as amp
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(src_vocab=30000, tgt_vocab=30000,
+                              max_len=256, d_model=512, d_ffn=2048,
+                              n_head=8, n_layer=6, dropout=0.1)
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 1
+    with fluid.program_guard(main_p, startup):
+        avg_cost, _tok, _ = T.transformer(cfg)
+        opt = amp.decorate(fluid.optimizer.AdamOptimizer(1e-3))
+        opt.minimize(avg_cost)
+    exe = fluid.Executor()
+    exe.run(startup)
+    import jax.numpy as jnp
+    feed = {k: jnp.asarray(v)
+            for k, v in T.make_fake_batch(cfg, args.batch).items()}
+    run = lambda k: exe.run_repeated(main_p, feed=feed,  # noqa: E731
+                                     fetch_list=[avg_cost], iters=k)
+    print("compiling + warmup...", file=sys.stderr, flush=True)
+    run(args.iters)
+    print("tracing...", file=sys.stderr, flush=True)
+    profiler.start_profiler("All", trace_path=args.trace_dir)
+    run(args.iters)
+    profiler.stop_profiler()
+    print(profiler.device_summary_table())
+
+
+if __name__ == "__main__":
+    main()
