@@ -85,6 +85,12 @@ def fused_linear_xent(x, w, label, *, epsilon=0.0):
     on the unfused path). Loss: float32 [..., 1].
     """
     V = w.shape[-1]
+    # f32 logits, deliberately: a bf16-logits variant (halving the
+    # [N, V] traffic, f32 in-register reductions) was chip-measured
+    # in round 4 at 0.287 MFU vs 0.372 — the (2,1)-packed bf16
+    # layout breaks XLA's convert_reduce fusions around the head and
+    # costs far more than the bandwidth saves. Measured beats
+    # theorized.
     logits = jnp.dot(x, w,
                      preferred_element_type=jnp.float32)  # [..., V]
     lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)

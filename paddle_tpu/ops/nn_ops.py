@@ -534,13 +534,16 @@ def _dropout_train(rate, upscale):
 def _keep_mask(key, rate, shape):
     """Bernoulli(1-rate) keep mask by raw-bit threshold compare.
 
-    Equivalent to jax.random.bernoulli (bits are uniform over 2^32, so
-    P[bits >= rate*2^32] = 1-rate to within 2^-32) but skips the
+    Equivalent to jax.random.bernoulli (bits uniform, so
+    P[bits >= rate*2^B] = 1-rate to within 2^-B) but skips the
     bits->float-uniform conversion — on the bench transformer the mask
     generation over the [B,H,S,S] attention weights and FFN
     activations is ~1/5 of step time, so the elementwise work here is
-    a measured win. RNG impl is whatever jax.random.bits uses (rbg on
-    TPU via bench.py)."""
+    a measured win. (A u16-halves variant — one generated u32 serving
+    two elements — was chip-measured in round 4 and did NOT win: the
+    bitcast+reshape breaks the generator's fusion with the consumer,
+    and the rbg generator is not bit-count-bound.) RNG impl is
+    whatever jax.random.bits uses (rbg on TPU via bench.py)."""
     bits = jax.random.bits(key, shape, jnp.uint32)
     thresh = min(int(rate * (1 << 32)), (1 << 32) - 1)
     return bits >= jnp.uint32(thresh)

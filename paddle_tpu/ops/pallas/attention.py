@@ -80,24 +80,66 @@ def _dropout_keep(seed_ref, i, j, kk, n_q, n_k, shape, rate):
     return u >= thresh
 
 
+@functools.lru_cache(maxsize=None)
+def _softmax_save_lowp(dtype_name):
+    """Softmax computed in f32 that SAVES ONLY the low-precision
+    probabilities for its backward (flash-attention discipline).
+    jax.nn.softmax's own vjp residual is the f32 output — at
+    [B,H,S,S] x 18 attention sites that one choice added ~4 GB of
+    HLO temps at batch 128 (observed in the round-4 OOM dump) and
+    doubled the probs read/write traffic; the bf16-rounded residual
+    changes the gradient by <=1 ulp of bf16, the same rounding every
+    flash kernel accepts."""
+    out_dtype = jnp.dtype(dtype_name)
+
+    @jax.custom_vjp
+    def f(s):
+        return jax.nn.softmax(s, axis=-1).astype(out_dtype)
+
+    def fwd(s):
+        w = jax.nn.softmax(s, axis=-1).astype(out_dtype)
+        return w, w
+
+    def bwd(w, g):
+        w32 = w.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        inner = jnp.sum(g32 * w32, axis=-1, keepdims=True)
+        return ((g32 - inner) * w32,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
 def _sdpa_reference(q, k, v, bias, *, scale, dropout_rate=0.0,
                     causal=False, rng=None):
     """Pure-jnp composite (the jit/refer/ analog): q,k,v [B,H,S,Dh],
-    bias additive, broadcastable to [B,1_or_H,Sq,Sk]."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    bias additive, broadcastable to [B,1_or_H,Sq,Sk].
+
+    Precision follows standard TPU practice (and the reference's f32
+    softmax accumulate): scores and softmax in float32 — the MXU
+    accumulates f32 for free and bf16 exp/sums over the key axis lose
+    real mantissa — then the probabilities drop back to the input
+    dtype (saving only the low-precision copy for the backward) for
+    the dropout mask and the PV matmul, so the [B,H,S,S] traffic
+    rides at half width under AMP."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
-        s = s + lax.stop_gradient(bias)
+        s = s + lax.stop_gradient(bias).astype(jnp.float32)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         rows = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(rows >= cols, s, _NEG_INF)
-    w = jax.nn.softmax(s, axis=-1)
+    w = _softmax_save_lowp(jnp.dtype(q.dtype).name)(s)
     if dropout_rate > 0.0:
         from ..nn_ops import _keep_mask
         keep = _keep_mask(rng, dropout_rate, w.shape)
-        w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
-    return jnp.einsum("bhqk,bhkd->bhqd", w, v).astype(q.dtype)
+        w = jnp.where(keep, w / (1.0 - dropout_rate),
+                      jnp.zeros((), q.dtype))
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v,
+                      preferred_element_type=jnp.float32).astype(
+        q.dtype)
 
 
 @register("scaled_dot_product_attention", ["Q", "K", "V", "Bias"],

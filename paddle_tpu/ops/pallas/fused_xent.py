@@ -92,17 +92,24 @@ def _fwd_call(x2, w, lab2, eps):
     V = w.shape[-1]
     bn = blk(N, 512)
     ni = N // bn
-    bv = min(2048, -(-V // 128) * 128)
+    # bv=1024: the 2048 block's f32 working set (double-buffered W
+    # block + transposed logits + exp) hit 16.11M scoped VMEM on chip,
+    # 112K over the 16M stack limit
+    bv = min(1024, -(-V // 128) * 128)
     nvj = -(-V // bv)
     Vp = nvj * bv
     if Vp > V:
         w = jnp.pad(w, ((0, 0), (0, Vp - V)))
     lab_row = lab2.reshape(1, N)
     kernel = functools.partial(_fwd_kernel, V=V, eps=eps, nvj=nvj)
+    # outputs are lane-major [1, N]: a (1, bn) block over an (ni, bn)
+    # array is ILLEGAL on the TPU lowering (sublane block dim 1 is
+    # neither 8-divisible nor the full dim); over (1, N) it is exact in
+    # the sublane and 128-divisible in the lane
     loss, lse = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((ni, bn), jnp.float32),
-                   jax.ShapeDtypeStruct((ni, bn), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct((1, N), jnp.float32),
+                   jax.ShapeDtypeStruct((1, N), jnp.float32)),
         grid=(nvj, ni),
         in_specs=[pl.BlockSpec((bn, D), lambda j, i: (i, 0),
                                memory_space=pltpu.VMEM),
@@ -110,9 +117,9 @@ def _fwd_call(x2, w, lab2, eps):
                                memory_space=pltpu.VMEM),
                   pl.BlockSpec((1, bn), lambda j, i: (0, i),
                                memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec((1, bn), lambda j, i: (i, 0),
+        out_specs=(pl.BlockSpec((1, bn), lambda j, i: (0, i),
                                 memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, bn), lambda j, i: (i, 0),
+                   pl.BlockSpec((1, bn), lambda j, i: (0, i),
                                 memory_space=pltpu.VMEM)),
         scratch_shapes=[pltpu.VMEM((ni, bn), jnp.float32)] * 4,
         compiler_params=pltpu.CompilerParams(
@@ -122,7 +129,7 @@ def _fwd_call(x2, w, lab2, eps):
             bytes_accessed=(N * D * nvj + D * Vp) * x2.dtype.itemsize),
         interpret=interpret_mode(),
     )(x2, w, lab_row)
-    return loss.reshape(N, 1), lse.reshape(N, 1)
+    return loss.reshape(N, 1), lse.reshape(N, 1)  # [1, N] -> [N, 1]
 
 
 @functools.lru_cache(maxsize=None)

@@ -43,8 +43,10 @@ def _xent_pallas_fwd(logits, label):
         N *= d
     lg2 = logits.reshape(N, C)
     lb2 = label.reshape(N, 1).astype(jnp.int32)
-    # VMEM-aware row block: ~3 [blk_n, C] f32 live buffers must fit
-    target = max(1, min(256, (4 << 20) // (12 * C)))
+    # VMEM-aware row block: ~7 [blk_n, C] f32 buffers live at once
+    # (double-buffered in/out blocks + exp/logp intermediates) under
+    # the 16M scoped-VMEM stack limit
+    target = max(1, min(256, (6 << 20) // (12 * C)))
     blk_n = blk(N, target)
     sm, loss = pl.pallas_call(
         functools.partial(_xent_kernel),
