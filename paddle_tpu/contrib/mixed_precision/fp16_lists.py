@@ -34,6 +34,12 @@ gray_list = {
     "elementwise_div", "relu", "gelu", "tanh", "sigmoid", "pool2d",
     "adaptive_pool2d", "transpose2", "reshape2", "concat", "split",
     "slice", "dropout", "scale", "stack", "expand",
+    # dtype-preserving movement/identity ops: must not break the
+    # low-precision chain (an unlisted op up-casts its inputs)
+    "unsqueeze", "squeeze", "unsqueeze2", "squeeze2", "assign",
+    "transpose", "reshape", "flatten", "flatten2", "pad", "gather",
+    "relu6", "leaky_relu", "clip", "elementwise_max",
+    "elementwise_min",
     # layer_norm's lowering computes its statistics in f32 and returns
     # the INPUT dtype (ops/nn_ops.py), so under AMP it can take bf16
     # activations directly — blacklisting it only inserts f32 casts

@@ -18,46 +18,108 @@ from ...framework import convert_dtype
 
 
 def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
-    """Insert cast-to-``dest_dtype`` ops in front of every float32 input
-    of white-list ops (forward ops only — backward regenerates through
-    the vjp of the rewritten forward). Returns the number of casts
-    inserted."""
+    """Insert casts so the low-precision region PROPAGATES through the
+    forward graph (reference: fp16_utils.py rewrite_program's
+    white/black/gray semantics; forward ops only — backward
+    regenerates through the vjp of the rewritten forward):
+
+    - white ops: every float32 input is cast down; their float outputs
+      become low-precision.
+    - gray ops: FOLLOW their inputs — if any float input is already
+      low, remaining float32 float inputs (residual branches, biases,
+      LN scales) are cast down too and the outputs stay low. This is
+      what keeps the residual stream bf16 end-to-end: without it every
+      ``bf16 matmul out + f32 residual`` add re-promotes to f32 and
+      the entire inter-matmul activation traffic (residuals, LN,
+      dropout, [B,S,D] saves for backward) runs at double width —
+      measured round 4 as the dominant non-MXU HBM load at flagship
+      shape.
+    - black and unlisted ops: low inputs are cast UP to float32
+      explicitly (there may be no f32 operand left to trigger
+      promotion), outputs leave the low region.
+
+    Returns the number of casts inserted."""
     dest_dtype = convert_dtype(dest_dtype)
-    n_casts = 0
+
+    def is_float(var):
+        return var is not None and var.dtype in (
+            "float32", "float64", "float16", "bfloat16")
+
+    # low set is program-wide: a white op's bf16 output in a parent
+    # block must still trigger gray propagation / black up-casts when
+    # read inside a sub-block (while/cond bodies)
+    low = set()   # vars carrying dest_dtype as a result of the pass
+    n_inserted = [0]
     for block in main_program.blocks:
         new_ops = []
-        # cache per-block so one var feeding several white ops is cast
+        # per-block cast caches so one var feeding several ops is cast
         # once (XLA would CSE it anyway; this keeps the program small)
-        casted = {}
+        cast_down, cast_up = {}, {}
+
+        def insert_cast(name, var, to_dtype, cache, sink):
+            if name not in cache:
+                n_inserted[0] += 1
+                cast_var = block.create_var(
+                    name=framework.unique_name.generate(
+                        name + ".cast_" + to_dtype),
+                    shape=tuple(var.shape),
+                    dtype=to_dtype,
+                    stop_gradient=var.stop_gradient)
+                sink.append(framework.Operator(
+                    block, "cast",
+                    inputs={"X": [name]},
+                    outputs={"Out": [cast_var.name]},
+                    attrs={"dtype": to_dtype}))
+                cache[name] = cast_var.name
+            return cache[name]
+
         for op in block.ops:
-            if op.type in amp_lists.white_list and \
-                    op.attrs.get("op_role") not in ("backward",
-                                                    "optimize"):
-                for slot, names in op.inputs.items():
-                    for j, name in enumerate(names):
-                        var = block._find_var_recursive(name)
-                        if var is None or var.dtype != "float32":
-                            continue
-                        if name not in casted:
-                            cast_var = block.create_var(
-                                name=framework.unique_name.generate(
-                                    name + ".cast_" + dest_dtype),
-                                shape=tuple(var.shape),
-                                dtype=dest_dtype,
-                                stop_gradient=var.stop_gradient)
-                            cast_op = framework.Operator(
-                                block, "cast",
-                                inputs={"X": [name]},
-                                outputs={"Out": [cast_var.name]},
-                                attrs={"dtype": dest_dtype})
-                            new_ops.append(cast_op)
-                            casted[name] = cast_var.name
-                            n_casts += 1
-                        names[j] = casted[name]
-            new_ops.append(op)
-            # a write to a var invalidates its cached cast
+            role = op.attrs.get("op_role")
+            if role in ("backward", "optimize") or op.type == "cast":
+                new_ops.append(op)
+                for n in op.output_arg_names:
+                    cast_down.pop(n, None)
+                    cast_up.pop(n, None)
+                    low.discard(n)
+                continue
+            white = op.type in amp_lists.white_list
+            gray = op.type in amp_lists.gray_list
+            float_ins = []
+            for slot, names in op.inputs.items():
+                for j, name in enumerate(names):
+                    var = block._find_var_recursive(name)
+                    if is_float(var):
+                        float_ins.append((names, j, name, var))
+            any_low = any(name in low or var.dtype == dest_dtype
+                          for _, _, name, var in float_ins)
+            if white or (gray and any_low):
+                for names, j, name, var in float_ins:
+                    if var.dtype != "float32" or name in low:
+                        continue
+                    names[j] = insert_cast(name, var, dest_dtype,
+                                           cast_down, new_ops)
+                new_ops.append(op)
+                for n in op.output_arg_names:
+                    v = block._find_var_recursive(n)
+                    if is_float(v) or v is None:
+                        low.add(n)
+            elif gray:
+                # no low input: pass through untouched, stays f32
+                new_ops.append(op)
+            else:
+                # black or unlisted: pull low inputs back to f32
+                for names, j, name, var in float_ins:
+                    if name in low or var.dtype == dest_dtype:
+                        names[j] = insert_cast(name, var, "float32",
+                                               cast_up, new_ops)
+                new_ops.append(op)
+            # a write to a var invalidates its cached casts and any
+            # stale low marking from a previous write
             for n in op.output_arg_names:
-                casted.pop(n, None)
+                cast_down.pop(n, None)
+                cast_up.pop(n, None)
+                if not (white or (gray and any_low)):
+                    low.discard(n)
         block.ops = new_ops
     main_program._bump()
-    return n_casts
+    return n_inserted[0]
