@@ -157,3 +157,42 @@ class TestAMPConvBN:
                       .reshape(-1)[0]) for _ in range(10)]
         assert np.isfinite(vals).all(), vals
         assert vals[-1] < vals[0]
+
+
+def test_gray_ops_propagate_low_precision():
+    """Round-4 propagation semantics (reference rewrite_program's
+    white/black/gray): a gray op with one bf16 input pulls its other
+    f32 float inputs down (the residual stream stays bf16), and a
+    black op downstream gets an explicit cast back up to f32."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4, 16], append_batch_size=False)
+        h = layers.fc(x, size=16)              # white: mul (+ add bias)
+        res = layers.elementwise_add(h, x)     # gray: h low -> x cast
+        sm = layers.softmax(res)               # black: cast UP first
+        layers.reduce_mean(sm)
+    n = mp.rewrite_program(main, mp.AutoMixedPrecisionLists())
+    assert n >= 3  # x->bf16 (mul), residual branch ->bf16, up-cast
+    ops = main.global_block().ops
+    casts = [(op.attrs["dtype"], op.inputs["X"][0], op.outputs["Out"][0])
+             for op in ops if op.type == "cast"]
+    downs = [c for c in casts if c[0] == "bfloat16"]
+    ups = [c for c in casts if c[0] == "float32"]
+    assert downs and ups
+    # the softmax input must be an up-cast output (f32), not the raw
+    # low-precision residual
+    softmax_in = next(op.inputs["X"][0] for op in ops
+                      if op.type == "softmax")
+    assert softmax_in in {u[2] for u in ups}
+
+
+def test_gray_op_without_low_input_untouched():
+    """A gray op fed only f32 stays f32: no spurious down-casts."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        a = layers.data("a", shape=[4, 8], append_batch_size=False)
+        b = layers.data("b", shape=[4, 8], append_batch_size=False)
+        layers.reduce_mean(layers.elementwise_add(a, b))
+    n = mp.rewrite_program(main, mp.AutoMixedPrecisionLists())
+    assert n == 0
+    assert all(op.type != "cast" for op in main.global_block().ops)

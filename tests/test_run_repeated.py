@@ -1,0 +1,95 @@
+"""Executor.run_repeated: K steps inside one compiled lax.scan must be
+bit-identical to K sequential run() calls (PRNG folding, persistable
+carry, donation) — the honest-throughput protocol bench.py relies on."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _net(seed=7, lr=1e-2):
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = seed
+    with fluid.program_guard(main, start):
+        x = layers.data("x", [32], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=64, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, start, loss
+
+
+def _feed():
+    rs = np.random.RandomState(0)
+    return {"x": rs.rand(8, 32).astype("float32"),
+            "y": rs.randint(0, 10, (8, 1)).astype("int64")}
+
+
+def test_matches_sequential_runs():
+    feed = _feed()
+    main, start, loss = _net()
+    s1 = fluid.core.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(s1):
+        exe.run(start)
+        seq = [float(np.ravel(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0])[0])
+               for _ in range(6)]
+
+    main2, start2, loss2 = _net()
+    s2 = fluid.core.Scope()
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(s2):
+        exe2.run(start2)
+        r1 = exe2.run_repeated(main2, feed=feed, fetch_list=[loss2],
+                               iters=1)
+        r3 = exe2.run_repeated(main2, feed=feed, fetch_list=[loss2],
+                               iters=2)
+        r6 = exe2.run_repeated(main2, feed=feed, fetch_list=[loss2],
+                               iters=3)
+    got = [float(np.ravel(r)[0]) for r in (r1, r3, r6)]
+    assert abs(seq[0] - got[0]) < 1e-5
+    assert abs(seq[2] - got[1]) < 1e-5
+    assert abs(seq[5] - got[2]) < 1e-4
+
+
+def test_dropout_keys_advance_per_step():
+    """Each in-scan step must fold a fresh PRNG key (masks differ) —
+    a constant key would silently train on one mask."""
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = 3
+    with fluid.program_guard(main, start):
+        x = layers.data("x", [64], dtype="float32")
+        d = layers.dropout(x, dropout_prob=0.5)
+        out = layers.reduce_sum(d, dim=-1)
+        out.persistable = True
+    exe = fluid.Executor()
+    s = fluid.core.Scope()
+    feed = {"x": np.ones((4, 64), np.float32)}
+    with fluid.scope_guard(s):
+        exe.run(start)
+        a = exe.run_repeated(main, feed=feed, fetch_list=[out.name],
+                             iters=1)
+        b = exe.run_repeated(main, feed=feed, fetch_list=[out.name],
+                             iters=1)
+    assert not np.allclose(a[0], b[0])
+
+
+def test_library_respected_by_fallback_loop():
+    """The interpreted/dist fallback must still honor an explicit
+    library argument (scoped through FLAGS)."""
+    from paddle_tpu.core.flags import FLAGS
+    main, start, loss = _net()
+    s = fluid.core.Scope()
+    exe = fluid.Executor()
+    feed = _feed()
+    with fluid.scope_guard(s):
+        exe.run(start)
+        prev = FLAGS.op_library
+        out = exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                               iters=2, library="")
+        assert FLAGS.op_library == prev
+        assert np.isfinite(np.ravel(out[0])[0])
