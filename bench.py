@@ -780,6 +780,7 @@ def child_main():
                  bench_resnet50_hostfed, bench_bert, bench_deepfm]
         for fn in extra:
             try:
+                _release_device_state()
                 r = fn()
                 r["vs_baseline"] = _vs_baseline(r.get("mfu"))
                 mixes = r.pop("_mixes", [])
@@ -788,6 +789,27 @@ def child_main():
             except Exception as e:
                 print(json.dumps({"metric": fn.__name__,
                                   "error": repr(e)}), flush=True)
+
+
+def _release_device_state():
+    """Free the previous config's HBM before building the next one.
+
+    The --all configs share one process; every config's parameters and
+    optimizer state live in the global scope, and compiled executables
+    pin their buffers — round 4 on-chip, the transformer + its b128
+    OOM attempt left enough resident that all four extras failed with
+    RESOURCE_EXHAUSTED. Dropping scope vars, jit caches, and live
+    jax.Arrays between configs returns the chip to a clean slate."""
+    import gc
+    import jax
+
+    import paddle_tpu as fluid
+    fluid.global_scope().drop_all()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,14 @@ class Scope:
     def drop_kids(self):
         self._kids.clear()
 
+    def drop_all(self):
+        """Release every variable and child scope (frees the device
+        buffers they pin — the reference's Scope::DeleteScope +
+        variable erasure rolled into one; used between benchmark
+        configs to return HBM)."""
+        self._vars.clear()
+        self._kids.clear()
+
 
 _global_scope = Scope()
 

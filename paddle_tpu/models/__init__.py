@@ -8,6 +8,7 @@ from . import deepfm  # noqa: F401
 from . import mnist  # noqa: F401
 from . import recommender  # noqa: F401
 from . import resnet  # noqa: F401
+from . import se_resnext  # noqa: F401
 from . import stacked_lstm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import vgg  # noqa: F401

@@ -1,0 +1,121 @@
+"""SE-ResNeXt for ImageNet classification.
+
+Reference: benchmark/fluid/models/se_resnext.py:40-199 (SE_ResNeXt.net
+/ bottleneck_block / squeeze_excitation; depths 50/101/152 with
+cardinality-32/64 group convolutions and reduction-ratio-16 SE gates).
+
+TPU notes: group convolution lowers to XLA conv_general_dilated with
+feature_group_count — the TPU backend tiles each group's contraction
+onto the MXU without the reference's cudnn group plumbing. The SE gate
+(global-avg-pool -> 2 tiny fc -> channelwise scale) is pure elementwise
++ [C, C/r] matmuls; XLA fuses the sigmoid scale back into the residual
+add.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..initializer import Uniform
+from ..param_attr import ParamAttr
+
+__all__ = ["se_resnext", "se_resnext50", "loss_and_acc"]
+
+_DEPTH_CFG = {
+    # depth: (block counts, cardinality, stem)
+    50: ([3, 4, 6, 3], 32, "7x7"),
+    101: ([3, 4, 23, 3], 32, "7x7"),
+    152: ([3, 8, 36, 3], 64, "3x3x3"),
+}
+_NUM_FILTERS = [128, 256, 512, 1024]
+_REDUCTION_RATIO = 16
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, is_test=False):
+    conv = layers.conv2d(input, num_filters=num_filters,
+                         filter_size=filter_size, stride=stride,
+                         padding=(filter_size - 1) // 2, groups=groups,
+                         act=None, bias_attr=False)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio,
+                       is_test=False):
+    pool = layers.pool2d(input, pool_type="avg", global_pooling=True)
+    stdv = 1.0 / math.sqrt(num_channels)
+    squeeze = layers.fc(
+        pool, size=num_channels // reduction_ratio, act="relu",
+        param_attr=ParamAttr(initializer=Uniform(-stdv, stdv)))
+    stdv = 1.0 / math.sqrt(num_channels // reduction_ratio)
+    excitation = layers.fc(
+        squeeze, size=num_channels, act="sigmoid",
+        param_attr=ParamAttr(initializer=Uniform(-stdv, stdv)))
+    # channelwise gate: [N, C] broadcast over [N, C, H, W]
+    return layers.elementwise_mul(input, excitation, axis=0)
+
+
+def _shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, is_test=is_test)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, cardinality,
+                     reduction_ratio, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride,
+                          groups=cardinality, act="relu",
+                          is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None,
+                          is_test=is_test)
+    scale = squeeze_excitation(conv2, num_filters * 2,
+                               reduction_ratio, is_test=is_test)
+    short = _shortcut(input, num_filters * 2, stride, is_test=is_test)
+    return layers.elementwise_add(short, scale, act="relu")
+
+
+def se_resnext(input, class_dim=1000, depth=50, is_test=False):
+    """SE-ResNeXt-{50,101,152}; input [N, 3, H, W]."""
+    if depth not in _DEPTH_CFG:
+        raise ValueError("supported depths are %s, got %d"
+                         % (sorted(_DEPTH_CFG), depth))
+    block_counts, cardinality, stem = _DEPTH_CFG[depth]
+    if stem == "7x7":
+        conv = conv_bn_layer(input, 64, 7, 2, act="relu",
+                             is_test=is_test)
+    else:  # the 152 stem: three stacked 3x3 convs
+        conv = conv_bn_layer(input, 64, 3, 2, act="relu",
+                             is_test=is_test)
+        conv = conv_bn_layer(conv, 64, 3, 1, act="relu",
+                             is_test=is_test)
+        conv = conv_bn_layer(conv, 128, 3, 1, act="relu",
+                             is_test=is_test)
+    conv = layers.pool2d(conv, pool_size=3, pool_stride=2,
+                         pool_padding=1, pool_type="max")
+    for block, count in enumerate(block_counts):
+        for i in range(count):
+            conv = bottleneck_block(
+                conv, _NUM_FILTERS[block],
+                stride=2 if i == 0 and block != 0 else 1,
+                cardinality=cardinality,
+                reduction_ratio=_REDUCTION_RATIO, is_test=is_test)
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    drop = pool if is_test else layers.dropout(pool, dropout_prob=0.5)
+    stdv = 1.0 / math.sqrt(drop.shape[1])
+    return layers.fc(drop, size=class_dim, act="softmax",
+                     param_attr=ParamAttr(
+                         initializer=Uniform(-stdv, stdv)))
+
+
+def se_resnext50(input, class_dim=1000, is_test=False):
+    return se_resnext(input, class_dim, depth=50, is_test=is_test)
+
+
+def loss_and_acc(prediction, label):
+    loss = layers.mean(layers.cross_entropy(prediction, label))
+    acc = layers.accuracy(prediction, label)
+    return loss, acc

@@ -137,3 +137,46 @@ def test_vgg16_cifar_forward():
     pred_v, = exe.run(main, feed={"img": iv}, fetch_list=[pred])
     assert pred_v.shape == (2, 10)
     np.testing.assert_allclose(pred_v.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_se_resnext50_trains():
+    """SE-ResNeXt-50 (reference benchmark/fluid/models/se_resnext.py):
+    group-conv bottlenecks + SE gates build, train a step, and the
+    eval clone is deterministic."""
+    from paddle_tpu.models import se_resnext as S
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 64, 64], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = S.se_resnext50(img, class_dim=10)
+        loss, acc = S.loss_and_acc(pred, label)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(loss)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor()
+    rs = np.random.RandomState(0)
+    feed = {"img": rs.rand(2, 3, 64, 64).astype("float32"),
+            "label": rs.randint(0, 10, (2, 1)).astype("int64")}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        l1 = float(np.ravel(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0])[0])
+        l2 = float(np.ravel(exe.run(main, feed=feed,
+                                    fetch_list=[loss])[0])[0])
+        assert np.isfinite(l1) and np.isfinite(l2) and l2 != l1
+        e1 = exe.run(test_prog, feed=feed, fetch_list=[loss])[0]
+        e2 = exe.run(test_prog, feed=feed, fetch_list=[loss])[0]
+        assert np.allclose(np.ravel(e1), np.ravel(e2))
+
+
+def test_se_resnext_rejects_unknown_depth():
+    import pytest
+    from paddle_tpu.models import se_resnext as S
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = layers.data("img", shape=[3, 32, 32], dtype="float32")
+        with pytest.raises(ValueError, match="supported depths"):
+            S.se_resnext(img, depth=77)

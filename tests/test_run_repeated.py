@@ -57,39 +57,82 @@ def test_matches_sequential_runs():
 
 
 def test_dropout_keys_advance_per_step():
-    """Each in-scan step must fold a fresh PRNG key (masks differ) —
-    a constant key would silently train on one mask."""
-    main, start = fluid.Program(), fluid.Program()
-    main.random_seed = start.random_seed = 3
-    with fluid.program_guard(main, start):
-        x = layers.data("x", [64], dtype="float32")
-        d = layers.dropout(x, dropout_prob=0.5)
-        out = layers.reduce_sum(d, dim=-1)
-        out.persistable = True
-    exe = fluid.Executor()
-    s = fluid.core.Scope()
+    """Each IN-SCAN step must fold a fresh PRNG key — a constant key
+    would silently train every scan iteration on one dropout mask.
+    The per-step mask sum is accumulated into a persistable var, so a
+    reused mask would make acc(iters=2) exactly 2x acc(iters=1) for
+    the same base key (same program seed, fresh scope)."""
+    def build():
+        main, start = fluid.Program(), fluid.Program()
+        main.random_seed = start.random_seed = 3
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [64], dtype="float32")
+            d = layers.dropout(x, dropout_prob=0.5)
+            step_sum = layers.reduce_sum(d)
+            acc = layers.create_global_var(
+                shape=[1], value=0.0, dtype="float32",
+                persistable=True, name="acc")
+            layers.assign(layers.elementwise_add(
+                acc, layers.reshape(step_sum, [1])), acc)
+        return main, start
+
     feed = {"x": np.ones((4, 64), np.float32)}
-    with fluid.scope_guard(s):
-        exe.run(start)
-        a = exe.run_repeated(main, feed=feed, fetch_list=[out.name],
-                             iters=1)
-        b = exe.run_repeated(main, feed=feed, fetch_list=[out.name],
-                             iters=1)
-    assert not np.allclose(a[0], b[0])
+
+    def acc_after(iters):
+        main, start = build()
+        sc = fluid.core.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(sc):
+            exe.run(start)
+            out = exe.run_repeated(main, feed=feed,
+                                   fetch_list=["acc"], iters=iters)
+        return float(np.ravel(out[0])[0])
+
+    a1 = acc_after(1)
+    a2 = acc_after(2)
+    assert a1 > 0
+    # distinct per-step masks: the second step's sum differs from the
+    # first's (dropout_prob=0.5 over 256 elements collides with
+    # probability ~2^-60)
+    assert abs(a2 - 2.0 * a1) > 1e-3
 
 
-def test_library_respected_by_fallback_loop():
-    """The interpreted/dist fallback must still honor an explicit
-    library argument (scoped through FLAGS)."""
+def test_library_respected_by_fallback_loop(monkeypatch):
+    """The interpreted/eager fallback must scope an explicit library
+    through FLAGS for the duration of the loop and restore it after.
+    The program includes a tensor-array op so _needs_eager is True and
+    run_repeated really takes the fallback path."""
     from paddle_tpu.core.flags import FLAGS
-    main, start, loss = _net()
-    s = fluid.core.Scope()
+    import paddle_tpu.executor as executor_mod
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data("x", [8], dtype="float32")
+        arr = layers.create_array("float32")
+        layers.array_write(x, layers.fill_constant([1], "int64", 0),
+                           array=arr)
+        y = layers.array_read(arr, layers.fill_constant([1], "int64",
+                                                        0))
+        loss = layers.reduce_sum(y)
+    assert executor_mod._needs_eager(main)
+
+    seen = []
+    orig_run = fluid.Executor.run
+
+    def spy(self, *a, **k):
+        seen.append(FLAGS.op_library)
+        return orig_run(self, *a, **k)
+
+    monkeypatch.setattr(fluid.Executor, "run", spy)
+    sc = fluid.core.Scope()
     exe = fluid.Executor()
-    feed = _feed()
-    with fluid.scope_guard(s):
+    feed = {"x": np.ones((2, 8), np.float32)}
+    prev = FLAGS.op_library
+    with fluid.scope_guard(sc):
         exe.run(start)
-        prev = FLAGS.op_library
+        seen.clear()
         out = exe.run_repeated(main, feed=feed, fetch_list=[loss],
-                               iters=2, library="")
-        assert FLAGS.op_library == prev
-        assert np.isfinite(np.ravel(out[0])[0])
+                               iters=2, library="pallas")
+    assert seen == ["pallas", "pallas"]
+    assert FLAGS.op_library == prev
+    assert np.isfinite(np.ravel(out[0])[0])
