@@ -355,36 +355,13 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=25,
     mfu = _mfu(transformer_flops_per_step(cfg, batch), sps)
     used_batch = batch
 
-    # with budget left, try the winning mix at batch 128: bigger
-    # batches amortize HBM-bound elementwise work over more MXU FLOPs
-    # (round-2's b>=128 OOM was the f32 [N,30k] logits temp; under
-    # bf16 AMP it is 2GB and fits)
-    if (compare_libs and len(measured) > 1
-            and _BUDGET_S - (time.time() - _T0) > 180):
-        try:
-            from paddle_tpu.core.flags import FLAGS
-            _log("trying batch 128 with the fused mix")
-            cfg2, run2, tokens2 = _build_transformer_step(
-                batch * 2, seq_len)
-            prev = FLAGS.op_library
-            FLAGS.op_library = "layer_norm:pallas,adam:pallas"
-            guard = _mix_guard("batch-%d attempt" % (batch * 2))
-            try:
-                sps2 = _timed_loop(run2, warmup, iters)
-            finally:
-                guard.cancel()
-                FLAGS.op_library = prev
-            measured.append(("fused@b%d" % (batch * 2), sps2))
-            _log("batch %d done: %.3f steps/s" % (batch * 2, sps2))
-            mfu2 = _mfu(transformer_flops_per_step(cfg2, batch * 2),
-                        sps2)
-            if tokens2 * sps2 > value:
-                value = tokens2 * sps2
-                mfu = mfu2
-                used_batch = batch * 2
-        except Exception as e:  # OOM etc. — keep the batch-64 result
-            _log("batch-%d attempt failed (keeping b%d): %r"
-                 % (batch * 2, batch, e))
+    # NO batch-128 attempt: measured twice on chip (two separate
+    # round-4 windows), b128 is worse per token than b64 when it fits
+    # (4.55 steps/s = 9.1 b64-equivalent vs 11.6) and OOMs under the
+    # current layout — and a RESOURCE_EXHAUSTED launch through the
+    # remote runtime leaks server-side buffers that poison every
+    # subsequent config in the process (all four --all extras failed
+    # until the attempt was removed).
     return {
         "metric": "transformer_base_train_throughput",
         "value": round(value, 1),
@@ -444,7 +421,13 @@ def bench_resnet50(batch=64, warmup=3, iters=60):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[224, 224, 3],
+        # NCHW — the model's declared layout (models/resnet.py). The
+        # NHWC shape fed here until round 4 collapsed the spatial dims
+        # to [112, 1] after the stem (C_in=224, W=3!), which is how the
+        # "0.745 MFU" round-2 figure slipped past: the network trained
+        # on a 1-pixel-wide image. Caught when the honest protocol
+        # reported MFU > 1.
+        img = fluid.layers.data("img", shape=[3, 224, 224],
                                 dtype="float32")
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         pred = R.resnet50(img)
@@ -455,7 +438,7 @@ def bench_resnet50(batch=64, warmup=3, iters=60):
     exe.run(startup)
     rs = np.random.RandomState(0)
     feed = _device_feed({
-        "img": rs.rand(batch, 224, 224, 3).astype(np.float32),
+        "img": rs.rand(batch, 3, 224, 224).astype(np.float32),
         "label": rs.randint(0, 1000, size=(batch, 1)).astype(np.int64),
     })
     sps, measured = _best_library(
@@ -482,7 +465,13 @@ def bench_resnet50_hostfed(batch=64, warmup=3, iters=10):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = 1
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[224, 224, 3],
+        # NCHW — the model's declared layout (models/resnet.py). The
+        # NHWC shape fed here until round 4 collapsed the spatial dims
+        # to [112, 1] after the stem (C_in=224, W=3!), which is how the
+        # "0.745 MFU" round-2 figure slipped past: the network trained
+        # on a 1-pixel-wide image. Caught when the honest protocol
+        # reported MFU > 1.
+        img = fluid.layers.data("img", shape=[3, 224, 224],
                                 dtype="float32")
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         pred = R.resnet50(img)
@@ -495,7 +484,7 @@ def bench_resnet50_hostfed(batch=64, warmup=3, iters=10):
     rs = np.random.RandomState(0)
     # a small rotating pool of distinct host batches: fresh arrays per
     # step (no device caching), without paying 10 full randn calls
-    pool = [{"img": rs.rand(batch, 224, 224, 3).astype(np.float32),
+    pool = [{"img": rs.rand(batch, 3, 224, 224).astype(np.float32),
              "label": rs.randint(0, 1000, size=(batch, 1))
              .astype(np.int64)} for _ in range(4)]
 
@@ -516,12 +505,18 @@ def bench_resnet50_hostfed(batch=64, warmup=3, iters=10):
     lv = float(np.asarray(out[0]).reshape(-1)[0])
     if not np.isfinite(lv):
         raise FloatingPointError("non-finite loss")
+    del jax  # sync below is a readback; block_until_ready is a no-op
+    # on the tunneled backend (see _timed_loop). The steps chain
+    # through donated weights, so reading the LAST loss waits for the
+    # whole pipeline — per-step host feeds are the thing measured.
     t0 = time.perf_counter()
     for _ in range(iters):
         out = exe.run(main, feed=next(it), fetch_list=[loss],
                       return_numpy=False)
-    jax.block_until_ready(out)
+    lv = float(np.asarray(out[0]).reshape(-1)[0])
     sps = iters / (time.perf_counter() - t0)
+    if not np.isfinite(lv):
+        raise FloatingPointError("non-finite loss")
     reader.reset()
     return {"metric": "resnet50_hostfed_train_throughput",
             "value": round(batch * sps, 1), "unit": "images/sec/chip",
