@@ -569,12 +569,18 @@ class Executor:
                 return fetches, persist_out
 
             def multi(persist, feed_vals, base_key):
-                # step 0 runs outside the scan to seed the fetches
-                # carry — carrying them (instead of scan ys stacking)
-                # keeps memory O(1) in iters, so fetching a large
-                # activation var doesn't allocate iters copies
-                fetches0, persist0 = step(
-                    persist, feed_vals, jax.random.fold_in(base_key, 0))
+                # the fetches carry (instead of scan ys stacking)
+                # keeps memory O(1) in iters; its initial value comes
+                # from eval_shape-derived zeros so EVERY step runs
+                # inside the scan and the step graph is compiled
+                # exactly once (an inlined step 0 would double the
+                # compile of large models — ResNet-50's scan never
+                # finished compiling through the remote helper with
+                # the body traced twice)
+                fetch_avals, _ = jax.eval_shape(step, persist,
+                                                feed_vals, base_key)
+                fetches0 = [jnp.zeros(a.shape, a.dtype)
+                            for a in fetch_avals]
 
                 def body(carry, i):
                     p, _ = carry
@@ -582,7 +588,7 @@ class Executor:
                                  jax.random.fold_in(base_key, i))
                     return (p2, f), None
                 (last_persist, last_fetches), _ = jax.lax.scan(
-                    body, (persist0, fetches0), jnp.arange(1, iters))
+                    body, (persist, fetches0), jnp.arange(iters))
                 return last_fetches, last_persist
 
             fn = jax.jit(multi, donate_argnums=(0,))
