@@ -771,12 +771,21 @@ def child_main():
     _emit(headline)
     _emit_mixes("transformer", mixes)
     if "--all" in sys.argv:
-        extra = [bench_mnist_mlp, bench_resnet50,
-                 bench_resnet50_hostfed, bench_bert, bench_deepfm]
+        # cheapest-compile first: ResNet-50's real NCHW fwd+bwd scan
+        # can take >20 min through the remote AOT helper (round 4: it
+        # never finished inside the window) — it must not starve the
+        # configs that measure in seconds. A stall in any config
+        # forfeits only the ones after it.
+        extra = [bench_mnist_mlp, bench_deepfm, bench_bert,
+                 bench_resnet50, bench_resnet50_hostfed]
         for fn in extra:
             try:
                 _release_device_state()
-                r = fn()
+                guard = _mix_guard("--all config %s" % fn.__name__)
+                try:
+                    r = fn()
+                finally:
+                    guard.cancel()
                 r["vs_baseline"] = _vs_baseline(r.get("mfu"))
                 mixes = r.pop("_mixes", [])
                 print(json.dumps(r), flush=True)
