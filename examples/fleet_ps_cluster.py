@@ -1,0 +1,97 @@
+"""Parameter-server cluster on localhost: 1 pserver + 2 trainers over
+the C++ framed-TCP transport (native/tensor_rpc.cpp) — the reference's
+fleet workflow (init -> distributed_optimizer -> init_server/run_server
+on the server; init_worker -> exe.run(fleet.main_program) ->
+stop_worker on trainers).
+
+Run (spawns its own role subprocesses):
+  JAX_PLATFORMS=cpu python examples/fleet_ps_cluster.py
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def role_main():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        Role, UserDefinedRoleMaker)
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        ParameterServerFleet)
+
+    ep = os.environ["PS_ENDPOINT"]
+    role_name = os.environ["PS_ROLE"]
+    rid = int(os.environ.get("PS_ID", "0"))
+    n_workers = 2
+
+    fleet = ParameterServerFleet()
+    fleet.init(UserDefinedRoleMaker(
+        current_id=rid,
+        role=Role.SERVER if role_name == "server" else Role.WORKER,
+        worker_num=n_workers, server_endpoints=[ep]))
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[13], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        opt = fleet.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.01))
+        opt.minimize(loss)
+
+    if role_name == "server":
+        fleet.init_server()
+        fleet.run_server()      # serves until the launcher kills us
+        return
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    fleet.init_worker()   # adopt server-side init AFTER local startup
+    rs = np.random.RandomState(rid)
+    for step in range(5):
+        xb = rs.rand(16, 13).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        lv, = exe.run(fleet.main_program, feed={"x": xb, "y": yb},
+                      fetch_list=[loss])
+        print("trainer %d step %d loss=%.5f"
+              % (rid, step, float(np.ravel(lv)[0])), flush=True)
+    fleet.stop_worker()
+
+
+def launcher():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+
+    def spawn(role, rid):
+        env = dict(os.environ, PS_ENDPOINT=ep, PS_ROLE=role,
+                   PS_ID=str(rid))
+        return subprocess.Popen([sys.executable, __file__], env=env)
+
+    server = spawn("server", 0)
+    trainers = [spawn("worker", i) for i in range(2)]
+    rc = 1
+    try:
+        rc = 0
+        for p in trainers:
+            rc |= p.wait(timeout=300)
+    finally:
+        # the pserver serves forever by design; never orphan it (it
+        # would hold the inherited stdout pipe open past our exit)
+        server.terminate()
+    print("trainers done rc=%d" % rc)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    if os.environ.get("PS_ROLE"):
+        role_main()
+    else:
+        launcher()

@@ -1,0 +1,61 @@
+"""Minimal train/eval loop — the fluid book's recognize_digits flow.
+
+Run: JAX_PLATFORMS=cpu python examples/train_mnist.py   (or on TPU,
+leave the backend alone). Uses the real MNIST idx files when present
+under DATA_HOME (paddle_tpu/dataset/mnist.py), synthetic otherwise.
+"""
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import mnist
+
+
+def main():
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = layers.data("img", shape=[784], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, size=200, act="relu")
+        h = layers.fc(h, size=200, act="relu")
+        pred = layers.fc(h, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        acc = layers.accuracy(pred, label)
+        test_prog = main_prog.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    reader = fluid.reader.batch(mnist.train(), batch_size=128)
+    feeder = fluid.DataFeeder(feed_list=[img, label],
+                              place=fluid.CPUPlace(),
+                              program=main_prog)
+    for epoch in range(2):
+        for step, batch in enumerate(reader()):
+            lv, av = exe.run(main_prog, feed=feeder.feed(batch),
+                             fetch_list=[loss, acc])
+            if step % 100 == 0:
+                print("epoch %d step %d: loss=%.4f acc=%.3f"
+                      % (epoch, step, float(np.ravel(lv)[0]),
+                         float(np.ravel(av)[0])))
+            if step >= 300:
+                break
+
+    # eval with the test clone (deterministic, dropout off)
+    test_batch = next(iter(fluid.reader.batch(mnist.test(), 256)()))
+    lv, av = exe.run(test_prog, feed=feeder.feed(test_batch),
+                     fetch_list=[loss, acc])
+    print("eval: loss=%.4f acc=%.3f"
+          % (float(np.ravel(lv)[0]), float(np.ravel(av)[0])))
+
+    model_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mnist_model"
+    fluid.io.save_inference_model(model_dir, ["img"], [pred],
+                                  exe, main_program=main_prog)
+    print("saved inference model to %s" % model_dir)
+
+
+if __name__ == "__main__":
+    main()

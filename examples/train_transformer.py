@@ -1,0 +1,62 @@
+"""Transformer-base NMT training (the flagship benchmark config) with
+bf16 AMP and optional Megatron-style tensor parallelism.
+
+Run small on CPU:
+  JAX_PLATFORMS=cpu python examples/train_transformer.py --small
+Multi-device data+tensor parallel (8 virtual CPU devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  JAX_PLATFORMS=cpu python examples/train_transformer.py --small --tp 2
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers  # noqa: F401
+from paddle_tpu.contrib import mixed_precision as amp
+from paddle_tpu.models import transformer as T
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways (shards attention/ffn)")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    if args.small:
+        cfg = T.TransformerConfig(src_vocab=1000, tgt_vocab=1000,
+                                  max_len=32, d_model=64, d_ffn=128,
+                                  n_head=4, n_layer=2)
+        batch = 8
+    else:
+        cfg = T.TransformerConfig()  # transformer-base
+        batch = 64
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main_prog, startup):
+        avg_cost, token_num, _ = T.transformer(cfg)
+        opt = amp.decorate(fluid.optimizer.Adam(learning_rate=1e-3))
+        opt.minimize(avg_cost)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    prog = main_prog
+    if args.tp > 1:
+        T.shard_tp(main_prog)
+        import jax
+        dp = max(jax.device_count() // args.tp, 1)
+        prog = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=avg_cost.name, axes={"dp": dp, "tp": args.tp})
+
+    feed = T.make_fake_batch(cfg, batch)
+    for step in range(args.steps):
+        lv, = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+        print("step %d: loss=%.4f" % (step, float(np.ravel(lv)[0])))
+
+
+if __name__ == "__main__":
+    main()

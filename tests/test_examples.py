@@ -1,0 +1,54 @@
+"""The examples/ directory must keep running — each script is smoke-run
+the way its header documents (reference analog: the book chapters
+doubling as tests)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+EX = os.path.join(ROOT, "examples")
+
+
+def _run(script, args=(), timeout=600, env=None):
+    full_env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+                    **(env or {}))
+    return subprocess.run(
+        [sys.executable, os.path.join(EX, script), *args],
+        env=full_env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_train_mnist_then_deploy(tmp_path):
+    model_dir = str(tmp_path / "mnist_model")
+    r = _run("train_mnist.py", [model_dir])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "saved inference model" in r.stdout
+    d = _run("deploy_inference.py", [model_dir])
+    assert d.returncode == 0, d.stderr[-2000:]
+    assert "clone agrees" in d.stdout
+
+
+def test_train_transformer_small():
+    r = _run("train_transformer.py", ["--small", "--steps", "3"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    losses = [float(ln.split("loss=")[1])
+              for ln in r.stdout.splitlines() if "loss=" in ln]
+    assert len(losses) == 3 and losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_train_transformer_tp2():
+    r = _run("train_transformer.py",
+             ["--small", "--tp", "2", "--steps", "2"],
+             env={"XLA_FLAGS":
+                  "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_fleet_ps_cluster():
+    r = _run("fleet_ps_cluster.py")
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "trainers done rc=0" in r.stdout
