@@ -62,7 +62,8 @@ from .pyreader import DataLoader, PyReader  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import ir  # noqa: F401
 from . import inference  # noqa: F401
-from . import transpiler  # noqa: F401
+from . import transpiler
+from . import utils  # noqa: F401
 from .transpiler import (DistributeTranspiler,  # noqa: F401
                          DistributeTranspilerConfig, memory_optimize,
                          release_memory)

@@ -1,0 +1,141 @@
+"""Multi-process training launcher.
+
+Reference: python/paddle/distributed/launch.py:1-200 — spawns one
+trainer process per GPU card with PADDLE_TRAINER_ID /
+PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS in each child's environment.
+
+TPU redesign: the process unit is a HOST, not a chip — one process
+per host owns all its local chips and `jax.distributed` federates
+hosts into one global device mesh (parallel/multihost.py consumes the
+same PADDLE_* spelling this launcher writes, so reference launch
+scripts port by changing the module name). ``--nproc_per_node`` still
+exists for CPU simulation and forced multi-process-per-host setups;
+each extra process then restricts its visible devices via
+``--selected_devices`` (the FLAGS_selected_gpus analog).
+
+Usage:
+    python -m paddle_tpu.distributed.launch train.py --your --args
+    python -m paddle_tpu.distributed.launch \
+        --cluster_node_ips=10.0.0.1,10.0.0.2 --node_ip=10.0.0.1 \
+        train.py --your --args
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from argparse import REMAINDER, ArgumentParser
+
+
+def _parse_args(argv=None):
+    parser = ArgumentParser(
+        description="start multi-process training "
+        "(PADDLE_TRAINER_* env contract; see "
+        "paddle_tpu.parallel.multihost.init_parallel_env)")
+    parser.add_argument(
+        "--cluster_node_ips", default="127.0.0.1",
+        help="comma-separated ips of all training nodes")
+    parser.add_argument(
+        "--node_ip", default="127.0.0.1",
+        help="this node's ip (must appear in --cluster_node_ips)")
+    parser.add_argument(
+        "--started_port", type=int, default=6170,
+        help="first coordinator port on each node")
+    parser.add_argument(
+        "--nproc_per_node", type=int, default=1,
+        help="processes per node (TPU: 1 process owns every local "
+        "chip; >1 is for CPU simulation / forced splits)")
+    parser.add_argument(
+        "--selected_devices", default=None,
+        help="comma-separated per-process device lists separated by "
+        "';' (FLAGS_selected_gpus analog), e.g. '0,1;2,3'")
+    parser.add_argument(
+        "--log_dir", default=None,
+        help="redirect each worker's output to <log_dir>/worker.N.log")
+    parser.add_argument(
+        "training_script",
+        help="the script to launch (followed by its own args)")
+    parser.add_argument("training_script_args", nargs=REMAINDER)
+    return parser.parse_args(argv)
+
+
+def get_cluster_env(args):
+    """Build the per-process env dicts (exposed for tests)."""
+    ips = [ip.strip() for ip in args.cluster_node_ips.split(",")
+           if ip.strip()]
+    if args.node_ip not in ips:
+        raise ValueError(
+            "--node_ip %s is not in --cluster_node_ips %s"
+            % (args.node_ip, args.cluster_node_ips))
+    nper = args.nproc_per_node
+    endpoints = ["%s:%d" % (ip, args.started_port + i)
+                 for ip in ips for i in range(nper)]
+    node_index = ips.index(args.node_ip)
+    selected = (args.selected_devices.split(";")
+                if args.selected_devices else [None] * nper)
+    if len(selected) != nper:
+        raise ValueError(
+            "--selected_devices must give %d ';'-separated groups, "
+            "got %r" % (nper, args.selected_devices))
+    envs = []
+    for local_rank in range(nper):
+        rank = node_index * nper + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        }
+        if selected[local_rank]:
+            env["FLAGS_selected_devices"] = selected[local_rank]
+        envs.append(env)
+    return envs
+
+
+def launch(args):
+    envs = get_cluster_env(args)
+    procs, logs = [], []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local_rank, env in enumerate(envs):
+        cmd = [sys.executable, "-u", args.training_script] \
+            + args.training_script_args
+        full = dict(os.environ, **env)
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(
+                args.log_dir,
+                "worker.%s.log" % env["PADDLE_TRAINER_ID"]), "w")
+            logs.append(out)
+        procs.append(subprocess.Popen(cmd, env=full, stdout=out,
+                                      stderr=out))
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+            if p.returncode != 0:
+                # one dead worker wedges the collective — take the
+                # rest down (the reference launcher's terminate-all)
+                for q in procs:
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    return launch(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

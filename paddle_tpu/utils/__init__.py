@@ -1,0 +1,6 @@
+"""paddle.utils analog (reference: python/paddle/utils/__init__.py).
+The v1-era preprocess_img/torch2paddle legacy helpers are not ported
+(dead surface per SURVEY); plot.Ploter is, because every book chapter
+draws its cost curve with it."""
+from . import plot  # noqa: F401
+from .plot import Ploter  # noqa: F401

@@ -1,0 +1,68 @@
+"""Training-curve plotting helper used throughout the book tutorials.
+
+Reference: python/paddle/utils/plot.py:17-116 (PlotData/Ploter —
+matplotlib when a display exists, silent data collection otherwise).
+Headless TPU pods are the common case here, so the data always
+accumulates and drawing is best-effort."""
+
+from __future__ import annotations
+
+import os
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """Ploter("train cost", "test cost"); .append(title, step, value);
+    .plot(path=None) draws (or saves) one figure with all series."""
+
+    def __init__(self, *titles):
+        self.__args__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "")
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__.lower() == "true"
+
+    def append(self, title, step, value):
+        if title not in self.__plot_data__:
+            raise KeyError(
+                "no such series %r (declared: %s)"
+                % (title, list(self.__plot_data__)))
+        self.__plot_data__[title].append(step, value)
+
+    def reset(self):
+        for d in self.__plot_data__.values():
+            d.reset()
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        try:
+            import matplotlib
+            if path is not None or not os.environ.get("DISPLAY"):
+                matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except Exception:
+            return  # headless image without matplotlib: keep the data
+        plt.figure()
+        for title, data in self.__plot_data__.items():
+            plt.plot(data.step, data.value, label=title)
+        plt.legend()
+        if path is not None:
+            plt.savefig(path)
+        else:
+            plt.show()
+        plt.close()

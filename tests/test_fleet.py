@@ -305,3 +305,57 @@ class TestFleetPSTwoProcess:
             finally:
                 if server.poll() is None:
                     server.kill()
+
+
+class TestLaunchModule:
+    def test_cluster_env_contract(self):
+        """python -m paddle_tpu.distributed.launch writes exactly the
+        PADDLE_TRAINER_* env vars init_parallel_env consumes
+        (reference launch.py's get_cluster env contract)."""
+        from paddle_tpu.distributed import launch as L
+
+        args = L._parse_args([
+            "--cluster_node_ips=10.0.0.1,10.0.0.2",
+            "--node_ip=10.0.0.2", "--started_port=7000",
+            "--nproc_per_node=2", "train.py", "--foo"])
+        envs = L.get_cluster_env(args)
+        assert len(envs) == 2
+        assert envs[0]["PADDLE_TRAINER_ID"] == "2"  # node 1, local 0
+        assert envs[1]["PADDLE_TRAINER_ID"] == "3"
+        assert envs[0]["PADDLE_TRAINERS_NUM"] == "4"
+        eps = envs[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert eps == ["10.0.0.1:7000", "10.0.0.1:7001",
+                       "10.0.0.2:7000", "10.0.0.2:7001"]
+        assert envs[1]["PADDLE_CURRENT_ENDPOINT"] == "10.0.0.2:7001"
+        assert args.training_script == "train.py"
+        assert args.training_script_args == ["--foo"]
+
+    def test_bad_node_ip_rejected(self):
+        from paddle_tpu.distributed import launch as L
+        args = L._parse_args(["--node_ip=9.9.9.9", "t.py"])
+        with pytest.raises(ValueError, match="not in"):
+            L.get_cluster_env(args)
+
+    def test_launch_runs_workers(self, tmp_path):
+        """End to end: launch a 2-process script; each worker sees its
+        rank env and exits 0; a failing worker propagates rc."""
+        from paddle_tpu.distributed import launch as L
+
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            "rid = os.environ['PADDLE_TRAINER_ID']\n"
+            "print('rank', rid, 'of',\n"
+            "      os.environ['PADDLE_TRAINERS_NUM'])\n"
+            "sys.exit(0 if len(sys.argv) == 1 else int(sys.argv[1]))\n")
+        args = L._parse_args(["--nproc_per_node=2",
+                              "--log_dir", str(tmp_path / "logs"),
+                              str(script)])
+        assert L.launch(args) == 0
+        logs = sorted((tmp_path / "logs").glob("worker.*.log"))
+        assert [p.name for p in logs] == ["worker.0.log",
+                                          "worker.1.log"]
+        assert "rank 0 of 2" in logs[0].read_text()
+
+        args2 = L._parse_args(["--nproc_per_node=2", str(script), "3"])
+        assert L.launch(args2) == 3

@@ -150,3 +150,32 @@ class TestTopLevelSurface:
                 last = v
             assert last < first * 0.5, (first, last)
             pe.drop_local_exe_scopes()
+
+
+def test_utils_ploter():
+    """paddle.utils.plot.Ploter (reference plot.py): series append,
+    unknown-series rejection, reset, and headless save."""
+    import os
+    import tempfile
+
+    import pytest
+
+    import paddle_tpu as fluid
+
+    p = fluid.utils.Ploter("train cost", "test cost")
+    for i in range(5):
+        p.append("train cost", i, 1.0 / (i + 1))
+    p.append("test cost", 0, 0.5)
+    assert p.__plot_data__["train cost"].step == [0, 1, 2, 3, 4]
+    with pytest.raises(KeyError, match="no such series"):
+        p.append("nope", 0, 0.0)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "curve.png")
+        p.plot(path)  # best-effort: file exists iff matplotlib does
+        try:
+            import matplotlib  # noqa: F401
+            assert os.path.exists(path)
+        except ImportError:
+            pass
+    p.reset()
+    assert p.__plot_data__["train cost"].step == []
