@@ -1,0 +1,49 @@
+"""Deprecated evaluator facade.
+
+Reference: python/paddle/fluid/evaluator.py:26-430 — already
+deprecated THERE ("better to use fluid.metrics", its own warning), but
+1.x model code still imports ChunkEvaluator / EditDistance /
+DetectionMAP from fluid.evaluator. Each shim warns once and delegates
+to the maintained implementation: the in-graph ops live in
+layers.chunk_eval / layers.edit_distance / layers.detection,
+host-side accumulation in metrics.py.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from . import metrics as _metrics
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+def _warn(name, use):
+    warnings.warn(
+        "fluid.evaluator.%s is deprecated (as in the reference); use "
+        "%s instead" % (name, use), DeprecationWarning, stacklevel=3)
+
+
+class ChunkEvaluator(_metrics.ChunkEvaluator):
+    def __init__(self, *args, **kwargs):
+        _warn("ChunkEvaluator",
+              "fluid.metrics.ChunkEvaluator with layers.chunk_eval")
+        super().__init__()
+        # graph-building arguments of the old API are not needed:
+        # feed layers.chunk_eval's counters into update()
+        self._legacy_args = (args, kwargs)
+
+
+class EditDistance(_metrics.EditDistance):
+    def __init__(self, *args, **kwargs):
+        _warn("EditDistance",
+              "fluid.metrics.EditDistance with layers.edit_distance")
+        super().__init__()
+        self._legacy_args = (args, kwargs)
+
+
+class DetectionMAP(_metrics.DetectionMAP):
+    def __init__(self, *args, **kwargs):
+        _warn("DetectionMAP", "fluid.metrics.DetectionMAP")
+        super().__init__()
+        self._legacy_args = (args, kwargs)

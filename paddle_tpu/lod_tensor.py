@@ -1,0 +1,74 @@
+"""LoD-tensor migration bridge.
+
+Reference: python/paddle/fluid/lod_tensor.py:22-151
+(create_lod_tensor / create_random_int_lodtensor). This framework has
+NO LoD metadata by design (SURVEY: TPUs want static shapes — every
+sequence op takes padded data + an explicit lengths vector instead),
+so these helpers return the padded+lengths pair directly: the exact
+feed format `layers.data([max_len, ...]) + seq_len=` sites consume.
+A reference program migrates by replacing its one create_lod_tensor
+call and threading the returned lengths into its sequence ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core.enforce import enforce
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Pack ragged rows into (padded [batch, max_len, ...], lengths
+    [batch] int64).
+
+    ``data``: flat ndarray of shape [sum(lens), ...] (the reference's
+    LoDTensor storage layout) or a Python list of per-sequence lists.
+    ``recursive_seq_lens``: one level, e.g. [[2, 3]] — deeper LoD
+    nesting was only used by nested-sequence ops the padded redesign
+    scopes out. ``place`` is accepted for signature parity.
+    """
+    del place
+    enforce(recursive_seq_lens and len(recursive_seq_lens) == 1,
+            "padded+lengths replaces exactly ONE LoD level; got %r "
+            "levels (nested sequences: restructure as [batch, outer, "
+            "inner] padded dims)"
+            % (len(recursive_seq_lens or ())))
+    lens = list(recursive_seq_lens[0])
+    enforce(all(int(n) >= 0 for n in lens),
+            "sequence lengths must be >= 0, got %r" % (lens,))
+    if isinstance(data, (list, tuple)):
+        flat = np.concatenate(
+            [np.asarray(seq).reshape(len(seq), -1) for seq in data
+             if len(seq)], axis=0) if any(len(s) for s in data) \
+            else np.zeros((0, 1))
+        enforce(len(data) == len(lens) or sum(lens) == sum(
+            len(s) for s in data),
+            "list data does not match recursive_seq_lens")
+    else:
+        flat = np.asarray(data)
+    total = int(sum(lens))
+    enforce(flat.shape[0] == total,
+            "data rows (%d) != sum of sequence lengths (%d)"
+            % (flat.shape[0], total))
+    max_len = max(lens) if lens else 0
+    padded = np.zeros((len(lens), max_len) + flat.shape[1:],
+                      dtype=flat.dtype)
+    off = 0
+    for i, n in enumerate(lens):
+        padded[i, :n] = flat[off:off + n]
+        off += n
+    return padded, np.asarray(lens, dtype=np.int64)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    """Reference lod_tensor.py:100 — random ints in [low, high] packed
+    per ``create_lod_tensor``."""
+    enforce(recursive_seq_lens and len(recursive_seq_lens) == 1,
+            "one LoD level (see create_lod_tensor)")
+    total = int(sum(recursive_seq_lens[0]))
+    shape = (total,) + tuple(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype(np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

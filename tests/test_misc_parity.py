@@ -179,3 +179,58 @@ def test_utils_ploter():
             pass
     p.reset()
     assert p.__plot_data__["train cost"].step == []
+
+
+def test_create_lod_tensor_bridge():
+    """fluid.create_lod_tensor (reference lod_tensor.py:22) returns
+    the padded+lengths pair this framework's sequence ops consume."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    padded, lens = fluid.create_lod_tensor(flat, [[2, 3]],
+                                           fluid.CPUPlace())
+    assert padded.shape == (2, 3, 2)
+    assert lens.tolist() == [2, 3]
+    assert np.allclose(padded[0, :2], flat[:2])
+    assert np.allclose(padded[1], flat[2:])
+    assert np.all(padded[0, 2] == 0)
+
+    with pytest.raises(Exception, match="ONE LoD level"):
+        fluid.create_lod_tensor(flat, [[1], [2, 2]], None)
+
+    # the pair feeds a sequence op directly
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[3], dtype="float32")
+        sl = layers.data("sl", shape=[], append_batch_size=False,
+                         dtype="int64")
+        pooled = layers.sequence_pool(x, "sum", seq_len=sl)
+    exe = fluid.Executor()
+    out, = exe.run(main, feed={"x": padded[:, :, 0], "sl": lens},
+                   fetch_list=[pooled])
+    assert np.allclose(np.ravel(out), [flat[:2, 0].sum(),
+                                       flat[2:, 0].sum()])
+
+    rnd, rlens = fluid.create_random_int_lodtensor(
+        [[1, 4]], base_shape=[1], place=None, low=0, high=9)
+    assert rnd.shape == (2, 4, 1) and rlens.tolist() == [1, 4]
+    assert rnd.max() <= 9 and rnd.min() >= 0
+
+
+def test_evaluator_deprecation_shims():
+    import warnings
+
+    import paddle_tpu as fluid
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ev = fluid.evaluator.EditDistance()
+        assert any(issubclass(x.category, DeprecationWarning)
+                   for x in w)
+    ev.update([2.0, 0.0], seq_num=2)  # metrics.EditDistance API
+    dist, instance_err = ev.eval()
+    assert dist == 1.0 and instance_err == 0.5
