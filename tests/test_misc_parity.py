@@ -234,3 +234,32 @@ def test_evaluator_deprecation_shims():
     ev.update([2.0, 0.0], seq_num=2)  # metrics.EditDistance API
     dist, instance_err = ev.eval()
     assert dist == 1.0 and instance_err == 0.5
+
+
+def test_debugger_pprint_and_graphviz(tmp_path, capsys):
+    """fluid.debugger (reference debugger.py): program pseudo-code
+    dump and block graphviz rendering."""
+    import os
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = layers.data("x", shape=[4], dtype="float32")
+        h = layers.fc(x, size=3, act="relu")
+        loss = layers.reduce_mean(h)
+        fluid.append_backward(loss)
+    text = fluid.debugger.pprint_program_codes(main)
+    assert "block 0" in text and "mul(" in text
+    assert "@GRAD" not in text  # backward hidden by default
+    full = fluid.debugger.pprint_block_codes(
+        main.global_block(), show_backward=True)
+    assert "@GRAD" in full
+
+    dot = str(tmp_path / "b.dot")
+    out = fluid.debugger.draw_block_graphviz(main.global_block(),
+                                             path=dot)
+    assert out == dot and os.path.exists(dot)
+    body = open(dot).read()
+    assert body.startswith("digraph") and "mul" in body
