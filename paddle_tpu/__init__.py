@@ -64,6 +64,7 @@ from . import ir  # noqa: F401
 from . import inference  # noqa: F401
 from . import transpiler
 from . import utils  # noqa: F401
+from .reader import batch  # noqa: F401  (paddle.batch, __init__.py:29)
 from . import debugger  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import lod_tensor  # noqa: F401
