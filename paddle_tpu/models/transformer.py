@@ -213,6 +213,136 @@ def transformer(cfg: TransformerConfig, is_test=False):
     return avg_cost, token_num, logits
 
 
+def fast_decode(cfg: TransformerConfig, beam_size, max_out_len,
+                bos_idx=0, eos_idx=1):
+    """Beam-search inference graph (reference: dist_transformer.py
+    fast_decode:1498 — while_op + beam_search over LoD-pruned beams
+    with per-layer KV caches).
+
+    TPU-first reformulation, fully compiled — the decode loop lowers
+    to ONE lax.while_loop (layers.While with dense state only, no
+    tensor arrays), so there is no per-step host dispatch:
+
+      - beams are a dense [batch, K] frontier riding a flattened
+        batch*K axis through the decoder (ops/beam_search_ops.py
+        replaces LoD pruning: finished beams survive as end_id
+        continuations);
+      - instead of KV caches the prefix buffer [batch*K, T] is
+        re-decoded each step and the current position is picked with
+        a one-hot time mask — recompute is XLA's preferred trade on
+        TPU (static shapes, no growing buffers); O(T^2) total like
+        the cached formulation's attention anyway;
+      - beam reordering (the reference's sequence_expand by score
+        LoD) is a batched one-hot matmul over the beam axis, and the
+        history is reordered IN-LOOP so no backtrack pass is needed;
+      - ids/masks round-trip through f32 for the arithmetic one-hots
+        (exact for vocab < 2^23).
+
+    Run it with the TRAINED scope: parameter names match the training
+    graph (enc*/dec*/proj), so ``exe.run(decode_prog, ...)`` after
+    training (or after io.load_persistables) just works.
+
+    Declares feeds src_ids/src_mask [batch, cfg.max_len]; returns
+    (sentence_ids [batch, K, max_out_len+1] best-first,
+    sentence_scores [batch, K]).
+    """
+    from ..core.enforce import enforce
+    K = int(beam_size)
+    T = int(max_out_len)
+    enforce(T + 1 <= cfg.max_len,
+            "max_out_len+1 (%d) exceeds the positional table "
+            "(cfg.max_len=%d)" % (T + 1, cfg.max_len))
+    s = cfg.max_len
+    src_ids = layers.data("src_ids", shape=[s], dtype="int64")
+    src_mask = layers.data("src_mask", shape=[s], dtype="float32")
+
+    enc_out = encoder(src_ids, src_mask, cfg, is_test=True)
+
+    # expand encoder state K-fold onto the flattened beam batch
+    enc_k = layers.expand(layers.unsqueeze(enc_out, [1]), [1, K, 1, 1])
+    enc_k = layers.reshape(enc_k, (-1, s, cfg.d_model))
+    src_mask_k = layers.reshape(
+        layers.expand(layers.unsqueeze(src_mask, [1]), [1, K, 1]),
+        (-1, s))
+
+    # dense loop state, batch-size-agnostic (derived from src_mask)
+    zeros_b = layers.scale(layers.reduce_sum(src_mask, dim=1,
+                                             keep_dim=True), scale=0.0)
+    # scores: beam 0 live, others -inf so step 1 fans out from bos
+    init_row = layers.assign(
+        np.array([0.0] + [-1e9] * (K - 1), np.float32))
+    scores = layers.elementwise_add(zeros_b, init_row)      # [B, K]
+    last_ids = layers.cast(
+        layers.scale(scores, scale=0.0, bias=float(bos_idx)), "int64")
+    hist = layers.cast(layers.expand(
+        layers.unsqueeze(layers.scale(scores, scale=0.0,
+                                      bias=float(bos_idx)), [2]),
+        [1, 1, T + 1]), "int64")                            # [B,K,T+1]
+
+    step = layers.fill_constant([1], "int64", value=1)
+    max_c = layers.fill_constant([1], "int64", value=T + 1)
+    cond = layers.less_than(step, max_c)
+
+    kidx = layers.assign(np.arange(K, dtype=np.float32))      # [K]
+    tidx = layers.assign(np.arange(T + 1, dtype=np.float32))  # [T+1]
+
+    w_proj = layers.create_parameter(
+        shape=(cfg.d_model, cfg.tgt_vocab), dtype="float32",
+        attr=ParamAttr(name="proj.w_0"))
+
+    loop = layers.While(cond)
+    with loop.block():
+        tgt = layers.reshape(hist, (-1, T + 1))         # [B*K, T+1]
+        tgt_mask = layers.cast(
+            layers.scale(layers.cast(tgt, "float32"), scale=0.0,
+                         bias=1.0), "float32")
+        dec_out = decoder(tgt, enc_k, src_mask_k, tgt_mask, cfg,
+                          is_test=True)                 # [B*K,T+1,D]
+        # pick position step-1 with an arithmetic one-hot over time
+        step_f = layers.cast(step, "float32")
+        tmask = layers.relu(
+            1.0 - layers.square(tidx - (step_f - 1.0)))  # [T+1]
+        cur = layers.reduce_sum(
+            dec_out * layers.unsqueeze(tmask, [1]), dim=1)  # [B*K,D]
+        logits = layers.matmul(cur, w_proj)             # [B*K, V]
+        logp = layers.log(layers.softmax(logits) + 1e-20)
+        logp3 = layers.reshape(logp, (-1, K, cfg.tgt_vocab))
+
+        sel_ids, sel_scores, parent = layers.beam_search(
+            pre_ids=last_ids, pre_scores=scores, ids=None,
+            scores=logp3, beam_size=K, end_id=eos_idx)
+
+        # reorder history by parent (one-hot matmul over the beam
+        # axis), then write the new ids at position `step`
+        oh = layers.relu(1.0 - layers.square(
+            layers.unsqueeze(layers.cast(parent, "float32"), [2])
+            - kidx))                                     # [B,K,K]
+        hist_f = layers.matmul(oh, layers.cast(hist, "float32"))
+        wmask = layers.relu(1.0 - layers.square(tidx - step_f))
+        hist_new = hist_f * (1.0 - wmask) + \
+            layers.cast(layers.unsqueeze(sel_ids, [2]),
+                        "float32") * wmask
+        layers.assign(layers.cast(hist_new, "int64"), hist)
+        layers.assign(sel_ids, last_ids)
+        layers.assign(sel_scores, scores)
+        layers.increment(step, value=1)
+        # continue while steps remain AND any beam is unfinished
+        alive = layers.reduce_sum(layers.cast(
+            layers.square(layers.cast(sel_ids, "float32")
+                          - float(eos_idx)), "float32"))
+        zero_c = layers.fill_constant([1], "float32", value=0.0)
+        layers.logical_and(layers.less_than(step, max_c),
+                           layers.less_than(zero_c, alive), out=cond)
+
+    # best-first: reorder by final scores
+    order_scores, order = layers.topk(scores, K)          # [B, K]
+    ooh = layers.relu(1.0 - layers.square(
+        layers.unsqueeze(layers.cast(order, "float32"), [2]) - kidx))
+    out_ids = layers.cast(
+        layers.matmul(ooh, layers.cast(hist, "float32")), "int64")
+    return out_ids, order_scores
+
+
 def shard_tp(program, axis="tp"):
     """Annotate attention/ffn weights Megatron-style over the tp axis:
     q/k/v and ffn fc1 column-parallel, output proj and ffn fc2

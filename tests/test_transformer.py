@@ -86,3 +86,104 @@ def test_transformer_tp_sharded_matches_replicated():
     ref = run(False)
     tp = run(True)
     np.testing.assert_allclose(tp, ref, rtol=2e-4, atol=1e-5)
+
+
+class TestFastDecode:
+    def _cfg(self):
+        from paddle_tpu.models import transformer as T
+        return T.TransformerConfig(src_vocab=40, tgt_vocab=40,
+                                   max_len=12, d_model=16, d_ffn=32,
+                                   n_head=2, n_layer=2, dropout=0.0)
+
+    def _build(self, cfg, K, T_out):
+        import paddle_tpu as fluid
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+        with unique_name.guard():
+            train, startup = fluid.Program(), fluid.Program()
+            train.random_seed = startup.random_seed = 9
+            with fluid.program_guard(train, startup):
+                T.transformer(cfg, is_test=False)
+        with unique_name.guard():
+            dec = fluid.Program()
+            with fluid.program_guard(dec, fluid.Program()):
+                out_ids, out_scores = T.fast_decode(
+                    cfg, beam_size=K, max_out_len=T_out, bos_idx=0,
+                    eos_idx=1)
+        return train, startup, dec, out_ids, out_scores
+
+    def _feed(self, cfg, B=2, seed=3):
+        rs = np.random.RandomState(seed)
+        s = cfg.max_len
+        src = rs.randint(2, cfg.src_vocab, (B, s)).astype(np.int64)
+        mask = np.ones((B, s), np.float32)
+        mask[:, s // 2:] = 0.0
+        return {"src_ids": src, "src_mask": mask}
+
+    def test_decodes_and_orders_beams(self):
+        import paddle_tpu as fluid
+        cfg = self._cfg()
+        K, T_out = 3, 6
+        train, startup, dec, out_ids, out_scores = self._build(
+            cfg, K, T_out)
+        scope = fluid.core.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ids, scores = exe.run(dec, feed=self._feed(cfg),
+                                  fetch_list=[out_ids, out_scores])
+        assert ids.shape == (2, K, T_out + 1)
+        assert scores.shape == (2, K)
+        assert np.all(ids[:, :, 0] == 0)          # bos everywhere
+        assert np.all(np.diff(scores, axis=1) <= 1e-5)  # best-first
+        # eos is sticky: after the first eos only eos follows
+        for b in range(2):
+            for k in range(K):
+                row = ids[b, k, 1:]
+                hit = np.where(row == 1)[0]
+                if hit.size:
+                    assert np.all(row[hit[0]:] == 1)
+        # deterministic
+        with fluid.scope_guard(scope):
+            ids2, _ = exe.run(dec, feed=self._feed(cfg),
+                              fetch_list=[out_ids, out_scores])
+        assert np.array_equal(ids, ids2)
+
+    def test_greedy_matches_teacher_forced_argmax(self):
+        """K=1 fast_decode must equal the greedy rollout computed from
+        the training graph's teacher-forced logits at every position
+        (the decode loop and full-sequence decoder share weights AND
+        math)."""
+        import paddle_tpu as fluid
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+        cfg = self._cfg()
+        T_out = 5
+        train, startup, dec, out_ids, out_scores = self._build(
+            cfg, 1, T_out)
+        with unique_name.guard():
+            logit_prog = fluid.Program()
+            with fluid.program_guard(logit_prog, fluid.Program()):
+                _cost, _tok, logits = T.transformer(cfg, is_test=True)
+        scope = fluid.core.Scope()
+        exe = fluid.Executor()
+        feed = self._feed(cfg, B=2)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ids, _ = exe.run(dec, feed=feed,
+                             fetch_list=[out_ids, out_scores])
+            seq = ids[:, 0, :]                     # [B, T_out+1]
+            B, s = 2, cfg.max_len
+            tgt = np.zeros((B, s), np.int64)
+            tgt[:, :T_out + 1] = seq
+            full = dict(feed, tgt_ids=tgt,
+                        lbl_ids=np.zeros((B, s), np.int64),
+                        tgt_mask=np.ones((B, s), np.float32))
+            lg, = exe.run(logit_prog, feed=full, fetch_list=[logits])
+        for b in range(B):
+            for t in range(1, T_out + 1):
+                if seq[b, t - 1] == 1:     # finished: stays eos
+                    assert seq[b, t] == 1
+                    continue
+                want = int(np.argmax(lg[b, t - 1]))
+                assert seq[b, t] == want, (b, t, seq[b], want)
