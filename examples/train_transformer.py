@@ -57,6 +57,23 @@ def main():
         lv, = exe.run(prog, feed=feed, fetch_list=[avg_cost])
         print("step %d: loss=%.4f" % (step, float(np.ravel(lv)[0])))
 
+    if args.tp == 1:
+        # beam-search inference with the trained weights: the decode
+        # program shares parameter names with the training graph
+        from paddle_tpu import unique_name
+        with unique_name.guard():
+            dec = fluid.Program()
+            with fluid.program_guard(dec, fluid.Program()):
+                out_ids, out_scores = T.fast_decode(
+                    cfg, beam_size=2,
+                    max_out_len=min(8, cfg.max_len - 1))
+        ids, scores = exe.run(
+            dec, feed={"src_ids": feed["src_ids"],
+                       "src_mask": feed["src_mask"]},
+            fetch_list=[out_ids, out_scores])
+        print("decoded[0], best beam:", ids[0, 0].tolist(),
+              "score %.3f" % scores[0, 0])
+
 
 if __name__ == "__main__":
     main()
