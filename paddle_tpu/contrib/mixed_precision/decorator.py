@@ -67,25 +67,32 @@ class OptimizerWithMixedPrecision:
             scaled_loss, startup_program, parameter_list, no_grad_set,
             callbacks)
 
-        inv = 1.0 / self._loss_scaling
-        if self._use_dynamic_loss_scaling:
-            finite = None
-            for _p, g in params_grads:
-                f = layers.reduce_all(layers.isfinite(g))
-                finite = f if finite is None else \
-                    layers.logical_and(finite, f)
-            self._all_finite = finite
-            # non-finite step: select zeros (a where, NOT a multiply —
-            # inf * 0 would poison the update with NaN) so the step is
-            # a no-op (reference: update_loss_scaling zeroes grads on
-            # overflow)
-            params_grads = [
-                (p, layers.where(finite, g * inv,
-                                 layers.zeros_like(g)))
-                for p, g in params_grads]
-            self._append_scale_update(finite)
-        else:
-            params_grads = [(p, g * inv) for p, g in params_grads]
+        # Everything from here on is update machinery: stamp the
+        # optimize role so clone(for_test=True) prunes it along with
+        # the backward ops it reads (framework.op_role_guard) — a test
+        # clone keeping an isfinite(g) op would dangle on the pruned
+        # gradient vars.
+        from ...framework import op_role_guard
+        with op_role_guard(main, "optimize"):
+            inv = 1.0 / self._loss_scaling
+            if self._use_dynamic_loss_scaling:
+                finite = None
+                for _p, g in params_grads:
+                    f = layers.reduce_all(layers.isfinite(g))
+                    finite = f if finite is None else \
+                        layers.logical_and(finite, f)
+                self._all_finite = finite
+                # non-finite step: select zeros (a where, NOT a
+                # multiply — inf * 0 would poison the update with NaN)
+                # so the step is a no-op (reference:
+                # update_loss_scaling zeroes grads on overflow)
+                params_grads = [
+                    (p, layers.where(finite, g * inv,
+                                     layers.zeros_like(g)))
+                    for p, g in params_grads]
+                self._append_scale_update(finite)
+            else:
+                params_grads = [(p, g * inv) for p, g in params_grads]
         return params_grads, scaled_loss
 
     def _append_scale_update(self, finite):
@@ -130,8 +137,13 @@ class OptimizerWithMixedPrecision:
             loss, startup_program, parameter_list, no_grad_set)
         if grad_clip is not None:
             from ...clip import append_gradient_clip_ops
-            params_grads = append_gradient_clip_ops(params_grads,
-                                                    grad_clip)
+            from ...framework import (default_main_program,
+                                      op_role_guard)
+            # clip ops read gradient vars: optimize role, or a test
+            # clone keeps them dangling (same guard as backward())
+            with op_role_guard(default_main_program(), "optimize"):
+                params_grads = append_gradient_clip_ops(params_grads,
+                                                        grad_clip)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 

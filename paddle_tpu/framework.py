@@ -308,6 +308,10 @@ class Block:
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None,
                   index=None) -> Operator:
+        role = getattr(self.program, "_current_op_role", None)
+        if role is not None and (attrs is None
+                                 or "op_role" not in attrs):
+            attrs = dict(attrs or {}, op_role=role)
         op = Operator(self, type, inputs, outputs, attrs)
         if index is None:
             self.ops.append(op)
@@ -789,6 +793,23 @@ def program_guard(main_program, startup_program=None):
         switch_main_program(prev_main)
         if prev_startup is not None:
             switch_startup_program(prev_startup)
+
+
+@contextlib.contextmanager
+def op_role_guard(program, role):
+    """Stamp ``op_role`` on every op appended to ``program`` inside the
+    block (unless an op sets its own). The analog of the reference's
+    ``program._optimized_guard`` / OpRole attr machinery
+    (framework.py:1268): clone(for_test=True) prunes by op_role, so
+    machinery appended AROUND the optimizer (AMP loss scaling, grad
+    clipping) must carry the optimize role or a test clone keeps ops
+    that reference pruned gradient vars."""
+    prev = getattr(program, "_current_op_role", None)
+    program._current_op_role = role
+    try:
+        yield
+    finally:
+        program._current_op_role = prev
 
 
 def _reset_default_programs():

@@ -148,6 +148,15 @@ class Optimizer:
         return new_pg, should
 
     def apply_gradients(self, params_grads):
+        # update machinery appended through layers.* helpers
+        # (regularizers, clip, accumulation gates) must carry the
+        # optimize role so clone(for_test=True) prunes it with the
+        # backward ops it reads (framework.op_role_guard)
+        with framework.op_role_guard(default_main_program(),
+                                     "optimize"):
+            return self._apply_gradients_impl(params_grads)
+
+    def _apply_gradients_impl(self, params_grads):
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
@@ -197,8 +206,10 @@ class Optimizer:
                                      parameter_list, no_grad_set)
         if grad_clip is not None:
             from .clip import append_gradient_clip_ops
-            params_grads = append_gradient_clip_ops(params_grads,
-                                                    grad_clip)
+            with framework.op_role_guard(default_main_program(),
+                                         "optimize"):
+                params_grads = append_gradient_clip_ops(params_grads,
+                                                        grad_clip)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 

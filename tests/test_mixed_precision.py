@@ -196,3 +196,87 @@ def test_gray_op_without_low_input_untouched():
     n = mp.rewrite_program(main, mp.AutoMixedPrecisionLists())
     assert n == 0
     assert all(op.type != "cast" for op in main.global_block().ops)
+
+
+def test_clone_for_test_prunes_amp_machinery():
+    """clone(for_test=True) after amp.decorate(...).minimize must
+    produce a runnable eval program: the loss-scaling machinery
+    (isfinite/where/scale updates) carries the optimize op_role and is
+    pruned with the backward ops it reads. Round-4 verify regression:
+    the isfinite ops used to survive the clone and dangle on pruned
+    gradient vars."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as amp
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    startup.random_seed = 4
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(p, y))
+            amp.decorate(fluid.optimizer.AdamOptimizer(1e-3)) \
+                .minimize(loss)
+    test_prog = main.clone(for_test=True)
+    # no op in the clone may reference a gradient var
+    for op in test_prog.global_block().ops:
+        for name in op.input_arg_names:
+            assert "@GRAD" not in name, (op.type, name)
+        assert op.type != "isfinite", "loss-scaling survived the clone"
+
+    scope = fluid.core.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        feed = {"x": rs.randn(16, 8).astype(np.float32),
+                "y": rs.randn(16, 1).astype(np.float32)}
+        l_train, = exe.run(main, feed=feed, fetch_list=[loss])
+        l_eval, = exe.run(test_prog, feed=feed,
+                          fetch_list=[loss.name])
+        assert np.isfinite(float(l_eval))
+        # eval must not have updated parameters or loss-scaling state
+        l_eval2, = exe.run(test_prog, feed=feed,
+                           fetch_list=[loss.name])
+        assert float(l_eval) == float(l_eval2)
+
+
+def test_soft_labels_stay_f32_under_amp():
+    """Gray-listing softmax_with_cross_entropy must NOT cast float32
+    soft-label targets down to bf16 (F32_CONTRACT_INPUTS): a
+    bf16-rounded distillation target loses ~3 decimal digits the loss
+    then inherits. Round-4 review regression test."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.mixed_precision.fp16_lists import (
+        AutoMixedPrecisionLists)
+    from paddle_tpu.contrib.mixed_precision.fp16_utils import (
+        rewrite_program)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            soft = fluid.layers.data("soft", shape=[10],
+                                     dtype="float32")
+            logits = fluid.layers.fc(x, size=10)
+            _, loss = fluid.layers.softmax_with_cross_entropy(
+                logits, soft, soft_label=True, return_softmax=True)
+    rewrite_program(main, AutoMixedPrecisionLists(), "bfloat16")
+    block = main.global_block()
+    for op in block.ops:
+        if op.type != "softmax_with_cross_entropy":
+            continue
+        # the logits input may be bf16 (gray), the Label must not be
+        # a cast-down copy
+        for name in op.inputs.get("Label", []):
+            var = block._find_var_recursive(name)
+            assert var is not None and var.dtype == "float32", name
+            assert "cast_bfloat16" not in name, name
