@@ -319,15 +319,18 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=25,
     _log("building transformer-base program")
     cfg, run, tokens_per_step = _build_transformer_step(batch, seq_len)
 
-    # curated mixes, most promising first (the soft budget may cut the
-    # tail), per the round-4 chip-measured kernel table (BASELINE.md,
-    # tools/kernel_table.py, honest in-graph protocol): layer_norm
-    # (1.72x) and adam (1.36x) pallas WIN at flagship shape;
-    # attention (0.63x), softmax_xent (0.58x) and fused_linear_xent
-    # (0.64x) LOSE to XLA and are off the default mix — only-winners
-    # discipline (jit/README.en.md). The fused-xent mix is still
-    # measured last as evidence the demotion holds in-model.
-    mixes = ("layer_norm:pallas,adam:pallas",
+    # curated mixes, most promising first (the soft budget may cut
+    # the tail). Round-4 chip evidence (BASELINE.md, tools/
+    # kernel_table.py + tools/lever_ab.py): the single-k-block flash
+    # attention WINS IN-MODEL by +12% (13.08 vs 11.69 steps/s,
+    # 2026-07-31) even though the f32 no-dropout micro-benchmark has
+    # it 0.94x — bf16 operands + in-kernel PRNG dropout is the real
+    # workload, and micro-benchmarks do not transfer in either
+    # direction. layer_norm (1.72x) and adam (1.36x) win at the OP
+    # level but lose in-model (custom-call boundary cost); they and
+    # fused_linear_xent are measured as evidence the demotions hold.
+    mixes = ("scaled_dot_product_attention:pallas",
+             "scaled_dot_product_attention:pallas,layer_norm:pallas",
              "layer_norm:pallas",
              "adam:pallas",
              "fused_linear_xent:pallas")

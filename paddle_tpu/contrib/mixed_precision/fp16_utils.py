@@ -16,6 +16,26 @@ from __future__ import annotations
 from ... import framework
 from ...framework import convert_dtype
 
+# Output slots that stay float32 by lowering contract even when the
+# op itself runs on low-precision inputs (the lowering computes them
+# in f32 internally and returns f32) — marking them "low" would make
+# downstream gray consumers cast genuine f32 operands down
+# (e.g. per-token loss weights multiplied into the Loss).
+F32_CONTRACT_OUTPUTS = {
+    "softmax_with_cross_entropy": ("Loss",),
+    "fused_linear_xent": ("Loss",),
+    "layer_norm": ("Mean", "Variance"),
+}
+
+# Input slots never cast down when a gray op goes low: training
+# targets must reach the lowering at full precision (a bf16-rounded
+# soft label loses ~3 decimal digits the loss then inherits; the
+# black-list era kept them exactly f32).
+F32_CONTRACT_INPUTS = {
+    "softmax_with_cross_entropy": ("Label",),
+    "fused_linear_xent": ("Label",),
+}
+
 
 def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
     """Insert casts so the low-precision region PROPAGATES through the
@@ -85,7 +105,10 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
             white = op.type in amp_lists.white_list
             gray = op.type in amp_lists.gray_list
             float_ins = []
+            keep_f32_slots = F32_CONTRACT_INPUTS.get(op.type, ())
             for slot, names in op.inputs.items():
+                if slot in keep_f32_slots:
+                    continue
                 for j, name in enumerate(names):
                     var = block._find_var_recursive(name)
                     if is_float(var):
@@ -99,7 +122,13 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
                     names[j] = insert_cast(name, var, dest_dtype,
                                            cast_down, new_ops)
                 new_ops.append(op)
+                f32_slots = F32_CONTRACT_OUTPUTS.get(op.type, ())
+                exempt = set()
+                for slot in f32_slots:
+                    exempt.update(op.outputs.get(slot, ()))
                 for n in op.output_arg_names:
+                    if n in exempt:
+                        continue
                     v = block._find_var_recursive(n)
                     if is_float(v) or v is None:
                         low.add(n)

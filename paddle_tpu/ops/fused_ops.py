@@ -9,8 +9,11 @@ traces — not for kernel-launch savings (XLA fuses either way).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .math_ops import _bcast_y
 from .registry import register
@@ -65,6 +68,75 @@ def fc(x, w, bias, *, in_num_col_dims=1, activation_type=""):
     return _UNARY[activation_type](out)
 
 
+@functools.lru_cache(maxsize=None)
+def _lean_xent(epsilon, V):
+    """custom_vjp core of fused_linear_xent, cached per (epsilon, V)
+    so the primitive identity is stable across traces.
+
+    The hand-written backward exists for bandwidth, not math: the
+    autodiff backward of the composite materialized a float32
+    ``dlogits`` [N, V] (2 GB at the flagship 16k x 30k head) built
+    from a scatter (take_along_axis transpose) plus three broadcast
+    fusions. Here the whole thing is ONE fusion — softmax recomputed
+    from the saved (logits, lse) residuals, one-hot as an iota
+    compare (no scatter) — and the result is written in the INPUT
+    dtype, so under AMP the tensor the two head matmuls re-read is
+    half the bytes. dlogits rounds to bf16 exactly once, the same
+    contract as the attention probs residual (ops/pallas/attention.py
+    _softmax_save_lowp); the f32 path is bit-identical to the
+    composite's gradients.
+
+    The label rides as float32 through the custom_vjp boundary to
+    avoid the int-cotangent float0 dance (the attention kernel's seed
+    uses the same trick)."""
+
+    @jax.custom_vjp
+    def f(x, w, lab_f):
+        return _fwd(x, w, lab_f)[0]
+
+    def _fwd(x, w, lab_f):
+        logits = jnp.dot(x, w,
+                         preferred_element_type=jnp.float32)  # [..., V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1,
+                                          keepdims=True)
+        lab = lab_f.astype(jnp.int32)
+        picked = jnp.take_along_axis(logits, lab, axis=-1)
+        loss = lse - (1.0 - epsilon) * picked
+        if epsilon:
+            loss = loss - (epsilon / V) * jnp.sum(logits, axis=-1,
+                                                  keepdims=True)
+        return loss, (x, w, lab_f, logits, lse)
+
+    def _bwd(res, g):
+        x, w, lab_f, logits, lse = res
+        lab = lab_f.astype(jnp.int32)
+        p = jnp.exp(logits - lse)                       # softmax, f32
+        # one-hot via iota compare, fused into the single dlogits
+        # fusion. A 16k-row scatter-add variant (.at[rows, lab].add)
+        # chip-measured CATASTROPHIC: 10.27 vs 13.08 steps/s in-model
+        # (+21 ms/step) — TPU lowers variable-index scatters to a
+        # serialized loop. The iota compare costs one extra [N, V]
+        # compare+select inside a fusion that is reading 2 GB anyway.
+        hot = (lax.broadcasted_iota(jnp.int32, logits.shape,
+                                    logits.ndim - 1) == lab)
+        if epsilon:
+            soft = jnp.where(hot, 1.0 - epsilon + epsilon / V,
+                             epsilon / V)
+        else:
+            soft = hot.astype(jnp.float32)
+        dlogits = (g * (p - soft)).astype(x.dtype)
+        dx = jnp.dot(dlogits, w.T,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+        bdims = tuple(range(x.ndim - 1))
+        dw = lax.dot_general(
+            x, dlogits, ((bdims, bdims), ((), ())),
+            preferred_element_type=jnp.float32).astype(w.dtype)
+        return dx, dw, jnp.zeros_like(lab_f)
+
+    f.defvjp(_fwd, _bwd)
+    return f
+
+
 @register("fused_linear_xent", ["X", "W", "Label"], ["Loss"],
           nondiff=("Label",))
 def fused_linear_xent(x, w, label, *, epsilon=0.0):
@@ -83,20 +155,27 @@ def fused_linear_xent(x, w, label, *, epsilon=0.0):
 
     Label: int [..., 1] (hard indices only; arbitrary soft targets stay
     on the unfused path). Loss: float32 [..., 1].
+
+    Forward logits stay f32, deliberately: a bf16-logits variant
+    (halving the [N, V] traffic, f32 in-register reductions) was
+    chip-measured in round 4 at 0.287 MFU vs 0.372 — the (2,1)-packed
+    bf16 layout breaks XLA's convert_reduce fusions around the head
+    and costs far more than the bandwidth saves. Measured beats
+    theorized. The BACKWARD is hand-written (see _lean_xent): bf16
+    dlogits only feed matmuls, which is the case packed bf16 is good
+    at.
     """
+    from ..core.flags import FLAGS
     V = w.shape[-1]
-    # f32 logits, deliberately: a bf16-logits variant (halving the
-    # [N, V] traffic, f32 in-register reductions) was chip-measured
-    # in round 4 at 0.287 MFU vs 0.372 — the (2,1)-packed bf16
-    # layout breaks XLA's convert_reduce fusions around the head and
-    # costs far more than the bandwidth saves. Measured beats
-    # theorized.
+    lab = label.astype(jnp.int32)
+    if lab.ndim == x.ndim - 1:
+        lab = lab[..., None]
+    if FLAGS.lean_xent_grad:
+        return _lean_xent(float(epsilon), int(V))(
+            x, w, lab.astype(jnp.float32))
     logits = jnp.dot(x, w,
                      preferred_element_type=jnp.float32)  # [..., V]
     lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
-    lab = label.astype(jnp.int32)
-    if lab.ndim == logits.ndim - 1:
-        lab = lab[..., None]
     picked = jnp.take_along_axis(logits, lab, axis=-1)
     loss = lse - (1.0 - epsilon) * picked
     if epsilon:

@@ -12,8 +12,11 @@ for API parity but lowered to ordinary reshape+broadcast.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register
@@ -36,7 +39,60 @@ def _elementwise(fn):
     return impl
 
 
-register("elementwise_add", ["X", "Y"], ["Out"])(_elementwise(jnp.add))
+@functools.lru_cache(maxsize=None)
+def _bias_add_vjp(dt_name):
+    """x + bias (y rank-1 over x's last dim) with the bias gradient
+    computed as ``ones @ dY`` on the MXU instead of autodiff's
+    broadcast-transpose reduce_sum.
+
+    Why: on transformer-base the step profile shows ~30
+    convert+reduce fusions/step re-reading the [16k, d] bf16
+    upstream gradients at well below HBM bandwidth (~0.26 ms each,
+    ~13x the traffic floor). A [1, N] x [N, d] dot streams dY once at
+    matmul speed with f32 accumulation — better-or-equal precision
+    than the f32 convert_reduce. Only the bf16 cotangent case routes
+    to the MXU (an f32 dot could be demoted to bf16 under
+    --xla_allow_excess_precision, which would LOSE precision vs the
+    exact f32 reduce)."""
+
+    dt = np.dtype(dt_name)
+
+    @jax.custom_vjp
+    def f(x, y):
+        return x + y
+
+    def fwd(x, y):
+        return x + y, None
+
+    def bwd(_, g):
+        g2 = g.reshape(-1, g.shape[-1])
+        if g2.dtype == jnp.bfloat16:
+            ones = jnp.ones((g2.shape[0],), g2.dtype)
+            db = lax.dot_general(ones, g2, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        else:
+            db = jnp.sum(g2.astype(jnp.float32), axis=0)
+        return g.astype(dt), db.astype(dt)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _elementwise_add(x, y, *, axis=-1):
+    from ..core.flags import FLAGS
+    if (FLAGS.mxu_bias_grad
+            and getattr(y, "ndim", None) == 1
+            and getattr(x, "ndim", 0) >= 2
+            and (axis in (-1, x.ndim - 1))
+            and x.shape[-1] == y.shape[0]
+            and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            and jnp.issubdtype(jnp.asarray(y).dtype, jnp.floating)
+            and jnp.asarray(x).dtype == jnp.asarray(y).dtype):
+        return _bias_add_vjp(jnp.asarray(x).dtype.name)(x, y)
+    return jnp.add(x, _bcast_y(x, y, axis))
+
+
+register("elementwise_add", ["X", "Y"], ["Out"])(_elementwise_add)
 register("elementwise_sub", ["X", "Y"], ["Out"])(_elementwise(jnp.subtract))
 register("elementwise_mul", ["X", "Y"], ["Out"])(_elementwise(jnp.multiply))
 register("elementwise_div", ["X", "Y"], ["Out"])(_elementwise(jnp.divide))

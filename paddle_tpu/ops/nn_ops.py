@@ -117,24 +117,107 @@ def cross_entropy(x, label, *, soft_label=False, ignore_index=-100):
     return loss
 
 
+@functools.lru_cache(maxsize=None)
+def _lean_softmax_xent(ignore_index):
+    """Hand-written backward for the hard-label softmax+xent chain
+    (the same bandwidth discipline as fused_ops._lean_xent): autodiff
+    of the softmax+log_softmax composite saves BOTH [N, V] float32
+    outputs as residuals and rebuilds dlogits from a scatter; here the
+    residuals are (logits, lse) — logits is usually live anyway — and
+    the backward is ONE fusion: ``dlogits = sm*(g_sm - <g_sm, sm>) +
+    (sm - onehot)*g_loss`` with the one-hot as an iota compare. The
+    label rides as float32 through the custom_vjp boundary (the float0
+    dance — see ops/pallas/attention.py seed_f)."""
+
+    from jax.custom_derivatives import SymbolicZero
+
+    def _core(logits, lab_f):
+        x = logits.astype(jnp.float32)
+        m = jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(x - m)
+        s = jnp.sum(e, axis=-1, keepdims=True)
+        lse = m + jnp.log(s)
+        sm = e / s
+        lab = lab_f.astype(jnp.int32)
+        picked = jnp.take_along_axis(x, lab, axis=-1)
+        loss = lse - picked
+        if ignore_index >= 0:
+            loss = jnp.where(lab == ignore_index, 0.0, loss)
+        return (sm.astype(logits.dtype), loss), (logits, lse, lab_f)
+
+    @jax.custom_vjp
+    def f(logits, lab_f):
+        return _core(logits, lab_f)[0]
+
+    def fwd(logits_p, lab_p):
+        # symbolic_zeros=True wraps primals in CustomVJPPrimal
+        return _core(logits_p.value, lab_p.value)
+
+    def _bwd(res, gs):
+        logits, lse, lab_f = res
+        g_sm, g_loss = gs
+        lab = lab_f.astype(jnp.int32)
+        sm = jnp.exp(logits.astype(jnp.float32) - lse)
+        d = None
+        # symbolic-zero cotangents (the common loss-only training
+        # case leaves g_sm a SymbolicZero) skip their whole [N, V]
+        # term — XLA does not fold float multiplies by zero
+        if not isinstance(g_loss, SymbolicZero):
+            gl = g_loss.astype(jnp.float32)
+            if ignore_index >= 0:
+                gl = jnp.where(lab == ignore_index, 0.0, gl)
+            # one-hot via iota compare — variable-index scatters
+            # serialize on TPU (see fused_ops._lean_xent)
+            hot = (lax.broadcasted_iota(jnp.int32, logits.shape,
+                                        logits.ndim - 1) == lab)
+            d = (sm - hot.astype(jnp.float32)) * gl
+        if not isinstance(g_sm, SymbolicZero):
+            gsm = g_sm.astype(jnp.float32)
+            t = sm * (gsm - jnp.sum(gsm * sm, axis=-1,
+                                    keepdims=True))
+            d = t if d is None else d + t
+        if d is None:
+            return (jnp.zeros_like(logits),
+                    jnp.zeros_like(lab_f))
+        return d.astype(logits.dtype), jnp.zeros_like(lab_f)
+
+    f.defvjp(fwd, _bwd, symbolic_zeros=True)
+    return f
+
+
 @register("softmax_with_cross_entropy", ["Logits", "Label"],
           ["Softmax", "Loss"], nondiff=("Label",))
 def softmax_with_cross_entropy(logits, label, *, soft_label=False,
                                ignore_index=-100, axis=-1,
                                return_softmax=True,
                                numeric_stable_mode=True):
-    sm = jax.nn.softmax(logits, axis=axis)
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    from ..core.flags import FLAGS
+    # Internals run in float32 regardless of input dtype (loss stays
+    # f32; the softmax output follows the input dtype) — that is what
+    # makes the op AMP-gray-safe: bf16 activations enter directly,
+    # like layer_norm (fp16_lists.py).
     if soft_label:
-        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
-    else:
-        lab = label.squeeze(axis) if label.ndim == logits.ndim else label
-        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
-                                     axis=axis)
-        loss = -picked
-        if ignore_index >= 0:
-            loss = jnp.where((lab == ignore_index)[..., None], 0.0, loss)
-    return sm, loss
+        x32 = logits.astype(jnp.float32)
+        sm = jax.nn.softmax(x32, axis=axis)
+        logp = jax.nn.log_softmax(x32, axis=axis)
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis,
+                        keepdims=True)
+        return sm.astype(logits.dtype), loss
+    if FLAGS.lean_xent_grad and axis in (-1, logits.ndim - 1):
+        lab = label.squeeze(axis) if label.ndim == logits.ndim \
+            else label
+        return _lean_softmax_xent(int(ignore_index))(
+            logits, lab[..., None].astype(jnp.float32))
+    x32 = logits.astype(jnp.float32)
+    sm = jax.nn.softmax(x32, axis=axis)
+    logp = jax.nn.log_softmax(x32, axis=axis)
+    lab = label.squeeze(axis) if label.ndim == logits.ndim else label
+    picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                 axis=axis)
+    loss = -picked
+    if ignore_index >= 0:
+        loss = jnp.where((lab == ignore_index)[..., None], 0.0, loss)
+    return sm.astype(logits.dtype), loss
 
 
 @register("sigmoid_cross_entropy_with_logits", ["X", "Label"], ["Out"],

@@ -27,6 +27,19 @@ matrix in HBM in either direction:
   cuts the grid to 64 cells with 8x the work and 8x larger DMA
   transfers. G divides H, so a cell never straddles a batch row and
   per-BATCH bias blocks stay well-defined.
+- **Single-k-block specialization** (``_1k_applicable``: Sq<=256,
+  Sk<=512, natural tiling): when the whole key range fits one block,
+  the online-softmax machinery is dropped (plain softmax in
+  registers, no m/l scratch, no lane-replicated statistics), and the
+  backward is ONE kernel producing dq/dk/dv from a single exp
+  recompute with lse and delta derived in-kernel — the only HBM
+  residual is the forward output. Chip-measured 2026-07-31: IN-MODEL
+  this mix wins +12% on transformer-base b64 (13.08 vs 11.69
+  steps/s, MFU 0.374 -> 0.419) — XLA's fused chain pays RNG mask
+  materialization + probs HBM round-trips at all 18 attention sites.
+  The f32 no-dropout micro-benchmark has the kernel 0.94x of XLA:
+  micro-benchmarks do not transfer, in either direction; only
+  in-model numbers decide (BASELINE.md round-4).
 
 ``Bias`` is an additive attention mask (0 / -1e9, built from data by the
 models) and is registered non-differentiable: the base lowering and the
@@ -155,6 +168,211 @@ def scaled_dot_product_attention(q, k, v, bias, *, scale=1.0,
 
 
 # ---------------------------------------------------------------------------
+# single-k-block specialization (short sequences — the flagship S=256
+# and BERT S=128 shapes). When the whole key range fits one block the
+# online-softmax machinery is pure overhead: no m/l scratch, no alpha
+# rescales, no lane-replicated statistics round-tripping through HBM.
+# The backward is ONE kernel computing dq/dk/dv together from a single
+# exp recompute (the blocked path needs two kernels = two recomputes),
+# with lse and delta = rowsum(dO*O) derived in-kernel so the only HBM
+# residual is the forward output itself.
+# ---------------------------------------------------------------------------
+
+
+def _attn_scores(q_ref, k_ref, b_ref, *, scale, causal):
+    s = lax.dot_general(q_ref[...], k_ref[...], _QK,
+                        preferred_element_type=jnp.float32) * scale
+    if b_ref is not None:
+        s = s + b_ref[:, 0].astype(jnp.float32)
+    if causal:
+        s = _causal_mask(s, 0, 0, s.shape[1], s.shape[2])
+    return s                                        # [G, Sq, Sk] f32
+
+
+def _fwd_kernel_1k(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, *,
+                   scale, rate, causal):
+    i = pl.program_id(0)
+    s = _attn_scores(q_ref, k_ref, b_ref, scale=scale, causal=causal)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, -1, keepdims=True)
+    if rate > 0.0:
+        keep = _dropout_keep(seed_ref, i, 0, 0, 1, 1, p.shape, rate)
+        p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+    pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[...], _PV,
+                         preferred_element_type=jnp.float32)
+    # reciprocal-multiply: a [G,Sq,1]-broadcast divide on the [G,Sq,Dh]
+    # tile costs ~4x a multiply on the VPU
+    rl = 1.0 / jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (pv * rl).astype(o_ref.dtype)
+
+
+def _bwd_kernel_1k(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
+                   dq_ref, dk_ref, dv_ref, *, scale, rate, causal):
+    i = pl.program_id(0)
+    s = _attn_scores(q_ref, k_ref, b_ref, scale=scale, causal=causal)
+    m = jnp.max(s, -1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, -1, keepdims=True)
+    rl = 1.0 / jnp.where(l == 0.0, 1.0, l)          # [G, Sq, 1]
+    p = e * rl                                      # [G, Sq, Sk] f32
+    do = do_ref[...]                                # [G, Sq, Dh]
+    delta = jnp.sum(do.astype(jnp.float32)
+                    * o_ref[...].astype(jnp.float32), -1,
+                    keepdims=True)                  # [G, Sq, 1]
+    dp = lax.dot_general(do, v_ref[...], _QK,
+                         preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        keep = _dropout_keep(seed_ref, i, 0, 0, 1, 1, p.shape, rate)
+        inv = 1.0 / (1.0 - rate)
+        pd = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    else:
+        pd = p
+    dv_ref[...] = lax.dot_general(
+        pd.astype(do.dtype), do, _TT,
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
+    dq_ref[...] = lax.dot_general(
+        ds, k_ref[...], _PV,
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_ref[...] = lax.dot_general(
+        ds, q_ref[...], _TT,
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def _1k_applicable(Sq, Sk):
+    # whole key range in one block, natural TPU tiling (no padding)
+    return (Sq <= 256 and Sk <= 512
+            and Sq % 8 == 0 and Sk % 128 == 0)
+
+
+def _bwd_G(H, itemsize):
+    """Backward rows per grid cell: the backward streams six operands
+    + three outputs + the f32 score/prob temporaries, so f32 needs
+    G=4 to fit the 16 MB scoped VMEM (tests/test_pallas_vmem.py).
+    The ONE definition both backward wrappers and _pick_G use — the
+    fwd/bwd dropout-seed consistency invariant hangs off it."""
+    return blk(H, 8 if itemsize <= 2 else 4)
+
+
+def _pick_G(H, itemsize, rate):
+    """Rows per grid cell — ONE choice shared by forward and backward.
+
+    The in-kernel dropout mask is seeded per grid CELL
+    (_dropout_keep), so the (batch, head) -> cell mapping MUST be
+    identical in the kernels that generate and regenerate it: a
+    fwd G=8 / bwd G=4 split at f32 silently regenerates different
+    masks for every head the two groupings assign to different
+    cells (caught by round-4 review: f32 H=8 dropout grads diverged
+    from finite differences on heads >= 4). Without dropout the
+    forward may keep G=8 at f32 (it streams fewer operands than the
+    backward, which needs G=4 to fit the 16 MB scoped VMEM —
+    tests/test_pallas_vmem.py), because no PRNG state crosses the
+    kernels."""
+    if rate == 0.0:
+        return blk(H, 8)
+    return _bwd_G(H, itemsize)
+
+
+def _1k_specs_args(q, k, v, bias, per_head, seed, G, hb):
+    """Shared in_specs/args plumbing for the single-k-block kernels."""
+    B, H, Sq, Dh = q.shape
+    Sk = k.shape[2]
+    BH = B * H
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((G, Sq, Dh), lambda i: (i, 0, 0)),
+        pl.BlockSpec((G, Sk, Dh), lambda i: (i, 0, 0)),
+        pl.BlockSpec((G, Sk, Dh), lambda i: (i, 0, 0)),
+    ]
+    args = [seed, q.reshape(BH, Sq, Dh), k.reshape(BH, Sk, Dh),
+            v.reshape(BH, Sk, Dh)]
+    if bias is not None:
+        if per_head:
+            in_specs.append(pl.BlockSpec((G, 1, Sq, Sk),
+                                         lambda i: (i, 0, 0, 0)))
+        else:
+            in_specs.append(pl.BlockSpec((1, 1, Sq, Sk),
+                                         lambda i: (i // hb, 0, 0, 0)))
+        args.append(bias)
+    return in_specs, args
+
+
+def _flash_fwd_1k(q, k, v, bias, seed_f, scale, rate, causal):
+    B, H, Sq, Dh = q.shape
+    Sk = k.shape[2]
+    BH = B * H
+    bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
+    G = _pick_G(H, q.dtype.itemsize, rate)
+    hb = H // G
+    seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
+
+    in_specs, args = _1k_specs_args(q, k, v, bias, per_head, seed, G,
+                                    hb)
+    if bias is not None:
+        kernel = _fwd_kernel_1k
+    else:
+        kernel = (lambda sr, qr, kr, vr, orf, **kw:
+                  _fwd_kernel_1k(sr, qr, kr, vr, None, orf, **kw))
+
+    out = pl.pallas_call(
+        functools.partial(kernel, scale=scale, rate=rate,
+                          causal=causal),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
+        grid=(BH // G,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((G, Sq, Dh), lambda i: (i, 0, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret_mode(),
+    )(*args)
+    return out.reshape(B, H, Sq, Dh)
+
+
+def _flash_bwd_1k(q, k, v, bias, seed_f, o, g, scale, rate, causal):
+    B, H, Sq, Dh = q.shape
+    Sk = k.shape[2]
+    BH = B * H
+    bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
+    G = _bwd_G(H, q.dtype.itemsize)
+    hb = H // G
+    seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
+
+    in_specs, args = _1k_specs_args(q, k, v, bias, per_head, seed, G,
+                                    hb)
+    if bias is not None:
+        kernel = _bwd_kernel_1k
+    else:
+        kernel = (lambda sr, qr, kr, vr, dor, orf, *outs, **kw:
+                  _bwd_kernel_1k(sr, qr, kr, vr, None, dor, orf,
+                                 *outs, **kw))
+    in_specs += [pl.BlockSpec((G, Sq, Dh), lambda i: (i, 0, 0)),
+                 pl.BlockSpec((G, Sq, Dh), lambda i: (i, 0, 0))]
+    args += [g.reshape(BH, Sq, Dh), o.reshape(BH, Sq, Dh)]
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(kernel, scale=scale, rate=rate,
+                          causal=causal),
+        out_shape=[jax.ShapeDtypeStruct((BH, Sq, Dh), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, Dh), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, Dh), v.dtype)],
+        grid=(BH // G,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((G, Sq, Dh), lambda i: (i, 0, 0)),
+            pl.BlockSpec((G, Sk, Dh), lambda i: (i, 0, 0)),
+            pl.BlockSpec((G, Sk, Dh), lambda i: (i, 0, 0)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret_mode(),
+    )(*args)
+    return (dq.reshape(B, H, Sq, Dh), dk.reshape(B, H, Sk, Dh),
+            dv.reshape(B, H, Sk, Dh))
+
+
+# ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
@@ -229,7 +447,9 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
     Sk = k.shape[2]
     BH = B * H
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
-    G = blk(H, 8)
+    # must match _flash_bwd's grouping when dropout is on (same
+    # per-cell PRNG seeding — see _pick_G)
+    G = _pick_G(H, q.dtype.itemsize, rate)
     hb = H // G                    # cells per batch row
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
@@ -391,9 +611,9 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
     # the bwd streams 6 (G, blk, Dh) operands + 2 outputs + 2 scratch;
     # with Dh<=64 lane-padded to 128, G=8 at f32 models ~18 MB and
-    # trips the v5e 16 MB scoped-VMEM limit (tests/test_pallas_vmem.py)
-    # — halve the (batch,head) rows per grid cell for 4-byte dtypes
-    G = blk(H, 8 if q.dtype.itemsize <= 2 else 4)
+    # trips the v5e 16 MB scoped-VMEM limit — halve the (batch,head)
+    # rows per grid cell for 4-byte dtypes (shared _bwd_G definition)
+    G = _bwd_G(H, q.dtype.itemsize)
     hb = H // G
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
@@ -493,19 +713,32 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _sdpa_flash(q, k, v, bias, seed_f, scale, rate, causal):
+    if _1k_applicable(q.shape[2], k.shape[2]):
+        return _flash_fwd_1k(q, k, v, bias, seed_f, scale, rate,
+                             causal)
     out, _lse = _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal)
     return out
 
 
 def _sdpa_flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
+    if _1k_applicable(q.shape[2], k.shape[2]):
+        out = _flash_fwd_1k(q, k, v, bias, seed_f, scale, rate,
+                            causal)
+        # the single-block backward re-derives lse in-kernel: the
+        # forward output is the only tensor residual
+        return out, (q, k, v, bias, seed_f, out, None)
     out, lse = _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal)
     return out, (q, k, v, bias, seed_f, out, lse)
 
 
 def _sdpa_flash_bwd(scale, rate, causal, res, g):
     q, k, v, bias, seed_f, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, bias, seed_f, out, lse, g,
-                            scale, rate, causal)
+    if lse is None:
+        dq, dk, dv = _flash_bwd_1k(q, k, v, bias, seed_f, out, g,
+                                   scale, rate, causal)
+    else:
+        dq, dk, dv = _flash_bwd(q, k, v, bias, seed_f, out, lse, g,
+                                scale, rate, causal)
     dbias = None if bias is None else jnp.zeros_like(bias)
     return dq, dk, dv, dbias, jnp.zeros_like(seed_f)
 

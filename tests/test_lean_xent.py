@@ -1,0 +1,134 @@
+"""fused_linear_xent's hand-written backward (ops/fused_ops.py
+_lean_xent) must match the autodiff of the composite lowering exactly
+in f32, and the bf16 path must round only the dlogits write (the
+attention-probs residual contract)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.fused_ops import fused_linear_xent
+
+
+def _composite(x, w, label, epsilon):
+    V = w.shape[-1]
+    logits = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    picked = jnp.take_along_axis(logits, label.astype(jnp.int32),
+                                 axis=-1)
+    loss = lse - (1.0 - epsilon) * picked
+    if epsilon:
+        loss = loss - (epsilon / V) * jnp.sum(logits, axis=-1,
+                                              keepdims=True)
+    return loss
+
+
+@pytest.mark.parametrize("epsilon", [0.0, 0.1])
+@pytest.mark.parametrize("rank", [2, 3])
+def test_grads_match_composite(epsilon, rank):
+    rs = np.random.RandomState(0)
+    shape = (6, 16) if rank == 2 else (2, 3, 16)
+    V = 32
+    x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+    w = jnp.asarray(rs.randn(16, V).astype(np.float32))
+    lab = jnp.asarray(rs.randint(0, V, shape[:-1] + (1,)))
+
+    def f_new(x, w):
+        return jnp.sum(fused_linear_xent(x, w, lab, epsilon=epsilon))
+
+    def f_old(x, w):
+        return jnp.sum(_composite(x, w, lab, epsilon))
+
+    lo, go = jax.value_and_grad(f_old, (0, 1))(x, w)
+    ln, gn = jax.value_and_grad(f_new, (0, 1))(x, w)
+    assert abs(float(lo - ln)) < 1e-5
+    np.testing.assert_allclose(go[0], gn[0], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(go[1], gn[1], rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_dlogits_rounding_only():
+    """bf16 inputs: gradients equal the f32-composite gradients up to
+    one bf16 rounding of the dlogits (not an accumulation of error)."""
+    rs = np.random.RandomState(1)
+    N, D, V = 32, 16, 64
+    xf = rs.randn(N, D).astype(np.float32)
+    wf = (rs.randn(D, V) * 0.1).astype(np.float32)
+    lab = jnp.asarray(rs.randint(0, V, (N, 1)))
+    x16 = jnp.asarray(xf).astype(jnp.bfloat16)
+    w16 = jnp.asarray(wf).astype(jnp.bfloat16)
+
+    gn = jax.grad(lambda x, w: jnp.sum(
+        fused_linear_xent(x, w, lab, epsilon=0.1)), (0, 1))(x16, w16)
+    # truth from the f32 values the bf16 inputs actually represent
+    gt = jax.grad(lambda x, w: jnp.sum(
+        _composite(x, w, lab, 0.1)), (0, 1))(
+        x16.astype(jnp.float32), w16.astype(jnp.float32))
+    for a, b in zip(gn, gt):
+        np.testing.assert_allclose(a.astype(jnp.float32), b,
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_label_not_differentiated():
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(4, 8).astype(np.float32))
+    w = jnp.asarray(rs.randn(8, 16).astype(np.float32))
+    lab = jnp.asarray(rs.randint(0, 16, (4, 1)))
+    out = fused_linear_xent(x, w, lab, epsilon=0.0)
+    assert out.shape == (4, 1)
+    # squeezed label rank (the [..., ] form) also accepted
+    out2 = fused_linear_xent(x, w, lab[..., 0], epsilon=0.0)
+    np.testing.assert_allclose(out, out2)
+
+
+class TestLeanSoftmaxXent:
+    """softmax_with_cross_entropy's lean hard-label backward
+    (ops/nn_ops.py _lean_softmax_xent) vs the composite autodiff."""
+
+    def _composite(self, logits, lab, ignore_index):
+        sm = jax.nn.softmax(logits, -1)
+        logp = jax.nn.log_softmax(logits, -1)
+        picked = jnp.take_along_axis(
+            logp, lab[..., None].astype(jnp.int32), -1)
+        loss = -picked
+        if ignore_index >= 0:
+            loss = jnp.where((lab == ignore_index)[..., None], 0.0,
+                             loss)
+        return sm, loss
+
+    @pytest.mark.parametrize("ignore_index", [-100, 7])
+    @pytest.mark.parametrize("use_softmax_out", [False, True])
+    def test_grads_match(self, ignore_index, use_softmax_out):
+        from paddle_tpu.ops.nn_ops import softmax_with_cross_entropy
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(6, 33).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, 33, (6,))).at[2].set(7)
+
+        def f_new(x):
+            sm, loss = softmax_with_cross_entropy(
+                x, lab, ignore_index=ignore_index)
+            r = jnp.sum(loss)
+            return r + jnp.sum(sm ** 2) if use_softmax_out else r
+
+        def f_old(x):
+            sm, loss = self._composite(x, lab, ignore_index)
+            r = jnp.sum(loss)
+            return r + jnp.sum(sm ** 2) if use_softmax_out else r
+
+        ln, gn = jax.value_and_grad(f_new)(x)
+        lo, go = jax.value_and_grad(f_old)(x)
+        assert abs(float(ln - lo)) < 1e-5
+        np.testing.assert_allclose(gn, go, rtol=1e-5, atol=1e-6)
+
+    def test_soft_label_and_trailing_dim(self):
+        from paddle_tpu.ops.nn_ops import softmax_with_cross_entropy
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(6, 33).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, 33, (6, 1)))
+        sm, loss = softmax_with_cross_entropy(x, lab)
+        assert loss.shape == (6, 1) and sm.shape == (6, 33)
+        soft = jnp.asarray(rs.rand(6, 33).astype(np.float32))
+        soft = soft / soft.sum(-1, keepdims=True)
+        _, loss2 = softmax_with_cross_entropy(x, soft,
+                                              soft_label=True)
+        assert loss2.shape == (6, 1)

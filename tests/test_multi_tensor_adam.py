@@ -1,0 +1,157 @@
+"""Multi-tensor adam (executor trace-time batching of consecutive
+adam/adamw ops — the fuse_adam_op_pass analog,
+reference: paddle/fluid/framework/ir/fuse_optimizer_ops_pass/
+fuse_adam_op_pass.cc) must match the per-op path to the ulp: the
+update math is identical element-for-element, but XLA may group the
+fused expressions differently (FMA contraction), so equality is
+asserted to float32 ulp tolerance rather than bitwise."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.core.flags import FLAGS
+
+
+def _build(opt_factory, seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed + 1
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            h = fluid.layers.fc(h, size=16, act="tanh")
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(p, y))
+            opt_factory().minimize(loss)
+    return main, startup, loss
+
+
+def _train(opt_factory, flag, steps=3, repeated=False):
+    prev = FLAGS.multi_tensor_adam
+    FLAGS.multi_tensor_adam = flag
+    try:
+        main, startup, loss = _build(opt_factory)
+        scope = fluid.core.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            feed = {"x": rs.randn(32, 8).astype(np.float32),
+                    "y": rs.randn(32, 1).astype(np.float32)}
+            if repeated:
+                l, = exe.run_repeated(main, feed=feed,
+                                      fetch_list=[loss], iters=steps)
+                losses = [float(np.asarray(l))]
+            else:
+                losses = []
+                for _ in range(steps):
+                    l, = exe.run(main, feed=feed, fetch_list=[loss])
+                    losses.append(float(l))
+            params = {v.name: np.asarray(scope.find_var(v.name))
+                      for v in main.global_block().all_parameters()}
+        return losses, params
+    finally:
+        FLAGS.multi_tensor_adam = prev
+
+
+@pytest.mark.parametrize("opt", ["adam", "adamw"])
+def test_bit_identical(opt):
+    factory = {
+        "adam": lambda: fluid.optimizer.AdamOptimizer(0.01),
+        "adamw": lambda: fluid.optimizer.AdamWOptimizer(
+            0.01, weight_decay=0.02),
+    }[opt]
+    l_off, p_off = _train(factory, False)
+    l_on, p_on = _train(factory, True)
+    assert l_off == l_on
+    for k in p_off:
+        np.testing.assert_allclose(p_off[k], p_on[k], rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
+
+
+def test_bit_identical_run_repeated():
+    factory = lambda: fluid.optimizer.AdamOptimizer(0.01)  # noqa: E731
+    l_off, p_off = _train(factory, False, repeated=True)
+    l_on, p_on = _train(factory, True, repeated=True)
+    np.testing.assert_allclose(l_off, l_on, rtol=1e-6)
+    for k in p_off:
+        np.testing.assert_allclose(p_off[k], p_on[k], rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
+
+
+def test_mixed_small_and_large(monkeypatch):
+    """Params above the numel threshold keep the per-op path; the mix
+    of batched + individual updates must still be exact."""
+    monkeypatch.setattr(executor_mod, "_MULTI_ADAM_MAX_NUMEL", 100)
+    factory = lambda: fluid.optimizer.AdamOptimizer(0.01)  # noqa: E731
+    l_off, p_off = _train(factory, False)
+    l_on, p_on = _train(factory, True)
+    np.testing.assert_allclose(l_off, l_on, rtol=1e-6)
+    for k in p_off:
+        np.testing.assert_allclose(p_off[k], p_on[k], rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
+
+
+def test_sparse_grads_fall_back():
+    """A sparse (SparseRows) grad must take the per-op lazy path and
+    train identically with the flag on and off."""
+
+    def run(flag):
+        prev = FLAGS.multi_tensor_adam
+        FLAGS.multi_tensor_adam = flag
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = 3
+            startup.random_seed = 4
+            with fluid.unique_name.guard():
+                with fluid.program_guard(main, startup):
+                    ids = fluid.layers.data("ids", shape=[1],
+                                            dtype="int64")
+                    y = fluid.layers.data("y", shape=[1],
+                                          dtype="float32")
+                    emb = fluid.layers.embedding(
+                        ids, size=[50, 8], is_sparse=True)
+                    p = fluid.layers.fc(emb, size=1)
+                    loss = fluid.layers.mean(
+                        fluid.layers.square_error_cost(p, y))
+                    fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+            scope = fluid.core.Scope()
+            exe = fluid.Executor()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                rs = np.random.RandomState(1)
+                feed = {"ids": rs.randint(0, 50, (16, 1)),
+                        "y": rs.randn(16, 1).astype(np.float32)}
+                out = []
+                for _ in range(3):
+                    l, = exe.run(main, feed=feed, fetch_list=[loss])
+                    out.append(float(l))
+            return out
+        finally:
+            FLAGS.multi_tensor_adam = prev
+
+    assert run(False) == run(True)
+
+
+def test_group_detection():
+    """Only consecutive same-attr dense adam ops group; a single op or
+    differing attrs do not."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            p = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(p)
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    block = main.global_block()
+    groups = executor_mod._adam_batch_groups(block)
+    n_adam = sum(1 for op in block.ops if op.type == "adam")
+    assert n_adam == 2  # weight + bias
+    assert len(groups) == 1
+    (idxs,) = groups.values()
+    assert len(idxs) == 2

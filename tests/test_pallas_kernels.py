@@ -268,3 +268,22 @@ def test_fused_linear_xent_3d_and_bf16():
     assert palb.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(palb), np.asarray(ref),
                                rtol=0.05, atol=0.05)
+
+
+def test_attention_dropout_grouping_consistent():
+    """The dropout mask is seeded per grid CELL, so forward and
+    backward must group (batch, head) rows into cells identically
+    whenever dropout is on (round-4 review: a fwd G=8 / bwd G=4 split
+    at f32 regenerated different masks for heads the groupings
+    assigned to different cells — silently wrong gradients)."""
+    from paddle_tpu.ops.pallas.attention import _bwd_G, _pick_G
+
+    for H in (1, 2, 4, 8, 16):
+        for itemsize in (2, 4):
+            for rate in (0.0, 0.1, 0.5):
+                fwd_G = _pick_G(H, itemsize, rate)
+                bwd_G = _bwd_G(H, itemsize)
+                if rate > 0.0:
+                    assert fwd_G == bwd_G, (H, itemsize, rate)
+                # and the backward grouping always fits scoped VMEM
+                assert bwd_G <= (8 if itemsize <= 2 else 4)

@@ -1,0 +1,88 @@
+"""Round-4 perf-lever in-model A/B on the real chip.
+
+Measures transformer-base b64 steps/s for each lever in isolation and
+combined, against the all-off baseline (the round-4 0.377-MFU
+configuration). One fresh program + Executor per config: the executor
+jit cache does not key on these trace-time flags.
+
+    python tools/lever_ab.py            # all configs
+    python tools/lever_ab.py fast       # baseline + all-on only
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_prng_impl", "rbg")
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+from paddle_tpu.core.flags import FLAGS  # noqa: E402
+
+LEVERS = ("lean_xent_grad", "mxu_bias_grad", "multi_tensor_adam")
+
+# Reproduces the BASELINE.md round-4b table. The historical
+# "multi-tensor adam @ 1M threshold = 1.8 steps/s" row predates the
+# 64k-threshold fix; reproduce it by editing
+# executor._MULTI_ADAM_MAX_NUMEL back to 1 << 20.
+CONFIGS = [
+    ("all-off(r4-baseline)", {}, ""),
+    ("lean_xent", {"lean_xent_grad": True}, ""),
+    ("mxu_bias_grad", {"mxu_bias_grad": True}, ""),
+    ("multi_tensor_adam_64k", {"multi_tensor_adam": True}, ""),
+    ("sdpa:pallas", {}, "scaled_dot_product_attention:pallas"),
+    ("all-on+sdpa:pallas", dict.fromkeys(LEVERS, True),
+     "scaled_dot_product_attention:pallas"),
+    # the shipped default configuration (headline)
+    ("FINAL(lean+biasgrad,adam-off)+sdpa:pallas",
+     {"lean_xent_grad": True, "mxu_bias_grad": True},
+     "scaled_dot_product_attention:pallas"),
+]
+
+
+def main():
+    fast = "fast" in sys.argv[1:]
+    configs = ([CONFIGS[0], CONFIGS[-1]] if fast else CONFIGS)
+    print("devices:", jax.devices(), flush=True)
+    results = []
+    for name, flags, mix in configs:
+        for lever in LEVERS:
+            setattr(FLAGS, lever, flags.get(lever, False))
+        FLAGS.op_library = mix
+        t0 = time.time()
+        try:
+            cfg, run, tokens = bench._build_transformer_step(64, 256)
+            sps = bench._timed_loop(run, 3, 25)
+            mfu = bench._mfu(
+                bench.transformer_flops_per_step(cfg, 64), sps)
+            row = {"config": name, "steps_per_s": round(sps, 3),
+                   "tokens_per_s": round(tokens * sps, 1),
+                   "mfu": mfu, "wall_s": round(time.time() - t0, 1)}
+        except Exception as e:  # noqa: BLE001
+            row = {"config": name, "error": repr(e)[:300],
+                   "wall_s": round(time.time() - t0, 1)}
+        finally:
+            FLAGS.op_library = ""
+        results.append(row)
+        print(json.dumps(row), flush=True)
+        with open(".lever_ab.jsonl", "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+        from paddle_tpu.core.scope import global_scope
+        global_scope().drop_all()
+    best = max((r for r in results if "steps_per_s" in r),
+               key=lambda r: r["steps_per_s"], default=None)
+    print("BEST:", json.dumps(best), flush=True)
+
+
+if __name__ == "__main__":
+    main()
