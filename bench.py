@@ -238,11 +238,17 @@ def _best_library(run_step, warmup, iters, extra_libs=("pallas",),
 
     def timed(lib):
         prev = FLAGS.op_library
+        prev_auto = FLAGS.sdpa_auto_flash
         FLAGS.op_library = lib
+        # every comparison row measures EXACTLY its declared mix: pin
+        # the runtime best-impl dispatch off ("base" = pure XLA; a mix
+        # names sdpa:pallas explicitly when it wants the kernel)
+        FLAGS.sdpa_auto_flash = False
         try:
             return _timed_loop(run_step, warmup, iters)
         finally:
             FLAGS.op_library = prev
+            FLAGS.sdpa_auto_flash = prev_auto
 
     _log("timing base library")
     best = timed("")

@@ -160,9 +160,22 @@ def _sdpa_reference(q, k, v, bias, *, scale, dropout_rate=0.0,
 def scaled_dot_product_attention(q, k, v, bias, *, scale=1.0,
                                  dropout_rate=0.0, causal=False,
                                  is_test=False, rng=None):
-    """Base lowering: XLA fuses the chain; the pallas flash variant is
-    substituted when FLAGS_op_library=pallas."""
+    """Base lowering: XLA fuses the chain — except inside the flash
+    kernel's chip-measured win envelope, where the base dispatches to
+    it (FLAGS_sdpa_auto_flash, the jit/README.en.md best-impl-wins
+    pool applied at run time). The envelope is exactly what the
+    2026-07-31 in-model A/B measured winning (+12%): TPU execution,
+    low-precision operands, dropout active, single-k-block shapes;
+    everything else keeps the XLA chain, which measured faster there."""
     rate = 0.0 if is_test else float(dropout_rate)
+    from ...core.flags import FLAGS
+    if (FLAGS.sdpa_auto_flash and rate > 0.0 and rng is not None
+            and not interpret_mode()
+            and jnp.dtype(q.dtype).itemsize <= 2
+            and _1k_applicable(q.shape[2], k.shape[2])):
+        return sdpa_pallas(q, k, v, bias, scale=scale,
+                           dropout_rate=dropout_rate, causal=causal,
+                           is_test=is_test, rng=rng)
     return _sdpa_reference(q, k, v, bias, scale=scale,
                            dropout_rate=rate, causal=causal, rng=rng)
 

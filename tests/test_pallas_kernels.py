@@ -287,3 +287,44 @@ def test_attention_dropout_grouping_consistent():
                     assert fwd_G == bwd_G, (H, itemsize, rate)
                 # and the backward grouping always fits scoped VMEM
                 assert bwd_G <= (8 if itemsize <= 2 else 4)
+
+
+def test_sdpa_auto_flash_dispatch_envelope(monkeypatch):
+    """FLAGS_sdpa_auto_flash routes the BASE lowering to the flash
+    kernel exactly inside the chip-measured win envelope: TPU
+    execution, <=2-byte dtype, dropout active, single-k-block shapes.
+    Everything else (f32, no dropout, long sequences, interpret mode)
+    keeps the XLA chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.flags import FLAGS
+    from paddle_tpu.ops.pallas import attention as A
+
+    calls = []
+    monkeypatch.setattr(A, "interpret_mode", lambda: False)
+    monkeypatch.setattr(
+        A, "sdpa_pallas",
+        lambda q, k, v, b, **kw: calls.append("flash") or q)
+    rng = jax.random.key(0)
+
+    def run(S=256, dtype=jnp.bfloat16, rate=0.1, auto=True):
+        calls.clear()
+        prev = FLAGS.sdpa_auto_flash
+        FLAGS.sdpa_auto_flash = auto
+        try:
+            q = jnp.zeros((2, 4, S, 64), dtype)
+            A.scaled_dot_product_attention(
+                q, q, q, None, scale=0.125, dropout_rate=rate,
+                rng=rng)
+        except Exception:
+            pass  # reference path may fail on zeros: dispatch decided
+        finally:
+            FLAGS.sdpa_auto_flash = prev
+        return calls == ["flash"]
+
+    assert run()                              # envelope: dispatches
+    assert not run(dtype=jnp.float32)         # f32: stays XLA
+    assert not run(rate=0.0)                  # no dropout: stays XLA
+    assert not run(S=1024)                    # blocked shapes: XLA
+    assert not run(auto=False)                # flag off: stays XLA

@@ -1,0 +1,59 @@
+"""Finite-difference check of the flash attention in-kernel dropout
+gradients at f32 (the round-4 review repro: fwd/bwd grid groupings
+must agree for the regenerated PRNG masks to match — _pick_G).
+Run on the real chip; CPU interpret mode cannot emulate the TPU PRNG.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache"))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from paddle_tpu.ops.pallas import attention as A  # noqa: E402
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rs = np.random.RandomState(0)
+    B, H, Sq, Sk, Dh = 2, 8, 16, 128, 64
+    q = jnp.asarray(rs.randn(B, H, Sq, Dh).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, H, Sk, Dh).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, H, Sk, Dh).astype(np.float32))
+    seed = jnp.float32(5)
+    rate = 0.5
+
+    @jax.jit
+    def loss(v_):
+        return jnp.sum(A._sdpa_flash(q, k, v_, None, seed, 0.125,
+                                     rate, False) ** 2)
+
+    g = jax.jit(jax.grad(loss))(v)
+    print("grad computed", flush=True)
+    bad = 0
+    for h in range(H):
+        i = (1, h, 7, 3)
+        eps = 1e-2
+        fd = (loss(v.at[i].add(eps))
+              - loss(v.at[i].add(-eps))) / (2 * eps)
+        diff = abs(float(fd) - float(g[i]))
+        ok = diff < 0.02
+        bad += not ok
+        print("head %d fd %.4f grad %.4f %s"
+              % (h, float(fd), float(g[i]), "ok" if ok else "BAD"),
+              flush=True)
+    print("FD_CHECK", "PASS" if bad == 0 else "FAIL(%d)" % bad,
+          flush=True)
+    return 0 if bad == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

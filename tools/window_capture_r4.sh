@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4b chip-window capture: waits for the axon tunnel to come
+# back (claims BLOCK rather than fail; killed claims leave stale
+# leases, so probes get long timeouts and cool-downs — the
+# chip_window.sh pattern), then captures in order:
+#   1. the f32+dropout finite-difference check of the attention
+#      dropout-seed fix (fwd/bwd G consistency),
+#   2. bench.py (headline + per-mix evidence lines, new mix list),
+#   3. bench.py --all (BERT with the gray-listed lean xent, ResNet,
+#      MNIST, DeepFM),
+#   4. tools/mem_estimate.py resnet50 64 96 128 (compile-only).
+set -u
+LOG="${1:-/root/repo/.window_capture_r4.log}"
+STOP_FILE="/root/repo/.stop_prober"
+MAX_HOURS="${MAX_HOURS:-6}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+cd /root/repo
+
+say() { echo "[capture $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    [ -e "$STOP_FILE" ] && { say "stop file present — exiting"; exit 3; }
+    say "probing for a claim (timeout 900s)..."
+    if timeout 900 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((512, 512), jnp.bfloat16)
+(x @ x).sum().block_until_ready()
+print('CLAIM_OK', d.device_kind)
+" >>"$LOG" 2>&1 && tail -5 "$LOG" | grep -q CLAIM_OK; then
+        say "window open — FD dropout check"
+        timeout 1800 python tools/fd_dropout_check.py >>"$LOG" 2>&1
+        say "bench headline"
+        timeout 2400 python bench.py >>"$LOG" 2>&1
+        say "bench --all"
+        timeout 3600 python bench.py --all >>"$LOG" 2>&1
+        say "resnet mem estimates"
+        timeout 2400 python tools/mem_estimate.py resnet50 96 128 \
+            >>"$LOG" 2>&1
+        say "capture complete"
+        exit 0
+    fi
+    say "no claim — cooling down 300s (stale-lease expiry)"
+    sleep 300
+done
+say "deadline reached without a window"
+exit 3
