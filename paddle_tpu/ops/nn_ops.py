@@ -362,12 +362,39 @@ def conv2d_transpose(x, w, *, strides=(1, 1), paddings=(0, 0),
     # Deconv = conv of the input dilated by `strides` with the spatially
     # flipped kernel; the IOHW dimension spec swaps in/out channels.
     w_flip = jnp.flip(w, axis=(2, 3))
-    dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "IOHW", "NCHW"))
+    if groups == 1:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "IOHW", "NCHW"))
+        return lax.conv_general_dilated(
+            x, w_flip, window_strides=(1, 1), padding=pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn)
+    # Grouped deconv: (g*in_g, out_g, kh, kw) -> (g*out_g, in_g, kh,
+    # kw) OIHW so lax's consecutive-block group semantics line up with
+    # fluid's consecutively-grouped output channels.
+    cin, out_g, kh, kw = w_flip.shape
+    in_g = cin // groups
+    w_oihw = (w_flip.reshape(groups, in_g, out_g, kh, kw)
+              .transpose(0, 2, 1, 3, 4)
+              .reshape(groups * out_g, in_g, kh, kw))
+    dn = lax.conv_dimension_numbers(x.shape, w_oihw.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
     return lax.conv_general_dilated(
-        x, w_flip, window_strides=(1, 1), padding=pad,
+        x, w_oihw, window_strides=(1, 1), padding=pad,
         lhs_dilation=strides, rhs_dilation=dilations,
         dimension_numbers=dn, feature_group_count=groups)
+
+
+@register("depthwise_conv2d_transpose", ["Input", "Filter"], ["Output"])
+def depthwise_conv2d_transpose(x, w, *, strides=(1, 1), paddings=(0, 0),
+                               dilations=(1, 1), groups=None,
+                               output_size=None):
+    """Reference: conv_transpose_op.cc (depthwise variant). Per-channel
+    transposed conv: groups defaults to the input channel count."""
+    g = groups or x.shape[1]
+    return conv2d_transpose(x, w, strides=strides, paddings=paddings,
+                            dilations=dilations, groups=g,
+                            output_size=output_size)
 
 
 @register("pool2d", ["X"], ["Out"])

@@ -321,6 +321,13 @@ spec("conv2d", {"Input": sgn((1, 2, 4, 4), 86),
 spec("conv2d_transpose", {"Input": sgn((1, 2, 3, 3), 88),
                           "Filter": sgn((2, 3, 2, 2), 89)},
      max_rel=0.01)
+spec("depthwise_conv2d_transpose",
+     {"Input": sgn((1, 2, 3, 3), 881), "Filter": sgn((2, 1, 2, 2), 891)},
+     max_rel=0.01,
+     ref=lambda ins: [__import__("torch").nn.functional.conv_transpose2d(
+         __import__("torch").from_numpy(ins["Input"]),
+         __import__("torch").from_numpy(ins["Filter"]),
+         groups=2).numpy()])
 spec("conv3d", {"Input": sgn((1, 1, 3, 3, 3), 90),
                 "Filter": sgn((2, 1, 2, 2, 2), 91)}, max_rel=0.01)
 spec("depthwise_conv2d", {"Input": sgn((1, 2, 4, 4), 92),
