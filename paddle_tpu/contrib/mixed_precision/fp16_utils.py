@@ -105,14 +105,15 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
             white = op.type in amp_lists.white_list
             gray = op.type in amp_lists.gray_list
             float_ins = []
+            contract_ins = []  # F32-contract slots (e.g. Label)
             keep_f32_slots = F32_CONTRACT_INPUTS.get(op.type, ())
             for slot, names in op.inputs.items():
-                if slot in keep_f32_slots:
-                    continue
+                dest = (contract_ins if slot in keep_f32_slots
+                        else float_ins)
                 for j, name in enumerate(names):
                     var = block._find_var_recursive(name)
                     if is_float(var):
-                        float_ins.append((names, j, name, var))
+                        dest.append((names, j, name, var))
             any_low = any(name in low or var.dtype == dest_dtype
                           for _, _, name, var in float_ins)
             if white or (gray and any_low):
@@ -137,7 +138,10 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
                 new_ops.append(op)
             else:
                 # black or unlisted: pull low inputs back to f32
-                for names, j, name, var in float_ins:
+                # contract slots (labels) are exempt from cast-DOWN,
+                # not from cast-UP: an in-graph low-precision label
+                # still gets pulled back to f32 here (ADVICE r4)
+                for names, j, name, var in float_ins + contract_ins:
                     if name in low or var.dtype == dest_dtype:
                         names[j] = insert_cast(name, var, "float32",
                                                cast_up, new_ops)

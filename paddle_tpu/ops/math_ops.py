@@ -70,8 +70,12 @@ def _bias_add_vjp(dt_name):
             ones = jnp.ones((g2.shape[0],), g2.dtype)
             db = lax.dot_general(ones, g2, (((0,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        else:
+        elif g2.dtype.itemsize <= 4:
             db = jnp.sum(g2.astype(jnp.float32), axis=0)
+        else:
+            # f64: a native-dtype reduce — an f32 accumulator would
+            # DOWNGRADE precision vs autodiff's own sum
+            db = jnp.sum(g2, axis=0)
         return g.astype(dt), db.astype(dt)
 
     f.defvjp(fwd, bwd)

@@ -260,6 +260,61 @@ def _1k_applicable(Sq, Sk):
             and Sq % 8 == 0 and Sk % 128 == 0)
 
 
+# VMEM model for the single-k-block kernels (ADVICE r4: the corner
+# Sq=256/Sk=512 exceeded scoped VMEM at the uncapped G=8). Per grid
+# row the kernels hold:
+#   - streamed blocks, double-buffered: q/do/o/dq rows of Sq, and
+#     k/v/dk/dv rows of Sk, each lane-padded to 128 in the minor dim;
+#   - [G,Sq,Sk] f32 score temporaries. 8 bytes/element — ~2 f32
+#     arrays live after Mosaic's buffer reuse. This constant is
+#     ANCHORED on chip evidence, not source-level counting: the
+#     bf16 [8,256,256] backward (5 source-level f32 temps = 20 B/elem
+#     would predict 22 MB) compiled and ran at G=8 in the round-4
+#     headline capture, so Mosaic demonstrably reuses all but ~2.
+# Budget 15 MB of the 16 MB v5e scoped limit; G halves until the
+# modeled row total fits. tests/test_pallas_vmem.py replays this
+# model at every _1k_applicable corner AND pins the chip-measured
+# headline geometry (bf16 256x256 dropout) to G=8.
+_1K_TEMP_BYTES = 8
+_1K_VMEM_BUDGET = 15 << 20
+
+
+def _1k_row_bytes(itemsize, Sq, Sk, Dh, n_sq_ops, n_sk_ops, has_bias):
+    lanes = max(Dh, 128)
+    stream = (n_sq_ops * Sq + n_sk_ops * Sk) * lanes * itemsize * 2
+    temps = Sq * Sk * _1K_TEMP_BYTES
+    if has_bias:
+        # bias block (streamed, double-buffered; charged per-row even
+        # for the shared non-per-head slab — conservative) plus the
+        # s + b f32 addend the biased kernel keeps live
+        temps += Sq * Sk * (itemsize * 2 + 4)
+    return stream + temps
+
+
+def _1k_bwd_G(H, itemsize, Sq, Sk, Dh, has_bias=False):
+    """Backward rows per grid cell, capped by the VMEM model
+    (streams: q,do,o,dq + k,v,dk,dv)."""
+    base = 8 if itemsize <= 2 else 4
+    row = _1k_row_bytes(itemsize, Sq, Sk, Dh, 4, 4, has_bias)
+    while base > 1 and base * row > _1K_VMEM_BUDGET:
+        base //= 2
+    return blk(H, base)
+
+
+def _1k_fwd_G(H, itemsize, rate, Sq, Sk, Dh, has_bias=False):
+    """Forward rows per grid cell. With dropout it MUST equal the
+    backward's G (the per-cell PRNG seed mapping — see _pick_G's
+    invariant note); without dropout the forward only needs its own
+    streams (q,o + k,v) to fit."""
+    if rate > 0.0:
+        return _1k_bwd_G(H, itemsize, Sq, Sk, Dh, has_bias)
+    base = 8
+    row = _1k_row_bytes(itemsize, Sq, Sk, Dh, 2, 2, has_bias)
+    while base > 1 and base * row > _1K_VMEM_BUDGET:
+        base //= 2
+    return blk(H, base)
+
+
 def _bwd_G(H, itemsize):
     """Backward rows per grid cell: the backward streams six operands
     + three outputs + the f32 score/prob temporaries, so f32 needs
@@ -317,7 +372,8 @@ def _flash_fwd_1k(q, k, v, bias, seed_f, scale, rate, causal):
     Sk = k.shape[2]
     BH = B * H
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
-    G = _pick_G(H, q.dtype.itemsize, rate)
+    G = _1k_fwd_G(H, q.dtype.itemsize, rate, Sq, Sk, Dh,
+                  bias is not None)
     hb = H // G
     seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
 
@@ -348,7 +404,7 @@ def _flash_bwd_1k(q, k, v, bias, seed_f, o, g, scale, rate, causal):
     Sk = k.shape[2]
     BH = B * H
     bias, per_head = _prep_bias(bias, B, H, Sq, Sk)
-    G = _bwd_G(H, q.dtype.itemsize)
+    G = _1k_bwd_G(H, q.dtype.itemsize, Sq, Sk, Dh, bias is not None)
     hb = H // G
     seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
 

@@ -313,12 +313,13 @@ def test_sdpa_auto_flash_dispatch_envelope(monkeypatch):
         prev = FLAGS.sdpa_auto_flash
         FLAGS.sdpa_auto_flash = auto
         try:
-            q = jnp.zeros((2, 4, S, 64), dtype)
+            # non-degenerate inputs: BOTH paths must run clean — a
+            # crash in either is a real failure (ADVICE r4: a blanket
+            # except here swallowed the dispatched path's errors too)
+            q = jnp.full((2, 4, S, 64), 0.1, dtype)
             A.scaled_dot_product_attention(
                 q, q, q, None, scale=0.125, dropout_rate=rate,
                 rng=rng)
-        except Exception:
-            pass  # reference path may fail on zeros: dispatch decided
         finally:
             FLAGS.sdpa_auto_flash = prev
         return calls == ["flash"]

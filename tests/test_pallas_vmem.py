@@ -178,6 +178,75 @@ def test_attention_flagship_fits_vmem(dtype):
     _assert_fits(calls, "scaled_dot_product_attention[%s]" % dtype)
 
 
+def _1k_temp_bytes(call):
+    """In-kernel [G,Sq,Sk] f32 temporary model for the single-k-block
+    attention kernels (ADVICE r4: streamed blocks alone under-count
+    them). q block = in_specs[1] (G, Sq, Dh); k block = (G, Sk, Dh).
+    Bytes/element anchored on the chip accepting the headline bf16
+    [8,256,256] backward — see attention._1K_TEMP_BYTES."""
+    from paddle_tpu.ops.pallas import attention as A
+    blocks = [getattr(s, "block_shape", None) for s in call["in_specs"]]
+    if len(blocks) < 3 or blocks[1] is None or len(blocks[1]) != 3:
+        return 0
+    G, Sq, _ = blocks[1]
+    Sk = blocks[2][1]
+    return int(G) * int(Sq) * int(Sk) * A._1K_TEMP_BYTES
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("rate", [0.0, 0.1])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_attention_1k_corner_fits_vmem(dtype, rate, with_bias):
+    """The Sq=256/Sk=512 corner of _1k_applicable — the largest
+    single-k-block geometry FLAGS_sdpa_auto_flash dispatches by
+    default. Charges streamed blocks AND the in-kernel score
+    temporaries."""
+    from paddle_tpu.ops.pallas import attention as A
+    Sq, Sk, Dh = 256, 512, 64
+    assert A._1k_applicable(Sq, Sk)
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.rand(4, _H, Sq, Dh).astype(dtype))
+    k = jnp.asarray(rs.rand(4, _H, Sk, Dh).astype(dtype))
+    v = jnp.asarray(rs.rand(4, _H, Sk, Dh).astype(dtype))
+    var = ops.get("scaled_dot_product_attention").variants["pallas"]
+    rng = jax.random.PRNGKey(0) if rate else None
+    bias = (jnp.asarray(rs.rand(4, _H, Sq, Sk).astype("float32"))
+            if with_bias else None)
+
+    def fwd_bwd():
+        def loss(q_, k_, v_):
+            return jnp.sum(var(q_, k_, v_, bias, dropout_rate=rate,
+                               causal=False, rng=rng))
+        jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    orig = A.interpret_mode
+    A.interpret_mode = lambda: False  # force the TPU kernel path
+    try:
+        calls = _capture_calls(fwd_bwd)
+    finally:
+        A.interpret_mode = orig
+    for n, call in enumerate(calls):
+        total = _footprint(call) + _1k_temp_bytes(call)
+        assert total <= V5E_SCOPED_VMEM, (
+            "1k[%s,rate=%s] call %d modeled VMEM %.1f MB exceeds the "
+            "v5e scoped limit" % (dtype, rate, n, total / 2**20))
+
+
+def test_1k_headline_geometry_pinned():
+    """The round-4 chip-measured winner (bf16, Sq=Sk=256, dropout,
+    H=8) ran at G=8 fwd AND bwd. Any VMEM-model change that silently
+    shrinks this G regresses the measured +12% — fail loudly here
+    instead."""
+    from paddle_tpu.ops.pallas import attention as A
+    assert A._1k_fwd_G(8, 2, 0.1, 256, 256, 64) == 8
+    assert A._1k_bwd_G(8, 2, 256, 256, 64) == 8
+    # the known f32 constraint: backward needs G=4 at the flagship
+    # shape (pre-existing _bwd_G contract, now reproduced by the model)
+    assert A._1k_bwd_G(8, 4, 256, 256, 64) == 4
+    # the ADVICE r4 corner: bf16 Sq=256/Sk=512 must NOT run at G=8
+    assert A._1k_bwd_G(8, 2, 256, 512, 64) <= 4
+
+
 def test_layer_norm_flagship_fits_vmem():
     rs = np.random.RandomState(0)
     x = jnp.asarray(rs.rand(_N, _D).astype("float32"))
