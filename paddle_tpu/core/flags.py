@@ -119,6 +119,13 @@ FLAGS.define("sdpa_auto_flash", True,
              "for its pure-XLA base row. Chip evidence 2026-07-31: "
              "+12% in-model on transformer-base b64.")
 
+FLAGS.define("ring_flash", True,
+             "ring_attention computes each hop's block attention with "
+             "the pallas partial-softmax kernels (ops/pallas/ring.py) "
+             "so [Sq_loc, Sk_loc] scores stay in VMEM; falls back to "
+             "the jnp body when no kernel geometry fits the scoped-"
+             "VMEM model (ring.applicable).")
+
 FLAGS.define("lean_xent_grad", True,
              "fused_linear_xent uses the hand-written one-fusion "
              "backward writing dlogits in the input dtype "

@@ -142,8 +142,18 @@ def _ps_minimize(f, fluid, loss):
     each trainer minimizes loss/N on the identical global batch —
     summed server grad == the local-run grad and every trainer's
     (unscaled) loss trace must equal the local trace. Server and
-    trainer must build the SAME program for grad names to align."""
+    trainer must build the SAME program for grad names to align.
+
+    DIST_PS_ASYNC=1 flips to asynchronous SGD (ListenAndServ
+    RunAsyncLoop semantics): every arriving grad optimizes
+    immediately, no barrier and no 1/N scaling — trainers only
+    guarantee convergence, not trace equality."""
     from paddle_tpu import layers
+    if os.environ.get("DIST_PS_ASYNC"):
+        opt = f.distributed_optimizer(fluid.optimizer.SGD(_lr()))
+        opt._strategy.async_mode = True
+        opt.minimize(loss)
+        return
     n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     obj = loss if n == 1 else layers.scale(loss, scale=1.0 / n)
     opt = f.distributed_optimizer(fluid.optimizer.SGD(_lr()))

@@ -119,3 +119,62 @@ def test_fallback_without_mesh(qkv):
                                atol=1e-6)
     np.testing.assert_allclose(np.asarray(got2), np.asarray(want),
                                atol=1e-6)
+
+
+# --- flash ring (pallas per-hop kernels, ops/pallas/ring.py) ---------------
+
+def _long_qkv(rng, S=1024, B=1, H=8, Dh=32):
+    q = jnp.asarray(rng.randn(B, H, S, Dh).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, Dh).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, Dh).astype(np.float32)) * 0.3
+    return q, k, v
+
+
+def test_ring_flash_applicable_at_long_seq():
+    from paddle_tpu.ops.pallas import ring as R
+    # the S=1024 sp=8 dryrun geometry must take the flash path...
+    assert R.applicable(1, 8, 128, 128, 32, 4)
+    # ...the S=64 sp=4 legacy test shapes (Sk=16) must not
+    assert not R.applicable(2, 8, 16, 16, 16, 4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full_attention_s1024(rng, causal):
+    """8 real ring hops at S=1024: the flash body (scores in VMEM)
+    must reproduce full attention — the VERDICT r4 long-context
+    measurement shape, run in pallas interpret mode on the CPU
+    mesh."""
+    q, k, v = _long_qkv(rng)
+    want = _full_attention(q, k, v, 0.5, causal)
+    mesh = _sp_mesh(8)
+    got = ring_attention(q, k, v, mesh=mesh, scale=0.5, causal=causal,
+                         use_flash=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    # and the jnp body agrees with the SAME tolerance (path parity)
+    got_jnp = ring_attention(q, k, v, mesh=mesh, scale=0.5,
+                             causal=causal, use_flash=False)
+    np.testing.assert_allclose(np.asarray(got_jnp), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_gradients_match_s1024(rng):
+    """Values AND grads through the ring backward (dk/dv accumulators
+    riding the ring) against full attention autodiff."""
+    q, k, v = _long_qkv(rng)
+    mesh = _sp_mesh(8)
+
+    def loss_ref(a, b, c):
+        return jnp.sum(_full_attention(a, b, c, 0.5, True) ** 2)
+
+    def loss_flash(a, b, c):
+        return jnp.sum(ring_attention(a, b, c, mesh=mesh, scale=0.5,
+                                      causal=True,
+                                      use_flash=True) ** 2)
+
+    gw = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gg, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg="d%s" % name)
