@@ -139,6 +139,13 @@ FLAGS.define("mxu_bias_grad", True,
              "_bias_add_vjp) — faster AND closer to the exact f32 "
              "sum.")
 
+FLAGS.define("mxu_ln_grad", False,
+             "layer_norm's dScale/dBias column reductions run as "
+             "ones@M MXU dots with f32 accumulation (the "
+             "mxu_bias_grad treatment extended to the layer-norm "
+             "affine tail — ops/nn_ops._ln_affine). Default OFF "
+             "until chip-measured in-model (tools/lever_ab.py).")
+
 FLAGS.define("multi_tensor_adam", False,
              "Trace consecutive dense adam/adamw ops over SMALL "
              "parameters as one concatenated multi-tensor update "

@@ -537,6 +537,41 @@ def batch_norm(x, scale, bias, mean, var, *, epsilon=1e-5, momentum=0.9,
     return y, mean_out, var_out, bmean, bvar
 
 
+@jax.custom_vjp
+def _ln_affine(norm, scale, bias):
+    """custom-vjp affine tail of layer_norm (FLAGS.mxu_ln_grad): the
+    dScale/dBias column reductions over N rows run as ones@M MXU dots
+    with f32 accumulation instead of the convert_reduce fusions the
+    round-4 step anatomy charged ~7.8 ms/step to (BASELINE.md). Same
+    treatment as ops/math_ops._bias_add_vjp, extended to the scale
+    product. dX path (through mean/var) stays autodiff. scale/bias
+    arrive already broadcast-shaped ([1, ..., D])."""
+    return norm * scale + bias
+
+
+def _ln_affine_fwd(norm, scale, bias):
+    return norm * scale + bias, (norm, scale)
+
+
+def _ln_affine_bwd(res, g):
+    norm, scale = res
+    dnorm = g * scale
+    d = g.shape[-1]
+    g2 = g.reshape(-1, d)
+    n2 = norm.reshape(-1, d)
+    ones = jnp.ones((g2.shape[0],), g2.dtype)
+    dims = (((0,), (0,)), ((), ()))
+    dbias = lax.dot_general(ones, g2, dims,
+                            preferred_element_type=jnp.float32)
+    dscale = lax.dot_general(ones, g2 * n2, dims,
+                             preferred_element_type=jnp.float32)
+    return (dnorm, dscale.reshape(scale.shape).astype(scale.dtype),
+            dbias.reshape(scale.shape).astype(g.dtype))
+
+
+_ln_affine.defvjp(_ln_affine_fwd, _ln_affine_bwd)
+
+
 @register("layer_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"])
 def layer_norm(x, scale, bias, *, epsilon=1e-5, begin_norm_axis=1):
     """Reference: layer_norm_op.cc. Normalizes over dims
@@ -546,6 +581,7 @@ def layer_norm(x, scale, bias, *, epsilon=1e-5, begin_norm_axis=1):
     precision), output back in the INPUT dtype — under AMP this keeps
     the bf16 stream flowing instead of shipping f32 activations to the
     next matmul's cast (the same policy as batch_norm)."""
+    from ..core.flags import FLAGS
     axes = tuple(range(begin_norm_axis, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
@@ -553,6 +589,12 @@ def layer_norm(x, scale, bias, *, epsilon=1e-5, begin_norm_axis=1):
     inv = lax.rsqrt(var + epsilon)
     norm = (xf - mean) * inv
     bshape = [1] * begin_norm_axis + list(x.shape[begin_norm_axis:])
+    if (FLAGS.mxu_ln_grad and scale is not None and bias is not None
+            and len(axes) == 1 and x.shape[-1] == scale.shape[-1]):
+        norm = _ln_affine(norm,
+                          scale.reshape(bshape).astype(norm.dtype),
+                          bias.reshape(bshape).astype(norm.dtype))
+        return norm.astype(x.dtype), jnp.squeeze(mean), jnp.squeeze(var)
     if scale is not None:
         norm = norm * scale.reshape(bshape)
     if bias is not None:

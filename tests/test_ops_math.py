@@ -265,3 +265,39 @@ class TestReviewRegressions:
             shape = (100, 50)
         fi, fo = _fan_in_out(V2)
         assert fi == 100 and fo == 50
+
+
+def test_mxu_ln_grad_matches_autodiff():
+    """FLAGS.mxu_ln_grad routes layer_norm's dScale/dBias through
+    ones@M MXU dots (ops/nn_ops._ln_affine); values and ALL grads
+    must match the plain autodiff lowering."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import ops
+    from paddle_tpu.core.flags import FLAGS
+
+    ln = ops.get("layer_norm").fn
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(48, 64).astype(np.float32))
+    sc = jnp.asarray(rs.rand(64).astype(np.float32) + 0.5)
+    b = jnp.asarray(rs.randn(64).astype(np.float32))
+
+    def loss(x_, s_, b_):
+        y, _, _ = ln(x_, s_, b_, begin_norm_axis=1)
+        return jnp.sum(y * jnp.cos(y))
+
+    prev = FLAGS.mxu_ln_grad
+    try:
+        FLAGS.mxu_ln_grad = False
+        want_y = ln(x, sc, b, begin_norm_axis=1)[0]
+        gw = jax.grad(loss, argnums=(0, 1, 2))(x, sc, b)
+        FLAGS.mxu_ln_grad = True
+        got_y = ln(x, sc, b, begin_norm_axis=1)[0]
+        gg = jax.grad(loss, argnums=(0, 1, 2))(x, sc, b)
+    finally:
+        FLAGS.mxu_ln_grad = prev
+    np.testing.assert_allclose(np.asarray(got_y), np.asarray(want_y),
+                               rtol=1e-6, atol=1e-6)
+    for name, a, b_ in zip(["dx", "dscale", "dbias"], gg, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
