@@ -456,27 +456,35 @@ def bench_mnist_mlp(batch=512, warmup=5, iters=300):
 _RESNET50_FWD_FLOPS = 8.2e9  # standard 224x224 fwd GFLOPs (convs+fc)
 
 
-def bench_resnet50(batch=64, warmup=3, iters=60):
+def _build_resnet_step(batch, s2d_stem=False):
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as amp
+    from paddle_tpu.core.flags import FLAGS
     from paddle_tpu.models import resnet as R
 
-    main, startup = fluid.Program(), fluid.Program()
-    main.random_seed = 1
-    with fluid.program_guard(main, startup):
-        # NCHW — the model's declared layout (models/resnet.py). The
-        # NHWC shape fed here until round 4 collapsed the spatial dims
-        # to [112, 1] after the stem (C_in=224, W=3!), which is how the
-        # "0.745 MFU" round-2 figure slipped past: the network trained
-        # on a 1-pixel-wide image. Caught when the honest protocol
-        # reported MFU > 1.
-        img = fluid.layers.data("img", shape=[3, 224, 224],
-                                dtype="float32")
-        label = fluid.layers.data("label", shape=[1], dtype="int64")
-        pred = R.resnet50(img)
-        loss, _acc = R.loss_and_acc(pred, label)
-        opt = amp.decorate(fluid.optimizer.MomentumOptimizer(0.1, 0.9))
-        opt.minimize(loss)
+    prev = FLAGS.resnet_s2d_stem
+    FLAGS.resnet_s2d_stem = s2d_stem
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 1
+        with fluid.program_guard(main, startup):
+            # NCHW — the model's declared layout (models/resnet.py).
+            # The NHWC shape fed here until round 4 collapsed the
+            # spatial dims to [112, 1] after the stem (C_in=224,
+            # W=3!), which is how the "0.745 MFU" round-2 figure
+            # slipped past: the network trained on a 1-pixel-wide
+            # image. Caught when the honest protocol reported MFU > 1.
+            img = fluid.layers.data("img", shape=[3, 224, 224],
+                                    dtype="float32")
+            label = fluid.layers.data("label", shape=[1],
+                                      dtype="int64")
+            pred = R.resnet50(img)
+            loss, _acc = R.loss_and_acc(pred, label)
+            opt = amp.decorate(
+                fluid.optimizer.MomentumOptimizer(0.1, 0.9))
+            opt.minimize(loss)
+    finally:
+        FLAGS.resnet_s2d_stem = prev
     exe = fluid.Executor()
     exe.run(startup)
     rs = np.random.RandomState(0)
@@ -484,12 +492,39 @@ def bench_resnet50(batch=64, warmup=3, iters=60):
         "img": rs.rand(batch, 3, 224, 224).astype(np.float32),
         "label": rs.randint(0, 1000, size=(batch, 1)).astype(np.int64),
     })
-    sps, measured = _best_library(
-        lambda k: exe.run_repeated(main, feed=feed, fetch_list=[loss],
-                                   iters=k),
-        warmup, iters)
+    return lambda k: exe.run_repeated(main, feed=feed,
+                                      fetch_list=[loss], iters=k)
+
+
+def bench_resnet50(batch=None, warmup=3, iters=60):
+    # batch override for the mem_estimate-guided scaling lever
+    # (VERDICT r4 #3): the capture script measures 64/96/128 without
+    # editing code; the committed default stays the known-safe 64
+    # until a larger batch is chip-proven.
+    if batch is None:
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
+    run = _build_resnet_step(batch, s2d_stem=False)
+    sps, measured = _best_library(run, warmup, iters)
+
+    # in-model A/B of the space_to_depth stem (numerically-equivalent
+    # MLPerf stem, FLAGS.resnet_s2d_stem) — measured as its own
+    # program; reported as a mix row so the evidence log carries both
+    try:
+        _release_device_state()
+    except Exception:
+        pass
+    try:
+        run_s2d = _build_resnet_step(batch, s2d_stem=True)
+        sps_s2d = _timed_loop(run_s2d, warmup, iters)
+        measured.append({"library": "s2d_stem",
+                         "steps_per_sec": round(sps_s2d, 3)})
+        if sps_s2d > sps:
+            sps = sps_s2d
+    except Exception as e:
+        measured.append({"library": "s2d_stem", "error": repr(e)})
     return {"metric": "resnet50_train_throughput",
             "value": round(batch * sps, 1), "unit": "images/sec/chip",
+            "batch": batch,
             "mfu": _mfu(3.0 * _RESNET50_FWD_FLOPS * batch, sps),
             "_mixes": measured}
 

@@ -139,6 +139,13 @@ FLAGS.define("mxu_bias_grad", True,
              "_bias_add_vjp) — faster AND closer to the exact f32 "
              "sum.")
 
+FLAGS.define("resnet_s2d_stem", False,
+             "ResNet ImageNet stem runs as space_to_depth(2) + "
+             "4x4/s1 conv (12 input channels) instead of 7x7/s2 on "
+             "3 channels — the numerically-equivalent MLPerf stem "
+             "(models/resnet.s2d_stem_weights). Default OFF until "
+             "chip-measured in-model.")
+
 FLAGS.define("mxu_ln_grad", False,
              "layer_norm's dScale/dBias column reductions run as "
              "ones@M MXU dots with f32 accumulation (the "

@@ -53,16 +53,56 @@ def _layer_warp(block_func, input, ch_out, count, stride, is_test=False):
     return res_out
 
 
+def s2d_stem_weights(w7):
+    """Rearrange a [oc, C, 7, 7] stride-2 stem kernel into the
+    numerically-EQUIVALENT [oc, 4C, 4, 4] stride-1 kernel applied
+    after space_to_depth(blocksize=2) (the MLPerf ResNet stem trick:
+    a 3-channel 7x7/s2 conv starves the MXU's 128 input lanes; the
+    12-channel 4x4/s1 form is the same linear map). Derivation:
+    2i+a-3 = 2(i+m)+r with r=(a-3)%2 — m spans [-2,1], hence the
+    (2,1) asymmetric pad in _s2d_stem. Channel order matches
+    ops/vision_ops.space_to_depth: out_ch = (r*2+s)*C + c.
+    tests/test_resnet_s2d.py proves output equality."""
+    import numpy as np
+    oc, C, kh, kw = w7.shape
+    w2 = np.zeros((oc, 4 * C, 4, 4), np.asarray(w7).dtype)
+    for r in (0, 1):
+        for s in (0, 1):
+            for m in range(-2, 2):
+                for n in range(-2, 2):
+                    a, b = 2 * m + r + 3, 2 * n + s + 3
+                    if 0 <= a < kh and 0 <= b < kw:
+                        w2[:, (r * 2 + s) * C:(r * 2 + s + 1) * C,
+                           m + 2, n + 2] = np.asarray(w7)[:, :, a, b]
+    return w2
+
+
+def _s2d_stem(input, is_test=False):
+    """space_to_depth stem: [B,3,224,224] -> s2d(2) [B,12,112,112] ->
+    4x4/s1 conv with (2,1) asymmetric pads -> [B,64,112,112], the
+    exact linear map of the 7x7/s2 stem (s2d_stem_weights)."""
+    s2d = layers.space_to_depth(input, blocksize=2)
+    conv = layers.conv2d(s2d, num_filters=64, filter_size=4, stride=1,
+                         padding=[2, 1, 2, 1], act=None,
+                         bias_attr=False)
+    return layers.batch_norm(conv, act="relu", is_test=is_test)
+
+
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
     """ImageNet-shape ResNet; depth in {18, 34, 50, 101, 152}."""
+    from ..core.flags import FLAGS
     cfg = {18: ([2, 2, 2, 2], basicblock),
            34: ([3, 4, 6, 3], basicblock),
            50: ([3, 4, 6, 3], bottleneck_block),
            101: ([3, 4, 23, 3], bottleneck_block),
            152: ([3, 8, 36, 3], bottleneck_block)}
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
-                          padding=3, is_test=is_test)
+    if FLAGS.resnet_s2d_stem and input.shape[2] % 2 == 0 \
+            and input.shape[3] % 2 == 0:
+        conv1 = _s2d_stem(input, is_test=is_test)
+    else:
+        conv1 = conv_bn_layer(input, ch_out=64, filter_size=7,
+                              stride=2, padding=3, is_test=is_test)
     pool1 = layers.pool2d(conv1, pool_size=3, pool_stride=2,
                           pool_padding=1, pool_type="max")
     res = pool1

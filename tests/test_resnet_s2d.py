@@ -1,0 +1,69 @@
+"""space_to_depth ResNet stem: exact equivalence to the 7x7/s2 conv.
+
+The MLPerf stem trick (models/resnet.s2d_stem_weights) must be the
+SAME linear map — conv(7x7, s2, p3) == conv(s2d(x), 4x4, s1,
+pads (2,1)) with the rearranged kernel — otherwise the lever would be
+changing the model, not its layout.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import ops
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.models.resnet import s2d_stem_weights
+
+
+def test_s2d_stem_weight_transform_exact():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 32, 32).astype(np.float32)
+    w7 = rs.randn(8, 3, 7, 7).astype(np.float32)
+
+    conv = ops.get("conv2d").fn
+    want = conv(jnp.asarray(x), jnp.asarray(w7), strides=(2, 2),
+                paddings=(3, 3))
+
+    s2d = ops.get("space_to_depth").fn
+    x2 = s2d(jnp.asarray(x), blocksize=2)
+    w2 = s2d_stem_weights(w7)
+    got = conv(x2, jnp.asarray(w2), strides=(1, 1),
+               paddings=(2, 1, 2, 1))
+
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet50_s2d_flag_builds_and_runs():
+    """Flag on: the model builds, trains a step, and the stem conv
+    parameter has the 12-channel 4x4 shape."""
+    prev = FLAGS.resnet_s2d_stem
+    FLAGS.resnet_s2d_stem = True
+    try:
+        from paddle_tpu.models import resnet as R
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[3, 64, 64],
+                                    dtype="float32")
+            label = fluid.layers.data("label", shape=[1],
+                                      dtype="int64")
+            pred = R.resnet50(img, class_dim=10)
+            loss, _ = R.loss_and_acc(pred, label)
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+        shapes = {tuple(v.shape)
+                  for v in main.global_block().all_parameters()}
+        assert (64, 12, 4, 4) in shapes
+        assert not any(s[-1] == 7 for s in shapes)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        (lv,) = exe.run(
+            main,
+            feed={"img": rs.rand(2, 3, 64, 64).astype(np.float32),
+                  "label": rs.randint(0, 10, (2, 1)).astype(np.int64)},
+            fetch_list=[loss])
+        assert np.isfinite(float(lv))
+    finally:
+        FLAGS.resnet_s2d_stem = prev

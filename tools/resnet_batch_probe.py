@@ -1,0 +1,56 @@
+"""Measure ResNet-50 at a LARGER batch — but only after the compiler
+says it fits (no OOM probing: a RESOURCE_EXHAUSTED launch leaks
+server-side buffers on the tunneled backend, BASELINE.md round-4
+harness learnings).
+
+    python tools/resnet_batch_probe.py 96 [128 ...]
+
+For each batch: compile-only mem_estimate first; if peak (or the
+temp+arg bound when peak is unreported) stays under the HBM budget,
+run the real measurement via bench.bench_resnet50 and print its JSON
+line. The batch-scaling lever of VERDICT r4 #3, made safe.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_prng_impl", "rbg")
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache"))
+
+# v5e: 16 GB HBM; leave 1.5 GB headroom for the runtime/framework
+HBM_BUDGET_GB = float(os.environ.get("HBM_BUDGET_GB", "14.5"))
+
+
+def main():
+    import mem_estimate
+
+    import bench
+
+    batches = [int(a) for a in sys.argv[1:]] or [96]
+    for b in batches:
+        est = mem_estimate.estimate("resnet50", b)
+        print(json.dumps({"probe": "estimate", **est}), flush=True)
+        peak = est.get("peak_memory_gb")
+        if peak is None:
+            peak = (est.get("temp_size_gb", 0)
+                    + est.get("argument_size_gb", 0))
+        if peak > HBM_BUDGET_GB:
+            print(json.dumps({"probe": "skip", "batch": b,
+                              "reason": "est %.2f GB > budget %.2f"
+                              % (peak, HBM_BUDGET_GB)}), flush=True)
+            continue
+        bench._release_device_state()
+        r = bench.bench_resnet50(batch=b)
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,15 +33,19 @@ print('CLAIM_OK', d.device_kind)
 " >>"$LOG" 2>&1 && tail -5 "$LOG" | grep -q CLAIM_OK; then
         say "window open — bench headline (flash mix)"
         timeout 2400 python bench.py >>"$LOG" 2>&1
-        say "lever_ab fast"
-        timeout 2400 python tools/lever_ab.py fast >>"$LOG" 2>&1
-        say "bench --all"
-        timeout 3600 python bench.py --all >>"$LOG" 2>&1
-        say "kernel table"
+        say "lever_ab FULL (r5: mxu_ln_grad rows)"
+        timeout 3600 python tools/lever_ab.py >>"$LOG" 2>&1
+        say "bench --all (longseq + resnet s2d A/B rows)"
+        timeout 4800 python bench.py --all >>"$LOG" 2>&1
+        say "kernel table (incl. bf16+dropout sdpa row)"
         KERNEL_TABLE_STALL_S=360 timeout 3000 \
             python tools/kernel_table.py --json >>"$LOG" 2>&1
-        say "resnet mem estimates"
+        say "resnet mem estimates 96/128"
         timeout 2400 python tools/mem_estimate.py resnet50 96 128 \
+            >>"$LOG" 2>&1
+        say "resnet b96 (only if mem_estimate said it fits: the"
+        say "  runner itself re-checks and skips on estimate-fail)"
+        timeout 2400 python tools/resnet_batch_probe.py 96 \
             >>"$LOG" 2>&1
         say "capture complete"
         exit 0
