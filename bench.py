@@ -496,32 +496,36 @@ def _build_resnet_step(batch, s2d_stem=False):
                                       fetch_list=[loss], iters=k)
 
 
-def bench_resnet50(batch=None, warmup=3, iters=60):
+def bench_resnet50(batch=None, warmup=3, iters=60, s2d_ab=True):
     # batch override for the mem_estimate-guided scaling lever
     # (VERDICT r4 #3): the capture script measures 64/96/128 without
     # editing code; the committed default stays the known-safe 64
-    # until a larger batch is chip-proven.
+    # until a larger batch is chip-proven. s2d_ab=False skips the
+    # second (s2d-stem) program — tools/resnet_batch_probe.py has
+    # only estimated the default program, so it must not launch an
+    # unestimated variant.
     if batch is None:
         batch = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
     run = _build_resnet_step(batch, s2d_stem=False)
     sps, measured = _best_library(run, warmup, iters)
 
     # in-model A/B of the space_to_depth stem (numerically-equivalent
-    # MLPerf stem, FLAGS.resnet_s2d_stem) — measured as its own
-    # program; reported as a mix row so the evidence log carries both
-    try:
-        _release_device_state()
-    except Exception:
-        pass
-    try:
-        run_s2d = _build_resnet_step(batch, s2d_stem=True)
-        sps_s2d = _timed_loop(run_s2d, warmup, iters)
-        measured.append({"library": "s2d_stem",
-                         "steps_per_sec": round(sps_s2d, 3)})
-        if sps_s2d > sps:
-            sps = sps_s2d
-    except Exception as e:
-        measured.append({"library": "s2d_stem", "error": repr(e)})
+    # MLPerf stem, FLAGS.resnet_s2d_stem): same _best_library
+    # methodology as the base program (best-of-mixes vs best-of-mixes,
+    # no library bias), reported as mix rows so the evidence log
+    # carries both sides.
+    if s2d_ab:
+        try:
+            _release_device_state()
+            run_s2d = _build_resnet_step(batch, s2d_stem=True)
+            sps_s2d, measured_s2d = _best_library(run_s2d, warmup,
+                                                  iters)
+            measured.extend(("s2d_stem+%s" % lib, v)
+                            for lib, v in measured_s2d)
+            if sps_s2d > sps:
+                sps = sps_s2d
+        except Exception as e:
+            measured.append(("s2d_stem:error:%r" % (e,), 0.0))
     return {"metric": "resnet50_train_throughput",
             "value": round(batch * sps, 1), "unit": "images/sec/chip",
             "batch": batch,

@@ -82,10 +82,8 @@ def _s2d_stem(input, is_test=False):
     4x4/s1 conv with (2,1) asymmetric pads -> [B,64,112,112], the
     exact linear map of the 7x7/s2 stem (s2d_stem_weights)."""
     s2d = layers.space_to_depth(input, blocksize=2)
-    conv = layers.conv2d(s2d, num_filters=64, filter_size=4, stride=1,
-                         padding=[2, 1, 2, 1], act=None,
-                         bias_attr=False)
-    return layers.batch_norm(conv, act="relu", is_test=is_test)
+    return conv_bn_layer(s2d, ch_out=64, filter_size=4, stride=1,
+                         padding=[2, 1, 2, 1], is_test=is_test)
 
 
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):

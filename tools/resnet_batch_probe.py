@@ -36,20 +36,42 @@ def main():
 
     batches = [int(a) for a in sys.argv[1:]] or [96]
     for b in batches:
-        est = mem_estimate.estimate("resnet50", b)
+        try:
+            est = mem_estimate.estimate("resnet50", b)
+        except Exception as e:
+            # a compile failure at one batch must not forfeit the
+            # remaining (smaller) batches of an unattended window
+            print(json.dumps({"probe": "estimate_error", "batch": b,
+                              "error": repr(e)}), flush=True)
+            continue
         print(json.dumps({"probe": "estimate", **est}), flush=True)
         peak = est.get("peak_memory_gb")
         if peak is None:
             peak = (est.get("temp_size_gb", 0)
                     + est.get("argument_size_gb", 0))
+        if peak <= 0:
+            # fail CLOSED: no memory fields reported means no safety
+            # information — refuse rather than risk the OOM buffer
+            # leak this tool exists to prevent
+            print(json.dumps({"probe": "skip", "batch": b,
+                              "reason": "memory_analysis reported no "
+                                        "sizes — refusing unestimated "
+                                        "launch"}), flush=True)
+            continue
         if peak > HBM_BUDGET_GB:
             print(json.dumps({"probe": "skip", "batch": b,
                               "reason": "est %.2f GB > budget %.2f"
                               % (peak, HBM_BUDGET_GB)}), flush=True)
             continue
-        bench._release_device_state()
-        r = bench.bench_resnet50(batch=b)
-        print(json.dumps(r), flush=True)
+        try:
+            bench._release_device_state()
+            # s2d_ab=False: only the default program was estimated;
+            # never launch an unestimated variant
+            r = bench.bench_resnet50(batch=b, s2d_ab=False)
+            print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(json.dumps({"probe": "bench_error", "batch": b,
+                              "error": repr(e)}), flush=True)
 
 
 if __name__ == "__main__":
