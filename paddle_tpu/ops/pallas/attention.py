@@ -55,6 +55,7 @@ kernel dispatch), operators/fused/.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -277,6 +278,14 @@ def _1k_applicable(Sq, Sk):
 # headline geometry (bf16 256x256 dropout) to G=8.
 _1K_TEMP_BYTES = 8
 _1K_VMEM_BUDGET = 15 << 20
+
+# Blocked-path tile targets, env-tunable for on-chip sweeps
+# (tools/blocked_sweep.py): PALLAS_BLK_Q / PALLAS_BLK_K. The committed
+# defaults are the round-4 choices; any change must be chip-measured
+# in-model at S>=1024 first (the blocked path never dispatches at the
+# S=256 flagship — _1k_applicable owns that envelope).
+_BLK_Q_TARGET = int(os.environ.get("PALLAS_BLK_Q", "256"))
+_BLK_K_TARGET = int(os.environ.get("PALLAS_BLK_K", "512"))
 
 
 def _1k_row_bytes(itemsize, Sq, Sk, Dh, n_sq_ops, n_sk_ops, has_bias):
@@ -523,8 +532,8 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
     q3 = q.reshape(BH, Sq, Dh)
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
-    blk_q = blk(Sq, 256)
-    blk_k = blk(Sk, 512)
+    blk_q = blk(Sq, _BLK_Q_TARGET)
+    blk_k = blk(Sk, _BLK_K_TARGET)
     n_k = Sk // blk_k
     grid = (BH // G, Sq // blk_q, n_k)
     seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
@@ -688,8 +697,8 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
     k3 = k.reshape(BH, Sk, Dh)
     v3 = v.reshape(BH, Sk, Dh)
     do3 = g.reshape(BH, Sq, Dh)
-    blk_q = blk(Sq, 256)
-    blk_k = blk(Sk, 512)
+    blk_q = blk(Sq, _BLK_Q_TARGET)
+    blk_k = blk(Sk, _BLK_K_TARGET)
     n_q, n_k = Sq // blk_q, Sk // blk_k
     seed = jnp.asarray([seed_f.astype(jnp.int32)], jnp.int32)
     # delta_i = rowsum(dO * O): O(S*Dh) elementwise work, XLA fuses it.
