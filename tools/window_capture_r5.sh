@@ -47,6 +47,8 @@ print('CLAIM_OK', d.device_kind)
         say "  runner itself re-checks and skips on estimate-fail)"
         timeout 2400 python tools/resnet_batch_probe.py 96 \
             >>"$LOG" 2>&1
+        say "step anatomy profile (copies chase, VERDICT r4 #8)"
+        timeout 1800 python tools/profile_step.py >>"$LOG" 2>&1
         say "capture complete"
         exit 0
     fi
