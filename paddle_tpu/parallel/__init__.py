@@ -25,3 +25,5 @@ from .ulysses import ulysses_attention as ulysses_attention_fn  # noqa
 from . import multihost  # noqa: F401
 from . import pipeline  # noqa: F401
 from .pipeline import gpipe_apply, stack_stage_params  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import moe_ffn, moe_ffn_reference  # noqa: F401

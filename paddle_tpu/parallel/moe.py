@@ -1,0 +1,154 @@
+"""Expert parallelism: Switch-style mixture-of-experts FFN over an
+``ep`` mesh axis.
+
+Not in the 2019 reference — the last cell of this framework's
+parallelism matrix (dp x tp x sp x pp x ep), built the TPU way
+(GShard/Switch): static shapes throughout (capacity buckets, no
+data-dependent shapes under jit), expert weights sharded over ``ep``,
+tokens data-sharded over the SAME axis, and ONE ``lax.all_to_all``
+each way moving only the capacity buckets across ICI.
+
+Top-1 (Switch) routing with capacity dropping:
+  gate probs -> argmax expert -> position-in-expert by cumsum ->
+  tokens beyond capacity C = ceil(n * capacity_factor / E) are
+  DROPPED (output zero for their expert contribution) — the standard
+  static-shape trade; callers size capacity_factor accordingly.
+Router z-loss / aux balancing losses are returned so training can
+regularize routing (Switch Transformer recipe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from . import mesh as mesh_lib
+
+
+def _route_top1(x, gate_w, n_experts, capacity):
+    """Shared routing math (identical on the sharded and reference
+    paths — determinism is the equality test's foundation).
+    Returns (dispatch [E, C, D], combine_prob [n], idx [n], pos [n],
+    keep [n], f [E] routed fraction, p [E] mean router prob). The aux
+    loss is E * sum(f * p) — composed by the CALLER so the sharded
+    path can pmean f and p across shards BEFORE the product (the
+    global Switch loss; per-shard products averaged afterwards would
+    be a different, larger quantity)."""
+    n, d = x.shape
+    logits = x @ gate_w                               # [n, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)                  # [n]
+    prob = jnp.max(probs, axis=-1)                    # [n]
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)
+    # position of each token within its expert's capacity bucket
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1.0  # [n]
+    pos = pos.astype(jnp.int32)
+    keep = (pos < capacity) & (pos >= 0)
+    f = onehot.mean(0)                                # fraction routed
+    p = probs.mean(0)                                 # mean router prob
+    dispatch = jnp.zeros((n_experts, capacity, d), x.dtype)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    dispatch = dispatch.at[idx, jnp.clip(pos, 0, capacity - 1)].add(
+        contrib)
+    return dispatch, prob, idx, pos, keep, f, p
+
+
+def _expert_ffn(w1, b1, w2, b2, h):
+    """Batched per-expert FFN: h [E_loc, T, D] -> [E_loc, T, D]."""
+    y = jnp.einsum("etd,edf->etf", h, w1) + b1[:, None, :]
+    y = jax.nn.relu(y)
+    return jnp.einsum("etf,efd->etd", y, w2) + b2[:, None, :]
+
+
+def _combine(expert_out, prob, idx, pos, keep, capacity):
+    """Gather each token's expert output and scale by its gate
+    probability; dropped tokens contribute zero."""
+    safe_pos = jnp.clip(pos, 0, capacity - 1)
+    y = expert_out[idx, safe_pos]                     # [n, D]
+    return jnp.where(keep[:, None],
+                     y * prob[:, None].astype(y.dtype), 0.0)
+
+
+def moe_ffn_reference(x, gate_w, w1, b1, w2, b2, *,
+                      capacity_factor=1.25):
+    """Single-device reference semantics (the equality oracle): same
+    routing, all experts local."""
+    n = x.shape[0]
+    E = w1.shape[0]
+    capacity = int(-(-n * capacity_factor // E))
+    dispatch, prob, idx, pos, keep, f, p = _route_top1(
+        x, gate_w, E, capacity)
+    aux = E * jnp.sum(f * p)
+    expert_out = _expert_ffn(w1, b1, w2, b2, dispatch)
+    return _combine(expert_out, prob, idx, pos, keep, capacity), aux
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
+            capacity_factor=1.25):
+    """Expert-parallel MoE FFN. x [N, D] tokens (sharded over the ep
+    axis by the shard_map in_specs); gate_w [D, E] replicated; expert
+    weights w1 [E, D, F], b1 [E, F], w2 [E, F, D], b2 [E, D] sharded
+    over ep on their leading E axis. Returns ([N, D], aux_loss).
+
+    Per shard: route local tokens to ALL experts into capacity
+    buckets, all_to_all the buckets so each device holds ITS experts'
+    tokens from every shard, run the batched expert FFN, all_to_all
+    back, combine. The aux loss is the GLOBAL Switch loss (fractions
+    pmean'd across shards before the product).
+
+    Capacity semantics under pressure: buckets are sized and filled
+    PER TOKEN SHARD (C = ceil(N/ep * cf / E), the GShard/Switch
+    static-shape discipline — dropping is a local decision, no global
+    sort). A skewed shard can therefore drop tokens the single-device
+    reference (global buckets) would keep: with no drops the two
+    paths are exactly equal (the tested contract); under capacity
+    pressure they legitimately differ. Size capacity_factor for the
+    no-drop regime or accept shard-local dropping, as on any ep
+    pod."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = mesh or mesh_lib.current_mesh()
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] == 1:
+        return moe_ffn_reference(x, gate_w, w1, b1, w2, b2,
+                                 capacity_factor=capacity_factor)
+
+    ep = mesh.shape[axis]
+    E = w1.shape[0]
+    if E % ep != 0:
+        raise ValueError("num experts %d not divisible by ep=%d"
+                         % (E, ep))
+    if x.shape[0] % ep != 0:
+        raise ValueError("token count %d not divisible by ep=%d"
+                         % (x.shape[0], ep))
+    n_loc = x.shape[0] // ep
+    capacity = int(-(-n_loc * capacity_factor // E))
+
+    def body(x_l, gate_w, w1_l, b1_l, w2_l, b2_l):
+        dispatch, prob, idx, pos, keep, f, p = _route_top1(
+            x_l, gate_w, E, capacity)                 # [E, C, D]
+        # [E, C, D] -> [E/ep, ep*C, D]: each device receives its
+        # experts' buckets from every token shard
+        h = lax.all_to_all(dispatch, axis, split_axis=0,
+                           concat_axis=1, tiled=True)
+        out = _expert_ffn(w1_l, b1_l, w2_l, b2_l, h)
+        # route the processed buckets back to their token shards
+        back = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
+                              tiled=True)             # [E, C, D]
+        y = _combine(back, prob, idx, pos, keep, capacity)
+        # GLOBAL Switch loss: average the fractions across shards
+        # first, then take the product (shards are equal-sized, so
+        # pmean(f) is the global routed fraction exactly)
+        aux = E * jnp.sum(lax.pmean(f, axis) * lax.pmean(p, axis))
+        return y, aux
+
+    tok = PartitionSpec(axis)
+    exp = PartitionSpec(axis)
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(tok, PartitionSpec(), exp, exp, exp, exp),
+        out_specs=(tok, PartitionSpec()),
+        check_rep=False)
+    return f(x, gate_w, w1, b1, w2, b2)

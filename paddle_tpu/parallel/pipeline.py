@@ -97,15 +97,27 @@ def gpipe_apply(stage_fn, stacked_params, x, *, mesh=None, axis="pp",
                          % (B, M))
     if mesh is None or axis not in mesh.axis_names \
             or mesh.shape[axis] == 1:
-        # no pipeline axis in scope: sequential reference semantics
-        y = x
-        for s in range(n_params):
-            params_s = jax.tree_util.tree_map(lambda a: a[s],
-                                              stacked_params)
-            y = stage_fn(params_s, y)
-        return y
+        # no pipeline axis in scope: sequential reference semantics —
+        # over the SAME microbatches the pipelined path uses, so a
+        # stage_fn with cross-row coupling (batch statistics) cannot
+        # silently diverge between one device and a pod
+        xm = x.reshape((M, B // M) + x.shape[1:])
+        outs = []
+        for m in range(M):
+            y = xm[m]
+            for s in range(n_params):
+                params_s = jax.tree_util.tree_map(lambda a: a[s],
+                                                  stacked_params)
+                y = stage_fn(params_s, y)
+            outs.append(y)
+        return jnp.concatenate(outs, axis=0)
 
     P = mesh.shape[axis]
+    if n_params != P:
+        raise ValueError(
+            "stacked_params has %d stages but the %r mesh axis has "
+            "%d devices — one stage per device (a [k*P] stack would "
+            "silently drop stages)" % (n_params, axis, P))
     x_micro = x.reshape((M, B // M) + x.shape[1:])
 
     # params: leading [P] axis sharded over pp; activations replicated

@@ -91,3 +91,17 @@ def test_gpipe_rejects_indivisible_batch(rng, stages):
         gpipe_apply(_stage_fn, stages, x, mesh=None, n_micro=4)
     with pytest.raises(ValueError, match=">= 1"):
         gpipe_apply(_stage_fn, stages, x, mesh=None, n_micro=0)
+
+
+def test_gpipe_rejects_stage_count_mismatch(rng, stages):
+    """A [2P]-stage stack on a P-device pp axis must fail loudly —
+    the shard body would otherwise silently run every other stage."""
+    import jax as _jax
+    double = _jax.tree_util.tree_map(
+        lambda a: jnp.concatenate([a, a]), stages)
+    x = jnp.asarray(rng.randn(8, D).astype(np.float32))
+    with pytest.raises(ValueError, match="one stage per device"):
+        gpipe_apply(_stage_fn, double, x, mesh=_pp_mesh(), n_micro=4)
+    # the no-mesh fallback legitimately runs all 8 stages
+    got = gpipe_apply(_stage_fn, double, x, mesh=None, n_micro=4)
+    assert got.shape == x.shape
