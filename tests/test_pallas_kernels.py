@@ -81,6 +81,31 @@ def test_sdpa_causal_matches_reference():
          {"scale": Dh ** -0.5, "causal": True})
 
 
+def test_sdpa_flash_blocked_multi_q_causal():
+    """Multiple q-blocks AND k-blocks with causal masking — the
+    longseq bench geometry (S=1024): exercises the dk/dv kernel's
+    q-block accumulation and the causal block-skip logic, fwd +
+    grads."""
+    r = np.random.RandomState(9)
+    B, H, S, Dh = 1, 2, 1024, 32
+    q = jnp.asarray(r.randn(B, H, S, Dh).astype(np.float32))
+    k = jnp.asarray(r.randn(B, H, S, Dh).astype(np.float32))
+    v = jnp.asarray(r.randn(B, H, S, Dh).astype(np.float32))
+    opdef = ops.get("scaled_dot_product_attention")
+    _cmp("scaled_dot_product_attention", (q, k, v, None),
+         {"scale": Dh ** -0.5, "causal": True}, rtol=5e-5, atol=1e-5)
+
+    def loss(fn):
+        return lambda q_, k_, v_: jnp.sum(jnp.square(
+            fn(q_, k_, v_, None, scale=Dh ** -0.5, causal=True)))
+
+    gr = jax.grad(loss(opdef.fn), (0, 1, 2))(q, k, v)
+    gp = jax.grad(loss(opdef.variants["pallas"]), (0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_sdpa_flash_blocked_shapes():
     """Shapes that force multiple k-blocks through the online-softmax
     path (Sk > blk_k), fwd + grads — the flash recurrence itself."""
