@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from ..ops.registry import register
 from . import mesh as mesh_lib
 
 
@@ -152,3 +153,14 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
         out_specs=(tok, PartitionSpec()),
         check_rep=False)
     return f(x, gate_w, w1, b1, w2, b2)
+
+
+@register("moe_ffn", ["X", "GateW", "W1", "B1", "W2", "B2"],
+          ["Out", "AuxLoss"])
+def moe_ffn_op(x, gate_w, w1, b1, w2, b2, *, capacity_factor=1.25,
+               axis="ep"):
+    """Static-graph op twin (the ring_attention_op pattern): uses the
+    ambient mesh set by CompiledProgram.run / mesh_guard; without an
+    ep axis in scope it falls back to the single-device reference."""
+    return moe_ffn(x, gate_w, w1, b1, w2, b2, axis=axis,
+                   capacity_factor=capacity_factor)

@@ -1493,6 +1493,43 @@ spec("ulysses_attention",
      {"scale": 0.5}, ref=_attn_ref, max_rel=0.01)
 
 
+def _moe_ref(ins):
+    """Per-token oracle of the Switch top-1 routing (no-drop cf)."""
+    x, gw = ins["X"], ins["GateW"]
+    w1, b1, w2, b2 = ins["W1"], ins["B1"], ins["W2"], ins["B2"]
+    E = w1.shape[0]
+    z = x @ gw
+    p = np.exp(z - z.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    idx = p.argmax(-1)
+    out = np.stack([
+        (np.maximum(x[i] @ w1[e] + b1[e], 0.0) @ w2[e] + b2[e])
+        * p[i, e]
+        for i, e in enumerate(idx)])
+    f = np.eye(E)[idx].mean(0)
+    aux = E * float((f * p.mean(0)).sum())
+    return [out.astype(np.float32), np.float32(aux)]
+
+
+# continuous inputs: sgn()'s +-1 grid creates router-logit TIES whose
+# argmax flips under finite-difference perturbation (discrete routing
+# is non-differentiable at ties; away from them the grads are exact)
+spec("moe_ffn",
+     {"X": u((6, 4), 922, lo=-0.9, hi=0.9),
+      "GateW": u((4, 2), 923, lo=-1.0, hi=1.0),
+      "W1": u((2, 4, 8), 924, lo=-0.3, hi=0.3),
+      "B1": u((2, 8), 925, lo=-0.1, hi=0.1),
+      "W2": u((2, 8, 4), 926, lo=-0.3, hi=0.3),
+      "B2": u((2, 4), 927, lo=-0.1, hi=0.1)},
+     {"capacity_factor": 2.0}, ref=_moe_ref, n_outputs=2,
+     # FD grads only on the post-routing smooth slots: X/GateW/W1
+     # cross the argmax routing boundary and the relu kink under
+     # perturbation (discrete routing is non-differentiable at
+     # flips); full analytic-grad equality sharded-vs-reference is
+     # tests/test_moe.py::test_sharded_gradients_match
+     grad=["W2", "B2"], max_rel=0.02)
+
+
 def _seq_expand_ref(ins):
     x, y, ln = ins["X"], ins["Y"], ins["SeqLenY"]
     out = np.repeat(x[:, None], y.shape[1], axis=1).astype(np.float32)
