@@ -1,0 +1,83 @@
+"""The parallelism matrix in one script: sequence parallelism (ring /
+Ulysses attention), pipeline parallelism (GPipe), and expert
+parallelism (Switch MoE) — each on its own mesh axis, each checked
+against its single-device reference. The dp/tp axes are shown by
+examples/train_transformer.py; together these cover dp x tp x sp x
+pp x ep.
+
+Run on 8 virtual CPU devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  JAX_PLATFORMS=cpu python examples/parallelism_matrix.py
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import (gpipe_apply, make_mesh, moe_ffn,
+                                 moe_ffn_reference, ring_attention_fn,
+                                 stack_stage_params,
+                                 ulysses_attention_fn)
+from paddle_tpu.parallel.ulysses import _full_attention
+
+
+def main():
+    rs = np.random.RandomState(0)
+    n = min(len(jax.devices()), 8)
+
+    # --- sequence parallelism: ring + Ulysses over sp ------------------
+    sp = 4 if n >= 4 else n
+    mesh = make_mesh({"sp": sp}, jax.devices()[:sp])
+    B, H, S, Dh = 2, 8, 256, 32
+    q, k, v = (jnp.asarray(rs.randn(B, H, S, Dh).astype(np.float32))
+               * 0.3 for _ in range(3))
+    want = _full_attention(q, k, v, 0.5, True)
+    for name, fn in (("ring", ring_attention_fn),
+                     ("ulysses", ulysses_attention_fn)):
+        got = fn(q, k, v, mesh=mesh, scale=0.5, causal=True)
+        err = float(jnp.max(jnp.abs(got - want)))
+        print("sp/%s attention (sp=%d, S=%d): max|err|=%.2e"
+              % (name, sp, S, err))
+        assert err < 1e-4
+
+    # --- pipeline parallelism: GPipe over pp ---------------------------
+    pp = 4 if n >= 4 else n
+    mesh = make_mesh({"pp": pp}, jax.devices()[:pp])
+    D = 32
+    stages = stack_stage_params(
+        [{"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.4),
+          "b": jnp.zeros((D,), jnp.float32)} for _ in range(pp)])
+    x = jnp.asarray(rs.randn(16, D).astype(np.float32))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    got = gpipe_apply(stage, stages, x, mesh=mesh, n_micro=8)
+    want = gpipe_apply(stage, stages, x, mesh=None, n_micro=8)
+    err = float(jnp.max(jnp.abs(got - want)))
+    print("pp/gpipe (pp=%d, micro=8): max|err|=%.2e" % (pp, err))
+    assert err < 1e-5
+
+    # --- expert parallelism: Switch MoE over ep ------------------------
+    ep = 4 if n >= 4 else n
+    mesh = make_mesh({"ep": ep}, jax.devices()[:ep])
+    E, F, N = 8, 64, 64
+    wt = dict(
+        gate_w=jnp.asarray(rs.randn(D, E).astype(np.float32)),
+        w1=jnp.asarray(rs.randn(E, D, F).astype(np.float32) * 0.2),
+        b1=jnp.zeros((E, F), jnp.float32),
+        w2=jnp.asarray(rs.randn(E, F, D).astype(np.float32) * 0.2),
+        b2=jnp.zeros((E, D), jnp.float32))
+    toks = jnp.asarray(rs.randn(N, D).astype(np.float32))
+    got, aux = moe_ffn(toks, mesh=mesh, capacity_factor=float(E), **wt)
+    want, aux_ref = moe_ffn_reference(toks, capacity_factor=float(E),
+                                      **wt)
+    err = float(jnp.max(jnp.abs(got - want)))
+    print("ep/moe (ep=%d, E=%d): max|err|=%.2e aux=%.4f" %
+          (ep, E, err, float(aux)))
+    assert err < 1e-5 and abs(float(aux) - float(aux_ref)) < 1e-5
+    print("parallelism matrix OK")
+
+
+if __name__ == "__main__":
+    main()

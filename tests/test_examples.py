@@ -52,3 +52,11 @@ def test_fleet_ps_cluster():
     r = _run("fleet_ps_cluster.py")
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
     assert "trainers done rc=0" in r.stdout
+
+
+def test_parallelism_matrix():
+    r = _run("parallelism_matrix.py", [],
+             env={"XLA_FLAGS":
+                  "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "parallelism matrix OK" in r.stdout
