@@ -178,3 +178,18 @@ def test_ring_flash_gradients_match_s1024(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-4, rtol=5e-4,
                                    err_msg="d%s" % name)
+
+
+def test_ring_flash_bfloat16(rng):
+    """bf16 operands through the flash hop kernels (the pod dtype):
+    f32 score/combine internals keep the error at bf16 resolution."""
+    q, k, v = (a.astype(jnp.bfloat16) for a in _long_qkv(rng))
+    mesh = _sp_mesh(8)
+    want = _full_attention(q.astype(jnp.float32),
+                           k.astype(jnp.float32),
+                           v.astype(jnp.float32), 0.5, True)
+    got = ring_attention(q, k, v, mesh=mesh, scale=0.5, causal=True,
+                         use_flash=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), atol=2e-2, rtol=2e-2)
