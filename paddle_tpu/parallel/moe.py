@@ -8,13 +8,13 @@ data-dependent shapes under jit), expert weights sharded over ``ep``,
 tokens data-sharded over the SAME axis, and ONE ``lax.all_to_all``
 each way moving only the capacity buckets across ICI.
 
-Top-1 (Switch) routing with capacity dropping:
-  gate probs -> argmax expert -> position-in-expert by cumsum ->
-  tokens beyond capacity C = ceil(n * capacity_factor / E) are
-  DROPPED (output zero for their expert contribution) — the standard
-  static-shape trade; callers size capacity_factor accordingly.
-Router z-loss / aux balancing losses are returned so training can
-regularize routing (Switch Transformer recipe).
+Routing (``top_k``): 1 = Switch (default), 2 = GShard top-2 with
+renormalized gates, secondaries queueing behind all primaries of the
+same expert. Capacity C = ceil(n * top_k * capacity_factor / E);
+tokens beyond it are DROPPED (zero contribution) — the standard
+static-shape trade; callers size capacity_factor accordingly. The
+aux balancing loss is returned so training can regularize routing
+(Switch Transformer recipe).
 """
 
 from __future__ import annotations
@@ -26,6 +26,54 @@ from jax.sharding import PartitionSpec
 
 from ..ops.registry import register
 from . import mesh as mesh_lib
+
+
+def _route_top2(x, gate_w, n_experts, capacity):
+    """GShard top-2 routing (shared by the sharded and reference
+    paths). Gate weights are renormalized over the two chosen experts;
+    secondary tokens take capacity slots AFTER all primary tokens of
+    the same expert (the GShard ordering), so under pressure the
+    second choice drops first. Returns (dispatch [E, C, D],
+    combines [2] of (prob, idx, pos, keep), f [E], p [E])."""
+    n, d = x.shape
+    logits = x @ gate_w
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)
+    p1 = jnp.max(probs, axis=-1)
+    masked = probs - jax.nn.one_hot(idx1, n_experts,
+                                    dtype=probs.dtype) * probs
+    idx2 = jnp.argmax(masked, axis=-1)
+    p2 = jnp.max(masked, axis=-1)
+    denom = jnp.maximum(p1 + p2, 1e-9)
+    g1, g2 = p1 / denom, p2 / denom
+    oh1 = jax.nn.one_hot(idx1, n_experts, dtype=jnp.float32)
+    oh2 = jax.nn.one_hot(idx2, n_experts, dtype=jnp.float32)
+    pos1 = ((jnp.cumsum(oh1, axis=0) * oh1).sum(-1) - 1.0)
+    # secondary tokens queue behind ALL primary tokens of the expert
+    pos2 = ((jnp.cumsum(oh2, axis=0) * oh2).sum(-1) - 1.0
+            + oh1.sum(0)[idx2])
+    combines = []
+    dispatch = jnp.zeros((n_experts, capacity, d), x.dtype)
+    for g, idx, posf in ((g1, idx1, pos1), (g2, idx2, pos2)):
+        pos = posf.astype(jnp.int32)
+        keep = (pos < capacity) & (pos >= 0)
+        contrib = jnp.where(keep[:, None], x, 0.0)
+        dispatch = dispatch.at[
+            idx, jnp.clip(pos, 0, capacity - 1)].add(contrib)
+        combines.append((g, idx, pos, keep))
+    f = oh1.mean(0)
+    p = probs.mean(0)
+    return dispatch, combines, f, p
+
+
+def _combine2(expert_out, combines, capacity):
+    out = 0.0
+    for g, idx, pos, keep in combines:
+        out = out + jnp.where(
+            keep[:, None],
+            expert_out[idx, jnp.clip(pos, 0, capacity - 1)]
+            * g[:, None].astype(expert_out.dtype), 0.0)
+    return out
 
 
 def _route_top1(x, gate_w, n_experts, capacity):
@@ -64,21 +112,25 @@ def _expert_ffn(w1, b1, w2, b2, h):
 
 
 def _combine(expert_out, prob, idx, pos, keep, capacity):
-    """Gather each token's expert output and scale by its gate
-    probability; dropped tokens contribute zero."""
-    safe_pos = jnp.clip(pos, 0, capacity - 1)
-    y = expert_out[idx, safe_pos]                     # [n, D]
-    return jnp.where(keep[:, None],
-                     y * prob[:, None].astype(y.dtype), 0.0)
+    """Top-1 combine: the single-choice case of _combine2."""
+    return _combine2(expert_out, [(prob, idx, pos, keep)], capacity)
 
 
 def moe_ffn_reference(x, gate_w, w1, b1, w2, b2, *,
-                      capacity_factor=1.25):
+                      capacity_factor=1.25, top_k=1):
     """Single-device reference semantics (the equality oracle): same
     routing, all experts local."""
+    if top_k not in (1, 2):
+        raise ValueError("top_k must be 1 (Switch) or 2 (GShard), "
+                         "got %r" % (top_k,))
     n = x.shape[0]
     E = w1.shape[0]
-    capacity = int(-(-n * capacity_factor // E))
+    capacity = int(-(-n * top_k * capacity_factor // E))
+    if top_k == 2:
+        dispatch, combines, f, p = _route_top2(x, gate_w, E, capacity)
+        aux = E * jnp.sum(f * p)
+        expert_out = _expert_ffn(w1, b1, w2, b2, dispatch)
+        return _combine2(expert_out, combines, capacity), aux
     dispatch, prob, idx, pos, keep, f, p = _route_top1(
         x, gate_w, E, capacity)
     aux = E * jnp.sum(f * p)
@@ -87,7 +139,7 @@ def moe_ffn_reference(x, gate_w, w1, b1, w2, b2, *,
 
 
 def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
-            capacity_factor=1.25):
+            capacity_factor=1.25, top_k=1):
     """Expert-parallel MoE FFN. x [N, D] tokens (sharded over the ep
     axis by the shard_map in_specs); gate_w [D, E] replicated; expert
     weights w1 [E, D, F], b1 [E, F], w2 [E, F, D], b2 [E, D] sharded
@@ -110,11 +162,15 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
     pod."""
     from jax.experimental.shard_map import shard_map
 
+    if top_k not in (1, 2):
+        raise ValueError("top_k must be 1 (Switch) or 2 (GShard), "
+                         "got %r" % (top_k,))
     mesh = mesh or mesh_lib.current_mesh()
     if mesh is None or axis not in mesh.axis_names \
             or mesh.shape[axis] == 1:
         return moe_ffn_reference(x, gate_w, w1, b1, w2, b2,
-                                 capacity_factor=capacity_factor)
+                                 capacity_factor=capacity_factor,
+                                 top_k=top_k)
 
     ep = mesh.shape[axis]
     E = w1.shape[0]
@@ -125,11 +181,15 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
         raise ValueError("token count %d not divisible by ep=%d"
                          % (x.shape[0], ep))
     n_loc = x.shape[0] // ep
-    capacity = int(-(-n_loc * capacity_factor // E))
+    capacity = int(-(-n_loc * top_k * capacity_factor // E))
 
     def body(x_l, gate_w, w1_l, b1_l, w2_l, b2_l):
-        dispatch, prob, idx, pos, keep, f, p = _route_top1(
-            x_l, gate_w, E, capacity)                 # [E, C, D]
+        if top_k == 2:
+            dispatch, combines, f, p = _route_top2(
+                x_l, gate_w, E, capacity)             # [E, C, D]
+        else:
+            dispatch, prob, idx, pos, keep, f, p = _route_top1(
+                x_l, gate_w, E, capacity)             # [E, C, D]
         # [E, C, D] -> [E/ep, ep*C, D]: each device receives its
         # experts' buckets from every token shard
         h = lax.all_to_all(dispatch, axis, split_axis=0,
@@ -138,7 +198,10 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
         # route the processed buckets back to their token shards
         back = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
                               tiled=True)             # [E, C, D]
-        y = _combine(back, prob, idx, pos, keep, capacity)
+        if top_k == 2:
+            y = _combine2(back, combines, capacity)
+        else:
+            y = _combine(back, prob, idx, pos, keep, capacity)
         # GLOBAL Switch loss: average the fractions across shards
         # first, then take the product (shards are equal-sized, so
         # pmean(f) is the global routed fraction exactly)
@@ -158,9 +221,9 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
 @register("moe_ffn", ["X", "GateW", "W1", "B1", "W2", "B2"],
           ["Out", "AuxLoss"])
 def moe_ffn_op(x, gate_w, w1, b1, w2, b2, *, capacity_factor=1.25,
-               axis="ep"):
+               axis="ep", top_k=1):
     """Static-graph op twin (the ring_attention_op pattern): uses the
     ambient mesh set by CompiledProgram.run / mesh_guard; without an
     ep axis in scope it falls back to the single-device reference."""
     return moe_ffn(x, gate_w, w1, b1, w2, b2, axis=axis,
-                   capacity_factor=capacity_factor)
+                   capacity_factor=capacity_factor, top_k=top_k)

@@ -107,3 +107,66 @@ def test_rejects_indivisible(rng, weights):
     x = jnp.asarray(rng.randn(10, D).astype(np.float32))
     with pytest.raises(ValueError, match="divisible"):
         moe_ffn(x, mesh=mesh, **weights)
+
+
+# --- top-2 (GShard) routing ------------------------------------------------
+
+def _oracle_top2(x, wt):
+    probs = jax.nn.softmax((x @ wt["gate_w"]).astype(jnp.float32), -1)
+    i1 = jnp.argmax(probs, -1)
+    masked = probs - jax.nn.one_hot(i1, E) * probs
+    i2 = jnp.argmax(masked, -1)
+    out = []
+    for i in range(x.shape[0]):
+        e1, e2 = int(i1[i]), int(i2[i])
+        p1, p2 = float(probs[i, e1]), float(masked[i, e2])
+        g1, g2 = p1 / (p1 + p2), p2 / (p1 + p2)
+        y = 0.0
+        for e, g in ((e1, g1), (e2, g2)):
+            h = jax.nn.relu(x[i] @ wt["w1"][e] + wt["b1"][e])
+            y = y + (h @ wt["w2"][e] + wt["b2"][e]) * g
+        out.append(y)
+    return jnp.stack(out)
+
+
+def test_top2_reference_matches_oracle(rng, weights):
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    want = _oracle_top2(x, weights)
+    got, _ = moe_ffn_reference(x, capacity_factor=float(E), top_k=2,
+                               **weights)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_top2_sharded_matches_reference(rng, weights):
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    mesh = _ep_mesh()
+    want, aux_ref = moe_ffn_reference(x, capacity_factor=float(E),
+                                      top_k=2, **weights)
+    got, aux = moe_ffn(x, mesh=mesh, capacity_factor=float(E),
+                       top_k=2, **weights)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_top2_sharded_gradients_match(rng, weights):
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    mesh = _ep_mesh()
+
+    def loss(wt, fn, kw):
+        y, aux = fn(x, capacity_factor=float(E), top_k=2, **kw, **wt)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    gw = jax.grad(lambda w: loss(w, moe_ffn_reference, {}))(weights)
+    gg = jax.grad(lambda w: loss(w, moe_ffn, {"mesh": mesh}))(weights)
+    for k in weights:
+        np.testing.assert_allclose(np.asarray(gg[k]),
+                                   np.asarray(gw[k]), atol=1e-4,
+                                   rtol=1e-4, err_msg=k)
+
+
+def test_top_k_validated(rng, weights):
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    with pytest.raises(ValueError, match="top_k"):
+        moe_ffn(x, mesh=_ep_mesh(), top_k=3, **weights)
