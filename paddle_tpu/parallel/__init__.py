@@ -27,3 +27,5 @@ from . import pipeline  # noqa: F401
 from .pipeline import gpipe_apply, stack_stage_params  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import moe_ffn, moe_ffn_reference  # noqa: F401
+from . import zigzag  # noqa: F401
+from .zigzag import zigzag_attention  # noqa: F401

@@ -28,80 +28,45 @@ from ..ops.registry import register
 from . import mesh as mesh_lib
 
 
-def _route_top2(x, gate_w, n_experts, capacity):
-    """GShard top-2 routing (shared by the sharded and reference
-    paths). Gate weights are renormalized over the two chosen experts;
-    secondary tokens take capacity slots AFTER all primary tokens of
-    the same expert (the GShard ordering), so under pressure the
-    second choice drops first. Returns (dispatch [E, C, D],
-    combines [2] of (prob, idx, pos, keep), f [E], p [E])."""
+def _route(x, gate_w, n_experts, capacity, top_k):
+    """Shared routing math, identical on the sharded and reference
+    paths (determinism is the equality test's foundation). top_k=1 is
+    Switch (raw top-1 gate prob); top_k=2 is GShard (gates
+    renormalized over the two chosen experts, secondary tokens
+    queueing behind ALL primary tokens of the same expert so the
+    second choice drops first under pressure). Returns
+    (dispatch [E, C, D], combines: list of (gate, idx, pos, keep),
+    f [E] primary routed fraction, p [E] mean router prob). The aux
+    loss is E * sum(f * p) — composed by the CALLER so the sharded
+    path can pmean f and p across shards BEFORE the product."""
     n, d = x.shape
     logits = x @ gate_w
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     idx1 = jnp.argmax(probs, axis=-1)
     p1 = jnp.max(probs, axis=-1)
-    masked = probs - jax.nn.one_hot(idx1, n_experts,
-                                    dtype=probs.dtype) * probs
-    idx2 = jnp.argmax(masked, axis=-1)
-    p2 = jnp.max(masked, axis=-1)
-    denom = jnp.maximum(p1 + p2, 1e-9)
-    g1, g2 = p1 / denom, p2 / denom
     oh1 = jax.nn.one_hot(idx1, n_experts, dtype=jnp.float32)
-    oh2 = jax.nn.one_hot(idx2, n_experts, dtype=jnp.float32)
-    pos1 = ((jnp.cumsum(oh1, axis=0) * oh1).sum(-1) - 1.0)
-    # secondary tokens queue behind ALL primary tokens of the expert
-    pos2 = ((jnp.cumsum(oh2, axis=0) * oh2).sum(-1) - 1.0
-            + oh1.sum(0)[idx2])
+    pos1 = (jnp.cumsum(oh1, axis=0) * oh1).sum(-1) - 1.0
+    if top_k == 2:
+        masked = probs - oh1 * probs
+        idx2 = jnp.argmax(masked, axis=-1)
+        p2 = jnp.max(masked, axis=-1)
+        oh2 = jax.nn.one_hot(idx2, n_experts, dtype=jnp.float32)
+        denom = jnp.maximum(p1 + p2, 1e-9)
+        pos2 = ((jnp.cumsum(oh2, axis=0) * oh2).sum(-1) - 1.0
+                + oh1.sum(0)[idx2])
+        choices = [(p1 / denom, idx1, pos1), (p2 / denom, idx2, pos2)]
+    else:
+        choices = [(p1, idx1, pos1)]
     combines = []
     dispatch = jnp.zeros((n_experts, capacity, d), x.dtype)
-    for g, idx, posf in ((g1, idx1, pos1), (g2, idx2, pos2)):
+    for g, idx, posf in choices:
         pos = posf.astype(jnp.int32)
         keep = (pos < capacity) & (pos >= 0)
         contrib = jnp.where(keep[:, None], x, 0.0)
         dispatch = dispatch.at[
             idx, jnp.clip(pos, 0, capacity - 1)].add(contrib)
         combines.append((g, idx, pos, keep))
-    f = oh1.mean(0)
-    p = probs.mean(0)
-    return dispatch, combines, f, p
-
-
-def _combine2(expert_out, combines, capacity):
-    out = 0.0
-    for g, idx, pos, keep in combines:
-        out = out + jnp.where(
-            keep[:, None],
-            expert_out[idx, jnp.clip(pos, 0, capacity - 1)]
-            * g[:, None].astype(expert_out.dtype), 0.0)
-    return out
-
-
-def _route_top1(x, gate_w, n_experts, capacity):
-    """Shared routing math (identical on the sharded and reference
-    paths — determinism is the equality test's foundation).
-    Returns (dispatch [E, C, D], combine_prob [n], idx [n], pos [n],
-    keep [n], f [E] routed fraction, p [E] mean router prob). The aux
-    loss is E * sum(f * p) — composed by the CALLER so the sharded
-    path can pmean f and p across shards BEFORE the product (the
-    global Switch loss; per-shard products averaged afterwards would
-    be a different, larger quantity)."""
-    n, d = x.shape
-    logits = x @ gate_w                               # [n, E]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    idx = jnp.argmax(probs, axis=-1)                  # [n]
-    prob = jnp.max(probs, axis=-1)                    # [n]
-    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)
-    # position of each token within its expert's capacity bucket
-    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1.0  # [n]
-    pos = pos.astype(jnp.int32)
-    keep = (pos < capacity) & (pos >= 0)
-    f = onehot.mean(0)                                # fraction routed
-    p = probs.mean(0)                                 # mean router prob
-    dispatch = jnp.zeros((n_experts, capacity, d), x.dtype)
-    contrib = jnp.where(keep[:, None], x, 0.0)
-    dispatch = dispatch.at[idx, jnp.clip(pos, 0, capacity - 1)].add(
-        contrib)
-    return dispatch, prob, idx, pos, keep, f, p
+    return dispatch, combines, oh1.mean(0), probs.mean(0)
 
 
 def _expert_ffn(w1, b1, w2, b2, h):
@@ -111,9 +76,16 @@ def _expert_ffn(w1, b1, w2, b2, h):
     return jnp.einsum("etf,efd->etd", y, w2) + b2[:, None, :]
 
 
-def _combine(expert_out, prob, idx, pos, keep, capacity):
-    """Top-1 combine: the single-choice case of _combine2."""
-    return _combine2(expert_out, [(prob, idx, pos, keep)], capacity)
+def _combine2(expert_out, combines, capacity):
+    """Gather each choice's expert output, scale by its gate, sum;
+    dropped tokens contribute zero."""
+    out = 0.0
+    for g, idx, pos, keep in combines:
+        out = out + jnp.where(
+            keep[:, None],
+            expert_out[idx, jnp.clip(pos, 0, capacity - 1)]
+            * g[:, None].astype(expert_out.dtype), 0.0)
+    return out
 
 
 def moe_ffn_reference(x, gate_w, w1, b1, w2, b2, *,
@@ -126,16 +98,10 @@ def moe_ffn_reference(x, gate_w, w1, b1, w2, b2, *,
     n = x.shape[0]
     E = w1.shape[0]
     capacity = int(-(-n * top_k * capacity_factor // E))
-    if top_k == 2:
-        dispatch, combines, f, p = _route_top2(x, gate_w, E, capacity)
-        aux = E * jnp.sum(f * p)
-        expert_out = _expert_ffn(w1, b1, w2, b2, dispatch)
-        return _combine2(expert_out, combines, capacity), aux
-    dispatch, prob, idx, pos, keep, f, p = _route_top1(
-        x, gate_w, E, capacity)
+    dispatch, combines, f, p = _route(x, gate_w, E, capacity, top_k)
     aux = E * jnp.sum(f * p)
     expert_out = _expert_ffn(w1, b1, w2, b2, dispatch)
-    return _combine(expert_out, prob, idx, pos, keep, capacity), aux
+    return _combine2(expert_out, combines, capacity), aux
 
 
 def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
@@ -184,12 +150,8 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
     capacity = int(-(-n_loc * top_k * capacity_factor // E))
 
     def body(x_l, gate_w, w1_l, b1_l, w2_l, b2_l):
-        if top_k == 2:
-            dispatch, combines, f, p = _route_top2(
-                x_l, gate_w, E, capacity)             # [E, C, D]
-        else:
-            dispatch, prob, idx, pos, keep, f, p = _route_top1(
-                x_l, gate_w, E, capacity)             # [E, C, D]
+        dispatch, combines, f, p = _route(
+            x_l, gate_w, E, capacity, top_k)          # [E, C, D]
         # [E, C, D] -> [E/ep, ep*C, D]: each device receives its
         # experts' buckets from every token shard
         h = lax.all_to_all(dispatch, axis, split_axis=0,
@@ -198,10 +160,7 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, mesh=None, axis="ep",
         # route the processed buckets back to their token shards
         back = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
                               tiled=True)             # [E, C, D]
-        if top_k == 2:
-            y = _combine2(back, combines, capacity)
-        else:
-            y = _combine(back, prob, idx, pos, keep, capacity)
+        y = _combine2(back, combines, capacity)
         # GLOBAL Switch loss: average the fractions across shards
         # first, then take the product (shards are equal-sized, so
         # pmean(f) is the global routed fraction exactly)

@@ -193,3 +193,42 @@ def test_ring_flash_bfloat16(rng):
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want), atol=2e-2, rtol=2e-2)
+
+
+# --- zigzag (load-balanced causal) ring ------------------------------------
+
+def test_zigzag_matches_full_attention(rng):
+    from paddle_tpu.parallel.zigzag import zigzag_attention
+    q, k, v = _long_qkv(rng, S=1024)
+    mesh = _sp_mesh(8)
+    want = _full_attention(q, k, v, 0.5, True)
+    got = zigzag_attention(q, k, v, mesh=mesh, scale=0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_zigzag_gradients_match(rng):
+    from paddle_tpu.parallel.zigzag import zigzag_attention
+    q, k, v = _long_qkv(rng, S=256)
+    mesh = _sp_mesh(4)
+
+    def loss_ref(a, b, c):
+        return jnp.sum(_full_attention(a, b, c, 0.5, True) ** 2)
+
+    def loss_z(a, b, c):
+        return jnp.sum(zigzag_attention(a, b, c, mesh=mesh,
+                                        scale=0.5) ** 2)
+
+    gw = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gg, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg="d%s" % name)
+
+
+def test_zigzag_rejects_bad_split(rng):
+    from paddle_tpu.parallel.zigzag import zigzag_attention
+    q, k, v = _long_qkv(rng, S=120)
+    with pytest.raises(ValueError, match="divide"):
+        zigzag_attention(q, k, v, mesh=_sp_mesh(8), scale=0.5)
