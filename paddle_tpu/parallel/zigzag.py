@@ -201,3 +201,14 @@ def zigzag_attention(q, k, v, mesh=None, axis="sp", scale=1.0):
                   out_specs=spec, check_rep=False)
     out = f(qz, kz, vz)
     return jnp.take(out, inv, axis=2)
+
+
+from ..ops.registry import register  # noqa: E402
+
+
+@register("zigzag_attention", ["Q", "K", "V"], ["Out"])
+def zigzag_attention_op(q, k, v, *, scale=1.0, axis="sp"):
+    """Static-graph op twin (the ring_attention_op pattern): uses the
+    ambient mesh; without an sp axis it falls back to full causal
+    attention."""
+    return zigzag_attention(q, k, v, axis=axis, scale=scale)

@@ -1493,6 +1493,22 @@ spec("ulysses_attention",
      {"scale": 0.5}, ref=_attn_ref, max_rel=0.01)
 
 
+def _causal_attn_ref(ins):
+    q, k, v = ins["Q"], ins["K"], ins["V"]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * 0.5
+    sq, sk = s.shape[-2], s.shape[-1]
+    mask = np.tril(np.ones((sq, sk), bool))
+    s = np.where(mask, s, -1e30)
+    return [np.einsum("bhqk,bhkd->bhqd", _np_softmax(s), v)]
+
+
+spec("zigzag_attention",
+     {"Q": sgn((1, 2, 4, 3), 928) * 0.4,
+      "K": sgn((1, 2, 4, 3), 929) * 0.4,
+      "V": sgn((1, 2, 4, 3), 930) * 0.4},
+     {"scale": 0.5}, ref=_causal_attn_ref, max_rel=0.01)
+
+
 def _moe_ref(ins):
     """Per-token oracle of the Switch top-1 routing (no-drop cf)."""
     x, gw = ins["X"], ins["GateW"]
