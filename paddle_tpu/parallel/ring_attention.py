@@ -86,6 +86,7 @@ def ring_attention_inner_flash(q, k, v, axis_name, n_blocks, scale,
 
 def _ring_flash_fwd(q, k, v, axis_name, n_blocks, scale, causal):
     from ..ops.pallas import ring as R
+    from .zigzag import online_merge_nk
 
     B, H, Sq, Dh = q.shape
     Sk = k.shape[2]
@@ -100,12 +101,7 @@ def _ring_flash_fwd(q, k, v, axis_name, n_blocks, scale, causal):
         src = (my - step) % n_blocks
         pv, mb, lb = R.fwd_block(q, k, v, my * Sq, src * Sk, scale,
                                  causal)
-        m_new = jnp.maximum(m, mb)
-        corr = jnp.exp(m - m_new)
-        corr_b = jnp.exp(mb - m_new)
-        l = l * corr + lb * corr_b
-        acc = acc * corr[..., None] + pv * corr_b[..., None]
-        m = m_new
+        acc, m, l = online_merge_nk(acc, m, l, pv, mb, lb)
         if step != n_blocks - 1:
             k = jax.lax.ppermute(k, axis_name, perm)
             v = jax.lax.ppermute(v, axis_name, perm)

@@ -35,6 +35,8 @@ as ring/Ulysses.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -75,6 +77,17 @@ def online_merge(acc, m, l, pv, mb, lb):
     c0 = jnp.exp(m - m_new)
     c1 = jnp.exp(mb - m_new)
     return acc * c0 + pv * c1, m_new, l * c0 + lb * c1
+
+
+def online_merge_nk(acc, m, l, pv, mb, lb):
+    """No-keepdims variant of online_merge (stats [..., Sq] — the
+    flash hop kernels' convention); the ONE copy both the flash ring
+    and flash zigzag bodies should use."""
+    m_new = jnp.maximum(m, mb)
+    c0 = jnp.exp(m - m_new)
+    c1 = jnp.exp(mb - m_new)
+    return (acc * c0[..., None] + pv * c1[..., None], m_new,
+            l * c0 + lb * c1)
 
 
 def _neutral(pv, m, l):
@@ -156,6 +169,172 @@ def zigzag_attention_inner(q, k, v, *, axis_name, n_blocks, scale=1.0):
     return jnp.concatenate(outs, axis=2).astype(q.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def zigzag_attention_inner_flash(q, k, v, axis_name, n_blocks, scale):
+    """Flash zigzag body: each chunk-pair runs the pallas hop kernels
+    (ops/pallas/ring.py) so scores stay in VMEM — the balanced
+    schedule AND the flash memory profile together."""
+    out, _ = _zz_flash_fwd(q, k, v, axis_name, n_blocks, scale)
+    return out
+
+
+def _zz_pair_neutral(B, H, c, Dh):
+    return (jnp.zeros((B, H, c, Dh), jnp.float32),
+            jnp.full((B, H, c), _NEG, jnp.float32),
+            jnp.zeros((B, H, c), jnp.float32))
+
+
+def _zz_flash_fwd(q, k, v, axis_name, n_blocks, scale):
+    from ..ops.pallas import ring as R
+
+    n = n_blocks
+    d = lax.axis_index(axis_name)
+    B, H, S2, Dh = q.shape
+    c = S2 // 2
+    qa, qb = q[:, :, :c], q[:, :, c:]
+    k0, v0 = k, v
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # running per-chunk stats (m, l WITHOUT keepdims — fwd_block's
+    # convention)
+    acc_a = jnp.zeros((B, H, c, Dh), jnp.float32)
+    m_a = jnp.full((B, H, c), _NEG, jnp.float32)
+    l_a = jnp.zeros((B, H, c), jnp.float32)
+    acc_b, m_b, l_b = acc_a, m_a, l_a
+
+    merge = online_merge_nk
+
+    for step in range(n):
+        s_idx = (d - step) % n
+        ka, kb = k[:, :, :c], k[:, :, c:]
+        va, vb = v[:, :, :c], v[:, :, c:]
+        off_qa, off_qb = d * c, (2 * n - 1 - d) * c
+        off_ka, off_kb = s_idx * c, (2 * n - 1 - s_idx) * c
+
+        # always-live full pair (q_b, k_a)
+        pv, mb_, lb_ = R.fwd_block(qb, ka, va, off_qb, off_ka, scale,
+                                   False)
+        acc_b, m_b, l_b = merge(acc_b, m_b, l_b, pv, mb_, lb_)
+
+        def qa_ka_full(_):
+            pv, mm, ll = R.fwd_block(qa, ka, va, off_qa, off_ka,
+                                     scale, False)
+            return (pv, mm, ll) + _zz_pair_neutral(B, H, c, Dh)
+
+        def qb_kb_full(_):
+            pv, mm, ll = R.fwd_block(qb, kb, vb, off_qb, off_kb,
+                                     scale, False)
+            return _zz_pair_neutral(B, H, c, Dh) + (pv, mm, ll)
+
+        def both_diag(_):
+            pva, ma, la = R.fwd_block(qa, ka, va, off_qa, off_ka,
+                                      scale, True)
+            pvb, mb2, lb2 = R.fwd_block(qb, kb, vb, off_qb, off_kb,
+                                        scale, True)
+            return (pva, ma, la, pvb, mb2, lb2)
+
+        branch = jnp.sign(d - s_idx) + 1
+        pva, ma, la, pvb, mb2, lb2 = lax.switch(
+            branch, [qb_kb_full, both_diag, qa_ka_full], None)
+        acc_a, m_a, l_a = merge(acc_a, m_a, l_a, pva, ma, la)
+        acc_b, m_b, l_b = merge(acc_b, m_b, l_b, pvb, mb2, lb2)
+
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+
+    l_a_s = jnp.maximum(l_a, 1e-20)
+    l_b_s = jnp.maximum(l_b, 1e-20)
+    out = jnp.concatenate(
+        [acc_a / l_a_s[..., None], acc_b / l_b_s[..., None]],
+        axis=2).astype(q.dtype)
+    lse = jnp.concatenate([m_a + jnp.log(l_a_s),
+                           m_b + jnp.log(l_b_s)], axis=2)
+    return out, (q, k0, v0, out, lse)
+
+
+def _zz_flash_bwd(axis_name, n_blocks, scale, res, g):
+    from ..ops.pallas import ring as R
+
+    q, k, v, out, lse = res
+    n = n_blocks
+    d = lax.axis_index(axis_name)
+    B, H, S2, Dh = q.shape
+    c = S2 // 2
+    qa, qb = q[:, :, :c], q[:, :, c:]
+    ga, gb = g[:, :, :c], g[:, :, c:]
+    lse_a, lse_b = lse[:, :, :c], lse[:, :, c:]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    del_a, del_b = delta[:, :, :c], delta[:, :, c:]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    dqa = jnp.zeros((B, H, c, Dh), jnp.float32)
+    dqb = jnp.zeros((B, H, c, Dh), jnp.float32)
+    dk_acc = jnp.zeros_like(k, dtype=jnp.float32)
+    dv_acc = jnp.zeros_like(v, dtype=jnp.float32)
+
+    zero_q = jnp.zeros((B, H, c, Dh), jnp.float32)
+    zero_k = jnp.zeros((B, H, c, Dh), jnp.float32)
+
+    for step in range(n):
+        s_idx = (d - step) % n
+        ka, kb = k[:, :, :c], k[:, :, c:]
+        va, vb = v[:, :, :c], v[:, :, c:]
+        off_qa, off_qb = d * c, (2 * n - 1 - d) * c
+        off_ka, off_kb = s_idx * c, (2 * n - 1 - s_idx) * c
+
+        # always-live pair (q_b, k_a)
+        dq_b1, dk_a1, dv_a1 = R.bwd_block(
+            qb, ka, va, gb, lse_b, del_b, off_qb, off_ka, scale,
+            False)
+
+        def qa_ka_full(_):
+            dq, dk, dv = R.bwd_block(qa, ka, va, ga, lse_a, del_a,
+                                     off_qa, off_ka, scale, False)
+            return (dq, zero_q, dk, zero_k, dv, zero_k)
+
+        def qb_kb_full(_):
+            dq, dk, dv = R.bwd_block(qb, kb, vb, gb, lse_b, del_b,
+                                     off_qb, off_kb, scale, False)
+            return (zero_q, dq, zero_k, dk, zero_k, dv)
+
+        def both_diag(_):
+            dqa_, dka_, dva_ = R.bwd_block(
+                qa, ka, va, ga, lse_a, del_a, off_qa, off_ka, scale,
+                True)
+            dqb_, dkb_, dvb_ = R.bwd_block(
+                qb, kb, vb, gb, lse_b, del_b, off_qb, off_kb, scale,
+                True)
+            return (dqa_, dqb_, dka_, dkb_, dva_, dvb_)
+
+        branch = jnp.sign(d - s_idx) + 1
+        dq_a2, dq_b2, dk_a2, dk_b2, dv_a2, dv_b2 = lax.switch(
+            branch, [qb_kb_full, both_diag, qa_ka_full], None)
+
+        dqa = dqa + dq_a2
+        dqb = dqb + dq_b1 + dq_b2
+        dk_hop = jnp.concatenate([dk_a1 + dk_a2, dk_b2], axis=2)
+        dv_hop = jnp.concatenate([dv_a1 + dv_a2, dv_b2], axis=2)
+        dk_acc = dk_acc + dk_hop
+        dv_acc = dv_acc + dv_hop
+
+        # k/v are not read after the last hop, but the accumulators
+        # need every rotation to land home after n permutes
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+
+    dq = jnp.concatenate([dqa, dqb], axis=2)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+zigzag_attention_inner_flash.defvjp(_zz_flash_fwd, _zz_flash_bwd)
+
+
 def _zigzag_perm(S, n):
     """Global position permutation: device-major concat of each
     device's (d, 2n-1-d) chunks. Returns (perm, inv) index arrays."""
@@ -171,12 +350,17 @@ def _zigzag_perm(S, n):
     return perm, inv
 
 
-def zigzag_attention(q, k, v, mesh=None, axis="sp", scale=1.0):
+def zigzag_attention(q, k, v, mesh=None, axis="sp", scale=1.0,
+                     use_flash=None):
     """Global-view causal attention in the zigzag schedule: q,k,v
     [B, H, S, Dh] in NATURAL sequence order; the permutation in/out is
-    internal. S must divide by 2*sp."""
+    internal. S must divide by 2*sp. use_flash: None = auto (pallas
+    chunk-pair kernels when the geometry fits and FLAGS.ring_flash is
+    on); False forces the jnp body."""
     from jax.experimental.shard_map import shard_map
 
+    from ..core.flags import FLAGS
+    from ..ops.pallas import ring as R
     from .ulysses import _full_attention
 
     mesh = mesh or mesh_lib.current_mesh()
@@ -184,18 +368,28 @@ def zigzag_attention(q, k, v, mesh=None, axis="sp", scale=1.0):
             or mesh.shape[axis] == 1:
         return _full_attention(q, k, v, scale, True)
     n = mesh.shape[axis]
-    S = q.shape[2]
+    B, H, S, Dh = q.shape
     if S % (2 * n) != 0:
         raise ValueError("S=%d must divide by 2*sp=%d" % (S, 2 * n))
+    c = S // (2 * n)
+    if use_flash is None:
+        use_flash = (FLAGS.ring_flash
+                     and R.applicable(B, H, c, c, Dh,
+                                      q.dtype.itemsize))
     perm, inv = _zigzag_perm(S, n)
     qz = jnp.take(q, perm, axis=2)
     kz = jnp.take(k, perm, axis=2)
     vz = jnp.take(v, perm, axis=2)
     spec = PartitionSpec(None, None, axis, None)
 
-    def body(q_, k_, v_):
-        return zigzag_attention_inner(q_, k_, v_, axis_name=axis,
-                                      n_blocks=n, scale=scale)
+    if use_flash:
+        def body(q_, k_, v_):
+            return zigzag_attention_inner_flash(q_, k_, v_, axis, n,
+                                                scale)
+    else:
+        def body(q_, k_, v_):
+            return zigzag_attention_inner(q_, k_, v_, axis_name=axis,
+                                          n_blocks=n, scale=scale)
 
     f = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                   out_specs=spec, check_rep=False)
