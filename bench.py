@@ -317,13 +317,20 @@ def _build_transformer_step(batch, seq_len):
     feed = _device_feed(feed)
     run = lambda k: exe.run_repeated(main, feed=feed,
                                      fetch_list=[avg_cost], iters=k)
-    return cfg, run, tokens_per_step
+    from paddle_tpu.parallel import collectives
+    # this program runs UN-distributed (run_repeated on one device), so
+    # its honest sync volume is world=1 => 0 bytes; the nonzero per-mode
+    # estimates live in the transformer_gradient_sync_mix rows, which
+    # pair them with runs that actually distribute (bench_gradient_sync)
+    wire_bytes = collectives.grad_bytes_per_step(main, "exact", 1)
+    return cfg, run, tokens_per_step, wire_bytes
 
 
 def bench_transformer(batch=64, seq_len=256, warmup=3, iters=25,
                       compare_libs=True):
     _log("building transformer-base program")
-    cfg, run, tokens_per_step = _build_transformer_step(batch, seq_len)
+    cfg, run, tokens_per_step, wire_bytes = \
+        _build_transformer_step(batch, seq_len)
 
     # curated mixes, most promising first (the soft budget may cut
     # the tail). Round-4 chip evidence (BASELINE.md, tools/
@@ -351,6 +358,7 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=25,
             "mfu": _mfu(transformer_flops_per_step(cfg, batch),
                         best_sps),
             "batch": batch,
+            "bytes_on_wire_per_step": wire_bytes,
         }
         _PARTIAL["mixes"] = list(mixes_so_far)
 
@@ -377,6 +385,10 @@ def bench_transformer(batch=64, seq_len=256, warmup=3, iters=25,
         "unit": "tokens/sec/chip",
         "mfu": mfu,
         "batch": used_batch,
+        # estimated gradient-sync comms volume at the current world
+        # size (parallel/collectives estimator; 0 on a single chip) so
+        # BENCH_*.json rounds track bytes-on-wire alongside tokens/sec
+        "bytes_on_wire_per_step": wire_bytes,
         "_mixes": measured,
     }
 
@@ -394,7 +406,8 @@ def bench_transformer_longseq(batch=16, seq_len=1024, warmup=3,
     b64/S=256 headline (16k), so steps/s are directly comparable.
     Measures the pure-XLA base against the sdpa:pallas mix — the
     blocked kernel has never had an in-model number."""
-    cfg, run, tokens_per_step = _build_transformer_step(batch, seq_len)
+    cfg, run, tokens_per_step, wire_bytes = \
+        _build_transformer_step(batch, seq_len)
     sps, measured = _best_library(
         run, warmup, iters,
         extra_libs=("scaled_dot_product_attention:pallas",))
@@ -404,8 +417,81 @@ def bench_transformer_longseq(batch=16, seq_len=1024, warmup=3,
         "unit": "tokens/sec/chip",
         "mfu": _mfu(transformer_flops_per_step(cfg, batch), sps),
         "batch": batch,
+        "bytes_on_wire_per_step": wire_bytes,
         "_mixes": measured,
     }
+
+
+# ---------------------------------------------------------------------------
+# config 3c: gradient-sync transports (exact vs q8, side by side)
+# ---------------------------------------------------------------------------
+
+def bench_gradient_sync(batch=None, seq_len=None, warmup=1, iters=4):
+    """Headline model under each BuildStrategy.gradient_sync transport
+    (parallel/collectives.py): implicit GSPMD baseline vs explicit
+    exact psum vs block-quantized int8 with error feedback, each row
+    carrying the estimated bytes_on_wire_per_step. Distributed
+    programs dispatch one step per run call (no run_repeated scan), so
+    absolute steps/s are conservative through the dev tunnel — the
+    signal is the exact-vs-q8 ordering plus the comms-volume estimate.
+    On a 1-chip backend dp=1: the collectives degenerate (bytes 0) but
+    every explicit code path still compiles and runs."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel import collectives
+
+    smoke = jax.devices()[0].platform == "cpu"
+    batch = batch or (8 if smoke else 64)
+    seq_len = seq_len or (32 if smoke else 256)
+    world = jax.device_count()
+    if batch % world:  # dp feed sharding wants divisible batches
+        batch = max(world, batch - batch % world)
+    rows = []
+    for mode in (None, "exact", "q8"):
+        _release_device_state()
+        cfg = T.TransformerConfig(src_vocab=30000, tgt_vocab=30000,
+                                  max_len=seq_len, d_model=512,
+                                  d_ffn=2048, n_head=8, n_layer=6,
+                                  dropout=0.1)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 1
+        with fluid.program_guard(main, startup):
+            avg_cost, _tok, _ = T.transformer(cfg)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
+        strat = fluid.BuildStrategy()
+        strat.gradient_sync = mode
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            build_strategy=strat)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _device_feed(T.make_fake_batch(cfg, batch))
+        _log("gradient_sync %r: warmup/compile" % (mode,))
+        out = None
+        for _ in range(warmup):
+            out = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+        if not np.isfinite(float(np.asarray(out[0]).reshape(-1)[0])):
+            raise FloatingPointError("non-finite loss under "
+                                     "gradient_sync=%r" % (mode,))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = exe.run(prog, feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False)
+        lv = float(np.asarray(out[0]).reshape(-1)[0])  # honest sync
+        sps = iters / (time.perf_counter() - t0)
+        if not np.isfinite(lv):
+            raise FloatingPointError("non-finite loss under "
+                                     "gradient_sync=%r" % (mode,))
+        _log("gradient_sync %r: %.3f steps/s" % (mode, sps))
+        rows.append({
+            "metric": "transformer_gradient_sync_mix",
+            "gradient_sync": mode or "implicit",
+            "value": round(sps, 4), "unit": "steps/sec",
+            "world": world, "batch": batch,
+            "bytes_on_wire_per_step":
+                collectives.grad_bytes_per_step(main, mode, world)})
+    return rows
 
 
 # ---------------------------------------------------------------------------
@@ -864,6 +950,23 @@ def child_main():
     mixes = headline.pop("_mixes", [])
     _emit(headline)
     _emit_mixes("transformer", mixes)
+    if headline.get("value") is not None and not _over_budget():
+        # exact-vs-q8 gradient-sync rows ride with the headline (and
+        # hence appear in --all output too): steps/s per transport plus
+        # estimated bytes-on-wire (parallel/collectives.py)
+        try:
+            guard = _mix_guard("gradient_sync mixes")
+            try:
+                gs_kw = {"batch": 4, "seq_len": 32, "iters": 2} \
+                    if smoke else {}
+                gs_rows = bench_gradient_sync(**gs_kw)
+            finally:
+                guard.cancel()
+            for r in gs_rows:
+                print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(json.dumps({"metric": "transformer_gradient_sync_mix",
+                              "error": repr(e)}), flush=True)
     if "--all" in sys.argv:
         # cheapest-compile first: ResNet-50's real NCHW fwd+bwd scan
         # can take >20 min through the remote AOT helper (round 4: it

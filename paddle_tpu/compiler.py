@@ -52,6 +52,14 @@ class BuildStrategy:
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = \
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        # Gradient-sync transport over the dp axis (parallel/
+        # collectives.py): None = implicit GSPMD all-reduce (the
+        # compiler inserts it); "exact" = explicit psum via shard_map;
+        # "rs_ag" = reduce-scatter + all-gather (arXiv:2004.13336,
+        # bit-identical to exact); "q8" = block-quantized int8
+        # all-reduce with per-parameter error feedback
+        # (arXiv:2506.17615 analog). See docs/gradient_sync.md.
+        self.gradient_sync = None
         # fuse_elewise_add_act_ops runs the real ir pass (ir/passes.py);
         # the remaining toggles are accepted for parity — the XLA
         # compiler performs those fusions itself.
@@ -180,17 +188,39 @@ class CompiledProgram:
             if v.persistable and v.sharding is not None))
         return (tuple(d.id for d in mesh.devices.flat),
                 mesh.axis_names, tuple(mesh.shape.values()),
-                self._build_strategy.reduce_strategy, var_specs)
+                self._build_strategy.reduce_strategy,
+                self._build_strategy.gradient_sync, var_specs)
+
+    def grad_sync_plan(self, block):
+        """Explicit-collective rewrite plan for the executor (None when
+        gradient_sync is unset or the block has no optimizer)."""
+        gs = self._build_strategy.gradient_sync
+        if not gs:
+            return None
+        from .parallel import collectives
+        return collectives.make_plan(block, gs, self._mesh)
 
     # -- execution ---------------------------------------------------------
     def run(self, exe, feed, fetch_list, scope, return_numpy,
-            use_program_cache=True):
+            use_program_cache=True, validate_feed=True):
         from .core.scope import global_scope
         if self._build_strategy.fuse_elewise_add_act_ops and \
                 not getattr(self, "_fuse_done", False):
             from . import ir
             ir.apply_passes(self.program, ["fuse_elewise_add_act_pass"])
             self._fuse_done = True
+        gs = self._build_strategy.gradient_sync
+        if gs:
+            from .parallel import collectives
+            enforce(gs in collectives.GRAD_SYNC_MODES,
+                    "BuildStrategy.gradient_sync must be one of %s, "
+                    "got %r", collectives.GRAD_SYNC_MODES, gs)
+            if gs == "q8":
+                # error-feedback residual slots must exist (block var +
+                # scope zeros) BEFORE the executor snapshots the
+                # persistable carry for this step
+                collectives.ensure_residual_vars(
+                    self.program, scope or global_scope())
         # ops that are mesh-aware (ring_attention, sp/ep lowerings)
         # read the ambient mesh during tracing
         with mesh_lib.mesh_guard(self._mesh):
@@ -198,4 +228,5 @@ class CompiledProgram:
                                  fetch_list or [],
                                  scope or global_scope(), return_numpy,
                                  dist=self,
-                                 use_program_cache=use_program_cache)
+                                 use_program_cache=use_program_cache,
+                                 validate_feed=validate_feed)

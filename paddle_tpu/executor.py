@@ -514,10 +514,17 @@ def _run_adam_group(ops_group, env, step_key, library):
         off += size
 
 
-def run_block(block, env, step_key, library=None):
+def run_block(block, env, step_key, library=None, grad_sync=None):
     """Trace every op of a block into env (the analog of the reference's
     RunPreparedContext hot loop, executor.cc:415 — but tracing, not
-    executing)."""
+    executing).
+
+    ``grad_sync``: optional parallel.collectives.GradSyncPlan — at its
+    boundary op index (first optimize-role consumer of a parameter
+    gradient) the plan rewrites the ``@GRAD`` env entries through the
+    selected explicit collective, INSIDE this same trace, so backward
+    and optimizer fuse around the sync exactly as they do around the
+    implicit GSPMD one."""
     vjp_fwd_indices = {op.attrs.get("fwd_op_index")
                        for op in block.ops if op.type in ("vjp", "vjp2")}
     adam_groups = _adam_batch_groups(block) \
@@ -525,6 +532,8 @@ def run_block(block, env, step_key, library=None):
             and not _adam_library_overridden(library)) else {}
     skip = set()
     for i, op in enumerate(block.ops):
+        if grad_sync is not None and i == grad_sync.boundary:
+            grad_sync.apply(env)
         if i in skip:
             continue
         if i in adam_groups:
@@ -636,16 +645,19 @@ class Executor:
 
     # -- public API --------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True,
+            validate_feed=True):
         program = program or framework.default_main_program()
         if getattr(program, "_is_compiled", False):
             # CompiledProgram (compiler.py) — distributed execution.
             return program.run(self, feed, fetch_list, scope,
                                return_numpy,
-                               use_program_cache=use_program_cache)
+                               use_program_cache=use_program_cache,
+                               validate_feed=validate_feed)
         return self._run_impl(program, feed or {}, fetch_list or [],
                               scope or global_scope(), return_numpy,
-                              use_program_cache=use_program_cache)
+                              use_program_cache=use_program_cache,
+                              validate_feed=validate_feed)
 
     def close(self):
         self._cache.clear()
@@ -800,12 +812,16 @@ class Executor:
             step += 1
             # fetch (which syncs host<->device) only on print steps —
             # every other step dispatches asynchronously (the
-            # reference also materializes fetch vars at print_period)
-            printing = debug and fetch_list and \
-                step % print_period == 0
+            # reference also materializes fetch vars at print_period).
+            # Honored whenever a fetch_list is given: the old
+            # debug-only gate silently dropped the caller's fetches.
+            printing = bool(fetch_list) and step % print_period == 0
+            # a Dataset emits homogeneous batches, so feed shape/dtype
+            # validation runs once on the first batch instead of
+            # re-deriving the same verdict every step of the loop
             vals = self.run(program, feed=feed,
                             fetch_list=fetch_list if printing else [],
-                            scope=scope)
+                            scope=scope, validate_feed=step == 1)
             if printing:
                 msg = ", ".join(
                     "%s=%s" % (n, np.asarray(v).reshape(-1)[:3])
@@ -840,7 +856,7 @@ class Executor:
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   dist=None, donate=True, library=None,
-                  use_program_cache=True):
+                  use_program_cache=True, validate_feed=True):
         fetch_names = [f.name if isinstance(f, framework.Variable) else f
                        for f in fetch_list]
         block = program.global_block()
@@ -864,7 +880,8 @@ class Executor:
                 if getattr(val, "sharding", None) != want:
                     persist_in[name] = jax.device_put(val, want)
 
-        _check_feed_shape_type(block, feed)
+        if validate_feed:
+            _check_feed_shape_type(block, feed)
         feed_names = tuple(sorted(feed))
         cache_key = (id(program), program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
@@ -875,12 +892,17 @@ class Executor:
         if fn is None:
             persistable_names = frozenset(
                 n for n, v in block.vars.items() if v.persistable)
+            # trace-time only (the closure bakes it into the compiled
+            # step), so the block scan stays off the per-step hot path
+            sync_plan = dist.grad_sync_plan(block) if dist is not None \
+                else None
 
             def step(persist, feed_vals, step_key):
                 env = dict(persist)
                 env.update(feed_vals)
                 with framework._trace_program_guard(program):
-                    run_block(block, env, step_key, library=library)
+                    run_block(block, env, step_key, library=library,
+                              grad_sync=sync_plan)
                 persist_out = {n: env[n] for n in persistable_names
                                if n in env}
                 try:

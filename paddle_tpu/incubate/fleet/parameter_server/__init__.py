@@ -45,7 +45,9 @@ class _PSTrainerProgram:
         self.program = runtime.program
 
     def run(self, exe, feed, fetch_list, scope, return_numpy,
-            use_program_cache=True):
+            use_program_cache=True, validate_feed=True):
+        # validate_feed is accepted for run()-protocol parity; the PS
+        # runtime validates feeds in its own local-step executor run
         return self._rt.run_step(exe, feed or {},
                                  fetch_list=fetch_list or [],
                                  return_numpy=return_numpy,

@@ -35,5 +35,6 @@ from . import quant_ops  # noqa: F401,E402
 from . import loss_ops  # noqa: F401,E402
 from . import vision_ops  # noqa: F401,E402
 from . import fused_ops  # noqa: F401,E402
+from . import collective_ops  # noqa: F401,E402
 from . import py_func_op  # noqa: F401,E402
 from . import pallas  # noqa: F401,E402

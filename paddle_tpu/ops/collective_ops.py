@@ -1,0 +1,50 @@
+"""Collective communication ops.
+
+Reference: paddle/fluid/operators/distributed_ops/ (allreduce_op,
+sparse_all_reduce_op_handle) — collectives as graph ops. Here GSPMD
+inserts most collectives from sharding annotations; this module
+registers the QUANTIZED gradient all-reduce as an explicit op so the
+per-op library-mix machinery (registry.pick best-impl-wins) and the
+test_op_sweep harness cover it like any other kernel. The heavy
+lifting lives in parallel/collectives.py; the executor's
+BuildStrategy.gradient_sync rewrite uses the same functions directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, register_variant
+
+
+@register("quant_allreduce", ["X", "Residual"], ["Out", "ResidualOut"],
+          differentiable=False)
+def quant_allreduce(x, residual, *, block_size=256, axis="dp"):
+    """Block-scaled int8 all-reduce with error feedback over the
+    ambient mesh's ``axis`` (EQuARX, arXiv:2506.17615 analog; see
+    parallel/collectives.all_reduce_q8). Without a mesh (or a 1-device
+    axis) the transport disappears but the quantize/dequant round-trip
+    and residual carry remain, so the op's numerics are scale-
+    invariant and testable on a single device."""
+    from ..parallel import collectives
+    from ..parallel import mesh as mesh_lib
+    if residual is None:
+        residual = jnp.zeros(jnp.shape(x), jnp.float32)
+    return collectives.all_reduce_q8(x, residual,
+                                     mesh_lib.current_mesh(),
+                                     axis=axis, block_size=block_size)
+
+
+@register_variant("quant_allreduce", "exact")
+def quant_allreduce_exact(x, residual, *, block_size=256, axis="dp"):
+    """Lossless twin for the best-impl-wins mix: full-precision
+    all-reduce, any pending residual transmitted in full and zeroed."""
+    from ..parallel import collectives
+    from ..parallel import mesh as mesh_lib
+    mesh = mesh_lib.current_mesh()
+    if residual is None:
+        residual = jnp.zeros(jnp.shape(x), jnp.float32)
+    n = collectives.axis_size(mesh, axis)
+    y = collectives.all_reduce_exact(x, mesh, axis)
+    y = y.astype(jnp.float32) + n * residual
+    return y.astype(jnp.asarray(x).dtype), jnp.zeros_like(residual)

@@ -18,6 +18,9 @@ from .mesh import (current_mesh, data_parallel_mesh, make_mesh,  # noqa
                    mesh_guard, named_sharding, set_mesh,
                    shard_batch_spec)
 from .api import shard, replicate  # noqa: F401
+from . import collectives  # noqa: F401
+from .collectives import (all_reduce_exact, all_reduce_q8,  # noqa: F401
+                          grad_bytes_per_step, reduce_scatter_gather)
 from . import ring_attention  # noqa: F401  (registers the op)
 from . import ulysses  # noqa: F401  (registers the op)
 from .ring_attention import ring_attention as ring_attention_fn  # noqa

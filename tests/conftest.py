@@ -31,6 +31,14 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    # declare the marker tier-1 deselects with -m 'not slow' so the
+    # @pytest.mark.slow tests don't warn PytestUnknownMarkWarning
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test, deselected by tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs, name generator, and global

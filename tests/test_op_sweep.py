@@ -589,6 +589,37 @@ def _np_qdq(x, scale, bits=8):
 
 
 _qx = sgn((2, 3), 210)
+def _np_q8_sync(x, r, bs):
+    """Numpy twin of quant_allreduce's single-device path: compensate
+    with the residual, one block-scaled int8 round trip, carry the
+    quantization error forward (parallel/collectives.all_reduce_q8)."""
+    c = (x + r).astype(np.float32)
+    flat = c.reshape(-1)
+    nblk = -(-flat.size // bs)
+    pad = np.zeros(nblk * bs, np.float32)
+    pad[:flat.size] = flat
+    blocks = pad.reshape(nblk, bs)
+    amax = np.abs(blocks).max(axis=1)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(blocks / scale[:, None]), -127, 127)
+    y = (q * scale[:, None]).reshape(-1)[:flat.size].reshape(c.shape)
+    return [y.astype(np.float32), c - y]
+
+
+# both outputs checked within half a quantization step (atol covers
+# the base q8 lowering AND the lossless "exact" variant rerun, whose
+# Out=X+R / ResidualOut=0 differ from the q8 reference by <= scale/2)
+spec("quant_allreduce",
+     {"X": sgn((4, 8), 920), "Residual": np.zeros((4, 8), np.float32)},
+     {"block_size": 8},
+     ref=lambda ins: _np_q8_sync(ins["X"], ins["Residual"], 8),
+     atol=0.01)
+spec("quant_allreduce",
+     {"X": sgn((3, 7), 921), "Residual": sgn((3, 7), 922) * 0.01},
+     {"block_size": 4},
+     ref=lambda ins: _np_q8_sync(ins["X"], ins["Residual"], 4),
+     atol=0.01)
+
 spec("fake_quantize_dequantize_abs_max", {"X": _qx},
      ref=lambda ins: [_np_qdq(ins["X"], np.abs(ins["X"]).max()),
                       np.abs(ins["X"]).max()],
