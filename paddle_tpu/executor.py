@@ -405,19 +405,21 @@ def _adam_library_overridden(library):
 
 def _adam_batch_groups(block):
     """Maximal runs of consecutive dense adam/adamw ops with identical
-    attrs: {start_index: [indices]} (len >= 2 only)."""
+    attrs: {start_index: [indices]} (len >= 2 only). Gated ops (anomaly
+    guard, gradient accumulation) batch together when they share the
+    same gate — _adam_group_sig includes the gate attr, and
+    _run_adam_group applies the select on its batched writes."""
     groups = {}
     ops_l = block.ops
     i = 0
     while i < len(ops_l):
         op = ops_l[i]
-        if op.type in _MULTI_ADAM_TYPES and "gate" not in op.attrs:
+        if op.type in _MULTI_ADAM_TYPES:
             sig = _adam_group_sig(op)
             idxs = [i]
             j = i + 1
             while (j < len(ops_l)
                    and ops_l[j].type == op.type
-                   and "gate" not in ops_l[j].attrs
                    and _adam_group_sig(ops_l[j]) == sig):
                 idxs.append(j)
                 j += 1
@@ -464,6 +466,14 @@ def _run_adam_group(ops_group, env, step_key, library):
 
     op0 = small[0][1]
     a = op0.attrs
+    # gated group (anomaly guard / grad accumulation — identical gate
+    # across the group by _adam_group_sig): batched writes select old
+    # vs new exactly like _gate_result does per-op
+    gate_name = a.get("gate")
+    gate = env[gate_name] if gate_name is not None else None
+
+    def _sel(new, old):
+        return new if gate is None else jnp.where(gate, new, old)
     # defaults mirror the op lowerings' signatures
     # (ops/optimizer_ops.py adam/adamw) so an op relying on an attr
     # default gets the identical value on the batched path
@@ -503,18 +513,23 @@ def _run_adam_group(ops_group, env, step_key, library):
         pn = pn - lr_raw * wd * pc
 
     off = 0
-    for (idx, op), p, b1p, b2p in zip(small, ps, b1ps, b2ps):
+    for (idx, op), p, m1, m2, b1p, b2p in zip(small, ps, m1s, m2s,
+                                              b1ps, b2ps):
         size = int(p.size)
         sl = slice(off, off + size)
-        env[op.outputs["ParamOut"][0]] = pn[sl].reshape(p.shape)
-        env[op.outputs["Moment1Out"][0]] = m1n[sl].reshape(p.shape)
-        env[op.outputs["Moment2Out"][0]] = m2n[sl].reshape(p.shape)
-        env[op.outputs["Beta1PowOut"][0]] = b1p * b1
-        env[op.outputs["Beta2PowOut"][0]] = b2p * b2
+        env[op.outputs["ParamOut"][0]] = _sel(
+            pn[sl].reshape(p.shape), p)
+        env[op.outputs["Moment1Out"][0]] = _sel(
+            m1n[sl].reshape(p.shape), m1)
+        env[op.outputs["Moment2Out"][0]] = _sel(
+            m2n[sl].reshape(p.shape), m2)
+        env[op.outputs["Beta1PowOut"][0]] = _sel(b1p * b1, b1p)
+        env[op.outputs["Beta2PowOut"][0]] = _sel(b2p * b2, b2p)
         off += size
 
 
-def run_block(block, env, step_key, library=None, grad_sync=None):
+def run_block(block, env, step_key, library=None, grad_sync=None,
+              anomaly_guard=None):
     """Trace every op of a block into env (the analog of the reference's
     RunPreparedContext hot loop, executor.cc:415 — but tracing, not
     executing).
@@ -524,16 +539,37 @@ def run_block(block, env, step_key, library=None, grad_sync=None):
     gradient) the plan rewrites the ``@GRAD`` env entries through the
     selected explicit collective, INSIDE this same trace, so backward
     and optimizer fuse around the sync exactly as they do around the
-    implicit GSPMD one."""
+    implicit GSPMD one.
+
+    ``anomaly_guard``: optional resilience.guard.AnomalyGuardPlan — at
+    the same boundary it derives an in-graph ``all_finite(loss, grads)``
+    flag BEFORE the collective runs (q8 quantization can launder a NaN
+    block into garbage finite values, so the check must see the raw
+    grads), and AFTER it protects the q8 error-feedback residuals and
+    advances the skipped/consecutive-anomaly counters. The optimize-role
+    ops themselves are gated on the flag via their ``gate`` attr (set by
+    resilience.guard.install_anomaly_guard), so a bad step's update is a
+    select-no-op inside the one traced step."""
     vjp_fwd_indices = {op.attrs.get("fwd_op_index")
                        for op in block.ops if op.type in ("vjp", "vjp2")}
     adam_groups = _adam_batch_groups(block) \
         if (FLAGS.multi_tensor_adam
             and not _adam_library_overridden(library)) else {}
     skip = set()
+    if anomaly_guard is not None:
+        # post_sync must see the post-collective residuals: when a sync
+        # plan exists its boundary is >= the guard's (the guard's grad
+        # set is a superset), so pin the post hook there
+        anomaly_guard.post_boundary = grad_sync.boundary \
+            if grad_sync is not None else anomaly_guard.boundary
     for i, op in enumerate(block.ops):
+        if anomaly_guard is not None and i == anomaly_guard.boundary:
+            anomaly_guard.pre_sync(env)
         if grad_sync is not None and i == grad_sync.boundary:
             grad_sync.apply(env)
+        if anomaly_guard is not None \
+                and i == anomaly_guard.post_boundary:
+            anomaly_guard.post_sync(env)
         if i in skip:
             continue
         if i in adam_groups:
@@ -718,18 +754,23 @@ class Executor:
                     and scope.find_var(name) is not None:
                 persist_in[name] = scope.find_var(name)
         _check_feed_shape_type(block, feed)
-        cache_key = ("repeat", iters, id(program), program._version,
+        # program._uid, NOT id(program): ids are reused after GC, and a
+        # recycled id with a matching version would return a stale
+        # compiled scan belonging to a dead program
+        cache_key = ("repeat", iters, program._uid, program._version,
                      tuple(sorted(feed)), tuple(fetch_names),
                      tuple(sorted(persist_in)), library)
         fn = self._cache.get(cache_key)
         if fn is None:
             carried = frozenset(persist_in)
+            guard_plan = self._guard_plan(program, block)
 
             def step(persist, feed_vals, step_key):
                 env = dict(persist)
                 env.update(feed_vals)
                 with framework._trace_program_guard(program):
-                    run_block(block, env, step_key, library=library)
+                    run_block(block, env, step_key, library=library,
+                              anomaly_guard=guard_plan)
                 # scan carries a FIXED structure: exactly the
                 # persistables present when tracing started (vars a
                 # step newly creates cannot join the carry — run the
@@ -847,6 +888,16 @@ class Executor:
                                        print_period)
 
     # -- internals ---------------------------------------------------------
+    @staticmethod
+    def _guard_plan(program, block):
+        """Anomaly-guard rewrite plan for programs that had
+        resilience.guard.install_anomaly_guard applied (trace-time
+        only — the closure bakes it into the compiled step)."""
+        if getattr(program, "_anomaly_guard", None) is None:
+            return None
+        from .resilience.guard import make_plan
+        return make_plan(block, program._anomaly_guard)
+
     def _base_key(self, program):
         seed = program.random_seed or FLAGS.global_seed
         if not seed:
@@ -883,7 +934,8 @@ class Executor:
         if validate_feed:
             _check_feed_shape_type(block, feed)
         feed_names = tuple(sorted(feed))
-        cache_key = (id(program), program._version, feed_names,
+        # program._uid, NOT id(program) — see run_repeated's cache key
+        cache_key = (program._uid, program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
                      library,
                      dist._fingerprint() if dist is not None else None)
@@ -896,13 +948,15 @@ class Executor:
             # step), so the block scan stays off the per-step hot path
             sync_plan = dist.grad_sync_plan(block) if dist is not None \
                 else None
+            guard_plan = self._guard_plan(program, block)
 
             def step(persist, feed_vals, step_key):
                 env = dict(persist)
                 env.update(feed_vals)
                 with framework._trace_program_guard(program):
                     run_block(block, env, step_key, library=library,
-                              grad_sync=sync_plan)
+                              grad_sync=sync_plan,
+                              anomaly_guard=guard_plan)
                 persist_out = {n: env[n] for n in persistable_names
                                if n in env}
                 try:

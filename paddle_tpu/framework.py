@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -488,17 +489,24 @@ def _infer_shapes(block, op):
 # Program
 # ---------------------------------------------------------------------------
 
+_program_uid_counter = itertools.count(1)
+
+
 class Program:
     """Reference: framework.py:2714 / ProgramDesc framework.proto:184.
 
-    ``_version`` increments on every mutation; the Executor uses it as its
-    compilation-cache key (the analog of the reference re-Preparing an
-    ExecutorPrepareContext when the program changes).
+    ``_version`` increments on every mutation; the Executor uses
+    ``(_uid, _version)`` as its compilation-cache key (the analog of the
+    reference re-Preparing an ExecutorPrepareContext when the program
+    changes). ``_uid`` is assigned monotonically — unlike ``id()``, it
+    can never be reused after a program is garbage-collected, so a cache
+    hit always belongs to THIS program.
     """
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
+        self._uid = next(_program_uid_counter)
         self._version = 0
         self._seed = 0
         self._is_test = False
@@ -661,8 +669,14 @@ class Program:
                      "attrs": op.attrs} for op in b.ops]
             blocks.append({"idx": b.idx, "parent_idx": b.parent_idx,
                            "vars": vars_, "ops": ops_})
-        return {"version": 1, "seed": self._seed,
-                "is_test": self._is_test, "blocks": blocks}
+        out = {"version": 1, "seed": self._seed,
+               "is_test": self._is_test, "blocks": blocks}
+        if getattr(self, "_anomaly_guard", None) is not None:
+            # carry the guard config (loss name) so a round-tripped
+            # program keeps the loss-finiteness check, not only the
+            # gate attrs
+            out["anomaly_guard"] = dict(self._anomaly_guard)
+        return out
 
     @staticmethod
     def from_dict(desc: dict) -> "Program":
@@ -698,6 +712,15 @@ class Program:
                               od["outputs"].items()}
                 op.attrs = dict(od["attrs"])
                 b.ops.append(op)
+        # a guarded train program round-trips its gate attrs; restore
+        # the guard config (with its loss name) or, for descs written
+        # before the config was serialized, sniff the gate attrs
+        # (resilience.guard.FLAG_KEY — string literal to avoid a cycle)
+        if desc.get("anomaly_guard") is not None:
+            p._anomaly_guard = dict(desc["anomaly_guard"])
+        elif any(op.attrs.get("gate") == "__guard_all_finite__"
+                 for blk in p.blocks for op in blk.ops):
+            p._anomaly_guard = {"loss": None}
         p._bump()
         return p
 
@@ -706,12 +729,19 @@ class Program:
         memo[id(self)] = p
         p.blocks = []
         p.current_block_idx = self.current_block_idx
+        # a clone is a DIFFERENT program: fresh cache identity
+        p._uid = next(_program_uid_counter)
         p._version = self._version
         p._seed = self._seed
         p._is_test = self._is_test
         p._op_role_var = list(self._op_role_var)
         p._exec_strategy = self._exec_strategy
         p._build_strategy = self._build_strategy
+        if getattr(self, "_anomaly_guard", None) is not None:
+            # cloned gate attrs need the guard marker or the gated ops
+            # would dangle on the missing flag (a for_test clone prunes
+            # the gated ops, so carrying the marker there is inert)
+            p._anomaly_guard = dict(self._anomaly_guard)
         if hasattr(self, "_distributed_lookups"):
             # >HBM table metadata (layers.embedding is_distributed=True)
             p._distributed_lookups = [dict(d) for d in

@@ -35,6 +35,22 @@ _TENSOR_MAGIC = b"PTPU"
 _TENSOR_VERSION = 1
 
 
+def _fsync_dir(path):
+    """fsync a DIRECTORY so its entries (new files, renames) are
+    durable, not merely in the page cache. No-op on platforms whose
+    directory handles refuse fsync (Windows)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 # ---------------------------------------------------------------------------
 # tensor wire format
 # ---------------------------------------------------------------------------
@@ -149,6 +165,9 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
     if filename is None:
         for v in vars:
             path = os.path.join(dirname, v.name)
+            if not os.path.exists(path) and _ckpt_optional(v):
+                _default_fill(scope, v)
+                continue
             enforce(os.path.exists(path),
                     "checkpoint file missing for var %r: %s"
                     % (v.name, path))
@@ -171,9 +190,28 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
             arr, off = deserialize_tensor(buf, off)
             tensors[name] = arr
         for v in vars:
+            if v.name not in tensors and _ckpt_optional(v):
+                _default_fill(scope, v)
+                continue
             enforce(v.name in tensors,
                     "var %r not in combined checkpoint" % v.name)
             _check_and_set(scope, v, tensors[v.name])
+
+
+def _ckpt_optional(v) -> bool:
+    """Vars a checkpoint may legitimately lack: subsystems added AFTER
+    a checkpoint was written (the anomaly guard's counters) mark their
+    vars ``_ckpt_optional`` so old checkpoints stay loadable — the var
+    default-fills instead of failing the whole restore. The name-prefix
+    fallback keeps the property through to_dict/from_dict round-trips,
+    which do not carry ad-hoc attributes."""
+    return bool(getattr(v, "_ckpt_optional", False)) \
+        or v.name.startswith("__guard_")
+
+
+def _default_fill(scope, v):
+    shape = tuple(int(d) for d in v.shape if d != -1)
+    scope.set_var(v.name, np.zeros(shape, np.dtype(v.dtype)))
 
 
 def _check_and_set(scope, v, arr):
@@ -302,12 +340,23 @@ class CheckpointSaver:
         self._inflight = None
         self._last_step = None
         self._last_snapshot = None
+        self._last_write_error = None
+        # test seam: called as (step, name, index) after each data file
+        # lands in the tmp dir (resilience.faults crashes the writer
+        # here to prove torn writes stay invisible)
+        self._write_file_hook = None
         os.makedirs(dirname, exist_ok=True)
-        # sweep tmp dirs stranded by a writer killed mid-save
         for name in os.listdir(dirname):
+            path = os.path.join(dirname, name)
             if name.startswith(".tmp-ckpt-"):
-                shutil.rmtree(os.path.join(dirname, name),
-                              ignore_errors=True)
+                # tmp dirs stranded by a writer killed mid-save
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.startswith("ckpt-") and not os.path.exists(
+                    os.path.join(path, self.MARKER)):
+                # an unmarked final dir is wreckage from a killed
+                # _prune (the marker is removed FIRST as the prune
+                # commit point) — finish the job
+                shutil.rmtree(path, ignore_errors=True)
 
     # -- writing -------------------------------------------------------
     def _should_save(self):
@@ -340,19 +389,46 @@ class CheckpointSaver:
         return snap
 
     def _write(self, snap, step, error_box):
+        """Durability ordering (crash/power-loss safe):
+
+        1. every tensor file is written AND fsynced into the tmp dir;
+        2. the ``_COMPLETE`` marker is written + fsynced INSIDE the tmp
+           dir, last — a marker can never exist next to unsynced data;
+        3. the tmp dir itself is fsynced (directory entries durable);
+        4. ONE ``os.rename`` publishes the checkpoint atomically, then
+           the parent dir is fsynced so the rename itself is durable.
+
+        A crash anywhere before (4) strands only an invisible
+        ``.tmp-ckpt-*`` dir (swept at init); after (4) the checkpoint
+        is complete by construction. The previous ordering (marker
+        written after the rename, nothing fsynced) had two real holes:
+        a crash between rename and marker left an invisible
+        never-pruned full checkpoint, and a power loss could persist
+        the marker before the data it vouches for."""
         try:
             tmp = os.path.join(self._dir, ".tmp-ckpt-%d-%d"
                                % (step, os.getpid()))
             os.makedirs(tmp, exist_ok=True)
-            for name, arr in snap.items():
+            for i, (name, arr) in enumerate(snap.items()):
                 with open(os.path.join(tmp, name), "wb") as f:
                     f.write(serialize_tensor(arr))
+                    f.flush()
+                    os.fsync(f.fileno())
+                if self._write_file_hook is not None:
+                    self._write_file_hook(step, name, i)
+            with open(os.path.join(tmp, self.MARKER), "w") as f:
+                f.write(str(step))
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
             final = self._ckpt_dir(step)
             if os.path.exists(final):
-                shutil.rmtree(final)
+                # re-saving an existing step (post-rollback re-save):
+                # unmark-first, same as _prune — a kill mid-rmtree must
+                # never leave a marked-but-partial dir
+                self._remove_ckpt_dir(final)
             os.rename(tmp, final)
-            with open(os.path.join(final, self.MARKER), "w") as f:
-                f.write(str(step))
+            _fsync_dir(self._dir)
             self._prune()
         except Exception as e:  # surfaced via wait()
             error_box.append(e)
@@ -363,12 +439,14 @@ class CheckpointSaver:
     def save(self, step, sync=False):
         """Snapshot now, write in the background (or synchronously
         with ``sync=True``). Returns an _AsyncSave handle or None when
-        this rank doesn't save."""
+        this rank doesn't save. A PREVIOUS background write's failure
+        never aborts this save — it is parked for
+        ``take_write_error()`` (the failure belongs to the old step,
+        and a training loop must survive a failed checkpoint)."""
         if not self._should_save():
             return None
-        if self._inflight is not None and not self._inflight.done():
-            # one writer at a time: let the previous save finish first
-            self._inflight.wait()
+        # one writer at a time: drain the previous save first
+        self.wait_quietly()
         snap = self._snapshot()
         self._last_step = step
         # retained so the preemption handler can re-write THIS step's
@@ -390,10 +468,48 @@ class CheckpointSaver:
         if self._inflight is not None:
             self._inflight.wait()
 
+    def wait_quietly(self):
+        """Drain any in-flight write WITHOUT raising; its error (if
+        any) is parked for ``take_write_error()``."""
+        if self._inflight is None:
+            return
+        self._inflight._thread.join()
+        if self._inflight._error:
+            self._last_write_error = self._inflight._error[0]
+            self._inflight = None
+
+    def take_write_error(self):
+        """Return-and-clear the most recent FINISHED background
+        write's error (None when the last write succeeded or is still
+        running). Lets a caller that never blocks on wait() still
+        account for failed checkpoints."""
+        if self._inflight is not None and self._inflight.done():
+            if self._inflight._error:
+                self._last_write_error = self._inflight._error[0]
+            self._inflight = None
+        err = getattr(self, "_last_write_error", None)
+        self._last_write_error = None
+        return err
+
+    def _remove_ckpt_dir(self, d):
+        """Delete a checkpoint dir with the marker removed FIRST (the
+        commit point): unmarking makes the dir invisible to
+        restore_latest, so a kill mid-rmtree can never leave a
+        marked-but-partial checkpoint (rmtree's deletion order is
+        arbitrary — the marker could otherwise outlive the tensors it
+        vouches for). init sweeps unmarked ckpt-* dirs left by exactly
+        this kill."""
+        try:
+            os.remove(os.path.join(d, self.MARKER))
+            _fsync_dir(d)
+        except OSError:
+            pass
+        shutil.rmtree(d, ignore_errors=True)
+
     def _prune(self):
         steps = sorted(self.list_checkpoints())
         for s in steps[:-self._max_to_keep]:
-            shutil.rmtree(self._ckpt_dir(s), ignore_errors=True)
+            self._remove_ckpt_dir(self._ckpt_dir(s))
 
     # -- reading -------------------------------------------------------
     def list_checkpoints(self):
@@ -410,16 +526,38 @@ class CheckpointSaver:
                     continue
         return sorted(out)
 
-    def restore_latest(self, executor=None):
+    def restore_latest(self, executor=None, max_step=None):
         """Load the newest complete checkpoint into the scope; returns
-        its step, or None if there is none."""
+        its step, or None if there is none. A marked checkpoint that
+        fails to LOAD (torn by a pre-durability-fix power loss, or
+        hand-damaged) is skipped with a warning and the next older one
+        is tried — a rollback must never be stopped by the very
+        corruption it exists to escape. ``max_step`` bounds the search
+        (the GuardedTrainer restores the newest checkpoint from BEFORE
+        a poisoned window, not one saved inside it)."""
+        import warnings
+        last_err = None
         steps = self.list_checkpoints()
-        if not steps:
-            return None
-        step = steps[-1]
-        load_persistables(executor, self._ckpt_dir(step),
-                          self._program, scope=self._scope)
-        return step
+        if max_step is not None:
+            # STRICT: restoring something newer than the bound would
+            # hand the caller state from outside the window it asked
+            # for (None is an answer the caller can reason about; a
+            # too-new checkpoint is not)
+            steps = [s for s in steps if s <= max_step]
+        for step in reversed(steps):
+            try:
+                load_persistables(executor, self._ckpt_dir(step),
+                                  self._program, scope=self._scope)
+                return step
+            except Exception as e:
+                last_err = e
+                warnings.warn(
+                    "checkpoint ckpt-%d is marked complete but failed "
+                    "to load (%r); falling back to the previous one"
+                    % (step, e))
+        if last_err is not None:
+            raise last_err
+        return None
 
     # -- preemption ----------------------------------------------------
     def install_signal_handler(self, signals=None, get_step=None):

@@ -1,0 +1,27 @@
+"""Guarded training: in-graph anomaly detection, auto-rollback,
+retry/backoff, and a deterministic fault-injection harness.
+
+Reference analog: the Fluid runtime's production failure handling —
+checkpoint_notify machinery (distribute_transpiler.py:1612), PS RPC
+retry loops — generalized for the one-traced-step TPU executor. See
+docs/resilience.md for the policy tables and chaos-harness usage.
+"""
+
+from .guard import (CONSEC_VAR, FLAG_KEY, SKIPPED_VAR,  # noqa: F401
+                    AnomalyGuardPlan, ensure_guard_state,
+                    install_anomaly_guard, read_counters,
+                    reset_guard_state)
+from .retry import (RetryBudgetExhausted, RetryPolicy,  # noqa: F401
+                    is_transient, retry_call)
+from .faults import (FaultInjector, InjectedDispatchError,  # noqa: F401
+                     SimulatedCrash, make_torn_checkpoint)
+from .trainer import GuardedTrainer, TrainingAborted  # noqa: F401
+
+__all__ = [
+    "AnomalyGuardPlan", "install_anomaly_guard", "read_counters",
+    "reset_guard_state", "ensure_guard_state",
+    "FLAG_KEY", "SKIPPED_VAR", "CONSEC_VAR",
+    "RetryPolicy", "RetryBudgetExhausted", "retry_call", "is_transient",
+    "FaultInjector", "InjectedDispatchError", "SimulatedCrash",
+    "make_torn_checkpoint", "GuardedTrainer", "TrainingAborted",
+]

@@ -1,0 +1,375 @@
+"""GuardedTrainer: the guarded training driver.
+
+Reference analog: Fluid survives production because the RUNTIME owns
+failure handling — checkpoint_notify machinery flushes parameter-server
+shards on preemption (distribute_transpiler.py:1612) and the RPC layer
+retries through pserver restarts. This driver composes the same three
+layers for the TPU-native executor:
+
+  in-graph   anomaly guard (guard.py): a non-finite step's update is a
+             select-no-op inside the compiled step; skipped/consecutive
+             counters ride the persistable carry.
+  host loop  auto-rollback: after K consecutive anomalous steps the
+             latest complete checkpoint (weights + optimizer moments +
+             q8 error-feedback residuals — ALL persistables) is
+             restored and training resumes. The PRNG stream never
+             rewinds: the executor folds its base key with a
+             monotonically increasing run counter, so replayed steps
+             draw FRESH dropout masks instead of deterministically
+             re-poisoning themselves.
+  dispatch   retry/backoff (retry.py): transient PJRT failures are
+             retried under a budget; exhaustion degrades gracefully to
+             a final synchronous checkpoint plus a structured
+             ``TrainingAborted`` report.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.enforce import enforce
+from . import guard as guard_mod
+from .retry import RetryBudgetExhausted, RetryPolicy, retry_call
+
+
+class TrainingAborted(RuntimeError):
+    """Raised when the trainer gives up (retry budget exhausted, or the
+    rollback budget spent on a persistent anomaly). A final synchronous
+    checkpoint has already been flushed; ``.report`` carries the
+    structured training summary."""
+
+    def __init__(self, reason: str, report: Dict):
+        self.reason = reason
+        self.report = report
+        super().__init__("%s\nsummary: %r" % (reason, report))
+
+
+class GuardedTrainer:
+    """Drives ``executor.run`` / ``run_repeated`` over a program whose
+    traced step carries the anomaly guard.
+
+    Parameters
+    ----------
+    executor, program, loss : the usual trio; ``install_anomaly_guard``
+        is applied to ``program`` here (idempotent) unless
+        ``guard=False``. If ``startup_program`` is given it runs first.
+    checkpoint_dir : directory for the ``io.CheckpointSaver``; required
+        for rollback (``rollback_after``) to have a restore target. A
+        step-0 checkpoint is flushed synchronously at the first
+        ``train`` call so rollback is ALWAYS possible.
+    checkpoint_every : save cadence in steps (0 = only the initial and
+        final checkpoints). ``sync_saves=False`` writes in the
+        background (training never blocks on the filesystem).
+    rollback_after : K — consecutive anomalous steps that trigger a
+        restore of the latest complete checkpoint. 0 disables rollback.
+    max_rollbacks : rollback budget; a persistent anomaly that keeps
+        re-triggering aborts once it is spent.
+    retry : RetryPolicy for transient dispatch failures.
+    faults : optional resilience.faults.FaultInjector (chaos testing).
+    """
+
+    def __init__(self, executor, program, loss, startup_program=None,
+                 scope=None, checkpoint_dir=None, checkpoint_every=0,
+                 max_to_keep=3, rollback_after=3, max_rollbacks=2,
+                 retry: Optional[RetryPolicy] = None, faults=None,
+                 guard: bool = True, sync_saves: bool = False):
+        from .. import io as io_mod
+        from ..core.scope import global_scope
+        self._exe = executor
+        # ``program`` may be a CompiledProgram (the q8 collective path):
+        # dispatch goes through it, while the guard install and the
+        # checkpoint saver operate on the underlying Program
+        self._program = program
+        self._base_program = program.program \
+            if getattr(program, "_is_compiled", False) else program
+        self._loss = loss
+        self._scope = scope or global_scope()
+        self._guard_on = bool(guard)
+        if startup_program is not None:
+            executor.run(startup_program, scope=self._scope)
+        if self._guard_on:
+            guard_mod.install_anomaly_guard(self._base_program,
+                                            loss=loss,
+                                            scope=self._scope)
+        if self._program is not self._base_program:
+            bs = getattr(self._program, "_build_strategy", None)
+            if getattr(bs, "gradient_sync", None) == "q8":
+                # the q8 error-feedback residuals must exist BEFORE the
+                # initial checkpoint: a rollback to ckpt-0 that lacked
+                # them could not restore the block's full persistable
+                # set once training had created them
+                from ..parallel.collectives import ensure_residual_vars
+                ensure_residual_vars(self._base_program, self._scope)
+        self._saver = None
+        if checkpoint_dir is not None:
+            self._saver = io_mod.CheckpointSaver(
+                checkpoint_dir, self._base_program,
+                max_to_keep=max_to_keep, scope=self._scope)
+            if faults is not None:
+                faults.attach_saver(self._saver)
+        self._checkpoint_every = int(checkpoint_every)
+        self._rollback_after = int(rollback_after)
+        self._max_rollbacks = int(max_rollbacks)
+        self._retry = retry or RetryPolicy()
+        self._faults = faults
+        self._sync_saves = bool(sync_saves)
+        # -- structured summary state -----------------------------------
+        self._steps_run = 0
+        self._retries = 0
+        self._rollbacks = 0
+        self._save_failures = 0
+        self._skipped_host = 0.0  # tally absorbed at rollback resets
+        self._last_finite_loss = None
+        self._losses: List[float] = []
+        self._aborted = None
+        self._initial_ckpt_done = False
+
+    # -- public API ----------------------------------------------------
+    def train(self, feeds, fetch_list=None):
+        """Run one guarded pass over ``feeds``.
+
+        ``feeds``: a SEQUENCE of feed dicts (replayable — rollback
+        rewinds the cursor so the poisoned window's batches are
+        replayed), or an ITERATOR (stream; rollback restores state but
+        continues with the next batches, since a stream cannot be
+        replayed — the train_from_dataset posture). Returns the
+        summary dict.
+        """
+        replayable = isinstance(feeds, (list, tuple))
+        if not replayable:
+            feeds = iter(feeds)
+        self._ensure_initial_checkpoint()
+        fetch = list(fetch_list) if fetch_list else [self._loss]
+        cursor = 0
+        while True:
+            if replayable:
+                if cursor >= len(feeds):
+                    break
+                feed = feeds[cursor]
+            else:
+                try:
+                    feed = next(feeds)
+                except StopIteration:
+                    break
+            step = self._steps_run
+            if self._faults is not None:
+                feed = self._faults.mutate_feed(step, feed)
+            try:
+                fetches = self._dispatch(step, feed, fetch)
+            except RetryBudgetExhausted as e:
+                self._abort("retry budget exhausted at step %d: %s"
+                            % (step, e), cause=e)
+            self._record_loss(fetches)
+            self._steps_run += 1
+            cursor += 1
+            before = self._steps_run
+            restored = self._maybe_rollback()
+            if restored is not None and replayable:
+                cursor = max(0, cursor - (before - restored))
+            self._maybe_checkpoint(self._steps_run)
+        self._finalize()
+        return self.summary()
+
+    def train_repeated(self, feed, iters, chunk=None, fetch_list=None):
+        """Guarded driving of ``Executor.run_repeated``: ``iters`` steps
+        of a FIXED feed dispatched in in-graph scan chunks. The anomaly
+        guard runs inside the scan (bad steps self-skip on device,
+        counters ride the scan carry); the host inspects the counters
+        only at chunk boundaries, where it applies the same
+        rollback/retry policy. ``chunk`` defaults to ``rollback_after``
+        so a fully-poisoned chunk is caught before a second one
+        dispatches."""
+        enforce(int(iters) >= 1, "train_repeated needs iters >= 1")
+        self._ensure_initial_checkpoint()
+        fetch = list(fetch_list) if fetch_list else [self._loss]
+        chunk = int(chunk or max(1, self._rollback_after or 8))
+        remaining = int(iters)
+        while remaining > 0:
+            k = min(chunk, remaining)
+            step = self._steps_run
+
+            def run_chunk():
+                if self._faults is not None:
+                    self._faults.before_dispatch(step)
+                return self._exe.run_repeated(
+                    self._program, feed=feed, fetch_list=fetch,
+                    iters=k, scope=self._scope)
+
+            try:
+                fetches, used = retry_call(run_chunk, self._retry,
+                                           on_retry=self._on_retry)
+                self._retries += used
+            except RetryBudgetExhausted as e:
+                self._abort("retry budget exhausted at step %d: %s"
+                            % (step, e), cause=e)
+            self._record_loss(fetches)
+            self._steps_run += k
+            remaining -= k
+            before = self._steps_run
+            restored = self._maybe_rollback()
+            if restored is not None:
+                remaining += before - restored
+            self._maybe_checkpoint(self._steps_run)
+        self._finalize()
+        return self.summary()
+
+    def train_from_dataset(self, dataset, fetch_list=None):
+        """Guarded twin of ``Executor.train_from_dataset``: iterate the
+        industrial Dataset's batches through the guarded step. The
+        batch stream is not replayable, so rollback restores state and
+        continues forward (weights rewind, data does not)."""
+        return self.train(dataset.batch_iterator(),
+                          fetch_list=fetch_list)
+
+    def summary(self) -> Dict:
+        skipped, consec = guard_mod.read_counters(self._scope) \
+            if self._guard_on else (0.0, 0.0)
+        ckpts = self._saver.list_checkpoints() if self._saver else []
+        return {
+            "steps_run": self._steps_run,
+            "skipped_steps": int(round(self._skipped_host + skipped)),
+            "consecutive_anomalies": int(consec),
+            "rollbacks": self._rollbacks,
+            "retries": self._retries,
+            "save_failures": self._save_failures,
+            "final_loss": self._last_finite_loss,
+            "losses": list(self._losses),
+            "checkpoints": ckpts,
+            "retry_schedule": [round(d, 4)
+                               for d in self._retry.delays()],
+            "aborted": self._aborted,
+            "faults": self._faults.summary()
+            if self._faults is not None else None,
+        }
+
+    # -- internals -----------------------------------------------------
+    def _dispatch(self, step, feed, fetch):
+        def run_once():
+            if self._faults is not None:
+                self._faults.before_dispatch(step)
+            return self._exe.run(self._program, feed=feed,
+                                 fetch_list=fetch, scope=self._scope)
+
+        fetches, used = retry_call(run_once, self._retry,
+                                   on_retry=self._on_retry)
+        self._retries += used
+        return fetches
+
+    def _on_retry(self, attempt, exc, delay):
+        # a transient failure can strand donated device buffers in a
+        # consumed state; a checkpoint restore heals the scope before
+        # the retry re-dispatches (no-op for pre-dispatch failures)
+        if "deleted" in str(exc).lower() and self._saver is not None:
+            try:
+                self._saver.restore_latest(self._exe)
+            except Exception:
+                pass
+
+    def _record_loss(self, fetches):
+        if not fetches:
+            return
+        v = float(np.asarray(fetches[0]).reshape(-1)[0])
+        self._losses.append(v)
+        if np.isfinite(v):
+            self._last_finite_loss = v
+
+    def _maybe_rollback(self):
+        """Restore the latest complete checkpoint once K consecutive
+        anomalous steps accumulate. Returns the restored step or
+        None."""
+        if not (self._guard_on and self._rollback_after
+                and self._saver is not None):
+            return None
+        skipped, consec = guard_mod.read_counters(self._scope)
+        if consec < self._rollback_after:
+            return None
+        if self._rollbacks >= self._max_rollbacks:
+            self._abort(
+                "anomaly persists after %d rollback(s) — %d "
+                "consecutive non-finite steps" % (self._rollbacks,
+                                                  int(consec)))
+        # the counters are persistables too — the restore would rewind
+        # them, so absorb the current tally into the host total first
+        self._skipped_host += skipped
+        self._saver.wait_quietly()
+        # restore from BEFORE the poisoned window: a checkpoint saved
+        # while steps were being skipped is finite (the guard protected
+        # it) but replaying from it would silently drop the skipped
+        # steps' batches; the window start is steps_run - consec
+        window_start = max(0, self._steps_run - int(consec))
+        restored = self._saver.restore_latest(self._exe,
+                                              max_step=window_start)
+        enforce(restored is not None,
+                "rollback triggered but no complete checkpoint exists "
+                "(the initial step-0 checkpoint should make this "
+                "unreachable)")
+        guard_mod.reset_guard_state(self._scope)
+        # PRNG: the executor's run counter is monotonic and never
+        # rewinds, so the replayed window draws fresh per-step keys —
+        # "re-folding past the poisoned window" is structural. The
+        # explicit bump documents the contract and separates the
+        # streams even when a restore lands between scan chunks.
+        self._exe._run_counter += 1
+        self._rollbacks += 1
+        self._steps_run = int(restored)
+        return int(restored)
+
+    def _maybe_checkpoint(self, step):
+        if self._saver is None:
+            return
+        if self._checkpoint_every and \
+                step % self._checkpoint_every == 0:
+            if self._guard_on:
+                # never checkpoint inside an anomaly window: the state
+                # is finite (guarded) but a mid-window save wastes a
+                # max_to_keep slot and can evict the pre-window
+                # checkpoint the rollback needs
+                _, consec = guard_mod.read_counters(self._scope)
+                if consec > 0:
+                    return
+            self._save(step, sync=self._sync_saves)
+
+    def _ensure_initial_checkpoint(self):
+        """Guarantee the rollback invariant: a complete checkpoint at
+        step <= steps_run always exists. An empty dir gets a
+        synchronous step-0 save; a dir with prior checkpoints is
+        RESUMED from (restore + adopt its step number) — otherwise a
+        later rollback could only reach state newer than the poisoned
+        window."""
+        if self._saver is None or self._initial_ckpt_done:
+            return
+        if not self._saver.list_checkpoints():
+            self._save(self._steps_run, sync=True)
+        elif self._steps_run == 0:
+            restored = self._saver.restore_latest(self._exe)
+            if restored is not None:
+                self._steps_run = int(restored)
+                if self._guard_on:
+                    guard_mod.reset_guard_state(self._scope)
+        self._initial_ckpt_done = True
+
+    def _save(self, step, sync):
+        try:
+            self._saver.save(step, sync=sync)
+        except Exception:
+            self._save_failures += 1
+        if self._saver.take_write_error() is not None:
+            self._save_failures += 1
+
+    def _finalize(self):
+        if self._saver is not None:
+            self._save(self._steps_run, sync=True)
+            self._saver.wait_quietly()
+            if self._saver.take_write_error() is not None:
+                self._save_failures += 1
+
+    def _abort(self, reason, cause=None):
+        if self._saver is not None:
+            self._save(self._steps_run, sync=True)
+        self._aborted = reason
+        err = TrainingAborted(reason, self.summary())
+        if cause is not None:
+            raise err from cause
+        raise err

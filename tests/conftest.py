@@ -37,6 +37,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running test, deselected by tier-1 (-m 'not slow')")
+    # chaos tests are the DETERMINISTIC fault-injection suite
+    # (resilience/faults.py): seed-driven, no real signals/network, so
+    # they run inside tier-1 ('not slow' keeps them selected) and can
+    # also be run alone with -m chaos
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection test (tier-1; select "
+        "alone with -m chaos)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Chaos harness CLI: run a GuardedTrainer under injected faults and
+print the structured summary as JSON — every robustness claim in
+docs/resilience.md is checkable by rerunning this.
+
+Examples
+--------
+# the acceptance scenario: NaN grads, a mid-save writer kill, one
+# transient dispatch failure — final loss must track the fault-free
+# twin within rtol 1e-2
+python tools/chaos_run.py --steps 30 --nan-step 5 --nan-step 6 \
+    --nan-step 7 --crash-save-step 8 --transient-step 11
+
+# q8 quantized-collective path on the 8-device CPU mesh
+python tools/chaos_run.py --steps 20 --nan-step 4 --q8
+
+Exit code: 0 when the run completes and (with --check) the final loss
+is within --rtol of the fault-free twin; 1 otherwise.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def build_model(seed):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    main, start = fluid.Program(), fluid.Program()
+    # never 0: random_seed=0 means "draw from os.urandom" (framework
+    # convention), which would initialize the chaos run and its
+    # fault-free twin with DIFFERENT weights and void the comparison
+    main.random_seed = start.random_seed = seed + 1
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [16], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            h = layers.fc(x, size=32, act="relu")
+            pred = layers.fc(h, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, start, loss
+
+
+def make_batches(n, seed, batch=16):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        x = rng.rand(batch, 16).astype(np.float32)
+        y = np.argmax(x[:, :4], 1).reshape(batch, 1).astype(np.int64)
+        out.append({"x": x, "label": y})
+    return out
+
+
+def run_once(args, injector, q8):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import GuardedTrainer, RetryPolicy
+    main, start, loss = build_model(args.seed)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    program = main
+    if q8:
+        from paddle_tpu.parallel import make_mesh
+        bs = fluid.BuildStrategy()
+        bs.gradient_sync = "q8"
+        program = fluid.CompiledProgram(main).with_data_parallel(
+            build_strategy=bs,
+            mesh=make_mesh({"dp": 4}, jax.devices()[:4]))
+    trainer = GuardedTrainer(
+        exe, program, loss, startup_program=start, scope=scope,
+        checkpoint_dir=tempfile.mkdtemp(prefix="chaos-ckpt-"),
+        checkpoint_every=args.checkpoint_every,
+        rollback_after=args.rollback_after,
+        retry=RetryPolicy(max_retries=args.max_retries,
+                          base_delay=args.base_delay,
+                          seed=args.seed),
+        faults=injector, sync_saves=True)
+    summary = trainer.train(make_batches(args.steps, args.seed))
+    return summary
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--nan-step", type=int, action="append",
+                    default=[], help="poison the feed at this step "
+                    "(repeatable)")
+    ap.add_argument("--transient-step", type=int, action="append",
+                    default=[], help="fail the dispatch once at this "
+                    "step (repeatable)")
+    ap.add_argument("--crash-save-step", type=int, action="append",
+                    default=[], help="kill the checkpoint writer at "
+                    "this step (repeatable)")
+    ap.add_argument("--checkpoint-every", type=int, default=2)
+    ap.add_argument("--rollback-after", type=int, default=3)
+    ap.add_argument("--max-retries", type=int, default=3)
+    ap.add_argument("--base-delay", type=float, default=0.05)
+    ap.add_argument("--q8", action="store_true",
+                    help="train through the q8 quantized collective "
+                    "on a 4-device CPU mesh")
+    ap.add_argument("--no-check", dest="check", action="store_false",
+                    help="skip the fault-free twin comparison")
+    ap.add_argument("--rtol", type=float, default=1e-2)
+    args = ap.parse_args()
+
+    from paddle_tpu.resilience import FaultInjector, TrainingAborted
+    injector = FaultInjector(seed=args.seed)
+    if args.nan_step:
+        injector.nan_grad_at(*args.nan_step)
+    for s in args.transient_step:
+        injector.transient_dispatch_at(s, times=1)
+    for s in args.crash_save_step:
+        injector.crash_save_at(s, after_files=1)
+
+    report = {"ok": False}
+    try:
+        summary = run_once(args, injector, args.q8)
+        report["chaos"] = summary
+        report["ok"] = summary["aborted"] is None
+        if args.check:
+            clean = run_once(args, None, args.q8)
+            report["fault_free_final_loss"] = clean["final_loss"]
+            a, b = summary["final_loss"], clean["final_loss"]
+            rel = abs(a - b) / max(abs(b), 1e-12)
+            report["final_loss_rel_diff"] = rel
+            report["ok"] = report["ok"] and rel <= args.rtol
+    except TrainingAborted as e:
+        report["chaos"] = e.report
+        report["aborted"] = e.reason
+    print(json.dumps(report, indent=2, default=str))
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
