@@ -772,6 +772,65 @@ def bench_deepfm(batch=4096, warmup=3, iters=100):
 
 
 # ---------------------------------------------------------------------------
+# serving: latency SLO at a fixed offered QPS
+# ---------------------------------------------------------------------------
+
+
+def bench_serving_latency(offered_qps=None, duration_s=None,
+                          max_batch=32):
+    """Serving-engine SLO row: open-loop traffic (fixed offered QPS,
+    arrivals never throttled by completions — no coordinated omission)
+    with ragged client batches against the micro-batching engine
+    (paddle_tpu/serving). Reports client-observed p50/p99 latency,
+    achieved QPS, mean batch occupancy, and the compile count (bounded
+    by the shape-bucket count regardless of traffic). Reuses
+    tools/load_gen.py so the bench row and the standalone tool can
+    never measure different things."""
+    import tempfile
+
+    import jax
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_gen
+
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    smoke = jax.devices()[0].platform == "cpu"
+    # through the dev tunnel each dispatch pays 50-1500 ms RTT, so the
+    # chip default offers far fewer arrivals than the CPU smoke run
+    offered_qps = offered_qps or _env_float(
+        "BENCH_SERVING_QPS", 200.0 if smoke else 25.0)
+    duration_s = duration_s or _env_float("BENCH_SERVING_DURATION_S",
+                                          5.0)
+    model_dir = load_gen.build_synthetic_model(
+        tempfile.mkdtemp(prefix="bench_serving_"))
+    engine = ServingEngine(model_dir, ServingConfig(
+        max_batch_size=max_batch, max_queue_wait_us=2000,
+        max_queue_size=512))
+    rng = np.random.RandomState(0)
+    make_feed = load_gen._feed_maker(engine, rng, 1, 8)
+    _log("serving: open loop %.0f qps for %.0fs"
+         % (offered_qps, duration_s))
+    client = load_gen.run_open_loop(engine, make_feed, offered_qps,
+                                    duration_s, deadline_ms=None)
+    stats = engine.stats()
+    engine.shutdown(drain=True, timeout=30)
+    lat = np.asarray(client["client_lat_ms"])
+    p50 = round(float(np.percentile(lat, 50)), 3) if lat.size else None
+    p99 = round(float(np.percentile(lat, 99)), 3) if lat.size else None
+    return {"metric": "serving_latency",
+            "value": p99, "unit": "ms p99",
+            "p50_ms": p50, "p99_ms": p99,
+            "offered_qps": offered_qps,
+            "achieved_qps": round(lat.size / duration_s, 2),
+            "mean_batch_occupancy": stats["batch_occupancy"]["mean"],
+            "compiles": stats["compiles"],
+            "rejected": stats["rejected"],
+            "completed": stats["completed"]}
+
+
+# ---------------------------------------------------------------------------
 # resilience: anomaly-guard overhead
 # ---------------------------------------------------------------------------
 
@@ -1038,6 +1097,7 @@ def child_main():
         # configs that measure in seconds. A stall in any config
         # forfeits only the ones after it.
         extra = [bench_mnist_mlp, bench_guarded_overhead,
+                 bench_serving_latency,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,
                  bench_resnet50, bench_resnet50_hostfed]

@@ -63,6 +63,7 @@ from .pyreader import DataLoader, PyReader  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import ir  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import transpiler
 from . import utils  # noqa: F401
 from .reader import batch  # noqa: F401  (paddle.batch, __init__.py:29)

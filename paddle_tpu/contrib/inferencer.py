@@ -3,7 +3,15 @@
 Reference: python/paddle/fluid/contrib/inferencer.py — builds the
 inference program from ``infer_func``, loads params saved by
 ``save_params``, and serves ``infer(inputs)`` feeds. The place /
-parallel knobs are dropped (XLA owns the device)."""
+parallel knobs are dropped (XLA owns the device).
+
+Deprecated facade, now ROUTED THROUGH AnalysisPredictor
+(``from_program``): every ``infer`` goes through the predictor's
+shared per-shape compiled-executable cache (clone-safe, first-compile
+lock-guarded) instead of a private Executor path — the facade and the
+deployment API can no longer drift apart, and an Inferencer handed to
+the serving engine batches like any other predictor.
+"""
 
 from __future__ import annotations
 
@@ -36,6 +44,12 @@ class Inferencer:
                                main_program=self.inference_program)
         self.inference_program = \
             self.inference_program.clone(for_test=True)
+        from ..inference import AnalysisPredictor
+        blk = self.inference_program.global_block()
+        feed_names = [v.name for v in blk.vars.values() if v.is_data]
+        self._predictor = AnalysisPredictor.from_program(
+            self.inference_program, feed_names,
+            [blk.var(self.predict_var.name)], self.scope)
 
     def infer(self, inputs, return_numpy=True):
         """inputs: {feed_name: ndarray} (reference
@@ -43,7 +57,5 @@ class Inferencer:
         if not isinstance(inputs, dict):
             raise ValueError(
                 "inputs should be a map of {'input_name': input_var}")
-        with scope_guard(self.scope):
-            return self.exe.run(self.inference_program, feed=inputs,
-                                fetch_list=[self.predict_var],
-                                return_numpy=return_numpy)
+        return self._predictor.predict(inputs,
+                                       return_numpy=return_numpy)

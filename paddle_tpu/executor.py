@@ -28,6 +28,7 @@ executor path.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -678,11 +679,28 @@ class Executor:
         self.place = place
         self._cache = {}
         self._run_counter = 0
+        # serving-facing compile accounting: one entry per distinct
+        # (program, feed-shape-signature) this Executor has traced.
+        # jax.jit hides its per-shape retraces inside the cached fn, so
+        # the cache key alone (names, no shapes) under-counts; the
+        # serving engine's bounded-compiles contract needs the true
+        # per-shape number (one executable per shape bucket).
+        self._compiled_sigs = set()
+        self._compile_count = 0
+        # counters/sets are mutated from concurrent predictor clones
+        # (AnalysisPredictor shares one Executor across clones); held
+        # only around bookkeeping, never across a dispatch
+        self._lock = threading.Lock()
 
     # -- public API --------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True,
-            validate_feed=True):
+            validate_feed=True, donate=True):
+        """``donate=False`` keeps persistable input buffers alive across
+        the call — required for CONCURRENT runs sharing one scope
+        (inference clones): donation invalidates the param buffers a
+        sibling thread may still be reading. Training keeps the default
+        (in-place HBM updates)."""
         program = program or framework.default_main_program()
         if getattr(program, "_is_compiled", False):
             # CompiledProgram (compiler.py) — distributed execution.
@@ -692,11 +710,20 @@ class Executor:
                                validate_feed=validate_feed)
         return self._run_impl(program, feed or {}, fetch_list or [],
                               scope or global_scope(), return_numpy,
+                              donate=donate,
                               use_program_cache=use_program_cache,
                               validate_feed=validate_feed)
 
+    @property
+    def compile_count(self):
+        """Distinct (program, feed-shape) signatures traced+compiled by
+        this Executor — the serving engine's bounded-compiles metric."""
+        return self._compile_count
+
     def close(self):
         self._cache.clear()
+        with self._lock:
+            self._compiled_sigs.clear()
 
     def run_repeated(self, program=None, feed=None, fetch_list=None,
                      iters=1, scope=None, return_numpy=True,
@@ -813,9 +840,10 @@ class Executor:
             fn = jax.jit(multi, donate_argnums=(0,))
             self._cache[cache_key] = fn
 
-        base_key = jax.random.fold_in(self._base_key(program),
-                                      self._run_counter)
-        self._run_counter += iters
+        with self._lock:
+            counter = self._run_counter
+            self._run_counter += iters
+        base_key = jax.random.fold_in(self._base_key(program), counter)
         with _profiler.RecordEvent("feed_h2d"):
             feed_vals = {k: jnp.asarray(v)
                          if not isinstance(v, jax.Array) else v
@@ -935,12 +963,27 @@ class Executor:
             _check_feed_shape_type(block, feed)
         feed_names = tuple(sorted(feed))
         # program._uid, NOT id(program) — see run_repeated's cache key
+        # donate is baked into the jitted fn (donate_argnums), so it
+        # must key the cache: a donate=False caller handed a donating
+        # executable would have its param buffers invalidated mid-call
         cache_key = (program._uid, program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
-                     library,
+                     library, donate,
                      dist._fingerprint() if dist is not None else None)
+        # per-SHAPE compile accounting: a cached jitted fn still
+        # retraces+recompiles for an unseen feed-shape signature, so
+        # the shape is part of what "compiled here" means
+        shape_sig = tuple(
+            (k, tuple(np.shape(feed[k])),
+             str(getattr(feed[k], "dtype", "")))
+            for k in feed_names)
+        with self._lock:
+            new_shape = (cache_key, shape_sig) not in self._compiled_sigs
+            if new_shape:
+                self._compiled_sigs.add((cache_key, shape_sig))
+                self._compile_count += 1
         fn = self._cache.get(cache_key) if use_program_cache else None
-        compiled_here = fn is None
+        compiled_here = fn is None or new_shape
         if fn is None:
             persistable_names = frozenset(
                 n for n, v in block.vars.items() if v.persistable)
@@ -991,9 +1034,10 @@ class Executor:
                 fn = jax.jit(step, **jit_kwargs)
             self._cache[cache_key] = fn
 
-        step_key = jax.random.fold_in(self._base_key(program),
-                                      self._run_counter)
-        self._run_counter += 1
+        with self._lock:
+            counter = self._run_counter
+            self._run_counter += 1
+        step_key = jax.random.fold_in(self._base_key(program), counter)
 
         with _profiler.RecordEvent("feed_h2d"):
             if dist is not None:

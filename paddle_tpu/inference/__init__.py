@@ -19,6 +19,7 @@ sharing the Pass/PatternDetector infrastructure (ir/).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -96,9 +97,12 @@ class PaddleTensor:
 
 
 class AnalysisPredictor:
-    """Reference: api/analysis_predictor.h:46. Thread-compatible for
-    reads; clone per thread for concurrent use (the reference's
-    Clone())."""
+    """Reference: api/analysis_predictor.h:46. Thread-safe for
+    concurrent ``predict``: clones share the loaded program, the weight
+    scope, AND one Executor (so every clone hits the same per-shape
+    compiled-executable cache); the first compile of each feed shape is
+    guarded by a per-shape gate so two threads racing the same shape
+    bucket can never compile the same executable twice."""
 
     def __init__(self, config: AnalysisConfig):
         enforce(config.model_dir,
@@ -114,6 +118,35 @@ class AnalysisPredictor:
                 params_filename=config.params_file, scope=self.scope)
         if config._ir_optim:
             self._optimize_program()
+        self._init_compile_guard()
+
+    @classmethod
+    def from_program(cls, program, feed_names, fetch_vars, scope,
+                     config: Optional[AnalysisConfig] = None,
+                     ir_optim: bool = False) -> "AnalysisPredictor":
+        """Build a predictor around an ALREADY-LOADED inference program
+        + scope (no disk round-trip) — the path contrib.Inferencer and
+        in-process serving use. ``ir_optim`` defaults off: the caller
+        owns the program and may not want its weights rewritten by the
+        fusion passes."""
+        p = cls.__new__(cls)
+        p.config = config or AnalysisConfig()
+        p.scope = scope
+        p.exe = Executor()
+        p.program = program
+        p.feed_names = list(feed_names)
+        p.fetch_vars = list(fetch_vars)
+        if ir_optim:
+            p._optimize_program()
+        p._init_compile_guard()
+        return p
+
+    def _init_compile_guard(self):
+        # shared (by reference) with every clone: the compiled-shape
+        # set, the per-shape gates, and the lock that creates gates
+        self._compiled_shapes = set()
+        self._shape_gates = {}
+        self._gate_lock = threading.Lock()
 
     def _optimize_program(self):
         """OptimizeInferenceProgram (analysis_predictor.cc:436): run
@@ -123,7 +156,48 @@ class AnalysisPredictor:
         ir.apply_passes(self.program, self.config._passes,
                         scope=self.scope)
 
+    @property
+    def signature(self) -> dict:
+        """Model I/O signature (names, dtypes, static/dynamic dims).
+        Prefers the ``__signature__.json`` sidecar written by
+        save_inference_model; models saved before the sidecar existed
+        derive the same dict live from the program declaration."""
+        sig = getattr(self.program, "_inference_signature", None)
+        if sig is None:
+            sig = _io.infer_signature(self.program, self.feed_names,
+                                      self.fetch_vars)
+        return sig
+
     # -- serving ------------------------------------------------------------
+    def _run_feed(self, feed: Dict[str, np.ndarray], return_numpy=True):
+        """One executor run with the first-compile of each feed-shape
+        signature serialized behind a per-shape gate. The steady state
+        (shape already compiled) takes no lock at all; only the two
+        threads racing an UNSEEN shape serialize, and the loser finds
+        the executable cached instead of compiling its own. ``donate``
+        is off: concurrent runs share the weight scope, and donation
+        would invalidate param buffers a sibling thread still reads."""
+        fetch = [v.name for v in self.fetch_vars]
+
+        def run():
+            return self.exe.run(self.program, feed=feed,
+                                fetch_list=fetch, scope=self.scope,
+                                return_numpy=return_numpy,
+                                donate=False)
+
+        key = tuple(sorted((k, tuple(np.shape(v)))
+                           for k, v in feed.items()))
+        if key not in self._compiled_shapes:
+            with self._gate_lock:
+                gate = self._shape_gates.setdefault(key,
+                                                    threading.Lock())
+            with gate:
+                if key not in self._compiled_shapes:
+                    outs = run()
+                    self._compiled_shapes.add(key)
+                    return outs
+        return run()
+
     def run(self, inputs: Sequence) -> List[PaddleTensor]:
         """Positional inputs in feed_names order (reference
         AnalysisPredictor::Run, analysis_predictor.cc:196)."""
@@ -134,35 +208,33 @@ class AnalysisPredictor:
         for name, t in zip(self.feed_names, inputs):
             feed[name] = t.data if isinstance(t, PaddleTensor) \
                 else np.asarray(t)
-        outs = self.exe.run(self.program, feed=feed,
-                            fetch_list=[v.name for v in
-                                        self.fetch_vars],
-                            scope=self.scope)
+        outs = self._run_feed(feed)
         return [PaddleTensor(o, v.name)
                 for o, v in zip(outs, self.fetch_vars)]
 
-    def predict(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+    def predict(self, feed: Dict[str, np.ndarray],
+                return_numpy=True) -> List[np.ndarray]:
         """Dict-feed convenience (not in the reference C API)."""
-        outs = self.exe.run(self.program, feed=feed,
-                            fetch_list=[v.name for v in
-                                        self.fetch_vars],
-                            scope=self.scope)
-        return list(outs)
+        return list(self._run_feed(feed, return_numpy=return_numpy))
 
     def clone(self) -> "AnalysisPredictor":
-        """Per-thread clone SHARING the loaded program and the weight
-        scope (reference: analysis_predictor.cc Clone shares the
-        program; weights are read-only at inference) — no disk reload,
-        no re-run of the ir passes; each clone gets its own Executor
-        (whose compiled-computation cache is keyed by program version
-        + feed signature, so clones also share compilations)."""
+        """Per-thread clone SHARING the loaded program, the weight
+        scope, and the Executor (reference: analysis_predictor.cc
+        Clone shares the program; weights are read-only at inference)
+        — no disk reload, no re-run of the ir passes, and one
+        per-shape compiled-executable cache across all clones. The
+        shared compile guard makes concurrent first-compiles of the
+        same shape happen exactly once."""
         c = AnalysisPredictor.__new__(AnalysisPredictor)
         c.config = self.config
         c.scope = self.scope
-        c.exe = Executor()
+        c.exe = self.exe
         c.program = self.program
         c.feed_names = list(self.feed_names)
         c.fetch_vars = list(self.fetch_vars)
+        c._compiled_shapes = self._compiled_shapes
+        c._shape_gates = self._shape_gates
+        c._gate_lock = self._gate_lock
         return c
 
     def get_input_names(self):

@@ -13,6 +13,7 @@ the compiled step program.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import shutil
@@ -29,7 +30,8 @@ from .framework import Parameter, Program, Variable, default_main_program
 
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "get_program_persistable_vars"]
+           "load_inference_model", "get_program_persistable_vars",
+           "infer_signature"]
 
 _TENSOR_MAGIC = b"PTPU"
 _TENSOR_VERSION = 1
@@ -240,6 +242,41 @@ def load_persistables(executor=None, dirname=None, main_program=None,
 # inference model
 # ---------------------------------------------------------------------------
 
+SIGNATURE_FILENAME = "__signature__.json"
+
+
+def infer_signature(program, feed_names, fetch_vars):
+    """Model I/O signature: per-tensor name, dtype, and per-dim
+    static/dynamic sizes (-1 = bound at trace time, by convention the
+    batch dim). Saved as a human-readable sidecar next to ``__model__``
+    so a serving layer can derive warmup shape buckets without user
+    hints; also derivable live from any loaded program (old models
+    without the sidecar lose nothing)."""
+    blk = program.global_block()
+
+    def entry(v):
+        dims = [int(d) for d in v.shape]
+        return {"name": v.name, "dtype": str(v.dtype), "shape": dims,
+                "dynamic_dims": [i for i, d in enumerate(dims)
+                                 if d == -1]}
+
+    inputs = []
+    for n in feed_names:
+        v = blk.vars.get(n)
+        if v is None:
+            # a feed name the inference prune dropped (declared for
+            # training, unused by the served targets) — legal in the
+            # reference's save path, so the signature records it
+            # shape-less instead of failing the save
+            inputs.append({"name": n, "dtype": None, "shape": None,
+                           "dynamic_dims": []})
+        else:
+            inputs.append(entry(v))
+    outputs = [entry(blk.vars[t.name if isinstance(t, Variable) else t])
+               for t in fetch_vars]
+    return {"version": 1, "inputs": inputs, "outputs": outputs}
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars,
                          executor=None, main_program=None,
                          model_filename=None, params_filename=None,
@@ -260,6 +297,12 @@ def save_inference_model(dirname, feeded_var_names, target_vars,
     model_path = os.path.join(dirname, model_filename or "__model__")
     with open(model_path, "wb") as f:
         pickle.dump(desc, f, protocol=4)
+    # signature sidecar (input names/dtypes/static-vs-dynamic dims):
+    # lets a serving engine pre-compile its shape buckets at load
+    # without user hints; readers tolerate its absence (old models)
+    sig = infer_signature(inf_prog, list(feeded_var_names), target_names)
+    with open(os.path.join(dirname, SIGNATURE_FILENAME), "w") as f:
+        json.dump(sig, f, indent=1, sort_keys=True)
     save_persistables(executor, dirname, inf_prog,
                       filename=params_filename, scope=scope)
     return target_names
@@ -279,6 +322,19 @@ def load_inference_model(dirname, executor=None, model_filename=None,
                       filename=params_filename, scope=scope)
     blk = program.global_block()
     fetch_vars = [blk.var(n) for n in desc["fetch_names"]]
+    # surface the signature sidecar when present; a missing or corrupt
+    # sidecar must never fail an otherwise-loadable model (pre-sidecar
+    # models), so consumers re-derive from the program declaration
+    program._inference_signature = None
+    sig_path = os.path.join(dirname, SIGNATURE_FILENAME)
+    if os.path.exists(sig_path):
+        try:
+            with open(sig_path) as f:
+                program._inference_signature = json.load(f)
+        except (OSError, ValueError):
+            import warnings
+            warnings.warn("ignoring unreadable signature sidecar %s"
+                          % sig_path)
     return program, desc["feed_names"], fetch_vars
 
 

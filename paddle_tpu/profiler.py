@@ -50,6 +50,7 @@ class _Event:
     end: float
     thread: int
     depth: int
+    args: Optional[dict] = None
 
     @property
     def dur(self):
@@ -65,10 +66,13 @@ def _stack():
 class RecordEvent:
     """RAII span (reference: platform/profiler.h:81). Usable as a
     context manager or via ``record_event``. No-op unless the profiler
-    is enabled — cheap enough to leave in hot paths."""
+    is enabled — cheap enough to leave in hot paths. ``args`` (a small
+    JSON-able dict, e.g. the serving engine's batch bucket/occupancy)
+    rides into the chrome-trace span's args panel."""
 
-    def __init__(self, name):
+    def __init__(self, name, args=None):
         self.name = name
+        self.args = args
         self._t0 = None
 
     def __enter__(self):
@@ -84,7 +88,8 @@ class RecordEvent:
             depth = len(stack) - 1
             stack.pop()
             ev = _Event(name=self.name, start=self._t0, end=end,
-                        thread=threading.get_ident(), depth=depth)
+                        thread=threading.get_ident(), depth=depth,
+                        args=self.args)
             with _lock:
                 _events.append(ev)
         return False
@@ -267,7 +272,7 @@ def export_chrome_tracing(path):
         {"name": ev.name, "cat": "host", "ph": "X",
          "ts": (ev.start - base) * 1e6, "dur": ev.dur * 1e6,
          "pid": 0, "tid": ev.thread % 10000,
-         "args": {"depth": ev.depth}}
+         "args": dict({"depth": ev.depth}, **(ev.args or {}))}
         for ev in events]
     tids = {}
     for ev in dev:

@@ -1,0 +1,21 @@
+"""Serving engine: dynamic micro-batching, shape buckets, backpressure,
+and latency SLO metrics over the AnalysisPredictor.
+
+The deploy-side subsystem matching PR1/PR2's train-side ones: the
+reference's serving story (AnalysisPredictor + Paddle Serving) amortizes
+one process per model; the TPU-native redesign amortizes one COMPILED
+EXECUTABLE PER SHAPE BUCKET across every concurrent client — see
+``engine.py`` (batching/admission/lifecycle), ``buckets.py`` (pow-2
+bucket math), ``metrics.py`` (SLO accumulators), ``docs/serving.md``.
+"""
+
+from .buckets import bucket_for, bucket_sizes, pad_batch  # noqa: F401
+from .engine import (BatcherDied, DeadlineExceeded,  # noqa: F401
+                     EngineStopped, InvalidRequest, ServerOverloaded,
+                     ServingConfig, ServingEngine, ServingError)
+from .metrics import EngineStats  # noqa: F401
+
+__all__ = ["ServingEngine", "ServingConfig", "ServingError",
+           "ServerOverloaded", "DeadlineExceeded", "EngineStopped",
+           "BatcherDied", "InvalidRequest", "EngineStats",
+           "bucket_sizes", "bucket_for", "pad_batch"]

@@ -1,0 +1,68 @@
+"""Shape-bucket math for the serving engine.
+
+The scarce resource on a compiled-executable backend is COMPILED-SHAPE
+CARDINALITY, not bytes (EQuARX-style transport thinking applied to
+serving, PAPERS.md arXiv:2506.17615): every distinct device batch size
+is one more XLA executable, one more cold-compile stall, and one more
+resident program in HBM. Padding every device batch up to a power of
+two caps the executable count at ``ceil(log2(max_batch)) + 1`` no
+matter how ragged client batch sizes are — 100 distinct client sizes
+in [1, 64] hit at most the 7 buckets [1, 2, 4, 8, 16, 32, 64], all
+pre-compilable by a warmup pass at model load.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+
+__all__ = ["bucket_sizes", "bucket_for", "pad_batch"]
+
+
+def bucket_sizes(max_batch_size: int) -> List[int]:
+    """Powers of two up to (and always including) ``max_batch_size``:
+    64 -> [1, 2, 4, 8, 16, 32, 64]; a non-power-of-two cap becomes the
+    last bucket (48 -> [1, 2, 4, 8, 16, 32, 48])."""
+    enforce(int(max_batch_size) >= 1,
+            "max_batch_size must be >= 1, got %s" % max_batch_size)
+    max_batch_size = int(max_batch_size)
+    sizes = []
+    b = 1
+    while b < max_batch_size:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch_size)
+    return sizes
+
+
+def bucket_for(rows: int, sizes: Sequence[int]) -> int:
+    """Smallest bucket that holds ``rows``."""
+    for s in sizes:
+        if rows <= s:
+            return s
+    raise InvalidArgumentError(
+        "batch of %d rows exceeds the largest bucket (%d)"
+        % (rows, sizes[-1]))
+
+
+def pad_batch(feed: Dict[str, np.ndarray], rows: int,
+              bucket: int) -> Dict[str, np.ndarray]:
+    """Pad every input's leading (batch) axis from ``rows`` up to
+    ``bucket`` with zeros. Zero is always shape/dtype-valid (and a
+    legal id-0 row for integer lookup inputs); the padded rows' outputs
+    are sliced away before results reach any caller, so their values
+    never escape. Per-row-independent inference graphs (everything a
+    ``clone(for_test=True)`` program contains — batch_norm uses saved
+    stats at inference) make the live rows bit-identical to an unpadded
+    run."""
+    if rows == bucket:
+        return feed
+    out = {}
+    for name, arr in feed.items():
+        arr = np.asarray(arr)
+        pad = np.zeros((bucket - rows,) + arr.shape[1:], arr.dtype)
+        out[name] = np.concatenate([arr, pad], axis=0)
+    return out

@@ -1,0 +1,556 @@
+"""In-process serving engine: dynamic micro-batching over the
+AnalysisPredictor.
+
+Reference deployment path: AnalysisPredictor + Paddle Serving (the
+reference serves one request per Run call on a private scope;
+concurrency = clone-per-thread). TPU-native redesign: the expensive
+resource is the COMPILED EXECUTABLE, not a thread — so the engine owns
+one batcher thread per model that coalesces concurrent ``infer`` calls
+into one device batch under a ``(max_batch_size, max_queue_wait_us)``
+policy, pads the batch up to a power-of-two shape bucket (buckets.py:
+ragged client sizes hit <= log2(max_batch)+1 executables, all
+pre-compiled by a warmup pass at load), dispatches through the
+predictor's shared per-shape compile cache, and splits/unpads results
+back to each caller bit-exactly.
+
+Admission control: a bounded queue rejects with a structured
+``ServerOverloaded`` instead of queueing unboundedly (backpressure the
+client can act on), and per-request deadlines expire queued work with
+``DeadlineExceeded`` before it wastes a device dispatch. Shutdown
+drains gracefully; a batcher thread killed by an unexpected error
+fails every queued future with a structured ``BatcherDied`` instead of
+hanging its clients. ``engine.stats()`` surfaces the SLO metrics
+(p50/p95/p99 latency, queue depth, batch-occupancy histogram, QPS,
+compile count), and every dispatch is a profiler ``RecordEvent`` span
+(with bucket/rows args) so serving shows up in the chrome trace.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import profiler as _profiler
+from ..inference import AnalysisConfig, AnalysisPredictor
+from .buckets import bucket_for, bucket_sizes, pad_batch
+from .metrics import EngineStats
+
+__all__ = ["ServingConfig", "ServingEngine", "ServingError",
+           "ServerOverloaded", "DeadlineExceeded", "EngineStopped",
+           "BatcherDied", "InvalidRequest"]
+
+
+# ---------------------------------------------------------------------------
+# structured errors
+# ---------------------------------------------------------------------------
+
+class ServingError(Exception):
+    """Base of every engine-raised error: ``code`` (stable string a
+    client can switch on) + ``details`` (JSON-able context)."""
+
+    code = "SERVING_ERROR"
+
+    def __init__(self, message, **details):
+        super().__init__(message)
+        self.details = details
+
+    def to_dict(self):
+        return {"code": self.code, "message": str(self),
+                "details": self.details}
+
+
+class ServerOverloaded(ServingError):
+    """Admission rejected: the bounded queue is full. Backpressure —
+    retry with backoff or shed load upstream."""
+    code = "SERVER_OVERLOADED"
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before it reached the device."""
+    code = "DEADLINE_EXCEEDED"
+
+
+class EngineStopped(ServingError):
+    """The engine is shut down (or shutting down) for this model."""
+    code = "ENGINE_STOPPED"
+
+
+class BatcherDied(ServingError):
+    """The batcher thread died on an unexpected error; queued and
+    in-flight requests are failed with this instead of hanging."""
+    code = "BATCHER_DIED"
+
+
+class InvalidRequest(ServingError):
+    """Malformed feed (wrong inputs, ragged leading dims, oversize)."""
+    code = "INVALID_REQUEST"
+
+
+# ---------------------------------------------------------------------------
+# config + request
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServingConfig:
+    """Batching/admission policy for one served model.
+
+    - ``max_batch_size``: device batch cap = largest shape bucket.
+    - ``max_queue_wait_us``: how long the batcher holds an open batch
+      for more requests before dispatching it (the latency the engine
+      spends buying occupancy).
+    - ``max_queue_size``: admission bound (requests, not rows); a full
+      queue rejects with ServerOverloaded.
+    - ``default_deadline_ms``: applied to requests that don't carry
+      their own; None = no deadline.
+    - ``warmup``: pre-compile every bucket at load so no client request
+      ever pays a cold XLA compile.
+    - ``latency_window``: ring size for percentile/QPS estimation.
+    """
+
+    max_batch_size: int = 64
+    max_queue_wait_us: int = 2000
+    max_queue_size: int = 256
+    default_deadline_ms: Optional[float] = None
+    warmup: bool = True
+    latency_window: int = 4096
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "future", "t_enqueue", "deadline")
+
+    def __init__(self, feed, rows, deadline):
+        self.feed = feed
+        self.rows = rows
+        self.future = Future()
+        self.t_enqueue = time.monotonic()
+        self.deadline = deadline  # monotonic seconds, or None
+
+
+# ---------------------------------------------------------------------------
+# per-model worker
+# ---------------------------------------------------------------------------
+
+class _ModelWorker:
+    """Queue + batcher thread + stats for one loaded model."""
+
+    def __init__(self, name: str, predictor: AnalysisPredictor,
+                 config: ServingConfig):
+        self.name = name
+        self.predictor = predictor
+        self.config = config
+        self.buckets = bucket_sizes(config.max_batch_size)
+        # admission-time spec per input: (declared dtype | None,
+        # trailing-dims template | None, -1 free). Feeds are NORMALIZED
+        # to the declared dtype and shape-checked at submit — one
+        # client's float64 array or wrong trailing dim must get ITS
+        # OWN InvalidRequest, not promote/poison the whole coalesced
+        # batch (dtype promotion would also mint fresh compile
+        # signatures, unbounding the bucket-compiles guarantee).
+        self._input_spec = {}
+        for inp in predictor.signature["inputs"]:
+            dt = np.dtype(inp["dtype"]) if inp["dtype"] else None
+            tail = None
+            if inp["shape"] is not None:
+                dims = list(inp["shape"])
+                if inp["dynamic_dims"] == [0]:
+                    tail = dims[1:]
+                elif not inp["dynamic_dims"]:
+                    tail = dims  # batch-less decl: feed adds dim 0
+            self._input_spec[inp["name"]] = (dt, tail)
+        self.stats = EngineStats(window=config.latency_window)
+        self._queue = []  # FIFO of _Request
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._drain = True
+        self._dead_error: Optional[BatcherDied] = None
+        self._inflight: List[_Request] = []
+        # test seam: called (worker, batch) at the top of every
+        # dispatch — chaos tests block it (to hold the queue) or raise
+        # through it (to simulate a dying batcher thread)
+        self._dispatch_hook = None
+        self._compile_base = predictor.exe.compile_count
+        self.warmed_buckets: List[int] = []
+        if config.warmup:
+            self._warmup()
+        self._thread = threading.Thread(
+            target=self._batcher_loop, daemon=True,
+            name="serving-batcher-%s" % name)
+        self._thread.start()
+
+    # -- warmup --------------------------------------------------------
+    def _warmup_feed(self, batch: int) -> Optional[Dict[str, np.ndarray]]:
+        """Zero feed with every dynamic batch dim bound to ``batch``,
+        derived from the model signature (sidecar or live program
+        declaration). None when any NON-batch dim is dynamic — that
+        shape can't be guessed, so its bucket compiles lazily."""
+        feed = {}
+        for inp in self.predictor.signature["inputs"]:
+            if inp["shape"] is None:  # pruned/shape-less feed decl
+                return None
+            dims = list(inp["shape"])
+            dyn = inp["dynamic_dims"]
+            if not dims or not dyn:
+                # batch-less declaration (append_batch_size=False):
+                # the executor's feed convention prepends the batch dim
+                dims = [batch] + dims
+            elif dyn == [0]:
+                dims[0] = batch
+            else:
+                return None
+            feed[inp["name"]] = np.zeros(dims, np.dtype(inp["dtype"]))
+        return feed
+
+    def _warmup(self):
+        """Pre-compile one executable per bucket, smallest first, so
+        no client request ever pays a cold XLA compile."""
+        for b in self.buckets:
+            feed = self._warmup_feed(b)
+            if feed is None:
+                return
+            with _profiler.RecordEvent(
+                    "serving_warmup_compile",
+                    args={"model": self.name, "bucket": b}):
+                self.predictor.predict(feed)
+            self.warmed_buckets.append(b)
+
+    # -- client side ---------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray],
+               deadline_ms: Optional[float] = None) -> Future:
+        if self._dead_error is not None:
+            raise self._dead_error
+        feed, rows = self._validate(feed)
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(feed, rows, deadline)
+        with self._cond:
+            if self._stopped:
+                raise EngineStopped("model %r is shut down" % self.name,
+                                    model=self.name)
+            if len(self._queue) >= self.config.max_queue_size:
+                self.stats.count("rejected")
+                raise ServerOverloaded(
+                    "queue full for model %r (%d queued)"
+                    % (self.name, len(self._queue)),
+                    model=self.name, queue_depth=len(self._queue),
+                    max_queue_size=self.config.max_queue_size)
+            self._queue.append(req)
+            self._cond.notify()
+        return req.future
+
+    def _validate(self, feed):
+        want = set(self.predictor.feed_names)
+        got = set(feed)
+        if want != got:
+            raise InvalidRequest(
+                "model %r expects inputs %s, got %s"
+                % (self.name, sorted(want), sorted(got)),
+                model=self.name)
+        arrs = {}
+        for k, v in feed.items():
+            arr = np.asarray(v)
+            dt, tail = self._input_spec.get(k, (None, None))
+            if dt is not None and arr.dtype != dt:
+                if not np.can_cast(arr.dtype, dt,
+                                   casting="same_kind"):
+                    raise InvalidRequest(
+                        "input %r has dtype %s, model declares %s"
+                        % (k, arr.dtype, dt), model=self.name)
+                # normalize to the declared dtype: exactly what the
+                # compiled executable computes in; also what keeps a
+                # float64 client from promoting its batchmates
+                arr = arr.astype(dt)
+            if tail is not None:
+                got = list(arr.shape[1:])
+                want = tail
+                if len(got) != len(want) or any(
+                        w != -1 and w != g
+                        for w, g in zip(want, got)):
+                    raise InvalidRequest(
+                        "input %r has per-row shape %s, model "
+                        "declares %s (-1 free)" % (k, got, want),
+                        model=self.name)
+            arrs[k] = arr
+        rows = {k: (v.shape[0] if v.ndim else 0)
+                for k, v in arrs.items()}
+        nrows = set(rows.values())
+        if len(nrows) != 1 or 0 in nrows:
+            raise InvalidRequest(
+                "inputs must share one non-empty leading batch dim, "
+                "got %s" % rows, model=self.name)
+        (n,) = nrows
+        if n > self.config.max_batch_size:
+            raise InvalidRequest(
+                "request batch %d exceeds max_batch_size %d — split "
+                "it client-side" % (n, self.config.max_batch_size),
+                model=self.name, rows=n,
+                max_batch_size=self.config.max_batch_size)
+        return arrs, int(n)
+
+    # -- batcher side --------------------------------------------------
+    @staticmethod
+    def _safe_resolve(fut, value=None, exc=None):
+        """Resolve a future the CLIENT may have cancelled concurrently:
+        set_result/set_exception on a cancelled (or raced) future
+        raises InvalidStateError, and an escaping raise here would kill
+        the batcher thread for everyone."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except Exception:
+            pass
+
+    def _expire(self, req):
+        self.stats.count("expired")
+        self._safe_resolve(req.future, exc=DeadlineExceeded(
+            "request expired after %.1f ms in queue"
+            % ((time.monotonic() - req.t_enqueue) * 1e3),
+            model=self.name))
+
+    def _pop_live(self):
+        """Pop the queue head, expiring dead and skipping
+        client-cancelled requests on the way. Caller holds the
+        condition lock."""
+        while self._queue:
+            req = self._queue.pop(0)
+            if req.future.cancelled():
+                continue
+            if req.deadline is not None \
+                    and time.monotonic() > req.deadline:
+                self._expire(req)
+                continue
+            return req
+        return None
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Block until a batch is ready: first request opens the
+        batch; it closes when full, when ``max_queue_wait_us`` passes,
+        or immediately while draining. Returns None to exit (stopped
+        and drained). Plain FIFO: a head that would overflow the
+        current batch closes it and opens the next one."""
+        cfg = self.config
+        with self._cond:
+            first = None
+            while first is None:
+                first = self._pop_live()
+                if first is None:
+                    if self._stopped:
+                        return None
+                    self._cond.wait(0.1)
+            batch, rows = [first], first.rows
+            close_at = time.monotonic() + cfg.max_queue_wait_us / 1e6
+            while rows < cfg.max_batch_size:
+                if self._queue:
+                    nxt = self._queue[0]
+                    if nxt.future.cancelled():
+                        self._queue.pop(0)
+                        continue
+                    if nxt.deadline is not None \
+                            and time.monotonic() > nxt.deadline:
+                        # expire ONLY the head (popping via _pop_live
+                        # here would pop-and-drop the next live
+                        # request behind it)
+                        self._expire(self._queue.pop(0))
+                        continue
+                    if rows + nxt.rows > cfg.max_batch_size:
+                        break
+                    self._queue.pop(0)
+                    batch.append(nxt)
+                    rows += nxt.rows
+                    continue
+                now = time.monotonic()
+                if now >= close_at or self._stopped:
+                    break
+                self._cond.wait(min(close_at - now, 0.01))
+            return batch
+
+    def _dispatch(self, batch: List[_Request]):
+        rows = sum(r.rows for r in batch)
+        bucket = bucket_for(rows, self.buckets)
+        joined = {}
+        for name in self.predictor.feed_names:
+            parts = [r.feed[name] for r in batch]
+            joined[name] = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts, axis=0)
+        joined = pad_batch(joined, rows, bucket)
+        try:
+            with _profiler.RecordEvent(
+                    "serving_dispatch",
+                    args={"model": self.name, "bucket": bucket,
+                          "rows": rows, "requests": len(batch)}):
+                if self._dispatch_hook is not None:
+                    # test seam inside the per-batch guard: an
+                    # Exception it raises is a batch failure (engine
+                    # survives); a BaseException simulates a dying
+                    # batcher thread and escapes to _die
+                    self._dispatch_hook(self, batch)
+                outs = self.predictor.predict(joined)
+        except Exception as e:  # per-batch failure; engine survives
+            self.stats.count("failed", len(batch))
+            for r in batch:
+                self._safe_resolve(r.future, exc=e)
+            return
+        self.stats.record_batch(rows, bucket)
+        done = time.monotonic()
+        off = 0
+        for r in batch:
+            # observability: the device shape this request actually
+            # executed at (readable after result()) — the engine's
+            # bit-exactness contract is "equal to a single-request
+            # predict padded to THIS bucket"; see docs/serving.md
+            r.future.bucket = bucket
+            self._safe_resolve(r.future,
+                               [np.asarray(o)[off:off + r.rows]
+                                for o in outs])
+            off += r.rows
+            self.stats.record_request(done - r.t_enqueue, t_done=done)
+
+    def _batcher_loop(self):
+        try:
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    return
+                # NOT try/finally: on an escaping BaseException the
+                # batch must STAY in _inflight so _die can fail its
+                # futures (_dispatch resolves every future on both its
+                # success and its per-batch failure paths)
+                self._inflight = batch
+                self._dispatch(batch)
+                self._inflight = []
+        except BaseException as e:  # noqa: B036 — a dying batcher
+            # must fail its clients, whatever killed it
+            self._die(e)
+
+    def _die(self, exc):
+        err = BatcherDied(
+            "batcher thread for model %r died: %r" % (self.name, exc),
+            model=self.name, cause=repr(exc))
+        self._dead_error = err
+        with self._cond:
+            self._stopped = True
+            pending = self._inflight + self._queue
+            self._inflight, self._queue = [], []
+            self._cond.notify_all()
+        self.stats.count("failed", len(pending))
+        for r in pending:
+            self._safe_resolve(r.future, exc=err)
+
+    # -- lifecycle -----------------------------------------------------
+    def shutdown(self, drain=True, timeout: Optional[float] = None):
+        with self._cond:
+            self._stopped = True
+            pending = [] if drain else list(self._queue)
+            if not drain:
+                self._queue = []
+            self._cond.notify_all()
+        for r in pending:
+            self._safe_resolve(r.future, exc=EngineStopped(
+                "model %r shut down without draining" % self.name,
+                model=self.name))
+        self._thread.join(timeout)
+
+    def snapshot(self) -> dict:
+        s = self.stats.snapshot()
+        with self._cond:
+            s["queue_depth"] = len(self._queue)
+        s["model"] = self.name
+        s["buckets"] = list(self.buckets)
+        s["warmed_buckets"] = list(self.warmed_buckets)
+        s["compiles"] = (self.predictor.exe.compile_count
+                         - self._compile_base)
+        return s
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class ServingEngine:
+    """Hosts one or more loaded inference models, each behind its own
+    micro-batching worker. ``infer`` returns a Future; ``infer_sync``
+    blocks. Usable as a context manager (drains on exit)."""
+
+    def __init__(self, model=None, config: Optional[ServingConfig] = None,
+                 name: str = "default"):
+        self._workers: Dict[str, _ModelWorker] = {}
+        self._default: Optional[str] = None
+        self._config = config
+        if model is not None:
+            self.add_model(name, model, config)
+
+    def add_model(self, name: str, model,
+                  config: Optional[ServingConfig] = None):
+        """``model``: an AnalysisPredictor, or a save_inference_model
+        directory (loaded through AnalysisConfig with default passes).
+        Returns self for chaining."""
+        if name in self._workers:
+            raise InvalidRequest("model %r already added" % name,
+                                 model=name)
+        if not isinstance(model, AnalysisPredictor):
+            model = AnalysisPredictor(AnalysisConfig(str(model)))
+        self._workers[name] = _ModelWorker(
+            name, model, config or self._config or ServingConfig())
+        if self._default is None:
+            self._default = name
+        return self
+
+    def _worker(self, model: Optional[str]) -> _ModelWorker:
+        name = model or self._default
+        if name is None or name not in self._workers:
+            raise InvalidRequest("no model %r loaded (have %s)"
+                                 % (name, sorted(self._workers)),
+                                 model=name)
+        return self._workers[name]
+
+    # -- serving -------------------------------------------------------
+    def infer(self, feed: Dict[str, np.ndarray],
+              model: Optional[str] = None,
+              deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request (all inputs share a leading batch dim);
+        resolves to the per-output list of np arrays for exactly this
+        request's rows. Raises ServerOverloaded/EngineStopped/
+        InvalidRequest synchronously; DeadlineExceeded/BatcherDied
+        surface through the Future."""
+        return self._worker(model).submit(feed, deadline_ms=deadline_ms)
+
+    def infer_sync(self, feed, model=None, deadline_ms=None,
+                   timeout: Optional[float] = None):
+        return self.infer(feed, model=model,
+                          deadline_ms=deadline_ms).result(timeout)
+
+    # -- introspection -------------------------------------------------
+    def stats(self, model: Optional[str] = None) -> dict:
+        """SLO snapshot. Single-model engines return that model's dict
+        directly; multi-model engines return {"models": {name: dict}}
+        unless ``model`` picks one."""
+        if model is not None or len(self._workers) == 1:
+            return self._worker(model).snapshot()
+        return {"models": {n: w.snapshot()
+                           for n, w in self._workers.items()}}
+
+    def models(self):
+        return sorted(self._workers)
+
+    # -- lifecycle -----------------------------------------------------
+    def shutdown(self, drain=True, timeout: Optional[float] = None):
+        """Stop accepting work. ``drain=True`` serves everything
+        already queued first; ``drain=False`` fails queued futures
+        with EngineStopped."""
+        for w in self._workers.values():
+            w.shutdown(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+        return False

@@ -1,0 +1,93 @@
+"""Serving SLO metrics: latency percentiles, queue depth, batch
+occupancy, QPS, compile count.
+
+The host-side accumulator twin of ``profiler.py``'s event spans: the
+engine records one latency sample per completed request and one
+occupancy sample per dispatched device batch; ``snapshot()`` reduces
+them into the SLO dict ``engine.stats()`` returns. Bounded memory: the
+latency/qps window is a ring buffer, occupancy aggregates into a
+per-bucket histogram.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["EngineStats"]
+
+
+class EngineStats:
+    """Thread-safe metric accumulator for one served model."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        # (t_done, latency_seconds) ring; t_done drives windowed QPS
+        self._lat = collections.deque(maxlen=int(window))
+        self._bucket_hist = collections.Counter()
+        self._occ_rows = 0        # live rows dispatched
+        self._occ_capacity = 0    # sum of bucket sizes dispatched
+        self.completed = 0
+        self.rejected = 0         # ServerOverloaded admissions
+        self.expired = 0          # deadline passed before dispatch
+        self.failed = 0           # dispatch raised / batcher died
+        self.batches = 0
+        self.started_at = time.monotonic()
+
+    # -- recording -----------------------------------------------------
+    def record_request(self, latency_s: float,
+                       t_done: Optional[float] = None):
+        with self._lock:
+            self.completed += 1
+            self._lat.append((t_done if t_done is not None
+                              else time.monotonic(), latency_s))
+
+    def record_batch(self, rows: int, bucket: int):
+        with self._lock:
+            self.batches += 1
+            self._bucket_hist[int(bucket)] += 1
+            self._occ_rows += int(rows)
+            self._occ_capacity += int(bucket)
+
+    def count(self, field: str, n: int = 1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    # -- reducing ------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = list(self._lat)
+            hist = dict(self._bucket_hist)
+            occ_rows, occ_cap = self._occ_rows, self._occ_capacity
+            completed, rejected = self.completed, self.rejected
+            expired, failed = self.expired, self.failed
+            batches = self.batches
+        ms = np.asarray([l * 1e3 for _, l in lat])
+        if ms.size:
+            p50, p95, p99 = (float(np.percentile(ms, q))
+                             for q in (50, 95, 99))
+        else:
+            p50 = p95 = p99 = None
+        # windowed QPS over the ring's completion timestamps; a single
+        # sample (or none) has no window to rate over
+        if len(lat) >= 2:
+            span = lat[-1][0] - lat[0][0]
+            qps = round((len(lat) - 1) / span, 2) if span > 0 else None
+        else:
+            qps = None
+        return {
+            "completed": completed, "rejected": rejected,
+            "expired": expired, "failed": failed, "batches": batches,
+            "p50_ms": round(p50, 3) if p50 is not None else None,
+            "p95_ms": round(p95, 3) if p95 is not None else None,
+            "p99_ms": round(p99, 3) if p99 is not None else None,
+            "qps": qps,
+            "batch_occupancy": {
+                "mean": round(occ_rows / occ_cap, 4) if occ_cap else None,
+                "hist": hist,
+            },
+        }

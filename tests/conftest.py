@@ -45,6 +45,12 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic fault-injection test (tier-1; select "
         "alone with -m chaos)")
+    # serving-engine suite (paddle_tpu/serving): in-process, CPU-fast,
+    # runs inside tier-1; select alone with -m serving
+    config.addinivalue_line(
+        "markers",
+        "serving: serving-engine test (tier-1; select alone with "
+        "-m serving)")
 
 
 @pytest.fixture(autouse=True)

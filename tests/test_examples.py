@@ -29,6 +29,27 @@ def test_train_mnist_then_deploy(tmp_path):
     d = _run("deploy_inference.py", [model_dir])
     assert d.returncode == 0, d.stderr[-2000:]
     assert "clone agrees" in d.stdout
+    # same saved model through the micro-batching serving engine
+    s = _run("deploy_serving.py", [model_dir])
+    assert s.returncode == 0, s.stderr[-2000:]
+    assert "serving engine agrees" in s.stdout
+    assert "bounded compiles" in s.stdout
+
+
+def test_load_gen_smoke():
+    import json
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "load_gen.py"),
+         "--synthetic", "--mode", "open", "--qps", "80",
+         "--duration", "1.5", "--max-batch", "8"],
+        env=dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["metric"] == "serving_load_gen"
+    assert report["completed"] > 0 and report["p99_ms"] is not None
+    assert report["engine"]["compiles"] <= 4
 
 
 def test_train_transformer_small():

@@ -1,0 +1,620 @@
+"""Serving-engine tests: micro-batching correctness (bit-equal to the
+unbatched predictor under concurrent ragged traffic), bounded compiles
+via shape buckets, admission control, deadlines, graceful drain, chaos
+(batcher death fails futures with a structured error instead of
+hanging), clone first-compile race, and the signature sidecar."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+from paddle_tpu.serving import (BatcherDied, DeadlineExceeded,
+                                EngineStopped, InvalidRequest,
+                                ServerOverloaded, ServingConfig,
+                                ServingEngine, bucket_for, bucket_sizes)
+
+pytestmark = pytest.mark.serving
+
+
+def _save_mlp_model(tmp_path, seed=7, in_dim=16, out_dim=4):
+    """Tiny MLP inference model on disk; returns its dir."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[in_dim], dtype="float32")
+        h = layers.fc(x, size=32, act="relu")
+        pred = layers.fc(h, size=out_dim, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main, scope=scope)
+    return d
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """ONE saved model shared by the whole module (read-only for every
+    consumer) — rebuilding/saving it per test dominated the suite's
+    runtime without adding coverage."""
+    return _save_mlp_model(tmp_path_factory.mktemp("serving"))
+
+
+def _engine(model_dir, **kw):
+    kw.setdefault("max_batch_size", 16)
+    kw.setdefault("max_queue_wait_us", 2000)
+    return ServingEngine(model_dir, ServingConfig(**kw))
+
+
+class TestBuckets:
+    def test_bucket_math(self):
+        assert bucket_sizes(64) == [1, 2, 4, 8, 16, 32, 64]
+        assert bucket_sizes(1) == [1]
+        assert bucket_sizes(48) == [1, 2, 4, 8, 16, 32, 48]
+        sizes = bucket_sizes(64)
+        assert bucket_for(1, sizes) == 1
+        assert bucket_for(3, sizes) == 4
+        assert bucket_for(64, sizes) == 64
+        with pytest.raises(Exception):
+            bucket_for(65, sizes)
+
+
+class TestEngineCorrectness:
+    def test_bit_equal_concurrent_ragged(self, model_dir):
+        """The acceptance criterion: engine results bit-equal to the
+        unbatched AnalysisPredictor.predict for EVERY request under 8
+        concurrent client threads with ragged batch sizes.
+
+        The bit-equal reference is a single-request predict of the
+        SAME rows at the request's executed device shape (the bucket
+        the Future reports) — proving coalescing, padding, offsets,
+        and split/unpad are lossless with zero cross-request
+        contamination. Against the NATIVE-shape predict the match is
+        allclose-tight but not always bitwise: XLA CPU lowers an M=1
+        matmul to a gemv whose accumulation order differs ~1 ulp from
+        the same row inside a larger batch — executable-selection
+        reassociation no serving layer controls (docs/serving.md)."""
+        from paddle_tpu.serving import pad_batch
+
+        d = model_dir
+        reference = AnalysisPredictor(AnalysisConfig(d))
+        engine = _engine(d)
+        results = []
+        lock = threading.Lock()
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(5):
+                n = int(r.randint(1, 10))
+                feed = {"x": r.rand(n, 16).astype(np.float32)}
+                fut = engine.infer(feed)
+                out = fut.result(timeout=60)
+                with lock:
+                    results.append((feed, out, fut.bucket))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 40
+        buckets_seen = set()
+        for feed, out, bucket in results:
+            n = feed["x"].shape[0]
+            assert out[0].shape[0] == n
+            buckets_seen.add(bucket)
+            # bit-equal vs the unbatched predict at the executed shape
+            (expect,) = reference.predict(
+                pad_batch(feed, n, bucket))
+            np.testing.assert_array_equal(np.asarray(expect)[:n],
+                                          out[0])
+            # and numerically identical-to-tolerance vs native shape
+            (native,) = reference.predict(feed)
+            np.testing.assert_allclose(np.asarray(native), out[0],
+                                       rtol=0, atol=1e-6)
+        stats = engine.stats()
+        engine.shutdown()
+        assert stats["completed"] == 40
+        # coalescing actually happened (fewer batches than requests,
+        # requests executed at buckets above their own size)
+        assert stats["batches"] < 40
+        assert max(buckets_seen) > 1
+
+    def test_bounded_compiles_100_ragged_requests(self, model_dir):
+        """100 requests with random batch sizes in [1, 64] trigger at
+        most 7 executable compiles (one per bucket), via the engine's
+        compile counter."""
+        d = model_dir
+        engine = _engine(d, max_batch_size=64, max_queue_wait_us=500,
+                         max_queue_size=512, warmup=False)
+        r = np.random.RandomState(1)
+        futs = [engine.infer(
+            {"x": r.rand(int(r.randint(1, 65)), 16)
+             .astype(np.float32)}) for _ in range(100)]
+        for f in futs:
+            f.result(timeout=120)
+        stats = engine.stats()
+        engine.shutdown()
+        assert stats["completed"] == 100
+        assert stats["compiles"] <= 7, stats
+
+    def test_warmup_precompiles_all_buckets(self, model_dir):
+        d = model_dir
+        engine = _engine(d, max_batch_size=8)
+        stats0 = engine.stats()
+        assert stats0["warmed_buckets"] == [1, 2, 4, 8]
+        assert stats0["compiles"] == 4
+        r = np.random.RandomState(2)
+        futs = [engine.infer(
+            {"x": r.rand(int(r.randint(1, 9)), 16)
+             .astype(np.float32)}) for _ in range(20)]
+        for f in futs:
+            f.result(timeout=60)
+        # traffic added ZERO compiles: every bucket was pre-compiled
+        stats = engine.stats()
+        engine.shutdown()
+        assert stats["compiles"] == 4
+
+    def test_multi_model_routing(self, tmp_path):
+        d_a = _save_mlp_model(tmp_path / "a", seed=5, out_dim=4)
+        d_b = _save_mlp_model(tmp_path / "b", seed=6, out_dim=2)
+        engine = ServingEngine()
+        engine.add_model("a", d_a, ServingConfig(max_batch_size=4,
+                                                 warmup=False))
+        engine.add_model("b", d_b, ServingConfig(max_batch_size=4,
+                                                 warmup=False))
+        feed = {"x": np.ones((2, 16), np.float32)}
+        out_a = engine.infer_sync(feed, model="a", timeout=60)
+        out_b = engine.infer_sync(feed, model="b", timeout=60)
+        assert out_a[0].shape == (2, 4) and out_b[0].shape == (2, 2)
+        # default model is the first added
+        assert engine.infer_sync(feed, timeout=60)[0].shape == (2, 4)
+        with pytest.raises(InvalidRequest):
+            engine.infer(feed, model="missing")
+        s = engine.stats()
+        assert set(s["models"]) == {"a", "b"}
+        assert s["models"]["a"]["completed"] == 2
+        assert engine.stats(model="b")["completed"] == 1
+        engine.shutdown()
+
+    def test_dispatch_spans_reach_chrome_trace(self, model_dir,
+                                               tmp_path):
+        """Serving shows up in the profiler: dispatch spans with
+        bucket/rows args land in the exported chrome trace."""
+        import json
+
+        from paddle_tpu import profiler
+
+        d = model_dir
+        profiler.reset_profiler()
+        path = str(tmp_path / "trace.json")
+        with profiler.profiler("CPU", profile_path=path):
+            engine = _engine(d, max_batch_size=4, warmup=False)
+            engine.infer_sync({"x": np.ones((3, 16), np.float32)},
+                              timeout=60)
+            engine.shutdown()
+        evs = json.load(open(path))["traceEvents"]
+        spans = [e for e in evs
+                 if e.get("name") == "serving_dispatch"]
+        assert spans, [e.get("name") for e in evs][:20]
+        assert spans[0]["args"]["bucket"] == 4
+        assert spans[0]["args"]["rows"] == 3
+
+    def test_stats_surface(self, model_dir):
+        d = model_dir
+        engine = _engine(d)
+        r = np.random.RandomState(3)
+        for _ in range(10):
+            engine.infer_sync({"x": r.rand(3, 16).astype(np.float32)},
+                              timeout=60)
+        s = engine.stats()
+        engine.shutdown()
+        for key in ("p50_ms", "p95_ms", "p99_ms", "qps", "queue_depth",
+                    "batch_occupancy", "compiles", "completed"):
+            assert key in s, key
+        assert s["p50_ms"] <= s["p99_ms"]
+        assert 0 < s["batch_occupancy"]["mean"] <= 1.0
+        assert s["queue_depth"] == 0
+
+
+class TestAdmissionControl:
+    def test_overload_rejection_is_structured(self, model_dir):
+        d = model_dir
+        engine = _engine(d, max_queue_size=3, max_batch_size=4)
+        worker = engine._worker(None)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def hold(w, batch):
+            entered.set()
+            release.wait(30)
+
+        worker._dispatch_hook = hold
+        feed = {"x": np.zeros((1, 16), np.float32)}
+        first = engine.infer(feed)      # picked up, held in dispatch
+        assert entered.wait(10)
+        queued = [engine.infer(feed) for _ in range(3)]  # fills queue
+        with pytest.raises(ServerOverloaded) as ei:
+            engine.infer(feed)
+        assert ei.value.code == "SERVER_OVERLOADED"
+        assert ei.value.details["queue_depth"] == 3
+        assert ei.value.to_dict()["code"] == "SERVER_OVERLOADED"
+        release.set()
+        worker._dispatch_hook = None
+        for f in [first] + queued:
+            f.result(timeout=60)
+        assert engine.stats()["rejected"] == 1
+        engine.shutdown()
+
+    def test_deadline_expires_queued_request(self, model_dir):
+        d = model_dir
+        engine = _engine(d, max_batch_size=4)
+        worker = engine._worker(None)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def hold(w, batch):
+            entered.set()
+            release.wait(30)
+
+        worker._dispatch_hook = hold
+        feed = {"x": np.zeros((1, 16), np.float32)}
+        first = engine.infer(feed)
+        assert entered.wait(10)
+        doomed = engine.infer(feed, deadline_ms=1.0)
+        time.sleep(0.05)  # deadline passes while the batcher is held
+        release.set()
+        worker._dispatch_hook = None
+        first.result(timeout=60)
+        with pytest.raises(DeadlineExceeded) as ei:
+            doomed.result(timeout=60)
+        assert ei.value.code == "DEADLINE_EXCEEDED"
+        assert engine.stats()["expired"] == 1
+        engine.shutdown()
+
+    def test_invalid_requests(self, model_dir):
+        d = model_dir
+        engine = _engine(d, max_batch_size=8)
+        with pytest.raises(InvalidRequest):   # wrong input name
+            engine.infer({"y": np.zeros((1, 16), np.float32)})
+        with pytest.raises(InvalidRequest):   # oversize batch
+            engine.infer({"x": np.zeros((9, 16), np.float32)})
+        with pytest.raises(InvalidRequest):   # wrong trailing dim
+            engine.infer({"x": np.zeros((2, 17), np.float32)})
+        with pytest.raises(InvalidRequest):   # uncastable dtype
+            engine.infer({"x": np.zeros((2, 16), np.complex64)})
+        engine.shutdown()
+
+    def test_dtype_normalized_not_batch_poisoning(self, model_dir):
+        """A float64 client is normalized to the model's declared
+        float32 at admission — co-batched float32 clients keep their
+        bit-exact results, and no fresh compile signature is minted."""
+        d = model_dir
+        engine = _engine(d, max_batch_size=8, max_queue_wait_us=20000)
+        worker = engine._worker(None)
+        compiles0 = engine.stats()["compiles"]
+        release = threading.Event()
+        entered = threading.Event()
+
+        def hold(w, batch):
+            entered.set()
+            release.wait(30)
+
+        worker._dispatch_hook = hold
+        r = np.random.RandomState(8)
+        first = engine.infer({"x": r.rand(1, 16).astype(np.float32)})
+        assert entered.wait(10)
+        f32_feed = {"x": r.rand(2, 16).astype(np.float32)}
+        fut32 = engine.infer(f32_feed)
+        fut64 = engine.infer({"x": r.rand(2, 16)})  # float64 client
+        release.set()
+        worker._dispatch_hook = None
+        first.result(timeout=60)
+        out32, out64 = fut32.result(timeout=60), fut64.result(timeout=60)
+        assert out32[0].dtype == np.float32
+        assert out64[0].dtype == np.float32
+        # the f32 batchmate is still bit-equal at its executed bucket
+        from paddle_tpu.serving import pad_batch
+        ref = AnalysisPredictor(AnalysisConfig(d))
+        n = 2
+        (expect,) = ref.predict(pad_batch(f32_feed, n, fut32.bucket))
+        np.testing.assert_array_equal(np.asarray(expect)[:n], out32[0])
+        assert engine.stats()["compiles"] - compiles0 <= \
+            len(worker.buckets)
+        engine.shutdown()
+
+    def test_expired_head_does_not_drop_live_request(self, model_dir):
+        """Regression: an expired request at the queue head while a
+        batch is accumulating must expire ALONE — the live request
+        behind it used to be popped and silently dropped (its future
+        hung forever)."""
+        d = model_dir
+        engine = _engine(d, max_batch_size=4, max_queue_wait_us=100000)
+        worker = engine._worker(None)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def hold(w, batch):
+            entered.set()
+            release.wait(30)
+
+        worker._dispatch_hook = hold
+        feed = {"x": np.zeros((2, 16), np.float32)}
+        first = engine.infer(feed)           # held in dispatch
+        assert entered.wait(10)
+        doomed = engine.infer(feed, deadline_ms=1.0)  # head, expires
+        live = engine.infer(feed)            # must NOT be dropped
+        time.sleep(0.05)
+        release.set()
+        worker._dispatch_hook = None
+        first.result(timeout=60)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=60)
+        assert len(live.result(timeout=60)) == 1  # served, not hung
+        engine.shutdown()
+
+    def test_client_cancel_does_not_kill_batcher(self, model_dir):
+        """Regression: a client cancelling its queued Future must not
+        kill the batcher (set_result on a cancelled future raises
+        InvalidStateError) — batchmates and later requests survive."""
+        d = model_dir
+        engine = _engine(d, max_batch_size=8)
+        worker = engine._worker(None)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def hold(w, batch):
+            entered.set()
+            release.wait(30)
+
+        worker._dispatch_hook = hold
+        feed = {"x": np.zeros((1, 16), np.float32)}
+        first = engine.infer(feed)
+        assert entered.wait(10)
+        cancelled = engine.infer(feed)
+        survivor = engine.infer(feed)
+        assert cancelled.cancel()
+        release.set()
+        worker._dispatch_hook = None
+        first.result(timeout=60)
+        assert len(survivor.result(timeout=60)) == 1
+        # engine fully alive for new work
+        assert len(engine.infer(feed).result(timeout=60)) == 1
+        assert worker._dead_error is None
+        engine.shutdown()
+
+    def test_graceful_drain_on_shutdown(self, model_dir):
+        d = model_dir
+        engine = _engine(d, max_queue_wait_us=20000, max_batch_size=4)
+        r = np.random.RandomState(4)
+        futs = [engine.infer(
+            {"x": r.rand(2, 16).astype(np.float32)})
+            for _ in range(12)]
+        engine.shutdown(drain=True, timeout=60)
+        for f in futs:  # every queued request was served, none failed
+            assert len(f.result(timeout=1)) == 1
+        with pytest.raises(EngineStopped):
+            engine.infer({"x": np.zeros((1, 16), np.float32)})
+
+    def test_shutdown_without_drain_fails_queued(self, model_dir):
+        d = model_dir
+        engine = _engine(d, max_batch_size=4)
+        worker = engine._worker(None)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def hold(w, batch):
+            entered.set()
+            release.wait(30)
+
+        worker._dispatch_hook = hold
+        feed = {"x": np.zeros((1, 16), np.float32)}
+        first = engine.infer(feed)
+        assert entered.wait(10)
+        queued = engine.infer(feed)
+        release.set()
+        worker._dispatch_hook = None
+        engine.shutdown(drain=False, timeout=60)
+        first.result(timeout=60)
+        with pytest.raises(EngineStopped):
+            queued.result(timeout=60)
+
+
+@pytest.mark.chaos
+class TestChaos:
+    def test_dead_batcher_fails_futures_structured(self, model_dir):
+        """A batcher thread killed by an unexpected error must fail
+        every queued future with a structured BatcherDied — clients
+        never hang on a dead engine."""
+        d = model_dir
+        engine = _engine(d, max_batch_size=4)
+        worker = engine._worker(None)
+        armed = threading.Event()
+
+        class _Kill(BaseException):  # escapes `except Exception`
+            pass
+
+        def bomb(w, batch):
+            armed.set()
+            raise _Kill("chaos: simulated batcher kill")
+
+        worker._dispatch_hook = bomb
+        feed = {"x": np.zeros((1, 16), np.float32)}
+        futs = [engine.infer(feed) for _ in range(5)]
+        assert armed.wait(10)
+        for f in futs:
+            with pytest.raises(BatcherDied) as ei:
+                f.result(timeout=30)  # structured failure, no hang
+            assert ei.value.code == "BATCHER_DIED"
+            assert "chaos" in ei.value.details["cause"]
+        # the engine is marked dead: new work is refused, not queued
+        with pytest.raises((BatcherDied, EngineStopped)):
+            engine.infer(feed)
+        worker._thread.join(timeout=10)
+        assert not worker._thread.is_alive()
+
+    def test_per_batch_failure_does_not_kill_engine(self, model_dir):
+        """An ordinary dispatch Exception fails only that batch; the
+        batcher survives and keeps serving."""
+        d = model_dir
+        engine = _engine(d, max_batch_size=4)
+        worker = engine._worker(None)
+        fired = threading.Event()
+
+        def bomb_once(w, batch):
+            worker._dispatch_hook = None
+            fired.set()
+            raise RuntimeError("transient dispatch failure")
+
+        worker._dispatch_hook = bomb_once
+        feed = {"x": np.zeros((1, 16), np.float32)}
+        doomed = engine.infer(feed)
+        with pytest.raises(RuntimeError):
+            doomed.result(timeout=30)
+        assert fired.is_set()
+        out = engine.infer(feed).result(timeout=60)  # engine lives
+        assert out[0].shape == (1, 4)
+        assert engine.stats()["failed"] == 1
+        engine.shutdown()
+
+
+class TestCloneThreadSafety:
+    def test_clone_compile_race_compiles_once(self, model_dir):
+        """Regression (satellite): two clones racing the same feed
+        shape must share ONE compiled executable — the shared
+        first-compile gate serializes only the first trace."""
+        d = model_dir
+        pred = AnalysisPredictor(AnalysisConfig(d))
+        clones = [pred.clone() for _ in range(4)]
+        assert all(c.exe is pred.exe for c in clones)
+        base = pred.exe.compile_count
+        feed = {"x": np.ones((5, 16), np.float32)}
+        barrier = threading.Barrier(len(clones))
+        outs, errs = [], []
+        lock = threading.Lock()
+
+        def race(c):
+            try:
+                barrier.wait(10)
+                (o,) = c.predict(feed)
+                with lock:
+                    outs.append(o)
+            except Exception as e:  # pragma: no cover
+                with lock:
+                    errs.append(e)
+
+        threads = [threading.Thread(target=race, args=(c,))
+                   for c in clones]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert pred.exe.compile_count - base == 1
+        for o in outs[1:]:
+            np.testing.assert_array_equal(outs[0], o)
+
+
+class TestSignatureSidecar:
+    def test_sidecar_written_and_surfaced(self, model_dir):
+        d = model_dir
+        assert os.path.exists(os.path.join(d, "__signature__.json"))
+        program, feed_names, fetch_vars = \
+            fluid.io.load_inference_model(d, fluid.Executor(),
+                                          scope=fluid.Scope())
+        sig = program._inference_signature
+        assert sig is not None and sig["version"] == 1
+        (inp,) = sig["inputs"]
+        assert inp["name"] == "x" and inp["dtype"] == "float32"
+        assert inp["shape"] == [-1, 16] and inp["dynamic_dims"] == [0]
+        assert len(sig["outputs"]) == 1
+
+    def test_old_model_without_sidecar_still_loads(self, tmp_path):
+        # own model (not the shared fixture): this test MUTATES the
+        # dir by deleting the sidecar
+        d = _save_mlp_model(tmp_path)
+        os.remove(os.path.join(d, "__signature__.json"))
+        pred = AnalysisPredictor(AnalysisConfig(d))
+        # predictor derives the signature live from the program
+        sig = pred.signature
+        assert sig["inputs"][0]["dynamic_dims"] == [0]
+        # and the serving engine still warms every bucket from it
+        engine = ServingEngine(pred, ServingConfig(max_batch_size=4))
+        assert engine.stats()["warmed_buckets"] == [1, 2, 4]
+        out = engine.infer_sync(
+            {"x": np.zeros((3, 16), np.float32)}, timeout=60)
+        assert out[0].shape == (3, 4)
+        engine.shutdown()
+
+
+class TestInferencerFacade:
+    def test_inferencer_routes_through_predictor(self, tmp_path):
+        """Satellite: the deprecated contrib.Inferencer shares the
+        AnalysisPredictor per-shape compile cache — repeated infers of
+        one shape compile exactly once."""
+        from paddle_tpu.contrib import Inferencer
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = layers.data("x", shape=[6])
+                layers.fc(x, size=2,
+                          param_attr=fluid.ParamAttr(name="w"))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor().run(startup)
+            fluid.io.save_params(None, str(tmp_path / "params"),
+                                 main_program=main, scope=scope)
+
+        def infer_func():
+            x = layers.data("x", shape=[6])
+            return layers.fc(x, size=2,
+                             param_attr=fluid.ParamAttr(name="w"))
+
+        inf = Inferencer(infer_func=infer_func,
+                         param_path=str(tmp_path / "params"))
+        assert isinstance(inf._predictor, AnalysisPredictor)
+        base = inf._predictor.exe.compile_count
+        feed = {"x": np.ones((3, 6), np.float32)}
+        (a,) = inf.infer(feed)
+        (b,) = inf.infer(feed)
+        np.testing.assert_array_equal(a, b)
+        assert inf._predictor.exe.compile_count - base == 1
+        with pytest.raises(ValueError):
+            inf.infer([1, 2, 3])
+
+
+class TestExecutorDonateCache:
+    def test_donate_is_part_of_compile_cache_key(self):
+        """Regression: donate is baked into the jitted fn
+        (donate_argnums), so runs differing only in donate must not
+        share a cached executable — a donate=False caller handed a
+        donating one would have its param buffers invalidated."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4])
+            layers.fc(x, size=2, name="dfc")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = {"x": np.ones((2, 4), np.float32)}
+            fetch = [main.global_block().var("dfc.tmp_1").name]
+            base = exe.compile_count
+            a = exe.run(main, feed=feed, fetch_list=fetch, scope=scope,
+                        donate=False)
+            n_cache = len(exe._cache)
+            b = exe.run(main, feed=feed, fetch_list=fetch, scope=scope,
+                        donate=True)
+            assert len(exe._cache) == n_cache + 1  # distinct entries
+            assert exe.compile_count - base == 2
+            np.testing.assert_array_equal(a[0], b[0])

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Load generator for the serving engine: open- or closed-loop traffic
+against a saved inference model (or a built-in synthetic MLP), emitting
+ONE JSON latency report — the serving analog of bench.py's one-line
+contract.
+
+- ``--mode open``: arrivals at a fixed offered QPS regardless of
+  completions (the SLO-honest protocol: queueing delay shows up in the
+  latencies instead of throttling the arrival process — avoids
+  coordinated omission).
+- ``--mode closed``: ``--concurrency`` workers each keep exactly one
+  request in flight (classic throughput probe; latencies flatter).
+
+Examples
+--------
+# synthetic model, open loop at 200 QPS for 5 s, ragged batches 1..8
+python tools/load_gen.py --synthetic --mode open --qps 200 --duration 5
+
+# a saved model dir, closed loop with 16 workers
+python tools/load_gen.py --model-dir /tmp/mnist_model --mode closed \
+    --concurrency 16 --duration 10
+
+Exit code 0 when the run completed and every non-rejected request
+resolved; 1 otherwise. The last stdout line is the JSON report.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_synthetic_model(dirname):
+    """Train-free 64->32->8 softmax MLP saved as an inference model —
+    enough to exercise batching/bucketing without a real checkpoint."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        h = layers.fc(x, size=32, act="relu")
+        pred = layers.fc(h, size=8, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["x"], [pred], exe,
+                                      main_program=main, scope=scope)
+    return dirname
+
+
+def _feed_maker(engine, rng, batch_min, batch_max):
+    """Random ragged feed built from the model signature (sidecar or
+    live derivation) — batch dim in [batch_min, batch_max]."""
+    worker = engine._worker(None)
+    sig = worker.predictor.signature
+
+    def make():
+        n = int(rng.randint(batch_min, batch_max + 1))
+        feed = {}
+        for inp in sig["inputs"]:
+            dims = list(inp["shape"])
+            if inp["dynamic_dims"]:
+                dims[inp["dynamic_dims"][0]] = n
+            else:
+                dims = [n] + dims
+            dt = np.dtype(inp["dtype"])
+            if np.issubdtype(dt, np.floating):
+                feed[inp["name"]] = rng.rand(*dims).astype(dt)
+            else:
+                feed[inp["name"]] = np.zeros(dims, dt)
+        return feed, n
+
+    return make
+
+
+def run_open_loop(engine, make_feed, qps, duration_s, deadline_ms):
+    """Fixed-rate arrivals; every submitted future is awaited at the
+    end so queueing delay lands in the latency record, not in a
+    throttled arrival process."""
+    from paddle_tpu.serving import ServerOverloaded
+
+    interval = 1.0 / qps
+    t_end = time.monotonic() + duration_s
+    pending, lat_ms, rejected = [], [], 0
+    failed = [0]
+    lock = threading.Lock()
+    next_fire = time.monotonic()
+    while time.monotonic() < t_end:
+        now = time.monotonic()
+        if now < next_fire:
+            time.sleep(min(next_fire - now, 0.002))
+            continue
+        next_fire += interval
+        feed, _n = make_feed()
+        t0 = time.monotonic()
+        try:
+            fut = engine.infer(feed, deadline_ms=deadline_ms)
+        except ServerOverloaded:
+            rejected += 1
+            continue
+
+        def on_done(f, t0=t0):
+            # completion time recorded IN the callback (fires on
+            # set_result), not when the harvest loop gets around to
+            # reading the future — the latter would overstate latency
+            # by the whole remaining run
+            with lock:
+                if f.exception() is None:
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+                else:
+                    failed[0] += 1
+
+        fut.add_done_callback(on_done)
+        pending.append(fut)
+    for fut in pending:  # drain; outcomes already recorded above
+        try:
+            fut.result(timeout=60)
+        except Exception:
+            pass
+    return {"offered_qps": qps, "submitted": len(pending),
+            "client_rejected": rejected, "client_failed": failed[0],
+            "client_lat_ms": lat_ms}
+
+
+def run_closed_loop(engine, make_feed, concurrency, duration_s,
+                    deadline_ms):
+    from paddle_tpu.serving import ServerOverloaded
+
+    t_end = time.monotonic() + duration_s
+    lock = threading.Lock()
+    lat_ms, counts = [], {"rejected": 0, "failed": 0, "submitted": 0}
+
+    def worker():
+        while time.monotonic() < t_end:
+            feed, _n = make_feed()
+            t0 = time.monotonic()
+            try:
+                with lock:
+                    counts["submitted"] += 1
+                engine.infer_sync(feed, deadline_ms=deadline_ms,
+                                  timeout=60)
+                with lock:
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+            except ServerOverloaded:
+                with lock:
+                    counts["rejected"] += 1
+                time.sleep(0.005)  # back off as the error instructs
+            except Exception:
+                with lock:
+                    counts["failed"] += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {"concurrency": concurrency,
+            "submitted": counts["submitted"],
+            "client_rejected": counts["rejected"],
+            "client_failed": counts["failed"], "client_lat_ms": lat_ms}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-dir", default=None)
+    ap.add_argument("--synthetic", action="store_true",
+                    help="build a throwaway MLP instead of loading")
+    ap.add_argument("--mode", choices=("open", "closed"),
+                    default="open")
+    ap.add_argument("--qps", type=float, default=100.0)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--wait-us", type=int, default=2000)
+    ap.add_argument("--queue-size", type=int, default=256)
+    ap.add_argument("--batch-min", type=int, default=1)
+    ap.add_argument("--batch-max", type=int, default=8)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if not args.model_dir and not args.synthetic:
+        ap.error("pass --model-dir or --synthetic")
+
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    model_dir = args.model_dir
+    if model_dir is None:
+        model_dir = build_synthetic_model(
+            tempfile.mkdtemp(prefix="load_gen_model_"))
+    cfg = ServingConfig(max_batch_size=args.max_batch,
+                        max_queue_wait_us=args.wait_us,
+                        max_queue_size=args.queue_size,
+                        warmup=not args.no_warmup)
+    engine = ServingEngine(model_dir, cfg)
+    rng = np.random.RandomState(args.seed)
+    make_feed = _feed_maker(engine, rng, args.batch_min,
+                            min(args.batch_max, args.max_batch))
+
+    t0 = time.monotonic()
+    if args.mode == "open":
+        client = run_open_loop(engine, make_feed, args.qps,
+                               args.duration, args.deadline_ms)
+    else:
+        client = run_closed_loop(engine, make_feed, args.concurrency,
+                                 args.duration, args.deadline_ms)
+    wall = time.monotonic() - t0
+    engine.shutdown(drain=True, timeout=30)
+
+    lat = np.asarray(client.pop("client_lat_ms"))
+    report = {
+        "metric": "serving_load_gen",
+        "mode": args.mode,
+        "duration_s": round(wall, 2),
+        "completed": int(lat.size),
+        "achieved_qps": round(lat.size / wall, 2) if wall > 0 else None,
+        "p50_ms": round(float(np.percentile(lat, 50)), 3)
+        if lat.size else None,
+        "p95_ms": round(float(np.percentile(lat, 95)), 3)
+        if lat.size else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 3)
+        if lat.size else None,
+        "engine": engine.stats(),
+    }
+    report.update(client)
+    print(json.dumps(report), flush=True)
+    return 1 if client.get("client_failed") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
