@@ -535,6 +535,39 @@ def bench_mnist_mlp(batch=512, warmup=5, iters=300):
             "mfu": _mfu(mnist_flops_per_step(batch), sps)}
 
 
+def bench_pipelined_train(steps=None, batch=256, chunk_size=8):
+    """Pipelined DATA-FED training (tools/pipeline_probe.py — the
+    bench row and the standalone tool can never measure different
+    things): host-manufactured batches ride a background
+    DevicePrefetcher into run_pipelined's chunked scan (one dispatch
+    per K steps), against the per-step-dispatch baseline that makes
+    each batch synchronously. Reports both protocols' steps/s and
+    input-pipeline stall fractions — the stall gap, not raw speedup,
+    is the portable number (on CPU the "device" and the reader share
+    cores; through the tunnel each avoided dispatch saves 50-1500 ms
+    of RTT on top)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import pipeline_probe
+
+    steps = steps or int(_env_float("BENCH_PIPELINE_STEPS", 64))
+    r = pipeline_probe.probe(steps=steps, batch=batch,
+                             chunk_size=chunk_size)
+    pipe, base = r["pipelined"], r["baseline"]
+    sps = pipe["steps_per_s"]
+    return {"metric": "pipelined_train_throughput",
+            "value": round(batch * sps, 1), "unit": "examples/sec",
+            "steps_per_s": sps,
+            "stall_fraction": pipe["stall_fraction"],
+            "chunk_size": chunk_size,
+            "dispatches": pipe["dispatches"],
+            "chunk_compiles": pipe["chunk_compiles"],
+            "baseline_steps_per_s": base["steps_per_s"],
+            "baseline_stall_fraction": base["stall_fraction"],
+            "speedup_vs_per_step": r["speedup_vs_per_step"],
+            "mfu": _mfu(mnist_flops_per_step(batch), sps)}
+
+
 # ---------------------------------------------------------------------------
 # config 2: ResNet-50 ImageNet
 # ---------------------------------------------------------------------------
@@ -1096,7 +1129,8 @@ def child_main():
         # never finished inside the window) — it must not starve the
         # configs that measure in seconds. A stall in any config
         # forfeits only the ones after it.
-        extra = [bench_mnist_mlp, bench_guarded_overhead,
+        extra = [bench_mnist_mlp, bench_pipelined_train,
+                 bench_guarded_overhead,
                  bench_serving_latency,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,

@@ -59,7 +59,8 @@ from . import recordio  # noqa: F401
 from .dataset_factory import (DatasetFactory, InMemoryDataset,  # noqa: F401
                               QueueDataset)
 from .data_feeder import DataFeeder  # noqa: F401
-from .pyreader import DataLoader, PyReader  # noqa: F401
+from .pyreader import (DataLoader, DevicePrefetcher,  # noqa: F401
+                       PyReader)
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import ir  # noqa: F401
 from . import inference  # noqa: F401

@@ -315,6 +315,36 @@ class DatasetBase:
                 feed[name] = out
         return feed
 
+    def chunk_iterator(self, chunk_size, drop_last=True,
+                       drop_last_chunk=False):
+        """Yield ``(chunk_dict, n_batches)``: ``chunk_size``
+        consecutive batches stacked along a NEW leading axis — the
+        host-side feed format of ``Executor.run_pipelined`` (K steps
+        per device dispatch). ``drop_last`` drops the final partial
+        BATCH (as batch_iterator does); ``drop_last_chunk`` also drops
+        a final partial chunk, keeping every chunk the same shape (one
+        compiled scan, no tail-shape recompile). For background
+        prefetch + device transfer use ``DevicePrefetcher`` over
+        ``batch_iterator()`` instead — this is the synchronous
+        building block (probe tools, no-prefetch baselines)."""
+        # validate EAGERLY (a generator body would defer the error to
+        # first iteration, far from the buggy call site)
+        enforce(chunk_size >= 1, "chunk_size must be >= 1")
+
+        from .pyreader import stack_batches
+
+        def gen():
+            buf = []
+            for feed in self.batch_iterator(drop_last=drop_last):
+                buf.append(feed)
+                if len(buf) == chunk_size:
+                    yield stack_batches(buf), len(buf)
+                    buf = []
+            if buf and not drop_last_chunk:
+                yield stack_batches(buf), len(buf)
+
+        return gen()
+
 
 class InMemoryDataset(DatasetBase):
     """Load everything, shuffle, iterate (reference: dataset.py

@@ -687,6 +687,13 @@ class Executor:
         # per-shape number (one executable per shape bucket).
         self._compiled_sigs = set()
         self._compile_count = 0
+        # device dispatches issued by this Executor: one per jitted-fn
+        # invocation (a run(), one run_repeated scan, one run_pipelined
+        # chunk scan). The pipelined-training contract (docs/
+        # input_pipeline.md) asserts ceil(steps/K) + O(1) against this.
+        self._dispatch_count = 0
+        # stats of the most recent pipelined *_from_dataset pass
+        self._last_pipeline_stats = None
         # counters/sets are mutated from concurrent predictor clones
         # (AnalysisPredictor shares one Executor across clones); held
         # only around bookkeeping, never across a dispatch
@@ -719,6 +726,19 @@ class Executor:
         """Distinct (program, feed-shape) signatures traced+compiled by
         this Executor — the serving engine's bounded-compiles metric."""
         return self._compile_count
+
+    @property
+    def dispatch_count(self):
+        """Device dispatches issued: one per jitted-fn invocation (a
+        run() step, a run_repeated scan, a run_pipelined chunk)."""
+        return self._dispatch_count
+
+    @property
+    def last_pipeline_stats(self):
+        """Prefetcher stats of the most recent pipelined
+        train_from_dataset / infer_from_dataset pass (None before
+        one ran): chunks, steps, stall_s, h2d_s, stall_fraction."""
+        return self._last_pipeline_stats
 
     def close(self):
         self._cache.clear()
@@ -757,15 +777,30 @@ class Executor:
             # dist/interpreted programs: plain loop (correct; per-step
             # dispatch cost applies). Honor an explicit library by
             # scoping the flag, since run() has no such parameter.
+            # The SAME feed dict repeats every iteration, so validation
+            # and feed->jnp conversion are hoisted out of the loop:
+            # validate once here, convert once, then every run() call
+            # sees ready device arrays and skips re-validation.
+            if not getattr(program, "_is_compiled", False):
+                _check_feed_shape_type(program.global_block(), feed)
+                feed = {k: jnp.asarray(v)
+                        if not isinstance(v, jax.Array) else v
+                        for k, v in feed.items()}
             prev = FLAGS.op_library
             if library is not None:
                 FLAGS.op_library = library
             try:
                 out = None
-                for _ in range(iters):
+                for i in range(iters):
+                    # compiled programs validate on the first pass only
+                    # (their feed check also derives shardings, which
+                    # must still happen once)
                     out = self.run(program, feed=feed,
                                    fetch_list=fetch_list, scope=scope,
-                                   return_numpy=return_numpy)
+                                   return_numpy=return_numpy,
+                                   validate_feed=i == 0 and
+                                   getattr(program, "_is_compiled",
+                                           False))
             finally:
                 FLAGS.op_library = prev
             return out
@@ -843,6 +878,7 @@ class Executor:
         with self._lock:
             counter = self._run_counter
             self._run_counter += iters
+            self._dispatch_count += 1
         base_key = jax.random.fold_in(self._base_key(program), counter)
         with _profiler.RecordEvent("feed_h2d"):
             feed_vals = {k: jnp.asarray(v)
@@ -856,64 +892,358 @@ class Executor:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
 
+    def run_pipelined(self, program=None, feed_chunk=None,
+                      fetch_list=None, scope=None, return_numpy=True,
+                      library=None):
+        """Run K data-fed steps inside ONE compiled ``lax.scan``
+        dispatch: ``feed_chunk`` maps each feed name to an array with
+        an EXTRA leading chunk axis ``[K, *batch_shape]``; step ``i``
+        of the scan consumes slice ``i`` as its feed. Returns the LAST
+        step's fetches, with persistables updated in place exactly as
+        K sequential ``run`` calls would.
+
+        This is ``run_repeated`` for REAL data: the fixed-feed scan
+        only amortizes dispatch for synthetic benchmarks, while here
+        fresh batches ride the scan as ``xs`` — the whole training
+        super-step stays on-device (the keep-it-in-graph philosophy of
+        the in-graph weight update, arXiv:2004.13336) and the host
+        pays one dispatch per K steps instead of one per step. Both
+        the persistable carry AND the chunk's feed buffers are donated
+        to XLA (the chunk is dead after its scan).
+
+        PRNG: step ``i`` of a chunk starting at run-counter ``c`` uses
+        ``fold_in(program_key, c+i)`` — bit-identical to the key the
+        same step would get from a sequential ``run()`` call, so
+        pipelined and per-step training match on the same seed.
+
+        The compiled scan is cached per (program version, feed names,
+        chunk SHAPE): feed every chunk the same K and batch shape (a
+        ragged tail chunk costs one extra compile). Typically driven
+        by ``DevicePrefetcher`` (pyreader.py), which stacks and
+        pre-transfers the next chunk on a background thread while this
+        chunk runs — ``train_from_dataset`` wires the two together.
+        """
+        program = program or framework.default_main_program()
+        scope = scope or global_scope()
+        fetch_list = fetch_list or []
+        enforce(feed_chunk, "run_pipelined needs a non-empty "
+                "feed_chunk (dict name -> [K, ...] array); for "
+                "feed-less programs use run_repeated")
+        iters = None
+        for name, val in feed_chunk.items():
+            shape = getattr(val, "shape", None)
+            enforce(shape is not None and len(shape) >= 1,
+                    "feed_chunk[%r] needs a leading chunk axis" % name)
+            enforce(iters is None or shape[0] == iters,
+                    "feed_chunk leading dims disagree: %r has %s, "
+                    "expected %s", name, shape[0], iters)
+            iters = shape[0]
+        enforce(iters >= 1, "feed_chunk must hold >= 1 batches")
+
+        if getattr(program, "_is_compiled", False) \
+                or _needs_eager(program):
+            # dist/interpreted programs can't scan the block: unstack
+            # the chunk and drive per-step run() (correct; per-step
+            # dispatch cost applies — same contract as run_repeated's
+            # fallback, including the hoisted one-time validation).
+            prev = FLAGS.op_library
+            if library is not None:
+                FLAGS.op_library = library
+            try:
+                out = None
+                for i in range(iters):
+                    feed_i = {k: v[i] for k, v in feed_chunk.items()}
+                    out = self.run(program, feed=feed_i,
+                                   fetch_list=fetch_list, scope=scope,
+                                   return_numpy=return_numpy,
+                                   validate_feed=i == 0)
+            finally:
+                FLAGS.op_library = prev
+            return out
+
+        block = program.global_block()
+        if library is None and FLAGS.op_library:
+            library = FLAGS.op_library
+        fetch_names = [f.name if isinstance(f, framework.Variable)
+                       else f for f in fetch_list]
+        persist_in = {}
+        for name, var in block.vars.items():
+            if var.persistable and scope.has_var(name) \
+                    and scope.find_var(name) is not None:
+                persist_in[name] = scope.find_var(name)
+        # validate the PER-STEP slice (shape/dtype only — no device
+        # readback: ShapeDtypeStructs stand in for the sliced values)
+        _check_feed_shape_type(block, {
+            k: jax.ShapeDtypeStruct(tuple(v.shape[1:]), v.dtype)
+            for k, v in feed_chunk.items()})
+        feed_names = tuple(sorted(feed_chunk))
+        cache_key = ("pipelined", program._uid, program._version,
+                     feed_names, tuple(fetch_names),
+                     tuple(sorted(persist_in)), library)
+        with _profiler.RecordEvent("feed_h2d"):
+            chunk_vals = {k: jnp.asarray(v)
+                          if not isinstance(v, jax.Array) else v
+                          for k, v in feed_chunk.items()}
+        # per-shape compile accounting, on the CONVERTED chunk — the
+        # dtypes XLA actually sees (asarray canonicalizes int64
+        # labels to int32, so the raw feed dtype would book phantom
+        # compiles). K is part of the shape: the ragged tail chunk
+        # legitimately counts as one extra compile.
+        shape_sig = tuple((k, tuple(chunk_vals[k].shape),
+                           str(chunk_vals[k].dtype))
+                          for k in feed_names)
+        with self._lock:
+            compiling = (cache_key, shape_sig) not in self._compiled_sigs
+            if compiling:
+                self._compiled_sigs.add((cache_key, shape_sig))
+                self._compile_count += 1
+        fn = self._cache.get(cache_key)
+        if fn is None:
+            carried = frozenset(persist_in)
+            persistable_names = frozenset(
+                n for n, v in block.vars.items() if v.persistable)
+            guard_plan = self._guard_plan(program, block)
+
+            def step(persist, feed_vals, idx, base_key):
+                env = dict(persist)
+                env.update(feed_vals)
+                step_key = jax.random.fold_in(base_key, idx)
+                with framework._trace_program_guard(program):
+                    run_block(block, env, step_key, library=library,
+                              anomaly_guard=guard_plan)
+                # fixed carry structure — see run_repeated. Unlike
+                # per-step run() (which writes back EVERY persistable
+                # the step produced), a persistable first materialized
+                # inside the scan cannot join the carry — its updates
+                # would be silently discarded each chunk, so detect it
+                # at trace time and say so (the default-on pipelined
+                # train_from_dataset must not silently diverge from
+                # the chunk_size=1 behavior).
+                dropped = sorted(n for n in persistable_names
+                                 if n in env and n not in carried)
+                if dropped:
+                    import warnings
+                    warnings.warn(
+                        "run_pipelined: persistable var(s) %s are "
+                        "first materialized inside the scan; their "
+                        "updates are DISCARDED between chunks. Run "
+                        "the startup program (or one warmup run()) "
+                        "first so they join the carry, or use "
+                        "chunk_size=1." % (dropped,))
+                persist_out = {
+                    n: env[n] if n in env else persist[n]
+                    for n in carried}
+                try:
+                    fetches = [env[n] for n in fetch_names]
+                except KeyError as e:
+                    raise InvalidArgumentError(
+                        "fetch var %r is not produced by this program "
+                        "(known vars: feed %s + program outputs)"
+                        % (e.args[0], sorted(feed_vals))) from e
+                return fetches, persist_out
+
+            def pipelined(persist, chunk, idxs, base_key):
+                # last-step fetches ride the CARRY (memory O(1) in K)
+                # seeded from eval_shape zeros so the step body is
+                # traced exactly once — same shape trick as
+                # run_repeated's multi()
+                fetch_avals, _ = jax.eval_shape(
+                    lambda p, c, i, b: step(
+                        p, {k: v[0] for k, v in c.items()}, i[0], b),
+                    persist, chunk, idxs, base_key)
+                fetches0 = [jnp.zeros(a.shape, a.dtype)
+                            for a in fetch_avals]
+
+                def body(carry, x):
+                    p, _ = carry
+                    feed_slice, idx = x
+                    f, p2 = step(p, feed_slice, idx, base_key)
+                    return (p2, f), None
+
+                (last_persist, last_fetches), _ = jax.lax.scan(
+                    body, (persist, fetches0), (chunk, idxs))
+                return last_fetches, last_persist
+
+            # donate the carry AND the feed chunk: the chunk's device
+            # buffers are dead once its scan consumed them
+            fn = jax.jit(pipelined, donate_argnums=(0, 1))
+            self._cache[cache_key] = fn
+
+        with self._lock:
+            counter = self._run_counter
+            self._run_counter += iters
+            self._dispatch_count += 1
+        base_key = self._base_key(program)
+        idxs = jnp.asarray(np.arange(counter, counter + iters,
+                                     dtype=np.int32))
+        with _profiler.RecordEvent("scan_dispatch",
+                                   args={"steps": int(iters)}):
+            if not compiling:
+                fetches, persist_out = fn(persist_in, chunk_vals,
+                                          idxs, base_key)
+            else:
+                # The feed chunk rarely aliases an output (fetches
+                # are scalars), so XLA warns its donation "was not
+                # usable" at compile time — expected, and it would
+                # noise up every data-fed run. The PERSIST CARRY
+                # shares the donate list though, and a carry that
+                # stops aliasing (param buffers silently duplicated
+                # each chunk) must stay loud: suppress only when
+                # every buffer the warning names is a chunk aval AND
+                # no persistable shares that aval (ambiguity stays
+                # loud). catch_warnings mutates process-global state,
+                # so the window is confined to this one-off compile
+                # call — steady-state dispatches touch no warning
+                # machinery.
+                import re
+                import warnings
+
+                def _aval(v):
+                    return "%s[%s]" % (v.dtype, ",".join(
+                        str(d) for d in v.shape))
+
+                chunk_avals = {_aval(v) for v in chunk_vals.values()}
+                persist_avals = {
+                    _aval(v) for v in persist_in.values()
+                    if hasattr(v, "shape") and hasattr(v, "dtype")}
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    fetches, persist_out = fn(persist_in, chunk_vals,
+                                              idxs, base_key)
+                for w in caught:
+                    msg = str(w.message)
+                    if "donated buffers were not usable" in msg:
+                        named = set(re.findall(
+                            r"ShapedArray\(([^)]+)\)", msg))
+                        if named and named <= chunk_avals \
+                                and not named & persist_avals:
+                            continue  # feed-chunk-only: expected
+                    warnings.warn_explicit(w.message, w.category,
+                                           w.filename, w.lineno)
+        for name, val in persist_out.items():
+            scope.set_var(name, val)
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
+        return fetches
+
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           chunk_size=None, prefetch_depth=2):
         """Run the program over every batch of an industrial Dataset
         (reference: executor.py train_from_dataset → C++
         Executor::RunFromDataset, executor.cc:120, driving trainer/
-        device-worker threads). TPU redesign: the Dataset's reader
-        threads pump host batches while the ONE compiled XLA step
-        consumes them — the device-worker thread pool dissolves into
-        XLA's async dispatch (steps overlap host loading because
-        executor runs don't block on fetch)."""
+        device-worker threads). TPU redesign: by default the loop is
+        PIPELINED — a DevicePrefetcher stacks ``chunk_size`` batches
+        and pre-transfers them to device on a background thread while
+        the current chunk's ``run_pipelined`` scan consumes K fresh
+        batches inside ONE dispatch; host↔device syncs (fetch
+        readback) happen only when a ``print_period`` boundary falls
+        inside a chunk. ``chunk_size=1`` or ``debug=True`` selects the
+        per-step loop (one dispatch + one synchronous feed per step —
+        the pre-pipeline behavior). ``chunk_size=None`` defaults to 8.
+        ``prefetch_depth`` chunks may be staged in flight (2 = double
+        buffering). Stats of the pass (incl. the input-pipeline stall
+        fraction) land in ``last_pipeline_stats``."""
+        return self._run_from_dataset(
+            program, dataset, scope, debug, fetch_list, fetch_info,
+            print_period, chunk_size, prefetch_depth,
+            label="train_from_dataset")
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           chunk_size=None, prefetch_depth=2):
+        """Inference twin of train_from_dataset (reference:
+        executor.py infer_from_dataset — same loop, no update ops;
+        pass a clone(for_test=True) program). Progress lines are
+        labelled ``[infer_from_dataset]`` — by the actual entry
+        point, not the training twin's name."""
+        return self._run_from_dataset(
+            program, dataset, scope, debug, fetch_list, fetch_info,
+            print_period, chunk_size, prefetch_depth,
+            label="infer_from_dataset")
+
+    def _run_from_dataset(self, program, dataset, scope, debug,
+                          fetch_list, fetch_info, print_period,
+                          chunk_size, prefetch_depth, label):
         from .dataset_factory import DatasetBase
         enforce(dataset is not None and
                 isinstance(dataset, DatasetBase),
-                "train_from_dataset needs a Dataset (DatasetFactory"
-                "().create_dataset(...))")
+                "%s needs a Dataset (DatasetFactory"
+                "().create_dataset(...))" % label)
         program = program or framework.default_main_program()
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [
             getattr(f, "name", str(f)) for f in fetch_list]
+
+        def progress(step, vals):
+            msg = ", ".join(
+                "%s=%s" % (n, np.asarray(v).reshape(-1)[:3])
+                for n, v in zip(fetch_info, vals))
+            print("[%s] step %d: %s" % (label, step, msg))
+
+        pipelined = (not debug and chunk_size != 1
+                     and not getattr(program, "_is_compiled", False)
+                     and not _needs_eager(program))
         step = 0
-        for feed in dataset.batch_iterator():
-            step += 1
-            # fetch (which syncs host<->device) only on print steps —
-            # every other step dispatches asynchronously (the
-            # reference also materializes fetch vars at print_period).
-            # Honored whenever a fetch_list is given: the old
-            # debug-only gate silently dropped the caller's fetches.
-            printing = bool(fetch_list) and step % print_period == 0
-            # a Dataset emits homogeneous batches, so feed shape/dtype
-            # validation runs once on the first batch instead of
-            # re-deriving the same verdict every step of the loop
-            vals = self.run(program, feed=feed,
-                            fetch_list=fetch_list if printing else [],
-                            scope=scope, validate_feed=step == 1)
-            if printing:
-                msg = ", ".join(
-                    "%s=%s" % (n, np.asarray(v).reshape(-1)[:3])
-                    for n, v in zip(fetch_info, vals))
-                print("[train_from_dataset] step %d: %s"
-                      % (step, msg))
+        if pipelined:
+            if chunk_size is None:
+                chunk_size = 8
+            from .pyreader import DevicePrefetcher
+            prefetcher = DevicePrefetcher(dataset.batch_iterator(),
+                                          chunk_size,
+                                          depth=prefetch_depth)
+            try:
+                for chunk, k in prefetcher:
+                    # the fetch vars ride EVERY chunk's scan carry (a
+                    # few scalars — dropping them between prints would
+                    # split the scan cache key and recompile the whole
+                    # K-step scan at the first print boundary), but
+                    # readback (the one host<->device sync) is
+                    # decimated: only when a print_period boundary
+                    # falls inside this chunk does np.asarray touch
+                    # the results; every other chunk dispatches fully
+                    # asynchronously
+                    vals = self.run_pipelined(
+                        program, feed_chunk=chunk,
+                        fetch_list=fetch_list,
+                        scope=scope, return_numpy=False)
+                    printing = bool(fetch_list) and \
+                        (step + k) // print_period > \
+                        step // print_period
+                    step += k
+                    if printing:
+                        progress(step, vals)
+            finally:
+                prefetcher.close()
+                self._last_pipeline_stats = prefetcher.stats()
+        else:
+            for feed in dataset.batch_iterator():
+                step += 1
+                # fetch (which syncs host<->device) only on print
+                # steps — every other step dispatches asynchronously
+                # (the reference also materializes fetch vars at
+                # print_period). Honored whenever a fetch_list is
+                # given: the old debug-only gate silently dropped the
+                # caller's fetches.
+                printing = bool(fetch_list) and \
+                    step % print_period == 0
+                # a Dataset emits homogeneous batches, so feed
+                # shape/dtype validation runs once on the first batch
+                vals = self.run(program, feed=feed,
+                                fetch_list=fetch_list if printing
+                                else [],
+                                scope=scope, validate_feed=step == 1)
+                if printing:
+                    progress(step, vals)
         if step == 0:
             import warnings
             warnings.warn(
-                "train_from_dataset ran 0 steps — the dataset holds "
-                "fewer instances than one batch (batch_iterator drops "
-                "the last partial batch)")
+                "%s ran 0 steps — the dataset holds fewer instances "
+                "than one batch (batch_iterator drops the last "
+                "partial batch)" % label)
         return step
-
-    def infer_from_dataset(self, program=None, dataset=None, scope=None,
-                           thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
-        """Inference twin of train_from_dataset (reference:
-        executor.py infer_from_dataset — same loop, no update ops;
-        pass a clone(for_test=True) program)."""
-        return self.train_from_dataset(program, dataset, scope, thread,
-                                       debug, fetch_list, fetch_info,
-                                       print_period)
 
     # -- internals ---------------------------------------------------------
     @staticmethod
@@ -1037,6 +1367,7 @@ class Executor:
         with self._lock:
             counter = self._run_counter
             self._run_counter += 1
+            self._dispatch_count += 1
         step_key = jax.random.fold_in(self._base_key(program), counter)
 
         with _profiler.RecordEvent("feed_h2d"):

@@ -29,6 +29,7 @@ from typing import List, Optional
 __all__ = ["RecordEvent", "record_event", "start_profiler",
            "stop_profiler", "reset_profiler", "profiler",
            "export_chrome_tracing", "device_summary_table",
+           "bump_counter", "counter_values",
            "cuda_profiler", "npu_profiler"]
 
 _state = threading.local()
@@ -98,6 +99,25 @@ class RecordEvent:
 record_event = RecordEvent
 
 
+# -- always-on scalar counters ---------------------------------------
+# Unlike spans, counters accumulate regardless of start_profiler: the
+# input-pipeline stall metric (time the device dispatch loop waited on
+# host data) must be measurable from a plain bench/probe run without
+# turning on the full event recorder. Cost per bump is one lock + one
+# float add.
+_counters: dict = {}
+
+
+def bump_counter(name, value=1.0):
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + float(value)
+
+
+def counter_values() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
 def start_profiler(state="All", trace_path=None):
     """Reference: profiler.py start_profiler (state CPU/GPU/All; GPU
     maps to the TPU/XLA device trace here). ``trace_path`` starts a
@@ -122,6 +142,7 @@ def reset_profiler():
     with _lock:
         _events.clear()
         _device_events.clear()
+        _counters.clear()
 
 
 def stop_profiler(sorted_key=None, profile_path=None):

@@ -15,12 +15,248 @@ import queue
 import threading
 from typing import Callable, List, Optional, Sequence
 
-from .core.enforce import enforce
+import time
+
+import numpy as np
+
+from . import profiler as _profiler
+from .core.enforce import InvalidArgumentError, enforce
 from .data_feeder import DataFeeder
 
-__all__ = ["PyReader", "DataLoader"]
+__all__ = ["PyReader", "DataLoader", "DevicePrefetcher"]
 
 _SENTINEL = object()
+
+
+def _bounded_put(q, stop, item) -> bool:
+    """Bounded put that aborts when the consumer went away (a stop
+    event was set) — checked BEFORE every attempt, so a producer
+    finishing work after shutdown can never enqueue. Shared by
+    DevicePrefetcher, PyReader, and reader.decorator.buffered: ONE
+    copy of the put/stop contract."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def stack_batches(batches):
+    """Stack per-step feed dicts along a NEW leading axis — THE
+    ``[K, *batch_shape]`` chunk format ``Executor.run_pipelined``
+    consumes. Single source of the format contract: used by
+    ``DevicePrefetcher``, ``DatasetBase.chunk_iterator``, and the
+    pipeline probe."""
+    keys = batches[0].keys()
+    for b in batches[1:]:
+        if b.keys() != keys:
+            raise InvalidArgumentError(
+                "prefetch chunk mixes feed keys %s vs %s — the "
+                "batch stream must be homogeneous"
+                % (sorted(keys), sorted(b.keys())))
+    return {k: np.stack([np.asarray(b[k]) for b in batches])
+            for k in keys}
+
+
+def _prefetch_build_chunk(buf, device_put, counters, lock):
+    t0 = time.perf_counter()
+    with _profiler.RecordEvent("chunk_h2d_overlap",
+                               args={"steps": len(buf)}):
+        chunk = stack_batches(buf)
+        if device_put:
+            import jax
+            chunk = {k: jax.device_put(v) for k, v in chunk.items()}
+            # materialize the transfer ON THIS THREAD so the
+            # consumer's get() never pays a lazy copy
+            for v in chunk.values():
+                v.block_until_ready()
+    dt = time.perf_counter() - t0
+    with lock:
+        counters["h2d_s"] += dt
+    _profiler.bump_counter("chunk_h2d_s", dt)
+    return chunk
+
+
+def _prefetch_pump(it, chunk_size, device_put, q, stop, err, counters,
+                   lock):
+    """DevicePrefetcher's producer body. Module-level on purpose: the
+    thread must reference the queue/event/counters, never the
+    prefetcher itself, so an abandoned prefetcher can be collected
+    (its finalizer sets ``stop``, which retires this thread)."""
+    buf = []
+    try:
+        for feed in it:
+            if stop.is_set():
+                return
+            buf.append(feed)
+            if len(buf) == chunk_size:
+                if not _bounded_put(
+                        q, stop,
+                        (_prefetch_build_chunk(buf, device_put,
+                                               counters, lock),
+                         len(buf))):
+                    return
+                buf = []
+        if buf and not stop.is_set():
+            # ragged tail chunk: fewer steps, one extra compile
+            _bounded_put(q, stop,
+                          (_prefetch_build_chunk(buf, device_put,
+                                                 counters, lock),
+                           len(buf)))
+    except BaseException as e:  # surfaces in the consumer
+        err.append(e)
+    finally:
+        _bounded_put(q, stop, _SENTINEL)
+
+
+class DevicePrefetcher:
+    """Host-side chunk builder feeding ``Executor.run_pipelined``:
+    pulls per-step feed dicts from ``batches``, stacks every
+    ``chunk_size`` of them along a NEW leading axis, and
+    ``jax.device_put``s the stacked chunk on a background thread while
+    the consumer's current chunk is still running on-device — the
+    double/triple-buffer-to-device pattern of the reference's
+    buffered_reader (operators/reader/buffered_reader.cc), lifted from
+    one batch to one scan chunk.
+
+    Iterating yields ``(chunk_dict, n_steps)``; the final chunk may
+    hold fewer than ``chunk_size`` batches (one extra compile for the
+    tail shape). ``depth`` chunks may be staged in the queue at once
+    (2 = double buffering); budget device memory for up to
+    ``depth + 2`` live chunks — the staged ones, plus the one the
+    producer is mid-``device_put`` on, plus the one the consumer
+    holds. A generator exception propagates to the consumer on the
+    next ``__next__``; ``close()`` (or exiting the ``with`` block, or
+    abandoning the iterator) retires the thread without it pinning
+    the staged device chunks forever.
+
+    Stall accounting: time the consumer spent blocked in ``__next__``
+    waiting for the host is the input-pipeline **stall** — the device
+    had no fresh chunk to run. ``stats()`` reports it as a fraction of
+    the consumer's wall time (also bumped into the profiler counters
+    ``input_stall_s`` / ``chunk_h2d_s``)."""
+
+    def __init__(self, batches, chunk_size: int, depth: int = 2,
+                 device_put: bool = True):
+        enforce(chunk_size >= 1, "chunk_size must be >= 1")
+        enforce(depth >= 1, "prefetch depth must be >= 1")
+        self.chunk_size = int(chunk_size)
+        self.depth = int(depth)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._err: List[BaseException] = []
+        self._lock = threading.Lock()
+        # producer-side counters live in a plain dict shared with the
+        # pump thread, NOT attributes: the thread must hold no
+        # reference to self (see the finalizer below)
+        self._c = {"chunks": 0, "steps": 0, "stall_s": 0.0,
+                   "h2d_s": 0.0}
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._done = False
+        # the pump closes over the queue/event/counters only — a
+        # bound-method target would pin self alive for the thread's
+        # lifetime and defeat abandonment cleanup
+        self._thread = threading.Thread(
+            target=_prefetch_pump,
+            args=(iter(batches), self.chunk_size, device_put,
+                  self._q, self._stop, self._err, self._c,
+                  self._lock),
+            daemon=True)
+        # a consumer that drops the prefetcher without close()/with
+        # must not leak the producer thread + `depth` device chunks:
+        # GC of this object trips the stop event (the finalizer holds
+        # the EVENT, not self, so it doesn't pin the prefetcher)
+        import weakref
+        self._finalizer = weakref.finalize(self, self._stop.set)
+        self._thread.start()
+
+    # -- consumer side -----------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        if self._t_first is None:
+            self._t_first = t0
+        item = self._q.get()
+        now = time.perf_counter()
+        stall = now - t0
+        with self._lock:
+            self._c["stall_s"] += stall
+        _profiler.bump_counter("input_stall_s", stall)
+        self._t_last = now
+        if item is _SENTINEL:
+            self._done = True
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
+        chunk, n = item
+        with self._lock:
+            self._c["chunks"] += 1
+            self._c["steps"] += n
+        return chunk, n
+
+    def close(self):
+        """Retire the producer: unblock its put, drain staged chunks,
+        join. Idempotent; safe mid-iteration (break / exception)."""
+        self._stop.set()
+
+        def _drain():
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    return
+
+        _drain()
+        self._thread.join(timeout=5)
+        # drain AGAIN after the join: a producer that was mid-put when
+        # the first drain emptied the queue can land one final
+        # device-resident chunk, which would stay pinned in device
+        # memory for the prefetcher's lifetime (stats() keeps the
+        # object alive past the with-block). A join TIMEOUT (producer
+        # stuck in a slow device_put) is still leak-free: _put checks
+        # the stop event before every put attempt, so a producer that
+        # finishes building after this point can never enqueue.
+        _drain()
+        self._done = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats(self) -> dict:
+        """{chunks, steps, stall_s, h2d_s, elapsed_s, stall_fraction}.
+        stall_fraction = consumer wait / consumer wall — the share of
+        the training loop's time the device had no data to run.
+        ``chunks``/``steps`` count CONSUMED chunks; ``h2d_s`` is
+        producer-side and includes staged chunks discarded at
+        close(), so on an early-abandoned run h2d_s/chunks overstates
+        per-chunk transfer cost by up to (depth+1)x."""
+        with self._lock:
+            elapsed = ((self._t_last - self._t_first)
+                       if self._t_first is not None
+                       and self._t_last is not None else 0.0)
+            return {
+                "chunks": self._c["chunks"],
+                "steps": self._c["steps"],
+                "chunk_size": self.chunk_size,
+                "depth": self.depth,
+                "stall_s": round(self._c["stall_s"], 6),
+                "h2d_s": round(self._c["h2d_s"], 6),
+                "elapsed_s": round(elapsed, 6),
+                "stall_fraction": round(
+                    self._c["stall_s"] / elapsed, 4) if elapsed > 0
+                else None,
+            }
 
 
 class PyReader:
@@ -87,28 +323,18 @@ class PyReader:
         err: List[BaseException] = []
         stop = threading.Event()
 
-        def _put(item) -> bool:
-            """put that aborts when the consumer went away."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
         def _pump():
             try:
                 for item in self._creator():
                     # transfer happens on this thread → overlaps with
                     # the consumer's compute
-                    if not _put(self._device_put(
+                    if not _bounded_put(q, stop, self._device_put(
                             self._to_feed_dict(item))):
                         return  # consumer abandoned iteration
             except BaseException as e:  # surface in consumer
                 err.append(e)
             finally:
-                _put(_SENTINEL)
+                _bounded_put(q, stop, _SENTINEL)
 
         t = threading.Thread(target=_pump, daemon=True)
         t.start()

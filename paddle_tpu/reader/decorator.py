@@ -94,34 +94,47 @@ def compose(*readers, check_alignment=True):
 
 def buffered(reader, size):
     """Background-thread prefetch into a bounded queue (reference
-    :180) — keeps the host pipeline ahead of the device step."""
+    :180) — keeps the host pipeline ahead of the device step. The
+    filler ALWAYS terminates with an end sentinel (after an upstream
+    exception too, which is re-raised consumer-side), and a consumer
+    that abandons the iterator early releases the filler instead of
+    leaving it blocked on the full queue pinning ``size`` samples."""
 
     class _End:
         pass
 
     def data_reader():
+        # the one shared put/stop contract (pyreader._bounded_put);
+        # imported lazily so this pure-host combinator module doesn't
+        # pull the framework in at import time
+        from ..pyreader import _bounded_put
         r = reader()
         q = queue.Queue(maxsize=size)
         err = []
+        stop = threading.Event()
 
         def _fill():
             try:
                 for d in r:
-                    q.put(d)
+                    if not _bounded_put(q, stop, d):
+                        return  # consumer abandoned iteration
             except BaseException as e:  # re-raised on the consumer side
                 err.append(e)
             finally:
-                q.put(_End)
+                _bounded_put(q, stop, _End)
 
         t = threading.Thread(target=_fill, daemon=True)
         t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                if err:
-                    raise err[0]
-                return
-            yield e
+        try:
+            while True:
+                e = q.get()
+                if e is _End:
+                    if err:
+                        raise err[0]
+                    return
+                yield e
+        finally:
+            stop.set()
 
     return data_reader
 

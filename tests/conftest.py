@@ -51,6 +51,12 @@ def pytest_configure(config):
         "markers",
         "serving: serving-engine test (tier-1; select alone with "
         "-m serving)")
+    # pipelined-input suite (run_pipelined / DevicePrefetcher /
+    # chunked train_from_dataset): CPU-fast, runs inside tier-1
+    config.addinivalue_line(
+        "markers",
+        "pipeline: pipelined data-fed training test (tier-1; select "
+        "alone with -m pipeline)")
 
 
 @pytest.fixture(autouse=True)

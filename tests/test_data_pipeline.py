@@ -60,6 +60,79 @@ def test_xmap_readers():
     assert list(r()) == list(range(1, 11))
 
 
+def test_buffered_end_sentinel_after_reader_exception():
+    """A raising upstream reader must still terminate the filler with
+    the end sentinel, yield everything produced BEFORE the raise, and
+    re-raise the original error in the consumer — not hang."""
+    def bad():
+        yield from range(5)
+        raise ValueError("upstream died")
+
+    got = []
+    with pytest.raises(ValueError, match="upstream died"):
+        for x in rd.buffered(bad, size=2)():
+            got.append(x)
+    assert got == list(range(5))
+
+
+def test_buffered_abandonment_releases_filler_thread():
+    """Breaking out of a buffered() iterator must unblock the filler
+    (it is parked on the FULL queue) instead of pinning `size`
+    samples forever."""
+    import threading
+    import time
+
+    produced = []
+
+    def slow_source():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = rd.buffered(slow_source, size=2)()
+    assert next(it) == 0
+    it.close()  # abandon: GeneratorExit runs the finally -> stop
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+    n = len(produced)
+    time.sleep(0.1)
+    assert len(produced) == n  # filler really stopped
+
+
+def test_xmap_readers_worker_exception_propagates():
+    """A raising mapper must surface in the consumer (after the
+    surviving workers drain), not hang the out-queue loop."""
+    def sometimes_boom(x):
+        if x == 7:
+            raise RuntimeError("mapper blew up on 7")
+        return x * 10
+
+    r = rd.xmap_readers(sometimes_boom, _counting_reader(20),
+                        process_num=3, buffer_size=4)
+    got = []
+    with pytest.raises(RuntimeError, match="mapper blew up on 7"):
+        for x in r():
+            got.append(x)
+    assert 70 not in got
+    assert all(x % 10 == 0 for x in got)
+
+
+def test_xmap_readers_feeder_exception_propagates():
+    """An upstream reader raising inside xmap's feeder thread must
+    also surface in the consumer."""
+    def bad_reader():
+        yield from range(4)
+        raise IOError("source went away")
+
+    r = rd.xmap_readers(lambda x: x, bad_reader, process_num=2,
+                        buffer_size=4)
+    with pytest.raises(IOError, match="source went away"):
+        list(r())
+
+
 def test_data_feeder_batches_and_pads():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

@@ -1,0 +1,349 @@
+"""Pipelined data-fed training: K stacked batches ride one lax.scan
+dispatch (Executor.run_pipelined) while DevicePrefetcher stages the
+next chunk host-side — per-step parity is BIT-exact (same PRNG keys as
+sequential run() calls) and the dispatch count collapses to
+ceil(steps/K) + O(1)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+pytestmark = pytest.mark.pipeline
+
+
+def _net(seed=7, lr=1e-2):
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = seed
+    with fluid.program_guard(main, start):
+        x = layers.data("x", [32], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=64, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, start, loss
+
+
+def _feeds(n, batch=8):
+    rs = np.random.RandomState(0)
+    return [{"x": rs.rand(batch, 32).astype("float32"),
+             "y": rs.randint(0, 10, (batch, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _stack(feeds):
+    return {k: np.stack([f[k] for f in feeds]) for k in feeds[0]}
+
+
+def test_matches_per_step_run_bit_for_bit():
+    """Chunked scan losses equal sequential run() losses EXACTLY —
+    same per-step PRNG keys (fold_in(program_key, global_step)), same
+    op math, so the only difference is where the loop lives."""
+    feeds = _feeds(6)
+    main, start, loss = _net()
+    s1 = fluid.core.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(s1):
+        exe.run(start)
+        seq = [float(np.ravel(exe.run(main, feed=f,
+                                      fetch_list=[loss])[0])[0])
+               for f in feeds]
+
+    main2, start2, loss2 = _net()
+    s2 = fluid.core.Scope()
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(s2):
+        exe2.run(start2)
+        d0 = exe2.dispatch_count
+        r1 = exe2.run_pipelined(main2, feed_chunk=_stack(feeds[:3]),
+                                fetch_list=[loss2])
+        r2 = exe2.run_pipelined(main2, feed_chunk=_stack(feeds[3:]),
+                                fetch_list=[loss2])
+        # 6 steps, K=3 -> exactly 2 device dispatches, 1 chunk compile
+        assert exe2.dispatch_count - d0 == 2
+    assert float(np.ravel(r1)[0]) == seq[2]
+    assert float(np.ravel(r2)[0]) == seq[5]
+
+
+def test_rng_ops_fold_the_sequential_keys():
+    """Dropout inside the chunk must draw the EXACT mask the same
+    global step would draw from a sequential run() call — the
+    accumulated mask sums match bitwise."""
+    def build():
+        main, start = fluid.Program(), fluid.Program()
+        main.random_seed = start.random_seed = 3
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [64], dtype="float32")
+            d = layers.dropout(x, dropout_prob=0.5)
+            step_sum = layers.reduce_sum(d)
+            acc = layers.create_global_var(
+                shape=[1], value=0.0, dtype="float32",
+                persistable=True, name="acc")
+            layers.assign(layers.elementwise_add(
+                acc, layers.reshape(step_sum, [1])), acc)
+        return main, start
+
+    feeds = [{"x": np.full((4, 64), 1.0 + i, np.float32)}
+             for i in range(3)]
+
+    main, start = build()
+    s1 = fluid.core.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(s1):
+        exe.run(start)
+        for f in feeds:
+            out = exe.run(main, feed=f, fetch_list=["acc"])
+    want = float(np.ravel(out[0])[0])
+
+    main2, start2 = build()
+    s2 = fluid.core.Scope()
+    exe2 = fluid.Executor()
+    with fluid.scope_guard(s2):
+        exe2.run(start2)
+        out2 = exe2.run_pipelined(main2, feed_chunk=_stack(feeds),
+                                  fetch_list=["acc"])
+    assert float(np.ravel(out2[0])[0]) == want
+
+
+def test_ragged_tail_chunk_and_compile_accounting():
+    """A shorter tail chunk runs correctly and costs exactly one
+    extra compile (its K is part of the shape signature)."""
+    feeds = _feeds(5)
+    main, start, loss = _net()
+    sc = fluid.core.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(sc):
+        exe.run(start)
+        c0 = exe.compile_count
+        exe.run_pipelined(main, feed_chunk=_stack(feeds[:4]),
+                          fetch_list=[loss])
+        assert exe.compile_count - c0 == 1
+        exe.run_pipelined(main, feed_chunk=_stack(feeds[:4]),
+                          fetch_list=[loss])
+        assert exe.compile_count - c0 == 1  # same shape: cached
+        out = exe.run_pipelined(main, feed_chunk=_stack(feeds[4:]),
+                                fetch_list=[loss])
+        assert exe.compile_count - c0 == 2  # tail K=1
+    assert np.isfinite(np.ravel(out[0])[0])
+
+
+def test_feed_chunk_validation():
+    main, start, loss = _net()
+    sc = fluid.core.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(sc):
+        exe.run(start)
+        with pytest.raises(fluid.core.InvalidArgumentError,
+                           match="non-empty"):
+            exe.run_pipelined(main, feed_chunk={},
+                              fetch_list=[loss])
+        bad = _stack(_feeds(3))
+        bad["y"] = bad["y"][:2]
+        with pytest.raises(fluid.core.InvalidArgumentError,
+                           match="leading dims disagree"):
+            exe.run_pipelined(main, feed_chunk=bad,
+                              fetch_list=[loss])
+        # per-step slice shape is validated against the declaration
+        bad2 = _stack(_feeds(3))
+        bad2["x"] = bad2["x"][:, :, :16]
+        with pytest.raises(fluid.core.InvalidArgumentError,
+                           match="shape"):
+            exe.run_pipelined(main, feed_chunk=bad2,
+                              fetch_list=[loss])
+
+
+# ---------------------------------------------------------------------
+# DevicePrefetcher
+# ---------------------------------------------------------------------
+
+def test_prefetcher_stacks_chunks_and_reports_stats():
+    feeds = _feeds(7)
+    with fluid.DevicePrefetcher(iter(feeds), chunk_size=3,
+                                depth=2) as pf:
+        got = list(pf)
+    assert [k for _, k in got] == [3, 3, 1]
+    chunk0 = got[0][0]
+    assert chunk0["x"].shape == (3, 8, 32)
+    np.testing.assert_array_equal(np.asarray(chunk0["x"]),
+                                  np.stack([f["x"] for f in
+                                            feeds[:3]]))
+    stats = pf.stats()
+    assert stats["chunks"] == 3 and stats["steps"] == 7
+    assert stats["stall_s"] >= 0.0
+    assert stats["stall_fraction"] is None or \
+        0.0 <= stats["stall_fraction"] <= 1.0
+
+
+def test_prefetcher_propagates_generator_exception():
+    def gen():
+        yield _feeds(1)[0]
+        raise RuntimeError("reader blew up")
+
+    pf = fluid.DevicePrefetcher(gen(), chunk_size=1)
+    next(pf)
+    with pytest.raises(RuntimeError, match="reader blew up"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetcher_close_releases_producer():
+    """Abandoning iteration mid-stream must not leave the producer
+    blocked on the bounded queue forever."""
+    produced = []
+
+    def gen():
+        for f in _feeds(100):
+            produced.append(1)
+            yield f
+
+    pf = fluid.DevicePrefetcher(gen(), chunk_size=2, depth=1)
+    next(pf)
+    pf.close()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n  # really stopped
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetcher_rejects_heterogeneous_keys():
+    batches = [{"x": np.ones((2, 4), np.float32)},
+               {"y": np.ones((2, 4), np.float32)}]
+    pf = fluid.DevicePrefetcher(iter(batches), chunk_size=2)
+    with pytest.raises(fluid.core.InvalidArgumentError,
+                       match="homogeneous"):
+        next(pf)
+    pf.close()
+
+
+# ---------------------------------------------------------------------
+# train_from_dataset / infer_from_dataset routing
+# ---------------------------------------------------------------------
+
+def _write_multislot(tmp_path, n_lines, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.rand(30).astype(np.float32)
+    p = tmp_path / "train.txt"
+    with open(p, "w") as f:
+        for _ in range(n_lines):
+            ids = rs.randint(0, 30, 4)
+            f.write("4 %s 1 %.6f\n"
+                    % (" ".join(map(str, ids)), w[ids].sum()))
+    return str(p)
+
+
+def _dataset_net(lr=0.1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[8, 4], dtype="int64",
+                          append_batch_size=False)
+        label = layers.data("label", shape=[8, 1],
+                            append_batch_size=False)
+        emb = layers.embedding(ids, size=(30, 1),
+                               param_attr=fluid.ParamAttr(
+                                   name="table"))
+        pred = layers.reduce_sum(
+            layers.reshape(emb, (8, 4)), dim=1, keep_dim=True)
+        loss = layers.reduce_mean(
+            layers.square_error_cost(input=pred, label=label))
+        if lr:
+            fluid.optimizer.Adam(lr).minimize(loss)
+    return main, startup, ids, label, loss
+
+
+def _make_dataset(path, ids, label, batch=8):
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist([path])
+    ds.set_batch_size(batch)
+    ds.set_use_var([ids, label])
+    ds.load_into_memory()
+    return ds
+
+
+def test_train_from_dataset_dispatch_bound_and_parity(tmp_path):
+    """40 data-fed steps with chunk_size=4 issue exactly ceil(40/4)
+    dispatches, produce the same final weights as the per-step loop,
+    and record prefetch stats."""
+    path = _write_multislot(tmp_path, 320)
+
+    def run(chunk_size):
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            main, startup, ids, label, loss = _dataset_net()
+            ds = _make_dataset(path, ids, label)
+            exe = fluid.Executor()
+            exe.run(startup)
+            d0 = exe.dispatch_count
+            n = exe.train_from_dataset(main, ds,
+                                       chunk_size=chunk_size)
+            table = np.asarray(scope.find_var("table"))
+            return n, exe.dispatch_count - d0, table, exe
+
+    n_pipe, d_pipe, w_pipe, exe = run(chunk_size=4)
+    assert n_pipe == 40
+    assert d_pipe == 10  # ceil(40/4), zero per-step dispatches
+    stats = exe.last_pipeline_stats
+    assert stats is not None and stats["steps"] == 40 \
+        and stats["chunks"] == 10
+
+    n_step, d_step, w_step, _ = run(chunk_size=1)
+    assert n_step == 40 and d_step == 40
+    np.testing.assert_array_equal(w_pipe, w_step)
+
+
+def test_entry_point_labels(tmp_path, capsys):
+    """Progress lines carry the ACTUAL entry point's name — inference
+    through infer_from_dataset must not print [train_from_dataset]."""
+    path = _write_multislot(tmp_path, 64)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, ids, label, loss = _dataset_net()
+        ds = _make_dataset(path, ids, label)
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.train_from_dataset(main, ds, fetch_list=[loss],
+                               print_period=4, chunk_size=4)
+        train_out = capsys.readouterr().out
+        infer_prog = main.clone(for_test=True)
+        exe.infer_from_dataset(infer_prog, ds, fetch_list=[loss],
+                               print_period=4, chunk_size=4)
+        infer_out = capsys.readouterr().out
+    assert "[train_from_dataset] step" in train_out
+    assert "[infer_from_dataset] step" in infer_out
+    assert "[train_from_dataset]" not in infer_out
+
+
+def test_infer_from_dataset_per_step_label(tmp_path, capsys):
+    """The per-step (chunk_size=1) loop is labelled too."""
+    path = _write_multislot(tmp_path, 32)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        main, startup, ids, label, loss = _dataset_net(lr=0)
+        ds = _make_dataset(path, ids, label)
+        exe = fluid.Executor()
+        exe.run(startup)
+        n = exe.infer_from_dataset(main, ds, fetch_list=[loss],
+                                   print_period=2, chunk_size=1)
+    assert n == 4
+    out = capsys.readouterr().out
+    assert "[infer_from_dataset] step 2" in out
+    assert "[train_from_dataset]" not in out
+
+
+def test_chunk_iterator_matches_prefetcher_stacking(tmp_path):
+    path = _write_multislot(tmp_path, 80)
+    main, startup, ids, label, loss = _dataset_net(lr=0)
+    ds = _make_dataset(path, ids, label)
+    chunks = list(ds.chunk_iterator(4))
+    assert [k for _, k in chunks] == [4, 4, 2]
+    assert chunks[0][0]["ids"].shape == (4, 8, 4)
+    full = list(ds.chunk_iterator(4, drop_last_chunk=True))
+    assert [k for _, k in full] == [4, 4]

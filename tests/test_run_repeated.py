@@ -136,3 +136,54 @@ def test_library_respected_by_fallback_loop(monkeypatch):
     assert seen == ["pallas", "pallas"]
     assert FLAGS.op_library == prev
     assert np.isfinite(np.ravel(out[0])[0])
+
+
+def test_fallback_loop_hoists_validation_and_conversion(monkeypatch):
+    """The eager fallback repeats ONE feed dict, so shape/dtype
+    validation and feed->jnp conversion must run once up front, not
+    once per iteration."""
+    import jax
+
+    import paddle_tpu.executor as executor_mod
+
+    main, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, start):
+        x = layers.data("x", [8], dtype="float32")
+        arr = layers.create_array("float32")
+        layers.array_write(x, layers.fill_constant([1], "int64", 0),
+                           array=arr)
+        y = layers.array_read(arr, layers.fill_constant([1], "int64",
+                                                        0))
+        loss = layers.reduce_sum(y)
+    assert executor_mod._needs_eager(main)
+
+    calls = []
+    orig_check = executor_mod._check_feed_shape_type
+
+    def counting_check(block, feed):
+        calls.append(1)
+        return orig_check(block, feed)
+
+    monkeypatch.setattr(executor_mod, "_check_feed_shape_type",
+                        counting_check)
+    converted = []
+    orig_run = fluid.Executor.run
+
+    def spy(self, program=None, feed=None, **kw):
+        converted.append(all(isinstance(v, jax.Array)
+                             for v in (feed or {}).values()))
+        return orig_run(self, program=program, feed=feed, **kw)
+
+    monkeypatch.setattr(fluid.Executor, "run", spy)
+    sc = fluid.core.Scope()
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 8), np.float32)}
+    with fluid.scope_guard(sc):
+        exe.run(start)
+        calls.clear()
+        converted.clear()
+        out = exe.run_repeated(main, feed=feed, fetch_list=[loss],
+                               iters=4)
+    assert len(calls) == 1  # validated once, not per iteration
+    assert converted == [True] * 4  # run() got ready device arrays
+    assert np.isfinite(np.ravel(out[0])[0])
