@@ -927,6 +927,114 @@ def bench_guarded_overhead(batch=2048, warmup=5, iters=100):
             "guarded_steps_per_sec": round(guarded_sps, 2)}
 
 
+def bench_ps_degraded(steps=16):
+    """Distributed PS resilience cost row: sync steps/s of a tiny
+    2-trainer PS run (in-process pserver over real TCP) in three
+    regimes — fault-free at n=2, through a 1%-request-drop NetFaultProxy
+    (deadline + retry + seq-dedup overhead), and at n-1 after one
+    trainer's lease expires (graceful degradation throughput). The
+    absolute numbers are transport-bound on this tiny model; the ROW's
+    job is the RATIOS: drop-recovery and eviction must not collapse
+    throughput."""
+    import tempfile
+    import threading
+    import time as _time
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.distributed import (ParameterServerRuntime,
+                                        PServerRuntime)
+    from paddle_tpu.resilience import NetFaultProxy, RetryPolicy
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    def build(n_trainers):
+        main, start = fluid.Program(), fluid.Program()
+        main.random_seed = start.random_seed = 5
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, start):
+                x = layers.data("x", [16], dtype="float32")
+                label = layers.data("label", [1], dtype="int64")
+                pred = layers.fc(x, size=4, act="softmax")
+                loss = layers.mean(layers.cross_entropy(pred, label))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=start,
+                    pservers="127.0.0.1:0", trainers=n_trainers)
+        return t, start, loss
+
+    def feed():
+        rs = np.random.RandomState(3)
+        return {"x": rs.rand(64, 16).astype(np.float32),
+                "label": rs.randint(0, 4, (64, 1)).astype(np.int64)}
+
+    def run(n_trainers, proxy=None, die_tid=None, lease=None):
+        t, start, loss = build(n_trainers)
+        s = PServerRuntime(t, t.pserver_endpoints[0],
+                           lease_timeout_s=lease,
+                           allow_degraded=lease is not None)
+        dial = s.serv.endpoint
+        p = None
+        if proxy is not None:
+            p = NetFaultProxy(s.serv.endpoint, seed=1)
+            p.set_drop_rate(proxy)
+            dial = p.endpoint
+        t.set_block_endpoints(s._minis.keys(), dial)
+        s.serv.start()
+        trainer = t.get_trainer_program()
+        f = feed()
+        walls = {}
+
+        def run_trainer(tid):
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            kw = dict(deadline_s=0.5, connect_timeout_s=20.0)
+            if lease is not None:
+                kw["heartbeat_interval_s"] = 0.1
+            if proxy is not None:
+                kw["retry"] = RetryPolicy(max_retries=8,
+                                          base_delay=0.02,
+                                          max_delay=0.2, seed=2)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        trainer_id=tid, **kw)
+            rt.init_params()
+            n_mine = 2 if tid == die_tid else steps
+            rt.run_step(exe, f, fetch_list=[loss])  # warmup/compile
+            t0 = _time.monotonic()
+            for _ in range(n_mine - 1):
+                rt.run_step(exe, f, fetch_list=[loss])
+            walls[tid] = _time.monotonic() - t0
+            if tid == die_tid:
+                rt.stop_heartbeats()
+                rt.comm.stop()
+            else:
+                rt.complete()
+
+        ths = [threading.Thread(target=run_trainer, args=(i,))
+               for i in range(n_trainers)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=300)
+        s.serv.shutdown()
+        if p is not None:
+            p.close()
+        survivor = 0 if die_tid != 0 else 1
+        return (steps - 1) / max(walls.get(survivor, 1e9), 1e-9)
+
+    n2 = run(2)
+    n2_drop = run(2, proxy=0.01)
+    n1_degraded = run(2, die_tid=1, lease=0.5)
+    return {"metric": "ps_degraded_throughput",
+            "value": round(n2, 2), "unit": "sync steps/sec (n=2)",
+            "n2_steps_per_sec": round(n2, 2),
+            "n2_drop1pct_steps_per_sec": round(n2_drop, 2),
+            "n1_degraded_steps_per_sec": round(n1_degraded, 2),
+            "drop1pct_ratio": round(n2_drop / n2, 3) if n2 else None,
+            "degraded_ratio": round(n1_degraded / n2, 3) if n2
+            else None}
+
+
 _EMITTED = []
 
 
@@ -1130,7 +1238,7 @@ def child_main():
         # configs that measure in seconds. A stall in any config
         # forfeits only the ones after it.
         extra = [bench_mnist_mlp, bench_pipelined_train,
-                 bench_guarded_overhead,
+                 bench_guarded_overhead, bench_ps_degraded,
                  bench_serving_latency,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,

@@ -15,8 +15,11 @@ TPU-native split:
   optimize step runs through the normal Executor.
 """
 
-from .rpc import RPCClient, RPCServer, VERBS  # noqa: F401
-from .ps import (Communicator, ListenAndServ,  # noqa: F401
-                 ParameterServerRuntime, PServerRuntime)
+from .rpc import (RPCClient, RPCServer, VERBS,  # noqa: F401
+                  BarrierAborted, DeadlineExceededError,
+                  RemoteHandlerError, RpcError, TrainerEvicted)
+from .ps import (Communicator, HeartbeatThread,  # noqa: F401
+                 ListenAndServ, ParameterServerRuntime,
+                 PServerRuntime, ShardSnapshotter)
 from .lookup_service import LargeScaleKV, LookupServiceClient  # noqa: F401
 from .sparse import SparseEmbeddingRuntime  # noqa: F401

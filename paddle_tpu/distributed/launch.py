@@ -27,6 +27,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 from argparse import REMAINDER, ArgumentParser
 
 
@@ -95,7 +96,7 @@ def get_cluster_env(args):
     return envs
 
 
-def launch(args):
+def launch(args, poll_interval_s=0.2, term_grace_s=10.0):
     envs = get_cluster_env(args)
     procs, logs = [], []
     if args.log_dir:
@@ -114,15 +115,28 @@ def launch(args):
                                       stderr=out))
     rc = 0
     try:
-        for p in procs:
-            p.wait()
-            rc = rc or p.returncode
-            if p.returncode != 0:
+        # Poll EVERY worker: the first failure anywhere triggers
+        # terminate-all immediately. (A sequential p.wait() blocked on
+        # worker 0, so a crash in worker N>0 wedged the surviving
+        # collective until worker 0 happened to exit on its own.)
+        while True:
+            statuses = [p.poll() for p in procs]
+            failed = [s for s in statuses if s is not None and s != 0]
+            if failed:
+                rc = failed[0]
                 # one dead worker wedges the collective — take the
                 # rest down (the reference launcher's terminate-all)
                 for q in procs:
                     if q.poll() is None:
                         q.send_signal(signal.SIGTERM)
+                deadline = time.time() + term_grace_s
+                while time.time() < deadline and \
+                        any(q.poll() is None for q in procs):
+                    time.sleep(poll_interval_s)
+                break
+            if all(s is not None for s in statuses):
+                break
+            time.sleep(poll_interval_s)
     finally:
         for q in procs:
             if q.poll() is None:

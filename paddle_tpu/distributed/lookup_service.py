@@ -101,12 +101,31 @@ class LargeScaleKV:
 class LookupServiceClient:
     """Trainer-side prefetch/push over the pserver shards
     (parameter_prefetch.cc analog). Rows hash-shard by
-    ``id % n_shards`` (the reference's RoundRobin section split)."""
+    ``id % n_shards`` (the reference's RoundRobin section split).
 
-    def __init__(self, table_name: str, endpoints: List[str], dim: int):
+    ``deadline_s``/``retry`` plumb straight into each shard's RPCClient
+    (prefetch is idempotent, so transparent retry is always safe; with
+    a ``trainer_id`` every push carries a monotonic seq so a replayed
+    push is deduped server-side instead of double-applied)."""
+
+    def __init__(self, table_name: str, endpoints: List[str], dim: int,
+                 deadline_s=30.0, retry=None, trainer_id=None):
         self.table = table_name
         self.dim = dim
-        self.clients = [RPCClient(ep) for ep in endpoints]
+        self.trainer_id = trainer_id
+        self.clients = [RPCClient(ep, deadline_s=deadline_s,
+                                  retry=retry, trainer_id=trainer_id)
+                        for ep in endpoints]
+        # per-SHARD counters: each shard's _SeqTracker must see a dense
+        # stream or its watermark never compacts (see Communicator
+        # .next_seq)
+        self._seqs = [0] * len(self.clients)
+
+    def _next_seq(self, shard):
+        if self.trainer_id is None:
+            return None
+        self._seqs[shard] += 1
+        return self._seqs[shard]
 
     def _shard(self, ids):
         return np.asarray(ids, np.int64) % len(self.clients)
@@ -133,7 +152,8 @@ class LookupServiceClient:
         for s, client in enumerate(self.clients):
             mask = shard == s
             if mask.any():
-                client.push_sparse(self.table, ids[mask], grads[mask])
+                client.push_sparse(self.table, ids[mask], grads[mask],
+                                   seq=self._next_seq(s))
 
     def embed_batch(self, id_batch) -> np.ndarray:
         """Lookup for a [batch, slots] id matrix -> [batch, slots, dim]

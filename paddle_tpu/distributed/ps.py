@@ -10,6 +10,16 @@ Reference:
   grads per param before one send; RecvThread pulling fresh params.
 - grad merge on the server: _append_pserver_grad_merge_ops
   (distribute_transpiler.py:1807).
+- failure posture: the reference's gRPC layer retries through pserver
+  restarts and checkpoint_notify snapshots server-side shards
+  (distribute_transpiler.py:1612, checkpoint_notify_op.cc:87). Here
+  that becomes: per-trainer monotonic sequence numbers dedupe replayed
+  SENDs, HEARTBEAT leases let the server evict dead trainers (or abort
+  the barrier so nobody hangs), step-boundary shard snapshots (durable
+  via io.durable_publish_dir) let a restarted PServerRuntime resume,
+  and the trainer replays a whole communication phase whenever any of
+  its connections had to be re-established — which, combined with the
+  dedup, keeps sync-mode training EXACT across a pserver kill+restart.
 
 TPU-native shape: the transport is the native tensor_rpc library; the
 server's optimize step runs each param's update op through the normal
@@ -20,6 +30,8 @@ async SGD, and the sparse/>HBM path (lookup_service.py).
 
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
 import time
@@ -27,10 +39,60 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..core.enforce import InvalidArgumentError, enforce
+from ..core.enforce import enforce
 from ..core.flags import FLAGS
-from ..io import deserialize_tensor, serialize_tensor
-from .rpc import RPCClient, RPCServer
+from ..io import (deserialize_tensor, durable_publish_dir,
+                  remove_marked_dir, serialize_tensor)
+from ..resilience.retry import RetryBudgetExhausted, RetryPolicy
+from .rpc import (STATUS_ABORTED, STATUS_EVICTED, RPCClient, RPCServer,
+                  RpcError, ServerCrash, StatusReply, TrainerEvicted,
+                  unpack_wire_name)
+
+
+class _SeqTracker:
+    """Per-trainer idempotency bookkeeping: a watermark (every seq <=
+    it is seen) plus the out-of-order window above it. Set-shaped
+    because a client-level retry can land a LATER seq on a freshly
+    restarted server before an earlier one's phase replay arrives —
+    a plain high-watermark would then discard the replayed (and still
+    unapplied) earlier grads."""
+
+    def __init__(self):
+        self._wm: Dict[int, int] = {}
+        self._ahead: Dict[int, set] = {}
+
+    def seen(self, tid: int, seq: int) -> bool:
+        """True if (tid, seq) was already recorded; records otherwise."""
+        wm = self._wm.get(tid, 0)
+        if seq <= wm:
+            return True
+        ahead = self._ahead.setdefault(tid, set())
+        if seq in ahead:
+            return True
+        ahead.add(seq)
+        while wm + 1 in ahead:  # compact the window into the watermark
+            wm += 1
+            ahead.discard(wm)
+        self._wm[tid] = wm
+        return False
+
+    def to_meta(self) -> dict:
+        return {"wm": {str(k): int(v) for k, v in self._wm.items()},
+                "ahead": {str(k): sorted(int(x) for x in v)
+                          for k, v in self._ahead.items() if v}}
+
+    @classmethod
+    def from_meta(cls, meta) -> "_SeqTracker":
+        t = cls()
+        t._wm = {int(k): int(v)
+                 for k, v in (meta or {}).get("wm", {}).items()}
+        t._ahead = {int(k): set(int(x) for x in v)
+                    for k, v in (meta or {}).get("ahead", {}).items()}
+        return t
+
+
+# pseudo-var a GET resolves to the server's incarnation nonce
+INCARNATION_KEY = "__incarnation__"
 
 
 class ListenAndServ:
@@ -38,15 +100,37 @@ class ListenAndServ:
 
     ``optimize_fn(param_name, grad_ndarray)`` applies one merged grad
     to the server-resident param and returns nothing; ``params`` maps
-    name -> initial ndarray. In sync mode the loop waits for
-    ``n_trainers`` SENDs per grad name, sums them, optimizes once, and
+    name -> initial ndarray. In sync mode the loop waits for one SEND
+    per ACTIVE trainer per grad name, sums them, optimizes once, and
     releases the barrier (RunSyncLoop :109). In async mode every
     arriving grad optimizes immediately (RunAsyncLoop :225).
+
+    Fault tolerance:
+
+    - SENDs/PUSH_SPARSEs carrying a ``(trainer_id, seq)`` wire suffix
+      are deduplicated per trainer (idempotent replay after a client
+      deadline/reconnect — a replayed grad is acked, never re-merged);
+    - ``lease_timeout_s`` arms liveness leases: trainers renew via
+      HEARTBEAT; when a lease expires the monitor either EVICTS the
+      trainer (``allow_degraded`` — training continues at n-1, a
+      structured ``trainer_evicted`` event is recorded, the barrier
+      quorum shrinks) or releases every parked barrier waiter with a
+      ``BarrierAborted`` error status so nobody hangs;
+    - COMPLETEd trainers leave the barrier/merge quorum, so a straggler
+      parked on the barrier is released rather than stranded, and
+      ``shutdown`` answers any still-parked waiter with an error status
+      before closing the sockets;
+    - ``snapshot_fn(boundary, meta)`` is called at sync step boundaries
+      (send-barrier release with no pending merges — a consistent
+      point) or, in async mode, every ``snapshot_every`` applies; the
+      PServerRuntime plugs durable shard snapshots in here.
     """
 
     def __init__(self, endpoint, params: Dict[str, np.ndarray],
                  optimize_fn, n_trainers=1, sync_mode=True,
-                 lookup_tables=None):
+                 lookup_tables=None, lease_timeout_s=None,
+                 allow_degraded=None, snapshot_fn=None,
+                 snapshot_every=1, restore_meta=None, on_event=None):
         self.server = RPCServer(endpoint)
         self.endpoint = self.server.endpoint
         # any Mapping works — PServerRuntime passes a live scope view
@@ -54,11 +138,52 @@ class ListenAndServ:
         self.optimize_fn = optimize_fn
         self.n_trainers = n_trainers
         self.sync_mode = sync_mode
+        self.lease_timeout_s = lease_timeout_s
+        self.allow_degraded = (not sync_mode) if allow_degraded is None \
+            else bool(allow_degraded)
+        self._snapshot_fn = snapshot_fn
+        self._snapshot_every = max(1, int(snapshot_every))
+        self._on_event = on_event
+        self.events: List[dict] = []
         self._mu = threading.Lock()
-        self._pending: Dict[str, List[np.ndarray]] = {}
-        self._barrier_waiters: List = []
-        self._completed = 0
+        # sync merge: name -> [(trainer_id|None, grad), ...]
+        self._pending: Dict[str, List] = {}
+        # barrier: key -> (tid|None, base_name, responder); keyed by
+        # trainer id so a REPLAYED barrier (deadline + reconnect)
+        # replaces its own stale parked entry instead of forging quorum
+        self._barrier_waiters: Dict = {}
+        self._barrier_anon = 0
+        self._completed = 0            # legacy tid-less COMPLETEs
+        self._completed_tids = set()
+        self._evicted = set()
+        self._leases: Dict[int, float] = {}
+        # idempotency trackers, per trainer, per channel (SEND and
+        # PUSH_SPARSE carry independent monotonic counters)
+        self._seen_send = _SeqTracker()
+        self._seen_push = _SeqTracker()
+        # incarnation nonce: trainers compare it across reconnects to
+        # tell "the network blipped" (same nonce -> acked state intact)
+        # from "the server restarted" (new nonce -> replay the phase)
+        import uuid
+        self._incarnation = uuid.uuid4().hex.encode()
+        self._boundary = 0
+        self._aborted = None
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self._crash_at: Dict[str, int] = {}
         self.lookup_tables = lookup_tables or {}
+        if restore_meta:
+            self._seen_send = _SeqTracker.from_meta(
+                restore_meta.get("send_seqs"))
+            # push seqs are deliberately NOT restored: lookup-table
+            # contents live outside the snapshotted scope, so a replayed
+            # push whose pre-crash effect was lost with the table MUST
+            # re-apply, not dedupe against a stale tracker
+            self._completed_tids = set(
+                int(t) for t in restore_meta.get("completed", []))
+            self._evicted = set(
+                int(t) for t in restore_meta.get("evicted", []))
+            self._boundary = int(restore_meta.get("boundary", 0))
 
         s = self.server
         s.register("SEND", self._on_send)
@@ -71,25 +196,111 @@ class ListenAndServ:
         s.register("COMPLETE", self._on_complete)
         s.register("PREFETCH", self._on_prefetch)
         s.register("PUSH_SPARSE", self._on_push_sparse)
+        s.register("HEARTBEAT", self._on_heartbeat)
+
+    # -- events / chaos -----------------------------------------------------
+    def _event(self, kind, **kw):
+        ev = dict(kind=kind, t=time.time(), **kw)
+        self.events.append(ev)
+        if self._on_event is not None:
+            try:
+                self._on_event(ev)
+            except Exception:
+                pass
+
+    def crash_after(self, verb: str, n: int):
+        """Chaos seam: hard-kill the server (sockets closed, nothing
+        answered — a SIGKILL stand-in) the moment the n-th subsequent
+        request of ``verb`` arrives, BEFORE it mutates any state."""
+        self._crash_at[verb] = int(n)
+        return self
+
+    def _chaos_tick(self, verb):
+        n = self._crash_at.get(verb)
+        if n is None:
+            return
+        n -= 1
+        if n <= 0:
+            self._crash_at.pop(verb)
+            raise ServerCrash("injected pserver kill on %s" % verb)
+        self._crash_at[verb] = n
+
+    # -- quorum bookkeeping (all _locked: caller holds self._mu) ------------
+    def _quorum_locked(self):
+        # union, not sum: a trainer can be BOTH evicted and completed
+        # (a slow-but-alive evictee's COMPLETE still lands) and must
+        # shrink the quorum exactly once
+        gone = len(self._evicted | self._completed_tids)
+        return max(0, self.n_trainers - gone - self._completed)
+
+    def _active_tids_locked(self):
+        # trainer ids are 0..n-1 (the launcher's PADDLE_TRAINER_ID
+        # contract), so the active universe is knowable server-side
+        return (set(range(self.n_trainers)) - self._evicted
+                - self._completed_tids)
+
+    def _touch_lease_locked(self, tid):
+        # traffic renews a lease, but only HEARTBEAT registers one: a
+        # trainer that never heartbeats is never lease-tracked (and so
+        # never falsely evicted for a long local compute step)
+        if tid is not None and tid in self._leases:
+            self._leases[tid] = time.monotonic()
+
+    def _check_live_locked(self, tid):
+        if self._aborted is not None:
+            raise StatusReply(STATUS_ABORTED,
+                              ("BarrierAborted: %s"
+                               % self._aborted).encode())
+        if tid is not None and tid in self._evicted:
+            raise StatusReply(STATUS_EVICTED,
+                              ("TrainerEvicted: trainer %d lease "
+                               "expired on %s" % (tid,
+                                                  self.endpoint)).encode())
 
     # -- handlers (each runs on the server drain thread) -------------------
     def _on_send(self, name, payload):
-        # "var@@tid" carries the sender's trainer id (DC-ASGD needs
-        # per-trainer weight backups; reference enable_dc_asgd,
-        # _append_dc_asgd_ops :1849). Single drain thread -> the
-        # current_trainer_id attribute is race-free.
-        name, _, tid = name.partition("@@")
-        self.current_trainer_id = int(tid) if tid else 0
+        self._chaos_tick("SEND")
+        # "var@@tid[@@seq]" carries the sender's trainer id (DC-ASGD
+        # needs per-trainer weight backups; reference enable_dc_asgd,
+        # _append_dc_asgd_ops :1849) and the idempotency sequence
+        # number. Single drain thread -> current_trainer_id is
+        # race-free for the apply it precedes.
+        name, tid, seq = unpack_wire_name(name)
+        self.current_trainer_id = tid if tid is not None else 0
         grad, _ = deserialize_tensor(payload)
         with self._mu:
+            self._touch_lease_locked(tid)
+            self._check_live_locked(tid)
+            if tid is not None and seq is not None:
+                if self._seen_send.seen(tid, seq):
+                    # replayed frame (client deadline / reconnect /
+                    # duplicated by the network): ack, never re-apply
+                    self._event("dup_send_ignored", name=name, tid=tid,
+                                seq=seq)
+                    return b""
             if not self.sync_mode:
                 self._apply(name, grad)
+                self._maybe_snapshot_locked()
                 return b""
-            self._pending.setdefault(name, []).append(grad)
-            if len(self._pending[name]) >= self.n_trainers:
-                merged = np.sum(self._pending.pop(name), axis=0)
-                self._apply(name, merged)
+            self._pending.setdefault(name, []).append((tid, grad))
+            self._maybe_merge_locked(name)
         return b""
+
+    def _maybe_merge_locked(self, name):
+        entries = self._pending.get(name)
+        if not entries:
+            return
+        tids = {t for t, _ in entries}
+        if None in tids:
+            # legacy tid-less senders: count-based quorum
+            ready = len(entries) >= max(1, self._quorum_locked())
+        else:
+            active = self._active_tids_locked()
+            ready = bool(active) and active <= tids
+        if ready:
+            merged = np.sum([g for _, g in self._pending.pop(name)],
+                            axis=0)
+            self._apply(name, merged)
 
     def _apply(self, name, grad):
         enforce(name in self.params,
@@ -97,35 +308,138 @@ class ListenAndServ:
         self.optimize_fn(name, grad)
 
     def _on_get(self, name, payload):
+        name, tid, _ = unpack_wire_name(name)
+        if name == INCARNATION_KEY:
+            return self._incarnation
         with self._mu:
+            self._touch_lease_locked(tid)
             enforce(name in self.params, "no param %r" % name)
             return serialize_tensor(np.asarray(self.params[name]))
 
     def _on_barrier(self, name, payload, responder):
-        """Sync-mode step barrier: all trainers must arrive before any
-        proceeds (send_barrier/fetch_barrier ops). Non-blocking: the
-        reply is parked until the n-th trainer arrives."""
-        release = None
+        """Sync-mode step barrier: all ACTIVE trainers must arrive
+        before any proceeds (send_barrier/fetch_barrier ops).
+        Non-blocking: the reply is parked until the quorum arrives.
+        Keyed by trainer id so a replayed barrier supersedes its own
+        stale parked entry."""
+        self._chaos_tick("BARRIER")
+        base, tid, _ = unpack_wire_name(name)
+        stale = None
         with self._mu:
-            self._barrier_waiters.append(responder)
-            if len(self._barrier_waiters) >= self.n_trainers:
-                release, self._barrier_waiters = \
-                    self._barrier_waiters, []
-        if release is not None:
-            for r in release:
-                r(0, b"")
+            self._touch_lease_locked(tid)
+            self._check_live_locked(tid)
+            if tid is not None:
+                key = ("t", tid)
+            else:
+                self._barrier_anon += 1
+                key = ("a", self._barrier_anon)
+            stale = self._barrier_waiters.pop(key, None)
+            self._barrier_waiters[key] = (tid, base, responder)
+            release = self._maybe_release_barrier_locked()
+        if stale is not None:
+            # answer the superseded responder so the native layer frees
+            # its parked request (its connection is typically dead)
+            stale[2](STATUS_ABORTED,
+                     b"BarrierAborted: superseded by replayed barrier")
+        self._release(release)
+
+    def _maybe_release_barrier_locked(self):
+        """Returns the waiters to release (outside the lock), or None.
+        At a sync send-barrier release with no pending merges — a
+        consistent end-of-step point — the shard snapshot is taken
+        BEFORE the acks go out, so a crash after trainers move on can
+        only restore to a state their replay protocol handles."""
+        if not self._barrier_waiters:
+            return None
+        if len(self._barrier_waiters) < max(1, self._quorum_locked()):
+            return None
+        waiters = list(self._barrier_waiters.values())
+        self._barrier_waiters = {}
+        bases = {b for _, b, _ in waiters}
+        if self.sync_mode and not self._pending \
+                and "fetch" not in bases:
+            self._maybe_snapshot_locked()
+        return waiters
+
+    def _release(self, waiters, status=0, msg=b""):
+        if waiters:
+            for _, _, r in waiters:
+                r(status, msg)
+
+    def _maybe_snapshot_locked(self):
+        if self._snapshot_fn is None:
+            return
+        self._boundary += 1
+        if self._boundary % self._snapshot_every:
+            return
+        meta = {
+            "send_seqs": self._seen_send.to_meta(),
+            "completed": sorted(self._completed_tids),
+            "evicted": sorted(self._evicted),
+            "boundary": self._boundary,
+        }
+        t0 = time.monotonic()
+        try:
+            self._snapshot_fn(self._boundary, meta)
+            self._event("snapshot", boundary=self._boundary)
+        except Exception as e:  # a failed snapshot must not kill serving
+            self._event("snapshot_failed", boundary=self._boundary,
+                        error=repr(e))
+        finally:
+            # the durable write runs on the drain thread under _mu, so
+            # no HEARTBEAT can renew a lease while it fsyncs; credit the
+            # stall to every live lease or slow storage would let the
+            # monitor evict healthy trainers at exactly the boundaries
+            # where snapshots fire
+            paused = time.monotonic() - t0
+            for t in self._leases:
+                self._leases[t] += paused
 
     def _on_complete(self, name, payload):
+        base, tid, _ = unpack_wire_name(name)
         with self._mu:
-            self._completed += 1
+            if tid is not None:
+                self._completed_tids.add(tid)
+                self._leases.pop(tid, None)
+            else:
+                self._completed += 1
+            # a completed trainer leaves the quorum: release barriers /
+            # merges its absence now satisfies (the straggler fix — a
+            # trainer parked on the barrier while its peers COMPLETE
+            # must be released, not stranded until shutdown)
+            for nm in list(self._pending):
+                self._maybe_merge_locked(nm)
+            release = self._maybe_release_barrier_locked()
+        self._release(release)
+        return b""
+
+    def _on_heartbeat(self, name, payload):
+        base, tid, _ = unpack_wire_name(name)
+        with self._mu:
+            if tid is not None:
+                if tid in self._evicted:
+                    raise StatusReply(
+                        STATUS_EVICTED,
+                        ("TrainerEvicted: trainer %d lease expired on "
+                         "%s" % (tid, self.endpoint)).encode())
+                self._leases[tid] = time.monotonic()
         return b""
 
     def _on_prefetch(self, name, payload):
+        name, _, _ = unpack_wire_name(name)
         ids, _ = deserialize_tensor(payload)
         table = self._table(name)
         return serialize_tensor(table.pull(ids))
 
     def _on_push_sparse(self, name, payload):
+        name, tid, seq = unpack_wire_name(name)
+        with self._mu:
+            self._touch_lease_locked(tid)
+            if tid is not None and seq is not None:
+                if self._seen_push.seen(tid, seq):
+                    self._event("dup_push_ignored", name=name, tid=tid,
+                                seq=seq)
+                    return b""
         ids, off = deserialize_tensor(payload)
         values, _ = deserialize_tensor(payload, off)
         self._table(name).push(ids, values)
@@ -137,22 +451,114 @@ class ListenAndServ:
                 % (self.endpoint, name, list(self.lookup_tables)))
         return self.lookup_tables[name]
 
+    # -- liveness monitor ---------------------------------------------------
+    def _monitor_loop(self):
+        period = max(0.01, min(self.lease_timeout_s / 4.0, 0.25))
+        while not self._monitor_stop.wait(period):
+            self._check_leases()
+
+    def _check_leases(self):
+        now = time.monotonic()
+        release = aborted = evicted_waiters = None
+        with self._mu:
+            if self._aborted is not None:
+                return
+            expired = sorted(
+                t for t, ts in self._leases.items()
+                if t not in self._evicted
+                and t not in self._completed_tids
+                and now - ts > self.lease_timeout_s)
+            if not expired:
+                return
+            if self.allow_degraded:
+                evicted_waiters = []
+                for t in expired:
+                    self._evicted.add(t)
+                    self._leases.pop(t, None)
+                    # drop the dead trainer's parked barrier entry NOW:
+                    # left in place it would count toward the shrunken
+                    # quorum and release live trainers before all of
+                    # them arrived (silently breaking sync semantics)
+                    w = self._barrier_waiters.pop(("t", t), None)
+                    if w is not None:
+                        evicted_waiters.append(w)
+                    self._event("trainer_evicted", tid=t,
+                                lease_timeout_s=self.lease_timeout_s)
+                # purge the evictees' buffered partial-step grads: a
+                # trainer that died after sending SOME blocks must not
+                # have those summed into the shrunken-quorum merge (the
+                # step would apply an n-trainer sum to some params and
+                # an (n-1)-sum to others)
+                for nm, entries in list(self._pending.items()):
+                    kept = [(t, g) for t, g in entries
+                            if t not in self._evicted]
+                    if kept:
+                        self._pending[nm] = kept
+                    else:
+                        self._pending.pop(nm)
+                # the smaller quorum may satisfy parked merges/barriers
+                for nm in list(self._pending):
+                    self._maybe_merge_locked(nm)
+                release = self._maybe_release_barrier_locked()
+            else:
+                self._aborted = ("trainer(s) %s lease expired after "
+                                 "%.2fs" % (expired,
+                                            self.lease_timeout_s))
+                aborted = list(self._barrier_waiters.values())
+                self._barrier_waiters = {}
+                self._event("barrier_aborted", tids=expired)
+        self._release(release)
+        if evicted_waiters:
+            for tid, _, r in evicted_waiters:
+                r(STATUS_EVICTED,
+                  ("TrainerEvicted: trainer %s lease expired on %s"
+                   % (tid, self.endpoint)).encode())
+        if aborted:
+            self._release(aborted, STATUS_ABORTED,
+                          ("BarrierAborted: %s" % self._aborted)
+                          .encode())
+
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         self.server.start()
+        if self.lease_timeout_s is not None and self._monitor is None:
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             daemon=True)
+            self._monitor.start()
         return self
 
     def run_until_complete(self, poll_s=0.2):
-        """Serve until every trainer has sent COMPLETE."""
-        self.server.start()
+        """Serve until every non-evicted trainer has sent COMPLETE (or
+        the run aborted on an expired lease in non-degraded mode)."""
+        self.start()
         while True:
             with self._mu:
-                if self._completed >= self.n_trainers:
+                done = len(self._completed_tids) + self._completed
+                if done >= self.n_trainers - len(self._evicted):
+                    break
+                if self._aborted is not None:
                     break
             time.sleep(poll_s)
         self.shutdown()
 
     def shutdown(self):
+        # answer every parked barrier responder BEFORE closing the
+        # sockets: a straggler must get a structured BarrierAborted,
+        # not a forever-parked connection (the shutdown-leak fix)
+        with self._mu:
+            waiters = list(self._barrier_waiters.values())
+            self._barrier_waiters = {}
+            if waiters and self._aborted is None:
+                self._aborted = "server shutting down"
+        if waiters:
+            self._release(waiters, STATUS_ABORTED,
+                          b"BarrierAborted: server shutting down")
+            self._event("barrier_aborted_on_shutdown",
+                        waiters=len(waiters))
+        if self._monitor is not None:
+            self._monitor_stop.set()
+            self._monitor.join(timeout=5)
+            self._monitor = None
         self.server.shutdown()
 
 
@@ -163,26 +569,57 @@ class Communicator:
     ``max_merge_var_num`` queued grads per name (summing them — the
     reference's merge_add) and issues one RPC. ``recv(name)`` pulls the
     fresh param. In sync mode trainers call flush() + barrier() each
-    step instead."""
+    step instead.
+
+    ``trainer_id`` stamps every client (and hence every wire name);
+    ``next_seq(endpoint)`` hands out the trainer's monotonic send
+    sequence PER PSERVER — each server must observe a dense 1,2,3,...
+    stream from each trainer or its _SeqTracker watermark can never
+    advance past the seqs that went to its siblings (the out-of-order
+    window, and the snapshot meta holding it, would grow with every
+    step of the run)."""
 
     def __init__(self, placement: Dict[str, str],
-                 max_merge_var_num=None, send_queue_size=None):
+                 max_merge_var_num=None, send_queue_size=None,
+                 trainer_id: Optional[int] = None,
+                 deadline_s: Optional[float] = 30.0,
+                 retry: Optional[RetryPolicy] = None,
+                 connect_timeout_s: float = 30.0):
         self.placement = placement
         self.max_merge = max_merge_var_num or \
             int(FLAGS.communicator_max_merge_var_num or 20)
         self.queue_size = send_queue_size or \
             int(FLAGS.communicator_send_queue_size or 20)
+        self.trainer_id = trainer_id
+        self.deadline_s = deadline_s
+        self.retry = retry
+        self.connect_timeout_s = connect_timeout_s
         self._clients: Dict[str, RPCClient] = {}
         self._q: "queue.Queue" = queue.Queue(self.queue_size)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inflight = threading.Semaphore(0)
         self._err: Optional[Exception] = None
+        self._seqs: Dict[str, int] = {}
+        self._seq_mu = threading.Lock()
+
+    def next_seq(self, endpoint: str) -> Optional[int]:
+        if self.trainer_id is None:
+            return None
+        with self._seq_mu:
+            self._seqs[endpoint] = self._seqs.get(endpoint, 0) + 1
+            return self._seqs[endpoint]
 
     def client(self, endpoint) -> RPCClient:
         if endpoint not in self._clients:
-            self._clients[endpoint] = RPCClient(endpoint)
+            self._clients[endpoint] = RPCClient(
+                endpoint, timeout_s=self.connect_timeout_s,
+                deadline_s=self.deadline_s, retry=self.retry,
+                trainer_id=self.trainer_id)
         return self._clients[endpoint]
+
+    def reconnect_count(self) -> int:
+        return sum(c.reconnects for c in self._clients.values())
 
     # -- async path ---------------------------------------------------------
     def start(self):
@@ -219,7 +656,11 @@ class Communicator:
                 merged = merged + nxt
                 n += 1
             try:
-                self.client(self.placement[name]).send_var(name, merged)
+                # one seq per MERGED send: a client-level retry replays
+                # the same wire name, so the server dedupes exactly
+                ep = self.placement[name]
+                self.client(ep).send_var(
+                    name, merged, seq=self.next_seq(ep))
             except Exception as e:
                 self._err = e
             for _ in range(n):
@@ -239,8 +680,8 @@ class Communicator:
         self._check_err()
 
     # -- sync helpers -------------------------------------------------------
-    def send_sync(self, name, grad):
-        self.client(self.placement[name]).send_var(name, grad)
+    def send_sync(self, name, grad, seq=None):
+        self.client(self.placement[name]).send_var(name, grad, seq=seq)
 
     def recv(self, name) -> np.ndarray:
         return self.client(self.placement[name]).get_var(name)
@@ -252,6 +693,150 @@ class Communicator:
     def complete_all(self):
         for ep in sorted(set(self.placement.values())):
             self.client(ep).complete()
+
+
+class HeartbeatThread:
+    """Background liveness lease renewal: one thread PER pserver
+    endpoint, each on a DEDICATED connection — a shared client would
+    park the beat behind a long in-flight call (e.g. a barrier), and a
+    shared thread would park the beat to a healthy server behind the
+    connect stall to an unreachable one; either way the lease expires
+    on a perfectly healthy trainer."""
+
+    def __init__(self, endpoints, trainer_id, interval_s=1.0):
+        self.endpoints = sorted(set(endpoints))
+        self.trainer_id = trainer_id
+        self.interval_s = float(interval_s)
+        self.evicted = False
+        self._clients: Dict[str, RPCClient] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self):
+        if not self._threads:
+            for ep in self.endpoints:
+                t = threading.Thread(target=self._loop, args=(ep,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def _client(self, ep):
+        if ep not in self._clients:
+            self._clients[ep] = RPCClient(
+                ep, timeout_s=max(0.2, self.interval_s),
+                deadline_s=max(0.2, self.interval_s),
+                trainer_id=self.trainer_id)
+        return self._clients[ep]
+
+    def _loop(self, ep):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._client(ep).heartbeat()
+            except TrainerEvicted:
+                self.evicted = True
+            except Exception:
+                # server briefly unreachable: renew on next tick
+                # (close the dropped client or every failed beat
+                # leaks its native handle + fd)
+                c = self._clients.pop(ep, None)
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+        for c in self._clients.values():
+            c.close()
+        self._clients = {}
+
+
+class ShardSnapshotter:
+    """Durable pserver shard snapshots — the ``checkpoint_notify``
+    analog (distribute_transpiler.py:1612): each server persists its
+    own param blocks + optimizer state + dedup metadata at step
+    boundaries, with the exact CheckpointSaver write ordering
+    (``io.durable_publish_dir``: fsynced files -> fsynced in-tmp marker
+    -> one atomic rename), so a killed pserver restarts from a
+    CONSISTENT boundary and replayed trainer sends dedupe exactly."""
+
+    MARKER = "_COMPLETE"
+    META = "_META.json"
+
+    def __init__(self, dirname, keep=2):
+        enforce(int(keep) >= 1, "keep must be >= 1")
+        self._dir = dirname
+        self._keep = int(keep)
+        os.makedirs(dirname, exist_ok=True)
+        for name in os.listdir(dirname):
+            path = os.path.join(dirname, name)
+            if name.startswith(".tmp-"):
+                # stranded by a writer killed mid-save
+                import shutil
+                shutil.rmtree(path, ignore_errors=True)
+            elif name.startswith("shard-") and not os.path.exists(
+                    os.path.join(path, self.MARKER)):
+                # wreckage of a killed prune (unmark-first commit)
+                import shutil
+                shutil.rmtree(path, ignore_errors=True)
+
+    def save(self, boundary: int, arrays: Dict[str, np.ndarray],
+             meta: dict):
+        files = [(n, serialize_tensor(np.asarray(a)))
+                 for n, a in sorted(arrays.items())]
+        files.append((self.META,
+                      json.dumps(meta, sort_keys=True).encode()))
+        durable_publish_dir(self._dir, "shard-%d" % boundary, files,
+                            marker=self.MARKER,
+                            marker_text=str(boundary))
+        self._prune()
+
+    def _prune(self):
+        for b in self.list_snapshots()[:-self._keep]:
+            remove_marked_dir(os.path.join(self._dir, "shard-%d" % b),
+                              self.MARKER)
+
+    def list_snapshots(self) -> List[int]:
+        out = []
+        for name in os.listdir(self._dir):
+            if name.startswith("shard-") and os.path.exists(
+                    os.path.join(self._dir, name, self.MARKER)):
+                try:
+                    out.append(int(name[len("shard-"):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def restore_latest(self):
+        """-> (arrays, meta) of the newest loadable snapshot, or None.
+        Falls back past a marked-but-unloadable dir, like
+        CheckpointSaver.restore_latest."""
+        import warnings
+        for b in reversed(self.list_snapshots()):
+            d = os.path.join(self._dir, "shard-%d" % b)
+            try:
+                arrays = {}
+                meta = {}
+                for name in os.listdir(d):
+                    if name == self.MARKER:
+                        continue
+                    path = os.path.join(d, name)
+                    if name == self.META:
+                        with open(path) as f:
+                            meta = json.load(f)
+                        continue
+                    with open(path, "rb") as f:
+                        arrays[name], _ = deserialize_tensor(f.read())
+                return arrays, meta
+            except Exception as e:
+                warnings.warn("shard snapshot %d failed to load (%r); "
+                              "falling back" % (b, e))
+        return None
 
 
 class _ScopeView:
@@ -271,9 +856,20 @@ class _ScopeView:
 class PServerRuntime:
     """One pserver process: startup + per-param optimize programs +
     the ListenAndServ loop (the full Executor.run(pserver_program)
-    experience of the reference, listen_and_serv_op.cc:464)."""
+    experience of the reference, listen_and_serv_op.cc:464).
 
-    def __init__(self, transpiler, endpoint, lookup_tables=None):
+    ``snapshot_dir`` arms shard snapshots + recovery: a restarted
+    runtime pointed at the same dir restores its param blocks,
+    optimizer state, and dedup metadata from the newest complete
+    snapshot before it starts serving, so reconnecting trainers replay
+    into a consistent state. ``bind_endpoint`` lets the restart bind
+    the PREVIOUS incarnation's concrete port while ``endpoint`` stays
+    the transpiler's logical name."""
+
+    def __init__(self, transpiler, endpoint, lookup_tables=None,
+                 snapshot_dir=None, snapshot_every=1,
+                 lease_timeout_s=None, allow_degraded=None,
+                 bind_endpoint=None):
         from ..core.scope import Scope
         from ..executor import Executor
         from ..framework import grad_var_name
@@ -284,6 +880,7 @@ class PServerRuntime:
         own = transpiler.params_on(endpoint)  # block names
         self._minis = {b: transpiler.get_block_program(b) for b in own}
         self._grad_name = {b: grad_var_name(b) for b in own}
+        self._pserver_program = transpiler.get_pserver_program(endpoint)
         self.dc_asgd = getattr(transpiler.config, "enable_dc_asgd",
                                False) and not transpiler.sync_mode
         self.dc_lambda = getattr(transpiler.config, "dc_asgd_lambda",
@@ -291,11 +888,35 @@ class PServerRuntime:
         self._dc_backup = {}
         startup = transpiler.get_startup_program(endpoint)
         self.exe.run(startup, scope=self.scope)
+        self._snap = None
+        restore_meta = None
+        if snapshot_dir is not None:
+            self._snap = ShardSnapshotter(snapshot_dir)
+            restored = self._snap.restore_latest()
+            if restored is not None:
+                arrays, restore_meta = restored
+                for name, arr in arrays.items():
+                    self.scope.set_var(name, arr)
         self.serv = ListenAndServ(
-            endpoint, _ScopeView(self.scope, own), self._optimize,
-            n_trainers=transpiler.trainer_num,
+            bind_endpoint or endpoint, _ScopeView(self.scope, own),
+            self._optimize, n_trainers=transpiler.trainer_num,
             sync_mode=transpiler.sync_mode,
-            lookup_tables=lookup_tables)
+            lookup_tables=lookup_tables,
+            lease_timeout_s=lease_timeout_s,
+            allow_degraded=allow_degraded,
+            snapshot_fn=self._snapshot_shard
+            if self._snap is not None else None,
+            snapshot_every=snapshot_every,
+            restore_meta=restore_meta)
+
+    def _snapshot_shard(self, boundary, meta):
+        from ..io import get_program_persistable_vars
+        arrays = {}
+        for v in get_program_persistable_vars(self._pserver_program):
+            val = self.scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+        self._snap.save(boundary, arrays, meta)
 
     def _optimize(self, bname, grad):
         if self.dc_asgd:
@@ -329,39 +950,141 @@ class ParameterServerRuntime:
     Trainer side: wraps a (fwd+bwd-only) trainer program; each
     ``run()`` executes the local step, sends every param grad to its
     pserver, barriers (sync mode), then pulls fresh params into the
-    local scope."""
+    local scope.
 
-    def __init__(self, transpiler, program, scope, sync_mode=True):
+    Fault tolerance: the whole communication phase of a step (sends ->
+    send barrier -> recvs -> fetch barrier) is replayed end-to-end
+    whenever any client connection had to be re-established mid-phase
+    (``phase_retries`` bounds the replays). Sequence numbers are
+    assigned ONCE per step, so a replay is idempotent on the server —
+    together with the pserver's boundary snapshots this keeps the
+    sync-mode loss trajectory EXACT across a pserver kill+restart.
+    ``heartbeat_interval_s > 0`` starts the liveness lease thread
+    (required when the server arms ``lease_timeout_s``)."""
+
+    def __init__(self, transpiler, program, scope, sync_mode=True,
+                 trainer_id=None, deadline_s: Optional[float] = 30.0,
+                 retry: Optional[RetryPolicy] = None,
+                 phase_retries=3, heartbeat_interval_s=0.0,
+                 connect_timeout_s=30.0):
         self.t = transpiler
         self.program = program
         self.scope = scope
         self.sync_mode = sync_mode
+        self.trainer_id = transpiler.trainer_id if trainer_id is None \
+            else int(trainer_id)
         self.blocks = transpiler.block_table()
+        # per-call transparent retry (reconnect + reissue; seq-deduped
+        # server-side, so always safe) — ``retry`` overrides the budget
+        call_retry = retry or RetryPolicy(
+            max_retries=4, base_delay=0.05, max_delay=1.0,
+            seed=1000 + self.trainer_id)
         # endpoint map for the communicator: block name -> endpoint
-        self.comm = Communicator({b["name"]: b["endpoint"]
-                                  for bs in self.blocks.values()
-                                  for b in bs})
+        self.comm = Communicator(
+            {b["name"]: b["endpoint"]
+             for bs in self.blocks.values() for b in bs},
+            trainer_id=self.trainer_id, deadline_s=deadline_s,
+            retry=call_retry, connect_timeout_s=connect_timeout_s)
+        self._phase_policy = RetryPolicy(
+            max_retries=int(phase_retries),
+            base_delay=call_retry.base_delay * 2,
+            max_delay=call_retry.max_delay,
+            seed=self.trainer_id)
+        self._last_inc: Dict[str, bytes] = {}
+        self.events: List[tuple] = []
         self.dc_asgd = getattr(transpiler.config, "enable_dc_asgd",
                                False) and not sync_mode
-        self._tid_suffix = "@@%d" % transpiler.trainer_id \
-            if self.dc_asgd else ""
+        self._hb = None
+        if heartbeat_interval_s and heartbeat_interval_s > 0:
+            eps = {b["endpoint"] for bs in self.blocks.values()
+                   for b in bs}
+            self._hb = HeartbeatThread(eps, self.trainer_id,
+                                       heartbeat_interval_s).start()
+
+    def stop_heartbeats(self):
+        if self._hb is not None:
+            self._hb.stop()
+            self._hb = None
 
     def _assemble(self, pname, parts):
         if len(parts) == 1:
             return parts[0]
         return np.concatenate(parts, axis=0)
 
+    # -- phase replay (exactness across reconnects) -------------------------
+    def _endpoints(self):
+        return sorted({b["endpoint"] for bs in self.blocks.values()
+                       for b in bs})
+
+    def _incarnation_changed(self):
+        """Did any pserver restart since we last looked? A reconnect
+        alone only proves the CONNECTION died; acked state is lost only
+        when the server process did. Queried solely after a phase that
+        had to reconnect, so the steady-state step pays zero extra
+        RPCs. Unreachable-right-now counts as changed (be safe:
+        replaying into an unchanged server is a no-op by dedup)."""
+        changed = False
+        for ep in self._endpoints():
+            try:
+                inc = self.comm.client(ep).call("GET", INCARNATION_KEY)
+            except Exception:
+                changed = True
+                continue
+            if self._last_inc.get(ep) != inc:
+                changed = True
+            self._last_inc[ep] = inc
+        return changed
+
+    def _replay_phase(self, fn, what):
+        """Run ``fn`` (an idempotent communication phase — every send
+        in it carries a pre-assigned seq). If any client had to
+        RECONNECT while it ran AND the server incarnation changed (the
+        pserver was restarted), REPLAY the phase end-to-end: effects
+        acked by the dead incarnation may be gone, and the dedup
+        sequence trackers make re-running the whole phase exactly-once
+        against the restored shard snapshot. Transient failures
+        (deadline, connection lost, reconnect still failing, per-call
+        retry budget spent) back off on the deterministic policy
+        schedule and replay."""
+        delays = self._phase_policy.delays()
+        for attempt in range(len(delays) + 1):
+            start = self.comm.reconnect_count()
+            try:
+                out = fn()
+            except (RpcError, RetryBudgetExhausted) as e:
+                if attempt >= len(delays):
+                    raise
+                self.events.append(("phase_retry", what, attempt,
+                                    repr(e)))
+                time.sleep(delays[attempt])
+                continue
+            if self.comm.reconnect_count() == start:
+                return out
+            if not self._incarnation_changed():
+                # connections blipped but the server kept its state:
+                # everything acked is still applied, nothing to replay
+                return out
+            if attempt >= len(delays):
+                raise RpcError(
+                    "UNAVAILABLE: %s phase kept landing on restarted "
+                    "servers after %d replays" % (what, len(delays)))
+            self.events.append(("phase_replay", what, attempt))
+
     def init_params(self):
         """Adopt the server-side initial parameter values (the
         reference's post-init param sync: trainers recv before step 0,
         so every trainer starts from the pserver's init)."""
 
-        def recv(ep, blocks):
-            client = self.comm.client(ep)
-            for b in blocks:
-                b["_value"] = client.get_var(b["name"])
+        def phase():
+            def recv(ep, blocks):
+                client = self.comm.client(ep)
+                for b in blocks:
+                    b["_value"] = client.get_var(b["name"])
 
-        self._per_endpoint(recv)
+            self._per_endpoint(recv)
+
+        self._replay_phase(phase, "init_params")
+        self._incarnation_changed()  # baseline the nonces for step 0
         for pname, bs in self.blocks.items():
             self.scope.set_var(
                 pname, self._assemble(pname,
@@ -404,33 +1127,44 @@ class ParameterServerRuntime:
         gvals = {p: np.asarray(g) for p, g in
                  zip(pnames, out[len(fetch_list):])}
 
+        # one seq per block send, assigned ONCE per step: a phase
+        # replay reuses them, so the server applies each grad exactly
+        # once no matter how many times the phase runs
+        seqs = {b["name"]:
+                self.comm.next_seq(self.comm.placement[b["name"]])
+                for bs in self.blocks.values() for b in bs}
+
         def send(ep, blocks):
             client = self.comm.client(ep)
             for b in blocks:
                 g = gvals[b["param"]]
                 if b["name"] != b["param"]:
                     g = g[b["start"]:b["end"]]
-                client.send_var(b["name"] + self._tid_suffix, g)
+                client.send_var(b["name"], g, seq=seqs[b["name"]])
 
         def recv(ep, blocks):
             client = self.comm.client(ep)
             for b in blocks:
                 b["_value"] = client.get_var(b["name"])
 
-        self._per_endpoint(send)
-        if self.sync_mode:
-            self.comm.barrier_all("send")
-        self._per_endpoint(recv)
+        def phase():
+            self._per_endpoint(send)
+            if self.sync_mode:
+                self.comm.barrier_all("send")
+            self._per_endpoint(recv)
+            if self.sync_mode:
+                self.comm.barrier_all("fetch")
+
+        self._replay_phase(phase, "step")
         for pname, bs in self.blocks.items():
             scope.set_var(
                 pname, self._assemble(pname,
                                       [b.pop("_value") for b in bs]))
-        if self.sync_mode:
-            self.comm.barrier_all("fetch")
         if return_numpy:
             user_out = [np.asarray(v) for v in user_out]
         return user_out
 
     def complete(self):
+        self.stop_heartbeats()
         self.comm.complete_all()
         self.comm.stop()

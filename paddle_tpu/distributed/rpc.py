@@ -19,6 +19,7 @@ import numpy as np
 from ..core.enforce import UnavailableError, enforce
 from ..io import deserialize_tensor, serialize_tensor
 from ..native import load_library
+from ..resilience.retry import RetryPolicy, retry_call
 
 # verb ids, shared with the server loop (the reference's request type
 # strings RequestSend/RequestGet/RequestPrefetch/RequestBarrier,
@@ -30,12 +31,82 @@ VERBS = {
     "BARRIER": 4,     # sync-mode batch barrier
     "COMPLETE": 5,    # trainer is done (graceful shutdown)
     "PUSH_SPARSE": 6,  # sparse grad push: payload = ids + values
+    "HEARTBEAT": 7,   # trainer liveness lease renewal
 }
 
 # response status byte (the wire field is u8 — keep codes < 256)
 STATUS_OK = 0
 STATUS_NOT_FOUND = 4
 STATUS_ERROR = 5
+STATUS_ABORTED = 6   # barrier/run aborted server-side (BarrierAborted)
+STATUS_EVICTED = 7   # caller's lease expired and it was evicted
+
+
+class RpcError(RuntimeError):
+    """Transport-level failure (connection lost / reset / desynced).
+    The message carries an UNAVAILABLE tag so ``resilience.retry``
+    classifies it transient: reconnect + retry may heal it."""
+
+
+class DeadlineExceededError(RpcError):
+    """The per-call deadline elapsed with the peer silent. The
+    connection is desynced; the client reconnects before reuse."""
+
+
+class RemoteHandlerError(UnavailableError):
+    """The server's HANDLER raised — an application-level failure
+    (missing param, bad payload), permanent by classification (it is
+    an EnforceNotMet): retrying the same call cannot heal it."""
+
+
+class BarrierAborted(Exception):
+    """The server released a parked barrier with an error status (a
+    peer trainer's lease expired, or the server is shutting down)
+    instead of letting waiters hang. Terminal: never retried."""
+
+
+class TrainerEvicted(Exception):
+    """THIS trainer's lease expired and the server evicted it from the
+    job; its sends/barriers are rejected. Terminal: never retried."""
+
+
+class ServerCrash(BaseException):
+    """Chaos seam: raised by a handler to make the server die like a
+    SIGKILLed process — sockets closed NOW, the in-flight request never
+    answered. BaseException so no handler-level ``except Exception``
+    can soften the crash into an error reply."""
+
+
+class StatusReply(Exception):
+    """Raised by a handler to answer with an explicit status byte +
+    payload (the drain loop converts it; plain exceptions become
+    STATUS_ERROR)."""
+
+    def __init__(self, status: int, payload: bytes = b""):
+        self.status = int(status)
+        self.payload = payload
+        super().__init__("status=%d" % status)
+
+
+def pack_wire_name(name, trainer_id=None, seq=None):
+    """Encode per-request metadata into the (<=512 byte) name field:
+    ``var``, ``var@@tid`` or ``var@@tid@@seq``. The sequence number
+    makes SEND/PUSH_SPARSE idempotent: the server remembers the highest
+    seq applied per trainer and acks-without-applying any replay."""
+    if trainer_id is None:
+        return name
+    if seq is None:
+        return "%s@@%d" % (name, trainer_id)
+    return "%s@@%d@@%d" % (name, trainer_id, seq)
+
+
+def unpack_wire_name(wire):
+    """Inverse of pack_wire_name -> (name, trainer_id|None, seq|None)."""
+    parts = wire.split("@@")
+    name = parts[0]
+    tid = int(parts[1]) if len(parts) > 1 and parts[1] != "" else None
+    seq = int(parts[2]) if len(parts) > 2 and parts[2] != "" else None
+    return name, tid, seq
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -69,6 +140,9 @@ def _load():
             lib.trpc_connect.restype = ctypes.c_int64
             lib.trpc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                          ctypes.c_int]
+            lib.trpc_set_deadline.restype = ctypes.c_int
+            lib.trpc_set_deadline.argtypes = [ctypes.c_int64,
+                                              ctypes.c_int]
             lib.trpc_call.restype = ctypes.c_int
             lib.trpc_call.argtypes = [
                 ctypes.c_int64, ctypes.c_int, ctypes.c_char_p,
@@ -154,17 +228,34 @@ class RPCServer:
 
                 try:
                     handler(name, body, responder)
+                except StatusReply as sr:
+                    responder(sr.status, sr.payload)
+                except ServerCrash:
+                    self._crash()
+                    return
                 except Exception as e:
                     responder(STATUS_ERROR, repr(e).encode())
                 continue
             try:
                 resp = handler(name, body)
                 status = STATUS_OK
+            except StatusReply as sr:
+                resp, status = sr.payload, sr.status
+            except ServerCrash:
+                self._crash()
+                return
             except Exception as e:  # error -> error status + message
                 resp = repr(e).encode()
                 status = STATUS_ERROR
             lib.trpc_server_respond(self._h, req_id, status,
                                     resp, len(resp))
+
+    def _crash(self):
+        """Die like a killed process: every socket closed NOW, the
+        current request (and any parked one) never answered. Chaos
+        tests use this through a handler raising ServerCrash."""
+        self._stop.set()
+        _load().trpc_server_shutdown(self._h)
 
     def start(self):
         if self._thread is not None:
@@ -184,55 +275,153 @@ class RPCServer:
         _load().trpc_server_shutdown(self._h)
 
 
+class _Unset:
+    """'use the client default' sentinel for per-call deadline
+    overrides (None means 'no deadline', so it can't double as the
+    sentinel). Stable repr: these defaults are frozen in API.spec."""
+
+    def __repr__(self):
+        return "<use client default>"
+
+
+_UNSET = _Unset()
+
+
 class RPCClient:
     """Synchronous client per endpoint (reference: GRPCClient,
     grpc_client.h:176 — async verbs + Wait; here Python threads provide
-    the asynchrony, see ps.Communicator)."""
+    the asynchrony, see ps.Communicator).
+
+    Failure posture (new in the fault-tolerant runtime):
+
+    - every ``call`` carries a **deadline** (``deadline_s``, idle
+      semantics — see trpc_set_deadline): a silent/hung peer fails the
+      call with ``DeadlineExceededError`` instead of parking forever;
+    - any transport failure (reset, timeout, desync) marks the
+      connection broken; the next call transparently **reconnects**;
+    - an optional ``retry`` RetryPolicy makes ``call`` retry transient
+      failures (reconnect + reissue) under a budget. Callers that need
+      exactly-once effects pass a stable ``seq`` so the server dedupes
+      replays (``trainer_id`` must be set);
+    - ``reconnects`` counts re-established connections — the
+      ParameterServerRuntime reads it to decide whether a communication
+      phase must be replayed end-to-end for exactness.
+    """
 
     def __init__(self, endpoint: str, timeout_s: float = 30.0,
-                 retry_interval_s: float = 0.1):
+                 retry_interval_s: float = 0.1,
+                 deadline_s: Optional[float] = 30.0,
+                 retry: Optional[RetryPolicy] = None,
+                 trainer_id: Optional[int] = None):
         self.endpoint = endpoint
-        host, port = _parse_endpoint(endpoint)
+        self.deadline_s = deadline_s
+        self.retry = retry
+        self.trainer_id = trainer_id
+        self.reconnects = 0
+        self.retries_used = 0
+        self._connect_timeout_s = timeout_s
+        self._retry_interval_s = retry_interval_s
+        self._host, self._port = _parse_endpoint(endpoint)
+        self._h = -1
+        self._broken = False
+        self._cur_deadline_ms = None
+        self._connect(timeout_s)
+
+    def _connect(self, timeout_s):
         lib = _load()
         deadline = time.time() + timeout_s
-        self._h = -1
-        while time.time() < deadline:
-            self._h = lib.trpc_connect(host.encode(), port, 1000)
-            if self._h > 0:
+        # a single attempt must not blow the whole budget (a heartbeat
+        # client with timeout_s=0.2 cannot afford a 1s connect stall)
+        per_ms = int(max(50, min(1000, timeout_s * 1000)))
+        h = -1
+        while True:
+            h = lib.trpc_connect(self._host.encode(), self._port,
+                                 per_ms)
+            if h > 0 or time.time() >= deadline:
                 break
-            time.sleep(retry_interval_s)  # server may not be up yet
-        enforce(self._h > 0,
+            time.sleep(self._retry_interval_s)  # server may be starting
+        enforce(h > 0,
                 "cannot connect to pserver %r within %.0fs"
-                % (endpoint, timeout_s))
+                % (self.endpoint, timeout_s))
+        self._h = h
+        self._broken = False
+        self._cur_deadline_ms = None  # fresh socket: deadline unset
 
-    def call(self, verb: str, name: str = "",
-             payload: bytes = b"") -> bytes:
+    def _reconnect(self):
+        if self._h > 0:
+            _load().trpc_close(self._h)
+            self._h = -1
+        try:
+            self._connect(self._connect_timeout_s)
+        except Exception as e:
+            # still transient: the pserver may be mid-restart
+            raise RpcError("UNAVAILABLE: cannot reconnect to %s: %s"
+                           % (self.endpoint, e))
+        self.reconnects += 1
+
+    def call(self, verb: str, name: str = "", payload: bytes = b"",
+             deadline_s=_UNSET, seq: Optional[int] = None) -> bytes:
+        wire = pack_wire_name(name, self.trainer_id, seq)
+        dl = self.deadline_s if deadline_s is _UNSET else deadline_s
+
+        def once():
+            if self._broken or self._h <= 0:
+                self._reconnect()
+            return self._call_once(verb, name, wire, payload, dl)
+
+        if self.retry is None:
+            return once()
+        out, used = retry_call(once, self.retry)
+        self.retries_used += used
+        return out
+
+    def _call_once(self, verb, name, wire, payload, deadline_s):
         lib = _load()
+        ms = 0 if not deadline_s else max(1, int(deadline_s * 1000))
+        if ms != self._cur_deadline_ms:
+            lib.trpc_set_deadline(self._h, ms)
+            self._cur_deadline_ms = ms
         resp = ctypes.POINTER(ctypes.c_char)()
         rlen = ctypes.c_uint64()
         status = ctypes.c_int()
-        rc = lib.trpc_call(self._h, VERBS[verb], name.encode(),
+        rc = lib.trpc_call(self._h, VERBS[verb], wire.encode(),
                            payload, len(payload), ctypes.byref(resp),
                            ctypes.byref(rlen), ctypes.byref(status))
-        enforce(rc == 0, "rpc %s(%s) to %s failed (rc=%d)"
-                % (verb, name, self.endpoint, rc))
+        if rc == -4:
+            self._broken = True  # stream desynced mid-frame
+            raise DeadlineExceededError(
+                "DEADLINE_EXCEEDED: rpc %s(%s) to %s idle past %s"
+                % (verb, name, self.endpoint,
+                   "%.2fs" % deadline_s if deadline_s else "deadline"))
+        if rc != 0:
+            self._broken = True
+            raise RpcError(
+                "UNAVAILABLE: rpc %s(%s) to %s connection failed "
+                "(rc=%d)" % (verb, name, self.endpoint, rc))
         body = ctypes.string_at(resp, rlen.value) if rlen.value else b""
         lib.trpc_free(resp)
-        if status.value == STATUS_ERROR:
-            raise UnavailableError(
+        st = status.value
+        if st == STATUS_ABORTED:
+            raise BarrierAborted(body.decode() or "aborted by server")
+        if st == STATUS_EVICTED:
+            raise TrainerEvicted(body.decode() or "evicted by server")
+        if st == STATUS_ERROR:
+            raise RemoteHandlerError(
                 "pserver %s handler error on %s(%s): %s"
                 % (self.endpoint, verb, name, body.decode()))
-        enforce(status.value == STATUS_OK,
-                "rpc %s(%s): server status %d"
-                % (verb, name, status.value))
+        enforce(st == STATUS_OK,
+                "rpc %s(%s): server status %d" % (verb, name, st))
         return body
 
     # -- tensor verbs (grpc_serde analog) ----------------------------------
-    def send_var(self, name: str, value: np.ndarray):
-        self.call("SEND", name, serialize_tensor(np.asarray(value)))
+    def send_var(self, name: str, value: np.ndarray,
+                 seq: Optional[int] = None, deadline_s=_UNSET):
+        self.call("SEND", name, serialize_tensor(np.asarray(value)),
+                  deadline_s=deadline_s, seq=seq)
 
-    def get_var(self, name: str) -> np.ndarray:
-        arr, _ = deserialize_tensor(self.call("GET", name))
+    def get_var(self, name: str, deadline_s=_UNSET) -> np.ndarray:
+        arr, _ = deserialize_tensor(
+            self.call("GET", name, deadline_s=deadline_s))
         return arr
 
     def prefetch(self, table: str, ids: np.ndarray) -> np.ndarray:
@@ -242,16 +431,20 @@ class RPCClient:
         return arr
 
     def push_sparse(self, table: str, ids: np.ndarray,
-                    values: np.ndarray):
+                    values: np.ndarray, seq: Optional[int] = None):
         payload = (serialize_tensor(np.asarray(ids, np.int64)) +
                    serialize_tensor(np.asarray(values)))
-        self.call("PUSH_SPARSE", table, payload)
+        self.call("PUSH_SPARSE", table, payload, seq=seq)
 
-    def barrier(self, name: str = "step"):
-        self.call("BARRIER", name)
+    def barrier(self, name: str = "step", deadline_s=_UNSET):
+        self.call("BARRIER", name, deadline_s=deadline_s)
 
     def complete(self):
         self.call("COMPLETE")
+
+    def heartbeat(self, deadline_s=_UNSET):
+        """Renew this trainer's liveness lease (requires trainer_id)."""
+        self.call("HEARTBEAT", deadline_s=deadline_s)
 
     def close(self):
         if self._h > 0:

@@ -104,7 +104,13 @@ class ParameterServerFleet(Collective):
         self._transpiler = t
 
     # -- server side --------------------------------------------------------
-    def init_server(self, model_dir=None):
+    def init_server(self, model_dir=None, snapshot_dir=None,
+                    lease_timeout_s=None, allow_degraded=None):
+        """``snapshot_dir`` arms durable shard snapshots + restart
+        recovery (checkpoint_notify analog); ``lease_timeout_s`` arms
+        trainer liveness leases (workers must then pass a heartbeat
+        interval to init_worker), with ``allow_degraded`` choosing
+        evict-and-continue over BarrierAborted."""
         if not self._server_mode():
             raise UnavailableError(
                 "no pserver endpoints configured: dense state is "
@@ -116,7 +122,10 @@ class ParameterServerFleet(Collective):
         from ....distributed import PServerRuntime
         rm = self._rm()
         ep = rm.get_pserver_endpoints()[rm.server_index()]
-        self._pserver = PServerRuntime(self._transpiler, ep)
+        self._pserver = PServerRuntime(self._transpiler, ep,
+                                       snapshot_dir=snapshot_dir,
+                                       lease_timeout_s=lease_timeout_s,
+                                       allow_degraded=allow_degraded)
         if model_dir:
             from .... import io as io_mod
             from ....executor import scope_guard
@@ -133,7 +142,12 @@ class ParameterServerFleet(Collective):
         self._pserver.run()  # run_until_complete starts the server
 
     # -- worker side --------------------------------------------------------
-    def init_worker(self):
+    def init_worker(self, heartbeat_interval_s=0.0, deadline_s=30.0,
+                    retry=None):
+        """``heartbeat_interval_s > 0`` starts the liveness lease
+        thread (pair with the server's lease_timeout_s); ``deadline_s``
+        bounds every RPC; ``retry`` overrides the per-call transparent
+        reconnect+retry policy."""
         if not self._server_mode():
             return  # collective path needs no worker bootstrap
         enforce(self._transpiler is not None,
@@ -143,7 +157,9 @@ class ParameterServerFleet(Collective):
         t = self._transpiler
         rt = ParameterServerRuntime(
             t, t.get_trainer_program(), global_scope(),
-            sync_mode=t.sync_mode)
+            sync_mode=t.sync_mode,
+            heartbeat_interval_s=heartbeat_interval_s,
+            deadline_s=deadline_s, retry=retry)
         rt.init_params()
         self._ps_trainer = _PSTrainerProgram(rt)
 

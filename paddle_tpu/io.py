@@ -358,6 +358,63 @@ class _AsyncSave:
         return not self._thread.is_alive()
 
 
+def durable_publish_dir(dirname, final_name, files, marker="_COMPLETE",
+                        marker_text="", file_hook=None):
+    """Publish ``files`` (an iterable of ``(name, bytes)``) as
+    ``dirname/final_name`` with the crash/power-loss-safe ordering the
+    CheckpointSaver pioneered (PR 2):
+
+    1. every file is written AND fsynced into a ``.tmp-<final_name>-*``
+       dir;
+    2. the ``marker`` file is written + fsynced INSIDE the tmp dir,
+       last — a marker can never exist next to unsynced data;
+    3. the tmp dir itself is fsynced (directory entries durable);
+    4. ONE ``os.rename`` publishes the dir atomically, then the parent
+       dir is fsynced so the rename itself is durable.
+
+    A crash anywhere before (4) strands only an invisible tmp dir
+    (callers sweep those at init); after (4) the dir is complete by
+    construction. An existing ``final_name`` is removed unmark-first
+    (``remove_marked_dir``) so a kill mid-replace can never leave a
+    marked-but-partial dir. ``file_hook(name, index)`` is the chaos
+    seam, called after each data file lands."""
+    tmp = os.path.join(dirname, ".tmp-%s-%d" % (final_name,
+                                                os.getpid()))
+    os.makedirs(tmp, exist_ok=True)
+    for i, (name, blob) in enumerate(files):
+        with open(os.path.join(tmp, name), "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        if file_hook is not None:
+            file_hook(name, i)
+    with open(os.path.join(tmp, marker), "w") as f:
+        f.write(marker_text)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(tmp)
+    final = os.path.join(dirname, final_name)
+    if os.path.exists(final):
+        remove_marked_dir(final, marker)
+    os.rename(tmp, final)
+    _fsync_dir(dirname)
+    return final
+
+
+def remove_marked_dir(d, marker="_COMPLETE"):
+    """Delete a published dir with the marker removed FIRST (the commit
+    point): unmarking makes the dir invisible to readers, so a kill
+    mid-rmtree can never leave a marked-but-partial dir (rmtree's
+    deletion order is arbitrary — the marker could otherwise outlive
+    the files it vouches for). Callers sweep unmarked dirs at init."""
+    try:
+        os.remove(os.path.join(d, marker))
+        _fsync_dir(d)
+    except OSError:
+        pass
+    shutil.rmtree(d, ignore_errors=True)
+
+
 class CheckpointSaver:
     """Preemption-aware, asynchronous checkpointing.
 
@@ -445,46 +502,20 @@ class CheckpointSaver:
         return snap
 
     def _write(self, snap, step, error_box):
-        """Durability ordering (crash/power-loss safe):
-
-        1. every tensor file is written AND fsynced into the tmp dir;
-        2. the ``_COMPLETE`` marker is written + fsynced INSIDE the tmp
-           dir, last — a marker can never exist next to unsynced data;
-        3. the tmp dir itself is fsynced (directory entries durable);
-        4. ONE ``os.rename`` publishes the checkpoint atomically, then
-           the parent dir is fsynced so the rename itself is durable.
-
-        A crash anywhere before (4) strands only an invisible
-        ``.tmp-ckpt-*`` dir (swept at init); after (4) the checkpoint
-        is complete by construction. The previous ordering (marker
-        written after the rename, nothing fsynced) had two real holes:
-        a crash between rename and marker left an invisible
-        never-pruned full checkpoint, and a power loss could persist
-        the marker before the data it vouches for."""
+        """Durability ordering: see ``durable_publish_dir`` (extracted
+        so the distributed PS shard snapshots share the exact same
+        crash/power-loss-safe sequence)."""
         try:
-            tmp = os.path.join(self._dir, ".tmp-ckpt-%d-%d"
-                               % (step, os.getpid()))
-            os.makedirs(tmp, exist_ok=True)
-            for i, (name, arr) in enumerate(snap.items()):
-                with open(os.path.join(tmp, name), "wb") as f:
-                    f.write(serialize_tensor(arr))
-                    f.flush()
-                    os.fsync(f.fileno())
-                if self._write_file_hook is not None:
-                    self._write_file_hook(step, name, i)
-            with open(os.path.join(tmp, self.MARKER), "w") as f:
-                f.write(str(step))
-                f.flush()
-                os.fsync(f.fileno())
-            _fsync_dir(tmp)
-            final = self._ckpt_dir(step)
-            if os.path.exists(final):
-                # re-saving an existing step (post-rollback re-save):
-                # unmark-first, same as _prune — a kill mid-rmtree must
-                # never leave a marked-but-partial dir
-                self._remove_ckpt_dir(final)
-            os.rename(tmp, final)
-            _fsync_dir(self._dir)
+            hook = None
+            if self._write_file_hook is not None:
+                hook = lambda name, i: self._write_file_hook(  # noqa: E731
+                    step, name, i)
+            durable_publish_dir(
+                self._dir, "ckpt-%d" % step,
+                [(name, serialize_tensor(arr))
+                 for name, arr in snap.items()],
+                marker=self.MARKER, marker_text=str(step),
+                file_hook=hook)
             self._prune()
         except Exception as e:  # surfaced via wait()
             error_box.append(e)
@@ -555,12 +586,7 @@ class CheckpointSaver:
         arbitrary — the marker could otherwise outlive the tensors it
         vouches for). init sweeps unmarked ckpt-* dirs left by exactly
         this kill."""
-        try:
-            os.remove(os.path.join(d, self.MARKER))
-            _fsync_dir(d)
-        except OSError:
-            pass
-        shutil.rmtree(d, ignore_errors=True)
+        remove_marked_dir(d, self.MARKER)
 
     def _prune(self):
         steps = sorted(self.list_checkpoints())

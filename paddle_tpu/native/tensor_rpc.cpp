@@ -19,9 +19,11 @@
 //   response: u32 magic | u8 status | u64 payload_len | payload
 
 #include <arpa/inet.h>
+#include <cerrno>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -47,7 +49,13 @@ bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
     ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) return false;
+    if (r == 0) {
+      // orderly EOF: distinguish from a stale EAGAIN left in errno by
+      // an earlier timed-out syscall (the caller classifies timeouts)
+      errno = ECONNRESET;
+      return false;
+    }
+    if (r < 0) return false;
     p += r;
     n -= static_cast<size_t>(r);
   }
@@ -58,11 +66,21 @@ bool write_full(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
   while (n > 0) {
     ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (r <= 0) return false;
+    if (r == 0) {
+      errno = ECONNRESET;
+      return false;
+    }
+    if (r < 0) return false;
     p += r;
     n -= static_cast<size_t>(r);
   }
   return true;
+}
+
+// -4 when the last socket syscall hit SO_RCVTIMEO/SO_SNDTIMEO (the
+// caller's deadline), otherwise the given base failure code.
+int io_fail_code(int base) {
+  return (errno == EAGAIN || errno == EWOULDBLOCK) ? -4 : base;
 }
 
 struct Conn {
@@ -333,8 +351,30 @@ int64_t trpc_connect(const char* host, int port, int timeout_ms) {
   return h;
 }
 
+// Bound every subsequent syscall of this client's calls: a peer that
+// goes silent for timeout_ms mid-frame fails the call with -4 instead
+// of parking the caller forever (0 restores fully-blocking sockets).
+// This is an IDLE deadline — each recv/send may wait up to timeout_ms,
+// so a slowly-trickling peer can stretch the wall-clock total; a dead
+// or stalled peer cannot exceed it. After a timeout the stream is
+// desynced: the Python layer must reconnect before reusing the handle.
+int trpc_set_deadline(int64_t h, int timeout_ms) {
+  Client* c = find_client(h);
+  if (!c) return -1;
+  timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  if (::setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) < 0)
+    return -2;
+  if (::setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) < 0)
+    return -2;
+  return 0;
+}
+
 // Synchronous call. Returns 0 on success; *resp is malloc'd (free with
-// trpc_free).
+// trpc_free). Negative: -2/-3 connection failure (write/read side),
+// -4 deadline (see trpc_set_deadline) — in every negative case the
+// connection is desynced and must be reconnected.
 int trpc_call(int64_t h, int verb, const char* name,
               const char* payload, uint64_t payload_len,
               char** resp, uint64_t* resp_len, int* status) {
@@ -348,18 +388,22 @@ int trpc_call(int64_t h, int verb, const char* name,
       !write_full(c->fd, &payload_len, 8) ||
       (name_len && !write_full(c->fd, name, name_len)) ||
       (payload_len && !write_full(c->fd, payload, payload_len)))
-    return -2;
+    return io_fail_code(-2);
   uint32_t magic;
   uint8_t st;
   uint64_t rlen;
-  if (!read_full(c->fd, &magic, 4) || magic != kMagic ||
-      !read_full(c->fd, &st, 1) || !read_full(c->fd, &rlen, 8))
-    return -3;
+  if (!read_full(c->fd, &magic, 4)) return io_fail_code(-3);
+  // magic mismatch is NOT a syscall failure: errno is stale here, and
+  // classifying via io_fail_code would misreport corruption as a
+  // deadline expiry (-4) whenever a previous call left EAGAIN behind
+  if (magic != kMagic) return -3;
+  if (!read_full(c->fd, &st, 1) || !read_full(c->fd, &rlen, 8))
+    return io_fail_code(-3);
   if (rlen > (1ull << 34)) return -3;
   char* buf = static_cast<char*>(std::malloc(rlen ? rlen : 1));
   if (rlen && !read_full(c->fd, buf, rlen)) {
     std::free(buf);
-    return -3;
+    return io_fail_code(-3);
   }
   *resp = buf;
   *resp_len = rlen;

@@ -15,6 +15,7 @@ from .retry import (RetryBudgetExhausted, RetryPolicy,  # noqa: F401
                     is_transient, retry_call)
 from .faults import (FaultInjector, InjectedDispatchError,  # noqa: F401
                      SimulatedCrash, make_torn_checkpoint)
+from .netfaults import NetFaultProxy  # noqa: F401
 from .trainer import GuardedTrainer, TrainingAborted  # noqa: F401
 
 __all__ = [
@@ -23,5 +24,6 @@ __all__ = [
     "FLAG_KEY", "SKIPPED_VAR", "CONSEC_VAR",
     "RetryPolicy", "RetryBudgetExhausted", "retry_call", "is_transient",
     "FaultInjector", "InjectedDispatchError", "SimulatedCrash",
-    "make_torn_checkpoint", "GuardedTrainer", "TrainingAborted",
+    "make_torn_checkpoint", "NetFaultProxy",
+    "GuardedTrainer", "TrainingAborted",
 ]

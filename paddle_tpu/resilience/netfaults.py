@@ -1,0 +1,319 @@
+"""Deterministic network fault injection: a framed-TCP proxy.
+
+The wire-level sibling of ``faults.FaultInjector``: where the injector
+plants in-process failures (NaN grads, dispatch errors, writer kills),
+this proxy sits between an ``RPCClient`` and an ``RPCServer`` (the
+tensor_rpc framing, native/tensor_rpc.cpp) and injures the CONNECTION
+itself, seed-driven and replayable:
+
+  - ``drop_rate`` / ``drop_next``    swallow a request frame — the
+                                     client's deadline must fire (no
+                                     response ever comes);
+  - ``delay_s``                      sleep before forwarding each
+                                     request (latency / stall model);
+  - ``blackhole(True)``              swallow everything until released
+                                     (the hard-stall model: a peer that
+                                     accepts bytes but answers nothing);
+  - ``disconnect_after(n)``          forward n more frames, then reset
+                                     both sides mid-conversation;
+  - ``duplicate_next(n)``            forward the next n SEND/PUSH
+                                     frames TWICE (the at-least-once
+                                     network) — the server's sequence
+                                     dedup must absorb the replay; the
+                                     proxy swallows the extra response
+                                     so the client stream stays framed;
+  - ``corrupt_next(mode)``           replace the next request with a
+                                     malformed frame: ``garbage`` (bad
+                                     magic), ``torn`` (header promises
+                                     more bytes than ever arrive, then
+                                     FIN), ``oversize`` (payload_len
+                                     past the server's 16 GiB sanity
+                                     cap). The server must fail that
+                                     one connection, not wedge or crash
+                                     its drain loop.
+
+The proxy is frame-aware in both directions (requests: magic|verb|
+name_len|payload_len|name|payload; responses: magic|status|len|payload)
+so faults hit whole frames, never split ones. Every fired fault is
+recorded in ``events`` — chaos runs prove their faults actually fired,
+exactly like FaultInjector.summary().
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_REQ_HDR = struct.Struct("<IBHQ")   # magic, verb, name_len, payload_len
+_RESP_HDR = struct.Struct("<IBQ")   # magic, status, payload_len
+_MAGIC = 0x43505254
+
+# verbs whose frames duplicate_next targets (idempotent-by-seq pushes)
+_DUP_VERBS = (1, 6)  # SEND, PUSH_SPARSE
+
+
+def _read_exact(sock, n) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _ConnState:
+    def __init__(self, client, upstream):
+        self.client = client
+        self.upstream = upstream
+        self.swallow_responses = 0  # one per duplicated request
+        self.mu = threading.Lock()
+        self.dead = False
+
+    def close(self):
+        self.dead = True
+        for s in (self.client, self.upstream):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class NetFaultProxy:
+    def __init__(self, upstream: str, seed: int = 0,
+                 listen_host: str = "127.0.0.1"):
+        host, port = upstream.rsplit(":", 1)
+        if host in ("localhost", ""):
+            host = "127.0.0.1"
+        self.upstream_addr = (host, int(port))
+        self.seed = int(seed)
+        self._rng = np.random.RandomState(self.seed)
+        self._mu = threading.Lock()
+        self.events: List[Tuple] = []
+        # fault arming
+        self.drop_rate = 0.0
+        self.delay_s = 0.0
+        self._blackhole = False
+        self._drop_next = 0
+        self._dup_next = 0
+        self._corrupt_next: Optional[str] = None
+        self._disconnect_after: Optional[int] = None
+        # listener
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((listen_host, 0))
+        self._lsock.listen(64)
+        self.endpoint = "%s:%d" % (listen_host,
+                                   self._lsock.getsockname()[1])
+        self._stop = threading.Event()
+        self._conns: List[_ConnState] = []
+        self._accept_t = threading.Thread(target=self._accept_loop,
+                                          daemon=True)
+        self._accept_t.start()
+
+    # -- arming --------------------------------------------------------
+    def set_drop_rate(self, p: float):
+        with self._mu:
+            self.drop_rate = float(p)
+        return self
+
+    def set_delay(self, seconds: float):
+        with self._mu:
+            self.delay_s = float(seconds)
+        return self
+
+    def blackhole(self, on: bool = True):
+        with self._mu:
+            self._blackhole = bool(on)
+        return self
+
+    def drop_next(self, n: int = 1):
+        with self._mu:
+            self._drop_next += int(n)
+        return self
+
+    def duplicate_next(self, n: int = 1):
+        with self._mu:
+            self._dup_next += int(n)
+        return self
+
+    def corrupt_next(self, mode: str = "garbage"):
+        assert mode in ("garbage", "torn", "oversize"), mode
+        with self._mu:
+            self._corrupt_next = mode
+        return self
+
+    def disconnect_after(self, n_frames: int):
+        with self._mu:
+            self._disconnect_after = int(n_frames)
+        return self
+
+    def _event(self, *ev):
+        self.events.append(ev)
+
+    def summary(self):
+        return {"seed": self.seed,
+                "events": [list(e) for e in self.events]}
+
+    # -- pumping -------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                cl, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.upstream_addr,
+                                              timeout=10)
+            except OSError:
+                cl.close()
+                continue
+            cl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            up.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            st = _ConnState(cl, up)
+            with self._mu:
+                self._conns.append(st)
+            threading.Thread(target=self._pump_requests, args=(st,),
+                             daemon=True).start()
+            threading.Thread(target=self._pump_responses, args=(st,),
+                             daemon=True).start()
+
+    def _read_request(self, sock):
+        hdr = _read_exact(sock, _REQ_HDR.size)
+        if hdr is None:
+            return None
+        magic, verb, name_len, payload_len = _REQ_HDR.unpack(hdr)
+        if magic != _MAGIC or payload_len > (1 << 34):
+            return None  # client itself desynced; kill the conn
+        rest = _read_exact(sock, name_len + payload_len)
+        if rest is None:
+            return None
+        return hdr + rest, verb
+
+    def _pump_requests(self, st):
+        try:
+            while not st.dead:
+                got = self._read_request(st.client)
+                if got is None:
+                    break
+                frame, verb = got
+                action, extra = self._decide(verb)
+                if action == "drop":
+                    self._event("drop", verb)
+                    continue
+                if action == "corrupt":
+                    self._send_corrupt(st, extra)
+                    continue
+                if action == "delay":
+                    time.sleep(extra)
+                try:
+                    st.upstream.sendall(frame)
+                    if action == "duplicate":
+                        st.upstream.sendall(frame)
+                        with st.mu:
+                            st.swallow_responses += 1
+                        self._event("duplicate", verb)
+                except OSError:
+                    break
+                if action == "disconnect":
+                    self._event("disconnect", verb)
+                    break
+        finally:
+            st.close()
+
+    def _decide(self, verb):
+        """One locked decision per request frame (deterministic: the
+        seeded RNG is consumed in arrival order)."""
+        with self._mu:
+            if self._corrupt_next is not None:
+                mode, self._corrupt_next = self._corrupt_next, None
+                return "corrupt", mode
+            if self._blackhole:
+                self._event("blackhole_drop", verb)
+                return "drop", None
+            if self._drop_next > 0:
+                self._drop_next -= 1
+                return "drop", None
+            if self.drop_rate > 0 and \
+                    float(self._rng.rand()) < self.drop_rate:
+                return "drop", None
+            if self._dup_next > 0 and verb in _DUP_VERBS:
+                self._dup_next -= 1
+                return "duplicate", None
+            if self._disconnect_after is not None:
+                self._disconnect_after -= 1
+                if self._disconnect_after <= 0:
+                    self._disconnect_after = None
+                    return "disconnect", None
+            if self.delay_s > 0:
+                return "delay", self.delay_s
+            return "forward", None
+
+    def _send_corrupt(self, st, mode):
+        self._event("corrupt", mode)
+        try:
+            if mode == "garbage":
+                st.upstream.sendall(b"\xde\xad\xbe\xef" * 8)
+            elif mode == "oversize":
+                st.upstream.sendall(
+                    _REQ_HDR.pack(_MAGIC, 1, 4, 1 << 35) + b"name")
+            elif mode == "torn":
+                # header promises 1000 payload bytes, sends 10, FIN
+                st.upstream.sendall(
+                    _REQ_HDR.pack(_MAGIC, 1, 4, 1000) + b"name" +
+                    b"\x00" * 10)
+        except OSError:
+            pass
+        # the injured conversation cannot be resynced: reset both sides
+        # so the client fails fast and reconnects
+        st.close()
+
+    def _pump_responses(self, st):
+        try:
+            while not st.dead:
+                hdr = _read_exact(st.upstream, _RESP_HDR.size)
+                if hdr is None:
+                    break
+                magic, status, plen = _RESP_HDR.unpack(hdr)
+                if magic != _MAGIC or plen > (1 << 34):
+                    break
+                payload = _read_exact(st.upstream, plen) if plen else b""
+                if payload is None:
+                    break
+                swallow = False
+                with st.mu:
+                    if st.swallow_responses > 0:
+                        st.swallow_responses -= 1
+                        swallow = True
+                if swallow:
+                    self._event("swallow_dup_response", status)
+                    continue
+                try:
+                    st.client.sendall(hdr + payload)
+                except OSError:
+                    break
+        finally:
+            st.close()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._mu:
+            conns = list(self._conns)
+        for st in conns:
+            st.close()

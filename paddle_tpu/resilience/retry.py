@@ -66,12 +66,21 @@ TRANSIENT_TYPE_NAMES = (
 AMBIGUOUS_TYPE_NAMES = ("XlaRuntimeError", "RpcError", "OSError",
                         "RuntimeError")
 
+# Structured TERMINAL outcomes of the distributed runtime: their
+# messages contain words like ABORTED that would otherwise satisfy the
+# pattern classifier, but retrying them is never correct (an evicted
+# trainer stays evicted; an aborted barrier stays aborted).
+PERMANENT_TYPE_NAMES = ("BarrierAborted", "TrainerEvicted",
+                        "SimulatedCrash")
+
 
 def is_transient(exc: BaseException) -> bool:
     """True when retrying the dispatch could plausibly succeed."""
     if isinstance(exc, EnforceNotMet):
         return False  # framework-detected misuse never heals by itself
     names = {t.__name__ for t in type(exc).__mro__}
+    if names & set(PERMANENT_TYPE_NAMES):
+        return False
     if names & set(TRANSIENT_TYPE_NAMES):
         return True
     if names & set(AMBIGUOUS_TYPE_NAMES):

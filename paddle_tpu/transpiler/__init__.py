@@ -320,14 +320,21 @@ class DistributeTranspiler:
     def set_block_endpoints(self, block_names, endpoint):
         """Re-point blocks at a live endpoint (launchers bind
         ephemeral ports after transpile; the reference's wait_port
-        dance)."""
+        dance). The endpoint universe follows the remap, so pserver
+        products (params_on / get_pserver_program) stay reachable
+        under the LIVE endpoint — a restarted PServerRuntime builds
+        against the port it actually serves."""
         self._ensure_split()
         names = set(block_names)
+        olds = set()
         for pname, bs in self._blocks.items():
             for b in bs:
                 if b["name"] in names:
+                    olds.add(b.get("endpoint"))
                     b["endpoint"] = endpoint
             self._placement[pname] = bs[0]["endpoint"]
+        self.pserver_endpoints = [endpoint if ep in olds else ep
+                                  for ep in self.pserver_endpoints]
 
     # -- products -----------------------------------------------------------
     def get_trainer_program(self, wait_port=True) -> Program:

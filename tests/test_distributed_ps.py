@@ -9,6 +9,7 @@ as separate processes, minus the fork cost; the 2-process fleet test
 (test_fleet.py) covers true process isolation."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -250,7 +251,12 @@ class TestPSTraining:
             scope = fluid.Scope()
             exe = fluid.Executor()
             exe.run(startup, scope=scope)
-            rt = ParameterServerRuntime(t, trainer, scope)
+            # each trainer carries ITS OWN id (a real deployment
+            # transpiles per trainer; the shared-transpiler shortcut
+            # here would otherwise alias both onto trainer 0 and break
+            # the per-trainer barrier/seq accounting)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        trainer_id=tid)
             rt.init_params()
             out = []
             for f in feeds:
@@ -297,6 +303,157 @@ class TestPSTraining:
         assert vals[-1] < vals[0]
 
 
+class TestRPCFaultPosture:
+    def test_deadline_on_silent_server(self):
+        """A handler that never responds must fail the call at the
+        client's deadline — no RPC path may block past it."""
+        from paddle_tpu.distributed.rpc import DeadlineExceededError
+        srv = RPCServer("127.0.0.1:0")
+        # deferred handler that parks the responder forever
+        srv.register_deferred("GET", lambda n, p, r: None).start()
+        try:
+            c = RPCClient(srv.endpoint, deadline_s=0.5)
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                c.get_var("w")
+            assert time.monotonic() - t0 < 5.0
+            c.close()
+        finally:
+            srv.shutdown()
+
+    def test_transparent_reconnect_retry(self, rng):
+        """A pserver restart between calls heals transparently when the
+        client carries a RetryPolicy: the broken connection is
+        re-established and the call reissued."""
+        from paddle_tpu.io import serialize_tensor
+        from paddle_tpu.resilience import RetryPolicy
+        w = rng.rand(4).astype(np.float32)
+
+        def on_get(name, payload):
+            return serialize_tensor(w)
+
+        srv = RPCServer("127.0.0.1:0")
+        srv.register("GET", on_get).start()
+        port = srv.port
+        c = RPCClient(srv.endpoint, deadline_s=2.0,
+                      retry=RetryPolicy(max_retries=3, base_delay=0.05,
+                                        seed=0))
+        np.testing.assert_array_equal(c.get_var("w"), w)
+        srv.shutdown()
+        srv2 = RPCServer("127.0.0.1:%d" % port)
+        srv2.register("GET", on_get).start()
+        try:
+            np.testing.assert_array_equal(c.get_var("w"), w)
+            assert c.reconnects >= 1
+            c.close()
+        finally:
+            srv2.shutdown()
+
+    def test_send_seq_dedup(self, rng):
+        """A replayed SEND (same trainer, same seq) must be acked
+        without re-applying — the idempotency contract retries and the
+        at-least-once network rely on."""
+        applied = []
+        serv = ListenAndServ(
+            "127.0.0.1:0", {"w": np.zeros(2)},
+            lambda n, g: applied.append(np.asarray(g).copy()),
+            n_trainers=1, sync_mode=True)
+        serv.start()
+        try:
+            c = RPCClient(serv.endpoint, trainer_id=0)
+            g = rng.rand(2).astype(np.float32)
+            c.send_var("w", g, seq=1)
+            c.send_var("w", g, seq=1)  # replay: deduped
+            c.send_var("w", g, seq=2)  # fresh: applied
+            c.close()
+            assert len(applied) == 2
+            dups = [e for e in serv.events
+                    if e["kind"] == "dup_send_ignored"]
+            assert len(dups) == 1 and dups[0]["seq"] == 1
+        finally:
+            serv.shutdown()
+
+    def test_straggler_released_when_peers_complete(self, rng):
+        """A trainer parked on the barrier while its peers COMPLETE
+        must be released by the shrinking quorum — not stranded until
+        shutdown."""
+        serv = ListenAndServ("127.0.0.1:0", {"w": np.zeros(2)},
+                             lambda n, g: None, n_trainers=2,
+                             sync_mode=True)
+        serv.start()
+        try:
+            straggler = RPCClient(serv.endpoint, trainer_id=1,
+                                  deadline_s=20.0)
+            done = []
+
+            def park():
+                straggler.barrier("send")
+                done.append(True)
+
+            th = threading.Thread(target=park, daemon=True)
+            th.start()
+            time.sleep(0.3)
+            assert not done  # genuinely parked at quorum 2
+            peer = RPCClient(serv.endpoint, trainer_id=0)
+            peer.complete()
+            th.join(timeout=10)
+            assert done, "straggler stayed parked after peer COMPLETE"
+            peer.close()
+            straggler.close()
+        finally:
+            serv.shutdown()
+
+    def test_shutdown_aborts_parked_barrier(self):
+        """Server shutdown must answer parked barrier waiters with
+        BarrierAborted instead of stranding them (regression for the
+        run_until_complete shutdown leak)."""
+        from paddle_tpu.distributed import BarrierAborted
+        serv = ListenAndServ("127.0.0.1:0", {}, lambda n, g: None,
+                             n_trainers=2, sync_mode=True)
+        serv.start()
+        c = RPCClient(serv.endpoint, trainer_id=0, deadline_s=20.0)
+        box = []
+
+        def park():
+            try:
+                c.barrier("send")
+                box.append("released")
+            except BarrierAborted:
+                box.append("aborted")
+            except Exception as e:
+                box.append(repr(e))
+
+        th = threading.Thread(target=park, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        serv.shutdown()
+        th.join(timeout=10)
+        assert box == ["aborted"], box
+        c.close()
+
+
+class TestLaunchPolling:
+    def test_first_failure_anywhere_terminates_all(self, tmp_path):
+        """A crash in worker N>0 must be detected promptly (not only
+        after worker 0 exits) and SIGTERM the survivors."""
+        from paddle_tpu.distributed.launch import _parse_args, launch
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "if rank == 1:\n"
+            "    sys.exit(3)\n"
+            "time.sleep(120)\n")
+        args = _parse_args(["--nproc_per_node=2", str(script)])
+        t0 = time.monotonic()
+        rc = launch(args, poll_interval_s=0.05, term_grace_s=5.0)
+        elapsed = time.monotonic() - t0
+        assert rc == 3
+        # far below worker 0's 120s sleep: the poll loop caught the
+        # rank-1 crash and took rank 0 down
+        assert elapsed < 30.0, elapsed
+
+
 class TestCommunicator:
     def test_merge_batching(self, rng):
         applied = []
@@ -322,6 +479,44 @@ class TestCommunicator:
             assert len(applied) < 8
         finally:
             srv.shutdown()
+
+    def test_send_thread_error_propagates(self, rng):
+        """A handler-raised UnavailableError inside the background
+        _send_loop must surface on the caller's next send/wait_sends —
+        never vanish with the thread."""
+        from paddle_tpu.core.enforce import UnavailableError
+
+        def on_send(name, payload):
+            raise UnavailableError("simulated pserver refusal")
+
+        srv = RPCServer("127.0.0.1:0")
+        srv.register("SEND", on_send).start()
+        try:
+            comm = Communicator({"w": srv.endpoint}).start()
+            comm.send("w", np.ones((2,), np.float32))
+            with pytest.raises(UnavailableError,
+                               match="simulated pserver refusal"):
+                comm.wait_sends(1)
+            # the loop survives the failure: the NEXT send surfaces a
+            # fresh error instead of silently queueing forever
+            comm.send("w", np.ones((2,), np.float32))
+            with pytest.raises(UnavailableError):
+                comm.wait_sends(1)
+            comm._stop.set()
+            comm._thread.join(timeout=5)
+        finally:
+            srv.shutdown()
+
+    def test_seq_streams_dense_per_endpoint(self):
+        """With >=2 pservers each server must observe a dense 1,2,3,...
+        sequence from each trainer — a counter shared across endpoints
+        leaves permanent gaps that pin every server's _SeqTracker
+        watermark and grow its out-of-order window (and the snapshot
+        meta carrying it) for the life of the run."""
+        comm = Communicator({"a": "h:1", "b": "h:2"}, trainer_id=0)
+        assert [comm.next_seq("h:1") for _ in range(3)] == [1, 2, 3]
+        assert [comm.next_seq("h:2") for _ in range(3)] == [1, 2, 3]
+        assert comm.next_seq("h:1") == 4
 
 
 class TestSparseEmbeddingRuntime:

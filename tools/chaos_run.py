@@ -14,8 +14,16 @@ python tools/chaos_run.py --steps 30 --nan-step 5 --nan-step 6 \
 # q8 quantized-collective path on the 8-device CPU mesh
 python tools/chaos_run.py --steps 20 --nan-step 4 --q8
 
+# the DISTRIBUTED acceptance scenarios (wire-level chaos against the
+# PS runtime): pserver kill+restart mid-run (exact trajectory),
+# trainer kill at the barrier (evict / BarrierAborted, bounded time),
+# 30% request drop (exact + bounded)
+python tools/chaos_run.py --distributed
+python tools/chaos_run.py --distributed --scenario pserver_restart
+
 Exit code: 0 when the run completes and (with --check) the final loss
-is within --rtol of the fault-free twin; 1 otherwise.
+is within --rtol of the fault-free twin (distributed: every scenario's
+verdict ok); 1 otherwise.
 """
 
 import argparse
@@ -92,6 +100,236 @@ def run_once(args, injector, q8):
     return summary
 
 
+# ---------------------------------------------------------------------------
+# distributed scenarios (wire-level chaos against the PS runtime)
+# ---------------------------------------------------------------------------
+
+def _dist_build(seed, n_trainers):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.transpiler import DistributeTranspiler
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = seed + 1
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [8], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            pred = layers.fc(x, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.3).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=start,
+                pservers="127.0.0.1:0", trainers=n_trainers)
+    return t, start, loss
+
+
+def _dist_feeds(seed, n):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.rand(16, 8).astype(np.float32),
+             "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+            for _ in range(n)]
+
+
+def _dist_run(seed, steps, n_trainers=1, snapshot_dir=None,
+              server_hook=None, endpoint_hook=None, runtime_kwargs=None,
+              trainer_hook=None, lease_timeout_s=None,
+              allow_degraded=None):
+    """One in-process sync PS run; returns (losses-per-trainer, errors,
+    server, transpiler). Mirrors tests/test_distributed_chaos.py."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import (ParameterServerRuntime,
+                                        PServerRuntime)
+    t, start, loss = _dist_build(seed, n_trainers)
+    s = PServerRuntime(t, t.pserver_endpoints[0],
+                       snapshot_dir=snapshot_dir,
+                       lease_timeout_s=lease_timeout_s,
+                       allow_degraded=allow_degraded)
+    dial = s.serv.endpoint
+    if endpoint_hook is not None:
+        dial = endpoint_hook(s.serv.endpoint)
+    t.set_block_endpoints(s._minis.keys(), dial)
+    s.serv.start()
+    if server_hook is not None:
+        server_hook(s)
+    trainer = t.get_trainer_program()
+    feeds = _dist_feeds(seed, steps)
+    kw = dict(deadline_s=2.0, connect_timeout_s=20.0)
+    kw.update(runtime_kwargs or {})
+    results, errors = {}, {}
+
+    def run_trainer(tid):
+        try:
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        trainer_id=tid, **kw)
+            rt.init_params()
+            out = []
+            for i, f in enumerate(feeds):
+                if trainer_hook is not None and \
+                        trainer_hook(tid, i, rt):
+                    return  # this trainer "dies" here
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+            results[tid] = out
+        except Exception as e:
+            errors[tid] = e
+
+    ths = [threading.Thread(target=run_trainer, args=(i,))
+           for i in range(n_trainers)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=180)
+    return results, errors, s, t
+
+
+def _scenario_pserver_restart(args):
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.distributed import PServerRuntime
+    res, errs, s, _ = _dist_run(args.seed, args.steps)
+    s.serv.shutdown()
+    if errs:
+        return {"ok": False, "error": repr(errs)}
+    clean = res[0]
+
+    snap = tempfile.mkdtemp(prefix="chaos-shards-")
+    restarted = []
+
+    def server_hook(s):
+        port = s.serv.server.port
+        s.serv.crash_after("SEND", args.steps)  # mid-run
+
+        def restarter():
+            while not s.serv.server._stop.is_set():
+                time.sleep(0.02)
+            s2 = PServerRuntime(s.t, "127.0.0.1:%d" % port,
+                                snapshot_dir=snap)
+            s2.serv.start()
+            restarted.append(s2)
+
+        threading.Thread(target=restarter, daemon=True).start()
+
+    t0 = time.monotonic()
+    res, errs, s, _ = _dist_run(args.seed, args.steps,
+                                snapshot_dir=snap,
+                                server_hook=server_hook)
+    elapsed = time.monotonic() - t0
+    s.serv.shutdown()
+    for s2 in restarted:
+        s2.serv.shutdown()
+    if errs:
+        return {"ok": False, "error": repr(errs), "elapsed_s": elapsed}
+    diff = float(np.max(np.abs(np.asarray(res[0]) - np.asarray(clean))))
+    return {"ok": bool(restarted) and diff < 1e-5,
+            "elapsed_s": round(elapsed, 2),
+            "kill_fired": bool(restarted),
+            "max_loss_trace_diff": diff,
+            "losses": res[0], "fault_free_losses": clean}
+
+
+def _scenario_trainer_kill(args):
+    import time
+    lease = 0.6
+
+    def trainer_hook(tid, step, rt):
+        if tid == 1 and step >= 1:
+            rt.stop_heartbeats()
+            rt.comm.stop()
+            return True
+        return False
+
+    t0 = time.monotonic()
+    res, errs, s, _ = _dist_run(
+        args.seed, args.steps, n_trainers=2, lease_timeout_s=lease,
+        allow_degraded=True,
+        runtime_kwargs=dict(deadline_s=2.0, connect_timeout_s=20.0,
+                            heartbeat_interval_s=0.1),
+        trainer_hook=trainer_hook)
+    elapsed = time.monotonic() - t0
+    evicted = [e for e in s.serv.events
+               if e["kind"] == "trainer_evicted"]
+    s.serv.shutdown()
+    ok = (not errs and 0 in res and len(res[0]) == args.steps
+          and bool(evicted) and elapsed < 120.0)
+    return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "survivor_steps": len(res.get(0, [])),
+            "evicted": [e["tid"] for e in evicted],
+            "errors": {k: repr(v) for k, v in errs.items()}}
+
+
+def _scenario_drop30(args):
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.resilience import NetFaultProxy, RetryPolicy
+    res, errs, s, _ = _dist_run(args.seed, args.steps)
+    s.serv.shutdown()
+    if errs:
+        return {"ok": False, "error": repr(errs)}
+    clean = res[0]
+
+    proxies = []
+
+    def endpoint_hook(real):
+        p = NetFaultProxy(real, seed=args.seed)
+        p.set_drop_rate(0.30)
+        proxies.append(p)
+        return p.endpoint
+
+    t0 = time.monotonic()
+    res, errs, s, _ = _dist_run(
+        args.seed, args.steps, endpoint_hook=endpoint_hook,
+        runtime_kwargs=dict(
+            deadline_s=0.5, connect_timeout_s=20.0,
+            retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                              max_delay=0.2, seed=args.seed)))
+    elapsed = time.monotonic() - t0
+    s.serv.shutdown()
+    dropped = sum(1 for e in proxies[0].events if e[0] == "drop")
+    for p in proxies:
+        p.close()
+    if errs:
+        return {"ok": False, "error": repr(errs), "elapsed_s": elapsed}
+    diff = float(np.max(np.abs(np.asarray(res[0]) - np.asarray(clean))))
+    return {"ok": dropped > 0 and diff < 1e-5 and elapsed < 180.0,
+            "elapsed_s": round(elapsed, 2), "frames_dropped": dropped,
+            "max_loss_trace_diff": diff}
+
+
+DIST_SCENARIOS = {
+    "pserver_restart": _scenario_pserver_restart,
+    "trainer_kill": _scenario_trainer_kill,
+    "drop30": _scenario_drop30,
+}
+
+
+def run_distributed(args):
+    report = {"distributed": True, "seed": args.seed,
+              "steps": args.steps, "scenarios": {}}
+    names = [args.scenario] if args.scenario else list(DIST_SCENARIOS)
+    for name in names:
+        try:
+            report["scenarios"][name] = DIST_SCENARIOS[name](args)
+        except Exception as e:
+            report["scenarios"][name] = {"ok": False, "error": repr(e)}
+    report["ok"] = all(v.get("ok") for v in report["scenarios"].values())
+    print(json.dumps(report, indent=2, default=str))
+    sys.exit(0 if report["ok"] else 1)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=30)
@@ -115,7 +353,20 @@ def main():
     ap.add_argument("--no-check", dest="check", action="store_false",
                     help="skip the fault-free twin comparison")
     ap.add_argument("--rtol", type=float, default=1e-2)
+    ap.add_argument("--distributed", action="store_true",
+                    help="run the wire-level PS chaos scenarios "
+                    "(pserver kill/restart, trainer kill, 30%% drop) "
+                    "and emit a JSON verdict")
+    ap.add_argument("--scenario", choices=sorted(DIST_SCENARIOS),
+                    default=None,
+                    help="with --distributed: run just one scenario")
     args = ap.parse_args()
+
+    if args.distributed:
+        if args.steps == 30:
+            args.steps = 4  # distributed default: short sync runs
+        run_distributed(args)
+        return
 
     from paddle_tpu.resilience import FaultInjector, TrainingAborted
     injector = FaultInjector(seed=args.seed)

@@ -44,6 +44,8 @@ PUBLIC_MODULES = [
     "paddle_tpu.contrib.slim.quantization",
     "paddle_tpu.contrib.utils",
     "paddle_tpu.recordio",
+    "paddle_tpu.resilience",
+    "paddle_tpu.distributed",
     "paddle_tpu.serving",
     "paddle_tpu.dataset_factory",
     "paddle_tpu.incubate.data_generator",
