@@ -58,8 +58,18 @@ class BuildStrategy:
         # "rs_ag" = reduce-scatter + all-gather (arXiv:2004.13336,
         # bit-identical to exact); "q8" = block-quantized int8
         # all-reduce with per-parameter error feedback
-        # (arXiv:2506.17615 analog). See docs/gradient_sync.md.
+        # (arXiv:2506.17615 analog); "sharded_update" /
+        # "sharded_update_q8" = ZeRO-sharded weight update — gradients
+        # are reduce-scattered (fp32 bit-exact, or int8+EF), the
+        # optimizer runs on the 1/n shard over 1/n-sharded accumulator
+        # slots, and the fresh PARAMS are all-gathered. See
+        # docs/gradient_sync.md.
         self.gradient_sync = None
+        # Param all-gather leg of the sharded_update modes: "fp32"
+        # (bit-exact) or "q8" (int8 blocks + f32 scales on the wire,
+        # with a param-side error-feedback residual and full-precision
+        # master shards). Ignored by the non-sharded modes.
+        self.param_gather = "fp32"
         # fuse_elewise_add_act_ops runs the real ir pass (ir/passes.py);
         # the remaining toggles are accepted for parity — the XLA
         # compiler performs those fusions itself.
@@ -189,7 +199,9 @@ class CompiledProgram:
         return (tuple(d.id for d in mesh.devices.flat),
                 mesh.axis_names, tuple(mesh.shape.values()),
                 self._build_strategy.reduce_strategy,
-                self._build_strategy.gradient_sync, var_specs)
+                self._build_strategy.gradient_sync,
+                getattr(self._build_strategy, "param_gather", "fp32"),
+                var_specs)
 
     def grad_sync_plan(self, block):
         """Explicit-collective rewrite plan for the executor (None when
@@ -198,7 +210,10 @@ class CompiledProgram:
         if not gs:
             return None
         from .parallel import collectives
-        return collectives.make_plan(block, gs, self._mesh)
+        return collectives.make_plan(
+            block, gs, self._mesh,
+            param_gather=getattr(self._build_strategy, "param_gather",
+                                 "fp32"))
 
     # -- execution ---------------------------------------------------------
     def run(self, exe, feed, fetch_list, scope, return_numpy,
@@ -215,7 +230,24 @@ class CompiledProgram:
             enforce(gs in collectives.GRAD_SYNC_MODES,
                     "BuildStrategy.gradient_sync must be one of %s, "
                     "got %r", collectives.GRAD_SYNC_MODES, gs)
-            if gs == "q8":
+            if gs in collectives.SHARDED_MODES:
+                enforce(self._build_strategy.reduce_strategy ==
+                        BuildStrategy.ReduceStrategy.AllReduce,
+                        "gradient_sync=%r IS the explicit ZeRO "
+                        "sharding; combine it with "
+                        "reduce_strategy=AllReduce (Reduce would "
+                        "shard the parameters a second time)", gs)
+                # accumulator slots become 1/n shards (block shapes +
+                # scope values) BEFORE the executor snapshots the
+                # persistable carry; q8 param gather also needs master
+                # shards and param-side residuals
+                collectives.ensure_sharded_state(
+                    self.program, scope or global_scope(), self._mesh,
+                    param_gather=self._build_strategy.param_gather)
+                if gs == "sharded_update_q8":
+                    collectives.ensure_residual_vars(
+                        self.program, scope or global_scope())
+            elif gs == "q8":
                 # error-feedback residual slots must exist (block var +
                 # scope zeros) BEFORE the executor snapshots the
                 # persistable carry for this step

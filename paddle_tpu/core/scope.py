@@ -11,9 +11,16 @@ reference's per-op variable creation + garbage collection
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional
 
 from .enforce import NotFoundError
+
+# Monotonic scope identity. ``id(scope)`` is reused by the allocator
+# after a scope dies, so caches keyed on it (the collectives residual
+# memo was) can silently treat a fresh scope as already-initialized;
+# ``_uid`` never repeats within a process.
+_scope_uid = itertools.count(1)
 
 
 class Scope:
@@ -21,6 +28,7 @@ class Scope:
         self._vars: Dict[str, object] = {}
         self._parent = parent
         self._kids = []
+        self._uid = next(_scope_uid)
 
     def new_scope(self) -> "Scope":
         kid = Scope(self)

@@ -563,6 +563,15 @@ def run_block(block, env, step_key, library=None, grad_sync=None,
         # set is a superset), so pin the post hook there
         anomaly_guard.post_boundary = grad_sync.boundary \
             if grad_sync is not None else anomaly_guard.boundary
+        if grad_sync is not None:
+            # a sharded bracket can open EARLIER than the guard's
+            # optimize-role rule (regularizers carry backward role):
+            # the flag must still be derived from the RAW grads, i.e.
+            # immediately before apply() rewrites them
+            anomaly_guard.boundary = min(anomaly_guard.boundary,
+                                         grad_sync.boundary)
+    sync_end = getattr(grad_sync, "end_boundary", None) \
+        if grad_sync is not None else None
     for i, op in enumerate(block.ops):
         if anomaly_guard is not None and i == anomaly_guard.boundary:
             anomaly_guard.pre_sync(env)
@@ -571,6 +580,11 @@ def run_block(block, env, step_key, library=None, grad_sync=None,
         if anomaly_guard is not None \
                 and i == anomaly_guard.post_boundary:
             anomaly_guard.post_sync(env)
+        if sync_end is not None and i == sync_end:
+            # sharded_update: every bracketed param has been written —
+            # gather the fresh shards back to full params before
+            # anything downstream (EMA, averaging, fetches) reads them
+            grad_sync.finish(env)
         if i in skip:
             continue
         if i in adam_groups:
@@ -607,6 +621,9 @@ def run_block(block, env, step_key, library=None, grad_sync=None,
             raise InvalidArgumentError(
                 "op %s (#%d %r) needs variable %r which has no value%s"
                 % (op.type, i, op, missing, hint)) from e
+    if sync_end is not None and sync_end >= len(block.ops):
+        # the update ops are the block's tail (the usual layout)
+        grad_sync.finish(env)
     return env
 
 
@@ -825,6 +842,7 @@ class Executor:
         fn = self._cache.get(cache_key)
         if fn is None:
             carried = frozenset(persist_in)
+            self._check_sharded_layout(block)
             guard_plan = self._guard_plan(program, block)
 
             def step(persist, feed_vals, step_key):
@@ -1002,6 +1020,7 @@ class Executor:
             carried = frozenset(persist_in)
             persistable_names = frozenset(
                 n for n, v in block.vars.items() if v.persistable)
+            self._check_sharded_layout(block)
             guard_plan = self._guard_plan(program, block)
 
             def step(persist, feed_vals, idx, base_key):
@@ -1247,6 +1266,18 @@ class Executor:
 
     # -- internals ---------------------------------------------------------
     @staticmethod
+    def _check_sharded_layout(block, sync_plan=None):
+        """Trace-time guard: a block whose slot declarations were
+        converted to the 1/n sharded layout (ensure_sharded_state) must
+        run inside a ShardedUpdatePlan bracket — anything else gets an
+        actionable error instead of a bare shape mismatch deep in the
+        update lowering."""
+        if sync_plan is None or sync_plan.end_boundary is None:
+            from .parallel.collectives import \
+                reject_stale_sharded_layout
+            reject_stale_sharded_layout(block)
+
+    @staticmethod
     def _guard_plan(program, block):
         """Anomaly-guard rewrite plan for programs that had
         resilience.guard.install_anomaly_guard applied (trace-time
@@ -1321,6 +1352,7 @@ class Executor:
             # step), so the block scan stays off the per-step hot path
             sync_plan = dist.grad_sync_plan(block) if dist is not None \
                 else None
+            self._check_sharded_layout(block, sync_plan)
             guard_plan = self._guard_plan(program, block)
 
             def step(persist, feed_vals, step_key):

@@ -217,12 +217,30 @@ def _default_fill(scope, v):
 
 
 def _check_and_set(scope, v, arr):
+    geom = getattr(v, "_shard_geometry", None)
+    if geom is not None:
+        # sharded optimizer slot (collectives.ensure_sharded_state):
+        # declared (padded,). A checkpoint written under the same world
+        # size holds exactly that; a replicated-era checkpoint holds
+        # the full param shape — pad-flatten it into the shard layout
+        # (value-preserving, the same conversion ensure applies to
+        # scope values).
+        numel, padded = geom
+        if tuple(arr.shape) != (padded,) and arr.size == numel:
+            flat = np.zeros((padded,), arr.dtype)
+            flat[:numel] = arr.reshape(-1)
+            arr = flat
     want = tuple(int(d) for d in v.shape if d != -1)
     got = tuple(arr.shape)
     if want and got != want:
+        hint = ""
+        if geom is not None:
+            hint = (" — sharded slot: the padded shard length depends "
+                    "on world size; restore under the same device "
+                    "count the checkpoint was saved with")
         raise InvalidArgumentError(
-            "shape mismatch loading %r: checkpoint %s vs program %s"
-            % (v.name, got, want))
+            "shape mismatch loading %r: checkpoint %s vs program %s%s"
+            % (v.name, got, want, hint))
     scope.set_var(v.name, arr)
 
 

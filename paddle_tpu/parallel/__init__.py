@@ -20,7 +20,10 @@ from .mesh import (current_mesh, data_parallel_mesh, make_mesh,  # noqa
 from .api import shard, replicate  # noqa: F401
 from . import collectives  # noqa: F401
 from .collectives import (all_reduce_exact, all_reduce_q8,  # noqa: F401
-                          grad_bytes_per_step, reduce_scatter_gather)
+                          all_gather_params, all_gather_params_q8,
+                          ensure_sharded_state, grad_bytes_per_step,
+                          reduce_scatter_gather, reduce_scatter_shard,
+                          reduce_scatter_shard_q8, slot_bytes_per_chip)
 from . import ring_attention  # noqa: F401  (registers the op)
 from . import ulysses  # noqa: F401  (registers the op)
 from .ring_attention import ring_attention as ring_attention_fn  # noqa

@@ -349,6 +349,172 @@ def test_forward_only_program_has_no_plan():
     assert C.make_plan(main.global_block(), "q8", _mesh4()) is None
 
 
+def test_residual_memo_keyed_on_scope_uid_not_id():
+    """Regression (ISSUE 6 satellite): ensure_residual_vars memoized on
+    ``id(scope)`` — a GC'd scope's id can be recycled by a fresh scope,
+    silently skipping residual creation. The memo must carry the
+    monotonic Scope._uid instead, and a fresh scope (whatever its id)
+    must get its residuals."""
+    main, _startup, _loss = _build_model()
+    s1 = fluid.Scope()
+    C.ensure_residual_vars(main, s1)
+    assert s1.has_var(C.residual_name("fc_0.w_0"))
+    memo = main._q8_residual_memo
+    assert memo == (main._version, s1._uid)
+    assert id(s1) not in memo  # the old, unsafe key
+    del s1
+    # a brand-new scope — under CPython its id often IS the freed one
+    s2 = fluid.Scope()
+    C.ensure_residual_vars(main, s2)
+    assert s2.has_var(C.residual_name("fc_0.w_0"))
+    assert s2.find_var(C.residual_name("fc_0.w_0")) is not None
+    assert main._q8_residual_memo == (main._version, s2._uid)
+
+
+# ---------------------------------------------------------------------------
+# edge-shape property sweeps (scalar params, numel < world,
+# non-divisible padding, all-zero blocks, pad-slice round trips)
+# ---------------------------------------------------------------------------
+
+_EDGE_SHAPES = ((), (1,), (2,), (3,), (5, 3), (7,), (16, 32), (257,))
+
+
+def test_block_geometry_property_sweep():
+    for shape in _EDGE_SHAPES:
+        numel = int(np.prod(shape)) if shape else 1
+        for world in (1, 2, 4, 8):
+            bs, nblk, padded = C.block_geometry(numel, world)
+            assert bs >= 1 and nblk % world == 0
+            assert padded == nblk * bs >= numel
+            assert padded % world == 0  # whole blocks per device
+            # scalars/tiny tensors never explode the pad
+            assert padded <= max(2 * numel, 2 * world)
+
+
+def test_quantize_all_zero_tensor_exact():
+    """A fully-zero tensor survives both q8 legs exactly (scale=1.0
+    path, no div-by-zero) and leaves a zero residual."""
+    mesh = _mesh4()
+    g = jnp.zeros((5, 3), jnp.float32)
+    y, r = jax.jit(lambda x, rr: C.all_reduce_q8(x, rr, mesh,
+                                                 block_size=4))(
+        g, jnp.zeros((5, 3), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(y), 0.0)
+    np.testing.assert_array_equal(np.asarray(r), 0.0)
+    ys, rs = jax.jit(lambda x, rr: C.reduce_scatter_shard_q8(
+        x, rr, mesh, block_size=4))(g, jnp.zeros((5, 3), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(ys), 0.0)
+    np.testing.assert_array_equal(np.asarray(rs), 0.0)
+
+
+def test_rs_ag_edge_shapes_bit_exact(rng):
+    """The pad-slice in rs_ag round-trips exactly for scalars,
+    numel < world, and non-divisible sizes — bit-identical to the
+    explicit psum on the same mesh."""
+    mesh = _mesh4()
+    for shape in _EDGE_SHAPES:
+        g = jnp.asarray(rng.randn(*shape).astype(np.float32)) \
+            if shape else jnp.float32(rng.randn())
+        ex = np.asarray(jax.jit(
+            lambda x, m=mesh: C.all_reduce_exact(x, m))(g))
+        ra = np.asarray(jax.jit(
+            lambda x, m=mesh: C.reduce_scatter_gather(x, m))(g))
+        np.testing.assert_array_equal(ex, ra, err_msg=str(shape))
+        assert np.shape(ra) == shape
+
+
+def test_q8_edge_shapes_bounded(rng):
+    mesh = _mesh4()
+    for shape in _EDGE_SHAPES:
+        g = jnp.asarray(rng.randn(*shape).astype(np.float32)) \
+            if shape else jnp.float32(rng.randn())
+        r0 = jnp.zeros(shape, jnp.float32)
+        y, r = jax.jit(lambda x, rr, m=mesh: C.all_reduce_q8(
+            x, rr, m, block_size=16))(g, r0)
+        assert np.shape(np.asarray(y)) == shape
+        assert np.isfinite(np.asarray(y)).all()
+        assert np.isfinite(np.asarray(r)).all()
+
+
+def test_sharded_transport_roundtrip_edge_shapes(rng):
+    """scatter -> gather round-trips the exact reduced gradient for
+    every edge shape: gather(reduce_scatter_shard(g))[:numel] is
+    bit-identical to the explicit psum."""
+    mesh = _mesh4()
+    for shape in _EDGE_SHAPES:
+        g = jnp.asarray(rng.randn(*shape).astype(np.float32)) \
+            if shape else jnp.float32(rng.randn())
+        numel = int(np.prod(shape)) if shape else 1
+
+        def rt(x, m=mesh, numel=numel, shape=shape):
+            s = C.reduce_scatter_shard(x, m)
+            return C.all_gather_params(s, m)[:numel].reshape(shape)
+
+        ex = np.asarray(jax.jit(
+            lambda x, m=mesh: C.all_reduce_exact(x, m))(g))
+        got = np.asarray(jax.jit(rt)(g))
+        np.testing.assert_array_equal(ex, got, err_msg=str(shape))
+
+
+def test_sharded_q8_param_gather_roundtrip(rng):
+    """Quantized param gather: |gathered - (shard + r)| <= scale/2 per
+    block and the residual is exactly what the wire failed to ship."""
+    mesh = _mesh4()
+    numel = 37
+    bs, nblk, padded = C.block_geometry(numel, 4, 16)
+    flat = np.zeros(padded, np.float32)
+    flat[:numel] = rng.randn(numel).astype(np.float32)
+    shard = jnp.asarray(flat)
+    r0 = jnp.zeros((padded,), jnp.float32)
+    y, r = jax.jit(lambda s, rr, m=mesh: C.all_gather_params_q8(
+        s, rr, m, bs=bs, nblk=nblk))(shard, r0)
+    y, r = np.asarray(y), np.asarray(r)
+    ref, scale = _np_block_qdq(flat, 16, world=4)
+    np.testing.assert_allclose(y, ref.reshape(-1), atol=1e-6)
+    np.testing.assert_allclose(r, flat - y, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# estimator: sharded modes priced against hand-computed ring costs
+# ---------------------------------------------------------------------------
+
+def test_bytes_on_wire_sharded_modes_hand_computed():
+    shape, world = (512, 512), 4
+    numel = 512 * 512
+    bs, nblk, padded = C.block_geometry(numel, world)
+    half = (world - 1) / world
+    fp_leg = half * padded * 4
+    q8_leg = half * (padded + 4 * nblk)
+    # fp32 scatter + fp32 gather: each leg moves (n-1)/n of the payload
+    # ONCE — together the same total as the full all-reduce
+    assert C.bytes_on_wire(shape, "sharded_update", world) == \
+        int(round(2 * fp_leg)) == C.bytes_on_wire(shape, "exact", world)
+    # q8 scatter + fp32 gather
+    assert C.bytes_on_wire(shape, "sharded_update_q8", world) == \
+        int(round(q8_leg + fp_leg))
+    # q8 both legs == the q8 all-reduce's total
+    both = C.bytes_on_wire(shape, "sharded_update_q8", world,
+                           param_gather="q8")
+    assert both == int(round(2 * q8_leg)) == \
+        C.bytes_on_wire(shape, "q8", world)
+    assert both < 0.30 * C.bytes_on_wire(shape, "exact", world)
+    # one device moves nothing
+    assert C.bytes_on_wire(shape, "sharded_update", 1) == 0
+    with pytest.raises(Exception, match="param_gather"):
+        C.bytes_on_wire(shape, "sharded_update", world,
+                        param_gather="fp8")
+
+
+def test_grad_bytes_per_step_sharded_program():
+    main, _, _ = _build_model()
+    ex = C.grad_bytes_per_step(main, "exact", 4)
+    sh = C.grad_bytes_per_step(main, "sharded_update", 4)
+    q8both = C.grad_bytes_per_step(main, "sharded_update_q8", 4,
+                                   param_gather="q8")
+    assert sh == ex  # same total bytes, half per leg
+    assert q8both <= 0.30 * ex
+
+
 def test_quant_allreduce_op_registered():
     """The op twin participates in the registry's best-impl-wins
     machinery: base lowering quantizes, the exact variant does not."""
