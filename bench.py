@@ -634,6 +634,53 @@ def bench_pipelined_train(steps=None, batch=256, chunk_size=8):
             "mfu": _mfu(mnist_flops_per_step(batch), sps)}
 
 
+def bench_telemetry_overhead(steps=None, batch=256, chunk_size=8):
+    """Observability hot-path cost row: the pipelined CPU probe
+    (tools/pipeline_probe.py — prefetcher stall counters, executor
+    dispatch/compile counters, step-time histogram all live on this
+    path) run twice, registry ON vs STUBBED
+    (``observability.disabled()``). The overhead fraction is the
+    price of the telemetry plane where it could plausibly hurt; the
+    acceptance bar is < 2% steps/s. Run second so both measurements
+    reuse the probe's compiled executables (per-run jitter, not
+    compile time, is what's left)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import pipeline_probe
+
+    from paddle_tpu import observability as obs
+
+    steps = steps or int(_env_float("BENCH_TELEMETRY_STEPS", 48))
+
+    def run(stubbed):
+        if stubbed:
+            with obs.disabled():
+                r = pipeline_probe.probe(steps=steps, batch=batch,
+                                         chunk_size=chunk_size)
+        else:
+            r = pipeline_probe.probe(steps=steps, batch=batch,
+                                     chunk_size=chunk_size)
+        return r["pipelined"]["steps_per_s"]
+
+    # interleaved best-of-2 per mode (OFF,ON,OFF,ON): the CPU probe's
+    # run-to-run jitter (~5%) dwarfs the registry's per-dispatch
+    # microseconds, and interleaving keeps a monotonic load drift from
+    # landing entirely on one mode's pair
+    sps_off = run(True)
+    sps_on = run(False)
+    sps_off = max(sps_off, run(True))
+    sps_on = max(sps_on, run(False))
+    overhead = (1.0 - sps_on / sps_off) if sps_off else None
+    return {"metric": "telemetry_overhead",
+            "value": round(overhead, 4) if overhead is not None
+            else None,
+            "unit": "fraction steps/s lost (registry on vs stubbed)",
+            "on_steps_per_s": sps_on,
+            "off_steps_per_s": sps_off,
+            "steps": steps, "chunk_size": chunk_size,
+            "mfu": None}
+
+
 # ---------------------------------------------------------------------------
 # config 2: ResNet-50 ImageNet
 # ---------------------------------------------------------------------------
@@ -1304,6 +1351,7 @@ def child_main():
         # configs that measure in seconds. A stall in any config
         # forfeits only the ones after it.
         extra = [bench_mnist_mlp, bench_pipelined_train,
+                 bench_telemetry_overhead,
                  bench_guarded_overhead, bench_ps_degraded,
                  bench_serving_latency,
                  bench_deepfm, bench_bert,

@@ -27,6 +27,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from argparse import REMAINDER, ArgumentParser
 
@@ -56,6 +57,18 @@ def _parse_args(argv=None):
     parser.add_argument(
         "--log_dir", default=None,
         help="redirect each worker's output to <log_dir>/worker.N.log")
+    parser.add_argument(
+        "--server_num", type=int, default=0,
+        help="pserver processes to spawn on this node (PS mode); each "
+        "runs the same script with PADDLE_TRAINING_ROLE=PSERVER")
+    parser.add_argument(
+        "--servers_started_port", type=int, default=7170,
+        help="first pserver port on each node (PS mode)")
+    parser.add_argument(
+        "--journal_dir", default=None,
+        help="directory for per-worker structured event journals "
+        "(events.<role>.jsonl, observability.journal); defaults to "
+        "--log_dir when that is set")
     parser.add_argument(
         "training_script",
         help="the script to launch (followed by its own args)")
@@ -89,30 +102,112 @@ def get_cluster_env(args):
             "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
             "PADDLE_TRAINERS_NUM": str(len(endpoints)),
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_TRAINING_ROLE": "TRAINER",
         }
+        _stamp_role(env, args, "trainer-%d" % rank)
         if selected[local_rank]:
             env["FLAGS_selected_devices"] = selected[local_rank]
         envs.append(env)
     return envs
 
 
+def get_server_env(args):
+    """Per-pserver-process env dicts for PS mode (``--server_num``):
+    the PADDLE_PSERVER_* spelling plus the same role/journal stamping
+    trainers get, so fleet logs and journals stay attributable."""
+    ips = [ip.strip() for ip in args.cluster_node_ips.split(",")
+           if ip.strip()]
+    if args.node_ip not in ips:
+        raise ValueError(
+            "--node_ip %s is not in --cluster_node_ips %s"
+            % (args.node_ip, args.cluster_node_ips))
+    nserv = int(args.server_num or 0)
+    endpoints = ["%s:%d" % (ip, args.servers_started_port + j)
+                 for ip in ips for j in range(nserv)]
+    node_index = ips.index(args.node_ip)
+    envs = []
+    for local in range(nserv):
+        sid = node_index * nserv + local
+        env = {
+            "PADDLE_PSERVER_ID": str(sid),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[sid],
+            "PADDLE_PSERVER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_TRAINERS_NUM": str(
+                len(ips) * args.nproc_per_node),
+            "PADDLE_TRAINING_ROLE": "PSERVER",
+        }
+        _stamp_role(env, args, "pserver-%d" % sid)
+        envs.append(env)
+    return envs
+
+
+def _journal_dir(args):
+    return getattr(args, "journal_dir", None) or \
+        getattr(args, "log_dir", None)
+
+
+def _stamp_role(env, args, role):
+    """Role tag + role-stamped event-journal path (the observability
+    plane's per-process identity: journal events carry the role, and
+    each worker writes its own events.<role>.jsonl)."""
+    env["PADDLE_TPU_ROLE"] = role
+    jdir = _journal_dir(args)
+    if jdir:
+        env["PADDLE_TPU_EVENT_JOURNAL"] = os.path.join(
+            jdir, "events.%s.jsonl" % role)
+
+
+def _prefix_pump(pipe, role, sink):
+    """Copy a worker's merged stdout/stderr to ``sink`` with each line
+    prefixed by its role tag, so interleaved fleet logs stay
+    attributable to the worker that wrote them."""
+    try:
+        for line in pipe:
+            sink.write("[%s] %s" % (role, line))
+            sink.flush()
+    except ValueError:
+        pass  # sink closed mid-shutdown
+    finally:
+        pipe.close()
+
+
 def launch(args, poll_interval_s=0.2, term_grace_s=10.0):
-    envs = get_cluster_env(args)
-    procs, logs = [], []
+    # pservers first (trainers connect to them), then trainers. Log
+    # files keep the historical worker.<trainer_id>.log names;
+    # pservers get worker.<role>.log.
+    specs = [(env["PADDLE_TPU_ROLE"], "worker.%s.log"
+              % env["PADDLE_TPU_ROLE"], env)
+             for env in get_server_env(args)]
+    specs += [(env["PADDLE_TPU_ROLE"], "worker.%s.log"
+               % env["PADDLE_TRAINER_ID"], env)
+              for env in get_cluster_env(args)]
+    jdir = _journal_dir(args)
+    if jdir:
+        os.makedirs(jdir, exist_ok=True)
+    procs, logs, pumps = [], [], []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-    for local_rank, env in enumerate(envs):
+    for role, logname, env in specs:
         cmd = [sys.executable, "-u", args.training_script] \
             + args.training_script_args
         full = dict(os.environ, **env)
-        out = None
         if args.log_dir:
-            out = open(os.path.join(
-                args.log_dir,
-                "worker.%s.log" % env["PADDLE_TRAINER_ID"]), "w")
+            out = open(os.path.join(args.log_dir, logname), "w")
             logs.append(out)
-        procs.append(subprocess.Popen(cmd, env=full, stdout=out,
-                                      stderr=out))
+            procs.append(subprocess.Popen(cmd, env=full, stdout=out,
+                                          stderr=out))
+        else:
+            # no log dir: pipe through a role-prefixing pump so the
+            # shared console stays attributable
+            p = subprocess.Popen(cmd, env=full,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+            t = threading.Thread(target=_prefix_pump,
+                                 args=(p.stdout, role, sys.stdout),
+                                 daemon=True)
+            t.start()
+            pumps.append(t)
+            procs.append(p)
     rc = 0
     try:
         # Poll EVERY worker: the first failure anywhere triggers
@@ -141,6 +236,8 @@ def launch(args, poll_interval_s=0.2, term_grace_s=10.0):
         for q in procs:
             if q.poll() is None:
                 q.kill()
+        for t in pumps:
+            t.join(timeout=5)
         for f in logs:
             f.close()
     return rc

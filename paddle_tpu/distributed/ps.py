@@ -39,8 +39,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core.enforce import enforce
 from ..core.flags import FLAGS
+from ..observability import trace as _trace
 from ..io import (deserialize_tensor, durable_publish_dir,
                   remove_marked_dir, serialize_tensor)
 from ..resilience.retry import RetryBudgetExhausted, RetryPolicy
@@ -202,6 +204,12 @@ class ListenAndServ:
     def _event(self, kind, **kw):
         ev = dict(kind=kind, t=time.time(), **kw)
         self.events.append(ev)
+        # structured journal twin: same kind, endpoint-attributed
+        # ("seq" is the journal's own core field, so the wire seq of a
+        # dup_* event travels as wire_seq)
+        _obs.emit(kind, endpoint=self.endpoint,
+                  **{("wire_seq" if k == "seq" else k): v
+                     for k, v in kw.items()})
         if self._on_event is not None:
             try:
                 self._on_event(ev)
@@ -414,7 +422,7 @@ class ListenAndServ:
         return b""
 
     def _on_heartbeat(self, name, payload):
-        base, tid, _ = unpack_wire_name(name)
+        base, tid, seq = unpack_wire_name(name)
         with self._mu:
             if tid is not None:
                 if tid in self._evicted:
@@ -423,6 +431,12 @@ class ListenAndServ:
                         ("TrainerEvicted: trainer %d lease expired on "
                          "%s" % (tid, self.endpoint)).encode())
                 self._leases[tid] = time.monotonic()
+        if seq is not None:
+            # clock-sync raw material: the trainer journals the same
+            # beat as heartbeat_rtt {t0,t1}; pairing (tid, beat) across
+            # journals gives tools/trace_merge.py its offset estimate
+            _obs.emit("heartbeat_recv", tid=tid, beat=seq,
+                      endpoint=self.endpoint)
         return b""
 
     def _on_prefetch(self, name, payload):
@@ -730,9 +744,23 @@ class HeartbeatThread:
         return self._clients[ep]
 
     def _loop(self, ep):
+        # disjoint beat range per endpoint thread: trace_merge pairs
+        # heartbeat_rtt/heartbeat_recv by (tid, beat) ALONE (the
+        # trainer journals the dialed address, the server its bind
+        # address — through a proxy or alias they never match), so a
+        # beat id must not repeat across this trainer's endpoints
+        beat = (self.endpoints.index(ep) + 1) * 1_000_000
         while not self._stop.wait(self.interval_s):
+            beat += 1
             try:
-                self._client(ep).heartbeat()
+                t0 = time.time()
+                self._client(ep).heartbeat(seq=beat)
+                t1 = time.time()
+                # the trainer-side half of the clock-offset pair (the
+                # server journals heartbeat_recv for the same beat)
+                _obs.emit("heartbeat_rtt", endpoint=ep, beat=beat,
+                          tid=self.trainer_id, t0_wall=t0, t1_wall=t1,
+                          rtt_s=round(t1 - t0, 6))
             except TrainerEvicted:
                 self.evicted = True
             except Exception:
@@ -869,7 +897,7 @@ class PServerRuntime:
     def __init__(self, transpiler, endpoint, lookup_tables=None,
                  snapshot_dir=None, snapshot_every=1,
                  lease_timeout_s=None, allow_degraded=None,
-                 bind_endpoint=None):
+                 bind_endpoint=None, metrics_port=None):
         from ..core.scope import Scope
         from ..executor import Executor
         from ..framework import grad_var_name
@@ -908,6 +936,12 @@ class PServerRuntime:
             if self._snap is not None else None,
             snapshot_every=snapshot_every,
             restore_meta=restore_meta)
+        # optional process-wide Prometheus /metrics export thread
+        # (observability.export); one per pserver process
+        self.metrics_server = None
+        if metrics_port is not None:
+            self.metrics_server = _obs.start_metrics_server(
+                port=metrics_port)
 
     def _snapshot_shard(self, boundary, meta):
         from ..io import get_program_persistable_vars
@@ -938,7 +972,12 @@ class PServerRuntime:
 
     def run(self):
         """Blocks until every trainer COMPLETEs."""
-        self.serv.run_until_complete()
+        try:
+            self.serv.run_until_complete()
+        finally:
+            if self.metrics_server is not None:
+                self.metrics_server.stop()
+                self.metrics_server = None
 
 
 class ParameterServerRuntime:
@@ -1050,12 +1089,22 @@ class ParameterServerRuntime:
         for attempt in range(len(delays) + 1):
             start = self.comm.reconnect_count()
             try:
-                out = fn()
+                # one correlated span per phase ATTEMPT: every RPC the
+                # phase issues (including via the per-endpoint pool,
+                # which attaches this context) inherits its trace id,
+                # so a pserver's handler spans link back to exactly
+                # this trainer phase in the merged chrome trace
+                with _trace.span("ps_phase:%s" % what,
+                                 args={"attempt": attempt,
+                                       "trainer": self.trainer_id}):
+                    out = fn()
             except (RpcError, RetryBudgetExhausted) as e:
                 if attempt >= len(delays):
                     raise
                 self.events.append(("phase_retry", what, attempt,
                                     repr(e)))
+                _obs.emit("phase_retry", what=what, attempt=attempt,
+                          trainer=self.trainer_id, error=repr(e))
                 time.sleep(delays[attempt])
                 continue
             if self.comm.reconnect_count() == start:
@@ -1069,6 +1118,8 @@ class ParameterServerRuntime:
                     "UNAVAILABLE: %s phase kept landing on restarted "
                     "servers after %d replays" % (what, len(delays)))
             self.events.append(("phase_replay", what, attempt))
+            _obs.emit("phase_replay", what=what, attempt=attempt,
+                      trainer=self.trainer_id)
 
     def init_params(self):
         """Adopt the server-side initial parameter values (the
@@ -1107,8 +1158,16 @@ class ParameterServerRuntime:
             ep, bs = next(iter(by_ep.items()))
             fn(ep, bs)
             return
+        # trace context is thread-local: hand the caller's span to the
+        # pool workers so per-endpoint RPCs stay on the phase's trace
+        ctx = _trace.current_span()
+
+        def run(ep, bs):
+            with _trace.attach(ctx):
+                fn(ep, bs)
+
         with ThreadPoolExecutor(max_workers=len(by_ep)) as pool:
-            futs = [pool.submit(fn, ep, bs)
+            futs = [pool.submit(run, ep, bs)
                     for ep, bs in by_ep.items()]
             for f in futs:
                 f.result()  # propagate RPC errors

@@ -9,6 +9,7 @@ cross the wire in the io.py serialization format.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import threading
 import time
@@ -16,6 +17,8 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from .. import observability as _obs
+from .. import profiler as _profiler
 from ..core.enforce import UnavailableError, enforce
 from ..io import deserialize_tensor, serialize_tensor
 from ..native import load_library
@@ -88,25 +91,67 @@ class StatusReply(Exception):
         super().__init__("status=%d" % status)
 
 
-def pack_wire_name(name, trainer_id=None, seq=None):
+def pack_wire_name(name, trainer_id=None, seq=None, trace=None):
     """Encode per-request metadata into the (<=512 byte) name field:
-    ``var``, ``var@@tid`` or ``var@@tid@@seq``. The sequence number
-    makes SEND/PUSH_SPARSE idempotent: the server remembers the highest
-    seq applied per trainer and acks-without-applying any replay."""
-    if trainer_id is None:
+    ``var``, ``var@@tid``, ``var@@tid@@seq`` or
+    ``var@@tid@@seq@@trace-span``. The sequence number makes
+    SEND/PUSH_SPARSE idempotent: the server remembers the highest seq
+    applied per trainer and acks-without-applying any replay. The
+    optional 4th field carries the caller's trace/span ids
+    (observability.trace.wire_token) so the server's handler span can
+    be correlated with the client span that caused it; servers without
+    the field simply see no trace (parsers ignore extra fields)."""
+    if trainer_id is None and seq is None and trace is None:
         return name
-    if seq is None:
-        return "%s@@%d" % (name, trainer_id)
-    return "%s@@%d@@%d" % (name, trainer_id, seq)
+    parts = [name,
+             "" if trainer_id is None else "%d" % trainer_id,
+             "" if seq is None else "%d" % seq,
+             "" if trace is None else trace]
+    while parts and parts[-1] == "":
+        parts.pop()
+    return "@@".join(parts)
 
 
 def unpack_wire_name(wire):
-    """Inverse of pack_wire_name -> (name, trainer_id|None, seq|None)."""
+    """Inverse of pack_wire_name -> (name, trainer_id|None, seq|None).
+    Extra fields (the trace token) are ignored — use
+    ``unpack_wire_meta`` for the full 4-tuple."""
     parts = wire.split("@@")
     name = parts[0]
     tid = int(parts[1]) if len(parts) > 1 and parts[1] != "" else None
     seq = int(parts[2]) if len(parts) > 2 and parts[2] != "" else None
     return name, tid, seq
+
+
+def unpack_wire_meta(wire):
+    """-> (name, trainer_id|None, seq|None, trace_token|None)."""
+    parts = wire.split("@@")
+    name, tid, seq = unpack_wire_name(wire)
+    trace = parts[3] if len(parts) > 3 and parts[3] != "" else None
+    return name, tid, seq, trace
+
+_VERB_NAMES = {v: k for k, v in VERBS.items()}
+
+
+def _handler_span(verb_val, wire_name):
+    """Span wrapping one server-side handler invocation, tagged with
+    the INBOUND trace/span ids so the chrome trace links the pserver's
+    work to the trainer span that caused it. No-op (and no parsing)
+    unless the profiler is enabled — the RPC hot path stays clean."""
+    if not _profiler._enabled:
+        return contextlib.nullcontext()
+    from ..observability import trace as _trace
+    base, tid, _seq, tok = unpack_wire_meta(wire_name)
+    trace_id, parent = _trace.parse_wire_token(tok)
+    args = {"name": base}
+    if tid is not None:
+        args["trainer_id"] = tid
+    if parent is not None:
+        args["parent_span"] = parent
+    verb = _VERB_NAMES.get(verb_val, str(verb_val))
+    return _trace.span("rpc_server:%s" % verb, trace=trace_id,
+                       args=args)
+
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -227,7 +272,8 @@ class RPCServer:
                                                 resp, len(resp))
 
                 try:
-                    handler(name, body, responder)
+                    with _handler_span(verb.value, name):
+                        handler(name, body, responder)
                 except StatusReply as sr:
                     responder(sr.status, sr.payload)
                 except ServerCrash:
@@ -237,7 +283,8 @@ class RPCServer:
                     responder(STATUS_ERROR, repr(e).encode())
                 continue
             try:
-                resp = handler(name, body)
+                with _handler_span(verb.value, name):
+                    resp = handler(name, body)
                 status = STATUS_OK
             except StatusReply as sr:
                 resp, status = sr.payload, sr.status
@@ -358,12 +405,31 @@ class RPCClient:
             raise RpcError("UNAVAILABLE: cannot reconnect to %s: %s"
                            % (self.endpoint, e))
         self.reconnects += 1
+        _obs.registry().counter("rpc_reconnects_total",
+                                endpoint=self.endpoint).inc()
+        _obs.emit("rpc_reconnect", endpoint=self.endpoint,
+                  reconnects=self.reconnects)
 
     def call(self, verb: str, name: str = "", payload: bytes = b"",
              deadline_s=_UNSET, seq: Optional[int] = None) -> bytes:
-        wire = pack_wire_name(name, self.trainer_id, seq)
         dl = self.deadline_s if deadline_s is _UNSET else deadline_s
+        if _profiler._enabled:
+            # correlated span: the trace/span ids ride the wire so the
+            # server's handler span links back to this one. Only under
+            # an enabled profiler — the steady-state hot path carries
+            # no token and records nothing.
+            from ..observability import trace as _trace
+            with _trace.span("rpc_client:%s" % verb,
+                             args={"endpoint": self.endpoint,
+                                   "name": name}) as (tr, sp):
+                wire = pack_wire_name(name, self.trainer_id, seq,
+                                      trace=_trace.wire_token(tr, sp))
+                return self._call_retrying(verb, name, wire, payload,
+                                           dl)
+        wire = pack_wire_name(name, self.trainer_id, seq)
+        return self._call_retrying(verb, name, wire, payload, dl)
 
+    def _call_retrying(self, verb, name, wire, payload, dl):
         def once():
             if self._broken or self._h <= 0:
                 self._reconnect()
@@ -389,6 +455,8 @@ class RPCClient:
                            ctypes.byref(rlen), ctypes.byref(status))
         if rc == -4:
             self._broken = True  # stream desynced mid-frame
+            _obs.registry().counter("rpc_deadline_exceeded_total",
+                                    endpoint=self.endpoint).inc()
             raise DeadlineExceededError(
                 "DEADLINE_EXCEEDED: rpc %s(%s) to %s idle past %s"
                 % (verb, name, self.endpoint,
@@ -442,9 +510,12 @@ class RPCClient:
     def complete(self):
         self.call("COMPLETE")
 
-    def heartbeat(self, deadline_s=_UNSET):
-        """Renew this trainer's liveness lease (requires trainer_id)."""
-        self.call("HEARTBEAT", deadline_s=deadline_s)
+    def heartbeat(self, deadline_s=_UNSET, seq: Optional[int] = None):
+        """Renew this trainer's liveness lease (requires trainer_id).
+        ``seq`` tags the beat so trainer-side RTT samples and the
+        server's receive events pair up for clock-offset estimation
+        (tools/trace_merge.py)."""
+        self.call("HEARTBEAT", deadline_s=deadline_s, seq=seq)
 
     def close(self):
         if self._h > 0:

@@ -27,8 +27,10 @@ executor path.
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -36,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import framework, ops
+from . import observability as _obs
 from . import profiler as _profiler
 from .core.enforce import (InvalidArgumentError, UnimplementedError,
                            enforce)
@@ -711,6 +714,18 @@ class Executor:
         self._dispatch_count = 0
         # stats of the most recent pipelined *_from_dataset pass
         self._last_pipeline_stats = None
+        # telemetry: host-observed dispatch wall time (dispatch call ->
+        # return; async PJRT dispatch means this is host-side cost plus
+        # whatever backpressure the device applies, synced for real at
+        # readbacks) and a ring of per-step estimates (dt / steps, one
+        # entry per dispatch) backing telemetry()'s percentiles
+        self._step_seconds = 0.0
+        self._step_times = collections.deque(maxlen=2048)
+        reg = _obs.registry()
+        self._m_dispatch = reg.counter("executor_dispatches_total")
+        self._m_compile = reg.counter("executor_compiles_total")
+        self._m_steps = reg.counter("executor_steps_total")
+        self._h_dispatch = reg.histogram("executor_dispatch_seconds")
         # counters/sets are mutated from concurrent predictor clones
         # (AnalysisPredictor shares one Executor across clones); held
         # only around bookkeeping, never across a dispatch
@@ -756,6 +771,74 @@ class Executor:
         train_from_dataset / infer_from_dataset pass (None before
         one ran): chunks, steps, stall_s, h2d_s, stall_fraction."""
         return self._last_pipeline_stats
+
+    def _note_dispatch(self, dt, steps):
+        with self._lock:
+            self._step_seconds += dt
+            self._step_times.append(dt / max(1, steps))
+        self._h_dispatch.observe(dt)
+
+    def _note_compile(self, entry, shape_sig):
+        """Registry + journal accounting for one fresh (program,
+        feed-shape) compile — the compile-count blindness fix: every
+        recompile is an attributable event, not a silent perf cliff."""
+        self._m_compile.inc()
+        shapes = {k: "%s[%s]" % (dt, ",".join(str(d) for d in shp))
+                  for k, shp, dt in shape_sig}
+        _obs.emit("executor_compile", entry=entry, shapes=shapes,
+                  nth=self._compile_count)
+
+    def telemetry(self, scope=None, program=None):
+        """One observability snapshot of this Executor: throughput
+        (steps/s over host-observed dispatch time), the step-time
+        distribution, compile/dispatch accounting, input-pipeline
+        stall stats of the last *_from_dataset pass, anomaly-guard
+        skip counters read from ``scope``, and (when a distributed
+        ``program`` is passed) the estimated gradient-sync
+        bytes-on-wire per step."""
+        with self._lock:
+            steps = self._run_counter
+            dispatches = self._dispatch_count
+            compiles = self._compile_count
+            secs = self._step_seconds
+            times = list(self._step_times)
+        out = {
+            "steps": steps,
+            "dispatches": dispatches,
+            "compiles": compiles,
+            "dispatch_seconds_total": round(secs, 6),
+            "steps_per_s": round(steps / secs, 3) if secs > 0 else None,
+        }
+        if times:
+            arr = np.asarray(times) * 1e3
+            out["step_time_ms"] = {
+                "mean": round(float(arr.mean()), 4),
+                "p50": round(float(np.percentile(arr, 50)), 4),
+                "p95": round(float(np.percentile(arr, 95)), 4),
+                "max": round(float(arr.max()), 4),
+            }
+        else:
+            out["step_time_ms"] = None
+        ps = self._last_pipeline_stats
+        out["input_pipeline"] = dict(ps) if ps else None
+        out["stall_fraction"] = ps.get("stall_fraction") if ps else None
+        from .resilience import guard as _guard
+        skipped, consec = _guard.read_counters(scope or global_scope())
+        out["anomaly_skipped_steps"] = skipped
+        out["anomaly_consecutive"] = consec
+        if program is not None and getattr(program, "_is_compiled",
+                                           False):
+            try:
+                from .parallel.collectives import grad_bytes_per_step
+                bs = program._build_strategy
+                world = program._mesh.shape.get("dp", 1) \
+                    if program._mesh is not None else 1
+                out["bytes_on_wire_per_step"] = grad_bytes_per_step(
+                    program.program, bs.gradient_sync, world,
+                    param_gather=getattr(bs, "param_gather", "fp32"))
+            except Exception:
+                out["bytes_on_wire_per_step"] = None
+        return out
 
     def close(self):
         self._cache.clear()
@@ -897,13 +980,17 @@ class Executor:
             counter = self._run_counter
             self._run_counter += iters
             self._dispatch_count += 1
+        self._m_dispatch.inc()
+        self._m_steps.inc(iters)
         base_key = jax.random.fold_in(self._base_key(program), counter)
         with _profiler.RecordEvent("feed_h2d"):
             feed_vals = {k: jnp.asarray(v)
                          if not isinstance(v, jax.Array) else v
                          for k, v in feed.items()}
+        t0 = time.perf_counter()
         with _profiler.RecordEvent("executor_run_repeated"):
             fetches, persist_out = fn(persist_in, feed_vals, base_key)
+        self._note_dispatch(time.perf_counter() - t0, iters)
         for name, val in persist_out.items():
             scope.set_var(name, val)
         if return_numpy:
@@ -1015,6 +1102,8 @@ class Executor:
             if compiling:
                 self._compiled_sigs.add((cache_key, shape_sig))
                 self._compile_count += 1
+        if compiling:
+            self._note_compile("run_pipelined", shape_sig)
         fn = self._cache.get(cache_key)
         if fn is None:
             carried = frozenset(persist_in)
@@ -1092,9 +1181,12 @@ class Executor:
             counter = self._run_counter
             self._run_counter += iters
             self._dispatch_count += 1
+        self._m_dispatch.inc()
+        self._m_steps.inc(iters)
         base_key = self._base_key(program)
         idxs = jnp.asarray(np.arange(counter, counter + iters,
                                      dtype=np.int32))
+        t_dispatch = time.perf_counter()
         with _profiler.RecordEvent("scan_dispatch",
                                    args={"steps": int(iters)}):
             if not compiling:
@@ -1139,6 +1231,7 @@ class Executor:
                             continue  # feed-chunk-only: expected
                     warnings.warn_explicit(w.message, w.category,
                                            w.filename, w.lineno)
+        self._note_dispatch(time.perf_counter() - t_dispatch, iters)
         for name, val in persist_out.items():
             scope.set_var(name, val)
         if return_numpy:
@@ -1343,6 +1436,8 @@ class Executor:
             if new_shape:
                 self._compiled_sigs.add((cache_key, shape_sig))
                 self._compile_count += 1
+        if new_shape:
+            self._note_compile("run", shape_sig)
         fn = self._cache.get(cache_key) if use_program_cache else None
         compiled_here = fn is None or new_shape
         if fn is None:
@@ -1400,6 +1495,8 @@ class Executor:
             counter = self._run_counter
             self._run_counter += 1
             self._dispatch_count += 1
+        self._m_dispatch.inc()
+        self._m_steps.inc()
         step_key = jax.random.fold_in(self._base_key(program), counter)
 
         with _profiler.RecordEvent("feed_h2d"):
@@ -1416,8 +1513,10 @@ class Executor:
         # first invocation of a jitted step traces + compiles
         span = "executor_trace_compile" if compiled_here \
             else "executor_run"
+        t0 = time.perf_counter()
         with _profiler.RecordEvent(span):
             fetches, persist_out = fn(persist_in, feed_vals, step_key)
+        self._note_dispatch(time.perf_counter() - t0, 1)
 
         for name, val in persist_out.items():
             scope.set_var(name, val)

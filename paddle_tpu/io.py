@@ -24,6 +24,7 @@ from typing import List, Optional
 import numpy as np
 
 from . import framework
+from . import observability as _obs
 from .core.enforce import InvalidArgumentError, enforce
 from .core.scope import global_scope
 from .framework import Parameter, Program, Variable, default_main_program
@@ -534,8 +535,12 @@ class CheckpointSaver:
                  for name, arr in snap.items()],
                 marker=self.MARKER, marker_text=str(step),
                 file_hook=hook)
+            _obs.emit("checkpoint_published", step=int(step),
+                      vars=len(snap), dir=self._dir)
             self._prune()
         except Exception as e:  # surfaced via wait()
+            _obs.emit("checkpoint_failed", step=int(step),
+                      error=repr(e))
             error_box.append(e)
 
     def _ckpt_dir(self, step):
@@ -610,6 +615,7 @@ class CheckpointSaver:
         steps = sorted(self.list_checkpoints())
         for s in steps[:-self._max_to_keep]:
             self._remove_ckpt_dir(self._ckpt_dir(s))
+            _obs.emit("checkpoint_pruned", step=int(s), dir=self._dir)
 
     # -- reading -------------------------------------------------------
     def list_checkpoints(self):

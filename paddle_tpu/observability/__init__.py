@@ -1,0 +1,65 @@
+"""Unified telemetry plane: metrics registry, structured event
+journal, and cross-process trace correlation.
+
+Reference analog: the reference dedicates a platform layer to
+observability (paddle/fluid/platform/profiler.{h,cc}); ``profiler.py``
+reproduced the RAII-span + chrome-trace piece, and this package is the
+rest — the one place the runtime's previously-disconnected telemetry
+islands (profiler counters, serving ``EngineStats``, executor
+compile/dispatch counts, RPC reconnects, guard skip counters,
+prefetcher stall stats, pserver runtime events) route through:
+
+  - **registry.py** — process-wide ``MetricsRegistry`` (labeled
+    counters/gauges/histograms, lock-cheap hot path), exported as
+    Prometheus text by **export.py**'s ``/metrics`` thread;
+  - **journal.py** — ``emit(kind, **fields)`` structured events with
+    wall+monotonic time, pid/role, per-process seq, and an optional
+    JSONL sink per process (the launcher stamps one per worker);
+  - **trace.py** — trace/span ids that ride the RPC wire next to
+    ``@@tid@@seq`` so pserver handler spans link to the trainer spans
+    that caused them; ``tools/trace_merge.py`` merges per-process
+    chrome traces into one timeline.
+
+See docs/observability.md for the schema and walkthroughs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .export import MetricsServer, start_metrics_server  # noqa: F401
+from .journal import (clear as clear_journal,  # noqa: F401
+                      configure as configure_journal,
+                      emit, events as journal_events, get_role,
+                      read_journal, set_role)
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, registry)
+from .trace import (attach, current_span, new_span_id,  # noqa: F401
+                    new_trace_id, parse_wire_token, span, wire_token)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "registry",
+    "emit", "journal_events", "clear_journal", "configure_journal",
+    "read_journal", "set_role", "get_role",
+    "span", "attach", "current_span", "new_trace_id", "new_span_id",
+    "wire_token", "parse_wire_token",
+    "MetricsServer", "start_metrics_server", "disabled",
+]
+
+
+@contextlib.contextmanager
+def disabled():
+    """Stub the whole telemetry plane (registry mutations + journal
+    emits become no-ops) for the duration — the baseline the
+    ``telemetry_overhead`` bench row measures against. Spans/profiler
+    behavior is unchanged (already gated on the profiler switch)."""
+    from . import journal as _journal
+    reg = registry()
+    prev_reg, prev_j = reg.enabled, _journal._ENABLED
+    reg.set_enabled(False)
+    _journal.set_enabled(False)
+    try:
+        yield
+    finally:
+        reg.set_enabled(prev_reg)
+        _journal.set_enabled(prev_j)

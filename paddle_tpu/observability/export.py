@@ -1,0 +1,84 @@
+"""Lightweight Prometheus-text ``/metrics`` export thread.
+
+``start_metrics_server(port=0)`` binds a daemon ThreadingHTTPServer
+serving:
+
+  - ``GET /metrics``  -> ``registry().prometheus_text()`` (text/plain
+    version 0.0.4 — scrapeable by any Prometheus/agent);
+  - ``GET /journal``  -> the in-memory event ring as JSON (newest
+    last) — a poor-man's debug endpoint for seam debugging;
+  - ``GET /healthz``  -> 200 ok.
+
+Usable by serving engines (``ServingEngine(metrics_port=...)``) and
+pservers (``PServerRuntime(metrics_port=...)``) or standalone; one
+server per process is the intended shape (the registry is
+process-wide)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import journal as _journal
+from .registry import registry
+
+__all__ = ["MetricsServer", "start_metrics_server"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — http.server contract
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = registry().prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/journal":
+            body = json.dumps(_journal.events(),
+                              default=repr).encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """Owns the HTTP server + its serve thread; ``stop()`` to close."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = "http://%s:%d" % (host, self.port)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="obs-metrics-%d" % self.port)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def start_metrics_server(port: int = 0,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    return MetricsServer(port=port, host=host)

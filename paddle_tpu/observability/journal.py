@@ -1,0 +1,177 @@
+"""Structured event journal: one ``emit(kind, **fields)`` for every
+discrete runtime event, with an optional per-process JSONL sink.
+
+Every event carries wall + monotonic timestamps, the process id, a
+ROLE tag (``trainer-0`` / ``pserver-1`` / ``serving`` — stamped by
+``set_role`` or the ``PADDLE_TPU_ROLE`` env the launcher writes), and
+a per-process monotonic sequence number, so fleet logs from N
+processes merge into one causally-ordered timeline
+(``tools/obs_dump.py`` / ``tools/trace_merge.py``).
+
+Producers routed through here: ``PServerRuntime``/``ListenAndServ``
+events (snapshot, trainer_evicted, dup_send_ignored, ...),
+``GuardedTrainer`` rollback/retry/abort, ``CheckpointSaver``
+publish/prune, serving ``server_overloaded``/``batcher_died``,
+executor recompiles, RPC reconnects, and heartbeat RTT samples (the
+clock-offset raw material for cross-process trace merge).
+
+The sink is configured per process: ``configure(path)`` or the
+``PADDLE_TPU_EVENT_JOURNAL`` env var (checked lazily on first emit —
+the launcher stamps one path per worker). Events are also kept in a
+bounded in-memory ring readable via ``events()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["emit", "events", "clear", "configure", "set_role",
+           "get_role", "read_journal"]
+
+_MU = threading.Lock()
+_RING: "collections.deque" = collections.deque(maxlen=4096)
+_SEQ = 0
+_ROLE: Optional[str] = None
+_SINK = None
+_SINK_PATH: Optional[str] = None
+_ENV_CHECKED = False
+_ENABLED = True
+
+ENV_JOURNAL = "PADDLE_TPU_EVENT_JOURNAL"
+ENV_ROLE = "PADDLE_TPU_ROLE"
+
+
+def set_role(role: Optional[str]):
+    """Stamp this process's role (``trainer-k`` / ``pserver-j`` /
+    ``serving``); None reverts to the env/pid default."""
+    global _ROLE
+    with _MU:
+        _ROLE = role
+
+
+def get_role() -> str:
+    role = _ROLE or os.environ.get(ENV_ROLE)
+    return role if role else "pid-%d" % os.getpid()
+
+
+def configure(path: Optional[str] = None, capacity: Optional[int] = None):
+    """Set (or with ``path=None`` close) the JSONL sink; optionally
+    resize the in-memory ring. Returns the active sink path."""
+    global _SINK, _SINK_PATH, _RING, _ENV_CHECKED
+    with _MU:
+        _ENV_CHECKED = True  # explicit config wins over the env var
+        if _SINK is not None:
+            try:
+                _SINK.close()
+            except Exception:
+                pass
+            _SINK, _SINK_PATH = None, None
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # line-buffered append: each event is one durable-ish line,
+            # and a crashed process leaves at worst one torn tail line
+            # (read_journal skips it)
+            _SINK = open(path, "a", buffering=1)
+            _SINK_PATH = path
+        if capacity is not None:
+            _RING = collections.deque(_RING, maxlen=int(capacity))
+        return _SINK_PATH
+
+
+def sink_path() -> Optional[str]:
+    _check_env()
+    return _SINK_PATH
+
+
+def _check_env():
+    """First-emit lazy pickup of the launcher-stamped journal path."""
+    global _ENV_CHECKED, _SINK, _SINK_PATH
+    if _ENV_CHECKED:
+        return
+    with _MU:
+        if _ENV_CHECKED:
+            return
+        _ENV_CHECKED = True
+        path = os.environ.get(ENV_JOURNAL)
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            _SINK = open(path, "a", buffering=1)
+            _SINK_PATH = path
+
+
+def set_enabled(on: bool):
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def emit(kind: str, **fields) -> Optional[dict]:
+    """Record one structured event; returns it (None while disabled).
+    ``fields`` must be JSON-able-ish (non-serializable values degrade
+    to repr in the sink, never crash the caller)."""
+    global _SEQ
+    if not _ENABLED:
+        return None
+    _check_env()
+    ev = dict(fields)
+    # core keys win over caller fields — the schema is the contract
+    ev.update(kind=str(kind), t_wall=time.time(),
+              t_mono=time.monotonic(), pid=os.getpid(),
+              role=get_role())
+    # ONE critical section for seq assignment + ring/sink append, so
+    # the journal's on-disk order IS its seq (causal) order even under
+    # concurrent emitters
+    with _MU:
+        _SEQ += 1
+        ev["seq"] = _SEQ
+        _RING.append(ev)
+        if _SINK is not None:
+            try:
+                _SINK.write(json.dumps(ev, default=repr) + "\n")
+            except Exception:
+                pass  # a full disk must not take training down
+    return ev
+
+
+def events(kind: Optional[str] = None,
+           since_seq: int = 0) -> List[dict]:
+    """In-memory ring view, oldest first; filter by ``kind`` and/or
+    strictly-greater ``since_seq``."""
+    with _MU:
+        evs = list(_RING)
+    return [e for e in evs
+            if (kind is None or e["kind"] == kind)
+            and e["seq"] > since_seq]
+
+
+def clear():
+    """Drop the in-memory ring (the sink file is untouched). The
+    per-process seq counter is NOT rewound: a configured sink may
+    already hold events with higher seqs, and the on-disk contract is
+    that seq order IS causal order for the life of the process."""
+    with _MU:
+        _RING.clear()
+
+
+def read_journal(path: str) -> List[dict]:
+    """Parse one JSONL journal file; malformed lines (torn tail of a
+    killed process) are skipped, not fatal."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

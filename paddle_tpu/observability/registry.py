@@ -1,0 +1,314 @@
+"""Process-wide metrics registry: labeled counters / gauges /
+histograms with a lock-cheap hot path.
+
+Reference analog: the reference scatters scalar accounting across
+subsystems (profiler counters, serving EngineStats, executor compile
+counts, RPC reconnect tallies, guard skip counters). This registry is
+the ONE store they all route through while keeping their existing
+public APIs — so ``tools/obs_dump.py``, the Prometheus ``/metrics``
+exporter (export.py), and ``Executor.telemetry()`` see a single
+consistent view of the process.
+
+Cost model: a bump is one dict-free attribute path — callers hold the
+metric object (``registry().counter(name)`` memoizes), and ``inc`` is
+one lock acquire + one float add, exactly what the old
+``profiler.bump_counter`` paid. Metric CREATION takes the registry
+lock; steady-state mutation takes only the metric's own lock.
+
+``registry().set_enabled(False)`` (or ``observability.disabled()``)
+turns every mutation into a no-op — the stub the
+``telemetry_overhead`` bench row compares against.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry"]
+
+# default histogram buckets: seconds-scaled (covers sub-ms device
+# dispatches through multi-second compiles)
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _label_key(labels: Dict[str, object]) -> Tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name: str, labels: Dict[str, str], reg):
+        self.name = name
+        self.labels = dict(labels)
+        self._reg = reg
+        self._mu = threading.Lock()
+
+    def _on(self) -> bool:
+        return self._reg._enabled
+
+    def label_str(self) -> str:
+        return _labels_str(self.labels)
+
+
+class Counter(_Metric):
+    """Monotonic accumulator (resettable for tests/bench probes)."""
+
+    kind = "counter"
+
+    def __init__(self, name, labels, reg):
+        super().__init__(name, labels, reg)
+        self._v = 0.0
+
+    def inc(self, value: float = 1.0):
+        if not self._on():
+            return
+        with self._mu:
+            self._v += float(value)
+
+    @property
+    def value(self) -> float:
+        with self._mu:
+            return self._v
+
+    def reset(self):
+        with self._mu:
+            self._v = 0.0
+
+
+class Gauge(_Metric):
+    """Last-write-wins scalar (queue depth, stall fraction, ...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, labels, reg):
+        super().__init__(name, labels, reg)
+        self._v = 0.0
+
+    def set(self, value: float):
+        if not self._on():
+            return
+        with self._mu:
+            self._v = float(value)
+
+    def inc(self, value: float = 1.0):
+        if not self._on():
+            return
+        with self._mu:
+            self._v += float(value)
+
+    @property
+    def value(self) -> float:
+        with self._mu:
+            return self._v
+
+    def reset(self):
+        with self._mu:
+            self._v = 0.0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (Prometheus classic shape): per-bucket
+    counts + running sum/count. ``observe`` is one bisect + three adds
+    under the metric lock."""
+
+    kind = "histogram"
+
+    def __init__(self, name, labels, reg, buckets=None):
+        super().__init__(name, labels, reg)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float):
+        if not self._on():
+            return
+        value = float(value)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._mu:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._mu:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._mu:
+            return self._sum
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        return {"buckets": list(self.buckets), "counts": counts,
+                "count": total, "sum": s,
+                "mean": (s / total) if total else None}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-upper-bound estimate of the q-quantile (the usual
+        Prometheus-side histogram_quantile approximation)."""
+        snap = self.snapshot()
+        total = snap["count"]
+        if not total:
+            return None
+        target = q * total
+        acc = 0
+        for ub, c in zip(list(self.buckets) + [float("inf")],
+                         snap["counts"]):
+            acc += c
+            if acc >= target:
+                return ub
+        return float("inf")
+
+    def reset(self):
+        with self._mu:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+
+class MetricsRegistry:
+    """Keyed store of metrics; one process-wide instance via
+    ``registry()`` (private instances allowed for tests)."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge,
+              "histogram": Histogram}
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: Dict[Tuple, _Metric] = {}
+        self._enabled = True
+
+    # -- creation/lookup (memoized; hot callers keep the object) ------
+    def _get(self, kind, name, labels, **kw):
+        key = (kind, name, _label_key(labels))
+        m = self._metrics.get(key)
+        if m is not None:
+            return m
+        with self._mu:
+            m = self._metrics.get(key)
+            if m is None:
+                existing_kind = next(
+                    (k for (k, n, lk), _ in self._metrics.items()
+                     if n == name and k != kind), None)
+                if existing_kind is not None:
+                    raise ValueError(
+                        "metric %r already registered as a %s"
+                        % (name, existing_kind))
+                m = self._KINDS[kind](name, labels, self, **kw)
+                self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels)
+
+    def histogram(self, name: str, buckets=None, **labels) -> Histogram:
+        return self._get("histogram", name, labels, buckets=buckets)
+
+    # -- enable/disable (the bench stub) ------------------------------
+    def set_enabled(self, on: bool):
+        self._enabled = bool(on)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- reducing -----------------------------------------------------
+    def _sorted(self):
+        with self._mu:
+            ms = list(self._metrics.values())
+        return sorted(ms, key=lambda m: (m.name, _label_key(m.labels)))
+
+    def snapshot(self) -> dict:
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}}
+        keyed by ``name{label="v",...}``."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self._sorted():
+            key = m.name + m.label_str()
+            if m.kind == "counter":
+                out["counters"][key] = m.value
+            elif m.kind == "gauge":
+                out["gauges"][key] = m.value
+            else:
+                out["histograms"][key] = m.snapshot()
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every metric.
+        Served by ``observability.start_metrics_server``."""
+        lines = []
+        seen_type = set()
+        for m in self._sorted():
+            name = _prom_name(m.name)
+            if name not in seen_type:
+                seen_type.add(name)
+                lines.append("# TYPE %s %s" % (name, m.kind))
+            if m.kind in ("counter", "gauge"):
+                lines.append("%s%s %s"
+                             % (name, m.label_str(), _fmt(m.value)))
+                continue
+            snap = m.snapshot()
+            acc = 0
+            base = dict(m.labels)
+            for ub, c in zip(snap["buckets"] + [float("inf")],
+                             snap["counts"]):
+                acc += c
+                lab = dict(base)
+                lab["le"] = "+Inf" if ub == float("inf") else _fmt(ub)
+                lines.append("%s_bucket%s %d"
+                             % (name, _labels_str(lab), acc))
+            lines.append("%s_sum%s %s" % (name, m.label_str(),
+                                          _fmt(snap["sum"])))
+            lines.append("%s_count%s %d" % (name, m.label_str(),
+                                            snap["count"]))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self):
+        """Drop every metric (tests). Live handles callers memoized
+        keep mutating their detached objects harmlessly."""
+        with self._mu:
+            self._metrics = {}
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isalnum() or ch in "_:"
+        if ok and ch.isdigit() and i == 0:
+            ok = False
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+def _labels_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, str(v).replace('"', r'\"'))
+        for k, v in sorted(labels.items()))
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry every telemetry island routes
+    through."""
+    return _REGISTRY

@@ -1,0 +1,116 @@
+"""Cross-process trace correlation: trace/span ids that ride the RPC
+wire so a pserver's handler span links back to the trainer span that
+caused it.
+
+Model (w3c-traceparent shaped, minimal): a TRACE id names one causal
+chain (e.g. one training step's communication phase); each unit of
+work inside it is a SPAN with its own id and an optional parent span.
+``span(name)`` opens a profiler ``RecordEvent`` carrying
+``trace``/``span``/``parent_span`` args (visible in the chrome trace's
+args panel) and installs the context in a thread-local stack, so
+nested spans and RPC calls issued inside it inherit the trace.
+
+Wire format: ``pack_wire_name`` appends a 4th ``@@``-delimited field
+``<trace>-<span>`` next to ``@@tid@@seq`` (rpc.py); the server tags
+its ``rpc_server:<VERB>`` span with the inbound ids. Spans are only
+recorded while the profiler is enabled (RecordEvent's no-op contract),
+so the steady-state RPC hot path pays nothing.
+
+``tools/trace_merge.py`` merges the per-process chrome traces into one
+timeline (clock offsets estimated from heartbeat RTT journal events)
+and draws flow arrows between client and server spans sharing a trace
+id.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["span", "attach", "current_span", "new_trace_id",
+           "new_span_id", "wire_token", "parse_wire_token"]
+
+_tls = threading.local()
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(4).hex()
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span() -> Tuple[Optional[str], Optional[str]]:
+    """(trace_id, span_id) of the innermost open span on this thread,
+    or (None, None)."""
+    st = _stack()
+    return st[-1] if st else (None, None)
+
+
+@contextlib.contextmanager
+def span(name: str, args: Optional[dict] = None,
+         trace: Optional[str] = None):
+    """Open a correlated span: a profiler RecordEvent named ``name``
+    whose args carry trace/span/parent ids. ``trace`` forces the trace
+    id (servers adopt the inbound one); otherwise the enclosing span's
+    trace is inherited, or a fresh one is minted."""
+    from .. import profiler as _profiler
+    parent_trace, parent_span = current_span()
+    trace_id = trace or parent_trace or new_trace_id()
+    span_id = new_span_id()
+    a = dict(args or {})
+    a["trace"] = trace_id
+    a["span"] = span_id
+    if parent_span is not None and trace is None:
+        a["parent_span"] = parent_span
+    st = _stack()
+    st.append((trace_id, span_id))
+    try:
+        with _profiler.RecordEvent(name, args=a):
+            yield trace_id, span_id
+    finally:
+        st.pop()
+
+
+@contextlib.contextmanager
+def attach(context: Tuple[Optional[str], Optional[str]]):
+    """Adopt an existing (trace_id, span_id) as this thread's current
+    span — the hand-off for work crossing a thread-pool boundary
+    (e.g. the PS runtime's per-endpoint workers), where thread-local
+    context does not follow the task."""
+    if not context or context[0] is None:
+        yield
+        return
+    st = _stack()
+    st.append((context[0], context[1]))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def wire_token(trace_id: Optional[str],
+               span_id: Optional[str]) -> Optional[str]:
+    """Encode (trace, span) for the RPC name field; None when there is
+    nothing to carry."""
+    if not trace_id:
+        return None
+    return "%s-%s" % (trace_id, span_id or "")
+
+
+def parse_wire_token(tok: Optional[str]):
+    """Inverse of wire_token -> (trace_id|None, span_id|None)."""
+    if not tok or "-" not in tok:
+        return None, None
+    trace_id, span_id = tok.split("-", 1)
+    return trace_id or None, span_id or None

@@ -27,9 +27,9 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = ["RecordEvent", "record_event", "start_profiler",
-           "stop_profiler", "reset_profiler", "profiler",
-           "export_chrome_tracing", "device_summary_table",
-           "bump_counter", "counter_values",
+           "stop_profiler", "reset_profiler", "reset_counters",
+           "profiler", "export_chrome_tracing",
+           "device_summary_table", "bump_counter", "counter_values",
            "cuda_profiler", "npu_profiler"]
 
 _state = threading.local()
@@ -104,18 +104,35 @@ record_event = RecordEvent
 # input-pipeline stall metric (time the device dispatch loop waited on
 # host data) must be measurable from a plain bench/probe run without
 # turning on the full event recorder. Cost per bump is one lock + one
-# float add.
-_counters: dict = {}
+# float add. Storage is the process-wide observability.MetricsRegistry
+# (same hot-path cost), so these counters show up in /metrics and
+# obs_dump next to every other subsystem's.
+_bump_names: set = set()
+
+
+def _registry():
+    from .observability import registry
+    return registry()
 
 
 def bump_counter(name, value=1.0):
-    with _lock:
-        _counters[name] = _counters.get(name, 0.0) + float(value)
+    _bump_names.add(name)  # set.add is atomic under the GIL
+    _registry().counter(name).inc(value)
 
 
 def counter_values() -> dict:
-    with _lock:
-        return dict(_counters)
+    reg = _registry()
+    return {n: reg.counter(n).value for n in sorted(_bump_names)}
+
+
+def reset_counters():
+    """Zero the always-on counters. Deliberately SEPARATE from
+    ``reset_profiler``: counters back stall accounting and bench
+    probes that must survive span resets — a probe that clears spans
+    between phases must not silently lose its stall tally."""
+    reg = _registry()
+    for n in list(_bump_names):
+        reg.counter(n).reset()
 
 
 def start_profiler(state="All", trace_path=None):
@@ -139,10 +156,13 @@ def start_profiler(state="All", trace_path=None):
 
 
 def reset_profiler():
+    """Clear recorded SPANS (host + device events) only. The always-on
+    counters are NOT touched — ``pyreader`` stall accounting and bench
+    probes depend on them accumulating across span resets; clear those
+    explicitly with ``reset_counters()``."""
     with _lock:
         _events.clear()
         _device_events.clear()
-        _counters.clear()
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
@@ -303,10 +323,27 @@ def export_chrome_tracing(path):
             {"name": ev["name"], "cat": "device", "ph": "X",
              "ts": ev["ts_ns"] / 1e3, "dur": ev["dur_ns"] / 1e3,
              "pid": 1, "tid": tid, "args": {"stream": ev["line"]}})
+    # wall-clock anchor: trace ts is perf_counter-based (per-process
+    # arbitrary epoch), so cross-process merge (tools/trace_merge.py)
+    # needs a (wall_time, trace_ts) correspondence to rebase timelines
+    now_wall = time.time()
+    now_ts = (time.perf_counter() - base) * 1e6
+    from .observability import journal as _obs_journal
     meta = [{"name": "process_name", "ph": "M", "pid": 0,
              "args": {"name": "host"}},
             {"name": "process_name", "ph": "M", "pid": 1,
-             "args": {"name": "device (XLA)"}}]
+             "args": {"name": "device (XLA)"}},
+            {"name": "clock_sync", "ph": "M", "pid": 0,
+             "args": {"wall_time_s": now_wall, "trace_ts_us": now_ts,
+                      "role": _obs_journal.get_role(),
+                      "pid_os": os.getpid()}}]
+    # always-on counters ride along as chrome counter samples (one
+    # terminal sample per counter — totals, not a timeseries)
+    for cname, cval in counter_values().items():
+        trace_events.append(
+            {"name": cname, "cat": "counter", "ph": "C",
+             "ts": now_ts, "pid": 0, "tid": 0,
+             "args": {cname: cval}})
     trace = {"traceEvents": meta + trace_events}
     d = os.path.dirname(path)
     if d:

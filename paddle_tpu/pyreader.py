@@ -215,6 +215,12 @@ class DevicePrefetcher:
 
         _drain()
         self._thread.join(timeout=5)
+        # registry gauge: the pass's final stall fraction, next to the
+        # input_stall_s / chunk_h2d_s counters bump_counter maintains
+        frac = self.stats().get("stall_fraction")
+        if frac is not None:
+            from .observability import registry as _registry
+            _registry().gauge("input_stall_fraction").set(frac)
         # drain AGAIN after the join: a producer that was mid-put when
         # the first drain emptied the queue can land one final
         # device-resident chunk, which would stay pinned in device

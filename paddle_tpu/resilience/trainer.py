@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core.enforce import enforce
 from . import guard as guard_mod
 from .retry import RetryBudgetExhausted, RetryPolicy, retry_call
@@ -226,6 +227,12 @@ class GuardedTrainer:
     def summary(self) -> Dict:
         skipped, consec = guard_mod.read_counters(self._scope) \
             if self._guard_on else (0.0, 0.0)
+        # registry mirror (gauges: in-graph counters rewind at
+        # rollback, so last-read-wins is the honest shape)
+        reg = _obs.registry()
+        reg.gauge("guard_skipped_steps").set(
+            self._skipped_host + skipped)
+        reg.gauge("guard_consec_anomalies").set(consec)
         ckpts = self._saver.list_checkpoints() if self._saver else []
         return {
             "steps_run": self._steps_run,
@@ -258,6 +265,9 @@ class GuardedTrainer:
         return fetches
 
     def _on_retry(self, attempt, exc, delay):
+        _obs.emit("dispatch_retry", attempt=attempt, error=repr(exc),
+                  delay_s=delay)
+        _obs.registry().counter("guard_retries_total").inc()
         # a transient failure can strand donated device buffers in a
         # consumed state; a checkpoint restore heals the scope before
         # the retry re-dispatches (no-op for pre-dispatch failures)
@@ -314,6 +324,10 @@ class GuardedTrainer:
         self._exe._run_counter += 1
         self._rollbacks += 1
         self._steps_run = int(restored)
+        _obs.emit("rollback", restored_step=int(restored),
+                  consecutive_anomalies=int(consec),
+                  rollbacks=self._rollbacks)
+        _obs.registry().counter("guard_rollbacks_total").inc()
         return int(restored)
 
     def _maybe_checkpoint(self, step):
@@ -369,6 +383,8 @@ class GuardedTrainer:
         if self._saver is not None:
             self._save(self._steps_run, sync=True)
         self._aborted = reason
+        _obs.emit("training_aborted", reason=reason,
+                  step=self._steps_run)
         err = TrainingAborted(reason, self.summary())
         if cause is not None:
             raise err from cause

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
 from .. import profiler as _profiler
 from ..inference import AnalysisConfig, AnalysisPredictor
 from .buckets import bucket_for, bucket_sizes, pad_batch
@@ -162,7 +163,8 @@ class _ModelWorker:
                 elif not inp["dynamic_dims"]:
                     tail = dims  # batch-less decl: feed adds dim 0
             self._input_spec[inp["name"]] = (dt, tail)
-        self.stats = EngineStats(window=config.latency_window)
+        self.stats = EngineStats(window=config.latency_window,
+                                 model=name)
         self._queue = []  # FIFO of _Request
         self._cond = threading.Condition()
         self._stopped = False
@@ -235,6 +237,8 @@ class _ModelWorker:
                                     model=self.name)
             if len(self._queue) >= self.config.max_queue_size:
                 self.stats.count("rejected")
+                _obs.emit("server_overloaded", model=self.name,
+                          queue_depth=len(self._queue))
                 raise ServerOverloaded(
                     "queue full for model %r (%d queued)"
                     % (self.name, len(self._queue)),
@@ -434,6 +438,7 @@ class _ModelWorker:
         err = BatcherDied(
             "batcher thread for model %r died: %r" % (self.name, exc),
             model=self.name, cause=repr(exc))
+        _obs.emit("batcher_died", model=self.name, cause=repr(exc))
         self._dead_error = err
         with self._cond:
             self._stopped = True
@@ -480,10 +485,18 @@ class ServingEngine:
     blocks. Usable as a context manager (drains on exit)."""
 
     def __init__(self, model=None, config: Optional[ServingConfig] = None,
-                 name: str = "default"):
+                 name: str = "default", metrics_port=None):
+        """``metrics_port``: when not None, start the process-wide
+        Prometheus ``/metrics`` export thread on that port (0 = any
+        free port; see ``engine.metrics_server.port``). Stopped at
+        shutdown."""
         self._workers: Dict[str, _ModelWorker] = {}
         self._default: Optional[str] = None
         self._config = config
+        self.metrics_server = None
+        if metrics_port is not None:
+            self.metrics_server = _obs.start_metrics_server(
+                port=metrics_port)
         if model is not None:
             self.add_model(name, model, config)
 
@@ -547,6 +560,9 @@ class ServingEngine:
         with EngineStopped."""
         for w in self._workers.values():
             w.shutdown(drain=drain, timeout=timeout)
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     def __enter__(self):
         return self

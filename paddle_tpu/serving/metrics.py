@@ -18,13 +18,21 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability import registry as _registry
+
 __all__ = ["EngineStats"]
 
 
 class EngineStats:
-    """Thread-safe metric accumulator for one served model."""
+    """Thread-safe metric accumulator for one served model.
 
-    def __init__(self, window: int = 4096):
+    Registry-backed: every count/latency/batch sample is mirrored into
+    the process-wide ``observability.MetricsRegistry`` under
+    ``serving_*`` metrics labeled by model, so the serving SLO numbers
+    show up in ``/metrics`` and ``tools/obs_dump.py`` next to the rest
+    of the runtime. The snapshot()/stats() surface is unchanged."""
+
+    def __init__(self, window: int = 4096, model: str = "default"):
         self._lock = threading.Lock()
         # (t_done, latency_seconds) ring; t_done drives windowed QPS
         self._lat = collections.deque(maxlen=int(window))
@@ -37,6 +45,16 @@ class EngineStats:
         self.failed = 0           # dispatch raised / batcher died
         self.batches = 0
         self.started_at = time.monotonic()
+        reg = _registry()
+        self._m = {f: reg.counter("serving_requests_total",
+                                  model=model, outcome=f)
+                   for f in ("completed", "rejected", "expired",
+                             "failed")}
+        self._m["batches"] = reg.counter("serving_batches_total",
+                                         model=model)
+        self._m_rows = reg.counter("serving_rows_total", model=model)
+        self._h_latency = reg.histogram("serving_latency_seconds",
+                                        model=model)
 
     # -- recording -----------------------------------------------------
     def record_request(self, latency_s: float,
@@ -45,6 +63,8 @@ class EngineStats:
             self.completed += 1
             self._lat.append((t_done if t_done is not None
                               else time.monotonic(), latency_s))
+        self._m["completed"].inc()
+        self._h_latency.observe(latency_s)
 
     def record_batch(self, rows: int, bucket: int):
         with self._lock:
@@ -52,10 +72,15 @@ class EngineStats:
             self._bucket_hist[int(bucket)] += 1
             self._occ_rows += int(rows)
             self._occ_capacity += int(bucket)
+        self._m["batches"].inc()
+        self._m_rows.inc(rows)
 
     def count(self, field: str, n: int = 1):
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+        m = self._m.get(field)
+        if m is not None:
+            m.inc(n)
 
     # -- reducing ------------------------------------------------------
     def snapshot(self) -> dict:

@@ -51,9 +51,83 @@ def test_chrome_trace_export(tmp_path):
     names = {e["name"] for e in evs}
     assert "executor_run" in names
     for e in evs:
-        if e["ph"] == "M":     # track-name metadata
+        if e["ph"] in ("M", "C"):  # metadata / counter samples
             continue
         assert e["ph"] == "X" and e["dur"] >= 0
+    # cross-process merge anchor (tools/trace_merge.py)
+    sync = [e for e in evs if e["name"] == "clock_sync"]
+    assert sync and sync[0]["args"]["wall_time_s"] > 0
+
+
+def test_chrome_trace_no_device_events(tmp_path):
+    """Host-only capture (no jax.profiler trace): export must emit a
+    valid single-process trace with only host-pid spans."""
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("solo"):
+        pass
+    profiler._enabled = False  # silent stop: no table print
+    path = str(tmp_path / "t.json")
+    profiler.export_chrome_tracing(path)
+    evs = json.load(open(path))["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"solo"}
+    assert all(e["pid"] == 0 for e in spans)
+    assert not [e for e in evs if e.get("cat") == "device"]
+
+
+def test_chrome_trace_nested_same_name_spans(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("dup"):
+        with profiler.RecordEvent("dup"):
+            with profiler.RecordEvent("dup"):
+                pass
+    profiler._enabled = False
+    path = str(tmp_path / "t.json")
+    profiler.export_chrome_tracing(path)
+    dups = [e for e in json.load(open(path))["traceEvents"]
+            if e["name"] == "dup"]
+    assert len(dups) == 3
+    assert sorted(e["args"]["depth"] for e in dups) == [0, 1, 2]
+    # nesting: each deeper span starts no earlier and ends no later
+    dups.sort(key=lambda e: e["args"]["depth"])
+    for outer, inner in zip(dups, dups[1:]):
+        assert inner["ts"] >= outer["ts"]
+        assert inner["ts"] + inner["dur"] <= \
+            outer["ts"] + outer["dur"] + 1e-6
+
+
+def test_chrome_trace_counters_only(tmp_path):
+    """A run that never recorded a span (counters only) still exports
+    valid JSON, with the counters as chrome counter samples."""
+    profiler.reset_profiler()
+    profiler.reset_counters()
+    profiler.bump_counter("test_export_counter", 3.5)
+    path = str(tmp_path / "t.json")
+    profiler.export_chrome_tracing(path)
+    evs = json.load(open(path))["traceEvents"]
+    assert not [e for e in evs if e["ph"] == "X"]
+    cs = [e for e in evs if e["ph"] == "C"
+          and e["name"] == "test_export_counter"]
+    assert cs and cs[0]["args"]["test_export_counter"] == 3.5
+
+
+def test_chrome_trace_args_json_roundtrip(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+    args = {"bucket": 8, "rows": 5, "label": "q1",
+            "nested": {"a": [1, 2]}}
+    with profiler.RecordEvent("argspan", args=args):
+        pass
+    profiler._enabled = False
+    path = str(tmp_path / "t.json")
+    profiler.export_chrome_tracing(path)
+    ev = next(e for e in json.load(open(path))["traceEvents"]
+              if e["name"] == "argspan")
+    for k, v in args.items():
+        assert ev["args"][k] == v
+    assert ev["args"]["depth"] == 0
 
 
 def test_disabled_profiler_records_nothing():

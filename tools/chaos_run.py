@@ -21,6 +21,12 @@ python tools/chaos_run.py --steps 20 --nan-step 4 --q8
 python tools/chaos_run.py --distributed
 python tools/chaos_run.py --distributed --scenario pserver_restart
 
+# the OBSERVABILITY acceptance scenario: 2 trainers x 2 pservers,
+# pserver kill+restart under 5% drop, profiler + journal on -> one
+# merged chrome trace (client/server spans linked by trace id) and a
+# causally-ordered event journal (snapshot + recovery evidence)
+python tools/chaos_run.py --distributed --scenario restart_2x2_obs
+
 Exit code: 0 when the run completes and (with --check) the final loss
 is within --rtol of the fault-free twin (distributed: every scenario's
 verdict ok); 1 otherwise.
@@ -34,6 +40,7 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
@@ -191,6 +198,17 @@ def _dist_run(seed, steps, n_trainers=1, snapshot_dir=None,
     return results, errors, s, t
 
 
+def _journal_watermark():
+    from paddle_tpu import observability as obs
+    evs = obs.journal_events()
+    return evs[-1]["seq"] if evs else 0
+
+
+def _journal_kinds(since_seq):
+    from paddle_tpu import observability as obs
+    return {e["kind"] for e in obs.journal_events(since_seq=since_seq)}
+
+
 def _scenario_pserver_restart(args):
     import threading
     import time
@@ -203,6 +221,7 @@ def _scenario_pserver_restart(args):
     if errs:
         return {"ok": False, "error": repr(errs)}
     clean = res[0]
+    mark = _journal_watermark()
 
     snap = tempfile.mkdtemp(prefix="chaos-shards-")
     restarted = []
@@ -232,16 +251,25 @@ def _scenario_pserver_restart(args):
     if errs:
         return {"ok": False, "error": repr(errs), "elapsed_s": elapsed}
     diff = float(np.max(np.abs(np.asarray(res[0]) - np.asarray(clean))))
-    return {"ok": bool(restarted) and diff < 1e-5,
+    # event-journal assertions: the chaos run must be DIAGNOSABLE from
+    # the journal alone — a boundary snapshot happened, and recovery
+    # (reconnect / phase replay) left structured evidence
+    kinds = _journal_kinds(mark)
+    journal_ok = "snapshot" in kinds and bool(
+        kinds & {"rpc_reconnect", "phase_replay", "phase_retry"})
+    return {"ok": bool(restarted) and diff < 1e-5 and journal_ok,
             "elapsed_s": round(elapsed, 2),
             "kill_fired": bool(restarted),
             "max_loss_trace_diff": diff,
+            "journal_kinds": sorted(kinds),
+            "journal_ok": journal_ok,
             "losses": res[0], "fault_free_losses": clean}
 
 
 def _scenario_trainer_kill(args):
     import time
     lease = 0.6
+    mark = _journal_watermark()
 
     def trainer_hook(tid, step, rt):
         if tid == 1 and step >= 1:
@@ -261,11 +289,14 @@ def _scenario_trainer_kill(args):
     evicted = [e for e in s.serv.events
                if e["kind"] == "trainer_evicted"]
     s.serv.shutdown()
+    # the eviction must ALSO be visible in the structured journal
+    journal_ok = "trainer_evicted" in _journal_kinds(mark)
     ok = (not errs and 0 in res and len(res[0]) == args.steps
-          and bool(evicted) and elapsed < 120.0)
+          and bool(evicted) and journal_ok and elapsed < 120.0)
     return {"ok": ok, "elapsed_s": round(elapsed, 2),
             "survivor_steps": len(res.get(0, [])),
             "evicted": [e["tid"] for e in evicted],
+            "journal_ok": journal_ok,
             "errors": {k: repr(v) for k, v in errs.items()}}
 
 
@@ -309,10 +340,182 @@ def _scenario_drop30(args):
             "max_loss_trace_diff": diff}
 
 
+def _scenario_restart_2x2_obs(args):
+    """The observability acceptance scenario: 2 trainers x 2 pservers,
+    pserver 0 killed + restarted while every wire drops 5% of frames,
+    run under the profiler with a journal sink — must yield ONE merged
+    chrome trace whose trainer rpc_client spans link to pserver
+    rpc_server handler spans by trace id, and a journal whose
+    snapshot / recovery events appear in causal (seq) order."""
+    import contextlib
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed import (ParameterServerRuntime,
+                                        PServerRuntime)
+    from paddle_tpu.resilience import NetFaultProxy, RetryPolicy
+    from paddle_tpu.transpiler import DistributeTranspiler
+    import trace_merge
+
+    workdir = tempfile.mkdtemp(prefix="chaos-obs-")
+    journal_path = os.path.join(workdir, "events.jsonl")
+    trace_path = os.path.join(workdir, "trace.json")
+    merged_path = os.path.join(workdir, "merged.json")
+    obs.configure_journal(journal_path)
+
+    # model: 2 fc layers -> >=2 param blocks spread over 2 pservers
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = args.seed + 1
+    from paddle_tpu import layers
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [8], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            h = layers.fc(x, size=8, act="relu")
+            pred = layers.fc(h, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.3).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=start,
+                pservers="127.0.0.1:0,localhost:0", trainers=2)
+
+    snaps = [os.path.join(workdir, "shards-%d" % i) for i in (0, 1)]
+    servers = [PServerRuntime(t, ep, snapshot_dir=snaps[i])
+               for i, ep in enumerate(t.pserver_endpoints)]
+    proxies = []
+    restarted = []
+    for i, s in enumerate(servers):
+        p = NetFaultProxy(s.serv.endpoint, seed=args.seed + i)
+        p.set_drop_rate(0.05)
+        proxies.append(p)
+        t.set_block_endpoints(s._minis.keys(), p.endpoint)
+        s.serv.start()
+
+    # kill pserver 0 mid-run; a restarter rebinds its concrete port so
+    # the proxy's upstream comes back
+    port0 = servers[0].serv.server.port
+    servers[0].serv.crash_after("SEND", 3)
+
+    def restarter():
+        while not servers[0].serv.server._stop.is_set():
+            time.sleep(0.02)
+        # set_block_endpoints repointed server 0's universe at its
+        # proxy, so that is the restart's LOGICAL endpoint; the bind
+        # goes to the dead incarnation's concrete port (the proxy's
+        # upstream)
+        s2 = PServerRuntime(t, proxies[0].endpoint,
+                            snapshot_dir=snaps[0],
+                            bind_endpoint="127.0.0.1:%d" % port0)
+        s2.serv.start()
+        restarted.append(s2)
+
+    threading.Thread(target=restarter, daemon=True).start()
+
+    trainer = t.get_trainer_program()
+    feeds = _dist_feeds(args.seed, args.steps)
+    results, errors = {}, {}
+
+    def run_trainer(tid):
+        try:
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            # a barrier legitimately parks until the OTHER trainer
+            # recovers through the restart, so its deadline must
+            # cover a peer's reconnect+replay, not just one RPC
+            rt = ParameterServerRuntime(
+                t, trainer, scope, trainer_id=tid, deadline_s=5.0,
+                connect_timeout_s=20.0, heartbeat_interval_s=0.1,
+                phase_retries=6,
+                retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                                  max_delay=0.2, seed=args.seed + tid))
+            rt.init_params()
+            out = []
+            for f in feeds:
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+            results[tid] = out
+        except Exception as e:
+            errors[tid] = e
+
+    profiler.start_profiler("CPU")
+    t0 = time.monotonic()
+    ths = [threading.Thread(target=run_trainer, args=(i,))
+           for i in range(2)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=180)
+    elapsed = time.monotonic() - t0
+    profiler.export_chrome_tracing(trace_path)
+    with contextlib.redirect_stdout(sys.stderr):
+        profiler.stop_profiler()  # summary table -> stderr, not verdict
+    for s in servers + restarted:
+        try:
+            s.serv.shutdown()
+        except Exception:
+            pass
+    for p in proxies:
+        p.close()
+    obs.configure_journal(None)
+
+    if errors:
+        return {"ok": False, "elapsed_s": round(elapsed, 2),
+                "error": {k: repr(v) for k, v in errors.items()}}
+
+    # ONE merged trace (per-process traces + journals in the general
+    # case; this in-process scenario has one of each) with client and
+    # server spans linked by trace id
+    _, report = trace_merge.merge([trace_path], [journal_path],
+                                  merged_path)
+
+    events = obs.read_journal(journal_path)
+    kinds = [e["kind"] for e in events]
+    seqs = [e["seq"] for e in events]
+    snapshot_seq = next((e["seq"] for e in events
+                         if e["kind"] == "snapshot"), None)
+    recovery_seq = next((e["seq"] for e in events
+                         if e["kind"] in ("rpc_reconnect",
+                                          "phase_replay",
+                                          "phase_retry",
+                                          "trainer_evicted")), None)
+    causal = seqs == sorted(seqs)
+    # the wall bound asserts "no hang", not throughput: drop-recovery
+    # under 5% loss with 5s barrier deadlines is legitimately slow on
+    # a loaded box
+    # offsets_s non-empty proves the heartbeat-RTT pairing survives
+    # the proxy (trainer journals the dialed proxy address, server its
+    # bind address — the pair key must not depend on endpoint strings)
+    ok = (bool(restarted) and report["links"] > 0
+          and len(report["offsets_s"]) >= 1
+          and snapshot_seq is not None and recovery_seq is not None
+          and causal and 0 in results and 1 in results
+          and elapsed < 300.0)
+    return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "kill_fired": bool(restarted),
+            "trace_links": report["links"],
+            "clock_offsets_s": report["offsets_s"],
+            "merged_trace": merged_path,
+            "journal_events": len(events),
+            "snapshot_seq": snapshot_seq,
+            "recovery_seq": recovery_seq,
+            "causal_order": causal,
+            "journal_kind_sample": sorted(set(kinds))[:12],
+            "losses": results.get(0)}
+
+
 DIST_SCENARIOS = {
     "pserver_restart": _scenario_pserver_restart,
     "trainer_kill": _scenario_trainer_kill,
     "drop30": _scenario_drop30,
+    "restart_2x2_obs": _scenario_restart_2x2_obs,
 }
 
 

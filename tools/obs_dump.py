@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Dump one JSON observability snapshot: registry metrics + merged
+event journals.
+
+Inputs (combine freely):
+
+  --metrics URL|FILE   a Prometheus /metrics endpoint (the
+                       observability.start_metrics_server thread) or a
+                       saved exposition-text file; parsed into
+                       {metric{labels}: value} ("_bucket/_sum/_count"
+                       series stay flat — this is a dump, not a TSDB).
+  --journal PATH       a JSONL event journal (repeatable — one per
+                       worker process); events from every journal are
+                       merged into one wall-clock-ordered tail.
+  --tail N             events to keep in the merged tail (default 50).
+
+Example (after a launch.py run with --journal_dir logs/):
+
+    python tools/obs_dump.py --journal logs/events.trainer-0.jsonl \
+        --journal logs/events.pserver-0.jsonl --tail 20
+
+Prints ONE JSON object:
+  {"metrics": {...}|null,
+   "journals": {path: {"events": n, "role": ..., "kinds": {...}}},
+   "tail": [ ...merged events, oldest first... ]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_prometheus_text(text):
+    """Exposition text -> {"series": {name{labels}: value},
+    "types": {name: kind}}. Tolerant: malformed lines are skipped."""
+    series, types = {}, {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        key, _, val = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            series[key] = float(val)
+        except ValueError:
+            continue
+    return {"series": series, "types": types}
+
+
+def load_metrics(src):
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(src, timeout=5) as r:
+            text = r.read().decode()
+    else:
+        with open(src) as f:
+            text = f.read()
+    return parse_prometheus_text(text)
+
+
+def summarize_journal(events):
+    kinds = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    roles = sorted({e.get("role", "?") for e in events})
+    return {"events": len(events),
+            "role": roles[0] if len(roles) == 1 else roles,
+            "kinds": kinds}
+
+
+def dump(metrics_src=None, journal_paths=(), tail=50):
+    from paddle_tpu.observability import read_journal
+    out = {"metrics": None, "journals": {}, "tail": []}
+    if metrics_src:
+        out["metrics"] = load_metrics(metrics_src)
+    merged = []
+    for path in journal_paths:
+        events = read_journal(path)
+        out["journals"][path] = summarize_journal(events)
+        merged.extend(events)
+    # wall clock first (cross-process), per-process seq as tiebreak
+    merged.sort(key=lambda e: (e.get("t_wall", 0.0),
+                               e.get("role", ""), e.get("seq", 0)))
+    out["tail"] = merged[-int(tail):] if tail else merged
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--metrics", default=None,
+                    help="/metrics URL or exposition-text file")
+    ap.add_argument("--journal", action="append", default=[],
+                    help="JSONL event journal (repeatable)")
+    ap.add_argument("--tail", type=int, default=50)
+    args = ap.parse_args(argv)
+    print(json.dumps(dump(args.metrics, args.journal, args.tail),
+                     indent=2, default=repr))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.initializer",
     "paddle_tpu.param_attr",
     "paddle_tpu.profiler",
+    "paddle_tpu.observability",
     "paddle_tpu.unique_name",
     "paddle_tpu.reader",
     "paddle_tpu.dygraph",

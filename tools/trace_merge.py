@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Merge per-process chrome traces into ONE fleet timeline.
+
+Each process's ``profiler.export_chrome_tracing`` output is
+self-relative (perf_counter epoch). This tool rebases them onto a
+common wall-clock axis using:
+
+  1. the ``clock_sync`` metadata each trace carries
+     (``{wall_time_s, trace_ts_us, role}`` — the wall↔trace-ts
+     correspondence captured at export), and
+  2. optional per-process event journals: paired ``heartbeat_rtt``
+     (trainer: t0/t1 around the beat) and ``heartbeat_recv`` (pserver:
+     its local receive time) events estimate each server clock's
+     OFFSET against the trainer clocks — ``offset = t_recv -
+     (t0+t1)/2`` at the minimum-RTT beat, the classic NTP-style
+     estimate. Without journals, wall clocks are trusted as-is
+     (same-host processes).
+
+Cross-process span correlation: ``rpc_client:*`` spans carry
+``args.trace``/``args.span`` and the server's ``rpc_server:*`` spans
+carry the same ``args.trace`` (+ ``parent_span``), so the merged
+timeline draws chrome FLOW arrows from each client span to the
+handler spans it caused.
+
+    python tools/trace_merge.py --out merged.json \
+        trace.trainer-0.json trace.pserver-0.json \
+        --journal events.trainer-0.jsonl \
+        --journal events.pserver-0.jsonl
+
+Prints a JSON report {processes, events, links, offsets_s, out}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _clock_sync(trace):
+    for e in trace.get("traceEvents", []):
+        if e.get("name") == "clock_sync" and e.get("ph") == "M":
+            return e.get("args", {})
+    return {}
+
+
+def estimate_offsets(journals):
+    """role -> clock offset seconds vs the trainer clocks (positive:
+    that role's clock runs ahead). Pairs heartbeat_rtt/heartbeat_recv
+    by (tid, beat) and takes the min-RTT beat per server role.
+    Deliberately NOT keyed on endpoint: the trainer journals the
+    address it DIALED (a proxy, an alias, localhost-vs-127.0.0.1)
+    while the server journals its BIND address, so endpoint strings
+    need not match across journals — instead HeartbeatThread assigns
+    each endpoint's beats from a disjoint range, making (tid, beat)
+    unique fleet-wide."""
+    rtts = {}   # (tid, beat) -> (t0, t1)
+    recvs = {}  # (tid, beat) -> (t_recv, server_role)
+    for events in journals:
+        for e in events:
+            if e.get("kind") == "heartbeat_rtt":
+                key = (e.get("tid"), e.get("beat"))
+                rtts[key] = (e.get("t0_wall"), e.get("t1_wall"))
+            elif e.get("kind") == "heartbeat_recv":
+                key = (e.get("tid"), e.get("beat"))
+                recvs[key] = (e.get("t_wall"), e.get("role"))
+    best = {}  # server role -> (rtt, offset)
+    for key, (t0, t1) in rtts.items():
+        hit = recvs.get(key)
+        if hit is None or t0 is None or t1 is None:
+            continue
+        t_recv, role = hit
+        rtt = t1 - t0
+        offset = t_recv - (t0 + t1) / 2.0
+        if role not in best or rtt < best[role][0]:
+            best[role] = (rtt, offset)
+    return {role: off for role, (_rtt, off) in best.items()}
+
+
+def merge(trace_paths, journal_paths=(), out_path=None):
+    from paddle_tpu.observability import read_journal
+    traces = []
+    for p in trace_paths:
+        with open(p) as f:
+            traces.append((p, json.load(f)))
+    journals = [read_journal(p) for p in journal_paths]
+    offsets = estimate_offsets(journals)
+
+    # wall time of each trace's ts==0, corrected onto the reference
+    # (trainer) clock by subtracting the role's estimated offset
+    anchors = []
+    for p, tr in traces:
+        cs = _clock_sync(tr)
+        role = cs.get("role") or os.path.basename(p)
+        wall0 = (cs.get("wall_time_s", 0.0)
+                 - cs.get("trace_ts_us", 0.0) / 1e6
+                 - offsets.get(role, 0.0))
+        anchors.append((p, tr, role, wall0))
+    t_ref = min(w for _, _, _, w in anchors) if anchors else 0.0
+
+    merged = []
+    client_spans = {}  # trace id -> [event]
+    server_spans = {}
+    links = 0
+    for i, (p, tr, role, wall0) in enumerate(anchors):
+        shift_us = (wall0 - t_ref) * 1e6
+        pid_map = {}
+        for e in tr.get("traceEvents", []):
+            e = dict(e)
+            old_pid = e.get("pid", 0)
+            pid = pid_map.setdefault(
+                old_pid, 10 * i + (old_pid if isinstance(old_pid, int)
+                                   else 0))
+            e["pid"] = pid
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    e["args"] = {"name": "%s: %s" % (
+                        role, e.get("args", {}).get("name", ""))}
+                merged.append(e)
+                continue
+            if "ts" in e:
+                e["ts"] = e["ts"] + shift_us
+            merged.append(e)
+            tid_arg = (e.get("args") or {}).get("trace")
+            if tid_arg:
+                name = e.get("name", "")
+                if name.startswith("rpc_client:"):
+                    client_spans.setdefault(tid_arg, []).append(e)
+                elif name.startswith("rpc_server:"):
+                    server_spans.setdefault(tid_arg, []).append(e)
+
+    # flow arrows: client span -> handler span(s) on the same trace id
+    # (parent_span narrows to the exact causal client span when the
+    # trace spans several RPCs)
+    flow_id = 0
+    flows = []
+    for trace_id, servers in server_spans.items():
+        clients = client_spans.get(trace_id, [])
+        if not clients:
+            continue
+        by_span = {c["args"].get("span"): c for c in clients}
+        for s in servers:
+            c = by_span.get((s.get("args") or {}).get("parent_span"))
+            if c is None:
+                c = min(clients, key=lambda e: e.get("ts", 0.0))
+            flow_id += 1
+            links += 1
+            base = {"cat": "rpc_flow", "name": "rpc", "id": flow_id}
+            flows.append(dict(base, ph="s", ts=c["ts"], pid=c["pid"],
+                              tid=c.get("tid", 0)))
+            flows.append(dict(base, ph="f", bp="e", ts=s["ts"],
+                              pid=s["pid"], tid=s.get("tid", 0)))
+    merged.extend(flows)
+
+    out = {"traceEvents": merged,
+           "metadata": {"clock_offsets_s": offsets,
+                        "processes": [r for _, _, r, _ in anchors]}}
+    if out_path:
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(out, f)
+    report = {"processes": len(anchors),
+              "events": len(merged),
+              "links": links,
+              "offsets_s": {k: round(v, 6)
+                            for k, v in offsets.items()},
+              "out": out_path}
+    return out, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+",
+                    help="per-process chrome trace JSON files")
+    ap.add_argument("--journal", action="append", default=[],
+                    help="per-process event journal (repeatable; "
+                    "enables heartbeat-RTT clock-offset estimation)")
+    ap.add_argument("--out", default="merged_trace.json")
+    args = ap.parse_args(argv)
+    _, report = merge(args.traces, args.journal, args.out)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
