@@ -976,6 +976,115 @@ def bench_serving_latency(offered_qps=None, duration_s=None,
             "completed": stats["completed"]}
 
 
+def bench_serving_fleet_scaling(duration_s=None, concurrency=None,
+                                device_ms=None):
+    """Serving-fleet row: aggregate closed-loop QPS at 1/2/4 replica
+    SUBPROCESSES behind the ServingRouter (tools/load_gen.spawn_fleet —
+    real processes, the scale-out the fleet exists for), plus p99 and
+    failure count through a mid-run replica SIGKILL at n=2.
+
+    The scaling claim is about replicas' DEVICE time running in
+    parallel; on a shared-core CPU host the replicas' real compute
+    serializes on the cores, so (exactly like ps_degraded, whose
+    absolute numbers are transport-bound and whose job is the RATIOS)
+    this row pins per-dispatch device time to a constant with the
+    replica CLI's ``--dispatch-floor-ms`` emulation
+    (``BENCH_FLEET_DEVICE_MS``, default 120; 0 = raw CPU compute,
+    which on an ``host_cpus``-core box can only ever scale to
+    ~host_cpus). What the row then measures is the serving PLANE —
+    router dispatch, RPC transport, batcher pipeline — not the host's
+    core count. Budget-aware: replica counts already measured are
+    kept when the soft budget cuts the row short."""
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_gen
+    from paddle_tpu.serving import RouterConfig
+
+    duration_s = duration_s or _env_float("BENCH_FLEET_DURATION_S",
+                                          5.0)
+    concurrency = concurrency or int(
+        _env_float("BENCH_FLEET_CONCURRENCY", 128))
+    device_ms = device_ms if device_ms is not None else _env_float(
+        "BENCH_FLEET_DEVICE_MS", 120.0)
+    model_dir = load_gen.build_synthetic_model(
+        tempfile.mkdtemp(prefix="bench_fleet_"), hidden=8)
+    rng = np.random.RandomState(0)
+    # pre-generated 1-row feeds, cycled: client-side CPU must not be
+    # what the row measures
+    feeds = [({"x": rng.rand(1, 64).astype(np.float32)}, 1)
+             for _ in range(16)]
+    replica_args = ["--dispatch-floor-ms", str(device_ms)] \
+        if device_ms > 0 else []
+
+    def fleet(n):
+        return load_gen.spawn_fleet(
+            model_dir, n, max_batch=8, wait_us=1000,
+            router_config=RouterConfig(
+                max_concurrency=concurrency + 32, max_pending=8192,
+                connect_timeout_s=10.0),
+            replica_args=replica_args)
+
+    def closed_loop(router):
+        import itertools
+        cyc = itertools.cycle(feeds)
+        t0 = time.time()
+        r = load_gen.run_closed_loop(router, lambda: next(cyc),
+                                     concurrency, duration_s, None)
+        # honest wall: includes the drain of the last in-flight wave
+        return r, time.time() - t0
+
+    qps = {}
+    skipped = []
+    for n in (1, 2, 4):
+        if _over_budget():
+            skipped.append("replicas=%d" % n)
+            _log("time budget exceeded — skipping fleet n=%d" % n)
+            continue
+        _log("fleet scaling: %d replica(s), closed loop c=%d for %.0fs"
+             % (n, concurrency, duration_s))
+        router, stop = fleet(n)
+        try:
+            r, wall = closed_loop(router)
+            qps[n] = round(len(r["client_lat_ms"]) / wall, 2)
+        finally:
+            stop()
+    scaling = round(qps[4] / qps[1], 2) if 1 in qps and 4 in qps \
+        and qps[1] else None
+
+    p99_kill = kill_failed = None
+    if not _over_budget():
+        _log("fleet p99-under-kill: 2 replicas, SIGKILL one mid-run")
+        router, stop = fleet(2)
+        try:
+            timer = threading.Timer(duration_s * 0.4,
+                                    stop.procs[0].kill)
+            timer.start()
+            r, _wall = closed_loop(router)
+            timer.cancel()
+            lat = np.asarray(r["client_lat_ms"])
+            p99_kill = round(float(np.percentile(lat, 99)), 2) \
+                if lat.size else None
+            kill_failed = int(r["client_failed"])
+        finally:
+            stop()
+    else:
+        skipped.append("p99_under_kill")
+
+    return {"metric": "serving_fleet_scaling",
+            "value": scaling, "unit": "x aggregate qps 1->4",
+            "qps_by_replicas": {str(k): v for k, v in qps.items()},
+            "concurrency": concurrency,
+            "duration_s_per_point": duration_s,
+            "emulated_device_ms": device_ms,
+            "host_cpus": os.cpu_count(),
+            "p99_under_kill_ms": p99_kill,
+            "kill_failed_requests": kill_failed,
+            "skipped": skipped}
+
+
 # ---------------------------------------------------------------------------
 # resilience: anomaly-guard overhead
 # ---------------------------------------------------------------------------
@@ -1353,7 +1462,7 @@ def child_main():
         extra = [bench_mnist_mlp, bench_pipelined_train,
                  bench_telemetry_overhead,
                  bench_guarded_overhead, bench_ps_degraded,
-                 bench_serving_latency,
+                 bench_serving_latency, bench_serving_fleet_scaling,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,
                  bench_resnet50, bench_resnet50_hostfed]

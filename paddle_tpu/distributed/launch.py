@@ -65,6 +65,15 @@ def _parse_args(argv=None):
         "--servers_started_port", type=int, default=7170,
         help="first pserver port on each node (PS mode)")
     parser.add_argument(
+        "--serving_replicas", type=int, default=0,
+        help="serving-replica processes to spawn on this node "
+        "(serving fleet mode); each runs the same script with "
+        "PADDLE_TRAINING_ROLE=SERVING and its replica id/endpoint "
+        "in PADDLE_SERVING_* (serving/replica.py consumes them)")
+    parser.add_argument(
+        "--serving_started_port", type=int, default=8170,
+        help="first serving-replica port on each node")
+    parser.add_argument(
         "--journal_dir", default=None,
         help="directory for per-worker structured event journals "
         "(events.<role>.jsonl, observability.journal); defaults to "
@@ -141,6 +150,36 @@ def get_server_env(args):
     return envs
 
 
+def get_serving_env(args):
+    """Per-serving-replica env dicts for fleet serving mode
+    (``--serving_replicas``): PADDLE_SERVING_REPLICA_ID + the fleet's
+    endpoint universe (the router's ``ServingRouter(endpoints)``
+    input), with the same role/journal stamping trainers and pservers
+    get so replica journals merge into the fleet timeline."""
+    ips = [ip.strip() for ip in args.cluster_node_ips.split(",")
+           if ip.strip()]
+    if args.node_ip not in ips:
+        raise ValueError(
+            "--node_ip %s is not in --cluster_node_ips %s"
+            % (args.node_ip, args.cluster_node_ips))
+    nrep = int(getattr(args, "serving_replicas", 0) or 0)
+    endpoints = ["%s:%d" % (ip, args.serving_started_port + k)
+                 for ip in ips for k in range(nrep)]
+    node_index = ips.index(args.node_ip)
+    envs = []
+    for local in range(nrep):
+        rid = node_index * nrep + local
+        env = {
+            "PADDLE_SERVING_REPLICA_ID": str(rid),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rid],
+            "PADDLE_SERVING_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_TRAINING_ROLE": "SERVING",
+        }
+        _stamp_role(env, args, "serving-%d" % rid)
+        envs.append(env)
+    return envs
+
+
 def _journal_dir(args):
     return getattr(args, "journal_dir", None) or \
         getattr(args, "log_dir", None)
@@ -172,12 +211,15 @@ def _prefix_pump(pipe, role, sink):
 
 
 def launch(args, poll_interval_s=0.2, term_grace_s=10.0):
-    # pservers first (trainers connect to them), then trainers. Log
-    # files keep the historical worker.<trainer_id>.log names;
-    # pservers get worker.<role>.log.
+    # pservers and serving replicas first (their peers connect to
+    # them), then trainers. Log files keep the historical
+    # worker.<trainer_id>.log names; other roles get worker.<role>.log.
     specs = [(env["PADDLE_TPU_ROLE"], "worker.%s.log"
               % env["PADDLE_TPU_ROLE"], env)
              for env in get_server_env(args)]
+    specs += [(env["PADDLE_TPU_ROLE"], "worker.%s.log"
+               % env["PADDLE_TPU_ROLE"], env)
+              for env in get_serving_env(args)]
     specs += [(env["PADDLE_TPU_ROLE"], "worker.%s.log"
                % env["PADDLE_TRAINER_ID"], env)
               for env in get_cluster_env(args)]

@@ -35,6 +35,14 @@ VERBS = {
     "COMPLETE": 5,    # trainer is done (graceful shutdown)
     "PUSH_SPARSE": 6,  # sparse grad push: payload = ids + values
     "HEARTBEAT": 7,   # trainer liveness lease renewal
+    # serving-fleet verbs (serving/replica.py): INFER carries one
+    # inference request (name field = model@@tid@@seq@@trace, payload =
+    # JSON meta + tensors) and its response piggybacks the replica's
+    # live load (queue depth + EWMA latency) so the router's dispatch
+    # stays fresh without extra RPCs; CTRL is the replica admin channel
+    # (stats / load_version / flip / drain_unload — versioned hot-swap)
+    "INFER": 8,
+    "CTRL": 9,
 }
 
 # response status byte (the wire field is u8 — keep codes < 256)

@@ -20,8 +20,10 @@
 
 #include <arpa/inet.h>
 #include <cerrno>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -213,8 +215,11 @@ int64_t trpc_server_create(int port) {
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(static_cast<uint16_t>(port));
+  // backlog sized for a serving router's reconnect stampede (every
+  // dispatch worker re-dialing the surviving replicas at once), not
+  // just a handful of trainers
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
-      ::listen(fd, 128) < 0) {
+      ::listen(fd, 512) < 0) {
     ::close(fd);
     return -1;
   }
@@ -334,13 +339,35 @@ int64_t trpc_connect(const char* host, int port, int timeout_ms) {
     ::close(fd);
     return -1;
   }
-  // bounded connect: poll-based timeout would be nicer; blocking
-  // connect with retries is handled by the Python layer
+  // bounded connect: non-blocking + poll so a SYN lost to a full
+  // listen backlog (or a blackholed peer) costs timeout_ms, not the
+  // kernel's minutes-long retransmission schedule — a blocking
+  // ::connect here is unboundable from the Python layer and parked
+  // serving-router dispatch threads for ~60s during replica-kill
+  // reconnect stampedes
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
   if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
                 sizeof(addr)) < 0) {
-    ::close(fd);
-    return -1;
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return -1;
+    }
+    pollfd p;
+    p.fd = fd;
+    p.events = POLLOUT;
+    int pr = ::poll(&p, 1, timeout_ms > 0 ? timeout_ms : -1);
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    if (pr <= 0 ||
+        ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) < 0 ||
+        err != 0) {
+      ::close(fd);
+      return -1;
+    }
   }
+  ::fcntl(fd, F_SETFL, flags);  // call path stays blocking (+ the
+                                // SO_RCVTIMEO/SNDTIMEO deadline)
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   auto cl = std::make_unique<Client>();

@@ -7,6 +7,12 @@ one process per model; the TPU-native redesign amortizes one COMPILED
 EXECUTABLE PER SHAPE BUCKET across every concurrent client — see
 ``engine.py`` (batching/admission/lifecycle), ``buckets.py`` (pow-2
 bucket math), ``metrics.py`` (SLO accumulators), ``docs/serving.md``.
+
+Fleet layer: ``replica.py`` puts one engine behind the RPC transport
+(INFER/HEARTBEAT/CTRL verbs, piggybacked load, versioned models) and
+``router.py`` fronts N replicas with queue-depth-aware dispatch,
+structured shedding, lease-based eviction with transparent retry, and
+``signature_compat``-gated hot-swap — docs/serving.md §"Fleet serving".
 """
 
 from .buckets import bucket_for, bucket_sizes, pad_batch  # noqa: F401
@@ -14,8 +20,15 @@ from .engine import (BatcherDied, DeadlineExceeded,  # noqa: F401
                      EngineStopped, InvalidRequest, ServerOverloaded,
                      ServingConfig, ServingEngine, ServingError)
 from .metrics import EngineStats  # noqa: F401
+from .replica import ServingReplica  # noqa: F401
+from .router import (ReplicaUnavailable, RouterConfig,  # noqa: F401
+                     ServingRouter)
+from .signature import SignatureMismatch, signature_compat  # noqa: F401
 
 __all__ = ["ServingEngine", "ServingConfig", "ServingError",
            "ServerOverloaded", "DeadlineExceeded", "EngineStopped",
            "BatcherDied", "InvalidRequest", "EngineStats",
-           "bucket_sizes", "bucket_for", "pad_batch"]
+           "bucket_sizes", "bucket_for", "pad_batch",
+           "ServingReplica", "ServingRouter", "RouterConfig",
+           "ReplicaUnavailable", "signature_compat",
+           "SignatureMismatch"]

@@ -165,6 +165,10 @@ class _ModelWorker:
             self._input_spec[inp["name"]] = (dt, tail)
         self.stats = EngineStats(window=config.latency_window,
                                  model=name)
+        # live queue-depth gauge: the router's dispatch signal and a
+        # per-model Prometheus series (serving_queue_depth{model=...})
+        self._depth_gauge = _obs.registry().gauge(
+            "serving_queue_depth", model=name)
         self._queue = []  # FIFO of _Request
         self._cond = threading.Condition()
         self._stopped = False
@@ -245,8 +249,15 @@ class _ModelWorker:
                     model=self.name, queue_depth=len(self._queue),
                     max_queue_size=self.config.max_queue_size)
             self._queue.append(req)
+            self._depth_gauge.set(len(self._queue))
             self._cond.notify()
         return req.future
+
+    def queue_depth(self) -> int:
+        """Live admission-queue depth (requests waiting, excluding the
+        batch currently on the device)."""
+        with self._cond:
+            return len(self._queue)
 
     def _validate(self, feed):
         want = set(self.predictor.feed_names)
@@ -374,6 +385,7 @@ class _ModelWorker:
                 if now >= close_at or self._stopped:
                     break
                 self._cond.wait(min(close_at - now, 0.01))
+            self._depth_gauge.set(len(self._queue))
             return batch
 
     def _dispatch(self, batch: List[_Request]):
@@ -444,6 +456,7 @@ class _ModelWorker:
             self._stopped = True
             pending = self._inflight + self._queue
             self._inflight, self._queue = [], []
+            self._depth_gauge.set(0)
             self._cond.notify_all()
         self.stats.count("failed", len(pending))
         for r in pending:
@@ -456,6 +469,7 @@ class _ModelWorker:
             pending = [] if drain else list(self._queue)
             if not drain:
                 self._queue = []
+                self._depth_gauge.set(0)
             self._cond.notify_all()
         for r in pending:
             self._safe_resolve(r.future, exc=EngineStopped(
@@ -516,6 +530,23 @@ class ServingEngine:
             self._default = name
         return self
 
+    def remove_model(self, name: str, drain: bool = True,
+                     timeout: Optional[float] = None):
+        """Unload one model: stop its worker (``drain=True`` serves
+        everything already queued first) and drop it from the engine.
+        The versioned hot-swap path uses this to retire a drained old
+        version while its successor keeps serving."""
+        if name not in self._workers:
+            raise InvalidRequest("no model %r loaded (have %s)"
+                                 % (name, sorted(self._workers)),
+                                 model=name)
+        worker = self._workers.pop(name)
+        worker.shutdown(drain=drain, timeout=timeout)
+        if self._default == name:
+            self._default = min(self._workers) if self._workers \
+                else None
+        return self
+
     def _worker(self, model: Optional[str]) -> _ModelWorker:
         name = model or self._default
         if name is None or name not in self._workers:
@@ -552,6 +583,15 @@ class ServingEngine:
 
     def models(self):
         return sorted(self._workers)
+
+    def queue_depth(self, model: Optional[str] = None) -> int:
+        """Live queued-request count: one model's depth, or (model
+        None with several loaded) the whole engine's — the load signal
+        replicas piggyback to the serving router, also exported as the
+        ``serving_queue_depth{model=...}`` gauge."""
+        if model is not None or len(self._workers) == 1:
+            return self._worker(model).queue_depth()
+        return sum(w.queue_depth() for w in self._workers.values())
 
     # -- lifecycle -----------------------------------------------------
     def shutdown(self, drain=True, timeout: Optional[float] = None):

@@ -32,10 +32,17 @@ class EngineStats:
     show up in ``/metrics`` and ``tools/obs_dump.py`` next to the rest
     of the runtime. The snapshot()/stats() surface is unchanged."""
 
+    # EWMA smoothing for the per-model latency signal replicas
+    # piggyback to the router (a full percentile window is too heavy
+    # to ship per response; one smoothed scalar is enough to rank
+    # replicas)
+    EWMA_ALPHA = 0.2
+
     def __init__(self, window: int = 4096, model: str = "default"):
         self._lock = threading.Lock()
         # (t_done, latency_seconds) ring; t_done drives windowed QPS
         self._lat = collections.deque(maxlen=int(window))
+        self._ewma_s = None
         self._bucket_hist = collections.Counter()
         self._occ_rows = 0        # live rows dispatched
         self._occ_capacity = 0    # sum of bucket sizes dispatched
@@ -63,8 +70,19 @@ class EngineStats:
             self.completed += 1
             self._lat.append((t_done if t_done is not None
                               else time.monotonic(), latency_s))
+            a = self.EWMA_ALPHA
+            self._ewma_s = latency_s if self._ewma_s is None \
+                else a * latency_s + (1.0 - a) * self._ewma_s
         self._m["completed"].inc()
         self._h_latency.observe(latency_s)
+
+    @property
+    def ewma_ms(self):
+        """Smoothed request latency in ms (None before any request) —
+        the scalar replicas piggyback on INFER responses/heartbeats."""
+        with self._lock:
+            return None if self._ewma_s is None \
+                else round(self._ewma_s * 1e3, 3)
 
     def record_batch(self, rows: int, bucket: int):
         with self._lock:
@@ -107,6 +125,7 @@ class EngineStats:
         return {
             "completed": completed, "rejected": rejected,
             "expired": expired, "failed": failed, "batches": batches,
+            "ewma_ms": self.ewma_ms,
             "p50_ms": round(p50, 3) if p50 is not None else None,
             "p95_ms": round(p95, 3) if p95 is not None else None,
             "p99_ms": round(p99, 3) if p99 is not None else None,

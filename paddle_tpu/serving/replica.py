@@ -1,0 +1,413 @@
+"""One serving-fleet replica: a ``ServingEngine`` behind the PR 5 RPC
+transport.
+
+The in-process engine (engine.py) is the unit of compute; this module
+makes it a FLEET citizen: an ``RPCServer`` (distributed/rpc.py — the
+same native transport, deadlines, and wire framing the PS runtime
+proved fault-tolerant) serving three verbs:
+
+  - **INFER** — one inference request. The wire name is
+    ``model@@tid@@seq@@trace`` (``pack_wire_name``), so the router's
+    trainer-id/sequence/trace metadata rides exactly like a trainer's
+    SEND and the replica's ``rpc_server:INFER`` span links into ONE
+    merged fleet trace (tools/trace_merge.py). The payload is a JSON
+    header + ``io.serialize_tensor`` frames (``pack_blob``). Handled
+    DEFERRED: the engine's future resolves on a batcher thread and the
+    responder is called from there, so a slow batch never blocks the
+    drain thread. Every response — success or structured error —
+    piggybacks the replica's live load (batcher queue depth + EWMA
+    latency) so the router's least-loaded dispatch stays fresh without
+    dedicated polling RPCs.
+  - **HEARTBEAT** — the router's liveness probe; answers with the same
+    load snapshot and journals ``heartbeat_recv`` (the clock-offset
+    raw material trace_merge pairs with the router's
+    ``heartbeat_rtt``).
+  - **CTRL** — the admin channel for versioned hot-swap: ``stats`` /
+    ``signature`` / ``load_version`` (load + warm v2 NEXT TO the live
+    version) / ``flip`` (atomically switch new admissions) /
+    ``drain_unload`` (retire the drained old version). Slow ops run on
+    a background thread and answer through the deferred responder so
+    warmup compiles never stall heartbeats.
+
+Versioning: each loaded version is its own engine worker named
+``<model>@<version>``; ``_active`` maps model -> admitted version and
+is flipped under a lock, so the swap is atomic at admission
+granularity — in-flight v1 requests finish on v1, new ones land on v2.
+
+Run standalone (the launcher's ``--serving_replicas`` children and
+``tools/load_gen.py --replicas`` use this):
+
+    python -m paddle_tpu.serving.replica --model-dir DIR --port 0
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as _obs
+from ..distributed.rpc import RPCServer, unpack_wire_meta
+from ..io import deserialize_tensor, serialize_tensor
+from .engine import (InvalidRequest, ServingConfig, ServingEngine,
+                     ServingError)
+
+__all__ = ["ServingReplica", "pack_blob", "unpack_blob", "serve_main"]
+
+
+# ---------------------------------------------------------------------------
+# wire payloads: JSON header + tensor frames
+# ---------------------------------------------------------------------------
+
+def pack_blob(meta: dict, arrays=()) -> bytes:
+    """``u32 header_len | json header | serialize_tensor frames``.
+    The header's ``n_arrays`` is stamped here so unpack never guesses."""
+    arrays = [np.asarray(a) for a in arrays]
+    meta = dict(meta, n_arrays=len(arrays))
+    head = json.dumps(meta, sort_keys=True, default=repr).encode()
+    parts = [struct.pack("<I", len(head)), head]
+    parts.extend(serialize_tensor(a) for a in arrays)
+    return b"".join(parts)
+
+
+def unpack_blob(payload: bytes):
+    """Inverse of ``pack_blob`` -> (meta, [ndarray, ...])."""
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    meta = json.loads(payload[4:4 + hlen].decode())
+    arrays = []
+    off = 4 + hlen
+    for _ in range(int(meta.get("n_arrays", 0))):
+        arr, off = deserialize_tensor(payload, off)
+        arrays.append(arr)
+    return meta, arrays
+
+
+# ---------------------------------------------------------------------------
+# replica
+# ---------------------------------------------------------------------------
+
+class ServingReplica:
+    """Hosts one ``ServingEngine`` behind an RPC endpoint, with
+    versioned models and piggybacked load reporting."""
+
+    def __init__(self, model=None, config: Optional[ServingConfig] = None,
+                 name: str = "default", version: str = "v1",
+                 endpoint: str = "127.0.0.1:0", replica_id: int = 0,
+                 metrics_port=None):
+        self.replica_id = int(replica_id)
+        self.engine = ServingEngine(config=config,
+                                    metrics_port=metrics_port)
+        self._config = config
+        self._mu = threading.Lock()
+        self._active: Dict[str, str] = {}      # model -> admitted ver
+        self._versions: Dict[str, List[str]] = {}
+        self._default_model: Optional[str] = None
+        self._crashed = False
+        if model is not None:
+            self._register(name, version, model, config)
+        self.server = RPCServer(endpoint)
+        self.endpoint = self.server.endpoint
+        self.server.register_deferred("INFER", self._on_infer)
+        self.server.register_deferred("CTRL", self._on_ctrl)
+        self.server.register("HEARTBEAT", self._on_heartbeat)
+
+    # -- versioned model registry --------------------------------------
+    @staticmethod
+    def _worker_name(model: str, version: str) -> str:
+        return "%s@%s" % (model, version)
+
+    def _register(self, model, version, source, config):
+        self.engine.add_model(self._worker_name(model, version),
+                              source, config)
+        with self._mu:
+            vs = self._versions.setdefault(model, [])
+            if version not in vs:
+                vs.append(version)
+            self._active.setdefault(model, version)
+            if self._default_model is None:
+                self._default_model = model
+
+    def _resolve(self, model: Optional[str]):
+        """-> (model, active_version, worker_name) for admission."""
+        with self._mu:
+            m = model or self._default_model
+            v = self._active.get(m)
+        if v is None:
+            raise InvalidRequest(
+                "replica %d serves no model %r (have %s)"
+                % (self.replica_id, m, sorted(self._versions)),
+                model=m, replica=self.replica_id)
+        return m, v, self._worker_name(m, v)
+
+    # -- load piggyback ------------------------------------------------
+    def load_snapshot(self) -> dict:
+        """The scalars the router ranks replicas by, shipped on every
+        INFER response and heartbeat."""
+        depth = 0
+        ewma = None
+        for w in list(self.engine._workers.values()):
+            depth += w.queue_depth()
+            e = w.stats.ewma_ms
+            if e is not None:
+                ewma = e if ewma is None else max(ewma, e)
+        return {"replica_id": self.replica_id, "queue_depth": depth,
+                "ewma_ms": ewma}
+
+    def _err_meta(self, exc) -> dict:
+        err = exc.to_dict() if isinstance(exc, ServingError) else {
+            "code": "SERVING_ERROR", "message": repr(exc),
+            "details": {}}
+        return {"ok": False, "error": err, "load": self.load_snapshot()}
+
+    # -- handlers ------------------------------------------------------
+    def _respond(self, responder, status, payload):
+        """A crashed replica answers nothing (chaos contract: die like
+        a SIGKILLed process); a closed peer socket is also survivable
+        — the router's deadline/retry owns that failure."""
+        if self._crashed:
+            return
+        try:
+            responder(status, payload)
+        except Exception:
+            pass
+
+    def _on_infer(self, wire, payload, responder):
+        base, _tid, _seq, _tok = unpack_wire_meta(wire)
+        try:
+            meta, arrays = unpack_blob(payload)
+            feed = dict(zip(meta["inputs"], arrays))
+            m, v, wname = self._resolve(base or None)
+            fut = self.engine.infer(feed, model=wname,
+                                    deadline_ms=meta.get("deadline_ms"))
+        except Exception as e:
+            self._respond(responder, 0, pack_blob(self._err_meta(e)))
+            return
+
+        def done(f, _v=v):
+            try:
+                outs = f.result()
+            except Exception as e:
+                self._respond(responder, 0,
+                              pack_blob(self._err_meta(e)))
+                return
+            meta_out = {"ok": True, "version": _v,
+                        "load": self.load_snapshot()}
+            self._respond(responder, 0, pack_blob(meta_out, outs))
+
+        fut.add_done_callback(done)
+
+    def _on_heartbeat(self, wire, payload):
+        _base, tid, seq, _tok = unpack_wire_meta(wire)
+        if seq is not None:
+            _obs.emit("heartbeat_recv", tid=tid, beat=seq,
+                      endpoint=self.endpoint)
+        return pack_blob({"ok": True, "load": self.load_snapshot()})
+
+    def _on_ctrl(self, wire, payload, responder):
+        try:
+            meta, _ = unpack_blob(payload)
+        except Exception as e:
+            self._respond(responder, 0, pack_blob(self._err_meta(e)))
+            return
+        op = meta.get("op")
+        if op in ("load_version", "drain_unload"):
+            # slow ops (warmup compiles, queue drain) must not stall
+            # the drain thread: run aside, answer via the responder
+            threading.Thread(
+                target=self._ctrl_slow, args=(op, meta, responder),
+                daemon=True,
+                name="serving-ctrl-%s" % op).start()
+            return
+        try:
+            out = self._ctrl_fast(op, meta)
+        except Exception as e:
+            out = self._err_meta(e)
+        self._respond(responder, 0, pack_blob(out))
+
+    def _ctrl_fast(self, op, meta):
+        if op == "stats":
+            return {"ok": True, "stats": self.stats()}
+        if op == "signature":
+            _m, v, wname = self._resolve(meta.get("model"))
+            sig = self.engine._workers[wname].predictor.signature
+            return {"ok": True, "version": v, "signature": sig}
+        if op == "flip":
+            return self._flip(meta["model"], meta["version"])
+        raise InvalidRequest("unknown CTRL op %r" % op, op=op)
+
+    def _ctrl_slow(self, op, meta, responder):
+        try:
+            if op == "load_version":
+                out = self._load_version(meta)
+            else:
+                out = self._drain_unload(meta)
+        except Exception as e:
+            out = self._err_meta(e)
+        self._respond(responder, 0, pack_blob(out))
+
+    def _load_version(self, meta):
+        m, v = meta["model"], meta["version"]
+        wname = self._worker_name(m, v)
+        self._register(m, v, meta["model_dir"], self._config)
+        worker = self.engine._workers[wname]
+        _obs.emit("model_version_loaded", model=m, version=v,
+                  replica=self.replica_id,
+                  warmed_buckets=list(worker.warmed_buckets))
+        return {"ok": True, "model": m, "version": v,
+                "warmed_buckets": list(worker.warmed_buckets),
+                "buckets": list(worker.buckets),
+                "signature": worker.predictor.signature}
+
+    def _flip(self, m, v):
+        wname = self._worker_name(m, v)
+        with self._mu:
+            if wname not in self.engine._workers:
+                raise InvalidRequest(
+                    "cannot flip %r to unloaded version %r (loaded: "
+                    "%s) — CTRL load_version first"
+                    % (m, v, self._versions.get(m, [])), model=m,
+                    version=v)
+            previous = self._active.get(m)
+            self._active[m] = v
+        _obs.emit("model_flip", model=m, version=v, previous=previous,
+                  replica=self.replica_id)
+        return {"ok": True, "model": m, "version": v,
+                "previous": previous}
+
+    def _drain_unload(self, meta):
+        m, v = meta["model"], meta["version"]
+        with self._mu:
+            if self._active.get(m) == v:
+                raise InvalidRequest(
+                    "version %r is still ADMITTING for model %r — "
+                    "flip to the successor before drain_unload"
+                    % (v, m), model=m, version=v)
+        self.engine.remove_model(self._worker_name(m, v), drain=True,
+                                 timeout=meta.get("timeout_s", 60))
+        with self._mu:
+            vs = self._versions.get(m, [])
+            if v in vs:
+                vs.remove(v)
+        _obs.emit("model_version_unloaded", model=m, version=v,
+                  replica=self.replica_id)
+        return {"ok": True, "model": m, "version": v}
+
+    # -- introspection / lifecycle ------------------------------------
+    def stats(self) -> dict:
+        with self._mu:
+            models = {m: {"active": self._active.get(m),
+                          "versions": list(vs)}
+                      for m, vs in self._versions.items()}
+        return {"replica_id": self.replica_id,
+                "endpoint": self.endpoint,
+                "models": models,
+                "load": self.load_snapshot(),
+                "engine": self.engine.stats()
+                if self.engine._workers else {}}
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def crash(self):
+        """Chaos seam: die like a SIGKILLed replica process — sockets
+        closed NOW, in-flight INFERs never answered. The router's
+        deadlines + lease monitor must absorb it."""
+        self._crashed = True
+        self.server._crash()
+
+    def shutdown(self, drain=True):
+        self.server.shutdown()
+        self.engine.shutdown(drain=drain, timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# standalone entry point (launcher children / load_gen --replicas)
+# ---------------------------------------------------------------------------
+
+def serve_main(argv=None):
+    """Run one replica process: load the model, announce the bound
+    endpoint as ``REPLICA_READY <endpoint>`` on stdout, serve until
+    stdin closes (the parent's handle on our lifetime) or SIGTERM."""
+    import argparse
+    import os
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser(description=serve_main.__doc__)
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--name", default="default")
+    ap.add_argument("--version", default="v1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replica-id", type=int, default=0)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--wait-us", type=int, default=2000)
+    ap.add_argument("--queue-size", type=int, default=256)
+    ap.add_argument("--metrics-port", type=int, default=None)
+    ap.add_argument("--dispatch-floor-ms", type=float, default=0.0,
+                    help="CPU-probe device-time emulation: minimum "
+                    "wall time per device dispatch (installed via the "
+                    "engine's dispatch hook). A fleet's scaling story "
+                    "is about replicas' DEVICE time running in "
+                    "parallel; on a shared-core CPU host the real "
+                    "compute of N replicas serializes on the cores, "
+                    "so the scaling bench pins dispatch time to a "
+                    "constant instead — 0 (default) disables.")
+    args = ap.parse_args(argv)
+
+    if not os.environ.get("PADDLE_TPU_ROLE"):
+        _obs.set_role("serving-%d" % args.replica_id)
+    cfg = ServingConfig(max_batch_size=args.max_batch,
+                        max_queue_wait_us=args.wait_us,
+                        max_queue_size=args.queue_size)
+    replica = ServingReplica(
+        args.model_dir, cfg, name=args.name, version=args.version,
+        endpoint="127.0.0.1:%d" % args.port,
+        replica_id=args.replica_id,
+        metrics_port=args.metrics_port)
+    if args.dispatch_floor_ms > 0:
+        import time as _time
+        floor_s = args.dispatch_floor_ms / 1e3
+
+        def _floor(worker, batch, _s=floor_s):
+            _time.sleep(_s)
+
+        for w in replica.engine._workers.values():
+            w._dispatch_hook = _floor
+    replica.start()
+    print("REPLICA_READY %s" % replica.endpoint, flush=True)
+    _obs.emit("replica_started", endpoint=replica.endpoint,
+              replica=args.replica_id)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    # parent closes our stdin to stop us (portable even when signals
+    # are swallowed by a shell wrapper)
+    def stdin_watch():
+        try:
+            while sys.stdin.read(1):
+                pass
+        except Exception:
+            pass
+        stop.set()
+
+    threading.Thread(target=stdin_watch, daemon=True).start()
+    while not stop.wait(0.1):
+        pass
+    replica.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(serve_main())

@@ -1,0 +1,617 @@
+"""Serving-fleet router: one front door over N ``ServingReplica``s.
+
+One process never carries production traffic (the reference's fleet
+heritage); the router is how N single-engine replicas become one
+service:
+
+  - **queue-depth-aware least-loaded dispatch** — every INFER response
+    and heartbeat piggybacks the replica's live batcher queue depth +
+    EWMA latency; dispatch scores each healthy replica as
+    ``reported_queue_depth + local_inflight`` (the local in-flight
+    count keeps the score honest between piggybacks) and picks the
+    minimum, tie-breaking on EWMA latency. ``policy="round_robin"``
+    keeps the naive baseline selectable — the bench's p99-under-skew
+    comparison is a one-flag A/B.
+  - **structured shedding** — when every healthy replica is saturated
+    (reported depth at/over ``shed_queue_depth``) or the router's own
+    pending cap is hit, ``infer`` raises ``ServerOverloaded``
+    SYNCHRONOUSLY, exactly like the in-process engine: backpressure
+    the client can act on, not a deep queue that melts p99 for
+    everyone.
+  - **replica health = PR 5 lease posture, inverted** — a per-replica
+    heartbeat thread probes each replica on a dedicated connection;
+    a replica silent past ``lease_timeout_s`` is EVICTED (journalled
+    ``replica_evicted``, dispatch stops choosing it) and re-admitted
+    when it answers again. In-flight requests to a dying replica fail
+    by RPC deadline — never a hang — and are transparently RETRIED on
+    a healthy replica (inference is read-only, so replay is always
+    safe; contrast the seq-dedup machinery writes need).
+  - **versioned hot-swap** — ``swap_model(model_dir)`` refuses a
+    successor whose ``__signature__.json`` would break live clients
+    (``signature_compat``), then loads + WARMS v2 next to v1 on every
+    replica, atomically flips admissions, and drains/unloads v1 —
+    zero failed requests through the flip.
+
+The client surface mirrors ``ServingEngine`` (``infer`` -> Future,
+``infer_sync``, ``stats``, ``shutdown``), so ``tools/load_gen.py``
+drives an engine and a fleet with the same loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as _obs
+from ..distributed.rpc import (DeadlineExceededError, RPCClient,
+                               RpcError)
+from ..io import SIGNATURE_FILENAME
+from .engine import (BatcherDied, DeadlineExceeded, EngineStopped,
+                     InvalidRequest, ServerOverloaded, ServingError)
+from .replica import pack_blob, unpack_blob
+from .signature import SignatureMismatch, signature_compat
+
+__all__ = ["RouterConfig", "ServingRouter", "ReplicaUnavailable"]
+
+
+class ReplicaUnavailable(ServingError):
+    """Every dispatch attempt for this request failed at the transport
+    (replicas dead/unreachable within the retry budget). Structured —
+    a future resolves with this, it never hangs."""
+    code = "REPLICA_UNAVAILABLE"
+
+
+_ERROR_TYPES = {c.code: c for c in
+                (ServerOverloaded, DeadlineExceeded, EngineStopped,
+                 BatcherDied, InvalidRequest, ReplicaUnavailable,
+                 SignatureMismatch, ServingError)}
+
+
+def _error_from_meta(meta: dict) -> ServingError:
+    err = meta.get("error") or {}
+    cls = _ERROR_TYPES.get(err.get("code"), ServingError)
+    return cls(err.get("message", "replica error"),
+               **(err.get("details") or {}))
+
+
+@dataclass
+class RouterConfig:
+    """Dispatch/admission policy for one router.
+
+    - ``policy``: ``least_loaded`` (queue-depth-aware, the default) or
+      ``round_robin`` (the baseline the bench compares against).
+    - ``shed_queue_depth``: a replica reporting this queue depth (or
+      more) counts saturated; when EVERY healthy replica is saturated
+      the router sheds with ``ServerOverloaded``.
+    - ``max_pending``: router-level admission cap on futures in
+      flight.
+    - ``max_retries``: transport-failure retries per request (each on
+      a different replica while any untried healthy one remains).
+    - ``lease_timeout_s`` / ``heartbeat_interval_s``: replica
+      liveness lease (PR 5 semantics, router-side).
+    - ``rpc_deadline_s``: per-INFER transport deadline for requests
+      that carry no deadline of their own — the bound that turns a
+      dead replica into a retryable error instead of a hang.
+    - ``max_concurrency``: dispatch worker threads (each blocked
+      request occupies one).
+    """
+
+    policy: str = "least_loaded"
+    shed_queue_depth: int = 256
+    max_pending: int = 4096
+    max_retries: int = 3
+    lease_timeout_s: float = 2.0
+    heartbeat_interval_s: float = 0.25
+    rpc_deadline_s: float = 30.0
+    connect_timeout_s: float = 5.0
+    # dispatch-path connects FAIL FAST: RPCClient's connect loop
+    # retries refused connections for its whole budget ("server may be
+    # starting" — right for a pserver restart, wrong mid-dispatch
+    # where a dead replica must cost ~one RTT before the request is
+    # retried on a live one). The health loop keeps using
+    # connect_timeout_s — it is the path that waits for restarts.
+    dispatch_connect_timeout_s: float = 1.0
+    max_concurrency: int = 32
+    router_id: int = 0
+    latency_window: int = 4096
+
+
+class _Replica:
+    """Router-side view of one replica: endpoint, lease, piggybacked
+    load, a small connection pool, and attribution stats."""
+
+    def __init__(self, rid: int, endpoint: str, cfg: RouterConfig):
+        self.id = rid
+        self.endpoint = endpoint
+        self.cfg = cfg
+        self.mu = threading.Lock()
+        self.healthy = True
+        self.last_ok = time.monotonic()
+        self.queue_depth = 0
+        self.ewma_ms: Optional[float] = None
+        self.inflight = 0
+        # attribution (load_gen per-replica report)
+        self.requests = 0
+        self.failures = 0
+        self.sheds = 0        # replica-reported overloads seen here
+        self.lat_ms = collections.deque(maxlen=cfg.latency_window)
+        self._free: List[RPCClient] = []
+        self._gauge = _obs.registry().gauge(
+            "router_replica_queue_depth", replica=str(rid))
+
+    # -- connection pool ----------------------------------------------
+    def acquire(self) -> RPCClient:
+        with self.mu:
+            if self._free:
+                return self._free.pop()
+        return RPCClient(
+            self.endpoint,
+            timeout_s=self.cfg.dispatch_connect_timeout_s,
+            deadline_s=self.cfg.rpc_deadline_s,
+            trainer_id=self.cfg.router_id)
+
+    def release(self, client: RPCClient):
+        with self.mu:
+            self._free.append(client)
+
+    def close_clients(self):
+        with self.mu:
+            free, self._free = self._free, []
+        for c in free:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    # -- load/lease ----------------------------------------------------
+    def mark_ok(self, load: Optional[dict]):
+        with self.mu:
+            self.last_ok = time.monotonic()
+            if load:
+                self.queue_depth = int(load.get("queue_depth") or 0)
+                if load.get("ewma_ms") is not None:
+                    self.ewma_ms = float(load["ewma_ms"])
+        self._gauge.set(self.queue_depth)
+
+    def score(self):
+        with self.mu:
+            return (self.queue_depth + self.inflight,
+                    self.ewma_ms if self.ewma_ms is not None else 0.0,
+                    self.id)
+
+    def saturated(self) -> bool:
+        with self.mu:
+            return (self.queue_depth + self.inflight
+                    >= self.cfg.shed_queue_depth)
+
+    def snapshot(self) -> dict:
+        with self.mu:
+            lat = list(self.lat_ms)
+            out = {"endpoint": self.endpoint, "healthy": self.healthy,
+                   "requests": self.requests,
+                   "failures": self.failures, "sheds": self.sheds,
+                   "inflight": self.inflight,
+                   "queue_depth": self.queue_depth,
+                   "ewma_ms": self.ewma_ms,
+                   "last_ok_age_s": round(
+                       time.monotonic() - self.last_ok, 3)}
+        arr = np.asarray(lat)
+        for q in (50, 99):
+            out["p%d_ms" % q] = round(
+                float(np.percentile(arr, q)), 3) if arr.size else None
+        return out
+
+
+class ServingRouter:
+    """Fronts N replicas (``endpoints``) with least-loaded dispatch,
+    shedding, lease-based eviction, transparent retry, and versioned
+    hot-swap. API mirrors ``ServingEngine``."""
+
+    def __init__(self, endpoints, config: Optional[RouterConfig] = None,
+                 metrics_port=None):
+        self.config = config or RouterConfig()
+        if self.config.policy not in ("least_loaded", "round_robin"):
+            raise InvalidRequest("unknown routing policy %r"
+                                 % self.config.policy)
+        self._replicas = [
+            _Replica(i, ep, self.config)
+            for i, ep in enumerate(endpoints)]
+        if not self._replicas:
+            raise InvalidRequest("a router needs >= 1 replica endpoint")
+        self._rr = itertools.count()
+        self._pending = 0
+        self._mu = threading.Lock()
+        self._stopped = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.config.max_concurrency,
+            thread_name_prefix="serving-router")
+        reg = _obs.registry()
+        # registry counters are process-wide (several routers share
+        # them in /metrics); the instance tallies back stats()
+        self._m_requests = {o: reg.counter("router_requests_total",
+                                           outcome=o)
+                           for o in ("completed", "shed", "failed")}
+        self._m_retries = reg.counter("router_retries_total")
+        self._h_latency = reg.histogram("router_latency_seconds")
+        self._counts = {"completed": 0, "shed": 0, "failed": 0,
+                        "retries": 0}
+        self.metrics_server = None
+        if metrics_port is not None:
+            self.metrics_server = _obs.start_metrics_server(
+                port=metrics_port)
+        # lease monitors: one thread + dedicated client per replica
+        # (PR 5's HeartbeatThread shape — a shared thread would park a
+        # healthy replica's probe behind a dead one's connect stall)
+        self._hb_stop = threading.Event()
+        self._hb_threads = []
+        for r in self._replicas:
+            t = threading.Thread(target=self._health_loop, args=(r,),
+                                 daemon=True,
+                                 name="router-health-%d" % r.id)
+            t.start()
+            self._hb_threads.append(t)
+
+    # -- dispatch ------------------------------------------------------
+    def _healthy(self) -> List[_Replica]:
+        return [r for r in self._replicas if r.healthy]
+
+    def _pick(self, tried) -> Optional[_Replica]:
+        cands = [r for r in self._healthy() if r.id not in tried]
+        if not cands:
+            # every healthy replica already tried this request: allow
+            # a second pass rather than failing early (the retry
+            # budget still bounds total attempts)
+            cands = self._healthy()
+        if not cands:
+            return None
+        if self.config.policy == "round_robin":
+            return cands[next(self._rr) % len(cands)]
+        return min(cands, key=_Replica.score)
+
+    def infer(self, feed: Dict[str, np.ndarray],
+              model: Optional[str] = None,
+              deadline_ms: Optional[float] = None):
+        """Route one request; returns a Future resolving to the
+        per-output list of arrays. ``ServerOverloaded`` (all replicas
+        saturated / router pending cap) raises synchronously; replica
+        failures surface through the Future as structured errors after
+        the retry budget."""
+        if self._stopped:
+            raise EngineStopped("router is shut down")
+        healthy = self._healthy()
+        if healthy and all(r.saturated() for r in healthy):
+            self._shed("all %d healthy replicas saturated (depth >= %d)"
+                       % (len(healthy), self.config.shed_queue_depth))
+        with self._mu:
+            capped = self._pending >= self.config.max_pending
+            if not capped:
+                self._pending += 1
+        if capped:
+            self._shed("router pending cap %d reached"
+                       % self.config.max_pending)
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        fut = self._pool.submit(self._run_request, model, feed,
+                                deadline_ms)
+        fut.add_done_callback(self._done_cb)
+        return fut
+
+    def _shed(self, why):
+        self._m_requests["shed"].inc()
+        with self._mu:
+            self._counts["shed"] += 1
+        _obs.emit("router_shed", reason=why)
+        raise ServerOverloaded("router shedding: %s" % why, reason=why)
+
+    def _retry_mark(self, replica_id, attempt, err):
+        self._m_retries.inc()
+        with self._mu:
+            self._counts["retries"] += 1
+        _obs.emit("router_retry", replica=replica_id, attempt=attempt,
+                  error=repr(err))
+
+    def _done_cb(self, fut):
+        try:
+            exc = fut.exception()
+        except Exception:
+            exc = None  # cancelled by the client
+        outcome = "failed" if exc is not None else "completed"
+        with self._mu:
+            self._pending -= 1
+            self._counts[outcome] += 1
+        self._m_requests[outcome].inc()
+
+    def infer_sync(self, feed, model=None, deadline_ms=None,
+                   timeout: Optional[float] = None):
+        return self.infer(feed, model=model,
+                          deadline_ms=deadline_ms).result(timeout)
+
+    def _run_request(self, model, feed, deadline_ms):
+        t0 = time.monotonic()
+        deadline = t0 + deadline_ms / 1e3 if deadline_ms else None
+        names = sorted(feed)
+        arrays = [feed[n] for n in names]
+        tried = set()
+        last_err = None
+        for attempt in range(self.config.max_retries + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded(
+                    "request deadline passed after %d dispatch "
+                    "attempt(s)" % attempt, attempts=attempt)
+            r = self._pick(tried)
+            if r is None:
+                raise ReplicaUnavailable(
+                    "no healthy replicas (all %d evicted)"
+                    % len(self._replicas),
+                    replicas=len(self._replicas))
+            remaining_ms = None if deadline is None else max(
+                1.0, (deadline - time.monotonic()) * 1e3)
+            payload = pack_blob({"inputs": names,
+                                 "deadline_ms": remaining_ms}, arrays)
+            rpc_deadline = self.config.rpc_deadline_s if deadline is \
+                None else max(0.05, deadline - time.monotonic() + 1.0)
+            try:
+                client = r.acquire()
+            except Exception as e:
+                # fresh connect to a dead replica: a transport-level
+                # miss like any other — try the next replica
+                last_err = e
+                tried.add(r.id)
+                with r.mu:
+                    r.failures += 1
+                self._retry_mark(r.id, attempt, e)
+                continue
+            with r.mu:
+                r.inflight += 1
+            try:
+                body = client.call("INFER", model or "", payload,
+                                   deadline_s=rpc_deadline)
+            except (RpcError, DeadlineExceededError) as e:
+                last_err = e
+                tried.add(r.id)
+                with r.mu:
+                    r.inflight -= 1
+                    r.failures += 1
+                r.release(client)
+                self._retry_mark(r.id, attempt, e)
+                continue
+            except Exception:
+                with r.mu:
+                    r.inflight -= 1
+                r.release(client)
+                raise
+            with r.mu:
+                r.inflight -= 1
+            r.release(client)
+            meta, outs = unpack_blob(body)
+            r.mark_ok(meta.get("load"))
+            if not meta.get("ok"):
+                err = _error_from_meta(meta)
+                if isinstance(err, ServerOverloaded):
+                    # THIS replica is full; another may not be — keep
+                    # the request alive while budget remains
+                    with r.mu:
+                        r.sheds += 1
+                    last_err = err
+                    tried.add(r.id)
+                    self._retry_mark(r.id, attempt, err)
+                    continue
+                raise err
+            lat = time.monotonic() - t0
+            with r.mu:
+                r.requests += 1
+                r.lat_ms.append(lat * 1e3)
+            self._h_latency.observe(lat)
+            return outs
+        if isinstance(last_err, ServingError):
+            raise last_err
+        raise ReplicaUnavailable(
+            "request failed on %d replicas within the retry budget: %r"
+            % (len(tried) or 1, last_err), last_error=repr(last_err))
+
+    # -- health / leases ----------------------------------------------
+    def _health_loop(self, r: _Replica):
+        # disjoint beat range per replica: trace_merge pairs
+        # heartbeat_rtt/heartbeat_recv by (tid, beat) alone
+        beat = (r.id + 1) * 1_000_000
+        client = None
+        interval = self.config.heartbeat_interval_s
+        while not self._hb_stop.wait(interval):
+            beat += 1
+            try:
+                if client is None:
+                    client = RPCClient(
+                        r.endpoint,
+                        timeout_s=max(0.2, interval),
+                        deadline_s=max(0.2, self.config.lease_timeout_s
+                                       / 2.0),
+                        trainer_id=self.config.router_id)
+                t0 = time.time()
+                body = client.call("HEARTBEAT", seq=beat)
+                t1 = time.time()
+                _obs.emit("heartbeat_rtt", endpoint=r.endpoint,
+                          beat=beat, tid=self.config.router_id,
+                          t0_wall=t0, t1_wall=t1,
+                          rtt_s=round(t1 - t0, 6))
+                load = None
+                if body:
+                    try:
+                        meta, _ = unpack_blob(body)
+                        load = meta.get("load")
+                    except Exception:
+                        pass
+                r.mark_ok(load)
+                if not r.healthy:
+                    r.healthy = True
+                    _obs.emit("replica_readmitted", replica=r.id,
+                              endpoint=r.endpoint)
+            except Exception:
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
+                    client = None
+                if r.healthy and (time.monotonic() - r.last_ok
+                                  > self.config.lease_timeout_s):
+                    r.healthy = False
+                    _obs.emit(
+                        "replica_evicted", replica=r.id,
+                        endpoint=r.endpoint,
+                        lease_timeout_s=self.config.lease_timeout_s)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    # -- control-plane helpers ----------------------------------------
+    def _ctrl(self, r: _Replica, meta: dict, deadline_s=120.0) -> dict:
+        client = r.acquire()
+        try:
+            body = client.call("CTRL", "", pack_blob(meta),
+                               deadline_s=deadline_s)
+        finally:
+            r.release(client)
+        out, _ = unpack_blob(body)
+        if not out.get("ok"):
+            raise _error_from_meta(out)
+        return out
+
+    def replica_stats(self, rid: int) -> dict:
+        return self._ctrl(self._replicas[rid], {"op": "stats"})["stats"]
+
+    # -- versioned hot-swap -------------------------------------------
+    def swap_model(self, model_dir: str, model: str = "default",
+                   version: Optional[str] = None,
+                   drain_timeout_s: float = 60.0) -> dict:
+        """Hot-swap ``model`` to the version saved at ``model_dir``
+        across every healthy replica: signature-compat gate -> load +
+        warm v2 next to v1 -> atomically flip admissions -> drain and
+        unload v1. No request fails because of the flip; a v2 that
+        would break v1 clients is refused before any replica loads
+        it."""
+        healthy = self._healthy()
+        if not healthy:
+            raise ReplicaUnavailable("no healthy replicas to swap on")
+        first = healthy[0]
+        cur = self._ctrl(first, {"op": "signature", "model": model})
+        old_version, old_sig = cur["version"], cur["signature"]
+        sig_path = os.path.join(str(model_dir), SIGNATURE_FILENAME)
+        if not os.path.exists(sig_path):
+            raise SignatureMismatch(
+                "no %s sidecar in %r — hot-swap needs the saved "
+                "signature to prove v2 serves v1 clients; re-save the "
+                "model with save_inference_model" % (SIGNATURE_FILENAME,
+                                                     model_dir),
+                model=model, model_dir=str(model_dir))
+        with open(sig_path) as f:
+            new_sig = json.load(f)
+        problems = signature_compat(old_sig, new_sig)
+        if problems:
+            raise SignatureMismatch(
+                "hot-swap %s %s -> %s refused — the new signature "
+                "breaks live clients:\n  - %s\nFix the saved model "
+                "(or serve it under a NEW model name so clients opt "
+                "in)" % (model, old_version, model_dir,
+                         "\n  - ".join(problems)),
+                model=model, problems=problems)
+        if version is None:
+            nums = [int(v[1:]) for r in healthy
+                    for v in (self.replica_stats(r.id)["models"]
+                              .get(model, {}).get("versions", []))
+                    if v.startswith("v") and v[1:].isdigit()]
+            version = "v%d" % (max(nums or [0]) + 1)
+        report = {"model": model, "from": old_version, "to": version,
+                  "replicas": [r.id for r in healthy]}
+        # 1) load + warm everywhere (abort-and-unload on any failure:
+        #    admissions never flip to a partially-loaded fleet)
+        loaded, warmed = [], {}
+        try:
+            for r in healthy:
+                out = self._ctrl(r, {"op": "load_version",
+                                     "model": model,
+                                     "version": version,
+                                     "model_dir": str(model_dir)})
+                loaded.append(r)
+                warmed[r.id] = out.get("warmed_buckets", [])
+                if not warmed[r.id]:
+                    raise ServingError(
+                        "replica %d loaded %s/%s but warmed no "
+                        "buckets — refusing to admit cold-compile "
+                        "traffic" % (r.id, model, version))
+        except Exception:
+            for r in loaded:
+                try:
+                    self._ctrl(r, {"op": "drain_unload",
+                                   "model": model, "version": version,
+                                   "timeout_s": drain_timeout_s})
+                except Exception:
+                    pass
+            raise
+        report["warmed_buckets"] = warmed
+        _obs.emit("model_swap_loaded", model=model, version=version,
+                  replicas=[r.id for r in healthy])
+        # 2) flip admissions (per replica the flip is atomic; across
+        #    replicas it is eventually-uniform within one pass)
+        for r in healthy:
+            self._ctrl(r, {"op": "flip", "model": model,
+                           "version": version})
+        _obs.emit("model_swap_flipped", model=model, version=version,
+                  previous=old_version)
+        # 3) drain + unload the predecessor
+        for r in healthy:
+            self._ctrl(r, {"op": "drain_unload", "model": model,
+                           "version": old_version,
+                           "timeout_s": drain_timeout_s},
+                       deadline_s=drain_timeout_s + 30.0)
+        _obs.emit("model_swap_complete", model=model,
+                  version=version, drained=old_version)
+        return report
+
+    # -- introspection / lifecycle ------------------------------------
+    def stats(self) -> dict:
+        with self._mu:
+            pending = self._pending
+            counts = dict(self._counts)
+        return {
+            "router": dict(counts, policy=self.config.policy,
+                           pending=pending),
+            "replicas": {str(r.id): r.snapshot()
+                         for r in self._replicas},
+        }
+
+    def models(self):
+        for r in self._healthy():
+            try:
+                return sorted(self.replica_stats(r.id)["models"])
+            except Exception:
+                continue
+        return []
+
+    def shutdown(self, timeout: Optional[float] = 10.0):
+        self._stopped = True
+        self._hb_stop.set()
+        for t in self._hb_threads:
+            t.join(timeout=timeout)
+        self._pool.shutdown(wait=True)
+        for r in self._replicas:
+            r.close_clients()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,0 +1,117 @@
+"""Model-signature compatibility for versioned hot-swap.
+
+A fleet flips admissions from model v1 to v2 while v1 CLIENTS keep
+sending the same feeds — so v2 must accept every request v1 accepted
+and answer in the shape v1 clients parse. ``signature_compat`` checks
+exactly that over the ``__signature__.json`` sidecar dicts
+(``io.infer_signature`` schema: per-tensor name, dtype, dims with -1
+dynamic):
+
+  - input NAME SETS must match exactly — a new required input breaks
+    every live client (they don't send it), a dropped one makes their
+    feeds InvalidRequest;
+  - input dtypes must match exactly — the engine normalizes feeds to
+    the DECLARED dtype, so a change silently alters what the compiled
+    program computes on old clients' data;
+  - input dims: same rank; a static dim must stay the same size, and a
+    dynamic (-1) dim must stay dynamic. v2 MAY relax a static dim to
+    dynamic (old clients' fixed size still validates);
+  - outputs are positional to clients: same count, same dtypes, same
+    rank, static output dims unchanged (relaxing to dynamic allowed).
+
+``signature_compat`` returns the list of human-readable
+incompatibilities (empty = safe to swap); ``SignatureMismatch`` is the
+structured error the router raises from it, carrying the same list so
+an operator can see every reason at once instead of fixing them one
+rejected swap at a time.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .engine import ServingError
+
+__all__ = ["signature_compat", "SignatureMismatch"]
+
+
+class SignatureMismatch(ServingError):
+    """The proposed model version would break live clients of the
+    currently-served version; the swap is refused. ``details``
+    carries the full problem list."""
+    code = "SIGNATURE_MISMATCH"
+
+
+def _by_name(entries):
+    return {e["name"]: e for e in entries or []}
+
+
+def _dims_compat(old_e, new_e, what, problems):
+    os_, ns = old_e.get("shape"), new_e.get("shape")
+    if os_ is None and ns is None:
+        return
+    if os_ is None or ns is None:
+        problems.append(
+            "%s %r: declared shape %s -> %s (shape-less and shaped "
+            "declarations are not interchangeable)"
+            % (what, old_e["name"], os_, ns))
+        return
+    if len(os_) != len(ns):
+        problems.append(
+            "%s %r: rank %d -> %d (clients' arrays would no longer "
+            "validate)" % (what, old_e["name"], len(os_), len(ns)))
+        return
+    for i, (od, nd) in enumerate(zip(os_, ns)):
+        if od == nd:
+            continue
+        if od != -1 and nd == -1:
+            continue  # static -> dynamic: old fixed size still valid
+        if od == -1:
+            problems.append(
+                "%s %r dim %d: dynamic (-1) -> static %d (clients "
+                "bound other sizes to this dim)"
+                % (what, old_e["name"], i, nd))
+        else:
+            problems.append(
+                "%s %r dim %d: static %d -> %d (clients send %d)"
+                % (what, old_e["name"], i, od, nd, od))
+
+
+def signature_compat(old: dict, new: dict) -> List[str]:
+    """Can ``new`` serve every live client of ``old``? Returns the
+    list of incompatibilities (empty list = compatible). ``old`` /
+    ``new`` are ``__signature__.json`` dicts (io.infer_signature)."""
+    problems: List[str] = []
+    old_in, new_in = _by_name(old.get("inputs")), \
+        _by_name(new.get("inputs"))
+    for name in sorted(set(old_in) - set(new_in)):
+        problems.append(
+            "input %r removed (v1 clients still send it, which the "
+            "engine rejects as unexpected)" % name)
+    for name in sorted(set(new_in) - set(old_in)):
+        problems.append(
+            "input %r added (v1 clients don't send it, so every "
+            "request would be rejected as incomplete)" % name)
+    for name in sorted(set(old_in) & set(new_in)):
+        oe, ne = old_in[name], new_in[name]
+        if oe.get("dtype") != ne.get("dtype"):
+            problems.append(
+                "input %r: dtype %s -> %s (feeds are normalized to "
+                "the declared dtype; old clients' data would be "
+                "reinterpreted)" % (name, oe.get("dtype"),
+                                    ne.get("dtype")))
+        _dims_compat(oe, ne, "input", problems)
+    old_out = old.get("outputs") or []
+    new_out = new.get("outputs") or []
+    if len(old_out) != len(new_out):
+        problems.append(
+            "output count %d -> %d (clients unpack outputs "
+            "positionally)" % (len(old_out), len(new_out)))
+    else:
+        for i, (oe, ne) in enumerate(zip(old_out, new_out)):
+            if oe.get("dtype") != ne.get("dtype"):
+                problems.append(
+                    "output %d (%r): dtype %s -> %s"
+                    % (i, oe["name"], oe.get("dtype"), ne.get("dtype")))
+            _dims_compat(oe, ne, "output", problems)
+    return problems

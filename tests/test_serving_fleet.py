@@ -1,0 +1,559 @@
+"""Serving-fleet suite: ServingRouter over N RPC-fronted replicas.
+
+What must hold (the fleet's acceptance bar):
+
+  - routing is CORRECT: results through router -> RPC -> replica ->
+    engine are the engine's own results (bit-exact for a lone
+    request at its bucket);
+  - queue-depth-aware dispatch actually uses the piggybacked load:
+    a slow replica is routed AROUND, and least-loaded beats
+    round-robin p99 under skewed per-request cost;
+  - overload is a STRUCTURED, synchronous ``ServerOverloaded`` at
+    the router — shedding, not queue-melt;
+  - a replica killed mid-flight loses NOTHING: every future resolves
+    (result / retried result / structured error), the lease evicts
+    the corpse (journalled), and the fleet keeps serving at n-1;
+  - versioned hot-swap flips v1 -> v2 under live load with zero
+    failed requests, v2 warmed before admission, v1 drained away —
+    and REFUSES a v2 whose signature would break v1 clients.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.serving import (InvalidRequest, ReplicaUnavailable,
+                                RouterConfig, ServerOverloaded,
+                                ServingConfig, ServingEngine,
+                                ServingReplica, ServingRouter,
+                                SignatureMismatch, pad_batch,
+                                signature_compat)
+
+pytestmark = pytest.mark.serving
+
+IN_DIM = 16
+
+
+def _save_mlp(dirname, seed=7, out_dim=4, in_dim=IN_DIM,
+              extra_input=False, dtype="float32"):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[in_dim], dtype=dtype)
+            feeds = ["x"]
+            if extra_input:
+                b = layers.data(name="bias_in", shape=[out_dim],
+                                dtype=dtype)
+                feeds.append("bias_in")
+            h = layers.fc(x, size=8, act="relu")
+            pred = layers.fc(h, size=out_dim, act="softmax")
+            if extra_input:
+                pred = layers.elementwise_add(pred, b)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(str(dirname), feeds, [pred],
+                                      exe, main_program=main,
+                                      scope=scope)
+    return str(dirname)
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return _save_mlp(tmp_path_factory.mktemp("fleet_model"))
+
+
+@pytest.fixture
+def fleet(model_dir):
+    """Factory for an in-process fleet (thread replicas over real
+    TCP); everything built through it is torn down after the test."""
+    created = []
+
+    def make(n=2, model=model_dir, config=None, router_config=None):
+        cfg = config or ServingConfig(max_batch_size=8,
+                                      max_queue_wait_us=500)
+        reps = [ServingReplica(model, cfg, replica_id=i).start()
+                for i in range(n)]
+        router = ServingRouter(
+            [r.endpoint for r in reps],
+            router_config or RouterConfig(
+                lease_timeout_s=1.0, heartbeat_interval_s=0.1,
+                rpc_deadline_s=10.0, connect_timeout_s=3.0))
+        created.append((router, reps))
+        return router, reps
+
+    yield make
+    for router, reps in created:
+        try:
+            router.shutdown()
+        except Exception:
+            pass
+        for r in reps:
+            try:
+                r.shutdown()
+            except Exception:
+                pass
+
+
+def _feed(rng, rows=2, in_dim=IN_DIM):
+    return {"x": rng.rand(rows, in_dim).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# signature compatibility (hot-swap gate)
+# ---------------------------------------------------------------------------
+
+class TestSignatureCompat:
+    def _sig(self, d):
+        with open(os.path.join(d, "__signature__.json")) as f:
+            return json.load(f)
+
+    def test_identical_is_compatible(self, model_dir, tmp_path):
+        v2 = _save_mlp(tmp_path / "v2", seed=99)
+        assert signature_compat(self._sig(model_dir),
+                                self._sig(v2)) == []
+
+    def test_static_to_dynamic_relax_is_compatible(self, model_dir):
+        old = self._sig(model_dir)
+        new = json.loads(json.dumps(old))
+        new["inputs"][0]["shape"][1] = -1
+        new["inputs"][0]["dynamic_dims"] = sorted(
+            new["inputs"][0]["dynamic_dims"] + [1])
+        assert signature_compat(old, new) == []
+
+    def test_dynamic_to_static_tighten_refused(self, model_dir):
+        old = self._sig(model_dir)
+        new = json.loads(json.dumps(old))
+        old2 = json.loads(json.dumps(old))
+        old2["inputs"][0]["shape"][1] = -1
+        problems = signature_compat(old2, new)
+        assert any("dynamic (-1) -> static" in p for p in problems)
+
+    def test_dtype_change_refused(self, model_dir, tmp_path):
+        v2 = _save_mlp(tmp_path / "v2f64", dtype="float64")
+        problems = signature_compat(self._sig(model_dir),
+                                    self._sig(v2))
+        assert any("dtype" in p and "float64" in p for p in problems)
+
+    def test_added_and_removed_inputs_refused(self, model_dir,
+                                              tmp_path):
+        v2 = _save_mlp(tmp_path / "v2extra", extra_input=True)
+        problems = signature_compat(self._sig(model_dir),
+                                    self._sig(v2))
+        assert any("added" in p for p in problems)
+        # and the reverse direction reports the removal
+        problems = signature_compat(self._sig(v2),
+                                    self._sig(model_dir))
+        assert any("removed" in p for p in problems)
+
+    def test_static_dim_and_output_changes_refused(self, model_dir,
+                                                   tmp_path):
+        wide_in = _save_mlp(tmp_path / "v2wide", in_dim=32)
+        problems = signature_compat(self._sig(model_dir),
+                                    self._sig(wide_in))
+        assert any("static 16 -> 32" in p for p in problems)
+        wide_out = _save_mlp(tmp_path / "v2out", out_dim=6)
+        problems = signature_compat(self._sig(model_dir),
+                                    self._sig(wide_out))
+        assert problems  # output dim 4 -> 6 must be flagged
+
+
+# ---------------------------------------------------------------------------
+# routing correctness + dispatch policy
+# ---------------------------------------------------------------------------
+
+class TestRouterDispatch:
+    def test_lone_request_bit_exact(self, fleet, model_dir):
+        router, reps = fleet(n=2)
+        from paddle_tpu.inference import (AnalysisConfig,
+                                          AnalysisPredictor)
+        ref = AnalysisPredictor(AnalysisConfig(model_dir))
+        rng = np.random.RandomState(0)
+        for rows in (1, 2, 3, 5):
+            feed = _feed(rng, rows)
+            out = router.infer_sync(feed, timeout=30)
+            # engine contract: equal to a single-request predict
+            # padded to the request's own bucket
+            from paddle_tpu.serving import bucket_for, bucket_sizes
+            bucket = bucket_for(rows, bucket_sizes(8))
+            want = ref.predict(pad_batch(dict(feed), rows, bucket))
+            assert np.array_equal(out[0],
+                                  np.asarray(want[0])[:rows])
+
+    def test_concurrent_burst_correct_and_attributed(self, fleet):
+        router, reps = fleet(n=2)
+        rng = np.random.RandomState(1)
+        feeds = [_feed(rng, int(rng.randint(1, 5)))
+                 for _ in range(40)]
+        futs = [router.infer(f) for f in feeds]
+        outs = [f.result(30) for f in futs]
+        assert all(o[0].shape[0] == f["x"].shape[0]
+                   for o, f in zip(outs, feeds))
+        st = router.stats()
+        served = sum(s["requests"]
+                     for s in st["replicas"].values())
+        assert served == 40
+        # both replicas participated (queue-depth dispatch spreads a
+        # 40-request burst far wider than one worker)
+        assert all(s["requests"] > 0
+                   for s in st["replicas"].values())
+        assert st["router"]["completed"] == 40
+
+    def test_least_loaded_avoids_slow_replica_and_beats_rr(
+            self, fleet, model_dir):
+        def run(policy):
+            router, reps = fleet(
+                n=2, router_config=RouterConfig(
+                    policy=policy, lease_timeout_s=5.0,
+                    heartbeat_interval_s=0.2, rpc_deadline_s=30.0,
+                    connect_timeout_s=3.0))
+            # replica 0 pays a fixed 80 ms per dispatch (skewed
+            # per-request cost: the piggybacked queue depth is the
+            # only way the router can know)
+            for w in reps[0].engine._workers.values():
+                w._dispatch_hook = \
+                    lambda worker, batch: time.sleep(0.08)
+            rng = np.random.RandomState(2)
+            lat = []
+            lock = threading.Lock()
+
+            def worker():
+                for _ in range(6):
+                    t0 = time.monotonic()
+                    router.infer_sync(_feed(rng, 1), timeout=60)
+                    with lock:
+                        lat.append((time.monotonic() - t0) * 1e3)
+
+            ths = [threading.Thread(target=worker)
+                   for _ in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            st = router.stats()
+            return (np.percentile(lat, 99),
+                    {rid: s["requests"]
+                     for rid, s in st["replicas"].items()})
+
+        p99_ll, served_ll = run("least_loaded")
+        p99_rr, served_rr = run("round_robin")
+        # round-robin splits ~50/50 by construction; least-loaded
+        # must route most traffic to the fast replica...
+        assert served_ll["1"] > served_ll["0"]
+        assert served_ll["1"] >= 0.6 * sum(served_ll.values())
+        # ...and that shows up as a better tail
+        assert p99_ll < p99_rr
+
+    def test_all_replicas_saturated_sheds_structured(self, fleet):
+        router, reps = fleet(
+            n=2, router_config=RouterConfig(
+                shed_queue_depth=0,  # everything counts saturated
+                lease_timeout_s=5.0, heartbeat_interval_s=0.2,
+                connect_timeout_s=3.0))
+        rng = np.random.RandomState(3)
+        before = obs.registry().counter(
+            "router_requests_total", outcome="shed").value
+        with pytest.raises(ServerOverloaded) as ei:
+            router.infer(_feed(rng))
+        assert ei.value.code == "SERVER_OVERLOADED"
+        assert "saturated" in str(ei.value)
+        after = obs.registry().counter(
+            "router_requests_total", outcome="shed").value
+        assert after == before + 1
+        assert any(e["kind"] == "router_shed"
+                   for e in obs.journal_events(kind="router_shed"))
+
+    def test_pending_cap_sheds_structured(self, fleet):
+        router, _ = fleet(
+            n=1, router_config=RouterConfig(
+                max_pending=0, lease_timeout_s=5.0,
+                heartbeat_interval_s=0.2, connect_timeout_s=3.0))
+        with pytest.raises(ServerOverloaded) as ei:
+            router.infer({"x": np.zeros((1, IN_DIM), np.float32)})
+        assert "pending cap" in str(ei.value)
+
+    def test_invalid_feed_is_structured_not_retried(self, fleet):
+        router, _ = fleet(n=2)
+        fut = router.infer({"nope": np.zeros((1, IN_DIM),
+                                             np.float32)})
+        with pytest.raises(InvalidRequest):
+            fut.result(30)
+
+
+# ---------------------------------------------------------------------------
+# replica kill: zero lost futures, eviction, n-1 service
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestReplicaKill:
+    def test_kill_mid_flight_zero_lost_then_n_minus_1(self, fleet,
+                                                      model_dir):
+        router, reps = fleet(
+            n=2, router_config=RouterConfig(
+                lease_timeout_s=0.6, heartbeat_interval_s=0.1,
+                rpc_deadline_s=5.0, connect_timeout_s=2.0,
+                max_retries=4))
+        rng = np.random.RandomState(4)
+        feeds = [_feed(rng, int(rng.randint(1, 5)))
+                 for _ in range(30)]
+        futs = [router.infer(f) for f in feeds]
+        reps[0].crash()  # SIGKILL stand-in: nothing in flight answers
+        outs = [f.result(30) for f in futs]  # must ALL resolve
+        assert all(o[0].shape[0] == f["x"].shape[0]
+                   for o, f in zip(outs, feeds))
+        # lease eviction journalled, fleet keeps serving at n-1
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if obs.journal_events(kind="replica_evicted"):
+                break
+            time.sleep(0.05)
+        evs = obs.journal_events(kind="replica_evicted")
+        assert any(e.get("replica") == 0 for e in evs)
+        out = router.infer_sync(_feed(rng), timeout=30)
+        assert out[0].shape == (2, 4)
+        st = router.stats()
+        assert st["replicas"]["0"]["healthy"] is False
+        assert st["replicas"]["1"]["healthy"] is True
+
+    def test_all_replicas_dead_is_structured_error(self, fleet):
+        router, reps = fleet(
+            n=1, router_config=RouterConfig(
+                lease_timeout_s=0.4, heartbeat_interval_s=0.1,
+                rpc_deadline_s=2.0, connect_timeout_s=1.0,
+                max_retries=1))
+        reps[0].crash()
+        deadline = time.monotonic() + 5.0
+        while router._healthy() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        fut = router.infer({"x": np.zeros((1, IN_DIM), np.float32)})
+        with pytest.raises(ReplicaUnavailable):
+            fut.result(30)
+
+
+# ---------------------------------------------------------------------------
+# versioned hot-swap
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_swap_under_live_load_zero_failures(self, fleet,
+                                                model_dir, tmp_path):
+        v2_dir = _save_mlp(tmp_path / "v2", seed=31)
+        router, reps = fleet(n=2)
+        stop = threading.Event()
+        failures, completed = [], [0]
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            while not stop.is_set():
+                try:
+                    router.infer_sync(_feed(rng), timeout=30)
+                    completed[0] += 1
+                except Exception as e:  # ANY failure breaks the bar
+                    failures.append(repr(e))
+
+        ths = [threading.Thread(target=client, args=(s,))
+               for s in (10, 11, 12)]
+        for t in ths:
+            t.start()
+        time.sleep(0.3)
+        report = router.swap_model(v2_dir)
+        time.sleep(0.3)
+        stop.set()
+        for t in ths:
+            t.join()
+        assert not failures
+        assert completed[0] > 0
+        assert report["from"] == "v1" and report["to"] == "v2"
+        # v2 warmed on every replica BEFORE admission
+        assert sorted(report["warmed_buckets"]) == [0, 1]
+        assert all(report["warmed_buckets"][r.replica_id]
+                   for r in reps)
+        # v1 drained + unloaded everywhere; v2 is the only version
+        for rid in (0, 1):
+            models = router.replica_stats(rid)["models"]
+            assert models["default"]["active"] == "v2"
+            assert models["default"]["versions"] == ["v2"]
+        # and traffic now computes with the v2 weights, bit-exactly
+        from paddle_tpu.inference import (AnalysisConfig,
+                                          AnalysisPredictor)
+        ref = AnalysisPredictor(AnalysisConfig(v2_dir))
+        rng = np.random.RandomState(13)
+        feed = _feed(rng, 2)
+        out = router.infer_sync(feed, timeout=30)
+        want = ref.predict(pad_batch(dict(feed), 2, 2))
+        assert np.array_equal(out[0], np.asarray(want[0])[:2])
+
+    def test_incompatible_swap_refused_with_reasons(self, fleet,
+                                                    tmp_path):
+        bad = _save_mlp(tmp_path / "bad", out_dim=6)
+        router, reps = fleet(n=2)
+        with pytest.raises(SignatureMismatch) as ei:
+            router.swap_model(bad)
+        assert "breaks live clients" in str(ei.value)
+        assert ei.value.details["problems"]
+        # nothing changed: v1 still the only version, still serving
+        models = router.replica_stats(0)["models"]
+        assert models["default"] == {"active": "v1",
+                                     "versions": ["v1"]}
+        out = router.infer_sync(
+            {"x": np.zeros((1, IN_DIM), np.float32)}, timeout=30)
+        assert out[0].shape == (1, 4)
+
+    def test_missing_sidecar_refused_actionably(self, fleet,
+                                                tmp_path):
+        v2 = _save_mlp(tmp_path / "nosig", seed=55)
+        os.remove(os.path.join(v2, "__signature__.json"))
+        router, _ = fleet(n=1)
+        with pytest.raises(SignatureMismatch) as ei:
+            router.swap_model(v2)
+        assert "__signature__.json" in str(ei.value)
+        assert "save_inference_model" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# queue-depth surfacing (engine satellite)
+# ---------------------------------------------------------------------------
+
+class TestQueueDepth:
+    def test_live_queue_depth_and_gauge(self, model_dir):
+        eng = ServingEngine(model_dir, ServingConfig(
+            max_batch_size=4, max_queue_wait_us=100))
+        try:
+            worker = eng._worker(None)
+            release = threading.Event()
+            worker._dispatch_hook = \
+                lambda w, b: release.wait(10)
+            rng = np.random.RandomState(5)
+            futs = [eng.infer({"x": rng.rand(1, IN_DIM)
+                               .astype(np.float32)})
+                    for _ in range(6)]
+            deadline = time.monotonic() + 5.0
+            while eng.queue_depth() < 2 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            depth = eng.queue_depth()
+            assert depth >= 2
+            gauge = obs.registry().gauge("serving_queue_depth",
+                                         model="default")
+            assert gauge.value >= 2
+            # and the Prometheus text surface shows the series
+            text = obs.registry().prometheus_text()
+            assert 'serving_queue_depth{model="default"}' in text
+            release.set()
+            for f in futs:
+                f.result(30)
+            assert eng.queue_depth() == 0
+        finally:
+            eng.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# launcher fleet mode
+# ---------------------------------------------------------------------------
+
+class TestLaunchServingEnv:
+    def test_get_serving_env_contract(self, tmp_path):
+        from paddle_tpu.distributed import launch as L
+        args = L._parse_args(
+            ["--serving_replicas", "3",
+             "--serving_started_port", "9300",
+             "--journal_dir", str(tmp_path), "script.py"])
+        envs = L.get_serving_env(args)
+        assert len(envs) == 3
+        eps = ["127.0.0.1:%d" % (9300 + k) for k in range(3)]
+        for k, env in enumerate(envs):
+            assert env["PADDLE_SERVING_REPLICA_ID"] == str(k)
+            assert env["PADDLE_CURRENT_ENDPOINT"] == eps[k]
+            assert env["PADDLE_SERVING_ENDPOINTS"] == ",".join(eps)
+            assert env["PADDLE_TRAINING_ROLE"] == "SERVING"
+            assert env["PADDLE_TPU_ROLE"] == "serving-%d" % k
+            assert env["PADDLE_TPU_EVENT_JOURNAL"] == os.path.join(
+                str(tmp_path), "events.serving-%d.jsonl" % k)
+
+    def test_no_serving_replicas_means_no_envs(self):
+        from paddle_tpu.distributed import launch as L
+        args = L._parse_args(["script.py"])
+        assert L.get_serving_env(args) == []
+
+
+# ---------------------------------------------------------------------------
+# load_gen: ramp mode + fleet smoke
+# ---------------------------------------------------------------------------
+
+class TestLoadGenRamp:
+    def _load_gen(self):
+        import importlib
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        return importlib.import_module("load_gen")
+
+    def test_ramp_mode_smoke(self, capsys):
+        load_gen = self._load_gen()
+        rc = load_gen.main(["--synthetic", "--mode", "ramp",
+                            "--ramp", "1,2", "--step-duration",
+                            "0.2", "--max-batch", "8"])
+        assert rc == 0
+        report = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert report["mode"] == "ramp"
+        assert [s["concurrency"] for s in report["steps"]] == [1, 2]
+        for s in report["steps"]:
+            assert s["completed"] > 0
+            assert s["p99_ms"] is not None
+        assert report["client_failed"] == 0
+
+    def test_fleet_subprocess_smoke_with_attribution(self, capsys):
+        load_gen = self._load_gen()
+        rc = load_gen.main(["--synthetic", "--replicas", "1",
+                            "--mode", "closed", "--concurrency", "2",
+                            "--duration", "0.3", "--max-batch", "8"])
+        assert rc == 0
+        report = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert report["replicas"] == 1
+        assert report["completed"] > 0
+        (attr,) = report["per_replica"].values()
+        assert attr["requests"] == report["completed"]
+        assert attr["sheds"] == 0
+        assert attr["p99_ms"] is not None
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill under 5% drop, merged trace, causal journal
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestFleetChaos:
+    def test_serving_kill_scenario(self):
+        """The full acceptance scenario (tools/chaos_run.py
+        serving_kill): replica killed under NetFaultProxy 5% drop ->
+        zero lost/hung futures, bounded p99, causal replica_evicted
+        journal event, ONE merged trace with router->replica span
+        links."""
+        import importlib
+        import sys
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        sys.path.insert(0, tools)
+        chaos_run = importlib.import_module("chaos_run")
+
+        class A:
+            seed = 0
+            steps = 3
+        verdict = chaos_run._scenario_serving_kill(A())
+        assert verdict["ok"], verdict
+        assert verdict["hung"] == []
+        assert verdict["unstructured"] == []
+        assert verdict["causal_order"]
+        assert verdict["trace_links"] > 0
+        assert verdict["replica_evicted_seq"] is not None

@@ -21,6 +21,11 @@ python tools/chaos_run.py --steps 20 --nan-step 4 --q8
 python tools/chaos_run.py --distributed
 python tools/chaos_run.py --distributed --scenario pserver_restart
 
+# the SERVING-FLEET acceptance scenario: replica killed mid-flight
+# under 5% drop -> zero lost/hung futures, bounded p99, causal
+# replica_evicted journal, ONE merged trace
+python tools/chaos_run.py --distributed --scenario serving_kill
+
 # the OBSERVABILITY acceptance scenario: 2 trainers x 2 pservers,
 # pserver kill+restart under 5% drop, profiler + journal on -> one
 # merged chrome trace (client/server spans linked by trace id) and a
@@ -511,11 +516,139 @@ def _scenario_restart_2x2_obs(args):
             "losses": results.get(0)}
 
 
+def _scenario_serving_kill(args):
+    """The serving-fleet acceptance scenario: 3 replicas behind
+    NetFaultProxies dropping 5% of frames, closed-loop clients on the
+    router, replica 0 SIGKILL-crashed mid-flight. Must hold: every
+    client future resolves (result, retried result, or structured
+    error) — zero lost/hung; p99 bounded; ``replica_evicted``
+    journalled in causal (seq) order; ONE merged chrome trace whose
+    router INFER client spans link to replica handler spans."""
+    import contextlib
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import profiler
+    from paddle_tpu.resilience import NetFaultProxy
+    from paddle_tpu.serving import (RouterConfig, ServingConfig,
+                                    ServingError, ServingReplica,
+                                    ServingRouter)
+    import load_gen
+    import trace_merge
+
+    workdir = tempfile.mkdtemp(prefix="chaos-serving-")
+    journal_path = os.path.join(workdir, "events.jsonl")
+    trace_path = os.path.join(workdir, "trace.json")
+    merged_path = os.path.join(workdir, "merged.json")
+    obs.configure_journal(journal_path)
+
+    model_dir = load_gen.build_synthetic_model(
+        os.path.join(workdir, "model"))
+    cfg = ServingConfig(max_batch_size=8, max_queue_wait_us=500)
+    replicas = [ServingReplica(model_dir, cfg, replica_id=i).start()
+                for i in range(3)]
+    proxies = []
+    for i, r in enumerate(replicas):
+        p = NetFaultProxy(r.endpoint, seed=args.seed + i)
+        p.set_drop_rate(0.05)
+        proxies.append(p)
+    router = ServingRouter(
+        [p.endpoint for p in proxies],
+        RouterConfig(lease_timeout_s=1.0, heartbeat_interval_s=0.1,
+                     rpc_deadline_s=3.0, connect_timeout_s=3.0,
+                     max_retries=5))
+
+    duration_s = max(4.0, args.steps)
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_ms, structured, hung, unstructured = [], [], [], []
+    rng_seed = [100]
+
+    def client():
+        with lock:
+            rng_seed[0] += 1
+            rng = np.random.RandomState(rng_seed[0])
+        while not stop.is_set():
+            feed = {"x": rng.rand(int(rng.randint(1, 5)),
+                                  64).astype(np.float32)}
+            t0 = time.monotonic()
+            try:
+                router.infer_sync(feed, timeout=30)
+                with lock:
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+            except ServingError as e:
+                with lock:
+                    structured.append(e.code)
+            except Exception as e:
+                name = type(e).__name__
+                with lock:
+                    (hung if "Timeout" in name
+                     else unstructured).append(repr(e))
+
+    profiler.start_profiler("CPU")
+    t_start = time.monotonic()
+    ths = [threading.Thread(target=client) for _ in range(8)]
+    for t in ths:
+        t.start()
+    time.sleep(duration_s * 0.3)
+    replicas[0].crash()  # mid-flight SIGKILL stand-in
+    kill_t = time.monotonic()
+    time.sleep(max(0.0, duration_s - (time.monotonic() - t_start)))
+    stop.set()
+    for t in ths:
+        t.join(timeout=60)
+    elapsed = time.monotonic() - t_start
+    profiler.export_chrome_tracing(trace_path)
+    with contextlib.redirect_stdout(sys.stderr):
+        profiler.stop_profiler()
+    router.shutdown()
+    for i, r in enumerate(replicas):
+        if i != 0:
+            try:
+                r.shutdown()
+            except Exception:
+                pass
+    for p in proxies:
+        p.close()
+    obs.configure_journal(None)
+
+    _, report = trace_merge.merge([trace_path], [journal_path],
+                                  merged_path)
+    events = obs.read_journal(journal_path)
+    seqs = [e["seq"] for e in events]
+    evict = next((e for e in events
+                  if e["kind"] == "replica_evicted"
+                  and e.get("replica") == 0), None)
+    p99 = float(np.percentile(np.asarray(lat_ms), 99)) \
+        if lat_ms else None
+    ok = (not hung and not unstructured and lat_ms
+          and evict is not None and seqs == sorted(seqs)
+          and report["links"] > 0
+          and p99 is not None and p99 < 5000.0)
+    return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "completed": len(lat_ms),
+            "p99_ms": round(p99, 2) if p99 is not None else None,
+            "structured_errors": sorted(set(structured)),
+            "structured_error_count": len(structured),
+            "hung": hung[:3], "unstructured": unstructured[:3],
+            "replica_evicted_seq": evict and evict["seq"],
+            "evicted_after_kill_s": evict and round(
+                evict["t_mono"] - kill_t, 2),
+            "causal_order": seqs == sorted(seqs),
+            "trace_links": report["links"],
+            "merged_trace": merged_path}
+
+
 DIST_SCENARIOS = {
     "pserver_restart": _scenario_pserver_restart,
     "trainer_kill": _scenario_trainer_kill,
     "drop30": _scenario_drop30,
     "restart_2x2_obs": _scenario_restart_2x2_obs,
+    "serving_kill": _scenario_serving_kill,
 }
 
 

@@ -10,6 +10,15 @@ contract.
   coordinated omission).
 - ``--mode closed``: ``--concurrency`` workers each keep exactly one
   request in flight (classic throughput probe; latencies flatter).
+- ``--mode ramp``: stepped-concurrency closed loop — one closed-loop
+  step per level in ``--ramp`` (e.g. 1,2,4,8), each ``--step-duration``
+  seconds, reported per step (where does throughput saturate? where
+  does p99 leave the SLO?).
+
+``--replicas N`` drives a FLEET instead of the in-process engine: N
+``serving/replica.py`` subprocesses behind a ``ServingRouter``
+(``--policy least_loaded|round_robin``), with per-replica attribution
+(requests, p99, sheds) in the JSON report.
 
 Examples
 --------
@@ -19,6 +28,10 @@ python tools/load_gen.py --synthetic --mode open --qps 200 --duration 5
 # a saved model dir, closed loop with 16 workers
 python tools/load_gen.py --model-dir /tmp/mnist_model --mode closed \
     --concurrency 16 --duration 10
+
+# 4-replica fleet, stepped ramp
+python tools/load_gen.py --synthetic --replicas 4 --mode ramp \
+    --ramp 2,4,8,16 --step-duration 3
 
 Exit code 0 when the run completed and every non-rejected request
 resolved; 1 otherwise. The last stdout line is the JSON report.
@@ -40,18 +53,22 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np  # noqa: E402
 
 
-def build_synthetic_model(dirname):
-    """Train-free 64->32->8 softmax MLP saved as an inference model —
-    enough to exercise batching/bucketing without a real checkpoint."""
+def build_synthetic_model(dirname, hidden=32, seed=3):
+    """Train-free 64->hidden->8 softmax MLP saved as an inference
+    model — enough to exercise batching/bucketing without a real
+    checkpoint. ``hidden`` scales per-request compute (the fleet
+    scaling bench uses a wider net so replica compute, not router
+    overhead, is the bottleneck being scaled)."""
     import paddle_tpu as fluid
     from paddle_tpu import layers
 
     main, startup = fluid.Program(), fluid.Program()
-    main.random_seed = startup.random_seed = 3
-    with fluid.program_guard(main, startup):
-        x = layers.data(name="x", shape=[64], dtype="float32")
-        h = layers.fc(x, size=32, act="relu")
-        pred = layers.fc(h, size=8, act="softmax")
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[64], dtype="float32")
+            h = layers.fc(x, size=hidden, act="relu")
+            pred = layers.fc(h, size=8, act="softmax")
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor()
@@ -65,7 +82,14 @@ def _feed_maker(engine, rng, batch_min, batch_max):
     """Random ragged feed built from the model signature (sidecar or
     live derivation) — batch dim in [batch_min, batch_max]."""
     worker = engine._worker(None)
-    sig = worker.predictor.signature
+    return _feed_maker_from_sig(worker.predictor.signature, rng,
+                                batch_min, batch_max)
+
+
+def _feed_maker_from_sig(sig, rng, batch_min, batch_max):
+    """Signature-driven twin of ``_feed_maker`` for targets without a
+    local predictor (the fleet router: the signature comes from the
+    model dir's ``__signature__.json`` sidecar)."""
 
     def make():
         n = int(rng.randint(batch_min, batch_max + 1))
@@ -135,6 +159,76 @@ def run_open_loop(engine, make_feed, qps, duration_s, deadline_ms):
             "client_lat_ms": lat_ms}
 
 
+def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
+                queue_size=256, policy="least_loaded",
+                router_config=None, startup_timeout_s=120.0,
+                replica_args=()):
+    """Spawn ``n_replicas`` serving-replica SUBPROCESSES (real
+    processes — the fleet's scaling claim is about escaping one
+    process) for ``model_dir`` and return ``(router, stop)`` where
+    ``stop()`` shuts the router down and reaps the children. Each
+    child announces ``REPLICA_READY <endpoint>`` on stdout before the
+    router is built, so a returned router is immediately usable."""
+    import subprocess
+
+    from paddle_tpu.serving import RouterConfig, ServingRouter
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs, endpoints = [], []
+    try:
+        for k in range(n_replicas):
+            cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+                   "--model-dir", str(model_dir), "--port", "0",
+                   "--replica-id", str(k),
+                   "--max-batch", str(max_batch),
+                   "--wait-us", str(wait_us),
+                   "--queue-size", str(queue_size)]
+            cmd.extend(replica_args)
+            procs.append(subprocess.Popen(
+                cmd, env=env, cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True))
+        deadline = time.monotonic() + startup_timeout_s
+        for p in procs:
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("replica startup timed out")
+                line = p.stdout.readline()
+                if not line:
+                    raise RuntimeError(
+                        "replica died before READY (rc=%s)"
+                        % p.poll())
+                if line.startswith("REPLICA_READY "):
+                    endpoints.append(line.split()[1])
+                    break
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+    cfg = router_config or RouterConfig(policy=policy,
+                                        lease_timeout_s=2.0,
+                                        heartbeat_interval_s=0.2,
+                                        connect_timeout_s=10.0)
+    router = ServingRouter(endpoints, cfg)
+
+    def stop():
+        router.shutdown()
+        for p in procs:
+            try:
+                p.stdin.close()  # replicas exit on stdin EOF
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+    stop.procs = procs  # chaos/bench seam: kill a REAL process
+    return router, stop
+
+
 def run_closed_loop(engine, make_feed, concurrency, duration_s,
                     deadline_ms):
     from paddle_tpu.serving import ServerOverloaded
@@ -174,16 +268,66 @@ def run_closed_loop(engine, make_feed, concurrency, duration_s,
             "client_failed": counts["failed"], "client_lat_ms": lat_ms}
 
 
+def run_ramp(engine, make_feed, concurrencies, step_duration_s,
+             deadline_ms):
+    """Stepped-concurrency closed loop: one closed-loop step per level,
+    each reported separately (completed/achieved QPS/p50/p99/rejected)
+    so the knee — where added concurrency stops buying throughput and
+    starts buying latency — is visible in one run."""
+    steps, all_lat = [], []
+    for c in concurrencies:
+        t0 = time.monotonic()
+        r = run_closed_loop(engine, make_feed, int(c), step_duration_s,
+                            deadline_ms)
+        wall = time.monotonic() - t0
+        lat = np.asarray(r["client_lat_ms"])
+        all_lat.extend(r["client_lat_ms"])
+        steps.append({
+            "concurrency": int(c),
+            "completed": int(lat.size),
+            "achieved_qps": round(lat.size / wall, 2) if wall else None,
+            "p50_ms": round(float(np.percentile(lat, 50)), 3)
+            if lat.size else None,
+            "p99_ms": round(float(np.percentile(lat, 99)), 3)
+            if lat.size else None,
+            "client_rejected": r["client_rejected"],
+            "client_failed": r["client_failed"],
+        })
+    return {"ramp": [int(c) for c in concurrencies],
+            "step_duration_s": step_duration_s, "steps": steps,
+            "submitted": sum(s["completed"] + s["client_rejected"]
+                             + s["client_failed"] for s in steps),
+            "client_rejected": sum(s["client_rejected"]
+                                   for s in steps),
+            "client_failed": sum(s["client_failed"] for s in steps),
+            "client_lat_ms": all_lat}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-dir", default=None)
     ap.add_argument("--synthetic", action="store_true",
                     help="build a throwaway MLP instead of loading")
-    ap.add_argument("--mode", choices=("open", "closed"),
+    ap.add_argument("--mode", choices=("open", "closed", "ramp"),
                     default="open")
     ap.add_argument("--qps", type=float, default=100.0)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--ramp", default="1,2,4,8",
+                    help="comma-separated concurrency levels for "
+                    "--mode ramp")
+    ap.add_argument("--step-duration", type=float, default=2.0,
+                    help="seconds per ramp step")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="drive a fleet of N replica subprocesses "
+                    "behind a ServingRouter instead of the in-process "
+                    "engine")
+    ap.add_argument("--policy", choices=("least_loaded",
+                                         "round_robin"),
+                    default="least_loaded",
+                    help="router dispatch policy (with --replicas)")
+    ap.add_argument("--hidden", type=int, default=32,
+                    help="synthetic model hidden width")
     ap.add_argument("--max-batch", type=int, default=32)
     ap.add_argument("--wait-us", type=int, default=2000)
     ap.add_argument("--queue-size", type=int, default=256)
@@ -202,30 +346,53 @@ def main(argv=None):
     model_dir = args.model_dir
     if model_dir is None:
         model_dir = build_synthetic_model(
-            tempfile.mkdtemp(prefix="load_gen_model_"))
-    cfg = ServingConfig(max_batch_size=args.max_batch,
-                        max_queue_wait_us=args.wait_us,
-                        max_queue_size=args.queue_size,
-                        warmup=not args.no_warmup)
-    engine = ServingEngine(model_dir, cfg)
+            tempfile.mkdtemp(prefix="load_gen_model_"),
+            hidden=args.hidden)
     rng = np.random.RandomState(args.seed)
-    make_feed = _feed_maker(engine, rng, args.batch_min,
-                            min(args.batch_max, args.max_batch))
+    stop_fleet = None
+    if args.replicas > 0:
+        engine, stop_fleet = spawn_fleet(
+            model_dir, args.replicas, max_batch=args.max_batch,
+            wait_us=args.wait_us, queue_size=args.queue_size,
+            policy=args.policy)
+        with open(os.path.join(model_dir,
+                               "__signature__.json")) as f:
+            sig = json.load(f)
+        make_feed = _feed_maker_from_sig(
+            sig, rng, args.batch_min,
+            min(args.batch_max, args.max_batch))
+    else:
+        cfg = ServingConfig(max_batch_size=args.max_batch,
+                            max_queue_wait_us=args.wait_us,
+                            max_queue_size=args.queue_size,
+                            warmup=not args.no_warmup)
+        engine = ServingEngine(model_dir, cfg)
+        make_feed = _feed_maker(engine, rng, args.batch_min,
+                                min(args.batch_max, args.max_batch))
 
     t0 = time.monotonic()
     if args.mode == "open":
         client = run_open_loop(engine, make_feed, args.qps,
                                args.duration, args.deadline_ms)
+    elif args.mode == "ramp":
+        levels = [int(c) for c in args.ramp.split(",") if c.strip()]
+        client = run_ramp(engine, make_feed, levels,
+                          args.step_duration, args.deadline_ms)
     else:
         client = run_closed_loop(engine, make_feed, args.concurrency,
                                  args.duration, args.deadline_ms)
     wall = time.monotonic() - t0
-    engine.shutdown(drain=True, timeout=30)
+    stats = engine.stats()
+    if stop_fleet is not None:
+        stop_fleet()
+    else:
+        engine.shutdown(drain=True, timeout=30)
 
     lat = np.asarray(client.pop("client_lat_ms"))
     report = {
         "metric": "serving_load_gen",
         "mode": args.mode,
+        "replicas": args.replicas,
         "duration_s": round(wall, 2),
         "completed": int(lat.size),
         "achieved_qps": round(lat.size / wall, 2) if wall > 0 else None,
@@ -235,8 +402,16 @@ def main(argv=None):
         if lat.size else None,
         "p99_ms": round(float(np.percentile(lat, 99)), 3)
         if lat.size else None,
-        "engine": engine.stats(),
+        "engine": stats,
     }
+    if args.replicas > 0:
+        # per-replica attribution: who served what, at what tail, and
+        # who shed (stats is the router snapshot here)
+        report["per_replica"] = {
+            rid: {k: s[k] for k in ("endpoint", "healthy", "requests",
+                                    "failures", "sheds", "p50_ms",
+                                    "p99_ms", "queue_depth")}
+            for rid, s in stats["replicas"].items()}
     report.update(client)
     print(json.dumps(report), flush=True)
     return 1 if client.get("client_failed") else 0
