@@ -681,6 +681,64 @@ def bench_telemetry_overhead(steps=None, batch=256, chunk_size=8):
             "mfu": None}
 
 
+def bench_health_overhead(steps=None, batch=256, chunk_size=8):
+    """Health-plane hot-path cost row: the pipelined CPU probe run
+    with the watchdog ARMED (ticking fast, default rules evaluating
+    registry deltas, a dispatch-beacon watch pending, flight recorder
+    sampling each tick) vs DISARMED. The per-dispatch cost the armed
+    mode adds is one beacon bump (executor already pays it either
+    way) plus the 4 Hz watchdog thread; the acceptance bar is < 2%
+    steps/s, same protocol as ``telemetry_overhead`` (interleaved
+    best-of-2 so CPU jitter doesn't land on one mode)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import pipeline_probe
+
+    from paddle_tpu.observability import health
+
+    steps = steps or int(_env_float("BENCH_HEALTH_STEPS", 48))
+
+    def run(armed):
+        wd = rec = None
+        if armed:
+            # a PRIVATE watchdog, ticking 2x faster than the 0.5s
+            # default so the row over-measures rather than under:
+            # rules over registry deltas + a beacon watch + recorder
+            # sampling — the full armed configuration
+            wd = health.Watchdog(role="bench", interval_s=0.25)
+            for r in health.default_rules():
+                wd.add_rule(r)
+            rec = health.FlightRecorder(role="bench")  # ring only
+            wd.attach_recorder(rec)
+            wd.watch("bench_probe",
+                     beacon=health.beacon("bench_health_probe"),
+                     deadline_s=600.0)
+            wd.start()
+        try:
+            r = pipeline_probe.probe(steps=steps, batch=batch,
+                                     chunk_size=chunk_size)
+        finally:
+            if wd is not None:
+                wd.stop()
+        return r["pipelined"]["steps_per_s"]
+
+    sps_off = run(False)
+    sps_on = run(True)
+    sps_off = max(sps_off, run(False))
+    sps_on = max(sps_on, run(True))
+    overhead = (1.0 - sps_on / sps_off) if sps_off else None
+    return {"metric": "health_overhead",
+            "value": round(overhead, 4) if overhead is not None
+            else None,
+            "unit": "fraction steps/s lost (watchdog armed vs "
+            "disarmed)",
+            "armed_steps_per_s": sps_on,
+            "disarmed_steps_per_s": sps_off,
+            "steps": steps, "chunk_size": chunk_size,
+            "bar": "< 0.02",
+            "mfu": None}
+
+
 # ---------------------------------------------------------------------------
 # config 2: ResNet-50 ImageNet
 # ---------------------------------------------------------------------------
@@ -1345,6 +1403,31 @@ def _claim_device_with_retry():
         delay = min(delay * 2, 60.0)
 
 
+def _arm_flight_recorder():
+    """Black-box the claim-timeout path: rounds 2-5 lost their device
+    claims to a SILENT jax.devices() hang the parent could only kill
+    blind. The child arms the health plane's flight recorder before
+    claiming, so the parent's SIGTERM leaves blackbox.bench-child.json
+    (all-thread stacks incl. the wedged claim frame, journal tail,
+    metric tail) — plus a faulthandler C-level stack dump that fires
+    even when the main thread is stuck inside the PJRT client and no
+    Python signal handler can run. Evidence for doctor/humans where
+    there used to be only a 240 s timeout."""
+    try:
+        from paddle_tpu.observability import health
+        rec = health.get_recorder()
+        if rec.dir is None:
+            rec.set_dir(os.environ.get("BENCH_BLACKBOX_DIR")
+                        or os.path.join(os.path.dirname(
+                            os.path.abspath(__file__)),
+                            ".bench_blackbox"))
+        rec.role = "bench-child"
+        rec.install_signal_handlers()
+        _log("flight recorder armed (blackbox dir %s)" % rec.dir)
+    except Exception as e:
+        _log("flight recorder unavailable: %r" % e)
+
+
 def _smoke_overrides():
     """--backend cpu: shrink the headline config so the harness itself
     is testable in CI without a chip (and without minute-long CPU
@@ -1407,6 +1490,7 @@ def child_main():
                 "jax_persistent_cache_min_compile_time_secs", 5.0)
         except Exception as e:
             _log("compile cache unavailable: %r" % e)
+        _arm_flight_recorder()
         _log("claiming device...")
         err = _claim_device_with_retry()
         if err is not None:
@@ -1460,7 +1544,7 @@ def child_main():
         # configs that measure in seconds. A stall in any config
         # forfeits only the ones after it.
         extra = [bench_mnist_mlp, bench_pipelined_train,
-                 bench_telemetry_overhead,
+                 bench_telemetry_overhead, bench_health_overhead,
                  bench_guarded_overhead, bench_ps_degraded,
                  bench_serving_latency, bench_serving_fleet_scaling,
                  bench_deepfm, bench_bert,
@@ -1604,7 +1688,10 @@ def _parent_attempt_loop(deadline, claim_timeout, grace):
                 kill_reason = "budget exceeded"
                 break
         if kill_reason:
-            _log("attempt %d: killing child: %s" % (attempt, kill_reason))
+            _log("attempt %d: killing child: %s (the child's flight "
+                 "recorder dumps blackbox.bench-child.json on the "
+                 "TERM — see tools/doctor.py --blackbox)"
+                 % (attempt, kill_reason))
             _kill_child(proc)
         rd.join(timeout=10)
         lines = [ln for ln in lines

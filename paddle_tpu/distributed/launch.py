@@ -188,12 +188,16 @@ def _journal_dir(args):
 def _stamp_role(env, args, role):
     """Role tag + role-stamped event-journal path (the observability
     plane's per-process identity: journal events carry the role, and
-    each worker writes its own events.<role>.jsonl)."""
+    each worker writes its own events.<role>.jsonl). The same dir is
+    stamped as the flight-recorder blackbox dir, so a worker that
+    wedges or gets SIGTERMed leaves blackbox.<role>.json next to its
+    journal (observability.health.FlightRecorder)."""
     env["PADDLE_TPU_ROLE"] = role
     jdir = _journal_dir(args)
     if jdir:
         env["PADDLE_TPU_EVENT_JOURNAL"] = os.path.join(
             jdir, "events.%s.jsonl" % role)
+        env.setdefault("PADDLE_TPU_BLACKBOX_DIR", jdir)
 
 
 def _prefix_pump(pipe, role, sink):

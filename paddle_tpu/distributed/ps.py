@@ -132,7 +132,8 @@ class ListenAndServ:
                  optimize_fn, n_trainers=1, sync_mode=True,
                  lookup_tables=None, lease_timeout_s=None,
                  allow_degraded=None, snapshot_fn=None,
-                 snapshot_every=1, restore_meta=None, on_event=None):
+                 snapshot_every=1, restore_meta=None, on_event=None,
+                 barrier_stall_s=120.0):
         self.server = RPCServer(endpoint)
         self.endpoint = self.server.endpoint
         # any Mapping works — PServerRuntime passes a live scope view
@@ -173,6 +174,21 @@ class ListenAndServ:
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         self._crash_at: Dict[str, int] = {}
+        # health plane: handler-drain beacon (one bump per handled
+        # verb — evidence in blackbox dumps) and a barrier-release
+        # beacon watched for the parked-barrier wedge: waiters parked
+        # past barrier_stall_s with no release means the quorum can
+        # never form (a dead trainer with no lease armed, a lost
+        # eviction) — exactly the hang class leases exist to prevent,
+        # surfaced instead of silent. None disables the watch.
+        # drain beacon via the registered factory (process-aggregate
+        # across instances) so it shows in beacons_snapshot() — the
+        # blackbox's "which loop stopped first" evidence; the barrier
+        # beacon stays PRIVATE because it is watched per-endpoint
+        self._drain_beacon = _obs.beacon("ps_handlers")
+        self._barrier_beacon = _obs.Beacon("ps_barrier")
+        self._barrier_stall_s = barrier_stall_s
+        self._health_watch = None
         self.lookup_tables = lookup_tables or {}
         if restore_meta:
             self._seen_send = _SeqTracker.from_meta(
@@ -267,6 +283,7 @@ class ListenAndServ:
 
     # -- handlers (each runs on the server drain thread) -------------------
     def _on_send(self, name, payload):
+        self._drain_beacon.bump()
         self._chaos_tick("SEND")
         # "var@@tid[@@seq]" carries the sender's trainer id (DC-ASGD
         # needs per-trainer weight backups; reference enable_dc_asgd,
@@ -316,6 +333,7 @@ class ListenAndServ:
         self.optimize_fn(name, grad)
 
     def _on_get(self, name, payload):
+        self._drain_beacon.bump()
         name, tid, _ = unpack_wire_name(name)
         if name == INCARNATION_KEY:
             return self._incarnation
@@ -330,6 +348,7 @@ class ListenAndServ:
         Non-blocking: the reply is parked until the quorum arrives.
         Keyed by trainer id so a replayed barrier supersedes its own
         stale parked entry."""
+        self._drain_beacon.bump()
         self._chaos_tick("BARRIER")
         base, tid, _ = unpack_wire_name(name)
         stale = None
@@ -373,6 +392,9 @@ class ListenAndServ:
         if waiters:
             for _, _, r in waiters:
                 r(status, msg)
+            # barrier progress: any answered waiter set (release,
+            # abort, eviction, shutdown) resets the stall clock
+            self._barrier_beacon.bump()
 
     def _maybe_snapshot_locked(self):
         if self._snapshot_fn is None:
@@ -404,6 +426,7 @@ class ListenAndServ:
                 self._leases[t] += paused
 
     def _on_complete(self, name, payload):
+        self._drain_beacon.bump()
         base, tid, _ = unpack_wire_name(name)
         with self._mu:
             if tid is not None:
@@ -422,6 +445,7 @@ class ListenAndServ:
         return b""
 
     def _on_heartbeat(self, name, payload):
+        self._drain_beacon.bump()
         base, tid, seq = unpack_wire_name(name)
         with self._mu:
             if tid is not None:
@@ -446,6 +470,7 @@ class ListenAndServ:
         return serialize_tensor(table.pull(ids))
 
     def _on_push_sparse(self, name, payload):
+        self._drain_beacon.bump()
         name, tid, seq = unpack_wire_name(name)
         with self._mu:
             self._touch_lease_locked(tid)
@@ -535,6 +560,13 @@ class ListenAndServ:
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         self.server.start()
+        if self._barrier_stall_s is not None \
+                and self._health_watch is None:
+            self._health_watch = _obs.get_watchdog().watch(
+                "ps_barrier@%s" % self.endpoint,
+                beacon=self._barrier_beacon,
+                deadline_s=self._barrier_stall_s,
+                pending_fn=lambda: bool(self._barrier_waiters))
         if self.lease_timeout_s is not None and self._monitor is None:
             self._monitor = threading.Thread(target=self._monitor_loop,
                                              daemon=True)
@@ -569,6 +601,9 @@ class ListenAndServ:
                           b"BarrierAborted: server shutting down")
             self._event("barrier_aborted_on_shutdown",
                         waiters=len(waiters))
+        if self._health_watch is not None:
+            _obs.get_watchdog().unwatch(self._health_watch)
+            self._health_watch = None
         if self._monitor is not None:
             self._monitor_stop.set()
             self._monitor.join(timeout=5)
@@ -897,7 +932,8 @@ class PServerRuntime:
     def __init__(self, transpiler, endpoint, lookup_tables=None,
                  snapshot_dir=None, snapshot_every=1,
                  lease_timeout_s=None, allow_degraded=None,
-                 bind_endpoint=None, metrics_port=None):
+                 bind_endpoint=None, metrics_port=None,
+                 barrier_stall_s=120.0):
         from ..core.scope import Scope
         from ..executor import Executor
         from ..framework import grad_var_name
@@ -935,7 +971,8 @@ class PServerRuntime:
             snapshot_fn=self._snapshot_shard
             if self._snap is not None else None,
             snapshot_every=snapshot_every,
-            restore_meta=restore_meta)
+            restore_meta=restore_meta,
+            barrier_stall_s=barrier_stall_s)
         # optional process-wide Prometheus /metrics export thread
         # (observability.export); one per pserver process
         self.metrics_server = None

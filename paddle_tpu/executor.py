@@ -721,6 +721,14 @@ class Executor:
         # entry per dispatch) backing telemetry()'s percentiles
         self._step_seconds = 0.0
         self._step_times = collections.deque(maxlen=2048)
+        # health-plane progress beacon: bumped once per COMPLETED
+        # dispatch (_note_dispatch). _dispatch_count increments before
+        # the jitted call, so "dispatch_count > dispatches_done" is
+        # the watchdog's work-in-flight signal — a wedged device
+        # dispatch (the bench-hang class) shows as a beacon that stops
+        # while that gap stays open.
+        self._beacon = _obs.Beacon("executor_dispatch")
+        self._dispatches_done = 0
         reg = _obs.registry()
         self._m_dispatch = reg.counter("executor_dispatches_total")
         self._m_compile = reg.counter("executor_compiles_total")
@@ -776,7 +784,31 @@ class Executor:
         with self._lock:
             self._step_seconds += dt
             self._step_times.append(dt / max(1, steps))
+            self._dispatches_done += 1
         self._h_dispatch.observe(dt)
+        self._beacon.bump()
+
+    def _note_dispatch_failed(self):
+        """A dispatch attempt that RAISED still settled: close the
+        started/done gap and bump the beacon, or one transient failure
+        would leave dispatch_inflight() stuck True (and the hang watch
+        primed for a false stall) for the process lifetime."""
+        with self._lock:
+            self._dispatches_done += 1
+        self._beacon.bump()
+
+    def dispatch_inflight(self) -> bool:
+        """True while a device dispatch has been issued but has not
+        completed — the health watchdog's pending signal for the
+        wedged-dispatch (bench-hang) class."""
+        with self._lock:
+            return self._dispatch_count > self._dispatches_done
+
+    @property
+    def dispatch_beacon(self):
+        """This Executor's progress beacon (one bump per completed
+        dispatch) — what GuardedTrainer's hang watch reads."""
+        return self._beacon
 
     def _note_compile(self, entry, shape_sig):
         """Registry + journal accounting for one fresh (program,
@@ -982,14 +1014,23 @@ class Executor:
             self._dispatch_count += 1
         self._m_dispatch.inc()
         self._m_steps.inc(iters)
-        base_key = jax.random.fold_in(self._base_key(program), counter)
-        with _profiler.RecordEvent("feed_h2d"):
-            feed_vals = {k: jnp.asarray(v)
-                         if not isinstance(v, jax.Array) else v
-                         for k, v in feed.items()}
-        t0 = time.perf_counter()
-        with _profiler.RecordEvent("executor_run_repeated"):
-            fetches, persist_out = fn(persist_in, feed_vals, base_key)
+        # the failed-settlement guard covers EVERYTHING after the
+        # count increment (feed conversion included), or an exception
+        # in between leaves dispatch_inflight() stuck True forever
+        try:
+            base_key = jax.random.fold_in(self._base_key(program),
+                                          counter)
+            with _profiler.RecordEvent("feed_h2d"):
+                feed_vals = {k: jnp.asarray(v)
+                             if not isinstance(v, jax.Array) else v
+                             for k, v in feed.items()}
+            t0 = time.perf_counter()
+            with _profiler.RecordEvent("executor_run_repeated"):
+                fetches, persist_out = fn(persist_in, feed_vals,
+                                          base_key)
+        except BaseException:
+            self._note_dispatch_failed()
+            raise
         self._note_dispatch(time.perf_counter() - t0, iters)
         for name, val in persist_out.items():
             scope.set_var(name, val)
@@ -1183,15 +1224,27 @@ class Executor:
             self._dispatch_count += 1
         self._m_dispatch.inc()
         self._m_steps.inc(iters)
-        base_key = self._base_key(program)
-        idxs = jnp.asarray(np.arange(counter, counter + iters,
-                                     dtype=np.int32))
+        try:
+            base_key = self._base_key(program)
+            idxs = jnp.asarray(np.arange(counter, counter + iters,
+                                         dtype=np.int32))
+        except BaseException:
+            # anything between the count increment and the dispatch
+            # settling must close the in-flight gap (see
+            # _note_dispatch_failed); the fn calls below carry their
+            # own guards
+            self._note_dispatch_failed()
+            raise
         t_dispatch = time.perf_counter()
         with _profiler.RecordEvent("scan_dispatch",
                                    args={"steps": int(iters)}):
             if not compiling:
-                fetches, persist_out = fn(persist_in, chunk_vals,
-                                          idxs, base_key)
+                try:
+                    fetches, persist_out = fn(persist_in, chunk_vals,
+                                              idxs, base_key)
+                except BaseException:
+                    self._note_dispatch_failed()
+                    raise
             else:
                 # The feed chunk rarely aliases an output (fetches
                 # are scalars), so XLA warns its donation "was not
@@ -1206,31 +1259,42 @@ class Executor:
                 # so the window is confined to this one-off compile
                 # call — steady-state dispatches touch no warning
                 # machinery.
-                import re
-                import warnings
+                # the settlement guard spans the WHOLE branch: the
+                # warning replay below can itself raise (process runs
+                # warnings-as-errors) after fn() succeeded, and that
+                # exit too must close the in-flight gap
+                try:
+                    import re
+                    import warnings
 
-                def _aval(v):
-                    return "%s[%s]" % (v.dtype, ",".join(
-                        str(d) for d in v.shape))
+                    def _aval(v):
+                        return "%s[%s]" % (v.dtype, ",".join(
+                            str(d) for d in v.shape))
 
-                chunk_avals = {_aval(v) for v in chunk_vals.values()}
-                persist_avals = {
-                    _aval(v) for v in persist_in.values()
-                    if hasattr(v, "shape") and hasattr(v, "dtype")}
-                with warnings.catch_warnings(record=True) as caught:
-                    warnings.simplefilter("always")
-                    fetches, persist_out = fn(persist_in, chunk_vals,
-                                              idxs, base_key)
-                for w in caught:
-                    msg = str(w.message)
-                    if "donated buffers were not usable" in msg:
-                        named = set(re.findall(
-                            r"ShapedArray\(([^)]+)\)", msg))
-                        if named and named <= chunk_avals \
-                                and not named & persist_avals:
-                            continue  # feed-chunk-only: expected
-                    warnings.warn_explicit(w.message, w.category,
-                                           w.filename, w.lineno)
+                    chunk_avals = {_aval(v)
+                                   for v in chunk_vals.values()}
+                    persist_avals = {
+                        _aval(v) for v in persist_in.values()
+                        if hasattr(v, "shape") and hasattr(v, "dtype")}
+                    with warnings.catch_warnings(record=True) \
+                            as caught:
+                        warnings.simplefilter("always")
+                        fetches, persist_out = fn(persist_in,
+                                                  chunk_vals,
+                                                  idxs, base_key)
+                    for w in caught:
+                        msg = str(w.message)
+                        if "donated buffers were not usable" in msg:
+                            named = set(re.findall(
+                                r"ShapedArray\(([^)]+)\)", msg))
+                            if named and named <= chunk_avals \
+                                    and not named & persist_avals:
+                                continue  # feed-chunk-only: expected
+                        warnings.warn_explicit(w.message, w.category,
+                                               w.filename, w.lineno)
+                except BaseException:
+                    self._note_dispatch_failed()
+                    raise
         self._note_dispatch(time.perf_counter() - t_dispatch, iters)
         for name, val in persist_out.items():
             scope.set_var(name, val)
@@ -1497,25 +1561,34 @@ class Executor:
             self._dispatch_count += 1
         self._m_dispatch.inc()
         self._m_steps.inc()
-        step_key = jax.random.fold_in(self._base_key(program), counter)
-
-        with _profiler.RecordEvent("feed_h2d"):
-            if dist is not None:
-                feed_vals = {
-                    k: jax.device_put(v,
-                                      dist.feed_sharding(np.shape(v),
-                                                         k))
-                    for k, v in feed.items()}
-            else:
-                feed_vals = {k: jnp.asarray(v)
-                             if not isinstance(v, jax.Array) else v
-                             for k, v in feed.items()}
-        # first invocation of a jitted step traces + compiles
-        span = "executor_trace_compile" if compiled_here \
-            else "executor_run"
-        t0 = time.perf_counter()
-        with _profiler.RecordEvent(span):
-            fetches, persist_out = fn(persist_in, feed_vals, step_key)
+        # the failed-settlement guard covers EVERYTHING after the
+        # count increment (feed conversion/device_put included), or
+        # an exception in between leaves dispatch_inflight() stuck
+        # True forever
+        try:
+            step_key = jax.random.fold_in(self._base_key(program),
+                                          counter)
+            with _profiler.RecordEvent("feed_h2d"):
+                if dist is not None:
+                    feed_vals = {
+                        k: jax.device_put(
+                            v, dist.feed_sharding(np.shape(v), k))
+                        for k, v in feed.items()}
+                else:
+                    feed_vals = {k: jnp.asarray(v)
+                                 if not isinstance(v, jax.Array)
+                                 else v
+                                 for k, v in feed.items()}
+            # first invocation of a jitted step traces + compiles
+            span = "executor_trace_compile" if compiled_here \
+                else "executor_run"
+            t0 = time.perf_counter()
+            with _profiler.RecordEvent(span):
+                fetches, persist_out = fn(persist_in, feed_vals,
+                                          step_key)
+        except BaseException:
+            self._note_dispatch_failed()
+            raise
         self._note_dispatch(time.perf_counter() - t0, 1)
 
         for name, val in persist_out.items():

@@ -28,6 +28,10 @@ from __future__ import annotations
 import contextlib
 
 from .export import MetricsServer, start_metrics_server  # noqa: F401
+from .health import (Beacon, FlightRecorder, HealthRule,  # noqa: F401
+                     Watchdog, arm_process, beacon,
+                     beacons_snapshot, default_rules, get_recorder,
+                     get_watchdog, healthz, set_blackbox_dir)
 from .journal import (clear as clear_journal,  # noqa: F401
                       configure as configure_journal,
                       emit, events as journal_events, get_role,
@@ -44,6 +48,9 @@ __all__ = [
     "span", "attach", "current_span", "new_trace_id", "new_span_id",
     "wire_token", "parse_wire_token",
     "MetricsServer", "start_metrics_server", "disabled",
+    "Beacon", "beacon", "beacons_snapshot", "HealthRule", "Watchdog",
+    "FlightRecorder", "get_watchdog", "get_recorder",
+    "set_blackbox_dir", "arm_process", "default_rules", "healthz",
 ]
 
 

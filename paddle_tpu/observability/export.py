@@ -7,7 +7,10 @@ serving:
     version 0.0.4 — scrapeable by any Prometheus/agent);
   - ``GET /journal``  -> the in-memory event ring as JSON (newest
     last) — a poor-man's debug endpoint for seam debugging;
-  - ``GET /healthz``  -> 200 ok.
+  - ``GET /healthz``  -> the health plane's machine-readable verdict
+    (health.healthz()): JSON body with state/problems/watches, 200
+    while healthy/degraded (or "unknown" when no watchdog is armed),
+    503 on an unhealthy verdict — a scraper or LB can act on it.
 
 Usable by serving engines (``ServingEngine(metrics_port=...)``) and
 pservers (``PServerRuntime(metrics_port=...)``) or standalone; one
@@ -21,6 +24,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from . import health as _health
 from . import journal as _journal
 from .registry import registry
 
@@ -30,6 +34,7 @@ __all__ = ["MetricsServer", "start_metrics_server"]
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server contract
         path = self.path.split("?", 1)[0]
+        code = 200
         if path == "/metrics":
             body = registry().prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -38,12 +43,14 @@ class _Handler(BaseHTTPRequestHandler):
                               default=repr).encode()
             ctype = "application/json"
         elif path == "/healthz":
-            body, ctype = b"ok\n", "text/plain"
+            code, verdict = _health.healthz()
+            body = (json.dumps(verdict, default=repr) + "\n").encode()
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -39,11 +39,15 @@ _SEQ = 0
 _ROLE: Optional[str] = None
 _SINK = None
 _SINK_PATH: Optional[str] = None
+_SINK_MAX_BYTES: Optional[int] = None
+_SINK_BYTES = 0
 _ENV_CHECKED = False
 _ENABLED = True
 
 ENV_JOURNAL = "PADDLE_TPU_EVENT_JOURNAL"
+ENV_JOURNAL_MAX_BYTES = "PADDLE_TPU_EVENT_JOURNAL_MAX_BYTES"
 ENV_ROLE = "PADDLE_TPU_ROLE"
+ROTATED_SUFFIX = ".1"
 
 
 def set_role(role: Optional[str]):
@@ -59,10 +63,17 @@ def get_role() -> str:
     return role if role else "pid-%d" % os.getpid()
 
 
-def configure(path: Optional[str] = None, capacity: Optional[int] = None):
+def configure(path: Optional[str] = None, capacity: Optional[int] = None,
+              max_bytes: Optional[int] = None):
     """Set (or with ``path=None`` close) the JSONL sink; optionally
-    resize the in-memory ring. Returns the active sink path."""
+    resize the in-memory ring. ``max_bytes`` arms keep-one size-based
+    rotation: when the sink file exceeds it, it is renamed to
+    ``<path>.1`` (replacing any previous ``.1``) and a fresh file is
+    opened — long fleet runs can't grow the journal unboundedly, and
+    ``read_journal`` stitches the rotated file back in. Returns the
+    active sink path."""
     global _SINK, _SINK_PATH, _RING, _ENV_CHECKED
+    global _SINK_MAX_BYTES, _SINK_BYTES
     with _MU:
         _ENV_CHECKED = True  # explicit config wins over the env var
         if _SINK is not None:
@@ -71,18 +82,51 @@ def configure(path: Optional[str] = None, capacity: Optional[int] = None):
             except Exception:
                 pass
             _SINK, _SINK_PATH = None, None
+        _SINK_MAX_BYTES = int(max_bytes) if max_bytes else None
         if path:
-            d = os.path.dirname(path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            # line-buffered append: each event is one durable-ish line,
-            # and a crashed process leaves at worst one torn tail line
-            # (read_journal skips it)
-            _SINK = open(path, "a", buffering=1)
-            _SINK_PATH = path
+            _open_sink_locked(path)
         if capacity is not None:
             _RING = collections.deque(_RING, maxlen=int(capacity))
         return _SINK_PATH
+
+
+def _open_sink_locked(path):
+    """Open the JSONL sink (caller holds _MU): line-buffered append —
+    each event is one durable-ish line, and a crashed process leaves
+    at worst one torn tail line (read_journal skips it)."""
+    global _SINK, _SINK_PATH, _SINK_BYTES
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    _SINK = open(path, "a", buffering=1)
+    _SINK_PATH = path
+    try:
+        _SINK_BYTES = os.path.getsize(path)
+    except OSError:
+        _SINK_BYTES = 0
+
+
+def _rotate_sink_locked():
+    """Keep-one rotation (caller holds _MU): the full file becomes
+    ``<path>.1`` and a fresh sink opens at the same path. Rotation
+    failures (exotic filesystems) degrade to append-forever rather
+    than crash an emitter."""
+    global _SINK, _SINK_MAX_BYTES
+    path = _SINK_PATH
+    try:
+        _SINK.close()
+    except Exception:
+        pass
+    _SINK = None
+    try:
+        os.replace(path, path + ROTATED_SUFFIX)
+    except OSError:
+        # a filesystem that cannot rename would otherwise re-trigger
+        # rotation (close+rename+open) on EVERY subsequent emit, since
+        # the reopened file is still over the bound — disarm and
+        # append forever, as documented
+        _SINK_MAX_BYTES = None
+    _open_sink_locked(path)
 
 
 def sink_path() -> Optional[str]:
@@ -92,7 +136,7 @@ def sink_path() -> Optional[str]:
 
 def _check_env():
     """First-emit lazy pickup of the launcher-stamped journal path."""
-    global _ENV_CHECKED, _SINK, _SINK_PATH
+    global _ENV_CHECKED, _SINK_MAX_BYTES
     if _ENV_CHECKED:
         return
     with _MU:
@@ -100,12 +144,14 @@ def _check_env():
             return
         _ENV_CHECKED = True
         path = os.environ.get(ENV_JOURNAL)
+        try:
+            mb = int(os.environ.get(ENV_JOURNAL_MAX_BYTES, "0"))
+        except ValueError:
+            mb = 0
+        if mb > 0:
+            _SINK_MAX_BYTES = mb
         if path:
-            d = os.path.dirname(path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            _SINK = open(path, "a", buffering=1)
-            _SINK_PATH = path
+            _open_sink_locked(path)
 
 
 def set_enabled(on: bool):
@@ -129,13 +175,19 @@ def emit(kind: str, **fields) -> Optional[dict]:
     # ONE critical section for seq assignment + ring/sink append, so
     # the journal's on-disk order IS its seq (causal) order even under
     # concurrent emitters
+    global _SINK_BYTES
     with _MU:
         _SEQ += 1
         ev["seq"] = _SEQ
         _RING.append(ev)
         if _SINK is not None:
             try:
-                _SINK.write(json.dumps(ev, default=repr) + "\n")
+                line = json.dumps(ev, default=repr) + "\n"
+                _SINK.write(line)
+                _SINK_BYTES += len(line)
+                if _SINK_MAX_BYTES is not None \
+                        and _SINK_BYTES > _SINK_MAX_BYTES:
+                    _rotate_sink_locked()
             except Exception:
                 pass  # a full disk must not take training down
     return ev
@@ -161,17 +213,24 @@ def clear():
         _RING.clear()
 
 
-def read_journal(path: str) -> List[dict]:
+def read_journal(path: str, include_rotated: bool = True) -> List[dict]:
     """Parse one JSONL journal file; malformed lines (torn tail of a
-    killed process) are skipped, not fatal."""
+    killed process) are skipped, not fatal. When a rotated sibling
+    (``<path>.1``, size-based keep-one rotation) exists it is
+    stitched in FIRST, so callers see one contiguous seq-ordered
+    stream."""
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except ValueError:
-                continue
+    paths = [path]
+    if include_rotated and os.path.exists(path + ROTATED_SUFFIX):
+        paths.insert(0, path + ROTATED_SUFFIX)
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
     return out

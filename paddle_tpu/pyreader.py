@@ -76,6 +76,12 @@ def _prefetch_build_chunk(buf, device_put, counters, lock):
     with lock:
         counters["h2d_s"] += dt
     _profiler.bump_counter("chunk_h2d_s", dt)
+    # health-plane progress: a silent prefetch beacon while the
+    # consumer stalls is the "input pipeline wedged" signature the
+    # flight recorder / doctor read (module-level beacon on purpose —
+    # the pump thread must hold no reference to the prefetcher)
+    from .observability import beacon as _beacon
+    _beacon("prefetch_chunks").bump()
     return chunk
 
 

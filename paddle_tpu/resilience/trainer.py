@@ -69,13 +69,22 @@ class GuardedTrainer:
         re-triggering aborts once it is spent.
     retry : RetryPolicy for transient dispatch failures.
     faults : optional resilience.faults.FaultInjector (chaos testing).
+    hang_deadline_s : health-plane stall deadline — while training, a
+        device dispatch in flight with no completion for this long
+        gets an unhealthy watchdog verdict (journal ``health`` event +
+        blackbox dump when a dump dir is armed): the silent
+        backend-hang class no retry policy can see, because the
+        dispatch call never returns. Generous by default so a cold
+        multi-minute XLA compile is never misread as a wedge; None
+        disables. A ``TrainingAborted`` also dumps the black box.
     """
 
     def __init__(self, executor, program, loss, startup_program=None,
                  scope=None, checkpoint_dir=None, checkpoint_every=0,
                  max_to_keep=3, rollback_after=3, max_rollbacks=2,
                  retry: Optional[RetryPolicy] = None, faults=None,
-                 guard: bool = True, sync_saves: bool = False):
+                 guard: bool = True, sync_saves: bool = False,
+                 hang_deadline_s: Optional[float] = 900.0):
         from .. import io as io_mod
         from ..core.scope import global_scope
         self._exe = executor
@@ -116,6 +125,8 @@ class GuardedTrainer:
         self._retry = retry or RetryPolicy()
         self._faults = faults
         self._sync_saves = bool(sync_saves)
+        self._hang_deadline_s = hang_deadline_s
+        self._health_watch = None
         # -- structured summary state -----------------------------------
         self._steps_run = 0
         self._retries = 0
@@ -141,36 +152,44 @@ class GuardedTrainer:
         replayable = isinstance(feeds, (list, tuple))
         if not replayable:
             feeds = iter(feeds)
-        self._ensure_initial_checkpoint()
-        fetch = list(fetch_list) if fetch_list else [self._loss]
-        cursor = 0
-        while True:
-            if replayable:
-                if cursor >= len(feeds):
-                    break
-                feed = feeds[cursor]
-            else:
+        self._arm_hang_watch()
+        try:
+            self._ensure_initial_checkpoint()
+            fetch = list(fetch_list) if fetch_list else [self._loss]
+            cursor = 0
+            while True:
+                if replayable:
+                    if cursor >= len(feeds):
+                        break
+                    feed = feeds[cursor]
+                else:
+                    try:
+                        feed = next(feeds)
+                    except StopIteration:
+                        break
+                step = self._steps_run
+                if self._faults is not None:
+                    feed = self._faults.mutate_feed(step, feed)
                 try:
-                    feed = next(feeds)
-                except StopIteration:
-                    break
-            step = self._steps_run
-            if self._faults is not None:
-                feed = self._faults.mutate_feed(step, feed)
-            try:
-                fetches = self._dispatch(step, feed, fetch)
-            except RetryBudgetExhausted as e:
-                self._abort("retry budget exhausted at step %d: %s"
-                            % (step, e), cause=e)
-            self._record_loss(fetches)
-            self._steps_run += 1
-            cursor += 1
-            before = self._steps_run
-            restored = self._maybe_rollback()
-            if restored is not None and replayable:
-                cursor = max(0, cursor - (before - restored))
-            self._maybe_checkpoint(self._steps_run)
-        self._finalize()
+                    fetches = self._dispatch(step, feed, fetch)
+                except RetryBudgetExhausted as e:
+                    self._abort("retry budget exhausted at step %d: %s"
+                                % (step, e), cause=e)
+                self._record_loss(fetches)
+                self._steps_run += 1
+                cursor += 1
+                before = self._steps_run
+                restored = self._maybe_rollback()
+                if restored is not None and replayable:
+                    cursor = max(0, cursor - (before - restored))
+                self._maybe_checkpoint(self._steps_run)
+            self._finalize()
+        finally:
+            # every exit path — including non-transient dispatch
+            # errors retry_call re-raises directly — must disarm, or
+            # the leaked watch turns into a guaranteed false stall on
+            # the process watchdog once training stops
+            self._disarm_hang_watch()
         return self.summary()
 
     def train_repeated(self, feed, iters, chunk=None, fetch_list=None):
@@ -183,37 +202,41 @@ class GuardedTrainer:
         so a fully-poisoned chunk is caught before a second one
         dispatches."""
         enforce(int(iters) >= 1, "train_repeated needs iters >= 1")
-        self._ensure_initial_checkpoint()
-        fetch = list(fetch_list) if fetch_list else [self._loss]
-        chunk = int(chunk or max(1, self._rollback_after or 8))
-        remaining = int(iters)
-        while remaining > 0:
-            k = min(chunk, remaining)
-            step = self._steps_run
+        self._arm_hang_watch()
+        try:
+            self._ensure_initial_checkpoint()
+            fetch = list(fetch_list) if fetch_list else [self._loss]
+            chunk = int(chunk or max(1, self._rollback_after or 8))
+            remaining = int(iters)
+            while remaining > 0:
+                k = min(chunk, remaining)
+                step = self._steps_run
 
-            def run_chunk():
-                if self._faults is not None:
-                    self._faults.before_dispatch(step)
-                return self._exe.run_repeated(
-                    self._program, feed=feed, fetch_list=fetch,
-                    iters=k, scope=self._scope)
+                def run_chunk():
+                    if self._faults is not None:
+                        self._faults.before_dispatch(step)
+                    return self._exe.run_repeated(
+                        self._program, feed=feed, fetch_list=fetch,
+                        iters=k, scope=self._scope)
 
-            try:
-                fetches, used = retry_call(run_chunk, self._retry,
-                                           on_retry=self._on_retry)
-                self._retries += used
-            except RetryBudgetExhausted as e:
-                self._abort("retry budget exhausted at step %d: %s"
-                            % (step, e), cause=e)
-            self._record_loss(fetches)
-            self._steps_run += k
-            remaining -= k
-            before = self._steps_run
-            restored = self._maybe_rollback()
-            if restored is not None:
-                remaining += before - restored
-            self._maybe_checkpoint(self._steps_run)
-        self._finalize()
+                try:
+                    fetches, used = retry_call(run_chunk, self._retry,
+                                               on_retry=self._on_retry)
+                    self._retries += used
+                except RetryBudgetExhausted as e:
+                    self._abort("retry budget exhausted at step %d: %s"
+                                % (step, e), cause=e)
+                self._record_loss(fetches)
+                self._steps_run += k
+                remaining -= k
+                before = self._steps_run
+                restored = self._maybe_rollback()
+                if restored is not None:
+                    remaining += before - restored
+                self._maybe_checkpoint(self._steps_run)
+            self._finalize()
+        finally:
+            self._disarm_hang_watch()  # see train(): no leaked watch
         return self.summary()
 
     def train_from_dataset(self, dataset, fetch_list=None):
@@ -252,6 +275,27 @@ class GuardedTrainer:
         }
 
     # -- internals -----------------------------------------------------
+    def _arm_hang_watch(self):
+        """Arm the wedged-dispatch watch on the process watchdog: the
+        executor's dispatch beacon must keep bumping while a dispatch
+        is in flight. Pending is THIS executor's in-flight gap, so two
+        trainers' executors never mask each other's wedge."""
+        if self._hang_deadline_s is None or \
+                self._health_watch is not None:
+            return
+        exe = self._exe
+        if not hasattr(exe, "dispatch_beacon"):
+            return
+        self._health_watch = _obs.get_watchdog().watch(
+            "guarded_dispatch", beacon=exe.dispatch_beacon,
+            deadline_s=self._hang_deadline_s,
+            pending_fn=exe.dispatch_inflight)
+
+    def _disarm_hang_watch(self):
+        if self._health_watch is not None:
+            _obs.get_watchdog().unwatch(self._health_watch)
+            self._health_watch = None
+
     def _dispatch(self, step, feed, fetch):
         def run_once():
             if self._faults is not None:
@@ -373,6 +417,7 @@ class GuardedTrainer:
             self._save_failures += 1
 
     def _finalize(self):
+        self._disarm_hang_watch()
         if self._saver is not None:
             self._save(self._steps_run, sync=True)
             self._saver.wait_quietly()
@@ -380,12 +425,21 @@ class GuardedTrainer:
                 self._save_failures += 1
 
     def _abort(self, reason, cause=None):
+        self._disarm_hang_watch()
         if self._saver is not None:
             self._save(self._steps_run, sync=True)
         self._aborted = reason
         _obs.emit("training_aborted", reason=reason,
                   step=self._steps_run)
         err = TrainingAborted(reason, self.summary())
+        # fatal-error black box: the abort report plus thread stacks /
+        # journal tail / metric tail, when a dump dir is armed
+        try:
+            _obs.get_recorder().dump(
+                "training_aborted", extra={"reason": reason,
+                                           "step": self._steps_run})
+        except Exception:
+            pass
         if cause is not None:
             raise err from cause
         raise err

@@ -111,6 +111,11 @@ class ServingConfig:
     - ``warmup``: pre-compile every bucket at load so no client request
       ever pays a cold XLA compile.
     - ``latency_window``: ring size for percentile/QPS estimation.
+    - ``hang_deadline_s``: health-plane stall deadline — a batcher
+      that makes no progress for this long WHILE requests are queued
+      or in flight gets an unhealthy watchdog verdict (journal
+      ``health`` event, ``health_state`` gauge, blackbox dump when a
+      dump dir is armed). None disables the watch.
     """
 
     max_batch_size: int = 64
@@ -119,6 +124,7 @@ class ServingConfig:
     default_deadline_ms: Optional[float] = None
     warmup: bool = True
     latency_window: int = 4096
+    hang_deadline_s: Optional[float] = 30.0
 
 
 class _Request:
@@ -183,6 +189,19 @@ class _ModelWorker:
         self.warmed_buckets: List[int] = []
         if config.warmup:
             self._warmup()
+        # health plane: one bump per batcher-loop unit of progress; a
+        # silent beacon with work queued/in flight is the wedged
+        # batcher the watchdog exists to catch (a DEAD batcher already
+        # fails its clients via BatcherDied — this is for the one that
+        # neither dies nor dispatches)
+        self._beacon = _obs.Beacon("serving_batcher/%s" % name)
+        self._health_watch = None
+        if config.hang_deadline_s is not None:
+            self._health_watch = _obs.get_watchdog().watch(
+                "serving_batcher/%s" % name, beacon=self._beacon,
+                deadline_s=config.hang_deadline_s,
+                pending_fn=lambda: bool(self._queue)
+                or bool(self._inflight))
         self._thread = threading.Thread(
             target=self._batcher_loop, daemon=True,
             name="serving-batcher-%s" % name)
@@ -440,17 +459,25 @@ class _ModelWorker:
                 # futures (_dispatch resolves every future on both its
                 # success and its per-batch failure paths)
                 self._inflight = batch
+                self._beacon.bump()  # progress: a batch formed
                 self._dispatch(batch)
                 self._inflight = []
+                self._beacon.bump()  # progress: the batch resolved
         except BaseException as e:  # noqa: B036 — a dying batcher
             # must fail its clients, whatever killed it
             self._die(e)
+
+    def _unwatch(self):
+        if self._health_watch is not None:
+            _obs.get_watchdog().unwatch(self._health_watch)
+            self._health_watch = None
 
     def _die(self, exc):
         err = BatcherDied(
             "batcher thread for model %r died: %r" % (self.name, exc),
             model=self.name, cause=repr(exc))
         _obs.emit("batcher_died", model=self.name, cause=repr(exc))
+        self._unwatch()  # the death is already structured evidence
         self._dead_error = err
         with self._cond:
             self._stopped = True
@@ -464,6 +491,7 @@ class _ModelWorker:
 
     # -- lifecycle -----------------------------------------------------
     def shutdown(self, drain=True, timeout: Optional[float] = None):
+        self._unwatch()
         with self._cond:
             self._stopped = True
             pending = [] if drain else list(self._queue)

@@ -391,6 +391,10 @@ def serve_main(argv=None):
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    # health plane: watchdog (the engine's batcher watches are already
+    # armed) + flight recorder. The SIGTERM chain dumps the black box
+    # FIRST, then falls through to the graceful stop handler above.
+    _obs.arm_process(signals=True)
     # parent closes our stdin to stop us (portable even when signals
     # are swallowed by a shell wrapper)
     def stdin_watch():

@@ -103,6 +103,11 @@ class RouterConfig:
       dead replica into a retryable error instead of a hang.
     - ``max_concurrency``: dispatch worker threads (each blocked
       request occupies one).
+    - ``hang_deadline_s``: health-plane stall deadline — a router
+      with pending requests and NO completions for this long gets an
+      unhealthy watchdog verdict (every per-attempt failure path has
+      its own deadline, so this firing means the dispatch machinery
+      itself is wedged). None disables the watch.
     """
 
     policy: str = "least_loaded"
@@ -123,6 +128,7 @@ class RouterConfig:
     max_concurrency: int = 32
     router_id: int = 0
     latency_window: int = 4096
+    hang_deadline_s: Optional[float] = 120.0
 
 
 class _Replica:
@@ -248,6 +254,17 @@ class ServingRouter:
         if metrics_port is not None:
             self.metrics_server = _obs.start_metrics_server(
                 port=metrics_port)
+        # health plane: one bump per completed/failed request; pending
+        # futures with a silent beacon = wedged dispatch machinery
+        self._beacon = _obs.Beacon(
+            "router_dispatch/%d" % self.config.router_id)
+        self._health_watch = None
+        if self.config.hang_deadline_s is not None:
+            self._health_watch = _obs.get_watchdog().watch(
+                "router_dispatch/%d" % self.config.router_id,
+                beacon=self._beacon,
+                deadline_s=self.config.hang_deadline_s,
+                pending_fn=lambda: self._pending > 0)
         # lease monitors: one thread + dedicated client per replica
         # (PR 5's HeartbeatThread shape — a shared thread would park a
         # healthy replica's probe behind a dead one's connect stall)
@@ -328,6 +345,7 @@ class ServingRouter:
             self._pending -= 1
             self._counts[outcome] += 1
         self._m_requests[outcome].inc()
+        self._beacon.bump()
 
     def infer_sync(self, feed, model=None, deadline_ms=None,
                    timeout: Optional[float] = None):
@@ -599,6 +617,9 @@ class ServingRouter:
 
     def shutdown(self, timeout: Optional[float] = 10.0):
         self._stopped = True
+        if self._health_watch is not None:
+            _obs.get_watchdog().unwatch(self._health_watch)
+            self._health_watch = None
         self._hb_stop.set()
         for t in self._hb_threads:
             t.join(timeout=timeout)

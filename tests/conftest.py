@@ -57,6 +57,12 @@ def pytest_configure(config):
         "markers",
         "pipeline: pipelined data-fed training test (tier-1; select "
         "alone with -m pipeline)")
+    # health-plane suite (observability/health.py watchdog + flight
+    # recorder + doctor): CPU-fast, runs inside tier-1
+    config.addinivalue_line(
+        "markers",
+        "health: fleet health-plane test (tier-1; select alone with "
+        "-m health)")
 
 
 @pytest.fixture(autouse=True)

@@ -249,8 +249,15 @@ class TestMetricsServer:
             j = json.loads(urllib.request.urlopen(
                 srv.url + "/journal").read().decode())
             assert any(e["kind"] == "test_http_event" for e in j)
-            assert urllib.request.urlopen(
-                srv.url + "/healthz").read() == b"ok\n"
+            # /healthz is the health plane's machine-readable verdict
+            # now (observability/health.py): JSON state, 200 unless
+            # an armed watchdog reports unhealthy
+            hz = urllib.request.urlopen(srv.url + "/healthz")
+            assert hz.status == 200
+            verdict = json.loads(hz.read().decode())
+            assert verdict["state"] in ("unknown", "healthy",
+                                        "degraded")
+            assert "role" in verdict
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(srv.url + "/nope")
 

@@ -214,6 +214,45 @@ def _journal_kinds(since_seq):
     return {e["kind"] for e in obs.journal_events(since_seq=since_seq)}
 
 
+# what tools/doctor.py must NAME for each injected fault — a chaos
+# scenario is only fully green when it is survivable AND diagnosable
+# (--verdict doctor folds the match into the exit code)
+DOCTOR_EXPECT = {
+    "pserver_restart": ("pserver_restart",),
+    "trainer_kill": ("trainer_eviction",),
+    "drop30": ("network_flaky",),
+    "restart_2x2_obs": ("pserver_restart",),
+    "serving_kill": ("replica_failure",),
+}
+
+
+def _doctor_verdict(scenario, events=None, journal_path=None):
+    """Run the offline auto-diagnosis over this scenario's journal
+    (file sink, or the in-memory ring tail for sink-less scenarios)
+    and report whether doctor NAMED the injected fault."""
+    import doctor
+    try:
+        if events is None:
+            from paddle_tpu import observability as obs
+            events = obs.read_journal(journal_path)
+        rep = doctor.diagnose(events)
+    except Exception as e:
+        return {"top": None, "match": False, "error": repr(e),
+                "expected": list(DOCTOR_EXPECT.get(scenario, ()))}
+    expect = DOCTOR_EXPECT.get(scenario, ())
+    d0 = rep["diagnoses"][0] if rep["diagnoses"] else None
+    return {"top": rep["top"], "expected": list(expect),
+            "match": rep["top"] in expect,
+            "summary": d0 and d0["summary"],
+            "evidence": d0 and d0["evidence"][:6],
+            "ranked": [d["name"] for d in rep["diagnoses"]]}
+
+
+def _journal_events_since(mark):
+    from paddle_tpu import observability as obs
+    return obs.journal_events(since_seq=mark)
+
+
 def _scenario_pserver_restart(args):
     import threading
     import time
@@ -268,6 +307,9 @@ def _scenario_pserver_restart(args):
             "max_loss_trace_diff": diff,
             "journal_kinds": sorted(kinds),
             "journal_ok": journal_ok,
+            "doctor": _doctor_verdict(
+                "pserver_restart",
+                events=_journal_events_since(mark)),
             "losses": res[0], "fault_free_losses": clean}
 
 
@@ -302,6 +344,8 @@ def _scenario_trainer_kill(args):
             "survivor_steps": len(res.get(0, [])),
             "evicted": [e["tid"] for e in evicted],
             "journal_ok": journal_ok,
+            "doctor": _doctor_verdict(
+                "trainer_kill", events=_journal_events_since(mark)),
             "errors": {k: repr(v) for k, v in errs.items()}}
 
 
@@ -316,6 +360,7 @@ def _scenario_drop30(args):
     if errs:
         return {"ok": False, "error": repr(errs)}
     clean = res[0]
+    mark = _journal_watermark()
 
     proxies = []
 
@@ -342,6 +387,8 @@ def _scenario_drop30(args):
     diff = float(np.max(np.abs(np.asarray(res[0]) - np.asarray(clean))))
     return {"ok": dropped > 0 and diff < 1e-5 and elapsed < 180.0,
             "elapsed_s": round(elapsed, 2), "frames_dropped": dropped,
+            "doctor": _doctor_verdict(
+                "drop30", events=_journal_events_since(mark)),
             "max_loss_trace_diff": diff}
 
 
@@ -395,9 +442,16 @@ def _scenario_restart_2x2_obs(args):
                for i, ep in enumerate(t.pserver_endpoints)]
     proxies = []
     restarted = []
+    # drop_rate is overridable (tests run the kill+restart without
+    # wire drop): under an unlucky drop pattern the two trainers'
+    # barrier replays can phase-lock into a retry storm that blows
+    # the whole budget — a pre-existing metastability of THIS
+    # scenario, fault class network_flaky, not the restart path under
+    # test. The 5% default stays for the CLI chaos suite.
+    drop_rate = getattr(args, "drop_rate", 0.05)
     for i, s in enumerate(servers):
         p = NetFaultProxy(s.serv.endpoint, seed=args.seed + i)
-        p.set_drop_rate(0.05)
+        p.set_drop_rate(drop_rate)
         proxies.append(p)
         t.set_block_endpoints(s._minis.keys(), p.endpoint)
         s.serv.start()
@@ -505,6 +559,8 @@ def _scenario_restart_2x2_obs(args):
           and elapsed < 300.0)
     return {"ok": ok, "elapsed_s": round(elapsed, 2),
             "kill_fired": bool(restarted),
+            "doctor": _doctor_verdict("restart_2x2_obs",
+                                      journal_path=journal_path),
             "trace_links": report["links"],
             "clock_offsets_s": report["offsets_s"],
             "merged_trace": merged_path,
@@ -630,6 +686,8 @@ def _scenario_serving_kill(args):
           and report["links"] > 0
           and p99 is not None and p99 < 5000.0)
     return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "doctor": _doctor_verdict("serving_kill",
+                                      journal_path=journal_path),
             "completed": len(lat_ms),
             "p99_ms": round(p99, 2) if p99 is not None else None,
             "structured_errors": sorted(set(structured)),
@@ -654,14 +712,24 @@ DIST_SCENARIOS = {
 
 def run_distributed(args):
     report = {"distributed": True, "seed": args.seed,
-              "steps": args.steps, "scenarios": {}}
+              "steps": args.steps, "verdict": args.verdict,
+              "scenarios": {}}
     names = [args.scenario] if args.scenario else list(DIST_SCENARIOS)
     for name in names:
         try:
             report["scenarios"][name] = DIST_SCENARIOS[name](args)
         except Exception as e:
             report["scenarios"][name] = {"ok": False, "error": repr(e)}
-    report["ok"] = all(v.get("ok") for v in report["scenarios"].values())
+    ok = all(v.get("ok") for v in report["scenarios"].values())
+    if args.verdict == "doctor":
+        # survivable is not enough: doctor must NAME the injected
+        # fault as its top diagnosis for every scenario that ran
+        diagnosed = all(
+            (v.get("doctor") or {}).get("match")
+            for v in report["scenarios"].values())
+        report["diagnosed"] = diagnosed
+        ok = ok and diagnosed
+    report["ok"] = ok
     print(json.dumps(report, indent=2, default=str))
     sys.exit(0 if report["ok"] else 1)
 
@@ -696,6 +764,12 @@ def main():
     ap.add_argument("--scenario", choices=sorted(DIST_SCENARIOS),
                     default=None,
                     help="with --distributed: run just one scenario")
+    ap.add_argument("--verdict", choices=["survive", "doctor"],
+                    default="survive",
+                    help="with --distributed: 'doctor' additionally "
+                    "requires tools/doctor.py to name each injected "
+                    "fault as its top diagnosis (exit nonzero on a "
+                    "wrong/missing diagnosis)")
     args = ap.parse_args()
 
     if args.distributed:

@@ -1,0 +1,475 @@
+#!/usr/bin/env python
+"""Offline fleet auto-diagnosis: turn merged event journals (+
+optional blackbox dumps and /metrics snapshots) into a RANKED,
+evidence-cited root-cause verdict.
+
+The health plane's watchdog answers "is this process healthy NOW";
+doctor answers "what went wrong in this RUN" after the fact, from the
+artifacts every process already writes:
+
+  - event journals  (observability.journal — one JSONL per worker,
+    ``launch.py --journal_dir``; rotated siblings are stitched in)
+  - blackbox dumps  (observability.health.FlightRecorder —
+    ``blackbox.<role>.json`` written on SIGTERM / fatal error /
+    watchdog stall verdict)
+  - metrics         (a ``/metrics`` URL or saved exposition text, or
+    a ``registry().snapshot()`` JSON file)
+
+Every diagnosis cites its evidence as ``role@seq kind`` journal
+references, so a verdict is checkable against the raw record.
+
+Examples
+--------
+    # a launch.py fleet run
+    python tools/doctor.py --journal logs/events.trainer-0.jsonl \\
+        --journal logs/events.pserver-0.jsonl \\
+        --blackbox logs/blackbox.trainer-0.json
+
+    # CI gate: fail unless the expected fault is the top diagnosis
+    python tools/doctor.py --journal logs/events.jsonl \\
+        --expect pserver_restart
+
+``tools/chaos_run.py`` runs doctor over every chaos scenario's
+journal; ``--verdict doctor`` makes a wrong/missing diagnosis fail
+the chaos run.
+
+Exit code: 0, or 1 when ``--expect NAME[,NAME...]`` is given and the
+top diagnosis does not match.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# base rank per diagnosis kind: process-fatal wedges first, then
+# component deaths, then resource/perf trends. Evidence volume only
+# nudges within a kind (score = base + min(n_evidence, 20) * 0.1).
+_BASE_SCORE = {
+    "hang": 100.0,
+    "batcher_death": 92.0,
+    "trainer_eviction": 88.0,
+    "replica_failure": 86.0,
+    "pserver_restart": 84.0,
+    "recompile_storm": 70.0,
+    "training_anomaly": 65.0,
+    "network_flaky": 60.0,
+    "overload": 55.0,
+    "input_bound": 50.0,
+}
+
+
+def _cite(e: dict, *fields) -> dict:
+    """One evidence citation: role@seq + kind + the named fields."""
+    out = {"role": e.get("role"), "seq": e.get("seq"),
+           "kind": e.get("kind")}
+    for f in fields:
+        if f in e:
+            out[f] = e[f]
+    return out
+
+
+def _diag(name, summary, evidence, detail=None, confidence=1.0):
+    return {"name": name, "summary": summary,
+            "confidence": round(float(confidence), 2),
+            "detail": detail,
+            "evidence": evidence,
+            "score": round(_BASE_SCORE[name]
+                           + min(len(evidence), 20) * 0.1, 2)}
+
+
+# ---------------------------------------------------------------------------
+# detectors (each: events -> [diagnosis])
+# ---------------------------------------------------------------------------
+
+def _by_kind(events) -> Dict[str, List[dict]]:
+    out = collections.defaultdict(list)
+    for e in events:
+        out[e.get("kind", "?")].append(e)
+    return out
+
+
+def _detect_hang(kinds, blackboxes):
+    """Watchdog stall verdicts (journal ``health`` raise events with
+    severity unhealthy) + blackbox dumps whose reason is a watchdog
+    verdict — the online detection, read back offline."""
+    evs = [e for e in kinds.get("health", [])
+           if e.get("action") == "raise"
+           and e.get("severity") == "unhealthy"]
+    boxes = [b for b in blackboxes
+             if str(b.get("reason", "")).startswith("watchdog:")]
+    if not evs and not boxes:
+        return []
+    reasons = sorted({e.get("reason") for e in evs}
+                     | {b["reason"].split("watchdog:", 1)[1]
+                        for b in boxes})
+    evidence = [_cite(e, "reason", "detail") for e in evs]
+    detail = None
+    for b in boxes:
+        stuck = _suspect_thread(b)
+        evidence.append({"role": b.get("role"), "seq": None,
+                         "kind": "blackbox",
+                         "reason": b.get("reason"),
+                         "path": b.get("_path")})
+        if stuck and detail is None:
+            detail = "thread %r parked in: %s" % (
+                stuck["name"], stuck["frames"][-1].strip()
+                if stuck.get("frames") else "?")
+    roles = sorted({c.get("role") for c in evidence})
+    return [_diag(
+        "hang",
+        "stall/hang verdict on %s: %s" % (", ".join(r or "?"
+                                                    for r in roles),
+                                          "; ".join(reasons)),
+        evidence, detail=detail)]
+
+
+def _suspect_thread(box) -> Optional[dict]:
+    """The most interesting thread in a blackbox: prefer non-infra
+    threads (not the watchdog/metrics plumbing, nor whichever thread
+    was busy TAKING the dump — its top frame is _capture_stacks, not
+    a wedge), longest stack first — heuristics, but the full dump is
+    always cited."""
+    infra = ("health-watchdog", "obs-metrics", "MainThread")
+    stacks = box.get("stacks") or []
+
+    def is_infra(s):
+        if any(s.get("name", "").startswith(p) for p in infra):
+            return True
+        frames = s.get("frames") or []
+        return bool(frames) and "observability/health" in frames[-1]
+
+    cands = [s for s in stacks if not is_infra(s)]
+    cands = cands or stacks
+    return max(cands, key=lambda s: len(s.get("frames") or []),
+               default=None)
+
+
+def _detect_trainer_eviction(kinds):
+    evs = kinds.get("trainer_evicted", [])
+    if not evs:
+        return []
+    tids = sorted({e.get("tid") for e in evs})
+    first = evs[0]
+    aborts = kinds.get("barrier_aborted", [])
+    summary = ("trainer %s lease expired on %s at seq %s -> evicted; "
+               "quorum shrank to the survivors"
+               % (",".join(str(t) for t in tids),
+                  first.get("endpoint", "?"), first.get("seq")))
+    if aborts:
+        summary = ("trainer %s lease expired at seq %s -> "
+                   "BarrierAborted released the parked waiters"
+                   % (",".join(str(t) for t in tids),
+                      first.get("seq")))
+    return [_diag("trainer_eviction", summary,
+                  [_cite(e, "tid", "endpoint", "lease_timeout_s")
+                   for e in evs]
+                  + [_cite(e, "tids") for e in aborts])]
+
+
+def _detect_replica_failure(kinds):
+    evs = kinds.get("replica_evicted", [])
+    if not evs:
+        return []
+    retries = kinds.get("router_retry", [])
+    readmits = kinds.get("replica_readmitted", [])
+    rids = sorted({e.get("replica") for e in evs})
+    first = evs[0]
+    summary = ("serving replica %s (%s) lease expired at seq %s -> "
+               "evicted from dispatch; %d in-flight request(s) "
+               "retried on healthy replicas; readmitted: %s"
+               % (",".join(str(r) for r in rids),
+                  first.get("endpoint", "?"), first.get("seq"),
+                  len(retries), "yes" if readmits else "no"))
+    return [_diag("replica_failure", summary,
+                  [_cite(e, "replica", "endpoint") for e in evs]
+                  + [_cite(e, "replica", "attempt")
+                     for e in retries[:8]]
+                  + [_cite(e, "replica") for e in readmits])]
+
+
+def _detect_batcher_death(kinds):
+    evs = kinds.get("batcher_died", [])
+    if not evs:
+        return []
+    models = sorted({e.get("model") for e in evs})
+    return [_diag("batcher_death",
+                  "serving batcher thread died for model %s: %s"
+                  % (",".join(str(m) for m in models),
+                     evs[0].get("cause", "?")),
+                  [_cite(e, "model", "cause") for e in evs])]
+
+
+def _detect_pserver_restart(kinds):
+    snaps = kinds.get("snapshot", [])
+    recov = (kinds.get("phase_replay", [])
+             + kinds.get("phase_retry", [])
+             + kinds.get("rpc_reconnect", []))
+    if not snaps or not recov:
+        return []
+    replays = kinds.get("phase_replay", [])
+    reconnects = kinds.get("rpc_reconnect", [])
+    last_snap = snaps[-1]
+    first_recov = min(recov, key=lambda e: e.get("seq") or 0)
+    summary = ("pserver restarted mid-run: boundary snapshot at seq "
+               "%s (boundary %s), then %d reconnect(s)%s — trainers "
+               "recovered via idempotent replay into the restored "
+               "shards" % (last_snap.get("seq"),
+                           last_snap.get("boundary", "?"),
+                           len(reconnects),
+                           " and whole-phase replay at seq %s"
+                           % replays[0].get("seq") if replays else ""))
+    return [_diag("pserver_restart", summary,
+                  [_cite(last_snap, "boundary", "endpoint"),
+                   _cite(first_recov, "endpoint", "what", "attempt")]
+                  + [_cite(e, "endpoint") for e in reconnects[:6]]
+                  + [_cite(e, "what") for e in replays[:4]],
+                  confidence=1.0 if replays else 0.7)]
+
+
+def _detect_network_flaky(kinds):
+    reconnects = kinds.get("rpc_reconnect", [])
+    if len(reconnects) < 3:
+        return []
+    eps = sorted({e.get("endpoint") for e in reconnects})
+    restarted = bool(kinds.get("snapshot")) and \
+        bool(kinds.get("phase_replay"))
+    return [_diag("network_flaky",
+                  "lossy/flaky network: %d reconnect(s) across %d "
+                  "endpoint(s)%s" % (
+                      len(reconnects), len(eps),
+                      "" if restarted else
+                      " with no server-restart evidence (no "
+                      "snapshot+replay) — transport-level loss"),
+                  [_cite(e, "endpoint", "reconnects")
+                   for e in reconnects[:10]],
+                  confidence=0.5 if restarted else 0.9)]
+
+
+def _detect_recompile_storm(kinds, window_s=60.0, threshold=8):
+    evs = kinds.get("executor_compile", [])
+    if len(evs) < threshold:
+        return []
+    # peak count in any sliding window_s (events carry t_wall)
+    ts = sorted(float(e.get("t_wall") or 0.0) for e in evs)
+    best_n, best_t0, j = 0, ts[0], 0
+    for i, t in enumerate(ts):
+        while t - ts[j] > window_s:
+            j += 1
+        if i - j + 1 > best_n:
+            best_n, best_t0 = i - j + 1, ts[j]
+    if best_n < threshold:
+        return []
+    rate_min = best_n / (window_s / 60.0)
+    entries = collections.Counter(
+        str(e.get("entry", "?")) for e in evs)
+    top_entry, top_n = entries.most_common(1)[0]
+    return [_diag("recompile_storm",
+                  "recompile storm: %d compiles within %.0fs "
+                  "(%.0f compiles/min), %d of them on entry %r — "
+                  "shape churn is defeating the compile cache"
+                  % (best_n, window_s, rate_min, top_n, top_entry),
+                  [_cite(e, "entry", "nth") for e in evs[:12]])]
+
+
+def _detect_overload(kinds, threshold=5):
+    evs = kinds.get("server_overloaded", []) \
+        + kinds.get("router_shed", [])
+    if len(evs) < threshold:
+        return []
+    models = sorted({e.get("model") for e in evs
+                     if e.get("model") is not None})
+    return [_diag("overload",
+                  "sustained overload: %d admission rejection(s)/"
+                  "shed(s)%s — offered load exceeds capacity"
+                  % (len(evs),
+                     " on model %s" % ",".join(models)
+                     if models else ""),
+                  [_cite(e, "model", "queue_depth", "reason")
+                   for e in evs[:10]])]
+
+
+def _detect_training_anomaly(kinds):
+    rollbacks = kinds.get("rollback", [])
+    aborts = kinds.get("training_aborted", [])
+    if not rollbacks and not aborts:
+        return []
+    bits = []
+    if rollbacks:
+        bits.append("%d rollback(s) to step %s on consecutive "
+                    "anomalies" % (len(rollbacks),
+                                   rollbacks[-1].get("restored_step")))
+    if aborts:
+        bits.append("training ABORTED at step %s: %s"
+                    % (aborts[-1].get("step"),
+                       aborts[-1].get("reason")))
+    return [_diag("training_anomaly",
+                  "anomaly-guard activity: " + "; ".join(bits),
+                  [_cite(e, "restored_step", "consecutive_anomalies")
+                   for e in rollbacks]
+                  + [_cite(e, "reason", "step") for e in aborts])]
+
+
+def _detect_input_bound(metrics, threshold=0.3):
+    """Metric-snapshot detector: the pipelined pass ran input-bound
+    (high stall fraction) — the offline twin of the watchdog's
+    input_bound gauge rule."""
+    out = []
+    for m in metrics:
+        frac = None
+        gauges = m.get("gauges")
+        if isinstance(gauges, dict):
+            for k, v in gauges.items():
+                if k.split("{", 1)[0] == "input_stall_fraction":
+                    frac = float(v)
+        series = m.get("series")
+        if frac is None and isinstance(series, dict):
+            for k, v in series.items():
+                if k.split("{", 1)[0] == "input_stall_fraction":
+                    frac = float(v)
+        if frac is not None and frac >= threshold:
+            out.append(_diag(
+                "input_bound",
+                "input-bound: stall fraction %.2f — the device waits "
+                "on the host pipeline; raise prefetch depth/chunk "
+                "size or speed up the reader" % frac,
+                [{"role": m.get("_path", "metrics"), "seq": None,
+                  "kind": "metrics", "input_stall_fraction": frac}]))
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# diagnosis
+# ---------------------------------------------------------------------------
+
+def diagnose(events: List[dict], blackboxes: List[dict] = (),
+             metrics: List[dict] = ()) -> dict:
+    """Run every detector over one merged event stream; returns
+    {"top": name|None, "diagnoses": [ranked...], "events_scanned",
+    "roles", "kinds"}."""
+    events = sorted(events, key=lambda e: (e.get("t_wall", 0.0),
+                                           e.get("seq", 0)))
+    kinds = _by_kind(events)
+    diagnoses = []
+    diagnoses += _detect_hang(kinds, list(blackboxes))
+    diagnoses += _detect_batcher_death(kinds)
+    diagnoses += _detect_trainer_eviction(kinds)
+    diagnoses += _detect_replica_failure(kinds)
+    diagnoses += _detect_pserver_restart(kinds)
+    diagnoses += _detect_recompile_storm(kinds)
+    diagnoses += _detect_training_anomaly(kinds)
+    diagnoses += _detect_network_flaky(kinds)
+    diagnoses += _detect_overload(kinds)
+    diagnoses += _detect_input_bound(list(metrics))
+    diagnoses.sort(key=lambda d: -d["score"])
+    return {
+        "top": diagnoses[0]["name"] if diagnoses else None,
+        "diagnoses": diagnoses,
+        "events_scanned": len(events),
+        "roles": sorted({e.get("role", "?") for e in events}),
+        "kinds": {k: len(v) for k, v in sorted(kinds.items())},
+    }
+
+
+def load_and_diagnose(journal_paths=(), blackbox_paths=(),
+                      metrics_srcs=()) -> dict:
+    """File-level front door: merge journals (rotated siblings
+    stitched), parse blackboxes and metrics, diagnose."""
+    from paddle_tpu.observability import read_journal
+    events = []
+    for p in journal_paths:
+        events.extend(read_journal(p))
+    boxes = []
+    for p in blackbox_paths:
+        with open(p) as f:
+            b = json.load(f)
+        b["_path"] = p
+        boxes.append(b)
+    metrics = []
+    for src in metrics_srcs:
+        m = _load_metrics(src)
+        m["_path"] = src
+        metrics.append(m)
+    return diagnose(events, boxes, metrics)
+
+
+def _load_metrics(src):
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(src, timeout=5) as r:
+            text = r.read().decode()
+        import obs_dump
+        return obs_dump.parse_prometheus_text(text)
+    with open(src) as f:
+        text = f.read()
+    if text.lstrip().startswith("{"):
+        return json.loads(text)  # registry().snapshot() JSON
+    import obs_dump
+    return obs_dump.parse_prometheus_text(text)
+
+
+def format_report(report: dict) -> str:
+    lines = ["doctor: scanned %d events from %s"
+             % (report["events_scanned"],
+                ", ".join(report["roles"]) or "(no journals)")]
+    if not report["diagnoses"]:
+        lines.append("no diagnosis: nothing in the record looks "
+                     "faulted")
+    for i, d in enumerate(report["diagnoses"], 1):
+        lines.append("%d. [%s score=%.1f conf=%.2f] %s"
+                     % (i, d["name"], d["score"], d["confidence"],
+                        d["summary"]))
+        if d.get("detail"):
+            lines.append("   %s" % d["detail"])
+        cites = ", ".join(
+            "%s@%s %s" % (c.get("role"), c.get("seq"), c.get("kind"))
+            for c in d["evidence"][:6])
+        lines.append("   evidence: %s%s"
+                     % (cites, " ..." if len(d["evidence"]) > 6
+                        else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--journal", action="append", default=[],
+                    help="JSONL event journal (repeatable; rotated "
+                    ".1 siblings stitched automatically)")
+    ap.add_argument("--blackbox", action="append", default=[],
+                    help="blackbox.<role>.json flight-recorder dump "
+                    "(repeatable)")
+    ap.add_argument("--metrics", action="append", default=[],
+                    help="/metrics URL, exposition-text file, or "
+                    "registry snapshot JSON (repeatable)")
+    ap.add_argument("--expect", default=None,
+                    help="comma-separated acceptable top diagnoses; "
+                    "exit 1 on mismatch (the chaos-gate mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON report instead of text")
+    args = ap.parse_args(argv)
+
+    report = load_and_diagnose(args.journal, args.blackbox,
+                               args.metrics)
+    if args.json:
+        print(json.dumps(report, indent=2, default=repr))
+    else:
+        print(format_report(report))
+    if args.expect is not None:
+        want = {w.strip() for w in args.expect.split(",") if w.strip()}
+        if report["top"] not in want:
+            print("doctor: EXPECTED %s, got %r"
+                  % (sorted(want), report["top"]), file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
