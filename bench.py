@@ -739,6 +739,168 @@ def bench_health_overhead(steps=None, batch=256, chunk_size=8):
             "mfu": None}
 
 
+def bench_compile_cache_warmup(steps=None, batch=256, chunk_size=8):
+    """Compile-plane row (ROADMAP "Compile plane"): restart warm-up
+    through the persistent AOT cache. The SAME small training program
+    is built fresh twice against a shared on-disk cache (fresh
+    Program + fresh Executor per pass, ``unique_name.guard`` so both
+    passes lower to identical canonical HLO — the in-process
+    emulation of the subprocess restart test in
+    tests/test_compile_cache.py): the cold pass pays the XLA compiles
+    and stores executables; the warm pass must LOAD every one (hit
+    rate 1.0, zero XLA compiles) in measurably less wall time. Also
+    reports the compile plane's steady-state cost on the pipelined
+    probe with the cache on vs off (interleaved best-of-2, same
+    protocol as telemetry_overhead; < 2% bar)."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import compile_cache as cc
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import pipeline_probe
+
+    import numpy as np
+
+    steps = steps or int(_env_float("BENCH_CC_STEPS", 32))
+    rng = np.random.RandomState(0)
+    xv = rng.rand(64, 64).astype(np.float32)
+    yv = rng.randint(0, 16, (64, 1)).astype(np.int64)
+
+    def build():
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = 11
+            startup.random_seed = 11
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[64])
+                label = fluid.layers.data("label", shape=[1],
+                                          dtype="int64")
+                h = fluid.layers.fc(x, size=256, act="relu")
+                pred = fluid.layers.fc(h, size=16, act="softmax")
+                loss = fluid.layers.mean(
+                    fluid.layers.cross_entropy(pred, label))
+                fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+        return main, startup, loss
+
+    def one_restart():
+        main, startup, loss = build()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        t0 = time.perf_counter()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed={"x": xv, "label": yv},
+                    fetch_list=[loss])
+        return time.perf_counter() - t0, exe
+
+    # restore whatever cache the process had (env-configured fleet
+    # dir) afterwards — this row must not disable it for later rows
+    prev = cc.active()
+
+    def restore():
+        if prev is not None:
+            cc.configure(prev.dir, max_bytes=prev.max_bytes)
+        else:
+            cc.configure(None)
+
+    tmp = tempfile.mkdtemp(prefix="bench_cc_")
+    try:
+        cc.configure(tmp)
+        cc.reset_stats()
+        cold_s, _ = one_restart()
+        cold = cc.stats()
+        cc.reset_stats()
+        warm_s, exe_warm = one_restart()
+        warm = cc.stats()
+    finally:
+        restore()
+        shutil.rmtree(tmp, ignore_errors=True)
+    attempts = warm["hits"] + warm["misses"]
+    hit_rate = (warm["hits"] / attempts) if attempts else None
+
+    # steady-state cost of the compile plane on the pipelined probe,
+    # cache ON vs OFF (the probe's timed window is steady-state
+    # dispatches, so this is the bar the AOT rework must not move)
+    def probe(cache_dir):
+        cc.configure(cache_dir)
+        try:
+            return pipeline_probe.probe(
+                steps=steps, batch=batch,
+                chunk_size=chunk_size)["pipelined"]["steps_per_s"]
+        finally:
+            restore()
+    tmp2 = tempfile.mkdtemp(prefix="bench_cc_probe_")
+    try:
+        sps_off = probe(None)
+        sps_on = probe(tmp2)
+        sps_off = max(sps_off, probe(None))
+        sps_on = max(sps_on, probe(tmp2))
+    finally:
+        shutil.rmtree(tmp2, ignore_errors=True)
+    overhead = (1.0 - sps_on / sps_off) if sps_off else None
+
+    return {"metric": "compile_cache_warmup",
+            "value": round(hit_rate, 4) if hit_rate is not None
+            else None,
+            "unit": "warm-restart hit rate",
+            "cold_wall_s": round(cold_s, 4),
+            "warm_wall_s": round(warm_s, 4),
+            "warm_speedup": round(cold_s / warm_s, 3)
+            if warm_s > 0 else None,
+            "warm_xla_compiles": exe_warm.xla_compile_count,
+            "cold_stores": cold["stores"],
+            "warm_hits": warm["hits"],
+            "bytes_stored": cold["bytes_stored"],
+            "probe_cache_on_steps_per_s": sps_on,
+            "probe_cache_off_steps_per_s": sps_off,
+            "cache_overhead_fraction": round(overhead, 4)
+            if overhead is not None else None,
+            "bar": "hit rate 1.0, warm_xla_compiles 0, "
+                   "|cache_overhead| < 0.02",
+            "mfu": None}
+
+
+def bench_fused_kernel_count():
+    """Fusion-boundary audit row (tools/fusion_report.py, PAPERS.md
+    arXiv:2301.13062): fused-kernel counts of the tiny transformer
+    program plain vs with the executor's rewrite boundaries injected
+    (q8 gradient-sync + anomaly guard on a dp mesh). The regression
+    contract — also asserted by tests/test_fusion_report.py — is that
+    the rewrites do not SPLIT fusion: the augmented program's
+    fused-kernel count is not lower than the plain program's, and its
+    collective boundaries sit between fused producers/consumers."""
+    import jax
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import fusion_report
+
+    devices = 2 if jax.device_count() >= 2 else 1
+    # like-for-like: the plain baseline carries the SAME
+    # CompiledProgram/mesh wrapper (implicit GSPMD sync; wrap_mesh
+    # forces it even on a 1-device host) so SPMD partitioning can't
+    # inflate the augmented count and mask a real fusion split
+    plain = fusion_report.run_and_report("transformer",
+                                         devices=devices,
+                                         wrap_mesh=True)
+    aug = fusion_report.run_and_report(
+        "transformer", gradient_sync="q8", guard=True,
+        devices=devices)
+    return {"metric": "fused_kernel_count",
+            "value": aug["fused_kernels_total"],
+            "unit": "fused kernels (transformer, q8+guard)",
+            "plain_fused_kernels": plain["fused_kernels_total"],
+            "collective_boundaries":
+                aug["collective_boundaries_total"],
+            "devices": devices,
+            "not_lower_than_plain":
+                aug["fused_kernels_total"]
+                >= plain["fused_kernels_total"],
+            "mfu": None}
+
+
 # ---------------------------------------------------------------------------
 # config 2: ResNet-50 ImageNet
 # ---------------------------------------------------------------------------
@@ -1545,6 +1707,7 @@ def child_main():
         # forfeits only the ones after it.
         extra = [bench_mnist_mlp, bench_pipelined_train,
                  bench_telemetry_overhead, bench_health_overhead,
+                 bench_compile_cache_warmup, bench_fused_kernel_count,
                  bench_guarded_overhead, bench_ps_degraded,
                  bench_serving_latency, bench_serving_fleet_scaling,
                  bench_deepfm, bench_bert,

@@ -49,6 +49,7 @@ from .framework import (Program, Variable, convert_dtype,  # noqa: F401
                         default_main_program, default_startup_program,
                         name_scope, program_guard)
 from . import io  # noqa: F401
+from . import compile_cache  # noqa: F401
 from . import resilience  # noqa: F401
 from . import incubate  # noqa: F401
 from . import metrics  # noqa: F401

@@ -28,6 +28,8 @@ executor path.
 from __future__ import annotations
 
 import collections
+import contextlib
+import hashlib
 import os
 import threading
 import time
@@ -37,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import compile_cache as _ccache
 from . import framework, ops
 from . import observability as _obs
 from . import profiler as _profiler
@@ -692,6 +695,52 @@ def _check_feed_shape_type(block, feed):
                 % (name, got_dt, want_dt))
 
 
+# the fragment PJRT puts in the TypeError an AOT executable raises
+# when called with avals it was not compiled for (the one legitimate
+# in-process trigger: a persistable's shape/dtype drifted between
+# calls, which jax.jit used to absorb with a silent retrace)
+_AVAL_MISMATCH = "for which this computation was compiled"
+
+# provenance miss reasons (docs/compile.md): why an XLA compile
+# happened instead of an executable being reused
+MISS_REASONS = ("new_program", "new_shape", "new_mesh", "cache_cold",
+                "evicted")
+
+
+def _dtype_tag(v) -> str:
+    """Canonical dtype string for a CONVERTED feed value; weak-typed
+    scalars are tagged so they never share an executable with a
+    strongly-typed aval of the same dtype."""
+    dt = str(v.dtype)
+    return dt + "~" if getattr(v, "weak_type", False) else dt
+
+
+def _fmt_aval(dt, shp) -> str:
+    """The one "dtype[d1,d2]" formatter behind shape keys, provenance
+    shapes, and the donation-warning aval match — keep in sync or
+    doctor's bucket aggregation and the warning filter drift apart."""
+    return "%s[%s]" % (dt, ",".join(str(d) for d in shp))
+
+
+def _aval_str(v) -> str:
+    return _fmt_aval(v.dtype, v.shape)
+
+
+def _shape_key(shape_sig) -> str:
+    """Stable compact label of one feed-shape signature — the
+    "shape bucket" the provenance ledger and doctor aggregate by."""
+    return ";".join("%s=%s" % (k, _fmt_aval(dt, shp))
+                    for k, shp, dt in shape_sig) or "(no feed)"
+
+
+def _mesh_tag(mesh_fp) -> Optional[str]:
+    """Short stable tag of a CompiledProgram mesh fingerprint for the
+    ledger (the full tuple is long and process-local)."""
+    if mesh_fp is None:
+        return None
+    return hashlib.sha1(repr(mesh_fp).encode()).hexdigest()[:12]
+
+
 class Executor:
     """Drop-in analog of fluid.Executor (executor.py:292)."""
 
@@ -707,6 +756,43 @@ class Executor:
         # per-shape number (one executable per shape bucket).
         self._compiled_sigs = set()
         self._compile_count = 0
+        # AOT executables: (cache_key, shape_sig) -> callable
+        # (jax.stages.Compiled / Loaded, or the eager step fn for
+        # interpreted programs). self._cache keeps the TRACEABLE
+        # (jitted step) per cache_key; executables live here, one per
+        # feed-shape signature, built via lower()+compile() so the
+        # compile is observable (provenance ledger) and portable
+        # (persistent compile_cache).
+        self._executables = {}
+        # sidecar of _executables for introspection (aot_artifacts):
+        # entry/uid/shape_key/fingerprint per executable
+        self._artifacts = {}
+        # per-(cache_key, shape_sig) first-compile gates: predictor
+        # clones sharing this Executor race HERE, not in jit's guts —
+        # the loser finds the executable, and the provenance ledger
+        # gets exactly one record per compile
+        self._exe_gates = {}
+        # AOT builds (trace+lower+compile/load) in progress: counted
+        # into dispatch_inflight() so the wedged-dispatch hang watch
+        # still covers a stuck first-step COMPILE — pre-AOT, the
+        # compile happened inside the dispatch in-flight window and
+        # the watch saw it; the AOT build runs before the dispatch
+        # counters and must stay visible
+        self._builds_inflight = 0
+        # miss-reason classification state: per executable family
+        # (cache_key) -> seen shape_sigs; per (program uid, version,
+        # shape_sig) -> mesh fingerprint
+        self._key_sigs = {}
+        self._sig_mesh = {}
+        # true XLA compiles (compile_count also counts interpret-mode
+        # trace entries and, with a warm persistent cache, shapes whose
+        # executable was LOADED rather than compiled)
+        self._xla_compiles = 0
+        # executables THIS executor loaded from the persistent cache
+        # (the precise per-executor hit count serving warmup reports)
+        self._cache_loads = 0
+        self._compile_seconds = 0.0
+        self._compiles_by_entry = {}
         # device dispatches issued by this Executor: one per jitted-fn
         # invocation (a run(), one run_repeated scan, one run_pipelined
         # chunk scan). The pipelined-training contract (docs/
@@ -734,6 +820,7 @@ class Executor:
         self._m_compile = reg.counter("executor_compiles_total")
         self._m_steps = reg.counter("executor_steps_total")
         self._h_dispatch = reg.histogram("executor_dispatch_seconds")
+        self._h_compile = reg.histogram("executor_compile_seconds")
         # counters/sets are mutated from concurrent predictor clones
         # (AnalysisPredictor shares one Executor across clones); held
         # only around bookkeeping, never across a dispatch
@@ -799,10 +886,13 @@ class Executor:
 
     def dispatch_inflight(self) -> bool:
         """True while a device dispatch has been issued but has not
-        completed — the health watchdog's pending signal for the
-        wedged-dispatch (bench-hang) class."""
+        completed, OR an AOT build (trace+compile/cache load) is in
+        progress — the health watchdog's pending signal for both the
+        wedged-dispatch (bench-hang) class and a wedged first-step
+        compile."""
         with self._lock:
-            return self._dispatch_count > self._dispatches_done
+            return (self._dispatch_count > self._dispatches_done
+                    or self._builds_inflight > 0)
 
     @property
     def dispatch_beacon(self):
@@ -810,15 +900,277 @@ class Executor:
         dispatch) — what GuardedTrainer's hang watch reads."""
         return self._beacon
 
-    def _note_compile(self, entry, shape_sig):
-        """Registry + journal accounting for one fresh (program,
-        feed-shape) compile — the compile-count blindness fix: every
-        recompile is an attributable event, not a silent perf cliff."""
+    @property
+    def xla_compile_count(self):
+        """True XLA compiles this Executor paid (excludes interpret-
+        mode trace entries and persistent-cache loads) — the number a
+        warm restart drives to ZERO."""
+        with self._lock:
+            return self._xla_compiles
+
+    @property
+    def cache_load_count(self):
+        """Executables this Executor LOADED from the persistent
+        compile cache instead of compiling (per-executor, unlike the
+        process-wide compile_cache counters)."""
+        with self._lock:
+            return self._cache_loads
+
+    def _book_fresh_sig(self, cache_key, shape_sig):
+        """ONE critical section for the per-shape compile accounting:
+        dedup by (cache_key, shape_sig) — concurrent predictor clones
+        racing the same unseen shape book it exactly once."""
+        with self._lock:
+            fresh = (cache_key, shape_sig) not in self._compiled_sigs
+            if fresh:
+                self._compiled_sigs.add((cache_key, shape_sig))
+                self._compile_count += 1
+        return fresh
+
+    def _classify_miss(self, cache_key, program, shape_sig, mesh_fp,
+                       disk_key, cache):
+        """Why did this compile happen? Evaluated against what this
+        process has compiled before (under self._lock) and what the
+        persistent cache knows:
+
+          evicted     - the disk cache HELD this key and LRU-dropped it
+          new_mesh    - this (program, shape) was compiled for a
+                        different mesh
+          new_shape   - this EXECUTABLE FAMILY (same cache_key: same
+                        program, fetches, entry point, ...) compiled
+                        before for different feed shapes — the
+                        shape-churn / recompile-storm case — or a
+                        booked shape compiling AGAIN (persistable aval
+                        drift). A distinct cache_key variant (new
+                        fetch_list, run vs run_repeated) is NOT shape
+                        churn and falls through.
+          cache_cold  - persistent cache enabled but has never seen
+                        this key (replica cold-start, version skew)
+          new_program - first compile of this program, no cache to be
+                        cold (the one reason that is not a perf smell)
+        """
+        if cache is not None and disk_key is not None \
+                and cache.was_evicted(disk_key):
+            return "evicted"
+        prog_key = (program._uid, program._version)
+        with self._lock:
+            seen_mesh = self._sig_mesh.get((prog_key, shape_sig))
+            # only a REAL mesh change books new_mesh: run_repeated /
+            # run_pipelined variants carry mesh_fp=None and must not
+            # read as (or overwrite) a mesh switch
+            if seen_mesh is not None and mesh_fp is not None \
+                    and seen_mesh != mesh_fp:
+                return "new_mesh"
+            if self._key_sigs.get(cache_key):
+                # family seen before: an unseen sig is shape churn, a
+                # seen sig recompiling is persistable aval drift —
+                # both book as new_shape
+                return "new_shape"
+        if cache is not None:
+            return "cache_cold"
+        return "new_program"
+
+    def _book_prog_sig(self, cache_key, program, shape_sig, mesh_fp):
+        prog_key = (program._uid, program._version)
+        with self._lock:
+            self._key_sigs.setdefault(cache_key, set()).add(shape_sig)
+            if mesh_fp is not None:
+                self._sig_mesh[(prog_key, shape_sig)] = mesh_fp
+
+    def _note_provenance(self, entry, shape_sig, reason, fingerprint,
+                         mesh_fp, seconds, mode="xla",
+                         xla_seconds=None):
+        """Registry + journal record for ONE compile — the compile
+        plane's provenance ledger (docs/compile.md): every compile is
+        an attributable event with a *miss reason*, not a silent perf
+        cliff. Emitted exactly once per compile (the caller holds the
+        per-key gate)."""
         self._m_compile.inc()
-        shapes = {k: "%s[%s]" % (dt, ",".join(str(d) for d in shp))
-                  for k, shp, dt in shape_sig}
+        self._h_compile.observe(seconds)
+        _obs.registry().counter("executor_compiles_entry_total",
+                                entry=entry, reason=reason).inc()
+        with self._lock:
+            if mode == "xla":
+                self._xla_compiles += 1
+            self._compile_seconds += seconds
+            self._compiles_by_entry[entry] = \
+                self._compiles_by_entry.get(entry, 0) + 1
+            nth = self._compile_count
+        shapes = {k: _fmt_aval(dt, shp) for k, shp, dt in shape_sig}
         _obs.emit("executor_compile", entry=entry, shapes=shapes,
-                  nth=self._compile_count)
+                  shape_key=_shape_key(shape_sig), miss_reason=reason,
+                  fingerprint=fingerprint, mesh=_mesh_tag(mesh_fp),
+                  compile_seconds=round(seconds, 6),
+                  xla_compile_seconds=round(xla_seconds, 6)
+                  if xla_seconds is not None else None,
+                  mode=mode, nth=nth)
+
+    def _executable_for(self, cache_key, shape_sig, entry, program,
+                        make_fn, lower_args, mesh_fp=None,
+                        compile_ctx=None):
+        """The executable for (cache_key, shape_sig), built AOT on
+        first need: trace+lower the jitted step, fingerprint the
+        canonical HLO, try the persistent compile cache, and only on a
+        true miss pay the XLA compile — recording one provenance
+        ledger event with its miss reason (or a ``compile_cache_hit``
+        event naming the process that originally paid the compile).
+
+        ``make_fn`` builds the traceable (jit-wrapped step, or the
+        plain eager step for interpreted programs), memoized in
+        ``self._cache`` under ``cache_key``. ``lower_args`` is a THUNK
+        returning the concrete args to lower against — evaluated only
+        on the build-miss path, so the steady-state dispatch fast path
+        pays one dict lookup and no arg construction. ``compile_ctx``
+        optionally wraps the lower+compile window (run_pipelined's
+        donation-warning filter). A per-key gate serializes concurrent
+        first-compiles (clones sharing this Executor), so the loser
+        finds the executable instead of compiling its own."""
+        ekey = (cache_key, shape_sig)
+        fn = self._executables.get(ekey)
+        if fn is not None:
+            return fn
+        with self._lock:
+            gate = self._exe_gates.setdefault(ekey, threading.Lock())
+            # visible to dispatch_inflight() for the whole build
+            # (including time parked on a sibling's gate): a wedged
+            # compile must still trip the hang watch
+            self._builds_inflight += 1
+        try:
+            return self._build_executable(ekey, gate, cache_key,
+                                          shape_sig, entry, program,
+                                          make_fn, lower_args, mesh_fp,
+                                          compile_ctx)
+        finally:
+            with self._lock:
+                self._builds_inflight -= 1
+
+    def _build_executable(self, ekey, gate, cache_key, shape_sig,
+                          entry, program, make_fn, lower_args, mesh_fp,
+                          compile_ctx):
+        with gate:
+            fn = self._executables.get(ekey)
+            if fn is not None:
+                return fn
+            jitfn = self._cache.get(cache_key)
+            if jitfn is None:
+                jitfn = make_fn()
+                self._cache[cache_key] = jitfn
+            if not hasattr(jitfn, "lower"):
+                # interpreted mode: no XLA program exists; the "compile"
+                # is this trace-cache entry (kept in the ledger so
+                # interpreted shape churn is just as attributable)
+                reason = self._classify_miss(cache_key, program,
+                                             shape_sig, mesh_fp,
+                                             None, None)
+                self._book_prog_sig(cache_key, program, shape_sig,
+                                    mesh_fp)
+                self._note_provenance(entry, shape_sig, reason, None,
+                                      mesh_fp, 0.0, mode="interpret")
+                self._artifacts[ekey] = {
+                    "entry": entry, "program_uid": program._uid,
+                    "shape_key": _shape_key(shape_sig),
+                    "fingerprint": None, "mode": "interpret"}
+                self._executables[ekey] = jitfn
+                return jitfn
+            ctx = compile_ctx if compile_ctx is not None \
+                else contextlib.nullcontext
+            t0 = time.perf_counter()
+            with _profiler.RecordEvent("executor_trace_compile"), \
+                    ctx():
+                lowered = jitfn.lower(*lower_args())
+                fp = _ccache.canonical_fingerprint(lowered.as_text())
+                cache = _ccache.active()
+                disk_key = None
+                loaded = None
+                if cache is not None:
+                    disk_key = _ccache.cache_key(fp, mesh_fp)
+                    hit = cache.get(disk_key, entry=entry)
+                    if hit is not None:
+                        loaded = hit.loaded
+                        self._book_prog_sig(cache_key, program,
+                                            shape_sig, mesh_fp)
+                        with self._lock:
+                            self._cache_loads += 1
+                        _obs.emit(
+                            "compile_cache_hit", entry=entry,
+                            key=disk_key, fingerprint=fp,
+                            shape_key=_shape_key(shape_sig),
+                            load_seconds=round(hit.load_seconds, 6),
+                            bytes=hit.nbytes,
+                            origin_pid=hit.meta.get("origin_pid"),
+                            origin_role=hit.meta.get("origin_role"),
+                            origin_t_wall=hit.meta.get("origin_t_wall"),
+                            compile_seconds_saved=hit.meta.get(
+                                "compile_seconds"))
+                if loaded is None:
+                    reason = self._classify_miss(cache_key, program,
+                                                 shape_sig, mesh_fp,
+                                                 disk_key, cache)
+                    self._book_prog_sig(cache_key, program, shape_sig,
+                                        mesh_fp)
+                    t1 = time.perf_counter()
+                    compiled = lowered.compile()
+                    xla_s = time.perf_counter() - t1
+                    self._note_provenance(
+                        entry, shape_sig, reason, fp, mesh_fp,
+                        time.perf_counter() - t0, mode="xla",
+                        xla_seconds=xla_s)
+                    if cache is not None:
+                        cache.put(disk_key, compiled, {
+                            "entry": entry, "fingerprint": fp,
+                            "shape_key": _shape_key(shape_sig),
+                            "mesh": _mesh_tag(mesh_fp),
+                            "compile_seconds": xla_s})
+                    loaded = compiled
+                # memoize INSIDE the compile_ctx window: the ctx's
+                # __exit__ may legitimately raise (run_pipelined's
+                # donation-warning replay under warnings-as-errors),
+                # and the built executable must survive that — the
+                # warning then raises ONCE, exactly like the pre-AOT
+                # jit cache behaved, instead of discarding the
+                # executable and recompile-raising forever
+                self._artifacts[ekey] = {
+                    "entry": entry, "program_uid": program._uid,
+                    "shape_key": _shape_key(shape_sig),
+                    "fingerprint": fp, "mode": "xla"}
+                self._executables[ekey] = loaded
+            return loaded
+
+    def aot_artifacts(self):
+        """Introspection snapshot for the fusion-boundary audit
+        (tools/fusion_report.py): one record per AOT executable this
+        Executor holds — entry point, program uid, shape key,
+        canonical fingerprint, and the OPTIMIZED (post-fusion) HLO
+        text when the backend exposes it (None for interpret-mode
+        entries or backends without as_text)."""
+        out = []
+        for ekey, fn in list(self._executables.items()):
+            rec = dict(self._artifacts.get(ekey, {}))
+            text = None
+            if hasattr(fn, "as_text"):
+                try:
+                    text = fn.as_text()
+                except Exception:
+                    text = None
+            rec["optimized_hlo"] = text
+            out.append(rec)
+        return out
+
+    def _call_executable(self, exe_fn, ekey, args, rebuild):
+        """Dispatch through an AOT executable, absorbing the one
+        legitimate aval drift jax.jit used to hide: a persistable's
+        shape/dtype changed between calls (feed shapes are pinned by
+        shape_sig, persistables are not). On the exact compiled-types
+        TypeError, drop the stale executable and rebuild against the
+        current avals — once."""
+        try:
+            return exe_fn(*args)
+        except TypeError as e:
+            if _AVAL_MISMATCH not in str(e) or not callable(rebuild):
+                raise
+            with self._lock:
+                self._executables.pop(ekey, None)
+            return rebuild()(*args)
 
     def telemetry(self, scope=None, program=None):
         """One observability snapshot of this Executor: throughput
@@ -832,12 +1184,21 @@ class Executor:
             steps = self._run_counter
             dispatches = self._dispatch_count
             compiles = self._compile_count
+            xla_compiles = self._xla_compiles
+            cache_loads = self._cache_loads
+            compile_secs = self._compile_seconds
+            by_entry = dict(self._compiles_by_entry)
             secs = self._step_seconds
             times = list(self._step_times)
         out = {
             "steps": steps,
             "dispatches": dispatches,
             "compiles": compiles,
+            "xla_compiles": xla_compiles,
+            "cache_loads": cache_loads,
+            "compile_seconds_total": round(compile_secs, 6),
+            "compiles_by_entry": by_entry,
+            "compile_cache": _ccache.stats(),
             "dispatch_seconds_total": round(secs, 6),
             "steps_per_s": round(steps / secs, 3) if secs > 0 else None,
         }
@@ -874,8 +1235,13 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._executables.clear()
+        self._artifacts.clear()
         with self._lock:
             self._compiled_sigs.clear()
+            self._exe_gates.clear()
+            self._key_sigs.clear()
+            self._sig_mesh.clear()
 
     def run_repeated(self, program=None, feed=None, fetch_list=None,
                      iters=1, scope=None, return_numpy=True,
@@ -954,8 +1320,19 @@ class Executor:
         cache_key = ("repeat", iters, program._uid, program._version,
                      tuple(sorted(feed)), tuple(fetch_names),
                      tuple(sorted(persist_in)), library)
-        fn = self._cache.get(cache_key)
-        if fn is None:
+        # convert the feed BEFORE compile accounting so the shape
+        # signature reflects the dtypes XLA actually sees (asarray
+        # canonicalizes int64 -> int32 etc.)
+        with _profiler.RecordEvent("feed_h2d"):
+            feed_vals = {k: jnp.asarray(v)
+                         if not isinstance(v, jax.Array) else v
+                         for k, v in feed.items()}
+        shape_sig = tuple((k, tuple(feed_vals[k].shape),
+                           _dtype_tag(feed_vals[k]))
+                          for k in sorted(feed_vals))
+        self._book_fresh_sig(cache_key, shape_sig)
+
+        def make_fn():
             carried = frozenset(persist_in)
             self._check_sharded_layout(block)
             guard_plan = self._guard_plan(program, block)
@@ -1005,9 +1382,20 @@ class Executor:
                     body, (persist, fetches0), jnp.arange(iters))
                 return last_fetches, last_persist
 
-            fn = jax.jit(multi, donate_argnums=(0,))
-            self._cache[cache_key] = fn
+            return jax.jit(multi, donate_argnums=(0,))
 
+        base_key0 = self._base_key(program)
+
+        def obtain():
+            # the fold_in value is irrelevant to lowering (only the
+            # key's aval matters); the dispatch below folds the real
+            # run counter in. Thunked: only a build miss pays it.
+            return self._executable_for(
+                cache_key, shape_sig, "run_repeated", program, make_fn,
+                lambda: (persist_in, feed_vals,
+                         jax.random.fold_in(base_key0, 0)))
+
+        exe_fn = obtain()
         with self._lock:
             counter = self._run_counter
             self._run_counter += iters
@@ -1015,19 +1403,15 @@ class Executor:
         self._m_dispatch.inc()
         self._m_steps.inc(iters)
         # the failed-settlement guard covers EVERYTHING after the
-        # count increment (feed conversion included), or an exception
-        # in between leaves dispatch_inflight() stuck True forever
+        # count increment, or an exception in between leaves
+        # dispatch_inflight() stuck True forever
         try:
-            base_key = jax.random.fold_in(self._base_key(program),
-                                          counter)
-            with _profiler.RecordEvent("feed_h2d"):
-                feed_vals = {k: jnp.asarray(v)
-                             if not isinstance(v, jax.Array) else v
-                             for k, v in feed.items()}
+            base_key = jax.random.fold_in(base_key0, counter)
             t0 = time.perf_counter()
             with _profiler.RecordEvent("executor_run_repeated"):
-                fetches, persist_out = fn(persist_in, feed_vals,
-                                          base_key)
+                fetches, persist_out = self._call_executable(
+                    exe_fn, (cache_key, shape_sig),
+                    (persist_in, feed_vals, base_key), obtain)
         except BaseException:
             self._note_dispatch_failed()
             raise
@@ -1136,17 +1520,11 @@ class Executor:
         # compiles). K is part of the shape: the ragged tail chunk
         # legitimately counts as one extra compile.
         shape_sig = tuple((k, tuple(chunk_vals[k].shape),
-                           str(chunk_vals[k].dtype))
+                           _dtype_tag(chunk_vals[k]))
                           for k in feed_names)
-        with self._lock:
-            compiling = (cache_key, shape_sig) not in self._compiled_sigs
-            if compiling:
-                self._compiled_sigs.add((cache_key, shape_sig))
-                self._compile_count += 1
-        if compiling:
-            self._note_compile("run_pipelined", shape_sig)
-        fn = self._cache.get(cache_key)
-        if fn is None:
+        self._book_fresh_sig(cache_key, shape_sig)
+
+        def make_fn():
             carried = frozenset(persist_in)
             persistable_names = frozenset(
                 n for n, v in block.vars.items() if v.persistable)
@@ -1215,86 +1593,75 @@ class Executor:
 
             # donate the carry AND the feed chunk: the chunk's device
             # buffers are dead once its scan consumed them
-            fn = jax.jit(pipelined, donate_argnums=(0, 1))
-            self._cache[cache_key] = fn
+            return jax.jit(pipelined, donate_argnums=(0, 1))
 
+        @contextlib.contextmanager
+        def donation_warning_filter():
+            # The feed chunk rarely aliases an output (fetches are
+            # scalars), so XLA warns its donation "was not usable" at
+            # compile time — expected, and it would noise up every
+            # data-fed run. The PERSIST CARRY shares the donate list
+            # though, and a carry that stops aliasing (param buffers
+            # silently duplicated each chunk) must stay loud: suppress
+            # only when every buffer the warning names is a chunk aval
+            # AND no persistable shares that aval (ambiguity stays
+            # loud). catch_warnings mutates process-global state, so
+            # the window is confined to the one-off lower+compile —
+            # steady-state dispatches touch no warning machinery.
+            import re
+            import warnings
+
+            chunk_avals = {_aval_str(v) for v in chunk_vals.values()}
+            persist_avals = {
+                _aval_str(v) for v in persist_in.values()
+                if hasattr(v, "shape") and hasattr(v, "dtype")}
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                yield
+            for w in caught:
+                msg = str(w.message)
+                if "donated buffers were not usable" in msg:
+                    named = set(re.findall(
+                        r"ShapedArray\(([^)]+)\)", msg))
+                    if named and named <= chunk_avals \
+                            and not named & persist_avals:
+                        continue  # feed-chunk-only: expected
+                warnings.warn_explicit(w.message, w.category,
+                                       w.filename, w.lineno)
+
+        base_key0 = self._base_key(program)
+
+        def obtain():
+            return self._executable_for(
+                cache_key, shape_sig, "run_pipelined", program,
+                make_fn,
+                lambda: (persist_in, chunk_vals,
+                         jnp.asarray(np.arange(iters, dtype=np.int32)),
+                         base_key0),
+                compile_ctx=donation_warning_filter)
+
+        exe_fn = obtain()
         with self._lock:
             counter = self._run_counter
             self._run_counter += iters
             self._dispatch_count += 1
         self._m_dispatch.inc()
         self._m_steps.inc(iters)
+        # the failed-settlement guard covers everything between the
+        # count increment and the dispatch settling (see
+        # _note_dispatch_failed)
         try:
-            base_key = self._base_key(program)
             idxs = jnp.asarray(np.arange(counter, counter + iters,
                                          dtype=np.int32))
+            t_dispatch = time.perf_counter()
+            with _profiler.RecordEvent("scan_dispatch",
+                                       args={"steps": int(iters)}):
+                fetches, persist_out = self._call_executable(
+                    exe_fn, (cache_key, shape_sig),
+                    (persist_in, chunk_vals, idxs, base_key0), obtain)
         except BaseException:
-            # anything between the count increment and the dispatch
-            # settling must close the in-flight gap (see
-            # _note_dispatch_failed); the fn calls below carry their
-            # own guards
             self._note_dispatch_failed()
             raise
-        t_dispatch = time.perf_counter()
-        with _profiler.RecordEvent("scan_dispatch",
-                                   args={"steps": int(iters)}):
-            if not compiling:
-                try:
-                    fetches, persist_out = fn(persist_in, chunk_vals,
-                                              idxs, base_key)
-                except BaseException:
-                    self._note_dispatch_failed()
-                    raise
-            else:
-                # The feed chunk rarely aliases an output (fetches
-                # are scalars), so XLA warns its donation "was not
-                # usable" at compile time — expected, and it would
-                # noise up every data-fed run. The PERSIST CARRY
-                # shares the donate list though, and a carry that
-                # stops aliasing (param buffers silently duplicated
-                # each chunk) must stay loud: suppress only when
-                # every buffer the warning names is a chunk aval AND
-                # no persistable shares that aval (ambiguity stays
-                # loud). catch_warnings mutates process-global state,
-                # so the window is confined to this one-off compile
-                # call — steady-state dispatches touch no warning
-                # machinery.
-                # the settlement guard spans the WHOLE branch: the
-                # warning replay below can itself raise (process runs
-                # warnings-as-errors) after fn() succeeded, and that
-                # exit too must close the in-flight gap
-                try:
-                    import re
-                    import warnings
-
-                    def _aval(v):
-                        return "%s[%s]" % (v.dtype, ",".join(
-                            str(d) for d in v.shape))
-
-                    chunk_avals = {_aval(v)
-                                   for v in chunk_vals.values()}
-                    persist_avals = {
-                        _aval(v) for v in persist_in.values()
-                        if hasattr(v, "shape") and hasattr(v, "dtype")}
-                    with warnings.catch_warnings(record=True) \
-                            as caught:
-                        warnings.simplefilter("always")
-                        fetches, persist_out = fn(persist_in,
-                                                  chunk_vals,
-                                                  idxs, base_key)
-                    for w in caught:
-                        msg = str(w.message)
-                        if "donated buffers were not usable" in msg:
-                            named = set(re.findall(
-                                r"ShapedArray\(([^)]+)\)", msg))
-                            if named and named <= chunk_avals \
-                                    and not named & persist_avals:
-                                continue  # feed-chunk-only: expected
-                        warnings.warn_explicit(w.message, w.category,
-                                               w.filename, w.lineno)
-                except BaseException:
-                    self._note_dispatch_failed()
-                    raise
         self._note_dispatch(time.perf_counter() - t_dispatch, iters)
         for name, val in persist_out.items():
             scope.set_var(name, val)
@@ -1480,31 +1847,36 @@ class Executor:
         if validate_feed:
             _check_feed_shape_type(block, feed)
         feed_names = tuple(sorted(feed))
+        mesh_fp = dist._fingerprint() if dist is not None else None
         # program._uid, NOT id(program) — see run_repeated's cache key
         # donate is baked into the jitted fn (donate_argnums), so it
         # must key the cache: a donate=False caller handed a donating
         # executable would have its param buffers invalidated mid-call
         cache_key = (program._uid, program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
-                     library, donate,
-                     dist._fingerprint() if dist is not None else None)
-        # per-SHAPE compile accounting: a cached jitted fn still
-        # retraces+recompiles for an unseen feed-shape signature, so
-        # the shape is part of what "compiled here" means
-        shape_sig = tuple(
-            (k, tuple(np.shape(feed[k])),
-             str(getattr(feed[k], "dtype", "")))
-            for k in feed_names)
-        with self._lock:
-            new_shape = (cache_key, shape_sig) not in self._compiled_sigs
-            if new_shape:
-                self._compiled_sigs.add((cache_key, shape_sig))
-                self._compile_count += 1
-        if new_shape:
-            self._note_compile("run", shape_sig)
-        fn = self._cache.get(cache_key) if use_program_cache else None
-        compiled_here = fn is None or new_shape
-        if fn is None:
+                     library, donate, mesh_fp)
+        # convert the feed BEFORE the per-SHAPE compile accounting:
+        # the signature must reflect the dtypes XLA actually sees
+        # (asarray canonicalizes int64 labels to int32, so the raw
+        # feed dtype would book phantom compiles), and the AOT
+        # executable keyed on it is called with exactly these values
+        with _profiler.RecordEvent("feed_h2d"):
+            if dist is not None:
+                feed_vals = {
+                    k: jax.device_put(
+                        v, dist.feed_sharding(np.shape(v), k))
+                    for k, v in feed.items()}
+            else:
+                feed_vals = {k: jnp.asarray(v)
+                             if not isinstance(v, jax.Array)
+                             else v
+                             for k, v in feed.items()}
+        shape_sig = tuple((k, tuple(feed_vals[k].shape),
+                           _dtype_tag(feed_vals[k]))
+                          for k in feed_names)
+        fresh_sig = self._book_fresh_sig(cache_key, shape_sig)
+
+        def make_fn():
             persistable_names = frozenset(
                 n for n, v in block.vars.items() if v.persistable)
             # trace-time only (the closure bakes it into the compiled
@@ -1539,21 +1911,44 @@ class Executor:
                 # the reference's single-threaded interpreter
                 # (executor.cc:415). Compiled recurrence goes through
                 # static_rnn/dynamic_rnn/beam-search instead.
-                fn = step
-            else:
-                jit_kwargs = {}
-                if donate:
-                    jit_kwargs["donate_argnums"] = (0,)
-                if dist is not None:
-                    # Pin persistable outputs to their input shardings so
-                    # parameters keep a stable layout across steps
-                    # (donation then reuses the buffers in place).
-                    persist_sharding = {
-                        n: dist.persist_sharding(block.vars[n])
-                        for n in persist_in}
-                    jit_kwargs["out_shardings"] = (None, persist_sharding)
-                fn = jax.jit(step, **jit_kwargs)
-            self._cache[cache_key] = fn
+                return step
+            jit_kwargs = {}
+            if donate:
+                jit_kwargs["donate_argnums"] = (0,)
+            if dist is not None:
+                # Pin persistable outputs to their input shardings so
+                # parameters keep a stable layout across steps
+                # (donation then reuses the buffers in place).
+                persist_sharding = {
+                    n: dist.persist_sharding(block.vars[n])
+                    for n in persist_in}
+                jit_kwargs["out_shardings"] = (None, persist_sharding)
+            return jax.jit(step, **jit_kwargs)
+
+        base_key0 = self._base_key(program)
+        if use_program_cache:
+            def obtain():
+                return self._executable_for(
+                    cache_key, shape_sig, "run", program, make_fn,
+                    lambda: (persist_in, feed_vals,
+                             jax.random.fold_in(base_key0, 0)),
+                    mesh_fp=mesh_fp)
+
+            exe_fn = obtain()
+        else:
+            # explicit no-caching contract: fresh traceable each call,
+            # jit-dispatched (jit compiles internally, invisibly to
+            # the AOT ledger beyond this booking)
+            obtain = None
+            exe_fn = make_fn()
+            if fresh_sig:
+                reason = self._classify_miss(cache_key, program,
+                                             shape_sig, mesh_fp,
+                                             None, None)
+                self._book_prog_sig(cache_key, program, shape_sig,
+                                    mesh_fp)
+                self._note_provenance("run", shape_sig, reason, None,
+                                      mesh_fp, 0.0, mode="uncached")
 
         with self._lock:
             counter = self._run_counter
@@ -1562,30 +1957,19 @@ class Executor:
         self._m_dispatch.inc()
         self._m_steps.inc()
         # the failed-settlement guard covers EVERYTHING after the
-        # count increment (feed conversion/device_put included), or
-        # an exception in between leaves dispatch_inflight() stuck
-        # True forever
+        # count increment, or an exception in between leaves
+        # dispatch_inflight() stuck True forever
         try:
-            step_key = jax.random.fold_in(self._base_key(program),
-                                          counter)
-            with _profiler.RecordEvent("feed_h2d"):
-                if dist is not None:
-                    feed_vals = {
-                        k: jax.device_put(
-                            v, dist.feed_sharding(np.shape(v), k))
-                        for k, v in feed.items()}
-                else:
-                    feed_vals = {k: jnp.asarray(v)
-                                 if not isinstance(v, jax.Array)
-                                 else v
-                                 for k, v in feed.items()}
-            # first invocation of a jitted step traces + compiles
-            span = "executor_trace_compile" if compiled_here \
-                else "executor_run"
+            step_key = jax.random.fold_in(base_key0, counter)
             t0 = time.perf_counter()
-            with _profiler.RecordEvent(span):
-                fetches, persist_out = fn(persist_in, feed_vals,
-                                          step_key)
+            with _profiler.RecordEvent("executor_run"):
+                if obtain is not None:
+                    fetches, persist_out = self._call_executable(
+                        exe_fn, (cache_key, shape_sig),
+                        (persist_in, feed_vals, step_key), obtain)
+                else:
+                    fetches, persist_out = exe_fn(persist_in,
+                                                  feed_vals, step_key)
         except BaseException:
             self._note_dispatch_failed()
             raise

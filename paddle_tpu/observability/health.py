@@ -824,7 +824,13 @@ class FlightRecorder:
 # process singletons + /healthz
 # ---------------------------------------------------------------------------
 
-_SINGLETON_MU = threading.Lock()
+# REENTRANT by design: get_watchdog() calls get_recorder() under it,
+# and a future accessor / watchdog callback reached from inside one of
+# these MUST NOT deadlock the way the CLI path once did (a plain Lock
+# here wedged `doctor`-adjacent tooling but never pytest, because
+# pytest happened to create the recorder first). Hardened PR 11 —
+# regression-tested by test_health.py::TestSingletonReentrancy.
+_SINGLETON_MU = threading.RLock()
 _WATCHDOG: Optional[Watchdog] = None
 _RECORDER: Optional[FlightRecorder] = None
 
@@ -839,10 +845,11 @@ def get_watchdog(role: Optional[str] = None,
     wd = _WATCHDOG
     if wd is not None:
         return wd
-    rec = get_recorder()  # before _SINGLETON_MU: the lock is not
-    #                       reentrant and get_recorder takes it too
     with _SINGLETON_MU:
         if _WATCHDOG is None:
+            # safe under the (reentrant) singleton lock — this nested
+            # acquisition is exactly the shape that used to deadlock
+            rec = get_recorder()
             _WATCHDOG = Watchdog(role=role, interval_s=interval_s)
             _WATCHDOG.attach_recorder(rec)
         return _WATCHDOG

@@ -232,16 +232,37 @@ class _ModelWorker:
 
     def _warmup(self):
         """Pre-compile one executable per bucket, smallest first, so
-        no client request ever pays a cold XLA compile."""
+        no client request ever pays a cold XLA compile. With a warm
+        persistent compile cache (PADDLE_TPU_COMPILE_CACHE_DIR shared
+        across the fleet) the buckets LOAD instead of compiling —
+        replica cold-start and hot-swap warmup become O(read) — and
+        the ``serving_warmup`` journal event says which happened: how
+        many true XLA compiles this warmup paid vs how many
+        executables it reused (in-process or loaded from the cache of
+        a sibling process)."""
+        from paddle_tpu import compile_cache as _ccache
+        exe = self.predictor.exe
+        xla0 = exe.xla_compile_count
+        loads0 = exe.cache_load_count
+        t0 = time.perf_counter()
         for b in self.buckets:
             feed = self._warmup_feed(b)
             if feed is None:
-                return
+                break
             with _profiler.RecordEvent(
                     "serving_warmup_compile",
                     args={"model": self.name, "bucket": b}):
                 self.predictor.predict(feed)
             self.warmed_buckets.append(b)
+        # hits from THIS executor's load counter, not the
+        # process-global cache counters: a sibling model warming
+        # concurrently must not cross-attribute its hits here
+        _obs.emit("serving_warmup", model=self.name,
+                  buckets=list(self.warmed_buckets),
+                  xla_compiles=exe.xla_compile_count - xla0,
+                  cache_hits=(exe.cache_load_count - loads0)
+                  if _ccache.active() is not None else None,
+                  wall_seconds=round(time.perf_counter() - t0, 6))
 
     # -- client side ---------------------------------------------------
     def submit(self, feed: Dict[str, np.ndarray],

@@ -63,6 +63,12 @@ def pytest_configure(config):
         "markers",
         "health: fleet health-plane test (tier-1; select alone with "
         "-m health)")
+    # compile-plane suite (compile_cache, provenance ledger,
+    # fusion_report): CPU-fast apart from two subprocess restarts
+    config.addinivalue_line(
+        "markers",
+        "compile: compile-plane observability test (tier-1; select "
+        "alone with -m compile)")
 
 
 @pytest.fixture(autouse=True)

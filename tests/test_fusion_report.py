@@ -1,0 +1,152 @@
+"""Fusion-boundary audit tests (PR 11): HLO parsing, boundary
+neighborhoods, the CLI JSON smoke, and the acceptance regression —
+the executor's rewrite boundaries (gradient-sync collective, guard
+gate) must not LOWER the transformer program's fused-kernel count.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import fusion_report  # noqa: E402
+
+pytestmark = pytest.mark.compile
+
+_HLO = """\
+HloModule jit_step, entry_computation_layout={()->f32[8,8]{1,0}}
+
+%fused_computation (param_0.1: f32[8,8]) -> f32[8,8] {
+  %param_0.1 = f32[8,8]{1,0} parameter(0)
+  %c = f32[] constant(2)
+  %b = f32[8,8]{1,0} broadcast(f32[] %c), dimensions={}
+  ROOT %m = f32[8,8]{1,0} multiply(%param_0.1, %b)
+}
+
+ENTRY %main.9 (Arg_0.1: f32[8,8], Arg_1.2: f32[8,8]) -> f32[8,8] {
+  %Arg_0.1 = f32[8,8]{1,0} parameter(0)
+  %Arg_1.2 = f32[8,8]{1,0} parameter(1)
+  %dot.3 = f32[8,8]{1,0} dot(%Arg_0.1, %Arg_1.2)
+  %fus.4 = f32[8,8]{1,0} fusion(f32[8,8]{1,0} %dot.3), kind=kLoop, calls=%fused_computation
+  %ar.5 = f32[8,8]{1,0} all-reduce(f32[8,8]{1,0} %fus.4), replica_groups={}
+  %sel.6 = f32[8,8]{1,0} select(pred[8,8]{1,0} %Arg_0.1, %ar.5, %Arg_1.2)
+  ROOT %add.7 = f32[8,8]{1,0} add(%sel.6, %Arg_1.2)
+}
+"""
+
+
+class TestAnalyzeHlo:
+    def test_counts_and_boundaries(self):
+        a = fusion_report.analyze_hlo(_HLO)
+        assert a["fused_kernels"] == 1
+        assert a["fusion_kinds"] == {"kLoop": 1}
+        assert a["instructions"] == 7
+        assert a["computations"] == 2
+        bounds = a["boundaries"]["collectives"]
+        assert len(bounds) == 1
+        ar = bounds[0]
+        assert ar["op"] == "all-reduce"
+        assert ar["fed_by_fusion"] is True   # fusion feeds it
+        assert ar["feeds_fusion"] is False   # bare select consumes it
+        assert "select" in ar["consumer_ops"]
+        # the top-level select + add are unfused elementwise residue
+        assert a["boundaries"]["gate_selects_top_level"] == 1
+        assert a["top_level_elementwise"]["add"] == 1
+
+    def test_tuple_typed_instructions_parse(self):
+        """Multi-output fusions, combined all-reduces, and ROOT tuples
+        carry a parenthesized tuple type between '=' and the opcode —
+        they must not drop out of the counts the audit gates on."""
+        hlo = (
+            "ENTRY %main (p0: f32[8]) -> (f32[8], f32[8]) {\n"
+            "  %p0 = f32[8]{0} parameter(0)\n"
+            "  %ar = (f32[8]{0}, f32[8]{0}) all-reduce(%p0, %p0), "
+            "replica_groups={}\n"
+            "  %gte = f32[8]{0} get-tuple-element(%ar), index=0\n"
+            "  %fus = (f32[8]{0}, f32[8]{0}) fusion(%gte), kind=kLoop, "
+            "calls=%fc\n"
+            "  ROOT %t = (f32[8]{0}, f32[8]{0}) tuple(%gte, %p0)\n"
+            "}\n")
+        a = fusion_report.analyze_hlo(hlo)
+        assert a["instructions"] == 5
+        assert a["fused_kernels"] == 1
+        assert [b["op"] for b in a["boundaries"]["collectives"]] == \
+            ["all-reduce"]
+
+    def test_calls_attr_not_counted_as_operand(self):
+        a = fusion_report.analyze_hlo(_HLO)
+        # the fusion's operand list is %dot.3 only — calls=%fused_...
+        # must not leak into the operand scan
+        comps = fusion_report._parse_computations(_HLO)
+        fus = next(i for i in comps["ENTRY"] if i["op"] == "fusion")
+        assert fus["operands"] == ["dot.3"]
+        assert a is not None
+
+
+class TestFusionReportLive:
+    def test_mlp_boundary_audit_q8_guard(self):
+        """q8 gradient-sync on a 2-way dp mesh + anomaly guard: the
+        report sees the training program, its collective boundary
+        instructions, and their fusion neighborhoods."""
+        rep = fusion_report.run_and_report(
+            "mlp", gradient_sync="q8", guard=True, devices=2)
+        train = [r for r in rep["programs"]
+                 if r["analysis"] and "x=" in r["shape_key"]]
+        assert train, rep["programs"]
+        a = train[0]["analysis"]
+        assert a["fused_kernels"] > 0
+        collectives = a["boundaries"]["collectives"]
+        assert collectives, "q8 rewrite produced no collective " \
+            "boundary instructions"
+        ops = {b["op"] for b in collectives}
+        assert "all-reduce" in ops or "all-gather" in ops
+        # the audit's point: every boundary should touch fusion on at
+        # least one side (a boundary with bare elementwise on BOTH
+        # sides means the rewrite split the fusion region)
+        touching = [b for b in collectives
+                    if b["fed_by_fusion"] or b["feeds_fusion"]]
+        assert touching, collectives
+
+    def test_transformer_rewrites_do_not_split_fusion(self):
+        """ACCEPTANCE: the transformer program with q8 gradient-sync +
+        anomaly guard keeps a fused-kernel count not lower than the
+        plain program — the executor's rewrite boundaries add their
+        own fused work but do not break the existing fusion regions.
+        LIKE-FOR-LIKE: the plain baseline runs on the SAME 2-device dp
+        mesh (implicit GSPMD sync, no explicit rewrites), so SPMD
+        partitioning cannot inflate the augmented count and mask a
+        real fusion split."""
+        plain = fusion_report.run_and_report("transformer", devices=2)
+        aug = fusion_report.run_and_report(
+            "transformer", gradient_sync="q8", guard=True, devices=2)
+        assert aug["fused_kernels_total"] >= \
+            plain["fused_kernels_total"], (
+                "rewrites LOWERED the fused-kernel count: %d -> %d"
+                % (plain["fused_kernels_total"],
+                   aug["fused_kernels_total"]))
+        assert aug["collective_boundaries_total"] > 0
+
+
+class TestCliSmoke:
+    def test_json_smoke(self, capsys):
+        rc = fusion_report.main(["--model", "mlp", "--json"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["model"] == "mlp"
+        assert rep["fused_kernels_total"] > 0
+        assert any(r["analysis"] for r in rep["programs"])
+        for r in rep["programs"]:
+            assert "entry" in r and "shape_key" in r
+
+    def test_text_summary(self, capsys):
+        rc = fusion_report.main(["--model", "mlp"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "fused kernels" in out

@@ -691,3 +691,37 @@ class TestBenchDiff:
         # lower-is-better heuristic: a latency RISE is the regression
         assert ("p99_latency", "REGRESSION") in flags
         assert ("dead_row", "RECOVERED") in flags
+
+
+# ---------------------------------------------------------------------------
+# singleton-lock reentrancy (PR 11 hardening)
+# ---------------------------------------------------------------------------
+
+class TestSingletonReentrancy:
+    def test_accessors_safe_under_singleton_lock(self):
+        """Regression for the known `_SINGLETON_MU` pitfall: the
+        singleton accessors must be callable while the lock is already
+        held by the same thread (a future watchdog/recorder callback
+        reaching back into the accessors is exactly this shape). With
+        the old non-reentrant Lock this thread parks forever — the
+        deadlock that only ever surfaced in the CLI path, because
+        pytest happened to create the recorder first."""
+        done = []
+
+        def inner():
+            with health._SINGLETON_MU:
+                health.get_recorder()
+                health.get_watchdog()
+            done.append(True)
+
+        t = threading.Thread(target=inner, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert done, ("health singleton accessors deadlocked while "
+                      "_SINGLETON_MU was held by the calling thread")
+
+    def test_get_watchdog_still_singleton(self):
+        wd1 = health.get_watchdog()
+        wd2 = health.get_watchdog()
+        assert wd1 is wd2
+        assert health.get_recorder() in wd1._recorders

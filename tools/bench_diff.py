@@ -42,6 +42,13 @@ from typing import Dict, List, Optional
 
 __all__ = ["load_rounds", "diff", "format_report"]
 
+# explicit higher-is-better override, checked FIRST: cache hit rates
+# and throughputs whose unit strings would otherwise trip the
+# lower-is-better heuristic below (e.g. "hit fraction")
+_HIGHER_IS_BETTER = re.compile(
+    r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps)",
+    re.IGNORECASE)
+
 # lower-is-better heuristic by unit/metric name: a drop in these is an
 # improvement, a rise is the regression
 _LOWER_IS_BETTER = re.compile(
@@ -153,7 +160,8 @@ def _flag_transition(metric, prev, cur, threshold, cur_error=None):
     if pv_f == 0:
         return (None, None)
     unit = (cur.get("unit") or "") + " " + metric
-    lower_better = bool(_LOWER_IS_BETTER.search(unit))
+    lower_better = bool(_LOWER_IS_BETTER.search(unit)) \
+        and not _HIGHER_IS_BETTER.search(unit)
     change = (cv_f - pv_f) / abs(pv_f)
     if not lower_better and change < -threshold:
         return ("REGRESSION", "%.4g -> %.4g (%.0f%%)"

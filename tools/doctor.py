@@ -254,6 +254,12 @@ def _detect_network_flaky(kinds):
 
 
 def _detect_recompile_storm(kinds, window_s=60.0, threshold=8):
+    """Names the CULPRIT, not just the storm: since the provenance
+    ledger (PR 11) every ``executor_compile`` event carries the entry
+    point, a stable ``shape_key`` (the shape bucket), and a
+    ``miss_reason`` — so the verdict cites the top offending
+    (entry, shape-bucket) pair and the reason mix instead of leaving
+    the reader to grep the journal."""
     evs = kinds.get("executor_compile", [])
     if len(evs) < threshold:
         return []
@@ -268,15 +274,40 @@ def _detect_recompile_storm(kinds, window_s=60.0, threshold=8):
     if best_n < threshold:
         return []
     rate_min = best_n / (window_s / 60.0)
-    entries = collections.Counter(
-        str(e.get("entry", "?")) for e in evs)
-    top_entry, top_n = entries.most_common(1)[0]
-    return [_diag("recompile_storm",
-                  "recompile storm: %d compiles within %.0fs "
-                  "(%.0f compiles/min), %d of them on entry %r — "
-                  "shape churn is defeating the compile cache"
-                  % (best_n, window_s, rate_min, top_n, top_entry),
-                  [_cite(e, "entry", "nth") for e in evs[:12]])]
+    # culprit/reason counts over the STORM WINDOW's events only — a
+    # journal spanning hours must not let historical compiles outvote
+    # the burst actually driving the verdict
+    in_window = [e for e in evs
+                 if best_t0 <= float(e.get("t_wall") or 0.0)
+                 <= best_t0 + window_s]
+    pairs = collections.Counter(
+        (str(e.get("entry", "?")), str(e.get("shape_key") or "?"))
+        for e in in_window)
+    (top_entry, top_shape), top_n = pairs.most_common(1)[0]
+    reasons = collections.Counter(
+        str(e.get("miss_reason")) for e in in_window
+        if e.get("miss_reason") is not None)
+    reason_bit = ""
+    if reasons:
+        reason_bit = "; miss reasons: " + ", ".join(
+            "%s x%d" % (r, n) for r, n in reasons.most_common(3))
+    shape_bit = "" if top_shape == "?" \
+        else " shape bucket %s" % top_shape
+    d = _diag("recompile_storm",
+              "recompile storm: %d compiles within %.0fs "
+              "(%.0f compiles/min), %d of them on entry %r%s%s — "
+              "shape churn is defeating the compile cache"
+              % (best_n, window_s, rate_min, top_n, top_entry,
+                 shape_bit, reason_bit),
+              [_cite(e, "entry", "shape_key", "miss_reason", "nth")
+               for e in in_window[:12]],
+              detail="top offender: entry=%r shape=%s (%d/%d compiles "
+              "in the storm window)"
+              % (top_entry, top_shape, top_n, len(in_window)))
+    d["culprit"] = {"entry": top_entry, "shape_key": top_shape,
+                    "count": top_n,
+                    "miss_reasons": dict(reasons)}
+    return [d]
 
 
 def _detect_overload(kinds, threshold=5):

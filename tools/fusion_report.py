@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""Fusion-boundary audit: dump per-program fused-kernel counts and the
+fusion decisions at the executor's rewrite boundaries.
+
+Operator fusion is the dominant, fragile determinant of step time on an
+XLA backend (PAPERS.md arXiv:2301.13062), and the executor injects
+whole-program rewrites exactly where fusion is most at risk:
+
+  - the **gradient-sync** boundary (parallel/collectives.py): explicit
+    quant/dequant + collective ops spliced between backward and
+    optimizer;
+  - the **shard bracket** (ShardedUpdatePlan): reduce-scatter → sharded
+    update → all-gather around every parameter's update;
+  - the **guard gate** (resilience/guard.py): every optimize-role op's
+    writes select-gated on the in-graph all-finite flag.
+
+This tool makes those decisions visible: it reads the OPTIMIZED
+(post-fusion) HLO of every AOT executable an Executor holds
+(``Executor.aot_artifacts()``), counts fused kernels, and reports — for
+each boundary-class instruction (collectives, gated selects) — whether
+XLA fused its producers and consumers around it or left bare
+elementwise ops at top level (the split-fusion smell).
+
+Library use::
+
+    report = fusion_report(exe)          # after at least one run()
+    rep = analyze_hlo(optimized_text)    # one module
+
+CLI (also the bench `fused_kernel_count` row and the tier-1 JSON
+smoke)::
+
+    python tools/fusion_report.py --model mlp --json
+    python tools/fusion_report.py --model transformer \\
+        --gradient-sync q8 --guard --devices 2 --json
+
+The regression contract (tests/test_fusion_report.py): the transformer
+program with ``gradient_sync=q8`` + anomaly guard must not show a LOWER
+fused-kernel count than the plain program — i.e. the executor's
+rewrites add work but do not split the existing fusion regions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+__all__ = ["analyze_hlo", "fusion_report", "build_demo_program"]
+
+# boundary-class opcodes the executor's rewrites introduce: the
+# gradient-sync collective family plus the shard bracket's pair
+COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                  "all-to-all", "collective-permute")
+# the guard gate lowers to selects on the optimizer's writes; a select
+# LEFT AT TOP LEVEL (not folded into a fusion) is a split-fusion smell
+GATE_OPS = ("select",)
+
+_COMP_HEADER = re.compile(
+    r"^(ENTRY\s+)?%?[\w.$-]+\s*\([^)]*\)\s*->\s*.*\{\s*$")
+# the type between '=' and the opcode is either one token
+# (f32[8,8]{1,0}) or a PARENTHESIZED TUPLE with spaces — multi-output
+# fusions, combined all-reduces, and ROOT tuples all have the latter
+# and must not be dropped from the counts the audit gates on
+_INSTR = re.compile(
+    r"^\s+(ROOT\s+)?%([\w.$-]+)\s*=\s*(\([^)]*\)|\S+)\s+([\w-]+)\(")
+
+
+def _parse_computations(text: str) -> Dict[str, List[dict]]:
+    """{computation_name: [{name, op, operands}]} from HLO text.
+    Operand names are the %refs inside the opcode's argument list
+    (attribute refs like ``calls=%fused_computation`` are excluded by
+    slicing at the closing paren of the call)."""
+    comps = {}
+    cur = None
+    cur_name = None
+    for line in text.splitlines():
+        if _COMP_HEADER.match(line):
+            cur_name = line.split("(", 1)[0].strip()
+            if cur_name.startswith("ENTRY"):
+                cur_name = "ENTRY"
+            cur = comps.setdefault(cur_name, [])
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        if cur is None:
+            continue
+        m = _INSTR.match(line)
+        if not m:
+            continue
+        op = m.group(4)
+        rest = line[m.end():]
+        # operand list = up to the matching close paren of the call
+        depth = 1
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    rest = rest[:i]
+                    break
+        operands = re.findall(r"%([\w.$-]+)", rest)
+        cur.append({"name": m.group(2), "op": op,
+                    "operands": operands})
+    return comps
+
+
+def analyze_hlo(text: str) -> dict:
+    """Fusion statistics of ONE optimized HLO module: total top-level
+    instructions, fused-kernel count (by kind), boundary-class
+    instructions with their fusion neighborhoods, and the top-level
+    elementwise residue (ops fusion should normally have absorbed)."""
+    comps = _parse_computations(text)
+    entry = comps.get("ENTRY", [])
+    by_name = {i["name"]: i for i in entry}
+    kinds = collections.Counter(
+        m.group(1) for m in re.finditer(r"kind=(k\w+)", text))
+    fused = sum(1 for i in entry if i["op"] == "fusion")
+
+    elementwise = ("add", "subtract", "multiply", "divide", "select",
+                   "maximum", "minimum", "compare", "negate", "abs",
+                   "exponential", "tanh", "rsqrt", "sqrt", "convert")
+    residue = collections.Counter(
+        i["op"] for i in entry if i["op"] in elementwise)
+
+    # consumers map for neighborhood checks
+    consumers = collections.defaultdict(list)
+    for i in entry:
+        for o in i["operands"]:
+            consumers[o].append(i)
+
+    def neighborhood(instr):
+        feeds = [by_name[o]["op"] for o in instr["operands"]
+                 if o in by_name]
+        fed = [c["op"] for c in consumers.get(instr["name"], ())]
+        return {
+            "op": instr["op"], "name": instr["name"],
+            "fed_by_fusion": "fusion" in feeds,
+            "feeds_fusion": "fusion" in fed,
+            "producer_ops": sorted(set(feeds)),
+            "consumer_ops": sorted(set(fed)),
+        }
+
+    boundaries = {"collectives": [], "gate_selects_top_level": 0}
+    for i in entry:
+        if i["op"] in COLLECTIVE_OPS:
+            boundaries["collectives"].append(neighborhood(i))
+        elif i["op"] in GATE_OPS:
+            # a top-level select is a gate (or other elementwise pick)
+            # fusion chose NOT to absorb
+            boundaries["gate_selects_top_level"] += 1
+
+    return {
+        "instructions": len(entry),
+        "fused_kernels": fused,
+        "fusion_kinds": dict(kinds),
+        "computations": len(comps),
+        "top_level_elementwise": dict(residue),
+        "boundaries": boundaries,
+    }
+
+
+def fusion_report(exe) -> List[dict]:
+    """One analysis record per AOT executable ``exe`` currently holds
+    (run the program at least once first). Interpret-mode entries and
+    backends without optimized-text introspection yield a record with
+    ``analysis: None``."""
+    out = []
+    for art in exe.aot_artifacts():
+        text = art.pop("optimized_hlo", None)
+        rec = dict(art)
+        rec["analysis"] = analyze_hlo(text) if text else None
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# demo programs (CLI + bench row + smoke test)
+# ---------------------------------------------------------------------------
+
+def build_demo_program(model="mlp", gradient_sync=None, guard=False,
+                       devices=1, seed=7, wrap_mesh=False):
+    """Build (program-to-run, startup, feed, scope, loss) for the CLI:
+    a small MLP or a tiny transformer, optionally data-parallel with an
+    explicit gradient_sync rewrite and/or the anomaly guard — the three
+    boundary rewrites the audit exists for. ``wrap_mesh=True`` forces
+    the CompiledProgram/mesh wrapper even at devices=1 with no
+    rewrites: a like-for-like plain baseline on a single-device host
+    must carry the same GSPMD wrapper as the augmented program it is
+    compared against."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(main, startup):
+        if model == "transformer":
+            from paddle_tpu.models import transformer as T
+            cfg = T.TransformerConfig(
+                src_vocab=64, tgt_vocab=64, max_len=16, d_model=32,
+                d_ffn=64, n_head=2, n_layer=1, dropout=0.1)
+            loss, _tok, _ = T.transformer(cfg)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+            feed = T.make_fake_batch(cfg, max(4, devices))
+        else:
+            x = fluid.layers.data("x", shape=[32])
+            label = fluid.layers.data("label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(x, size=64, act="relu")
+            pred = fluid.layers.fc(h, size=8, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+            b = max(8, devices)
+            feed = {"x": rng.rand(b, 32).astype(np.float32),
+                    "label": rng.randint(0, 8, (b, 1)).astype(
+                        np.int64)}
+    scope = fluid.Scope()
+    if guard:
+        from paddle_tpu.resilience.guard import install_anomaly_guard
+        with fluid.scope_guard(scope):
+            install_anomaly_guard(main, loss=loss, scope=scope)
+    prog = main
+    if gradient_sync or devices > 1 or wrap_mesh:
+        from paddle_tpu.parallel import mesh as mesh_lib
+        bs = fluid.BuildStrategy()
+        if gradient_sync:
+            bs.gradient_sync = gradient_sync
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            build_strategy=bs,
+            mesh=mesh_lib.data_parallel_mesh(devices))
+    return prog, startup, feed, scope, loss
+
+
+def run_and_report(model="mlp", gradient_sync=None, guard=False,
+                   devices=1, wrap_mesh=False) -> dict:
+    """Build, compile (one run), audit. The returned dict is the CLI's
+    JSON payload: per-executable analyses + module totals."""
+    import paddle_tpu as fluid
+    prog, startup, feed, scope, loss = build_demo_program(
+        model, gradient_sync=gradient_sync, guard=guard,
+        devices=devices, wrap_mesh=wrap_mesh)
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    recs = fusion_report(exe)
+    analyzed = [r for r in recs if r.get("analysis")]
+    return {
+        "model": model, "gradient_sync": gradient_sync,
+        "guard": bool(guard), "devices": devices,
+        "programs": recs,
+        "fused_kernels_total": sum(
+            r["analysis"]["fused_kernels"] for r in analyzed),
+        "collective_boundaries_total": sum(
+            len(r["analysis"]["boundaries"]["collectives"])
+            for r in analyzed),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="mlp",
+                    choices=("mlp", "transformer"))
+    ap.add_argument("--gradient-sync", default=None,
+                    help="explicit collective rewrite to audit "
+                    "(exact|rs_ag|q8|sharded_update|sharded_update_q8)")
+    ap.add_argument("--guard", action="store_true",
+                    help="install the anomaly guard (gate-select "
+                    "boundary)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="dp mesh size (CPU tests force 8 virtual "
+                    "devices)")
+    ap.add_argument("--json", action="store_true",
+                    help="full JSON report (default: summary lines)")
+    args = ap.parse_args(argv)
+
+    # standalone CLI nicety: a multi-device audit on the CPU backend
+    # needs virtual devices (tests get this from conftest; the flag
+    # only affects the HOST platform, so it is harmless under TPU)
+    if args.devices > 1 and "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % max(8, args.devices)).strip()
+
+    rep = run_and_report(args.model, gradient_sync=args.gradient_sync,
+                         guard=args.guard, devices=args.devices)
+    if args.json:
+        print(json.dumps(rep, indent=1, default=repr))
+        return 0
+    print("fusion_report: model=%s gradient_sync=%s guard=%s "
+          "devices=%d" % (rep["model"], rep["gradient_sync"],
+                          rep["guard"], rep["devices"]))
+    for r in rep["programs"]:
+        a = r.get("analysis")
+        if not a:
+            print("  [%s %s] (no optimized HLO)"
+                  % (r.get("entry"), r.get("shape_key")))
+            continue
+        print("  [%s] %d instrs, %d fused kernels %s, "
+              "%d collective boundaries, %d top-level gate selects"
+              % (r.get("entry"), a["instructions"], a["fused_kernels"],
+                 a["fusion_kinds"], len(a["boundaries"]["collectives"]),
+                 a["boundaries"]["gate_selects_top_level"]))
+        for b in a["boundaries"]["collectives"][:8]:
+            print("    %s: fed_by_fusion=%s feeds_fusion=%s"
+                  % (b["op"], b["fed_by_fusion"], b["feeds_fusion"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
