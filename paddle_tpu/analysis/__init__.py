@@ -1,0 +1,106 @@
+"""Program verifier plane: static analysis of built Programs.
+
+Three layers (see docs/analysis.md):
+
+  - **verifier.py** — IR invariant passes over ``ir.Graph`` (use-
+    before-def / dangling reads, dead ops & unreachable writes,
+    slot/dtype/shape consistency, persistable writes outside the
+    optimizer, duplicate-output hazards) — MLIR-style per-pass
+    verification (arXiv:2002.11054) without tracing or compiling.
+  - **contracts.py** — machine-checkable pre/post conditions of every
+    executor rewrite: the gradient-sync splice, the ZeRO sharded
+    bracket, the anomaly-guard gates, the PS optimize-op split, the
+    pipelined chunk scan.
+  - **matrix.py** — the static composition-matrix checker: build and
+    verify every guard × gradient_sync × pipelined × PS combination,
+    turning the ROADMAP's "unverified seams" item into a fast CI gate.
+
+``verify_program`` is the front door; ``verify_and_report`` adds the
+journal wiring (one ``verifier_finding`` event per finding, so
+``tools/doctor.py`` can cite program defects next to runtime faults)
+and optional raise-on-error. Rewrites auto-verify when
+``FLAGS_verify_rewrites`` is on (env ``FLAGS_verify_rewrites=true``)
+— the debug/verify mode; ``tools/verify_program.py`` is the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.enforce import InvalidArgumentError
+from ..core.flags import FLAGS
+from .findings import (Finding, SEVERITIES, errors,  # noqa: F401
+                       format_findings, worst_severity)
+from .verifier import (DEFAULT_RULES, verify_graph,  # noqa: F401
+                       verify_program_ir)
+from .contracts import (check_collective_contract,  # noqa: F401
+                        check_contracts, check_guard_contract,
+                        check_pipeline_contract, check_ps_contract,
+                        check_sharded_contract)
+from .matrix import (build_training_program,  # noqa: F401
+                     composition_matrix)
+
+__all__ = [
+    "Finding", "SEVERITIES", "errors", "format_findings",
+    "worst_severity", "DEFAULT_RULES", "verify_graph",
+    "verify_program_ir", "verify_program", "verify_and_report",
+    "check_contracts", "check_guard_contract",
+    "check_collective_contract", "check_sharded_contract",
+    "check_ps_contract", "check_pipeline_contract",
+    "composition_matrix", "build_training_program",
+]
+
+def verify_program(program, feed=None, targets=None,
+                   gradient_sync=None, rules=DEFAULT_RULES,
+                   contracts=True) -> List[Finding]:
+    """Statically verify a built ``Program``: IR invariant passes
+    over every block plus (``contracts=True``) the rewrite
+    contracts. Returns the findings; never traces or compiles.
+
+    ``feed``: extra var names fed at run time (``is_data`` vars are
+    always assumed fed). ``targets``: fetch/output names — enables
+    dead-op liveness. ``gradient_sync``: the BuildStrategy mode the
+    program will run under (defaults to an attached strategy's)."""
+    out = verify_program_ir(program, rules=rules, feed=feed,
+                            targets=targets)
+    if contracts:
+        from .contracts import check_contracts as _cc
+        out += _cc(program, gradient_sync=gradient_sync)
+    return out
+
+
+def verify_and_report(program, stage: str, feed=None, targets=None,
+                      gradient_sync=None,
+                      raise_on_error: Optional[bool] = None
+                      ) -> List[Finding]:
+    """``verify_program`` + the observability wiring: every finding
+    becomes a ``verifier_finding`` journal event (citing rule,
+    severity, op index/type, var, and the rewrite ``stage`` that
+    triggered the check) so doctor can name program defects next to
+    runtime faults; error findings raise when ``raise_on_error``
+    (default: only in ``FLAGS_verify_rewrites`` mode)."""
+    from .. import observability as _obs
+    findings = verify_program(program, feed=feed, targets=targets,
+                              gradient_sync=gradient_sync)
+    for f in findings:
+        _obs.emit("verifier_finding", stage=stage,
+                  program_uid=getattr(program, "_uid", None),
+                  **f.to_dict())
+    if raise_on_error is None:
+        raise_on_error = bool(FLAGS.verify_rewrites)
+    errs = errors(findings)
+    if errs and raise_on_error:
+        raise InvalidArgumentError(
+            "program verifier found %d error(s) after %s:\n%s"
+            % (len(errs), stage, format_findings(errs)))
+    return findings
+
+
+def maybe_verify_rewrite(program, stage: str, **kw):
+    """The auto-run hook rewrites call: a no-op unless
+    ``FLAGS_verify_rewrites`` is on (so the build path stays free),
+    then a full verify_and_report with raise-on-error."""
+    if not FLAGS.verify_rewrites:
+        return None
+    return verify_and_report(program, stage, raise_on_error=True,
+                             **kw)

@@ -1,0 +1,345 @@
+"""Rewrite contracts: machine-checkable pre/post conditions for every
+executor rewrite.
+
+Each rewrite the runtime performs on (or around) a Program — the
+gradient-sync splice (parallel/collectives.GradSyncPlan), the ZeRO
+shard→update→gather bracket (ShardedUpdatePlan / ensure_sharded_state),
+the anomaly-guard gates (resilience/guard.install_anomaly_guard), the
+PS optimize-op split (transpiler.DistributeTranspiler) and the
+pipelined chunk scan (executor.run_pipelined) — declares here what
+must hold of the program BEFORE the rewrite can be applied and what
+must hold AFTER it was. The checks are purely static (no tracing, no
+compile) and each violation is a cited ``Finding``:
+
+  - guard: every state-mutating optimize-role op at/after the guard
+    boundary carries a ``gate`` attr (a missed gate is silent state
+    corruption on anomaly steps); no op carries the guard's flag gate
+    without the guard installed or before the flag can exist.
+  - collectives: a parameter gradient consumed by the optimizer passes
+    through EXACTLY one collective — an explicit collective op chained
+    onto a grad that a gradient_sync plan will also rewrite double-
+    syncs it (applied twice, the mean is divided twice).
+  - sharded bracket: shard-laid-out state (``_shard_geometry`` vars)
+    is never touched outside the bracket — the generalization of
+    executor._check_sharded_layout from "optimize-role ops" to every
+    op, plus "a shard layout with no bracket at all is unrunnable".
+  - PS split: optimize ops moved off the trainer entirely, every
+    trainable parameter's update landed on exactly one pserver, and no
+    pserver op gates on a trainer-side flag that cannot exist there.
+  - pipeline: the program is scannable (no eager-only tensor-array
+    ops), so ``run_pipelined``'s chunk scan can legally wrap it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..framework import Parameter, Program, grad_var_name
+from .findings import Finding
+
+# Explicit collective op types (ops/collective_ops.py). The implicit
+# plans (GradSyncPlan / ShardedUpdatePlan) are env rewrites, not ops;
+# a mode being set counts as one sync for every dense trainable grad.
+_COLLECTIVE_OP_TYPES = frozenset(("quant_allreduce",))
+
+
+# ---------------------------------------------------------------------------
+# anomaly-guard gate contract
+# ---------------------------------------------------------------------------
+
+def check_guard_contract(program: Program) -> List[Finding]:
+    from ..resilience import guard as _guard
+    out: List[Finding] = []
+    block = program.global_block()
+    installed = getattr(program, "_anomaly_guard", None) is not None
+    boundary = None
+    if installed:
+        boundary, grad_keys, _res = _guard._guard_entries(block)
+    has_accum = any(op.type == "grad_accumulate" for op in block.ops)
+
+    for i, op in enumerate(block.ops):
+        gate = op.attrs.get("gate")
+        if gate == _guard.FLAG_KEY:
+            if not installed:
+                out.append(Finding(
+                    "guard_gate_dangling", "error",
+                    "op carries gate=%r but the program has no "
+                    "anomaly guard installed — the flag is derived "
+                    "by the guard plan at trace time, so this gate "
+                    "reads an undefined key and the trace fails"
+                    % gate, op_index=i, op_type=op.type, var=gate))
+            elif boundary is not None and i < boundary:
+                out.append(Finding(
+                    "guard_gate_before_boundary", "error",
+                    "op is gated on the all-finite flag but sits "
+                    "BEFORE the guard boundary (op #%d) where the "
+                    "flag is derived from the gradients — the gate "
+                    "reads an undefined key" % boundary,
+                    op_index=i, op_type=op.type, var=gate))
+    if not installed or boundary is None:
+        return out
+
+    for i, op in enumerate(block.ops[boundary:], boundary):
+        if op.attrs.get("op_role") != "optimize":
+            continue
+        if has_accum and op.type == "grad_accumulate":
+            continue  # zero-grads mode: accumulation stays ungated
+        writes_persistable = any(
+            (v := block.vars.get(n)) is not None and v.persistable
+            for n in op.output_arg_names)
+        if writes_persistable and "gate" not in op.attrs:
+            out.append(Finding(
+                "guard_gate_missing", "error",
+                "optimize-role op writes persistable state after the "
+                "guard boundary but carries NO gate attr — on an "
+                "anomaly step every gated op skips its update while "
+                "this one applies NaN-poisoned values: silent state "
+                "corruption",
+                op_index=i, op_type=op.type,
+                var=next((n for n in op.output_arg_names
+                          if (v := block.vars.get(n)) is not None
+                          and v.persistable), None)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gradient-collective contract
+# ---------------------------------------------------------------------------
+
+def _dense_trainable_params(block) -> Dict[str, Parameter]:
+    from ..parallel.collectives import _sparse_grad_params
+    sparse = _sparse_grad_params(block)
+    return {p.name: p for p in block.vars.values()
+            if isinstance(p, Parameter)
+            and getattr(p, "trainable", True)
+            and p.name not in sparse}
+
+
+def check_collective_contract(program: Program,
+                              gradient_sync: Optional[str] = None
+                              ) -> List[Finding]:
+    """``gradient_sync``: the BuildStrategy mode the program will run
+    under (None = implicit GSPMD). Every dense trainable ``@GRAD``
+    consumed by an optimize-role op must be synced exactly once."""
+    out: List[Finding] = []
+    block = program.global_block()
+    params = _dense_trainable_params(block)
+    grads = {grad_var_name(n): n for n in params}
+
+    # per-name write positions, so the def chain respects program
+    # order even for IN-PLACE rewrites (collective X == Out)
+    writes: Dict[str, List[int]] = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_arg_names:
+            writes.setdefault(n, []).append(i)
+
+    def producer(name, before):
+        """Index of the last op writing ``name`` before op
+        ``before``, or None (the value is raw at that point)."""
+        prev = None
+        for w in writes.get(name, ()):
+            if w >= before:
+                break
+            prev = w
+        return prev
+
+    mode_syncs = bool(gradient_sync)
+    consumed = set()
+    for i, op in enumerate(block.ops):
+        if op.attrs.get("op_role") != "optimize":
+            continue
+        for n in op.input_arg_names:
+            if n not in grads or (n, i) in consumed:
+                continue
+            consumed.add((n, i))
+            # walk the def chain backward counting explicit
+            # collective hops between the raw grad and this consumer
+            hops = []
+            cur, at = n, i
+            while True:
+                p = producer(cur, at)
+                if p is None:
+                    break
+                w = block.ops[p]
+                if w.type not in _COLLECTIVE_OP_TYPES:
+                    break
+                hops.append((p, w.type))
+                ins = w.inputs.get("X") or []
+                if not ins:
+                    break
+                cur, at = ins[0], p
+            n_syncs = len(hops) + (1 if mode_syncs else 0)
+            if n_syncs > 1:
+                detail = ", ".join("op#%d(%s)" % h for h in hops)
+                if mode_syncs:
+                    detail += " + gradient_sync=%r plan" \
+                        % gradient_sync
+                out.append(Finding(
+                    "double_collective", "error",
+                    "gradient %r reaches its optimizer through %d "
+                    "syncs (%s) — it is reduced twice, so the "
+                    "applied update is off by the world size"
+                    % (n, n_syncs, detail),
+                    op_index=i, op_type=op.type, var=n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sharded-bracket contract
+# ---------------------------------------------------------------------------
+
+def check_sharded_contract(program: Program) -> List[Finding]:
+    """Generalizes ``executor._check_sharded_layout``: NO op outside
+    the shard→update→gather bracket may touch shard-laid-out state,
+    whatever its role — a read sees a flat ``[padded]`` 1/n slice
+    where full-shape data is expected, a write corrupts the shards."""
+    out: List[Finding] = []
+    block = program.global_block()
+    shard_vars = {n for n, v in block.vars.items()
+                  if getattr(v, "_shard_geometry", None) is not None}
+    if not shard_vars:
+        return out
+    from ..core.enforce import UnimplementedError
+    from ..parallel.collectives import sharded_entries
+    try:
+        boundary, end, entries = sharded_entries(block, 1)
+    except UnimplementedError as e:
+        out.append(Finding("sharded_bracket_invalid", "error", str(e)))
+        return out
+    if boundary is None or end is None:
+        out.append(Finding(
+            "sharded_layout_without_bracket", "error",
+            "block declares shard-laid-out state (%s…) but has no "
+            "shard→update→gather bracket (no optimizer consumes a "
+            "parameter gradient) — the layout is unrunnable; restore "
+            "the optimizer or rebuild unsharded"
+            % sorted(shard_vars)[0]))
+        return out
+    for i, op in enumerate(block.ops):
+        if boundary <= i < end:
+            continue
+        touched = [n for n in (list(op.input_arg_names)
+                               + list(op.output_arg_names))
+                   if n in shard_vars]
+        for n in touched:
+            out.append(Finding(
+                "shard_layout_leak", "error",
+                "op touches shard-laid-out var %r OUTSIDE the "
+                "bracket [op#%d, op#%d) — it would see a flat 1/n "
+                "[padded] slice (or corrupt the shards) instead of "
+                "full-shape state" % (n, boundary, end),
+                op_index=i, op_type=op.type, var=n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PS-split contract
+# ---------------------------------------------------------------------------
+
+def check_ps_contract(origin: Program, trainer: Program,
+                      pserver_programs: Dict[str, Program]
+                      ) -> List[Finding]:
+    """Postconditions of the DistributeTranspiler optimize-op split:
+    the trainer kept no param updates, every trainable param's update
+    landed on exactly one pserver (block slices count per block), and
+    no server-side op gates on the trainer-side guard flag."""
+    from ..resilience.guard import FLAG_KEY
+    out: List[Finding] = []
+    tblock = trainer.global_block()
+    params = _dense_trainable_params(origin.global_block())
+    grads = {grad_var_name(n): n for n in params}
+    for i, op in enumerate(tblock.ops):
+        if op.attrs.get("op_role") == "optimize" and \
+                any(n in grads for n in op.input_arg_names):
+            out.append(Finding(
+                "ps_optimize_on_trainer", "error",
+                "optimize-role op consuming %r remained on the "
+                "trainer after the PS split — the parameter would be "
+                "updated on BOTH sides"
+                % next(n for n in op.input_arg_names if n in grads),
+                op_index=i, op_type=op.type,
+                var=next(n for n in op.input_arg_names
+                         if n in grads)))
+
+    served: Dict[str, List[str]] = {}
+    for ep, prog in pserver_programs.items():
+        for i, op in enumerate(prog.global_block().ops):
+            if op.attrs.get("op_role") != "optimize":
+                continue
+            if op.attrs.get("gate") == FLAG_KEY:
+                out.append(Finding(
+                    "ps_gate_dangling", "error",
+                    "pserver op carries the trainer-side guard gate "
+                    "%r — the flag is derived from the trainer's "
+                    "gradients and cannot exist server-side; the "
+                    "trace fails on %s" % (FLAG_KEY, ep),
+                    op_index=i, op_type=op.type, var=FLAG_KEY,
+                    extra={"endpoint": ep}))
+            for n in op.output_arg_names:
+                base = n.split(".block")[0]
+                if base in params:
+                    served.setdefault(n, []).append(ep)
+    for name, eps in served.items():
+        if len(eps) > 1:
+            out.append(Finding(
+                "ps_double_apply", "error",
+                "param (block) %r is updated on %d pservers (%s) — "
+                "each grad receipt applies the update twice"
+                % (name, len(eps), ", ".join(sorted(eps))),
+                var=name))
+    served_bases = {n.split(".block")[0] for n in served}
+    updated_origin = set()
+    for op in origin.global_block().ops:
+        if op.attrs.get("op_role") == "optimize":
+            updated_origin.update(n for n in op.output_arg_names
+                                  if n in params)
+    for pname in sorted(updated_origin - served_bases):
+        out.append(Finding(
+            "ps_param_not_served", "error",
+            "param %r has an optimize op in the origin program but "
+            "no pserver serves its update — its grads are sent into "
+            "the void and the param never trains" % pname,
+            var=pname))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pipeline (chunk-scan) contract
+# ---------------------------------------------------------------------------
+
+def check_pipeline_contract(program: Program) -> List[Finding]:
+    from ..executor import _needs_eager
+    from ..ops.control_flow_ops import ARRAY_OP_TYPES
+    out: List[Finding] = []
+    if _needs_eager(program):
+        eager = sorted({op.type for b in program.blocks
+                        for op in b.ops
+                        if op.type in ARRAY_OP_TYPES})
+        out.append(Finding(
+            "pipeline_not_scannable", "error",
+            "program contains eager-only tensor-array ops (%s) — "
+            "run_pipelined's chunk scan cannot wrap it; it falls "
+            "back to per-step dispatch (chunk_size=1 semantics)"
+            % ", ".join(eager)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# front door: program-shaped contract dispatch
+# ---------------------------------------------------------------------------
+
+def check_contracts(program: Program,
+                    gradient_sync: Optional[str] = None
+                    ) -> List[Finding]:
+    """The contracts that apply to a standalone program (the PS-split
+    contract needs the product set — call check_ps_contract with
+    them). ``gradient_sync`` defaults to the program's attached
+    BuildStrategy when one exists."""
+    if gradient_sync is None:
+        bs = getattr(program, "_build_strategy", None)
+        gradient_sync = getattr(bs, "gradient_sync", None)
+    out = []
+    out += check_guard_contract(program)
+    out += check_collective_contract(program, gradient_sync)
+    out += check_sharded_contract(program)
+    return out

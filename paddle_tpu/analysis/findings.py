@@ -1,0 +1,92 @@
+"""Finding: one verifier result with an op/var citation.
+
+The verifier plane's unit of output — every rule violation is a
+structured record naming the rule, a severity, and WHERE (block, op
+index, op type, var name), so a finding is checkable against the
+program the way a doctor diagnosis is checkable against the journal
+(tools/doctor.py cites ``role@seq``; the verifier cites ``block:op#``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# severities, most severe first. "error": the executor would crash at
+# trace time or — worse — silently corrupt state at run time.
+# "warning": legal but almost certainly not what was meant. "info":
+# notable composition facts (a mode that is inert in this program).
+SEVERITIES = ("error", "warning", "info")
+
+
+class Finding:
+    """One verifier finding. Immutable-ish value object; ``to_dict``
+    is the JSON the CLI prints and the journal event carries."""
+
+    __slots__ = ("rule", "severity", "message", "block", "op_index",
+                 "op_type", "var", "extra")
+
+    def __init__(self, rule: str, severity: str, message: str,
+                 block: int = 0, op_index: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 var: Optional[str] = None,
+                 extra: Optional[Dict] = None):
+        assert severity in SEVERITIES, severity
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.block = block
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+        self.extra = dict(extra or {})
+
+    @property
+    def citation(self) -> str:
+        """``block0:op#3(adam) var=fc_0.w_0@GRAD`` — the stable
+        reference a reader greps the program dump for."""
+        bits = ["block%d" % self.block]
+        if self.op_index is not None:
+            bits.append("op#%d(%s)" % (self.op_index,
+                                       self.op_type or "?"))
+        if self.var is not None:
+            bits.append("var=%s" % self.var)
+        return ":".join(bits[:1]) + (":" + " ".join(bits[1:])
+                                     if len(bits) > 1 else "")
+
+    def to_dict(self) -> Dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message, "block": self.block,
+             "op_index": self.op_index, "op_type": self.op_type,
+             "var": self.var, "citation": self.citation}
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def __repr__(self):
+        return "Finding(%s/%s %s: %s)" % (self.rule, self.severity,
+                                          self.citation, self.message)
+
+
+def errors(findings: List[Finding]) -> List[Finding]:
+    return [f for f in findings if f.severity == "error"]
+
+
+def worst_severity(findings: List[Finding]) -> Optional[str]:
+    for sev in SEVERITIES:
+        if any(f.severity == sev for f in findings):
+            return sev
+    return None
+
+
+def format_findings(findings: List[Finding]) -> str:
+    """Human-readable report (the CLI's default output)."""
+    if not findings:
+        return "verifier: clean (0 findings)"
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    lines = ["verifier: %d finding(s)" % len(findings)]
+    for f in sorted(findings, key=lambda f: (order[f.severity],
+                                             f.block,
+                                             f.op_index or -1)):
+        lines.append("  [%s] %s %s: %s" % (f.severity, f.rule,
+                                           f.citation, f.message))
+    return "\n".join(lines)

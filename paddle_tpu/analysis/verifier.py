@@ -1,0 +1,424 @@
+"""IR invariant passes: static well-formedness checks over a Program.
+
+MLIR-style verifier discipline (arXiv:2002.11054) on the ``ir.Graph``
+toolkit this repo already ships: each rule is a read-only ``ir.Pass``
+registered in the ordinary pass registry (so ``ir.all_pass_names()``
+lists them and ``get_pass`` instantiates them like any rewrite), run
+over the SSA node graph of every block. A rule never mutates the
+graph; it appends ``Finding``s to the injected ``findings`` attribute.
+
+The graph's var-node versioning does the heavy lifting: a read of a
+version with no writer is a *graph input* (legal only for
+persistables, feed vars, and declared-elsewhere parent-block vars);
+a version with no readers that a later version overwrites is an
+*unreachable write*; liveness from declared targets walks writer
+edges backward. All checks are static — no tracing, no compile.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .. import ops
+from ..framework import Parameter, Program, grad_var_name
+from ..ir import Graph, Pass, register_pass
+from ..ir.graph import Node
+from .findings import Finding
+
+# Ops whose writes accumulate into an existing env entry instead of
+# overwriting it (executor._scatter_outputs / _run_vjp_op): a second
+# write to the same name is a SUM, not a kill, so write-after-write
+# is legal for them.
+_ACCUMULATE_TYPES = ("vjp", "vjp2")
+
+# Ops with effects beyond their dataflow outputs (host I/O, RPC,
+# sub-block execution): never reported dead, and liveness roots.
+_SIDE_EFFECT_TYPES = frozenset((
+    "print", "py_func", "send", "recv", "while", "conditional_block",
+    "increment",  # global-step counters read by the host between steps
+))
+
+# Forward-role ops with sanctioned in-graph persistable state updates
+# (the reference's "stateful forward" class): moving statistics.
+_STATEFUL_FORWARD_TYPES = frozenset((
+    "batch_norm", "sync_batch_norm", "data_norm",
+))
+
+
+def _accumulates(op) -> bool:
+    if op.type in _ACCUMULATE_TYPES:
+        return True
+    if ops.has(op.type):
+        return ops.get(op.type).accumulate_outputs
+    return False
+
+
+def _op_positions(block) -> Dict[int, int]:
+    return {id(op): i for i, op in enumerate(block.ops)}
+
+
+class VerifierPass(Pass):
+    """Read-only pass: appends to the injected ``findings`` list.
+
+    Injected attrs (pass_base.Pass.set):
+      - ``findings``: the shared output list (required)
+      - ``feed``: extra var names fed at run time (optional)
+      - ``targets``: fetch/output var names for liveness (optional)
+    """
+
+    severity = "error"
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        self.check(graph, self.require("findings"))
+        return graph
+
+    def check(self, graph: Graph, out: List[Finding]):
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+    def _emit(self, out, graph, message, op=None, var=None,
+              severity=None, rule=None, **extra):
+        pos = _op_positions(graph.program.block(graph.block_idx))
+        out.append(Finding(
+            rule or self.name, severity or self.severity, message,
+            block=graph.block_idx,
+            op_index=pos.get(id(op.op)) if op is not None else None,
+            op_type=op.op.type if op is not None else None,
+            var=var, extra=extra or None))
+
+
+@register_pass
+class UseBeforeDefPass(VerifierPass):
+    """A read that no earlier write, feed, or persistable can satisfy
+    crashes the trace with "needs variable which has no value"
+    (executor.run_block) — or, for a name declared nowhere at all,
+    is a dangling reference left by a rewrite."""
+
+    name = "verify_use_before_def"
+
+    def check(self, graph, out):
+        feed: Set[str] = set(self.get("feed") or ())
+        block = graph.program.block(graph.block_idx)
+        parent = block.parent_block
+        reported = set()
+        for node in graph.var_nodes():
+            if node.inputs or not node.outputs:
+                continue  # has a writer, or is never read
+            name = node.name
+            if name in feed or name in reported:
+                continue
+            var = node.var
+            if var is None:
+                reader = node.outputs[0]
+                self._emit(out, graph,
+                           "op reads %r which is declared in no "
+                           "block and written by no earlier op — "
+                           "dangling reference (a rewrite renamed or "
+                           "dropped its producer?)" % name,
+                           op=reader, var=name, rule="dangling_read")
+                reported.add(name)
+                continue
+            if var.persistable or var.is_data:
+                continue  # scope carry / feed: defined at run time
+            if parent is not None and \
+                    parent._find_var_recursive(name) is not None:
+                # sub-block closing over a parent-block value: defined
+                # by the parent's execution (checked in ITS block)
+                continue
+            reader = node.outputs[0]
+            self._emit(out, graph,
+                       "op reads %r before any op writes it (not "
+                       "persistable, not a feed): the trace fails "
+                       "with 'needs variable which has no value'"
+                       % name, op=reader, var=name)
+            reported.add(name)
+
+
+@register_pass
+class DeadCodePass(VerifierPass):
+    """Two rules on the version chain:
+
+    - *unreachable write*: a non-accumulating op writes a var version
+      nothing reads before a later op overwrites it — the computed
+      value is silently discarded (the classic symptom of a splice
+      writing the wrong name).
+    - *dead op* (only when ``targets`` is injected): an op from which
+      no path of reads reaches a target, a persistable write, or a
+      side-effecting op — wasted work the fetch can never observe.
+    """
+
+    name = "verify_dead_code"
+    severity = "warning"
+
+    def check(self, graph, out):
+        # -- unreachable writes ------------------------------------------
+        by_name: Dict[str, List[Node]] = {}
+        for node in graph.var_nodes():
+            by_name.setdefault(node.name, []).append(node)
+        for name, versions in by_name.items():
+            versions.sort(key=lambda n: n.version)
+            for node in versions[:-1]:  # a later version exists
+                if node.outputs or not node.inputs:
+                    continue  # read, or graph input
+                writer = node.inputs[0]
+                if _accumulates(writer.op):
+                    continue
+                if node.var is not None and node.var.persistable:
+                    continue
+                over = versions[versions.index(node) + 1]
+                self._emit(
+                    out, graph,
+                    "op writes %r but op %s overwrites it before "
+                    "any read — the value is unreachable"
+                    % (name, over.inputs[0] if over.inputs else "?"),
+                    op=writer, var=name, rule="unreachable_write")
+
+        # -- dead ops (needs declared targets) ---------------------------
+        targets = self.get("targets")
+        if not targets:
+            return
+        targets = set(targets)
+        live: Set[int] = set()
+        frontier: List[Node] = []
+        for node in graph.op_nodes():
+            op = node.op
+            rooted = op.type in _SIDE_EFFECT_TYPES \
+                or op.attrs.get("sub_block") is not None
+            for vn in node.outputs:
+                if vn.var is not None and vn.var.persistable:
+                    rooted = True
+                if vn.name in targets and vn is self._last(vn, graph):
+                    rooted = True
+            if rooted:
+                live.add(id(node))
+                frontier.append(node)
+        while frontier:
+            n = frontier.pop()
+            for vn in n.inputs:
+                for w in vn.inputs:
+                    if id(w) not in live:
+                        live.add(id(w))
+                        frontier.append(w)
+        for node in graph.op_nodes():
+            if id(node) not in live:
+                outs = sorted({vn.name for vn in node.outputs})
+                self._emit(out, graph,
+                           "op influences no target %s, persistable, "
+                           "or side effect — dead code (outputs: %s)"
+                           % (sorted(targets), ", ".join(outs) or
+                              "none"),
+                           op=node, rule="dead_op")
+
+    @staticmethod
+    def _last(vn, graph):
+        latest = None
+        for n in graph.var_nodes(vn.name):
+            if latest is None or n.version > latest.version:
+                latest = n
+        return latest
+
+
+@register_pass
+class SlotConsistencyPass(VerifierPass):
+    """Op records must match their registered lowering's slot
+    structure, and the gradient family must match its parameters:
+    an op type with no lowering, an unknown slot, a multi-var
+    non-variadic slot, a vjp whose ``fwd_op_index`` desynchronized
+    from its forward op, or a ``param@GRAD`` declared with a dtype/
+    shape differing from the parameter's all fail at trace time (or
+    silently mis-gather) — catch them statically."""
+
+    name = "verify_slot_consistency"
+
+    def check(self, graph, out):
+        block = graph.program.block(graph.block_idx)
+        for node in graph.op_nodes():
+            op = node.op
+            if op.type in ("vjp", "vjp2"):
+                self._check_vjp(graph, out, node, block)
+                continue
+            if not ops.has(op.type):
+                self._emit(out, graph,
+                           "op type %r has no registered lowering — "
+                           "the trace raises UnimplementedError"
+                           % op.type, op=node, rule="unknown_op")
+                continue
+            opdef = ops.get(op.type)
+            in_slots = {s: v for s, v in opdef.input_slots}
+            out_slots = {s[:-1] if s.endswith("*") else s:
+                         s.endswith("*") for s in opdef.output_slots}
+            for slot, names in op.inputs.items():
+                if slot not in in_slots:
+                    self._emit(out, graph,
+                               "input slot %r is not declared by the "
+                               "%r lowering (have: %s) — its values "
+                               "are silently ignored"
+                               % (slot, op.type,
+                                  sorted(in_slots)), op=node,
+                               rule="unknown_slot", slot=slot)
+                elif not in_slots[slot] and len(names) > 1:
+                    self._emit(out, graph,
+                               "input slot %r of %r is not variadic "
+                               "but carries %d vars — only the first "
+                               "is consumed" % (slot, op.type,
+                                                len(names)),
+                               op=node, rule="slot_arity", slot=slot)
+            for slot, names in op.outputs.items():
+                if slot not in out_slots:
+                    self._emit(out, graph,
+                               "output slot %r is not declared by "
+                               "the %r lowering (have: %s) — its "
+                               "vars are never written"
+                               % (slot, op.type, sorted(out_slots)),
+                               op=node, rule="unknown_slot", slot=slot)
+
+    def _check_vjp(self, graph, out, node, block):
+        a = node.op.attrs
+        idx = a.get("fwd_op_index")
+        if idx is None:
+            return
+        if not (0 <= idx < len(block.ops)) \
+                or block.ops[idx].type != a.get("fwd_type"):
+            found = block.ops[idx].type \
+                if 0 <= idx < len(block.ops) else "<out of range>"
+            self._emit(out, graph,
+                       "vjp op's fwd_op_index=%s points at %s but "
+                       "records fwd_type=%r — a rewrite shifted op "
+                       "positions without remapping (Graph."
+                       "to_program does this; ad-hoc splices must "
+                       "too). Forward/backward RNG streams would "
+                       "silently desynchronize."
+                       % (idx, found, a.get("fwd_type")),
+                       op=node, rule="vjp_index_desync")
+
+
+@register_pass
+class GradFamilyPass(VerifierPass):
+    """``param@GRAD`` declarations must agree with their parameter:
+    dtype mismatch mis-accumulates, static-shape mismatch crashes the
+    optimizer lowering with a bare broadcast error."""
+
+    name = "verify_grad_family"
+
+    def check(self, graph, out):
+        block = graph.program.block(graph.block_idx)
+        for name, var in block.vars.items():
+            if not isinstance(var, Parameter):
+                continue
+            g = block.vars.get(grad_var_name(name))
+            if g is None or getattr(g, "_shard_geometry", None):
+                continue
+            if g.dtype != var.dtype:
+                self._emit(out, graph,
+                           "gradient %r is declared %s but its "
+                           "parameter is %s" % (g.name, g.dtype,
+                                                var.dtype),
+                           var=g.name, rule="grad_dtype_mismatch")
+            if g.shape and var.shape and -1 not in g.shape \
+                    and -1 not in var.shape \
+                    and tuple(g.shape) != tuple(var.shape):
+                self._emit(out, graph,
+                           "gradient %r is declared shape %s but its "
+                           "parameter is %s" % (g.name,
+                                                tuple(g.shape),
+                                                tuple(var.shape)),
+                           var=g.name, rule="grad_shape_mismatch")
+
+
+@register_pass
+class PersistableWritePass(VerifierPass):
+    """In a training block (one that contains optimize-role ops),
+    persistable state may only be written by optimizer-role ops —
+    plus the sanctioned stateful-forward class (moving statistics).
+    Anything else mutates checkpointed state outside the gated,
+    rolled-back update path: a write the anomaly guard cannot gate
+    and a rollback cannot see."""
+
+    name = "verify_persistable_writes"
+
+    def check(self, graph, out):
+        block = graph.program.block(graph.block_idx)
+        if not any(op.attrs.get("op_role") == "optimize"
+                   for op in block.ops):
+            return  # startup/inference program: init writes are its job
+        for node in graph.op_nodes():
+            op = node.op
+            if op.attrs.get("op_role") == "optimize" \
+                    or op.type in _STATEFUL_FORWARD_TYPES \
+                    or _accumulates(op):
+                continue
+            for vn in node.outputs:
+                if vn.var is None or not vn.var.persistable:
+                    continue
+                is_param = isinstance(vn.var, Parameter)
+                self._emit(
+                    out, graph,
+                    "%s-role op writes persistable %s%r outside the "
+                    "optimizer — unguarded, non-rollbackable state "
+                    "mutation" % (op.attrs.get("op_role") or "no",
+                                  "parameter " if is_param else "",
+                                  vn.name),
+                    op=node, var=vn.name,
+                    severity="error" if is_param else "warning")
+
+
+@register_pass
+class DuplicateOutputPass(VerifierPass):
+    """One op naming the same var in two output slots (or twice in
+    one non-accumulating slot): ``_scatter_outputs`` writes them in
+    slot order, so one silently wins — the duplicate-output hazard."""
+
+    name = "verify_duplicate_outputs"
+
+    def check(self, graph, out):
+        for node in graph.op_nodes():
+            op = node.op
+            if _accumulates(op):
+                continue
+            seen: Dict[str, str] = {}
+            for slot, names in op.outputs.items():
+                for n in names:
+                    if n in seen:
+                        self._emit(
+                            out, graph,
+                            "var %r appears in output slots %r and "
+                            "%r of one %r op — the later write "
+                            "silently overwrites the earlier"
+                            % (n, seen[n], slot, op.type),
+                            op=node, var=n)
+                    seen[n] = slot
+
+
+# Ordered rule set (errors before hygiene so reports read causally).
+DEFAULT_RULES = (
+    "verify_use_before_def",
+    "verify_slot_consistency",
+    "verify_grad_family",
+    "verify_duplicate_outputs",
+    "verify_persistable_writes",
+    "verify_dead_code",
+)
+
+
+def verify_graph(graph: Graph, rules=DEFAULT_RULES, feed=None,
+                 targets=None) -> List[Finding]:
+    from ..ir import get_pass
+    out: List[Finding] = []
+    for name in rules:
+        get_pass(name, findings=out, feed=feed,
+                 targets=targets).apply(graph)
+    return out
+
+
+def verify_program_ir(program: Program, rules=DEFAULT_RULES,
+                      feed=None, targets=None) -> List[Finding]:
+    """Run the IR invariant passes over every non-empty block."""
+    out: List[Finding] = []
+    for b in program.blocks:
+        if not b.ops:
+            continue
+        out.extend(verify_graph(Graph(program, b.idx), rules,
+                                feed=feed,
+                                targets=targets if b.idx == 0
+                                else None))
+    return out

@@ -253,6 +253,20 @@ class CompiledProgram:
                 # persistable carry for this step
                 collectives.ensure_residual_vars(
                     self.program, scope or global_scope())
+            if getattr(self, "_verified_version", None) != \
+                    self.program._version:
+                # debug/verify mode (FLAGS_verify_rewrites): statically
+                # verify the composed program once per version, right
+                # after the sharded-state/residual conversions rewrote
+                # its declarations. The memo is only booked when a
+                # verify actually RAN (maybe_verify returns None when
+                # the flag is off), so flipping the flag on mid-run
+                # still verifies the current version.
+                from .analysis import maybe_verify_rewrite
+                if maybe_verify_rewrite(self.program,
+                                        "compiled_program_run",
+                                        gradient_sync=gs) is not None:
+                    self._verified_version = self.program._version
         # ops that are mesh-aware (ring_attention, sp/ep lowerings)
         # read the ambient mesh during tracing
         with mesh_lib.mesh_guard(self._mesh):

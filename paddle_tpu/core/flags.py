@@ -167,3 +167,11 @@ FLAGS.define("multi_tensor_adam", False,
              "fusions already schedule well and the concat/slice "
              "copies only add traffic. Kept as the parity analog and "
              "for param-heavy models with many tiny tensors.")
+
+FLAGS.define("verify_rewrites", False,
+             "Run the static program verifier (paddle_tpu/analysis) "
+             "automatically after each executor rewrite — guard "
+             "install, sharded-state conversion, PS split, every "
+             "trace entry — and raise on error-severity findings. "
+             "The analysis plane's debug/verify mode; off (default) "
+             "the hooks cost one flag read.")

@@ -79,6 +79,15 @@ def _parse_args(argv=None):
         "(events.<role>.jsonl, observability.journal); defaults to "
         "--log_dir when that is set")
     parser.add_argument(
+        "--compile_cache_dir", default=None,
+        help="persistent AOT compile-cache directory shared by every "
+        "worker (PADDLE_TPU_COMPILE_CACHE_DIR). Default: inherit the "
+        "launcher's env var if set, else <journal_dir|log_dir>/"
+        "compile_cache, else ~/.cache/paddle_tpu/compile_cache — so "
+        "real fleets share one cache and warm restarts perform zero "
+        "XLA compiles (docs/compile.md). Pass an empty string to "
+        "disable stamping.")
+    parser.add_argument(
         "training_script",
         help="the script to launch (followed by its own args)")
     parser.add_argument("training_script_args", nargs=REMAINDER)
@@ -185,6 +194,32 @@ def _journal_dir(args):
         getattr(args, "log_dir", None)
 
 
+def default_compile_cache_dir(args=None):
+    """The fleet-shared persistent compile-cache directory
+    (ROADMAP compile-plane follow-up): an explicit
+    ``--compile_cache_dir`` wins; an empty string disables stamping;
+    otherwise the launcher's own PADDLE_TPU_COMPILE_CACHE_DIR (every
+    child inherits the env anyway — returning it keeps the contract
+    visible), else a ``compile_cache/`` sibling of the fleet's
+    journals/logs, else one stable per-user location so even ad-hoc
+    fleets share warm executables across restarts."""
+    explicit = getattr(args, "compile_cache_dir", None) \
+        if args is not None else None
+    if explicit is not None:
+        return explicit or None  # "" = opt out
+    env = os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR")
+    if env is not None:
+        # an INHERITED "" is the documented disabled value
+        # (compile_cache.active() reads it as off) — honor it as an
+        # explicit opt-out, don't fall through and re-enable
+        return env or None
+    jdir = _journal_dir(args) if args is not None else None
+    if jdir:
+        return os.path.join(jdir, "compile_cache")
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "paddle_tpu", "compile_cache")
+
+
 def _stamp_role(env, args, role):
     """Role tag + role-stamped event-journal path (the observability
     plane's per-process identity: journal events carry the role, and
@@ -198,6 +233,19 @@ def _stamp_role(env, args, role):
         env["PADDLE_TPU_EVENT_JOURNAL"] = os.path.join(
             jdir, "events.%s.jsonl" % role)
         env.setdefault("PADDLE_TPU_BLACKBOX_DIR", jdir)
+    # one persistent AOT compile cache per FLEET (same dir in every
+    # worker): replica N's first compile is replica N+1's cache hit,
+    # and a warm restart performs zero XLA compiles (compile_cache.py;
+    # concurrent writers are safe — atomic tmp+rename entries)
+    if getattr(args, "compile_cache_dir", None) == "":
+        # explicit opt-out must beat an INHERITED env var too: the
+        # child env is built as dict(os.environ, **env), and
+        # compile_cache.active() reads "" as disabled
+        env["PADDLE_TPU_COMPILE_CACHE_DIR"] = ""
+    else:
+        cdir = default_compile_cache_dir(args)
+        if cdir:
+            env["PADDLE_TPU_COMPILE_CACHE_DIR"] = cdir
 
 
 def _prefix_pump(pipe, role, sink):

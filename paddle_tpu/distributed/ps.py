@@ -148,6 +148,9 @@ class ListenAndServ:
         self._snapshot_every = max(1, int(snapshot_every))
         self._on_event = on_event
         self.events: List[dict] = []
+        # events queued under self._mu, flushed by the lock-dropping
+        # handler (_event_locked/_flush_events)
+        self._evq: List[tuple] = []
         self._mu = threading.Lock()
         # sync merge: name -> [(trainer_id|None, grad), ...]
         self._pending: Dict[str, List] = {}
@@ -218,6 +221,13 @@ class ListenAndServ:
 
     # -- events / chaos -----------------------------------------------------
     def _event(self, kind, **kw):
+        """Emit one structured event NOW: journal sink write plus the
+        arbitrary user ``on_event`` callback. Must never run under
+        ``self._mu`` — the callback may call back into this server
+        (taking the lock again) and the journal write is file I/O;
+        locked sections queue through ``_event_locked`` and the
+        handler flushes after dropping the lock (the split
+        ``tools/lock_lint.py`` enforces repo-wide)."""
         ev = dict(kind=kind, t=time.time(), **kw)
         self.events.append(ev)
         # structured journal twin: same kind, endpoint-attributed
@@ -231,6 +241,21 @@ class ListenAndServ:
                 self._on_event(ev)
             except Exception:
                 pass
+
+    def _event_locked(self, kind, **kw):
+        """Queue an event from inside a ``self._mu`` section; the
+        lock-dropping caller runs ``_flush_events``. FIFO, flushed
+        before the RPC reply goes out, so causal order (this event
+        precedes anything the acked trainer does next) is kept."""
+        self._evq.append((kind, kw))
+
+    def _flush_events(self):
+        if not self._evq:
+            return
+        with self._mu:
+            q, self._evq = self._evq, []
+        for kind, kw in q:
+            self._event(kind, **kw)
 
     def crash_after(self, verb: str, n: int):
         """Chaos seam: hard-kill the server (sockets closed, nothing
@@ -293,23 +318,30 @@ class ListenAndServ:
         name, tid, seq = unpack_wire_name(name)
         self.current_trainer_id = tid if tid is not None else 0
         grad, _ = deserialize_tensor(payload)
-        with self._mu:
-            self._touch_lease_locked(tid)
-            self._check_live_locked(tid)
-            if tid is not None and seq is not None:
-                if self._seen_send.seen(tid, seq):
-                    # replayed frame (client deadline / reconnect /
-                    # duplicated by the network): ack, never re-apply
-                    self._event("dup_send_ignored", name=name, tid=tid,
-                                seq=seq)
+        try:
+            with self._mu:
+                self._touch_lease_locked(tid)
+                self._check_live_locked(tid)
+                if tid is not None and seq is not None:
+                    if self._seen_send.seen(tid, seq):
+                        # replayed frame (client deadline / reconnect
+                        # / duplicated by the network): ack, never
+                        # re-apply
+                        self._event_locked("dup_send_ignored",
+                                           name=name, tid=tid,
+                                           seq=seq)
+                        return b""
+                if not self.sync_mode:
+                    self._apply(name, grad)
+                    self._maybe_snapshot_locked()
                     return b""
-            if not self.sync_mode:
-                self._apply(name, grad)
-                self._maybe_snapshot_locked()
-                return b""
-            self._pending.setdefault(name, []).append((tid, grad))
-            self._maybe_merge_locked(name)
-        return b""
+                self._pending.setdefault(name, []).append((tid, grad))
+                self._maybe_merge_locked(name)
+            return b""
+        finally:
+            # journal emits + the user on_event callback run only
+            # AFTER the lock dropped and BEFORE the ack goes out
+            self._flush_events()
 
     def _maybe_merge_locked(self, name):
         entries = self._pending.get(name)
@@ -363,6 +395,8 @@ class ListenAndServ:
             stale = self._barrier_waiters.pop(key, None)
             self._barrier_waiters[key] = (tid, base, responder)
             release = self._maybe_release_barrier_locked()
+        # snapshot events precede the acks that let trainers move on
+        self._flush_events()
         if stale is not None:
             # answer the superseded responder so the native layer frees
             # its parked request (its connection is typically dead)
@@ -411,10 +445,11 @@ class ListenAndServ:
         t0 = time.monotonic()
         try:
             self._snapshot_fn(self._boundary, meta)
-            self._event("snapshot", boundary=self._boundary)
+            self._event_locked("snapshot", boundary=self._boundary)
         except Exception as e:  # a failed snapshot must not kill serving
-            self._event("snapshot_failed", boundary=self._boundary,
-                        error=repr(e))
+            self._event_locked("snapshot_failed",
+                               boundary=self._boundary,
+                               error=repr(e))
         finally:
             # the durable write runs on the drain thread under _mu, so
             # no HEARTBEAT can renew a lease while it fsyncs; credit the
@@ -441,6 +476,7 @@ class ListenAndServ:
             for nm in list(self._pending):
                 self._maybe_merge_locked(nm)
             release = self._maybe_release_barrier_locked()
+        self._flush_events()
         self._release(release)
         return b""
 
@@ -472,13 +508,17 @@ class ListenAndServ:
     def _on_push_sparse(self, name, payload):
         self._drain_beacon.bump()
         name, tid, seq = unpack_wire_name(name)
-        with self._mu:
-            self._touch_lease_locked(tid)
-            if tid is not None and seq is not None:
-                if self._seen_push.seen(tid, seq):
-                    self._event("dup_push_ignored", name=name, tid=tid,
-                                seq=seq)
-                    return b""
+        try:
+            with self._mu:
+                self._touch_lease_locked(tid)
+                if tid is not None and seq is not None:
+                    if self._seen_push.seen(tid, seq):
+                        self._event_locked("dup_push_ignored",
+                                           name=name, tid=tid,
+                                           seq=seq)
+                        return b""
+        finally:
+            self._flush_events()
         ids, off = deserialize_tensor(payload)
         values, _ = deserialize_tensor(payload, off)
         self._table(name).push(ids, values)
@@ -521,8 +561,9 @@ class ListenAndServ:
                     w = self._barrier_waiters.pop(("t", t), None)
                     if w is not None:
                         evicted_waiters.append(w)
-                    self._event("trainer_evicted", tid=t,
-                                lease_timeout_s=self.lease_timeout_s)
+                    self._event_locked(
+                        "trainer_evicted", tid=t,
+                        lease_timeout_s=self.lease_timeout_s)
                 # purge the evictees' buffered partial-step grads: a
                 # trainer that died after sending SOME blocks must not
                 # have those summed into the shrunken-quorum merge (the
@@ -545,7 +586,8 @@ class ListenAndServ:
                                             self.lease_timeout_s))
                 aborted = list(self._barrier_waiters.values())
                 self._barrier_waiters = {}
-                self._event("barrier_aborted", tids=expired)
+                self._event_locked("barrier_aborted", tids=expired)
+        self._flush_events()
         self._release(release)
         if evicted_waiters:
             for tid, _, r in evicted_waiters:

@@ -1800,6 +1800,12 @@ class Executor:
             from .parallel.collectives import \
                 reject_stale_sharded_layout
             reject_stale_sharded_layout(block)
+        # debug/verify mode: the fast stale-layout check above guards
+        # the one corruption class cheaply; FLAGS_verify_rewrites
+        # escalates to the FULL static verifier (all IR invariant
+        # passes + rewrite contracts, analysis/) at every trace entry
+        from .analysis import maybe_verify_rewrite
+        maybe_verify_rewrite(block.program, "trace_entry")
 
     @staticmethod
     def _guard_plan(program, block):

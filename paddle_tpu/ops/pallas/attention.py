@@ -64,7 +64,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..registry import register, register_variant
-from .common import blk, interpret_mode
+from .common import CompilerParams, blk, interpret_mode
 
 _NEG_INF = -1e30
 
@@ -401,7 +401,7 @@ def _flash_fwd_1k(q, k, v, bias, seed_f, scale, rate, causal):
         grid=(BH // G,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((G, Sq, Dh), lambda i: (i, 0, 0)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret_mode(),
     )(*args)
@@ -442,7 +442,7 @@ def _flash_bwd_1k(q, k, v, bias, seed_f, o, g, scale, rate, causal):
             pl.BlockSpec((G, Sk, Dh), lambda i: (i, 0, 0)),
             pl.BlockSpec((G, Sk, Dh), lambda i: (i, 0, 0)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret_mode(),
     )(*args)
@@ -577,7 +577,7 @@ def _flash_fwd(q, k, v, bias, seed_f, scale, rate, causal):
             pltpu.VMEM((G, blk_q, 128), jnp.float32),
             pltpu.VMEM((G, blk_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
     )(*args)
@@ -758,7 +758,7 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
         out_specs=pl.BlockSpec((G, blk_q, Dh),
                                lambda i, j, kk: (i, j, 0)),
         scratch_shapes=[pltpu.VMEM((G, blk_q, Dh), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
     )(*ar)
@@ -778,7 +778,7 @@ def _flash_bwd(q, k, v, bias, seed_f, o, lse, g, scale, rate, causal):
         ],
         scratch_shapes=[pltpu.VMEM((G, blk_k, Dh), jnp.float32),
                         pltpu.VMEM((G, blk_k, Dh), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret_mode(),
     )(*ar)

@@ -35,7 +35,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .common import blk, interpret_mode
+from .common import CompilerParams, blk, interpret_mode
 
 _NEG = -1.0e30
 
@@ -188,7 +188,7 @@ def fwd_block(q, k, v, q_off, k_off, scale, causal):
         out_specs=(pl.BlockSpec((G, blk_q, Dh), lambda i, j: (i, j, 0)),
                    pl.BlockSpec((G, blk_q), lambda i, j: (i, j)),
                    pl.BlockSpec((G, blk_q), lambda i, j: (i, j))),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret_mode(),
     )(offs, q.reshape(BH, Sq, Dh), k.reshape(BH, Sk, Dh),
@@ -234,7 +234,7 @@ def bwd_block(q, k, v, do, lse, delta, q_off, k_off, scale, causal):
             pl.BlockSpec((G, blk_q, Dh), lambda i, j: (i, j, 0)),
             pl.BlockSpec((G, Sk, Dh), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((G, Sk, Dh), lambda i, j: (i, 0, 0))),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret_mode(),
     )(offs, q.reshape(BH, Sq, Dh), k.reshape(BH, Sk, Dh),

@@ -253,6 +253,11 @@ def install_anomaly_guard(program, loss=None, scope=None):
     reset_guard_state(scope)
     program._anomaly_guard = {"loss": loss}
     program._bump()
+    # debug/verify mode: prove the gate contract (every state-mutating
+    # optimize op gated, no gate before the boundary) right after the
+    # rewrite that establishes it
+    from ..analysis import maybe_verify_rewrite
+    maybe_verify_rewrite(program, "install_anomaly_guard")
     return program
 
 

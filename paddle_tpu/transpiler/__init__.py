@@ -34,6 +34,9 @@ from typing import Dict, List
 from ..core.enforce import UnavailableError, enforce
 from ..framework import (Parameter, Program, default_main_program,
                          default_startup_program, grad_var_name)
+# string constant (resilience.guard.FLAG_KEY) imported lazily-safe:
+# guard.py has no transpiler dependency, so the direct import is fine
+from ..resilience.guard import FLAG_KEY as _GUARD_FLAG_KEY
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
            "memory_optimize", "release_memory", "HashName",
@@ -350,6 +353,8 @@ class DistributeTranspiler:
                 if id(op) not in split]
         blk.ops = [blk.ops[i] for i in keep]
         trainer._bump()
+        from ..analysis import maybe_verify_rewrite
+        maybe_verify_rewrite(trainer, "ps_trainer_split")
         return trainer
 
     def _block_rename(self, pname, binfo):
@@ -405,13 +410,22 @@ class DistributeTranspiler:
                     _copy_var(blk, v, name=rename.get(n, n),
                               shape=new_shape(v),
                               persistable=v.persistable)
+            attrs = dict(op.attrs)
+            # anomaly-guard gates are trainer-side in-graph state: the
+            # all-finite flag is derived from the traced step's raw
+            # gradients by the guard plan, which cannot exist in a
+            # standalone server-side update program — a copied gate
+            # would read an undefined key and kill the pserver trace
+            # (found by analysis.composition_matrix, guard x PS).
+            if attrs.get("gate") == _GUARD_FLAG_KEY:
+                attrs.pop("gate")
             blk.append_op(
                 type=op.type,
                 inputs={sl: [rename.get(n, n) for n in ns]
                         for sl, ns in op.inputs.items()},
                 outputs={sl: [rename.get(n, n) for n in ns]
                          for sl, ns in op.outputs.items()},
-                attrs=dict(op.attrs))
+                attrs=attrs)
         return prog
 
     def get_param_program(self, pname) -> Program:
@@ -444,6 +458,8 @@ class DistributeTranspiler:
             for b in self._blocks[pname]:
                 if b["endpoint"] == endpoint:
                     self._append_param_ops(prog, pname, b)
+        from ..analysis import maybe_verify_rewrite
+        maybe_verify_rewrite(prog, "ps_pserver_split")
         return prog
 
     def params_on(self, endpoint) -> List[str]:

@@ -69,6 +69,12 @@ def pytest_configure(config):
         "markers",
         "compile: compile-plane observability test (tier-1; select "
         "alone with -m compile)")
+    # static-analysis suite (paddle_tpu/analysis verifier plane +
+    # tools/lock_lint.py): pure-static, no tracing or XLA compiles
+    config.addinivalue_line(
+        "markers",
+        "analysis: program-verifier / static-analysis test (tier-1; "
+        "select alone with -m analysis)")
 
 
 @pytest.fixture(autouse=True)

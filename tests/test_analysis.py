@@ -1,0 +1,571 @@
+"""Program verifier plane (paddle_tpu/analysis): IR invariant passes,
+rewrite contracts, the static composition-matrix checker,
+tools/verify_program.py, and the doctor wiring.
+
+Structure:
+  - known-bad corpus: one MINIMAL program per verifier rule, asserting
+    the rule fires with the right op/var citation and severity;
+  - zero-findings sweep: representative programs built exactly like
+    the rest of the test suite builds them (plain/guarded/q8/sharded
+    training, batch_norm, startup, inference clones, PS products)
+    produce NO findings of any severity — the no-false-positives bar;
+  - the full guard x gradient_sync x pipelined x PS matrix is swept
+    statically (no tracing, no XLA compile) with zero broken combos;
+  - CLI + journal/doctor integration.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import framework, layers, optimizer
+from paddle_tpu.analysis import (build_training_program,
+                                 check_collective_contract,
+                                 check_guard_contract,
+                                 check_pipeline_contract,
+                                 check_ps_contract,
+                                 check_sharded_contract,
+                                 composition_matrix, errors,
+                                 verify_program)
+from paddle_tpu.core.flags import FLAGS
+from paddle_tpu.framework import Program, program_guard
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+pytestmark = pytest.mark.analysis
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def build_plain(hidden=8):
+    return build_training_program(hidden=hidden)
+
+
+# ---------------------------------------------------------------------------
+# known-bad corpus: each seeded defect fires its rule, cited
+# ---------------------------------------------------------------------------
+
+class TestKnownBadCorpus:
+    def test_use_before_def_cited(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        u = b.create_var(name="never_written", shape=(8,),
+                         dtype="float32")
+        out = b.create_var(name="ubd_out", shape=(8,),
+                           dtype="float32")
+        b.append_op(type="relu", inputs={"X": [u]},
+                    outputs={"Out": [out]})
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "verify_use_before_def")
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.severity == "error"
+        assert f.var == "never_written"
+        assert f.op_type == "relu"
+        assert f.op_index == len(b.ops) - 1
+        assert "no value" in f.message
+
+    def test_dangling_read_cited(self):
+        main = Program()
+        with program_guard(main, Program()):
+            layers.data(name="x", shape=[4], dtype="float32")
+        b = main.global_block()
+        out = b.create_var(name="o", shape=(4,), dtype="float32")
+        b.append_op(type="relu", inputs={"X": ["ghost"]},
+                    outputs={"Out": [out]})
+        fs = by_rule(verify_program(main), "dangling_read")
+        assert len(fs) == 1
+        assert fs[0].severity == "error"
+        assert fs[0].var == "ghost"
+        assert fs[0].op_index == 0
+
+    def test_unreachable_write_cited(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        tmp = b.create_var(name="tmp_dead", shape=(1,),
+                           dtype="float32")
+        first = len(b.ops)
+        b.append_op(type="fill_constant", outputs={"Out": [tmp]},
+                    attrs={"shape": (1,), "dtype": "float32",
+                           "value": 1.0})
+        b.append_op(type="fill_constant", outputs={"Out": [tmp]},
+                    attrs={"shape": (1,), "dtype": "float32",
+                           "value": 2.0})
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "unreachable_write")
+        assert len(fs) == 1
+        assert fs[0].severity == "warning"
+        assert fs[0].var == "tmp_dead"
+        assert fs[0].op_index == first
+
+    def test_dead_op_needs_targets(self):
+        main, _s, _sc, loss = build_plain()
+        b = main.global_block()
+        dead = b.create_var(name="dead_out", shape=(1,),
+                            dtype="float32")
+        b.append_op(type="scale", inputs={"X": [loss]},
+                    outputs={"Out": [dead]}, attrs={"scale": 2.0})
+        # without targets: liveness unknowable, rule stays silent
+        assert not by_rule(verify_program(main, feed=("x", "y")),
+                           "dead_op")
+        fs = by_rule(verify_program(main, feed=("x", "y"),
+                                    targets=(loss,)), "dead_op")
+        assert len(fs) == 1
+        assert fs[0].severity == "warning"
+        assert fs[0].op_type == "scale"
+        assert "dead_out" in fs[0].message
+
+    def test_unknown_op_cited(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        b.append_op(type="warp_drive", inputs={}, outputs={})
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "unknown_op")
+        assert len(fs) == 1
+        assert fs[0].severity == "error"
+        assert fs[0].op_type == "warp_drive"
+
+    def test_duplicate_output_cited(self):
+        main, _s, _sc, loss = build_plain()
+        b = main.global_block()
+        dup = b.create_var(name="dup_v", shape=(1,),
+                           dtype="float32")
+        b.append_op(type="momentum",
+                    inputs={"Param": [dup], "Grad": [loss],
+                            "Velocity": [dup],
+                            "LearningRate": [loss]},
+                    outputs={"ParamOut": [dup],
+                             "VelocityOut": [dup]},
+                    attrs={"mu": 0.9})
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "verify_duplicate_outputs")
+        assert len(fs) == 1
+        assert fs[0].severity == "error"
+        assert fs[0].var == "dup_v"
+
+    def test_grad_dtype_mismatch_cited(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        gname = next(
+            n for n in b.vars if n.endswith("@GRAD")
+            and isinstance(b.vars.get(n[:-len("@GRAD")]),
+                           framework.Parameter))
+        b.vars[gname].dtype = "float64"
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "grad_dtype_mismatch")
+        assert [f.var for f in fs] == [gname]
+        assert fs[0].severity == "error"
+
+    def test_persistable_write_outside_optimizer(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        pname = next(n for n, v in b.vars.items()
+                     if isinstance(v, framework.Parameter))
+        b.append_op(type="scale", inputs={"X": [pname]},
+                    outputs={"Out": [pname]}, attrs={"scale": 0.5})
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "verify_persistable_writes")
+        assert len(fs) == 1
+        assert fs[0].severity == "error"  # a Parameter write
+        assert fs[0].var == pname
+
+    def test_vjp_index_desync_cited(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        filler = b.create_var(name="filler", shape=(1,),
+                              dtype="float32")
+        # shift every op position by one WITHOUT remapping
+        # fwd_op_index — the splice bug Graph.to_program guards
+        op = framework.Operator(b, "fill_constant", {},
+                                {"Out": [filler.name]},
+                                {"shape": (1,), "dtype": "float32",
+                                 "value": 0.0})
+        b.ops.insert(0, op)
+        main._bump()
+        fs = by_rule(verify_program(main, feed=("x", "y")),
+                     "vjp_index_desync")
+        assert fs and all(f.severity == "error" for f in fs)
+        assert "RNG" in fs[0].message
+
+    def test_missing_guard_gate_cited(self):
+        main, _s, _sc, _l = build_training_program(guard=True)
+        b = main.global_block()
+        victim = next(
+            i for i, op in enumerate(b.ops)
+            if op.attrs.get("gate") and any(
+                (v := b.vars.get(n)) is not None and v.persistable
+                for n in op.output_arg_names))
+        del b.ops[victim].attrs["gate"]
+        fs = by_rule(check_guard_contract(main), "guard_gate_missing")
+        assert len(fs) == 1
+        assert fs[0].severity == "error"
+        assert fs[0].op_index == victim
+        assert "silent state corruption" in fs[0].message
+        # the full front door surfaces it too
+        assert "guard_gate_missing" in rules_of(
+            verify_program(main, feed=("x", "y")))
+
+    def test_dangling_guard_gate_cited(self):
+        from paddle_tpu.resilience.guard import FLAG_KEY
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        gated = next(i for i, op in enumerate(b.ops)
+                     if op.type == "adam")
+        b.ops[gated].attrs["gate"] = FLAG_KEY
+        fs = by_rule(check_guard_contract(main),
+                     "guard_gate_dangling")
+        assert len(fs) == 1
+        assert fs[0].op_index == gated
+
+    def test_double_collective_cited(self):
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        gname = next(n for n in b.vars if n.endswith("@GRAD")
+                     and not b.vars[n].persistable
+                     and n[:-len("@GRAD")] in b.vars
+                     and isinstance(b.vars[n[:-len("@GRAD")]],
+                                    framework.Parameter))
+        boundary = next(i for i, op in enumerate(b.ops)
+                        if op.attrs.get("op_role") == "optimize"
+                        and gname in op.input_arg_names)
+        res = b.create_var(name="coll_res", shape=(1,),
+                           dtype="float32", persistable=True)
+        op = framework.Operator(
+            b, "quant_allreduce", {"X": [gname], "Residual": []},
+            {"Out": [gname], "ResidualOut": [res.name]},
+            {"op_role": "backward"})
+        b.ops.insert(boundary, op)
+        main._bump()
+        # one explicit collective + the q8 plan = synced twice
+        fs = by_rule(check_collective_contract(main, "q8"),
+                     "double_collective")
+        assert fs and fs[0].severity == "error"
+        assert fs[0].var == gname
+        assert "quant_allreduce" in fs[0].message
+        # without a plan the single explicit collective is legal
+        assert not check_collective_contract(main, None)
+        # chain a SECOND explicit collective: illegal even plan-less
+        op2 = framework.Operator(
+            b, "quant_allreduce", {"X": [gname], "Residual": []},
+            {"Out": [gname], "ResidualOut": [res.name]},
+            {"op_role": "backward"})
+        b.ops.insert(boundary + 1, op2)
+        main._bump()
+        fs = by_rule(check_collective_contract(main, None),
+                     "double_collective")
+        assert fs and fs[0].var == gname
+
+    def test_shard_layout_leak_cited(self):
+        main, _s, _sc, _l = build_training_program(
+            gradient_sync="sharded_update")
+        b = main.global_block()
+        slot = next(n for n, v in b.vars.items()
+                    if getattr(v, "_shard_geometry", None))
+        leak = b.create_var(name="leak_out", shape=(1,),
+                            dtype="float32")
+        b.append_op(type="scale", inputs={"X": [slot]},
+                    outputs={"Out": [leak]}, attrs={"scale": 1.0})
+        fs = by_rule(check_sharded_contract(main),
+                     "shard_layout_leak")
+        assert len(fs) == 1
+        assert fs[0].severity == "error"
+        assert fs[0].var == slot
+        assert fs[0].op_index == len(b.ops) - 1
+
+    def test_sharded_layout_without_bracket(self):
+        main, _s, _sc, _l = build_training_program(
+            gradient_sync="sharded_update")
+        b = main.global_block()
+        b.ops = [op for op in b.ops
+                 if op.attrs.get("op_role") != "optimize"]
+        main._bump()
+        fs = check_sharded_contract(main)
+        assert "sharded_layout_without_bracket" in {f.rule
+                                                    for f in fs}
+
+
+# ---------------------------------------------------------------------------
+# zero findings on every program the suite builds (no false positives)
+# ---------------------------------------------------------------------------
+
+class TestZeroFindings:
+    def assert_clean(self, program, **kw):
+        fs = verify_program(program, **kw)
+        assert fs == [], "false positives:\n%s" % "\n".join(
+            map(repr, fs))
+
+    def test_plain_training_and_startup(self):
+        main, startup, _sc, loss = build_plain()
+        self.assert_clean(main, feed=("x", "y"), targets=(loss,))
+        self.assert_clean(startup)
+
+    def test_guarded(self):
+        main, startup, _sc, loss = build_training_program(guard=True)
+        self.assert_clean(main, feed=("x", "y"), targets=(loss,))
+        self.assert_clean(startup)
+
+    def test_q8(self):
+        main, _s, _sc, loss = build_training_program(
+            gradient_sync="q8")
+        self.assert_clean(main, feed=("x", "y"), targets=(loss,),
+                          gradient_sync="q8")
+
+    def test_sharded_both_gathers(self):
+        for pg in ("fp32", "q8"):
+            main, _s, _sc, loss = build_training_program(
+                gradient_sync="sharded_update_q8", param_gather=pg)
+            self.assert_clean(main, feed=("x", "y"), targets=(loss,),
+                              gradient_sync="sharded_update_q8")
+
+    def test_guard_plus_sharded(self):
+        main, _s, _sc, loss = build_training_program(
+            guard=True, gradient_sync="sharded_update")
+        self.assert_clean(main, feed=("x", "y"), targets=(loss,),
+                          gradient_sync="sharded_update")
+
+    def test_batch_norm_stateful_forward(self):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[16], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            h = layers.fc(input=x, size=16)
+            h = layers.batch_norm(input=h)
+            out = layers.fc(input=h, size=1)
+            loss = layers.reduce_mean(
+                layers.square_error_cost(out, y))
+            optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        self.assert_clean(main, feed=("x", "y"),
+                          targets=(loss.name,))
+        self.assert_clean(startup)
+
+    def test_inference_clone(self):
+        main, _s, _sc, loss = build_plain()
+        infer = main.clone(for_test=True)
+        self.assert_clean(infer, feed=("x", "y"))
+
+    def test_ps_products_clean(self):
+        from paddle_tpu.transpiler import DistributeTranspiler
+        main, startup, _sc, _l = build_plain()
+        eps = "127.0.0.1:26170,127.0.0.1:26171"
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=eps, trainers=2,
+                    startup_program=startup)
+        trainer = t.get_trainer_program()
+        pservers = {ep: t.get_pserver_program(ep)
+                    for ep in eps.split(",")}
+        self.assert_clean(trainer, feed=("x", "y"))
+        for prog in pservers.values():
+            self.assert_clean(prog)
+        assert check_ps_contract(main, trainer, pservers) == []
+
+    def test_guarded_ps_products_clean(self):
+        """The seam the matrix checker found: pserver programs built
+        from a GUARDED origin used to carry dangling
+        gate=__guard_all_finite__ attrs (an undefined env key
+        server-side). The transpiler now strips them."""
+        from paddle_tpu.resilience.guard import FLAG_KEY
+        from paddle_tpu.transpiler import DistributeTranspiler
+        main, startup, _sc, _l = build_training_program(guard=True)
+        eps = "127.0.0.1:26270,127.0.0.1:26271"
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=eps, trainers=2,
+                    startup_program=startup)
+        trainer = t.get_trainer_program()
+        pservers = {ep: t.get_pserver_program(ep)
+                    for ep in eps.split(",")}
+        for prog in pservers.values():
+            assert not any(op.attrs.get("gate") == FLAG_KEY
+                           for op in prog.global_block().ops)
+            self.assert_clean(prog)
+        assert check_ps_contract(main, trainer, pservers) == []
+
+    def test_pipeline_contract_scannable(self):
+        main, _s, _sc, _l = build_plain()
+        assert check_pipeline_contract(main) == []
+
+
+# ---------------------------------------------------------------------------
+# the static composition matrix
+# ---------------------------------------------------------------------------
+
+class TestCompositionMatrix:
+    def test_full_matrix_static_and_clean(self):
+        rep = composition_matrix()
+        # 2 guard x 6 sync x 2 pipelined x 2 ps = 48 combos, all
+        # classified, zero broken — the ROADMAP "seams" CI gate
+        assert len(rep["combos"]) == 48
+        assert rep["counts"]["broken"] == 0, rep["broken"]
+        assert rep["counts"]["ok"] == 32
+        assert rep["counts"]["rejected"] == 16
+        for c in rep["combos"]:
+            if c["status"] == "rejected":
+                assert c["reason"], c
+            else:
+                assert not [f for f in c["findings"]
+                            if f["severity"] == "error"], c
+        # PS combos with a gradient_sync mode document its inertness
+        noted = [c for c in rep["combos"]
+                 if c["ps"] and c["gradient_sync"]
+                 and c["status"] == "ok"]
+        assert noted and all(
+            any("inert" in n for n in c["notes"]) for c in noted)
+
+    def test_matrix_performs_zero_compiles(self):
+        """The whole sweep is static: the process-wide executor
+        compile counters must not move (no trace, no XLA)."""
+        from paddle_tpu import observability as obs
+        reg = obs.registry()
+        before = reg.snapshot().get("counters", {}).get(
+            "executor_compiles_total", 0)
+        composition_matrix(sync_axis=(None, "sharded_update"))
+        after = reg.snapshot().get("counters", {}).get(
+            "executor_compiles_total", 0)
+        assert after == before
+
+
+# ---------------------------------------------------------------------------
+# journal + doctor wiring
+# ---------------------------------------------------------------------------
+
+class TestObservabilityWiring:
+    def test_verify_and_report_emits_findings(self):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.analysis import verify_and_report
+        obs.clear_journal()
+        main, _s, _sc, _l = build_plain()
+        b = main.global_block()
+        out = b.create_var(name="o", shape=(4,), dtype="float32")
+        b.append_op(type="relu", inputs={"X": ["ghost"]},
+                    outputs={"Out": [out]})
+        fs = verify_and_report(main, "unit_test", feed=("x", "y"),
+                               raise_on_error=False)
+        assert fs
+        evs = [e for e in obs.journal_events()
+               if e["kind"] == "verifier_finding"]
+        assert len(evs) == len(fs)
+        assert evs[0]["rule"] == "dangling_read"
+        assert evs[0]["stage"] == "unit_test"
+        assert evs[0]["citation"].startswith("block0:op#")
+
+    def test_doctor_cites_verifier_findings(self):
+        import doctor
+        evs = [{"kind": "verifier_finding", "role": "trainer-0",
+                "seq": i, "t_wall": 100.0 + i, "severity": "error",
+                "rule": "guard_gate_missing",
+                "citation": "block0:op#12(adam) var=fc_0.w_0",
+                "var": "fc_0.w_0", "op_type": "adam",
+                "stage": "install_anomaly_guard",
+                "message": "optimize-role op writes persistable ..."}
+               for i in range(3)]
+        rep = doctor.diagnose(evs)
+        assert rep["top"] == "program_invariant"
+        d = rep["diagnoses"][0]
+        assert "guard_gate_missing x3" in d["summary"]
+        assert "block0:op#12(adam)" in d["summary"]
+        assert d["evidence"][0]["rule"] == "guard_gate_missing"
+
+    def test_doctor_ignores_warning_findings(self):
+        import doctor
+        evs = [{"kind": "verifier_finding", "role": "t", "seq": 1,
+                "severity": "warning", "rule": "dead_op",
+                "t_wall": 1.0}]
+        assert doctor.diagnose(evs)["top"] is None
+
+    def test_verify_rewrites_flag_raises_at_install(self):
+        from paddle_tpu.resilience.guard import install_anomaly_guard
+        main, _s, scope, loss = build_plain()
+        b = main.global_block()
+        out = b.create_var(name="o", shape=(4,), dtype="float32")
+        b.append_op(type="relu", inputs={"X": ["ghost"]},
+                    outputs={"Out": [out]})
+        from paddle_tpu.core.enforce import InvalidArgumentError
+        FLAGS.verify_rewrites = True
+        try:
+            with pytest.raises(InvalidArgumentError,
+                               match="dangling_read"):
+                install_anomaly_guard(main, loss=loss, scope=scope)
+        finally:
+            FLAGS.verify_rewrites = False
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _write_model(tmp_path, program, feed, fetch):
+    d = tmp_path / "model"
+    d.mkdir(exist_ok=True)
+    with open(d / "__model__", "wb") as f:
+        pickle.dump({"program": program.to_dict(),
+                     "feed_names": list(feed),
+                     "fetch_names": list(fetch)}, f, protocol=4)
+    return str(d)
+
+
+def _run_cli(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "verify_program.py")]
+        + args, capture_output=True, text=True, env=env, timeout=120)
+
+
+class TestCLI:
+    def test_clean_model_exits_zero(self, tmp_path):
+        main, _s, _sc, loss = build_plain()
+        mdir = _write_model(tmp_path, main, ("x", "y"), (loss,))
+        r = _run_cli([mdir, "--json"])
+        assert r.returncode == 0, r.stderr
+        rep = json.loads(r.stdout)
+        assert rep["ok"] and rep["findings"] == []
+
+    def test_bad_model_exits_nonzero_with_citation(self, tmp_path):
+        main, _s, _sc, loss = build_plain()
+        b = main.global_block()
+        out = b.create_var(name="o", shape=(4,), dtype="float32")
+        b.append_op(type="relu", inputs={"X": ["ghost"]},
+                    outputs={"Out": [out]})
+        mdir = _write_model(tmp_path, main, ("x", "y"), (loss,))
+        r = _run_cli([mdir, "--json"])
+        assert r.returncode == 2
+        rep = json.loads(r.stdout)
+        assert not rep["ok"]
+        assert rep["findings"][0]["rule"] == "dangling_read"
+        assert rep["findings"][0]["var"] == "ghost"
+
+    def test_in_process_main_matrix(self, capsys):
+        """--matrix through main() in process (the subprocess sweep
+        would re-pay jax import for no extra coverage)."""
+        import verify_program as vp
+        rc = vp.main(["--matrix"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "0 BROKEN" in out
+
+    def test_save_inference_model_artifact_loads(self, tmp_path):
+        """The CLI reads the real save_inference_model layout."""
+        import verify_program as vp
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            out = layers.fc(input=x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"],
+                                      [out], exe, main_program=main)
+        prog, feeds, fetches = vp.load_program(str(tmp_path / "m"))
+        assert feeds == ["x"]
+        fs = verify_program(prog, feed=feeds, targets=fetches)
+        assert errors(fs) == []

@@ -336,6 +336,112 @@ class TestLaunchModule:
         with pytest.raises(ValueError, match="not in"):
             L.get_cluster_env(args)
 
+    def test_compile_cache_env_contract(self, monkeypatch):
+        """Every role's env carries ONE shared
+        PADDLE_TPU_COMPILE_CACHE_DIR (the ROADMAP compile-plane
+        follow-up: real fleets share a persistent AOT cache by
+        default), resolved journal-dir > user-cache, explicit flag
+        wins, empty string opts out."""
+        from paddle_tpu.distributed import launch as L
+        monkeypatch.delenv("PADDLE_TPU_COMPILE_CACHE_DIR",
+                           raising=False)
+
+        args = L._parse_args(["--nproc_per_node=2",
+                              "--server_num=1",
+                              "--serving_replicas=1",
+                              "--journal_dir=/tmp/jd", "t.py"])
+        envs = (L.get_cluster_env(args) + L.get_server_env(args)
+                + L.get_serving_env(args))
+        assert len(envs) == 4
+        dirs = {e["PADDLE_TPU_COMPILE_CACHE_DIR"] for e in envs}
+        assert dirs == {os.path.join("/tmp/jd", "compile_cache")}
+
+        # no journal/log dir: one stable per-user location
+        args = L._parse_args(["t.py"])
+        env = L.get_cluster_env(args)[0]
+        assert env["PADDLE_TPU_COMPILE_CACHE_DIR"].endswith(
+            os.path.join(".cache", "paddle_tpu", "compile_cache"))
+
+        # explicit flag wins over journal dir; "" opts out by
+        # stamping an EMPTY value (children inherit the launcher's
+        # env, so the blank must override an inherited var —
+        # compile_cache.active() reads "" as disabled)
+        args = L._parse_args(["--journal_dir=/tmp/jd",
+                              "--compile_cache_dir=/tmp/cc", "t.py"])
+        assert L.get_cluster_env(args)[0][
+            "PADDLE_TPU_COMPILE_CACHE_DIR"] == "/tmp/cc"
+        args = L._parse_args(["--compile_cache_dir=", "t.py"])
+        assert L.get_cluster_env(args)[0][
+            "PADDLE_TPU_COMPILE_CACHE_DIR"] == ""
+
+        # an INHERITED empty var is the documented disabled value:
+        # the journal-dir fallback must NOT re-enable the cache
+        # (children inherit the "" and stay disabled)
+        monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE_DIR", "")
+        args = L._parse_args(["--journal_dir=/tmp/jd", "t.py"])
+        assert "PADDLE_TPU_COMPILE_CACHE_DIR" not in \
+            L.get_cluster_env(args)[0]
+        monkeypatch.delenv("PADDLE_TPU_COMPILE_CACHE_DIR")
+
+        # the launcher's own env var is the fleet default and is
+        # never overridden by the journal-dir fallback; an explicit
+        # flag (or "") still beats it
+        monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE_DIR",
+                           "/tmp/inherited")
+        args = L._parse_args(["--journal_dir=/tmp/jd", "t.py"])
+        assert L.get_cluster_env(args)[0][
+            "PADDLE_TPU_COMPILE_CACHE_DIR"] == "/tmp/inherited"
+        args = L._parse_args(["--compile_cache_dir=/tmp/cc", "t.py"])
+        assert L.get_cluster_env(args)[0][
+            "PADDLE_TPU_COMPILE_CACHE_DIR"] == "/tmp/cc"
+        args = L._parse_args(["--compile_cache_dir=", "t.py"])
+        assert L.get_cluster_env(args)[0][
+            "PADDLE_TPU_COMPILE_CACHE_DIR"] == ""
+
+    def test_spawn_fleet_stamps_compile_cache(self, monkeypatch,
+                                              tmp_path):
+        """tools/load_gen.spawn_fleet stamps the shared cache dir
+        into every replica's env (replica 0's warmup compiles become
+        replicas 1..N's cache loads)."""
+        import importlib
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        load_gen = importlib.import_module("load_gen")
+        monkeypatch.delenv("PADDLE_TPU_COMPILE_CACHE_DIR",
+                           raising=False)
+        seen = {}
+
+        class FakePopen:
+            def __init__(self, cmd, env=None, **kw):
+                seen["env"] = env
+                raise RuntimeError("stop before spawning")
+
+            def kill(self):
+                pass
+
+        monkeypatch.setattr("subprocess.Popen", FakePopen)
+        with pytest.raises(RuntimeError, match="stop before"):
+            load_gen.spawn_fleet(str(tmp_path), 1,
+                                 compile_cache_dir=str(tmp_path /
+                                                       "cc"))
+        assert seen["env"]["PADDLE_TPU_COMPILE_CACHE_DIR"] == \
+            str(tmp_path / "cc")
+        # an explicit dir beats an INHERITED env var (the replica env
+        # is seeded from os.environ), and "" blanks the inherited var
+        # out — compile_cache.active() reads "" as disabled
+        monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE_DIR",
+                           "/tmp/inherited")
+        with pytest.raises(RuntimeError, match="stop before"):
+            load_gen.spawn_fleet(str(tmp_path), 1,
+                                 compile_cache_dir=str(tmp_path /
+                                                       "cc"))
+        assert seen["env"]["PADDLE_TPU_COMPILE_CACHE_DIR"] == \
+            str(tmp_path / "cc")
+        with pytest.raises(RuntimeError, match="stop before"):
+            load_gen.spawn_fleet(str(tmp_path), 1,
+                                 compile_cache_dir="")
+        assert seen["env"]["PADDLE_TPU_COMPILE_CACHE_DIR"] == ""
+
     def test_launch_runs_workers(self, tmp_path):
         """End to end: launch a 2-process script; each worker sees its
         rank env and exits 0; a failing worker propagates rc."""

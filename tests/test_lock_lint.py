@@ -1,0 +1,195 @@
+"""tools/lock_lint.py: the AST lock-order lint.
+
+Two halves: (1) the repo's threaded packages (observability/,
+serving/, distributed/) pass clean — the standing tier-1 gate the
+PR 11 ``_SINGLETON_MU`` deadlock motivated; (2) the lint demonstrably
+FAILS on synthetic fixtures for each violation class: an A→B / B→A
+ordering cycle, a non-reentrant self re-entry through a call chain,
+and a journal emit under a held lock — with RLock re-entry and the
+``# lock-lint: ok`` pragma as the sanctioned escapes."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+import lock_lint  # noqa: E402
+
+pytestmark = pytest.mark.analysis
+
+
+def run_lint(paths):
+    locks, funcs = lock_lint.scan(paths)
+    return lock_lint.analyze(locks, funcs)
+
+
+def kinds(report):
+    return sorted({v["kind"] for v in report["violations"]})
+
+
+class TestRepoPasses:
+    def test_default_packages_clean(self):
+        report = run_lint(lock_lint.DEFAULT_PATHS)
+        assert report["violations"] == [], report["violations"]
+        # sanity: the scan actually saw the runtime's locks and code
+        assert len(report["locks"]) >= 10
+        assert report["functions_scanned"] >= 200
+
+    def test_cli_gate_exits_zero(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "lock_lint.py"),
+             "--json"], capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        import json
+        assert json.loads(r.stdout)["violations"] == []
+
+
+def _fixture(tmp_path, body):
+    p = tmp_path / "fixture_mod.py"
+    p.write_text("import threading\n" + textwrap.dedent(body))
+    return [str(p)]
+
+
+class TestViolationsDetected:
+    def test_ordering_cycle(self, tmp_path):
+        rep = run_lint(_fixture(tmp_path, """
+            A = threading.Lock()
+            B = threading.Lock()
+            def f():
+                with A:
+                    with B:
+                        pass
+            def g():
+                with B:
+                    helper()
+            def helper():
+                with A:
+                    pass
+            """))
+        assert kinds(rep) == ["cycle"]
+        cyc = rep["violations"][0]
+        assert len(cyc["locks"]) == 2
+        assert cyc["witness"]  # cites file:line edges
+
+    def test_self_reentry_via_call_chain(self, tmp_path):
+        rep = run_lint(_fixture(tmp_path, """
+            MU = threading.Lock()
+            def outer():
+                with MU:
+                    inner()
+            def inner():
+                with MU:
+                    pass
+            """))
+        assert kinds(rep) == ["self_deadlock"]
+        assert "_SINGLETON_MU" in rep["violations"][0]["detail"]
+
+    def test_rlock_reentry_is_legal(self, tmp_path):
+        rep = run_lint(_fixture(tmp_path, """
+            MU = threading.RLock()
+            def outer():
+                with MU:
+                    inner()
+            def inner():
+                with MU:
+                    pass
+            """))
+        assert rep["violations"] == []
+
+    def test_emit_under_lock(self, tmp_path):
+        rep = run_lint(_fixture(tmp_path, """
+            MU = threading.Lock()
+            def f(emit):
+                with MU:
+                    emit("kind", x=1)
+            """))
+        assert kinds(rep) == ["emit_under_lock"]
+        assert rep["violations"][0]["lock"].endswith(".MU")
+
+    def test_instance_lock_and_acquire_call(self, tmp_path):
+        rep = run_lint(_fixture(tmp_path, """
+            class S:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                def a(self):
+                    with self._mu:
+                        self.b()
+                def b(self):
+                    self._mu.acquire()
+            """))
+        assert kinds(rep) == ["self_deadlock"]
+
+    def test_acquire_release_region_tracked(self, tmp_path):
+        """A manual acquire()/release() region is a held region: an
+        emit inside it is flagged, one after release() is not."""
+        rep = run_lint(_fixture(tmp_path, """
+            MU = threading.Lock()
+            def f(emit):
+                MU.acquire()
+                emit("x", y=1)
+                MU.release()
+                emit("y", z=2)
+            """))
+        bad = [v for v in rep["violations"]
+               if v["kind"] == "emit_under_lock"]
+        assert len(bad) == 1 and bad[0]["line"] == 6  # the emit line
+
+    def test_class_attribute_lock_discovered(self, tmp_path):
+        """The _SINGLETON_MU shape written as a CLASS attribute:
+        both `Cls._MU` and `self._MU` spellings resolve to one lock
+        and self-reentry through a call chain is caught."""
+        rep = run_lint(_fixture(tmp_path, """
+            class S:
+                _MU = threading.Lock()
+                def a(self):
+                    with S._MU:
+                        self.b()
+                def b(self):
+                    with self._MU:
+                        pass
+            """))
+        assert kinds(rep) == ["self_deadlock"]
+
+    def test_missing_path_fails_loudly(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="no Python"):
+            run_lint([str(tmp_path / "nope")])
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "lock_lint.py"),
+             str(tmp_path / "nope")],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 2
+        assert "no Python files" in r.stderr
+
+    def test_pragma_suppresses(self, tmp_path):
+        rep = run_lint(_fixture(tmp_path, """
+            MU = threading.Lock()
+            def f(emit):
+                with MU:
+                    emit("kind", x=1)  # lock-lint: ok
+            """))
+        assert rep["violations"] == []
+
+    def test_cli_fails_on_cycle(self, tmp_path):
+        paths = _fixture(tmp_path, """
+            A = threading.Lock()
+            B = threading.Lock()
+            def f():
+                with A:
+                    with B:
+                        pass
+            def g():
+                with B:
+                    with A:
+                        pass
+            """)
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "lock_lint.py")]
+            + paths, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1
+        assert "cycle" in r.stdout

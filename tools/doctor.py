@@ -54,6 +54,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # nudges within a kind (score = base + min(n_evidence, 20) * 0.1).
 _BASE_SCORE = {
     "hang": 100.0,
+    "program_invariant": 95.0,
     "batcher_death": 92.0,
     "trainer_eviction": 88.0,
     "replica_failure": 86.0,
@@ -310,6 +311,37 @@ def _detect_recompile_storm(kinds, window_s=60.0, threshold=8):
     return [d]
 
 
+def _detect_program_invariant(kinds):
+    """Static-verifier findings (paddle_tpu/analysis —
+    ``verifier_finding`` events emitted by verify_and_report / the
+    CLI's --emit-journal): error-severity findings mean the program
+    itself violates an invariant or rewrite contract, which outranks
+    every runtime-trend diagnosis — the run was broken before step 1,
+    so name the defect with its op/var citation."""
+    evs = kinds.get("verifier_finding", [])
+    errs = [e for e in evs if e.get("severity") == "error"]
+    if not errs:
+        return []
+    rules = collections.Counter(str(e.get("rule")) for e in errs)
+    first = errs[0]
+    where = first.get("citation") or "?"
+    stage_bit = ""
+    stages = sorted({str(e.get("stage")) for e in errs
+                     if e.get("stage") is not None})
+    if stages:
+        stage_bit = " (flagged at %s)" % ", ".join(stages)
+    return [_diag(
+        "program_invariant",
+        "program verifier flagged %d invariant violation(s): %s — "
+        "first: %s at %s%s"
+        % (len(errs),
+           ", ".join("%s x%d" % rn for rn in rules.most_common(4)),
+           first.get("rule"), where, stage_bit),
+        [_cite(e, "rule", "severity", "citation", "var", "op_type",
+               "stage") for e in errs[:10]],
+        detail=first.get("message"))]
+
+
 def _detect_overload(kinds, threshold=5):
     evs = kinds.get("server_overloaded", []) \
         + kinds.get("router_shed", [])
@@ -396,6 +428,7 @@ def diagnose(events: List[dict], blackboxes: List[dict] = (),
     diagnoses += _detect_replica_failure(kinds)
     diagnoses += _detect_pserver_restart(kinds)
     diagnoses += _detect_recompile_storm(kinds)
+    diagnoses += _detect_program_invariant(kinds)
     diagnoses += _detect_training_anomaly(kinds)
     diagnoses += _detect_network_flaky(kinds)
     diagnoses += _detect_overload(kinds)

@@ -162,18 +162,33 @@ def run_open_loop(engine, make_feed, qps, duration_s, deadline_ms):
 def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
                 queue_size=256, policy="least_loaded",
                 router_config=None, startup_timeout_s=120.0,
-                replica_args=()):
+                replica_args=(), compile_cache_dir=None):
     """Spawn ``n_replicas`` serving-replica SUBPROCESSES (real
     processes — the fleet's scaling claim is about escaping one
     process) for ``model_dir`` and return ``(router, stop)`` where
     ``stop()`` shuts the router down and reaps the children. Each
     child announces ``REPLICA_READY <endpoint>`` on stdout before the
-    router is built, so a returned router is immediately usable."""
+    router is built, so a returned router is immediately usable.
+
+    Every replica is stamped with ONE shared persistent compile-cache
+    dir (PADDLE_TPU_COMPILE_CACHE_DIR; ROADMAP compile-plane
+    follow-up): replica 0's warmup compiles are replicas 1..N's cache
+    loads, and a respawned fleet cold-starts with zero XLA compiles.
+    ``compile_cache_dir``: explicit dir, or "" to disable stamping;
+    default resolves like launch.py (env var, else the per-user
+    cache location)."""
     import subprocess
 
+    from paddle_tpu.distributed.launch import default_compile_cache_dir
     from paddle_tpu.serving import RouterConfig, ServingRouter
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if compile_cache_dir is None:
+        compile_cache_dir = default_compile_cache_dir()
+    # ASSIGN, never setdefault: env was seeded from os.environ, so an
+    # explicit dir must beat an inherited var, and "" must blank the
+    # inherited var out (compile_cache.active() reads "" as disabled)
+    env["PADDLE_TPU_COMPILE_CACHE_DIR"] = compile_cache_dir or ""
     procs, endpoints = [], []
     try:
         for k in range(n_replicas):

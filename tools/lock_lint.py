@@ -1,0 +1,529 @@
+#!/usr/bin/env python
+"""AST lock-order lint: find lock-ordering cycles and telemetry emits
+under held non-reentrant locks, statically.
+
+The PR 11 ``_SINGLETON_MU`` deadlock (an accessor re-acquiring the
+non-reentrant singleton lock it was called under) is a CLASS of bug,
+not an incident: any two locks acquired in opposite orders on two
+threads, or any non-reentrant lock re-entered through a call chain,
+wedges the process with no exception to observe. This lint makes the
+class a standing check over the threaded packages
+(``observability/``, ``serving/``, ``distributed/`` by default):
+
+  1. discover locks — module-level ``NAME = threading.Lock()`` /
+     ``RLock()`` / ``Condition()`` and instance attrs
+     ``self.attr = threading.Lock()`` (identity: module.Class.attr —
+     one id per DECLARATION, the granularity ordering is about);
+  2. build per-function acquisition records: ``with lock:`` nesting
+     plus ``lock.acquire()`` events, and the calls made while holding;
+  3. propagate transitively (fixpoint over the intra-package call
+     graph: ``self.method()``, module functions, imported modules);
+  4. report (a) ordering CYCLES (A→B and B→A reachable), (b) SELF
+     re-entry of a non-reentrant lock through any call chain, and
+     (c) journal/registry emits (``emit(...)``, ``registry(...)``)
+     reached while a non-reentrant lock is held — the emit path takes
+     the telemetry plane's own locks and may call arbitrary sinks, so
+     it must never run under a hot-path mutex.
+
+Deliberately conservative where resolution fails (unknown callee or
+lock expression ⇒ no claim); suppress a justified single site with a
+``# lock-lint: ok`` comment on the acquiring/calling line.
+
+Exit code 1 when violations are found (CI gate), 0 otherwise.
+
+    python tools/lock_lint.py                   # default packages
+    python tools/lock_lint.py --json paddle_tpu/serving
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import collections
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = (
+    "paddle_tpu/observability",
+    "paddle_tpu/serving",
+    "paddle_tpu/distributed",
+)
+
+# mutexes only: semaphores are deliberately NOT tracked — the repo
+# uses them as cross-thread completion SIGNALS (Semaphore(0) with
+# release() on another thread), where "held between acquire and
+# release" is not a meaningful region and ordering edges are noise
+_LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True}
+# telemetry entry points that must not run under a held hot-path lock
+_EMIT_NAMES = {"emit"}
+_REGISTRY_NAMES = {"registry"}
+PRAGMA = "lock-lint: ok"
+
+
+class Lock:
+    __slots__ = ("key", "reentrant", "file", "line")
+
+    def __init__(self, key, reentrant, file, line):
+        self.key = key          # "module.NAME" or "module.Class.attr"
+        self.reentrant = reentrant
+        self.file = file
+        self.line = line
+
+
+class FuncInfo:
+    """Per-function record of lock events and outgoing calls."""
+
+    __slots__ = ("key", "file", "acquires", "calls", "emits")
+
+    def __init__(self, key, file):
+        self.key = key
+        self.file = file
+        # (lock_key, line, held_tuple, pragma_ok)
+        self.acquires: List[Tuple] = []
+        # (callee_key_or_None, call_display, line, held_tuple,
+        #  pragma_ok, is_emit, is_registry)
+        self.calls: List[Tuple] = []
+        self.emits: List[Tuple] = []
+
+
+def _module_name(path: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), REPO)
+    if rel.startswith(".."):
+        # outside the repo (test fixtures): absolute path as the id
+        rel = os.path.abspath(path).lstrip(os.sep)
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _lock_ctor(node) -> Optional[bool]:
+    """Is this expression a threading lock constructor? Returns its
+    reentrancy, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute) and \
+            isinstance(f.value, ast.Name) and \
+            f.value.id == "threading":
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One pass over a module: lock declarations, import aliases, and
+    per-function event records."""
+
+    def __init__(self, mod: str, file: str, src_lines: List[str]):
+        self.mod = mod
+        self.file = file
+        self.lines = src_lines
+        self.locks: Dict[str, Lock] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.import_mods: Dict[str, str] = {}   # alias -> module
+        self.import_names: Dict[str, Tuple[str, str]] = {}
+        self.class_names: set = set()
+        self._class: List[str] = []
+        self._func: List[FuncInfo] = []
+        self._held: List[str] = []
+
+    # -- declarations -------------------------------------------------------
+    def visit_Import(self, node):
+        for a in node.names:
+            self.import_mods[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node):
+        if node.level:
+            base = self.mod.split(".")
+            # relative import: level N strips N trailing components
+            # (module's own name counts as one)
+            base = base[: len(base) - node.level]
+            mod = ".".join(base + ([node.module] if node.module
+                                   else []))
+        else:
+            mod = node.module or ""
+        for a in node.names:
+            self.import_names[a.asname or a.name] = (mod, a.name)
+
+    def visit_Assign(self, node):
+        re = _lock_ctor(node.value)
+        if re is not None:
+            for t in node.targets:
+                key = None
+                if isinstance(t, ast.Name):
+                    if self._class and not self._func:
+                        # class-body attribute (the _SINGLETON_MU
+                        # shape as a class attr): same key space as
+                        # self.attr assignments so both spellings
+                        # resolve to ONE lock
+                        key = "%s.%s.%s" % (self.mod,
+                                            self._class[-1], t.id)
+                    elif not self._class:
+                        key = "%s.%s" % (self.mod, t.id)
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and self._class:
+                    key = "%s.%s.%s" % (self.mod, self._class[-1],
+                                        t.attr)
+                if key:
+                    self.locks[key] = Lock(key, re, self.file,
+                                           node.lineno)
+        self.generic_visit(node)
+
+    # -- structure ----------------------------------------------------------
+    def visit_ClassDef(self, node):
+        self.class_names.add(node.name)
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _fn(self, node):
+        qual = ".".join(self._class + [node.name])
+        info = FuncInfo("%s.%s" % (self.mod, qual), self.file)
+        self.funcs[info.key] = info
+        self._func.append(info)
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+        self._func.pop()
+
+    visit_FunctionDef = _fn
+    visit_AsyncFunctionDef = _fn
+
+    # -- lock expression resolution ----------------------------------------
+    def _lock_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            key = "%s.%s" % (self.mod, expr.id)
+            return key if key in self.locks else None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base == "self" and self._class:
+                key = "%s.%s.%s" % (self.mod, self._class[-1],
+                                    expr.attr)
+                return key if key in self.locks else None
+            if base in self.class_names:
+                # ClassName._MU spelling of a class-attribute lock
+                key = "%s.%s.%s" % (self.mod, base, expr.attr)
+                return key if key in self.locks else None
+        return None
+
+    def _pragma(self, line: int) -> bool:
+        try:
+            return PRAGMA in self.lines[line - 1]
+        except IndexError:
+            return False
+
+    # -- events -------------------------------------------------------------
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            lk = self._lock_of(item.context_expr)
+            if lk is not None and self._func:
+                self._func[-1].acquires.append(
+                    (lk, node.lineno, tuple(self._held),
+                     self._pragma(node.lineno)))
+                self._held.append(lk)
+                acquired.append(lk)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lk in reversed(acquired):
+            # remove THIS with's instances specifically: a manual
+            # lock.acquire() inside the body may have appended since
+            self._unhold(lk)
+        # with-items' own expressions (callables etc.)
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    def _unhold(self, lk):
+        for i in range(len(self._held) - 1, -1, -1):
+            if self._held[i] == lk:
+                del self._held[i]
+                return
+
+    visit_AsyncWith = visit_With
+
+    def _callee_key(self, f) -> Tuple[Optional[str], str]:
+        """Resolve a call target to a scanned-function key (or None)
+        plus a display string."""
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in self.import_names:
+                mod, orig = self.import_names[name]
+                return "%s.%s" % (mod, orig), name
+            return "%s.%s" % (self.mod, name), name
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                base = f.value.id
+                if base == "self" and self._class:
+                    return ("%s.%s.%s" % (self.mod, self._class[-1],
+                                          f.attr),
+                            "self.%s" % f.attr)
+                if base in self.import_mods:
+                    return ("%s.%s" % (self.import_mods[base], f.attr),
+                            "%s.%s" % (base, f.attr))
+                if base in self.import_names:
+                    mod, orig = self.import_names[base]
+                    return ("%s.%s.%s" % (mod, orig, f.attr),
+                            "%s.%s" % (base, f.attr))
+            return None, ast.unparse(f) if hasattr(ast, "unparse") \
+                else f.attr
+        return None, "<dynamic>"
+
+    def visit_Call(self, node):
+        if self._func:
+            info = self._func[-1]
+            f = node.func
+            # lock.acquire() opens a HELD region lasting until a
+            # matching release() or the end of the function
+            # (conservative: a conditional acquire over-approximates)
+            if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                lk = self._lock_of(f.value)
+                if lk is not None:
+                    info.acquires.append(
+                        (lk, node.lineno, tuple(self._held),
+                         self._pragma(node.lineno)))
+                    self._held.append(lk)
+            elif isinstance(f, ast.Attribute) and f.attr == "release":
+                lk = self._lock_of(f.value)
+                if lk is not None:
+                    self._unhold(lk)
+            key, disp = self._callee_key(f)
+            leaf = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            is_emit = leaf in _EMIT_NAMES
+            is_reg = leaf in _REGISTRY_NAMES
+            info.calls.append((key, disp, node.lineno,
+                               tuple(self._held),
+                               self._pragma(node.lineno),
+                               is_emit, is_reg))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# analysis over the scanned set
+# ---------------------------------------------------------------------------
+
+def scan(paths) -> Tuple[Dict[str, Lock], Dict[str, FuncInfo]]:
+    locks: Dict[str, Lock] = {}
+    funcs: Dict[str, FuncInfo] = {}
+    for root in paths:
+        root = os.path.join(REPO, root) if not os.path.isabs(root) \
+            else root
+        files = []
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            for d, _dirs, names in os.walk(root):
+                files += [os.path.join(d, n) for n in names
+                          if n.endswith(".py")]
+        if not files:
+            # a typo'd/renamed path must fail LOUDLY: a vacuous scan
+            # exiting 0 would turn the CI gate into a no-op
+            raise FileNotFoundError(
+                "lock_lint: no Python files under %r — check the "
+                "scan path" % root)
+        for path in sorted(files):
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            s = _ModuleScan(_module_name(path), path,
+                            src.splitlines())
+            s.visit(tree)
+            locks.update(s.locks)
+            funcs.update(s.funcs)
+    return locks, funcs
+
+
+def _transitive_acquires(funcs) -> Dict[str, Set[str]]:
+    """Fixpoint: every lock a function may acquire, directly or
+    through calls into scanned functions."""
+    acq = {k: {a[0] for a in f.acquires} for k, f in funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, f in funcs.items():
+            for callee, _d, _l, _h, _p, _e, _r in f.calls:
+                extra = acq.get(callee)
+                if extra and not extra <= acq[k]:
+                    acq[k] |= extra
+                    changed = True
+    return acq
+
+
+def _emits_transitively(funcs) -> Dict[str, bool]:
+    em = {k: any(c[5] or c[6] for c in f.calls)
+          for k, f in funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, f in funcs.items():
+            if em[k]:
+                continue
+            if any(em.get(c[0]) for c in f.calls):
+                em[k] = True
+                changed = True
+    return em
+
+
+def analyze(locks: Dict[str, Lock],
+            funcs: Dict[str, FuncInfo]) -> dict:
+    acq_star = _transitive_acquires(funcs)
+    emit_star = _emits_transitively(funcs)
+
+    edges: Dict[Tuple[str, str], List[dict]] = \
+        collections.defaultdict(list)
+    violations: List[dict] = []
+
+    def note_edge(a, b, where):
+        edges[(a, b)].append(where)
+
+    for fk, f in funcs.items():
+        for lk, line, held, ok in f.acquires:
+            if ok:
+                continue
+            for h in held:
+                if h == lk:
+                    if not locks[lk].reentrant:
+                        violations.append({
+                            "kind": "self_deadlock",
+                            "lock": lk, "func": fk,
+                            "file": f.file, "line": line,
+                            "detail": "non-reentrant lock %r "
+                            "re-acquired while already held in the "
+                            "same function" % lk})
+                else:
+                    note_edge(h, lk, {"func": fk, "file": f.file,
+                                      "line": line, "via": "with"})
+        for callee, disp, line, held, ok, _e, _r in f.calls:
+            if ok or not held or callee not in acq_star:
+                continue
+            for lk in acq_star[callee]:
+                for h in held:
+                    if h == lk:
+                        if not locks[lk].reentrant:
+                            violations.append({
+                                "kind": "self_deadlock",
+                                "lock": lk, "func": fk,
+                                "file": f.file, "line": line,
+                                "detail": "call to %s() while "
+                                "holding non-reentrant %r; the "
+                                "callee (re)acquires it — the "
+                                "_SINGLETON_MU class" % (disp, lk)})
+                    else:
+                        note_edge(h, lk,
+                                  {"func": fk, "file": f.file,
+                                   "line": line,
+                                   "via": "call %s()" % disp})
+
+    # emits under held non-reentrant locks
+    for fk, f in funcs.items():
+        for callee, disp, line, held, ok, is_emit, is_reg in f.calls:
+            if ok:
+                continue
+            direct = is_emit or is_reg
+            transitive = callee in emit_star and emit_star[callee]
+            if not (direct or transitive):
+                continue
+            bad = [h for h in held if not locks[h].reentrant]
+            # the telemetry plane's own modules emit under their own
+            # locks by design (the journal's seq/sink critical
+            # section IS the emit)
+            if bad and not fk.startswith("paddle_tpu.observability."):
+                violations.append({
+                    "kind": "emit_under_lock",
+                    "lock": bad[0], "func": fk,
+                    "file": f.file, "line": line,
+                    "detail": "%s() reached while holding "
+                    "non-reentrant %r — journal/registry emits take "
+                    "the telemetry plane's locks and run sink I/O; "
+                    "move the emit outside the critical section"
+                    % (disp, bad[0])})
+
+    # ordering cycles over the edge graph
+    graph: Dict[str, Set[str]] = collections.defaultdict(set)
+    for (a, b) in edges:
+        graph[a].add(b)
+    for cyc in _find_cycles(graph):
+        witness = []
+        for a, b in zip(cyc, cyc[1:] + cyc[:1]):
+            w = edges.get((a, b))
+            if w:
+                witness.append(dict(w[0], edge="%s -> %s" % (a, b)))
+        violations.append({
+            "kind": "cycle",
+            "locks": cyc,
+            "detail": "lock-order cycle: %s -> %s — two threads "
+            "taking these in opposite orders deadlock"
+            % (" -> ".join(cyc), cyc[0]),
+            "witness": witness})
+
+    return {
+        "locks": sorted(locks),
+        "functions_scanned": len(funcs),
+        "edges": [{"from": a, "to": b, "sites": w[:3]}
+                  for (a, b), w in sorted(edges.items())],
+        "violations": violations,
+    }
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS with canonicalization (small graphs:
+    a handful of locks per package)."""
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start, node, path, seen):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 0:
+                cyc = path[:]
+                i = cyc.index(min(cyc))
+                cycles.add(tuple(cyc[i:] + cyc[:i]))
+            elif nxt not in seen and nxt > start:
+                # only explore nodes > start: each cycle found once,
+                # rooted at its smallest member
+                dfs(start, nxt, path + [nxt], seen | {nxt})
+
+    for n in sorted(graph):
+        dfs(n, n, [n], {n})
+    return [list(c) for c in sorted(cycles)]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="packages/files to scan (default: %s)"
+                    % ", ".join(DEFAULT_PATHS))
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        locks, funcs = scan(args.paths or DEFAULT_PATHS)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    report = analyze(locks, funcs)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print("lock_lint: %d lock(s), %d function(s), %d ordering "
+              "edge(s), %d violation(s)"
+              % (len(report["locks"]), report["functions_scanned"],
+                 len(report["edges"]), len(report["violations"])))
+        for v in report["violations"]:
+            loc = "%s:%s" % (v.get("file"), v.get("line")) \
+                if v.get("file") else ""
+            print("  [%s] %s %s" % (v["kind"], loc, v["detail"]))
+    return 1 if report["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

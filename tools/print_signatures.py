@@ -47,6 +47,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.recordio",
     "paddle_tpu.resilience",
     "paddle_tpu.compile_cache",
+    "paddle_tpu.analysis",
     "paddle_tpu.distributed",
     "paddle_tpu.serving",
     "paddle_tpu.dataset_factory",

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Static program verifier CLI (the analysis plane's front door).
+
+Load a saved program artifact — a ``save_inference_model`` directory
+or its ``__model__`` file — and run the full static verifier over it
+(IR invariant passes + rewrite contracts, paddle_tpu/analysis/): no
+tracing, no XLA compile, findings printed with op/var citations.
+
+Exit code: 0 when no error-severity findings, 2 otherwise (1 is
+argparse/load failures) — so the CLI is a CI gate.
+
+Examples
+--------
+    # verify a serialized model artifact
+    python tools/verify_program.py path/to/model_dir
+    python tools/verify_program.py path/to/model_dir/__model__ --json
+
+    # sweep the static composition matrix
+    # (guard x gradient_sync x pipelined x PS)
+    python tools/verify_program.py --matrix --json
+
+    # assume a gradient_sync mode and extra run-time feeds
+    python tools/verify_program.py model_dir --gradient-sync q8 \\
+        --feed lr --targets loss
+
+``--emit-journal`` additionally emits one ``verifier_finding`` event
+per finding into the configured journal (PADDLE_TPU_EVENT_JOURNAL),
+so ``tools/doctor.py`` can cite program defects next to runtime
+faults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_program(path):
+    """(program, feed_names, target_names) from a model dir or a
+    ``__model__`` file (the save_inference_model pickle desc)."""
+    from paddle_tpu.framework import Program
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    if not os.path.exists(path):
+        raise FileNotFoundError("no program artifact at %r" % path)
+    with open(path, "rb") as f:
+        desc = pickle.load(f)
+    program = Program.from_dict(desc["program"])
+    return (program, list(desc.get("feed_names") or ()),
+            list(desc.get("fetch_names") or ()))
+
+
+class _Parser(argparse.ArgumentParser):
+    """Usage failures exit 1, keeping 2 EXCLUSIVELY for 'the program
+    has error-severity findings' — the code the CI gate keys on (a
+    typo'd flag must not read as a verifier failure)."""
+
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        print("%s: error: %s" % (self.prog, message),
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def main(argv=None):
+    ap = _Parser(description=__doc__)
+    ap.add_argument("model", nargs="?", default=None,
+                    help="save_inference_model dir or __model__ file")
+    ap.add_argument("--matrix", action="store_true",
+                    help="run the static composition-matrix sweep "
+                    "instead of verifying one artifact")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report")
+    ap.add_argument("--gradient-sync", default=None,
+                    help="BuildStrategy.gradient_sync mode the "
+                    "program will run under (collective contract)")
+    ap.add_argument("--feed", default=None,
+                    help="comma-separated extra feed var names")
+    ap.add_argument("--targets", default=None,
+                    help="comma-separated fetch var names (enables "
+                    "dead-op liveness; defaults to the artifact's "
+                    "fetch_names)")
+    ap.add_argument("--emit-journal", action="store_true",
+                    help="also emit verifier_finding journal events")
+    args = ap.parse_args(argv)
+
+    if args.matrix:
+        from paddle_tpu.analysis import composition_matrix
+        report = composition_matrix()
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            c = report["counts"]
+            print("composition matrix: %d ok, %d rejected "
+                  "(documented), %d BROKEN"
+                  % (c["ok"], c["rejected"], c["broken"]))
+            for combo in report["broken"]:
+                print("  BROKEN guard=%s sync=%s pipelined=%s ps=%s"
+                      % (combo["guard"], combo["gradient_sync"],
+                         combo["pipelined"], combo["ps"]))
+                for f in combo["findings"]:
+                    if f["severity"] == "error":
+                        print("    [%s] %s %s: %s"
+                              % (f["severity"], f["rule"],
+                                 f["citation"], f["message"]))
+        return 2 if report["counts"]["broken"] else 0
+
+    if not args.model:
+        ap.error("need a model artifact path (or --matrix)")
+    try:
+        program, feed_names, fetch_names = load_program(args.model)
+    except (OSError, pickle.UnpicklingError, KeyError) as e:
+        print("verify_program: cannot load %r: %s"
+              % (args.model, e), file=sys.stderr)
+        return 1
+    if args.feed:
+        feed_names += [n for n in args.feed.split(",") if n]
+    targets = [n for n in args.targets.split(",") if n] \
+        if args.targets else (fetch_names or None)
+
+    from paddle_tpu.analysis import (errors, format_findings,
+                                     verify_program)
+    findings = verify_program(program, feed=feed_names or None,
+                              targets=targets,
+                              gradient_sync=args.gradient_sync)
+    if args.emit_journal:
+        from paddle_tpu import observability as obs
+        for f in findings:
+            obs.emit("verifier_finding", stage="cli",
+                     program_uid=program._uid, **f.to_dict())
+    if args.json:
+        print(json.dumps({
+            "model": args.model,
+            "findings": [f.to_dict() for f in findings],
+            "errors": len(errors(findings)),
+            "ok": not errors(findings),
+        }, indent=2))
+    else:
+        print(format_findings(findings))
+    return 2 if errors(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
