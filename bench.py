@@ -560,6 +560,91 @@ def bench_gradient_sync(batch=None, seq_len=None, warmup=1, iters=4):
     return rows
 
 
+def bench_model_parallel(batch=None, seq_len=None, warmup=2, iters=6):
+    """Model parallelism in production (PR 13): the SAME transformer
+    probe trained on a pure-dp mesh vs a dp×sp mesh of equal device
+    count — attention routes through the sp schedule (zigzag/Ulysses)
+    under dp×sp, activations sequence-shard, and the gradient-sync
+    layer keeps operating along dp only. Reports tokens/s for each
+    mesh plus the per-mesh gradient-sync bytes-on-wire (the dp=2 mesh
+    halves the ring cost the estimator prices) — on the 2-core CPU
+    probe the signal is equality-at-same-cost and the wire-byte
+    column; the chip rounds are where sp's memory headroom converts
+    to batch/sequence scale."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel import collectives, make_mesh
+
+    smoke = jax.devices()[0].platform == "cpu"
+    ndev = min(4, jax.device_count())
+    if ndev < 4:
+        return {"metric": "model_parallel_throughput", "value": None,
+                "unit": "tokens/sec",
+                "error": "needs >= 4 devices (have %d)" % ndev}
+    batch = batch or (8 if smoke else 32)
+    seq_len = seq_len or (32 if smoke else 256)
+    meshes = (("dp4", {"dp": 4}), ("dp2_sp2", {"dp": 2, "sp": 2}))
+    out = {"metric": "model_parallel_throughput",
+           "unit": "tokens/sec", "batch": batch, "seq_len": seq_len,
+           "meshes": {}}
+    for tag, axes in meshes:
+        _release_device_state()
+        # no attention dropout: the sp schedules run test-mode
+        # kernels, and the A/B must compare identical math
+        cfg = T.TransformerConfig(src_vocab=4000, tgt_vocab=4000,
+                                  max_len=seq_len, d_model=128,
+                                  d_ffn=512, n_head=8, n_layer=2,
+                                  dropout=0.0)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 1
+        with fluid.program_guard(main, startup):
+            avg_cost, _tok, _ = T.transformer(cfg)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(avg_cost)
+        strat = fluid.BuildStrategy()
+        strat.gradient_sync = "exact"
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            build_strategy=strat,
+            mesh=make_mesh(axes, jax.devices()[:ndev]))
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = _device_feed(T.make_fake_batch(cfg, batch))
+        _log("model_parallel %s: warmup/compile" % tag)
+        lv = None
+        for i in range(warmup):
+            (v,) = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+            if i == 0:
+                lv = v  # step-0 forward: the cross-mesh comparable
+        if lv is None or not np.isfinite(float(np.asarray(lv))):
+            raise FloatingPointError("non-finite loss on %s" % tag)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = exe.run(prog, feed=feed, fetch_list=[avg_cost],
+                        return_numpy=False)
+        float(np.asarray(o[0]).reshape(-1)[0])  # honest sync
+        sps = iters / (time.perf_counter() - t0)
+        tokens = sps * batch * seq_len
+        dp = axes["dp"]
+        out["meshes"][tag] = {
+            "axes": axes,
+            "steps_per_s": round(sps, 4),
+            "tokens_per_s": round(tokens, 1),
+            "bytes_on_wire_per_step": collectives.grad_bytes_per_step(
+                main, "exact", dp),
+            "loss": float(np.asarray(lv).reshape(-1)[0]),
+        }
+        _log("model_parallel %s: %.1f tokens/s" % (tag, tokens))
+    m = out["meshes"]
+    out["value"] = m["dp2_sp2"]["tokens_per_s"]
+    out["dp4_tokens_per_s"] = m["dp4"]["tokens_per_s"]
+    # the equality the matrix test proves at rtol 1e-5; here the two
+    # one-batch losses ride along as a cross-check
+    out["loss_rel_diff"] = abs(m["dp4"]["loss"] - m["dp2_sp2"]["loss"]
+                               ) / max(abs(m["dp4"]["loss"]), 1e-9)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # config 1: MNIST MLP
 # ---------------------------------------------------------------------------
@@ -1708,6 +1793,7 @@ def child_main():
         extra = [bench_mnist_mlp, bench_pipelined_train,
                  bench_telemetry_overhead, bench_health_overhead,
                  bench_compile_cache_warmup, bench_fused_kernel_count,
+                 bench_model_parallel,
                  bench_guarded_overhead, bench_ps_degraded,
                  bench_serving_latency, bench_serving_fleet_scaling,
                  bench_deepfm, bench_bert,

@@ -35,8 +35,8 @@ from .verifier import (DEFAULT_RULES, verify_graph,  # noqa: F401
                        verify_program_ir)
 from .contracts import (check_collective_contract,  # noqa: F401
                         check_contracts, check_guard_contract,
-                        check_pipeline_contract, check_ps_contract,
-                        check_sharded_contract)
+                        check_mesh_contract, check_pipeline_contract,
+                        check_ps_contract, check_sharded_contract)
 from .matrix import (build_training_program,  # noqa: F401
                      composition_matrix)
 
@@ -47,7 +47,8 @@ __all__ = [
     "check_contracts", "check_guard_contract",
     "check_collective_contract", "check_sharded_contract",
     "check_ps_contract", "check_pipeline_contract",
-    "composition_matrix", "build_training_program",
+    "check_mesh_contract", "composition_matrix",
+    "build_training_program",
 ]
 
 def verify_program(program, feed=None, targets=None,

@@ -325,6 +325,86 @@ def check_pipeline_contract(program: Program) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# mesh contract: model-parallel axes compose with the dp rewrites
+# ---------------------------------------------------------------------------
+
+# ops that engage a MODEL mesh axis at trace time: the sp attention
+# schedules (the sdpa base lowering routes into ulysses/zigzag under
+# an sp mesh — parallel/ulysses.sequence_parallel_attention), the
+# explicit sp/ep op twins, and the expert-parallel FFN
+MODEL_AXIS_OP_TYPES = frozenset((
+    "scaled_dot_product_attention", "ulysses_attention",
+    "zigzag_attention", "ring_attention", "moe_ffn"))
+
+
+def check_mesh_contract(program: Program,
+                        mesh_axes: Optional[Dict[str, int]] = None
+                        ) -> List[Finding]:
+    """Model-parallel mesh composition contract (dp × sp/tp/ep):
+
+      - every model-axis op (attention schedules, moe_ffn) sits in
+        the forward/backward region, STRICTLY BEFORE the first
+        optimize-role op — the dp gradient-sync bracket must never
+        contain an sp/ep collective (the model-axis partial sums are
+        finished by ``finish_model_partials`` at the bracket's edge,
+        exactly once);
+      - no model-axis op carries a ``gate`` attr — gates belong to the
+        optimize ops; a select-gated collective still executes its
+        collective on anomaly steps and desynchronizes the shards'
+        view of who participated;
+      - optimizer STATE never shards along a model axis: accumulator
+        slots / residuals / master shards are a dp-axis (ZeRO) story;
+        a slot annotated over sp/ep would make the update's layout
+        depend on activation sharding. Parameters themselves MAY
+        shard over tp/ep (that is what model parallelism is).
+    """
+    out: List[Finding] = []
+    block = program.global_block()
+    model = set((mesh_axes or {}).keys()) - {"dp"} or \
+        {"sp", "tp", "ep", "pp"}
+    boundary = None
+    for i, op in enumerate(block.ops):
+        if op.attrs.get("op_role") == "optimize":
+            boundary = i
+            break
+    for i, op in enumerate(block.ops):
+        if op.type not in MODEL_AXIS_OP_TYPES:
+            continue
+        if boundary is not None and i >= boundary:
+            out.append(Finding(
+                "model_axis_op_in_optimize_region", "error",
+                "model-parallel op sits at/after the first "
+                "optimize-role op (#%d): the dp gradient-sync "
+                "bracket would contain a model-axis collective, "
+                "racing the bracket's own partial-sum completion"
+                % boundary, op_index=i, op_type=op.type))
+        if op.attrs.get("gate") is not None:
+            out.append(Finding(
+                "model_axis_op_gated", "error",
+                "model-parallel op carries gate=%r — gates belong "
+                "to optimize-role state writes; a gated collective "
+                "still runs its collective on anomaly steps"
+                % op.attrs.get("gate"), op_index=i, op_type=op.type,
+                var=op.attrs.get("gate")))
+    for name, var in block.vars.items():
+        if not var.persistable or var.sharding is None \
+                or isinstance(var, Parameter):
+            continue
+        axes = [a for e in var.sharding
+                for a in (e if isinstance(e, (tuple, list)) else (e,))
+                if a is not None]
+        bad = sorted(set(axes) & model)
+        if bad:
+            out.append(Finding(
+                "optimizer_state_on_model_axis", "error",
+                "persistable state %r shards over model axis(es) %s "
+                "— optimizer state lays out along dp only (the ZeRO "
+                "bracket's contract); model axes shard activations "
+                "and parameters" % (name, bad), var=name))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # front door: program-shaped contract dispatch
 # ---------------------------------------------------------------------------
 

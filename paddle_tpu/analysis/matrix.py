@@ -35,6 +35,13 @@ SYNC_AXIS = (None, "exact", "rs_ag", "q8",
              "sharded_update", "sharded_update_q8")
 PIPELINE_AXIS = (False, True)
 PS_AXIS = (False, True)
+# mesh dimension (PR 13): "dp" = the pure data-parallel probe the
+# matrix always swept; "dp_sp" = a dp×sp mesh probe whose forward
+# carries a routable attention op — guard × gradient_sync × sp
+# combinations are statically verified (check_mesh_contract) before
+# any trace, keeping the zero-XLA-compile tier-1 gate
+MESH_AXIS = ("dp", "dp_sp")
+MESH_AXES = {"dp": {"dp": 2}, "dp_sp": {"dp": 2, "sp": 2}}
 
 # Structurally impossible pairs, with the reason a reader (and the
 # matrix report) gets. These are CONTRACTS too: a combo leaving this
@@ -57,11 +64,16 @@ def build_training_program(guard: bool = False,
                            gradient_sync: Optional[str] = None,
                            param_gather: str = "fp32",
                            hidden: int = 8,
-                           world: int = 2):
+                           world: int = 2,
+                           mesh: str = "dp"):
     """One tiny composed training program, assembled exactly the way
     the runtime paths assemble it (install_anomaly_guard for the
     guard, ensure_sharded_state/ensure_residual_vars for the sharded/
-    q8 modes). Returns (main, startup, scope, loss_name)."""
+    q8 modes). ``mesh="dp_sp"`` builds the dp×sp probe: the forward
+    carries the routable attention op (what the sdpa lowering sends
+    through ulysses/zigzag under an sp mesh) so the mesh contract has
+    the real op shape to inspect. Returns (main, startup, scope,
+    loss_name)."""
     from .. import layers, optimizer as opt
     from ..core.scope import Scope
 
@@ -71,6 +83,15 @@ def build_training_program(guard: bool = False,
         x = layers.data(name="x", shape=[hidden], dtype="float32")
         y = layers.data(name="y", shape=[1], dtype="float32")
         h = layers.fc(input=x, size=hidden, act="relu")
+        if mesh == "dp_sp":
+            # [B, hidden] -> [B, H=2, S=2, Dh] -> routable attention
+            # (the op the compiler's sp dispatch rewrites) -> back
+            dh = max(1, hidden // 4)
+            t = layers.reshape(h, (-1, 2, 2, dh))
+            t = layers.scaled_dot_product_attention(t, t, t,
+                                                    scale=dh ** -0.5,
+                                                    is_test=True)
+            h = layers.reshape(t, (-1, 4 * dh))
         out = layers.fc(input=h, size=1)
         loss = layers.reduce_mean(layers.square_error_cost(out, y))
         opt.AdamOptimizer(learning_rate=1e-3).minimize(loss)
@@ -83,8 +104,8 @@ def build_training_program(guard: bool = False,
         from ..parallel import mesh as mesh_lib
         from ..parallel.collectives import ensure_sharded_state
         dp = min(world, jax.device_count())
-        mesh = mesh_lib.make_mesh({"dp": dp}, jax.devices()[:dp])
-        ensure_sharded_state(main, scope, mesh,
+        mesh_obj = mesh_lib.make_mesh({"dp": dp}, jax.devices()[:dp])
+        ensure_sharded_state(main, scope, mesh_obj,
                              param_gather=param_gather)
     if guard:
         from ..resilience.guard import install_anomaly_guard
@@ -92,12 +113,13 @@ def build_training_program(guard: bool = False,
     return main, startup, scope, loss.name
 
 
-def _verify_combo(guard, sync, pipelined, ps) -> Dict:
+def _verify_combo(guard, sync, pipelined, ps, mesh="dp") -> Dict:
     from . import verify_program
-    from .contracts import check_pipeline_contract, check_ps_contract
+    from .contracts import (check_mesh_contract,
+                            check_pipeline_contract, check_ps_contract)
 
     combo = {"guard": guard, "gradient_sync": sync,
-             "pipelined": pipelined, "ps": ps}
+             "pipelined": pipelined, "ps": ps, "mesh": mesh}
     if ps and sync in SHARDED_MODES:
         return dict(combo, status="rejected",
                     reason=REJECTIONS[("ps", "sharded")], findings=[])
@@ -107,9 +129,16 @@ def _verify_combo(guard, sync, pipelined, ps) -> Dict:
                     findings=[])
 
     main, startup, scope, loss_name = build_training_program(
-        guard=guard, gradient_sync=sync)
+        guard=guard, gradient_sync=sync, mesh=mesh)
     findings: List[Finding] = []
     notes: List[str] = []
+    if mesh == "dp_sp":
+        findings += check_mesh_contract(main, MESH_AXES[mesh])
+        notes.append(
+            "dp×sp: the attention op routes through the sp schedule "
+            "inside forward/backward; gradient_sync=%r operates along "
+            "dp only, with model-axis partial sums finished at the "
+            "bracket edge (finish_model_partials)" % (sync,))
 
     if ps:
         from ..transpiler import DistributeTranspiler
@@ -146,7 +175,8 @@ def _verify_combo(guard, sync, pipelined, ps) -> Dict:
 
 def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
                        pipeline_axis=PIPELINE_AXIS,
-                       ps_axis=PS_AXIS) -> Dict:
+                       ps_axis=PS_AXIS,
+                       mesh_axis=MESH_AXIS) -> Dict:
     """Sweep the full feature matrix; returns a JSON-able report:
     ``{"combos": [...], "counts": {"ok": n, "rejected": n,
     "broken": n}, "broken": [...]}``. The CI gate asserts
@@ -156,8 +186,9 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
         for sync in sync_axis:
             for pipelined in pipeline_axis:
                 for ps in ps_axis:
-                    combos.append(_verify_combo(guard, sync,
-                                                pipelined, ps))
+                    for mesh in mesh_axis:
+                        combos.append(_verify_combo(
+                            guard, sync, pipelined, ps, mesh=mesh))
     counts: Dict[str, int] = {"ok": 0, "rejected": 0, "broken": 0}
     for c in combos:
         counts[c["status"]] += 1
@@ -168,5 +199,6 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
         "axes": {"guard": list(guard_axis),
                  "gradient_sync": list(sync_axis),
                  "pipelined": list(pipeline_axis),
-                 "ps": list(ps_axis)},
+                 "ps": list(ps_axis),
+                 "mesh": list(mesh_axis)},
     }

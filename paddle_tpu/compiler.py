@@ -150,10 +150,28 @@ class CompiledProgram:
         return self
 
     # -- sharding assignment -----------------------------------------------
+    def _mesh_spec(self, spec: PartitionSpec) -> PartitionSpec:
+        """A var's declared PartitionSpec restricted to THIS mesh's
+        axes: entries naming absent axes bind to None (replicated).
+        Model libraries annotate for the largest mesh they support
+        (moe_ffn's ep-sharded experts, shard_tp's tp weights); a
+        smaller mesh must run the same program, just less sharded."""
+        names = set(self._mesh.axis_names)
+
+        def keep(e):
+            if e is None:
+                return None
+            if isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a in names)
+                return kept if kept else None
+            return e if e in names else None
+
+        return PartitionSpec(*(keep(e) for e in spec))
+
     def _var_spec(self, var: Variable) -> PartitionSpec:
         """PartitionSpec for a persistable var under the strategy."""
         if var.sharding is not None:
-            return var.sharding
+            return self._mesh_spec(var.sharding)
         if self._build_strategy.reduce_strategy == \
                 BuildStrategy.ReduceStrategy.Reduce and var.persistable:
             # ZeRO-style: shard over dp on the first divisible dim.
@@ -172,18 +190,30 @@ class CompiledProgram:
     def feed_sharding(self, shape, name=None) -> NamedSharding:
         """Batch-shard a feed over dp when its leading dim divides
         evenly; otherwise replicate (partial final batches, scalar
-        feeds like learning rates). A feed var annotated via
-        parallel.shard (e.g. sequence-sharded inputs for sp) uses its
-        own spec."""
+        feeds like learning rates). Under an sp axis the SEQUENCE dim
+        (dim 1 of a [batch, seq, ...] feed) additionally shards over
+        sp when divisible — activations then enter the step already
+        sequence-sharded, and the zigzag/Ulysses schedules' shard_map
+        in_specs meet data laid out where they want it instead of
+        forcing a gather-then-scatter (the resharding-collective
+        posture of arXiv:2112.01075). A feed var annotated via
+        parallel.shard uses its own spec."""
         if name is not None:
             var = self.program.global_block().vars.get(name)
             if var is not None and var.sharding is not None:
-                return NamedSharding(self._mesh, var.sharding)
+                return NamedSharding(self._mesh,
+                                     self._mesh_spec(var.sharding))
+        spec = [None] * len(shape)
         dp = self._mesh.shape.get("dp", 1)
         if dp > 1 and len(shape) > 0 and shape[0] % dp == 0:
-            return NamedSharding(self._mesh,
-                                 mesh_lib.shard_batch_spec(len(shape)))
-        return NamedSharding(self._mesh, PartitionSpec())
+            spec[0] = "dp"
+        # the sp gate is independent of dp: an sp-only serving mesh
+        # (enable_mesh({"sp": n})) or a partial final batch must still
+        # sequence-shard a divisible seq dim
+        sp = self._mesh.shape.get("sp", 1)
+        if sp > 1 and len(shape) > 1 and shape[1] % sp == 0:
+            spec[1] = "sp"
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
 
     def _fingerprint(self):
         """Stable identity for the executor's jit cache (NOT id(): a
@@ -217,7 +247,7 @@ class CompiledProgram:
 
     # -- execution ---------------------------------------------------------
     def run(self, exe, feed, fetch_list, scope, return_numpy,
-            use_program_cache=True, validate_feed=True):
+            use_program_cache=True, validate_feed=True, donate=True):
         from .core.scope import global_scope
         if self._build_strategy.fuse_elewise_add_act_ops and \
                 not getattr(self, "_fuse_done", False):
@@ -273,6 +303,6 @@ class CompiledProgram:
             return exe._run_impl(self.program, feed or {},
                                  fetch_list or [],
                                  scope or global_scope(), return_numpy,
-                                 dist=self,
+                                 dist=self, donate=donate,
                                  use_program_cache=use_program_cache,
                                  validate_feed=validate_feed)

@@ -119,6 +119,16 @@ FLAGS.define("sdpa_auto_flash", True,
              "for its pure-XLA base row. Chip evidence 2026-07-31: "
              "+12% in-model on transformer-base b64.")
 
+FLAGS.define("sp_attention", True,
+             "scaled_dot_product_attention's base lowering routes "
+             "through the sequence-parallel schedules when the ambient "
+             "mesh carries an sp axis (parallel/ulysses.py "
+             "sequence_parallel_attention): zigzag ring for causal "
+             "no-bias shapes, Ulysses all-to-all head re-sharding "
+             "otherwise. Off = keep the replicated full-attention "
+             "lowering and let GSPMD place it (correct, but the "
+             "S^2 score matrix is not sequence-sharded).")
+
 FLAGS.define("ring_flash", True,
              "ring_attention computes each hop's block attention with "
              "the pallas partial-softmax kernels (ops/pallas/ring.py) "

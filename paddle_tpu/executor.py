@@ -841,7 +841,8 @@ class Executor:
             return program.run(self, feed, fetch_list, scope,
                                return_numpy,
                                use_program_cache=use_program_cache,
-                               validate_feed=validate_feed)
+                               validate_feed=validate_feed,
+                               donate=donate)
         return self._run_impl(program, feed or {}, fetch_list or [],
                               scope or global_scope(), return_numpy,
                               donate=donate,

@@ -147,6 +147,52 @@ class AnalysisPredictor:
         self._compiled_shapes = set()
         self._shape_gates = {}
         self._gate_lock = threading.Lock()
+        # model-parallel serving (enable_mesh): None = plain
+        self._dist_program = None
+        self._mesh_axes = None
+
+    def enable_mesh(self, axes: Dict[str, int]) -> "AnalysisPredictor":
+        """Serve this model as ONE pjit'd forward over a device mesh —
+        the sharded-group-inference executor path (docs/parallel.md):
+        a model bigger than one replica's HBM runs with its weights
+        partitioned over ``tp`` (every ≥2-D parameter shards its
+        largest divisible dim; GSPMD inserts the ICI collectives) and
+        its attention sequence-sharded over ``sp`` (the
+        zigzag/Ulysses routing the compiler does for training). Axis
+        sizes must multiply to the local device count — on a TPU
+        replica group each member host contributes its slice of the
+        same mesh via jax.distributed; the CPU probe emulates the
+        group's mesh with virtual host devices.
+
+        Returns self. Clones share the distributed program (weights
+        stay sharded once placed)."""
+        import jax
+        import numpy as _np
+
+        from ..compiler import CompiledProgram
+        from ..parallel import mesh as mesh_lib
+        from ..parallel.api import shard as _shard
+        ndev = int(_np.prod(list(axes.values()))) if axes else 1
+        mesh = mesh_lib.make_mesh(dict(axes), jax.devices()[:ndev])
+        tp = int(axes.get("tp", 1))
+        if tp > 1:
+            for p in self.program.all_parameters():
+                if p.sharding is not None or len(p.shape) < 2:
+                    continue
+                # shard the LAST divisible dim (output features for
+                # fc weights — column-parallel, the Megatron default);
+                # semantics stay global either way, GSPMD closes the
+                # seams
+                for dim in range(len(p.shape) - 1, -1, -1):
+                    if p.shape[dim] and p.shape[dim] % tp == 0:
+                        spec = [None] * len(p.shape)
+                        spec[dim] = "tp"
+                        _shard(p, *spec)
+                        break
+        self._mesh_axes = dict(axes)
+        self._dist_program = CompiledProgram(self.program) \
+            .with_data_parallel(mesh=mesh)
+        return self
 
     def _optimize_program(self):
         """OptimizeInferenceProgram (analysis_predictor.cc:436): run
@@ -178,9 +224,11 @@ class AnalysisPredictor:
         is off: concurrent runs share the weight scope, and donation
         would invalidate param buffers a sibling thread still reads."""
         fetch = [v.name for v in self.fetch_vars]
+        prog = self._dist_program if self._dist_program is not None \
+            else self.program
 
         def run():
-            return self.exe.run(self.program, feed=feed,
+            return self.exe.run(prog, feed=feed,
                                 fetch_list=fetch, scope=self.scope,
                                 return_numpy=return_numpy,
                                 donate=False)
@@ -235,6 +283,8 @@ class AnalysisPredictor:
         c._compiled_shapes = self._compiled_shapes
         c._shape_gates = self._shape_gates
         c._gate_lock = self._gate_lock
+        c._dist_program = self._dist_program
+        c._mesh_axes = self._mesh_axes
         return c
 
     def get_input_names(self):

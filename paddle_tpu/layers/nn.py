@@ -922,6 +922,55 @@ def scaled_dot_product_attention(q, k, v, bias=None, scale=1.0,
     return out
 
 
+def moe_ffn(x, num_experts, d_ffn, capacity_factor=1.25, top_k=1,
+            param_attr=None, name=None):
+    """Mixture-of-experts FFN layer over ``[tokens, d_model]`` input:
+    Switch (top_k=1) / GShard top-2 routing into ``num_experts``
+    relu-FFN experts of width ``d_ffn`` (parallel/moe.py). Returns
+    ``(out [tokens, d_model], aux_loss scalar)`` — add the aux loss
+    (scaled) into the training objective to regularize routing.
+
+    Under a CompiledProgram mesh with an ``ep`` axis the op runs
+    expert-parallel: expert weights shard over ``ep`` on their leading
+    E dim, tokens data-shard over the same axis, and one capacity-
+    bucketed ``all_to_all`` each way moves only the dispatched tokens
+    across ICI. Without an ep axis it is the exact single-device
+    reference — the same program serves both, like the attention ops."""
+    helper = LayerHelper("moe_ffn", name=name)
+    enforce(x.shape is not None and len(x.shape) == 2,
+            "moe_ffn wants [tokens, d_model] input (flatten sequence "
+            "dims first), got shape %r" % (x.shape,))
+    d_model = int(x.shape[1])
+    E, F = int(num_experts), int(d_ffn)
+    gate_w = helper.create_parameter(attr=param_attr,
+                                     shape=(d_model, E), dtype=x.dtype)
+    w1 = helper.create_parameter(attr=param_attr, shape=(E, d_model, F),
+                                 dtype=x.dtype)
+    b1 = helper.create_parameter(attr=param_attr, shape=(E, F),
+                                 dtype=x.dtype, is_bias=True)
+    w2 = helper.create_parameter(attr=param_attr, shape=(E, F, d_model),
+                                 dtype=x.dtype)
+    b2 = helper.create_parameter(attr=param_attr, shape=(E, d_model),
+                                 dtype=x.dtype, is_bias=True)
+    # expert weights shard over ep on the leading E axis; the mesh-less
+    # case ignores the annotation (PartitionSpec axes not in the mesh
+    # never bind)
+    from ..parallel.api import shard as _shard
+    _shard(w1, "ep", None, None)
+    _shard(b1, "ep", None)
+    _shard(w2, "ep", None, None)
+    _shard(b2, "ep", None)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="moe_ffn",
+                     inputs={"X": [x], "GateW": [gate_w], "W1": [w1],
+                             "B1": [b1], "W2": [w2], "B2": [b2]},
+                     outputs={"Out": [out], "AuxLoss": [aux]},
+                     attrs={"capacity_factor": float(capacity_factor),
+                            "top_k": int(top_k)})
+    return out, aux
+
+
 # ---------------------------------------------------------------------------
 # sequence-labeling / sampled losses (reference: layers/nn.py warpctc,
 # edit_distance, linear_chain_crf, crf_decoding, nce, hsigmoid,

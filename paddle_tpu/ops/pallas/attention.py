@@ -170,6 +170,21 @@ def scaled_dot_product_attention(q, k, v, bias, *, scale=1.0,
     everything else keeps the XLA chain, which measured faster there."""
     rate = 0.0 if is_test else float(dropout_rate)
     from ...core.flags import FLAGS
+    if FLAGS.sp_attention and rate == 0.0:
+        # model-parallel production path: under a mesh with an sp axis
+        # (CompiledProgram.with_data_parallel(axes={"dp":d,"sp":s})
+        # installs it as the ambient mesh for the whole trace) the one
+        # attention op the models build lowers to the zigzag ring /
+        # Ulysses schedule — activations stay sequence-sharded through
+        # the S^2 core instead of replicating. Returns None when no sp
+        # axis is in scope or the geometry doesn't admit a schedule,
+        # in which case the replicated lowerings below stay in charge.
+        from ...parallel.ulysses import sequence_parallel_attention
+        routed = sequence_parallel_attention(q, k, v, bias=bias,
+                                             scale=scale,
+                                             causal=causal)
+        if routed is not None:
+            return routed
     if (FLAGS.sdpa_auto_flash and rate > 0.0 and rng is not None
             and not interpret_mode()
             and jnp.dtype(q.dtype).itemsize <= 2

@@ -28,6 +28,7 @@ from . import ring_attention  # noqa: F401  (registers the op)
 from . import ulysses  # noqa: F401  (registers the op)
 from .ring_attention import ring_attention as ring_attention_fn  # noqa
 from .ulysses import ulysses_attention as ulysses_attention_fn  # noqa
+from .ulysses import sequence_parallel_attention  # noqa: F401
 from . import multihost  # noqa: F401
 from . import pipeline  # noqa: F401
 from .pipeline import gpipe_apply, stack_stage_params  # noqa: F401

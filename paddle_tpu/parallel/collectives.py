@@ -133,6 +133,36 @@ def axis_size(mesh, axis: str = "dp") -> int:
     return int(mesh.shape.get(axis, 1))
 
 
+def model_axes(mesh, sync_axis: str = "dp") -> Tuple[str, ...]:
+    """The mesh's MODEL-parallel axes: every axis other than the
+    gradient-sync axis with extent > 1 (sp/tp/ep/pp). These shard
+    activations and expert weights inside the forward/backward; the
+    gradient-sync layer operates along ``sync_axis`` only."""
+    if mesh is None:
+        return ()
+    return tuple(a for a in mesh.axis_names
+                 if a != sync_axis and mesh.shape[a] > 1)
+
+
+def finish_model_partials(g, mesh, sync_axis: str = "dp"):
+    """Pin a parameter gradient replicated over the mesh BEFORE it
+    enters the dp gradient-sync bracket.
+
+    Under a dp×sp (or ×tp/×ep) mesh the backward produces each weight
+    gradient as PARTIAL sums distributed over the model axes (every sp
+    shard contributes its sequence chunk's term). The dp transports'
+    shard_map in_specs are replicated, so GSPMD must finish that
+    partial reduction first — this constraint makes the seam explicit:
+    the model-axis all-reduce lands HERE, once, immediately before the
+    dp collective, instead of wherever the partitioner's propagation
+    happens to put it (and the fusion-boundary audit sees one stable
+    boundary). A no-op on pure-dp meshes."""
+    if not model_axes(mesh, sync_axis):
+        return g
+    return jax.lax.with_sharding_constraint(
+        g, NamedSharding(mesh, PartitionSpec()))
+
+
 def _numel(shape) -> int:
     return int(np.prod(shape)) if len(shape) else 1
 
@@ -498,6 +528,11 @@ class GradSyncPlan:
                 continue
             if not jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
                 continue
+            # dp×sp/tp composition: the model-axis partial sums finish
+            # here, so the transport below sees the SAME full-batch
+            # gradient it sees on a pure-dp mesh (and q8's residual
+            # telescope stays a dp-axis-only story)
+            v = finish_model_partials(v, self.mesh, self.axis)
             if self.mode == "exact":
                 env[gkey] = all_reduce_exact(v, self.mesh, self.axis)
             elif self.mode == "rs_ag":
@@ -668,6 +703,9 @@ class ShardedUpdatePlan:
                 continue
             if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
                 continue
+            # model-axis partial sums must complete before the shard
+            # bracket opens (see GradSyncPlan.apply)
+            g = finish_model_partials(g, self.mesh, self.axis)
             if self.quant_grads:
                 r = env.get(e.grad_res_key)
                 if r is None:

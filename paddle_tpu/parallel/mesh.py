@@ -54,16 +54,25 @@ def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
     return make_mesh({"dp": n}, jax.devices()[:n])
 
 
-_current_mesh: Optional[Mesh] = None
+# THREAD-LOCAL ambient mesh: mesh-aware ops (ring/zigzag/ulysses/moe,
+# and the sdpa sp routing) read it at trace time, and every trace
+# happens in the thread that entered mesh_guard (CompiledProgram.run
+# traces synchronously inside its guard). A process-global here would
+# let one thread's mesh silently reroute an UNRELATED program being
+# traced concurrently on another thread (a serving process hosting a
+# mesh model next to a plain one) through a schedule it never opted
+# into.
+import threading as _threading
+
+_mesh_tls = _threading.local()
 
 
 def set_mesh(mesh: Optional[Mesh]):
-    global _current_mesh
-    _current_mesh = mesh
+    _mesh_tls.mesh = mesh
 
 
 def current_mesh() -> Optional[Mesh]:
-    return _current_mesh
+    return getattr(_mesh_tls, "mesh", None)
 
 
 @contextlib.contextmanager

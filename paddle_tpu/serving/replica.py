@@ -96,8 +96,23 @@ class ServingReplica:
     def __init__(self, model=None, config: Optional[ServingConfig] = None,
                  name: str = "default", version: str = "v1",
                  endpoint: str = "127.0.0.1:0", replica_id: int = 0,
-                 metrics_port=None):
+                 metrics_port=None, mesh_axes: Optional[dict] = None,
+                 group_rank: int = 0, group_size: int = 1):
+        """``mesh_axes`` (e.g. ``{"tp": 2}`` / ``{"sp": 2}``): serve
+        the model as one pjit'd forward over a device mesh
+        (AnalysisPredictor.enable_mesh) — the sharded replica-GROUP
+        executor. ``group_rank``/``group_size``: this process's place
+        in its group; rank 0 is the group's executor member (receives
+        INFER), ranks > 0 are shard members — they hold the group's
+        lease surface (HEARTBEAT/CTRL stats) and, on a TPU pod, the
+        other hosts of the shared mesh (jax.distributed; the CPU
+        probe's rank 0 emulates the whole group mesh with virtual
+        devices). An INFER landing on a shard member answers a
+        structured error, never silence."""
         self.replica_id = int(replica_id)
+        self.group_rank = int(group_rank)
+        self.group_size = int(group_size)
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.engine = ServingEngine(config=config,
                                     metrics_port=metrics_port)
         self._config = config
@@ -106,13 +121,23 @@ class ServingReplica:
         self._versions: Dict[str, List[str]] = {}
         self._default_model: Optional[str] = None
         self._crashed = False
-        if model is not None:
+        if model is not None and self.group_rank == 0:
             self._register(name, version, model, config)
         self.server = RPCServer(endpoint)
         self.endpoint = self.server.endpoint
         self.server.register_deferred("INFER", self._on_infer)
         self.server.register_deferred("CTRL", self._on_ctrl)
         self.server.register("HEARTBEAT", self._on_heartbeat)
+
+    def _make_model(self, source):
+        """Predictor for ``source`` (dir or predictor), mesh-sharded
+        when this replica serves a group mesh."""
+        from ..inference import AnalysisConfig, AnalysisPredictor
+        if not isinstance(source, AnalysisPredictor):
+            source = AnalysisPredictor(AnalysisConfig(str(source)))
+        if self.mesh_axes:
+            source.enable_mesh(self.mesh_axes)
+        return source
 
     # -- versioned model registry --------------------------------------
     @staticmethod
@@ -121,7 +146,7 @@ class ServingReplica:
 
     def _register(self, model, version, source, config):
         self.engine.add_model(self._worker_name(model, version),
-                              source, config)
+                              self._make_model(source), config)
         with self._mu:
             vs = self._versions.setdefault(model, [])
             if version not in vs:
@@ -177,6 +202,14 @@ class ServingReplica:
     def _on_infer(self, wire, payload, responder):
         base, _tid, _seq, _tok = unpack_wire_meta(wire)
         try:
+            if self.group_rank != 0:
+                raise InvalidRequest(
+                    "replica %d is shard member rank %d of a "
+                    "group-of-%d — INFER dispatches to the group's "
+                    "rank-0 executor" % (self.replica_id,
+                                         self.group_rank,
+                                         self.group_size),
+                    replica=self.replica_id, group_rank=self.group_rank)
             meta, arrays = unpack_blob(payload)
             feed = dict(zip(meta["inputs"], arrays))
             m, v, wname = self._resolve(base or None)
@@ -304,6 +337,9 @@ class ServingReplica:
         return {"replica_id": self.replica_id,
                 "endpoint": self.endpoint,
                 "models": models,
+                "group_rank": self.group_rank,
+                "group_size": self.group_size,
+                "mesh_axes": self.mesh_axes,
                 "load": self.load_snapshot(),
                 "engine": self.engine.stats()
                 if self.engine._workers else {}}
@@ -354,6 +390,15 @@ def serve_main(argv=None):
     ap.add_argument("--wait-us", type=int, default=2000)
     ap.add_argument("--queue-size", type=int, default=256)
     ap.add_argument("--metrics-port", type=int, default=None)
+    ap.add_argument("--mesh-axes", default=None,
+                    help="JSON axis dict (e.g. '{\"tp\": 2}') — serve "
+                    "the model as one pjit'd forward over this mesh "
+                    "(sharded replica group executor). Sizes multiply "
+                    "to the local device count.")
+    ap.add_argument("--group-rank", type=int, default=0,
+                    help="this process's rank in its replica group "
+                    "(0 = executor member, >0 = shard member)")
+    ap.add_argument("--group-size", type=int, default=1)
     ap.add_argument("--dispatch-floor-ms", type=float, default=0.0,
                     help="CPU-probe device-time emulation: minimum "
                     "wall time per device dispatch (installed via the "
@@ -370,11 +415,24 @@ def serve_main(argv=None):
     cfg = ServingConfig(max_batch_size=args.max_batch,
                         max_queue_wait_us=args.wait_us,
                         max_queue_size=args.queue_size)
+    mesh_axes = json.loads(args.mesh_axes) if args.mesh_axes else None
+    if mesh_axes:
+        import numpy as _np
+        want = int(_np.prod(list(mesh_axes.values())))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            # CPU probe: back the group mesh with virtual host devices
+            # (a TPU host sees its real chips instead)
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % max(want, 1)).strip()
     replica = ServingReplica(
         args.model_dir, cfg, name=args.name, version=args.version,
         endpoint="127.0.0.1:%d" % args.port,
         replica_id=args.replica_id,
-        metrics_port=args.metrics_port)
+        metrics_port=args.metrics_port,
+        mesh_axes=mesh_axes, group_rank=args.group_rank,
+        group_size=args.group_size)
     if args.dispatch_floor_ms > 0:
         import time as _time
         floor_s = args.dispatch_floor_ms / 1e3

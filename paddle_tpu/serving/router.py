@@ -111,6 +111,14 @@ class RouterConfig:
     """
 
     policy: str = "least_loaded"
+    # replica GROUPS (sharded group inference, docs/parallel.md): the
+    # endpoint list is consecutive groups of this size; member 0 of
+    # each group is its executor (one pjit'd forward over the group's
+    # mesh), the rest are shard members. Dispatch targets healthy
+    # groups' executors; ANY member's lease lapsing evicts the WHOLE
+    # group (a mesh missing one host cannot answer), and in-flight
+    # requests retry on another group — a future never hangs.
+    group_size: int = 1
     shed_queue_depth: int = 256
     max_pending: int = 4096
     max_retries: int = 3
@@ -217,10 +225,28 @@ class _Replica:
         return out
 
 
+class _ReplicaGroup:
+    """One sharded replica group: N member `_Replica`s forming a mesh,
+    member 0 the executor. Healthy = EVERY member's lease is live."""
+
+    def __init__(self, gid: int, members: List[_Replica]):
+        self.id = gid
+        self.members = members
+        self.primary = members[0]
+        # evicted-state memo so the health loops emit one
+        # group_evicted per transition, not one per probe tick
+        self.evicted = False
+
+    def healthy(self) -> bool:
+        return all(m.healthy for m in self.members)
+
+
 class ServingRouter:
     """Fronts N replicas (``endpoints``) with least-loaded dispatch,
     shedding, lease-based eviction, transparent retry, and versioned
-    hot-swap. API mirrors ``ServingEngine``."""
+    hot-swap. API mirrors ``ServingEngine``. With
+    ``config.group_size > 1`` the endpoints form sharded replica
+    GROUPS and dispatch targets group executors (see RouterConfig)."""
 
     def __init__(self, endpoints, config: Optional[RouterConfig] = None,
                  metrics_port=None):
@@ -233,6 +259,21 @@ class ServingRouter:
             for i, ep in enumerate(endpoints)]
         if not self._replicas:
             raise InvalidRequest("a router needs >= 1 replica endpoint")
+        gs = max(1, int(self.config.group_size))
+        if gs > 1 and len(self._replicas) % gs:
+            raise InvalidRequest(
+                "group_size=%d does not divide the %d endpoints — "
+                "groups are consecutive endpoint runs"
+                % (gs, len(self._replicas)))
+        self._groups = [
+            _ReplicaGroup(g, self._replicas[g * gs:(g + 1) * gs])
+            for g in range(len(self._replicas) // gs)] if gs > 1 \
+            else None
+        self._group_of = {}
+        if self._groups:
+            for grp in self._groups:
+                for m in grp.members:
+                    self._group_of[m.id] = grp
         self._rr = itertools.count()
         self._pending = 0
         self._mu = threading.Lock()
@@ -249,7 +290,8 @@ class ServingRouter:
         self._m_retries = reg.counter("router_retries_total")
         self._h_latency = reg.histogram("router_latency_seconds")
         self._counts = {"completed": 0, "shed": 0, "failed": 0,
-                        "retries": 0}
+                        "retries": 0, "group_evictions": 0,
+                        "group_readmissions": 0}
         self.metrics_server = None
         if metrics_port is not None:
             self.metrics_server = _obs.start_metrics_server(
@@ -279,6 +321,11 @@ class ServingRouter:
 
     # -- dispatch ------------------------------------------------------
     def _healthy(self) -> List[_Replica]:
+        """Dispatchable targets: healthy replicas, or — under groups —
+        the EXECUTORS of fully-healthy groups (a group with any member
+        down is not a target even while its executor still answers)."""
+        if self._groups is not None:
+            return [g.primary for g in self._groups if g.healthy()]
         return [r for r in self._replicas if r.healthy]
 
     def _pick(self, tried) -> Optional[_Replica]:
@@ -471,6 +518,7 @@ class ServingRouter:
                     r.healthy = True
                     _obs.emit("replica_readmitted", replica=r.id,
                               endpoint=r.endpoint)
+                    self._note_group_transition(r)
             except Exception:
                 if client is not None:
                     try:
@@ -485,11 +533,47 @@ class ServingRouter:
                         "replica_evicted", replica=r.id,
                         endpoint=r.endpoint,
                         lease_timeout_s=self.config.lease_timeout_s)
+                    self._note_group_transition(r, cause=r.id)
         if client is not None:
             try:
                 client.close()
             except Exception:
                 pass
+
+    def _note_group_transition(self, r: _Replica, cause=None):
+        """After one member's health flipped: emit the whole-group
+        eviction/readmission transition (once per edge). A group is a
+        mesh — losing ANY host loses the executable, so the group
+        leaves the dispatch set as one unit and comes back as one.
+        The edge detection (read + flip of ``grp.evicted``) happens
+        under ``self._mu``: each group has one health thread PER
+        member, and two members lapsing in the same heartbeat window
+        must still produce exactly one transition. The journal emit
+        stays outside the lock (lock_lint's emit-under-lock rule)."""
+        if self._groups is None:
+            return
+        grp = self._group_of.get(r.id)
+        if grp is None:
+            return
+        healthy = grp.healthy()
+        edge = None
+        with self._mu:
+            if not healthy and not grp.evicted:
+                grp.evicted = True
+                self._counts["group_evictions"] += 1
+                edge = "group_evicted"
+            elif healthy and grp.evicted:
+                grp.evicted = False
+                self._counts["group_readmissions"] += 1
+                edge = "group_readmitted"
+        if edge == "group_evicted":
+            _obs.emit("group_evicted", group=grp.id,
+                      members=[m.id for m in grp.members],
+                      cause_member=cause,
+                      executor=grp.primary.id)
+        elif edge == "group_readmitted":
+            _obs.emit("group_readmitted", group=grp.id,
+                      members=[m.id for m in grp.members])
 
     # -- control-plane helpers ----------------------------------------
     def _ctrl(self, r: _Replica, meta: dict, deadline_s=120.0) -> dict:
@@ -600,12 +684,19 @@ class ServingRouter:
         with self._mu:
             pending = self._pending
             counts = dict(self._counts)
-        return {
+        out = {
             "router": dict(counts, policy=self.config.policy,
                            pending=pending),
             "replicas": {str(r.id): r.snapshot()
                          for r in self._replicas},
         }
+        if self._groups is not None:
+            out["groups"] = {
+                str(g.id): {"members": [m.id for m in g.members],
+                            "executor": g.primary.id,
+                            "healthy": g.healthy()}
+                for g in self._groups}
+        return out
 
     def models(self):
         for r in self._healthy():

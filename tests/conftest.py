@@ -75,6 +75,13 @@ def pytest_configure(config):
         "markers",
         "analysis: program-verifier / static-analysis test (tier-1; "
         "select alone with -m analysis)")
+    # model-parallel suite (2D mesh training equality, sp attention
+    # routing, sharded group inference): CPU-fast on the virtual
+    # 8-device mesh, runs inside tier-1
+    config.addinivalue_line(
+        "markers",
+        "mp: model-parallelism (dp × sp/tp/ep mesh) test (tier-1; "
+        "select alone with -m mp)")
 
 
 @pytest.fixture(autouse=True)

@@ -404,12 +404,13 @@ class TestZeroFindings:
 class TestCompositionMatrix:
     def test_full_matrix_static_and_clean(self):
         rep = composition_matrix()
-        # 2 guard x 6 sync x 2 pipelined x 2 ps = 48 combos, all
-        # classified, zero broken — the ROADMAP "seams" CI gate
-        assert len(rep["combos"]) == 48
+        # 2 guard x 6 sync x 2 pipelined x 2 ps x 2 mesh = 96 combos,
+        # all classified, zero broken — the ROADMAP "seams" CI gate,
+        # now with the model-parallel mesh dimension (PR 13)
+        assert len(rep["combos"]) == 96
         assert rep["counts"]["broken"] == 0, rep["broken"]
-        assert rep["counts"]["ok"] == 32
-        assert rep["counts"]["rejected"] == 16
+        assert rep["counts"]["ok"] == 64
+        assert rep["counts"]["rejected"] == 32
         for c in rep["combos"]:
             if c["status"] == "rejected":
                 assert c["reason"], c
@@ -422,6 +423,15 @@ class TestCompositionMatrix:
                  and c["status"] == "ok"]
         assert noted and all(
             any("inert" in n for n in c["notes"]) for c in noted)
+        # every dp_sp combo that verifies carries the mesh note, and
+        # the guard x sp x sharded product is in the verified set
+        sp = [c for c in rep["combos"] if c["mesh"] == "dp_sp"]
+        assert len(sp) == 48
+        assert all(any("dp×sp" in n for n in c["notes"])
+                   for c in sp if c["status"] == "ok")
+        assert any(c["guard"] and c["gradient_sync"] ==
+                   "sharded_update_q8" and c["status"] == "ok"
+                   for c in sp)
 
     def test_matrix_performs_zero_compiles(self):
         """The whole sweep is static: the process-wide executor

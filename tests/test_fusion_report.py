@@ -133,6 +133,34 @@ class TestFusionReportLive:
                    aug["fused_kernels_total"]))
         assert aug["collective_boundaries_total"] > 0
 
+    @pytest.mark.mp
+    def test_sp_axis_boundaries_do_not_split_fusion(self):
+        """ISSUE 13 satellite: enabling sp (attention through the
+        Ulysses/zigzag schedule, sequence-sharded activations) must
+        not LOWER the transformer's fused-kernel count vs the same
+        4-device budget spent as pure dp — and the sp-axis collective
+        boundaries (the schedules' all_to_all / permute plus GSPMD's
+        reshard gathers) must be visible to the audit with fused
+        kernels on at least one side."""
+        base = fusion_report.run_and_report("transformer",
+                                            axes={"dp": 4})
+        sp = fusion_report.run_and_report(
+            "transformer", axes={"dp": 2, "sp": 2})
+        assert sp["fused_kernels_total"] >= \
+            base["fused_kernels_total"], (
+                "sp LOWERED the fused-kernel count: %d -> %d"
+                % (base["fused_kernels_total"],
+                   sp["fused_kernels_total"]))
+        assert sp["collective_boundaries_total"] > \
+            base["collective_boundaries_total"]
+        colls = [b for r in sp["programs"] if r.get("analysis")
+                 for b in r["analysis"]["boundaries"]["collectives"]]
+        assert any(b["op"] == "all-to-all" for b in colls), \
+            "the Ulysses all_to_all boundary is missing — sp did " \
+            "not engage"
+        assert any(b["fed_by_fusion"] or b["feeds_fusion"]
+                   for b in colls)
+
 
 class TestCliSmoke:
     def test_json_smoke(self, capsys):

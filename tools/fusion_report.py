@@ -187,7 +187,7 @@ def fusion_report(exe) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 def build_demo_program(model="mlp", gradient_sync=None, guard=False,
-                       devices=1, seed=7, wrap_mesh=False):
+                       devices=1, seed=7, wrap_mesh=False, axes=None):
     """Build (program-to-run, startup, feed, scope, loss) for the CLI:
     a small MLP or a tiny transformer, optionally data-parallel with an
     explicit gradient_sync rewrite and/or the anomaly guard — the three
@@ -195,11 +195,19 @@ def build_demo_program(model="mlp", gradient_sync=None, guard=False,
     the CompiledProgram/mesh wrapper even at devices=1 with no
     rewrites: a like-for-like plain baseline on a single-device host
     must carry the same GSPMD wrapper as the augmented program it is
-    compared against."""
+    compared against. ``axes`` (e.g. {"dp": 2, "sp": 2}) selects an
+    explicit multi-axis mesh: the transformer's attention then routes
+    through the sp schedule (zigzag chunk-pair permute / Ulysses
+    all_to_all), adding the sp-axis collective boundaries this audit
+    inspects alongside the gradient-sync ones."""
     import numpy as np
 
     import paddle_tpu as fluid
 
+    if axes:
+        devices = 1
+        for v in axes.values():
+            devices *= int(v)
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = seed
     startup.random_seed = seed
@@ -207,9 +215,14 @@ def build_demo_program(model="mlp", gradient_sync=None, guard=False,
     with fluid.program_guard(main, startup):
         if model == "transformer":
             from paddle_tpu.models import transformer as T
+            # attention dropout pins the replicated lowering (the sp
+            # schedules run their per-device kernels test-mode), so
+            # every explicit-axes probe trains without it — keeping
+            # the dp-vs-dp×sp comparison like-for-like
+            dropout = 0.0 if axes else 0.1
             cfg = T.TransformerConfig(
                 src_vocab=64, tgt_vocab=64, max_len=16, d_model=32,
-                d_ffn=64, n_head=2, n_layer=1, dropout=0.1)
+                d_ffn=64, n_head=2, n_layer=1, dropout=dropout)
             loss, _tok, _ = T.transformer(cfg)
             fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
             feed = T.make_fake_batch(cfg, max(4, devices))
@@ -232,25 +245,29 @@ def build_demo_program(model="mlp", gradient_sync=None, guard=False,
         with fluid.scope_guard(scope):
             install_anomaly_guard(main, loss=loss, scope=scope)
     prog = main
-    if gradient_sync or devices > 1 or wrap_mesh:
+    if gradient_sync or devices > 1 or wrap_mesh or axes:
+        import jax
+
         from paddle_tpu.parallel import mesh as mesh_lib
         bs = fluid.BuildStrategy()
         if gradient_sync:
             bs.gradient_sync = gradient_sync
+        mesh = mesh_lib.make_mesh(dict(axes),
+                                  jax.devices()[:devices]) \
+            if axes else mesh_lib.data_parallel_mesh(devices)
         prog = fluid.CompiledProgram(main).with_data_parallel(
-            build_strategy=bs,
-            mesh=mesh_lib.data_parallel_mesh(devices))
+            build_strategy=bs, mesh=mesh)
     return prog, startup, feed, scope, loss
 
 
 def run_and_report(model="mlp", gradient_sync=None, guard=False,
-                   devices=1, wrap_mesh=False) -> dict:
+                   devices=1, wrap_mesh=False, axes=None) -> dict:
     """Build, compile (one run), audit. The returned dict is the CLI's
     JSON payload: per-executable analyses + module totals."""
     import paddle_tpu as fluid
     prog, startup, feed, scope, loss = build_demo_program(
         model, gradient_sync=gradient_sync, guard=guard,
-        devices=devices, wrap_mesh=wrap_mesh)
+        devices=devices, wrap_mesh=wrap_mesh, axes=axes)
     exe = fluid.Executor()
     with fluid.scope_guard(scope):
         exe.run(startup)
@@ -259,7 +276,7 @@ def run_and_report(model="mlp", gradient_sync=None, guard=False,
     analyzed = [r for r in recs if r.get("analysis")]
     return {
         "model": model, "gradient_sync": gradient_sync,
-        "guard": bool(guard), "devices": devices,
+        "guard": bool(guard), "devices": devices, "axes": axes,
         "programs": recs,
         "fused_kernels_total": sum(
             r["analysis"]["fused_kernels"] for r in analyzed),
@@ -282,9 +299,24 @@ def main(argv=None):
     ap.add_argument("--devices", type=int, default=1,
                     help="dp mesh size (CPU tests force 8 virtual "
                     "devices)")
+    ap.add_argument("--axes", default=None,
+                    help="explicit multi-axis mesh, e.g. "
+                    "'dp=2,sp=2' — audits the sp-axis collective "
+                    "boundaries (zigzag permute / Ulysses all_to_all) "
+                    "the model-parallel runtime splices in")
     ap.add_argument("--json", action="store_true",
                     help="full JSON report (default: summary lines)")
     args = ap.parse_args(argv)
+
+    axes = None
+    if args.axes:
+        axes = {}
+        for part in args.axes.split(","):
+            k, v = part.split("=")
+            axes[k.strip()] = int(v)
+        args.devices = 1
+        for v in axes.values():
+            args.devices *= v
 
     # standalone CLI nicety: a multi-device audit on the CPU backend
     # needs virtual devices (tests get this from conftest; the flag
@@ -297,7 +329,8 @@ def main(argv=None):
                 % max(8, args.devices)).strip()
 
     rep = run_and_report(args.model, gradient_sync=args.gradient_sync,
-                         guard=args.guard, devices=args.devices)
+                         guard=args.guard, devices=args.devices,
+                         axes=axes)
     if args.json:
         print(json.dumps(rep, indent=1, default=repr))
         return 0

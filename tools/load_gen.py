@@ -162,7 +162,8 @@ def run_open_loop(engine, make_feed, qps, duration_s, deadline_ms):
 def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
                 queue_size=256, policy="least_loaded",
                 router_config=None, startup_timeout_s=120.0,
-                replica_args=(), compile_cache_dir=None):
+                replica_args=(), compile_cache_dir=None,
+                group_size=1, mesh_axes=None):
     """Spawn ``n_replicas`` serving-replica SUBPROCESSES (real
     processes — the fleet's scaling claim is about escaping one
     process) for ``model_dir`` and return ``(router, stop)`` where
@@ -189,18 +190,38 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
     # explicit dir must beat an inherited var, and "" must blank the
     # inherited var out (compile_cache.active() reads "" as disabled)
     env["PADDLE_TPU_COMPILE_CACHE_DIR"] = compile_cache_dir or ""
+    group_size = max(1, int(group_size))
+    # with groups, n_replicas counts GROUPS; total = groups * size.
+    # Member 0 of each group executes the pjit'd forward over
+    # mesh_axes; members >0 are the group's shard/lease surface.
+    n_procs = n_replicas * group_size
+    mesh_json = json.dumps(mesh_axes) if mesh_axes else None
     procs, endpoints = [], []
     try:
-        for k in range(n_replicas):
+        for k in range(n_procs):
+            rank = k % group_size
             cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
                    "--model-dir", str(model_dir), "--port", "0",
                    "--replica-id", str(k),
                    "--max-batch", str(max_batch),
                    "--wait-us", str(wait_us),
                    "--queue-size", str(queue_size)]
+            child_env = env
+            if group_size > 1:
+                cmd.extend(["--group-rank", str(rank),
+                            "--group-size", str(group_size)])
+                if rank == 0 and mesh_json:
+                    cmd.extend(["--mesh-axes", mesh_json])
+                    import numpy as _np
+                    ndev = int(_np.prod(list(mesh_axes.values())))
+                    child_env = dict(
+                        env, XLA_FLAGS=(env.get("XLA_FLAGS", "")
+                                        + " --xla_force_host_platform"
+                                        "_device_count=%d"
+                                        % ndev).strip())
             cmd.extend(replica_args)
             procs.append(subprocess.Popen(
-                cmd, env=env, cwd=os.path.dirname(os.path.dirname(
+                cmd, env=child_env, cwd=os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))),
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 text=True))
@@ -224,7 +245,8 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
     cfg = router_config or RouterConfig(policy=policy,
                                         lease_timeout_s=2.0,
                                         heartbeat_interval_s=0.2,
-                                        connect_timeout_s=10.0)
+                                        connect_timeout_s=10.0,
+                                        group_size=group_size)
     router = ServingRouter(endpoints, cfg)
 
     def stop():
@@ -341,6 +363,17 @@ def main(argv=None):
                                          "round_robin"),
                     default="least_loaded",
                     help="router dispatch policy (with --replicas)")
+    ap.add_argument("--group-size", type=int, default=1,
+                    help="sharded replica groups: --replicas counts "
+                    "GROUPS of this many member processes each; "
+                    "member 0 executes one pjit'd forward over "
+                    "--mesh-axes, the rest are the group's lease "
+                    "surface. Any member dying evicts the whole "
+                    "group; the report carries group-evict/retry "
+                    "counts.")
+    ap.add_argument("--mesh-axes", default=None,
+                    help="JSON axis dict for the group executor's "
+                    "mesh, e.g. '{\"tp\": 2}' (with --group-size)")
     ap.add_argument("--hidden", type=int, default=32,
                     help="synthetic model hidden width")
     ap.add_argument("--max-batch", type=int, default=32)
@@ -369,7 +402,9 @@ def main(argv=None):
         engine, stop_fleet = spawn_fleet(
             model_dir, args.replicas, max_batch=args.max_batch,
             wait_us=args.wait_us, queue_size=args.queue_size,
-            policy=args.policy)
+            policy=args.policy, group_size=args.group_size,
+            mesh_axes=json.loads(args.mesh_axes)
+            if args.mesh_axes else None)
         with open(os.path.join(model_dir,
                                "__signature__.json")) as f:
             sig = json.load(f)
@@ -427,6 +462,16 @@ def main(argv=None):
                                     "failures", "sheds", "p50_ms",
                                     "p99_ms", "queue_depth")}
             for rid, s in stats["replicas"].items()}
+        if args.group_size > 1:
+            # group serving: evict/readmit transitions + retry volume
+            # (the acceptance numbers for sharded group inference)
+            rc = stats["router"]
+            report["group_size"] = args.group_size
+            report["groups"] = stats.get("groups", {})
+            report["group_evictions"] = rc.get("group_evictions", 0)
+            report["group_readmissions"] = rc.get(
+                "group_readmissions", 0)
+            report["retries"] = rc.get("retries", 0)
     report.update(client)
     print(json.dumps(report), flush=True)
     return 1 if client.get("client_failed") else 0
