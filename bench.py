@@ -1562,6 +1562,219 @@ def bench_ps_degraded(steps=16):
             else None}
 
 
+def zipf_ids(rng, vocab, size, skew=0.9, perm=None):
+    """Bounded Zipf key stream: P(rank r) ∝ r^-skew over ``vocab``
+    ids, rank->id scrambled by ``perm`` so hot keys scatter across
+    hash shards (a real CTR id space has no rank order)."""
+    p = np.arange(1, vocab + 1, dtype=np.float64) ** -float(skew)
+    p /= p.sum()
+    ranks = rng.choice(vocab, size=size, p=p)
+    return (perm[ranks] if perm is not None else ranks) \
+        .astype(np.int64)
+
+
+def bench_sparse_embedding_throughput(steps=12, batch_rows=2048,
+                                      vocab=10000, dim=32):
+    """Tiered-sparse plane row (docs/sparse.md): rows/s and measured
+    bytes-on-wire of the LookupServiceClient pull+push loop against 2
+    in-process pserver shards, at Zipf skew 0.9 vs uniform keys, hot
+    cache on vs off, q8 vs fp32 wire. The acceptance bars: q8 push
+    wire bytes <= 0.35x fp32, STEADY-STATE hot-cache hit rate > 0.8
+    at skew 0.9 (last quarter of the run — compulsory first-touch
+    misses are ~1/3 of this short probe's draws and say nothing about
+    the tier; the lifetime average is reported alongside), and a
+    small DeepFM-style model's loss trajectory with q8+cache within
+    rtol of the exact/uncached twin."""
+    import time as _time
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.distributed import (LargeScaleKV,
+                                        LookupServiceClient,
+                                        SparsePServer,
+                                        SparseEmbeddingRuntime,
+                                        SparseTierConfig)
+
+    LR = 0.1
+    rng = np.random.RandomState(7)
+    perm = rng.permutation(vocab)
+    streams = {
+        "zipf0.9": [zipf_ids(rng, vocab, batch_rows, 0.9, perm)
+                    for _ in range(steps)],
+        "uniform": [rng.randint(0, vocab, batch_rows)
+                    .astype(np.int64) for _ in range(steps)],
+    }
+
+    def run(stream, cache, q8):
+        tables = [{"t": LargeScaleKV(dim=dim, lr=LR, seed=3)}
+                  for _ in range(2)]
+        servers = [SparsePServer("127.0.0.1:0", tb).start()
+                   for tb in tables]
+        try:
+            # hot tier = half the PROBE vocab (zipf0.9 over 10k ids:
+            # the top half absorbs ~89% of draws — web-scale vocabs
+            # are larger but so is the skew concentration, the CPU
+            # probe just shrinks the id space). admit_after stays 1:
+            # this short probe (24k draws) never gives the tail a 2nd
+            # touch, so stricter admission only starves the tier
+            # (the admission policy's churn protection is unit-tested
+            # under a long stream in tests/test_sparse_tier.py)
+            cl = LookupServiceClient(
+                "t", [s.endpoint for s in servers], dim=dim,
+                trainer_id=0,
+                cache_bytes=(vocab // 2) * dim * 4 if cache else 0,
+                push_q8=q8, pull_q8=q8,
+                write_policy="mirror_sgd", mirror_lr=LR)
+            grads = rng.randn(batch_rows, dim).astype(np.float32) \
+                * 0.01
+            cl.pull(streams[stream][0])   # warm connections
+            # counter baselines AFTER the warm pull: every reported
+            # metric (wire bytes, hit rates, rows/s) covers the SAME
+            # 12-step window
+            wire0 = cl.wire_bytes()["total"]
+            hits0, pulled0 = cl.cache_hit_rows, cl.pulled_rows
+            marks = []
+            t0 = _time.monotonic()
+            for ids in streams[stream]:
+                cl.pull(ids)
+                cl.push(ids, grads)
+                marks.append((cl.cache_hit_rows, cl.pulled_rows))
+            wall = _time.monotonic() - t0
+            wire = cl.wire_bytes()["total"] - wire0
+            tail = max(1, steps // 4)   # steady state = last quarter
+            dh = marks[-1][0] - marks[-1 - tail][0]
+            dp = marks[-1][1] - marks[-1 - tail][1]
+            lifetime_pulled = cl.pulled_rows - pulled0
+            out = {
+                "rows_per_sec": 2 * steps * batch_rows / wall,
+                "wire_bytes_per_step": wire / steps,
+                "hit_rate": (cl.cache_hit_rows - hits0)
+                / lifetime_pulled
+                if cache and lifetime_pulled else None,
+                "hit_rate_steady": (dh / dp) if cache and dp else None,
+            }
+            cl.close()
+            return out
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    rows = {}
+    for stream in streams:
+        for cache in (False, True):
+            for q8 in (False, True):
+                lib = "%s/%s/%s" % (stream,
+                                    "cache" if cache else "nocache",
+                                    "q8" if q8 else "fp32")
+                rows[lib] = run(stream, cache, q8)
+                print(json.dumps(dict(
+                    {"metric": "sparse_embedding_throughput_mix",
+                     "library": lib, "unit": "rows/s",
+                     "value": round(rows[lib]["rows_per_sec"], 1)},
+                    wire_bytes_per_step=round(
+                        rows[lib]["wire_bytes_per_step"], 1),
+                    hit_rate=None
+                    if rows[lib]["hit_rate"] is None
+                    else round(rows[lib]["hit_rate"], 4),
+                    hit_rate_steady=None
+                    if rows[lib]["hit_rate_steady"] is None
+                    else round(rows[lib]["hit_rate_steady"], 4))),
+                    flush=True)
+
+    # loss-trajectory twin: DeepFM-style CTR net over a distributed
+    # table — exact/uncached vs q8+cache must match within rtol
+    def trajectory(tier):
+        with fluid.unique_name.guard():
+            fluid.framework._reset_default_programs()
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 11
+            with fluid.program_guard(main, startup):
+                ids = layers.data("ids", shape=[6], dtype="int64")
+                label = layers.data("label", shape=[1],
+                                    dtype="float32")
+                emb = layers.embedding(
+                    ids, size=[vocab, dim], is_distributed=True,
+                    param_attr=fluid.ParamAttr(name="bench_sparse_w"))
+                first = layers.reduce_sum(emb, dim=[1, 2],
+                                          keep_dim=True)
+                inter = layers.reduce_sum(  # FM-style interaction
+                    layers.square(layers.reduce_sum(emb, dim=1)),
+                    dim=1, keep_dim=True)
+                h = layers.fc(layers.reshape(emb,
+                                             shape=[-1, 6 * dim]),
+                              size=16, act="relu")
+                logit = layers.fc(h, size=1) + first \
+                    + layers.scale(inter, scale=0.01)
+                loss = layers.mean(
+                    layers.sigmoid_cross_entropy_with_logits(
+                        logit, label))
+                fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+            tables = [{"bench_sparse_w": LargeScaleKV(dim=dim, lr=LR,
+                                                      seed=5)}
+                      for _ in range(2)]
+            servers = [SparsePServer("127.0.0.1:0", tb).start()
+                       for tb in tables]
+            try:
+                srt = SparseEmbeddingRuntime(
+                    main, [s.endpoint for s in servers], tier=tier)
+                scope = fluid.Scope()
+                losses = []
+                with fluid.scope_guard(scope):
+                    exe = fluid.Executor()
+                    exe.run(startup)
+                    r = np.random.RandomState(0)
+                    id_batch = r.randint(0, vocab, (64, 6))
+                    lbl = (id_batch.sum(1) % 2).reshape(-1, 1) \
+                        .astype(np.float32)
+                    feed0 = {"ids": id_batch.astype(np.int64),
+                             "label": lbl}
+                    for _ in range(8):
+                        feed = srt.wrap_feed(feed0)
+                        out = exe.run(main, feed=feed,
+                                      fetch_list=[loss]
+                                      + srt.grad_fetch_names())
+                        losses.append(float(
+                            np.asarray(out[0]).reshape(-1)[0]))
+                        srt.push_grads(feed, out[1:])
+                srt.close()
+                return losses
+            finally:
+                for s in servers:
+                    s.shutdown()
+
+    exact = trajectory(SparseTierConfig())
+    q8c = trajectory(SparseTierConfig(
+        cache_bytes=vocab * dim * 4, push_q8=True,
+        write_policy="mirror_sgd", mirror_lr=LR, trainer_id=0))
+    rel = float(np.max(np.abs(np.asarray(q8c) - np.asarray(exact))
+                       / np.maximum(np.abs(exact), 1e-9)))
+
+    hot = rows["zipf0.9/cache/q8"]
+    ratio = rows["zipf0.9/nocache/q8"]["wire_bytes_per_step"] \
+        / rows["zipf0.9/nocache/fp32"]["wire_bytes_per_step"]
+    cache_wire = rows["zipf0.9/nocache/q8"]["wire_bytes_per_step"] \
+        / hot["wire_bytes_per_step"]
+    return {"metric": "sparse_embedding_throughput",
+            "value": round(hot["rows_per_sec"], 1),
+            "unit": "rows/s (zipf0.9, cache+q8)",
+            "hit_rate_zipf09_steady":
+                round(hot["hit_rate_steady"], 4),
+            "hit_rate_zipf09_lifetime": round(hot["hit_rate"], 4),
+            "hit_rate_uniform":
+                round(rows["uniform/cache/q8"]["hit_rate"], 4),
+            "q8_wire_ratio": round(ratio, 4),
+            "q8_wire_ratio_ok": ratio <= 0.35,
+            "hit_rate_ok": hot["hit_rate_steady"] > 0.8,
+            "cache_wire_reduction_zipf09": round(cache_wire, 2),
+            "cache_speedup_zipf09": round(
+                hot["rows_per_sec"]
+                / rows["zipf0.9/nocache/q8"]["rows_per_sec"], 2),
+            "loss_max_rel_diff_q8_cache_vs_exact": round(rel, 6),
+            "loss_rtol_ok": rel < 0.05,
+            "steps": steps, "batch_rows": batch_rows,
+            "vocab": vocab, "dim": dim}
+
+
 _EMITTED = []
 
 
@@ -1795,6 +2008,7 @@ def child_main():
                  bench_compile_cache_warmup, bench_fused_kernel_count,
                  bench_model_parallel,
                  bench_guarded_overhead, bench_ps_degraded,
+                 bench_sparse_embedding_throughput,
                  bench_serving_latency, bench_serving_fleet_scaling,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,

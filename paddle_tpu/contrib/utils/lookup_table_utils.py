@@ -47,12 +47,44 @@ def save_lookup_table(table: LargeScaleKV, dirname):
     stopped, including lazy-init seed and adagrad accumulators."""
     os.makedirs(dirname, exist_ok=True)
     with table._mu:
-        ids = np.asarray(sorted(table._rows), np.int64)
-        rows = (np.stack([table._rows[int(i)] for i in ids])
-                if len(ids) else np.zeros((0, table.dim), np.float32))
-        acc_ids = np.asarray(sorted(table._accum), np.int64)
-        accum = (np.stack([table._accum[int(i)] for i in acc_ids])
-                 if len(acc_ids)
+        # ALL touched rows: resident plus the Tier-2 spilled set (a
+        # budgeted table keeps most trained rows on disk — reading
+        # only _rows would silently drop them from the checkpoint).
+        # peek() leaves residency undisturbed.
+        spill = table._spill
+        spilled = set(spill._index) if spill is not None else set()
+        # read each spill SEGMENT once (grouped by segment, not id
+        # order — the store's parse cache is tiny and sorted-id
+        # iteration would re-read whole segment files per row)
+        spilled_rows, spilled_acc = {}, {}
+        if spill is not None:
+            by_seg = {}
+            for rid, seg in spill._index.items():
+                by_seg.setdefault(seg, []).append(rid)
+            for seg, rids in by_seg.items():
+                p = spill._parse(seg)
+                for rid in rids:
+                    spilled_rows[rid] = p["rows"][p["pos"][rid]]
+                    if p["accum"] is not None and rid in p["a_pos"]:
+                        spilled_acc[rid] = \
+                            p["accum"][p["a_pos"][rid]]
+        ids = np.asarray(sorted(set(table._rows) | spilled), np.int64)
+        row_list, acc_pairs = [], []
+        for rid in ids:
+            rid = int(rid)
+            if rid in table._rows:
+                row_list.append(table._rows[rid])
+                acc = table._accum.get(rid)
+            else:
+                row_list.append(spilled_rows[rid])
+                acc = spilled_acc.get(rid)
+            if acc is not None:
+                acc_pairs.append((rid, acc))
+        rows = (np.stack(row_list) if len(ids)
+                else np.zeros((0, table.dim), np.float32))
+        acc_ids = np.asarray([r for r, _ in acc_pairs], np.int64)
+        accum = (np.stack([a for _, a in acc_pairs])
+                 if acc_pairs
                  else np.zeros((0, table.dim), np.float32))
     np.savez(os.path.join(dirname, LOOKUP_TABLE_FILE),
              ids=ids, rows=rows, dim=np.int64(table.dim),
@@ -149,12 +181,16 @@ def convert_dist_to_sparse_program(program):
             blk.create_parameter(name=lk["table"],
                                  shape=(lk["rows"], lk["dim"]),
                                  dtype="float32")
+        pad = lk.get("padding_idx")
         blk.prepend_op(
             type="lookup_table",
             inputs={"W": [lk["table"]], "Ids": [lk["ids"]]},
             outputs={"Out": [lk["out"]]},
             attrs={"is_sparse": False, "is_distributed": False,
-                   "padding_idx": -1})
+                   # carry the recorded padding contract into the
+                   # local op (training zeroed pad rows via
+                   # wrap_feed; serving must too)
+                   "padding_idx": -1 if pad is None else int(pad)})
         # the op now produces lk["out"]; it is no longer fed
         v = blk.var(lk["out"])
         v.is_data = False

@@ -20,6 +20,8 @@ from .rpc import (RPCClient, RPCServer, VERBS,  # noqa: F401
                   RemoteHandlerError, RpcError, TrainerEvicted)
 from .ps import (Communicator, HeartbeatThread,  # noqa: F401
                  ListenAndServ, ParameterServerRuntime,
-                 PServerRuntime, ShardSnapshotter)
-from .lookup_service import LargeScaleKV, LookupServiceClient  # noqa: F401
-from .sparse import SparseEmbeddingRuntime  # noqa: F401
+                 PServerRuntime, ShardSnapshotter, SparsePServer)
+from .embedding_cache import EmbeddingRowCache  # noqa: F401
+from .lookup_service import (LargeScaleKV,  # noqa: F401
+                             LookupServiceClient, RowSpillStore)
+from .sparse import SparseEmbeddingRuntime, SparseTierConfig  # noqa: F401

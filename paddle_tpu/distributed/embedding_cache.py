@@ -1,0 +1,202 @@
+"""Trainer-side hot-tier embedding row cache (Tier 0 of the sparse
+plane).
+
+Reference shape: the CTR workloads the fleet/Downpour stack existed
+for see Zipf-skewed key streams — a few thousand hot ids absorb most
+of a batch's lookups — yet the baseline LookupServiceClient pays a
+full DCN round-trip for every row of every batch. This cache sits in
+front of the prefetch path (LookupServiceClient(cache_bytes=...)
+wires it in) so skewed traffic is served host-local:
+
+  - **admission by touch frequency**: a row enters the cache only
+    after it has MISSED ``admit_after`` times (admit_after=1 admits on
+    first touch). One-touch cold rows — the long Zipf tail — never
+    displace hot rows, the classic TinyLFU/ghost-counter argument.
+  - **eviction by CLOCK under a byte budget**: ``capacity_bytes``
+    bounds resident bytes; the victim scan gives recently-referenced
+    rows a second chance (ref bit cleared, requeued) — LRU quality at
+    FIFO cost.
+  - **write-through of sparse grads**: ``apply_delta`` updates CACHED
+    rows in place with the same update image the pserver applies
+    (lookup_service mirrors the server's SGD step, including the q8
+    dequantization round-trip), so a pushed hot row stays valid
+    instead of being invalidated back into a miss every step.
+  - **explicit invalidation**: ``invalidate_all`` / ``invalidate_ids``
+    — the owning client calls these exactly once per observed pserver
+    ``__incarnation__`` change (restarted server state may differ from
+    any cached image).
+
+Lock discipline (tools/lock_lint.py gates this file): ``_mu`` protects
+only dict/bytes bookkeeping — no journal emit, no RPC, no disk I/O
+ever runs under it; callers emit AFTER their cache call returns.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+from ..core.enforce import enforce
+
+__all__ = ["EmbeddingRowCache"]
+
+
+class EmbeddingRowCache:
+    """Byte-budgeted id -> row cache with frequency admission and
+    CLOCK (second-chance) eviction. Thread-safe; all-numpy; one
+    instance per (table, trainer)."""
+
+    def __init__(self, dim: int, capacity_bytes: int,
+                 admit_after: int = 1, dtype=np.float32):
+        enforce(int(dim) > 0, "cache dim must be positive")
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.row_bytes = self.dim * self.dtype.itemsize
+        self.capacity_rows = max(1, int(capacity_bytes)
+                                 // self.row_bytes)
+        self.admit_after = max(1, int(admit_after))
+        # CLOCK as a second-chance FIFO: OrderedDict insertion order is
+        # the ring; the "hand" pops from the front, a set ref bit
+        # requeues to the back instead of evicting
+        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._ref: Dict[int, bool] = {}
+        # ghost touch counters for admission (misses per id); bounded
+        # by periodic halving so the tail can't grow it unboundedly
+        self._touches: Dict[int, int] = {}
+        self._touch_cap = max(4096, 8 * self.capacity_rows)
+        self._mu = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    # -- read path ----------------------------------------------------------
+    def get_many(self, ids: Sequence[int]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (values [n, dim], hit_mask [n] bool). Missing rows are
+        zero-filled in ``values``; every id's touch counter is bumped
+        so repeat misses become admissible."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.zeros((len(ids), self.dim), self.dtype)
+        mask = np.zeros(len(ids), bool)
+        with self._mu:
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                if row is not None:
+                    out[i] = row
+                    mask[i] = True
+                    self._ref[rid] = True
+                    self.hits += 1
+                else:
+                    self.misses += 1
+                    self._touch_locked(rid)
+        return out, mask
+
+    def _touch_locked(self, rid: int):
+        self._touches[rid] = self._touches.get(rid, 0) + 1
+        if len(self._touches) > self._touch_cap:
+            # halve-and-drop keeps the counter dict bounded while
+            # preserving relative hotness (TinyLFU aging)
+            self._touches = {k: v // 2
+                             for k, v in self._touches.items()
+                             if v > 1}
+
+    # -- fill path ----------------------------------------------------------
+    def put_many(self, ids: Sequence[int], rows: np.ndarray):
+        """Offer freshly pulled rows. Admission: only ids whose miss
+        count reached ``admit_after`` enter; admitted rows are COPIES
+        (caller may hand the same buffer to the device)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, self.dtype).reshape(len(ids), self.dim)
+        with self._mu:
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                if rid in self._rows:
+                    # refresh in place: the pull is at least as new as
+                    # the cached image
+                    self._rows[rid][...] = rows[i]
+                    self._ref[rid] = True
+                    continue
+                if self._touches.get(rid, 0) < self.admit_after:
+                    continue
+                self._evict_until_fits_locked()
+                self._rows[rid] = np.array(rows[i], self.dtype)
+                self._ref[rid] = False
+                self._touches.pop(rid, None)
+
+    def _evict_until_fits_locked(self):
+        while len(self._rows) >= self.capacity_rows:
+            rid, row = self._rows.popitem(last=False)
+            if self._ref.pop(rid, False):
+                # second chance: recently referenced — requeue
+                self._rows[rid] = row
+                self._ref[rid] = False
+            else:
+                self.evictions += 1
+
+    # -- write-through ------------------------------------------------------
+    def apply_delta(self, ids: Sequence[int], deltas: np.ndarray):
+        """In-place ``row += delta`` for PRESENT rows (absent ids are
+        ignored — the authority copy on the pserver got the same
+        update). ``deltas`` must already be the server's exact update
+        image (e.g. ``-lr * dequant(q8(grad))``)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, self.dtype).reshape(len(ids),
+                                                        self.dim)
+        with self._mu:
+            for i, rid in enumerate(ids):
+                row = self._rows.get(int(rid))
+                if row is not None:
+                    row += deltas[i]
+
+    # -- invalidation -------------------------------------------------------
+    def invalidate_ids(self, ids: Sequence[int]) -> int:
+        n = 0
+        with self._mu:
+            for rid in np.asarray(ids, np.int64).reshape(-1):
+                if self._rows.pop(int(rid), None) is not None:
+                    self._ref.pop(int(rid), None)
+                    n += 1
+            self.invalidations += n
+        return n
+
+    def invalidate_all(self) -> int:
+        with self._mu:
+            n = len(self._rows)
+            self._rows.clear()
+            self._ref.clear()
+            self._touches.clear()
+            self.invalidations += n
+        return n
+
+    # -- introspection ------------------------------------------------------
+    def __len__(self):
+        with self._mu:
+            return len(self._rows)
+
+    def resident_bytes(self) -> int:
+        with self._mu:
+            return len(self._rows) * self.row_bytes
+
+    def hit_rate(self) -> float:
+        with self._mu:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        with self._mu:
+            total = self.hits + self.misses
+            return {
+                "rows": len(self._rows),
+                "capacity_rows": self.capacity_rows,
+                "resident_bytes": len(self._rows) * self.row_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }

@@ -1,4 +1,4 @@
-"""Distributed sparse embedding service: host-resident sharded tables.
+"""Distributed sparse embedding service: tiered host-resident tables.
 
 Reference: the large-scale sparse competency (L7c) —
 - trainer-side prefetch of remote embedding rows:
@@ -12,23 +12,259 @@ Reference: the large-scale sparse competency (L7c) —
 
 TPU-native design: tables that FIT in HBM shard over the mesh with
 all-to-all lookup (models/deepfm.py). This module is the beyond-HBM
-tier: rows live in host RAM across pserver processes (hash-sharded by
-row id), trainers PREFETCH the rows a batch needs into a small device
-tensor, and push sparse (ids, values) grads back — over DCN, exactly
-the reference's Downpour flow. Works with any optimizer that has a
-sparse row update (sgd/adagrad/momentum; optimizer_ops.py SparseRows
-path).
+TIERED story (docs/sparse.md):
+
+  Tier 0  trainer-side hot row cache (embedding_cache.py) in front of
+          the prefetch path — admission by touch frequency, CLOCK
+          eviction under a byte budget, write-through of sparse-grad
+          updates, invalidated exactly once per observed pserver
+          ``__incarnation__`` change;
+  Tier 1  the pserver shard (LargeScaleKV): hash-sharded authority,
+          rows materialize lazily on first touch;
+  Tier 2  durable disk spill (RowSpillStore): cold rows leave host RAM
+          under ``resident_bytes`` pressure and reload bit-equal on
+          next touch, so the RESIDENT set — not the logical table —
+          bounds pserver memory.
+
+Wire: PUSH_SPARSE / PREFETCH payloads optionally ride the q8 row
+codec (parallel/collectives.quantize_rows_q8 — one scale per row, the
+EQuARX block pattern with rows as the natural blocks) with per-touched
+-row error-feedback residuals held TRAINER-side, exact fp32 fallback
+below ``SPARSE_Q8_MIN_DIM``. Replayed quantized pushes dedupe on the
+PR 5 seq tracker server-side, and the residual is consumed once per
+logical push (the payload is built before any transport retry), so
+replays never double-apply and never double-consume residuals.
 """
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, List, Sequence
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core.enforce import InvalidArgumentError, enforce
+from ..io import deserialize_tensor, serialize_tensor
+from ..parallel.collectives import (SPARSE_Q8_MIN_DIM,
+                                    dequantize_rows_q8,
+                                    quantize_rows_q8)
+from .embedding_cache import EmbeddingRowCache
 from .rpc import RPCClient
+
+
+class RowSpillStore:
+    """Tier 2: durable cold-row spill segments under one directory.
+
+    Each ``spill`` writes ONE immutable segment file (tmp + fsync +
+    atomic rename — a torn writer leaves only an invisible tmp) holding
+    (ids, rows[, accum ids, accum rows]); the in-memory index maps
+    rid -> newest segment. Rows round-trip through the io.py tensor
+    format, so spill -> reload is bit-equal. Fully-superseded segments
+    are unlinked. NOT thread-safe on its own: the owning LargeScaleKV
+    serializes access under its row mutex."""
+
+    def __init__(self, dirname: str):
+        self.dir = dirname
+        os.makedirs(dirname, exist_ok=True)
+        self._index: Dict[int, int] = {}          # rid -> seg id
+        self._live: Dict[int, int] = {}           # seg id -> live rows
+        # segments with zero live rows. While NO snapshot boundary has
+        # ever been observed (epoch 0) they are unlinked immediately
+        # (pure budget mode, nothing restores from this dir); once
+        # boundaries exist they are only unlinked two boundaries after
+        # death (``on_boundary``) — a restart restoring either of the
+        # ShardSnapshotter's keep=2 snapshots may still need them
+        self._dead: Dict[int, int] = {}           # seg id -> epoch
+        self._epoch = 0
+        self._next_seg = 1
+        self._parsed: "OrderedDict[int, dict]" = OrderedDict()
+        self.spilled_rows = 0
+        self.loaded_rows = 0
+        self._scan()
+
+    def _scan(self):
+        """(Re)build index/live from the segment files on disk —
+        ascending order, newest segment wins every row. Never unlinks
+        (``_scanning``): a scan-superseded segment may still be the
+        fallback copy ``prune_after`` resurrects."""
+        self._index.clear()
+        self._live.clear()
+        self._dead.clear()
+        self._parsed.clear()
+        self._scanning = True
+        for name in sorted(os.listdir(self.dir)):
+            path = os.path.join(self.dir, name)
+            if name.endswith(".tmp"):
+                os.unlink(path)
+                continue
+            if not name.startswith("seg-"):
+                continue
+            try:
+                seg = int(name[len("seg-"):])
+            except ValueError:
+                continue   # seg-*.bak / editor strays: foreign, skip
+            self._next_seg = max(self._next_seg, seg + 1)
+            try:
+                ids = self._parse(seg)["ids"]
+            except Exception:
+                # torn/foreign file: ignore (rename is the commit
+                # point, so this only happens to hand-damaged dirs)
+                continue
+            for rid in ids:
+                self._claim(int(rid), seg)
+        self._scanning = False
+
+    def _path(self, seg: int) -> str:
+        return os.path.join(self.dir, "seg-%08d" % seg)
+
+    def _claim(self, rid: int, seg: int):
+        old = self._index.get(rid)
+        self._index[rid] = seg
+        self._live[seg] = self._live.get(seg, 0) + 1
+        if old is not None:
+            self._release_seg(old)
+
+    def _release_seg(self, seg: int):
+        n = self._live.get(seg, 0) - 1
+        if n <= 0:
+            self._live.pop(seg, None)
+            self._parsed.pop(seg, None)
+            if self._epoch == 0 and not self._scanning:
+                try:
+                    os.unlink(self._path(seg))
+                except OSError:
+                    pass
+            else:
+                # boundary discipline active (or mid-scan): the dead
+                # segment may hold a row's state AT an earlier
+                # boundary whose snapshot a restart can still
+                # restore — defer
+                self._dead[seg] = self._epoch
+        else:
+            self._live[seg] = n
+
+    def on_boundary(self):
+        """Called at every shard-snapshot boundary (export_state):
+        advance the GC epoch and unlink segments that have been fully
+        superseded for >= 2 boundaries (both retained snapshots are
+        newer than their death — no restore path can need them)."""
+        self._epoch += 1
+        for seg, died in list(self._dead.items()):
+            if died <= self._epoch - 2:
+                del self._dead[seg]
+                try:
+                    os.unlink(self._path(seg))
+                except OSError:
+                    pass
+
+    def spill(self, rows: Dict[int, np.ndarray],
+              accum: Optional[Dict[int, np.ndarray]] = None) -> int:
+        """Persist a batch of evicted rows; returns the segment id."""
+        enforce(rows, "spill of zero rows")
+        ids = np.fromiter(rows.keys(), np.int64, len(rows))
+        vals = np.stack([rows[int(i)] for i in ids])
+        a_ids = [i for i in ids if accum and int(i) in accum]
+        blob = serialize_tensor(ids) + serialize_tensor(vals)
+        blob += serialize_tensor(np.asarray(a_ids, np.int64))
+        if a_ids:
+            blob += serialize_tensor(
+                np.stack([accum[int(i)] for i in a_ids]))
+        seg = self._next_seg
+        self._next_seg += 1
+        tmp = self._path(seg) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, self._path(seg))
+        for rid in ids:
+            self._claim(int(rid), seg)
+        self.spilled_rows += len(ids)
+        return seg
+
+    def _parse(self, seg: int) -> dict:
+        hit = self._parsed.get(seg)
+        if hit is not None:
+            self._parsed.move_to_end(seg)
+            return hit
+        with open(self._path(seg), "rb") as f:
+            blob = f.read()
+        ids, off = deserialize_tensor(blob)
+        rows, off = deserialize_tensor(blob, off)
+        a_ids, off = deserialize_tensor(blob, off)
+        accum = None
+        if a_ids.size:
+            accum, _ = deserialize_tensor(blob, off)
+        out = {"ids": ids, "rows": rows, "a_ids": a_ids,
+               "accum": accum,
+               "pos": {int(r): j for j, r in enumerate(ids)},
+               "a_pos": {int(r): j for j, r in enumerate(a_ids)}}
+        self._parsed[seg] = out
+        while len(self._parsed) > 2:   # tiny parsed-segment LRU
+            self._parsed.popitem(last=False)
+        return out
+
+    def __contains__(self, rid: int) -> bool:
+        return int(rid) in self._index
+
+    def __len__(self):
+        return len(self._index)
+
+    def peek(self, rid: int) -> Tuple[np.ndarray,
+                                      Optional[np.ndarray]]:
+        """Read a spilled row WITHOUT forgetting it -> (row,
+        accum|None). Checkpoint/export paths use this so residency is
+        undisturbed."""
+        rid = int(rid)
+        p = self._parse(self._index[rid])
+        row = np.array(p["rows"][p["pos"][rid]])
+        acc = None
+        if p["accum"] is not None and rid in p["a_pos"]:
+            acc = np.array(p["accum"][p["a_pos"][rid]])
+        return row, acc
+
+    def load(self, rid: int) -> Tuple[np.ndarray,
+                                      Optional[np.ndarray]]:
+        """Reload (and forget) a spilled row -> (row, accum|None)."""
+        rid = int(rid)
+        row, acc = self.peek(rid)
+        seg = self._index.pop(rid)
+        self.loaded_rows += 1
+        self._release_seg(seg)
+        return row, acc
+
+    def discard(self, rid: int):
+        """Forget a spilled row WITHOUT reading it (a newer copy took
+        authority, e.g. a restored snapshot row) — releases the
+        segment claim so fully-superseded segments can be GC'd."""
+        seg = self._index.pop(int(rid), None)
+        if seg is not None:
+            self._release_seg(seg)
+
+    def horizon(self) -> int:
+        """Newest segment id written so far (0 = none) — recorded in
+        shard-snapshot meta so a restart can discard post-boundary
+        segments (state rolls back to the boundary EXACTLY)."""
+        return self._next_seg - 1
+
+    def prune_after(self, horizon: int):
+        """Drop every segment newer than ``horizon`` (restart-to-
+        boundary semantics), then REBUILD the index from the
+        survivors: a row whose newest copy was post-boundary falls
+        back to its pre-boundary segment copy (kept alive by the
+        deferred GC), the boundary snapshot, or deterministic lazy
+        init."""
+        drop = [s for s in (set(self._live) | set(self._dead))
+                if s > horizon]
+        for seg in drop:
+            try:
+                os.unlink(self._path(seg))
+            except OSError:
+                pass
+        self._scan()
 
 
 class LargeScaleKV:
@@ -36,34 +272,120 @@ class LargeScaleKV:
     "DownpourSparseTable" analog, fleet_wrapper.h pull_sparse/
     push_sparse). Rows materialize lazily on first touch (new ids
     init from a seeded hash so every shard is deterministic), so the
-    logical table can be arbitrarily larger than allocated memory."""
+    logical table can be arbitrarily larger than allocated memory.
+
+    ``resident_bytes`` + ``spill_dir`` arm Tier 2: when resident rows
+    (+ adagrad accumulators) exceed the budget, the CLOCK-cold ones
+    spill durably to disk and reload bit-equal on next touch —
+    pserver RSS is bounded by the budget, not the logical table."""
 
     def __init__(self, dim, init_std=0.01, optimizer="sgd", lr=0.01,
-                 seed=0, dtype=np.float32):
+                 seed=0, dtype=np.float32, resident_bytes=None,
+                 spill_dir=None):
         self.dim = int(dim)
         self.init_std = float(init_std)
         self.optimizer = optimizer
         self.lr = float(lr)
         self.seed = int(seed)
-        self.dtype = dtype
-        self._rows: Dict[int, np.ndarray] = {}
+        self.dtype = np.dtype(dtype)
+        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
         self._accum: Dict[int, np.ndarray] = {}  # adagrad state
+        self._ref: Dict[int, bool] = {}          # CLOCK bits
         self._mu = threading.Lock()
+        self._row_bytes = self.dim * self.dtype.itemsize
+        enforce(resident_bytes is None or spill_dir is not None,
+                "resident_bytes needs a spill_dir (evicted rows must "
+                "go somewhere durable)")
+        self.resident_rows = None
+        if resident_bytes is not None:
+            # a resident adagrad accumulator costs a second row
+            per_row = self._row_bytes * \
+                (2 if optimizer == "adagrad" else 1)
+            self.resident_rows = max(8, int(resident_bytes) // per_row)
+        self._spill = RowSpillStore(spill_dir) \
+            if spill_dir is not None else None
+
+    def _init_row(self, rid: int) -> np.ndarray:
+        rs = np.random.RandomState(
+            (self.seed * 0x9E3779B1 + rid) & 0x7FFFFFFF)
+        return (rs.randn(self.dim) * self.init_std).astype(self.dtype)
 
     def _row(self, rid: int) -> np.ndarray:
+        """Materialize ``rid`` resident. Budget discipline lives in
+        the CALLING batch op (``_reserve_locked`` before the loop,
+        ``_trim_locked`` after), not here — per-row enforcement would
+        write one tiny fsynced spill segment per eviction."""
         row = self._rows.get(rid)
-        if row is None:
-            rs = np.random.RandomState(
-                (self.seed * 0x9E3779B1 + rid) & 0x7FFFFFFF)
-            row = (rs.randn(self.dim) * self.init_std).astype(self.dtype)
-            self._rows[rid] = row
+        if row is not None:
+            self._ref[rid] = True
+            return row
+        if self._spill is not None and rid in self._spill:
+            row, acc = self._spill.load(rid)
+            if acc is not None:
+                self._accum[rid] = acc
+        else:
+            row = self._init_row(rid)
+        self._rows[rid] = row
+        self._ref[rid] = False
         return row
+
+    def _reserve_locked(self, ids):
+        """Pre-batch: make room for the batch's NEW rows in one spill
+        segment. A batch with more new rows than the whole budget
+        transiently overshoots (there is nothing cold left to evict);
+        ``_trim_locked`` restores the bound right after."""
+        if self.resident_rows is None:
+            return
+        # set-dedupe: pull() accepts duplicated ids, and counting each
+        # copy of one new id as a separate incoming row would evict
+        # (and fsync-spill) warm rows for slots that are never used
+        uniq = {int(i) for i in ids}
+        n_new = len(uniq - self._rows.keys())
+        # the batch's RESIDENT members are about to be referenced:
+        # set their CLOCK bits now so the victim scan second-chances
+        # them instead of spilling a row this very call reloads
+        for rid in uniq:
+            if rid in self._rows:
+                self._ref[rid] = True
+        self._maybe_spill_locked(min(n_new, self.resident_rows))
+
+    def _trim_locked(self):
+        if self.resident_rows is not None:
+            self._maybe_spill_locked(0)
+
+    def _maybe_spill_locked(self, incoming: int):
+        """CLOCK-evict cold rows into ONE spill segment until
+        ``incoming`` more rows fit in the resident budget."""
+        if self.resident_rows is None:
+            return
+        spare = self.resident_rows - incoming
+        if len(self._rows) <= spare:
+            return
+        victims: Dict[int, np.ndarray] = {}
+        accum: Dict[int, np.ndarray] = {}
+        while len(self._rows) > spare:
+            rid, row = self._rows.popitem(last=False)
+            if self._ref.pop(rid, False):
+                self._rows[rid] = row       # second chance
+                self._ref[rid] = False
+                continue
+            victims[rid] = row
+            if rid in self._accum:
+                accum[rid] = self._accum.pop(rid)
+        if victims:
+            self._spill.spill(victims, accum)
 
     def pull(self, ids: Sequence[int]) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)
         with self._mu:
-            return np.stack([self._row(int(i)) for i in ids]) \
-                if ids.size else np.zeros((0, self.dim), self.dtype)
+            if not ids.size:
+                return np.zeros((0, self.dim), self.dtype)
+            self._reserve_locked(ids)
+            # np.stack copies into a fresh buffer, so the caller never
+            # aliases live row storage
+            out = np.stack([self._row(int(i)) for i in ids])
+            self._trim_locked()
+            return out
 
     def push(self, ids, values):
         """Apply sparse grads row-wise (server-side optimize — the
@@ -77,6 +399,7 @@ class LargeScaleKV:
         merged = np.zeros((len(uniq), self.dim), self.dtype)
         np.add.at(merged, inv, values)
         with self._mu:
+            self._reserve_locked(uniq)
             for j, rid in enumerate(uniq):
                 rid = int(rid)
                 g = merged[j]
@@ -92,10 +415,97 @@ class LargeScaleKV:
                     raise InvalidArgumentError(
                         "sparse optimizer %r (have sgd, adagrad)"
                         % self.optimizer)
+            self._trim_locked()
 
     def size(self):
         with self._mu:
+            return len(self._rows) + (len(self._spill)
+                                      if self._spill else 0)
+
+    def resident_size(self):
+        with self._mu:
             return len(self._rows)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "resident_rows": len(self._rows),
+                "resident_budget_rows": self.resident_rows,
+                "resident_bytes": len(self._rows) * self._row_bytes,
+                "spilled_rows": len(self._spill)
+                if self._spill else 0,
+                "spill_writes": self._spill.spilled_rows
+                if self._spill else 0,
+                "spill_loads": self._spill.loaded_rows
+                if self._spill else 0,
+            }
+
+    # -- shard-snapshot integration (PServerRuntime) -----------------------
+    def export_state(self) -> Dict[str, np.ndarray]:
+        """Snapshot arrays for the RESIDENT tier: (ids, rows, adagrad
+        accum) plus the spill horizon. Spilled rows are already
+        durable in ``spill_dir``; the horizon lets restore discard
+        segments written after this boundary."""
+        with self._mu:
+            ids = np.fromiter(self._rows.keys(), np.int64,
+                              len(self._rows))
+            out = {"ids": ids,
+                   "rows": np.stack([self._rows[int(i)] for i in ids])
+                   if len(ids) else
+                   np.zeros((0, self.dim), self.dtype)}
+            a_ids = np.fromiter(self._accum.keys(), np.int64,
+                                len(self._accum))
+            out["accum_ids"] = a_ids
+            if len(a_ids):
+                out["accum"] = np.stack(
+                    [self._accum[int(i)] for i in a_ids])
+            out["spill_horizon"] = np.asarray(
+                self._spill.horizon() if self._spill else 0, np.int64)
+            return out
+
+    def gc_boundary(self):
+        """Called by the snapshot owner AFTER its durable save
+        SUCCEEDED: advances the spill GC epoch (dead segments older
+        than both retained snapshots are collected). Kept separate
+        from export_state so a FAILED save (disk full — the server
+        keeps serving) never advances the epoch past segments the
+        last good snapshot still needs for restore."""
+        with self._mu:
+            if self._spill is not None:
+                self._spill.on_boundary()
+
+    def import_state(self, arrays: Dict[str, np.ndarray]):
+        with self._mu:
+            if self._spill is not None:
+                self._spill.prune_after(int(np.asarray(
+                    arrays.get("spill_horizon", 0)).reshape(-1)[0]))
+                # restoring FROM a snapshot proves boundary
+                # discipline is active, but the GC epoch is
+                # process-local and restarted at 0 — re-arm deferral
+                # NOW or post-restart loads would eagerly unlink
+                # <=horizon segments the retained snapshots still
+                # need if we crash again before two new boundaries
+                self._spill._epoch = max(self._spill._epoch, 1)
+            self._rows.clear()
+            self._ref.clear()
+            self._accum.clear()
+            ids = np.asarray(arrays["ids"], np.int64)
+            rows = np.asarray(arrays["rows"], self.dtype)
+            for j, rid in enumerate(ids):
+                rid = int(rid)
+                self._rows[rid] = np.array(rows[j])
+                self._ref[rid] = False
+                if self._spill is not None:
+                    # the snapshot copy is at least as new as any
+                    # <=horizon segment copy: release the stale claim
+                    # (keeps segment live-counts honest so superseded
+                    # segments remain collectable)
+                    self._spill.discard(rid)
+            a_ids = np.asarray(arrays.get("accum_ids", ()), np.int64)
+            if len(a_ids):
+                accum = np.asarray(arrays["accum"], self.dtype)
+                for j, rid in enumerate(a_ids):
+                    self._accum[int(rid)] = np.array(accum[j])
 
 
 class LookupServiceClient:
@@ -106,10 +516,39 @@ class LookupServiceClient:
     ``deadline_s``/``retry`` plumb straight into each shard's RPCClient
     (prefetch is idempotent, so transparent retry is always safe; with
     a ``trainer_id`` every push carries a monotonic seq so a replayed
-    push is deduped server-side instead of double-applied)."""
+    push is deduped server-side instead of double-applied).
+
+    Tier 0 + wire options:
+
+    - ``cache_bytes > 0`` puts an EmbeddingRowCache in front of pull:
+      hits skip the RPC entirely; misses fill under the admission
+      policy. ``write_policy`` keeps cached rows valid across pushes:
+      ``"mirror_sgd"`` applies the server's exact SGD update image
+      locally (``mirror_lr`` must equal the table's lr — bit-equal to
+      the authority row when pulls are exact), ``"invalidate"`` drops
+      pushed rows, ``"none"`` leaves them (acceptable staleness for
+      async CTR training).
+    - ``push_q8``/``pull_q8`` ride the q8 row codec when
+      ``dim >= q8_min_dim`` (exact fallback below); pushes carry
+      per-touched-row error-feedback residuals (the ``.q8_ef_residual``
+      family pattern, trainer-side, keyed by row id) so compression
+      error telescopes instead of accumulating.
+    - after any RPC that had to reconnect, the pserver
+      ``__incarnation__`` nonce is re-read; a changed nonce means the
+      server restarted (cached rows may be stale) — the hot tier is
+      invalidated EXACTLY ONCE per observed change and the pull rereads
+      through the restored authority. Residual state is NOT touched:
+      error feedback survives restarts by design.
+    """
 
     def __init__(self, table_name: str, endpoints: List[str], dim: int,
-                 deadline_s=30.0, retry=None, trainer_id=None):
+                 deadline_s=30.0, retry=None, trainer_id=None,
+                 cache_bytes: int = 0, admit_after: int = 1,
+                 push_q8: bool = False, pull_q8: bool = False,
+                 q8_min_dim: int = SPARSE_Q8_MIN_DIM,
+                 write_policy: str = "mirror_sgd",
+                 mirror_lr: Optional[float] = None,
+                 max_residual_rows: Optional[int] = None):
         self.table = table_name
         self.dim = dim
         self.trainer_id = trainer_id
@@ -120,6 +559,37 @@ class LookupServiceClient:
         # stream or its watermark never compacts (see Communicator
         # .next_seq)
         self._seqs = [0] * len(self.clients)
+        enforce(write_policy in ("mirror_sgd", "invalidate", "none"),
+                "write_policy %r" % (write_policy,))
+        enforce(not (cache_bytes and write_policy == "mirror_sgd"
+                     and mirror_lr is None),
+                "write_policy='mirror_sgd' with a cache needs "
+                "mirror_lr (the server table's SGD lr — sgd tables "
+                "only; use write_policy='invalidate' for adagrad or "
+                "unknown server optimizers)")
+        self.q8 = bool(dim >= q8_min_dim)
+        self.push_q8 = bool(push_q8) and self.q8
+        self.pull_q8 = bool(pull_q8) and self.q8
+        self.write_policy = write_policy
+        self.mirror_lr = mirror_lr
+        self.cache = EmbeddingRowCache(dim, cache_bytes, admit_after) \
+            if cache_bytes else None
+        # per-touched-row EF residuals (trainer-side; survive pserver
+        # restarts — the compensation memory must not be lost).
+        # ``max_residual_rows`` bounds the map on beyond-HBM vocabs:
+        # on overflow the smallest-magnitude residuals are dropped —
+        # each costs at most one quantization step of future
+        # compensation, the same bounded-loss class as EF across a
+        # training restart. None (default) = unbounded.
+        self.residuals: Dict[int, np.ndarray] = {}
+        self.max_residual_rows = max_residual_rows
+        self.residuals_dropped = 0
+        self._incarnations: Dict[int, Optional[bytes]] = {}
+        self._reconnects_seen = 0
+        self.invalidation_count = 0
+        self.pulled_rows = 0
+        self.pushed_rows = 0
+        self.cache_hit_rows = 0
 
     def _next_seq(self, shard):
         if self.trainer_id is None:
@@ -130,30 +600,197 @@ class LookupServiceClient:
     def _shard(self, ids):
         return np.asarray(ids, np.int64) % len(self.clients)
 
-    def pull(self, ids) -> np.ndarray:
-        """Fetch rows for (possibly duplicated) ids; returns
-        [len(ids), dim] in input order."""
-        ids = np.asarray(ids, np.int64).reshape(-1)
+    # -- incarnation fencing ------------------------------------------------
+    def _reconnects(self) -> int:
+        return sum(c.reconnects for c in self.clients)
+
+    def _fence_incarnation(self, strict: bool = True) -> bool:
+        """Re-read every shard's nonce; invalidate the hot tier (once)
+        when any server restarted. ``strict`` treats a shard with no
+        recorded baseline as changed (used after a reconnect, where
+        "can't tell" must mean "assume restarted"); the non-strict
+        call merely records the baseline (first contact, cache still
+        empty). Returns True when an invalidation happened. Journal
+        emits run here — never under the cache lock."""
+        changed = []
+        for s, client in enumerate(self.clients):
+            try:
+                from .ps import INCARNATION_KEY
+                inc = client.call("GET", INCARNATION_KEY)
+            except Exception:
+                inc = None   # unreachable: be safe, treat as changed
+            prev = self._incarnations.get(s, ())
+            if (prev != () and prev != inc) or \
+                    (prev == () and strict) or inc is None:
+                changed.append(s)
+            self._incarnations[s] = inc
+        if not changed:
+            return False
+        self.invalidation_count += 1
+        dropped = self.cache.invalidate_all() if self.cache else 0
+        _obs.emit("sparse_cache_invalidated", table=self.table,
+                  shards=changed, rows_dropped=dropped,
+                  tid=self.trainer_id)
+        return True
+
+    def _maybe_fence(self, before: int) -> bool:
+        """Fence after an RPC round: steady state (no reconnect) costs
+        zero extra RPCs; the FIRST round records the incarnation
+        baseline; a reconnected round re-reads and invalidates on
+        change."""
+        if self._reconnects() == before:
+            if not self._incarnations:
+                self._fence_incarnation(strict=False)
+            return False
+        return self._fence_incarnation()
+
+    # -- pull path ----------------------------------------------------------
+    def _rpc_pull(self, ids: np.ndarray) -> np.ndarray:
+        """Fetch UNIQUE ids from their shards (q8 wire when armed)."""
         out = np.zeros((len(ids), self.dim), np.float32)
         shard = self._shard(ids)
         for s, client in enumerate(self.clients):
             mask = shard == s
             if not mask.any():
                 continue
-            rows = client.prefetch(self.table, ids[mask])
-            out[mask] = rows
+            if self.pull_q8:
+                q, scales = client.prefetch_q8(self.table, ids[mask])
+                out[mask] = dequantize_rows_q8(q, scales)
+            else:
+                out[mask] = client.prefetch(self.table, ids[mask])
         return out
 
+    def pull(self, ids) -> np.ndarray:
+        """Fetch rows for (possibly duplicated) ids; returns
+        [len(ids), dim] in input order. Cache hits skip the wire; an
+        incarnation change observed during the miss RPC re-reads
+        EVERYTHING through the restored authority, so no stale cached
+        row can reach the caller."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        self.pulled_rows += ids.size
+        if not ids.size:
+            return np.zeros((0, self.dim), np.float32)
+        uniq, inv, counts = np.unique(ids, return_inverse=True,
+                                      return_counts=True)
+        if self.cache is None:
+            before = self._reconnects()
+            rows = self._rpc_pull(uniq)
+            if self._maybe_fence(before):
+                rows = self._rpc_pull(uniq)
+            return rows[inv].astype(np.float32)
+        for attempt in (0, 1):
+            rows, hit = self.cache.get_many(uniq)
+            # hit accounting is per REQUESTED row (duplicates of a
+            # cached id are all served from the hot tier): the rate
+            # that prices avoided DCN traffic. Booked only when the
+            # attempt's rows are RETURNED — discarded attempt-0 hits
+            # of a fenced pull avoided nothing.
+            hits_now = int(counts[hit].sum())
+            miss = ~hit
+            if miss.any():
+                before = self._reconnects()
+                fetched = self._rpc_pull(uniq[miss])
+                fenced = self._maybe_fence(before)
+                if fenced and attempt == 0:
+                    # hot tier just dropped: the cached half of THIS
+                    # lookup may be stale — redo the whole pull
+                    # against the restored server (cache now cold)
+                    continue
+                rows[miss] = fetched
+                if not fenced:
+                    self.cache.put_many(uniq[miss], fetched)
+                # a SECOND fence mid-pull (server flapping): still
+                # return the freshly fetched rows — on this attempt
+                # every row came from a live authority read (the
+                # cache was cold), only the cache fill is skipped
+            self.cache_hit_rows += hits_now
+            return rows[inv].astype(np.float32)
+        # unreachable: attempt 1 always returns (only attempt 0 may
+        # ``continue`` on a fence)
+
+    # -- push path ----------------------------------------------------------
     def push(self, ids, grads):
+        """Sparse grad push. Duplicates merge FIRST (matching the
+        server's SelectedRows merge-add) so q8 error feedback sees one
+        residual update per touched row. The q8 payload (and residual
+        consumption) happens once per call — transport-level retries
+        resend the same bytes under the same seq and the server acks
+        without re-applying."""
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids),
                                                       self.dim)
-        shard = self._shard(ids)
-        for s, client in enumerate(self.clients):
-            mask = shard == s
-            if mask.any():
-                client.push_sparse(self.table, ids[mask], grads[mask],
-                                   seq=self._next_seq(s))
+        if not ids.size:
+            return
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        self.pushed_rows += uniq.size
+        if self.push_q8:
+            comp = merged.copy()
+            for j, rid in enumerate(uniq):
+                r = self.residuals.get(int(rid))
+                if r is not None:
+                    comp[j] += r
+            q, scales = quantize_rows_q8(comp)
+            applied = dequantize_rows_q8(q, scales)
+        else:
+            q = scales = None
+            applied = merged
+        before = self._reconnects()
+        shard = self._shard(uniq)
+        try:
+            for s, client in enumerate(self.clients):
+                mask = shard == s
+                if not mask.any():
+                    continue
+                seq = self._next_seq(s)
+                if self.push_q8:
+                    client.push_sparse_q8(self.table, uniq[mask],
+                                          q[mask], scales[mask],
+                                          seq=seq)
+                    # residuals COMMIT per shard, after its push was
+                    # accepted (or transparently retried to
+                    # acceptance): a shard that fails past the retry
+                    # budget keeps its rows' OLD residuals, so the
+                    # compensation memory of the never-applied
+                    # gradient is not lost — an application-level
+                    # re-push still carries it
+                    for j in np.nonzero(mask)[0]:
+                        self.residuals[int(uniq[j])] = \
+                            comp[j] - applied[j]
+                else:
+                    client.push_sparse(self.table, uniq[mask],
+                                       merged[mask], seq=seq)
+        except Exception:
+            # partial failure: earlier shards APPLIED server-side but
+            # the write-policy block below will not run — drop every
+            # touched row from the hot tier or mirror_sgd would keep
+            # serving the pre-push image as a hit forever
+            if self.cache is not None:
+                self.cache.invalidate_ids(uniq)
+            raise
+        if self.push_q8 and self.max_residual_rows is not None \
+                and len(self.residuals) > self.max_residual_rows:
+            # keep the 3/4 largest by magnitude (hot, most
+            # compensation value); overflow is amortized
+            keep = sorted(
+                self.residuals.items(),
+                key=lambda kv: -float(np.abs(kv[1]).max())
+            )[: self.max_residual_rows * 3 // 4]
+            self.residuals_dropped += \
+                len(self.residuals) - len(keep)
+            self.residuals = dict(keep)
+        self._maybe_fence(before)
+        if self.cache is not None:
+            if self.write_policy == "mirror_sgd" \
+                    and self.mirror_lr is not None:
+                # the server's exact update image: -lr * (what it
+                # dequantized), same f32 ops => cached row stays
+                # bit-equal to the authority row (given exact pulls)
+                self.cache.apply_delta(
+                    uniq, -np.float32(self.mirror_lr) * applied)
+            elif self.write_policy == "invalidate":
+                self.cache.invalidate_ids(uniq)
 
     def embed_batch(self, id_batch) -> np.ndarray:
         """Lookup for a [batch, slots] id matrix -> [batch, slots, dim]
@@ -163,6 +800,30 @@ class LookupServiceClient:
         id_batch = np.asarray(id_batch, np.int64)
         flat = self.pull(id_batch.reshape(-1))
         return flat.reshape(id_batch.shape + (self.dim,))
+
+    # -- introspection ------------------------------------------------------
+    def wire_bytes(self) -> dict:
+        sent = sum(c.bytes_sent for c in self.clients)
+        recv = sum(c.bytes_recv for c in self.clients)
+        return {"sent": sent, "recv": recv, "total": sent + recv}
+
+    def stats(self) -> dict:
+        out = {"pulled_rows": self.pulled_rows,
+               "pushed_rows": self.pushed_rows,
+               "cache_hit_rows": self.cache_hit_rows,
+               # requested-row basis (duplicates of a cached id count
+               # — each was served without touching the wire); the
+               # cache's own stats() carries the unique-id rate
+               "hit_rate": self.cache_hit_rows / self.pulled_rows
+               if self.pulled_rows else 0.0,
+               "invalidations": self.invalidation_count,
+               "residual_rows": len(self.residuals),
+               "residuals_dropped": self.residuals_dropped,
+               "push_q8": self.push_q8, "pull_q8": self.pull_q8,
+               "wire_bytes": self.wire_bytes()}
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
 
     def close(self):
         for c in self.clients:

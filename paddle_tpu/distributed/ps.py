@@ -96,6 +96,32 @@ class _SeqTracker:
 # pseudo-var a GET resolves to the server's incarnation nonce
 INCARNATION_KEY = "__incarnation__"
 
+# snapshot-array namespace for lookup-table state (PServerRuntime
+# folds each table's export_state() into the shard snapshot under
+# "__table__@@<table>@@<key>" so rows + dedup meta commit atomically)
+_TABLE_PREFIX = "__table__"
+_TABLE_SEP = "@@"
+
+
+def _pack_table_arrays(tables) -> Dict[str, np.ndarray]:
+    arrays = {}
+    for tname, table in (tables or {}).items():
+        for key, arr in table.export_state().items():
+            arrays[_TABLE_SEP.join((_TABLE_PREFIX, tname, key))] = arr
+    return arrays
+
+
+def _split_table_arrays(arrays):
+    """-> (scope_arrays, {table: {key: array}})."""
+    scope, tables = {}, {}
+    for name, arr in arrays.items():
+        if name.startswith(_TABLE_PREFIX + _TABLE_SEP):
+            _, tname, key = name.split(_TABLE_SEP, 2)
+            tables.setdefault(tname, {})[key] = arr
+        else:
+            scope[name] = arr
+    return scope, tables
+
 
 class ListenAndServ:
     """The pserver main loop (listen_and_serv_op.cc analog).
@@ -133,7 +159,7 @@ class ListenAndServ:
                  lookup_tables=None, lease_timeout_s=None,
                  allow_degraded=None, snapshot_fn=None,
                  snapshot_every=1, restore_meta=None, on_event=None,
-                 barrier_stall_s=120.0):
+                 barrier_stall_s=120.0, snapshot_tables=False):
         self.server = RPCServer(endpoint)
         self.endpoint = self.server.endpoint
         # any Mapping works — PServerRuntime passes a live scope view
@@ -193,13 +219,29 @@ class ListenAndServ:
         self._barrier_stall_s = barrier_stall_s
         self._health_watch = None
         self.lookup_tables = lookup_tables or {}
+        # when the runtime snapshots the lookup tables INSIDE the same
+        # durable boundary (PServerRuntime with lookup_tables +
+        # snapshot_dir), the push-seq tracker travels in the meta and
+        # is restored — a replayed quantized push then correctly
+        # acks-without-reapply against the restored table state
+        self._snapshot_tables = bool(snapshot_tables)
+        # sparse pushes tick the snapshot boundary only where a push
+        # IS the unit of progress — async servers and pure-sparse
+        # servers (no dense params => no sync step barrier to ride)
+        self._sparse_boundary = (not sync_mode) or not params
         if restore_meta:
             self._seen_send = _SeqTracker.from_meta(
                 restore_meta.get("send_seqs"))
-            # push seqs are deliberately NOT restored: lookup-table
-            # contents live outside the snapshotted scope, so a replayed
-            # push whose pre-crash effect was lost with the table MUST
-            # re-apply, not dedupe against a stale tracker
+            # push seqs are restored ONLY on a table-snapshotting
+            # server (whose tables came back in the same durable
+            # boundary as this meta — see above); any other server
+            # ignores even a present blob: a replayed push whose
+            # pre-crash effect was lost with the table MUST re-apply,
+            # not dedupe against a stale tracker
+            if self._snapshot_tables and \
+                    "push_seqs" in restore_meta:
+                self._seen_push = _SeqTracker.from_meta(
+                    restore_meta.get("push_seqs"))
             self._completed_tids = set(
                 int(t) for t in restore_meta.get("completed", []))
             self._evicted = set(
@@ -216,7 +258,9 @@ class ListenAndServ:
         s.register_deferred("BARRIER", self._on_barrier)
         s.register("COMPLETE", self._on_complete)
         s.register("PREFETCH", self._on_prefetch)
+        s.register("PREFETCH_Q8", self._on_prefetch_q8)
         s.register("PUSH_SPARSE", self._on_push_sparse)
+        s.register("PUSH_SPARSE_Q8", self._on_push_sparse_q8)
         s.register("HEARTBEAT", self._on_heartbeat)
 
     # -- events / chaos -----------------------------------------------------
@@ -442,6 +486,11 @@ class ListenAndServ:
             "evicted": sorted(self._evicted),
             "boundary": self._boundary,
         }
+        if self._snapshot_tables:
+            # table state lands in the same durable dir (snapshot_fn),
+            # so the dedup tracker and the rows it guards commit
+            # atomically — the precondition for restoring it
+            meta["push_seqs"] = self._seen_push.to_meta()
         t0 = time.monotonic()
         try:
             self._snapshot_fn(self._boundary, meta)
@@ -505,9 +554,31 @@ class ListenAndServ:
         table = self._table(name)
         return serialize_tensor(table.pull(ids))
 
-    def _on_push_sparse(self, name, payload):
-        self._drain_beacon.bump()
-        name, tid, seq = unpack_wire_name(name)
+    def _on_prefetch_q8(self, name, payload):
+        """Quantized rows lookup: pull fp32 authority rows, quantize
+        per row (one scale each) for the wire — the PULL leg of the
+        q8 sparse plane. Read-only: no dedup, no lease semantics
+        beyond the exact twin's."""
+        from ..parallel.collectives import quantize_rows_q8
+        name, _, _ = unpack_wire_name(name)
+        ids, _ = deserialize_tensor(payload)
+        q, scales = quantize_rows_q8(self._table(name).pull(ids))
+        return serialize_tensor(q) + serialize_tensor(scales)
+
+    def _push_sparse_common(self, name, tid, seq, apply_fn):
+        """Shared dedup + apply + boundary skeleton of the exact and
+        q8 push handlers. The apply runs OUTSIDE ``self._mu`` (table
+        rows have their own mutex; the spill tier does disk I/O), then
+        the sparse snapshot boundary ticks where pushes are the unit
+        of progress (async / pure-sparse servers).
+
+        Mark-seen-before-apply is safe: every handler (and every
+        snapshot site) runs on the ONE server drain thread, so no
+        snapshot can capture this seq before its apply lands — the
+        mark only reaches disk via the boundary snapshot taken AFTER
+        ``apply_fn`` in this same invocation, and a crash in between
+        loses the in-memory mark with the process (replay then
+        re-applies, correctly)."""
         try:
             with self._mu:
                 self._touch_lease_locked(tid)
@@ -519,10 +590,44 @@ class ListenAndServ:
                         return b""
         finally:
             self._flush_events()
-        ids, off = deserialize_tensor(payload)
-        values, _ = deserialize_tensor(payload, off)
-        self._table(name).push(ids, values)
+        apply_fn()
+        if self._sparse_boundary and self._snapshot_fn is not None:
+            with self._mu:
+                self._maybe_snapshot_locked()
+            self._flush_events()
         return b""
+
+    def _on_push_sparse(self, name, payload):
+        self._drain_beacon.bump()
+        self._chaos_tick("PUSH_SPARSE")
+        name, tid, seq = unpack_wire_name(name)
+
+        def apply():
+            ids, off = deserialize_tensor(payload)
+            values, _ = deserialize_tensor(payload, off)
+            self._table(name).push(ids, values)
+
+        return self._push_sparse_common(name, tid, seq, apply)
+
+    def _on_push_sparse_q8(self, name, payload):
+        """Quantized sparse push: dequantize the int8 rows + per-row
+        scales and apply through the SAME table optimize path (and the
+        same per-trainer seq stream) as the exact verb — a replayed
+        quantized push acks-without-reapply, and the trainer's
+        error-feedback residual (consumed when the payload was built)
+        is never double-consumed."""
+        from ..parallel.collectives import dequantize_rows_q8
+        self._drain_beacon.bump()
+        self._chaos_tick("PUSH_SPARSE_Q8")
+        name, tid, seq = unpack_wire_name(name)
+
+        def apply():
+            ids, off = deserialize_tensor(payload)
+            q, off = deserialize_tensor(payload, off)
+            scales, _ = deserialize_tensor(payload, off)
+            self._table(name).push(ids, dequantize_rows_q8(q, scales))
+
+        return self._push_sparse_common(name, tid, seq, apply)
 
     def _table(self, name):
         enforce(name in self.lookup_tables,
@@ -958,6 +1063,62 @@ class _ScopeView:
         return self.scope.find_var(name)
 
 
+class SparsePServer:
+    """A PURE-sparse pserver: ListenAndServ hosting only lookup
+    tables (Tier 1 of the sparse plane, docs/sparse.md) — no dense
+    params, no transpiler. Pushes are the unit of progress, so every
+    ``snapshot_every``-th applied push commits a durable boundary of
+    (table rows + adagrad state + spill horizon + push-seq trackers);
+    a restarted SparsePServer pointed at the same ``snapshot_dir``
+    restores all of it, so a replayed quantized push
+    acks-without-reapply against exactly the table state its first
+    copy mutated. ``bind_endpoint`` lets a restart reclaim the dead
+    incarnation's concrete port."""
+
+    def __init__(self, endpoint, tables, snapshot_dir=None,
+                 snapshot_every=1, n_trainers=1,
+                 lease_timeout_s=None, bind_endpoint=None,
+                 barrier_stall_s=None):
+        self.tables = dict(tables)
+        self._snap = None
+        restore_meta = None
+        if snapshot_dir is not None:
+            self._snap = ShardSnapshotter(snapshot_dir)
+            restored = self._snap.restore_latest()
+            if restored is not None:
+                arrays, restore_meta = restored
+                _, table_arrays = _split_table_arrays(arrays)
+                for tname, tarrs in table_arrays.items():
+                    if tname in self.tables:
+                        self.tables[tname].import_state(tarrs)
+        self.serv = ListenAndServ(
+            bind_endpoint or endpoint, {}, lambda _n, _g: None,
+            n_trainers=n_trainers, sync_mode=False,
+            lookup_tables=self.tables,
+            lease_timeout_s=lease_timeout_s,
+            snapshot_fn=self._snapshot
+            if self._snap is not None else None,
+            snapshot_every=snapshot_every,
+            restore_meta=restore_meta,
+            barrier_stall_s=barrier_stall_s,
+            snapshot_tables=self._snap is not None)
+        self.endpoint = self.serv.endpoint
+
+    def _snapshot(self, boundary, meta):
+        self._snap.save(boundary, _pack_table_arrays(self.tables),
+                        meta)
+        # durable save SUCCEEDED: only now may spill GC advance
+        for t in self.tables.values():
+            t.gc_boundary()
+
+    def start(self):
+        self.serv.start()
+        return self
+
+    def shutdown(self):
+        self.serv.shutdown()
+
+
 class PServerRuntime:
     """One pserver process: startup + per-param optimize programs +
     the ListenAndServ loop (the full Executor.run(pserver_program)
@@ -995,14 +1156,20 @@ class PServerRuntime:
         startup = transpiler.get_startup_program(endpoint)
         self.exe.run(startup, scope=self.scope)
         self._snap = None
+        self._tables = lookup_tables or {}
         restore_meta = None
         if snapshot_dir is not None:
             self._snap = ShardSnapshotter(snapshot_dir)
             restored = self._snap.restore_latest()
             if restored is not None:
                 arrays, restore_meta = restored
-                for name, arr in arrays.items():
+                scope_arrays, table_arrays = _split_table_arrays(
+                    arrays)
+                for name, arr in scope_arrays.items():
                     self.scope.set_var(name, arr)
+                for tname, tarrs in table_arrays.items():
+                    if tname in self._tables:
+                        self._tables[tname].import_state(tarrs)
         self.serv = ListenAndServ(
             bind_endpoint or endpoint, _ScopeView(self.scope, own),
             self._optimize, n_trainers=transpiler.trainer_num,
@@ -1014,7 +1181,9 @@ class PServerRuntime:
             if self._snap is not None else None,
             snapshot_every=snapshot_every,
             restore_meta=restore_meta,
-            barrier_stall_s=barrier_stall_s)
+            barrier_stall_s=barrier_stall_s,
+            snapshot_tables=bool(self._tables)
+            and self._snap is not None)
         # optional process-wide Prometheus /metrics export thread
         # (observability.export); one per pserver process
         self.metrics_server = None
@@ -1029,7 +1198,14 @@ class PServerRuntime:
             val = self.scope.find_var(v.name)
             if val is not None:
                 arrays[v.name] = np.asarray(val)
+        # lookup tables commit in the SAME durable boundary as the
+        # push-seq tracker riding in ``meta`` (docs/sparse.md §restart
+        # contract): resident rows + adagrad state + spill horizon
+        arrays.update(_pack_table_arrays(self._tables))
         self._snap.save(boundary, arrays, meta)
+        # durable save SUCCEEDED: only now may spill GC advance
+        for t in self._tables.values():
+            t.gc_boundary()
 
     def _optimize(self, bname, grad):
         if self.dc_asgd:

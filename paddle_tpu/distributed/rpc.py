@@ -43,6 +43,15 @@ VERBS = {
     # (stats / load_version / flip / drain_unload — versioned hot-swap)
     "INFER": 8,
     "CTRL": 9,
+    # quantized sparse wire (docs/sparse.md): PUSH_SPARSE_Q8 carries
+    # ids + int8 rows + one f32 scale per row (the EQuARX block
+    # pattern with rows as blocks, error-feedback residuals held
+    # trainer-side); PREFETCH_Q8 answers a rows lookup with the same
+    # quantized layout. Both dedupe/serve against the SAME table and
+    # (for pushes) the same per-trainer seq stream as their exact
+    # twins, so a client may mix precisions mid-run.
+    "PUSH_SPARSE_Q8": 10,
+    "PREFETCH_Q8": 11,
 }
 
 # response status byte (the wire field is u8 — keep codes < 256)
@@ -374,6 +383,10 @@ class RPCClient:
         self.trainer_id = trainer_id
         self.reconnects = 0
         self.retries_used = 0
+        # wire accounting (payload + response bodies, headers
+        # excluded): the sparse bench's measured bytes-on-wire
+        self.bytes_sent = 0
+        self.bytes_recv = 0
         self._connect_timeout_s = timeout_s
         self._retry_interval_s = retry_interval_s
         self._host, self._port = _parse_endpoint(endpoint)
@@ -476,6 +489,8 @@ class RPCClient:
                 "(rc=%d)" % (verb, name, self.endpoint, rc))
         body = ctypes.string_at(resp, rlen.value) if rlen.value else b""
         lib.trpc_free(resp)
+        self.bytes_sent += len(payload)
+        self.bytes_recv += rlen.value
         st = status.value
         if st == STATUS_ABORTED:
             raise BarrierAborted(body.decode() or "aborted by server")
@@ -511,6 +526,28 @@ class RPCClient:
         payload = (serialize_tensor(np.asarray(ids, np.int64)) +
                    serialize_tensor(np.asarray(values)))
         self.call("PUSH_SPARSE", table, payload, seq=seq)
+
+    def push_sparse_q8(self, table: str, ids: np.ndarray,
+                       q: np.ndarray, scales: np.ndarray,
+                       seq: Optional[int] = None):
+        """Quantized sparse push: int8 rows + one f32 scale per row
+        (collectives.quantize_rows_q8 layout). The payload is built
+        ONCE per logical push — a transport retry resends identical
+        bytes under the same ``seq``, so the server's dedup makes the
+        replay ack-without-reapply and the caller's error-feedback
+        residual is never double-consumed."""
+        payload = (serialize_tensor(np.asarray(ids, np.int64)) +
+                   serialize_tensor(np.asarray(q, np.int8)) +
+                   serialize_tensor(np.asarray(scales, np.float32)))
+        self.call("PUSH_SPARSE_Q8", table, payload, seq=seq)
+
+    def prefetch_q8(self, table: str, ids: np.ndarray):
+        """Quantized rows lookup -> (q int8 [n, dim], scale f32 [n])."""
+        payload = serialize_tensor(np.asarray(ids, np.int64))
+        body = self.call("PREFETCH_Q8", table, payload)
+        q, off = deserialize_tensor(body)
+        scales, _ = deserialize_tensor(body, off)
+        return q, scales
 
     def barrier(self, name: str = "step", deadline_s=_UNSET):
         self.call("BARRIER", name, deadline_s=deadline_s)

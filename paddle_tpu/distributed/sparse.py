@@ -9,40 +9,92 @@ and the Downpour per-batch pull_sparse/push_sparse flow
 The program-side contract is established by
 ``layers.embedding(..., is_distributed=True)``: the lookup result is a
 data var and ``program._distributed_lookups`` records
-{table, ids, out, rows, dim}. This runtime closes the loop per step:
+{table, ids, out, rows, dim, padding_idx}. This runtime closes the
+loop per step:
 
     feed = srt.wrap_feed(feed)        # pull rows for the batch's ids
     ... run the step, fetching srt.grad_fetch_names() ...
     srt.push_grads(feed, grad_values) # sparse push (server-side opt)
+
+``SparseTierConfig`` arms the tiered/quantized plane (docs/sparse.md):
+a hot row cache in front of the pull (Tier 0), q8 push/pull wire
+compression with trainer-side error-feedback residuals, and the
+exactly-once hot-tier invalidation on pserver restart — all inside
+LookupServiceClient, so the training loop above is unchanged.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
 from ..framework import grad_var_name
+from ..parallel.collectives import SPARSE_Q8_MIN_DIM
 from .lookup_service import LookupServiceClient
 
 
+@dataclass
+class SparseTierConfig:
+    """Per-run knobs of the tiered sparse plane; one instance covers
+    every distributed table of the program (per-table overrides via
+    ``table_overrides[table] = SparseTierConfig(...)``).
+
+    cache_bytes=0 disables Tier 0; push_q8/pull_q8 fall back to exact
+    below ``q8_min_dim``; ``write_policy``/``mirror_lr`` keep cached
+    rows valid across pushes (mirror_lr must equal the server table's
+    lr for the ``mirror_sgd`` policy — see LookupServiceClient)."""
+
+    cache_bytes: int = 0
+    admit_after: int = 1
+    push_q8: bool = False
+    pull_q8: bool = False
+    q8_min_dim: int = SPARSE_Q8_MIN_DIM
+    write_policy: str = "mirror_sgd"
+    mirror_lr: Optional[float] = None
+    max_residual_rows: Optional[int] = None
+    deadline_s: float = 30.0
+    retry: Optional[object] = None
+    trainer_id: Optional[int] = None
+    table_overrides: Dict[str, "SparseTierConfig"] = field(
+        default_factory=dict)
+
+    def client_kwargs(self, table: str) -> dict:
+        cfg = self.table_overrides.get(table, self)
+        return dict(cache_bytes=cfg.cache_bytes,
+                    admit_after=cfg.admit_after,
+                    push_q8=cfg.push_q8, pull_q8=cfg.pull_q8,
+                    q8_min_dim=cfg.q8_min_dim,
+                    write_policy=cfg.write_policy,
+                    mirror_lr=cfg.mirror_lr,
+                    max_residual_rows=cfg.max_residual_rows,
+                    deadline_s=cfg.deadline_s, retry=cfg.retry,
+                    trainer_id=cfg.trainer_id)
+
+
 class SparseEmbeddingRuntime:
-    def __init__(self, program, endpoints: List[str]):
+    def __init__(self, program, endpoints: List[str],
+                 tier: Optional[SparseTierConfig] = None):
         self.lookups = list(getattr(program, "_distributed_lookups",
                                     []))
         enforce(self.lookups,
                 "program has no distributed lookups (build the net "
                 "with layers.embedding(..., is_distributed=True))")
+        self.tier = tier or SparseTierConfig()
         self.clients: Dict[str, LookupServiceClient] = {}
         for lk in self.lookups:
             if lk["table"] not in self.clients:
                 self.clients[lk["table"]] = LookupServiceClient(
-                    lk["table"], endpoints, lk["dim"])
+                    lk["table"], endpoints, lk["dim"],
+                    **self.tier.client_kwargs(lk["table"]))
 
     def wrap_feed(self, feed: Dict[str, np.ndarray]):
         """Prefetch: resolve every distributed lookup against the
-        host-side table shards and add the result to the feed."""
+        tiered table shards (hot-cache hits never touch the wire) and
+        add the result to the feed. ``padding_idx`` rows read as
+        zeros, matching the lookup_table op."""
         feed = dict(feed)
         for lk in self.lookups:
             if lk["ids"] not in feed:
@@ -50,8 +102,12 @@ class SparseEmbeddingRuntime:
                     "feed is missing %r (the ids of distributed table "
                     "%r)" % (lk["ids"], lk["table"]))
             ids = np.asarray(feed[lk["ids"]], np.int64)
-            feed[lk["out"]] = self.clients[lk["table"]].embed_batch(
+            emb = self.clients[lk["table"]].embed_batch(
                 ids).astype(np.float32)
+            pad = lk.get("padding_idx")
+            if pad is not None and pad >= 0:
+                emb[ids == pad] = 0.0
+            feed[lk["out"]] = emb
         return feed
 
     def grad_fetch_names(self) -> List[str]:
@@ -60,11 +116,21 @@ class SparseEmbeddingRuntime:
     def push_grads(self, feed, grad_values):
         """Sparse push: ids from the feed + the fetched out-grads form
         (rows, values) updates applied by the owning pserver (its table
-        optimizer — the server-side optimize block)."""
+        optimizer — the server-side optimize block). ``padding_idx``
+        rows get no grad, matching the lookup_table backward."""
         for lk, g in zip(self.lookups, grad_values):
             ids = np.asarray(feed[lk["ids"]], np.int64).reshape(-1)
             g = np.asarray(g, np.float32).reshape(len(ids), lk["dim"])
+            pad = lk.get("padding_idx")
+            if pad is not None and pad >= 0:
+                keep = ids != pad
+                ids, g = ids[keep], g[keep]
             self.clients[lk["table"]].push(ids, g)
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-table tier/wire stats (cache hit rate, wire bytes,
+        residual rows) — the bench row's raw material."""
+        return {t: c.stats() for t, c in self.clients.items()}
 
     def close(self):
         for c in self.clients.values():

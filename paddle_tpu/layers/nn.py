@@ -137,7 +137,15 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper = LayerHelper("embedding", name=name)
     if is_distributed:
         from .. import unique_name
-        table = name or unique_name.generate("dist_table")
+        # a ParamAttr name pins the table id across processes (server
+        # and trainer must agree on it — same contract as dense param
+        # names under unique_name.guard); _to_attr so the plain-str
+        # spelling every other layer accepts works here too
+        from ..param_attr import ParamAttr
+        attr = ParamAttr._to_attr(param_attr) \
+            if param_attr is not None else None
+        attr_name = attr.name if isinstance(attr, ParamAttr) else None
+        table = attr_name or name or unique_name.generate("dist_table")
         out_shape = tuple(input.shape) + (size[1],)
         out = helper.main_program.global_block().create_var(
             name=unique_name.generate(table + "_prefetch"),
@@ -145,9 +153,11 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         meta = getattr(helper.main_program, "_distributed_lookups", None)
         if meta is None:
             meta = helper.main_program._distributed_lookups = []
+        pad = None if padding_idx is None else \
+            (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
         meta.append({"table": table, "ids": input.name,
                      "out": out.name, "rows": size[0],
-                     "dim": size[1]})
+                     "dim": size[1], "padding_idx": pad})
         return out
     w = helper.create_parameter(attr=param_attr, shape=tuple(size),
                                 dtype=dtype)

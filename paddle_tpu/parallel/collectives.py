@@ -196,6 +196,49 @@ def dequantize_q8(q, scale):
     return q.astype(jnp.float32) * scale[:, None]
 
 
+# ---------------------------------------------------------------------------
+# host-side row codec (the sparse wire format)
+# ---------------------------------------------------------------------------
+
+# Embedding rows below this width ship exact fp32: at dim < 16 the
+# 4-byte scale overhead erodes the int8 win (dim 8: 12/32 = 0.375x vs
+# the 0.35x wire-bytes bar) and tiny rows are latency- not
+# bandwidth-bound anyway.
+SPARSE_Q8_MIN_DIM = 16
+
+
+def quantize_rows_q8(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """NumPy twin of ``quantize_q8`` for the HOST sparse path
+    (PUSH_SPARSE/PREFETCH payloads move through the RPC plane, never
+    XLA): each embedding row is one quantization block — ``rows``
+    [n, dim] f32 -> (q int8 [n, dim], scale f32 [n]). Same format and
+    semantics as ``quantize_q8`` with ``block_size = dim`` (scale =
+    rowmax/127, 1.0 for all-zero rows, |dequant - x| <= scale/2), so
+    device- and wire-quantization error models match."""
+    rows = np.ascontiguousarray(rows, np.float32)
+    amax = np.max(np.abs(rows), axis=1)
+    scale = np.where(amax > 0, amax / _QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(rows / scale[:, None]), -_QMAX, _QMAX)
+    return q.astype(np.int8), scale
+
+
+def dequantize_rows_q8(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    return q.astype(np.float32) * np.asarray(
+        scale, np.float32)[:, None]
+
+
+def sparse_wire_bytes(n_rows: int, dim: int, q8: bool,
+                      ids_bytes: bool = True) -> int:
+    """Payload bytes a sparse push/pull of ``n_rows`` moves: int64 ids
+    (optional) + either f32 rows or int8 rows with one f32 scale each.
+    Serialization headers excluded — this prices the algorithm, the
+    bench rows report measured socket bytes."""
+    ids = 8 * n_rows if ids_bytes else 0
+    if q8:
+        return ids + n_rows * (dim + 4)
+    return ids + n_rows * dim * 4
+
+
 def _pad_flat(x, padded_len: int):
     flat = x.reshape(-1)
     return jnp.pad(flat, (0, padded_len - flat.shape[0]))

@@ -82,6 +82,12 @@ def pytest_configure(config):
         "markers",
         "mp: model-parallelism (dp × sp/tp/ep mesh) test (tier-1; "
         "select alone with -m mp)")
+    # tiered-sparse suite (embedding cache / spill tier / q8 sparse
+    # wire, docs/sparse.md): host-side numpy + loopback RPC, CPU-fast
+    config.addinivalue_line(
+        "markers",
+        "sparse: tiered sparse embedding plane test (tier-1; select "
+        "alone with -m sparse)")
 
 
 @pytest.fixture(autouse=True)

@@ -79,9 +79,23 @@ def _lr():
     return 0.01 if os.environ.get("DIST_MODEL") == "mnist" else 0.1
 
 
-def run_local(n_steps):
+def _maybe_gloo():
+    """Arm gloo CPU collectives ONLY for a process that will actually
+    call jax.distributed.initialize (fleet mode at trainers > 1):
+    this jaxlib's make_gloo_tcp_collectives requires a live
+    DistributedRuntimeClient, so setting gloo in a single process now
+    crashes CPU backend creation with "distributed_client: NoneType"
+    instead of being silently ignored (env drift: older jaxlibs
+    accepted None). The local reference run never initializes
+    jax.distributed and must never set gloo — single-device numerics
+    are identical either way."""
     import jax
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+        jax.config.update("jax_cpu_collectives_implementation",
+                          "gloo")
+
+
+def run_local(n_steps):
     import paddle_tpu as fluid
 
     main, startup, loss = build_model()
@@ -106,8 +120,7 @@ def run_local(n_steps):
 
 
 def run_fleet(n_steps):
-    import jax
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    _maybe_gloo()
     import paddle_tpu as fluid
     from paddle_tpu.incubate.fleet.base import role_maker
     from paddle_tpu.incubate.fleet.collective import fleet

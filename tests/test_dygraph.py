@@ -34,7 +34,12 @@ def test_linear_regression_trains():
         model = dnn.Linear(4, 1)
         opt = fluid.optimizer.SGD(learning_rate=0.1)
         losses = []
-        for _ in range(200):
+        # 300 steps, not 200: convergence of the weakest direction is
+        # the algorithm's pace, not a bug — this env's XLA leaves the
+        # max weight error at 0.246 after 200 steps (atol is 0.2) and
+        # 0.114 after 300, still shrinking ~2x/100 steps (same
+        # env-drift class as the PR 13 adadelta horizon fix)
+        for _ in range(300):
             x = dygraph.to_variable(x_np)
             y = dygraph.to_variable(y_np)
             pred = model(x)
@@ -291,7 +296,14 @@ class TestNewDygraphLayers:
             xb = dg.to_variable(rng.rand(2, 3).astype(np.float32))
             yb = dg.to_variable(rng.rand(2, 4).astype(np.float32))
             assert bt(xb, yb).numpy().shape == (2, 5)
-            sn = dnn.SpectralNorm("sn", weight_shape=(4, 6))
+            # power_iters=5: the layer default (1) estimates sigma
+            # from the RANDOM u/v init, so the result's norm depends
+            # on the RNG draw (this env's draw leaves it at 2.12 —
+            # env drift flipped a lucky draw unlucky); five iterations
+            # converge the estimate and the assertion is deterministic
+            # (measured: norm == 1.0000 at power_iters >= 5)
+            sn = dnn.SpectralNorm("sn", weight_shape=(4, 6),
+                                  power_iters=5)
             w = dg.to_variable(rng.rand(4, 6).astype(np.float32))
             wn = sn(w).numpy()
             # spectral norm of the result ~ 1

@@ -692,6 +692,43 @@ class TestBenchDiff:
         assert ("p99_latency", "REGRESSION") in flags
         assert ("dead_row", "RECOVERED") in flags
 
+    def test_sparse_throughput_metrics_direction(self, tmp_path):
+        """ISSUE 14 satellite: the sparse rows (rows/s throughput and
+        cache hit rate) are registered HIGHER-is-better, both
+        directions — a drop flags REGRESSION, a rise does not (the
+        raw unit strings would otherwise trip the lower-is-better
+        'rate/fraction' heuristics)."""
+        import bench_diff
+
+        def write(path, n, rps, hit):
+            rows = [{"metric": "sparse_embedding_throughput",
+                     "value": rps,
+                     "unit": "rows/s (zipf0.9, cache+q8)"},
+                    {"metric": "sparse_embedding_throughput_mix",
+                     "library": "zipf0.9/cache/q8", "value": hit,
+                     "unit": "cache hit rate fraction"}]
+            path.write_text(json.dumps(
+                {"n": n, "tail": "\n".join(json.dumps(r)
+                                           for r in rows)}))
+
+        r1 = tmp_path / "BENCH_r01.json"
+        r2 = tmp_path / "BENCH_r02.json"
+        # direction 1: a DROP in rows/s and hit rate is a regression
+        write(r1, 1, 50000.0, 0.85)
+        write(r2, 2, 20000.0, 0.40)
+        report = bench_diff.diff(
+            bench_diff.load_rounds([str(r1), str(r2)]))
+        flags = {(f["metric"], f["flag"]) for f in report["flags"]}
+        assert ("sparse_embedding_throughput", "REGRESSION") in flags
+        assert ("sparse_embedding_throughput_mix[zipf0.9/cache/q8]",
+                "REGRESSION") in flags
+        # direction 2: a RISE reads as an improvement, no flag
+        write(r1, 1, 20000.0, 0.40)
+        write(r2, 2, 50000.0, 0.85)
+        report = bench_diff.diff(
+            bench_diff.load_rounds([str(r1), str(r2)]))
+        assert not report["regressions"], report["flags"]
+
 
 # ---------------------------------------------------------------------------
 # singleton-lock reentrancy (PR 11 hardening)

@@ -41,6 +41,26 @@ class TestRepoPasses:
         assert len(report["locks"]) >= 10
         assert report["functions_scanned"] >= 200
 
+    def test_sparse_tier_modules_in_gated_set(self):
+        """The lock-heavy sparse hot tier (ISSUE 14) is INSIDE the
+        default gated target set: the scan must actually discover the
+        cache/table mutexes and their functions — a rename that moved
+        them out of the scanned packages would silently drop the
+        emits-under-cache-mutex protection this lint provides."""
+        locks, funcs = lock_lint.scan(lock_lint.DEFAULT_PATHS)
+        assert "paddle_tpu.distributed.embedding_cache." \
+            "EmbeddingRowCache._mu" in locks
+        assert "paddle_tpu.distributed.lookup_service." \
+            "LargeScaleKV._mu" in locks
+        scanned = {k for k in funcs
+                   if k.startswith("paddle_tpu.distributed."
+                                   "embedding_cache.")
+                   or k.startswith("paddle_tpu.distributed."
+                                   "lookup_service.")}
+        assert len(scanned) >= 20, sorted(scanned)
+        report = lock_lint.analyze(locks, funcs)
+        assert report["violations"] == [], report["violations"]
+
     def test_cli_gate_exits_zero(self):
         r = subprocess.run(
             [sys.executable, os.path.join(TOOLS, "lock_lint.py"),

@@ -138,7 +138,11 @@ def test_ring_flash_applicable_at_long_seq():
     assert not R.applicable(2, 8, 16, 16, 16, 4)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+# tier-1 wall-time headroom (ISSUE 14): the causal=False twin adds
+# ~18 s for the same flash body (only the mask leg differs) — the
+# slow tier keeps it
+@pytest.mark.parametrize("causal", [
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_ring_flash_matches_full_attention_s1024(rng, causal):
     """8 real ring hops at S=1024: the flash body (scores in VMEM)
     must reproduce full attention — the VERDICT r4 long-context
@@ -158,9 +162,15 @@ def test_ring_flash_matches_full_attention_s1024(rng, causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_flash_gradients_match_s1024(rng):
     """Values AND grads through the ring backward (dk/dv accumulators
-    riding the ring) against full attention autodiff."""
+    riding the ring) against full attention autodiff.
+
+    Slow tier (ISSUE 14 wall-time headroom): ~21 s of pallas
+    interpret mode; tier-1 keeps the s1024 flash FORWARD parity test
+    and the dp2xsp2 trained-through-sp equality in
+    test_model_parallel.py as the everyday coverage."""
     q, k, v = _long_qkv(rng)
     mesh = _sp_mesh(8)
 
@@ -207,7 +217,11 @@ def test_zigzag_matches_full_attention(rng):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_gradients_match(rng):
+    # slow tier (ISSUE 14): ~42 s of interpret-mode backward whose
+    # everyday coverage is test_model_parallel's dp2xsp2 loss-equality
+    # training THROUGH the zigzag route (30 steps, rtol 1e-5)
     from paddle_tpu.parallel.zigzag import zigzag_attention
     q, k, v = _long_qkv(rng, S=256)
     mesh = _sp_mesh(4)
@@ -234,10 +248,15 @@ def test_zigzag_rejects_bad_split(rng):
         zigzag_attention(q, k, v, mesh=_sp_mesh(8), scale=0.5)
 
 
+@pytest.mark.slow
 def test_zigzag_flash_matches_full_attention(rng):
     """Flash chunk-pair kernels inside the zigzag schedule: S=2048
     (chunk=128 — the kernel tile minimum) across 8 devices, values
-    AND grads vs full causal attention."""
+    AND grads vs full causal attention.
+
+    Slow tier (ISSUE 14 wall-time headroom): at 66 s this was tier-1's
+    single heaviest test; the non-flash zigzag parity test above and
+    the flash RING parity test keep both kernel families covered."""
     from paddle_tpu.parallel.zigzag import zigzag_attention
     q, k, v = _long_qkv(rng, S=2048, B=1, H=2)
     mesh = _sp_mesh(8)

@@ -1,0 +1,904 @@
+"""Tiered sparse embedding plane (ISSUE 14, docs/sparse.md): hot-tier
+row cache, durable spill tier, q8 sparse wire with error feedback, and
+the exactly-once restart semantics across the three tiers.
+
+Reference discipline: the loss-equality posture of test_dist_base.py
+— every approximation (q8 wire, cache mirror) is held against its
+exact twin, bit-equal where the design claims bit-equal (spill
+round-trip, mirror_sgd write-through, snapshot restore) and
+rtol-bounded where it claims bounded (EF telescope, pull
+quantization)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.distributed import (EmbeddingRowCache, LargeScaleKV,
+                                    LookupServiceClient, RowSpillStore,
+                                    SparseEmbeddingRuntime,
+                                    SparsePServer, SparseTierConfig)
+from paddle_tpu.parallel.collectives import (SPARSE_Q8_MIN_DIM,
+                                             dequantize_rows_q8,
+                                             quantize_rows_q8,
+                                             sparse_wire_bytes)
+
+pytestmark = pytest.mark.sparse
+
+
+# ---------------------------------------------------------------------------
+# q8 row codec (the shared wire format)
+# ---------------------------------------------------------------------------
+
+class TestRowCodec:
+    def test_roundtrip_error_bound(self, rng):
+        rows = (rng.randn(64, 32) * rng.lognormal(size=(64, 1))) \
+            .astype(np.float32)
+        q, scale = quantize_rows_q8(rows)
+        assert q.dtype == np.int8 and scale.shape == (64,)
+        err = np.abs(dequantize_rows_q8(q, scale) - rows)
+        # per-element bound: half a quantization step of the row scale
+        assert (err <= scale[:, None] / 2 + 1e-7).all()
+
+    def test_all_zero_rows_dequantize_to_zero(self):
+        q, scale = quantize_rows_q8(np.zeros((3, 16), np.float32))
+        assert (scale == 1.0).all()
+        assert (dequantize_rows_q8(q, scale) == 0.0).all()
+
+    def test_matches_device_codec_geometry(self, rng):
+        """Host rows and the device block codec agree when the block
+        IS the row (block_size=dim) — one error model for wire and
+        collective quantization."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.parallel.collectives import (dequantize_q8,
+                                                     quantize_q8)
+        rows = rng.randn(8, 32).astype(np.float32)
+        qh, sh = quantize_rows_q8(rows)
+        qd, sd = quantize_q8(jnp.asarray(rows))
+        np.testing.assert_array_equal(qh, np.asarray(qd))
+        np.testing.assert_allclose(sh, np.asarray(sd), rtol=1e-6)
+        np.testing.assert_allclose(
+            dequantize_rows_q8(qh, sh),
+            np.asarray(dequantize_q8(qd, sd)), rtol=1e-6)
+
+    def test_wire_bytes_pricing(self):
+        # dim 32: q8 moves 8+36=44 per row vs 8+128=136 fp32 -> 0.32x
+        assert sparse_wire_bytes(10, 32, q8=True) == 10 * (8 + 36)
+        assert sparse_wire_bytes(10, 32, q8=False) == 10 * (8 + 128)
+        ratio = sparse_wire_bytes(1000, 32, True) \
+            / sparse_wire_bytes(1000, 32, False)
+        assert ratio <= 0.35
+
+
+# ---------------------------------------------------------------------------
+# Tier 0: hot row cache
+# ---------------------------------------------------------------------------
+
+class TestEmbeddingRowCache:
+    def test_admission_by_touch_frequency(self):
+        c = EmbeddingRowCache(dim=4, capacity_bytes=16 * 100,
+                              admit_after=2)
+        rows = np.ones((2, 4), np.float32)
+        ids = np.array([1, 2])
+        c.get_many(ids)              # 1st miss
+        c.put_many(ids, rows)        # not admissible yet
+        assert len(c) == 0
+        c.get_many(ids)              # 2nd miss -> admissible
+        c.put_many(ids, rows)
+        assert len(c) == 2
+        _, hit = c.get_many(ids)
+        assert hit.all()
+
+    def test_clock_eviction_respects_budget_and_second_chance(self):
+        c = EmbeddingRowCache(dim=4, capacity_bytes=16 * 4)  # 4 rows
+        ids = np.arange(4)
+        c.get_many(ids)
+        c.put_many(ids, np.ones((4, 4), np.float32))
+        assert len(c) == 4
+        # touch rows 0 and 1 (ref bits set), then insert two more:
+        # the UNtouched 2,3 must be the victims
+        c.get_many(np.array([0, 1]))
+        newer = np.arange(4, 6)
+        c.get_many(newer)
+        c.put_many(newer, np.full((2, 4), 2.0, np.float32))
+        assert len(c) == 4
+        _, hit = c.get_many(np.arange(6))
+        assert list(hit) == [True, True, False, False, True, True]
+        assert c.stats()["evictions"] == 2
+        assert c.resident_bytes() == 4 * 16
+
+    def test_write_through_and_invalidation(self):
+        c = EmbeddingRowCache(dim=2, capacity_bytes=8 * 10)
+        ids = np.array([7, 9])
+        c.get_many(ids)
+        c.put_many(ids, np.zeros((2, 2), np.float32))
+        c.apply_delta(np.array([7, 9, 11]),   # 11 absent: ignored
+                      np.full((3, 2), 0.5, np.float32))
+        out, hit = c.get_many(ids)
+        assert hit.all()
+        np.testing.assert_array_equal(out, np.full((2, 2), 0.5))
+        assert c.invalidate_ids([7]) == 1
+        _, hit = c.get_many(ids)
+        assert list(hit) == [False, True]
+        assert c.invalidate_all() == 1
+        assert len(c) == 0
+
+    def test_admission_protects_hot_set_from_one_touch_flood(self, rng):
+        """The TinyLFU argument, under a long stream: a hot working
+        set + a one-touch cold flood. With admit_after=2 the flood
+        never displaces hot rows; with admit_after=1 it churns
+        them."""
+
+        def run(admit_after):
+            c = EmbeddingRowCache(dim=4, capacity_bytes=16 * 64,
+                                  admit_after=admit_after)
+            hot = np.arange(50)
+            hot_rows = np.ones((50, 4), np.float32)
+            for step in range(60):
+                _, h = c.get_many(hot)
+                c.put_many(hot, hot_rows)
+                flood = 10_000 + np.arange(step * 64, step * 64 + 64)
+                c.get_many(flood)
+                c.put_many(flood, np.zeros((64, 4), np.float32))
+            _, hit = c.get_many(hot)
+            return hit.mean()
+
+        assert run(2) == 1.0          # hot set fully resident
+        assert run(2) > run(1)        # and strictly better than no
+        #                               admission under the same flood
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: durable spill
+# ---------------------------------------------------------------------------
+
+class TestSpillTier:
+    def test_budget_bounds_resident_and_rows_bit_equal(self, rng):
+        """The acceptance shape: a logical table larger than the
+        resident budget trains on, resident rows stay bounded, and
+        every row (spilled or not) reads back BIT-equal to an
+        unbounded twin fed the identical stream."""
+        tmp = tempfile.mkdtemp()
+        budget_rows = 32
+        kv = LargeScaleKV(dim=8, optimizer="sgd", lr=0.1, seed=3,
+                          resident_bytes=budget_rows * 32,
+                          spill_dir=tmp)
+        twin = LargeScaleKV(dim=8, optimizer="sgd", lr=0.1, seed=3)
+        for _ in range(30):
+            ids = rng.randint(0, 2000, 64)
+            g = rng.randn(64, 8).astype(np.float32)
+            kv.push(ids, g)
+            twin.push(ids, g)
+            assert kv.resident_size() <= kv.resident_rows
+        assert kv.stats()["spilled_rows"] > 0
+        probe = rng.randint(0, 2000, 300)
+        np.testing.assert_array_equal(kv.pull(probe), twin.pull(probe))
+
+    def test_adagrad_state_spills_with_the_row(self, rng):
+        tmp = tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=4, optimizer="adagrad", lr=0.5, seed=1,
+                          resident_bytes=8 * 8 * 2, spill_dir=tmp)
+        twin = LargeScaleKV(dim=4, optimizer="adagrad", lr=0.5,
+                            seed=1)
+        for _ in range(20):
+            ids = rng.randint(0, 200, 16)
+            g = rng.randn(16, 4).astype(np.float32)
+            kv.push(ids, g)
+            twin.push(ids, g)
+        probe = np.arange(200)
+        np.testing.assert_array_equal(kv.pull(probe), twin.pull(probe))
+
+    def test_batched_eviction_one_segment_per_op(self, rng):
+        """A cold batch at budget spills via ONE reserve segment (+
+        at most one trim segment), not one fsynced file per evicted
+        row."""
+        tmp = tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=8, optimizer="sgd", lr=0.1, seed=3,
+                          resident_bytes=32 * 32, spill_dir=tmp)
+        kv.push(np.arange(32), rng.randn(32, 8).astype(np.float32))
+        segs_before = len(os.listdir(tmp))
+        # 32 brand-new ids displace the 32 resident ones
+        kv.push(np.arange(100, 132),
+                rng.randn(32, 8).astype(np.float32))
+        assert len(os.listdir(tmp)) - segs_before <= 2
+        assert kv.resident_size() <= kv.resident_rows
+
+    def test_save_lookup_table_includes_spilled_rows(self, rng):
+        """contrib checkpoint x Tier 2: a budgeted table's checkpoint
+        must carry the SPILLED trained rows too, and restore
+        bit-equal into an unbudgeted table."""
+        from paddle_tpu.contrib.utils.lookup_table_utils import (
+            _load_table_file, save_lookup_table)
+        tmp, ckpt = tempfile.mkdtemp(), tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=4, optimizer="adagrad", lr=0.3, seed=7,
+                          resident_bytes=8 * 16, spill_dir=tmp)
+        for _ in range(10):
+            kv.push(rng.randint(0, 300, 32),
+                    rng.randn(32, 4).astype(np.float32))
+        assert kv.stats()["spilled_rows"] > 0
+        save_lookup_table(kv, ckpt)
+        blob = _load_table_file(ckpt)
+        assert len(blob["ids"]) == kv.size()   # resident + spilled
+        by_id = {int(i): blob["rows"][j]
+                 for j, i in enumerate(blob["ids"])}
+        probe = np.asarray(sorted(by_id), np.int64)
+        np.testing.assert_array_equal(
+            np.stack([by_id[int(i)] for i in probe]),
+            kv.pull(probe))
+
+    def test_convert_dist_program_carries_padding_idx(self):
+        from paddle_tpu.contrib.utils.lookup_table_utils import (
+            convert_dist_to_sparse_program)
+        main, _startup, _loss = _ctr_model(50, 16, padding_idx=0)
+        out = convert_dist_to_sparse_program(main)
+        op = out.global_block().ops[0]
+        assert op.type == "lookup_table"
+        assert op.attr("padding_idx") == 0
+
+    def test_residual_cap_bounds_map_and_keeps_hot(self, rng):
+        servers, _ = _sparse_server()
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=32, trainer_id=0,
+                                     push_q8=True,
+                                     max_residual_rows=64)
+            for step in range(8):
+                ids = np.arange(step * 40, step * 40 + 40)
+                cl.push(ids, rng.randn(40, 32).astype(np.float32))
+            assert len(cl.residuals) <= 64
+            assert cl.stats()["residuals_dropped"] > 0
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_duplicated_pull_ids_reserve_one_slot(self, rng):
+        """pull() accepts duplicated ids; the budget reservation must
+        count UNIQUE new ids, not copies — over-counting evicted warm
+        rows into needless fsynced segments."""
+        tmp = tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=8, seed=1, resident_bytes=32 * 100,
+                          spill_dir=tmp)
+        kv.pull(np.arange(50))
+        kv.pull(np.full(90, 1000, np.int64))   # ONE new id, 90 copies
+        st = kv.stats()
+        assert st["spill_writes"] == 0, st
+        assert st["resident_rows"] == 51
+
+    def test_scan_skips_foreign_seg_files(self):
+        tmp = tempfile.mkdtemp()
+        st = RowSpillStore(tmp)
+        st.spill({1: np.ones(4, np.float32)})
+        open(os.path.join(tmp, "seg-copy.bak"), "w").close()
+        st2 = RowSpillStore(tmp)   # must not crash on the stray file
+        assert 1 in st2
+
+    def test_gc_epoch_advances_only_on_successful_save(self, rng):
+        """A failed snapshot save (disk full) must NOT advance the
+        spill GC epoch — otherwise deferred-dead segments the last
+        GOOD snapshot still needs get unlinked under it."""
+        tmp = tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=4, seed=1, resident_bytes=8 * 8,
+                          spill_dir=tmp)
+        kv.push(np.arange(32), rng.randn(32, 4).astype(np.float32))
+        state = kv.export_state()  # snapshot ATTEMPT: no epoch tick
+        kv.export_state()
+        assert kv._spill._epoch == 0
+        kv.gc_boundary()           # save succeeded: epoch advances
+        assert kv._spill._epoch == 1
+        # restart: the epoch is process-local — restoring FROM a
+        # snapshot must re-arm deferral immediately, or a load in
+        # the restart window would eagerly unlink a <=horizon
+        # segment the retained snapshot still needs (double-crash
+        # data loss)
+        kv2 = LargeScaleKV(dim=4, seed=1, resident_bytes=8 * 8,
+                           spill_dir=tmp)
+        assert kv2._spill._epoch == 0
+        kv2.import_state(state)
+        assert kv2._spill._epoch >= 1
+
+    def test_spill_store_restart_rescan(self, rng):
+        """A fresh store over the same dir rebuilds the index
+        (newest segment wins) and rows reload bit-equal."""
+        tmp = tempfile.mkdtemp()
+        st = RowSpillStore(tmp)
+        r1 = {1: rng.randn(4).astype(np.float32),
+              2: rng.randn(4).astype(np.float32)}
+        st.spill(dict(r1))
+        newer = {2: rng.randn(4).astype(np.float32)}
+        st.spill(dict(newer))
+        st2 = RowSpillStore(tmp)
+        assert 1 in st2 and 2 in st2
+        np.testing.assert_array_equal(st2.load(1)[0], r1[1])
+        np.testing.assert_array_equal(st2.load(2)[0], newer[2])
+
+    def test_prune_after_rolls_back_to_horizon(self, rng):
+        """Roll back to a snapshot boundary: segments written AFTER
+        the horizon are dropped and a row whose newest copy was
+        post-boundary falls back to its pre-boundary segment — kept
+        on disk by the deferred GC that boundary mode switches on."""
+        tmp = tempfile.mkdtemp()
+        st = RowSpillStore(tmp)
+        st.spill({1: np.ones(4, np.float32)})
+        h = st.horizon()
+        st.on_boundary()   # the snapshot at ``h`` commits
+        st.spill({1: np.full(4, 2.0, np.float32),
+                  3: np.zeros(4, np.float32)})
+        st.prune_after(h)
+        assert 3 not in st
+        np.testing.assert_array_equal(st.load(1)[0],
+                                      np.ones(4, np.float32))
+
+    def test_gc_unlinks_two_boundaries_after_death(self):
+        tmp = tempfile.mkdtemp()
+        st = RowSpillStore(tmp)
+        st.on_boundary()
+        seg1 = st.spill({1: np.ones(4, np.float32)})
+        st.spill({1: np.zeros(4, np.float32)})   # supersedes seg1
+        assert os.path.exists(st._path(seg1))    # deferred, on disk
+        st.on_boundary()
+        assert os.path.exists(st._path(seg1))    # 1 boundary: kept
+        st.on_boundary()
+        st.on_boundary()
+        assert not os.path.exists(st._path(seg1))  # >=2: collected
+
+    def test_export_import_state_round_trip(self, rng):
+        tmp = tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=4, optimizer="adagrad", lr=0.3, seed=7,
+                          resident_bytes=8 * 16, spill_dir=tmp)
+        for _ in range(10):
+            kv.push(rng.randint(0, 300, 32),
+                    rng.randn(32, 4).astype(np.float32))
+        probe = np.arange(300)
+        expect = kv.pull(probe)   # before handing the dir to kv2
+        state = kv.export_state()
+        kv2 = LargeScaleKV(dim=4, optimizer="adagrad", lr=0.3, seed=7,
+                           resident_bytes=8 * 16, spill_dir=tmp)
+        kv2.import_state(state)
+        np.testing.assert_array_equal(kv2.pull(probe), expect)
+
+
+# ---------------------------------------------------------------------------
+# q8 wire verbs + seq dedup
+# ---------------------------------------------------------------------------
+
+def _sparse_server(dim=32, lr=0.25, seed=11, n=1, **kv_kw):
+    tables = [{"emb": LargeScaleKV(dim=dim, optimizer="sgd", lr=lr,
+                                   seed=seed + i, **kv_kw)}
+              for i in range(n)]
+    servers = [SparsePServer("127.0.0.1:0", tb).start()
+               for tb in tables]
+    return servers, tables
+
+
+class TestQ8Wire:
+    def test_push_q8_applies_dequantized_rows(self, rng):
+        servers, tables = _sparse_server()
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=32, trainer_id=0,
+                                     push_q8=True)
+            ids = np.arange(6)
+            before = tables[0]["emb"].pull(ids)
+            g = rng.randn(6, 32).astype(np.float32)
+            cl.push(ids, g)
+            after = tables[0]["emb"].pull(ids)
+            q, s = quantize_rows_q8(g)   # residuals start at zero
+            expect = before - 0.25 * dequantize_rows_q8(q, s)
+            np.testing.assert_array_equal(after, expect)
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_pull_q8_bounded_error(self, rng):
+        servers, tables = _sparse_server()
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=32, pull_q8=True)
+            ids = np.arange(20)
+            exact = tables[0]["emb"].pull(ids)
+            got = cl.pull(ids)
+            scale = np.max(np.abs(exact), axis=1) / 127.0
+            assert (np.abs(got - exact)
+                    <= scale[:, None] / 2 + 1e-7).all()
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_q8_replay_acks_without_reapply(self, rng):
+        """Duplicate quantized PUSH_SPARSE under the PR 5 seq
+        tracker: second copy acked, table untouched, dup event."""
+        servers, tables = _sparse_server()
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=32, trainer_id=3,
+                                     push_q8=True)
+            ids = np.arange(5)
+            cl.push(ids, rng.randn(5, 32).astype(np.float32))
+            seq_used = cl._seqs[0]
+            q, s = quantize_rows_q8(np.ones((5, 32), np.float32))
+            state = tables[0]["emb"].pull(ids)
+            cl.clients[0].push_sparse_q8("emb", ids, q, s,
+                                         seq=seq_used)  # replay
+            np.testing.assert_array_equal(
+                tables[0]["emb"].pull(ids), state)
+            dups = [e for e in servers[0].serv.events
+                    if e["kind"] == "dup_push_ignored"]
+            assert len(dups) == 1 and dups[0]["tid"] == 3
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_error_feedback_telescopes(self, rng):
+        """EF convergence (the collectives residual contract, on the
+        wire): pushing the SAME grad K times applies a cumulative
+        update within one quantization step of K*g per row — the
+        compression error is carried, not accumulated."""
+        servers, tables = _sparse_server(lr=1.0)
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=32, trainer_id=0,
+                                     push_q8=True)
+            ids = np.arange(4)
+            g = (rng.randn(4, 32) * rng.lognormal(size=(4, 1))) \
+                .astype(np.float32)
+            start = tables[0]["emb"].pull(ids)
+            K = 16
+            for _ in range(K):
+                cl.push(ids, g)
+            applied = start - tables[0]["emb"].pull(ids)  # lr=1.0
+            err = np.abs(applied - K * g)
+            # telescope: total error == the LAST residual, bounded by
+            # one step's quantization error, NOT K of them
+            step_bound = np.max(np.abs(g), axis=1) / 127.0 * 1.5 \
+                + 1e-6
+            assert (err <= step_bound[:, None]).all()
+            assert len(cl.residuals) == 4
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_small_dim_falls_back_exact(self):
+        """Below SPARSE_Q8_MIN_DIM the q8 flags are inert: the scale
+        overhead erodes the win and tiny rows are latency-bound."""
+        assert SPARSE_Q8_MIN_DIM == 16
+        servers, tables = _sparse_server(dim=8)
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=8, trainer_id=0,
+                                     push_q8=True, pull_q8=True)
+            assert not cl.push_q8 and not cl.pull_q8
+            ids = np.arange(3)
+            before = tables[0]["emb"].pull(ids)
+            g = np.full((3, 8), 0.125, np.float32)
+            cl.push(ids, g)   # exact fp32: bit-exact sgd, no residual
+            np.testing.assert_array_equal(
+                tables[0]["emb"].pull(ids), before - 0.25 * g)
+            assert not cl.residuals
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cache x wire integration: mirror write-through, incarnation fence
+# ---------------------------------------------------------------------------
+
+class TestCacheIntegration:
+    def test_mirror_sgd_keeps_cache_bit_equal_to_authority(self, rng):
+        servers, tables = _sparse_server(n=2, lr=0.05)
+        try:
+            cl = LookupServiceClient(
+                "emb", [s.endpoint for s in servers], dim=32,
+                trainer_id=0, cache_bytes=1 << 20, push_q8=True,
+                write_policy="mirror_sgd", mirror_lr=0.05)
+            ids = rng.randint(0, 100, 200)
+            cl.pull(ids)
+            for _ in range(5):
+                cl.push(ids, rng.randn(200, 32).astype(np.float32))
+            uniq = np.unique(ids)
+            shard = uniq % 2
+            authority = np.zeros((len(uniq), 32), np.float32)
+            for s_i in range(2):
+                m = shard == s_i
+                authority[m] = tables[s_i]["emb"].pull(uniq[m])
+            hits_before = cl.cache.hits
+            cached = cl.pull(uniq)
+            assert cl.cache.hits - hits_before == len(uniq)
+            np.testing.assert_array_equal(cached, authority)
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_partial_push_failure_invalidates_touched_rows(self, rng):
+        """A push that fails on shard 1 after shard 0 applied must
+        drop the touched rows from the hot tier — the write-policy
+        block never ran, so a surviving mirror image would serve the
+        pre-push value as a hit forever."""
+        from paddle_tpu.distributed.rpc import RpcError
+        servers, tables = _sparse_server(n=2, lr=0.5)
+        try:
+            cl = LookupServiceClient(
+                "emb", [s.endpoint for s in servers], dim=32,
+                trainer_id=0, cache_bytes=1 << 20, deadline_s=1.0,
+                write_policy="mirror_sgd", mirror_lr=0.5)
+            ids = np.arange(8)          # both shards touched
+            cl.pull(ids)
+            servers[1].shutdown()       # shard 1 down, hard
+            with pytest.raises(Exception):
+                cl.push(ids, np.ones((8, 32), np.float32))
+            # shard-0 rows applied server-side; the cache must NOT
+            # serve any touched row as a (stale) hit now
+            _, hit = cl.cache.get_many(ids)
+            assert not hit.any()
+            even = ids[ids % 2 == 0]    # shard-0 rows
+            np.testing.assert_array_equal(cl.pull(even),
+                                          tables[0]["emb"].pull(even))
+            cl.close()
+        finally:
+            for s_ in servers:
+                try:
+                    s_.shutdown()
+                except Exception:
+                    pass
+
+    def test_mirror_sgd_with_cache_requires_mirror_lr(self):
+        """A cache armed with the default mirror_sgd policy but no
+        mirror_lr would silently never write through NOR invalidate —
+        stale rows with no error. The constructor refuses it."""
+        from paddle_tpu.core.enforce import EnforceNotMet
+        servers, _ = _sparse_server()
+        try:
+            with pytest.raises(EnforceNotMet, match="mirror_lr"):
+                LookupServiceClient("emb", [servers[0].endpoint],
+                                    dim=32, cache_bytes=1 << 20)
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_invalidate_policy_drops_pushed_rows(self, rng):
+        servers, _tables = _sparse_server()
+        try:
+            cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                     dim=32, trainer_id=0,
+                                     cache_bytes=1 << 20,
+                                     write_policy="invalidate")
+            ids = np.arange(10)
+            cl.pull(ids)
+            cl.push(ids[:4], np.ones((4, 32), np.float32))
+            _, hit = cl.cache.get_many(ids)
+            assert list(hit) == [False] * 4 + [True] * 6
+            cl.close()
+        finally:
+            for s_ in servers:
+                s_.shutdown()
+
+    def test_restart_invalidates_hot_tier_exactly_once(self, rng):
+        """PR 5 __incarnation__ as the hot-tier invalidation signal:
+        kill + restart the pserver (same port, durable snapshot) ->
+        the NEXT wire round reconnects, re-reads the nonce, drops the
+        cache EXACTLY once, and no stale row is served."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.resilience.retry import RetryPolicy
+        snap = tempfile.mkdtemp()
+        table = {"emb": LargeScaleKV(dim=32, optimizer="sgd", lr=0.5,
+                                     seed=2)}
+        srv = SparsePServer("127.0.0.1:0", table,
+                            snapshot_dir=snap).start()
+        port = srv.serv.server.port
+        cl = LookupServiceClient("emb", [srv.endpoint], dim=32,
+                                 trainer_id=0, cache_bytes=1 << 20,
+                                 push_q8=True,
+                                 write_policy="mirror_sgd",
+                                 mirror_lr=0.5,
+                                 retry=RetryPolicy(max_retries=6,
+                                                   base_delay=0.05,
+                                                   max_delay=0.4,
+                                                   seed=1))
+        try:
+            ids = np.arange(50)
+            cl.pull(ids)
+            cl.push(ids, rng.randn(50, 32).astype(np.float32))
+            srv.shutdown()
+            table2 = {"emb": LargeScaleKV(dim=32, optimizer="sgd",
+                                          lr=0.5, seed=2)}
+            srv = SparsePServer("127.0.0.1:%d" % port, table2,
+                                snapshot_dir=snap).start()
+            mark = (obs.journal_events()[-1]["seq"]
+                    if obs.journal_events() else 0)
+            cl.push(ids, rng.randn(50, 32).astype(np.float32))
+            assert cl.invalidation_count == 1
+            # post-restart pull re-reads THROUGH the restored server
+            np.testing.assert_array_equal(cl.pull(ids),
+                                          table2["emb"].pull(ids))
+            # steady state: further rounds do NOT re-invalidate
+            cl.push(ids, rng.randn(50, 32).astype(np.float32))
+            cl.pull(ids)
+            assert cl.invalidation_count == 1
+            evs = [e for e in obs.journal_events(since_seq=mark)
+                   if e["kind"] == "sparse_cache_invalidated"]
+            assert len(evs) == 1 and evs[0]["table"] == "emb"
+        finally:
+            srv.shutdown()
+            cl.close()
+
+    def test_residuals_survive_restart(self, rng):
+        """'Loses no trainer-side residuals': the EF residual map is
+        trainer state; a pserver restart must leave it untouched."""
+        from paddle_tpu.resilience.retry import RetryPolicy
+        snap = tempfile.mkdtemp()
+        table = {"emb": LargeScaleKV(dim=32, lr=0.5, seed=2)}
+        srv = SparsePServer("127.0.0.1:0", table,
+                            snapshot_dir=snap).start()
+        port = srv.serv.server.port
+        cl = LookupServiceClient("emb", [srv.endpoint], dim=32,
+                                 trainer_id=0, cache_bytes=1 << 20,
+                                 push_q8=True,
+                                 write_policy="invalidate",
+                                 retry=RetryPolicy(max_retries=6,
+                                                   base_delay=0.05,
+                                                   max_delay=0.4,
+                                                   seed=1))
+        try:
+            ids = np.arange(8)
+            cl.push(ids, rng.randn(8, 32).astype(np.float32))
+            saved = {k: v.copy() for k, v in cl.residuals.items()}
+            assert saved
+            srv.shutdown()
+            srv = SparsePServer(
+                "127.0.0.1:%d" % port,
+                {"emb": LargeScaleKV(dim=32, lr=0.5, seed=2)},
+                snapshot_dir=snap).start()
+            cl.pull(ids)   # reconnect + fence
+            assert cl.invalidation_count == 1
+            assert set(cl.residuals) == set(saved)
+            for k in saved:
+                np.testing.assert_array_equal(cl.residuals[k],
+                                              saved[k])
+        finally:
+            srv.shutdown()
+            cl.close()
+
+
+# ---------------------------------------------------------------------------
+# SparsePServer snapshot/restore (push seqs + table state)
+# ---------------------------------------------------------------------------
+
+class TestSparseSnapshot:
+    def test_restore_is_bit_exact_and_tracker_restored(self, rng):
+        snap = tempfile.mkdtemp()
+        kv = LargeScaleKV(dim=16, optimizer="adagrad", lr=0.2, seed=4)
+        srv = SparsePServer("127.0.0.1:0", {"emb": kv},
+                            snapshot_dir=snap, snapshot_every=1)
+        srv.start()
+        cl = LookupServiceClient("emb", [srv.endpoint], dim=16,
+                                 trainer_id=1)
+        ids = np.arange(30)
+        for _ in range(3):
+            cl.push(ids, rng.randn(30, 16).astype(np.float32))
+        state = kv.pull(ids)
+        used_seq = cl._seqs[0]
+        srv.shutdown()
+
+        kv2 = LargeScaleKV(dim=16, optimizer="adagrad", lr=0.2,
+                           seed=4)
+        srv2 = SparsePServer("127.0.0.1:0", {"emb": kv2},
+                             snapshot_dir=snap, snapshot_every=1)
+        srv2.start()
+        np.testing.assert_array_equal(kv2.pull(ids), state)
+        # restored push-seq tracker: a replay of the last applied
+        # push must ack-without-reapply on the NEW incarnation
+        cl2 = LookupServiceClient("emb", [srv2.endpoint], dim=16,
+                                  trainer_id=1)
+        cl2.clients[0].push_sparse("emb", ids,
+                                   np.ones((30, 16), np.float32),
+                                   seq=used_seq)
+        np.testing.assert_array_equal(kv2.pull(ids), state)
+        dups = [e for e in srv2.serv.events
+                if e["kind"] == "dup_push_ignored"]
+        assert len(dups) == 1
+        cl.close()
+        cl2.close()
+        srv2.shutdown()
+
+    def test_spill_dir_survives_restart_with_snapshot(self, rng):
+        """Tier 2 x restart: rows beyond the resident budget live in
+        spill segments; a restart restores resident rows from the
+        snapshot and re-scans (<= horizon) segments — every row
+        bit-equal to the pre-kill table."""
+        snap = tempfile.mkdtemp()
+        spill = tempfile.mkdtemp()
+
+        def make_kv(spill_dir):
+            return LargeScaleKV(dim=8, optimizer="sgd", lr=0.1,
+                                seed=6, resident_bytes=32 * 24,
+                                spill_dir=spill_dir)
+
+        kv = make_kv(spill)
+        srv = SparsePServer("127.0.0.1:0", {"emb": kv},
+                            snapshot_dir=snap, snapshot_every=1)
+        srv.start()
+        cl = LookupServiceClient("emb", [srv.endpoint], dim=8,
+                                 trainer_id=0)
+        for _ in range(6):
+            cl.push(rng.randint(0, 500, 64),
+                    rng.randn(64, 8).astype(np.float32))
+        probe = np.arange(500)
+        state = kv.pull(probe)
+        assert kv.stats()["spilled_rows"] > 0
+        srv.shutdown()
+
+        kv2 = make_kv(spill)
+        srv2 = SparsePServer("127.0.0.1:0", {"emb": kv2},
+                             snapshot_dir=snap, snapshot_every=1)
+        srv2.start()
+        np.testing.assert_array_equal(kv2.pull(probe), state)
+        cl.close()
+        srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the training loop through the tiers
+# ---------------------------------------------------------------------------
+
+def _ctr_model(vocab, dim, padding_idx=None):
+    from paddle_tpu.param_attr import ParamAttr
+    fluid.framework._reset_default_programs()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[6], dtype="int64")
+        label = layers.data(name="label", shape=[1], dtype="float32")
+        emb = layers.embedding(ids, size=[vocab, dim],
+                               is_distributed=True,
+                               padding_idx=padding_idx,
+                               param_attr=ParamAttr(name="ctr_w"))
+        flat = layers.reshape(emb, shape=[-1, 6 * dim])
+        h = layers.fc(flat, size=16, act="relu")
+        logit = layers.fc(h, size=1)
+        loss = layers.mean(
+            layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss
+
+
+class TestRuntimeEndToEnd:
+    def _train(self, tier, steps=8, vocab=5000, dim=32,
+               padding_idx=None, seed=0):
+        with fluid.unique_name.guard():
+            main, startup, loss = _ctr_model(vocab, dim, padding_idx)
+            servers, tables = [], []
+            for i in range(2):
+                kv = LargeScaleKV(dim=dim, optimizer="sgd", lr=0.1,
+                                  seed=2 + i)
+                tables.append(kv)
+                servers.append(SparsePServer(
+                    "127.0.0.1:0", {"ctr_w": kv}).start())
+            try:
+                srt = SparseEmbeddingRuntime(
+                    main, [s.endpoint for s in servers], tier=tier)
+                scope = fluid.Scope()
+                losses = []
+                with fluid.scope_guard(scope):
+                    exe = fluid.Executor()
+                    exe.run(startup)
+                    r = np.random.RandomState(seed)
+                    ids = r.randint(0, vocab, (32, 6))
+                    lbl = (ids.sum(1) % 2).reshape(-1, 1) \
+                        .astype(np.float32)
+                    feed0 = {"ids": ids.astype(np.int64),
+                             "label": lbl}
+                    for _ in range(steps):
+                        feed = srt.wrap_feed(feed0)
+                        out = exe.run(main, feed=feed,
+                                      fetch_list=[loss]
+                                      + srt.grad_fetch_names())
+                        losses.append(float(
+                            np.asarray(out[0]).reshape(-1)[0]))
+                        srt.push_grads(feed, out[1:])
+                stats = srt.stats()
+                srt.close()
+                return losses, stats, tables
+            finally:
+                for s in servers:
+                    s.shutdown()
+
+    def test_q8_cache_trajectory_within_rtol_of_exact(self):
+        """The DeepFM-style acceptance: q8 push + hot cache (mirror
+        write-through) must track the exact/uncached twin's loss
+        trajectory within rtol — the EF telescope and the bit-equal
+        mirror keep the approximation bounded."""
+        exact, _, _ = self._train(SparseTierConfig())
+        q8c, stats, _ = self._train(SparseTierConfig(
+            cache_bytes=1 << 22, push_q8=True,
+            write_policy="mirror_sgd", mirror_lr=0.1, trainer_id=0))
+        np.testing.assert_allclose(q8c, exact, rtol=2e-3)
+        st = stats["ctr_w"]
+        assert st["push_q8"] and st["cache"]["hits"] > 0
+        assert st["wire_bytes"]["total"] > 0
+
+    def test_param_attr_str_pins_table_name(self):
+        fluid.framework._reset_default_programs()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data(name="ids", shape=[4], dtype="int64")
+            layers.embedding(ids, size=[100, 16],
+                             is_distributed=True,
+                             param_attr="pinned_tbl")
+        assert main._distributed_lookups[0]["table"] == "pinned_tbl"
+
+    def test_padding_idx_rows_zero_and_unpushed(self):
+        """Distributed twin of the lookup_table padding contract:
+        padding rows read as zeros and receive no sparse grad."""
+        tier = SparseTierConfig(trainer_id=0)
+        with fluid.unique_name.guard():
+            main, startup, loss = _ctr_model(50, 16, padding_idx=0)
+            kv = LargeScaleKV(dim=16, optimizer="sgd", lr=0.1, seed=1)
+            srv = SparsePServer("127.0.0.1:0", {"ctr_w": kv}).start()
+            try:
+                srt = SparseEmbeddingRuntime(main, [srv.endpoint],
+                                             tier=tier)
+                row0 = kv.pull([0])[0].copy()
+                scope = fluid.Scope()
+                with fluid.scope_guard(scope):
+                    exe = fluid.Executor()
+                    exe.run(startup)
+                    ids = np.array([[0, 0, 1, 2, 3, 4]] * 4,
+                                   np.int64)
+                    feed0 = {"ids": ids,
+                             "label": np.ones((4, 1), np.float32)}
+                    feed = srt.wrap_feed(feed0)
+                    pad_vecs = feed[srt.lookups[0]["out"]][ids == 0]
+                    assert (pad_vecs == 0.0).all()
+                    out = exe.run(main, feed=feed,
+                                  fetch_list=[loss]
+                                  + srt.grad_fetch_names())
+                    srt.push_grads(feed, out[1:])
+                # padding row untouched on the server, others moved
+                np.testing.assert_array_equal(kv.pull([0])[0], row0)
+                assert not np.array_equal(kv.pull([1])[0],
+                                          LargeScaleKV(
+                                              dim=16, seed=1)
+                                          .pull([1])[0])
+                srt.close()
+            finally:
+                srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the sparse_restart scenario inside tier-1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_sparse_restart_scenario_green_and_diagnosed():
+    """Run the real chaos scenario (kill mid-PUSH_SPARSE_Q8, restart
+    from the durable snapshot on the same port): rows bit-equal to
+    the fault-free twin, pulls stale-free, residuals preserved,
+    exactly one hot-tier invalidation, dup replay ack-without-reapply
+    — and doctor NAMES pserver_restart from the journal alone."""
+    import argparse
+    import sys as _sys
+    TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if TOOLS not in _sys.path:
+        _sys.path.insert(0, TOOLS)
+    import chaos_run
+    res = chaos_run._scenario_sparse_restart(
+        argparse.Namespace(seed=0, steps=6))
+    assert res["ok"], res
+    assert res["rows_bit_equal"] and res["pulls_stale_free"], res
+    assert res["residuals_preserved"], res
+    assert res["hot_tier_invalidations"] == 1, res
+    assert res["dup_push_ack_without_reapply"], res
+    doc = res["doctor"]
+    assert doc["top"] == "pserver_restart" and doc["match"], doc

@@ -46,7 +46,8 @@ __all__ = ["load_rounds", "diff", "format_report"]
 # and throughputs whose unit strings would otherwise trip the
 # lower-is-better heuristic below (e.g. "hit fraction")
 _HIGHER_IS_BETTER = re.compile(
-    r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps)",
+    r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps"
+    r"|rows/s)",
     re.IGNORECASE)
 
 # lower-is-better heuristic by unit/metric name: a drop in these is an

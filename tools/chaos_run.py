@@ -223,6 +223,7 @@ DOCTOR_EXPECT = {
     "drop30": ("network_flaky",),
     "restart_2x2_obs": ("pserver_restart",),
     "serving_kill": ("replica_failure",),
+    "sparse_restart": ("pserver_restart",),
 }
 
 
@@ -572,6 +573,142 @@ def _scenario_restart_2x2_obs(args):
             "losses": results.get(0)}
 
 
+def _scenario_sparse_restart(args):
+    """Tiered-sparse chaos (docs/sparse.md runbook): one trainer
+    drives the pull -> q8-push loop with the hot cache through a
+    SparsePServer taking a durable table snapshot after EVERY applied
+    push; the server is hard-killed mid-PUSH_SPARSE_Q8 and restarted
+    on the same port from the snapshot dir. Green means: final rows
+    BIT-EQUAL to a fault-free twin (exactly-once pushes through the
+    restored seq tracker), trainer-side EF residuals bit-equal to the
+    twin's (nothing lost), the hot tier invalidated EXACTLY once, no
+    stale pull anywhere, a forced duplicate quantized push
+    acks-without-reapply, and doctor NAMES the restart."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.distributed import (LargeScaleKV,
+                                        LookupServiceClient,
+                                        SparsePServer)
+    from paddle_tpu.parallel.collectives import quantize_rows_q8
+    from paddle_tpu.resilience import RetryPolicy
+
+    DIM, VOCAB, LR = 16, 512, 0.5
+    rng = np.random.RandomState(args.seed)
+    stream = [(rng.randint(0, VOCAB, 96).astype(np.int64),
+               (rng.randn(96, DIM) * 0.1).astype(np.float32))
+              for _ in range(args.steps)]
+
+    def run(chaos, snap_dir):
+        tables = {"emb": LargeScaleKV(dim=DIM, lr=LR, seed=9)}
+        s = SparsePServer("127.0.0.1:0", tables,
+                          snapshot_dir=snap_dir, snapshot_every=1)
+        s.start()
+        port = s.serv.server.port
+        restarted = []
+        if chaos:
+            s.serv.crash_after("PUSH_SPARSE_Q8",
+                               max(2, args.steps // 2))
+
+            def restarter():
+                while not s.serv.server._stop.is_set():
+                    time.sleep(0.01)
+                t2 = {"emb": LargeScaleKV(dim=DIM, lr=LR, seed=9)}
+                s2 = SparsePServer("127.0.0.1:%d" % port, t2,
+                                   snapshot_dir=snap_dir,
+                                   snapshot_every=1)
+                s2.start()
+                restarted.append(s2)
+
+            threading.Thread(target=restarter, daemon=True).start()
+        cl = LookupServiceClient(
+            "emb", [s.endpoint], dim=DIM, trainer_id=0,
+            deadline_s=2.0, cache_bytes=VOCAB * DIM * 4,
+            push_q8=True, write_policy="mirror_sgd", mirror_lr=LR,
+            retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                              max_delay=0.3, seed=args.seed))
+        pulls = []
+        for ids, grads in stream:
+            pulls.append(cl.pull(ids))
+            cl.push(ids, grads)
+        # client view (rides the cache) AND authority view (the live
+        # table itself): both must match the fault-free twin
+        final = cl.pull(np.arange(VOCAB))
+        servers = [s] + restarted
+        final_server = servers[-1].tables["emb"].pull(
+            np.arange(VOCAB))
+        residuals = {k: v.copy() for k, v in cl.residuals.items()}
+        return {"pulls": pulls, "final": final,
+                "final_server": final_server,
+                "residuals": residuals, "client": cl,
+                "servers": servers, "restarted": bool(restarted)}
+
+    clean = run(False, tempfile.mkdtemp(prefix="chaos-sparse-clean-"))
+    for s in clean["servers"]:
+        s.shutdown()
+    clean["client"].close()
+
+    mark = _journal_watermark()
+    t0 = __import__("time").monotonic()
+    chaos = run(True, tempfile.mkdtemp(prefix="chaos-sparse-"))
+    elapsed = __import__("time").monotonic() - t0
+    cl = chaos["client"]
+    live = chaos["servers"][-1]
+
+    # forced duplicate: replay an already-used seq — the restored
+    # tracker must ack-without-reapply
+    ids_d = np.arange(4, dtype=np.int64)
+    q, sc = quantize_rows_q8(np.full((4, DIM), 0.3, np.float32))
+    before_dup = live.tables["emb"].pull(ids_d)
+    cl.clients[0].push_sparse_q8("emb", ids_d, q, sc,
+                                 seq=cl._seqs[0])  # replayed seq
+    after_dup = live.tables["emb"].pull(ids_d)
+    dup_ok = bool(np.array_equal(before_dup, after_dup))
+
+    rows_equal = bool(
+        np.array_equal(chaos["final"], clean["final"])
+        and np.array_equal(chaos["final_server"],
+                           clean["final_server"]))
+    stale_free = all(
+        np.array_equal(a, b)
+        for a, b in zip(chaos["pulls"], clean["pulls"]))
+    res_equal = (set(chaos["residuals"]) == set(clean["residuals"])
+                 and all(np.array_equal(chaos["residuals"][k],
+                                        clean["residuals"][k])
+                         for k in clean["residuals"]))
+    kinds = _journal_kinds(mark)
+    inval_events = [e for e in _journal_events_since(mark)
+                    if e["kind"] == "sparse_cache_invalidated"]
+    journal_ok = "snapshot" in kinds and "rpc_reconnect" in kinds \
+        and "dup_push_ignored" in kinds
+    verdict = {
+        "ok": (chaos["restarted"] and rows_equal and stale_free
+               and res_equal and dup_ok
+               and len(inval_events) == 1 and journal_ok
+               and elapsed < 120.0),
+        "elapsed_s": round(elapsed, 2),
+        "kill_fired": chaos["restarted"],
+        "rows_bit_equal": rows_equal,
+        "pulls_stale_free": stale_free,
+        "residuals_preserved": res_equal,
+        "residual_rows": len(chaos["residuals"]),
+        "dup_push_ack_without_reapply": dup_ok,
+        "hot_tier_invalidations": len(inval_events),
+        "cache_hit_rate": round(
+            cl.cache.stats()["hit_rate"], 4),
+        "journal_kinds": sorted(kinds),
+        "journal_ok": journal_ok,
+        "doctor": _doctor_verdict(
+            "sparse_restart", events=_journal_events_since(mark)),
+    }
+    for s in chaos["servers"]:
+        s.shutdown()
+    cl.close()
+    return verdict
+
+
 def _scenario_serving_kill(args):
     """The serving-fleet acceptance scenario: 3 replicas behind
     NetFaultProxies dropping 5% of frames, closed-loop clients on the
@@ -707,6 +844,7 @@ DIST_SCENARIOS = {
     "drop30": _scenario_drop30,
     "restart_2x2_obs": _scenario_restart_2x2_obs,
     "serving_kill": _scenario_serving_kill,
+    "sparse_restart": _scenario_sparse_restart,
 }
 
 

@@ -210,9 +210,14 @@ def _detect_batcher_death(kinds):
 
 def _detect_pserver_restart(kinds):
     snaps = kinds.get("snapshot", [])
+    # hot-tier invalidations are restart evidence too: the sparse
+    # client only drops its row cache on an observed __incarnation__
+    # change (docs/sparse.md)
+    invals = kinds.get("sparse_cache_invalidated", [])
     recov = (kinds.get("phase_replay", [])
              + kinds.get("phase_retry", [])
-             + kinds.get("rpc_reconnect", []))
+             + kinds.get("rpc_reconnect", [])
+             + invals)
     if not snaps or not recov:
         return []
     replays = kinds.get("phase_replay", [])
@@ -220,19 +225,23 @@ def _detect_pserver_restart(kinds):
     last_snap = snaps[-1]
     first_recov = min(recov, key=lambda e: e.get("seq") or 0)
     summary = ("pserver restarted mid-run: boundary snapshot at seq "
-               "%s (boundary %s), then %d reconnect(s)%s — trainers "
+               "%s (boundary %s), then %d reconnect(s)%s%s — trainers "
                "recovered via idempotent replay into the restored "
                "shards" % (last_snap.get("seq"),
                            last_snap.get("boundary", "?"),
                            len(reconnects),
                            " and whole-phase replay at seq %s"
-                           % replays[0].get("seq") if replays else ""))
+                           % replays[0].get("seq") if replays else "",
+                           ", hot embedding tier invalidated on the "
+                           "incarnation change" if invals else ""))
     return [_diag("pserver_restart", summary,
                   [_cite(last_snap, "boundary", "endpoint"),
                    _cite(first_recov, "endpoint", "what", "attempt")]
                   + [_cite(e, "endpoint") for e in reconnects[:6]]
-                  + [_cite(e, "what") for e in replays[:4]],
-                  confidence=1.0 if replays else 0.7)]
+                  + [_cite(e, "what") for e in replays[:4]]
+                  + [_cite(e, "table", "rows_dropped")
+                     for e in invals[:2]],
+                  confidence=1.0 if replays or invals else 0.7)]
 
 
 def _detect_network_flaky(kinds):
