@@ -1390,6 +1390,209 @@ def bench_serving_fleet_scaling(duration_s=None, concurrency=None,
             "skipped": skipped}
 
 
+def bench_remediation_recovery(duration_s=None):
+    """Closed-loop control-plane row (observability/control.py):
+    seconds from a replica SIGKILL to the fleet serving HEALTHY again
+    with ZERO human/test-driver intervention — the router's lease
+    monitor detects the death, the ControlPlane's
+    ``event:replica_evicted`` policy respawns the replica, and the
+    clock stops when the fleet is back at full strength and a probe
+    request completes. Lower is better; the unit says "recovery" so
+    bench_diff flags a RISE."""
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_gen
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import (ControlPlane,
+                                          RemediationPolicy)
+    from paddle_tpu.serving import (RouterConfig, ServingConfig,
+                                    ServingReplica, ServingRouter)
+
+    duration_s = duration_s or _env_float(
+        "BENCH_REMEDIATION_DURATION_S", 12.0)
+    model_dir = load_gen.build_synthetic_model(
+        tempfile.mkdtemp(prefix="bench_remediation_"), hidden=8)
+    cfg = ServingConfig(max_batch_size=8, max_queue_wait_us=500)
+    live = {i: ServingReplica(model_dir, cfg, replica_id=i).start()
+            for i in range(2)}
+    router = ServingRouter(
+        [live[i].endpoint for i in range(2)],
+        RouterConfig(lease_timeout_s=0.8, heartbeat_interval_s=0.1,
+                     rpc_deadline_s=3.0, max_retries=4))
+    next_id = [2]
+    retired = []
+
+    def restart_replica(ctx):
+        rid = (ctx.get("event") or {}).get("replica")
+        if rid is None:
+            # no victim named: spawning anyway would grow the fleet
+            # past the row's fixed size and skew the recovery number
+            return {"ok": True, "noop": "no_victim"}
+        old = live.pop(rid, None)
+        if old is not None:
+            retired.append(old)
+        try:
+            router.remove_replica(rid)
+        except Exception:
+            pass
+        k = next_id[0]
+        next_id[0] += 1
+        rep = ServingReplica(model_dir, cfg, replica_id=k).start()
+        live[router.add_replica(rep.endpoint)] = rep
+        return {"ok": True, "replaced": rid,
+                "endpoint": rep.endpoint}
+
+    cp = ControlPlane(interval_s=0.2, max_actions_per_min=12)
+    cp.register_policy(RemediationPolicy(
+        "respawn_dead_replica", "event:replica_evicted",
+        "restart_replica", cooldown_s=0.5, deadline_s=30.0),
+        restart_replica)
+    cp.start()
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(2, 64).astype(np.float32)}
+    router.infer_sync(feed, timeout=30)   # fleet warm + serving
+    t_kill = time.monotonic()
+    live[0].crash()
+    recovered_s = None
+    deadline = t_kill + duration_s
+    while time.monotonic() < deadline:
+        # recovered = the plane ACTED (respawn fired), the fleet is
+        # back at strength, and a probe completes — healthy==2 alone
+        # would stop the clock before the lease even expired (the
+        # router masks a dead replica by retrying on the survivor)
+        respawned = any(r["decision"] == "fired"
+                        and r["action"] == "restart_replica"
+                        for r in cp.ledger())
+        if respawned and len(router._healthy()) == 2:
+            try:
+                router.infer_sync(feed, timeout=10)
+                recovered_s = time.monotonic() - t_kill
+                break
+            except Exception:
+                pass
+        time.sleep(0.05)
+    fired = [r for r in cp.ledger() if r["decision"] == "fired"]
+    cp.stop()
+    router.shutdown()
+    for rep in list(live.values()) + retired:
+        try:
+            rep.engine.shutdown(drain=False, timeout=5)
+            rep.server.shutdown()
+        except Exception:
+            pass
+    return {"metric": "remediation_recovery",
+            "value": round(recovered_s, 3)
+            if recovered_s is not None else None,
+            "unit": "seconds kill->healthy recovery (human-free)",
+            "actions_fired": [r["action"] for r in fired],
+            "healthy_replicas_end": 2 if recovered_s is not None
+            else len(router._healthy()),
+            "error": None if recovered_s is not None
+            else "fleet never recovered within %.0fs" % duration_s}
+
+
+def bench_qps_under_autoscale(duration_s=None, concurrency=None,
+                              device_ms=None):
+    """Closed-loop QPS while the control plane scales the fleet
+    1 -> 3 -> 1 under it (ScalingPolicy over the router pressure tap,
+    ``FleetScaler``/``spawn_fleet`` as the actuator): the row proves
+    autoscaling pays for itself in throughput WHILE it happens — the
+    client loop never pauses for the scale events, and the same
+    dispatch-floor device-time emulation as ``serving_fleet_scaling``
+    keeps the number about the serving plane, not host cores.
+    Budget-aware: skipped entirely when the soft budget is spent."""
+    import tempfile
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_gen
+    from paddle_tpu.observability import ControlPlane, ScalingPolicy
+    from paddle_tpu.serving import RouterConfig
+
+    if _over_budget():
+        _log("time budget exceeded — skipping qps_under_autoscale")
+        return {"metric": "qps_under_autoscale", "value": None,
+                "unit": "qps closed-loop while scaling 1->3->1",
+                "skipped": ["over_budget"]}
+    duration_s = duration_s or _env_float(
+        "BENCH_AUTOSCALE_DURATION_S", 18.0)
+    concurrency = concurrency or int(
+        _env_float("BENCH_AUTOSCALE_CONCURRENCY", 64))
+    device_ms = device_ms if device_ms is not None else _env_float(
+        "BENCH_FLEET_DEVICE_MS", 120.0)
+    model_dir = load_gen.build_synthetic_model(
+        tempfile.mkdtemp(prefix="bench_autoscale_"), hidden=8)
+    replica_args = ["--dispatch-floor-ms", str(device_ms)] \
+        if device_ms > 0 else []
+    router, stop = load_gen.spawn_fleet(
+        model_dir, 1, max_batch=8, wait_us=1000,
+        router_config=RouterConfig(
+            max_concurrency=concurrency + 32, max_pending=8192,
+            connect_timeout_s=10.0),
+        replica_args=replica_args)
+    scaler = load_gen.FleetScaler(router, stop)
+    cp = ControlPlane(interval_s=0.3, max_actions_per_min=12)
+    policy = ScalingPolicy(up_depth=4.0, down_depth=0.5,
+                           sustain_s=1.0, cooldown_s=2.0,
+                           min_replicas=1, max_replicas=3)
+    cp.attach_scaler(scaler, policy)
+    cp.start()
+
+    rng = np.random.RandomState(0)
+    feeds = [({"x": rng.rand(1, 64).astype(np.float32)}, 1)
+             for _ in range(16)]
+    import itertools
+    cyc = itertools.cycle(feeds)
+    t0 = time.time()
+    r = load_gen.run_closed_loop(router, lambda: next(cyc),
+                                 concurrency, duration_s, None)
+    wall = time.time() - t0
+    qps = round(len(r["client_lat_ms"]) / wall, 2) if wall else None
+    # load gone: pressure collapses below down_depth and the plane
+    # retires the spawned replicas back to min (cooldown-spaced)
+    t_down = time.monotonic() + 20.0
+    while scaler.replica_count() > 1 and time.monotonic() < t_down:
+        time.sleep(0.25)
+    final = scaler.replica_count()
+    ledger = cp.ledger()
+    cp.stop()
+    stop()
+    # peak from the LEDGER, not a point sample (a scale-down racing
+    # the end of the load window must not under-report the peak):
+    # walk the fired scale events and track the running count
+    n, peak = 1, 1
+    for rec in ledger:
+        if rec["decision"] != "fired":
+            continue
+        if rec["action"] == "scale_up":
+            n += 1
+        elif rec["action"] == "scale_down":
+            n -= 1
+        peak = max(peak, n)
+    scale_events = [{k: rec.get(k) for k in ("action", "decision",
+                                             "reason")}
+                    for rec in ledger
+                    if rec["action"].startswith("scale_")]
+    lat = np.asarray(r["client_lat_ms"])
+    return {"metric": "qps_under_autoscale",
+            "value": qps, "unit": "qps closed-loop while scaling 1->3->1",
+            "concurrency": concurrency,
+            "duration_s": duration_s,
+            "emulated_device_ms": device_ms,
+            "host_cpus": os.cpu_count(),
+            "peak_replicas": peak,
+            "final_replicas": final,
+            "scaled_back_down": final == 1,
+            "p99_ms": round(float(np.percentile(lat, 99)), 2)
+            if lat.size else None,
+            "client_failed": r["client_failed"],
+            "scale_events": scale_events}
+
+
 # ---------------------------------------------------------------------------
 # resilience: anomaly-guard overhead
 # ---------------------------------------------------------------------------
@@ -2010,6 +2213,7 @@ def child_main():
                  bench_guarded_overhead, bench_ps_degraded,
                  bench_sparse_embedding_throughput,
                  bench_serving_latency, bench_serving_fleet_scaling,
+                 bench_remediation_recovery, bench_qps_under_autoscale,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,
                  bench_resnet50, bench_resnet50_hostfed]

@@ -180,11 +180,21 @@ class ListenAndServ:
         self._mu = threading.Lock()
         # sync merge: name -> [(trainer_id|None, grad), ...]
         self._pending: Dict[str, List] = {}
-        # barrier: key -> (tid|None, base_name, responder); keyed by
-        # trainer id so a REPLAYED barrier (deadline + reconnect)
-        # replaces its own stale parked entry instead of forging quorum
+        # barrier: key -> (tid|None, base_name, epoch|None, responder);
+        # keyed by trainer id so a REPLAYED barrier (deadline +
+        # reconnect) replaces its own stale parked entry instead of
+        # forging quorum
         self._barrier_waiters: Dict = {}
         self._barrier_anon = 0
+        # replay-epoch fence: per-trainer watermark of barrier epochs
+        # already RELEASED (status 0). A replay at/below it is a
+        # retry whose release ack was lost on the wire — re-ack it
+        # immediately. Parking it instead would (a) count a finished
+        # step's barrier toward the NEXT step's quorum (releasing the
+        # peer before all of its step's arrivals — silent sync break)
+        # and (b) under loss, phase-lock the trainers into deadline-
+        # long retry cascades (the restart_2x2_obs 360 s storm).
+        self._barrier_released: Dict[int, int] = {}
         self._completed = 0            # legacy tid-less COMPLETEs
         self._completed_tids = set()
         self._evicted = set()
@@ -203,6 +213,13 @@ class ListenAndServ:
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         self._crash_at: Dict[str, int] = {}
+        # control-plane quarantine (observability/control.py): while
+        # set, the lease monitor's EVICTION authority is suspended —
+        # on a network_flaky verdict the lossy wire, not the trainers,
+        # is the suspect, and evicting healthy trainers on missed
+        # heartbeats would turn a transport incident into a training
+        # incident. Probation/readmit is driven by the control plane.
+        self._quarantined = False
         # health plane: handler-drain beacon (one bump per handled
         # verb — evidence in blackbox dumps) and a barrier-release
         # beacon watched for the parked-barrier wedge: waiters parked
@@ -247,6 +264,9 @@ class ListenAndServ:
             self._evicted = set(
                 int(t) for t in restore_meta.get("evicted", []))
             self._boundary = int(restore_meta.get("boundary", 0))
+            self._barrier_released = {
+                int(t): int(e) for t, e in
+                (restore_meta.get("barrier_released") or {}).items()}
 
         s = self.server
         s.register("SEND", self._on_send)
@@ -300,6 +320,37 @@ class ListenAndServ:
             q, self._evq = self._evq, []
         for kind, kw in q:
             self._event(kind, **kw)
+
+    def quarantine(self, reason=None):
+        """Control-plane hook: suspend this server's lease-eviction
+        authority (evict + probation posture for a ``network_flaky``
+        verdict — see the ``_quarantined`` comment). Serving, merges,
+        barriers and snapshots continue untouched; only the monitor's
+        evictions pause. Idempotent; journalled once per transition."""
+        with self._mu:
+            was = self._quarantined
+            self._quarantined = True
+        if not was:
+            self._event("pserver_quarantined", reason=reason)
+        return self
+
+    def readmit(self):
+        """End quarantine: re-arm lease evictions with a fresh grace
+        window (every live lease is renewed NOW — heartbeats missed
+        during the flaky window must not expire retroactively)."""
+        with self._mu:
+            was = self._quarantined
+            self._quarantined = False
+            now = time.monotonic()
+            for t in self._leases:
+                self._leases[t] = now
+        if was:
+            self._event("pserver_readmitted")
+        return self
+
+    @property
+    def quarantined(self) -> bool:
+        return self._quarantined
 
     def crash_after(self, verb: str, n: int):
         """Chaos seam: hard-kill the server (sockets closed, nothing
@@ -426,26 +477,40 @@ class ListenAndServ:
         stale parked entry."""
         self._drain_beacon.bump()
         self._chaos_tick("BARRIER")
-        base, tid, _ = unpack_wire_name(name)
+        base, tid, epoch = unpack_wire_name(name)
         stale = None
+        already_released = False
         with self._mu:
             self._touch_lease_locked(tid)
             self._check_live_locked(tid)
-            if tid is not None:
-                key = ("t", tid)
+            if tid is not None and epoch is not None and \
+                    epoch <= self._barrier_released.get(tid, 0):
+                # the replay-epoch FENCE: this barrier was already
+                # released; only its ack died on the wire — re-ack
+                # now, never re-park (see _barrier_released)
+                self._event_locked("dup_barrier_ack", name=base,
+                                   tid=tid, seq=epoch)
+                already_released = True
             else:
-                self._barrier_anon += 1
-                key = ("a", self._barrier_anon)
-            stale = self._barrier_waiters.pop(key, None)
-            self._barrier_waiters[key] = (tid, base, responder)
-            release = self._maybe_release_barrier_locked()
+                if tid is not None:
+                    key = ("t", tid)
+                else:
+                    self._barrier_anon += 1
+                    key = ("a", self._barrier_anon)
+                stale = self._barrier_waiters.pop(key, None)
+                self._barrier_waiters[key] = (tid, base, epoch,
+                                              responder)
+                release = self._maybe_release_barrier_locked()
         # snapshot events precede the acks that let trainers move on
         self._flush_events()
+        if already_released:
+            responder(0, b"")
+            return
         if stale is not None:
             # answer the superseded responder so the native layer frees
             # its parked request (its connection is typically dead)
-            stale[2](STATUS_ABORTED,
-                     b"BarrierAborted: superseded by replayed barrier")
+            stale[-1](STATUS_ABORTED,
+                      b"BarrierAborted: superseded by replayed barrier")
         self._release(release)
 
     def _maybe_release_barrier_locked(self):
@@ -460,7 +525,14 @@ class ListenAndServ:
             return None
         waiters = list(self._barrier_waiters.values())
         self._barrier_waiters = {}
-        bases = {b for _, b, _ in waiters}
+        # advance the replay-epoch fence: these barriers are about to
+        # be RELEASED (status 0), so any later copy of them on the
+        # wire is a lost-ack retry and must be re-acked, not parked
+        for tid, _b, epoch, _r in waiters:
+            if tid is not None and epoch is not None and \
+                    epoch > self._barrier_released.get(tid, 0):
+                self._barrier_released[tid] = epoch
+        bases = {b for _, b, _, _ in waiters}
         if self.sync_mode and not self._pending \
                 and "fetch" not in bases:
             self._maybe_snapshot_locked()
@@ -468,7 +540,7 @@ class ListenAndServ:
 
     def _release(self, waiters, status=0, msg=b""):
         if waiters:
-            for _, _, r in waiters:
+            for _, _, _, r in waiters:
                 r(status, msg)
             # barrier progress: any answered waiter set (release,
             # abort, eviction, shutdown) resets the stall clock
@@ -485,6 +557,14 @@ class ListenAndServ:
             "completed": sorted(self._completed_tids),
             "evicted": sorted(self._evicted),
             "boundary": self._boundary,
+            # the barrier replay-epoch fence survives a restart:
+            # epochs are per-trainer monotonic for the life of the
+            # TRAINER process (which outlives a server restart), so a
+            # restored watermark stays valid — and a lost-release-ack
+            # retry landing on the restarted server re-acks in one
+            # RTT instead of re-parking into the recovery quorum
+            "barrier_released": {str(t): int(e) for t, e in
+                                 self._barrier_released.items()},
         }
         if self._snapshot_tables:
             # table state lands in the same durable dir (snapshot_fn),
@@ -647,6 +727,11 @@ class ListenAndServ:
         with self._mu:
             if self._aborted is not None:
                 return
+            if self._quarantined:
+                # quarantined: leases keep renewing on traffic but the
+                # monitor must not evict anybody while the network is
+                # the suspect
+                return
             expired = sorted(
                 t for t, ts in self._leases.items()
                 if t not in self._evicted
@@ -695,7 +780,7 @@ class ListenAndServ:
         self._flush_events()
         self._release(release)
         if evicted_waiters:
-            for tid, _, r in evicted_waiters:
+            for tid, _, _, r in evicted_waiters:
                 r(STATUS_EVICTED,
                   ("TrainerEvicted: trainer %s lease expired on %s"
                    % (tid, self.endpoint)).encode())
@@ -797,6 +882,11 @@ class Communicator:
         self._inflight = threading.Semaphore(0)
         self._err: Optional[Exception] = None
         self._seqs: Dict[str, int] = {}
+        # barrier EPOCHS ride a separate per-endpoint counter: the
+        # server's barrier-release watermark must not consume the
+        # dense SEND seq stream (whose _SeqTracker window depends on
+        # 1,2,3,... density)
+        self._bseqs: Dict[str, int] = {}
         self._seq_mu = threading.Lock()
 
     def next_seq(self, endpoint: str) -> Optional[int]:
@@ -805,6 +895,13 @@ class Communicator:
         with self._seq_mu:
             self._seqs[endpoint] = self._seqs.get(endpoint, 0) + 1
             return self._seqs[endpoint]
+
+    def next_barrier_seq(self, endpoint: str) -> Optional[int]:
+        if self.trainer_id is None:
+            return None
+        with self._seq_mu:
+            self._bseqs[endpoint] = self._bseqs.get(endpoint, 0) + 1
+            return self._bseqs[endpoint]
 
     def client(self, endpoint) -> RPCClient:
         if endpoint not in self._clients:
@@ -884,7 +981,8 @@ class Communicator:
 
     def barrier_all(self, name="step"):
         for ep in sorted(set(self.placement.values())):
-            self.client(ep).barrier(name)
+            self.client(ep).barrier(
+                name, seq=self.next_barrier_seq(ep))
 
     def complete_all(self):
         for ep in sorted(set(self.placement.values())):
@@ -1284,6 +1382,15 @@ class ParameterServerRuntime:
             base_delay=call_retry.base_delay * 2,
             max_delay=call_retry.max_delay,
             seed=self.trainer_id)
+        # replay-backoff jitter stream, seeded per TRAINER: two
+        # trainers driven into lockstep replays by the same loss
+        # pattern must draw different backoffs on every attempt, or
+        # their replayed barriers keep colliding at the server in
+        # phase (the restart_2x2_obs retry-storm half the epoch fence
+        # doesn't cover). Deterministic per trainer — chaos runs stay
+        # reproducible.
+        self._replay_rng = np.random.RandomState(
+            (0x5EED ^ (self.trainer_id * 2654435761)) % (2 ** 31))
         self._last_inc: Dict[str, bytes] = {}
         self.events: List[tuple] = []
         self.dc_asgd = getattr(transpiler.config, "enable_dc_asgd",
@@ -1375,6 +1482,14 @@ class ParameterServerRuntime:
             self.events.append(("phase_replay", what, attempt))
             _obs.emit("phase_replay", what=what, attempt=attempt,
                       trainer=self.trainer_id)
+            # jittered backoff BEFORE the replay (this path used to
+            # re-run the phase immediately): a random fraction of the
+            # policy delay, per-trainer stream — decorrelates the
+            # replaying trainers instead of re-colliding them
+            base = delays[min(attempt, len(delays) - 1)] \
+                if delays else 0.05
+            time.sleep(base * float(self._replay_rng.uniform(0.1,
+                                                             1.0)))
 
     def init_params(self):
         """Adopt the server-side initial parameter values (the

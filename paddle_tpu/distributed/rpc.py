@@ -549,8 +549,15 @@ class RPCClient:
         scales, _ = deserialize_tensor(body, off)
         return q, scales
 
-    def barrier(self, name: str = "step", deadline_s=_UNSET):
-        self.call("BARRIER", name, deadline_s=deadline_s)
+    def barrier(self, name: str = "step", deadline_s=_UNSET,
+                seq: Optional[int] = None):
+        """``seq`` is the barrier EPOCH (per-trainer, per-server
+        monotonic): the server remembers the highest epoch it already
+        RELEASED for this trainer and immediately re-acks any replay
+        of it — a release ack lost on a lossy wire then costs one
+        round-trip on retry instead of re-parking the trainer into the
+        next step's quorum (the restart_2x2_obs retry-storm fence)."""
+        self.call("BARRIER", name, deadline_s=deadline_s, seq=seq)
 
     def complete(self):
         self.call("COMPLETE")

@@ -27,11 +27,14 @@ from __future__ import annotations
 
 import contextlib
 
+from .control import (ControlPlane, RemediationPolicy,  # noqa: F401
+                      ScalingPolicy)
 from .export import MetricsServer, start_metrics_server  # noqa: F401
 from .health import (Beacon, FlightRecorder, HealthRule,  # noqa: F401
                      Watchdog, arm_process, beacon,
                      beacons_snapshot, default_rules, get_recorder,
-                     get_watchdog, healthz, set_blackbox_dir)
+                     get_watchdog, healthz,
+                     register_control_provider, set_blackbox_dir)
 from .journal import (clear as clear_journal,  # noqa: F401
                       configure as configure_journal,
                       emit, events as journal_events, get_role,
@@ -51,6 +54,8 @@ __all__ = [
     "Beacon", "beacon", "beacons_snapshot", "HealthRule", "Watchdog",
     "FlightRecorder", "get_watchdog", "get_recorder",
     "set_blackbox_dir", "arm_process", "default_rules", "healthz",
+    "register_control_provider",
+    "ControlPlane", "RemediationPolicy", "ScalingPolicy",
 ]
 
 

@@ -1,0 +1,782 @@
+"""Closed-loop control plane: verdict-driven remediation + router
+autoscaling with a machine-auditable action ledger.
+
+PR 7 built the SIGNALS (metrics/journal/traces), PR 10 the VERDICTS
+(watchdog stalls, declarative HealthRules, doctor's offline ranking).
+This module closes the loop — at fleet scale there is no human reading
+a blackbox, so verdicts must DRIVE remediation, and (critically for an
+observability plane) every automated action must itself be observable:
+
+  - **RemediationPolicy** — a declarative binding from a trigger to an
+    actuator. Triggers are ``"verdict:<reason-prefix>"`` (watchdog
+    problems, e.g. ``verdict:stall:serving_batcher`` for a wedged
+    batcher) or ``"event:<journal-kind>"`` (e.g.
+    ``event:replica_evicted`` for a SIGKILLed replica). Actuators are
+    plain callables registered next to the policy — the supervisor
+    actions live WITH the component that owns them (a replica
+    respawner in the serving harness, ``ListenAndServ.quarantine`` for
+    a flaky pserver) while this module owns WHEN they may run.
+  - **ScalingPolicy** — router-driven autoscaling: spawn/retire
+    serving replicas from SUSTAINED queue-depth pressure, with
+    hysteresis (the up threshold sits above the down threshold and the
+    sustain clock resets inside the band, so oscillation around a
+    threshold never flaps the fleet), min/max replica bounds, and the
+    rolling-EWMA pressure baseline journalled with every decision.
+    The actuator is a ``scaler`` duck (``tools/load_gen.FleetScaler``
+    over ``spawn_fleet``): spawned replicas inherit the fleet's shared
+    compile-cache dir, so scale-up warms from the PR 11 persistent
+    cache and never cold-compiles in the request path.
+  - **Safety rails** — per-policy cooldowns, a GLOBAL action-rate
+    limiter (a flapping sensor must not become an action storm), and
+    the scaling bounds/hysteresis above. Suppressed decisions are
+    ledgered exactly like fired ones.
+  - **The action ledger** — every decision emits one
+    ``control_action`` journal event carrying the policy, action,
+    decision (``fired``/``failed``/``suppressed``), the triggering
+    verdict/event, ``role@seq`` evidence citations, suppress reason,
+    and cooldown state. Policies announce themselves with
+    ``control_policy_armed`` (trigger + deadline), so
+    ``tools/doctor.py --expect``'s ``remediation_audit`` pass can
+    prove — from the journal alone — that every action had a cause and
+    every armed verdict was remediated inside its deadline.
+  - **Probation** — a quarantine-style action may return a ``probe``
+    callable: the control plane probes each tick and fires the
+    ``readmit`` callable after ``ok_needed`` consecutive successes
+    (evict + probation + readmit-on-probe), ledgered as its own
+    ``control_action`` citing the original quarantine.
+
+``GET /healthz`` grows a ``control`` block (armed policies, recent
+actions, suppression counts) via ``health.register_control_provider``.
+
+Locking: decisions are computed under ``self._mu`` but every journal
+emit happens AFTER the lock is dropped (the ``ps.py _event_locked``
+discipline ``tools/lock_lint.py`` enforces) — actuators run outside
+the lock too, since they may call back into arbitrary runtime locks.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import journal as _journal
+from . import health as _health
+from .registry import registry as _registry
+
+__all__ = ["RemediationPolicy", "ScalingPolicy", "ControlPlane"]
+
+
+def _cite(e: Optional[dict], **extra) -> dict:
+    """One ``role@seq`` evidence citation for the ledger (the same
+    shape doctor's detectors emit, so audit chains are greppable)."""
+    out = {"role": None, "seq": None, "kind": None}
+    if e:
+        out = {"role": e.get("role"), "seq": e.get("seq"),
+               "kind": e.get("kind")}
+        for f in ("reason", "replica", "endpoint", "detail"):
+            if f in e:
+                out[f] = e[f]
+    out.update(extra)
+    return out
+
+
+class RemediationPolicy:
+    """One declarative verdict->action binding.
+
+    - ``trigger``: ``"verdict:<reason-prefix>"`` matches active
+      watchdog problems by reason prefix;``"event:<kind>"`` matches
+      new journal events by exact kind.
+    - ``action``: the actuator name the ledger records (the callable
+      itself is registered alongside via
+      ``ControlPlane.register_policy``).
+    - ``cooldown_s``: minimum spacing between fires of THIS policy
+      (re-triggers inside it are ledgered as suppressed).
+    - ``deadline_s``: the audit contract — a matching verdict with no
+      fired action within this window is an un-remediated verdict and
+      fails ``doctor --expect``.
+    """
+
+    def __init__(self, name: str, trigger: str, action: str,
+                 cooldown_s: float = 30.0, deadline_s: float = 60.0):
+        if not (trigger.startswith("verdict:")
+                or trigger.startswith("event:")):
+            raise ValueError(
+                "trigger must be 'verdict:<reason-prefix>' or "
+                "'event:<journal-kind>', got %r" % (trigger,))
+        self.name = name
+        self.trigger = trigger
+        self.action = action
+        self.cooldown_s = float(cooldown_s)
+        self.deadline_s = float(deadline_s)
+
+    @property
+    def kind(self) -> str:
+        return "verdict" if self.trigger.startswith("verdict:") \
+            else "event"
+
+    @property
+    def selector(self) -> str:
+        return self.trigger.split(":", 1)[1]
+
+    def describe(self) -> dict:
+        return {"policy": self.name, "trigger": self.trigger,
+                "action": self.action, "cooldown_s": self.cooldown_s,
+                "deadline_s": self.deadline_s}
+
+
+class ScalingPolicy:
+    """Router-driven autoscaling rails.
+
+    Pressure is the router's queue depth per healthy replica
+    (``ServingRouter.pressure()``). ``up_depth`` must exceed
+    ``down_depth`` — the gap IS the hysteresis band: inside it the
+    sustain clocks reset, so pressure oscillating around either
+    threshold can never flap the fleet. A scale decision additionally
+    requires the condition to hold for ``sustain_s`` continuously,
+    respects ``min_replicas``/``max_replicas`` (out-of-bounds wants
+    are ledgered as suppressed), and shares the global action-rate
+    limiter with every other policy."""
+
+    def __init__(self, name: str = "router_autoscale",
+                 up_depth: float = 8.0, down_depth: float = 1.0,
+                 sustain_s: float = 3.0, cooldown_s: float = 15.0,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 deadline_s: float = 120.0):
+        if not up_depth > down_depth:
+            raise ValueError(
+                "up_depth (%.3g) must exceed down_depth (%.3g) — the "
+                "gap is the hysteresis band" % (up_depth, down_depth))
+        if not 1 <= int(min_replicas) <= int(max_replicas):
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+        self.name = name
+        self.up_depth = float(up_depth)
+        self.down_depth = float(down_depth)
+        self.sustain_s = float(sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.deadline_s = float(deadline_s)
+
+    def describe(self) -> dict:
+        return {"policy": self.name, "trigger": "pressure",
+                "action": "scale", "cooldown_s": self.cooldown_s,
+                "deadline_s": self.deadline_s,
+                "up_depth": self.up_depth,
+                "down_depth": self.down_depth,
+                "sustain_s": self.sustain_s,
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas}
+
+
+class _ScalerState:
+    __slots__ = ("policy", "scaler", "above_since", "below_since",
+                 "ewma")
+
+    def __init__(self, policy, scaler):
+        self.policy = policy
+        self.scaler = scaler
+        self.above_since: Optional[float] = None
+        self.below_since: Optional[float] = None
+        self.ewma: Optional[float] = None
+
+
+class ControlPlane:
+    """The supervisor: subscribes to watchdog verdicts, journal events
+    and router pressure, and executes declarative policies through
+    registered actuators — every decision (including suppressed ones)
+    lands in the action ledger. ``start()`` runs the evaluation as a
+    daemon thread at ``interval_s``; tests drive ``tick()`` directly.
+
+    ``max_actions_per_min`` is the GLOBAL rate limiter across every
+    policy: a flapping sensor (or a mis-tuned rule) can at worst cost
+    that many actions per minute, never an action storm.
+
+    Actuators run SYNCHRONOUSLY on the evaluation thread — a
+    deliberate tradeoff: the ledger stays strictly ordered (one
+    decision fully executes and records before the next) at the cost
+    that one slow actuator delays the other policies' evaluation by
+    its runtime. Keep actuators bounded (the shipped ones are: an
+    in-process respawn is seconds, a subprocess spawn is bounded by
+    its startup timeout) and size ``deadline_s`` to cover the slowest
+    actuator that can run ahead of a policy's own."""
+
+    def __init__(self, watchdog=None, interval_s: float = 0.5,
+                 max_actions_per_min: int = 6,
+                 ledger_capacity: int = 256):
+        self._wd = watchdog
+        self.interval_s = float(interval_s)
+        self.max_actions_per_min = int(max_actions_per_min)
+        self._mu = threading.Lock()
+        self._policies: List = []         # (policy, actuator)
+        self._scalers: List[_ScalerState] = []
+        # trigger bookkeeping, all RECENCY-BOUNDED (the supervisor is
+        # the one process designed never to restart — no set may grow
+        # with uptime): keys are seq-monotonic, so oldest-first
+        # eviction is safe
+        self._handled = collections.OrderedDict()   # fired/failed
+        self._suppress_noted = collections.OrderedDict()
+        # event-trigger instances held back by a rail: the journal
+        # window has already moved past them, so they are retried
+        # from here each tick until they fire — a second replica
+        # dying inside the first one's cooldown must be remediated
+        # when the cooldown opens, not silently dropped
+        self._deferred = collections.OrderedDict()
+        # per-(policy, reason) high-water of handled verdict raises:
+        # when the raise event ages out of the bounded journal ring
+        # while the problem is still active, this (not the ring)
+        # proves the episode was already acted on — no duplicate
+        # remediation of an already-replaced component
+        self._last_raise_handled: Dict = {}
+        self._cooldowns: Dict[str, float] = {}
+        self._action_times: "collections.deque" = collections.deque()
+        self._probations: List[dict] = []
+        self._ledger: "collections.deque" = collections.deque(
+            maxlen=int(ledger_capacity))
+        self._counts = {"fired": 0, "failed": 0, "suppressed": 0}
+        # event triggers act on journal events AFTER this plane came
+        # up — history must never re-trigger remediation
+        self._last_seq = self._watermark()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._armed = False
+        self._was_stopped = False
+        self._m_actions = {
+            d: _registry().counter("control_actions_total", decision=d)
+            for d in ("fired", "failed", "suppressed")}
+
+    # -- arming -------------------------------------------------------
+    def register_policy(self, policy: RemediationPolicy,
+                        actuator: Callable[[dict], object]):
+        """Arm one remediation policy. ``actuator(ctx)`` runs OUTSIDE
+        the control-plane lock with ``ctx`` = {"policy", "reason",
+        "problem"?, "event"?}; its return value is ledgered (a dict
+        with a ``probe``/``readmit`` pair additionally enters
+        probation — see class docstring)."""
+        with self._mu:
+            self._policies.append((policy, actuator))
+        _journal.emit("control_policy_armed", **policy.describe())
+        return policy
+
+    def attach_scaler(self, scaler,
+                      policy: Optional[ScalingPolicy] = None):
+        """Arm autoscaling over a ``scaler`` duck: ``replica_count()``,
+        ``pressure()`` (or a router with one), ``scale_up()``,
+        ``scale_down()`` — ``tools/load_gen.FleetScaler`` is the
+        subprocess-fleet implementation."""
+        policy = policy or ScalingPolicy()
+        with self._mu:
+            self._scalers.append(_ScalerState(policy, scaler))
+        _journal.emit("control_policy_armed", **policy.describe())
+        return policy
+
+    def start(self):
+        """Arm the /healthz control block and start the daemon.
+        Re-startable: a stopped plane re-registers its provider."""
+        if not self._armed:
+            self._armed = True
+            # keep the exact bound-method object so stop() can tell
+            # OUR registration from another plane's
+            self._provider = self.control_block
+            _health.register_control_provider(self._provider)
+        if self._was_stopped:
+            # events from the stopped window are history, not
+            # triggers: whatever happened while the plane was down was
+            # handled by whoever ran the fleet then — the same
+            # "history never re-triggers" contract as construction
+            self._last_seq = self._watermark()
+            self._was_stopped = False
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = threading.Event()
+            # the loop gets ITS OWN stop event: stop()'s bounded join
+            # can expire while an actuator blocks a tick (a spawn can
+            # legitimately take ~2 min), and a zombie loop re-reading
+            # the rebound self._stop would never see its set flag —
+            # two concurrent planes racing every policy
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._stop,),
+                daemon=True, name="control-plane")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        self._armed = False
+        self._was_stopped = True
+        # only clear the /healthz provider if it is still OURS — a
+        # second plane's registration must survive this one's stop
+        if getattr(_health, "_CONTROL_PROVIDER", None) is \
+                getattr(self, "_provider", None):
+            _health.register_control_provider(None)
+
+    def _loop(self, stop):
+        last_err, repeats = None, 0
+        while not stop.wait(self.interval_s):
+            try:
+                self.tick()
+                if last_err is not None:
+                    _journal.emit("control_plane_error",
+                                  action="clear", repeats=repeats)
+                last_err, repeats = None, 0
+            except Exception as e:
+                # the control plane must never take the process down —
+                # but a plane that dies every tick must not die
+                # SILENTLY while /healthz still shows it armed: journal
+                # the error once per distinct failure (a permanent bug
+                # is one loud event, not a 2/s storm)
+                err = repr(e)
+                repeats += 1
+                if err != last_err:
+                    _journal.emit("control_plane_error",
+                                  action="raise", error=err)
+                    last_err = err
+
+    @staticmethod
+    def _watermark() -> int:
+        evs = _journal.events()
+        return evs[-1]["seq"] if evs else 0
+
+    def _watchdog(self):
+        if self._wd is None:
+            self._wd = _health.get_watchdog()
+        return self._wd
+
+    # -- evaluation ---------------------------------------------------
+    def tick(self) -> List[dict]:
+        """One full evaluation: verdict + event triggers, scaling,
+        probations. Returns the ledger records it produced (each also
+        emitted as a ``control_action`` journal event)."""
+        now = time.monotonic()
+        records: List[dict] = []
+        try:
+            problems = (self._watchdog().verdict() or {}).get(
+                "problems", [])
+        except Exception:
+            problems = []
+        new_events = _journal.events(since_seq=self._last_seq)
+        if new_events:
+            self._last_seq = new_events[-1]["seq"]
+        with self._mu:
+            policies = list(self._policies)
+            scalers = list(self._scalers)
+            deferred = list(self._deferred.items())
+        # the newest raise event per reason — the citation that makes
+        # an action's cause checkable against the raw record. Only
+        # verdict policies consume it: don't scan the whole journal
+        # ring several times a second on an event/scaling-only plane
+        raises: Dict[str, dict] = {}
+        if any(pol.kind == "verdict" for pol, _ in policies):
+            for e in _journal.events(kind="health"):
+                if e.get("action") == "raise":
+                    raises[e.get("reason")] = e
+        try:
+            # rail-held event instances first: their journal events are
+            # behind the window now, so this queue is their only way
+            # back
+            for key, (pol, act, reason, evidence, ctx) in deferred:
+                rec = self._decide(pol, act, key, reason, evidence,
+                                   ctx, now)
+                if rec is not None:
+                    records.append(rec)
+                with self._mu:
+                    if key in self._handled:
+                        self._deferred.pop(key, None)
+            for pol, act in policies:
+                for key, reason, evidence, ctx in self._instances(
+                        pol, problems, raises, new_events):
+                    rec = self._decide(pol, act, key, reason, evidence,
+                                       ctx, now)
+                    if rec is not None:
+                        records.append(rec)
+            for st in scalers:
+                records.extend(self._tick_scaler(st, now))
+            records.extend(self._tick_probations(now))
+        finally:
+            # ledger emits strictly AFTER all decision locks dropped —
+            # and even when a later phase raised: an action that RAN
+            # must reach the ledger, else it is the unexplained actor
+            # this module exists to forbid
+            for rec in records:
+                ev = _journal.emit("control_action", **rec)
+                if ev is not None:
+                    rec["seq"] = ev["seq"]
+                self._m_actions[rec["decision"]].inc()
+            with self._mu:
+                self._ledger.extend(records)
+                for rec in records:
+                    self._counts[rec["decision"]] += 1
+        return records
+
+    def _instances(self, pol, problems, raises, new_events):
+        """Trigger instances for one policy this tick:
+        [(dedup_key, reason, evidence, ctx)]."""
+        out = []
+        if pol.kind == "verdict":
+            for p in problems:
+                reason = str(p.get("reason", ""))
+                if not reason.startswith(pol.selector):
+                    continue
+                ev = raises.get(reason)
+                seq = ev["seq"] if ev else None
+                last = self._last_raise_handled.get(
+                    (pol.name, reason))
+                if last is not None and (seq is None
+                                         or seq <= last):
+                    # same episode: either this exact raise was
+                    # already acted on, or the raise aged out of the
+                    # journal ring while the problem stayed active —
+                    # never re-remediate an already-handled verdict
+                    continue
+                key = (pol.name, reason, seq)
+                out.append((key, reason,
+                            [_cite(ev, reason=reason)],
+                            {"policy": pol.name, "reason": reason,
+                             "problem": dict(p), "event": ev}))
+        else:
+            for e in new_events:
+                if e.get("kind") != pol.selector:
+                    continue
+                key = (pol.name, e["kind"], e["seq"])
+                out.append((key, e["kind"], [_cite(e)],
+                            {"policy": pol.name, "reason": e["kind"],
+                             "event": dict(e)}))
+        return out
+
+    @staticmethod
+    def _bounded_add(od, key, cap=4096):
+        """Insert into a recency-bounded OrderedDict; True when the
+        key was already present."""
+        if key in od:
+            return True
+        od[key] = True
+        while len(od) > cap:
+            od.popitem(last=False)
+        return False
+
+    def _rate_open_locked(self, now) -> bool:
+        while self._action_times and \
+                now - self._action_times[0] > 60.0:
+            self._action_times.popleft()
+        return len(self._action_times) < self.max_actions_per_min
+
+    def _decide(self, pol, act, key, reason, evidence, ctx, now):
+        """Safety rails for one trigger instance -> ledger record (or
+        None when this instance was already handled/noted)."""
+        with self._mu:
+            if key in self._handled:
+                return None
+            fired_at = self._cooldowns.get(pol.name)
+            cooling = fired_at is not None and \
+                now - fired_at < pol.cooldown_s
+            remaining = round(pol.cooldown_s - (now - fired_at), 3) \
+                if cooling else 0.0
+            rate_open = self._rate_open_locked(now)
+            if cooling or not rate_open:
+                why = "cooldown" if cooling else "rate_limit"
+                if pol.kind == "event":
+                    # the journal window has moved past this event:
+                    # park the instance for retry once the rail opens
+                    if key not in self._deferred:
+                        self._deferred[key] = (pol, act, reason,
+                                               evidence, ctx)
+                        while len(self._deferred) > 4096:
+                            self._deferred.popitem(last=False)
+                already = self._bounded_add(self._suppress_noted,
+                                            (key, why))
+                if already:
+                    return None
+            else:
+                prev_last = self._last_raise_handled.get(
+                    (pol.name, reason))
+                self._bounded_add(self._handled, key)
+                if pol.kind == "verdict":
+                    self._last_raise_handled[(pol.name, reason)] = \
+                        key[2] if key[2] is not None else -1
+                self._cooldowns[pol.name] = now
+                self._action_times.append(now)
+        if cooling or not rate_open:
+            return self._record(
+                pol.name, pol.action, "suppressed", reason, evidence,
+                suppress_reason="cooldown" if cooling else "rate_limit",
+                cooldown_remaining_s=remaining)
+        rec = self._run_action(pol.name, pol.action, act, reason,
+                               evidence, ctx)
+        if rec["decision"] == "failed":
+            # a FAILED remediation must stay remediable: un-handle the
+            # instance so it retries once the (already-consumed)
+            # cooldown reopens — bounded by the same rails as any
+            # action, each attempt ledgered. A permanently-failing
+            # actuator then shows up as failed records AND, past the
+            # policy deadline, as an un-remediated verdict in the
+            # audit — the correct signal, not silent abandonment.
+            with self._mu:
+                self._handled.pop(key, None)
+                if pol.kind == "verdict":
+                    if prev_last is None:
+                        self._last_raise_handled.pop(
+                            (pol.name, reason), None)
+                    else:
+                        self._last_raise_handled[(pol.name, reason)] \
+                            = prev_last
+                elif key not in self._deferred:
+                    self._deferred[key] = (pol, act, reason,
+                                           evidence, ctx)
+        return rec
+
+    def _run_action(self, policy, action, act, reason, evidence, ctx,
+                    **extra):
+        t0 = time.monotonic()
+        try:
+            result = act(ctx)
+            decision = "fired"
+        except Exception as e:
+            result, decision = {"error": repr(e)}, "failed"
+        took = round(time.monotonic() - t0, 4)
+        prob_err = None
+        if isinstance(result, dict) and callable(result.get("probe")):
+            # one probation per (policy, action, target): a re-fire for
+            # the same component RESTARTS its probation (fresh evidence,
+            # fresh clock) instead of appending a duplicate — the list
+            # is bounded by the registered policy set, not uptime.
+            # Actuators guarding several components under one policy
+            # disambiguate via result["target"].
+            try:
+                entry = {
+                    "key": (policy, action, result.get("target")),
+                    "policy": policy, "action": action,
+                    "reason": reason,
+                    "probe": result["probe"],
+                    "readmit": result.get("readmit"),
+                    "ok_needed": int(result.get("ok_needed", 3)),
+                    "deadline_s": float(
+                        result.get("probe_deadline_s", 600.0)),
+                    "started": t0,
+                    "oks": 0, "evidence": list(evidence)}
+            except Exception as e:
+                # the actuator already RAN — a malformed probation
+                # shape must not raise the record away (an executed but
+                # unledgered action is the exact thing this module
+                # forbids); ledger the action with the defect noted
+                prob_err = repr(e)
+            else:
+                with self._mu:
+                    self._probations = [
+                        p for p in self._probations
+                        if p["key"] != entry["key"]]
+                    self._probations.append(entry)
+        summary = result if isinstance(result, dict) else (
+            None if result is None else repr(result))
+        if isinstance(summary, dict):
+            summary = {k: v for k, v in summary.items()
+                       if not callable(v)}
+        return self._record(policy, action, decision, reason,
+                            evidence, result=summary,
+                            action_seconds=took,
+                            probation_error=prob_err, **extra)
+
+    @staticmethod
+    def _record(policy, action, decision, reason, evidence, **extra):
+        rec = {"policy": policy, "action": action,
+               "decision": decision, "reason": reason,
+               "evidence": list(evidence)}
+        rec.update({k: v for k, v in extra.items() if v is not None})
+        return rec
+
+    # -- scaling ------------------------------------------------------
+    def _clear_scaler_notes_locked(self, pol):
+        for d in ("up", "down"):
+            for w in ("bounds", "cooldown", "rate_limit"):
+                self._suppress_noted.pop((pol.name, d, w), None)
+
+    def _pressure(self, st) -> Optional[dict]:
+        scaler = st.scaler
+        try:
+            if hasattr(scaler, "pressure"):
+                p = scaler.pressure()
+            else:
+                p = scaler.router.pressure()
+        except Exception:
+            return None
+        return p if isinstance(p, dict) else {"depth_per_replica":
+                                              float(p)}
+
+    def _tick_scaler(self, st, now) -> List[dict]:
+        pol = st.policy
+        p = self._pressure(st)
+        if p is None:
+            return []
+        depth = float(p.get("depth_per_replica") or 0.0)
+        # rolling EWMA baseline: journalled with every decision so a
+        # reader can see what "normal" looked like when the plane acted
+        st.ewma = depth if st.ewma is None \
+            else 0.8 * st.ewma + 0.2 * depth
+        if depth >= pol.up_depth:
+            st.above_since = st.above_since or now
+            st.below_since = None
+            want = "up" if now - st.above_since >= pol.sustain_s \
+                else None
+        elif depth <= pol.down_depth and p.get("healthy", 1) != 0:
+            # healthy == 0 is a total outage, not idleness: the
+            # pressure fallback reads a drained pending count as "no
+            # load", and retiring recovery capacity mid-outage is the
+            # one move that can never be right — hold instead
+            st.below_since = st.below_since or now
+            st.above_since = None
+            want = "down" if now - st.below_since >= pol.sustain_s \
+                else None
+        else:
+            # the hysteresis band: both sustain clocks reset, and any
+            # suppression episode from the last excursion closes
+            st.above_since = st.below_since = None
+            with self._mu:
+                self._clear_scaler_notes_locked(pol)
+            return []
+        if want is None:
+            return []
+        try:
+            n = int(st.scaler.replica_count())
+        except Exception:
+            return []
+        reason = "router_pressure_high" if want == "up" \
+            else "router_pressure_low"
+        out_of_bounds = (want == "up" and n >= pol.max_replicas) or \
+                        (want == "down" and n <= pol.min_replicas)
+        if want == "down" and not out_of_bounds:
+            # an actuator that owns only part of the fleet (FleetScaler
+            # never retires the base replicas) exposes how many it can
+            # actually take back; "nothing retirable" is a bounds
+            # condition, NOT a failed action — firing anyway would burn
+            # the cooldown + a rate-limiter slot on a guaranteed
+            # failure, forever, on any idle fleet above min_replicas
+            rc = getattr(st.scaler, "retirable_count", None)
+            if callable(rc):
+                try:
+                    out_of_bounds = int(rc()) <= 0
+                except Exception:
+                    pass
+        with self._mu:
+            fired_at = self._cooldowns.get(pol.name)
+            cooling = fired_at is not None and \
+                now - fired_at < pol.cooldown_s
+            rate_open = self._rate_open_locked(now)
+            if out_of_bounds or cooling or not rate_open:
+                why = "bounds" if out_of_bounds else (
+                    "cooldown" if cooling else "rate_limit")
+                if self._bounded_add(self._suppress_noted,
+                                     (pol.name, want, why)):
+                    return []
+                suppressed = why
+            else:
+                suppressed = None
+                self._cooldowns[pol.name] = now
+                self._action_times.append(now)
+                st.above_since = st.below_since = None
+                self._clear_scaler_notes_locked(pol)
+        detail = dict(p, ewma_baseline=round(st.ewma, 4),
+                      threshold=pol.up_depth if want == "up"
+                      else pol.down_depth, replicas=n)
+        if suppressed is not None:
+            return [self._record(
+                pol.name, "scale_%s" % want, "suppressed", reason,
+                [_cite(None, reason=reason, pressure=detail)],
+                suppress_reason=suppressed)]
+        # the pressure signal is its own journal event, emitted BEFORE
+        # the action so the ledger's cause precedes its effect in seq
+        # order (and the audit has a verdict to chain to)
+        sig = _journal.emit("control_signal", reason=reason,
+                            policy=pol.name, **detail)
+        act = st.scaler.scale_up if want == "up" \
+            else st.scaler.scale_down
+        rec = self._run_action(
+            pol.name, "scale_%s" % want,
+            lambda _ctx: act(), reason,
+            [_cite(sig, reason=reason)], {"pressure": detail},
+            pressure=detail)
+        return [rec]
+
+    # -- probation ----------------------------------------------------
+    def _tick_probations(self, now) -> List[dict]:
+        with self._mu:
+            probs = list(self._probations)
+        out = []
+        done = []
+        for pr in probs:
+            if now - pr["started"] > pr["deadline_s"]:
+                # a component that never passes its probe must not pin
+                # a probation (and its per-tick probe cost) forever:
+                # give up loudly — the failed record IS the signal that
+                # the quarantined component needs a human after all
+                done.append(pr)
+                out.append(self._record(
+                    pr["policy"], "readmit:%s" % pr["action"],
+                    "failed", "probation_expired",
+                    list(pr["evidence"]),
+                    result={"error": "probe never passed within "
+                                     "%.0fs deadline" % pr["deadline_s"]},
+                    probes_ok=pr["oks"]))
+                continue
+            try:
+                ok = bool(pr["probe"]())
+            except Exception:
+                ok = False
+            pr["oks"] = pr["oks"] + 1 if ok else 0
+            if pr["oks"] < pr["ok_needed"]:
+                continue
+            done.append(pr)
+            decision = "fired"
+            result = None
+            if callable(pr.get("readmit")):
+                try:
+                    result = pr["readmit"]()
+                except Exception as e:
+                    result, decision = {"error": repr(e)}, "failed"
+            out.append(self._record(
+                pr["policy"], "readmit:%s" % pr["action"], decision,
+                "probation_passed", list(pr["evidence"]),
+                result=result if isinstance(result, dict)
+                else (None if result is None else repr(result)),
+                probes_ok=pr["ok_needed"]))
+        if done:
+            with self._mu:
+                self._probations = [p for p in self._probations
+                                    if p not in done]
+        return out
+
+    # -- introspection ------------------------------------------------
+    def ledger(self) -> List[dict]:
+        with self._mu:
+            return list(self._ledger)
+
+    def control_block(self) -> dict:
+        """The ``/healthz`` ``control`` block: what is armed, what
+        recently happened, what was held back."""
+        with self._mu:
+            armed = [p.describe() for p, _ in self._policies] \
+                + [s.policy.describe() for s in self._scalers]
+            recent = [
+                {k: r.get(k) for k in ("policy", "action", "decision",
+                                       "reason", "suppress_reason",
+                                       "seq")}
+                for r in list(self._ledger)[-8:]]
+            counts = dict(self._counts)
+            probations = [{"policy": p["policy"],
+                           "action": p["action"], "oks": p["oks"],
+                           "ok_needed": p["ok_needed"]}
+                          for p in self._probations]
+            in_window = len(self._action_times)
+        return {"armed_policies": armed, "recent_actions": recent,
+                "counts": counts, "probations": probations,
+                "rate_limiter": {"max_per_min": self.max_actions_per_min,
+                                 "in_window": in_window}}
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -62,7 +62,7 @@ from .registry import MetricsRegistry, registry
 __all__ = ["Beacon", "beacon", "beacons_snapshot", "HealthRule",
            "Watchdog", "FlightRecorder", "get_watchdog",
            "get_recorder", "set_blackbox_dir", "arm_process",
-           "default_rules", "healthz"]
+           "default_rules", "healthz", "register_control_provider"]
 
 ENV_BLACKBOX_DIR = "PADDLE_TPU_BLACKBOX_DIR"
 
@@ -902,19 +902,47 @@ def arm_process(role: Optional[str] = None,
     return wd, rec
 
 
+# the control plane (observability/control.py) registers its
+# control_block() here so /healthz can show WHAT IS ACTING on this
+# process next to what is being watched — armed policies, recent
+# ledger entries, suppression counts
+_CONTROL_PROVIDER: Optional[Callable[[], dict]] = None
+
+
+def register_control_provider(fn: Optional[Callable[[], dict]]):
+    """Install (or with ``None`` clear) the callable whose dict lands
+    in the ``control`` block of every ``healthz()`` payload."""
+    global _CONTROL_PROVIDER
+    _CONTROL_PROVIDER = fn
+    return fn
+
+
+def _attach_control(verdict: dict) -> dict:
+    prov = _CONTROL_PROVIDER
+    if prov is not None:
+        try:
+            verdict["control"] = prov()
+        except Exception:
+            verdict["control"] = {"error": "control provider raised"}
+    return verdict
+
+
 def healthz():
     """The ``GET /healthz`` payload: (http_status, verdict_dict).
     200 while healthy/degraded (degraded is advisory — the process is
     making progress), 503 on an unhealthy verdict, and 200/"unknown"
     when no watchdog was ever armed in this process (nothing is
-    watching, which is itself worth surfacing to the scraper)."""
+    watching, which is itself worth surfacing to the scraper). When a
+    control plane is armed the payload grows a ``control`` block
+    (armed policies, recent actions, suppressions)."""
     wd = _WATCHDOG
     if wd is None:
-        return 200, {"state": "unknown",
-                     "role": _journal.get_role(),
-                     "detail": "no watchdog armed in this process"}
+        return 200, _attach_control(
+            {"state": "unknown",
+             "role": _journal.get_role(),
+             "detail": "no watchdog armed in this process"})
     # rules=False: a scrape re-checks the stall watches (cheap,
     # idempotent) but must not feed rule windows/baselines — external
     # probe frequency must never change detection sensitivity
-    v = wd.check_now(rules=False)
+    v = _attach_control(wd.check_now(rules=False))
     return (503 if v["state"] == "unhealthy" else 200), v

@@ -217,6 +217,18 @@ class MetricsRegistry:
     def histogram(self, name: str, buckets=None, **labels) -> Histogram:
         return self._get("histogram", name, labels, buckets=buckets)
 
+    def remove_series(self, name: str, **labels):
+        """Drop one labeled series (any kind) from the export set —
+        for per-instance series whose instance is gone for good (a
+        scaled-down replica's queue-depth gauge): under churn, zeroing
+        alone leaves the registry growing one dead series per retired
+        instance forever. The detached metric object stays safe to
+        write; it just no longer exports."""
+        lk = _label_key(labels)
+        with self._mu:
+            for kind in self._KINDS:
+                self._metrics.pop((kind, name, lk), None)
+
     # -- enable/disable (the bench stub) ------------------------------
     def set_enabled(self, on: bool):
         self._enabled = bool(on)

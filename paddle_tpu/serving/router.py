@@ -149,6 +149,7 @@ class _Replica:
         self.cfg = cfg
         self.mu = threading.Lock()
         self.healthy = True
+        self.retired = False   # scale-down: health loop exits, no evict
         self.last_ok = time.monotonic()
         self.queue_depth = 0
         self.ewma_ms: Optional[float] = None
@@ -175,7 +176,15 @@ class _Replica:
 
     def release(self, client: RPCClient):
         with self.mu:
-            self._free.append(client)
+            if not self.retired:
+                self._free.append(client)
+                return
+        # scale-down raced an in-flight dispatch: the pool is gone,
+        # close instead of parking the socket on a dead replica view
+        try:
+            client.close()
+        except Exception:
+            pass
 
     def close_clients(self):
         with self.mu:
@@ -189,12 +198,17 @@ class _Replica:
     # -- load/lease ----------------------------------------------------
     def mark_ok(self, load: Optional[dict]):
         with self.mu:
+            # ordered against remove_replica's retire+zero under the
+            # same lock: a probe reply landing mid-retire must not
+            # resurrect the gauge with the last live depth forever
+            if self.retired:
+                return
             self.last_ok = time.monotonic()
             if load:
                 self.queue_depth = int(load.get("queue_depth") or 0)
                 if load.get("ewma_ms") is not None:
                     self.ewma_ms = float(load["ewma_ms"])
-        self._gauge.set(self.queue_depth)
+            self._gauge.set(self.queue_depth)
 
     def score(self):
         with self.mu:
@@ -312,12 +326,113 @@ class ServingRouter:
         # healthy replica's probe behind a dead one's connect stall)
         self._hb_stop = threading.Event()
         self._hb_threads = []
+        self._next_rid = len(self._replicas)
         for r in self._replicas:
-            t = threading.Thread(target=self._health_loop, args=(r,),
-                                 daemon=True,
-                                 name="router-health-%d" % r.id)
-            t.start()
-            self._hb_threads.append(t)
+            self._start_health_thread(r)
+
+    def _start_health_thread(self, r: "_Replica"):
+        # prune exited monitors (retired replicas) so autoscale churn
+        # can't grow this list for the life of the router
+        self._hb_threads = [t for t in self._hb_threads
+                            if t.is_alive()]
+        t = threading.Thread(target=self._health_loop, args=(r,),
+                             daemon=True,
+                             name="router-health-%d" % r.id)
+        t.start()
+        self._hb_threads.append(t)
+
+    # -- dynamic membership (control-plane scale actuation) -----------
+    def add_replica(self, endpoint: str) -> int:
+        """Admit one more replica endpoint into dispatch (the
+        autoscaler's scale-up actuator; observability/control.py).
+        Returns the new replica id. Grouped routers don't scale — a
+        group is a mesh, not a unit you add one endpoint to."""
+        if self._groups is not None:
+            raise InvalidRequest(
+                "add_replica on a grouped router (group_size=%d) — "
+                "scale whole groups via spawn_fleet instead"
+                % self.config.group_size)
+        if self._stopped:
+            raise EngineStopped("router is shut down")
+        with self._mu:
+            rid = self._next_rid
+            self._next_rid += 1
+        # construct outside the lock (the replica view registers a
+        # registry gauge), then admit with an atomic list swap so
+        # dispatch readers never see a torn list
+        r = _Replica(rid, endpoint, self.config)
+        with self._mu:
+            self._replicas = self._replicas + [r]
+        self._start_health_thread(r)
+        _obs.emit("replica_added", replica=rid, endpoint=endpoint,
+                  replicas=len(self._replicas))
+        return rid
+
+    def remove_replica(self, rid: int) -> dict:
+        """Retire one replica from dispatch (scale-down actuator):
+        new requests stop landing on it immediately; in-flight ones
+        finish (inference is read-only). Returns its final snapshot
+        so the caller can reap the process behind it."""
+        if self._groups is not None:
+            raise InvalidRequest("remove_replica on a grouped router")
+        with self._mu:
+            r = next((x for x in self._replicas if x.id == rid), None)
+            if r is None:
+                raise InvalidRequest("no replica %d to remove" % rid)
+            self._replicas = [x for x in self._replicas
+                              if x.id != rid]
+        with r.mu:
+            r.retired = True
+            r.healthy = False
+            # zero AND drop the gauge series: a retired replica must
+            # not export its last live depth, and under respawn/scale
+            # churn (monotonic rids) dead series would otherwise
+            # accumulate in the registry forever. Under r.mu so it
+            # cannot race a mark_ok mid-probe (which checks `retired`
+            # under the same lock; the detached object is write-safe)
+            r._gauge.set(0)
+            _obs.registry().remove_series(
+                "router_replica_queue_depth", replica=str(rid))
+        snap = r.snapshot()
+        r.close_clients()
+        _obs.emit("replica_retired", replica=rid,
+                  endpoint=r.endpoint,
+                  replicas=len(self._replicas))
+        return snap
+
+    def _replica_by_id(self, rid: int) -> "_Replica":
+        r = next((x for x in self._replicas if x.id == rid), None)
+        if r is None:
+            raise InvalidRequest("no replica %d" % rid)
+        return r
+
+    # -- pressure tap (control-plane autoscaling sensor) --------------
+    def pressure(self) -> dict:
+        """The autoscaler's sensor: live queue/latency pressure over
+        the HEALTHY dispatch set. ``depth_per_replica`` is the scaling
+        signal (reported batcher depth + local in-flight, averaged
+        over healthy replicas); p99 comes from the replicas' recent
+        latency windows."""
+        healthy = self._healthy()
+        depth = 0
+        lat = []
+        for r in healthy:
+            with r.mu:
+                depth += r.queue_depth + r.inflight
+                lat.extend(list(r.lat_ms)[-256:])
+        arr = np.asarray(lat)
+        with self._mu:
+            pending = self._pending
+        return {
+            "replicas": len(self._replicas),
+            "healthy": len(healthy),
+            "queue_depth": depth,
+            "depth_per_replica": round(depth / len(healthy), 4)
+            if healthy else float(pending),
+            "pending": pending,
+            "p99_ms": round(float(np.percentile(arr, 99)), 3)
+            if arr.size else None,
+        }
 
     # -- dispatch ------------------------------------------------------
     def _healthy(self) -> List[_Replica]:
@@ -490,6 +605,8 @@ class ServingRouter:
         client = None
         interval = self.config.heartbeat_interval_s
         while not self._hb_stop.wait(interval):
+            if r.retired:
+                break  # scale-down: probe loop ends with the replica
             beat += 1
             try:
                 if client is None:
@@ -514,8 +631,15 @@ class ServingRouter:
                     except Exception:
                         pass
                 r.mark_ok(load)
-                if not r.healthy:
-                    r.healthy = True
+                with r.mu:
+                    # atomic vs remove_replica's retire: a heartbeat
+                    # that raced the retire must not flip the replica
+                    # back healthy and forge a replica_readmitted for
+                    # a component that just left the fleet
+                    readmit = not r.healthy and not r.retired
+                    if readmit:
+                        r.healthy = True
+                if readmit:
                     _obs.emit("replica_readmitted", replica=r.id,
                               endpoint=r.endpoint)
                     self._note_group_transition(r)
@@ -589,7 +713,8 @@ class ServingRouter:
         return out
 
     def replica_stats(self, rid: int) -> dict:
-        return self._ctrl(self._replicas[rid], {"op": "stats"})["stats"]
+        return self._ctrl(self._replica_by_id(rid),
+                          {"op": "stats"})["stats"]
 
     # -- versioned hot-swap -------------------------------------------
     def swap_model(self, model_dir: str, model: str = "default",

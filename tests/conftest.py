@@ -88,6 +88,14 @@ def pytest_configure(config):
         "markers",
         "sparse: tiered sparse embedding plane test (tier-1; select "
         "alone with -m sparse)")
+    # closed-loop control-plane suite (observability/control.py:
+    # policies, safety rails, ledger, autoscaling, doctor audit):
+    # rail units are in-memory-fast; the subprocess/scenario cases
+    # also carry -m chaos
+    config.addinivalue_line(
+        "markers",
+        "control: closed-loop control-plane test (tier-1; select "
+        "alone with -m control)")
 
 
 @pytest.fixture(autouse=True)

@@ -4,6 +4,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers, profiler
@@ -138,6 +139,10 @@ def test_disabled_profiler_records_nothing():
     assert "should_not_appear" not in table
 
 
+# tier-1 wall-time headroom (ISSUE 15): ~10 s spent to reach this
+# platform's quarantine skip (jax emits no device events here) — the
+# slow tier keeps it for platforms where the capture works
+@pytest.mark.slow
 def test_device_trace_merged_into_timeline(tmp_path):
     """Host RecordEvents and XLA device-op events land in ONE chrome
     trace (separate pid tracks) and the per-op device table reports

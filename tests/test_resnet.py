@@ -3,6 +3,7 @@ test_parallel_executor_seresnext / book image_classification — assert
 the model builds and the loss decreases on synthetic data)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers, optimizer
@@ -139,6 +140,9 @@ def test_vgg16_cifar_forward():
     np.testing.assert_allclose(pred_v.sum(axis=1), 1.0, rtol=1e-4)
 
 
+# tier-1 wall-time headroom (ISSUE 15): ~21 s architecture-variant
+# smoke; resnet50_s2d + the other conv nets keep the class in tier-1
+@pytest.mark.slow
 def test_se_resnext50_trains():
     """SE-ResNeXt-50 (reference benchmark/fluid/models/se_resnext.py):
     group-conv bottlenecks + SE gates build, train a step, and the

@@ -41,7 +41,12 @@ def test_matches_full_attention(qkv, impl, causal):
                                atol=2e-5, rtol=2e-5)
 
 
-@pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
+@pytest.mark.parametrize("impl", [
+    # tier-1 wall-time headroom (ISSUE 15): ring grads cost ~17 s and
+    # the ring forward variants + routed trained-through equality in
+    # test_model_parallel stay tier-1 — the slow tier keeps the grads
+    pytest.param(ring_attention, marks=pytest.mark.slow),
+    ulysses_attention])
 def test_gradients_match(qkv, impl):
     q, k, v = qkv
     mesh = _sp_mesh(4)
@@ -138,11 +143,11 @@ def test_ring_flash_applicable_at_long_seq():
     assert not R.applicable(2, 8, 16, 16, 16, 4)
 
 
-# tier-1 wall-time headroom (ISSUE 14): the causal=False twin adds
-# ~18 s for the same flash body (only the mask leg differs) — the
-# slow tier keeps it
-@pytest.mark.parametrize("causal", [
-    pytest.param(False, marks=pytest.mark.slow), True])
+# tier-1 wall-time headroom (ISSUE 14/15): both S=1024 flash twins
+# (~24 s + ~18 s) live in the slow tier — the shorter ring-flash
+# bf16 + matches_full_attention variants keep the class in tier-1
+@pytest.mark.slow
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_matches_full_attention_s1024(rng, causal):
     """8 real ring hops at S=1024: the flash body (scores in VMEM)
     must reproduce full attention — the VERDICT r4 long-context
@@ -207,6 +212,10 @@ def test_ring_flash_bfloat16(rng):
 
 # --- zigzag (load-balanced causal) ring ------------------------------------
 
+# tier-1 wall-time headroom (ISSUE 15): ~27 s; the zigzag path stays
+# tier-1-covered by test_model_parallel's routed trained-through
+# equality (test_causal_no_bias_routes_zigzag)
+@pytest.mark.slow
 def test_zigzag_matches_full_attention(rng):
     from paddle_tpu.parallel.zigzag import zigzag_attention
     q, k, v = _long_qkv(rng, S=1024)

@@ -240,18 +240,26 @@ class TestRouterDispatch:
             for t in ths:
                 t.join()
             st = router.stats()
-            return (np.percentile(lat, 99),
+            return (np.asarray(lat),
                     {rid: s["requests"]
                      for rid, s in st["replicas"].items()})
 
-        p99_ll, served_ll = run("least_loaded")
-        p99_rr, served_rr = run("round_robin")
+        lat_ll, served_ll = run("least_loaded")
+        lat_rr, served_rr = run("round_robin")
         # round-robin splits ~50/50 by construction; least-loaded
         # must route most traffic to the fast replica...
         assert served_ll["1"] > served_ll["0"]
         assert served_ll["1"] >= 0.6 * sum(served_ll.values())
-        # ...and that shows up as a better tail
-        assert p99_ll < p99_rr
+        # ...and that shows up as better latency. MEAN, not p99: with
+        # 24 samples p99 is effectively the max, and even least-loaded
+        # tie-breaks its first request(s) onto the slow replica, so
+        # BOTH policies' maxima sit near that replica's 80 ms floor —
+        # the old p99 A/B decided on sub-1% scheduler noise and flaked
+        # on loaded boxes (fails on the clean tree too). The mean
+        # carries the routing signal the test is about; the bench's
+        # p99-under-skew claim lives in serving_fleet_scaling with
+        # real sample counts.
+        assert float(lat_ll.mean()) < float(lat_rr.mean())
 
     def test_all_replicas_saturated_sheds_structured(self, fleet):
         router, reps = fleet(

@@ -44,7 +44,11 @@ __all__ = ["load_rounds", "diff", "format_report"]
 
 # explicit higher-is-better override, checked FIRST: cache hit rates
 # and throughputs whose unit strings would otherwise trip the
-# lower-is-better heuristic below (e.g. "hit fraction")
+# lower-is-better heuristic below (e.g. "hit fraction"). The PR 15
+# metrics need no new entries — "qps" already covers
+# qps_under_autoscale (name AND unit), and remediation_recovery is
+# lower-is-better by both its "recovery" name and "seconds" unit —
+# but both directions are pinned by tests/test_control.py.
 _HIGHER_IS_BETTER = re.compile(
     r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps"
     r"|rows/s)",

@@ -32,6 +32,13 @@ python tools/chaos_run.py --distributed --scenario serving_kill
 # causally-ordered event journal (snapshot + recovery evidence)
 python tools/chaos_run.py --distributed --scenario restart_2x2_obs
 
+# the CLOSED-LOOP acceptance scenario: replica SIGKILL + wedged
+# batcher + flaky pserver under live load, remediated human-free by
+# the armed ControlPlane; --verdict doctor additionally requires the
+# remediation audit to NAME every action's verdict chain
+python tools/chaos_run.py --distributed --scenario control_loop \
+    --verdict doctor
+
 Exit code: 0 when the run completes and (with --check) the final loss
 is within --rtol of the fault-free twin (distributed: every scenario's
 verdict ok); 1 otherwise.
@@ -224,6 +231,10 @@ DOCTOR_EXPECT = {
     "restart_2x2_obs": ("pserver_restart",),
     "serving_kill": ("replica_failure",),
     "sparse_restart": ("pserver_restart",),
+    # three concurrent faults: the wedged batcher's stall verdict
+    # outranks the rest; replica_failure is acceptable when eviction
+    # evidence dominates an unlucky interleaving
+    "control_loop": ("hang", "replica_failure"),
 }
 
 
@@ -242,11 +253,19 @@ def _doctor_verdict(scenario, events=None, journal_path=None):
                 "expected": list(DOCTOR_EXPECT.get(scenario, ()))}
     expect = DOCTOR_EXPECT.get(scenario, ())
     d0 = rep["diagnoses"][0] if rep["diagnoses"] else None
-    return {"top": rep["top"], "expected": list(expect),
-            "match": rep["top"] in expect,
-            "summary": d0 and d0["summary"],
-            "evidence": d0 and d0["evidence"][:6],
-            "ranked": [d["name"] for d in rep["diagnoses"]]}
+    out = {"top": rep["top"], "expected": list(expect),
+           "match": rep["top"] in expect,
+           "summary": d0 and d0["summary"],
+           "evidence": d0 and d0["evidence"][:6],
+           "ranked": [d["name"] for d in rep["diagnoses"]]}
+    if rep.get("remediation") is not None:
+        # a control plane ran: surface its audited action->cause
+        # chains, and fold the audit into the match (an unexplained
+        # action or un-remediated verdict fails the scenario exactly
+        # like a wrong diagnosis)
+        out["remediation"] = rep["remediation"]
+        out["match"] = out["match"] and rep["remediation"]["ok"]
+    return out
 
 
 def _journal_events_since(mark):
@@ -838,6 +857,305 @@ def _scenario_serving_kill(args):
             "merged_trace": merged_path}
 
 
+def _scenario_control_loop(args):
+    """The CLOSED-LOOP acceptance scenario (docs/observability.md §6):
+    three concurrent faults under live load — a serving replica
+    SIGKILLed, a second replica's batcher wedged mid-dispatch, and a
+    pserver's wire flaked — with a ControlPlane armed and NO
+    human/test-driver remediation anywhere: the supervisor must
+    respawn both replicas (event:replica_evicted and
+    verdict:stall:serving_batcher policies), quarantine the pserver's
+    eviction authority on the network_flaky verdict and readmit it
+    through probation probes, while every client future resolves and
+    the trainer finishes every step un-evicted. The verdict then
+    requires doctor's ``remediation_audit`` to NAME each automated
+    action with its triggering verdict (zero unexplained actions,
+    zero un-remediated verdicts) from the journal alone."""
+    import threading
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import (ParameterServerRuntime,
+                                        PServerRuntime)
+    from paddle_tpu.distributed.ps import INCARNATION_KEY
+    from paddle_tpu.distributed.rpc import RPCClient
+    from paddle_tpu.observability import (ControlPlane, HealthRule,
+                                          RemediationPolicy)
+    from paddle_tpu.resilience import NetFaultProxy, RetryPolicy
+    from paddle_tpu.serving import (RouterConfig, ServingConfig,
+                                    ServingError, ServingReplica,
+                                    ServingRouter)
+    import doctor
+    import load_gen
+
+    workdir = tempfile.mkdtemp(prefix="chaos-control-")
+    journal_path = os.path.join(workdir, "events.jsonl")
+    obs.configure_journal(journal_path)
+
+    model_dir = load_gen.build_synthetic_model(
+        os.path.join(workdir, "model"))
+    cfg = ServingConfig(max_batch_size=8, max_queue_wait_us=500,
+                        hang_deadline_s=1.5)
+    live = {}   # router rid -> in-process ServingReplica
+    retired = []
+    next_id = [3]
+    for i in range(3):
+        live[i] = ServingReplica(model_dir, cfg, replica_id=i).start()
+    router = ServingRouter(
+        [live[i].endpoint for i in range(3)],
+        RouterConfig(lease_timeout_s=1.0, heartbeat_interval_s=0.1,
+                     rpc_deadline_s=3.0, connect_timeout_s=3.0,
+                     max_retries=5))
+
+    # PS leg: 1 trainer x 1 pserver through a 20%-drop proxy, leases
+    # armed — the flaky wire is exactly what could falsely evict the
+    # healthy trainer, which is what quarantine suspends (the lease
+    # is long enough that a false eviction needs ~30 consecutive
+    # dropped beats, so the pre-quarantine window stays safe and the
+    # run is seed-stable)
+    t, start, loss = _dist_build(args.seed, 1)
+    server = PServerRuntime(t, t.pserver_endpoints[0],
+                            lease_timeout_s=3.0, allow_degraded=True)
+    proxy = NetFaultProxy(server.serv.endpoint, seed=args.seed)
+    proxy.set_drop_rate(0.20)
+    t.set_block_endpoints(server._minis.keys(), proxy.endpoint)
+    server.serv.start()
+
+    wd = obs.get_watchdog()
+    flaky_rule = HealthRule.rate_above(
+        "network_flaky", "rpc_reconnects_total", per_s=0.2,
+        window_s=6.0)
+    wd.add_rule(flaky_rule)
+    wd.start()
+
+    # -- actuators (the supervisor's hands; policy owns the WHEN) ----
+    def find_wedged_rid():
+        now = time.monotonic()
+        for rid, rep in list(live.items()):
+            for w in rep.engine._workers.values():
+                _count, t_last = w._beacon.read()
+                if w.queue_depth() > 0 and now - t_last > 1.0:
+                    return rid
+        return None
+
+    def restart_replica(ctx):
+        ev = ctx.get("event") or {}
+        rid = ev.get("replica")
+        if rid is None:
+            rid = find_wedged_rid()
+        if rid is None:
+            # no identifiable victim (queue momentarily empty, or a
+            # racing fire already replaced it): spawning anyway would
+            # GROW the fleet past the scenario's 3 and the convergence
+            # check could never pass — the no-op still fires (it cites
+            # the verdict for the audit), it just touches nothing
+            return {"ok": True, "noop": "no_victim"}
+        old = live.pop(rid, None)
+        try:
+            router.remove_replica(rid)
+        except ServingError:
+            pass
+        if old is not None:
+            # the replaced component's stall watches retire with it —
+            # the zombie engine must not keep the process unhealthy
+            for w in list(old.engine._workers.values()):
+                w._unwatch()
+            retired.append(old)
+        k = next_id[0]
+        next_id[0] += 1
+        rep = ServingReplica(model_dir, cfg, replica_id=k).start()
+        new_rid = router.add_replica(rep.endpoint)
+        live[new_rid] = rep
+        return {"ok": True, "replaced": rid, "new_replica": new_rid,
+                "endpoint": rep.endpoint}
+
+    def probe_pserver():
+        c = RPCClient(server.serv.endpoint, timeout_s=1.0,
+                      deadline_s=1.0)
+        try:
+            c.call("GET", INCARNATION_KEY)
+            return True
+        except Exception:
+            return False
+        finally:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def quarantine_pserver(_ctx):
+        server.serv.quarantine(reason="network_flaky verdict")
+        return {"ok": True, "endpoint": server.serv.endpoint,
+                "probe": probe_pserver,
+                "readmit": lambda: (server.serv.readmit() and None)
+                or {"ok": True}, "ok_needed": 3}
+
+    cp = ControlPlane(watchdog=wd, interval_s=0.2,
+                      max_actions_per_min=12)
+    cp.register_policy(RemediationPolicy(
+        "respawn_dead_replica", "event:replica_evicted",
+        "restart_replica", cooldown_s=1.0, deadline_s=30.0),
+        restart_replica)
+    cp.register_policy(RemediationPolicy(
+        "restart_wedged_batcher", "verdict:stall:serving_batcher",
+        "restart_replica", cooldown_s=1.0, deadline_s=30.0),
+        restart_replica)
+    cp.register_policy(RemediationPolicy(
+        "quarantine_flaky_pserver", "verdict:network_flaky",
+        "quarantine_pserver", cooldown_s=10.0, deadline_s=60.0),
+        quarantine_pserver)
+    cp.start()
+
+    # -- load + faults -----------------------------------------------
+    duration_s = max(8.0, 2.0 * args.steps)
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_ms, structured, hung, unstructured = [], [], [], []
+    seeds = [100]
+
+    def client():
+        with lock:
+            seeds[0] += 1
+            rng = np.random.RandomState(seeds[0])
+        while not stop.is_set():
+            feed = {"x": rng.rand(int(rng.randint(1, 5)),
+                                  64).astype(np.float32)}
+            t0 = time.monotonic()
+            try:
+                router.infer_sync(feed, timeout=60)
+                with lock:
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+            except ServingError as e:
+                with lock:
+                    structured.append(e.code)
+            except Exception as e:
+                name = type(e).__name__
+                with lock:
+                    (hung if "Timeout" in name
+                     else unstructured).append(repr(e))
+
+    trainer_done = {}
+
+    def run_trainer():
+        try:
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = ParameterServerRuntime(
+                t, t.get_trainer_program(), scope, trainer_id=0,
+                deadline_s=2.0, connect_timeout_s=20.0,
+                heartbeat_interval_s=0.1, phase_retries=6,
+                retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                                  max_delay=0.2, seed=args.seed))
+            rt.init_params()
+            out = []
+            for f in _dist_feeds(args.seed, args.steps * 3):
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.complete()
+            trainer_done["losses"] = out
+        except Exception as e:
+            trainer_done["error"] = repr(e)
+
+    t_start = time.monotonic()
+    ths = [threading.Thread(target=client) for _ in range(4)]
+    for th in ths:
+        th.start()
+    tr = threading.Thread(target=run_trainer)
+    tr.start()
+
+    time.sleep(duration_s * 0.2)
+    live[0].crash()          # fault 1: SIGKILL stand-in
+    time.sleep(duration_s * 0.1)
+    hold = threading.Event()  # fault 2: wedge replica 1's batcher
+
+    def wedge(w, batch):
+        hold.wait()
+
+    for w in live[1].engine._workers.values():
+        w._dispatch_hook = wedge
+    # fault 3 (pserver flake) is the 20% drop proxy, already live
+    time.sleep(max(0.0, duration_s - (time.monotonic() - t_start)))
+    stop.set()
+    for th in ths:
+        th.join(timeout=90)
+    tr.join(timeout=150)
+    # convergence, not a snapshot: the plane stays armed and we WAIT
+    # (bounded) for it to finish — a respawn mid-warmup or a probation
+    # still probing when the load stops is the loop working, not a
+    # failure. Still zero test-driver remediation: we only watch.
+    def _converged():
+        fired_now = [r for r in cp.ledger()
+                     if r["decision"] == "fired"]
+        return (len(router._healthy()) == 3
+                and len([r for r in fired_now
+                         if r["action"] == "restart_replica"]) >= 2
+                and any(r["action"] == "readmit:quarantine_pserver"
+                        for r in fired_now))
+
+    settle_deadline = time.monotonic() + 60.0
+    while not _converged() and time.monotonic() < settle_deadline:
+        time.sleep(0.25)
+    elapsed = time.monotonic() - t_start
+
+    healthy_end = len(router._healthy())
+    ledger = cp.ledger()
+    cp.stop()
+    hold.set()               # unstick the zombie batcher for teardown
+    wd.remove_rule(flaky_rule)
+    router.shutdown()
+    for rep in list(live.values()) + retired:
+        try:
+            rep.engine.shutdown(drain=False, timeout=5)
+            rep.server.shutdown()
+        except Exception:
+            pass
+    server.serv.shutdown()
+    proxy.close()
+    obs.configure_journal(None)
+
+    events = obs.read_journal(journal_path)
+    audit = doctor.remediation_audit(events)
+    fired = [r for r in ledger if r["decision"] == "fired"]
+    fired_actions = sorted({r["action"] for r in fired})
+    evicted_trainers = [e for e in events
+                        if e["kind"] == "trainer_evicted"]
+    quarantined = any(e["kind"] == "pserver_quarantined"
+                      for e in events)
+    readmitted = any(e["kind"] == "pserver_readmitted"
+                     for e in events)
+    restarts = [r for r in fired if r["action"] == "restart_replica"]
+    ok = (not hung and not unstructured and len(lat_ms) > 0
+          and healthy_end == 3
+          and len(restarts) >= 2
+          and quarantined and readmitted
+          and not evicted_trainers
+          and "losses" in trainer_done
+          and audit is not None and audit["ok"]
+          and len(audit["chains"]) >= 3
+          and elapsed < 240.0)
+    return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "doctor": _doctor_verdict("control_loop", events=events),
+            "completed": len(lat_ms),
+            "structured_errors": sorted(set(structured)),
+            "hung": hung[:3], "unstructured": unstructured[:3],
+            "healthy_replicas_end": healthy_end,
+            "actions_fired": fired_actions,
+            "restarts": len(restarts),
+            "pserver_quarantined": quarantined,
+            "pserver_readmitted": readmitted,
+            "trainer": {"steps": len(trainer_done.get("losses", [])),
+                        "error": trainer_done.get("error")},
+            "trainer_evictions": len(evicted_trainers),
+            "audit_ok": audit is not None and audit["ok"],
+            "action_chains": audit["chains"] if audit else None,
+            "unexplained": audit["unexplained"] if audit else None,
+            "unremediated": audit["unremediated"] if audit else None}
+
+
 DIST_SCENARIOS = {
     "pserver_restart": _scenario_pserver_restart,
     "trainer_kill": _scenario_trainer_kill,
@@ -845,6 +1163,7 @@ DIST_SCENARIOS = {
     "restart_2x2_obs": _scenario_restart_2x2_obs,
     "serving_kill": _scenario_serving_kill,
     "sparse_restart": _scenario_sparse_restart,
+    "control_loop": _scenario_control_loop,
 }
 
 

@@ -419,6 +419,135 @@ def _detect_input_bound(metrics, threshold=0.3):
 
 
 # ---------------------------------------------------------------------------
+# remediation audit (the control plane's ledger, checked)
+# ---------------------------------------------------------------------------
+
+def remediation_audit(events: List[dict]) -> Optional[dict]:
+    """Audit the control plane's action ledger against the verdicts
+    in the same journal (observability/control.py). Returns None when
+    no control plane ran; otherwise a dict whose ``ok`` is the CI
+    contract ``--expect`` folds in:
+
+      - **chains** — every FIRED ``control_action`` joined to its
+        triggering verdict/event through the action's ``role@seq``
+        evidence citations, ranked by action time (the "why did it
+        act" answer, machine-readable);
+      - **unexplained** — fired actions whose citations resolve to no
+        event in the record (an action without a cause is the one
+        thing an autonomous plane is never allowed to produce);
+      - **unremediated** — verdict raises matching an ARMED policy's
+        trigger (``control_policy_armed`` carries trigger +
+        ``deadline_s``) with no fired action citing them inside the
+        deadline and no ``clear`` inside it either — detection that
+        never became remediation.
+    """
+    armed = [e for e in events if e.get("kind") == "control_policy_armed"]
+    actions = [e for e in events if e.get("kind") == "control_action"]
+    if not armed and not actions:
+        return None
+    by_ref: Dict = {}
+    for e in events:
+        by_ref[(e.get("role"), e.get("seq"))] = e
+    fired = [a for a in actions if a.get("decision") == "fired"]
+    suppressed = [a for a in actions
+                  if a.get("decision") == "suppressed"]
+    raises = [e for e in events if e.get("kind") == "health"
+              and e.get("action") == "raise"]
+    chains, unexplained = [], []
+    for a in fired:
+        cause = None
+        for c in (a.get("evidence") or []):
+            src = by_ref.get((c.get("role"), c.get("seq")))
+            if src is not None and src is not a:
+                cause = src
+                break
+        if cause is None:
+            # seq-less citation (the raise aged out of the emitter's
+            # bounded in-memory ring before the action fired) — the
+            # FILE journal doctor reads still holds it: resolve by
+            # reason to the newest raise preceding the action
+            want = a.get("reason")
+            t_a = float(a.get("t_wall") or 0.0)
+            prior = [r for r in raises
+                     if r.get("reason") == want
+                     and float(r.get("t_wall") or 0.0) <= t_a]
+            if prior:
+                cause = prior[-1]
+        link = {"policy": a.get("policy"), "action": a.get("action"),
+                "reason": a.get("reason"),
+                "action_ref": "%s@%s" % (a.get("role"), a.get("seq")),
+                "t_wall": a.get("t_wall")}
+        if cause is None:
+            unexplained.append(link)
+            continue
+        link.update({
+            "verdict_kind": cause.get("kind"),
+            "verdict_reason": cause.get("reason", cause.get("kind")),
+            "verdict_ref": "%s@%s" % (cause.get("role"),
+                                      cause.get("seq")),
+            "verdict_to_action_s": round(
+                float(a.get("t_wall", 0.0))
+                - float(cause.get("t_wall", 0.0)), 3)
+            if a.get("t_wall") and cause.get("t_wall") else None})
+        chains.append(link)
+    chains.sort(key=lambda c: c.get("t_wall") or 0.0)
+    # un-remediated verdicts: armed verdict-trigger policies define
+    # the contract; the journal's last timestamp bounds what we can
+    # judge (a deadline still running when the record ends is not a
+    # breach)
+    t_end = max((float(e.get("t_wall") or 0.0) for e in events),
+                default=0.0)
+    unremediated = []
+    clears = [e for e in events if e.get("kind") == "health"
+              and e.get("action") == "clear"]
+    for pol in armed:
+        trig = str(pol.get("trigger") or "")
+        if not trig.startswith("verdict:"):
+            continue
+        prefix = trig.split(":", 1)[1]
+        deadline = float(pol.get("deadline_s") or 0.0)
+        t_armed = float(pol.get("t_wall") or 0.0)
+        for r in raises:
+            reason = str(r.get("reason") or "")
+            if not reason.startswith(prefix):
+                continue
+            t_raise = float(r.get("t_wall") or 0.0)
+            # the deadline clock starts when BOTH the verdict exists
+            # and the policy is armed — a raise predating arming is
+            # judged from the arming moment, not retroactively
+            t_anchor = max(t_raise, t_armed)
+            if t_end <= t_anchor + deadline:
+                continue  # deadline hadn't elapsed by end of record
+            ref = (r.get("role"), r.get("seq"))
+            acted = any(
+                a.get("policy") == pol.get("policy")
+                and t_raise <= float(a.get("t_wall") or 0.0)
+                <= t_anchor + deadline
+                and any((c.get("role"), c.get("seq")) == ref
+                        or c.get("reason") == reason
+                        for c in (a.get("evidence") or []))
+                for a in fired)
+            cleared = any(
+                c.get("reason") == reason
+                and t_raise <= float(c.get("t_wall") or 0.0)
+                <= t_anchor + deadline
+                for c in clears)
+            if not acted and not cleared:
+                unremediated.append({
+                    "policy": pol.get("policy"), "reason": reason,
+                    "verdict_ref": "%s@%s" % ref,
+                    "deadline_s": deadline})
+    return {"ok": not unexplained and not unremediated,
+            "chains": chains,
+            "unexplained": unexplained,
+            "unremediated": unremediated,
+            "actions_fired": len(fired),
+            "actions_suppressed": len(suppressed),
+            "policies_armed": sorted({str(p.get("policy"))
+                                      for p in armed})}
+
+
+# ---------------------------------------------------------------------------
 # diagnosis
 # ---------------------------------------------------------------------------
 
@@ -443,13 +572,17 @@ def diagnose(events: List[dict], blackboxes: List[dict] = (),
     diagnoses += _detect_overload(kinds)
     diagnoses += _detect_input_bound(list(metrics))
     diagnoses.sort(key=lambda d: -d["score"])
-    return {
+    report = {
         "top": diagnoses[0]["name"] if diagnoses else None,
         "diagnoses": diagnoses,
         "events_scanned": len(events),
         "roles": sorted({e.get("role", "?") for e in events}),
         "kinds": {k: len(v) for k, v in sorted(kinds.items())},
     }
+    audit = remediation_audit(events)
+    if audit is not None:
+        report["remediation"] = audit
+    return report
 
 
 def load_and_diagnose(journal_paths=(), blackbox_paths=(),
@@ -508,6 +641,32 @@ def format_report(report: dict) -> str:
         lines.append("   evidence: %s%s"
                      % (cites, " ..." if len(d["evidence"]) > 6
                         else ""))
+    audit = report.get("remediation")
+    if audit is not None:
+        lines.append("remediation audit: %s — %d fired / %d "
+                     "suppressed under policies %s"
+                     % ("OK" if audit["ok"] else "FAILED",
+                        audit["actions_fired"],
+                        audit["actions_suppressed"],
+                        ", ".join(audit["policies_armed"]) or "(none)"))
+        for c in audit["chains"]:
+            lines.append("   %s %s <- %s %r (%s)%s"
+                         % (c["action"], c["action_ref"],
+                            c.get("verdict_kind"),
+                            c.get("verdict_reason"),
+                            c.get("verdict_ref"),
+                            " in %.2fs" % c["verdict_to_action_s"]
+                            if c.get("verdict_to_action_s") is not None
+                            else ""))
+        for u in audit["unexplained"]:
+            lines.append("   !! UNEXPLAINED action %s %s — no cited "
+                         "verdict in the record"
+                         % (u["action"], u["action_ref"]))
+        for u in audit["unremediated"]:
+            lines.append("   !! UNREMEDIATED verdict %r %s — policy "
+                         "%s never fired within %.0fs"
+                         % (u["reason"], u["verdict_ref"],
+                            u["policy"], u["deadline_s"]))
     return "\n".join(lines)
 
 
@@ -540,6 +699,16 @@ def main(argv=None):
         if report["top"] not in want:
             print("doctor: EXPECTED %s, got %r"
                   % (sorted(want), report["top"]), file=sys.stderr)
+            return 1
+        audit = report.get("remediation")
+        if audit is not None and not audit["ok"]:
+            # a control plane ran: the gate also demands every action
+            # has a named verdict and every armed verdict was
+            # remediated inside its deadline
+            print("doctor: remediation audit FAILED — %d unexplained "
+                  "action(s), %d unremediated verdict(s)"
+                  % (len(audit["unexplained"]),
+                     len(audit["unremediated"])), file=sys.stderr)
             return 1
     return 0
 

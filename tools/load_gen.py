@@ -159,11 +159,99 @@ def run_open_loop(engine, make_feed, qps, duration_s, deadline_ms):
             "client_lat_ms": lat_ms}
 
 
+def _replica_cmd(model_dir, k, max_batch, wait_us, queue_size,
+                 replica_args=()):
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+           "--model-dir", str(model_dir), "--port", "0",
+           "--replica-id", str(k),
+           "--max-batch", str(max_batch),
+           "--wait-us", str(wait_us),
+           "--queue-size", str(queue_size)]
+    cmd.extend(replica_args)
+    return cmd
+
+
+def _stamp_replica_env(env, k, journal_dir=None):
+    """Per-replica observability stamping (launch.py's posture for
+    fleet workers): role + its OWN journal file + blackbox dir, so a
+    spawned replica's ledger trail (compile_cache_hit origin
+    attribution, serving_warmup, executor_compile) is separable from
+    its siblings'."""
+    env = dict(env, PADDLE_TPU_ROLE="serving-%d" % k)
+    if journal_dir:
+        os.makedirs(journal_dir, exist_ok=True)
+        env["PADDLE_TPU_EVENT_JOURNAL"] = os.path.join(
+            journal_dir, "events.serving-%d.jsonl" % k)
+        env["PADDLE_TPU_BLACKBOX_DIR"] = str(journal_dir)
+    return env
+
+
+def _wait_ready(p, deadline):
+    """Deadline-bounded wait for a replica child's ``REPLICA_READY``
+    line -> endpoint. A plain ``readline()`` would block PAST the
+    deadline on a silent-hung child — and this can run on the control
+    plane's evaluation thread (``FleetScaler.scale_up``), where one
+    wedged spawn would stall all remediation fleet-wide. A daemon
+    reader thread does the blocking reads; it also keeps draining
+    stdout for the child's lifetime, so a chatty replica can never
+    block on a full pipe."""
+    import queue as _queue
+
+    q = _queue.Queue()
+    ready = threading.Event()
+
+    def _reader():
+        try:
+            for line in iter(p.stdout.readline, ""):
+                # post-READY chatter is discarded, not queued: the
+                # consumer is gone, and a long-lived chatty replica
+                # must drain to nowhere, not into the parent's heap
+                if not ready.is_set():
+                    q.put(line)
+        except Exception:
+            pass
+        q.put(None)
+
+    threading.Thread(target=_reader, daemon=True,
+                     name="replica-ready-reader").start()
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RuntimeError("replica startup timed out")
+        try:
+            line = q.get(timeout=min(remaining, 1.0))
+        except _queue.Empty:
+            continue
+        if line is None:
+            raise RuntimeError(
+                "replica died before READY (rc=%s)" % p.poll())
+        if line.startswith("REPLICA_READY "):
+            ready.set()
+            return line.split()[1]
+
+
+def _spawn_replica(cmd, env, cwd, startup_timeout_s=120.0):
+    """Start one replica subprocess and wait for its REPLICA_READY
+    line -> (proc, endpoint). Kills the child on timeout/death."""
+    import subprocess
+
+    p = subprocess.Popen(cmd, env=env, cwd=cwd,
+                         stdin=subprocess.PIPE,
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        endpoint = _wait_ready(
+            p, time.monotonic() + startup_timeout_s)
+        return p, endpoint
+    except Exception:
+        p.kill()
+        raise
+
+
 def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
                 queue_size=256, policy="least_loaded",
                 router_config=None, startup_timeout_s=120.0,
                 replica_args=(), compile_cache_dir=None,
-                group_size=1, mesh_axes=None):
+                group_size=1, mesh_axes=None, journal_dir=None):
     """Spawn ``n_replicas`` serving-replica SUBPROCESSES (real
     processes — the fleet's scaling claim is about escaping one
     process) for ``model_dir`` and return ``(router, stop)`` where
@@ -177,9 +265,9 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
     loads, and a respawned fleet cold-starts with zero XLA compiles.
     ``compile_cache_dir``: explicit dir, or "" to disable stamping;
     default resolves like launch.py (env var, else the per-user
-    cache location)."""
-    import subprocess
-
+    cache location). ``journal_dir``: stamp each replica with its OWN
+    event-journal file + blackbox dir (``events.serving-<k>.jsonl``)
+    so per-replica ledger trails stay separable."""
     from paddle_tpu.distributed.launch import default_compile_cache_dir
     from paddle_tpu.serving import RouterConfig, ServingRouter
 
@@ -196,17 +284,17 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
     # mesh_axes; members >0 are the group's shard/lease surface.
     n_procs = n_replicas * group_size
     mesh_json = json.dumps(mesh_axes) if mesh_axes else None
+    import subprocess
+
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs, endpoints = [], []
     try:
         for k in range(n_procs):
             rank = k % group_size
-            cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
-                   "--model-dir", str(model_dir), "--port", "0",
-                   "--replica-id", str(k),
-                   "--max-batch", str(max_batch),
-                   "--wait-us", str(wait_us),
-                   "--queue-size", str(queue_size)]
-            child_env = env
+            cmd = _replica_cmd(model_dir, k, max_batch, wait_us,
+                               queue_size)
+            child_env = _stamp_replica_env(env, k,
+                                           journal_dir=journal_dir)
             if group_size > 1:
                 cmd.extend(["--group-rank", str(rank),
                             "--group-size", str(group_size)])
@@ -215,29 +303,19 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
                     import numpy as _np
                     ndev = int(_np.prod(list(mesh_axes.values())))
                     child_env = dict(
-                        env, XLA_FLAGS=(env.get("XLA_FLAGS", "")
-                                        + " --xla_force_host_platform"
-                                        "_device_count=%d"
-                                        % ndev).strip())
+                        child_env,
+                        XLA_FLAGS=(child_env.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform"
+                                   "_device_count=%d"
+                                   % ndev).strip())
             cmd.extend(replica_args)
             procs.append(subprocess.Popen(
-                cmd, env=child_env, cwd=os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__))),
+                cmd, env=child_env, cwd=cwd,
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 text=True))
         deadline = time.monotonic() + startup_timeout_s
         for p in procs:
-            while True:
-                if time.monotonic() > deadline:
-                    raise RuntimeError("replica startup timed out")
-                line = p.stdout.readline()
-                if not line:
-                    raise RuntimeError(
-                        "replica died before READY (rc=%s)"
-                        % p.poll())
-                if line.startswith("REPLICA_READY "):
-                    endpoints.append(line.split()[1])
-                    break
+            endpoints.append(_wait_ready(p, deadline))
     except Exception:
         for p in procs:
             p.kill()
@@ -263,7 +341,111 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
                 p.kill()
 
     stop.procs = procs  # chaos/bench seam: kill a REAL process
+    stop.model_dir = str(model_dir)
+    stop.env = env
+    stop.journal_dir = journal_dir
+    stop.spawn_opts = {"max_batch": max_batch, "wait_us": wait_us,
+                       "queue_size": queue_size,
+                       "replica_args": list(replica_args)}
     return router, stop
+
+
+class FleetScaler:
+    """``spawn_fleet``'s actuator face for the control plane
+    (``observability.control.ControlPlane.attach_scaler``): spawn or
+    retire ONE replica subprocess per call, through the router's
+    dynamic-membership API. Spawned replicas reuse the fleet's
+    environment — in particular the shared
+    ``PADDLE_TPU_COMPILE_CACHE_DIR`` — so a scale-up warms from the
+    persistent compile cache (replica 0 paid the compiles) and serves
+    its first request with zero XLA compiles, and the per-replica
+    journal stamping keeps each spawned replica's ledger separable.
+
+    Build from a live fleet: ``FleetScaler(router, stop)`` (the pair
+    ``spawn_fleet`` returns)."""
+
+    def __init__(self, router, stop, startup_timeout_s=120.0):
+        self.router = router
+        self._stop = stop
+        self.model_dir = stop.model_dir
+        self.startup_timeout_s = float(startup_timeout_s)
+        self._mu = threading.Lock()
+        self._next_k = len(stop.procs)
+        self._cwd = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        # rid -> proc for the replicas THIS scaler spawned (scale-down
+        # retires newest-first and only ever reaps what it created)
+        self._spawned = {}
+
+    def replica_count(self) -> int:
+        # membership, NOT the healthy subset: max_replicas bounds the
+        # process budget, and an evicted-but-member replica still owns
+        # its slot (it may be readmitted) — counting only healthy would
+        # let repeated crashes under load scale past the cap
+        return len(self.router._replicas)
+
+    def retirable_count(self) -> int:
+        # the control plane's down-bound tap: this scaler only ever
+        # retires replicas IT spawned, never the base fleet
+        with self._mu:
+            return len(self._spawned)
+
+    def pressure(self) -> dict:
+        return self.router.pressure()
+
+    def scale_up(self) -> dict:
+        with self._mu:
+            k = self._next_k
+            self._next_k += 1
+        opts = self._stop.spawn_opts
+        cmd = _replica_cmd(self.model_dir, k, opts["max_batch"],
+                           opts["wait_us"], opts["queue_size"],
+                           opts["replica_args"])
+        env = _stamp_replica_env(self._stop.env, k,
+                                 journal_dir=self._stop.journal_dir)
+        t0 = time.monotonic()
+        proc, endpoint = _spawn_replica(
+            cmd, env, self._cwd,
+            startup_timeout_s=self.startup_timeout_s)
+        try:
+            rid = self.router.add_replica(endpoint)
+        except Exception:
+            # admission refused (router shutting down, ...): the
+            # already-READY child must not outlive the failure
+            proc.kill()
+            raise
+        with self._mu:
+            self._spawned[rid] = proc
+        self._stop.procs.append(proc)  # fleet stop() reaps it too
+        return {"ok": True, "op": "scale_up", "replica": rid,
+                "endpoint": endpoint, "pid": proc.pid,
+                "spawn_seconds": round(time.monotonic() - t0, 3),
+                "replicas": self.replica_count()}
+
+    def scale_down(self) -> dict:
+        with self._mu:
+            if not self._spawned:
+                raise RuntimeError(
+                    "nothing to retire: this scaler spawned no "
+                    "replicas beyond the base fleet")
+            rid = max(self._spawned)   # newest-first
+            proc = self._spawned.pop(rid)
+        snap = self.router.remove_replica(rid)
+        try:
+            proc.stdin.close()   # replicas exit on stdin EOF
+        except Exception:
+            pass
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+        try:
+            self._stop.procs.remove(proc)
+        except ValueError:
+            pass
+        return {"ok": True, "op": "scale_down", "replica": rid,
+                "served_requests": snap.get("requests"),
+                "replicas": self.replica_count()}
 
 
 def run_closed_loop(engine, make_feed, concurrency, duration_s,
