@@ -12,20 +12,29 @@ This module makes the matrix a fast static gate: enumerate
                      sharded_update, sharded_update_q8}
   × pipelined ∈ {off, on}
   × PS ∈ {off, on}
+  × sparse ∈ {off, on}
 
 build each composed program the same way the runtime would (install
-the guard, convert the sharded state, run the PS transpiler split),
-and run the FULL verifier (IR invariant passes + every rewrite
-contract) over every product — no tracing, no XLA compile. Known
-structurally-impossible pairs are *structured rejections* with a
-documented reason, so the matrix distinguishes "verified clean",
-"documented incompatibility", and "broken seam" (error findings).
+the guard, convert the sharded state, run the PS transpiler split,
+declare the distributed-embedding lookup), and run the FULL verifier
+(IR invariant passes + every rewrite contract) over every product —
+no tracing, no XLA compile. Known structurally-impossible pairs are
+*structured rejections* with a documented reason, so the matrix
+distinguishes "verified clean", "documented incompatibility", and
+"broken seam" (error findings).
+
+The rejection table lives in ``engine.rules`` and is SHARED with the
+runtime StepEngine: a combo this matrix rejects is a combo the engine
+refuses to assemble, with the identical message (the parity gate in
+tests/test_step_engine.py asserts both directions).
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..engine import rules
+from ..engine.rules import REJECTIONS  # noqa: F401  (re-export)
 from ..framework import Program, program_guard
 from ..parallel.collectives import SHARDED_MODES
 from .findings import Finding, errors
@@ -35,6 +44,12 @@ SYNC_AXIS = (None, "exact", "rs_ag", "q8",
              "sharded_update", "sharded_update_q8")
 PIPELINE_AXIS = (False, True)
 PS_AXIS = (False, True)
+# sparse dimension (PR 14→16): a distributed-embedding lookup whose
+# rows live host-side — the probe carries the
+# program._distributed_lookups contract (prefetch data var + sparse
+# push). Sparse adds NO rejections (engine.rules): the exchange rides
+# chunk boundaries, so it composes with everything including PS.
+SPARSE_AXIS = (False, True)
 # mesh dimension (PR 13): "dp" = the pure data-parallel probe the
 # matrix always swept; "dp_sp" = a dp×sp mesh probe whose forward
 # carries a routable attention op — guard × gradient_sync × sp
@@ -43,37 +58,25 @@ PS_AXIS = (False, True)
 MESH_AXIS = ("dp", "dp_sp")
 MESH_AXES = {"dp": {"dp": 2}, "dp_sp": {"dp": 2, "sp": 2}}
 
-# Structurally impossible pairs, with the reason a reader (and the
-# matrix report) gets. These are CONTRACTS too: a combo leaving this
-# table is expected to verify clean.
-REJECTIONS = {
-    ("ps", "sharded"): (
-        "sharded_update and the PS split both claim the optimize "
-        "ops: the bracket runs them on 1/n shards in-graph, the "
-        "transpiler moves them server-side. The transpiler already "
-        "maps dense parameter serving to ZeRO-sharded state for "
-        "pod (non-pserver) runs instead."),
-    ("ps", "pipelined"): (
-        "the PS grad/param exchange is a host-side per-step phase "
-        "(Communicator send/recv around each step); a K-step "
-        "on-device chunk scan would silently skip K-1 exchanges."),
-}
-
 
 def build_training_program(guard: bool = False,
                            gradient_sync: Optional[str] = None,
                            param_gather: str = "fp32",
                            hidden: int = 8,
                            world: int = 2,
-                           mesh: str = "dp"):
+                           mesh: str = "dp",
+                           sparse: bool = False):
     """One tiny composed training program, assembled exactly the way
     the runtime paths assemble it (install_anomaly_guard for the
     guard, ensure_sharded_state/ensure_residual_vars for the sharded/
     q8 modes). ``mesh="dp_sp"`` builds the dp×sp probe: the forward
     carries the routable attention op (what the sdpa lowering sends
     through ulysses/zigzag under an sp mesh) so the mesh contract has
-    the real op shape to inspect. Returns (main, startup, scope,
-    loss_name)."""
+    the real op shape to inspect. ``sparse=True`` adds a distributed
+    embedding lookup (no in-graph parameter; the prefetch var enters
+    as a feed, the table id rides ``main._distributed_lookups`` — the
+    exact contract SparseEmbeddingRuntime drives). Returns (main,
+    startup, scope, loss_name)."""
     from .. import layers, optimizer as opt
     from ..core.scope import Scope
 
@@ -83,6 +86,13 @@ def build_training_program(guard: bool = False,
         x = layers.data(name="x", shape=[hidden], dtype="float32")
         y = layers.data(name="y", shape=[1], dtype="float32")
         h = layers.fc(input=x, size=hidden, act="relu")
+        if sparse:
+            ids = layers.data(name="ids", shape=[4], dtype="int64")
+            emb = layers.embedding(ids, size=(32, hidden),
+                                   is_distributed=True,
+                                   param_attr="matrix_tbl")
+            h = layers.elementwise_add(
+                h, layers.reduce_sum(emb, dim=1))
         if mesh == "dp_sp":
             # [B, hidden] -> [B, H=2, S=2, Dh] -> routable attention
             # (the op the compiler's sp dispatch rewrites) -> back
@@ -113,25 +123,42 @@ def build_training_program(guard: bool = False,
     return main, startup, scope, loss.name
 
 
-def _verify_combo(guard, sync, pipelined, ps, mesh="dp") -> Dict:
+def _verify_combo(guard, sync, pipelined, ps, mesh="dp",
+                  sparse=False) -> Dict:
     from . import verify_program
     from .contracts import (check_mesh_contract,
                             check_pipeline_contract, check_ps_contract)
 
     combo = {"guard": guard, "gradient_sync": sync,
-             "pipelined": pipelined, "ps": ps, "mesh": mesh}
-    if ps and sync in SHARDED_MODES:
-        return dict(combo, status="rejected",
-                    reason=REJECTIONS[("ps", "sharded")], findings=[])
-    if ps and pipelined:
-        return dict(combo, status="rejected",
-                    reason=REJECTIONS[("ps", "pipelined")],
+             "pipelined": pipelined, "ps": ps, "mesh": mesh,
+             "sparse": sparse}
+    # the ONE legality table, shared with the runtime engine: the
+    # reason string here is byte-for-byte the InvalidArgumentError the
+    # StepEngine raises for the same combo
+    rej = rules.rejection(gradient_sync=sync, pipelined=pipelined,
+                          ps=ps, sparse=sparse)
+    if rej is not None:
+        return dict(combo, status="rejected", reason=rej[1],
                     findings=[])
 
     main, startup, scope, loss_name = build_training_program(
-        guard=guard, gradient_sync=sync, mesh=mesh)
+        guard=guard, gradient_sync=sync, mesh=mesh, sparse=sparse)
+    feed = ("x", "y")
+    if sparse:
+        # the prefetch var is feed-like: the runtime's wrap_feed
+        # supplies it before each step (pull), and its grad is fetched
+        # for the push — both at chunk boundaries
+        feed = feed + ("ids",) + tuple(
+            lk["out"] for lk in main._distributed_lookups)
     findings: List[Finding] = []
     notes: List[str] = []
+    if sparse:
+        notes.append(
+            "sparse: distributed lookup rows live host-side; the "
+            "pull/push exchange rides CHUNK boundaries (per-step "
+            "payloads through the scan ys), so sparse composes with "
+            "every other stage — including PS at K=1, the Downpour "
+            "dense+sparse posture")
     if mesh == "dp_sp":
         findings += check_mesh_contract(main, MESH_AXES[mesh])
         notes.append(
@@ -149,7 +176,7 @@ def _verify_combo(guard, sync, pipelined, ps, mesh="dp") -> Dict:
         trainer = t.get_trainer_program()
         pservers = {ep: t.get_pserver_program(ep)
                     for ep in eps.split(",")}
-        findings += verify_program(trainer, feed=("x", "y"),
+        findings += verify_program(trainer, feed=feed,
                                    gradient_sync=None)
         for ep, prog in pservers.items():
             findings += verify_program(prog, gradient_sync=None)
@@ -161,7 +188,7 @@ def _verify_combo(guard, sync, pipelined, ps, mesh="dp") -> Dict:
                 "so the trainer applies no collective — grads ride "
                 "the PS transport instead" % sync)
     else:
-        findings += verify_program(main, feed=("x", "y"),
+        findings += verify_program(main, feed=feed,
                                    targets=(loss_name,),
                                    gradient_sync=sync)
         findings += verify_program(startup)
@@ -176,7 +203,8 @@ def _verify_combo(guard, sync, pipelined, ps, mesh="dp") -> Dict:
 def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
                        pipeline_axis=PIPELINE_AXIS,
                        ps_axis=PS_AXIS,
-                       mesh_axis=MESH_AXIS) -> Dict:
+                       mesh_axis=MESH_AXIS,
+                       sparse_axis=SPARSE_AXIS) -> Dict:
     """Sweep the full feature matrix; returns a JSON-able report:
     ``{"combos": [...], "counts": {"ok": n, "rejected": n,
     "broken": n}, "broken": [...]}``. The CI gate asserts
@@ -187,8 +215,10 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
             for pipelined in pipeline_axis:
                 for ps in ps_axis:
                     for mesh in mesh_axis:
-                        combos.append(_verify_combo(
-                            guard, sync, pipelined, ps, mesh=mesh))
+                        for sparse in sparse_axis:
+                            combos.append(_verify_combo(
+                                guard, sync, pipelined, ps,
+                                mesh=mesh, sparse=sparse))
     counts: Dict[str, int] = {"ok": 0, "rejected": 0, "broken": 0}
     for c in combos:
         counts[c["status"]] += 1
@@ -200,5 +230,6 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
                  "gradient_sync": list(sync_axis),
                  "pipelined": list(pipeline_axis),
                  "ps": list(ps_axis),
-                 "mesh": list(mesh_axis)},
+                 "mesh": list(mesh_axis),
+                 "sparse": list(sparse_axis)},
     }

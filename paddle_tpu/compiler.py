@@ -246,8 +246,13 @@ class CompiledProgram:
                                  "fp32"))
 
     # -- execution ---------------------------------------------------------
-    def run(self, exe, feed, fetch_list, scope, return_numpy,
-            use_program_cache=True, validate_feed=True, donate=True):
+    def _prepare_run(self, scope=None):
+        """State prep shared by EVERY dispatch path — per-step run()
+        and the executor's pipelined chunk scan: fuse pass,
+        gradient-sync validation, sharded/residual state conversion,
+        and the one-shot rewrite-verify memo. Must run BEFORE a caller
+        snapshots the persistable carry (ensure_sharded_state rewrites
+        block shapes and scope values). Idempotent per version."""
         from .core.scope import global_scope
         if self._build_strategy.fuse_elewise_add_act_ops and \
                 not getattr(self, "_fuse_done", False):
@@ -297,6 +302,11 @@ class CompiledProgram:
                                         "compiled_program_run",
                                         gradient_sync=gs) is not None:
                     self._verified_version = self.program._version
+
+    def run(self, exe, feed, fetch_list, scope, return_numpy,
+            use_program_cache=True, validate_feed=True, donate=True):
+        from .core.scope import global_scope
+        self._prepare_run(scope)
         # ops that are mesh-aware (ring_attention, sp/ep lowerings)
         # read the ambient mesh during tracing
         with mesh_lib.mesh_guard(self._mesh):

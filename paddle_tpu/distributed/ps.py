@@ -42,6 +42,7 @@ import numpy as np
 from .. import observability as _obs
 from ..core.enforce import enforce
 from ..core.flags import FLAGS
+from ..engine import HostStage
 from ..observability import trace as _trace
 from ..io import (deserialize_tensor, durable_publish_dir,
                   remove_marked_dir, serialize_tensor)
@@ -1542,20 +1543,10 @@ class ParameterServerRuntime:
             for f in futs:
                 f.result()  # propagate RPC errors
 
-    def run_step(self, exe, feed, fetch_list=None, return_numpy=True,
-                 scope=None):
-        from ..framework import grad_var_name
-        scope = scope or self.scope
-        fetch_list = list(fetch_list or [])
-        pnames = sorted(self.blocks)
-        gnames = [grad_var_name(p) for p in pnames]
-        out = exe.run(self.program, feed=feed,
-                      fetch_list=fetch_list + gnames,
-                      scope=scope, return_numpy=False)
-        user_out = out[:len(fetch_list)]
-        gvals = {p: np.asarray(g) for p, g in
-                 zip(pnames, out[len(fetch_list):])}
-
+    def _exchange(self, gvals, scope):
+        """One step's communication phase: push every param grad to
+        its pserver shard, barrier (sync mode), pull fresh params back
+        into ``scope``. Replay-idempotent — see ``_replay_phase``."""
         # one seq per block send, assigned ONCE per step: a phase
         # replay reuses them, so the server applies each grad exactly
         # once no matter how many times the phase runs
@@ -1589,11 +1580,50 @@ class ParameterServerRuntime:
             scope.set_var(
                 pname, self._assemble(pname,
                                       [b.pop("_value") for b in bs]))
-        if return_numpy:
-            user_out = [np.asarray(v) for v in user_out]
-        return user_out
+
+    def exchange_stage(self, scope=None):
+        """The PS grad/param exchange as an engine HostStage: the
+        engine fetches the param grads for us, ``after_chunk`` runs
+        the replayed phase. K=1 only — engine.rules rejects
+        ps × pipelined (a chunk scan would skip K-1 exchanges) with
+        the static matrix's message. GuardedTrainer and the sparse
+        runtime compose this stage via ``stages=``."""
+        return _PSExchangeStage(self, scope or self.scope)
+
+    def run_step(self, exe, feed, fetch_list=None, return_numpy=True,
+                 scope=None):
+        """Thin shim: one engine-composed step with the exchange stage
+        (local fwd+bwd dispatch + grad push + barrier + param pull)."""
+        from ..engine import StepEngine
+        scope = scope or self.scope
+        return StepEngine(exe).run_step(
+            self.program, feed, fetch_list=list(fetch_list or []),
+            scope=scope, stages=(self.exchange_stage(scope),),
+            return_numpy=return_numpy)
 
     def complete(self):
         self.stop_heartbeats()
         self.comm.complete_all()
         self.comm.stop()
+
+
+class _PSExchangeStage(HostStage):
+    """Engine HostStage adapter for the PS phase (kind drives the
+    composition rules: ps × sharded and ps × pipelined reject)."""
+
+    kind = "ps"
+
+    def __init__(self, runtime, scope):
+        self._rt = runtime
+        self._scope = scope
+
+    def extra_fetch_names(self):
+        from ..framework import grad_var_name
+        return [grad_var_name(p) for p in sorted(self._rt.blocks)]
+
+    def after_chunk(self, feeds, stacked):
+        from ..framework import grad_var_name
+        # K == 1 guaranteed by the composition rules; [0] is the step
+        gvals = {p: np.asarray(stacked[grad_var_name(p)][0])
+                 for p in sorted(self._rt.blocks)}
+        self._rt._exchange(gvals, self._scope)

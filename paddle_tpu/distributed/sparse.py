@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce
+from ..engine import HostStage
 from ..framework import grad_var_name
 from ..parallel.collectives import SPARSE_Q8_MIN_DIM
 from .lookup_service import LookupServiceClient
@@ -132,6 +133,49 @@ class SparseEmbeddingRuntime:
         residual rows) — the bench row's raw material."""
         return {t: c.stats() for t, c in self.clients.items()}
 
+    def chunk_stage(self):
+        """The sparse exchange as an engine HostStage riding CHUNK
+        boundaries: ``before_chunk`` pulls all K batches' rows in one
+        host phase (they enter the scan as xs), the engine stacks the
+        per-step out-grads through the scan ys, and ``after_chunk``
+        pushes them back in step order — the client assigns push seqs
+        internally, so per-step ack/replay semantics are exactly the
+        per-step loop's. This is what removes the one host dispatch
+        per step the bespoke wrap_feed/run/push_grads loop paid."""
+        return _SparseChunkStage(self)
+
+    def run_chunk(self, exe, program, feeds, fetch_list=None,
+                  scope=None, return_numpy=True):
+        """Run K sparse training steps as ONE engine-composed chunk
+        (K=1 degenerates to the old per-step flow). Returns the last
+        step's fetches."""
+        from ..engine import StepEngine
+        return StepEngine(exe).run_chunk(
+            program, feeds, fetch_list=fetch_list, scope=scope,
+            stages=(self.chunk_stage(),), return_numpy=return_numpy)
+
     def close(self):
         for c in self.clients.values():
             c.close()
+
+
+class _SparseChunkStage(HostStage):
+    """Engine HostStage adapter for the sparse pull/push (kind feeds
+    the composition rules: sparse composes with everything, including
+    PS at K=1 — the Downpour dense+sparse posture)."""
+
+    kind = "sparse"
+
+    def __init__(self, runtime):
+        self._rt = runtime
+
+    def extra_fetch_names(self):
+        return self._rt.grad_fetch_names()
+
+    def before_chunk(self, feeds):
+        return [self._rt.wrap_feed(f) for f in feeds]
+
+    def after_chunk(self, feeds, stacked):
+        names = self._rt.grad_fetch_names()
+        for i, feed in enumerate(feeds):
+            self._rt.push_grads(feed, [stacked[n][i] for n in names])

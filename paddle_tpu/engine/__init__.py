@@ -1,0 +1,17 @@
+"""The step engine: one traced, composable training step.
+
+``build_step`` assembles the single traced step every runtime path
+dispatches (guard × collectives × sharded bracket × mesh finisher);
+``build_repeat_fn`` / ``build_chunk_fn`` wrap it in the K-step scans;
+``StepEngine`` drives composed chunks with host-exchange stages (PS,
+sparse) riding the chunk boundaries. ``rules`` is the shared
+composition-legality table — the static matrix and the runtime engine
+reject the same combos with the same message.
+"""
+
+from . import rules  # noqa: F401
+from .step_engine import (HostStage, StepEngine,  # noqa: F401
+                          build_chunk_fn, build_repeat_fn, build_step)
+
+__all__ = ["rules", "HostStage", "StepEngine", "build_step",
+           "build_repeat_fn", "build_chunk_fn"]

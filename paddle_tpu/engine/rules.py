@@ -1,0 +1,47 @@
+"""Composition rules: the ONE place a feature pair is declared
+structurally impossible.
+
+Both planes consume this table: the static composition matrix
+(analysis/matrix.py) marks the combo ``rejected`` with the reason
+string, and the runtime StepEngine refuses to assemble the same combo
+by raising ``InvalidArgumentError`` whose message IS the same string.
+The parity gate (tests/test_step_engine.py) asserts the two planes
+agree cell-for-cell in both directions — a rejection added to one
+plane only is a test failure, not a silent drift.
+
+Keys are (feature, feature) pairs; values are the documented reason a
+reader (and the matrix report, and the runtime error) gets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+REJECTIONS = {
+    ("ps", "sharded"): (
+        "sharded_update and the PS split both claim the optimize "
+        "ops: the bracket runs them on 1/n shards in-graph, the "
+        "transpiler moves them server-side. The transpiler already "
+        "maps dense parameter serving to ZeRO-sharded state for "
+        "pod (non-pserver) runs instead."),
+    ("ps", "pipelined"): (
+        "the PS grad/param exchange is a host-side per-step phase "
+        "(Communicator send/recv around each step); a K-step "
+        "on-device chunk scan would silently skip K-1 exchanges."),
+}
+
+
+def rejection(gradient_sync: Optional[str] = None,
+              pipelined: bool = False, ps: bool = False,
+              sparse: bool = False) -> Optional[Tuple[tuple, str]]:
+    """-> ((feature, feature), reason) when the combo is structurally
+    impossible, else None. The sparse exchange deliberately adds no
+    rejections: it rides chunk boundaries (K=1 degenerates to the
+    per-step flow), so it composes with every other stage — including
+    PS at K=1, the reference's Downpour dense+sparse posture."""
+    from ..parallel.collectives import SHARDED_MODES
+    if ps and gradient_sync in SHARDED_MODES:
+        return ("ps", "sharded"), REJECTIONS[("ps", "sharded")]
+    if ps and pipelined:
+        return ("ps", "pipelined"), REJECTIONS[("ps", "pipelined")]
+    return None

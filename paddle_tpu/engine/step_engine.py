@@ -1,0 +1,305 @@
+"""StepEngine: ONE traced, composable training step.
+
+The runtime used to hold six separately-built step loops — ``run``'s
+per-step jit, ``run_repeated``'s fixed-feed scan, ``run_pipelined``'s
+chunk scan, the GuardedTrainer retry/rollback driver, the PS trainer
+phase, and the sparse runtime's per-step ``wrap_feed``/``push_grads``
+loop — each re-assembling the same traced step by hand. This module is
+now the only place a step is assembled; everything else routes through
+it (docs/step_engine.md has the migration table).
+
+Stages, all orthogonal, all spliced by ``build_step`` into one trace:
+
+  collective transport   GradSyncPlan (exact / rs_ag / q8) at the sync
+                         boundary — parallel/collectives.py
+  sharded-update bracket ShardedUpdatePlan apply()/finish() around the
+                         optimize ops (ZeRO shards + param gather)
+  model-axis finisher    finish_model_partials inside the plans on a
+                         dp×sp mesh (PR 13) — partial sums pinned
+                         replicated before the dp bracket
+  anomaly gate           AnomalyGuardPlan pre/post hooks + gated
+                         optimize-role writes (PR 2)
+  chunking + prefetch    build_chunk_fn's K-step lax.scan over feed xs
+                         (PR 4; DevicePrefetcher stages the next chunk)
+  host exchange          HostStage hooks at CHUNK boundaries: sparse
+                         pull/push (PR 14) with per-step payloads
+                         riding the scan xs/ys, and the PS phase
+                         (PR 5) at K=1
+
+Composition legality lives in ``engine.rules`` and is shared verbatim
+with the static matrix (analysis/matrix.py): a combo the static plane
+rejects raises here with the SAME message, so the two planes cannot
+drift (the parity gate asserts both directions).
+
+Tracing contract (unchanged from the loops this replaces): step ``i``
+at run-counter ``c`` uses ``fold_in(base_key, c+i)`` on the chunked
+path — bit-identical to sequential ``run()`` — and persistables ride a
+FIXED scan carry (exactly the scope's persistables at trace time).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce
+from . import rules
+
+__all__ = ["build_step", "build_repeat_fn", "build_chunk_fn",
+           "HostStage", "StepEngine"]
+
+
+def build_step(program, block, fetch_names: Sequence[str],
+               library=None, sync_plan=None, guard_plan=None,
+               carried=None, warn_dropped: bool = False) -> Callable:
+    """Assemble THE traced step: ``step(persist, feed_vals, step_key)
+    -> (fetches, persist_out)``.
+
+    ``sync_plan`` / ``guard_plan`` splice at their boundary op indices
+    inside ``run_block`` (collective transport, sharded bracket, and
+    anomaly gate are all boundary splices — the step stays one XLA
+    computation and fusion crosses the seams).
+
+    ``carried=None`` (the per-step ``run`` posture) writes back every
+    persistable the step produced. A frozenset pins a FIXED carry for
+    scan bodies: vars first materialized inside a scan cannot join it;
+    ``warn_dropped=True`` additionally warns when such a var appears
+    (the pipelined contract — updates outside the carry are discarded
+    between chunks)."""
+    from .. import framework
+    from ..executor import run_block
+
+    persistable_names = frozenset(
+        n for n, v in block.vars.items() if v.persistable)
+
+    def step(persist, feed_vals, step_key):
+        env = dict(persist)
+        env.update(feed_vals)
+        with framework._trace_program_guard(program):
+            run_block(block, env, step_key, library=library,
+                      grad_sync=sync_plan, anomaly_guard=guard_plan)
+        if carried is None:
+            persist_out = {n: env[n] for n in persistable_names
+                           if n in env}
+        else:
+            if warn_dropped:
+                dropped = sorted(n for n in persistable_names
+                                 if n in env and n not in carried)
+                if dropped:
+                    import warnings
+                    warnings.warn(
+                        "run_pipelined: persistable var(s) %s are "
+                        "first materialized inside the scan; their "
+                        "updates are DISCARDED between chunks. Run "
+                        "the startup program (or one warmup run()) "
+                        "first so they join the carry, or use "
+                        "chunk_size=1." % (dropped,))
+            persist_out = {n: env[n] if n in env else persist[n]
+                           for n in carried}
+        try:
+            fetches = [env[n] for n in fetch_names]
+        except KeyError as e:
+            raise InvalidArgumentError(
+                "fetch var %r is not produced by this program "
+                "(known vars: feed %s + program outputs)"
+                % (e.args[0], sorted(feed_vals))) from e
+        return fetches, persist_out
+
+    return step
+
+
+def build_repeat_fn(step: Callable, iters: int) -> Callable:
+    """K steps of a FIXED feed in one ``lax.scan``:
+    ``multi(persist, feed_vals, base_key) -> (last_fetches, persist)``.
+
+    The fetches carry (instead of scan ys stacking) keeps memory O(1)
+    in iters; its initial value comes from eval_shape-derived zeros so
+    EVERY step runs inside the scan and the step graph is compiled
+    exactly once (an inlined step 0 would double the compile of large
+    models). PRNG: step ``i`` folds ``i`` into the (already
+    counter-folded) base key — run_repeated's documented stream."""
+
+    def multi(persist, feed_vals, base_key):
+        fetch_avals, _ = jax.eval_shape(step, persist, feed_vals,
+                                        base_key)
+        fetches0 = [jnp.zeros(a.shape, a.dtype) for a in fetch_avals]
+
+        def body(carry, i):
+            p, _ = carry
+            f, p2 = step(p, feed_vals,
+                         jax.random.fold_in(base_key, i))
+            return (p2, f), None
+
+        (last_persist, last_fetches), _ = jax.lax.scan(
+            body, (persist, fetches0), jnp.arange(iters))
+        return last_fetches, last_persist
+
+    return multi
+
+
+def build_chunk_fn(step: Callable,
+                   stacked_idx: Sequence[int] = ()) -> Callable:
+    """K data-fed steps in one ``lax.scan`` over the chunk xs:
+    ``pipelined(persist, chunk, idxs, base_key) ->
+    (last_fetches, stacked, persist)``.
+
+    ``idxs`` carry ABSOLUTE run counters, so step ``i`` of a chunk
+    starting at counter ``c`` uses ``fold_in(base_key, c+i)`` —
+    bit-identical to the key the same step would get from a sequential
+    ``run()`` call.
+
+    ``stacked_idx`` selects fetch positions whose PER-STEP values ride
+    the scan ys stacked ``[K, ...]`` — the chunk-boundary host stages'
+    raw material (sparse out-grads for the push). Everything else
+    returns last-step-only via the carry, as before."""
+    stacked_idx = tuple(stacked_idx)
+
+    def pipelined(persist, chunk, idxs, base_key):
+        # last-step fetches ride the CARRY (memory O(1) in K) seeded
+        # from eval_shape zeros so the step body is traced exactly once
+        fetch_avals, _ = jax.eval_shape(
+            lambda p, c, i, b: step(
+                p, {k: v[0] for k, v in c.items()},
+                jax.random.fold_in(b, i[0])),
+            persist, chunk, idxs, base_key)
+        fetches0 = [jnp.zeros(a.shape, a.dtype) for a in fetch_avals]
+
+        def body(carry, x):
+            p, _ = carry
+            feed_slice, idx = x
+            f, p2 = step(p, feed_slice,
+                         jax.random.fold_in(base_key, idx))
+            return (p2, f), [f[j] for j in stacked_idx]
+
+        (last_persist, last_fetches), stacked = jax.lax.scan(
+            body, (persist, fetches0), (chunk, idxs))
+        return last_fetches, stacked, last_persist
+
+    return pipelined
+
+
+class HostStage:
+    """A host-side exchange riding the chunk boundary.
+
+    ``before_chunk`` runs before the dispatch and may rewrite the K
+    per-step feeds (the sparse pull stages its embedding payloads here
+    — they enter the scan as xs). ``extra_fetch_names`` are fetched
+    PER STEP (stacked ``[K, ...]`` via the scan ys) and handed to
+    ``after_chunk`` once the dispatch settles. ``kind`` feeds the
+    composition rules (engine.rules)."""
+
+    kind = "host"
+
+    def extra_fetch_names(self) -> List[str]:
+        return []
+
+    def before_chunk(self, feeds: List[Dict]) -> List[Dict]:
+        return feeds
+
+    def after_chunk(self, feeds: List[Dict],
+                    stacked: Dict[str, np.ndarray]) -> None:
+        pass
+
+
+class StepEngine:
+    """Drives composed chunks through an Executor.
+
+    ``run_chunk`` is the one entry every host-exchanging caller uses:
+    the GuardedTrainer step (K=1), the PS trainer phase (K=1 + PS
+    stage), and the sparse runtime (K>=1 + sparse stage). Pure
+    on-device callers (run_repeated / run_pipelined / run) call the
+    builders above directly through the executor — same assembly,
+    no host stages."""
+
+    def __init__(self, executor):
+        self._exe = executor
+
+    # -- composition legality (shared with the static matrix) ---------
+    @staticmethod
+    def check_composition(program, k: int = 1,
+                          stages: Sequence[HostStage] = ()):
+        """Raise InvalidArgumentError with the static matrix's exact
+        reason string when the combo is structurally impossible."""
+        bs = getattr(program, "_build_strategy", None)
+        rej = rules.rejection(
+            gradient_sync=getattr(bs, "gradient_sync", None),
+            pipelined=k > 1,
+            ps=any(st.kind == "ps" for st in stages),
+            sparse=any(st.kind == "sparse" for st in stages))
+        if rej is not None:
+            raise InvalidArgumentError(rej[1])
+
+    # -- the composed chunk -------------------------------------------
+    def run_chunk(self, program, feeds: List[Dict], fetch_list=None,
+                  scope=None, stages: Sequence[HostStage] = (),
+                  return_numpy: bool = True):
+        """Run one chunk of ``len(feeds)`` steps with the host stages
+        bracketing the single on-device dispatch:
+
+            stage.before_chunk  (sparse pull: K batches, one RPC round)
+            one dispatch        (K=1: run(); K>1: run_pipelined scan,
+                                 per-step stage fetches stacked as ys)
+            stage.after_chunk   (sparse push / PS exchange, in step
+                                 order — seqs/acks exactly as the
+                                 per-step loop assigned them)
+
+        Returns the LAST step's user fetches (run_pipelined's
+        contract)."""
+        from ..framework import Variable
+        enforce(feeds, "run_chunk needs at least one feed dict")
+        feeds = list(feeds)
+        k = len(feeds)
+        stages = tuple(stages)
+        self.check_composition(program, k=k, stages=stages)
+        fetch_list = list(fetch_list or [])
+        user_names = [f.name if isinstance(f, Variable) else f
+                      for f in fetch_list]
+        extra: List[str] = []
+        for st in stages:
+            for n in st.extra_fetch_names():
+                if n not in extra:
+                    enforce(n not in user_names,
+                            "stage fetch %r collides with a user "
+                            "fetch", n)
+                    extra.append(n)
+        for st in stages:
+            feeds = st.before_chunk(feeds)
+
+        if k == 1:
+            out = self._exe.run(program, feed=feeds[0],
+                                fetch_list=fetch_list + extra,
+                                scope=scope, return_numpy=False)
+            user_out = out[:len(fetch_list)]
+            stacked = {n: np.asarray(v)[None] for n, v in
+                       zip(extra, out[len(fetch_list):])}
+        else:
+            names = sorted(feeds[0])
+            for f in feeds:
+                enforce(sorted(f) == names,
+                        "chunk feeds disagree on keys: %s vs %s",
+                        sorted(f), names)
+            feed_chunk = {n: np.stack([np.asarray(f[n])
+                                       for f in feeds]) for n in names}
+            user_out, stacked_vals = self._exe.run_pipelined(
+                program, feed_chunk, fetch_list=fetch_list,
+                stack_fetch_list=extra, scope=scope,
+                return_numpy=False)
+            stacked = {n: np.asarray(v)
+                       for n, v in zip(extra, stacked_vals)}
+        for st in stages:
+            st.after_chunk(feeds, stacked)
+        if return_numpy:
+            user_out = [np.asarray(v) for v in user_out]
+        return user_out
+
+    def run_step(self, program, feed, fetch_list=None, scope=None,
+                 stages: Sequence[HostStage] = (),
+                 return_numpy: bool = True):
+        """K=1 convenience: one composed step (the GuardedTrainer
+        dispatch unit)."""
+        return self.run_chunk(program, [feed], fetch_list=fetch_list,
+                              scope=scope, stages=stages,
+                              return_numpy=return_numpy)

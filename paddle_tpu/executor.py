@@ -1334,56 +1334,20 @@ class Executor:
         self._book_fresh_sig(cache_key, shape_sig)
 
         def make_fn():
-            carried = frozenset(persist_in)
             self._check_sharded_layout(block)
-            guard_plan = self._guard_plan(program, block)
-
-            def step(persist, feed_vals, step_key):
-                env = dict(persist)
-                env.update(feed_vals)
-                with framework._trace_program_guard(program):
-                    run_block(block, env, step_key, library=library,
-                              anomaly_guard=guard_plan)
-                # scan carries a FIXED structure: exactly the
-                # persistables present when tracing started (vars a
-                # step newly creates cannot join the carry — run the
-                # startup program / one warmup run() first)
-                persist_out = {
-                    n: env[n] if n in env else persist[n]
-                    for n in carried}
-                try:
-                    fetches = [env[n] for n in fetch_names]
-                except KeyError as e:
-                    raise InvalidArgumentError(
-                        "fetch var %r is not produced by this program "
-                        "(known vars: feed %s + program outputs)"
-                        % (e.args[0], sorted(feed_vals))) from e
-                return fetches, persist_out
-
-            def multi(persist, feed_vals, base_key):
-                # the fetches carry (instead of scan ys stacking)
-                # keeps memory O(1) in iters; its initial value comes
-                # from eval_shape-derived zeros so EVERY step runs
-                # inside the scan and the step graph is compiled
-                # exactly once (an inlined step 0 would double the
-                # compile of large models — ResNet-50's scan never
-                # finished compiling through the remote helper with
-                # the body traced twice)
-                fetch_avals, _ = jax.eval_shape(step, persist,
-                                                feed_vals, base_key)
-                fetches0 = [jnp.zeros(a.shape, a.dtype)
-                            for a in fetch_avals]
-
-                def body(carry, i):
-                    p, _ = carry
-                    f, p2 = step(p, feed_vals,
-                                 jax.random.fold_in(base_key, i))
-                    return (p2, f), None
-                (last_persist, last_fetches), _ = jax.lax.scan(
-                    body, (persist, fetches0), jnp.arange(iters))
-                return last_fetches, last_persist
-
-            return jax.jit(multi, donate_argnums=(0,))
+            # scan carries a FIXED structure: exactly the persistables
+            # present when tracing started (vars a step newly creates
+            # cannot join the carry — run the startup program / one
+            # warmup run() first). Step assembly and the O(1)-memory
+            # fetches-in-carry scan both live in the engine.
+            from .engine import build_repeat_fn, build_step
+            step = build_step(program, block, fetch_names,
+                              library=library,
+                              guard_plan=self._guard_plan(program,
+                                                          block),
+                              carried=frozenset(persist_in))
+            return jax.jit(build_repeat_fn(step, iters),
+                           donate_argnums=(0,))
 
         base_key0 = self._base_key(program)
 
@@ -1425,13 +1389,27 @@ class Executor:
 
     def run_pipelined(self, program=None, feed_chunk=None,
                       fetch_list=None, scope=None, return_numpy=True,
-                      library=None):
+                      library=None, stack_fetch_list=None):
         """Run K data-fed steps inside ONE compiled ``lax.scan``
         dispatch: ``feed_chunk`` maps each feed name to an array with
         an EXTRA leading chunk axis ``[K, *batch_shape]``; step ``i``
         of the scan consumes slice ``i`` as its feed. Returns the LAST
         step's fetches, with persistables updated in place exactly as
         K sequential ``run`` calls would.
+
+        ``program`` may be a CompiledProgram: the gradient-sync plan
+        (exact/rs_ag/q8 and the sharded-update bracket) then splices
+        INSIDE the scanned step — guard × collective × bracket × K-step
+        chunk compose into one dispatch on the strategy's mesh, the
+        composition the per-step fallback used to pay K host
+        round-trips for. Only interpreted (eager) programs still
+        unstack to the per-step loop.
+
+        ``stack_fetch_list`` names fetches whose PER-STEP values are
+        additionally returned stacked ``[K, ...]`` (they ride the scan
+        ys) — the chunk-boundary host exchanges' raw material (the
+        StepEngine's sparse push consumes the per-step out-grads).
+        When given, the return value is ``(fetches, stacked_list)``.
 
         This is ``run_repeated`` for REAL data: the fixed-feed scan
         only amortizes dispatch for synthetic benchmarks, while here
@@ -1471,10 +1449,16 @@ class Executor:
             iters = shape[0]
         enforce(iters >= 1, "feed_chunk must hold >= 1 batches")
 
-        if getattr(program, "_is_compiled", False) \
-                or _needs_eager(program):
-            # dist/interpreted programs can't scan the block: unstack
-            # the chunk and drive per-step run() (correct; per-step
+        want_stacked = stack_fetch_list is not None
+        stack_names = [f.name if isinstance(f, framework.Variable)
+                       else f for f in (stack_fetch_list or [])]
+        dist = program if getattr(program, "_is_compiled", False) \
+            else None
+        base = dist.program if dist is not None else program
+
+        if _needs_eager(base):
+            # interpreted programs can't scan the block: unstack the
+            # chunk and drive per-step run() (correct; per-step
             # dispatch cost applies — same contract as run_repeated's
             # fallback, including the hoisted one-time validation).
             prev = FLAGS.op_library
@@ -1482,39 +1466,81 @@ class Executor:
                 FLAGS.op_library = library
             try:
                 out = None
+                rows = [[] for _ in stack_names]
                 for i in range(iters):
                     feed_i = {k: v[i] for k, v in feed_chunk.items()}
-                    out = self.run(program, feed=feed_i,
-                                   fetch_list=fetch_list, scope=scope,
-                                   return_numpy=return_numpy,
-                                   validate_feed=i == 0)
+                    vals = self.run(
+                        program, feed=feed_i,
+                        fetch_list=list(fetch_list) + stack_names,
+                        scope=scope, return_numpy=return_numpy,
+                        validate_feed=i == 0)
+                    out = vals[:len(fetch_list)]
+                    for r, v in zip(rows, vals[len(fetch_list):]):
+                        r.append(np.asarray(v))
             finally:
                 FLAGS.op_library = prev
+            if want_stacked:
+                return out, [np.stack(r) for r in rows]
             return out
 
-        block = program.global_block()
+        block = base.global_block()
         if library is None and FLAGS.op_library:
             library = FLAGS.op_library
         fetch_names = [f.name if isinstance(f, framework.Variable)
                        else f for f in fetch_list]
+        all_fetch_names = fetch_names + stack_names
+        if dist is not None:
+            # fuse pass + sharded/residual state conversion + verify
+            # memo must run BEFORE the persistable snapshot below —
+            # ensure_sharded_state rewrites block shapes AND scope
+            # values (same ordering contract as CompiledProgram.run)
+            dist._prepare_run(scope)
         persist_in = {}
         for name, var in block.vars.items():
             if var.persistable and scope.has_var(name) \
                     and scope.find_var(name) is not None:
                 persist_in[name] = scope.find_var(name)
+        if dist is not None:
+            # lay the carry out on the mesh per the strategy (see
+            # _run_impl — a sharded device_put, no-op when already
+            # correctly placed)
+            for name, val in persist_in.items():
+                want = dist.persist_sharding(block.vars[name])
+                if getattr(val, "sharding", None) != want:
+                    persist_in[name] = jax.device_put(val, want)
         # validate the PER-STEP slice (shape/dtype only — no device
         # readback: ShapeDtypeStructs stand in for the sliced values)
         _check_feed_shape_type(block, {
             k: jax.ShapeDtypeStruct(tuple(v.shape[1:]), v.dtype)
             for k, v in feed_chunk.items()})
         feed_names = tuple(sorted(feed_chunk))
-        cache_key = ("pipelined", program._uid, program._version,
-                     feed_names, tuple(fetch_names),
-                     tuple(sorted(persist_in)), library)
+        mesh_fp = dist._fingerprint() if dist is not None else None
+        # stack_names key the cache SEPARATELY from all_fetch_names:
+        # the user/stacked split is baked into the compiled scan (which
+        # fetch positions ride the ys), so two calls with the same
+        # union but a different split must not share an executable
+        cache_key = ("pipelined", base._uid, base._version,
+                     feed_names, tuple(all_fetch_names),
+                     tuple(stack_names), tuple(sorted(persist_in)),
+                     library, mesh_fp)
         with _profiler.RecordEvent("feed_h2d"):
-            chunk_vals = {k: jnp.asarray(v)
-                          if not isinstance(v, jax.Array) else v
-                          for k, v in feed_chunk.items()}
+            if dist is not None:
+                # batch-shard each per-step slice exactly as run()
+                # would, with the chunk axis replicated in front: dp
+                # shards the batch dim (now dim 1), sp the sequence dim
+                from jax.sharding import NamedSharding, PartitionSpec
+                chunk_vals = {}
+                for k, v in feed_chunk.items():
+                    per_step = dist.feed_sharding(
+                        tuple(np.shape(v))[1:], k)
+                    chunk_vals[k] = jax.device_put(
+                        v, NamedSharding(
+                            dist._mesh,
+                            PartitionSpec(None, *per_step.spec)))
+            else:
+                chunk_vals = {k: jnp.asarray(v)
+                              if not isinstance(v, jax.Array) else v
+                              for k, v in feed_chunk.items()}
         # per-shape compile accounting, on the CONVERTED chunk — the
         # dtypes XLA actually sees (asarray canonicalizes int64
         # labels to int32, so the raw feed dtype would book phantom
@@ -1526,75 +1552,33 @@ class Executor:
         self._book_fresh_sig(cache_key, shape_sig)
 
         def make_fn():
-            carried = frozenset(persist_in)
-            persistable_names = frozenset(
-                n for n, v in block.vars.items() if v.persistable)
-            self._check_sharded_layout(block)
-            guard_plan = self._guard_plan(program, block)
-
-            def step(persist, feed_vals, idx, base_key):
-                env = dict(persist)
-                env.update(feed_vals)
-                step_key = jax.random.fold_in(base_key, idx)
-                with framework._trace_program_guard(program):
-                    run_block(block, env, step_key, library=library,
-                              anomaly_guard=guard_plan)
-                # fixed carry structure — see run_repeated. Unlike
-                # per-step run() (which writes back EVERY persistable
-                # the step produced), a persistable first materialized
-                # inside the scan cannot join the carry — its updates
-                # would be silently discarded each chunk, so detect it
-                # at trace time and say so (the default-on pipelined
-                # train_from_dataset must not silently diverge from
-                # the chunk_size=1 behavior).
-                dropped = sorted(n for n in persistable_names
-                                 if n in env and n not in carried)
-                if dropped:
-                    import warnings
-                    warnings.warn(
-                        "run_pipelined: persistable var(s) %s are "
-                        "first materialized inside the scan; their "
-                        "updates are DISCARDED between chunks. Run "
-                        "the startup program (or one warmup run()) "
-                        "first so they join the carry, or use "
-                        "chunk_size=1." % (dropped,))
-                persist_out = {
-                    n: env[n] if n in env else persist[n]
-                    for n in carried}
-                try:
-                    fetches = [env[n] for n in fetch_names]
-                except KeyError as e:
-                    raise InvalidArgumentError(
-                        "fetch var %r is not produced by this program "
-                        "(known vars: feed %s + program outputs)"
-                        % (e.args[0], sorted(feed_vals))) from e
-                return fetches, persist_out
-
-            def pipelined(persist, chunk, idxs, base_key):
-                # last-step fetches ride the CARRY (memory O(1) in K)
-                # seeded from eval_shape zeros so the step body is
-                # traced exactly once — same shape trick as
-                # run_repeated's multi()
-                fetch_avals, _ = jax.eval_shape(
-                    lambda p, c, i, b: step(
-                        p, {k: v[0] for k, v in c.items()}, i[0], b),
-                    persist, chunk, idxs, base_key)
-                fetches0 = [jnp.zeros(a.shape, a.dtype)
-                            for a in fetch_avals]
-
-                def body(carry, x):
-                    p, _ = carry
-                    feed_slice, idx = x
-                    f, p2 = step(p, feed_slice, idx, base_key)
-                    return (p2, f), None
-
-                (last_persist, last_fetches), _ = jax.lax.scan(
-                    body, (persist, fetches0), (chunk, idxs))
-                return last_fetches, last_persist
-
+            # trace-time only (see _run_impl): the grad-sync plan, the
+            # guard splice, and the chunk scan all assemble in the ONE
+            # step factory (engine/step_engine.py) — collective ×
+            # bracket × guard × K-step chunk compose inside the scan
+            sync_plan = dist.grad_sync_plan(block) if dist is not None \
+                else None
+            self._check_sharded_layout(block, sync_plan)
+            guard_plan = self._guard_plan(base, block)
+            from .engine import build_chunk_fn, build_step
+            step = build_step(base, block, all_fetch_names,
+                              library=library, sync_plan=sync_plan,
+                              guard_plan=guard_plan,
+                              carried=frozenset(persist_in),
+                              warn_dropped=True)
+            pipelined = build_chunk_fn(
+                step, range(len(fetch_names), len(all_fetch_names)))
             # donate the carry AND the feed chunk: the chunk's device
             # buffers are dead once its scan consumed them
-            return jax.jit(pipelined, donate_argnums=(0, 1))
+            jit_kwargs = {"donate_argnums": (0, 1)}
+            if dist is not None:
+                # pin persistable outputs to their input shardings so
+                # parameters keep a stable layout across chunks
+                # (donation then reuses the buffers in place)
+                jit_kwargs["out_shardings"] = (None, None, {
+                    n: dist.persist_sharding(block.vars[n])
+                    for n in persist_in})
+            return jax.jit(pipelined, **jit_kwargs)
 
         @contextlib.contextmanager
         def donation_warning_filter():
@@ -1612,10 +1596,26 @@ class Executor:
             import re
             import warnings
 
-            chunk_avals = {_aval_str(v) for v in chunk_vals.values()}
-            persist_avals = {
-                _aval_str(v) for v in persist_in.values()
-                if hasattr(v, "shape") and hasattr(v, "dtype")}
+            def avals(vals):
+                # XLA names donated buffers by their PER-SHARD aval on
+                # a mesh (global aval on one device) — match both
+                out = set()
+                for v in vals:
+                    if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+                        continue
+                    out.add(_aval_str(v))
+                    sharding = getattr(v, "sharding", None)
+                    if sharding is not None:
+                        try:
+                            out.add(_fmt_aval(
+                                v.dtype,
+                                sharding.shard_shape(v.shape)))
+                        except Exception:
+                            pass
+                return out
+
+            chunk_avals = avals(chunk_vals.values())
+            persist_avals = avals(persist_in.values())
             with warnings.catch_warnings(record=True) as caught:
                 warnings.simplefilter("always")
                 yield
@@ -1630,44 +1630,58 @@ class Executor:
                 warnings.warn_explicit(w.message, w.category,
                                        w.filename, w.lineno)
 
-        base_key0 = self._base_key(program)
+        base_key0 = self._base_key(base)
 
         def obtain():
             return self._executable_for(
-                cache_key, shape_sig, "run_pipelined", program,
+                cache_key, shape_sig, "run_pipelined", base,
                 make_fn,
                 lambda: (persist_in, chunk_vals,
                          jnp.asarray(np.arange(iters, dtype=np.int32)),
                          base_key0),
+                mesh_fp=mesh_fp,
                 compile_ctx=donation_warning_filter)
 
-        exe_fn = obtain()
-        with self._lock:
-            counter = self._run_counter
-            self._run_counter += iters
-            self._dispatch_count += 1
-        self._m_dispatch.inc()
-        self._m_steps.inc(iters)
-        # the failed-settlement guard covers everything between the
-        # count increment and the dispatch settling (see
-        # _note_dispatch_failed)
-        try:
-            idxs = jnp.asarray(np.arange(counter, counter + iters,
-                                         dtype=np.int32))
-            t_dispatch = time.perf_counter()
-            with _profiler.RecordEvent("scan_dispatch",
-                                       args={"steps": int(iters)}):
-                fetches, persist_out = self._call_executable(
-                    exe_fn, (cache_key, shape_sig),
-                    (persist_in, chunk_vals, idxs, base_key0), obtain)
-        except BaseException:
-            self._note_dispatch_failed()
-            raise
+        if dist is not None:
+            # mesh-aware ops (ring_attention, sp/ep lowerings) read the
+            # ambient mesh during tracing
+            from .parallel import mesh as mesh_lib
+            mesh_ctx = mesh_lib.mesh_guard(dist._mesh)
+        else:
+            mesh_ctx = contextlib.nullcontext()
+        with mesh_ctx:
+            exe_fn = obtain()
+            with self._lock:
+                counter = self._run_counter
+                self._run_counter += iters
+                self._dispatch_count += 1
+            self._m_dispatch.inc()
+            self._m_steps.inc(iters)
+            # the failed-settlement guard covers everything between the
+            # count increment and the dispatch settling (see
+            # _note_dispatch_failed)
+            try:
+                idxs = jnp.asarray(np.arange(counter, counter + iters,
+                                             dtype=np.int32))
+                t_dispatch = time.perf_counter()
+                with _profiler.RecordEvent("scan_dispatch",
+                                           args={"steps": int(iters)}):
+                    fetches, stacked, persist_out = \
+                        self._call_executable(
+                            exe_fn, (cache_key, shape_sig),
+                            (persist_in, chunk_vals, idxs, base_key0),
+                            obtain)
+            except BaseException:
+                self._note_dispatch_failed()
+                raise
         self._note_dispatch(time.perf_counter() - t_dispatch, iters)
         for name, val in persist_out.items():
             scope.set_var(name, val)
+        fetches = fetches[:len(fetch_names)]
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
+        if want_stacked:
+            return fetches, [np.asarray(s) for s in stacked]
         return fetches
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -1884,32 +1898,18 @@ class Executor:
         fresh_sig = self._book_fresh_sig(cache_key, shape_sig)
 
         def make_fn():
-            persistable_names = frozenset(
-                n for n, v in block.vars.items() if v.persistable)
             # trace-time only (the closure bakes it into the compiled
             # step), so the block scan stays off the per-step hot path
             sync_plan = dist.grad_sync_plan(block) if dist is not None \
                 else None
             self._check_sharded_layout(block, sync_plan)
             guard_plan = self._guard_plan(program, block)
-
-            def step(persist, feed_vals, step_key):
-                env = dict(persist)
-                env.update(feed_vals)
-                with framework._trace_program_guard(program):
-                    run_block(block, env, step_key, library=library,
-                              grad_sync=sync_plan,
-                              anomaly_guard=guard_plan)
-                persist_out = {n: env[n] for n in persistable_names
-                               if n in env}
-                try:
-                    fetches = [env[n] for n in fetch_names]
-                except KeyError as e:
-                    raise InvalidArgumentError(
-                        "fetch var %r is not produced by this program "
-                        "(known vars: feed %s + program outputs)"
-                        % (e.args[0], sorted(feed_vals))) from e
-                return fetches, persist_out
+            # the ONE step assembly (engine/step_engine.py): guard,
+            # collective, and sharded-bracket splices all live there
+            from .engine import build_step
+            step = build_step(program, block, fetch_names,
+                              library=library, sync_plan=sync_plan,
+                              guard_plan=guard_plan)
 
             if _needs_eager(program):
                 # Interpreted mode: programs with While loops / tensor

@@ -84,10 +84,19 @@ class GuardedTrainer:
                  max_to_keep=3, rollback_after=3, max_rollbacks=2,
                  retry: Optional[RetryPolicy] = None, faults=None,
                  guard: bool = True, sync_saves: bool = False,
-                 hang_deadline_s: Optional[float] = 900.0):
+                 hang_deadline_s: Optional[float] = 900.0,
+                 stages=()):
         from .. import io as io_mod
         from ..core.scope import global_scope
+        from ..engine import StepEngine
         self._exe = executor
+        # every per-step dispatch is one engine-composed step; host
+        # exchanges (the PS phase, the sparse pull/push) ride along as
+        # ``stages`` — composition legality is checked ONCE here, with
+        # the static matrix's exact message (engine.rules)
+        self._engine = StepEngine(executor)
+        self._stages = tuple(stages)
+        StepEngine.check_composition(program, k=1, stages=self._stages)
         # ``program`` may be a CompiledProgram (the q8 collective path):
         # dispatch goes through it, while the guard install and the
         # checkpoint saver operate on the underlying Program
@@ -300,8 +309,10 @@ class GuardedTrainer:
         def run_once():
             if self._faults is not None:
                 self._faults.before_dispatch(step)
-            return self._exe.run(self._program, feed=feed,
-                                 fetch_list=fetch, scope=self._scope)
+            return self._engine.run_step(self._program, feed,
+                                         fetch_list=fetch,
+                                         scope=self._scope,
+                                         stages=self._stages)
 
         fetches, used = retry_call(run_once, self._retry,
                                    on_retry=self._on_retry)

@@ -96,6 +96,13 @@ def pytest_configure(config):
         "markers",
         "control: closed-loop control-plane test (tier-1; select "
         "alone with -m control)")
+    # step-engine suite (paddle_tpu/engine: the one composed step,
+    # the runtime equality matrix, and static/runtime rule parity);
+    # the full matrix sweep also carries -m slow
+    config.addinivalue_line(
+        "markers",
+        "engine: composed step-engine test (tier-1; select alone "
+        "with -m engine)")
 
 
 @pytest.fixture(autouse=True)

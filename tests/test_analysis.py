@@ -404,13 +404,14 @@ class TestZeroFindings:
 class TestCompositionMatrix:
     def test_full_matrix_static_and_clean(self):
         rep = composition_matrix()
-        # 2 guard x 6 sync x 2 pipelined x 2 ps x 2 mesh = 96 combos,
-        # all classified, zero broken — the ROADMAP "seams" CI gate,
-        # now with the model-parallel mesh dimension (PR 13)
-        assert len(rep["combos"]) == 96
+        # 2 guard x 6 sync x 2 pipelined x 2 ps x 2 mesh x 2 sparse
+        # = 192 combos, all classified, zero broken — the ROADMAP
+        # "seams" CI gate, now with the model-parallel mesh dimension
+        # (PR 13) and the sparse-exchange dimension (PR 16)
+        assert len(rep["combos"]) == 192
         assert rep["counts"]["broken"] == 0, rep["broken"]
-        assert rep["counts"]["ok"] == 64
-        assert rep["counts"]["rejected"] == 32
+        assert rep["counts"]["ok"] == 128
+        assert rep["counts"]["rejected"] == 64
         for c in rep["combos"]:
             if c["status"] == "rejected":
                 assert c["reason"], c
@@ -426,12 +427,25 @@ class TestCompositionMatrix:
         # every dp_sp combo that verifies carries the mesh note, and
         # the guard x sp x sharded product is in the verified set
         sp = [c for c in rep["combos"] if c["mesh"] == "dp_sp"]
-        assert len(sp) == 48
+        assert len(sp) == 96
         assert all(any("dp×sp" in n for n in c["notes"])
                    for c in sp if c["status"] == "ok")
         assert any(c["guard"] and c["gradient_sync"] ==
                    "sharded_update_q8" and c["status"] == "ok"
                    for c in sp)
+        # sparse adds NO rejections: its rejected set is exactly the
+        # ps-driven one, and sparse x ps (Downpour dense+sparse) is in
+        # the verified set with the chunk-boundary note
+        sparse = [c for c in rep["combos"] if c["sparse"]]
+        assert len(sparse) == 96
+        assert {(c["ps"], c["pipelined"], c["gradient_sync"])
+                for c in sparse if c["status"] == "rejected"} == \
+               {(c["ps"], c["pipelined"], c["gradient_sync"])
+                for c in rep["combos"] if not c["sparse"]
+                and c["status"] == "rejected"}
+        assert any(c["ps"] and c["status"] == "ok" and
+                   any("Downpour" in n for n in c["notes"])
+                   for c in sparse)
 
     def test_matrix_performs_zero_compiles(self):
         """The whole sweep is static: the process-wide executor

@@ -48,7 +48,12 @@ __all__ = ["load_rounds", "diff", "format_report"]
 # metrics need no new entries — "qps" already covers
 # qps_under_autoscale (name AND unit), and remediation_recovery is
 # lower-is-better by both its "recovery" name and "seconds" unit —
-# but both directions are pinned by tests/test_control.py.
+# but both directions are pinned by tests/test_control.py. The
+# step-engine rows likewise ride the existing patterns:
+# composed_step_overhead is lower-is-better by its "overhead" name
+# (and "% step time" unit), pipelined_sparse_throughput is
+# higher-is-better by its "examples/sec" unit — both directions are
+# pinned by tests/test_step_engine.py.
 _HIGHER_IS_BETTER = re.compile(
     r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps"
     r"|rows/s)",

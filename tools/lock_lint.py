@@ -50,6 +50,7 @@ DEFAULT_PATHS = (
     "paddle_tpu/observability",
     "paddle_tpu/serving",
     "paddle_tpu/distributed",
+    "paddle_tpu/engine",
 )
 
 # mutexes only: semaphores are deliberately NOT tracked — the repo

@@ -50,6 +50,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.analysis",
     "paddle_tpu.distributed",
     "paddle_tpu.serving",
+    "paddle_tpu.engine",
     "paddle_tpu.dataset_factory",
     "paddle_tpu.incubate.data_generator",
     "paddle_tpu.incubate.fleet.base.role_maker",
