@@ -40,8 +40,9 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -52,7 +53,14 @@ from ..parallel.collectives import (SPARSE_Q8_MIN_DIM,
                                     dequantize_rows_q8,
                                     quantize_rows_q8)
 from .embedding_cache import EmbeddingRowCache
-from .rpc import RPCClient
+from .rpc import RPCClient, RpcError, ShardMapChanged
+
+# bounded wait for the reshard commit->activate window: an op that
+# keeps fencing (STATUS_RESHARDED) re-resolves the topology and
+# retries this many times with a short sleep — the window only spans
+# the dirty-delta stream, so it is short by construction
+_RESHARD_RETRIES = 60
+_RESHARD_BACKOFF_S = 0.05
 
 
 class RowSpillStore:
@@ -213,6 +221,11 @@ class RowSpillStore:
     def __len__(self):
         return len(self._index)
 
+    def ids(self) -> List[int]:
+        """Live spilled row ids (newest-copy view) — the cold half of
+        a shard's materialized set, enumerated for reshard planning."""
+        return list(self._index.keys())
+
     def peek(self, rid: int) -> Tuple[np.ndarray,
                                       Optional[np.ndarray]]:
         """Read a spilled row WITHOUT forgetting it -> (row,
@@ -304,6 +317,10 @@ class LargeScaleKV:
             self.resident_rows = max(8, int(resident_bytes) // per_row)
         self._spill = RowSpillStore(spill_dir) \
             if spill_dir is not None else None
+        # armed by reshard prepare (begin_dirty_tracking): unique row
+        # ids pushed while the bulk stream is in flight, re-sent as
+        # the commit delta so no update is lost to the race
+        self._dirty: Optional[set] = None
 
     def _init_row(self, rid: int) -> np.ndarray:
         rs = np.random.RandomState(
@@ -415,6 +432,8 @@ class LargeScaleKV:
                     raise InvalidArgumentError(
                         "sparse optimizer %r (have sgd, adagrad)"
                         % self.optimizer)
+            if self._dirty is not None:
+                self._dirty.update(int(i) for i in uniq)
             self._trim_locked()
 
     def size(self):
@@ -507,6 +526,103 @@ class LargeScaleKV:
                 for j, rid in enumerate(a_ids):
                     self._accum[int(rid)] = np.array(accum[j])
 
+    # -- live-reshard integration (distributed/reshard.py) -----------------
+    def owned_ids(self) -> np.ndarray:
+        """Every MATERIALIZED row id on this shard (resident +
+        spilled), sorted. Rows never touched need no migration at all:
+        lazy init is a pure function of (table seed, rid), so the new
+        owner re-materializes them bit-equal on first touch."""
+        with self._mu:
+            ids = set(int(r) for r in self._rows)
+            if self._spill is not None:
+                ids.update(int(r) for r in self._spill.ids())
+            return np.asarray(sorted(ids), np.int64)
+
+    def export_rows(self, ids) -> Tuple[np.ndarray, np.ndarray,
+                                        np.ndarray]:
+        """-> (values [n, dim], accum_ids, accum rows) for migration.
+        Spilled rows read via ``peek`` so a serving shard's residency
+        (and CLOCK state) is undisturbed by the bulk stream."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._mu:
+            vals = np.zeros((len(ids), self.dim), self.dtype)
+            a_ids: List[int] = []
+            a_rows: List[np.ndarray] = []
+            for j, rid in enumerate(ids):
+                rid = int(rid)
+                row = self._rows.get(rid)
+                acc = self._accum.get(rid)
+                if row is None and self._spill is not None \
+                        and rid in self._spill:
+                    row, s_acc = self._spill.peek(rid)
+                    if acc is None:
+                        acc = s_acc
+                if row is None:
+                    row = self._init_row(rid)
+                vals[j] = row
+                if acc is not None:
+                    a_ids.append(rid)
+                    a_rows.append(acc)
+            accum = np.stack(a_rows) if a_rows else \
+                np.zeros((0, self.dim), self.dtype)
+            return vals, np.asarray(a_ids, np.int64), accum
+
+    def import_rows(self, ids, values, accum_ids=(), accum=None):
+        """Install migrated rows as AUTHORITY: absolute values (not
+        grads) overwrite any resident/spilled copy; optimizer slots
+        travel with their rows. Idempotent by content, so a replayed
+        transfer chunk is harmless. Budget-disciplined like any batch
+        op."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        values = np.asarray(values, self.dtype).reshape(len(ids),
+                                                        self.dim)
+        accum_ids = np.asarray(accum_ids, np.int64).reshape(-1)
+        with self._mu:
+            self._reserve_locked(ids)
+            for j, rid in enumerate(ids):
+                rid = int(rid)
+                if self._spill is not None:
+                    self._spill.discard(rid)
+                self._rows[rid] = np.array(values[j])
+                self._ref[rid] = False
+                self._accum.pop(rid, None)
+            if len(accum_ids):
+                acc = np.asarray(accum, self.dtype).reshape(
+                    len(accum_ids), self.dim)
+                for j, rid in enumerate(accum_ids):
+                    self._accum[int(rid)] = np.array(acc[j])
+            self._trim_locked()
+
+    def drop_rows(self, ids):
+        """Forget rows this shard no longer owns (reshard activate):
+        resident copies, optimizer slots and spill claims all
+        released."""
+        with self._mu:
+            for rid in np.asarray(ids, np.int64).reshape(-1):
+                rid = int(rid)
+                self._rows.pop(rid, None)
+                self._ref.pop(rid, None)
+                self._accum.pop(rid, None)
+                if self._spill is not None:
+                    self._spill.discard(rid)
+
+    def begin_dirty_tracking(self):
+        with self._mu:
+            self._dirty = set()
+
+    def take_dirty(self) -> np.ndarray:
+        """Drain the dirty set (tracking stays armed until
+        ``end_dirty_tracking``) -> sorted unique pushed row ids."""
+        with self._mu:
+            drained = self._dirty or ()
+            if self._dirty is not None:
+                self._dirty = set()
+            return np.asarray(sorted(drained), np.int64)
+
+    def end_dirty_tracking(self):
+        with self._mu:
+            self._dirty = None
+
 
 class LookupServiceClient:
     """Trainer-side prefetch/push over the pserver shards
@@ -548,17 +664,26 @@ class LookupServiceClient:
                  q8_min_dim: int = SPARSE_Q8_MIN_DIM,
                  write_policy: str = "mirror_sgd",
                  mirror_lr: Optional[float] = None,
-                 max_residual_rows: Optional[int] = None):
+                 max_residual_rows: Optional[int] = None,
+                 topology: Optional[Callable[[], List[str]]] = None):
         self.table = table_name
         self.dim = dim
         self.trainer_id = trainer_id
+        self._deadline_s = deadline_s
+        self._retry = retry
+        # () -> current shard endpoint list: consulted when a server
+        # answers STATUS_RESHARDED (the shard map moved under us);
+        # without one, ShardMapChanged propagates to the caller
+        self.topology = topology
+        self.endpoints = list(endpoints)
         self.clients = [RPCClient(ep, deadline_s=deadline_s,
                                   retry=retry, trainer_id=trainer_id)
                         for ep in endpoints]
-        # per-SHARD counters: each shard's _SeqTracker must see a dense
-        # stream or its watermark never compacts (see Communicator
-        # .next_seq)
-        self._seqs = [0] * len(self.clients)
+        # per-ENDPOINT counters: each server's _SeqTracker must see a
+        # dense stream or its watermark never compacts (see
+        # Communicator.next_seq). Keyed by endpoint — not shard index
+        # — so a surviving server keeps its stream across a reshard.
+        self._seqs: Dict[str, int] = {}
         enforce(write_policy in ("mirror_sgd", "invalidate", "none"),
                 "write_policy %r" % (write_policy,))
         enforce(not (cache_bytes and write_policy == "mirror_sgd"
@@ -594,8 +719,21 @@ class LookupServiceClient:
     def _next_seq(self, shard):
         if self.trainer_id is None:
             return None
-        self._seqs[shard] += 1
-        return self._seqs[shard]
+        ep = self.clients[shard].endpoint
+        self._seqs[ep] = self._seqs.get(ep, 0) + 1
+        return self._seqs[ep]
+
+    def _return_seq(self, shard, seq):
+        """Give a seq back to its endpoint's stream: the server
+        REJECTED the push via the reshard route fence BEFORE recording
+        the seq (ps._push_sparse_common orders peek -> route check ->
+        mark), so reusing it keeps the stream dense instead of
+        punching a permanent watermark hole."""
+        if seq is None:
+            return
+        ep = self.clients[shard].endpoint
+        if self._seqs.get(ep) == seq:
+            self._seqs[ep] = seq - 1
 
     def _shard(self, ids):
         return np.asarray(ids, np.int64) % len(self.clients)
@@ -644,21 +782,98 @@ class LookupServiceClient:
             return False
         return self._fence_incarnation()
 
+    # -- live reshard: shard-map fencing ------------------------------------
+    def apply_reshard(self, new_endpoints: List[str]):
+        """Adopt a committed N->M shard map. Surviving endpoints KEEP
+        their RPCClient and their dense per-endpoint seq streams (the
+        server-affine _SeqTracker watermarks stay valid); new
+        endpoints get fresh clients with fresh streams; retired
+        clients close. The hot tier drops wholesale (its rows were
+        keyed under the old map's authority), incarnation baselines
+        re-record lazily. Residuals are keyed by GLOBAL row id —
+        shard-agnostic — so q8 error-feedback memory migrates with
+        its rows for free."""
+        new_endpoints = list(new_endpoints)
+        old = {c.endpoint: c for c in self.clients}
+        clients = []
+        kept = set()
+        for ep in new_endpoints:
+            c = old.get(ep)
+            if c is None:
+                c = RPCClient(ep, deadline_s=self._deadline_s,
+                              retry=self._retry,
+                              trainer_id=self.trainer_id)
+            else:
+                kept.add(ep)
+            clients.append(c)
+        for ep, c in old.items():
+            if ep not in kept:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        self.clients = clients
+        self.endpoints = new_endpoints
+        self._incarnations = {}
+        self.invalidation_count += 1
+        dropped = self.cache.invalidate_all() if self.cache else 0
+        _obs.emit("sparse_shard_map_applied", table=self.table,
+                  n_shards=len(clients), rows_dropped=dropped,
+                  tid=self.trainer_id)
+
+    def _refresh_topology(self, exc: Exception) -> None:
+        """A server fenced us (STATUS_RESHARDED): re-resolve the shard
+        map and adopt it. Without a topology source the fence is the
+        caller's problem."""
+        if self.topology is None:
+            raise exc
+        eps = list(self.topology())
+        _obs.emit("sparse_shard_map_fenced", table=self.table,
+                  tid=self.trainer_id, n_shards=len(eps),
+                  reason=str(exc))
+        self.apply_reshard(eps)
+
     # -- pull path ----------------------------------------------------------
     def _rpc_pull(self, ids: np.ndarray) -> np.ndarray:
-        """Fetch UNIQUE ids from their shards (q8 wire when armed)."""
+        """Fetch UNIQUE ids from their shards (q8 wire when armed).
+        A shard that answers STATUS_RESHARDED no longer owns the rows
+        we asked for: re-resolve the topology and retry JUST the
+        unserved rows under the new map (bounded — the cutover window
+        only spans the dirty-delta stream)."""
         out = np.zeros((len(ids), self.dim), np.float32)
-        shard = self._shard(ids)
-        for s, client in enumerate(self.clients):
-            mask = shard == s
-            if not mask.any():
-                continue
-            if self.pull_q8:
-                q, scales = client.prefetch_q8(self.table, ids[mask])
-                out[mask] = dequantize_rows_q8(q, scales)
-            else:
-                out[mask] = client.prefetch(self.table, ids[mask])
-        return out
+        pending = np.arange(len(ids))
+        fence: Optional[Exception] = None
+        for _attempt in range(_RESHARD_RETRIES):
+            shard = self._shard(ids[pending])
+            served: List[np.ndarray] = []
+            fence = None
+            for s, client in enumerate(self.clients):
+                mask = shard == s
+                if not mask.any():
+                    continue
+                pos = pending[mask]
+                try:
+                    if self.pull_q8:
+                        q, scales = client.prefetch_q8(self.table,
+                                                       ids[pos])
+                        out[pos] = dequantize_rows_q8(q, scales)
+                    else:
+                        out[pos] = client.prefetch(self.table,
+                                                   ids[pos])
+                    served.append(pos)
+                except ShardMapChanged as e:
+                    fence = e
+            if served:
+                pending = np.setdiff1d(pending,
+                                       np.concatenate(served),
+                                       assume_unique=True)
+            if not pending.size:
+                return out
+            self._refresh_topology(fence)   # raises without topology
+            time.sleep(_RESHARD_BACKOFF_S)
+        raise RpcError("UNAVAILABLE: sparse pull on %r kept fencing "
+                       "across %d shard-map refreshes (%s)"
+                       % (self.table, _RESHARD_RETRIES, fence))
 
     def pull(self, ids) -> np.ndarray:
         """Fetch rows for (possibly duplicated) ids; returns
@@ -737,30 +952,62 @@ class LookupServiceClient:
             q = scales = None
             applied = merged
         before = self._reconnects()
-        shard = self._shard(uniq)
         try:
-            for s, client in enumerate(self.clients):
-                mask = shard == s
-                if not mask.any():
-                    continue
-                seq = self._next_seq(s)
-                if self.push_q8:
-                    client.push_sparse_q8(self.table, uniq[mask],
-                                          q[mask], scales[mask],
-                                          seq=seq)
-                    # residuals COMMIT per shard, after its push was
-                    # accepted (or transparently retried to
-                    # acceptance): a shard that fails past the retry
-                    # budget keeps its rows' OLD residuals, so the
-                    # compensation memory of the never-applied
-                    # gradient is not lost — an application-level
-                    # re-push still carries it
-                    for j in np.nonzero(mask)[0]:
-                        self.residuals[int(uniq[j])] = \
-                            comp[j] - applied[j]
-                else:
-                    client.push_sparse(self.table, uniq[mask],
-                                       merged[mask], seq=seq)
+            # the quantized payload is built ONCE (above); a reshard
+            # fence mid-push re-ROUTES surviving row positions under
+            # the new map but never re-quantizes — residuals commit
+            # exactly once per accepted row
+            pending = np.arange(len(uniq))
+            fence: Optional[Exception] = None
+            for _attempt in range(_RESHARD_RETRIES):
+                shard = self._shard(uniq[pending])
+                served: List[np.ndarray] = []
+                fence = None
+                for s, client in enumerate(self.clients):
+                    mask = shard == s
+                    if not mask.any():
+                        continue
+                    pos = pending[mask]
+                    seq = self._next_seq(s)
+                    try:
+                        if self.push_q8:
+                            client.push_sparse_q8(
+                                self.table, uniq[pos], q[pos],
+                                scales[pos], seq=seq)
+                            # residuals COMMIT per shard, after its
+                            # push was accepted (or transparently
+                            # retried to acceptance): a shard that
+                            # fails past the retry budget keeps its
+                            # rows' OLD residuals, so the compensation
+                            # memory of the never-applied gradient is
+                            # not lost — an application-level re-push
+                            # still carries it
+                            for j in pos:
+                                self.residuals[int(uniq[j])] = \
+                                    comp[j] - applied[j]
+                        else:
+                            client.push_sparse(self.table, uniq[pos],
+                                               merged[pos], seq=seq)
+                        served.append(pos)
+                    except ShardMapChanged as e:
+                        # rejected BEFORE the seq was recorded
+                        # server-side: reclaim it (stream stays
+                        # dense), re-route these rows after a refresh
+                        self._return_seq(s, seq)
+                        fence = e
+                if served:
+                    pending = np.setdiff1d(pending,
+                                           np.concatenate(served),
+                                           assume_unique=True)
+                if not pending.size:
+                    break
+                self._refresh_topology(fence)
+                time.sleep(_RESHARD_BACKOFF_S)
+            else:
+                raise RpcError(
+                    "UNAVAILABLE: sparse push on %r kept fencing "
+                    "across %d shard-map refreshes (%s)"
+                    % (self.table, _RESHARD_RETRIES, fence))
         except Exception:
             # partial failure: earlier shards APPLIED server-side but
             # the write-policy block below will not run — drop every

@@ -47,8 +47,9 @@ from ..observability import trace as _trace
 from ..io import (deserialize_tensor, durable_publish_dir,
                   remove_marked_dir, serialize_tensor)
 from ..resilience.retry import RetryBudgetExhausted, RetryPolicy
-from .rpc import (STATUS_ABORTED, STATUS_EVICTED, RPCClient, RPCServer,
-                  RpcError, ServerCrash, StatusReply, TrainerEvicted,
+from .rpc import (STATUS_ABORTED, STATUS_ERROR, STATUS_EVICTED,
+                  STATUS_RESHARDED, RPCClient, RPCServer, RpcError,
+                  ServerCrash, StatusReply, TrainerEvicted,
                   unpack_wire_name)
 
 
@@ -79,6 +80,17 @@ class _SeqTracker:
         self._wm[tid] = wm
         return False
 
+    def peek(self, tid: int, seq: int) -> bool:
+        """True if (tid, seq) was already recorded — WITHOUT recording.
+        The reshard route fence consults this first: a replayed
+        already-applied push must re-ack even if its rows have since
+        migrated (re-routing it would double-apply), while a REJECTED
+        fresh push must leave no trace (its seq returns to the
+        client's stream)."""
+        if seq <= self._wm.get(tid, 0):
+            return True
+        return seq in self._ahead.get(tid, ())
+
     def to_meta(self) -> dict:
         return {"wm": {str(k): int(v) for k, v in self._wm.items()},
                 "ahead": {str(k): sorted(int(x) for x in v)
@@ -96,6 +108,11 @@ class _SeqTracker:
 
 # pseudo-var a GET resolves to the server's incarnation nonce
 INCARNATION_KEY = "__incarnation__"
+
+# pseudo-var a GET resolves to the server's repartition nonce: bumped
+# at every reshard activate, so trainers can fence on "did the shard
+# map move" exactly like they fence on restarts via INCARNATION_KEY
+REPARTITION_KEY = "__repartition__"
 
 # snapshot-array namespace for lookup-table state (PServerRuntime
 # folds each table's export_state() into the shard snapshot under
@@ -160,7 +177,8 @@ class ListenAndServ:
                  lookup_tables=None, lease_timeout_s=None,
                  allow_degraded=None, snapshot_fn=None,
                  snapshot_every=1, restore_meta=None, on_event=None,
-                 barrier_stall_s=120.0, snapshot_tables=False):
+                 barrier_stall_s=120.0, snapshot_tables=False,
+                 partition=None, reshard_standby=False):
         self.server = RPCServer(endpoint)
         self.endpoint = self.server.endpoint
         # any Mapping works — PServerRuntime passes a live scope view
@@ -247,6 +265,25 @@ class ListenAndServ:
         # IS the unit of progress — async servers and pure-sparse
         # servers (no dense params => no sync step barrier to ride)
         self._sparse_boundary = (not sync_mode) or not params
+        # -- elastic membership + live reshard state -------------------
+        self._left = set()               # graceful LEAVEs (quorum shrink)
+        self._pending_joins: List = []   # [(tid, token, responder)]
+        self._join_grants: Dict[str, int] = {}   # token -> granted tid
+        self._joined = set()             # tids ADMITTED via JOIN
+        self._join_outbox: List = []     # [(responder, reply bytes)]
+        # shard-map filter: None = this server owns every row addressed
+        # to it (the pre-elastic contract, fully backward compatible);
+        # (n_shards, index) after a reshard — rows outside the slice
+        # answer STATUS_RESHARDED so clients re-resolve the map
+        self._partition = None if partition is None \
+            else (int(partition[0]), int(partition[1]))
+        # a shard spawned MID-cutover: accepts only IMPORT_ROWS (and
+        # control verbs) until the coordinator's activate flips it live
+        self._standby = bool(reshard_standby)
+        self._repartition = uuid.uuid4().hex.encode()
+        # per-table in-flight migration state (reshard.py), mutated
+        # only on the drain thread between prepare and activate
+        self._migrations: Dict[str, dict] = {}
         if restore_meta:
             self._seen_send = _SeqTracker.from_meta(
                 restore_meta.get("send_seqs"))
@@ -268,6 +305,15 @@ class ListenAndServ:
             self._barrier_released = {
                 int(t): int(e) for t, e in
                 (restore_meta.get("barrier_released") or {}).items()}
+            # elastic membership survives a restart: quorum growth
+            # (joined trainers) and graceful leavers both restore, or
+            # the recovered server would wait on the wrong quorum
+            self._left = set(
+                int(t) for t in restore_meta.get("left", []))
+            self.n_trainers = max(
+                self.n_trainers,
+                int(restore_meta.get("n_trainers",
+                                     self.n_trainers) or 0))
 
         s = self.server
         s.register("SEND", self._on_send)
@@ -283,6 +329,13 @@ class ListenAndServ:
         s.register("PUSH_SPARSE", self._on_push_sparse)
         s.register("PUSH_SPARSE_Q8", self._on_push_sparse_q8)
         s.register("HEARTBEAT", self._on_heartbeat)
+        # elastic membership + live reshard. JOIN defers (the grant is
+        # parked until a step boundary); RESHARD defers (prepare
+        # streams rows from a background thread while serving goes on)
+        s.register_deferred("JOIN", self._on_join)
+        s.register("LEAVE", self._on_leave)
+        s.register_deferred("RESHARD", self._on_reshard)
+        s.register("IMPORT_ROWS", self._on_import_rows)
 
     # -- events / chaos -----------------------------------------------------
     def _event(self, kind, **kw):
@@ -375,14 +428,15 @@ class ListenAndServ:
         # union, not sum: a trainer can be BOTH evicted and completed
         # (a slow-but-alive evictee's COMPLETE still lands) and must
         # shrink the quorum exactly once
-        gone = len(self._evicted | self._completed_tids)
+        gone = len(self._evicted | self._completed_tids | self._left)
         return max(0, self.n_trainers - gone - self._completed)
 
     def _active_tids_locked(self):
         # trainer ids are 0..n-1 (the launcher's PADDLE_TRAINER_ID
-        # contract), so the active universe is knowable server-side
+        # contract, grown by JOIN admissions), so the active universe
+        # is knowable server-side
         return (set(range(self.n_trainers)) - self._evicted
-                - self._completed_tids)
+                - self._completed_tids - self._left)
 
     def _touch_lease_locked(self, tid):
         # traffic renews a lease, but only HEARTBEAT registers one: a
@@ -401,6 +455,12 @@ class ListenAndServ:
                               ("TrainerEvicted: trainer %d lease "
                                "expired on %s" % (tid,
                                                   self.endpoint)).encode())
+        if tid is not None and tid in self._left:
+            # a LEAVE is final: a leaver's straggling sends must not
+            # poison the shrunken-quorum merges
+            raise StatusReply(STATUS_ERROR,
+                              ("trainer %d already left the job on %s"
+                               % (tid, self.endpoint)).encode())
 
     # -- handlers (each runs on the server drain thread) -------------------
     def _on_send(self, name, payload):
@@ -451,8 +511,15 @@ class ListenAndServ:
             active = self._active_tids_locked()
             ready = bool(active) and active <= tids
         if ready:
-            merged = np.sum([g for _, g in self._pending.pop(name)],
-                            axis=0)
+            # merge in TID order, not arrival order: float addition is
+            # commutative but not associative, so at quorum >= 3 an
+            # arrival-order sum makes the trajectory depend on network
+            # timing (a dropped-and-retried SEND would shuffle it).
+            # Sorting keeps sync runs bit-reproducible under faults
+            # and across elastic membership changes.
+            entries = self._pending.pop(name)
+            entries.sort(key=lambda e: (e[0] is None, e[0] or 0))
+            merged = np.sum([g for _, g in entries], axis=0)
             self._apply(name, merged)
 
     def _apply(self, name, grad):
@@ -465,6 +532,8 @@ class ListenAndServ:
         name, tid, _ = unpack_wire_name(name)
         if name == INCARNATION_KEY:
             return self._incarnation
+        if name == REPARTITION_KEY:
+            return self._repartition
         with self._mu:
             self._touch_lease_locked(tid)
             enforce(name in self.params, "no param %r" % name)
@@ -513,16 +582,29 @@ class ListenAndServ:
             stale[-1](STATUS_ABORTED,
                       b"BarrierAborted: superseded by replayed barrier")
         self._release(release)
+        # a step-boundary release may have admitted parked JOINs
+        self._flush_joins()
 
     def _maybe_release_barrier_locked(self):
         """Returns the waiters to release (outside the lock), or None.
         At a sync send-barrier release with no pending merges — a
         consistent end-of-step point — the shard snapshot is taken
         BEFORE the acks go out, so a crash after trainers move on can
-        only restore to a state their replay protocol handles."""
-        if not self._barrier_waiters:
-            return None
-        if len(self._barrier_waiters) < max(1, self._quorum_locked()):
+        only restore to a state their replay protocol handles.
+
+        Membership grows here too: pending JOINs admit at a non-"send"
+        barrier release (the true end-of-step point — the in-flight
+        step completes at its OLD quorum, the NEXT step's merges and
+        barriers require the joiner) or, absent barrier traffic,
+        whenever ``_can_admit_now_locked`` says no sync step can be in
+        flight. Admitting at a SEND-barrier release instead would grow
+        the quorum of the already-started step's fetch barrier, which
+        the joiner never arrives at — a deadlock."""
+        if not self._barrier_waiters or \
+                len(self._barrier_waiters) < max(1,
+                                                 self._quorum_locked()):
+            if self._pending_joins and self._can_admit_now_locked():
+                self._admit_joiners_locked()
             return None
         waiters = list(self._barrier_waiters.values())
         self._barrier_waiters = {}
@@ -537,6 +619,8 @@ class ListenAndServ:
         if self.sync_mode and not self._pending \
                 and "fetch" not in bases:
             self._maybe_snapshot_locked()
+        if self._pending_joins and "send" not in bases:
+            self._admit_joiners_locked()
         return waiters
 
     def _release(self, waiters, status=0, msg=b""):
@@ -566,6 +650,8 @@ class ListenAndServ:
             # RTT instead of re-parking into the recovery quorum
             "barrier_released": {str(t): int(e) for t, e in
                                  self._barrier_released.items()},
+            "left": sorted(self._left),
+            "n_trainers": int(self.n_trainers),
         }
         if self._snapshot_tables:
             # table state lands in the same durable dir (snapshot_fn),
@@ -608,6 +694,164 @@ class ListenAndServ:
             release = self._maybe_release_barrier_locked()
         self._flush_events()
         self._release(release)
+        self._flush_joins()
+        return b""
+
+    # -- elastic membership: JOIN / LEAVE -----------------------------------
+    def _can_admit_now_locked(self):
+        """Membership may grow NOW (not at a barrier release) only
+        when no sync step can be in flight: async mode, a quorum that
+        drained to zero, or a truly idle pre-start server (no barrier
+        ever released, none parked, no partial merges buffered). The
+        window between a send-barrier release and the fetch arrivals
+        LOOKS idle but is mid-step — it fails the _barrier_released
+        check."""
+        if not self.sync_mode:
+            return True
+        if self._quorum_locked() == 0:
+            return True
+        return (not self._barrier_released
+                and not self._barrier_waiters and not self._pending)
+
+    def _next_tid_locked(self):
+        # fresh, never recycled: a retired tid's seq/fence watermarks
+        # must never alias a new trainer's streams
+        n = self.n_trainers
+        for tid, _tok, _r in self._pending_joins:
+            n = max(n, tid + 1)
+        return n
+
+    def _join_reply_locked(self, tid):
+        return json.dumps({"tid": int(tid),
+                           "n_trainers": int(self.n_trainers),
+                           "boundary": int(self._boundary)}).encode()
+
+    def _admit_joiners_locked(self):
+        """Grow membership at this instant (a step boundary or a
+        provably idle point): n_trainers, the active-tid universe, the
+        merge readiness rule and the barrier quorum all move together
+        under the lock. Replies park in the outbox and go out via
+        ``_flush_joins`` AFTER the lock drops."""
+        for tid, _token, responder in self._pending_joins:
+            self.n_trainers = max(self.n_trainers, tid + 1)
+            self._joined.add(tid)
+            self._event_locked("trainer_joined", tid=tid,
+                               n_trainers=self.n_trainers,
+                               boundary=self._boundary)
+            self._join_outbox.append(
+                (responder, self._join_reply_locked(tid)))
+        self._pending_joins = []
+
+    def _flush_joins(self):
+        if not self._join_outbox:
+            return
+        with self._mu:
+            q, self._join_outbox = self._join_outbox, []
+        for responder, reply in q:
+            responder(0, reply)
+
+    def _on_join(self, name, payload, responder):
+        """Admit a NEW trainer (deferred): the grant parks until the
+        next step boundary so the barrier quorum grows atomically —
+        the in-flight step completes at the OLD quorum, the next
+        step's merges require the joiner, and the sync loss trajectory
+        stays exact. Idempotent by ``token``: a lossy-wire replay
+        re-acks the original grant (or supersedes the still-parked
+        responder) instead of admitting twice."""
+        self._drain_beacon.bump()
+        self._chaos_tick("JOIN")
+        req = json.loads(payload.decode() or "{}")
+        token = str(req.get("token") or "")
+        want = req.get("tid")
+        stale = granted = None
+        with self._mu:
+            if self._aborted is not None:
+                raise StatusReply(STATUS_ABORTED,
+                                  ("BarrierAborted: %s"
+                                   % self._aborted).encode())
+            if token and token in self._join_grants:
+                tid = self._join_grants[token]
+                if tid in self._joined:
+                    self._event_locked("dup_join_ack", tid=tid)
+                    granted = self._join_reply_locked(tid)
+                else:
+                    # grant still parked: supersede the stale
+                    # responder (its connection is typically dead)
+                    for k, (t, tok, r) in \
+                            enumerate(self._pending_joins):
+                        if tok == token:
+                            stale = r
+                            self._pending_joins[k] = (t, tok,
+                                                      responder)
+                            break
+            else:
+                tid = int(want) if want is not None \
+                    else self._next_tid_locked()
+                if tid < self.n_trainers or any(
+                        t == tid for t, _, _ in self._pending_joins):
+                    raise StatusReply(
+                        STATUS_ERROR,
+                        ("JOIN: trainer id %d is not fresh on %s "
+                         "(n_trainers=%d)" % (tid, self.endpoint,
+                                              self.n_trainers))
+                        .encode())
+                self._pending_joins.append((tid, token, responder))
+                if token:
+                    self._join_grants[token] = tid
+                self._event_locked("trainer_join_request", tid=tid,
+                                   n_trainers=self.n_trainers,
+                                   boundary=self._boundary)
+                if self._can_admit_now_locked():
+                    self._admit_joiners_locked()
+        self._flush_events()
+        if stale is not None:
+            stale(STATUS_ABORTED,
+                  b"BarrierAborted: superseded by replayed JOIN")
+        if granted is not None:
+            responder(0, granted)
+        self._flush_joins()
+
+    def _on_leave(self, name, payload):
+        """Graceful membership shrink — the eviction path's twin
+        without the forged-merge hazard: the leaver's partial-step
+        grads are DRAINED (discarded, never summed into a
+        smaller-quorum merge), its lease retires, and the barrier
+        quorum shrinks at this boundary; the remaining trainers'
+        parked merges/barriers re-evaluate immediately."""
+        self._drain_beacon.bump()
+        self._chaos_tick("LEAVE")
+        base, tid, _ = unpack_wire_name(name)
+        if tid is None:
+            raise StatusReply(STATUS_ERROR,
+                              b"LEAVE requires a trainer id")
+        release = stale = None
+        with self._mu:
+            if tid not in self._left:
+                self._left.add(tid)
+                self._leases.pop(tid, None)
+                stale = self._barrier_waiters.pop(("t", tid), None)
+                drained = 0
+                for nm, entries in list(self._pending.items()):
+                    kept = [(t, g) for t, g in entries if t != tid]
+                    drained += len(entries) - len(kept)
+                    if kept:
+                        self._pending[nm] = kept
+                    else:
+                        self._pending.pop(nm)
+                self._event_locked("trainer_left", tid=tid,
+                                   boundary=self._boundary,
+                                   n_trainers=self.n_trainers,
+                                   quorum=self._quorum_locked(),
+                                   drained_partials=drained)
+                for nm in list(self._pending):
+                    self._maybe_merge_locked(nm)
+                release = self._maybe_release_barrier_locked()
+        self._flush_events()
+        if stale is not None:
+            stale[-1](STATUS_ABORTED,
+                      b"BarrierAborted: trainer left the job")
+        self._release(release)
+        self._flush_joins()
         return b""
 
     def _on_heartbeat(self, name, payload):
@@ -629,9 +873,43 @@ class ListenAndServ:
                       endpoint=self.endpoint)
         return b""
 
+    def _check_sparse_route(self, table, ids, push):
+        """Live-reshard routing fence (all its state is mutated only
+        on this drain thread, so reads need no lock):
+
+        - a STANDBY shard (spawned mid-cutover) answers everything but
+          IMPORT_ROWS with STATUS_RESHARDED until activated;
+        - after activation, rows outside this shard's (n, index) slice
+          answer STATUS_RESHARDED (the client re-resolves the map);
+        - while a migration is SEALED (commit..activate window) pushes
+          to its MOVING rows are fenced — their final state is already
+          in the dirty-delta stream — but reads keep serving (nobody
+          else owns those rows until activate)."""
+        if self._standby:
+            raise StatusReply(
+                STATUS_RESHARDED,
+                b"shard standby: reshard cutover in progress")
+        a = np.asarray(ids, np.int64).reshape(-1)
+        if self._partition is not None:
+            n, idx = self._partition
+            bad = a % n != idx
+            if bad.any():
+                raise StatusReply(
+                    STATUS_RESHARDED,
+                    ("shard map is %d-way: %d row(s) not owned by "
+                     "shard %d" % (n, int(bad.sum()), idx)).encode())
+        if push:
+            mig = self._migrations.get(table)
+            if mig is not None and mig.get("sealed") and \
+                    (a % mig["n_dst"] != mig["src_index"]).any():
+                raise StatusReply(
+                    STATUS_RESHARDED,
+                    b"reshard cutover: rows migrating off this shard")
+
     def _on_prefetch(self, name, payload):
         name, _, _ = unpack_wire_name(name)
         ids, _ = deserialize_tensor(payload)
+        self._check_sparse_route(name, ids, push=False)
         table = self._table(name)
         return serialize_tensor(table.pull(ids))
 
@@ -643,15 +921,25 @@ class ListenAndServ:
         from ..parallel.collectives import quantize_rows_q8
         name, _, _ = unpack_wire_name(name)
         ids, _ = deserialize_tensor(payload)
+        self._check_sparse_route(name, ids, push=False)
         q, scales = quantize_rows_q8(self._table(name).pull(ids))
         return serialize_tensor(q) + serialize_tensor(scales)
 
-    def _push_sparse_common(self, name, tid, seq, apply_fn):
-        """Shared dedup + apply + boundary skeleton of the exact and
-        q8 push handlers. The apply runs OUTSIDE ``self._mu`` (table
-        rows have their own mutex; the spill tier does disk I/O), then
-        the sparse snapshot boundary ticks where pushes are the unit
-        of progress (async / pure-sparse servers).
+    def _push_sparse_common(self, name, tid, seq, ids, apply_fn):
+        """Shared dedup + route fence + apply + boundary skeleton of
+        the exact and q8 push handlers. The apply runs OUTSIDE
+        ``self._mu`` (table rows have their own mutex; the spill tier
+        does disk I/O), then the sparse snapshot boundary ticks where
+        pushes are the unit of progress (async / pure-sparse servers).
+
+        Ordering is peek -> route fence -> mark-seen -> apply: a
+        replayed ALREADY-APPLIED push re-acks even when its rows have
+        since migrated (re-routing it would double-apply on the new
+        owner — its effect already travelled there inside the migrated
+        row values), while a route-REJECTED fresh push leaves no dedup
+        trace, so the client can return the seq to its stream and
+        re-route the rows without punching a permanent hole in the
+        dense per-endpoint stream the _SeqTracker watermark needs.
 
         Mark-seen-before-apply is safe: every handler (and every
         snapshot site) runs on the ONE server drain thread, so no
@@ -663,14 +951,17 @@ class ListenAndServ:
         try:
             with self._mu:
                 self._touch_lease_locked(tid)
-                if tid is not None and seq is not None:
-                    if self._seen_push.seen(tid, seq):
-                        self._event_locked("dup_push_ignored",
-                                           name=name, tid=tid,
-                                           seq=seq)
-                        return b""
+                if tid is not None and seq is not None and \
+                        self._seen_push.peek(tid, seq):
+                    self._event_locked("dup_push_ignored",
+                                       name=name, tid=tid, seq=seq)
+                    return b""
         finally:
             self._flush_events()
+        self._check_sparse_route(name, ids, push=True)
+        if tid is not None and seq is not None:
+            with self._mu:
+                self._seen_push.seen(tid, seq)
         apply_fn()
         if self._sparse_boundary and self._snapshot_fn is not None:
             with self._mu:
@@ -682,13 +973,13 @@ class ListenAndServ:
         self._drain_beacon.bump()
         self._chaos_tick("PUSH_SPARSE")
         name, tid, seq = unpack_wire_name(name)
+        ids, off = deserialize_tensor(payload)
 
         def apply():
-            ids, off = deserialize_tensor(payload)
             values, _ = deserialize_tensor(payload, off)
             self._table(name).push(ids, values)
 
-        return self._push_sparse_common(name, tid, seq, apply)
+        return self._push_sparse_common(name, tid, seq, ids, apply)
 
     def _on_push_sparse_q8(self, name, payload):
         """Quantized sparse push: dequantize the int8 rows + per-row
@@ -701,14 +992,58 @@ class ListenAndServ:
         self._drain_beacon.bump()
         self._chaos_tick("PUSH_SPARSE_Q8")
         name, tid, seq = unpack_wire_name(name)
+        ids, off = deserialize_tensor(payload)
 
         def apply():
-            ids, off = deserialize_tensor(payload)
-            q, off = deserialize_tensor(payload, off)
-            scales, _ = deserialize_tensor(payload, off)
+            q, off2 = deserialize_tensor(payload, off)
+            scales, _ = deserialize_tensor(payload, off2)
             self._table(name).push(ids, dequantize_rows_q8(q, scales))
 
-        return self._push_sparse_common(name, tid, seq, apply)
+        return self._push_sparse_common(name, tid, seq, ids, apply)
+
+    # -- live reshard (distributed/reshard.py drives these) -----------------
+    def _on_reshard(self, name, payload, responder):
+        """Reshard control verb (deferred): ``prepare`` arms the
+        migration and streams the bulk rows from a background thread
+        (serving continues; the responder answers when the stream
+        lands), while ``commit``/``activate``/``abort``/``ids`` run
+        synchronously ON the drain thread — commit's seal is thereby
+        atomic w.r.t. every push."""
+        from . import reshard as _reshard
+        self._drain_beacon.bump()
+        self._chaos_tick("RESHARD")
+        name, _, _ = unpack_wire_name(name)
+        req = json.loads(payload.decode() or "{}")
+        op = req.get("op")
+        if op == "prepare":
+            _reshard.handle_prepare(self, name, req, responder)
+            return
+        if op == "commit":
+            responder(0, _reshard.handle_commit(self, name, req))
+        elif op == "activate":
+            responder(0, _reshard.handle_activate(self, name, req))
+        elif op == "abort":
+            responder(0, _reshard.handle_abort(self, name, req))
+        elif op == "ids":
+            responder(0, _reshard.handle_ids(self, name))
+        else:
+            raise StatusReply(STATUS_ERROR,
+                              ("unknown reshard op %r" % (op,))
+                              .encode())
+
+    def _on_import_rows(self, name, payload):
+        """Install a peer-to-peer migrated row block (reshard bulk or
+        dirty-delta stream). Accepted regardless of standby/partition
+        state — this is how rows ARRIVE at their new owner — and
+        idempotent by content (absolute values + optimizer slots
+        overwrite)."""
+        from . import reshard as _reshard
+        self._drain_beacon.bump()
+        self._chaos_tick("IMPORT_ROWS")
+        name, _, _ = unpack_wire_name(name)
+        n = _reshard.unpack_rows_into(self._table(name), payload)
+        self._event("rows_imported", table=name, rows=n)
+        return b""
 
     def _table(self, name):
         enforce(name in self.lookup_tables,
@@ -780,6 +1115,7 @@ class ListenAndServ:
                 self._event_locked("barrier_aborted", tids=expired)
         self._flush_events()
         self._release(release)
+        self._flush_joins()
         if evicted_waiters:
             for tid, _, _, r in evicted_waiters:
                 r(STATUS_EVICTED,
@@ -812,8 +1148,10 @@ class ListenAndServ:
         self.start()
         while True:
             with self._mu:
-                done = len(self._completed_tids) + self._completed
-                if done >= self.n_trainers - len(self._evicted):
+                # the active universe already folds evictions, LEAVEs
+                # and JOIN-grown n_trainers; legacy tid-less COMPLETEs
+                # count against it
+                if len(self._active_tids_locked()) <= self._completed:
                     break
                 if self._aborted is not None:
                     break
@@ -823,12 +1161,20 @@ class ListenAndServ:
     def shutdown(self):
         # answer every parked barrier responder BEFORE closing the
         # sockets: a straggler must get a structured BarrierAborted,
-        # not a forever-parked connection (the shutdown-leak fix)
+        # not a forever-parked connection (the shutdown-leak fix).
+        # Granted-but-unflushed JOINs go out first; still-parked JOIN
+        # requests abort the same way the barrier waiters do.
+        self._flush_joins()
         with self._mu:
             waiters = list(self._barrier_waiters.values())
             self._barrier_waiters = {}
+            joins = [r for _t, _tok, r in self._pending_joins]
+            self._pending_joins = []
             if waiters and self._aborted is None:
                 self._aborted = "server shutting down"
+        for r in joins:
+            r(STATUS_ABORTED,
+              b"BarrierAborted: server shutting down")
         if waiters:
             self._release(waiters, STATUS_ABORTED,
                           b"BarrierAborted: server shutting down")
@@ -1177,7 +1523,8 @@ class SparsePServer:
     def __init__(self, endpoint, tables, snapshot_dir=None,
                  snapshot_every=1, n_trainers=1,
                  lease_timeout_s=None, bind_endpoint=None,
-                 barrier_stall_s=None):
+                 barrier_stall_s=None, partition=None,
+                 reshard_standby=False):
         self.tables = dict(tables)
         self._snap = None
         restore_meta = None
@@ -1200,7 +1547,8 @@ class SparsePServer:
             snapshot_every=snapshot_every,
             restore_meta=restore_meta,
             barrier_stall_s=barrier_stall_s,
-            snapshot_tables=self._snap is not None)
+            snapshot_tables=self._snap is not None,
+            partition=partition, reshard_standby=reshard_standby)
         self.endpoint = self.serv.endpoint
 
     def _snapshot(self, boundary, meta):
@@ -1605,6 +1953,83 @@ class ParameterServerRuntime:
         self.stop_heartbeats()
         self.comm.complete_all()
         self.comm.stop()
+
+    def leave(self):
+        """Gracefully RESIGN from a running job (the elastic shrink
+        path). Unlike ``complete()`` the job keeps going at the
+        smaller quorum: each pserver drains this trainer's partial-
+        step grads (never forging them into a smaller-quorum merge),
+        retires its lease, and shrinks the barrier quorum at the
+        boundary."""
+        self.stop_heartbeats()
+        for ep in self._endpoints():
+            self.comm.client(ep).leave()
+        self.comm.stop()
+        _obs.emit("trainer_leave", tid=self.trainer_id)
+
+
+def join_running_job(transpiler, program, scope, sync_mode=True,
+                     token=None, join_deadline_s=60.0,
+                     **runtime_kwargs):
+    """Admit THIS process as a NEW trainer into a RUNNING PS job and
+    return a ready-to-step ParameterServerRuntime (the elastic grow
+    path).
+
+    Protocol: ask the dense pserver for a fresh tid — the grant parks
+    server-side until the next step boundary, so the barrier quorum
+    grows atomically and the sync loss trajectory stays exact — then
+    catch up by adopting the live authority params (``init_params``;
+    the in-flight step's pending merges cannot apply until THIS
+    trainer contributes, so the pull reads a consistent end-of-
+    boundary state — newest snapshot + everything the replay window
+    already applied).
+
+    Sync mode supports a SINGLE dense pserver: multi-server sync
+    admission would need the servers to agree on one admission
+    boundary (each admits at its own barrier release, and a joiner
+    waiting on server B's grant while server A already counts it
+    deadlocks the fetch quorum — see docs/resilience.md §Elastic
+    membership). Async mode joins any number of servers.
+
+    The returned runtime carries ``join_grant`` (the server's grant
+    dict) and ``join_seconds`` (join request -> ready to contribute)
+    — the ``elastic_join_catchup`` bench row."""
+    import uuid as _uuid
+    blocks = transpiler.block_table()
+    eps = sorted({b["endpoint"] for bs in blocks.values()
+                  for b in bs})
+    enforce(not sync_mode or len(eps) == 1,
+            "sync-mode JOIN supports a single dense pserver (got %d:"
+            " servers cannot agree on an admission boundary without "
+            "cross-server coordination)" % len(eps))
+    token = token or _uuid.uuid4().hex
+    t0 = time.monotonic()
+    tid = grant = None
+    for ep in eps:
+        c = RPCClient(ep, deadline_s=join_deadline_s,
+                      retry=RetryPolicy(max_retries=6,
+                                        base_delay=0.05,
+                                        max_delay=0.5, seed=0xE1A57))
+        try:
+            grant = c.join(token, tid=tid)
+        finally:
+            c.close()
+        if tid is None:
+            tid = int(grant["tid"])
+        else:
+            enforce(int(grant["tid"]) == tid,
+                    "JOIN grant mismatch across pservers: %r vs tid "
+                    "%d" % (grant, tid))
+    rt = ParameterServerRuntime(transpiler, program, scope,
+                                sync_mode=sync_mode, trainer_id=tid,
+                                **runtime_kwargs)
+    rt.init_params()
+    rt.join_grant = grant
+    rt.join_seconds = time.monotonic() - t0
+    _obs.emit("trainer_join_catchup", tid=tid,
+              seconds=round(rt.join_seconds, 6),
+              boundary=(grant or {}).get("boundary"))
+    return rt
 
 
 class _PSExchangeStage(HostStage):

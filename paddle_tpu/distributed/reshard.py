@@ -1,0 +1,422 @@
+"""Live pserver N->M shard redistribution (the elastic reshard plane).
+
+The plan follows the portable all-to-all redistribution scheme of
+arXiv:2112.01075: every (src, dst) pair exchanges exactly the row
+slice that MOVES between them, peer to peer — no coordinator ever
+materializes the table, so redistribution bytes are bounded by the
+moving fraction (for modulo sharding N->M that is
+``1 - gcd-overlap``, e.g. 2->3 moves 2/3 of the rows once) instead of
+the 2x full-table gather+scatter of the naive plan, and no
+participant ever holds more than its own source + destination shards.
+
+Cutover protocol (driven by ``execute_reshard``, served by
+``ListenAndServ._on_reshard`` on the drain thread):
+
+1. ``prepare``  — each src arms dirty tracking, then streams its
+   MOVING rows (values + optimizer slots) directly to their new
+   owners in bounded chunks, from a background thread; the OLD
+   partition keeps serving reads AND writes the whole time (racing
+   pushes are recorded dirty).
+2. ``commit``   — the SEAL: runs synchronously on the src's drain
+   thread, so it serializes against every push. From here pushes to
+   MOVING rows answer STATUS_RESHARDED (their final state is about to
+   leave); the dirty∩moving delta streams to the new owners. Reads
+   keep serving — nobody else owns these rows yet.
+3. ``activate`` — every member of the NEW map (surviving srcs and
+   freshly spawned standbys alike) atomically adopts its
+   ``(n_shards, index)`` slice, drops rows it no longer owns, clears
+   standby, and bumps the repartition nonce clients fence on. A
+   retired src activates with index -1 (owns nothing — every late
+   call re-resolves). Only after ALL deltas landed does any new owner
+   start accepting pushes, so the lost-update race is closed by
+   construction.
+4. ``abort``    — disarm dirty tracking and forget the migration
+   (rows already copied are harmless: the old map stays authority).
+
+Untouched rows never move at all: ``LargeScaleKV`` lazy-init is a
+pure function of (table seed, rid), so any owner re-materializes
+them bit-equal on first touch — only MATERIALIZED rows are planned.
+
+Trainer-side, ``LookupServiceClient`` reacts to STATUS_RESHARDED by
+re-resolving its ``topology`` and re-routing only the unserved rows;
+q8 error-feedback residuals are keyed by global row id, so the
+compensation memory migrates with its rows for free.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as _obs
+from ..core.enforce import enforce
+from ..io import deserialize_tensor, serialize_tensor
+from .rpc import RPCClient
+
+DEFAULT_CHUNK_ROWS = 512
+
+
+class ReshardPlanner:
+    """Per-(src, dst) block transfer schedule for modulo sharding
+    N->M (arXiv:2112.01075's portable all-to-all plan, specialized to
+    the ``id % n_shards`` partition this PS plane uses): a row moves
+    iff its owner under the NEW map differs from its current home;
+    stationary rows are excluded from every schedule."""
+
+    def __init__(self, n_src: int, n_dst: int):
+        enforce(n_src >= 1 and n_dst >= 1,
+                "reshard needs >=1 shard on both sides (got %d -> %d)"
+                % (n_src, n_dst))
+        self.n_src = int(n_src)
+        self.n_dst = int(n_dst)
+
+    def owner(self, ids) -> np.ndarray:
+        """New-map owner index per row id."""
+        return np.asarray(ids, np.int64) % self.n_dst
+
+    def moves(self, src_index: int,
+              ids) -> Dict[int, np.ndarray]:
+        """dst index -> sorted moving row ids, for the rows ``ids``
+        currently homed on shard ``src_index``. Rows whose new owner
+        IS ``src_index`` are stationary and never scheduled."""
+        ids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        dst = self.owner(ids)
+        out: Dict[int, np.ndarray] = {}
+        for d in range(self.n_dst):
+            if d == src_index:
+                continue
+            sel = ids[dst == d]
+            if sel.size:
+                out[d] = sel
+        return out
+
+    def moving_fraction(self, ids, src_index: int) -> float:
+        ids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        if not ids.size:
+            return 0.0
+        return float(np.count_nonzero(self.owner(ids) != src_index)
+                     / ids.size)
+
+
+# -- row block wire format ---------------------------------------------------
+def pack_rows(table, ids) -> bytes:
+    """One IMPORT_ROWS payload: (ids, values, accum_ids[, accum]) in
+    the io.py tensor format — bit-equal round trip, optimizer slots
+    travel with their rows."""
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    values, a_ids, accum = table.export_rows(ids)
+    blob = serialize_tensor(ids) + serialize_tensor(values)
+    blob += serialize_tensor(a_ids)
+    if a_ids.size:
+        blob += serialize_tensor(accum)
+    return blob
+
+
+def unpack_rows_into(table, payload: bytes) -> int:
+    """Install one packed row block into ``table``; returns the row
+    count. Absolute-value overwrite => idempotent by content."""
+    ids, off = deserialize_tensor(payload)
+    values, off = deserialize_tensor(payload, off)
+    a_ids, off = deserialize_tensor(payload, off)
+    accum = None
+    if a_ids.size:
+        accum, _ = deserialize_tensor(payload, off)
+    table.import_rows(ids, values, a_ids, accum)
+    return int(len(ids))
+
+
+# -- server-side handlers (called by ListenAndServ._on_reshard on the
+#    drain thread; prepare's stream runs on its own background thread,
+#    and journal emits never happen under any lock — lock_lint clean) -------
+def _dst_client(mig: dict, d: int) -> RPCClient:
+    ep = mig["dst_endpoints"][d]
+    cl = mig["clients"].get(ep)
+    if cl is None:
+        cl = RPCClient(ep, deadline_s=mig["deadline_s"])
+        mig["clients"][ep] = cl
+    return cl
+
+
+def _stream_rows(table_name: str, table, mig: dict, ids) -> dict:
+    """Stream ``ids`` (the rows currently on this src) to their new
+    owners per the plan, in bounded chunks — the src only ever holds
+    one chunk's serialization beyond its own shard."""
+    plan = mig["planner"].moves(mig["src_index"], ids)
+    moved = chunks = 0
+    for d in sorted(plan):
+        cl = _dst_client(mig, d)
+        dids = plan[d]
+        step = mig["chunk_rows"]
+        for lo in range(0, len(dids), step):
+            part = dids[lo:lo + step]
+            cl.import_rows(table_name, pack_rows(table, part))
+            chunks += 1
+            moved += len(part)
+    mig["rows_moved"] += moved
+    return {"rows_moved": moved, "chunks": chunks,
+            "rows_stationary": int(len(np.unique(
+                np.asarray(ids, np.int64))) - moved),
+            # cumulative across prepare+commit for this src
+            "bytes_sent": int(sum(c.bytes_sent
+                                  for c in mig["clients"].values()))}
+
+
+def handle_prepare(serv, table_name: str, req: dict, responder):
+    """Arm the migration and bulk-stream moving rows WITHOUT blocking
+    the drain thread (serving continues under the old map). Dirty
+    tracking arms HERE, on the drain thread, before the stream thread
+    spawns — every push racing the bulk stream is recorded and
+    re-sent by commit's delta."""
+    table = serv._table(table_name)
+    n_dst = int(req["n_dst"])
+    src_index = int(req["src_index"])
+    dsts = [str(e) for e in req["dst_endpoints"]]
+    enforce(len(dsts) == n_dst,
+            "reshard prepare: %d dst endpoints for n_dst=%d"
+            % (len(dsts), n_dst))
+    mig = {
+        "n_dst": n_dst,
+        "src_index": src_index,
+        "dst_endpoints": dsts,
+        "chunk_rows": max(1, int(req.get("chunk_rows")
+                                 or DEFAULT_CHUNK_ROWS)),
+        "deadline_s": float(req.get("deadline_s") or 30.0),
+        "planner": ReshardPlanner(int(req.get("n_src") or 1), n_dst),
+        "sealed": False,
+        "clients": {},
+        "rows_moved": 0,
+    }
+    table.begin_dirty_tracking()
+    serv._migrations[table_name] = mig
+    serv._event("reshard_prepare", table=table_name, n_dst=n_dst,
+                src_index=src_index)
+
+    def stream():
+        t0 = time.monotonic()
+        try:
+            ids = table.owned_ids()
+            stats = _stream_rows(table_name, table, mig, ids)
+            stats.update(phase="prepare", rows_total=int(len(ids)),
+                         seconds=round(time.monotonic() - t0, 6))
+            responder(0, json.dumps(stats).encode())
+        except Exception as e:
+            responder(5, repr(e).encode())   # STATUS_ERROR
+
+    threading.Thread(target=stream, daemon=True,
+                     name="reshard-prepare:%s" % table_name).start()
+
+
+def handle_commit(serv, table_name: str, req: dict) -> bytes:
+    """The SEAL — synchronous on the drain thread, so from its first
+    instruction no push can interleave: mark the migration sealed
+    (pushes to moving rows now fence with STATUS_RESHARDED), then
+    stream the dirty∩moving delta. After this returns, the new owners
+    hold every moving row's final state."""
+    mig = serv._migrations.get(table_name)
+    enforce(mig is not None,
+            "reshard commit without prepare for table %r"
+            % table_name)
+    table = serv._table(table_name)
+    t0 = time.monotonic()
+    mig["sealed"] = True
+    dirty = table.take_dirty()
+    stats = _stream_rows(table_name, table, mig, dirty)
+    stats.update(phase="commit", dirty_rows=int(len(dirty)),
+                 seconds=round(time.monotonic() - t0, 6))
+    serv._event("reshard_committed", table=table_name,
+                dirty_rows=stats["dirty_rows"],
+                rows_moved=stats["rows_moved"])
+    return json.dumps(stats).encode()
+
+
+def handle_activate(serv, table_name: str, req: dict) -> bytes:
+    """Adopt the new map atomically (drain thread): set the
+    ``(n_shards, index)`` partition filter, drop rows this shard no
+    longer owns, clear standby, bump the repartition nonce. Runs on
+    surviving srcs, retired srcs (index -1: own nothing) and fresh
+    standbys alike."""
+    import uuid
+    n_shards = int(req["n_shards"])
+    index = int(req["index"])
+    mig = serv._migrations.pop(table_name, None)
+    dropped = 0
+    if table_name in serv.lookup_tables:
+        table = serv._table(table_name)
+        ids = table.owned_ids()
+        gone = ids[ids % n_shards != index]
+        if gone.size:
+            table.drop_rows(gone)
+            dropped = int(gone.size)
+        table.end_dirty_tracking()
+    if mig is not None:
+        for cl in mig["clients"].values():
+            try:
+                cl.close()
+            except Exception:
+                pass
+    serv._partition = (n_shards, index)
+    serv._standby = False
+    serv._repartition = uuid.uuid4().hex.encode()
+    serv._event("reshard_activated", table=table_name,
+                n_shards=n_shards, index=index, rows_dropped=dropped)
+    return json.dumps({"n_shards": n_shards, "index": index,
+                       "rows_dropped": dropped}).encode()
+
+
+def handle_abort(serv, table_name: str, req: dict) -> bytes:
+    """Roll back a prepared-but-uncommitted migration: the old map
+    stays authority (rows already copied to would-be owners are inert
+    — standbys never activated)."""
+    mig = serv._migrations.pop(table_name, None)
+    if table_name in serv.lookup_tables:
+        serv._table(table_name).end_dirty_tracking()
+    if mig is not None:
+        for cl in mig["clients"].values():
+            try:
+                cl.close()
+            except Exception:
+                pass
+    serv._event("reshard_aborted", table=table_name)
+    return json.dumps({"aborted": mig is not None}).encode()
+
+
+def handle_ids(serv, table_name: str) -> bytes:
+    """Materialized row ids on this shard (planning / the naive
+    baseline's gather leg)."""
+    ids = serv._table(table_name).owned_ids()
+    return json.dumps({"ids": [int(i) for i in ids]}).encode()
+
+
+# -- coordinator --------------------------------------------------------------
+def execute_reshard(table_name: str, old_endpoints: List[str],
+                    new_endpoints: List[str], deadline_s: float = 30.0,
+                    chunk_rows: int = DEFAULT_CHUNK_ROWS) -> dict:
+    """Drive the full two-phase cutover of ``table_name`` from the
+    ``old_endpoints`` partition to ``new_endpoints``. The coordinator
+    carries CONTROL JSON only — row bytes flow src->dst directly
+    (``control_bytes`` in the stats proves it). New endpoints must be
+    serving in ``reshard_standby=True`` mode; retired old endpoints
+    are activated with index -1 so every late call re-resolves.
+
+    Returns {rows_moved, bytes_moved, control_bytes, seconds,
+    prepare/commit/activate per-phase stats}."""
+    from concurrent.futures import ThreadPoolExecutor
+    old = list(old_endpoints)
+    new = list(new_endpoints)
+    t0 = time.monotonic()
+    clients = {ep: RPCClient(ep, deadline_s=deadline_s)
+               for ep in set(old) | set(new)}
+    try:
+        # phase 1: concurrent peer-to-peer bulk streams, old map serves
+        def prep(i_ep):
+            i, ep = i_ep
+            return clients[ep].reshard(table_name, "prepare", {
+                "n_src": len(old), "n_dst": len(new),
+                "src_index": i, "dst_endpoints": new,
+                "chunk_rows": chunk_rows, "deadline_s": deadline_s})
+
+        with ThreadPoolExecutor(max_workers=max(1, len(old))) as pool:
+            prepared = list(pool.map(prep, enumerate(old)))
+        # phase 2: seal each src + stream its dirty delta (fast)
+        committed = [clients[ep].reshard(table_name, "commit", {})
+                     for ep in old]
+        # phase 3: the whole NEW map (and retired srcs) adopts slices;
+        # every delta has landed, so new owners may now accept pushes
+        activated = []
+        for idx, ep in enumerate(new):
+            activated.append(clients[ep].reshard(
+                table_name, "activate",
+                {"n_shards": len(new), "index": idx}))
+        for ep in old:
+            if ep not in new:
+                activated.append(clients[ep].reshard(
+                    table_name, "activate",
+                    {"n_shards": len(new), "index": -1}))
+        stats = {
+            "table": table_name,
+            "n_src": len(old), "n_dst": len(new),
+            "rows_moved": sum(c.get("rows_moved", 0)
+                              for c in prepared + committed),
+            "rows_total": sum(p.get("rows_total", 0)
+                              for p in prepared),
+            "dirty_rows": sum(c.get("dirty_rows", 0)
+                              for c in committed),
+            # commit's bytes_sent is cumulative (prepare + delta) per
+            # src, summed over srcs = total redistribution volume
+            "bytes_moved": sum(c.get("bytes_sent", 0)
+                               for c in committed),
+            "control_bytes": sum(cl.bytes_sent + cl.bytes_recv
+                                 for cl in clients.values()),
+            "seconds": round(time.monotonic() - t0, 6),
+            "prepare": prepared, "commit": committed,
+            "activate": activated,
+        }
+        _obs.emit("reshard_complete", table=table_name,
+                  n_src=len(old), n_dst=len(new),
+                  rows_moved=stats["rows_moved"],
+                  bytes_moved=stats["bytes_moved"],
+                  seconds=stats["seconds"])
+        return stats
+    finally:
+        for cl in clients.values():
+            try:
+                cl.close()
+            except Exception:
+                pass
+
+
+def naive_gather_scatter(table_name: str, old_endpoints: List[str],
+                         new_endpoints: List[str],
+                         deadline_s: float = 30.0,
+                         chunk_rows: int = DEFAULT_CHUNK_ROWS) -> dict:
+    """The plan resharding replaces — bench baseline ONLY: a
+    coordinator PULLS every materialized row off every source shard
+    (gather — the coordinator transiently holds the FULL table), then
+    pushes each row to its new owner (scatter). Roughly 2x the p2p
+    plan's worst-case wire volume, a full-table coordinator memory
+    spike, and it silently DROPS optimizer slots (prefetch returns
+    values only) — all the reasons arXiv:2112.01075 exists. Does not
+    drive the cutover protocol; run it against throwaway servers.
+
+    Returns {bytes, rows, coordinator_rows_held, seconds}."""
+    t0 = time.monotonic()
+    gathered: Dict[int, np.ndarray] = {}
+    wire = 0
+    for ep in old_endpoints:
+        cl = RPCClient(ep, deadline_s=deadline_s)
+        try:
+            ids = np.asarray(
+                cl.reshard(table_name, "ids", {})["ids"], np.int64)
+            for lo in range(0, len(ids), chunk_rows):
+                part = ids[lo:lo + chunk_rows]
+                rows = cl.prefetch(table_name, part)
+                for j, rid in enumerate(part):
+                    gathered[int(rid)] = rows[j]
+            wire += cl.bytes_sent + cl.bytes_recv
+        finally:
+            cl.close()
+    n_dst = len(new_endpoints)
+    all_ids = np.asarray(sorted(gathered), np.int64)
+    for d, ep in enumerate(new_endpoints):
+        sel = all_ids[all_ids % n_dst == d]
+        if not sel.size:
+            continue
+        cl = RPCClient(ep, deadline_s=deadline_s)
+        try:
+            for lo in range(0, len(sel), chunk_rows):
+                part = sel[lo:lo + chunk_rows]
+                vals = np.stack([gathered[int(r)] for r in part])
+                blob = serialize_tensor(part) + serialize_tensor(
+                    np.asarray(vals, np.float32))
+                blob += serialize_tensor(np.zeros(0, np.int64))
+                cl.import_rows(table_name, blob)
+            wire += cl.bytes_sent + cl.bytes_recv
+        finally:
+            cl.close()
+    return {"bytes": int(wire), "rows": int(len(all_ids)),
+            "coordinator_rows_held": int(len(gathered)),
+            "seconds": round(time.monotonic() - t0, 6)}

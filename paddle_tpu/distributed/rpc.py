@@ -52,6 +52,21 @@ VERBS = {
     # twins, so a client may mix precisions mid-run.
     "PUSH_SPARSE_Q8": 10,
     "PREFETCH_Q8": 11,
+    # elastic membership (docs/resilience.md §Elastic membership):
+    # JOIN admits a new trainer into a RUNNING job (payload = JSON
+    # {token, tid?}; the reply is parked until the next step boundary
+    # so barrier quorum grows atomically); LEAVE is the graceful twin
+    # of eviction (partial-step grads drained, quorum shrinks at the
+    # boundary, no forged merges)
+    "JOIN": 12,
+    "LEAVE": 13,
+    # live pserver N->M resharding (distributed/reshard.py): RESHARD
+    # carries the coordinator's prepare/commit/abort control ops;
+    # IMPORT_ROWS is the direct peer-to-peer row-block transfer a
+    # source shard streams to its destinations (ids + rows + adagrad
+    # state) — no coordinator ever materializes the table
+    "RESHARD": 14,
+    "IMPORT_ROWS": 15,
 }
 
 # response status byte (the wire field is u8 — keep codes < 256)
@@ -60,6 +75,7 @@ STATUS_NOT_FOUND = 4
 STATUS_ERROR = 5
 STATUS_ABORTED = 6   # barrier/run aborted server-side (BarrierAborted)
 STATUS_EVICTED = 7   # caller's lease expired and it was evicted
+STATUS_RESHARDED = 8  # shard map changed: re-resolve topology, retry
 
 
 class RpcError(RuntimeError):
@@ -88,6 +104,15 @@ class BarrierAborted(Exception):
 class TrainerEvicted(Exception):
     """THIS trainer's lease expired and the server evicted it from the
     job; its sends/barriers are rejected. Terminal: never retried."""
+
+
+class ShardMapChanged(Exception):
+    """The pserver committed a live reshard and no longer owns the
+    rows this call addressed (or the repartition nonce moved).
+    NOT transport-retriable on the same connection — the caller must
+    re-resolve the shard topology and re-route the surviving rows
+    (LookupServiceClient.apply_reshard does exactly that), so it is
+    deliberately not an RpcError subclass."""
 
 
 class ServerCrash(BaseException):
@@ -496,6 +521,10 @@ class RPCClient:
             raise BarrierAborted(body.decode() or "aborted by server")
         if st == STATUS_EVICTED:
             raise TrainerEvicted(body.decode() or "evicted by server")
+        if st == STATUS_RESHARDED:
+            raise ShardMapChanged(
+                body.decode() or "shard map changed on %s"
+                % self.endpoint)
         if st == STATUS_ERROR:
             raise RemoteHandlerError(
                 "pserver %s handler error on %s(%s): %s"
@@ -561,6 +590,55 @@ class RPCClient:
 
     def complete(self):
         self.call("COMPLETE")
+
+    def join(self, token: str, tid: Optional[int] = None,
+             deadline_s=_UNSET) -> dict:
+        """Ask the server to admit a NEW trainer. The reply is parked
+        server-side until the next step boundary (quorum must grow
+        atomically), so callers should pass a generous deadline. The
+        ``token`` makes the request idempotent under a lossy wire: a
+        retried JOIN with the same token re-acks the original grant
+        instead of admitting a second trainer. Pass ``tid`` to request
+        a specific id (the multi-pserver protocol: first server
+        assigns, the rest confirm). -> grant dict {tid, n_trainers,
+        boundary}."""
+        import json as _json
+        req = {"token": token}
+        if tid is not None:
+            req["tid"] = int(tid)
+        body = self.call("JOIN", "", _json.dumps(req).encode(),
+                         deadline_s=deadline_s)
+        return _json.loads(body.decode())
+
+    def leave(self, deadline_s=_UNSET):
+        """Gracefully resign this trainer (requires trainer_id): the
+        server drains any partial-step grads it sent, shrinks the
+        barrier quorum at the boundary, and never forges a merge on
+        its behalf. Unlike COMPLETE the leaver is simply GONE — the
+        job keeps running with the remaining quorum."""
+        self.call("LEAVE", deadline_s=deadline_s)
+
+    def reshard(self, table: str, op: str, meta: dict,
+                deadline_s=_UNSET) -> dict:
+        """Drive one phase of the two-phase N->M reshard cutover on a
+        source shard: op is 'prepare' (stream the bulk rows
+        peer-to-peer while the old partition keeps serving), 'commit'
+        (drain the dirty delta, drop moved rows, flip the partition +
+        repartition nonce — serialized on the server's drain thread so
+        it is atomic w.r.t. pushes) or 'abort'. -> stats dict."""
+        import json as _json
+        req = dict(meta, op=op)
+        body = self.call("RESHARD", table, _json.dumps(req).encode(),
+                         deadline_s=deadline_s)
+        return _json.loads(body.decode()) if body else {}
+
+    def import_rows(self, table: str, payload: bytes,
+                    seq: Optional[int] = None, deadline_s=_UNSET):
+        """Install a peer-to-peer row block on a DESTINATION shard
+        (reshard.pack_rows layout: ids + values + optimizer slots).
+        ``seq`` dedupes replayed blocks under retry."""
+        self.call("IMPORT_ROWS", table, payload, deadline_s=deadline_s,
+                  seq=seq)
 
     def heartbeat(self, deadline_s=_UNSET, seq: Optional[int] = None):
         """Renew this trainer's liveness lease (requires trainer_id).

@@ -57,6 +57,8 @@ the lock too, since they may call back into arbitrary runtime locks.
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -143,13 +145,23 @@ class ScalingPolicy:
                  up_depth: float = 8.0, down_depth: float = 1.0,
                  sustain_s: float = 3.0, cooldown_s: float = 15.0,
                  min_replicas: int = 1, max_replicas: int = 4,
-                 deadline_s: float = 120.0):
+                 deadline_s: float = 120.0, target: str = "serving",
+                 p99_factor: Optional[float] = None,
+                 p99_floor_ms: float = 0.0):
         if not up_depth > down_depth:
             raise ValueError(
                 "up_depth (%.3g) must exceed down_depth (%.3g) — the "
                 "gap is the hysteresis band" % (up_depth, down_depth))
         if not 1 <= int(min_replicas) <= int(max_replicas):
             raise ValueError("need 1 <= min_replicas <= max_replicas")
+        if target not in ("serving", "trainer", "pserver"):
+            raise ValueError(
+                "target must be 'serving', 'trainer' or 'pserver', "
+                "got %r" % (target,))
+        if p99_factor is not None and not float(p99_factor) > 1.0:
+            raise ValueError(
+                "p99_factor must exceed 1.0 (it multiplies the p99 "
+                "EWMA baseline), got %r" % (p99_factor,))
         self.name = name
         self.up_depth = float(up_depth)
         self.down_depth = float(down_depth)
@@ -158,21 +170,41 @@ class ScalingPolicy:
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.deadline_s = float(deadline_s)
+        # which stateful/stateless plane this policy actuates — purely
+        # declarative (the scaler duck does the plane-specific work)
+        # but ledgered with every decision so the audit can tell a
+        # trainer grow from a serving spawn
+        self.target = target
+        # p99-vs-EWMA: a FIRST-CLASS scale-up trigger next to queue
+        # depth. Fires when the live p99 exceeds ``p99_factor`` x its
+        # own EWMA baseline (and ``p99_floor_ms``, so microsecond
+        # noise on an idle fleet can't trip the ratio), sustained like
+        # the depth trigger. The baseline FREEZES while the trigger is
+        # hot — folding the regression into its own baseline would
+        # normalize it away mid-sustain.
+        self.p99_factor = None if p99_factor is None \
+            else float(p99_factor)
+        self.p99_floor_ms = float(p99_floor_ms)
 
     def describe(self) -> dict:
-        return {"policy": self.name, "trigger": "pressure",
-                "action": "scale", "cooldown_s": self.cooldown_s,
-                "deadline_s": self.deadline_s,
-                "up_depth": self.up_depth,
-                "down_depth": self.down_depth,
-                "sustain_s": self.sustain_s,
-                "min_replicas": self.min_replicas,
-                "max_replicas": self.max_replicas}
+        out = {"policy": self.name, "trigger": "pressure",
+               "action": "scale", "cooldown_s": self.cooldown_s,
+               "deadline_s": self.deadline_s,
+               "up_depth": self.up_depth,
+               "down_depth": self.down_depth,
+               "sustain_s": self.sustain_s,
+               "min_replicas": self.min_replicas,
+               "max_replicas": self.max_replicas,
+               "target": self.target}
+        if self.p99_factor is not None:
+            out["p99_factor"] = self.p99_factor
+            out["p99_floor_ms"] = self.p99_floor_ms
+        return out
 
 
 class _ScalerState:
     __slots__ = ("policy", "scaler", "above_since", "below_since",
-                 "ewma")
+                 "ewma", "p99_ewma")
 
     def __init__(self, policy, scaler):
         self.policy = policy
@@ -180,6 +212,7 @@ class _ScalerState:
         self.above_since: Optional[float] = None
         self.below_since: Optional[float] = None
         self.ewma: Optional[float] = None
+        self.p99_ewma: Optional[float] = None
 
 
 class ControlPlane:
@@ -204,13 +237,22 @@ class ControlPlane:
 
     def __init__(self, watchdog=None, interval_s: float = 0.5,
                  max_actions_per_min: int = 6,
-                 ledger_capacity: int = 256):
+                 ledger_capacity: int = 256,
+                 policy_file: Optional[str] = None):
         self._wd = watchdog
         self.interval_s = float(interval_s)
         self.max_actions_per_min = int(max_actions_per_min)
+        # declarative persistence: policies registered through a NAMED
+        # actuator are mirrored to this JSON file, and start() re-arms
+        # any spec whose actuator name is registered — so a supervisor
+        # restart (new ControlPlane, same policy_file) resumes the
+        # exact policy set it was running, not a blank slate
+        self.policy_file = policy_file
         self._mu = threading.Lock()
         self._policies: List = []         # (policy, actuator)
         self._scalers: List[_ScalerState] = []
+        self._actuators: Dict[str, object] = {}
+        self._specs: List[dict] = []      # persistable policy specs
         # trigger bookkeeping, all RECENCY-BOUNDED (the supervisor is
         # the one process designed never to restart — no set may grow
         # with uptime): keys are seq-monotonic, so oldest-first
@@ -247,15 +289,91 @@ class ControlPlane:
             for d in ("fired", "failed", "suppressed")}
 
     # -- arming -------------------------------------------------------
+    def register_actuator(self, name: str, actuator):
+        """Register a NAMED actuator (a remediation callable or a
+        scaler duck). Names are the persistence seam: a policy armed
+        through a name can be written to ``policy_file`` and re-armed
+        by a future supervisor that registers the same name — the
+        callable itself can't survive a restart, the binding can."""
+        with self._mu:
+            self._actuators[str(name)] = actuator
+        return self
+
+    def _resolve(self, ref):
+        if not isinstance(ref, str):
+            return ref, None
+        with self._mu:
+            act = self._actuators.get(ref)
+        if act is None:
+            raise KeyError(
+                "no actuator registered under %r — call "
+                "register_actuator(name, fn) first" % (ref,))
+        return act, ref
+
+    def _persist_spec(self, spec: dict):
+        """Mirror one persistable policy spec to the policy file
+        (atomic rewrite; only name-bound policies are persistable)."""
+        with self._mu:
+            self._specs = [s for s in self._specs
+                           if s["spec"].get("name") != spec["spec"]
+                           .get("name")] + [spec]
+            specs = list(self._specs)
+        if not self.policy_file:
+            return
+        tmp = self.policy_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"policies": specs}, f, indent=1,
+                      sort_keys=True)
+        os.replace(tmp, self.policy_file)
+
+    def _rearm_from_file(self):
+        """Re-arm persisted policy specs whose actuator names are
+        registered (start()-time; specs for unknown actuators stay in
+        the file untouched — they re-arm when their owner shows up)."""
+        if not self.policy_file or not os.path.exists(self.policy_file):
+            return
+        try:
+            with open(self.policy_file) as f:
+                specs = (json.load(f) or {}).get("policies", [])
+        except Exception as e:
+            _journal.emit("control_plane_error", action="raise",
+                          error="policy_file unreadable: %r" % (e,))
+            return
+        with self._mu:
+            armed = {p.name for p, _ in self._policies} \
+                | {s.policy.name for s in self._scalers}
+            actuators = dict(self._actuators)
+        for entry in specs:
+            spec, act_name = entry.get("spec", {}), entry.get(
+                "actuator")
+            if spec.get("name") in armed or act_name not in actuators:
+                continue
+            if entry.get("type") == "scaling":
+                self.attach_scaler(act_name, ScalingPolicy(**spec))
+            else:
+                self.register_policy(RemediationPolicy(**spec),
+                                     act_name)
+
     def register_policy(self, policy: RemediationPolicy,
-                        actuator: Callable[[dict], object]):
+                        actuator):
         """Arm one remediation policy. ``actuator(ctx)`` runs OUTSIDE
         the control-plane lock with ``ctx`` = {"policy", "reason",
         "problem"?, "event"?}; its return value is ledgered (a dict
         with a ``probe``/``readmit`` pair additionally enters
-        probation — see class docstring)."""
+        probation — see class docstring). ``actuator`` may be a
+        registered actuator NAME, which also makes the policy
+        persistable to ``policy_file``."""
+        act, act_name = self._resolve(actuator)
         with self._mu:
-            self._policies.append((policy, actuator))
+            self._policies.append((policy, act))
+        if act_name is not None:
+            self._persist_spec({
+                "type": "remediation", "actuator": act_name,
+                "spec": {"name": policy.name,
+                         "trigger": policy.trigger,
+                         "action": policy.action,
+                         "cooldown_s": policy.cooldown_s,
+                         "deadline_s": policy.deadline_s}})
         _journal.emit("control_policy_armed", **policy.describe())
         return policy
 
@@ -264,10 +382,28 @@ class ControlPlane:
         """Arm autoscaling over a ``scaler`` duck: ``replica_count()``,
         ``pressure()`` (or a router with one), ``scale_up()``,
         ``scale_down()`` — ``tools/load_gen.FleetScaler`` is the
-        subprocess-fleet implementation."""
+        subprocess-fleet implementation; trainer/pserver elasticity
+        ducks (tools/chaos_run.py) actuate the stateful planes through
+        the same surface. ``scaler`` may be a registered actuator
+        NAME, which also makes the policy persistable."""
         policy = policy or ScalingPolicy()
+        duck, act_name = self._resolve(scaler)
         with self._mu:
-            self._scalers.append(_ScalerState(policy, scaler))
+            self._scalers.append(_ScalerState(policy, duck))
+        if act_name is not None:
+            spec = {"name": policy.name, "up_depth": policy.up_depth,
+                    "down_depth": policy.down_depth,
+                    "sustain_s": policy.sustain_s,
+                    "cooldown_s": policy.cooldown_s,
+                    "min_replicas": policy.min_replicas,
+                    "max_replicas": policy.max_replicas,
+                    "deadline_s": policy.deadline_s,
+                    "target": policy.target}
+            if policy.p99_factor is not None:
+                spec["p99_factor"] = policy.p99_factor
+                spec["p99_floor_ms"] = policy.p99_floor_ms
+            self._persist_spec({"type": "scaling",
+                                "actuator": act_name, "spec": spec})
         _journal.emit("control_policy_armed", **policy.describe())
         return policy
 
@@ -280,6 +416,9 @@ class ControlPlane:
             # OUR registration from another plane's
             self._provider = self.control_block
             _health.register_control_provider(self._provider)
+        # persisted specs re-arm FIRST (so a restarted supervisor's
+        # re-announcements below cover them too)
+        self._rearm_from_file()
         if self._was_stopped:
             # events from the stopped window are history, not
             # triggers: whatever happened while the plane was down was
@@ -287,6 +426,15 @@ class ControlPlane:
             # "history never re-triggers" contract as construction
             self._last_seq = self._watermark()
             self._was_stopped = False
+            # re-announce every armed policy: the audit window after a
+            # restart must see its own control_policy_armed records,
+            # not depend on pre-restart history surviving the ring
+            with self._mu:
+                described = [p.describe() for p, _ in self._policies] \
+                    + [s.policy.describe() for s in self._scalers]
+            for d in described:
+                _journal.emit("control_policy_armed", rearmed=True,
+                              **d)
         if self._thread is None or not self._thread.is_alive():
             self._stop = threading.Event()
             # the loop gets ITS OWN stop event: stop()'s bounded join
@@ -615,7 +763,21 @@ class ControlPlane:
         # reader can see what "normal" looked like when the plane acted
         st.ewma = depth if st.ewma is None \
             else 0.8 * st.ewma + 0.2 * depth
-        if depth >= pol.up_depth:
+        # p99-vs-EWMA trigger: a latency regression is pressure even
+        # when the queue looks shallow (stragglers, a degraded member
+        # slowing its group's executor). Baseline freezes while hot —
+        # see ScalingPolicy.__init__.
+        p99 = p.get("p99_ms")
+        p99_hot = False
+        if pol.p99_factor is not None and p99 is not None:
+            p99 = float(p99)
+            base = st.p99_ewma
+            p99_hot = (base is not None and p99 >= pol.p99_floor_ms
+                       and p99 >= pol.p99_factor * base)
+            if not p99_hot:
+                st.p99_ewma = p99 if base is None \
+                    else 0.8 * base + 0.2 * p99
+        if depth >= pol.up_depth or p99_hot:
             st.above_since = st.above_since or now
             st.below_since = None
             want = "up" if now - st.above_since >= pol.sustain_s \
@@ -642,8 +804,11 @@ class ControlPlane:
             n = int(st.scaler.replica_count())
         except Exception:
             return []
-        reason = "router_pressure_high" if want == "up" \
-            else "router_pressure_low"
+        if want == "up":
+            reason = "router_pressure_high" if depth >= pol.up_depth \
+                else "router_p99_regression"
+        else:
+            reason = "router_pressure_low"
         out_of_bounds = (want == "up" and n >= pol.max_replicas) or \
                         (want == "down" and n <= pol.min_replicas)
         if want == "down" and not out_of_bounds:
@@ -679,7 +844,10 @@ class ControlPlane:
                 self._clear_scaler_notes_locked(pol)
         detail = dict(p, ewma_baseline=round(st.ewma, 4),
                       threshold=pol.up_depth if want == "up"
-                      else pol.down_depth, replicas=n)
+                      else pol.down_depth, replicas=n,
+                      target=pol.target)
+        if st.p99_ewma is not None:
+            detail["p99_ewma_baseline"] = round(st.p99_ewma, 4)
         if suppressed is not None:
             return [self._record(
                 pol.name, "scale_%s" % want, "suppressed", reason,

@@ -288,6 +288,7 @@ class ServingRouter:
             for grp in self._groups:
                 for m in grp.members:
                     self._group_of[m.id] = grp
+        self._next_gid = len(self._groups) if self._groups else 0
         self._rr = itertools.count()
         self._pending = 0
         self._mu = threading.Lock()
@@ -399,6 +400,84 @@ class ServingRouter:
                   endpoint=r.endpoint,
                   replicas=len(self._replicas))
         return snap
+
+    def add_group(self, endpoints) -> int:
+        """Admit one WHOLE sharded replica group into dispatch — the
+        grouped counterpart of ``add_replica`` and the unit
+        ``FleetScaler`` group scale-up actuates. ``endpoints`` must be
+        exactly ``group_size`` members in rank order (member 0 becomes
+        the group executor). Admission is atomic: the group enters the
+        dispatch set in one list swap, so a request either sees the
+        full mesh or none of it — never a partial group."""
+        if self._groups is None:
+            raise InvalidRequest(
+                "add_group on an ungrouped router — scale single "
+                "replicas via add_replica instead")
+        if self._stopped:
+            raise EngineStopped("router is shut down")
+        endpoints = list(endpoints)
+        gs = int(self.config.group_size)
+        if len(endpoints) != gs:
+            raise InvalidRequest(
+                "add_group needs exactly group_size=%d endpoints, "
+                "got %d — a group is admitted whole or not at all"
+                % (gs, len(endpoints)))
+        with self._mu:
+            rids = list(range(self._next_rid, self._next_rid + gs))
+            self._next_rid += gs
+            gid = self._next_gid
+            self._next_gid += 1
+        # construct outside the lock (gauge registration), then admit
+        # with atomic swaps so dispatch never sees a partial group
+        members = [_Replica(rid, ep, self.config)
+                   for rid, ep in zip(rids, endpoints)]
+        grp = _ReplicaGroup(gid, members)
+        with self._mu:
+            self._replicas = self._replicas + members
+            self._groups = self._groups + [grp]
+            for m in members:
+                self._group_of[m.id] = grp
+        for m in members:
+            self._start_health_thread(m)
+        _obs.emit("group_added", group=gid,
+                  members=[m.id for m in members],
+                  executor=grp.primary.id, groups=len(self._groups))
+        return gid
+
+    def remove_group(self, gid: int) -> dict:
+        """Retire one whole replica group from dispatch (group
+        scale-down actuator): the group leaves the dispatch set in one
+        swap, every member is marked retired, and the final member
+        snapshots come back so the caller can reap the processes."""
+        if self._groups is None:
+            raise InvalidRequest("remove_group on an ungrouped router")
+        with self._mu:
+            grp = next((g for g in self._groups if g.id == gid), None)
+            if grp is None:
+                raise InvalidRequest("no group %d to remove" % gid)
+            if len(self._groups) <= 1:
+                raise InvalidRequest(
+                    "refusing to remove the last group — a router "
+                    "needs >= 1 dispatch target")
+            gone = {m.id for m in grp.members}
+            self._groups = [g for g in self._groups if g.id != gid]
+            self._replicas = [r for r in self._replicas
+                              if r.id not in gone]
+            for rid in gone:
+                self._group_of.pop(rid, None)
+        snaps = {}
+        for m in grp.members:
+            with m.mu:
+                m.retired = True
+                m.healthy = False
+                m._gauge.set(0)
+                _obs.registry().remove_series(
+                    "router_replica_queue_depth", replica=str(m.id))
+            snaps[str(m.id)] = m.snapshot()
+            m.close_clients()
+        _obs.emit("group_retired", group=gid,
+                  members=sorted(gone), groups=len(self._groups))
+        return snaps
 
     def _replica_by_id(self, rid: int) -> "_Replica":
         r = next((x for x in self._replicas if x.id == rid), None)

@@ -103,6 +103,14 @@ def pytest_configure(config):
         "markers",
         "engine: composed step-engine test (tier-1; select alone "
         "with -m engine)")
+    # elastic-membership suite (trainer JOIN/LEAVE, pserver live
+    # resharding, group-atomic scaling): loopback RPC, CPU-fast; the
+    # acceptance scenario also carries -m chaos, the multi-seed sweep
+    # and real-subprocess group scaling carry -m slow
+    config.addinivalue_line(
+        "markers",
+        "elastic: elastic membership (join/leave/reshard) test "
+        "(tier-1; select alone with -m elastic)")
 
 
 @pytest.fixture(autouse=True)

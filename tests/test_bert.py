@@ -3,6 +3,7 @@ learns, and data-parallel loss trace matches single-device (the
 test_dist_base.py:316 loss-equality methodology)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers, optimizer
@@ -88,6 +89,10 @@ def _dp_losses(compiled, steps=6):
     return losses
 
 
+# tier-1 headroom (PR 17): ~22 s dp-equality twin -> slow; dp
+# equality stays via test_model_parallel.py dp/sp cells and
+# test_fleet.py::test_two_process_loss_equals_local
+@pytest.mark.slow
 def test_bert_dp_matches_single_device():
     single = _dp_losses(False)
     dp = _dp_losses(True)

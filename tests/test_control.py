@@ -981,6 +981,251 @@ class TestBenchDiffDirections:
         drop = self._diff("remediation_recovery", unit, 6.0, 1.5)
         assert drop["flags"] == []
 
+    def test_elastic_join_catchup_lower_is_better(self):
+        unit = "seconds (request -> first contributing step)"
+        rise = self._diff("elastic_join_catchup", unit, 0.2, 2.0)
+        assert [f["flag"] for f in rise["flags"]] == ["REGRESSION"]
+        drop = self._diff("elastic_join_catchup", unit, 2.0, 0.2)
+        assert drop["flags"] == []
+
+    def test_reshard_bytes_lower_is_better(self):
+        unit = "bytes on wire (p2p plan, 2->3 shards)"
+        rise = self._diff("reshard_bytes", unit, 60000, 190000)
+        assert [f["flag"] for f in rise["flags"]] == ["REGRESSION"]
+        drop = self._diff("reshard_bytes", unit, 190000, 60000)
+        assert drop["flags"] == []
+
+
+# ---------------------------------------------------------------------------
+# p99-vs-EWMA: the latency-regression scaling trigger (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+class _P99Scaler(_FakeScaler):
+    def __init__(self, replicas=1):
+        super().__init__(replicas)
+        self.p99 = None
+
+    def pressure(self):
+        p = super().pressure()
+        if self.p99 is not None:
+            p["p99_ms"] = self.p99
+        return p
+
+
+def _p99_policy(**kw):
+    """A policy only the p99 trigger can fire: depth thresholds are
+    pushed out of reach on both sides."""
+    kw.setdefault("up_depth", 1e9)
+    kw.setdefault("down_depth", -1.0)
+    kw.setdefault("sustain_s", 0.0)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    return ScalingPolicy("p99pol", **kw)
+
+
+class TestP99Trigger:
+    def test_regression_vs_own_ewma_fires_scale_up(self):
+        sc = _P99Scaler()
+        cp = ControlPlane(watchdog=_StubWatchdog())
+        cp.attach_scaler(sc, _p99_policy(p99_factor=2.0,
+                                         p99_floor_ms=5.0))
+        sc.p99 = 10.0
+        for _ in range(4):                 # build the baseline
+            assert cp.tick() == []
+        sc.p99 = 25.0                      # 2.5x the ~10ms EWMA
+        mark = obs.emit("p99_probe")["seq"]
+        recs = cp.tick()
+        assert [r["decision"] for r in recs] == ["fired"]
+        assert recs[0]["action"] == "scale_up"
+        assert recs[0]["reason"] == "router_p99_regression"
+        # the causal control_signal carries the frozen baseline
+        sigs = [e for e in obs.journal_events(since_seq=mark)
+                if e["kind"] == "control_signal"
+                and e["reason"] == "router_p99_regression"]
+        assert sigs and sigs[-1]["p99_ewma_baseline"] < 25.0
+        assert sigs[-1]["target"] == "serving"
+        assert sc.ups == 1
+
+    def test_baseline_frozen_while_hot(self):
+        """A sustained regression must not teach the EWMA that slow
+        is normal: while the trigger condition holds, the baseline
+        does not absorb the hot samples (cooldown owns re-fire
+        pacing); once p99 recovers, tracking resumes."""
+        sc = _P99Scaler()
+        cp = ControlPlane(watchdog=_StubWatchdog())
+        cp.attach_scaler(sc, _p99_policy(p99_factor=2.0,
+                                         cooldown_s=3600.0))
+        sc.p99 = 10.0
+        for _ in range(4):
+            cp.tick()
+        st = cp._scalers[0]
+        base = st.p99_ewma
+        assert base is not None and abs(base - 10.0) < 1e-6
+        sc.p99 = 50.0
+        assert cp.tick()[0]["decision"] == "fired"
+        for _ in range(3):                 # still hot, inside cooldown
+            cp.tick()
+        assert st.p99_ewma == base         # frozen, not 50-polluted
+        sc.p99 = 15.0                      # recovered: tracking resumes
+        cp.tick()
+        assert st.p99_ewma != base
+
+    def test_floor_suppresses_microsecond_noise(self):
+        sc = _P99Scaler()
+        cp = ControlPlane(watchdog=_StubWatchdog())
+        cp.attach_scaler(sc, _p99_policy(p99_factor=2.0,
+                                         p99_floor_ms=5.0))
+        sc.p99 = 0.1
+        for _ in range(4):
+            cp.tick()
+        sc.p99 = 0.9                       # 9x the baseline, sub-floor
+        assert cp.tick() == []
+        assert sc.ups == 0
+
+    def test_factor_must_exceed_one(self):
+        with pytest.raises(Exception):
+            ScalingPolicy("bad", p99_factor=0.9)
+
+    def test_target_validated_and_described(self):
+        pol = ScalingPolicy("t", target="pserver", p99_factor=1.5)
+        d = pol.describe()
+        assert d["target"] == "pserver"
+        assert d["p99_factor"] == 1.5
+        with pytest.raises(Exception):
+            ScalingPolicy("bad", target="toaster")
+
+
+# ---------------------------------------------------------------------------
+# ScalingPolicy persistence: policies survive supervisor restarts
+# ---------------------------------------------------------------------------
+
+class TestPolicyPersistence:
+    def test_stop_start_rearms_and_rewatermarks(self):
+        """A stop()/start() cycle re-announces every armed policy
+        (``control_policy_armed`` with ``rearmed=True`` — the
+        post-restart audit window must be self-contained) and
+        re-watermarks the journal cursor so events from the stopped
+        window are history, never triggers."""
+        wd = _StubWatchdog()
+        cp = ControlPlane(watchdog=wd, interval_s=30.0)
+        fired = []
+        cp.register_policy(
+            RemediationPolicy("r", "event:boom", "fix",
+                              cooldown_s=0.0),
+            lambda ctx: fired.append(1))
+        cp.attach_scaler(_FakeScaler(), ScalingPolicy(
+            "s", up_depth=1e9, down_depth=-1.0, target="trainer"))
+        cp.start()
+        cp.stop()
+        stale = obs.emit("boom", n=1)      # lands while the plane is down
+        mark = stale["seq"]
+        cp.start()
+        try:
+            assert cp._last_seq >= mark    # re-watermarked past it
+            assert cp.tick() == []         # history never re-triggers
+            assert fired == []
+            rearmed = [e for e in obs.journal_events(since_seq=mark)
+                       if e["kind"] == "control_policy_armed"
+                       and e.get("rearmed")]
+            assert {e["policy"] for e in rearmed} == {"r", "s"}
+        finally:
+            cp.stop()
+
+    def test_policy_file_round_trip_into_fresh_plane(self, tmp_path):
+        """Named-actuator policies persist as declarative specs: a
+        FRESH ControlPlane (new supervisor process) pointed at the
+        same policy_file re-arms both policy kinds on start(), with
+        every knob — including the p99 trigger and the target
+        surface — intact."""
+        pf = str(tmp_path / "policies.json")
+        sc1 = _FakeScaler()
+        p1 = ControlPlane(watchdog=_StubWatchdog(), policy_file=pf)
+        p1.register_actuator("fleet", sc1)
+        p1.register_actuator("fixer", lambda ctx: {"ok": True})
+        p1.attach_scaler("fleet", ScalingPolicy(
+            "elastic", up_depth=7.0, down_depth=2.0, sustain_s=1.5,
+            cooldown_s=9.0, min_replicas=2, max_replicas=5,
+            target="trainer", p99_factor=2.5, p99_floor_ms=4.0))
+        p1.register_policy(
+            RemediationPolicy("heal", "event:boom", "fix",
+                              cooldown_s=11.0), "fixer")
+        spec = json.load(open(pf))
+        assert {s["spec"]["name"] for s in spec["policies"]} == \
+            {"elastic", "heal"}
+        sc2 = _FakeScaler()
+        p2 = ControlPlane(watchdog=_StubWatchdog(), policy_file=pf)
+        p2.register_actuator("fleet", sc2)
+        p2.register_actuator("fixer", lambda ctx: {"ok": True})
+        p2.start()
+        try:
+            assert len(p2._scalers) == 1 and len(p2._policies) == 1
+            d = p2._scalers[0].policy.describe()
+            assert d["target"] == "trainer"
+            assert d["p99_factor"] == 2.5
+            assert d["up_depth"] == 7.0
+            assert d["sustain_s"] == 1.5
+            assert d["max_replicas"] == 5
+            assert p2._scalers[0].scaler is sc2
+            assert p2._policies[0][0].cooldown_s == 11.0
+            # the trigger actually works through the re-armed binding
+            # (sustain_s persisted as 1.5, so the started loop takes
+            # a couple of ticks to fire)
+            sc2.depth = 100.0
+            assert _wait_for(lambda: sc2.ups >= 1, timeout=10.0)
+        finally:
+            p2.stop()
+
+    def test_rearm_skips_unregistered_actuators(self, tmp_path):
+        """Specs whose actuator name has no registration in THIS
+        supervisor re-arm nothing (and nothing raises): a policy file
+        shared across heterogeneous supervisors arms only what each
+        one can actually drive."""
+        pf = str(tmp_path / "policies.json")
+        p1 = ControlPlane(watchdog=_StubWatchdog(), policy_file=pf)
+        p1.register_actuator("fleet", _FakeScaler())
+        p1.attach_scaler("fleet", ScalingPolicy("elastic"))
+        p2 = ControlPlane(watchdog=_StubWatchdog(), policy_file=pf)
+        p2.start()
+        try:
+            assert p2._scalers == []
+        finally:
+            p2.stop()
+
+    def test_inflight_decision_ledgered_across_stop(self):
+        """stop() while an actuator is mid-flight: the decision is
+        NEVER dropped — the tick's finally block lands the record in
+        the ledger (and journal) even as the plane shuts down."""
+        entered = threading.Event()
+
+        class _SlowScaler(_FakeScaler):
+            def scale_up(self):
+                entered.set()
+                time.sleep(0.8)
+                return super().scale_up()
+
+        sc = _SlowScaler()
+        cp = ControlPlane(watchdog=_StubWatchdog(), interval_s=0.02)
+        cp.attach_scaler(sc, ScalingPolicy(
+            "s", up_depth=1.0, down_depth=-1.0, sustain_s=0.0,
+            cooldown_s=0.0, max_replicas=4))
+        sc.depth = 50.0
+        mark = obs.emit("persist_probe")["seq"]
+        cp.start()
+        try:
+            assert entered.wait(timeout=8.0)
+        finally:
+            cp.stop()                      # joins the in-flight tick
+        led = [r for r in cp.ledger()
+               if r["decision"] == "fired"
+               and r["action"] == "scale_up"]
+        assert led, "in-flight decision dropped at stop()"
+        assert sc.ups >= 1
+        acted = [e for e in obs.journal_events(since_seq=mark)
+                 if e["kind"] == "control_action"
+                 and e.get("action") == "scale_up"]
+        assert acted, "ledgered record never reached the journal"
+
 
 # ---------------------------------------------------------------------------
 # lock_lint gate over the new module
@@ -1051,6 +1296,13 @@ class TestWarmScaleUp:
         assert warm[-1]["buckets"], warm
 
 
+# tier-1 headroom (PR 17): the full closed-loop scenario (~54 s:
+# SIGKILL respawn + wedged batcher + flaky-pserver quarantine under
+# live load) rides -m slow; the control-plane end-to-end class
+# stays in tier-1 via TestElasticScenario (scale actions + audit
+# through the same plane), TestWarmScaleUp, and the in-memory
+# rail/probation/audit units above. CLI chaos suite unchanged.
+@pytest.mark.slow
 @pytest.mark.chaos
 class TestControlLoopScenario:
     def test_closed_loop_chaos_scenario(self):

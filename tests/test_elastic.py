@@ -1,0 +1,506 @@
+"""Elastic membership (docs/resilience.md §Elastic membership):
+trainer JOIN/LEAVE against a live sync PS job (fresh tids, boundary-
+atomic quorum growth, graceful drain on leave), the ``ReshardPlanner``
+p2p transfer schedule + the two-phase pserver cutover, router
+group-atomic membership (``add_group``/``remove_group``) and the
+``FleetScaler`` group path over it, the engine-seam guarantee that
+membership changes never enter the step trace (zero recompiles), the
+lock_lint gate pinning ``distributed/reshard.py`` in the scan set, and
+— under ``-m chaos`` — the ``elastic_2_3_2`` acceptance scenario
+(multi-seed sweep and the real-subprocess group spawn ride ``-m
+slow``)."""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import (LargeScaleKV, LookupServiceClient,
+                                    ParameterServerRuntime,
+                                    PServerRuntime, SparsePServer)
+from paddle_tpu.distributed.ps import join_running_job
+from paddle_tpu.distributed.reshard import (ReshardPlanner,
+                                            execute_reshard,
+                                            naive_gather_scatter)
+from paddle_tpu.distributed.rpc import RPCClient, ShardMapChanged
+from paddle_tpu.transpiler import DistributeTranspiler
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+sys.path.insert(0, TOOLS)
+
+pytestmark = pytest.mark.elastic
+
+
+def _build(n_trainers, seed=5):
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = seed
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [8], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            pred = layers.fc(x, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.3).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=start,
+                pservers="127.0.0.1:0", trainers=n_trainers)
+    return t, start, loss
+
+
+def _feed(seed=3, n=64):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.rand(n, 8).astype(np.float32),
+            "label": rs.randint(0, 4, (n, 1)).astype(np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# ReshardPlanner: the p2p schedule (arXiv:2112.01075 style)
+# ---------------------------------------------------------------------------
+
+class TestReshardPlanner:
+    def test_only_owner_changing_rows_scheduled(self):
+        p = ReshardPlanner(2, 3)
+        ids = np.arange(60)
+        home0 = ids[ids % 2 == 0]          # rows currently on shard 0
+        plan = p.moves(0, home0)
+        # no self-transfers, ever
+        assert 0 not in plan
+        # every scheduled row's NEW owner is the schedule's dst, and
+        # differs from its current home
+        for d, rows in plan.items():
+            assert (rows % 3 == d).all()
+            assert (rows % 3 != 0).all()
+        # stationary rows (new owner == current home) appear nowhere
+        stationary = home0[home0 % 3 == 0]
+        scheduled = np.concatenate(list(plan.values()))
+        assert not np.intersect1d(stationary, scheduled).size
+        # and the union of moving + stationary is exactly the shard
+        assert np.array_equal(
+            np.sort(np.concatenate([stationary, scheduled])), home0)
+
+    def test_shrink_schedule(self):
+        p = ReshardPlanner(3, 2)
+        home2 = np.arange(2, 90, 3)        # shard 2 of 3
+        plan = p.moves(2, home2)
+        # a retiring shard owns nothing under the new map: every row
+        # moves, split across the survivors
+        assert set(plan) <= {0, 1}
+        assert sum(len(v) for v in plan.values()) == len(home2)
+
+    def test_moving_fraction_and_validation(self):
+        p = ReshardPlanner(2, 3)
+        ids = np.arange(0, 600, 2)
+        frac = p.moving_fraction(ids, 0)
+        assert 0.0 < frac < 1.0
+        assert p.moving_fraction(np.array([], np.int64), 0) == 0.0
+        with pytest.raises(Exception):
+            ReshardPlanner(0, 3)
+
+
+# ---------------------------------------------------------------------------
+# JOIN/LEAVE protocol units
+# ---------------------------------------------------------------------------
+
+class TestJoinLeaveUnit:
+    def test_join_idempotent_by_token_fresh_tids_never_recycled(self):
+        t, start, _ = _build(1)
+        s = PServerRuntime(t, t.pserver_endpoints[0])
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+        s.serv.start()
+        try:
+            c = RPCClient(s.serv.endpoint, deadline_s=5.0)
+            try:
+                g1 = c.join("tok-a")
+                # a retried JOIN (dropped ack, client replay) returns
+                # the SAME grant — admission happened exactly once
+                g2 = c.join("tok-a")
+                assert g1["tid"] == g2["tid"]
+                assert g2["n_trainers"] == g1["n_trainers"]
+                g3 = c.join("tok-b")
+                assert g3["tid"] != g1["tid"]
+                assert g3["n_trainers"] == g1["n_trainers"] + 1
+            finally:
+                c.close()
+        finally:
+            s.serv.shutdown()
+
+    def test_sync_join_requires_single_dense_pserver(self):
+        main, start = fluid.Program(), fluid.Program()
+        main.random_seed = start.random_seed = 5
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, start):
+                x = layers.data("x", [8], dtype="float32")
+                label = layers.data("label", [1], dtype="int64")
+                pred = layers.fc(x, size=4, act="softmax")
+                loss = layers.mean(layers.cross_entropy(pred, label))
+                fluid.optimizer.SGD(0.3).minimize(loss)
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, startup_program=start,
+                    pservers="127.0.0.1:6871,127.0.0.1:6872",
+                    trainers=1)
+        with pytest.raises(Exception, match="single dense pserver"):
+            join_running_job(t, t.get_trainer_program(), fluid.Scope())
+
+
+class TestElasticDense:
+    def test_join_contribute_leave_full_cycle(self):
+        """The tier-1 elastic integration: a third trainer JOINs a
+        live 2-trainer sync job, is admitted at a step boundary with
+        a fresh tid, contributes real merges, then LEAVEs gracefully
+        — originals finish clean, nobody is evicted, and the
+        membership events tell the whole story. Also the engine-seam
+        guarantee: the joiner rides the already-traced step (quorum
+        membership is server state, not a trace input), so the
+        membership change triggers ZERO new XLA compiles for the
+        incumbents."""
+        t, start, loss = _build(2)
+        s = PServerRuntime(t, t.pserver_endpoints[0])
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+        s.serv.start()
+        trainer = t.get_trainer_program()
+        N, JOIN_AT, JSTEPS = 12, 2, 4
+        warm = threading.Event()
+        left_evt = threading.Event()
+        results, errors = {}, {}
+        grant_box = {}
+
+        def run_trainer(tid):
+            try:
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = ParameterServerRuntime(t, trainer, scope,
+                                            trainer_id=tid,
+                                            connect_timeout_s=20.0)
+                rt.init_params()
+                out = []
+                for i in range(N):
+                    if i == JOIN_AT + 1:
+                        # hold until the JOIN request is parked at
+                        # the server: admission needs our barrier
+                        # traffic (it lands at a step-boundary
+                        # release), so don't burn the remaining
+                        # steps before the request arrives
+                        deadline = time.time() + 60
+                        while time.time() < deadline and not (
+                                s.serv._pending_joins
+                                or s.serv._joined):
+                            time.sleep(0.01)
+                    if i == N - 1:
+                        # hold the LAST step until the joiner has
+                        # left: its LEAVE must shrink a live quorum,
+                        # not race the originals' completion
+                        left_evt.wait(timeout=120)
+                    (lv,) = rt.run_step(exe, _feed(i), [loss])
+                    out.append(float(np.asarray(lv).reshape(-1)[0]))
+                    if tid == 0 and i == JOIN_AT:
+                        warm.set()
+                rt.complete()
+                results[tid] = out
+            except Exception as e:          # pragma: no cover
+                errors[tid] = repr(e)
+
+        def run_joiner():
+            try:
+                assert warm.wait(timeout=60)
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = join_running_job(t, trainer, scope,
+                                      connect_timeout_s=20.0)
+                grant_box.update(rt.join_grant,
+                                 seconds=rt.join_seconds)
+                out = []
+                for i in range(JSTEPS):
+                    (lv,) = rt.run_step(exe, _feed(100 + i), [loss])
+                    out.append(float(np.asarray(lv).reshape(-1)[0]))
+                rt.leave()
+                results["join"] = out
+            finally:
+                left_evt.set()
+
+        evs = obs.journal_events()
+        mark = evs[-1]["seq"] if evs else 0
+        ths = [threading.Thread(target=run_trainer, args=(i,))
+               for i in range(2)] + \
+              [threading.Thread(target=run_joiner)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=180)
+        s.serv.shutdown()
+        assert not errors, errors
+        assert not any(th.is_alive() for th in ths)
+        # fresh tid beyond the initial membership, granted exactly once
+        assert grant_box["tid"] == 2
+        assert grant_box["n_trainers"] == 3
+        assert grant_box["seconds"] < 60
+        assert len(results["join"]) == JSTEPS
+        assert all(np.isfinite(v) for out in results.values()
+                   for v in out)
+        window = obs.journal_events(since_seq=mark)
+        kinds = [e["kind"] for e in window]
+        assert "trainer_joined" in kinds
+        assert "trainer_left" in kinds
+        assert "trainer_join_catchup" in kinds
+        assert "trainer_evicted" not in kinds
+        joined = next(e for e in window
+                      if e["kind"] == "trainer_joined")
+        left = next(e for e in window if e["kind"] == "trainer_left")
+        assert joined["tid"] == 2 and joined["n_trainers"] == 3
+        # n_trainers is the membership WATERMARK (tids are never
+        # recycled); the live barrier quorum is what shrinks
+        assert left["tid"] == 2 and left["quorum"] == 2
+        # the LEAVE was graceful: quorum shrank at a boundary with no
+        # partial-step grads forged into a merge
+        assert left.get("drained_partials", 0) == 0
+        # engine seam: membership is NOT a trace input. The joiner's
+        # own first step may compile after the admission event (its
+        # Executor has a cold cache), but it must land on a
+        # fingerprint the incumbents already compiled — the quorum
+        # change itself introduces zero new traces
+        join_seq = joined["seq"]
+        pre = {e["fingerprint"] for e in window
+               if e["kind"] == "executor_compile"
+               and e["seq"] <= join_seq}
+        late = [e for e in window if e["kind"] == "executor_compile"
+                and e["seq"] > join_seq
+                and e["fingerprint"] not in pre]
+        assert late == [], late
+
+
+# ---------------------------------------------------------------------------
+# live resharding: cutover semantics beyond the chaos scenario
+# ---------------------------------------------------------------------------
+
+class TestLiveReshard:
+    DIM = 16
+
+    def _fleet(self, n, standby_from=2):
+        servers = [SparsePServer(
+            "127.0.0.1:0",
+            {"emb": LargeScaleKV(dim=self.DIM, lr=0.5, seed=9)},
+            reshard_standby=(i >= standby_from)) for i in range(n)]
+        for s in servers:
+            s.start()
+        return servers
+
+    def test_rows_seqs_and_naive_dominated(self):
+        """2 -> 3 cutover on a populated table: values bit-preserved,
+        every activated server owns exactly its %3 partition, the
+        planner moved strictly less wire bytes than the naive
+        gather-scatter on an identical twin fleet, and no participant
+        ever materialized more than its source + destination rows
+        (the naive coordinator holds the FULL table)."""
+        servers = self._fleet(3)
+        eps = [[s.endpoint for s in servers[:2]]]
+        cl = LookupServiceClient("emb", list(eps[0]), dim=self.DIM,
+                                 trainer_id=0,
+                                 topology=lambda: list(eps[0]))
+        rng = np.random.RandomState(11)
+        ids = rng.permutation(512)[:300].astype(np.int64)
+        cl.push(ids, np.ones((300, self.DIM), np.float32) * 0.25)
+        before = cl.pull(np.arange(512))
+        old = list(eps[0])
+        eps[0] = [s.endpoint for s in servers]
+        stats = execute_reshard("emb", old, list(eps[0]))
+        assert stats["rows_moved"] > 0
+        after = cl.pull(np.arange(512))
+        assert np.array_equal(before, after)
+        for idx, s in enumerate(servers):
+            assert s.serv._partition == (3, idx)
+            owned = s.tables["emb"].owned_ids()
+            assert (owned % 3 == idx).all()
+        # no participant held more than src + dst worth of rows
+        assert max(len(s.tables["emb"].owned_ids())
+                   for s in servers) < 300
+        cl.close()
+        for s in servers:
+            s.shutdown()
+        # naive twin: same population, gather-then-scatter
+        servers = self._fleet(3)
+        cl = LookupServiceClient(
+            "emb", [s.endpoint for s in servers[:2]], dim=self.DIM,
+            trainer_id=0)
+        cl.push(ids, np.ones((300, self.DIM), np.float32) * 0.25)
+        naive = naive_gather_scatter(
+            "emb", [s.endpoint for s in servers[:2]],
+            [s.endpoint for s in servers])
+        cl.close()
+        for s in servers:
+            s.shutdown()
+        assert naive["coordinator_rows_held"] == 300
+        assert stats["bytes_moved"] < naive["bytes"]
+
+    def test_standby_fences_until_activate(self):
+        """A push routed to a standby before activation answers
+        STATUS_RESHARDED: without a topology callback the client
+        surfaces ShardMapChanged instead of silently writing into a
+        shard that is not authority yet."""
+        servers = self._fleet(1, standby_from=0)   # standby-only
+        cl = LookupServiceClient("emb", [servers[0].endpoint],
+                                 dim=self.DIM, trainer_id=0)
+        with pytest.raises(ShardMapChanged):
+            cl.push(np.array([1, 2], np.int64),
+                    np.ones((2, self.DIM), np.float32))
+        cl.close()
+        for s in servers:
+            s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# router group-atomic membership + FleetScaler group path
+# ---------------------------------------------------------------------------
+
+class TestRouterGroups:
+    def _router(self):
+        from paddle_tpu.serving import RouterConfig, ServingRouter
+        return ServingRouter(
+            ["127.0.0.1:1", "127.0.0.1:2"],
+            RouterConfig(group_size=2, heartbeat_interval_s=60.0))
+
+    def test_add_group_atomic_and_validated(self):
+        from paddle_tpu.serving import InvalidRequest
+        router = self._router()
+        try:
+            with pytest.raises(InvalidRequest):
+                router.add_group(["127.0.0.1:3"])   # partial mesh
+            assert len(router._groups) == 1
+            gid = router.add_group(["127.0.0.1:3", "127.0.0.1:4"])
+            assert gid == 1
+            assert len(router._groups) == 2
+            assert len(router._replicas) == 4
+            assert {e["kind"] for e in obs.journal_events()} >= \
+                {"group_added"}
+        finally:
+            router.shutdown()
+
+    def test_remove_group_retires_members_refuses_last(self):
+        from paddle_tpu.serving import InvalidRequest
+        router = self._router()
+        try:
+            gid = router.add_group(["127.0.0.1:3", "127.0.0.1:4"])
+            res = router.remove_group(gid)
+            assert len(res) == 2           # both members' snapshots
+            assert len(router._groups) == 1
+            assert all(not r.retired for r in router._replicas)
+            with pytest.raises(InvalidRequest,
+                               match=">= 1 dispatch target"):
+                router.remove_group(0)
+        finally:
+            router.shutdown()
+
+    def test_ungrouped_router_refuses_group_ops(self):
+        from paddle_tpu.serving import (InvalidRequest, RouterConfig,
+                                        ServingRouter)
+        router = ServingRouter(["127.0.0.1:1"],
+                               RouterConfig(heartbeat_interval_s=60.0))
+        try:
+            with pytest.raises(InvalidRequest):
+                router.add_group(["127.0.0.1:2"])
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# lock_lint gate: reshard.py pinned in the scan set
+# ---------------------------------------------------------------------------
+
+class TestLockLintReshardGate:
+    def test_reshard_module_scanned_and_clean(self):
+        import lock_lint
+        assert "paddle_tpu/distributed/reshard.py" in \
+            lock_lint.DEFAULT_PATHS
+        locks, funcs = lock_lint.scan(lock_lint.DEFAULT_PATHS)
+        assert any(fk.startswith("paddle_tpu.distributed.reshard.")
+                   for fk in funcs), \
+            "reshard.py fell out of the lock_lint scan set"
+        report = lock_lint.analyze(locks, funcs)
+        assert report["violations"] == [], report["violations"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario (chaos: tier-1 seed; slow: the sweep)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestElasticScenario:
+    def test_elastic_2_3_2_green_and_diagnosed(self):
+        """ISSUE 17 acceptance, seed 0: grow 2->3 trainers mid-run
+        under 5% frame drop, shrink back, reshard pservers 2->3 under
+        live q8 pushes — trajectory exact against both twins, sparse
+        state bit-equal, doctor names every transition, audit
+        explains every scale action."""
+        import chaos_run
+        res = chaos_run._scenario_elastic_2_3_2(
+            argparse.Namespace(seed=0, steps=4))
+        assert res["ok"], {k: v for k, v in res.items()
+                           if k not in ("journal_kinds",)}
+        tr = res["trajectory"]
+        assert tr["fixed_twin_prefix_exact"]
+        assert tr["diverges_after_join"]
+        assert tr["fault_free_twin_exact"]
+        assert res["frames_dropped"] > 0
+        sp = res["sparse"]
+        assert sp["rows_bit_equal"] and sp["residuals_bit_equal"]
+        assert sp["pulls_stale_free"]
+        assert sp["dup_ack_without_reapply"]
+        doc = res["doctor"]
+        assert doc["match"] and doc["top"] == "elastic_membership"
+        rem = doc["remediation"]
+        assert rem["ok"] and rem["unexplained"] == []
+        assert rem["actions_fired"] >= 3
+
+
+@pytest.mark.slow
+class TestElasticScenarioSweep:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_seed_sweep(self, seed):
+        import chaos_run
+        res = chaos_run._scenario_elastic_2_3_2(
+            argparse.Namespace(seed=seed, steps=4))
+        assert res["ok"], {k: v for k, v in res.items()
+                           if k not in ("journal_kinds",)}
+
+
+@pytest.mark.slow
+class TestFleetScalerGroups:
+    def test_scale_up_spawns_whole_group_atomically(self, tmp_path):
+        """The group-atomic FleetScaler path with REAL subprocess
+        replicas: scale_up spawns a full sharded group (all ranks or
+        none), admits it to the router as one unit, and scale_down
+        retires the newest group whole."""
+        import load_gen
+        model_dir = load_gen.build_synthetic_model(
+            str(tmp_path / "model"), hidden=8)
+        # n_replicas counts GROUPS when group_size > 1: one sharded
+        # group of two processes to start
+        router, stop = load_gen.spawn_fleet(
+            model_dir, 1, group_size=2,
+            compile_cache_dir=str(tmp_path / "cache"))
+        try:
+            feed = {"x": np.random.RandomState(0).rand(
+                2, 64).astype(np.float32)}
+            router.infer_sync(feed, timeout=120)
+            scaler = load_gen.FleetScaler(router, stop)
+            assert scaler.replica_count() == 1     # groups, not procs
+            res = scaler.scale_up()
+            assert res["ok"] and res["op"] == "scale_up_group"
+            assert res["groups"] == 2
+            assert len(res["pids"]) == 2
+            for _ in range(4):
+                router.infer_sync(feed, timeout=120)
+            down = scaler.scale_down()
+            assert down["ok"] and down["op"] == "scale_down_group"
+            assert down["groups"] == 1
+            router.infer_sync(feed, timeout=120)
+        finally:
+            stop()

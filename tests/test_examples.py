@@ -52,6 +52,9 @@ def test_load_gen_smoke():
     assert report["engine"]["compiles"] <= 4
 
 
+# tier-1 headroom (PR 17): ~35 s; transformer training stays via
+# test_transformer.py::test_transformer_trains
+@pytest.mark.slow
 def test_train_transformer_small():
     r = _run("train_transformer.py", ["--small", "--steps", "3"])
     assert r.returncode == 0, r.stderr[-2000:]

@@ -114,6 +114,9 @@ class TestFusionReportLive:
                     if b["fed_by_fusion"] or b["feeds_fusion"]]
         assert touching, collectives
 
+    # tier-1 headroom (PR 17): ~36 s; the fusion-split gate class
+    # stays via test_sp_axis_boundaries_do_not_split_fusion below
+    @pytest.mark.slow
     def test_transformer_rewrites_do_not_split_fusion(self):
         """ACCEPTANCE: the transformer program with q8 gradient-sync +
         anomaly guard keeps a fused-kernel count not lower than the

@@ -421,7 +421,7 @@ class TestQ8Wire:
                                      push_q8=True)
             ids = np.arange(5)
             cl.push(ids, rng.randn(5, 32).astype(np.float32))
-            seq_used = cl._seqs[0]
+            seq_used = cl._seqs[cl.clients[0].endpoint]
             q, s = quantize_rows_q8(np.ones((5, 32), np.float32))
             state = tables[0]["emb"].pull(ids)
             cl.clients[0].push_sparse_q8("emb", ids, q, s,
@@ -687,7 +687,7 @@ class TestSparseSnapshot:
         for _ in range(3):
             cl.push(ids, rng.randn(30, 16).astype(np.float32))
         state = kv.pull(ids)
-        used_seq = cl._seqs[0]
+        used_seq = cl._seqs[cl.clients[0].endpoint]
         srv.shutdown()
 
         kv2 = LargeScaleKV(dim=16, optimizer="adagrad", lr=0.2,

@@ -2,6 +2,7 @@
 dist_transformer.py model + machine_translation benchmark)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models import transformer as T
@@ -60,6 +61,10 @@ def test_transformer_mask_ignores_pad():
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
 
 
+# tier-1 headroom (PR 17): heavy tp-equality twin (~38 s) -> slow;
+# tp sharding stays covered by test_bert.py::test_bert_tp_sharding_runs
+# and the dp/sp equality cells in test_model_parallel.py
+@pytest.mark.slow
 def test_transformer_tp_sharded_matches_replicated():
     """Megatron-sharded transformer must produce the same loss as
     unsharded (GSPMD collectives correctness)."""

@@ -53,7 +53,10 @@ __all__ = ["load_rounds", "diff", "format_report"]
 # composed_step_overhead is lower-is-better by its "overhead" name
 # (and "% step time" unit), pipelined_sparse_throughput is
 # higher-is-better by its "examples/sec" unit — both directions are
-# pinned by tests/test_step_engine.py.
+# pinned by tests/test_step_engine.py. The elastic rows are both
+# lower-is-better via existing patterns — elastic_join_catchup by its
+# "seconds" unit, reshard_bytes by its "bytes" unit — and both
+# directions are pinned by tests/test_control.py.
 _HIGHER_IS_BETTER = re.compile(
     r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps"
     r"|rows/s)",

@@ -235,6 +235,7 @@ DOCTOR_EXPECT = {
     # outranks the rest; replica_failure is acceptable when eviction
     # evidence dominates an unlucky interleaving
     "control_loop": ("hang", "replica_failure"),
+    "elastic_2_3_2": ("elastic_membership",),
 }
 
 
@@ -681,8 +682,11 @@ def _scenario_sparse_restart(args):
     ids_d = np.arange(4, dtype=np.int64)
     q, sc = quantize_rows_q8(np.full((4, DIM), 0.3, np.float32))
     before_dup = live.tables["emb"].pull(ids_d)
-    cl.clients[0].push_sparse_q8("emb", ids_d, q, sc,
-                                 seq=cl._seqs[0])  # replayed seq
+    cl.clients[0].push_sparse_q8(
+        "emb", ids_d, q, sc,
+        # replayed seq (_seqs is keyed by ENDPOINT so a stream
+        # survives resharding; this client has one shard)
+        seq=cl._seqs[cl.clients[0].endpoint])
     after_dup = live.tables["emb"].pull(ids_d)
     dup_ok = bool(np.array_equal(before_dup, after_dup))
 
@@ -1156,6 +1160,465 @@ def _scenario_control_loop(args):
             "unremediated": audit["unremediated"] if audit else None}
 
 
+def _scenario_elastic_2_3_2(args):
+    """The ELASTIC acceptance scenario (ISSUE 17 / docs/resilience.md
+    §Elastic membership): stateful grow/shrink/reshard actuated by the
+    control plane, under faults, with EXACT training semantics.
+
+    Dense leg — trainers 2->3->2 under a 5% drop wire: a ControlPlane
+    ScalingPolicy(target="trainer") fires scale_up on scripted
+    pressure; the actuator JOINs a third trainer (parked server-side,
+    admitted atomically at a step boundary), it contributes a fixed
+    window of steps, then scale_down makes it LEAVE gracefully. Green
+    means the loss trajectory is EXACT three ways: (a) bitwise equal
+    to a FIXED-membership 2-trainer twin on every step whose effective
+    batch set matches (the pre-join prefix — admission perturbs
+    nothing before its boundary), (b) provably DIVERGENT once the
+    joiner's grads enter the merge (it really contributed), and
+    (c) bitwise equal end-to-end to a fault-free elastic twin at the
+    same membership schedule (drops + retries + fencing never touch
+    the math).
+
+    Sparse leg — pservers 2->3 live-resharded mid-push-stream by a
+    ScalingPolicy(target="pserver") whose actuator runs the
+    arXiv:2112.01075 p2p plan under the two-phase cutover, while the
+    q8 pusher keeps pushing. Green means rows, per-step pulls, and
+    client error-feedback residuals all BIT-EQUAL a fixed-membership
+    2-server twin; pre- and post-reshard seqs replay as
+    ack-without-reapply (watermarks survived the cutover); every
+    activated server owns exactly its %3 partition.
+
+    The journal then has to explain it all: doctor's top diagnosis
+    names the membership transitions (``elastic_membership``) and
+    ``remediation_audit`` chains every fired scale action to its
+    ``control_signal`` cause — zero unexplained actions."""
+    import threading
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import (LargeScaleKV,
+                                        LookupServiceClient,
+                                        ParameterServerRuntime,
+                                        PServerRuntime, SparsePServer)
+    from paddle_tpu.distributed.ps import join_running_job
+    from paddle_tpu.distributed.reshard import execute_reshard
+    from paddle_tpu.observability import ControlPlane, ScalingPolicy
+    from paddle_tpu.parallel.collectives import quantize_rows_q8
+    from paddle_tpu.resilience import NetFaultProxy, RetryPolicy
+
+    workdir = tempfile.mkdtemp(prefix="chaos-elastic-")
+    journal_path = os.path.join(workdir, "events.jsonl")
+
+    # membership schedule (step-aligned, identical in every run):
+    # steps [0, P1]: quorum 2 (the JOIN parks before step P1 and
+    # admits at ITS boundary, so merge P1 is still 2-way); steps
+    # (P1, P2): quorum 3; steps [P2, N): quorum 2 after the LEAVE
+    P1, P2, N = 3, 7, 9
+    JSTEPS = P2 - P1 - 1
+    feeds_a = _dist_feeds(args.seed, N)
+    feeds_b = _dist_feeds(args.seed + 1000, N)
+    feeds_c = _dist_feeds(args.seed + 2000, JSTEPS)
+
+    def run_dense(drop=False, elastic=True, control=False):
+        t, start, loss = _dist_build(args.seed, 2)
+        s = PServerRuntime(t, t.pserver_endpoints[0],
+                           lease_timeout_s=5.0)
+        dial = s.serv.endpoint
+        proxy = None
+        if drop:
+            proxy = NetFaultProxy(s.serv.endpoint, seed=args.seed)
+            proxy.set_drop_rate(0.05)
+            dial = proxy.endpoint
+        t.set_block_endpoints(s._minis.keys(), dial)
+        s.serv.start()
+        trainer = t.get_trainer_program()
+        kw = dict(deadline_s=2.0, connect_timeout_s=20.0,
+                  heartbeat_interval_s=0.1,
+                  retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                                    max_delay=0.2, seed=args.seed))
+        gate = threading.Condition()
+        allow = [N if not elastic else P1]
+        prog = {0: -1, 1: -1, "join": -1}
+        results, errors = {}, {}
+        joined_evt, leave_evt, left_evt = (threading.Event(),
+                                           threading.Event(),
+                                           threading.Event())
+        join_info = {}
+
+        def wait_gate(i):
+            with gate:
+                while i >= allow[0]:
+                    gate.wait(timeout=120)
+
+        def open_gate(n):
+            with gate:
+                allow[0] = n
+                gate.notify_all()
+
+        def run_trainer(tid, feeds):
+            try:
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = ParameterServerRuntime(t, trainer, scope,
+                                            trainer_id=tid, **kw)
+                rt.init_params()
+                out = []
+                for i, f in enumerate(feeds):
+                    wait_gate(i)
+                    (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                    out.append(float(np.asarray(lv).reshape(-1)[0]))
+                    prog[tid] = i
+                rt.complete()
+                results[tid] = out
+            except Exception as e:
+                errors[tid] = repr(e)
+
+        def run_joiner():
+            try:
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = join_running_job(t, trainer, scope, **kw)
+                join_info["grant"] = dict(rt.join_grant)
+                join_info["seconds"] = rt.join_seconds
+                joined_evt.set()
+                out = []
+                for i, f in enumerate(feeds_c):
+                    (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                    out.append(float(np.asarray(lv).reshape(-1)[0]))
+                    prog["join"] = i
+                leave_evt.wait(timeout=120)
+                rt.leave()
+                left_evt.set()
+                results["join"] = out
+            except Exception as e:
+                errors["join"] = repr(e)
+
+        def wait_for(fn, timeout=60.0, what="condition"):
+            deadline = time.monotonic() + timeout
+            while not fn():
+                if errors or time.monotonic() > deadline:
+                    raise RuntimeError("elastic harness stuck on %s "
+                                       "(errors=%r)" % (what, errors))
+                time.sleep(0.01)
+
+        # -- actuators (the WHAT; a ScalingPolicy owns the WHEN) -----
+        def do_grow(_ctx=None):
+            threading.Thread(target=run_joiner, daemon=True).start()
+            # returns once the grant is recorded server-side: from
+            # here the NEXT boundary admits the joiner atomically
+            wait_for(lambda: s.serv._join_grants or joined_evt.is_set(),
+                     what="join grant")
+            return {"ok": True, "op": "trainer_join_requested"}
+
+        def do_shrink(_ctx=None):
+            leave_evt.set()
+            wait_for(left_evt.is_set, what="graceful leave")
+            return {"ok": True, "op": "trainer_left",
+                    "steps_contributed": len(results.get("join") or
+                                             feeds_c)}
+
+        cp = demand = None
+        if control:
+            class _TrainerDuck:
+                def __init__(self):
+                    self.demand = [3.0]
+
+                def pressure(self):
+                    return {"depth_per_replica": self.demand[0],
+                            "healthy": 1}
+
+                def replica_count(self):
+                    return 3 if (joined_evt.is_set()
+                                 and not left_evt.is_set()) else 2
+
+                def retirable_count(self):
+                    return 1 if (joined_evt.is_set()
+                                 and not left_evt.is_set()) else 0
+
+                def scale_up(self):
+                    return do_grow()
+
+                def scale_down(self):
+                    return do_shrink()
+
+            duck = _TrainerDuck()
+            demand = duck.demand
+            cp = ControlPlane(interval_s=0.05, max_actions_per_min=30)
+            cp.attach_scaler(duck, ScalingPolicy(
+                "trainer_elastic", up_depth=5.0, down_depth=1.0,
+                sustain_s=0.0, cooldown_s=0.5, min_replicas=2,
+                max_replicas=3, target="trainer"))
+            cp.start()
+
+        ths = [threading.Thread(target=run_trainer, args=(i, fs))
+               for i, fs in enumerate([feeds_a, feeds_b])]
+        for th in ths:
+            th.start()
+        verdict = {}
+        try:
+            if elastic:
+                # phase 1 done, trainers parked before step P1
+                wait_for(lambda: prog[0] == P1 - 1 and
+                         prog[1] == P1 - 1, what="phase-1 park")
+                if control:
+                    demand[0] = 10.0        # the grow trigger
+                    wait_for(lambda: s.serv._join_grants
+                             or joined_evt.is_set(), what="scale_up")
+                    demand[0] = 3.0         # back inside the band
+                else:
+                    do_grow()
+                open_gate(P2)  # step P1 admits; (P1, P2) run 3-way
+                wait_for(lambda: prog[0] == P2 - 1 and
+                         prog[1] == P2 - 1 and
+                         prog["join"] == JSTEPS - 1,
+                         what="phase-2 park")
+                if control:
+                    demand[0] = 0.0         # the shrink trigger
+                    wait_for(left_evt.is_set, what="scale_down")
+                    demand[0] = 3.0
+                else:
+                    do_shrink()
+                open_gate(N)   # [P2, N) back at quorum 2
+            for th in ths:
+                th.join(timeout=180)
+            hung = [th.is_alive() for th in ths]
+            verdict = {
+                "losses": {str(k): v for k, v in results.items()},
+                "errors": dict(errors), "hung": any(hung),
+                "join": dict(join_info),
+                "dropped": (sum(1 for e in proxy.events
+                                if e[0] == "drop") if proxy else 0),
+                "server_events": [e["kind"] for e in s.serv.events
+                                  if e["kind"].startswith(
+                                      ("trainer_join", "trainer_left",
+                                       "trainer_evicted"))]}
+        finally:
+            if cp is not None:
+                cp.stop()
+            s.serv.shutdown()
+            if proxy is not None:
+                proxy.close()
+        return verdict
+
+    # -- sparse leg: pservers 2->3 resharded under live q8 pushes ----
+    DIM, VOCAB, LR = 16, 768, 0.5
+    rng = np.random.RandomState(args.seed)
+    stream = [(rng.randint(0, VOCAB, 96).astype(np.int64),
+               (rng.randn(96, DIM) * 0.1).astype(np.float32))
+              for _ in range(max(12, args.steps * 3))]
+
+    def run_sparse(reshard=False):
+        import time as _time
+
+        def mk():
+            return {"emb": LargeScaleKV(dim=DIM, lr=LR, seed=9)}
+
+        servers = [SparsePServer("127.0.0.1:0", mk()),
+                   SparsePServer("127.0.0.1:0", mk())]
+        for s in servers:
+            s.start()
+        eps = [[s.endpoint for s in servers]]
+        cl = LookupServiceClient(
+            "emb", list(eps[0]), dim=DIM, trainer_id=0,
+            deadline_s=2.0, cache_bytes=VOCAB * DIM * 4,
+            push_q8=True, write_policy="mirror_sgd", mirror_lr=LR,
+            retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                              max_delay=0.3, seed=args.seed),
+            topology=lambda: list(eps[0]))
+        out = {"stats": None, "pre_seq": None}
+        cp = None
+        try:
+            if reshard:
+                standby = SparsePServer("127.0.0.1:0", mk(),
+                                        reshard_standby=True)
+                standby.start()
+                servers.append(standby)
+
+                def do_reshard():
+                    old = list(eps[0])
+                    new = old + [standby.endpoint]
+                    # topology flips first: a push fenced mid-cutover
+                    # re-resolves to the NEW map and retries into it
+                    eps[0] = new
+                    st = execute_reshard("emb", old, new)
+                    out["stats"] = st
+                    return {"ok": True,
+                            "rows_moved": st["rows_moved"],
+                            "bytes_moved": st["bytes_moved"]}
+
+                class _PsDuck:
+                    def __init__(self):
+                        self.demand = [3.0]
+
+                    def pressure(self):
+                        return {"depth_per_replica": self.demand[0],
+                                "healthy": 1}
+
+                    def replica_count(self):
+                        return len(eps[0])
+
+                    def scale_up(self):
+                        return do_reshard()
+
+                    def scale_down(self):
+                        raise RuntimeError("shrink not in this leg")
+
+                duck = _PsDuck()
+                cp = ControlPlane(interval_s=0.05,
+                                  max_actions_per_min=30)
+                cp.attach_scaler(duck, ScalingPolicy(
+                    "pserver_reshard", up_depth=5.0, down_depth=0.5,
+                    sustain_s=0.0, cooldown_s=5.0, min_replicas=1,
+                    max_replicas=3, target="pserver"))
+                cp.start()
+            pulls = []
+            trigger_at = len(stream) // 3
+            for i, (ids, grads) in enumerate(stream):
+                if reshard and i == trigger_at:
+                    # capture a pre-cutover seq for the watermark
+                    # replay check, then fire the trigger and keep
+                    # pushing WHILE the plan streams
+                    out["pre_seq"] = dict(cl._seqs)
+                    duck.demand[0] = 10.0
+                pulls.append(cl.pull(ids))
+                cl.push(ids, grads)
+            if reshard:
+                deadline = _time.monotonic() + 60.0
+                while out["stats"] is None:
+                    if _time.monotonic() > deadline:
+                        raise RuntimeError("reshard never fired")
+                    _time.sleep(0.01)
+                duck.demand[0] = 3.0
+            final = cl.pull(np.arange(VOCAB))
+            out.update({
+                "pulls": pulls, "final": final,
+                "residuals": {k: v.copy()
+                              for k, v in cl.residuals.items()},
+                "n_servers": len(eps[0])})
+            if reshard:
+                # watermark survival: replaying a pre-cutover seq AND
+                # the newest seq must both ack-without-reapply on a
+                # SURVIVING endpoint (its tracker crossed the cutover)
+                ep0 = cl.clients[0].endpoint
+                ids_d = np.array([0, 3, 6, 9], dtype=np.int64)
+                q, sc = quantize_rows_q8(
+                    np.full((4, DIM), 0.3, np.float32))
+                before = servers[0].tables["emb"].pull(ids_d)
+                cl.clients[0].push_sparse_q8(
+                    "emb", ids_d, q, sc, seq=cl._seqs[ep0])
+                old_seq = out["pre_seq"].get(ep0)
+                if old_seq:
+                    cl.clients[0].push_sparse_q8(
+                        "emb", ids_d, q, sc, seq=old_seq)
+                after = servers[0].tables["emb"].pull(ids_d)
+                out["dup_ok"] = bool(np.array_equal(before, after))
+                out["partitions"] = [
+                    s.serv._partition for s in servers]
+                out["owned_ok"] = all(
+                    all(int(r) % 3 == idx
+                        for r in s.tables["emb"].owned_ids())
+                    for idx, s in enumerate(servers))
+        finally:
+            if cp is not None:
+                cp.stop()
+            cl.close()
+            for s in servers:
+                s.shutdown()
+        return out
+
+    # ---- twins first (no journal sink), then the journaled chaos ---
+    t0 = time.monotonic()
+    fixed = run_dense(drop=False, elastic=False)       # 2 trainers, fixed
+    twin = run_dense(drop=False, elastic=True)         # elastic, fault-free
+    sparse_twin = run_sparse(reshard=False)
+
+    obs.configure_journal(journal_path)
+    try:
+        chaos = run_dense(drop=True, elastic=True, control=True)
+        sparse = run_sparse(reshard=True)
+    finally:
+        obs.configure_journal(None)
+    elapsed = time.monotonic() - t0
+
+    events = obs.read_journal(journal_path)
+    kinds = {e["kind"] for e in events}
+
+    def _eq(a, b):
+        return (a is not None and b is not None
+                and np.array_equal(np.asarray(a), np.asarray(b)))
+
+    cl_, tw_, fx_ = (chaos.get("losses", {}), twin.get("losses", {}),
+                     fixed.get("losses", {}))
+    ok_runs = not (chaos.get("errors") or twin.get("errors")
+                   or fixed.get("errors") or chaos.get("hung"))
+    # (a) fixed-membership twin: bitwise on the matched prefix (loss
+    # index P1+1 still reflects only 2-way merges), (b) divergence
+    # once the joiner's grads land, (c) fault-free elastic twin:
+    # bitwise everywhere incl. the joiner's own trajectory
+    prefix_exact = divergent = drop_exact = False
+    if ok_runs and "0" in cl_ and "0" in fx_:
+        prefix_exact = (_eq(cl_["0"][:P1 + 2], fx_["0"][:P1 + 2])
+                        and _eq(cl_["1"][:P1 + 2], fx_["1"][:P1 + 2]))
+        divergent = (cl_["0"][P1 + 2:] != fx_["0"][P1 + 2:])
+        drop_exact = all(_eq(cl_.get(k), tw_.get(k))
+                         for k in ("0", "1", "join"))
+    sp_rows = _eq(sparse.get("final"), sparse_twin.get("final"))
+    sp_pulls = (len(sparse.get("pulls", ())) ==
+                len(sparse_twin.get("pulls", ()))
+                and all(_eq(a, b) for a, b in
+                        zip(sparse["pulls"], sparse_twin["pulls"])))
+    res_a, res_b = sparse.get("residuals", {}), \
+        sparse_twin.get("residuals", {})
+    sp_res = (set(res_a) == set(res_b)
+              and all(_eq(res_a[k], res_b[k]) for k in res_b))
+    reshard_ok = (sparse.get("n_servers") == 3
+                  and (sparse.get("stats") or {}).get("rows_moved", 0)
+                  > 0
+                  and sparse.get("dup_ok") and sparse.get("owned_ok")
+                  and sparse.get("partitions") ==
+                  [(3, 0), (3, 1), (3, 2)])
+    journal_ok = {"trainer_joined", "trainer_left",
+                  "reshard_complete", "control_action"} <= kinds \
+        and "trainer_evicted" not in kinds
+    doc = _doctor_verdict("elastic_2_3_2", events=events)
+    ok = (ok_runs and prefix_exact and divergent and drop_exact
+          and chaos.get("dropped", 0) > 0
+          and sp_rows and sp_pulls and sp_res and bool(reshard_ok)
+          and journal_ok and elapsed < 420.0)
+    return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "trajectory": {
+                "fixed_twin_prefix_exact": prefix_exact,
+                "diverges_after_join": divergent,
+                "fault_free_twin_exact": drop_exact},
+            "frames_dropped": chaos.get("dropped"),
+            "join": chaos.get("join"),
+            "membership_events": chaos.get("server_events"),
+            "sparse": {
+                "rows_bit_equal": sp_rows,
+                "pulls_stale_free": sp_pulls,
+                "residuals_bit_equal": sp_res,
+                "rows_moved": (sparse.get("stats") or {}).get(
+                    "rows_moved"),
+                "bytes_moved": (sparse.get("stats") or {}).get(
+                    "bytes_moved"),
+                "dup_ack_without_reapply": sparse.get("dup_ok"),
+                "partitions_ok": sparse.get("owned_ok")},
+            "journal_ok": journal_ok,
+            "journal_kinds": sorted(k for k in kinds
+                                    if k.startswith(
+                                        ("trainer_", "reshard_",
+                                         "control_", "sparse_"))),
+            "doctor": doc,
+            "errors": {"chaos": chaos.get("errors"),
+                       "twin": twin.get("errors"),
+                       "fixed": fixed.get("errors")}}
+
+
 DIST_SCENARIOS = {
     "pserver_restart": _scenario_pserver_restart,
     "trainer_kill": _scenario_trainer_kill,
@@ -1164,6 +1627,7 @@ DIST_SCENARIOS = {
     "serving_kill": _scenario_serving_kill,
     "sparse_restart": _scenario_sparse_restart,
     "control_loop": _scenario_control_loop,
+    "elastic_2_3_2": _scenario_elastic_2_3_2,
 }
 
 

@@ -59,6 +59,7 @@ _BASE_SCORE = {
     "trainer_eviction": 88.0,
     "replica_failure": 86.0,
     "pserver_restart": 84.0,
+    "elastic_membership": 75.0,
     "recompile_storm": 70.0,
     "training_anomaly": 65.0,
     "network_flaky": 60.0,
@@ -389,6 +390,52 @@ def _detect_training_anomaly(kinds):
                   + [_cite(e, "reason", "step") for e in aborts])]
 
 
+def _detect_elastic_membership(kinds):
+    """Elastic membership transitions (PR 17): trainer JOIN/LEAVE,
+    pserver N->M reshard cutovers, whole-group serving admissions.
+    These are deliberate reconfigurations, not failures — the
+    diagnosis NAMES every transition so a reader of any incident
+    window can separate 'the fleet changed shape on purpose' from
+    'the fleet broke' (and the audit can chain scale actions here)."""
+    joins = kinds.get("trainer_joined", [])
+    leaves = kinds.get("trainer_left", [])
+    reshards = kinds.get("reshard_complete", []) \
+        + kinds.get("reshard_activated", [])
+    groups = kinds.get("group_added", []) + kinds.get("group_retired",
+                                                      [])
+    if not (joins or leaves or reshards or groups):
+        return []
+    bits = []
+    if joins:
+        bits.append("%d trainer join(s) admitted at step boundaries "
+                    "(tids %s)"
+                    % (len(joins),
+                       ",".join(str(e.get("tid")) for e in joins)))
+    if leaves:
+        bits.append("%d graceful trainer leave(s) (tids %s; partial-"
+                    "step grads drained, no forged merges)"
+                    % (len(leaves),
+                       ",".join(str(e.get("tid")) for e in leaves)))
+    done = [e for e in reshards if e.get("kind") == "reshard_complete"]
+    if reshards:
+        shapes = ["%s->%s" % (e.get("n_src", "?"), e.get("n_dst", "?"))
+                  for e in done] or ["activated shard"]
+        bits.append("pserver reshard %s under live traffic"
+                    % ", ".join(shapes))
+    if groups:
+        bits.append("%d whole-group serving membership change(s)"
+                    % len(groups))
+    return [_diag(
+        "elastic_membership",
+        "elastic membership transitions: " + "; ".join(bits),
+        [_cite(e, "tid", "n_trainers", "boundary") for e in joins]
+        + [_cite(e, "tid", "drained_partials", "boundary")
+           for e in leaves]
+        + [_cite(e, "n_src", "n_dst", "rows_moved", "table")
+           for e in reshards[:10]]
+        + [_cite(e, "group", "members") for e in groups[:10]])]
+
+
 def _detect_input_bound(metrics, threshold=0.3):
     """Metric-snapshot detector: the pipelined pass ran input-bound
     (high stall fraction) — the offline twin of the watchdog's
@@ -568,6 +615,7 @@ def diagnose(events: List[dict], blackboxes: List[dict] = (),
     diagnoses += _detect_recompile_storm(kinds)
     diagnoses += _detect_program_invariant(kinds)
     diagnoses += _detect_training_anomaly(kinds)
+    diagnoses += _detect_elastic_membership(kinds)
     diagnoses += _detect_network_flaky(kinds)
     diagnoses += _detect_overload(kinds)
     diagnoses += _detect_input_bound(list(metrics))

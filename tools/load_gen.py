@@ -346,7 +346,9 @@ def spawn_fleet(model_dir, n_replicas, max_batch=32, wait_us=2000,
     stop.journal_dir = journal_dir
     stop.spawn_opts = {"max_batch": max_batch, "wait_us": wait_us,
                        "queue_size": queue_size,
-                       "replica_args": list(replica_args)}
+                       "replica_args": list(replica_args),
+                       "group_size": group_size,
+                       "mesh_axes": mesh_axes}
     return router, stop
 
 
@@ -360,6 +362,15 @@ class FleetScaler:
     persistent compile cache (replica 0 paid the compiles) and serves
     its first request with zero XLA compiles, and the per-replica
     journal stamping keeps each spawned replica's ledger separable.
+
+    On a GROUPED fleet (``spawn_fleet(..., group_size>1)``) the unit
+    of scaling is a WHOLE sharded replica group: ``scale_up`` spawns
+    all ``group_size`` member processes, waits for every READY line,
+    and admits the group to the router atomically (``add_group``) or
+    — if any member fails to come up — kills ALL of them and admits
+    nothing; a partial mesh never reaches dispatch. The spawned group
+    warms through the same shared compile cache as the base fleet
+    (member 0's pjit compile is a cache load, not a cold compile).
 
     Build from a live fleet: ``FleetScaler(router, stop)`` (the pair
     ``spawn_fleet`` returns)."""
@@ -376,24 +387,37 @@ class FleetScaler:
         # rid -> proc for the replicas THIS scaler spawned (scale-down
         # retires newest-first and only ever reaps what it created)
         self._spawned = {}
+        # gid -> [procs] for groups this scaler spawned (grouped fleet)
+        self._spawned_groups = {}
+
+    @property
+    def _grouped(self) -> bool:
+        return getattr(self.router, "_groups", None) is not None
 
     def replica_count(self) -> int:
         # membership, NOT the healthy subset: max_replicas bounds the
         # process budget, and an evicted-but-member replica still owns
         # its slot (it may be readmitted) — counting only healthy would
-        # let repeated crashes under load scale past the cap
+        # let repeated crashes under load scale past the cap. On a
+        # grouped fleet the unit is the GROUP (max_replicas bounds
+        # groups, each group_size processes).
+        if self._grouped:
+            return len(self.router._groups)
         return len(self.router._replicas)
 
     def retirable_count(self) -> int:
         # the control plane's down-bound tap: this scaler only ever
-        # retires replicas IT spawned, never the base fleet
+        # retires replicas/groups IT spawned, never the base fleet
         with self._mu:
-            return len(self._spawned)
+            return len(self._spawned_groups) if self._grouped \
+                else len(self._spawned)
 
     def pressure(self) -> dict:
         return self.router.pressure()
 
     def scale_up(self) -> dict:
+        if self._grouped:
+            return self._scale_up_group()
         with self._mu:
             k = self._next_k
             self._next_k += 1
@@ -422,7 +446,65 @@ class FleetScaler:
                 "spawn_seconds": round(time.monotonic() - t0, 3),
                 "replicas": self.replica_count()}
 
+    def _scale_up_group(self) -> dict:
+        """Spawn one whole sharded group and admit it atomically."""
+        opts = self._stop.spawn_opts
+        gs = max(1, int(opts.get("group_size") or 1))
+        mesh_axes = opts.get("mesh_axes")
+        mesh_json = json.dumps(mesh_axes) if mesh_axes else None
+        with self._mu:
+            ks = list(range(self._next_k, self._next_k + gs))
+            self._next_k += gs
+        t0 = time.monotonic()
+        procs = []
+        import subprocess
+        try:
+            for rank, k in enumerate(ks):
+                cmd = _replica_cmd(self.model_dir, k,
+                                   opts["max_batch"], opts["wait_us"],
+                                   opts["queue_size"],
+                                   opts["replica_args"])
+                cmd.extend(["--group-rank", str(rank),
+                            "--group-size", str(gs)])
+                env = _stamp_replica_env(
+                    self._stop.env, k,
+                    journal_dir=self._stop.journal_dir)
+                if rank == 0 and mesh_json:
+                    cmd.extend(["--mesh-axes", mesh_json])
+                    import numpy as _np
+                    ndev = int(_np.prod(list(mesh_axes.values())))
+                    env = dict(
+                        env,
+                        XLA_FLAGS=(env.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform"
+                                   "_device_count=%d" % ndev).strip())
+                procs.append(subprocess.Popen(
+                    cmd, env=env, cwd=self._cwd,
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True))
+            deadline = time.monotonic() + self.startup_timeout_s
+            endpoints = [_wait_ready(p, deadline) for p in procs]
+            gid = self.router.add_group(endpoints)
+        except Exception:
+            # all-or-nothing: ANY member failing (spawn, READY
+            # timeout, admission refused) kills the WHOLE group — a
+            # partial mesh must never linger as orphan processes or
+            # reach the dispatch set
+            for p in procs:
+                p.kill()
+            raise
+        with self._mu:
+            self._spawned_groups[gid] = procs
+        self._stop.procs.extend(procs)  # fleet stop() reaps them too
+        return {"ok": True, "op": "scale_up_group", "group": gid,
+                "endpoints": endpoints,
+                "pids": [p.pid for p in procs],
+                "spawn_seconds": round(time.monotonic() - t0, 3),
+                "groups": self.replica_count()}
+
     def scale_down(self) -> dict:
+        if self._grouped:
+            return self._scale_down_group()
         with self._mu:
             if not self._spawned:
                 raise RuntimeError(
@@ -446,6 +528,32 @@ class FleetScaler:
         return {"ok": True, "op": "scale_down", "replica": rid,
                 "served_requests": snap.get("requests"),
                 "replicas": self.replica_count()}
+
+    def _scale_down_group(self) -> dict:
+        with self._mu:
+            if not self._spawned_groups:
+                raise RuntimeError(
+                    "nothing to retire: this scaler spawned no "
+                    "groups beyond the base fleet")
+            gid = max(self._spawned_groups)   # newest-first
+            procs = self._spawned_groups.pop(gid)
+        self.router.remove_group(gid)
+        for proc in procs:
+            try:
+                proc.stdin.close()   # replicas exit on stdin EOF
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+            try:
+                self._stop.procs.remove(proc)
+            except ValueError:
+                pass
+        return {"ok": True, "op": "scale_down_group", "group": gid,
+                "groups": self.replica_count()}
 
 
 def run_closed_loop(engine, make_feed, concurrency, duration_s,
