@@ -1593,6 +1593,132 @@ def bench_qps_under_autoscale(duration_s=None, concurrency=None,
             "scale_events": scale_events}
 
 
+def bench_sparse_serving(duration_s=None, concurrency=None,
+                         trials=None):
+    """Sparse serving plane rows (docs/serving.md §Sparse serving),
+    both through tools/load_gen.build_sparse_stack so the bench, the
+    standalone tool, and the chaos scenario measure the same world:
+
+    - ``sparse_serving_qps``: closed-loop Zipf-skewed traffic against
+      a SparseServingReplica (device tier + host Tier 0 + stamped
+      authority pulls, staleness bound 8) WHILE a trainer pushes q8
+      grads into the same tables — the train-and-serve number.
+    - ``fresh_weight_to_served_ms`` (printed alongside): push-commit
+      to the FIRST request whose reply observes the new row, probed at
+      the tightest contract (bound 0, watermark poll every request) so
+      the number is the coherence machinery's floor — watermark poll +
+      authority re-pull + device-tier refill — not an artifact of how
+      long a loose bound legally hides the update."""
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_gen
+    from paddle_tpu.serving import SparseServingConfig
+
+    unit_qps = "qps closed-loop Zipf serving while training pushes"
+    unit_fresh = "ms push-commit to first served read (bound 0)"
+    if _over_budget():
+        _log("time budget exceeded — skipping sparse_serving")
+        print(json.dumps({"metric": "fresh_weight_to_served_ms",
+                          "value": None, "unit": unit_fresh,
+                          "skipped": ["over_budget"]}), flush=True)
+        return {"metric": "sparse_serving_qps", "value": None,
+                "unit": unit_qps, "skipped": ["over_budget"]}
+    duration_s = duration_s or _env_float(
+        "BENCH_SPARSE_SERVING_DURATION_S", 8.0)
+    concurrency = concurrency or int(
+        _env_float("BENCH_SPARSE_SERVING_CONCURRENCY", 8))
+    trials = trials or int(_env_float("BENCH_FRESHNESS_TRIALS", 5))
+    VOCAB, DIM, SLOTS = 4096, 16, 3
+    rng = np.random.RandomState(11)
+    perm = rng.permutation(VOCAB)
+
+    # -- row 1: train-and-serve closed-loop throughput ---------------
+    router, reps, _servers, trainer, stop = \
+        load_gen.build_sparse_stack(VOCAB, DIM, shards=2,
+                                    staleness_bound=8)
+    try:
+        make_feed = load_gen.sparse_feed_maker(
+            rng, VOCAB, SLOTS, 1, 8, perm=perm)
+        for _ in range(4):            # warm connections + jit buckets
+            router.infer_sync(make_feed()[0], timeout=30)
+        push_stop = threading.Event()
+        pushes = [0]
+
+        def pusher():
+            trng = np.random.RandomState(23)
+            while not push_stop.is_set():
+                ids = load_gen.zipf_ids(trng, VOCAB, 64, perm=perm)
+                trainer.push(ids, (trng.randn(64, DIM) * 0.01)
+                             .astype(np.float32))
+                pushes[0] += 1
+                push_stop.wait(0.02)
+
+        pt = threading.Thread(target=pusher, daemon=True)
+        pt.start()
+        t0 = time.time()
+        r = load_gen.run_closed_loop(router, make_feed, concurrency,
+                                     duration_s, None)
+        wall = time.time() - t0
+        push_stop.set()
+        pt.join(timeout=10)
+        stats = reps[0].stats()
+    finally:
+        stop()
+    lat = np.asarray(r["client_lat_ms"])
+    qps = round(lat.size / wall, 2) if wall else None
+
+    # -- row 2: freshness floor at the tightest contract -------------
+    router2, _reps2, _srv2, trainer2, stop2 = \
+        load_gen.build_sparse_stack(
+            VOCAB, DIM, shards=2, staleness_bound=0)
+    fresh_ms = []
+    try:
+        _reps2[0].config.watermark_poll_every = 1
+        for k in range(trials):
+            pid = int(perm[k])
+            feed = {"ids": np.asarray([[pid]], np.int64)}
+            base = np.asarray(
+                router2.infer_sync(feed, timeout=30)[1])
+            t_push = time.monotonic()
+            trainer2.push(np.asarray([pid], np.int64),
+                          np.full((1, DIM), 1.0, np.float32))
+            while True:
+                out = np.asarray(
+                    router2.infer_sync(feed, timeout=30)[1])
+                if not np.allclose(out, base):
+                    fresh_ms.append(
+                        (time.monotonic() - t_push) * 1e3)
+                    break
+                if time.monotonic() - t_push > 30.0:
+                    break
+    finally:
+        stop2()
+    fresh = round(float(np.median(fresh_ms)), 3) if fresh_ms else None
+    print(json.dumps({
+        "metric": "fresh_weight_to_served_ms", "value": fresh,
+        "unit": unit_fresh, "trials": len(fresh_ms),
+        "p_max_ms": round(float(np.max(fresh_ms)), 3)
+        if fresh_ms else None}), flush=True)
+
+    tiers = stats.get("tiers") or {}
+    dev = tiers.get("device") or {}
+    return {"metric": "sparse_serving_qps", "value": qps,
+            "unit": unit_qps,
+            "concurrency": concurrency, "duration_s": duration_s,
+            "vocab": VOCAB, "dim": DIM, "slots": SLOTS,
+            "trainer_pushes": pushes[0],
+            "p99_ms": round(float(np.percentile(lat, 99)), 2)
+            if lat.size else None,
+            "device_hit_rate": round(dev.get("hit_rate", 0.0), 4),
+            "host_hit_rows": tiers.get("host_hit_rows"),
+            "remote_rows": tiers.get("remote_rows"),
+            "staleness": stats.get("staleness"),
+            "client_failed": r["client_failed"]}
+
+
 # ---------------------------------------------------------------------------
 # resilience: anomaly-guard overhead
 # ---------------------------------------------------------------------------
@@ -1960,14 +2086,14 @@ def bench_reshard_bytes(vocab=4096, dim=32, touched=3000):
 
 
 def zipf_ids(rng, vocab, size, skew=0.9, perm=None):
-    """Bounded Zipf key stream: P(rank r) ∝ r^-skew over ``vocab``
-    ids, rank->id scrambled by ``perm`` so hot keys scatter across
-    hash shards (a real CTR id space has no rank order)."""
-    p = np.arange(1, vocab + 1, dtype=np.float64) ** -float(skew)
-    p /= p.sum()
-    ranks = rng.choice(vocab, size=size, p=p)
-    return (perm[ranks] if perm is not None else ranks) \
-        .astype(np.int64)
+    """Bounded Zipf key stream — delegates to the CANONICAL
+    tools/load_gen.zipf_ids so the sparse bench rows, the standalone
+    ``--sparse-table`` tool, and the train-and-serve chaos scenario
+    all draw from ONE generator (comparable skew by construction)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_gen
+    return load_gen.zipf_ids(rng, vocab, size, skew=skew, perm=perm)
 
 
 def bench_sparse_embedding_throughput(steps=12, batch_rows=2048,
@@ -2692,6 +2818,7 @@ def child_main():
                  bench_pipelined_sparse_throughput,
                  bench_serving_latency, bench_serving_fleet_scaling,
                  bench_remediation_recovery, bench_qps_under_autoscale,
+                 bench_sparse_serving,
                  bench_deepfm, bench_bert,
                  bench_transformer_longseq,
                  bench_resnet50, bench_resnet50_hostfed]

@@ -321,6 +321,15 @@ class LargeScaleKV:
         # ids pushed while the bulk stream is in flight, re-sent as
         # the commit delta so no update is lost to the race
         self._dirty: Optional[set] = None
+        # bounded-staleness coherence stamps (docs/serving.md §Sparse
+        # serving): the shard's push WATERMARK counts applied push
+        # calls; every touched row records the watermark of its last
+        # update. The version map is NOT evicted with its row — a
+        # spilled row's version must survive the spill round-trip, and
+        # two ints per ever-touched row is noise next to the row
+        # itself.
+        self._push_count = 0
+        self._versions: Dict[int, int] = {}
 
     def _init_row(self, rid: int) -> np.ndarray:
         rs = np.random.RandomState(
@@ -434,7 +443,43 @@ class LargeScaleKV:
                         % self.optimizer)
             if self._dirty is not None:
                 self._dirty.update(int(i) for i in uniq)
+            self._push_count += 1
+            for rid in uniq:
+                self._versions[int(rid)] = self._push_count
             self._trim_locked()
+
+    # -- bounded-staleness stamps (serving/sparse.py consumes these) --------
+    def watermark(self) -> int:
+        """Count of APPLIED push calls on this shard. A serving
+        replica that saw watermark W when it cached a row knows the
+        copy can miss at most (current - W) pushes."""
+        with self._mu:
+            return self._push_count
+
+    def versions(self, ids) -> np.ndarray:
+        """Per-row last-push version (0 = never pushed: the row is
+        still its deterministic lazy init, fresh by construction)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._mu:
+            return np.asarray([self._versions.get(int(i), 0)
+                               for i in ids], np.int64)
+
+    def pull_stamped(self, ids):
+        """-> (rows, versions, watermark) under ONE lock acquisition,
+        so the triple is mutually consistent: no push can land between
+        the rows read and the watermark stamped on them. Empty ids
+        answer just the watermark (the gate's cheap poll)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._mu:
+            if not ids.size:
+                return (np.zeros((0, self.dim), self.dtype),
+                        np.zeros(0, np.int64), self._push_count)
+            self._reserve_locked(ids)
+            out = np.stack([self._row(int(i)) for i in ids])
+            vers = np.asarray([self._versions.get(int(i), 0)
+                               for i in ids], np.int64)
+            self._trim_locked()
+            return out, vers, self._push_count
 
     def size(self):
         with self._mu:
@@ -480,6 +525,19 @@ class LargeScaleKV:
                     [self._accum[int(i)] for i in a_ids])
             out["spill_horizon"] = np.asarray(
                 self._spill.horizon() if self._spill else 0, np.int64)
+            # coherence stamps commit in the SAME durable boundary as
+            # the rows they describe: a restart rolls the watermark
+            # back exactly as far as it rolls the rows back, so a
+            # serving replica's staleness math stays sound across the
+            # restore (the incarnation fence re-reads everything
+            # through the restored authority anyway)
+            out["push_watermark"] = np.asarray(self._push_count,
+                                               np.int64)
+            v_ids = np.fromiter(self._versions.keys(), np.int64,
+                                len(self._versions))
+            out["version_ids"] = v_ids
+            out["version_vals"] = np.asarray(
+                [self._versions[int(i)] for i in v_ids], np.int64)
             return out
 
     def gc_boundary(self):
@@ -525,6 +583,15 @@ class LargeScaleKV:
                 accum = np.asarray(arrays["accum"], self.dtype)
                 for j, rid in enumerate(a_ids):
                     self._accum[int(rid)] = np.array(accum[j])
+            self._push_count = int(np.asarray(
+                arrays.get("push_watermark", 0)).reshape(-1)[0])
+            self._versions = {}
+            v_ids = np.asarray(arrays.get("version_ids", ()),
+                               np.int64)
+            if len(v_ids):
+                v_vals = np.asarray(arrays["version_vals"], np.int64)
+                for j, rid in enumerate(v_ids):
+                    self._versions[int(rid)] = int(v_vals[j])
 
     # -- live-reshard integration (distributed/reshard.py) -----------------
     def owned_ids(self) -> np.ndarray:
@@ -586,6 +653,9 @@ class LargeScaleKV:
                 self._rows[rid] = np.array(values[j])
                 self._ref[rid] = False
                 self._accum.pop(rid, None)
+                # migrated rows install as authority "fresh as of this
+                # shard's now": their last write IS the migration
+                self._versions[rid] = self._push_count
             if len(accum_ids):
                 acc = np.asarray(accum, self.dtype).reshape(
                     len(accum_ids), self.dim)
@@ -603,6 +673,7 @@ class LargeScaleKV:
                 self._rows.pop(rid, None)
                 self._ref.pop(rid, None)
                 self._accum.pop(rid, None)
+                self._versions.pop(rid, None)
                 if self._spill is not None:
                     self._spill.discard(rid)
 
@@ -665,7 +736,8 @@ class LookupServiceClient:
                  write_policy: str = "mirror_sgd",
                  mirror_lr: Optional[float] = None,
                  max_residual_rows: Optional[int] = None,
-                 topology: Optional[Callable[[], List[str]]] = None):
+                 topology: Optional[Callable[[], List[str]]] = None,
+                 stamped: bool = False):
         self.table = table_name
         self.dim = dim
         self.trainer_id = trainer_id
@@ -715,6 +787,17 @@ class LookupServiceClient:
         self.pulled_rows = 0
         self.pushed_rows = 0
         self.cache_hit_rows = 0
+        # bounded-staleness stamps (``stamped=True`` — the serving
+        # read path, docs/serving.md §Sparse serving): pulls ride
+        # PREFETCH_STAMPED and record, per pulled row, (last-push
+        # version, shard watermark at pull time) plus each shard's
+        # last observed watermark. The consumer (SparseServingReplica)
+        # serializes access, so plain dicts suffice; both maps drop
+        # with the hot tier on an incarnation fence or reshard — a
+        # restarted/resharded authority's watermark is a NEW clock.
+        self.stamped = bool(stamped)
+        self.row_stamps: Dict[int, Tuple[int, int]] = {}
+        self.shard_watermarks: Dict[str, int] = {}
 
     def _next_seq(self, shard):
         if self.trainer_id is None:
@@ -766,6 +849,11 @@ class LookupServiceClient:
             return False
         self.invalidation_count += 1
         dropped = self.cache.invalidate_all() if self.cache else 0
+        # a restarted authority restored an OLDER watermark with its
+        # rows: the stamp clock moved backwards, so every recorded
+        # stamp is meaningless — drop them with the hot tier
+        self.row_stamps.clear()
+        self.shard_watermarks.clear()
         _obs.emit("sparse_cache_invalidated", table=self.table,
                   shards=changed, rows_dropped=dropped,
                   tid=self.trainer_id)
@@ -816,6 +904,8 @@ class LookupServiceClient:
         self.endpoints = new_endpoints
         self._incarnations = {}
         self.invalidation_count += 1
+        self.row_stamps.clear()
+        self.shard_watermarks.clear()
         dropped = self.cache.invalidate_all() if self.cache else 0
         _obs.emit("sparse_shard_map_applied", table=self.table,
                   n_shards=len(clients), rows_dropped=dropped,
@@ -853,7 +943,14 @@ class LookupServiceClient:
                     continue
                 pos = pending[mask]
                 try:
-                    if self.pull_q8:
+                    if self.stamped:
+                        res, vers, wm = client.prefetch_stamped(
+                            self.table, ids[pos], q8=self.pull_q8)
+                        out[pos] = dequantize_rows_q8(*res) \
+                            if self.pull_q8 else res
+                        self._record_stamps(client.endpoint,
+                                            ids[pos], vers, wm)
+                    elif self.pull_q8:
                         q, scales = client.prefetch_q8(self.table,
                                                        ids[pos])
                         out[pos] = dequantize_rows_q8(q, scales)
@@ -874,6 +971,56 @@ class LookupServiceClient:
         raise RpcError("UNAVAILABLE: sparse pull on %r kept fencing "
                        "across %d shard-map refreshes (%s)"
                        % (self.table, _RESHARD_RETRIES, fence))
+
+    # -- bounded-staleness stamps (the serving read path) -------------------
+    def _record_stamps(self, endpoint, ids, versions, watermark):
+        self.shard_watermarks[endpoint] = int(watermark)
+        wm = int(watermark)
+        for j, rid in enumerate(np.asarray(ids, np.int64)):
+            self.row_stamps[int(rid)] = (int(versions[j]), wm)
+
+    def watermarks(self, refresh: bool = False) -> Dict[str, int]:
+        """Per-shard push watermark as last OBSERVED (every stamped
+        pull piggybacks its shard's). ``refresh`` polls every shard
+        with an empty stamped prefetch — the staleness gate amortizes
+        this across ``watermark_poll_every`` requests."""
+        enforce(self.stamped, "watermarks() needs stamped=True")
+        if refresh or not self.shard_watermarks:
+            empty = np.zeros(0, np.int64)
+            for client in self.clients:
+                _, _, wm = client.prefetch_stamped(self.table, empty)
+                self.shard_watermarks[client.endpoint] = wm
+        return dict(self.shard_watermarks)
+
+    def staleness(self, ids) -> np.ndarray:
+        """Per-id bound on missed pushes: the id's shard watermark
+        (last observed) minus the watermark recorded when the row was
+        pulled. -1 = no stamp (never pulled, or dropped by a fence) —
+        the caller must treat it as "fetch before serving"."""
+        enforce(self.stamped, "staleness() needs stamped=True")
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.full(len(ids), -1, np.int64)
+        shard = self._shard(ids)
+        for j, rid in enumerate(ids):
+            stamp = self.row_stamps.get(int(rid))
+            if stamp is None:
+                continue
+            wm_now = self.shard_watermarks.get(
+                self.clients[int(shard[j])].endpoint)
+            if wm_now is None:
+                continue
+            out[j] = max(0, wm_now - stamp[1])
+        return out
+
+    def refresh_rows(self, ids) -> np.ndarray:
+        """Force an authority re-read of ``ids`` (the staleness gate's
+        REPULL action): hot-tier copies drop first so the pull cannot
+        be served from the very rows being refreshed. Returns the
+        fresh rows; stamps update as a side effect."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if self.cache is not None and ids.size:
+            self.cache.invalidate_ids(np.unique(ids))
+        return self.pull(ids)
 
     def pull(self, ids) -> np.ndarray:
         """Fetch rows for (possibly duplicated) ids; returns
@@ -1070,6 +1217,9 @@ class LookupServiceClient:
                "wire_bytes": self.wire_bytes()}
         if self.cache is not None:
             out["cache"] = self.cache.stats()
+        if self.stamped:
+            out["stamped_rows"] = len(self.row_stamps)
+            out["shard_watermarks"] = dict(self.shard_watermarks)
         return out
 
     def close(self):

@@ -326,6 +326,7 @@ class ListenAndServ:
         s.register("COMPLETE", self._on_complete)
         s.register("PREFETCH", self._on_prefetch)
         s.register("PREFETCH_Q8", self._on_prefetch_q8)
+        s.register("PREFETCH_STAMPED", self._on_prefetch_stamped)
         s.register("PUSH_SPARSE", self._on_push_sparse)
         s.register("PUSH_SPARSE_Q8", self._on_push_sparse_q8)
         s.register("HEARTBEAT", self._on_heartbeat)
@@ -924,6 +925,30 @@ class ListenAndServ:
         self._check_sparse_route(name, ids, push=False)
         q, scales = quantize_rows_q8(self._table(name).pull(ids))
         return serialize_tensor(q) + serialize_tensor(scales)
+
+    def _on_prefetch_stamped(self, name, payload):
+        """Stamped rows lookup (docs/serving.md §Sparse serving): rows
+        + per-row last-push versions + this shard's push watermark,
+        all read under ONE table lock so the serving replicas'
+        staleness math is exact. The payload's q8 flag picks the wire
+        codec (same threshold discipline as PREFETCH_Q8); EMPTY ids
+        are the cheap watermark poll. Response layout:
+        versions | watermark | rows (or q | scales)."""
+        from ..parallel.collectives import quantize_rows_q8
+        name, _, _ = unpack_wire_name(name)
+        ids, off = deserialize_tensor(payload)
+        flag, _ = deserialize_tensor(payload, off)
+        q8 = bool(np.asarray(flag).reshape(-1)[0])
+        self._check_sparse_route(name, ids, push=False)
+        rows, vers, wm = self._table(name).pull_stamped(ids)
+        head = (serialize_tensor(vers) +
+                serialize_tensor(np.asarray(wm, np.int64)))
+        if q8:
+            q, scales = quantize_rows_q8(rows)
+            return (head + serialize_tensor(q) +
+                    serialize_tensor(scales))
+        return head + serialize_tensor(
+            np.asarray(rows, np.float32))
 
     def _push_sparse_common(self, name, tid, seq, ids, apply_fn):
         """Shared dedup + route fence + apply + boundary skeleton of

@@ -67,6 +67,15 @@ VERBS = {
     # state) — no coordinator ever materializes the table
     "RESHARD": 14,
     "IMPORT_ROWS": 15,
+    # stamped sparse read (docs/serving.md §Sparse serving): a
+    # PREFETCH twin whose response additionally carries each row's
+    # last-push VERSION and the shard's push WATERMARK, read under one
+    # table lock so they are mutually consistent — the raw material of
+    # the serving replicas' bounded-staleness gate. An EMPTY id set is
+    # legal and answers just the watermark (the cheap poll the gate
+    # amortizes across requests). Payload: ids + q8 flag; response:
+    # versions | watermark | rows (or q | scales when q8).
+    "PREFETCH_STAMPED": 16,
 }
 
 # response status byte (the wire field is u8 — keep codes < 256)
@@ -577,6 +586,28 @@ class RPCClient:
         q, off = deserialize_tensor(body)
         scales, _ = deserialize_tensor(body, off)
         return q, scales
+
+    def prefetch_stamped(self, table: str, ids: np.ndarray,
+                         q8: bool = False):
+        """Stamped rows lookup -> (rows, versions i64 [n], watermark
+        int); ``rows`` is f32 [n, dim], or the (q, scales) pair when
+        ``q8``. The triple is read under one table lock server-side,
+        so no push can land between the rows and the watermark stamped
+        on them. Empty ``ids`` still answers the shard's live push
+        watermark — the staleness gate's cheap poll."""
+        payload = (serialize_tensor(np.asarray(ids, np.int64)) +
+                   serialize_tensor(
+                       np.asarray([1 if q8 else 0], np.int64)))
+        body = self.call("PREFETCH_STAMPED", table, payload)
+        versions, off = deserialize_tensor(body)
+        wm_arr, off = deserialize_tensor(body, off)
+        wm = int(np.asarray(wm_arr).reshape(-1)[0])
+        if q8:
+            q, off = deserialize_tensor(body, off)
+            scales, _ = deserialize_tensor(body, off)
+            return (q, scales), versions, wm
+        rows, _ = deserialize_tensor(body, off)
+        return rows, versions, wm
 
     def barrier(self, name: str = "step", deadline_s=_UNSET,
                 seq: Optional[int] = None):

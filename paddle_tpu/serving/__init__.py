@@ -13,6 +13,11 @@ Fleet layer: ``replica.py`` puts one engine behind the RPC transport
 ``router.py`` fronts N replicas with queue-depth-aware dispatch,
 structured shedding, lease-based eviction with transparent retry, and
 ``signature_compat``-gated hot-swap — docs/serving.md §"Fleet serving".
+
+Sparse plane: ``sparse.py`` serves the >HBM recommender straight from
+the LIVE pserver tables trainers are pushing into — device row tier
+over host Tier 0 over the spill+snapshot authority, with a
+bounded-staleness coherence gate — docs/serving.md §"Sparse serving".
 """
 
 from .buckets import bucket_for, bucket_sizes, pad_batch  # noqa: F401
@@ -24,6 +29,8 @@ from .replica import ServingReplica  # noqa: F401
 from .router import (ReplicaUnavailable, RouterConfig,  # noqa: F401
                      ServingRouter)
 from .signature import SignatureMismatch, signature_compat  # noqa: F401
+from .sparse import (SparseServingConfig,  # noqa: F401
+                     SparseServingReplica, StaleRows)
 
 __all__ = ["ServingEngine", "ServingConfig", "ServingError",
            "ServerOverloaded", "DeadlineExceeded", "EngineStopped",
@@ -31,4 +38,5 @@ __all__ = ["ServingEngine", "ServingConfig", "ServingError",
            "bucket_sizes", "bucket_for", "pad_batch",
            "ServingReplica", "ServingRouter", "RouterConfig",
            "ReplicaUnavailable", "signature_compat",
-           "SignatureMismatch"]
+           "SignatureMismatch", "SparseServingReplica",
+           "SparseServingConfig", "StaleRows"]

@@ -1,0 +1,584 @@
+"""Sparse serving plane: train-AND-serve the >HBM recommender through
+one embedding authority (docs/serving.md §Sparse serving).
+
+PR 14 built the storage tiers (hot row cache, q8 wire, durable spill,
+bit-exact table snapshots) and PR 17 made the pserver plane elastically
+reshardable — this module SERVES through all of it. A
+``SparseServingReplica`` answers the PR 8 router's INFER protocol
+(``pack_blob`` meta + tensors, piggybacked load, HEARTBEAT lease,
+structured errors, chaos ``crash()`` seam — wire-compatible with
+``ServingRouter`` unchanged), but its per-request forward consults a
+``LookupServiceClient`` instead of a compiled model: the request's id
+set keys a batch prefetch against the live ``LargeScaleKV`` shards the
+TRAINERS are pushing into, so freshly trained rows reach serving with
+no export/reload step in between.
+
+Cache tiers, top down:
+
+  - **device tier** (``_DeviceRowTier``): the hottest rows resident as
+    ONE pinned device array (slots gathered on device per request,
+    CLOCK eviction, per-tier hit/miss counters);
+  - **host Tier 0**: the client's ``EmbeddingRowCache`` (PR 14 —
+    touch-frequency admission under a byte budget);
+  - **authority**: the pserver shards (``PREFETCH_STAMPED`` — the
+    PREFETCH_Q8 codec plus per-row versions + the shard's push
+    watermark), with Tier 2 spill + snapshots below, so the served
+    table can be bigger than any host.
+
+Bounded-staleness coherence contract (async multi-trainer fleets —
+beyond ``mirror_sgd``'s bit-equal sync-only contract): every shard
+counts applied pushes (its WATERMARK) and stamps each row with the
+watermark of its last update; every stamped pull records (row version,
+watermark seen). A cached row's staleness bound is the shard's current
+watermark minus the watermark it was pulled at — the number of pushes
+the copy can possibly have missed. Before serving, the gate bounds that
+lag by ``max_staleness_steps``: rows over the bound are RE-PULLED from
+authority (``staleness_action="repull"``, the default) or the request
+is SHED with a structured ``StaleRows`` error ("shed"). Watermark
+knowledge stays fresh for free on every authority read and is refreshed
+by an amortized empty-prefetch poll every ``watermark_poll_every``
+requests. With ``enforce=False`` the gate only OBSERVES: over-bound
+rows are served and journalled as ``stale_row_served`` (row id, its
+last-push version, the replica's pull watermark, the shard's current
+watermark) — the event ``tools/doctor.py`` turns into a
+``stale_serving`` verdict.
+
+Lock discipline (tools/lock_lint.py pins this file): journal emits
+NEVER happen under the cache mutex — handlers collect events while
+holding ``_mu`` and flush them after release.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as _obs
+from ..distributed.lookup_service import LookupServiceClient
+from .engine import InvalidRequest, ServingError
+from .replica import pack_blob, unpack_blob
+
+__all__ = ["SparseServingReplica", "StaleRows", "SparseServingConfig"]
+
+
+class StaleRows(ServingError):
+    """The staleness gate shed this request: rows in its id set exceed
+    ``max_staleness_steps`` and the replica is configured to refuse
+    rather than re-pull (e.g. while its authority shard is
+    restarting). Structured — the router/client can switch on the
+    code and retry elsewhere or later."""
+    code = "STALE_ROWS"
+
+
+class SparseServingConfig:
+    """Knobs of one sparse serving replica (constructor kwargs live
+    here so the chaos scenario, bench, and load_gen share defaults).
+
+    - ``max_staleness_steps``: serve no row whose copy may have missed
+      more than this many authority pushes (None disarms the gate).
+    - ``staleness_action``: ``"repull"`` re-reads over-bound rows from
+      authority; ``"shed"`` refuses the request with ``StaleRows``.
+    - ``enforce``: False = observe-only (serve + journal
+      ``stale_row_served`` — the doctor-visible breach).
+    - ``watermark_poll_every``: refresh every shard's watermark by an
+      empty stamped prefetch every N requests (authority reads keep it
+      fresh in between, for free).
+    - ``device_rows``: capacity of the pinned device array tier.
+    - ``cache_bytes``: host Tier 0 budget (EmbeddingRowCache).
+    """
+
+    def __init__(self, max_staleness_steps: Optional[int] = 8,
+                 staleness_action: str = "repull",
+                 enforce: bool = True,
+                 watermark_poll_every: int = 16,
+                 device_rows: int = 1024,
+                 cache_bytes: int = 1 << 20,
+                 pull_q8: bool = True,
+                 admit_after: int = 1,
+                 deadline_s: float = 10.0,
+                 retry=None,
+                 workers: int = 4):
+        if staleness_action not in ("repull", "shed"):
+            raise ValueError("staleness_action must be 'repull' or "
+                             "'shed', got %r" % (staleness_action,))
+        self.max_staleness_steps = max_staleness_steps
+        self.staleness_action = staleness_action
+        self.enforce = bool(enforce)
+        self.watermark_poll_every = max(1, int(watermark_poll_every))
+        self.device_rows = int(device_rows)
+        self.cache_bytes = int(cache_bytes)
+        self.pull_q8 = bool(pull_q8)
+        self.admit_after = int(admit_after)
+        self.deadline_s = float(deadline_s)
+        self.retry = retry
+        self.workers = max(1, int(workers))
+
+
+class _DeviceRowTier:
+    """The hottest rows as one resident device array: ``capacity``
+    slots of ``dim`` f32, id->slot map with CLOCK eviction, per-tier
+    hit/miss accounting. Slot bookkeeping is mutex-guarded; the device
+    array update itself runs outside the mutex (the replica serializes
+    fills through its lookup lock, and a device write is exactly the
+    slow path the bookkeeping lock must not cover)."""
+
+    def __init__(self, dim: int, capacity_rows: int):
+        import jax
+        import jax.numpy as jnp
+        self._jnp = jnp
+        self.dim = int(dim)
+        self.capacity = max(8, int(capacity_rows))
+        self._slots = jax.device_put(
+            jnp.zeros((self.capacity, self.dim), jnp.float32))
+        self._mu = threading.Lock()
+        self._slot_of: Dict[int, int] = {}
+        self._rid_of: List[Optional[int]] = [None] * self.capacity
+        self._ref = bytearray(self.capacity)
+        self._hand = 0
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+        self.fills = 0
+        self.evictions = 0
+        self.invalidated_rows = 0
+
+    def lookup(self, uniq: np.ndarray) -> np.ndarray:
+        """-> per-id slot (int32), -1 = miss. Touches CLOCK bits."""
+        with self._mu:
+            out = np.full(len(uniq), -1, np.int32)
+            for j, rid in enumerate(uniq):
+                s = self._slot_of.get(int(rid))
+                if s is not None:
+                    out[j] = s
+                    self._ref[s] = 1
+            n_hit = int((out >= 0).sum())
+            self.hits += n_hit
+            self.misses += len(uniq) - n_hit
+            return out
+
+    def _alloc_locked(self) -> int:
+        if self._free:
+            return self._free.pop()
+        while True:
+            s = self._hand
+            self._hand = (self._hand + 1) % self.capacity
+            if self._ref[s]:
+                self._ref[s] = 0
+            else:
+                return s
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        """Shape bucket (serving/buckets.py posture): device scatter/
+        gather index counts round up to the next power of two so the
+        jit cache holds O(log capacity) programs, not one per distinct
+        id-set size."""
+        return 1 << max(0, int(n) - 1).bit_length()
+
+    def fill(self, ids, rows) -> np.ndarray:
+        """Install host ``rows`` for ``ids``; returns their slots."""
+        ids = [int(i) for i in np.asarray(ids, np.int64)]
+        with self._mu:
+            slots = []
+            for rid in ids:
+                s = self._slot_of.get(rid)
+                if s is None:
+                    s = self._alloc_locked()
+                    old = self._rid_of[s]
+                    if old is not None:
+                        del self._slot_of[old]
+                        self.evictions += 1
+                    self._slot_of[rid] = s
+                    self._rid_of[s] = rid
+                self._ref[s] = 1
+                slots.append(s)
+            self.fills += len(ids)
+        slots = np.asarray(slots, np.int32)
+        rows = np.asarray(rows, np.float32)
+        # bucket-pad by REPEATING the last (slot, row) pair: writing
+        # one slot twice with the same row is idempotent, and the
+        # padded scatter shape comes from a pow-2 menu
+        pad = self._pow2(len(slots)) - len(slots)
+        if pad:
+            slots_w = np.concatenate([slots,
+                                      np.repeat(slots[-1:], pad)])
+            rows_w = np.concatenate([rows,
+                                     np.repeat(rows[-1:], pad, 0)])
+        else:
+            slots_w, rows_w = slots, rows
+        self._slots = self._slots.at[slots_w].set(
+            self._jnp.asarray(rows_w))
+        return slots
+
+    def gather(self, slots: np.ndarray) -> np.ndarray:
+        """Device-side gather of resident rows -> host [n, dim]
+        (bucket-padded with slot 0, sliced back after)."""
+        n = len(slots)
+        pad = self._pow2(n) - n
+        slots_w = np.concatenate([np.asarray(slots, np.int32),
+                                  np.zeros(pad, np.int32)]) \
+            if pad else np.asarray(slots, np.int32)
+        out = self._jnp.take(self._slots,
+                             self._jnp.asarray(slots_w), axis=0)
+        return np.asarray(out, np.float32)[:n]
+
+    def invalidate_ids(self, ids) -> int:
+        with self._mu:
+            n = 0
+            for rid in np.asarray(ids, np.int64).reshape(-1):
+                s = self._slot_of.pop(int(rid), None)
+                if s is not None:
+                    self._rid_of[s] = None
+                    self._ref[s] = 0
+                    self._free.append(s)
+                    n += 1
+            self.invalidated_rows += n
+            return n
+
+    def invalidate_all(self) -> int:
+        with self._mu:
+            n = len(self._slot_of)
+            self._slot_of.clear()
+            self._rid_of = [None] * self.capacity
+            self._ref = bytearray(self.capacity)
+            self._free = list(range(self.capacity - 1, -1, -1))
+            self._hand = 0
+            self.invalidated_rows += n
+            return n
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"capacity_rows": self.capacity,
+                    "resident_rows": len(self._slot_of),
+                    "hits": self.hits, "misses": self.misses,
+                    "hit_rate": self.hits / (self.hits + self.misses)
+                    if (self.hits + self.misses) else 0.0,
+                    "fills": self.fills, "evictions": self.evictions,
+                    "invalidated_rows": self.invalidated_rows}
+
+
+class SparseServingReplica:
+    """One sparse serving replica: the ``ServingReplica`` wire surface
+    (INFER/HEARTBEAT/CTRL verbs, piggybacked load, structured errors,
+    ``crash()``) with a LookupServiceClient + device tier forward
+    instead of a compiled model, and the bounded-staleness gate in
+    front of every served row.
+
+    The forward is a DeepFM-style scoring head over the live table:
+    request arrays carry an int64 id matrix ``[batch, slots]``; the
+    reply is ``[scores [batch], pooled [batch, dim]]`` where pooled is
+    the device-side sum of the slots' embedding rows and the score a
+    seeded fixed linear head over it — a deterministic function of the
+    authority rows, so freshness is black-box observable (the bench's
+    ``fresh_weight_to_served_ms`` row and the chaos scenario's
+    staleness assertions both key on it).
+
+    ``group_rank``/``group_size`` mirror the PR 13 sharded replica
+    groups: rank 0 is the group's executor (owns the lookup client +
+    device tier), ranks > 0 are the group's lease surface — an INFER
+    landing there answers a structured error, never silence. Behind a
+    ``RouterConfig(group_size=N)`` router the whole group admits and
+    evicts atomically, so a table larger than one host serves from as
+    many hosts as its shards need."""
+
+    def __init__(self, table: str, endpoints: List[str], dim: int,
+                 config: Optional[SparseServingConfig] = None,
+                 endpoint: str = "127.0.0.1:0", replica_id: int = 0,
+                 group_rank: int = 0, group_size: int = 1,
+                 topology=None, head_seed: int = 7,
+                 version: str = "v1"):
+        self.table = table
+        self.dim = int(dim)
+        self.config = config or SparseServingConfig()
+        self.replica_id = int(replica_id)
+        self.group_rank = int(group_rank)
+        self.group_size = int(group_size)
+        self.version = version
+        cfg = self.config
+        self._crashed = False
+        self._mu = threading.Lock()        # counters + ewma only
+        self._lookup_mu = threading.Lock()  # serializes tier pipeline
+        self._inflight = 0
+        self._ewma_ms: Optional[float] = None
+        self._req_count = 0
+        self._seen_invalidations = 0
+        # per-tier accounting (requested-row basis, like the client's)
+        self.host_hit_rows = 0
+        self.remote_rows = 0
+        self.repulled_rows = 0
+        self.shed_requests = 0
+        self.stale_served_rows = 0
+        self.max_lag_served = 0
+        self.client: Optional[LookupServiceClient] = None
+        self.device_tier: Optional[_DeviceRowTier] = None
+        if self.group_rank == 0:
+            self.client = LookupServiceClient(
+                table, list(endpoints), dim=dim,
+                deadline_s=cfg.deadline_s, retry=cfg.retry,
+                cache_bytes=cfg.cache_bytes,
+                admit_after=cfg.admit_after,
+                pull_q8=cfg.pull_q8, write_policy="none",
+                topology=topology, stamped=True)
+            self.device_tier = _DeviceRowTier(dim, cfg.device_rows)
+            rs = np.random.RandomState(head_seed)
+            self._head = (rs.randn(dim) / np.sqrt(dim)).astype(
+                np.float32)
+        import concurrent.futures
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=cfg.workers,
+            thread_name_prefix="sparse-serve-%d" % self.replica_id)
+        from ..distributed.rpc import RPCServer
+        self.server = RPCServer(endpoint)
+        self.endpoint = self.server.endpoint
+        self.server.register_deferred("INFER", self._on_infer)
+        self.server.register_deferred("CTRL", self._on_ctrl)
+        self.server.register("HEARTBEAT", self._on_heartbeat)
+
+    # -- load piggyback / wire plumbing (ServingReplica contract) ------
+    def load_snapshot(self) -> dict:
+        with self._mu:
+            return {"replica_id": self.replica_id,
+                    "queue_depth": self._inflight,
+                    "ewma_ms": self._ewma_ms}
+
+    def _err_meta(self, exc) -> dict:
+        err = exc.to_dict() if isinstance(exc, ServingError) else {
+            "code": "SERVING_ERROR", "message": repr(exc),
+            "details": {}}
+        return {"ok": False, "error": err,
+                "load": self.load_snapshot()}
+
+    def _respond(self, responder, status, payload):
+        if self._crashed:
+            return
+        try:
+            responder(status, payload)
+        except Exception:
+            pass
+
+    # -- the staleness gate + tier pipeline ----------------------------
+    def _gate_locked(self, uniq: np.ndarray, events: list):
+        """Bound every row's possible missed-push count BEFORE it is
+        served. Called under ``_lookup_mu``; journal emits are
+        deferred into ``events``. Raises ``StaleRows`` on shed (the
+        caller flushes events first)."""
+        cfg = self.config
+        cl = self.client
+        if cfg.max_staleness_steps is None:
+            return None
+        self._req_count += 1
+        if (self._req_count % cfg.watermark_poll_every == 0
+                or not cl.shard_watermarks):
+            cl.watermarks(refresh=True)
+        lag = cl.staleness(uniq)
+        over = lag > cfg.max_staleness_steps
+        # the served-lag audit is measured against THIS gate's
+        # watermark snapshot — the bound is relative to the coherence
+        # check, not to pushes that land while the reply is in flight
+        # (those are the NEXT request's gate's problem). Rows the gate
+        # passes bound it; rows it repulls serve at lag 0 on this
+        # clock; -1 (never stamped) rows are pulled fresh below.
+        if over.any() or lag.size:
+            under = lag[~over]
+            if under.size and under.max() > 0:
+                self.max_lag_served = max(self.max_lag_served,
+                                          int(under.max()))
+        if not over.any():
+            return None
+        stale = uniq[over]
+        worst = int(lag[over].max())
+        if not cfg.enforce:
+            # observe-only: the breach doctor must be able to explain
+            self.stale_served_rows += int(stale.size)
+            self.max_lag_served = max(self.max_lag_served, worst)
+            rid = int(stale[0])
+            ver, seen_w = cl.row_stamps.get(rid, (0, 0))
+            shard = int(rid % len(cl.clients))
+            events.append(("stale_row_served", dict(
+                table=self.table, replica=self.replica_id,
+                rows=int(stale.size), row=rid, row_version=ver,
+                pull_watermark=seen_w,
+                shard_watermark=cl.shard_watermarks.get(
+                    cl.clients[shard].endpoint),
+                lag=worst, bound=cfg.max_staleness_steps)))
+            return None
+        if cfg.staleness_action == "shed":
+            self.shed_requests += 1
+            events.append(("stale_shed", dict(
+                table=self.table, replica=self.replica_id,
+                rows=int(stale.size), lag=worst,
+                bound=cfg.max_staleness_steps)))
+            return StaleRows(
+                "replica %d refuses %d row(s) up to %d push(es) "
+                "stale (bound %d)" % (self.replica_id, stale.size,
+                                      worst, cfg.max_staleness_steps),
+                replica=self.replica_id, rows=int(stale.size),
+                lag=worst, bound=cfg.max_staleness_steps)
+        # repull: authority re-read; device-tier copies of the stale
+        # rows drop so the fill below re-installs the fresh image
+        cl.refresh_rows(stale)
+        if self.device_tier is not None:
+            self.device_tier.invalidate_ids(stale)
+        self.repulled_rows += int(stale.size)
+        events.append(("stale_repull", dict(
+            table=self.table, replica=self.replica_id,
+            rows=int(stale.size), lag=worst,
+            bound=cfg.max_staleness_steps)))
+        return None
+
+    def _forward(self, id_batch: np.ndarray):
+        """ids [batch, slots] -> (scores [batch], pooled [batch, dim]).
+        Returns (outputs, events, exc): emits NEVER fire under
+        ``_lookup_mu`` — the caller flushes ``events`` after release
+        (lock_lint gate)."""
+        events: list = []
+        cl = self.client
+        tier = self.device_tier
+        b, s = id_batch.shape
+        flat = id_batch.reshape(-1)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        with self._lookup_mu:
+            # a restarted/resharded authority dropped the client's hot
+            # tier: the device tier mirrors those rows and must drop
+            # with it, exactly once per observed invalidation
+            if cl.invalidation_count != self._seen_invalidations:
+                self._seen_invalidations = cl.invalidation_count
+                dropped = tier.invalidate_all()
+                events.append(("sparse_device_tier_invalidated", dict(
+                    table=self.table, replica=self.replica_id,
+                    rows_dropped=dropped)))
+            exc = self._gate_locked(uniq, events)
+            if exc is not None:
+                return None, events, exc
+            slots = tier.lookup(uniq)
+            miss = slots < 0
+            if miss.any():
+                hits0 = cl.cache_hit_rows
+                rows_miss = cl.pull(uniq[miss])
+                host_hits = cl.cache_hit_rows - hits0
+                self.host_hit_rows += host_hits
+                self.remote_rows += int(miss.sum()) - host_hits
+                slots[miss] = tier.fill(uniq[miss], rows_miss)
+            emb_uniq = tier.gather(slots)
+        pooled = emb_uniq[inv].reshape(b, s, self.dim).sum(axis=1)
+        scores = pooled @ self._head
+        return ([np.asarray(scores, np.float32),
+                 np.asarray(pooled, np.float32)], events, None)
+
+    # -- handlers ------------------------------------------------------
+    def _serve(self, payload, responder):
+        t0 = time.monotonic()
+        events = ()
+        try:
+            meta, arrays = unpack_blob(payload)
+            if self.group_rank != 0:
+                raise InvalidRequest(
+                    "replica %d is shard member rank %d of a "
+                    "group-of-%d — INFER dispatches to the group's "
+                    "rank-0 executor" % (self.replica_id,
+                                         self.group_rank,
+                                         self.group_size),
+                    replica=self.replica_id,
+                    group_rank=self.group_rank)
+            names = list(meta.get("inputs") or ())
+            if "ids" not in names or not arrays:
+                raise InvalidRequest(
+                    "sparse INFER needs an int64 'ids' array, got "
+                    "inputs=%r" % (names,), replica=self.replica_id)
+            ids = np.asarray(arrays[names.index("ids")], np.int64)
+            if ids.ndim == 1:
+                ids = ids[:, None]
+            outs, events, exc = self._forward(ids)
+            for kind, fields in events:
+                _obs.emit(kind, **fields)
+            events = ()
+            if exc is not None:
+                raise exc
+            meta_out = {"ok": True, "version": self.version,
+                        "load": self.load_snapshot()}
+            self._respond(responder, 0, pack_blob(meta_out, outs))
+        except Exception as e:
+            for kind, fields in events:
+                _obs.emit(kind, **fields)
+            self._respond(responder, 0, pack_blob(self._err_meta(e)))
+        finally:
+            dt_ms = (time.monotonic() - t0) * 1e3
+            with self._mu:
+                self._inflight -= 1
+                self._ewma_ms = dt_ms if self._ewma_ms is None \
+                    else 0.2 * dt_ms + 0.8 * self._ewma_ms
+
+    def _on_infer(self, wire, payload, responder):
+        with self._mu:
+            self._inflight += 1
+        self._pool.submit(self._serve, payload, responder)
+
+    def _on_heartbeat(self, wire, payload):
+        from ..distributed.rpc import unpack_wire_meta
+        _base, tid, seq, _tok = unpack_wire_meta(wire)
+        if seq is not None:
+            _obs.emit("heartbeat_recv", tid=tid, beat=seq,
+                      endpoint=self.endpoint)
+        return pack_blob({"ok": True, "load": self.load_snapshot()})
+
+    def _on_ctrl(self, wire, payload, responder):
+        try:
+            meta, _ = unpack_blob(payload)
+            op = meta.get("op")
+            if op == "stats":
+                out = {"ok": True, "stats": self.stats()}
+            else:
+                raise InvalidRequest("unknown CTRL op %r" % op, op=op)
+        except Exception as e:
+            out = self._err_meta(e)
+        self._respond(responder, 0, pack_blob(out))
+
+    # -- introspection / lifecycle ------------------------------------
+    def stats(self) -> dict:
+        out = {"replica_id": self.replica_id,
+               "endpoint": self.endpoint,
+               "table": self.table,
+               "group_rank": self.group_rank,
+               "group_size": self.group_size,
+               "load": self.load_snapshot(),
+               "staleness": {
+                   "bound": self.config.max_staleness_steps,
+                   "action": self.config.staleness_action,
+                   "enforce": self.config.enforce,
+                   "repulled_rows": self.repulled_rows,
+                   "shed_requests": self.shed_requests,
+                   "stale_served_rows": self.stale_served_rows,
+                   "max_lag_served": self.max_lag_served}}
+        if self.client is not None:
+            out["tiers"] = {
+                "device": self.device_tier.stats(),
+                "host_hit_rows": self.host_hit_rows,
+                "remote_rows": self.remote_rows,
+                "client": self.client.stats()}
+        return out
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def crash(self):
+        """Chaos seam: die like a SIGKILLed process — sockets closed
+        NOW, in-flight INFERs never answered."""
+        self._crashed = True
+        self.server._crash()
+
+    def shutdown(self):
+        self.server.shutdown()
+        self._pool.shutdown(wait=False)
+        if self.client is not None:
+            self.client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

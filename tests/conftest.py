@@ -111,6 +111,14 @@ def pytest_configure(config):
         "markers",
         "elastic: elastic membership (join/leave/reshard) test "
         "(tier-1; select alone with -m elastic)")
+    # sparse serving plane (serving/sparse.py: device tier + host
+    # Tier 0 over the live pserver tables, bounded-staleness gate):
+    # loopback RPC, CPU-fast; the train-and-serve acceptance scenario
+    # also carries -m chaos, the multi-seed sweep -m slow
+    config.addinivalue_line(
+        "markers",
+        "sparse_serving: sparse serving plane test (tier-1; select "
+        "alone with -m sparse_serving)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,589 @@
+"""Sparse serving plane (docs/serving.md §"Sparse serving"): the
+stamped authority (per-row push versions + shard watermark, surviving
+snapshot round-trips and row migration), the stamped
+LookupServiceClient (staleness bounds, watermark polls, authority
+re-pulls), the device row tier (hit/miss accounting, CLOCK eviction,
+pow-2 shape buckets), the SparseServingReplica's bounded-staleness
+gate in its three modes (repull / shed / observe-only), group-sharded
+lookup routing behind the PR 8 router, the ``stale_serving`` doctor
+verdict, the lock_lint pin on serving/sparse.py, bench_diff direction
+pins for the two bench rows, and — under ``-m chaos`` — the
+train-AND-serve acceptance scenario (pserver kill mid-stream under
+1->3->1 autoscaling; the multi-seed sweep rides ``-m slow``)."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import LargeScaleKV, LookupServiceClient
+from paddle_tpu.distributed.ps import ListenAndServ
+from paddle_tpu.distributed.rpc import RPCClient
+from paddle_tpu.serving import (InvalidRequest, RouterConfig,
+                                ServingError, ServingRouter,
+                                SparseServingConfig,
+                                SparseServingReplica, StaleRows)
+from paddle_tpu.serving.sparse import _DeviceRowTier
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+sys.path.insert(0, TOOLS)
+
+pytestmark = pytest.mark.sparse_serving
+
+DIM = 16
+
+
+def _shards(n=2, lr=0.5, seed=9):
+    tables = [{"emb": LargeScaleKV(dim=DIM, lr=lr, seed=seed)}
+              for _ in range(n)]
+    servers = [ListenAndServ("127.0.0.1:0", {}, lambda nm, g: None,
+                             lookup_tables=tb).start()
+               for tb in tables]
+    return servers, [s.endpoint for s in servers], tables
+
+
+def _replica(eps, **cfg_kw):
+    kw = dict(max_staleness_steps=2, watermark_poll_every=1,
+              device_rows=64, cache_bytes=1 << 18)
+    kw.update(cfg_kw)
+    return SparseServingReplica(
+        "emb", eps, DIM, config=SparseServingConfig(**kw)).start()
+
+
+def _push_all(tables, ids, val=1.0, times=1):
+    """Authority-side pushes: every shard applies ``times`` pushes on
+    its subset of ``ids`` (ids route by id %% n_shards)."""
+    n = len(tables)
+    ids = np.asarray(ids, np.int64)
+    for _ in range(times):
+        for shard, tb in enumerate(tables):
+            mine = ids[ids % n == shard]
+            if mine.size:
+                tb["emb"].push(mine,
+                               np.full((mine.size, DIM), val,
+                                       np.float32))
+
+
+# ---------------------------------------------------------------------------
+# stamped authority: versions + watermark on the table and the wire
+# ---------------------------------------------------------------------------
+
+class TestStampedAuthority:
+    def test_watermark_counts_pushes_and_versions_stamp_rows(self):
+        kv = LargeScaleKV(dim=DIM, lr=0.5, seed=1)
+        assert kv.watermark() == 0
+        ids = np.arange(4, dtype=np.int64)
+        g = np.ones((4, DIM), np.float32)
+        kv.push(ids, g)
+        kv.push(ids[:2], g[:2])
+        assert kv.watermark() == 2
+        assert kv.versions(ids).tolist() == [2, 2, 1, 1]
+        # 0 = never pushed: lazily-initialized rows are fresh by
+        # construction (deterministic seed), not stale
+        assert kv.versions([99]).tolist() == [0]
+
+    def test_pull_stamped_is_one_consistent_read(self):
+        kv = LargeScaleKV(dim=DIM, lr=0.5, seed=1)
+        ids = np.arange(3, dtype=np.int64)
+        kv.push(ids, np.ones((3, DIM), np.float32))
+        rows, vers, wm = kv.pull_stamped(ids)
+        assert rows.shape == (3, DIM)
+        assert vers.tolist() == [1, 1, 1] and wm == 1
+        rows0, vers0, wm0 = kv.pull_stamped(np.zeros(0, np.int64))
+        assert rows0.shape == (0, DIM) and wm0 == 1
+
+    def test_stamps_survive_snapshot_roundtrip(self):
+        kv = LargeScaleKV(dim=DIM, lr=0.5, seed=1)
+        ids = np.arange(4, dtype=np.int64)
+        kv.push(ids, np.ones((4, DIM), np.float32))
+        kv.push(ids[2:], np.ones((2, DIM), np.float32))
+        state = kv.export_state()
+        kv2 = LargeScaleKV(dim=DIM, lr=0.5, seed=1)
+        kv2.import_state(state)
+        # the stamp clock commits in the SAME durable boundary as the
+        # rows: a restore rolls the watermark back exactly as far as
+        # the rows it restores
+        assert kv2.watermark() == kv.watermark() == 2
+        assert kv2.versions(ids).tolist() == kv.versions(ids).tolist()
+
+    def test_migrated_rows_stamp_at_dest_watermark(self):
+        src = LargeScaleKV(dim=DIM, lr=0.5, seed=1)
+        dst = LargeScaleKV(dim=DIM, lr=0.5, seed=2)
+        ids = np.arange(3, dtype=np.int64)
+        src.push(ids, np.ones((3, DIM), np.float32))
+        dst.push(np.asarray([7], np.int64),
+                 np.ones((1, DIM), np.float32))
+        dst.import_rows(ids, src.pull(ids))
+        # "fresh as of this shard's now" — the importing shard's clock
+        # owns the rows from here on
+        assert dst.versions(ids).tolist() == [1, 1, 1]
+        dst.drop_rows(ids[:1])
+        assert dst.versions(ids[:1]).tolist() == [0]
+
+    def test_prefetch_stamped_verb_and_empty_poll(self):
+        servers, eps, tables = _shards(1)
+        try:
+            tables[0]["emb"].push(np.arange(4, dtype=np.int64),
+                                  np.ones((4, DIM), np.float32))
+            c = RPCClient(eps[0])
+            rows, vers, wm = c.prefetch_stamped(
+                "emb", np.arange(4, dtype=np.int64))
+            assert rows.shape == (4, DIM)
+            assert vers.tolist() == [1, 1, 1, 1] and wm == 1
+            # EMPTY ids = the cheap watermark poll
+            rows0, vers0, wm0 = c.prefetch_stamped(
+                "emb", np.zeros(0, np.int64))
+            assert rows0.shape == (0, DIM) and wm0 == 1
+            c.close()
+        finally:
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# stamped client: staleness bounds + authority re-pull
+# ---------------------------------------------------------------------------
+
+class TestStampedClient:
+    def test_staleness_minus_one_until_pulled_then_tracks_lag(self):
+        servers, eps, tables = _shards(2)
+        cl = LookupServiceClient("emb", eps, dim=DIM, stamped=True,
+                                 write_policy="none")
+        try:
+            ids = np.arange(6, dtype=np.int64)
+            assert (cl.staleness(ids) == -1).all()
+            cl.pull(ids)
+            assert (cl.staleness(ids) == 0).all()
+            _push_all(tables, ids, times=3)
+            cl.watermarks(refresh=True)
+            assert (cl.staleness(ids) == 3).all()
+            # authority re-read resets the stamps it refreshes
+            cl.refresh_rows(ids[:3])
+            lag = cl.staleness(ids)
+            assert (lag[:3] == 0).all() and (lag[3:] == 3).all()
+        finally:
+            cl.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_stats_carry_stamp_counters(self):
+        servers, eps, _tables = _shards(1)
+        cl = LookupServiceClient("emb", eps, dim=DIM, stamped=True,
+                                 write_policy="none")
+        try:
+            cl.pull(np.arange(5, dtype=np.int64))
+            st = cl.stats()
+            assert st["stamped_rows"] == 5
+            assert eps[0] in st["shard_watermarks"]
+        finally:
+            cl.close()
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# device row tier: accounting, CLOCK eviction, shape buckets
+# ---------------------------------------------------------------------------
+
+class TestDeviceTier:
+    def test_hit_miss_accounting(self):
+        t = _DeviceRowTier(DIM, 16)
+        ids = np.arange(4, dtype=np.int64)
+        slots = t.lookup(ids)
+        assert (slots == -1).all() and t.misses == 4 and t.hits == 0
+        t.fill(ids, np.ones((4, DIM), np.float32))
+        slots = t.lookup(ids)
+        assert (slots >= 0).all() and t.hits == 4
+        got = t.gather(slots)
+        assert got.shape == (4, DIM)
+        assert np.allclose(got, 1.0)
+
+    def test_clock_eviction_bounds_residency(self):
+        t = _DeviceRowTier(DIM, 8)   # capacity floor is 8 slots
+        for batch in range(4):
+            ids = np.arange(batch * 8, batch * 8 + 8, dtype=np.int64)
+            t.lookup(ids)
+            t.fill(ids, np.full((8, DIM), float(batch), np.float32))
+        st = t.stats()
+        assert st["resident_rows"] == 8
+        assert st["evictions"] == 24
+        # the survivors serve the LAST batch's rows
+        slots = t.lookup(np.arange(24, 32, dtype=np.int64))
+        assert (slots >= 0).all()
+        assert np.allclose(t.gather(slots), 3.0)
+
+    def test_fill_pads_to_pow2_idempotently(self):
+        t = _DeviceRowTier(DIM, 16)
+        # 3 rows -> padded scatter of 4 (last pair repeated): the
+        # duplicate write must not corrupt the slot
+        ids = np.asarray([5, 6, 7], np.int64)
+        rows = np.stack([np.full(DIM, float(i), np.float32)
+                         for i in range(3)])
+        slots = t.fill(ids, rows)
+        assert len(slots) == 3
+        assert np.allclose(t.gather(slots), rows)
+        assert _DeviceRowTier._pow2(3) == 4
+        assert _DeviceRowTier._pow2(8) == 8
+
+    def test_invalidation_frees_slots(self):
+        t = _DeviceRowTier(DIM, 16)
+        ids = np.arange(6, dtype=np.int64)
+        t.fill(ids, np.ones((6, DIM), np.float32))
+        assert t.invalidate_ids(ids[:2]) == 2
+        assert (t.lookup(ids[:2]) == -1).all()
+        assert t.invalidate_all() == 4
+        assert t.stats()["resident_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the bounded-staleness gate: repull / shed / observe-only
+# ---------------------------------------------------------------------------
+
+class TestStalenessGate:
+    def test_repull_serves_fresh_rows_within_bound(self):
+        servers, eps, tables = _shards(2)
+        rep = _replica(eps, max_staleness_steps=2)
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            ids = np.arange(8, dtype=np.int64).reshape(4, 2)
+            out1 = router.infer_sync({"ids": ids}, timeout=30)
+            _push_all(tables, ids.reshape(-1), times=3)  # lag 3 > 2
+            out2 = router.infer_sync({"ids": ids}, timeout=30)
+            st = rep.stats()["staleness"]
+            assert st["repulled_rows"] > 0
+            assert st["stale_served_rows"] == 0
+            assert st["max_lag_served"] <= 2
+            # freshness is black-box observable: pooled rows moved
+            assert not np.allclose(out1[1], out2[1])
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_shed_raises_structured_stale_rows(self):
+        servers, eps, tables = _shards(2)
+        rep = _replica(eps, max_staleness_steps=0,
+                       staleness_action="shed")
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            ids = np.arange(6, dtype=np.int64).reshape(2, 3)
+            router.infer_sync({"ids": ids}, timeout=30)  # fresh pull
+            _push_all(tables, ids.reshape(-1), times=1)
+            with pytest.raises(ServingError) as ei:
+                router.infer_sync({"ids": ids}, timeout=30)
+            # StaleRows crosses the wire structured: details intact
+            # (the router maps unknown codes to the base class)
+            assert ei.value.details["bound"] == 0
+            assert ei.value.details["lag"] >= 1
+            assert rep.stats()["staleness"]["shed_requests"] == 1
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_shed_is_a_servingerror_subclass_locally(self):
+        assert issubclass(StaleRows, ServingError)
+        assert StaleRows.code == "STALE_ROWS"
+        e = StaleRows("x", lag=3, bound=1)
+        assert e.to_dict()["details"]["lag"] == 3
+
+    def test_observe_only_serves_and_journals_breach(self):
+        servers, eps, tables = _shards(2)
+        rep = _replica(eps, max_staleness_steps=1, enforce=False)
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            mark = obs.journal_events()[-1]["seq"] \
+                if obs.journal_events() else 0
+            ids = np.arange(4, dtype=np.int64).reshape(2, 2)
+            router.infer_sync({"ids": ids}, timeout=30)
+            _push_all(tables, ids.reshape(-1), times=4)
+            out = router.infer_sync({"ids": ids}, timeout=30)
+            assert out is not None          # served anyway
+            st = rep.stats()["staleness"]
+            assert st["stale_served_rows"] > 0
+            assert st["max_lag_served"] >= 4
+            evs = [e for e in obs.journal_events(since_seq=mark)
+                   if e["kind"] == "stale_row_served"]
+            assert evs, "breach must be journalled for doctor"
+            e0 = evs[0]
+            assert e0["bound"] == 1 and e0["lag"] >= 4
+            assert "row_version" in e0 and "pull_watermark" in e0
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_gate_disarmed_when_bound_none(self):
+        servers, eps, tables = _shards(1)
+        rep = _replica(eps, max_staleness_steps=None)
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            ids = np.arange(4, dtype=np.int64).reshape(2, 2)
+            router.infer_sync({"ids": ids}, timeout=30)
+            _push_all(tables, ids.reshape(-1), times=5)
+            router.infer_sync({"ids": ids}, timeout=30)
+            st = rep.stats()["staleness"]
+            assert st["repulled_rows"] == 0
+            assert st["shed_requests"] == 0
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_tier_accounting_across_requests(self):
+        servers, eps, _tables = _shards(2)
+        rep = _replica(eps)
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            ids = np.arange(10, dtype=np.int64).reshape(5, 2)
+            router.infer_sync({"ids": ids}, timeout=30)
+            tiers1 = rep.stats()["tiers"]
+            assert tiers1["device"]["misses"] == 10
+            assert tiers1["remote_rows"] == 10
+            router.infer_sync({"ids": ids}, timeout=30)
+            tiers2 = rep.stats()["tiers"]
+            # second identical request is a pure device-tier hit: no
+            # new host hits, no new authority rows
+            assert tiers2["device"]["hits"] == 10
+            assert tiers2["remote_rows"] == 10
+            assert tiers2["host_hit_rows"] == tiers1["host_hit_rows"]
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# group-sharded lookup routing (PR 13 replica groups)
+# ---------------------------------------------------------------------------
+
+class TestGroupShardedRouting:
+    def test_grouped_router_dispatches_to_rank0_executor(self):
+        servers, eps, _tables = _shards(2)
+        r0 = SparseServingReplica(
+            "emb", eps, DIM, replica_id=0, group_rank=0, group_size=2,
+            config=SparseServingConfig(max_staleness_steps=4)).start()
+        r1 = SparseServingReplica(
+            "emb", eps, DIM, replica_id=1, group_rank=1,
+            group_size=2).start()
+        router = ServingRouter([r0.endpoint, r1.endpoint],
+                               RouterConfig(group_size=2))
+        try:
+            ids = np.arange(6, dtype=np.int64).reshape(2, 3)
+            out = router.infer_sync({"ids": ids}, timeout=30)
+            assert out[0].shape == (2,)
+            # only the executor owns lookup state
+            assert "tiers" in r0.stats()
+            assert "tiers" not in r1.stats()
+        finally:
+            router.shutdown()
+            r0.shutdown()
+            r1.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_member_rank_answers_structured_error(self):
+        servers, eps, _tables = _shards(1)
+        r1 = SparseServingReplica("emb", eps, DIM, replica_id=3,
+                                  group_rank=1, group_size=2).start()
+        router = ServingRouter([r1.endpoint], RouterConfig())
+        try:
+            ids = np.arange(2, dtype=np.int64).reshape(1, 2)
+            with pytest.raises(InvalidRequest) as ei:
+                router.infer_sync({"ids": ids}, timeout=30)
+            assert ei.value.details["group_rank"] == 1
+        finally:
+            router.shutdown()
+            r1.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_missing_ids_input_is_invalid_request(self):
+        servers, eps, _tables = _shards(1)
+        rep = _replica(eps)
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            with pytest.raises(InvalidRequest):
+                router.infer_sync(
+                    {"x": np.zeros((1, 2), np.float32)}, timeout=30)
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# doctor: the stale_serving verdict
+# ---------------------------------------------------------------------------
+
+def _stale_event(row=42, lag=9, bound=2, **kw):
+    e = {"kind": "stale_row_served", "role": "serving", "seq": 10,
+         "table": "emb", "replica": 0, "rows": 3, "row": row,
+         "row_version": 17, "pull_watermark": 20,
+         "shard_watermark": 29, "lag": lag, "bound": bound}
+    e.update(kw)
+    return e
+
+
+class TestDoctorStaleServing:
+    def test_breach_diagnosed_with_coherence_arithmetic(self):
+        import doctor
+        rep = doctor.diagnose([
+            _stale_event(),
+            {"kind": "stale_repull", "role": "serving", "seq": 11,
+             "replica": 0, "rows": 5, "lag": 4},
+        ])
+        assert rep["top"] == "stale_serving"
+        d = rep["diagnoses"][0]
+        # the verdict cites the push seq and the pull watermark — the
+        # exact numbers the coherence contract is stated in
+        assert "version 17" in d["summary"]
+        assert "watermark 20" in d["summary"]
+        assert any(c.get("row_version") == 17
+                   and c.get("pull_watermark") == 20
+                   for c in d["evidence"])
+
+    def test_repulls_alone_are_the_gate_working_not_a_breach(self):
+        import doctor
+        rep = doctor.diagnose([
+            {"kind": "stale_repull", "role": "serving", "seq": 3,
+             "replica": 0, "rows": 5, "lag": 4},
+            {"kind": "stale_shed", "role": "serving", "seq": 4,
+             "replica": 0, "rows": 2, "lag": 9},
+        ])
+        assert all(d["name"] != "stale_serving"
+                   for d in rep["diagnoses"])
+
+    def test_breach_outranks_pserver_restart(self):
+        import doctor
+        assert doctor._BASE_SCORE["stale_serving"] > \
+            doctor._BASE_SCORE["pserver_restart"]
+
+
+# ---------------------------------------------------------------------------
+# bench_diff: both new rows' directions pinned
+# ---------------------------------------------------------------------------
+
+class TestBenchDiffDirections:
+    def _diff(self, metric, unit, v1, v2):
+        import bench_diff
+        rounds = [
+            {"round": 1, "path": "r1", "error": None,
+             "rows": {metric: {"metric": metric, "value": v1,
+                               "unit": unit}}},
+            {"round": 2, "path": "r2", "error": None,
+             "rows": {metric: {"metric": metric, "value": v2,
+                               "unit": unit}}},
+        ]
+        return bench_diff.diff(rounds)
+
+    def test_sparse_serving_qps_higher_is_better(self):
+        unit = "qps closed-loop Zipf serving while training pushes"
+        drop = self._diff("sparse_serving_qps", unit, 150.0, 60.0)
+        assert [f["flag"] for f in drop["flags"]] == ["REGRESSION"]
+        rise = self._diff("sparse_serving_qps", unit, 60.0, 150.0)
+        assert rise["flags"] == []
+
+    def test_fresh_weight_to_served_ms_lower_is_better(self):
+        unit = "ms push-commit to first served read (bound 0)"
+        rise = self._diff("fresh_weight_to_served_ms", unit, 5.0, 50.0)
+        assert [f["flag"] for f in rise["flags"]] == ["REGRESSION"]
+        drop = self._diff("fresh_weight_to_served_ms", unit, 50.0, 5.0)
+        assert drop["flags"] == []
+
+
+# ---------------------------------------------------------------------------
+# lock_lint gate: serving/sparse.py pinned in the scan set
+# ---------------------------------------------------------------------------
+
+class TestLockLintSparseServingGate:
+    def test_sparse_module_scanned_and_clean(self):
+        import lock_lint
+        assert "paddle_tpu/serving/sparse.py" in \
+            lock_lint.DEFAULT_PATHS
+        locks, funcs = lock_lint.scan(lock_lint.DEFAULT_PATHS)
+        assert any(fk.startswith("paddle_tpu.serving.sparse.")
+                   for fk in funcs), \
+            "serving/sparse.py fell out of the lock_lint scan set"
+        report = lock_lint.analyze(locks, funcs)
+        assert report["violations"] == [], report["violations"]
+
+
+# ---------------------------------------------------------------------------
+# load_gen: the ONE shared Zipf traffic generator
+# ---------------------------------------------------------------------------
+
+class TestSharedTrafficGenerator:
+    def test_bench_zipf_delegates_to_load_gen(self):
+        import bench
+        import load_gen
+        a = bench.zipf_ids(np.random.RandomState(3), 100, 50)
+        b = load_gen.zipf_ids(np.random.RandomState(3), 100, 50)
+        assert np.array_equal(a, b)
+
+    def test_zipf_skew_concentrates_head(self):
+        import load_gen
+        rng = np.random.RandomState(0)
+        ids = load_gen.zipf_ids(rng, 1000, 5000, skew=0.9)
+        assert ids.dtype == np.int64
+        assert (ids < 1000).all() and (ids >= 0).all()
+        # top-10% of ranks absorb well over a uniform share
+        assert (ids < 100).mean() > 0.4
+
+    def test_sparse_feed_maker_contract(self):
+        import load_gen
+        rng = np.random.RandomState(1)
+        mk = load_gen.sparse_feed_maker(rng, 500, 3, 2, 6)
+        feed, b = mk()
+        assert set(feed) == {"ids"}
+        assert feed["ids"].shape == (b, 3) and 2 <= b <= 6
+        assert feed["ids"].dtype == np.int64
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario (chaos: tier-1 seed; slow: the sweep)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestTrainAndServeScenario:
+    def test_sparse_serving_green_and_diagnosed(self):
+        """ISSUE 18 acceptance, seed 0: DeepFM-style trainer pushes a
+        live stream while the SAME tables serve Zipf traffic, the
+        ControlPlane scales serving 1->3->1, pserver shard 0 is
+        killed mid-push and restarted from its snapshots — no served
+        row beyond the bound, zero hung/unstructured futures, doctor
+        names the restart and explains every autoscale action."""
+        import chaos_run
+        res = chaos_run._scenario_sparse_serving(
+            argparse.Namespace(seed=0, steps=4))
+        assert res["ok"], {k: v for k, v in res.items()
+                           if k not in ("journal_kinds",)}
+        assert res["kill_fired"] and res["peak_replicas"] == 3
+        assert res["stale_served_rows"] == 0
+        assert res["max_lag_served"] <= res["staleness_bound"]
+        assert res["hung"] == [] and res["unstructured"] == []
+        doc = res["doctor"]
+        assert doc["match"] and doc["top"] == "pserver_restart"
+        rem = doc["remediation"]
+        assert rem["ok"] and rem["unexplained"] == []
+
+
+@pytest.mark.slow
+class TestTrainAndServeScenarioSweep:
+    @pytest.mark.parametrize("seed", [1, 2])
+    def test_seed_sweep(self, seed):
+        import chaos_run
+        res = chaos_run._scenario_sparse_serving(
+            argparse.Namespace(seed=seed, steps=4))
+        assert res["ok"], {k: v for k, v in res.items()
+                           if k not in ("journal_kinds",)}

@@ -49,7 +49,12 @@ __all__ = ["load_rounds", "diff", "format_report"]
 # qps_under_autoscale (name AND unit), and remediation_recovery is
 # lower-is-better by both its "recovery" name and "seconds" unit —
 # but both directions are pinned by tests/test_control.py. The
-# step-engine rows likewise ride the existing patterns:
+# sparse serving rows also need no new entries: sparse_serving_qps is
+# higher-is-better by "qps" (name AND unit) and
+# fresh_weight_to_served_ms lower-is-better by its "_ms" suffix (and
+# "ms ..." unit) — both directions pinned by
+# tests/test_sparse_serving.py. The step-engine rows likewise ride
+# the existing patterns:
 # composed_step_overhead is lower-is-better by its "overhead" name
 # (and "% step time" unit), pipelined_sparse_throughput is
 # higher-is-better by its "examples/sec" unit — both directions are

@@ -231,6 +231,7 @@ DOCTOR_EXPECT = {
     "restart_2x2_obs": ("pserver_restart",),
     "serving_kill": ("replica_failure",),
     "sparse_restart": ("pserver_restart",),
+    "sparse_serving": ("pserver_restart",),
     # three concurrent faults: the wedged batcher's stall verdict
     # outranks the rest; replica_failure is acceptable when eviction
     # evidence dominates an unlucky interleaving
@@ -730,6 +731,252 @@ def _scenario_sparse_restart(args):
         s.shutdown()
     cl.close()
     return verdict
+
+
+def _scenario_sparse_serving(args):
+    """The train-AND-serve acceptance scenario (docs/serving.md
+    §Sparse serving): a DeepFM-style trainer drives a live pull ->
+    q8-push stream into 2 snapshotting pserver shards while the SAME
+    tables serve Zipf-skewed traffic through SparseServingReplicas
+    behind the router, a ControlPlane autoscaling the serving fleet
+    1 -> 3 -> 1 on offered pressure, and pserver shard 0 hard-killed
+    mid-PUSH_SPARSE_Q8 then restarted on its port from the snapshot
+    dir. Green means: the kill fired and the shard came back; the
+    fleet actually reached 3 and settled back to 1; every client
+    future resolved (zero hung, zero unstructured); NO served row
+    exceeded ``max_staleness_steps`` on any replica that ever served
+    (the gate repulled instead — stale_served_rows == 0 everywhere);
+    the serving hot tiers dropped on the observed incarnation fence;
+    and doctor NAMES the restart with its remediation audit clean
+    (every autoscale action explained by its armed policy)."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import ControlPlane, ScalingPolicy
+    from paddle_tpu.resilience import RetryPolicy
+    from paddle_tpu.serving import (RouterConfig, ServingError,
+                                    SparseServingConfig,
+                                    SparseServingReplica,
+                                    ServingRouter)
+    import load_gen
+
+    DIM, VOCAB, SLOTS, BOUND = 16, 1024, 3, 8
+    workdir = tempfile.mkdtemp(prefix="chaos-sparse-serving-")
+    journal_path = os.path.join(workdir, "events.jsonl")
+    obs.configure_journal(journal_path)
+    rng = np.random.RandomState(args.seed)
+    perm = rng.permutation(VOCAB)
+    retry = RetryPolicy(max_retries=8, base_delay=0.02,
+                        max_delay=0.3, seed=args.seed)
+
+    router, base_reps, servers, trainer, stop_stack = \
+        load_gen.build_sparse_stack(
+            VOCAB, DIM, shards=2, staleness_bound=BOUND,
+            snapshot_dir=workdir, retry=retry)
+    eps = [s.endpoint for s in servers]
+    port0 = servers[0].serv.server.port
+
+    # -- restarter: shard 0 comes back on ITS port from ITS snapshots
+    restarted = []
+
+    def restarter():
+        from paddle_tpu.distributed import LargeScaleKV, SparsePServer
+        while not servers[0].serv.server._stop.is_set():
+            time.sleep(0.01)
+        t2 = {"emb": LargeScaleKV(dim=DIM, lr=0.5, seed=9)}
+        s2 = SparsePServer("127.0.0.1:%d" % port0, t2,
+                           snapshot_dir=os.path.join(workdir,
+                                                     "shard0"),
+                           snapshot_every=1)
+        s2.start()
+        restarted.append(s2)
+
+    threading.Thread(target=restarter, daemon=True).start()
+
+    # -- serving autoscale duck (the WHAT; ScalingPolicy owns WHEN) --
+    live = {0: base_reps[0]}
+    retired_stats = []
+    next_id = [1]
+    demand = [3.0]
+    peak = [1]
+    lock = threading.Lock()
+
+    class _ServeScaler:
+        def replica_count(self):
+            with lock:
+                return len(live)
+
+        def pressure(self):
+            with lock:
+                n = len(live)
+            return {"depth_per_replica": demand[0], "replicas": n,
+                    "healthy": n}
+
+        def scale_up(self):
+            k = next_id[0]
+            next_id[0] += 1
+            rep = SparseServingReplica(
+                "emb", eps, DIM, replica_id=k,
+                config=SparseServingConfig(
+                    max_staleness_steps=BOUND, retry=retry,
+                    device_rows=VOCAB // 4,
+                    cache_bytes=VOCAB * DIM * 2)).start()
+            rid = router.add_replica(rep.endpoint)
+            with lock:
+                live[rid] = rep
+                peak[0] = max(peak[0], len(live))
+            return {"ok": True, "op": "scale_up", "replica": rid}
+
+        def scale_down(self):
+            with lock:
+                spawned = [r for r in live if r != 0]
+                if not spawned:
+                    raise RuntimeError("base replica is not retirable")
+                rid = max(spawned)
+                rep = live.pop(rid)
+            router.remove_replica(rid)
+            retired_stats.append(rep.stats())
+            rep.shutdown()
+            return {"ok": True, "op": "scale_down", "replica": rid}
+
+    cp = ControlPlane(interval_s=0.1, max_actions_per_min=30)
+    cp.attach_scaler(_ServeScaler(), ScalingPolicy(
+        "sparse_serving_scale", up_depth=5.0, down_depth=1.0,
+        sustain_s=0.0, cooldown_s=0.3, min_replicas=1,
+        max_replicas=3, target="serving"))
+    cp.start()
+
+    # -- live load: trainer stream + Zipf request clients ------------
+    duration_s = max(8.0, 2.0 * args.steps)
+    stop = threading.Event()
+    lat_ms, structured, hung, unstructured = [], [], [], []
+    trainer_steps = [0]
+    trainer_err = []
+
+    def run_trainer():
+        trng = np.random.RandomState(args.seed + 7)
+        try:
+            while not stop.is_set():
+                ids = load_gen.zipf_ids(trng, VOCAB, 96, perm=perm)
+                trainer.pull(ids)
+                trainer.push(ids, (trng.randn(96, DIM) * 0.05)
+                             .astype(np.float32))
+                trainer_steps[0] += 1
+                time.sleep(0.005)
+        except Exception as e:
+            trainer_err.append(repr(e))
+
+    seeds = [200]
+
+    def client():
+        with lock:
+            seeds[0] += 1
+            crng = np.random.RandomState(seeds[0])
+        while not stop.is_set():
+            b = int(crng.randint(1, 5))
+            feed = {"ids": load_gen.zipf_ids(
+                crng, VOCAB, b * SLOTS, perm=perm).reshape(b, SLOTS)}
+            t0 = time.monotonic()
+            try:
+                router.infer_sync(feed, timeout=30)
+                with lock:
+                    lat_ms.append((time.monotonic() - t0) * 1e3)
+            except ServingError as e:
+                with lock:
+                    structured.append(e.code)
+            except Exception as e:
+                name = type(e).__name__
+                with lock:
+                    (hung if "Timeout" in name
+                     else unstructured).append(repr(e))
+
+    def wait_for(fn, timeout, what):
+        deadline = time.monotonic() + timeout
+        while not fn():
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)
+        return True
+
+    t_start = time.monotonic()
+    ths = [threading.Thread(target=client) for _ in range(6)]
+    for th in ths:
+        th.start()
+    tr = threading.Thread(target=run_trainer)
+    tr.start()
+
+    time.sleep(duration_s * 0.15)
+    demand[0] = 10.0                   # pressure spike: grow to 3
+    grew = wait_for(lambda: len(live) == 3, 60.0, "scale_up")
+    time.sleep(duration_s * 0.15)
+    # kill shard 0 mid-push while the fleet is at 3 and serving
+    servers[0].serv.crash_after("PUSH_SPARSE_Q8", 1)
+    came_back = wait_for(lambda: bool(restarted), 60.0, "restart")
+    time.sleep(duration_s * 0.2)
+    demand[0] = 0.0                    # pressure gone: shrink to 1
+    shrank = wait_for(lambda: len(live) == 1, 60.0, "scale_down")
+    demand[0] = 3.0                    # back inside the band
+    time.sleep(max(0.0, duration_s - (time.monotonic() - t_start)))
+    stop.set()
+    for th in ths:
+        th.join(timeout=60)
+    tr.join(timeout=60)
+    elapsed = time.monotonic() - t_start
+
+    ledger = cp.ledger()
+    cp.stop()
+    rep_stats = [r.stats() for r in live.values()] + retired_stats
+    try:
+        stop_stack()
+    finally:
+        for s2 in restarted:
+            s2.shutdown()
+        obs.configure_journal(None)
+
+    events = obs.read_journal(journal_path)
+    kinds = {e["kind"] for e in events}
+    stale_served = sum(s["staleness"]["stale_served_rows"]
+                       for s in rep_stats)
+    worst_lag = max(s["staleness"]["max_lag_served"]
+                    for s in rep_stats)
+    fired = [r for r in ledger if r["decision"] == "fired"]
+    ups = [r for r in fired if r["action"].endswith("scale_up")]
+    downs = [r for r in fired if r["action"].endswith("scale_down")]
+    ok = (grew and came_back and shrank and peak[0] == 3
+          and len(live) == 1 and bool(lat_ms)
+          and not hung and not unstructured
+          and not trainer_err and trainer_steps[0] > 10
+          and stale_served == 0 and worst_lag <= BOUND
+          and "snapshot" in kinds
+          and "sparse_device_tier_invalidated" in kinds
+          and len(ups) >= 2 and len(downs) >= 2
+          and elapsed < 150.0)
+    return {"ok": ok, "elapsed_s": round(elapsed, 2),
+            "doctor": _doctor_verdict("sparse_serving",
+                                      journal_path=journal_path),
+            "completed": len(lat_ms),
+            "qps": round(len(lat_ms) / elapsed, 1),
+            "p99_ms": round(float(np.percentile(
+                np.asarray(lat_ms), 99)), 2) if lat_ms else None,
+            "trainer_steps": trainer_steps[0],
+            "trainer_errors": trainer_err[:3],
+            "structured_errors": sorted(set(structured)),
+            "structured_error_count": len(structured),
+            "hung": hung[:3], "unstructured": unstructured[:3],
+            "kill_fired": came_back, "scaled": [grew, shrank],
+            "peak_replicas": peak[0],
+            "stale_served_rows": stale_served,
+            "max_lag_served": worst_lag, "staleness_bound": BOUND,
+            "repulled_rows": sum(s["staleness"]["repulled_rows"]
+                                 for s in rep_stats),
+            "scale_actions": {"up": len(ups), "down": len(downs)},
+            "journal_kinds": sorted(
+                k for k in kinds
+                if k.startswith(("sparse_", "stale_", "control_",
+                                 "snapshot", "rpc_")))}
 
 
 def _scenario_serving_kill(args):
@@ -1626,6 +1873,7 @@ DIST_SCENARIOS = {
     "restart_2x2_obs": _scenario_restart_2x2_obs,
     "serving_kill": _scenario_serving_kill,
     "sparse_restart": _scenario_sparse_restart,
+    "sparse_serving": _scenario_sparse_serving,
     "control_loop": _scenario_control_loop,
     "elastic_2_3_2": _scenario_elastic_2_3_2,
 }

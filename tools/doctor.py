@@ -57,6 +57,7 @@ _BASE_SCORE = {
     "program_invariant": 95.0,
     "batcher_death": 92.0,
     "trainer_eviction": 88.0,
+    "stale_serving": 90.0,
     "replica_failure": 86.0,
     "pserver_restart": 84.0,
     "elastic_membership": 75.0,
@@ -195,6 +196,47 @@ def _detect_replica_failure(kinds):
                   + [_cite(e, "replica", "attempt")
                      for e in retries[:8]]
                   + [_cite(e, "replica") for e in readmits])]
+
+
+def _detect_stale_serving(kinds):
+    """Bounded-staleness breach on the sparse serving plane: a replica
+    served embedding rows that may have missed more pushes than its
+    ``max_staleness_steps`` bound allows (enforce=False observe-only
+    mode, docs/serving.md §Sparse serving). Each ``stale_row_served``
+    event carries the exact coherence arithmetic — the row's last-push
+    version (the push seq on the authority's clock), the watermark the
+    replica pulled it at, and the shard's current watermark — so the
+    verdict cites WHICH copy was stale and by how many pushes. Sheds
+    and repulls are the gate WORKING and are not breaches; they only
+    ride along as context when a breach exists."""
+    evs = kinds.get("stale_row_served", [])
+    if not evs:
+        return []
+    sheds = kinds.get("stale_shed", [])
+    repulls = kinds.get("stale_repull", [])
+    worst = max(evs, key=lambda e: e.get("lag") or 0)
+    reps = sorted({e.get("replica") for e in evs})
+    n_rows = sum(int(e.get("rows") or 0) for e in evs)
+    summary = ("sparse serving replica %s served %d row(s) beyond the "
+               "staleness bound %s: worst row %s at push version %s "
+               "was pulled at shard watermark %s but the shard is now "
+               "at %s (lag %s pushes); gate also repulled %d and shed "
+               "%d request(s) — raise the bound, speed up re-pulls, "
+               "or shed during authority outages"
+               % (",".join(str(r) for r in reps), n_rows,
+                  worst.get("bound"), worst.get("row"),
+                  worst.get("row_version"), worst.get("pull_watermark"),
+                  worst.get("shard_watermark"), worst.get("lag"),
+                  sum(int(e.get("rows") or 0) for e in repulls),
+                  len(sheds)))
+    return [_diag("stale_serving", summary,
+                  [_cite(e, "replica", "table", "row", "row_version",
+                         "pull_watermark", "shard_watermark", "lag",
+                         "bound", "rows") for e in evs[:12]]
+                  + [_cite(e, "replica", "rows", "lag")
+                     for e in repulls[:4]]
+                  + [_cite(e, "replica", "rows", "lag")
+                     for e in sheds[:4]])]
 
 
 def _detect_batcher_death(kinds):
@@ -611,6 +653,7 @@ def diagnose(events: List[dict], blackboxes: List[dict] = (),
     diagnoses += _detect_batcher_death(kinds)
     diagnoses += _detect_trainer_eviction(kinds)
     diagnoses += _detect_replica_failure(kinds)
+    diagnoses += _detect_stale_serving(kinds)
     diagnoses += _detect_pserver_restart(kinds)
     diagnoses += _detect_recompile_storm(kinds)
     diagnoses += _detect_program_invariant(kinds)

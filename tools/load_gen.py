@@ -53,6 +53,94 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np  # noqa: E402
 
 
+def zipf_ids(rng, vocab, size, skew=0.9, perm=None):
+    """Bounded Zipf key stream: P(rank r) ∝ r^-skew over ``vocab``
+    ids, rank->id scrambled by ``perm`` so hot keys scatter across
+    hash shards (a real CTR id space has no rank order). CANONICAL
+    implementation — bench.py's sparse rows, the train-and-serve chaos
+    scenario, and ``--sparse-table`` below all draw their traffic from
+    this one function, so their skew profiles are comparable by
+    construction."""
+    p = np.arange(1, vocab + 1, dtype=np.float64) ** -float(skew)
+    p /= p.sum()
+    ranks = rng.choice(vocab, size=size, p=p)
+    return (perm[ranks] if perm is not None else ranks) \
+        .astype(np.int64)
+
+
+def sparse_feed_maker(rng, vocab, slots, batch_min, batch_max,
+                      skew=0.9, perm=None):
+    """Feed maker for the sparse serving plane: each call returns
+    ``({"ids": int64 [b, slots]}, b)`` with ids drawn from the shared
+    Zipf stream — the sparse analog of ``_feed_maker`` (same
+    ``(feed, n)`` contract, so ``run_open_loop``/``run_closed_loop``/
+    ``run_ramp`` drive it unchanged)."""
+    def make_feed():
+        b = int(rng.randint(batch_min, batch_max + 1))
+        ids = zipf_ids(rng, vocab, b * slots, skew=skew,
+                       perm=perm).reshape(b, slots)
+        return {"ids": ids}, b
+    return make_feed
+
+
+def build_sparse_stack(vocab, dim, shards=2, lr=0.5, seed=9,
+                       staleness_bound=8, staleness_action="repull",
+                       device_rows=None, cache_bytes=None,
+                       snapshot_dir=None, replica_kw=None,
+                       retry=None):
+    """One in-process train-AND-serve sparse stack: ``shards``
+    SparsePServers hosting one LargeScaleKV table, a
+    SparseServingReplica over them, and a ServingRouter in front —
+    plus a trainer-side LookupServiceClient pushing into the SAME
+    tables. Returns ``(router, replicas, servers, trainer_client,
+    stop)``; the chaos scenario and ``--sparse-table`` both build
+    their worlds through this so they cannot drift apart."""
+    from paddle_tpu.distributed import (LargeScaleKV,
+                                        LookupServiceClient,
+                                        SparsePServer)
+    from paddle_tpu.serving import (RouterConfig, SparseServingConfig,
+                                    SparseServingReplica,
+                                    ServingRouter)
+
+    servers = []
+    for i in range(shards):
+        tables = {"emb": LargeScaleKV(dim=dim, lr=lr, seed=seed)}
+        kw = {}
+        if snapshot_dir is not None:
+            kw = {"snapshot_dir": os.path.join(snapshot_dir,
+                                               "shard%d" % i),
+                  "snapshot_every": 1}
+        servers.append(SparsePServer("127.0.0.1:0", tables,
+                                     **kw).start())
+    eps = [s.endpoint for s in servers]
+    cfg = SparseServingConfig(
+        max_staleness_steps=staleness_bound,
+        staleness_action=staleness_action, retry=retry,
+        device_rows=device_rows
+        if device_rows is not None else max(64, vocab // 4),
+        cache_bytes=cache_bytes
+        if cache_bytes is not None else vocab * dim * 4 // 2)
+    rep = SparseServingReplica("emb", eps, dim, config=cfg,
+                               **(replica_kw or {})).start()
+    router = ServingRouter([rep.endpoint], RouterConfig(
+        lease_timeout_s=2.0, heartbeat_interval_s=0.2,
+        rpc_deadline_s=5.0, connect_timeout_s=5.0, max_retries=5))
+    trainer = LookupServiceClient("emb", eps, dim=dim, trainer_id=0,
+                                  push_q8=True, retry=retry,
+                                  write_policy="none")
+
+    def stop():
+        try:
+            router.shutdown()
+        finally:
+            rep.shutdown()
+            trainer.close()
+            for s in servers:
+                s.shutdown()
+
+    return router, [rep], servers, trainer, stop
+
+
 def build_synthetic_model(dirname, hidden=32, seed=3):
     """Train-free 64->hidden->8 softmax MLP saved as an inference
     model — enough to exercise batching/bucketing without a real
@@ -630,6 +718,80 @@ def run_ramp(engine, make_feed, concurrencies, step_duration_s,
             "client_lat_ms": all_lat}
 
 
+def _sparse_table_main(args):
+    """``--sparse-table``: Zipf traffic against the train-and-serve
+    sparse stack; same open/closed/ramp protocols, one JSON report
+    with per-tier hit accounting and the staleness gate's counters."""
+    rng = np.random.RandomState(args.seed)
+    perm = rng.permutation(args.vocab)
+    router, reps, _servers, trainer, stop_stack = build_sparse_stack(
+        args.vocab, args.dim, shards=args.shards,
+        staleness_bound=args.staleness_bound)
+    make_feed = sparse_feed_maker(rng, args.vocab, args.slots,
+                                  args.batch_min, args.batch_max,
+                                  skew=args.skew, perm=perm)
+    push_stop = threading.Event()
+    pushes = [0]
+
+    def pusher():
+        trng = np.random.RandomState(args.seed + 1)
+        while not push_stop.is_set():
+            ids = zipf_ids(trng, args.vocab, 64, skew=args.skew,
+                           perm=perm)
+            trainer.push(ids, (trng.randn(len(ids), args.dim)
+                               * 0.01).astype(np.float32))
+            pushes[0] += 1
+            push_stop.wait(args.train_push_every)
+
+    pt = None
+    if args.train_push_every > 0:
+        pt = threading.Thread(target=pusher, daemon=True)
+        pt.start()
+    t0 = time.monotonic()
+    try:
+        if args.mode == "open":
+            client = run_open_loop(router, make_feed, args.qps,
+                                   args.duration, args.deadline_ms)
+        elif args.mode == "ramp":
+            levels = [int(c) for c in args.ramp.split(",")
+                      if c.strip()]
+            client = run_ramp(router, make_feed, levels,
+                              args.step_duration, args.deadline_ms)
+        else:
+            client = run_closed_loop(router, make_feed,
+                                     args.concurrency, args.duration,
+                                     args.deadline_ms)
+        wall = time.monotonic() - t0
+        push_stop.set()
+        if pt is not None:
+            pt.join(timeout=10)
+        stats = reps[0].stats()
+    finally:
+        push_stop.set()
+        stop_stack()
+
+    lat = np.asarray(client.pop("client_lat_ms"))
+    report = {
+        "metric": "sparse_load_gen", "mode": args.mode,
+        "vocab": args.vocab, "slots": args.slots, "dim": args.dim,
+        "skew": args.skew, "shards": args.shards,
+        "duration_s": round(wall, 2),
+        "completed": int(lat.size),
+        "achieved_qps": round(lat.size / wall, 2) if wall > 0
+        else None,
+        "p50_ms": round(float(np.percentile(lat, 50)), 3)
+        if lat.size else None,
+        "p99_ms": round(float(np.percentile(lat, 99)), 3)
+        if lat.size else None,
+        "trainer_pushes": pushes[0],
+        "tiers": stats.get("tiers"),
+        "staleness": stats.get("staleness"),
+    }
+    report.update(client)
+    print(json.dumps(report), flush=True)
+    return 1 if client.get("client_failed") else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-dir", default=None)
@@ -674,8 +836,32 @@ def main(argv=None):
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sparse-table", action="store_true",
+                    help="drive the sparse serving plane instead of a "
+                    "dense model: Zipf id-stream traffic against a "
+                    "SparseServingReplica over in-process pserver "
+                    "shards (docs/serving.md §Sparse serving), with "
+                    "an optional concurrent trainer pushing into the "
+                    "SAME tables (--train-push-every)")
+    ap.add_argument("--vocab", type=int, default=4096,
+                    help="sparse id space (with --sparse-table)")
+    ap.add_argument("--slots", type=int, default=3,
+                    help="ids per example (with --sparse-table)")
+    ap.add_argument("--dim", type=int, default=16,
+                    help="embedding dim (with --sparse-table)")
+    ap.add_argument("--skew", type=float, default=0.9,
+                    help="Zipf skew of the id stream")
+    ap.add_argument("--shards", type=int, default=2,
+                    help="pserver shard count (with --sparse-table)")
+    ap.add_argument("--staleness-bound", type=int, default=8,
+                    help="replica max_staleness_steps")
+    ap.add_argument("--train-push-every", type=float, default=0.0,
+                    help="seconds between concurrent trainer pushes "
+                    "into the served tables (0 = serve-only)")
     args = ap.parse_args(argv)
 
+    if args.sparse_table:
+        return _sparse_table_main(args)
     if not args.model_dir and not args.synthetic:
         ap.error("pass --model-dir or --synthetic")
 

@@ -56,6 +56,13 @@ DEFAULT_PATHS = (
     # pinned EXPLICITLY: a future split of distributed/ into
     # subpackages cannot silently drop it from the scan
     "paddle_tpu/distributed/reshard.py",
+    # sparse.py rides paddle_tpu/serving above, but its per-request
+    # tier pipeline holds the cache mutex on the serving HOT PATH
+    # (journal emits are collected under the lock and flushed after
+    # release — docs/serving.md §Sparse serving), so it is pinned
+    # EXPLICITLY for the same reason as reshard.py: no future package
+    # split may silently drop it from the scan
+    "paddle_tpu/serving/sparse.py",
     "paddle_tpu/engine",
 )
 
