@@ -737,7 +737,8 @@ class LookupServiceClient:
                  mirror_lr: Optional[float] = None,
                  max_residual_rows: Optional[int] = None,
                  topology: Optional[Callable[[], List[str]]] = None,
-                 stamped: bool = False):
+                 stamped: bool = False,
+                 max_stamp_rows: Optional[int] = None):
         self.table = table_name
         self.dim = dim
         self.trainer_id = trainer_id
@@ -792,11 +793,24 @@ class LookupServiceClient:
         # PREFETCH_STAMPED and record, per pulled row, (last-push
         # version, shard watermark at pull time) plus each shard's
         # last observed watermark. The consumer (SparseServingReplica)
-        # serializes access, so plain dicts suffice; both maps drop
-        # with the hot tier on an incarnation fence or reshard — a
-        # restarted/resharded authority's watermark is a NEW clock.
+        # serializes access, so unsynchronized dicts suffice; both
+        # maps drop with the hot tier on an incarnation fence or
+        # reshard — a restarted/resharded authority's watermark is a
+        # NEW clock. ``row_stamps`` is least-recently-PULLED ordered
+        # and capped at ``max_stamp_rows`` (default: 8x the hot
+        # tier's row capacity, floor 65536) — the serving table is
+        # bigger than any host, so the stamp map must not outgrow the
+        # tiers it describes. A trimmed row's host-cache copy drops
+        # WITH its stamp, keeping the invariant "host-cached =>
+        # stamped"; staleness() reports trimmed rows as -1 (fetch
+        # before serving), so they re-pull and re-stamp on next touch.
         self.stamped = bool(stamped)
-        self.row_stamps: Dict[int, Tuple[int, int]] = {}
+        self.row_stamps: "OrderedDict[int, Tuple[int, int]]" = \
+            OrderedDict()
+        cache_cap = self.cache.capacity_rows if self.cache else 0
+        self.max_stamp_rows = int(max_stamp_rows) \
+            if max_stamp_rows is not None else max(65536, 8 * cache_cap)
+        self.stamps_trimmed = 0
         self.shard_watermarks: Dict[str, int] = {}
 
     def _next_seq(self, shard):
@@ -973,23 +987,83 @@ class LookupServiceClient:
                        % (self.table, _RESHARD_RETRIES, fence))
 
     # -- bounded-staleness stamps (the serving read path) -------------------
+    def _note_watermark(self, endpoint: str, wm: int):
+        """Record one shard-watermark observation. A watermark that
+        moved BACKWARDS means the shard restarted from older state
+        (its stamp clock reset): every recorded stamp compares against
+        a clock that no longer exists, so all of them — and the hot
+        tier they vouch for — drop, instead of pre-restart rows
+        masquerading as lag-0 fresh."""
+        prev = self.shard_watermarks.get(endpoint)
+        if prev is not None and wm < prev:
+            self.invalidation_count += 1
+            dropped = self.cache.invalidate_all() if self.cache else 0
+            self.row_stamps.clear()
+            self.shard_watermarks.clear()
+            _obs.emit("sparse_watermark_regressed", table=self.table,
+                      endpoint=endpoint, old_watermark=prev,
+                      new_watermark=wm, rows_dropped=dropped,
+                      tid=self.trainer_id)
+        self.shard_watermarks[endpoint] = wm
+
     def _record_stamps(self, endpoint, ids, versions, watermark):
-        self.shard_watermarks[endpoint] = int(watermark)
         wm = int(watermark)
+        self._note_watermark(endpoint, wm)
         for j, rid in enumerate(np.asarray(ids, np.int64)):
-            self.row_stamps[int(rid)] = (int(versions[j]), wm)
+            rid = int(rid)
+            self.row_stamps[rid] = (int(versions[j]), wm)
+            self.row_stamps.move_to_end(rid)
+        # trimming runs at the END of pull(), after the cache fill —
+        # trimming here would let put_many re-admit a row whose stamp
+        # was just dropped (host-cached but ungated)
+
+    def _trim_stamps(self):
+        """Keep ``row_stamps`` under ``max_stamp_rows`` by dropping
+        the least-recently-pulled stamps. Each trimmed row's
+        host-cache copy drops with it ("host-cached => stamped" — a
+        resident row without a stamp would serve ungated), so the
+        row's next touch is an authority pull that re-stamps it; the
+        device tier's copy is the replica's to drop (its gate treats
+        a missing stamp as fetch-before-serve)."""
+        n = len(self.row_stamps) - self.max_stamp_rows
+        if n <= 0:
+            return
+        dropped = [self.row_stamps.popitem(last=False)[0]
+                   for _ in range(n)]
+        self.stamps_trimmed += n
+        if self.cache is not None:
+            self.cache.invalidate_ids(np.asarray(dropped, np.int64))
 
     def watermarks(self, refresh: bool = False) -> Dict[str, int]:
         """Per-shard push watermark as last OBSERVED (every stamped
         pull piggybacks its shard's). ``refresh`` polls every shard
         with an empty stamped prefetch — the staleness gate amortizes
-        this across ``watermark_poll_every`` requests."""
+        this across ``watermark_poll_every`` requests. The poll rides
+        the SAME fence machinery as pull: a reconnect re-reads
+        incarnation nonces (restart => stamps and caches drop, then
+        one re-poll against the restored clock) and a RESHARDED
+        answer re-resolves the topology — so the gate never bounds
+        staleness against a dead authority's clock."""
         enforce(self.stamped, "watermarks() needs stamped=True")
         if refresh or not self.shard_watermarks:
             empty = np.zeros(0, np.int64)
-            for client in self.clients:
-                _, _, wm = client.prefetch_stamped(self.table, empty)
-                self.shard_watermarks[client.endpoint] = wm
+            for _attempt in (0, 1):
+                before = self._reconnects()
+                try:
+                    for client in self.clients:
+                        _, _, wm = client.prefetch_stamped(self.table,
+                                                           empty)
+                        self._note_watermark(client.endpoint, int(wm))
+                except ShardMapChanged as e:
+                    self._refresh_topology(e)  # raises w/o topology
+                    continue
+                if not self._maybe_fence(before):
+                    break
+                # a restart was fenced mid-poll: stamps + watermarks
+                # just dropped — attempt 1 re-reads the restored
+                # clock. A second fence (flapping server) leaves the
+                # maps empty: staleness() then reports every row
+                # unknown, which the gate treats as fetch-before-serve
         return dict(self.shard_watermarks)
 
     def staleness(self, ids) -> np.ndarray:
@@ -1009,7 +1083,13 @@ class LookupServiceClient:
                 self.clients[int(shard[j])].endpoint)
             if wm_now is None:
                 continue
-            out[j] = max(0, wm_now - stamp[1])
+            lag = wm_now - stamp[1]
+            # negative lag cannot survive the fences (a backwards
+            # watermark drops every stamp in _note_watermark) — if it
+            # somehow appears, the stamp's clock is not this shard's
+            # clock: report unknown (fetch before serving), never
+            # clamp to "fresh"
+            out[j] = lag if lag >= 0 else -1
         return out
 
     def refresh_rows(self, ids) -> np.ndarray:
@@ -1039,6 +1119,7 @@ class LookupServiceClient:
             rows = self._rpc_pull(uniq)
             if self._maybe_fence(before):
                 rows = self._rpc_pull(uniq)
+            self._trim_stamps()
             return rows[inv].astype(np.float32)
         for attempt in (0, 1):
             rows, hit = self.cache.get_many(uniq)
@@ -1051,8 +1132,15 @@ class LookupServiceClient:
             miss = ~hit
             if miss.any():
                 before = self._reconnects()
+                inv0 = self.invalidation_count
                 fetched = self._rpc_pull(uniq[miss])
-                fenced = self._maybe_fence(before)
+                # an invalidation the RPC round itself observed — a
+                # regressed watermark (_note_watermark) or a shard-map
+                # fence (_refresh_topology) — dropped the hot tier the
+                # same way a reconnect fence does: the cached half of
+                # THIS lookup is suspect either way
+                fenced = self._maybe_fence(before) or \
+                    self.invalidation_count != inv0
                 if fenced and attempt == 0:
                     # hot tier just dropped: the cached half of THIS
                     # lookup may be stale — redo the whole pull
@@ -1066,6 +1154,7 @@ class LookupServiceClient:
                 # every row came from a live authority read (the
                 # cache was cold), only the cache fill is skipped
             self.cache_hit_rows += hits_now
+            self._trim_stamps()
             return rows[inv].astype(np.float32)
         # unreachable: attempt 1 always returns (only attempt 0 may
         # ``continue`` on a fence)
@@ -1219,6 +1308,7 @@ class LookupServiceClient:
             out["cache"] = self.cache.stats()
         if self.stamped:
             out["stamped_rows"] = len(self.row_stamps)
+            out["stamps_trimmed"] = self.stamps_trimmed
             out["shard_watermarks"] = dict(self.shard_watermarks)
         return out
 

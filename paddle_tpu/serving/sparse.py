@@ -144,6 +144,7 @@ class _DeviceRowTier:
         self.fills = 0
         self.evictions = 0
         self.invalidated_rows = 0
+        self.overflow_rows = 0
 
     def lookup(self, uniq: np.ndarray) -> np.ndarray:
         """-> per-id slot (int32), -1 = miss. Touches CLOCK bits."""
@@ -159,16 +160,26 @@ class _DeviceRowTier:
             self.misses += len(uniq) - n_hit
             return out
 
-    def _alloc_locked(self) -> int:
+    def _alloc_locked(self, pinned) -> int:
+        """One free or evictable slot, or -1 when every candidate is
+        in ``pinned`` — slots the CURRENT request's gather depends on
+        (its hits plus ids placed earlier in the same fill). Without
+        the pin set, a fill larger than free capacity would CLOCK its
+        way back onto its own slots and map two ids to one row."""
         if self._free:
             return self._free.pop()
-        while True:
+        if len(pinned) >= self.capacity:
+            return -1
+        for _ in range(2 * self.capacity):
             s = self._hand
             self._hand = (self._hand + 1) % self.capacity
+            if s in pinned:
+                continue
             if self._ref[s]:
                 self._ref[s] = 0
             else:
                 return s
+        return -1
 
     @staticmethod
     def _pow2(n: int) -> int:
@@ -178,15 +189,27 @@ class _DeviceRowTier:
         id-set size."""
         return 1 << max(0, int(n) - 1).bit_length()
 
-    def fill(self, ids, rows) -> np.ndarray:
-        """Install host ``rows`` for ``ids``; returns their slots."""
+    def fill(self, ids, rows, pinned=()) -> np.ndarray:
+        """Install host ``rows`` for ``ids``; returns their slots,
+        ``-1`` for ids the tier could NOT place (one request's unique
+        ids exceed capacity) — the caller serves those from the host
+        rows it already holds. ``pinned``: slots the current request's
+        gather already depends on (its hit slots); they are never
+        evicted, so a full tier can't remap an id out from under the
+        request that is about to read it."""
         ids = [int(i) for i in np.asarray(ids, np.int64)]
+        pin = {int(s) for s in np.asarray(pinned, np.int64).reshape(-1)
+               if s >= 0}
         with self._mu:
             slots = []
             for rid in ids:
                 s = self._slot_of.get(rid)
                 if s is None:
-                    s = self._alloc_locked()
+                    s = self._alloc_locked(pin)
+                    if s < 0:
+                        self.overflow_rows += 1
+                        slots.append(-1)
+                        continue
                     old = self._rid_of[s]
                     if old is not None:
                         del self._slot_of[old]
@@ -194,21 +217,26 @@ class _DeviceRowTier:
                     self._slot_of[rid] = s
                     self._rid_of[s] = rid
                 self._ref[s] = 1
+                pin.add(s)
                 slots.append(s)
-            self.fills += len(ids)
+                self.fills += 1
         slots = np.asarray(slots, np.int32)
-        rows = np.asarray(rows, np.float32)
+        placed = slots >= 0
+        if not placed.any():
+            return slots
+        slots_p = slots[placed]
+        rows_p = np.asarray(rows, np.float32)[placed]
         # bucket-pad by REPEATING the last (slot, row) pair: writing
         # one slot twice with the same row is idempotent, and the
         # padded scatter shape comes from a pow-2 menu
-        pad = self._pow2(len(slots)) - len(slots)
+        pad = self._pow2(len(slots_p)) - len(slots_p)
         if pad:
-            slots_w = np.concatenate([slots,
-                                      np.repeat(slots[-1:], pad)])
-            rows_w = np.concatenate([rows,
-                                     np.repeat(rows[-1:], pad, 0)])
+            slots_w = np.concatenate([slots_p,
+                                      np.repeat(slots_p[-1:], pad)])
+            rows_w = np.concatenate([rows_p,
+                                     np.repeat(rows_p[-1:], pad, 0)])
         else:
-            slots_w, rows_w = slots, rows
+            slots_w, rows_w = slots_p, rows_p
         self._slots = self._slots.at[slots_w].set(
             self._jnp.asarray(rows_w))
         return slots
@@ -257,7 +285,8 @@ class _DeviceRowTier:
                     "hit_rate": self.hits / (self.hits + self.misses)
                     if (self.hits + self.misses) else 0.0,
                     "fills": self.fills, "evictions": self.evictions,
-                    "invalidated_rows": self.invalidated_rows}
+                    "invalidated_rows": self.invalidated_rows,
+                    "overflow_rows": self.overflow_rows}
 
 
 class SparseServingReplica:
@@ -308,6 +337,7 @@ class SparseServingReplica:
         # per-tier accounting (requested-row basis, like the client's)
         self.host_hit_rows = 0
         self.remote_rows = 0
+        self.device_overflow_rows = 0
         self.repulled_rows = 0
         self.shed_requests = 0
         self.stale_served_rows = 0
@@ -374,6 +404,13 @@ class SparseServingReplica:
                 or not cl.shard_watermarks):
             cl.watermarks(refresh=True)
         lag = cl.staleness(uniq)
+        unknown = lag < 0
+        if unknown.any():
+            # no stamp = "fetch before serving" (never pulled, stamp
+            # trimmed under the cap, or dropped by a fence): any
+            # device-resident copy predates stamp knowledge — drop it
+            # so the miss path below re-pulls from authority
+            self.device_tier.invalidate_ids(uniq[unknown])
         over = lag > cfg.max_staleness_steps
         # the served-lag audit is measured against THIS gate's
         # watermark snapshot — the bound is relative to the coherence
@@ -455,14 +492,33 @@ class SparseServingReplica:
                 return None, events, exc
             slots = tier.lookup(uniq)
             miss = slots < 0
+            rows_miss = None
             if miss.any():
                 hits0 = cl.cache_hit_rows
                 rows_miss = cl.pull(uniq[miss])
                 host_hits = cl.cache_hit_rows - hits0
                 self.host_hit_rows += host_hits
                 self.remote_rows += int(miss.sum()) - host_hits
-                slots[miss] = tier.fill(uniq[miss], rows_miss)
-            emb_uniq = tier.gather(slots)
+                # the request's hit slots are PINNED: a fill bigger
+                # than free capacity must spill, never remap a slot
+                # this gather is about to read
+                slots[miss] = tier.fill(uniq[miss], rows_miss,
+                                        pinned=slots[~miss])
+            ovf = slots < 0
+            emb_uniq = tier.gather(np.where(ovf, 0, slots))
+            if ovf.any():
+                # overflow: more unique ids than the tier could place
+                # for ONE request — those ids bypass the device tier
+                # and serve the authority rows already pulled above
+                # (-1 slots only ever come from this fill's misses);
+                # gather hands back a read-only device view, so copy
+                emb_uniq = np.array(emb_uniq)
+                emb_uniq[ovf] = rows_miss[ovf[miss]]
+                self.device_overflow_rows += int(ovf.sum())
+                events.append(("sparse_device_tier_overflow", dict(
+                    table=self.table, replica=self.replica_id,
+                    rows=int(ovf.sum()),
+                    capacity_rows=tier.capacity)))
         pooled = emb_uniq[inv].reshape(b, s, self.dim).sum(axis=1)
         scores = pooled @ self._head
         return ([np.asarray(scores, np.float32),
@@ -557,6 +613,7 @@ class SparseServingReplica:
                 "device": self.device_tier.stats(),
                 "host_hit_rows": self.host_hit_rows,
                 "remote_rows": self.remote_rows,
+                "device_overflow_rows": self.device_overflow_rows,
                 "client": self.client.stats()}
         return out
 

@@ -145,6 +145,10 @@ class TestBook:
             ["firstw", "secondw", "thirdw", "fourthw"], 120,
             tmp_path, lr=5e-3)
 
+    # tier-1 headroom (PR 18): recommender chapter (~7 s) -> slow;
+    # recommender wiring stays via
+    # test_datasets.py::TestModelWiring::test_recommender_on_movielens
+    @pytest.mark.slow
     def test_recommender_system(self, tmp_path):
         """test_recommender_system.py: two-tower embedding fusion."""
         from paddle_tpu.models import recommender as R
@@ -284,6 +288,9 @@ class TestBook:
         _train_save_reload(build, feeder, ["img", "ilen"], 150,
                            tmp_path, lr=0.02, loss_ratio=0.5)
 
+    # tier-1 headroom (PR 18): seq2seq-attention chapter (~10 s) -> slow;
+    # seq2seq coverage stays via test_machine_translation
+    @pytest.mark.slow
     def test_rnn_encoder_decoder(self, tmp_path):
         """test_rnn_encoder_decoder.py — the pre-attention seq2seq
         chapter: bi-LSTM encoder (forward-last + backward-first

@@ -144,6 +144,11 @@ class TestDownloadProtocol:
 
 
 class TestModelWiring:
+    # tier-1 headroom (PR 18): wmt14 training wiring (~8 s) -> slow;
+    # the wmt14 contract stays via
+    # TestContracts::test_wmt14_shapes_and_determinism and seq2seq via
+    # test_book.py::TestBook::test_machine_translation
+    @pytest.mark.slow
     def test_machine_translation_on_wmt14(self):
         """The flagship NMT model trains on wmt14 reader batches
         (pad + mask built from the raw samples — the book test path

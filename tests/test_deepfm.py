@@ -2,6 +2,7 @@
 signal, AUC accumulates, sharded-table mesh run matches replicated."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers, optimizer
@@ -39,6 +40,9 @@ def test_deepfm_trains_and_auc_improves():
     assert aucs[-1] > 0.68, aucs[-1]  # clearly better than chance
 
 
+# tier-1 headroom (PR 18): sharded-vs-replicated deepfm (~6 s) -> slow;
+# deepfm training stays via test_deepfm_trains_and_auc_improves
+@pytest.mark.slow
 def test_deepfm_sharded_tables_match_replicated():
     """Row-sharded embedding tables over an mp axis produce the same
     loss trace as the replicated run — the TPU equivalent of the

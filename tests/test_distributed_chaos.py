@@ -521,6 +521,10 @@ class TestNetworkFaults:
             for p in proxies:
                 p.close()
 
+    # tier-1 headroom (PR 18): 30% drop trajectory (~14 s) -> slow;
+    # drop/dup semantics stay via test_duplicate_sends_not_double_counted
+    # and test_blackhole_stall_bounded_by_deadline
+    @pytest.mark.slow
     def test_30pct_drop_exact_and_bounded(self):
         """30% of request frames vanish: deadlines + per-call retry +
         dedup must finish the sync run in bounded time with the exact

@@ -433,6 +433,11 @@ class TestLockLintReshardGate:
 
 @pytest.mark.chaos
 class TestElasticScenario:
+    # tier-1 headroom (PR 18): full 2->3->2 chaos scenario (~35 s) -> slow;
+    # join/leave and resharding stay via
+    # TestElasticDense::test_join_contribute_leave_full_cycle and
+    # TestLiveReshard; the seed sweep is already slow
+    @pytest.mark.slow
     def test_elastic_2_3_2_green_and_diagnosed(self):
         """ISSUE 17 acceptance, seed 0: grow 2->3 trainers mid-run
         under 5% frame drop, shrink back, reshard pservers 2->3 under

@@ -21,6 +21,10 @@ def _run(script, args=(), timeout=600, env=None):
         env=full_env, capture_output=True, text=True, timeout=timeout)
 
 
+# tier-1 headroom (PR 18): end-to-end train+deploy example (~13 s) ->
+# slow; the deploy/serve path stays via test_load_gen_smoke and the
+# training path via test_fleet_ps_cluster
+@pytest.mark.slow
 def test_train_mnist_then_deploy(tmp_path):
     model_dir = str(tmp_path / "mnist_model")
     r = _run("train_mnist.py", [model_dir])
@@ -78,6 +82,10 @@ def test_fleet_ps_cluster():
     assert "trainers done rc=0" in r.stdout
 
 
+# tier-1 headroom (PR 18): full parallelism matrix (~13 s) -> slow;
+# per-mode equality stays via the test_model_parallel.py dp/sp cells
+# and test_fleet_ps_cluster
+@pytest.mark.slow
 def test_parallelism_matrix():
     r = _run("parallelism_matrix.py", [],
              env={"XLA_FLAGS":

@@ -136,6 +136,9 @@ class TestFusionReportLive:
                    aug["fused_kernels_total"]))
         assert aug["collective_boundaries_total"] > 0
 
+    # tier-1 headroom (PR 18): sp-mesh fusion audit (~15 s) -> slow;
+    # boundary auditing stays via test_mlp_boundary_audit_q8_guard
+    @pytest.mark.slow
     @pytest.mark.mp
     def test_sp_axis_boundaries_do_not_split_fusion(self):
         """ISSUE 13 satellite: enabling sp (attention through the

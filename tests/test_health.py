@@ -631,6 +631,11 @@ class TestChaosDoctor:
         assert any(c.get("seq") is not None
                    for c in doc["evidence"]), doc
 
+    # tier-1 headroom (PR 18): full 2x2 restart chaos scenario (~53 s) -> slow;
+    # doctor restart diagnosis stays via TestDoctor::
+    # test_pserver_restart_beats_network_flaky and exact restart
+    # trajectories via test_distributed_chaos.py::TestPServerKillRestart
+    @pytest.mark.slow
     def test_restart_2x2_obs_diagnosed(self):
         """The 2x2 pserver kill+restart scenario must be diagnosed as
         pserver_restart (snapshot -> reconnect/replay evidence) —

@@ -180,6 +180,10 @@ class TestRouting:
                                            jax.devices()[:4])):
             assert sequence_parallel_attention(q, k, v) is None
 
+    # tier-1 headroom (PR 18): zigzag routing compile (~11 s) -> slow;
+    # attention routing stays via test_bias_routes_ulysses_exactly and
+    # test_flag_disables_routing
+    @pytest.mark.slow
     def test_causal_no_bias_routes_zigzag(self, rng):
         from paddle_tpu.parallel.zigzag import zigzag_attention
         q, k, v = self._qkv(rng)
@@ -406,6 +410,9 @@ def test_gated_step_on_dp_sp_mesh_bit_identical():
         assert np.isfinite(lv)
 
 
+# tier-1 headroom (PR 18): rollback on the dp x sp mesh (~7 s) -> slow;
+# guard composition stays via the test_guard_composes_on_dp_sp cells
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_guarded_trainer_rollback_on_dp_sp_mesh(tmp_path):
     """GuardedTrainer window rollback on the 2D mesh: persistent NaNs

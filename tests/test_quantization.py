@@ -71,6 +71,10 @@ def _train(main, startup, avg_loss, acc, scope, steps=60):
 
 
 class TestQAT:
+    # tier-1 headroom (PR 18): QAT convergence run (~5 s) -> slow; QAT
+    # stays via test_qat_abs_max_channelwise and
+    # test_freeze_int8_and_parity
+    @pytest.mark.slow
     def test_qat_converges_close_to_fp32(self):
         m, s, _, l, a, _ = _build(False)
         fp32 = _train(m, s, l, a, fluid.Scope())

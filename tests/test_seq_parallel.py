@@ -195,6 +195,10 @@ def test_ring_flash_gradients_match_s1024(rng):
                                    err_msg="d%s" % name)
 
 
+# tier-1 headroom (PR 18): bf16 ring-flash equality (~12 s) -> slow;
+# ring-flash stays via test_ring_flash_applicable_at_long_seq; the
+# f32 s1024 equality runs are already slow
+@pytest.mark.slow
 def test_ring_flash_bfloat16(rng):
     """bf16 operands through the flash hop kernels (the pod dtype):
     f32 score/combine internals keep the error at bf16 resolution."""

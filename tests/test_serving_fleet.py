@@ -542,6 +542,10 @@ class TestLoadGenRamp:
 
 @pytest.mark.chaos
 class TestFleetChaos:
+    # tier-1 headroom (PR 18): full fleet kill scenario (~11 s) -> slow;
+    # kill semantics stay via
+    # TestReplicaKill::test_kill_mid_flight_zero_lost_then_n_minus_1
+    @pytest.mark.slow
     def test_serving_kill_scenario(self):
         """The full acceptance scenario (tools/chaos_run.py
         serving_kill): replica killed under NetFaultProxy 5% drop ->

@@ -91,6 +91,10 @@ def test_shard_member_rejects_infer_structured(model_dir):
         member.shutdown()
 
 
+# tier-1 headroom (PR 18): full group kill/evict scenario (~17 s) ->
+# slow; group routing stays via test_predictor_enable_mesh_is_bit_exact
+# and test_executor_kill_retries_on_other_group_no_hangs
+@pytest.mark.slow
 def test_group_serves_and_member_kill_evicts_whole_group(model_dir):
     """Two groups of two: requests serve through group executors;
     killing a NON-executor member evicts its whole group (the mesh

@@ -170,6 +170,72 @@ class TestStampedClient:
             for s in servers:
                 s.shutdown()
 
+    def test_watermark_regression_drops_stamps_and_caches(self):
+        """An authority restored from an OLDER snapshot rolls its
+        watermark clock backwards: the refresh poll must invalidate
+        every stamp and the hot tier instead of clamping the negative
+        lag to 0 — pre-restore cached rows are NOT lag-0 fresh."""
+        servers, eps, tables = _shards(1)
+        cl = LookupServiceClient("emb", eps, dim=DIM, stamped=True,
+                                 write_policy="none",
+                                 cache_bytes=1 << 16)
+        try:
+            kv = tables[0]["emb"]
+            ids = np.arange(4, dtype=np.int64)
+            g = np.ones((4, DIM), np.float32)
+            kv.push(ids, g)                        # watermark 1
+            old_state = kv.export_state()
+            for _ in range(3):
+                kv.push(ids, g)                    # watermark 4
+            cl.pull(ids)                           # stamps @ wm 4
+            assert (cl.staleness(ids) == 0).all()
+            kv.import_state(old_state)             # wm back to 1
+            cl.watermarks(refresh=True)
+            # the poll saw the clock move backwards: stamps gone,
+            # staleness unknown (fetch-before-serve), never lag 0
+            assert cl.stats()["stamped_rows"] == 0
+            assert (cl.staleness(ids) == -1).all()
+            # the hot tier dropped with the stamps: pull re-reads the
+            # restored authority, not the pre-restore cached image
+            rows = cl.pull(ids)
+            assert np.allclose(rows, kv.pull(ids))
+            assert (cl.staleness(ids) == 0).all()
+        finally:
+            cl.close()
+            for s in servers:
+                s.shutdown()
+
+    def test_stamp_map_bounded_by_lru_trim(self):
+        """row_stamps must not outgrow the tiers it describes: the
+        cap trims least-recently-pulled stamps WITH their host-cache
+        rows ("host-cached => stamped"), and trimmed rows re-pull +
+        re-stamp on next touch."""
+        servers, eps, _tables = _shards(1)
+        cl = LookupServiceClient("emb", eps, dim=DIM, stamped=True,
+                                 write_policy="none",
+                                 cache_bytes=1 << 16,
+                                 max_stamp_rows=8)
+        try:
+            cl.pull(np.arange(20, dtype=np.int64))
+            assert len(cl.row_stamps) == 8
+            assert cl.stats()["stamps_trimmed"] == 12
+            # survivors are the most recently pulled
+            assert set(cl.row_stamps) == set(range(12, 20))
+            # trimmed rows read as unknown, not fresh
+            trimmed = np.arange(12, dtype=np.int64)
+            assert (cl.staleness(trimmed) == -1).all()
+            # ...and left the host cache with their stamps, so the
+            # next touch is an authority pull that re-stamps them
+            hits0 = cl.cache_hit_rows
+            cl.pull(trimmed[:4])
+            assert cl.cache_hit_rows == hits0
+            assert (cl.staleness(trimmed[:4]) == 0).all()
+            assert len(cl.row_stamps) == 8
+        finally:
+            cl.close()
+            for s in servers:
+                s.shutdown()
+
     def test_stats_carry_stamp_counters(self):
         servers, eps, _tables = _shards(1)
         cl = LookupServiceClient("emb", eps, dim=DIM, stamped=True,
@@ -228,6 +294,37 @@ class TestDeviceTier:
         assert np.allclose(t.gather(slots), rows)
         assert _DeviceRowTier._pow2(3) == 4
         assert _DeviceRowTier._pow2(8) == 8
+
+    def test_fill_overflow_spills_instead_of_remapping(self):
+        """A single fill larger than capacity must NOT wrap CLOCK
+        back onto slots it just allocated (two ids -> one slot ->
+        another id's row served): the unplaceable tail spills as -1
+        and every placed id gathers ITS OWN row."""
+        t = _DeviceRowTier(DIM, 8)
+        ids = np.arange(10, dtype=np.int64)
+        rows = np.stack([np.full(DIM, float(i), np.float32)
+                         for i in range(10)])
+        slots = t.fill(ids, rows)
+        placed = slots >= 0
+        assert int(placed.sum()) == 8 and t.overflow_rows == 2
+        # no slot serves two ids
+        assert len(set(slots[placed].tolist())) == 8
+        assert np.allclose(t.gather(slots[placed]), rows[placed])
+
+    def test_fill_never_evicts_pinned_hit_slots(self):
+        """Slots the current request already depends on (its hits)
+        survive any same-request fill — evicting one would corrupt
+        the gather that is about to read it."""
+        t = _DeviceRowTier(DIM, 8)
+        hit_ids = np.arange(4, dtype=np.int64)
+        hit_rows = np.stack([np.full(DIM, 100.0 + i, np.float32)
+                             for i in range(4)])
+        hit_slots = t.fill(hit_ids, hit_rows)
+        new_ids = np.arange(50, 60, dtype=np.int64)   # 10 > 4 free
+        new_rows = np.zeros((10, DIM), np.float32)
+        t.fill(new_ids, new_rows, pinned=hit_slots)
+        assert (t.lookup(hit_ids) == hit_slots).all()
+        assert np.allclose(t.gather(hit_slots), hit_rows)
 
     def test_invalidation_frees_slots(self):
         t = _DeviceRowTier(DIM, 16)
@@ -332,6 +429,31 @@ class TestStalenessGate:
             st = rep.stats()["staleness"]
             assert st["repulled_rows"] == 0
             assert st["shed_requests"] == 0
+        finally:
+            router.shutdown()
+            rep.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_overflow_request_serves_authority_rows(self):
+        """More unique ids in ONE request than the device tier holds:
+        the overflow bypasses the tier and serves the authority rows
+        already pulled — never another id's resident slot."""
+        servers, eps, tables = _shards(2)
+        rep = _replica(eps, device_rows=8, pull_q8=False)
+        router = ServingRouter([rep.endpoint], RouterConfig())
+        try:
+            ids = np.arange(12, dtype=np.int64)
+            out = router.infer_sync({"ids": ids.reshape(12, 1)},
+                                    timeout=30)
+            pooled = out[1]
+            want = np.stack([
+                tables[int(i) % 2]["emb"].pull(
+                    np.asarray([i], np.int64))[0] for i in ids])
+            assert np.allclose(pooled, want, atol=1e-5)
+            tiers = rep.stats()["tiers"]
+            assert tiers["device"]["overflow_rows"] == 4
+            assert tiers["device_overflow_rows"] == 4
         finally:
             router.shutdown()
             rep.shutdown()

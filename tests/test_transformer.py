@@ -25,6 +25,10 @@ def _build(cfg, seed=7):
     return main, startup, avg_cost
 
 
+# tier-1 headroom (PR 18): full training run (~23 s) -> slow;
+# transformer forward/loss stays via test_transformer_mask_ignores_pad
+# and TestFastDecode::test_greedy_matches_teacher_forced_argmax
+@pytest.mark.slow
 def test_transformer_trains():
     cfg = _tiny_cfg()
     main, startup, avg_cost = _build(cfg)
@@ -125,6 +129,10 @@ class TestFastDecode:
         mask[:, s // 2:] = 0.0
         return {"src_ids": src, "src_mask": mask}
 
+    # tier-1 headroom (PR 18): beam-search ordering (~10 s) -> slow;
+    # fast-decode parity stays via
+    # test_greedy_matches_teacher_forced_argmax
+    @pytest.mark.slow
     def test_decodes_and_orders_beams(self):
         import paddle_tpu as fluid
         cfg = self._cfg()
