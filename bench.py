@@ -2579,6 +2579,135 @@ def bench_pipelined_sparse_throughput(steps=None, chunk_size=8,
             "mfu": None}
 
 
+def bench_pipeline_bubble_fraction(n_micro=8, n_stages=2, batch=256,
+                                   hidden=256):
+    """Pipeline-schedule quality row (docs/step_engine.md): the
+    idle-slot (bubble) fraction of the traced schedule tables at
+    M=8, P=2 — 1F1B's fused forward/backward interleave must sit
+    STRICTLY below gpipe's two-phase schedule — plus each schedule's
+    peak live activation footprint (the saved-input ring: gpipe keeps
+    every in-flight microbatch, 1F1B caps at min(M, 2P-1)). Lower is
+    better; both numbers are pure schedule-table math shared with the
+    runtime (engine.pipeline), so this row moves ONLY when the
+    schedule itself changes."""
+    from paddle_tpu.engine.pipeline import (bubble_fraction,
+                                            peak_live_microbatches)
+
+    mb = batch // n_micro
+    per_schedule = {}
+    for sched in ("gpipe", "1f1b"):
+        peak = peak_live_microbatches(sched, n_micro, n_stages)
+        per_schedule[sched] = {
+            "bubble_fraction": round(
+                bubble_fraction(sched, n_micro, n_stages), 6),
+            "peak_live_microbatches": peak,
+            # fp32 activations on the saved-input ring, per stage
+            "peak_live_activation_bytes": peak * mb * hidden * 4,
+        }
+    f1, fg = (per_schedule["1f1b"]["bubble_fraction"],
+              per_schedule["gpipe"]["bubble_fraction"])
+    return {"metric": "pipeline_bubble_fraction",
+            "value": f1,
+            "unit": "idle-slot bubble fraction (1f1b, M=%d, P=%d)"
+                    % (n_micro, n_stages),
+            "gpipe_bubble_fraction": fg,
+            "strictly_below_gpipe": bool(f1 < fg),
+            "per_schedule": per_schedule,
+            "n_micro": n_micro, "n_stages": n_stages,
+            "microbatch": mb, "hidden": hidden,
+            "mfu": None}
+
+
+def bench_pipeline_parallel_throughput(steps=None, n_micro=4,
+                                       batch=256, hidden=256):
+    """Pipeline-stage training row (docs/step_engine.md): the SAME
+    model compiled three ways on the same device budget — unpipelined
+    dp over all devices, and a pp=2 x dp mesh with the gpipe and 1F1B
+    schedules traced inside the one step (engine.PipelinePlan) — each
+    timed over per-step dispatches. Higher is better; the ledger
+    provenance (per-path XLA compile counts) proves every path paid
+    exactly ONE trace: the whole microbatch schedule lives inside a
+    single compiled step, not M dispatches."""
+    import time as _time
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.engine import PipelinePlan
+    from paddle_tpu.parallel import make_mesh
+
+    steps = steps or int(_env_float("BENCH_PP_STEPS", 24))
+    ndev = jax.device_count()
+    ndev -= ndev % 2
+    ndev = max(2, min(8, ndev))
+    rng = np.random.RandomState(11)
+    feeds = [{"x": rng.randn(batch, hidden).astype(np.float32),
+              "y": rng.randn(batch, 1).astype(np.float32)}
+             for _ in range(steps)]
+
+    def build():
+        with fluid.unique_name.guard():
+            fluid.framework._reset_default_programs()
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 3
+            with fluid.program_guard(main, startup):
+                x = layers.data(name="x", shape=[hidden],
+                                dtype="float32")
+                y = layers.data(name="y", shape=[1], dtype="float32")
+                h = layers.fc(x, size=hidden, act="relu")
+                h = layers.fc(h, size=hidden, act="relu")
+                h = layers.fc(h, size=hidden, act="relu")
+                out = layers.fc(h, size=1)
+                loss = layers.reduce_mean(
+                    layers.square_error_cost(out, y))
+                fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+        return main, startup, loss
+
+    def run(axes, plan):
+        main, startup, loss = build()
+        bs = fluid.BuildStrategy()
+        bs.pipeline = plan
+        nd = 1
+        for v in axes.values():
+            nd *= v
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            build_strategy=bs, mesh=make_mesh(axes,
+                                              jax.devices()[:nd]))
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(prog, feed=feeds[0], fetch_list=[loss])  # warmup
+            t0 = _time.monotonic()
+            for f in feeds:
+                out = exe.run(prog, feed=f, fetch_list=[loss])
+            wall = _time.monotonic() - t0
+        return {"steps_per_s": round(steps / wall, 2),
+                "examples_per_sec": round(steps * batch / wall, 1),
+                "last_loss": float(np.asarray(out[0]).ravel()[0]),
+                "xla_compiles": exe.xla_compile_count}
+
+    paths = {
+        "unpipelined_dp%d" % ndev: run({"dp": ndev}, None),
+        "gpipe_pp2": run({"pp": 2, "dp": ndev // 2},
+                         PipelinePlan(2, n_micro, "gpipe")),
+        "1f1b_pp2": run({"pp": 2, "dp": ndev // 2},
+                        PipelinePlan(2, n_micro, "1f1b")),
+    }
+    f1 = paths["1f1b_pp2"]
+    return {"metric": "pipeline_parallel_throughput",
+            "value": f1["examples_per_sec"],
+            "unit": "examples/sec (1f1b pp=2 traced in-step, M=%d)"
+                    % n_micro,
+            "paths": paths,
+            "one_trace_per_path": bool(all(
+                p["xla_compiles"] <= 2 for p in paths.values())),
+            "steps": steps, "batch": batch, "hidden": hidden,
+            "n_micro": n_micro, "devices": ndev,
+            "mfu": None}
+
+
 _EMITTED = []
 
 
@@ -2816,6 +2945,8 @@ def child_main():
                  bench_elastic_join_catchup, bench_reshard_bytes,
                  bench_sparse_embedding_throughput,
                  bench_pipelined_sparse_throughput,
+                 bench_pipeline_bubble_fraction,
+                 bench_pipeline_parallel_throughput,
                  bench_serving_latency, bench_serving_fleet_scaling,
                  bench_remediation_recovery, bench_qps_under_autoscale,
                  bench_sparse_serving,

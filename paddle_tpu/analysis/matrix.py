@@ -13,6 +13,7 @@ This module makes the matrix a fast static gate: enumerate
   × pipelined ∈ {off, on}
   × PS ∈ {off, on}
   × sparse ∈ {off, on}
+  × pp ∈ {off, on}
 
 build each composed program the same way the runtime would (install
 the guard, convert the sharded state, run the PS transpiler split,
@@ -44,6 +45,16 @@ SYNC_AXIS = (None, "exact", "rs_ag", "q8",
              "sharded_update", "sharded_update_q8")
 PIPELINE_AXIS = (False, True)
 PS_AXIS = (False, True)
+# pipeline-stage dimension (PR 19): pp=True widens the probe's forward
+# with two structurally-identical fc segments and statically binds
+# ``PipelinePlan(2, 2)`` against the composed block — the SAME bind
+# (segment isomorphism, boundary externals, tail classification) the
+# StepEngine runs before tracing the microbatch schedule. pp adds NO
+# rejection pairs (engine.rules): its contracts are bind-time shape
+# checks on the block, not combo-level legality — a program whose
+# region can't stage fails bind with a cited reason, which this matrix
+# surfaces as an error finding rather than a rejection.
+PP_AXIS = (False, True)
 # sparse dimension (PR 14→16): a distributed-embedding lookup whose
 # rows live host-side — the probe carries the
 # program._distributed_lookups contract (prefetch data var + sparse
@@ -65,7 +76,8 @@ def build_training_program(guard: bool = False,
                            hidden: int = 8,
                            world: int = 2,
                            mesh: str = "dp",
-                           sparse: bool = False):
+                           sparse: bool = False,
+                           pp: bool = False):
     """One tiny composed training program, assembled exactly the way
     the runtime paths assemble it (install_anomaly_guard for the
     guard, ensure_sharded_state/ensure_residual_vars for the sharded/
@@ -75,8 +87,11 @@ def build_training_program(guard: bool = False,
     the real op shape to inspect. ``sparse=True`` adds a distributed
     embedding lookup (no in-graph parameter; the prefetch var enters
     as a feed, the table id rides ``main._distributed_lookups`` — the
-    exact contract SparseEmbeddingRuntime drives). Returns (main,
-    startup, scope, loss_name)."""
+    exact contract SparseEmbeddingRuntime drives). ``pp=True`` widens
+    the forward with two identical hidden->hidden fc segments — the
+    minimal region ``infer_segments`` can split into two stages, so
+    the static ``PipelinePlan.bind`` check has a stageable window to
+    verify against. Returns (main, startup, scope, loss_name)."""
     from .. import layers, optimizer as opt
     from ..core.scope import Scope
 
@@ -86,6 +101,9 @@ def build_training_program(guard: bool = False,
         x = layers.data(name="x", shape=[hidden], dtype="float32")
         y = layers.data(name="y", shape=[1], dtype="float32")
         h = layers.fc(input=x, size=hidden, act="relu")
+        if pp:
+            h = layers.fc(input=h, size=hidden, act="relu")
+            h = layers.fc(input=h, size=hidden, act="relu")
         if sparse:
             ids = layers.data(name="ids", shape=[4], dtype="int64")
             emb = layers.embedding(ids, size=(32, hidden),
@@ -124,25 +142,26 @@ def build_training_program(guard: bool = False,
 
 
 def _verify_combo(guard, sync, pipelined, ps, mesh="dp",
-                  sparse=False) -> Dict:
+                  sparse=False, pp=False) -> Dict:
     from . import verify_program
     from .contracts import (check_mesh_contract,
                             check_pipeline_contract, check_ps_contract)
 
     combo = {"guard": guard, "gradient_sync": sync,
              "pipelined": pipelined, "ps": ps, "mesh": mesh,
-             "sparse": sparse}
+             "sparse": sparse, "pp": pp}
     # the ONE legality table, shared with the runtime engine: the
     # reason string here is byte-for-byte the InvalidArgumentError the
     # StepEngine raises for the same combo
     rej = rules.rejection(gradient_sync=sync, pipelined=pipelined,
-                          ps=ps, sparse=sparse)
+                          ps=ps, sparse=sparse, pp=pp)
     if rej is not None:
         return dict(combo, status="rejected", reason=rej[1],
                     findings=[])
 
     main, startup, scope, loss_name = build_training_program(
-        guard=guard, gradient_sync=sync, mesh=mesh, sparse=sparse)
+        guard=guard, gradient_sync=sync, mesh=mesh, sparse=sparse,
+        pp=pp)
     feed = ("x", "y")
     if sparse:
         # the prefetch var is feed-like: the runtime's wrap_feed
@@ -166,6 +185,27 @@ def _verify_combo(guard, sync, pipelined, ps, mesh="dp",
             "inside forward/backward; gradient_sync=%r operates along "
             "dp only, with model-axis partial sums finished at the "
             "bracket edge (finish_model_partials)" % (sync,))
+    if pp:
+        # the SAME bind the StepEngine runs before tracing: segment
+        # isomorphism, boundary externals, tail classification —
+        # statically, on the composed (guarded/sharded/sparse) block,
+        # BEFORE any ps transpile mutates it
+        from ..engine.pipeline import PipelinePlan
+        try:
+            bound = PipelinePlan(2, 2).bind(main.global_block())
+        except Exception as exc:  # surfaced, not swallowed: a combo
+            # whose region can't stage is a broken seam, not a reject
+            findings.append(Finding(
+                rule="pp-bind", severity="error",
+                message="PipelinePlan(2, 2).bind failed on the "
+                        "composed block: %s" % (exc,)))
+        else:
+            notes.append(
+                "pp: PipelinePlan(2, 2) binds statically — region "
+                "ops [%d, %d), schedule writes the region output and "
+                "every @GRAD the sequential trace would have "
+                "produced, so guard/sync/sparse splice points are "
+                "untouched" % (bound.region_start, bound.region_end))
 
     if ps:
         from ..transpiler import DistributeTranspiler
@@ -204,7 +244,8 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
                        pipeline_axis=PIPELINE_AXIS,
                        ps_axis=PS_AXIS,
                        mesh_axis=MESH_AXIS,
-                       sparse_axis=SPARSE_AXIS) -> Dict:
+                       sparse_axis=SPARSE_AXIS,
+                       pp_axis=PP_AXIS) -> Dict:
     """Sweep the full feature matrix; returns a JSON-able report:
     ``{"combos": [...], "counts": {"ok": n, "rejected": n,
     "broken": n}, "broken": [...]}``. The CI gate asserts
@@ -216,9 +257,11 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
                 for ps in ps_axis:
                     for mesh in mesh_axis:
                         for sparse in sparse_axis:
-                            combos.append(_verify_combo(
-                                guard, sync, pipelined, ps,
-                                mesh=mesh, sparse=sparse))
+                            for pp in pp_axis:
+                                combos.append(_verify_combo(
+                                    guard, sync, pipelined, ps,
+                                    mesh=mesh, sparse=sparse,
+                                    pp=pp))
     counts: Dict[str, int] = {"ok": 0, "rejected": 0, "broken": 0}
     for c in combos:
         counts[c["status"]] += 1
@@ -231,5 +274,6 @@ def composition_matrix(guard_axis=GUARD_AXIS, sync_axis=SYNC_AXIS,
                  "pipelined": list(pipeline_axis),
                  "ps": list(ps_axis),
                  "mesh": list(mesh_axis),
-                 "sparse": list(sparse_axis)},
+                 "sparse": list(sparse_axis),
+                 "pp": list(pp_axis)},
     }

@@ -70,6 +70,14 @@ class BuildStrategy:
         # with a param-side error-feedback residual and full-precision
         # master shards). Ignored by the non-sharded modes.
         self.param_gather = "fp32"
+        # Pipeline (pp) stages inside the one traced step: an
+        # engine.pipeline.PipelinePlan (n_stages, n_micro, schedule
+        # "gpipe"/"1f1b") or None. The plan binds against the block at
+        # step-assembly time; when the mesh carries a "pp" axis the
+        # stage shifts route over it as ppermute hops. Composes with
+        # every gradient_sync mode, the guard, and chunk scans — see
+        # docs/step_engine.md.
+        self.pipeline = None
         # fuse_elewise_add_act_ops runs the real ir pass (ir/passes.py);
         # the remaining toggles are accepted for parity — the XLA
         # compiler performs those fusions itself.
@@ -197,7 +205,10 @@ class CompiledProgram:
         in_specs meet data laid out where they want it instead of
         forcing a gather-then-scatter (the resharding-collective
         posture of arXiv:2112.01075). A feed var annotated via
-        parallel.shard uses its own spec."""
+        parallel.shard uses its own spec. The pp axis never shards
+        feeds: microbatching happens INSIDE the step trace (the
+        schedule reshapes the batch), and what the pp axis carries is
+        the stacked stage-parameter/activation axis, not data."""
         if name is not None:
             var = self.program.global_block().vars.get(name)
             if var is not None and var.sharding is not None:
@@ -226,11 +237,13 @@ class CompiledProgram:
             (n, str(v.sharding)) for n, v in
             self.program.global_block().vars.items()
             if v.persistable and v.sharding is not None))
+        pplan = getattr(self._build_strategy, "pipeline", None)
         return (tuple(d.id for d in mesh.devices.flat),
                 mesh.axis_names, tuple(mesh.shape.values()),
                 self._build_strategy.reduce_strategy,
                 self._build_strategy.gradient_sync,
                 getattr(self._build_strategy, "param_gather", "fp32"),
+                pplan.signature() if pplan is not None else None,
                 var_specs)
 
     def grad_sync_plan(self, block):

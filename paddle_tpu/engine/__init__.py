@@ -4,14 +4,19 @@
 dispatches (guard × collectives × sharded bracket × mesh finisher);
 ``build_repeat_fn`` / ``build_chunk_fn`` wrap it in the K-step scans;
 ``StepEngine`` drives composed chunks with host-exchange stages (PS,
-sparse) riding the chunk boundaries. ``rules`` is the shared
+sparse) riding the chunk boundaries. ``PipelinePlan`` makes pipeline
+(pp) stages a build_step axis: the whole gpipe/1F1B microbatch
+schedule traces inside the same one step. ``rules`` is the shared
 composition-legality table — the static matrix and the runtime engine
 reject the same combos with the same message.
 """
 
 from . import rules  # noqa: F401
+from .pipeline import (PipelinePlan, infer_segments,  # noqa: F401
+                       stack_stage_params)
 from .step_engine import (HostStage, StepEngine,  # noqa: F401
                           build_chunk_fn, build_repeat_fn, build_step)
 
 __all__ = ["rules", "HostStage", "StepEngine", "build_step",
-           "build_repeat_fn", "build_chunk_fn"]
+           "build_repeat_fn", "build_chunk_fn", "PipelinePlan",
+           "infer_segments", "stack_stage_params"]

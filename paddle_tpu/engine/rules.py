@@ -33,12 +33,20 @@ REJECTIONS = {
 
 def rejection(gradient_sync: Optional[str] = None,
               pipelined: bool = False, ps: bool = False,
-              sparse: bool = False) -> Optional[Tuple[tuple, str]]:
+              sparse: bool = False,
+              pp: bool = False) -> Optional[Tuple[tuple, str]]:
     """-> ((feature, feature), reason) when the combo is structurally
     impossible, else None. The sparse exchange deliberately adds no
     rejections: it rides chunk boundaries (K=1 degenerates to the
     per-step flow), so it composes with every other stage — including
-    PS at K=1, the reference's Downpour dense+sparse posture."""
+    PS at K=1, the reference's Downpour dense+sparse posture.
+
+    ``pp`` (pipeline stages inside the step trace) likewise adds NO
+    pairs: the schedule is a region splice inside the one step, so it
+    composes with guard, every collective mode, the sharded bracket,
+    chunk scans, sparse, and PS alike — per-block structural limits
+    (batch_norm, rng ops, skip connections) are bind-time contract
+    checks on the specific block, not combo rejections."""
     from ..parallel.collectives import SHARDED_MODES
     if ps and gradient_sync in SHARDED_MODES:
         return ("ps", "sharded"), REJECTIONS[("ps", "sharded")]

@@ -54,7 +54,8 @@ __all__ = ["build_step", "build_repeat_fn", "build_chunk_fn",
 
 def build_step(program, block, fetch_names: Sequence[str],
                library=None, sync_plan=None, guard_plan=None,
-               carried=None, warn_dropped: bool = False) -> Callable:
+               carried=None, warn_dropped: bool = False,
+               pipeline_plan=None, mesh=None) -> Callable:
     """Assemble THE traced step: ``step(persist, feed_vals, step_key)
     -> (fetches, persist_out)``.
 
@@ -62,6 +63,14 @@ def build_step(program, block, fetch_names: Sequence[str],
     inside ``run_block`` (collective transport, sharded bracket, and
     anomaly gate are all boundary splices — the step stays one XLA
     computation and fusion crosses the seams).
+
+    ``pipeline_plan`` (engine.pipeline.PipelinePlan) splices a third
+    stage the same way: it binds against the block HERE (validation is
+    assembly-time, not trace-time) and run_block traces the whole
+    microbatch schedule at the region start — stage stacking, shifts,
+    per-microbatch backward — inside the same one trace the other
+    stages splice into. ``mesh`` (optional jax Mesh) lets the bound
+    plan route stage shifts over a ``pp`` axis when one is in scope.
 
     ``carried=None`` (the per-step ``run`` posture) writes back every
     persistable the step produced. A frozenset pins a FIXED carry for
@@ -72,6 +81,10 @@ def build_step(program, block, fetch_names: Sequence[str],
     from .. import framework
     from ..executor import run_block
 
+    bound_pipeline = None
+    if pipeline_plan is not None:
+        bound_pipeline = pipeline_plan.bind(block, mesh=mesh)
+
     persistable_names = frozenset(
         n for n, v in block.vars.items() if v.persistable)
 
@@ -80,7 +93,8 @@ def build_step(program, block, fetch_names: Sequence[str],
         env.update(feed_vals)
         with framework._trace_program_guard(program):
             run_block(block, env, step_key, library=library,
-                      grad_sync=sync_plan, anomaly_guard=guard_plan)
+                      grad_sync=sync_plan, anomaly_guard=guard_plan,
+                      pipeline=bound_pipeline)
         if carried is None:
             persist_out = {n: env[n] for n in persistable_names
                            if n in env}
@@ -141,10 +155,18 @@ def build_repeat_fn(step: Callable, iters: int) -> Callable:
 
 
 def build_chunk_fn(step: Callable,
-                   stacked_idx: Sequence[int] = ()) -> Callable:
+                   stacked_idx: Sequence[int] = (),
+                   pipeline_plan=None) -> Callable:
     """K data-fed steps in one ``lax.scan`` over the chunk xs:
     ``pipelined(persist, chunk, idxs, base_key) ->
     (last_fetches, stacked, persist)``.
+
+    ``pipeline_plan`` is accepted for assembly-API parity with
+    ``build_step``: when the step was built WITH a plan, the whole
+    microbatch schedule is already inside the step trace, so the chunk
+    scan wraps it unchanged — pp × pipelined-chunk composes by
+    construction. Passing a plan here only asserts the caller's
+    intent matches (a plan object, not truthy garbage).
 
     ``idxs`` carry ABSOLUTE run counters, so step ``i`` of a chunk
     starting at counter ``c`` uses ``fold_in(base_key, c+i)`` —
@@ -155,6 +177,11 @@ def build_chunk_fn(step: Callable,
     the scan ys stacked ``[K, ...]`` — the chunk-boundary host stages'
     raw material (sparse out-grads for the push). Everything else
     returns last-step-only via the carry, as before."""
+    if pipeline_plan is not None:
+        from .pipeline import PipelinePlan
+        enforce(isinstance(pipeline_plan, PipelinePlan),
+                "pipeline_plan must be a PipelinePlan, got %r",
+                type(pipeline_plan).__name__)
     stacked_idx = tuple(stacked_idx)
 
     def pipelined(persist, chunk, idxs, base_key):
@@ -228,7 +255,8 @@ class StepEngine:
             gradient_sync=getattr(bs, "gradient_sync", None),
             pipelined=k > 1,
             ps=any(st.kind == "ps" for st in stages),
-            sparse=any(st.kind == "sparse" for st in stages))
+            sparse=any(st.kind == "sparse" for st in stages),
+            pp=getattr(bs, "pipeline", None) is not None)
         if rej is not None:
             raise InvalidArgumentError(rej[1])
 

@@ -536,7 +536,7 @@ def _run_adam_group(ops_group, env, step_key, library):
 
 
 def run_block(block, env, step_key, library=None, grad_sync=None,
-              anomaly_guard=None):
+              anomaly_guard=None, pipeline=None):
     """Trace every op of a block into env (the analog of the reference's
     RunPreparedContext hot loop, executor.cc:415 — but tracing, not
     executing).
@@ -556,13 +556,23 @@ def run_block(block, env, step_key, library=None, grad_sync=None,
     advances the skipped/consecutive-anomaly counters. The optimize-role
     ops themselves are gated on the flag via their ``gate`` attr (set by
     resilience.guard.install_anomaly_guard), so a bad step's update is a
-    select-no-op inside the one traced step."""
+    select-no-op inside the one traced step.
+
+    ``pipeline``: optional engine.pipeline._BoundPipeline — at its
+    region start the bound plan traces the WHOLE microbatch schedule
+    (stacked stages, stage shifts, per-microbatch backward) into env,
+    writing the region output and every ``@GRAD`` entry the skipped
+    sequential region/vjp ops would have produced; the rest of the
+    block (guard, collectives, optimizer tail) then composes
+    unchanged."""
     vjp_fwd_indices = {op.attrs.get("fwd_op_index")
                        for op in block.ops if op.type in ("vjp", "vjp2")}
     adam_groups = _adam_batch_groups(block) \
         if (FLAGS.multi_tensor_adam
             and not _adam_library_overridden(library)) else {}
     skip = set()
+    if pipeline is not None:
+        skip.update(pipeline.skip)
     if anomaly_guard is not None:
         # post_sync must see the post-collective residuals: when a sync
         # plan exists its boundary is >= the guard's (the guard's grad
@@ -591,6 +601,8 @@ def run_block(block, env, step_key, library=None, grad_sync=None,
             # gather the fresh shards back to full params before
             # anything downstream (EMA, averaging, fetches) reads them
             grad_sync.finish(env)
+        if pipeline is not None and i == pipeline.region_start:
+            pipeline.execute(env, step_key, library=library)
         if i in skip:
             continue
         if i in adam_groups:
@@ -1519,10 +1531,14 @@ class Executor:
         # the user/stacked split is baked into the compiled scan (which
         # fetch positions ride the ys), so two calls with the same
         # union but a different split must not share an executable
+        pplan = getattr(dist._build_strategy, "pipeline", None) \
+            if dist is not None \
+            else getattr(base, "_pipeline_plan", None)
         cache_key = ("pipelined", base._uid, base._version,
                      feed_names, tuple(all_fetch_names),
                      tuple(stack_names), tuple(sorted(persist_in)),
-                     library, mesh_fp)
+                     library, mesh_fp,
+                     pplan.signature() if pplan is not None else None)
         with _profiler.RecordEvent("feed_h2d"):
             if dist is not None:
                 # batch-shard each per-step slice exactly as run()
@@ -1565,9 +1581,13 @@ class Executor:
                               library=library, sync_plan=sync_plan,
                               guard_plan=guard_plan,
                               carried=frozenset(persist_in),
-                              warn_dropped=True)
+                              warn_dropped=True,
+                              pipeline_plan=pplan,
+                              mesh=dist._mesh if dist is not None
+                              else None)
             pipelined = build_chunk_fn(
-                step, range(len(fetch_names), len(all_fetch_names)))
+                step, range(len(fetch_names), len(all_fetch_names)),
+                pipeline_plan=pplan)
             # donate the carry AND the feed chunk: the chunk's device
             # buffers are dead once its scan consumed them
             jit_kwargs = {"donate_argnums": (0, 1)}
@@ -1873,9 +1893,13 @@ class Executor:
         # donate is baked into the jitted fn (donate_argnums), so it
         # must key the cache: a donate=False caller handed a donating
         # executable would have its param buffers invalidated mid-call
+        pplan = getattr(dist._build_strategy, "pipeline", None) \
+            if dist is not None \
+            else getattr(program, "_pipeline_plan", None)
         cache_key = (program._uid, program._version, feed_names,
                      tuple(fetch_names), tuple(sorted(persist_in)),
-                     library, donate, mesh_fp)
+                     library, donate, mesh_fp,
+                     pplan.signature() if pplan is not None else None)
         # convert the feed BEFORE the per-SHAPE compile accounting:
         # the signature must reflect the dtypes XLA actually sees
         # (asarray canonicalizes int64 labels to int32, so the raw
@@ -1909,7 +1933,10 @@ class Executor:
             from .engine import build_step
             step = build_step(program, block, fetch_names,
                               library=library, sync_plan=sync_plan,
-                              guard_plan=guard_plan)
+                              guard_plan=guard_plan,
+                              pipeline_plan=pplan,
+                              mesh=dist._mesh if dist is not None
+                              else None)
 
             if _needs_eager(program):
                 # Interpreted mode: programs with While loops / tensor

@@ -11,10 +11,15 @@ through the whole schedule (scan/ppermute/dynamic-slice all have
 transposes), so ``jax.grad`` of a pipelined loss yields exactly the
 1F1B-equivalent backward without hand-written scheduling.
 
-Composable like the other parallel modules:
-  - pure function ``gpipe_apply(stage_fn, stage_params, x, ...)`` over
-    globally-sharded arrays (shard_map under the hood);
-  - ``gpipe_apply_inner`` for use inside user shard_map code.
+As of PR 19 the scheduler itself lives in ``engine.pipeline`` — the
+schedule tables, the functional forward scan, the stage stacking, and
+the microbatch validation are the SAME code the StepEngine traces when
+a ``PipelinePlan`` rides a build strategy (gpipe AND 1F1B, forward and
+backward, composed with guard/collectives/sharded-update inside the
+one step trace). This module keeps the global-view ``gpipe_apply``
+entry for user shard_map code: the explicit pp-mesh path (one stage
+per device, ppermute transfers) plus the sequential reference
+semantics when no pp axis is in scope.
 
 The bubble fraction is (P-1)/(M+P-1) — callers pick n_micro >> pp for
 efficiency; correctness holds for any M >= 1.
@@ -23,56 +28,18 @@ efficiency; correctness holds for any M >= 1.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+# the scheduler plane is shared with the engine: these are the exact
+# callables PipelinePlan traces inside build_step
+from ..engine.pipeline import (gpipe_apply_inner, schedule_forward,
+                               stack_stage_params,
+                               validate_microbatches)
 from . import mesh as mesh_lib
 
-
-def gpipe_apply_inner(stage_fn, stage_params, x_micro, *, axis_name,
-                      n_stages):
-    """Per-shard GPipe body (call inside shard_map).
-
-    stage_fn(params, x) -> y   — one stage's computation; the SAME
-        callable runs on every stage with that stage's params shard.
-        Input and output must have identical shape/dtype (the
-        activation that travels the pipe).
-    stage_params — this device's stage parameters (pytree).
-    x_micro [M, ...] — the microbatches; every stage receives the same
-        array, only stage 0 reads it.
-
-    Returns y_micro [M, ...]: on the LAST stage, the pipeline outputs;
-    on other stages, zeros (gpipe_apply ppermutes them home)."""
-    stage = lax.axis_index(axis_name)
-    M = x_micro.shape[0]
-    P = n_stages
-    fwd_perm = [(i, (i + 1) % P) for i in range(P)]
-
-    carry_act = jnp.zeros_like(x_micro[0])
-    out_buf = jnp.zeros_like(x_micro)
-
-    def tick(carry, t):
-        act, outs = carry
-        # stage 0 injects microbatch t (clamped; ticks >= M feed a
-        # dummy that never reaches the output buffer)
-        mb = lax.dynamic_index_in_dim(x_micro, jnp.minimum(t, M - 1),
-                                      keepdims=False)
-        inp = jnp.where(stage == 0, mb, act)
-        y = stage_fn(stage_params, inp)
-        # last stage completes microbatch t - (P-1) at tick t
-        done_idx = t - (P - 1)
-        outs = lax.cond(
-            jnp.logical_and(stage == P - 1, done_idx >= 0),
-            lambda o: lax.dynamic_update_index_in_dim(
-                o, y, jnp.maximum(done_idx, 0), 0),
-            lambda o: o, outs)
-        act_next = lax.ppermute(y, axis_name, fwd_perm)
-        return (act_next, outs), None
-
-    (_, out_buf), _ = lax.scan(tick, (carry_act, out_buf),
-                               jnp.arange(M + P - 1))
-    return out_buf
+__all__ = ["gpipe_apply", "gpipe_apply_inner", "schedule_forward",
+           "stack_stage_params", "validate_microbatches"]
 
 
 def gpipe_apply(stage_fn, stacked_params, x, *, mesh=None, axis="pp",
@@ -90,27 +57,16 @@ def gpipe_apply(stage_fn, stacked_params, x, *, mesh=None, axis="pp",
     # validate BEFORE the mesh branch: the same call must behave
     # identically on one device and on a pod
     M = n_micro if n_micro is not None else n_params
-    if M < 1:
-        raise ValueError("n_micro must be >= 1, got %r" % (n_micro,))
-    if B % M != 0:
-        raise ValueError("batch %d not divisible by n_micro %d"
-                         % (B, M))
+    validate_microbatches(B, M)
     if mesh is None or axis not in mesh.axis_names \
             or mesh.shape[axis] == 1:
-        # no pipeline axis in scope: sequential reference semantics —
-        # over the SAME microbatches the pipelined path uses, so a
+        # no pipeline axis in scope: the engine's functional scheduler
+        # over the SAME microbatches the meshed path uses — so a
         # stage_fn with cross-row coupling (batch statistics) cannot
         # silently diverge between one device and a pod
         xm = x.reshape((M, B // M) + x.shape[1:])
-        outs = []
-        for m in range(M):
-            y = xm[m]
-            for s in range(n_params):
-                params_s = jax.tree_util.tree_map(lambda a: a[s],
-                                                  stacked_params)
-                y = stage_fn(params_s, y)
-            outs.append(y)
-        return jnp.concatenate(outs, axis=0)
+        return schedule_forward(stage_fn, stacked_params,
+                                xm).reshape((B,) + x.shape[1:])
 
     P = mesh.shape[axis]
     if n_params != P:
@@ -144,10 +100,3 @@ def gpipe_apply(stage_fn, stacked_params, x, *, mesh=None, axis="pp",
         check_rep=False)
     out = f(stacked_params, x_micro)          # [P, M, b, ...]
     return out[0].reshape((B,) + x.shape[1:])
-
-
-def stack_stage_params(per_stage_params):
-    """[{...}, {...}, ...] (one pytree per stage, equal structure) ->
-    one pytree with leading [P] stage axis, ready for gpipe_apply."""
-    return jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves), *per_stage_params)

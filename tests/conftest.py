@@ -103,6 +103,13 @@ def pytest_configure(config):
         "markers",
         "engine: composed step-engine test (tier-1; select alone "
         "with -m engine)")
+    # pipeline-stage suite (engine/pipeline.py: gpipe/1F1B microbatch
+    # schedules traced inside the one step); the sync-mode sweep
+    # beyond one-cell-per-feature-pair also carries -m slow
+    config.addinivalue_line(
+        "markers",
+        "pp: pipeline-stage (gpipe/1F1B in-step schedule) test "
+        "(tier-1; select alone with -m pp)")
     # elastic-membership suite (trainer JOIN/LEAVE, pserver live
     # resharding, group-atomic scaling): loopback RPC, CPU-fast; the
     # acceptance scenario also carries -m chaos, the multi-seed sweep

@@ -405,13 +405,14 @@ class TestCompositionMatrix:
     def test_full_matrix_static_and_clean(self):
         rep = composition_matrix()
         # 2 guard x 6 sync x 2 pipelined x 2 ps x 2 mesh x 2 sparse
-        # = 192 combos, all classified, zero broken — the ROADMAP
-        # "seams" CI gate, now with the model-parallel mesh dimension
-        # (PR 13) and the sparse-exchange dimension (PR 16)
-        assert len(rep["combos"]) == 192
+        # x 2 pp = 384 combos, all classified, zero broken — the
+        # ROADMAP "seams" CI gate, now with the model-parallel mesh
+        # dimension (PR 13), the sparse-exchange dimension (PR 16),
+        # and the pipeline-stage dimension (PR 19)
+        assert len(rep["combos"]) == 384
         assert rep["counts"]["broken"] == 0, rep["broken"]
-        assert rep["counts"]["ok"] == 128
-        assert rep["counts"]["rejected"] == 64
+        assert rep["counts"]["ok"] == 256
+        assert rep["counts"]["rejected"] == 128
         for c in rep["combos"]:
             if c["status"] == "rejected":
                 assert c["reason"], c
@@ -427,7 +428,7 @@ class TestCompositionMatrix:
         # every dp_sp combo that verifies carries the mesh note, and
         # the guard x sp x sharded product is in the verified set
         sp = [c for c in rep["combos"] if c["mesh"] == "dp_sp"]
-        assert len(sp) == 96
+        assert len(sp) == 192
         assert all(any("dp×sp" in n for n in c["notes"])
                    for c in sp if c["status"] == "ok")
         assert any(c["guard"] and c["gradient_sync"] ==
@@ -437,7 +438,7 @@ class TestCompositionMatrix:
         # ps-driven one, and sparse x ps (Downpour dense+sparse) is in
         # the verified set with the chunk-boundary note
         sparse = [c for c in rep["combos"] if c["sparse"]]
-        assert len(sparse) == 96
+        assert len(sparse) == 192
         assert {(c["ps"], c["pipelined"], c["gradient_sync"])
                 for c in sparse if c["status"] == "rejected"} == \
                {(c["ps"], c["pipelined"], c["gradient_sync"])
@@ -454,7 +455,11 @@ class TestCompositionMatrix:
         reg = obs.registry()
         before = reg.snapshot().get("counters", {}).get(
             "executor_compiles_total", 0)
-        composition_matrix(sync_axis=(None, "sharded_update"))
+        # a thin slice is enough: ANY built combo compiling would move
+        # the counter, and the full-product build runs above anyway
+        composition_matrix(sync_axis=(None, "sharded_update"),
+                           mesh_axis=("dp",), sparse_axis=(False,),
+                           pp_axis=(False,))
         after = reg.snapshot().get("counters", {}).get(
             "executor_compiles_total", 0)
         assert after == before
@@ -569,9 +574,15 @@ class TestCLI:
         assert rep["findings"][0]["rule"] == "dangling_read"
         assert rep["findings"][0]["var"] == "ghost"
 
+    @pytest.mark.slow
     def test_in_process_main_matrix(self, capsys):
         """--matrix through main() in process (the subprocess sweep
-        would re-pay jax import for no extra coverage)."""
+        would re-pay jax import for no extra coverage). Slow: this is
+        the THIRD full 384-combo build in the suite — tier-1 keeps the
+        sweep itself (TestCompositionMatrix::
+        test_full_matrix_static_and_clean) and the CLI plumbing
+        (test_clean_model_exits_zero and friends); only the one-line
+        --matrix dispatch rides the slow lane."""
         import verify_program as vp
         rc = vp.main(["--matrix"])
         out = capsys.readouterr().out

@@ -22,6 +22,14 @@ repeats this table):
       K>1 with the static reason); the NEW composition here is the
       ps stage × sparse stage Downpour step, compared against the
       bespoke PR 5 + PR 14 loops chained by hand.
+  pp (PipelinePlan on a pp×dp mesh)             vs the SAME mesh
+      budget dp-only: RTOL 1e-6 (the schedule is per-microbatch
+      gradient accumulation — the same partial-sum tree dp uses, but
+      reassociated per microbatch). vs the UNMESHED sequential loop:
+      RTOL 1e-4 (inherits the dp-vs-sequential float drift). The
+      pp chunk scan vs pp per-step dispatch is BIT-EXACT (same traced
+      schedule either way). q8-containing sync under pp keeps the q8
+      posture (rtol 2e-3).
 
 The tier-1 slice keeps one cell per feature pair; the full sweep is
 ``-m slow`` (ROADMAP 870 s cap discipline).
@@ -61,6 +69,25 @@ def _build_mlp(seed=7):
     return main, startup, loss
 
 
+def _build_pp3(seed=7):
+    """Three identical hidden->hidden relu fcs: the contiguous window
+    ``infer_segments`` splits into two pipeline stages (the last two
+    fcs), with the first fc as the full-batch head."""
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[HIDDEN], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=HIDDEN, act="relu")
+            h = layers.fc(h, size=HIDDEN, act="relu")
+            h = layers.fc(h, size=HIDDEN, act="relu")
+            out = layers.fc(h, size=1)
+            loss = layers.reduce_mean(layers.square_error_cost(out, y))
+            optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
 def _batches(n, seed=0, poison=()):
     rng = np.random.RandomState(seed)
     out = []
@@ -82,7 +109,7 @@ def _snapshot(scope):
 
 def _equality_cell(sync=None, guard=False, mesh=None, steps=4,
                    poison=(), rtol=None, probe=_build_mlp,
-                   feeds=None):
+                   feeds=None, pipeline=None):
     """One runtime-equality cell: K sequential run() steps (ground
     truth) vs ONE engine-assembled run_pipelined chunk, same initial
     state, same PRNG counters. ``rtol=None`` asserts bit-exact."""
@@ -99,6 +126,7 @@ def _equality_cell(sync=None, guard=False, mesh=None, steps=4,
         from paddle_tpu.parallel import make_mesh
         bs = fluid.BuildStrategy()
         bs.gradient_sync = sync
+        bs.pipeline = pipeline
         axes = mesh or {"dp": 2}
         ndev = int(np.prod(list(axes.values())))
         prog = fluid.CompiledProgram(main).with_data_parallel(
@@ -202,6 +230,137 @@ class TestEqualityMatrixFull:
     @pytest.mark.parametrize("sync", [None, "sharded_update"])
     def test_dp_sp_cells(self, sync):
         _dp_sp_cell(sync)
+
+
+# ---------------------------------------------------------------------------
+# pipeline stages traced inside the one step (PR 19)
+# ---------------------------------------------------------------------------
+
+def _pp_traj(axes=None, plan=None, sync=None, guard=False, steps=4,
+             poison=()):
+    """Per-step exe.run() loss trajectory of the 3-fc probe, compiled
+    on ``axes`` with an optional PipelinePlan riding the build
+    strategy; ``axes=None`` is the unmeshed sequential reference."""
+    import jax
+
+    main, startup, loss = _build_pp3()
+    scope = fluid.Scope()
+    if guard:
+        from paddle_tpu.resilience.guard import install_anomaly_guard
+        with fluid.scope_guard(scope):
+            install_anomaly_guard(main, loss=loss, scope=scope)
+    prog = main
+    if axes is not None:
+        from paddle_tpu.parallel import make_mesh
+        bs = fluid.BuildStrategy()
+        bs.gradient_sync = sync
+        bs.pipeline = plan
+        ndev = int(np.prod(list(axes.values())))
+        if jax.device_count() < ndev:
+            pytest.skip("needs %d virtual devices" % ndev)
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            build_strategy=bs,
+            mesh=make_mesh(axes, jax.devices()[:ndev]))
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        return np.array(
+            [np.asarray(exe.run(prog, feed=f, fetch_list=[loss])[0])
+             for f in _batches(steps, poison=poison)]).ravel()
+
+
+@pytest.mark.pp
+class TestPipelineStages:
+    """Tier-1 pp cells: one per feature pair (posture table in the
+    module docstring); the sync-mode sweep is in the slow twin."""
+
+    @pytest.mark.parametrize("sched", [
+        pytest.param("gpipe", marks=pytest.mark.slow),
+        "1f1b"])
+    def test_pp_matches_dp_and_sequential(self, sched):
+        # pp=2 x dp=2 with the schedule traced in-step vs the same
+        # 4-device budget spent dp-only, and vs the unmeshed loop.
+        # tier-1 keeps the 1f1b cell (the production schedule; gpipe
+        # rides the slow sweep — both schedules still meet the slow
+        # twins' sync-mode and microbatch matrices, and gpipe's table
+        # is pinned by test_1f1b_bubble_and_ring_strictly_below_gpipe)
+        from paddle_tpu.engine import PipelinePlan
+        seq = _pp_traj()
+        dp4 = _pp_traj(axes={"dp": 4})
+        pp = _pp_traj(axes={"pp": 2, "dp": 2},
+                      plan=PipelinePlan(2, 4, sched))
+        np.testing.assert_allclose(pp, dp4, rtol=1e-6)
+        np.testing.assert_allclose(pp, seq, rtol=1e-4)
+
+    def test_pp_exact_guard_composes(self):
+        # guard skips the poisoned step inside the pipelined trace
+        # exactly as it does in the sequential one, with the exact
+        # collective mode composing on the dp axis
+        from paddle_tpu.engine import PipelinePlan
+        seq = _pp_traj(guard=True, poison=(1,))
+        pp = _pp_traj(axes={"pp": 2, "dp": 2},
+                      plan=PipelinePlan(2, 4, "1f1b"), sync="exact",
+                      guard=True, poison=(1,))
+        # the poisoned step's LOSS is nan in both trajectories (the
+        # guard gates the update, not the fetch); the steps after it
+        # matching proves the pipelined guard skipped the same update
+        assert np.isnan(seq[1]) and np.isnan(pp[1])
+        np.testing.assert_allclose(pp, seq, rtol=1e-4)
+
+    def test_pp_chunk_scan_bit_exact_vs_per_step(self):
+        # the K-step chunk scan composes with the in-step schedule:
+        # same traced schedule either way, so bit-exact posture
+        from paddle_tpu.engine import PipelinePlan
+        _equality_cell(mesh={"pp": 2, "dp": 2}, probe=_build_pp3,
+                       pipeline=PipelinePlan(2, 4, "1f1b"))
+
+    def test_1f1b_bubble_and_ring_strictly_below_gpipe(self):
+        # M=8, P=2: 1F1B's fused interleave idles (P-1)/(M+2P-1) of
+        # its slots vs gpipe's (P-1)/(M+P-1), and its saved-input
+        # ring caps at min(M, 2P-1) microbatches vs gpipe's M
+        from paddle_tpu.engine.pipeline import (bubble_fraction,
+                                                peak_live_microbatches)
+        f1 = bubble_fraction("1f1b", 8, 2)
+        fg = bubble_fraction("gpipe", 8, 2)
+        assert f1 < fg, (f1, fg)
+        assert f1 == pytest.approx(1.0 / 11.0)
+        assert fg == pytest.approx(1.0 / 9.0)
+        assert peak_live_microbatches("1f1b", 8, 2) == 3
+        assert peak_live_microbatches("gpipe", 8, 2) == 8
+
+    def test_pp_mesh_size_mismatch_rejected(self):
+        from paddle_tpu.engine import PipelinePlan
+        with pytest.raises(InvalidArgumentError,
+                           match="one stage per pp shard"):
+            _pp_traj(axes={"pp": 4, "dp": 2},
+                     plan=PipelinePlan(2, 4, "1f1b"))
+
+
+@pytest.mark.pp
+@pytest.mark.slow
+class TestPipelineStagesFull:
+    """The sync-mode sweep beyond one-cell-per-feature-pair."""
+
+    @pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+    @pytest.mark.parametrize("sync,rtol", [("sharded_update", 1e-6),
+                                           ("q8", 2e-3)])
+    def test_pp_sync_modes_match_dp_twin(self, sched, sync, rtol):
+        # vs dp=2 with the SAME sync mode: the collective operates on
+        # the same dp axis size either way, so q8 quantizes the same
+        # buckets and sharded_update shards the same state
+        from paddle_tpu.engine import PipelinePlan
+        dp = _pp_traj(axes={"dp": 2}, sync=sync)
+        pp = _pp_traj(axes={"pp": 2, "dp": 2},
+                      plan=PipelinePlan(2, 4, sched), sync=sync)
+        np.testing.assert_allclose(pp, dp, rtol=rtol, atol=1e-6)
+
+    @pytest.mark.parametrize("M", [1, 2, 8])
+    def test_pp_microbatch_counts(self, M):
+        from paddle_tpu.engine import PipelinePlan
+        seq = _pp_traj()
+        pp = _pp_traj(axes={"pp": 2, "dp": 2},
+                      plan=PipelinePlan(2, M, "1f1b"))
+        np.testing.assert_allclose(pp, seq, rtol=1e-4)
 
 
 # ---------------------------------------------------------------------------
@@ -418,28 +577,80 @@ class _Stage(HostStage):
 
 
 class _Strategized:
-    def __init__(self, gradient_sync):
+    def __init__(self, gradient_sync, pipeline=None):
         class BS:
             pass
 
         self._build_strategy = BS()
         self._build_strategy.gradient_sync = gradient_sync
+        self._build_strategy.pipeline = pipeline
 
 
 class TestStaticRuntimeParity:
     def test_partition_matches_both_directions(self):
-        """Every static-matrix cell maps to the engine's accept/reject
-        verdict: rejected cells raise InvalidArgumentError whose
-        message IS the static reason string; ok cells assemble. Both
-        directions — a rejection added to either plane alone fails
-        here."""
+        """Every cell of the 384-combo axis product maps to the
+        engine's accept/reject verdict: cells the static table rejects
+        raise InvalidArgumentError whose message IS the static reason
+        string; every other cell assembles. Both directions — a
+        rejection added to either plane alone fails here. The sweep
+        enumerates the SAME axes the matrix sweeps but derives the
+        expected verdict from ``rules.rejection`` directly (tier-1
+        builds the 384 real programs once already, in
+        test_analysis.py::TestCompositionMatrix::
+        test_full_matrix_static_and_clean — the slow twin below
+        cross-validates this sweep against that built report)."""
+        import itertools
+
+        from paddle_tpu.analysis import matrix as m
+        from paddle_tpu.engine import PipelinePlan
+
+        checked_rej = checked_ok = 0
+        for guard, sync, pipelined, ps, mesh, sparse, pp in \
+                itertools.product(m.GUARD_AXIS, m.SYNC_AXIS,
+                                  m.PIPELINE_AXIS, m.PS_AXIS,
+                                  m.MESH_AXIS, m.SPARSE_AXIS,
+                                  m.PP_AXIS):
+            expected = rules.rejection(
+                gradient_sync=sync, pipelined=pipelined, ps=ps,
+                sparse=sparse, pp=pp)
+            prog = _Strategized(
+                sync, pipeline=PipelinePlan(2, 2) if pp else None)
+            stages = []
+            if ps:
+                stages.append(_Stage("ps"))
+            if sparse:
+                stages.append(_Stage("sparse"))
+            k = 8 if pipelined else 1
+            if expected is not None:
+                with pytest.raises(InvalidArgumentError) as ei:
+                    StepEngine.check_composition(prog, k=k,
+                                                 stages=stages)
+                assert expected[1] in str(ei.value), (guard, sync)
+                checked_rej += 1
+            else:
+                StepEngine.check_composition(prog, k=k, stages=stages)
+                checked_ok += 1
+        assert checked_rej == 128
+        assert checked_ok == 256
+
+    @pytest.mark.slow
+    def test_partition_matches_built_matrix(self):
+        """Slow twin: the same sweep cross-validated against the REAL
+        built composition_matrix() report — catches a matrix driver
+        that classifies a combo differently than ``rules.rejection``
+        says it should (tier-1 sibling above covers the static
+        mapping; test_analysis keeps the built 0-broken gate)."""
         from paddle_tpu.analysis.matrix import composition_matrix
+
+        from paddle_tpu.engine import PipelinePlan
 
         rep = composition_matrix()
         assert rep["counts"]["broken"] == 0
         checked_rej = checked_ok = 0
         for c in rep["combos"]:
-            prog = _Strategized(c["gradient_sync"])
+            prog = _Strategized(
+                c["gradient_sync"],
+                pipeline=PipelinePlan(2, 2) if c["pp"] else None)
             stages = []
             if c["ps"]:
                 stages.append(_Stage("ps"))
@@ -455,8 +666,8 @@ class TestStaticRuntimeParity:
             else:
                 StepEngine.check_composition(prog, k=k, stages=stages)
                 checked_ok += 1
-        assert checked_rej == rep["counts"]["rejected"] == 64
-        assert checked_ok == rep["counts"]["ok"] == 128
+        assert checked_rej == rep["counts"]["rejected"] == 128
+        assert checked_ok == rep["counts"]["ok"] == 256
 
     def test_rules_is_single_source(self):
         """The matrix re-exports the engine's table (same object):
@@ -562,6 +773,27 @@ class TestBenchDiffDirections:
                           4000.0, 9000.0)
         assert rise["flags"] == []
 
+    def test_pipeline_bubble_fraction_lower_is_better(self):
+        # pinned BOTH ways: the "bubble" token is a NEW
+        # lower-is-better pattern, so a silent heuristic edit that
+        # drops it (or flips "fraction") fails here
+        unit = "idle-slot bubble fraction (1f1b, M=8, P=2)"
+        rise = self._diff("pipeline_bubble_fraction", unit,
+                          0.0909, 0.25)
+        assert [f["flag"] for f in rise["flags"]] == ["REGRESSION"]
+        drop = self._diff("pipeline_bubble_fraction", unit,
+                          0.25, 0.0909)
+        assert drop["flags"] == []
+
+    def test_pipeline_parallel_throughput_higher_is_better(self):
+        unit = "examples/sec (1f1b pp=2 traced in-step, M=4)"
+        drop = self._diff("pipeline_parallel_throughput", unit,
+                          9000.0, 4000.0)
+        assert [f["flag"] for f in drop["flags"]] == ["REGRESSION"]
+        rise = self._diff("pipeline_parallel_throughput", unit,
+                          4000.0, 9000.0)
+        assert rise["flags"] == []
+
 
 class TestLockLintGate:
     def test_engine_module_scanned_and_clean(self):
@@ -570,6 +802,20 @@ class TestLockLintGate:
         assert any(fk.startswith("paddle_tpu.engine.")
                    for fk in funcs), \
             "paddle_tpu/engine fell out of the lock_lint scan set"
+        report = lock_lint.analyze(locks, funcs)
+        assert report["violations"] == [], report["violations"]
+
+    def test_pipeline_module_pinned_and_clean(self):
+        # the scheduler is the pipelined step's hot path: pinned
+        # EXPLICITLY in DEFAULT_PATHS (not just riding engine/), so a
+        # future split of engine/ can't silently drop it
+        import lock_lint
+        assert "paddle_tpu/engine/pipeline.py" in \
+            lock_lint.DEFAULT_PATHS
+        locks, funcs = lock_lint.scan(
+            ("paddle_tpu/engine/pipeline.py",))
+        assert any(fk.startswith("paddle_tpu.engine.pipeline")
+                   for fk in funcs), "pipeline module yielded no scan"
         report = lock_lint.analyze(locks, funcs)
         assert report["violations"] == [], report["violations"]
 
@@ -640,5 +886,50 @@ class TestFusionRegression:
         colls = eng["boundaries"]["collectives"]
         assert colls, "sharded_update_q8 produced no collective " \
             "boundary instructions"
+        assert any(b["fed_by_fusion"] or b["feeds_fusion"]
+                   for b in colls), colls
+
+    @pytest.mark.pp
+    def test_pp_stage_fuses_no_worse_than_unpipelined_twin(self):
+        """ISSUE 19 satellite: the pp=2 transformer probe's traced
+        schedule must not SHATTER stage-body fusion — the pipelined
+        executable (whose scan traces each stage body once) must keep
+        at least the unpipelined twin's per-stage fused-kernel count,
+        and its collective boundaries must stay fusion-adjacent."""
+        import fusion_report
+
+        from paddle_tpu.engine import PipelinePlan
+
+        def audit(pipeline, axes):
+            prog, startup, feed, scope, loss = \
+                fusion_report.build_demo_program(
+                    "transformer_pp", gradient_sync="exact",
+                    axes=axes, pipeline=pipeline)
+            exe = fluid.Executor()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                out = exe.run(prog, feed=feed, fetch_list=[loss])
+            base = prog.program
+            recs = [r for r in fusion_report.fusion_report(exe)
+                    if r["entry"] == "run"
+                    and r["program_uid"] == base._uid
+                    and r["analysis"]]
+            assert recs, "training executable not audited"
+            return np.asarray(out[0]), recs[0]["analysis"]
+
+        loss_pp, pp = audit(PipelinePlan(2, 4, "1f1b"),
+                            {"pp": 2, "dp": 2})
+        loss_base, ref = audit(None, {"dp": 2})
+        # same model, same math: the schedule is loss-neutral
+        np.testing.assert_allclose(loss_pp, loss_base, rtol=1e-4)
+        # the twin unrolls BOTH stages inline, so its count is ~2
+        # stages' worth; the scan body holds one stage's
+        per_stage_ref = ref["fused_kernels"] // 2
+        assert pp["fused_kernels"] >= per_stage_ref, (
+            "pp stage body fuses WORSE than the unpipelined twin "
+            "per stage: %d < %d"
+            % (pp["fused_kernels"], per_stage_ref))
+        colls = pp["boundaries"]["collectives"]
+        assert colls, "exact sync under pp produced no collectives"
         assert any(b["fed_by_fusion"] or b["feeds_fusion"]
                    for b in colls), colls

@@ -58,7 +58,12 @@ __all__ = ["load_rounds", "diff", "format_report"]
 # composed_step_overhead is lower-is-better by its "overhead" name
 # (and "% step time" unit), pipelined_sparse_throughput is
 # higher-is-better by its "examples/sec" unit — both directions are
-# pinned by tests/test_step_engine.py. The elastic rows are both
+# pinned by tests/test_step_engine.py. The pipeline-stage rows (PR
+# 19): pipeline_parallel_throughput rides "examples/sec"
+# (higher-is-better), pipeline_bubble_fraction is lower-is-better by
+# its "fraction" unit AND the explicit "bubble" token below (so a
+# future rename of the unit string cannot silently flip it) — both
+# directions pinned by tests/test_step_engine.py. The elastic rows are both
 # lower-is-better via existing patterns — elastic_join_catchup by its
 # "seconds" unit, reshard_bytes by its "bytes" unit — and both
 # directions are pinned by tests/test_control.py.
@@ -70,7 +75,8 @@ _HIGHER_IS_BETTER = re.compile(
 # lower-is-better heuristic by unit/metric name: a drop in these is an
 # improvement, a rise is the regression
 _LOWER_IS_BETTER = re.compile(
-    r"(seconds|_ms\b|latency|overhead|fraction|p9\d|bytes|recovery)",
+    r"(seconds|_ms\b|latency|overhead|fraction|p9\d|bytes|recovery"
+    r"|bubble)",
     re.IGNORECASE)
 
 

@@ -187,7 +187,8 @@ def fusion_report(exe) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 def build_demo_program(model="mlp", gradient_sync=None, guard=False,
-                       devices=1, seed=7, wrap_mesh=False, axes=None):
+                       devices=1, seed=7, wrap_mesh=False, axes=None,
+                       pipeline=None):
     """Build (program-to-run, startup, feed, scope, loss) for the CLI:
     a small MLP or a tiny transformer, optionally data-parallel with an
     explicit gradient_sync rewrite and/or the anomaly guard — the three
@@ -199,7 +200,16 @@ def build_demo_program(model="mlp", gradient_sync=None, guard=False,
     explicit multi-axis mesh: the transformer's attention then routes
     through the sp schedule (zigzag chunk-pair permute / Ulysses
     all_to_all), adding the sp-axis collective boundaries this audit
-    inspects alongside the gradient-sync ones."""
+    inspects alongside the gradient-sync ones.
+
+    ``model="transformer_pp"`` builds the pipeline-stage probe: two
+    structurally-identical attention+fc stages (test-mode sdpa — rng
+    inert — so the stage is replayable per microbatch), the minimal
+    window an ``engine.PipelinePlan`` stages. ``pipeline`` (a
+    PipelinePlan) rides the build strategy so the audited training
+    executable traces the microbatch schedule inside the one step —
+    the fusion-regression gate compares its per-stage fused-kernel
+    count against the unpipelined twin's."""
     import numpy as np
 
     import paddle_tpu as fluid
@@ -226,6 +236,31 @@ def build_demo_program(model="mlp", gradient_sync=None, guard=False,
             loss, _tok, _ = T.transformer(cfg)
             fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
             feed = T.make_fake_batch(cfg, max(4, devices))
+        elif model == "transformer_pp":
+            # two IDENTICAL attention blocks: reshape to [b, H=2,
+            # S=4, Dh=4], test-mode sdpa (dropout rate forced to 0 —
+            # replay-safe), reshape back, fc+relu. The repeated block
+            # is the contiguous window infer_segments partitions into
+            # two pipeline stages; everything after (the classifier
+            # head) is the schedule's full-batch tail.
+            x = fluid.layers.data("x", shape=[32])
+            label = fluid.layers.data("label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(x, size=32, act="relu")
+            for _ in range(2):
+                t = fluid.layers.reshape(h, (-1, 2, 4, 4))
+                t = fluid.layers.scaled_dot_product_attention(
+                    t, t, t, scale=0.5, is_test=True)
+                t = fluid.layers.reshape(t, (-1, 32))
+                h = fluid.layers.fc(t, size=32, act="relu")
+            pred = fluid.layers.fc(h, size=8, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+            b = max(8, devices)
+            feed = {"x": rng.rand(b, 32).astype(np.float32),
+                    "label": rng.randint(0, 8, (b, 1)).astype(
+                        np.int64)}
         else:
             x = fluid.layers.data("x", shape=[32])
             label = fluid.layers.data("label", shape=[1],
@@ -245,13 +280,14 @@ def build_demo_program(model="mlp", gradient_sync=None, guard=False,
         with fluid.scope_guard(scope):
             install_anomaly_guard(main, loss=loss, scope=scope)
     prog = main
-    if gradient_sync or devices > 1 or wrap_mesh or axes:
+    if gradient_sync or devices > 1 or wrap_mesh or axes or pipeline:
         import jax
 
         from paddle_tpu.parallel import mesh as mesh_lib
         bs = fluid.BuildStrategy()
         if gradient_sync:
             bs.gradient_sync = gradient_sync
+        bs.pipeline = pipeline
         mesh = mesh_lib.make_mesh(dict(axes),
                                   jax.devices()[:devices]) \
             if axes else mesh_lib.data_parallel_mesh(devices)
@@ -289,7 +325,7 @@ def run_and_report(model="mlp", gradient_sync=None, guard=False,
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="mlp",
-                    choices=("mlp", "transformer"))
+                    choices=("mlp", "transformer", "transformer_pp"))
     ap.add_argument("--gradient-sync", default=None,
                     help="explicit collective rewrite to audit "
                     "(exact|rs_ag|q8|sharded_update|sharded_update_q8)")

@@ -64,6 +64,12 @@ DEFAULT_PATHS = (
     # split may silently drop it from the scan
     "paddle_tpu/serving/sparse.py",
     "paddle_tpu/engine",
+    # engine/pipeline.py rides paddle_tpu/engine above, but the
+    # microbatch schedule it traces IS the step hot path (every
+    # pipelined training step runs through it), so it is pinned
+    # EXPLICITLY like reshard.py/sparse.py: a future split of
+    # engine/ cannot silently drop the scheduler from the scan
+    "paddle_tpu/engine/pipeline.py",
 )
 
 # mutexes only: semaphores are deliberately NOT tracked — the repo
