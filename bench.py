@@ -2001,6 +2001,97 @@ def bench_elastic_join_catchup(steps=10, join_at=3):
             "base_trainers": 2, "join_at_step": join_at}
 
 
+def bench_join_commit_latency(steps=10, join_at=2):
+    """Cross-shard JOIN admission row (docs/resilience.md §Fault-point
+    catalog): wall seconds from the 2PC park on the FIRST dense shard
+    to the all-shards admission commit, against a live 2-pserver sync
+    job (``ParameterServerRuntime.join_admit_seconds``). This is the
+    transaction the crash-anywhere sweep exercises — the row exists so
+    the epoch-vote round stays boundary-bounded (one barrier release
+    per shard) instead of drifting toward a per-shard serial wait.
+    Lower is better."""
+    import threading
+    import time as _time
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.distributed import (ParameterServerRuntime,
+                                        PServerRuntime)
+    from paddle_tpu.distributed.ps import join_running_job
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = 5
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [16], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            pred = layers.fc(x, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=start,
+                pservers="127.0.0.1:0,localhost:0", trainers=1)
+    servers = [PServerRuntime(t, ep) for ep in list(t.pserver_endpoints)]
+    for s in servers:
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+        s.serv.server.start()
+    trainer = t.get_trainer_program()
+    rs = np.random.RandomState(3)
+    f = {"x": rs.rand(64, 16).astype(np.float32),
+         "label": rs.randint(0, 4, (64, 1)).astype(np.int64)}
+    timing = {}
+    errs = {}
+
+    def run_trainer():
+        try:
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        trainer_id=0,
+                                        connect_timeout_s=20.0)
+            rt.init_params()
+            for _ in range(steps):
+                rt.run_step(exe, f, fetch_list=[loss])
+            rt.complete()
+        except Exception as e:
+            errs[0] = repr(e)
+
+    def run_joiner():
+        try:
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = join_running_job(t, trainer, scope,
+                                  connect_timeout_s=20.0)
+            timing["admit_seconds"] = rt.join_admit_seconds
+            timing["join_seconds"] = rt.join_seconds
+            for _ in range(2):
+                rt.run_step(exe, f, fetch_list=[loss])
+            rt.leave()
+        except Exception as e:
+            errs["join"] = repr(e)
+
+    th = threading.Thread(target=run_trainer)
+    th.start()
+    # join against live barrier traffic, not the pre-start idle server
+    _time.sleep(0.02 * join_at)
+    jt = threading.Thread(target=run_joiner)
+    jt.start()
+    for x_ in (th, jt):
+        x_.join(timeout=300)
+    for s in servers:
+        s.serv.shutdown()
+    if errs:
+        return {"metric": "join_commit_latency", "error": repr(errs)}
+    return {"metric": "join_commit_latency",
+            "value": round(timing["admit_seconds"], 4),
+            "unit": "seconds (2PC park -> all-shard admission commit)",
+            "join_seconds": round(timing["join_seconds"], 4),
+            "shards": len(servers), "base_trainers": 1}
+
+
 def bench_reshard_bytes(vocab=4096, dim=32, touched=3000):
     """Live-reshard wire-cost row: bytes moved + wall seconds to
     repartition a populated sparse table 2 -> 3 shards, p2p plan
@@ -2942,7 +3033,8 @@ def child_main():
                  bench_compile_cache_warmup, bench_fused_kernel_count,
                  bench_model_parallel,
                  bench_guarded_overhead, bench_ps_degraded,
-                 bench_elastic_join_catchup, bench_reshard_bytes,
+                 bench_elastic_join_catchup,
+                 bench_join_commit_latency, bench_reshard_bytes,
                  bench_sparse_embedding_throughput,
                  bench_pipelined_sparse_throughput,
                  bench_pipeline_bubble_fraction,

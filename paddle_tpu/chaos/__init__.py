@@ -1,0 +1,14 @@
+"""Deterministic chaos plane: named protocol fault points + seeded
+fault plans (docs/resilience.md §Fault-point catalog). The sweep
+harness lives in tools/chaos_run.py (--sweep faultpoints)."""
+
+from .faultpoints import (ACTIONS, POINTS, FaultDrop, FaultPlan,
+                          clear, decide, faultpoint, fired,
+                          flush_events, install, planned, plans,
+                          protocol_of, record, remove)
+
+__all__ = [
+    "ACTIONS", "POINTS", "FaultDrop", "FaultPlan", "clear", "decide",
+    "faultpoint", "fired", "flush_events", "install", "planned",
+    "plans", "protocol_of", "record", "remove",
+]

@@ -1,0 +1,295 @@
+"""Protocol-step fault-point plane (docs/resilience.md §Fault-point
+catalog).
+
+Every multi-step elasticity protocol in the PS runtime — the reshard
+cutover, the 2PC JOIN admission, the snapshot boundary, the barrier
+release — is instrumented with NAMED fault points: ``faultpoint(name)``
+calls placed at each state transition. A seeded :class:`FaultPlan`
+makes one point misbehave DETERMINISTICALLY (at the Nth hit, not at a
+random draw), which turns "we ran chaos with seed 3" into "we crashed
+at every step of the protocol and proved convergence-or-clean-abort
+for each" — the deterministic-simulation idiom (FoundationDB/Jepsen;
+cf. the fault posture of arXiv:2112.01075's PS lineage).
+
+Actions::
+
+    crash  raise rpc.ServerCrash — the process dies AT the transition
+           (sockets closed, nothing answered), before any state
+           mutation the point guards
+    delay  sleep ``delay_s`` at the transition (stall model)
+    drop   raise FaultDrop — the transition's message is lost; the
+           instrumented protocol must retry idempotently or abort
+           cleanly (an RPC handler surfaces it as a structured error
+           reply, never a hang)
+    dup    return ``"dup"`` — the instrumented site re-runs the
+           transition's idempotent step a second time
+
+Locking contract (tools/lock_lint.py enforces it repo-wide): fault
+points fire INSIDE locked protocol sections, so ``faultpoint()`` never
+journals directly — a firing is queued, and :func:`flush_events` (the
+only emitting function here, drained by a background flusher and by
+lock-free callers such as the sweep harness) writes the
+``fault_injected`` journal events after every lock has dropped.
+
+The catalog below (``POINTS``) is the sweep grid of
+``tools/chaos_run.py --sweep faultpoints``; dynamic points (the
+``rpc.<VERB>`` family behind the legacy ``crash_after`` shim, the
+``net.*`` family behind the NetFaultProxy knobs, ``serving.*`` lease
+probes) ride the same plane and the same journal without appearing in
+the grid.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import observability as _obs
+from ..core.enforce import enforce
+
+ACTIONS = ("crash", "delay", "drop", "dup")
+
+# The sweep grid: point -> actions that are meaningful there. Client-
+# side points (no process to kill at the injection site) carry no
+# "crash"; "drop" is absent where the transition is not a message
+# (first_merge, snapshot boundaries) or where losing it could only be
+# observed as a crash anyway; "dup" appears only where the site
+# actually re-runs the idempotent step.
+POINTS: Dict[str, tuple] = {
+    # reshard cutover (distributed/reshard.py handlers + the
+    # LookupServiceClient's shard-map refetch)
+    "reshard.prepare":          ("crash", "delay", "drop"),
+    "reshard.seal":             ("crash", "delay", "drop"),
+    "reshard.activate":         ("crash", "delay", "drop"),
+    "reshard.client_refetch":   ("delay", "drop", "dup"),
+    # 2PC JOIN admission (distributed/ps.py)
+    "join.park":                ("crash", "delay", "drop", "dup"),
+    "join.admit":               ("crash", "delay", "drop"),
+    "join.catchup_pull":        ("delay", "drop", "dup"),
+    "join.first_merge":         ("crash", "delay"),
+    # snapshot boundary protocol (ps._maybe_snapshot_locked + the
+    # durable save / GC-advance split in the shard runtimes)
+    "snapshot.boundary_begin":  ("crash", "delay"),
+    "snapshot.boundary_commit": ("crash", "delay"),
+    "snapshot.gc_advance":      ("crash", "delay"),
+    # sync-step barrier release (ps._maybe_release_barrier_locked)
+    "barrier.release":          ("crash", "delay"),
+}
+
+
+def protocol_of(point: str) -> str:
+    """``"reshard.seal"`` -> ``"reshard"`` (the fault_audit grouping
+    key; dynamic families map the same way: rpc.*, net.*, serving.*)."""
+    return point.split(".", 1)[0]
+
+
+class FaultDrop(Exception):
+    """The injected 'message lost' fault: raised by ``faultpoint()``
+    for a ``drop`` plan. Protocols either retry the step idempotently
+    or surface a structured abort; an RPC handler letting it propagate
+    answers the caller with a STATUS_ERROR reply (never a hang)."""
+
+
+class FaultPlan:
+    """One deterministic injection: fire ``action`` at the ``at``-th
+    hit of ``point`` (counting only hits whose context matches
+    ``where``), ``times`` consecutive hits long. ``seed`` is recorded
+    in the journal so a sweep cell's ledger names its exact plan."""
+
+    def __init__(self, point: str, action: str, at: int = 1,
+                 times: int = 1, seed: int = 0, delay_s: float = 0.05,
+                 where: Optional[dict] = None):
+        enforce(action in ACTIONS,
+                "unknown fault action %r (want one of %s)"
+                % (action, list(ACTIONS)))
+        if point in POINTS:
+            enforce(action in POINTS[point],
+                    "action %r is not in the catalog for point %r "
+                    "(allowed: %s)" % (action, point,
+                                       list(POINTS[point])))
+        enforce(int(at) >= 1 and int(times) >= 1,
+                "FaultPlan needs at >= 1 and times >= 1")
+        self.point = str(point)
+        self.action = str(action)
+        self.at = int(at)
+        self.times = int(times)
+        self.seed = int(seed)
+        self.delay_s = float(delay_s)
+        self.where = dict(where or {})
+        self.hits = 0
+        self.fired = 0
+
+    def matches(self, point: str, ctx: dict) -> bool:
+        if point != self.point:
+            return False
+        return all(ctx.get(k) == v for k, v in self.where.items())
+
+    def __repr__(self):
+        return ("FaultPlan(%r, %r, at=%d, times=%d, where=%r)"
+                % (self.point, self.action, self.at, self.times,
+                   self.where))
+
+
+_MU = threading.Lock()
+_PLANS: List[FaultPlan] = []
+_FIRED: List[dict] = []     # every firing, for harness assertions
+_PENDING: List[dict] = []   # queued fault_injected journal events
+_FLUSHER: Optional[threading.Thread] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Arm a plan process-wide. Plans are consulted in install order;
+    the FIRST plan matching a point owns that hit."""
+    with _MU:
+        _PLANS.append(plan)
+        _ensure_flusher_locked()
+    return plan
+
+
+def remove(plan: FaultPlan) -> None:
+    with _MU:
+        if plan in _PLANS:
+            _PLANS.remove(plan)
+
+
+def clear() -> None:
+    """Disarm every plan and forget the firing record (sweep cells and
+    the test fixture call this between runs; queued journal events
+    still flush)."""
+    with _MU:
+        del _PLANS[:]
+        del _FIRED[:]
+
+
+def plans() -> List[FaultPlan]:
+    return list(_PLANS)
+
+
+def fired() -> List[dict]:
+    """Every firing so far (plan-driven and shim-recorded), oldest
+    first — the harness's ground truth for 'doctor named every
+    injected fault'."""
+    return list(_FIRED)
+
+
+class planned:
+    """``with planned("join.park", "crash") as p:`` — scoped install;
+    the plan disarms on exit whether or not it fired."""
+
+    def __init__(self, point: str, action: str, **kw):
+        self.plan = FaultPlan(point, action, **kw)
+
+    def __enter__(self) -> FaultPlan:
+        return install(self.plan)
+
+    def __exit__(self, *exc):
+        remove(self.plan)
+        return False
+
+
+def _arm(point: str, ctx: dict) -> Optional[FaultPlan]:
+    """Count a hit; return the plan to execute if one fires. The
+    firing is queued for the journal here (under the plane's own lock
+    only — never emitted: the call site may hold a server lock)."""
+    with _MU:
+        for p in _PLANS:
+            if p.matches(point, ctx):
+                p.hits += 1
+                if p.hits >= p.at and p.fired < p.times:
+                    p.fired += 1
+                    rec = dict(point=point, action=p.action,
+                               protocol=protocol_of(point),
+                               hit=p.hits, plan_seed=p.seed)
+                    rec.update({k: v for k, v in ctx.items()
+                                if isinstance(v, (str, int, float,
+                                                  bool))})
+                    _FIRED.append(rec)
+                    _PENDING.append(rec)
+                    _ensure_flusher_locked()
+                    return p
+                return None
+        return None
+
+
+def faultpoint(point: str, **ctx):
+    """The instrumentation call: one per protocol transition. Returns
+    None (no armed plan fired here) or ``"dup"``; raises ServerCrash
+    for a ``crash`` plan and :class:`FaultDrop` for a ``drop`` plan;
+    sleeps for a ``delay`` plan. Never journals directly — safe inside
+    locked protocol sections (the lock_lint contract)."""
+    if not _PLANS:
+        return None
+    plan = _arm(point, ctx)
+    if plan is None:
+        return None
+    if plan.action == "delay":
+        time.sleep(plan.delay_s)
+        return None
+    if plan.action == "drop":
+        raise FaultDrop("injected drop at fault point %r (hit %d)"
+                        % (point, plan.hits))
+    if plan.action == "crash":
+        from ..distributed.rpc import ServerCrash
+        raise ServerCrash("injected crash at fault point %r (hit %d)"
+                          % (point, plan.hits))
+    return "dup"
+
+
+def decide(point: str, **ctx) -> Optional[str]:
+    """Shim surface for injectors with their OWN mechanics (the
+    NetFaultProxy): consult the plans like ``faultpoint`` but return
+    the action name instead of performing it. The firing is journaled
+    identically."""
+    if not _PLANS:
+        return None
+    plan = _arm(point, ctx)
+    return plan.action if plan is not None else None
+
+
+def record(point: str, action: str, **ctx) -> None:
+    """Journal a fault an EXTERNAL mechanism injected (the legacy
+    knobs riding the plane as shims: NetFaultProxy armed one-shot
+    faults, env-var kills). Queued like a plan firing — one journal
+    shape, ``fault_injected``, for every injection in the system."""
+    rec = dict(point=point, action=action,
+               protocol=protocol_of(point), shim=True)
+    rec.update({k: v for k, v in ctx.items()
+                if isinstance(v, (str, int, float, bool))})
+    with _MU:
+        _FIRED.append(rec)
+        _PENDING.append(rec)
+        _ensure_flusher_locked()
+
+
+def flush_events() -> int:
+    """Emit every queued ``fault_injected`` journal event. The ONLY
+    emitting function of the plane — must never run under a lock
+    (``faultpoint()`` fires inside locked protocol sections and only
+    queues). The background flusher drains continuously; harnesses
+    call it directly before reading the journal."""
+    with _MU:
+        q, _PENDING[:] = list(_PENDING), []
+    for rec in q:
+        _obs.emit("fault_injected", **rec)
+    return len(q)
+
+
+def _flush_loop():
+    # retire after ~1 s with no plans armed and nothing queued; a
+    # later install() starts a fresh flusher
+    idle = 0
+    while idle < 50:
+        time.sleep(0.02)
+        if flush_events():
+            idle = 0
+        elif not _PLANS:
+            idle += 1
+
+
+def _ensure_flusher_locked():
+    global _FLUSHER
+    if _FLUSHER is None or not _FLUSHER.is_alive():
+        _FLUSHER = threading.Thread(target=_flush_loop, daemon=True,
+                                    name="faultpoint-flusher")
+        _FLUSHER.start()

@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import observability as _obs
+from ..chaos import faultpoints as _faults
 from ..core.enforce import InvalidArgumentError, enforce
 from ..io import deserialize_tensor, serialize_tensor
 from ..parallel.collectives import (SPARSE_Q8_MIN_DIM,
@@ -931,11 +932,24 @@ class LookupServiceClient:
         caller's problem."""
         if self.topology is None:
             raise exc
+        try:
+            act = _faults.faultpoint("reshard.client_refetch",
+                                     table=self.table,
+                                     tid=self.trainer_id)
+        except _faults.FaultDrop:
+            # the refetch round is 'lost': keep the stale map — the
+            # pull/push retry loop fences again next attempt (bounded
+            # by _RESHARD_RETRIES)
+            return
         eps = list(self.topology())
         _obs.emit("sparse_shard_map_fenced", table=self.table,
                   tid=self.trainer_id, n_shards=len(eps),
                   reason=str(exc))
         self.apply_reshard(eps)
+        if act == "dup":
+            # duplicated refetch: adopting the same map twice is
+            # idempotent (clients rebuilt, caches re-dropped)
+            self.apply_reshard(list(self.topology()))
 
     # -- pull path ----------------------------------------------------------
     def _rpc_pull(self, ids: np.ndarray) -> np.ndarray:

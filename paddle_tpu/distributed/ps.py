@@ -46,6 +46,7 @@ from ..engine import HostStage
 from ..observability import trace as _trace
 from ..io import (deserialize_tensor, durable_publish_dir,
                   remove_marked_dir, serialize_tensor)
+from ..chaos import faultpoints as _faults
 from ..resilience.retry import RetryBudgetExhausted, RetryPolicy
 from .rpc import (STATUS_ABORTED, STATUS_ERROR, STATUS_EVICTED,
                   STATUS_RESHARDED, RPCClient, RPCServer, RpcError,
@@ -231,7 +232,6 @@ class ListenAndServ:
         self._aborted = None
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
-        self._crash_at: Dict[str, int] = {}
         # control-plane quarantine (observability/control.py): while
         # set, the lease monitor's EVICTION authority is suspended —
         # on a network_flaky verdict the lossy wire, not the trainers,
@@ -270,7 +270,23 @@ class ListenAndServ:
         self._pending_joins: List = []   # [(tid, token, responder)]
         self._join_grants: Dict[str, int] = {}   # token -> granted tid
         self._joined = set()             # tids ADMITTED via JOIN
+        # the barrier/membership UNIVERSE: the initial tids plus every
+        # tid actually ADMITTED via JOIN. ``n_trainers`` stays the
+        # watermark (max tid + 1, never recycled) — but an aborted 2PC
+        # attempt can leave a granted-never-admitted HOLE below the
+        # watermark, so quorums count members, not the watermark, or a
+        # barrier would wait forever on a tid that never stepped
+        self._members = set(range(n_trainers))
         self._join_outbox: List = []     # [(responder, reply bytes)]
+        # admission epoch per admitted joiner: the barrier fence value
+        # at the admitting boundary. The 2PC joiner compares it across
+        # shards — every shard must vote the SAME epoch or the
+        # transaction aborts (a shard admitting at a different step
+        # boundary would split the quorums)
+        self._join_epochs: Dict[int, int] = {}
+        # joined tids whose first contributing merge already fired the
+        # join.first_merge fault point
+        self._merged_joiners = set()
         # shard-map filter: None = this server owns every row addressed
         # to it (the pre-elastic contract, fully backward compatible);
         # (n_shards, index) after a reshard — rows outside the slice
@@ -314,6 +330,32 @@ class ListenAndServ:
                 self.n_trainers,
                 int(restore_meta.get("n_trainers",
                                      self.n_trainers) or 0))
+            self._members = set(
+                int(t) for t in restore_meta.get(
+                    "members", range(self.n_trainers)))
+            # reshard x snapshot fencing: the shard map is part of the
+            # durable boundary. A restored server re-enters the epoch
+            # the snapshot belongs to — explicit ctor args win (the
+            # supervisor knows better), the meta fills the rest
+            part = restore_meta.get("partition")
+            if part and self._partition is None:
+                self._partition = (int(part[0]), int(part[1]))
+            if "standby" in restore_meta:
+                # the durable boundary knows whether this shard had
+                # activated; a restart's ctor default must not fence a
+                # shard that was already authority (or vice versa)
+                self._standby = bool(restore_meta["standby"])
+            # a migration that was in flight at the snapshot died with
+            # the process BEFORE its activate: the restored state is
+            # the PRE-cutover epoch (old map, old rows — consistent).
+            # Ledger the implicit abort so doctor can explain the
+            # coordinator's failed cutover
+            for tname, nonce in sorted(
+                    (restore_meta.get("migrations_inflight")
+                     or {}).items()):
+                self._event("reshard_aborted", table=tname,
+                            nonce=str(nonce),
+                            reason="restored_pre_cutover")
 
         s = self.server
         s.register("SEND", self._on_send)
@@ -410,33 +452,37 @@ class ListenAndServ:
     def crash_after(self, verb: str, n: int):
         """Chaos seam: hard-kill the server (sockets closed, nothing
         answered — a SIGKILL stand-in) the moment the n-th subsequent
-        request of ``verb`` arrives, BEFORE it mutates any state."""
-        self._crash_at[verb] = int(n)
+        request of ``verb`` arrives, BEFORE it mutates any state.
+
+        A shim over the fault-point plane since PR 20: installs a
+        deterministic plan on the dynamic point ``rpc.<verb>`` scoped
+        to this endpoint, so the kill is journaled as
+        ``fault_injected`` like every other injection (the plan is
+        one-shot — a restarted server on the same endpoint does not
+        re-crash)."""
+        _faults.install(_faults.FaultPlan(
+            "rpc." + verb, "crash", at=int(n),
+            where={"endpoint": self.endpoint}))
         return self
 
     def _chaos_tick(self, verb):
-        n = self._crash_at.get(verb)
-        if n is None:
-            return
-        n -= 1
-        if n <= 0:
-            self._crash_at.pop(verb)
-            raise ServerCrash("injected pserver kill on %s" % verb)
-        self._crash_at[verb] = n
+        _faults.faultpoint("rpc." + verb, endpoint=self.endpoint)
 
     # -- quorum bookkeeping (all _locked: caller holds self._mu) ------------
     def _quorum_locked(self):
         # union, not sum: a trainer can be BOTH evicted and completed
         # (a slow-but-alive evictee's COMPLETE still lands) and must
-        # shrink the quorum exactly once
-        gone = len(self._evicted | self._completed_tids | self._left)
-        return max(0, self.n_trainers - gone - self._completed)
+        # shrink the quorum exactly once. Counted over _members, not
+        # the n_trainers watermark: a granted-never-admitted tid (an
+        # aborted 2PC JOIN attempt) must not be waited for
+        gone = self._evicted | self._completed_tids | self._left
+        return max(0, len(self._members - gone) - self._completed)
 
     def _active_tids_locked(self):
         # trainer ids are 0..n-1 (the launcher's PADDLE_TRAINER_ID
         # contract, grown by JOIN admissions), so the active universe
         # is knowable server-side
-        return (set(range(self.n_trainers)) - self._evicted
+        return (self._members - self._evicted
                 - self._completed_tids - self._left)
 
     def _touch_lease_locked(self, tid):
@@ -519,6 +565,16 @@ class ListenAndServ:
             # Sorting keeps sync runs bit-reproducible under faults
             # and across elastic membership changes.
             entries = self._pending.pop(name)
+            fresh = sorted(t for t, _ in entries
+                           if t in self._joined
+                           and t not in self._merged_joiners)
+            if fresh:
+                # a joiner's FIRST contributing merge: the transition
+                # that makes the admission irreversible-by-abort
+                _faults.faultpoint("join.first_merge",
+                                   endpoint=self.endpoint,
+                                   tid=int(fresh[0]))
+                self._merged_joiners.update(fresh)
             entries.sort(key=lambda e: (e[0] is None, e[0] or 0))
             merged = np.sum([g for _, g in entries], axis=0)
             self._apply(name, merged)
@@ -622,6 +678,8 @@ class ListenAndServ:
             self._maybe_snapshot_locked()
         if self._pending_joins and "send" not in bases:
             self._admit_joiners_locked()
+        _faults.faultpoint("barrier.release", endpoint=self.endpoint,
+                           bases=",".join(sorted(bases)))
         return waiters
 
     def _release(self, waiters, status=0, msg=b""):
@@ -638,6 +696,12 @@ class ListenAndServ:
         self._boundary += 1
         if self._boundary % self._snapshot_every:
             return
+        self._snapshot_now_locked()
+
+    def _snapshot_now_locked(self):
+        _faults.faultpoint("snapshot.boundary_begin",
+                           endpoint=self.endpoint,
+                           boundary=self._boundary)
         meta = {
             "send_seqs": self._seen_send.to_meta(),
             "completed": sorted(self._completed_tids),
@@ -653,6 +717,20 @@ class ListenAndServ:
                                  self._barrier_released.items()},
             "left": sorted(self._left),
             "n_trainers": int(self.n_trainers),
+            # the membership universe (admitted joiners included, a
+            # granted-never-admitted hole excluded): quorums count
+            # members, and a restore must not resurrect holes
+            "members": sorted(self._members),
+            # reshard x snapshot fencing: the shard map travels in the
+            # same durable boundary as the rows it routes, and any
+            # cutover still in flight (prepared/sealed, NOT activated)
+            # is recorded so a restore can ledger its implicit abort
+            "partition": (list(self._partition)
+                          if self._partition is not None else None),
+            "standby": bool(self._standby),
+            "migrations_inflight": {
+                t: str(m.get("nonce") or "")
+                for t, m in self._migrations.items()},
         }
         if self._snapshot_tables:
             # table state lands in the same durable dir (snapshot_fn),
@@ -720,12 +798,20 @@ class ListenAndServ:
         n = self.n_trainers
         for tid, _tok, _r in self._pending_joins:
             n = max(n, tid + 1)
+        # parked 2PC grants (not yet committed on this shard) also
+        # reserve their tid — a fresh grant must never alias one
+        for tid in self._join_grants.values():
+            n = max(n, tid + 1)
         return n
 
     def _join_reply_locked(self, tid):
         return json.dumps({"tid": int(tid),
                            "n_trainers": int(self.n_trainers),
-                           "boundary": int(self._boundary)}).encode()
+                           "boundary": int(self._boundary),
+                           # the shard's admission VOTE (see
+                           # _join_epochs); -1 = not admitted yet
+                           "epoch": int(self._join_epochs.get(tid, -1)),
+                           }).encode()
 
     def _admit_joiners_locked(self):
         """Grow membership at this instant (a step boundary or a
@@ -733,15 +819,46 @@ class ListenAndServ:
         merge readiness rule and the barrier quorum all move together
         under the lock. Replies park in the outbox and go out via
         ``_flush_joins`` AFTER the lock drops."""
+        try:
+            _faults.faultpoint("join.admit", endpoint=self.endpoint,
+                               joiners=len(self._pending_joins))
+        except _faults.FaultDrop:
+            # the admit decision is 'lost': fail the parked commits so
+            # the joiner aborts (and retries); the grants stay PARKED —
+            # membership is untouched, never half-admitted
+            for _tid, _token, responder in self._pending_joins:
+                self._join_outbox.append((responder, None))
+            self._pending_joins = []
+            self._event_locked("trainer_join_aborted", tid=-1,
+                               rolled="parked",
+                               reason="fault_drop@join.admit")
+            return
+        # one vote value per admitting boundary: the max barrier fence
+        # is identical across shards at the same step boundary (every
+        # trainer barriers every shard each phase), so equal epochs
+        # across ACKs prove the shards admitted at the SAME step
+        epoch = max(self._barrier_released.values(), default=0)
         for tid, _token, responder in self._pending_joins:
             self.n_trainers = max(self.n_trainers, tid + 1)
             self._joined.add(tid)
+            self._members.add(tid)
+            self._join_epochs[tid] = epoch
             self._event_locked("trainer_joined", tid=tid,
                                n_trainers=self.n_trainers,
-                               boundary=self._boundary)
+                               boundary=self._boundary,
+                               epoch=epoch)
             self._join_outbox.append(
                 (responder, self._join_reply_locked(tid)))
+        admitted = bool(self._pending_joins)
         self._pending_joins = []
+        if admitted and self._snapshot_fn is not None:
+            # admission must be DURABLE before the commit-acks go out:
+            # a crash after the joiner starts stepping would otherwise
+            # restore a pre-admission snapshot that has forgotten the
+            # member — the joiner's replayed sends then buffer outside
+            # any quorum and its barriers pair half a step off
+            self._boundary += 1
+            self._snapshot_now_locked()
 
     def _flush_joins(self):
         if not self._join_outbox:
@@ -749,7 +866,11 @@ class ListenAndServ:
         with self._mu:
             q, self._join_outbox = self._join_outbox, []
         for responder, reply in q:
-            responder(0, reply)
+            if reply is None:
+                responder(STATUS_ERROR,
+                          b"JOIN admission dropped (injected fault)")
+            else:
+                responder(0, reply)
 
     def _on_join(self, name, payload, responder):
         """Admit a NEW trainer (deferred): the grant parks until the
@@ -758,12 +879,30 @@ class ListenAndServ:
         step's merges require the joiner, and the sync loss trajectory
         stays exact. Idempotent by ``token``: a lossy-wire replay
         re-acks the original grant (or supersedes the still-parked
-        responder) instead of admitting twice."""
+        responder) instead of admitting twice.
+
+        Phased requests carry the cross-shard admission transaction
+        (``join_running_job`` over >= 2 dense pservers): ``park``
+        grants a tid WITHOUT admissibility and acks at once;
+        ``commit`` makes the grant admissible — the ack goes out at
+        this shard's next non-SEND barrier release and carries the
+        admission epoch, the shard's VOTE; ``abort`` rolls a
+        committed-but-unadmitted grant back to parked and drains an
+        already-admitted one back out of membership (the LEAVE
+        mechanics). No phase = the legacy fused park+commit."""
         self._drain_beacon.bump()
         self._chaos_tick("JOIN")
         req = json.loads(payload.decode() or "{}")
         token = str(req.get("token") or "")
         want = req.get("tid")
+        phase = str(req.get("phase") or "")
+        if phase == "park":
+            return self._join_park(token, want, responder)
+        if phase == "commit":
+            return self._join_commit(token, want, responder)
+        if phase == "abort":
+            return self._join_abort(token, responder)
+        enforce(not phase, "unknown JOIN phase %r" % phase)
         stale = granted = None
         with self._mu:
             if self._aborted is not None:
@@ -812,6 +951,172 @@ class ListenAndServ:
             responder(0, granted)
         self._flush_joins()
 
+    def _join_park(self, token, want, responder):
+        """2PC phase 1: grant (or re-ack) a parked tid. A parked
+        grant reserves the tid but is NOT admissible — membership,
+        quorum and merges are untouched until commit."""
+        dup = _faults.faultpoint("join.park", endpoint=self.endpoint,
+                                 token=token) == "dup"
+        if not token:
+            raise StatusReply(STATUS_ERROR,
+                              b"JOIN park requires a token")
+        with self._mu:
+            if self._aborted is not None:
+                raise StatusReply(STATUS_ABORTED,
+                                  ("BarrierAborted: %s"
+                                   % self._aborted).encode())
+            if token in self._join_grants:
+                tid = self._join_grants[token]
+                self._event_locked("dup_join_ack", tid=tid)
+            else:
+                tid = int(want) if want is not None \
+                    else self._next_tid_locked()
+                if tid < self.n_trainers or any(
+                        t == tid for t, _, _ in self._pending_joins) \
+                        or tid in self._join_grants.values():
+                    raise StatusReply(
+                        STATUS_ERROR,
+                        ("JOIN park: trainer id %d is not fresh on %s "
+                         "(n_trainers=%d)" % (tid, self.endpoint,
+                                              self.n_trainers))
+                        .encode())
+                self._join_grants[token] = tid
+                self._event_locked("trainer_join_parked", tid=tid,
+                                   n_trainers=self.n_trainers,
+                                   boundary=self._boundary)
+            reply = self._join_reply_locked(tid)
+        self._flush_events()
+        responder(0, reply)
+        if dup:
+            # network-duplicated park: re-run the idempotent grant
+            # path — it must re-ack the same tid, never grant twice
+            self._join_park(token, want, lambda *_a: None)
+
+    def _join_commit(self, token, want, responder):
+        """2PC phase 2: make a parked grant admissible. The reply is
+        DEFERRED to this shard's next admitting boundary (non-SEND
+        barrier release, or now if provably idle) and carries the
+        admission epoch — the shard's vote."""
+        stale = granted = None
+        with self._mu:
+            if self._aborted is not None:
+                raise StatusReply(STATUS_ABORTED,
+                                  ("BarrierAborted: %s"
+                                   % self._aborted).encode())
+            tid = self._join_grants.get(token)
+            if tid is None:
+                raise StatusReply(
+                    STATUS_ERROR,
+                    b"JOIN commit without a parked grant "
+                    b"(server restarted mid-transaction?)")
+            if want is not None and int(want) != tid:
+                raise StatusReply(
+                    STATUS_ERROR,
+                    ("JOIN commit tid mismatch: granted %d, "
+                     "committing %r" % (tid, want)).encode())
+            if tid in self._left or tid in self._evicted:
+                raise StatusReply(
+                    STATUS_ERROR,
+                    ("JOIN commit for retired trainer %d" % tid)
+                    .encode())
+            if tid in self._joined:
+                # replay of a commit whose admission ack was lost
+                self._event_locked("dup_join_ack", tid=tid)
+                granted = self._join_reply_locked(tid)
+            else:
+                for k, (t, tok, r) in enumerate(self._pending_joins):
+                    if tok == token:
+                        stale = r
+                        self._pending_joins[k] = (t, tok, responder)
+                        break
+                else:
+                    self._pending_joins.append((tid, token,
+                                                responder))
+                    self._event_locked("trainer_join_request",
+                                       tid=tid,
+                                       n_trainers=self.n_trainers,
+                                       boundary=self._boundary)
+                if self._can_admit_now_locked():
+                    self._admit_joiners_locked()
+        self._flush_events()
+        if stale is not None:
+            stale(STATUS_ABORTED,
+                  b"BarrierAborted: superseded by replayed JOIN "
+                  b"commit")
+        if granted is not None:
+            responder(0, granted)
+        self._flush_joins()
+
+    def _join_abort(self, token, responder):
+        """2PC rollback: a committed-but-unadmitted grant is REAPED
+        (the joiner renounced it — the tid returns to the pool instead
+        of leaking a parked watermark hole); an already-ADMITTED grant
+        is drained back out of membership with the LEAVE mechanics, so
+        a half-admitted transaction across shards always converges to
+        'joiner out, survivors exact'. Idempotent by token."""
+        release = stale_commit = stale_barrier = None
+        rolled = "none"
+        drained = 0
+        with self._mu:
+            tid = self._join_grants.pop(token, None)
+            if tid is not None:
+                for k, (t, tok, r) in enumerate(self._pending_joins):
+                    if tok == token:
+                        stale_commit = r
+                        del self._pending_joins[k]
+                        rolled = "parked"
+                        break
+                if tid in self._joined and tid not in self._left:
+                    # this shard already voted: drain the joiner back
+                    # out — quorum shrinks at this boundary, partial
+                    # grads drained, survivor merges stay exact
+                    stale_barrier, drained = \
+                        self._retire_tid_locked(tid)
+                    rolled = "drained"
+                elif tid in self._left:
+                    rolled = "drained"   # replayed abort: already out
+                elif rolled == "none":
+                    rolled = "parked"
+                self._event_locked("trainer_join_aborted", tid=tid,
+                                   rolled=rolled,
+                                   n_trainers=self.n_trainers,
+                                   drained_partials=drained)
+                if rolled == "drained":
+                    for nm in list(self._pending):
+                        self._maybe_merge_locked(nm)
+                    release = self._maybe_release_barrier_locked()
+        self._flush_events()
+        if stale_commit is not None:
+            stale_commit(STATUS_ABORTED,
+                         b"BarrierAborted: join aborted by joiner")
+        if stale_barrier is not None:
+            stale_barrier[-1](STATUS_ABORTED,
+                              b"BarrierAborted: join aborted")
+        self._release(release)
+        self._flush_joins()
+        responder(0, json.dumps({"aborted": tid is not None,
+                                 "rolled": rolled}).encode())
+
+    def _retire_tid_locked(self, tid):
+        """Shared shrink mechanics for LEAVE and JOIN rollback of an
+        admitted grant: retire the lease, unpark the tid's barrier
+        waiter (returned for an out-of-lock abort reply), and drain
+        its partial-step grads — discarded, never summed into a
+        smaller-quorum merge. Caller re-evaluates merges + barriers
+        and emits its own event."""
+        self._left.add(tid)
+        self._leases.pop(tid, None)
+        stale = self._barrier_waiters.pop(("t", tid), None)
+        drained = 0
+        for nm, entries in list(self._pending.items()):
+            kept = [(t, g) for t, g in entries if t != tid]
+            drained += len(entries) - len(kept)
+            if kept:
+                self._pending[nm] = kept
+            else:
+                self._pending.pop(nm)
+        return stale, drained
+
     def _on_leave(self, name, payload):
         """Graceful membership shrink — the eviction path's twin
         without the forged-merge hazard: the leaver's partial-step
@@ -828,17 +1133,7 @@ class ListenAndServ:
         release = stale = None
         with self._mu:
             if tid not in self._left:
-                self._left.add(tid)
-                self._leases.pop(tid, None)
-                stale = self._barrier_waiters.pop(("t", tid), None)
-                drained = 0
-                for nm, entries in list(self._pending.items()):
-                    kept = [(t, g) for t, g in entries if t != tid]
-                    drained += len(entries) - len(kept)
-                    if kept:
-                        self._pending[nm] = kept
-                    else:
-                        self._pending.pop(nm)
+                stale, drained = self._retire_tid_locked(tid)
                 self._event_locked("trainer_left", tid=tid,
                                    boundary=self._boundary,
                                    n_trainers=self.n_trainers,
@@ -1351,10 +1646,14 @@ class Communicator:
     def recv(self, name) -> np.ndarray:
         return self.client(self.placement[name]).get_var(name)
 
-    def barrier_all(self, name="step"):
+    def barrier_all(self, name="step", seqs=None):
+        """``seqs`` (endpoint -> epoch) lets a replayed phase reuse the
+        epochs its first attempt consumed, so the server's replay fence
+        re-acks instead of parking a forged second waiter."""
         for ep in sorted(set(self.placement.values())):
-            self.client(ep).barrier(
-                name, seq=self.next_barrier_seq(ep))
+            seq = seqs[ep] if seqs is not None \
+                else self.next_barrier_seq(ep)
+            self.client(ep).barrier(name, seq=seq)
 
     def complete_all(self):
         for ep in sorted(set(self.placement.values())):
@@ -1579,9 +1878,18 @@ class SparsePServer:
     def _snapshot(self, boundary, meta):
         self._snap.save(boundary, _pack_table_arrays(self.tables),
                         meta)
-        # durable save SUCCEEDED: only now may spill GC advance
-        for t in self.tables.values():
-            t.gc_boundary()
+        _faults.faultpoint("snapshot.boundary_commit",
+                           endpoint=self.endpoint, boundary=boundary)
+        # durable save SUCCEEDED: only now may spill GC advance — and
+        # never while a cutover is in flight: a crash before activate
+        # restores the PRE-cutover epoch, whose spill horizons must
+        # still be readable
+        if not self.serv._migrations:
+            _faults.faultpoint("snapshot.gc_advance",
+                               endpoint=self.endpoint,
+                               boundary=boundary)
+            for t in self.tables.values():
+                t.gc_boundary()
 
     def start(self):
         self.serv.start()
@@ -1675,9 +1983,17 @@ class PServerRuntime:
         # contract): resident rows + adagrad state + spill horizon
         arrays.update(_pack_table_arrays(self._tables))
         self._snap.save(boundary, arrays, meta)
-        # durable save SUCCEEDED: only now may spill GC advance
-        for t in self._tables.values():
-            t.gc_boundary()
+        _faults.faultpoint("snapshot.boundary_commit",
+                           endpoint=self.serv.endpoint,
+                           boundary=boundary)
+        # durable save SUCCEEDED: only now may spill GC advance — but
+        # never past an in-flight cutover (see SparsePServer._snapshot)
+        if not self.serv._migrations:
+            _faults.faultpoint("snapshot.gc_advance",
+                               endpoint=self.serv.endpoint,
+                               boundary=boundary)
+            for t in self._tables.values():
+                t.gc_boundary()
 
     def _optimize(self, bname, grad):
         if self.dc_asgd:
@@ -1926,6 +2242,18 @@ class ParameterServerRuntime:
         seqs = {b["name"]:
                 self.comm.next_seq(self.comm.placement[b["name"]])
                 for bs in self.blocks.values() for b in bs}
+        # barrier epochs are pre-assigned ONCE per step for the same
+        # reason: a replayed barrier with a FRESH epoch defeats the
+        # server's replay fence and parks as a second waiter — after
+        # an elastic JOIN admitted mid-replay, that forged waiter
+        # pairs with the joiner's first real barrier and skews every
+        # later merge by half a step
+        bseqs = {}
+        if self.sync_mode:
+            eps = sorted(set(self.comm.placement.values()))
+            bseqs = {b: {ep: self.comm.next_barrier_seq(ep)
+                         for ep in eps}
+                     for b in ("send", "fetch")}
 
         def send(ep, blocks):
             client = self.comm.client(ep)
@@ -1943,10 +2271,10 @@ class ParameterServerRuntime:
         def phase():
             self._per_endpoint(send)
             if self.sync_mode:
-                self.comm.barrier_all("send")
+                self.comm.barrier_all("send", seqs=bseqs["send"])
             self._per_endpoint(recv)
             if self.sync_mode:
-                self.comm.barrier_all("fetch")
+                self.comm.barrier_all("fetch", seqs=bseqs["fetch"])
 
         self._replay_phase(phase, "step")
         for pname, bs in self.blocks.items():
@@ -1993,9 +2321,118 @@ class ParameterServerRuntime:
         _obs.emit("trainer_leave", tid=self.trainer_id)
 
 
+def _join_sync_two_phase(eps, base_token, deadline_s, attempts):
+    """Cross-shard admission transaction (docs/resilience.md §Elastic
+    membership): sync-mode JOIN over N dense pservers.
+
+    Phase 1 (PARK): every shard, in endpoint order, grants and parks
+    the SAME fresh tid — parked grants reserve the tid but leave
+    membership, quorum and merges untouched. Phase 2 (COMMIT): every
+    shard is asked to admit; each admits at its own next non-SEND
+    barrier release and its deferred ack carries the admission EPOCH
+    (the barrier fence at that boundary) — the shard's vote. Because
+    every trainer barriers every shard each phase, the fences advance
+    in lockstep, so equal epochs across all acks prove every shard
+    admitted at the SAME step boundary.
+
+    Any park/commit failure (a crashed shard, a dropped message, a
+    refused grant, a commit deadline) or an epoch disagreement ABORTs:
+    every shard rolls the joiner back — committed-but-unadmitted
+    grants return to parked, an already-admitted shard drains the
+    joiner back out with the LEAVE mechanics (quorum re-shrinks at a
+    boundary, survivor merges stay exact) — and the transaction
+    retries with a fresh token, up to ``attempts`` times. The joiner
+    is never half-admitted: it is either in on every shard at one
+    epoch, or out everywhere."""
+    last_err = None
+    for attempt in range(max(1, attempts)):
+        token = base_token if attempt == 0 \
+            else "%s.r%d" % (base_token, attempt)
+        clients = {}
+        # deadline_s bounds the whole transaction ATTEMPT by wall
+        # clock, not each RPC: the retry budget below is 7 attempts,
+        # so a per-call deadline of the full budget would let ONE
+        # dead shard burn 7x deadline_s before the abort even starts
+        t_end = time.monotonic() + deadline_s
+
+        def _call_deadline():
+            return max(0.2, (t_end - time.monotonic()) / 7.0)
+
+        def _client(ep):
+            if ep not in clients:
+                # connect timeout rides the same 7-way split: each
+                # retry RECONNECTS, and a dead shard's connect burns
+                # the full window every time
+                clients[ep] = RPCClient(
+                    ep,
+                    timeout_s=max(0.2, min(10.0, deadline_s / 7.0)),
+                    deadline_s=deadline_s,
+                    retry=RetryPolicy(max_retries=6, base_delay=0.05,
+                                      max_delay=0.5, seed=0xE1A57))
+            return clients[ep]
+
+        tid = None
+        try:
+            for ep in eps:
+                g = _client(ep).join(token, tid=tid, phase="park",
+                                     deadline_s=_call_deadline())
+                if tid is None:
+                    tid = int(g["tid"])
+                else:
+                    enforce(int(g["tid"]) == tid,
+                            "JOIN park grant mismatch across "
+                            "pservers: %r vs tid %d" % (g, tid))
+            # commits run concurrently: each shard defers its ack to
+            # its own admitting boundary, and those boundaries only
+            # arrive while the incumbents keep stepping — serial
+            # commits would wait on votes the next request unlocks
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(eps)) as pool:
+                # commits legitimately WAIT (the ack is deferred to
+                # the shard's admitting boundary): full remaining
+                # budget, not the per-call split
+                rem = max(0.2, t_end - time.monotonic())
+                futs = [(ep, pool.submit(_client(ep).join, token,
+                                         tid=tid, phase="commit",
+                                         deadline_s=rem))
+                        for ep in eps]
+                grants = {ep: f.result() for ep, f in futs}
+            epochs = {int(g.get("epoch", -1))
+                      for g in grants.values()}
+            enforce(len(epochs) == 1 and -1 not in epochs,
+                    "JOIN admission epoch disagreement across "
+                    "shards: %r" % {ep: g.get("epoch")
+                                    for ep, g in grants.items()})
+            _obs.emit("trainer_join_committed", tid=tid,
+                      token=token, shards=len(eps),
+                      epoch=next(iter(epochs)), attempt=attempt)
+            return tid, grants[eps[0]]
+        except Exception as e:
+            last_err = e
+            # roll EVERY shard back before retrying: the joiner must
+            # never stay half-admitted across the fleet
+            for ep in eps:
+                try:
+                    _client(ep).join(token, tid=tid, phase="abort",
+                                     deadline_s=_call_deadline())
+                except Exception:
+                    pass
+            _obs.emit("trainer_join_rollback", token=token,
+                      tid=-1 if tid is None else int(tid),
+                      attempt=attempt, shards=len(eps),
+                      error=repr(e))
+        finally:
+            for c in clients.values():
+                try:
+                    c.close()
+                except Exception:
+                    pass
+    raise last_err
+
+
 def join_running_job(transpiler, program, scope, sync_mode=True,
                      token=None, join_deadline_s=60.0,
-                     **runtime_kwargs):
+                     join_attempts=3, **runtime_kwargs):
     """Admit THIS process as a NEW trainer into a RUNNING PS job and
     return a ready-to-step ParameterServerRuntime (the elastic grow
     path).
@@ -2009,50 +2446,71 @@ def join_running_job(transpiler, program, scope, sync_mode=True,
     boundary state — newest snapshot + everything the replay window
     already applied).
 
-    Sync mode supports a SINGLE dense pserver: multi-server sync
-    admission would need the servers to agree on one admission
-    boundary (each admits at its own barrier release, and a joiner
-    waiting on server B's grant while server A already counts it
-    deadlocks the fetch quorum — see docs/resilience.md §Elastic
-    membership). Async mode joins any number of servers.
+    Sync mode over >= 2 dense pservers runs the cross-shard admission
+    transaction (``_join_sync_two_phase``): all shards park the
+    joiner, the admit lands only when every shard votes the same
+    admission epoch at its non-SEND barrier release, and any refusal
+    or crash mid-admit rolls the joiner back to parked and retries
+    (``join_attempts``). A single pserver (or async mode) keeps the
+    one-shot grant path.
 
     The returned runtime carries ``join_grant`` (the server's grant
-    dict) and ``join_seconds`` (join request -> ready to contribute)
-    — the ``elastic_join_catchup`` bench row."""
+    dict), ``join_seconds`` (join request -> ready to contribute, the
+    ``elastic_join_catchup`` bench row) and ``join_admit_seconds``
+    (request -> every shard voted, the ``join_commit_latency`` bench
+    row)."""
     import uuid as _uuid
     blocks = transpiler.block_table()
     eps = sorted({b["endpoint"] for bs in blocks.values()
                   for b in bs})
-    enforce(not sync_mode or len(eps) == 1,
-            "sync-mode JOIN supports a single dense pserver (got %d:"
-            " servers cannot agree on an admission boundary without "
-            "cross-server coordination)" % len(eps))
-    token = token or _uuid.uuid4().hex
+    base_token = token or _uuid.uuid4().hex
     t0 = time.monotonic()
-    tid = grant = None
-    for ep in eps:
-        c = RPCClient(ep, deadline_s=join_deadline_s,
-                      retry=RetryPolicy(max_retries=6,
-                                        base_delay=0.05,
-                                        max_delay=0.5, seed=0xE1A57))
-        try:
-            grant = c.join(token, tid=tid)
-        finally:
-            c.close()
-        if tid is None:
-            tid = int(grant["tid"])
-        else:
-            enforce(int(grant["tid"]) == tid,
-                    "JOIN grant mismatch across pservers: %r vs tid "
-                    "%d" % (grant, tid))
+    if sync_mode and len(eps) > 1:
+        tid, grant = _join_sync_two_phase(
+            eps, base_token, join_deadline_s, join_attempts)
+    else:
+        tid = grant = None
+        for ep in eps:
+            c = RPCClient(ep, deadline_s=join_deadline_s,
+                          retry=RetryPolicy(max_retries=6,
+                                            base_delay=0.05,
+                                            max_delay=0.5,
+                                            seed=0xE1A57))
+            try:
+                grant = c.join(base_token, tid=tid)
+            finally:
+                c.close()
+            if tid is None:
+                tid = int(grant["tid"])
+            else:
+                enforce(int(grant["tid"]) == tid,
+                        "JOIN grant mismatch across pservers: %r vs "
+                        "tid %d" % (grant, tid))
+    admit_s = time.monotonic() - t0
     rt = ParameterServerRuntime(transpiler, program, scope,
                                 sync_mode=sync_mode, trainer_id=tid,
                                 **runtime_kwargs)
-    rt.init_params()
+    for attempt in (0, 1):
+        try:
+            act = _faults.faultpoint("join.catchup_pull", tid=tid)
+            rt.init_params()
+            if act == "dup":
+                # duplicated catch-up pull: adopting the authority
+                # twice is idempotent (reads, no writes)
+                rt.init_params()
+            break
+        except _faults.FaultDrop:
+            if attempt:
+                raise
+            # the catch-up pull was 'lost': one straight retry — the
+            # authority params are still there to adopt
+            continue
     rt.join_grant = grant
     rt.join_seconds = time.monotonic() - t0
+    rt.join_admit_seconds = admit_s
     _obs.emit("trainer_join_catchup", tid=tid,
               seconds=round(rt.join_seconds, 6),
+              admit_seconds=round(admit_s, 6),
               boundary=(grant or {}).get("boundary"))
     return rt
 

@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import observability as _obs
+from ..chaos import faultpoints as _faults
 from ..core.enforce import enforce
 from ..io import deserialize_tensor, serialize_tensor
 from .rpc import RPCClient
@@ -171,6 +172,8 @@ def handle_prepare(serv, table_name: str, req: dict, responder):
     tracking arms HERE, on the drain thread, before the stream thread
     spawns — every push racing the bulk stream is recorded and
     re-sent by commit's delta."""
+    _faults.faultpoint("reshard.prepare", endpoint=serv.endpoint,
+                       table=table_name)
     table = serv._table(table_name)
     n_dst = int(req["n_dst"])
     src_index = int(req["src_index"])
@@ -179,6 +182,11 @@ def handle_prepare(serv, table_name: str, req: dict, responder):
             "reshard prepare: %d dst endpoints for n_dst=%d"
             % (len(dsts), n_dst))
     mig = {
+        # the coordinator's cutover nonce: commit and activate must
+        # present it back, so a server that LOST the migration (crash
+        # + restore between phases) refuses the stale cutover instead
+        # of activating onto inconsistent rows
+        "nonce": str(req.get("nonce") or ""),
         "n_dst": n_dst,
         "src_index": src_index,
         "dst_endpoints": dsts,
@@ -216,10 +224,17 @@ def handle_commit(serv, table_name: str, req: dict) -> bytes:
     (pushes to moving rows now fence with STATUS_RESHARDED), then
     stream the dirty∩moving delta. After this returns, the new owners
     hold every moving row's final state."""
+    _faults.faultpoint("reshard.seal", endpoint=serv.endpoint,
+                       table=table_name)
     mig = serv._migrations.get(table_name)
     enforce(mig is not None,
             "reshard commit without prepare for table %r"
             % table_name)
+    want = str(req.get("nonce") or "")
+    enforce(not want or want == mig.get("nonce"),
+            "reshard commit nonce mismatch on %s: armed %r, asked %r "
+            "(stale cutover?)" % (serv.endpoint, mig.get("nonce"),
+                                  want))
     table = serv._table(table_name)
     t0 = time.monotonic()
     mig["sealed"] = True
@@ -240,8 +255,24 @@ def handle_activate(serv, table_name: str, req: dict) -> bytes:
     surviving srcs, retired srcs (index -1: own nothing) and fresh
     standbys alike."""
     import uuid
+    _faults.faultpoint("reshard.activate", endpoint=serv.endpoint,
+                       table=table_name)
     n_shards = int(req["n_shards"])
     index = int(req["index"])
+    want = str(req.get("nonce") or "")
+    if want:
+        # a SRC activate is fenced on the cutover nonce: a server that
+        # crashed and restored between seal and activate lost the
+        # armed migration (and was restored to the PRE-cutover epoch),
+        # so flipping it to the new map would serve rows whose delta
+        # never landed — refuse, the coordinator aborts everywhere
+        mig_armed = serv._migrations.get(table_name)
+        enforce(mig_armed is not None
+                and mig_armed.get("nonce") == want,
+                "reshard activate nonce mismatch on %s: armed %r, "
+                "asked %r (server restored mid-cutover?)"
+                % (serv.endpoint,
+                   (mig_armed or {}).get("nonce"), want))
     mig = serv._migrations.pop(table_name, None)
     dropped = 0
     if table_name in serv.lookup_tables:
@@ -270,18 +301,26 @@ def handle_activate(serv, table_name: str, req: dict) -> bytes:
 def handle_abort(serv, table_name: str, req: dict) -> bytes:
     """Roll back a prepared-but-uncommitted migration: the old map
     stays authority (rows already copied to would-be owners are inert
-    — standbys never activated)."""
+    — standbys never activated). A nonce in the request scopes the
+    abort to ONE cutover attempt — a stale coordinator's abort cannot
+    kill a newer attempt's armed migration (and a shard that already
+    activated, or never prepared, treats it as a no-op)."""
+    want = str(req.get("nonce") or "")
+    mig = serv._migrations.get(table_name)
+    if mig is not None and want and mig.get("nonce") != want:
+        return json.dumps({"aborted": False}).encode()
     mig = serv._migrations.pop(table_name, None)
+    if mig is None:
+        return json.dumps({"aborted": False}).encode()
     if table_name in serv.lookup_tables:
         serv._table(table_name).end_dirty_tracking()
-    if mig is not None:
-        for cl in mig["clients"].values():
-            try:
-                cl.close()
-            except Exception:
-                pass
+    for cl in mig["clients"].values():
+        try:
+            cl.close()
+        except Exception:
+            pass
     serv._event("reshard_aborted", table=table_name)
-    return json.dumps({"aborted": mig is not None}).encode()
+    return json.dumps({"aborted": True}).encode()
 
 
 def handle_ids(serv, table_name: str) -> bytes:
@@ -304,12 +343,32 @@ def execute_reshard(table_name: str, old_endpoints: List[str],
 
     Returns {rows_moved, bytes_moved, control_bytes, seconds,
     prepare/commit/activate per-phase stats}."""
+    import uuid
     from concurrent.futures import ThreadPoolExecutor
     old = list(old_endpoints)
     new = list(new_endpoints)
     t0 = time.monotonic()
+    # one nonce per cutover attempt: srcs arm it at prepare and fence
+    # commit/activate on it, so a src that crashed + restored between
+    # phases (losing the armed migration, reverting its rows to the
+    # pre-cutover snapshot epoch) REFUSES the stale activate — the
+    # whole attempt aborts to the old map instead of mixing epochs
+    nonce = uuid.uuid4().hex
     clients = {ep: RPCClient(ep, deadline_s=deadline_s)
                for ep in set(old) | set(new)}
+
+    def _abort_all():
+        # best-effort rollback to the old map: every shard drops its
+        # prepared migration (nonce-scoped — a shard that never saw
+        # this attempt treats it as a no-op) and keeps serving the
+        # pre-cutover partition; rows already copied stay inert
+        for ep in set(old) | set(new):
+            try:
+                clients[ep].reshard(table_name, "abort",
+                                    {"nonce": nonce})
+            except Exception:
+                pass
+
     try:
         # phase 1: concurrent peer-to-peer bulk streams, old map serves
         def prep(i_ep):
@@ -317,25 +376,31 @@ def execute_reshard(table_name: str, old_endpoints: List[str],
             return clients[ep].reshard(table_name, "prepare", {
                 "n_src": len(old), "n_dst": len(new),
                 "src_index": i, "dst_endpoints": new,
-                "chunk_rows": chunk_rows, "deadline_s": deadline_s})
+                "chunk_rows": chunk_rows, "deadline_s": deadline_s,
+                "nonce": nonce})
 
         with ThreadPoolExecutor(max_workers=max(1, len(old))) as pool:
             prepared = list(pool.map(prep, enumerate(old)))
         # phase 2: seal each src + stream its dirty delta (fast)
-        committed = [clients[ep].reshard(table_name, "commit", {})
+        committed = [clients[ep].reshard(table_name, "commit",
+                                         {"nonce": nonce})
                      for ep in old]
         # phase 3: the whole NEW map (and retired srcs) adopts slices;
-        # every delta has landed, so new owners may now accept pushes
+        # every delta has landed, so new owners may now accept pushes.
+        # Only srcs fence on the nonce (standbys never armed one)
         activated = []
         for idx, ep in enumerate(new):
+            req = {"n_shards": len(new), "index": idx}
+            if ep in old:
+                req["nonce"] = nonce
             activated.append(clients[ep].reshard(
-                table_name, "activate",
-                {"n_shards": len(new), "index": idx}))
+                table_name, "activate", req))
         for ep in old:
             if ep not in new:
                 activated.append(clients[ep].reshard(
                     table_name, "activate",
-                    {"n_shards": len(new), "index": -1}))
+                    {"n_shards": len(new), "index": -1,
+                     "nonce": nonce}))
         stats = {
             "table": table_name,
             "n_src": len(old), "n_dst": len(new),
@@ -361,6 +426,12 @@ def execute_reshard(table_name: str, old_endpoints: List[str],
                   bytes_moved=stats["bytes_moved"],
                   seconds=stats["seconds"])
         return stats
+    except BaseException:
+        # a phase failed (fault-point crash/drop, wire error, nonce
+        # fence refusal): the attempt must resolve to a CLEAN abort —
+        # old map authority, no shard left half-armed
+        _abort_all()
+        raise
     finally:
         for cl in clients.values():
             try:

@@ -623,7 +623,7 @@ class RPCClient:
         self.call("COMPLETE")
 
     def join(self, token: str, tid: Optional[int] = None,
-             deadline_s=_UNSET) -> dict:
+             phase: Optional[str] = None, deadline_s=_UNSET) -> dict:
         """Ask the server to admit a NEW trainer. The reply is parked
         server-side until the next step boundary (quorum must grow
         atomically), so callers should pass a generous deadline. The
@@ -631,12 +631,17 @@ class RPCClient:
         retried JOIN with the same token re-acks the original grant
         instead of admitting a second trainer. Pass ``tid`` to request
         a specific id (the multi-pserver protocol: first server
-        assigns, the rest confirm). -> grant dict {tid, n_trainers,
-        boundary}."""
+        assigns, the rest confirm). ``phase`` selects a step of the
+        cross-shard admission transaction ('park' | 'commit' |
+        'abort'; None = the legacy fused grant — see
+        ps.ListenAndServ._on_join). -> grant dict {tid, n_trainers,
+        boundary, epoch}."""
         import json as _json
         req = {"token": token}
         if tid is not None:
             req["tid"] = int(tid)
+        if phase:
+            req["phase"] = str(phase)
         body = self.call("JOIN", "", _json.dumps(req).encode(),
                          deadline_s=deadline_s)
         return _json.loads(body.decode())

@@ -49,6 +49,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..chaos import faultpoints as _faults
+
 _REQ_HDR = struct.Struct("<IBHQ")   # magic, verb, name_len, payload_len
 _RESP_HDR = struct.Struct("<IBQ")   # magic, status, payload_len
 _MAGIC = 0x43505254
@@ -235,27 +237,53 @@ class NetFaultProxy:
 
     def _decide(self, verb):
         """One locked decision per request frame (deterministic: the
-        seeded RNG is consumed in arrival order)."""
+        seeded RNG is consumed in arrival order).
+
+        ARMED one-shot faults are journaled through the fault-point
+        plane (``faultpoints.record`` — queue-only, safe under this
+        lock) so chaos ledgers carry one uniform ``fault_injected``
+        shape; steady-state ``drop_rate``/``delay_s`` noise is NOT —
+        it models an unreliable wire, not a discrete injection, and
+        would drown doctor's fault audit. Plans armed on the dynamic
+        ``net.request`` point act here too (crash -> disconnect)."""
         with self._mu:
+            planned = _faults.decide("net.request", verb=int(verb),
+                                     upstream="%s:%d"
+                                     % self.upstream_addr)
+            if planned == "drop":
+                return "drop", None
+            if planned == "delay":
+                return "delay", 0.05
+            if planned == "crash":
+                return "disconnect", None
+            if planned == "dup" and verb in _DUP_VERBS:
+                return "duplicate", None
             if self._corrupt_next is not None:
                 mode, self._corrupt_next = self._corrupt_next, None
+                _faults.record("net.corrupt", "drop", verb=int(verb),
+                               mode=mode)
                 return "corrupt", mode
             if self._blackhole:
                 self._event("blackhole_drop", verb)
                 return "drop", None
             if self._drop_next > 0:
                 self._drop_next -= 1
+                _faults.record("net.drop", "drop", verb=int(verb))
                 return "drop", None
             if self.drop_rate > 0 and \
                     float(self._rng.rand()) < self.drop_rate:
                 return "drop", None
             if self._dup_next > 0 and verb in _DUP_VERBS:
                 self._dup_next -= 1
+                _faults.record("net.duplicate", "dup",
+                               verb=int(verb))
                 return "duplicate", None
             if self._disconnect_after is not None:
                 self._disconnect_after -= 1
                 if self._disconnect_after <= 0:
                     self._disconnect_after = None
+                    _faults.record("net.disconnect", "crash",
+                                   verb=int(verb))
                     return "disconnect", None
             if self.delay_s > 0:
                 return "delay", self.delay_s

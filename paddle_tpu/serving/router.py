@@ -52,6 +52,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import observability as _obs
+from ..chaos import faultpoints as _faults
 from ..distributed.rpc import (DeadlineExceededError, RPCClient,
                                RpcError)
 from ..io import SIGNATURE_FILENAME
@@ -688,6 +689,12 @@ class ServingRouter:
                 break  # scale-down: probe loop ends with the replica
             beat += 1
             try:
+                # serving lease probe rides the fault-point plane: a
+                # "drop" plan loses this beat (the eviction clock keeps
+                # running — enough dropped beats and the lease expires
+                # exactly like a dead replica), a "delay" stalls it
+                _faults.faultpoint("serving.lease_probe",
+                                   endpoint=r.endpoint, replica=r.id)
                 if client is None:
                     client = RPCClient(
                         r.endpoint,

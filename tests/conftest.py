@@ -126,6 +126,14 @@ def pytest_configure(config):
         "markers",
         "sparse_serving: sparse serving plane test (tier-1; select "
         "alone with -m sparse_serving)")
+    # protocol-step fault-point plane (paddle_tpu/chaos): plane units
+    # and one crash cell per protocol run inside tier-1; the full
+    # (point x action) sweep grid also carries -m slow
+    config.addinivalue_line(
+        "markers",
+        "faultpoint: protocol-step fault-injection test (tier-1 "
+        "cells; full sweep grid is -m slow; select alone with "
+        "-m faultpoint)")
 
 
 @pytest.fixture(autouse=True)
@@ -136,7 +144,12 @@ def fresh_programs():
     from paddle_tpu.core import scope as scope_mod
     framework._reset_default_programs()
     scope_mod._reset_global_scope()
+    # a leaked FaultPlan from one test must never fire inside the
+    # next test's protocol traffic
+    from paddle_tpu.chaos import faultpoints
+    faultpoints.clear()
     yield
+    faultpoints.clear()
 
 
 @pytest.fixture

@@ -995,6 +995,13 @@ class TestBenchDiffDirections:
         drop = self._diff("reshard_bytes", unit, 190000, 60000)
         assert drop["flags"] == []
 
+    def test_join_commit_latency_lower_is_better(self):
+        unit = "seconds (2PC park -> all-shard admission commit)"
+        rise = self._diff("join_commit_latency", unit, 0.2, 2.0)
+        assert [f["flag"] for f in rise["flags"]] == ["REGRESSION"]
+        drop = self._diff("join_commit_latency", unit, 2.0, 0.2)
+        assert drop["flags"] == []
+
 
 # ---------------------------------------------------------------------------
 # p99-vs-EWMA: the latency-regression scaling trigger (ISSUE 17)

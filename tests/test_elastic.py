@@ -39,7 +39,7 @@ sys.path.insert(0, TOOLS)
 pytestmark = pytest.mark.elastic
 
 
-def _build(n_trainers, seed=5):
+def _build(n_trainers, seed=5, pservers="127.0.0.1:0"):
     main, start = fluid.Program(), fluid.Program()
     main.random_seed = start.random_seed = seed
     with fluid.unique_name.guard():
@@ -51,7 +51,7 @@ def _build(n_trainers, seed=5):
             fluid.optimizer.SGD(0.3).minimize(loss)
     t = DistributeTranspiler()
     t.transpile(0, program=main, startup_program=start,
-                pservers="127.0.0.1:0", trainers=n_trainers)
+                pservers=pservers, trainers=n_trainers)
     return t, start, loss
 
 
@@ -132,22 +132,117 @@ class TestJoinLeaveUnit:
         finally:
             s.serv.shutdown()
 
-    def test_sync_join_requires_single_dense_pserver(self):
-        main, start = fluid.Program(), fluid.Program()
-        main.random_seed = start.random_seed = 5
-        with fluid.unique_name.guard():
-            with fluid.program_guard(main, start):
-                x = layers.data("x", [8], dtype="float32")
-                label = layers.data("label", [1], dtype="int64")
-                pred = layers.fc(x, size=4, act="softmax")
-                loss = layers.mean(layers.cross_entropy(pred, label))
-                fluid.optimizer.SGD(0.3).minimize(loss)
-        t = DistributeTranspiler()
-        t.transpile(0, program=main, startup_program=start,
-                    pservers="127.0.0.1:6871,127.0.0.1:6872",
-                    trainers=1)
-        with pytest.raises(Exception, match="single dense pserver"):
-            join_running_job(t, t.get_trainer_program(), fluid.Scope())
+    def test_sync_join_two_phase_across_two_dense_pservers(self):
+        """Sync-mode JOIN over a SHARDED dense job (the restriction
+        PR 20 lifted): the joiner PARKS a grant on every pserver,
+        COMMITS, and is admitted only when EVERY shard votes at the
+        same barrier-release epoch — no shard ever sees a
+        half-member, and the grant carries the agreed epoch."""
+        t, start, loss = _build(1, pservers="127.0.0.1:0,localhost:0")
+        servers = [PServerRuntime(t, ep)
+                   for ep in list(t.pserver_endpoints)]
+        for s in servers:
+            t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+            s.serv.start()
+        trainer = t.get_trainer_program()
+        N, JOIN_AT, JSTEPS = 10, 2, 3
+        warm = threading.Event()
+        left_evt = threading.Event()
+        results, errors = {}, {}
+        grant_box = {}
+
+        def run_incumbent():
+            try:
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = ParameterServerRuntime(t, trainer, scope,
+                                            trainer_id=0,
+                                            connect_timeout_s=20.0)
+                rt.init_params()
+                out = []
+                for i in range(N):
+                    if i == JOIN_AT + 1:
+                        # hold until the commit is parked (or already
+                        # admitted) on EVERY shard — admission rides
+                        # our barrier traffic
+                        deadline = time.time() + 60
+                        while time.time() < deadline and not all(
+                                s.serv._pending_joins or s.serv._joined
+                                for s in servers):
+                            time.sleep(0.01)
+                    if i == N - 1:
+                        left_evt.wait(timeout=120)
+                    (lv,) = rt.run_step(exe, _feed(i), [loss])
+                    out.append(float(np.asarray(lv).reshape(-1)[0]))
+                    if i == JOIN_AT:
+                        warm.set()
+                rt.complete()
+                results[0] = out
+            except Exception as e:          # pragma: no cover
+                errors[0] = repr(e)
+
+        def run_joiner():
+            try:
+                assert warm.wait(timeout=60)
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = join_running_job(t, trainer, scope,
+                                      connect_timeout_s=20.0)
+                grant_box.update(rt.join_grant,
+                                 seconds=rt.join_seconds,
+                                 admit_seconds=rt.join_admit_seconds)
+                out = []
+                for i in range(JSTEPS):
+                    (lv,) = rt.run_step(exe, _feed(100 + i), [loss])
+                    out.append(float(np.asarray(lv).reshape(-1)[0]))
+                rt.leave()
+                results["join"] = out
+            except Exception as e:          # pragma: no cover
+                errors["join"] = repr(e)
+            finally:
+                left_evt.set()
+
+        evs = obs.journal_events()
+        mark = evs[-1]["seq"] if evs else 0
+        ths = [threading.Thread(target=run_incumbent),
+               threading.Thread(target=run_joiner)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=180)
+        for s in servers:
+            s.serv.shutdown()
+        assert not errors, errors
+        assert not any(th.is_alive() for th in ths)
+        assert grant_box["tid"] == 1
+        assert grant_box["n_trainers"] == 2
+        assert grant_box["admit_seconds"] < 60
+        assert len(results["join"]) == JSTEPS
+        assert all(np.isfinite(v) for out in results.values()
+                   for v in out)
+        window = obs.journal_events(since_seq=mark)
+        kinds = [e["kind"] for e in window]
+        # the transaction's paper trail: a park per shard, ONE commit
+        # record, an admission per shard — and no rollback, no
+        # eviction, no half-member anywhere
+        parked = [e for e in window
+                  if e["kind"] == "trainer_join_parked"]
+        assert len({e["endpoint"] for e in parked}) == 2
+        committed = [e for e in window
+                     if e["kind"] == "trainer_join_committed"]
+        assert len(committed) == 1 and committed[0]["shards"] == 2
+        joined = [e for e in window if e["kind"] == "trainer_joined"]
+        assert len({e["endpoint"] for e in joined}) == 2
+        # every shard voted the SAME admission epoch
+        assert len({e["epoch"] for e in joined}) == 1
+        assert committed[0]["epoch"] == joined[0]["epoch"]
+        assert "trainer_join_rollback" not in kinds
+        assert "trainer_evicted" not in kinds
+        left = [e for e in window if e["kind"] == "trainer_left"]
+        assert len({e["endpoint"] for e in left}) == 2
+        assert all(e.get("drained_partials", 0) == 0 for e in left)
 
 
 class TestElasticDense:

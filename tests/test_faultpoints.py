@@ -1,0 +1,469 @@
+"""Protocol-step fault-point plane (docs/resilience.md §Fault-point
+catalog): the deterministic injection plane itself (``FaultPlan``
+at-N firing, where-filters, the action catalog, the queued-journal
+locking contract), the doctor's ``fault_audit`` pass, the lock_lint
+gate pinning ``paddle_tpu/chaos`` in the scan set, the reshard x
+snapshot mutual fencing units, and the crash-anywhere sweep cells of
+``tools/chaos_run.py --sweep faultpoints`` — one crash cell per
+protocol runs inside tier-1, the full (point x action) grid rides
+``-m slow``. The cross-shard 2PC admission edge (a crash BETWEEN
+shard park votes) is proven here too: the joiner aborts cleanly,
+no shard is ever half-admitted."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.chaos import faultpoints as fp
+from paddle_tpu.distributed import (ParameterServerRuntime,
+                                    PServerRuntime)
+from paddle_tpu.distributed.ps import join_running_job
+from paddle_tpu.distributed import reshard as rsh
+from paddle_tpu.distributed.rpc import ServerCrash
+from paddle_tpu.transpiler import DistributeTranspiler
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+sys.path.insert(0, TOOLS)
+
+pytestmark = pytest.mark.faultpoint
+
+
+def _build(n_trainers, seed=5, pservers="127.0.0.1:0"):
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = seed
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, start):
+            x = layers.data("x", [8], dtype="float32")
+            label = layers.data("label", [1], dtype="int64")
+            pred = layers.fc(x, size=4, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.3).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, startup_program=start,
+                pservers=pservers, trainers=n_trainers)
+    return t, start, loss
+
+
+def _feed(seed=3, n=64):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.rand(n, 8).astype(np.float32),
+            "label": rs.randint(0, 4, (n, 1)).astype(np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# the plane itself
+# ---------------------------------------------------------------------------
+
+class TestFaultPlanUnits:
+    def test_fires_at_nth_hit_times_consecutive(self):
+        with fp.planned("barrier.release", "delay", at=3, times=2,
+                        delay_s=0.0) as p:
+            for _ in range(2):
+                assert fp.faultpoint("barrier.release") is None
+            fp.faultpoint("barrier.release")   # hit 3: fires
+            fp.faultpoint("barrier.release")   # hit 4: fires
+            assert fp.faultpoint("barrier.release") is None
+            assert (p.hits, p.fired) == (5, 2)
+        recs = [r for r in fp.fired()
+                if r["point"] == "barrier.release"]
+        assert [r["hit"] for r in recs] == [3, 4]
+        assert all(r["protocol"] == "barrier" for r in recs)
+
+    def test_where_filter_counts_matching_hits_only(self):
+        with fp.planned("join.park", "dup",
+                        where={"endpoint": "a:1"}) as p:
+            assert fp.faultpoint("join.park", endpoint="b:2") is None
+            assert fp.faultpoint("join.park", endpoint="a:1") == "dup"
+            assert p.hits == 1
+
+    def test_catalog_rejects_off_grid_action(self):
+        # first_merge is not a message: no "drop" cell exists for it
+        with pytest.raises(Exception):
+            fp.FaultPlan("join.first_merge", "drop")
+        with pytest.raises(Exception):
+            fp.FaultPlan("join.park", "explode")
+        # dynamic families (rpc.*, net.*) ride the plane off-catalog
+        fp.FaultPlan("rpc.SEND", "crash")
+
+    def test_drop_raises_faultdrop(self):
+        with fp.planned("join.park", "drop"):
+            with pytest.raises(fp.FaultDrop):
+                fp.faultpoint("join.park")
+
+    def test_crash_raises_servercrash(self):
+        with fp.planned("reshard.seal", "crash"):
+            with pytest.raises(ServerCrash):
+                fp.faultpoint("reshard.seal", endpoint="x:1")
+
+    def test_planned_disarms_on_exit(self):
+        with fp.planned("join.admit", "drop"):
+            assert len(fp.plans()) == 1
+        assert fp.plans() == []
+        assert fp.faultpoint("join.admit") is None
+
+    def test_decide_and_record_share_the_ledger(self):
+        with fp.planned("net.drop", "drop", where={"edge": "t0"}):
+            assert fp.decide("net.drop", edge="t1") is None
+            assert fp.decide("net.drop", edge="t0") == "drop"
+        fp.record("rpc.SEND", "crash", endpoint="y:2", after=3)
+        kinds = [(r["point"], r["action"]) for r in fp.fired()]
+        assert ("net.drop", "drop") in kinds
+        assert ("rpc.SEND", "crash") in kinds
+        shim = [r for r in fp.fired() if r["point"] == "rpc.SEND"][0]
+        assert shim["shim"] is True and shim["protocol"] == "rpc"
+
+    def test_firings_queue_and_flush_to_the_journal(self):
+        """The locking contract: faultpoint() fires inside locked
+        protocol sections, so the journal twin appears only after
+        flush_events() — never synchronously at the call site."""
+        evs = obs.journal_events()
+        mark = evs[-1]["seq"] if evs else 0
+        with fp.planned("snapshot.gc_advance", "delay", delay_s=0.0,
+                        seed=7):
+            fp.faultpoint("snapshot.gc_advance", endpoint="z:3",
+                          boundary=4)
+        fp.flush_events()
+        inj = [e for e in obs.journal_events(since_seq=mark)
+               if e["kind"] == "fault_injected"]
+        assert any(e["point"] == "snapshot.gc_advance"
+                   and e["action"] == "delay"
+                   and e["protocol"] == "snapshot"
+                   and e["plan_seed"] == 7
+                   and e["boundary"] == 4 for e in inj)
+
+
+# ---------------------------------------------------------------------------
+# doctor: the fault_audit pass
+# ---------------------------------------------------------------------------
+
+class TestFaultAudit:
+    def _ev(self, kind, t, **kw):
+        d = dict(kind=kind, t_wall=t, role="r", seq=int(t * 10))
+        d.update(kw)
+        return d
+
+    def test_no_injections_is_none(self):
+        import doctor
+        assert doctor.fault_audit([self._ev("snapshot", 1.0)]) is None
+
+    def test_explained_injection_chains(self):
+        import doctor
+        evs = [self._ev("fault_injected", 1.0, point="join.park",
+                        action="crash", protocol="join"),
+               self._ev("trainer_joined", 2.0, tid=1)]
+        rep = doctor.fault_audit(evs)
+        assert rep["ok"] and rep["injections"] == 1
+        assert rep["chains"][0]["explained_by"] == "trainer_joined"
+        assert rep["points"] == ["join.park"]
+
+    def test_unexplained_injection_fails_the_audit(self):
+        import doctor
+        evs = [self._ev("fault_injected", 1.0, point="reshard.seal",
+                        action="drop", protocol="reshard"),
+               # far past every protocol deadline, no explainer
+               self._ev("snapshot", 500.0)]
+        rep = doctor.fault_audit(evs)
+        assert not rep["ok"]
+        assert rep["unexplained"][0]["point"] == "reshard.seal"
+
+
+# ---------------------------------------------------------------------------
+# lock_lint gate: the chaos package pinned in the scan set
+# ---------------------------------------------------------------------------
+
+class TestLockLintChaosGate:
+    def test_chaos_package_scanned_and_clean(self):
+        import lock_lint
+        assert "paddle_tpu/chaos" in lock_lint.DEFAULT_PATHS
+        locks, funcs = lock_lint.scan(lock_lint.DEFAULT_PATHS)
+        assert any(fk.startswith("paddle_tpu.chaos.")
+                   for fk in funcs), \
+            "chaos/ fell out of the lock_lint scan set"
+        report = lock_lint.analyze(locks, funcs)
+        assert report["violations"] == [], report["violations"]
+
+
+# ---------------------------------------------------------------------------
+# reshard x snapshot mutual fencing units
+# ---------------------------------------------------------------------------
+
+class _FakeShard:
+    """The minimal surface the reshard handlers touch."""
+
+    def __init__(self):
+        self.endpoint = "fake:1"
+        self.lookup_tables = {}
+        self._migrations = {}
+        self._partition = None
+        self._standby = False
+        self._repartition = b"r0"
+        self.events = []
+
+    def _event(self, kind, **kw):
+        self.events.append(dict(kind=kind, **kw))
+
+
+class TestReshardSnapshotFencing:
+    def test_abort_is_nonce_scoped(self):
+        serv = _FakeShard()
+        serv._migrations["emb"] = {"nonce": "live-2", "clients": {}}
+        # a STALE coordinator's abort cannot kill a newer attempt
+        out = rsh.handle_abort(serv, "emb", {"nonce": "old-1"})
+        assert b'"aborted": false' in out.lower()
+        assert "emb" in serv._migrations and serv.events == []
+        # the owning attempt's abort lands, exactly once
+        out = rsh.handle_abort(serv, "emb", {"nonce": "live-2"})
+        assert b'"aborted": true' in out.lower()
+        assert serv._migrations == {}
+        assert [e["kind"] for e in serv.events] == ["reshard_aborted"]
+        # idempotent: a no-op abort neither raises nor journals
+        out = rsh.handle_abort(serv, "emb", {"nonce": "live-2"})
+        assert b'"aborted": false' in out.lower()
+        assert len(serv.events) == 1
+
+    def test_activate_refuses_lost_cutover_nonce(self):
+        """A shard restored from a PRE-cutover snapshot lost its armed
+        migration: activating it onto the new map would serve rows
+        whose delta never landed — the nonce fence refuses."""
+        serv = _FakeShard()
+        with pytest.raises(Exception, match="nonce mismatch"):
+            rsh.handle_activate(serv, "emb",
+                                {"n_shards": 3, "index": 0,
+                                 "nonce": "cutover-9"})
+        assert serv._partition is None and serv.events == []
+
+    def test_snapshot_meta_records_inflight_cutover_and_members(self):
+        """The snapshot boundary carries the OTHER protocol's in-
+        flight state: armed migration nonces (so a restore ledgers
+        the implicit abort) and the membership universe (so a restore
+        never resurrects an aborted grant's watermark hole)."""
+        t, start, _ = _build(1)
+        s = PServerRuntime(t, t.pserver_endpoints[0])
+        taken = {}
+        serv = s.serv
+        serv._snapshot_fn = lambda b, meta: taken.update(meta)
+        try:
+            with serv._mu:
+                serv._migrations["emb"] = {"nonce": "live-7"}
+                serv._members.add(4)
+                serv._snapshot_now_locked()
+            serv._flush_events()
+            assert taken["migrations_inflight"] == {"emb": "live-7"}
+            assert taken["members"] == [0, 4]
+            assert "barrier_released" in taken
+            assert "standby" in taken
+        finally:
+            serv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 2PC admission: a crash BETWEEN shard park votes
+# ---------------------------------------------------------------------------
+
+class TestCrashBetweenVotes:
+    def test_joiner_aborts_cleanly_never_half_admitted(self):
+        """The joiner's park lands on shard A, then shard B crashes AT
+        its park and stays down (no restart): the attempt must abort
+        cleanly — A's grant rolls back, no shard ever admits, and the
+        job's membership is untouched."""
+        t, start, loss = _build(1, pservers="127.0.0.1:0,localhost:0")
+        servers = [PServerRuntime(t, ep)
+                   for ep in list(t.pserver_endpoints)]
+        for s in servers:
+            t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+            s.serv.server.start()
+        trainer = t.get_trainer_program()
+        eps = sorted(s.serv.endpoint for s in servers)
+        by_ep = {s.serv.endpoint: s.serv for s in servers}
+        surv, dead = by_ep[eps[0]], by_ep[eps[1]]
+        evs = obs.journal_events()
+        mark = evs[-1]["seq"] if evs else 0
+        try:
+            # a real job ran and completed: quorum drained, parks are
+            # the only live protocol traffic during the join attempt
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        trainer_id=0,
+                                        connect_timeout_s=20.0)
+            rt.init_params()
+            for i in range(3):
+                rt.run_step(exe, _feed(i), [loss])
+            rt.complete()
+            with fp.planned("join.park", "crash",
+                            where={"endpoint": eps[1]}):
+                with pytest.raises(Exception):
+                    join_running_job(t, trainer, fluid.Scope(),
+                                     connect_timeout_s=5.0,
+                                     deadline_s=2.0,
+                                     join_deadline_s=3.0,
+                                     join_attempts=1)
+            # the survivor rolled the grant back: nothing parked,
+            # nothing admitted, the tid returned to the pool
+            assert surv._join_grants == {}
+            assert surv._pending_joins == []
+            assert surv._joined == set()
+            assert surv.n_trainers == 1
+            assert surv._members == {0}
+            # the crashed shard died BEFORE any grant mutation
+            assert dead._joined == set()
+            assert dead.n_trainers == 1
+            fp.flush_events()
+            window = obs.journal_events(since_seq=mark)
+            parked = [e for e in window
+                      if e["kind"] == "trainer_join_parked"]
+            assert {e["endpoint"] for e in parked} == {eps[0]}
+            assert not any(e["kind"] == "trainer_joined"
+                           for e in window)
+            inj = [e for e in window if e["kind"] == "fault_injected"]
+            assert any(e["point"] == "join.park"
+                       and e["action"] == "crash" for e in inj)
+        finally:
+            for s in servers:
+                s.serv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# merge exactness: an injected stall must not move the trajectory
+# ---------------------------------------------------------------------------
+
+class TestJoinTrajectoryExactUnderFaults:
+    def _run(self, plans=()):
+        """One 2-shard sync job with a mid-run JOIN; returns the
+        incumbent's and the joiner's loss trajectories."""
+        t, start, loss = _build(1, pservers="127.0.0.1:0,localhost:0")
+        servers = [PServerRuntime(t, ep)
+                   for ep in list(t.pserver_endpoints)]
+        for s in servers:
+            t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+            s.serv.server.start()
+        trainer = t.get_trainer_program()
+        N, JOIN_AT, JSTEPS = 8, 2, 3
+        warm, left_evt = threading.Event(), threading.Event()
+        results, errors = {}, {}
+        installed = [fp.install(p) for p in plans]
+
+        def run_incumbent():
+            try:
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = ParameterServerRuntime(t, trainer, scope,
+                                            trainer_id=0,
+                                            connect_timeout_s=20.0)
+                rt.init_params()
+                out = []
+                for i in range(N):
+                    if i == JOIN_AT + 1:
+                        deadline = time.time() + 60
+                        while time.time() < deadline and not all(
+                                s.serv._pending_joins or s.serv._joined
+                                for s in servers):
+                            time.sleep(0.01)
+                    if i == N - 1:
+                        left_evt.wait(timeout=120)
+                    (lv,) = rt.run_step(exe, _feed(i), [loss])
+                    out.append(np.asarray(lv).reshape(-1)[0])
+                    if i == JOIN_AT:
+                        warm.set()
+                rt.complete()
+                results[0] = np.asarray(out)
+            except Exception as e:          # pragma: no cover
+                errors[0] = repr(e)
+
+        def run_joiner():
+            try:
+                assert warm.wait(timeout=60)
+                scope = fluid.Scope()
+                exe = fluid.Executor()
+                exe.run(start, scope=scope)
+                rt = join_running_job(t, trainer, scope,
+                                      connect_timeout_s=20.0)
+                out = []
+                for i in range(JSTEPS):
+                    (lv,) = rt.run_step(exe, _feed(100 + i), [loss])
+                    out.append(np.asarray(lv).reshape(-1)[0])
+                rt.leave()
+                results["join"] = np.asarray(out)
+            except Exception as e:          # pragma: no cover
+                errors["join"] = repr(e)
+            finally:
+                left_evt.set()
+
+        ths = [threading.Thread(target=run_incumbent),
+               threading.Thread(target=run_joiner)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=180)
+        for s in servers:
+            s.serv.shutdown()
+        for p in installed:
+            fp.remove(p)
+        assert not errors, errors
+        return results[0], results["join"]
+
+    def test_delay_faults_leave_the_trajectory_bit_equal(self):
+        """Merges sum in TID order, not arrival order — so stalling
+        the park and the catch-up pull shifts WHEN things happen but
+        never WHAT is summed: both trajectories stay bit-identical
+        to the fault-free twin's."""
+        base_inc, base_join = self._run()
+        fault_inc, fault_join = self._run(plans=(
+            fp.FaultPlan("join.park", "delay", delay_s=0.03),
+            fp.FaultPlan("join.catchup_pull", "delay", delay_s=0.03),
+        ))
+        assert np.array_equal(base_inc, fault_inc)
+        assert np.array_equal(base_join, fault_join)
+
+
+# ---------------------------------------------------------------------------
+# crash-anywhere sweep cells (tools/chaos_run.py --sweep faultpoints)
+# ---------------------------------------------------------------------------
+
+def _cell(protocol, point, action, seed=0):
+    import chaos_run
+    driver = chaos_run._SWEEP_DRIVERS[protocol]
+    fp.clear()
+    try:
+        v = driver(point, action, seed)
+    finally:
+        fp.clear()
+    assert v["ok"], v
+    return v
+
+
+class TestSweepCellsTier1:
+    """One CRASH cell per protocol rides tier-1; the full grid is the
+    slow sweep below (and the CLI: --sweep faultpoints)."""
+
+    def test_reshard_activate_crash(self):
+        v = _cell("reshard", "reshard.activate", "crash")
+        assert v["rows_bit_equal"] and v["fault_on_ledger"]
+
+    def test_join_park_crash(self):
+        v = _cell("join", "join.park", "crash")
+        assert v["no_forged_merges"] and v["admission_atomic"]
+        assert v["fault_on_ledger"]
+
+    def test_snapshot_boundary_commit_crash(self):
+        v = _cell("snapshot", "snapshot.boundary_commit", "crash")
+        assert v["trajectory_bit_equal"] and v["fault_on_ledger"]
+
+
+@pytest.mark.slow
+class TestSweepGridFull:
+    @pytest.mark.parametrize("point,action", [
+        (p, a) for p in sorted(fp.POINTS) for a in fp.POINTS[p]])
+    def test_cell(self, point, action):
+        import chaos_run
+        _cell(chaos_run._sweep_group(point), point, action)

@@ -66,7 +66,10 @@ __all__ = ["load_rounds", "diff", "format_report"]
 # directions pinned by tests/test_step_engine.py. The elastic rows are both
 # lower-is-better via existing patterns — elastic_join_catchup by its
 # "seconds" unit, reshard_bytes by its "bytes" unit — and both
-# directions are pinned by tests/test_control.py.
+# directions are pinned by tests/test_control.py. The PR 20
+# join_commit_latency row is lower-is-better TWICE over ("latency"
+# name and "seconds" unit); both directions are pinned by
+# tests/test_control.py so neither pattern can silently rot.
 _HIGHER_IS_BETTER = re.compile(
     r"(hit.?rate|hit.fraction|speedup|examples/sec|tokens/s|qps"
     r"|rows/s)",

@@ -123,7 +123,7 @@ def run_once(args, injector, q8):
 # distributed scenarios (wire-level chaos against the PS runtime)
 # ---------------------------------------------------------------------------
 
-def _dist_build(seed, n_trainers):
+def _dist_build(seed, n_trainers, pservers="127.0.0.1:0"):
     import paddle_tpu as fluid
     from paddle_tpu import layers
     from paddle_tpu.transpiler import DistributeTranspiler
@@ -138,7 +138,7 @@ def _dist_build(seed, n_trainers):
             fluid.optimizer.SGD(0.3).minimize(loss)
     t = DistributeTranspiler()
     t.transpile(0, program=main, startup_program=start,
-                pservers="127.0.0.1:0", trainers=n_trainers)
+                pservers=pservers, trainers=n_trainers)
     return t, start, loss
 
 
@@ -267,6 +267,14 @@ def _doctor_verdict(scenario, events=None, journal_path=None):
         # like a wrong diagnosis)
         out["remediation"] = rep["remediation"]
         out["match"] = out["match"] and rep["remediation"]["ok"]
+    if rep.get("faults") is not None:
+        # fault-point injections rode this journal: doctor's fault
+        # audit must explain every one of them — an unexplained
+        # injection fails the scenario exactly like a wrong diagnosis
+        out["fault_audit"] = {
+            k: rep["faults"].get(k)
+            for k in ("ok", "unexplained", "injections", "points")}
+        out["match"] = out["match"] and rep["faults"]["ok"]
     return out
 
 
@@ -1866,6 +1874,499 @@ def _scenario_elastic_2_3_2(args):
                        "fixed": fixed.get("errors")}}
 
 
+# ---------------------------------------------------------------------------
+# fault-point sweep: crash-anywhere elasticity (docs/resilience.md
+# §Fault-point catalog). One CELL per (point x action) pair of the
+# paddle_tpu.chaos.faultpoints catalog: arm ONE deterministic plan,
+# drive the protocol end-to-end with restart machinery standing by,
+# then hold the cell to the crash-anywhere invariants — post-recovery
+# state bit-equal to the fault-free baseline OR a clean LEDGERED
+# abort, zero hung threads, a contiguous journal, a fault_injected
+# record for the cell, and doctor's fault audit explaining it.
+# ---------------------------------------------------------------------------
+
+def _cell_audit(mark, point):
+    """The invariants every sweep cell shares, computed from the
+    journal window: the injection is on the ledger, the journal has no
+    watermark holes, and doctor's fault audit explains every injected
+    fault (no unexplained injections)."""
+    import doctor
+    from paddle_tpu.chaos import faultpoints as fp
+    fp.flush_events()
+    events = _journal_events_since(mark)
+    seqs = [e["seq"] for e in events]
+    injected = [e for e in events if e["kind"] == "fault_injected"
+                and e.get("point") == point]
+    try:
+        faudit = doctor.fault_audit(events)
+    except Exception as e:
+        faudit = {"ok": False, "error": repr(e)}
+    audit_ok = bool(faudit and faudit.get("ok"))
+    return {
+        "fault_on_ledger": bool(injected),
+        "injections": len(injected),
+        "journal_contiguous": seqs == sorted(seqs) and
+        len(set(seqs)) == len(seqs),
+        "fault_audit_ok": audit_ok,
+        "fault_audit": faudit and {
+            k: faudit.get(k) for k in ("ok", "unexplained", "pending",
+                                       "injections", "error")
+            if k in faudit},
+    }
+
+
+def _sweep_reshard_cell(point, action, seed):
+    """One reshard-cutover cell: 2 active + 1 standby SparsePServers
+    (each durably snapshotting), 300 populated rows, a faulted 2->3
+    ``execute_reshard``. A failed attempt must resolve to a CLEAN
+    abort (old map authority, no armed migration anywhere) and a
+    clear-plan rerun must converge; rows are bit-preserved either
+    way and every activated shard owns exactly its %3 partition."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.chaos import faultpoints as fp
+    from paddle_tpu.distributed import (LargeScaleKV,
+                                        LookupServiceClient,
+                                        SparsePServer)
+    from paddle_tpu.distributed.reshard import execute_reshard
+    from paddle_tpu.resilience import RetryPolicy
+
+    DIM, VOCAB, LR = 16, 512, 0.5
+    rng = np.random.RandomState(seed)
+    ids = rng.permutation(VOCAB)[:300].astype(np.int64)
+    vals = (rng.randn(300, DIM) * 0.1).astype(np.float32)
+    snap_root = tempfile.mkdtemp(prefix="fp-reshard-")
+
+    def spawn(i, port=0):
+        return SparsePServer(
+            "127.0.0.1:%d" % port,
+            {"emb": LargeScaleKV(dim=DIM, lr=LR, seed=9)},
+            snapshot_dir=os.path.join(snap_root, "s%d" % i),
+            snapshot_every=1, reshard_standby=(i >= 2))
+
+    live = {i: spawn(i) for i in range(3)}
+    for s in live.values():
+        s.start()
+    eps = [live[i].endpoint for i in range(3)]
+    spawned = list(live.values())
+    stop_watch = threading.Event()
+
+    def watcher():
+        # crash-anywhere recovery: any shard that dies comes back on
+        # its OWN port from its OWN durable snapshots
+        while not stop_watch.is_set():
+            for i in range(3):
+                s = live[i]
+                if s.serv.server._stop.is_set() and \
+                        not stop_watch.is_set():
+                    s2 = spawn(i, port=s.serv.server.port)
+                    s2.start()
+                    live[i] = s2
+                    spawned.append(s2)
+            _time.sleep(0.02)
+
+    wt = threading.Thread(target=watcher, daemon=True)
+    wt.start()
+    topo = [eps[:2]]
+    cl = LookupServiceClient(
+        "emb", list(topo[0]), dim=DIM, trainer_id=0, deadline_s=2.0,
+        retry=RetryPolicy(max_retries=8, base_delay=0.02,
+                          max_delay=0.3, seed=seed),
+        topology=lambda: list(topo[0]))
+    mark = _journal_watermark()
+    t0 = _time.monotonic()
+    verdict = {"cell": "%s x %s" % (point, action)}
+    try:
+        cl.push(ids, vals)
+        before = cl.pull(np.arange(VOCAB))
+        server_side = point != "reshard.client_refetch"
+        plan = fp.install(fp.FaultPlan(point, action, seed=seed)) \
+            if server_side else None
+        aborted = False
+        try:
+            execute_reshard("emb", eps[:2], list(eps))
+        except Exception as e:
+            aborted = True
+            verdict["first_attempt_error"] = repr(e)
+        finally:
+            if plan is not None:
+                fp.remove(plan)
+        verdict["first_attempt_aborted"] = aborted
+        if aborted:
+            # clean-abort invariant, then converge with the plan gone
+            deadline = _time.time() + 30
+            while _time.time() < deadline and any(
+                    live[i].serv.server._stop.is_set()
+                    for i in range(3)):
+                _time.sleep(0.02)
+            execute_reshard("emb", eps[:2], list(eps))
+        topo[0] = list(eps)
+        if not server_side:
+            plan = fp.install(fp.FaultPlan(point, action, seed=seed))
+        try:
+            after = cl.pull(np.arange(VOCAB))
+        finally:
+            if not server_side:
+                fp.remove(plan)
+        rows_equal = bool(np.array_equal(after, before))
+        parts_ok = all(
+            live[i].serv._partition == (3, i)
+            and (live[i].tables["emb"].owned_ids() % 3 == i).all()
+            for i in range(3))
+        no_residue = not any(live[i].serv._migrations
+                             for i in range(3))
+        verdict.update(_cell_audit(mark, point))
+        verdict.update({
+            "rows_bit_equal": rows_equal,
+            "partitions_ok": parts_ok,
+            "no_migration_residue": no_residue,
+            "elapsed_s": round(_time.monotonic() - t0, 2),
+            "ok": (rows_equal and parts_ok and no_residue
+                   and verdict["fault_on_ledger"]
+                   and verdict["journal_contiguous"]
+                   and verdict["fault_audit_ok"]
+                   and (not aborted or action in ("crash", "drop"))),
+        })
+    finally:
+        stop_watch.set()
+        cl.close()
+        for s in spawned:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+        wt.join(timeout=5)
+    verdict["ok"] = verdict.get("ok", False) and not wt.is_alive()
+    return verdict
+
+
+def _sweep_snapshot_cell(point, action, seed):
+    """One snapshot-boundary cell: a single dense pserver committing a
+    durable boundary EVERY step, faulted at the ``at=2``-nd hit of the
+    point (so one good boundary exists to restore from), restarted on
+    its port when it crashes. The survivor trajectory must be
+    BIT-EQUAL to the fault-free twin — exactly-once merges through
+    restore + client replay."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.chaos import faultpoints as fp
+    from paddle_tpu.distributed import PServerRuntime
+
+    STEPS = 6
+    clean_res, clean_errs, s, _ = _dist_run(
+        seed, STEPS, snapshot_dir=tempfile.mkdtemp(prefix="fp-snap0-"))
+    s.serv.shutdown()
+    if clean_errs:
+        return {"ok": False, "error": "twin: %r" % clean_errs}
+
+    snap = tempfile.mkdtemp(prefix="fp-snap-")
+    restarted = []
+    mark = _journal_watermark()
+    plan = fp.install(fp.FaultPlan(point, action, at=2, seed=seed))
+
+    def server_hook(srt):
+        if action != "crash":
+            return
+        port = srt.serv.server.port
+
+        def restarter():
+            while not srt.serv.server._stop.is_set():
+                _time.sleep(0.02)
+            s2 = PServerRuntime(srt.t, "127.0.0.1:%d" % port,
+                                snapshot_dir=snap)
+            s2.serv.start()
+            restarted.append(s2)
+
+        threading.Thread(target=restarter, daemon=True).start()
+
+    t0 = _time.monotonic()
+    try:
+        res, errs, s, _ = _dist_run(seed, STEPS, snapshot_dir=snap,
+                                    server_hook=server_hook)
+    finally:
+        fp.remove(plan)
+    elapsed = _time.monotonic() - t0
+    s.serv.shutdown()
+    for s2 in restarted:
+        s2.serv.shutdown()
+    verdict = {"cell": "%s x %s" % (point, action)}
+    verdict.update(_cell_audit(mark, point))
+    if errs:
+        verdict.update({"ok": False,
+                        "error": {k: repr(v) for k, v in errs.items()},
+                        "elapsed_s": round(elapsed, 2)})
+        return verdict
+    equal = bool(np.array_equal(np.asarray(res[0]),
+                                np.asarray(clean_res[0])))
+    kinds = _journal_kinds(mark)
+    recovered = action != "crash" or (
+        bool(restarted) and bool(
+            kinds & {"rpc_reconnect", "phase_replay", "phase_retry"}))
+    verdict.update({
+        "trajectory_bit_equal": equal,
+        "restarted": bool(restarted),
+        "recovery_evidence": recovered,
+        "elapsed_s": round(elapsed, 2),
+        "ok": (equal and recovered and verdict["fault_on_ledger"]
+               and verdict["journal_contiguous"]
+               and verdict["fault_audit_ok"]),
+    })
+    return verdict
+
+
+def _sweep_join_cell(point, action, seed):
+    """One 2PC-JOIN cell: an incumbent syncing through TWO dense
+    pservers (each durably snapshotting, each with a restarter
+    standing by), a joiner driving the park/commit transaction under
+    the armed fault. Green means the incumbent finishes every step
+    finite, and the joiner is either admitted on EVERY shard at ONE
+    agreed epoch or rolled back on the ledger — never half-admitted;
+    any tid a shard admitted that didn't win everywhere must show its
+    abort/leave trail on that same shard."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.chaos import faultpoints as fp
+    from paddle_tpu.distributed import (ParameterServerRuntime,
+                                        PServerRuntime)
+    from paddle_tpu.distributed.ps import join_running_job
+
+    N, JOIN_AT = 8, 2
+    t, start, loss = _dist_build(seed, 1,
+                                 pservers="127.0.0.1:0,localhost:0")
+    snaps = [tempfile.mkdtemp(prefix="fp-join%d-" % i)
+             for i in range(2)]
+    live = {}
+    for i, ep in enumerate(list(t.pserver_endpoints)):
+        s = PServerRuntime(t, ep, snapshot_dir=snaps[i])
+        t.set_block_endpoints(s._minis.keys(), s.serv.endpoint)
+        s.serv.start()
+        live[i] = s
+    spawned = list(live.values())
+    stop_watch = threading.Event()
+
+    def watcher():
+        while not stop_watch.is_set():
+            for i in range(2):
+                s = live[i]
+                if s.serv.server._stop.is_set() and \
+                        not stop_watch.is_set():
+                    s2 = PServerRuntime(
+                        t, "127.0.0.1:%d" % s.serv.server.port,
+                        snapshot_dir=snaps[i])
+                    s2.serv.start()
+                    live[i] = s2
+                    spawned.append(s2)
+            _time.sleep(0.02)
+
+    wt = threading.Thread(target=watcher, daemon=True)
+    wt.start()
+    trainer = t.get_trainer_program()
+    feeds = _dist_feeds(seed, N)
+    warm = threading.Event()
+    done = threading.Event()
+    results, errors, grant_box = {}, {}, {}
+
+    def run_incumbent():
+        try:
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = ParameterServerRuntime(t, trainer, scope,
+                                        trainer_id=0, deadline_s=2.0,
+                                        connect_timeout_s=20.0)
+            rt.init_params()
+            out = []
+            for i, f in enumerate(feeds):
+                if i == JOIN_AT + 1:
+                    # hold until the join transaction resolves (a
+                    # parked commit needs our barrier traffic; a
+                    # rolled-back one unblocks us via `done`)
+                    deadline = _time.time() + 60
+                    while _time.time() < deadline and \
+                            not done.is_set() and not any(
+                                sv.serv._pending_joins or
+                                sv.serv._joined
+                                for sv in (live[0], live[1])):
+                        _time.sleep(0.01)
+                (lv,) = rt.run_step(exe, f, fetch_list=[loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+                if i == JOIN_AT:
+                    warm.set()
+            rt.complete()
+            results[0] = out
+        except Exception as e:
+            errors[0] = repr(e)
+
+    def run_joiner():
+        try:
+            warm.wait(timeout=60)
+            scope = fluid.Scope()
+            exe = fluid.Executor()
+            exe.run(start, scope=scope)
+            rt = join_running_job(t, trainer, scope, deadline_s=2.0,
+                                  connect_timeout_s=20.0,
+                                  join_deadline_s=40.0,
+                                  join_attempts=4)
+            grant_box.update(rt.join_grant,
+                             admit_seconds=rt.join_admit_seconds)
+            out = []
+            for i in range(2):
+                (lv,) = rt.run_step(exe, _dist_feeds(seed + 77, 2)[i],
+                                    [loss])
+                out.append(float(np.asarray(lv).reshape(-1)[0]))
+            rt.leave()
+            results["join"] = out
+        except Exception as e:
+            errors["join"] = repr(e)
+        finally:
+            done.set()
+
+    mark = _journal_watermark()
+    plan = fp.install(fp.FaultPlan(
+        point, action, seed=seed,
+        # barrier.release fires every boundary: skip past init-time
+        # releases so the fault lands mid-protocol
+        at=3 if point == "barrier.release" else 1))
+    t0 = _time.monotonic()
+    ths = [threading.Thread(target=run_incumbent),
+           threading.Thread(target=run_joiner)]
+    verdict = {"cell": "%s x %s" % (point, action)}
+    try:
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=150)
+    finally:
+        fp.remove(plan)
+        stop_watch.set()
+    elapsed = _time.monotonic() - t0
+    hung = any(th.is_alive() for th in ths)
+    pending_left = any(sv.serv._pending_joins
+                       for sv in (live[0], live[1]))
+    for s in spawned:
+        try:
+            s.serv.shutdown()
+        except Exception:
+            pass
+    wt.join(timeout=5)
+    verdict.update(_cell_audit(mark, point))
+    events = _journal_events_since(mark)
+    eps = sorted({sv.serv.endpoint for sv in (live[0], live[1])})
+    joined = {}
+    for e in events:
+        if e["kind"] == "trainer_joined":
+            joined.setdefault(int(e["tid"]), {})[e["endpoint"]] = \
+                int(e.get("epoch", -1))
+    rolled = {e["kind"]: True for e in events
+              if e["kind"] in ("trainer_join_aborted",
+                               "trainer_join_rollback")}
+    aborted_tids = {int(e["tid"]) for e in events
+                    if e["kind"] == "trainer_join_aborted"
+                    and int(e.get("tid", -1)) >= 0}
+    left_tids = {(e["endpoint"], int(e["tid"])) for e in events
+                 if e["kind"] == "trainer_left"}
+    atomic = True
+    for tid, by_ep in joined.items():
+        if set(by_ep) == set(eps):
+            atomic = atomic and len(set(by_ep.values())) == 1
+        else:
+            # partial admission MUST carry its rollback trail on the
+            # very shards that admitted: aborted (rolled back by the
+            # joiner) or left (drained via the LEAVE mechanics)
+            atomic = atomic and all(
+                tid in aborted_tids or (ep, tid) in left_tids
+                for ep in by_ep)
+    join_won = bool(grant_box) and "join" in results
+    # the joiner gave up: acceptable ONLY as a LEDGERED abort (a
+    # rollback/abort record exists and — via `atomic` — every shard
+    # that admitted anything shows the matching trail)
+    clean_abort = "join" in errors and bool(rolled) and not join_won
+    no_forged = all(e.get("drained_partials", 0) == 0 for e in events
+                    if e["kind"] == "trainer_left")
+    incumbent_ok = (0 in results and len(results[0]) == N
+                    and all(np.isfinite(v) for v in results[0]))
+    verdict.update({
+        "incumbent_ok": incumbent_ok,
+        "join_admitted_everywhere": join_won,
+        "join_clean_abort": clean_abort,
+        "admission_atomic": atomic,
+        "no_forged_merges": no_forged,
+        "no_parked_residue": not pending_left,
+        "hung_threads": hung,
+        "grant": dict(grant_box) or None,
+        "errors": errors or None,
+        "elapsed_s": round(elapsed, 2),
+        "ok": (incumbent_ok and atomic and no_forged
+               and not pending_left and not hung
+               and (join_won or clean_abort)
+               and verdict["fault_on_ledger"]
+               and verdict["journal_contiguous"]
+               and verdict["fault_audit_ok"]),
+    })
+    return verdict
+
+
+# point -> which sweep driver exercises it (barrier.release rides the
+# join driver: it is the admission protocol's release edge)
+def _sweep_group(point):
+    from paddle_tpu.chaos import faultpoints as fp
+    proto = fp.protocol_of(point)
+    return "join" if proto in ("join", "barrier") else proto
+
+
+_SWEEP_DRIVERS = {
+    "reshard": _sweep_reshard_cell,
+    "join": _sweep_join_cell,
+    "snapshot": _sweep_snapshot_cell,
+}
+
+
+def run_faultpoint_sweep(args):
+    """``--sweep faultpoints [--protocol P] [--actions a,b]``:
+    enumerate the catalog's (point x action) grid for the chosen
+    protocol(s) and run one cell each; exit 0 only when EVERY cell is
+    green. ``tests/test_faultpoints.py`` rides one crash cell per
+    protocol in tier-1 and the full grid under ``-m slow``."""
+    from paddle_tpu.chaos import faultpoints as fp
+    protos = ([args.protocol] if args.protocol
+              else sorted(_SWEEP_DRIVERS))
+    want_actions = set(a for a in
+                       (args.actions or "").split(",") if a)
+    report = {"sweep": "faultpoints", "seed": args.seed,
+              "protocols": protos, "cells": {}}
+    for point in sorted(fp.POINTS):
+        group = _sweep_group(point)
+        if group not in protos:
+            continue
+        for action in fp.POINTS[point]:
+            if want_actions and action not in want_actions:
+                continue
+            key = "%s x %s" % (point, action)
+            fp.clear()
+            try:
+                report["cells"][key] = _SWEEP_DRIVERS[group](
+                    point, action, args.seed)
+            except Exception as e:
+                report["cells"][key] = {"ok": False,
+                                        "error": repr(e)}
+            fp.clear()
+            fp.flush_events()
+    report["ok"] = bool(report["cells"]) and all(
+        c.get("ok") for c in report["cells"].values())
+    print(json.dumps(report, indent=2, default=str))
+    sys.exit(0 if report["ok"] else 1)
+
+
 DIST_SCENARIOS = {
     "pserver_restart": _scenario_pserver_restart,
     "trainer_kill": _scenario_trainer_kill,
@@ -1939,7 +2440,22 @@ def main():
                     "requires tools/doctor.py to name each injected "
                     "fault as its top diagnosis (exit nonzero on a "
                     "wrong/missing diagnosis)")
+    ap.add_argument("--sweep", choices=["faultpoints"], default=None,
+                    help="run the deterministic fault-point sweep: "
+                    "one cell per (point x action) pair of the "
+                    "paddle_tpu.chaos.faultpoints catalog")
+    ap.add_argument("--protocol",
+                    choices=sorted(_SWEEP_DRIVERS), default=None,
+                    help="with --sweep: restrict the grid to one "
+                    "protocol (barrier.release rides 'join')")
+    ap.add_argument("--actions", default=None,
+                    help="with --sweep: comma-separated action "
+                    "filter, e.g. 'crash' or 'crash,drop'")
     args = ap.parse_args()
+
+    if args.sweep:
+        run_faultpoint_sweep(args)
+        return
 
     if args.distributed:
         if args.steps == 30:

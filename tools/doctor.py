@@ -637,6 +637,113 @@ def remediation_audit(events: List[dict]) -> Optional[dict]:
 
 
 # ---------------------------------------------------------------------------
+# fault audit (the chaos plane's ledger, checked)
+# ---------------------------------------------------------------------------
+
+# protocol -> (journal kinds that EXPLAIN an injection at one of its
+# fault points, deadline seconds). An explanation is a recovery/abort
+# chain: the protocol either completed a later round (convergence), a
+# replay/reconnect absorbed the fault, or a clean ledgered abort named
+# it. The kinds come from the protocols' own emitters
+# (distributed/ps.py, distributed/reshard.py, serving/router.py).
+_FAULT_EXPLAIN: Dict[str, tuple] = {
+    "reshard": ({"reshard_activated", "reshard_aborted",
+                 "reshard_complete", "reshard_committed",
+                 "sparse_shard_map_applied", "sparse_shard_map_fenced",
+                 "snapshot", "rows_imported"}, 60.0),
+    "join": ({"trainer_joined", "trainer_join_aborted",
+              "trainer_join_rollback", "trainer_join_parked",
+              "trainer_join_committed", "trainer_join_catchup",
+              "dup_join_ack", "trainer_left", "barrier_aborted",
+              "trainer_evicted", "rpc_reconnect", "snapshot"}, 60.0),
+    "snapshot": ({"snapshot", "snapshot_failed", "reshard_aborted",
+                  "rpc_reconnect", "phase_replay",
+                  "dup_push_ignored"}, 60.0),
+    "barrier": ({"barrier_aborted", "dup_barrier_ack", "snapshot",
+                 "trainer_joined", "trainer_left", "phase_replay",
+                 "rpc_reconnect", "trainer_evicted"}, 60.0),
+    # the legacy crash_after shim (rpc.<VERB> kills) and the
+    # NetFaultProxy's armed one-shot faults (net.*): recovery is
+    # reconnection, phase replay, dedup absorbing a duplicate, or the
+    # lease plane evicting the silent party
+    "rpc": ({"snapshot", "rpc_reconnect", "phase_replay",
+             "phase_retry", "dup_push_ignored", "dup_send_ignored",
+             "dup_barrier_ack", "sparse_cache_invalidated",
+             "trainer_evicted", "replica_evicted",
+             "barrier_aborted"}, 60.0),
+    "net": ({"rpc_reconnect", "phase_retry", "phase_replay",
+             "dup_push_ignored", "dup_send_ignored",
+             "dup_barrier_ack", "swallow_dup_response",
+             "replica_evicted", "trainer_evicted", "router_retry",
+             "dispatch_retry"}, 60.0),
+    "serving": ({"replica_evicted", "replica_readmitted",
+                 "group_evicted", "group_readmitted",
+                 "heartbeat_rtt"}, 60.0),
+}
+
+
+def fault_audit(events: List[dict]) -> Optional[dict]:
+    """Audit the chaos plane's injection ledger (paddle_tpu/chaos):
+    every ``fault_injected`` journal event must be EXPLAINED by a
+    recovery/abort chain within its protocol's deadline — a later
+    event from the protocol's explanation set (a completed round, a
+    replay, a clean abort). Returns None when nothing was injected;
+    otherwise a dict whose ``ok`` the CI contract ``--expect`` folds
+    in (mirrors ``remediation_audit``). A deadline still running when
+    the record ends is judged pending, not unexplained."""
+    injections = [e for e in events
+                  if e.get("kind") == "fault_injected"]
+    if not injections:
+        return None
+    t_end = max((float(e.get("t_wall") or 0.0) for e in events),
+                default=0.0)
+    chains, unexplained = [], []
+    pending = 0
+    for inj in injections:
+        point = str(inj.get("point") or "?")
+        proto = str(inj.get("protocol")
+                    or point.split(".", 1)[0])
+        kinds, deadline = _FAULT_EXPLAIN.get(
+            proto, (set().union(*(k for k, _ in
+                                  _FAULT_EXPLAIN.values())), 60.0))
+        t_f = float(inj.get("t_wall") or 0.0)
+        cause = None
+        for e in events:
+            if e.get("kind") in kinds:
+                t_e = float(e.get("t_wall") or 0.0)
+                if t_f <= t_e <= t_f + deadline:
+                    cause = e
+                    break
+        link = {"point": point, "action": inj.get("action"),
+                "protocol": proto,
+                "inject_ref": "%s@%s" % (inj.get("role"),
+                                         inj.get("seq")),
+                "t_wall": t_f}
+        if cause is not None:
+            link.update({
+                "explained_by": cause.get("kind"),
+                "explain_ref": "%s@%s" % (cause.get("role"),
+                                          cause.get("seq")),
+                "inject_to_explain_s": round(
+                    float(cause.get("t_wall") or 0.0) - t_f, 3)})
+            chains.append(link)
+        elif t_end <= t_f + deadline:
+            link["pending"] = True
+            pending += 1
+            chains.append(link)
+        else:
+            unexplained.append(link)
+    chains.sort(key=lambda c: c.get("t_wall") or 0.0)
+    return {"ok": not unexplained,
+            "chains": chains,
+            "unexplained": unexplained,
+            "pending": pending,
+            "injections": len(injections),
+            "points": sorted({str(i.get("point"))
+                              for i in injections})}
+
+
+# ---------------------------------------------------------------------------
 # diagnosis
 # ---------------------------------------------------------------------------
 
@@ -673,6 +780,9 @@ def diagnose(events: List[dict], blackboxes: List[dict] = (),
     audit = remediation_audit(events)
     if audit is not None:
         report["remediation"] = audit
+    faudit = fault_audit(events)
+    if faudit is not None:
+        report["faults"] = faudit
     return report
 
 
@@ -758,6 +868,30 @@ def format_report(report: dict) -> str:
                          "%s never fired within %.0fs"
                          % (u["reason"], u["verdict_ref"],
                             u["policy"], u["deadline_s"]))
+    faudit = report.get("faults")
+    if faudit is not None:
+        lines.append("fault audit: %s — %d injection(s) at %s"
+                     % ("OK" if faudit["ok"] else "FAILED",
+                        faudit["injections"],
+                        ", ".join(faudit["points"]) or "(none)"))
+        for c in faudit["chains"]:
+            if c.get("pending"):
+                lines.append("   %s %s %s — deadline still running "
+                             "at end of record"
+                             % (c["point"], c["action"],
+                                c["inject_ref"]))
+            else:
+                lines.append("   %s %s %s -> %s (%s)%s"
+                             % (c["point"], c["action"],
+                                c["inject_ref"], c.get("explained_by"),
+                                c.get("explain_ref"),
+                                " in %.2fs" % c["inject_to_explain_s"]
+                                if c.get("inject_to_explain_s")
+                                is not None else ""))
+        for u in faudit["unexplained"]:
+            lines.append("   !! UNEXPLAINED injection %s %s %s — no "
+                         "recovery/abort chain within the deadline"
+                         % (u["point"], u["action"], u["inject_ref"]))
     return "\n".join(lines)
 
 
@@ -800,6 +934,14 @@ def main(argv=None):
                   "action(s), %d unremediated verdict(s)"
                   % (len(audit["unexplained"]),
                      len(audit["unremediated"])), file=sys.stderr)
+            return 1
+        faudit = report.get("faults")
+        if faudit is not None and not faudit["ok"]:
+            # faults were injected: the gate also demands every one is
+            # explained by a recovery/abort chain inside its deadline
+            print("doctor: fault audit FAILED — %d unexplained "
+                  "injection(s)" % len(faudit["unexplained"]),
+                  file=sys.stderr)
             return 1
     return 0
 

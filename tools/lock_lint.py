@@ -64,6 +64,13 @@ DEFAULT_PATHS = (
     # split may silently drop it from the scan
     "paddle_tpu/serving/sparse.py",
     "paddle_tpu/engine",
+    # the fault-point plane fires INSIDE protocol handlers that hold
+    # the server mutex (ps.py _mu, sparse shard locks): faultpoint()
+    # must queue its journal twin under its own registry lock and
+    # flush only from flush_events() — an emit under a held hot-path
+    # lock here would deadlock the very crash drills the plane exists
+    # to run, so the package is pinned EXPLICITLY
+    "paddle_tpu/chaos",
     # engine/pipeline.py rides paddle_tpu/engine above, but the
     # microbatch schedule it traces IS the step hot path (every
     # pipelined training step runs through it), so it is pinned

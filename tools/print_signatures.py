@@ -46,6 +46,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.contrib.utils",
     "paddle_tpu.recordio",
     "paddle_tpu.resilience",
+    "paddle_tpu.chaos",
     "paddle_tpu.compile_cache",
     "paddle_tpu.analysis",
     "paddle_tpu.distributed",
